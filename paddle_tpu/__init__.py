@@ -1,0 +1,121 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capabilities of
+PaddlePaddle Fluid (reference: /root/reference, czhu15/Paddle ~v1.5).
+
+Design (see SURVEY.md): a static-graph ``Program`` IR is built by a Python layers
+DSL (mirroring ``python/paddle/fluid/framework.py``), but execution is TPU-native:
+the Executor lowers a whole block to a single jaxpr and caches the ``jax.jit``
+compilation, instead of interpreting ops one by one against a mutable Scope
+(reference: ``paddle/fluid/framework/executor.cc:416``).  Autodiff is
+program-level reverse mode (``append_backward``) like the reference's
+``python/paddle/fluid/backward.py``, with per-op grad rules derived from the op's
+own XLA lowering via ``jax.vjp``.  Multi-device/multi-host training uses GSPMD
+(`jax.jit` over a ``jax.sharding.Mesh``) in place of the reference's
+ParallelExecutor/NCCL op-handle machinery.
+"""
+
+from . import core
+from .framework import (
+    Program,
+    Block,
+    Operator,
+    Variable,
+    Parameter,
+    program_guard,
+    name_scope,
+    default_main_program,
+    default_startup_program,
+    switch_main_program,
+    switch_startup_program,
+    cpu_places,
+    cuda_places,
+    tpu_places,
+    device_places,
+    in_dygraph_mode,
+)
+from .executor import Executor, global_scope, scope_guard, Scope
+from .param_attr import ParamAttr, WeightNormParamAttr
+from .data_feeder import DataFeeder
+from .core import CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace
+from .backward import append_backward, gradients
+from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy
+from . import layers
+from . import initializer
+from . import optimizer
+from . import regularizer
+from . import clip
+from . import nets
+from . import metrics
+from . import io
+from . import unique_name
+from . import dygraph
+from . import profiler
+from . import contrib
+from . import reader
+from .reader import PyReader, DataLoader
+from .io import (
+    save_vars,
+    save_params,
+    save_persistables,
+    load_vars,
+    load_params,
+    load_persistables,
+    save_inference_model,
+    load_inference_model,
+)
+from .initializer import set_global_initializer  # noqa: F401
+from .clip import GradientClipByGlobalNorm, GradientClipByNorm, GradientClipByValue
+from .parallel import ParallelExecutor
+from .dygraph.base import enable_dygraph, disable_dygraph
+
+# `import paddle_tpu as fluid` is the intended spelling for users of the
+# reference's `import paddle.fluid as fluid`.
+fluid = __import__(__name__)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Program",
+    "Block",
+    "Operator",
+    "Variable",
+    "Parameter",
+    "program_guard",
+    "name_scope",
+    "default_main_program",
+    "default_startup_program",
+    "Executor",
+    "ParallelExecutor",
+    "CompiledProgram",
+    "BuildStrategy",
+    "ExecutionStrategy",
+    "global_scope",
+    "scope_guard",
+    "Scope",
+    "ParamAttr",
+    "WeightNormParamAttr",
+    "DataFeeder",
+    "CPUPlace",
+    "TPUPlace",
+    "CUDAPlace",
+    "CUDAPinnedPlace",
+    "append_backward",
+    "gradients",
+    "layers",
+    "initializer",
+    "optimizer",
+    "regularizer",
+    "clip",
+    "nets",
+    "metrics",
+    "io",
+    "reader",
+    "PyReader",
+    "DataLoader",
+    "unique_name",
+    "dygraph",
+    "profiler",
+    "contrib",
+    "cpu_places",
+    "cuda_places",
+    "tpu_places",
+]

@@ -1,0 +1,302 @@
+"""Program-level reverse-mode autodiff.
+
+Mirrors the reference's ``python/paddle/fluid/backward.py``: `append_backward`
+(backward.py:432) walks the op list in reverse, appends one ``<type>_grad`` op
+per forward op, inserts `sum` ops where a var's grad fans in from several
+consumers (``_addup_repetitive_outputs_``, backward.py:135), and prunes
+branches with no grad path (backward.py:211,655).
+
+Where the reference asks a C++ registry for hand-written grad-op descs
+(``core.get_grad_op_desc``, grad_op_desc_maker.h:36), the grad op here is by
+default the *generic* ``<type>_grad`` whose lowering is ``jax.vjp`` over the
+forward lowering (ops/registry.py) — the grad program structure is identical,
+but every op's grad rule is derived from its own XLA lowering, and XLA CSEs
+the recomputed forward against the original forward ops at jit time.
+"""
+
+from .framework import Parameter, Variable, grad_var_name
+from . import unique_name
+from .ops import registry as op_registry
+
+__all__ = ["append_backward", "gradients", "calc_gradient"]
+
+
+def _find_op_path(block, targets, sources=None):
+    """Indices of ops contributing to `targets` (reference backward.py:655)."""
+    needed = set(t.name if isinstance(t, Variable) else t for t in targets)
+    path = []
+    for idx in range(len(block.ops) - 1, -1, -1):
+        op = block.ops[idx]
+        if needed & set(op.output_arg_names):
+            path.append(idx)
+            needed.update(op.input_arg_names)
+    path.reverse()
+    return path
+
+
+def _var_can_have_grad(block, name, no_grad_set):
+    if name in no_grad_set or not name or name == op_registry.EMPTY_VAR_NAME:
+        return False
+    v = block._find_var_recursive(name)
+    if v is None:
+        return False
+    if v.stop_gradient:
+        return False
+    if v.dtype is not None and v.dtype not in (
+        "float16", "float32", "float64", "bfloat16"
+    ):
+        return False
+    return True
+
+
+def _create_grad_var(block, fwd_name, grad_name):
+    fwd = block._find_var_recursive(fwd_name)
+    if block.has_var(grad_name):
+        return block.var(grad_name)
+    return block.create_var(
+        name=grad_name,
+        shape=fwd.shape if fwd is not None else None,
+        dtype=fwd.dtype if fwd is not None else "float32",
+        persistable=False,
+        stop_gradient=False,
+    )
+
+
+class _GradEngine:
+    """Reverse accumulation over one block."""
+
+    def __init__(self, block, no_grad_set):
+        self.block = block
+        self.no_grad_set = set(no_grad_set or [])
+        # forward var name -> list of pending grad var names (fan-in)
+        self.pending = {}
+        # forward var name -> resolved (summed) grad var name
+        self.resolved = {}
+
+    def seed(self, var_name, grad_name):
+        self.pending.setdefault(var_name, []).append(grad_name)
+
+    def resolve(self, var_name):
+        """Sum pending grads of `var_name` (reference
+        _addup_repetitive_outputs_)."""
+        if var_name in self.resolved:
+            return self.resolved[var_name]
+        plist = self.pending.get(var_name)
+        if not plist:
+            return None
+        if len(plist) == 1:
+            g = plist[0]
+        else:
+            g = grad_var_name(var_name)
+            if g in plist:  # canonical name already used by one producer
+                g = unique_name.generate(grad_var_name(var_name) + "@SUM")
+            _create_grad_var(self.block, var_name, g)
+            self.block.append_op(
+                type="sum",
+                inputs={"X": list(plist)},
+                outputs={"Out": [g]},
+                attrs={"op_role": "backward"},
+            )
+        self.resolved[var_name] = g
+        return g
+
+    def new_grad_name(self, var_name):
+        base = grad_var_name(var_name)
+        n = len(self.pending.get(var_name, []))
+        if n == 0 and not self.block.has_var(base):
+            return base
+        return unique_name.generate(base + "@RENAME")
+
+    def backprop_op(self, op):
+        """Append the grad op(s) for `op`; returns True if appended."""
+        try:
+            opdef = op_registry.get_op_def(op.type)
+        except op_registry.OpNotRegistered:
+            return False
+        if opdef.no_grad:
+            return False
+
+        # resolve available output grads
+        out_grads = {}
+        any_grad = False
+        for slot, names in op.outputs.items():
+            if slot in opdef.stateful_outputs:
+                continue
+            gnames = []
+            got = False
+            for y in names:
+                g = self.resolve(y)
+                gnames.append(g if g is not None else op_registry.EMPTY_VAR_NAME)
+                got = got or g is not None
+            if got:
+                out_grads[slot + "@GRAD"] = gnames
+                any_grad = True
+        if not any_grad:
+            return False
+
+        # which input grads to produce
+        in_grads = {}
+        for slot, names in op.inputs.items():
+            gnames = []
+            need = False
+            for x in names:
+                if _var_can_have_grad(self.block, x, self.no_grad_set):
+                    gn = self.new_grad_name(x)
+                    gnames.append(gn)
+                    need = True
+                else:
+                    gnames.append(op_registry.EMPTY_VAR_NAME)
+            if need:
+                in_grads[slot + "@GRAD"] = gnames
+        if not in_grads:
+            return False
+
+        if opdef.grad_maker is not None:
+            descs = opdef.grad_maker(op, self.block, out_grads, in_grads)
+        else:
+            # default maker: grad op sees all fwd inputs, outputs, out-grads
+            inputs = {}
+            for slot, names in op.inputs.items():
+                inputs[slot] = list(names)
+            for slot, names in op.outputs.items():
+                inputs[slot] = list(names)
+            inputs.update(out_grads)
+            attrs = dict(op.attrs)
+            attrs["__fwd_op_id__"] = op.attrs.get("__op_id__", 0)
+            attrs["op_role"] = "backward"
+            attrs.pop("__op_id__", None)
+            descs = [
+                {
+                    "type": op.type + "_grad",
+                    "inputs": inputs,
+                    "outputs": in_grads,
+                    "attrs": attrs,
+                }
+            ]
+
+        for d in descs:
+            for slot, gnames in d["outputs"].items():
+                if not slot.endswith("@GRAD"):
+                    continue
+                fwd_slot = slot[: -len("@GRAD")]
+                fwd_names = op.inputs.get(fwd_slot, [])
+                for fn_, gn in zip(fwd_names, gnames):
+                    if gn != op_registry.EMPTY_VAR_NAME:
+                        _create_grad_var(self.block, fn_, gn)
+            self.block.append_op(
+                type=d["type"],
+                inputs=d["inputs"],
+                outputs=d["outputs"],
+                attrs=d.get("attrs", {}),
+            )
+
+        # register produced grads as pending on the forward inputs
+        for slot, names in op.inputs.items():
+            gnames = in_grads.get(slot + "@GRAD")
+            if not gnames:
+                continue
+            for x, g in zip(names, gnames):
+                if g != op_registry.EMPTY_VAR_NAME:
+                    self.pending.setdefault(x, []).append(g)
+        return True
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Append grad ops for `loss`; returns [(param, grad_var)] (reference
+    backward.py:432)."""
+    assert isinstance(loss, Variable)
+    block = loss.block
+    program = block.program
+
+    no_grad = set(no_grad_set or [])
+    for v in block.vars.values():
+        if v.stop_gradient and not isinstance(v, Parameter):
+            no_grad.add(v.name)
+
+    op_path = _find_op_path(block, [loss])
+
+    # d(loss)/d(loss) = 1
+    loss_g_name = grad_var_name(loss.name)
+    loss_grad = block.create_var(
+        name=loss_g_name,
+        shape=loss.shape or (1,),
+        dtype=loss.dtype,
+        persistable=False,
+    )
+    block.append_op(
+        type="fill_constant",
+        outputs={"Out": [loss_grad]},
+        attrs={
+            "shape": list(loss.shape or (1,)),
+            "value": 1.0,
+            "dtype": loss.dtype,
+            "op_role": "backward",
+        },
+    )
+
+    engine = _GradEngine(block, no_grad)
+    engine.seed(loss.name, loss_g_name)
+    for idx in reversed(op_path):
+        engine.backprop_op(block.ops[idx])
+
+    if parameter_list is not None:
+        params = [
+            block.program.global_block().var(p) if isinstance(p, str) else p
+            for p in parameter_list
+        ]
+    else:
+        params = [p for p in program.all_parameters() if p.trainable]
+
+    params_grads = []
+    for p in params:
+        g = engine.resolve(p.name)
+        if g is None:
+            continue
+        params_grads.append((p, block.var(g)))
+    return params_grads
+
+
+def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Grads of `targets` w.r.t. `inputs` (reference backward.py:695)."""
+    if isinstance(targets, Variable):
+        targets = [targets]
+    if isinstance(inputs, Variable):
+        inputs = [inputs]
+    if isinstance(target_gradients, Variable):
+        target_gradients = [target_gradients]
+    block = targets[0].block
+
+    engine = _GradEngine(block, no_grad_set)
+    op_path = _find_op_path(block, targets)
+    for i, t in enumerate(targets):
+        tg = target_gradients[i] if target_gradients else None
+        if tg is None:
+            gname = grad_var_name(t.name)
+            gv = block.create_var(
+                name=gname, shape=t.shape, dtype=t.dtype
+            )
+            block.append_op(
+                type="fill_constant",
+                outputs={"Out": [gv]},
+                attrs={
+                    "shape": list(t.shape or (1,)),
+                    "value": 1.0,
+                    "dtype": t.dtype,
+                    "op_role": "backward",
+                },
+            )
+            engine.seed(t.name, gname)
+        else:
+            engine.seed(t.name, tg.name)
+    for idx in reversed(op_path):
+        engine.backprop_op(block.ops[idx])
+    outs = []
+    for x in inputs:
+        g = engine.resolve(x.name)
+        outs.append(block.var(g) if g is not None else None)
+    return outs
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    return calc_gradient(targets, inputs, target_gradients, no_grad_set)

@@ -1,0 +1,200 @@
+"""Gradient clipping appended as graph ops (reference:
+``python/paddle/fluid/clip.py``)."""
+
+from .framework import default_main_program
+from . import unique_name
+
+__all__ = [
+    "GradientClipByValue",
+    "GradientClipByNorm",
+    "GradientClipByGlobalNorm",
+    "set_gradient_clip",
+    "ErrorClipByValue",
+]
+
+
+class BaseErrorClipAttr:
+    pass
+
+
+class ErrorClipByValue(BaseErrorClipAttr):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = min if min is not None else -max
+
+
+def error_clip_callback(block, context):
+    pass
+
+
+class BaseGradientClipAttr:
+    def _process(self, params_grads):
+        raise NotImplementedError
+
+
+class NullGradientClipAttr(BaseGradientClipAttr):
+    def _process(self, params_grads):
+        return params_grads
+
+
+class GradientClipByValue(BaseGradientClipAttr):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def _clip_one(self, block, grad):
+        out = block.create_var(
+            name=unique_name.generate(grad.name + ".clip"),
+            shape=grad.shape, dtype=grad.dtype,
+        )
+        block.append_op(
+            type="clip", inputs={"X": [grad]}, outputs={"Out": [out]},
+            attrs={"min": self.min, "max": self.max, "op_role": "backward"},
+        )
+        return out
+
+    def _process(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            out.append((p, self._clip_one(g.block, g)))
+        return out
+
+
+class GradientClipByNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _process(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            block = g.block
+            o = block.create_var(
+                name=unique_name.generate(g.name + ".clipnorm"),
+                shape=g.shape, dtype=g.dtype,
+            )
+            block.append_op(
+                type="clip_by_norm", inputs={"X": [g]},
+                outputs={"Out": [o]},
+                attrs={"max_norm": self.clip_norm, "op_role": "backward"},
+            )
+            out.append((p, o))
+        return out
+
+
+class GradientClipByGlobalNorm(BaseGradientClipAttr):
+    """scale = clip_norm / max(global_norm, clip_norm), applied to every
+    grad (reference clip.py GradientClipByGlobalNorm)."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _process(self, params_grads):
+        live = [(p, g) for p, g in params_grads if g is not None]
+        if not live:
+            return params_grads
+        block = live[0][1].block
+        sq_norms = []
+        for _, g in live:
+            sq = block.create_var(
+                name=unique_name.generate(g.name + ".sq"),
+                shape=[1], dtype="float32",
+            )
+            block.append_op(
+                type="squared_l2_norm", inputs={"X": [g]},
+                outputs={"Out": [sq]}, attrs={"op_role": "backward"},
+            )
+            sq_norms.append(sq)
+        total = block.create_var(
+            name=unique_name.generate("global_norm_sq"), shape=[1],
+            dtype="float32",
+        )
+        block.append_op(
+            type="sum", inputs={"X": sq_norms}, outputs={"Out": [total]},
+            attrs={"op_role": "backward"},
+        )
+        gnorm = block.create_var(
+            name=unique_name.generate("global_norm"), shape=[1],
+            dtype="float32",
+        )
+        block.append_op(
+            type="sqrt", inputs={"X": [total]}, outputs={"Out": [gnorm]},
+            attrs={"op_role": "backward"},
+        )
+        # denom = max(gnorm, clip_norm); scale = clip_norm / denom
+        clipc = block.create_var(
+            name=unique_name.generate("clip_norm_const"), shape=[1],
+            dtype="float32",
+        )
+        block.append_op(
+            type="fill_constant", outputs={"Out": [clipc]},
+            attrs={"shape": [1], "dtype": "float32", "value": self.clip_norm,
+                   "op_role": "backward"},
+        )
+        denom = block.create_var(
+            name=unique_name.generate("clip_denom"), shape=[1],
+            dtype="float32",
+        )
+        block.append_op(
+            type="elementwise_max", inputs={"X": [gnorm], "Y": [clipc]},
+            outputs={"Out": [denom]}, attrs={"op_role": "backward"},
+        )
+        scale = block.create_var(
+            name=unique_name.generate("clip_scale"), shape=[1],
+            dtype="float32",
+        )
+        block.append_op(
+            type="elementwise_div", inputs={"X": [clipc], "Y": [denom]},
+            outputs={"Out": [scale]}, attrs={"op_role": "backward"},
+        )
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            o = g.block.create_var(
+                name=unique_name.generate(g.name + ".gclip"),
+                shape=g.shape, dtype=g.dtype,
+            )
+            g.block.append_op(
+                type="elementwise_mul", inputs={"X": [g], "Y": [scale]},
+                outputs={"Out": [o]}, attrs={"op_role": "backward"},
+            )
+            out.append((p, o))
+        return out
+
+
+_clip_attr = {}
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    if program is None:
+        program = default_main_program()
+    _clip_attr[id(program)] = clip
+
+
+def append_gradient_clip_ops(params_grads):
+    if not params_grads:
+        return params_grads
+    program = params_grads[0][0].block.program
+    clip = _clip_attr.get(id(program))
+    # per-param clip attrs win (reference clip.py:333)
+    per_param = [
+        getattr(p, "gradient_clip_attr", None) for p, _ in params_grads
+    ]
+    if clip is None and not any(per_param):
+        return params_grads
+    if clip is not None:
+        return clip._process(params_grads)
+    out = []
+    for (p, g), attr in zip(params_grads, per_param):
+        if attr is None or g is None:
+            out.append((p, g))
+        else:
+            out.extend(attr._process([(p, g)]))
+    return out

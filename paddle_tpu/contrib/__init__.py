@@ -1,0 +1,6 @@
+"""Contrib surface (reference: ``python/paddle/fluid/contrib/``):
+mixed_precision AMP, slim (quant/prune/NAS), extend optimizers."""
+
+from . import mixed_precision
+
+__all__ = ["mixed_precision"]

@@ -1,0 +1,4 @@
+from .decorator import decorate, OptimizerWithMixedPrecision
+from . import fp16_lists
+
+__all__ = ["decorate", "OptimizerWithMixedPrecision", "fp16_lists"]

@@ -1,0 +1,46 @@
+"""Low-precision op lists (reference:
+``python/paddle/fluid/contrib/mixed_precision/fp16_lists.py``).
+
+TPU note: the low precision is **bfloat16**, not float16 — same exponent
+range as fp32, so no loss scaling is required and the dynamic-loss-scaling
+machinery of the reference degenerates to a no-op."""
+
+# matmul-class ops: run in bf16 on the MXU (fp32 accumulation is set via
+# preferred_element_type in the op lowerings)
+white_list = {
+    "mul",
+    "matmul",
+    "conv2d",
+    "depthwise_conv2d",
+    "conv3d",
+    "conv2d_transpose",
+}
+
+# numerically sensitive ops: keep fp32 inputs
+black_list = {
+    "softmax_with_cross_entropy",
+    "cross_entropy",
+    "softmax",
+    "log_softmax",
+    "mean",
+    "reduce_mean",
+    "reduce_sum",
+    "layer_norm",
+    "batch_norm",
+    "exp",
+    "log",
+    "squared_l2_norm",
+}
+
+# everything else follows its inputs
+gray_list = set()
+
+
+class AutoMixedPrecisionLists:
+    def __init__(self, custom_white_list=None, custom_black_list=None):
+        self.white_list = set(white_list)
+        self.black_list = set(black_list)
+        if custom_white_list:
+            self.white_list |= set(custom_white_list)
+        if custom_black_list:
+            self.black_list |= set(custom_black_list)

@@ -1,0 +1,140 @@
+"""Core runtime types: places, dtypes, VarType.
+
+The reference implements these natively (``paddle/fluid/platform/place.h``,
+``paddle/fluid/framework/framework.proto:105`` VarType) and exposes them via
+pybind (``paddle/fluid/pybind/pybind.cc``).  On TPU the device abstraction is
+jax's; a Place here is a thin selector that maps onto a ``jax.Device`` (or the
+whole default device set), so `Executor(place)` keeps the reference API shape
+while jit/XLA own actual placement.
+"""
+
+import enum
+
+import numpy as np
+
+
+class VarDesc:
+    """Namespace mirroring the reference's VarDesc proto enums
+    (``framework.proto:105-163``)."""
+
+    class VarType(enum.IntEnum):
+        # tensor types
+        BOOL = 0
+        INT16 = 1
+        INT32 = 2
+        INT64 = 3
+        FP16 = 4
+        FP32 = 5
+        FP64 = 6
+        SIZE_T = 19
+        UINT8 = 20
+        INT8 = 21
+        BF16 = 22
+        # container / special types
+        LOD_TENSOR = 7
+        SELECTED_ROWS = 8
+        FEED_MINIBATCH = 9
+        FETCH_LIST = 10
+        STEP_SCOPES = 11
+        LOD_RANK_TABLE = 12
+        LOD_TENSOR_ARRAY = 13
+        PLACE_LIST = 14
+        READER = 15
+        RAW = 17
+        TUPLE = 18
+
+
+_DTYPE_TO_VARTYPE = {
+    np.dtype("bool"): VarDesc.VarType.BOOL,
+    np.dtype("int16"): VarDesc.VarType.INT16,
+    np.dtype("int32"): VarDesc.VarType.INT32,
+    np.dtype("int64"): VarDesc.VarType.INT64,
+    np.dtype("float16"): VarDesc.VarType.FP16,
+    np.dtype("float32"): VarDesc.VarType.FP32,
+    np.dtype("float64"): VarDesc.VarType.FP64,
+    np.dtype("uint8"): VarDesc.VarType.UINT8,
+    np.dtype("int8"): VarDesc.VarType.INT8,
+}
+
+_VARTYPE_TO_DTYPE = {v: k for k, v in _DTYPE_TO_VARTYPE.items()}
+
+
+def convert_np_dtype_to_dtype_(dtype):
+    """Normalize a user dtype spec (str / np.dtype / VarType) to a canonical
+    string.  'bfloat16' is kept as a string since numpy has no native bf16."""
+    if isinstance(dtype, VarDesc.VarType):
+        if dtype == VarDesc.VarType.BF16:
+            return "bfloat16"
+        return _VARTYPE_TO_DTYPE[dtype].name
+    if isinstance(dtype, str):
+        if dtype in ("bfloat16", "bf16"):
+            return "bfloat16"
+        return np.dtype(dtype).name
+    return np.dtype(dtype).name
+
+
+def dtype_is_floating(dtype):
+    d = convert_np_dtype_to_dtype_(dtype)
+    return d in ("float16", "float32", "float64", "bfloat16")
+
+
+class Place:
+    """Base device selector."""
+
+    _kind = "base"
+
+    def __init__(self, device_id=0):
+        self._device_id = int(device_id)
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self._device_id == other._device_id
+
+    def __hash__(self):
+        return hash((type(self).__name__, self._device_id))
+
+    def __repr__(self):
+        return "%s(%d)" % (type(self).__name__, self._device_id)
+
+    def jax_device(self):
+        """Resolve to a concrete jax.Device (lazy import keeps `core` light)."""
+        import jax
+
+        if isinstance(self, CPUPlace):
+            devs = jax.devices("cpu")
+        else:
+            devs = jax.devices()
+        return devs[self._device_id % len(devs)]
+
+
+class CPUPlace(Place):
+    _kind = "cpu"
+
+
+class TPUPlace(Place):
+    """The native accelerator place of this framework (reference analogue:
+    CUDAPlace, ``platform/place.h``)."""
+
+    _kind = "tpu"
+
+
+# Alias for source compatibility with reference user scripts; on this
+# framework "CUDA" places simply select the default jax accelerator.
+CUDAPlace = TPUPlace
+
+
+class CUDAPinnedPlace(Place):
+    _kind = "pinned"
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_tpu():
+    return True
+
+
+def get_device_count():
+    import jax
+
+    return jax.device_count()

@@ -1,0 +1,38 @@
+"""DataFeeder: convert user minibatch rows → feed arrays (reference:
+``python/paddle/fluid/data_feeder.py``)."""
+
+import numpy as np
+
+from .framework import Variable
+
+__all__ = ["DataFeeder"]
+
+
+class DataFeeder:
+    def __init__(self, feed_list, place=None, program=None):
+        self.feed_vars = []
+        for v in feed_list:
+            if isinstance(v, str):
+                from .framework import default_main_program
+
+                v = (program or default_main_program()).global_block().var(v)
+            self.feed_vars.append(v)
+        self.place = place
+
+    def feed(self, iterable):
+        """iterable of rows, each row a tuple with one entry per feed var."""
+        columns = [[] for _ in self.feed_vars]
+        for row in iterable:
+            for i, item in enumerate(row):
+                columns[i].append(np.asarray(item))
+        out = {}
+        for var, col in zip(self.feed_vars, columns):
+            arr = np.stack(col)
+            want = var.shape
+            # reference feeders deliver labels as [N, 1]
+            if want is not None and len(want) == arr.ndim + 1 and want[-1] == 1:
+                arr = arr[..., None]
+            if var.dtype is not None and var.dtype != "bfloat16":
+                arr = arr.astype(var.dtype)
+            out[var.name] = arr
+        return out

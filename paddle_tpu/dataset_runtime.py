@@ -1,0 +1,26 @@
+"""Dataset → executor bridge (reference: ``Executor::RunFromDataset``,
+``executor.cc:120`` → trainers/device workers).  The Dataset/DataFeed
+pipeline lands with the CTR batch; this keeps the Executor entry points
+importable."""
+
+
+def run_from_dataset(executor, program, dataset, scope, fetch_list,
+                     fetch_info, print_period, train=True):
+    if dataset is None:
+        raise ValueError("dataset is required")
+    it = dataset.batch_iterator()
+    results = []
+    for i, feed in enumerate(it):
+        out = executor.run(
+            program, feed=feed, fetch_list=fetch_list, scope=scope
+        )
+        if fetch_list and print_period and i % print_period == 0:
+            names = fetch_info or [
+                getattr(v, "name", str(v)) for v in fetch_list
+            ]
+            msg = ", ".join(
+                "%s=%s" % (n, o.reshape(-1)[:3]) for n, o in zip(names, out)
+            )
+            print("[paddle_tpu] step %d: %s" % (i, msg))
+        results.append(out)
+    return results

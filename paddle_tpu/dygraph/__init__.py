@@ -1,0 +1,11 @@
+"""Imperative (dygraph) front-end (reference:
+``paddle/fluid/imperative/`` + ``python/paddle/fluid/dygraph/``).
+
+The eager tracer + Layer/nn module surface lands as its own batch (SURVEY.md
+§7 stage 9); `guard`/`to_variable` plumbing is here so user scripts import
+cleanly."""
+
+from .base import guard, enabled, to_variable, enable_dygraph, disable_dygraph
+
+__all__ = ["guard", "enabled", "to_variable", "enable_dygraph",
+           "disable_dygraph"]

@@ -1,0 +1,40 @@
+"""Dygraph mode plumbing (reference: ``python/paddle/fluid/dygraph/base.py``).
+
+On TPU, eager mode is simply jax's default op-by-op dispatch; the full
+Layer/autograd surface lands with the dygraph batch."""
+
+import contextlib
+
+from .. import framework
+
+__all__ = ["guard", "enabled", "to_variable", "enable_dygraph",
+           "disable_dygraph"]
+
+
+def enabled():
+    return framework.in_dygraph_mode()
+
+
+def enable_dygraph(place=None):
+    framework._dygraph_tracer_ = object()  # marker; eager dispatch is jax's
+
+
+def disable_dygraph():
+    framework._dygraph_tracer_ = None
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    enable_dygraph(place)
+    try:
+        yield
+    finally:
+        disable_dygraph()
+
+
+def to_variable(value, block=None, name=None):
+    import jax.numpy as jnp
+
+    if not framework.in_dygraph_mode():
+        raise RuntimeError("to_variable requires dygraph mode (use guard())")
+    return jnp.asarray(value)

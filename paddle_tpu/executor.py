@@ -1,0 +1,396 @@
+"""Executor: Program → jaxpr lowering + jit cache.
+
+The reference Executor interprets a block op-by-op against a mutable Scope
+(``paddle/fluid/framework/executor.cc:416`` hot loop, kernel dispatch at
+``operator.cc:881``).  On TPU that design would bounce every intermediate
+through HBM and defeat XLA fusion, so this Executor instead:
+
+1. analyzes the block once: feeds, fetches, which scope (persistable) vars
+   are read, which are written (SSA-ification of the mutable-Scope program);
+2. lowers the whole block into ONE pure jax function
+   ``f(feeds, mutable_params, ro_params, rng_key) -> (fetches, new_params)``;
+3. ``jax.jit``-compiles it with the mutable param buffers donated (the
+   functional analogue of the reference's in-place param updates + its
+   memory-reuse passes), and caches the compilation keyed on
+   (program version, feed shapes/dtypes, fetch names) — the same shape-keyed
+   engine cache the reference's nGraph bridge uses
+   (``operators/ngraph/ngraph_engine.cc:515``).
+
+Feed/fetch become function arguments/results instead of `feed`/`fetch` ops
+writing into scope slots (``executor.cc:254-325``); `feed`/`fetch` ops that
+exist in serialized programs are recognized and skipped.
+"""
+
+import contextlib
+
+import numpy as np
+
+from . import core
+from .framework import Program, default_main_program, Variable
+from .ops import registry as op_registry
+from .ops.registry import EMPTY_VAR_NAME
+
+__all__ = ["Executor", "Scope", "global_scope", "scope_guard"]
+
+
+class _ScopeTensor:
+    """LoDTensor-flavored view over a scope entry (reference
+    ``pybind.cc:202`` Tensor bindings): supports np.array(t), t.set(arr),
+    t.shape()."""
+
+    def __init__(self, scope, name):
+        self._scope = scope
+        self._name = name
+
+    def set(self, array, place=None):
+        import jax.numpy as jnp
+
+        self._scope.vars[self._name] = jnp.asarray(array)
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._scope.vars[self._name])
+        return a.astype(dtype) if dtype is not None else a
+
+    def shape(self):
+        return list(np.shape(self._scope.vars[self._name]))
+
+    def set_lod(self, lod):
+        self._scope.lod[self._name] = lod
+
+    def lod(self):
+        return self._scope.lod.get(self._name, [])
+
+
+class _ScopeVar:
+    def __init__(self, scope, name):
+        self._scope = scope
+        self._name = name
+
+    def get_tensor(self):
+        return _ScopeTensor(self._scope, self._name)
+
+    def name(self):
+        return self._name
+
+
+class Scope:
+    """name → device array map (reference ``framework/scope.h:45``; the
+    parent-chain lexical lookup is preserved for local scopes)."""
+
+    def __init__(self, parent=None):
+        self.vars = {}
+        self.lod = {}
+        self.parent = parent
+        self._kids = []
+
+    def var(self, name):
+        if name not in self.vars and self.find_var(name) is None:
+            self.vars[name] = None
+        return _ScopeVar(self._owner_of(name), name)
+
+    def find_var(self, name):
+        s = self
+        while s is not None:
+            if name in s.vars:
+                return _ScopeVar(s, name)
+            s = s.parent
+        return None
+
+    def _owner_of(self, name):
+        s = self
+        while s is not None:
+            if name in s.vars:
+                return s
+            s = s.parent
+        return self
+
+    def new_scope(self):
+        kid = Scope(parent=self)
+        self._kids.append(kid)
+        return kid
+
+    def drop_kids(self):
+        self._kids = []
+
+    def local_var_names(self):
+        return list(self.vars)
+
+    # internal helpers
+    def get(self, name):
+        s = self
+        while s is not None:
+            if name in s.vars:
+                return s.vars[name]
+            s = s.parent
+        return None
+
+    def has(self, name):
+        s = self
+        while s is not None:
+            if name in s.vars:
+                return True
+            s = s.parent
+        return False
+
+    def set(self, name, value):
+        self._owner_of(name).vars[name] = value
+
+
+_global_scope = Scope()
+_scope_stack = [_global_scope]
+
+
+def global_scope():
+    return _scope_stack[-1]
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    _scope_stack.append(scope)
+    try:
+        yield
+    finally:
+        _scope_stack.pop()
+
+
+def as_numpy(value):
+    if isinstance(value, (list, tuple)):
+        return [as_numpy(v) for v in value]
+    return np.asarray(value)
+
+
+def _analyze_block(block, feed_names, fetch_names):
+    """SSA analysis: (external scope reads, written names, written persistables)."""
+    defined = set(feed_names)
+    ext_reads = []
+    written = []
+    for op in block.ops:
+        if op.type in ("feed", "fetch"):
+            continue
+        for n in op.input_arg_names:
+            if n and n != EMPTY_VAR_NAME and n not in defined:
+                if n not in ext_reads:
+                    ext_reads.append(n)
+        for n in op.output_arg_names:
+            if n and n != EMPTY_VAR_NAME:
+                defined.add(n)
+                written.append(n)
+    for n in fetch_names:
+        if n not in defined and n not in ext_reads:
+            ext_reads.append(n)
+    persist_written = []
+    for n in written:
+        v = block._find_var_recursive(n)
+        if v is not None and v.persistable and n not in persist_written:
+            persist_written.append(n)
+    return ext_reads, written, persist_written
+
+
+class _CompiledBlock:
+    def __init__(self, program, block, feed_names, fetch_names, scope, mode,
+                 mesh=None):
+        import jax
+
+        self.feed_names = list(feed_names)
+        self.fetch_names = list(fetch_names)
+        ext_reads, written, persist_written = _analyze_block(
+            block, feed_names, fetch_names
+        )
+        # vars read from scope, split into mutated (donated) vs read-only
+        self.rw_names = [n for n in ext_reads if n in persist_written]
+        self.ro_names = [n for n in ext_reads if n not in persist_written]
+        # persistables written but never read (e.g. startup init, fresh
+        # accumulators) are also returned to the scope
+        self.fresh_persist = [n for n in persist_written if n not in self.rw_names]
+        self.block = block
+        self.mode = mode
+
+        missing = [n for n in ext_reads if not scope.has(n)]
+        if missing:
+            data_vars = []
+            state_vars = []
+            for n in missing:
+                v = block._find_var_recursive(n)
+                (data_vars if v is not None and v.is_data else
+                 state_vars).append(n)
+            msgs = []
+            if data_vars:
+                msgs.append(
+                    "data variables %s were not fed — pass them in `feed`"
+                    % data_vars
+                )
+            if state_vars:
+                msgs.append(
+                    "variables %s are not initialized in scope — run the "
+                    "startup program first" % state_vars
+                )
+            raise RuntimeError(
+                "; ".join(msgs)
+                + " (reference: executor.cc enforce 'Tensor holds no memory')"
+            )
+
+        def run_block(feeds, rw, ro, key):
+            env = {}
+            env.update(ro)
+            env.update(rw)
+            env.update(feeds)
+            ctx = op_registry.LoweringContext(base_key=key, mode=mode)
+            _run_ops_into_env(block, env, ctx)
+            fetches = [env[n] for n in self.fetch_names]
+            new_rw = {n: env[n] for n in self.rw_names}
+            fresh = {n: env[n] for n in self.fresh_persist if n in env}
+            return fetches, new_rw, fresh
+
+        if mesh is None:
+            self.jitted = jax.jit(run_block, donate_argnums=(1,))
+        else:
+            # SPMD: batch dim of every feed sharded over the mesh's data
+            # axis, params replicated; GSPMD inserts the ICI collectives
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            data_axis = mesh.axis_names[0]
+            batch = NamedSharding(mesh, P(data_axis))
+            repl = NamedSharding(mesh, P())
+            feed_sh = {n: batch for n in self.feed_names}
+            rw_sh = {n: repl for n in self.rw_names}
+            ro_sh = {n: repl for n in self.ro_names}
+            self.jitted = jax.jit(
+                run_block,
+                donate_argnums=(1,),
+                in_shardings=(feed_sh, rw_sh, ro_sh, repl),
+            )
+
+
+def _run_ops_into_env(block, env, ctx):
+    """Lower every op of `block` into `env` (the SSA value map)."""
+    for op in block.ops:
+        if op.type in ("feed", "fetch"):
+            continue
+        opdef = op_registry.get_op_def(op.type)
+        ins = {}
+        for slot, names in op.inputs.items():
+            vals = []
+            for n in names:
+                if not n or n == EMPTY_VAR_NAME:
+                    vals.append(None)
+                else:
+                    vals.append(env.get(n))
+            ins[slot] = vals
+        op_id = op.attrs.get("__fwd_op_id__", op.attrs.get("__op_id__", 0))
+        outs = op_registry.call_op(opdef, ctx, ins, op.attrs, op_id=op_id)
+        for slot, names in op.outputs.items():
+            vals = outs.get(slot)
+            if vals is None:
+                continue
+            for n, v in zip(names, vals):
+                if n and n != EMPTY_VAR_NAME and v is not None:
+                    env[n] = v
+    return env
+
+
+class Executor:
+    """Reference API: ``Executor(place).run(program, feed, fetch_list)``
+    (``python/paddle/fluid/executor.py:565``)."""
+
+    def __init__(self, place=None):
+        self.place = place if place is not None else core.TPUPlace(0)
+        self._cache = {}
+        self._step = 0
+
+    def close(self):
+        self._cache.clear()
+
+    def run(
+        self,
+        program=None,
+        feed=None,
+        fetch_list=None,
+        feed_var_name="feed",
+        fetch_var_name="fetch",
+        scope=None,
+        return_numpy=True,
+        use_program_cache=True,
+        use_prune=False,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        from .compiler import CompiledProgram
+
+        if program is None:
+            program = default_main_program()
+        if isinstance(program, CompiledProgram):
+            return program._run(self, feed, fetch_list, scope, return_numpy)
+        if scope is None:
+            scope = global_scope()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+
+        fetch_names = [
+            v.name if isinstance(v, Variable) else str(v) for v in fetch_list
+        ]
+
+        # device transfer of feeds (reference: _feed_data → set_feed_variable)
+        feed_vals = {}
+        for name, value in feed.items():
+            if isinstance(value, (np.ndarray, list, tuple, int, float)):
+                value = jnp.asarray(value)
+            feed_vals[name] = value
+
+        sig = tuple(
+            (n, tuple(v.shape), str(v.dtype)) for n, v in sorted(feed_vals.items())
+        )
+        mode = "train"
+        key_tuple = (
+            id(program),
+            program._version,
+            id(scope),
+            sig,
+            tuple(fetch_names),
+        )
+        compiled = self._cache.get(key_tuple) if use_program_cache else None
+        if compiled is None:
+            compiled = _CompiledBlock(
+                program,
+                program.global_block(),
+                list(feed_vals),
+                fetch_names,
+                scope,
+                mode,
+            )
+            if use_program_cache:
+                self._cache[key_tuple] = compiled
+
+        rw = {n: scope.get(n) for n in compiled.rw_names}
+        ro = {n: scope.get(n) for n in compiled.ro_names}
+        seed = program.random_seed or 0
+        base_key = jax.random.fold_in(jax.random.key(seed), self._step)
+        self._step += 1
+
+        fetches, new_rw, fresh = compiled.jitted(feed_vals, rw, ro, base_key)
+        for n, v in new_rw.items():
+            scope.set(n, v)
+        for n, v in fresh.items():
+            scope.set(n, v)
+
+        if return_numpy:
+            return [np.asarray(v) for v in fetches]
+        return list(fetches)
+
+    # ------ dataset entry points (reference executor.py:909) — see
+    # paddle_tpu/trainer.py once the dataset path lands ------
+    def train_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        from .dataset_runtime import run_from_dataset
+
+        return run_from_dataset(self, program, dataset, scope, fetch_list,
+                                fetch_info, print_period, train=True)
+
+    def infer_from_dataset(self, program=None, dataset=None, scope=None,
+                           thread=0, debug=False, fetch_list=None,
+                           fetch_info=None, print_period=100):
+        from .dataset_runtime import run_from_dataset
+
+        return run_from_dataset(self, program, dataset, scope, fetch_list,
+                                fetch_info, print_period, train=False)

@@ -1,0 +1,156 @@
+"""Checkpoint save/load + inference export (reference:
+``python/paddle/fluid/io.py``: save/load_vars :108, save_persistables :475,
+load_persistables :714, save_inference_model :921, load_inference_model
+:1109).
+
+Storage format: one ``.npy`` per var (filename = var name) or a combined
+``.npz`` — numpy containers instead of the reference's LoDTensor binary
+framing.  The orbax-style sharded checkpoint path for multi-host lands with
+the distributed batch."""
+
+import os
+
+import numpy as np
+
+from .framework import Program, Parameter, default_main_program
+from .executor import global_scope
+from . import proto
+
+__all__ = [
+    "save_vars",
+    "save_params",
+    "save_persistables",
+    "load_vars",
+    "load_params",
+    "load_persistables",
+    "save_inference_model",
+    "load_inference_model",
+    "get_program_parameter",
+]
+
+MODEL_FILENAME = "__model__"
+
+
+def _is_persistable(var):
+    return var.persistable and not var.is_data
+
+
+def _is_parameter(var):
+    return isinstance(var, Parameter)
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    if main_program is None:
+        main_program = default_main_program()
+    if vars is None:
+        vars = [
+            v for v in main_program.list_vars()
+            if (predicate or _is_persistable)(v)
+        ]
+    scope = global_scope()
+    os.makedirs(dirname, exist_ok=True)
+    if filename is None:
+        for v in vars:
+            val = scope.get(v.name)
+            if val is None:
+                continue
+            np.save(os.path.join(dirname, v.name.replace("/", "_")),
+                    np.asarray(val))
+    else:
+        arrays = {}
+        for v in vars:
+            val = scope.get(v.name)
+            if val is not None:
+                arrays[v.name] = np.asarray(val)
+        np.savez(os.path.join(dirname, filename), **arrays)
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=_is_parameter, filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=_is_persistable, filename=filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    import jax.numpy as jnp
+
+    if main_program is None:
+        main_program = default_main_program()
+    if vars is None:
+        vars = [
+            v for v in main_program.list_vars()
+            if (predicate or _is_persistable)(v)
+        ]
+    scope = global_scope()
+    if filename is None:
+        for v in vars:
+            path = os.path.join(dirname, v.name.replace("/", "_") + ".npy")
+            if not os.path.exists(path):
+                continue
+            scope.set(v.name, jnp.asarray(np.load(path)))
+    else:
+        path = os.path.join(dirname, filename)
+        if not path.endswith(".npz"):
+            path = path + ".npz"
+        data = np.load(path)
+        for v in vars:
+            if v.name in data:
+                scope.set(v.name, jnp.asarray(data[v.name]))
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program,
+                     predicate=_is_parameter, filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    return load_vars(executor, dirname, main_program,
+                     predicate=_is_persistable, filename=filename)
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True):
+    """Prune to the inference subgraph + serialize (reference io.py:921)."""
+    if main_program is None:
+        main_program = default_main_program()
+    pruned = main_program.clone(for_test=True)
+    target_names = [v.name for v in target_vars]
+    pruned = pruned._prune(feeded_var_names, target_names)
+    os.makedirs(dirname, exist_ok=True)
+    proto.save_program(
+        pruned, os.path.join(dirname, model_filename or MODEL_FILENAME)
+    )
+    meta = {"feed": list(feeded_var_names), "fetch": target_names}
+    import json
+
+    with open(os.path.join(dirname, "__meta__.json"), "w") as f:
+        json.dump(meta, f)
+    save_persistables(executor, dirname, main_program=pruned,
+                      filename=params_filename)
+    return target_names
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    import json
+
+    program = proto.load_program(
+        os.path.join(dirname, model_filename or MODEL_FILENAME)
+    )
+    with open(os.path.join(dirname, "__meta__.json")) as f:
+        meta = json.load(f)
+    load_persistables(executor, dirname, main_program=program,
+                      filename=params_filename)
+    fetch_vars = [program.global_block().var(n) for n in meta["fetch"]]
+    return program, meta["feed"], fetch_vars
+
+
+def get_program_parameter(program):
+    return list(program.all_parameters())

@@ -1,0 +1,121 @@
+"""Control-flow layers (reference:
+``python/paddle/fluid/layers/control_flow.py``: While:630, StaticRNN:280,
+DynamicRNN:1700, IfElse:1564, Switch:1436 — each opens a sub-block).
+
+TPU lowering: sub-blocks lower to ``lax.while_loop`` / ``lax.cond`` /
+``lax.scan`` bodies (compiler-friendly control flow, no per-iteration host
+dispatch).  The While/StaticRNN surface lands with the sequence batch
+(stage 7 of SURVEY.md §7); array ops used by beam-search decoders are here.
+"""
+
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+from . import tensor as _tensor
+
+__all__ = [
+    "increment",
+    "array_write",
+    "array_read",
+    "array_length",
+    "less_than",
+    "equal",
+    "not_equal",
+    "greater_than",
+    "While",
+    "StaticRNN",
+    "Switch",
+    "IfElse",
+    "DynamicRNN",
+]
+
+
+def increment(x, value=1.0, in_place=True):
+    helper = LayerHelper("increment", **locals())
+    if in_place:
+        out = x
+    else:
+        out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="increment", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={"step": float(value)},
+    )
+    return out
+
+
+def _compare(op_type, x, y, cond=None):
+    helper = LayerHelper(op_type, **locals())
+    if cond is None:
+        cond = helper.create_variable_for_type_inference("bool", True)
+    helper.append_op(
+        type=op_type, inputs={"X": [x], "Y": [y]}, outputs={"Out": [cond]},
+    )
+    return cond
+
+
+def less_than(x, y, force_cpu=None, cond=None):
+    return _compare("less_than", x, y, cond)
+
+
+def equal(x, y, cond=None):
+    return _compare("equal", x, y, cond)
+
+
+def not_equal(x, y, cond=None):
+    return _compare("not_equal", x, y, cond)
+
+
+def greater_than(x, y, cond=None):
+    return _compare("greater_than", x, y, cond)
+
+
+def array_write(x, i, array=None):
+    raise NotImplementedError(
+        "LoDTensorArray ops land with the sequence/control-flow batch"
+    )
+
+
+def array_read(array, i):
+    raise NotImplementedError(
+        "LoDTensorArray ops land with the sequence/control-flow batch"
+    )
+
+
+def array_length(array):
+    raise NotImplementedError(
+        "LoDTensorArray ops land with the sequence/control-flow batch"
+    )
+
+
+class While:
+    def __init__(self, cond, is_test=False, name=None):
+        raise NotImplementedError(
+            "While lowers to lax.while_loop — lands with stage 7 "
+            "(control flow + sequences)"
+        )
+
+
+class StaticRNN:
+    def __init__(self, name=None):
+        raise NotImplementedError(
+            "StaticRNN lowers to lax.scan — lands with stage 7"
+        )
+
+
+class Switch:
+    def __init__(self, name=None):
+        raise NotImplementedError("Switch lands with stage 7")
+
+
+class IfElse:
+    def __init__(self, cond, name=None):
+        raise NotImplementedError(
+            "IfElse lowers to lax.cond — lands with stage 7"
+        )
+
+
+class DynamicRNN:
+    def __init__(self, name=None):
+        raise NotImplementedError(
+            "DynamicRNN maps to a masked lax.scan over padded+bucketed "
+            "batches — lands with stage 7"
+        )

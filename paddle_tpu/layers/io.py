@@ -1,0 +1,34 @@
+"""Data-layer front-end (reference: ``python/paddle/fluid/layers/io.py``)."""
+
+from ..framework import default_main_program, default_startup_program
+from .. import core
+
+__all__ = ["data"]
+
+
+def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
+         type=core.VarDesc.VarType.LOD_TENSOR, stop_gradient=True):
+    """Declare an input variable fed at run time (reference io.py `data`).
+    With append_batch_size, a leading -1 batch dim is added."""
+    helper_block = default_main_program().current_block()
+    shape = list(shape)
+    if append_batch_size:
+        shape = [-1] + shape
+    var = helper_block.create_var(
+        name=name,
+        shape=shape,
+        dtype=dtype,
+        lod_level=lod_level,
+        stop_gradient=stop_gradient,
+        is_data=True,
+        need_check_feed=True,
+    )
+    # mirror into the startup program like the reference so either program
+    # can resolve the var
+    sb = default_startup_program().current_block()
+    if not sb.has_var(name):
+        sb.create_var(
+            name=name, shape=shape, dtype=dtype, lod_level=lod_level,
+            stop_gradient=stop_gradient, is_data=True,
+        )
+    return var

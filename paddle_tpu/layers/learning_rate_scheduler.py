@@ -1,0 +1,136 @@
+"""LR schedules as graph ops (reference:
+``python/paddle/fluid/layers/learning_rate_scheduler.py`` — each decay is a
+small subgraph reading a global step counter).
+
+TPU note: the schedule subgraph lowers into the same jitted step function as
+the rest of the program, so there's no host round-trip per step; the global
+step counter is a persistable scalar updated in-graph."""
+
+import math
+
+from ..framework import default_main_program
+from ..layer_helper import LayerHelper
+from ..initializer import ConstantInitializer
+from . import tensor
+from . import ops
+
+__all__ = [
+    "exponential_decay",
+    "natural_exp_decay",
+    "inverse_time_decay",
+    "polynomial_decay",
+    "piecewise_decay",
+    "noam_decay",
+    "cosine_decay",
+    "linear_lr_warmup",
+]
+
+
+def _global_step(counter_name="@LR_DECAY_COUNTER@"):
+    """Autoincrementing global step var (reference
+    layers/tensor.py autoincreased_step_counter)."""
+    helper = LayerHelper("global_step_counter")
+    block = default_main_program().global_block()
+    if block.has_var(counter_name):
+        counter = block.var(counter_name)
+    else:
+        counter = block.create_var(
+            name=counter_name, dtype="float32", shape=[1], persistable=True
+        )
+        helper.set_variable_initializer(counter, ConstantInitializer(0.0))
+        block._prepend_op(
+            type="increment",
+            inputs={"X": [counter]},
+            outputs={"Out": [counter]},
+            attrs={"step": 1.0},
+        )
+        counter.stop_gradient = True
+    return counter
+
+
+def noam_decay(d_model, warmup_steps):
+    from .nn import elementwise_min
+
+    step = _global_step()
+    a = ops.pow(step, -0.5)
+    b = step * (warmup_steps ** -1.5)
+    return elementwise_min(a, b) * (d_model ** -0.5)
+
+
+def exponential_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _global_step()
+    div = step / float(decay_steps)
+    if staircase:
+        div = ops.floor(div)
+    return _pow_scalar_base(decay_rate, div) * float(learning_rate)
+
+
+def _pow_scalar_base(base, exponent_var):
+    """base ** x as exp(x * ln(base)) using graph ops."""
+    return ops.exp(exponent_var * math.log(base))
+
+
+def natural_exp_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _global_step()
+    div = step / float(decay_steps)
+    if staircase:
+        div = ops.floor(div)
+    return learning_rate * ops.exp(div * (-decay_rate))
+
+
+def inverse_time_decay(learning_rate, decay_steps, decay_rate, staircase=False):
+    step = _global_step()
+    div = step / float(decay_steps)
+    if staircase:
+        div = ops.floor(div)
+    denom = div * decay_rate + 1.0
+    return (tensor.fill_constant([1], "float32", learning_rate)) / denom
+
+
+def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
+                     power=1.0, cycle=False):
+    from .nn import elementwise_min
+
+    step = _global_step()
+    if cycle:
+        raise NotImplementedError("cycle=True polynomial_decay")
+    capped = elementwise_min(
+        step, tensor.fill_constant([1], "float32", float(decay_steps))
+    )
+    frac = capped / float(decay_steps)
+    one_minus = frac * (-1.0) + 1.0
+    return (learning_rate - end_learning_rate) * ops.pow(
+        one_minus, power
+    ) + end_learning_rate
+
+
+def piecewise_decay(boundaries, values):
+    import numpy as np
+
+    from .nn import where
+
+    step = _global_step()
+    lr = tensor.fill_constant([1], "float32", values[-1])
+    # chained where's, evaluated right-to-left
+    for b, v in zip(reversed(boundaries), reversed(values[:-1])):
+        cond = step < float(b)
+        lr = where(cond, tensor.fill_constant([1], "float32", v), lr)
+    return lr
+
+
+def cosine_decay(learning_rate, step_each_epoch, epochs):
+    step = _global_step()
+    epoch = ops.floor(step / float(step_each_epoch))
+    inner = epoch * (math.pi / float(epochs))
+    return 0.5 * learning_rate * (ops.cos(inner) + 1.0)
+
+
+def linear_lr_warmup(learning_rate, warmup_steps, start_lr, end_lr):
+    from .nn import where
+
+    step = _global_step()
+    warm = start_lr + (end_lr - start_lr) * (step / float(warmup_steps))
+    if not hasattr(learning_rate, "name"):
+        learning_rate = tensor.fill_constant([1], "float32",
+                                             float(learning_rate))
+    return where(step < float(warmup_steps), warm, learning_rate)

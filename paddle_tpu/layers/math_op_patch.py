@@ -1,0 +1,48 @@
+"""Operator-overload sugar for Variables (reference:
+``python/paddle/fluid/layers/math_op_patch.py``)."""
+
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+
+
+_SCALAR_SHORTCUTS = {
+    "elementwise_add": lambda s: {"scale": 1.0, "bias": float(s)},
+    "elementwise_sub": lambda s: {"scale": 1.0, "bias": -float(s)},
+    "elementwise_mul": lambda s: {"scale": float(s), "bias": 0.0},
+}
+
+
+def binary_op(x, other, op_type, reverse=False):
+    helper = LayerHelper(op_type)
+    if not isinstance(other, Variable):
+        s = float(other)
+        # scalar fast paths lower to one fused `scale` op
+        if not reverse and op_type in _SCALAR_SHORTCUTS:
+            attrs = _SCALAR_SHORTCUTS[op_type](s)
+            out = helper.create_variable_for_type_inference(x.dtype)
+            helper.append_op(
+                type="scale", inputs={"X": [x]}, outputs={"Out": [out]},
+                attrs=attrs,
+            )
+            return out
+        if not reverse and op_type == "elementwise_div":
+            out = helper.create_variable_for_type_inference(x.dtype)
+            helper.append_op(
+                type="scale", inputs={"X": [x]}, outputs={"Out": [out]},
+                attrs={"scale": 1.0 / s, "bias": 0.0},
+            )
+            return out
+        from .tensor import fill_constant
+
+        other = fill_constant([1], x.dtype, s)
+    a, b = (other, x) if reverse else (x, other)
+    out = helper.create_variable_for_type_inference(
+        a.dtype if isinstance(a, Variable) else b.dtype
+    )
+    helper.append_op(
+        type=op_type,
+        inputs={"X": [a], "Y": [b]},
+        outputs={"Out": [out]},
+        attrs={"axis": -1},
+    )
+    return out

@@ -1,0 +1,36 @@
+"""In-graph metric layers (reference:
+``python/paddle/fluid/layers/metric_op.py`` → ``operators/metrics/``)."""
+
+from ..layer_helper import LayerHelper
+
+__all__ = ["accuracy", "auc"]
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    helper = LayerHelper("accuracy", **locals())
+    topk_out = helper.create_variable_for_type_inference(input.dtype)
+    topk_indices = helper.create_variable_for_type_inference("int64")
+    helper.append_op(
+        type="top_k",
+        inputs={"X": [input]},
+        outputs={"Out": [topk_out], "Indices": [topk_indices]},
+        attrs={"k": k},
+    )
+    acc_out = helper.create_variable_for_type_inference("float32", True)
+    if correct is None:
+        correct = helper.create_variable_for_type_inference("int64", True)
+    if total is None:
+        total = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op(
+        type="accuracy",
+        inputs={"Out": [topk_out], "Indices": [topk_indices],
+                "Label": [label]},
+        outputs={"Accuracy": [acc_out], "Correct": [correct],
+                 "Total": [total]},
+    )
+    return acc_out
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    raise NotImplementedError("auc op lands with the CTR/metrics batch")

@@ -1,0 +1,96 @@
+"""Auto-generated thin layer wrappers over registered ops (reference:
+``python/paddle/fluid/layers/ops.py``, generated from OpProtos by
+``layer_function_generator.py``)."""
+
+from ..layer_helper import LayerHelper
+
+__all__ = [
+    "exp", "tanh", "sqrt", "rsqrt", "abs", "ceil", "floor", "cos", "sin",
+    "round", "reciprocal", "square", "softplus", "softsign", "logsigmoid",
+    "sigmoid", "relu6", "pow", "stanh", "hard_sigmoid", "swish",
+    "thresholded_relu", "hard_shrink", "softshrink", "elu", "gelu", "erf",
+    "brelu", "soft_relu", "leaky_relu", "log", "scale", "hard_swish",
+    "sign", "tanh_shrink",
+]
+
+
+def _generate_unary(op_type):
+    def func(x, name=None, **kwargs):
+        helper = LayerHelper(op_type, **locals())
+        out = helper.create_variable_for_type_inference(x.dtype)
+        helper.append_op(
+            type=op_type, inputs={"X": [x]}, outputs={"Out": [out]},
+            attrs={k: v for k, v in kwargs.items() if v is not None},
+        )
+        return out
+
+    func.__name__ = op_type
+    return func
+
+
+exp = _generate_unary("exp")
+tanh = _generate_unary("tanh")
+sqrt = _generate_unary("sqrt")
+rsqrt = _generate_unary("rsqrt")
+abs = _generate_unary("abs")
+ceil = _generate_unary("ceil")
+floor = _generate_unary("floor")
+cos = _generate_unary("cos")
+sin = _generate_unary("sin")
+round = _generate_unary("round")
+reciprocal = _generate_unary("reciprocal")
+square = _generate_unary("square")
+softplus = _generate_unary("softplus")
+softsign = _generate_unary("softsign")
+logsigmoid = _generate_unary("logsigmoid")
+sigmoid = _generate_unary("sigmoid")
+relu6 = _generate_unary("relu6")
+stanh = _generate_unary("stanh")
+hard_sigmoid = _generate_unary("hard_sigmoid")
+swish = _generate_unary("swish")
+thresholded_relu = _generate_unary("thresholded_relu")
+hard_shrink = _generate_unary("hard_shrink")
+softshrink = _generate_unary("softshrink")
+elu = _generate_unary("elu")
+gelu = _generate_unary("gelu")
+erf = _generate_unary("erf")
+brelu = _generate_unary("brelu")
+soft_relu = _generate_unary("soft_relu")
+log = _generate_unary("log")
+sign = _generate_unary("sign")
+tanh_shrink = _generate_unary("tanh_shrink")
+hard_swish = _generate_unary("hard_swish")
+
+
+def leaky_relu(x, alpha=0.02, name=None):
+    helper = LayerHelper("leaky_relu", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="leaky_relu", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={"alpha": alpha},
+    )
+    return out
+
+
+def pow(x, factor=1.0, name=None):
+    helper = LayerHelper("pow", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="pow", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={"factor": float(factor)},
+    )
+    return out
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    helper = LayerHelper("scale", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="scale", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={
+            "scale": float(scale),
+            "bias": float(bias),
+            "bias_after_scale": bias_after_scale,
+        },
+    )
+    return helper.append_activation(out)

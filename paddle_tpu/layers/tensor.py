@@ -1,0 +1,238 @@
+"""Tensor creation/util layers (reference:
+``python/paddle/fluid/layers/tensor.py``)."""
+
+import numpy as np
+
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+from ..initializer import ConstantInitializer
+
+__all__ = [
+    "create_tensor",
+    "create_global_var",
+    "cast",
+    "concat",
+    "sums",
+    "assign",
+    "fill_constant",
+    "fill_constant_batch_size_like",
+    "ones",
+    "zeros",
+    "ones_like",
+    "zeros_like",
+    "has_inf",
+    "has_nan",
+    "isfinite",
+    "range",
+    "linspace",
+    "diag",
+    "argmax",
+    "argmin",
+]
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    helper = LayerHelper("create_tensor", **locals())
+    return helper.create_variable(
+        name=helper.name, dtype=dtype, persistable=persistable
+    )
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    helper = LayerHelper("global_var", **locals())
+    var = helper.create_global_variable(
+        dtype=dtype, shape=shape, persistable=persistable
+    )
+    helper.set_variable_initializer(var, ConstantInitializer(value))
+    return var
+
+
+def cast(x, dtype):
+    helper = LayerHelper("cast", **locals())
+    from ..core import convert_np_dtype_to_dtype_
+
+    dtype = convert_np_dtype_to_dtype_(dtype)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="cast",
+        inputs={"X": [x]},
+        outputs={"Out": [out]},
+        attrs={"in_dtype": x.dtype, "out_dtype": dtype},
+    )
+    return out
+
+
+def concat(input, axis=0, name=None):
+    from .nn import concat as _concat
+
+    return _concat(input, axis, name)
+
+
+def sums(input, out=None):
+    helper = LayerHelper("sum", **locals())
+    if out is None:
+        out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op(type="sum", inputs={"X": list(input)},
+                     outputs={"Out": [out]})
+    return out
+
+
+def assign(input, output=None):
+    helper = LayerHelper("assign", **locals())
+    if isinstance(input, Variable):
+        if output is None:
+            output = helper.create_variable_for_type_inference(input.dtype)
+        helper.append_op(
+            type="assign", inputs={"X": [input]}, outputs={"Out": [output]}
+        )
+    elif isinstance(input, np.ndarray):
+        if output is None:
+            output = helper.create_variable_for_type_inference(
+                str(input.dtype)
+            )
+        helper.append_op(
+            type="assign_value",
+            outputs={"Out": [output]},
+            attrs={
+                "shape": list(input.shape),
+                "dtype": str(input.dtype),
+                "values": input,
+            },
+        )
+    else:
+        raise TypeError("assign expects Variable or ndarray")
+    return output
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None):
+    helper = LayerHelper("fill_constant", **locals())
+    if out is None:
+        out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="fill_constant",
+        outputs={"Out": [out]},
+        attrs={
+            "shape": [int(s) for s in shape],
+            "dtype": dtype,
+            "value": float(value),
+        },
+        stop_gradient=True,
+    )
+    out.stop_gradient = True
+    return out
+
+
+def fill_constant_batch_size_like(input, shape, dtype, value,
+                                  input_dim_idx=0, output_dim_idx=0):
+    helper = LayerHelper("fill_constant_batch_size_like", **locals())
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="fill_constant_batch_size_like",
+        inputs={"Input": [input]},
+        outputs={"Out": [out]},
+        attrs={
+            "shape": [int(s) for s in shape],
+            "dtype": dtype,
+            "value": float(value),
+            "input_dim_idx": input_dim_idx,
+            "output_dim_idx": output_dim_idx,
+        },
+        stop_gradient=True,
+    )
+    out.stop_gradient = True
+    return out
+
+
+def ones(shape, dtype, force_cpu=False):
+    return fill_constant(shape, dtype, 1.0)
+
+
+def zeros(shape, dtype, force_cpu=False):
+    return fill_constant(shape, dtype, 0.0)
+
+
+def ones_like(x, out=None):
+    helper = LayerHelper("fill_any_like", **locals())
+    if out is None:
+        out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="fill_any_like", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={"value": 1.0, "dtype": -1},
+    )
+    return out
+
+
+def zeros_like(x, out=None):
+    helper = LayerHelper("fill_any_like", **locals())
+    if out is None:
+        out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="fill_any_like", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={"value": 0.0, "dtype": -1},
+    )
+    return out
+
+
+def has_inf(x):
+    helper = LayerHelper("isinf", **locals())
+    out = helper.create_variable_for_type_inference("bool", True)
+    helper.append_op(type="isinf", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def has_nan(x):
+    helper = LayerHelper("isnan", **locals())
+    out = helper.create_variable_for_type_inference("bool", True)
+    helper.append_op(type="isnan", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+def isfinite(x):
+    helper = LayerHelper("isfinite", **locals())
+    out = helper.create_variable_for_type_inference("bool", True)
+    helper.append_op(type="isfinite", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def range(start, end, step, dtype):
+    helper = LayerHelper("range", **locals())
+    attrs = {"dtype": dtype}
+    inputs = {}
+    # python scalars become static attrs (XLA needs a static length);
+    # Variables are passed through and must be trace-time constants
+    for key, val in (("start", start), ("end", end), ("step", step)):
+        if isinstance(val, Variable):
+            inputs[key.capitalize()] = [val]
+        else:
+            attrs[key] = float(val)
+    out = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op(
+        type="range", inputs=inputs, outputs={"Out": [out]}, attrs=attrs,
+    )
+    return out
+
+
+def linspace(start, stop, num, dtype):
+    step = (stop - start) / float(max(int(num) - 1, 1))
+    vals = np.linspace(start, stop, int(num)).astype(dtype)
+    return assign(vals)
+
+
+def diag(diagonal):
+    if isinstance(diagonal, np.ndarray):
+        return assign(np.diag(diagonal))
+    raise NotImplementedError("diag of Variable lands later")
+
+
+def argmax(x, axis=0):
+    from .nn import argmax as _argmax
+
+    return _argmax(x, axis)
+
+
+def argmin(x, axis=0):
+    from .nn import argmin as _argmin
+
+    return _argmin(x, axis)

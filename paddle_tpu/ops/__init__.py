@@ -1,0 +1,27 @@
+"""Op library: each module registers XLA lowerings with the registry.
+
+Importing this package populates the registry (the analogue of the
+reference's static REGISTER_OPERATOR initializers,
+``paddle/fluid/framework/op_registry.h:197``).
+"""
+
+from . import registry
+from .registry import (
+    register_op,
+    get_op_def,
+    has_op,
+    OpDef,
+    OpNotRegistered,
+    LoweringContext,
+    call_op,
+    EMPTY_VAR_NAME,
+)
+
+# op families — import order is unimportant; each module only registers
+from . import basic  # noqa: F401
+from . import math  # noqa: F401
+from . import activations  # noqa: F401
+from . import nn  # noqa: F401
+from . import tensor_manip  # noqa: F401
+from . import compare  # noqa: F401
+from . import optimizer_ops  # noqa: F401

@@ -1,0 +1,115 @@
+"""Activation ops (reference: ``paddle/fluid/operators/activation_op.cc`` —
+one REGISTER_OPERATOR + CPU/CUDA functor pair per activation; here one jnp
+expression each, fused by XLA into whatever op precedes them)."""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _unary(name, fn):
+    @register_op(name, inputs=["X"], outputs=["Out"])
+    def _op(ctx, attrs, X, _fn=fn):
+        return _fn(X)
+
+    return _op
+
+
+_unary("relu", jax.nn.relu)
+_unary("sigmoid", jax.nn.sigmoid)
+_unary("logsigmoid", jax.nn.log_sigmoid)
+_unary("tanh", jnp.tanh)
+_unary("exp", jnp.exp)
+_unary("log", jnp.log)
+_unary("sqrt", jnp.sqrt)
+_unary("rsqrt", jax.lax.rsqrt)
+_unary("abs", jnp.abs)
+_unary("square", jnp.square)
+_unary("reciprocal", jnp.reciprocal)
+_unary("softplus", jax.nn.softplus)
+_unary("softsign", jax.nn.soft_sign)
+_unary("ceil", jnp.ceil)
+_unary("floor", jnp.floor)
+_unary("round", jnp.round)
+_unary("cos", jnp.cos)
+_unary("sin", jnp.sin)
+_unary("tanh_shrink", lambda x: x - jnp.tanh(x))
+_unary("relu6", lambda x: jnp.clip(x, 0.0, 6.0))
+_unary("sign", jnp.sign)
+_unary("erf", jax.lax.erf)
+
+
+@register_op("gelu", inputs=["X"], outputs=["Out"])
+def gelu(ctx, attrs, X):
+    return jax.nn.gelu(X, approximate=bool(attrs.get("approximate", False)))
+
+
+@register_op("leaky_relu", inputs=["X"], outputs=["Out"])
+def leaky_relu(ctx, attrs, X):
+    alpha = attrs.get("alpha", 0.02)
+    return jnp.where(X >= 0, X, jnp.asarray(alpha, X.dtype) * X)
+
+
+@register_op("elu", inputs=["X"], outputs=["Out"])
+def elu(ctx, attrs, X):
+    return jax.nn.elu(X, alpha=attrs.get("alpha", 1.0))
+
+
+@register_op("hard_sigmoid", inputs=["X"], outputs=["Out"])
+def hard_sigmoid(ctx, attrs, X):
+    slope = attrs.get("slope", 0.2)
+    offset = attrs.get("offset", 0.5)
+    return jnp.clip(slope * X + offset, 0.0, 1.0).astype(X.dtype)
+
+
+@register_op("hard_swish", inputs=["X"], outputs=["Out"])
+def hard_swish(ctx, attrs, X):
+    threshold = attrs.get("threshold", 6.0)
+    s = attrs.get("scale", 6.0)
+    offset = attrs.get("offset", 3.0)
+    return X * jnp.clip(X + offset, 0.0, threshold).astype(X.dtype) / s
+
+
+@register_op("swish", inputs=["X"], outputs=["Out"])
+def swish(ctx, attrs, X):
+    beta = attrs.get("beta", 1.0)
+    return X * jax.nn.sigmoid(jnp.asarray(beta, X.dtype) * X)
+
+
+@register_op("brelu", inputs=["X"], outputs=["Out"])
+def brelu(ctx, attrs, X):
+    return jnp.clip(X, attrs.get("t_min", 0.0), attrs.get("t_max", 24.0))
+
+
+@register_op("soft_relu", inputs=["X"], outputs=["Out"])
+def soft_relu(ctx, attrs, X):
+    threshold = attrs.get("threshold", 40.0)
+    return jnp.log1p(jnp.exp(jnp.clip(X, -threshold, threshold)))
+
+
+@register_op("stanh", inputs=["X"], outputs=["Out"])
+def stanh(ctx, attrs, X):
+    a = attrs.get("scale_a", 0.67)
+    b = attrs.get("scale_b", 1.7159)
+    return jnp.asarray(b, X.dtype) * jnp.tanh(jnp.asarray(a, X.dtype) * X)
+
+
+@register_op("thresholded_relu", inputs=["X"], outputs=["Out"])
+def thresholded_relu(ctx, attrs, X):
+    t = attrs.get("threshold", 1.0)
+    return jnp.where(X > t, X, jnp.zeros_like(X))
+
+
+@register_op("hard_shrink", inputs=["X"], outputs=["Out"])
+def hard_shrink(ctx, attrs, X):
+    t = attrs.get("threshold", 0.5)
+    return jnp.where(jnp.abs(X) > t, X, jnp.zeros_like(X))
+
+
+@register_op("softshrink", inputs=["X"], outputs=["Out"])
+def softshrink(ctx, attrs, X):
+    lam = attrs.get("lambda", 0.5)
+    return jnp.where(
+        X > lam, X - lam, jnp.where(X < -lam, X + lam, jnp.zeros_like(X))
+    )

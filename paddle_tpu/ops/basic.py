@@ -1,0 +1,238 @@
+"""Creation / casting / misc ops.
+
+Reference kernels: ``paddle/fluid/operators/fill_constant_op.cc``,
+``gaussian_random_op.cc``, ``uniform_random_op.cc``, ``cast_op.cc``,
+``scale_op.cc``, ``sum_op.cc``, ``assign_op.cc`` — here each is a few lines of
+jnp lowered into the block's jaxpr.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+from .common import resolve_dtype
+
+
+@register_op("fill_constant", inputs=[], outputs=["Out"], no_grad=True)
+def fill_constant(ctx, attrs):
+    dtype = resolve_dtype(attrs.get("dtype", "float32"))
+    shape = tuple(int(s) for s in attrs.get("shape", []))
+    return jnp.full(shape, attrs.get("value", 0.0), dtype=dtype)
+
+
+@register_op("fill_constant_batch_size_like", inputs=["Input"], outputs=["Out"],
+             no_grad=True)
+def fill_constant_batch_size_like(ctx, attrs, Input):
+    dtype = resolve_dtype(attrs.get("dtype", "float32"))
+    shape = [int(s) for s in attrs.get("shape", [])]
+    in_idx = int(attrs.get("input_dim_idx", 0))
+    out_idx = int(attrs.get("output_dim_idx", 0))
+    shape[out_idx] = jnp.shape(Input)[in_idx]
+    return jnp.full(tuple(shape), attrs.get("value", 0.0), dtype=dtype)
+
+
+@register_op("fill_any_like", inputs=["X"], outputs=["Out"], no_grad=True)
+def fill_any_like(ctx, attrs, X):
+    dtype = attrs.get("dtype", -1)
+    dt = jnp.result_type(X) if dtype in (-1, None) else resolve_dtype(dtype)
+    return jnp.full(jnp.shape(X), attrs.get("value", 0.0), dtype=dt)
+
+
+@register_op("fill_zeros_like", inputs=["X"], outputs=["Out"], no_grad=True)
+def fill_zeros_like(ctx, attrs, X):
+    return jnp.zeros_like(X)
+
+
+@register_op("gaussian_random", inputs=[], outputs=["Out"], no_grad=True)
+def gaussian_random(ctx, attrs):
+    dtype = resolve_dtype(attrs.get("dtype", "float32"))
+    shape = tuple(int(s) for s in attrs.get("shape", []))
+    seed = int(attrs.get("seed", 0))
+    key = jax.random.key(seed) if seed else ctx.rng()
+    return (
+        attrs.get("mean", 0.0)
+        + attrs.get("std", 1.0) * jax.random.normal(key, shape)
+    ).astype(dtype)
+
+
+@register_op("uniform_random", inputs=[], outputs=["Out"], no_grad=True)
+def uniform_random(ctx, attrs):
+    dtype = resolve_dtype(attrs.get("dtype", "float32"))
+    shape = tuple(int(s) for s in attrs.get("shape", []))
+    seed = int(attrs.get("seed", 0))
+    key = jax.random.key(seed) if seed else ctx.rng()
+    return jax.random.uniform(
+        key, shape, dtype=jnp.float32,
+        minval=attrs.get("min", -1.0), maxval=attrs.get("max", 1.0),
+    ).astype(dtype)
+
+
+@register_op("truncated_gaussian_random", inputs=[], outputs=["Out"], no_grad=True)
+def truncated_gaussian_random(ctx, attrs):
+    dtype = resolve_dtype(attrs.get("dtype", "float32"))
+    shape = tuple(int(s) for s in attrs.get("shape", []))
+    seed = int(attrs.get("seed", 0))
+    key = jax.random.key(seed) if seed else ctx.rng()
+    std = attrs.get("std", 1.0)
+    mean = attrs.get("mean", 0.0)
+    return (
+        mean + std * jax.random.truncated_normal(key, -2.0, 2.0, shape)
+    ).astype(dtype)
+
+
+@register_op("randint", inputs=[], outputs=["Out"], no_grad=True)
+def randint(ctx, attrs):
+    shape = tuple(int(s) for s in attrs.get("shape", []))
+    seed = int(attrs.get("seed", 0))
+    key = jax.random.key(seed) if seed else ctx.rng()
+    dtype = resolve_dtype(attrs.get("dtype", "int64"))
+    return jax.random.randint(
+        key, shape, attrs.get("low", 0), attrs.get("high", 100)
+    ).astype(dtype)
+
+
+@register_op("assign", inputs=["X"], outputs=["Out"])
+def assign(ctx, attrs, X):
+    return X
+
+
+@register_op("assign_value", inputs=[], outputs=["Out"], no_grad=True)
+def assign_value(ctx, attrs):
+    import numpy as np
+
+    values = attrs.get("values")
+    if values is None:  # reference attr spelling: fp32_values / int32_values
+        values = attrs.get("fp32_values", attrs.get("int32_values"))
+    arr = np.asarray(values).reshape(tuple(int(s) for s in attrs["shape"]))
+    return jnp.asarray(arr).astype(resolve_dtype(attrs.get("dtype", arr.dtype)))
+
+
+@register_op("share_data", inputs=["X"], outputs=["Out"])
+def share_data(ctx, attrs, X):
+    return X
+
+
+@register_op("cast", inputs=["X"], outputs=["Out"])
+def cast(ctx, attrs, X):
+    return X.astype(resolve_dtype(attrs.get("out_dtype", "float32")))
+
+
+@register_op("scale", inputs=["X"], outputs=["Out"])
+def scale(ctx, attrs, X):
+    s = attrs.get("scale", 1.0)
+    b = attrs.get("bias", 0.0)
+    if attrs.get("bias_after_scale", True):
+        return X * jnp.asarray(s, X.dtype) + jnp.asarray(b, X.dtype)
+    return (X + jnp.asarray(b, X.dtype)) * jnp.asarray(s, X.dtype)
+
+
+@register_op("sum", inputs=["X*"], outputs=["Out"])
+def sum_op(ctx, attrs, X):
+    out = X[0]
+    for x in X[1:]:
+        out = out + x
+    return out
+
+
+@register_op("shape", inputs=["Input"], outputs=["Out"], no_grad=True)
+def shape_op(ctx, attrs, Input):
+    return jnp.asarray(jnp.shape(Input), dtype=jnp.int32)
+
+
+@register_op("increment", inputs=["X"], outputs=["Out"], no_grad=True)
+def increment(ctx, attrs, X):
+    return X + jnp.asarray(attrs.get("step", 1.0), X.dtype)
+
+
+@register_op("clip", inputs=["X"], outputs=["Out"])
+def clip(ctx, attrs, X):
+    return jnp.clip(X, attrs.get("min"), attrs.get("max"))
+
+
+@register_op("clip_by_norm", inputs=["X"], outputs=["Out"])
+def clip_by_norm(ctx, attrs, X):
+    max_norm = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(jnp.square(X)))
+    scale = jnp.where(norm > max_norm, max_norm / jnp.maximum(norm, 1e-12), 1.0)
+    return X * scale.astype(X.dtype)
+
+
+@register_op("squared_l2_norm", inputs=["X"], outputs=["Out"])
+def squared_l2_norm(ctx, attrs, X):
+    return jnp.sum(jnp.square(X)).reshape(1)
+
+
+@register_op("isfinite", inputs=["X*"], outputs=["Out"], no_grad=True)
+def isfinite(ctx, attrs, X):
+    ok = jnp.array(True)
+    for x in X:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(x)))
+    return ok.reshape(1)
+
+
+@register_op("isinf", inputs=["X*"], outputs=["Out"], no_grad=True)
+def isinf(ctx, attrs, X):
+    hit = jnp.array(False)
+    for x in X:
+        hit = jnp.logical_or(hit, jnp.any(jnp.isinf(x)))
+    return hit.reshape(1)
+
+
+@register_op("isnan", inputs=["X*"], outputs=["Out"], no_grad=True)
+def isnan(ctx, attrs, X):
+    hit = jnp.array(False)
+    for x in X:
+        hit = jnp.logical_or(hit, jnp.any(jnp.isnan(x)))
+    return hit.reshape(1)
+
+
+def _infer_range_shape(op, block):
+    out = block._find_var_recursive(op.outputs["Out"][0])
+    if out is None:
+        return
+    a = op.attrs
+    if all(k in a for k in ("start", "end", "step")) and a["step"]:
+        import math
+
+        n = max(0, math.ceil((a["end"] - a["start"]) / a["step"]))
+        out.shape = (n,)
+
+
+@register_op("range", inputs=["Start", "End", "Step"], outputs=["Out"],
+             no_grad=True, infer_shape=_infer_range_shape)
+def range_op(ctx, attrs, Start=None, End=None, Step=None):
+    # XLA requires static shapes, so the bounds must be trace-time
+    # constants: taken from attrs (set by layers.range for python scalars)
+    # or from concrete (non-traced) input arrays
+    import numpy as np
+
+    def _const(v, attr, default=None):
+        if attr in attrs:
+            return float(attrs[attr])
+        if v is None:
+            return default
+        try:
+            return float(np.asarray(v).reshape(()))
+        except Exception:
+            raise ValueError(
+                "range op bounds must be static on TPU (python scalars or "
+                "constants); got a traced tensor for %r" % attr
+            )
+
+    s = _const(Start, "start", 0.0)
+    e = _const(End, "end")
+    st = _const(Step, "step", 1.0)
+    from .common import resolve_dtype
+
+    dt = resolve_dtype(attrs["dtype"]) if "dtype" in attrs else jnp.float32
+    return jnp.arange(s, e, st, dtype=dt)
+
+
+@register_op("feed", inputs=["X"], outputs=["Out"], no_grad=True)
+def feed(ctx, attrs, X):
+    return X
+
+
+@register_op("fetch", inputs=["X"], outputs=["Out"], no_grad=True)
+def fetch(ctx, attrs, X):
+    return X

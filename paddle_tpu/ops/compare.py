@@ -1,0 +1,49 @@
+"""Comparison / logical ops (reference:
+``paddle/fluid/operators/controlflow/compare_op.cc``, ``logical_op.cc``)."""
+
+import jax.numpy as jnp
+
+from .registry import register_op
+from .common import fluid_broadcast
+
+
+def _compare(name, fn):
+    @register_op(name, inputs=["X", "Y"], outputs=["Out"], no_grad=True)
+    def _op(ctx, attrs, X, Y, _fn=fn):
+        x, y = fluid_broadcast(X, Y, attrs.get("axis", -1))
+        return _fn(x, y)
+
+    return _op
+
+
+_compare("less_than", jnp.less)
+_compare("less_equal", jnp.less_equal)
+_compare("greater_than", jnp.greater)
+_compare("greater_equal", jnp.greater_equal)
+_compare("equal", jnp.equal)
+_compare("not_equal", jnp.not_equal)
+
+
+@register_op("logical_and", inputs=["X", "Y"], outputs=["Out"], no_grad=True)
+def logical_and(ctx, attrs, X, Y):
+    return jnp.logical_and(X, Y)
+
+
+@register_op("logical_or", inputs=["X", "Y"], outputs=["Out"], no_grad=True)
+def logical_or(ctx, attrs, X, Y):
+    return jnp.logical_or(X, Y)
+
+
+@register_op("logical_xor", inputs=["X", "Y"], outputs=["Out"], no_grad=True)
+def logical_xor(ctx, attrs, X, Y):
+    return jnp.logical_xor(X, Y)
+
+
+@register_op("logical_not", inputs=["X"], outputs=["Out"], no_grad=True)
+def logical_not(ctx, attrs, X):
+    return jnp.logical_not(X)
+
+
+@register_op("where", inputs=["Condition", "X", "Y"], outputs=["Out"])
+def where(ctx, attrs, Condition, X, Y):
+    return jnp.where(Condition, X, Y)

@@ -1,0 +1,168 @@
+"""Matmul / elementwise / reduction ops.
+
+Reference kernels: ``paddle/fluid/operators/mul_op.cc`` (cuBLAS via
+``math/blas.h``), ``matmul_op.cc``, ``elementwise/*``, ``reduce_ops/*``,
+``mean_op.cc``.  On TPU these lower to jnp/lax so XLA schedules them on the
+MXU (matmuls accumulate in fp32 via preferred_element_type when inputs are
+bf16) and fuses the elementwise ops into neighbors.
+"""
+
+import jax.numpy as jnp
+
+from .registry import register_op
+from .common import fluid_broadcast
+
+
+def _mm_accum_dtype(x, y):
+    d = jnp.result_type(x, y)
+    if d in (jnp.bfloat16, jnp.float16):
+        return jnp.float32
+    return None
+
+
+@register_op("mul", inputs=["X", "Y"], outputs=["Out"])
+def mul(ctx, attrs, X, Y):
+    xd = int(attrs.get("x_num_col_dims", 1))
+    yd = int(attrs.get("y_num_col_dims", 1))
+    xs, ys = jnp.shape(X), jnp.shape(Y)
+    xm = X.reshape(int(jnp.prod(jnp.asarray(xs[:xd]))), -1) if len(xs) != 2 or xd != 1 else X
+    ym = Y.reshape(int(jnp.prod(jnp.asarray(ys[:yd]))), -1) if len(ys) != 2 or yd != 1 else Y
+    out = jnp.matmul(xm, ym, preferred_element_type=_mm_accum_dtype(X, Y))
+    out = out.astype(jnp.result_type(X, Y))
+    return out.reshape(xs[:xd] + ys[yd:])
+
+
+@register_op("matmul", inputs=["X", "Y"], outputs=["Out"])
+def matmul(ctx, attrs, X, Y):
+    x, y = X, Y
+    if attrs.get("transpose_X", False):
+        axes = list(range(jnp.ndim(x)))
+        axes[-1], axes[-2] = axes[-2], axes[-1]
+        x = jnp.transpose(x, axes) if jnp.ndim(x) > 1 else x
+    if attrs.get("transpose_Y", False):
+        axes = list(range(jnp.ndim(y)))
+        axes[-1], axes[-2] = axes[-2], axes[-1]
+        y = jnp.transpose(y, axes) if jnp.ndim(y) > 1 else y
+    out = jnp.matmul(x, y, preferred_element_type=_mm_accum_dtype(x, y))
+    out = out.astype(jnp.result_type(X, Y))
+    alpha = attrs.get("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * jnp.asarray(alpha, out.dtype)
+    return out
+
+
+def _elementwise(name, fn):
+    @register_op(name, inputs=["X", "Y"], outputs=["Out"])
+    def _op(ctx, attrs, X, Y, _fn=fn):
+        x, y = fluid_broadcast(X, Y, attrs.get("axis", -1))
+        return _fn(x, y)
+
+    return _op
+
+
+_elementwise("elementwise_add", jnp.add)
+_elementwise("elementwise_sub", jnp.subtract)
+_elementwise("elementwise_mul", jnp.multiply)
+_elementwise("elementwise_div", jnp.divide)
+_elementwise("elementwise_min", jnp.minimum)
+_elementwise("elementwise_max", jnp.maximum)
+_elementwise("elementwise_pow", jnp.power)
+_elementwise("elementwise_mod", jnp.mod)
+_elementwise("elementwise_floordiv", jnp.floor_divide)
+
+
+def _reduce_axes(attrs, x):
+    if attrs.get("reduce_all", False):
+        return None
+    dim = attrs.get("dim", [0])
+    if isinstance(dim, int):
+        dim = [dim]
+    return tuple(d % jnp.ndim(x) if d < 0 else d for d in dim)
+
+
+def _reduction(name, fn):
+    @register_op(name, inputs=["X"], outputs=["Out"])
+    def _op(ctx, attrs, X, _fn=fn):
+        axes = _reduce_axes(attrs, X)
+        keep = attrs.get("keep_dim", False)
+        out = _fn(X, axis=axes, keepdims=keep)
+        if jnp.ndim(out) == 0:
+            out = out.reshape(1)  # reference reduces to shape [1], not []
+        return out
+
+    return _op
+
+
+_reduction("reduce_sum", jnp.sum)
+_reduction("reduce_mean", jnp.mean)
+_reduction("reduce_max", jnp.max)
+_reduction("reduce_min", jnp.min)
+_reduction("reduce_prod", jnp.prod)
+_reduction("reduce_all", jnp.all)
+_reduction("reduce_any", jnp.any)
+
+
+@register_op("mean", inputs=["X"], outputs=["Out"])
+def mean(ctx, attrs, X):
+    return jnp.mean(X).reshape(1)
+
+
+@register_op("pow", inputs=["X"], outputs=["Out"])
+def pow_op(ctx, attrs, X):
+    return jnp.power(X, jnp.asarray(attrs.get("factor", 1.0), X.dtype))
+
+
+@register_op("top_k", inputs=["X"], outputs=["Out", "Indices"])
+def top_k(ctx, attrs, X):
+    import jax
+
+    k = int(attrs.get("k", 1))
+    vals, idx = jax.lax.top_k(X, k)
+    return {"Out": vals, "Indices": idx.astype(jnp.int32)}
+
+
+@register_op("arg_max", inputs=["X"], outputs=["Out"], no_grad=True)
+def arg_max(ctx, attrs, X):
+    axis = int(attrs.get("axis", -1))
+    return jnp.argmax(X, axis=axis).astype(jnp.int32)
+
+
+@register_op("arg_min", inputs=["X"], outputs=["Out"], no_grad=True)
+def arg_min(ctx, attrs, X):
+    axis = int(attrs.get("axis", -1))
+    return jnp.argmin(X, axis=axis).astype(jnp.int32)
+
+
+@register_op("argsort", inputs=["X"], outputs=["Out", "Indices"], no_grad=True)
+def argsort(ctx, attrs, X):
+    axis = int(attrs.get("axis", -1))
+    idx = jnp.argsort(X, axis=axis)
+    return {"Out": jnp.sort(X, axis=axis), "Indices": idx.astype(jnp.int32)}
+
+
+@register_op("cumsum", inputs=["X"], outputs=["Out"])
+def cumsum(ctx, attrs, X):
+    axis = attrs.get("axis", -1)
+    x = X
+    if attrs.get("flatten", False):
+        x = x.reshape(-1)
+        axis = 0
+    if attrs.get("reverse", False):
+        out = jnp.flip(
+            jnp.cumsum(jnp.flip(x, axis=axis), axis=axis), axis=axis
+        )
+    else:
+        out = jnp.cumsum(x, axis=axis)
+    if attrs.get("exclusive", False):
+        out = out - x
+    return out
+
+
+@register_op("maximum", inputs=["X", "Y"], outputs=["Out"])
+def maximum(ctx, attrs, X, Y):
+    return jnp.maximum(X, Y)
+
+
+@register_op("minimum", inputs=["X", "Y"], outputs=["Out"])
+def minimum(ctx, attrs, X, Y):
+    return jnp.minimum(X, Y)

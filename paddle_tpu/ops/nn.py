@@ -1,0 +1,426 @@
+"""Neural-network ops: softmax/losses, conv, pooling, norms, embedding,
+dropout.
+
+Reference kernels: ``paddle/fluid/operators/softmax_op.cc`` (+cuDNN variant),
+``softmax_with_cross_entropy_op.cc``, ``conv_op.cc``/``conv_cudnn_op.cu.cc``,
+``pool_op.cc``, ``batch_norm_op.cc``, ``layer_norm_op.cc``,
+``lookup_table_op.cc``, ``dropout_op.cc``.  TPU-native notes:
+
+* conv lowers to ``lax.conv_general_dilated`` — XLA tiles it onto the MXU;
+  there is no cuDNN-style algorithm-choice surface.
+* batch/layer norm are plain jnp expressions; XLA fuses the reductions. The
+  cross-replica variant (sync BN) is the same expression with ``lax.pmean``
+  under a mesh axis — see ops/collective.py.
+* ``softmax_with_cross_entropy`` is written as logsumexp−logit so its
+  autodiff-derived grad is exactly (softmax − onehot), matching the
+  reference's hand-written fused grad kernel.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+from .common import normalize_axis
+
+
+@register_op("softmax", inputs=["X"], outputs=["Out"])
+def softmax(ctx, attrs, X):
+    axis = int(attrs.get("axis", -1))
+    return jax.nn.softmax(X, axis=axis)
+
+
+@register_op("log_softmax", inputs=["X"], outputs=["Out"])
+def log_softmax(ctx, attrs, X):
+    axis = int(attrs.get("axis", -1))
+    return jax.nn.log_softmax(X, axis=axis)
+
+
+@register_op("cross_entropy", inputs=["X", "Label"], outputs=["Y"])
+def cross_entropy(ctx, attrs, X, Label):
+    soft_label = attrs.get("soft_label", False)
+    ignore_index = int(attrs.get("ignore_index", -100))
+    eps = 1e-12
+    if soft_label:
+        loss = -jnp.sum(Label * jnp.log(X + eps), axis=-1, keepdims=True)
+    else:
+        lab = Label.reshape(Label.shape[:-1]) if Label.shape[-1] == 1 else Label
+        lab = lab.astype(jnp.int32)
+        picked = jnp.take_along_axis(
+            X, jnp.maximum(lab, 0)[..., None], axis=-1
+        )[..., 0]
+        loss = -jnp.log(picked + eps)
+        loss = jnp.where(lab == ignore_index, jnp.zeros_like(loss), loss)
+        loss = loss[..., None]
+    return loss
+
+
+@register_op(
+    "softmax_with_cross_entropy",
+    inputs=["Logits", "Label"],
+    outputs=["Softmax", "Loss"],
+    stateful_outputs=("Softmax",),
+)
+def softmax_with_cross_entropy(ctx, attrs, Logits, Label):
+    axis = normalize_axis(int(attrs.get("axis", -1)), jnp.ndim(Logits))
+    soft_label = attrs.get("soft_label", False)
+    ignore_index = int(attrs.get("ignore_index", -100))
+    lse = jax.scipy.special.logsumexp(Logits, axis=axis, keepdims=True)
+    log_softmax = Logits - lse
+    if soft_label:
+        loss = -jnp.sum(Label * log_softmax, axis=axis, keepdims=True)
+    else:
+        lab = Label
+        if lab.shape[axis] == 1:
+            lab = jnp.squeeze(lab, axis=axis)
+        lab = lab.astype(jnp.int32)
+        picked = jnp.take_along_axis(
+            log_softmax, jnp.expand_dims(jnp.maximum(lab, 0), axis), axis=axis
+        )
+        loss = -picked
+        mask = jnp.expand_dims(lab, axis) == ignore_index
+        loss = jnp.where(mask, jnp.zeros_like(loss), loss)
+    return {"Softmax": jax.lax.stop_gradient(jnp.exp(log_softmax)), "Loss": loss}
+
+
+@register_op("dropout", inputs=["X"], outputs=["Out", "Mask"],
+             stateful_outputs=("Mask",))
+def dropout(ctx, attrs, X):
+    p = float(attrs.get("dropout_prob", 0.5))
+    is_test = attrs.get("is_test", False) or ctx.mode == "infer"
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if is_test:
+        if impl == "upscale_in_train":
+            out = X
+        else:
+            out = X * jnp.asarray(1.0 - p, X.dtype)
+        return {"Out": out, "Mask": jnp.ones_like(X, dtype=jnp.uint8)}
+    seed = int(attrs.get("seed", 0))
+    # a user seed pins the stream deterministically but must still vary
+    # per step/op — fold it into the per-step key rather than replacing it
+    key = ctx.rng()
+    if seed:
+        key = jax.random.fold_in(key, seed)
+    keep = jax.random.bernoulli(key, 1.0 - p, jnp.shape(X))
+    if impl == "upscale_in_train":
+        scale = 1.0 / (1.0 - p) if p < 1.0 else 0.0
+        out = jnp.where(keep, X * jnp.asarray(scale, X.dtype), jnp.zeros_like(X))
+    else:
+        out = jnp.where(keep, X, jnp.zeros_like(X))
+    return {"Out": out, "Mask": keep.astype(jnp.uint8)}
+
+
+def _lookup(W, Ids, padding_idx):
+    ids = Ids
+    squeeze_last = ids.ndim > 1 and ids.shape[-1] == 1
+    if squeeze_last:
+        ids = ids[..., 0]
+    ids = ids.astype(jnp.int32)
+    out = jnp.take(W, jnp.maximum(ids, 0), axis=0)
+    if padding_idx is not None and padding_idx != -1:
+        out = jnp.where(
+            (ids == padding_idx)[..., None], jnp.zeros_like(out), out
+        )
+    return out
+
+
+@register_op("lookup_table", inputs=["W", "Ids"], outputs=["Out"])
+def lookup_table(ctx, attrs, W, Ids):
+    # reference op: Ids shaped [..., 1] int64 (lookup_table_op.cc); grad wrt W
+    # is the vjp of take = scatter-add, XLA's native sparse-grad form on TPU
+    return _lookup(W, Ids, attrs.get("padding_idx", -1))
+
+
+@register_op("lookup_table_v2", inputs=["W", "Ids"], outputs=["Out"])
+def lookup_table_v2(ctx, attrs, W, Ids):
+    return _lookup(W, Ids, attrs.get("padding_idx", -1))
+
+
+@register_op("embedding", inputs=["W", "Ids"], outputs=["Out"])
+def embedding(ctx, attrs, W, Ids):
+    return _lookup(W, Ids, attrs.get("padding_idx", -1))
+
+
+@register_op("one_hot", inputs=["X"], outputs=["Out"], no_grad=True)
+def one_hot(ctx, attrs, X):
+    depth = int(attrs.get("depth"))
+    ids = X
+    if ids.ndim > 1 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    return jax.nn.one_hot(ids.astype(jnp.int32), depth, dtype=jnp.float32)
+
+
+@register_op("one_hot_v2", inputs=["X"], outputs=["Out"], no_grad=True)
+def one_hot_v2(ctx, attrs, X):
+    depth = int(attrs.get("depth"))
+    return jax.nn.one_hot(X.astype(jnp.int32), depth, dtype=jnp.float32)
+
+
+@register_op(
+    "layer_norm",
+    inputs=["X", "Scale", "Bias"],
+    outputs=["Y", "Mean", "Variance"],
+    stateful_outputs=("Mean", "Variance"),
+)
+def layer_norm(ctx, attrs, X, Scale, Bias):
+    begin = int(attrs.get("begin_norm_axis", 1))
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(begin, jnp.ndim(X)))
+    x32 = X.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=axes, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+    # Scale/Bias are stored flattened over the normalized dims
+    # (layer_norm_op.cc InferShape); broadcast them back over X's tail
+    bshape = (1,) * begin + jnp.shape(X)[begin:]
+    if Scale is not None:
+        y = y * Scale.astype(jnp.float32).reshape(bshape)
+    if Bias is not None:
+        y = y + Bias.astype(jnp.float32).reshape(bshape)
+    return {
+        "Y": y.astype(X.dtype),
+        "Mean": jnp.squeeze(mean, axes).reshape(-1),
+        "Variance": jnp.squeeze(var, axes).reshape(-1),
+    }
+
+
+@register_op(
+    "batch_norm",
+    inputs=["X", "Scale", "Bias", "Mean", "Variance"],
+    outputs=["Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"],
+    stateful_outputs=("MeanOut", "VarianceOut", "SavedMean", "SavedVariance"),
+)
+def batch_norm(ctx, attrs, X, Scale, Bias, Mean, Variance):
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    is_test = attrs.get("is_test", False) or attrs.get("use_global_stats", False)
+    layout = attrs.get("data_layout", "NCHW")
+    c_axis = 1 if layout == "NCHW" else jnp.ndim(X) - 1
+    reduce_axes = tuple(i for i in range(jnp.ndim(X)) if i != c_axis)
+    bshape = tuple(
+        jnp.shape(X)[i] if i == c_axis else 1 for i in range(jnp.ndim(X))
+    )
+    x32 = X.astype(jnp.float32)
+    if is_test:
+        use_mean, use_var = Mean, Variance
+        mean_out, var_out = Mean, Variance
+        saved_mean, saved_var = Mean, Variance
+    else:
+        bm = jnp.mean(x32, axis=reduce_axes)
+        bv = jnp.mean(jnp.square(x32 - bm.reshape(bshape)), axis=reduce_axes)
+        use_mean, use_var = bm, bv
+        mean_out = Mean * momentum + bm * (1 - momentum)
+        var_out = Variance * momentum + bv * (1 - momentum)
+        saved_mean, saved_var = bm, jax.lax.rsqrt(bv + eps)
+    y = (x32 - use_mean.reshape(bshape)) * jax.lax.rsqrt(
+        use_var.reshape(bshape) + eps
+    )
+    y = y * Scale.reshape(bshape) + Bias.reshape(bshape)
+    return {
+        "Y": y.astype(X.dtype),
+        "MeanOut": jax.lax.stop_gradient(mean_out),
+        "VarianceOut": jax.lax.stop_gradient(var_out),
+        "SavedMean": jax.lax.stop_gradient(saved_mean),
+        "SavedVariance": jax.lax.stop_gradient(saved_var),
+    }
+
+
+def _conv_padding(paddings, ksize, dilations):
+    if isinstance(paddings, str):
+        return paddings  # 'SAME' / 'VALID'
+    if len(paddings) == len(ksize):
+        return [(p, p) for p in paddings]
+    # already pairs
+    return [
+        (paddings[2 * i], paddings[2 * i + 1]) for i in range(len(ksize))
+    ]
+
+
+def _conv_nd(ctx, attrs, Input, Filter, nd):
+    strides = [int(s) for s in attrs.get("strides", [1] * nd)]
+    paddings = attrs.get("paddings", [0] * nd)
+    dilations = [int(d) for d in attrs.get("dilations", [1] * nd)]
+    groups = int(attrs.get("groups", 1) or 1)
+    layout = attrs.get("data_format", "NCHW")
+    ksize = jnp.shape(Filter)[2:]
+    pad = _conv_padding(paddings, ksize, dilations)
+    if nd == 2:
+        dn_in = "NCHW" if layout in ("NCHW", "AnyLayout") else "NHWC"
+        dn = (dn_in, "OIHW", dn_in)
+    else:
+        dn_in = "NCDHW" if layout in ("NCDHW", "AnyLayout", "NCHW") else "NDHWC"
+        dn = (dn_in, "OIDHW", dn_in)
+    acc = (
+        jnp.float32
+        if jnp.result_type(Input, Filter) in (jnp.bfloat16, jnp.float16)
+        else None
+    )
+    out = jax.lax.conv_general_dilated(
+        Input,
+        Filter,
+        window_strides=strides,
+        padding=pad,
+        rhs_dilation=dilations,
+        dimension_numbers=dn,
+        feature_group_count=groups,
+        preferred_element_type=acc,
+    )
+    return out.astype(jnp.result_type(Input, Filter))
+
+
+@register_op("conv2d", inputs=["Input", "Filter"], outputs=["Output"])
+def conv2d(ctx, attrs, Input, Filter):
+    return _conv_nd(ctx, attrs, Input, Filter, 2)
+
+
+@register_op("depthwise_conv2d", inputs=["Input", "Filter"], outputs=["Output"])
+def depthwise_conv2d(ctx, attrs, Input, Filter):
+    return _conv_nd(ctx, attrs, Input, Filter, 2)
+
+
+@register_op("conv3d", inputs=["Input", "Filter"], outputs=["Output"])
+def conv3d(ctx, attrs, Input, Filter):
+    return _conv_nd(ctx, attrs, Input, Filter, 3)
+
+
+@register_op("conv2d_transpose", inputs=["Input", "Filter"], outputs=["Output"])
+def conv2d_transpose(ctx, attrs, Input, Filter):
+    strides = [int(s) for s in attrs.get("strides", [1, 1])]
+    paddings = attrs.get("paddings", [0, 0])
+    dilations = [int(d) for d in attrs.get("dilations", [1, 1])]
+    groups = int(attrs.get("groups", 1) or 1)
+    ksize = jnp.shape(Filter)[2:]
+    pad = _conv_padding(paddings, ksize, dilations)
+    out = jax.lax.conv_transpose(
+        Input,
+        Filter,
+        strides=strides,
+        padding=pad,
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        transpose_kernel=True,
+    )
+    if groups != 1:
+        raise NotImplementedError("grouped conv2d_transpose")
+    return out
+
+
+@register_op("pool2d", inputs=["X"], outputs=["Out"])
+def pool2d(ctx, attrs, X):
+    ptype = attrs.get("pooling_type", "max")
+    ksize = [int(k) for k in attrs.get("ksize", [2, 2])]
+    strides = [int(s) for s in attrs.get("strides", [2, 2])]
+    paddings = [int(p) for p in attrs.get("paddings", [0, 0])]
+    global_pooling = attrs.get("global_pooling", False)
+    adaptive = attrs.get("adaptive", False)
+    exclusive = attrs.get("exclusive", True)
+    n, c, h, w = jnp.shape(X)
+    if global_pooling or (adaptive and ksize == [1, 1]):
+        ksize = [h, w]
+        strides = [1, 1]
+        paddings = [0, 0]
+    elif adaptive:
+        # adaptive pooling with output size evenly dividing input
+        ksize = [h // ksize[0], w // ksize[1]]
+        strides = list(ksize)
+        paddings = [0, 0]
+    window = (1, 1) + tuple(ksize)
+    wstrides = (1, 1) + tuple(strides)
+    pad = ((0, 0), (0, 0)) + tuple((p, p) for p in paddings)
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(X.dtype, jnp.floating) else jnp.iinfo(X.dtype).min
+        return jax.lax.reduce_window(
+            X, jnp.asarray(init, X.dtype), jax.lax.max, window, wstrides, pad
+        )
+    s = jax.lax.reduce_window(
+        X.astype(jnp.float32), 0.0, jax.lax.add, window, wstrides, pad
+    )
+    if exclusive and any(paddings):
+        ones = jnp.ones((1, 1, h, w), jnp.float32)
+        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, wstrides, pad)
+        out = s / cnt
+    else:
+        out = s / float(ksize[0] * ksize[1])
+    return out.astype(X.dtype)
+
+
+@register_op("accuracy", inputs=["Out", "Indices", "Label"],
+             outputs=["Accuracy", "Correct", "Total"], no_grad=True)
+def accuracy(ctx, attrs, Out, Indices, Label):
+    lab = Label
+    if lab.ndim > 1 and lab.shape[-1] == 1:
+        lab = lab[..., 0]
+    hit = jnp.any(Indices == lab[:, None].astype(Indices.dtype), axis=1)
+    correct = jnp.sum(hit.astype(jnp.int32))
+    total = jnp.asarray(lab.shape[0], jnp.int32)
+    return {
+        "Accuracy": (correct / total).astype(jnp.float32).reshape(1),
+        "Correct": correct.reshape(1),
+        "Total": total.reshape(1),
+    }
+
+
+@register_op("huber_loss", inputs=["X", "Y"], outputs=["Out", "Residual"],
+             stateful_outputs=("Residual",))
+def huber_loss(ctx, attrs, X, Y):
+    delta = attrs.get("delta", 1.0)
+    r = Y - X
+    ar = jnp.abs(r)
+    loss = jnp.where(
+        ar <= delta, 0.5 * jnp.square(r), delta * (ar - 0.5 * delta)
+    )
+    return {"Out": loss, "Residual": jax.lax.stop_gradient(r)}
+
+
+@register_op("square_error_cost", inputs=["X", "Y"], outputs=["Out"])
+def square_error_cost(ctx, attrs, X, Y):
+    return jnp.square(X - Y)
+
+
+@register_op("sigmoid_cross_entropy_with_logits", inputs=["X", "Label"],
+             outputs=["Out"])
+def sigmoid_cross_entropy_with_logits(ctx, attrs, X, Label):
+    ignore_index = attrs.get("ignore_index", -100)
+    loss = jnp.maximum(X, 0) - X * Label + jnp.log1p(jnp.exp(-jnp.abs(X)))
+    loss = jnp.where(Label == ignore_index, jnp.zeros_like(loss), loss)
+    if attrs.get("normalize", False):
+        norm = jnp.maximum(
+            jnp.sum((Label != ignore_index).astype(loss.dtype)), 1.0
+        )
+        loss = loss / norm
+    return loss
+
+
+@register_op("smooth_l1_loss", inputs=["X", "Y", "InsideWeight", "OutsideWeight"],
+             outputs=["Diff", "Out"], stateful_outputs=("Diff",))
+def smooth_l1_loss(ctx, attrs, X, Y, InsideWeight, OutsideWeight):
+    sigma = attrs.get("sigma", 1.0)
+    s2 = sigma * sigma
+    d = X - Y
+    if InsideWeight is not None:
+        d = d * InsideWeight
+    ad = jnp.abs(d)
+    loss = jnp.where(ad < 1.0 / s2, 0.5 * s2 * jnp.square(d), ad - 0.5 / s2)
+    if OutsideWeight is not None:
+        loss = loss * OutsideWeight
+    loss = jnp.sum(loss.reshape(loss.shape[0], -1), axis=1, keepdims=True)
+    return {"Diff": jax.lax.stop_gradient(d), "Out": loss}
+
+
+@register_op("label_smooth", inputs=["X", "PriorDist"], outputs=["Out"])
+def label_smooth(ctx, attrs, X, PriorDist):
+    eps = attrs.get("epsilon", 0.0)
+    if PriorDist is not None:
+        return (1 - eps) * X + eps * PriorDist
+    return (1 - eps) * X + eps / X.shape[-1]
+
+
+@register_op("prelu", inputs=["X", "Alpha"], outputs=["Out"])
+def prelu(ctx, attrs, X, Alpha):
+    mode = attrs.get("mode", "all")
+    if mode == "all":
+        a = Alpha.reshape(())
+    elif mode == "channel":
+        a = Alpha.reshape((1, -1) + (1,) * (jnp.ndim(X) - 2))
+    else:
+        a = Alpha.reshape((1,) + jnp.shape(X)[1:])
+    return jnp.where(X >= 0, X, a * X)

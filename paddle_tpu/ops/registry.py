@@ -1,0 +1,359 @@
+"""Op registry: op type → XLA lowering rule.
+
+The reference registers each op natively with C++ kernels per (place, dtype,
+layout, library) (``paddle/fluid/framework/op_registry.h:197,237``), separate
+``InferShape`` functions, and hand-written grad-op makers
+(``grad_op_desc_maker.h:36``).  TPU-native, one registered jax lowering
+function per op subsumes all three:
+
+* **kernels** — the lowering *is* the kernel; XLA compiles/fuses it for the
+  actual device, so there is no per-place kernel table;
+* **InferShape** — derived with ``jax.eval_shape`` over the lowering
+  (see :func:`infer_shapes`);
+* **grad ops** — a generic ``<type>_grad`` lowering is derived with
+  ``jax.vjp`` over the forward lowering (:func:`generic_grad_fn`).  Because
+  the Executor lowers the whole block into one jaxpr, XLA CSEs the forward
+  recomputation inside the vjp against the original forward ops, so the
+  default grad costs no extra FLOPs; ops can still register a hand-written
+  ``<type>_grad`` where a different formula is preferable.
+
+This mirrors the precedent the reference itself set for graph-compiler
+backends: the nGraph bridge's per-op builders (``operators/ngraph/ops/*.h``,
+``ngraph_engine.cc:474``), generalized to every op.
+"""
+
+import functools
+
+import numpy as np
+
+__all__ = [
+    "register_op",
+    "get_op_def",
+    "has_op",
+    "OpDef",
+    "OpNotRegistered",
+    "LoweringContext",
+    "call_op",
+    "infer_shapes",
+    "EMPTY_VAR_NAME",
+]
+
+EMPTY_VAR_NAME = "@EMPTY@"
+
+_OP_REGISTRY = {}
+
+_SHAPE_SENTINELS = (100003, 100019, 100043, 100057, 100069, 100103, 100109)
+
+
+class OpNotRegistered(KeyError):
+    pass
+
+
+def _parse_slots(slots):
+    """'X' plain, 'X*' duplicable (list-valued slot)."""
+    out = []
+    for s in slots or []:
+        if s.endswith("*"):
+            out.append((s[:-1], True))
+        else:
+            out.append((s, False))
+    return out
+
+
+def _kwarg_name(slot):
+    return slot.replace("@GRAD", "_grad").replace("@", "_")
+
+
+class OpDef:
+    def __init__(self, type, fn, inputs, outputs, no_grad=False,
+                 infer_shape=None, grad_maker=None, stateful_outputs=()):
+        self.type = type
+        self.fn = fn
+        self.inputs = _parse_slots(inputs)  # [(slot, duplicable)]
+        self.outputs = _parse_slots(outputs)
+        self.no_grad = no_grad
+        self.custom_infer_shape = infer_shape
+        # custom grad maker: fn(op, block, out_grads: {slot: [names]},
+        #   in_grads: {slot: [names]}) -> list of op-desc dicts
+        self.grad_maker = grad_maker
+        # output slots that are state (e.g. batch_norm running stats) —
+        # excluded from differentiation paths
+        self.stateful_outputs = set(stateful_outputs)
+
+    @property
+    def input_slot_names(self):
+        return [s for s, _ in self.inputs]
+
+    @property
+    def output_slot_names(self):
+        return [s for s, _ in self.outputs]
+
+
+def register_op(type, inputs, outputs, no_grad=False, infer_shape=None,
+                grad_maker=None, stateful_outputs=()):
+    """Decorator: register `fn(ctx, attrs, **slots)` as the lowering of `type`.
+
+    Slot kwargs are arrays (or lists of arrays for duplicable slots, or None
+    for absent optional slots).  Return value: a single array (one output
+    slot), a tuple in declared output order, or a dict slot→array/list.
+    """
+
+    def deco(fn):
+        _OP_REGISTRY[type] = OpDef(
+            type, fn, inputs, outputs, no_grad=no_grad,
+            infer_shape=infer_shape, grad_maker=grad_maker,
+            stateful_outputs=stateful_outputs,
+        )
+        return fn
+
+    return deco
+
+
+def has_op(type):
+    if type in _OP_REGISTRY:
+        return True
+    if type.endswith("_grad") and type[: -len("_grad")] in _OP_REGISTRY:
+        return True
+    return False
+
+
+def get_op_def(type):
+    d = _OP_REGISTRY.get(type)
+    if d is not None:
+        return d
+    if type.endswith("_grad"):
+        base = _OP_REGISTRY.get(type[: -len("_grad")])
+        if base is not None:
+            d = _make_generic_grad_def(base)
+            _OP_REGISTRY[type] = d
+            return d
+    raise OpNotRegistered(type)
+
+
+class LoweringContext:
+    """Per-lowering state threaded through op fns.
+
+    RNG: keys are derived deterministically from (step key, op id, draw index)
+    so that a grad op recomputing its forward (vjp) draws identical randomness
+    — which both makes dropout-style grads correct and lets XLA CSE the
+    recompute against the original forward.
+    """
+
+    def __init__(self, base_key=None, mode="train"):
+        self.base_key = base_key
+        self.mode = mode
+        self._op_id = 0
+        self._rng_count = 0
+        # hook for control-flow ops to lower sub-blocks; set by the executor
+        self.lower_sub_block = None
+        self.scope = None
+
+    def set_op(self, op_id):
+        self._op_id = op_id
+        self._rng_count = 0
+
+    def rng(self):
+        import jax
+
+        key = self.base_key
+        if key is None:
+            key = jax.random.key(0)
+        k = jax.random.fold_in(jax.random.fold_in(key, self._op_id), self._rng_count)
+        self._rng_count += 1
+        return k
+
+
+def _normalize_result(opdef, res):
+    """Normalize an op fn's return value to {slot: [values]}."""
+    if isinstance(res, dict):
+        named = res
+    elif isinstance(res, tuple):
+        named = {s: v for (s, _), v in zip(opdef.outputs, res)}
+    else:
+        slot = opdef.outputs[0][0]
+        named = {slot: res}
+    out = {}
+    for slot, dup in opdef.outputs:
+        if slot not in named or named[slot] is None:
+            continue
+        v = named[slot]
+        out[slot] = list(v) if isinstance(v, (list, tuple)) else [v]
+    return out
+
+
+def call_op(opdef, ctx, ins, attrs, op_id=0):
+    """Invoke an op lowering. `ins`: {slot: [value-or-None]}."""
+    ctx.set_op(op_id)
+    kwargs = {}
+    for slot, dup in opdef.inputs:
+        vals = ins.get(slot) or []
+        if dup:
+            kwargs[_kwarg_name(slot)] = [v for v in vals]
+        else:
+            kwargs[_kwarg_name(slot)] = vals[0] if vals else None
+    res = opdef.fn(ctx, dict(attrs), **kwargs)
+    return _normalize_result(opdef, res)
+
+
+# ---------------------------------------------------------------------------
+# Generic grad op derivation via jax.vjp
+# ---------------------------------------------------------------------------
+
+def _make_generic_grad_def(fwd_def):
+    import jax
+    import jax.numpy as jnp
+
+    grad_inputs = []
+    for slot, dup in fwd_def.inputs:
+        grad_inputs.append(slot + ("*" if dup else ""))
+    for slot, dup in fwd_def.outputs:
+        grad_inputs.append(slot + ("*" if dup else ""))
+        grad_inputs.append(slot + "@GRAD" + ("*" if dup else ""))
+    grad_outputs = [
+        slot + "@GRAD" + ("*" if dup else "") for slot, dup in fwd_def.inputs
+    ]
+
+    def grad_fn(ctx, attrs, **kwargs):
+        # reconstruct raw slot dicts from kwargs
+        fwd_in = {}
+        for slot, dup in fwd_def.inputs:
+            v = kwargs.get(_kwarg_name(slot))
+            if v is None:
+                continue
+            fwd_in[slot] = list(v) if dup else [v]
+        out_grads = {}
+        for slot, dup in fwd_def.outputs:
+            g = kwargs.get(_kwarg_name(slot + "@GRAD"))
+            if g is None:
+                continue
+            out_grads[slot] = list(g) if dup else [g]
+
+        fwd_op_id = attrs.get("__fwd_op_id__", attrs.get("__op_id__", 0))
+
+        def f(fin):
+            return call_op(fwd_def, ctx, fin, attrs, op_id=fwd_op_id)
+
+        primal, vjp_fn = jax.vjp(f, fwd_in)
+        # build cotangents matching the primal pytree exactly
+        cot = {}
+        for slot, vals in primal.items():
+            gs = out_grads.get(slot)
+            lst = []
+            for i, p in enumerate(vals):
+                g = gs[i] if gs is not None and i < len(gs) and gs[i] is not None else None
+                if g is None or slot in fwd_def.stateful_outputs:
+                    g = jnp.zeros(jnp.shape(p), _cotangent_dtype(p))
+                else:
+                    g = g.astype(_cotangent_dtype(p))
+                lst.append(g)
+            cot[slot] = lst
+        (gin,) = vjp_fn(cot)
+        result = {}
+        for slot, dup in fwd_def.inputs:
+            if slot not in gin:
+                continue
+            vals = []
+            for i, g in enumerate(gin[slot]):
+                if g is None or g.dtype == jax.dtypes.float0:
+                    # non-differentiable (int) input: emit zeros so the slot
+                    # is well-formed if someone requested it anyway
+                    p = fwd_in[slot][i]
+                    g = jnp.zeros(jnp.shape(p), jnp.float32)
+                vals.append(g)
+            result[slot + "@GRAD"] = vals
+        return result
+
+    return OpDef(
+        fwd_def.type + "_grad", grad_fn, grad_inputs, grad_outputs, no_grad=True
+    )
+
+
+def _cotangent_dtype(p):
+    import jax.numpy as jnp
+
+    d = jnp.result_type(p)
+    if jnp.issubdtype(d, jnp.floating) or jnp.issubdtype(d, jnp.complexfloating):
+        return d
+    return jnp.float32
+
+
+# ---------------------------------------------------------------------------
+# Shape/dtype inference via jax.eval_shape
+# ---------------------------------------------------------------------------
+
+def _np_dtype_of(var):
+    import jax.numpy as jnp
+
+    if var.dtype == "bfloat16":
+        return jnp.bfloat16
+    return np.dtype(var.dtype)
+
+
+def infer_shapes(op, block):
+    """Infer output var shapes/dtypes for a freshly appended op by running
+    jax.eval_shape over its lowering, with -1 dims replaced by sentinel
+    primes (mapped back to -1 afterwards).  Static shapes here are
+    graph-construction metadata only; execution re-traces with concrete feed
+    shapes, so approximation is acceptable (the reference's InferShape has
+    the same -1-propagation looseness, framework.py:985)."""
+    import jax
+
+    opdef = get_op_def(op.type)
+
+    if opdef.custom_infer_shape is not None:
+        opdef.custom_infer_shape(op, block)
+        return
+
+    ins = {}
+    used_sentinel = False
+    for slot, names in op.inputs.items():
+        vals = []
+        for n in names:
+            if n == EMPTY_VAR_NAME:
+                vals.append(None)
+                continue
+            var = block._find_var_recursive(n)
+            if var is None or var.shape is None:
+                return  # cannot infer
+            shape = []
+            for i, d in enumerate(var.shape):
+                if d is None or d < 0:
+                    shape.append(_SHAPE_SENTINELS[i % len(_SHAPE_SENTINELS)])
+                    used_sentinel = True
+                else:
+                    shape.append(int(d))
+            vals.append(jax.ShapeDtypeStruct(tuple(shape), _np_dtype_of(var)))
+        ins[slot] = vals
+
+    ctx = LoweringContext(base_key=None, mode="infer")
+
+    def f(ins_):
+        return call_op(opdef, ctx, ins_, op.attrs, op_id=op.attrs.get("__op_id__", 0))
+
+    try:
+        out_structs = jax.eval_shape(f, ins)
+    except Exception:
+        if used_sentinel:
+            return  # sentinel arithmetic broke the trace; leave shapes unset
+        raise
+
+    sent = set(_SHAPE_SENTINELS)
+    for slot, names in op.outputs.items():
+        structs = out_structs.get(slot)
+        if structs is None:
+            continue
+        for n, s in zip(names, structs):
+            var = block._find_var_recursive(n)
+            if var is None or s is None:
+                continue
+            var.shape = tuple(-1 if d in sent else int(d) for d in s.shape)
+            var.dtype = (
+                "bfloat16" if s.dtype == _np_dtype_of_bf16() else np.dtype(s.dtype).name
+            )
+
+
+@functools.lru_cache(maxsize=1)
+def _np_dtype_of_bf16():
+    import jax.numpy as jnp
+
+    return jnp.bfloat16

@@ -1,0 +1,289 @@
+"""Shape-manipulation ops (reference: ``paddle/fluid/operators/reshape_op.cc``,
+``transpose_op.cc``, ``concat_op.cc``, ``split_op.cc``, ``slice_op.cc``,
+``gather_op.cc``, ``expand_op.cc`` …).
+
+The `*2` variants (reshape2/transpose2/…) also emit an `XShape` output which
+the reference's grad kernels use to recover the input shape
+(``reshape_op.cc`` ReshapeGradOp); here XShape is a zero-size placeholder —
+the vjp-derived grads recover shapes from tracing — kept for program-structure
+parity with serialized reference models.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _resolve_new_shape(shape_attr, in_shape):
+    """Fluid reshape semantics: 0 copies the input dim, -1 infers."""
+    out = []
+    for i, s in enumerate(shape_attr):
+        if s == 0:
+            out.append(in_shape[i])
+        else:
+            out.append(int(s))
+    return tuple(out)
+
+
+def _xshape(x):
+    return jnp.zeros((0,) + tuple(jnp.shape(x)), x.dtype)
+
+
+def _infer_reshape(op, block):
+    for slot in ("X",):
+        name = op.inputs.get(slot, [None])[0]
+        var = block._find_var_recursive(name) if name else None
+    out_name = op.outputs["Out"][0]
+    out_var = block._find_var_recursive(out_name)
+    shape_attr = op.attrs.get("shape", [])
+    if out_var is None or var is None:
+        return
+    if var.shape is not None:
+        in_shape = var.shape
+        new = []
+        for i, s in enumerate(shape_attr):
+            if s == 0 and i < len(in_shape):
+                new.append(in_shape[i])
+            else:
+                new.append(int(s))
+        # resolve a single -1 if the other dims are static
+        if new.count(-1) == 1 and all(d >= 0 for d in in_shape):
+            known = 1
+            for d in new:
+                if d != -1:
+                    known *= d
+            total = 1
+            for d in in_shape:
+                total *= d
+            if known > 0 and total % known == 0:
+                new[new.index(-1)] = total // known
+        out_var.shape = tuple(new)
+    else:
+        out_var.shape = tuple(int(s) for s in shape_attr)
+    out_var.dtype = var.dtype
+    if "XShape" in op.outputs:
+        xs = block._find_var_recursive(op.outputs["XShape"][0])
+        if xs is not None and var.shape is not None:
+            xs.shape = (0,) + tuple(var.shape)
+            xs.dtype = var.dtype
+
+
+@register_op("reshape", inputs=["X", "Shape"], outputs=["Out"],
+             infer_shape=_infer_reshape)
+def reshape(ctx, attrs, X, Shape):
+    new_shape = _resolve_new_shape(attrs.get("shape", []), jnp.shape(X))
+    return jnp.reshape(X, new_shape)
+
+
+@register_op("reshape2", inputs=["X", "Shape"], outputs=["Out", "XShape"],
+             infer_shape=_infer_reshape, stateful_outputs=("XShape",))
+def reshape2(ctx, attrs, X, Shape):
+    new_shape = _resolve_new_shape(attrs.get("shape", []), jnp.shape(X))
+    return {"Out": jnp.reshape(X, new_shape), "XShape": _xshape(X)}
+
+
+@register_op("transpose", inputs=["X"], outputs=["Out"])
+def transpose(ctx, attrs, X):
+    return jnp.transpose(X, attrs.get("axis"))
+
+
+@register_op("transpose2", inputs=["X"], outputs=["Out", "XShape"],
+             stateful_outputs=("XShape",))
+def transpose2(ctx, attrs, X):
+    return {"Out": jnp.transpose(X, attrs.get("axis")), "XShape": _xshape(X)}
+
+
+@register_op("concat", inputs=["X*"], outputs=["Out"])
+def concat(ctx, attrs, X):
+    return jnp.concatenate(X, axis=int(attrs.get("axis", 0)))
+
+
+@register_op("split", inputs=["X"], outputs=["Out*"])
+def split(ctx, attrs, X):
+    axis = int(attrs.get("axis", 0))
+    sections = attrs.get("sections", [])
+    num = int(attrs.get("num", 0))
+    if sections:
+        idx = []
+        acc = 0
+        for s in sections[:-1]:
+            acc += int(s)
+            idx.append(acc)
+        parts = jnp.split(X, idx, axis=axis)
+    else:
+        parts = jnp.split(X, num, axis=axis)
+    return {"Out": parts}
+
+
+@register_op("slice", inputs=["Input"], outputs=["Out"])
+def slice_op(ctx, attrs, Input):
+    axes = attrs.get("axes", [])
+    starts = attrs.get("starts", [])
+    ends = attrs.get("ends", [])
+    idx = [slice(None)] * jnp.ndim(Input)
+    shape = jnp.shape(Input)
+    for ax, st, en in zip(axes, starts, ends):
+        st = int(st)
+        en = min(int(en), shape[ax]) if int(en) >= 0 else int(en)
+        idx[ax] = slice(st, en)
+    out = Input[tuple(idx)]
+    decrease = attrs.get("decrease_axis", [])
+    if decrease:
+        out = jnp.squeeze(out, axis=tuple(decrease))
+    return out
+
+
+@register_op("squeeze", inputs=["X"], outputs=["Out"])
+def squeeze(ctx, attrs, X):
+    axes = [a % jnp.ndim(X) for a in attrs.get("axes", [])]
+    if not axes:
+        return jnp.squeeze(X)
+    axes = [a for a in axes if jnp.shape(X)[a] == 1]
+    return jnp.squeeze(X, axis=tuple(axes))
+
+
+@register_op("squeeze2", inputs=["X"], outputs=["Out", "XShape"],
+             stateful_outputs=("XShape",))
+def squeeze2(ctx, attrs, X):
+    return {"Out": squeeze(ctx, attrs, X), "XShape": _xshape(X)}
+
+
+@register_op("unsqueeze", inputs=["X"], outputs=["Out"])
+def unsqueeze(ctx, attrs, X):
+    out = X
+    for a in sorted(attrs.get("axes", [])):
+        out = jnp.expand_dims(out, a)
+    return out
+
+
+@register_op("unsqueeze2", inputs=["X"], outputs=["Out", "XShape"],
+             stateful_outputs=("XShape",))
+def unsqueeze2(ctx, attrs, X):
+    out = X
+    for a in sorted(attrs.get("axes", [])):
+        out = jnp.expand_dims(out, a)
+    return {"Out": out, "XShape": _xshape(X)}
+
+
+@register_op("flatten", inputs=["X"], outputs=["Out"])
+def flatten(ctx, attrs, X):
+    axis = int(attrs.get("axis", 1))
+    shape = jnp.shape(X)
+    lead = 1
+    for d in shape[:axis]:
+        lead *= d
+    return jnp.reshape(X, (lead, -1))
+
+
+@register_op("flatten2", inputs=["X"], outputs=["Out", "XShape"],
+             stateful_outputs=("XShape",))
+def flatten2(ctx, attrs, X):
+    axis = int(attrs.get("axis", 1))
+    shape = jnp.shape(X)
+    lead = 1
+    for d in shape[:axis]:
+        lead *= d
+    return {"Out": jnp.reshape(X, (lead, -1)), "XShape": _xshape(X)}
+
+
+@register_op("stack", inputs=["X*"], outputs=["Y"])
+def stack(ctx, attrs, X):
+    return jnp.stack(X, axis=int(attrs.get("axis", 0)))
+
+
+@register_op("unstack", inputs=["X"], outputs=["Y*"])
+def unstack(ctx, attrs, X):
+    axis = int(attrs.get("axis", 0))
+    num = attrs.get("num") or jnp.shape(X)[axis]
+    parts = jnp.split(X, int(num), axis=axis)
+    return {"Y": [jnp.squeeze(p, axis=axis) for p in parts]}
+
+
+@register_op("gather", inputs=["X", "Index"], outputs=["Out"])
+def gather(ctx, attrs, X, Index):
+    idx = Index.astype(jnp.int32)
+    if idx.ndim > 1 and idx.shape[-1] == 1:
+        idx = idx[..., 0]
+    return jnp.take(X, idx, axis=0)
+
+
+@register_op("gather_nd", inputs=["X", "Index"], outputs=["Out"])
+def gather_nd(ctx, attrs, X, Index):
+    idx = Index.astype(jnp.int32)
+    return X[tuple(jnp.moveaxis(idx, -1, 0))]
+
+
+@register_op("scatter", inputs=["X", "Ids", "Updates"], outputs=["Out"])
+def scatter(ctx, attrs, X, Ids, Updates):
+    ids = Ids.astype(jnp.int32)
+    if ids.ndim > 1 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    if attrs.get("overwrite", True):
+        return X.at[ids].set(Updates)
+    return X.at[ids].add(Updates)
+
+
+@register_op("expand", inputs=["X"], outputs=["Out"])
+def expand(ctx, attrs, X):
+    times = [int(t) for t in attrs.get("expand_times", [])]
+    return jnp.tile(X, times)
+
+
+@register_op("expand_as", inputs=["X", "target_tensor"], outputs=["Out"])
+def expand_as(ctx, attrs, X, target_tensor):
+    times = [
+        t // s for t, s in zip(jnp.shape(target_tensor), jnp.shape(X))
+    ]
+    return jnp.tile(X, times)
+
+
+@register_op("tile", inputs=["X"], outputs=["Out"])
+def tile(ctx, attrs, X):
+    return jnp.tile(X, [int(t) for t in attrs.get("repeat_times", [])])
+
+
+@register_op("pad", inputs=["X"], outputs=["Out"])
+def pad(ctx, attrs, X):
+    p = attrs.get("paddings", [])
+    pairs = [(int(p[2 * i]), int(p[2 * i + 1])) for i in range(jnp.ndim(X))]
+    return jnp.pad(X, pairs, constant_values=attrs.get("pad_value", 0.0))
+
+
+@register_op("pad2d", inputs=["X"], outputs=["Out"])
+def pad2d(ctx, attrs, X):
+    p = [int(v) for v in attrs.get("paddings", [0, 0, 0, 0])]
+    mode = attrs.get("mode", "constant")
+    fmt = attrs.get("data_format", "NCHW")
+    if fmt == "NCHW":
+        pairs = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    else:
+        pairs = [(0, 0), (p[0], p[1]), (p[2], p[3]), (0, 0)]
+    if mode == "constant":
+        return jnp.pad(X, pairs, constant_values=attrs.get("pad_value", 0.0))
+    jmode = {"reflect": "reflect", "edge": "edge"}[mode]
+    return jnp.pad(X, pairs, mode=jmode)
+
+
+@register_op("reverse", inputs=["X"], outputs=["Out"])
+def reverse(ctx, attrs, X):
+    return jnp.flip(X, axis=tuple(attrs.get("axis", [0])))
+
+
+@register_op("lod_reset", inputs=["X", "Y"], outputs=["Out"])
+def lod_reset(ctx, attrs, X, Y):
+    # LoD metadata is carried out-of-band on TPU (segment companions);
+    # values pass through
+    return X
+
+
+@register_op("im2sequence", inputs=["X"], outputs=["Out"], no_grad=True)
+def im2sequence(ctx, attrs, X):
+    kernels = attrs.get("kernels")
+    strides = attrs.get("strides", [1, 1])
+    n, c, h, w = jnp.shape(X)
+    patches = jax.lax.conv_general_dilated_patches(
+        X, kernels, strides, "VALID", dimension_numbers=("NCHW", "OIHW", "NCHW")
+    )
+    oh, ow = patches.shape[2], patches.shape[3]
+    return patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, -1)

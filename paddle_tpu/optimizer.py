@@ -1,0 +1,746 @@
+"""Optimizers (reference: ``python/paddle/fluid/optimizer.py`` — Optimizer
+base at :50, minimize = append_backward + apply_gradients at :566,
+accumulators + one optimizer op per param at :339).
+
+TPU note: every per-param optimizer op lowers into the same jitted step
+function as the model; param/accumulator buffers are donated by the
+executor, so the update is in-place in HBM and XLA fuses the whole update
+chain — subsuming the reference's fuse_optimizer_ops_pass."""
+
+from collections import defaultdict
+
+from .framework import Program, Variable, default_main_program, default_startup_program, program_guard, name_scope
+from .layer_helper import LayerHelper
+from .initializer import ConstantInitializer
+from .backward import append_backward
+from .clip import append_gradient_clip_ops, error_clip_callback
+from .regularizer import append_regularization_ops
+from . import unique_name
+from .layers import tensor as _tensor
+
+__all__ = [
+    "Optimizer",
+    "SGD",
+    "SGDOptimizer",
+    "Momentum",
+    "MomentumOptimizer",
+    "Adagrad",
+    "AdagradOptimizer",
+    "DecayedAdagrad",
+    "DecayedAdagradOptimizer",
+    "Adam",
+    "AdamOptimizer",
+    "Adamax",
+    "AdamaxOptimizer",
+    "Adadelta",
+    "AdadeltaOptimizer",
+    "RMSProp",
+    "RMSPropOptimizer",
+    "Ftrl",
+    "FtrlOptimizer",
+    "Lamb",
+    "LambOptimizer",
+    "LarsMomentum",
+    "LarsMomentumOptimizer",
+    "ExponentialMovingAverage",
+    "ModelAverage",
+    "PipelineOptimizer",
+    "DGCMomentumOptimizer",
+]
+
+
+class Optimizer:
+    def __init__(self, learning_rate, regularization=None, name=None):
+        self.regularization = regularization
+        self._name = name
+        if not isinstance(learning_rate, (float, int, Variable)):
+            raise TypeError("learning_rate must be float or Variable")
+        self._learning_rate = learning_rate
+        self._learning_rate_map = {}
+        # {accum_name: {param_name: accum_var}}
+        self._accumulators = defaultdict(dict)
+        self.helper = None
+        self.type = getattr(self, "type", "optimizer")
+
+    # ---- learning rate ----
+    def _create_global_learning_rate(self):
+        program = default_main_program()
+        lr = self._learning_rate_map.get(program)
+        if lr is not None:
+            return
+        if isinstance(self._learning_rate, Variable):
+            self._learning_rate_map[program] = self._learning_rate
+            return
+        name = unique_name.generate("learning_rate")
+        lr_var = program.global_block().create_var(
+            name=name, shape=[1], dtype="float32", persistable=True
+        )
+        lr_var.stop_gradient = True
+        helper = LayerHelper("learning_rate")
+        helper.set_variable_initializer(
+            lr_var, ConstantInitializer(float(self._learning_rate))
+        )
+        self._learning_rate_map[program] = lr_var
+
+    def _global_learning_rate(self, program=None):
+        if program is None:
+            program = default_main_program()
+        return self._learning_rate_map.get(program)
+
+    def _create_param_lr(self, param_and_grad):
+        param = param_and_grad[0]
+        param_lr = param.optimize_attr.get("learning_rate", 1.0)
+        base = self._global_learning_rate()
+        if float(param_lr) == 1.0:
+            return base
+        helper = LayerHelper("param_lr")
+        out = helper.create_variable_for_type_inference("float32", True)
+        helper.append_op(
+            type="scale", inputs={"X": [base]}, outputs={"Out": [out]},
+            attrs={"scale": float(param_lr), "bias": 0.0},
+        )
+        return out
+
+    # ---- accumulators (reference optimizer.py:252 _add_accumulator) ----
+    def _add_accumulator(self, name, param, dtype=None, fill_value=0.0,
+                         shape=None):
+        if param.name in self._accumulators[name]:
+            return self._accumulators[name][param.name]
+        if shape is None:
+            shape = param.shape
+        helper = LayerHelper(self.type)
+        var_name = unique_name.generate(
+            "_".join([param.name, self.type, name])
+        )
+        var = default_main_program().global_block().create_var(
+            name=var_name,
+            shape=list(shape),
+            dtype=dtype or "float32",
+            persistable=True,
+        )
+        var.stop_gradient = True
+        helper.set_variable_initializer(
+            var, ConstantInitializer(float(fill_value))
+        )
+        self._accumulators[name][param.name] = var
+        return var
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][param.name]
+
+    # ---- subclass hooks ----
+    def _create_accumulators(self, block, parameters):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    def _finish_update(self, block, params_grads):
+        pass
+
+    # ---- driver (reference optimizer.py:339,441,499,566) ----
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        with program_guard(loss.block.program,
+                           startup_program or default_startup_program()):
+            return append_backward(loss, parameter_list, no_grad_set)
+
+    def _create_optimization_pass(self, parameters_and_grads):
+        program = default_main_program()
+        with name_scope("optimizer"):
+            self._create_global_learning_rate()
+            global_block = program.global_block()
+            self._create_accumulators(
+                global_block,
+                [p for p, g in parameters_and_grads if g is not None],
+            )
+            optimize_ops = []
+            for param_and_grad in parameters_and_grads:
+                if param_and_grad[1] is None:
+                    continue
+                if param_and_grad[0].trainable:
+                    optimize_ops.append(
+                        self._append_optimize_op(global_block, param_and_grad)
+                    )
+            self._finish_update(global_block, parameters_and_grads)
+        return optimize_ops
+
+    def apply_gradients(self, params_grads):
+        """clip → regularize → one optimizer op per param (reference
+        optimizer.py:499)."""
+        params_grads = sorted(params_grads, key=lambda x: x[0].name)
+        params_grads = append_gradient_clip_ops(params_grads)
+        params_grads = append_regularization_ops(
+            params_grads, self.regularization
+        )
+        self._create_optimization_pass(params_grads)
+        return params_grads
+
+    def apply_optimize(self, loss, startup_program, params_grads):
+        with program_guard(loss.block.program,
+                           startup_program or default_startup_program()):
+            self.apply_gradients(params_grads)
+        return []
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, grad_clip=None):
+        params_grads = self.backward(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+        optimize_ops = self.apply_optimize(loss, startup_program, params_grads)
+        return optimize_ops, params_grads
+
+
+class SGDOptimizer(Optimizer):
+    def __init__(self, learning_rate, regularization=None, name=None):
+        self.type = "sgd"
+        super().__init__(learning_rate, regularization, name)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        return block.append_op(
+            type="sgd",
+            inputs={
+                "Param": [param_and_grad[0]],
+                "Grad": [param_and_grad[1]],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={"ParamOut": [param_and_grad[0]]},
+            attrs={"op_role": "optimize"},
+        )
+
+
+class MomentumOptimizer(Optimizer):
+    _velocity_acc_str = "velocity"
+
+    def __init__(self, learning_rate, momentum, use_nesterov=False,
+                 regularization=None, name=None):
+        self.type = "momentum"
+        super().__init__(learning_rate, regularization, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._velocity_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        velocity = self._get_accumulator(
+            self._velocity_acc_str, param_and_grad[0]
+        )
+        return block.append_op(
+            type="momentum",
+            inputs={
+                "Param": [param_and_grad[0]],
+                "Grad": [param_and_grad[1]],
+                "Velocity": [velocity],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={
+                "ParamOut": [param_and_grad[0]],
+                "VelocityOut": [velocity],
+            },
+            attrs={
+                "mu": self._momentum,
+                "use_nesterov": self._use_nesterov,
+                "op_role": "optimize",
+            },
+        )
+
+
+class LarsMomentumOptimizer(Optimizer):
+    _velocity_acc_str = "velocity"
+
+    def __init__(self, learning_rate, momentum, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, regularization=None, name=None):
+        self.type = "lars_momentum"
+        super().__init__(learning_rate, regularization, name)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._velocity_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        velocity = self._get_accumulator(
+            self._velocity_acc_str, param_and_grad[0]
+        )
+        return block.append_op(
+            type="lars_momentum",
+            inputs={
+                "Param": [param_and_grad[0]],
+                "Grad": [param_and_grad[1]],
+                "Velocity": [velocity],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={
+                "ParamOut": [param_and_grad[0]],
+                "VelocityOut": [velocity],
+            },
+            attrs={
+                "mu": self._momentum,
+                "lars_coeff": self._lars_coeff,
+                "lars_weight_decay": self._lars_weight_decay,
+                "op_role": "optimize",
+            },
+        )
+
+
+class AdagradOptimizer(Optimizer):
+    _moment_acc_str = "moment"
+
+    def __init__(self, learning_rate, epsilon=1e-6, regularization=None,
+                 name=None, initial_accumulator_value=0.0):
+        self.type = "adagrad"
+        super().__init__(learning_rate, regularization, name)
+        self._epsilon = epsilon
+        self._initial_accumulator_value = initial_accumulator_value
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(
+                self._moment_acc_str, p,
+                fill_value=self._initial_accumulator_value,
+            )
+
+    def _append_optimize_op(self, block, param_and_grad):
+        moment = self._get_accumulator(self._moment_acc_str, param_and_grad[0])
+        return block.append_op(
+            type="adagrad",
+            inputs={
+                "Param": [param_and_grad[0]],
+                "Grad": [param_and_grad[1]],
+                "Moment": [moment],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={"ParamOut": [param_and_grad[0]], "MomentOut": [moment]},
+            attrs={"epsilon": self._epsilon, "op_role": "optimize"},
+        )
+
+
+class DecayedAdagradOptimizer(AdagradOptimizer):
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6,
+                 regularization=None, name=None):
+        Optimizer.__init__(self, learning_rate, regularization, name)
+        self.type = "decayed_adagrad"
+        self._decay = decay
+        self._epsilon = epsilon
+        self._initial_accumulator_value = 0.0
+
+    def _append_optimize_op(self, block, param_and_grad):
+        moment = self._get_accumulator(self._moment_acc_str, param_and_grad[0])
+        return block.append_op(
+            type="decayed_adagrad",
+            inputs={
+                "Param": [param_and_grad[0]],
+                "Grad": [param_and_grad[1]],
+                "Moment": [moment],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={"ParamOut": [param_and_grad[0]], "MomentOut": [moment]},
+            attrs={
+                "decay": self._decay,
+                "epsilon": self._epsilon,
+                "op_role": "optimize",
+            },
+        )
+
+
+class AdamOptimizer(Optimizer):
+    _moment1_acc_str = "moment1"
+    _moment2_acc_str = "moment2"
+    _beta1_pow_acc_str = "beta1_pow_acc"
+    _beta2_pow_acc_str = "beta2_pow_acc"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, regularization=None, name=None,
+                 lazy_mode=False):
+        self.type = "adam"
+        super().__init__(learning_rate, regularization, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._lazy_mode = lazy_mode
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._moment1_acc_str, p)
+            self._add_accumulator(self._moment2_acc_str, p)
+            self._add_accumulator(
+                self._beta1_pow_acc_str, p, fill_value=self._beta1, shape=[1]
+            )
+            self._add_accumulator(
+                self._beta2_pow_acc_str, p, fill_value=self._beta2, shape=[1]
+            )
+
+    def _append_optimize_op(self, block, param_and_grad):
+        m1 = self._get_accumulator(self._moment1_acc_str, param_and_grad[0])
+        m2 = self._get_accumulator(self._moment2_acc_str, param_and_grad[0])
+        b1p = self._get_accumulator(self._beta1_pow_acc_str, param_and_grad[0])
+        b2p = self._get_accumulator(self._beta2_pow_acc_str, param_and_grad[0])
+        return block.append_op(
+            type="adam",
+            inputs={
+                "Param": [param_and_grad[0]],
+                "Grad": [param_and_grad[1]],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+                "Moment1": [m1],
+                "Moment2": [m2],
+                "Beta1Pow": [b1p],
+                "Beta2Pow": [b2p],
+            },
+            outputs={
+                "ParamOut": [param_and_grad[0]],
+                "Moment1Out": [m1],
+                "Moment2Out": [m2],
+                "Beta1PowOut": [b1p],
+                "Beta2PowOut": [b2p],
+            },
+            attrs={
+                "beta1": self._beta1,
+                "beta2": self._beta2,
+                "epsilon": self._epsilon,
+                "lazy_mode": self._lazy_mode,
+                "op_role": "optimize",
+            },
+        )
+
+
+class AdamaxOptimizer(Optimizer):
+    _moment_acc_str = "moment"
+    _inf_norm_acc_str = "inf_norm"
+    _beta1_pow_acc_str = "beta1_pow_acc"
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, regularization=None, name=None):
+        self.type = "adamax"
+        super().__init__(learning_rate, regularization, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._moment_acc_str, p)
+            self._add_accumulator(self._inf_norm_acc_str, p)
+            self._add_accumulator(
+                self._beta1_pow_acc_str, p, fill_value=self._beta1, shape=[1]
+            )
+
+    def _append_optimize_op(self, block, param_and_grad):
+        moment = self._get_accumulator(self._moment_acc_str, param_and_grad[0])
+        inf_norm = self._get_accumulator(
+            self._inf_norm_acc_str, param_and_grad[0]
+        )
+        b1p = self._get_accumulator(self._beta1_pow_acc_str, param_and_grad[0])
+        op = block.append_op(
+            type="adamax",
+            inputs={
+                "Param": [param_and_grad[0]],
+                "Grad": [param_and_grad[1]],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+                "Moment": [moment],
+                "InfNorm": [inf_norm],
+                "Beta1Pow": [b1p],
+            },
+            outputs={
+                "ParamOut": [param_and_grad[0]],
+                "MomentOut": [moment],
+                "InfNormOut": [inf_norm],
+            },
+            attrs={
+                "beta1": self._beta1,
+                "beta2": self._beta2,
+                "epsilon": self._epsilon,
+                "op_role": "optimize",
+            },
+        )
+        # scale beta1_pow each step (reference adamax _finish_update)
+        block.append_op(
+            type="scale",
+            inputs={"X": [b1p]},
+            outputs={"Out": [b1p]},
+            attrs={"scale": self._beta1, "op_role": "optimize"},
+        )
+        return op
+
+
+class AdadeltaOptimizer(Optimizer):
+    _avg_squared_grad_acc_str = "_avg_squared_grad"
+    _avg_squared_update_acc_str = "_avg_squared_update"
+
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95,
+                 regularization=None, name=None):
+        self.type = "adadelta"
+        super().__init__(learning_rate, regularization, name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._avg_squared_grad_acc_str, p)
+            self._add_accumulator(self._avg_squared_update_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        asg = self._get_accumulator(
+            self._avg_squared_grad_acc_str, param_and_grad[0]
+        )
+        asu = self._get_accumulator(
+            self._avg_squared_update_acc_str, param_and_grad[0]
+        )
+        return block.append_op(
+            type="adadelta",
+            inputs={
+                "Param": [param_and_grad[0]],
+                "Grad": [param_and_grad[1]],
+                "AvgSquaredGrad": [asg],
+                "AvgSquaredUpdate": [asu],
+            },
+            outputs={
+                "ParamOut": [param_and_grad[0]],
+                "AvgSquaredGradOut": [asg],
+                "AvgSquaredUpdateOut": [asu],
+            },
+            attrs={
+                "epsilon": self._epsilon,
+                "rho": self._rho,
+                "op_role": "optimize",
+            },
+        )
+
+
+class RMSPropOptimizer(Optimizer):
+    _momentum_acc_str = "momentum"
+    _mean_square_acc_str = "mean_square"
+    _mean_grad_acc_str = "mean_grad"
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, regularization=None, name=None):
+        self.type = "rmsprop"
+        super().__init__(learning_rate, regularization, name)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._momentum_acc_str, p)
+            self._add_accumulator(self._mean_square_acc_str, p)
+            self._add_accumulator(self._mean_grad_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        momentum = self._get_accumulator(
+            self._momentum_acc_str, param_and_grad[0]
+        )
+        ms = self._get_accumulator(self._mean_square_acc_str, param_and_grad[0])
+        mg = self._get_accumulator(self._mean_grad_acc_str, param_and_grad[0])
+        return block.append_op(
+            type="rmsprop",
+            inputs={
+                "Param": [param_and_grad[0]],
+                "Grad": [param_and_grad[1]],
+                "Moment": [momentum],
+                "MeanSquare": [ms],
+                "MeanGrad": [mg],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={
+                "ParamOut": [param_and_grad[0]],
+                "MomentOut": [momentum],
+                "MeanSquareOut": [ms],
+                "MeanGradOut": [mg],
+            },
+            attrs={
+                "epsilon": self._epsilon,
+                "decay": self._rho,
+                "momentum": self._momentum,
+                "centered": self._centered,
+                "op_role": "optimize",
+            },
+        )
+
+
+class FtrlOptimizer(Optimizer):
+    _squared_acc_str = "squared"
+    _linear_acc_str = "linear"
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 regularization=None, name=None):
+        self.type = "ftrl"
+        super().__init__(learning_rate, regularization, name)
+        self._l1 = l1
+        self._l2 = l2
+        self._lr_power = lr_power
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator(self._squared_acc_str, p)
+            self._add_accumulator(self._linear_acc_str, p)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        sq = self._get_accumulator(self._squared_acc_str, param_and_grad[0])
+        lin = self._get_accumulator(self._linear_acc_str, param_and_grad[0])
+        return block.append_op(
+            type="ftrl",
+            inputs={
+                "Param": [param_and_grad[0]],
+                "Grad": [param_and_grad[1]],
+                "SquaredAccumulator": [sq],
+                "LinearAccumulator": [lin],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+            },
+            outputs={
+                "ParamOut": [param_and_grad[0]],
+                "SquaredAccumOut": [sq],
+                "LinearAccumOut": [lin],
+            },
+            attrs={
+                "l1": self._l1,
+                "l2": self._l2,
+                "lr_power": self._lr_power,
+                "op_role": "optimize",
+            },
+        )
+
+
+class LambOptimizer(AdamOptimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, regularization=None,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon,
+                         regularization, name)
+        self.type = "lamb"
+        self._weight_decay = lamb_weight_decay
+
+    def _append_optimize_op(self, block, param_and_grad):
+        m1 = self._get_accumulator(self._moment1_acc_str, param_and_grad[0])
+        m2 = self._get_accumulator(self._moment2_acc_str, param_and_grad[0])
+        b1p = self._get_accumulator(self._beta1_pow_acc_str, param_and_grad[0])
+        b2p = self._get_accumulator(self._beta2_pow_acc_str, param_and_grad[0])
+        return block.append_op(
+            type="lamb",
+            inputs={
+                "Param": [param_and_grad[0]],
+                "Grad": [param_and_grad[1]],
+                "LearningRate": [self._create_param_lr(param_and_grad)],
+                "Moment1": [m1],
+                "Moment2": [m2],
+                "Beta1Pow": [b1p],
+                "Beta2Pow": [b2p],
+            },
+            outputs={
+                "ParamOut": [param_and_grad[0]],
+                "Moment1Out": [m1],
+                "Moment2Out": [m2],
+                "Beta1PowOut": [b1p],
+                "Beta2PowOut": [b2p],
+            },
+            attrs={
+                "beta1": self._beta1,
+                "beta2": self._beta2,
+                "epsilon": self._epsilon,
+                "weight_decay": self._weight_decay,
+                "op_role": "optimize",
+            },
+        )
+
+
+class DGCMomentumOptimizer(MomentumOptimizer):
+    """Deep-gradient-compression momentum (reference optimizer.py:787).
+    On TPU the grads ride ICI, where sparsifying compression loses more in
+    gather overhead than it saves in bytes — accepted for API parity,
+    behaves as plain momentum."""
+
+    def __init__(self, learning_rate, momentum, rampup_begin_step=0,
+                 rampup_step=1, sparsity=(0.999,), use_nesterov=False,
+                 local_grad_clip_norm=None, num_trainers=None,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, momentum, use_nesterov,
+                         regularization, name)
+
+
+class ExponentialMovingAverage:
+    """EMA of params maintained as extra persistable vars updated in-graph
+    (reference optimizer.py:2434)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._name = name or ""
+        self._ema_vars = {}
+        self._params = []
+        program = default_main_program()
+        helper = LayerHelper("ema")
+        block = program.global_block()
+        for p in program.all_parameters():
+            if not p.trainable:
+                continue
+            ema = block.create_var(
+                name=unique_name.generate(p.name + ".ema"),
+                shape=p.shape, dtype=p.dtype, persistable=True,
+            )
+            ema.stop_gradient = True
+            helper.set_variable_initializer(ema, ConstantInitializer(0.0))
+            self._ema_vars[p.name] = ema
+            self._params.append(p)
+
+    def update(self):
+        block = default_main_program().global_block()
+        for p in self._params:
+            ema = self._ema_vars[p.name]
+            # ema = decay*ema + (1-decay)*p, built from scale+sum ops
+            t1 = block.create_var(
+                name=unique_name.generate(p.name + ".ema_t1"),
+                shape=p.shape, dtype=p.dtype,
+            )
+            t2 = block.create_var(
+                name=unique_name.generate(p.name + ".ema_t2"),
+                shape=p.shape, dtype=p.dtype,
+            )
+            block.append_op(
+                type="scale", inputs={"X": [ema]}, outputs={"Out": [t1]},
+                attrs={"scale": self._decay},
+            )
+            block.append_op(
+                type="scale", inputs={"X": [p]}, outputs={"Out": [t2]},
+                attrs={"scale": 1.0 - self._decay},
+            )
+            block.append_op(
+                type="sum", inputs={"X": [t1, t2]}, outputs={"Out": [ema]},
+            )
+
+    def apply(self, executor=None, need_restore=True):
+        raise NotImplementedError("EMA apply/restore lands with io batch")
+
+    def restore(self, executor=None):
+        raise NotImplementedError
+
+
+class ModelAverage(Optimizer):
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "ModelAverage lands with the advanced-optimizer batch"
+        )
+
+
+class PipelineOptimizer:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "PipelineOptimizer → microbatched shard_map pipeline, stage 9 "
+            "of SURVEY.md §7"
+        )
+
+
+# reference short aliases
+SGD = SGDOptimizer
+Momentum = MomentumOptimizer
+Adagrad = AdagradOptimizer
+DecayedAdagrad = DecayedAdagradOptimizer
+Adam = AdamOptimizer
+Adamax = AdamaxOptimizer
+Adadelta = AdadeltaOptimizer
+RMSProp = RMSPropOptimizer
+Ftrl = FtrlOptimizer
+Lamb = LambOptimizer
+LarsMomentum = LarsMomentumOptimizer

@@ -1,0 +1,60 @@
+"""Profiler bridge (reference: ``python/paddle/fluid/profiler.py`` +
+``platform/profiler.h`` RecordEvent + CUPTI device tracer + timeline.py).
+
+TPU-native: jax's XPlane profiler is the device tracer; traces are written
+as TensorBoard trace files (the chrome://tracing role of
+``tools/timeline.py``).  `_RecordEvent`/`record_event` maps to
+``jax.profiler.TraceAnnotation`` so user annotations appear in the trace."""
+
+import contextlib
+import tempfile
+
+__all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
+           "record_event", "cuda_profiler"]
+
+_trace_dir = None
+
+
+def start_profiler(state="All", tracer_option=None):
+    import jax
+
+    global _trace_dir
+    _trace_dir = tempfile.mkdtemp(prefix="paddle_tpu_profile_")
+    jax.profiler.start_trace(_trace_dir)
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    import jax
+
+    jax.profiler.stop_trace()
+    print("[paddle_tpu.profiler] trace written under %s "
+          "(open with TensorBoard)" % _trace_dir)
+
+
+def reset_profiler():
+    pass
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/profile",
+             tracer_option=None):
+    start_profiler(state, tracer_option)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def record_event(name):
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+@contextlib.contextmanager
+def cuda_profiler(*args, **kwargs):
+    # accepted for source compatibility; TPU tracing is the jax profiler
+    with profiler():
+        yield

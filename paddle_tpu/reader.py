@@ -1,0 +1,120 @@
+"""Host input pipeline (reference: ``python/paddle/fluid/reader.py`` PyReader
+→ background thread → LoDTensorBlockingQueue → read op).
+
+TPU-native: a double-buffered background-thread prefetcher that overlaps
+host batch assembly + H2D transfer with device compute — the role the
+reference's blocking queue + read op play, without graph-side reader ops."""
+
+import queue as _queue
+import threading
+
+import numpy as np
+
+__all__ = ["PyReader", "DataLoader"]
+
+
+class _Prefetcher:
+    def __init__(self, gen_fn, capacity):
+        self.gen_fn = gen_fn
+        self.capacity = capacity
+        self.queue = None
+        self.thread = None
+        self._stop = threading.Event()
+
+    def start(self):
+        self.queue = _queue.Queue(maxsize=self.capacity)
+        self._stop.clear()
+
+        def worker():
+            try:
+                for item in self.gen_fn():
+                    if self._stop.is_set():
+                        return
+                    self.queue.put(item)
+            finally:
+                self.queue.put(None)  # end-of-epoch sentinel
+
+        self.thread = threading.Thread(target=worker, daemon=True)
+        self.thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self.queue is not None:
+            try:
+                while True:
+                    self.queue.get_nowait()
+            except _queue.Empty:
+                pass
+
+    def __iter__(self):
+        while True:
+            item = self.queue.get()
+            if item is None:
+                return
+            yield item
+
+
+class PyReader:
+    """Iterable/decorated reader (reference reader.py:46).  Use
+    ``decorate_sample_list_generator``/``decorate_batch_generator`` then
+    iterate: each item is a feed dict."""
+
+    def __init__(self, feed_list=None, capacity=16, use_double_buffer=True,
+                 iterable=True, return_list=False):
+        self._feed_list = feed_list or []
+        self._capacity = capacity
+        self._iterable = iterable
+        self._prefetcher = None
+        self._feeder = None
+
+    def decorate_sample_list_generator(self, reader, places=None):
+        from .data_feeder import DataFeeder
+
+        feeder = DataFeeder(self._feed_list, places)
+
+        def gen():
+            for batch in reader():
+                yield feeder.feed(batch)
+
+        self._gen = gen
+        return self
+
+    def decorate_batch_generator(self, reader, places=None):
+        def gen():
+            for batch in reader():
+                if isinstance(batch, dict):
+                    yield batch
+                else:
+                    yield {
+                        v.name: np.asarray(b)
+                        for v, b in zip(self._feed_list, batch)
+                    }
+
+        self._gen = gen
+        return self
+
+    decorate_paddle_reader = decorate_sample_list_generator
+
+    def start(self):
+        self._prefetcher = _Prefetcher(self._gen, self._capacity)
+        self._prefetcher.start()
+
+    def reset(self):
+        if self._prefetcher:
+            self._prefetcher.stop()
+        self._prefetcher = None
+
+    def __iter__(self):
+        if self._prefetcher is None:
+            self.start()
+        p = self._prefetcher
+        self._prefetcher = None
+        return iter(p)
+
+
+class DataLoader:
+    @staticmethod
+    def from_generator(feed_list=None, capacity=16, use_double_buffer=True,
+                       iterable=True, return_list=False):
+        return PyReader(feed_list, capacity, use_double_buffer, iterable,
+                        return_list)

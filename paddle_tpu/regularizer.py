@@ -1,0 +1,88 @@
+"""Weight-decay regularizers appended as grad ops (reference:
+``python/paddle/fluid/regularizer.py``)."""
+
+from .framework import Parameter
+from . import unique_name
+
+__all__ = ["L1Decay", "L2Decay", "L1DecayRegularizer", "L2DecayRegularizer",
+           "append_regularization_ops"]
+
+
+class WeightDecayRegularizer:
+    def __call__(self, param, grad, block):
+        raise NotImplementedError
+
+
+class L2DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._regularization_coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        decay = block.create_var(
+            name=unique_name.generate(param.name + ".l2decay"),
+            shape=param.shape, dtype=param.dtype,
+        )
+        block.append_op(
+            type="scale", inputs={"X": [param]}, outputs={"Out": [decay]},
+            attrs={"scale": self._regularization_coeff, "op_role": "backward"},
+        )
+        return decay
+
+
+class L1DecayRegularizer(WeightDecayRegularizer):
+    def __init__(self, regularization_coeff=0.0):
+        self._regularization_coeff = regularization_coeff
+
+    def __call__(self, param, grad, block):
+        sign = block.create_var(
+            name=unique_name.generate(param.name + ".sign"),
+            shape=param.shape, dtype=param.dtype,
+        )
+        block.append_op(
+            type="sign", inputs={"X": [param]}, outputs={"Out": [sign]},
+            attrs={"op_role": "backward"},
+        )
+        decay = block.create_var(
+            name=unique_name.generate(param.name + ".l1decay"),
+            shape=param.shape, dtype=param.dtype,
+        )
+        block.append_op(
+            type="scale", inputs={"X": [sign]}, outputs={"Out": [decay]},
+            attrs={"scale": self._regularization_coeff, "op_role": "backward"},
+        )
+        return decay
+
+
+def append_regularization_ops(parameters_and_grads, regularization=None):
+    """grad += coeff * decay_term(param) for each regularized param
+    (reference regularizer.py append_regularization_ops)."""
+    params_and_grads = []
+    for param, grad in parameters_and_grads:
+        if grad is None:
+            params_and_grads.append((param, grad))
+            continue
+        regularization_term = None
+        block = grad.block
+        if isinstance(param, Parameter) and param.regularizer is not None:
+            regularization_term = param.regularizer(param, grad, block)
+        elif regularization is not None:
+            regularization_term = regularization(param, grad, block)
+        if regularization_term is None:
+            params_and_grads.append((param, grad))
+            continue
+        new_grad = block.create_var(
+            name=unique_name.generate(grad.name + ".reg"),
+            shape=grad.shape, dtype=grad.dtype,
+        )
+        block.append_op(
+            type="sum",
+            inputs={"X": [grad, regularization_term]},
+            outputs={"Out": [new_grad]},
+            attrs={"op_role": "backward"},
+        )
+        params_and_grads.append((param, new_grad))
+    return params_and_grads
+
+
+L1Decay = L1DecayRegularizer
+L2Decay = L2DecayRegularizer

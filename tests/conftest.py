@@ -1,0 +1,16 @@
+"""Test config: run on a virtual 8-device CPU mesh (the reference's
+"fake cluster" pattern: test_dist_base.py uses localhost subprocesses; here
+XLA's forced host device count gives 8 fake TPU chips — SURVEY.md §4)."""
+
+import os
+
+# must be set before the XLA backend initializes
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+# the image pins jax_platforms=axon,cpu (real TPU via tunnel); tests run on
+# CPU so they are hermetic and can use the 8-device mesh
+jax.config.update("jax_platforms", "cpu")

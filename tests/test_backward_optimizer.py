@@ -1,0 +1,186 @@
+"""append_backward + optimizer correctness (reference tests:
+unittests/test_backward.py, test_optimizer.py — and regression tests for
+review findings: apply_gradients no-op, Adam bias correction)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.executor import Scope, scope_guard, global_scope
+
+
+def _linreg_program(lr=0.1, optimizer=None):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        yt = fluid.layers.data("yt", shape=[1], dtype="float32")
+        y = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(y, yt))
+    return main, startup, x, yt, loss
+
+
+def test_append_backward_grads_match_numeric():
+    main, startup, x, yt, loss = _linreg_program()
+    with fluid.program_guard(main, startup):
+        params_grads = fluid.append_backward(loss)
+    assert len(params_grads) == 2  # w, b
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        xv = rng.rand(8, 4).astype("float32")
+        yv = rng.rand(8, 1).astype("float32")
+        p, g = params_grads[0]
+        w0 = np.asarray(global_scope().get(p.name))
+        analytic = exe.run(
+            main, feed={"x": xv, "yt": yv}, fetch_list=[g]
+        )[0]
+        # numeric gradient (the reference op_test.py oracle)
+        eps = 1e-3
+        num = np.zeros_like(w0)
+        for i in range(w0.shape[0]):
+            for j in range(w0.shape[1]):
+                for sgn in (+1, -1):
+                    w = w0.copy()
+                    w[i, j] += sgn * eps
+                    global_scope().set(p.name, w)
+                    lv = exe.run(
+                        main, feed={"x": xv, "yt": yv}, fetch_list=[loss]
+                    )[0]
+                    num[i, j] += sgn * float(lv[0])
+                num[i, j] /= 2 * eps
+        global_scope().set(p.name, w0)
+        np.testing.assert_allclose(analytic, num, rtol=1e-2, atol=1e-3)
+
+
+def test_sgd_converges_linear_regression():
+    main, startup, x, yt, loss = _linreg_program()
+    with fluid.program_guard(main, startup):
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        rng = np.random.RandomState(1)
+        w_true = rng.randn(4, 1).astype("float32")
+        first = last = None
+        for step in range(200):
+            xv = rng.randn(32, 4).astype("float32")
+            yv = xv @ w_true
+            lv = exe.run(main, feed={"x": xv, "yt": yv},
+                         fetch_list=[loss])[0]
+            if first is None:
+                first = float(lv[0])
+            last = float(lv[0])
+        assert last < 1e-3, (first, last)
+
+
+def test_backward_then_apply_gradients_trains():
+    """apply_gradients alone must append the update ops (review finding:
+    the split API silently trained nothing)."""
+    main, startup, x, yt, loss = _linreg_program()
+    opt = fluid.optimizer.SGD(learning_rate=0.1)
+    with fluid.program_guard(main, startup):
+        params_grads = opt.backward(loss)
+        opt.apply_gradients(params_grads)
+    sgd_ops = [op for op in main.global_block().ops if op.type == "sgd"]
+    assert len(sgd_ops) == 2
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        rng = np.random.RandomState(2)
+        w_true = rng.randn(4, 1).astype("float32")
+        for _ in range(100):
+            xv = rng.randn(32, 4).astype("float32")
+            lv = exe.run(main, feed={"x": xv, "yt": xv @ w_true},
+                         fetch_list=[loss])[0]
+        assert float(lv[0]) < 1e-2
+
+
+def test_adam_first_step_matches_reference_formula():
+    """Regression: bias correction must use beta_pow = beta^t as stored,
+    not advance it an extra step (reference adam_op.h:93)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[1], dtype="float32")
+        y = fluid.layers.fc(
+            x, size=1, bias_attr=False,
+            param_attr=fluid.ParamAttr(
+                initializer=fluid.initializer.Constant(0.5)
+            ),
+        )
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.Adam(learning_rate=0.1, beta1=0.9, beta2=0.999,
+                             epsilon=1e-8).minimize(loss)
+    p = main.all_parameters()[0]
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        xv = np.ones((1, 1), "float32")
+        exe.run(main, feed={"x": xv}, fetch_list=[loss])
+        w1 = float(np.asarray(global_scope().get(p.name)).reshape(()))
+    # hand-computed Adam step: g=1, m=0.1, v=0.001,
+    # lr_t = lr*sqrt(1-0.999)/(1-0.9) = lr*0.31623..., update ≈ -0.1
+    g = 1.0
+    m = 0.1 * g
+    v = 0.001 * g * g
+    lr_t = 0.1 * np.sqrt(1 - 0.999) / (1 - 0.9)
+    expected = 0.5 - lr_t * m / (np.sqrt(v) + 1e-8)
+    np.testing.assert_allclose(w1, expected, rtol=1e-5)
+
+
+def test_momentum_adam_lamb_all_converge():
+    rng = np.random.RandomState(3)
+    w_true = rng.randn(4, 1).astype("float32")
+    for make_opt in (
+        lambda: fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9),
+        lambda: fluid.optimizer.Adam(learning_rate=0.05),
+        lambda: fluid.optimizer.Adagrad(learning_rate=0.5),
+        lambda: fluid.optimizer.RMSProp(learning_rate=0.05),
+        lambda: fluid.optimizer.Lamb(learning_rate=0.05),
+    ):
+        main, startup, x, yt, loss = _linreg_program()
+        with fluid.program_guard(main, startup):
+            make_opt().minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            exe.run(startup)
+            for _ in range(150):
+                xv = rng.randn(64, 4).astype("float32")
+                lv = exe.run(main, feed={"x": xv, "yt": xv @ w_true},
+                             fetch_list=[loss])[0]
+            assert float(lv[0]) < 0.05, make_opt
+
+
+def test_gradients_api():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3], dtype="float32")
+        x.stop_gradient = False
+        y = fluid.layers.scale(x, scale=3.0)
+        z = fluid.layers.reduce_sum(y)
+        (gx,) = fluid.gradients(z, x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    out = exe.run(main, feed={"x": np.ones((2, 3), "float32")},
+                  fetch_list=[gx])[0]
+    np.testing.assert_allclose(out, 3.0)
+
+
+def test_weight_decay_and_grad_clip():
+    main, startup, x, yt, loss = _linreg_program()
+    with fluid.program_guard(main, startup):
+        fluid.clip.set_gradient_clip(
+            fluid.clip.GradientClipByGlobalNorm(1.0), program=main
+        )
+        fluid.optimizer.SGD(
+            learning_rate=0.1,
+            regularization=fluid.regularizer.L2Decay(0.01),
+        ).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        rng = np.random.RandomState(4)
+        w_true = rng.randn(4, 1).astype("float32")
+        for _ in range(200):
+            xv = rng.randn(32, 4).astype("float32")
+            lv = exe.run(main, feed={"x": xv, "yt": xv @ w_true},
+                         fetch_list=[loss])[0]
+        assert float(lv[0]) < 0.1

@@ -1,0 +1,120 @@
+"""Executor lowering + jit-cache behavior (reference tests:
+unittests/test_executor_and_mul.py, test_exe caching)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.executor import Scope, global_scope, scope_guard
+
+
+def _fresh():
+    return fluid.Program(), fluid.Program()
+
+
+def test_fc_matches_numpy():
+    prog, startup = _fresh()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(x, size=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        sc = global_scope()
+        w = np.asarray(sc.get("fc_0.w_0") if sc.has("fc_0.w_0") else None)
+        # param names are unique per test session; find them from program
+        params = prog.all_parameters()
+        w = np.asarray(sc.get(params[0].name))
+        b = np.asarray(sc.get(params[1].name))
+        xv = np.random.RandomState(0).rand(2, 4).astype("float32")
+        out = exe.run(prog, feed={"x": xv}, fetch_list=[y])[0]
+        np.testing.assert_allclose(out, xv @ w + b, rtol=1e-5, atol=1e-5)
+
+
+def test_feed_fetch_roundtrip():
+    prog, startup = _fresh()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", shape=[3], dtype="float32")
+        y = fluid.layers.scale(x, scale=2.0, bias=1.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.arange(6, dtype="float32").reshape(2, 3)
+    out = exe.run(prog, feed={"x": xv}, fetch_list=[y])[0]
+    np.testing.assert_allclose(out, xv * 2 + 1)
+
+
+def test_persistable_update_across_runs():
+    """An op writing a persistable var must persist it (the in-place SGD
+    pattern)."""
+    prog, startup = _fresh()
+    with fluid.program_guard(prog, startup):
+        counter = fluid.layers.create_global_var(
+            [1], 0.0, "float32", persistable=True
+        )
+        fluid.layers.increment(counter, value=1.0, in_place=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        for expected in (1.0, 2.0, 3.0):
+            out = exe.run(prog, fetch_list=[counter])[0]
+            assert float(out[0]) == expected
+
+
+def test_uninitialized_var_raises():
+    prog, startup = _fresh()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(x, size=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        try:
+            exe.run(prog, feed={"x": np.zeros((1, 4), "float32")},
+                    fetch_list=[y])
+        except RuntimeError as e:
+            assert "not initialized" in str(e)
+        else:
+            raise AssertionError("expected RuntimeError")
+
+
+def test_shape_bucketing_recompiles():
+    """Different feed shapes hit different cache entries, same program."""
+    prog, startup = _fresh()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.scale(x, scale=3.0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    for n in (1, 2, 5):
+        xv = np.ones((n, 4), "float32")
+        out = exe.run(prog, feed={"x": xv}, fetch_list=[y])[0]
+        assert out.shape == (n, 4)
+        np.testing.assert_allclose(out, 3.0)
+
+
+def test_fetch_parameter_directly():
+    prog, startup = _fresh()
+    with fluid.program_guard(prog, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(x, size=3)
+    p = prog.all_parameters()[0]
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        out = exe.run(prog, feed={"x": np.zeros((1, 4), "float32")},
+                      fetch_list=[p])[0]
+        assert out.shape == tuple(p.shape)
+
+
+def test_random_ops_vary_per_step_and_respect_seed():
+    prog, startup = _fresh()
+    with fluid.program_guard(prog, startup):
+        r = fluid.layers.io.data  # noqa: F841  (no feeds needed)
+        from paddle_tpu.layer_helper import LayerHelper
+
+        helper = LayerHelper("rand")
+        out = helper.create_variable_for_type_inference("float32")
+        helper.append_op(
+            type="uniform_random", outputs={"Out": [out]},
+            attrs={"shape": [4], "min": 0.0, "max": 1.0, "seed": 0},
+        )
+    exe = fluid.Executor(fluid.CPUPlace())
+    a = exe.run(prog, fetch_list=[out])[0]
+    b = exe.run(prog, fetch_list=[out])[0]
+    assert not np.allclose(a, b), "per-step RNG should differ"

@@ -1,0 +1,101 @@
+"""Program/Block/Operator/Variable construction and shape inference
+(reference tests: unittests/test_program.py, test_variable.py,
+test_operator_desc.py)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def test_program_blocks():
+    prog = fluid.Program()
+    assert prog.num_blocks == 1
+    b = prog.global_block()
+    v = b.create_var(name="x", shape=[2, 3], dtype="float32")
+    assert b.var("x") is v
+    assert v.shape == (2, 3)
+    assert v.dtype == "float32"
+
+
+def test_program_guard_switches_default():
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        assert fluid.default_main_program() is prog
+        assert fluid.default_startup_program() is startup
+    assert fluid.default_main_program() is not prog
+
+
+def test_shape_inference_static():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = fluid.layers.data("x", shape=[8, 16], dtype="float32",
+                              append_batch_size=False)
+        y = fluid.layers.fc(x, size=4)
+        assert y.shape == (8, 4)
+        s = fluid.layers.softmax(y)
+        assert s.shape == (8, 4)
+
+
+def test_shape_inference_batch_dim():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = fluid.layers.data("x", shape=[16], dtype="float32")
+        assert x.shape == (-1, 16)
+        y = fluid.layers.fc(x, size=4)
+        # -1 batch dim propagates through mul/elementwise_add
+        assert y.shape == (-1, 4)
+
+
+def test_clone_for_test_flips_is_test():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.dropout(x, dropout_prob=0.5)
+    test_prog = prog.clone(for_test=True)
+    dropout_ops = [op for op in test_prog.global_block().ops
+                   if op.type == "dropout"]
+    assert dropout_ops and dropout_ops[0].attrs["is_test"] is True
+    # original untouched
+    assert not prog.global_block().ops[-1].attrs.get("is_test", False)
+
+
+def test_prune():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y1 = fluid.layers.fc(x, size=3)
+        y2 = fluid.layers.fc(x, size=5)
+    pruned = prog._prune(["x"], [y1])
+    kept_outputs = {
+        n for op in pruned.global_block().ops for n in op.output_arg_names
+    }
+    assert y1.name in kept_outputs
+    assert y2.name not in kept_outputs
+
+
+def test_operator_io_lists():
+    prog = fluid.Program()
+    b = prog.global_block()
+    b.create_var(name="a", shape=[2], dtype="float32")
+    b.create_var(name="c", shape=[2], dtype="float32")
+    op = b.append_op(
+        type="sum", inputs={"X": ["a", "a"]}, outputs={"Out": ["c"]}
+    )
+    assert op.input("X") == ["a", "a"]
+    assert op.output("Out") == ["c"]
+    assert set(op.input_arg_names) == {"a"}
+
+
+def test_serialization_roundtrip():
+    prog = fluid.Program()
+    with fluid.program_guard(prog, fluid.Program()):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(x, size=3, act="relu")
+    d = prog.to_proto_dict()
+    prog2 = fluid.Program.parse_from_proto_dict(d)
+    assert [op.type for op in prog2.global_block().ops] == [
+        op.type for op in prog.global_block().ops
+    ]
+    assert prog2.global_block().var(y.name).shape == y.shape
