@@ -1,0 +1,95 @@
+"""Flagship benchmark: BERT-base MLM training step, tokens/sec/chip + MFU.
+
+Reference harness analogue: ``benchmark/fluid/fluid_benchmark.py:296-300``
+(same examples/sec methodology: timed steps after warmup).  Target from
+BASELINE.json: >=45% MFU on a v5e chip (bf16 peak 197 TFLOP/s).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+V5E_BF16_PEAK = 197e12  # TPU v5e per-chip bf16 peak FLOP/s
+
+
+def model_train_flops_per_token(cfg, seq_len):
+    """Analytic FLOPs per token for one fwd+bwd step (bwd = 2x fwd)."""
+    d, ff, layers, vocab = cfg.hidden, cfg.ffn, cfg.layers, cfg.vocab_size
+    per_layer = (
+        2 * 4 * d * d          # q,k,v,o projections
+        + 2 * 2 * d * ff       # ffn in+out
+        + 2 * 2 * seq_len * d  # scores + context matmuls
+    )
+    fwd = layers * per_layer + 2 * d * vocab  # + MLM vocab projection
+    return 3 * fwd
+
+
+def peak_flops(device):
+    kind = getattr(device, "device_kind", "").lower()
+    if "v5 lite" in kind or "v5e" in kind:
+        return V5E_BF16_PEAK
+    if "v4" in kind:
+        return 275e12
+    if "cpu" in kind or not kind:
+        return 1e12  # nominal, CPU smoke runs only
+    return V5E_BF16_PEAK
+
+
+def main():
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import bert
+
+    dev = jax.devices()[0]
+    on_tpu = "tpu" in str(dev.platform).lower() or "axon" in str(
+        dev.platform
+    ).lower()
+
+    cfg = bert.BERT_BASE  # L12 D768 H12 FF3072 V30522
+    seq_len = 128
+    batch = 64 if on_tpu else 8
+    warmup, steps = 3, 20 if on_tpu else 5
+
+    main_prog, startup, feed_names, loss = bert.build_pretrain(
+        cfg, seq_len=seq_len, lr=1e-4, amp=True, train=True
+    )
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+
+    rng = np.random.RandomState(0)
+    feed = bert.make_fake_batch(batch, seq_len, cfg, rng)
+
+    for _ in range(warmup):
+        exe.run(main_prog, feed=feed, fetch_list=[])
+    lv = exe.run(main_prog, feed=feed, fetch_list=[loss])[0]  # sync
+    assert np.isfinite(lv).all()
+
+    t0 = time.perf_counter()
+    for _ in range(steps - 1):
+        exe.run(main_prog, feed=feed, fetch_list=[])
+    lv = exe.run(main_prog, feed=feed, fetch_list=[loss])[0]  # final sync
+    dt = time.perf_counter() - t0
+    assert np.isfinite(lv).all()
+
+    tokens_per_sec = batch * seq_len * steps / dt
+    flops_per_token = model_train_flops_per_token(cfg, seq_len)
+    mfu = tokens_per_sec * flops_per_token / peak_flops(dev)
+
+    print(json.dumps({
+        "metric": "bert_base_mlm_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec/chip (seq128 bs%d bf16 AMP, MFU %.3f on %s)"
+                % (batch, mfu, getattr(dev, "device_kind", str(dev))),
+        "vs_baseline": round(mfu / 0.45, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,4 +1,5 @@
-from .decorator import decorate, OptimizerWithMixedPrecision
+from .decorator import decorate, OptimizerWithMixedPrecision, rewrite_program_bf16
 from . import fp16_lists
 
-__all__ = ["decorate", "OptimizerWithMixedPrecision", "fp16_lists"]
+__all__ = ["decorate", "OptimizerWithMixedPrecision", "rewrite_program_bf16",
+           "fp16_lists"]

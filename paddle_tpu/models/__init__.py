@@ -1,0 +1,10 @@
+"""Model zoo built on the layers DSL (reference:
+``benchmark/fluid/models/`` {mnist,resnet,vgg,...}.py and the book tests
+``python/paddle/fluid/tests/book/``)."""
+
+from . import mnist
+from . import resnet
+from . import bert
+from . import vgg
+
+__all__ = ["mnist", "resnet", "bert", "vgg"]

@@ -1,0 +1,194 @@
+"""BERT/Transformer-encoder pretraining model (BASELINE config 3; reference
+analogue: the transformer benchmark ``benchmark/fluid/models/``,
+attention built like ``python/paddle/fluid/nets.py`` scaled-dot-product).
+
+TPU design: every projection is an MXU-shaped matmul via `fc` with
+num_flatten_dims=2 (so [B,T,D]x[D,K] batched GEMMs); the attention mask is
+an additive [-inf] bias broadcast over heads; AMP (bf16 rewrite,
+contrib.mixed_precision) turns all of these into bf16 MXU matmuls with fp32
+master weights."""
+
+import math
+
+import paddle_tpu as fluid
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden=768, layers=12, heads=12,
+                 ffn=3072, max_seq=512, type_vocab=2, dropout=0.1):
+        self.vocab_size = vocab_size
+        self.hidden = hidden
+        self.layers = layers
+        self.heads = heads
+        self.ffn = ffn
+        self.max_seq = max_seq
+        self.type_vocab = type_vocab
+        self.dropout = dropout
+
+
+BERT_BASE = BertConfig()
+BERT_TINY = BertConfig(vocab_size=1024, hidden=128, layers=2, heads=2,
+                       ffn=512, max_seq=128)
+
+
+def _attention(x, mask_bias, cfg, prefix):
+    d = cfg.hidden
+    dh = d // cfg.heads
+
+    def proj(inp, size, name):
+        return fluid.layers.fc(
+            inp, size=size, num_flatten_dims=2,
+            param_attr=fluid.ParamAttr(name=prefix + "." + name + ".w"),
+            bias_attr=fluid.ParamAttr(name=prefix + "." + name + ".b"),
+        )
+
+    def split_heads(t):
+        t = fluid.layers.reshape(t, [0, 0, cfg.heads, dh])
+        return fluid.layers.transpose(t, [0, 2, 1, 3])
+
+    q = split_heads(proj(x, d, "q"))
+    k = split_heads(proj(x, d, "k"))
+    v = split_heads(proj(x, d, "v"))
+    scores = fluid.layers.matmul(q, k, transpose_y=True,
+                                 alpha=1.0 / math.sqrt(dh))
+    if mask_bias is not None:
+        scores = fluid.layers.elementwise_add(scores, mask_bias)
+    probs = fluid.layers.softmax(scores)
+    if cfg.dropout:
+        probs = fluid.layers.dropout(
+            probs, cfg.dropout, dropout_implementation="upscale_in_train"
+        )
+    ctx = fluid.layers.matmul(probs, v)
+    ctx = fluid.layers.transpose(ctx, [0, 2, 1, 3])
+    ctx = fluid.layers.reshape(ctx, [0, 0, d])
+    return proj(ctx, d, "o")
+
+
+def _encoder_layer(x, mask_bias, cfg, prefix):
+    attn = _attention(x, mask_bias, cfg, prefix + ".attn")
+    if cfg.dropout:
+        attn = fluid.layers.dropout(
+            attn, cfg.dropout, dropout_implementation="upscale_in_train"
+        )
+    x = fluid.layers.layer_norm(
+        fluid.layers.elementwise_add(x, attn), begin_norm_axis=2,
+        param_attr=fluid.ParamAttr(name=prefix + ".ln1.scale"),
+        bias_attr=fluid.ParamAttr(name=prefix + ".ln1.bias"),
+    )
+    ff = fluid.layers.fc(
+        x, size=cfg.ffn, num_flatten_dims=2, act="gelu",
+        param_attr=fluid.ParamAttr(name=prefix + ".ffn1.w"),
+        bias_attr=fluid.ParamAttr(name=prefix + ".ffn1.b"),
+    )
+    ff = fluid.layers.fc(
+        ff, size=cfg.hidden, num_flatten_dims=2,
+        param_attr=fluid.ParamAttr(name=prefix + ".ffn2.w"),
+        bias_attr=fluid.ParamAttr(name=prefix + ".ffn2.b"),
+    )
+    if cfg.dropout:
+        ff = fluid.layers.dropout(
+            ff, cfg.dropout, dropout_implementation="upscale_in_train"
+        )
+    return fluid.layers.layer_norm(
+        fluid.layers.elementwise_add(x, ff), begin_norm_axis=2,
+        param_attr=fluid.ParamAttr(name=prefix + ".ln2.scale"),
+        bias_attr=fluid.ParamAttr(name=prefix + ".ln2.bias"),
+    )
+
+
+def encoder(input_ids, token_type_ids, attn_mask_bias, cfg, seq_len):
+    """[B,T] ids → [B,T,D] hidden states."""
+    init = fluid.initializer.TruncatedNormal(scale=0.02)
+    word_emb = fluid.layers.embedding(
+        input_ids, size=[cfg.vocab_size, cfg.hidden],
+        param_attr=fluid.ParamAttr(name="bert.word_emb", initializer=init),
+    )
+    pos_ids = fluid.layers.data("pos_ids", shape=[seq_len], dtype="int64")
+    pos_emb = fluid.layers.embedding(
+        pos_ids, size=[cfg.max_seq, cfg.hidden],
+        param_attr=fluid.ParamAttr(name="bert.pos_emb", initializer=init),
+    )
+    type_emb = fluid.layers.embedding(
+        token_type_ids, size=[cfg.type_vocab, cfg.hidden],
+        param_attr=fluid.ParamAttr(name="bert.type_emb", initializer=init),
+    )
+    x = fluid.layers.elementwise_add(
+        fluid.layers.elementwise_add(word_emb, pos_emb), type_emb
+    )
+    x = fluid.layers.layer_norm(
+        x, begin_norm_axis=2,
+        param_attr=fluid.ParamAttr(name="bert.emb_ln.scale"),
+        bias_attr=fluid.ParamAttr(name="bert.emb_ln.bias"),
+    )
+    if cfg.dropout:
+        x = fluid.layers.dropout(
+            x, cfg.dropout, dropout_implementation="upscale_in_train"
+        )
+    for i in range(cfg.layers):
+        x = _encoder_layer(x, attn_mask_bias, cfg, "bert.layer%d" % i)
+    return x
+
+
+def build_pretrain(cfg=BERT_BASE, seq_len=128, lr=1e-4, amp=False,
+                   train=True):
+    """Masked-LM pretraining program.  Returns
+    (main, startup, feed_names, loss).  With train=False only the forward
+    loss graph is built (no grad/optimizer ops)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        input_ids = fluid.layers.data("input_ids", shape=[seq_len],
+                                      dtype="int64")
+        token_type = fluid.layers.data("token_type_ids", shape=[seq_len],
+                                       dtype="int64")
+        # additive mask bias, [B,1,1,T]: 0 keep / -1e4 drop
+        mask_bias = fluid.layers.data(
+            "attn_mask_bias", shape=[1, 1, seq_len], dtype="float32"
+        )
+        mlm_labels = fluid.layers.data("mlm_labels", shape=[seq_len],
+                                       dtype="int64")
+        mlm_weights = fluid.layers.data("mlm_weights", shape=[seq_len],
+                                        dtype="float32")
+        x = encoder(input_ids, token_type, mask_bias, cfg, seq_len)
+        # MLM head: project back to vocab with the word embedding transposed
+        # (weight tying, the standard BERT head)
+        block = main.global_block()
+        word_emb = block.var("bert.word_emb")
+        logits = fluid.layers.matmul(x, word_emb, transpose_y=True)
+        loss_tok = fluid.layers.softmax_with_cross_entropy(
+            logits, fluid.layers.unsqueeze(mlm_labels, [2])
+        )
+        loss_tok = fluid.layers.squeeze(loss_tok, [2])
+        num = fluid.layers.reduce_sum(
+            fluid.layers.elementwise_mul(loss_tok, mlm_weights)
+        )
+        den = fluid.layers.reduce_sum(mlm_weights)
+        loss = fluid.layers.elementwise_div(num, den)
+        if train:
+            opt = fluid.optimizer.Adam(learning_rate=lr)
+            if amp:
+                opt = fluid.contrib.mixed_precision.decorate(opt)
+            opt.minimize(loss)
+        elif amp:
+            fluid.contrib.mixed_precision.rewrite_program_bf16(main)
+    feeds = ["input_ids", "token_type_ids", "attn_mask_bias", "pos_ids",
+             "mlm_labels", "mlm_weights"]
+    return main, startup, feeds, loss
+
+
+def make_fake_batch(batch, seq_len, cfg, rng):
+    import numpy as np
+
+    ids = rng.randint(10, cfg.vocab_size, (batch, seq_len)).astype("int64")
+    types = np.zeros((batch, seq_len), "int64")
+    mask = np.zeros((batch, 1, 1, seq_len), "float32")
+    pos = np.tile(np.arange(seq_len, dtype="int64"), (batch, 1))
+    labels = ids.copy()
+    weights = (rng.rand(batch, seq_len) < 0.15).astype("float32")
+    return {
+        "input_ids": ids,
+        "token_type_ids": types,
+        "attn_mask_bias": mask,
+        "pos_ids": pos,
+        "mlm_labels": labels,
+        "mlm_weights": weights,
+    }

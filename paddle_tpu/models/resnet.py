@@ -1,0 +1,114 @@
+"""ResNet for cifar/ImageNet (reference: ``benchmark/fluid/models/resnet.py``
+— BASELINE config 2).
+
+TPU notes: NCHW layout is kept for reference parity (XLA re-lays out for the
+MXU internally); batch_norm is the framework's batch_norm op whose
+running-stat updates ride the same jitted step."""
+
+import paddle_tpu as fluid
+
+
+def conv_bn_layer(input, ch_out, filter_size, stride, padding, act="relu",
+                  is_test=False):
+    conv = fluid.layers.conv2d(
+        input=input, num_filters=ch_out, filter_size=filter_size,
+        stride=stride, padding=padding, bias_attr=False,
+    )
+    return fluid.layers.batch_norm(conv, act=act, is_test=is_test)
+
+
+def _shortcut(input, ch_in, ch_out, stride, is_test):
+    if stride != 1 or ch_in != ch_out:
+        return conv_bn_layer(input, ch_out, 1, stride, 0, act=None,
+                             is_test=is_test)
+    return input
+
+
+def basicblock(input, ch_in, ch_out, stride, is_test):
+    short = _shortcut(input, ch_in, ch_out, stride, is_test)
+    conv1 = conv_bn_layer(input, ch_out, 3, stride, 1, is_test=is_test)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, act=None, is_test=is_test)
+    return fluid.layers.elementwise_add(short, conv2, act="relu")
+
+
+def bottleneck(input, ch_in, ch_out, stride, is_test):
+    short = _shortcut(input, ch_in, ch_out * 4, stride, is_test)
+    conv1 = conv_bn_layer(input, ch_out, 1, stride, 0, is_test=is_test)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, is_test=is_test)
+    conv3 = conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, act=None,
+                          is_test=is_test)
+    return fluid.layers.elementwise_add(short, conv3, act="relu")
+
+
+def _layer_warp(block_func, input, ch_in, ch_out, count, stride, is_test):
+    res = block_func(input, ch_in, ch_out, stride, is_test)
+    for _ in range(1, count):
+        res = block_func(res, ch_out, ch_out, 1, is_test)
+    return res
+
+
+def resnet_cifar10(input, class_dim=10, depth=32, is_test=False):
+    assert (depth - 2) % 6 == 0
+    n = (depth - 2) // 6
+    conv1 = conv_bn_layer(input, 16, 3, 1, 1, is_test=is_test)
+    res1 = _layer_warp(basicblock, conv1, 16, 16, n, 1, is_test)
+    res2 = _layer_warp(basicblock, res1, 16, 32, n, 2, is_test)
+    res3 = _layer_warp(basicblock, res2, 32, 64, n, 2, is_test)
+    pool = fluid.layers.pool2d(res3, pool_size=8, pool_type="avg",
+                               pool_stride=1)
+    return fluid.layers.fc(pool, size=class_dim)
+
+
+def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False):
+    cfg = {
+        18: ([2, 2, 2, 2], basicblock),
+        34: ([3, 4, 6, 3], basicblock),
+        50: ([3, 4, 6, 3], bottleneck),
+        101: ([3, 4, 23, 3], bottleneck),
+        152: ([3, 8, 36, 3], bottleneck),
+    }
+    stages, block_func = cfg[depth]
+    conv1 = conv_bn_layer(input, 64, 7, 2, 3, is_test=is_test)
+    pool1 = fluid.layers.pool2d(conv1, pool_size=3, pool_stride=2,
+                                pool_padding=1, pool_type="max")
+    expansion = 4 if block_func is bottleneck else 1
+    res = pool1
+    ch_in = 64
+    for i, count in enumerate(stages):
+        ch_out = 64 * (2 ** i)
+        stride = 1 if i == 0 else 2
+        res = _layer_warp(block_func, res, ch_in, ch_out, count, stride,
+                          is_test)
+        ch_in = ch_out * expansion
+    pool2 = fluid.layers.pool2d(res, pool_size=7, pool_type="avg",
+                                global_pooling=True)
+    return fluid.layers.fc(pool2, size=class_dim)
+
+
+def build(dataset="cifar10", depth=None, batch_lr=0.1, class_dim=None,
+          is_test=False):
+    """Returns (main, startup, feeds, loss, acc)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        if dataset == "cifar10":
+            img = fluid.layers.data("img", shape=[3, 32, 32],
+                                    dtype="float32")
+            logits_fn = lambda im: resnet_cifar10(  # noqa: E731
+                im, class_dim or 10, depth or 20, is_test
+            )
+        else:
+            img = fluid.layers.data("img", shape=[3, 224, 224],
+                                    dtype="float32")
+            logits_fn = lambda im: resnet_imagenet(  # noqa: E731
+                im, class_dim or 1000, depth or 50, is_test
+            )
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        logits = logits_fn(img)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label)
+        )
+        acc = fluid.layers.accuracy(fluid.layers.softmax(logits), label)
+        opt = fluid.optimizer.Momentum(learning_rate=batch_lr, momentum=0.9,
+                                       use_nesterov=True)
+        opt.minimize(loss)
+    return main, startup, [img, label], loss, acc

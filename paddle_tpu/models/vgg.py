@@ -1,0 +1,41 @@
+"""VGG-16 (reference: ``benchmark/fluid/models/vgg.py``)."""
+
+import paddle_tpu as fluid
+
+
+def vgg16(input, class_dim, is_test=False):
+    def conv_block(inp, num_filter, groups):
+        return fluid.nets.img_conv_group(
+            input=inp, conv_num_filter=[num_filter] * groups,
+            pool_size=2, pool_stride=2, conv_filter_size=3,
+            conv_act="relu", conv_with_batchnorm=True,
+        )
+
+    c1 = conv_block(input, 64, 2)
+    c2 = conv_block(c1, 128, 2)
+    c3 = conv_block(c2, 256, 3)
+    c4 = conv_block(c3, 512, 3)
+    c5 = conv_block(c4, 512, 3)
+    d1 = fluid.layers.dropout(c5, 0.5)
+    fc1 = fluid.layers.fc(d1, size=512, act=None)
+    bn = fluid.layers.batch_norm(fc1, act="relu", is_test=is_test,
+                                 data_layout="NHWC")
+    d2 = fluid.layers.dropout(bn, 0.5)
+    fc2 = fluid.layers.fc(d2, size=512, act=None)
+    return fluid.layers.fc(fc2, size=class_dim)
+
+
+def build(dataset="cifar10", lr=1e-3):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        shape = [3, 32, 32] if dataset == "cifar10" else [3, 224, 224]
+        class_dim = 10 if dataset == "cifar10" else 1000
+        img = fluid.layers.data("img", shape=shape, dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        logits = vgg16(img, class_dim)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label)
+        )
+        acc = fluid.layers.accuracy(fluid.layers.softmax(logits), label)
+        fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
+    return main, startup, [img, label], loss, acc

@@ -22,11 +22,13 @@ def _mm_accum_dtype(x, y):
 
 @register_op("mul", inputs=["X", "Y"], outputs=["Out"])
 def mul(ctx, attrs, X, Y):
+    import math as _math
+
     xd = int(attrs.get("x_num_col_dims", 1))
     yd = int(attrs.get("y_num_col_dims", 1))
     xs, ys = jnp.shape(X), jnp.shape(Y)
-    xm = X.reshape(int(jnp.prod(jnp.asarray(xs[:xd]))), -1) if len(xs) != 2 or xd != 1 else X
-    ym = Y.reshape(int(jnp.prod(jnp.asarray(ys[:yd]))), -1) if len(ys) != 2 or yd != 1 else Y
+    xm = X.reshape(_math.prod(xs[:xd]), -1) if len(xs) != 2 or xd != 1 else X
+    ym = Y.reshape(_math.prod(ys[:yd]), -1) if len(ys) != 2 or yd != 1 else Y
     out = jnp.matmul(xm, ym, preferred_element_type=_mm_accum_dtype(X, Y))
     out = out.astype(jnp.result_type(X, Y))
     return out.reshape(xs[:xd] + ys[yd:])

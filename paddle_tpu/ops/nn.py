@@ -327,9 +327,22 @@ def pool2d(ctx, attrs, X):
     wstrides = (1, 1) + tuple(strides)
     pad = ((0, 0), (0, 0)) + tuple((p, p) for p in paddings)
     if ptype == "max":
-        init = -jnp.inf if jnp.issubdtype(X.dtype, jnp.floating) else jnp.iinfo(X.dtype).min
+        import numpy as np
+
+        # init must be a trace-time constant: reduce_window's grad rule
+        # (select-and-scatter) cannot linearize a traced init value
+        if jnp.issubdtype(X.dtype, jnp.floating):
+            import ml_dtypes
+
+            np_dt = (
+                ml_dtypes.bfloat16 if X.dtype == jnp.bfloat16
+                else np.dtype(X.dtype)
+            )
+            init = np.asarray(-np.inf, np_dt)
+        else:
+            init = np.asarray(np.iinfo(np.dtype(X.dtype)).min, X.dtype)
         return jax.lax.reduce_window(
-            X, jnp.asarray(init, X.dtype), jax.lax.max, window, wstrides, pad
+            X, init, jax.lax.max, window, wstrides, pad
         )
     s = jax.lax.reduce_window(
         X.astype(jnp.float32), 0.0, jax.lax.add, window, wstrides, pad

@@ -1,0 +1,154 @@
+"""Golden op-test harness (reference:
+``python/paddle/fluid/tests/unittests/op_test.py`` — OpTest builds a one-op
+program from inputs/attrs/outputs, checks outputs against a numpy oracle
+(check_output_with_place, op_test.py:368) and analytic grads against numeric
+finite differences (check_grad, op_test.py:532)).
+
+Same oracles here: numpy forward reference supplied by each test;
+grad check compares the program-level grad ops produced by append_backward
+against central finite differences of the op's own lowering."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.executor import Scope, scope_guard, global_scope
+from paddle_tpu.ops import registry as op_registry
+
+
+class OpTest:
+    """Subclass and set: op_type, inputs {slot: np.ndarray | [(name, arr)]},
+    attrs, outputs {slot: expected np.ndarray | [(name, arr)]}."""
+
+    op_type = None
+    inputs = {}
+    attrs = {}
+    outputs = {}
+
+    def _build_program(self):
+        main, startup = fluid.Program(), fluid.Program()
+        feed = {}
+        with fluid.program_guard(main, startup):
+            block = main.global_block()
+            in_names = {}
+            for slot, value in self.inputs.items():
+                if isinstance(value, list):
+                    names = []
+                    for name, arr in value:
+                        arr = np.asarray(arr)
+                        block.create_var(
+                            name=name, shape=arr.shape, dtype=str(arr.dtype),
+                            is_data=True, stop_gradient=False,
+                        )
+                        feed[name] = arr
+                        names.append(name)
+                    in_names[slot] = names
+                else:
+                    arr = np.asarray(value)
+                    name = "in_%s" % slot
+                    block.create_var(
+                        name=name, shape=arr.shape, dtype=str(arr.dtype),
+                        is_data=True, stop_gradient=False,
+                    )
+                    feed[name] = arr
+                    in_names[slot] = [name]
+            out_names = {}
+            for slot, value in self.outputs.items():
+                if isinstance(value, list):
+                    out_names[slot] = [n for n, _ in value]
+                else:
+                    out_names[slot] = ["out_%s" % slot]
+                for n in out_names[slot]:
+                    block.create_var(name=n, dtype="float32")
+            block.append_op(
+                type=self.op_type, inputs=in_names, outputs=out_names,
+                attrs=dict(self.attrs),
+            )
+        return main, startup, feed, in_names, out_names
+
+    def check_output(self, atol=1e-5, rtol=1e-5):
+        main, startup, feed, _, out_names = self._build_program()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            fetch = [n for slot in self.outputs for n in out_names[slot]]
+            outs = exe.run(main, feed=feed, fetch_list=fetch)
+            i = 0
+            for slot, value in self.outputs.items():
+                expect = (
+                    [a for _, a in value] if isinstance(value, list)
+                    else [value]
+                )
+                for e in expect:
+                    np.testing.assert_allclose(
+                        outs[i], np.asarray(e), atol=atol, rtol=rtol,
+                        err_msg="output %s of %s" % (slot, self.op_type),
+                    )
+                    i += 1
+
+    def check_grad(self, inputs_to_check, output_name, max_relative_error=5e-3,
+                   numeric_delta=1e-3):
+        """Analytic (program grad-op) vs numeric (finite difference) grads
+        w.r.t. each named input, using sum(output) as the scalar loss."""
+        main, startup, feed, in_names, out_names = self._build_program()
+        with fluid.program_guard(main, startup):
+            block = main.global_block()
+            out_var = block.var(
+                "out_%s" % output_name
+                if not isinstance(self.outputs[output_name], list)
+                else self.outputs[output_name][0][0]
+            )
+            # loss = sum(out * R) with fixed random R — a plain sum is
+            # degenerate for ops like softmax whose outputs sum to a
+            # constant (numeric grad would be pure float noise)
+            expect = self.outputs[output_name]
+            expect_arr = np.asarray(
+                expect[0][1] if isinstance(expect, list) else expect
+            )
+            proj = np.random.RandomState(1234).uniform(
+                0.5, 1.5, expect_arr.shape
+            ).astype("float32")
+            block.create_var(
+                name="__proj__", shape=proj.shape, dtype="float32",
+                is_data=True, stop_gradient=True,
+            )
+            feed["__proj__"] = proj
+            weighted = fluid.layers.elementwise_mul(
+                out_var, block.var("__proj__")
+            )
+            loss = fluid.layers.reduce_sum(weighted)
+            check_vars = [block.var(n) for n in inputs_to_check]
+            grads = fluid.gradients(loss, check_vars)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            analytic = exe.run(main, feed=feed, fetch_list=grads)
+
+            def loss_at(feed_override):
+                with scope_guard(Scope()):
+                    return float(
+                        exe.run(main, feed=feed_override,
+                                fetch_list=[loss])[0].reshape(-1)[0]
+                    )
+
+            for name, g in zip(inputs_to_check, analytic):
+                base = feed[name].astype(np.float64)
+                num = np.zeros_like(base)
+                flat = base.reshape(-1)
+                numf = num.reshape(-1)
+                for i in range(flat.size):
+                    for sgn in (+1, -1):
+                        pert = flat.copy()
+                        pert[i] += sgn * numeric_delta
+                        f2 = dict(feed)
+                        f2[name] = pert.reshape(base.shape).astype(
+                            feed[name].dtype
+                        )
+                        numf[i] += sgn * loss_at(f2)
+                    numf[i] /= 2 * numeric_delta
+                abs_max = max(np.abs(num).max(), np.abs(g).max(), 1e-3)
+                rel_err = np.abs(g - num).max() / abs_max
+                assert rel_err < max_relative_error, (
+                    "grad of %s wrt %s: rel err %.3g (analytic %s vs "
+                    "numeric %s)" % (
+                        self.op_type, name, rel_err,
+                        np.asarray(g).reshape(-1)[:5], num.reshape(-1)[:5],
+                    )
+                )

@@ -1,0 +1,100 @@
+"""Book-style model convergence tests (reference:
+``python/paddle/fluid/tests/book/`` — train a few iterations, assert the
+loss decreases, save+reload)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.executor import Scope, scope_guard
+from paddle_tpu.models import mnist, resnet, bert
+
+
+def _train(main, startup, feed_fn, loss, steps=30):
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        losses = []
+        for _ in range(steps):
+            lv = exe.run(main, feed=feed_fn(), fetch_list=[loss])[0]
+            losses.append(float(np.asarray(lv).reshape(-1)[0]))
+    return losses
+
+
+def test_mnist_mlp_converges():
+    main, startup, feeds, loss, acc = mnist.build(lr=3e-3)
+    rng = np.random.RandomState(0)
+    w = rng.randn(784, 10).astype("float32")
+
+    def feed_fn():
+        x = rng.randn(64, 784).astype("float32")
+        y = np.argmax(x @ w, axis=1).astype("int64")[:, None]
+        return {"img": x, "label": y}
+
+    losses = _train(main, startup, feed_fn, loss, steps=80)
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_mnist_conv_runs():
+    main, startup, feeds, loss, acc = mnist.build(use_conv=True)
+    rng = np.random.RandomState(0)
+
+    def feed_fn():
+        return {
+            "img": rng.rand(4, 1, 28, 28).astype("float32"),
+            "label": rng.randint(0, 10, (4, 1)).astype("int64"),
+        }
+
+    losses = _train(main, startup, feed_fn, loss, steps=3)
+    assert np.isfinite(losses).all()
+
+
+def test_resnet_cifar_runs_and_learns():
+    main, startup, feeds, loss, acc = resnet.build(
+        dataset="cifar10", depth=8, batch_lr=0.05
+    )
+    rng = np.random.RandomState(0)
+    # two well-separated classes
+    def feed_fn():
+        y = rng.randint(0, 2, (8, 1)).astype("int64")
+        x = rng.randn(8, 3, 32, 32).astype("float32") * 0.1
+        x += y[:, :, None, None].astype("float32") * 2.0 - 1.0
+        return {"img": x, "label": y}
+
+    losses = _train(main, startup, feed_fn, loss, steps=25)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+def test_bert_tiny_trains():
+    cfg = bert.BERT_TINY
+    main, startup, feeds, loss = bert.build_pretrain(
+        cfg, seq_len=32, lr=5e-4
+    )
+    rng = np.random.RandomState(0)
+
+    def feed_fn():
+        return bert.make_fake_batch(2, 32, cfg, rng)
+
+    losses = _train(main, startup, feed_fn, loss, steps=12)
+    assert np.isfinite(losses).all()
+    # memorizing random tokens: loss should move down from ~ln(vocab)
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
+def test_bert_tiny_amp_bf16():
+    cfg = bert.BERT_TINY
+    main, startup, feeds, loss = bert.build_pretrain(
+        cfg, seq_len=16, lr=5e-4, amp=True
+    )
+    # bf16 casts must be present after the AMP rewrite
+    cast_ops = [op for op in main.global_block().ops if op.type == "cast"]
+    assert cast_ops, "AMP rewrite inserted no casts"
+    rng = np.random.RandomState(0)
+
+    def feed_fn():
+        return bert.make_fake_batch(2, 16, cfg, rng)
+
+    losses = _train(main, startup, feed_fn, loss, steps=6)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]

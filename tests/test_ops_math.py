@@ -1,0 +1,399 @@
+"""Op correctness vs numpy oracles + gradient checks (reference:
+unittests/test_mul_op.py, test_elementwise_*_op.py, test_softmax_op.py,
+test_reduce_op.py, ... — same OpTest pattern)."""
+
+import numpy as np
+import pytest
+
+from op_test import OpTest
+
+rng = np.random.RandomState(42)
+
+
+class TestMul(OpTest):
+    op_type = "mul"
+
+    def setup(self):
+        x = rng.rand(4, 5).astype("float32")
+        y = rng.rand(5, 3).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x @ y}
+
+    def test_output(self):
+        self.setup()
+        self.check_output()
+
+    def test_grad(self):
+        self.setup()
+        self.check_grad(["in_X", "in_Y"], "Out")
+
+
+class TestMulFlatten(OpTest):
+    op_type = "mul"
+
+    def test_output(self):
+        x = rng.rand(2, 3, 4).astype("float32")
+        y = rng.rand(12, 5).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"x_num_col_dims": 1}
+        self.outputs = {"Out": x.reshape(2, 12) @ y}
+        self.check_output()
+
+
+class TestMatmulTranspose(OpTest):
+    op_type = "matmul"
+
+    def test_output(self):
+        x = rng.rand(3, 4).astype("float32")
+        y = rng.rand(5, 4).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"transpose_Y": True}
+        self.outputs = {"Out": x @ y.T}
+        self.check_output()
+
+    def test_batched(self):
+        x = rng.rand(2, 3, 4).astype("float32")
+        y = rng.rand(2, 4, 5).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {}
+        self.outputs = {"Out": np.matmul(x, y)}
+        self.check_output()
+
+
+class TestElementwiseAddBroadcastAxis(OpTest):
+    op_type = "elementwise_add"
+
+    def test_mid_axis_broadcast(self):
+        x = rng.rand(2, 3, 4).astype("float32")
+        y = rng.rand(3).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": x + y[None, :, None]}
+        self.check_output()
+
+    def test_grad(self):
+        x = rng.rand(2, 3).astype("float32")
+        y = rng.rand(3).astype("float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"axis": -1}
+        self.outputs = {"Out": x + y}
+        self.check_grad(["in_X", "in_Y"], "Out")
+
+
+class TestElementwiseDivGrad(OpTest):
+    op_type = "elementwise_div"
+
+    def test_grad(self):
+        x = rng.rand(3, 4).astype("float32") + 0.5
+        y = rng.rand(3, 4).astype("float32") + 0.5
+        self.inputs = {"X": x, "Y": y}
+        self.outputs = {"Out": x / y}
+        self.check_grad(["in_X", "in_Y"], "Out", max_relative_error=1e-2)
+
+
+class TestSoftmax(OpTest):
+    op_type = "softmax"
+
+    def test_output_and_grad(self):
+        x = rng.rand(5, 7).astype("float32")
+        e = np.exp(x - x.max(-1, keepdims=True))
+        self.inputs = {"X": x}
+        self.outputs = {"Out": e / e.sum(-1, keepdims=True)}
+        self.check_output()
+        self.check_grad(["in_X"], "Out", max_relative_error=2e-2)
+
+
+class TestSoftmaxWithCrossEntropy(OpTest):
+    op_type = "softmax_with_cross_entropy"
+
+    def test_output(self):
+        logits = rng.rand(6, 10).astype("float32") * 4
+        labels = rng.randint(0, 10, (6, 1)).astype("int64")
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        sm = e / e.sum(-1, keepdims=True)
+        loss = -np.log(sm[np.arange(6), labels[:, 0]] + 1e-20)[:, None]
+        self.inputs = {"Logits": logits, "Label": labels}
+        self.outputs = {"Softmax": sm, "Loss": loss}
+        self.check_output(atol=1e-5)
+
+
+class TestReduce(OpTest):
+    op_type = "reduce_sum"
+
+    def test_dim(self):
+        x = rng.rand(3, 4, 5).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [1]}
+        self.outputs = {"Out": x.sum(1)}
+        self.check_output()
+
+    def test_keepdim_grad(self):
+        x = rng.rand(3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"dim": [0], "keep_dim": True}
+        self.outputs = {"Out": x.sum(0, keepdims=True)}
+        self.check_grad(["in_X"], "Out")
+
+    def test_reduce_all(self):
+        x = rng.rand(3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"reduce_all": True}
+        self.outputs = {"Out": np.asarray([x.sum()])}
+        self.check_output()
+
+
+class TestMean(OpTest):
+    op_type = "mean"
+
+    def test_output_and_grad(self):
+        x = rng.rand(4, 6).astype("float32")
+        self.inputs = {"X": x}
+        self.outputs = {"Out": np.asarray([x.mean()])}
+        self.check_output()
+        self.check_grad(["in_X"], "Out")
+
+
+class TestSum(OpTest):
+    op_type = "sum"
+
+    def test_multi_input(self):
+        a = rng.rand(3, 4).astype("float32")
+        b = rng.rand(3, 4).astype("float32")
+        c = rng.rand(3, 4).astype("float32")
+        self.inputs = {"X": [("a", a), ("b", b), ("c", c)]}
+        self.outputs = {"Out": a + b + c}
+        self.check_output()
+        self.check_grad(["a", "b"], "Out")
+
+
+class TestCumsum(OpTest):
+    op_type = "cumsum"
+
+    def test_exclusive_reverse(self):
+        x = np.array([[1.0, 2.0, 3.0]], dtype="float32")
+        self.inputs = {"X": x}
+        self.attrs = {"axis": 1, "exclusive": True, "reverse": True}
+        self.outputs = {"Out": np.array([[5.0, 3.0, 0.0]], dtype="float32")}
+        self.check_output()
+
+
+class TestConcatSplit(OpTest):
+    op_type = "concat"
+
+    def test_concat(self):
+        a = rng.rand(2, 3).astype("float32")
+        b = rng.rand(2, 5).astype("float32")
+        self.inputs = {"X": [("ca", a), ("cb", b)]}
+        self.attrs = {"axis": 1}
+        self.outputs = {"Out": np.concatenate([a, b], axis=1)}
+        self.check_output()
+        self.check_grad(["ca", "cb"], "Out")
+
+
+class TestTopK(OpTest):
+    op_type = "top_k"
+
+    def test_output(self):
+        x = np.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], dtype="float32")
+        self.inputs = {"X": x}
+        self.attrs = {"k": 2}
+        self.outputs = {
+            "Out": np.array([[3.0, 2.0], [5.0, 4.0]], dtype="float32"),
+            "Indices": np.array([[0, 2], [1, 2]], dtype="int32"),
+        }
+        self.check_output()
+
+
+class TestActivations:
+    def _check(self, op_type, ref, x=None, grad=True, **attrs):
+        class T(OpTest):
+            pass
+
+        T.op_type = op_type
+        t = T()
+        xv = x if x is not None else (rng.rand(3, 4).astype("float32") + 0.1)
+        t.inputs = {"X": xv}
+        t.attrs = attrs
+        t.outputs = {"Out": ref(xv)}
+        t.check_output(atol=1e-5, rtol=1e-4)
+        if grad:
+            t.check_grad(["in_X"], "Out", max_relative_error=1e-2)
+
+    def test_relu(self):
+        x = rng.randn(3, 4).astype("float32")
+        x[np.abs(x) < 0.1] = 0.5  # keep away from kink for numeric grad
+        self._check("relu", lambda v: np.maximum(v, 0), x=x)
+
+    def test_sigmoid(self):
+        self._check("sigmoid", lambda v: 1 / (1 + np.exp(-v)))
+
+    def test_tanh(self):
+        self._check("tanh", np.tanh)
+
+    def test_exp(self):
+        self._check("exp", np.exp)
+
+    def test_sqrt(self):
+        self._check("sqrt", np.sqrt)
+
+    def test_square(self):
+        self._check("square", np.square)
+
+    def test_gelu(self):
+        from scipy.stats import norm  # available via scipy in image
+
+        x = rng.randn(3, 4).astype("float32")
+        self._check(
+            "gelu", lambda v: v * norm.cdf(v), x=x, grad=False,
+        )
+
+    def test_leaky_relu(self):
+        x = rng.randn(3, 4).astype("float32")
+        x[np.abs(x) < 0.1] = 0.5
+        self._check(
+            "leaky_relu", lambda v: np.where(v >= 0, v, 0.1 * v), x=x,
+            alpha=0.1,
+        )
+
+
+class TestCast(OpTest):
+    op_type = "cast"
+
+    def test_output(self):
+        x = rng.rand(3, 4).astype("float32") * 10
+        self.inputs = {"X": x}
+        self.attrs = {"out_dtype": "int32"}
+        self.outputs = {"Out": x.astype("int32")}
+        self.check_output()
+
+
+class TestScale(OpTest):
+    op_type = "scale"
+
+    def test_bias_order(self):
+        x = rng.rand(3).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"scale": 2.0, "bias": 1.0, "bias_after_scale": False}
+        self.outputs = {"Out": (x + 1.0) * 2.0}
+        self.check_output()
+
+
+class TestLookupTable(OpTest):
+    op_type = "lookup_table"
+
+    def test_output_and_grad(self):
+        w = rng.rand(10, 4).astype("float32")
+        ids = np.array([[1], [3], [1], [9]], dtype="int64")
+        self.inputs = {"W": w, "Ids": ids}
+        self.outputs = {"Out": w[ids[:, 0]]}
+        self.check_output()
+        self.check_grad(["in_W"], "Out")
+
+    def test_padding_idx(self):
+        w = rng.rand(10, 4).astype("float32")
+        ids = np.array([[1], [3]], dtype="int64")
+        expect = w[ids[:, 0]].copy()
+        expect[1] = 0.0
+        self.inputs = {"W": w, "Ids": ids}
+        self.attrs = {"padding_idx": 3}
+        self.outputs = {"Out": expect}
+        self.check_output()
+
+
+class TestGather(OpTest):
+    op_type = "gather"
+
+    def test_output(self):
+        x = rng.rand(5, 3).astype("float32")
+        idx = np.array([0, 2, 4], dtype="int32")
+        self.inputs = {"X": x, "Index": idx}
+        self.outputs = {"Out": x[idx]}
+        self.check_output()
+
+
+class TestOneHot(OpTest):
+    op_type = "one_hot"
+
+    def test_output(self):
+        ids = np.array([[0], [2], [1]], dtype="int64")
+        expect = np.zeros((3, 3), "float32")
+        expect[np.arange(3), ids[:, 0]] = 1.0
+        self.inputs = {"X": ids}
+        self.attrs = {"depth": 3}
+        self.outputs = {"Out": expect}
+        self.check_output()
+
+
+class TestLayerNorm(OpTest):
+    op_type = "layer_norm"
+
+    def test_output(self):
+        x = rng.rand(4, 6).astype("float32")
+        scale = rng.rand(6).astype("float32")
+        bias = rng.rand(6).astype("float32")
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        y = (x - mu) / np.sqrt(var + 1e-5) * scale + bias
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.attrs = {"begin_norm_axis": 1, "epsilon": 1e-5}
+        self.outputs = {"Y": y}
+        self.check_output(atol=1e-4, rtol=1e-4)
+
+    def test_grad(self):
+        x = rng.rand(3, 4).astype("float32")
+        scale = np.ones(4, "float32")
+        bias = np.zeros(4, "float32")
+        self.inputs = {"X": x, "Scale": scale, "Bias": bias}
+        self.attrs = {"begin_norm_axis": 1}
+        self.outputs = {"Y": x}  # unused by check_grad
+        self.check_grad(["in_X", "in_Scale"], "Y",
+                        max_relative_error=2e-2)
+
+
+class TestClip(OpTest):
+    op_type = "clip"
+
+    def test_output(self):
+        x = rng.randn(4, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"min": -0.5, "max": 0.5}
+        self.outputs = {"Out": np.clip(x, -0.5, 0.5)}
+        self.check_output()
+
+
+class TestTranspose(OpTest):
+    op_type = "transpose2"
+
+    def test_output(self):
+        x = rng.rand(2, 3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"axis": [0, 2, 1]}
+        self.outputs = {"Out": x.transpose(0, 2, 1)}
+        main, startup, feed, _, out_names = self._build_program()
+        import paddle_tpu as fluid
+        from paddle_tpu.executor import Scope, scope_guard
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            out = exe.run(main, feed=feed,
+                          fetch_list=[out_names["Out"][0]])[0]
+        np.testing.assert_allclose(out, x.transpose(0, 2, 1))
+
+
+class TestReshape(OpTest):
+    op_type = "reshape2"
+
+    def test_zero_and_minus_one(self):
+        x = rng.rand(2, 3, 4).astype("float32")
+        self.inputs = {"X": x}
+        self.attrs = {"shape": [0, -1]}
+        self.outputs = {"Out": x.reshape(2, 12)}
+        main, startup, feed, _, out_names = self._build_program()
+        import paddle_tpu as fluid
+        from paddle_tpu.executor import Scope, scope_guard
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            out = exe.run(main, feed=feed,
+                          fetch_list=[out_names["Out"][0]])[0]
+        np.testing.assert_allclose(out, x.reshape(2, 12))
