@@ -109,6 +109,10 @@ class _GradEngine:
 
     def backprop_op(self, op):
         """Append the grad op(s) for `op`; returns True if appended."""
+        from .ops import control_flow as cf_ops
+
+        if op.type in ("while", "conditional_block", "recurrent"):
+            return self._backprop_sub_block_op(op)
         try:
             opdef = op_registry.get_op_def(op.type)
         except op_registry.OpNotRegistered:
@@ -198,6 +202,89 @@ class _GradEngine:
             for x, g in zip(names, gnames):
                 if g != op_registry.EMPTY_VAR_NAME:
                     self.pending.setdefault(x, []).append(g)
+        return True
+
+    def _backprop_sub_block_op(self, op):
+        """Grads through control-flow ops (reference: while_grad,
+        recurrent_grad ops registered in C++; here the grad op's lowering is
+        jax.vjp over the scan/cond closure, differentiating w.r.t. declared
+        inputs AND the sub-block's captured outer vars — the parameters used
+        inside the step block)."""
+        from .ops import control_flow as cf_ops
+
+        out_slot = {"recurrent": "outputs", "conditional_block": "Out",
+                    "while": "Out"}[op.type]
+        out_names = op.outputs.get(out_slot, [])
+        gnames = []
+        any_grad = False
+        for y in out_names:
+            g = self.resolve(y)
+            gnames.append(g if g is not None else op_registry.EMPTY_VAR_NAME)
+            any_grad = any_grad or g is not None
+        if not any_grad:
+            return False
+        if op.type == "while":
+            raise NotImplementedError(
+                "gradients through `while` are not supported (XLA has no "
+                "reverse-mode for unbounded while_loop); use StaticRNN "
+                "(lax.scan, fully differentiable) for recurrence, or keep "
+                "the loss outside the loop"
+            )
+
+        sub_block = self.block.program.block(op.attrs["sub_block"])
+        exclude = set()
+        if op.type == "recurrent":
+            exclude.update(op.attrs.get("step_input_names", []))
+            exclude.update(op.attrs.get("state_names", []))
+        captured = [
+            n for n in cf_ops.sub_block_external_reads(sub_block, exclude)
+            if self.block._find_var_recursive(n) is not None
+        ]
+
+        inputs = {k: list(v) for k, v in op.inputs.items()}
+        inputs["Captured"] = captured
+        inputs[out_slot] = list(out_names)
+        inputs[out_slot + "@GRAD"] = gnames
+
+        outputs = {}
+        grad_targets = []  # (fwd_name, grad_name) to register as pending
+        for slot in (("inputs", "initial_states") if op.type == "recurrent"
+                     else ()):
+            names = op.inputs.get(slot, [])
+            gouts = []
+            for x in names:
+                if _var_can_have_grad(self.block, x, self.no_grad_set):
+                    gn = self.new_grad_name(x)
+                    gouts.append(gn)
+                    grad_targets.append((x, gn))
+                else:
+                    gouts.append(op_registry.EMPTY_VAR_NAME)
+            if any(g != op_registry.EMPTY_VAR_NAME for g in gouts):
+                outputs[slot + "@GRAD"] = gouts
+        cap_gouts = []
+        for x in captured:
+            if _var_can_have_grad(self.block, x, self.no_grad_set):
+                gn = self.new_grad_name(x)
+                cap_gouts.append(gn)
+                grad_targets.append((x, gn))
+            else:
+                cap_gouts.append(op_registry.EMPTY_VAR_NAME)
+        outputs["Captured@GRAD"] = cap_gouts
+        if not grad_targets:
+            return False
+
+        attrs = dict(op.attrs)
+        attrs["__fwd_op_id__"] = op.attrs.get("__op_id__", 0)
+        attrs["op_role"] = "backward"
+        attrs.pop("__op_id__", None)
+        for fwd_name, gn in grad_targets:
+            _create_grad_var(self.block, fwd_name, gn)
+        self.block.append_op(
+            type=op.type + "_grad", inputs=inputs, outputs=outputs,
+            attrs=attrs,
+        )
+        for fwd_name, gn in grad_targets:
+            self.pending.setdefault(fwd_name, []).append(gn)
         return True
 
 

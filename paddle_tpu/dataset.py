@@ -1,0 +1,185 @@
+"""Dataset pipeline (reference: ``python/paddle/fluid/dataset.py`` facades +
+C++ ``framework/data_set.h:40`` Dataset/MultiSlotDataset and
+``data_feed.h`` MultiSlot parsers feeding trainer threads).
+
+TPU-native: files are parsed into padded numpy slot batches on the host
+(threaded), prefetched, and fed to the jitted step — the channel/queue
+machinery of the reference maps onto the PyReader prefetcher.  MultiSlot
+text format (one example per line: per slot ``<n> id...`` or
+``<n> v v ...``) is parsed as in ``data_feed.cc``; ragged slots pad/clip to
+the slot var's declared static length (XLA static shapes).
+"""
+
+import os
+import random
+
+import numpy as np
+
+__all__ = ["DatasetFactory", "DatasetBase", "InMemoryDataset",
+           "QueueDataset"]
+
+
+class DatasetFactory:
+    def create_dataset(self, datafeed_class="QueueDataset"):
+        if datafeed_class == "InMemoryDataset":
+            return InMemoryDataset()
+        if datafeed_class == "QueueDataset":
+            return QueueDataset()
+        raise ValueError("unknown dataset class %r" % datafeed_class)
+
+
+class DatasetBase:
+    def __init__(self):
+        self.proto_desc_pipe_command = "cat"
+        self.batch_size = 1
+        self.filelist = []
+        self.use_vars = []
+        self.thread_num = 1
+        self.hdfs_config = None
+        self._shuffle_seed = 0
+
+    # ---- reference config surface ----
+    def set_pipe_command(self, pipe_command):
+        self.proto_desc_pipe_command = pipe_command
+
+    def set_batch_size(self, batch_size):
+        self.batch_size = int(batch_size)
+
+    def set_thread(self, thread_num):
+        self.thread_num = int(thread_num)
+
+    def set_filelist(self, filelist):
+        self.filelist = list(filelist)
+
+    def set_use_var(self, var_list):
+        self.use_vars = list(var_list)
+
+    def set_hdfs_config(self, fs_name, fs_ugi):
+        self.hdfs_config = (fs_name, fs_ugi)
+
+    def desc(self):
+        return {
+            "pipe_command": self.proto_desc_pipe_command,
+            "batch_size": self.batch_size,
+            "thread_num": self.thread_num,
+        }
+
+    # ---- parsing ----
+    def _slot_len(self, var):
+        shape = var.shape or (-1, 1)
+        inner = 1
+        for d in shape[1:]:
+            inner *= abs(d)
+        return max(inner, 1)
+
+    def _parse_line(self, line):
+        """MultiSlot: per use_var, ``<count> v1 v2 ...`` (data_feed.cc
+        MultiSlotDataFeed::ParseOneInstance)."""
+        toks = line.split()
+        pos = 0
+        example = []
+        for var in self.use_vars:
+            n = int(toks[pos])
+            pos += 1
+            vals = toks[pos: pos + n]
+            pos += n
+            if var.dtype in ("int64", "int32"):
+                arr = np.asarray([int(v) for v in vals], dtype="int64")
+            else:
+                arr = np.asarray([float(v) for v in vals], dtype="float32")
+            L = self._slot_len(var)
+            if arr.size < L:  # pad with zeros (padding id 0 by convention)
+                arr = np.concatenate(
+                    [arr, np.zeros(L - arr.size, arr.dtype)]
+                )
+            example.append(arr[:L])
+        return example
+
+    def _iter_examples(self):
+        for path in self.filelist:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        yield self._parse_line(line)
+
+    def _batches_from(self, examples):
+        batch = []
+        for ex in examples:
+            batch.append(ex)
+            if len(batch) == self.batch_size:
+                yield self._to_feed(batch)
+                batch = []
+        if batch:
+            yield self._to_feed(batch)
+
+    def _to_feed(self, batch):
+        feed = {}
+        for i, var in enumerate(self.use_vars):
+            arr = np.stack([ex[i] for ex in batch])
+            shape = var.shape or ()
+            if len(shape) > 1:
+                arr = arr.reshape((len(batch),) + tuple(
+                    abs(d) for d in shape[1:]
+                ))
+            feed[var.name] = arr
+        return feed
+
+    def batch_iterator(self):
+        return self._batches_from(self._iter_examples())
+
+
+class QueueDataset(DatasetBase):
+    """Streams files (reference dataset.py QueueDataset)."""
+
+    def local_shuffle(self):
+        raise NotImplementedError(
+            "QueueDataset streams; use InMemoryDataset for shuffling "
+            "(same restriction as the reference)"
+        )
+
+    def global_shuffle(self, fleet=None):
+        raise NotImplementedError(
+            "QueueDataset streams; use InMemoryDataset for shuffling"
+        )
+
+
+class InMemoryDataset(DatasetBase):
+    """Loads, shuffles in memory (reference dataset.py InMemoryDataset;
+    global_shuffle's cross-worker exchange maps to per-worker filelist
+    sharding + local shuffle on TPU pods)."""
+
+    def __init__(self):
+        super().__init__()
+        self._examples = []
+        self._loaded = False
+
+    def load_into_memory(self):
+        self._examples = list(self._iter_examples())
+        self._loaded = True
+
+    def local_shuffle(self):
+        if not self._loaded:
+            raise RuntimeError("call load_into_memory() first")
+        random.Random(self._shuffle_seed).shuffle(self._examples)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        if fleet is not None:
+            self.filelist = fleet.split_files(self.filelist)
+            self.load_into_memory()
+        self.local_shuffle()
+
+    def release_memory(self):
+        self._examples = []
+        self._loaded = False
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._examples)
+
+    def get_shuffle_data_size(self, fleet=None):
+        return len(self._examples)
+
+    def batch_iterator(self):
+        if self._loaded:
+            return self._batches_from(iter(self._examples))
+        return super().batch_iterator()

@@ -1,11 +1,24 @@
 """Imperative (dygraph) front-end (reference:
-``paddle/fluid/imperative/`` + ``python/paddle/fluid/dygraph/``).
+``paddle/fluid/imperative/`` Tracer/VarBase + ``python/paddle/fluid/dygraph/``).
 
-The eager tracer + Layer/nn module surface lands as its own batch (SURVEY.md
-§7 stage 9); `guard`/`to_variable` plumbing is here so user scripts import
-cleanly."""
+TPU-native eager: ops dispatch immediately through the same XLA-lowering
+registry the static graph uses; a tape records them and backward replays
+vjp-derived grad rules, so the op surface is identical in both modes."""
 
-from .base import guard, enabled, to_variable, enable_dygraph, disable_dygraph
+from .base import (guard, enabled, to_variable, enable_dygraph,
+                   disable_dygraph, no_grad)
+from .varbase import VarBase
+from .layers import Layer
+from . import nn
+from .nn import (Linear, FC, Conv2D, Pool2D, BatchNorm, Embedding,
+                 LayerNorm, Dropout)
+from .parallel import DataParallel, ParallelEnv, prepare_context
+from .checkpoint import save_dygraph, load_dygraph
 
-__all__ = ["guard", "enabled", "to_variable", "enable_dygraph",
-           "disable_dygraph"]
+__all__ = [
+    "guard", "enabled", "to_variable", "enable_dygraph", "disable_dygraph",
+    "no_grad", "VarBase", "Layer", "nn", "Linear", "FC", "Conv2D",
+    "Pool2D", "BatchNorm", "Embedding", "LayerNorm", "Dropout",
+    "DataParallel", "ParallelEnv", "prepare_context",
+    "save_dygraph", "load_dygraph",
+]

@@ -1,14 +1,17 @@
 """Dygraph mode plumbing (reference: ``python/paddle/fluid/dygraph/base.py``).
 
-On TPU, eager mode is simply jax's default op-by-op dispatch; the full
-Layer/autograd surface lands with the dygraph batch."""
+Eager mode is jax's default op-by-op dispatch; ops are recorded on a tape
+for autograd (tape.py)."""
 
 import contextlib
 
+import numpy as np
+
 from .. import framework
+from .tape import push_tape, pop_tape
 
 __all__ = ["guard", "enabled", "to_variable", "enable_dygraph",
-           "disable_dygraph"]
+           "disable_dygraph", "no_grad"]
 
 
 def enabled():
@@ -16,11 +19,17 @@ def enabled():
 
 
 def enable_dygraph(place=None):
-    framework._dygraph_tracer_ = object()  # marker; eager dispatch is jax's
+    framework._dygraph_tracer_ = push_tape()
 
 
 def disable_dygraph():
-    framework._dygraph_tracer_ = None
+    """Exit the innermost dygraph scope, restoring the enclosing one (so
+    nested guards compose and no tape leaks on the stack)."""
+    from .tape import current_tape
+
+    if framework.in_dygraph_mode():
+        pop_tape()
+    framework._dygraph_tracer_ = current_tape()
 
 
 @contextlib.contextmanager
@@ -32,9 +41,27 @@ def guard(place=None):
         disable_dygraph()
 
 
+@contextlib.contextmanager
+def no_grad():
+    """Suspend gradient RECORDING while staying in dygraph mode
+    (reference dygraph.no_grad): eager dispatch still works, the tape just
+    ignores ops executed in the scope."""
+    tape = framework._dygraph_tracer_
+    prev = getattr(tape, "paused", False) if tape is not None else False
+    if tape is not None:
+        tape.paused = True
+    try:
+        yield
+    finally:
+        if tape is not None:
+            tape.paused = prev
+
+
 def to_variable(value, block=None, name=None):
-    import jax.numpy as jnp
+    from .varbase import VarBase
 
     if not framework.in_dygraph_mode():
         raise RuntimeError("to_variable requires dygraph mode (use guard())")
-    return jnp.asarray(value)
+    if isinstance(value, VarBase):
+        return value
+    return VarBase(np.asarray(value), name=name, stop_gradient=True)

@@ -1,0 +1,32 @@
+"""Dygraph checkpoint (reference:
+``python/paddle/fluid/dygraph/checkpoint.py`` save/load state dicts)."""
+
+import os
+
+import numpy as np
+
+__all__ = ["save_dygraph", "load_dygraph", "save_persistables",
+           "load_persistables"]
+
+
+def save_dygraph(state_dict, model_path):
+    arrays = {k: np.asarray(v) for k, v in state_dict.items()}
+    np.savez(model_path + ".pdparams.npz", **arrays)
+
+
+def load_dygraph(model_path):
+    path = model_path + ".pdparams.npz"
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    data = np.load(path)
+    return {k: data[k] for k in data.files}, None
+
+
+def save_persistables(model_dict, dirname="save_dir"):
+    os.makedirs(dirname, exist_ok=True)
+    save_dygraph(model_dict, os.path.join(dirname, "params"))
+
+
+def load_persistables(dirname="save_dir"):
+    state, _ = load_dygraph(os.path.join(dirname, "params"))
+    return state

@@ -1,0 +1,85 @@
+"""Dygraph Layer base (reference: ``python/paddle/fluid/dygraph/layers.py``)."""
+
+from collections import OrderedDict
+
+import numpy as np
+
+from .varbase import VarBase
+
+__all__ = ["Layer"]
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self._parameters = OrderedDict()
+        self._sub_layers = OrderedDict()
+        self.training = True
+
+    def create_parameter(self, shape, dtype, value):
+        p = VarBase(np.asarray(value, dtype), persistable=True,
+                    stop_gradient=False)
+        p.trainable = True
+        return p
+
+    # attribute tracking of params / sublayers
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        subs = self.__dict__.get("_sub_layers")
+        if isinstance(value, VarBase) and value.persistable and params is not None:
+            params[name] = value
+        elif isinstance(value, Layer) and subs is not None:
+            subs[name] = value
+        object.__setattr__(self, name, value)
+
+    def parameters(self, include_sublayers=True):
+        out = list(self._parameters.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.parameters())
+        return out
+
+    def sublayers(self, include_sublayers=True):
+        out = list(self._sub_layers.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.sublayers())
+        return out
+
+    def train(self):
+        self.training = True
+        for l in self._sub_layers.values():
+            l.train()
+
+    def eval(self):
+        self.training = False
+        for l in self._sub_layers.values():
+            l.eval()
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
+
+    # ---- state dict (reference dygraph/checkpoint.py) ----
+    def state_dict(self, prefix=""):
+        out = OrderedDict()
+        for name, p in self._parameters.items():
+            out[prefix + name] = p.numpy()
+        for lname, l in self._sub_layers.items():
+            out.update(l.state_dict(prefix + lname + "."))
+        return out
+
+    def set_dict(self, state, prefix=""):
+        for name, p in self._parameters.items():
+            key = prefix + name
+            if key in state:
+                p.set_value(state[key])
+        for lname, l in self._sub_layers.items():
+            l.set_dict(state, prefix + lname + ".")
+
+    load_dict = set_dict

@@ -1,0 +1,249 @@
+"""Dygraph layers (reference: ``python/paddle/fluid/dygraph/nn.py`` —
+Conv2D, FC, BatchNorm, Embedding, LayerNorm, Pool2D module classes)."""
+
+import numpy as np
+
+from .. import initializer as init_mod
+from ..param_attr import ParamAttr
+from .layers import Layer
+from .varbase import VarBase, eager_op
+
+__all__ = ["Linear", "FC", "Conv2D", "Pool2D", "BatchNorm", "Embedding",
+           "LayerNorm", "Dropout"]
+
+
+def _init_array(initializer, shape, dtype, rng):
+    """Evaluate an initializer eagerly (dygraph params materialize at
+    construction, not via a startup program)."""
+    initializer = initializer or init_mod.XavierInitializer()
+    if isinstance(initializer, init_mod.ConstantInitializer):
+        return np.full(shape, initializer._value, dtype)
+    if isinstance(initializer, init_mod.UniformInitializer):
+        return rng.uniform(initializer._low, initializer._high,
+                           shape).astype(dtype)
+    if isinstance(initializer, init_mod.NormalInitializer):
+        return (initializer._mean + initializer._std *
+                rng.randn(*shape)).astype(dtype)
+    if isinstance(initializer, init_mod.TruncatedNormalInitializer):
+        v = rng.randn(*shape)
+        v = np.clip(v, -2, 2)
+        return (initializer._mean + initializer._std * v).astype(dtype)
+    if isinstance(initializer, init_mod.XavierInitializer):
+        fan_in, fan_out = _fans(shape)
+        limit = np.sqrt(6.0 / (fan_in + fan_out))
+        return rng.uniform(-limit, limit, shape).astype(dtype)
+    if isinstance(initializer, init_mod.MSRAInitializer):
+        fan_in, _ = _fans(shape)
+        limit = np.sqrt(6.0 / fan_in)
+        return rng.uniform(-limit, limit, shape).astype(dtype)
+    if isinstance(initializer, init_mod.NumpyArrayInitializer):
+        return np.asarray(initializer._value, dtype)
+    raise NotImplementedError(type(initializer))
+
+
+def _fans(shape):
+    if len(shape) < 2:
+        return (shape[0] if shape else 1,) * 2
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+_param_rng = np.random.RandomState(20190701)
+
+
+class Linear(Layer):
+    def __init__(self, input_dim, output_dim, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__()
+        p = ParamAttr._to_attr(param_attr)
+        self.weight = self.create_parameter(
+            [input_dim, output_dim], dtype,
+            _init_array(p.initializer, (input_dim, output_dim), dtype,
+                        _param_rng),
+        )
+        self._act = act
+        b = ParamAttr._to_attr(bias_attr)
+        if b is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter(
+                [output_dim], dtype,
+                _init_array(b.initializer or init_mod.Constant(0.0),
+                            (output_dim,), dtype, _param_rng),
+            )
+
+    def forward(self, x):
+        out = eager_op("mul", {"X": [x], "Y": [self.weight]},
+                       {"x_num_col_dims": 1, "y_num_col_dims": 1})[0]
+        if self.bias is not None:
+            out = eager_op("elementwise_add",
+                           {"X": [out], "Y": [self.bias]}, {"axis": 1})[0]
+        if self._act:
+            out = eager_op(self._act, {"X": [out]})[0]
+        return out
+
+
+class FC(Linear):
+    """Old-style FC (reference dygraph/nn.py FC) — alias of Linear with
+    size-first signature."""
+
+    def __init__(self, name_scope=None, size=None, input_dim=None,
+                 param_attr=None, bias_attr=None, act=None,
+                 dtype="float32"):
+        if input_dim is None:
+            raise ValueError("FC requires input_dim on TPU (static shapes)")
+        super().__init__(input_dim, size, param_attr, bias_attr, act, dtype)
+
+
+class Conv2D(Layer):
+    def __init__(self, num_channels, num_filters, filter_size, stride=1,
+                 padding=0, dilation=1, groups=1, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__()
+        fs = filter_size if isinstance(filter_size, (list, tuple)) else (
+            filter_size, filter_size)
+        shape = (num_filters, num_channels // (groups or 1)) + tuple(fs)
+        p = ParamAttr._to_attr(param_attr)
+        fan_in = shape[1] * shape[2] * shape[3]
+        default = init_mod.NormalInitializer(0.0, (2.0 / fan_in) ** 0.5)
+        self.weight = self.create_parameter(
+            list(shape), dtype,
+            _init_array(p.initializer or default, shape, dtype, _param_rng),
+        )
+        b = ParamAttr._to_attr(bias_attr)
+        self.bias = None if b is False else self.create_parameter(
+            [num_filters], dtype,
+            _init_array(b.initializer or init_mod.Constant(0.0),
+                        (num_filters,), dtype, _param_rng),
+        )
+        self._attrs = {
+            "strides": [stride] * 2 if isinstance(stride, int) else list(stride),
+            "paddings": [padding] * 2 if isinstance(padding, int) else list(padding),
+            "dilations": [dilation] * 2 if isinstance(dilation, int) else list(dilation),
+            "groups": groups or 1,
+        }
+        self._act = act
+
+    def forward(self, x):
+        out = eager_op("conv2d", {"Input": [x], "Filter": [self.weight]},
+                       self._attrs)[0]
+        if self.bias is not None:
+            out = eager_op("elementwise_add",
+                           {"X": [out], "Y": [self.bias]}, {"axis": 1})[0]
+        if self._act:
+            out = eager_op(self._act, {"X": [out]})[0]
+        return out
+
+
+class Pool2D(Layer):
+    def __init__(self, pool_size=-1, pool_type="max", pool_stride=1,
+                 pool_padding=0, global_pooling=False, ceil_mode=False,
+                 exclusive=True):
+        super().__init__()
+        self._attrs = {
+            "pooling_type": pool_type,
+            "ksize": [pool_size] * 2 if isinstance(pool_size, int) else list(pool_size),
+            "strides": [pool_stride] * 2 if isinstance(pool_stride, int) else list(pool_stride),
+            "paddings": [pool_padding] * 2 if isinstance(pool_padding, int) else list(pool_padding),
+            "global_pooling": global_pooling,
+            "ceil_mode": ceil_mode,
+            "exclusive": exclusive,
+        }
+
+    def forward(self, x):
+        return eager_op("pool2d", {"X": [x]}, self._attrs)[0]
+
+
+class BatchNorm(Layer):
+    def __init__(self, num_channels, act=None, is_test=False, momentum=0.9,
+                 epsilon=1e-5, param_attr=None, bias_attr=None,
+                 dtype="float32", data_layout="NCHW"):
+        super().__init__()
+        c = (num_channels,)
+        self.weight = self.create_parameter(
+            [num_channels], "float32",
+            _init_array(init_mod.Constant(1.0), c, "float32", _param_rng),
+        )
+        self.bias = self.create_parameter(
+            [num_channels], "float32",
+            _init_array(init_mod.Constant(0.0), c, "float32", _param_rng),
+        )
+        self._mean = VarBase(np.zeros(c, "float32"), "bn.mean",
+                             stop_gradient=True, persistable=True)
+        self._variance = VarBase(np.ones(c, "float32"), "bn.var",
+                                 stop_gradient=True, persistable=True)
+        self._attrs = {
+            "momentum": momentum, "epsilon": epsilon,
+            "data_layout": data_layout, "is_test": is_test,
+        }
+        self._act = act
+
+    def forward(self, x):
+        outs = eager_op(
+            "batch_norm",
+            {"X": [x], "Scale": [self.weight], "Bias": [self.bias],
+             "Mean": [self._mean], "Variance": [self._variance]},
+            dict(self._attrs, is_test=self._attrs["is_test"] or
+                 not self.training),
+        )
+        y, mean_out, var_out = outs[0], outs[1], outs[2]
+        self._mean.set_value(mean_out.value)
+        self._variance.set_value(var_out.value)
+        if self._act:
+            y = eager_op(self._act, {"X": [y]})[0]
+        return y
+
+
+class Embedding(Layer):
+    def __init__(self, size, is_sparse=False, padding_idx=None,
+                 param_attr=None, dtype="float32"):
+        super().__init__()
+        p = ParamAttr._to_attr(param_attr)
+        default = init_mod.UniformInitializer(-0.05, 0.05)
+        self.weight = self.create_parameter(
+            list(size), dtype,
+            _init_array(p.initializer or default, tuple(size), dtype,
+                        _param_rng),
+        )
+        self._padding_idx = -1 if padding_idx is None else padding_idx
+
+    def forward(self, ids):
+        return eager_op(
+            "lookup_table", {"W": [self.weight], "Ids": [ids]},
+            {"padding_idx": self._padding_idx},
+        )[0]
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-5, dtype="float32"):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        n = int(np.prod(normalized_shape))
+        self.weight = self.create_parameter(
+            [n], "float32", np.ones(n, "float32"))
+        self.bias = self.create_parameter(
+            [n], "float32", np.zeros(n, "float32"))
+        self._eps = epsilon
+
+    def forward(self, x):
+        return eager_op(
+            "layer_norm",
+            {"X": [x], "Scale": [self.weight], "Bias": [self.bias]},
+            {"begin_norm_axis": len(x.shape) - 1, "epsilon": self._eps},
+        )[0]
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        return eager_op(
+            "dropout", {"X": [x]},
+            {"dropout_prob": self._p, "is_test": not self.training,
+             "dropout_implementation": "upscale_in_train"},
+        )[0]

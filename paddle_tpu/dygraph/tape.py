@@ -1,0 +1,97 @@
+"""Eager autograd tape (reference: ``paddle/fluid/imperative/``:
+``Tracer::Trace`` records OpBase/VarBase edges (tracer.cc:140), backward
+walks them via ``VarBase::RunBackward`` (layer.h:260) + Engine).
+
+TPU-native: eager ops ARE jax ops dispatched immediately; the tape records
+(opdef, inputs, outputs, attrs) and backward replays each op's vjp-derived
+grad rule — the same generic grad machinery the static graph uses, so every
+registered op is dygraph-capable with zero extra code."""
+
+from ..ops import registry as op_registry
+
+__all__ = ["Tape", "current_tape", "push_tape", "pop_tape"]
+
+
+class TapeEntry:
+    __slots__ = ("opdef", "ins", "outs", "attrs", "op_id", "in_vars",
+                 "out_vars")
+
+    def __init__(self, opdef, ins, outs, attrs, op_id, in_vars, out_vars):
+        self.opdef = opdef
+        self.ins = ins          # {slot: [jnp values]}
+        self.outs = outs        # {slot: [jnp values]}
+        self.attrs = attrs
+        self.op_id = op_id
+        self.in_vars = in_vars  # {slot: [VarBase|None]}
+        self.out_vars = out_vars
+
+
+class Tape:
+    def __init__(self):
+        self.entries = []
+        self.paused = False  # set by dygraph.no_grad()
+
+    def record(self, entry):
+        if not self.paused:
+            self.entries.append(entry)
+
+    def backward(self, root_var, root_grad):
+        import jax.numpy as jnp
+
+        grads = {id(root_var): root_grad}
+
+        ctx = op_registry.LoweringContext(mode="train")
+        for e in reversed(self.entries):
+            # collect available output grads for this entry
+            out_grads = {}
+            any_grad = False
+            for slot, vars_ in e.out_vars.items():
+                if slot in e.opdef.stateful_outputs:
+                    continue
+                gs = []
+                for v in vars_:
+                    g = grads.get(id(v)) if v is not None else None
+                    gs.append(g)
+                    any_grad = any_grad or g is not None
+                out_grads[slot] = gs
+            if not any_grad or e.opdef.no_grad:
+                continue
+            grad_def = op_registry.get_op_def(e.opdef.type + "_grad")
+            gin = {}
+            for slot, vals in e.ins.items():
+                gin[slot] = vals
+            for slot, vals in e.outs.items():
+                gin[slot] = vals
+            for slot, gs in out_grads.items():
+                gin[slot + "@GRAD"] = gs
+            attrs = dict(e.attrs)
+            attrs["__fwd_op_id__"] = e.op_id
+            result = op_registry.call_op(grad_def, ctx, gin, attrs,
+                                         op_id=e.op_id)
+            for slot, vars_ in e.in_vars.items():
+                gvals = result.get(slot + "@GRAD")
+                if gvals is None:
+                    continue
+                for v, g in zip(vars_, gvals):
+                    if v is None or g is None or v.stop_gradient:
+                        continue
+                    prev = grads.get(id(v))
+                    grads[id(v)] = g if prev is None else prev + g
+        return grads
+
+
+_tape_stack = []
+
+
+def current_tape():
+    return _tape_stack[-1] if _tape_stack else None
+
+
+def push_tape(tape=None):
+    t = tape or Tape()
+    _tape_stack.append(t)
+    return t
+
+
+def pop_tape():
+    return _tape_stack.pop()

@@ -1,0 +1,168 @@
+"""Eager variable (reference: ``paddle/fluid/imperative/layer.h:133``
+VarBase) — a jnp array + grad slot + tape bookkeeping."""
+
+import numpy as np
+
+from ..ops import registry as op_registry
+from .tape import current_tape, TapeEntry
+
+__all__ = ["VarBase", "eager_op", "to_variable_value"]
+
+_eager_op_counter = [0]
+
+
+class VarBase:
+    def __init__(self, value, name=None, stop_gradient=False,
+                 persistable=False, trainable=True):
+        import jax.numpy as jnp
+
+        self._value = jnp.asarray(value)
+        self.name = name or "eager_var"
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self.trainable = trainable
+        self._grad = None
+
+    # ---- reference VarBase surface ----
+    def numpy(self):
+        return np.asarray(self._value)
+
+    @property
+    def value(self):
+        return self._value
+
+    @property
+    def shape(self):
+        return tuple(self._value.shape)
+
+    @property
+    def dtype(self):
+        return str(self._value.dtype)
+
+    @property
+    def gradient_value(self):
+        return self._grad
+
+    def gradient(self):
+        return None if self._grad is None else np.asarray(self._grad)
+
+    def clear_gradient(self):
+        self._grad = None
+
+    def set_value(self, value):
+        import jax.numpy as jnp
+
+        self._value = jnp.asarray(value)
+
+    def detach(self):
+        return VarBase(self._value, self.name + ".detached",
+                       stop_gradient=True)
+
+    def backward(self, retain_graph=False):
+        import jax.numpy as jnp
+
+        tape = current_tape()
+        if tape is None:
+            raise RuntimeError(
+                "backward() outside dygraph.guard() — no tape is recording"
+            )
+        grads = tape.backward(self, jnp.ones_like(self._value))
+        # deposit grads on every VarBase seen by the tape
+        seen = {}
+        for e in tape.entries:
+            for vars_ in list(e.in_vars.values()) + list(e.out_vars.values()):
+                for v in vars_:
+                    if v is not None:
+                        seen[id(v)] = v
+        seen[id(self)] = self
+        for vid, g in grads.items():
+            v = seen.get(vid)
+            if v is not None and not v.stop_gradient:
+                v._grad = g if v._grad is None else v._grad + g
+        if not retain_graph:
+            tape.entries.clear()
+
+    def __repr__(self):
+        return "VarBase(%s, shape=%s)" % (self.name, self.shape)
+
+    # ---- operator sugar (eager) ----
+    def _binary(self, other, op_type, reverse=False):
+        o = other if isinstance(other, VarBase) else VarBase(
+            np.asarray(other, dtype=self.numpy().dtype), stop_gradient=True
+        )
+        a, b = (o, self) if reverse else (self, o)
+        return eager_op(op_type, {"X": [a], "Y": [b]}, {"axis": -1})[0]
+
+    def __add__(self, other):
+        return self._binary(other, "elementwise_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binary(other, "elementwise_sub")
+
+    def __rsub__(self, other):
+        return self._binary(other, "elementwise_sub", reverse=True)
+
+    def __mul__(self, other):
+        return self._binary(other, "elementwise_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._binary(other, "elementwise_div")
+
+
+def to_variable_value(v):
+    if isinstance(v, VarBase):
+        return v._value
+    return v
+
+
+def eager_op(op_type, in_vars, attrs=None, n_outs=None):
+    """Dispatch one op eagerly and record it on the tape.  `in_vars`:
+    {slot: [VarBase|value|None]}.  Returns list of output VarBases in the
+    opdef's declared slot order."""
+    opdef = op_registry.get_op_def(op_type)
+    attrs = dict(attrs or {})
+    _eager_op_counter[0] += 1
+    op_id = _eager_op_counter[0]
+
+    ins_vals = {}
+    in_vb = {}
+    for slot, vs in in_vars.items():
+        vals, vbs = [], []
+        for v in vs:
+            if isinstance(v, VarBase):
+                vals.append(v._value)
+                vbs.append(v)
+            else:
+                vals.append(v)
+                vbs.append(None)
+        ins_vals[slot] = vals
+        in_vb[slot] = vbs
+
+    ctx = op_registry.LoweringContext(mode="train")
+    outs = op_registry.call_op(opdef, ctx, ins_vals, attrs, op_id=op_id)
+
+    out_vb = {}
+    flat_out = []
+    for slot, dup in opdef.outputs:
+        vals = outs.get(slot)
+        if vals is None:
+            out_vb[slot] = []
+            continue
+        vbs = []
+        for v in vals:
+            vb = VarBase(v, name="%s.%s" % (op_type, slot)) \
+                if not isinstance(v, dict) else VarBase(
+                    np.zeros(1), stop_gradient=True)
+            vbs.append(vb)
+            flat_out.append(vb)
+        out_vb[slot] = vbs
+
+    tape = current_tape()
+    if tape is not None and not opdef.no_grad:
+        tape.record(TapeEntry(opdef, ins_vals, outs, attrs, op_id, in_vb,
+                              out_vb))
+    return flat_out

@@ -263,8 +263,14 @@ class _CompiledBlock:
 
 def _run_ops_into_env(block, env, ctx):
     """Lower every op of `block` into `env` (the SSA value map)."""
+    from .ops import control_flow as cf_ops
+
     for op in block.ops:
         if op.type in ("feed", "fetch"):
+            continue
+        if op.type in cf_ops.SUB_BLOCK_OPS:
+            # control-flow ops need names + the sub-block, not just values
+            cf_ops.run_sub_block_op(op, block, env, ctx, _run_ops_into_env)
             continue
         opdef = op_registry.get_op_def(op.type)
         ins = {}

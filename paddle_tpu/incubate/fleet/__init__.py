@@ -1,0 +1,4 @@
+from . import base
+from . import collective
+
+__all__ = ["base", "collective"]

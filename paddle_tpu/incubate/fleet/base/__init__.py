@@ -1,0 +1,4 @@
+from . import role_maker
+from .fleet_base import Fleet, DistributedOptimizer
+
+__all__ = ["role_maker", "Fleet", "DistributedOptimizer"]

@@ -1,0 +1,143 @@
+"""Collective fleet (reference:
+``python/paddle/fluid/incubate/fleet/collective/__init__.py``:135 Collective
+fleet, :262 CollectiveOptimizer).
+
+TPU-native: `fleet.init` also initializes the jax coordination service when
+the role maker reports >1 workers (multi-host), replacing the reference's
+gen_nccl_id bootstrap; `CollectiveOptimizer.minimize` runs the wrapped
+optimizer then records the DP topology for CompiledProgram — GSPMD performs
+the gradient all-reduce, so no graph rewrite is needed (the reference's
+transpile step collapses into mesh construction)."""
+
+import os
+
+from ..base.fleet_base import Fleet, DistributedOptimizer, Mode
+from .... import io as fluid_io
+
+__all__ = ["fleet", "Collective", "CollectiveOptimizer",
+           "DistributedStrategy"]
+
+
+class DistributedStrategy:
+    """reference fleet/collective/__init__.py:25 + BuildStrategy knobs"""
+
+    def __init__(self):
+        from ....compiler import BuildStrategy, ExecutionStrategy
+
+        self.exec_strategy = ExecutionStrategy()
+        self.build_strategy = BuildStrategy()
+        self.use_amp = False
+        self.amp_loss_scaling = 2 ** 15
+        self.use_recompute = False
+        self.recompute_checkpoints = []
+        self.use_local_sgd = False
+        self.mode = "collective"
+        self.collective_mode = "grad_allreduce"
+        self.nccl_comm_num = 1
+
+
+class Collective(Fleet):
+    def __init__(self):
+        super().__init__(Mode.COLLECTIVE)
+        self._local_ip = 0
+
+    def init(self, role_maker=None):
+        super().init(role_maker)
+        self._init_jax_distributed()
+
+    def _init_jax_distributed(self):
+        """Multi-host bootstrap via the jax coordination service (replaces
+        gen_nccl_id_op.cc:188 rank-0 RPC broadcast)."""
+        n = self.worker_num()
+        if n <= 1:
+            return
+        import jax
+
+        coord = os.environ.get("PADDLE_COORDINATOR_ADDRESS")
+        if coord is None:
+            eps = self.worker_endpoints()
+            coord = eps[0] if eps else None
+        if coord is None:
+            return
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=n,
+                process_id=self.worker_index(),
+            )
+        except (RuntimeError, ValueError):
+            pass  # already initialized, or single-host testing
+
+    def init_worker(self):
+        pass
+
+    def init_server(self, model_dir=None):
+        raise NotImplementedError(
+            "Collective fleet has no servers; all members are workers"
+        )
+
+    def run_server(self):
+        raise NotImplementedError(
+            "Collective fleet has no servers; all members are workers"
+        )
+
+    def stop_worker(self):
+        pass
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._optimizer = CollectiveOptimizer(optimizer, strategy)
+        self._optimizer._fleet = self
+        return self._optimizer
+
+    def save_inference_model(self, executor, dirname, feeded_var_names=None,
+                             target_vars=None, main_program=None,
+                             export_for_deployment=True):
+        return fluid_io.save_inference_model(
+            dirname, feeded_var_names, target_vars, executor, main_program,
+        )
+
+    def save_persistables(self, executor, dirname, main_program=None,
+                          filename=None):
+        return fluid_io.save_persistables(executor, dirname, main_program,
+                                          filename)
+
+
+fleet = Collective()
+
+
+class CollectiveOptimizer(DistributedOptimizer):
+    """reference :262 — wraps a regular optimizer; after minimize, the
+    program carries the DP topology for mesh construction."""
+
+    def __init__(self, optimizer, strategy=None):
+        super().__init__(optimizer, strategy or DistributedStrategy())
+        self._fleet = None
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return self._optimizer.backward(
+            loss, startup_program, parameter_list, no_grad_set, callbacks
+        )
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        if self._strategy and getattr(self._strategy, "use_amp", False):
+            from ....contrib import mixed_precision
+
+            self._optimizer = mixed_precision.decorate(self._optimizer)
+        ops, params_grads = self._optimizer.minimize(
+            loss, startup_program, parameter_list, no_grad_set
+        )
+        program = loss.block.program
+        if self._fleet is not None:
+            program._num_trainers = self._fleet.worker_num()
+            program._trainer_id = self._fleet.worker_index()
+        return ops, params_grads
+
+    def main_program(self):
+        from ....framework import default_main_program
+
+        return default_main_program()

@@ -5,6 +5,7 @@ from . import io
 from . import tensor
 from . import ops
 from . import control_flow
+from . import sequence
 from . import metric_op
 from . import learning_rate_scheduler
 from . import collective
@@ -15,6 +16,7 @@ from .io import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
 from .control_flow import *  # noqa: F401,F403
+from .sequence import *  # noqa: F401,F403
 from .metric_op import *  # noqa: F401,F403
 from .learning_rate_scheduler import *  # noqa: F401,F403
 
@@ -24,6 +26,7 @@ __all__ = (
     + tensor.__all__
     + ops.__all__
     + control_flow.__all__
+    + sequence.__all__
     + metric_op.__all__
     + learning_rate_scheduler.__all__
 )

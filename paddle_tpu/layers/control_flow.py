@@ -1,40 +1,46 @@
 """Control-flow layers (reference:
 ``python/paddle/fluid/layers/control_flow.py``: While:630, StaticRNN:280,
-DynamicRNN:1700, IfElse:1564, Switch:1436 — each opens a sub-block).
+Switch:1436, ConditionalBlock:1352 — each opens a sub-block).
 
-TPU lowering: sub-blocks lower to ``lax.while_loop`` / ``lax.cond`` /
-``lax.scan`` bodies (compiler-friendly control flow, no per-iteration host
-dispatch).  The While/StaticRNN surface lands with the sequence batch
-(stage 7 of SURVEY.md §7); array ops used by beam-search decoders are here.
+TPU lowering: sub-blocks lower ONCE to pure jax functions run under
+``lax.while_loop`` / ``lax.cond`` / ``lax.scan`` (ops/control_flow.py) —
+compiled control flow, no per-iteration interpreter dispatch.  Loop-state
+vars must be created BEFORE the loop and assigned inside it (the same
+discipline the reference requires); shapes must be loop-invariant (XLA
+static shapes).
 """
 
-from ..framework import Variable
+from ..framework import Variable, default_main_program
 from ..layer_helper import LayerHelper
+from .. import core
+from ..ops.control_flow import ARRAY_CAPACITY_ATTR, DEFAULT_ARRAY_CAPACITY
 from . import tensor as _tensor
 
 __all__ = [
+    "While",
+    "StaticRNN",
+    "Switch",
+    "ConditionalBlock",
+    "IfElse",
+    "DynamicRNN",
     "increment",
     "array_write",
     "array_read",
     "array_length",
+    "create_array",
     "less_than",
+    "less_equal",
+    "greater_than",
+    "greater_equal",
     "equal",
     "not_equal",
-    "greater_than",
-    "While",
-    "StaticRNN",
-    "Switch",
-    "IfElse",
-    "DynamicRNN",
+    "cond",
 ]
 
 
 def increment(x, value=1.0, in_place=True):
     helper = LayerHelper("increment", **locals())
-    if in_place:
-        out = x
-    else:
-        out = helper.create_variable_for_type_inference(x.dtype)
+    out = x if in_place else helper.create_variable_for_type_inference(x.dtype)
     helper.append_op(
         type="increment", inputs={"X": [x]}, outputs={"Out": [out]},
         attrs={"step": float(value)},
@@ -56,6 +62,18 @@ def less_than(x, y, force_cpu=None, cond=None):
     return _compare("less_than", x, y, cond)
 
 
+def less_equal(x, y, cond=None):
+    return _compare("less_equal", x, y, cond)
+
+
+def greater_than(x, y, cond=None):
+    return _compare("greater_than", x, y, cond)
+
+
+def greater_equal(x, y, cond=None):
+    return _compare("greater_equal", x, y, cond)
+
+
 def equal(x, y, cond=None):
     return _compare("equal", x, y, cond)
 
@@ -64,58 +82,471 @@ def not_equal(x, y, cond=None):
     return _compare("not_equal", x, y, cond)
 
 
-def greater_than(x, y, cond=None):
-    return _compare("greater_than", x, y, cond)
+# ---------------------------------------------------------------------------
+# LoDTensorArray (fixed-capacity device buffer — see ops/control_flow.py)
+# ---------------------------------------------------------------------------
 
-
-def array_write(x, i, array=None):
-    raise NotImplementedError(
-        "LoDTensorArray ops land with the sequence/control-flow batch"
+def create_array(dtype, capacity=DEFAULT_ARRAY_CAPACITY):
+    helper = LayerHelper("array")
+    var = helper.main_program.current_block().create_var(
+        name=helper.name + ".out",
+        dtype=dtype,
+        type=core.VarDesc.VarType.LOD_TENSOR_ARRAY,
     )
+    # capacity rides on the var so every subsequent array_write allocates
+    # the same fixed-size device buffer
+    var._tensor_array_capacity = int(capacity)
+    return var
+
+
+def array_write(x, i, array=None, capacity=None):
+    helper = LayerHelper("array_write", **locals())
+    fresh = array is None
+    if capacity is None:
+        capacity = getattr(array, "_tensor_array_capacity",
+                           DEFAULT_ARRAY_CAPACITY) if array is not None \
+            else DEFAULT_ARRAY_CAPACITY
+    if fresh:
+        array = create_array(x.dtype, capacity)
+    # the first write to an array can't read a prior buffer value; a write
+    # that may re-run (e.g. inside a While body) must read it so the value
+    # is loop-carried
+    first_write = not getattr(array, "_tensor_array_written", False)
+    inputs = {"X": [x], "I": [i]}
+    if not first_write:
+        inputs["Array"] = [array]
+    array._tensor_array_written = True
+    helper.append_op(
+        type="write_to_array",
+        inputs=inputs,
+        outputs={"Out": [array]},
+        attrs={ARRAY_CAPACITY_ATTR: int(capacity)},
+    )
+    return array
 
 
 def array_read(array, i):
-    raise NotImplementedError(
-        "LoDTensorArray ops land with the sequence/control-flow batch"
+    helper = LayerHelper("array_read", **locals())
+    out = helper.create_variable_for_type_inference(array.dtype)
+    helper.append_op(
+        type="read_from_array",
+        inputs={"X": [array], "I": [i]},
+        outputs={"Out": [out]},
     )
+    return out
 
 
 def array_length(array):
-    raise NotImplementedError(
-        "LoDTensorArray ops land with the sequence/control-flow batch"
+    helper = LayerHelper("array_length", **locals())
+    out = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op(
+        type="lod_array_length", inputs={"X": [array]},
+        outputs={"Out": [out]},
     )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# While
+# ---------------------------------------------------------------------------
+
+class BlockGuard:
+    def __init__(self, program):
+        self.program = program
+
+    def __enter__(self):
+        self.program._create_block()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.program._rollback()
+        return exc_type is None
+
+
+class WhileGuard(BlockGuard):
+    def __init__(self, while_op):
+        super().__init__(while_op.helper.main_program)
+        self.while_op = while_op
+
+    def __enter__(self):
+        self.sub_block = self.program._create_block()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        self.program._rollback()
+        self.while_op._complete(self.sub_block)
+        return True
 
 
 class While:
+    """``with While(cond).block(): ...`` — the condition var must be
+    reassigned inside the block (reference control_flow.py:630)."""
+
     def __init__(self, cond, is_test=False, name=None):
-        raise NotImplementedError(
-            "While lowers to lax.while_loop — lands with stage 7 "
-            "(control flow + sequences)"
+        self.helper = LayerHelper("while", name=name)
+        if cond.dtype != "bool":
+            raise TypeError("While condition must be a bool Variable")
+        self.cond_var = cond
+
+    def block(self):
+        return WhileGuard(self)
+
+    def _complete(self, sub_block):
+        parent = self.helper.main_program.current_block()
+        # external reads = X; writes that exist outside = Out (loop state)
+        written = set()
+        reads = []
+        for op in sub_block.ops:
+            for n in op.input_arg_names:
+                if n not in written and n not in reads:
+                    reads.append(n)
+            written.update(op.output_arg_names)
+        x_names = [
+            n for n in reads
+            if parent._find_var_recursive(n) is not None
+        ]
+        out_names = [
+            n for n in written
+            if parent._find_var_recursive(n) is not None
+        ]
+        step_scopes = parent.create_var(
+            name=self.helper.name + ".step_scopes",
+            type=core.VarDesc.VarType.STEP_SCOPES,
+        )
+        parent.append_op(
+            type="while",
+            inputs={"X": x_names, "Condition": [self.cond_var]},
+            outputs={"Out": out_names, "StepScopes": [step_scopes]},
+            attrs={"sub_block": sub_block.idx, "is_test": False},
         )
 
 
-class StaticRNN:
-    def __init__(self, name=None):
-        raise NotImplementedError(
-            "StaticRNN lowers to lax.scan — lands with stage 7"
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """Functional two-branch conditional: both branches lower through
+    ConditionalBlock → lax.cond, merging into shared outer output vars
+    (zero-initialized, then assigned by whichever branch runs)."""
+    from .. import unique_name
+    from . import tensor as layers_tensor
+
+    helper = LayerHelper("cond", name=name)
+    parent = helper.main_program.current_block()
+    out_vars = []
+
+    def capture(rets):
+        if rets is None:
+            return
+        rets_t = list(rets) if isinstance(rets, (list, tuple)) else [rets]
+        if not out_vars:
+            for r in rets_t:
+                if r.shape is None or any(d < 0 for d in r.shape):
+                    raise ValueError(
+                        "cond() branch outputs need static shapes on TPU "
+                        "(got %s for %s)" % (r.shape, r.name)
+                    )
+                ov = parent.create_var(
+                    name=unique_name.generate("cond.out"),
+                    shape=r.shape, dtype=r.dtype,
+                )
+                parent.append_op(
+                    type="fill_constant",
+                    outputs={"Out": [ov]},
+                    attrs={"shape": list(r.shape), "dtype": r.dtype,
+                           "value": 0.0},
+                )
+                out_vars.append(ov)
+        cur = helper.main_program.current_block()
+        for r, ov in zip(rets_t, out_vars):
+            cur.append_op(
+                type="assign", inputs={"X": [r]}, outputs={"Out": [ov]}
+            )
+
+    cb = ConditionalBlock([pred])
+    with cb.block():
+        capture(true_fn() if true_fn is not None else None)
+    if false_fn is not None:
+        notp = _logical_not(pred)
+        cb2 = ConditionalBlock([notp])
+        with cb2.block():
+            capture(false_fn())
+    if not out_vars:
+        return None
+    return out_vars[0] if len(out_vars) == 1 else out_vars
+
+
+class ConditionalBlock:
+    """Run a sub-block iff cond is true (reference control_flow.py:1352);
+    vars assigned inside keep their prior value when cond is false."""
+
+    def __init__(self, inputs, is_scalar_condition=True, name=None):
+        self.helper = LayerHelper("conditional_block", name=name)
+        self.inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+
+    def block(self):
+        return ConditionalBlockGuard(self)
+
+    def _complete(self, sub_block):
+        parent = self.helper.main_program.current_block()
+        written = set()
+        for op in sub_block.ops:
+            written.update(op.output_arg_names)
+        out_names = [
+            n for n in written
+            if parent._find_var_recursive(n) is not None
+        ]
+        scope_var = parent.create_var(
+            name=self.helper.name + ".scope",
+            type=core.VarDesc.VarType.STEP_SCOPES,
         )
+        parent.append_op(
+            type="conditional_block",
+            inputs={"Cond": [self.inputs[0]]},
+            outputs={"Out": out_names, "Scope": [scope_var]},
+            attrs={"sub_block": sub_block.idx},
+        )
+
+
+class ConditionalBlockGuard(BlockGuard):
+    def __init__(self, cb):
+        super().__init__(cb.helper.main_program)
+        self.cb = cb
+
+    def __enter__(self):
+        self.sub_block = self.program._create_block()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        self.program._rollback()
+        self.cb._complete(self.sub_block)
+        return True
 
 
 class Switch:
+    """case/default chain built from ConditionalBlocks (reference
+    control_flow.py:1436)."""
+
     def __init__(self, name=None):
-        raise NotImplementedError("Switch lands with stage 7")
+        self.helper = LayerHelper("switch", name=name)
+        self.pre_not_conditions = []
+        self.inside_scope = False
+
+    def case(self, condition):
+        from . import nn as _nn
+
+        # condition AND not(any previous condition)
+        cur = condition
+        for prev in self.pre_not_conditions:
+            cur = _logical_and(cur, prev)
+        self.pre_not_conditions.append(_logical_not(condition))
+        return ConditionalBlock([cur]).block()
+
+    def default(self):
+        cur = self.pre_not_conditions[0]
+        for prev in self.pre_not_conditions[1:]:
+            cur = _logical_and(cur, prev)
+        return ConditionalBlock([cur]).block()
+
+    def __enter__(self):
+        self.inside_scope = True
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        self.inside_scope = False
+        return exc_type is None
+
+
+def _logical_and(x, y):
+    helper = LayerHelper("logical_and")
+    out = helper.create_variable_for_type_inference("bool", True)
+    helper.append_op(type="logical_and", inputs={"X": [x], "Y": [y]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def _logical_not(x):
+    helper = LayerHelper("logical_not")
+    out = helper.create_variable_for_type_inference("bool", True)
+    helper.append_op(type="logical_not", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return out
 
 
 class IfElse:
     def __init__(self, cond, name=None):
         raise NotImplementedError(
-            "IfElse lowers to lax.cond — lands with stage 7"
+            "IfElse (split/merge by mask) lands with the sequence batch; "
+            "use ConditionalBlock or Switch"
+        )
+
+
+# ---------------------------------------------------------------------------
+# StaticRNN
+# ---------------------------------------------------------------------------
+
+class StaticRNNGuard(BlockGuard):
+    def __init__(self, rnn):
+        super().__init__(rnn.helper.main_program)
+        self.rnn = rnn
+
+    def __enter__(self):
+        self.sub_block = self.program._create_block()
+        self.rnn._sub_block = self.sub_block
+        self.rnn.status = StaticRNN.IN_RNN_BLOCK
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        self.program._rollback()
+        self.rnn.status = StaticRNN.AFTER_RNN_BLOCK
+        self.rnn._complete()
+        return True
+
+
+class StaticRNN:
+    """Unrolled-by-scan RNN over time-major [T, B, ...] sequences
+    (reference control_flow.py:280 → recurrent_op.cc)."""
+
+    BEFORE_RNN_BLOCK = 0
+    IN_RNN_BLOCK = 1
+    AFTER_RNN_BLOCK = 2
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self.status = StaticRNN.BEFORE_RNN_BLOCK
+        self._sub_block = None
+        self.seq_inputs = []     # outer [T,B,...] vars
+        self.step_input_vars = []  # per-step sub-block vars
+        self.memories = []       # (pre_state_var, init_var)
+        self.mem_updates = {}    # pre_state name -> new value name
+        self.step_outputs = []   # per-step output vars
+        self.outputs = []        # outer stacked outputs
+
+    def step(self):
+        return StaticRNNGuard(self)
+
+    def _assert_in_rnn_block(self, method):
+        if self.status != StaticRNN.IN_RNN_BLOCK:
+            raise RuntimeError("%s() can only be called inside rnn.step()"
+                               % method)
+
+    def step_input(self, x):
+        self._assert_in_rnn_block("step_input")
+        self.seq_inputs.append(x)
+        sv = self._sub_block.create_var(
+            name=self.helper.name + ".step_in_%d" % len(self.step_input_vars),
+            shape=x.shape[1:] if x.shape else None,
+            dtype=x.dtype,
+        )
+        self.step_input_vars.append(sv)
+        return sv
+
+    def memory(self, init=None, shape=None, batch_ref=None,
+               init_value=0.0, init_batch_dim_idx=0, ref_batch_dim_idx=1):
+        self._assert_in_rnn_block("memory")
+        if init is None:
+            if shape is None or batch_ref is None:
+                raise ValueError("memory needs init or (shape & batch_ref)")
+            # the init must exist BEFORE the recurrent op runs, so its
+            # fill op goes into the PARENT block, sized from the outer
+            # sequence var (batch dim 1 of the time-major [T,B,...] input)
+            ref = batch_ref
+            for sv, seq in zip(self.step_input_vars, self.seq_inputs):
+                if ref is sv or ref.name == sv.name:
+                    ref = seq
+                    break
+            else:
+                raise ValueError(
+                    "batch_ref must be one of this RNN's step_input vars"
+                )
+            parent = self.helper.main_program.block(
+                self._sub_block.parent_idx
+            )
+            init = parent.create_var(
+                name=self.helper.name + ".mem_init_%d" % len(self.memories),
+                shape=(-1,) + tuple(shape),
+                dtype="float32",
+            )
+            parent.append_op(
+                type="fill_constant_batch_size_like",
+                inputs={"Input": [ref]},
+                outputs={"Out": [init]},
+                attrs={
+                    "shape": [0] + [int(s) for s in shape],
+                    "dtype": "float32",
+                    "value": float(init_value),
+                    "input_dim_idx": 1,  # batch dim of [T,B,...]
+                    "output_dim_idx": 0,
+                },
+            )
+        pre = self._sub_block.create_var(
+            name=self.helper.name + ".mem_%d" % len(self.memories),
+            shape=init.shape, dtype=init.dtype,
+        )
+        self.memories.append((pre, init))
+        return pre
+
+    def update_memory(self, mem, var):
+        self._assert_in_rnn_block("update_memory")
+        self.mem_updates[mem.name] = var.name
+
+    def step_output(self, o):
+        self._assert_in_rnn_block("step_output")
+        self.step_outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def __call__(self, *args):
+        if self.status != StaticRNN.AFTER_RNN_BLOCK:
+            raise RuntimeError("RNN output requested before step() closed")
+        if len(self.outputs) == 1:
+            return self.outputs[0]
+        return self.outputs
+
+    def _complete(self):
+        parent = self.helper.main_program.current_block()
+        out_vars = []
+        for i, so in enumerate(self.step_outputs):
+            T = self.seq_inputs[0].shape[0] if self.seq_inputs else -1
+            ov = parent.create_var(
+                name=self.helper.name + ".out_%d" % i,
+                shape=(T,) + tuple(so.shape or ()),
+                dtype=so.dtype,
+            )
+            out_vars.append(ov)
+        self.outputs = out_vars
+        final_states = []
+        state_out_names = []
+        for pre, init in self.memories:
+            state_out_names.append(self.mem_updates.get(pre.name, pre.name))
+        parent.append_op(
+            type="recurrent",
+            inputs={
+                "inputs": [v.name for v in self.seq_inputs],
+                "initial_states": [init.name for _, init in self.memories],
+            },
+            outputs={
+                "outputs": [v.name for v in out_vars],
+                "final_states": final_states,
+            },
+            attrs={
+                "sub_block": self._sub_block.idx,
+                "step_input_names": [v.name for v in self.step_input_vars],
+                "state_names": [pre.name for pre, _ in self.memories],
+                "state_out_names": state_out_names,
+                "step_output_names": [v.name for v in self.step_outputs],
+            },
         )
 
 
 class DynamicRNN:
     def __init__(self, name=None):
         raise NotImplementedError(
-            "DynamicRNN maps to a masked lax.scan over padded+bucketed "
-            "batches — lands with stage 7"
+            "DynamicRNN maps to a masked lax.scan over padded batches — "
+            "use StaticRNN with sequence masks, or layers.dynamic_lstm/gru"
         )

@@ -1,0 +1,233 @@
+"""Sequence layers over padded batches (reference: these live in
+``python/paddle/fluid/layers/nn.py`` as LoD-aware sequence_* functions and
+``dynamic_lstm``/``dynamic_gru``).
+
+Representation change (SURVEY.md §5): instead of LoD offsets carried on the
+tensor, sequence layers accept an optional ``seq_len`` Variable ([B] ints).
+Omitted seq_len = all rows full length."""
+
+import numpy as np
+
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+from ..param_attr import ParamAttr
+
+__all__ = [
+    "sequence_pool",
+    "sequence_softmax",
+    "sequence_reverse",
+    "sequence_concat",
+    "sequence_expand",
+    "sequence_pad",
+    "sequence_unpad",
+    "sequence_mask",
+    "sequence_slice",
+    "sequence_enumerate",
+    "sequence_first_step",
+    "sequence_last_step",
+    "dynamic_lstm",
+    "dynamic_gru",
+]
+
+
+def _seq_op(op_type, helper_name, x, seq_len=None, out_dtype=None,
+            extra_inputs=None, attrs=None, outputs_spec=None):
+    helper = LayerHelper(helper_name)
+    out = helper.create_variable_for_type_inference(out_dtype or x.dtype)
+    inputs = {"X": [x]}
+    if seq_len is not None:
+        inputs["SeqLen"] = [seq_len]
+    if extra_inputs:
+        inputs.update(extra_inputs)
+    outs = {"Out": [out]}
+    extra_outs = {}
+    if outputs_spec:
+        for slot, dtype in outputs_spec.items():
+            extra_outs[slot] = [
+                helper.create_variable_for_type_inference(dtype, True)
+            ]
+        outs.update(extra_outs)
+    helper.append_op(type=op_type, inputs=inputs, outputs=outs,
+                     attrs=attrs or {})
+    return out
+
+
+def sequence_pool(input, pool_type, is_test=False, seq_len=None):
+    return _seq_op(
+        "sequence_pool", "sequence_pool", input, seq_len,
+        attrs={"pooltype": pool_type.upper()},
+        outputs_spec={"MaxIndex": "int32"},
+    )
+
+
+def sequence_first_step(input, seq_len=None):
+    return sequence_pool(input, "first", seq_len=seq_len)
+
+
+def sequence_last_step(input, seq_len=None):
+    return sequence_pool(input, "last", seq_len=seq_len)
+
+
+def sequence_softmax(input, use_cudnn=False, name=None, seq_len=None):
+    return _seq_op("sequence_softmax", "sequence_softmax", input, seq_len)
+
+
+def sequence_reverse(x, name=None, seq_len=None):
+    helper = LayerHelper("sequence_reverse", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    inputs = {"X": [x]}
+    if seq_len is not None:
+        inputs["SeqLen"] = [seq_len]
+    helper.append_op(type="sequence_reverse", inputs=inputs,
+                     outputs={"Y": [out]})
+    return out
+
+
+def sequence_concat(input, name=None):
+    helper = LayerHelper("sequence_concat", **locals())
+    out = helper.create_variable_for_type_inference(input[0].dtype)
+    helper.append_op(type="sequence_concat", inputs={"X": list(input)},
+                     outputs={"Out": [out]})
+    return out
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    helper = LayerHelper("sequence_expand", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="sequence_expand", inputs={"X": [x], "Y": [y]},
+        outputs={"Out": [out]}, attrs={"ref_level": ref_level},
+    )
+    return out
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None, seq_len=None):
+    helper = LayerHelper("sequence_pad", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    length = helper.create_variable_for_type_inference("int64", True)
+    inputs = {"X": [x], "PadValue": [pad_value]}
+    if seq_len is not None:
+        inputs["SeqLen"] = [seq_len]
+    helper.append_op(
+        type="sequence_pad", inputs=inputs,
+        outputs={"Out": [out], "Length": [length]},
+    )
+    return out, length
+
+
+def sequence_unpad(x, length, name=None):
+    helper = LayerHelper("sequence_unpad", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="sequence_unpad", inputs={"X": [x], "Length": [length]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    helper = LayerHelper("sequence_mask", **locals())
+    out = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op(
+        type="sequence_mask", inputs={"X": [x]}, outputs={"Y": [out]},
+        attrs={"maxlen": int(maxlen) if maxlen else -1, "out_dtype": dtype},
+    )
+    return out
+
+
+def sequence_slice(input, offset, length, name=None):
+    helper = LayerHelper("sequence_slice", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="sequence_slice",
+        inputs={"X": [input], "Offset": [offset], "Length": [length]},
+        outputs={"Out": [out]},
+    )
+    return out
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    helper = LayerHelper("sequence_enumerate", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op(
+        type="sequence_enumerate", inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={"win_size": win_size, "pad_value": pad_value},
+    )
+    return out
+
+
+def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
+                 bias_attr=None, use_peepholes=False, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype="float32", name=None,
+                 seq_len=None):
+    """LSTM over padded [B, T, 4*hidden] pre-projected input (reference
+    nn.py dynamic_lstm over LoD input; input = fc(x, 4*hidden) as there).
+    size = 4 * hidden."""
+    assert size % 4 == 0
+    hidden = size // 4
+    if use_peepholes:
+        raise NotImplementedError("peephole LSTM lands later")
+    helper = LayerHelper("dynamic_lstm", **locals())
+    weight = helper.create_parameter(
+        attr=helper.param_attr, shape=[hidden, 4 * hidden], dtype=dtype
+    )
+    bias = helper.create_parameter(
+        attr=helper.bias_attr, shape=[1, 4 * hidden], dtype=dtype,
+        is_bias=True,
+    )
+    hidden_out = helper.create_variable_for_type_inference(dtype)
+    cell_out = helper.create_variable_for_type_inference(dtype)
+    inputs = {"Input": [input], "Weight": [weight], "Bias": [bias]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    if c_0 is not None:
+        inputs["C0"] = [c_0]
+    if seq_len is not None:
+        inputs["SeqLen"] = [seq_len]
+    helper.append_op(
+        type="dynamic_lstm",
+        inputs=inputs,
+        outputs={"Hidden": [hidden_out], "Cell": [cell_out]},
+        attrs={
+            "is_reverse": is_reverse,
+            "gate_activation": gate_activation,
+            "cell_activation": cell_activation,
+            "candidate_activation": candidate_activation,
+        },
+    )
+    return hidden_out, cell_out
+
+
+def dynamic_gru(input, size, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", h_0=None, name=None,
+                seq_len=None):
+    """GRU over padded [B, T, 3*size] pre-projected input (reference nn.py
+    dynamic_gru)."""
+    helper = LayerHelper("dynamic_gru", **locals())
+    dtype = input.dtype
+    weight = helper.create_parameter(
+        attr=helper.param_attr, shape=[size, 3 * size], dtype=dtype
+    )
+    bias = helper.create_parameter(
+        attr=helper.bias_attr, shape=[1, 3 * size], dtype=dtype, is_bias=True
+    )
+    hidden_out = helper.create_variable_for_type_inference(dtype)
+    inputs = {"Input": [input], "Weight": [weight], "Bias": [bias]}
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    if seq_len is not None:
+        inputs["SeqLen"] = [seq_len]
+    helper.append_op(
+        type="dynamic_gru",
+        inputs=inputs,
+        outputs={"Hidden": [hidden_out]},
+        attrs={
+            "is_reverse": is_reverse,
+            "gate_activation": gate_activation,
+            "activation": candidate_activation,
+        },
+    )
+    return hidden_out

@@ -6,5 +6,6 @@ from . import mnist
 from . import resnet
 from . import bert
 from . import vgg
+from . import ctr
 
-__all__ = ["mnist", "resnet", "bert", "vgg"]
+__all__ = ["mnist", "resnet", "bert", "vgg", "ctr"]

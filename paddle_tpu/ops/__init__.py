@@ -25,3 +25,7 @@ from . import nn  # noqa: F401
 from . import tensor_manip  # noqa: F401
 from . import compare  # noqa: F401
 from . import optimizer_ops  # noqa: F401
+from . import control_flow  # noqa: F401
+from . import rnn  # noqa: F401
+from . import sequence  # noqa: F401
+from . import collective  # noqa: F401

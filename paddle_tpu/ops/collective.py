@@ -1,0 +1,95 @@
+"""Collective ops (reference: ``paddle/fluid/operators/collective/``
+c_allreduce_{sum,max,min,prod}, c_broadcast, c_allgather, c_reducescatter,
+c_comm_init/c_gen_nccl_id + sync-stream ops).
+
+TPU-native: under a shard_map with a named mesh axis these lower to
+``lax.psum``-family collectives over ICI; under plain jit/GSPMD (the normal
+path) the partitioner inserts collectives itself and these ops act on
+already-global values, so they are identity.  The ctx carries the active
+axis name when the executor runs inside shard_map (`ctx.collective_axis`).
+The NCCL bootstrap ops (c_gen_nccl_id, c_comm_init) are no-ops: device-mesh
+membership comes from the jax coordination service
+(``jax.distributed.initialize``), not a rank-0 RPC broadcast
+(``gen_nccl_id_op.cc:188``)."""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _axis(ctx):
+    return getattr(ctx, "collective_axis", None)
+
+
+def _allreduce(name, fn):
+    @register_op(name, inputs=["X"], outputs=["Out"], no_grad=True)
+    def _op(ctx, attrs, X, _fn=fn):
+        ax = _axis(ctx)
+        if ax is None:
+            return X
+        return _fn(X, ax)
+
+    return _op
+
+
+_allreduce("c_allreduce_sum", lambda x, ax: jax.lax.psum(x, ax))
+_allreduce("c_allreduce_max", lambda x, ax: jax.lax.pmax(x, ax))
+_allreduce("c_allreduce_min", lambda x, ax: jax.lax.pmin(x, ax))
+_allreduce("c_allreduce_prod",
+           lambda x, ax: jnp.exp(jax.lax.psum(jnp.log(x), ax)))
+_allreduce("allreduce", lambda x, ax: jax.lax.psum(x, ax))
+
+
+@register_op("c_broadcast", inputs=["X"], outputs=["Out"], no_grad=True)
+def c_broadcast(ctx, attrs, X):
+    ax = _axis(ctx)
+    if ax is None:
+        return X
+    root = int(attrs.get("root", 0))
+    # select root's value on every member of the axis
+    return jax.lax.all_gather(X, ax)[root]
+
+
+@register_op("broadcast", inputs=["X"], outputs=["Out"], no_grad=True)
+def broadcast(ctx, attrs, X):
+    return c_broadcast(ctx, attrs, X)
+
+
+@register_op("c_allgather", inputs=["X"], outputs=["Out"], no_grad=True)
+def c_allgather(ctx, attrs, X):
+    ax = _axis(ctx)
+    if ax is None:
+        return X
+    g = jax.lax.all_gather(X, ax)  # [n, ...]
+    return jnp.reshape(g, (-1,) + tuple(jnp.shape(X)[1:]))
+
+
+@register_op("c_reducescatter", inputs=["X"], outputs=["Out"], no_grad=True)
+def c_reducescatter(ctx, attrs, X):
+    ax = _axis(ctx)
+    if ax is None:
+        return X
+    return jax.lax.psum_scatter(X, ax, tiled=True)
+
+
+@register_op("c_sync_calc_stream", inputs=["X"], outputs=["Out"],
+             no_grad=True)
+def c_sync_calc_stream(ctx, attrs, X):
+    return X  # stream ordering is XLA's job
+
+
+@register_op("c_sync_comm_stream", inputs=["X"], outputs=["Out"],
+             no_grad=True)
+def c_sync_comm_stream(ctx, attrs, X):
+    return X
+
+
+@register_op("c_gen_nccl_id", inputs=[], outputs=["Out"], no_grad=True)
+def c_gen_nccl_id(ctx, attrs):
+    return jnp.zeros((1,), jnp.int32)  # bootstrap handled by jax.distributed
+
+
+@register_op("c_comm_init", inputs=["X"], outputs=[], no_grad=True)
+def c_comm_init(ctx, attrs, X):
+    return {}

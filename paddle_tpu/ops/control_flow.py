@@ -1,0 +1,345 @@
+"""Control-flow op lowerings: sub-block ops → lax control flow.
+
+Reference: ``paddle/fluid/operators/controlflow/while_op.cc`` (interprets the
+sub-block per iteration against step scopes) and
+``conditional_block_op.cc``; ``recurrent_op.cc`` for StaticRNN.
+
+TPU-native: the sub-block is lowered ONCE into a pure jax function and run
+under ``lax.while_loop`` / ``lax.cond`` / ``lax.scan`` — no per-iteration
+host dispatch, fully compiled, fixed shapes.  Loop state (the carry) is the
+set of sub-block-written vars that are loop-carried (read before written, or
+live-out); everything else is a per-iteration temporary.
+
+LoDTensorArray (beam-search/RNN collectors) is a fixed-capacity device
+buffer + length scalar — `array_write` is a dynamic_update_slice, the
+TPU-static analogue of the reference's growable vector<LoDTensor>.
+"""
+
+import numpy as np
+
+from .registry import register_op, EMPTY_VAR_NAME
+
+SUB_BLOCK_OPS = ("while", "conditional_block", "recurrent",
+                 "recurrent_grad", "conditional_block_grad")
+
+ARRAY_CAPACITY_ATTR = "tensor_array_capacity"
+DEFAULT_ARRAY_CAPACITY = 128
+
+
+def _gather_inputs(op, env):
+    ins = {}
+    for slot, names in op.inputs.items():
+        ins[slot] = [
+            None if (not n or n == EMPTY_VAR_NAME) else env.get(n)
+            for n in names
+        ]
+    return ins
+
+
+def _carry_analysis(sub_block, outer_env):
+    """Split sub-block-written vars into loop-carried vs temporaries.
+
+    carried := written vars that are (a) read within the body before their
+    first write (previous-iteration value used), or (b) present in the
+    outer env (live-in/live-out state).
+    """
+    written_order = []
+    written = set()
+    read_before_write = set()
+    for op in sub_block.ops:
+        for n in op.input_arg_names:
+            if n and n != EMPTY_VAR_NAME and n not in written:
+                read_before_write.add(n)
+        for n in op.output_arg_names:
+            if n and n != EMPTY_VAR_NAME and n not in written:
+                written.add(n)
+                written_order.append(n)
+    carried = [
+        n for n in written_order
+        if n in read_before_write or n in outer_env
+    ]
+    return carried, written_order
+
+
+def sub_block_external_reads(sub_block, exclude=()):
+    """Names read by the sub-block before any write (closure captures)."""
+    written = set(exclude)
+    reads = []
+    for op in sub_block.ops:
+        for n in op.input_arg_names:
+            if n and n != EMPTY_VAR_NAME and n not in written and n not in reads:
+                reads.append(n)
+        written.update(op.output_arg_names)
+    return reads
+
+
+def _nonzero_cotangent(g, primal):
+    import jax
+    import jax.numpy as jnp
+
+    if g is None:
+        return jnp.zeros_like(primal)
+    return g
+
+
+def _clean_grad(g, primal):
+    import jax
+    import jax.numpy as jnp
+
+    if g is None or g.dtype == jax.dtypes.float0:
+        return jnp.zeros(jnp.shape(primal), jnp.float32)
+    return g
+
+
+def run_sub_block_op(op, block, env, ctx, run_block_fn):
+    import jax
+    import jax.numpy as jnp
+
+    program = block.program
+    sub_block = program.block(op.attrs["sub_block"])
+
+    if op.type == "recurrent_grad":
+        _run_recurrent_grad(op, sub_block, env, ctx, run_block_fn)
+        return
+    if op.type == "conditional_block_grad":
+        _run_conditional_grad(op, sub_block, env, ctx, run_block_fn)
+        return
+
+    if op.type == "while":
+        cond_name = op.inputs["Condition"][0]
+        carried, written = _carry_analysis(sub_block, env)
+        if cond_name not in carried:
+            carried = carried + [cond_name]
+        missing = [n for n in carried if n not in env]
+        if missing:
+            raise RuntimeError(
+                "while op: loop-carried vars %s have no initial value "
+                "before the loop" % missing
+            )
+        carry0 = {n: env[n] for n in carried}
+        outer = dict(env)
+
+        def body(carry):
+            e = dict(outer)
+            e.update(carry)
+            run_block_fn(sub_block, e, ctx)
+            return {n: e[n] for n in carried}
+
+        def cond(carry):
+            return jnp.reshape(carry[cond_name], ()).astype(bool)
+
+        final = jax.lax.while_loop(cond, body, carry0)
+        env.update(final)
+        return
+
+    if op.type == "conditional_block":
+        cond_val = env[op.inputs["Cond"][0]]
+        carried, written = _carry_analysis(sub_block, env)
+        outer = dict(env)
+        branch_outs = [n for n in written if n in env] or carried
+        branch_outs = list(dict.fromkeys(branch_outs))
+
+        def true_fn(carry):
+            e = dict(outer)
+            e.update(carry)
+            run_block_fn(sub_block, e, ctx)
+            return {n: e[n] for n in branch_outs}
+
+        def false_fn(carry):
+            return dict(carry)
+
+        carry0 = {n: env[n] for n in branch_outs}
+        pred = jnp.reshape(cond_val, ()).astype(bool)
+        result = jax.lax.cond(pred, true_fn, false_fn, carry0)
+        env.update(result)
+        return
+
+    if op.type == "recurrent":
+        _run_recurrent(op, sub_block, env, ctx, run_block_fn)
+        return
+
+    raise NotImplementedError(op.type)
+
+
+def _run_recurrent(op, sub_block, env, ctx, run_block_fn):
+    """StaticRNN (reference recurrent_op.cc): scan the sub-block over the
+    time axis of the sequence inputs."""
+    import jax
+    import jax.numpy as jnp
+
+    seq_inputs = op.inputs.get("inputs", [])         # [B, T, ...] outer vars
+    step_inputs = op.attrs["step_input_names"]       # per-step names in body
+    init_states = op.inputs.get("initial_states", [])  # [B, ...] outer vars
+    state_names = op.attrs["state_names"]            # pre-state name in body
+    state_out_names = op.attrs["state_out_names"]    # post-state name in body
+    step_output_names = op.attrs["step_output_names"]
+    outputs = op.outputs.get("outputs", [])          # stacked [B,T,...] outs
+
+    outer = dict(env)
+    # StaticRNN steps over axis 0 (time-major [T, B, ...] inputs, matching
+    # the reference's recurrent_op slicing)
+    xs = [env[n] for n in seq_inputs]
+    carry0 = tuple(env[n] for n in init_states)
+
+    def step(carry, xt):
+        e = dict(outer)
+        for name, val in zip(state_names, carry):
+            e[name] = val
+        for name, val in zip(step_inputs, xt):
+            e[name] = val
+        run_block_fn(sub_block, e, ctx)
+        new_carry = tuple(e[n] for n in state_out_names)
+        ys = tuple(e[n] for n in step_output_names)
+        return new_carry, ys
+
+    final_carry, stacked = jax.lax.scan(step, carry0, tuple(xs))
+    for name, val in zip(outputs, stacked):
+        env[name] = val  # [T, B, ...]
+    for name, val in zip(op.outputs.get("final_states", []), final_carry):
+        env[name] = val
+
+
+def _run_recurrent_grad(op, sub_block, env, ctx, run_block_fn):
+    """Grad of the StaticRNN scan: jax.vjp over the SAME scan closure,
+    differentiating w.r.t. sequence inputs, initial states, AND captured
+    outer vars (the parameters used inside the step block) — the role of
+    the reference's recurrent_grad op (recurrent_op.cc RecurrentGradOp)."""
+    import jax
+    import jax.numpy as jnp
+
+    seq_names = op.inputs.get("inputs", [])
+    init_names = op.inputs.get("initial_states", [])
+    cap_names = op.inputs.get("Captured", [])
+    out_names = op.inputs.get("outputs", [])
+    gout_names = op.inputs.get("outputs@GRAD", [])
+    step_inputs = op.attrs["step_input_names"]
+    state_names = op.attrs["state_names"]
+    state_out_names = op.attrs["state_out_names"]
+    step_output_names = op.attrs["step_output_names"]
+    outer = dict(env)
+
+    def f(seq_vals, init_vals, cap_vals):
+        caps = dict(zip(cap_names, cap_vals))
+
+        def step(carry, xts):
+            e = dict(outer)
+            e.update(caps)
+            for name, val in zip(state_names, carry):
+                e[name] = val
+            for name, val in zip(step_inputs, xts):
+                e[name] = val
+            run_block_fn(sub_block, e, ctx)
+            return (
+                tuple(e[n] for n in state_out_names),
+                tuple(e[n] for n in step_output_names),
+            )
+
+        _, ys = jax.lax.scan(step, tuple(init_vals), tuple(seq_vals))
+        return ys
+
+    seq_vals = tuple(env[n] for n in seq_names)
+    init_vals = tuple(env[n] for n in init_names)
+    cap_vals = tuple(env[n] for n in cap_names)
+    primal, vjp_fn = jax.vjp(f, seq_vals, init_vals, cap_vals)
+    cots = []
+    for i, p in enumerate(primal):
+        gname = gout_names[i] if i < len(gout_names) else EMPTY_VAR_NAME
+        g = env.get(gname) if gname and gname != EMPTY_VAR_NAME else None
+        cots.append(_nonzero_cotangent(g, p))
+    gseq, ginit, gcap = vjp_fn(tuple(cots))
+    for slot, gvals, primals in (
+        ("inputs@GRAD", gseq, seq_vals),
+        ("initial_states@GRAD", ginit, init_vals),
+        ("Captured@GRAD", gcap, cap_vals),
+    ):
+        names = op.outputs.get(slot, [])
+        for n, g, p in zip(names, gvals, primals):
+            if n and n != EMPTY_VAR_NAME:
+                env[n] = _clean_grad(g, p)
+
+
+def _run_conditional_grad(op, sub_block, env, ctx, run_block_fn):
+    """Grad of conditional_block via vjp over lax.cond, w.r.t. captured
+    outer vars.  Note: grads w.r.t. the PRE-values of vars overwritten by
+    the block (the false-branch passthrough) are not propagated — those
+    pre-values are no longer live in the SSA env; typical conditional
+    blocks (lr bands, metric branches) have no grad flow through them."""
+    import jax
+    import jax.numpy as jnp
+
+    cond_name = op.inputs["Cond"][0]
+    cap_names = op.inputs.get("Captured", [])
+    out_names = op.inputs.get("Out", [])
+    gout_names = op.inputs.get("Out@GRAD", [])
+    outer = dict(env)
+    pred = jnp.reshape(env[cond_name], ()).astype(bool)
+
+    def f(cap_vals):
+        caps = dict(zip(cap_names, cap_vals))
+
+        def true_fn(cap):
+            e = dict(outer)
+            e.update(dict(zip(cap_names, cap)))
+            run_block_fn(sub_block, e, ctx)
+            return tuple(e[n] for n in out_names)
+
+        def false_fn(cap):
+            return tuple(outer[n] for n in out_names)
+
+        return jax.lax.cond(pred, true_fn, false_fn, cap_vals)
+
+    cap_vals = tuple(env[n] for n in cap_names)
+    primal, vjp_fn = jax.vjp(f, cap_vals)
+    cots = []
+    for i, p in enumerate(primal):
+        gname = gout_names[i] if i < len(gout_names) else EMPTY_VAR_NAME
+        g = env.get(gname) if gname and gname != EMPTY_VAR_NAME else None
+        cots.append(_nonzero_cotangent(g, p))
+    (gcap,) = vjp_fn(tuple(cots))
+    names = op.outputs.get("Captured@GRAD", [])
+    for n, g, p in zip(names, gcap, cap_vals):
+        if n and n != EMPTY_VAR_NAME:
+            env[n] = _clean_grad(g, p)
+
+
+# ---------------------------------------------------------------------------
+# LoDTensorArray ops (reference: lod_tensor_array ops + lod_array_length_op)
+# ---------------------------------------------------------------------------
+
+def _no_infer(op, block):
+    pass
+
+
+@register_op("write_to_array", inputs=["X", "I", "Array"], outputs=["Out"],
+             no_grad=True, infer_shape=_no_infer)
+def write_to_array(ctx, attrs, X, I, Array):
+    import jax
+    import jax.numpy as jnp
+
+    idx = jnp.reshape(I, ()).astype(jnp.int32)
+    cap = int(attrs.get(ARRAY_CAPACITY_ATTR, DEFAULT_ARRAY_CAPACITY))
+    if Array is None:
+        buf = jnp.zeros((cap,) + tuple(jnp.shape(X)), X.dtype)
+        length = jnp.asarray(0, jnp.int32)
+    else:
+        buf, length = Array["buffer"], Array["length"]
+    buf = jax.lax.dynamic_update_index_in_dim(buf, X, idx, 0)
+    return {"Out": {"buffer": buf, "length": jnp.maximum(length, idx + 1)}}
+
+
+@register_op("read_from_array", inputs=["X", "I"], outputs=["Out"],
+             no_grad=True, infer_shape=_no_infer)
+def read_from_array(ctx, attrs, X, I):
+    import jax
+    import jax.numpy as jnp
+
+    idx = jnp.reshape(I, ()).astype(jnp.int32)
+    return jax.lax.dynamic_index_in_dim(X["buffer"], idx, 0, keepdims=False)
+
+
+@register_op("lod_array_length", inputs=["X"], outputs=["Out"], no_grad=True,
+             infer_shape=_no_infer)
+def lod_array_length(ctx, attrs, X):
+    import jax.numpy as jnp
+
+    return jnp.reshape(X["length"], (1,)).astype(jnp.int32)

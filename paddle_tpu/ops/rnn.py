@@ -1,0 +1,154 @@
+"""Recurrent ops: LSTM/GRU as fused lax.scan kernels.
+
+Reference: ``paddle/fluid/operators/lstm_op.cc`` / ``gru_op.cc`` (LoD-batched
+CPU/GPU kernels via ``math/detail/lstm_kernel.h``) and the fused variants
+(``fused/fusion_lstm_op.cc``).
+
+TPU-native representation: padded dense batches [B, T, ...] with an optional
+``SeqLen`` [B] companion instead of LoD offsets (SURVEY.md §5: LoD becomes
+padding+masking under XLA static shapes).  The whole recurrence is ONE
+lax.scan — XLA pipelines the per-step gate matmuls onto the MXU; masked
+steps carry the previous state through, reproducing ragged-batch semantics.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _mask_time(SeqLen, B, T):
+    """[T, B] bool validity mask."""
+    if SeqLen is None:
+        return None
+    return jnp.arange(T)[:, None] < jnp.reshape(SeqLen, (B,))[None, :]
+
+
+_ACT = {
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "identity": lambda x: x,
+}
+
+
+@register_op(
+    "lstm",
+    inputs=["Input", "H0", "C0", "Weight", "Bias", "SeqLen"],
+    outputs=["Hidden", "Cell"],
+)
+def lstm(ctx, attrs, Input, H0, C0, Weight, Bias, SeqLen):
+    """Input [B,T,4D] (pre-projected x·Wx, as in the reference where the fc
+    is applied outside), Weight [D,4D] recurrent weights, Bias [1,4D] (or
+    [1,7D] with peepholes — peepholes unsupported).  Gate order i,f,c,o
+    (reference gate_activation defaults)."""
+    B, T, four_d = jnp.shape(Input)
+    d = four_d // 4
+    gate_act = _ACT[attrs.get("gate_activation", "sigmoid")]
+    cell_act = _ACT[attrs.get("cell_activation", "tanh")]
+    cand_act = _ACT[attrs.get("candidate_activation", "tanh")]
+    is_reverse = attrs.get("is_reverse", False)
+
+    h0 = H0 if H0 is not None else jnp.zeros((B, d), Input.dtype)
+    c0 = C0 if C0 is not None else jnp.zeros((B, d), Input.dtype)
+    x = jnp.moveaxis(Input, 1, 0)  # [T,B,4D]
+    if is_reverse:
+        x = jnp.flip(x, 0)
+    mask = _mask_time(SeqLen, B, T)
+    if mask is not None and is_reverse:
+        mask = jnp.flip(mask, 0)
+
+    def step(carry, inp):
+        h, c = carry
+        if mask is not None:
+            xt, mt = inp
+        else:
+            xt, mt = inp, None
+        gates = xt + jnp.matmul(h, Weight)
+        if Bias is not None:
+            gates = gates + jnp.reshape(Bias, (1, -1))[:, : 4 * d]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = gate_act(i), gate_act(f), gate_act(o)
+        g = cand_act(g)
+        c_new = f * c + i * g
+        h_new = o * cell_act(c_new)
+        if mt is not None:
+            keep = mt[:, None]
+            h_new = jnp.where(keep, h_new, h)
+            c_new = jnp.where(keep, c_new, c)
+        return (h_new, c_new), (h_new, c_new)
+
+    xs = (x, mask) if mask is not None else x
+    (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0), xs)
+    if is_reverse:
+        hs, cs = jnp.flip(hs, 0), jnp.flip(cs, 0)
+    return {
+        "Hidden": jnp.moveaxis(hs, 0, 1),
+        "Cell": jnp.moveaxis(cs, 0, 1),
+    }
+
+
+@register_op(
+    "dynamic_lstm",
+    inputs=["Input", "H0", "C0", "Weight", "Bias", "SeqLen"],
+    outputs=["Hidden", "Cell"],
+)
+def dynamic_lstm(ctx, attrs, Input, H0, C0, Weight, Bias, SeqLen):
+    return lstm(ctx, attrs, Input, H0, C0, Weight, Bias, SeqLen)
+
+
+@register_op(
+    "gru",
+    inputs=["Input", "H0", "Weight", "Bias", "SeqLen"],
+    outputs=["Hidden"],
+)
+def gru(ctx, attrs, Input, H0, Weight, Bias, SeqLen):
+    """Input [B,T,3D] pre-projected; Weight [D,3D]: first 2D for
+    update/reset gates, last D for candidate (reference gru_op.cc layout)."""
+    B, T, three_d = jnp.shape(Input)
+    d = three_d // 3
+    gate_act = _ACT[attrs.get("gate_activation", "sigmoid")]
+    cand_act = _ACT[attrs.get("activation", "tanh")]
+    is_reverse = attrs.get("is_reverse", False)
+
+    h0 = H0 if H0 is not None else jnp.zeros((B, d), Input.dtype)
+    x = jnp.moveaxis(Input, 1, 0)
+    if is_reverse:
+        x = jnp.flip(x, 0)
+    mask = _mask_time(SeqLen, B, T)
+    if mask is not None and is_reverse:
+        mask = jnp.flip(mask, 0)
+    w_gate = Weight[:, : 2 * d]   # [D, 2D]
+    w_cand = Weight[:, 2 * d:]    # [D, D]
+
+    def step(carry, inp):
+        h = carry
+        if mask is not None:
+            xt, mt = inp
+        else:
+            xt, mt = inp, None
+        if Bias is not None:
+            xt = xt + jnp.reshape(Bias, (1, -1))
+        xu, xr, xc = xt[:, :d], xt[:, d:2 * d], xt[:, 2 * d:]
+        g = jnp.concatenate([xu, xr], axis=-1) + jnp.matmul(h, w_gate)
+        u, r = jnp.split(gate_act(g), 2, axis=-1)
+        c = cand_act(xc + jnp.matmul(r * h, w_cand))
+        h_new = u * h + (1.0 - u) * c
+        if mt is not None:
+            h_new = jnp.where(mt[:, None], h_new, h)
+        return h_new, h_new
+
+    xs = (x, mask) if mask is not None else x
+    _, hs = jax.lax.scan(step, h0, xs)
+    if is_reverse:
+        hs = jnp.flip(hs, 0)
+    return {"Hidden": jnp.moveaxis(hs, 0, 1)}
+
+
+@register_op(
+    "dynamic_gru",
+    inputs=["Input", "H0", "Weight", "Bias", "SeqLen"],
+    outputs=["Hidden"],
+)
+def dynamic_gru(ctx, attrs, Input, H0, Weight, Bias, SeqLen):
+    return gru(ctx, attrs, Input, H0, Weight, Bias, SeqLen)

@@ -1,0 +1,171 @@
+"""Sequence ops on padded batches + explicit lengths.
+
+Reference: ``paddle/fluid/operators/sequence_ops/`` (15 LoD-aware ops over
+ragged LoDTensors).  TPU-native representation (SURVEY.md §5): a "sequence"
+is a padded dense [B, T, ...] tensor plus an optional ``SeqLen`` [B] int
+companion; masking reproduces ragged semantics under XLA static shapes.
+Ops that reorganize raggedness itself (sequence_unpad to ragged, LoD level
+manipulation) keep the padded form.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _mask(SeqLen, B, T, dtype=jnp.float32):
+    if SeqLen is None:
+        return jnp.ones((B, T), dtype)
+    return (
+        jnp.arange(T)[None, :] < jnp.reshape(SeqLen, (B,))[:, None]
+    ).astype(dtype)
+
+
+@register_op("sequence_pool", inputs=["X", "SeqLen"],
+             outputs=["Out", "MaxIndex"], stateful_outputs=("MaxIndex",))
+def sequence_pool(ctx, attrs, X, SeqLen):
+    ptype = attrs.get("pooltype", "AVERAGE").upper()
+    B, T = jnp.shape(X)[0], jnp.shape(X)[1]
+    feat_rank = X.ndim - 2
+    m = _mask(SeqLen, B, T, X.dtype).reshape((B, T) + (1,) * feat_rank)
+    lengths = (
+        jnp.reshape(SeqLen, (B,)).astype(X.dtype)
+        if SeqLen is not None else jnp.full((B,), T, X.dtype)
+    ).reshape((B,) + (1,) * feat_rank)
+    if ptype == "SUM":
+        out = jnp.sum(X * m, axis=1)
+    elif ptype == "AVERAGE":
+        out = jnp.sum(X * m, axis=1) / jnp.maximum(lengths, 1)
+    elif ptype == "SQRT":
+        out = jnp.sum(X * m, axis=1) / jnp.sqrt(jnp.maximum(lengths, 1))
+    elif ptype == "MAX":
+        neg = jnp.asarray(-1e30, X.dtype)
+        out = jnp.max(jnp.where(m > 0, X, neg), axis=1)
+    elif ptype == "LAST":
+        idx = (
+            jnp.reshape(SeqLen, (B,)).astype(jnp.int32) - 1
+            if SeqLen is not None
+            else jnp.full((B,), T - 1, jnp.int32)
+        )
+        out = jnp.take_along_axis(
+            X, idx.reshape((B, 1) + (1,) * feat_rank), axis=1
+        )[:, 0]
+    elif ptype == "FIRST":
+        out = X[:, 0]
+    else:
+        raise NotImplementedError("sequence_pool type %s" % ptype)
+    return {"Out": out, "MaxIndex": jnp.zeros((B,), jnp.int32)}
+
+
+@register_op("sequence_softmax", inputs=["X", "SeqLen"], outputs=["Out"])
+def sequence_softmax(ctx, attrs, X, SeqLen):
+    B, T = jnp.shape(X)[0], jnp.shape(X)[1]
+    m = _mask(SeqLen, B, T, X.dtype)
+    while m.ndim < X.ndim:
+        m = m[..., None]
+    logits = jnp.where(m > 0, X, jnp.asarray(-1e30, X.dtype))
+    p = jax.nn.softmax(logits, axis=1)
+    return p * m
+
+
+@register_op("sequence_reverse", inputs=["X", "SeqLen"], outputs=["Y"])
+def sequence_reverse(ctx, attrs, X, SeqLen):
+    B, T = jnp.shape(X)[0], jnp.shape(X)[1]
+    if SeqLen is None:
+        return jnp.flip(X, axis=1)
+    lens = jnp.reshape(SeqLen, (B,)).astype(jnp.int32)
+    t = jnp.arange(T)[None, :]
+    # position i maps to len-1-i within the valid prefix; padding unchanged
+    src = jnp.where(t < lens[:, None], lens[:, None] - 1 - t, t)
+    return jnp.take_along_axis(
+        X, src.reshape((B, T) + (1,) * (X.ndim - 2)), axis=1
+    )
+
+
+@register_op("sequence_expand", inputs=["X", "Y"], outputs=["Out"])
+def sequence_expand(ctx, attrs, X, Y):
+    """Tile X rows to match Y's time dimension (padded analogue of the
+    LoD-driven expand used by attention decoders)."""
+    T = jnp.shape(Y)[1]
+    return jnp.repeat(jnp.expand_dims(X, 1), T, axis=1) if X.ndim == 2 else X
+
+
+@register_op("sequence_concat", inputs=["X*"], outputs=["Out"], no_grad=True)
+def sequence_concat(ctx, attrs, X):
+    return jnp.concatenate(X, axis=1)
+
+
+@register_op("sequence_pad", inputs=["X", "PadValue", "SeqLen"],
+             outputs=["Out", "Length"], stateful_outputs=("Length",))
+def sequence_pad(ctx, attrs, X, PadValue, SeqLen):
+    # inputs are already padded in this representation; normalize padding
+    B, T = jnp.shape(X)[0], jnp.shape(X)[1]
+    m = _mask(SeqLen, B, T, X.dtype)
+    while m.ndim < X.ndim:
+        m = m[..., None]
+    pad = jnp.reshape(PadValue, ()) if PadValue is not None else 0.0
+    out = jnp.where(m > 0, X, jnp.asarray(pad, X.dtype))
+    length = (
+        jnp.reshape(SeqLen, (B,)).astype(jnp.int32)
+        if SeqLen is not None else jnp.full((B,), T, jnp.int32)
+    )
+    return {"Out": out, "Length": length}
+
+
+@register_op("sequence_unpad", inputs=["X", "Length"], outputs=["Out"])
+def sequence_unpad(ctx, attrs, X, Length):
+    # stays padded under static shapes; zero out beyond Length
+    B, T = jnp.shape(X)[0], jnp.shape(X)[1]
+    m = _mask(Length, B, T, X.dtype)
+    while m.ndim < X.ndim:
+        m = m[..., None]
+    return X * m
+
+
+@register_op("sequence_mask", inputs=["X"], outputs=["Y"], no_grad=True)
+def sequence_mask(ctx, attrs, X):
+    maxlen = int(attrs.get("maxlen", -1))
+    from .common import resolve_dtype
+
+    dtype = resolve_dtype(attrs.get("out_dtype", "int64"))
+    lens = jnp.reshape(X, (-1,)).astype(jnp.int32)
+    if maxlen < 0:
+        raise ValueError(
+            "sequence_mask needs a static maxlen attr on TPU (dynamic "
+            "max-length output shapes are not XLA-compatible)"
+        )
+    return (
+        jnp.arange(maxlen)[None, :] < lens[:, None]
+    ).astype(dtype)
+
+
+@register_op("sequence_slice", inputs=["X", "Offset", "Length"],
+             outputs=["Out"], no_grad=True)
+def sequence_slice(ctx, attrs, X, Offset, Length):
+    B, T = jnp.shape(X)[0], jnp.shape(X)[1]
+    off = jnp.reshape(Offset, (B,)).astype(jnp.int32)
+    t = jnp.arange(T)[None, :]
+    src = jnp.minimum(t + off[:, None], T - 1)
+    out = jnp.take_along_axis(
+        X, src.reshape((B, T) + (1,) * (X.ndim - 2)), axis=1
+    )
+    m = _mask(Length, B, T, X.dtype)
+    while m.ndim < out.ndim:
+        m = m[..., None]
+    return out * m
+
+
+@register_op("sequence_enumerate", inputs=["X"], outputs=["Out"],
+             no_grad=True)
+def sequence_enumerate(ctx, attrs, X):
+    win = int(attrs.get("win_size", 2))
+    pad = attrs.get("pad_value", 0)
+    B, T = jnp.shape(X)[0], jnp.shape(X)[1]
+    cols = []
+    for k in range(win):
+        shifted = jnp.concatenate(
+            [X[:, k:], jnp.full((B, k), pad, X.dtype)], axis=1
+        )
+        cols.append(shifted)
+    return jnp.stack(cols, axis=-1)

@@ -184,17 +184,93 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None, grad_clip=None):
+        from .framework import in_dygraph_mode
+
+        if in_dygraph_mode():
+            return self._dygraph_minimize(loss, parameter_list)
         params_grads = self.backward(
             loss, startup_program, parameter_list, no_grad_set
         )
         optimize_ops = self.apply_optimize(loss, startup_program, params_grads)
         return optimize_ops, params_grads
 
+    # ---- dygraph (eager) path: apply the SAME optimizer op lowering to
+    # eager values; per-param accumulators live on the optimizer ----
+    def _eager_state_for(self, param):
+        if not hasattr(self, "_eager_state"):
+            self._eager_state = {}
+        return self._eager_state.setdefault(id(param), {})
+
+    def _eager_lr(self):
+        import jax.numpy as jnp
+
+        lr = self._learning_rate
+        if not isinstance(lr, (float, int)):
+            raise TypeError("dygraph mode needs a float learning rate")
+        return jnp.asarray([lr], jnp.float32)
+
+    def _eager_apply(self, param):
+        raise NotImplementedError(
+            "%s has no dygraph update yet — use SGD/Momentum/Adam"
+            % type(self).__name__
+        )
+
+    def _dygraph_apply_regularization(self, param):
+        """Apply weight decay to the eager grad (the dygraph analogue of
+        append_regularization_ops)."""
+        from .regularizer import L1DecayRegularizer, L2DecayRegularizer
+
+        reg = getattr(param, "regularizer", None) or self.regularization
+        if reg is None:
+            return
+        import jax.numpy as jnp
+
+        if isinstance(reg, L2DecayRegularizer):
+            param._grad = param._grad + jnp.asarray(
+                reg._regularization_coeff, param._grad.dtype
+            ) * param.value
+        elif isinstance(reg, L1DecayRegularizer):
+            param._grad = param._grad + jnp.asarray(
+                reg._regularization_coeff, param._grad.dtype
+            ) * jnp.sign(param.value)
+
+    def _dygraph_minimize(self, loss, parameter_list):
+        if parameter_list is None:
+            raise ValueError(
+                "dygraph minimize requires parameter_list (the Layer's "
+                ".parameters())"
+            )
+        if loss is not None and getattr(loss, "_grad", None) is None:
+            loss.backward()
+        for p in parameter_list:
+            if getattr(p, "_grad", None) is None or not p.trainable:
+                continue
+            self._dygraph_apply_regularization(p)
+            self._eager_apply(p)
+        return [], []
+
+
+def _eager_run_op(op_type, ins, attrs):
+    from .ops.registry import get_op_def, call_op, LoweringContext
+
+    ctx = LoweringContext(mode="train")
+    return call_op(get_op_def(op_type), ctx,
+                   {k: [v] for k, v in ins.items()}, attrs)
+
 
 class SGDOptimizer(Optimizer):
     def __init__(self, learning_rate, regularization=None, name=None):
         self.type = "sgd"
         super().__init__(learning_rate, regularization, name)
+
+    def _eager_apply(self, param):
+        outs = _eager_run_op(
+            "sgd",
+            {"Param": param.value, "Grad": param._grad,
+             "LearningRate": self._eager_lr()},
+            {},
+        )
+        param.set_value(outs["ParamOut"][0])
 
     def _append_optimize_op(self, block, param_and_grad):
         return block.append_op(
@@ -222,6 +298,22 @@ class MomentumOptimizer(Optimizer):
     def _create_accumulators(self, block, parameters):
         for p in parameters:
             self._add_accumulator(self._velocity_acc_str, p)
+
+    def _eager_apply(self, param):
+        import jax.numpy as jnp
+
+        st = self._eager_state_for(param)
+        if "velocity" not in st:
+            st["velocity"] = jnp.zeros_like(param.value)
+        outs = _eager_run_op(
+            "momentum",
+            {"Param": param.value, "Grad": param._grad,
+             "Velocity": st["velocity"],
+             "LearningRate": self._eager_lr()},
+            {"mu": self._momentum, "use_nesterov": self._use_nesterov},
+        )
+        param.set_value(outs["ParamOut"][0])
+        st["velocity"] = outs["VelocityOut"][0]
 
     def _append_optimize_op(self, block, param_and_grad):
         velocity = self._get_accumulator(
@@ -373,6 +465,30 @@ class AdamOptimizer(Optimizer):
             self._add_accumulator(
                 self._beta2_pow_acc_str, p, fill_value=self._beta2, shape=[1]
             )
+
+    def _eager_apply(self, param):
+        import jax.numpy as jnp
+
+        st = self._eager_state_for(param)
+        if "m1" not in st:
+            st["m1"] = jnp.zeros_like(param.value)
+            st["m2"] = jnp.zeros_like(param.value)
+            st["b1p"] = jnp.asarray([self._beta1], jnp.float32)
+            st["b2p"] = jnp.asarray([self._beta2], jnp.float32)
+        outs = _eager_run_op(
+            "adam",
+            {"Param": param.value, "Grad": param._grad,
+             "LearningRate": self._eager_lr(),
+             "Moment1": st["m1"], "Moment2": st["m2"],
+             "Beta1Pow": st["b1p"], "Beta2Pow": st["b2p"]},
+            {"beta1": self._beta1, "beta2": self._beta2,
+             "epsilon": self._epsilon},
+        )
+        param.set_value(outs["ParamOut"][0])
+        st["m1"] = outs["Moment1Out"][0]
+        st["m2"] = outs["Moment2Out"][0]
+        st["b1p"] = outs["Beta1PowOut"][0]
+        st["b2p"] = outs["Beta2PowOut"][0]
 
     def _append_optimize_op(self, block, param_and_grad):
         m1 = self._get_accumulator(self._moment1_acc_str, param_and_grad[0])

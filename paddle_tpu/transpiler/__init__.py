@@ -1,0 +1,32 @@
+"""Distributed transpilers (reference:
+``python/paddle/fluid/transpiler/``)."""
+
+from .distribute_transpiler import (
+    DistributeTranspiler,
+    DistributeTranspilerConfig,
+)
+from .ps_dispatcher import HashName, RoundRobin
+from . import collective
+
+__all__ = [
+    "DistributeTranspiler",
+    "DistributeTranspilerConfig",
+    "HashName",
+    "RoundRobin",
+    "memory_optimize",
+    "release_memory",
+    "collective",
+]
+
+
+def memory_optimize(input_program, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=False):
+    """Legacy var-reuse pass (reference
+    memory_optimization_transpiler.py).  XLA's buffer assignment + the
+    executor's donated params already subsume in-place reuse under jit, so
+    this is a recorded no-op for API parity."""
+    return None
+
+
+def release_memory(input_program, skip_opt_set=None):
+    return None

@@ -1,0 +1,138 @@
+"""Collective transpilers (reference:
+``python/paddle/fluid/transpiler/collective.py``: GradAllReduce:175 inserts
+c_allreduce_sum after each grad + scales the loss grad; LocalSGD:263
+snapshots params and allreduces deltas).
+
+On TPU the inserted ops are identity under GSPMD (which already reduces
+grads globally because the batch is sharded) and real psums under shard_map
+execution — so a transpiled program is correct either way."""
+
+from ..framework import default_main_program, default_startup_program
+
+__all__ = ["GradAllReduce", "LocalSGD", "Collective"]
+
+OP_ROLE_BACKWARD = "backward"
+
+
+class Collective:
+    def __init__(self, nrings=1):
+        self.nrings = nrings
+        self.rank = 0
+        self.nranks = 1
+
+    def transpile(self, startup_program=None, program=None, rank=0,
+                  nranks=1, endpoints=None, current_endpoint=None,
+                  wait_port=True):
+        self.rank = rank
+        self.nranks = nranks
+        self.main_program = program or default_main_program()
+        self.startup_program = startup_program or default_startup_program()
+        self._transpile_startup_program()
+        self._transpile_main_program()
+
+    def _transpile_startup_program(self):
+        # reference appends c_gen_nccl_id + c_comm_init per ring; on TPU
+        # mesh membership comes from the jax coordination service, the ops
+        # are kept (as no-ops) for program-structure parity
+        block = self.startup_program.global_block()
+        nccl_id = block.create_var(name="tpu_comm_id_0", shape=[1],
+                                   dtype="int32", persistable=True)
+        block.append_op(
+            type="c_gen_nccl_id", outputs={"Out": [nccl_id]},
+            attrs={"rank": self.rank, "ring_id": 0},
+        )
+        block.append_op(
+            type="c_comm_init", inputs={"X": [nccl_id]},
+            attrs={"nranks": self.nranks, "rank": self.rank, "ring_id": 0},
+        )
+
+    def _transpile_main_program(self):
+        raise NotImplementedError
+
+
+class GradAllReduce(Collective):
+    def _transpile_main_program(self):
+        if self.nranks <= 1:
+            return
+        block = self.main_program.global_block()
+        # find grads by op role; insert allreduce right after the producing
+        # op, scaled 1/nranks (reference collective.py:205)
+        new_ops = []
+        from ..framework import Operator
+
+        for op in block.ops:
+            new_ops.append(op)
+            if op.attrs.get("op_role") != OP_ROLE_BACKWARD:
+                continue
+            grad_outs = [
+                n for n in op.output_arg_names if n.endswith("@GRAD")
+            ]
+            for g in grad_outs:
+                v = block._find_var_recursive(g)
+                if v is None:
+                    continue
+                new_ops.append(Operator(
+                    block, "scale", {"X": [g]}, {"Out": [g]},
+                    {"scale": 1.0 / self.nranks,
+                     "op_role": OP_ROLE_BACKWARD},
+                ))
+                new_ops.append(Operator(
+                    block, "c_allreduce_sum", {"X": [g]}, {"Out": [g]},
+                    {"ring_id": 0, "op_role": OP_ROLE_BACKWARD},
+                ))
+        block.ops = new_ops
+        self.main_program._bump_version()
+
+
+class LocalSGD(Collective):
+    """Periodic model averaging (reference collective.py:263): snapshot
+    params, train locally, allreduce param deltas."""
+
+    def _transpile_main_program(self):
+        if self.nranks <= 1:
+            return
+        block = self.main_program.global_block()
+        from ..framework import Operator
+        from ..initializer import ConstantInitializer
+        from ..layer_helper import LayerHelper
+
+        helper = LayerHelper("local_sgd")
+        for p in self.main_program.all_parameters():
+            snap_name = p.name + "@SNAPSHOT"
+            snap = block.create_var(
+                name=snap_name, shape=p.shape, dtype=p.dtype,
+                persistable=True,
+            )
+            sb = self.startup_program.global_block()
+            sv = sb.create_var(name=snap_name, shape=p.shape, dtype=p.dtype,
+                               persistable=True)
+            sb.append_op(
+                type="assign", inputs={"X": [p.name]},
+                outputs={"Out": [snap_name]},
+            )
+            # delta = snapshot - param ; allreduce ; param = snapshot - delta/n
+            delta = p.name + "@DELTA"
+            block.create_var(name=delta, shape=p.shape, dtype=p.dtype)
+            block.append_op(
+                type="elementwise_sub",
+                inputs={"X": [snap_name], "Y": [p.name]},
+                outputs={"Out": [delta]},
+            )
+            block.append_op(
+                type="scale", inputs={"X": [delta]}, outputs={"Out": [delta]},
+                attrs={"scale": 1.0 / self.nranks},
+            )
+            block.append_op(
+                type="c_allreduce_sum", inputs={"X": [delta]},
+                outputs={"Out": [delta]}, attrs={"ring_id": 0},
+            )
+            block.append_op(
+                type="elementwise_sub",
+                inputs={"X": [snap_name], "Y": [delta]},
+                outputs={"Out": [p.name]},
+            )
+            block.append_op(
+                type="assign", inputs={"X": [p.name]},
+                outputs={"Out": [snap_name]},
+            )
+        self.main_program._bump_version()

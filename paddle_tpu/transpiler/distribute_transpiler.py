@@ -1,0 +1,134 @@
+"""DistributeTranspiler (reference:
+``python/paddle/fluid/transpiler/distribute_transpiler.py:377``).
+
+The reference rewrites programs three ways:
+- **pserver mode**: slice params into blocks, replace grads with
+  send/recv ops, emit a pserver program run by listen_and_serv
+  (``:836``) — per-step RPC.
+- **nccl2 mode** (``:261``): append a gen_nccl_id bootstrap op; the program
+  itself stays local and BuildStrategy carries num_trainers/trainer_id.
+- **collective mode** (``:313``): insert explicit c_allreduce ops.
+
+TPU-native: data-parallel gradient exchange is GSPMD's job — one program
+jitted over a mesh, collectives over ICI/DCN inserted by the partitioner,
+membership from the jax coordination service.  So:
+- nccl2/collective modes record the trainer topology (consumed by
+  CompiledProgram/fleet for mesh construction) and, for collective mode,
+  insert the same program-level `c_allreduce_sum` ops the reference does
+  (identity under GSPMD, psum under shard_map execution).
+- pserver mode has no TPU equivalent worth building (RPC per step against
+  host servers defeats ICI); the sparse/huge-embedding use case it served
+  maps to sharded embedding tables (see layers.embedding is_distributed +
+  the CTR path).  get_pserver_program raises with that guidance.
+"""
+
+from ..framework import default_main_program, default_startup_program
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
+           "slice_variable"]
+
+
+class DistributeTranspilerConfig:
+    """reference distribute_transpiler.py:131"""
+
+    slice_var_up = True
+    split_method = None
+    min_block_size = 8192
+    enable_dc_asgd = False
+    sync_mode = True
+    runtime_split_send_recv = False
+    geo_sgd_mode = False
+    geo_sgd_need_push_nums = 100
+    mode = "nccl2"
+    print_log = False
+    wait_port = True
+    collective_mode = None
+
+
+def slice_variable(var_list, slice_count, min_block_size=8192):
+    """Param slicing plan (reference distribute_transpiler.py:85) — kept for
+    API/test parity and used by the sharded-embedding planner."""
+    blocks = []
+    for var in var_list:
+        split_count = slice_count
+        import numpy as np
+
+        var_numel = int(np.prod(var.shape))
+        max_pserver_count = int(var_numel / min_block_size)
+        if max_pserver_count == 0:
+            max_pserver_count = 1
+        if max_pserver_count < slice_count:
+            split_count = max_pserver_count
+        block_size = int((var_numel + split_count - 1) / split_count)
+        if len(var.shape) >= 2:
+            dim1 = int(np.prod(var.shape[1:]))
+            remains = block_size % dim1
+            if remains != 0:
+                block_size += dim1 - remains
+        split_count = int((var_numel + block_size - 1) / block_size)
+        for block_id in range(split_count):
+            curr_block_size = min(block_size,
+                                  var_numel - (block_id * block_size))
+            blocks.append("%s:%d:%d" % (var.name, block_id, curr_block_size))
+    return blocks
+
+
+class DistributeTranspiler:
+    def __init__(self, config=None):
+        self.config = config or DistributeTranspilerConfig()
+        self.trainer_id = 0
+        self.trainers = 1
+        self.endpoints = []
+
+    def transpile(self, trainer_id, program=None, pservers="127.0.0.1:6174",
+                  trainers=1, sync_mode=True, startup_program=None,
+                  current_endpoint="127.0.0.1:6174"):
+        program = program or default_main_program()
+        startup_program = startup_program or default_startup_program()
+        self.trainer_id = trainer_id
+        mode = getattr(self.config, "mode", "nccl2")
+        if isinstance(trainers, str):
+            self.endpoints = trainers.split(",")
+            self.trainers = len(self.endpoints)
+        else:
+            self.trainers = int(trainers)
+        if mode in ("nccl2", "grad_allreduce", "collective"):
+            # topology recorded on the program; mesh construction and
+            # collective insertion happen at jit time (GSPMD) — the
+            # gen_nccl_id bootstrap is subsumed by jax.distributed
+            program._trainer_id = trainer_id
+            program._num_trainers = self.trainers
+            if mode in ("grad_allreduce", "collective"):
+                from .collective import GradAllReduce
+
+                GradAllReduce().transpile(
+                    program=program, startup_program=startup_program,
+                    rank=trainer_id, nranks=self.trainers,
+                )
+            return
+        raise NotImplementedError(
+            "pserver transpilation has no TPU-native equivalent: per-step "
+            "RPC to host parameter servers defeats ICI. Use collective "
+            "mode (fleet.CollectiveOptimizer) for dense training, or "
+            "sharded embeddings (layers.embedding(is_distributed=True)) "
+            "for the huge-sparse-table use case the pserver served."
+        )
+
+    def get_trainer_program(self, wait_port=True):
+        return default_main_program()
+
+    def get_pserver_program(self, endpoint):
+        raise NotImplementedError(
+            "no pserver program on TPU — see DistributeTranspiler.transpile"
+        )
+
+    def get_pserver_programs(self, endpoint):
+        raise NotImplementedError(
+            "no pserver program on TPU — see DistributeTranspiler.transpile"
+        )
+
+    def get_startup_program(self, endpoint, pserver_program=None,
+                            startup_program=None):
+        raise NotImplementedError(
+            "no pserver startup program on TPU"
+        )

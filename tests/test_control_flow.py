@@ -1,0 +1,144 @@
+"""Control flow: While → lax.while_loop, ConditionalBlock → lax.cond,
+StaticRNN → lax.scan, tensor arrays (reference tests:
+unittests/test_while_op.py, test_conditional_block.py, test_recurrent_op.py,
+test_lod_tensor_array_ops.py)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.executor import Scope, scope_guard
+
+
+def test_while_sum_of_squares():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = fluid.layers.fill_constant([1], "float32", 0.0)
+        acc = fluid.layers.fill_constant([1], "float32", 0.0)
+        limit = fluid.layers.fill_constant([1], "float32", 10.0)
+        cond = fluid.layers.less_than(i, limit)
+        w = fluid.layers.While(cond)
+        with w.block():
+            sq = fluid.layers.elementwise_mul(i, i)
+            fluid.layers.assign(
+                fluid.layers.elementwise_add(acc, sq), output=acc
+            )
+            fluid.layers.increment(i, value=1.0, in_place=True)
+            fluid.layers.less_than(i, limit, cond=cond)
+    exe = fluid.Executor(fluid.CPUPlace())
+    out = exe.run(main, fetch_list=[acc, i])
+    assert float(out[0][0]) == sum(k * k for k in range(10))
+    assert float(out[1][0]) == 10.0
+
+
+def test_while_with_tensor_array():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = fluid.layers.fill_constant([1], "int32", 0)
+        limit = fluid.layers.fill_constant([1], "int32", 5)
+        x = fluid.layers.fill_constant([3], "float32", 2.0)
+        arr = fluid.layers.array_write(x, i, capacity=8)
+        cond = fluid.layers.less_than(i, limit)
+        w = fluid.layers.While(cond)
+        with w.block():
+            val = fluid.layers.array_read(arr, i)
+            doubled = fluid.layers.scale(val, scale=2.0)
+            fluid.layers.increment(i, value=1, in_place=True)
+            fluid.layers.array_write(doubled, i, array=arr)
+            fluid.layers.less_than(i, limit, cond=cond)
+        final = fluid.layers.array_read(arr, i)
+        n = fluid.layers.array_length(arr)
+    exe = fluid.Executor(fluid.CPUPlace())
+    out = exe.run(main, fetch_list=[final, n])
+    np.testing.assert_allclose(out[0], 2.0 * 2 ** 5)
+    assert int(out[1][0]) == 6
+
+
+def test_conditional_block_true_false():
+    for flag, expect in ((1.0, 5.0), (-1.0, 0.0)):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[1], dtype="float32",
+                                  append_batch_size=False)
+            zero = fluid.layers.fill_constant([1], "float32", 0.0)
+            out = fluid.layers.fill_constant([1], "float32", 0.0)
+            pred = fluid.layers.greater_than(x, zero)
+            cb = fluid.layers.ConditionalBlock([pred])
+            with cb.block():
+                fluid.layers.assign(
+                    fluid.layers.fill_constant([1], "float32", 5.0),
+                    output=out,
+                )
+        exe = fluid.Executor(fluid.CPUPlace())
+        res = exe.run(main, feed={"x": np.array([flag], "float32")},
+                      fetch_list=[out])[0]
+        assert float(res[0]) == expect, (flag, res)
+
+
+def test_switch_lr_band():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        step = fluid.layers.data("step", shape=[1], dtype="float32",
+                                 append_batch_size=False)
+        lr = fluid.layers.fill_constant([1], "float32", 0.0)
+        b1 = fluid.layers.fill_constant([1], "float32", 10.0)
+        with fluid.layers.Switch() as switch:
+            with switch.case(fluid.layers.less_than(step, b1)):
+                fluid.layers.assign(
+                    fluid.layers.fill_constant([1], "float32", 0.1),
+                    output=lr)
+            with switch.default():
+                fluid.layers.assign(
+                    fluid.layers.fill_constant([1], "float32", 0.01),
+                    output=lr)
+    exe = fluid.Executor(fluid.CPUPlace())
+    lo = exe.run(main, feed={"step": np.array([5.0], "float32")},
+                 fetch_list=[lr])[0]
+    hi = exe.run(main, feed={"step": np.array([50.0], "float32")},
+                 fetch_list=[lr])[0]
+    assert abs(float(lo[0]) - 0.1) < 1e-6
+    assert abs(float(hi[0]) - 0.01) < 1e-6
+
+
+def test_static_rnn_cumsum():
+    """StaticRNN accumulating inputs = running sum over time."""
+    T, B, D = 4, 2, 3
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[T, B, D], dtype="float32",
+                              append_batch_size=False)
+        h0 = fluid.layers.fill_constant([B, D], "float32", 0.0)
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            mem = rnn.memory(init=h0)
+            s = fluid.layers.elementwise_add(mem, xt)
+            rnn.update_memory(mem, s)
+            rnn.step_output(s)
+        out = rnn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.random.RandomState(0).rand(T, B, D).astype("float32")
+    res = exe.run(main, feed={"x": xv}, fetch_list=[out])[0]
+    np.testing.assert_allclose(res, np.cumsum(xv, axis=0), rtol=1e-5)
+
+
+def test_while_inside_jit_is_compiled_loop():
+    """A 1000-iteration while must execute fast (compiled, not
+    op-by-op host dispatch)."""
+    import time
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = fluid.layers.fill_constant([1], "float32", 0.0)
+        limit = fluid.layers.fill_constant([1], "float32", 1000.0)
+        cond = fluid.layers.less_than(i, limit)
+        w = fluid.layers.While(cond)
+        with w.block():
+            fluid.layers.increment(i, value=1.0, in_place=True)
+            fluid.layers.less_than(i, limit, cond=cond)
+    exe = fluid.Executor(fluid.CPUPlace())
+    out = exe.run(main, fetch_list=[i])  # includes compile
+    t0 = time.perf_counter()
+    out = exe.run(main, fetch_list=[i])
+    dt = time.perf_counter() - t0
+    assert float(out[0][0]) == 1000.0
+    assert dt < 0.5, "while loop appears to be interpreted (%.3fs)" % dt

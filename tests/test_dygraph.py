@@ -1,0 +1,128 @@
+"""Dygraph (eager) mode: tape autograd, layers, optimizers, checkpoints
+(reference tests: unittests/test_imperative_basic.py,
+test_imperative_mnist.py, test_imperative_checkpoint.py)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.dygraph import (
+    guard, to_variable, Linear, Conv2D, Pool2D, BatchNorm, Embedding,
+    Layer, Dropout, save_dygraph, load_dygraph, no_grad,
+)
+
+
+def test_eager_arithmetic_and_backward():
+    with guard():
+        x = to_variable(np.array([[1.0, 2.0], [3.0, 4.0]], "float32"))
+        x.stop_gradient = False
+        y = x * x + 2.0
+        from paddle_tpu.dygraph.varbase import eager_op
+
+        loss = eager_op("mean", {"X": [y]})[0]
+        loss.backward()
+        g = x.gradient()
+    np.testing.assert_allclose(g, 2 * np.array([[1, 2], [3, 4]]) / 4,
+                               rtol=1e-5)
+
+
+def test_linear_layer_trains_sgd():
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(4, 1).astype("float32")
+    with guard():
+        model = Linear(4, 1)
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        losses = []
+        for _ in range(100):
+            xv = rng.randn(16, 4).astype("float32")
+            x = to_variable(xv)
+            target = to_variable(xv @ w_true)
+            pred = model(x)
+            diff = pred - target
+            from paddle_tpu.dygraph.varbase import eager_op
+
+            loss = eager_op("mean", {"X": [diff * diff]})[0]
+            loss.backward()
+            opt.minimize(loss, parameter_list=model.parameters())
+            model.clear_gradients()
+            losses.append(float(loss.numpy()[0]))
+    assert losses[-1] < 1e-2, (losses[0], losses[-1])
+
+
+class _MNISTNet(Layer):
+    def __init__(self):
+        super().__init__()
+        self.conv = Conv2D(1, 8, 3, padding=1)
+        self.pool = Pool2D(2, "max", 2)
+        self.bn = BatchNorm(8)
+        self.fc = Linear(8 * 14 * 14, 10)
+        self.dropout = Dropout(0.2)
+
+    def forward(self, x):
+        from paddle_tpu.dygraph.varbase import eager_op
+
+        h = self.conv(x)
+        h = self.bn(h)
+        h = eager_op("relu", {"X": [h]})[0]
+        h = self.pool(h)
+        h = eager_op("reshape2", {"X": [h]}, {"shape": [0, -1]})[0]
+        h = self.dropout(h)
+        return self.fc(h)
+
+
+def test_conv_net_adam_step_and_eval_mode():
+    rng = np.random.RandomState(1)
+    with guard():
+        model = _MNISTNet()
+        opt = fluid.optimizer.Adam(learning_rate=1e-3)
+        from paddle_tpu.dygraph.varbase import eager_op
+
+        for step in range(3):
+            x = to_variable(rng.rand(4, 1, 28, 28).astype("float32"))
+            label = to_variable(rng.randint(0, 10, (4, 1)).astype("int64"))
+            logits = model(x)
+            outs = eager_op(
+                "softmax_with_cross_entropy",
+                {"Logits": [logits], "Label": [label]},
+            )
+            loss = eager_op("mean", {"X": [outs[1]]})[0]
+            loss.backward()
+            opt.minimize(loss, parameter_list=model.parameters())
+            model.clear_gradients()
+            assert np.isfinite(loss.numpy()).all()
+        # eval mode: dropout off, bn uses running stats → deterministic
+        model.eval()
+        x = to_variable(rng.rand(2, 1, 28, 28).astype("float32"))
+        a = model(x).numpy()
+        b = model(x).numpy()
+        np.testing.assert_allclose(a, b)
+
+
+def test_embedding_and_state_dict_roundtrip(tmp_path):
+    with guard():
+        emb = Embedding([50, 8])
+        ids = to_variable(np.array([[1], [3]], "int64"))
+        out = emb(ids)
+        assert out.shape == (2, 8)  # [N,1] ids squeeze (lookup_table_op.cc)
+        state = emb.state_dict()
+        save_dygraph(state, str(tmp_path / "model"))
+        loaded, _ = load_dygraph(str(tmp_path / "model"))
+        emb2 = Embedding([50, 8])
+        emb2.set_dict(loaded)
+        np.testing.assert_allclose(
+            emb2.weight.numpy(), emb.weight.numpy()
+        )
+
+
+def test_no_grad_suspends_tape():
+    with guard():
+        x = to_variable(np.ones((2, 2), "float32"))
+        x.stop_gradient = False
+        with no_grad():
+            y = x * 3.0
+        z = x * 2.0
+        from paddle_tpu.dygraph.varbase import eager_op
+
+        loss = eager_op("mean", {"X": [z]})[0]
+        loss.backward()
+        assert x.gradient() is not None
+        np.testing.assert_allclose(x.gradient(), 0.5)

@@ -1,0 +1,158 @@
+"""RNN + sequence ops on padded batches (reference tests:
+unittests/test_lstm_op.py, test_gru_op.py, test_seq_pool.py,
+test_sequence_reverse.py, test_sequence_mask.py)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.executor import Scope, scope_guard
+
+rng = np.random.RandomState(7)
+
+
+def _run(main, startup, feed, fetch):
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def _np_lstm(x, w, b, h0, c0):
+    """numpy oracle, gate order i,f,g,o."""
+    B, T, four_d = x.shape
+    d = four_d // 4
+    h, c = h0.copy(), c0.copy()
+    hs = []
+    sig = lambda v: 1 / (1 + np.exp(-v))  # noqa: E731
+    for t in range(T):
+        g = x[:, t] + h @ w + b
+        i, f, gg, o = np.split(g, 4, axis=-1)
+        c = sig(f) * c + sig(i) * np.tanh(gg)
+        h = sig(o) * np.tanh(c)
+        hs.append(h.copy())
+    return np.stack(hs, 1), c
+
+
+def test_dynamic_lstm_matches_numpy():
+    B, T, D = 2, 5, 4
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[T, 4 * D], dtype="float32")
+        h, c = fluid.layers.dynamic_lstm(x, size=4 * D)
+    xv = rng.randn(B, T, 4 * D).astype("float32") * 0.5
+    params = main.all_parameters()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        from paddle_tpu.executor import global_scope
+
+        w = np.asarray(global_scope().get(params[0].name))
+        b = np.asarray(global_scope().get(params[1].name))
+        out = exe.run(main, feed={"x": xv}, fetch_list=[h])[0]
+    ref, _ = _np_lstm(xv, w, b.reshape(1, -1)[:, :4 * D].repeat(B, 0) * 0 +
+                      b.reshape(-1)[:4 * D], np.zeros((B, D), "float32"),
+                      np.zeros((B, D), "float32"))
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_dynamic_lstm_masking():
+    """Shorter sequences must freeze their state at their length."""
+    B, T, D = 2, 6, 3
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[T, 4 * D], dtype="float32")
+        lens = fluid.layers.data("lens", shape=[], dtype="int32")
+        h, c = fluid.layers.dynamic_lstm(x, size=4 * D, seq_len=lens)
+    xv = rng.randn(B, T, 4 * D).astype("float32")
+    lv = np.array([3, 6], "int32")
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        out = exe.run(main, feed={"x": xv, "lens": lv}, fetch_list=[h])[0]
+    # row 0 frozen after t=3
+    np.testing.assert_allclose(out[0, 3], out[0, 4])
+    np.testing.assert_allclose(out[0, 3], out[0, 5])
+    assert not np.allclose(out[1, 4], out[1, 5])
+
+
+def test_dynamic_gru_runs_and_grads():
+    B, T, D = 2, 4, 3
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[T, 3 * D], dtype="float32")
+        h = fluid.layers.dynamic_gru(x, size=D)
+        loss = fluid.layers.mean(h)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    xv = rng.randn(B, T, 3 * D).astype("float32")
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        l1 = exe.run(main, feed={"x": xv}, fetch_list=[loss])[0]
+        l2 = exe.run(main, feed={"x": xv}, fetch_list=[loss])[0]
+    assert np.isfinite(l1).all() and np.isfinite(l2).all()
+    assert not np.allclose(l1, l2)  # params moved
+
+
+def test_sequence_pool_types():
+    B, T, D = 2, 4, 3
+    x = rng.rand(B, T, D).astype("float32")
+    lens = np.array([2, 4], "int32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data("x", shape=[T, D], dtype="float32")
+        lv = fluid.layers.data("lens", shape=[], dtype="int32")
+        outs = {
+            ptype: fluid.layers.sequence_pool(xv, ptype, seq_len=lv)
+            for ptype in ("sum", "average", "max", "last", "first")
+        }
+    res = _run(main, startup, {"x": x, "lens": lens}, list(outs.values()))
+    got = dict(zip(outs.keys(), res))
+    m = (np.arange(T)[None, :] < lens[:, None]).astype("float32")[..., None]
+    np.testing.assert_allclose(got["sum"], (x * m).sum(1), rtol=1e-5)
+    np.testing.assert_allclose(
+        got["average"], (x * m).sum(1) / lens[:, None], rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        got["max"], np.where(m > 0, x, -np.inf).max(1), rtol=1e-5
+    )
+    np.testing.assert_allclose(got["last"][0], x[0, 1])
+    np.testing.assert_allclose(got["last"][1], x[1, 3])
+    np.testing.assert_allclose(got["first"], x[:, 0])
+
+
+def test_sequence_reverse_respects_lengths():
+    B, T, D = 2, 4, 2
+    x = rng.rand(B, T, D).astype("float32")
+    lens = np.array([2, 4], "int32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data("x", shape=[T, D], dtype="float32")
+        lv = fluid.layers.data("lens", shape=[], dtype="int32")
+        out = fluid.layers.sequence_reverse(xv, seq_len=lv)
+    res = _run(main, startup, {"x": x, "lens": lens}, [out])[0]
+    np.testing.assert_allclose(res[0, :2], x[0, :2][::-1])
+    np.testing.assert_allclose(res[0, 2:], x[0, 2:])  # padding untouched
+    np.testing.assert_allclose(res[1], x[1][::-1])
+
+
+def test_sequence_mask():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        lens = fluid.layers.data("lens", shape=[], dtype="int32")
+        m = fluid.layers.sequence_mask(lens, maxlen=5, dtype="float32")
+    res = _run(main, startup, {"lens": np.array([2, 5], "int32")}, [m])[0]
+    np.testing.assert_allclose(res, [[1, 1, 0, 0, 0], [1, 1, 1, 1, 1]])
+
+
+def test_sequence_softmax_masks_padding():
+    B, T = 2, 4
+    x = rng.rand(B, T).astype("float32")
+    lens = np.array([2, 4], "int32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data("x", shape=[T], dtype="float32")
+        lv = fluid.layers.data("lens", shape=[], dtype="int32")
+        out = fluid.layers.sequence_softmax(xv, seq_len=lv)
+    res = _run(main, startup, {"x": x, "lens": lens}, [out])[0]
+    assert res[0, 2] == 0 and res[0, 3] == 0
+    np.testing.assert_allclose(res.sum(1), 1.0, rtol=1e-5)
