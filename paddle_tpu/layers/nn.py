@@ -35,6 +35,7 @@ __all__ = [
     "reduce_prod",
     "matmul",
     "mul",
+    "fused_multihead_attention",
     "elementwise_add",
     "elementwise_sub",
     "elementwise_mul",
@@ -979,3 +980,26 @@ def cos_sim(X, Y):
     xn = l2_normalize(X, axis=-1)
     yn = l2_normalize(Y, axis=-1)
     return reduce_sum(elementwise_mul(xn, yn), dim=-1, keep_dim=True)
+
+
+def fused_multihead_attention(q, k, v, bias=None, causal=False, scale=None,
+                              name=None):
+    """Fused multi-head attention over [B, H, T, Dh] tensors; on TPU this
+    is a single Pallas flash-attention kernel (O(T) memory), elsewhere XLA
+    attention.  `bias` is an additive key bias ([B, Tk] or [B,1,1,Tk],
+    e.g. a padding mask); no gradient flows to it."""
+    helper = LayerHelper("fused_multihead_attention", **locals())
+    out = helper.create_variable_for_type_inference(q.dtype)
+    inputs = {"Q": [q], "K": [k], "V": [v]}
+    if bias is not None:
+        inputs["BiasQK"] = [bias]
+    attrs = {"causal": bool(causal)}
+    if scale is not None:
+        attrs["scale"] = float(scale)
+    helper.append_op(
+        type="fused_multihead_attention",
+        inputs=inputs,
+        outputs={"Out": [out]},
+        attrs=attrs,
+    )
+    return out

@@ -15,7 +15,8 @@ import paddle_tpu as fluid
 
 class BertConfig:
     def __init__(self, vocab_size=30522, hidden=768, layers=12, heads=12,
-                 ffn=3072, max_seq=512, type_vocab=2, dropout=0.1):
+                 ffn=3072, max_seq=512, type_vocab=2, dropout=0.1,
+                 attn_dropout=None, fuse_attn=True):
         self.vocab_size = vocab_size
         self.hidden = hidden
         self.layers = layers
@@ -24,6 +25,11 @@ class BertConfig:
         self.max_seq = max_seq
         self.type_vocab = type_vocab
         self.dropout = dropout
+        # attention-probability dropout; the fused flash-attention path
+        # requires 0 (as in production TPU flash attention), so configs
+        # that want the fused kernel set attn_dropout=0
+        self.attn_dropout = dropout if attn_dropout is None else attn_dropout
+        self.fuse_attn = fuse_attn
 
 
 BERT_BASE = BertConfig()
@@ -49,16 +55,22 @@ def _attention(x, mask_bias, cfg, prefix):
     q = split_heads(proj(x, d, "q"))
     k = split_heads(proj(x, d, "k"))
     v = split_heads(proj(x, d, "v"))
-    scores = fluid.layers.matmul(q, k, transpose_y=True,
-                                 alpha=1.0 / math.sqrt(dh))
-    if mask_bias is not None:
-        scores = fluid.layers.elementwise_add(scores, mask_bias)
-    probs = fluid.layers.softmax(scores)
-    if cfg.dropout:
-        probs = fluid.layers.dropout(
-            probs, cfg.dropout, dropout_implementation="upscale_in_train"
+    if cfg.fuse_attn and not cfg.attn_dropout:
+        ctx = fluid.layers.fused_multihead_attention(
+            q, k, v, bias=mask_bias, scale=1.0 / math.sqrt(dh)
         )
-    ctx = fluid.layers.matmul(probs, v)
+    else:
+        scores = fluid.layers.matmul(q, k, transpose_y=True,
+                                     alpha=1.0 / math.sqrt(dh))
+        if mask_bias is not None:
+            scores = fluid.layers.elementwise_add(scores, mask_bias)
+        probs = fluid.layers.softmax(scores)
+        if cfg.attn_dropout:
+            probs = fluid.layers.dropout(
+                probs, cfg.attn_dropout,
+                dropout_implementation="upscale_in_train"
+            )
+        ctx = fluid.layers.matmul(probs, v)
     ctx = fluid.layers.transpose(ctx, [0, 2, 1, 3])
     ctx = fluid.layers.reshape(ctx, [0, 0, d])
     return proj(ctx, d, "o")
@@ -134,6 +146,13 @@ def build_pretrain(cfg=BERT_BASE, seq_len=128, lr=1e-4, amp=False,
     """Masked-LM pretraining program.  Returns
     (main, startup, feed_names, loss).  With train=False only the forward
     loss graph is built (no grad/optimizer ops)."""
+    if not train:
+        # attention-prob dropout is inert at inference, so the fused
+        # flash-attention path applies regardless of the configured rate
+        import copy
+
+        cfg = copy.copy(cfg)
+        cfg.attn_dropout = 0.0
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         input_ids = fluid.layers.data("input_ids", shape=[seq_len],

@@ -437,3 +437,23 @@ def prelu(ctx, attrs, X, Alpha):
     else:
         a = Alpha.reshape((1,) + jnp.shape(X)[1:])
     return jnp.where(X >= 0, X, a * X)
+
+
+@register_op("fused_multihead_attention", inputs=["Q", "K", "V", "BiasQK"],
+             outputs=["Out"])
+def fused_multihead_attention(ctx, attrs, Q, K, V, BiasQK=None):
+    """Fused scaled-dot-product attention (reference analogue: the
+    fusion_* attention kernels under ``paddle/fluid/operators/fused/``).
+    Q,K,V: [B, H, T, Dh]; BiasQK: additive key bias [B, Tk] or
+    [B,1,1,Tk].  Lowered to the Pallas FlashAttention-2 TPU kernel when
+    profitable, XLA attention otherwise (ops/pallas/flash_attention.py);
+    its backward is the custom-vjp flash backward, reached through the
+    registry's generic jax.vjp grad derivation."""
+    from .pallas.flash_attention import flash_attention
+
+    causal = bool(attrs.get("causal", False))
+    scale = attrs.get("scale", None)
+    if scale is not None:
+        scale = float(scale)
+    return flash_attention(Q, K, V, bias=BiasQK, causal=causal,
+                           sm_scale=scale)

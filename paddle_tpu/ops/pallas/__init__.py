@@ -1,0 +1,11 @@
+"""Pallas TPU kernels for the hot ops.
+
+The reference keeps a hand-tuned native kernel library for its hot loops
+(x86 JIT codegen under ``paddle/fluid/operators/jit/``, fused CUDA kernels
+under ``operators/fused/``).  The TPU-native analogue is Pallas: kernels
+written against VMEM/MXU with explicit blocking, compiled by Mosaic.  Each
+kernel here ships with an XLA fallback so every op runs on any backend; the
+Pallas path is selected on TPU (or when interpret-mode testing is forced).
+"""
+
+from .flash_attention import flash_attention  # noqa: F401

@@ -1,0 +1,130 @@
+"""Flash-attention Pallas kernel tests (interpret mode on CPU) and the
+fused_multihead_attention op/layer, mirroring the reference OpTest pattern
+(`python/paddle/fluid/tests/unittests/op_test.py`): kernel vs XLA-reference
+oracle for forward and grads."""
+
+import os
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("PADDLE_TPU_PALLAS", "interpret")
+
+import jax
+import jax.numpy as jnp
+
+import importlib
+
+# the package __init__ re-exports the flash_attention *function* under the
+# same name as the module, so resolve the module explicitly
+FA = importlib.import_module("paddle_tpu.ops.pallas.flash_attention")
+
+
+def _rand(rng, *shape):
+    return jnp.asarray(rng.randn(*shape).astype("float32"))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("with_bias", [False, True])
+def test_kernel_fwd_bwd_single_block(causal, with_bias):
+    rng = np.random.RandomState(0)
+    B, H, T, D = 2, 2, 128, 64
+    q, k, v = (_rand(rng, B, H, T, D) for _ in range(3))
+    bias = None
+    if with_bias:
+        bias = jnp.asarray(
+            np.where(rng.rand(B, T) < 0.2, -1e4, 0).astype("float32")
+        )
+
+    o1 = FA.flash_attention(q, k, v, bias=bias, causal=causal)
+    o2 = FA.mha_reference(q, k, v, bias=bias, causal=causal)
+    np.testing.assert_allclose(o1, o2, atol=2e-5, rtol=2e-5)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(
+            fn(q, k, v, bias=bias, causal=causal) * v
+        )
+
+    g1 = jax.grad(loss(FA.flash_attention), argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss(FA.mha_reference), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(a, b, atol=5e-4, rtol=1e-3)
+
+
+def test_kernel_multiblock(monkeypatch):
+    """Multiple KV/Q blocks exercise the online-softmax accumulation and
+    the bwd sweep accumulators (plus the big-|bias| precision path that
+    motivated saving (m, l) instead of lse)."""
+    monkeypatch.setattr(FA, "_pick_blocks", lambda tq, tk: (64, 128))
+    rng = np.random.RandomState(1)
+    B, H, T, D = 2, 2, 256, 64
+    q, k, v = (_rand(rng, B, H, T, D) for _ in range(3))
+    bias = jnp.asarray(
+        np.where(rng.rand(B, T) < 0.2, -1e4, 0).astype("float32")
+    )
+    for causal in (False, True):
+        o1 = FA.flash_attention(q, k, v, bias=bias, causal=causal)
+        o2 = FA.mha_reference(q, k, v, bias=bias, causal=causal)
+        np.testing.assert_allclose(o1, o2, atol=2e-5, rtol=2e-5)
+    g1 = jax.grad(
+        lambda q, k, v: jnp.sum(
+            FA.flash_attention(q, k, v, bias=bias, causal=True) * v
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g2 = jax.grad(
+        lambda q, k, v: jnp.sum(
+            FA.mha_reference(q, k, v, bias=bias, causal=True) * v
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(a, b, atol=5e-4, rtol=1e-3)
+
+
+def test_fused_op_in_program():
+    """Program-level: fused_multihead_attention layer vs the unfused op
+    chain, both through the Executor, gradients included.  T=128 so the
+    Pallas kernel path (interpret mode) actually engages — this covers the
+    registry's generic jax.vjp grad over the kernel's custom_vjp."""
+    import paddle_tpu as fluid
+    from paddle_tpu.ops.pallas import flash_attention as _fa_fn  # noqa: F401
+
+    assert FA._kernel_applicable(
+        jnp.zeros((4, 128, 16)), jnp.zeros((4, 128, 16)), None
+    ), "test shapes must exercise the kernel path"
+
+    B, H, T, D = 1, 2, 128, 16
+    rng = np.random.RandomState(2)
+    qv = rng.randn(B, H, T, D).astype("float32")
+    kv = rng.randn(B, H, T, D).astype("float32")
+    vv = rng.randn(B, H, T, D).astype("float32")
+
+    def build(fused):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            q = fluid.layers.data("q", shape=[H, T, D])
+            k = fluid.layers.data("k", shape=[H, T, D])
+            v = fluid.layers.data("v", shape=[H, T, D])
+            q.stop_gradient = False
+            if fused:
+                out = fluid.layers.fused_multihead_attention(q, k, v)
+            else:
+                s = fluid.layers.matmul(
+                    q, k, transpose_y=True, alpha=1.0 / np.sqrt(D)
+                )
+                p = fluid.layers.softmax(s)
+                out = fluid.layers.matmul(p, v)
+            loss = fluid.layers.reduce_sum(out)
+            grads = fluid.backward.gradients([loss], [q])
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        return exe.run(
+            main, feed={"q": qv, "k": kv, "v": vv},
+            fetch_list=[out, grads[0]],
+        )
+
+    o_f, gq_f = build(True)
+    o_u, gq_u = build(False)
+    np.testing.assert_allclose(o_f, o_u, atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(gq_f, gq_u, atol=1e-4, rtol=1e-4)
