@@ -13,9 +13,9 @@ the XLA partitioner.
 
 import numpy as np
 
-from . import core
-from .executor import _CompiledBlock, global_scope
-from .framework import Variable, default_main_program
+from .. import core
+from ..executor import _CompiledBlock, global_scope
+from ..framework import Variable, default_main_program
 
 __all__ = ["ParallelExecutor", "SPMDRunner"]
 
@@ -110,3 +110,12 @@ class ParallelExecutor:
         return self._runner.run(
             self._exe, feed, fetch_list, self._scope, return_numpy
         )
+
+
+from .ring_attention import ring_attention, ring_attention_local  # noqa: E402,F401
+
+__all__ += ["ring_attention", "ring_attention_local"]
+
+from .pipeline import gpipe, gpipe_stage_params  # noqa: E402,F401
+
+__all__ += ["gpipe", "gpipe_stage_params"]

@@ -1,0 +1,119 @@
+"""Microbatched pipeline parallelism over a mesh axis (GPipe schedule).
+
+Reference analogue: ``PipelineOptimizer`` (``optimizer.py:2664``) +
+``PipelineTrainer``/``SectionWorker`` (``trainer.h:95``,
+``device_worker.h:240``) — the reference cuts the program into sections per
+device and streams scopes through blocking queues, with concurrency per
+section.
+
+TPU-native: the pipeline is a *single SPMD computation* under ``shard_map``
+over a ``pipe`` mesh axis.  Every device holds one stage's parameters
+(stacked pytree sharded on the leading dim); each tick every device applies
+the SAME traced stage function to its current activation, then the
+activations rotate one hop with ``lax.ppermute``; stage 0 ingests a fresh
+microbatch per tick and the last stage banks finished microbatches.  After
+M + n - 1 ticks all M microbatches are through — the GPipe fill/drain
+schedule, with the queues/threads of the reference replaced by XLA's
+static schedule and ICI transfers.
+
+Gradients: plain ``jax.grad`` through the scan — XLA's transpose runs the
+reverse schedule (drain/fill mirrored) with the same communication pattern.
+``remat=True`` (default) checkpoints each stage application so backward
+recomputes activations instead of storing every tick's intermediates (the
+standard GPipe memory trade).
+
+The stage function must be shape-uniform (activation in == activation out),
+which is the transformer-block case the reference pipeline targets too;
+embedding/head layers run outside the pipelined region.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["gpipe", "gpipe_stage_params"]
+
+
+def gpipe_stage_params(params_per_stage):
+    """Stack a list of per-stage parameter pytrees (identical structure)
+    into one pytree with a leading stage dim, ready to shard over the
+    pipeline axis."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *params_per_stage
+    )
+
+
+def gpipe(stage_fn, stage_params, x, mesh, axis_name, num_microbatches,
+          remat=True):
+    """Run ``num_microbatches`` microbatches through an n-stage pipeline.
+
+    stage_fn(params, x_mb) -> y_mb with y_mb.shape == x_mb.shape;
+    stage_params: pytree with leading dim n (one slice per stage, see
+    :func:`gpipe_stage_params`); x: [M, mb, ...] microbatched input
+    (M = num_microbatches); returns [M, mb, ...] outputs of the last stage.
+    """
+    n = mesh.shape[axis_name]
+    m = int(num_microbatches)
+    if x.shape[0] != m:
+        raise ValueError(
+            "x leading dim %d != num_microbatches %d" % (x.shape[0], m)
+        )
+    leaves = jax.tree_util.tree_leaves(stage_params)
+    for leaf in leaves:
+        if leaf.shape[0] != n:
+            raise ValueError(
+                "stage_params leading dim %d != pipeline depth %d"
+                % (leaf.shape[0], n)
+            )
+
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    from jax import shard_map
+
+    shift_perm = [(i, i + 1) for i in range(n - 1)]
+
+    def local(params, x_all):
+        idx = jax.lax.axis_index(axis_name)
+        my_params = jax.tree_util.tree_map(lambda p: p[0], params)
+
+        def body(carry, t):
+            state, outbuf = carry
+            # stage 0 ingests microbatch t (clamped; collection is gated)
+            mb_in = jax.lax.dynamic_index_in_dim(
+                x_all, jnp.clip(t, 0, m - 1), 0, keepdims=False
+            )
+            cur = jnp.where(idx == 0, mb_in, state)
+            out = fn(my_params, cur)
+            # last stage banks microbatch t-(n-1) once it's real
+            done_i = t - (n - 1)
+            banked = jax.lax.dynamic_update_index_in_dim(
+                outbuf, out, jnp.clip(done_i, 0, m - 1), 0
+            )
+            collect = jnp.logical_and(idx == n - 1, done_i >= 0)
+            outbuf = jnp.where(collect, banked, outbuf)
+            if n > 1:
+                state = jax.lax.ppermute(out, axis_name, shift_perm)
+            else:
+                state = out
+            return (state, outbuf), None
+
+        init = (jnp.zeros_like(x_all[0]), jnp.zeros_like(x_all))
+        (_, outbuf), _ = jax.lax.scan(
+            body, init, jnp.arange(m + n - 1), length=m + n - 1
+        )
+        # outbuf is populated on the last stage only; sum-replicate it
+        # (all other stages contribute zeros)
+        return jax.lax.psum(
+            jnp.where(idx == n - 1, outbuf, jnp.zeros_like(outbuf)),
+            axis_name,
+        )
+
+    spec_params = jax.tree_util.tree_map(lambda _: P(axis_name),
+                                         stage_params)
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(spec_params, P()), out_specs=P(),
+        check_vma=False,
+    )(stage_params, x)

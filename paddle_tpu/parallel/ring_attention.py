@@ -1,0 +1,173 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+The reference (2019) has no sequence parallelism — its long-sequence story
+is LoD dynamic batching (SURVEY.md §5).  This is the TPU-native net-new
+capability called for by the build brief: shard the sequence dimension of
+Q/K/V over a mesh axis, keep Q local, and rotate K/V shards around the ring
+with ``lax.ppermute`` while accumulating blockwise online-softmax partial
+results (the Ring Attention construction of Liu et al., built from the same
+(m, l, acc) merge the flash kernel uses).  Peak memory per chip is
+O(T_local * T_local) for one score chunk instead of O(T^2); compute and ICI
+transfer overlap because XLA pipelines the ppermute against the chunk
+matmuls.
+
+Two entry points:
+
+* :func:`ring_attention_local` — call INSIDE an existing ``shard_map``
+  (per-shard values, explicit axis name + static axis size);
+* :func:`ring_attention` — takes global [B,H,T,D] arrays and a mesh, wraps
+  the shard_map itself.
+
+As with the fused flash-attention op, the additive key bias is treated as a
+CONSTANT (padding masks are data): no gradient flows to it on any path.
+
+Gradients flow through ``lax.scan`` + ``ppermute`` transpose rules; the
+per-chunk score math is wrapped in ``jax.checkpoint`` so backward re-forms
+the [Tl, Tl] probability chunks instead of storing them.
+
+Causal masking uses global positions; whole above-diagonal chunks are
+skipped with ``lax.cond`` (devices later in the ring do proportionally
+less work — the standard non-load-balanced schedule).
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["ring_attention", "ring_attention_local"]
+
+
+def _merge(acc, m, l, o_c, m_c, l_c):
+    """Online-softmax merge of a new chunk's (unnormalized out, max, sum)."""
+    m_new = jnp.maximum(m, m_c)
+    a = jnp.exp(m - m_new)
+    a_c = jnp.exp(m_c - m_new)
+    return acc * a[..., None] + o_c * a_c[..., None], m_new, l * a + l_c * a_c
+
+
+@functools.partial(jax.checkpoint, static_argnums=(5, 6))
+def _chunk_scores(q32, kc, vc, bias_c, col0_row0, sm_scale, causal):
+    """(unnormalized out, rowmax, rowsum) of local Q against one K/V chunk.
+
+    q32 [B,H,Tq,D] f32; kc/vc [B,H,Tc,D]; bias_c [B,Tc] or None;
+    col0_row0 = (global col offset of this chunk, global row offset of Q).
+    """
+    col0, row0 = col0_row0
+    s = jnp.einsum("bhqd,bhkd->bhqk", q32, kc.astype(jnp.float32)) * sm_scale
+    if bias_c is not None:
+        s = s + bias_c[:, None, None, :].astype(jnp.float32)
+    if causal:
+        tq, tc = s.shape[-2], s.shape[-1]
+        rows = row0 + jax.lax.broadcasted_iota(jnp.int32, (tq, tc), 0)
+        cols = col0 + jax.lax.broadcasted_iota(jnp.int32, (tq, tc), 1)
+        s = jnp.where(rows >= cols, s, -1e30)
+    m_c = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m_c[..., None])
+    l_c = jnp.sum(p, axis=-1)
+    o_c = jnp.einsum("bhqk,bhkd->bhqd", p, vc.astype(jnp.float32))
+    return o_c, m_c, l_c
+
+
+def ring_attention_local(q, k, v, axis_name, axis_size, bias=None,
+                         causal=False, sm_scale=None):
+    """Ring attention over per-shard values (call inside shard_map).
+
+    q,k,v: [B,H,Tl,D] — the local sequence shard; bias: [B,Tl] additive
+    key bias shard (rotates with k/v); returns the local [B,H,Tl,D] output.
+    ``axis_size`` must be the static mesh-axis size.
+    """
+    n = int(axis_size)
+    d = q.shape[-1]
+    tl = q.shape[2]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    idx = jax.lax.axis_index(axis_name)
+    row0 = idx * tl
+    q32 = q.astype(jnp.float32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def body(carry, step):
+        acc, m, l, kc, vc, bc = carry
+        src = (idx - step) % n          # shard this K/V chunk started on
+        col0 = src * tl
+
+        def compute(args):
+            acc, m, l = args
+            o_c, m_c, l_c = _chunk_scores(
+                q32, kc, vc, bc, (col0, row0), sm_scale, causal
+            )
+            return _merge(acc, m, l, o_c, m_c, l_c)
+
+        if causal:
+            acc, m, l = jax.lax.cond(
+                src <= idx, compute, lambda args: args, (acc, m, l)
+            )
+        else:
+            acc, m, l = compute((acc, m, l))
+
+        if n > 1:
+            kc = jax.lax.ppermute(kc, axis_name, perm)
+            vc = jax.lax.ppermute(vc, axis_name, perm)
+            if bc is not None:
+                bc = jax.lax.ppermute(bc, axis_name, perm)
+        return (acc, m, l, kc, vc, bc), None
+
+    b, h = q.shape[0], q.shape[1]
+    init = (
+        jnp.zeros((b, h, tl, d), jnp.float32),
+        jnp.full((b, h, tl), -1e30, jnp.float32),
+        jnp.zeros((b, h, tl), jnp.float32),
+        k, v, bias,
+    )
+    (acc, m, l, _, _, _), _ = jax.lax.scan(
+        body, init, jnp.arange(n), length=n
+    )
+    # l > 0 always: the causal diagonal chunk (src == idx) is never skipped
+    # and every row sees at least its own position
+    return (acc / l[..., None]).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, axis_name, bias=None, causal=False,
+                   sm_scale=None, batch_axis=None):
+    """Ring attention over global arrays: shards the sequence dim of
+    q/k/v (and key-bias) over ``mesh[axis_name]`` and runs the ring.
+
+    q,k,v: [B,H,T,D] with T divisible by the axis size; bias: [B,T] or
+    [B,1,1,T] additive key bias; returns [B,H,T,D].  ``batch_axis``
+    optionally also shards the batch dim (dp x sp meshes) — the ring only
+    spans ``axis_name``; batch shards run independent rings.  ``bias`` is a
+    constant: no gradient flows to it (matching fused_multihead_attention).
+    """
+    from jax import shard_map
+
+    n = mesh.shape[axis_name]
+    t = q.shape[2]
+    if t % n:
+        raise ValueError(
+            "sequence length %d not divisible by mesh axis %r size %d"
+            % (t, axis_name, n)
+        )
+    if bias is not None and bias.ndim == 4:
+        bias = bias.reshape(bias.shape[0], bias.shape[-1])
+
+    seq = P(batch_axis, None, axis_name, None)
+    bspec = P(batch_axis, axis_name)
+
+    args = (q, k, v)
+    in_specs = (seq, seq, seq)
+    if bias is not None:
+        args += (jax.lax.stop_gradient(bias),)
+        in_specs += (bspec,)
+
+    def local(q, k, v, b=None):
+        return ring_attention_local(
+            q, k, v, axis_name, n, bias=b, causal=causal, sm_scale=sm_scale
+        )
+
+    fn = shard_map(local, mesh=mesh, in_specs=in_specs, out_specs=seq,
+                   check_vma=False)
+    return fn(*args)
