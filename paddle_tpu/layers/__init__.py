@@ -7,6 +7,7 @@ from . import ops
 from . import control_flow
 from . import sequence
 from . import metric_op
+from . import detection
 from . import learning_rate_scheduler
 from . import collective
 from . import math_op_patch  # noqa: F401  (Variable operator overloads)
@@ -18,6 +19,7 @@ from .ops import *  # noqa: F401,F403
 from .control_flow import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403
 from .metric_op import *  # noqa: F401,F403
+from .detection import *  # noqa: F401,F403
 from .learning_rate_scheduler import *  # noqa: F401,F403
 
 __all__ = (
@@ -28,5 +30,6 @@ __all__ = (
     + control_flow.__all__
     + sequence.__all__
     + metric_op.__all__
+    + detection.__all__
     + learning_rate_scheduler.__all__
 )

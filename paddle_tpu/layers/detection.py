@@ -1,0 +1,383 @@
+"""Detection layers (reference: ``python/paddle/fluid/layers/detection.py``).
+
+Graph-DSL wrappers over the detection op family (ops/detection.py).  The
+surface mirrors the reference's (prior_box :1381, density_prior_box :1495,
+multi_box_head :1650, anchor_generator :1902, box_coder :564, yolo_box :750,
+multiclass_nms :2381, box_clip :2200, iou_similarity :516, roi_align in
+nn.py, sigmoid_focal_loss :294, polygon_box_transform :676) with TPU-static
+shape semantics documented in ops/detection.py.
+"""
+
+import math
+
+from ..layer_helper import LayerHelper
+from ..framework import Variable
+
+__all__ = [
+    "prior_box",
+    "density_prior_box",
+    "anchor_generator",
+    "box_coder",
+    "box_clip",
+    "iou_similarity",
+    "yolo_box",
+    "multiclass_nms",
+    "roi_align",
+    "sigmoid_focal_loss",
+    "polygon_box_transform",
+    "detection_output",
+    "ssd_loss",
+    "multi_box_head",
+]
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, name=None,
+              min_max_aspect_ratios_order=False):
+    helper = LayerHelper("prior_box", **locals())
+    dtype = "float32"
+    if not isinstance(min_sizes, (list, tuple)):
+        min_sizes = [min_sizes]
+    attrs = {
+        "min_sizes": [float(v) for v in min_sizes],
+        "aspect_ratios": [float(v) for v in aspect_ratios],
+        "variances": [float(v) for v in variance],
+        "flip": flip,
+        "clip": clip,
+        "step_w": float(steps[0]),
+        "step_h": float(steps[1]),
+        "offset": float(offset),
+        "min_max_aspect_ratios_order": min_max_aspect_ratios_order,
+    }
+    if max_sizes:
+        if not isinstance(max_sizes, (list, tuple)):
+            max_sizes = [max_sizes]
+        assert len(max_sizes) == len(min_sizes), (
+            "prior_box: max_sizes must pair 1:1 with min_sizes "
+            "(got %d vs %d)" % (len(max_sizes), len(min_sizes)))
+        attrs["max_sizes"] = [float(v) for v in max_sizes]
+    box = helper.create_variable_for_type_inference(dtype)
+    var = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="prior_box",
+        inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [box], "Variances": [var]},
+        attrs=attrs,
+    )
+    box.stop_gradient = True
+    var.stop_gradient = True
+    return box, var
+
+
+def density_prior_box(input, image, densities, fixed_sizes, fixed_ratios,
+                      variance=(0.1, 0.1, 0.2, 0.2), clip=False,
+                      steps=(0.0, 0.0), offset=0.5, flatten_to_2d=False,
+                      name=None):
+    helper = LayerHelper("density_prior_box", **locals())
+    dtype = "float32"
+    box = helper.create_variable_for_type_inference(dtype)
+    var = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="density_prior_box",
+        inputs={"Input": [input], "Image": [image]},
+        outputs={"Boxes": [box], "Variances": [var]},
+        attrs={
+            "densities": [int(v) for v in densities],
+            "fixed_sizes": [float(v) for v in fixed_sizes],
+            "fixed_ratios": [float(v) for v in fixed_ratios],
+            "variances": [float(v) for v in variance],
+            "clip": clip,
+            "step_w": float(steps[0]),
+            "step_h": float(steps[1]),
+            "offset": float(offset),
+        },
+    )
+    if flatten_to_2d:
+        from .nn import reshape
+
+        box = reshape(box, shape=[-1, 4])
+        var = reshape(var, shape=[-1, 4])
+    box.stop_gradient = True
+    var.stop_gradient = True
+    return box, var
+
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=None, offset=0.5,
+                     name=None):
+    helper = LayerHelper("anchor_generator", **locals())
+    dtype = "float32"
+    anchor_sizes = anchor_sizes or [64.0, 128.0, 256.0, 512.0]
+    aspect_ratios = aspect_ratios or [0.5, 1.0, 2.0]
+    stride = stride or [16.0, 16.0]
+    anchors = helper.create_variable_for_type_inference(dtype)
+    var = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="anchor_generator",
+        inputs={"Input": [input]},
+        outputs={"Anchors": [anchors], "Variances": [var]},
+        attrs={
+            "anchor_sizes": [float(v) for v in anchor_sizes],
+            "aspect_ratios": [float(v) for v in aspect_ratios],
+            "variances": [float(v) for v in variance],
+            "stride": [float(v) for v in stride],
+            "offset": float(offset),
+        },
+    )
+    anchors.stop_gradient = True
+    var.stop_gradient = True
+    return anchors, var
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, name=None,
+              axis=0):
+    helper = LayerHelper("box_coder", **locals())
+    out = helper.create_variable_for_type_inference(target_box.dtype)
+    inputs = {"PriorBox": [prior_box], "TargetBox": [target_box]}
+    attrs = {"code_type": code_type, "box_normalized": box_normalized,
+             "axis": axis}
+    if isinstance(prior_box_var, Variable):
+        inputs["PriorBoxVar"] = [prior_box_var]
+    elif isinstance(prior_box_var, (list, tuple)):
+        attrs["variance"] = [float(v) for v in prior_box_var]
+    helper.append_op(
+        type="box_coder", inputs=inputs, outputs={"OutputBox": [out]},
+        attrs=attrs)
+    return out
+
+
+def box_clip(input, im_info, name=None):
+    helper = LayerHelper("box_clip", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="box_clip",
+        inputs={"Input": [input], "ImInfo": [im_info]},
+        outputs={"Output": [out]},
+    )
+    return out
+
+
+def iou_similarity(x, y, box_normalized=True, name=None):
+    helper = LayerHelper("iou_similarity", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="iou_similarity",
+        inputs={"X": [x], "Y": [y]},
+        outputs={"Out": [out]},
+        attrs={"box_normalized": box_normalized},
+    )
+    return out
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             name=None):
+    helper = LayerHelper("yolo_box", **locals())
+    boxes = helper.create_variable_for_type_inference(x.dtype)
+    scores = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="yolo_box",
+        inputs={"X": [x], "ImgSize": [img_size]},
+        outputs={"Boxes": [boxes], "Scores": [scores]},
+        attrs={
+            "anchors": [int(v) for v in anchors],
+            "class_num": int(class_num),
+            "conf_thresh": float(conf_thresh),
+            "downsample_ratio": int(downsample_ratio),
+        },
+    )
+    boxes.stop_gradient = True
+    scores.stop_gradient = True
+    return boxes, scores
+
+
+def multiclass_nms(bboxes, scores, score_threshold, nms_top_k, keep_top_k,
+                   nms_threshold=0.3, normalized=True, nms_eta=1.0,
+                   background_label=0, name=None, return_rois_num=False):
+    """Fixed-shape NMS: Out is [N, keep_top_k, 6] padded with -1 rows
+    (the reference returns a ragged LoDTensor — see ops/detection.py)."""
+    helper = LayerHelper("multiclass_nms", **locals())
+    out = helper.create_variable_for_type_inference(bboxes.dtype)
+    num = helper.create_variable_for_type_inference("int32")
+    helper.append_op(
+        type="multiclass_nms",
+        inputs={"BBoxes": [bboxes], "Scores": [scores]},
+        outputs={"Out": [out], "NmsRoisNum": [num]},
+        attrs={
+            "background_label": int(background_label),
+            "score_threshold": float(score_threshold),
+            "nms_top_k": int(nms_top_k),
+            "keep_top_k": int(keep_top_k),
+            "nms_threshold": float(nms_threshold),
+            "nms_eta": float(nms_eta),
+            "normalized": normalized,
+        },
+    )
+    out.stop_gradient = True
+    num.stop_gradient = True
+    if return_rois_num:
+        return out, num
+    return out
+
+
+def roi_align(input, rois, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, rois_num=None, name=None):
+    helper = LayerHelper("roi_align", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    inputs = {"X": [input], "ROIs": [rois]}
+    if rois_num is not None:
+        inputs["RoisNum"] = [rois_num]
+    helper.append_op(
+        type="roi_align",
+        inputs=inputs,
+        outputs={"Out": [out]},
+        attrs={
+            "pooled_height": int(pooled_height),
+            "pooled_width": int(pooled_width),
+            "spatial_scale": float(spatial_scale),
+            "sampling_ratio": int(sampling_ratio),
+        },
+    )
+    return out
+
+
+def sigmoid_focal_loss(x, label, fg_num, gamma=2.0, alpha=0.25, name=None):
+    helper = LayerHelper("sigmoid_focal_loss", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="sigmoid_focal_loss",
+        inputs={"X": [x], "Label": [label], "FgNum": [fg_num]},
+        outputs={"Out": [out]},
+        attrs={"gamma": float(gamma), "alpha": float(alpha)},
+    )
+    return out
+
+
+def polygon_box_transform(input, name=None):
+    helper = LayerHelper("polygon_box_transform", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="polygon_box_transform",
+        inputs={"Input": [input]},
+        outputs={"Output": [out]},
+    )
+    return out
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0,
+                     return_rois_num=False):
+    """SSD inference head (reference detection.py:440): decode loc against
+    priors, then multiclass NMS.  loc [N, P, 4]; scores [N, P, C];
+    prior_box [P, 4] (flattened)."""
+    from .nn import transpose
+
+    decoded = box_coder(
+        prior_box=prior_box,
+        prior_box_var=prior_box_var,
+        target_box=loc,
+        code_type="decode_center_size",
+    )
+    cls_scores = transpose(scores, perm=[0, 2, 1])  # [N, C, P]
+    return multiclass_nms(
+        bboxes=decoded,
+        scores=cls_scores,
+        score_threshold=score_threshold,
+        nms_top_k=nms_top_k,
+        keep_top_k=keep_top_k,
+        nms_threshold=nms_threshold,
+        nms_eta=nms_eta,
+        background_label=background_label,
+        return_rois_num=return_rois_num,
+    )
+
+
+def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
+             prior_box_var=None, background_label=0, overlap_threshold=0.5,
+             neg_pos_ratio=3.0, neg_overlap=0.5, loc_loss_weight=1.0,
+             conf_loss_weight=1.0, mining_type="max_negative",
+             normalize=True, sample_size=None):
+    """Simplified SSD training loss with static shapes.
+
+    The reference composes bipartite_match + target_assign +
+    mine_hard_examples (detection.py:1074).  TPU-static version: per-prior
+    argmax matching against padded gt boxes (gt padded with zero-area boxes,
+    label slot required to be [N, G] with -1 padding), hard-negative mining
+    by per-image top-k over a static negative budget.
+    """
+    raise NotImplementedError(
+        "ssd_loss composite lands with the SSD model; use "
+        "iou_similarity/box_coder/sigmoid_focal_loss directly")
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD multi-feature-map head (reference detection.py:1650): per input
+    feature map, a conv predicting loc+conf and a prior_box; results are
+    flattened and concatenated."""
+    from .nn import conv2d, transpose, reshape, concat
+
+    n_layer = len(inputs)
+    if n_layer <= 2:
+        # reference requires explicit sizes for <=2 maps (detection.py:1650)
+        assert min_sizes is not None and max_sizes is not None, (
+            "multi_box_head with <=2 feature maps needs explicit "
+            "min_sizes/max_sizes")
+    elif min_sizes is None:
+        # reference formula: evenly spaced ratios of base_size
+        min_sizes, max_sizes = [], []
+        step = int(math.floor((max_ratio - min_ratio) / (n_layer - 2)))
+        for ratio in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes
+        max_sizes = [base_size * 0.2] + max_sizes
+
+    locs, confs, boxes_l, vars_l = [], [], [], []
+    for i, input in enumerate(inputs):
+        min_size = min_sizes[i]
+        max_size = max_sizes[i] if max_sizes else None
+        if not isinstance(min_size, list):
+            min_size = [min_size]
+        if max_size is not None and not isinstance(max_size, list):
+            max_size = [max_size]
+        aspect_ratio = aspect_ratios[i]
+        if not isinstance(aspect_ratio, list):
+            aspect_ratio = [aspect_ratio]
+        step = [step_w[i] if step_w else 0.0, step_h[i] if step_h else 0.0] \
+            if (step_w or step_h) else (steps[i] if steps else [0.0, 0.0])
+        if not isinstance(step, (list, tuple)):
+            step = [step, step]
+
+        box, var = prior_box(
+            input, image, min_size, max_size, aspect_ratio, variance, flip,
+            clip, step, offset,
+            min_max_aspect_ratios_order=min_max_aspect_ratios_order)
+        num_priors_per_cell = box.shape[2]
+
+        num_loc_output = num_priors_per_cell * 4
+        mbox_loc = conv2d(input, num_filters=num_loc_output,
+                          filter_size=kernel_size, padding=pad, stride=stride)
+        mbox_loc = transpose(mbox_loc, perm=[0, 2, 3, 1])
+        locs.append(reshape(mbox_loc, shape=[0, -1, 4]))
+
+        num_conf_output = num_priors_per_cell * num_classes
+        conf = conv2d(input, num_filters=num_conf_output,
+                      filter_size=kernel_size, padding=pad, stride=stride)
+        conf = transpose(conf, perm=[0, 2, 3, 1])
+        confs.append(reshape(conf, shape=[0, -1, num_classes]))
+
+        boxes_l.append(reshape(box, shape=[-1, 4]))
+        vars_l.append(reshape(var, shape=[-1, 4]))
+
+    mbox_locs = concat(locs, axis=1)
+    mbox_confs = concat(confs, axis=1)
+    box = concat(boxes_l, axis=0)
+    var = concat(vars_l, axis=0)
+    return mbox_locs, mbox_confs, box, var

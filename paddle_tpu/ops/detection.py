@@ -1,0 +1,688 @@
+"""Detection ops: SSD/YOLO/RPN box generation, coding, NMS, ROI pooling.
+
+Reference: ``paddle/fluid/operators/detection/`` (prior_box_op.h,
+box_coder_op.h, yolo_box_op.h, multiclass_nms_op.cc, iou_similarity_op.h,
+box_clip_op.h, anchor_generator_op.h, density_prior_box_op.h,
+sigmoid_focal_loss_op.cc, polygon_box_transform_op.cc) and
+``paddle/fluid/operators/roi_align_op.cc``.
+
+TPU-native design notes:
+
+* All shapes are static.  The reference's ``multiclass_nms`` emits a
+  variable-row LoDTensor ``[M, 6]``; here the output is a fixed
+  ``[N, keep_top_k, 6]`` tensor padded with rows of ``-1`` (the reference
+  itself uses ``label = -1`` rows to signal "no detection"), plus an
+  ``NmsRoisNum``-style count output.  Downstream consumers mask on
+  ``label >= 0``.
+* NMS is the classic greedy suppression re-expressed as a
+  ``lax.fori_loop`` over a statically sized candidate set with an O(k²)
+  IoU matrix — sequential dependencies live in a tiny boolean carry while
+  the heavy work (IoU matrix) is one batched computation on the MXU-adjacent
+  vector unit; classes and batch are handled by ``vmap``.
+* ``roi_align`` is expressed with gather-based bilinear interpolation so the
+  whole op is differentiable w.r.t. ``X`` via the registry's generic vjp;
+  the data-dependent adaptive sampling grid of the reference
+  (``sampling_ratio <= 0`` → ``ceil(roi_size/pooled_size)``) is replaced by
+  a static grid (``sampling_ratio`` when positive, else 2) because XLA
+  requires static shapes.  Batch membership of ROIs comes from an explicit
+  ``RoisNum`` [B] companion instead of LoD offsets (sequence-op convention).
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _expand_aspect_ratios(aspect_ratios, flip):
+    """prior_box_op.h:28 ExpandAspectRatios: dedup, prepend 1.0, add 1/r."""
+    out = [1.0]
+    for ar in aspect_ratios:
+        if any(abs(ar - o) < 1e-6 for o in out):
+            continue
+        out.append(float(ar))
+        if flip:
+            out.append(1.0 / float(ar))
+    return out
+
+
+def _box_area(boxes, normalized):
+    w = boxes[..., 2] - boxes[..., 0]
+    h = boxes[..., 3] - boxes[..., 1]
+    if not normalized:
+        w = w + 1.0
+        h = h + 1.0
+    area = w * h
+    # invalid box (xmax < xmin) → 0 (multiclass_nms_op.cc BBoxArea)
+    valid = (boxes[..., 2] >= boxes[..., 0]) & (boxes[..., 3] >= boxes[..., 1])
+    return jnp.where(valid, area, 0.0)
+
+
+def _pairwise_iou(a, b, normalized):
+    """[..., Na, 4] x [..., Nb, 4] -> [..., Na, Nb] Jaccard overlap."""
+    norm = 0.0 if normalized else 1.0
+    xmin = jnp.maximum(a[..., :, None, 0], b[..., None, :, 0])
+    ymin = jnp.maximum(a[..., :, None, 1], b[..., None, :, 1])
+    xmax = jnp.minimum(a[..., :, None, 2], b[..., None, :, 2])
+    ymax = jnp.minimum(a[..., :, None, 3], b[..., None, :, 3])
+    iw = jnp.maximum(xmax - xmin + norm, 0.0)
+    ih = jnp.maximum(ymax - ymin + norm, 0.0)
+    inter = iw * ih
+    area_a = _box_area(a, normalized)[..., :, None]
+    area_b = _box_area(b, normalized)[..., None, :]
+    union = area_a + area_b - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# prior / anchor generation
+# ---------------------------------------------------------------------------
+
+def _prior_box_shapes(min_sizes, max_sizes, aspect_ratios, flip):
+    ars = _expand_aspect_ratios(aspect_ratios, flip)
+    num = len(ars) * len(min_sizes) + len(max_sizes)
+    return ars, num
+
+
+@register_op("prior_box", inputs=["Input", "Image"],
+             outputs=["Boxes", "Variances"], no_grad=True)
+def prior_box(ctx, attrs, Input, Image):
+    """SSD prior boxes (prior_box_op.h:52).  Out: [H, W, P, 4] each."""
+    min_sizes = [float(v) for v in attrs.get("min_sizes", [])]
+    max_sizes = [float(v) for v in attrs.get("max_sizes", [])]
+    variances = [float(v) for v in attrs.get("variances", [0.1, 0.1, 0.2, 0.2])]
+    flip = bool(attrs.get("flip", False))
+    clip = bool(attrs.get("clip", False))
+    step_w = float(attrs.get("step_w", 0.0))
+    step_h = float(attrs.get("step_h", 0.0))
+    offset = float(attrs.get("offset", 0.5))
+    mmar_order = bool(attrs.get("min_max_aspect_ratios_order", False))
+
+    ars, num_priors = _prior_box_shapes(
+        min_sizes, max_sizes, attrs.get("aspect_ratios", []), flip)
+
+    img_h, img_w = Image.shape[2], Image.shape[3]
+    feat_h, feat_w = Input.shape[2], Input.shape[3]
+    step_width = step_w if step_w else img_w / feat_w
+    step_height = step_h if step_h else img_h / feat_h
+
+    # per-prior half extents (static python lists, ordering per reference)
+    half_w, half_h = [], []
+    for s, mn in enumerate(min_sizes):
+        per_min_w, per_min_h = [], []
+        for ar in ars:
+            per_min_w.append(mn * math.sqrt(ar) / 2.0)
+            per_min_h.append(mn / math.sqrt(ar) / 2.0)
+        if mmar_order:
+            # min, [max], then ratios != 1
+            half_w.append(per_min_w[0]); half_h.append(per_min_h[0])
+            if max_sizes:
+                sq = math.sqrt(mn * max_sizes[s]) / 2.0
+                half_w.append(sq); half_h.append(sq)
+            for ar, w_, h_ in zip(ars, per_min_w, per_min_h):
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                half_w.append(w_); half_h.append(h_)
+        else:
+            half_w.extend(per_min_w); half_h.extend(per_min_h)
+            if max_sizes:
+                sq = math.sqrt(mn * max_sizes[s]) / 2.0
+                half_w.append(sq); half_h.append(sq)
+
+    hw = jnp.asarray(half_w, jnp.float32)  # [P]
+    hh = jnp.asarray(half_h, jnp.float32)
+
+    cx = (jnp.arange(feat_w, dtype=jnp.float32) + offset) * step_width   # [W]
+    cy = (jnp.arange(feat_h, dtype=jnp.float32) + offset) * step_height  # [H]
+    cx = cx[None, :, None]  # [1, W, 1]
+    cy = cy[:, None, None]  # [H, 1, 1]
+    boxes = jnp.stack(
+        [
+            jnp.broadcast_to((cx - hw) / img_w, (feat_h, feat_w, len(half_w))),
+            jnp.broadcast_to((cy - hh) / img_h, (feat_h, feat_w, len(half_w))),
+            jnp.broadcast_to((cx + hw) / img_w, (feat_h, feat_w, len(half_w))),
+            jnp.broadcast_to((cy + hh) / img_h, (feat_h, feat_w, len(half_w))),
+        ],
+        axis=-1,
+    )
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(
+        jnp.asarray(variances, jnp.float32), (feat_h, feat_w, num_priors, 4)
+    )
+    return boxes, var
+
+
+@register_op("density_prior_box", inputs=["Input", "Image"],
+             outputs=["Boxes", "Variances"], no_grad=True)
+def density_prior_box(ctx, attrs, Input, Image):
+    """Densified priors (density_prior_box_op.h): each fixed_size is tiled
+    density×density per cell with shifts.  Out: [H, W, P, 4]."""
+    fixed_sizes = [float(v) for v in attrs.get("fixed_sizes", [])]
+    fixed_ratios = [float(v) for v in attrs.get("fixed_ratios", [1.0])]
+    densities = [int(v) for v in attrs.get("densities", [])]
+    variances = [float(v) for v in attrs.get("variances", [0.1, 0.1, 0.2, 0.2])]
+    clip = bool(attrs.get("clip", False))
+    step_w = float(attrs.get("step_w", 0.0))
+    step_h = float(attrs.get("step_h", 0.0))
+    offset = float(attrs.get("offset", 0.5))
+
+    img_h, img_w = Image.shape[2], Image.shape[3]
+    feat_h, feat_w = Input.shape[2], Input.shape[3]
+    step_width = step_w if step_w else img_w / feat_w
+    step_height = step_h if step_h else img_h / feat_h
+
+    # per-prior (shift_x, shift_y, half_w, half_h) relative to cell center;
+    # both axes shift by step_average (density_prior_box_op.h:69,91 — int
+    # truncation kept for parity)
+    step_average = int((step_width + step_height) * 0.5)
+    sx, sy, hw, hh = [], [], [], []
+    for size, density in zip(fixed_sizes, densities):
+        shift = int(step_average / density)
+        for ratio in fixed_ratios:
+            bw = size * math.sqrt(ratio) / 2.0
+            bh = size / math.sqrt(ratio) / 2.0
+            for di in range(density):
+                for dj in range(density):
+                    sx.append(-step_average / 2.0 + shift / 2.0 + dj * shift)
+                    sy.append(-step_average / 2.0 + shift / 2.0 + di * shift)
+                    hw.append(bw)
+                    hh.append(bh)
+    P = len(sx)
+    sx = jnp.asarray(sx, jnp.float32)
+    sy = jnp.asarray(sy, jnp.float32)
+    hw = jnp.asarray(hw, jnp.float32)
+    hh = jnp.asarray(hh, jnp.float32)
+
+    cx = (jnp.arange(feat_w, dtype=jnp.float32) + offset) * step_width
+    cy = (jnp.arange(feat_h, dtype=jnp.float32) + offset) * step_height
+    cx = cx[None, :, None] + sx  # [1, W, P]
+    cy = cy[:, None, None] + sy  # [H, 1, P]
+    boxes = jnp.stack(
+        [
+            jnp.broadcast_to((cx - hw) / img_w, (feat_h, feat_w, P)),
+            jnp.broadcast_to((cy - hh) / img_h, (feat_h, feat_w, P)),
+            jnp.broadcast_to((cx + hw) / img_w, (feat_h, feat_w, P)),
+            jnp.broadcast_to((cy + hh) / img_h, (feat_h, feat_w, P)),
+        ],
+        axis=-1,
+    )
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(
+        jnp.asarray(variances, jnp.float32), (feat_h, feat_w, P, 4)
+    )
+    return boxes, var
+
+
+@register_op("anchor_generator", inputs=["Input"],
+             outputs=["Anchors", "Variances"], no_grad=True)
+def anchor_generator(ctx, attrs, Input):
+    """RPN anchors in absolute pixels (anchor_generator_op.h).
+    Out: [H, W, A, 4]."""
+    anchor_sizes = [float(v) for v in attrs.get("anchor_sizes", [64., 128., 256., 512.])]
+    aspect_ratios = [float(v) for v in attrs.get("aspect_ratios", [0.5, 1.0, 2.0])]
+    variances = [float(v) for v in attrs.get("variances", [0.1, 0.1, 0.2, 0.2])]
+    stride = [float(v) for v in attrs.get("stride", [16.0, 16.0])]
+    offset = float(attrs.get("offset", 0.5))
+
+    feat_h, feat_w = Input.shape[2], Input.shape[3]
+    hw, hh = [], []
+    for ar in aspect_ratios:
+        for size in anchor_sizes:
+            area = stride[0] * stride[1]
+            area_ratios = area / ar
+            base_w = round(math.sqrt(area_ratios))
+            base_h = round(base_w * ar)
+            scale_w = size / stride[0]
+            scale_h = size / stride[1]
+            hw.append(0.5 * (scale_w * base_w - 1))
+            hh.append(0.5 * (scale_h * base_h - 1))
+    A = len(hw)
+    hw = jnp.asarray(hw, jnp.float32)
+    hh = jnp.asarray(hh, jnp.float32)
+    cx = (jnp.arange(feat_w, dtype=jnp.float32) * stride[0] + offset * stride[0])[None, :, None]
+    cy = (jnp.arange(feat_h, dtype=jnp.float32) * stride[1] + offset * stride[1])[:, None, None]
+    anchors = jnp.stack(
+        [
+            jnp.broadcast_to(cx - hw, (feat_h, feat_w, A)),
+            jnp.broadcast_to(cy - hh, (feat_h, feat_w, A)),
+            jnp.broadcast_to(cx + hw, (feat_h, feat_w, A)),
+            jnp.broadcast_to(cy + hh, (feat_h, feat_w, A)),
+        ],
+        axis=-1,
+    )
+    var = jnp.broadcast_to(
+        jnp.asarray(variances, jnp.float32), (feat_h, feat_w, A, 4)
+    )
+    return anchors, var
+
+
+# ---------------------------------------------------------------------------
+# box coding / clipping / IoU
+# ---------------------------------------------------------------------------
+
+def _center_size(boxes, normalized):
+    norm = 0.0 if normalized else 1.0
+    w = boxes[..., 2] - boxes[..., 0] + norm
+    h = boxes[..., 3] - boxes[..., 1] + norm
+    cx = boxes[..., 0] + w / 2.0
+    cy = boxes[..., 1] + h / 2.0
+    return cx, cy, w, h
+
+
+@register_op("box_coder", inputs=["PriorBox", "PriorBoxVar", "TargetBox"],
+             outputs=["OutputBox"])
+def box_coder(ctx, attrs, PriorBox, PriorBoxVar, TargetBox):
+    """Encode/decode center-size box deltas (box_coder_op.h).
+
+    encode: TargetBox [R, 4], PriorBox [C, 4] → [R, C, 4]
+    decode: TargetBox [R, C, 4], PriorBox [C, 4] (axis=0) or [R, 4] (axis=1)
+            → [R, C, 4]
+    """
+    code_type = attrs.get("code_type", "encode_center_size")
+    normalized = bool(attrs.get("box_normalized", True))
+    axis = int(attrs.get("axis", 0))
+    variance = [float(v) for v in attrs.get("variance", [])]
+
+    pcx, pcy, pw, ph = _center_size(PriorBox, normalized)
+
+    if code_type == "encode_center_size":
+        tcx = (TargetBox[:, 2] + TargetBox[:, 0]) / 2.0
+        tcy = (TargetBox[:, 3] + TargetBox[:, 1]) / 2.0
+        norm = 0.0 if normalized else 1.0
+        tw = TargetBox[:, 2] - TargetBox[:, 0] + norm
+        th = TargetBox[:, 3] - TargetBox[:, 1] + norm
+        # [R, C]
+        dx = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        dy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        dw = jnp.log(jnp.abs(tw[:, None] / pw[None, :]))
+        dh = jnp.log(jnp.abs(th[:, None] / ph[None, :]))
+        out = jnp.stack([dx, dy, dw, dh], axis=-1)  # [R, C, 4]
+        if PriorBoxVar is not None:
+            out = out / PriorBoxVar[None, :, :]
+        elif variance:
+            out = out / jnp.asarray(variance, out.dtype)
+        return out
+
+    # decode_center_size: prior broadcast along `axis`
+    if axis == 0:
+        pcx_b, pcy_b = pcx[None, :], pcy[None, :]
+        pw_b, ph_b = pw[None, :], ph[None, :]
+        var_b = PriorBoxVar[None, :, :] if PriorBoxVar is not None else None
+    else:
+        pcx_b, pcy_b = pcx[:, None], pcy[:, None]
+        pw_b, ph_b = pw[:, None], ph[:, None]
+        var_b = PriorBoxVar[:, None, :] if PriorBoxVar is not None else None
+
+    t = TargetBox
+    if var_b is not None:
+        v = var_b
+    elif variance:
+        v = jnp.asarray(variance, t.dtype)
+    else:
+        v = jnp.ones((4,), t.dtype)
+    dcx = v[..., 0] * t[..., 0] * pw_b + pcx_b
+    dcy = v[..., 1] * t[..., 1] * ph_b + pcy_b
+    dw = jnp.exp(v[..., 2] * t[..., 2]) * pw_b
+    dh = jnp.exp(v[..., 3] * t[..., 3]) * ph_b
+    norm = 0.0 if normalized else 1.0
+    out = jnp.stack(
+        [
+            dcx - dw / 2.0,
+            dcy - dh / 2.0,
+            dcx + dw / 2.0 - norm,
+            dcy + dh / 2.0 - norm,
+        ],
+        axis=-1,
+    )
+    return out
+
+
+@register_op("box_clip", inputs=["Input", "ImInfo"], outputs=["Output"])
+def box_clip(ctx, attrs, Input, ImInfo):
+    """Clip boxes to image bounds (box_clip_op.h).  Input [B, R, 4] or
+    [R, 4] (then ImInfo row 0 is used); ImInfo [B, 3] = (h, w, scale)."""
+    boxes = Input
+    squeeze = False
+    if boxes.ndim == 2:
+        boxes = boxes[None]
+        squeeze = True
+    # reference rounds the recovered extents (box_clip_op.h)
+    im_h = jnp.round(ImInfo[:, 0] / ImInfo[:, 2])
+    im_w = jnp.round(ImInfo[:, 1] / ImInfo[:, 2])
+    xmax = (im_w - 1.0)[:, None]
+    ymax = (im_h - 1.0)[:, None]
+    out = jnp.stack(
+        [
+            jnp.minimum(jnp.maximum(boxes[..., 0], 0.0), xmax),
+            jnp.minimum(jnp.maximum(boxes[..., 1], 0.0), ymax),
+            jnp.minimum(jnp.maximum(boxes[..., 2], 0.0), xmax),
+            jnp.minimum(jnp.maximum(boxes[..., 3], 0.0), ymax),
+        ],
+        axis=-1,
+    )
+    return out[0] if squeeze else out
+
+
+@register_op("iou_similarity", inputs=["X", "Y"], outputs=["Out"])
+def iou_similarity(ctx, attrs, X, Y):
+    """Pairwise IoU [N, M] (iou_similarity_op.h)."""
+    normalized = bool(attrs.get("box_normalized", True))
+    return _pairwise_iou(X, Y, normalized)
+
+
+@register_op("polygon_box_transform", inputs=["Input"], outputs=["Output"],
+             no_grad=True)
+def polygon_box_transform(ctx, attrs, Input):
+    """EAST-style offset→vertex transform (polygon_box_transform_op.cc):
+    out[b, 2k, h, w]   = 4*w_idx - in[b, 2k, h, w]
+    out[b, 2k+1, h, w] = 4*h_idx - in[b, 2k+1, h, w]."""
+    B, C, H, W = Input.shape
+    wi = jnp.arange(W, dtype=Input.dtype)[None, None, None, :]
+    hi = jnp.arange(H, dtype=Input.dtype)[None, None, :, None]
+    even = jnp.arange(C) % 2 == 0
+    grid = jnp.where(even[None, :, None, None], 4.0 * wi, 4.0 * hi)
+    return grid - Input
+
+
+# ---------------------------------------------------------------------------
+# YOLO box decoding
+# ---------------------------------------------------------------------------
+
+@register_op("yolo_box", inputs=["X", "ImgSize"], outputs=["Boxes", "Scores"],
+             no_grad=True)
+def yolo_box(ctx, attrs, X, ImgSize):
+    """Decode YOLOv3 head output (yolo_box_op.h:46 GetYoloBox).
+
+    X: [N, A*(5+C), H, W]; ImgSize: [N, 2] (h, w) int.
+    Boxes: [N, A*H*W, 4]; Scores: [N, A*H*W, C].
+    """
+    anchors = [int(v) for v in attrs.get("anchors", [])]
+    class_num = int(attrs["class_num"])
+    conf_thresh = float(attrs.get("conf_thresh", 0.01))
+    downsample = int(attrs.get("downsample_ratio", 32))
+
+    N, _, H, W = X.shape
+    A = len(anchors) // 2
+    input_size = downsample * H
+
+    x = X.reshape(N, A, 5 + class_num, H, W)
+    img_h = ImgSize[:, 0].astype(jnp.float32)[:, None, None, None]
+    img_w = ImgSize[:, 1].astype(jnp.float32)[:, None, None, None]
+
+    gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+    an_w = jnp.asarray(anchors[0::2], jnp.float32)[None, :, None, None]
+    an_h = jnp.asarray(anchors[1::2], jnp.float32)[None, :, None, None]
+
+    cx = (gx + jax.nn.sigmoid(x[:, :, 0])) * img_w / W
+    cy = (gy + jax.nn.sigmoid(x[:, :, 1])) * img_h / H
+    bw = jnp.exp(x[:, :, 2]) * an_w * img_w / input_size
+    bh = jnp.exp(x[:, :, 3]) * an_h * img_h / input_size
+
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    valid = conf >= conf_thresh
+
+    xmin = jnp.maximum(cx - bw / 2.0, 0.0)
+    ymin = jnp.maximum(cy - bh / 2.0, 0.0)
+    xmax = jnp.minimum(cx + bw / 2.0, img_w - 1.0)
+    ymax = jnp.minimum(cy + bh / 2.0, img_h - 1.0)
+    boxes = jnp.stack([xmin, ymin, xmax, ymax], axis=-1)  # [N, A, H, W, 4]
+    boxes = jnp.where(valid[..., None], boxes, 0.0)
+
+    scores = conf[..., None] * jax.nn.sigmoid(
+        jnp.moveaxis(x[:, :, 5:], 2, -1)
+    )  # [N, A, H, W, C]
+    scores = jnp.where(valid[..., None], scores, 0.0)
+
+    return (
+        boxes.reshape(N, A * H * W, 4),
+        scores.reshape(N, A * H * W, class_num),
+    )
+
+
+# ---------------------------------------------------------------------------
+# NMS
+# ---------------------------------------------------------------------------
+
+def _nms_single_class(boxes, scores, score_threshold, nms_threshold, eta,
+                      top_k, normalized):
+    """Greedy NMS over one class (multiclass_nms_op.cc NMSFast).
+
+    boxes [R, 4], scores [R] → keep mask [K] + (scores, boxes) of the top_k
+    candidates, K = min(top_k, R) (static).
+    """
+    R = boxes.shape[0]
+    K = min(int(top_k), R) if top_k > 0 else R
+    cand = scores > score_threshold
+    neg_inf = jnp.asarray(-jnp.inf, scores.dtype)
+    masked = jnp.where(cand, scores, neg_inf)
+    top_scores, idx = lax.top_k(masked, K)  # descending, stable
+    top_boxes = boxes[idx]
+    valid = top_scores > neg_inf
+
+    iou = _pairwise_iou(top_boxes, top_boxes, normalized)  # [K, K]
+
+    def body(i, carry):
+        keep, thresh = carry
+        # kept earlier & IoU over current adaptive threshold → suppressed
+        sup = jnp.any(
+            jnp.where((jnp.arange(K) < i) & keep, iou[i], 0.0) > thresh)
+        ki = valid[i] & ~sup
+        keep = keep.at[i].set(ki)
+        # adaptive NMS (eta < 1): shrink threshold after each kept box
+        thresh = jnp.where(
+            ki & (eta < 1.0) & (thresh > 0.5), thresh * eta, thresh)
+        return keep, thresh
+
+    keep0 = jnp.zeros((K,), bool)
+    keep, _ = lax.fori_loop(
+        0, K, body, (keep0, jnp.asarray(nms_threshold, jnp.float32)))
+    return keep, top_scores, top_boxes, idx
+
+
+def _multiclass_nms_one(bboxes, scores, background_label, score_threshold,
+                        nms_top_k, keep_top_k, nms_threshold, eta, normalized):
+    """One batch element.  bboxes [R, C, 4] (shared → broadcast), scores
+    [C, R] → ([keep_top_k, 6], count, candidate indices into R)."""
+    C, R = scores.shape
+
+    def per_class(c_boxes, c_scores):
+        return _nms_single_class(
+            c_boxes, c_scores, score_threshold, nms_threshold, eta,
+            nms_top_k, normalized)
+
+    class_boxes = jnp.moveaxis(bboxes, 1, 0)  # [C, R, 4]
+    keep, top_scores, top_boxes, top_idx = jax.vmap(per_class)(
+        class_boxes, scores)
+    # [C, K] / [C, K, 4]
+    K = keep.shape[1]
+    labels = jnp.broadcast_to(jnp.arange(C)[:, None], (C, K))
+    is_bg = labels == background_label
+    sel = keep & ~is_bg
+
+    flat_scores = jnp.where(sel, top_scores, -jnp.inf).reshape(-1)
+    flat_boxes = top_boxes.reshape(-1, 4)
+    flat_labels = labels.reshape(-1)
+    flat_orig = top_idx.reshape(-1)
+
+    M = min(int(keep_top_k), flat_scores.shape[0]) if keep_top_k > 0 else flat_scores.shape[0]
+    fin_scores, fin_idx = lax.top_k(flat_scores, M)
+    fin_valid = fin_scores > -jnp.inf
+    fin_boxes = flat_boxes[fin_idx]
+    fin_labels = flat_labels[fin_idx]
+    fin_orig = jnp.where(fin_valid, flat_orig[fin_idx], -1).astype(jnp.int32)
+
+    out = jnp.concatenate(
+        [
+            jnp.where(fin_valid, fin_labels, -1).astype(jnp.float32)[:, None],
+            jnp.where(fin_valid, fin_scores, -1.0)[:, None],
+            jnp.where(fin_valid[:, None], fin_boxes, -1.0),
+        ],
+        axis=1,
+    )  # [M, 6]
+    count = jnp.sum(fin_valid.astype(jnp.int32))
+    return out, count, fin_orig
+
+
+@register_op("multiclass_nms", inputs=["BBoxes", "Scores"],
+             outputs=["Out", "NmsRoisNum"], no_grad=True)
+def multiclass_nms(ctx, attrs, BBoxes, Scores):
+    """Per-class greedy NMS + cross-class top-k (multiclass_nms_op.cc).
+
+    BBoxes [N, R, 4], Scores [N, C, R] → Out [N, keep_top_k, 6]
+    (label, score, x1, y1, x2, y2; -1-padded), NmsRoisNum [N].
+    The reference emits a ragged LoDTensor; fixed-size padding is the
+    TPU-static equivalent (see module docstring).
+    """
+    background_label = int(attrs.get("background_label", 0))
+    score_threshold = float(attrs["score_threshold"])
+    nms_top_k = int(attrs.get("nms_top_k", -1))
+    keep_top_k = int(attrs.get("keep_top_k", -1))
+    nms_threshold = float(attrs.get("nms_threshold", 0.3))
+    eta = float(attrs.get("nms_eta", 1.0))
+    normalized = bool(attrs.get("normalized", True))
+
+    bb = BBoxes[:, :, None, :] if BBoxes.ndim == 3 else BBoxes
+
+    def one_fixed(b, s):
+        C, R = s.shape
+        b4 = jnp.broadcast_to(b, (R, C, 4)) if b.shape[1] == 1 else b
+        return _multiclass_nms_one(
+            b4, s, background_label, score_threshold, nms_top_k, keep_top_k,
+            nms_threshold, eta, normalized)
+
+    out, num, _ = jax.vmap(one_fixed)(bb, Scores)
+    return out, num
+
+
+@register_op("multiclass_nms2", inputs=["BBoxes", "Scores"],
+             outputs=["Out", "Index", "NmsRoisNum"], no_grad=True)
+def multiclass_nms2(ctx, attrs, BBoxes, Scores):
+    """multiclass_nms variant also returning, per detection, the index of
+    the kept box among the input candidates R (-1 for padding rows)."""
+    background_label = int(attrs.get("background_label", 0))
+    score_threshold = float(attrs["score_threshold"])
+    nms_top_k = int(attrs.get("nms_top_k", -1))
+    keep_top_k = int(attrs.get("keep_top_k", -1))
+    nms_threshold = float(attrs.get("nms_threshold", 0.3))
+    eta = float(attrs.get("nms_eta", 1.0))
+    normalized = bool(attrs.get("normalized", True))
+
+    bb = BBoxes[:, :, None, :] if BBoxes.ndim == 3 else BBoxes
+
+    def one_fixed(b, s):
+        C, R = s.shape
+        b4 = jnp.broadcast_to(b, (R, C, 4)) if b.shape[1] == 1 else b
+        return _multiclass_nms_one(
+            b4, s, background_label, score_threshold, nms_top_k, keep_top_k,
+            nms_threshold, eta, normalized)
+
+    out, num, idx = jax.vmap(one_fixed)(bb, Scores)
+    return out, idx, num
+
+
+# ---------------------------------------------------------------------------
+# ROI align (differentiable)
+# ---------------------------------------------------------------------------
+
+def _bilinear(feat, y, x):
+    """feat [C, H, W], y/x scalar grids [...] → [C, ...] bilinear samples.
+    Out-of-range (< -1 or > size) samples are 0 (roi_align_op.cc)."""
+    H, W = feat.shape[-2], feat.shape[-1]
+    oob = (y < -1.0) | (y > H * 1.0) | (x < -1.0) | (x > W * 1.0)
+    y = jnp.clip(y, 0.0, None)
+    x = jnp.clip(x, 0.0, None)
+    y0 = jnp.clip(jnp.floor(y), 0, H - 1)
+    x0 = jnp.clip(jnp.floor(x), 0, W - 1)
+    y1 = jnp.clip(y0 + 1, 0, H - 1)
+    x1 = jnp.clip(x0 + 1, 0, W - 1)
+    ly = jnp.clip(y - y0, 0.0, 1.0)
+    lx = jnp.clip(x - x0, 0.0, 1.0)
+    y0i, x0i, y1i, x1i = (v.astype(jnp.int32) for v in (y0, x0, y1, x1))
+    v00 = feat[:, y0i, x0i]
+    v01 = feat[:, y0i, x1i]
+    v10 = feat[:, y1i, x0i]
+    v11 = feat[:, y1i, x1i]
+    val = (
+        v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx
+        + v10 * ly * (1 - lx) + v11 * ly * lx
+    )
+    return jnp.where(oob[None], 0.0, val)
+
+
+@register_op("roi_align", inputs=["X", "ROIs", "RoisNum"], outputs=["Out"])
+def roi_align(ctx, attrs, X, ROIs, RoisNum):
+    """ROI Align (roi_align_op.cc).  X [B, C, H, W]; ROIs [R, 4]
+    (x1, y1, x2, y2 in image coords); RoisNum [B] optional per-image counts
+    (defaults: all ROIs on image 0).  Out [R, C, ph, pw]."""
+    spatial_scale = float(attrs.get("spatial_scale", 1.0))
+    ph = int(attrs["pooled_height"])
+    pw = int(attrs["pooled_width"])
+    sampling_ratio = int(attrs.get("sampling_ratio", -1))
+    grid = sampling_ratio if sampling_ratio > 0 else 2  # static grid (see doc)
+
+    B = X.shape[0]
+    R = ROIs.shape[0]
+    if RoisNum is not None:
+        ends = jnp.cumsum(RoisNum.astype(jnp.int32))
+        batch_idx = jnp.searchsorted(ends, jnp.arange(R), side="right")
+        batch_idx = jnp.clip(batch_idx, 0, B - 1)
+    else:
+        batch_idx = jnp.zeros((R,), jnp.int32)
+
+    def one_roi(roi, bi):
+        feat = X[bi]  # [C, H, W]
+        x1, y1, x2, y2 = roi[0], roi[1], roi[2], roi[3]
+        roi_w = jnp.maximum((x2 - x1) * spatial_scale, 1.0)
+        roi_h = jnp.maximum((y2 - y1) * spatial_scale, 1.0)
+        bin_w = roi_w / pw
+        bin_h = roi_h / ph
+        # sample grid: [ph, grid] x [pw, grid]
+        iy = jnp.arange(ph, dtype=X.dtype)[:, None]
+        gy = (iy * bin_h + (jnp.arange(grid, dtype=X.dtype)[None, :] + 0.5)
+              * bin_h / grid + y1 * spatial_scale)  # [ph, g]
+        ix = jnp.arange(pw, dtype=X.dtype)[:, None]
+        gx = (ix * bin_w + (jnp.arange(grid, dtype=X.dtype)[None, :] + 0.5)
+              * bin_w / grid + x1 * spatial_scale)  # [pw, g]
+        yy = jnp.broadcast_to(gy[:, None, :, None], (ph, pw, grid, grid))
+        xx = jnp.broadcast_to(gx[None, :, None, :], (ph, pw, grid, grid))
+        samples = _bilinear(feat, yy, xx)  # [C, ph, pw, g, g]
+        return jnp.mean(samples, axis=(-2, -1))  # [C, ph, pw]
+
+    return jax.vmap(one_roi)(ROIs.astype(X.dtype), batch_idx)
+
+
+# ---------------------------------------------------------------------------
+# Losses
+# ---------------------------------------------------------------------------
+
+@register_op("sigmoid_focal_loss", inputs=["X", "Label", "FgNum"],
+             outputs=["Out"])
+def sigmoid_focal_loss(ctx, attrs, X, Label, FgNum):
+    """RetinaNet focal loss (sigmoid_focal_loss_op.cc).  X [N, C] logits,
+    Label [N, 1] int (0 = background, c in 1..C = foreground class c),
+    FgNum [1] normalizer."""
+    gamma = float(attrs.get("gamma", 2.0))
+    alpha = float(attrs.get("alpha", 0.25))
+    N, C = X.shape
+    lab = Label.reshape(N).astype(jnp.int32)
+    fg = jnp.maximum(FgNum.reshape(()).astype(X.dtype), 1.0)
+    # one-hot over classes 1..C mapped to column c-1
+    t = (lab[:, None] == (jnp.arange(C)[None, :] + 1)).astype(X.dtype)
+    p = jax.nn.sigmoid(X)
+    ce_pos = -jnp.log(jnp.clip(p, 1e-12, 1.0))
+    ce_neg = -jnp.log(jnp.clip(1.0 - p, 1e-12, 1.0))
+    loss = (
+        t * alpha * jnp.power(1.0 - p, gamma) * ce_pos
+        + (1.0 - t) * (1.0 - alpha) * jnp.power(p, gamma) * ce_neg
+    )
+    return loss / fg

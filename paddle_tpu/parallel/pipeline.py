@@ -27,7 +27,6 @@ which is the transformer-block case the reference pipeline targets too;
 embedding/head layers run outside the pipelined region.
 """
 
-import functools
 
 import jax
 import jax.numpy as jnp

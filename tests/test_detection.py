@@ -1,0 +1,538 @@
+"""Detection op tests vs numpy oracles (reference:
+unittests/test_prior_box_op.py, test_box_coder_op.py, test_yolo_box_op.py,
+test_multiclass_nms_op.py, test_iou_similarity_op.py, test_roi_align_op.py,
+test_anchor_generator_op.py — same oracle style: numpy reimplementation)."""
+
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.executor import Scope, scope_guard
+from op_test import OpTest
+
+rng = np.random.RandomState(7)
+
+
+# ---------------------------------------------------------------------------
+# numpy oracles
+# ---------------------------------------------------------------------------
+
+def np_expand_ar(ars, flip):
+    out = [1.0]
+    for ar in ars:
+        if any(abs(ar - o) < 1e-6 for o in out):
+            continue
+        out.append(ar)
+        if flip:
+            out.append(1.0 / ar)
+    return out
+
+
+def np_prior_box(feat_shape, img_shape, min_sizes, max_sizes, ars, flip,
+                 clip, steps, offset, mmar=False):
+    fh, fw = feat_shape
+    ih, iw = img_shape
+    sw = steps[0] or iw / fw
+    sh = steps[1] or ih / fh
+    ars_e = np_expand_ar(ars, flip)
+    num = len(ars_e) * len(min_sizes) + len(max_sizes)
+    boxes = np.zeros((fh, fw, num, 4), "float32")
+    for h in range(fh):
+        for w in range(fw):
+            cx = (w + offset) * sw
+            cy = (h + offset) * sh
+            k = 0
+            for s, mn in enumerate(min_sizes):
+                if mmar:
+                    items = [(mn / 2.0, mn / 2.0)]
+                    if max_sizes:
+                        q = math.sqrt(mn * max_sizes[s]) / 2.0
+                        items.append((q, q))
+                    for ar in ars_e:
+                        if abs(ar - 1.0) < 1e-6:
+                            continue
+                        items.append((mn * math.sqrt(ar) / 2.0,
+                                      mn / math.sqrt(ar) / 2.0))
+                else:
+                    items = [(mn * math.sqrt(ar) / 2.0,
+                              mn / math.sqrt(ar) / 2.0) for ar in ars_e]
+                    if max_sizes:
+                        q = math.sqrt(mn * max_sizes[s]) / 2.0
+                        items.append((q, q))
+                for bw, bh in items:
+                    boxes[h, w, k] = [(cx - bw) / iw, (cy - bh) / ih,
+                                      (cx + bw) / iw, (cy + bh) / ih]
+                    k += 1
+    if clip:
+        boxes = np.clip(boxes, 0, 1)
+    return boxes
+
+
+def np_iou(a, b, normalized=True):
+    norm = 0.0 if normalized else 1.0
+    n, m = a.shape[0], b.shape[0]
+    out = np.zeros((n, m), "float32")
+    for i in range(n):
+        for j in range(m):
+            xmin = max(a[i, 0], b[j, 0]); ymin = max(a[i, 1], b[j, 1])
+            xmax = min(a[i, 2], b[j, 2]); ymax = min(a[i, 3], b[j, 3])
+            iw = max(xmax - xmin + norm, 0.0); ih = max(ymax - ymin + norm, 0.0)
+            inter = iw * ih
+            aa = (a[i, 2] - a[i, 0] + norm) * (a[i, 3] - a[i, 1] + norm)
+            bb = (b[j, 2] - b[j, 0] + norm) * (b[j, 3] - b[j, 1] + norm)
+            if aa < 0: aa = 0
+            if bb < 0: bb = 0
+            u = aa + bb - inter
+            out[i, j] = inter / u if u > 0 else 0.0
+    return out
+
+
+# ---------------------------------------------------------------------------
+# tests
+# ---------------------------------------------------------------------------
+
+class TestPriorBox(OpTest):
+    op_type = "prior_box"
+
+    def test_output(self):
+        feat = rng.rand(1, 8, 4, 6).astype("float32")
+        img = rng.rand(1, 3, 32, 48).astype("float32")
+        min_sizes, max_sizes = [4.0, 8.0], [9.0, 12.0]
+        ars = [2.0]
+        self.inputs = {"Input": feat, "Image": img}
+        self.attrs = {
+            "min_sizes": min_sizes, "max_sizes": max_sizes,
+            "aspect_ratios": ars, "flip": True, "clip": True,
+            "variances": [0.1, 0.1, 0.2, 0.2],
+            "step_w": 0.0, "step_h": 0.0, "offset": 0.5,
+        }
+        expect = np_prior_box((4, 6), (32, 48), min_sizes, max_sizes, ars,
+                              True, True, (0, 0), 0.5)
+        var = np.broadcast_to(
+            np.array([0.1, 0.1, 0.2, 0.2], "float32"), expect.shape)
+        self.outputs = {"Boxes": expect, "Variances": var.copy()}
+        self.check_output(atol=1e-5)
+
+    def test_min_max_order(self):
+        feat = rng.rand(1, 8, 2, 2).astype("float32")
+        img = rng.rand(1, 3, 16, 16).astype("float32")
+        self.inputs = {"Input": feat, "Image": img}
+        self.attrs = {
+            "min_sizes": [4.0], "max_sizes": [8.0], "aspect_ratios": [2.0],
+            "flip": False, "clip": False, "variances": [0.1, 0.1, 0.2, 0.2],
+            "step_w": 0.0, "step_h": 0.0, "offset": 0.5,
+            "min_max_aspect_ratios_order": True,
+        }
+        expect = np_prior_box((2, 2), (16, 16), [4.0], [8.0], [2.0],
+                              False, False, (0, 0), 0.5, mmar=True)
+        var = np.broadcast_to(
+            np.array([0.1, 0.1, 0.2, 0.2], "float32"), expect.shape)
+        self.outputs = {"Boxes": expect, "Variances": var.copy()}
+        self.check_output(atol=1e-5)
+
+
+class TestIouSimilarity(OpTest):
+    op_type = "iou_similarity"
+
+    def test_output(self):
+        x = np.array([[0, 0, 10, 10], [2, 2, 8, 8]], "float32")
+        y = np.array([[0, 0, 10, 10], [5, 5, 15, 15], [20, 20, 30, 30]],
+                     "float32")
+        self.inputs = {"X": x, "Y": y}
+        self.attrs = {"box_normalized": True}
+        self.outputs = {"Out": np_iou(x, y)}
+        self.check_output(atol=1e-5)
+
+
+class TestBoxCoder(OpTest):
+    op_type = "box_coder"
+
+    def test_encode_decode_roundtrip(self):
+        """decode(encode(t)) == t for variance-free center-size coding."""
+        priors = np.array([[0.1, 0.1, 0.5, 0.5], [0.2, 0.3, 0.7, 0.8]],
+                          "float32")
+        targets = np.array([[0.15, 0.12, 0.55, 0.45]], "float32")
+
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            p = fluid.layers.data("p", shape=[2, 4], append_batch_size=False)
+            t = fluid.layers.data("t", shape=[1, 4], append_batch_size=False)
+            enc = fluid.layers.detection.box_coder(
+                p, None, t, code_type="encode_center_size")
+            dec = fluid.layers.detection.box_coder(
+                p, None, enc, code_type="decode_center_size")
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            e, d = exe.run(main, feed={"p": priors, "t": targets},
+                           fetch_list=[enc, dec])
+        assert e.shape == (1, 2, 4)
+        # each decoded row should reproduce the target box
+        np.testing.assert_allclose(d[0, 0], targets[0], atol=1e-5)
+        np.testing.assert_allclose(d[0, 1], targets[0], atol=1e-5)
+
+    def test_encode_with_variance(self):
+        priors = rng.rand(3, 4).astype("float32")
+        priors[:, 2:] += priors[:, :2] + 0.1
+        targets = rng.rand(2, 4).astype("float32")
+        targets[:, 2:] += targets[:, :2] + 0.1
+        variance = [0.1, 0.1, 0.2, 0.2]
+
+        pw = priors[:, 2] - priors[:, 0]
+        ph = priors[:, 3] - priors[:, 1]
+        pcx = priors[:, 0] + pw / 2
+        pcy = priors[:, 1] + ph / 2
+        tw = targets[:, 2] - targets[:, 0]
+        th = targets[:, 3] - targets[:, 1]
+        tcx = (targets[:, 0] + targets[:, 2]) / 2
+        tcy = (targets[:, 1] + targets[:, 3]) / 2
+        expect = np.zeros((2, 3, 4), "float32")
+        for i in range(2):
+            for j in range(3):
+                expect[i, j] = [
+                    (tcx[i] - pcx[j]) / pw[j] / variance[0],
+                    (tcy[i] - pcy[j]) / ph[j] / variance[1],
+                    math.log(abs(tw[i] / pw[j])) / variance[2],
+                    math.log(abs(th[i] / ph[j])) / variance[3],
+                ]
+        self.inputs = {"PriorBox": priors, "TargetBox": targets}
+        self.attrs = {"code_type": "encode_center_size",
+                      "box_normalized": True, "variance": variance}
+        self.outputs = {"OutputBox": expect}
+        self.check_output(atol=1e-4)
+
+
+class TestBoxClip(OpTest):
+    op_type = "box_clip"
+
+    def test_output(self):
+        boxes = np.array(
+            [[[-2.0, -3.0, 50.0, 60.0], [5.0, 6.0, 7.0, 8.0]]], "float32")
+        im_info = np.array([[20.0, 30.0, 1.0]], "float32")
+        expect = np.array(
+            [[[0.0, 0.0, 29.0, 19.0], [5.0, 6.0, 7.0, 8.0]]], "float32")
+        self.inputs = {"Input": boxes, "ImInfo": im_info}
+        self.outputs = {"Output": expect}
+        self.check_output(atol=1e-5)
+
+
+class TestYoloBox(OpTest):
+    op_type = "yolo_box"
+
+    def test_output(self):
+        N, A, C, H, W = 1, 2, 3, 2, 2
+        anchors = [10, 13, 16, 30]
+        downsample = 32
+        x = rng.randn(N, A * (5 + C), H, W).astype("float32")
+        img_size = np.array([[64, 64]], "int32")
+
+        def sigmoid(v):
+            return 1.0 / (1.0 + np.exp(-v))
+
+        input_size = downsample * H
+        xr = x.reshape(N, A, 5 + C, H, W)
+        boxes = np.zeros((N, A, H, W, 4), "float32")
+        scores = np.zeros((N, A, H, W, C), "float32")
+        for a in range(A):
+            for i in range(H):
+                for j in range(W):
+                    ih, iw = img_size[0]
+                    cx = (j + sigmoid(xr[0, a, 0, i, j])) * iw / W
+                    cy = (i + sigmoid(xr[0, a, 1, i, j])) * ih / H
+                    bw = math.exp(xr[0, a, 2, i, j]) * anchors[2 * a] * iw / input_size
+                    bh = math.exp(xr[0, a, 3, i, j]) * anchors[2 * a + 1] * ih / input_size
+                    conf = sigmoid(xr[0, a, 4, i, j])
+                    if conf < 0.01:
+                        continue
+                    b = [cx - bw / 2, cy - bh / 2, cx + bw / 2, cy + bh / 2]
+                    b[0] = max(b[0], 0.0); b[1] = max(b[1], 0.0)
+                    b[2] = min(b[2], iw - 1.0); b[3] = min(b[3], ih - 1.0)
+                    boxes[0, a, i, j] = b
+                    scores[0, a, i, j] = conf * sigmoid(xr[0, a, 5:, i, j])
+        self.inputs = {"X": x, "ImgSize": img_size}
+        self.attrs = {"anchors": anchors, "class_num": C,
+                      "conf_thresh": 0.01, "downsample_ratio": downsample}
+        self.outputs = {"Boxes": boxes.reshape(N, -1, 4),
+                        "Scores": scores.reshape(N, -1, C)}
+        self.check_output(atol=1e-4)
+
+
+class TestMulticlassNMS:
+    def test_basic_suppression(self):
+        # two overlapping boxes of class 1, one separate box of class 2
+        bboxes = np.array(
+            [[[0.0, 0.0, 1.0, 1.0], [0.02, 0.0, 1.0, 1.0],
+              [0.0, 0.0, 0.2, 0.2]]], "float32")  # [1, 3, 4]
+        # scores [N, C, R]; class 0 is background
+        scores = np.array([[
+            [0.01, 0.01, 0.01],
+            [0.9, 0.8, 0.01],
+            [0.01, 0.02, 0.7],
+        ]], "float32")
+
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            b = fluid.layers.data("b", shape=[1, 3, 4], append_batch_size=False)
+            s = fluid.layers.data("s", shape=[1, 3, 3], append_batch_size=False)
+            out, num = fluid.layers.detection.multiclass_nms(
+                b, s, score_threshold=0.05, nms_top_k=3, keep_top_k=5,
+                nms_threshold=0.5, return_rois_num=True)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            o, n = exe.run(main, feed={"b": bboxes, "s": scores},
+                           fetch_list=[out, num])
+        assert o.shape == (1, 5, 6)
+        assert n[0] == 2  # one kept of class 1 (second suppressed), one class 2
+        kept = o[0][o[0][:, 0] >= 0]
+        assert set(kept[:, 0].astype(int)) == {1, 2}
+        # highest score first
+        np.testing.assert_allclose(kept[0, 1], 0.9, atol=1e-6)
+        np.testing.assert_allclose(kept[0, 2:], [0, 0, 1, 1], atol=1e-6)
+
+    def test_nms2_index(self):
+        """multiclass_nms2's Index maps detections back to input rows."""
+        bboxes = np.array(
+            [[[0.0, 0.0, 1.0, 1.0], [0.02, 0.0, 1.0, 1.0],
+              [0.0, 0.0, 0.2, 0.2]]], "float32")
+        scores = np.array([[
+            [0.01, 0.01, 0.01],
+            [0.9, 0.8, 0.01],
+            [0.01, 0.02, 0.7],
+        ]], "float32")
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            b = fluid.layers.data("b", shape=[1, 3, 4], append_batch_size=False)
+            s = fluid.layers.data("s", shape=[1, 3, 3], append_batch_size=False)
+            block = main.current_block()
+            out = block.create_var(name="nms_out", dtype="float32")
+            idx = block.create_var(name="nms_idx", dtype="int32")
+            num = block.create_var(name="nms_num", dtype="int32")
+            block.append_op(
+                type="multiclass_nms2",
+                inputs={"BBoxes": [b], "Scores": [s]},
+                outputs={"Out": [out], "Index": [idx], "NmsRoisNum": [num]},
+                attrs={"background_label": 0, "score_threshold": 0.05,
+                       "nms_top_k": 3, "keep_top_k": 5,
+                       "nms_threshold": 0.5, "nms_eta": 1.0,
+                       "normalized": True},
+            )
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            o, ix, n = exe.run(main, feed={"b": bboxes, "s": scores},
+                               fetch_list=[out, idx, num])
+        assert n[0] == 2
+        # detection 0: class 1 best box = input row 0; detection 1: class 2
+        # box = input row 2; padding rows are -1
+        assert ix[0, 0] == 0 and ix[0, 1] == 2
+        assert (ix[0, 2:] == -1).all()
+
+    def test_adaptive_eta(self):
+        # eta < 1 progressively shrinks the threshold; with high initial
+        # threshold all three chained boxes survive the first pass
+        bboxes = np.array(
+            [[[0.0, 0.0, 1.0, 1.0], [0.3, 0.0, 1.3, 1.0],
+              [0.6, 0.0, 1.6, 1.0]]], "float32")
+        scores = np.array([[[0.0, 0.0, 0.0], [0.9, 0.8, 0.7]]], "float32")
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            b = fluid.layers.data("b", shape=[1, 3, 4], append_batch_size=False)
+            s = fluid.layers.data("s", shape=[1, 2, 3], append_batch_size=False)
+            out, num = fluid.layers.detection.multiclass_nms(
+                b, s, score_threshold=0.05, nms_top_k=3, keep_top_k=3,
+                nms_threshold=0.7, nms_eta=0.5, return_rois_num=True)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            o, n = exe.run(main, feed={"b": bboxes, "s": scores},
+                           fetch_list=[out, num])
+        # overlap(box0, box1) ≈ 0.538 < 0.7 → box1 kept, then thresh drops
+        # to 0.35 → box2 (overlap vs box1 ≈ 0.538) suppressed
+        assert n[0] == 2
+
+
+class TestRoiAlign:
+    def test_uniform_field(self):
+        """On a constant feature map every aligned value equals the const."""
+        X = np.full((1, 2, 8, 8), 3.5, "float32")
+        rois = np.array([[0.0, 0.0, 7.0, 7.0], [2.0, 2.0, 6.0, 6.0]],
+                        "float32")
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[1, 2, 8, 8],
+                                  append_batch_size=False)
+            r = fluid.layers.data("r", shape=[2, 4], append_batch_size=False)
+            out = fluid.layers.detection.roi_align(
+                x, r, pooled_height=2, pooled_width=2, spatial_scale=1.0,
+                sampling_ratio=2)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            (o,) = exe.run(main, feed={"x": X, "r": rois}, fetch_list=[out])
+        assert o.shape == (2, 2, 2, 2)
+        np.testing.assert_allclose(o, 3.5, atol=1e-5)
+
+    def test_linear_field_exact(self):
+        """Bilinear interpolation of a linear field is exact: f(y,x) = x."""
+        H = W = 8
+        X = np.broadcast_to(
+            np.arange(W, dtype="float32")[None, None, None, :], (1, 1, H, W)
+        ).copy()
+        rois = np.array([[1.0, 1.0, 5.0, 5.0]], "float32")
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[1, 1, H, W],
+                                  append_batch_size=False)
+            r = fluid.layers.data("r", shape=[1, 4], append_batch_size=False)
+            out = fluid.layers.detection.roi_align(
+                x, r, pooled_height=2, pooled_width=2, spatial_scale=1.0,
+                sampling_ratio=2)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            (o,) = exe.run(main, feed={"x": X, "r": rois}, fetch_list=[out])
+        # roi w=h=4 (clamped min 1); bins of 2; samples at x = x1 + (k+.5)/g*bin
+        bin_w = 4.0 / 2
+        g = 2
+        for pj in range(2):
+            xs = [1.0 + pj * bin_w + (k + 0.5) * bin_w / g for k in range(g)]
+            np.testing.assert_allclose(o[0, 0, :, pj], np.mean(xs), atol=1e-5)
+
+    def test_grad_flows(self):
+        """roi_align is differentiable w.r.t. X via the generic vjp."""
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[1, 1, 4, 4],
+                                  append_batch_size=False)
+            x.stop_gradient = False
+            r = fluid.layers.data("r", shape=[1, 4], append_batch_size=False)
+            out = fluid.layers.detection.roi_align(
+                x, r, pooled_height=2, pooled_width=2, sampling_ratio=1)
+            loss = fluid.layers.reduce_mean(out)
+            grads = fluid.backward.gradients([loss], [x])
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            (g,) = exe.run(
+                main,
+                feed={"x": np.ones((1, 1, 4, 4), "float32"),
+                      "r": np.array([[0.0, 0.0, 3.0, 3.0]], "float32")},
+                fetch_list=[grads[0]])
+        assert g.shape == (1, 1, 4, 4)
+        assert g.sum() > 0.9  # mass ≈ 1 distributed over touched pixels
+
+
+class TestSigmoidFocalLoss(OpTest):
+    op_type = "sigmoid_focal_loss"
+
+    def test_output(self):
+        N, C = 4, 3
+        x = rng.randn(N, C).astype("float32")
+        label = np.array([[0], [1], [2], [3]], "int32")
+        fg = np.array([2], "int32")
+        gamma, alpha = 2.0, 0.25
+        p = 1.0 / (1.0 + np.exp(-x))
+        t = np.zeros((N, C), "float32")
+        for i in range(N):
+            if label[i, 0] > 0:
+                t[i, label[i, 0] - 1] = 1.0
+        loss = (
+            t * alpha * (1 - p) ** gamma * -np.log(np.clip(p, 1e-12, 1))
+            + (1 - t) * (1 - alpha) * p ** gamma
+            * -np.log(np.clip(1 - p, 1e-12, 1))
+        ) / max(fg[0], 1)
+        self.inputs = {"X": x, "Label": label, "FgNum": fg}
+        self.attrs = {"gamma": gamma, "alpha": alpha}
+        self.outputs = {"Out": loss.astype("float32")}
+        self.check_output(atol=1e-5)
+
+
+class TestAnchorGenerator:
+    def test_shapes_and_center(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[1, 8, 3, 3],
+                                  append_batch_size=False)
+            anchors, var = fluid.layers.detection.anchor_generator(
+                x, anchor_sizes=[32.0, 64.0], aspect_ratios=[1.0],
+                stride=[16.0, 16.0])
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            a, v = exe.run(
+                main, feed={"x": np.zeros((1, 8, 3, 3), "float32")},
+                fetch_list=[anchors, var])
+        assert a.shape == (3, 3, 2, 4)
+        assert v.shape == (3, 3, 2, 4)
+        # anchor centers advance by the stride
+        c0 = (a[0, 0, 0, 0] + a[0, 0, 0, 2]) / 2
+        c1 = (a[0, 1, 0, 0] + a[0, 1, 0, 2]) / 2
+        np.testing.assert_allclose(c1 - c0, 16.0, atol=1e-4)
+
+
+class TestPolygonBoxTransform(OpTest):
+    op_type = "polygon_box_transform"
+
+    def test_output(self):
+        B, C, H, W = 1, 4, 2, 3
+        x = rng.randn(B, C, H, W).astype("float32")
+        expect = np.zeros_like(x)
+        for c in range(C):
+            for h in range(H):
+                for w in range(W):
+                    base = 4.0 * w if c % 2 == 0 else 4.0 * h
+                    expect[0, c, h, w] = base - x[0, c, h, w]
+        self.inputs = {"Input": x}
+        self.outputs = {"Output": expect}
+        self.check_output(atol=1e-5)
+
+
+class TestDensityPriorBox:
+    def test_count_and_range(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            feat = fluid.layers.data("f", shape=[1, 8, 2, 2],
+                                     append_batch_size=False)
+            img = fluid.layers.data("i", shape=[1, 3, 16, 16],
+                                    append_batch_size=False)
+            box, var = fluid.layers.detection.density_prior_box(
+                feat, img, densities=[2], fixed_sizes=[4.0],
+                fixed_ratios=[1.0], clip=True)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            b, v = exe.run(
+                main,
+                feed={"f": np.zeros((1, 8, 2, 2), "float32"),
+                      "i": np.zeros((1, 3, 16, 16), "float32")},
+                fetch_list=[box, var])
+        assert b.shape == (2, 2, 4, 4)  # density² priors per cell
+        assert (b >= 0).all() and (b <= 1).all()
+
+
+class TestDetectionOutput:
+    def test_end_to_end(self):
+        """decode + NMS pipeline produces sane, sorted detections."""
+        N, P, C = 1, 4, 3
+        loc = np.zeros((N, P, 4), "float32")  # zero deltas → priors
+        prior = np.array([[0.1, 0.1, 0.4, 0.4],
+                          [0.5, 0.5, 0.9, 0.9],
+                          [0.12, 0.1, 0.42, 0.4],
+                          [0.6, 0.6, 0.95, 0.95]], "float32")
+        pvar = np.broadcast_to(
+            np.array([0.1, 0.1, 0.2, 0.2], "float32"), (P, 4)).copy()
+        scores = rng.rand(N, P, C).astype("float32")
+        scores[..., 0] = 0.0  # background
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            l = fluid.layers.data("l", shape=[N, P, 4], append_batch_size=False)
+            p = fluid.layers.data("p", shape=[P, 4], append_batch_size=False)
+            v = fluid.layers.data("v", shape=[P, 4], append_batch_size=False)
+            s = fluid.layers.data("s", shape=[N, P, C], append_batch_size=False)
+            out = fluid.layers.detection.detection_output(
+                l, s, p, v, score_threshold=0.01, nms_threshold=0.45,
+                nms_top_k=4, keep_top_k=4)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            (o,) = exe.run(
+                main, feed={"l": loc, "p": prior, "v": pvar, "s": scores},
+                fetch_list=[out])
+        assert o.shape == (1, 4, 6)
+        kept = o[0][o[0][:, 0] >= 0]
+        assert len(kept) >= 1
+        # scores sorted descending
+        assert all(kept[i, 1] >= kept[i + 1, 1] for i in range(len(kept) - 1))
