@@ -30,3 +30,4 @@ from . import rnn  # noqa: F401
 from . import sequence  # noqa: F401
 from . import collective  # noqa: F401
 from . import detection  # noqa: F401
+from . import metrics  # noqa: F401

@@ -1,0 +1,137 @@
+"""In-graph metric ops with stateful accumulators.
+
+Reference: ``paddle/fluid/operators/metrics/`` — ``auc_op.h`` (bucketed
+TPR/FPR histogram + trapezoid area, sliding-window or global accumulation),
+``precision_recall_op.h`` (per-class TP/FP/TN/FN states → macro/micro
+metrics).  ``accuracy_op`` lives in ops/basic.py.
+
+TPU-native notes: the reference mutates persistable state vars in place;
+here state flows through the op functionally (StatPos in → StatPosOut out,
+wired to the same variable by the layer), which the executor writes back to
+the scope — same net effect, jit-compatible.  Histogramming uses
+``segment_sum`` instead of a scalar loop so it vectorizes on device.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@register_op("auc", inputs=["Predict", "Label", "StatPos", "StatNeg"],
+             outputs=["AUC", "StatPosOut", "StatNegOut"], no_grad=True)
+def auc(ctx, attrs, Predict, Label, StatPos, StatNeg):
+    """Streaming AUC (auc_op.h:27).
+
+    Predict [N, 2] probabilities (column 1 used), Label [N, 1] {0,1}.
+    StatPos/StatNeg: [1, T+1] bucket counts when slide_steps == 0 (global
+    accumulation), else [slide_steps, T+1] ring buffer of per-step counts.
+    """
+    num_thresholds = int(attrs.get("num_thresholds", (2 ** 12) - 1))
+    slide_steps = int(attrs.get("slide_steps", 1))
+    B = num_thresholds + 1
+
+    pred = Predict[:, 1] if Predict.shape[1] > 1 else Predict[:, 0]
+    lab = Label.reshape(-1).astype(bool)
+    idx = jnp.clip(
+        (pred * num_thresholds).astype(jnp.int32), 0, num_thresholds)
+    w_pos = lab.astype(StatPos.dtype)
+    hist_pos = jax.ops.segment_sum(w_pos, idx, num_segments=B)
+    hist_neg = jax.ops.segment_sum(1 - w_pos, idx, num_segments=B)
+
+    if slide_steps == 0:
+        pos_out = StatPos + hist_pos[None, :].astype(StatPos.dtype)
+        neg_out = StatNeg + hist_neg[None, :].astype(StatNeg.dtype)
+        stat_pos, stat_neg = pos_out[0], neg_out[0]
+    else:
+        # shift window up one step, append the current histogram
+        pos_out = jnp.concatenate(
+            [StatPos[1:], hist_pos[None, :].astype(StatPos.dtype)], axis=0)
+        neg_out = jnp.concatenate(
+            [StatNeg[1:], hist_neg[None, :].astype(StatNeg.dtype)], axis=0)
+        stat_pos = jnp.sum(pos_out, axis=0)
+        stat_neg = jnp.sum(neg_out, axis=0)
+
+    # trapezoid area over buckets scanned from the highest threshold down
+    # (auc_op.h calcAuc): cumulative TP/FP counts trace the ROC curve
+    pos_rev = stat_pos[::-1].astype(jnp.float32)
+    neg_rev = stat_neg[::-1].astype(jnp.float32)
+    tot_pos = jnp.cumsum(pos_rev)
+    tot_neg = jnp.cumsum(neg_rev)
+    tot_pos_prev = tot_pos - pos_rev
+    tot_neg_prev = tot_neg - neg_rev
+    area = jnp.sum(
+        jnp.abs(tot_neg - tot_neg_prev) * (tot_pos + tot_pos_prev) / 2.0)
+    denom = tot_pos[-1] * tot_neg[-1]
+    auc_val = jnp.where(denom > 0, area / jnp.maximum(denom, 1.0), 0.0)
+    return auc_val.reshape(1), pos_out, neg_out
+
+
+def _calc_precision(tp, fp):
+    has = (tp > 0) | (fp > 0)
+    return jnp.where(has, tp / jnp.maximum(tp + fp, 1e-38), 1.0)
+
+
+def _calc_recall(tp, fn):
+    has = (tp > 0) | (fn > 0)
+    return jnp.where(has, tp / jnp.maximum(tp + fn, 1e-38), 1.0)
+
+
+def _calc_f1(p, r):
+    has = (p > 0) | (r > 0)
+    return jnp.where(has, 2 * p * r / jnp.maximum(p + r, 1e-38), 0.0)
+
+
+def _metrics_from_states(states):
+    """states [C, 4] (TP, FP, TN, FN) → [6] macro/micro P/R/F1
+    (precision_recall_op.h ComputeMetrics)."""
+    tp, fp, fn = states[:, 0], states[:, 1], states[:, 3]
+    macro_p = jnp.mean(_calc_precision(tp, fp))
+    macro_r = jnp.mean(_calc_recall(tp, fn))
+    macro_f1 = _calc_f1(macro_p, macro_r)
+    ttp, tfp, tfn = jnp.sum(tp), jnp.sum(fp), jnp.sum(fn)
+    micro_p = _calc_precision(ttp, tfp)
+    micro_r = _calc_recall(ttp, tfn)
+    micro_f1 = _calc_f1(micro_p, micro_r)
+    return jnp.stack([macro_p, macro_r, macro_f1, micro_p, micro_r, micro_f1])
+
+
+@register_op(
+    "precision_recall",
+    inputs=["MaxProbs", "Indices", "Labels", "Weights", "StatesInfo"],
+    outputs=["BatchMetrics", "AccumMetrics", "AccumStatesInfo"],
+    no_grad=True)
+def precision_recall(ctx, attrs, MaxProbs, Indices, Labels, Weights,
+                     StatesInfo):
+    """Multi-class streaming precision/recall (precision_recall_op.h:30).
+
+    Indices/Labels [N, 1] int; Weights optional [N, 1]; StatesInfo optional
+    [C, 4] running (TP, FP, TN, FN).  Metrics layout: [macro_p, macro_r,
+    macro_f1, micro_p, micro_r, micro_f1].
+    """
+    C = int(attrs["class_number"])
+    ids = Indices.reshape(-1).astype(jnp.int32)
+    labels = Labels.reshape(-1).astype(jnp.int32)
+    w = (Weights.reshape(-1).astype(jnp.float32)
+         if Weights is not None else jnp.ones(ids.shape, jnp.float32))
+
+    correct = ids == labels
+    onehot_id = jax.nn.one_hot(ids, C, dtype=jnp.float32)      # [N, C]
+    onehot_lab = jax.nn.one_hot(labels, C, dtype=jnp.float32)
+
+    tp = jnp.sum(jnp.where(correct, w, 0.0)[:, None] * onehot_id, axis=0)
+    fp = jnp.sum(jnp.where(~correct, w, 0.0)[:, None] * onehot_id, axis=0)
+    fn = jnp.sum(jnp.where(~correct, w, 0.0)[:, None] * onehot_lab, axis=0)
+    # TN per class: every sample adds w to all classes except the predicted
+    # one, and (when wrong) except the labeled one (precision_recall_op.h:69)
+    total_w = jnp.sum(w)
+    tn = total_w - tp - fp - fn
+
+    batch_states = jnp.stack([tp, fp, tn, fn], axis=1)  # [C, 4]
+    batch_metrics = _metrics_from_states(batch_states)
+    accum_states = (
+        batch_states + StatesInfo.astype(jnp.float32)
+        if StatesInfo is not None else batch_states
+    )
+    accum_metrics = _metrics_from_states(accum_states)
+    return batch_metrics, accum_metrics, accum_states

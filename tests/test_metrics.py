@@ -1,0 +1,213 @@
+"""Metric op tests (reference: unittests/test_auc_op.py,
+test_precision_recall_op.py — numpy-oracle style)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.executor import Scope, scope_guard
+
+
+def np_auc(pos_hist, neg_hist):
+    """Trapezoid AUC from bucket histograms (auc_op.h calcAuc)."""
+    tot_pos = tot_neg = 0.0
+    tot_pos_prev = tot_neg_prev = 0.0
+    area = 0.0
+    for idx in range(len(pos_hist) - 1, -1, -1):
+        tot_pos_prev, tot_neg_prev = tot_pos, tot_neg
+        tot_pos += pos_hist[idx]
+        tot_neg += neg_hist[idx]
+        area += abs(tot_neg - tot_neg_prev) * (tot_pos + tot_pos_prev) / 2.0
+    if tot_pos > 0 and tot_neg > 0:
+        return area / tot_pos / tot_neg
+    return 0.0
+
+
+class TestAuc:
+    def _run(self, num_thresholds, batches, slide_steps=1):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            pred = fluid.layers.data("pred", shape=[4, 2],
+                                     append_batch_size=False)
+            label = fluid.layers.data("label", shape=[4, 1], dtype="int32",
+                                      append_batch_size=False)
+            g_auc, b_auc, _ = fluid.layers.auc(
+                pred, label, num_thresholds=num_thresholds,
+                slide_steps=slide_steps)
+        exe = fluid.Executor(fluid.CPUPlace())
+        outs = []
+        with scope_guard(Scope()):
+            exe.run(startup)
+            for p, l in batches:
+                outs.append(exe.run(main, feed={"pred": p, "label": l},
+                                    fetch_list=[g_auc, b_auc]))
+        return outs
+
+    def test_global_accumulates(self):
+        rng = np.random.RandomState(0)
+        T = 63
+        batches = []
+        for _ in range(3):
+            p = rng.rand(4).astype("float32")
+            pred = np.stack([1 - p, p], axis=1)
+            lab = rng.randint(0, 2, size=(4, 1)).astype("int32")
+            batches.append((pred, lab))
+        outs = self._run(T, batches, slide_steps=1)
+
+        # numpy oracle: global AUC over all seen batches
+        pos = np.zeros(T + 1)
+        neg = np.zeros(T + 1)
+        for i, (pred, lab) in enumerate(batches):
+            for j in range(4):
+                b = min(int(pred[j, 1] * T), T)
+                if lab[j, 0]:
+                    pos[b] += 1
+                else:
+                    neg[b] += 1
+            np.testing.assert_allclose(
+                outs[i][0][0], np_auc(pos, neg), atol=1e-5,
+                err_msg="global auc batch %d" % i)
+
+    def test_batch_auc_is_windowed(self):
+        rng = np.random.RandomState(1)
+        T = 31
+        batches = []
+        for _ in range(4):
+            p = rng.rand(4).astype("float32")
+            pred = np.stack([1 - p, p], axis=1)
+            lab = rng.randint(0, 2, size=(4, 1)).astype("int32")
+            batches.append((pred, lab))
+        # slide_steps=1 → batch AUC computed from the current batch only
+        outs = self._run(T, batches, slide_steps=1)
+        for i, (pred, lab) in enumerate(batches):
+            pos = np.zeros(T + 1)
+            neg = np.zeros(T + 1)
+            for j in range(4):
+                b = min(int(pred[j, 1] * T), T)
+                if lab[j, 0]:
+                    pos[b] += 1
+                else:
+                    neg[b] += 1
+            np.testing.assert_allclose(
+                outs[i][1][0], np_auc(pos, neg), atol=1e-5,
+                err_msg="batch auc %d" % i)
+
+    def test_slide_zero_batch_equals_global(self):
+        rng = np.random.RandomState(2)
+        batches = []
+        for _ in range(3):
+            p = rng.rand(4).astype("float32")
+            pred = np.stack([1 - p, p], axis=1)
+            lab = rng.randint(0, 2, size=(4, 1)).astype("int32")
+            batches.append((pred, lab))
+        outs = self._run(31, batches, slide_steps=0)
+        for g, b in outs:
+            np.testing.assert_allclose(np.asarray(g), np.asarray(b),
+                                       atol=1e-7)
+
+    def test_perfect_separation(self):
+        pred = np.array([[0.9, 0.1], [0.8, 0.2], [0.2, 0.8], [0.1, 0.9]],
+                        "float32")
+        lab = np.array([[0], [0], [1], [1]], "int32")
+        outs = self._run(255, [(pred, lab)])
+        np.testing.assert_allclose(outs[0][0][0], 1.0, atol=1e-6)
+
+
+class TestPrecisionRecall:
+    def _build_and_run(self, C, ids, labels, weights=None, states=None):
+        N = len(ids)
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            block = main.current_block()
+            probs = fluid.layers.data("probs", shape=[N, 1],
+                                      append_batch_size=False)
+            idx = fluid.layers.data("idx", shape=[N, 1], dtype="int32",
+                                    append_batch_size=False)
+            lab = fluid.layers.data("lab", shape=[N, 1], dtype="int32",
+                                    append_batch_size=False)
+            ins = {"MaxProbs": [probs], "Indices": [idx], "Labels": [lab]}
+            feed = {
+                "probs": np.ones((N, 1), "float32"),
+                "idx": np.asarray(ids, "int32").reshape(N, 1),
+                "lab": np.asarray(labels, "int32").reshape(N, 1),
+            }
+            if weights is not None:
+                w = fluid.layers.data("w", shape=[N, 1],
+                                      append_batch_size=False)
+                ins["Weights"] = [w]
+                feed["w"] = np.asarray(weights, "float32").reshape(N, 1)
+            if states is not None:
+                st = fluid.layers.data("st", shape=[C, 4],
+                                       append_batch_size=False)
+                ins["StatesInfo"] = [st]
+                feed["st"] = np.asarray(states, "float32")
+            bm = block.create_var(name="bm", dtype="float32")
+            am = block.create_var(name="am", dtype="float32")
+            ast = block.create_var(name="ast", dtype="float32")
+            block.append_op(
+                type="precision_recall", inputs=ins,
+                outputs={"BatchMetrics": [bm], "AccumMetrics": [am],
+                         "AccumStatesInfo": [ast]},
+                attrs={"class_number": C},
+            )
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            return exe.run(main, feed=feed, fetch_list=[bm, am, ast])
+
+    @staticmethod
+    def np_metrics(states):
+        C = states.shape[0]
+        precs, recs = [], []
+        for c in range(C):
+            tp, fp, tn, fn = states[c]
+            precs.append(tp / (tp + fp) if (tp > 0 or fp > 0) else 1.0)
+            recs.append(tp / (tp + fn) if (tp > 0 or fn > 0) else 1.0)
+        mp, mr = np.mean(precs), np.mean(recs)
+        mf1 = 2 * mp * mr / (mp + mr) if (mp > 0 or mr > 0) else 0.0
+        ttp, tfp, tfn = states[:, 0].sum(), states[:, 1].sum(), states[:, 3].sum()
+        up = ttp / (ttp + tfp) if (ttp > 0 or tfp > 0) else 1.0
+        ur = ttp / (ttp + tfn) if (ttp > 0 or tfn > 0) else 1.0
+        uf1 = 2 * up * ur / (up + ur) if (up > 0 or ur > 0) else 0.0
+        return np.array([mp, mr, mf1, up, ur, uf1])
+
+    @staticmethod
+    def np_states(C, ids, labels, weights=None):
+        states = np.zeros((C, 4))
+        w = weights if weights is not None else [1.0] * len(ids)
+        for i, (p, l) in enumerate(zip(ids, labels)):
+            if p == l:
+                states[p, 0] += w[i]
+                states[:, 2] += w[i]
+                states[p, 2] -= w[i]
+            else:
+                states[l, 3] += w[i]
+                states[p, 1] += w[i]
+                states[:, 2] += w[i]
+                states[p, 2] -= w[i]
+                states[l, 2] -= w[i]
+        return states
+
+    def test_batch_metrics(self):
+        C = 3
+        ids = [0, 1, 2, 1, 0]
+        labels = [0, 1, 1, 2, 0]
+        bm, am, ast = self._build_and_run(C, ids, labels)
+        expect_states = self.np_states(C, ids, labels)
+        np.testing.assert_allclose(ast, expect_states, atol=1e-5)
+        np.testing.assert_allclose(bm, self.np_metrics(expect_states),
+                                   atol=1e-5)
+        np.testing.assert_allclose(am, bm, atol=1e-6)  # no prior states
+
+    def test_weighted_with_accum(self):
+        C = 2
+        ids = [0, 1, 1]
+        labels = [0, 0, 1]
+        weights = [0.5, 2.0, 1.0]
+        prior = np.array([[1.0, 0.0, 2.0, 0.0], [0.5, 0.5, 1.0, 1.0]],
+                         "float32")
+        bm, am, ast = self._build_and_run(C, ids, labels, weights, prior)
+        batch_states = self.np_states(C, ids, labels, weights)
+        np.testing.assert_allclose(bm, self.np_metrics(batch_states),
+                                   atol=1e-5)
+        np.testing.assert_allclose(ast, batch_states + prior, atol=1e-5)
+        np.testing.assert_allclose(am, self.np_metrics(batch_states + prior),
+                                   atol=1e-5)
