@@ -8,6 +8,7 @@ from . import control_flow
 from . import sequence
 from . import metric_op
 from . import detection
+from . import beam
 from . import learning_rate_scheduler
 from . import collective
 from . import math_op_patch  # noqa: F401  (Variable operator overloads)
@@ -20,6 +21,7 @@ from .control_flow import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403
 from .metric_op import *  # noqa: F401,F403
 from .detection import *  # noqa: F401,F403
+from .beam import *  # noqa: F401,F403
 from .learning_rate_scheduler import *  # noqa: F401,F403
 
 __all__ = (
@@ -31,5 +33,6 @@ __all__ = (
     + sequence.__all__
     + metric_op.__all__
     + detection.__all__
+    + beam.__all__
     + learning_rate_scheduler.__all__
 )

@@ -359,19 +359,15 @@ class Switch:
 
 
 def _logical_and(x, y):
-    helper = LayerHelper("logical_and")
-    out = helper.create_variable_for_type_inference("bool", True)
-    helper.append_op(type="logical_and", inputs={"X": [x], "Y": [y]},
-                     outputs={"Out": [out]})
-    return out
+    from .ops import logical_and
+
+    return logical_and(x, y)
 
 
 def _logical_not(x):
-    helper = LayerHelper("logical_not")
-    out = helper.create_variable_for_type_inference("bool", True)
-    helper.append_op(type="logical_not", inputs={"X": [x]},
-                     outputs={"Out": [out]})
-    return out
+    from .ops import logical_not
+
+    return logical_not(x)
 
 
 class IfElse:

@@ -94,3 +94,35 @@ def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
         },
     )
     return helper.append_activation(out)
+
+
+def _generate_binary_logical(op_type):
+    def func(x, y, out=None, name=None):
+        helper = LayerHelper(op_type, **locals())
+        if out is None:
+            out = helper.create_variable_for_type_inference("bool")
+        helper.append_op(
+            type=op_type, inputs={"X": [x], "Y": [y]},
+            outputs={"Out": [out]},
+        )
+        return out
+
+    func.__name__ = op_type
+    return func
+
+
+logical_and = _generate_binary_logical("logical_and")
+logical_or = _generate_binary_logical("logical_or")
+logical_xor = _generate_binary_logical("logical_xor")
+
+
+def logical_not(x, out=None, name=None):
+    helper = LayerHelper("logical_not", **locals())
+    if out is None:
+        out = helper.create_variable_for_type_inference("bool")
+    helper.append_op(
+        type="logical_not", inputs={"X": [x]}, outputs={"Out": [out]})
+    return out
+
+
+__all__ += ["logical_and", "logical_or", "logical_xor", "logical_not"]

@@ -7,5 +7,6 @@ from . import resnet
 from . import bert
 from . import vgg
 from . import ctr
+from . import machine_translation
 
-__all__ = ["mnist", "resnet", "bert", "vgg", "ctr"]
+__all__ = ["mnist", "resnet", "bert", "vgg", "ctr", "machine_translation"]

@@ -31,3 +31,4 @@ from . import sequence  # noqa: F401
 from . import collective  # noqa: F401
 from . import detection  # noqa: F401
 from . import metrics  # noqa: F401
+from . import beam_search  # noqa: F401

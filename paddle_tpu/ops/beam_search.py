@@ -1,0 +1,132 @@
+"""Beam search ops, static-shape formulation.
+
+Reference: ``paddle/fluid/operators/beam_search_op.cc`` (one expansion step
+over LoD-encoded ragged beams) and ``beam_search_decode_op.cc`` (backtrace
+of the beam tree recorded across steps into sentences).
+
+TPU-native redesign: beams live in a dense ``[B, K]`` layout (batch ×
+beam_size) instead of LoD offsets, so every step is one fused
+``top_k(candidates.reshape(B, K*V))`` on device — no host-side ragged
+bookkeeping.  The parent chain the reference encodes in the output LoD is
+returned explicitly as ``parent_idx`` and replayed by ``beam_search_decode``
+with a reverse scan.  Pruned/finished-beam semantics match the reference:
+a beam that has emitted ``end_id`` keeps exactly one candidate (``end_id``
+again, score unchanged), so it survives top-k without growing.
+
+First-step convention: initialize ``pre_scores`` to ``[0, -1e9, ...]`` per
+batch row so that all K identical start beams collapse to beam 0 (the
+standard dense-beam trick replacing the reference's "lod has one source
+item" case).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+NEG_INF = -1e9
+
+
+@register_op(
+    "beam_search",
+    inputs=["pre_ids", "pre_scores", "ids", "scores"],
+    outputs=["selected_ids", "selected_scores", "parent_idx"],
+    no_grad=True)
+def beam_search(ctx, attrs, pre_ids, pre_scores, ids, scores):
+    """One beam expansion step.
+
+    pre_ids [B, K] int: last chosen token per beam (end_id marks finished).
+    pre_scores [B, K] float: cumulative log-prob per beam.
+    scores [B, K, V] float: this step's per-token scores — log-probs when
+    ``is_accumulated`` is False (added to pre_scores here), else already
+    accumulated totals.
+    ids: optional [B, K, V] candidate token table (defaults to 0..V-1).
+
+    Returns selected_ids [B, K], selected_scores [B, K], parent_idx [B, K].
+    """
+    beam_size = int(attrs["beam_size"])
+    end_id = int(attrs["end_id"])
+    is_accumulated = bool(attrs.get("is_accumulated", True))
+
+    B, K, V = scores.shape
+    pre_ids = pre_ids.reshape(B, K)
+    pre_scores = pre_scores.reshape(B, K).astype(scores.dtype)
+
+    if not is_accumulated:
+        cand = pre_scores[:, :, None] + scores
+    else:
+        cand = scores
+
+    finished = pre_ids == end_id  # [B, K]
+    vocab_ids = jnp.arange(V, dtype=jnp.int32)[None, None, :]
+    # finished beams: only the end_id column stays alive, score frozen
+    frozen = jnp.where(vocab_ids == end_id, pre_scores[:, :, None],
+                       jnp.asarray(NEG_INF, scores.dtype))
+    cand = jnp.where(finished[:, :, None], frozen, cand)
+
+    flat = cand.reshape(B, K * V)
+    top_scores, top_idx = lax.top_k(flat, beam_size)  # [B, beam]
+    parent = (top_idx // V).astype(jnp.int32)
+    token = (top_idx % V).astype(jnp.int32)
+    if ids is not None:
+        token = jnp.take_along_axis(
+            ids.reshape(B, K * V).astype(jnp.int32), top_idx, axis=1)
+        # a selection from a finished beam is its frozen end candidate —
+        # emit end_id itself, not the table entry at that column, so the
+        # beam stays finished next step
+        parent_finished = jnp.take_along_axis(finished, parent, axis=1)
+        token = jnp.where(parent_finished, end_id, token)
+    return (
+        token.astype(jnp.int32),
+        top_scores,
+        parent,
+    )
+
+
+@register_op(
+    "beam_search_decode",
+    inputs=["Ids", "Scores", "ParentIdx"],
+    outputs=["SentenceIds", "SentenceScores"],
+    no_grad=True)
+def beam_search_decode(ctx, attrs, Ids, Scores, ParentIdx):
+    """Backtrace the beam tree (beam_search_decode_op.cc).
+
+    Ids / Scores / ParentIdx: tensor arrays ({buffer, length}) written once
+    per decode step — buffers [T, B, K] (ids/parents int, scores float).
+    Returns SentenceIds [B, K, T] (positions past a sentence's end padded
+    with end_id) and SentenceScores [B, K] (cumulative score of each final
+    beam).
+    """
+    end_id = int(attrs["end_id"])
+
+    ids_buf = Ids["buffer"]          # [T, B, K]
+    parents_buf = ParentIdx["buffer"]
+    length = Ids["length"]           # actual number of steps written
+    T, B, K = ids_buf.shape
+
+    # walk from the final beams backward; steps >= length are identity
+    beam0 = jnp.broadcast_to(jnp.arange(K, dtype=jnp.int32)[None, :], (B, K))
+
+    def step(cur, t):
+        # cur [B, K]: beam index at step t+1 whose ancestry we are tracing
+        valid = t < length
+        tok = jnp.take_along_axis(ids_buf[t], cur, axis=1)      # [B, K]
+        par = jnp.take_along_axis(parents_buf[t], cur, axis=1)
+        tok = jnp.where(valid, tok, end_id)
+        nxt = jnp.where(valid, par, cur)
+        return nxt, tok
+
+    _, toks_rev = lax.scan(step, beam0, jnp.arange(T - 1, -1, -1))
+    sent = jnp.moveaxis(toks_rev[::-1], 0, -1)  # [B, K, T]
+
+    scores_buf = Scores["buffer"]  # [T, B, K] cumulative per step
+    last = jnp.clip(length - 1, 0, T - 1)
+    final_scores = lax.dynamic_index_in_dim(scores_buf, last, 0,
+                                            keepdims=False)  # [B, K]
+
+    # positions after each sentence's first end_id → end_id padding
+    emitted_end = jnp.cumsum((sent == end_id).astype(jnp.int32), axis=-1)
+    after_end = emitted_end - (sent == end_id).astype(jnp.int32) > 0
+    sent = jnp.where(after_end, end_id, sent)
+    return sent.astype(jnp.int32), final_scores

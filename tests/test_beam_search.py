@@ -1,0 +1,161 @@
+"""Beam search op + seq2seq NMT tests (reference:
+unittests/test_beam_search_op.py, test_beam_search_decode_op.py, and the
+book test tests/book/test_machine_translation.py)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.executor import Scope, scope_guard
+from paddle_tpu.models import machine_translation
+
+
+class TestBeamSearchStep:
+    def _run_step(self, pre_ids, pre_scores, scores, beam_size, end_id,
+                  is_accumulated=False):
+        B, K, V = scores.shape
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            pi = fluid.layers.data("pi", shape=[B, K], dtype="int32",
+                                   append_batch_size=False)
+            ps = fluid.layers.data("ps", shape=[B, K], dtype="float32",
+                                   append_batch_size=False)
+            sc = fluid.layers.data("sc", shape=[B, K, V], dtype="float32",
+                                   append_batch_size=False)
+            ids, sco, par = fluid.layers.beam_search(
+                pi, ps, None, sc, beam_size=beam_size, end_id=end_id,
+                is_accumulated=is_accumulated)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            return exe.run(
+                main,
+                feed={"pi": pre_ids, "ps": pre_scores, "sc": scores},
+                fetch_list=[ids, sco, par])
+
+    def test_topk_over_beams(self):
+        # B=1, K=2, V=4; beam log-probs chosen so the best two candidates
+        # come from different beams
+        pre_ids = np.array([[5, 6]], "int32")
+        pre_scores = np.array([[-1.0, -2.0]], "float32")
+        step = np.array([[[-0.1, -3.0, -4.0, -5.0],
+                          [-4.0, -0.2, -6.0, -7.0]]], "float32")
+        ids, sco, par = self._run_step(pre_ids, pre_scores, step, 2, end_id=0)
+        # candidates: beam0: -1.1 (tok 0), -4.0 (tok 1)...; beam1: -2.2 (tok 1)
+        assert ids[0].tolist() == [0, 1]
+        np.testing.assert_allclose(sco[0], [-1.1, -2.2], atol=1e-6)
+        assert par[0].tolist() == [0, 1]
+
+    def test_finished_beam_frozen(self):
+        end_id = 3
+        pre_ids = np.array([[3, 7]], "int32")      # beam 0 already finished
+        pre_scores = np.array([[-0.5, -1.0]], "float32")
+        step = np.full((1, 2, 4), -10.0, "float32")
+        step[0, 1, 1] = -0.1
+        ids, sco, par = self._run_step(pre_ids, pre_scores, step, 2, end_id)
+        # finished beam survives with frozen score; live beam extends
+        rows = sorted(zip(ids[0].tolist(), sco[0].tolist(), par[0].tolist()))
+        assert (1, -1.1, 1) in [(r[0], round(r[1], 6), r[2]) for r in rows]
+        assert (3, -0.5, 0) in [(r[0], round(r[1], 6), r[2]) for r in rows]
+
+    def test_first_step_convention(self):
+        # pre_scores [0, -1e9]: all selected beams must come from beam 0
+        pre_ids = np.array([[1, 1]], "int32")
+        pre_scores = np.array([[0.0, -1e9]], "float32")
+        step = np.log(np.array(
+            [[[0.1, 0.5, 0.2, 0.2], [0.1, 0.5, 0.2, 0.2]]], "float32"))
+        ids, sco, par = self._run_step(pre_ids, pre_scores, step, 2, end_id=0)
+        assert par[0].tolist() == [0, 0]
+        assert ids[0].tolist() == [1, 2] or ids[0].tolist() == [1, 3]
+
+
+class TestBeamSearchDecode:
+    def test_backtrace(self):
+        """Hand-built two-step beam tree: verify parent-chain replay."""
+        B, K, T = 1, 2, 3
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            i = fluid.layers.fill_constant([1], "int32", 0)
+            ids0 = fluid.layers.assign(np.array([[4, 5]], "int32"))
+            sc0 = fluid.layers.assign(np.array([[-1.0, -2.0]], "float32"))
+            par0 = fluid.layers.assign(np.array([[0, 0]], "int32"))
+            ids_arr = fluid.layers.array_write(ids0, i, capacity=T)
+            sc_arr = fluid.layers.array_write(sc0, i, capacity=T)
+            par_arr = fluid.layers.array_write(par0, i, capacity=T)
+            i1 = fluid.layers.fill_constant([1], "int32", 1)
+            # step 1: beam0 ← parent 1 (tok 6), beam1 ← parent 0 (tok 7)
+            ids1 = fluid.layers.assign(np.array([[6, 7]], "int32"))
+            sc1 = fluid.layers.assign(np.array([[-1.5, -2.5]], "float32"))
+            par1 = fluid.layers.assign(np.array([[1, 0]], "int32"))
+            fluid.layers.array_write(ids1, i1, array=ids_arr)
+            fluid.layers.array_write(sc1, i1, array=sc_arr)
+            fluid.layers.array_write(par1, i1, array=par_arr)
+            sent, scores = fluid.layers.beam_search_decode(
+                ids_arr, sc_arr, par_arr, beam_size=K, end_id=0)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            s, sc = exe.run(main, fetch_list=[sent, scores])
+        # beam 0 at final step came from parent beam 1: sequence [5, 6]
+        assert s[0, 0, :2].tolist() == [5, 6]
+        # beam 1 came from parent beam 0: sequence [4, 7]
+        assert s[0, 1, :2].tolist() == [4, 7]
+        # unwritten step 2 (capacity padding) → end_id
+        assert (s[:, :, 2] == 0).all()
+        np.testing.assert_allclose(sc[0], [-1.5, -2.5], atol=1e-6)
+
+
+class TestNMTBook:
+    """Train a toy copy-task seq2seq, then beam-decode it (book test
+    pattern: train until loss drops, assert decode quality)."""
+
+    def test_train_and_decode(self):
+        V, L = 12, 4
+        start_id, end_id = 1, 2
+        B = 4
+        rng = np.random.RandomState(0)
+
+        main, startup, feeds, loss = machine_translation.build_train(V, emb_dim=24, hidden_dim=48, src_len=L,
+                        tgt_len=L + 1, lr=5e-3)
+
+        def make_batch(n):
+            toks = rng.randint(3, V, size=(n, L))
+            tgt_in = np.concatenate(
+                [np.full((n, 1), start_id), toks], axis=1)
+            tgt_out = np.concatenate(
+                [toks, np.full((n, 1), end_id)], axis=1)[..., None]
+            return (toks.astype("int64"), tgt_in.astype("int64"),
+                    tgt_out.astype("int64"))
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = Scope()
+        with scope_guard(scope):
+            exe.run(startup)
+            first = last = None
+            for step in range(200):
+                s, ti, to = make_batch(16)
+                (l,) = exe.run(
+                    main, feed={"src": s, "tgt_in": ti, "tgt_out": to},
+                    fetch_list=[loss])
+                l = float(np.asarray(l).reshape(()))
+                if first is None:
+                    first = l
+                last = l
+            assert last < first * 0.25, (first, last)
+
+            # decode in the same scope → shared trained parameters
+            imain, istartup, ifeeds, sent, scores = \
+                machine_translation.build_infer(
+                    V, emb_dim=24, hidden_dim=48, src_len=L, batch_size=B,
+                    beam_size=3, max_len=L + 2, start_id=start_id,
+                    end_id=end_id)
+            s, _, _ = make_batch(B)
+            sids, sscores = exe.run(imain, feed={"src": s},
+                                    fetch_list=[sent, scores])
+        assert sids.shape == (B, 3, L + 2)
+        # top beam should reproduce the source tokens then emit end_id
+        correct = 0
+        for b in range(B):
+            got = sids[b, 0, :L].tolist()
+            if got == s[b].tolist():
+                correct += 1
+        assert correct >= B - 1, (sids[:, 0], s)
+        # scores sorted: beam 0 is the best-scoring hypothesis
+        assert (sscores[:, 0] >= sscores[:, 1] - 1e-6).all()
