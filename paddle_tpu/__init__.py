@@ -69,6 +69,8 @@ from . import dygraph
 from . import profiler
 from . import contrib
 from . import reader
+from . import native
+from . import recordio_writer
 from .reader import PyReader, DataLoader
 from .io import (
     save_vars,

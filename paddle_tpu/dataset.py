@@ -95,13 +95,16 @@ class DatasetBase:
             example.append(arr[:L])
         return example
 
+    def _iter_file(self, path):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    yield self._parse_line(line)
+
     def _iter_examples(self):
         for path in self.filelist:
-            with open(path) as f:
-                for line in f:
-                    line = line.strip()
-                    if line:
-                        yield self._parse_line(line)
+            yield from self._iter_file(path)
 
     def _batches_from(self, examples):
         batch = []
@@ -125,8 +128,32 @@ class DatasetBase:
             feed[var.name] = arr
         return feed
 
+    def _native_file_arrays(self, path):
+        """Parse one file with the native MultiSlot parser (C++ thread pool,
+        paddle_tpu/native) into per-slot [N, L] arrays; None if native
+        support is unavailable."""
+        from . import native
+
+        if not native.is_native():
+            return None
+        types = ["uint64" if v.dtype in ("int64", "int32") else "float"
+                 for v in self.use_vars]
+        lens = [self._slot_len(v) for v in self.use_vars]
+        return native.parse_multislot_file(path, types, lens,
+                                           threads=self.thread_num)
+
+    def _iter_examples_native(self):
+        for path in self.filelist:
+            arrays = self._native_file_arrays(path)
+            if arrays is None:
+                yield from self._iter_file(path)
+                continue
+            n = arrays[0].shape[0] if arrays else 0
+            for i in range(n):
+                yield [a[i] for a in arrays]
+
     def batch_iterator(self):
-        return self._batches_from(self._iter_examples())
+        return self._batches_from(self._iter_examples_native())
 
 
 class QueueDataset(DatasetBase):
@@ -155,7 +182,7 @@ class InMemoryDataset(DatasetBase):
         self._loaded = False
 
     def load_into_memory(self):
-        self._examples = list(self._iter_examples())
+        self._examples = list(self._iter_examples_native())
         self._loaded = True
 
     def local_shuffle(self):

@@ -1,0 +1,57 @@
+"""RecordIO conversion helpers (reference:
+``python/paddle/fluid/recordio_writer.py`` —
+``convert_reader_to_recordio_file`` serializes feeder-built batches into a
+recordio file consumed by reader ops).
+
+Serialization here is npz-per-record (a record holds one sample: a tuple of
+arrays) over the native chunked writer (paddle_tpu/native/src/recordio.cc).
+"""
+
+import io
+
+import numpy as np
+
+from . import native
+
+__all__ = [
+    "convert_reader_to_recordio_file",
+    "recordio_reader",
+]
+
+
+def _pack(sample):
+    buf = io.BytesIO()
+    arrays = {("f%d" % i): np.asarray(a) for i, a in enumerate(sample)}
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def _unpack(record):
+    with np.load(io.BytesIO(record)) as z:
+        return tuple(z["f%d" % i] for i in range(len(z.files)))
+
+
+def convert_reader_to_recordio_file(filename, reader_creator, feeder=None,
+                                    compressor=None, max_num_records=1000):
+    """Writes every sample from ``reader_creator()`` into ``filename``.
+    Returns the number of records written."""
+    count = 0
+    with native.RecordIOWriter(filename,
+                               max_chunk_records=max_num_records) as w:
+        for sample in reader_creator():
+            if not isinstance(sample, (tuple, list)):
+                sample = (sample,)
+            w.write(_pack(sample))
+            count += 1
+    return count
+
+
+def recordio_reader(filename):
+    """Reader creator yielding the samples stored in ``filename``."""
+
+    def reader():
+        with native.RecordIOScanner(filename) as s:
+            for record in s:
+                yield _unpack(record)
+
+    return reader
