@@ -71,6 +71,7 @@ from . import contrib
 from . import reader
 from . import native
 from . import recordio_writer
+from . import inference
 from .reader import PyReader, DataLoader
 from .io import (
     save_vars,

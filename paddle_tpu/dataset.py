@@ -129,13 +129,11 @@ class DatasetBase:
         return feed
 
     def _native_file_arrays(self, path):
-        """Parse one file with the native MultiSlot parser (C++ thread pool,
-        paddle_tpu/native) into per-slot [N, L] arrays; None if native
-        support is unavailable."""
+        """Parse one file with the MultiSlot parser (C++ thread pool when
+        available, else its semantics-identical Python fallback —
+        paddle_tpu/native) into per-slot [N, L] arrays."""
         from . import native
 
-        if not native.is_native():
-            return None
         types = ["uint64" if v.dtype in ("int64", "int32") else "float"
                  for v in self.use_vars]
         lens = [self._slot_len(v) for v in self.use_vars]
@@ -145,9 +143,6 @@ class DatasetBase:
     def _iter_examples_native(self):
         for path in self.filelist:
             arrays = self._native_file_arrays(path)
-            if arrays is None:
-                yield from self._iter_file(path)
-                continue
             n = arrays[0].shape[0] if arrays else 0
             for i in range(n):
                 yield [a[i] for a in arrays]

@@ -1,0 +1,226 @@
+"""Inference stack: analysis passes + predictor API.
+
+Reference: ``paddle/fluid/inference/`` — ``AnalysisPredictor``
+(``api/analysis_predictor.h``: load ``__model__`` + params, run the
+Analyzer pass pipeline, execute with ``NaiveExecutor``), config objects
+(``api/paddle_analysis_config.h``), and the python
+``transpiler/inference_transpiler.py`` (conv+bn folding).
+
+TPU-native notes: XLA already fuses elementwise chains into the conv, so
+the payoff of conv+bn folding here is removing the bn op's extra
+params/state from the graph (smaller program, fewer buffers) and matching
+the reference's transpiler surface; the predictor's "optimization" is
+mostly jit-cache warmth — the Executor jit-compiles the pruned program
+whole.
+"""
+
+import os
+
+import numpy as np
+
+from . import io as fluid_io
+from .executor import Executor, Scope, scope_guard
+from .framework import Program
+from .core import TPUPlace
+
+__all__ = [
+    "InferenceTranspiler",
+    "AnalysisConfig",
+    "AnalysisPredictor",
+    "create_paddle_predictor",
+    "fuse_conv_bn",
+]
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+def fuse_conv_bn(program, scope, eps_default=1e-5):
+    """Fold batch_norm (inference mode) into the preceding conv2d
+    (reference inference_transpiler.py:  _fuse_param / fuse_batch_norm).
+
+    W' = W * gamma / sqrt(var + eps)   (per output channel)
+    b' = beta - mean * gamma / sqrt(var + eps)
+    The bn op is replaced by an elementwise_add of b' (XLA fuses it into
+    the conv).  Returns the number of folded pairs.
+    """
+    block = program.global_block()
+    # map: var name -> (op index, op) of its single producer; count readers
+    producers = {}
+    read_count = {}
+    for i, op in enumerate(block.ops):
+        for name in op.input_arg_names:
+            read_count[name] = read_count.get(name, 0) + 1
+        for name in op.output_arg_names:
+            producers[name] = (i, op)
+
+    fused = 0
+    i = 0
+    while i < len(block.ops):
+        op = block.ops[i]
+        if op.type != "batch_norm" or not (
+                op.attrs.get("is_test") or op.attrs.get("use_global_stats")):
+            i += 1
+            continue
+        x_name = op.inputs["X"][0]
+        if read_count.get(x_name, 0) != 1:
+            i += 1
+            continue
+        prod = producers.get(x_name)
+        # chain shapes: conv2d → bn, or conv2d → elementwise_add(bias) → bn
+        # (the conv2d layer appends a separate bias add, layers/nn.py)
+        conv_op = None
+        bias_add_op = None
+        if prod is not None and prod[1].type in ("conv2d",
+                                                 "depthwise_conv2d"):
+            conv_op = prod[1]
+        elif (prod is not None and prod[1].type == "elementwise_add"
+              and prod[1].attrs.get("axis", -1) == 1):
+            add_x = prod[1].inputs["X"][0]
+            up = producers.get(add_x)
+            if (up is not None
+                    and up[1].type in ("conv2d", "depthwise_conv2d")
+                    and read_count.get(add_x, 0) == 1):
+                conv_op = up[1]
+                bias_add_op = prod[1]
+        if conv_op is None:
+            i += 1
+            continue
+        if conv_op.attrs.get("data_format", "NCHW") != "NCHW" or \
+                op.attrs.get("data_layout", "NCHW") != "NCHW":
+            i += 1
+            continue
+
+        scale = np.asarray(scope.get(op.inputs["Scale"][0]))
+        bias = np.asarray(scope.get(op.inputs["Bias"][0]))
+        mean = np.asarray(scope.get(op.inputs["Mean"][0]))
+        var = np.asarray(scope.get(op.inputs["Variance"][0]))
+        eps = float(op.attrs.get("epsilon", eps_default))
+        std = np.sqrt(var + eps)
+        gamma_over_std = scale / std
+
+        w_name = conv_op.inputs["Filter"][0]
+        w = np.asarray(scope.get(w_name))
+        w = w * gamma_over_std[:, None, None, None]
+        scope.set(w_name, w.astype(np.float32))
+
+        y_name = op.outputs["Y"][0]
+        if bias_add_op is not None:
+            # fold into the existing conv bias; rewire the add to produce
+            # the bn's output var
+            cb_name = bias_add_op.inputs["Y"][0]
+            cb = np.asarray(scope.get(cb_name)).reshape(-1)
+            b_new = ((cb - mean) * gamma_over_std + bias).astype(np.float32)
+            scope.set(cb_name, b_new.reshape(np.shape(scope.get(cb_name))))
+            bias_add_op.outputs["Out"] = [y_name]
+            block._remove_op(i)
+            # i now points at the op after the removed bn; don't advance
+        else:
+            b_new = (bias - mean * gamma_over_std).astype(np.float32)
+            bias_var_name = y_name + ".fused_bn_bias"
+            bias_var = block.create_var(
+                name=bias_var_name, shape=(b_new.shape[0],),
+                dtype="float32", persistable=True)
+            bias_var.stop_gradient = True
+            scope.set(bias_var_name, b_new)
+            # replace the bn op with the add (channel axis 1, NCHW)
+            block._remove_op(i)
+            block._insert_op(
+                i, type="elementwise_add",
+                inputs={"X": [x_name], "Y": [bias_var_name]},
+                outputs={"Out": [y_name]},
+                attrs={"axis": 1},
+            )
+            i += 1
+        fused += 1
+    if fused:
+        program._bump_version()
+    return fused
+
+
+class InferenceTranspiler:
+    """Reference ``transpiler/inference_transpiler.py`` surface."""
+
+    def transpile(self, program, place=None, scope=None):
+        if scope is None:
+            from .executor import global_scope
+
+            scope = global_scope()
+        fuse_conv_bn(program, scope)
+        return program
+
+
+class AnalysisConfig:
+    """Reference ``api/paddle_analysis_config.h`` (subset: model path +
+    optimization switches; device knobs are meaningless off-GPU)."""
+
+    def __init__(self, model_dir=None, prog_file=None, params_file=None):
+        self.model_dir = model_dir
+        self.prog_file = prog_file
+        self.params_file = params_file
+        self._ir_optim = True
+
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = bool(flag)
+
+    def ir_optim(self):
+        return self._ir_optim
+
+
+class AnalysisPredictor:
+    """Load → analyze → run (reference analysis_predictor.h:50).
+
+    Owns a private scope (like the reference's sub-scope) so concurrent
+    predictors don't clash; ``run`` takes/returns numpy arrays in feed
+    order.
+    """
+
+    def __init__(self, config):
+        self._config = config
+        self._scope = Scope()
+        self._place = TPUPlace()
+        self._exe = Executor(self._place)
+        with scope_guard(self._scope):
+            program, feed_names, fetch_vars = fluid_io.load_inference_model(
+                config.model_dir, self._exe,
+                model_filename=config.prog_file,
+                params_filename=config.params_file)
+            if config.ir_optim():
+                fuse_conv_bn(program, self._scope)
+        self._program = program
+        self._feed_names = feed_names
+        self._fetch_vars = fetch_vars
+
+    def get_input_names(self):
+        return list(self._feed_names)
+
+    def get_output_names(self):
+        return [v.name for v in self._fetch_vars]
+
+    @property
+    def program(self):
+        return self._program
+
+    def run(self, inputs):
+        """inputs: list of numpy arrays in get_input_names() order (or a
+        dict name→array).  Returns list of numpy arrays."""
+        if isinstance(inputs, dict):
+            feed = dict(inputs)
+        else:
+            inputs = _as_list(inputs)
+            if len(inputs) != len(self._feed_names):
+                raise ValueError(
+                    "expected %d inputs (%s), got %d" % (
+                        len(self._feed_names), self._feed_names,
+                        len(inputs)))
+            feed = dict(zip(self._feed_names, inputs))
+        with scope_guard(self._scope):
+            outs = self._exe.run(self._program, feed=feed,
+                                 fetch_list=self._fetch_vars)
+        return [np.asarray(o) for o in outs]
+
+
+def create_paddle_predictor(config):
+    """Reference ``CreatePaddlePredictor<AnalysisConfig>``."""
+    return AnalysisPredictor(config)

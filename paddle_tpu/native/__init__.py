@@ -152,12 +152,14 @@ class RecordIOWriter:
 
     def close(self):
         if self._lib is not None:
-            if self._lib.rio_writer_close(self._h) != 0:
-                raise IOError("recordio flush failed")
-            self._h = None
-        else:
+            if self._h:
+                h, self._h = self._h, None  # C side frees even on error
+                if self._lib.rio_writer_close(h) != 0:
+                    raise IOError("recordio flush failed")
+        elif self._f is not None:
             self._flush()
             self._f.close()
+            self._f = None
 
     def __enter__(self):
         return self
@@ -204,6 +206,8 @@ class RecordIOScanner:
             head = self._f.read(20)
             if not head:
                 raise StopIteration
+            if len(head) < 20:  # truncated header
+                raise IOError("corrupt recordio chunk")
             magic, num, comp, crc, size = struct.unpack("<IIIII", head)
             if magic != _RIO_MAGIC or comp != 0:
                 raise IOError("corrupt recordio chunk")
@@ -306,7 +310,17 @@ def parse_multislot_file(path, slot_types, slot_lens, threads=0):
                     if types[s] == 0:
                         vals.append([float(x) for x in v])
                     else:
-                        vals.append([int(x) for x in v])
+                        # uint64 feasigns wrap two's-complement into int64,
+                        # matching the native parser's C cast (jax has no
+                        # uint64 on TPU; hash ids below 2^63 to avoid
+                        # negative embedding rows)
+                        vals.append(
+                            [((int(x) & 0xFFFFFFFFFFFFFFFF)
+                              - (1 << 64)
+                              if (int(x) & 0xFFFFFFFFFFFFFFFF)
+                              >= (1 << 63)
+                              else int(x) & 0xFFFFFFFFFFFFFFFF)
+                             for x in v])
                 except ValueError:
                     ok = False
                     break

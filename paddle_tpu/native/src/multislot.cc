@@ -144,6 +144,9 @@ void* ms_parse_file(const char* path, const int* slot_types,
                       &iparts[t], &counts[t], &errors[t]);
   }
   for (auto& th : pool) th.join();
+  // release the raw text before merging: bounds peak memory to roughly
+  // two copies of the parsed data (per-thread parts + merged buffers)
+  std::string().swap(text);
 
   ParsedFile* out = new ParsedFile();
   out->num_slots = num_slots;
@@ -156,13 +159,17 @@ void* ms_parse_file(const char* path, const int* slot_types,
     if (slot_types[s] == 0) {
       auto& dst = out->fbuf[s];
       dst.reserve(out->num_examples * slot_lens[s]);
-      for (int t = 0; t < actual; ++t)
+      for (int t = 0; t < actual; ++t) {
         dst.insert(dst.end(), fparts[t][s].begin(), fparts[t][s].end());
+        std::vector<float>().swap(fparts[t][s]);  // free as we merge
+      }
     } else {
       auto& dst = out->ibuf[s];
       dst.reserve(out->num_examples * slot_lens[s]);
-      for (int t = 0; t < actual; ++t)
+      for (int t = 0; t < actual; ++t) {
         dst.insert(dst.end(), iparts[t][s].begin(), iparts[t][s].end());
+        std::vector<int64_t>().swap(iparts[t][s]);
+      }
     }
   }
   return out;

@@ -1,0 +1,127 @@
+"""Inference stack tests (reference: inference/tests/api/*,
+unittests/test_inference_model_io.py, test_inference_transpiler.py —
+save → load → predict round-trips and pass-preserves-output checks)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.executor import Scope, scope_guard
+from paddle_tpu.inference import (
+    AnalysisConfig, create_paddle_predictor, fuse_conv_bn,
+    InferenceTranspiler)
+
+
+def _build_convbn_model():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[3, 8, 8], dtype="float32")
+        conv = fluid.layers.conv2d(img, num_filters=4, filter_size=3,
+                                   padding=1)
+        bn = fluid.layers.batch_norm(conv, act="relu")
+        conv2 = fluid.layers.conv2d(bn, num_filters=4, filter_size=3,
+                                    padding=1)
+        bn2 = fluid.layers.batch_norm(conv2)
+        pool = fluid.layers.pool2d(bn2, pool_size=8, pool_type="avg")
+        logits = fluid.layers.fc(pool, size=3)
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        test_prog = main.clone(for_test=True)
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    return main, startup, test_prog, img, label, logits, loss
+
+
+class TestFuseConvBn:
+    def test_fold_preserves_output(self):
+        main, startup, test_prog, img, label, logits, loss = \
+            _build_convbn_model()
+        exe = fluid.Executor(fluid.CPUPlace())
+        rng = np.random.RandomState(0)
+        x = rng.rand(2, 3, 8, 8).astype("float32")
+        y = rng.randint(0, 3, size=(2, 1)).astype("int64")
+        scope = Scope()
+        with scope_guard(scope):
+            exe.run(startup)
+            # a few steps so bn stats are non-trivial
+            for _ in range(5):
+                exe.run(main, feed={"img": x, "label": y},
+                        fetch_list=[loss])
+            (before,) = exe.run(test_prog, feed={"img": x, "label": y},
+                                fetch_list=[logits])
+            n_bn = sum(op.type == "batch_norm"
+                       for op in test_prog.global_block().ops)
+            assert n_bn == 2
+            fused = fuse_conv_bn(test_prog, scope)
+            assert fused == 2
+            assert not any(op.type == "batch_norm"
+                           for op in test_prog.global_block().ops)
+            (after,) = exe.run(test_prog, feed={"img": x, "label": y},
+                               fetch_list=[logits])
+        np.testing.assert_allclose(before, after, rtol=1e-4, atol=1e-5)
+
+    def test_transpiler_surface(self):
+        main, startup, test_prog, img, label, logits, loss = \
+            _build_convbn_model()
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = Scope()
+        with scope_guard(scope):
+            exe.run(startup)
+            InferenceTranspiler().transpile(test_prog, fluid.CPUPlace(),
+                                            scope)
+        assert not any(op.type == "batch_norm"
+                       for op in test_prog.global_block().ops)
+
+
+class TestAnalysisPredictor:
+    def test_save_load_predict(self, tmp_path):
+        main, startup, test_prog, img, label, logits, loss = \
+            _build_convbn_model()
+        exe = fluid.Executor(fluid.CPUPlace())
+        rng = np.random.RandomState(1)
+        x = rng.rand(2, 3, 8, 8).astype("float32")
+        y = rng.randint(0, 3, size=(2, 1)).astype("int64")
+        model_dir = str(tmp_path / "model")
+        with scope_guard(Scope()):
+            exe.run(startup)
+            for _ in range(3):
+                exe.run(main, feed={"img": x, "label": y},
+                        fetch_list=[loss])
+            (expect,) = exe.run(test_prog, feed={"img": x, "label": y},
+                                fetch_list=[logits])
+            fluid.io.save_inference_model(
+                model_dir, ["img"], [logits], exe, main_program=test_prog)
+
+        for ir_optim in (False, True):
+            config = AnalysisConfig(model_dir)
+            config.switch_ir_optim(ir_optim)
+            pred = create_paddle_predictor(config)
+            assert pred.get_input_names() == ["img"]
+            (got,) = pred.run([x])
+            np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+            has_bn = any(op.type == "batch_norm"
+                         for op in pred.program.global_block().ops)
+            assert has_bn == (not ir_optim)
+
+    def test_predictors_isolated(self, tmp_path):
+        """Two predictors own separate scopes (reference: per-predictor
+        sub-scope in analysis_predictor.cc)."""
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            out = fluid.layers.fc(x, size=2)
+        exe = fluid.Executor(fluid.CPUPlace())
+        d = str(tmp_path / "m")
+        with scope_guard(Scope()):
+            exe.run(startup)
+            fluid.io.save_inference_model(d, ["x"], [out], exe,
+                                          main_program=main)
+        p1 = create_paddle_predictor(AnalysisConfig(d))
+        p2 = create_paddle_predictor(AnalysisConfig(d))
+        xv = np.ones((1, 4), "float32")
+        r1 = p1.run([xv])[0]
+        # clobber p2's params; p1 must be unaffected
+        p2._scope.set(p2.program.all_parameters()[0].name,
+                      np.zeros_like(p2._scope.get(
+                          p2.program.all_parameters()[0].name)))
+        r1b = p1.run([xv])[0]
+        np.testing.assert_array_equal(r1, r1b)
