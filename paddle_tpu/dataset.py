@@ -72,40 +72,6 @@ class DatasetBase:
             inner *= abs(d)
         return max(inner, 1)
 
-    def _parse_line(self, line):
-        """MultiSlot: per use_var, ``<count> v1 v2 ...`` (data_feed.cc
-        MultiSlotDataFeed::ParseOneInstance)."""
-        toks = line.split()
-        pos = 0
-        example = []
-        for var in self.use_vars:
-            n = int(toks[pos])
-            pos += 1
-            vals = toks[pos: pos + n]
-            pos += n
-            if var.dtype in ("int64", "int32"):
-                arr = np.asarray([int(v) for v in vals], dtype="int64")
-            else:
-                arr = np.asarray([float(v) for v in vals], dtype="float32")
-            L = self._slot_len(var)
-            if arr.size < L:  # pad with zeros (padding id 0 by convention)
-                arr = np.concatenate(
-                    [arr, np.zeros(L - arr.size, arr.dtype)]
-                )
-            example.append(arr[:L])
-        return example
-
-    def _iter_file(self, path):
-        with open(path) as f:
-            for line in f:
-                line = line.strip()
-                if line:
-                    yield self._parse_line(line)
-
-    def _iter_examples(self):
-        for path in self.filelist:
-            yield from self._iter_file(path)
-
     def _batches_from(self, examples):
         batch = []
         for ex in examples:

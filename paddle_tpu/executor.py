@@ -354,16 +354,19 @@ class Executor:
             sig,
             tuple(fetch_names),
         )
+        from . import profiler as _prof
+
         compiled = self._cache.get(key_tuple) if use_program_cache else None
         if compiled is None:
-            compiled = _CompiledBlock(
-                program,
-                program.global_block(),
-                list(feed_vals),
-                fetch_names,
-                scope,
-                mode,
-            )
+            with _prof.record_event("executor.lower_and_jit"):
+                compiled = _CompiledBlock(
+                    program,
+                    program.global_block(),
+                    list(feed_vals),
+                    fetch_names,
+                    scope,
+                    mode,
+                )
             if use_program_cache:
                 self._cache[key_tuple] = compiled
 
@@ -373,7 +376,14 @@ class Executor:
         base_key = jax.random.fold_in(jax.random.key(seed), self._step)
         self._step += 1
 
-        fetches, new_rw, fresh = compiled.jitted(feed_vals, rw, ro, base_key)
+        import contextlib
+
+        run_ctx = (_prof.record_event("executor.run")
+                   if _prof.is_profiler_enabled()
+                   else contextlib.nullcontext())
+        with run_ctx:
+            fetches, new_rw, fresh = compiled.jitted(
+                feed_vals, rw, ro, base_key)
         for n, v in new_rw.items():
             scope.set(n, v)
         for n, v in fresh.items():

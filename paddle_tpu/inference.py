@@ -14,8 +14,6 @@ mostly jit-cache warmth — the Executor jit-compiles the pruned program
 whole.
 """
 
-import os
-
 import numpy as np
 
 from . import io as fluid_io
@@ -89,6 +87,14 @@ def fuse_conv_bn(program, scope, eps_default=1e-5):
             continue
         if conv_op.attrs.get("data_format", "NCHW") != "NCHW" or \
                 op.attrs.get("data_layout", "NCHW") != "NCHW":
+            i += 1
+            continue
+        # never fold into weight-shared params (another op would see the
+        # scaled filter/bias)
+        w_shared = read_count.get(conv_op.inputs["Filter"][0], 0) != 1
+        b_shared = (bias_add_op is not None
+                    and read_count.get(bias_add_op.inputs["Y"][0], 0) != 1)
+        if w_shared or b_shared:
             i += 1
             continue
 
@@ -181,11 +187,25 @@ class AnalysisPredictor:
         self._scope = Scope()
         self._place = TPUPlace()
         self._exe = Executor(self._place)
+        # accept both forms: model_dir (+ optional relative filenames) or
+        # full prog_file/params_file paths (reference AnalysisConfig)
+        import os
+
+        model_dir = config.model_dir
+        prog_file, params_file = config.prog_file, config.params_file
+        if model_dir is None:
+            if prog_file is None:
+                raise ValueError(
+                    "AnalysisConfig needs model_dir or prog_file")
+            model_dir = os.path.dirname(os.path.abspath(prog_file))
+            prog_file = os.path.basename(prog_file)
+            if params_file is not None:
+                params_file = os.path.basename(params_file)
         with scope_guard(self._scope):
             program, feed_names, fetch_vars = fluid_io.load_inference_model(
-                config.model_dir, self._exe,
-                model_filename=config.prog_file,
-                params_filename=config.params_file)
+                model_dir, self._exe,
+                model_filename=prog_file,
+                params_filename=params_file)
             if config.ir_optim():
                 fuse_conv_bn(program, self._scope)
         self._program = program

@@ -247,6 +247,11 @@ class RecordIOScanner:
 # MultiSlot parser
 # ---------------------------------------------------------------------------
 
+def _wrap_u64(x):
+    u = int(x) & 0xFFFFFFFFFFFFFFFF
+    return u - (1 << 64) if u >= (1 << 63) else u
+
+
 def parse_multislot_file(path, slot_types, slot_lens, threads=0):
     """Parse a MultiSlot text file into dense per-slot arrays.
 
@@ -314,13 +319,7 @@ def parse_multislot_file(path, slot_types, slot_lens, threads=0):
                         # matching the native parser's C cast (jax has no
                         # uint64 on TPU; hash ids below 2^63 to avoid
                         # negative embedding rows)
-                        vals.append(
-                            [((int(x) & 0xFFFFFFFFFFFFFFFF)
-                              - (1 << 64)
-                              if (int(x) & 0xFFFFFFFFFFFFFFFF)
-                              >= (1 << 63)
-                              else int(x) & 0xFFFFFFFFFFFFFFFF)
-                             for x in v])
+                        vals.append([_wrap_u64(x) for x in v])
                 except ValueError:
                     ok = False
                     break

@@ -1,38 +1,139 @@
-"""Profiler bridge (reference: ``python/paddle/fluid/profiler.py`` +
-``platform/profiler.h`` RecordEvent + CUPTI device tracer + timeline.py).
+"""Profiler: host event recorder + device tracer bridge + timeline export.
 
-TPU-native: jax's XPlane profiler is the device tracer; traces are written
-as TensorBoard trace files (the chrome://tracing role of
-``tools/timeline.py``).  `_RecordEvent`/`record_event` maps to
-``jax.profiler.TraceAnnotation`` so user annotations appear in the trace."""
+Reference surfaces reproduced:
+* ``platform/profiler.h`` — RAII ``RecordEvent`` wrapped around every op
+  run, thread-local ``EventList``, ``EnableProfiler/DisableProfiler``
+  printing tables aggregated by total/max/ave/calls.  Here host events
+  come from ``record_event`` scopes and the Executor's phase hooks
+  (lower/compile/execute) — per-op host timing does not exist under a
+  whole-block jit, so phases are the host-side unit of accounting (the
+  per-op cost lives in the device trace, which XLA annotates with HLO op
+  names).
+* ``tools/timeline.py:115-161`` — chrome://tracing JSON; written directly
+  by ``stop_profiler`` from the recorded host events.
+* device side: ``jax.profiler`` (XPlane → TensorBoard), the CUPTI
+  ``DeviceTracer`` analogue; ``record_event`` doubles as a
+  ``jax.profiler.TraceAnnotation`` so user scopes appear in device traces.
+"""
 
 import contextlib
+import json
 import tempfile
+import threading
+import time
 
 __all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
-           "record_event", "cuda_profiler"]
+           "record_event", "cuda_profiler", "is_profiler_enabled"]
 
 _trace_dir = None
+_enabled = False
+_events = []          # (name, tid, t0_us, t1_us)
+_events_lock = threading.Lock()
+_device_trace = False
+
+
+def is_profiler_enabled():
+    return _enabled
 
 
 def start_profiler(state="All", tracer_option=None):
-    import jax
+    """state: 'CPU' → host events only; 'GPU'/'All' → also start the jax
+    device tracer (reference profiler.py:127 semantics, GPU≈device)."""
+    global _enabled, _trace_dir, _device_trace
+    reset_profiler()
+    _enabled = True
+    _device_trace = state in ("GPU", "All")
+    if _device_trace:
+        import jax
 
-    global _trace_dir
-    _trace_dir = tempfile.mkdtemp(prefix="paddle_tpu_profile_")
-    jax.profiler.start_trace(_trace_dir)
+        _trace_dir = tempfile.mkdtemp(prefix="paddle_tpu_profile_")
+        try:
+            jax.profiler.start_trace(_trace_dir)
+        except Exception:
+            _device_trace = False
+
+
+def _aggregate():
+    table = {}
+    for name, tid, t0, t1 in _events:
+        row = table.setdefault(name, [0, 0.0, 0.0, None])
+        dt = (t1 - t0) / 1000.0  # ms
+        row[0] += 1
+        row[1] += dt
+        row[2] = max(row[2], dt)
+        row[3] = dt if row[3] is None else min(row[3], dt)
+    return table
+
+
+def _print_summary(sorted_key):
+    table = _aggregate()
+    if not table:
+        return
+    keyfn = {
+        None: lambda kv: -kv[1][1],
+        "default": lambda kv: -kv[1][1],
+        "total": lambda kv: -kv[1][1],
+        "calls": lambda kv: -kv[1][0],
+        "max": lambda kv: -kv[1][2],
+        "min": lambda kv: kv[1][3],
+        "ave": lambda kv: -(kv[1][1] / kv[1][0]),
+    }.get(sorted_key, lambda kv: -kv[1][1])
+    rows = sorted(table.items(), key=keyfn)
+    name_w = max(len("Event"), *(len(n) for n, _ in rows)) + 2
+    print("\n------------------------->  Profiling Report  "
+          "<-------------------------\n")
+    print("%-*s %-8s %-12s %-12s %-12s %-12s" % (
+        name_w, "Event", "Calls", "Total(ms)", "Max(ms)", "Min(ms)",
+        "Ave(ms)"))
+    for name, (calls, total, mx, mn) in rows:
+        print("%-*s %-8d %-12.4f %-12.4f %-12.4f %-12.4f" % (
+            name_w, name, calls, total, mx, mn or 0.0, total / calls))
+    print()
+
+
+def _write_chrome_trace(path):
+    """chrome://tracing 'traceEvents' JSON (tools/timeline.py output
+    format: X (complete) events with microsecond timestamps)."""
+    events = []
+    for name, tid, t0, t1 in _events:
+        events.append({
+            "name": name, "cat": "paddle_tpu", "ph": "X",
+            "pid": 0, "tid": tid, "ts": t0, "dur": t1 - t0,
+        })
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events,
+                   "displayTimeUnit": "ms"}, f)
 
 
 def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
-    import jax
+    global _enabled, _device_trace
+    if not _enabled:
+        return
+    _enabled = False
+    if _device_trace:
+        import jax
 
-    jax.profiler.stop_trace()
-    print("[paddle_tpu.profiler] trace written under %s "
-          "(open with TensorBoard)" % _trace_dir)
+        try:
+            jax.profiler.stop_trace()
+            print("[paddle_tpu.profiler] device trace under %s "
+                  "(open with TensorBoard)" % _trace_dir)
+        except Exception:
+            pass
+        _device_trace = False
+    if profile_path:
+        try:
+            _write_chrome_trace(profile_path)
+            print("[paddle_tpu.profiler] host timeline written to %s "
+                  "(open with chrome://tracing)" % profile_path)
+        except OSError:
+            pass
+    _print_summary(sorted_key)
 
 
 def reset_profiler():
-    pass
+    global _events
+    with _events_lock:
+        _events = []
 
 
 @contextlib.contextmanager
@@ -47,10 +148,28 @@ def profiler(state="All", sorted_key=None, profile_path="/tmp/profile",
 
 @contextlib.contextmanager
 def record_event(name):
+    """Scoped annotation: host event (when profiling) + device trace
+    annotation (reference RecordEvent, profiler.h:81)."""
+    if not _enabled:
+        # still forward to the device tracer so annotations show up in
+        # externally started jax traces
+        import jax
+
+        with jax.profiler.TraceAnnotation(name):
+            yield
+        return
     import jax
 
-    with jax.profiler.TraceAnnotation(name):
-        yield
+    # wall-clock epoch so traces from different hosts merge sensibly in
+    # tools/timeline.py
+    t0 = time.time_ns() // 1000
+    try:
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    finally:
+        t1 = time.time_ns() // 1000
+        with _events_lock:
+            _events.append((name, threading.get_ident() % 10000, t0, t1))
 
 
 @contextlib.contextmanager

@@ -1,0 +1,76 @@
+"""Generate the frozen public-API listing (API.spec).
+
+Reference: ``tools/print_signatures.py`` writes ``paddle/fluid/API.spec``
+(1031 entries) and ``tools/diff_api.py`` fails CI when the public surface
+changes without updating the spec.  Same contract here:
+
+  python tools/print_signatures.py > API.spec
+
+``tests/test_api_spec.py`` diffs the committed spec against a fresh
+generation.
+"""
+
+import inspect
+import sys
+
+
+MODULES = [
+    "paddle_tpu",
+    "paddle_tpu.layers",
+    "paddle_tpu.optimizer",
+    "paddle_tpu.initializer",
+    "paddle_tpu.regularizer",
+    "paddle_tpu.clip",
+    "paddle_tpu.io",
+    "paddle_tpu.nets",
+    "paddle_tpu.metrics",
+    "paddle_tpu.backward",
+    "paddle_tpu.profiler",
+    "paddle_tpu.inference",
+    "paddle_tpu.recordio_writer",
+    "paddle_tpu.dataset",
+    "paddle_tpu.transpiler",
+    "paddle_tpu.dygraph",
+    "paddle_tpu.contrib.mixed_precision",
+]
+
+
+def _signature_of(obj):
+    try:
+        return str(inspect.signature(obj))
+    except (ValueError, TypeError):
+        return "(...)"
+
+
+def iter_api():
+    import importlib
+
+    for modname in MODULES:
+        mod = importlib.import_module(modname)
+        names = getattr(mod, "__all__", None)
+        if names is None:
+            names = [n for n in dir(mod) if not n.startswith("_")]
+        for name in sorted(set(names)):
+            obj = getattr(mod, name, None)
+            if obj is None or inspect.ismodule(obj):
+                continue
+            if inspect.isclass(obj):
+                yield "%s.%s %s" % (modname, name,
+                                    _signature_of(obj.__init__))
+                for mname, meth in sorted(vars(obj).items()):
+                    if mname.startswith("_"):
+                        continue
+                    if callable(meth):
+                        yield "%s.%s.%s %s" % (modname, name, mname,
+                                               _signature_of(meth))
+            elif callable(obj):
+                yield "%s.%s %s" % (modname, name, _signature_of(obj))
+
+
+def main(out=sys.stdout):
+    for line in iter_api():
+        print(line, file=out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""Merge profile timelines into one chrome://tracing file.
+
+Reference: ``tools/timeline.py`` — converts profiler output to a chrome
+trace, one pid per device/profile.  Here each input is already a chrome
+trace JSON written by ``paddle_tpu.profiler.stop_profiler``; this tool
+merges several (e.g. one per host/worker) assigning a pid per input.
+
+Usage:
+  python tools/timeline.py --profile_path host0=/tmp/p0,host1=/tmp/p1 \
+      --timeline_path /tmp/timeline.json
+"""
+
+import argparse
+import json
+
+
+def merge(named_paths, out_path):
+    events = []
+    for pid, (name, path) in enumerate(named_paths):
+        with open(path) as f:
+            trace = json.load(f)
+        events.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": name},
+        })
+        for ev in trace.get("traceEvents", []):
+            ev = dict(ev)
+            ev["pid"] = pid
+            events.append(ev)
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return len(events)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--profile_path", required=True,
+                    help="comma-separated [name=]path entries")
+    ap.add_argument("--timeline_path", required=True)
+    args = ap.parse_args()
+    named = []
+    for i, ent in enumerate(args.profile_path.split(",")):
+        if "=" in ent:
+            name, path = ent.split("=", 1)
+        else:
+            name, path = "profile_%d" % i, ent
+        named.append((name, path))
+    n = merge(named, args.timeline_path)
+    print("wrote %d events to %s" % (n, args.timeline_path))
+
+
+if __name__ == "__main__":
+    main()
