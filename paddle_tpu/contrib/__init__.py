@@ -2,5 +2,6 @@
 mixed_precision AMP, slim (quant/prune/NAS), extend optimizers."""
 
 from . import mixed_precision
+from . import slim
 
-__all__ = ["mixed_precision"]
+__all__ = ["mixed_precision", "slim"]

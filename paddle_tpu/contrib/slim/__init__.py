@@ -1,0 +1,6 @@
+"""Model-compression toolkit (reference:
+``python/paddle/fluid/contrib/slim/``).  Quantization-aware training lives
+in ``quantization``; pruning/NAS/distillation strategies are composed from
+the base framework (clip/regularizer/program surgery) as needed."""
+
+from . import quantization  # noqa: F401

@@ -1,0 +1,8 @@
+from .quantization_pass import (  # noqa: F401
+    QuantizationTranspiler,
+    TransformForTraining,
+    QUANTIZABLE_OP_TYPES,
+)
+
+__all__ = ["QuantizationTranspiler", "TransformForTraining",
+           "QUANTIZABLE_OP_TYPES"]

@@ -1,0 +1,141 @@
+"""Quantization-aware training transform.
+
+Reference: ``python/paddle/fluid/contrib/slim/quantization/
+quantization_pass.py`` (``TransformForTrainingPass``: insert
+quant/dequant ops on every input of quantizable ops — conv2d,
+depthwise_conv2d, mul — weights with ``abs_max``, activations with
+``moving_average_abs_max``) and ``contrib/quantize/quantize_transpiler.py``.
+
+TPU-native: the inserted ops are the *fused* quantize+dequantize
+simulators (ops/quantize.py) so the transformed program stays float
+end-to-end (XLA fuses the round/clip chain into neighbours) while the
+straight-through grad ops make training quantization-aware.  Run this
+BEFORE ``append_backward``/``minimize`` (same contract as the reference
+pass operating on the forward IrGraph).
+"""
+
+from paddle_tpu.initializer import ConstantInitializer
+
+# which input slots of each quantizable op get quantized
+_QUANT_SLOTS = {
+    "conv2d": ("Input", "Filter"),
+    "depthwise_conv2d": ("Input", "Filter"),
+    "mul": ("X", "Y"),
+    "matmul": ("X", "Y"),
+}
+
+QUANTIZABLE_OP_TYPES = tuple(_QUANT_SLOTS)
+
+
+class TransformForTraining:
+    """Insert fake quant-dequant ops ahead of quantizable ops."""
+
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_quantize_type="abs_max", moving_rate=0.9):
+        if activation_quantize_type not in ("moving_average_abs_max",
+                                            "abs_max"):
+            raise ValueError(
+                "unsupported activation_quantize_type %r"
+                % activation_quantize_type)
+        if weight_quantize_type != "abs_max":
+            raise ValueError(
+                "unsupported weight_quantize_type %r" % weight_quantize_type)
+        self.weight_bits = int(weight_bits)
+        self.activation_bits = int(activation_bits)
+        self.activation_quantize_type = activation_quantize_type
+        self.moving_rate = float(moving_rate)
+
+    def apply(self, program, startup_program=None):
+        """Rewrites `program` in place; returns the number of quantized
+        input slots."""
+        block = program.global_block()
+        quantized = {}  # var name -> dequantized var name
+        count = 0
+        i = 0
+        while i < len(block.ops):
+            op = block.ops[i]
+            if op.type not in _QUANT_SLOTS or op.attrs.get("__quant_skip__"):
+                i += 1
+                continue
+            for slot in _QUANT_SLOTS[op.type]:
+                names = op.inputs.get(slot)
+                if not names:
+                    continue
+                name = names[0]
+                if name in quantized:
+                    op.inputs[slot] = [quantized[name]]
+                    continue
+                var = block._find_var_recursive(name)
+                if var is None:
+                    continue
+                is_weight = getattr(var, "persistable", False) or \
+                    type(var).__name__ == "Parameter"
+                n_new = self._insert_quant_dequant(
+                    block, i, name, var, is_weight, startup_program)
+                quantized[name] = name + ".quant_dequant"
+                op.inputs[slot] = [quantized[name]]
+                i += n_new
+                count += 1
+            i += 1
+        if count:
+            program._bump_version()
+        return count
+
+    def _insert_quant_dequant(self, block, idx, name, var, is_weight,
+                              startup_program):
+        """Insert the quant-dequant op at `idx`; returns #ops inserted."""
+        out_name = name + ".quant_dequant"
+        out = block.create_var(name=out_name, shape=var.shape,
+                               dtype=var.dtype)
+        out.stop_gradient = False
+        scale = block.create_var(
+            name=name + ".quant_scale", shape=(1,), dtype="float32",
+            persistable=True)
+        scale.stop_gradient = True
+
+        bits = self.weight_bits if is_weight else self.activation_bits
+        use_ma = (not is_weight
+                  and self.activation_quantize_type
+                  == "moving_average_abs_max")
+        if not use_ma:
+            block._insert_op(
+                idx,
+                type="fake_quantize_dequantize_abs_max",
+                inputs={"X": [name]},
+                outputs={"Out": [out_name], "OutScale": [scale.name]},
+                attrs={"bit_length": bits},
+            )
+            return 1
+
+        accum = block.create_var(
+            name=name + ".quant_accum", shape=(1,), dtype="float32",
+            persistable=True)
+        state = block.create_var(
+            name=name + ".quant_state", shape=(1,), dtype="float32",
+            persistable=True)
+        for v, init in ((scale, 1.0), (accum, 0.0), (state, 0.0)):
+            v.stop_gradient = True
+            if startup_program is not None:
+                sb = startup_program.global_block()
+                sv = sb.create_var(name=v.name, shape=v.shape,
+                                   dtype=v.dtype, persistable=True)
+                ConstantInitializer(init)(sv, sb)
+        block._insert_op(
+            idx,
+            type="fake_quantize_dequantize_moving_average_abs_max",
+            inputs={"X": [name], "InScale": [scale.name],
+                    "InAccum": [accum.name], "InState": [state.name]},
+            outputs={"Out": [out_name], "OutScale": [scale.name],
+                     "OutAccum": [accum.name], "OutState": [state.name]},
+            attrs={"bit_length": bits, "moving_rate": self.moving_rate},
+        )
+        return 1
+
+
+class QuantizationTranspiler(TransformForTraining):
+    """``contrib/quantize/quantize_transpiler.py`` façade: the v1.5 entry
+    point name, same transform."""
+
+    def training_transpile(self, program, startup_program=None):
+        return self.apply(program, startup_program)

@@ -32,3 +32,4 @@ from . import collective  # noqa: F401
 from . import detection  # noqa: F401
 from . import metrics  # noqa: F401
 from . import beam_search  # noqa: F401
+from . import quantize  # noqa: F401

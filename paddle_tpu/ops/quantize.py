@@ -1,0 +1,160 @@
+"""Fake-quantization ops (quantization-aware training).
+
+Reference: ``paddle/fluid/operators/fake_quantize_op.{h,cc}`` —
+``ClipAndFakeQuantFunctor``: Out = round(clip(X, -s, s) * bin_cnt / s),
+scale variants {abs_max, channel_wise_abs_max, range_abs_max,
+moving_average_abs_max}; ``fake_dequantize_op.cc``: Out = X * s/max_range.
+
+TPU-native notes: the quantize+dequantize pair used by QAT is also
+provided fused (``fake_quantize_dequantize_*``) with an explicit
+straight-through-estimator grad op (X@GRAD = Out@GRAD masked to the clip
+range) — the reference gets STE by transpiler wiring; here it is a
+registered ``*_grad`` lowering, so ``append_backward`` picks it up like
+any hand-written grad kernel.  Scale state (moving average accum/state)
+threads functionally through In*/Out* slots like batch-norm stats.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _bin_cnt(attrs):
+    return (1 << (int(attrs.get("bit_length", 8)) - 1)) - 1
+
+
+def _clip_quant(x, scale, bin_cnt):
+    s = jnp.maximum(scale, 1e-8)
+    clipped = jnp.clip(x, -s, s)
+    return jnp.round(clipped * (bin_cnt / s))
+
+
+@register_op("fake_quantize_abs_max", inputs=["X"],
+             outputs=["Out", "OutScale"], no_grad=True)
+def fake_quantize_abs_max(ctx, attrs, X):
+    bin_cnt = _bin_cnt(attrs)
+    scale = jnp.max(jnp.abs(X))
+    return _clip_quant(X, scale, bin_cnt), scale.reshape(1)
+
+
+@register_op("fake_channel_wise_quantize_abs_max", inputs=["X"],
+             outputs=["Out", "OutScale"], no_grad=True)
+def fake_channel_wise_quantize_abs_max(ctx, attrs, X):
+    """Per-output-channel scales (axis 0, conv filter layout)."""
+    bin_cnt = _bin_cnt(attrs)
+    scale = jnp.max(jnp.abs(X.reshape(X.shape[0], -1)), axis=1)
+    s_b = scale.reshape((-1,) + (1,) * (X.ndim - 1))
+    return _clip_quant(X, s_b, bin_cnt), scale
+
+
+@register_op("fake_dequantize_max_abs", inputs=["X", "Scale"],
+             outputs=["Out"], no_grad=True)
+def fake_dequantize_max_abs(ctx, attrs, X, Scale):
+    max_range = float(attrs.get("max_range", 127.0))
+    return X * (Scale.reshape(()) / max_range)
+
+
+@register_op("fake_channel_wise_dequantize_max_abs",
+             inputs=["X", "Scales*"], outputs=["Out"], no_grad=True)
+def fake_channel_wise_dequantize_max_abs(ctx, attrs, X, Scales):
+    """One scale: per-channel along axis 0 (conv filter case).  Two scales
+    (the mul/fc case, fake_dequantize_op.cc ChannelDequantizeFunctor
+    scale_num==2): Scales[0] is per-channel along the LAST axis, Scales[1]
+    a scalar."""
+    quant_bits = attrs.get("quant_bits", [8] * len(Scales))
+    out = X
+    for i, s in enumerate(Scales):
+        bits = int(quant_bits[i]) if i < len(quant_bits) else 8
+        max_range = float((1 << (bits - 1)) - 1)
+        if s.ndim >= 1 and s.size > 1:
+            if len(Scales) == 1:
+                shape = (-1,) + (1,) * (X.ndim - 1)
+            else:
+                shape = (1,) * (X.ndim - 1) + (-1,)
+            out = out * (s.reshape(shape) / max_range)
+        else:
+            out = out * (s.reshape(()) / max_range)
+    return out
+
+
+def _moving_average_scale(X, InAccum, InState, attrs):
+    rate = float(attrs.get("moving_rate", 0.9))
+    abs_max = jnp.max(jnp.abs(X))
+    accum = InAccum.reshape(()) * rate + abs_max
+    state = InState.reshape(()) * rate + 1.0
+    scale = accum / state
+    return scale, accum, state
+
+
+@register_op(
+    "fake_quantize_moving_average_abs_max",
+    inputs=["X", "InScale", "InAccum", "InState"],
+    outputs=["Out", "OutScale", "OutAccum", "OutState"], no_grad=True)
+def fake_quantize_moving_average_abs_max(ctx, attrs, X, InScale, InAccum,
+                                         InState):
+    bin_cnt = _bin_cnt(attrs)
+    if attrs.get("is_test", False) or InAccum is None:
+        scale = InScale.reshape(())
+        out = _clip_quant(X, scale, bin_cnt)
+        return out, scale.reshape(1), InAccum, InState
+    scale, accum, state = _moving_average_scale(X, InAccum, InState, attrs)
+    out = _clip_quant(X, scale, bin_cnt)
+    return out, scale.reshape(1), accum.reshape(1), state.reshape(1)
+
+
+# ---------------------------------------------------------------------------
+# fused quantize+dequantize (QAT simulation) with STE grads
+# ---------------------------------------------------------------------------
+
+def _quant_dequant(x, scale, bin_cnt):
+    s = jnp.maximum(scale, 1e-8)
+    return _clip_quant(x, s, bin_cnt) * (s / bin_cnt)
+
+
+@register_op("fake_quantize_dequantize_abs_max", inputs=["X"],
+             outputs=["Out", "OutScale"])
+def fake_quantize_dequantize_abs_max(ctx, attrs, X):
+    bin_cnt = _bin_cnt(attrs)
+    scale = jnp.max(jnp.abs(X))
+    return _quant_dequant(X, scale, bin_cnt), scale.reshape(1)
+
+
+@register_op("fake_quantize_dequantize_abs_max_grad",
+             inputs=["X", "Out", "OutScale", "Out@GRAD"],
+             outputs=["X@GRAD"], no_grad=True)
+def fake_quantize_dequantize_abs_max_grad(ctx, attrs, X, Out, OutScale,
+                                          Out_grad):
+    # straight-through estimator; abs_max scale never clips interior values
+    return Out_grad
+
+
+@register_op(
+    "fake_quantize_dequantize_moving_average_abs_max",
+    inputs=["X", "InScale", "InAccum", "InState"],
+    outputs=["Out", "OutScale", "OutAccum", "OutState"],
+    stateful_outputs=("OutAccum", "OutState", "OutScale"))
+def fake_quantize_dequantize_moving_average_abs_max(ctx, attrs, X, InScale,
+                                                    InAccum, InState):
+    bin_cnt = _bin_cnt(attrs)
+    if attrs.get("is_test", False) or InAccum is None:
+        scale = InScale.reshape(())
+        return (_quant_dequant(X, scale, bin_cnt), scale.reshape(1),
+                InAccum, InState)
+    scale, accum, state = _moving_average_scale(X, InAccum, InState, attrs)
+    return (_quant_dequant(X, scale, bin_cnt), scale.reshape(1),
+            accum.reshape(1), state.reshape(1))
+
+
+@register_op(
+    "fake_quantize_dequantize_moving_average_abs_max_grad",
+    inputs=["X", "InScale", "InAccum", "InState", "Out", "OutScale",
+            "OutAccum", "OutState", "Out@GRAD"],
+    outputs=["X@GRAD"], no_grad=True)
+def fake_qdq_moving_average_grad(ctx, attrs, X, InScale, InAccum, InState,
+                                 Out, OutScale, OutAccum, OutState,
+                                 Out_grad):
+    # STE with clip masking: values clipped by the running scale get no grad
+    s = jnp.maximum(OutScale.reshape(()), 1e-8)
+    inside = (jnp.abs(X) <= s).astype(Out_grad.dtype)
+    return Out_grad * inside

@@ -1,0 +1,159 @@
+"""Quantization-aware training tests (reference:
+unittests/test_fake_quantize_op.py, test_fake_dequantize_op.py, and
+slim/tests/test_quantization_pass.py)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.executor import Scope, scope_guard
+from paddle_tpu.contrib.slim.quantization import (
+    QuantizationTranspiler, TransformForTraining)
+from op_test import OpTest
+
+rng = np.random.RandomState(0)
+
+
+class TestFakeQuantizeAbsMax(OpTest):
+    op_type = "fake_quantize_abs_max"
+
+    def test_output(self):
+        x = rng.randn(8, 6).astype("float32")
+        scale = np.max(np.abs(x))
+        bin_cnt = 127.0
+        out = np.round(np.clip(x, -scale, scale) * bin_cnt / scale)
+        self.inputs = {"X": x}
+        self.attrs = {"bit_length": 8}
+        self.outputs = {"Out": out, "OutScale": np.array([scale], "float32")}
+        self.check_output(atol=1e-5)
+
+
+class TestFakeDequantize(OpTest):
+    op_type = "fake_dequantize_max_abs"
+
+    def test_output(self):
+        x = rng.randint(-127, 128, size=(4, 5)).astype("float32")
+        scale = np.array([3.7], "float32")
+        self.inputs = {"X": x, "Scale": scale}
+        self.attrs = {"max_range": 127.0}
+        self.outputs = {"Out": x * scale[0] / 127.0}
+        self.check_output(atol=1e-5)
+
+
+class TestChannelWise(OpTest):
+    op_type = "fake_channel_wise_quantize_abs_max"
+
+    def test_output(self):
+        x = rng.randn(4, 3, 2, 2).astype("float32")
+        scale = np.abs(x.reshape(4, -1)).max(axis=1)
+        out = np.zeros_like(x)
+        for c in range(4):
+            out[c] = np.round(
+                np.clip(x[c], -scale[c], scale[c]) * 127.0 / scale[c])
+        self.inputs = {"X": x}
+        self.attrs = {"bit_length": 8}
+        self.outputs = {"Out": out, "OutScale": scale.astype("float32")}
+        self.check_output(atol=1e-4)
+
+
+class TestQuantDequantRoundTrip:
+    def test_error_bounded(self):
+        """quant-dequant error is bounded by scale/bin_cnt per element."""
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[16], dtype="float32")
+            block = main.current_block()
+            out = block.create_var(name="qdq", dtype="float32")
+            sc = block.create_var(name="qdq_s", dtype="float32")
+            block.append_op(
+                type="fake_quantize_dequantize_abs_max",
+                inputs={"X": [x]},
+                outputs={"Out": [out], "OutScale": [sc]},
+                attrs={"bit_length": 8})
+        exe = fluid.Executor(fluid.CPUPlace())
+        xv = rng.randn(4, 16).astype("float32")
+        with scope_guard(Scope()):
+            o, s = exe.run(main, feed={"x": xv}, fetch_list=[out, sc])
+        assert np.abs(o - xv).max() <= s[0] / 127.0 + 1e-6
+
+
+class TestQATTransform:
+    def _build(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data("img", shape=[1, 8, 8], dtype="float32")
+            label = fluid.layers.data("label", shape=[1], dtype="int64")
+            conv = fluid.layers.conv2d(img, num_filters=4, filter_size=3,
+                                       padding=1, act="relu")
+            pool = fluid.layers.pool2d(conv, pool_size=8, pool_type="avg")
+            logits = fluid.layers.fc(pool, size=3)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, label))
+        return main, startup, loss
+
+    def test_transform_inserts_ops(self):
+        main, startup, loss = self._build()
+        n = TransformForTraining().apply(main, startup)
+        # conv (Input+Filter) + fc's mul (X+Y) = 4 quantized slots
+        assert n == 4
+        types = [op.type for op in main.global_block().ops]
+        assert types.count("fake_quantize_dequantize_moving_average_abs_max") == 2
+        assert types.count("fake_quantize_dequantize_abs_max") == 2
+        # quantizable ops now read the dequantized vars
+        for op in main.global_block().ops:
+            if op.type == "conv2d":
+                assert op.inputs["Input"][0].endswith(".quant_dequant")
+                assert op.inputs["Filter"][0].endswith(".quant_dequant")
+
+    def test_qat_trains(self):
+        main, startup, loss = self._build()
+        with fluid.program_guard(main, startup):
+            QuantizationTranspiler().training_transpile(main, startup)
+            fluid.optimizer.Adam(learning_rate=5e-3).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        r = np.random.RandomState(1)
+        W = r.randn(64, 3)
+        def batch(n=16):
+            xv = r.rand(n, 1, 8, 8).astype("float32")
+            yv = np.argmax(xv.reshape(n, -1) @ W, axis=1)[:, None]
+            return xv, yv.astype("int64")
+        with scope_guard(Scope()):
+            exe.run(startup)
+            losses = []
+            for _ in range(60):
+                xv, yv = batch()
+                (l,) = exe.run(main, feed={"img": xv, "label": yv},
+                               fetch_list=[loss])
+                losses.append(float(np.asarray(l).reshape(())))
+            scale = exe.run(main, feed={"img": xv, "label": yv},
+                            fetch_list=["img.quant_scale"])[0]
+        # training ran and the activation scale accumulated something real
+        assert scale[0] > 0.1
+        assert losses[-1] < 1.5
+
+    def _train_curve(self, transform, steps=120):
+        main, startup, loss = self._build()
+        with fluid.program_guard(main, startup):
+            if transform:
+                TransformForTraining(
+                    activation_quantize_type="abs_max").apply(main, startup)
+            fluid.optimizer.Adam(learning_rate=5e-3).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        r = np.random.RandomState(2)
+        xv = r.rand(16, 1, 8, 8).astype("float32")
+        yv = r.randint(0, 3, size=(16, 1)).astype("int64")
+        with scope_guard(Scope()):
+            exe.run(startup)
+            ls = []
+            for _ in range(steps):
+                (l,) = exe.run(main, feed={"img": xv, "label": yv},
+                               fetch_list=[loss])
+                ls.append(float(np.asarray(l).reshape(())))
+        return ls
+
+    def test_qat_loss_tracks_float_baseline(self):
+        """STE grads must let QAT train essentially as well as float
+        (slim/tests pattern: quantized-vs-float loss parity)."""
+        plain = self._train_curve(transform=False)
+        qat = self._train_curve(transform=True)
+        assert qat[-1] < qat[0] * 0.8, (qat[0], qat[-1])
+        assert qat[-1] < plain[-1] + 0.1, (plain[-1], qat[-1])
