@@ -72,6 +72,9 @@ from . import reader
 from . import native
 from . import recordio_writer
 from . import inference
+from . import reader_decorators
+from . import datasets
+from .reader_decorators import batch
 from .reader import PyReader, DataLoader
 from .io import (
     save_vars,

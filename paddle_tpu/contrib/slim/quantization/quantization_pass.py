@@ -48,7 +48,16 @@ class TransformForTraining:
 
     def apply(self, program, startup_program=None):
         """Rewrites `program` in place; returns the number of quantized
-        input slots."""
+        input slots.  `startup_program` is required for moving-average
+        activation quantization (it receives the scale-state
+        initializers)."""
+        if (startup_program is None
+                and self.activation_quantize_type
+                == "moving_average_abs_max"):
+            raise ValueError(
+                "moving_average_abs_max needs startup_program to "
+                "initialize scale state (pass it to apply(), or use "
+                "activation_quantize_type='abs_max')")
         block = program.global_block()
         quantized = {}  # var name -> dequantized var name
         count = 0
@@ -63,6 +72,8 @@ class TransformForTraining:
                 if not names:
                     continue
                 name = names[0]
+                if name.endswith(".quant_dequant"):
+                    continue  # already transformed (idempotent re-apply)
                 if name in quantized:
                     op.inputs[slot] = [quantized[name]]
                     continue

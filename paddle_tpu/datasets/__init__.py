@@ -1,0 +1,18 @@
+"""Canned datasets (reference: ``python/paddle/dataset/`` — mnist, cifar,
+uci_housing, imdb, ... with download+cache).
+
+This environment has zero network egress, so each dataset loads from a
+local file when present (``PADDLE_TPU_DATA_HOME``, default
+``~/.cache/paddle_tpu/dataset``) and otherwise serves a deterministic
+synthetic surrogate with the exact same sample shapes/dtypes/label ranges
+as the real data — keeping every reader-creator API (``train()``,
+``test()``) drop-in compatible for pipelines and tests.
+"""
+
+from . import common  # noqa: F401
+from . import mnist  # noqa: F401
+from . import cifar  # noqa: F401
+from . import uci_housing  # noqa: F401
+from . import imdb  # noqa: F401
+
+__all__ = ["common", "mnist", "cifar", "uci_housing", "imdb"]

@@ -1,0 +1,79 @@
+"""CIFAR readers (reference: ``python/paddle/dataset/cifar.py`` —
+``train10()/test10()/train100()/test100()`` yield (3072-float32 image in
+[0, 1], int label)).  Real pickled batches load from the data home;
+otherwise a deterministic synthetic surrogate with per-class color
+prototypes."""
+
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+
+def _real_tar(name):
+    p = common.data_path("cifar", name)
+    return p if os.path.exists(p) else None
+
+
+def _tar_reader(path, sub_name):
+    def reader():
+        with tarfile.open(path, mode="r") as f:
+            names = [n for n in f.getnames() if sub_name in n]
+            for name in names:
+                batch = pickle.load(f.extractfile(name), encoding="bytes")
+                data = batch[b"data"]
+                labels = batch.get(b"labels") or batch.get(b"fine_labels")
+                for s, l in zip(data, labels):
+                    yield s.astype("float32") / 255.0, int(l)
+
+    return reader
+
+
+def _synthetic(num_classes, split, size):
+    rng = np.random.RandomState(7 + num_classes)
+    protos = rng.rand(num_classes, 3072).astype("float32")
+    seed = 0 if split == "train" else 1
+
+    def reader():
+        r = np.random.RandomState(seed)
+        for _ in range(size):
+            y = int(r.randint(num_classes))
+            x = np.clip(
+                protos[y] + 0.15 * r.randn(3072).astype("float32"), 0.0, 1.0
+            ).astype("float32")
+            yield x, y
+
+    return reader
+
+
+def train10():
+    tar = _real_tar("cifar-10-python.tar.gz")
+    if tar:
+        return _tar_reader(tar, "data_batch")
+    return _synthetic(10, "train", 50000)
+
+
+def test10():
+    tar = _real_tar("cifar-10-python.tar.gz")
+    if tar:
+        return _tar_reader(tar, "test_batch")
+    return _synthetic(10, "test", 10000)
+
+
+def train100():
+    tar = _real_tar("cifar-100-python.tar.gz")
+    if tar:
+        return _tar_reader(tar, "train")
+    return _synthetic(100, "train", 50000)
+
+
+def test100():
+    tar = _real_tar("cifar-100-python.tar.gz")
+    if tar:
+        return _tar_reader(tar, "test")
+    return _synthetic(100, "test", 10000)

@@ -1,0 +1,43 @@
+"""IMDB sentiment readers (reference: ``python/paddle/dataset/imdb.py`` —
+``word_dict()``, ``train(word_dict)``/``test(word_dict)`` yield (word-id
+list, 0/1 label)).  Synthetic surrogate: two vocab halves biased by class
+so embedding+pool models learn the split."""
+
+import numpy as np
+
+__all__ = ["word_dict", "train", "test"]
+
+VOCAB = 5149  # reference vocab size (cutoff 150)
+
+
+def word_dict():
+    return {("w%d" % i).encode(): i for i in range(VOCAB)}
+
+
+def _synthetic(split, size):
+    seed = 0 if split == "train" else 1
+
+    def reader():
+        r = np.random.RandomState(seed)
+        half = VOCAB // 2
+        for _ in range(size):
+            label = int(r.randint(2))
+            n = int(r.randint(20, 120))
+            # positive samples draw mostly from the upper vocab half
+            biased = r.rand(n) < 0.7
+            ids = np.where(
+                biased == bool(label),
+                r.randint(half, VOCAB - 2, size=n),
+                r.randint(0, half, size=n),
+            )
+            yield [int(v) for v in ids], label
+
+    return reader
+
+
+def train(word_idx=None):
+    return _synthetic("train", 25000)
+
+
+def test(word_idx=None):
+    return _synthetic("test", 25000)

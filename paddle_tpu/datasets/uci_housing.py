@@ -1,0 +1,63 @@
+"""UCI housing readers (reference: ``python/paddle/dataset/uci_housing.py``
+— ``train()/test()`` yield (13-float32 features, 1-float32 price),
+feature-normalized).  Synthetic surrogate: a fixed linear model plus noise
+so fit_a_line-style book tests converge."""
+
+import os
+
+import numpy as np
+
+from . import common
+
+__all__ = ["train", "test", "feature_names"]
+
+feature_names = [
+    "CRIM", "ZN", "INDUS", "CHAS", "NOX", "RM", "AGE", "DIS", "RAD", "TAX",
+    "PTRATIO", "B", "LSTAT",
+]
+
+_W = None
+_DATA = None
+
+
+def _load_real():
+    p = common.data_path("uci_housing", "housing.data")
+    if not os.path.exists(p):
+        return None
+    raw = np.loadtxt(p).astype("float32")
+    feats = raw[:, :-1]
+    feats = (feats - feats.mean(axis=0)) / (feats.std(axis=0) + 1e-6)
+    return np.concatenate([feats, raw[:, -1:]], axis=1)
+
+
+def _data():
+    global _DATA, _W
+    if _DATA is not None:
+        return _DATA
+    real = _load_real()
+    if real is not None:
+        _DATA = real
+        return _DATA
+    rng = np.random.RandomState(13)
+    _W = rng.randn(13, 1).astype("float32")
+    x = rng.randn(506, 13).astype("float32")
+    y = x @ _W + 0.1 * rng.randn(506, 1).astype("float32") + 22.5
+    _DATA = np.concatenate([x, y], axis=1)
+    return _DATA
+
+
+def _reader(lo, hi):
+    def reader():
+        d = _data()
+        for i in range(int(lo * len(d)), int(hi * len(d))):
+            yield d[i, :-1], d[i, -1:]
+
+    return reader
+
+
+def train():
+    return _reader(0.0, 0.8)
+
+
+def test():
+    return _reader(0.8, 1.0)

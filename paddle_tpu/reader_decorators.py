@@ -1,0 +1,239 @@
+"""Reader decorators (reference: ``python/paddle/reader/decorator.py`` —
+cache, map_readers, shuffle, chain, compose, buffered, firstn,
+xmap_readers, multiprocess_reader) and ``python/paddle/batch.py``.
+
+A *reader creator* is a zero-arg callable returning an iterator of
+samples; decorators wrap creators.  Threaded variants use threads (not
+processes) — the consumers feed a jitted step, so the GIL is released
+during device execution and thread workers overlap fine.
+"""
+
+import itertools
+import queue as _queue
+import random as _random
+import threading
+
+__all__ = [
+    "cache", "map_readers", "shuffle", "chain", "compose", "buffered",
+    "firstn", "xmap_readers", "batch", "ComposeNotAligned",
+]
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def cache(reader):
+    """Cache the first *complete* pass in memory; later passes replay it.
+    A partially consumed pass is discarded (not mixed into a later one)."""
+    all_data = []
+    filled = []
+
+    def impl():
+        if not filled:
+            fresh = []
+            for item in reader():
+                fresh.append(item)
+                yield item
+            all_data[:] = fresh
+            filled.append(True)
+        else:
+            for item in all_data:
+                yield item
+
+    return impl
+
+
+def map_readers(func, *readers):
+    """Zip readers, map func over the per-reader samples."""
+
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Buffered shuffle (reference decorator.py:82)."""
+
+    def data_reader():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                for b in buf:
+                    yield b
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            for b in buf:
+                yield b
+
+    return data_reader
+
+
+def chain(*readers):
+    """Concatenate readers end to end."""
+
+    def reader():
+        for r in readers:
+            for item in r():
+                yield item
+
+    return reader
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into flat tuples: (a, b1, b2) from ((a,), (b1, b2)).
+    check_alignment=True (default) raises ComposeNotAligned on length
+    mismatch."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if check_alignment:
+            for outputs in itertools.zip_longest(*rs):
+                if any(o is None for o in outputs):
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned")
+                yield sum((make_tuple(o) for o in outputs), ())
+        else:
+            for outputs in zip(*rs):
+                yield sum((make_tuple(o) for o in outputs), ())
+
+    return reader
+
+
+def buffered(reader, size):
+    """Background-thread prefetch of up to `size` samples.  Reader
+    exceptions propagate to the consumer (instead of hanging the queue)."""
+
+    class _End:
+        pass
+
+    def data_reader():
+        r = reader()
+        q = _queue.Queue(maxsize=size)
+
+        def read_worker():
+            try:
+                for d in r:
+                    q.put(d)
+                q.put(_End)
+            except BaseException as exc:  # propagate to consumer
+                q.put(exc)
+
+        t = threading.Thread(target=read_worker, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                return
+            if isinstance(e, BaseException):
+                raise e
+            yield e
+
+    return data_reader
+
+
+def firstn(reader, n):
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i == n:
+                return
+            yield item
+
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map with `process_num` worker threads (reference uses
+    threads too despite the name)."""
+
+    end = object()
+
+    def data_reader():
+        in_q = _queue.Queue(buffer_size)
+        out_q = _queue.Queue(buffer_size)
+
+        def feed():
+            try:
+                for i, sample in enumerate(reader()):
+                    in_q.put((i, sample))
+            except BaseException as exc:
+                out_q.put(exc)
+            finally:
+                for _ in range(process_num):
+                    in_q.put(end)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is end:
+                    out_q.put(end)
+                    return
+                i, sample = item
+                try:
+                    out_q.put((i, mapper(sample)))
+                except BaseException as exc:
+                    out_q.put(exc)
+                    out_q.put(end)
+                    return
+
+        threading.Thread(target=feed, daemon=True).start()
+        workers = [threading.Thread(target=work, daemon=True)
+                   for _ in range(process_num)]
+        for w in workers:
+            w.start()
+
+        finished = 0
+        if order:
+            pending = {}
+            want = 0
+            while finished < process_num:
+                item = out_q.get()
+                if item is end:
+                    finished += 1
+                    continue
+                if isinstance(item, BaseException):
+                    raise item
+                i, mapped = item
+                pending[i] = mapped
+                while want in pending:
+                    yield pending.pop(want)
+                    want += 1
+            for i in sorted(pending):
+                yield pending[i]
+        else:
+            while finished < process_num:
+                item = out_q.get()
+                if item is end:
+                    finished += 1
+                    continue
+                if isinstance(item, BaseException):
+                    raise item
+                yield item[1]
+
+    return data_reader
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Group samples into lists of `batch_size` (reference batch.py)."""
+
+    def batch_reader():
+        b = []
+        for instance in reader():
+            b.append(instance)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+
+    return batch_reader
