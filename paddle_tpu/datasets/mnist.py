@@ -26,7 +26,8 @@ def _load_idx(image_path, label_path):
     with gzip.open(label_path, "rb") as f:
         struct.unpack(">II", f.read(8))
         labels = np.frombuffer(f.read(), np.uint8)
-    images = images.astype("float32") / 127.5 - 1.0
+    # keep uint8 in the cache (4x smaller); normalize per sample in the
+    # reader
     return images, labels.astype("int64")
 
 
@@ -69,7 +70,8 @@ def _reader(split, size):
 
     def reader():
         for i in range(images.shape[0]):
-            yield images[i], int(labels[i])
+            yield (images[i].astype("float32") / 127.5 - 1.0,
+                   int(labels[i]))
 
     return reader
 

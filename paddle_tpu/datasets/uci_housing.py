@@ -16,7 +16,6 @@ feature_names = [
     "PTRATIO", "B", "LSTAT",
 ]
 
-_W = None
 _DATA = None
 
 
@@ -31,7 +30,7 @@ def _load_real():
 
 
 def _data():
-    global _DATA, _W
+    global _DATA
     if _DATA is not None:
         return _DATA
     real = _load_real()
@@ -39,9 +38,9 @@ def _data():
         _DATA = real
         return _DATA
     rng = np.random.RandomState(13)
-    _W = rng.randn(13, 1).astype("float32")
+    w = rng.randn(13, 1).astype("float32")
     x = rng.randn(506, 13).astype("float32")
-    y = x @ _W + 0.1 * rng.randn(506, 1).astype("float32") + 22.5
+    y = x @ w + 0.1 * rng.randn(506, 1).astype("float32") + 22.5
     _DATA = np.concatenate([x, y], axis=1)
     return _DATA
 

@@ -237,7 +237,19 @@ class Operator:
 
         self.inputs = _canon(inputs)
         self.outputs = _canon(outputs)
-        self.attrs.setdefault("__op_id__", next(_op_id_counter))
+        # per-program op ids: unique within the program (RNG key folding,
+        # vjp CSE) yet reproducible across separate builds of the same
+        # graph — a fixed random_seed then yields identical random ops
+        # (the reference's cross-build determinism contract)
+        program = block.program if block is not None else None
+        if program is None:
+            self.attrs.setdefault("__op_id__", next(_op_id_counter))
+        elif "__op_id__" in self.attrs:
+            # preserved id (clone/deserialize): keep it and raise the
+            # program counter floor so later inserts cannot collide
+            program._note_op_id(self.attrs["__op_id__"])
+        else:
+            self.attrs["__op_id__"] = program._next_op_id()
         if _name_scope_stack:
             self.attrs.setdefault("op_namescope", "/".join(_name_scope_stack))
 
@@ -406,6 +418,14 @@ class Program:
         self._current_role = "forward"
         self.random_seed = 0
         self._is_start_up_program = False
+        self._last_op_id = 0
+
+    def _next_op_id(self):
+        self._last_op_id += 1
+        return self._last_op_id
+
+    def _note_op_id(self, op_id):
+        self._last_op_id = max(self._last_op_id, int(op_id))
 
     # ---- version for jit-cache invalidation ----
     def _bump_version(self):

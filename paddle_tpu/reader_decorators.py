@@ -208,8 +208,7 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                 while want in pending:
                     yield pending.pop(want)
                     want += 1
-            for i in sorted(pending):
-                yield pending[i]
+            assert not pending, "xmap order protocol violated"
         else:
             while finished < process_num:
                 item = out_q.get()

@@ -1,0 +1,152 @@
+"""Book model tests (reference: ``python/paddle/fluid/tests/book/`` —
+train a few iterations, assert the loss decreases, save + reload the
+inference model).  fit_a_line, word2vec and recommender_system here;
+recognize_digits/image_classification/machine_translation live in
+test_models.py / test_beam_search.py."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import datasets, reader_decorators as rd
+from paddle_tpu.executor import Scope, scope_guard
+
+
+class TestFitALine:
+    """book/test_fit_a_line.py: linear regression on uci_housing."""
+
+    def test_train_and_infer(self, tmp_path):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[13], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="float32")
+            pred = fluid.layers.fc(x, size=1)
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.Adam(learning_rate=0.1).minimize(loss)
+
+        reader = rd.batch(datasets.uci_housing.train(), 64)
+        exe = fluid.Executor(fluid.CPUPlace())
+        model_dir = str(tmp_path / "fit_a_line")
+        with scope_guard(Scope()):
+            exe.run(startup)
+            first = last = None
+            for epoch in range(100):
+                for b in reader():
+                    xs = np.stack([s[0] for s in b]).astype("float32")
+                    ys = np.stack([s[1] for s in b]).astype("float32")
+                    (l,) = exe.run(main, feed={"x": xs, "y": ys},
+                                   fetch_list=[loss])
+                    l = float(np.asarray(l).reshape(()))
+                    first = first if first is not None else l
+                    last = l
+            assert last < first * 0.01, (first, last)
+            fluid.io.save_inference_model(model_dir, ["x"], [pred], exe,
+                                          main_program=main)
+
+        # reload and check prediction error is in the trained ballpark
+        with scope_guard(Scope()):
+            prog, feeds, fetches = fluid.io.load_inference_model(
+                model_dir, exe)
+            b = next(iter(rd.batch(datasets.uci_housing.test(), 32)()))
+            xs = np.stack([s[0] for s in b]).astype("float32")
+            ys = np.stack([s[1] for s in b]).astype("float32")
+            (p,) = exe.run(prog, feed={feeds[0]: xs}, fetch_list=fetches)
+        assert np.mean((p - ys) ** 2) < 2.0
+
+
+class TestWord2Vec:
+    """book/test_word2vec.py: N-gram LM with shared embeddings."""
+
+    def test_train(self):
+        V, EMB, N = 40, 16, 4
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            words = [fluid.layers.data("w%d" % i, shape=[1], dtype="int64")
+                     for i in range(N)]
+            embs = [
+                fluid.layers.embedding(
+                    w, size=[V, EMB],
+                    param_attr=fluid.ParamAttr(name="shared_emb"))
+                for w in words
+            ]
+            embs = [fluid.layers.reshape(e, shape=[-1, EMB]) for e in embs]
+            concat = fluid.layers.concat(embs, axis=1)
+            hidden = fluid.layers.fc(concat, size=64, act="relu")
+            logits = fluid.layers.fc(hidden, size=V)
+            target = fluid.layers.data("target", shape=[1], dtype="int64")
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, target))
+            fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+
+        # synthetic corpus with strong 4-gram structure: w_{t+1} = 3w_t+1 mod V
+        rng = np.random.RandomState(0)
+
+        def batch(bs=64):
+            w0 = rng.randint(0, V, size=(bs, 1))
+            seq = [w0]
+            for _ in range(N):
+                seq.append((3 * seq[-1] + 1) % V)
+            return [s.astype("int64") for s in seq]
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            exe.run(startup)
+            first = last = None
+            for _ in range(120):
+                *ws, tgt = batch()
+                feed = {("w%d" % i): w for i, w in enumerate(ws)}
+                feed["target"] = tgt
+                (l,) = exe.run(main, feed=feed, fetch_list=[loss])
+                l = float(np.asarray(l).reshape(()))
+                first = first if first is not None else l
+                last = l
+        assert last < first * 0.2, (first, last)
+
+
+class TestRecommender:
+    """book/test_recommender_system.py: user/item embedding dot-product
+    rating model."""
+
+    def test_train(self):
+        USERS, ITEMS, EMB = 30, 50, 16
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            uid = fluid.layers.data("uid", shape=[1], dtype="int64")
+            iid = fluid.layers.data("iid", shape=[1], dtype="int64")
+            rating = fluid.layers.data("rating", shape=[1], dtype="float32")
+            uemb = fluid.layers.reshape(
+                fluid.layers.embedding(uid, size=[USERS, EMB]),
+                shape=[-1, EMB])
+            iemb = fluid.layers.reshape(
+                fluid.layers.embedding(iid, size=[ITEMS, EMB]),
+                shape=[-1, EMB])
+            uvec = fluid.layers.fc(uemb, size=EMB, act="relu")
+            ivec = fluid.layers.fc(iemb, size=EMB, act="relu")
+            sim = fluid.layers.cos_sim(uvec, ivec)
+            pred = fluid.layers.scale(sim, scale=5.0)
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.square_error_cost(pred, rating))
+            fluid.optimizer.Adam(learning_rate=5e-3).minimize(loss)
+
+        rng = np.random.RandomState(1)
+        affinity = rng.rand(USERS, ITEMS).astype("float32") * 5.0
+
+        def batch(bs=64):
+            u = rng.randint(0, USERS, size=(bs, 1))
+            i = rng.randint(0, ITEMS, size=(bs, 1))
+            r = affinity[u[:, 0], i[:, 0]][:, None]
+            return u.astype("int64"), i.astype("int64"), r.astype("float32")
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            exe.run(startup)
+            first = last = None
+            for _ in range(200):
+                u, i, r = batch()
+                (l,) = exe.run(
+                    main, feed={"uid": u, "iid": i, "rating": r},
+                    fetch_list=[loss])
+                l = float(np.asarray(l).reshape(()))
+                first = first if first is not None else l
+                last = l
+        assert last < first * 0.6, (first, last)
