@@ -5,27 +5,68 @@ TPU note: the low precision is **bfloat16**, not float16 — same exponent
 range as fp32, so no loss scaling is required and the dynamic-loss-scaling
 machinery of the reference degenerates to a no-op."""
 
-# matmul-class ops: run in bf16 on the MXU (fp32 accumulation is set via
-# preferred_element_type in the op lowerings)
+# bf16 compute set.  TPU-native AMP runs the whole compute body in bf16 —
+# matmuls on the MXU (fp32 accumulation via preferred_element_type in the
+# lowerings) AND the elementwise/norm/shape glue between them.  Keeping the
+# glue f32 (the reference's GPU-era policy) forces a bf16↔f32 ping-pong
+# around every matmul that doubles HBM traffic and measurably loses MFU;
+# numerically-sensitive internals (layer_norm stats, softmax exp) upcast to
+# f32 inside their own lowerings, so whitelisting them is safe.
 white_list = {
+    # matmul-class
     "mul",
     "matmul",
     "conv2d",
     "depthwise_conv2d",
     "conv3d",
     "conv2d_transpose",
+    "fused_multihead_attention",
+    # elementwise / activation glue
+    "elementwise_add",
+    "elementwise_sub",
+    "elementwise_mul",
+    "elementwise_div",
+    "elementwise_max",
+    "elementwise_min",
+    "scale",
+    "sum",
+    "relu",
+    "gelu",
+    "tanh",
+    "sigmoid",
+    "swish",
+    "leaky_relu",
+    "dropout",
+    # shape glue (cast-free but keeps dtype propagation consistent)
+    "reshape",
+    "reshape2",
+    "transpose",
+    "transpose2",
+    "concat",
+    "split",
+    "stack",
+    "slice",
+    "squeeze",
+    "squeeze2",
+    "unsqueeze",
+    "unsqueeze2",
+    "expand",
+    "pad",
+    # normalization / attention softmax / fused loss (f32 internals in
+    # the lowerings)
+    "layer_norm",
+    "softmax",
+    "softmax_with_cross_entropy",
 }
 
-# numerically sensitive ops: keep fp32 inputs
+# numerically sensitive ops: keep fp32 inputs (loss path + norms whose
+# lowerings lack f32 internals)
 black_list = {
-    "softmax_with_cross_entropy",
     "cross_entropy",
-    "softmax",
     "log_softmax",
     "mean",
     "reduce_mean",
     "reduce_sum",
-    "layer_norm",
     "batch_norm",
     "exp",
     "log",

@@ -26,6 +26,11 @@ from .common import normalize_axis
 @register_op("softmax", inputs=["X"], outputs=["Out"])
 def softmax(ctx, attrs, X):
     axis = int(attrs.get("axis", -1))
+    # f32 internals under bf16 AMP (exp/sum accumulate in f32; XLA fuses
+    # the casts) — the standard TPU attention-softmax recipe
+    if X.dtype == jnp.bfloat16:
+        return jax.nn.softmax(X.astype(jnp.float32), axis=axis).astype(
+            X.dtype)
     return jax.nn.softmax(X, axis=axis)
 
 
@@ -64,6 +69,12 @@ def softmax_with_cross_entropy(ctx, attrs, Logits, Label):
     axis = normalize_axis(int(attrs.get("axis", -1)), jnp.ndim(Logits))
     soft_label = attrs.get("soft_label", False)
     ignore_index = int(attrs.get("ignore_index", -100))
+    # f32 internals for bf16 logits (AMP): the logsumexp reduction and the
+    # log-prob gather fuse with the upcast, so no f32 logits tensor is
+    # materialized in HBM
+    in_dtype = Logits.dtype
+    if in_dtype == jnp.bfloat16:
+        Logits = Logits.astype(jnp.float32)
     lse = jax.scipy.special.logsumexp(Logits, axis=axis, keepdims=True)
     log_softmax = Logits - lse
     if soft_label:
@@ -79,7 +90,8 @@ def softmax_with_cross_entropy(ctx, attrs, Logits, Label):
         loss = -picked
         mask = jnp.expand_dims(lab, axis) == ignore_index
         loss = jnp.where(mask, jnp.zeros_like(loss), loss)
-    return {"Softmax": jax.lax.stop_gradient(jnp.exp(log_softmax)), "Loss": loss}
+    return {"Softmax": jax.lax.stop_gradient(
+        jnp.exp(log_softmax).astype(in_dtype)), "Loss": loss}
 
 
 @register_op("dropout", inputs=["X"], outputs=["Out", "Mask"],

@@ -14,7 +14,7 @@ the XLA partitioner.
 import numpy as np
 
 from .. import core
-from ..executor import _CompiledBlock, global_scope
+from ..executor import _CompiledBlock, global_scope, rng_key
 from ..framework import Variable, default_main_program
 
 __all__ = ["ParallelExecutor", "SPMDRunner"]
@@ -74,7 +74,7 @@ class SPMDRunner:
         rw = {n: scope.get(n) for n in compiled.rw_names}
         ro = {n: scope.get(n) for n in compiled.ro_names}
         seed = self.program.random_seed or 0
-        base_key = jax.random.fold_in(jax.random.key(seed), executor._step)
+        base_key = jax.random.fold_in(rng_key(seed), executor._step)
         executor._step += 1
         fetches, new_rw, fresh = compiled.jitted(feed_vals, rw, ro, base_key)
         for n, v in new_rw.items():
