@@ -77,13 +77,18 @@ def rng_key(seed):
     """Base PRNG key.  On TPU the default is the hardware-accelerated
     ``rbg`` generator — threefry bit generation is pure VPU arithmetic and
     costs real step time in dropout-heavy models (~25% of a BERT-base
-    train step at bs64); override with PADDLE_TPU_RNG_IMPL=threefry for
-    bit-exact cross-platform draws."""
+    train step at bs64); override with PADDLE_TPU_RNG_IMPL=threefry2x32
+    (alias: threefry) for bit-exact cross-platform draws.  Note the
+    default therefore differs between CPU (threefry2x32) and TPU/GPU
+    (rbg): fixed-seed runs are NOT reproducible across backends unless
+    the env var pins one impl."""
     import os
 
     import jax
 
     impl = os.environ.get("PADDLE_TPU_RNG_IMPL")
+    if impl == "threefry":
+        impl = "threefry2x32"
     if impl is None:
         backend = jax.default_backend().lower()
         impl = "rbg" if backend not in ("cpu",) else "threefry2x32"

@@ -10,7 +10,7 @@ __all__ = ["beam_search", "beam_search_decode"]
 
 def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
                 level=0, is_accumulated=True, name=None,
-                return_parent_idx=True):
+                return_parent_idx=False):
     """One beam expansion step over dense [B, K] beams.
 
     ``scores`` must be [B, K, V]; pass ``is_accumulated=False`` when they

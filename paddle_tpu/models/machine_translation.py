@@ -155,7 +155,8 @@ def build_infer(vocab_size, emb_dim=32, hidden_dim=64, src_len=8,
 
             sel_ids, sel_scores, parent = fluid.layers.beam_search(
                 pre_ids, pre_scores, None, logp3, beam_size=K,
-                end_id=end_id, is_accumulated=False)
+                end_id=end_id, is_accumulated=False,
+                return_parent_idx=True)
 
             # reorder beam states by parent: global row = b*K + parent
             global_parent = fluid.layers.reshape(

@@ -248,8 +248,10 @@ def anchor_generator(ctx, attrs, Input):
     A = len(hw)
     hw = jnp.asarray(hw, jnp.float32)
     hh = jnp.asarray(hh, jnp.float32)
-    cx = (jnp.arange(feat_w, dtype=jnp.float32) * stride[0] + offset * stride[0])[None, :, None]
-    cy = (jnp.arange(feat_h, dtype=jnp.float32) * stride[1] + offset * stride[1])[:, None, None]
+    # center convention: offset*(stride-1), matching the reference
+    # (anchor_generator_op.h:55-56) so anchors parity with ref-trained RPNs
+    cx = (jnp.arange(feat_w, dtype=jnp.float32) * stride[0] + offset * (stride[0] - 1))[None, :, None]
+    cy = (jnp.arange(feat_h, dtype=jnp.float32) * stride[1] + offset * (stride[1] - 1))[:, None, None]
     anchors = jnp.stack(
         [
             jnp.broadcast_to(cx - hw, (feat_h, feat_w, A)),
@@ -529,6 +531,15 @@ def _multiclass_nms_one(bboxes, scores, background_label, score_threshold,
         ],
         axis=1,
     )  # [M, 6]
+    if 0 <= M < keep_top_k:
+        # honor the documented [keep_top_k, 6] shape contract even when
+        # the candidate pool (C*nms_top_k) is smaller: -1 padding rows
+        out = jnp.concatenate(
+            [out, jnp.full((keep_top_k - M, 6), -1.0, out.dtype)], axis=0
+        )
+        fin_orig = jnp.concatenate(
+            [fin_orig, jnp.full((keep_top_k - M,), -1, fin_orig.dtype)]
+        )
     count = jnp.sum(fin_valid.astype(jnp.int32))
     return out, count, fin_orig
 

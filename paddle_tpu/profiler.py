@@ -55,7 +55,9 @@ def start_profiler(state="All", tracer_option=None):
 
 def _aggregate():
     table = {}
-    for name, tid, t0, t1 in _events:
+    with _events_lock:
+        evs = list(_events)
+    for name, tid, t0, t1 in evs:
         row = table.setdefault(name, [0, 0.0, 0.0, None])
         dt = (t1 - t0) / 1000.0  # ms
         row[0] += 1
@@ -95,7 +97,9 @@ def _write_chrome_trace(path):
     """chrome://tracing 'traceEvents' JSON (tools/timeline.py output
     format: X (complete) events with microsecond timestamps)."""
     events = []
-    for name, tid, t0, t1 in _events:
+    with _events_lock:
+        evs = list(_events)
+    for name, tid, t0, t1 in evs:
         events.append({
             "name": name, "cat": "paddle_tpu", "ph": "X",
             "pid": 0, "tid": tid, "ts": t0, "dur": t1 - t0,

@@ -23,7 +23,7 @@ class TestBeamSearchStep:
                                    append_batch_size=False)
             ids, sco, par = fluid.layers.beam_search(
                 pi, ps, None, sc, beam_size=beam_size, end_id=end_id,
-                is_accumulated=is_accumulated)
+                is_accumulated=is_accumulated, return_parent_idx=True)
         exe = fluid.Executor(fluid.CPUPlace())
         with scope_guard(Scope()):
             return exe.run(
