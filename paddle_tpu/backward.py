@@ -223,12 +223,13 @@ class _GradEngine:
             any_grad = any_grad or g is not None
         if not any_grad:
             return False
-        if op.type == "while":
+        if op.type == "while" and not op.attrs.get("max_trip_count"):
             raise NotImplementedError(
-                "gradients through `while` are not supported (XLA has no "
-                "reverse-mode for unbounded while_loop); use StaticRNN "
-                "(lax.scan, fully differentiable) for recurrence, or keep "
-                "the loss outside the loop"
+                "gradients through an unbounded `while` are not supported "
+                "(XLA has no reverse-mode for while_loop); pass "
+                "While(cond, max_trip_count=N) to lower backward as a "
+                "masked N-step scan, or use StaticRNN (lax.scan, fully "
+                "differentiable) for recurrence"
             )
 
         sub_block = self.block.program.block(op.attrs["sub_block"])
@@ -236,6 +237,10 @@ class _GradEngine:
         if op.type == "recurrent":
             exclude.update(op.attrs.get("step_input_names", []))
             exclude.update(op.attrs.get("state_names", []))
+        if op.type == "while":
+            # loop-state vars get their grads through StateIn@GRAD (w.r.t.
+            # their pre-loop values), not through the captured-closure path
+            exclude.update(op.outputs.get("Out", []))
         captured = [
             n for n in cf_ops.sub_block_external_reads(sub_block, exclude)
             if self.block._find_var_recursive(n) is not None
@@ -248,6 +253,23 @@ class _GradEngine:
 
         outputs = {}
         grad_targets = []  # (fwd_name, grad_name) to register as pending
+        if op.type == "while":
+            # the same names flow in and out of the loop: the grads just
+            # resolved above were w.r.t. the POST-loop values; reset the
+            # accumulator so grads seeded below (w.r.t. the PRE-loop values)
+            # reach the pre-loop producers
+            gouts = []
+            for x in out_names:
+                self.resolved.pop(x, None)
+                self.pending[x] = []
+                if _var_can_have_grad(self.block, x, self.no_grad_set):
+                    gn = self.new_grad_name(x)
+                    gouts.append(gn)
+                    grad_targets.append((x, gn))
+                else:
+                    gouts.append(op_registry.EMPTY_VAR_NAME)
+            if any(g != op_registry.EMPTY_VAR_NAME for g in gouts):
+                outputs["StateIn@GRAD"] = gouts
         for slot in (("inputs", "initial_states") if op.type == "recurrent"
                      else ()):
             names = op.inputs.get(slot, [])

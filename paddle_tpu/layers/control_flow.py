@@ -180,20 +180,45 @@ class WhileGuard(BlockGuard):
         return True
 
 
+def _has_value_before(block, name):
+    """Graph-time check: will `name` hold a value at this point of the
+    block (written earlier, fed, or persistable)?  Used to decide which
+    loop-state vars get a pre-loop snapshot for while_grad."""
+    b = block
+    while b is not None:
+        for op in b.ops:
+            if name in op.output_arg_names:
+                return True
+        v = b.vars.get(name)
+        if v is not None and (v.persistable or v.is_data):
+            return True
+        b = (b.program.block(b.parent_idx)
+             if getattr(b, "parent_idx", -1) not in (-1, None) else None)
+    return False
+
+
 class While:
     """``with While(cond).block(): ...`` — the condition var must be
-    reassigned inside the block (reference control_flow.py:630)."""
+    reassigned inside the block (reference control_flow.py:630).
 
-    def __init__(self, cond, is_test=False, name=None):
+    TPU-native extension: pass ``max_trip_count=N`` to make the loop
+    differentiable — backward lowers the loop to a lax.scan over N steps
+    with an active mask (XLA cannot transpose an unbounded while_loop).
+    The forward still runs as a true ``lax.while_loop`` (early exit)."""
+
+    def __init__(self, cond, is_test=False, name=None, max_trip_count=None):
         self.helper = LayerHelper("while", name=name)
         if cond.dtype != "bool":
             raise TypeError("While condition must be a bool Variable")
         self.cond_var = cond
+        self.max_trip_count = max_trip_count
 
     def block(self):
         return WhileGuard(self)
 
     def _complete(self, sub_block):
+        from .. import unique_name
+
         parent = self.helper.main_program.current_block()
         # external reads = X; writes that exist outside = Out (loop state)
         written = set()
@@ -211,6 +236,23 @@ class While:
             n for n in written
             if parent._find_var_recursive(n) is not None
         ]
+        # snapshot pre-loop values of loop-state vars (incl. the condition)
+        # so while_grad can rebuild the loop from its initial state; unused
+        # snapshots are dead code XLA eliminates
+        snap_vars, snap_pres = [], []
+        for n in sorted(set(out_names) | {self.cond_var.name}):
+            if not _has_value_before(parent, n):
+                continue
+            v = parent._find_var_recursive(n)
+            pre = parent.create_var(
+                name=unique_name.generate(n + "@WHILE_PRE"),
+                shape=v.shape, dtype=v.dtype,
+            )
+            parent.append_op(
+                type="assign", inputs={"X": [n]}, outputs={"Out": [pre.name]}
+            )
+            snap_vars.append(n)
+            snap_pres.append(pre.name)
         step_scopes = parent.create_var(
             name=self.helper.name + ".step_scopes",
             type=core.VarDesc.VarType.STEP_SCOPES,
@@ -219,7 +261,13 @@ class While:
             type="while",
             inputs={"X": x_names, "Condition": [self.cond_var]},
             outputs={"Out": out_names, "StepScopes": [step_scopes]},
-            attrs={"sub_block": sub_block.idx, "is_test": False},
+            attrs={
+                "sub_block": sub_block.idx,
+                "is_test": False,
+                "max_trip_count": int(self.max_trip_count or 0),
+                "snapshot_vars": snap_vars,
+                "snapshot_pres": snap_pres,
+            },
         )
 
 
@@ -540,9 +588,194 @@ class StaticRNN:
         )
 
 
+class DynamicRNNGuard(BlockGuard):
+    def __init__(self, rnn):
+        super().__init__(rnn.helper.main_program)
+        self.rnn = rnn
+
+    def __enter__(self):
+        self.sub_block = self.program._create_block()
+        self.rnn._sub_block = self.sub_block
+        self.rnn.status = DynamicRNN.IN_RNN
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        self.program._rollback()
+        self.rnn.status = DynamicRNN.AFTER_RNN
+        self.rnn._complete()
+        return True
+
+
 class DynamicRNN:
+    """Variable-length RNN over PADDED batch-major sequences (reference
+    ``python/paddle/fluid/layers/control_flow.py:1700``).
+
+    The reference walks ragged LoD batches with a lod_rank_table that
+    reorders and shrinks the batch per step; under XLA's static shapes the
+    TPU-native equivalent is a masked ``lax.scan``: sequences are padded to
+    [B, T, ...], a `lengths` tensor [B] marks the real extents, state
+    updates are masked with ``t < length`` (rows past their length carry
+    the previous state), and padded step outputs are zeroed.
+
+    Usage::
+
+        drnn = DynamicRNN()
+        with drnn.block():
+            x_t = drnn.step_input(x, lengths=seq_len)   # x: [B, T, D]
+            h_prev = drnn.memory(shape=[H], value=0.0)
+            h = some_layers(x_t, h_prev)
+            drnn.update_memory(h_prev, h)
+            drnn.output(h)
+        out = drnn()                                    # [B, T, H]
+    """
+
+    BEFORE_RNN = 0
+    IN_RNN = 1
+    AFTER_RNN = 2
+
     def __init__(self, name=None):
-        raise NotImplementedError(
-            "DynamicRNN maps to a masked lax.scan over padded batches — "
-            "use StaticRNN with sequence masks, or layers.dynamic_lstm/gru"
+        self.helper = LayerHelper("dynamic_rnn", name=name)
+        self.status = DynamicRNN.BEFORE_RNN
+        self._sub_block = None
+        self.seq_inputs = []
+        self.step_input_vars = []
+        self.lengths = None
+        self.memories = []
+        self.mem_updates = {}
+        self.step_outputs = []
+        self.outputs = []
+
+    def block(self):
+        return DynamicRNNGuard(self)
+
+    def _assert_in_rnn_block(self, method):
+        if self.status != DynamicRNN.IN_RNN:
+            raise RuntimeError(
+                "%s() can only be called inside drnn.block()" % method
+            )
+
+    def step_input(self, x, level=0, lengths=None):
+        """Declare a [B, T, ...] padded sequence input; returns the per-step
+        [B, ...] view.  `lengths` ([B] int tensor) must accompany the first
+        step_input (it replaces the reference's LoD offsets)."""
+        self._assert_in_rnn_block("step_input")
+        if lengths is not None:
+            self.lengths = lengths
+        if self.lengths is None:
+            raise ValueError(
+                "DynamicRNN.step_input needs a `lengths` tensor with the "
+                "first sequence input (padded batches carry explicit "
+                "lengths instead of LoD)"
+            )
+        self.seq_inputs.append(x)
+        shape = None
+        if x.shape is not None:
+            shape = (x.shape[0],) + tuple(x.shape[2:])
+        sv = self._sub_block.create_var(
+            name=self.helper.name + ".step_in_%d" % len(self.step_input_vars),
+            shape=shape,
+            dtype=x.dtype,
+        )
+        self.step_input_vars.append(sv)
+        return sv
+
+    def static_input(self, x):
+        """A non-sequence var visible unchanged at every step (reference
+        static_input reorders by rank table; the masked scan needs no
+        reorder, so this is the identity — the var is closure-captured)."""
+        self._assert_in_rnn_block("static_input")
+        return x
+
+    def memory(self, init=None, shape=None, value=0.0, need_reorder=False,
+               dtype="float32"):
+        self._assert_in_rnn_block("memory")
+        if init is None:
+            if shape is None or not self.seq_inputs:
+                raise ValueError(
+                    "memory needs init=, or shape= after a step_input"
+                )
+            ref = self.seq_inputs[0]
+            parent = self.helper.main_program.block(
+                self._sub_block.parent_idx
+            )
+            init = parent.create_var(
+                name=self.helper.name + ".mem_init_%d" % len(self.memories),
+                shape=(-1,) + tuple(shape),
+                dtype=dtype,
+            )
+            parent.append_op(
+                type="fill_constant_batch_size_like",
+                inputs={"Input": [ref]},
+                outputs={"Out": [init]},
+                attrs={
+                    "shape": [0] + [int(s) for s in shape],
+                    "dtype": dtype,
+                    "value": float(value),
+                    "input_dim_idx": 0,  # batch dim of [B,T,...]
+                    "output_dim_idx": 0,
+                },
+            )
+        pre = self._sub_block.create_var(
+            name=self.helper.name + ".mem_%d" % len(self.memories),
+            shape=init.shape, dtype=init.dtype,
+        )
+        self.memories.append((pre, init))
+        return pre
+
+    def update_memory(self, ex_mem, new_mem):
+        self._assert_in_rnn_block("update_memory")
+        self.mem_updates[ex_mem.name] = new_mem.name
+
+    def output(self, *outputs):
+        self._assert_in_rnn_block("output")
+        for o in outputs:
+            self.step_outputs.append(o)
+
+    def __call__(self, *args):
+        if self.status != DynamicRNN.AFTER_RNN:
+            raise RuntimeError(
+                "DynamicRNN output requested before block() closed"
+            )
+        if len(self.outputs) == 1:
+            return self.outputs[0]
+        return self.outputs
+
+    def _complete(self):
+        parent = self.helper.main_program.current_block()
+        B = self.seq_inputs[0].shape[0] if self.seq_inputs[0].shape else -1
+        T = self.seq_inputs[0].shape[1] if self.seq_inputs[0].shape else -1
+        out_vars = []
+        for i, so in enumerate(self.step_outputs):
+            ov = parent.create_var(
+                name=self.helper.name + ".out_%d" % i,
+                shape=(B, T) + tuple((so.shape or ())[1:]),
+                dtype=so.dtype,
+            )
+            out_vars.append(ov)
+        self.outputs = out_vars
+        state_out_names = [
+            self.mem_updates.get(pre.name, pre.name)
+            for pre, _ in self.memories
+        ]
+        parent.append_op(
+            type="recurrent",
+            inputs={
+                "inputs": [v.name for v in self.seq_inputs],
+                "initial_states": [init.name for _, init in self.memories],
+                "sequence_length": [self.lengths.name],
+            },
+            outputs={
+                "outputs": [v.name for v in out_vars],
+                "final_states": [],
+            },
+            attrs={
+                "sub_block": self._sub_block.idx,
+                "time_major": False,
+                "step_input_names": [v.name for v in self.step_input_vars],
+                "state_names": [pre.name for pre, _ in self.memories],
+                "state_out_names": state_out_names,
+                "step_output_names": [v.name for v in self.step_outputs],
+            },
         )

@@ -20,7 +20,7 @@ import numpy as np
 from .registry import register_op, EMPTY_VAR_NAME
 
 SUB_BLOCK_OPS = ("while", "conditional_block", "recurrent",
-                 "recurrent_grad", "conditional_block_grad")
+                 "recurrent_grad", "conditional_block_grad", "while_grad")
 
 ARRAY_CAPACITY_ATTR = "tensor_array_capacity"
 DEFAULT_ARRAY_CAPACITY = 128
@@ -104,6 +104,9 @@ def run_sub_block_op(op, block, env, ctx, run_block_fn):
     if op.type == "conditional_block_grad":
         _run_conditional_grad(op, sub_block, env, ctx, run_block_fn)
         return
+    if op.type == "while_grad":
+        _run_while_grad(op, sub_block, env, ctx, run_block_fn)
+        return
 
     if op.type == "while":
         cond_name = op.inputs["Condition"][0]
@@ -161,28 +164,153 @@ def run_sub_block_op(op, block, env, ctx, run_block_fn):
     raise NotImplementedError(op.type)
 
 
-def _run_recurrent(op, sub_block, env, ctx, run_block_fn):
-    """StaticRNN (reference recurrent_op.cc): scan the sub-block over the
-    time axis of the sequence inputs."""
+def _block_carry_sets(sub_block):
+    """Env-independent carry analysis: (written-in-order, read-before-write).
+
+    The grad pass must reproduce the forward loop's math without depending on
+    the runtime env contents, so it uses only block structure + the
+    pre-loop snapshots recorded by the While layer."""
+    written_order = []
+    written = set()
+    read_before_write = set()
+    for op in sub_block.ops:
+        for n in op.input_arg_names:
+            if n and n != EMPTY_VAR_NAME and n not in written:
+                read_before_write.add(n)
+        for n in op.output_arg_names:
+            if n and n != EMPTY_VAR_NAME and n not in written:
+                written.add(n)
+                written_order.append(n)
+    return written_order, read_before_write
+
+
+def _run_while_grad(op, sub_block, env, ctx, run_block_fn):
+    """Reverse-mode through a bounded `while`: re-run the loop as a
+    lax.scan over ``max_trip_count`` steps with an active mask (the standard
+    XLA answer to differentiating data-dependent loops — scan is
+    transposable, while_loop is not), then jax.vjp w.r.t. the pre-loop
+    carry values and the captured outer vars.
+
+    Reference: ``paddle/fluid/operators/controlflow/while_op.cc``
+    (WhileGradOp interprets the block in reverse per step scope); here the
+    whole masked loop is one differentiable scan."""
     import jax
     import jax.numpy as jnp
 
-    seq_inputs = op.inputs.get("inputs", [])         # [B, T, ...] outer vars
-    step_inputs = op.attrs["step_input_names"]       # per-step names in body
-    init_states = op.inputs.get("initial_states", [])  # [B, ...] outer vars
-    state_names = op.attrs["state_names"]            # pre-state name in body
-    state_out_names = op.attrs["state_out_names"]    # post-state name in body
-    step_output_names = op.attrs["step_output_names"]
-    outputs = op.outputs.get("outputs", [])          # stacked [B,T,...] outs
+    out_names = op.inputs.get("Out", [])
+    gout_names = op.inputs.get("Out@GRAD", [])
+    cap_names = op.inputs.get("Captured", [])
+    cond_name = op.inputs["Condition"][0]
+    snap_vars = op.attrs.get("snapshot_vars", [])
+    snap_pres = op.attrs.get("snapshot_pres", [])
+    pre_of = dict(zip(snap_vars, snap_pres))
+    max_trip = int(op.attrs.get("max_trip_count") or 0)
 
+    written_order, read_before_write = _block_carry_sets(sub_block)
+    carried = [
+        n for n in written_order
+        if n in read_before_write or n in pre_of
+    ]
+    if cond_name not in carried:
+        carried.append(cond_name)
+
+    init_vals = []
+    for n in carried:
+        pre = pre_of.get(n)
+        if pre is not None and pre in env:
+            init_vals.append(env[pre])
+        elif n in env:
+            # not written before the loop in the parent block: current env
+            # value IS the pre-loop value (never snapshotted)
+            init_vals.append(env[n])
+        else:
+            raise RuntimeError(
+                "while_grad: no pre-loop value for carried var %r" % n
+            )
+    cap_vals = tuple(env[n] for n in cap_names)
+    active0 = jnp.reshape(init_vals[carried.index(cond_name)], ()).astype(bool)
     outer = dict(env)
-    # StaticRNN steps over axis 0 (time-major [T, B, ...] inputs, matching
-    # the reference's recurrent_op slicing)
-    xs = [env[n] for n in seq_inputs]
-    carry0 = tuple(env[n] for n in init_states)
 
-    def step(carry, xt):
+    def f(init_vals, cap_vals):
+        caps = dict(zip(cap_names, cap_vals))
+
+        def step(state, _):
+            carry, active = state
+            e = dict(outer)
+            e.update(caps)
+            e.update(dict(zip(carried, carry)))
+            run_block_fn(sub_block, e, ctx)
+            new_carry = tuple(
+                jnp.where(active, e[n], old)
+                for n, old in zip(carried, carry)
+            )
+            new_cond = jnp.reshape(
+                new_carry[carried.index(cond_name)], ()
+            ).astype(bool)
+            return (new_carry, jnp.logical_and(active, new_cond)), None
+
+        (final, _), _ = jax.lax.scan(
+            step, (tuple(init_vals), active0), None, length=max_trip
+        )
+        # only float-dtype finals need cotangents
+        return tuple(
+            final[i] for i in range(len(carried))
+            if jnp.issubdtype(final[i].dtype, jnp.inexact)
+        )
+
+    float_idx = [
+        i for i, v in enumerate(init_vals)
+        if jnp.issubdtype(jnp.asarray(v).dtype, jnp.inexact)
+    ]
+    primal, vjp_fn = jax.vjp(f, tuple(init_vals), cap_vals)
+    grad_of_out = dict(zip(out_names, gout_names))
+    cots = []
+    for k, i in enumerate(float_idx):
+        n = carried[i]
+        gname = grad_of_out.get(n)
+        g = env.get(gname) if gname and gname != EMPTY_VAR_NAME else None
+        if g is not None:
+            cots.append(g.astype(primal[k].dtype))
+        else:
+            cots.append(jnp.zeros_like(primal[k]))
+    ginit, gcap = vjp_fn(tuple(cots))
+    gi_of = dict(zip(carried, ginit))
+    names = op.outputs.get("StateIn@GRAD", [])
+    for n, gn in zip(out_names, names):
+        if gn and gn != EMPTY_VAR_NAME and n in gi_of:
+            pre = pre_of.get(n)
+            p = env[pre] if pre is not None and pre in env else env[n]
+            env[gn] = _clean_grad(gi_of[n], p)
+    names = op.outputs.get("Captured@GRAD", [])
+    for n, g, p in zip(names, gcap, cap_vals):
+        if n and n != EMPTY_VAR_NAME:
+            env[n] = _clean_grad(g, p)
+
+
+def _seq_mask_tb(env, op):
+    """[T, B] bool mask from the optional sequence_length input (DynamicRNN
+    masked-scan path); None for the StaticRNN full-length path."""
+    import jax.numpy as jnp
+
+    names = op.inputs.get("sequence_length", [])
+    if not names or not names[0] or names[0] == EMPTY_VAR_NAME:
+        return None
+    lengths = jnp.reshape(env[names[0]], (-1,)).astype(jnp.int32)  # [B]
+    return lengths
+
+
+def _make_step(outer, sub_block, ctx, run_block_fn, op, masked):
+    """Shared scan-step closure for recurrent fwd + grad lowerings."""
+    import jax.numpy as jnp
+
+    step_inputs = op.attrs["step_input_names"]
+    state_names = op.attrs["state_names"]
+    state_out_names = op.attrs["state_out_names"]
+    step_output_names = op.attrs["step_output_names"]
+
+    def step(caps, carry, xt, mt):
         e = dict(outer)
+        e.update(caps)
         for name, val in zip(state_names, carry):
             e[name] = val
         for name, val in zip(step_inputs, xt):
@@ -190,11 +318,64 @@ def _run_recurrent(op, sub_block, env, ctx, run_block_fn):
         run_block_fn(sub_block, e, ctx)
         new_carry = tuple(e[n] for n in state_out_names)
         ys = tuple(e[n] for n in step_output_names)
+        if masked:
+            def bmask(v):
+                return jnp.reshape(mt, (-1,) + (1,) * (v.ndim - 1))
+
+            # inactive (t >= length) rows keep their previous state; padded
+            # step outputs are zeroed (the padded-batch representation of
+            # "no output at this step")
+            new_carry = tuple(
+                jnp.where(bmask(nv), nv, ov)
+                for nv, ov in zip(new_carry, carry)
+            )
+            ys = tuple(jnp.where(bmask(y), y, jnp.zeros_like(y)) for y in ys)
         return new_carry, ys
 
-    final_carry, stacked = jax.lax.scan(step, carry0, tuple(xs))
+    return step
+
+
+def _run_recurrent(op, sub_block, env, ctx, run_block_fn):
+    """StaticRNN (reference recurrent_op.cc): scan the sub-block over the
+    time axis of the sequence inputs.  With attr time_major=False +
+    a sequence_length input this is the DynamicRNN lowering: batch-major
+    padded [B,T,...] sequences, state updates masked by t < length
+    (the TPU-static replacement for the reference's lod_rank_table
+    shrinking-batch reordering, control_flow.py:1700)."""
+    import jax
+    import jax.numpy as jnp
+
+    seq_inputs = op.inputs.get("inputs", [])
+    init_states = op.inputs.get("initial_states", [])  # [B, ...] outer vars
+    outputs = op.outputs.get("outputs", [])          # stacked outs
+    time_major = op.attrs.get("time_major", True)
+
+    outer = dict(env)
+    xs = [env[n] for n in seq_inputs]
+    if not time_major:
+        xs = [jnp.moveaxis(x, 1, 0) for x in xs]  # [B,T,...] -> [T,B,...]
+    carry0 = tuple(env[n] for n in init_states)
+    lengths = _seq_mask_tb(env, op)
+    T = jnp.shape(xs[0])[0] if xs else int(op.attrs.get("max_len", 0))
+    if lengths is not None:
+        mask = jnp.arange(T)[:, None] < lengths[None, :]  # [T, B]
+    else:
+        mask = None
+
+    step_fn = _make_step(outer, sub_block, ctx, run_block_fn, op,
+                         masked=mask is not None)
+
+    def step(carry, inp):
+        xt, mt = inp
+        return step_fn({}, carry, xt, mt)
+
+    final_carry, stacked = jax.lax.scan(
+        step, carry0, (tuple(xs), mask), length=None if xs else T
+    )
     for name, val in zip(outputs, stacked):
-        env[name] = val  # [T, B, ...]
+        if not time_major:
+            val = jnp.moveaxis(val, 0, 1)  # [T,B,...] -> [B,T,...]
+        env[name] = val
     for name, val in zip(op.outputs.get("final_states", []), final_carry):
         env[name] = val
 
@@ -212,29 +393,28 @@ def _run_recurrent_grad(op, sub_block, env, ctx, run_block_fn):
     cap_names = op.inputs.get("Captured", [])
     out_names = op.inputs.get("outputs", [])
     gout_names = op.inputs.get("outputs@GRAD", [])
-    step_inputs = op.attrs["step_input_names"]
-    state_names = op.attrs["state_names"]
-    state_out_names = op.attrs["state_out_names"]
-    step_output_names = op.attrs["step_output_names"]
+    time_major = op.attrs.get("time_major", True)
     outer = dict(env)
+    lengths = _seq_mask_tb(env, op)
 
     def f(seq_vals, init_vals, cap_vals):
         caps = dict(zip(cap_names, cap_vals))
+        xs = list(seq_vals)
+        if not time_major:
+            xs = [jnp.moveaxis(x, 1, 0) for x in xs]
+        T = jnp.shape(xs[0])[0]
+        mask = (jnp.arange(T)[:, None] < lengths[None, :]
+                if lengths is not None else None)
+        step_fn = _make_step(outer, sub_block, ctx, run_block_fn, op,
+                             masked=mask is not None)
 
-        def step(carry, xts):
-            e = dict(outer)
-            e.update(caps)
-            for name, val in zip(state_names, carry):
-                e[name] = val
-            for name, val in zip(step_inputs, xts):
-                e[name] = val
-            run_block_fn(sub_block, e, ctx)
-            return (
-                tuple(e[n] for n in state_out_names),
-                tuple(e[n] for n in step_output_names),
-            )
+        def step(carry, inp):
+            xt, mt = inp
+            return step_fn(caps, carry, xt, mt)
 
-        _, ys = jax.lax.scan(step, tuple(init_vals), tuple(seq_vals))
+        _, ys = jax.lax.scan(step, tuple(init_vals), (tuple(xs), mask))
+        if not time_major:
+            ys = tuple(jnp.moveaxis(y, 0, 1) for y in ys)
         return ys
 
     seq_vals = tuple(env[n] for n in seq_names)

@@ -1,0 +1,208 @@
+"""Bounded-while gradients (masked-scan transpose) + DynamicRNN.
+
+Reference parity targets: ``paddle/fluid/operators/controlflow/while_op.cc``
+(while grad registered in C++) and DynamicRNN at
+``python/paddle/fluid/layers/control_flow.py:1700``.  TPU lowering: backward
+of a bounded `while` re-runs the loop as a lax.scan over max_trip_count
+steps with an active mask; DynamicRNN is a masked scan over padded
+batch-major sequences.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.executor import Scope, scope_guard
+
+
+def _build_pow_loop(max_trip):
+    """y = w**3 * x via `while i < 3: y = w*y` with a trainable scalar w=2."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[1, 1], dtype="float32",
+                              append_batch_size=False)
+        y = fluid.layers.assign(x)
+        i = fluid.layers.fill_constant([1], "float32", 0.0)
+        limit = fluid.layers.fill_constant([1], "float32", 3.0)
+        cond = fluid.layers.less_than(i, limit)
+        w = fluid.layers.While(cond, max_trip_count=max_trip)
+        with w.block():
+            fluid.layers.assign(
+                fluid.layers.fc(
+                    y, size=1, bias_attr=False,
+                    param_attr=fluid.ParamAttr(
+                        name="loop.w",
+                        initializer=fluid.initializer.Constant(2.0),
+                    ),
+                ),
+                output=y,
+            )
+            fluid.layers.increment(i, in_place=True)
+            fluid.layers.less_than(i, limit, cond=cond)
+        loss = fluid.layers.mean(y)
+        params_grads = fluid.backward.append_backward(loss)
+    return main, startup, loss, params_grads
+
+
+@pytest.mark.parametrize("max_trip", [3, 8])
+def test_while_grad_closed_form(max_trip):
+    """d mean(w^3 x)/dw = 3 w^2 x; with max_trip > actual trips the active
+    mask must make the extra scan steps no-ops."""
+    main, startup, loss, params_grads = _build_pow_loop(max_trip)
+    assert len(params_grads) == 1 and params_grads[0][0].name == "loop.w"
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        xv = np.array([[0.5]], "float32")
+        lv, gv = exe.run(
+            main, feed={"x": xv},
+            fetch_list=[loss, params_grads[0][1]],
+        )
+    np.testing.assert_allclose(lv, 8.0 * 0.5, rtol=1e-5)       # w^3 x
+    np.testing.assert_allclose(gv, [[3 * 4.0 * 0.5]], rtol=1e-5)  # 3 w^2 x
+
+
+def test_while_grad_wrt_data_input():
+    """dy/dx through the loop = w^3 (grads reach pre-loop producers)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[1, 1], dtype="float32",
+                              append_batch_size=False, stop_gradient=False)
+        x2 = fluid.layers.scale(x, 3.0)  # pre-loop producer: dy/dx = 3 w^3
+        y = fluid.layers.assign(x2)
+        i = fluid.layers.fill_constant([1], "float32", 0.0)
+        limit = fluid.layers.fill_constant([1], "float32", 3.0)
+        cond = fluid.layers.less_than(i, limit)
+        w = fluid.layers.While(cond, max_trip_count=5)
+        with w.block():
+            fluid.layers.assign(
+                fluid.layers.fc(
+                    y, size=1, bias_attr=False,
+                    param_attr=fluid.ParamAttr(
+                        name="loop2.w",
+                        initializer=fluid.initializer.Constant(2.0),
+                    ),
+                ),
+                output=y,
+            )
+            fluid.layers.increment(i, in_place=True)
+            fluid.layers.less_than(i, limit, cond=cond)
+        loss = fluid.layers.mean(y)
+        (gx,) = fluid.backward.gradients(loss, x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        gv = exe.run(main, feed={"x": np.array([[0.5]], "float32")},
+                     fetch_list=[gx])[0]
+    np.testing.assert_allclose(gv, [[3 * 8.0]], rtol=1e-5)
+
+
+def test_while_unbounded_grad_still_raises():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[1, 1], dtype="float32",
+                              append_batch_size=False)
+        y = fluid.layers.assign(x)
+        i = fluid.layers.fill_constant([1], "float32", 0.0)
+        limit = fluid.layers.fill_constant([1], "float32", 3.0)
+        cond = fluid.layers.less_than(i, limit)
+        w = fluid.layers.While(cond)  # no max_trip_count
+        with w.block():
+            fluid.layers.assign(fluid.layers.scale(y, 2.0), output=y)
+            fluid.layers.increment(i, in_place=True)
+            fluid.layers.less_than(i, limit, cond=cond)
+        loss = fluid.layers.mean(y)
+        with pytest.raises(NotImplementedError, match="max_trip_count"):
+            fluid.backward.append_backward(loss)
+
+
+def _np_dynrnn_cumsum(xv, lens):
+    B, T, D = xv.shape
+    out = np.zeros_like(xv)
+    for b in range(B):
+        h = np.zeros(D, xv.dtype)
+        for t in range(int(lens[b])):
+            h = h + xv[b, t]
+            out[b, t] = h
+    return out
+
+
+def test_dynamic_rnn_cumsum_and_grad():
+    B, T, D = 3, 4, 2
+    lens = np.array([4, 2, 3], "int64")
+    rng = np.random.RandomState(0)
+    xv = rng.randn(B, T, D).astype("float32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[B, T, D], dtype="float32",
+                              append_batch_size=False, stop_gradient=False)
+        sl = fluid.layers.data("sl", shape=[B], dtype="int64",
+                               append_batch_size=False)
+        drnn = fluid.layers.DynamicRNN()
+        with drnn.block():
+            xt = drnn.step_input(x, lengths=sl)
+            h = drnn.memory(shape=[D], value=0.0)
+            nh = fluid.layers.elementwise_add(h, xt)
+            drnn.update_memory(h, nh)
+            drnn.output(nh)
+        out = drnn()  # [B, T, D], zeros past each length
+        loss = fluid.layers.reduce_sum(out)
+        (gx,) = fluid.backward.gradients(loss, x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        ov, gv = exe.run(main, feed={"x": xv, "sl": lens},
+                         fetch_list=[out, gx])
+    np.testing.assert_allclose(ov, _np_dynrnn_cumsum(xv, lens), rtol=1e-5)
+    # d reduce_sum(out)/dx[b,t] = #steps s in [t, len_b) = len_b - t
+    expect = np.zeros((B, T, D), "float32")
+    for b in range(B):
+        for t in range(int(lens[b])):
+            expect[b, t] = lens[b] - t
+    np.testing.assert_allclose(gv, expect, rtol=1e-5)
+
+
+def test_dynamic_rnn_with_fc_trains():
+    B, T, D, H = 4, 5, 3, 6
+    rng = np.random.RandomState(0)
+    lens = np.array([5, 3, 4, 2], "int64")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[B, T, D], dtype="float32",
+                              append_batch_size=False)
+        sl = fluid.layers.data("sl", shape=[B], dtype="int64",
+                               append_batch_size=False)
+        yt = fluid.layers.data("yt", shape=[B, H], dtype="float32",
+                               append_batch_size=False)
+        drnn = fluid.layers.DynamicRNN()
+        with drnn.block():
+            xt = drnn.step_input(x, lengths=sl)
+            mem = drnn.memory(shape=[H], value=0.0)
+            nxt = fluid.layers.fc(
+                [xt, mem], size=H, act="tanh", bias_attr=False
+            )
+            drnn.update_memory(mem, nxt)
+            drnn.output(nxt)
+        out = drnn()  # [B, T, H]
+        # final state of each sequence = out[b, len_b - 1]
+        last = fluid.layers.sequence_last_step_padded(out, sl) \
+            if hasattr(fluid.layers, "sequence_last_step_padded") else None
+        if last is None:
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(
+                    fluid.layers.reduce_sum(out, dim=[1]), yt
+                )
+            )
+        _, params_grads = fluid.optimizer.SGD(0.2).minimize(loss)
+    assert len(params_grads) == 2, "fc weights inside DynamicRNN got no grads"
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        xv = rng.randn(B, T, D).astype("float32")
+        yv = (rng.rand(B, H).astype("float32") - 0.5)
+        losses = [
+            float(exe.run(main, feed={"x": xv, "sl": lens, "yt": yv},
+                          fetch_list=[loss])[0][0])
+            for _ in range(80)
+        ]
+    assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
