@@ -53,7 +53,7 @@ from .framework import (
 from .executor import Executor, global_scope, scope_guard, Scope
 from .param_attr import ParamAttr, WeightNormParamAttr
 from .data_feeder import DataFeeder
-from .core import CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace
+from .core import CPUPlace, TPUPlace, CUDAPlace, CUDAPinnedPlace, set_flags, get_flags
 from .backward import append_backward, gradients
 from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy
 from . import layers

@@ -73,10 +73,30 @@ class CompiledProgram:
         self._loss_name = loss_name
         if build_strategy is not None:
             self._build_strategy = build_strategy
+        self._warn_inert_knobs(self._build_strategy)
         self._exec_strategy = exec_strategy or ExecutionStrategy()
         self._places = places
         self._share_vars_from = share_vars_from
         return self
+
+    @staticmethod
+    def _warn_inert_knobs(bs):
+        """A user porting reference code must not get silently different
+        behavior: warn for knobs this backend does not honor."""
+        import warnings
+
+        if bs.reduce_strategy != BuildStrategy.ReduceStrategy.AllReduce:
+            warnings.warn(
+                "BuildStrategy.reduce_strategy=Reduce has no TPU "
+                "equivalent: GSPMD always emits fused all-reduce over ICI; "
+                "proceeding with AllReduce semantics", stacklevel=3)
+        if (bs.gradient_scale_strategy
+                == BuildStrategy.GradientScaleStrategy.Customized):
+            warnings.warn(
+                "GradientScaleStrategy.Customized is not supported: scale "
+                "the loss explicitly in the program instead "
+                "(reference multi_devices_graph_pass ScaleLossGrad)",
+                stacklevel=3)
 
     def with_inference_optimize(self, config):
         # analysis passes are XLA's job under jit; clone(for_test) is enough

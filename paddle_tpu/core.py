@@ -138,3 +138,60 @@ def get_device_count():
     import jax
 
     return jax.device_count()
+
+
+# ---------------------------------------------------------------------------
+# Flag system (reference: gflags exposed through __bootstrap__ forwarding
+# whitelisted FLAGS_* env vars, python/paddle/fluid/__init__.py:124-199).
+# TPU-native: the debugging flags map onto jax config switches.
+# ---------------------------------------------------------------------------
+
+_flags = {
+    # NaN/Inf debugging (reference FLAGS_check_nan_inf: per-op nan printers
+    # via lodtensor_printer; here jax re-runs the offending op de-optimized
+    # and raises with the op name — same diagnosis, compiler-native)
+    "FLAGS_check_nan_inf": False,
+    # bit-exact cross-platform determinism (reference FLAGS_cpu_deterministic)
+    "FLAGS_cpu_deterministic": False,
+    "FLAGS_benchmark": False,
+}
+
+
+def set_flags(flags):
+    """Set runtime debugging flags (reference ``fluid.set_flags``)."""
+    import jax
+
+    flags = dict(flags)
+    unknown = [n for n in flags if n not in _flags]
+    if unknown:
+        raise KeyError("unknown flag(s) %r (known: %s)"
+                       % (unknown, sorted(_flags)))
+    for name, value in flags.items():
+        _flags[name] = value
+        if name == "FLAGS_check_nan_inf":
+            jax.config.update("jax_debug_nans", bool(value))
+        elif name == "FLAGS_cpu_deterministic" and value:
+            import os
+
+            os.environ.setdefault("PADDLE_TPU_RNG_IMPL", "threefry2x32")
+
+
+def get_flags(names):
+    if isinstance(names, str):
+        return {names: _flags[names]}
+    return {n: _flags[n] for n in names}
+
+
+def _bootstrap_flags():
+    """Forward FLAGS_* env vars into the flag registry at import, the
+    reference ``__bootstrap__`` pattern."""
+    import os
+
+    for name in list(_flags):
+        raw = os.environ.get(name)
+        if raw is None:
+            continue
+        set_flags({name: raw.lower() in ("1", "true", "yes", "on")})
+
+
+_bootstrap_flags()

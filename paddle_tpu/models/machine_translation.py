@@ -93,6 +93,53 @@ def build_train(vocab_size, emb_dim=32, hidden_dim=64, src_len=8, tgt_len=8,
     return main, startup, [src, tgt_in, tgt_out], loss
 
 
+def build_train_dynamic(vocab_size, emb_dim=32, hidden_dim=64, src_len=8,
+                        tgt_len=8, lr=1e-3):
+    """Teacher-forced trainer whose decoder is a DynamicRNN over padded
+    variable-length targets (the reference book model's decoder shape:
+    ``python/paddle/fluid/tests/book/test_machine_translation.py`` uses
+    DynamicRNN over ragged LoD targets; here targets are padded [B,T]
+    with an explicit `tgt_lens` feed and the loss is length-masked).
+
+    Returns (main, startup, feed names, loss)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = fluid.layers.data("src", shape=[src_len], dtype="int64")
+        tgt_in = fluid.layers.data("tgt_in", shape=[tgt_len], dtype="int64")
+        tgt_out = fluid.layers.data("tgt_out", shape=[tgt_len, 1],
+                                    dtype="int64")
+        tgt_lens = fluid.layers.data("tgt_lens", shape=[], dtype="int64")
+        context, h0 = encode(src, vocab_size, emb_dim, hidden_dim)
+
+        tgt_emb = fluid.layers.embedding(
+            tgt_in, size=[vocab_size, emb_dim], param_attr=_shared("tgt_emb"))
+
+        drnn = fluid.layers.DynamicRNN()
+        with drnn.block():
+            x_t = drnn.step_input(tgt_emb, lengths=tgt_lens)  # [B, E]
+            h = drnn.memory(init=h0)                          # [B, H]
+            inp = fluid.layers.concat([x_t, context], axis=1)
+            h_new = gru_cell(inp, h, hidden_dim, "dec_gru")
+            drnn.update_memory(h, h_new)
+            drnn.output(h_new)
+        hiddens = drnn()                                      # [B, T, H]
+
+        logits = fluid.layers.fc(
+            hiddens, size=vocab_size, num_flatten_dims=2,
+            param_attr=_shared("out_w"), bias_attr=_shared("out_b"))
+        tok_loss = fluid.layers.softmax_with_cross_entropy(
+            logits, tgt_out)                                  # [B, T, 1]
+        mask = fluid.layers.cast(
+            fluid.layers.sequence_mask(tgt_lens, maxlen=tgt_len), "float32")
+        tok_loss = fluid.layers.elementwise_mul(
+            fluid.layers.squeeze(tok_loss, axes=[2]), mask)
+        loss = fluid.layers.elementwise_div(
+            fluid.layers.reduce_sum(tok_loss),
+            fluid.layers.reduce_sum(mask))
+        fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
+    return main, startup, [src, tgt_in, tgt_out, tgt_lens], loss
+
+
 def build_infer(vocab_size, emb_dim=32, hidden_dim=64, src_len=8,
                 batch_size=4, beam_size=3, max_len=10, start_id=1, end_id=2):
     """Beam-search decoder sharing all parameters with build_train.

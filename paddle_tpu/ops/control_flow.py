@@ -287,8 +287,8 @@ def _run_while_grad(op, sub_block, env, ctx, run_block_fn):
             env[n] = _clean_grad(g, p)
 
 
-def _seq_mask_tb(env, op):
-    """[T, B] bool mask from the optional sequence_length input (DynamicRNN
+def _seq_lengths(env, op):
+    """[B] int32 lengths from the optional sequence_length input (DynamicRNN
     masked-scan path); None for the StaticRNN full-length path."""
     import jax.numpy as jnp
 
@@ -355,7 +355,7 @@ def _run_recurrent(op, sub_block, env, ctx, run_block_fn):
     if not time_major:
         xs = [jnp.moveaxis(x, 1, 0) for x in xs]  # [B,T,...] -> [T,B,...]
     carry0 = tuple(env[n] for n in init_states)
-    lengths = _seq_mask_tb(env, op)
+    lengths = _seq_lengths(env, op)
     T = jnp.shape(xs[0])[0] if xs else int(op.attrs.get("max_len", 0))
     if lengths is not None:
         mask = jnp.arange(T)[:, None] < lengths[None, :]  # [T, B]
@@ -395,7 +395,7 @@ def _run_recurrent_grad(op, sub_block, env, ctx, run_block_fn):
     gout_names = op.inputs.get("outputs@GRAD", [])
     time_major = op.attrs.get("time_major", True)
     outer = dict(env)
-    lengths = _seq_mask_tb(env, op)
+    lengths = _seq_lengths(env, op)
 
     def f(seq_vals, init_vals, cap_vals):
         caps = dict(zip(cap_names, cap_vals))

@@ -259,3 +259,50 @@ def lamb(ctx, attrs, Param, Grad, LearningRate, Moment1, Moment2,
         "Beta1PowOut": (b1p * beta1).reshape(Beta1Pow.shape).astype(Beta1Pow.dtype),
         "Beta2PowOut": (b2p * beta2).reshape(Beta2Pow.shape).astype(Beta2Pow.dtype),
     }
+
+
+@register_op(
+    "average_accumulates",
+    inputs=["param", "in_sum_1", "in_sum_2", "in_sum_3",
+            "in_num_accumulates", "in_old_num_accumulates",
+            "in_num_updates"],
+    outputs=["out_sum_1", "out_sum_2", "out_sum_3", "out_num_accumulates",
+             "out_old_num_accumulates", "out_num_updates"],
+    no_grad=True,
+)
+def average_accumulates(ctx, attrs, param, in_sum_1, in_sum_2, in_sum_3,
+                        in_num_accumulates, in_old_num_accumulates,
+                        in_num_updates):
+    """Sliding-window parameter-sum accumulator for ModelAverage
+    (reference ``paddle/fluid/operators/average_accumulates_op.h:30``):
+    three-tier sums avoid fp precision loss; the window restarts when
+    num_accumulates exceeds min(max_average_window,
+    num_updates*average_window).  The C++ kernel's host-side branches
+    become jnp.where selects so the whole update stays inside jit."""
+    s1, s2, s3 = in_sum_1, in_sum_2, in_sum_3
+    na, ona, nu = in_num_accumulates, in_old_num_accumulates, in_num_updates
+    k_max = 16384  # kMaxNumAccumulates, precision-preserving fold period
+    avg_window = float(attrs.get("average_window", 0.0))
+    max_w = int(attrs.get("max_average_window", 10000))
+    min_w = int(attrs.get("min_average_window", 10000))
+
+    nu = nu + 1
+    na = na + 1
+    s1 = s1 + param.astype(s1.dtype)
+    fold = (nu % k_max) == 0
+    s2 = jnp.where(fold, s2 + s1, s2)
+    s1 = jnp.where(fold, jnp.zeros_like(s1), s1)
+    window = jnp.minimum(
+        jnp.asarray(max_w, jnp.float32), nu.astype(jnp.float32) * avg_window
+    )
+    restart = (na >= min_w) & (na.astype(jnp.float32) >= window)
+    s3 = jnp.where(restart, s1 + s2, s3)
+    s1 = jnp.where(restart, jnp.zeros_like(s1), s1)
+    s2 = jnp.where(restart, jnp.zeros_like(s2), s2)
+    ona = jnp.where(restart, na, ona)
+    na = jnp.where(restart, jnp.zeros_like(na), na)
+    return {
+        "out_sum_1": s1, "out_sum_2": s2, "out_sum_3": s3,
+        "out_num_accumulates": na, "out_old_num_accumulates": ona,
+        "out_num_updates": nu,
+    }

@@ -7,6 +7,8 @@ function as the model; param/accumulator buffers are donated by the
 executor, so the update is in-place in HBM and XLA fuses the whole update
 chain — subsuming the reference's fuse_optimizer_ops_pass."""
 
+import contextlib
+
 from collections import defaultdict
 
 from .framework import Program, Variable, default_main_program, default_startup_program, program_guard, name_scope
@@ -777,14 +779,34 @@ class DGCMomentumOptimizer(MomentumOptimizer):
                          regularization, name)
 
 
+def _mirror_var(block, var, persistable=True):
+    """Declare `var` (by name) in another program's block so its value is
+    resolved from the shared scope at run time (the reference's
+    block._clone_variable pattern for apply/restore programs)."""
+    if block.has_var(var.name):
+        return block.var(var.name)
+    v = block.create_var(
+        name=var.name, shape=var.shape, dtype=var.dtype,
+        persistable=persistable,
+    )
+    v.stop_gradient = True
+    return v
+
+
 class ExponentialMovingAverage:
     """EMA of params maintained as extra persistable vars updated in-graph
-    (reference optimizer.py:2434)."""
+    (reference optimizer.py:2434).  ``apply``/``restore`` run small
+    dedicated programs against the same scope (the reference's
+    apply_program/restore_program pattern): apply backs params up to tmp
+    vars and swaps in the bias-corrected EMA ``ema/(1-decay^t)``; restore
+    copies the backups back."""
 
     def __init__(self, decay=0.999, thres_steps=None, name=None):
         self._decay = decay
+        self._thres_steps = thres_steps
         self._name = name or ""
         self._ema_vars = {}
+        self._backup_vars = {}
         self._params = []
         program = default_main_program()
         helper = LayerHelper("ema")
@@ -800,12 +822,74 @@ class ExponentialMovingAverage:
             helper.set_variable_initializer(ema, ConstantInitializer(0.0))
             self._ema_vars[p.name] = ema
             self._params.append(p)
+        # update-step counter for the 1/(1-decay^t) bias correction
+        self._counter = block.create_var(
+            name=unique_name.generate(self._name + "ema.step"),
+            shape=[1], dtype="float32", persistable=True,
+        )
+        self._counter.stop_gradient = True
+        helper.set_variable_initializer(
+            self._counter, ConstantInitializer(0.0)
+        )
+        # scheduled decay rate (reference _get_ema_decay: with thres_steps
+        # the effective decay is min(decay, (1+t)/(10+t))), kept in a
+        # persistable var so the apply program's bias correction sees the
+        # same rate the updates used
+        self._decay_var = block.create_var(
+            name=unique_name.generate(self._name + "ema.decay"),
+            shape=[1], dtype="float32", persistable=True,
+        )
+        self._decay_var.stop_gradient = True
+        helper.set_variable_initializer(
+            self._decay_var, ConstantInitializer(float(decay))
+        )
+        self._apply_program = None
+        self._restore_program = None
+
+    def _append_scheduled_decay(self, block):
+        """decay_var = min(decay, (1+thres)/(10+thres)) as graph ops."""
+        from .layers import tensor as ltensor
+
+        t = self._thres_steps
+        num = block.create_var(
+            name=unique_name.generate("ema.decay_num"), shape=[1],
+            dtype="float32")
+        den = block.create_var(
+            name=unique_name.generate("ema.decay_den"), shape=[1],
+            dtype="float32")
+        ratio = block.create_var(
+            name=unique_name.generate("ema.decay_ratio"), shape=[1],
+            dtype="float32")
+        tf = block.create_var(
+            name=unique_name.generate("ema.thres_f"), shape=[1],
+            dtype="float32")
+        block.append_op(type="cast", inputs={"X": [t]},
+                        outputs={"Out": [tf]},
+                        attrs={"out_dtype": "float32"})
+        block.append_op(type="scale", inputs={"X": [tf]},
+                        outputs={"Out": [num]},
+                        attrs={"scale": 1.0, "bias": 1.0})
+        block.append_op(type="scale", inputs={"X": [tf]},
+                        outputs={"Out": [den]},
+                        attrs={"scale": 1.0, "bias": 10.0})
+        block.append_op(type="elementwise_div",
+                        inputs={"X": [num], "Y": [den]},
+                        outputs={"Out": [ratio]})
+        cap = ltensor.fill_constant([1], "float32", float(self._decay))
+        block.append_op(type="elementwise_min",
+                        inputs={"X": [ratio], "Y": [cap]},
+                        outputs={"Out": [self._decay_var]})
 
     def update(self):
         block = default_main_program().global_block()
+        block.append_op(
+            type="increment", inputs={"X": [self._counter]},
+            outputs={"Out": [self._counter]}, attrs={"step": 1.0},
+        )
+        if self._thres_steps is not None:
+            self._append_scheduled_decay(block)
         for p in self._params:
             ema = self._ema_vars[p.name]
-            # ema = decay*ema + (1-decay)*p, built from scale+sum ops
             t1 = block.create_var(
                 name=unique_name.generate(p.name + ".ema_t1"),
                 shape=p.shape, dtype=p.dtype,
@@ -814,30 +898,281 @@ class ExponentialMovingAverage:
                 name=unique_name.generate(p.name + ".ema_t2"),
                 shape=p.shape, dtype=p.dtype,
             )
-            block.append_op(
-                type="scale", inputs={"X": [ema]}, outputs={"Out": [t1]},
-                attrs={"scale": self._decay},
-            )
-            block.append_op(
-                type="scale", inputs={"X": [p]}, outputs={"Out": [t2]},
-                attrs={"scale": 1.0 - self._decay},
-            )
+            if self._thres_steps is not None:
+                # ema = d*ema + (1-d)*p with the runtime-scheduled d
+                one_minus = block.create_var(
+                    name=unique_name.generate(p.name + ".ema_1md"),
+                    shape=[1], dtype="float32",
+                )
+                block.append_op(
+                    type="scale", inputs={"X": [self._decay_var]},
+                    outputs={"Out": [one_minus]},
+                    attrs={"scale": -1.0, "bias": 1.0},
+                )
+                block.append_op(
+                    type="elementwise_mul",
+                    inputs={"X": [ema], "Y": [self._decay_var]},
+                    outputs={"Out": [t1]},
+                )
+                block.append_op(
+                    type="elementwise_mul",
+                    inputs={"X": [p], "Y": [one_minus]},
+                    outputs={"Out": [t2]},
+                )
+            else:
+                # fixed decay: ema = decay*ema + (1-decay)*p via scale ops
+                block.append_op(
+                    type="scale", inputs={"X": [ema]}, outputs={"Out": [t1]},
+                    attrs={"scale": self._decay},
+                )
+                block.append_op(
+                    type="scale", inputs={"X": [p]}, outputs={"Out": [t2]},
+                    attrs={"scale": 1.0 - self._decay},
+                )
             block.append_op(
                 type="sum", inputs={"X": [t1, t2]}, outputs={"Out": [ema]},
             )
 
-    def apply(self, executor=None, need_restore=True):
-        raise NotImplementedError("EMA apply/restore lands with io batch")
+    def _mirror(self, block, var, persistable=True):
+        return _mirror_var(block, var, persistable)
 
-    def restore(self, executor=None):
-        raise NotImplementedError
+    def _build_programs(self):
+        from .layers import tensor as ltensor
+
+        self._apply_program = Program()
+        with program_guard(self._apply_program):
+            block = self._apply_program.global_block()
+            counter = self._mirror(block, self._counter)
+            decay = self._mirror(block, self._decay_var)
+            decay_pow = block.create_var(
+                name=unique_name.generate("ema.decay_pow"),
+                shape=[1], dtype="float32",
+            )
+            block.append_op(
+                type="elementwise_pow",
+                inputs={"X": [decay], "Y": [counter]},
+                outputs={"Out": [decay_pow]},
+            )
+            one = ltensor.fill_constant([1], "float32", 1.0)
+            denom = block.create_var(
+                name=unique_name.generate("ema.denom"),
+                shape=[1], dtype="float32",
+            )
+            block.append_op(
+                type="elementwise_sub",
+                inputs={"X": [one], "Y": [decay_pow]},
+                outputs={"Out": [denom]},
+            )
+            # before any update() has run, counter==0 → denom==0; clamp so
+            # apply() yields the zero-initialized EMA instead of NaN params
+            denom_safe = block.create_var(
+                name=unique_name.generate("ema.denom_safe"),
+                shape=[1], dtype="float32",
+            )
+            eps = ltensor.fill_constant([1], "float32", 1e-12)
+            block.append_op(
+                type="elementwise_max",
+                inputs={"X": [denom], "Y": [eps]},
+                outputs={"Out": [denom_safe]},
+            )
+            denom = denom_safe
+            for p in self._params:
+                pv = self._mirror(block, p)
+                ema = self._mirror(block, self._ema_vars[p.name])
+                backup = block.create_var(
+                    name=unique_name.generate(p.name + ".ema_bak"),
+                    shape=p.shape, dtype=p.dtype, persistable=True,
+                )
+                backup.stop_gradient = True
+                self._backup_vars[p.name] = backup
+                block.append_op(
+                    type="assign", inputs={"X": [pv]},
+                    outputs={"Out": [backup]},
+                )
+                corrected = block.create_var(
+                    name=unique_name.generate(p.name + ".ema_corr"),
+                    shape=p.shape, dtype=p.dtype,
+                )
+                block.append_op(
+                    type="elementwise_div",
+                    inputs={"X": [ema], "Y": [denom]},
+                    outputs={"Out": [corrected]},
+                )
+                block.append_op(
+                    type="assign", inputs={"X": [corrected]},
+                    outputs={"Out": [pv]},
+                )
+
+        self._restore_program = Program()
+        with program_guard(self._restore_program):
+            block = self._restore_program.global_block()
+            for p in self._params:
+                pv = self._mirror(block, p)
+                bak = self._mirror(block, self._backup_vars[p.name])
+                block.append_op(
+                    type="assign", inputs={"X": [bak]},
+                    outputs={"Out": [pv]},
+                )
+
+    @contextlib.contextmanager
+    def apply(self, executor, need_restore=True):
+        """Swap bias-corrected EMA values into the params for evaluation."""
+        if self._apply_program is None:
+            self._build_programs()
+        executor.run(self._apply_program)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(executor)
+
+    def restore(self, executor):
+        if self._restore_program is None:
+            raise RuntimeError("EMA.restore called before apply")
+        executor.run(self._restore_program)
 
 
 class ModelAverage(Optimizer):
-    def __init__(self, *a, **k):
-        raise NotImplementedError(
-            "ModelAverage lands with the advanced-optimizer batch"
+    """Sliding-window average of parameters (reference optimizer.py:2244):
+    every step accumulates the param into three-tier sums via the
+    ``average_accumulates`` op; ``apply`` swaps the window average
+    ``(sum_1+sum_2+sum_3)/(num_accumulates+old_num_accumulates)`` into the
+    params for evaluation and ``restore`` swaps back."""
+
+    def __init__(self, average_window_rate, min_average_window=10000,
+                 max_average_window=10000, regularization=None, name=None):
+        super().__init__(0.0, regularization=regularization, name=name)
+        self.type = "average_accumulates"
+        self.average_window = average_window_rate
+        self.min_average_window = min_average_window
+        self.max_average_window = max_average_window
+        self._params = []
+        self._backup_vars = {}
+        program = default_main_program()
+        block = program.global_block()
+        for p in program.all_parameters():
+            if getattr(p, "do_model_average", None) is False:
+                continue
+            self._params.append(p)
+        for p in self._params:
+            self._append_average_accumulate_op(block, p)
+        self._apply_program = None
+        self._restore_program = None
+
+    def _append_average_accumulate_op(self, block, param):
+        s1 = self._add_accumulator("sum_1", param, dtype=param.dtype)
+        s2 = self._add_accumulator("sum_2", param, dtype=param.dtype)
+        s3 = self._add_accumulator("sum_3", param, dtype=param.dtype)
+        na = self._add_accumulator("num_accumulates", param, dtype="int64",
+                                   shape=[1])
+        ona = self._add_accumulator("old_num_accumulates", param,
+                                    dtype="int64", shape=[1])
+        nu = self._add_accumulator("num_updates", param, dtype="int64",
+                                   shape=[1])
+        block.append_op(
+            type="average_accumulates",
+            inputs={
+                "param": [param], "in_sum_1": [s1], "in_sum_2": [s2],
+                "in_sum_3": [s3], "in_num_accumulates": [na],
+                "in_old_num_accumulates": [ona], "in_num_updates": [nu],
+            },
+            outputs={
+                "out_sum_1": [s1], "out_sum_2": [s2], "out_sum_3": [s3],
+                "out_num_accumulates": [na], "out_old_num_accumulates": [ona],
+                "out_num_updates": [nu],
+            },
+            attrs={
+                "average_window": float(self.average_window),
+                "min_average_window": int(self.min_average_window),
+                "max_average_window": int(self.max_average_window),
+                "op_role": "optimize",
+            },
         )
+
+    def _mirror(self, block, var, persistable=True):
+        return _mirror_var(block, var, persistable)
+
+    def _build_programs(self):
+        self._apply_program = Program()
+        with program_guard(self._apply_program):
+            block = self._apply_program.global_block()
+            for p in self._params:
+                pv = self._mirror(block, p)
+                s1 = self._mirror(block, self._get_accumulator("sum_1", p))
+                s2 = self._mirror(block, self._get_accumulator("sum_2", p))
+                s3 = self._mirror(block, self._get_accumulator("sum_3", p))
+                na = self._mirror(
+                    block, self._get_accumulator("num_accumulates", p))
+                ona = self._mirror(
+                    block, self._get_accumulator("old_num_accumulates", p))
+                backup = block.create_var(
+                    name=unique_name.generate(p.name + ".avg_bak"),
+                    shape=p.shape, dtype=p.dtype, persistable=True,
+                )
+                backup.stop_gradient = True
+                self._backup_vars[p.name] = backup
+                block.append_op(
+                    type="assign", inputs={"X": [pv]},
+                    outputs={"Out": [backup]},
+                )
+                total = block.create_var(
+                    name=unique_name.generate(p.name + ".avg_sum"),
+                    shape=p.shape, dtype=p.dtype,
+                )
+                block.append_op(
+                    type="sum", inputs={"X": [s1, s2, s3]},
+                    outputs={"Out": [total]},
+                )
+                cnt_i = block.create_var(
+                    name=unique_name.generate(p.name + ".avg_cnt_i"),
+                    shape=[1], dtype="int64",
+                )
+                block.append_op(
+                    type="sum", inputs={"X": [na, ona]},
+                    outputs={"Out": [cnt_i]},
+                )
+                cnt = block.create_var(
+                    name=unique_name.generate(p.name + ".avg_cnt"),
+                    shape=[1], dtype="float32",
+                )
+                block.append_op(
+                    type="cast", inputs={"X": [cnt_i]},
+                    outputs={"Out": [cnt]},
+                    attrs={"out_dtype": "float32"},
+                )
+                block.append_op(
+                    type="elementwise_div",
+                    inputs={"X": [total], "Y": [cnt]},
+                    outputs={"Out": [pv]},
+                )
+
+        self._restore_program = Program()
+        with program_guard(self._restore_program):
+            block = self._restore_program.global_block()
+            for p in self._params:
+                pv = self._mirror(block, p)
+                bak = self._mirror(block, self._backup_vars[p.name])
+                block.append_op(
+                    type="assign", inputs={"X": [bak]},
+                    outputs={"Out": [pv]},
+                )
+
+    @contextlib.contextmanager
+    def apply(self, executor, need_restore=True):
+        """Swap the window-averaged params in for evaluation."""
+        if self._apply_program is None:
+            self._build_programs()
+        executor.run(self._apply_program)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore(executor)
+
+    def restore(self, executor):
+        if self._restore_program is None:
+            raise RuntimeError("ModelAverage.restore called before apply")
+        executor.run(self._restore_program)
 
 
 class PipelineOptimizer:

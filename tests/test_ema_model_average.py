@@ -1,0 +1,142 @@
+"""EMA apply/restore + ModelAverage (reference optimizer.py:2244,2434)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.executor import Scope, scope_guard
+
+
+def _param_value(scope, name):
+    return np.asarray(scope.get(name))
+
+
+def test_ema_apply_restore_bias_corrected():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(
+            x, size=3, bias_attr=False,
+            param_attr=fluid.ParamAttr(name="w"))
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        ema = fluid.optimizer.ExponentialMovingAverage(decay=0.9)
+        ema.update()
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = Scope()
+    rng = np.random.RandomState(0)
+    with scope_guard(scope):
+        exe.run(startup)
+        steps = 4
+        param_hist = []
+        for _ in range(steps):
+            exe.run(main, feed={"x": rng.randn(8, 4).astype("float32")},
+                    fetch_list=[])
+            param_hist.append(_param_value(scope, "w"))
+        raw = _param_value(scope, "w")
+        # numpy EMA oracle with bias correction
+        ema_np = np.zeros_like(param_hist[0])
+        for p in param_hist:
+            ema_np = 0.9 * ema_np + 0.1 * p
+        ema_np = ema_np / (1.0 - 0.9 ** steps)
+        with ema.apply(exe):
+            applied = _param_value(scope, "w")
+            np.testing.assert_allclose(applied, ema_np, rtol=1e-5)
+            assert not np.allclose(applied, raw)
+        restored = _param_value(scope, "w")
+        np.testing.assert_allclose(restored, raw, rtol=1e-6)
+
+
+def test_ema_apply_no_restore():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(x, size=3, bias_attr=False,
+                            param_attr=fluid.ParamAttr(name="w2"))
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        ema = fluid.optimizer.ExponentialMovingAverage(decay=0.5)
+        ema.update()
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = Scope()
+    rng = np.random.RandomState(1)
+    with scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed={"x": rng.randn(8, 4).astype("float32")},
+                fetch_list=[])
+        with ema.apply(exe, need_restore=False):
+            applied = _param_value(scope, "w2")
+        after = _param_value(scope, "w2")
+        np.testing.assert_allclose(after, applied)
+        ema.restore(exe)  # explicit restore still works
+
+
+def test_model_average_window():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.fc(x, size=3, bias_attr=False,
+                            param_attr=fluid.ParamAttr(name="wa", do_model_average=True))
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        # window never restarts in this short run: average over ALL steps
+        avg = fluid.optimizer.ModelAverage(
+            0.15, min_average_window=10000, max_average_window=20000)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = Scope()
+    rng = np.random.RandomState(2)
+    with scope_guard(scope):
+        exe.run(startup)
+        hist = []
+        for _ in range(6):
+            exe.run(main, feed={"x": rng.randn(8, 4).astype("float32")},
+                    fetch_list=[])
+            hist.append(_param_value(scope, "wa"))
+        raw = _param_value(scope, "wa")
+        with avg.apply(exe):
+            applied = _param_value(scope, "wa")
+            np.testing.assert_allclose(
+                applied, np.mean(hist, axis=0), rtol=1e-5)
+        np.testing.assert_allclose(_param_value(scope, "wa"), raw, rtol=1e-6)
+
+
+def test_model_average_window_restart():
+    """With a tiny max window the accumulator restarts: the average covers
+    only the steps since the last restart (old window kept via
+    old_num_accumulates until the next fold)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2], dtype="float32")
+        y = fluid.layers.fc(x, size=1, bias_attr=False,
+                            param_attr=fluid.ParamAttr(name="wr", do_model_average=True))
+        loss = fluid.layers.mean(y)
+        fluid.optimizer.SGD(0.5).minimize(loss)
+        avg = fluid.optimizer.ModelAverage(
+            1.0, min_average_window=2, max_average_window=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = Scope()
+    rng = np.random.RandomState(3)
+    with scope_guard(scope):
+        exe.run(startup)
+        hist = []
+        for _ in range(7):
+            exe.run(main, feed={"x": rng.randn(4, 2).astype("float32")},
+                    fetch_list=[])
+            hist.append(_param_value(scope, "wr"))
+        # numpy oracle of the reference accumulator
+        s1 = s2 = s3 = np.zeros_like(hist[0])
+        na = ona = nu = 0
+        for p in hist:
+            nu += 1
+            na += 1
+            s1 = s1 + p
+            if na >= 2 and na >= min(3, nu * 1.0):
+                s3 = s1 + s2
+                s1 = np.zeros_like(s1)
+                s2 = np.zeros_like(s2)
+                ona, na = na, 0
+        expect = (s1 + s2 + s3) / float(na + ona)
+        with avg.apply(exe):
+            np.testing.assert_allclose(
+                _param_value(scope, "wr"), expect, rtol=1e-5)

@@ -1,0 +1,52 @@
+"""Flag system: FLAGS_check_nan_inf → jax_debug_nans (reference:
+FLAGS_check_nan_inf / nan-inf printers, SURVEY §5 race/NaN aids) and
+BuildStrategy inert-knob warnings."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.executor import Scope, scope_guard
+
+
+def test_check_nan_inf_flag_catches_nan():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2], dtype="float32")
+        y = fluid.layers.log(x)  # log(-1) = nan
+        loss = fluid.layers.mean(y)
+    exe = fluid.Executor(fluid.CPUPlace())
+    fluid.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with scope_guard(Scope()):
+            with pytest.raises(Exception, match="[Nn]a[Nn]"):
+                exe.run(main, feed={"x": np.array([[-1.0, 2.0]], "float32")},
+                        fetch_list=[loss])
+    finally:
+        fluid.set_flags({"FLAGS_check_nan_inf": False})
+    # and with the flag off the same program runs (nan propagates silently)
+    with scope_guard(Scope()):
+        out = exe.run(main, feed={"x": np.array([[-1.0, 2.0]], "float32")},
+                      fetch_list=[loss])[0]
+    assert np.isnan(out).any()
+
+
+def test_unknown_flag_rejected():
+    with pytest.raises(KeyError):
+        fluid.set_flags({"FLAGS_not_a_flag": 1})
+    assert fluid.get_flags("FLAGS_benchmark") is not None
+
+
+def test_build_strategy_inert_knob_warns():
+    bs = fluid.BuildStrategy()
+    bs.reduce_strategy = fluid.BuildStrategy.ReduceStrategy.Reduce
+    prog = fluid.Program()
+    with pytest.warns(UserWarning, match="reduce_strategy"):
+        fluid.CompiledProgram(prog).with_data_parallel(
+            loss_name="x", build_strategy=bs)
+    bs2 = fluid.BuildStrategy()
+    bs2.gradient_scale_strategy = (
+        fluid.BuildStrategy.GradientScaleStrategy.Customized)
+    with pytest.warns(UserWarning, match="Customized"):
+        fluid.CompiledProgram(prog).with_data_parallel(
+            loss_name="x", build_strategy=bs2)

@@ -1,0 +1,51 @@
+"""2-process jax.distributed smoke test — the reference's localhost
+subprocess-cluster pattern (test_dist_base.py:414 free ports, :429 Popen
+trainers), with the jax coordination service replacing gen_nccl_id."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_fleet_allreduce_and_dp_step():
+    port = _free_port()
+    coord = "127.0.0.1:%d" % port
+    worker = os.path.join(os.path.dirname(__file__), "dist_worker.py")
+    procs = []
+    for rank in (0, 1):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)  # 1 local device per process
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": "2",
+            "PADDLE_TRAINER_ENDPOINTS": "%s,127.0.0.1:%d" % (coord,
+                                                             port + 1),
+            "PADDLE_COORDINATOR_ADDRESS": coord,
+            "JAX_PLATFORMS": "cpu",
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, worker], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for rank, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, "rank %d failed:\n%s" % (rank, out[-4000:])
+        assert "DIST_OK rank=%d" % rank in out

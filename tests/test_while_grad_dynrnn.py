@@ -162,6 +162,35 @@ def test_dynamic_rnn_cumsum_and_grad():
     np.testing.assert_allclose(gv, expect, rtol=1e-5)
 
 
+def test_nmt_dynamic_rnn_decoder_trains():
+    """Seq2seq trainer whose decoder is a DynamicRNN over padded
+    variable-length targets (book machine_translation decoder shape)."""
+    from paddle_tpu.models import machine_translation as mt
+
+    V, B, TS, TT = 40, 8, 6, 7
+    rng = np.random.RandomState(0)
+    main, startup, feeds, loss = mt.build_train_dynamic(
+        V, emb_dim=16, hidden_dim=24, src_len=TS, tgt_len=TT, lr=5e-3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        src = rng.randint(3, V, (B, TS)).astype("int64")
+        tgt = rng.randint(3, V, (B, TT)).astype("int64")
+        lens = rng.randint(2, TT + 1, (B,)).astype("int64")
+        feed = {
+            "src": src,
+            "tgt_in": tgt,
+            "tgt_out": tgt[:, :, None],
+            "tgt_lens": lens,
+        }
+        losses = [
+            float(np.asarray(exe.run(main, feed=feed,
+                                     fetch_list=[loss])[0]).reshape(()))
+            for _ in range(60)
+        ]
+    assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
+
+
 def test_dynamic_rnn_with_fc_trains():
     B, T, D, H = 4, 5, 3, 6
     rng = np.random.RandomState(0)
@@ -184,15 +213,11 @@ def test_dynamic_rnn_with_fc_trains():
             drnn.update_memory(mem, nxt)
             drnn.output(nxt)
         out = drnn()  # [B, T, H]
-        # final state of each sequence = out[b, len_b - 1]
-        last = fluid.layers.sequence_last_step_padded(out, sl) \
-            if hasattr(fluid.layers, "sequence_last_step_padded") else None
-        if last is None:
-            loss = fluid.layers.mean(
-                fluid.layers.square_error_cost(
-                    fluid.layers.reduce_sum(out, dim=[1]), yt
-                )
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(
+                fluid.layers.reduce_sum(out, dim=[1]), yt
             )
+        )
         _, params_grads = fluid.optimizer.SGD(0.2).minimize(loss)
     assert len(params_grads) == 2, "fc weights inside DynamicRNN got no grads"
     exe = fluid.Executor(fluid.CPUPlace())

@@ -1,0 +1,40 @@
+"""Dump the optimized HLO of the BERT bench train step (layout diagnosis)."""
+import sys
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def main():
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.models import bert
+    from paddle_tpu.executor import Scope, scope_guard, _CompiledBlock
+
+    cfg = bert.BERT_BASE
+    batch, seq_len = 64, 128
+    main_prog, startup, _, loss = bert.build_pretrain(
+        cfg, seq_len=seq_len, lr=1e-4, amp=True, train=True
+    )
+    scope = Scope()
+    with scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed = bert.make_fake_batch(batch, seq_len, cfg, rng)
+        import jax.numpy as jnp
+
+        feed_vals = {k: jnp.asarray(v) for k, v in feed.items()}
+        cb = _CompiledBlock(main_prog, main_prog.global_block(),
+                           list(feed_vals), [], scope, "train")
+        rw = {n: scope.get(n) for n in cb.rw_names}
+        ro = {n: scope.get(n) for n in cb.ro_names}
+        key = jax.random.key(0)
+        txt = cb.jitted.lower(feed_vals, rw, ro, key).compile().as_text()
+        open("/tmp/bench_hlo.txt", "w").write(txt)
+        print("wrote /tmp/bench_hlo.txt", len(txt))
+
+
+if __name__ == "__main__":
+    main()

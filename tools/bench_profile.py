@@ -1,0 +1,56 @@
+"""Capture a jax profiler trace of the BERT bench step and print the
+top-op time breakdown (MFU diagnosis aid)."""
+import glob
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+TRACE_DIR = "/tmp/bench_trace"
+
+
+def run_and_trace(cfg_kw=None, batch=64, seq_len=128, steps=5):
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.models import bert
+    from paddle_tpu.executor import Scope, scope_guard
+
+    cfg = bert.BertConfig(**cfg_kw) if cfg_kw else bert.BERT_BASE
+    main_prog, startup, _, loss = bert.build_pretrain(
+        cfg, seq_len=seq_len, lr=1e-4, amp=True, train=True
+    )
+    scope = Scope()
+    with scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed = bert.make_fake_batch(batch, seq_len, cfg, rng)
+        for _ in range(3):
+            exe.run(main_prog, feed=feed, fetch_list=[])
+        exe.run(main_prog, feed=feed, fetch_list=[loss])
+        jax.profiler.start_trace(TRACE_DIR)
+        for _ in range(steps - 1):
+            exe.run(main_prog, feed=feed, fetch_list=[])
+        exe.run(main_prog, feed=feed, fetch_list=[loss])
+        jax.profiler.stop_trace()
+
+
+def analyze():
+    from tensorboard_plugin_profile.convert import raw_to_tool_data
+
+    xplanes = glob.glob(TRACE_DIR + "/**/*.xplane.pb", recursive=True)
+    assert xplanes, "no xplane captured"
+    xp = max(xplanes, key=os.path.getmtime)
+    data, _ = raw_to_tool_data.xspace_to_tool_data(
+        [xp], "framework_op_stats", {}
+    )
+    out = data.decode() if isinstance(data, bytes) else str(data)
+    open("/tmp/bench_trace/op_stats.csv", "w").write(out)
+    print(out[:4000])
+
+
+if __name__ == "__main__":
+    run_and_trace()
+    analyze()

@@ -45,9 +45,16 @@ BASE = dict(vocab_size=30522, hidden=768, layers=12, heads=12, ffn=3072,
             max_seq=512)
 
 if __name__ == "__main__":
-    run_variant("baseline (dropout .1, unfused attn)", dict(BASE), 64)
-    run_variant("attn_dropout=0 (flash attn)", dict(BASE, attn_dropout=0.0), 64)
-    run_variant("no dropout at all", dict(BASE, dropout=0.0), 64)
-    run_variant("baseline bs128", dict(BASE), 128)
-    run_variant("attn_dropout=0 bs128", dict(BASE, attn_dropout=0.0), 128)
-    run_variant("no dropout bs128", dict(BASE, dropout=0.0), 128)
+    which = sys.argv[1] if len(sys.argv) > 1 else "128"
+    if which == "128":
+        run_variant("baseline (dropout .1, unfused attn)", dict(BASE), 64)
+        run_variant("attn_dropout=0 (flash attn)", dict(BASE, attn_dropout=0.0), 64)
+        run_variant("no dropout at all", dict(BASE, dropout=0.0), 64)
+        run_variant("baseline bs128", dict(BASE), 128)
+        run_variant("attn_dropout=0 bs128", dict(BASE, attn_dropout=0.0), 128)
+        run_variant("no dropout bs128", dict(BASE, dropout=0.0), 128)
+    elif which == "512":
+        run_variant("seq512 bs16 dropout .1", dict(BASE), 16, seq_len=512)
+        run_variant("seq512 bs16 attn_dropout=0 (flash)", dict(BASE, attn_dropout=0.0), 16, seq_len=512)
+        run_variant("seq512 bs32 dropout .1", dict(BASE), 32, seq_len=512)
+        run_variant("seq512 bs32 attn_dropout=0 (flash)", dict(BASE, attn_dropout=0.0), 32, seq_len=512)

@@ -1,0 +1,42 @@
+"""Parse a jax profiler xplane.pb and print per-op time on the device plane
+(MFU diagnosis aid; framework_op_stats without the tensorboard stack)."""
+import collections
+import glob
+import os
+import sys
+
+from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+
+def top_ops(trace_dir, n=35):
+    xplanes = glob.glob(trace_dir + "/**/*.xplane.pb", recursive=True)
+    assert xplanes, "no xplane under " + trace_dir
+    xp = max(xplanes, key=os.path.getmtime)
+    space = xplane_pb2.XSpace()
+    space.ParseFromString(open(xp, "rb").read())
+    for plane in space.planes:
+        if "TPU" not in plane.name and "/device:" not in plane.name:
+            continue
+        ev_names = plane.event_metadata
+        by_name = collections.Counter()
+        cnt = collections.Counter()
+        total = 0
+        for line in plane.lines:
+            if "XLA Ops" not in line.name and "Ops" != line.name:
+                continue
+            for ev in line.events:
+                name = ev_names[ev.metadata_id].name
+                by_name[name] += ev.duration_ps
+                cnt[name] += 1
+                total += ev.duration_ps
+        if not total:
+            continue
+        print("== plane: %s  (total XLA-op time %.2f ms) ==" % (
+            plane.name, total / 1e9))
+        for name, ps in by_name.most_common(n):
+            print("%8.3f ms  %5.1f%%  x%-4d %s" % (
+                ps / 1e9, 100.0 * ps / total, cnt[name], name[:110]))
+
+
+if __name__ == "__main__":
+    top_ops(sys.argv[1] if len(sys.argv) > 1 else "/tmp/bench_trace")
