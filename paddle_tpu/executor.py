@@ -267,15 +267,29 @@ class _CompiledBlock:
             self.jitted = jax.jit(run_block, donate_argnums=(1,))
         else:
             # SPMD: batch dim of every feed sharded over the mesh's data
-            # axis, params replicated; GSPMD inserts the ICI collectives
+            # axis; params replicated EXCEPT is_distributed embedding
+            # tables (+ their table-shaped optimizer accumulators), which
+            # are row-sharded over the same axis — the PS/distributed-
+            # lookup-table replacement (GSPMD partitions the lookup and
+            # its scatter grad with the id exchange over ICI)
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             data_axis = mesh.axis_names[0]
             batch = NamedSharding(mesh, P(data_axis))
             repl = NamedSharding(mesh, P())
+
+            def param_sharding(n):
+                v = block._find_var_recursive(n)
+                if (v is not None and getattr(v, "_is_distributed", False)
+                        and v.shape):
+                    return NamedSharding(
+                        mesh, P(data_axis, *([None] * (len(v.shape) - 1)))
+                    )
+                return repl
+
             feed_sh = {n: batch for n in self.feed_names}
-            rw_sh = {n: repl for n in self.rw_names}
-            ro_sh = {n: repl for n in self.ro_names}
+            rw_sh = {n: param_sharding(n) for n in self.rw_names}
+            ro_sh = {n: param_sharding(n) for n in self.ro_names}
             self.jitted = jax.jit(
                 run_block,
                 donate_argnums=(1,),

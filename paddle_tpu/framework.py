@@ -318,7 +318,12 @@ class Block:
     def create_parameter(self, **kwargs):
         # Parameters always live in block 0 (reference framework.py:1727)
         global_block = self.program.global_block()
+        prev = global_block.vars.get(kwargs.get("name"))
         p = Parameter(global_block, **kwargs)
+        # a re-declared shared parameter keeps its sharding mark (e.g. a
+        # second embedding() on the same table without is_distributed=True)
+        if getattr(prev, "_is_distributed", False):
+            p._is_distributed = True
         global_block.vars[p.name] = p
         self.program._bump_version()
         return p
@@ -487,6 +492,8 @@ class Program:
                         regularizer=v.regularizer,
                         stop_gradient=v.stop_gradient,
                     )
+                    if getattr(v, "_is_distributed", False):
+                        nv._is_distributed = True
                 else:
                     nv = Variable(
                         nb,

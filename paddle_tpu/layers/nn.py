@@ -124,11 +124,22 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
               padding_idx=None, param_attr=None, dtype="float32"):
     """Embedding lookup (reference nn.py embedding → lookup_table op).
     is_sparse selects the reference's SelectedRows grad path; on TPU the
-    grad is always XLA scatter-add, so the flag is accepted and ignored."""
+    grad is always XLA scatter-add, so the flag is accepted and ignored.
+
+    is_distributed=True row-shards the table over the mesh's data axis
+    when the program runs under ``CompiledProgram.with_data_parallel`` —
+    the TPU-native replacement for the reference's parameter-server
+    distributed lookup table (``transpiler/distribute_transpiler.py:
+    353-376``, ``operators/distributed/parameter_prefetch.cc``): GSPMD
+    partitions the lookup/scatter-grad with the id exchange over ICI
+    instead of RPC remote_prefetch, and the optimizer state shards with
+    the table."""
     helper = LayerHelper("embedding", **locals())
     w = helper.create_parameter(
         attr=helper.param_attr, shape=size, dtype=dtype, is_bias=False
     )
+    if is_distributed:
+        w._is_distributed = True
     tmp = helper.create_variable_for_type_inference(dtype)
     padding_idx = (
         -1 if padding_idx is None

@@ -121,6 +121,11 @@ class Optimizer:
             persistable=True,
         )
         var.stop_gradient = True
+        # table-shaped accumulators of a distributed (row-sharded) embedding
+        # shard with it, so the optimizer update stays local to each shard
+        if (getattr(param, "_is_distributed", False)
+                and list(shape) == list(param.shape or [])):
+            var._is_distributed = True
         helper.set_variable_initializer(
             var, ConstantInitializer(float(fill_value))
         )

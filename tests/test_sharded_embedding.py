@@ -1,0 +1,103 @@
+"""Sharded-embedding (PS-replacement) path: is_distributed=True tables
+row-shard over the mesh data axis under DP.
+
+Reference parity target: the distributed lookup table
+(``transpiler/distribute_transpiler.py:353-376`` slices the table across
+pservers; ``operators/distributed/parameter_prefetch.cc`` exchanges ids by
+RPC).  TPU-native: GSPMD partitions lookup + scatter-grad over ICI; the
+oracle is per-step loss parity vs the single-device run (the
+test_dist_base bar)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.executor import Scope, scope_guard
+from paddle_tpu.models import ctr
+
+VOCAB = 4096  # divisible by the 8-device mesh
+N_SLOTS, SLOT_LEN, DENSE = 3, 5, 8
+
+
+def _build(is_distributed, lr=0.05):
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        slots = [
+            fluid.layers.data("slot%d" % i, shape=[SLOT_LEN], dtype="int64")
+            for i in range(N_SLOTS)
+        ]
+        dense = fluid.layers.data("dense", shape=[DENSE], dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        loss, prob = ctr.wide_deep(
+            slots, dense, label, vocab=VOCAB, embed_dim=16,
+            hidden=(32, 32), is_distributed=is_distributed)
+        fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
+    return main, startup, loss
+
+
+def _batches(n_steps, bs=32):
+    rng = np.random.RandomState(3)
+    out = []
+    for _ in range(n_steps):
+        slots = [
+            rng.randint(0, VOCAB, (bs, SLOT_LEN)).astype("int64")
+            for _ in range(N_SLOTS)
+        ]
+        dense = rng.randn(bs, DENSE).astype("float32")
+        label = rng.randint(0, 2, (bs, 1)).astype("int64")
+        out.append((slots, dense, label))
+    return out
+
+
+def _run(data_parallel, is_distributed, n_steps=6):
+    main, startup, loss = _build(is_distributed)
+    exe = fluid.Executor(fluid.CPUPlace())
+    losses = []
+    scope = Scope()
+    with scope_guard(scope):
+        exe.run(startup)
+        prog = main
+        if data_parallel:
+            prog = fluid.CompiledProgram(main).with_data_parallel(
+                loss_name=loss.name)
+        for slots, dense, label in _batches(n_steps):
+            feed = {"slot%d" % i: s for i, s in enumerate(slots)}
+            feed["dense"] = dense
+            feed["label"] = label
+            (l,) = exe.run(prog, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(l).reshape(())))
+        table = scope.get("deep_emb_0")
+    return losses, table
+
+
+class TestShardedEmbedding:
+    def test_sharded_table_matches_single_device(self):
+        """8-way DP with the table sharded 8 ways reproduces the
+        single-device per-step losses, and the table actually lives
+        row-sharded across the mesh."""
+        single, _ = _run(data_parallel=False, is_distributed=False)
+        sharded, table = _run(data_parallel=True, is_distributed=True)
+        np.testing.assert_allclose(sharded, single, rtol=3e-4, atol=3e-4)
+        assert single[-1] < single[0]
+        # the updated table returned to scope is row-sharded over 8 devices
+        import jax
+
+        assert len(table.sharding.device_set) == 8
+        spec = table.sharding.spec
+        assert spec and spec[0] == "data", spec
+        # each device holds VOCAB/8 rows
+        shard = table.addressable_shards[0]
+        assert shard.data.shape == (VOCAB // 8, 16), shard.data.shape
+
+    def test_distributed_param_marked(self):
+        main, startup, _ = _build(is_distributed=True)
+        w = main.global_block().var("deep_emb_0")
+        assert getattr(w, "_is_distributed", False)
+        # adam moments of the table inherit the mark
+        dist_accums = [
+            v for v in main.global_block().vars.values()
+            if getattr(v, "_is_distributed", False)
+            and "moment" in v.name and "deep_emb_0" in v.name
+        ]
+        assert len(dist_accums) == 2, [v.name for v in dist_accums]

@@ -7,7 +7,7 @@ import numpy as np
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 
-def run_variant(tag, cfg_kw, batch, seq_len=128, steps=12, warmup=3):
+def run_variant(tag, cfg_kw, batch, seq_len=128, steps=60, warmup=3):
     import paddle_tpu as fluid
     from paddle_tpu.models import bert
 
@@ -53,6 +53,9 @@ if __name__ == "__main__":
         run_variant("baseline bs128", dict(BASE), 128)
         run_variant("attn_dropout=0 bs128", dict(BASE, attn_dropout=0.0), 128)
         run_variant("no dropout bs128", dict(BASE, dropout=0.0), 128)
+    elif which == "attn":
+        run_variant("attn_dropout=0 (fused attn)", dict(BASE, attn_dropout=0.0), 64)
+        run_variant("no dropout at all", dict(BASE, dropout=0.0), 64)
     elif which == "512":
         run_variant("seq512 bs16 dropout .1", dict(BASE), 16, seq_len=512)
         run_variant("seq512 bs16 attn_dropout=0 (flash)", dict(BASE, attn_dropout=0.0), 16, seq_len=512)
