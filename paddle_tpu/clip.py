@@ -49,7 +49,7 @@ class GradientClipByValue(BaseGradientClipAttr):
         )
         block.append_op(
             type="clip", inputs={"X": [grad]}, outputs={"Out": [out]},
-            attrs={"min": self.min, "max": self.max, "op_role": "backward"},
+            attrs={"min": self.min, "max": self.max, "op_role": "optimize"},
         )
         return out
 
@@ -81,7 +81,7 @@ class GradientClipByNorm(BaseGradientClipAttr):
             block.append_op(
                 type="clip_by_norm", inputs={"X": [g]},
                 outputs={"Out": [o]},
-                attrs={"max_norm": self.clip_norm, "op_role": "backward"},
+                attrs={"max_norm": self.clip_norm, "op_role": "optimize"},
             )
             out.append((p, o))
         return out
@@ -107,7 +107,7 @@ class GradientClipByGlobalNorm(BaseGradientClipAttr):
             )
             block.append_op(
                 type="squared_l2_norm", inputs={"X": [g]},
-                outputs={"Out": [sq]}, attrs={"op_role": "backward"},
+                outputs={"Out": [sq]}, attrs={"op_role": "optimize"},
             )
             sq_norms.append(sq)
         total = block.create_var(
@@ -116,7 +116,7 @@ class GradientClipByGlobalNorm(BaseGradientClipAttr):
         )
         block.append_op(
             type="sum", inputs={"X": sq_norms}, outputs={"Out": [total]},
-            attrs={"op_role": "backward"},
+            attrs={"op_role": "optimize"},
         )
         gnorm = block.create_var(
             name=unique_name.generate("global_norm"), shape=[1],
@@ -124,7 +124,7 @@ class GradientClipByGlobalNorm(BaseGradientClipAttr):
         )
         block.append_op(
             type="sqrt", inputs={"X": [total]}, outputs={"Out": [gnorm]},
-            attrs={"op_role": "backward"},
+            attrs={"op_role": "optimize"},
         )
         # denom = max(gnorm, clip_norm); scale = clip_norm / denom
         clipc = block.create_var(
@@ -134,7 +134,7 @@ class GradientClipByGlobalNorm(BaseGradientClipAttr):
         block.append_op(
             type="fill_constant", outputs={"Out": [clipc]},
             attrs={"shape": [1], "dtype": "float32", "value": self.clip_norm,
-                   "op_role": "backward"},
+                   "op_role": "optimize"},
         )
         denom = block.create_var(
             name=unique_name.generate("clip_denom"), shape=[1],
@@ -142,7 +142,7 @@ class GradientClipByGlobalNorm(BaseGradientClipAttr):
         )
         block.append_op(
             type="elementwise_max", inputs={"X": [gnorm], "Y": [clipc]},
-            outputs={"Out": [denom]}, attrs={"op_role": "backward"},
+            outputs={"Out": [denom]}, attrs={"op_role": "optimize"},
         )
         scale = block.create_var(
             name=unique_name.generate("clip_scale"), shape=[1],
@@ -150,7 +150,7 @@ class GradientClipByGlobalNorm(BaseGradientClipAttr):
         )
         block.append_op(
             type="elementwise_div", inputs={"X": [clipc], "Y": [denom]},
-            outputs={"Out": [scale]}, attrs={"op_role": "backward"},
+            outputs={"Out": [scale]}, attrs={"op_role": "optimize"},
         )
         out = []
         for p, g in params_grads:
@@ -163,7 +163,7 @@ class GradientClipByGlobalNorm(BaseGradientClipAttr):
             )
             g.block.append_op(
                 type="elementwise_mul", inputs={"X": [g], "Y": [scale]},
-                outputs={"Out": [o]}, attrs={"op_role": "backward"},
+                outputs={"Out": [o]}, attrs={"op_role": "optimize"},
             )
             out.append((p, o))
         return out

@@ -39,12 +39,27 @@ class BuildStrategy:
         self.num_trainers = 1
         self.trainer_id = 0
         self.trainers_endpoints = []
+        # under jit+GSPMD batch-norm stats of a batch-sharded input are
+        # ALWAYS global (the partitioner emits the cross-device reduction),
+        # so DP batch norm is inherently synchronized — the reference's
+        # sync_batch_norm_pass is subsumed; the knob is kept for API parity
+        # (tests/test_grad_accum_syncbn.py proves the global-stats parity)
         self.sync_batch_norm = False
         self.nccl_comm_num = 1
         self.use_hierarchical_allreduce = False
         # TPU-native extensions
         self.remat = False  # jax.checkpoint the forward
         self.donate_params = True
+        # microbatch gradient accumulation (reference
+        # ir/multi_batch_merge_pass.cc "repeat"): split the batch into k
+        # microbatches, scan fwd+bwd accumulating grads, apply the
+        # optimizer once on the average
+        self.batch_merge_repeat = 1
+        # tensor parallelism (SURVEY §2.3 TP row — beyond the reference,
+        # which only row-shards PS parameter blocks): devices reshape to a
+        # (data, model) mesh and params annotated with
+        # ParamAttr(shard_spec=...) partition over the model axis
+        self.tensor_parallel_degree = 1
 
 
 class ExecutionStrategy:
@@ -108,7 +123,8 @@ class CompiledProgram:
         return self._program
 
     def _run(self, executor, feed, fetch_list, scope, return_numpy):
-        if not self._is_data_parallel:
+        accum = getattr(self._build_strategy, "batch_merge_repeat", 1) or 1
+        if not self._is_data_parallel and accum <= 1:
             return executor.run(
                 self._program, feed=feed, fetch_list=fetch_list, scope=scope,
                 return_numpy=return_numpy, use_program_cache=True,
@@ -117,7 +133,8 @@ class CompiledProgram:
 
         if self._parallel_runner is None:
             self._parallel_runner = SPMDRunner(
-                self._program, self._build_strategy, self._places
+                self._program, self._build_strategy, self._places,
+                data_parallel=self._is_data_parallel,
             )
         return self._parallel_runner.run(
             executor, feed, fetch_list, scope, return_numpy

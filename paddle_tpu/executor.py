@@ -210,11 +210,12 @@ def _analyze_block(block, feed_names, fetch_names):
 
 class _CompiledBlock:
     def __init__(self, program, block, feed_names, fetch_names, scope, mode,
-                 mesh=None):
+                 mesh=None, accumulate_steps=1):
         import jax
 
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
+        self.accumulate_steps = int(accumulate_steps or 1)
         ext_reads, written, persist_written = _analyze_block(
             block, feed_names, fetch_names
         )
@@ -251,17 +252,20 @@ class _CompiledBlock:
                 + " (reference: executor.cc enforce 'Tensor holds no memory')"
             )
 
-        def run_block(feeds, rw, ro, key):
-            env = {}
-            env.update(ro)
-            env.update(rw)
-            env.update(feeds)
-            ctx = op_registry.LoweringContext(base_key=key, mode=mode)
-            _run_ops_into_env(block, env, ctx)
-            fetches = [env[n] for n in self.fetch_names]
-            new_rw = {n: env[n] for n in self.rw_names}
-            fresh = {n: env[n] for n in self.fresh_persist if n in env}
-            return fetches, new_rw, fresh
+        if self.accumulate_steps > 1:
+            run_block = _AccumRunner(self, block, mode)
+        else:
+            def run_block(feeds, rw, ro, key):
+                env = {}
+                env.update(ro)
+                env.update(rw)
+                env.update(feeds)
+                ctx = op_registry.LoweringContext(base_key=key, mode=mode)
+                _run_ops_into_env(block, env, ctx)
+                fetches = [env[n] for n in self.fetch_names]
+                new_rw = {n: env[n] for n in self.rw_names}
+                fresh = {n: env[n] for n in self.fresh_persist if n in env}
+                return fetches, new_rw, fresh
 
         if mesh is None:
             self.jitted = jax.jit(run_block, donate_argnums=(1,))
@@ -280,8 +284,30 @@ class _CompiledBlock:
 
             def param_sharding(n):
                 v = block._find_var_recursive(n)
-                if (v is not None and getattr(v, "_is_distributed", False)
-                        and v.shape):
+                if v is None:
+                    return repl
+                spec = getattr(v, "shard_spec", None)
+                if spec is not None and v.shape:
+                    # TP annotation (ParamAttr.shard_spec): validate axes +
+                    # divisibility, else fall back replicated with a warning
+                    import warnings
+
+                    ok = len(spec) <= len(v.shape)
+                    if ok:
+                        for i, ax in enumerate(spec):
+                            if ax is None:
+                                continue
+                            if (ax not in mesh.axis_names
+                                    or v.shape[i] % mesh.shape[ax]):
+                                ok = False
+                                break
+                    if ok:
+                        return NamedSharding(mesh, P(*spec))
+                    warnings.warn(
+                        "shard_spec %r of %r does not fit mesh %s / shape "
+                        "%s; replicating" % (spec, n, dict(mesh.shape),
+                                             v.shape))
+                if getattr(v, "_is_distributed", False) and v.shape:
                     return NamedSharding(
                         mesh, P(data_axis, *([None] * (len(v.shape) - 1)))
                     )
@@ -297,11 +323,135 @@ class _CompiledBlock:
             )
 
 
-def _run_ops_into_env(block, env, ctx):
-    """Lower every op of `block` into `env` (the SSA value map)."""
+def _accum_partition(block):
+    """Split the block at the first optimize-role op for microbatch
+    gradient accumulation (reference ``ir/multi_batch_merge_pass.cc``:
+    the forward+backward subgraph is repeated per microbatch, optimizer
+    ops run once on the merged gradients)."""
+    ops = [op for op in block.ops if op.type not in ("feed", "fetch")]
+    split = next(
+        (i for i, op in enumerate(ops)
+         if op.attrs.get("op_role") == "optimize"),
+        len(ops),
+    )
+    head, tail = ops[:split], ops[split:]
+    head_written = set()
+    for op in head:
+        head_written.update(op.output_arg_names)
+    tail_reads = []
+    for op in tail:
+        for n in op.input_arg_names:
+            if (n and n != EMPTY_VAR_NAME and n in head_written
+                    and n not in tail_reads):
+                tail_reads.append(n)
+    grad_reads = [n for n in tail_reads if "@GRAD" in n]
+    other_reads = [n for n in tail_reads if "@GRAD" not in n]
+    return head, tail, head_written, grad_reads, other_reads
+
+
+class _AccumRunner:
+    """run_block variant that scans the forward+backward ops over k
+    microbatches (feeds reshaped [k, B/k, ...]), averages the gradients,
+    then runs the optimizer ops once — lax.scan keeps ONE compiled copy of
+    the model in HBM regardless of k (vs the reference pass's k-times
+    graph replication).
+
+    Caveat (documented): in-graph counters written by pre-optimizer ops
+    (e.g. lr-scheduler step counters) advance once per MICRObatch."""
+
+    def __init__(self, cb, block, mode):
+        self.cb = cb
+        self.block = block
+        self.mode = mode
+        (self.head, self.tail, self.head_written, self.grad_reads,
+         self.other_reads) = _accum_partition(block)
+        # head-written values the caller needs: fetches + persistables
+        carry_out = list(self.other_reads)
+        for n in cb.fetch_names + cb.rw_names + cb.fresh_persist:
+            if n in self.head_written and n not in carry_out \
+                    and n not in self.grad_reads:
+                carry_out.append(n)
+        self.carry_out = carry_out
+
+    def __call__(self, feeds, rw, ro, key):
+        import jax
+        import jax.numpy as jnp
+
+        cb, k = self.cb, self.cb.accumulate_steps
+        base_env = {}
+        base_env.update(ro)
+        base_env.update(rw)
+        micro = {}
+        for n, v in feeds.items():
+            b = v.shape[0]
+            if b % k:
+                raise ValueError(
+                    "batch dim %d of feed %r is not divisible by "
+                    "accumulate_steps=%d" % (b, n, k))
+            micro[n] = v.reshape((k, b // k) + v.shape[1:])
+
+        def head_fn(mf, idx):
+            e = dict(base_env)
+            e.update(mf)
+            ctx = op_registry.LoweringContext(
+                base_key=jax.random.fold_in(key, idx), mode=self.mode)
+            _run_ops_into_env(self.block, e, ctx, ops=self.head)
+            return (
+                {n: e[n] for n in self.grad_reads},
+                {n: e[n] for n in self.carry_out if n in e},
+            )
+
+        shapes = jax.eval_shape(
+            head_fn, {n: v[0] for n, v in micro.items()}, 0)
+        acc0 = {n: jnp.zeros(s.shape, s.dtype)
+                for n, s in shapes[0].items()}
+
+        def body(carry, mf):
+            idx, acc = carry
+            grads, outs = head_fn(mf, idx)
+            acc = {n: acc[n] + grads[n].astype(acc[n].dtype) for n in acc}
+            return (idx + 1, acc), outs
+
+        (_, acc), stacked = jax.lax.scan(
+            body, (jnp.asarray(0, jnp.int32), acc0), micro)
+
+        micro_bs = next(iter(micro.values())).shape[1] if micro else None
+        env = dict(base_env)
+        for n in self.carry_out:
+            if n not in stacked:
+                continue
+            v = stacked[n]
+            is_state = n in cb.rw_names or n in cb.fresh_persist
+            if n in cb.fetch_names and not is_state:
+                # per-sample outputs ([k, B/k, ...]) reassemble to the full
+                # batch; per-step scalars (losses/metrics) report the
+                # microbatch average (the full-batch mean for mean losses)
+                if (micro_bs is not None and v.ndim >= 2
+                        and v.shape[1] == micro_bs):
+                    env[n] = v.reshape((k * micro_bs,) + v.shape[2:])
+                elif jnp.issubdtype(v.dtype, jnp.inexact):
+                    env[n] = jnp.mean(v, axis=0)
+                else:
+                    env[n] = v[-1]
+            else:
+                # state (persistables, counters): last microbatch's value
+                env[n] = v[-1] if v.shape[0] == k else v
+        for n in self.grad_reads:
+            env[n] = acc[n] / jnp.asarray(k, acc[n].dtype)
+        ctx = op_registry.LoweringContext(base_key=key, mode=self.mode)
+        _run_ops_into_env(self.block, env, ctx, ops=self.tail)
+        fetches = [env[n] for n in cb.fetch_names]
+        new_rw = {n: env[n] for n in cb.rw_names}
+        fresh = {n: env[n] for n in cb.fresh_persist if n in env}
+        return fetches, new_rw, fresh
+
+
+def _run_ops_into_env(block, env, ctx, ops=None):
+    """Lower ops of `block` (all, or the given subset) into `env` (the SSA
+    value map)."""
     from .ops import control_flow as cf_ops
 
-    for op in block.ops:
+    for op in (block.ops if ops is None else ops):
         if op.type in ("feed", "fetch"):
             continue
         if op.type in cf_ops.SUB_BLOCK_OPS:

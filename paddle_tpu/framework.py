@@ -206,6 +206,7 @@ class Parameter(Variable):
         self.gradient_clip_attr = kwargs.pop("gradient_clip_attr", None)
         self.do_model_average = kwargs.pop("do_model_average", None)
         self.is_distributed = kwargs.pop("is_distributed", False)
+        self.shard_spec = kwargs.pop("shard_spec", None)
         super().__init__(
             block, shape=shape, dtype=dtype, persistable=True, **kwargs
         )
@@ -320,10 +321,12 @@ class Block:
         global_block = self.program.global_block()
         prev = global_block.vars.get(kwargs.get("name"))
         p = Parameter(global_block, **kwargs)
-        # a re-declared shared parameter keeps its sharding mark (e.g. a
+        # a re-declared shared parameter keeps its sharding marks (e.g. a
         # second embedding() on the same table without is_distributed=True)
         if getattr(prev, "_is_distributed", False):
             p._is_distributed = True
+        if getattr(p, "shard_spec", None) is None:
+            p.shard_spec = getattr(prev, "shard_spec", None)
         global_block.vars[p.name] = p
         self.program._bump_version()
         return p
@@ -494,6 +497,7 @@ class Program:
                     )
                     if getattr(v, "_is_distributed", False):
                         nv._is_distributed = True
+                    nv.shard_spec = getattr(v, "shard_spec", None)
                 else:
                     nv = Variable(
                         nb,

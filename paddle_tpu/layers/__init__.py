@@ -1,6 +1,7 @@
 """Layers DSL (reference: ``python/paddle/fluid/layers/``)."""
 
 from . import nn
+from . import nn_extra
 from . import io
 from . import tensor
 from . import ops
@@ -14,6 +15,7 @@ from . import collective
 from . import math_op_patch  # noqa: F401  (Variable operator overloads)
 
 from .nn import *  # noqa: F401,F403
+from .nn_extra import *  # noqa: F401,F403
 from .io import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
@@ -26,6 +28,7 @@ from .learning_rate_scheduler import *  # noqa: F401,F403
 
 __all__ = (
     nn.__all__
+    + nn_extra.__all__
     + io.__all__
     + tensor.__all__
     + ops.__all__

@@ -1,0 +1,559 @@
+"""Second wave of the layers.nn surface (reference
+``python/paddle/fluid/layers/nn.py``) — vision rearrangements, extra
+losses, samplers, norms, and compositions.  Each function cites its
+reference op; lowerings live in ops/{vision,losses,nn}.py."""
+
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+from ..param_attr import ParamAttr
+from ..initializer import ConstantInitializer, NormalInitializer
+
+__all__ = [
+    "selu", "maxout", "multiplex", "crop", "pad_constant_like",
+    "random_crop", "sampling_id", "uniform_random_batch_size_like",
+    "gaussian_random_batch_size_like", "gaussian_random",
+    "add_position_encoding", "hash", "data_norm", "spectral_norm",
+    "row_conv", "pixel_shuffle", "shuffle_channel", "space_to_depth",
+    "temporal_shift", "affine_channel", "fsp_matrix", "grid_sampler",
+    "affine_grid", "roi_pool", "psroi_pool", "unfold", "lrn",
+    "log_loss", "kldiv_loss", "rank_loss", "margin_rank_loss", "bpr_loss",
+    "teacher_student_sigmoid_loss", "mean_iou", "bilinear_tensor_product",
+    "dice_loss", "npair_loss", "rank", "sum", "image_resize_short",
+    "autoincreased_step_counter", "reduce_all", "reduce_any",
+    "elementwise_mod", "elementwise_floordiv", "im2sequence",
+]
+
+
+def _simple(op_type, inputs, attrs=None, out_dtype=None, outs=("Out",),
+            stop_gradient=False):
+    helper = LayerHelper(op_type)
+    dtype = out_dtype
+    if dtype is None:
+        for v in inputs.values():
+            vs = v if isinstance(v, (list, tuple)) else [v]
+            if vs and isinstance(vs[0], Variable):
+                dtype = vs[0].dtype
+                break
+    outputs = {}
+    ret = []
+    for slot in outs:
+        ov = helper.create_variable_for_type_inference(dtype, stop_gradient)
+        outputs[slot] = [ov]
+        ret.append(ov)
+    helper.append_op(
+        type=op_type,
+        inputs={k: (list(v) if isinstance(v, (list, tuple)) else [v])
+                for k, v in inputs.items() if v is not None},
+        outputs=outputs,
+        attrs=attrs or {},
+    )
+    return ret[0] if len(ret) == 1 else tuple(ret)
+
+
+# ---- activations / selection -------------------------------------------
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    """reference nn.py selu → selu_op.cc"""
+    return _simple("selu", {"X": x}, {"scale": scale, "alpha": alpha})
+
+
+def maxout(x, groups, name=None):
+    """reference nn.py maxout → maxout_op.cc (NCHW)"""
+    return _simple("maxout", {"X": x}, {"groups": groups})
+
+
+def multiplex(inputs, index):
+    """reference nn.py multiplex → multiplex_op.cc"""
+    return _simple("multiplex", {"X": list(inputs), "Ids": index})
+
+
+# ---- crops / pads / random ---------------------------------------------
+
+def crop(x, shape=None, offsets=None, name=None):
+    """reference nn.py crop → crop_op.cc; static shape/offsets or a Y
+    template variable for the target shape."""
+    attrs = {}
+    ins = {"X": x}
+    if isinstance(shape, Variable):
+        ins["Y"] = shape
+    else:
+        attrs["shape"] = [int(s) for s in shape]
+    if isinstance(offsets, Variable):
+        ins["Offsets"] = offsets
+    elif offsets is not None:
+        attrs["offsets"] = [int(o) for o in offsets]
+    return _simple("crop", ins, attrs)
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    """reference nn.py pad_constant_like → pad_constant_like_op.cc"""
+    return _simple("pad_constant_like", {"X": x, "Y": y},
+                   {"pad_value": float(pad_value)})
+
+
+def random_crop(x, shape, seed=None):
+    """reference nn.py random_crop → random_crop_op.h"""
+    out, _ = _simple("random_crop", {"X": x},
+                     {"shape": [int(s) for s in shape]},
+                     outs=("Out", "SeedOut"))
+    return out
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="float32"):
+    """reference nn.py sampling_id → sampling_id_op.cc"""
+    return _simple("sampling_id", {"X": x}, {"seed": seed},
+                   out_dtype="int64", stop_gradient=True)
+
+
+def uniform_random_batch_size_like(input, shape, dtype="float32",
+                                   input_dim_idx=0, output_dim_idx=0,
+                                   min=-1.0, max=1.0, seed=0):
+    """reference nn.py → uniform_random_batch_size_like_op.cc"""
+    return _simple(
+        "uniform_random_batch_size_like", {"Input": input},
+        {"shape": [int(s) for s in shape], "input_dim_idx": input_dim_idx,
+         "output_dim_idx": output_dim_idx, "min": min, "max": max,
+         "seed": seed, "dtype": dtype},
+        out_dtype=dtype, stop_gradient=True)
+
+
+def gaussian_random_batch_size_like(input, shape, input_dim_idx=0,
+                                    output_dim_idx=0, mean=0.0, std=1.0,
+                                    seed=0, dtype="float32"):
+    """reference nn.py → gaussian_random_batch_size_like_op.cc"""
+    return _simple(
+        "gaussian_random_batch_size_like", {"Input": input},
+        {"shape": [int(s) for s in shape], "input_dim_idx": input_dim_idx,
+         "output_dim_idx": output_dim_idx, "mean": mean, "std": std,
+         "seed": seed, "dtype": dtype},
+        out_dtype=dtype, stop_gradient=True)
+
+
+def gaussian_random(shape, mean=0.0, std=1.0, seed=0, dtype="float32"):
+    """reference nn.py gaussian_random → gaussian_random_op.cc"""
+    return _simple(
+        "gaussian_random", {},
+        {"shape": [int(s) for s in shape], "mean": mean, "std": std,
+         "seed": seed, "dtype": dtype},
+        out_dtype=dtype, stop_gradient=True)
+
+
+# ---- positional / hashing / norms --------------------------------------
+
+def add_position_encoding(input, alpha=1.0, beta=1.0, name=None):
+    """reference nn.py add_position_encoding → add_position_encoding_op.h"""
+    return _simple("add_position_encoding", {"X": input},
+                   {"alpha": float(alpha), "beta": float(beta)})
+
+
+def hash(input, hash_size, num_hash=1, name=None):
+    """reference nn.py hash → hash_op.h (xxhash there; splitmix-style
+    mix here — deterministic but not bit-identical across frameworks)."""
+    return _simple("hash", {"X": input},
+                   {"mod_by": int(hash_size), "num_hash": int(num_hash)},
+                   out_dtype="int64", stop_gradient=True)
+
+
+def data_norm(input, act=None, epsilon=1e-05, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=False):
+    """reference nn.py data_norm → data_norm_op.cc: per-feature shift/scale
+    from persistable batch statistics (CTR path; stats start at
+    size=1e4, sum=0, square_sum=1e4 like the reference defaults)."""
+    helper = LayerHelper("data_norm", **locals())
+    dtype = helper.input_dtype()
+    c = input.shape[-1]
+    stat_attr = ParamAttr._to_attr(param_attr)
+
+    def stat(name_suffix, value):
+        attr = ParamAttr(
+            name=(stat_attr.name + name_suffix) if stat_attr.name else None,
+            initializer=ConstantInitializer(value), trainable=True)
+        return helper.create_parameter(
+            attr=attr, shape=[c], dtype=dtype, is_bias=False)
+
+    batch_size = stat(".batch_size", 1e4)
+    batch_sum = stat(".batch_sum", 0.0)
+    batch_square_sum = stat(".batch_square_sum", 1e4)
+    y = helper.create_variable_for_type_inference(dtype)
+    means = helper.create_variable_for_type_inference(dtype, True)
+    scales = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op(
+        type="data_norm",
+        inputs={"X": [input], "BatchSize": [batch_size],
+                "BatchSum": [batch_sum],
+                "BatchSquareSum": [batch_square_sum]},
+        outputs={"Y": [y], "Means": [means], "Scales": [scales]},
+        attrs={"epsilon": epsilon, "data_layout": data_layout},
+    )
+    return helper.append_activation(y)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """reference nn.py spectral_norm → spectral_norm_op.h"""
+    helper = LayerHelper("spectral_norm", **locals())
+    dtype = weight.dtype
+    h = weight.shape[dim]
+    w = 1
+    for i, s in enumerate(weight.shape):
+        if i != dim:
+            w *= s
+    u = helper.create_parameter(
+        attr=ParamAttr(initializer=NormalInitializer(0.0, 1.0),
+                       trainable=False),
+        shape=[h], dtype=dtype, is_bias=False)
+    v = helper.create_parameter(
+        attr=ParamAttr(initializer=NormalInitializer(0.0, 1.0),
+                       trainable=False),
+        shape=[w], dtype=dtype, is_bias=False)
+    u.stop_gradient = True
+    v.stop_gradient = True
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="spectral_norm",
+        inputs={"Weight": [weight], "U": [u], "V": [v]},
+        outputs={"Out": [out]},
+        attrs={"dim": dim, "power_iters": power_iters, "eps": eps},
+    )
+    return out
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """reference nn.py row_conv → row_conv_op.cc (padded [B,T,D] here)."""
+    helper = LayerHelper("row_conv", **locals())
+    dtype = helper.input_dtype()
+    filter_shape = [future_context_size + 1, input.shape[-1]]
+    filter_param = helper.create_parameter(
+        attr=helper.param_attr, shape=filter_shape, dtype=dtype,
+        is_bias=False)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="row_conv",
+        inputs={"X": [input], "Filter": [filter_param]},
+        outputs={"Out": [out]},
+    )
+    return helper.append_activation(out)
+
+
+def lrn(input, n=5, k=1.0, alpha=1e-4, beta=0.75, name=None):
+    """reference nn.py lrn → lrn_op.cc"""
+    out, _ = _simple("lrn", {"X": input},
+                     {"n": n, "k": k, "alpha": alpha, "beta": beta},
+                     outs=("Out", "MidOut"))
+    return out
+
+
+# ---- vision rearrangements ---------------------------------------------
+
+def pixel_shuffle(x, upscale_factor):
+    """reference nn.py pixel_shuffle → pixel_shuffle_op.cc"""
+    return _simple("pixel_shuffle", {"X": x},
+                   {"upscale_factor": int(upscale_factor)})
+
+
+def shuffle_channel(x, group, name=None):
+    """reference nn.py shuffle_channel → shuffle_channel_op.cc"""
+    return _simple("shuffle_channel", {"X": x}, {"group": int(group)})
+
+
+def space_to_depth(x, blocksize, name=None):
+    """reference nn.py space_to_depth → space_to_depth_op.cc"""
+    return _simple("space_to_depth", {"X": x}, {"blocksize": int(blocksize)})
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None):
+    """reference nn.py temporal_shift → temporal_shift_op.cc"""
+    return _simple("temporal_shift", {"X": x},
+                   {"seg_num": int(seg_num),
+                    "shift_ratio": float(shift_ratio)})
+
+
+def affine_channel(x, scale=None, bias=None, data_layout="NCHW", name=None):
+    """reference nn.py affine_channel → affine_channel_op.cc"""
+    return _simple("affine_channel",
+                   {"X": x, "Scale": scale, "Bias": bias},
+                   {"data_layout": data_layout})
+
+
+def fsp_matrix(x, y):
+    """reference nn.py fsp_matrix → fsp_op.cc"""
+    return _simple("fsp", {"X": x, "Y": y})
+
+
+def grid_sampler(x, grid, name=None):
+    """reference nn.py grid_sampler → grid_sampler_op.cc"""
+    helper = LayerHelper("grid_sampler", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="grid_sampler",
+        inputs={"X": [x], "Grid": [grid]},
+        outputs={"Output": [out]},
+    )
+    return out
+
+
+def affine_grid(theta, out_shape, name=None):
+    """reference nn.py affine_grid → affine_grid_op.cc"""
+    if isinstance(out_shape, Variable):
+        raise NotImplementedError(
+            "affine_grid with a variable out_shape needs static shapes on "
+            "TPU; pass a python list")
+    helper = LayerHelper("affine_grid", **locals())
+    out = helper.create_variable_for_type_inference(theta.dtype)
+    helper.append_op(
+        type="affine_grid", inputs={"Theta": [theta]},
+        outputs={"Output": [out]},
+        attrs={"output_shape": [int(s) for s in out_shape]})
+    return out
+
+
+def roi_pool(input, rois, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0, rois_lod=None):
+    """reference nn.py roi_pool → roi_pool_op.cc; rois [R,5] with a
+    leading batch-index column (TPU-static replacement for ROI LoD)."""
+    out, _ = _simple(
+        "roi_pool", {"X": input, "ROIs": rois, "RoisLod": rois_lod},
+        {"pooled_height": int(pooled_height),
+         "pooled_width": int(pooled_width),
+         "spatial_scale": float(spatial_scale)},
+        outs=("Out", "Argmax"))
+    return out
+
+
+def psroi_pool(input, rois, output_channels, spatial_scale, pooled_height,
+               pooled_width, name=None):
+    """reference nn.py psroi_pool → psroi_pool_op.cc"""
+    return _simple(
+        "psroi_pool", {"X": input, "ROIs": rois},
+        {"output_channels": int(output_channels),
+         "spatial_scale": float(spatial_scale),
+         "pooled_height": int(pooled_height),
+         "pooled_width": int(pooled_width)})
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """reference nn.py unfold → unfold_op.cc (im2col)."""
+    def pair(v):
+        return [int(v), int(v)] if isinstance(v, int) else [int(a) for a in v]
+
+    pads = pair(paddings)
+    if len(pads) == 2:
+        pads = pads + pads
+    return _simple(
+        "unfold", {"X": x},
+        {"kernel_sizes": pair(kernel_sizes), "strides": pair(strides),
+         "paddings": pads, "dilations": pair(dilations)},
+        outs=("Y",))
+
+
+# ---- losses -------------------------------------------------------------
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    """reference nn.py log_loss → log_loss_op.h"""
+    return _simple("log_loss", {"Predicted": input, "Labels": label},
+                   {"epsilon": float(epsilon)}, outs=("Loss",))
+
+
+def kldiv_loss(x, target, reduction="mean", name=None):
+    """reference nn.py kldiv_loss → kldiv_loss_op.h"""
+    return _simple("kldiv_loss", {"X": x, "Target": target},
+                   {"reduction": reduction}, outs=("Loss",))
+
+
+def rank_loss(label, left, right, name=None):
+    """reference nn.py rank_loss → rank_loss_op.h (RankNet)"""
+    return _simple("rank_loss",
+                   {"Label": label, "Left": left, "Right": right})
+
+
+def margin_rank_loss(label, left, right, margin=0.1, name=None):
+    """reference nn.py margin_rank_loss → margin_rank_loss_op.h"""
+    out, _ = _simple("margin_rank_loss",
+                     {"Label": label, "X1": left, "X2": right},
+                     {"margin": float(margin)},
+                     outs=("Out", "Activated"))
+    return out
+
+
+def bpr_loss(input, label, name=None):
+    """reference nn.py bpr_loss → bpr_loss_op.h"""
+    return _simple("bpr_loss", {"X": input, "Label": label}, outs=("Y",))
+
+
+def teacher_student_sigmoid_loss(input, label, soft_max_up_bound=15.0,
+                                 soft_max_lower_bound=-15.0):
+    """reference nn.py → teacher_student_sigmoid_loss_op.h"""
+    return _simple("teacher_student_sigmoid_loss",
+                   {"X": input, "Label": label},
+                   {"soft_max_up_bound": soft_max_up_bound,
+                    "soft_max_lower_bound": soft_max_lower_bound},
+                   outs=("Y",))
+
+
+def mean_iou(input, label, num_classes):
+    """reference nn.py mean_iou → mean_iou_op.h"""
+    return _simple("mean_iou", {"Predictions": input, "Labels": label},
+                   {"num_classes": int(num_classes)},
+                   out_dtype="float32",
+                   outs=("OutMeanIou", "OutWrong", "OutCorrect"),
+                   stop_gradient=True)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """reference nn.py bilinear_tensor_product →
+    bilinear_tensor_product_op.h"""
+    helper = LayerHelper("bilinear_tensor_product", **locals())
+    dtype = helper.input_dtype()
+    w = helper.create_parameter(
+        attr=helper.param_attr,
+        shape=[size, x.shape[1], y.shape[1]], dtype=dtype, is_bias=False)
+    out = helper.create_variable_for_type_inference(dtype)
+    inputs = {"X": [x], "Y": [y], "Weight": [w]}
+    if bias_attr is not False:
+        bias = helper.create_parameter(
+            attr=helper.bias_attr, shape=[1, size], dtype=dtype,
+            is_bias=True)
+        inputs["Bias"] = [bias]
+    helper.append_op(type="bilinear_tensor_product", inputs=inputs,
+                     outputs={"Out": [out]})
+    return helper.append_activation(out)
+
+
+# ---- compositions (pure-python in the reference too) --------------------
+
+def dice_loss(input, label, epsilon=1e-5):
+    """reference nn.py dice_loss (composition)."""
+    from . import nn as _nn
+    from . import ops as _ops
+    from . import tensor as _tensor
+
+    label = _nn.one_hot(label, depth=input.shape[-1])
+    reduce_dims = list(range(1, len(input.shape)))
+    inse = _nn.reduce_sum(_nn.elementwise_mul(input, label),
+                          dim=reduce_dims)
+    dice_denominator = _nn.elementwise_add(
+        _nn.reduce_sum(input, dim=reduce_dims),
+        _nn.reduce_sum(label, dim=reduce_dims))
+    dice_score = _ops.scale(
+        _nn.elementwise_div(
+            _ops.scale(inse, scale=2.0),
+            _ops.scale(dice_denominator, scale=1.0, bias=epsilon)),
+        scale=-1.0, bias=1.0)
+    return _nn.reduce_mean(dice_score)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """reference nn.py npair_loss (composition): cross-entropy over
+    anchor@positive^T similarities + L2 on the embeddings."""
+    from . import nn as _nn
+    from . import ops as _ops
+
+    batch = anchor.shape[0]
+    sim = _nn.matmul(anchor, positive, transpose_y=True)
+    lab = _nn.one_hot(labels, depth=batch)
+    # row and column softmax cross entropies against the label matching
+    ce_row = _nn.reduce_mean(_nn.softmax_with_cross_entropy(
+        sim, lab, soft_label=True))
+    ce_col = _nn.reduce_mean(_nn.softmax_with_cross_entropy(
+        _nn.transpose(sim, perm=[1, 0]), lab, soft_label=True))
+    l2 = _ops.scale(
+        _nn.reduce_mean(
+            _nn.elementwise_add(
+                _nn.reduce_sum(_ops.square(anchor), dim=[1]),
+                _nn.reduce_sum(_ops.square(positive), dim=[1]))),
+        scale=float(l2_reg))
+    return _nn.elementwise_add(
+        _ops.scale(_nn.elementwise_add(ce_row, ce_col), 0.5), l2)
+
+
+def rank(input):
+    """reference nn.py rank: static ndim as a constant tensor."""
+    from . import tensor as _tensor
+
+    return _tensor.fill_constant([1], "int32", len(input.shape))
+
+
+def sum(x):
+    """reference nn.py sum → sum op (list fan-in add)."""
+    return _simple("sum", {"X": list(x) if isinstance(x, (list, tuple))
+                           else [x]})
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    """reference nn.py image_resize_short (composition over image_resize).
+    Static shapes on TPU: input H,W must be known."""
+    from . import nn as _nn
+
+    h, w = input.shape[2], input.shape[3]
+    short = min(h, w)
+    scale = float(out_short_len) / float(short)
+    out_shape = [int(round(h * scale)), int(round(w * scale))]
+    return _nn.image_resize(input, out_shape=out_shape, resample=resample)
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """reference nn.py autoincreased_step_counter (persistable int counter
+    incremented in-graph each step)."""
+    from .. import unique_name
+    from ..initializer import ConstantInitializer
+
+    helper = LayerHelper("global_step_counter")
+    name = counter_name or "@STEP_COUNTER@"
+    block = helper.main_program.global_block()
+    if block.has_var(name):
+        counter = block.var(name)
+    else:
+        counter = block.create_var(
+            name=name, dtype="int64", shape=[1], persistable=True)
+        counter.stop_gradient = True
+        helper.set_variable_initializer(
+            counter, ConstantInitializer(float(begin - step)))
+    block.append_op(
+        type="increment", inputs={"X": [counter]},
+        outputs={"Out": [counter]}, attrs={"step": float(step)})
+    return counter
+
+
+# ---- wrappers over already-registered ops ------------------------------
+
+def reduce_all(input, dim=None, keep_dim=False, name=None):
+    """reference nn.py reduce_all → reduce_all op"""
+    return _simple("reduce_all", {"X": input},
+                   {"dim": dim if dim is not None else [],
+                    "keep_dim": keep_dim, "reduce_all": dim is None},
+                   out_dtype="bool", stop_gradient=True)
+
+
+def reduce_any(input, dim=None, keep_dim=False, name=None):
+    """reference nn.py reduce_any → reduce_any op"""
+    return _simple("reduce_any", {"X": input},
+                   {"dim": dim if dim is not None else [],
+                    "keep_dim": keep_dim, "reduce_all": dim is None},
+                   out_dtype="bool", stop_gradient=True)
+
+
+def elementwise_mod(x, y, axis=-1, act=None, name=None):
+    """reference nn.py elementwise_mod op"""
+    return _simple("elementwise_mod", {"X": x, "Y": y}, {"axis": axis})
+
+
+def elementwise_floordiv(x, y, axis=-1, act=None, name=None):
+    """reference nn.py elementwise_floordiv op"""
+    return _simple("elementwise_floordiv", {"X": x, "Y": y}, {"axis": axis})
+
+
+def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None,
+                out_stride=1, name=None):
+    """reference nn.py im2sequence → im2sequence_op.cc"""
+    def pair(v):
+        return [int(v), int(v)] if isinstance(v, int) else [int(a) for a in v]
+
+    pads = pair(padding)
+    if len(pads) == 2:
+        pads = pads + pads
+    return _simple(
+        "im2sequence", {"X": input},
+        {"kernels": pair(filter_size), "strides": pair(stride),
+         "paddings": pads})

@@ -1,0 +1,127 @@
+"""Loss / metric op lowerings beyond the core set.
+
+Reference kernels: ``paddle/fluid/operators/{log_loss,kldiv_loss,rank_loss,
+margin_rank_loss,bpr_loss,teacher_student_sigmoid_loss,mean_iou,
+bilinear_tensor_product}_op.*``."""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@register_op("log_loss", inputs=["Predicted", "Labels"], outputs=["Loss"])
+def log_loss(ctx, attrs, Predicted, Labels):
+    """-y*log(p+eps) - (1-y)*log(1-p+eps) (log_loss_op.h)."""
+    eps = float(attrs.get("epsilon", 1e-4))
+    p, y = Predicted, Labels
+    return -y * jnp.log(p + eps) - (1.0 - y) * jnp.log(1.0 - p + eps)
+
+
+@register_op("kldiv_loss", inputs=["X", "Target"], outputs=["Loss"])
+def kldiv_loss(ctx, attrs, X, Target):
+    """target * (log(target) - x), with 'none'/'batchmean'/'mean'/'sum'
+    reduction (kldiv_loss_op.h; x is already log-probability)."""
+    red = attrs.get("reduction", "mean")
+    t = Target
+    loss = jnp.where(t > 0, t * (jnp.log(jnp.maximum(t, 1e-38)) - X), 0.0)
+    if red == "none":
+        return loss
+    if red == "sum":
+        return jnp.sum(loss)
+    if red == "batchmean":
+        return jnp.sum(loss) / jnp.asarray(X.shape[0], X.dtype)
+    return jnp.mean(loss)
+
+
+@register_op("rank_loss", inputs=["Label", "Left", "Right"], outputs=["Out"])
+def rank_loss(ctx, attrs, Label, Left, Right):
+    """RankNet pairwise loss (rank_loss_op.h):
+    log(1 + exp(left-right)) - label*(left-right), computed stably."""
+    o = Left - Right
+    return jnp.logaddexp(0.0, o) - Label * o
+
+
+@register_op("margin_rank_loss", inputs=["Label", "X1", "X2"],
+             outputs=["Out", "Activated"], stateful_outputs=("Activated",))
+def margin_rank_loss(ctx, attrs, Label, X1, X2):
+    """max(0, -label*(x1-x2) + margin) (margin_rank_loss_op.h)."""
+    margin = float(attrs.get("margin", 0.0))
+    raw = -Label * (X1 - X2) + margin
+    out = jnp.maximum(raw, 0.0)
+    return {"Out": out, "Activated": (raw > 0).astype(X1.dtype)}
+
+
+@register_op("bpr_loss", inputs=["X", "Label"], outputs=["Y"])
+def bpr_loss(ctx, attrs, X, Label):
+    """Bayesian personalized ranking (bpr_loss_op.h): per sample,
+    mean over negatives j != y of log(1 + exp(x_j - x_y))."""
+    b, c = X.shape
+    lbl = jnp.reshape(Label, (b,)).astype(jnp.int32)
+    pos = jnp.take_along_axis(X, lbl[:, None], axis=1)  # [B,1]
+    # log(1+exp(neg-pos)) summed over j != y
+    all_terms = jnp.logaddexp(0.0, X - pos)  # j == y term is log(2)...
+    # ...so subtract the diagonal contribution exactly
+    diag = jnp.logaddexp(0.0, jnp.zeros((b, 1), X.dtype))
+    s = jnp.sum(all_terms, axis=1, keepdims=True) - diag
+    return s / jnp.asarray(c - 1, X.dtype)
+
+
+@register_op("teacher_student_sigmoid_loss", inputs=["X", "Label"],
+             outputs=["Y"])
+def teacher_student_sigmoid_loss(ctx, attrs, X, Label):
+    """CTR distillation loss (teacher_student_sigmoid_loss_op.h): label
+    encodes click and optional teacher score z':
+    label < -1: no z', clk=0;  -1 <= label < 0: no z', clk=1;
+    0 <= label < 1: z'=label, clk=0;  label >= 1: z'=label-1, clk=1."""
+    x, lbl = X, Label
+    sce = jnp.maximum(x, 0.0) + jnp.log1p(jnp.exp(-jnp.abs(x)))  # BCE@z=0
+    sce1 = sce - x                                               # BCE@z=1
+    no_t_clk0 = sce
+    no_t_clk1 = sce1
+    t_clk0 = sce + jnp.maximum(x, 0.0) - x * lbl \
+        + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    t_clk1 = sce1 + jnp.maximum(x, 0.0) - x * (lbl - 1.0) \
+        + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return jnp.where(
+        lbl < -1.0, no_t_clk0,
+        jnp.where(lbl < 0.0, no_t_clk1,
+                  jnp.where(lbl < 1.0, t_clk0, t_clk1)))
+
+
+@register_op("mean_iou", inputs=["Predictions", "Labels"],
+             outputs=["OutMeanIou", "OutWrong", "OutCorrect"],
+             no_grad=True)
+def mean_iou(ctx, attrs, Predictions, Labels):
+    """Mean IoU over classes (mean_iou_op.h): per class
+    iou = correct / (pred_count + label_count - correct); classes absent
+    from both are excluded from the mean."""
+    n = int(attrs["num_classes"])
+    pred = jnp.ravel(Predictions).astype(jnp.int32)
+    lab = jnp.ravel(Labels).astype(jnp.int32)
+    pred_cnt = jnp.bincount(pred, length=n).astype(jnp.float32)
+    lab_cnt = jnp.bincount(lab, length=n).astype(jnp.float32)
+    correct = jnp.bincount(
+        jnp.where(pred == lab, pred, n), length=n + 1
+    )[:n].astype(jnp.float32)
+    union = pred_cnt + lab_cnt - correct
+    present = union > 0
+    iou = jnp.where(present, correct / jnp.maximum(union, 1.0), 0.0)
+    denom = jnp.maximum(jnp.sum(present.astype(jnp.float32)), 1.0)
+    wrong = (pred_cnt + lab_cnt - 2.0 * correct).astype(jnp.int32)
+    return {
+        "OutMeanIou": jnp.sum(iou) / denom,
+        "OutWrong": wrong,
+        "OutCorrect": correct.astype(jnp.int32),
+    }
+
+
+@register_op("bilinear_tensor_product", inputs=["X", "Y", "Weight", "Bias"],
+             outputs=["Out"])
+def bilinear_tensor_product(ctx, attrs, X, Y, Weight, Bias):
+    """out[b,k] = x[b] @ W[k] @ y[b]^T (+ bias)
+    (bilinear_tensor_product_op.h); W: [K, dx, dy]."""
+    out = jnp.einsum("bi,kij,bj->bk", X, Weight, Y)
+    if Bias is not None:
+        out = out + Bias.reshape(1, -1)
+    return out

@@ -469,3 +469,166 @@ def fused_multihead_attention(ctx, attrs, Q, K, V, BiasQK=None):
         scale = float(scale)
     return flash_attention(Q, K, V, bias=BiasQK, causal=causal,
                            sm_scale=scale)
+
+
+@register_op("selu", inputs=["X"], outputs=["Out"])
+def selu(ctx, attrs, X):
+    """scale * (max(0,x) + min(0, alpha*(exp(x)-1))) (selu_op.cc)."""
+    scale = float(attrs.get("scale", 1.0507009873554805))
+    alpha = float(attrs.get("alpha", 1.6732632423543772))
+    return scale * jnp.where(X > 0, X, alpha * (jnp.exp(X) - 1.0))
+
+
+@register_op("multiplex", inputs=["X*", "Ids"], outputs=["Out"])
+def multiplex(ctx, attrs, X, Ids):
+    """Row-wise select among k candidate tensors (multiplex_op.cc):
+    out[i] = X[ids[i]][i]."""
+    stacked = jnp.stack(X, axis=0)  # [k, B, ...]
+    ids = jnp.reshape(Ids, (-1,)).astype(jnp.int32)
+    rows = jnp.arange(stacked.shape[1])
+    return stacked[ids, rows]
+
+
+@register_op("sampling_id", inputs=["X"], outputs=["Out"], no_grad=True)
+def sampling_id(ctx, attrs, X):
+    """Sample one column index per row of a probability matrix
+    (sampling_id_op.cc)."""
+    key = ctx.rng()
+    return jax.random.categorical(
+        key, jnp.log(jnp.maximum(X, 1e-38)), axis=-1
+    ).astype(jnp.int64)
+
+
+@register_op("uniform_random_batch_size_like", inputs=["Input"],
+             outputs=["Out"], no_grad=True)
+def uniform_random_batch_size_like(ctx, attrs, Input):
+    from .common import resolve_dtype
+
+    shape = [int(s) for s in attrs["shape"]]
+    idx_in = int(attrs.get("input_dim_idx", 0))
+    idx_out = int(attrs.get("output_dim_idx", 0))
+    shape[idx_out] = Input.shape[idx_in]
+    dtype = resolve_dtype(attrs.get("dtype", 5))
+    lo = float(attrs.get("min", -1.0))
+    hi = float(attrs.get("max", 1.0))
+    return jax.random.uniform(ctx.rng(), shape, dtype, lo, hi)
+
+
+@register_op("gaussian_random_batch_size_like", inputs=["Input"],
+             outputs=["Out"], no_grad=True)
+def gaussian_random_batch_size_like(ctx, attrs, Input):
+    from .common import resolve_dtype
+
+    shape = [int(s) for s in attrs["shape"]]
+    idx_in = int(attrs.get("input_dim_idx", 0))
+    idx_out = int(attrs.get("output_dim_idx", 0))
+    shape[idx_out] = Input.shape[idx_in]
+    dtype = resolve_dtype(attrs.get("dtype", 5))
+    mean = float(attrs.get("mean", 0.0))
+    std = float(attrs.get("std", 1.0))
+    return mean + std * jax.random.normal(ctx.rng(), shape, dtype)
+
+
+@register_op("add_position_encoding", inputs=["X"], outputs=["Out"])
+def add_position_encoding(ctx, attrs, X):
+    """alpha*x + beta*PE with PE[j, k<half] = sin(j / 10000^(k/(half-1))),
+    PE[j, half+k] = cos(same) (add_position_encoding_op.h)."""
+    alpha = float(attrs.get("alpha", 1.0))
+    beta = float(attrs.get("beta", 1.0))
+    b, t, d = X.shape
+    half = d // 2
+    j = jnp.arange(t, dtype=jnp.float32)[:, None]
+    k = jnp.arange(half, dtype=jnp.float32)[None, :]
+    denom = jnp.power(10000.0, k / max(half - 1, 1))
+    val = j / denom
+    parts = [jnp.sin(val), jnp.cos(val)]
+    if d % 2:
+        # odd feature dim: the reference kernel leaves the last column
+        # unwritten; define it as passthrough (pe = 0) instead of UB
+        parts.append(jnp.zeros((t, 1), jnp.float32))
+    pe = jnp.concatenate(parts, axis=1)  # [T, D]
+    return alpha * X + beta * pe[None, :, :].astype(X.dtype)
+
+
+@register_op("hash", inputs=["X"], outputs=["Out"], no_grad=True)
+def hash_op(ctx, attrs, X):
+    """num_hash integer hashes of each id row, mod mod_by (hash_op.h).
+    The reference uses XXH64; here a splitmix64-style mix — deterministic
+    and well-distributed, but NOT bit-identical to xxhash (documented
+    deviation: hashed-embedding training is seed-compatible within this
+    framework, not across frameworks)."""
+    num_hash = int(attrs.get("num_hash", 1))
+    mod_by = int(attrs.get("mod_by", 1))
+    x = X.astype(jnp.uint32)
+    # combine each row's ids into one 32-bit state per hash seed
+    outs = []
+    for seed in range(num_hash):
+        h = jnp.full(x.shape[:-1], 0x9E3779B9 * (seed + 1), jnp.uint32)
+        for i in range(x.shape[-1]):
+            v = x[..., i]
+            v = v * jnp.uint32(0x85EBCA6B)
+            v = v ^ (v >> 13)
+            v = v * jnp.uint32(0xC2B2AE35)
+            h = (h ^ v) * jnp.uint32(0x01000193)
+        outs.append((h % jnp.uint32(mod_by)).astype(jnp.int64))
+    out = jnp.stack(outs, axis=-1)  # [..., num_hash]
+    return out[..., None] if X.ndim == 2 else out
+
+
+@register_op("data_norm", inputs=["X", "BatchSize", "BatchSum",
+                                  "BatchSquareSum"],
+             outputs=["Y", "Means", "Scales"],
+             stateful_outputs=("Means", "Scales"))
+def data_norm(ctx, attrs, X, BatchSize, BatchSum, BatchSquareSum):
+    """CTR feature normalization (data_norm_op.cc): means = sum/size,
+    scales = sqrt(size/square_sum); y = (x - means) * scales.  The stat
+    accumulators are persistable params updated by the training loop."""
+    means = BatchSum / BatchSize
+    scales = jnp.sqrt(BatchSize / BatchSquareSum)
+    y = (X - means[None, :]) * scales[None, :]
+    return {"Y": y, "Means": means, "Scales": scales}
+
+
+@register_op("spectral_norm", inputs=["Weight", "U", "V"], outputs=["Out"])
+def spectral_norm(ctx, attrs, Weight, U, V):
+    """Power-iteration spectral normalization (spectral_norm_op.h):
+    repeat {v = W^T u / ||.||; u = W v / ||.||}; sigma = u^T W v;
+    out = W / sigma.  dim selects the 'height' axis (transposed first)."""
+    dim = int(attrs.get("dim", 0))
+    power_iters = int(attrs.get("power_iters", 1))
+    eps = float(attrs.get("eps", 1e-12))
+    w = Weight
+    perm = None
+    if dim != 0:
+        perm = [dim] + [i for i in range(w.ndim) if i != dim]
+        w = jnp.transpose(w, perm)
+    h = w.shape[0]
+    mat = w.reshape(h, -1)
+    u = jnp.reshape(U, (h,))
+    v = jnp.reshape(V, (-1,))
+    for _ in range(power_iters):
+        v = mat.T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = mat @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    u = jax.lax.stop_gradient(u)
+    v = jax.lax.stop_gradient(v)
+    sigma = u @ (mat @ v)
+    out = w / sigma
+    if perm is not None:
+        inv = [perm.index(i) for i in range(len(perm))]
+        out = jnp.transpose(out, inv)
+    return out
+
+
+@register_op("row_conv", inputs=["X", "Filter"], outputs=["Out"])
+def row_conv(ctx, attrs, X, Filter):
+    """Lookahead row convolution (row_conv_op.cc): for padded [B,T,D]
+    input and [K,D] filter, out[t] = sum_{i<K, t+i<T} x[t+i] * w[i]."""
+    k = Filter.shape[0]
+    b, t, d = X.shape
+    out = jnp.zeros_like(X)
+    for i in range(k):
+        shifted = jnp.pad(X[:, i:, :], ((0, 0), (0, i), (0, 0)))
+        out = out + shifted * Filter[i][None, None, :]
+    return out

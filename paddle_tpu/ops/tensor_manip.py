@@ -281,9 +281,13 @@ def lod_reset(ctx, attrs, X, Y):
 def im2sequence(ctx, attrs, X):
     kernels = attrs.get("kernels")
     strides = attrs.get("strides", [1, 1])
+    pads = [int(p) for p in attrs.get("paddings", [0, 0, 0, 0])]
+    # reference im2sequence_op.cc padding order: [up, left, down, right]
+    padding = ((pads[0], pads[2]), (pads[1], pads[3]))
     n, c, h, w = jnp.shape(X)
     patches = jax.lax.conv_general_dilated_patches(
-        X, kernels, strides, "VALID", dimension_numbers=("NCHW", "OIHW", "NCHW")
+        X, kernels, strides, padding,
+        dimension_numbers=("NCHW", "OIHW", "NCHW")
     )
     oh, ow = patches.shape[2], patches.shape[3]
     return patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, -1)

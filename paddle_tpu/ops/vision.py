@@ -1,0 +1,361 @@
+"""Vision / image-manipulation op lowerings.
+
+Reference kernels: ``paddle/fluid/operators/{pixel_shuffle,shuffle_channel,
+space_to_depth,temporal_shift,affine_channel,crop,pad_constant_like,
+maxout,lrn,fsp,grid_sampler,affine_grid,roi_pool,psroi_pool,unfold,pool,
+conv_transpose}_op.*``.  TPU-native notes: every rearrangement lowers to
+reshape/transpose (free layout changes under XLA); samplers/pools become
+gathers + segment reductions with static shapes; nothing loops on the
+host."""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+from .common import normalize_axis
+
+
+@register_op("pixel_shuffle", inputs=["X"], outputs=["Out"])
+def pixel_shuffle(ctx, attrs, X):
+    """[N, C*r^2, H, W] -> [N, C, H*r, W*r] (pixel_shuffle_op.cc)."""
+    r = int(attrs.get("upscale_factor", 1))
+    n, c, h, w = X.shape
+    x = X.reshape(n, c // (r * r), r, r, h, w)
+    x = x.transpose(0, 1, 4, 2, 5, 3)
+    return x.reshape(n, c // (r * r), h * r, w * r)
+
+
+@register_op("shuffle_channel", inputs=["X"], outputs=["Out"])
+def shuffle_channel(ctx, attrs, X):
+    """Group-interleave channels (shuffle_channel_op.cc)."""
+    g = int(attrs.get("group", 1))
+    n, c, h, w = X.shape
+    x = X.reshape(n, g, c // g, h, w).transpose(0, 2, 1, 3, 4)
+    return x.reshape(n, c, h, w)
+
+
+@register_op("space_to_depth", inputs=["X"], outputs=["Out"])
+def space_to_depth(ctx, attrs, X):
+    """[N,C,H,W] -> [N, C*b^2, H/b, W/b] (space_to_depth_op.cc)."""
+    b = int(attrs.get("blocksize", 1))
+    n, c, h, w = X.shape
+    x = X.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+@register_op("temporal_shift", inputs=["X"], outputs=["Out"])
+def temporal_shift(ctx, attrs, X):
+    """[N*T, C, H, W]: shift the first fold of channels backward in time,
+    the second fold forward, keep the rest (temporal_shift_op.cc)."""
+    t = int(attrs.get("seg_num", 1))
+    ratio = float(attrs.get("shift_ratio", 0.25))
+    nt, c, h, w = X.shape
+    n = nt // t
+    c1 = int(c * ratio)
+    c2 = int(c * 2 * ratio)
+    x = X.reshape(n, t, c, h, w)
+    pad = jnp.zeros((n, 1, c, h, w), X.dtype)
+    slow = jnp.concatenate([x[:, 1:, :c1], pad[:, :, :c1]], axis=1)
+    fast = jnp.concatenate([pad[:, :, c1:c2], x[:, :-1, c1:c2]], axis=1)
+    keep = x[:, :, c2:]
+    out = jnp.concatenate([slow, fast, keep], axis=2)
+    return out.reshape(nt, c, h, w)
+
+
+@register_op("affine_channel", inputs=["X", "Scale", "Bias"],
+             outputs=["Out"])
+def affine_channel(ctx, attrs, X, Scale, Bias):
+    """x*scale[C]+bias[C] per channel (affine_channel_op.cc); NCHW/NHWC."""
+    layout = attrs.get("data_layout", "NCHW")
+    if layout == "NCHW":
+        shape = (1, -1) + (1,) * (X.ndim - 2)
+    else:
+        shape = (1,) * (X.ndim - 1) + (-1,)
+    return X * Scale.reshape(shape) + Bias.reshape(shape)
+
+
+@register_op("crop", inputs=["X", "Y", "Offsets"], outputs=["Out"])
+def crop(ctx, attrs, X, Y, Offsets):
+    """Static crop to `shape` at `offsets` (crop_op.cc); Y supplies the
+    target shape when given."""
+    shape = [int(s) for s in attrs.get("shape", [])] if Y is None \
+        else list(Y.shape)
+    if Offsets is not None:
+        offsets = [int(o) for o in jnp.ravel(Offsets)] \
+            if not hasattr(Offsets, "aval") else None
+        if offsets is None:
+            # traced offsets: dynamic_slice
+            starts = jnp.ravel(Offsets).astype(jnp.int32)
+            return jax.lax.dynamic_slice(
+                X, [starts[i] for i in range(X.ndim)], shape)
+    else:
+        offsets = [int(o) for o in attrs.get("offsets", [0] * X.ndim)]
+    sl = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return X[sl]
+
+
+@register_op("pad_constant_like", inputs=["X", "Y"], outputs=["Out"])
+def pad_constant_like(ctx, attrs, X, Y):
+    """Pad Y at the high end of every dim up to X's shape
+    (pad_constant_like_op.cc)."""
+    val = float(attrs.get("pad_value", 0.0))
+    pads = [(0, int(xs - ys)) for xs, ys in zip(X.shape, Y.shape)]
+    return jnp.pad(Y, pads, constant_values=jnp.asarray(val, Y.dtype))
+
+
+@register_op("random_crop", inputs=["X", "Seed"], outputs=["Out", "SeedOut"],
+             no_grad=True, stateful_outputs=("SeedOut",))
+def random_crop(ctx, attrs, X, Seed):
+    """Uniform-offset crop of the trailing dims to `shape`
+    (random_crop_op.h); the leading (batch) dims are kept."""
+    shape = [int(s) for s in attrs["shape"]]
+    k = len(shape)
+    key = ctx.rng()
+    starts = []
+    for i, s in enumerate(shape):
+        dim = X.shape[X.ndim - k + i]
+        key, sub = jax.random.split(key)
+        starts.append(
+            jax.random.randint(sub, (), 0, dim - s + 1, jnp.int32))
+    full_starts = [jnp.zeros((), jnp.int32)] * (X.ndim - k) + starts
+    out = jax.lax.dynamic_slice(
+        X, full_starts, list(X.shape[: X.ndim - k]) + shape)
+    seed_out = Seed if Seed is not None else jnp.zeros((1,), jnp.int64)
+    return {"Out": out, "SeedOut": seed_out}
+
+
+@register_op("maxout", inputs=["X"], outputs=["Out"])
+def maxout(ctx, attrs, X):
+    """[N,C,H,W] -> [N, C/groups, H, W], max across each channel group
+    (math/maxouting.cc: out[c] = max_g in[c*groups+g])."""
+    g = int(attrs.get("groups", 1))
+    n, c, h, w = X.shape
+    return jnp.max(X.reshape(n, c // g, g, h, w), axis=2)
+
+
+@register_op("lrn", inputs=["X"], outputs=["Out", "MidOut"],
+             stateful_outputs=("MidOut",))
+def lrn(ctx, attrs, X):
+    """Across-channel local response norm (lrn_op.cc):
+    mid = k + alpha * sum_{window n} x^2 ; out = x * mid^-beta."""
+    n = int(attrs.get("n", 5))
+    k = float(attrs.get("k", 2.0))
+    alpha = float(attrs.get("alpha", 1e-4))
+    beta = float(attrs.get("beta", 0.75))
+    sq = jnp.square(X)
+    half = n // 2
+    pad = jnp.pad(sq, ((0, 0), (half, n - 1 - half), (0, 0), (0, 0)))
+    window = sum(pad[:, i:i + X.shape[1]] for i in range(n))
+    mid = k + alpha * window
+    return {"Out": X * jnp.power(mid, -beta), "MidOut": mid}
+
+
+@register_op("fsp", inputs=["X", "Y"], outputs=["Out"])
+def fsp(ctx, attrs, X, Y):
+    """FSP matrix for distillation (fsp_op.cc):
+    out[b,i,j] = (1/HW) sum_hw X[b,i,h,w] * Y[b,j,h,w]."""
+    b, c1, h, w = X.shape
+    c2 = Y.shape[1]
+    xf = X.reshape(b, c1, h * w)
+    yf = Y.reshape(b, c2, h * w)
+    return jnp.einsum("bik,bjk->bij", xf, yf) / jnp.asarray(
+        h * w, X.dtype)
+
+
+def _bilinear_sample(x, gx, gy, align_corners=True):
+    """Sample NCHW `x` at normalized [-1,1] grid coords (gx, gy) [N,Ho,Wo]
+    with zero padding outside — grid_sampler_op.cc convention."""
+    n, c, h, w = x.shape
+    if align_corners:
+        fx = (gx + 1) * 0.5 * (w - 1)
+        fy = (gy + 1) * 0.5 * (h - 1)
+    else:
+        fx = ((gx + 1) * w - 1) * 0.5
+        fy = ((gy + 1) * h - 1) * 0.5
+    x0 = jnp.floor(fx)
+    y0 = jnp.floor(fy)
+    dx = fx - x0
+    dy = fy - y0
+
+    def gather(yi, xi):
+        valid = ((yi >= 0) & (yi < h) & (xi >= 0) & (xi < w))
+        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+        v = x[jnp.arange(n)[:, None, None], :, yc, xc]  # [N,Ho,Wo,C]
+        return jnp.where(valid[..., None], v, 0.0)
+
+    v00 = gather(y0, x0)
+    v01 = gather(y0, x0 + 1)
+    v10 = gather(y0 + 1, x0)
+    v11 = gather(y0 + 1, x0 + 1)
+    dx = dx[..., None]
+    dy = dy[..., None]
+    out = (v00 * (1 - dx) * (1 - dy) + v01 * dx * (1 - dy)
+           + v10 * (1 - dx) * dy + v11 * dx * dy)
+    return jnp.moveaxis(out, -1, 1)  # [N,C,Ho,Wo]
+
+
+@register_op("grid_sampler", inputs=["X", "Grid"], outputs=["Output"])
+def grid_sampler(ctx, attrs, X, Grid):
+    """Bilinear sampling of X [N,C,H,W] at Grid [N,Ho,Wo,2] (x,y in
+    [-1,1]), zeros outside (grid_sampler_op.cc, align_corners=True)."""
+    return _bilinear_sample(X, Grid[..., 0], Grid[..., 1])
+
+
+@register_op("affine_grid", inputs=["Theta"], outputs=["Output"])
+def affine_grid(ctx, attrs, Theta):
+    """2x3 affine params -> sampling grid [N,H,W,2] (affine_grid_op.cc,
+    align_corners semantics of the reference: linspace over [-1,1])."""
+    n, c, h, w = [int(v) for v in attrs["output_shape"]]
+    ys = jnp.linspace(-1.0, 1.0, h, dtype=Theta.dtype)
+    xs = jnp.linspace(-1.0, 1.0, w, dtype=Theta.dtype)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)  # [H,W,3]
+    out = jnp.einsum("hwk,njk->nhwj", base, Theta)  # [N,H,W,2]
+    return out
+
+
+def _roi_regions(rois, spatial_scale, pooled_h, pooled_w, hin, win,
+                 round_mode):
+    """Per-ROI bin boundaries (roi_pool_op.cc integer arithmetic)."""
+    x1 = jnp.round(rois[:, 0] * spatial_scale)
+    y1 = jnp.round(rois[:, 1] * spatial_scale)
+    x2 = jnp.round(rois[:, 2] * spatial_scale)
+    y2 = jnp.round(rois[:, 3] * spatial_scale)
+    rh = jnp.maximum(y2 - y1 + 1, 1.0)
+    rw = jnp.maximum(x2 - x1 + 1, 1.0)
+    bin_h = rh / pooled_h
+    bin_w = rw / pooled_w
+    return x1, y1, bin_h, bin_w
+
+
+@register_op("roi_pool", inputs=["X", "ROIs", "RoisLod"],
+             outputs=["Out", "Argmax"], stateful_outputs=("Argmax",))
+def roi_pool(ctx, attrs, X, ROIs, RoisLod):
+    """Max-pool each ROI bin (roi_pool_op.cc).  ROIs: [R, 4] boxes plus a
+    batch-index column convention: here RoisLod (or a 5-col ROIs with
+    leading batch id) maps each ROI to its image; TPU-static via a dense
+    per-bin mask-max over the feature map."""
+    scale = float(attrs.get("spatial_scale", 1.0))
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    if ROIs.shape[-1] == 5:
+        batch_idx = ROIs[:, 0].astype(jnp.int32)
+        boxes = ROIs[:, 1:]
+    else:
+        batch_idx = (jnp.zeros((ROIs.shape[0],), jnp.int32)
+                     if RoisLod is None
+                     else RoisLod.astype(jnp.int32)[: ROIs.shape[0]])
+        boxes = ROIs
+    n, c, h, w = X.shape
+    r = boxes.shape[0]
+    x1, y1, bin_h, bin_w = _roi_regions(boxes, scale, ph, pw, h, w, "round")
+
+    ys = jnp.arange(h, dtype=jnp.float32)
+    xs = jnp.arange(w, dtype=jnp.float32)
+    # bin start/end per (roi, bin): floor/ceil as in the reference
+    iy = jnp.arange(ph, dtype=jnp.float32)
+    ix = jnp.arange(pw, dtype=jnp.float32)
+    hstart = jnp.floor(y1[:, None] + iy[None, :] * bin_h[:, None])
+    hend = jnp.ceil(y1[:, None] + (iy[None, :] + 1) * bin_h[:, None])
+    wstart = jnp.floor(x1[:, None] + ix[None, :] * bin_w[:, None])
+    wend = jnp.ceil(x1[:, None] + (ix[None, :] + 1) * bin_w[:, None])
+    hstart = jnp.clip(hstart, 0, h)
+    hend = jnp.clip(hend, 0, h)
+    wstart = jnp.clip(wstart, 0, w)
+    wend = jnp.clip(wend, 0, w)
+    # mask [R, ph, H] / [R, pw, W]
+    hmask = ((ys[None, None, :] >= hstart[:, :, None])
+             & (ys[None, None, :] < hend[:, :, None]))
+    wmask = ((xs[None, None, :] >= wstart[:, :, None])
+             & (xs[None, None, :] < wend[:, :, None]))
+    feats = X[batch_idx]  # [R, C, H, W]
+    neg = jnp.asarray(-3.4e38, X.dtype)
+    # separable masked max (static ph/pw loops): reduce H per bin-row,
+    # then W per bin-col — peak intermediate [R,C,H,W], not
+    # [R,C,ph,pw,H,W]
+    hred = jnp.stack([
+        jnp.max(jnp.where(hmask[:, i, None, :, None], feats, neg), axis=2)
+        for i in range(ph)], axis=2)                   # [R,C,ph,W]
+    out = jnp.stack([
+        jnp.max(jnp.where(wmask[:, j, None, None, :], hred, neg), axis=-1)
+        for j in range(pw)], axis=3)                   # [R,C,ph,pw]
+    empty = (jnp.sum(hmask, 2)[:, None, :, None] *
+             jnp.sum(wmask, 2)[:, None, None, :]) == 0
+    out = jnp.where(empty, jnp.zeros_like(out), out)
+    return {"Out": out, "Argmax": jnp.zeros(out.shape, jnp.int64)}
+
+
+@register_op("psroi_pool", inputs=["X", "ROIs"], outputs=["Out"])
+def psroi_pool(ctx, attrs, X, ROIs):
+    """Position-sensitive ROI average pool (psroi_pool_op.cc): input
+    channels C = out_c * ph * pw; bin (i,j) of output channel k averages
+    input channel k*ph*pw + i*pw + j inside the bin."""
+    scale = float(attrs.get("spatial_scale", 1.0))
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    out_c = int(attrs.get("output_channels"))
+    if ROIs.shape[-1] == 5:
+        batch_idx = ROIs[:, 0].astype(jnp.int32)
+        boxes = ROIs[:, 1:]
+    else:
+        batch_idx = jnp.zeros((ROIs.shape[0],), jnp.int32)
+        boxes = ROIs
+    n, c, h, w = X.shape
+    r = boxes.shape[0]
+    x1 = boxes[:, 0] * scale
+    y1 = boxes[:, 1] * scale
+    x2 = boxes[:, 2] * scale
+    y2 = boxes[:, 3] * scale
+    rh = jnp.maximum(y2 - y1, 0.1)
+    rw = jnp.maximum(x2 - x1, 0.1)
+    bin_h = rh / ph
+    bin_w = rw / pw
+    ys = jnp.arange(h, dtype=jnp.float32)
+    xs = jnp.arange(w, dtype=jnp.float32)
+    iy = jnp.arange(ph, dtype=jnp.float32)
+    ix = jnp.arange(pw, dtype=jnp.float32)
+    hstart = jnp.floor(y1[:, None] + iy[None, :] * bin_h[:, None])
+    hend = jnp.ceil(y1[:, None] + (iy[None, :] + 1) * bin_h[:, None])
+    wstart = jnp.floor(x1[:, None] + ix[None, :] * bin_w[:, None])
+    wend = jnp.ceil(x1[:, None] + (ix[None, :] + 1) * bin_w[:, None])
+    hstart = jnp.clip(hstart, 0, h)
+    hend = jnp.clip(hend, 0, h)
+    wstart = jnp.clip(wstart, 0, w)
+    wend = jnp.clip(wend, 0, w)
+    hmask = ((ys[None, None, :] >= hstart[:, :, None])
+             & (ys[None, None, :] < hend[:, :, None])).astype(X.dtype)
+    wmask = ((xs[None, None, :] >= wstart[:, :, None])
+             & (xs[None, None, :] < wend[:, :, None])).astype(X.dtype)
+    feats = X[batch_idx].reshape(r, out_c, ph, pw, h, w)
+    # separable masked sum: einsum contracts H then W per bin without a
+    # [R,out_c,ph,pw,H,W] mask product
+    s = jnp.einsum("rkijhw,rih,rjw->rkij", feats, hmask, wmask)
+    area = jnp.maximum(
+        jnp.sum(hmask, 2)[:, None, :, None]
+        * jnp.sum(wmask, 2)[:, None, None, :], 1.0)
+    return s / area
+
+
+@register_op("unfold", inputs=["X"], outputs=["Y"])
+def unfold(ctx, attrs, X):
+    """im2col (unfold_op.cc): [N,C,H,W] -> [N, C*kh*kw, L]."""
+    kh, kw = [int(v) for v in attrs["kernel_sizes"]]
+    sh, sw = [int(v) for v in attrs.get("strides", [1, 1])]
+    pads = [int(v) for v in attrs.get("paddings", [0, 0, 0, 0])]
+    dh, dw = [int(v) for v in attrs.get("dilations", [1, 1])]
+    n, c, h, w = X.shape
+    x = jnp.pad(X, ((0, 0), (0, 0), (pads[0], pads[2]), (pads[1], pads[3])))
+    hp, wp = x.shape[2], x.shape[3]
+    oh = (hp - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (wp - (dw * (kw - 1) + 1)) // sw + 1
+    patches = []
+    for i in range(kh):
+        for j in range(kw):
+            patches.append(
+                x[:, :, i * dh:i * dh + oh * sh:sh,
+                  j * dw:j * dw + ow * sw:sw])
+    out = jnp.stack(patches, axis=2)  # [N, C, kh*kw, oh, ow]
+    return out.reshape(n, c * kh * kw, oh * ow)

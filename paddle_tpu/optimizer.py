@@ -121,11 +121,15 @@ class Optimizer:
             persistable=True,
         )
         var.stop_gradient = True
-        # table-shaped accumulators of a distributed (row-sharded) embedding
-        # shard with it, so the optimizer update stays local to each shard
-        if (getattr(param, "_is_distributed", False)
-                and list(shape) == list(param.shape or [])):
-            var._is_distributed = True
+        # param-shaped accumulators shard with their param (distributed
+        # embedding rows / TP shard_spec), so the optimizer update stays
+        # local to each shard
+        if list(shape) == list(param.shape or []):
+            if getattr(param, "_is_distributed", False):
+                var._is_distributed = True
+            spec = getattr(param, "shard_spec", None)
+            if spec is not None:
+                var.shard_spec = spec
         helper.set_variable_initializer(
             var, ConstantInitializer(float(fill_value))
         )

@@ -20,7 +20,7 @@ from ..framework import Variable, default_main_program
 __all__ = ["ParallelExecutor", "SPMDRunner"]
 
 
-def _make_mesh(places=None, num_devices=None):
+def _make_mesh(places=None, num_devices=None, tp_degree=1):
     import jax
     from jax.sharding import Mesh
 
@@ -29,16 +29,29 @@ def _make_mesh(places=None, num_devices=None):
         devs = devs[: len(places)]
     elif num_devices:
         devs = devs[:num_devices]
+    tp = max(1, int(tp_degree or 1))
+    if tp > 1:
+        if len(devs) % tp:
+            raise ValueError(
+                "tensor_parallel_degree=%d does not divide the %d-device "
+                "mesh" % (tp, len(devs)))
+        return Mesh(
+            np.array(devs).reshape(len(devs) // tp, tp), ("data", "model"))
     return Mesh(np.array(devs), ("data",))
 
 
 class SPMDRunner:
     """jit-with-shardings runner behind CompiledProgram.with_data_parallel."""
 
-    def __init__(self, program, build_strategy=None, places=None):
+    def __init__(self, program, build_strategy=None, places=None,
+                 data_parallel=True):
         self.program = program
         self.build_strategy = build_strategy
-        self.mesh = _make_mesh(places)
+        tp = int(getattr(build_strategy, "tensor_parallel_degree", 1) or 1)
+        self.mesh = (_make_mesh(places, tp_degree=tp)
+                     if data_parallel else None)
+        self.accumulate_steps = int(
+            getattr(build_strategy, "batch_merge_repeat", 1) or 1)
         self._cache = {}
 
     def run(self, executor, feed, fetch_list, scope, return_numpy):
@@ -68,6 +81,7 @@ class SPMDRunner:
                 scope,
                 "train",
                 mesh=self.mesh,
+                accumulate_steps=self.accumulate_steps,
             )
             self._cache[key_tuple] = compiled
 

@@ -24,7 +24,7 @@ class L2DecayRegularizer(WeightDecayRegularizer):
         )
         block.append_op(
             type="scale", inputs={"X": [param]}, outputs={"Out": [decay]},
-            attrs={"scale": self._regularization_coeff, "op_role": "backward"},
+            attrs={"scale": self._regularization_coeff, "op_role": "optimize"},
         )
         return decay
 
@@ -40,7 +40,7 @@ class L1DecayRegularizer(WeightDecayRegularizer):
         )
         block.append_op(
             type="sign", inputs={"X": [param]}, outputs={"Out": [sign]},
-            attrs={"op_role": "backward"},
+            attrs={"op_role": "optimize"},
         )
         decay = block.create_var(
             name=unique_name.generate(param.name + ".l1decay"),
@@ -48,7 +48,7 @@ class L1DecayRegularizer(WeightDecayRegularizer):
         )
         block.append_op(
             type="scale", inputs={"X": [sign]}, outputs={"Out": [decay]},
-            attrs={"scale": self._regularization_coeff, "op_role": "backward"},
+            attrs={"scale": self._regularization_coeff, "op_role": "optimize"},
         )
         return decay
 
@@ -78,7 +78,7 @@ def append_regularization_ops(parameters_and_grads, regularization=None):
             type="sum",
             inputs={"X": [grad, regularization_term]},
             outputs={"Out": [new_grad]},
-            attrs={"op_role": "backward"},
+            attrs={"op_role": "optimize"},
         )
         params_and_grads.append((param, new_grad))
     return params_and_grads

@@ -1,0 +1,503 @@
+"""Numpy-oracle tests for the second-wave layers.nn surface
+(ops/{vision,losses}.py + nn extras).  Harness pattern: op_test.py golden
+oracles (reference unittests/test_*_op.py equivalents)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.executor import Scope, scope_guard
+
+
+def run_layer(build, feeds, n_out=1):
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        outs = build()
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        vals = exe.run(main, feed=feeds, fetch_list=list(outs))
+    return vals[0] if n_out == 1 else vals
+
+
+def _data(name, arr, stop_gradient=True):
+    return fluid.layers.data(name, shape=list(arr.shape), dtype=str(arr.dtype),
+                             append_batch_size=False,
+                             stop_gradient=stop_gradient)
+
+
+def test_selu():
+    x = np.random.RandomState(0).randn(4, 5).astype("float32")
+    got = run_layer(lambda: fluid.layers.selu(_data("x", x)), {"x": x})
+    scale, alpha = 1.0507009873554805, 1.6732632423543772
+    exp = scale * np.where(x > 0, x, alpha * (np.exp(x) - 1))
+    np.testing.assert_allclose(got, exp, rtol=1e-6)
+
+
+def test_maxout():
+    x = np.random.RandomState(1).randn(2, 6, 3, 3).astype("float32")
+    got = run_layer(lambda: fluid.layers.maxout(_data("x", x), groups=3),
+                    {"x": x})
+    exp = x.reshape(2, 2, 3, 3, 3).max(axis=2)
+    np.testing.assert_allclose(got, exp)
+
+
+def test_multiplex():
+    rng = np.random.RandomState(2)
+    xs = [rng.randn(4, 3).astype("float32") for _ in range(3)]
+    ids = np.array([[2], [0], [1], [2]], "int32")
+    got = run_layer(
+        lambda: fluid.layers.multiplex(
+            [_data("x%d" % i, x) for i, x in enumerate(xs)],
+            _data("ids", ids)),
+        {"x%d" % i: x for i, x in enumerate(xs)} | {"ids": ids})
+    exp = np.stack([xs[ids[i, 0]][i] for i in range(4)])
+    np.testing.assert_allclose(got, exp)
+
+
+def test_crop_and_pad_constant_like():
+    x = np.arange(24, dtype="float32").reshape(2, 3, 4)
+    got = run_layer(
+        lambda: fluid.layers.crop(_data("x", x), shape=[1, 2, 2],
+                                  offsets=[1, 0, 1]), {"x": x})
+    np.testing.assert_allclose(got, x[1:2, 0:2, 1:3])
+
+    big = np.zeros((3, 5), "float32")
+    small = np.ones((2, 3), "float32")
+    got = run_layer(
+        lambda: fluid.layers.pad_constant_like(
+            _data("b", big), _data("s", small), pad_value=7.0),
+        {"b": big, "s": small})
+    exp = np.full((3, 5), 7.0, "float32")
+    exp[:2, :3] = 1.0
+    np.testing.assert_allclose(got, exp)
+
+
+def test_pixel_shuffle_shuffle_channel_space_to_depth():
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 8, 3, 3).astype("float32")
+    got = run_layer(lambda: fluid.layers.pixel_shuffle(_data("x", x), 2),
+                    {"x": x})
+    exp = x.reshape(2, 2, 2, 2, 3, 3).transpose(0, 1, 4, 2, 5, 3) \
+        .reshape(2, 2, 6, 6)
+    np.testing.assert_allclose(got, exp)
+
+    got = run_layer(lambda: fluid.layers.shuffle_channel(_data("x", x), 4),
+                    {"x": x})
+    exp = x.reshape(2, 4, 2, 3, 3).transpose(0, 2, 1, 3, 4).reshape(x.shape)
+    np.testing.assert_allclose(got, exp)
+
+    y = rng.randn(2, 3, 4, 4).astype("float32")
+    got = run_layer(lambda: fluid.layers.space_to_depth(_data("y", y), 2),
+                    {"y": y})
+    exp = y.reshape(2, 3, 2, 2, 2, 2).transpose(0, 3, 5, 1, 2, 4) \
+        .reshape(2, 12, 2, 2)
+    np.testing.assert_allclose(got, exp)
+
+
+def test_temporal_shift():
+    rng = np.random.RandomState(4)
+    t, ratio = 3, 0.25
+    x = rng.randn(6, 4, 2, 2).astype("float32")  # N=2, T=3
+    got = run_layer(
+        lambda: fluid.layers.temporal_shift(_data("x", x), t, ratio),
+        {"x": x})
+    xr = x.reshape(2, 3, 4, 2, 2)
+    exp = np.zeros_like(xr)
+    exp[:, :-1, :1] = xr[:, 1:, :1]    # backward shift
+    exp[:, 1:, 1:2] = xr[:, :-1, 1:2]  # forward shift
+    exp[:, :, 2:] = xr[:, :, 2:]
+    np.testing.assert_allclose(got, exp.reshape(x.shape))
+
+
+def test_affine_channel_and_fsp():
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 3, 4, 4).astype("float32")
+    s = rng.randn(3).astype("float32")
+    b = rng.randn(3).astype("float32")
+    got = run_layer(
+        lambda: fluid.layers.affine_channel(
+            _data("x", x), _data("s", s), _data("b", b)),
+        {"x": x, "s": s, "b": b})
+    np.testing.assert_allclose(
+        got, x * s[None, :, None, None] + b[None, :, None, None],
+        rtol=1e-5, atol=1e-6)
+
+    y = rng.randn(2, 5, 4, 4).astype("float32")
+    got = run_layer(
+        lambda: fluid.layers.fsp_matrix(_data("x", x), _data("y", y)),
+        {"x": x, "y": y})
+    exp = np.einsum("bchw,bdhw->bcd", x, y) / 16.0
+    np.testing.assert_allclose(got, exp, rtol=1e-5)
+
+
+def test_lrn():
+    rng = np.random.RandomState(6)
+    x = rng.rand(2, 7, 3, 3).astype("float32")
+    got = run_layer(lambda: fluid.layers.lrn(_data("x", x), n=5, k=2.0,
+                                             alpha=1e-4, beta=0.75),
+                    {"x": x})
+    sq = x ** 2
+    mid = np.zeros_like(x) + 2.0
+    for c in range(7):
+        lo, hi = max(0, c - 2), min(7, c + 3)
+        mid[:, c] += 1e-4 * sq[:, lo:hi].sum(axis=1)
+    np.testing.assert_allclose(got, x * mid ** -0.75, rtol=1e-5)
+
+
+def test_unfold():
+    rng = np.random.RandomState(7)
+    x = rng.randn(2, 3, 5, 5).astype("float32")
+    got = run_layer(
+        lambda: fluid.layers.unfold(_data("x", x), [2, 2], 1, 0, 1),
+        {"x": x})
+    # numpy im2col oracle
+    cols = []
+    for i in range(2):
+        for j in range(2):
+            cols.append(x[:, :, i:i + 4, j:j + 4])
+    exp = np.stack(cols, 2).reshape(2, 3 * 4, 16)
+    np.testing.assert_allclose(got, exp)
+
+
+def test_grid_sampler_identity():
+    rng = np.random.RandomState(8)
+    x = rng.randn(2, 3, 4, 4).astype("float32")
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 4), np.linspace(-1, 1, 4),
+                         indexing="ij")
+    grid = np.stack([xs, ys], -1)[None].repeat(2, 0).astype("float32")
+    got = run_layer(
+        lambda: fluid.layers.grid_sampler(_data("x", x), _data("g", grid)),
+        {"x": x, "g": grid})
+    np.testing.assert_allclose(got, x, rtol=1e-5, atol=1e-5)
+
+
+def test_affine_grid_identity_transform():
+    theta = np.tile(np.array([[[1, 0, 0], [0, 1, 0]]], "float32"), (2, 1, 1))
+    got = run_layer(
+        lambda: fluid.layers.affine_grid(_data("t", theta), [2, 3, 4, 5]),
+        {"t": theta})
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 4), np.linspace(-1, 1, 5),
+                         indexing="ij")
+    exp = np.stack([xs, ys], -1)[None].repeat(2, 0).astype("float32")
+    np.testing.assert_allclose(got, exp, rtol=1e-5, atol=1e-6)
+
+
+def test_roi_pool():
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 0, 3, 3]], "float32")  # whole image
+    got = run_layer(
+        lambda: fluid.layers.roi_pool(_data("x", x), _data("r", rois),
+                                      pooled_height=2, pooled_width=2),
+        {"x": x, "r": rois})
+    exp = np.array([[[[5, 7], [13, 15]]]], "float32")
+    np.testing.assert_allclose(got, exp)
+
+
+def test_psroi_pool():
+    # C = out_c(1) * 2*2; each bin reads its own channel group
+    x = np.stack([np.full((3, 3), i, "float32") for i in range(4)])[None]
+    rois = np.array([[0, 0, 0, 3, 3]], "float32")
+    got = run_layer(
+        lambda: fluid.layers.psroi_pool(
+            _data("x", x), _data("r", rois), 1, 1.0, 2, 2),
+        {"x": x, "r": rois})
+    np.testing.assert_allclose(got.reshape(-1), [0, 1, 2, 3], atol=1e-6)
+
+
+def test_losses_against_formulas():
+    rng = np.random.RandomState(9)
+    p = rng.rand(6, 1).astype("float32") * 0.9 + 0.05
+    y = (rng.rand(6, 1) > 0.5).astype("float32")
+    got = run_layer(
+        lambda: fluid.layers.log_loss(_data("p", p), _data("y", y)),
+        {"p": p, "y": y})
+    exp = -y * np.log(p + 1e-4) - (1 - y) * np.log(1 - p + 1e-4)
+    np.testing.assert_allclose(got, exp, rtol=1e-5)
+
+    x = rng.randn(4, 5).astype("float32")
+    t = rng.rand(4, 5).astype("float32")
+    got = run_layer(
+        lambda: fluid.layers.kldiv_loss(_data("x", x), _data("t", t),
+                                        reduction="none"),
+        {"x": x, "t": t})
+    np.testing.assert_allclose(got, t * (np.log(t) - x), rtol=1e-4)
+
+    l = rng.randn(5, 1).astype("float32")
+    r = rng.randn(5, 1).astype("float32")
+    lab = (rng.rand(5, 1) > 0.5).astype("float32")
+    got = run_layer(
+        lambda: fluid.layers.rank_loss(
+            _data("lab", lab), _data("l", l), _data("r", r)),
+        {"lab": lab, "l": l, "r": r})
+    o = l - r
+    np.testing.assert_allclose(got, np.log1p(np.exp(o)) - lab * o, rtol=1e-5)
+
+    got = run_layer(
+        lambda: fluid.layers.margin_rank_loss(
+            _data("lab", lab), _data("l", l), _data("r", r), margin=0.1),
+        {"lab": lab, "l": l, "r": r})
+    np.testing.assert_allclose(
+        got, np.maximum(0, -lab * (l - r) + 0.1), rtol=1e-5)
+
+
+def test_bpr_loss_oracle():
+    rng = np.random.RandomState(10)
+    x = rng.randn(4, 6).astype("float32")
+    lab = rng.randint(0, 6, (4, 1)).astype("int64")
+    got = run_layer(
+        lambda: fluid.layers.bpr_loss(_data("x", x), _data("y", lab)),
+        {"x": x, "y": lab})
+    exp = np.zeros((4, 1), "float32")
+    for i in range(4):
+        s = 0.0
+        for j in range(6):
+            if j == lab[i, 0]:
+                continue
+            s += -np.log(1.0 + np.exp(x[i, j] - x[i, lab[i, 0]]))
+        exp[i, 0] = -s / 5.0
+    np.testing.assert_allclose(got, exp, rtol=1e-4)
+
+
+def test_teacher_student_loss_oracle():
+    x = np.array([0.5, -0.3, 1.2, -0.8], "float32")[:, None]
+    lab = np.array([-2.0, -1.0, 0.7, 1.4], "float32")[:, None]
+    got = run_layer(
+        lambda: fluid.layers.teacher_student_sigmoid_loss(
+            _data("x", x), _data("y", lab)),
+        {"x": x, "y": lab})
+    exp = np.zeros_like(x)
+    for i in range(4):
+        xi, li = x[i, 0], lab[i, 0]
+        sce = max(xi, 0) + np.log1p(np.exp(-abs(xi)))
+        if li < -1.0:
+            exp[i, 0] = sce
+        elif li < 0.0:
+            exp[i, 0] = sce - xi
+        elif li < 1.0:
+            exp[i, 0] = sce + max(xi, 0) - xi * li \
+                + np.log1p(np.exp(-abs(xi)))
+        else:
+            exp[i, 0] = sce - xi + max(xi, 0) - xi * (li - 1.0) \
+                + np.log1p(np.exp(-abs(xi)))
+    np.testing.assert_allclose(got, exp, rtol=1e-5)
+
+
+def test_mean_iou():
+    pred = np.array([0, 1, 1, 2, 2, 2], "int32")
+    lab = np.array([0, 1, 2, 2, 2, 1], "int32")
+    miou, wrong, correct = run_layer(
+        lambda: fluid.layers.mean_iou(_data("p", pred), _data("l", lab), 4),
+        {"p": pred, "l": lab}, n_out=3)
+    # class0: 1/1, class1: 1/3, class2: 2/4; class3 absent
+    np.testing.assert_allclose(miou, (1.0 + 1 / 3 + 0.5) / 3, rtol=1e-5)
+    np.testing.assert_allclose(correct, [1, 1, 2, 0])
+
+
+def test_bilinear_tensor_product_shape_and_value():
+    rng = np.random.RandomState(11)
+    x = rng.randn(3, 4).astype("float32")
+    y = rng.randn(3, 5).astype("float32")
+
+    def build():
+        return fluid.layers.bilinear_tensor_product(
+            _data("x", x), _data("y", y), size=2,
+            param_attr=fluid.ParamAttr(
+                name="btp.w",
+                initializer=fluid.initializer.Constant(0.1)),
+            bias_attr=fluid.ParamAttr(
+                name="btp.b",
+                initializer=fluid.initializer.Constant(0.5)))
+
+    got = run_layer(build, {"x": x, "y": y})
+    w = np.full((2, 4, 5), 0.1, "float32")
+    exp = np.einsum("bi,kij,bj->bk", x, w, y) + 0.5
+    np.testing.assert_allclose(got, exp, rtol=1e-5)
+
+
+def test_add_position_encoding():
+    rng = np.random.RandomState(12)
+    x = rng.randn(2, 3, 6).astype("float32")
+    got = run_layer(
+        lambda: fluid.layers.add_position_encoding(_data("x", x), 0.7, 0.3),
+        {"x": x})
+    half = 3
+    pe = np.zeros((3, 6), "float32")
+    for j in range(3):
+        for k in range(half):
+            v = j / np.power(10000.0, k / (half - 1))
+            pe[j, k] = np.sin(v)
+            pe[j, half + k] = np.cos(v)
+    np.testing.assert_allclose(got, 0.7 * x + 0.3 * pe[None], rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_row_conv():
+    rng = np.random.RandomState(13)
+    x = rng.randn(2, 5, 3).astype("float32")
+
+    def build():
+        return fluid.layers.row_conv(
+            _data("x", x), future_context_size=2,
+            param_attr=fluid.ParamAttr(
+                name="rc.w",
+                initializer=fluid.initializer.Constant(0.5)))
+
+    got = run_layer(build, {"x": x})
+    w = np.full((3, 3), 0.5, "float32")
+    exp = np.zeros_like(x)
+    for t in range(5):
+        for i in range(3):
+            if t + i < 5:
+                exp[:, t] += x[:, t + i] * w[i]
+    np.testing.assert_allclose(got, exp, rtol=1e-5)
+
+
+def test_spectral_norm_unit_sigma():
+    rng = np.random.RandomState(14)
+    w = rng.randn(6, 4).astype("float32")
+
+    def build():
+        return fluid.layers.spectral_norm(_data("w", w, False),
+                                          power_iters=50)
+
+    got = run_layer(build, {"w": w})
+    # after normalization the top singular value is ~1
+    s = np.linalg.svd(got, compute_uv=False)
+    np.testing.assert_allclose(s[0], 1.0, rtol=1e-3)
+
+
+def test_data_norm():
+    rng = np.random.RandomState(15)
+    x = rng.randn(8, 4).astype("float32")
+    got = run_layer(lambda: fluid.layers.data_norm(_data("x", x)), {"x": x})
+    # fresh stats: size=1e4, sum=0, sqsum=1e4 -> means 0, scales 1
+    np.testing.assert_allclose(got, x, rtol=1e-5)
+
+
+def test_hash_deterministic_in_range():
+    ids = np.array([[1, 2], [3, 4], [1, 2]], "int64")
+    a = run_layer(lambda: fluid.layers.hash(_data("i", ids), 1000, 2),
+                  {"i": ids})
+    b = run_layer(lambda: fluid.layers.hash(_data("i", ids), 1000, 2),
+                  {"i": ids})
+    np.testing.assert_array_equal(a, b)
+    assert a.min() >= 0 and a.max() < 1000
+    np.testing.assert_array_equal(a[0], a[2])  # same row -> same hash
+    assert not np.array_equal(a[0], a[1])
+
+
+def test_sampling_id_and_randoms():
+    probs = np.array([[0, 0, 1, 0], [1, 0, 0, 0]], "float32")
+    got = run_layer(lambda: fluid.layers.sampling_id(_data("p", probs)),
+                    {"p": probs})
+    np.testing.assert_array_equal(got, [2, 0])
+
+    x = np.zeros((5, 3), "float32")
+    got = run_layer(
+        lambda: fluid.layers.uniform_random_batch_size_like(
+            _data("x", x), shape=[-1, 7], min=2.0, max=3.0),
+        {"x": x})
+    assert got.shape == (5, 7) and got.min() >= 2.0 and got.max() <= 3.0
+    got = run_layer(
+        lambda: fluid.layers.gaussian_random_batch_size_like(
+            _data("x", x), shape=[-1, 9], mean=10.0, std=0.1),
+        {"x": x})
+    assert got.shape == (5, 9) and abs(got.mean() - 10.0) < 0.5
+
+
+def test_random_crop():
+    x = np.arange(64, dtype="float32").reshape(1, 8, 8)
+    got = run_layer(
+        lambda: fluid.layers.random_crop(_data("x", x), shape=[4, 4]),
+        {"x": x})
+    assert got.shape == (1, 4, 4)
+    # crop is a contiguous window: row deltas are 1, col deltas are 8
+    np.testing.assert_allclose(np.diff(got[0], axis=1), 1.0)
+    np.testing.assert_allclose(np.diff(got[0], axis=0), 8.0)
+
+
+def test_compositions_and_misc():
+    rng = np.random.RandomState(16)
+    probs = rng.rand(4, 3).astype("float32")
+    probs /= probs.sum(1, keepdims=True)
+    lab = rng.randint(0, 3, (4, 1)).astype("int64")
+    got = run_layer(
+        lambda: fluid.layers.dice_loss(_data("p", probs), _data("l", lab)),
+        {"p": probs, "l": lab})
+    assert got.shape in ((), (1,)) and 0.0 <= float(np.ravel(got)[0]) <= 1.0
+
+    a = rng.randn(4, 8).astype("float32")
+    p = rng.randn(4, 8).astype("float32")
+    labels = np.arange(4).astype("int64")
+    got = run_layer(
+        lambda: fluid.layers.npair_loss(
+            _data("a", a), _data("p", p), _data("l", labels)),
+        {"a": a, "p": p, "l": labels})
+    assert np.isfinite(got).all()
+
+    x = np.zeros((2, 3, 4), "float32")
+    got = run_layer(lambda: fluid.layers.rank(_data("x", x)), {"x": x})
+    assert int(np.ravel(got)[0]) == 3
+
+    xs = [rng.randn(3, 2).astype("float32") for _ in range(3)]
+    got = run_layer(
+        lambda: fluid.layers.sum(
+            [_data("s%d" % i, x) for i, x in enumerate(xs)]),
+        {"s%d" % i: x for i, x in enumerate(xs)})
+    np.testing.assert_allclose(got, np.sum(xs, axis=0), rtol=1e-5)
+
+    b = np.array([[True, False], [True, True]])
+    got = run_layer(lambda: fluid.layers.reduce_all(_data("b", b)), {"b": b})
+    assert not bool(np.ravel(got)[0])
+    got = run_layer(lambda: fluid.layers.reduce_any(_data("b", b)), {"b": b})
+    assert bool(np.ravel(got)[0])
+
+    x = np.array([7.0, -7.0], "float32")
+    y = np.array([3.0, 3.0], "float32")
+    got = run_layer(
+        lambda: fluid.layers.elementwise_mod(
+            _data("x", np.array([7, -7], "int64")),
+            _data("y", np.array([3, 3], "int64"))),
+        {"x": np.array([7, -7], "int64"), "y": np.array([3, 3], "int64")})
+    np.testing.assert_array_equal(got, [1, 2])  # python-style mod
+    got = run_layer(
+        lambda: fluid.layers.elementwise_floordiv(
+            _data("x", np.array([7, -7], "int64")),
+            _data("y", np.array([3, 3], "int64"))),
+        {"x": np.array([7, -7], "int64"), "y": np.array([3, 3], "int64")})
+    np.testing.assert_array_equal(got, [2, -3])
+
+
+def test_step_counter():
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        c = fluid.layers.autoincreased_step_counter()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        vals = [int(exe.run(main, fetch_list=[c])[0][0]) for _ in range(3)]
+    assert vals == [1, 2, 3]
+
+
+def test_grads_flow_through_new_ops():
+    """Spot grad-check: losses and samplers backprop into inputs."""
+    rng = np.random.RandomState(17)
+    x = rng.randn(3, 4).astype("float32")
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = _data("x", x, stop_gradient=False)
+        out = fluid.layers.selu(xv)
+        out = fluid.layers.reduce_sum(out)
+        (gx,) = fluid.backward.gradients(out, xv)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        gv = exe.run(main, feed={"x": x}, fetch_list=[gx])[0]
+    scale, alpha = 1.0507009873554805, 1.6732632423543772
+    exp = np.where(x > 0, scale, scale * alpha * np.exp(x))
+    np.testing.assert_allclose(gv, exp, rtol=1e-4)
