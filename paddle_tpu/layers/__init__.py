@@ -2,6 +2,7 @@
 
 from . import nn
 from . import nn_extra
+from . import nn_extra2
 from . import io
 from . import tensor
 from . import ops
@@ -16,6 +17,7 @@ from . import math_op_patch  # noqa: F401  (Variable operator overloads)
 
 from .nn import *  # noqa: F401,F403
 from .nn_extra import *  # noqa: F401,F403
+from .nn_extra2 import *  # noqa: F401,F403
 from .io import *  # noqa: F401,F403
 from .tensor import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
@@ -29,6 +31,7 @@ from .learning_rate_scheduler import *  # noqa: F401,F403
 __all__ = (
     nn.__all__
     + nn_extra.__all__
+    + nn_extra2.__all__
     + io.__all__
     + tensor.__all__
     + ops.__all__

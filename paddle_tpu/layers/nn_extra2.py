@@ -1,0 +1,662 @@
+"""Third wave of the layers.nn surface: RNN cells, CRF/CTC, sampled
+softmax family, 3-D conv/pool, sequence extras, CTR helpers (reference
+``python/paddle/fluid/layers/nn.py``)."""
+
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+from ..param_attr import ParamAttr
+from ..initializer import ConstantInitializer
+from .nn_extra import _simple
+
+__all__ = [
+    "lstm_unit", "gru_unit", "dynamic_lstmp", "lstm",
+    "linear_chain_crf", "crf_decoding", "chunk_eval",
+    "edit_distance", "ctc_greedy_decoder", "warpctc",
+    "nce", "hsigmoid", "sampled_softmax_with_cross_entropy",
+    "conv3d", "conv3d_transpose", "pool3d", "adaptive_pool2d",
+    "adaptive_pool3d",
+    "sequence_conv", "sequence_expand_as", "sequence_reshape",
+    "sequence_scatter",
+    "continuous_value_model", "get_tensor_from_selected_rows",
+    "merge_selected_rows", "py_func", "tree_conv", "similarity_focus",
+    "deformable_conv", "deformable_roi_pooling",
+]
+
+
+# ---- RNN cells ----------------------------------------------------------
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, forget_bias=0.0,
+              param_attr=None, bias_attr=None, name=None):
+    """reference nn.py lstm_unit: fc([x, h]) -> 4D gates -> lstm_unit op
+    (lstm_unit_op.h; gate order i,f,o,g)."""
+    from . import nn as _nn
+
+    d = cell_t_prev.shape[-1]
+    concat = _nn.concat([x_t, hidden_t_prev], axis=1)
+    gates = _nn.fc(concat, size=4 * d, param_attr=param_attr,
+                   bias_attr=bias_attr)
+    helper = LayerHelper("lstm_unit", **locals())
+    c = helper.create_variable_for_type_inference(x_t.dtype)
+    h = helper.create_variable_for_type_inference(x_t.dtype)
+    helper.append_op(
+        type="lstm_unit",
+        inputs={"X": [gates], "C_prev": [cell_t_prev]},
+        outputs={"C": [c], "H": [h]},
+        attrs={"forget_bias": float(forget_bias)},
+    )
+    return h, c
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid",
+             origin_mode=False):
+    """reference nn.py gru_unit → gru_unit_op.h; size = 3*D."""
+    helper = LayerHelper("gru_unit", **locals())
+    d = size // 3
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=[d, 3 * d], dtype=input.dtype,
+        is_bias=False)
+    inputs = {"Input": [input], "HiddenPrev": [hidden], "Weight": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(
+            attr=helper.bias_attr, shape=[1, 3 * d], dtype=input.dtype,
+            is_bias=True)
+        inputs["Bias"] = [b]
+    gate = helper.create_variable_for_type_inference(input.dtype, True)
+    rhp = helper.create_variable_for_type_inference(input.dtype, True)
+    hid = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="gru_unit", inputs=inputs,
+        outputs={"Gate": [gate], "ResetHiddenPrev": [rhp], "Hidden": [hid]},
+        attrs={"activation": activation, "gate_activation": gate_activation,
+               "origin_mode": origin_mode},
+    )
+    return hid, rhp, gate
+
+
+def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
+                  use_peepholes=False, is_reverse=False,
+                  gate_activation="sigmoid", cell_activation="tanh",
+                  candidate_activation="tanh", proj_activation="identity",
+                  dtype="float32", name=None, h_0=None, c_0=None,
+                  seq_len=None):
+    """reference nn.py dynamic_lstmp → lstmp_op.h.  Padded [B,T,4D]
+    pre-projected input + seq_len (LoD replacement); weight [P,4D],
+    projection [D,P]."""
+    helper = LayerHelper("dynamic_lstmp", **locals())
+    d = size // 4
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=[proj_size, 4 * d], dtype=dtype,
+        is_bias=False)
+    pw = helper.create_parameter(
+        attr=ParamAttr(name=(helper.param_attr.name + ".proj")
+                       if helper.param_attr.name else None),
+        shape=[d, proj_size], dtype=dtype, is_bias=False)
+    inputs = {"Input": [input], "Weight": [w], "ProjWeight": [pw]}
+    if bias_attr is not False:
+        b = helper.create_parameter(
+            attr=helper.bias_attr, shape=[1, 4 * d], dtype=dtype,
+            is_bias=True)
+        inputs["Bias"] = [b]
+    if h_0 is not None:
+        inputs["H0"] = [h_0]
+    if c_0 is not None:
+        inputs["C0"] = [c_0]
+    if seq_len is not None:
+        inputs["SeqLen"] = [seq_len]
+    proj = helper.create_variable_for_type_inference(dtype)
+    cell = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op(
+        type="dynamic_lstmp", inputs=inputs,
+        outputs={"Projection": [proj], "Cell": [cell]},
+        attrs={"gate_activation": gate_activation,
+               "cell_activation": cell_activation,
+               "candidate_activation": candidate_activation,
+               "proj_activation": proj_activation,
+               "is_reverse": is_reverse},
+    )
+    return proj, cell
+
+
+def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
+         dropout_prob=0.0, is_bidirec=False, is_test=False, name=None,
+         default_initializer=None, seed=-1):
+    """reference nn.py lstm (cudnn_lstm_op): multi-layer LSTM over padded
+    [B,T,D]; composed from the framework's lstm op per layer/direction
+    (XLA fuses the scan; there is no cuDNN algorithm surface)."""
+    from . import nn as _nn
+
+    from . import tensor as _tensor
+
+    helper = LayerHelper("cudnn_lstm", **locals())
+    x = input
+    ndirs = 2 if is_bidirec else 1
+    last_hs, last_cs = [], []
+    for layer in range(num_layers):
+        outs = []
+        for direction in range(ndirs):
+            gates = _nn.fc(
+                x, size=4 * hidden_size, num_flatten_dims=2,
+                param_attr=ParamAttr(
+                    name="%s_l%d_d%d.w" % (helper.name, layer, direction)),
+                bias_attr=ParamAttr(
+                    name="%s_l%d_d%d.b" % (helper.name, layer, direction)))
+            h = helper.create_variable_for_type_inference(input.dtype)
+            c = helper.create_variable_for_type_inference(input.dtype, True)
+            wh = helper.create_parameter(
+                attr=ParamAttr(
+                    name="%s_l%d_d%d.wh" % (helper.name, layer, direction)),
+                shape=[hidden_size, 4 * hidden_size], dtype=input.dtype,
+                is_bias=False)
+            inputs = {"Input": [gates], "Weight": [wh]}
+            slot = layer * ndirs + direction
+            if init_h is not None:
+                h0 = _nn.squeeze(_nn.slice(
+                    init_h, axes=[0], starts=[slot], ends=[slot + 1]),
+                    axes=[0])
+                inputs["H0"] = [h0]
+            if init_c is not None:
+                c0 = _nn.squeeze(_nn.slice(
+                    init_c, axes=[0], starts=[slot], ends=[slot + 1]),
+                    axes=[0])
+                inputs["C0"] = [c0]
+            helper.append_op(
+                type="lstm",
+                inputs=inputs,
+                outputs={"Hidden": [h], "Cell": [c]},
+                attrs={"is_reverse": direction == 1},
+            )
+            outs.append(h)
+            # final state: last valid step of the scan (step 0 of a
+            # reversed direction, since outputs are re-flipped)
+            t_last = 0 if direction == 1 else (input.shape[1] - 1)
+            for seq, acc in ((h, last_hs), (c, last_cs)):
+                v = _nn.squeeze(_nn.slice(
+                    seq, axes=[1], starts=[t_last], ends=[t_last + 1]),
+                    axes=[1])
+                acc.append(v)
+        x = outs[0] if len(outs) == 1 else _nn.concat(outs, axis=2)
+        if dropout_prob and not is_test:
+            x = _nn.dropout(x, dropout_prob,
+                            dropout_implementation="upscale_in_train")
+    last_h = _nn.stack(last_hs, axis=0)  # [L*dirs, B, D]
+    last_c = _nn.stack(last_cs, axis=0)
+    return x, last_h, last_c
+
+
+# ---- CRF / CTC ----------------------------------------------------------
+
+def linear_chain_crf(input, label, param_attr=None, length=None):
+    """reference nn.py linear_chain_crf → linear_chain_crf_op.h; padded
+    [B,T,D] emissions + length tensor (LoD replacement)."""
+    helper = LayerHelper("linear_chain_crf", **locals())
+    num_tags = input.shape[-1]
+    transition = helper.create_parameter(
+        attr=helper.param_attr, shape=[num_tags + 2, num_tags],
+        dtype=input.dtype, is_bias=False)
+    inputs = {"Emission": [input], "Transition": [transition],
+              "Label": [label]}
+    if length is not None:
+        inputs["Length"] = [length]
+    alpha = helper.create_variable_for_type_inference(input.dtype, True)
+    ee = helper.create_variable_for_type_inference(input.dtype, True)
+    te = helper.create_variable_for_type_inference(input.dtype, True)
+    ll = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="linear_chain_crf", inputs=inputs,
+        outputs={"Alpha": [alpha], "EmissionExps": [ee],
+                 "TransitionExps": [te], "LogLikelihood": [ll]},
+    )
+    return ll
+
+
+def crf_decoding(input, param_attr, label=None, length=None):
+    """reference nn.py crf_decoding → crf_decoding_op.h (viterbi)."""
+    helper = LayerHelper("crf_decoding", **locals())
+    transition = helper.main_program.global_block().var(param_attr.name)
+    inputs = {"Emission": [input], "Transition": [transition]}
+    if label is not None:
+        inputs["Label"] = [label]
+    if length is not None:
+        inputs["Length"] = [length]
+    path = helper.create_variable_for_type_inference("int64", True)
+    helper.append_op(
+        type="crf_decoding", inputs=inputs,
+        outputs={"ViterbiPath": [path]},
+    )
+    return path
+
+
+def chunk_eval(input, label, chunk_scheme, num_chunk_types,
+               excluded_chunk_types=None, seq_length=None):
+    """reference nn.py chunk_eval → chunk_eval_op.h"""
+    helper = LayerHelper("chunk_eval", **locals())
+    outs = {}
+    names = ["Precision", "Recall", "F1-Score", "NumInferChunks",
+             "NumLabelChunks", "NumCorrectChunks"]
+    ret = []
+    for nm in names:
+        dt = "float32" if nm in ("Precision", "Recall", "F1-Score") \
+            else "int64"
+        v = helper.create_variable_for_type_inference(dt, True)
+        outs[nm] = [v]
+        ret.append(v)
+    inputs = {"Inference": [input], "Label": [label]}
+    if seq_length is not None:
+        inputs["SeqLength"] = [seq_length]
+    helper.append_op(
+        type="chunk_eval", inputs=inputs, outputs=outs,
+        attrs={"chunk_scheme": chunk_scheme,
+               "num_chunk_types": int(num_chunk_types),
+               "excluded_chunk_types": list(excluded_chunk_types or [])},
+    )
+    return tuple(ret)
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None):
+    """reference nn.py edit_distance → edit_distance_op.h (padded)."""
+    out, seq_num = _simple(
+        "edit_distance",
+        {"Hyps": input, "Refs": label, "HypsLength": input_length,
+         "RefsLength": label_length},
+        {"normalized": bool(normalized),
+         "ignored_tokens": [int(t) for t in (ignored_tokens or [])]},
+        out_dtype="float32", outs=("Out", "SequenceNum"),
+        stop_gradient=True)
+    return out, seq_num
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0,
+                       name=None):
+    """reference nn.py ctc_greedy_decoder: argmax + ctc_align collapse
+    (ctc_align_op.h); padded [B,T,C] probs + lengths."""
+    from . import nn as _nn
+
+    ids = _nn.argmax(input, axis=-1)
+    out, out_len = _simple(
+        "ctc_align", {"Input": ids, "InputLength": input_length},
+        {"blank": int(blank), "padding_value": int(padding_value)},
+        out_dtype="int64", outs=("Output", "OutputLength"),
+        stop_gradient=True)
+    return out, out_len
+
+
+def warpctc(input, label, blank=0, norm_by_times=False,
+            input_length=None, label_length=None):
+    """reference nn.py warpctc → warpctc_op (padded logits [B,T,C] +
+    labels [B,L] + length tensors; softmax applied inside like
+    warp-ctc)."""
+    grad, loss = _simple(
+        "warpctc",
+        {"Logits": input, "Label": label, "LogitsLength": input_length,
+         "LabelLength": label_length},
+        {"blank": int(blank), "norm_by_times": bool(norm_by_times)},
+        outs=("WarpCTCGrad", "Loss"))
+    return loss
+
+
+# ---- sampled softmax family --------------------------------------------
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """reference nn.py nce → nce_op.h"""
+    helper = LayerHelper("nce", **locals())
+    dim = input.shape[-1]
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=[num_total_classes, dim],
+        dtype=input.dtype, is_bias=False)
+    inputs = {"Input": [input], "Label": [label], "Weight": [w]}
+    if bias_attr is not False:
+        b = helper.create_parameter(
+            attr=helper.bias_attr, shape=[num_total_classes, 1],
+            dtype=input.dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    if sample_weight is not None:
+        inputs["SampleWeight"] = [sample_weight]
+    cost = helper.create_variable_for_type_inference(input.dtype)
+    sl = helper.create_variable_for_type_inference(input.dtype, True)
+    slab = helper.create_variable_for_type_inference("int64", True)
+    sampler_id = {"uniform": 0, "log_uniform": 1}.get(sampler, 0)
+    helper.append_op(
+        type="nce", inputs=inputs,
+        outputs={"Cost": [cost], "SampleLogits": [sl],
+                 "SampleLabels": [slab]},
+        attrs={"num_total_classes": int(num_total_classes),
+               "num_neg_samples": int(num_neg_samples),
+               "sampler": sampler_id, "seed": seed},
+    )
+    return cost
+
+
+def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
+             name=None, path_table=None, path_code=None,
+             is_custom=False, is_sparse=False):
+    """reference nn.py hsigmoid → hierarchical_sigmoid_op.h (complete
+    binary SimpleCode tree; custom trees unsupported on TPU — static
+    shapes need the default tree)."""
+    if is_custom or path_table is not None:
+        raise NotImplementedError(
+            "hsigmoid custom trees: the SimpleCode complete binary tree "
+            "is the TPU-static path")
+    helper = LayerHelper("hierarchical_sigmoid", **locals())
+    dim = input.shape[-1]
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=[num_classes - 1, dim],
+        dtype=input.dtype, is_bias=False)
+    inputs = {"X": [input], "W": [w], "Label": [label]}
+    if bias_attr is not False:
+        b = helper.create_parameter(
+            attr=helper.bias_attr, shape=[num_classes - 1, 1],
+            dtype=input.dtype, is_bias=True)
+        inputs["Bias"] = [b]
+    out = helper.create_variable_for_type_inference(input.dtype)
+    pre = helper.create_variable_for_type_inference(input.dtype, True)
+    helper.append_op(
+        type="hierarchical_sigmoid", inputs=inputs,
+        outputs={"Out": [out], "PreOut": [pre]},
+        attrs={"num_classes": int(num_classes)},
+    )
+    return out
+
+
+def sampled_softmax_with_cross_entropy(logits, label, num_samples,
+                                       num_true=1, remove_accidental_hits=True,
+                                       use_customized_samples=False,
+                                       customized_samples=None,
+                                       customized_probabilities=None,
+                                       seed=0):
+    """reference nn.py sampled_softmax_with_cross_entropy →
+    sample_logits_op + softmax pipeline (single fused op here)."""
+    _, loss = _simple(
+        "sampled_softmax_with_cross_entropy",
+        {"Logits": logits, "Label": label},
+        {"num_samples": int(num_samples), "seed": seed},
+        outs=("Softmax", "Loss"))
+    return loss
+
+
+# ---- 3-D conv / pool ----------------------------------------------------
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=None, param_attr=None, bias_attr=None,
+           use_cudnn=True, act=None, name=None):
+    """reference nn.py conv3d → conv_op.cc 3-D registration."""
+    helper = LayerHelper("conv3d", **locals())
+    dtype = helper.input_dtype()
+
+    def triple(v):
+        return [int(v)] * 3 if isinstance(v, int) else [int(a) for a in v]
+
+    stride, padding, dilation = (triple(stride), triple(padding),
+                                 triple(dilation))
+    filter_size = triple(filter_size)
+    c_in = input.shape[1]
+    g = groups or 1
+    w = helper.create_parameter(
+        attr=helper.param_attr,
+        shape=[num_filters, c_in // g] + filter_size, dtype=dtype,
+        is_bias=False)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="conv3d",
+        inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": stride, "paddings": padding,
+               "dilations": dilation, "groups": g},
+    )
+    pre_act = helper.append_bias_op(out, dim_start=1)
+    return helper.append_activation(pre_act)
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    """reference nn.py conv3d_transpose → conv_transpose_op.cc 3-D."""
+    helper = LayerHelper("conv3d_transpose", **locals())
+    dtype = helper.input_dtype()
+
+    def triple(v):
+        return [int(v)] * 3 if isinstance(v, int) else [int(a) for a in v]
+
+    stride, padding, dilation = (triple(stride), triple(padding),
+                                 triple(dilation))
+    filter_size = triple(filter_size)
+    c_in = input.shape[1]
+    w = helper.create_parameter(
+        attr=helper.param_attr,
+        shape=[c_in, num_filters] + filter_size, dtype=dtype,
+        is_bias=False)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="conv3d_transpose",
+        inputs={"Input": [input], "Filter": [w]},
+        outputs={"Output": [out]},
+        attrs={"strides": stride, "paddings": padding,
+               "dilations": dilation},
+    )
+    pre_act = helper.append_bias_op(out, dim_start=1)
+    return helper.append_activation(pre_act)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, name=None, exclusive=True):
+    """reference nn.py pool3d → pool_op.cc 3-D."""
+    def triple(v):
+        return [int(v)] * 3 if isinstance(v, int) else [int(a) for a in v]
+
+    return _simple(
+        "pool3d", {"X": input},
+        {"pooling_type": pool_type, "ksize": triple(pool_size),
+         "strides": triple(pool_stride), "paddings": triple(pool_padding),
+         "global_pooling": global_pooling, "exclusive": exclusive})
+
+
+def adaptive_pool2d(input, pool_size, pool_type="max",
+                    require_index=False, name=None):
+    """reference nn.py adaptive_pool2d → pool_op adaptive attr."""
+    if require_index:
+        raise NotImplementedError("adaptive_pool2d(require_index=True)")
+
+    def pair(v):
+        return [int(v)] * 2 if isinstance(v, int) else [int(a) for a in v]
+
+    return _simple(
+        "pool2d", {"X": input},
+        {"pooling_type": pool_type, "ksize": pair(pool_size),
+         "adaptive": True, "strides": [1, 1], "paddings": [0, 0]})
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max",
+                    require_index=False, name=None):
+    """reference nn.py adaptive_pool3d → pool_op adaptive attr."""
+    if require_index:
+        raise NotImplementedError("adaptive_pool3d(require_index=True)")
+
+    def triple(v):
+        return [int(v)] * 3 if isinstance(v, int) else [int(a) for a in v]
+
+    return _simple(
+        "pool3d", {"X": input},
+        {"pooling_type": pool_type, "ksize": triple(pool_size),
+         "adaptive": True, "strides": [1, 1, 1], "paddings": [0, 0, 0]})
+
+
+# ---- sequence extras ----------------------------------------------------
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None, seq_len=None):
+    """reference nn.py sequence_conv → sequence_conv_op.h (padded
+    [B,T,D] + seq_len)."""
+    helper = LayerHelper("sequence_conv", **locals())
+    dtype = helper.input_dtype()
+    d = input.shape[-1]
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=[filter_size * d, num_filters],
+        dtype=dtype, is_bias=False)
+    start = (-int(filter_size // 2) if padding_start is None
+             else int(padding_start))
+    out = helper.create_variable_for_type_inference(dtype)
+    inputs = {"X": [input], "Filter": [w]}
+    if seq_len is not None:
+        inputs["SeqLen"] = [seq_len]
+    helper.append_op(
+        type="sequence_conv", inputs=inputs, outputs={"Out": [out]},
+        attrs={"contextLength": int(filter_size),
+               "contextStart": start, "contextStride": int(filter_stride)},
+    )
+    pre_act = helper.append_bias_op(out, dim_start=2)
+    return helper.append_activation(pre_act)
+
+
+def sequence_expand_as(x, y, ref_len=None, name=None):
+    """reference nn.py sequence_expand_as (padded: x [B,D] rows repeated
+    to y's [B,T,...] time extent, masked by ref_len)."""
+    return _simple("sequence_expand_as",
+                   {"X": x, "Y": y, "RefLen": ref_len})
+
+
+def sequence_reshape(input, new_dim):
+    """reference nn.py sequence_reshape → sequence_reshape_op.h"""
+    return _simple("sequence_reshape", {"X": input},
+                   {"new_dim": int(new_dim)})
+
+
+def sequence_scatter(input, index, updates, seq_len=None, name=None):
+    """reference nn.py sequence_scatter → sequence_scatter_op.h"""
+    return _simple("sequence_scatter",
+                   {"X": input, "Ids": index, "Updates": updates,
+                    "SeqLen": seq_len})
+
+
+# ---- CTR / misc ---------------------------------------------------------
+
+def continuous_value_model(input, cvm, use_cvm=True):
+    """reference nn.py continuous_value_model → cvm_op.cc"""
+    return _simple("cvm", {"X": input, "CVM": cvm},
+                   {"use_cvm": bool(use_cvm)}, outs=("Y",))
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    """reference nn.py get_tensor_from_selected_rows (identity on TPU:
+    SelectedRows subsumed by dense scatter-add grads)."""
+    return _simple("get_tensor_from_selected_rows", {"X": x})
+
+
+def merge_selected_rows(x, name=None):
+    """reference nn.py merge_selected_rows (identity on TPU)."""
+    return _simple("merge_selected_rows", {"X": x})
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """reference nn.py py_func → py_func_op.cc.  `out` must be variables
+    with static shapes (created via create_variable/data); backward_func
+    is not supported (host grads break the jit boundary)."""
+    if backward_func is not None:
+        raise NotImplementedError(
+            "py_func backward_func: host-side gradients are not "
+            "representable under jit; compute grads in-graph instead")
+    from ..ops import py_func_registry
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    specs = [(tuple(o.shape), o.dtype) for o in outs]
+    fid = py_func_registry.register(func, specs)
+    helper = LayerHelper("py_func")
+    helper.append_op(
+        type="py_func",
+        inputs={"X": list(xs)},
+        outputs={"Out": list(outs)},
+        attrs={"func_id": fid},
+    )
+    return out
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+              name=None):
+    """reference nn.py tree_conv → tree_conv_op.h (simplified continuous
+    binary-tree aggregation)."""
+    helper = LayerHelper("tree_conv", **locals())
+    dtype = helper.input_dtype("nodes_vector")
+    d = nodes_vector.shape[-1]
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=[d, output_size, 3], dtype=dtype,
+        is_bias=False)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(
+        type="tree_conv",
+        inputs={"NodesVector": [nodes_vector], "EdgeSet": [edge_set],
+                "Filter": [w]},
+        outputs={"Out": [out]},
+        attrs={"max_depth": int(max_depth)},
+    )
+    return out
+
+
+def similarity_focus(input, axis, indexes, name=None):
+    """reference nn.py similarity_focus → similarity_focus_op.h"""
+    return _simple("similarity_focus", {"X": input},
+                   {"axis": int(axis), "indexes": [int(i) for i in indexes]})
+
+
+def deformable_conv(input, offset, mask, num_filters, filter_size,
+                    stride=1, padding=0, dilation=1, groups=None,
+                    deformable_groups=None, im2col_step=None,
+                    param_attr=None, bias_attr=None, modulated=True,
+                    name=None):
+    """reference nn.py deformable_conv → deformable_conv_op (v2
+    modulated; v1 with mask=None)."""
+    helper = LayerHelper("deformable_conv", **locals())
+    dtype = helper.input_dtype()
+
+    def pair(v):
+        return [int(v)] * 2 if isinstance(v, int) else [int(a) for a in v]
+
+    fs = pair(filter_size)
+    c_in = input.shape[1]
+    g = groups or 1
+    w = helper.create_parameter(
+        attr=helper.param_attr, shape=[num_filters, c_in // g] + fs,
+        dtype=dtype, is_bias=False)
+    out = helper.create_variable_for_type_inference(dtype)
+    inputs = {"Input": [input], "Offset": [offset], "Filter": [w]}
+    if modulated and mask is not None:
+        inputs["Mask"] = [mask]
+    helper.append_op(
+        type="deformable_conv", inputs=inputs,
+        outputs={"Output": [out]},
+        attrs={"strides": pair(stride), "paddings": pair(padding),
+               "dilations": pair(dilation), "groups": g,
+               "deformable_groups": deformable_groups or 1},
+    )
+    pre_act = helper.append_bias_op(out, dim_start=1)
+    return helper.append_activation(pre_act)
+
+
+def deformable_roi_pooling(input, rois, trans, no_trans=False,
+                           spatial_scale=1.0, group_size=(1, 1),
+                           pooled_height=1, pooled_width=1, part_size=None,
+                           sample_per_part=1, trans_std=0.1, position_sensitive=True,
+                           name=None):
+    """reference nn.py deformable_roi_pooling →
+    deformable_psroi_pooling_op."""
+    out_dim = input.shape[1] // (pooled_height * pooled_width) \
+        if position_sensitive else input.shape[1]
+    out, _ = _simple(
+        "deformable_psroi_pooling",
+        {"Input": input, "ROIs": rois,
+         "Trans": None if no_trans else trans},
+        {"spatial_scale": float(spatial_scale),
+         "pooled_height": int(pooled_height),
+         "pooled_width": int(pooled_width),
+         "output_dim": int(out_dim),
+         "trans_std": float(trans_std),
+         "sample_per_part": int(sample_per_part),
+         "no_trans": bool(no_trans)},
+        outs=("Output", "TopCount"))
+    return out

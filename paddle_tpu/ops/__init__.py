@@ -35,3 +35,5 @@ from . import beam_search  # noqa: F401
 from . import quantize  # noqa: F401
 from . import vision  # noqa: F401
 from . import losses  # noqa: F401
+from . import crf_ctc  # noqa: F401
+from . import misc  # noqa: F401

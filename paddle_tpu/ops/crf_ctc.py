@@ -1,0 +1,303 @@
+"""Linear-chain CRF, CTC, and edit-distance lowerings.
+
+Reference kernels: ``paddle/fluid/operators/linear_chain_crf_op.h`` (alpha
+recursion with L1 renormalization), ``crf_decoding_op.h`` (viterbi),
+``warpctc_op.*`` (external warp-ctc), ``edit_distance_op.h``,
+``ctc_align_op.h``.  TPU redesign: ragged LoD batches become padded
+[B,T,...] + length tensors; every dynamic recursion is a lax.scan in log
+space (no L1-renorm trick needed — logsumexp is stable), so the losses are
+differentiable by jax.vjp instead of hand-written grad kernels."""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+NEG = -1e30
+
+
+def _len_mask(T, lengths):
+    # [T, B] step-active mask
+    return jnp.arange(T)[:, None] < lengths[None, :]
+
+
+@register_op("linear_chain_crf",
+             inputs=["Emission", "Transition", "Label", "Length"],
+             outputs=["Alpha", "EmissionExps", "TransitionExps",
+                      "LogLikelihood"],
+             stateful_outputs=("Alpha", "EmissionExps", "TransitionExps"))
+def linear_chain_crf(ctx, attrs, Emission, Transition, Label, Length):
+    """Negative log-likelihood of a linear-chain CRF
+    (linear_chain_crf_op.h ForwardOneSequence): returns logZ - gold_score
+    per sequence.  Transition row 0 = start weights, row 1 = end weights,
+    rows 2.. = state transitions w[j+2, i] = score(j -> i).
+    Padded [B,T,D] emissions + Length[B] replace the reference's LoD."""
+    B, T, D = Emission.shape
+    w_start = Transition[0]
+    w_end = Transition[1]
+    w_trans = Transition[2:]  # [D, D], [from, to]
+    lengths = (jnp.reshape(Length, (-1,)).astype(jnp.int32)
+               if Length is not None else jnp.full((B,), T, jnp.int32))
+    labels = jnp.reshape(Label, (B, T)).astype(jnp.int32)
+    em_t = jnp.moveaxis(Emission, 1, 0)  # [T, B, D]
+    lab_t = jnp.moveaxis(labels, 1, 0)   # [T, B]
+    mask = _len_mask(T, lengths)         # [T, B]
+
+    # --- logZ by alpha recursion in log space ---
+    alpha0 = w_start[None, :] + em_t[0]  # [B, D]
+
+    def step(carry, xt):
+        alpha = carry
+        em, m = xt  # [B, D], [B]
+        nxt = jax.nn.logsumexp(
+            alpha[:, :, None] + w_trans[None, :, :], axis=1) + em
+        alpha = jnp.where(m[:, None], nxt, alpha)
+        return alpha, alpha
+
+    alpha_last, alphas = jax.lax.scan(step, alpha0, (em_t[1:], mask[1:]))
+    logz = jax.nn.logsumexp(alpha_last + w_end[None, :], axis=1)  # [B]
+
+    # --- gold path score ---
+    t_idx = jnp.arange(T)
+    em_lab = jnp.take_along_axis(
+        Emission, labels[:, :, None], axis=2)[:, :, 0]  # [B, T]
+    em_score = jnp.sum(jnp.where(mask.T, em_lab, 0.0), axis=1)
+    trans_lab = w_trans[labels[:, :-1], labels[:, 1:]]  # [B, T-1]
+    trans_score = jnp.sum(
+        jnp.where(mask.T[:, 1:], trans_lab, 0.0), axis=1)
+    last_idx = jnp.maximum(lengths - 1, 0)
+    last_lab = jnp.take_along_axis(labels, last_idx[:, None], axis=1)[:, 0]
+    gold = (w_start[labels[:, 0]] + em_score + trans_score
+            + w_end[last_lab])
+    ll = (logz - gold)[:, None]  # [B, 1], reference sign (NLL)
+    alphas_full = jnp.concatenate(
+        [alpha0[None], alphas], axis=0)  # [T, B, D]
+    return {
+        "LogLikelihood": ll,
+        "Alpha": jnp.moveaxis(alphas_full, 0, 1),
+        "EmissionExps": jnp.exp(
+            Emission - jnp.max(Emission, axis=2, keepdims=True)),
+        "TransitionExps": jnp.exp(Transition),
+    }
+
+
+@register_op("crf_decoding",
+             inputs=["Emission", "Transition", "Label", "Length"],
+             outputs=["ViterbiPath"], no_grad=True)
+def crf_decoding(ctx, attrs, Emission, Transition, Label, Length):
+    """Viterbi decode (crf_decoding_op.h).  Output: [B, T] best tag ids
+    (padded steps 0); with Label given, outputs 1 where the label
+    DISAGREES with the viterbi path is the reference convention inverted —
+    the reference emits 1 for correct tags; we match it."""
+    B, T, D = Emission.shape
+    w_start = Transition[0]
+    w_end = Transition[1]
+    w_trans = Transition[2:]
+    lengths = (jnp.reshape(Length, (-1,)).astype(jnp.int32)
+               if Length is not None else jnp.full((B,), T, jnp.int32))
+    em_t = jnp.moveaxis(Emission, 1, 0)
+    mask = _len_mask(T, lengths)
+
+    v0 = w_start[None, :] + em_t[0]
+
+    def step(carry, xt):
+        v = carry
+        em, m = xt
+        scores = v[:, :, None] + w_trans[None, :, :]  # [B, from, to]
+        best = jnp.max(scores, axis=1) + em
+        back = jnp.argmax(scores, axis=1)  # [B, D]
+        v = jnp.where(m[:, None], best, v)
+        return v, (back, m)
+
+    v_last, (backs, ms) = jax.lax.scan(step, v0, (em_t[1:], mask[1:]))
+    # add end weights at each sequence's true last position: emulate by
+    # adding w_end to v_last (v_last holds the value at position len-1)
+    v_final = v_last + w_end[None, :]
+    last_tag = jnp.argmax(v_final, axis=1)  # [B]
+
+    def backtrack(carry, xt):
+        tag = carry
+        back, m = xt
+        prev = jnp.take_along_axis(back, tag[:, None], axis=1)[:, 0]
+        tag = jnp.where(m, prev, tag)
+        return tag, tag
+
+    _, path_rev = jax.lax.scan(
+        backtrack, last_tag, (backs, ms), reverse=True)
+    path = jnp.concatenate([path_rev, last_tag[None]], axis=0)  # [T, B]
+    path = jnp.moveaxis(path, 0, 1)
+    path = jnp.where(mask.T, path, 0)
+    if Label is not None:
+        lab = jnp.reshape(Label, (B, T)).astype(path.dtype)
+        return jnp.where(mask.T, (lab == path).astype(jnp.int64), 0)
+    return path.astype(jnp.int64)
+
+
+@register_op("edit_distance", inputs=["Hyps", "Refs", "HypsLength",
+                                      "RefsLength"],
+             outputs=["Out", "SequenceNum"], no_grad=True)
+def edit_distance(ctx, attrs, Hyps, Refs, HypsLength, RefsLength):
+    """Levenshtein distance per sequence pair (edit_distance_op.h), DP
+    rows scanned over hypothesis positions; padded [B, L] + lengths."""
+    B, L1 = Hyps.shape[0], Hyps.shape[1]
+    L2 = Refs.shape[1]
+    hl = jnp.reshape(HypsLength, (-1,)).astype(jnp.int32) \
+        if HypsLength is not None else jnp.full((B,), L1, jnp.int32)
+    rl = jnp.reshape(RefsLength, (-1,)).astype(jnp.int32) \
+        if RefsLength is not None else jnp.full((B,), L2, jnp.int32)
+    hyps = jnp.reshape(Hyps, (B, L1))
+    refs = jnp.reshape(Refs, (B, L2))
+    ignored = [int(t) for t in attrs.get("ignored_tokens", []) or []]
+    if ignored:
+        # erase ignored tokens (reference erases them before the DP):
+        # left-pack the kept tokens and shrink the lengths
+        ig = jnp.asarray(ignored, jnp.int32)
+
+        def compact(seq, lens, L):
+            in_range = jnp.arange(L)[None, :] < lens[:, None]
+            keep = (~jnp.isin(seq.astype(jnp.int32), ig)) & in_range
+            pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+            safe_pos = jnp.where(keep, pos, L - 1)
+            packed = jax.vmap(
+                lambda s, p, k: jnp.zeros((L,), seq.dtype).at[p].set(
+                    jnp.where(k, s, 0)))(seq, safe_pos, keep)
+            return packed, jnp.sum(keep, axis=1).astype(jnp.int32)
+
+        hyps, hl = compact(hyps, hl, L1)
+        refs, rl = compact(refs, rl, L2)
+    cols = jnp.arange(L2 + 1, dtype=jnp.float32)
+    row0 = jnp.broadcast_to(cols, (B, L2 + 1))
+
+    def step(carry, xt):
+        prev_row, i = carry
+        h = xt  # [B] hyp tokens at position i
+        active = i < hl  # [B]
+        sub_cost = (refs != h[:, None]).astype(jnp.float32)  # [B, L2]
+        # new_row[0] = i+1
+        def inner(c, xs):
+            left = c  # new_row[j-1]
+            up, diag, sc = xs  # prev_row[j], prev_row[j-1], sub cost
+            val = jnp.minimum(jnp.minimum(up + 1, left + 1), diag + sc)
+            return val, val
+
+        first = jnp.full((B,), 0.0) + (i + 1)
+        _, rest = jax.lax.scan(
+            inner, first,
+            (prev_row[:, 1:].T, prev_row[:, :-1].T, sub_cost.T))
+        new_row = jnp.concatenate([first[:, None], rest.T], axis=1)
+        new_row = jnp.where(active[:, None], new_row, prev_row)
+        return (new_row, i + 1), None
+
+    (final_row, _), _ = jax.lax.scan(
+        step, (row0, jnp.asarray(0, jnp.int32)), hyps.T)
+    dist = jnp.take_along_axis(final_row, rl[:, None], axis=1)  # [B,1]
+    # empty-ref convention (reference): distance = hyp length
+    dist = jnp.where((rl == 0)[:, None], hl[:, None].astype(jnp.float32),
+                     dist)
+    if attrs.get("normalized", True):
+        dist = dist / jnp.maximum(rl[:, None].astype(jnp.float32), 1.0)
+    return {"Out": dist, "SequenceNum": jnp.asarray([B], jnp.int64)}
+
+
+@register_op("ctc_align", inputs=["Input", "InputLength"],
+             outputs=["Output", "OutputLength"], no_grad=True,
+             stateful_outputs=("OutputLength",))
+def ctc_align(ctx, attrs, Input, InputLength):
+    """CTC greedy post-processing (ctc_align_op.h): collapse repeats,
+    strip blanks, left-pack; padded [B, T] + lengths; padding value fills
+    the tail (attr padding_value, default 0)."""
+    blank = int(attrs.get("blank", 0))
+    pad_val = int(attrs.get("padding_value", 0))
+    B, T = Input.shape[0], Input.shape[1]
+    x = jnp.reshape(Input, (B, T)).astype(jnp.int32)
+    lengths = (jnp.reshape(InputLength, (-1,)).astype(jnp.int32)
+               if InputLength is not None
+               else jnp.full((B,), T, jnp.int32))
+    in_range = jnp.arange(T)[None, :] < lengths[:, None]
+    prev = jnp.concatenate(
+        [jnp.full((B, 1), -1, jnp.int32), x[:, :-1]], axis=1)
+    keep = (x != blank) & (x != prev) & in_range  # [B, T]
+    # left-pack kept tokens: target position = cumsum(keep)-1
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    out_len = jnp.maximum(pos[:, -1] + 1, 0) * (
+        jnp.sum(keep, axis=1) > 0).astype(jnp.int32)
+    out = jnp.full((B, T), pad_val, jnp.int32)
+    # scatter kept tokens to packed positions
+    safe_pos = jnp.where(keep, pos, T - 1)
+    dummy = jnp.full((B, T), pad_val, jnp.int32)
+    vals = jnp.where(keep, x, pad_val)
+    out = jax.vmap(
+        lambda o, p, v, k: o.at[p].set(jnp.where(k, v, o[p]))
+    )(dummy, safe_pos, vals, keep)
+    return {"Output": out.astype(jnp.int64),
+            "OutputLength": out_len[:, None].astype(jnp.int64)}
+
+
+@register_op("warpctc", inputs=["Logits", "Label", "LogitsLength",
+                                "LabelLength"],
+             outputs=["WarpCTCGrad", "Loss"],
+             stateful_outputs=("WarpCTCGrad",))
+def warpctc(ctx, attrs, Logits, Label, LogitsLength, LabelLength):
+    """CTC loss (warpctc_op.*; the reference links Baidu warp-ctc — here
+    the standard log-space alpha recursion as a lax.scan, differentiable
+    by jax.vjp, so no hand-written gradient kernel is needed).
+    Padded convention: Logits [B, T, C] activations (softmax applied
+    internally, like warp-ctc), Label [B, L] (padded with blank), plus
+    length tensors."""
+    blank = int(attrs.get("blank", 0))
+    norm_by_times = bool(attrs.get("norm_by_times", False))
+    B, T, C = Logits.shape
+    L = Label.shape[1]
+    log_probs = jax.nn.log_softmax(Logits.astype(jnp.float32), axis=2)
+    lab = jnp.reshape(Label, (B, L)).astype(jnp.int32)
+    tl = (jnp.reshape(LogitsLength, (-1,)).astype(jnp.int32)
+          if LogitsLength is not None else jnp.full((B,), T, jnp.int32))
+    ll = (jnp.reshape(LabelLength, (-1,)).astype(jnp.int32)
+          if LabelLength is not None else jnp.full((B,), L, jnp.int32))
+
+    # extended sequence: blank y1 blank y2 ... blank  -> S = 2L+1
+    S = 2 * L + 1
+    ext = jnp.full((B, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(lab)
+    s_idx = jnp.arange(S)
+    s_active = s_idx[None, :] < (2 * ll + 1)[:, None]  # [B, S]
+    # allow diagonal skip when ext[s] != blank and ext[s] != ext[s-2]
+    ext_m2 = jnp.concatenate(
+        [jnp.full((B, 2), -1, jnp.int32), ext[:, :-2]], axis=1)
+    can_skip = (ext != blank) & (ext != ext_m2)
+
+    lp_t = jnp.moveaxis(log_probs, 1, 0)  # [T, B, C]
+
+    def emit(lp):
+        return jnp.take_along_axis(lp, ext, axis=1)  # [B, S]
+
+    alpha0 = jnp.full((B, S), NEG)
+    alpha0 = alpha0.at[:, 0].set(emit(lp_t[0])[:, 0])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(ll > 0, emit(lp_t[0])[:, 1], NEG))
+
+    def step(carry, xt):
+        alpha, t = carry
+        lp = xt
+        a_prev1 = jnp.concatenate(
+            [jnp.full((B, 1), NEG), alpha[:, :-1]], axis=1)
+        a_prev2 = jnp.concatenate(
+            [jnp.full((B, 2), NEG), alpha[:, :-2]], axis=1)
+        a_prev2 = jnp.where(can_skip, a_prev2, NEG)
+        nxt = jnp.logaddexp(
+            jnp.logaddexp(alpha, a_prev1), a_prev2) + emit(lp)
+        nxt = jnp.where(s_active, nxt, NEG)
+        active_t = (t < tl)[:, None]
+        alpha = jnp.where(active_t, nxt, alpha)
+        return (alpha, t + 1), None
+
+    (alpha_T, _), _ = jax.lax.scan(
+        step, (alpha0, jnp.asarray(1, jnp.int32)), lp_t[1:])
+    end1 = jnp.take_along_axis(alpha_T, (2 * ll)[:, None], axis=1)[:, 0]
+    end2 = jnp.take_along_axis(
+        alpha_T, jnp.maximum(2 * ll - 1, 0)[:, None], axis=1)[:, 0]
+    nll = -jnp.logaddexp(end1, end2)  # [B]
+    if norm_by_times:
+        nll = nll / jnp.maximum(tl.astype(jnp.float32), 1.0)
+    return {"Loss": nll[:, None],
+            "WarpCTCGrad": jnp.zeros_like(log_probs)}

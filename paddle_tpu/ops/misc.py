@@ -1,0 +1,195 @@
+"""Miscellaneous op lowerings: chunk evaluation, CVM, SelectedRows shims,
+host callbacks, tree conv, similarity focus.
+
+Reference kernels: ``paddle/fluid/operators/{chunk_eval,cvm,
+get_tensor_from_selected_rows,merge_selected_rows,py_func,tree_conv,
+similarity_focus}_op.*``."""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@register_op("chunk_eval",
+             inputs=["Inference", "Label", "SeqLength"],
+             outputs=["Precision", "Recall", "F1-Score", "NumInferChunks",
+                      "NumLabelChunks", "NumCorrectChunks"],
+             no_grad=True)
+def chunk_eval(ctx, attrs, Inference, Label, SeqLength):
+    """Chunk-level P/R/F1 for sequence labeling (chunk_eval_op.h).
+    Schemes: IOB (tag = type*2 + {0:B,1:I}) and plain (tag == type).
+    Padded [B, T] tags + SeqLength; a predicted chunk is correct when its
+    begin, end, and type all match a gold chunk — evaluated with a
+    per-position begin/end/type encoding, no host loops."""
+    scheme = attrs.get("chunk_scheme", "IOB")
+    num_types = int(attrs.get("num_chunk_types"))
+    excluded = set(attrs.get("excluded_chunk_types", []) or [])
+    B, T = Inference.shape[0], Inference.shape[1]
+    inf = jnp.reshape(Inference, (B, T)).astype(jnp.int32)
+    lab = jnp.reshape(Label, (B, T)).astype(jnp.int32)
+    lengths = (jnp.reshape(SeqLength, (-1,)).astype(jnp.int32)
+               if SeqLength is not None else jnp.full((B,), T, jnp.int32))
+    valid = jnp.arange(T)[None, :] < lengths[:, None]
+
+    def decompose(tags):
+        if scheme == "plain":
+            ctype = tags
+            inside = jnp.ones_like(tags, dtype=bool)
+            is_b = jnp.ones_like(tags, dtype=bool)  # refined below
+        else:  # IOB: B = type*2, I = type*2 + 1
+            ctype = tags // 2
+            is_b = (tags % 2) == 0
+            inside = jnp.ones_like(tags, dtype=bool)
+        prev_type = jnp.concatenate(
+            [jnp.full((B, 1), -1, jnp.int32), ctype[:, :-1]], axis=1)
+        prev_valid = jnp.concatenate(
+            [jnp.zeros((B, 1), bool), valid[:, :-1]], axis=1)
+        if scheme == "plain":
+            begin = valid & ((~prev_valid) | (ctype != prev_type))
+        else:
+            prev_inside = prev_valid
+            begin = valid & (is_b | (~prev_inside)
+                             | (ctype != prev_type))
+        # end position of the chunk starting at p: next begin - 1 or len-1
+        nxt_begin = jnp.concatenate(
+            [begin[:, 1:], jnp.ones((B, 1), bool)], axis=1)
+        # compute chunk id per position: cumsum of begins
+        return begin, ctype
+
+    def chunk_key(begin, ctype, tags):
+        """Encode each chunk as (batch, start, end, type); represented as
+        a per-START-position integer key; -1 where no chunk starts."""
+        idx = jnp.arange(T)[None, :]
+        # end = (next start or len) - 1, computed via reverse cummax of
+        # next-begin positions
+        begin_pos = jnp.where(begin, idx, T + 1)
+
+        def nxt(carry, x):
+            carry = jnp.minimum(carry, x)
+            return carry, carry
+
+        # scan right-to-left over positions for next begin AFTER p
+        bp_rev = begin_pos[:, ::-1]
+        init = jnp.full((B,), T + 1)
+        _, nb_rev = jax.lax.scan(
+            lambda c, x: (jnp.minimum(c, x), jnp.minimum(c, x)),
+            init, bp_rev[:, :].T)
+        nb = nb_rev.T[:, ::-1]  # next begin at or after p
+        nb_after = jnp.concatenate(
+            [nb[:, 1:], jnp.full((B, 1), T + 1)], axis=1)
+        end = jnp.minimum(nb_after - 1, lengths[:, None] - 1)
+        key = (idx * (T + 2) + (end + 1)) * (num_types + 1) + ctype
+        return jnp.where(begin, key, -1)
+
+    ib, it = decompose(inf)
+    lb, lt = decompose(lab)
+    ikey = chunk_key(ib, it, inf)
+    lkey = chunk_key(lb, lt, lab)
+    if excluded:
+        exc = jnp.asarray(sorted(excluded), jnp.int32)
+        ib = ib & ~jnp.isin(it, exc)
+        lb = lb & ~jnp.isin(lt, exc)
+        ikey = jnp.where(ib, ikey, -1)
+        lkey = jnp.where(lb, lkey, -1)
+    n_inf = jnp.sum(ib & valid)
+    n_lab = jnp.sum(lb & valid)
+    correct = jnp.sum((ikey == lkey) & (ikey >= 0) & valid)
+    p = correct / jnp.maximum(n_inf, 1)
+    r = correct / jnp.maximum(n_lab, 1)
+    f1 = jnp.where(p + r > 0, 2 * p * r / jnp.maximum(p + r, 1e-12), 0.0)
+    as_f = lambda v: v.astype(jnp.float32).reshape(1)
+    return {
+        "Precision": as_f(p), "Recall": as_f(r), "F1-Score": as_f(f1),
+        "NumInferChunks": n_inf.reshape(1).astype(jnp.int64),
+        "NumLabelChunks": n_lab.reshape(1).astype(jnp.int64),
+        "NumCorrectChunks": correct.reshape(1).astype(jnp.int64),
+    }
+
+
+@register_op("cvm", inputs=["X", "CVM"], outputs=["Y"])
+def cvm(ctx, attrs, X, CVM):
+    """Continuous-value model (cvm_op.cc): X = [show, click, emb...];
+    use_cvm=True -> log-transform the two lead features; False -> strip
+    them."""
+    use_cvm = bool(attrs.get("use_cvm", True))
+    if not use_cvm:
+        return X[:, 2:]
+    show = jnp.log(jnp.maximum(X[:, :1], 1e-20) + 1.0)
+    click = jnp.log(jnp.maximum(X[:, 1:2], 1e-20) + 1.0) - show
+    return jnp.concatenate([show, click, X[:, 2:]], axis=1)
+
+
+@register_op("get_tensor_from_selected_rows", inputs=["X"], outputs=["Out"])
+def get_tensor_from_selected_rows(ctx, attrs, X):
+    """SelectedRows were replaced by dense scatter-add grads (SURVEY §2.1
+    Tensor row); the conversion is the identity."""
+    return X
+
+
+@register_op("merge_selected_rows", inputs=["X"], outputs=["Out"])
+def merge_selected_rows(ctx, attrs, X):
+    """Row-duplicate merging happened implicitly in the scatter-add grad;
+    identity on dense tensors."""
+    return X
+
+
+@register_op("py_func", inputs=["X*"], outputs=["Out*"], no_grad=True)
+def py_func(ctx, attrs, X):
+    """Host-python callback (py_func_op.cc) via jax.pure_callback: the
+    registered callable runs on host per execution; output shapes/dtypes
+    must be declared (TPU static shapes)."""
+    from . import py_func_registry
+
+    fn_id = int(attrs["func_id"])
+    fn, out_specs = py_func_registry.get(fn_id)
+    result_shape = [jax.ShapeDtypeStruct(tuple(s), d) for s, d in out_specs]
+    outs = jax.pure_callback(
+        lambda *a: fn(*a), result_shape, *X, vmap_method="sequential")
+    return {"Out": list(outs)}
+
+
+@register_op("tree_conv", inputs=["NodesVector", "EdgeSet", "Filter"],
+             outputs=["Out"])
+def tree_conv(ctx, attrs, NodesVector, EdgeSet, Filter):
+    """Tree-based convolution (tree_conv_op.h, simplified continuous
+    binary tree form): for each node, aggregate its edge-neighbors with
+    the 3-way filter [D, H, 3] per output channel.  NodesVector [B,N,D],
+    EdgeSet [B,E,2] (parent,child pairs, 0-padded), Filter [D,H,3]
+    (self/left-ish/right-ish mixing)."""
+    B, N, D = NodesVector.shape
+    w_self, w_l, w_r = Filter[..., 0], Filter[..., 1], Filter[..., 2]
+    edges = EdgeSet.astype(jnp.int32)
+    parent, child = edges[..., 0], edges[..., 1]  # [B, E]
+    # padding rows are (0, 0); a real tree edge never has parent == child,
+    # so self-loops mark padding and contribute nothing
+    real = (parent != child).astype(NodesVector.dtype)  # [B, E]
+
+    def agg(nodes, par, chi, m):
+        up = jnp.zeros_like(nodes).at[par].add(nodes[chi] * m[:, None])
+        down = jnp.zeros_like(nodes).at[chi].add(nodes[par] * m[:, None])
+        return up, down
+
+    up, down = jax.vmap(agg)(NodesVector, parent, child, real)
+    out = (jnp.matmul(NodesVector, w_self) + jnp.matmul(up, w_l)
+           + jnp.matmul(down, w_r))
+    return jnp.tanh(out)
+
+
+@register_op("similarity_focus", inputs=["X"], outputs=["Out"])
+def similarity_focus(ctx, attrs, X):
+    """Similarity-focus mask (similarity_focus_op.h): for each selected
+    channel (axis/indexes attrs), mark rows/cols containing that
+    channel's per-row/col maxima; output is X's shape with the focus mask
+    values 1.0/0.0."""
+    axis = int(attrs.get("axis", 1))
+    indexes = [int(i) for i in attrs.get("indexes", [0])]
+    assert axis == 1, "similarity_focus: only channel axis supported"
+    B, C, H, W = X.shape
+    mask = jnp.zeros((B, H, W), X.dtype)
+    for idx in indexes:
+        ch = X[:, idx]  # [B, H, W]
+        row_max = ch == jnp.max(ch, axis=2, keepdims=True)
+        col_max = ch == jnp.max(ch, axis=1, keepdims=True)
+        mask = jnp.maximum(mask, (row_max | col_max).astype(X.dtype))
+    return jnp.broadcast_to(mask[:, None], X.shape)

@@ -247,6 +247,23 @@ def _conv_padding(paddings, ksize, dilations):
     ]
 
 
+def _conv_transpose_padding(paddings, ksize, dilations):
+    """Map the reference's symmetric transpose-conv padding p (output =
+    (in-1)*s + dilated_k - 2p) onto jax.lax.conv_transpose's input-side
+    pads of the fractionally-strided conv: lo = hi = d*(k-1) - p."""
+    if isinstance(paddings, str):
+        return paddings
+    if len(paddings) == len(ksize):
+        pairs = [(int(p), int(p)) for p in paddings]
+    else:
+        pairs = [(int(paddings[2 * i]), int(paddings[2 * i + 1]))
+                 for i in range(len(ksize))]
+    return [
+        (d * (int(k) - 1) - lo, d * (int(k) - 1) - hi)
+        for (lo, hi), k, d in zip(pairs, ksize, dilations)
+    ]
+
+
 def _conv_nd(ctx, attrs, Input, Filter, nd):
     strides = [int(s) for s in attrs.get("strides", [1] * nd)]
     paddings = attrs.get("paddings", [0] * nd)
@@ -301,71 +318,77 @@ def conv2d_transpose(ctx, attrs, Input, Filter):
     dilations = [int(d) for d in attrs.get("dilations", [1, 1])]
     groups = int(attrs.get("groups", 1) or 1)
     ksize = jnp.shape(Filter)[2:]
-    pad = _conv_padding(paddings, ksize, dilations)
-    out = jax.lax.conv_transpose(
+    pad = _conv_transpose_padding(paddings, ksize, dilations)
+    if groups != 1:
+        raise NotImplementedError("grouped conv2d_transpose")
+    # kernel stays in the reference's [C_in, C_out, kh, kw] layout: under
+    # transpose_kernel=True that is spec OIHW (O = the fwd conv's output =
+    # C_in) — verified against the scatter oracle incl. C_in != C_out and
+    # paddings (round-1 used IOHW, which breaks for C_in != C_out)
+    return jax.lax.conv_transpose(
         Input,
         Filter,
         strides=strides,
         padding=pad,
         rhs_dilation=dilations,
-        dimension_numbers=("NCHW", "IOHW", "NCHW"),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
         transpose_kernel=True,
     )
-    if groups != 1:
-        raise NotImplementedError("grouped conv2d_transpose")
-    return out
 
 
-@register_op("pool2d", inputs=["X"], outputs=["Out"])
-def pool2d(ctx, attrs, X):
+def _pool_nd(attrs, X, nd):
+    """Shared max/avg pooling (pool_op.cc 2-D/3-D): global/adaptive
+    handling + the trace-time-constant init for reduce_window (its grad
+    rule, select-and-scatter, cannot linearize a traced init value)."""
+    import numpy as np
+
     ptype = attrs.get("pooling_type", "max")
-    ksize = [int(k) for k in attrs.get("ksize", [2, 2])]
-    strides = [int(s) for s in attrs.get("strides", [2, 2])]
-    paddings = [int(p) for p in attrs.get("paddings", [0, 0])]
+    ksize = [int(k) for k in attrs.get("ksize", [2] * nd)]
+    strides = [int(s) for s in attrs.get("strides", [2] * nd)]
+    paddings = [int(p) for p in attrs.get("paddings", [0] * nd)]
     global_pooling = attrs.get("global_pooling", False)
     adaptive = attrs.get("adaptive", False)
     exclusive = attrs.get("exclusive", True)
-    n, c, h, w = jnp.shape(X)
-    if global_pooling or (adaptive and ksize == [1, 1]):
-        ksize = [h, w]
-        strides = [1, 1]
-        paddings = [0, 0]
+    spatial = jnp.shape(X)[2:]
+    if global_pooling or (adaptive and ksize == [1] * nd):
+        ksize = list(spatial)
+        strides = [1] * nd
+        paddings = [0] * nd
     elif adaptive:
-        # adaptive pooling with output size evenly dividing input
-        ksize = [h // ksize[0], w // ksize[1]]
+        ksize = [s // k for s, k in zip(spatial, ksize)]
         strides = list(ksize)
-        paddings = [0, 0]
+        paddings = [0] * nd
     window = (1, 1) + tuple(ksize)
     wstrides = (1, 1) + tuple(strides)
     pad = ((0, 0), (0, 0)) + tuple((p, p) for p in paddings)
     if ptype == "max":
-        import numpy as np
-
-        # init must be a trace-time constant: reduce_window's grad rule
-        # (select-and-scatter) cannot linearize a traced init value
         if jnp.issubdtype(X.dtype, jnp.floating):
             import ml_dtypes
 
-            np_dt = (
-                ml_dtypes.bfloat16 if X.dtype == jnp.bfloat16
-                else np.dtype(X.dtype)
-            )
+            np_dt = (ml_dtypes.bfloat16 if X.dtype == jnp.bfloat16
+                     else np.dtype(X.dtype))
             init = np.asarray(-np.inf, np_dt)
         else:
             init = np.asarray(np.iinfo(np.dtype(X.dtype)).min, X.dtype)
         return jax.lax.reduce_window(
-            X, init, jax.lax.max, window, wstrides, pad
-        )
+            X, init, jax.lax.max, window, wstrides, pad)
     s = jax.lax.reduce_window(
-        X.astype(jnp.float32), 0.0, jax.lax.add, window, wstrides, pad
-    )
+        X.astype(jnp.float32), 0.0, jax.lax.add, window, wstrides, pad)
     if exclusive and any(paddings):
-        ones = jnp.ones((1, 1, h, w), jnp.float32)
-        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, wstrides, pad)
+        ones = jnp.ones((1, 1) + tuple(spatial), jnp.float32)
+        cnt = jax.lax.reduce_window(
+            ones, 0.0, jax.lax.add, window, wstrides, pad)
         out = s / cnt
     else:
-        out = s / float(ksize[0] * ksize[1])
+        import math as _math
+
+        out = s / float(_math.prod(ksize))
     return out.astype(X.dtype)
+
+
+@register_op("pool2d", inputs=["X"], outputs=["Out"])
+def pool2d(ctx, attrs, X):
+    return _pool_nd(attrs, X, 2)
 
 
 @register_op("accuracy", inputs=["Out", "Indices", "Label"],
@@ -632,3 +655,147 @@ def row_conv(ctx, attrs, X, Filter):
         shifted = jnp.pad(X[:, i:, :], ((0, 0), (0, i), (0, 0)))
         out = out + shifted * Filter[i][None, None, :]
     return out
+
+
+def _sampler_logq(sampler, ids, n):
+    """log q(id) under the negative sampler (nce_op.h samplers):
+    0=uniform, 1=log-uniform (Zipf: q(c)=log((c+2)/(c+1))/log(n+1))."""
+    if sampler == 1:
+        ids_f = ids.astype(jnp.float32)
+        q = jnp.log((ids_f + 2.0) / (ids_f + 1.0)) / jnp.log(n + 1.0)
+        return jnp.log(jnp.maximum(q, 1e-20))
+    return jnp.full(jnp.shape(ids), -jnp.log(float(n)))
+
+
+def _draw_negatives(ctx, sampler, k, n, seed=0):
+    key = ctx.rng()
+    if seed:
+        key = jax.random.fold_in(key, int(seed))
+    if sampler == 1:
+        # inverse-CDF of the Zipfian log-uniform distribution
+        u = jax.random.uniform(key, (k,))
+        ids = jnp.exp(u * jnp.log(n + 1.0)) - 1.0
+        return jnp.clip(ids.astype(jnp.int32), 0, n - 1)
+    return jax.random.randint(key, (k,), 0, n, jnp.int32)
+
+
+@register_op("nce", inputs=["Input", "Label", "Weight", "Bias",
+                            "SampleWeight"],
+             outputs=["Cost", "SampleLogits", "SampleLabels"],
+             stateful_outputs=("SampleLogits", "SampleLabels"))
+def nce(ctx, attrs, Input, Label, Weight, Bias, SampleWeight):
+    """Noise-contrastive estimation (nce_op.h): binary logistic loss for
+    the true class against k sampled noise classes with the sampler-
+    probability correction s - log(k*q)."""
+    k = int(attrs.get("num_neg_samples", 10))
+    n = int(attrs.get("num_total_classes"))
+    sampler = int(attrs.get("sampler", 0))
+    B = Input.shape[0]
+    lbl = jnp.reshape(Label, (B, -1))[:, 0].astype(jnp.int32)
+    neg = _draw_negatives(ctx, sampler, k, n,
+                          attrs.get("seed", 0))  # [K], shared across batch
+    # true-class logit: row-wise dot, not a [B,B] matmul
+    s_true = jnp.einsum("bd,bd->b", Input, Weight[lbl])[:, None]
+    if Bias is not None:
+        s_true = s_true + jnp.reshape(Bias, (-1,))[lbl][:, None]
+    s_neg = jnp.matmul(Input, Weight[neg].T)  # [B, K]
+    if Bias is not None:
+        s_neg = s_neg + jnp.reshape(Bias, (-1,))[neg][None, :]
+    adj_true = s_true - (jnp.log(float(k)) + _sampler_logq(sampler, lbl, n)
+                         )[:, None]
+    adj_neg = s_neg - (jnp.log(float(k)) + _sampler_logq(sampler, neg, n)
+                       )[None, :]
+    # -log sigma(true) - sum log(1 - sigma(neg)), in stable softplus form
+    cost = (jnp.logaddexp(0.0, -adj_true)[:, 0]
+            + jnp.sum(jnp.logaddexp(0.0, adj_neg), axis=1))
+    if SampleWeight is not None:
+        cost = cost * jnp.reshape(SampleWeight, (-1,))
+    sample_logits = jnp.concatenate([s_true, s_neg], axis=1)
+    sample_labels = jnp.concatenate(
+        [lbl[:, None], jnp.broadcast_to(neg[None, :], (B, k))], axis=1)
+    return {"Cost": cost[:, None], "SampleLogits": sample_logits,
+            "SampleLabels": sample_labels.astype(jnp.int64)}
+
+
+@register_op("hierarchical_sigmoid", inputs=["X", "W", "Label", "Bias"],
+             outputs=["Out", "PreOut"], stateful_outputs=("PreOut",))
+def hierarchical_sigmoid(ctx, attrs, X, W, Label, Bias):
+    """Hierarchical sigmoid over the complete binary 'SimpleCode' tree
+    (hierarchical_sigmoid_op.h + framework MatrixBitCode): for class c,
+    code = c + num_classes; node j has index (code>>(j+1))-1 and bit
+    (code>>j)&1; loss = sum_j BCE(sigmoid(x.w_idx + b_idx), bit)."""
+    n = int(attrs.get("num_classes"))
+    B = X.shape[0]
+    lbl = jnp.reshape(Label, (B,)).astype(jnp.int32)
+    code = lbl + n
+    import math as _math
+
+    max_len = int(_math.ceil(_math.log2(2 * n)))
+    losses = jnp.zeros((B,), jnp.float32)
+    length = jnp.floor(
+        jnp.log2(code.astype(jnp.float32) + 1e-6)).astype(jnp.int32)
+    for j in range(max_len):
+        idx = (code >> (j + 1)) - 1          # [B]
+        bit = ((code >> j) & 1).astype(jnp.float32)
+        valid = j < length
+        idx_safe = jnp.clip(idx, 0, W.shape[0] - 1)
+        pre = jnp.sum(X * W[idx_safe], axis=1)
+        if Bias is not None:
+            pre = pre + jnp.reshape(Bias, (-1,))[idx_safe]
+        # BCE with logit `pre`, label `bit`
+        term = jnp.logaddexp(0.0, pre) - bit * pre
+        losses = losses + jnp.where(valid, term, 0.0)
+    return {"Out": losses[:, None],
+            "PreOut": jnp.zeros((B, max_len), jnp.float32)}
+
+
+@register_op("sampled_softmax_with_cross_entropy",
+             inputs=["Logits", "Label"], outputs=["Softmax", "Loss"],
+             stateful_outputs=("Softmax",))
+def sampled_softmax_with_cross_entropy(ctx, attrs, Logits, Label):
+    """Softmax CE over {true, S sampled} classes with -log q correction
+    (reference python sampled_softmax_with_cross_entropy →
+    sample_logits_op + softmax; single fused lowering here)."""
+    s_count = int(attrs.get("num_samples", 10))
+    B, C = Logits.shape
+    lbl = jnp.reshape(Label, (B,)).astype(jnp.int32)
+    neg = _draw_negatives(ctx, 1, s_count, C, attrs.get("seed", 0))
+    s_true = jnp.take_along_axis(Logits, lbl[:, None], axis=1)
+    s_neg = jnp.take(Logits, neg, axis=1)
+    adj_true = s_true - _sampler_logq(1, lbl, C)[:, None]
+    adj_neg = s_neg - _sampler_logq(1, neg, C)[None, :]
+    if attrs.get("remove_accidental_hits", True):
+        # a sampled negative equal to the true label would double-count
+        # the true class in the denominator; mask it out (reference
+        # sample_logits_op remove_accidental_hits)
+        hit = neg[None, :] == lbl[:, None]
+        adj_neg = jnp.where(hit, -1e30, adj_neg)
+    z = jnp.concatenate([adj_true, adj_neg], axis=1)  # true at col 0
+    logp = jax.nn.log_softmax(z, axis=1)
+    return {"Loss": -logp[:, :1], "Softmax": jnp.exp(logp)}
+
+
+@register_op("conv3d_transpose", inputs=["Input", "Filter"],
+             outputs=["Output"])
+def conv3d_transpose(ctx, attrs, Input, Filter):
+    """NCDHW transposed 3-D conv (conv3d_transpose variant of
+    conv_transpose_op.cc)."""
+    strides = [int(s) for s in attrs.get("strides", [1, 1, 1])]
+    paddings = attrs.get("paddings", [0, 0, 0])
+    dilations = [int(d) for d in attrs.get("dilations", [1, 1, 1])]
+    if int(attrs.get("groups", 1) or 1) != 1:
+        raise NotImplementedError("grouped conv3d_transpose")
+    ksize = jnp.shape(Filter)[2:]
+    pad = _conv_transpose_padding(paddings, ksize, dilations)
+    return jax.lax.conv_transpose(
+        Input, Filter, strides=strides, padding=pad,
+        rhs_dilation=dilations,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        transpose_kernel=True,
+    )
+
+
+@register_op("pool3d", inputs=["X"], outputs=["Out"])
+def pool3d(ctx, attrs, X):
+    """NCDHW pooling (pool_op.cc 3-D registration)."""
+    return _pool_nd(attrs, X, 3)

@@ -1,0 +1,19 @@
+"""Host-callable registry for the py_func op (reference py_func_op.cc
+keeps a global vector of PyObject callables indexed by an int attr — same
+pattern here; the executable program stores only the index)."""
+
+_funcs = {}
+_next_id = [0]
+
+
+def register(fn, out_specs):
+    """Register `fn` returning arrays matching out_specs
+    [(shape, dtype), ...]; returns the func_id attr value."""
+    fid = _next_id[0]
+    _next_id[0] += 1
+    _funcs[fid] = (fn, list(out_specs))
+    return fid
+
+
+def get(fid):
+    return _funcs[fid]

@@ -152,3 +152,129 @@ def gru(ctx, attrs, Input, H0, Weight, Bias, SeqLen):
 )
 def dynamic_gru(ctx, attrs, Input, H0, Weight, Bias, SeqLen):
     return gru(ctx, attrs, Input, H0, Weight, Bias, SeqLen)
+
+
+@register_op("lstm_unit", inputs=["X", "C_prev"], outputs=["C", "H"])
+def lstm_unit(ctx, attrs, X, C_prev):
+    """One LSTM cell step on pre-projected gates (lstm_unit_op.h):
+    X [B, 4D] in (i, f, o, g) order; c = sigm(f+fb)*c_prev + sigm(i)*tanh(g);
+    h = sigm(o)*tanh(c)."""
+    fb = float(attrs.get("forget_bias", 0.0))
+    d = C_prev.shape[-1]
+    i = jax.nn.sigmoid(X[:, :d])
+    f = jax.nn.sigmoid(X[:, d:2 * d] + fb)
+    o = jax.nn.sigmoid(X[:, 2 * d:3 * d])
+    g = jnp.tanh(X[:, 3 * d:])
+    c = f * C_prev + i * g
+    return {"C": c, "H": o * jnp.tanh(c)}
+
+
+@register_op("gru_unit", inputs=["Input", "HiddenPrev", "Weight", "Bias"],
+             outputs=["Gate", "ResetHiddenPrev", "Hidden"],
+             stateful_outputs=("Gate", "ResetHiddenPrev"))
+def gru_unit(ctx, attrs, Input, HiddenPrev, Weight, Bias):
+    """One GRU cell step (gru_unit_op.h): Input [B,3D] pre-projected;
+    Weight [D, 3D] (first 2D update+reset, last D candidate);
+    h = u*c + (1-u)*h_prev (origin_mode flips the mix)."""
+    d = HiddenPrev.shape[-1]
+    g = Input if Bias is None else Input + Bias.reshape(1, -1)
+    gate_act = _ACT[{1: "sigmoid", 2: "tanh", 3: "relu", 0: "identity"}.get(
+        attrs.get("gate_activation", 1), "sigmoid")] \
+        if isinstance(attrs.get("gate_activation", 1), int) \
+        else _ACT[attrs.get("gate_activation", "sigmoid")]
+    cand_act = _ACT[{1: "sigmoid", 2: "tanh", 3: "relu", 0: "identity"}.get(
+        attrs.get("activation", 2), "tanh")] \
+        if isinstance(attrs.get("activation", 2), int) \
+        else _ACT[attrs.get("activation", "tanh")]
+    ur = g[:, :2 * d] + jnp.matmul(HiddenPrev, Weight[:, :2 * d])
+    ur = gate_act(ur)
+    u, r = ur[:, :d], ur[:, d:]
+    rhp = r * HiddenPrev
+    c = cand_act(g[:, 2 * d:] + jnp.matmul(rhp, Weight[:, 2 * d:]))
+    if attrs.get("origin_mode", False):
+        h = c + u * (HiddenPrev - c)
+    else:
+        h = u * c + (1.0 - u) * HiddenPrev
+    gate_out = jnp.concatenate([u, r, c], axis=1)
+    return {"Gate": gate_out, "ResetHiddenPrev": rhp, "Hidden": h}
+
+
+@register_op(
+    "dynamic_lstmp",
+    inputs=["Input", "H0", "C0", "Weight", "ProjWeight", "Bias", "SeqLen"],
+    outputs=["Projection", "Cell"],
+)
+def dynamic_lstmp(ctx, attrs, Input, H0, C0, Weight, ProjWeight, Bias,
+                  SeqLen):
+    """LSTM with projection (lstmp_op.h): recurrent input is the
+    projection r = act(h @ ProjWeight) [B,P]; Weight [P, 4D];
+    Input [B,T,4D] pre-projected gates; padded + SeqLen mask."""
+    B, T, four_d = jnp.shape(Input)
+    d = four_d // 4
+    p = ProjWeight.shape[1]
+    gate_act = _ACT[attrs.get("gate_activation", "sigmoid")]
+    cell_act = _ACT[attrs.get("cell_activation", "tanh")]
+    cand_act = _ACT[attrs.get("candidate_activation", "tanh")]
+    proj_act = _ACT[attrs.get("proj_activation", "identity")]
+    is_reverse = attrs.get("is_reverse", False)
+
+    r0 = H0 if H0 is not None else jnp.zeros((B, p), Input.dtype)
+    c0 = C0 if C0 is not None else jnp.zeros((B, d), Input.dtype)
+    x = jnp.moveaxis(Input, 1, 0)
+    if is_reverse:
+        x = jnp.flip(x, 0)
+    mask = _mask_time(SeqLen, B, T)
+    if mask is not None and is_reverse:
+        mask = jnp.flip(mask, 0)
+
+    def step(carry, inp):
+        r, c = carry
+        if mask is not None:
+            xt, mt = inp
+        else:
+            xt, mt = inp, None
+        gates = xt + jnp.matmul(r, Weight)
+        if Bias is not None:
+            gates = gates + jnp.reshape(Bias, (1, -1))[:, : 4 * d]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = gate_act(i), gate_act(f), gate_act(o)
+        g = cand_act(g)
+        c_new = f * c + i * g
+        h_new = o * cell_act(c_new)
+        r_new = proj_act(jnp.matmul(h_new, ProjWeight))
+        if mt is not None:
+            keep = mt[:, None]
+            r_new = jnp.where(keep, r_new, r)
+            c_new = jnp.where(keep, c_new, c)
+        return (r_new, c_new), (r_new, c_new)
+
+    xs = (x, mask) if mask is not None else x
+    _, (rs, cs) = jax.lax.scan(step, (r0, c0), xs)
+    if is_reverse:
+        rs, cs = jnp.flip(rs, 0), jnp.flip(cs, 0)
+    return {"Projection": jnp.moveaxis(rs, 0, 1),
+            "Cell": jnp.moveaxis(cs, 0, 1)}
+
+
+@register_op(
+    "fusion_lstm",
+    inputs=["X", "WeightX", "WeightH", "Bias", "H0", "C0", "SeqLen"],
+    outputs=["Hidden", "Cell"],
+)
+def fusion_lstm(ctx, attrs, X, WeightX, WeightH, Bias, H0, C0, SeqLen):
+    """Fused x-projection + LSTM (fused/fusion_lstm_op.cc).  On TPU the
+    'fusion' is XLA's job — this lowers to one [B*T,D]x[D,4D] matmul plus
+    the same scan as the lstm op."""
+    gates = jnp.matmul(X, WeightX)
+    return lstm(ctx, dict(attrs), gates, H0, C0, WeightH, Bias, SeqLen)
+
+
+@register_op(
+    "fusion_gru",
+    inputs=["X", "WeightX", "WeightH", "Bias", "H0", "SeqLen"],
+    outputs=["Hidden"],
+)
+def fusion_gru(ctx, attrs, X, WeightX, WeightH, Bias, H0, SeqLen):
+    """Fused x-projection + GRU (fused/fusion_gru_op.cc)."""
+    gates = jnp.matmul(X, WeightX)
+    return gru(ctx, dict(attrs), gates, H0, WeightH, Bias, SeqLen)

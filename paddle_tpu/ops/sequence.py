@@ -169,3 +169,76 @@ def sequence_enumerate(ctx, attrs, X):
         )
         cols.append(shifted)
     return jnp.stack(cols, axis=-1)
+
+
+@register_op("sequence_conv", inputs=["X", "Filter", "SeqLen"],
+             outputs=["Out"])
+def sequence_conv(ctx, attrs, X, Filter, SeqLen):
+    """Context-window convolution over padded [B,T,D] sequences
+    (sequence_conv_op.h + math/context_project.h): each step concatenates
+    contextLength rows starting at contextStart, then matmuls the
+    [ctx*D, M] filter; rows past a sequence's length contribute zeros."""
+    ctx_len = int(attrs.get("contextLength", 3))
+    ctx_start = int(attrs.get("contextStart", -1))
+    B, T, D = X.shape
+    if SeqLen is not None:
+        lengths = jnp.reshape(SeqLen, (-1,)).astype(jnp.int32)
+        tmask = (jnp.arange(T)[None, :] < lengths[:, None])[:, :, None]
+        x = jnp.where(tmask, X, 0.0)
+    else:
+        x = X
+    cols = []
+    for i in range(ctx_len):
+        off = ctx_start + i
+        if off < 0:
+            shifted = jnp.pad(x[:, :T + off], ((0, 0), (-off, 0), (0, 0)))
+        elif off > 0:
+            shifted = jnp.pad(x[:, off:], ((0, 0), (0, off), (0, 0)))
+        else:
+            shifted = x
+        cols.append(shifted)
+    ctx_mat = jnp.concatenate(cols, axis=2)  # [B, T, ctx*D]
+    return jnp.matmul(ctx_mat, Filter)
+
+
+@register_op("sequence_expand_as", inputs=["X", "Y", "RefLen"],
+             outputs=["Out"])
+def sequence_expand_as(ctx, attrs, X, Y, RefLen):
+    """Repeat each row of X to match Y's per-sequence lengths
+    (sequence_expand_as_op.h).  Padded form: X [B, D], ref lengths [B],
+    output [B, Tmax, D] with rows repeated up to each length, zeros
+    beyond."""
+    lengths = jnp.reshape(RefLen, (-1,)).astype(jnp.int32) \
+        if RefLen is not None else None
+    Tmax = Y.shape[1]
+    out = jnp.repeat(X[:, None, :], Tmax, axis=1)
+    if lengths is not None:
+        m = (jnp.arange(Tmax)[None, :] < lengths[:, None])[:, :, None]
+        out = jnp.where(m, out, 0.0)
+    return out
+
+
+@register_op("sequence_reshape", inputs=["X"], outputs=["Out"])
+def sequence_reshape(ctx, attrs, X):
+    """Change the inner dim, folding factor into time
+    (sequence_reshape_op.h): [B, T, D] -> [B, T*D/new_dim, new_dim]."""
+    new_dim = int(attrs["new_dim"])
+    B, T, D = X.shape
+    return X.reshape(B, T * D // new_dim, new_dim)
+
+
+@register_op("sequence_scatter", inputs=["X", "Ids", "Updates", "SeqLen"],
+             outputs=["Out"])
+def sequence_scatter(ctx, attrs, X, Ids, Updates, SeqLen):
+    """Scatter-ADD per-sequence updates into X (sequence_scatter_op.h):
+    X [B, D]; Ids/Updates [B, L] (padded; positions past SeqLen masked)."""
+    B, L = Ids.shape[0], Ids.shape[1]
+    ids = jnp.reshape(Ids, (B, L)).astype(jnp.int32)
+    upd = jnp.reshape(Updates, (B, L))
+    if SeqLen is not None:
+        lengths = jnp.reshape(SeqLen, (-1,)).astype(jnp.int32)
+        valid = jnp.arange(L)[None, :] < lengths[:, None]
+        upd = jnp.where(valid, upd, 0.0)
+    def one(row, idx, u):
+        return row.at[idx].add(u)
+    return jax.vmap(one)(X, ids, upd)

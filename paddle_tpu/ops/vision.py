@@ -359,3 +359,124 @@ def unfold(ctx, attrs, X):
                   j * dw:j * dw + ow * sw:sw])
     out = jnp.stack(patches, axis=2)  # [N, C, kh*kw, oh, ow]
     return out.reshape(n, c * kh * kw, oh * ow)
+
+
+@register_op("deformable_conv", inputs=["Input", "Offset", "Mask", "Filter"],
+             outputs=["Output"])
+def deformable_conv(ctx, attrs, Input, Offset, Mask, Filter):
+    """Modulated deformable conv v2 (deformable_conv_op.cu): for each
+    kernel tap (ki,kj), bilinear-sample the input at
+    base + dilation placement + learned offset, scale by the modulation
+    mask, then contract taps x channels with the filter.  Static loops
+    over the (small) kernel; the sampling is a batched gather — no host
+    loops, MXU does the final contraction."""
+    strides = [int(s) for s in attrs.get("strides", [1, 1])]
+    pads = [int(p) for p in attrs.get("paddings", [0, 0])]
+    dil = [int(d) for d in attrs.get("dilations", [1, 1])]
+    groups = int(attrs.get("groups", 1) or 1)
+    dg = int(attrs.get("deformable_groups", 1) or 1)
+    if groups != 1:
+        raise NotImplementedError("deformable_conv groups>1")
+    n, c, h, w = Input.shape
+    m, c_g, kh, kw = Filter.shape
+    oh = (h + 2 * pads[0] - (dil[0] * (kh - 1) + 1)) // strides[0] + 1
+    ow = (w + 2 * pads[1] - (dil[1] * (kw - 1) + 1)) // strides[1] + 1
+    # offset layout: [N, dg*2*kh*kw, OH, OW], channel 2t = y_t, 2t+1 = x_t
+    # per tap (deformable_conv_op.cu modulated_deformable_im2col)
+    off = Offset.reshape(n, dg, kh * kw, 2, oh, ow)
+    msk = (Mask.reshape(n, dg, kh * kw, oh, ow)
+           if Mask is not None else None)
+    base_y = (jnp.arange(oh) * strides[0] - pads[0])[None, :, None]
+    base_x = (jnp.arange(ow) * strides[1] - pads[1])[None, None, :]
+    cpg = c // dg  # channels per deformable group
+    taps = []
+    for t in range(kh * kw):
+        ki, kj = t // kw, t % kw
+        group_feats = []
+        for g in range(dg):
+            py = (base_y + ki * dil[0] + off[:, g, t, 0]).astype(jnp.float32)
+            px = (base_x + kj * dil[1] + off[:, g, t, 1]).astype(jnp.float32)
+            # normalize to [-1, 1] for the shared bilinear sampler
+            gx = 2.0 * px / jnp.maximum(w - 1, 1) - 1.0
+            gy = 2.0 * py / jnp.maximum(h - 1, 1) - 1.0
+            v = _bilinear_sample(
+                Input[:, g * cpg:(g + 1) * cpg], gx, gy)  # [N,cpg,OH,OW]
+            if msk is not None:
+                v = v * msk[:, g, t][:, None]
+            group_feats.append(v)
+        taps.append(jnp.concatenate(group_feats, axis=1))  # [N,C,OH,OW]
+    col = jnp.stack(taps, axis=2)  # [N, C, kh*kw, OH, OW]
+    return jnp.einsum("nckhw,mck->nmhw",
+                      col.reshape(n, c, kh * kw, oh, ow),
+                      Filter.reshape(m, c, kh * kw))
+
+
+@register_op("deformable_psroi_pooling",
+             inputs=["Input", "ROIs", "Trans"],
+             outputs=["Output", "TopCount"], stateful_outputs=("TopCount",))
+def deformable_psroi_pooling(ctx, attrs, Input, ROIs, Trans):
+    """Deformable position-sensitive ROI pooling
+    (deformable_psroi_pooling_op.cu): each bin's sampling window is
+    shifted by a learned normalized offset (Trans [R, 2, ph, pw]) scaled
+    by trans_std and the ROI extent; average-pool the shifted bin from
+    the bin's position-sensitive channel group."""
+    scale = float(attrs.get("spatial_scale", 1.0))
+    ph = int(attrs.get("pooled_height", 1))
+    pw = int(attrs.get("pooled_width", 1))
+    out_c = int(attrs.get("output_dim"))
+    trans_std = float(attrs.get("trans_std", 0.1))
+    sample_per_part = int(attrs.get("sample_per_part", 4))
+    no_trans = bool(attrs.get("no_trans", False))
+    if ROIs.shape[-1] == 5:
+        batch_idx = ROIs[:, 0].astype(jnp.int32)
+        boxes = ROIs[:, 1:]
+    else:
+        batch_idx = jnp.zeros((ROIs.shape[0],), jnp.int32)
+        boxes = ROIs
+    n, c, h, w = Input.shape
+    r = boxes.shape[0]
+    x1 = boxes[:, 0] * scale - 0.5
+    y1 = boxes[:, 1] * scale - 0.5
+    x2 = (boxes[:, 2] + 1.0) * scale - 0.5
+    y2 = (boxes[:, 3] + 1.0) * scale - 0.5
+    rw = jnp.maximum(x2 - x1, 0.1)
+    rh = jnp.maximum(y2 - y1, 0.1)
+    bin_w = rw / pw
+    bin_h = rh / ph
+    feats = Input[batch_idx].reshape(r, out_c, ph, pw, h, w)
+    if Trans is not None and not no_trans:
+        tr = Trans.reshape(r, 2, ph, pw) * trans_std
+        dy = tr[:, 0] * rh[:, None, None]
+        dx = tr[:, 1] * rw[:, None, None]
+    else:
+        dy = jnp.zeros((r, ph, pw))
+        dx = jnp.zeros((r, ph, pw))
+    iy = jnp.arange(ph, dtype=jnp.float32)
+    ix = jnp.arange(pw, dtype=jnp.float32)
+    # sample_per_part^2 bilinear samples per bin, averaged
+    sub = (jnp.arange(sample_per_part, dtype=jnp.float32) + 0.5) \
+        / sample_per_part
+    ys = (y1[:, None, None, None] + iy[None, :, None, None]
+          * bin_h[:, None, None, None]
+          + sub[None, None, None, :] * bin_h[:, None, None, None]
+          + dy[:, :, :, None])  # [R, ph, pw, S]
+    xs = (x1[:, None, None, None] + ix[None, None, :, None]
+          * bin_w[:, None, None, None]
+          + sub[None, None, None, :] * bin_w[:, None, None, None]
+          + dx[:, :, :, None])
+    acc = jnp.zeros((r, out_c, ph, pw))
+    for sy in range(sample_per_part):
+        for sx in range(sample_per_part):
+            gy = 2.0 * ys[..., sy] / jnp.maximum(h - 1, 1) - 1.0
+            gx = 2.0 * xs[..., sx] / jnp.maximum(w - 1, 1) - 1.0
+            # sample each bin's own channel group: flatten bins into the
+            # batch to reuse the NCHW sampler per (i,j)
+            for i in range(ph):
+                for j in range(pw):
+                    v = _bilinear_sample(
+                        feats[:, :, i, j], gx[:, i, j][:, None, None],
+                        gy[:, i, j][:, None, None])  # [R,out_c,1,1]
+                    acc = acc.at[:, :, i, j].add(v[:, :, 0, 0])
+    out = acc / float(sample_per_part * sample_per_part)
+    return {"Output": out,
+            "TopCount": jnp.ones((r, out_c, ph, pw), jnp.float32)}

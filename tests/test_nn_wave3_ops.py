@@ -1,0 +1,614 @@
+"""Numpy/dynamic-programming oracles for the third-wave surface: CRF,
+CTC, edit distance, RNN cells, sampled-softmax family, sequence extras,
+3-D conv/pool, CTR helpers."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.executor import Scope, scope_guard
+from test_nn_extra_ops import run_layer, _data
+
+
+# ---------------- CRF ----------------
+
+def _np_crf_nll(em, trans, lab, lens):
+    """Brute-force CRF NLL oracle (enumerate paths)."""
+    import itertools
+
+    B, T, D = em.shape
+    w_start, w_end, w = trans[0], trans[1], trans[2:]
+    out = np.zeros((B, 1), "float64")
+    for b in range(B):
+        L = int(lens[b])
+        def score(path):
+            s = w_start[path[0]] + em[b, 0, path[0]]
+            for t in range(1, L):
+                s += w[path[t - 1], path[t]] + em[b, t, path[t]]
+            return s + w_end[path[-1]]
+        logz = np.logaddexp.reduce(
+            [score(p) for p in itertools.product(range(D), repeat=L)])
+        out[b, 0] = logz - score([lab[b, t] for t in range(L)])
+    return out
+
+
+def test_linear_chain_crf_matches_bruteforce():
+    rng = np.random.RandomState(0)
+    B, T, D = 3, 4, 3
+    em = rng.randn(B, T, D).astype("float32")
+    lab = rng.randint(0, D, (B, T)).astype("int64")
+    lens = np.array([4, 2, 3], "int64")
+    trans = rng.randn(D + 2, D).astype("float32") * 0.5
+
+    def build():
+        return fluid.layers.linear_chain_crf(
+            _data("em", em, False), _data("lab", lab),
+            param_attr=fluid.ParamAttr(
+                name="crf.w",
+                initializer=fluid.initializer.NumpyArrayInitializer(trans)),
+            length=_data("len", lens))
+
+    got = run_layer(build, {"em": em, "lab": lab, "len": lens})
+    exp = _np_crf_nll(em, trans, lab, lens)
+    np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-4)
+
+
+def test_crf_decoding_matches_bruteforce():
+    rng = np.random.RandomState(1)
+    B, T, D = 3, 4, 3
+    em = rng.randn(B, T, D).astype("float32")
+    lens = np.array([4, 3, 2], "int64")
+    trans = rng.randn(D + 2, D).astype("float32") * 0.5
+
+    def build():
+        attr = fluid.ParamAttr(
+            name="crfd.w",
+            initializer=fluid.initializer.NumpyArrayInitializer(trans))
+        # create the transition param via the crf layer-helper mechanism
+        fluid.layers.linear_chain_crf(
+            _data("em", em, False),
+            _data("lab", np.zeros((B, T), "int64")),
+            param_attr=attr, length=_data("len", lens))
+        return fluid.layers.crf_decoding(
+            _data("em2", em), attr, length=_data("len2", lens))
+
+    got = run_layer(build, {"em": em, "em2": em, "len": lens, "len2": lens,
+                            "lab": np.zeros((B, T), "int64")})
+    import itertools
+    w_start, w_end, w = trans[0], trans[1], trans[2:]
+    for b in range(B):
+        L = int(lens[b])
+        best, best_s = None, -1e30
+        for p in itertools.product(range(D), repeat=L):
+            s = w_start[p[0]] + em[b, 0, p[0]]
+            for t in range(1, L):
+                s += w[p[t - 1], p[t]] + em[b, t, p[t]]
+            s += w_end[p[-1]]
+            if s > best_s:
+                best, best_s = p, s
+        np.testing.assert_array_equal(got[b, :L], best)
+        assert (got[b, L:] == 0).all()
+
+
+def test_chunk_eval_iob():
+    # types: 2; IOB tags: B0=0 I0=1 B1=2 I1=3
+    inf = np.array([[0, 1, 2, 3, 0]], "int64")
+    lab = np.array([[0, 1, 2, 2, 0]], "int64")
+    lens = np.array([5], "int64")
+    p, r, f1, ni, nl, nc = run_layer(
+        lambda: fluid.layers.chunk_eval(
+            _data("i", inf), _data("l", lab), "IOB", 2,
+            seq_length=_data("sl", lens)),
+        {"i": inf, "l": lab, "sl": lens}, n_out=6)
+    # inferred chunks: [0-1]:t0, [2-3]:t1, [4]:t0  -> 3
+    # label chunks:    [0-1]:t0, [2]:t1, [3]:t1(B again), [4]:t0 -> 4
+    # correct: [0-1] t0 and [4] t0 -> 2
+    assert int(ni[0]) == 3 and int(nl[0]) == 4 and int(nc[0]) == 2
+    np.testing.assert_allclose(p, 2 / 3, rtol=1e-5)
+    np.testing.assert_allclose(r, 2 / 4, rtol=1e-5)
+
+
+# ---------------- CTC / edit distance ----------------
+
+def test_edit_distance():
+    hyp = np.array([[1, 2, 3, 0], [1, 1, 1, 1]], "int64")
+    ref = np.array([[1, 3, 3], [2, 2, 2]], "int64")
+    hl = np.array([3, 4], "int64")
+    rl = np.array([3, 3], "int64")
+    out, seq_num = run_layer(
+        lambda: fluid.layers.edit_distance(
+            _data("h", hyp), _data("r", ref), normalized=False,
+            input_length=_data("hl", hl), label_length=_data("rl", rl)),
+        {"h": hyp, "r": ref, "hl": hl, "rl": rl}, n_out=2)
+    np.testing.assert_allclose(out, [[1.0], [4.0]])
+    assert int(seq_num[0]) == 2
+
+
+def test_ctc_greedy_decoder():
+    # probs argmax path: [1,1,0,2,2,0] -> collapse -> [1,2]
+    T, C = 6, 3
+    path = [1, 1, 0, 2, 2, 0]
+    probs = np.zeros((1, T, C), "float32")
+    for t, c in enumerate(path):
+        probs[0, t, c] = 1.0
+    lens = np.array([6], "int64")
+    out, out_len = run_layer(
+        lambda: fluid.layers.ctc_greedy_decoder(
+            _data("p", probs), blank=0, input_length=_data("l", lens)),
+        {"p": probs, "l": lens}, n_out=2)
+    assert int(out_len[0, 0]) == 2
+    np.testing.assert_array_equal(out[0, :2], [1, 2])
+
+
+def _np_ctc_nll(logits, labels, blank=0):
+    """Forward-algorithm CTC oracle for one sequence (log domain)."""
+    T, C = logits.shape
+    lp = logits - np.log(np.exp(logits - logits.max(1, keepdims=True)).sum(
+        1, keepdims=True)) - logits.max(1, keepdims=True) * 0  # log_softmax
+    lp = logits - np.logaddexp.reduce(logits, axis=1, keepdims=True)
+    L = len(labels)
+    ext = [blank]
+    for c in labels:
+        ext += [c, blank]
+    S = len(ext)
+    NEG = -1e30
+    a = np.full((S,), NEG)
+    a[0] = lp[0, ext[0]]
+    if S > 1:
+        a[1] = lp[0, ext[1]]
+    for t in range(1, T):
+        na = np.full((S,), NEG)
+        for s in range(S):
+            best = a[s]
+            if s >= 1:
+                best = np.logaddexp(best, a[s - 1])
+            if s >= 2 and ext[s] != blank and ext[s] != ext[s - 2]:
+                best = np.logaddexp(best, a[s - 2])
+            na[s] = best + lp[t, ext[s]]
+        a = na
+    return -np.logaddexp(a[S - 1], a[S - 2])
+
+
+def test_warpctc_against_dp_oracle():
+    rng = np.random.RandomState(2)
+    B, T, C, L = 2, 5, 4, 2
+    logits = rng.randn(B, T, C).astype("float32")
+    labels = np.array([[1, 2], [3, 3]], "int64")
+    tl = np.array([5, 4], "int64")
+    ll = np.array([2, 2], "int64")
+    got = run_layer(
+        lambda: fluid.layers.warpctc(
+            _data("x", logits, False), _data("y", labels),
+            input_length=_data("tl", tl), label_length=_data("ll", ll)),
+        {"x": logits, "y": labels, "tl": tl, "ll": ll})
+    for b in range(B):
+        exp = _np_ctc_nll(logits[b, : tl[b]].astype("float64"),
+                          list(labels[b, : ll[b]]))
+        np.testing.assert_allclose(got[b, 0], exp, rtol=1e-4)
+
+
+def test_warpctc_trains():
+    rng = np.random.RandomState(3)
+    B, T, C, L = 4, 6, 5, 2
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[T, 8], dtype="float32",
+                              append_batch_size=True)
+        y = fluid.layers.data("y", shape=[L], dtype="int64")
+        tl = fluid.layers.data("tl", shape=[], dtype="int64")
+        ll = fluid.layers.data("ll", shape=[], dtype="int64")
+        h = fluid.layers.fc(x, size=C, num_flatten_dims=2)
+        loss = fluid.layers.mean(fluid.layers.warpctc(
+            h, y, input_length=tl, label_length=ll))
+        fluid.optimizer.Adam(5e-2).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    feed = {
+        "x": rng.randn(B, T, 8).astype("float32"),
+        "y": rng.randint(1, C, (B, L)).astype("int64"),
+        "tl": np.full((B,), T, "int64"),
+        "ll": np.full((B,), L, "int64"),
+    }
+    with scope_guard(Scope()):
+        exe.run(startup)
+        losses = [float(np.asarray(exe.run(main, feed=feed,
+                                           fetch_list=[loss])[0]).reshape(()))
+                  for _ in range(40)]
+    assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
+
+
+# ---------------- RNN cells ----------------
+
+def test_lstm_unit_formula():
+    rng = np.random.RandomState(4)
+    B, D = 3, 4
+    xh = rng.randn(B, 2 * D).astype("float32")
+    c_prev = rng.randn(B, D).astype("float32")
+
+    def build():
+        h, c = fluid.layers.lstm_unit(
+            _data("x", xh[:, :D], False), _data("h", xh[:, D:], False),
+            _data("c", c_prev, False), forget_bias=1.0,
+            param_attr=fluid.ParamAttr(
+                name="lu.w", initializer=fluid.initializer.Constant(0.1)),
+            bias_attr=fluid.ParamAttr(
+                name="lu.b", initializer=fluid.initializer.Constant(0.0)))
+        return h, c
+
+    h, c = run_layer(build, {"x": xh[:, :D], "h": xh[:, D:], "c": c_prev},
+                     n_out=2)
+
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+
+    gates = np.concatenate([xh[:, :D], xh[:, D:]], 1) @ np.full(
+        (2 * D, 4 * D), 0.1, "float32")
+    i, f, o, g = np.split(gates, 4, axis=1)
+    ce = sig(f + 1.0) * c_prev + sig(i) * np.tanh(g)
+    he = sig(o) * np.tanh(ce)
+    np.testing.assert_allclose(c, ce, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(h, he, rtol=1e-4, atol=1e-5)
+
+
+def test_gru_unit_formula():
+    rng = np.random.RandomState(5)
+    B, D = 2, 3
+    inp = rng.randn(B, 3 * D).astype("float32")
+    hp = rng.randn(B, D).astype("float32")
+    w = rng.randn(D, 3 * D).astype("float32") * 0.3
+
+    def build():
+        hid, rhp, gate = fluid.layers.gru_unit(
+            _data("i", inp, False), _data("h", hp, False), 3 * D,
+            param_attr=fluid.ParamAttr(
+                name="gu.w",
+                initializer=fluid.initializer.NumpyArrayInitializer(w)),
+            bias_attr=False)
+        return hid
+
+    got = run_layer(build, {"i": inp, "h": hp})
+
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+
+    ur = sig(inp[:, :2 * D] + hp @ w[:, :2 * D])
+    u, r = ur[:, :D], ur[:, D:]
+    c = np.tanh(inp[:, 2 * D:] + (r * hp) @ w[:, 2 * D:])
+    he = u * c + (1 - u) * hp
+    np.testing.assert_allclose(got, he, rtol=1e-4, atol=1e-5)
+
+
+def test_dynamic_lstmp_shapes_and_mask():
+    rng = np.random.RandomState(6)
+    B, T, D, P = 2, 5, 4, 3
+    x = rng.randn(B, T, 4 * D).astype("float32")
+    lens = np.array([5, 3], "int64")
+
+    def build():
+        proj, cell = fluid.layers.dynamic_lstmp(
+            _data("x", x, False), 4 * D, P,
+            param_attr=fluid.ParamAttr(name="lp.w"),
+            bias_attr=fluid.ParamAttr(name="lp.b"),
+            seq_len=_data("sl", lens))
+        return proj, cell
+
+    proj, cell = run_layer(build, {"x": x, "sl": lens}, n_out=2)
+    assert proj.shape == (B, T, P) and cell.shape == (B, T, D)
+    # masked steps carry the last state forward
+    np.testing.assert_allclose(proj[1, 3], proj[1, 2], rtol=1e-6)
+    np.testing.assert_allclose(proj[1, 4], proj[1, 2], rtol=1e-6)
+
+
+# ---------------- sampled softmax family ----------------
+
+def test_nce_and_hsigmoid_and_sampled_softmax_train():
+    rng = np.random.RandomState(7)
+    B, D, N = 8, 6, 16
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[D], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=D, act="tanh")
+        c_nce = fluid.layers.mean(fluid.layers.nce(
+            h, y, num_total_classes=N, num_neg_samples=4))
+        c_hs = fluid.layers.mean(fluid.layers.hsigmoid(h, y, N))
+        logits = fluid.layers.fc(h, size=N)
+        c_ss = fluid.layers.mean(
+            fluid.layers.sampled_softmax_with_cross_entropy(
+                logits, y, num_samples=5))
+        loss = c_nce + c_hs + c_ss
+        fluid.optimizer.Adam(5e-2).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = rng.randn(B, D).astype("float32")
+    yv = rng.randint(0, N, (B, 1)).astype("int64")
+    with scope_guard(Scope()):
+        exe.run(startup)
+        losses = [float(np.asarray(exe.run(main, feed={"x": xv, "y": yv},
+                                           fetch_list=[loss])[0]).reshape(()))
+                  for _ in range(60)]
+    assert losses[-1] < 0.8 * losses[0], (losses[0], losses[-1])
+
+
+# ---------------- sequence extras ----------------
+
+def test_sequence_conv_oracle():
+    rng = np.random.RandomState(8)
+    B, T, D, M = 2, 4, 3, 5
+    x = rng.randn(B, T, D).astype("float32")
+    lens = np.array([4, 2], "int64")
+    w = rng.randn(3 * D, M).astype("float32")
+
+    def build():
+        return fluid.layers.sequence_conv(
+            _data("x", x, False), M, filter_size=3,
+            param_attr=fluid.ParamAttr(
+                name="sc.w",
+                initializer=fluid.initializer.NumpyArrayInitializer(w)),
+            bias_attr=False, seq_len=_data("sl", lens))
+
+    got = run_layer(build, {"x": x, "sl": lens})
+    xm = x.copy()
+    xm[1, 2:] = 0.0  # beyond length
+    exp = np.zeros((B, T, M), "float32")
+    for t in range(T):
+        ctx_rows = []
+        for off in (-1, 0, 1):
+            tt = t + off
+            ctx_rows.append(xm[:, tt] if 0 <= tt < T
+                            else np.zeros((B, D), "float32"))
+        exp[:, t] = np.concatenate(ctx_rows, 1) @ w
+    np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-5)
+
+
+def test_sequence_reshape_expand_as_scatter():
+    rng = np.random.RandomState(9)
+    x = rng.randn(2, 4, 6).astype("float32")
+    got = run_layer(
+        lambda: fluid.layers.sequence_reshape(_data("x", x), 3), {"x": x})
+    np.testing.assert_allclose(got, x.reshape(2, 8, 3))
+
+    v = rng.randn(2, 3).astype("float32")
+    ref = np.zeros((2, 4, 1), "float32")
+    lens = np.array([4, 2], "int64")
+    got = run_layer(
+        lambda: fluid.layers.sequence_expand_as(
+            _data("v", v), _data("r", ref), ref_len=_data("l", lens)),
+        {"v": v, "r": ref, "l": lens})
+    assert got.shape == (2, 4, 3)
+    np.testing.assert_allclose(got[0, 3], v[0])
+    np.testing.assert_allclose(got[1, 2:], 0.0)
+
+    base = np.zeros((2, 6), "float32")
+    ids = np.array([[0, 2, 2], [5, 0, 0]], "int64")
+    upd = np.array([[1., 2., 3.], [4., 5., 6.]], "float32")
+    sl = np.array([3, 1], "int64")
+    got = run_layer(
+        lambda: fluid.layers.sequence_scatter(
+            _data("b", base), _data("i", ids), _data("u", upd),
+            seq_len=_data("sl", sl)),
+        {"b": base, "i": ids, "u": upd, "sl": sl})
+    exp = np.zeros((2, 6), "float32")
+    exp[0, 0], exp[0, 2] = 1.0, 5.0
+    exp[1, 5] = 4.0
+    np.testing.assert_allclose(got, exp)
+
+
+# ---------------- 3-D conv/pool, CTR ----------------
+
+def test_conv3d_pool3d_run_and_shapes():
+    rng = np.random.RandomState(10)
+    x = rng.randn(2, 3, 4, 6, 6).astype("float32")
+
+    def build():
+        c = fluid.layers.conv3d(_data("x", x, False), num_filters=4,
+                                filter_size=3, padding=1)
+        p = fluid.layers.pool3d(c, pool_size=2, pool_stride=2,
+                                pool_type="avg")
+        a = fluid.layers.adaptive_pool3d(p, pool_size=1, pool_type="avg")
+        return c, p, a
+
+    c, p, a = run_layer(build, {"x": x}, n_out=3)
+    assert c.shape == (2, 4, 4, 6, 6)
+    assert p.shape == (2, 4, 2, 3, 3)
+    assert a.shape == (2, 4, 1, 1, 1)
+    got = run_layer(
+        lambda: fluid.layers.adaptive_pool2d(
+            _data("y", x[:, :, 0]), pool_size=[2, 3], pool_type="avg"),
+        {"y": x[:, :, 0]})
+    assert got.shape == (2, 3, 2, 3)
+
+
+def test_conv3d_transpose_shape():
+    rng = np.random.RandomState(11)
+    x = rng.randn(1, 2, 3, 3, 3).astype("float32")
+    got = run_layer(
+        lambda: fluid.layers.conv3d_transpose(
+            _data("x", x, False), num_filters=4, filter_size=2, stride=2),
+        {"x": x})
+    assert got.shape == (1, 4, 6, 6, 6)
+
+
+def test_cvm_and_selected_rows_shims():
+    x = np.array([[3.0, 1.0, 0.5, 0.6]], "float32")
+    cvm = np.zeros((1, 2), "float32")
+    got = run_layer(
+        lambda: fluid.layers.continuous_value_model(
+            _data("x", x), _data("c", cvm)), {"x": x, "c": cvm})
+    np.testing.assert_allclose(got[0, 0], np.log(4.0), rtol=1e-5)
+    np.testing.assert_allclose(got[0, 1], np.log(2.0) - np.log(4.0),
+                               rtol=1e-5)
+    np.testing.assert_allclose(got[0, 2:], x[0, 2:])
+
+    got = run_layer(
+        lambda: fluid.layers.get_tensor_from_selected_rows(_data("x", x)),
+        {"x": x})
+    np.testing.assert_allclose(got, x)
+    got = run_layer(
+        lambda: fluid.layers.merge_selected_rows(_data("x", x)), {"x": x})
+    np.testing.assert_allclose(got, x)
+
+
+def test_py_func_host_callback():
+    x = np.arange(6, dtype="float32").reshape(2, 3)
+
+    def host_fn(a):
+        return (np.asarray(a) * 2.0).astype("float32")
+
+    def build():
+        xin = _data("x", x)
+        out = fluid.default_main_program().current_block().create_var(
+            name="pyfunc.out", shape=[2, 3], dtype="float32")
+        fluid.layers.py_func(host_fn, xin, out)
+        return out
+
+    got = run_layer(build, {"x": x})
+    np.testing.assert_allclose(got, x * 2.0)
+
+
+def test_tree_conv_and_similarity_focus_run():
+    rng = np.random.RandomState(12)
+    nodes = rng.randn(2, 5, 4).astype("float32")
+    edges = np.array([[[0, 1], [0, 2], [1, 3]],
+                      [[0, 1], [1, 2], [2, 3]]], "int64")
+    got = run_layer(
+        lambda: fluid.layers.tree_conv(
+            _data("n", nodes, False), _data("e", edges), output_size=6),
+        {"n": nodes, "e": edges})
+    assert got.shape == (2, 5, 6) and np.isfinite(got).all()
+
+    x = rng.randn(2, 3, 4, 4).astype("float32")
+    got = run_layer(
+        lambda: fluid.layers.similarity_focus(_data("x", x), 1, [0]),
+        {"x": x})
+    assert got.shape == x.shape
+    assert set(np.unique(got)).issubset({0.0, 1.0})
+
+
+def test_conv2d_transpose_oracle_asymmetric_channels():
+    """Regression: round-1 used spec IOHW which breaks (and would silently
+    transpose channels) for C_in != C_out; oracle = explicit scatter."""
+    rng = np.random.RandomState(13)
+    x = rng.randn(1, 2, 3, 3).astype("float32")
+    f = rng.randn(2, 4, 2, 2).astype("float32")
+
+    def build():
+        return fluid.layers.conv2d_transpose(
+            _data("x", x, False), num_filters=4, filter_size=2, stride=2,
+            param_attr=fluid.ParamAttr(
+                name="ct.w",
+                initializer=fluid.initializer.NumpyArrayInitializer(f)),
+            bias_attr=False)
+
+    got = run_layer(build, {"x": x})
+    exp = np.zeros((1, 4, 6, 6), "float32")
+    for ci in range(2):
+        for co in range(4):
+            for i in range(3):
+                for j in range(3):
+                    for ki in range(2):
+                        for kj in range(2):
+                            exp[0, co, i * 2 + ki, j * 2 + kj] += \
+                                x[0, ci, i, j] * f[ci, co, ki, kj]
+    np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    """With zero offsets and unit mask, deformable conv must equal plain
+    conv2d (the reference's own degenerate-case identity)."""
+    rng = np.random.RandomState(14)
+    x = rng.randn(1, 2, 5, 5).astype("float32")
+    f = rng.randn(3, 2, 3, 3).astype("float32")
+    offset = np.zeros((1, 2 * 9, 5, 5), "float32")
+    mask = np.ones((1, 9, 5, 5), "float32")
+
+    def build_deform():
+        return fluid.layers.deformable_conv(
+            _data("x", x, False), _data("o", offset), _data("m", mask),
+            num_filters=3, filter_size=3, padding=1,
+            param_attr=fluid.ParamAttr(
+                name="dc.w",
+                initializer=fluid.initializer.NumpyArrayInitializer(f)),
+            bias_attr=False)
+
+    got = run_layer(build_deform, {"x": x, "o": offset, "m": mask})
+
+    def build_plain():
+        return fluid.layers.conv2d(
+            _data("x", x, False), num_filters=3, filter_size=3, padding=1,
+            param_attr=fluid.ParamAttr(
+                name="pc.w",
+                initializer=fluid.initializer.NumpyArrayInitializer(f)),
+            bias_attr=False)
+
+    exp = run_layer(build_plain, {"x": x})
+    np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-4)
+
+
+def test_deformable_roi_pooling_runs():
+    rng = np.random.RandomState(15)
+    x = rng.randn(1, 4, 6, 6).astype("float32")  # out_c=1, ph=pw=2
+    rois = np.array([[0, 0, 0, 5, 5]], "float32")
+    trans = np.zeros((1, 2, 2, 2), "float32")
+    got = run_layer(
+        lambda: fluid.layers.deformable_roi_pooling(
+            _data("x", x, False), _data("r", rois), _data("t", trans),
+            pooled_height=2, pooled_width=2, sample_per_part=2),
+        {"x": x, "r": rois, "t": trans})
+    assert got.shape == (1, 1, 2, 2) and np.isfinite(got).all()
+
+
+def test_deformable_conv_integer_offset_shifts():
+    """1x1 kernel with offset (0, +1) samples the pixel to the right —
+    catches y/x interleave layout mistakes (offsets are (y,x) pairs)."""
+    rng = np.random.RandomState(16)
+    x = rng.randn(1, 1, 4, 4).astype("float32")
+    f = np.ones((1, 1, 1, 1), "float32")
+    offset = np.zeros((1, 2, 4, 4), "float32")
+    offset[0, 1] = 1.0  # x-offset = +1
+    mask = np.ones((1, 1, 4, 4), "float32")
+    got = run_layer(
+        lambda: fluid.layers.deformable_conv(
+            _data("x", x, False), _data("o", offset), _data("m", mask),
+            num_filters=1, filter_size=1,
+            param_attr=fluid.ParamAttr(
+                name="dcs.w",
+                initializer=fluid.initializer.NumpyArrayInitializer(f)),
+            bias_attr=False),
+        {"x": x, "o": offset, "m": mask})
+    exp = np.zeros_like(x)
+    exp[:, :, :, :-1] = x[:, :, :, 1:]  # shift left (sample right)
+    np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_layer_returns_final_states():
+    rng = np.random.RandomState(17)
+    B, T, D, H = 2, 5, 3, 4
+    x = rng.randn(B, T, D).astype("float32")
+
+    def build():
+        out, lh, lc = fluid.layers.lstm(
+            _data("x", x, False), None, None, T, H, num_layers=2,
+            is_bidirec=True)
+        return out, lh, lc
+
+    out, lh, lc = run_layer(build, {"x": x}, n_out=3)
+    assert out.shape == (B, T, 2 * H)
+    assert lh.shape == (4, B, H) and lc.shape == (4, B, H)
+    # forward-direction final hidden of the last layer == out's last step
+    np.testing.assert_allclose(lh[2], out[:, -1, :H], rtol=1e-5)
+
+
+def test_edit_distance_ignored_tokens():
+    hyp = np.array([[1, 0, 2, 3]], "int64")
+    ref = np.array([[1, 3, 3]], "int64")
+    out, _ = run_layer(
+        lambda: fluid.layers.edit_distance(
+            _data("h", hyp), _data("r", ref), normalized=False,
+            ignored_tokens=[0],
+            input_length=_data("hl", np.array([4], "int64")),
+            label_length=_data("rl", np.array([3], "int64"))),
+        {"h": hyp, "r": ref, "hl": np.array([4], "int64"),
+         "rl": np.array([3], "int64")}, n_out=2)
+    # hyp filtered -> [1,2,3]; distance([1,2,3],[1,3,3]) = 1
+    np.testing.assert_allclose(out, [[1.0]])
