@@ -1,12 +1,15 @@
-"""Flagship benchmark: BERT-base MLM training step, tokens/sec/chip + MFU.
+"""Flagship benchmarks: BERT-base MLM training (tokens/sec/chip + MFU,
+the headline metric, printed LAST) and ResNet-50 ImageNet-shape training
+(images/sec/chip + MFU, BASELINE.json's first north star).
 
 Reference harness analogue: ``benchmark/fluid/fluid_benchmark.py:296-300``
-(same examples/sec methodology: timed steps after warmup).  Target from
-BASELINE.json: >=45% MFU on a v5e chip (bf16 peak 197 TFLOP/s).
+(same examples/sec methodology: timed steps after warmup) +
+``benchmark/fluid/models/resnet.py``.  Target from BASELINE.json: >=45%
+MFU on a v5e chip (bf16 peak 197 TFLOP/s).
 
-Prints ONE JSON line:
+Prints one JSON line per workload:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
-"""
+(the flagship BERT line last, for single-line consumers)."""
 
 import json
 import sys
@@ -41,11 +44,72 @@ def peak_flops(device):
     return V5E_BF16_PEAK
 
 
+RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 4.09e9  # fwd 4.09 GFLOP @224^2, bwd 2x
+
+
+def bench_resnet50():
+    import json
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import resnet
+    from paddle_tpu.executor import Scope, scope_guard
+
+    dev = jax.devices()[0]
+    on_tpu = "tpu" in str(dev.platform).lower()
+    batch = 64 if on_tpu else 4
+    warmup, steps = 3, (60 if on_tpu else 3)
+    size = 224 if on_tpu else 32
+    main_prog, startup, feeds, loss, acc = resnet.build(
+        dataset="imagenet" if on_tpu else "cifar10", amp=on_tpu)
+    scope = Scope()
+    with scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed = {
+            "img": jnp.asarray(
+                rng.randn(batch, 3, size, size).astype("float32")),
+            "label": jnp.asarray(
+                rng.randint(0, 10, (batch, 1)).astype("int64")),
+        }
+        for _ in range(warmup):
+            exe.run(main_prog, feed=feed, fetch_list=[])
+        lv = exe.run(main_prog, feed=feed, fetch_list=[loss])[0]
+        assert np.isfinite(lv).all()
+        t0 = time.perf_counter()
+        for _ in range(steps - 1):
+            exe.run(main_prog, feed=feed, fetch_list=[])
+        lv = exe.run(main_prog, feed=feed, fetch_list=[loss])[0]
+        dt = time.perf_counter() - t0
+        assert np.isfinite(lv).all()
+    ips = batch * steps / dt
+    mfu = ips * RESNET50_TRAIN_FLOPS_PER_IMAGE / peak_flops(dev)
+    print(json.dumps({
+        "metric": "resnet50_imagenet_train_images_per_sec_per_chip"
+                  if on_tpu else "resnet_cifar_smoke_images_per_sec",
+        "value": round(ips, 1),
+        "unit": "images/sec/chip (%dx%d bs%d bf16 AMP, MFU %.3f on %s)"
+                % (size, size, batch, mfu,
+                   getattr(dev, "device_kind", str(dev))),
+        "vs_baseline": round(mfu / 0.45, 3),
+    }), flush=True)
+
+
 def main():
     import jax
 
     import paddle_tpu as fluid
     from paddle_tpu.models import bert
+
+    try:
+        bench_resnet50()
+    except Exception as e:  # ResNet line is secondary; never block BERT
+        print("# resnet50 bench skipped: %s" % e, flush=True)
 
     dev = jax.devices()[0]
     on_tpu = "tpu" in str(dev.platform).lower() or "axon" in str(

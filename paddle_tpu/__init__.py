@@ -32,6 +32,8 @@ def _configure_jax():
 _configure_jax()
 
 from . import core
+from . import average
+from . import evaluator
 from .framework import (
     Program,
     Block,

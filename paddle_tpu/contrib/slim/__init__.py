@@ -1,6 +1,9 @@
 """Model-compression toolkit (reference:
-``python/paddle/fluid/contrib/slim/``).  Quantization-aware training lives
-in ``quantization``; pruning/NAS/distillation strategies are composed from
-the base framework (clip/regularizer/program surgery) as needed."""
+``python/paddle/fluid/contrib/slim/``): quantization-aware training,
+structured/magnitude pruning + sensitivity analysis, distillation losses
+(L2/FSP/soft-label), and simulated-annealing NAS."""
 
 from . import quantization  # noqa: F401
+from . import prune  # noqa: F401
+from . import distillation  # noqa: F401
+from . import nas  # noqa: F401

@@ -1,0 +1,104 @@
+"""Knowledge distillation losses (reference
+``contrib/slim/distillation/distiller.py``: L2Distiller, FSPDistiller,
+SoftLabelDistiller — each appends its loss subgraph to the merged
+student+teacher program).
+
+TPU note: the 'merge graphs' machinery of the reference collapses to
+building teacher and student in ONE program (the teacher branch under
+stop_gradient); these helpers only append the loss ops."""
+
+__all__ = ["L2Distiller", "FSPDistiller", "SoftLabelDistiller",
+           "l2_loss", "fsp_loss", "soft_label_loss"]
+
+
+def l2_loss(teacher_var, student_var):
+    """mean((t - s)^2) (reference distiller.py L2DistillerPass.apply)."""
+    import paddle_tpu as fluid
+
+    t = fluid.layers.assign(teacher_var)
+    t.stop_gradient = True
+    return fluid.layers.reduce_mean(
+        fluid.layers.square(fluid.layers.elementwise_sub(student_var, t)))
+
+
+def fsp_loss(teacher_var1, teacher_var2, student_var1, student_var2):
+    """mean((FSP_t - FSP_s)^2) over flow matrices (reference
+    FSPDistillerPass; fsp op = fsp_op.cc)."""
+    import paddle_tpu as fluid
+
+    t = fluid.layers.fsp_matrix(teacher_var1, teacher_var2)
+    t.stop_gradient = True
+    s = fluid.layers.fsp_matrix(student_var1, student_var2)
+    return fluid.layers.reduce_mean(
+        fluid.layers.square(fluid.layers.elementwise_sub(s, t)))
+
+
+def soft_label_loss(teacher_logits, student_logits,
+                    teacher_temperature=2.0, student_temperature=2.0):
+    """Cross entropy of softened student vs softened teacher
+    (reference SoftLabelDistillerPass)."""
+    import paddle_tpu as fluid
+
+    t = fluid.layers.softmax(
+        fluid.layers.scale(teacher_logits, 1.0 / teacher_temperature))
+    t.stop_gradient = True
+    s = fluid.layers.softmax(
+        fluid.layers.scale(student_logits, 1.0 / student_temperature))
+    return fluid.layers.reduce_mean(
+        fluid.layers.cross_entropy(s, t, soft_label=True))
+
+
+class L2Distiller:
+    """reference distiller.py:25 — callable returning the loss var."""
+
+    def __init__(self, student_feature_map, teacher_feature_map,
+                 distillation_loss_weight=1.0):
+        self.student = student_feature_map
+        self.teacher = teacher_feature_map
+        self.weight = distillation_loss_weight
+
+    def distiller_loss(self, student_var, teacher_var):
+        import paddle_tpu as fluid
+
+        return fluid.layers.scale(
+            l2_loss(teacher_var, student_var), self.weight)
+
+
+class FSPDistiller:
+    """reference distiller.py:101."""
+
+    def __init__(self, student_pairs=None, teacher_pairs=None,
+                 distillation_loss_weight=1.0):
+        self.student_pairs = student_pairs or []
+        self.teacher_pairs = teacher_pairs or []
+        self.weight = distillation_loss_weight
+
+    def distiller_loss(self, svars, tvars):
+        import paddle_tpu as fluid
+
+        losses = [
+            fsp_loss(t1, t2, s1, s2)
+            for (s1, s2), (t1, t2) in zip(svars, tvars)
+        ]
+        total = losses[0]
+        for l in losses[1:]:
+            total = fluid.layers.elementwise_add(total, l)
+        return fluid.layers.scale(total, self.weight)
+
+
+class SoftLabelDistiller:
+    """reference distiller.py SoftLabelDistiller."""
+
+    def __init__(self, student_temperature=2.0, teacher_temperature=2.0,
+                 distillation_loss_weight=1.0):
+        self.st = student_temperature
+        self.tt = teacher_temperature
+        self.weight = distillation_loss_weight
+
+    def distiller_loss(self, student_logits, teacher_logits):
+        import paddle_tpu as fluid
+
+        return fluid.layers.scale(
+            soft_label_loss(teacher_logits, student_logits,
+                            self.tt, self.st),
+            self.weight)

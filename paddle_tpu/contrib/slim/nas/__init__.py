@@ -1,0 +1,122 @@
+"""Neural architecture search (reference ``contrib/slim/searcher/
+controller.py`` EvolutionaryController/SAController +
+``contrib/slim/nas/light_nas_strategy.py``).
+
+TPU redesign: the reference's controller-server/agent RPC machinery
+(controller_server.py, search_agent.py, lock.py) coordinated multi-
+process trainers over sockets; here search runs in-process — each token
+evaluation is one jit-compiled short training run, so the socket layer
+has no role.  The controller API (reset/next_tokens/update) is kept
+verbatim for strategy-porting parity."""
+
+import math
+
+import numpy as np
+
+__all__ = ["EvolutionaryController", "SAController", "SearchSpace",
+           "light_nas_search"]
+
+
+class EvolutionaryController:
+    """reference controller.py:28."""
+
+    def update(self, tokens, reward):
+        raise NotImplementedError
+
+    def reset(self, range_table, constrain_func=None):
+        raise NotImplementedError
+
+    def next_tokens(self):
+        raise NotImplementedError
+
+
+class SAController(EvolutionaryController):
+    """Simulated-annealing controller (reference controller.py:59)."""
+
+    def __init__(self, range_table=None, reduce_rate=0.85,
+                 init_temperature=1024, max_iter_number=300, seed=0):
+        self._range_table = range_table
+        self._reduce_rate = reduce_rate
+        self._init_temperature = init_temperature
+        self._max_iter_number = max_iter_number
+        self._reward = -1.0
+        self._tokens = None
+        self._max_reward = -1.0
+        self._best_tokens = None
+        self._iter = 0
+        self._constrain_func = None
+        self._rng = np.random.RandomState(seed)
+
+    @property
+    def best_tokens(self):
+        return self._best_tokens
+
+    @property
+    def max_reward(self):
+        return self._max_reward
+
+    def reset(self, range_table, init_tokens, constrain_func=None):
+        self._range_table = list(range_table)
+        self._constrain_func = constrain_func
+        self._tokens = list(init_tokens)
+        self._iter = 0
+
+    def update(self, tokens, reward):
+        """Accept better tokens always; worse ones with the annealing
+        probability exp(dr / T) (reference controller.py:105)."""
+        self._iter += 1
+        temperature = self._init_temperature * (
+            self._reduce_rate ** self._iter)
+        dr = reward - self._reward
+        if dr > 0 or self._rng.random_sample() <= math.exp(
+                dr / max(temperature, 1e-9)):
+            self._reward = reward
+            self._tokens = list(tokens)
+        if reward > self._max_reward:
+            self._max_reward = reward
+            self._best_tokens = list(tokens)
+
+    def next_tokens(self):
+        """Mutate one random position (reference controller.py:127)."""
+        for _ in range(self._max_iter_number):
+            new_tokens = list(self._tokens)
+            index = int(self._rng.randint(len(self._range_table)))
+            rt = self._range_table[index]
+            new_tokens[index] = (
+                new_tokens[index] + self._rng.randint(rt - 1) + 1) % rt
+            if self._constrain_func is None \
+                    or self._constrain_func(new_tokens):
+                return new_tokens
+        return list(self._tokens)
+
+
+class SearchSpace:
+    """reference nas/search_space.py: subclass and implement the three
+    hooks; `create_net(tokens)` returns (startup, main, loss) or any
+    structure your reward_fn understands."""
+
+    def init_tokens(self):
+        raise NotImplementedError
+
+    def range_table(self):
+        raise NotImplementedError
+
+    def create_net(self, tokens):
+        raise NotImplementedError
+
+
+def light_nas_search(search_space, reward_fn, search_steps=50,
+                     controller=None, constrain_func=None):
+    """In-process LightNAS loop (reference light_nas_strategy.py
+    on_compression_begin): anneal over the token space, evaluating each
+    candidate with `reward_fn(net)`; returns (best_tokens, best_reward)."""
+    ctl = controller or SAController()
+    init = search_space.init_tokens()
+    ctl.reset(search_space.range_table(), init, constrain_func)
+    reward = reward_fn(search_space.create_net(init))
+    ctl.update(init, reward)
+    for _ in range(search_steps):
+        tokens = ctl.next_tokens()
+        reward = reward_fn(search_space.create_net(tokens))
+        ctl.update(tokens, reward)
+    return ctl.best_tokens, ctl.max_reward

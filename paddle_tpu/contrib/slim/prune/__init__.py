@@ -1,0 +1,101 @@
+"""Model pruning (reference ``contrib/slim/prune/pruner.py``
+StructurePruner + prune_strategy.py sensitivity pruning).
+
+TPU redesign: pruning is a SCOPE transform, not a graph pass — under XLA
+the win from structured sparsity is realized by shrinking the actual
+weight shapes at export; during sensitivity analysis the framework keeps
+shapes static and applies mask-zeroing (so one compiled program serves
+every ratio)."""
+
+import numpy as np
+
+__all__ = ["Pruner", "StructurePruner", "MagnitudePruner",
+           "sensitivity_analysis"]
+
+
+class Pruner:
+    """Base pruner (reference pruner.py:Pruner)."""
+
+    def prune(self, param):
+        raise NotImplementedError
+
+
+class StructurePruner(Pruner):
+    """Group (filter/channel) pruning by l1 norm along an axis
+    (reference pruner.py:StructurePruner)."""
+
+    def __init__(self, pruning_axis=None, criterions=None):
+        self.pruning_axis = pruning_axis or {"*": 0}
+        self.criterions = criterions or {"*": "l1_norm"}
+
+    def _axis_for(self, name):
+        return self.pruning_axis.get(name, self.pruning_axis.get("*", 0))
+
+    def cal_pruned_idx(self, name, param, ratio, axis=None):
+        """Indices of the lowest-norm groups to prune (reference
+        cal_pruned_idx)."""
+        axis = self._axis_for(name) if axis is None else axis
+        p = np.asarray(param)
+        reduce_dims = tuple(i for i in range(p.ndim) if i != axis)
+        norms = np.abs(p).sum(axis=reduce_dims)
+        k = int(round(norms.shape[0] * float(ratio)))
+        return np.argsort(norms)[:k]
+
+    def prune_tensor(self, param, idx, axis, lazy=False):
+        """Remove (or zero when lazy=True) the given groups (reference
+        prune_tensor)."""
+        p = np.asarray(param)
+        if lazy:
+            out = p.copy()
+            sl = [slice(None)] * p.ndim
+            sl[axis] = idx
+            out[tuple(sl)] = 0.0
+            return out
+        return np.delete(p, idx, axis=axis)
+
+    def prune_scope(self, scope, name, ratio, lazy=True):
+        """Apply pruning to a parameter living in an executor scope."""
+        val = np.asarray(scope.get(name))
+        axis = self._axis_for(name)
+        idx = self.cal_pruned_idx(name, val, ratio)
+        scope.set(name, self.prune_tensor(val, idx, axis, lazy=lazy))
+        return idx
+
+
+class MagnitudePruner(Pruner):
+    """Unstructured magnitude pruning: zero the smallest |w| entries."""
+
+    def __init__(self, ratio):
+        self.ratio = float(ratio)
+
+    def prune(self, param):
+        p = np.asarray(param)
+        k = int(p.size * self.ratio)
+        if k == 0:
+            return p
+        thresh = np.partition(np.abs(p).ravel(), k - 1)[k - 1]
+        return np.where(np.abs(p) <= thresh, 0.0, p).astype(p.dtype)
+
+
+def sensitivity_analysis(executor, program, feed, fetch_loss, scope,
+                         param_names, ratios=(0.1, 0.3, 0.5), lazy=True):
+    """Per-parameter pruning sensitivity (reference
+    prune_strategy.py:SensitivePruneStrategy._compute_sensitivities):
+    prune each param at each ratio, measure the loss delta on one batch,
+    restore, and return {param: {ratio: loss}}."""
+    pruner = StructurePruner()
+    base = float(np.asarray(
+        executor.run(program, feed=feed, fetch_list=[fetch_loss],
+                     scope=scope)[0]).reshape(()))
+    report = {}
+    for name in param_names:
+        saved = np.asarray(scope.get(name)).copy()
+        report[name] = {0.0: base}
+        for ratio in ratios:
+            pruner.prune_scope(scope, name, ratio, lazy=lazy)
+            loss = float(np.asarray(
+                executor.run(program, feed=feed, fetch_list=[fetch_loss],
+                             scope=scope)[0]).reshape(()))
+            report[name][ratio] = loss
+            scope.set(name, saved)
+    return report

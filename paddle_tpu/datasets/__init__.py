@@ -14,5 +14,12 @@ from . import mnist  # noqa: F401
 from . import cifar  # noqa: F401
 from . import uci_housing  # noqa: F401
 from . import imdb  # noqa: F401
+from . import flowers  # noqa: F401
+from . import conll05  # noqa: F401
+from . import wmt14  # noqa: F401
+from . import wmt16  # noqa: F401
+from . import movielens  # noqa: F401
+from . import sentiment  # noqa: F401
 
-__all__ = ["common", "mnist", "cifar", "uci_housing", "imdb"]
+__all__ = ["common", "mnist", "cifar", "uci_housing", "imdb", "flowers",
+           "conll05", "wmt14", "wmt16", "movielens", "sentiment"]

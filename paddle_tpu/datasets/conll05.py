@@ -1,0 +1,55 @@
+"""CoNLL-2005 SRL readers (reference: ``python/paddle/dataset/conll05.py``
+— ``get_dict()`` returns (word, verb, label) dicts; ``test()`` yields
+9-slot tuples: word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, verb_ids,
+mark, labels for the label_semantic_roles model).  Synthetic surrogate
+with the reference's dict sizes and the same tuple layout."""
+
+import numpy as np
+
+__all__ = ["get_dict", "get_embedding", "test"]
+
+WORD_DICT_LEN = 44068
+LABEL_DICT_LEN = 59
+PRED_DICT_LEN = 3162
+
+
+def get_dict():
+    word_dict = {("w%d" % i): i for i in range(WORD_DICT_LEN)}
+    verb_dict = {("v%d" % i): i for i in range(PRED_DICT_LEN)}
+    label_dict = {("l%d" % i): i for i in range(LABEL_DICT_LEN)}
+    return word_dict, verb_dict, label_dict
+
+
+def get_embedding():
+    """Reference returns a pretrained word-embedding matrix; here a
+    deterministic random one with the same shape."""
+    r = np.random.RandomState(42)
+    return r.rand(WORD_DICT_LEN, 32).astype("float32") * 0.1
+
+
+def _synthetic(size, seed):
+    def reader():
+        r = np.random.RandomState(seed)
+        for _ in range(size):
+            n = int(r.randint(5, 30))
+            words = r.randint(0, WORD_DICT_LEN, size=n)
+            ctx = [np.clip(words + d, 0, WORD_DICT_LEN - 1)
+                   for d in (-2, -1, 0, 1, 2)]
+            verb = int(r.randint(PRED_DICT_LEN))
+            mark_pos = int(r.randint(n))
+            mark = np.zeros(n, "int64")
+            mark[mark_pos] = 1
+            # labels correlate with word ids so models can learn
+            labels = (words + verb) % LABEL_DICT_LEN
+            yield tuple(
+                [list(map(int, words))]
+                + [list(map(int, c)) for c in ctx]
+                + [[verb] * n, list(map(int, mark)),
+                   list(map(int, labels))]
+            )
+
+    return reader
+
+
+def test():
+    return _synthetic(5267, 1)

@@ -1,0 +1,38 @@
+"""Flowers-102 readers (reference: ``python/paddle/dataset/flowers.py`` —
+``train()``/``test()``/``valid()`` yield (3x224x224 float image, label)).
+Synthetic surrogate: class-colored noise images so conv models learn the
+split."""
+
+import numpy as np
+
+__all__ = ["train", "test", "valid"]
+
+CLASSES = 102
+
+
+def _synthetic(split, size, use_xmap=True):
+    seed = {"train": 0, "test": 1, "valid": 2}[split]
+
+    def reader():
+        r = np.random.RandomState(seed)
+        for _ in range(size):
+            label = int(r.randint(CLASSES))
+            img = r.rand(3, 224, 224).astype("float32") * 0.2
+            # class-dependent mean color makes the task learnable
+            img += (label / CLASSES) * np.array(
+                [0.5, 0.3, 0.7], "float32")[:, None, None]
+            yield img, label
+
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    return _synthetic("train", 6149)
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True, cycle=False):
+    return _synthetic("test", 1020)
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True):
+    return _synthetic("valid", 1020)

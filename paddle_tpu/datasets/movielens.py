@@ -1,0 +1,62 @@
+"""MovieLens-1M readers (reference: ``python/paddle/dataset/movielens.py``
+— ``train()``/``test()`` yield [user_id, gender_id, age_id, job_id,
+movie_id, category_ids, title_ids, rating]; plus meta helpers).
+Synthetic surrogate with the reference's cardinalities and a latent
+user x movie affinity so recommenders converge."""
+
+import numpy as np
+
+__all__ = ["train", "test", "max_user_id", "max_movie_id", "max_job_id",
+           "age_table", "movie_categories"]
+
+USERS, MOVIES, JOBS = 6040, 3952, 21
+CATEGORIES = 18
+TITLE_VOCAB = 5175
+age_table = [1, 18, 25, 35, 45, 50, 56]
+
+
+def max_user_id():
+    return USERS
+
+
+def max_movie_id():
+    return MOVIES
+
+
+def max_job_id():
+    return JOBS - 1
+
+
+def movie_categories():
+    return {("c%d" % i): i for i in range(CATEGORIES)}
+
+
+def _affinity(u, m):
+    return ((u * 31 + m * 17) % 50) / 10.0  # 0..4.9
+
+
+def _synthetic(size, seed):
+    def reader():
+        r = np.random.RandomState(seed)
+        for _ in range(size):
+            u = int(r.randint(1, USERS + 1))
+            m = int(r.randint(1, MOVIES + 1))
+            gender = u % 2
+            age = int(r.randint(len(age_table)))
+            job = u % JOBS
+            cats = [int(c) for c in
+                    r.randint(0, CATEGORIES, size=r.randint(1, 4))]
+            title = [int(t) for t in
+                     r.randint(0, TITLE_VOCAB, size=r.randint(1, 6))]
+            rating = float(np.clip(round(_affinity(u, m)), 1, 5))
+            yield [u, gender, age, job, m, cats, title, rating]
+
+    return reader
+
+
+def train():
+    return _synthetic(900189, 0)
+
+
+def test():
+    return _synthetic(100020, 1)

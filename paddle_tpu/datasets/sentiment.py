@@ -1,0 +1,44 @@
+"""NLTK movie-reviews sentiment readers (reference:
+``python/paddle/dataset/sentiment.py`` — ``get_word_dict()``,
+``train()``/``test()`` yield (word-id list, 0/1 label)).  Synthetic
+surrogate: vocab halves biased by polarity (same scheme as imdb)."""
+
+import numpy as np
+
+__all__ = ["get_word_dict", "train", "test"]
+
+VOCAB = 8000
+NUM_TRAINING_INSTANCES = 1600
+NUM_TOTAL_INSTANCES = 2000
+
+
+def get_word_dict():
+    return {("w%d" % i): i for i in range(VOCAB)}
+
+
+def _synthetic(split, size):
+    seed = 10 if split == "train" else 11
+
+    def reader():
+        r = np.random.RandomState(seed)
+        half = VOCAB // 2
+        for _ in range(size):
+            label = int(r.randint(2))
+            n = int(r.randint(10, 80))
+            biased = r.rand(n) < 0.7
+            ids = np.where(
+                biased == bool(label),
+                r.randint(half, VOCAB, size=n),
+                r.randint(0, half, size=n),
+            )
+            yield [int(v) for v in ids], label
+
+    return reader
+
+
+def train():
+    return _synthetic("train", NUM_TRAINING_INSTANCES)
+
+
+def test():
+    return _synthetic("test", NUM_TOTAL_INSTANCES - NUM_TRAINING_INSTANCES)

@@ -1,0 +1,43 @@
+"""WMT-14 en-fr readers (reference: ``python/paddle/dataset/wmt14.py`` —
+``train(dict_size)``/``test(dict_size)`` yield (src_ids, trg_ids,
+trg_next_ids) with <s>/<e>/<unk> conventions).  Synthetic surrogate: the
+target is a learnable transform of the source sequence."""
+
+import numpy as np
+
+__all__ = ["train", "test", "N", "get_dict"]
+
+N = 30000  # reference default dict size
+
+
+def get_dict(dict_size, reverse=False):
+    src = {("s%d" % i): i for i in range(dict_size)}
+    trg = {("t%d" % i): i for i in range(dict_size)}
+    if reverse:
+        src = {v: k for k, v in src.items()}
+        trg = {v: k for k, v in trg.items()}
+    return src, trg
+
+
+def _synthetic(size, seed, dict_size):
+    start, end = 0, 1
+
+    def reader():
+        r = np.random.RandomState(seed)
+        for _ in range(size):
+            n = int(r.randint(4, 20))
+            src = r.randint(3, dict_size, size=n)
+            trg = (src + 7) % (dict_size - 3) + 3  # learnable mapping
+            trg_in = [start] + [int(v) for v in trg]
+            trg_next = [int(v) for v in trg] + [end]
+            yield [int(v) for v in src], trg_in, trg_next
+
+    return reader
+
+
+def train(dict_size):
+    return _synthetic(191155, 0, dict_size)
+
+
+def test(dict_size):
+    return _synthetic(5957, 1, dict_size)

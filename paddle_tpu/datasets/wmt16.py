@@ -1,0 +1,43 @@
+"""WMT-16 en-de readers (reference: ``python/paddle/dataset/wmt16.py`` —
+``train/test/validation(src_dict_size, trg_dict_size, src_lang)`` yield
+(src_ids, trg_in_ids, trg_next_ids); BPE dicts).  Synthetic surrogate
+mirroring wmt14's learnable mapping with the wmt16 API shape."""
+
+import numpy as np
+
+__all__ = ["train", "test", "validation", "get_dict"]
+
+
+def get_dict(lang, dict_size, reverse=False):
+    d = {("%s%d" % (lang, i)): i for i in range(dict_size)}
+    if reverse:
+        d = {v: k for k, v in d.items()}
+    return d
+
+
+def _synthetic(size, seed, src_dict_size, trg_dict_size):
+    start, end = 0, 1
+
+    def reader():
+        r = np.random.RandomState(seed)
+        for _ in range(size):
+            n = int(r.randint(4, 24))
+            src = r.randint(3, src_dict_size, size=n)
+            trg = (src * 3 + 11) % (trg_dict_size - 3) + 3
+            yield ([int(v) for v in src],
+                   [start] + [int(v) for v in trg],
+                   [int(v) for v in trg] + [end])
+
+    return reader
+
+
+def train(src_dict_size, trg_dict_size, src_lang="en"):
+    return _synthetic(29000, 0, src_dict_size, trg_dict_size)
+
+
+def test(src_dict_size, trg_dict_size, src_lang="en"):
+    return _synthetic(1000, 1, src_dict_size, trg_dict_size)
+
+
+def validation(src_dict_size, trg_dict_size, src_lang="en"):
+    return _synthetic(1014, 2, src_dict_size, trg_dict_size)

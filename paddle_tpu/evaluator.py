@@ -1,0 +1,136 @@
+"""Graph-building metric evaluators (reference:
+``python/paddle/fluid/evaluator.py`` — deprecated in favor of
+fluid.metrics, kept for API parity: each Evaluator appends its metric ops
+plus persistable accumulator state, with reset/eval run through the
+executor)."""
+
+import numpy as np
+
+from . import unique_name
+from .framework import Program, Variable, default_main_program, program_guard
+from .initializer import ConstantInitializer
+from .layer_helper import LayerHelper
+
+__all__ = ["Evaluator", "ChunkEvaluator", "EditDistance"]
+
+
+class Evaluator:
+    """Base evaluator (reference evaluator.py:Evaluator): subclasses
+    create accumulator states updated by in-graph ops; ``reset`` zeroes
+    them, ``eval`` computes the final metric on the host."""
+
+    def __init__(self, name, **kwargs):
+        self.states = []
+        self.metrics = []
+        self.helper = LayerHelper(name, **kwargs)
+
+    def reset(self, executor, reset_program=None):
+        if reset_program is None:
+            reset_program = Program()
+        with program_guard(main_program=reset_program):
+            for var in self.states:
+                zeros = self.helper.main_program.current_block()
+                reset_program.global_block().create_var(
+                    name=var.name, shape=var.shape, dtype=var.dtype,
+                    persistable=True)
+                reset_program.global_block().append_op(
+                    type="fill_constant",
+                    outputs={"Out": [var.name]},
+                    attrs={"shape": list(var.shape), "dtype": var.dtype,
+                           "value": 0.0})
+        executor.run(reset_program)
+
+    def eval(self, executor, eval_program=None):
+        raise NotImplementedError
+
+    def _create_state(self, suffix, dtype, shape):
+        state = self.helper.main_program.current_block().create_var(
+            name="_".join([unique_name.generate(self.helper.name), suffix]),
+            persistable=True, dtype=dtype, shape=list(shape))
+        state.stop_gradient = True
+        self.helper.set_variable_initializer(
+            state, ConstantInitializer(0.0))
+        self.states.append(state)
+        return state
+
+
+class ChunkEvaluator(Evaluator):
+    """Accumulating chunk F1 (reference evaluator.py:ChunkEvaluator):
+    sums num_infer/num_label/num_correct chunks across batches."""
+
+    def __init__(self, input, label, chunk_scheme, num_chunk_types,
+                 excluded_chunk_types=None, seq_length=None):
+        super().__init__("chunk_eval")
+        from .layers import nn_extra2 as _l
+
+        main_program = self.helper.main_program
+        self.num_infer_chunks = self._create_state(
+            "num_infer_chunks", "int64", (1,))
+        self.num_label_chunks = self._create_state(
+            "num_label_chunks", "int64", (1,))
+        self.num_correct_chunks = self._create_state(
+            "num_correct_chunks", "int64", (1,))
+        (precision, recall, f1, num_infer, num_label,
+         num_correct) = _l.chunk_eval(
+            input, label, chunk_scheme, num_chunk_types,
+            excluded_chunk_types, seq_length)
+        block = main_program.current_block()
+        for state, delta in ((self.num_infer_chunks, num_infer),
+                             (self.num_label_chunks, num_label),
+                             (self.num_correct_chunks, num_correct)):
+            block.append_op(
+                type="sum", inputs={"X": [state, delta]},
+                outputs={"Out": [state]})
+        self.metrics.extend([precision, recall, f1])
+
+    def eval(self, executor, eval_program=None):
+        from .executor import global_scope
+
+        ni = float(np.asarray(global_scope().get(
+            self.num_infer_chunks.name)).reshape(-1)[0])
+        nl = float(np.asarray(global_scope().get(
+            self.num_label_chunks.name)).reshape(-1)[0])
+        nc = float(np.asarray(global_scope().get(
+            self.num_correct_chunks.name)).reshape(-1)[0])
+        precision = nc / ni if ni else 0.0
+        recall = nc / nl if nl else 0.0
+        f1 = (2 * precision * recall / (precision + recall)
+              if precision + recall else 0.0)
+        return np.array([precision]), np.array([recall]), np.array([f1])
+
+
+class EditDistance(Evaluator):
+    """Accumulating average edit distance (reference
+    evaluator.py:EditDistance)."""
+
+    def __init__(self, input, label, ignored_tokens=None,
+                 input_length=None, label_length=None):
+        super().__init__("edit_distance")
+        from .layers import nn_extra2 as _l
+
+        self.total_distance = self._create_state(
+            "total_distance", "float32", (1,))
+        self.seq_num = self._create_state("seq_num", "int64", (1,))
+        distances, seq_num = _l.edit_distance(
+            input, label, normalized=False,
+            ignored_tokens=ignored_tokens,
+            input_length=input_length, label_length=label_length)
+        from .layers import nn as _nn
+
+        batch_total = _nn.reduce_sum(distances)
+        block = self.helper.main_program.current_block()
+        block.append_op(type="sum",
+                        inputs={"X": [self.total_distance, batch_total]},
+                        outputs={"Out": [self.total_distance]})
+        block.append_op(type="sum", inputs={"X": [self.seq_num, seq_num]},
+                        outputs={"Out": [self.seq_num]})
+        self.metrics.append(distances)
+
+    def eval(self, executor, eval_program=None):
+        from .executor import global_scope
+
+        total = float(np.asarray(global_scope().get(
+            self.total_distance.name)).reshape(-1)[0])
+        n = float(np.asarray(global_scope().get(
+            self.seq_num.name)).reshape(-1)[0])
+        return np.array([total / n if n else 0.0])

@@ -176,7 +176,9 @@ def lstm(input, init_h, init_c, max_len, hidden_size, num_layers,
                     axes=[1])
                 acc.append(v)
         x = outs[0] if len(outs) == 1 else _nn.concat(outs, axis=2)
-        if dropout_prob and not is_test:
+        # cuDNN semantics: dropout BETWEEN layers only, never on the
+        # final layer's output
+        if dropout_prob and not is_test and layer < num_layers - 1:
             x = _nn.dropout(x, dropout_prob,
                             dropout_implementation="upscale_in_train")
     last_h = _nn.stack(last_hs, axis=0)  # [L*dirs, B, D]
@@ -418,6 +420,11 @@ def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
     helper = LayerHelper("conv3d_transpose", **locals())
     dtype = helper.input_dtype()
 
+    if filter_size is None:
+        raise NotImplementedError(
+            "conv3d_transpose: pass filter_size explicitly "
+            "(output_size-only inference is not implemented)")
+
     def triple(v):
         return [int(v)] * 3 if isinstance(v, int) else [int(a) for a in v]
 
@@ -435,7 +442,7 @@ def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
         inputs={"Input": [input], "Filter": [w]},
         outputs={"Output": [out]},
         attrs={"strides": stride, "paddings": padding,
-               "dilations": dilation},
+               "dilations": dilation, "groups": groups or 1},
     )
     pre_act = helper.append_bias_op(out, dim_start=1)
     return helper.append_activation(pre_act)

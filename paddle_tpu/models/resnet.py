@@ -86,8 +86,9 @@ def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False):
 
 
 def build(dataset="cifar10", depth=None, batch_lr=0.1, class_dim=None,
-          is_test=False):
-    """Returns (main, startup, feeds, loss, acc)."""
+          is_test=False, amp=False):
+    """Returns (main, startup, feeds, loss, acc).  amp=True applies the
+    bf16 AMP rewrite (fp32 master weights) like the BERT bench path."""
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         if dataset == "cifar10":
@@ -110,5 +111,7 @@ def build(dataset="cifar10", depth=None, batch_lr=0.1, class_dim=None,
         acc = fluid.layers.accuracy(fluid.layers.softmax(logits), label)
         opt = fluid.optimizer.Momentum(learning_rate=batch_lr, momentum=0.9,
                                        use_nesterov=True)
+        if amp:
+            opt = fluid.contrib.mixed_precision.decorate(opt)
         opt.minimize(loss)
     return main, startup, [img, label], loss, acc

@@ -37,3 +37,4 @@ from . import vision  # noqa: F401
 from . import losses  # noqa: F401
 from . import crf_ctc  # noqa: F401
 from . import misc  # noqa: F401
+from . import extra  # noqa: F401

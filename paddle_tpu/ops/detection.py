@@ -697,3 +697,104 @@ def sigmoid_focal_loss(ctx, attrs, X, Label, FgNum):
         + (1.0 - t) * (1.0 - alpha) * jnp.power(p, gamma) * ce_neg
     )
     return loss / fg
+
+
+@register_op("bipartite_match", inputs=["DistMat"],
+             outputs=["ColToRowMatchIndices", "ColToRowMatchDist"],
+             no_grad=True)
+def bipartite_match(ctx, attrs, DistMat):
+    """Greedy bipartite matching (bipartite_match_op.cc): repeatedly take
+    the globally-largest remaining (row, col) pair; with
+    match_type=per_prediction, afterwards match leftover cols whose best
+    row distance exceeds dist_threshold.  DistMat [R, C] (one image);
+    outputs are [1, C] row indices (-1 unmatched) and distances.
+    TPU-static: the greedy loop is a lax.fori over min(R, C) rounds."""
+    import jax as _jax
+
+    match_type = attrs.get("match_type", "bipartite")
+    thresh = float(attrs.get("dist_threshold", 0.5))
+    batched = DistMat.ndim == 3
+    dm = DistMat if batched else DistMat[None]
+    R, C = dm.shape[1], dm.shape[2]
+
+    def match_one(d):
+        def body(_, state):
+            match_idx, match_dist, active = state
+            masked = jnp.where(active, d, -1.0)
+            flat = jnp.argmax(masked)
+            r, c = flat // C, flat % C
+            best = masked[r, c]
+            do = best >= 0
+            match_idx = jnp.where(
+                do, match_idx.at[c].set(r.astype(jnp.int32)), match_idx)
+            match_dist = jnp.where(
+                do, match_dist.at[c].set(best), match_dist)
+            active = jnp.where(do, active.at[r, :].set(False), active)
+            active = jnp.where(do, active.at[:, c].set(False), active)
+            return match_idx, match_dist, active
+
+        init = (jnp.full((C,), -1, jnp.int32), jnp.zeros((C,), d.dtype),
+                jnp.ones((R, C), bool))
+        match_idx, match_dist, _ = _jax.lax.fori_loop(
+            0, min(R, C), body, init)
+        if match_type == "per_prediction":
+            best_row = jnp.argmax(d, axis=0).astype(jnp.int32)
+            best_dist = jnp.max(d, axis=0)
+            extra = (match_idx < 0) & (best_dist >= thresh)
+            match_idx = jnp.where(extra, best_row, match_idx)
+            match_dist = jnp.where(extra, best_dist, match_dist)
+        return match_idx, match_dist
+
+    match_idx, match_dist = _jax.vmap(match_one)(dm)  # [N, C]
+    return {"ColToRowMatchIndices": match_idx,
+            "ColToRowMatchDist": match_dist}
+
+
+@register_op("target_assign",
+             inputs=["X", "MatchIndices", "NegIndices"],
+             outputs=["Out", "OutWeight"], no_grad=True)
+def target_assign(ctx, attrs, X, MatchIndices, NegIndices):
+    """Assign per-prior targets by match indices (target_assign_op.h):
+    out[i, j] = X[match[i, j]] (weight 1) or mismatch_value (weight 0).
+    X here is [M, K] per-image entities (padded batch dim folded)."""
+    mismatch = attrs.get("mismatch_value", 0)
+    mi = MatchIndices.astype(jnp.int32)  # [N, P]
+    n, p = mi.shape
+    k = X.shape[-1]
+    x2 = X.reshape(-1, k)
+    gathered = x2[jnp.maximum(mi, 0).reshape(-1)].reshape(n, p, k)
+    matched = (mi >= 0)[:, :, None]
+    out = jnp.where(matched, gathered,
+                    jnp.asarray(mismatch, gathered.dtype))
+    weight = matched.astype(jnp.float32)
+    return {"Out": out, "OutWeight": weight[..., 0:1] * jnp.ones((1, 1, 1))}
+
+
+@register_op("mine_hard_examples",
+             inputs=["ClsLoss", "LocLoss", "MatchIndices", "MatchDist"],
+             outputs=["NegIndices", "UpdatedMatchIndices"], no_grad=True)
+def mine_hard_examples(ctx, attrs, ClsLoss, LocLoss, MatchIndices,
+                       MatchDist):
+    """OHEM negative mining (mine_hard_examples_op.cc, max_negative
+    mode): keep the hardest negatives up to neg_pos_ratio * #positives;
+    padded output: NegIndices [N, P] with -1 beyond the kept count."""
+    ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    mi = MatchIndices.astype(jnp.int32)  # [N, P]
+    n, p = mi.shape
+    loss = ClsLoss
+    if LocLoss is not None and attrs.get("mining_type",
+                                         "max_negative") == "hard_example":
+        loss = loss + LocLoss
+    is_neg = mi < 0
+    neg_loss = jnp.where(is_neg, loss.reshape(n, p), -jnp.inf)
+    order = jnp.argsort(-neg_loss, axis=1)  # hardest first
+    num_pos = jnp.sum(mi >= 0, axis=1)
+    num_neg = jnp.sum(is_neg, axis=1)
+    quota = jnp.minimum(
+        jnp.ceil(num_pos.astype(jnp.float32) * ratio).astype(jnp.int32),
+        num_neg)
+    rank = jnp.arange(p)[None, :]
+    keep = rank < quota[:, None]
+    neg_idx = jnp.where(keep, order.astype(jnp.int32), -1)
+    return {"NegIndices": neg_idx,
+            "UpdatedMatchIndices": mi}

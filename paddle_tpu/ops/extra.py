@@ -1,0 +1,538 @@
+"""Remaining op-parity batch: simple losses/math, pooling-with-index,
+unpool, SPP, interpolation aliases, fused compositions, debug print.
+
+Reference kernels: ``paddle/fluid/operators/{hinge_loss,modified_huber_loss,
+l1_norm,squared_l2_distance,minus,fill,diag,is_empty,cross_entropy2,norm,
+conv_shift,cos_sim,pool_with_index,unpool,spp,interpolate,print}_op.*`` and
+``operators/fused/*``.  The fused family lowers to compositions — XLA's
+fusion pass IS the fused kernel on TPU."""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+# ---- simple losses / math ----------------------------------------------
+
+@register_op("hinge_loss", inputs=["Logits", "Labels"], outputs=["Loss"])
+def hinge_loss(ctx, attrs, Logits, Labels):
+    """max(0, 1 - (2y-1) * logit) (hinge_loss_op.h)."""
+    return jnp.maximum(0.0, 1.0 - (2.0 * Labels - 1.0) * Logits)
+
+
+@register_op("modified_huber_loss", inputs=["X", "Y"],
+             outputs=["Out", "IntermediateVal"],
+             stateful_outputs=("IntermediateVal",))
+def modified_huber_loss(ctx, attrs, X, Y):
+    """Modified Huber for classification (modified_huber_loss_op.h):
+    z = (2y-1)*x; z >= -1: max(0,1-z)^2 ; else -4z."""
+    z = (2.0 * Y - 1.0) * X
+    loss = jnp.where(z >= -1.0, jnp.square(jnp.maximum(0.0, 1.0 - z)),
+                     -4.0 * z)
+    return {"Out": loss, "IntermediateVal": z}
+
+
+@register_op("l1_norm", inputs=["X"], outputs=["Out"])
+def l1_norm(ctx, attrs, X):
+    return jnp.sum(jnp.abs(X))
+
+
+@register_op("squared_l2_distance", inputs=["X", "Y"],
+             outputs=["Out", "sub_result"], stateful_outputs=("sub_result",))
+def squared_l2_distance(ctx, attrs, X, Y):
+    sub = X - Y
+    return {"Out": jnp.sum(jnp.square(sub), axis=tuple(range(1, sub.ndim)),
+                           keepdims=True)[:, :1],
+            "sub_result": sub}
+
+
+@register_op("minus", inputs=["X", "Y"], outputs=["Out"])
+def minus(ctx, attrs, X, Y):
+    return X - Y
+
+
+@register_op("fill", inputs=[], outputs=["Out"], no_grad=True)
+def fill(ctx, attrs, **kw):
+    from .common import resolve_dtype
+
+    shape = [int(s) for s in attrs["shape"]]
+    value = attrs.get("value", [0.0])
+    dtype = resolve_dtype(attrs.get("dtype", 5))
+    import numpy as np
+
+    return jnp.asarray(np.asarray(value, dtype).reshape(shape))
+
+
+@register_op("diag", inputs=["Diagonal"], outputs=["Out"], no_grad=True)
+def diag(ctx, attrs, Diagonal):
+    return jnp.diag(jnp.ravel(Diagonal))
+
+
+@register_op("is_empty", inputs=["X"], outputs=["Out"], no_grad=True)
+def is_empty(ctx, attrs, X):
+    return jnp.asarray([X.size == 0])
+
+
+@register_op("cross_entropy2", inputs=["X", "Label"],
+             outputs=["Y", "XShape", "MatchX"],
+             stateful_outputs=("XShape", "MatchX"))
+def cross_entropy2(ctx, attrs, X, Label):
+    """Hard-label cross entropy keeping the matched probability
+    (cross_entropy2_op.cc; used by softmax+CE decompositions)."""
+    lab = Label
+    if lab.ndim == X.ndim and lab.shape[-1] == 1:
+        lab = lab[..., 0]
+    lab = lab.astype(jnp.int32)
+    ignore_index = int(attrs.get("ignore_index", -100))
+    picked = jnp.take_along_axis(
+        X, jnp.maximum(lab, 0)[..., None], axis=-1)[..., 0]
+    loss = -jnp.log(jnp.maximum(picked, 1e-20))
+    loss = jnp.where(lab == ignore_index, 0.0, loss)
+    return {"Y": loss[..., None], "XShape": jnp.zeros((1,), jnp.int32),
+            "MatchX": picked[..., None]}
+
+
+@register_op("norm", inputs=["X"], outputs=["Out", "Norm"],
+             stateful_outputs=("Norm",))
+def norm(ctx, attrs, X):
+    """L2-normalize along `axis` (norm_op.h)."""
+    from .common import normalize_axis
+
+    axis = normalize_axis(int(attrs.get("axis", 1)), X.ndim)
+    eps = float(attrs.get("epsilon", 1e-10))
+    n = jnp.sqrt(jnp.sum(jnp.square(X), axis=axis, keepdims=True) + eps)
+    return {"Out": X / n, "Norm": n}
+
+
+@register_op("conv_shift", inputs=["X", "Y"], outputs=["Out"])
+def conv_shift(ctx, attrs, X, Y):
+    """Circular correlation (conv_shift_op.cc): X [B,M], Y [B,N] (N odd,
+    N <= M); out[b,i] = sum_j x[b, (i+j-N/2) mod M] * y[b,j]."""
+    B, M = X.shape
+    N = Y.shape[1]
+    half = N // 2
+    outs = []
+    for j in range(N):
+        outs.append(jnp.roll(X, half - j, axis=1) * Y[:, j:j + 1])
+    return sum(outs)
+
+
+@register_op("cos_sim", inputs=["X", "Y"],
+             outputs=["Out", "XNorm", "YNorm"],
+             stateful_outputs=("XNorm", "YNorm"))
+def cos_sim_op(ctx, attrs, X, Y):
+    """Row-wise cosine similarity (cos_sim_op.h); Y may be [1, D]."""
+    xn = jnp.sqrt(jnp.sum(jnp.square(X), axis=1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(Y), axis=1, keepdims=True))
+    dot = jnp.sum(X * Y, axis=1, keepdims=True)
+    return {"Out": dot / jnp.maximum(xn * yn, 1e-12),
+            "XNorm": xn, "YNorm": yn}
+
+
+@register_op("fill_zeros_like2", inputs=["X"], outputs=["Out"],
+             no_grad=True)
+def fill_zeros_like2(ctx, attrs, X):
+    return jnp.zeros_like(X)
+
+
+@register_op("squared_l2_norm", inputs=["X"], outputs=["Out"])
+def squared_l2_norm2(ctx, attrs, X):
+    return jnp.sum(jnp.square(X)).reshape(1)
+
+
+# ---- pooling with index / unpool / spp ---------------------------------
+
+@register_op("max_pool2d_with_index", inputs=["X"],
+             outputs=["Out", "Mask"], stateful_outputs=("Mask",))
+def max_pool2d_with_index(ctx, attrs, X):
+    """Max pool returning flat argmax indices (pool_with_index_op.cc)."""
+    ksize = [int(k) for k in attrs.get("ksize", [2, 2])]
+    strides = [int(s) for s in attrs.get("strides", ksize)]
+    paddings = [int(p) for p in attrs.get("paddings", [0, 0])]
+    if attrs.get("global_pooling", False):
+        ksize = list(X.shape[2:])
+        strides = [1, 1]
+        paddings = [0, 0]
+    n, c, h, w = X.shape
+    xp = jnp.pad(X, ((0, 0), (0, 0), (paddings[0], paddings[0]),
+                     (paddings[1], paddings[1])),
+                 constant_values=-jnp.inf)
+    idx = jnp.arange(h * w, dtype=jnp.float32).reshape(1, 1, h, w)
+    idxp = jnp.pad(idx, ((0, 0), (0, 0), (paddings[0], paddings[0]),
+                         (paddings[1], paddings[1])), constant_values=-1)
+    oh = (h + 2 * paddings[0] - ksize[0]) // strides[0] + 1
+    ow = (w + 2 * paddings[1] - ksize[1]) // strides[1] + 1
+    windows = []
+    wins_idx = []
+    for i in range(ksize[0]):
+        for j in range(ksize[1]):
+            windows.append(
+                xp[:, :, i:i + oh * strides[0]:strides[0],
+                   j:j + ow * strides[1]:strides[1]])
+            wins_idx.append(
+                jnp.broadcast_to(
+                    idxp[:, :, i:i + oh * strides[0]:strides[0],
+                         j:j + ow * strides[1]:strides[1]],
+                    (n, c, oh, ow)))
+    stack = jnp.stack(windows, 0)       # [K, N, C, OH, OW]
+    istack = jnp.stack(wins_idx, 0)
+    arg = jnp.argmax(stack, axis=0)
+    out = jnp.max(stack, axis=0)
+    mask = jnp.take_along_axis(istack, arg[None], axis=0)[0]
+    return {"Out": out, "Mask": mask.astype(jnp.int32)}
+
+
+@register_op("max_pool3d_with_index", inputs=["X"],
+             outputs=["Out", "Mask"], stateful_outputs=("Mask",))
+def max_pool3d_with_index(ctx, attrs, X):
+    """3-D max pool returning flat d*h*w argmax indices
+    (pool_with_index_op.cc 3-D registration)."""
+    ksize = [int(k) for k in attrs.get("ksize", [2, 2, 2])]
+    strides = [int(s) for s in attrs.get("strides", ksize)]
+    pads = [int(p) for p in attrs.get("paddings", [0, 0, 0])]
+    if attrs.get("global_pooling", False):
+        ksize = list(X.shape[2:])
+        strides = [1, 1, 1]
+        pads = [0, 0, 0]
+    n, c, d, h, w = X.shape
+    xp = jnp.pad(X, ((0, 0), (0, 0)) + tuple((p, p) for p in pads),
+                 constant_values=-jnp.inf)
+    idx = jnp.arange(d * h * w, dtype=jnp.float32).reshape(1, 1, d, h, w)
+    idxp = jnp.pad(idx, ((0, 0), (0, 0)) + tuple((p, p) for p in pads),
+                   constant_values=-1)
+    od = (d + 2 * pads[0] - ksize[0]) // strides[0] + 1
+    oh = (h + 2 * pads[1] - ksize[1]) // strides[1] + 1
+    ow = (w + 2 * pads[2] - ksize[2]) // strides[2] + 1
+    wins, wins_idx = [], []
+    for i in range(ksize[0]):
+        for j in range(ksize[1]):
+            for k in range(ksize[2]):
+                sl = (slice(None), slice(None),
+                      slice(i, i + od * strides[0], strides[0]),
+                      slice(j, j + oh * strides[1], strides[1]),
+                      slice(k, k + ow * strides[2], strides[2]))
+                wins.append(xp[sl])
+                wins_idx.append(
+                    jnp.broadcast_to(idxp[sl], (n, c, od, oh, ow)))
+    stack = jnp.stack(wins, 0)
+    istack = jnp.stack(wins_idx, 0)
+    arg = jnp.argmax(stack, axis=0)
+    out = jnp.max(stack, axis=0)
+    mask = jnp.take_along_axis(istack, arg[None], axis=0)[0]
+    return {"Out": out, "Mask": mask.astype(jnp.int32)}
+
+
+@register_op("unpool", inputs=["X", "Indices"], outputs=["Out"])
+def unpool(ctx, attrs, X, Indices):
+    """Max unpooling (unpool_op.cc): scatter values back to the argmax
+    positions recorded by max_pool2d_with_index."""
+    out_h, out_w = [int(v) for v in attrs.get("unpooling_type_shape",
+                                              attrs.get("output_size"))]
+    n, c, h, w = X.shape
+    flat = jnp.zeros((n, c, out_h * out_w), X.dtype)
+    idx = Indices.reshape(n, c, h * w).astype(jnp.int32)
+    vals = X.reshape(n, c, h * w)
+    flat = jax.vmap(jax.vmap(lambda f, i, v: f.at[i].add(v)))(
+        flat, idx, vals)
+    return flat.reshape(n, c, out_h, out_w)
+
+
+@register_op("spp", inputs=["X"], outputs=["Out"])
+def spp(ctx, attrs, X):
+    """Spatial pyramid pooling (spp_op.cc): concat flattened adaptive
+    pools at 1x1, 2x2, ... 2^(L-1) bins."""
+    from .nn import _pool_nd
+
+    levels = int(attrs.get("pyramid_height", 2))
+    ptype = attrs.get("pooling_type", "max")
+    n = X.shape[0]
+    outs = []
+    for l in range(levels):
+        bins = 2 ** l
+        pooled = _pool_nd({"pooling_type": ptype, "adaptive": True,
+                           "ksize": [bins, bins]}, X, 2)
+        outs.append(pooled.reshape(n, -1))
+    return jnp.concatenate(outs, axis=1)
+
+
+# ---- interpolation canonical names -------------------------------------
+
+def _interp(ctx, attrs, X, OutSize, method):
+    shape = attrs.get("out_shape") or [int(attrs.get("out_h")),
+                                       int(attrs.get("out_w"))]
+    oh, ow = int(shape[0]), int(shape[1])
+    align = bool(attrs.get("align_corners", True))
+    n, c, h, w = X.shape
+    img = jnp.moveaxis(X, 1, -1)
+    out = jax.image.resize(
+        img, (n, oh, ow, c),
+        method="bilinear" if method == "bilinear" else "nearest")
+    if align and method == "bilinear" and oh > 1 and ow > 1:
+        ys = jnp.linspace(0, h - 1, oh)
+        xs = jnp.linspace(0, w - 1, ow)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        from .vision import _bilinear_sample
+
+        gxn = 2.0 * gx / jnp.maximum(w - 1, 1) - 1.0
+        gyn = 2.0 * gy / jnp.maximum(h - 1, 1) - 1.0
+        return _bilinear_sample(
+            X, jnp.broadcast_to(gxn, (n, oh, ow)),
+            jnp.broadcast_to(gyn, (n, oh, ow)))
+    return jnp.moveaxis(out, -1, 1)
+
+
+@register_op("bilinear_interp", inputs=["X", "OutSize"], outputs=["Out"])
+def bilinear_interp(ctx, attrs, X, OutSize):
+    """interpolate_op.cc bilinear registration."""
+    return _interp(ctx, attrs, X, OutSize, "bilinear")
+
+
+@register_op("nearest_interp", inputs=["X", "OutSize"], outputs=["Out"])
+def nearest_interp(ctx, attrs, X, OutSize):
+    """interpolate_op.cc nearest registration."""
+    return _interp(ctx, attrs, X, OutSize, "nearest")
+
+
+# ---- debug print --------------------------------------------------------
+
+@register_op("print", inputs=["In"], outputs=["Out"])
+def print_op(ctx, attrs, In):
+    """Debug tensor printer (print_op.cc) via jax.debug.print — works
+    under jit, prints asynchronously from the runtime."""
+    msg = attrs.get("message", "")
+    jax.debug.print(msg + "{x}", x=In)
+    return In
+
+
+# ---- fused compositions (XLA fuses; these keep op-level parity) ---------
+
+@register_op("fused_elemwise_activation", inputs=["X", "Y"],
+             outputs=["Out", "IntermediateOut"],
+             stateful_outputs=("IntermediateOut",))
+def fused_elemwise_activation(ctx, attrs, X, Y):
+    """fused/fused_elemwise_activation_op.cc: functor_list like
+    ['elementwise_add', 'relu'] (binary then unary, or unary then
+    binary)."""
+    from . import activations as acts
+    from .registry import get_op_def
+
+    functors = list(attrs.get("functor_list", ["elementwise_add", "relu"]))
+    binary = [f for f in functors if f.startswith("elementwise_")][0]
+    unary = [f for f in functors if not f.startswith("elementwise_")][0]
+    bin_fn = {"elementwise_add": jnp.add, "elementwise_sub": jnp.subtract,
+              "elementwise_mul": jnp.multiply}[binary]
+    un_def = get_op_def(unary)
+    if functors[0] == binary:
+        mid = bin_fn(X, Y)
+        out = un_def.fn(ctx, {}, mid)
+    else:
+        mid = un_def.fn(ctx, {}, Y)
+        out = bin_fn(X, mid)
+    if isinstance(out, dict):
+        out = list(out.values())[0]
+    return {"Out": out, "IntermediateOut": mid}
+
+
+@register_op("fused_embedding_seq_pool", inputs=["W", "Ids", "SeqLen"],
+             outputs=["Out"])
+def fused_embedding_seq_pool(ctx, attrs, W, Ids, SeqLen):
+    """fused/fused_embedding_seq_pool_op.cc: lookup + sum-pool over the
+    sequence dim; padded [B, L] ids (+ optional lengths)."""
+    ids = Ids
+    if ids.ndim == 3 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    emb = jnp.take(W, jnp.maximum(ids.astype(jnp.int32), 0), axis=0)
+    if SeqLen is not None:
+        lengths = jnp.reshape(SeqLen, (-1,)).astype(jnp.int32)
+        m = (jnp.arange(ids.shape[1])[None, :]
+             < lengths[:, None])[:, :, None]
+        emb = jnp.where(m, emb, 0.0)
+    return jnp.sum(emb, axis=1)
+
+
+@register_op("fusion_repeated_fc_relu", inputs=["X", "W*", "Bias*"],
+             outputs=["ReluOut", "Out"], stateful_outputs=("ReluOut",))
+def fusion_repeated_fc_relu(ctx, attrs, X, W, Bias):
+    """fused/fusion_repeated_fc_relu_op.cc: chain of fc+relu."""
+    x = X
+    for i, (w, b) in enumerate(zip(W, Bias)):
+        x = jnp.matmul(x, w) + b.reshape(1, -1)
+        if i < len(W) - 1:
+            x = jnp.maximum(x, 0.0)
+    return {"Out": x, "ReluOut": x}
+
+
+@register_op("fusion_seqconv_eltadd_relu",
+             inputs=["X", "Filter", "Bias", "SeqLen"],
+             outputs=["Out", "ColMat"], stateful_outputs=("ColMat",))
+def fusion_seqconv_eltadd_relu(ctx, attrs, X, Filter, Bias, SeqLen):
+    """fused/fusion_seqconv_eltadd_relu_op.cc = sequence_conv + bias +
+    relu."""
+    from .sequence import sequence_conv
+
+    out = sequence_conv(ctx, attrs, X, Filter, SeqLen)
+    out = out + Bias.reshape(1, 1, -1)
+    return {"Out": jnp.maximum(out, 0.0), "ColMat": out}
+
+
+@register_op("fusion_seqpool_concat", inputs=["X*", "SeqLen*"],
+             outputs=["Out"])
+def fusion_seqpool_concat(ctx, attrs, X, SeqLen):
+    """fused/fusion_seqpool_concat_op.cc: per-input sequence sum/avg pool,
+    then concat."""
+    ptype = attrs.get("pooltype", "SUM").upper()
+    outs = []
+    for i, x in enumerate(X):
+        sl = SeqLen[i] if SeqLen and i < len(SeqLen) else None
+        if sl is not None:
+            lengths = jnp.reshape(sl, (-1,)).astype(jnp.int32)
+            m = (jnp.arange(x.shape[1])[None, :]
+                 < lengths[:, None])[:, :, None]
+            xm = jnp.where(m, x, 0.0)
+            s = jnp.sum(xm, axis=1)
+            if ptype == "AVERAGE":
+                s = s / jnp.maximum(lengths[:, None].astype(x.dtype), 1)
+        else:
+            s = (jnp.mean(x, axis=1) if ptype == "AVERAGE"
+                 else jnp.sum(x, axis=1))
+        outs.append(s)
+    return jnp.concatenate(outs, axis=1)
+
+
+@register_op("fusion_seqexpand_concat_fc",
+             inputs=["X*", "FCWeight", "FCBias"], outputs=["Out", "FCOut"],
+             stateful_outputs=("FCOut",))
+def fusion_seqexpand_concat_fc(ctx, attrs, X, FCWeight, FCBias):
+    """fused/fusion_seqexpand_concat_fc_op.cc: X[0] is [B,T,D0]; the rest
+    are [B,Di] rows broadcast over T; concat + fc + activation."""
+    from . import activations as acts
+    from .registry import get_op_def
+
+    base = X[0]
+    T = base.shape[1]
+    parts = [base]
+    for x in X[1:]:
+        parts.append(jnp.broadcast_to(
+            x[:, None, :], (x.shape[0], T, x.shape[1])))
+    cat = jnp.concatenate(parts, axis=2)
+    out = jnp.matmul(cat, FCWeight)
+    if FCBias is not None:
+        out = out + FCBias.reshape(1, 1, -1)
+    act = attrs.get("fc_activation", "identity")
+    if act not in ("identity", "", None):
+        out = get_op_def(act).fn(ctx, {}, out)
+        if isinstance(out, dict):
+            out = list(out.values())[0]
+    return {"Out": out, "FCOut": out}
+
+
+@register_op("fusion_squared_mat_sub", inputs=["X", "Y"],
+             outputs=["SquaredX", "SquaredY", "SquaredXY", "Out"],
+             stateful_outputs=("SquaredX", "SquaredY", "SquaredXY"))
+def fusion_squared_mat_sub(ctx, attrs, X, Y):
+    """fused/fusion_squared_mat_sub_op.cc: scalar * ((XY)^2 - X^2 Y^2),
+    the FM second-order interaction kernel."""
+    scalar = float(attrs.get("scalar", 1.0))
+    xy = jnp.matmul(X, Y)
+    x2y2 = jnp.matmul(jnp.square(X), jnp.square(Y))
+    return {"SquaredX": jnp.square(X), "SquaredY": jnp.square(Y),
+            "SquaredXY": jnp.square(xy),
+            "Out": scalar * (jnp.square(xy) - x2y2)}
+
+
+@register_op("fc", inputs=["Input", "W", "Bias"], outputs=["Out"])
+def fc_op(ctx, attrs, Input, W, Bias):
+    """Standalone fc op (fc_op.cc; the mkldnn-era fused fc)."""
+    in_num_col_dims = int(attrs.get("in_num_col_dims", 1))
+    import math as _math
+
+    shape = Input.shape
+    x = Input.reshape(_math.prod(shape[:in_num_col_dims]), -1)
+    out = jnp.matmul(x, W)
+    if Bias is not None:
+        out = out + Bias.reshape(1, -1)
+    return out.reshape(tuple(shape[:in_num_col_dims]) + (W.shape[1],))
+
+
+@register_op("get_places", inputs=[], outputs=["Out"], no_grad=True)
+def get_places(ctx, attrs, **kw):
+    """Device-count query (get_places_op.cc) — the mesh owns placement on
+    TPU; returns the device count as a tensor."""
+    import jax as _jax
+
+    return jnp.asarray([_jax.device_count()], jnp.int32)
+
+
+@register_op("sample_logits",
+             inputs=["Logits", "Labels"],
+             outputs=["Samples", "Probabilities", "SampledLogits",
+                      "SampledLabels"],
+             stateful_outputs=("Samples", "Probabilities"))
+def sample_logits(ctx, attrs, Logits, Labels):
+    """sample_logits_op.cc: gather true + log-uniform sampled logits with
+    -log q correction (the decomposed sampled-softmax front half)."""
+    from .nn import _draw_negatives, _sampler_logq
+
+    s_count = int(attrs.get("num_samples", 10))
+    B, C = Logits.shape
+    lbl = jnp.reshape(Labels, (B,)).astype(jnp.int32)
+    neg = _draw_negatives(ctx, 1, s_count, C, attrs.get("seed", 0))
+    s_true = jnp.take_along_axis(Logits, lbl[:, None], axis=1)
+    s_neg = jnp.take(Logits, neg, axis=1)
+    adj_true = s_true - _sampler_logq(1, lbl, C)[:, None]
+    adj_neg = s_neg - _sampler_logq(1, neg, C)[None, :]
+    if attrs.get("remove_accidental_hits", True):
+        adj_neg = jnp.where(neg[None, :] == lbl[:, None], -1e30, adj_neg)
+    sampled = jnp.concatenate([adj_true, adj_neg], axis=1)
+    samples = jnp.concatenate(
+        [lbl[:, None], jnp.broadcast_to(neg[None, :], (B, s_count))],
+        axis=1)
+    return {
+        "Samples": samples.astype(jnp.int64),
+        "Probabilities": jnp.exp(jax.nn.log_softmax(sampled, axis=1)),
+        "SampledLogits": sampled,
+        "SampledLabels": jnp.zeros((B,), jnp.int64),
+    }
+
+
+@register_op("depthwise_conv2d_transpose", inputs=["Input", "Filter"],
+             outputs=["Output"])
+def depthwise_conv2d_transpose(ctx, attrs, Input, Filter):
+    """conv_transpose_op.cc depthwise registration: per-channel transpose
+    conv (groups == channels)."""
+    from .nn import _conv_transpose_padding
+
+    strides = [int(s) for s in attrs.get("strides", [1, 1])]
+    paddings = attrs.get("paddings", [0, 0])
+    dilations = [int(d) for d in attrs.get("dilations", [1, 1])]
+    ksize = Filter.shape[2:]
+    pad = _conv_transpose_padding(paddings, ksize, dilations)
+    c = Input.shape[1]
+    outs = []
+    for ch in range(c):
+        outs.append(jax.lax.conv_transpose(
+            Input[:, ch:ch + 1], Filter[ch:ch + 1, :1],
+            strides=strides, padding=pad, rhs_dilation=dilations,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            transpose_kernel=True))
+    return jnp.concatenate(outs, axis=1)
+
+
+@register_op("lstmp", inputs=["Input", "H0", "C0", "Weight", "ProjWeight",
+                              "Bias", "SeqLen"],
+             outputs=["Projection", "Cell"])
+def lstmp(ctx, attrs, Input, H0, C0, Weight, ProjWeight, Bias, SeqLen):
+    """lstmp_op.cc canonical name for dynamic_lstmp."""
+    from .rnn import dynamic_lstmp
+
+    return dynamic_lstmp(ctx, attrs, Input, H0, C0, Weight, ProjWeight,
+                         Bias, SeqLen)
+
+
+@register_op("max_sequence_len", inputs=["RankTable"], outputs=["Out"],
+             no_grad=True)
+def max_sequence_len(ctx, attrs, RankTable):
+    """max_sequence_len_op.cc: with padded batches the rank table is the
+    lengths tensor; returns its max."""
+    return jnp.max(RankTable).reshape(1).astype(jnp.int64)

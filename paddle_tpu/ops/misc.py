@@ -33,24 +33,25 @@ def chunk_eval(ctx, attrs, Inference, Label, SeqLength):
     valid = jnp.arange(T)[None, :] < lengths[:, None]
 
     def decompose(tags):
+        # the O (outside) tag encodes as chunk_type >= num_chunk_types
+        # (reference chunk_eval_op.h: IOB O = num_types*2, plain O =
+        # num_types); outside positions belong to no chunk
         if scheme == "plain":
             ctype = tags
-            inside = jnp.ones_like(tags, dtype=bool)
-            is_b = jnp.ones_like(tags, dtype=bool)  # refined below
+            is_b = jnp.ones_like(tags, dtype=bool)
         else:  # IOB: B = type*2, I = type*2 + 1
             ctype = tags // 2
             is_b = (tags % 2) == 0
-            inside = jnp.ones_like(tags, dtype=bool)
+        inside = valid & (ctype < num_types)
         prev_type = jnp.concatenate(
             [jnp.full((B, 1), -1, jnp.int32), ctype[:, :-1]], axis=1)
-        prev_valid = jnp.concatenate(
-            [jnp.zeros((B, 1), bool), valid[:, :-1]], axis=1)
+        prev_inside = jnp.concatenate(
+            [jnp.zeros((B, 1), bool), inside[:, :-1]], axis=1)
         if scheme == "plain":
-            begin = valid & ((~prev_valid) | (ctype != prev_type))
+            begin = inside & ((~prev_inside) | (ctype != prev_type))
         else:
-            prev_inside = prev_valid
-            begin = valid & (is_b | (~prev_inside)
-                             | (ctype != prev_type))
+            begin = inside & (is_b | (~prev_inside)
+                              | (ctype != prev_type))
         # end position of the chunk starting at p: next begin - 1 or len-1
         nxt_begin = jnp.concatenate(
             [begin[:, 1:], jnp.ones((B, 1), bool)], axis=1)

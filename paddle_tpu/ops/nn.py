@@ -785,6 +785,7 @@ def conv3d_transpose(ctx, attrs, Input, Filter):
     dilations = [int(d) for d in attrs.get("dilations", [1, 1, 1])]
     if int(attrs.get("groups", 1) or 1) != 1:
         raise NotImplementedError("grouped conv3d_transpose")
+
     ksize = jnp.shape(Filter)[2:]
     pad = _conv_transpose_padding(paddings, ksize, dilations)
     return jax.lax.conv_transpose(
@@ -799,3 +800,42 @@ def conv3d_transpose(ctx, attrs, Input, Filter):
 def pool3d(ctx, attrs, X):
     """NCDHW pooling (pool_op.cc 3-D registration)."""
     return _pool_nd(attrs, X, 3)
+
+
+@register_op("group_norm", inputs=["X", "Scale", "Bias"],
+             outputs=["Y", "Mean", "Variance"],
+             stateful_outputs=("Mean", "Variance"))
+def group_norm_op(ctx, attrs, X, Scale, Bias):
+    """Group normalization (group_norm_op.cc): NCHW, stats per (n, group)."""
+    g = int(attrs.get("groups", 1))
+    eps = float(attrs.get("epsilon", 1e-5))
+    n, c = X.shape[0], X.shape[1]
+    xg = X.reshape((n, g, c // g) + X.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xg - mean), axis=axes, keepdims=True)
+    y = ((xg - mean) * jax.lax.rsqrt(var + eps)).reshape(X.shape)
+    shape = (1, c) + (1,) * (X.ndim - 2)
+    if Scale is not None:
+        y = y * Scale.reshape(shape)
+    if Bias is not None:
+        y = y + Bias.reshape(shape)
+    return {"Y": y, "Mean": mean.reshape(n, g), "Variance": var.reshape(n, g)}
+
+
+@register_op(
+    "sync_batch_norm",
+    inputs=["X", "Scale", "Bias", "Mean", "Variance"],
+    outputs=["Y", "MeanOut", "VarianceOut", "SavedMean", "SavedVariance"],
+    stateful_outputs=("MeanOut", "VarianceOut", "SavedMean",
+                      "SavedVariance"),
+)
+def sync_batch_norm(ctx, attrs, X, Scale, Bias, Mean, Variance):
+    """Cross-device batch norm (sync_batch_norm_op.cu).  Under jit+GSPMD
+    batch stats of a batch-sharded input are ALREADY global, so this is
+    the plain batch_norm lowering registered under the sync name
+    (tests/test_grad_accum_syncbn.py proves the global-stats parity)."""
+    from .registry import get_op_def
+
+    return get_op_def("batch_norm").fn(ctx, attrs, X, Scale, Bias, Mean,
+                                       Variance)

@@ -158,3 +158,84 @@ def fake_qdq_moving_average_grad(ctx, attrs, X, InScale, InAccum, InState,
     s = jnp.maximum(OutScale.reshape(()), 1e-8)
     inside = (jnp.abs(X) <= s).astype(Out_grad.dtype)
     return Out_grad * inside
+
+
+@register_op("fake_quantize_range_abs_max",
+             inputs=["X", "InScale", "Iter"],
+             outputs=["Out", "OutScale", "OutScales"],
+             no_grad=True, stateful_outputs=("OutScale", "OutScales"))
+def fake_quantize_range_abs_max(ctx, attrs, X, InScale, Iter):
+    """Windowed running-max scale (fake_quantize_op.cc range_abs_max):
+    scale = max(current |X| max, previous scale) inside the window."""
+    bin_cnt = _bin_cnt(attrs)
+    cur = jnp.max(jnp.abs(X))
+    window = int(attrs.get("window_size", 10000))
+    if InScale is None:
+        scale = cur
+    else:
+        prev = InScale.reshape(())
+        if Iter is not None:
+            # window boundary resets the running max (reference
+            # FindRangeAbsMaxFunctor: it = iter % window == 0 restarts)
+            at_boundary = (Iter.reshape(()).astype(jnp.int32)
+                           % window) == 0
+            scale = jnp.where(at_boundary, cur, jnp.maximum(cur, prev))
+        else:
+            scale = jnp.maximum(cur, prev)
+    return {
+        "Out": _clip_quant(X, scale, bin_cnt),
+        "OutScale": scale.reshape(1),
+        "OutScales": scale.reshape(1),
+    }
+
+
+@register_op("moving_average_abs_max_scale",
+             inputs=["X", "InAccum", "InState"],
+             outputs=["Out", "OutScale", "OutAccum", "OutState"],
+             no_grad=True,
+             stateful_outputs=("OutScale", "OutAccum", "OutState"))
+def moving_average_abs_max_scale(ctx, attrs, X, InAccum, InState):
+    """Scale observer without quantization
+    (fake_quantize_op.cc moving_average_abs_max_scale)."""
+    rate = float(attrs.get("moving_rate", 0.9))
+    cur = jnp.max(jnp.abs(X))
+    accum = (InAccum.reshape(()) * rate + cur if InAccum is not None
+             else cur)
+    state = (InState.reshape(()) * rate + 1.0 if InState is not None
+             else jnp.asarray(1.0))
+    return {"Out": X, "OutScale": (accum / state).reshape(1),
+            "OutAccum": accum.reshape(1), "OutState": state.reshape(1)}
+
+
+def _affine_q(x, scale, shift, bits):
+    qmax = (1 << bits) - 1
+    return jnp.clip(jnp.round(x * scale + shift), 0, qmax)
+
+
+@register_op("quantize", inputs=["Input"], outputs=["Output"],
+             no_grad=True)
+def quantize(ctx, attrs, Input):
+    """INT8 affine quantize (mkldnn quantize_op.cc: Out = round(X*Scale)
+    + Shift as uint8)."""
+    scale = float(attrs.get("Scale", 1.0))
+    shift = float(attrs.get("Shift", 0.0))
+    return _affine_q(Input, scale, shift, 8).astype(jnp.uint8)
+
+
+@register_op("dequantize", inputs=["Input"], outputs=["Output"],
+             no_grad=True)
+def dequantize(ctx, attrs, Input):
+    """INT8 affine dequantize (mkldnn dequantize_op.cc)."""
+    scale = float(attrs.get("Scale", 1.0))
+    shift = float(attrs.get("Shift", 0.0))
+    return (Input.astype(jnp.float32) - shift) / max(scale, 1e-12)
+
+
+@register_op("requantize", inputs=["Input"], outputs=["Output"],
+             no_grad=True)
+def requantize(ctx, attrs, Input):
+    """INT8 rescale (mkldnn requantize_op.cc)."""
+    sin = float(attrs.get("Scale_in", 1.0))
+    sout = float(attrs.get("Scale_out", 1.0))
+    x = Input.astype(jnp.float32) * (sout / max(sin, 1e-12))
+    return jnp.clip(jnp.round(x), 0, 255).astype(jnp.uint8)

@@ -443,7 +443,20 @@ def deformable_psroi_pooling(ctx, attrs, Input, ROIs, Trans):
     rh = jnp.maximum(y2 - y1, 0.1)
     bin_w = rw / pw
     bin_h = rh / ph
-    feats = Input[batch_idx].reshape(r, out_c, ph, pw, h, w)
+    if c == out_c * ph * pw:
+        # position-sensitive layout: bin (i,j) samples its own channel
+        # group
+        feats = Input[batch_idx].reshape(r, out_c, ph, pw, h, w)
+    elif c == out_c:
+        # plain deformable ROI pooling: every bin samples all channels
+        feats = jnp.broadcast_to(
+            Input[batch_idx][:, :, None, None],
+            (r, out_c, ph, pw, h, w))
+    else:
+        raise ValueError(
+            "deformable_psroi_pooling: channels %d fit neither the "
+            "position-sensitive (out_c*ph*pw=%d) nor plain (out_c=%d) "
+            "layout" % (c, out_c * ph * pw, out_c))
     if Trans is not None and not no_trans:
         tr = Trans.reshape(r, 2, ph, pw) * trans_std
         dy = tr[:, 0] * rh[:, None, None]

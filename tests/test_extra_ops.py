@@ -1,0 +1,284 @@
+"""Oracles for the op-parity batch (ops/extra.py + quant/detection
+stragglers)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.executor import Scope, scope_guard
+from paddle_tpu.ops import registry
+from paddle_tpu.ops.registry import LoweringContext
+
+import jax
+
+
+def call(op, ins, attrs=None):
+    ctx = LoweringContext(base_key=jax.random.key(0), mode="train")
+    opdef = registry.get_op_def(op)
+    slots = {}
+    for slot, v in ins.items():
+        slots[slot] = v if isinstance(v, list) else [v]
+    out = registry.call_op(opdef, ctx, slots, attrs or {})
+    return {k: [np.asarray(x) if x is not None else None for x in v]
+            for k, v in out.items()}
+
+
+def test_simple_losses_and_math():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 1).astype("float32")
+    y = (rng.rand(4, 1) > 0.5).astype("float32")
+    out = call("hinge_loss", {"Logits": x, "Labels": y})["Loss"][0]
+    np.testing.assert_allclose(out, np.maximum(0, 1 - (2 * y - 1) * x),
+                               rtol=1e-6)
+
+    out = call("modified_huber_loss", {"X": x, "Y": y})["Out"][0]
+    z = (2 * y - 1) * x
+    exp = np.where(z >= -1, np.square(np.maximum(0, 1 - z)), -4 * z)
+    np.testing.assert_allclose(out, exp, rtol=1e-5)
+
+    a = rng.randn(3, 4).astype("float32")
+    np.testing.assert_allclose(call("l1_norm", {"X": a})["Out"][0],
+                               np.abs(a).sum(), rtol=1e-6)
+    b = rng.randn(3, 4).astype("float32")
+    np.testing.assert_allclose(
+        call("squared_l2_distance", {"X": a, "Y": b})["Out"][0].ravel(),
+        ((a - b) ** 2).sum(1), rtol=1e-5)
+    np.testing.assert_allclose(call("minus", {"X": a, "Y": b})["Out"][0],
+                               a - b)
+    d = call("diag", {"Diagonal": np.array([1., 2., 3.], "float32")})
+    np.testing.assert_allclose(d["Out"][0], np.diag([1., 2., 3.]))
+    out = call("norm", {"X": a}, {"axis": 1})["Out"][0]
+    np.testing.assert_allclose(out, a / np.sqrt((a**2).sum(1, keepdims=True)
+                                                + 1e-10), rtol=1e-5)
+    cs = call("cos_sim", {"X": a, "Y": b})["Out"][0]
+    exp = (a * b).sum(1) / (np.linalg.norm(a, axis=1)
+                            * np.linalg.norm(b, axis=1))
+    np.testing.assert_allclose(cs.ravel(), exp, rtol=1e-5)
+
+    ce = call("cross_entropy2",
+              {"X": np.array([[0.2, 0.8], [0.5, 0.5]], "float32"),
+               "Label": np.array([[1], [0]], "int64")})["Y"][0]
+    np.testing.assert_allclose(ce.ravel(),
+                               [-np.log(0.8), -np.log(0.5)], rtol=1e-5)
+
+
+def test_conv_shift():
+    x = np.array([[1., 2., 3., 4.]], "float32")
+    y = np.array([[0., 1., 0.]], "float32")  # identity kernel
+    out = call("conv_shift", {"X": x, "Y": y})["Out"][0]
+    np.testing.assert_allclose(out, x, rtol=1e-6)
+
+
+def test_max_pool_with_index_and_unpool_roundtrip():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 3, 4, 4).astype("float32")
+    r = call("max_pool2d_with_index", {"X": x},
+             {"ksize": [2, 2], "strides": [2, 2]})
+    out, mask = r["Out"][0], r["Mask"][0]
+    np.testing.assert_allclose(
+        out, x.reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5)))
+    # unpool scatters back to original positions
+    up = call("unpool", {"X": out, "Indices": mask},
+              {"output_size": [4, 4]})["Out"][0]
+    assert up.shape == x.shape
+    np.testing.assert_allclose(up.max(axis=(2, 3)), out.max(axis=(2, 3)))
+    assert (np.count_nonzero(up.reshape(2, 3, -1), axis=2) <= 4).all()
+
+
+def test_spp_shapes():
+    x = np.random.RandomState(2).randn(2, 3, 8, 8).astype("float32")
+    out = call("spp", {"X": x}, {"pyramid_height": 2,
+                                 "pooling_type": "max"})["Out"][0]
+    assert out.shape == (2, 3 * (1 + 4))
+
+
+def test_interp_ops():
+    x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    out = call("nearest_interp", {"X": x},
+               {"out_h": 2, "out_w": 2, "align_corners": False})["Out"][0]
+    assert out.shape == (1, 1, 2, 2)
+    out = call("bilinear_interp", {"X": x},
+               {"out_h": 8, "out_w": 8, "align_corners": True})["Out"][0]
+    assert out.shape == (1, 1, 8, 8)
+    np.testing.assert_allclose(out[0, 0, 0, 0], 0.0, atol=1e-5)
+    np.testing.assert_allclose(out[0, 0, -1, -1], 15.0, atol=1e-4)
+
+
+def test_fused_family():
+    rng = np.random.RandomState(3)
+    x = rng.randn(3, 4).astype("float32")
+    y = rng.randn(3, 4).astype("float32")
+    r = call("fused_elemwise_activation", {"X": x, "Y": y},
+             {"functor_list": ["elementwise_add", "relu"]})
+    np.testing.assert_allclose(r["Out"][0], np.maximum(x + y, 0), rtol=1e-6)
+
+    W = rng.randn(10, 5).astype("float32")
+    ids = rng.randint(0, 10, (2, 4)).astype("int64")
+    lens = np.array([4, 2], "int64")
+    r = call("fused_embedding_seq_pool", {"W": W, "Ids": ids,
+                                          "SeqLen": lens})
+    exp = np.stack([W[ids[0]].sum(0), W[ids[1, :2]].sum(0)])
+    np.testing.assert_allclose(r["Out"][0], exp, rtol=1e-5)
+
+    ws = [rng.randn(4, 6).astype("float32"), rng.randn(6, 2).astype("float32")]
+    bs = [np.zeros(6, "float32"), np.zeros(2, "float32")]
+    r = call("fusion_repeated_fc_relu", {"X": x, "W": ws, "Bias": bs})
+    exp = np.maximum(x @ ws[0], 0) @ ws[1]
+    np.testing.assert_allclose(r["Out"][0], exp, rtol=1e-4)
+
+    a = rng.randn(2, 3).astype("float32")
+    b = rng.randn(3, 4).astype("float32")
+    r = call("fusion_squared_mat_sub", {"X": a, "Y": b}, {"scalar": 0.5})
+    exp = 0.5 * ((a @ b) ** 2 - (a ** 2) @ (b ** 2))
+    np.testing.assert_allclose(r["Out"][0], exp, rtol=1e-4)
+
+    seqs = [rng.randn(2, 3, 4).astype("float32"),
+            rng.randn(2, 5, 4).astype("float32")]
+    r = call("fusion_seqpool_concat", {"X": seqs, "SeqLen": []},
+             {"pooltype": "SUM"})
+    exp = np.concatenate([seqs[0].sum(1), seqs[1].sum(1)], axis=1)
+    np.testing.assert_allclose(r["Out"][0], exp, rtol=1e-5)
+
+
+def test_fc_and_sample_logits():
+    rng = np.random.RandomState(4)
+    x = rng.randn(3, 4).astype("float32")
+    w = rng.randn(4, 5).astype("float32")
+    b = rng.randn(5).astype("float32")
+    r = call("fc", {"Input": x, "W": w, "Bias": b})
+    np.testing.assert_allclose(r["Out"][0], x @ w + b, rtol=1e-5)
+
+    logits = rng.randn(4, 20).astype("float32")
+    lab = rng.randint(0, 20, (4, 1)).astype("int64")
+    r = call("sample_logits", {"Logits": logits, "Labels": lab},
+             {"num_samples": 6})
+    assert r["SampledLogits"][0].shape == (4, 7)
+    assert (r["Samples"][0][:, 0] == lab[:, 0]).all()
+
+
+def test_quant_family():
+    x = np.array([[0.5, -1.5, 2.0]], "float32")
+    q = call("quantize", {"Input": x}, {"Scale": 10.0})["Output"][0]
+    np.testing.assert_array_equal(q, [[5, 0, 20]])
+    dq = call("dequantize", {"Input": q.astype("float32")},
+              {"Scale": 10.0})["Output"][0]
+    np.testing.assert_allclose(dq, [[0.5, 0.0, 2.0]], rtol=1e-5)
+    rq = call("requantize", {"Input": q.astype("float32")},
+              {"Scale_in": 10.0, "Scale_out": 20.0})["Output"][0]
+    np.testing.assert_array_equal(rq, [[10, 0, 40]])
+
+    r = call("fake_quantize_range_abs_max",
+             {"X": x, "InScale": np.array([3.0], "float32")},
+             {"bit_length": 8})
+    assert float(r["OutScale"][0][0]) == 3.0
+    r = call("moving_average_abs_max_scale",
+             {"X": x, "InAccum": np.array([1.0], "float32"),
+              "InState": np.array([1.0], "float32")},
+             {"moving_rate": 0.9})
+    np.testing.assert_allclose(r["OutAccum"][0], [0.9 + 2.0], rtol=1e-5)
+
+
+def test_group_norm_and_sync_bn_ops():
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 4, 3, 3).astype("float32")
+    r = call("group_norm", {"X": x}, {"groups": 2, "epsilon": 1e-5})
+    y = r["Y"][0]
+    xg = x.reshape(2, 2, 2, 3, 3)
+    exp = (xg - xg.mean(axis=(2, 3, 4), keepdims=True)) / np.sqrt(
+        xg.var(axis=(2, 3, 4), keepdims=True) + 1e-5)
+    np.testing.assert_allclose(y, exp.reshape(x.shape), rtol=1e-4,
+                               atol=1e-5)
+
+    scale = np.ones(4, "float32")
+    bias = np.zeros(4, "float32")
+    mean = np.zeros(4, "float32")
+    var = np.ones(4, "float32")
+    r = call("sync_batch_norm",
+             {"X": x, "Scale": scale, "Bias": bias, "Mean": mean,
+              "Variance": var},
+             {"momentum": 0.9, "epsilon": 1e-5, "is_test": False})
+    assert r["Y"][0].shape == x.shape
+
+
+def test_bipartite_match_and_target_assign():
+    dist = np.array([[0.9, 0.1, 0.3],
+                     [0.2, 0.8, 0.4]], "float32")  # 2 gt, 3 priors
+    r = call("bipartite_match", {"DistMat": dist},
+             {"match_type": "per_prediction", "dist_threshold": 0.35})
+    idx = r["ColToRowMatchIndices"][0][0]
+    np.testing.assert_array_equal(idx[:2], [0, 1])
+    assert idx[2] == 1  # per-prediction fills col 2 (best row 1, 0.4>=.35)
+
+    x = np.array([[1., 2.], [3., 4.]], "float32")  # 2 gt entities
+    mi = np.array([[0, -1, 1]], "int32")
+    r = call("target_assign", {"X": x, "MatchIndices": mi},
+             {"mismatch_value": 0})
+    out = r["Out"][0]
+    np.testing.assert_allclose(out[0, 0], [1., 2.])
+    np.testing.assert_allclose(out[0, 1], [0., 0.])
+    np.testing.assert_allclose(out[0, 2], [3., 4.])
+
+
+def test_mine_hard_examples():
+    cls_loss = np.array([[0.1, 0.9, 0.5, 0.7]], "float32")
+    mi = np.array([[0, -1, -1, -1]], "int32")  # 1 positive, 3 negatives
+    r = call("mine_hard_examples",
+             {"ClsLoss": cls_loss, "MatchIndices": mi},
+             {"neg_pos_ratio": 2.0, "mining_type": "max_negative"})
+    neg = r["NegIndices"][0][0]
+    # 2 hardest negatives: priors 1 (0.9) and 3 (0.7)
+    assert set(neg[neg >= 0].tolist()) == {1, 3}
+
+
+def test_print_op_passthrough():
+    x = np.ones((2, 2), "float32")
+    out = call("print", {"In": x}, {"message": "dbg: "})["Out"][0]
+    np.testing.assert_allclose(out, x)
+
+
+def test_max_pool3d_with_index_real_indices():
+    rng = np.random.RandomState(6)
+    x = rng.randn(1, 2, 4, 4, 4).astype("float32")
+    r = call("max_pool3d_with_index", {"X": x},
+             {"ksize": [2, 2, 2], "strides": [2, 2, 2]})
+    out, mask = r["Out"][0], r["Mask"][0]
+    exp = x.reshape(1, 2, 2, 2, 2, 2, 2, 2).max(axis=(3, 5, 7))
+    np.testing.assert_allclose(out, exp)
+    flat = x.reshape(1, 2, -1)
+    picked = np.take_along_axis(flat, mask.reshape(1, 2, -1), axis=2)
+    np.testing.assert_allclose(picked.reshape(out.shape), out)
+
+
+def test_chunk_eval_outside_tag():
+    """O tag (chunk_type >= num_types) must not count as a chunk."""
+    inf = np.array([[0, 4, 4, 2, 4]], "int64")  # B0, O, O, B1, O
+    lab = np.array([[0, 4, 4, 2, 4]], "int64")
+    from test_nn_extra_ops import run_layer, _data
+    import paddle_tpu as fluid
+
+    p, r, f1, ni, nl, nc = run_layer(
+        lambda: fluid.layers.chunk_eval(
+            _data("i", inf), _data("l", lab), "IOB", 2),
+        {"i": inf, "l": lab}, n_out=6)
+    assert int(ni[0]) == 2 and int(nl[0]) == 2 and int(nc[0]) == 2
+    np.testing.assert_allclose(f1, 1.0)
+
+
+def test_bipartite_match_batched():
+    dist = np.stack([
+        np.array([[0.9, 0.1], [0.2, 0.8]], "float32"),
+        np.array([[0.1, 0.9], [0.8, 0.2]], "float32"),
+    ])
+    r = call("bipartite_match", {"DistMat": dist}, {})
+    idx = r["ColToRowMatchIndices"][0]
+    np.testing.assert_array_equal(idx[0], [0, 1])
+    np.testing.assert_array_equal(idx[1], [1, 0])
+
+
+def test_mine_hard_examples_quota_capped():
+    cls_loss = np.array([[0.1, 0.9, 0.5, 0.7]], "float32")
+    mi = np.array([[0, 1, 0, -1]], "int32")  # 3 positives, 1 negative
+    r = call("mine_hard_examples",
+             {"ClsLoss": cls_loss, "MatchIndices": mi},
+             {"neg_pos_ratio": 3.0})
+    neg = r["NegIndices"][0][0]
+    assert (neg >= 0).sum() == 1 and neg[0] == 3

@@ -139,3 +139,36 @@ class TestDatasets:
                         first = l
                     last = l
         assert last < first * 0.2, (first, last)
+
+
+def test_new_canned_datasets_shapes():
+    """flowers/conll05/wmt14/wmt16/movielens/sentiment surrogates keep the
+    reference sample layouts (python/paddle/dataset/*)."""
+    from paddle_tpu import datasets
+
+    img, label = next(datasets.flowers.train()())
+    assert img.shape == (3, 224, 224) and 0 <= label < 102
+
+    sample = next(datasets.conll05.test()())
+    # word + 5 ctx windows + predicate + mark + labels = 9 slots
+    assert len(sample) == 9
+    n = len(sample[0])
+    assert all(len(s) == n for s in sample)
+    wd, vd, ld = datasets.conll05.get_dict()
+    assert len(ld) == 59
+    emb = datasets.conll05.get_embedding()
+    assert emb.shape[0] == len(wd)
+
+    src, trg_in, trg_next = next(datasets.wmt14.train(1000)())
+    assert trg_in[0] == 0 and trg_next[-1] == 1
+    assert len(trg_in) == len(trg_next)
+
+    s2, t2in, t2next = next(datasets.wmt16.validation(500, 600)())
+    assert max(s2) < 500 and max(t2in) < 600
+
+    row = next(datasets.movielens.train()())
+    assert len(row) == 8 and 1 <= row[-1] <= 5
+    assert datasets.movielens.max_user_id() == 6040
+
+    ids, lab = next(datasets.sentiment.train()())
+    assert lab in (0, 1) and len(ids) > 0

@@ -1,0 +1,156 @@
+"""slim pruning / distillation / NAS (reference contrib/slim/{prune,
+distillation,searcher,nas})."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.executor import Scope, scope_guard
+from paddle_tpu.contrib.slim import prune, distillation, nas
+
+
+def test_structure_pruner_and_magnitude():
+    p = np.array([[1.0, -5.0, 0.1], [2.0, 6.0, 0.2]], "float32")
+    sp = prune.StructurePruner({"*": 1})
+    idx = sp.cal_pruned_idx("w", p, 1 / 3)
+    np.testing.assert_array_equal(idx, [2])  # col 2 has smallest l1
+    pruned = sp.prune_tensor(p, idx, axis=1)
+    assert pruned.shape == (2, 2)
+    lazy = sp.prune_tensor(p, idx, axis=1, lazy=True)
+    assert lazy.shape == p.shape and (lazy[:, 2] == 0).all()
+
+    mp = prune.MagnitudePruner(0.5)
+    out = mp.prune(np.array([1.0, -0.1, 3.0, 0.2], "float32"))
+    assert (out == np.array([1.0, 0.0, 3.0, 0.0], "float32")).all()
+
+
+def test_sensitivity_analysis_and_lazy_prune_in_scope():
+    rng = np.random.RandomState(0)
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, size=16, act="relu",
+                            param_attr=fluid.ParamAttr(name="sens.w1"))
+        p = fluid.layers.fc(h, size=1,
+                            param_attr=fluid.ParamAttr(name="sens.w2"))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(p, y))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = Scope()
+    with scope_guard(scope):
+        exe.run(startup)
+        feed = {"x": rng.randn(16, 8).astype("float32"),
+                "y": rng.randn(16, 1).astype("float32")}
+        rep = prune.sensitivity_analysis(
+            exe, main, feed, loss, scope, ["sens.w1"], ratios=(0.5,))
+    assert 0.0 in rep["sens.w1"] and 0.5 in rep["sens.w1"]
+    # restoring happened: scope weight unchanged after analysis
+    assert np.asarray(scope.get("sens.w1")).shape == (8, 16)
+
+
+def test_distillation_losses_train_student_towards_teacher():
+    rng = np.random.RandomState(1)
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        teacher = fluid.layers.fc(
+            x, size=3, param_attr=fluid.ParamAttr(
+                name="t.w", initializer=fluid.initializer.Constant(0.7)),
+            bias_attr=False)
+        teacher.stop_gradient = True
+        student = fluid.layers.fc(
+            x, size=3, param_attr=fluid.ParamAttr(name="s.w"),
+            bias_attr=False)
+        l2 = distillation.l2_loss(teacher, student)
+        soft = distillation.SoftLabelDistiller().distiller_loss(
+            student, teacher)
+        loss = fluid.layers.elementwise_add(l2, soft)
+        fluid.optimizer.Adam(5e-2).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        xv = rng.randn(16, 4).astype("float32")
+        losses = [float(np.asarray(exe.run(main, feed={"x": xv},
+                                           fetch_list=[loss])[0]).reshape(()))
+                  for _ in range(80)]
+        sw = np.asarray(fluid.executor.global_scope().get("s.w"))
+    # the soft-label CE term floors at the teacher's entropy, so assert
+    # improvement + convergence of the student weights to the teacher's
+    assert losses[-1] < 0.5 * losses[0]
+    np.testing.assert_allclose(sw, 0.7, atol=0.15)
+
+
+def test_fsp_distiller_builds():
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3, 8, 8], dtype="float32")
+        t1 = fluid.layers.conv2d(x, num_filters=4, filter_size=3, padding=1)
+        t2 = fluid.layers.conv2d(t1, num_filters=6, filter_size=3, padding=1)
+        s1 = fluid.layers.conv2d(x, num_filters=4, filter_size=3, padding=1)
+        s2 = fluid.layers.conv2d(s1, num_filters=6, filter_size=3, padding=1)
+        loss = distillation.FSPDistiller().distiller_loss(
+            [(s1, s2)], [(t1, t2)])
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        out = exe.run(main, feed={
+            "x": np.random.RandomState(2).randn(2, 3, 8, 8).astype(
+                "float32")}, fetch_list=[loss])[0]
+    assert np.isfinite(out).all()
+
+
+def test_sa_nas_finds_optimum_on_toy_space():
+    class Toy(nas.SearchSpace):
+        def init_tokens(self):
+            return [0, 0, 0]
+
+        def range_table(self):
+            return [5, 5, 5]
+
+        def create_net(self, tokens):
+            return tokens
+
+    # reward maximized at tokens == [4, 4, 4]
+    best, reward = nas.light_nas_search(
+        Toy(), lambda t: sum(t), search_steps=200)
+    assert reward >= 10, (best, reward)
+
+
+def test_sa_controller_respects_constraint():
+    ctl = nas.SAController()
+    ctl.reset([4, 4], [0, 0], constrain_func=lambda t: sum(t) <= 3)
+    for _ in range(20):
+        t = ctl.next_tokens()
+        assert sum(t) <= 3
+        ctl.update(t, float(sum(t)))
+
+
+def test_weighted_average_and_evaluators():
+    from paddle_tpu.average import WeightedAverage
+    from paddle_tpu import evaluator as ev
+
+    wa = WeightedAverage()
+    wa.add(2.0, 1)
+    wa.add(4.0, 3)
+    np.testing.assert_allclose(wa.eval(), 3.5)
+
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        inf = fluid.layers.data("inf", shape=[5], dtype="int64")
+        lab = fluid.layers.data("lab", shape=[5], dtype="int64")
+        sl = fluid.layers.data("sl", shape=[], dtype="int64")
+        chunk_ev = ev.ChunkEvaluator(inf, lab, "IOB", 2, seq_length=sl)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        feed = {"inf": np.array([[0, 1, 2, 3, 0]], "int64"),
+                "lab": np.array([[0, 1, 2, 2, 0]], "int64"),
+                "sl": np.array([5], "int64")}
+        exe.run(main, feed=feed, fetch_list=[])
+        exe.run(main, feed=feed, fetch_list=[])
+        p, r, f1 = chunk_ev.eval(exe)
+        np.testing.assert_allclose(p[0], 2 / 3, rtol=1e-6)
+        np.testing.assert_allclose(r[0], 0.5, rtol=1e-6)

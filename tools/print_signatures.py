@@ -32,6 +32,34 @@ MODULES = [
     "paddle_tpu.transpiler",
     "paddle_tpu.dygraph",
     "paddle_tpu.contrib.mixed_precision",
+    "paddle_tpu.contrib.slim.quantization",
+    "paddle_tpu.contrib.slim.prune",
+    "paddle_tpu.contrib.slim.distillation",
+    "paddle_tpu.contrib.slim.nas",
+    "paddle_tpu.datasets.mnist",
+    "paddle_tpu.datasets.cifar",
+    "paddle_tpu.datasets.imdb",
+    "paddle_tpu.datasets.uci_housing",
+    "paddle_tpu.datasets.flowers",
+    "paddle_tpu.datasets.conll05",
+    "paddle_tpu.datasets.wmt14",
+    "paddle_tpu.datasets.wmt16",
+    "paddle_tpu.datasets.movielens",
+    "paddle_tpu.datasets.sentiment",
+    "paddle_tpu.datasets.common",
+    "paddle_tpu.reader_decorators",
+    "paddle_tpu.data_feeder",
+    "paddle_tpu.reader",
+    "paddle_tpu.unique_name",
+    "paddle_tpu.param_attr",
+    "paddle_tpu.incubate.fleet.base.role_maker",
+    "paddle_tpu.incubate.fleet.collective",
+    "paddle_tpu.parallel",
+    "paddle_tpu.compiler",
+    "paddle_tpu.executor",
+    "paddle_tpu.framework",
+    "paddle_tpu.average",
+    "paddle_tpu.evaluator",
 ]
 
 
