@@ -33,6 +33,9 @@ _configure_jax()
 
 from . import core
 from . import average
+from . import analysis
+from . import trainer_desc
+from . import device_worker
 from . import evaluator
 from .framework import (
     Program,

@@ -1,0 +1,167 @@
+"""Inference analysis pass pipeline (reference:
+``paddle/fluid/inference/analysis/`` — Analyzer runs a configured pass
+pipeline (ir_graph_build, ir_analysis passes, memory_optimize) over the
+loaded program before handing it to the executor;
+``paddle/fluid/framework/ir/fc_fuse_pass.cc`` and friends).
+
+TPU note: XLA performs instruction-level fusion and DCE at jit time, so
+these passes exist for PROGRAM-level parity (smaller op lists, fused op
+types visible to program inspection/serialization) and for numeric folds
+that change weights (conv+bn).  Passes are program→program functions on
+the framework IR, registered by name like the reference's PassRegistry."""
+
+__all__ = ["register_pass", "get_pass", "PassBuilder", "Analyzer",
+           "fc_fuse_pass", "dead_code_elimination_pass",
+           "conv_bn_fuse_pass"]
+
+_PASSES = {}
+
+
+def register_pass(name):
+    def deco(fn):
+        _PASSES[name] = fn
+        return fn
+
+    return deco
+
+
+def get_pass(name):
+    return _PASSES[name]
+
+
+@register_pass("conv_bn_fuse_pass")
+def conv_bn_fuse_pass(program, scope=None, targets=None):
+    """Fold batch-norm statistics into conv weights
+    (ir/conv_bn_fuse_pass.cc; numeric rewrite of the weights)."""
+    from .inference import fuse_conv_bn
+
+    if scope is None:
+        from .executor import global_scope
+
+        scope = global_scope()
+    fuse_conv_bn(program, scope)
+    return program
+
+
+@register_pass("fc_fuse_pass")
+def fc_fuse_pass(program, scope=None, targets=None):
+    """mul + elementwise_add(bias) → one fc op (ir/fc_fuse_pass.cc).
+
+    Matches when the mul output has exactly one consumer (the add) and
+    the add's Y operand is a 1-D persistable bias."""
+    block = program.global_block()
+    ops = block.ops
+    consumers = {}
+    for op in ops:
+        for n in op.input_arg_names:
+            consumers.setdefault(n, []).append(op)
+    fused = 0
+    i = 0
+    while i < len(block.ops):
+        op = block.ops[i]
+        if op.type != "mul" or int(op.attrs.get("y_num_col_dims", 1)) != 1:
+            i += 1
+            continue
+        out = op.outputs["Out"][0]
+        if targets and out in targets:
+            # the intermediate is itself a fetch target: fusing would
+            # leave it unproduced
+            i += 1
+            continue
+        cons = consumers.get(out, [])
+        if len(cons) != 1 or cons[0].type != "elementwise_add":
+            i += 1
+            continue
+        add = cons[0]
+        if add.inputs.get("X", [None])[0] != out:
+            i += 1
+            continue
+        # the bias must broadcast over the LAST dim (fc semantics): axis
+        # -1 or == mul's x_num_col_dims
+        axis = int(add.attrs.get("axis", -1))
+        if axis not in (-1, int(op.attrs.get("x_num_col_dims", 1))):
+            i += 1
+            continue
+        bias_name = add.inputs.get("Y", [None])[0]
+        bias_var = block._find_var_recursive(bias_name)
+        if bias_var is None or not bias_var.persistable \
+                or len(bias_var.shape or ()) != 1:
+            i += 1
+            continue
+        j = block.ops.index(add)
+        from .framework import Operator
+
+        fc = Operator(
+            block, "fc",
+            {"Input": list(op.inputs["X"]), "W": list(op.inputs["Y"]),
+             "Bias": [bias_name]},
+            {"Out": list(add.outputs["Out"])},
+            {"in_num_col_dims": int(op.attrs.get("x_num_col_dims", 1))},
+        )
+        block.ops[i] = fc
+        del block.ops[j]
+        fused += 1
+        i += 1
+    if fused:
+        program._bump_version()
+    return program
+
+
+@register_pass("dead_code_elimination_pass")
+def dead_code_elimination_pass(program, scope=None, targets=None):
+    """Remove ops whose outputs never reach the targets (the analysis
+    memory_optimize/prune role; XLA also DCEs at jit, this shrinks the
+    PROGRAM)."""
+    if not targets:
+        return program
+    block = program.global_block()
+    needed = set(targets)
+    keep = []
+    for op in reversed(block.ops):
+        outs = set(op.output_arg_names)
+        writes_persistable = any(
+            (v := block._find_var_recursive(n)) is not None and v.persistable
+            for n in outs)
+        if outs & needed or writes_persistable or op.type in (
+                "feed", "fetch", "print"):
+            keep.append(op)
+            needed.update(op.input_arg_names)
+    if len(keep) != len(block.ops):
+        block.ops[:] = list(reversed(keep))
+        program._bump_version()
+    return program
+
+
+class PassBuilder:
+    """Mutable pass pipeline (reference paddle_pass_builder.h)."""
+
+    DEFAULT = ["conv_bn_fuse_pass", "fc_fuse_pass",
+               "dead_code_elimination_pass"]
+
+    def __init__(self, passes=None):
+        self._passes = list(passes if passes is not None else self.DEFAULT)
+
+    def all_passes(self):
+        return list(self._passes)
+
+    def append_pass(self, name):
+        self._passes.append(name)
+
+    def delete_pass(self, name):
+        self._passes = [p for p in self._passes if p != name]
+
+    def insert_pass(self, idx, name):
+        self._passes.insert(idx, name)
+
+
+class Analyzer:
+    """Run the configured pipeline (reference analysis/analyzer.h:
+    Analyzer::RunAnalysis)."""
+
+    def __init__(self, pass_builder=None):
+        self._builder = pass_builder or PassBuilder()
+
+    def run(self, program, scope=None, targets=None):
+        for name in self._builder.all_passes():
+            program = get_pass(name)(program, scope=scope, targets=targets)
+        return program

@@ -1,13 +1,22 @@
 """Dataset → executor bridge (reference: ``Executor::RunFromDataset``,
-``executor.cc:120`` → trainers/device workers).  The Dataset/DataFeed
-pipeline lands with the CTR batch; this keeps the Executor entry points
-importable."""
+``executor.cc:120`` → TrainerFactory → trainers/device workers).  The
+thread-per-core C++ worker runtime is subsumed by the jitted SPMD step;
+the TrainerDesc/DeviceWorker configuration surface survives via
+``trainer_desc.TrainerFactory`` (reference trainer_factory.cc)."""
 
 
 def run_from_dataset(executor, program, dataset, scope, fetch_list,
                      fetch_info, print_period, train=True):
+    from .trainer_desc import TrainerFactory
+
     if dataset is None:
         raise ValueError("dataset is required")
+    opt_info = getattr(program, "_opt_info", None) or {}
+    trainer = TrainerFactory()._create_trainer(opt_info)
+    trainer._set_program(program)
+    trainer._set_infer(not train)
+    trainer._set_fetch_var_and_info(fetch_list, fetch_info, print_period)
+    program._trainer_desc = trainer
     it = dataset.batch_iterator()
     results = []
     for i, feed in enumerate(it):

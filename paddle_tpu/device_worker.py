@@ -1,0 +1,48 @@
+"""Device-worker facades (reference:
+``python/paddle/fluid/device_worker.py`` — Hogwild/DownpourSGD/Section
+configure the per-thread C++ workers, ``framework/device_worker.h``).
+
+On TPU the 'worker' is the jitted SPMD step; these classes keep the
+configuration surface and record their role for the dataset runtime."""
+
+__all__ = ["DeviceWorker", "Hogwild", "DownpourSGD", "Section"]
+
+
+class DeviceWorker:
+    """reference device_worker.py:18."""
+
+    def __init__(self):
+        self._infer = False
+        self._fleet_desc = None
+        self._program = None
+        self._trainer = None
+
+    def _set_infer(self, infer=False):
+        self._infer = infer
+
+    def _set_fleet_desc(self, fleet_desc):
+        self._fleet_desc = fleet_desc
+
+    def _set_program(self, program):
+        self._program = program
+
+    def _set_trainer(self, trainer):
+        self._trainer = trainer
+
+    def _gen_worker_desc(self, trainer_desc):
+        return trainer_desc
+
+
+class Hogwild(DeviceWorker):
+    """Lock-free per-thread SGD in the reference (hogwild_worker.cc);
+    the single jitted step subsumes it — all 'threads' are XLA cores."""
+
+
+class DownpourSGD(DeviceWorker):
+    """Pserver pull/push worker (downpour_worker.cc); the sparse path is
+    sharded embeddings over the mesh, so the worker is the same step."""
+
+
+class Section(DeviceWorker):
+    """Pipeline-stage worker (section_worker.cc); scheduling is
+    parallel.gpipe's shard_map program, not scope queues."""

@@ -159,19 +159,27 @@ class InferenceTranspiler:
 
 class AnalysisConfig:
     """Reference ``api/paddle_analysis_config.h`` (subset: model path +
-    optimization switches; device knobs are meaningless off-GPU)."""
+    optimization switches + pass pipeline; device knobs are meaningless
+    off-GPU)."""
 
     def __init__(self, model_dir=None, prog_file=None, params_file=None):
+        from .analysis import PassBuilder
+
         self.model_dir = model_dir
         self.prog_file = prog_file
         self.params_file = params_file
         self._ir_optim = True
+        self._pass_builder = PassBuilder()
 
     def switch_ir_optim(self, flag=True):
         self._ir_optim = bool(flag)
 
     def ir_optim(self):
         return self._ir_optim
+
+    def pass_builder(self):
+        """Mutable pipeline (reference AnalysisConfig::pass_builder)."""
+        return self._pass_builder
 
 
 class AnalysisPredictor:
@@ -207,7 +215,11 @@ class AnalysisPredictor:
                 model_filename=prog_file,
                 params_filename=params_file)
             if config.ir_optim():
-                fuse_conv_bn(program, self._scope)
+                from .analysis import Analyzer
+
+                program = Analyzer(config.pass_builder()).run(
+                    program, scope=self._scope,
+                    targets=[v.name for v in fetch_vars])
         self._program = program
         self._feed_names = feed_names
         self._fetch_vars = fetch_vars

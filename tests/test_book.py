@@ -150,3 +150,73 @@ class TestRecommender:
                 first = first if first is not None else l
                 last = l
         assert last < first * 0.6, (first, last)
+
+
+class TestLabelSemanticRoles:
+    """book/test_label_semantic_roles.py: SRL tagging with word+context
+    +predicate embeddings -> CRF loss, viterbi decode + chunk precision
+    (reference model uses conll05; padded + lengths here)."""
+
+    def test_train_and_decode(self):
+        from paddle_tpu import datasets
+
+        T, NTAG = 12, 59
+        wd, vd, ld = datasets.conll05.get_dict()
+        WORDS, VERBS = 600, 50  # truncated vocab for the test
+
+        fluid.unique_name.switch()
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 7
+        with fluid.program_guard(main, startup):
+            word = fluid.layers.data("word", shape=[T], dtype="int64")
+            verb = fluid.layers.data("verb", shape=[T], dtype="int64")
+            mark = fluid.layers.data("mark", shape=[T], dtype="int64")
+            lens = fluid.layers.data("lens", shape=[], dtype="int64")
+            tags = fluid.layers.data("tags", shape=[T], dtype="int64")
+            embs = [
+                fluid.layers.embedding(word, size=[WORDS, 32]),
+                fluid.layers.embedding(verb, size=[VERBS, 16]),
+                fluid.layers.embedding(mark, size=[2, 8]),
+            ]
+            x = fluid.layers.concat(embs, axis=2)
+            h = fluid.layers.fc(x, size=64, num_flatten_dims=2, act="tanh")
+            emission = fluid.layers.fc(h, size=NTAG, num_flatten_dims=2)
+            crf_attr = fluid.ParamAttr(name="srl.crfw")
+            nll = fluid.layers.linear_chain_crf(
+                emission, tags, param_attr=crf_attr, length=lens)
+            loss = fluid.layers.mean(nll)
+            test_prog = main.clone(for_test=True)
+            fluid.optimizer.Adam(5e-2).minimize(loss)
+        with fluid.program_guard(test_prog):
+            em = test_prog.global_block().var(emission.name)
+            path = fluid.layers.crf_decoding(
+                em, crf_attr,
+                length=test_prog.global_block().var("lens"))
+
+        rng = np.random.RandomState(0)
+
+        def batch(bs=16):
+            n = rng.randint(4, T + 1, (bs,)).astype("int64")
+            w = rng.randint(0, WORDS, (bs, T)).astype("int64")
+            v = rng.randint(0, VERBS, (bs, T)).astype("int64")
+            m = (rng.rand(bs, T) < 0.1).astype("int64")
+            t = w % NTAG  # learnable per-word rule
+            return {"word": w, "verb": v, "mark": m, "lens": n,
+                    "tags": t.astype("int64")}
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            exe.run(startup)
+            first = last = None
+            for _ in range(120):
+                f = batch()
+                (l,) = exe.run(main, feed=f, fetch_list=[loss])
+                l = float(np.asarray(l).reshape(()))
+                first = first if first is not None else l
+                last = l
+            assert last < 0.5 * first, (first, last)
+            # decode runs and emits valid tags within lengths
+            f = batch(4)
+            p = exe.run(test_prog, feed=f, fetch_list=[path])[0]
+            assert p.shape == (4, T)
+            assert (p >= 0).all() and (p < NTAG).all()

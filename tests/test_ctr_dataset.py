@@ -147,3 +147,20 @@ def test_queue_dataset_streams():
     assert len(batches) == 3  # 4+4+2
     assert batches[0]["slot"].shape == (4, 2)
     assert batches[-1]["dense"].shape == (2, 2)
+
+
+def test_trainer_factory_and_desc_wiring():
+    """TrainerDesc/DeviceWorker config surface (reference trainer_desc.py
+    + device_worker.py + trainer_factory.py), recorded by
+    run_from_dataset."""
+    from paddle_tpu.trainer_desc import TrainerFactory, MultiTrainer
+    from paddle_tpu.device_worker import Hogwild, Section
+
+    t = TrainerFactory()._create_trainer({})
+    assert isinstance(t, MultiTrainer)
+    assert isinstance(t._device_worker, Hogwild)
+    t2 = TrainerFactory()._create_trainer(
+        {"trainer": "PipelineTrainer", "device_worker": "Section"})
+    assert isinstance(t2._device_worker, Section)
+    t2._set_fetch_var_and_info(["loss"], ["l"], 5)
+    assert t2._print_period == 5 and t2._fetch_info == ["l"]

@@ -125,3 +125,93 @@ class TestAnalysisPredictor:
                           p2.program.all_parameters()[0].name)))
         r1b = p1.run([xv])[0]
         np.testing.assert_array_equal(r1, r1b)
+
+
+def test_fc_fuse_and_dce_passes():
+    """fc_fuse_pass folds mul+add(bias) into one fc op; DCE prunes ops
+    off the target path; outputs unchanged (reference
+    ir/fc_fuse_pass.cc + analysis memory passes)."""
+    from paddle_tpu.analysis import Analyzer, PassBuilder
+
+    rng = np.random.RandomState(0)
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(x, size=8, act=None)      # mul + add
+        dead = fluid.layers.fc(x, size=16)            # not on target path
+        out = fluid.layers.fc(h, size=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = Scope()
+    with scope_guard(scope):
+        exe.run(startup)
+        xv = rng.randn(5, 4).astype("float32")
+        before = exe.run(main, feed={"x": xv}, fetch_list=[out])[0]
+        n_ops_before = len(main.global_block().ops)
+        Analyzer(PassBuilder(["fc_fuse_pass",
+                              "dead_code_elimination_pass"])).run(
+            main, scope=scope, targets=[out.name])
+        n_ops_after = len(main.global_block().ops)
+        after = exe.run(main, feed={"x": xv}, fetch_list=[out])[0]
+    assert n_ops_after < n_ops_before
+    types = [op.type for op in main.global_block().ops]
+    assert "fc" in types and "elementwise_add" not in types
+    # the dead fc's mul is gone
+    assert types.count("mul") == 0
+    np.testing.assert_allclose(after, before, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_runs_analysis_pipeline(tmp_path):
+    rng = np.random.RandomState(1)
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[3, 8, 8], dtype="float32")
+        c = fluid.layers.conv2d(img, num_filters=4, filter_size=3,
+                                padding=1, bias_attr=False)
+        c = fluid.layers.batch_norm(c)
+        p = fluid.layers.pool2d(c, pool_size=8, pool_type="avg")
+        out = fluid.layers.fc(p, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = Scope()
+    with scope_guard(scope):
+        exe.run(startup)
+        xv = rng.randn(2, 3, 8, 8).astype("float32")
+        # oracle must run BN in inference mode (moving stats), like the
+        # exported model does
+        test_prog = main.clone(for_test=True)
+        ref = exe.run(test_prog, feed={"img": xv}, fetch_list=[out])[0]
+        fluid.io.save_inference_model(
+            str(tmp_path), ["img"], [out], exe, main)
+    cfg = fluid.inference.AnalysisConfig(model_dir=str(tmp_path))
+    pred = fluid.inference.create_paddle_predictor(cfg)
+    got = pred.run([xv])[0]
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+    types = [op.type for op in pred.program.global_block().ops]
+    assert "batch_norm" not in types  # folded
+    assert "fc" in types              # fused
+
+
+def test_fc_fuse_preserves_fetched_intermediate():
+    """Regression: fusing must not erase a var that is itself a target."""
+    from paddle_tpu.analysis import Analyzer, PassBuilder
+
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(x, size=8)
+    block = main.global_block()
+    mul_out = next(op.outputs["Out"][0] for op in block.ops
+                   if op.type == "mul")
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = Scope()
+    with scope_guard(scope):
+        exe.run(startup)
+        Analyzer(PassBuilder(["fc_fuse_pass"])).run(
+            main, scope=scope, targets=[mul_out, h.name])
+        xv = np.ones((2, 4), "float32")
+        outs = exe.run(main, feed={"x": xv}, fetch_list=[mul_out, h])
+    assert all(np.isfinite(o).all() for o in outs)
+    types = [op.type for op in main.global_block().ops]
+    assert "mul" in types  # fusion skipped, target still produced

@@ -59,6 +59,9 @@ MODULES = [
     "paddle_tpu.executor",
     "paddle_tpu.framework",
     "paddle_tpu.average",
+    "paddle_tpu.trainer_desc",
+    "paddle_tpu.analysis",
+    "paddle_tpu.device_worker",
     "paddle_tpu.evaluator",
 ]
 
