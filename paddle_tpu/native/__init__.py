@@ -20,7 +20,7 @@ import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SO_PATH = os.path.join(_HERE, "_paddle_tpu_native.so")
-_SOURCES = ["recordio.cc", "multislot.cc"]
+_SOURCES = ["recordio.cc", "multislot.cc", "blocking_queue.cc"]
 
 _lib = None
 _lib_lock = threading.Lock()
@@ -88,6 +88,22 @@ def get_lib():
         lib.ms_copy_slot.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                      ctypes.c_void_p]
         lib.ms_free.argtypes = [ctypes.c_void_p]
+        lib.ptq_create.restype = ctypes.c_void_p
+        lib.ptq_create.argtypes = [ctypes.c_size_t]
+        lib.ptq_destroy.argtypes = [ctypes.c_void_p]
+        lib.ptq_push.restype = ctypes.c_int
+        lib.ptq_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_size_t]
+        lib.ptq_pop.restype = ctypes.c_int64
+        lib.ptq_pop.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_size_t]
+        lib.ptq_peek_len.restype = ctypes.c_int64
+        lib.ptq_peek_len.argtypes = [ctypes.c_void_p]
+        lib.ptq_size.restype = ctypes.c_size_t
+        lib.ptq_size.argtypes = [ctypes.c_void_p]
+        lib.ptq_close.argtypes = [ctypes.c_void_p]
+        lib.ptq_is_closed.restype = ctypes.c_int
+        lib.ptq_is_closed.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -338,3 +354,93 @@ def parse_multislot_file(path, slot_types, slot_lens, threads=0):
             (0, lens[s]), np.float32 if types[s] == 0 else np.int64)
         for s, r in enumerate(rows)
     ]
+
+
+# ---------------------------------------------------------------------------
+# Blocking reader queue (reference: framework/blocking_queue.h + the
+# LoDTensorBlockingQueue bound at pybind.cc:591) — native bounded MPMC
+# byte-buffer queue with a queue.Queue fallback.
+# ---------------------------------------------------------------------------
+
+
+class BlockingQueue:
+    """Bounded blocking queue of PICKLED items — the serialized-batch /
+    cross-process role of the reference's LoDTensorBlockingQueue (items
+    must be picklable; in-process prefetch passes references through
+    queue.Queue instead, see reader.py).  The C++ side releases the GIL
+    while copying/waiting."""
+
+    def __init__(self, capacity=64):
+        import threading as _threading
+
+        self._lib = get_lib()
+        self._capacity = int(capacity)
+        # peek+pop must be atomic per consumer (the C queue is MPMC but
+        # the two-call read is not)
+        self._pop_lock = _threading.Lock()
+        self._closed = _threading.Event()
+        if self._lib is not None:
+            self._h = self._lib.ptq_create(self._capacity)
+            self._q = None
+        else:  # pure-python fallback with the same close semantics
+            import queue
+
+            self._h = None
+            self._q = queue.Queue(maxsize=self._capacity)
+
+    def push(self, obj):
+        """False once the queue is closed."""
+        import pickle
+        import queue
+
+        if self._h is not None:
+            raw = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+            return bool(self._lib.ptq_push(self._h, raw, len(raw)))
+        while not self._closed.is_set():
+            try:
+                self._q.put(obj, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def pop(self):
+        """Next item, or None when closed and drained."""
+        import pickle
+        import queue
+
+        if self._h is not None:
+            with self._pop_lock:
+                n = self._lib.ptq_peek_len(self._h)
+                if n <= 0:
+                    return None
+                buf = ctypes.create_string_buffer(int(n))
+                got = self._lib.ptq_pop(self._h, buf, int(n))
+            if got <= 0:
+                return None
+            return pickle.loads(buf.raw[:got])
+        while True:
+            try:
+                return self._q.get(timeout=0.05)
+            except queue.Empty:
+                if self._closed.is_set():
+                    return None
+
+    def size(self):
+        if self._h is not None:
+            return int(self._lib.ptq_size(self._h))
+        return self._q.qsize()
+
+    def close(self):
+        self._closed.set()
+        if self._h is not None:
+            self._lib.ptq_close(self._h)
+
+    def __del__(self):
+        try:
+            if self._h is not None:
+                self._lib.ptq_close(self._h)
+                self._lib.ptq_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass

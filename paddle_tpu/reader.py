@@ -3,7 +3,13 @@
 
 TPU-native: a double-buffered background-thread prefetcher that overlaps
 host batch assembly + H2D transfer with device compute — the role the
-reference's blocking queue + read op play, without graph-side reader ops."""
+reference's blocking queue + read op play, without graph-side reader ops.
+In-process batches pass by REFERENCE through a bounded queue.Queue (its
+condition variables already release the GIL during waits; serializing
+numpy batches here would only add copies).  The native byte-buffer queue
+(``native.BlockingQueue``, blocking_queue.cc) serves the
+serialized-batch/multi-process role of the reference's
+LoDTensorBlockingQueue instead."""
 
 import queue as _queue
 import threading
@@ -11,6 +17,8 @@ import threading
 import numpy as np
 
 __all__ = ["PyReader", "DataLoader"]
+
+_SENTINEL = "__paddle_tpu_epoch_end__"
 
 
 class _Prefetcher:
@@ -32,7 +40,7 @@ class _Prefetcher:
                         return
                     self.queue.put(item)
             finally:
-                self.queue.put(None)  # end-of-epoch sentinel
+                self.queue.put(_SENTINEL)  # end-of-epoch sentinel
 
         self.thread = threading.Thread(target=worker, daemon=True)
         self.thread.start()
@@ -49,7 +57,7 @@ class _Prefetcher:
     def __iter__(self):
         while True:
             item = self.queue.get()
-            if item is None:
+            if isinstance(item, str) and item == _SENTINEL:
                 return
             yield item
 
