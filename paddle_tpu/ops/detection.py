@@ -798,3 +798,365 @@ def mine_hard_examples(ctx, attrs, ClsLoss, LocLoss, MatchIndices,
     neg_idx = jnp.where(keep, order.astype(jnp.int32), -1)
     return {"NegIndices": neg_idx,
             "UpdatedMatchIndices": mi}
+
+
+def _sce(x, label):
+    """Stable sigmoid cross entropy (yolov3_loss_op.h
+    SigmoidCrossEntropy): max(x,0) - x*label + log(1+exp(-|x|))."""
+    return jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+
+
+@register_op("yolov3_loss",
+             inputs=["X", "GTBox", "GTLabel", "GTScore"],
+             outputs=["Loss", "ObjectnessMask", "GTMatchMask"],
+             stateful_outputs=("ObjectnessMask", "GTMatchMask"))
+def yolov3_loss(ctx, attrs, X, GTBox, GTLabel, GTScore):
+    """YOLOv3 training loss (yolov3_loss_op.h): per ground-truth box,
+    match the best anchor by centered IoU; at the matched cell compute
+    location (sce tx/ty + L1 tw/th, scaled by (2 - gw*gh)) and class
+    (per-class sce with optional label smoothing) losses; objectness is
+    sce against {1 at matched cells, 0 elsewhere, ignored where the best
+    pred-gt IoU exceeds ignore_thresh}.  The reference's per-image host
+    loops become batched jnp ops + a static loop over the (small) gt
+    capacity."""
+    anchors = [int(a) for a in attrs["anchors"]]
+    anchor_mask = [int(a) for a in attrs["anchor_mask"]]
+    class_num = int(attrs["class_num"])
+    ignore_thresh = float(attrs.get("ignore_thresh", 0.7))
+    downsample = int(attrs.get("downsample_ratio", 32))
+    use_label_smooth = bool(attrs.get("use_label_smooth", True))
+    n, c, h, w = X.shape
+    mask_num = len(anchor_mask)
+    an_num = len(anchors) // 2
+    b = GTBox.shape[1]
+    input_size = downsample * h
+    x5 = X.reshape(n, mask_num, 5 + class_num, h, w)
+    gtb = GTBox  # [N, B, 4] (cx, cy, w, h) normalized
+    gtl = jnp.reshape(GTLabel, (n, b)).astype(jnp.int32)
+    gts = (jnp.reshape(GTScore, (n, b)) if GTScore is not None
+           else jnp.ones((n, b), X.dtype))
+    gt_valid = (gtb[:, :, 2] > 0) & (gtb[:, :, 3] > 0)
+
+    if use_label_smooth:
+        sw = min(1.0 / class_num, 1.0 / 40)
+        label_pos, label_neg = 1.0 - sw, sw
+    else:
+        label_pos, label_neg = 1.0, 0.0
+
+    # ---- decode predicted boxes (GetYoloBox) for the ignore mask ----
+    gx = (jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+          + jax.nn.sigmoid(x5[:, :, 0])) / w
+    gy = (jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+          + jax.nn.sigmoid(x5[:, :, 1])) / h
+    aw = jnp.asarray([anchors[2 * m] for m in anchor_mask],
+                     jnp.float32)[None, :, None, None]
+    ah = jnp.asarray([anchors[2 * m + 1] for m in anchor_mask],
+                     jnp.float32)[None, :, None, None]
+    pw = jnp.exp(x5[:, :, 2]) * aw / input_size
+    ph = jnp.exp(x5[:, :, 3]) * ah / input_size
+
+    def centered_iou(w1, h1, w2, h2):
+        inter = jnp.minimum(w1, w2) * jnp.minimum(h1, h2)
+        return inter / jnp.maximum(w1 * h1 + w2 * h2 - inter, 1e-10)
+
+    def box_iou(px, py, pw_, ph_, g):
+        # [..., ] pred vs one gt box [4]
+        x1 = jnp.maximum(px - pw_ / 2, g[0] - g[2] / 2)
+        y1 = jnp.maximum(py - ph_ / 2, g[1] - g[3] / 2)
+        x2 = jnp.minimum(px + pw_ / 2, g[0] + g[2] / 2)
+        y2 = jnp.minimum(py + ph_ / 2, g[1] + g[3] / 2)
+        inter = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+        union = pw_ * ph_ + g[2] * g[3] - inter
+        return inter / jnp.maximum(union, 1e-10)
+
+    best_iou = jnp.zeros((n, mask_num, h, w), jnp.float32)
+    for t in range(b):
+        iou_t = jax.vmap(
+            lambda px, py, pw_, ph_, g: box_iou(px, py, pw_, ph_, g)
+        )(gx, gy, pw, ph, gtb[:, t])
+        iou_t = jnp.where(gt_valid[:, t][:, None, None, None], iou_t, 0.0)
+        best_iou = jnp.maximum(best_iou, iou_t)
+    obj_mask = jnp.where(best_iou > ignore_thresh, -1.0, 0.0)
+
+    # ---- per-gt anchor matching + location/class losses ----
+    loss = jnp.zeros((n,), jnp.float32)
+    gt_match = jnp.full((n, b), -1, jnp.int32)
+    an_w = jnp.asarray(anchors[0::2], jnp.float32) / input_size
+    an_h = jnp.asarray(anchors[1::2], jnp.float32) / input_size
+    mask_of_anchor = jnp.asarray(
+        [anchor_mask.index(a) if a in anchor_mask else -1
+         for a in range(an_num)], jnp.int32)
+    rows = jnp.arange(n)
+    for t in range(b):
+        g = gtb[:, t]  # [N, 4]
+        valid = gt_valid[:, t]
+        score = gts[:, t]
+        ious = centered_iou(g[:, 2:3], g[:, 3:4], an_w[None, :],
+                            an_h[None, :])  # [N, an_num]
+        best_n = jnp.argmax(ious, axis=1).astype(jnp.int32)
+        mask_idx = mask_of_anchor[best_n]  # [N], -1 if not in this head
+        gi = jnp.clip((g[:, 0] * w).astype(jnp.int32), 0, w - 1)
+        gj = jnp.clip((g[:, 1] * h).astype(jnp.int32), 0, h - 1)
+        active = valid & (mask_idx >= 0)
+        midx = jnp.maximum(mask_idx, 0)
+        cell = x5[rows, midx, :, gj, gi]  # [N, 5+C]
+        tx = g[:, 0] * w - gi
+        ty = g[:, 1] * h - gj
+        # tw = log(gt_w * input_size / anchor_px) = log(gt_w / an_w_norm)
+        tw = jnp.log(jnp.maximum(g[:, 2] / an_w[best_n], 1e-9))
+        th = jnp.log(jnp.maximum(g[:, 3] / an_h[best_n], 1e-9))
+        scale = (2.0 - g[:, 2] * g[:, 3]) * score
+        loc = (_sce(cell[:, 0], tx) + _sce(cell[:, 1], ty)
+               + jnp.abs(cell[:, 2] - tw)
+               + jnp.abs(cell[:, 3] - th)) * scale
+        onehot = jax.nn.one_hot(gtl[:, t], class_num)
+        cls_target = onehot * label_pos + (1.0 - onehot) * label_neg
+        cls = jnp.sum(_sce(cell[:, 5:], cls_target), axis=1) * score
+        loss = loss + jnp.where(active, loc + cls, 0.0)
+        gt_match = gt_match.at[:, t].set(
+            jnp.where(valid, mask_idx, -1))
+        obj_mask = obj_mask.at[rows, midx, gj, gi].set(
+            jnp.where(active, score, obj_mask[rows, midx, gj, gi]))
+
+    # ---- objectness loss (CalcObjnessLoss) ----
+    obj_logit = x5[:, :, 4]
+    pos = obj_mask > 1e-5
+    neg = (obj_mask > -0.5) & ~pos
+    obj_loss = (jnp.where(pos, _sce(obj_logit, 1.0) * obj_mask, 0.0)
+                + jnp.where(neg, _sce(obj_logit, 0.0), 0.0))
+    loss = loss + jnp.sum(obj_loss, axis=(1, 2, 3))
+    return {"Loss": loss, "ObjectnessMask": obj_mask,
+            "GTMatchMask": gt_match}
+
+
+@register_op("rpn_target_assign",
+             inputs=["Anchor", "GtBoxes", "IsCrowd", "ImInfo"],
+             outputs=["LocationIndex", "ScoreIndex", "TargetLabel",
+                      "TargetBBox", "BBoxInsideWeight"],
+             no_grad=True)
+def rpn_target_assign(ctx, attrs, Anchor, GtBoxes, IsCrowd, ImInfo):
+    """RPN anchor labeling (rpn_target_assign_op.cc), TPU-static single
+    image: anchors with IoU > positive_overlap (or the argmax anchor per
+    gt) are positive, IoU < negative_overlap negative; outputs are padded
+    index lists (-1 padding) of fixed capacity rpn_batch_size_per_im."""
+    pos_thr = float(attrs.get("rpn_positive_overlap", 0.7))
+    neg_thr = float(attrs.get("rpn_negative_overlap", 0.3))
+    cap = int(attrs.get("rpn_batch_size_per_im", 256))
+    anchors = Anchor.reshape(-1, 4)
+    gts = GtBoxes.reshape(-1, 4)
+    a = anchors.shape[0]
+    iou = _pairwise_iou(anchors, gts, True)  # [A, G]
+    gt_valid = (gts[:, 2] > gts[:, 0]) & (gts[:, 3] > gts[:, 1])
+    iou = jnp.where(gt_valid[None, :], iou, -1.0)
+    best_gt = jnp.argmax(iou, axis=1)
+    best_iou = jnp.max(iou, axis=1)
+    # anchors that are the best for some gt are positive too
+    best_anchor_per_gt = jnp.argmax(iou, axis=0)  # [G]
+    # max-combine so a padding gt's False cannot clobber a valid gt's True
+    # at the shared argmax fallback index 0
+    is_best = jnp.zeros((a,), bool).at[best_anchor_per_gt].max(gt_valid)
+    positive = (best_iou >= pos_thr) | is_best
+    # anchors overlapping nothing (best_iou == -1 because no valid gt, or
+    # genuinely 0) are background negatives, like the reference's
+    # max-overlap-0 case
+    negative = (best_iou < neg_thr) & ~positive
+    labels = jnp.where(positive, 1, jnp.where(negative, 0, -1))
+    # padded index lists, positives first (deterministic, no subsampling
+    # RNG: the reference subsamples to cap; we keep the hardest-capped
+    # deterministic prefix)
+    order = jnp.argsort(-labels)  # 1s first, then 0s, then -1s
+    loc_idx = jnp.where(jnp.arange(a) < jnp.sum(positive),
+                        order, -1)[:cap]
+    score_idx = jnp.where(
+        jnp.arange(a) < jnp.sum(positive) + jnp.sum(negative),
+        order, -1)[:cap]
+    tgt_gt = gts[best_gt]
+    # encode anchor->gt offsets (box_coder encode_center_size)
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    ax = anchors[:, 0] + aw / 2
+    ay = anchors[:, 1] + ah / 2
+    gw = tgt_gt[:, 2] - tgt_gt[:, 0]
+    gh = tgt_gt[:, 3] - tgt_gt[:, 1]
+    gx = tgt_gt[:, 0] + gw / 2
+    gy = tgt_gt[:, 1] + gh / 2
+    tgt = jnp.stack([
+        (gx - ax) / jnp.maximum(aw, 1e-6),
+        (gy - ay) / jnp.maximum(ah, 1e-6),
+        jnp.log(jnp.maximum(gw / jnp.maximum(aw, 1e-6), 1e-6)),
+        jnp.log(jnp.maximum(gh / jnp.maximum(ah, 1e-6), 1e-6)),
+    ], axis=1)
+    return {
+        "LocationIndex": loc_idx.astype(jnp.int32),
+        "ScoreIndex": score_idx.astype(jnp.int32),
+        "TargetLabel": labels.astype(jnp.int32),
+        "TargetBBox": tgt,
+        "BBoxInsideWeight": jnp.where(positive[:, None], 1.0, 0.0)
+                            * jnp.ones((1, 4)),
+    }
+
+
+@register_op("generate_proposals",
+             inputs=["Scores", "BboxDeltas", "ImInfo", "Anchors",
+                     "Variances"],
+             outputs=["RpnRois", "RpnRoiProbs"], no_grad=True)
+def generate_proposals(ctx, attrs, Scores, BboxDeltas, ImInfo, Anchors,
+                       Variances):
+    """RPN proposal generation (generate_proposals_op.cc): decode anchor
+    deltas, clip to the image, take pre_nms_topN by score, NMS to
+    post_nms_topN.  TPU-static: fixed-capacity outputs padded with zeros
+    (single image per call; batch via vmap upstream)."""
+    pre_n = int(attrs.get("pre_nms_topN", 6000))
+    post_n = int(attrs.get("post_nms_topN", 1000))
+    nms_thresh = float(attrs.get("nms_thresh", 0.7))
+    min_size = float(attrs.get("min_size", 0.1))
+    scores = Scores.reshape(-1)
+    deltas = BboxDeltas.reshape(-1, 4)
+    anchors = Anchors.reshape(-1, 4)
+    var = (Variances.reshape(-1, 4) if Variances is not None
+           else jnp.ones_like(anchors))
+    # decode (box_coder decode_center_size with variances)
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    ax = anchors[:, 0] + aw / 2
+    ay = anchors[:, 1] + ah / 2
+    cx = var[:, 0] * deltas[:, 0] * aw + ax
+    cy = var[:, 1] * deltas[:, 1] * ah + ay
+    bw = jnp.exp(jnp.minimum(var[:, 2] * deltas[:, 2], 10.0)) * aw
+    bh = jnp.exp(jnp.minimum(var[:, 3] * deltas[:, 3], 10.0)) * ah
+    boxes = jnp.stack([cx - bw / 2, cy - bh / 2,
+                       cx + bw / 2, cy + bh / 2], axis=1)
+    if ImInfo is not None:
+        im = ImInfo.reshape(-1)
+        im_scale = im[2] if im.shape[0] >= 3 else 1.0
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, im[1] - 1),
+            jnp.clip(boxes[:, 1], 0, im[0] - 1),
+            jnp.clip(boxes[:, 2], 0, im[1] - 1),
+            jnp.clip(boxes[:, 3], 0, im[0] - 1)], axis=1)
+    else:
+        im_scale = 1.0
+    # legacy pixel convention (generate_proposals_op.cc): width =
+    # x2-x1+1, min_size scaled back to the original image
+    ws = boxes[:, 2] - boxes[:, 0] + 1.0
+    hs = boxes[:, 3] - boxes[:, 1] + 1.0
+    eff_min = jnp.maximum(min_size * im_scale, 1.0)
+    keep_size = (ws >= eff_min) & (hs >= eff_min)
+    scores = jnp.where(keep_size, scores, -1e9)
+    k = min(pre_n, scores.shape[0])
+    pre_scores, pre_idx = jax.lax.top_k(scores, k)
+    pre_boxes = boxes[pre_idx]
+    keep, top_scores, top_boxes, _ = _nms_single_class(
+        pre_boxes, pre_scores, -1e8, nms_thresh, 1.0, k, False)
+    # left-pack kept boxes to fixed post_n capacity (zero padding)
+    kept_rank = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    dest = jnp.where(keep & (kept_rank < post_n), kept_rank, post_n)
+    out_boxes = jnp.zeros((post_n + 1, 4), boxes.dtype).at[dest].set(
+        top_boxes)[:post_n]
+    out_scores = jnp.zeros((post_n + 1,), scores.dtype).at[dest].set(
+        top_scores)[:post_n]
+    return {"RpnRois": out_boxes, "RpnRoiProbs": out_scores[:, None]}
+
+
+@register_op("detection_map",
+             inputs=["DetectRes", "Label", "HasState", "PosCount",
+                     "TruePos", "FalsePos"],
+             outputs=["MAP", "AccumPosCount", "AccumTruePos",
+                      "AccumFalsePos"],
+             no_grad=True,
+             stateful_outputs=("AccumPosCount", "AccumTruePos",
+                               "AccumFalsePos"))
+def detection_map(ctx, attrs, DetectRes, Label, HasState, PosCount,
+                  TruePos, FalsePos):
+    """Mean average precision (detection_map_op.h) for ONE padded image
+    batch per call: detections [D, 6] (label, score, x1,y1,x2,y2; label
+    < 0 = padding) vs gts [G, 5] (label, x1,y1,x2,y2; label < 0 =
+    padding).  Greedy per-class matching at overlap_threshold, then
+    11-point or integral AP.  The reference's accumulator-state streaming
+    (PosCount/TruePos/FalsePos across batches) is not carried — each call
+    reports the mAP of its own batch (the common single-eval-pass use);
+    accumulator outputs echo fixed-capacity per-class counts."""
+    import jax as _jax
+
+    overlap = float(attrs.get("overlap_threshold", 0.5))
+    ap_type = attrs.get("ap_type", "integral")
+    num_classes = int(attrs.get("class_num", 21))
+    evaluate_difficult = bool(attrs.get("evaluate_difficult", True))
+    det = jnp.asarray(DetectRes).reshape(-1, 6)
+    gt = jnp.asarray(Label).reshape(-1, Label.shape[-1])
+    g_lab = gt[:, 0].astype(jnp.int32)
+    g_box = gt[:, -4:]
+    # 6-column labels carry a difficult flag (label, difficult, box);
+    # with evaluate_difficult=False those gts neither count in npos nor
+    # penalize matches (PASCAL VOC convention, detection_map_op.h)
+    if gt.shape[-1] >= 6 and not evaluate_difficult:
+        g_difficult = gt[:, 1] > 0.5
+    else:
+        g_difficult = jnp.zeros(gt.shape[:1], bool)
+    d_lab = det[:, 0].astype(jnp.int32)
+    d_score = det[:, 1]
+    d_box = det[:, 2:6]
+    D, G = det.shape[0], gt.shape[0]
+    d_valid = d_lab >= 0
+    g_valid = (g_lab >= 0) & ~g_difficult
+
+    iou = _pairwise_iou(d_box, g_box, True)  # [D, G]
+    same_class = d_lab[:, None] == g_lab[None, :]
+    iou = jnp.where(same_class & g_valid[None, :] & d_valid[:, None],
+                    iou, -1.0)
+
+    # process detections in score order; greedily claim the best unmatched
+    # same-class gt with IoU >= overlap
+    order = jnp.argsort(-jnp.where(d_valid, d_score, -jnp.inf))
+
+    def body(i, carry):
+        tp, fp, used = carry
+        d = order[i]
+        ious = jnp.where(used, -1.0, iou[d])
+        best_g = jnp.argmax(ious)
+        ok = (ious[best_g] >= overlap) & d_valid[d]
+        tp = tp.at[d].set(jnp.where(ok, 1.0, 0.0))
+        fp = fp.at[d].set(jnp.where(d_valid[d] & ~ok, 1.0, 0.0))
+        used = used.at[best_g].set(used[best_g] | ok)
+        return tp, fp, used
+
+    tp0 = jnp.zeros((D,))
+    fp0 = jnp.zeros((D,))
+    used0 = jnp.zeros((G,), bool)
+    tp, fp, _ = lax.fori_loop(0, D, body, (tp0, fp0, used0))
+
+    # per-class AP over the score-sorted list
+    aps = []
+    present = []
+    for c in range(num_classes):
+        npos = jnp.sum(g_valid & (g_lab == c)).astype(jnp.float32)
+        in_c = (d_lab == c) & d_valid
+        # sort class detections by score
+        sc = jnp.where(in_c, d_score, -jnp.inf)
+        c_order = jnp.argsort(-sc)
+        c_tp = tp[c_order] * in_c[c_order]
+        c_fp = fp[c_order] * in_c[c_order]
+        cum_tp = jnp.cumsum(c_tp)
+        cum_fp = jnp.cumsum(c_fp)
+        recall = cum_tp / jnp.maximum(npos, 1.0)
+        precision = cum_tp / jnp.maximum(cum_tp + cum_fp, 1.0)
+        active = in_c[c_order]
+        if ap_type == "11point":
+            pts = []
+            for r in [i / 10.0 for i in range(11)]:
+                p_at = jnp.max(jnp.where(active & (recall >= r),
+                                         precision, 0.0))
+                pts.append(p_at)
+            ap = sum(pts) / 11.0
+        else:  # integral
+            d_rec = jnp.diff(jnp.concatenate([jnp.zeros(1), recall]))
+            ap = jnp.sum(jnp.where(active, precision * d_rec, 0.0))
+        aps.append(jnp.where(npos > 0, ap, 0.0))
+        present.append((npos > 0).astype(jnp.float32))
+    aps = jnp.stack(aps)
+    present = jnp.stack(present)
+    m_ap = jnp.sum(aps) / jnp.maximum(jnp.sum(present), 1.0)
+    zeros = jnp.zeros((num_classes, 1), jnp.float32)
+    return {"MAP": m_ap.reshape(1),
+            "AccumPosCount": zeros.astype(jnp.int32),
+            "AccumTruePos": zeros, "AccumFalsePos": zeros}

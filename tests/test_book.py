@@ -220,3 +220,62 @@ class TestLabelSemanticRoles:
             p = exe.run(test_prog, feed=f, fetch_list=[path])[0]
             assert p.shape == (4, T)
             assert (p >= 0).all() and (p < NTAG).all()
+
+
+class TestUnderstandSentiment:
+    """book/test_understand_sentiment.py: embedding + masked mean-pool
+    classifier on the sentiment reader pipeline (canned dataset →
+    reader decorators → feed)."""
+
+    def test_train_reaches_accuracy(self):
+        from paddle_tpu import datasets, reader_decorators as rd
+
+        L = 40
+        V = datasets.sentiment.VOCAB
+        fluid.unique_name.switch()
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 2
+        with fluid.program_guard(main, startup):
+            ids = fluid.layers.data("ids", shape=[L], dtype="int64")
+            lens = fluid.layers.data("lens", shape=[], dtype="int64")
+            label = fluid.layers.data("label", shape=[1], dtype="int64")
+            emb = fluid.layers.embedding(ids, size=[V, 16])
+            mask = fluid.layers.cast(
+                fluid.layers.sequence_mask(lens, maxlen=L), "float32")
+            summed = fluid.layers.reduce_sum(
+                fluid.layers.elementwise_mul(
+                    emb, fluid.layers.unsqueeze(mask, [2])), dim=[1])
+            denom = fluid.layers.unsqueeze(
+                fluid.layers.reduce_sum(mask, dim=[1]), [1])
+            pooled = fluid.layers.elementwise_div(summed, denom)
+            logits = fluid.layers.fc(pooled, size=2)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, label))
+            acc = fluid.layers.accuracy(fluid.layers.softmax(logits), label)
+            fluid.optimizer.Adam(5e-3).minimize(loss)
+
+        reader = rd.batch(
+            rd.shuffle(datasets.sentiment.train(), buf_size=500), 64)
+
+        def to_feed(batch):
+            n = len(batch)
+            idm = np.zeros((n, L), "int64")
+            ln = np.zeros((n,), "int64")
+            lb = np.zeros((n, 1), "int64")
+            for i, (seq, y) in enumerate(batch):
+                k = min(len(seq), L)
+                idm[i, :k] = seq[:k]
+                ln[i] = k
+                lb[i, 0] = y
+            return {"ids": idm, "lens": ln, "label": lb}
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            exe.run(startup)
+            accs = []
+            for step, b in enumerate(reader()):
+                if len(b) < 64 or step >= 40:
+                    break
+                av = exe.run(main, feed=to_feed(b), fetch_list=[acc])[0]
+                accs.append(float(np.asarray(av).reshape(())))
+        assert np.mean(accs[-5:]) > 0.8, accs[-5:]

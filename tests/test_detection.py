@@ -536,3 +536,134 @@ class TestDetectionOutput:
         assert len(kept) >= 1
         # scores sorted descending
         assert all(kept[i, 1] >= kept[i + 1, 1] for i in range(len(kept) - 1))
+
+
+def test_yolov3_loss_basics():
+    """yolov3_loss (yolov3_loss_op.h): loss finite and positive; the
+    matched cell gets objectness target = score; invalid gts (-1 match);
+    zero-gt image contributes only negative-objectness loss."""
+    from paddle_tpu.ops import registry
+    from paddle_tpu.ops.registry import LoweringContext
+    import jax
+
+    rng = np.random.RandomState(0)
+    N, H, W, C = 2, 4, 4, 3
+    anchors = [10, 13, 16, 30, 33, 23]
+    mask = [0, 1, 2]
+    x = rng.randn(N, len(mask) * (5 + C), H, W).astype("float32") * 0.1
+    gtb = np.zeros((N, 5, 4), "float32")
+    gtb[0, 0] = [0.4, 0.6, 0.2, 0.3]  # one valid gt in image 0
+    gtl = np.zeros((N, 5), "int32")
+    gtl[0, 0] = 1
+
+    ctx = LoweringContext(base_key=jax.random.key(0), mode="train")
+    opdef = registry.get_op_def("yolov3_loss")
+    out = registry.call_op(
+        opdef, ctx,
+        {"X": [x], "GTBox": [gtb], "GTLabel": [gtl], "GTScore": [None]},
+        {"anchors": anchors, "anchor_mask": mask, "class_num": C,
+         "ignore_thresh": 0.7, "downsample_ratio": 32})
+    loss = np.asarray(out["Loss"][0])
+    match = np.asarray(out["GTMatchMask"][0])
+    obj = np.asarray(out["ObjectnessMask"][0])
+    assert loss.shape == (N,) and np.isfinite(loss).all()
+    assert (loss > 0).all()
+    assert match[0, 0] >= 0          # valid gt matched some anchor head
+    assert (match[:, 1:] == -1).all()  # padding gts unmatched
+    assert (obj == 1.0).sum() == 1   # exactly the one matched cell
+    # image 0 carries the extra location+class loss
+    assert loss[0] > loss[1]
+
+
+def test_rpn_target_assign_and_generate_proposals():
+    from paddle_tpu.ops import registry
+    from paddle_tpu.ops.registry import LoweringContext
+    import jax
+
+    ctx = LoweringContext(base_key=jax.random.key(0), mode="train")
+    anchors = np.array([[0, 0, 10, 10], [20, 20, 30, 30],
+                        [100, 100, 110, 110]], "float32")
+    gts = np.array([[0, 0, 9, 9]], "float32")
+    out = registry.call_op(
+        registry.get_op_def("rpn_target_assign"), ctx,
+        {"Anchor": [anchors], "GtBoxes": [gts], "IsCrowd": [None],
+         "ImInfo": [None]},
+        {"rpn_positive_overlap": 0.7, "rpn_negative_overlap": 0.3,
+         "rpn_batch_size_per_im": 4})
+    labels = np.asarray(out["TargetLabel"][0])
+    assert labels[0] == 1 and (labels[1:] == 0).all()
+
+    scores = np.array([0.9, 0.8, 0.1], "float32")
+    deltas = np.zeros((3, 4), "float32")
+    out = registry.call_op(
+        registry.get_op_def("generate_proposals"), ctx,
+        {"Scores": [scores], "BboxDeltas": [deltas],
+         "ImInfo": [np.array([200.0, 200.0, 1.0], "float32")],
+         "Anchors": [anchors], "Variances": [None]},
+        {"pre_nms_topN": 3, "post_nms_topN": 2, "nms_thresh": 0.5,
+         "min_size": 1.0})
+    rois = np.asarray(out["RpnRois"][0])
+    probs = np.asarray(out["RpnRoiProbs"][0])
+    assert rois.shape == (2, 4)
+    np.testing.assert_allclose(rois[0], anchors[0], atol=1e-4)
+    np.testing.assert_allclose(probs[0, 0], 0.9, atol=1e-5)
+
+
+def test_detection_map():
+    """detection_map (detection_map_op.h): perfect detections -> mAP 1;
+    one wrong-class detection halves the class average."""
+    from paddle_tpu.ops import registry
+    from paddle_tpu.ops.registry import LoweringContext
+    import jax
+
+    ctx = LoweringContext(base_key=jax.random.key(0), mode="train")
+    gts = np.array([[0, 10, 10, 20, 20],
+                    [1, 30, 30, 40, 40],
+                    [-1, 0, 0, 0, 0]], "float32")
+    dets = np.array([
+        [0, 0.9, 10, 10, 20, 20],   # perfect match class 0
+        [1, 0.8, 30, 30, 40, 40],   # perfect match class 1
+        [-1, 0, 0, 0, 0, 0],        # padding
+    ], "float32")
+    out = registry.call_op(
+        registry.get_op_def("detection_map"), ctx,
+        {"DetectRes": [dets], "Label": [gts], "HasState": [None],
+         "PosCount": [None], "TruePos": [None], "FalsePos": [None]},
+        {"overlap_threshold": 0.5, "class_num": 3, "ap_type": "integral"})
+    np.testing.assert_allclose(np.asarray(out["MAP"][0]), 1.0, rtol=1e-5)
+
+    dets_bad = dets.copy()
+    dets_bad[1, 2:] = [100, 100, 110, 110]  # class-1 det misses its gt
+    out = registry.call_op(
+        registry.get_op_def("detection_map"), ctx,
+        {"DetectRes": [dets_bad], "Label": [gts], "HasState": [None],
+         "PosCount": [None], "TruePos": [None], "FalsePos": [None]},
+        {"overlap_threshold": 0.5, "class_num": 3, "ap_type": "integral"})
+    np.testing.assert_allclose(np.asarray(out["MAP"][0]), 0.5, rtol=1e-5)
+
+
+def test_rpn_target_assign_empty_image_and_anchor0():
+    from paddle_tpu.ops import registry
+    from paddle_tpu.ops.registry import LoweringContext
+    import jax
+
+    ctx = LoweringContext(base_key=jax.random.key(0), mode="train")
+    anchors = np.array([[0, 0, 10, 10], [20, 20, 30, 30]], "float32")
+    # all-padding gts: every anchor is a background negative
+    gts = np.zeros((2, 4), "float32")
+    out = registry.call_op(
+        registry.get_op_def("rpn_target_assign"), ctx,
+        {"Anchor": [anchors], "GtBoxes": [gts], "IsCrowd": [None],
+         "ImInfo": [None]}, {})
+    labels = np.asarray(out["TargetLabel"][0])
+    assert (labels == 0).all()
+
+    # valid gt whose best anchor is 0 with sub-threshold IoU must stay
+    # positive even with trailing padding gts (is_best max-combine)
+    gts2 = np.array([[0, 0, 18, 18], [0, 0, 0, 0]], "float32")
+    out = registry.call_op(
+        registry.get_op_def("rpn_target_assign"), ctx,
+        {"Anchor": [anchors], "GtBoxes": [gts2], "IsCrowd": [None],
+         "ImInfo": [None]}, {"rpn_positive_overlap": 0.9})
+    labels = np.asarray(out["TargetLabel"][0])
+    assert labels[0] == 1
