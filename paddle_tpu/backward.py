@@ -138,14 +138,22 @@ class _GradEngine:
         if not any_grad:
             return False
 
-        # which input grads to produce
+        # which input grads to produce; a var appearing in SEVERAL input
+        # slots (e.g. merge_lod_tensor's InTrue also bound to X) must get
+        # DISTINCT grad names per slot, else the later slot's (often zero)
+        # grad overwrites the real one in the SSA env
         in_grads = {}
+        used_gnames = set()
         for slot, names in op.inputs.items():
             gnames = []
             need = False
             for x in names:
                 if _var_can_have_grad(self.block, x, self.no_grad_set):
                     gn = self.new_grad_name(x)
+                    while gn in used_gnames:
+                        gn = unique_name.generate(
+                            grad_var_name(x) + "@RENAME")
+                    used_gnames.add(gn)
                     gnames.append(gn)
                     need = True
                 else:

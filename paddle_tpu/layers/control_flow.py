@@ -418,12 +418,120 @@ def _logical_not(x):
     return logical_not(x)
 
 
+class IfElseBlockGuard:
+    def __init__(self, is_true, ie):
+        self.is_true = is_true
+        self.ie = ie
+
+    def __enter__(self):
+        self.ie.status = (IfElse.IN_IF_ELSE_TRUE_BLOCKS if self.is_true
+                          else IfElse.IN_IF_ELSE_FALSE_BLOCKS)
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            return False
+        if not self.ie.output_table[1 if self.is_true else 0]:
+            raise ValueError("Must set output inside block")
+        self.ie.status = IfElse.OUT_IF_ELSE_BLOCKS
+        return True
+
+
 class IfElse:
+    """Per-row two-branch control flow (reference control_flow.py:1564:
+    split_lod_tensor partitions rows by a [B,1] bool mask, each branch
+    runs on its sub-batch, merge_lod_tensor re-interleaves).
+
+    TPU-static redesign: ragged row partitions are not expressible under
+    XLA static shapes, so BOTH branches compute on the full batch and the
+    merge selects per row (``merge_lod_tensor`` → jnp.where) — identical
+    results for row-wise computations, with the reference's op names kept
+    in the program for parity.  Usage::
+
+        ie = fluid.layers.IfElse(cond)        # cond: [B, 1] bool
+        with ie.true_block():
+            x_t = ie.input(x)
+            ie.output(some_layers(x_t))
+        with ie.false_block():
+            x_f = ie.input(x)
+            ie.output(other_layers(x_f))
+        merged, = ie()
+    """
+
+    OUT_IF_ELSE_BLOCKS = 0
+    IN_IF_ELSE_TRUE_BLOCKS = 1
+    IN_IF_ELSE_FALSE_BLOCKS = 2
+
     def __init__(self, cond, name=None):
-        raise NotImplementedError(
-            "IfElse (split/merge by mask) lands with the sequence batch; "
-            "use ConditionalBlock or Switch"
-        )
+        if not isinstance(cond, Variable):
+            raise TypeError("cond must be a Variable")
+        self.helper = LayerHelper("ifelse", name=name)
+        self.cond = cond
+        self.status = IfElse.OUT_IF_ELSE_BLOCKS
+        self.input_table = {}
+        self.output_table = ([], [])  # (false_outs, true_outs)
+
+    def input(self, x):
+        if self.status == IfElse.OUT_IF_ELSE_BLOCKS:
+            raise ValueError("input() must be called inside a block")
+        if id(x) not in self.input_table:
+            # program parity: record the split op; both halves carry the
+            # full batch (see ops/control_flow.py split_lod_tensor)
+            block = self.helper.main_program.current_block()
+            out_true = block.create_var(
+                name=self.helper.name + ".in_true_%d" % len(self.input_table),
+                shape=x.shape, dtype=x.dtype)
+            out_false = block.create_var(
+                name=self.helper.name + ".in_false_%d"
+                % len(self.input_table),
+                shape=x.shape, dtype=x.dtype)
+            block.append_op(
+                type="split_lod_tensor",
+                inputs={"X": [x], "Mask": [self.cond]},
+                outputs={"OutTrue": [out_true], "OutFalse": [out_false]},
+                attrs={"level": 0},
+            )
+            self.input_table[id(x)] = (out_true, out_false)
+        out_true, out_false = self.input_table[id(x)]
+        return (out_true if self.status == IfElse.IN_IF_ELSE_TRUE_BLOCKS
+                else out_false)
+
+    def true_block(self):
+        return IfElseBlockGuard(True, self)
+
+    def false_block(self):
+        return IfElseBlockGuard(False, self)
+
+    def output(self, *outs):
+        if self.status == IfElse.OUT_IF_ELSE_BLOCKS:
+            raise ValueError("output() can only be invoked inside a block")
+        table = self.output_table[
+            1 if self.status == IfElse.IN_IF_ELSE_TRUE_BLOCKS else 0]
+        table.extend(outs)
+
+    def __call__(self):
+        if self.status != IfElse.OUT_IF_ELSE_BLOCKS:
+            raise ValueError("IfElse::__call__ must be out of sub-blocks")
+        false_outs, true_outs = self.output_table
+        if len(false_outs) != len(true_outs):
+            raise ValueError(
+                "true_block and false_block must set the same number of "
+                "outputs (%d vs %d)" % (len(true_outs), len(false_outs)))
+        block = self.helper.main_program.current_block()
+        merged = []
+        for i, (t, f) in enumerate(zip(true_outs, false_outs)):
+            out = block.create_var(
+                name=self.helper.name + ".out_%d" % i,
+                shape=t.shape, dtype=t.dtype)
+            block.append_op(
+                type="merge_lod_tensor",
+                inputs={"InTrue": [t], "InFalse": [f],
+                        "Mask": [self.cond], "X": [t]},
+                outputs={"Out": [out]},
+                attrs={"level": 0},
+            )
+            merged.append(out)
+        return merged
 
 
 # ---------------------------------------------------------------------------

@@ -523,3 +523,30 @@ def lod_array_length(ctx, attrs, X):
     import jax.numpy as jnp
 
     return jnp.reshape(X["length"], (1,)).astype(jnp.int32)
+
+
+@register_op("split_lod_tensor", inputs=["X", "Mask"],
+             outputs=["OutTrue", "OutFalse"])
+def split_lod_tensor(ctx, attrs, X, Mask):
+    """Reference split_lod_tensor_op.cc partitions rows by mask into two
+    ragged tensors.  Under XLA static shapes both 'halves' keep the full
+    batch (masked-execution semantics): the row selection happens at
+    merge_lod_tensor, so each branch computes on all rows and inactive
+    rows are discarded by the final select — the TPU-standard way to run
+    data-dependent per-row branches."""
+    return {"OutTrue": X, "OutFalse": X}
+
+
+@register_op("merge_lod_tensor", inputs=["InTrue", "InFalse", "Mask", "X"],
+             outputs=["Out"])
+def merge_lod_tensor(ctx, attrs, InTrue, InFalse, Mask, X):
+    """Row-wise select by mask (merge_lod_tensor_op.cc re-interleaving,
+    expressed as a where select over the full batch)."""
+    import jax.numpy as jnp
+
+    m = Mask
+    if m.ndim < InTrue.ndim:
+        m = m.reshape(m.shape + (1,) * (InTrue.ndim - m.ndim))
+    elif m.ndim > InTrue.ndim:
+        m = m.reshape(m.shape[: InTrue.ndim])
+    return jnp.where(m.astype(bool), InTrue, InFalse)

@@ -142,3 +142,57 @@ def test_while_inside_jit_is_compiled_loop():
     dt = time.perf_counter() - t0
     assert float(out[0][0]) == 1000.0
     assert dt < 0.5, "while loop appears to be interpreted (%.3fs)" % dt
+
+
+def test_ifelse_per_row_branches():
+    """IfElse (reference control_flow.py:1564): per-row branch selection;
+    TPU-static masked-merge semantics (both branches on the full batch,
+    per-row select at merge)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.executor import Scope, scope_guard
+    import numpy as np
+
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3], dtype="float32")
+        zero = fluid.layers.fill_constant([1], "float32", 0.0)
+        row_sum = fluid.layers.reduce_sum(x, dim=[1], keep_dim=True)
+        cond = fluid.layers.greater_than(row_sum, zero)  # [B,1] bool
+        ie = fluid.layers.IfElse(cond)
+        with ie.true_block():
+            xt = ie.input(x)
+            ie.output(fluid.layers.scale(xt, 10.0))
+        with ie.false_block():
+            xf = ie.input(x)
+            ie.output(fluid.layers.scale(xf, -1.0))
+        (merged,) = ie()
+        total = fluid.layers.reduce_sum(merged)
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.array([[1, 1, 1], [-1, -1, -1], [2, 0, 0]], "float32")
+    with scope_guard(Scope()):
+        out, tot = exe.run(main, feed={"x": xv}, fetch_list=[merged, total])
+    exp = np.where(xv.sum(1, keepdims=True) > 0, xv * 10.0, -xv)
+    np.testing.assert_allclose(out, exp, rtol=1e-6)
+    # grads flow through the select
+    fluid.unique_name.switch()
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        x = fluid.layers.data("x", shape=[3], dtype="float32",
+                              stop_gradient=False)
+        zero = fluid.layers.fill_constant([1], "float32", 0.0)
+        cond = fluid.layers.greater_than(
+            fluid.layers.reduce_sum(x, dim=[1], keep_dim=True), zero)
+        ie = fluid.layers.IfElse(cond)
+        with ie.true_block():
+            ie.output(fluid.layers.scale(ie.input(x), 10.0))
+        with ie.false_block():
+            ie.output(fluid.layers.scale(ie.input(x), -1.0))
+        (merged,) = ie()
+        loss = fluid.layers.reduce_sum(merged)
+        (gx,) = fluid.backward.gradients(loss, x)
+    with scope_guard(Scope()):
+        gv = exe.run(main2, feed={"x": xv}, fetch_list=[gx])[0]
+    exp_g = np.where(xv.sum(1, keepdims=True) > 0, 10.0, -1.0)
+    np.testing.assert_allclose(gv, np.broadcast_to(exp_g, xv.shape),
+                               rtol=1e-6)
