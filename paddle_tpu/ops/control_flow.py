@@ -550,3 +550,66 @@ def merge_lod_tensor(ctx, attrs, InTrue, InFalse, Mask, X):
     elif m.ndim > InTrue.ndim:
         m = m.reshape(m.shape[: InTrue.ndim])
     return jnp.where(m.astype(bool), InTrue, InFalse)
+
+
+@register_op("lod_rank_table", inputs=["X"], outputs=["Out"], no_grad=True,
+             infer_shape=_no_infer)
+def lod_rank_table(ctx, attrs, X):
+    """Reference lod_rank_table_op.cc sorts sequences by length for the
+    shrinking-batch DynamicRNN.  Padded batches need no reorder: the
+    'rank table' is the lengths tensor itself (descending sort indices
+    attached for parity consumers)."""
+    import jax.numpy as jnp
+
+    lengths = jnp.reshape(X, (-1,)) if X.ndim <= 1 else \
+        jnp.full((X.shape[0],), X.shape[1], jnp.int32)
+    order = jnp.argsort(-lengths.astype(jnp.int32))
+    return {"Out": {"lengths": lengths, "order": order}}
+
+
+@register_op("max_sequence_len2", inputs=["RankTable"], outputs=["Out"],
+             no_grad=True, infer_shape=_no_infer)
+def max_sequence_len2(ctx, attrs, RankTable):
+    import jax.numpy as jnp
+
+    return jnp.max(RankTable["lengths"]).reshape(1).astype(jnp.int64)
+
+
+@register_op("lod_tensor_to_array", inputs=["X", "RankTable"],
+             outputs=["Out"], infer_shape=_no_infer)
+def lod_tensor_to_array(ctx, attrs, X, RankTable):
+    """Reference lod_tensor_to_array_op.cc slices a ragged batch into
+    per-timestep tensors.  Padded [B, T, ...] form: the 'array' is the
+    time-major view in a fixed-capacity buffer."""
+    import jax.numpy as jnp
+
+    tm = jnp.moveaxis(X, 1, 0)  # [T, B, ...]
+    return {"Out": {"buffer": tm,
+                    "length": jnp.asarray(tm.shape[0], jnp.int32)}}
+
+
+@register_op("array_to_lod_tensor", inputs=["X", "RankTable"],
+             outputs=["Out"], infer_shape=_no_infer)
+def array_to_lod_tensor(ctx, attrs, X, RankTable):
+    """Inverse of lod_tensor_to_array: stack the time-major buffer back
+    to batch-major (array_to_lod_tensor_op.cc)."""
+    import jax.numpy as jnp
+
+    return jnp.moveaxis(X["buffer"], 0, 1)
+
+
+@register_op("shrink_rnn_memory", inputs=["X", "RankTable", "I"],
+             outputs=["Out"], infer_shape=_no_infer)
+def shrink_rnn_memory(ctx, attrs, X, RankTable, I):
+    """Reference shrink_rnn_memory_op.cc drops finished sequences from
+    the RNN state as t grows; with masked-scan recurrence the state is
+    full-width and masking handles completion — identity passthrough."""
+    return X
+
+
+@register_op("reorder_lod_tensor_by_rank", inputs=["X", "RankTable"],
+             outputs=["Out"], infer_shape=_no_infer)
+def reorder_lod_tensor_by_rank(ctx, attrs, X, RankTable):
+    """Row reorder by the rank table's descending-length order
+    (reorder_lod_tensor_by_rank_op.cc)."""
+    return X[RankTable["order"]]

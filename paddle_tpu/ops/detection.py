@@ -1160,3 +1160,81 @@ def detection_map(ctx, attrs, DetectRes, Label, HasState, PosCount,
     return {"MAP": m_ap.reshape(1),
             "AccumPosCount": zeros.astype(jnp.int32),
             "AccumTruePos": zeros, "AccumFalsePos": zeros}
+
+
+@register_op("box_decoder_and_assign",
+             inputs=["PriorBox", "PriorBoxVar", "TargetBox", "BoxScore"],
+             outputs=["DecodeBox", "OutputAssignBox"], no_grad=True)
+def box_decoder_and_assign(ctx, attrs, PriorBox, PriorBoxVar, TargetBox,
+                           BoxScore):
+    """Decode per-class box deltas and assign each prior its best-scoring
+    class's box (box_decoder_and_assign_op.cc)."""
+    prior = PriorBox.reshape(-1, 4)
+    n = prior.shape[0]
+    deltas = TargetBox.reshape(n, -1, 4)  # [N, C, 4]
+    var = (PriorBoxVar.reshape(-1, 4) if PriorBoxVar is not None
+           else jnp.ones((1, 4)))
+    pw = prior[:, 2] - prior[:, 0] + 1.0
+    ph = prior[:, 3] - prior[:, 1] + 1.0
+    px = prior[:, 0] + pw * 0.5
+    py = prior[:, 1] + ph * 0.5
+    v = var if var.shape[0] == n else jnp.broadcast_to(var, (n, 4))
+    cx = v[:, None, 0] * deltas[:, :, 0] * pw[:, None] + px[:, None]
+    cy = v[:, None, 1] * deltas[:, :, 1] * ph[:, None] + py[:, None]
+    bw = jnp.exp(jnp.minimum(v[:, None, 2] * deltas[:, :, 2], 10.0)) \
+        * pw[:, None]
+    bh = jnp.exp(jnp.minimum(v[:, None, 3] * deltas[:, :, 3], 10.0)) \
+        * ph[:, None]
+    decoded = jnp.stack([cx - bw / 2, cy - bh / 2,
+                         cx + bw / 2 - 1.0, cy + bh / 2 - 1.0], axis=2)
+    scores = BoxScore.reshape(n, -1)
+    # best non-background class (class 0 = background per the reference)
+    best = jnp.argmax(scores[:, 1:], axis=1) + 1 \
+        if scores.shape[1] > 1 else jnp.zeros((n,), jnp.int32)
+    assigned = jnp.take_along_axis(
+        decoded, best[:, None, None].astype(jnp.int32) *
+        jnp.ones((1, 1, 4), jnp.int32), axis=1)[:, 0]
+    return {"DecodeBox": decoded.reshape(n, -1),
+            "OutputAssignBox": assigned}
+
+
+@register_op("distribute_fpn_proposals", inputs=["FpnRois"],
+             outputs=["MultiFpnRois*", "RestoreIndex"], no_grad=True)
+def distribute_fpn_proposals(ctx, attrs, FpnRois):
+    """Route each ROI to its FPN level by scale
+    (distribute_fpn_proposals_op.cc): level = floor(log2(sqrt(area)/224))
+    + refer_level, clipped.  TPU-static: each level output keeps the full
+    capacity with non-member rows zeroed (RestoreIndex maps rows back)."""
+    min_l = int(attrs.get("min_level", 2))
+    max_l = int(attrs.get("max_level", 5))
+    refer_l = int(attrs.get("refer_level", 4))
+    refer_s = float(attrs.get("refer_scale", 224))
+    rois = FpnRois.reshape(-1, 4)
+    w = jnp.maximum(rois[:, 2] - rois[:, 0], 0.0)
+    h = jnp.maximum(rois[:, 3] - rois[:, 1], 0.0)
+    scale = jnp.sqrt(w * h)
+    lvl = jnp.floor(jnp.log2(jnp.maximum(scale, 1e-6) / refer_s + 1e-12)
+                    ) + refer_l
+    lvl = jnp.clip(lvl, min_l, max_l).astype(jnp.int32)
+    outs = []
+    for l in range(min_l, max_l + 1):
+        m = (lvl == l)[:, None]
+        outs.append(jnp.where(m, rois, 0.0))
+    restore = jnp.argsort(jnp.argsort(lvl, stable=True), stable=True)
+    return {"MultiFpnRois": outs,
+            "RestoreIndex": restore[:, None].astype(jnp.int32)}
+
+
+@register_op("collect_fpn_proposals", inputs=["MultiLevelRois*",
+                                              "MultiLevelScores*"],
+             outputs=["FpnRois"], no_grad=True)
+def collect_fpn_proposals(ctx, attrs, MultiLevelRois, MultiLevelScores):
+    """Merge per-level proposals and keep the post_nms_topN best by score
+    (collect_fpn_proposals_op.cc)."""
+    post_n = int(attrs.get("post_nms_topN", 1000))
+    rois = jnp.concatenate([r.reshape(-1, 4) for r in MultiLevelRois], 0)
+    scores = jnp.concatenate(
+        [s.reshape(-1) for s in MultiLevelScores], 0)
+    k = min(post_n, scores.shape[0])
+    top, idx = jax.lax.top_k(scores, k)
+    return rois[idx]

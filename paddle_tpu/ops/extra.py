@@ -536,3 +536,42 @@ def max_sequence_len(ctx, attrs, RankTable):
     """max_sequence_len_op.cc: with padded batches the rank table is the
     lengths tensor; returns its max."""
     return jnp.max(RankTable).reshape(1).astype(jnp.int64)
+
+
+@register_op("fusion_transpose_flatten_concat", inputs=["X*"],
+             outputs=["Out"])
+def fusion_transpose_flatten_concat(ctx, attrs, X):
+    """fused/fusion_transpose_flatten_concat_op.cc: per-input transpose →
+    flatten from `flatten_axis` → concat on `concat_axis`."""
+    trans = [int(a) for a in attrs.get("trans_axis", [])]
+    flat_axis = int(attrs.get("flatten_axis", 1))
+    concat_axis = int(attrs.get("concat_axis", 1))
+    import math as _math
+
+    outs = []
+    for x in X:
+        t = jnp.transpose(x, trans) if trans else x
+        outs.append(t.reshape(
+            _math.prod(t.shape[:flat_axis]), -1))
+    return jnp.concatenate(outs, axis=concat_axis)
+
+
+@register_op("conv2d_fusion", inputs=["Input", "Filter", "Bias",
+                                      "ResidualData"],
+             outputs=["Output"])
+def conv2d_fusion(ctx, attrs, Input, Filter, Bias, ResidualData):
+    """conv2d_fusion_op.cc: conv + bias + (residual add) + activation —
+    XLA fuses the epilogue; registered for op-level parity."""
+    from .nn import _conv_nd
+    from .registry import get_op_def
+
+    out = _conv_nd(ctx, attrs, Input, Filter, 2)
+    if Bias is not None:
+        out = out + Bias.reshape(1, -1, 1, 1)
+    if ResidualData is not None:
+        out = out + ResidualData
+    act = attrs.get("activation", "relu")
+    if act and act not in ("identity", ""):
+        res = get_op_def(act).fn(ctx, {}, out)
+        out = list(res.values())[0] if isinstance(res, dict) else res
+    return out

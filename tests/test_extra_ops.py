@@ -282,3 +282,78 @@ def test_mine_hard_examples_quota_capped():
              {"neg_pos_ratio": 3.0})
     neg = r["NegIndices"][0][0]
     assert (neg >= 0).sum() == 1 and neg[0] == 3
+
+
+def test_lod_bridges_roundtrip():
+    rng = np.random.RandomState(20)
+    x = rng.randn(2, 3, 4).astype("float32")
+    lens = np.array([3, 2], "int32")
+    ctx = LoweringContext(base_key=jax.random.key(0), mode="train")
+    rt = registry.call_op(
+        registry.get_op_def("lod_rank_table"), ctx, {"X": [lens]}, {}
+    )["Out"][0]
+    assert int(np.asarray(rt["order"])[0]) == 0  # longest first
+    arr = registry.call_op(
+        registry.get_op_def("lod_tensor_to_array"), ctx,
+        {"X": [x], "RankTable": [None]}, {})["Out"][0]
+    back = registry.call_op(
+        registry.get_op_def("array_to_lod_tensor"), ctx,
+        {"X": [arr], "RankTable": [None]}, {})["Out"][0]
+    np.testing.assert_allclose(np.asarray(back), x)
+    reord = registry.call_op(
+        registry.get_op_def("reorder_lod_tensor_by_rank"), ctx,
+        {"X": [x], "RankTable": [rt]}, {})["Out"][0]
+    np.testing.assert_allclose(np.asarray(reord), x)  # already sorted
+
+
+def test_fusion_transpose_flatten_concat_and_conv2d_fusion():
+    rng = np.random.RandomState(21)
+    a = rng.randn(2, 3, 4).astype("float32")
+    b = rng.randn(2, 5, 4).astype("float32")
+    out = call("fusion_transpose_flatten_concat", {"X": [a, b]},
+               {"trans_axis": [0, 2, 1], "flatten_axis": 1,
+                "concat_axis": 1})["Out"][0]
+    exp = np.concatenate([a.transpose(0, 2, 1).reshape(2, -1),
+                          b.transpose(0, 2, 1).reshape(2, -1)], 1)
+    np.testing.assert_allclose(out, exp)
+
+    x = rng.randn(1, 2, 5, 5).astype("float32")
+    f = rng.randn(3, 2, 3, 3).astype("float32")
+    bias = rng.randn(3).astype("float32")
+    out = call("conv2d_fusion",
+               {"Input": x, "Filter": f, "Bias": bias,
+                "ResidualData": None},
+               {"strides": [1, 1], "paddings": [1, 1],
+                "dilations": [1, 1], "activation": "relu"})["Output"][0]
+    assert out.shape == (1, 3, 5, 5) and (out >= 0).all()
+
+
+def test_fpn_distribute_collect():
+    rois = np.array([[0, 0, 30, 30],      # small -> low level
+                     [0, 0, 400, 400]], "float32")  # big -> high level
+    r = call("distribute_fpn_proposals", {"FpnRois": rois},
+             {"min_level": 2, "max_level": 5, "refer_level": 4,
+              "refer_scale": 224})
+    levels = r["MultiFpnRois"]
+    assert len(levels) == 4
+    assert (levels[0][0] != 0).any()      # small roi landed at level 2
+    # 400px roi: floor(log2(400/224)) + 4 = 4 -> index 2
+    assert (levels[2][1] != 0).any()
+
+    out = call("collect_fpn_proposals",
+               {"MultiLevelRois": [rois[:1], rois[1:]],
+                "MultiLevelScores": [np.array([0.1], "float32"),
+                                     np.array([0.9], "float32")]},
+               {"post_nms_topN": 1})["FpnRois"][0]
+    np.testing.assert_allclose(out[0], rois[1])
+
+
+def test_box_decoder_and_assign():
+    prior = np.array([[0, 0, 9, 9]], "float32")
+    deltas = np.zeros((1, 2, 4), "float32")  # 2 classes, zero deltas
+    scores = np.array([[0.1, 0.9]], "float32")
+    r = call("box_decoder_and_assign",
+             {"PriorBox": prior, "PriorBoxVar": None,
+              "TargetBox": deltas.reshape(1, -1), "BoxScore": scores}, {})
+    np.testing.assert_allclose(r["OutputAssignBox"][0][0], prior[0],
+                               atol=1e-4)
