@@ -11,7 +11,10 @@ from .varbase import VarBase
 from .layers import Layer
 from . import nn
 from .nn import (Linear, FC, Conv2D, Pool2D, BatchNorm, Embedding,
-                 LayerNorm, Dropout)
+                 LayerNorm, Dropout, Conv3D, Conv2DTranspose,
+                 Conv3DTranspose, GRUUnit, PRelu, BilinearTensorProduct,
+                 SequenceConv, RowConv, GroupNorm, SpectralNorm, TreeConv,
+                 NCE)
 from .parallel import DataParallel, ParallelEnv, prepare_context
 from .checkpoint import save_dygraph, load_dygraph
 
