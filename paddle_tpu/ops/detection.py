@@ -1238,3 +1238,134 @@ def collect_fpn_proposals(ctx, attrs, MultiLevelRois, MultiLevelScores):
     k = min(post_n, scores.shape[0])
     top, idx = jax.lax.top_k(scores, k)
     return rois[idx]
+
+
+@register_op("retinanet_target_assign",
+             inputs=["Anchor", "GtBoxes", "GtLabels", "IsCrowd", "ImInfo"],
+             outputs=["LocationIndex", "ScoreIndex", "TargetLabel",
+                      "TargetBBox", "BBoxInsideWeight", "ForegroundNumber"],
+             no_grad=True)
+def retinanet_target_assign(ctx, attrs, Anchor, GtBoxes, GtLabels,
+                            IsCrowd, ImInfo):
+    """RetinaNet anchor labeling (retinanet_target_assign_op.cc): like
+    rpn_target_assign but with CLASS labels for positives (focal-loss
+    head) and no subsampling."""
+    pos_thr = float(attrs.get("positive_overlap", 0.5))
+    neg_thr = float(attrs.get("negative_overlap", 0.4))
+    anchors = Anchor.reshape(-1, 4)
+    gts = GtBoxes.reshape(-1, 4)
+    glab = (GtLabels.reshape(-1).astype(jnp.int32)
+            if GtLabels is not None
+            else jnp.ones((gts.shape[0],), jnp.int32))
+    a = anchors.shape[0]
+    iou = _pairwise_iou(anchors, gts, True)
+    gt_valid = (gts[:, 2] > gts[:, 0]) & (gts[:, 3] > gts[:, 1])
+    iou = jnp.where(gt_valid[None, :], iou, -1.0)
+    best_gt = jnp.argmax(iou, axis=1)
+    best_iou = jnp.max(iou, axis=1)
+    best_anchor_per_gt = jnp.argmax(iou, axis=0)
+    is_best = jnp.zeros((a,), bool).at[best_anchor_per_gt].max(gt_valid)
+    positive = (best_iou >= pos_thr) | is_best
+    negative = (best_iou < neg_thr) & ~positive
+    labels = jnp.where(positive, glab[best_gt],
+                       jnp.where(negative, 0, -1))
+    order = jnp.argsort(-jnp.where(positive, 1, jnp.where(negative, 0, -1)
+                                   ))
+    tgt_gt = gts[best_gt]
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    ax = anchors[:, 0] + aw / 2
+    ay = anchors[:, 1] + ah / 2
+    gw = tgt_gt[:, 2] - tgt_gt[:, 0]
+    gh = tgt_gt[:, 3] - tgt_gt[:, 1]
+    gx2 = tgt_gt[:, 0] + gw / 2
+    gy2 = tgt_gt[:, 1] + gh / 2
+    tgt = jnp.stack([
+        (gx2 - ax) / jnp.maximum(aw, 1e-6),
+        (gy2 - ay) / jnp.maximum(ah, 1e-6),
+        jnp.log(jnp.maximum(gw / jnp.maximum(aw, 1e-6), 1e-6)),
+        jnp.log(jnp.maximum(gh / jnp.maximum(ah, 1e-6), 1e-6)),
+    ], axis=1)
+    fg = jnp.sum(positive).astype(jnp.int32)
+    return {
+        "LocationIndex": jnp.where(
+            jnp.arange(a) < fg, order, -1).astype(jnp.int32),
+        "ScoreIndex": jnp.where(
+            jnp.arange(a) < fg + jnp.sum(negative), order, -1
+        ).astype(jnp.int32),
+        "TargetLabel": labels.astype(jnp.int32),
+        "TargetBBox": tgt,
+        "BBoxInsideWeight": jnp.where(positive[:, None], 1.0, 0.0)
+                            * jnp.ones((1, 4)),
+        "ForegroundNumber": fg.reshape(1),
+    }
+
+
+@register_op("roi_perspective_transform",
+             inputs=["X", "ROIs"],
+             outputs=["Out", "Mask", "TransformMatrix", "Out2InIdx",
+                      "Out2InWeights"],
+             no_grad=True,
+             stateful_outputs=("Mask", "TransformMatrix", "Out2InIdx",
+                               "Out2InWeights"))
+def roi_perspective_transform(ctx, attrs, X, ROIs):
+    """Perspective-warp quadrilateral ROIs to a fixed rectangle
+    (roi_perspective_transform_op.cc, OCR text rectification): solve the
+    4-point homography per ROI, then bilinear-sample.  ROIs: [R, 8]
+    quad corners (x1..y4), optionally a leading batch index col."""
+    h_out = int(attrs.get("transformed_height", 8))
+    w_out = int(attrs.get("transformed_width", 8))
+    scale = float(attrs.get("spatial_scale", 1.0))
+    if ROIs.shape[-1] == 9:
+        batch_idx = ROIs[:, 0].astype(jnp.int32)
+        quads = ROIs[:, 1:] * scale
+    else:
+        batch_idx = jnp.zeros((ROIs.shape[0],), jnp.int32)
+        quads = ROIs * scale
+    r = quads.shape[0]
+    n, c, h, w = X.shape
+    # homography mapping unit rect corners -> quad corners (per ROI):
+    # solve the standard 8x8 DLT system
+    src = jnp.asarray([[0.0, 0.0], [1.0, 0.0], [1.0, 1.0], [0.0, 1.0]])
+    dst = quads.reshape(r, 4, 2)
+
+    def solve_h(d):
+        rows = []
+        rhs = []
+        for k in range(4):
+            sx, sy = src[k, 0], src[k, 1]
+            dx, dy = d[k, 0], d[k, 1]
+            rows.append(jnp.asarray(
+                [sx, sy, 1.0, 0.0, 0.0, 0.0, 0.0, 0.0])
+                .at[6].set(-sx * dx).at[7].set(-sy * dx))
+            rhs.append(dx)
+            rows.append(jnp.asarray(
+                [0.0, 0.0, 0.0, sx, sy, 1.0, 0.0, 0.0])
+                .at[6].set(-sx * dy).at[7].set(-sy * dy))
+            rhs.append(dy)
+        A = jnp.stack(rows)
+        b = jnp.asarray(rhs)
+        sol = jnp.linalg.solve(A, b)
+        return jnp.concatenate([sol, jnp.ones(1)]).reshape(3, 3)
+
+    H = jax.vmap(solve_h)(dst)  # [R, 3, 3]
+    ys = (jnp.arange(h_out) + 0.5) / h_out
+    xs = (jnp.arange(w_out) + 0.5) / w_out
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    grid = jnp.stack([gx, gy, ones], axis=-1)  # [Ho, Wo, 3]
+    mapped = jnp.einsum("rij,hwj->rhwi", H, grid)
+    px = mapped[..., 0] / jnp.maximum(mapped[..., 2], 1e-8)
+    py = mapped[..., 1] / jnp.maximum(mapped[..., 2], 1e-8)
+    from .vision import _bilinear_sample
+
+    gxn = 2.0 * px / jnp.maximum(w - 1, 1) - 1.0
+    gyn = 2.0 * py / jnp.maximum(h - 1, 1) - 1.0
+    feats = X[batch_idx]  # [R, C, H, W]
+    out = _bilinear_sample(feats, gxn, gyn)  # [R, C, Ho, Wo]
+    in_img = ((px >= 0) & (px <= w - 1) & (py >= 0)
+              & (py <= h - 1)).astype(jnp.int32)
+    return {"Out": out, "Mask": in_img[:, None],
+            "TransformMatrix": H.reshape(r, 9),
+            "Out2InIdx": jnp.zeros((1,), jnp.int32),
+            "Out2InWeights": jnp.zeros((1,), jnp.float32)}

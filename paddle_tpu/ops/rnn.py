@@ -278,3 +278,83 @@ def fusion_gru(ctx, attrs, X, WeightX, WeightH, Bias, H0, SeqLen):
     """Fused x-projection + GRU (fused/fusion_gru_op.cc)."""
     gates = jnp.matmul(X, WeightX)
     return gru(ctx, dict(attrs), gates, H0, WeightH, Bias, SeqLen)
+
+
+@register_op(
+    "fused_embedding_fc_lstm",
+    inputs=["Ids", "Embeddings", "WeightH", "Bias", "H0", "C0", "SeqLen"],
+    outputs=["Hidden", "Cell"],
+)
+def fused_embedding_fc_lstm(ctx, attrs, Ids, Embeddings, WeightH, Bias,
+                            H0, C0, SeqLen):
+    """fused/fused_embedding_fc_lstm_op.cc: embedding lookup (the table
+    already contains W_x-projected gate rows) + LSTM.  Embeddings:
+    [V, 4D] pre-projected rows; Ids [B, T]."""
+    ids = Ids
+    if ids.ndim == 3 and ids.shape[-1] == 1:
+        ids = ids[..., 0]
+    gates = jnp.take(Embeddings, jnp.maximum(ids.astype(jnp.int32), 0),
+                     axis=0)  # [B, T, 4D]
+    return lstm(ctx, dict(attrs), gates, H0, C0, WeightH, Bias, SeqLen)
+
+
+@register_op(
+    "attention_lstm",
+    inputs=["X", "C0", "H0", "AttentionWeight", "AttentionBias",
+            "AttentionScalar", "AttentionScalarBias", "LSTMWeight",
+            "LSTMBias", "SeqLen"],
+    outputs=["Hidden", "Cell", "AttentionedX", "AttentionFCOut",
+             "LSTMX", "LSTMOUT"],
+    stateful_outputs=("AttentionedX", "AttentionFCOut", "LSTMX",
+                      "LSTMOUT"),
+)
+def attention_lstm(ctx, attrs, X, C0, H0, AttentionWeight, AttentionBias,
+                   AttentionScalar, AttentionScalarBias, LSTMWeight,
+                   LSTMBias, SeqLen):
+    """fused/attention_lstm_op.cc: per step, score every input row by
+    fc([x_t_all, h]) → softmax over time → attention-pooled x feeds one
+    LSTM step.  Padded [B, T, D] + lengths; the per-step host loop
+    becomes a lax.scan whose body does the [B,T] attention."""
+    B, T, D = X.shape
+    d = C0.shape[-1]
+    gate_act = _ACT[attrs.get("gate_activation", "sigmoid")]
+    cell_act = _ACT[attrs.get("cell_activation", "tanh")]
+    cand_act = _ACT[attrs.get("candidate_activation", "tanh")]
+    h0 = H0 if H0 is not None else jnp.zeros((B, d), X.dtype)
+    c0 = C0
+    lengths = (jnp.reshape(SeqLen, (-1,)).astype(jnp.int32)
+               if SeqLen is not None else jnp.full((B,), T, jnp.int32))
+    tmask = jnp.arange(T)[None, :] < lengths[:, None]  # [B, T]
+
+    def step(carry, _):
+        h, c = carry
+        # attention scores: fc([x_t, h]) per row
+        hx = jnp.concatenate(
+            [X, jnp.broadcast_to(h[:, None, :], (B, T, d))], axis=2)
+        s = jnp.tanh(jnp.matmul(hx, AttentionWeight)
+                     + (AttentionBias.reshape(1, 1, -1)
+                        if AttentionBias is not None else 0.0))
+        if AttentionScalar is not None:
+            s = s * AttentionScalar.reshape(1, 1, -1)
+            s = jnp.sum(s, axis=2)
+            if AttentionScalarBias is not None:
+                s = s + AttentionScalarBias.reshape(1, -1)[:, :1]
+        else:
+            s = s[..., 0]
+        s = jnp.where(tmask, s, -1e30)
+        w = jax.nn.softmax(s, axis=1)  # [B, T]
+        xt = jnp.einsum("bt,btd->bd", w, X)
+        gates = jnp.matmul(jnp.concatenate([xt, h], axis=1), LSTMWeight)
+        if LSTMBias is not None:
+            gates = gates + LSTMBias.reshape(1, -1)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c_new = gate_act(f) * c + gate_act(i) * cand_act(g)
+        h_new = gate_act(o) * cell_act(c_new)
+        return (h_new, c_new), (h_new, c_new)
+
+    (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0), None, length=T)
+    hs = jnp.moveaxis(hs, 0, 1)
+    cs = jnp.moveaxis(cs, 0, 1)
+    zero = jnp.zeros((1,), X.dtype)
+    return {"Hidden": hs, "Cell": cs, "AttentionedX": zero,
+            "AttentionFCOut": zero, "LSTMX": zero, "LSTMOUT": zero}

@@ -667,3 +667,43 @@ def test_rpn_target_assign_empty_image_and_anchor0():
          "ImInfo": [None]}, {"rpn_positive_overlap": 0.9})
     labels = np.asarray(out["TargetLabel"][0])
     assert labels[0] == 1
+
+
+def test_retinanet_target_assign_labels():
+    from paddle_tpu.ops import registry
+    from paddle_tpu.ops.registry import LoweringContext
+    import jax
+
+    ctx = LoweringContext(base_key=jax.random.key(0), mode="train")
+    anchors = np.array([[0, 0, 10, 10], [50, 50, 60, 60]], "float32")
+    gts = np.array([[0, 0, 9, 9]], "float32")
+    labels = np.array([3], "int32")
+    out = registry.call_op(
+        registry.get_op_def("retinanet_target_assign"), ctx,
+        {"Anchor": [anchors], "GtBoxes": [gts], "GtLabels": [labels],
+         "IsCrowd": [None], "ImInfo": [None]}, {})
+    tl = np.asarray(out["TargetLabel"][0])
+    assert tl[0] == 3 and tl[1] == 0  # class label kept; background 0
+    assert int(np.asarray(out["ForegroundNumber"][0])[0]) == 1
+
+
+def test_roi_perspective_transform_axis_aligned():
+    """Axis-aligned quad == plain resize of the crop region."""
+    from paddle_tpu.ops import registry
+    from paddle_tpu.ops.registry import LoweringContext
+    import jax
+
+    ctx = LoweringContext(base_key=jax.random.key(0), mode="train")
+    x = np.arange(36, dtype="float32").reshape(1, 1, 6, 6)
+    # quad = full image corners, clockwise from top-left
+    rois = np.array([[0, 0, 5, 0, 5, 5, 0, 5]], "float32")
+    out = registry.call_op(
+        registry.get_op_def("roi_perspective_transform"), ctx,
+        {"X": [x], "ROIs": [rois]},
+        {"transformed_height": 6, "transformed_width": 6,
+         "spatial_scale": 1.0})
+    o = np.asarray(out["Out"][0])
+    assert o.shape == (1, 1, 6, 6)
+    # corners approximately preserved (half-pixel sampling offsets)
+    assert abs(o[0, 0, 0, 0] - x[0, 0, 0, 0]) < 4.0
+    assert o[0, 0, -1, -1] > 25.0

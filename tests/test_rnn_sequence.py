@@ -156,3 +156,40 @@ def test_sequence_softmax_masks_padding():
     res = _run(main, startup, {"x": x, "lens": lens}, [out])[0]
     assert res[0, 2] == 0 and res[0, 3] == 0
     np.testing.assert_allclose(res.sum(1), 1.0, rtol=1e-5)
+
+
+def test_attention_lstm_and_fused_embedding_fc_lstm():
+    from paddle_tpu.ops import registry
+    from paddle_tpu.ops.registry import LoweringContext
+    import jax
+
+    ctx = LoweringContext(base_key=jax.random.key(0), mode="train")
+    rng = np.random.RandomState(0)
+    B, T, D, H = 2, 5, 4, 3
+    x = rng.randn(B, T, D).astype("float32")
+    c0 = np.zeros((B, H), "float32")
+    attn_w = rng.randn(D + H, 1).astype("float32")
+    lstm_w = rng.randn(D + H, 4 * H).astype("float32")
+    out = registry.call_op(
+        registry.get_op_def("attention_lstm"), ctx,
+        {"X": [x], "C0": [c0], "H0": [None],
+         "AttentionWeight": [attn_w], "AttentionBias": [None],
+         "AttentionScalar": [None], "AttentionScalarBias": [None],
+         "LSTMWeight": [lstm_w], "LSTMBias": [None],
+         "SeqLen": [np.array([5, 3], "int64")]}, {})
+    hs = np.asarray(out["Hidden"][0])
+    assert hs.shape == (B, T, H) and np.isfinite(hs).all()
+
+    V = 11
+    emb = rng.randn(V, 4 * H).astype("float32")
+    wh = rng.randn(H, 4 * H).astype("float32")
+    ids = rng.randint(0, V, (B, T)).astype("int64")
+    out = registry.call_op(
+        registry.get_op_def("fused_embedding_fc_lstm"), ctx,
+        {"Ids": [ids], "Embeddings": [emb], "WeightH": [wh],
+         "Bias": [None], "H0": [None], "C0": [None],
+         "SeqLen": [np.array([5, 2], "int64")]}, {})
+    hs = np.asarray(out["Hidden"][0])
+    assert hs.shape == (B, T, H) and np.isfinite(hs).all()
+    # masked steps carry state: rows past length equal the last valid row
+    np.testing.assert_allclose(hs[1, 2], hs[1, 1], rtol=1e-6)
