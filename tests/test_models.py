@@ -98,3 +98,53 @@ def test_bert_tiny_amp_bf16():
     losses = _train(main, startup, feed_fn, loss, steps=6)
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+def test_stacked_dynamic_lstm_trains():
+    """benchmark/fluid/models/stacked_dynamic_lstm.py parity model."""
+    from paddle_tpu.models import stacked_dynamic_lstm as sdl
+
+    rng = np.random.RandomState(0)
+    V, T = 120, 12
+    main, startup, feeds, loss, acc = sdl.build(
+        vocab_size=V, seq_len=T, emb_dim=16, hidden_dim=16,
+        stacked_num=3, lr=5e-3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        half = V // 2
+        # overfit one fixed batch: the canonical loss-drops oracle
+        y = rng.randint(0, 2, (16, 1)).astype("int64")
+        w = np.where(
+            (rng.rand(16, T) < 0.7) == y.astype(bool),
+            rng.randint(half, V, (16, T)),
+            rng.randint(1, half, (16, T))).astype("int64")
+        l = rng.randint(4, T + 1, (16,)).astype("int64")
+        losses = []
+        for _ in range(50):
+            (lv,) = exe.run(main, feed={"words": w, "lens": l, "label": y},
+                            fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(())))
+    assert losses[-1] < 0.3 * losses[0], (losses[0], losses[-1])
+
+
+def test_se_resnext_trains():
+    """benchmark/fluid/models/se_resnext.py parity model (compact)."""
+    from paddle_tpu.models import se_resnext
+
+    rng = np.random.RandomState(1)
+    main, startup, feeds, loss, acc = se_resnext.build(
+        image_shape=(3, 16, 16), class_dim=4, lr=5e-3,
+        cardinality=4, depth=(1, 1), num_filters=(8, 16))
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        # overfit one fixed batch
+        x = rng.randn(16, 3, 16, 16).astype("float32")
+        y = rng.randint(0, 4, (16, 1)).astype("int64")
+        losses = []
+        for _ in range(40):
+            (lv,) = exe.run(main, feed={"img": x, "label": y},
+                            fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(())))
+    assert losses[-1] < 0.6 * losses[0], (losses[0], losses[-1])
