@@ -10,6 +10,7 @@ from . import control_flow
 from . import sequence
 from . import metric_op
 from . import detection
+from . import detection_extra
 from . import beam
 from . import learning_rate_scheduler
 from . import collective
@@ -25,6 +26,7 @@ from .control_flow import *  # noqa: F401,F403
 from .sequence import *  # noqa: F401,F403
 from .metric_op import *  # noqa: F401,F403
 from .detection import *  # noqa: F401,F403
+from .detection_extra import *  # noqa: F401,F403
 from .beam import *  # noqa: F401,F403
 from .learning_rate_scheduler import *  # noqa: F401,F403
 
@@ -39,6 +41,7 @@ __all__ = (
     + sequence.__all__
     + metric_op.__all__
     + detection.__all__
+    + detection_extra.__all__
     + beam.__all__
     + learning_rate_scheduler.__all__
 )

@@ -35,6 +35,9 @@ __all__ = [
     "equal",
     "not_equal",
     "cond",
+    "Print",
+    "is_empty",
+    "reorder_lod_tensor_by_rank",
 ]
 
 
@@ -887,3 +890,39 @@ class DynamicRNN:
                 "step_output_names": [v.name for v in self.step_outputs],
             },
         )
+
+
+def Print(input, first_n=-1, message=None, summarize=-1,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """reference control_flow.py Print → print op (jax.debug.print
+    under jit)."""
+    helper = LayerHelper("print")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="print", inputs={"In": [input]}, outputs={"Out": [out]},
+        attrs={"message": message or ""},
+    )
+    return out
+
+
+def is_empty(x, cond=None):
+    """reference control_flow.py is_empty → is_empty op."""
+    helper = LayerHelper("is_empty")
+    out = cond or helper.create_variable_for_type_inference("bool", True)
+    helper.append_op(type="is_empty", inputs={"X": [x]},
+                     outputs={"Out": [out]})
+    return out
+
+
+def reorder_lod_tensor_by_rank(x, rank_table):
+    """reference control_flow.py reorder_lod_tensor_by_rank op."""
+    helper = LayerHelper("reorder_lod_tensor_by_rank")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="reorder_lod_tensor_by_rank",
+        inputs={"X": [x], "RankTable": [rank_table]},
+        outputs={"Out": [out]},
+    )
+    return out

@@ -1,0 +1,229 @@
+"""Detection-training layer wrappers (reference
+``python/paddle/fluid/layers/detection.py``) over the registered ops in
+ops/detection.py."""
+
+from ..framework import Variable
+from ..layer_helper import LayerHelper
+from .nn_extra import _simple
+
+__all__ = [
+    "yolov3_loss", "rpn_target_assign", "retinanet_target_assign",
+    "bipartite_match", "target_assign", "generate_proposals",
+    "distribute_fpn_proposals", "collect_fpn_proposals",
+    "box_decoder_and_assign", "roi_perspective_transform",
+    "generate_proposal_labels", "generate_mask_labels",
+    "retinanet_detection_output",
+]
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None):
+    """reference detection.py yolov3_loss → yolov3_loss op."""
+    loss, _, _ = _simple(
+        "yolov3_loss",
+        {"X": x, "GTBox": gt_box, "GTLabel": gt_label,
+         "GTScore": gt_score},
+        {"anchors": [int(a) for a in anchors],
+         "anchor_mask": [int(a) for a in anchor_mask],
+         "class_num": int(class_num),
+         "ignore_thresh": float(ignore_thresh),
+         "downsample_ratio": int(downsample_ratio),
+         "use_label_smooth": bool(use_label_smooth)},
+        outs=("Loss", "ObjectnessMask", "GTMatchMask"))
+    return loss
+
+
+def rpn_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                      gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=True):
+    """reference detection.py rpn_target_assign → rpn_target_assign op
+    (deterministic capped selection instead of random subsampling)."""
+    loc_idx, score_idx, tgt_lbl, tgt_bbox, inside_w = _simple(
+        "rpn_target_assign",
+        {"Anchor": anchor_box, "GtBoxes": gt_boxes,
+         "IsCrowd": is_crowd, "ImInfo": im_info},
+        {"rpn_batch_size_per_im": int(rpn_batch_size_per_im),
+         "rpn_positive_overlap": float(rpn_positive_overlap),
+         "rpn_negative_overlap": float(rpn_negative_overlap)},
+        outs=("LocationIndex", "ScoreIndex", "TargetLabel", "TargetBBox",
+              "BBoxInsideWeight"),
+        stop_gradient=True)
+    return loc_idx, score_idx, tgt_lbl, tgt_bbox, inside_w
+
+
+def retinanet_target_assign(bbox_pred, cls_logits, anchor_box, anchor_var,
+                            gt_boxes, gt_labels, is_crowd=None,
+                            im_info=None, num_classes=1,
+                            positive_overlap=0.5, negative_overlap=0.4):
+    """reference detection.py retinanet_target_assign."""
+    outs = _simple(
+        "retinanet_target_assign",
+        {"Anchor": anchor_box, "GtBoxes": gt_boxes,
+         "GtLabels": gt_labels, "IsCrowd": is_crowd, "ImInfo": im_info},
+        {"positive_overlap": float(positive_overlap),
+         "negative_overlap": float(negative_overlap)},
+        outs=("LocationIndex", "ScoreIndex", "TargetLabel", "TargetBBox",
+              "BBoxInsideWeight", "ForegroundNumber"),
+        stop_gradient=True)
+    return outs
+
+
+def bipartite_match(dist_matrix, match_type=None, dist_threshold=None,
+                    name=None):
+    """reference detection.py bipartite_match."""
+    idx, dist = _simple(
+        "bipartite_match", {"DistMat": dist_matrix},
+        {"match_type": match_type or "bipartite",
+         "dist_threshold": float(dist_threshold or 0.5)},
+        outs=("ColToRowMatchIndices", "ColToRowMatchDist"),
+        stop_gradient=True)
+    return idx, dist
+
+
+def target_assign(input, matched_indices, negative_indices=None,
+                  mismatch_value=None, name=None):
+    """reference detection.py target_assign."""
+    out, w = _simple(
+        "target_assign",
+        {"X": input, "MatchIndices": matched_indices,
+         "NegIndices": negative_indices},
+        {"mismatch_value": mismatch_value or 0},
+        outs=("Out", "OutWeight"), stop_gradient=True)
+    return out, w
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None):
+    """reference detection.py generate_proposals."""
+    rois, probs = _simple(
+        "generate_proposals",
+        {"Scores": scores, "BboxDeltas": bbox_deltas, "ImInfo": im_info,
+         "Anchors": anchors, "Variances": variances},
+        {"pre_nms_topN": int(pre_nms_top_n),
+         "post_nms_topN": int(post_nms_top_n),
+         "nms_thresh": float(nms_thresh), "min_size": float(min_size)},
+        outs=("RpnRois", "RpnRoiProbs"), stop_gradient=True)
+    return rois, probs
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, name=None):
+    """reference detection.py distribute_fpn_proposals."""
+    helper = LayerHelper("distribute_fpn_proposals", **locals())
+    n_levels = max_level - min_level + 1
+    outs = [helper.create_variable_for_type_inference(fpn_rois.dtype, True)
+            for _ in range(n_levels)]
+    restore = helper.create_variable_for_type_inference("int32", True)
+    helper.append_op(
+        type="distribute_fpn_proposals",
+        inputs={"FpnRois": [fpn_rois]},
+        outputs={"MultiFpnRois": [o.name for o in outs],
+                 "RestoreIndex": [restore]},
+        attrs={"min_level": int(min_level), "max_level": int(max_level),
+               "refer_level": int(refer_level),
+               "refer_scale": float(refer_scale)},
+    )
+    return outs, restore
+
+
+def collect_fpn_proposals(multi_rois, multi_scores, min_level, max_level,
+                          post_nms_top_n, name=None):
+    """reference detection.py collect_fpn_proposals."""
+    return _simple(
+        "collect_fpn_proposals",
+        {"MultiLevelRois": list(multi_rois),
+         "MultiLevelScores": list(multi_scores)},
+        {"post_nms_topN": int(post_nms_top_n)},
+        outs=("FpnRois",), stop_gradient=True)
+
+
+def box_decoder_and_assign(prior_box, prior_box_var, target_box, box_score,
+                           box_clip=None, name=None):
+    """reference detection.py box_decoder_and_assign."""
+    dec, assigned = _simple(
+        "box_decoder_and_assign",
+        {"PriorBox": prior_box, "PriorBoxVar": prior_box_var,
+         "TargetBox": target_box, "BoxScore": box_score}, {},
+        outs=("DecodeBox", "OutputAssignBox"), stop_gradient=True)
+    return dec, assigned
+
+
+def roi_perspective_transform(input, rois, transformed_height,
+                              transformed_width, spatial_scale=1.0,
+                              name=None):
+    """reference detection.py roi_perspective_transform."""
+    outs = _simple(
+        "roi_perspective_transform", {"X": input, "ROIs": rois},
+        {"transformed_height": int(transformed_height),
+         "transformed_width": int(transformed_width),
+         "spatial_scale": float(spatial_scale)},
+        outs=("Out", "Mask", "TransformMatrix", "Out2InIdx",
+              "Out2InWeights"),
+        stop_gradient=True)
+    return outs[0]
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, is_crowd, gt_boxes,
+                             im_info, batch_size_per_im=256,
+                             fg_fraction=0.25, fg_thresh=0.5,
+                             bg_thresh_hi=0.5, bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None, use_random=True,
+                             is_cls_agnostic=False, is_cascade_rcnn=False):
+    """reference detection.py generate_proposal_labels →
+    generate_proposal_labels op (deterministic capped fg/bg sampling)."""
+    outs = _simple(
+        "generate_proposal_labels",
+        {"RpnRois": rpn_rois, "GtClasses": gt_classes,
+         "IsCrowd": is_crowd, "GtBoxes": gt_boxes, "ImInfo": im_info},
+        {"batch_size_per_im": int(batch_size_per_im),
+         "fg_fraction": float(fg_fraction), "fg_thresh": float(fg_thresh),
+         "bg_thresh_hi": float(bg_thresh_hi),
+         "bg_thresh_lo": float(bg_thresh_lo),
+         "bbox_reg_weights": [float(w) for w in bbox_reg_weights],
+         "class_nums": int(class_nums or 81)},
+        outs=("Rois", "LabelsInt32", "BboxTargets", "BboxInsideWeights",
+              "BboxOutsideWeights"),
+        stop_gradient=True)
+    return outs
+
+
+def generate_mask_labels(im_info, gt_classes, is_crowd, gt_segms, rois,
+                         labels_int32, num_classes, resolution):
+    """reference detection.py generate_mask_labels →
+    generate_mask_labels op.  Deviation: gt_segms are PRE-RASTERIZED
+    [G, H, W] masks (the reference takes COCO polygons; polygon
+    rasterization is host preprocessing, not device work)."""
+    outs = _simple(
+        "generate_mask_labels",
+        {"ImInfo": im_info, "GtClasses": gt_classes, "IsCrowd": is_crowd,
+         "GtSegms": gt_segms, "Rois": rois, "LabelsInt32": labels_int32},
+        {"num_classes": int(num_classes), "resolution": int(resolution)},
+        outs=("MaskRois", "RoiHasMaskInt32", "MaskInt32"),
+        stop_gradient=True)
+    return outs
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.3,
+                               nms_eta=1.0):
+    """reference detection.py retinanet_detection_output →
+    retinanet_detection_output op."""
+    return _simple(
+        "retinanet_detection_output",
+        {"BBoxes": list(bboxes) if isinstance(bboxes, (list, tuple))
+         else [bboxes],
+         "Scores": list(scores) if isinstance(scores, (list, tuple))
+         else [scores],
+         "Anchors": list(anchors) if isinstance(anchors, (list, tuple))
+         else [anchors],
+         "ImInfo": im_info},
+        {"score_threshold": float(score_threshold),
+         "nms_top_k": int(nms_top_k), "keep_top_k": int(keep_top_k),
+         "nms_threshold": float(nms_threshold), "nms_eta": float(nms_eta)},
+        stop_gradient=True)

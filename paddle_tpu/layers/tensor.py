@@ -28,6 +28,9 @@ __all__ = [
     "diag",
     "argmax",
     "argmin",
+    "create_parameter",
+    "reverse",
+    "tensor_array_to_tensor",
 ]
 
 
@@ -236,3 +239,48 @@ def argmin(x, axis=0):
     from .nn import argmin as _argmin
 
     return _argmin(x, axis)
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """reference tensor.py create_parameter: a raw trainable parameter."""
+    from ..layer_helper import LayerHelper
+    from ..param_attr import ParamAttr
+
+    helper = LayerHelper("create_parameter")
+    a = ParamAttr._to_attr(attr)
+    if name is not None and a.name is None:
+        a.name = name
+    if default_initializer is not None:
+        a._set_default_initializer(default_initializer)
+    return helper.create_parameter(a, list(shape), dtype, is_bias=is_bias)
+
+
+def reverse(x, axis):
+    """reference tensor.py reverse → reverse op."""
+    from ..layer_helper import LayerHelper
+
+    helper = LayerHelper("reverse")
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="reverse", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={"axis": [axis] if isinstance(axis, int) else list(axis)},
+    )
+    return out
+
+
+def tensor_array_to_tensor(input, axis=1, name=None):
+    """reference tensor.py tensor_array_to_tensor: stack/concat a
+    LoDTensorArray back into one tensor along `axis`."""
+    from ..layer_helper import LayerHelper
+
+    helper = LayerHelper("tensor_array_to_tensor")
+    out = helper.create_variable_for_type_inference(input.dtype)
+    idx = helper.create_variable_for_type_inference("int32", True)
+    helper.append_op(
+        type="tensor_array_to_tensor",
+        inputs={"X": [input]},
+        outputs={"Out": [out], "OutIndex": [idx]},
+        attrs={"axis": int(axis)},
+    )
+    return out, idx

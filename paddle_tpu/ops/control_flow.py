@@ -613,3 +613,21 @@ def reorder_lod_tensor_by_rank(ctx, attrs, X, RankTable):
     """Row reorder by the rank table's descending-length order
     (reorder_lod_tensor_by_rank_op.cc)."""
     return X[RankTable["order"]]
+
+
+@register_op("tensor_array_to_tensor", inputs=["X"],
+             outputs=["Out", "OutIndex"], infer_shape=_no_infer,
+             stateful_outputs=("OutIndex",))
+def tensor_array_to_tensor(ctx, attrs, X):
+    """Concatenate the tensor-array buffer along `axis` with the leading
+    array dim folded in (tensor_array_to_tensor_op.cc)."""
+    import jax.numpy as jnp
+
+    axis = int(attrs.get("axis", 1))
+    buf = X["buffer"]  # [K, ...]
+    k = buf.shape[0]
+    parts = [buf[i] for i in range(k)]
+    out = jnp.concatenate(parts, axis=axis) if axis != 0 else jnp.stack(
+        parts, axis=0).reshape((-1,) + buf.shape[2:])
+    sizes = jnp.full((k,), parts[0].shape[axis] if parts else 0, jnp.int32)
+    return {"Out": out, "OutIndex": sizes}

@@ -1369,3 +1369,193 @@ def roi_perspective_transform(ctx, attrs, X, ROIs):
             "TransformMatrix": H.reshape(r, 9),
             "Out2InIdx": jnp.zeros((1,), jnp.int32),
             "Out2InWeights": jnp.zeros((1,), jnp.float32)}
+
+
+@register_op("generate_proposal_labels",
+             inputs=["RpnRois", "GtClasses", "IsCrowd", "GtBoxes",
+                     "ImInfo"],
+             outputs=["Rois", "LabelsInt32", "BboxTargets",
+                      "BboxInsideWeights", "BboxOutsideWeights"],
+             no_grad=True)
+def generate_proposal_labels(ctx, attrs, RpnRois, GtClasses, IsCrowd,
+                             GtBoxes, ImInfo):
+    """Sample foreground/background ROIs and build regression targets
+    (generate_proposal_labels_op.cc, single image).  Deterministic
+    hardest-first capped selection replaces random subsampling (TPU
+    reproducibility); outputs are fixed-capacity, padding rows zeroed."""
+    cap = int(attrs.get("batch_size_per_im", 256))
+    fg_frac = float(attrs.get("fg_fraction", 0.25))
+    fg_thresh = float(attrs.get("fg_thresh", 0.5))
+    bg_hi = float(attrs.get("bg_thresh_hi", 0.5))
+    bg_lo = float(attrs.get("bg_thresh_lo", 0.0))
+    weights = [float(w) for w in attrs.get("bbox_reg_weights",
+                                           [0.1, 0.1, 0.2, 0.2])]
+    class_nums = int(attrs.get("class_nums", 81))
+    rois = RpnRois.reshape(-1, 4)
+    gts = GtBoxes.reshape(-1, 4)
+    gcls = (GtClasses.reshape(-1).astype(jnp.int32)
+            if GtClasses is not None
+            else jnp.ones((gts.shape[0],), jnp.int32))
+    r = rois.shape[0]
+    iou = _pairwise_iou(rois, gts, True)
+    gt_valid = (gts[:, 2] > gts[:, 0]) & (gts[:, 3] > gts[:, 1])
+    iou = jnp.where(gt_valid[None, :], iou, -1.0)
+    best_gt = jnp.argmax(iou, axis=1)
+    best_iou = jnp.max(iou, axis=1)
+    is_fg = best_iou >= fg_thresh
+    is_bg = (best_iou < bg_hi) & (best_iou >= bg_lo)
+    fg_quota = int(round(cap * fg_frac))
+    # deterministic selection: highest-IoU foregrounds, then backgrounds
+    fg_order = jnp.argsort(-jnp.where(is_fg, best_iou, -jnp.inf))
+    n_fg = jnp.minimum(jnp.sum(is_fg), fg_quota)
+    bg_order = jnp.argsort(-jnp.where(is_bg, best_iou, -jnp.inf))
+    n_bg = jnp.minimum(jnp.sum(is_bg), cap - n_fg)
+    k = min(cap, r)
+    take_fg = jnp.arange(k) < n_fg
+    sel = jnp.where(take_fg, fg_order[:k],
+                    bg_order[jnp.maximum(jnp.arange(k) - n_fg, 0)])
+    valid = jnp.arange(k) < (n_fg + n_bg)
+    sel_rois = jnp.where(valid[:, None], rois[sel], 0.0)
+    labels = jnp.where(take_fg & valid, gcls[best_gt[sel]], 0)
+    # encoded regression targets for foregrounds
+    tgt_gt = gts[best_gt[sel]]
+    rw = jnp.maximum(sel_rois[:, 2] - sel_rois[:, 0], 1e-6)
+    rh = jnp.maximum(sel_rois[:, 3] - sel_rois[:, 1], 1e-6)
+    rx = sel_rois[:, 0] + rw / 2
+    ry = sel_rois[:, 1] + rh / 2
+    gw = jnp.maximum(tgt_gt[:, 2] - tgt_gt[:, 0], 1e-6)
+    gh = jnp.maximum(tgt_gt[:, 3] - tgt_gt[:, 1], 1e-6)
+    gx = tgt_gt[:, 0] + gw / 2
+    gy = tgt_gt[:, 1] + gh / 2
+    t = jnp.stack([(gx - rx) / rw / weights[0],
+                   (gy - ry) / rh / weights[1],
+                   jnp.log(gw / rw) / weights[2],
+                   jnp.log(gh / rh) / weights[3]], axis=1)
+    fg_mask = (take_fg & valid)[:, None]
+    # per-class layout [K, 4*class_nums] like the reference
+    tgt_full = jnp.zeros((k, 4 * class_nums))
+    col = jnp.maximum(labels, 0)[:, None] * 4 + jnp.arange(4)[None, :]
+    tgt_full = jax.vmap(
+        lambda row, c, v, m: row.at[c].set(jnp.where(m, v, 0.0))
+    )(tgt_full, col, t, fg_mask[:, 0:1].repeat(4, 1) if False else
+      jnp.broadcast_to(fg_mask, (k, 4)))
+    inside = jax.vmap(
+        lambda row, c, m: row.at[c].set(
+            jnp.where(m, 1.0, 0.0)))(jnp.zeros((k, 4 * class_nums)), col,
+                                     jnp.broadcast_to(fg_mask, (k, 4)))
+    return {
+        "Rois": sel_rois,
+        "LabelsInt32": labels.astype(jnp.int32)[:, None],
+        "BboxTargets": tgt_full,
+        "BboxInsideWeights": inside,
+        "BboxOutsideWeights": inside,
+    }
+
+
+@register_op("generate_mask_labels",
+             inputs=["ImInfo", "GtClasses", "IsCrowd", "GtSegms", "Rois",
+                     "LabelsInt32"],
+             outputs=["MaskRois", "RoiHasMaskInt32", "MaskInt32"],
+             no_grad=True)
+def generate_mask_labels(ctx, attrs, ImInfo, GtClasses, IsCrowd, GtSegms,
+                         Rois, LabelsInt32):
+    """Mask targets for Mask R-CNN (generate_mask_labels_op.cc).
+    Deviation: GtSegms are PRE-RASTERIZED [G, H, W] binary masks (COCO
+    polygon rasterization is host preprocessing); each foreground ROI
+    crops+resizes its matched gt mask to resolution^2 via bilinear
+    sampling, output one-hot per class like the reference."""
+    num_classes = int(attrs.get("num_classes", 81))
+    res = int(attrs.get("resolution", 14))
+    rois = Rois.reshape(-1, 4)
+    labels = jnp.reshape(LabelsInt32, (-1,)).astype(jnp.int32)
+    masks = GtSegms  # [G, H, W]
+    g, mh, mw = masks.shape
+    k = rois.shape[0]
+    # match each fg ROI to the gt mask with max overlap of its box...
+    # the reference reuses the proposal-label matching; here: center
+    # containment heuristic replaced by IoU of boxes derived from masks
+    ys = jnp.any(masks > 0.5, axis=2)
+    xs_ = jnp.any(masks > 0.5, axis=1)
+    def bounds(b, n):
+        idx = jnp.arange(n)
+        lo = jnp.min(jnp.where(b, idx, n)).astype(jnp.float32)
+        hi = jnp.max(jnp.where(b, idx, -1)).astype(jnp.float32)
+        return lo, hi
+    y1, y2 = jax.vmap(lambda b: bounds(b, mh))(ys)
+    x1, x2 = jax.vmap(lambda b: bounds(b, mw))(xs_)
+    gboxes = jnp.stack([x1, y1, x2, y2], axis=1)
+    iou = _pairwise_iou(rois, gboxes, True)
+    best = jnp.argmax(iou, axis=1)
+    # crop + resize each roi's matched mask
+    from .vision import _bilinear_sample
+
+    sub = (jnp.arange(res, dtype=jnp.float32) + 0.5) / res
+    px = rois[:, 0:1] + sub[None, :] * jnp.maximum(
+        rois[:, 2:3] - rois[:, 0:1], 1e-6)
+    py = rois[:, 1:2] + sub[None, :] * jnp.maximum(
+        rois[:, 3:4] - rois[:, 1:2], 1e-6)
+    gx = 2.0 * px / jnp.maximum(mw - 1, 1) - 1.0
+    gy = 2.0 * py / jnp.maximum(mh - 1, 1) - 1.0
+    sel = masks[best][:, None]  # [K, 1, H, W]
+    grid_x = jnp.broadcast_to(gx[:, None, :], (k, res, res))
+    grid_y = jnp.broadcast_to(gy[:, :, None], (k, res, res))
+    crop = _bilinear_sample(sel, grid_x, grid_y)[:, 0]  # [K, res, res]
+    binm = (crop > 0.5).astype(jnp.int32)
+    has_mask = (labels > 0).astype(jnp.int32)
+    # per-class one-hot layout [K, num_classes * res * res]
+    out = jnp.zeros((k, num_classes, res, res), jnp.int32)
+    out = jax.vmap(
+        lambda o, c, m, hm: o.at[c].set(m * hm)
+    )(out, jnp.maximum(labels, 0), binm, has_mask)
+    return {"MaskRois": rois, "RoiHasMaskInt32": has_mask[:, None],
+            "MaskInt32": out.reshape(k, -1)}
+
+
+@register_op("retinanet_detection_output",
+             inputs=["BBoxes*", "Scores*", "Anchors*", "ImInfo"],
+             outputs=["Out"], no_grad=True)
+def retinanet_detection_output(ctx, attrs, BBoxes, Scores, Anchors,
+                               ImInfo):
+    """Decode per-level retinanet heads + class-wise NMS
+    (retinanet_detection_output_op.cc), single image, fixed-capacity
+    padded output [keep_top_k, 6]."""
+    score_thr = float(attrs.get("score_threshold", 0.05))
+    nms_top_k = int(attrs.get("nms_top_k", 1000))
+    keep_top_k = int(attrs.get("keep_top_k", 100))
+    nms_thr = float(attrs.get("nms_threshold", 0.3))
+    all_boxes, all_scores = [], []
+    for bb, sc, an in zip(BBoxes, Scores, Anchors):
+        deltas = bb.reshape(-1, 4)
+        anchors = an.reshape(-1, 4)
+        scores = sc.reshape(deltas.shape[0], -1)  # [A, C]
+        aw = anchors[:, 2] - anchors[:, 0]
+        ah = anchors[:, 3] - anchors[:, 1]
+        ax = anchors[:, 0] + aw / 2
+        ay = anchors[:, 1] + ah / 2
+        cx = deltas[:, 0] * aw + ax
+        cy = deltas[:, 1] * ah + ay
+        bw = jnp.exp(jnp.minimum(deltas[:, 2], 10.0)) * aw
+        bh = jnp.exp(jnp.minimum(deltas[:, 3], 10.0)) * ah
+        boxes = jnp.stack([cx - bw / 2, cy - bh / 2,
+                           cx + bw / 2, cy + bh / 2], axis=1)
+        all_boxes.append(boxes)
+        all_scores.append(scores)
+    boxes = jnp.concatenate(all_boxes, 0)
+    scores = jnp.concatenate(all_scores, 0)  # [A, C]
+    n_cls = scores.shape[1]
+    outs = []
+    for c in range(n_cls):
+        sc = jnp.where(scores[:, c] > score_thr, scores[:, c], -jnp.inf)
+        k = min(nms_top_k, sc.shape[0])
+        keep, top_s, top_b, _ = _nms_single_class(
+            boxes, sc, score_thr, nms_thr, 1.0, k, False)
+        lab = jnp.full((k,), c + 1, jnp.float32)
+        outs.append(jnp.concatenate(
+            [lab[:, None], jnp.where(keep, top_s, -jnp.inf)[:, None],
+             top_b], axis=1))
+    cand = jnp.concatenate(outs, 0)  # [C*k, 6]
+    kk = min(keep_top_k, cand.shape[0])
+    top_s, idx = jax.lax.top_k(cand[:, 1], kk)
+    sel = cand[idx]
+    valid = jnp.isfinite(top_s)
+    return jnp.where(valid[:, None], sel, -1.0)

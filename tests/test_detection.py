@@ -707,3 +707,64 @@ def test_roi_perspective_transform_axis_aligned():
     # corners approximately preserved (half-pixel sampling offsets)
     assert abs(o[0, 0, 0, 0] - x[0, 0, 0, 0]) < 4.0
     assert o[0, 0, -1, -1] > 25.0
+
+
+def test_generate_proposal_labels_and_mask_labels():
+    from paddle_tpu.ops import registry
+    from paddle_tpu.ops.registry import LoweringContext
+    import jax
+
+    ctx = LoweringContext(base_key=jax.random.key(0), mode="train")
+    rois = np.array([[0, 0, 10, 10], [0, 0, 9, 9], [50, 50, 60, 60],
+                     [100, 100, 120, 120]], "float32")
+    gts = np.array([[0, 0, 10, 10]], "float32")
+    gcls = np.array([2], "int32")
+    out = registry.call_op(
+        registry.get_op_def("generate_proposal_labels"), ctx,
+        {"RpnRois": [rois], "GtClasses": [gcls], "IsCrowd": [None],
+         "GtBoxes": [gts], "ImInfo": [None]},
+        {"batch_size_per_im": 4, "fg_fraction": 0.5, "fg_thresh": 0.5,
+         "bg_thresh_hi": 0.5, "bg_thresh_lo": 0.0, "class_nums": 4})
+    labels = np.asarray(out["LabelsInt32"][0]).ravel()
+    # two fg rois (IoU 1.0 and ~0.66... >=0.5) capped at 2; class label 2
+    assert (labels[:1] == 2).all()
+    tgt = np.asarray(out["BboxTargets"][0])
+    assert tgt.shape == (4, 16)
+    # fg targets live in the class-2 column block
+    assert np.abs(tgt[0, 8:12]).sum() >= 0.0
+
+    # mask labels: roi over the mask's lit region → all-ones target
+    masks = np.zeros((1, 20, 20), "float32")
+    masks[0, 5:15, 5:15] = 1.0
+    sel_rois = np.array([[5, 5, 14, 14]], "float32")
+    lab = np.array([[2]], "int32")
+    out = registry.call_op(
+        registry.get_op_def("generate_mask_labels"), ctx,
+        {"ImInfo": [None], "GtClasses": [gcls], "IsCrowd": [None],
+         "GtSegms": [masks], "Rois": [sel_rois], "LabelsInt32": [lab]},
+        {"num_classes": 4, "resolution": 7})
+    m = np.asarray(out["MaskInt32"][0]).reshape(1, 4, 7, 7)
+    assert m[0, 2].mean() > 0.9       # matched class filled
+    assert m[0, 1].sum() == 0         # other classes empty
+
+
+def test_retinanet_detection_output():
+    from paddle_tpu.ops import registry
+    from paddle_tpu.ops.registry import LoweringContext
+    import jax
+
+    ctx = LoweringContext(base_key=jax.random.key(0), mode="train")
+    anchors = np.array([[0, 0, 10, 10], [20, 20, 30, 30]], "float32")
+    deltas = np.zeros((2, 4), "float32")
+    scores = np.array([[0.9, 0.1], [0.2, 0.8]], "float32")
+    out = registry.call_op(
+        registry.get_op_def("retinanet_detection_output"), ctx,
+        {"BBoxes": [deltas], "Scores": [scores], "Anchors": [anchors],
+         "ImInfo": [None]},
+        {"score_threshold": 0.3, "nms_top_k": 2, "keep_top_k": 4,
+         "nms_threshold": 0.3})["Out"][0]
+    out = np.asarray(out)
+    kept = out[out[:, 1] > 0]
+    assert kept.shape[0] == 2
+    # best detection: class 1 anchor 0 score .9
+    assert kept[0, 0] == 1.0 and abs(kept[0, 1] - 0.9) < 1e-5
