@@ -575,3 +575,106 @@ def conv2d_fusion(ctx, attrs, Input, Filter, Bias, ResidualData):
         res = get_op_def(act).fn(ctx, {}, out)
         out = list(res.values())[0] if isinstance(res, dict) else res
     return out
+
+
+@register_op("cudnn_lstm",
+             inputs=["Input", "InitH", "InitC", "W", "SeqLen"],
+             outputs=["Out", "last_h", "last_c"],
+             stateful_outputs=("last_h", "last_c"))
+def cudnn_lstm(ctx, attrs, Input, InitH, InitC, W, SeqLen):
+    """Single fused multi-step LSTM (cudnn_lstm_op.cc, single layer,
+    unidirectional): W packs [D+H, 4H] input+recurrent weights followed
+    by the 4H bias, the cuDNN parameter layout flattened."""
+    from .rnn import lstm as lstm_op
+
+    B, T, D = Input.shape
+    hidden = int(attrs.get("hidden_size", D))
+    wx_sz = D * 4 * hidden
+    wh_sz = hidden * 4 * hidden
+    flat = W.reshape(-1)
+    wx = flat[:wx_sz].reshape(D, 4 * hidden)
+    wh = flat[wx_sz:wx_sz + wh_sz].reshape(hidden, 4 * hidden)
+    bias = flat[wx_sz + wh_sz:wx_sz + wh_sz + 4 * hidden].reshape(
+        1, 4 * hidden)
+    gates = jnp.matmul(Input, wx)
+    h0 = InitH.reshape(-1, hidden) if InitH is not None else None
+    c0 = InitC.reshape(-1, hidden) if InitC is not None else None
+    res = lstm_op(ctx, dict(attrs), gates, h0, c0, wh, bias, SeqLen)
+    hs, cs = res["Hidden"], res["Cell"]
+    return {"Out": hs, "last_h": hs[:, -1][None],
+            "last_c": cs[:, -1][None]}
+
+
+@register_op("conv2d_inception_fusion",
+             inputs=["Input", "Filter*", "Bias*"], outputs=["Output"])
+def conv2d_inception_fusion(ctx, attrs, Input, Filter, Bias):
+    """Inception branch fusion (conv2d_inception_fusion_op.cc): parallel
+    conv towers concatenated on channels; XLA fuses the epilogues."""
+    from .nn import _conv_nd
+
+    outs = []
+    for f, b in zip(Filter, Bias):
+        k = f.shape[-1]
+        o = _conv_nd(ctx, {"strides": [1, 1],
+                           "paddings": [(k - 1) // 2] * 2,
+                           "dilations": [1, 1], "groups": 1}, Input, f, 2)
+        if b is not None:
+            o = o + b.reshape(1, -1, 1, 1)
+        outs.append(jnp.maximum(o, 0.0))
+    return jnp.concatenate(outs, axis=1)
+
+
+@register_op("split_ids", inputs=["Ids"], outputs=["Out*"], no_grad=True)
+def split_ids(ctx, attrs, Ids):
+    """Shard sparse ids round-robin (split_ids_op.cc fed the pserver
+    shards; here it documents/serves the row-sharded-table path).
+    TPU-static: each shard keeps full length with non-members masked to
+    -1."""
+    n = int(attrs.get("num_shards", 1))
+    ids = jnp.reshape(Ids, (-1,)).astype(jnp.int64)
+    outs = []
+    for s in range(n):
+        m = (ids % n) == s
+        outs.append(jnp.where(m, ids, -1))
+    return {"Out": outs}
+
+
+@register_op("merge_ids", inputs=["Ids", "Rows*", "X*"], outputs=["Out"],
+             no_grad=True)
+def merge_ids(ctx, attrs, Ids, Rows, X):
+    """Merge per-shard embedding lookups back to the original id order
+    (merge_ids_op.cc): shard s owns ids with id %% n == s; its X rows are
+    the lookups for its (masked) slots."""
+    ids = jnp.reshape(Ids, (-1,)).astype(jnp.int64)
+    n = len(X)
+    d = X[0].shape[-1]
+    out = jnp.zeros((ids.shape[0], d), X[0].dtype)
+    for s in range(n):
+        m = ((ids % n) == s)[:, None]
+        out = jnp.where(m, X[s], out)
+    return out
+
+
+@register_op("split_selected_rows", inputs=["X"], outputs=["Out*"],
+             no_grad=True)
+def split_selected_rows(ctx, attrs, X):
+    """Split rows into height-section shards
+    (split_selected_rows_op.cc); dense equivalent: contiguous row
+    ranges."""
+    sections = [int(s) for s in attrs.get("height_sections", [])]
+    outs = []
+    start = 0
+    for sec in sections:
+        outs.append(X[start:start + sec])
+        start += sec
+    return {"Out": outs}
+
+
+@register_op("fake_init", inputs=[], outputs=["Out"], no_grad=True)
+def fake_init(ctx, attrs, **kw):
+    """Placeholder init for remote-table vars (fake_init_op.cc); dense
+    zeros here."""
+    from .common import resolve_dtype
+
+    shape = [int(s) for s in attrs.get("shape", [1])]
+    return jnp.zeros(shape, resolve_dtype(attrs.get("dtype", 5)))

@@ -357,3 +357,37 @@ def test_box_decoder_and_assign():
               "TargetBox": deltas.reshape(1, -1), "BoxScore": scores}, {})
     np.testing.assert_allclose(r["OutputAssignBox"][0][0], prior[0],
                                atol=1e-4)
+
+
+def test_cudnn_lstm_and_inception_fusion_and_id_shards():
+    rng = np.random.RandomState(30)
+    B, T, D, H = 2, 4, 3, 5
+    x = rng.randn(B, T, D).astype("float32")
+    w = rng.randn(D * 4 * H + H * 4 * H + 4 * H).astype("float32") * 0.1
+    r = call("cudnn_lstm", {"Input": x, "InitH": None, "InitC": None,
+                            "W": w, "SeqLen": None},
+             {"hidden_size": H})
+    assert r["Out"][0].shape == (B, T, H)
+    np.testing.assert_allclose(r["last_h"][0][0], r["Out"][0][:, -1],
+                               rtol=1e-5)
+
+    xi = rng.randn(1, 2, 6, 6).astype("float32")
+    f1 = rng.randn(3, 2, 1, 1).astype("float32")
+    f3 = rng.randn(4, 2, 3, 3).astype("float32")
+    r = call("conv2d_inception_fusion",
+             {"Input": xi, "Filter": [f1, f3],
+              "Bias": [np.zeros(3, "float32"), np.zeros(4, "float32")]},
+             {})
+    assert r["Output"][0].shape == (1, 7, 6, 6)
+
+    ids = np.array([0, 1, 2, 3, 4, 5], "int64")
+    r = call("split_ids", {"Ids": ids}, {"num_shards": 2})
+    np.testing.assert_array_equal(r["Out"][0], [0, -1, 2, -1, 4, -1])
+    emb = [np.full((6, 2), s, "float32") for s in range(2)]
+    merged = call("merge_ids", {"Ids": ids, "Rows": [], "X": emb},
+                  {})["Out"][0]
+    np.testing.assert_allclose(merged[:, 0], [0, 1, 0, 1, 0, 1])
+
+    x = np.arange(12, dtype="float32").reshape(6, 2)
+    r = call("split_selected_rows", {"X": x}, {"height_sections": [2, 4]})
+    assert r["Out"][0].shape == (2, 2) and r["Out"][1].shape == (4, 2)
