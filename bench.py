@@ -1,17 +1,27 @@
 """Flagship benchmarks: BERT-base MLM training (tokens/sec/chip + MFU,
 the headline metric, printed LAST) and ResNet-50 ImageNet-shape training
-(images/sec/chip + MFU, BASELINE.json's first north star).
+(images/sec/chip + MFU, BASELINE.json's first north star), plus a
+seq512 BERT line exercising the Pallas flash-attention kernel.
 
 Reference harness analogue: ``benchmark/fluid/fluid_benchmark.py:296-300``
 (same examples/sec methodology: timed steps after warmup) +
 ``benchmark/fluid/models/resnet.py``.  Target from BASELINE.json: >=45%
 MFU on a v5e chip (bf16 peak 197 TFLOP/s).
 
-Prints one JSON line per workload:
+Robustness contract (round-3): the orchestrator process imports NO jax.
+Backend init and every workload run in child subprocesses with hard
+timeouts, so a dead TPU tunnel can never hang this script (round-2
+failure: ``jax.devices()`` blocked ~25 min on a down tunnel).  On any
+failure the script still prints a CPU smoke line plus a flagship error
+line with value 0 and exits 0 — the driver's ``parsed`` is never null.
+
+Prints one JSON line per workload (flagship BERT seq128 line last):
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
-(the flagship BERT line last, for single-line consumers)."""
+"""
 
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -19,6 +29,11 @@ import numpy as np
 
 
 V5E_BF16_PEAK = 197e12  # TPU v5e per-chip bf16 peak FLOP/s
+
+FLAGSHIP_METRIC = "bert_base_mlm_train_tokens_per_sec_per_chip"
+
+PROBE_TIMEOUT_S = 120
+TOTAL_BUDGET_S = 2100  # hard ceiling on orchestrator wall time
 
 
 def model_train_flops_per_token(cfg, seq_len):
@@ -47,20 +62,57 @@ def peak_flops(device):
 RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 4.09e9  # fwd 4.09 GFLOP @224^2, bwd 2x
 
 
-def bench_resnet50():
-    import json
-    import time
+def _is_tpu_platform(platform):
+    """The real chip arrives via the axon tunnel plugin, whose platform
+    string is 'axon', not 'tpu' (round-2 bench accepted both)."""
+    p = str(platform).lower()
+    return "tpu" in p or "axon" in p
 
+
+def _child_setup():
+    """Per-child backend forcing: the image pins jax_platforms=axon in jax
+    config, so the JAX_PLATFORMS env var is IGNORED — forcing CPU must be
+    done in-process before first backend use."""
+    if os.environ.get("PADDLE_BENCH_FORCE_CPU"):
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
+# ---------------------------------------------------------------------------
+# child workloads (each runs in its own subprocess; may import jax)
+# ---------------------------------------------------------------------------
+
+
+def child_probe():
+    """Initialize the backend and report platform/device kind as JSON."""
+    import jax
+
+    dev = jax.devices()[0]
+    # one tiny computation proves the backend actually executes, not just
+    # enumerates (a half-dead tunnel can list devices then hang on compile)
+    import jax.numpy as jnp
+
+    x = jnp.ones((8, 8))
+    float((x @ x).sum())
+    print(json.dumps({
+        "probe": "ok",
+        "platform": str(dev.platform),
+        "device_kind": getattr(dev, "device_kind", str(dev)),
+        "n_devices": len(jax.devices()),
+    }), flush=True)
+
+
+def child_resnet():
     import jax
     import jax.numpy as jnp
-    import numpy as np
 
     import paddle_tpu as fluid
     from paddle_tpu.models import resnet
     from paddle_tpu.executor import Scope, scope_guard
 
     dev = jax.devices()[0]
-    on_tpu = "tpu" in str(dev.platform).lower()
+    on_tpu = _is_tpu_platform(dev.platform)
     batch = 64 if on_tpu else 4
     warmup, steps = 3, (60 if on_tpu else 3)
     size = 224 if on_tpu else 32
@@ -100,25 +152,21 @@ def bench_resnet50():
     }), flush=True)
 
 
-def main():
+def child_bert(seq_len=128):
     import jax
+    import jax.numpy as jnp
 
     import paddle_tpu as fluid
     from paddle_tpu.models import bert
 
-    try:
-        bench_resnet50()
-    except Exception as e:  # ResNet line is secondary; never block BERT
-        print("# resnet50 bench skipped: %s" % e, flush=True)
-
     dev = jax.devices()[0]
-    on_tpu = "tpu" in str(dev.platform).lower() or "axon" in str(
-        dev.platform
-    ).lower()
+    on_tpu = _is_tpu_platform(dev.platform)
 
     cfg = bert.BERT_BASE  # L12 D768 H12 FF3072 V30522
-    seq_len = 128
-    batch = 64 if on_tpu else 8
+    if not on_tpu:
+        cfg = bert.BERT_TINY  # CPU smoke: prove the path, not the chip
+        seq_len = min(seq_len, 128)
+    batch = (64 if seq_len <= 128 else 16) if on_tpu else 8
     # the timed window ends with one loss fetch; through the axon tunnel a
     # fetch costs ~67ms of pure roundtrip latency, so the window must be
     # long enough to amortize it (real training fetches metrics rarely)
@@ -135,8 +183,6 @@ def main():
     # stage the batch on device once: a real input pipeline prefetches
     # batches ahead of the step (SURVEY §7 input-pipeline overlap), so the
     # timed loop should not pay per-step H2D latency for an identical batch
-    import jax.numpy as jnp
-
     feed = {k: jnp.asarray(v) for k, v in feed.items()}
 
     for _ in range(warmup):
@@ -155,14 +201,149 @@ def main():
     flops_per_token = model_train_flops_per_token(cfg, seq_len)
     mfu = tokens_per_sec * flops_per_token / peak_flops(dev)
 
+    if not on_tpu:
+        metric, bar = "bert_cpu_smoke_tokens_per_sec", 0.45
+    elif seq_len == 128:
+        metric, bar = FLAGSHIP_METRIC, 0.45
+    else:
+        metric = "bert_base_seq%d_mlm_train_tokens_per_sec_per_chip" % seq_len
+        bar = 0.40  # long-seq target (VERDICT r2 #3)
     print(json.dumps({
-        "metric": "bert_base_mlm_train_tokens_per_sec_per_chip",
+        "metric": metric,
         "value": round(tokens_per_sec, 1),
-        "unit": "tokens/sec/chip (seq128 bs%d bf16 AMP, MFU %.3f on %s)"
-                % (batch, mfu, getattr(dev, "device_kind", str(dev))),
-        "vs_baseline": round(mfu / 0.45, 3),
-    }))
+        "unit": "tokens/sec/chip (seq%d bs%d bf16 AMP, MFU %.3f on %s)"
+                % (seq_len, batch, mfu, getattr(dev, "device_kind", str(dev))),
+        "vs_baseline": round(mfu / bar, 3),
+    }), flush=True)
+
+
+# ---------------------------------------------------------------------------
+# orchestrator (imports no jax; everything subprocessed + timed out)
+# ---------------------------------------------------------------------------
+
+
+def _run_child(mode, timeout_s, env_extra=None):
+    """Run ``python bench.py --child <mode>``; return (ok, json_lines, err).
+
+    The child runs in its own session (process group) and the WHOLE group
+    is SIGKILLed on timeout: the TPU plugin spawns helper processes that
+    inherit the stdout pipe, and killing only the direct child would leave
+    communicate() blocked on pipe EOF held by the orphan — the 25-minute
+    round-2 hang, one layer down."""
+    import signal
+
+    env = dict(os.environ)
+    if env_extra:
+        env.update(env_extra)
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--child", mode],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, start_new_session=True,
+        )
+    except Exception as e:  # noqa: BLE001 - harness must never crash
+        return False, [], "launch failed: %s" % e
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+        rc = proc.returncode
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        try:  # group is dead → EOF arrives; bounded residual drain
+            out, _ = proc.communicate(timeout=15)
+        except Exception:  # noqa: BLE001
+            out = ""
+        return False, _json_lines(out or ""), "timeout after %ds" % timeout_s
+    lines = _json_lines(out or "")
+    if rc != 0:
+        return False, lines, "rc=%d %s" % (rc, (err or "")[-400:].strip())
+    return True, lines, ""
+
+
+def _json_lines(text):
+    out = []
+    for ln in text.splitlines():
+        ln = ln.strip()
+        if ln.startswith("{"):
+            try:
+                out.append(json.loads(ln))
+            except ValueError:
+                pass
+    return out
+
+
+def main():
+    t_start = time.time()
+
+    def remaining(cap):
+        return max(10, min(cap, TOTAL_BUDGET_S - (time.time() - t_start)))
+
+    ok, lines, err = _run_child("probe", PROBE_TIMEOUT_S)
+    probe = next((l for l in lines if l.get("probe") == "ok"), None)
+    on_tpu = bool(probe) and _is_tpu_platform(probe.get("platform", ""))
+
+    flagship_line = None
+    extra_lines = []
+
+    if on_tpu:
+        plan = [("resnet", 600), ("bert512", 700), ("bert", 700)]
+        for mode, cap in plan:
+            w_ok, w_lines, w_err = _run_child(mode, remaining(cap))
+            if not w_ok:
+                print("# %s bench failed: %s" % (mode, w_err), flush=True)
+            for l in w_lines:
+                if l.get("metric") == FLAGSHIP_METRIC:
+                    flagship_line = l
+                else:
+                    extra_lines.append(l)
+    else:
+        reason = err or "backend probe returned no TPU (platform=%s)" % (
+            probe and probe.get("platform"))
+        print("# TPU unavailable: %s — emitting CPU smoke + zero flagship"
+              % reason, flush=True)
+        w_ok, w_lines, w_err = _run_child(
+            "bert", remaining(420),
+            env_extra={"PADDLE_BENCH_FORCE_CPU": "1"})
+        if not w_ok:
+            print("# cpu smoke failed too: %s" % w_err, flush=True)
+        extra_lines.extend(w_lines)
+        flagship_line = {
+            "metric": FLAGSHIP_METRIC,
+            "value": 0,
+            "unit": "tokens/sec/chip (TPU backend unavailable)",
+            "vs_baseline": 0,
+            "error": reason,
+        }
+
+    for l in extra_lines:
+        print(json.dumps(l), flush=True)
+    if flagship_line is None:
+        flagship_line = {
+            "metric": FLAGSHIP_METRIC,
+            "value": 0,
+            "unit": "tokens/sec/chip (benchmark child failed)",
+            "vs_baseline": 0,
+            "error": "flagship child produced no line",
+        }
+    print(json.dumps(flagship_line), flush=True)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--child":
+        mode = sys.argv[2]
+        _child_setup()
+        if mode == "probe":
+            child_probe()
+        elif mode == "resnet":
+            child_resnet()
+        elif mode == "bert":
+            child_bert(128)
+        elif mode == "bert512":
+            child_bert(512)
+        else:
+            raise SystemExit("unknown child mode %r" % mode)
+        sys.exit(0)
+    sys.exit(main())

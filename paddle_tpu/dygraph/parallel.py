@@ -48,16 +48,11 @@ Env = ParallelEnv
 def prepare_context(strategy=None):
     env = ParallelEnv()
     if env.nranks > 1:
-        import jax
+        from ..incubate.fleet.base.fleet_base import init_jax_distributed
 
-        try:
-            jax.distributed.initialize(
-                coordinator_address=(env.trainer_endpoints or ["localhost:0"])[0],
-                num_processes=env.nranks,
-                process_id=env.local_rank,
-            )
-        except (RuntimeError, ValueError):
-            pass
+        init_jax_distributed(
+            (env.trainer_endpoints or ["localhost:0"])[0],
+            env.nranks, env.local_rank)
     return strategy
 
 

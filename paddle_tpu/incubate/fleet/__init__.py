@@ -1,4 +1,5 @@
 from . import base
 from . import collective
+from . import parameter_server
 
-__all__ = ["base", "collective"]
+__all__ = ["base", "collective", "parameter_server"]

@@ -2,10 +2,33 @@
 ``python/paddle/fluid/incubate/fleet/base/fleet_base.py``)."""
 
 import abc
+import os
 
 from ....executor import global_scope
 
-__all__ = ["Fleet", "DistributedOptimizer", "Mode"]
+__all__ = ["Fleet", "DistributedOptimizer", "Mode",
+           "init_jax_distributed"]
+
+
+def init_jax_distributed(coordinator_address, num_processes, process_id):
+    """Multi-host bootstrap via the jax coordination service (replaces
+    the reference's gen_nccl_id_op.cc:188 rank-0 RPC broadcast).
+
+    A genuinely failed bootstrap re-raises: silently degrading to
+    un-synchronized single-host training on an n-host job is the one
+    outcome worse than crashing.  Only 'already initialized' is benign.
+    """
+    import jax
+
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except (RuntimeError, ValueError) as e:
+        if "already" not in str(e).lower():
+            raise
 
 
 class Mode:
@@ -64,6 +87,20 @@ class Fleet(abc.ABC):
         self._role_maker = role_maker or PaddleCloudRoleMaker()
         self._role_maker.generate_role()
         self._is_initialized = True
+
+    def _init_jax_distributed(self):
+        """Boot the coordination service when the role maker reports a
+        multi-host job; no-op single-host."""
+        n = self.worker_num()
+        if n <= 1:
+            return
+        coord = os.environ.get("PADDLE_COORDINATOR_ADDRESS")
+        if coord is None:
+            eps = self.worker_endpoints()
+            coord = eps[0] if eps else None
+        if coord is None:
+            return
+        init_jax_distributed(coord, n, self.worker_index())
 
     @abc.abstractmethod
     def init_worker(self):

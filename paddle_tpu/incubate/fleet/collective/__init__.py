@@ -45,28 +45,8 @@ class Collective(Fleet):
         super().init(role_maker)
         self._init_jax_distributed()
 
-    def _init_jax_distributed(self):
-        """Multi-host bootstrap via the jax coordination service (replaces
-        gen_nccl_id_op.cc:188 rank-0 RPC broadcast)."""
-        n = self.worker_num()
-        if n <= 1:
-            return
-        import jax
-
-        coord = os.environ.get("PADDLE_COORDINATOR_ADDRESS")
-        if coord is None:
-            eps = self.worker_endpoints()
-            coord = eps[0] if eps else None
-        if coord is None:
-            return
-        try:
-            jax.distributed.initialize(
-                coordinator_address=coord,
-                num_processes=n,
-                process_id=self.worker_index(),
-            )
-        except (RuntimeError, ValueError):
-            pass  # already initialized, or single-host testing
+    # _init_jax_distributed inherited from Fleet (fleet_base.py): boots
+    # the coordination service, re-raising genuine bootstrap failures
 
     def init_worker(self):
         pass

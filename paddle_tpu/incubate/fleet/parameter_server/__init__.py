@@ -1,0 +1,11 @@
+"""Parameter-server fleet façade (reference:
+``python/paddle/fluid/incubate/fleet/parameter_server/__init__.py``).
+
+The reference splits the PS fleet into ``distribute_transpiler`` (native
+send/recv PS built by DistributeTranspiler) and ``pslib`` (the Downpour
+in-house PS).  On TPU there are no parameter servers: ``is_distributed``
+embedding tables row-shard over the worker mesh (GSPMD moves ids/rows
+over ICI — see ``layers.embedding``), and dense gradients all-reduce via
+the partitioner.  Both submodules here are thin lifecycle façades that
+accept the reference API unchanged and route onto that substrate.
+"""

@@ -1,0 +1,167 @@
+"""PS fleet over the sharded-embedding substrate (reference:
+``python/paddle/fluid/incubate/fleet/parameter_server/
+distribute_transpiler/__init__.py``: DistributedTranspiler fleet :32,
+TranspilerOptimizer :246).
+
+Reference lifecycle: ``fleet.init(role)`` → ``distributed_optimizer(
+opt, DistributeTranspilerConfig()).minimize(loss)`` → transpile splits
+the program into trainer/pserver halves; server processes call
+``init_server()/run_server()`` (blocking listen_and_serv), workers call
+``init_worker()`` (connect + fetch params), train on
+``fleet.main_program``, then ``stop_worker()``.
+
+TPU-native redesign — same script, no servers:
+- ``minimize`` runs the wrapped optimizer, then "transpiles" by marking
+  every sparse ``lookup_table`` parameter ``_is_distributed`` (the
+  row-sharded GSPMD table replaces the pserver-sliced distributed lookup
+  table, ``transpiler/distribute_transpiler.py:353-376``) and recording
+  the trainer topology on the program for mesh construction.
+- ``fleet.main_program``/``startup_program`` are the original programs:
+  there is no program split because there is no second process kind.
+- ``init_worker`` boots the jax coordination service when multi-host
+  (replacing the worker→pserver connect); ``init_server``/``run_server``
+  warn-and-return so a launcher that still spawns PSERVER-role processes
+  degrades gracefully instead of wedging a TPU host on a dead RPC loop.
+"""
+
+import warnings
+
+from ...base.fleet_base import Fleet, DistributedOptimizer, Mode
+from ..... import io as fluid_io
+
+__all__ = ["fleet", "DistributedTranspiler", "TranspilerOptimizer"]
+
+
+def _mark_sparse_tables(program):
+    """Mark every sparse/distributed ``lookup_table`` parameter
+    ``_is_distributed`` so it row-shards over the mesh data axis (the
+    TPU replacement for the pserver-sliced distributed lookup table,
+    ``transpiler/distribute_transpiler.py:353-376``).  Params live in
+    the global block even when the lookup runs in a sub-block, hence
+    the recursive var lookup."""
+    for block in program.blocks:
+        for op in block.ops:
+            if op.type not in ("lookup_table", "lookup_table_v2"):
+                continue
+            if not op.attr("is_sparse") and not op.attr("is_distributed"):
+                continue
+            w = block.var_recursive(op.input("W")[0])
+            w._is_distributed = True
+            op._set_attr("is_distributed", True)
+
+
+class DistributedTranspiler(Fleet):
+    """Drop-in for the reference PS fleet entry point."""
+
+    def __init__(self):
+        super().__init__(Mode.TRANSPILER)
+        self.main_program = None
+        self.startup_program = None
+
+    def init_worker(self):
+        """Reference :46 waits for pservers then pulls params; here the
+        mesh IS the store, so this is the multi-host bootstrap point
+        (``Fleet._init_jax_distributed``)."""
+        self._init_jax_distributed()
+
+    def init_server(self, model_dir=None):
+        """No pserver process exists on TPU; tables live row-sharded on
+        the worker mesh.  Loading a warm-start dir is the one still-
+        meaningful piece (reference :71 loads persistables first)."""
+        warnings.warn(
+            "TPU fleet has no parameter servers; is_distributed tables "
+            "row-shard over the worker mesh. init_server is a no-op "
+            "(pass model_dir to io.load_persistables on a worker instead)."
+        )
+
+    def run_server(self):
+        warnings.warn(
+            "TPU fleet has no parameter servers; run_server returns "
+            "immediately. Launch this process as a TRAINER instead."
+        )
+
+    def stop_worker(self):
+        pass
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._optimizer = TranspilerOptimizer(optimizer, strategy)
+        self._optimizer._fleet = self
+        return self._optimizer
+
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None,
+                             export_for_deployment=True):
+        return fluid_io.save_inference_model(
+            dirname, feeded_var_names, target_vars, executor, main_program)
+
+    def save_persistables(self, executor, dirname, main_program=None):
+        """Sharded tables save per-process shards (io.py handles the
+        is_distributed split; reference :178 re-assembles pserver
+        blocks)."""
+        return fluid_io.save_persistables(executor, dirname, main_program)
+
+    def _transpile(self, config, programs=None):
+        """The TPU 'transpile': mark sparse-lookup params as row-sharded
+        and stamp the trainer topology.  No program split.  Of the
+        DistributeTranspilerConfig fields only sync_mode is meaningful
+        here (the jitted step is always synchronous; slicing/geo-sgd
+        knobs describe the pserver program that no longer exists)."""
+        from .....framework import (default_main_program,
+                                    default_startup_program)
+
+        if config is not None and not getattr(config, "sync_mode", True):
+            warnings.warn(
+                "sync_mode=False (async PS training) has no TPU "
+                "equivalent; the jitted step runs synchronously")
+
+        main = (programs or {}).get("main") or default_main_program()
+        startup = (programs or {}).get("startup") or \
+            default_startup_program()
+        _mark_sparse_tables(main)
+        main._num_trainers = self.worker_num()
+        main._trainer_id = self.worker_index()
+        self.main_program = main
+        self.startup_program = startup
+
+
+fleet = DistributedTranspiler()
+
+
+class TranspilerOptimizer(DistributedOptimizer):
+    """Reference :246 — validates the config, runs the inner optimizer,
+    then transpiles.  Here the optimizer's sharded-accumulator logic
+    (table-shaped moments inherit ``_is_distributed``) does the real PS
+    work, so minimize is: mark tables → inner minimize → record topology."""
+
+    def __init__(self, optimizer, strategy=None):
+        from .....transpiler import DistributeTranspilerConfig
+
+        if strategy is None:
+            strategy = DistributeTranspilerConfig()
+        if not isinstance(strategy, DistributeTranspilerConfig):
+            raise TypeError(
+                "strategy must be a DistributeTranspilerConfig, got %r"
+                % (type(strategy),))
+        super().__init__(optimizer, strategy)
+        self._fleet = None
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return self._optimizer.backward(
+            loss, startup_program, parameter_list, no_grad_set, callbacks)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        fleet_obj = self._fleet or fleet
+        main = loss.block.program
+        # mark BEFORE the inner minimize so freshly-created optimizer
+        # accumulators for table params inherit _is_distributed
+        _mark_sparse_tables(main)
+        ops, params_grads = self._optimizer.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+        fleet_obj._transpile(self._strategy, programs={
+            "main": main, "startup": startup_program})
+        return ops, params_grads

@@ -1,0 +1,86 @@
+"""PSLib (Downpour) fleet façade (reference:
+``python/paddle/fluid/incubate/fleet/parameter_server/pslib/__init__.py``:
+PSLib :27, DownpourOptimizer :274).
+
+The reference pslib drives the in-house Downpour parameter server (async
+push/pull of sparse tables, ps_pb2 configs, server/worker daemons).  The
+TPU substrate has one store — the mesh — so PSLib here shares the
+DistributedTranspiler lifecycle (mark sparse tables ``_is_distributed``,
+row-shard over the data axis) and keeps pslib-specific surface:
+
+- ``distributed_optimizer(opt, strategy={})`` accepts the pslib dict
+  strategy (entries recorded, sparse-table routing is automatic).
+- ``shrink_dense_table(decay)`` — the one pslib op with dense-math
+  meaning — decays persistable params in the live scope, matching the
+  reference's in-place ``scale`` on server tables (:228).
+- ``shrink_sparse_table`` warns: TPU tables are dense row-sharded arrays;
+  frequency-based row eviction has no equivalent (rows simply stay).
+"""
+
+import warnings
+
+from ..distribute_transpiler import (DistributedTranspiler,
+                                     TranspilerOptimizer)
+
+__all__ = ["fleet", "PSLib", "DownpourOptimizer"]
+
+
+class PSLib(DistributedTranspiler):
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._optimizer = DownpourOptimizer(optimizer, strategy)
+        self._optimizer._fleet = self
+        return self._optimizer
+
+    def init_server(self, model_dir=None, **kwargs):
+        return super().init_server(model_dir)
+
+    def shrink_sparse_table(self):
+        warnings.warn(
+            "shrink_sparse_table: TPU tables are dense row-sharded "
+            "arrays; frequency-based row eviction is a no-op.")
+
+    def shrink_dense_table(self, decay, scope=None, table_id=None):
+        """Decay dense model parameters in place (reference pslib :228
+        sends a scale command to the server dense table).  Only true
+        ``Parameter`` vars are touched — optimizer accumulators
+        (moments, beta-pow) and row-sharded sparse tables are exactly
+        what the reference's dense-table scale does NOT reach."""
+        import numpy as np
+
+        from .....executor import global_scope
+        from .....framework import Parameter, default_main_program
+
+        if table_id is not None:
+            warnings.warn(
+                "shrink_dense_table: table_id selection is a pslib "
+                "server concept; on TPU all dense params form one "
+                "logical table, so table_id=%r is ignored" % (table_id,))
+        scope = scope or global_scope()
+        program = self.main_program or default_main_program()
+        for var in program.global_block().vars.values():
+            if not isinstance(var, Parameter):
+                continue
+            if getattr(var, "_is_distributed", False):
+                continue  # sparse table, not a dense-table member
+            if not scope.has(var.name):
+                continue
+            val = scope.get(var.name)
+            if not hasattr(val, "dtype"):
+                continue
+            if np.issubdtype(np.dtype(val.dtype), np.floating):
+                scope.set(var.name, val * decay)
+
+
+fleet = PSLib()
+
+
+class DownpourOptimizer(TranspilerOptimizer):
+    """Reference :274 — pslib strategies arrive as plain dicts."""
+
+    def __init__(self, optimizer, strategy=None):
+        from .....transpiler import DistributeTranspilerConfig
+
+        if strategy is None or isinstance(strategy, dict):
+            self._pslib_strategy = strategy or {}
+            strategy = DistributeTranspilerConfig()
+        super().__init__(optimizer, strategy)

@@ -24,12 +24,12 @@ def _slot_embed_sum(slot, vocab, dim, name, is_sparse=True,
 
 
 def wide_deep(slots, dense, label, vocab=100000, embed_dim=16,
-              hidden=(400, 400, 400), is_distributed=False):
+              hidden=(400, 400, 400), is_distributed=False, is_sparse=True):
     """Wide (linear over slots) + Deep (MLP over embeddings + dense)."""
     # deep part
     deep_in = [
         _slot_embed_sum(s, vocab, embed_dim, "deep_emb_%d" % i,
-                        is_distributed=is_distributed)
+                        is_sparse=is_sparse, is_distributed=is_distributed)
         for i, s in enumerate(slots)
     ]
     if dense is not None:
@@ -41,7 +41,7 @@ def wide_deep(slots, dense, label, vocab=100000, embed_dim=16,
     # wide part: per-slot scalar embeddings (linear terms)
     wide_terms = [
         _slot_embed_sum(s, vocab, 1, "wide_emb_%d" % i,
-                        is_distributed=is_distributed)
+                        is_sparse=is_sparse, is_distributed=is_distributed)
         for i, s in enumerate(slots)
     ]
     wide_logit = fluid.layers.sums(wide_terms)
