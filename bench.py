@@ -288,7 +288,9 @@ def main():
     extra_lines = []
 
     if on_tpu:
-        plan = [("resnet", 600), ("bert512", 700), ("bert", 700)]
+        # flagship seq128 runs BEFORE the secondary seq512 line so budget
+        # exhaustion can never zero the headline metric (printed last anyway)
+        plan = [("resnet", 600), ("bert", 700), ("bert512", 700)]
         for mode, cap in plan:
             w_ok, w_lines, w_err = _run_child(mode, remaining(cap))
             if not w_ok:

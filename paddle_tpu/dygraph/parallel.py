@@ -47,12 +47,14 @@ Env = ParallelEnv
 
 def prepare_context(strategy=None):
     env = ParallelEnv()
-    if env.nranks > 1:
+    if env.nranks > 1 and env.trainer_endpoints:
+        # without endpoints there is no coordinator to dial — skip the
+        # bootstrap (single-host local testing), matching
+        # Fleet._init_jax_distributed's no-coordinator no-op
         from ..incubate.fleet.base.fleet_base import init_jax_distributed
 
         init_jax_distributed(
-            (env.trainer_endpoints or ["localhost:0"])[0],
-            env.nranks, env.local_rank)
+            env.trainer_endpoints[0], env.nranks, env.local_rank)
     return strategy
 
 

@@ -5,9 +5,18 @@ load_persistables :714, save_inference_model :921, load_inference_model
 
 Storage format: one ``.npy`` per var (filename = var name) or a combined
 ``.npz`` — numpy containers instead of the reference's LoDTensor binary
-framing.  The orbax-style sharded checkpoint path for multi-host lands with
-the distributed batch."""
+framing.
 
+Sharded vars (row-sharded ``is_distributed`` tables and their table-shaped
+optimizer accumulators — the reference's pserver-sliced persistables,
+``python/paddle/fluid/io.py:294`` ``_save_distributed_persistables``) are
+saved WITHOUT gathering: each process writes only its addressable shards
+(replica 0) into ``<var>.shards/`` keyed by global index range, and load
+reassembles directly onto the live sharding via ``make_array_from_callback``
+— each device reads only its own rows, so a multi-host table never
+materializes on any single host in either direction."""
+
+import json
 import os
 
 import numpy as np
@@ -35,6 +44,132 @@ def _is_persistable(var):
     return var.persistable and not var.is_data
 
 
+def _is_sharded_value(val):
+    """True for a jax Array actually laid out across devices (vs
+    replicated) — the values that must not be gathered to one host."""
+    sharding = getattr(val, "sharding", None)
+    if sharding is None:
+        return False
+    try:
+        return not val.is_fully_replicated
+    except (AttributeError, TypeError):
+        return False
+
+
+def _index_key(index, shape):
+    """Canonical start/stop bounds of a shard's global slice."""
+    bounds = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        bounds.append((start, stop))
+    return tuple(bounds)
+
+
+def _shard_fname(bounds):
+    return "shard-" + "-".join("%d_%d" % b for b in bounds) + ".npy"
+
+
+def _save_sharded(dirname, name, val):
+    """Per-process shard save: each process writes only the shards it can
+    address, one file per distinct global slice (replica 0 only, so a
+    table replicated over a second mesh axis is written once).  meta.json
+    records the COMPLETE global file list (derivable on every process
+    from the sharding), so load ignores stale files from an earlier save
+    with a different layout and can detect missing shards."""
+    safe = name.replace("/", "_")
+    shard_dir = os.path.join(dirname, safe + ".shards")
+    os.makedirs(shard_dir, exist_ok=True)
+    all_files = sorted({
+        _shard_fname(_index_key(idx, val.shape))
+        for idx in val.sharding.devices_indices_map(val.shape).values()
+    })
+    for shard in val.addressable_shards:
+        if shard.replica_id != 0:
+            continue
+        bounds = _index_key(shard.index, val.shape)
+        np.save(os.path.join(shard_dir, _shard_fname(bounds)),
+                np.asarray(shard.data))
+    # meta is tiny and identical on every process; last writer wins
+    with open(os.path.join(shard_dir, "meta.json"), "w") as f:
+        json.dump({"shape": list(val.shape), "dtype": str(val.dtype),
+                   "files": all_files}, f)
+
+
+def _shard_entries(shard_dir, meta):
+    """(bounds, path) for each shard file of THIS save (meta-listed)."""
+    names = meta.get("files")
+    if names is None:  # pre-meta-list checkpoint dirs
+        names = [f for f in os.listdir(shard_dir)
+                 if f.startswith("shard-") and f.endswith(".npy")]
+    entries = []
+    for fname in names:
+        fb = tuple(tuple(int(x) for x in part.split("_"))
+                   for part in fname[len("shard-"):-len(".npy")].split("-"))
+        entries.append((fb, os.path.join(shard_dir, fname)))
+    return entries
+
+
+def _read_sharded_region(entries, meta, bounds, name):
+    """Assemble the [start, stop) region from the shard files overlapping
+    it — reads only the overlapping files, not the whole table.  A region
+    not fully covered raises: silently zero-filling rows (e.g. loading a
+    2-host checkpoint where only one host's shards are visible) would
+    resume training from a corrupted model."""
+    region = np.zeros([b[1] - b[0] for b in bounds],
+                      dtype=np.dtype(meta["dtype"]))
+    covered = np.zeros(region.shape, dtype=bool)
+    for fb, path in entries:
+        overlap = [(max(a0, b0), min(a1, b1))
+                   for (a0, a1), (b0, b1) in zip(fb, bounds)]
+        if any(o0 >= o1 for o0, o1 in overlap):
+            continue
+        if not os.path.exists(path):
+            raise RuntimeError(
+                "sharded checkpoint for %r is missing %s — all shard "
+                "files listed in meta.json must be reachable from this "
+                "process (on multi-host, merge the per-host checkpoint "
+                "dirs or load on the saving topology)" % (name, path))
+        data = np.load(path)
+        src = tuple(slice(o0 - f0, o1 - f0)
+                    for (o0, o1), (f0, _) in zip(overlap, fb))
+        dst = tuple(slice(o0 - b0, o1 - b0)
+                    for (o0, o1), (b0, _) in zip(overlap, bounds))
+        region[dst] = data[src]
+        covered[dst] = True
+    if not covered.all():
+        raise RuntimeError(
+            "sharded checkpoint for %r does not cover region %s — the "
+            "meta.json shard list leaves gaps (partial or corrupted "
+            "checkpoint dir)" % (name, bounds))
+    return region
+
+
+def _load_sharded(shard_dir, current, name):
+    """Rebuild a sharded var.  When the live scope value still carries a
+    device layout, place each device's rows directly (no host-level full
+    table); otherwise fall back to a host assembly (single-device use)."""
+    import jax
+    import jax.numpy as jnp
+
+    with open(os.path.join(shard_dir, "meta.json")) as f:
+        meta = json.load(f)
+    shape = tuple(meta["shape"])
+    entries = _shard_entries(shard_dir, meta)
+    if current is not None and _is_sharded_value(current) \
+            and tuple(current.shape) == shape:
+        sharding = current.sharding
+
+        def cb(index):
+            return _read_sharded_region(
+                entries, meta, _index_key(index, shape), name)
+
+        return jax.make_array_from_callback(shape, sharding, cb)
+    full_bounds = tuple((0, d) for d in shape)
+    return jnp.asarray(
+        _read_sharded_region(entries, meta, full_bounds, name))
+
+
 def _is_parameter(var):
     return isinstance(var, Parameter)
 
@@ -55,13 +190,22 @@ def save_vars(executor, dirname, main_program=None, vars=None,
             val = scope.get(v.name)
             if val is None:
                 continue
-            np.save(os.path.join(dirname, v.name.replace("/", "_")),
-                    np.asarray(val))
+            if _is_sharded_value(val):
+                _save_sharded(dirname, v.name, val)
+            else:
+                np.save(os.path.join(dirname, v.name.replace("/", "_")),
+                        np.asarray(val))
     else:
         arrays = {}
         for v in vars:
             val = scope.get(v.name)
-            if val is not None:
+            if val is None:
+                continue
+            if _is_sharded_value(val):
+                # sharded vars never enter the combined container: a
+                # gather would defeat the per-process shard contract
+                _save_sharded(dirname, v.name, val)
+            else:
                 arrays[v.name] = np.asarray(val)
         np.savez(os.path.join(dirname, filename), **arrays)
 
@@ -90,7 +234,13 @@ def load_vars(executor, dirname, main_program=None, vars=None,
     scope = global_scope()
     if filename is None:
         for v in vars:
-            path = os.path.join(dirname, v.name.replace("/", "_") + ".npy")
+            safe = v.name.replace("/", "_")
+            shard_dir = os.path.join(dirname, safe + ".shards")
+            if os.path.isdir(shard_dir):
+                cur = scope.get(v.name) if scope.has(v.name) else None
+                scope.set(v.name, _load_sharded(shard_dir, cur, v.name))
+                continue
+            path = os.path.join(dirname, safe + ".npy")
             if not os.path.exists(path):
                 continue
             scope.set(v.name, jnp.asarray(np.load(path)))
@@ -100,7 +250,12 @@ def load_vars(executor, dirname, main_program=None, vars=None,
             path = path + ".npz"
         data = np.load(path)
         for v in vars:
-            if v.name in data:
+            shard_dir = os.path.join(
+                dirname, v.name.replace("/", "_") + ".shards")
+            if os.path.isdir(shard_dir):
+                cur = scope.get(v.name) if scope.has(v.name) else None
+                scope.set(v.name, _load_sharded(shard_dir, cur, v.name))
+            elif v.name in data:
                 scope.set(v.name, jnp.asarray(data[v.name]))
 
 
