@@ -95,6 +95,10 @@ from .initializer import set_global_initializer  # noqa: F401
 from .clip import GradientClipByGlobalNorm, GradientClipByNorm, GradientClipByValue
 from .parallel import ParallelExecutor
 from .dygraph.base import enable_dygraph, disable_dygraph
+from . import transpiler
+from .transpiler import DistributeTranspiler, DistributeTranspilerConfig
+from .data_feed_desc import DataFeedDesc
+from .dataset import DatasetFactory
 
 # `import paddle_tpu as fluid` is the intended spelling for users of the
 # reference's `import paddle.fluid as fluid`.
@@ -129,6 +133,10 @@ __all__ = [
     "CUDAPinnedPlace",
     "append_backward",
     "gradients",
+    "DistributeTranspiler",
+    "DistributeTranspilerConfig",
+    "DataFeedDesc",
+    "DatasetFactory",
     "layers",
     "initializer",
     "optimizer",

@@ -181,13 +181,19 @@ def as_numpy(value):
     return np.asarray(value)
 
 
+# ops executed host-side by Executor.run, invisible to the jit path
+# (feed/fetch are call arguments/results; save/load run via io_ops)
+_HOST_SIDE_OPS = ("feed", "fetch", "save", "load", "save_combine",
+                  "load_combine")
+
+
 def _analyze_block(block, feed_names, fetch_names):
     """SSA analysis: (external scope reads, written names, written persistables)."""
     defined = set(feed_names)
     ext_reads = []
     written = []
     for op in block.ops:
-        if op.type in ("feed", "fetch"):
+        if op.type in _HOST_SIDE_OPS:
             continue
         for n in op.input_arg_names:
             if n and n != EMPTY_VAR_NAME and n not in defined:
@@ -328,7 +334,7 @@ def _accum_partition(block):
     gradient accumulation (reference ``ir/multi_batch_merge_pass.cc``:
     the forward+backward subgraph is repeated per microbatch, optimizer
     ops run once on the merged gradients)."""
-    ops = [op for op in block.ops if op.type not in ("feed", "fetch")]
+    ops = [op for op in block.ops if op.type not in _HOST_SIDE_OPS]
     split = next(
         (i for i, op in enumerate(ops)
          if op.attrs.get("op_role") == "optimize"),
@@ -452,7 +458,7 @@ def _run_ops_into_env(block, env, ctx, ops=None):
     from .ops import control_flow as cf_ops
 
     for op in (block.ops if ops is None else ops):
-        if op.type in ("feed", "fetch"):
+        if op.type in _HOST_SIDE_OPS:
             continue
         if op.type in cf_ops.SUB_BLOCK_OPS:
             # control-flow ops need names + the sub-block, not just values
@@ -522,6 +528,23 @@ class Executor:
             v.name if isinstance(v, Variable) else str(v) for v in fetch_list
         ]
 
+        # save/load ops are host IO, never jitted (reference save_op.cc).
+        # Loads run now (their outputs feed the compute), saves after the
+        # jitted step's scope writeback; a pure-IO program skips jit.
+        from .ops.io_ops import HOST_IO_OP_TYPES, run_host_io_block
+
+        has_host_io = any(op.type in HOST_IO_OP_TYPES
+                          for op in program.global_block().ops)
+        if has_host_io:
+            run_host_io_block(program.global_block(), scope, phase="load")
+            if all(op.type in HOST_IO_OP_TYPES + ("feed", "fetch")
+                   for op in program.global_block().ops):
+                run_host_io_block(program.global_block(), scope,
+                                  phase="save")
+                vals = [scope.get(n) for n in fetch_names]
+                return [np.asarray(v) for v in vals] if return_numpy \
+                    else vals
+
         # device transfer of feeds (reference: _feed_data → set_feed_variable)
         feed_vals = {}
         for name, value in feed.items():
@@ -574,6 +597,9 @@ class Executor:
             scope.set(n, v)
         for n, v in fresh.items():
             scope.set(n, v)
+
+        if has_host_io:
+            run_host_io_block(program.global_block(), scope, phase="save")
 
         if return_numpy:
             return [np.asarray(v) for v in fetches]

@@ -5,6 +5,7 @@ from .. import core
 
 __all__ = [
     "data",
+    "load",
     "py_reader",
     "create_py_reader_by_data",
     "read_file",
@@ -160,3 +161,14 @@ def open_files(filenames=None, shapes=None, lod_levels=None, dtypes=None,
         "open_files: graph-side RecordIO readers are replaced by the "
         "host pipeline — read with native.recordio scanner + "
         "reader_decorators, then feed via PyReader")
+
+
+def load(out, file_path, load_as_fp16=None):
+    """Append an in-graph ``load`` op targeting `out` (reference
+    ``layers/io.py:1269``; executed host-side by the Executor's save/load
+    program path, ``ops/io_ops.py``)."""
+    attrs = {"file_path": file_path}
+    if load_as_fp16 is not None:
+        attrs["load_as_fp16"] = load_as_fp16
+    out.block.append_op(
+        type="load", inputs={}, outputs={"Out": [out]}, attrs=attrs)

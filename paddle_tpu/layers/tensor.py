@@ -218,9 +218,27 @@ def range(start, end, step, dtype):
 
 
 def linspace(start, stop, num, dtype):
-    step = (stop - start) / float(max(int(num) - 1, 1))
-    vals = np.linspace(start, stop, int(num)).astype(dtype)
-    return assign(vals)
+    """Emit the linspace op (reference tensor.py:880: Start/Stop as
+    1-element tensors, Num pinned static via the num attr for XLA)."""
+    from ..layer_helper import LayerHelper
+
+    helper = LayerHelper("linspace", **locals())
+    start_t = start if isinstance(start, Variable) else fill_constant(
+        [1], dtype, float(start))
+    stop_t = stop if isinstance(stop, Variable) else fill_constant(
+        [1], dtype, float(stop))
+    inputs = {"Start": [start_t], "Stop": [stop_t]}
+    attrs = {}
+    if isinstance(num, Variable):
+        # reference API admits a Variable num; XLA needs it concrete at
+        # lowering (the op resolves it or raises a targeted error)
+        inputs["Num"] = [num]
+    else:
+        attrs["num"] = int(num)
+    out = helper.create_variable_for_type_inference(dtype)
+    helper.append_op(type="linspace", inputs=inputs,
+                     outputs={"Out": [out]}, attrs=attrs)
+    return out
 
 
 def diag(diagonal):

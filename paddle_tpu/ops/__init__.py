@@ -38,3 +38,4 @@ from . import losses  # noqa: F401
 from . import crf_ctc  # noqa: F401
 from . import misc  # noqa: F401
 from . import extra  # noqa: F401
+from . import io_ops  # noqa: F401

@@ -8,6 +8,7 @@ jnp lowered into the block's jaxpr.
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .registry import register_op
 from .common import resolve_dtype
@@ -236,3 +237,35 @@ def feed(ctx, attrs, X):
 @register_op("fetch", inputs=["X"], outputs=["Out"], no_grad=True)
 def fetch(ctx, attrs, X):
     return X
+
+
+def _linspace_infer_shape(op, block):
+    num = op.attr("num")
+    if num is not None:
+        v = block._find_var_recursive(op.output("Out")[0])
+        if v is not None:
+            v.shape = (int(num),)
+    # Variable Num: length unknown until lowering — leave declared shape
+
+
+@register_op("linspace", inputs=["Start", "Stop", "Num"], outputs=["Out"],
+             no_grad=True, infer_shape=_linspace_infer_shape)
+def linspace(ctx, attrs, Start, Stop, Num=None):
+    """Evenly spaced values (reference ``linspace_op.cc``: Start/Stop/Num
+    arrive as 1-element tensors).  XLA needs a static output length, so
+    Num must be a compile-time constant: either the ``num`` attr (set by
+    ``layers.linspace``) or a concrete (untraced) Num input."""
+    num = attrs.get("num")
+    if num is None:
+        if Num is None:
+            raise ValueError("linspace needs the num attr or a Num input")
+        try:
+            num = int(np.asarray(Num).reshape(()))
+        except Exception:
+            raise ValueError(
+                "linspace Num must be compile-time constant on TPU "
+                "(dynamic output shapes are not XLA-compatible); pass "
+                "num as a python int so it lands in the num attr")
+    start = jnp.reshape(Start, ())
+    stop = jnp.reshape(Stop, ())
+    return jnp.linspace(start, stop, int(num), dtype=Start.dtype)

@@ -1,0 +1,154 @@
+"""In-graph checkpoint ops: ``save`` / ``load`` / ``save_combine`` /
+``load_combine`` (reference: ``operators/save_op.cc``, ``load_op.cc``,
+``save_combine_op.cc``, ``load_combine_op.cc``).
+
+The reference runs these as device kernels that serialize LoDTensors to
+its binary framing.  TPU-native, file IO cannot live inside the jitted
+step (XLA programs are pure); instead the Executor detects blocks
+containing these op types and interprets them host-side against the
+scope (``executor.py run_host_io_block``) — matching the reference's
+actual usage, where save/load programs are dedicated op lists built by
+``io.py`` and run once, never fused into a training step.
+
+Storage format is ``.npy`` (the repo-wide container; ``io.py`` module
+docstring), not the reference binary framing — a program serialized by
+THIS framework round-trips; foreign reference checkpoints need a
+one-time conversion.
+"""
+
+import os
+
+import numpy as np
+
+from .registry import register_op
+
+HOST_IO_OP_TYPES = ("save", "load", "save_combine", "load_combine")
+
+
+def _jit_path_error(ctx, attrs, *a, **k):
+    raise RuntimeError(
+        "save/load ops are host-IO and cannot be traced into a jitted "
+        "block; the Executor runs them via run_host_io_block (a program "
+        "mixing save/load ops with compute ops is not supported — the "
+        "reference's io.py emits dedicated save/load programs)")
+
+
+def _io_infer_shape(op, block):
+    """Output shapes come from the file at runtime, not the graph — the
+    declared var shapes stand (reference load_op.cc InferShape is
+    likewise a no-op)."""
+
+
+for _t, _ins, _outs in (
+    ("save", ["X"], []),
+    ("load", [], ["Out"]),
+    ("save_combine", ["X*"], []),
+    ("load_combine", [], ["Out*"]),
+):
+    register_op(_t, inputs=_ins, outputs=_outs, no_grad=True,
+                infer_shape=_io_infer_shape)(_jit_path_error)
+
+
+def _npy_path(file_path):
+    return file_path if file_path.endswith(".npy") else file_path + ".npy"
+
+
+def _exec_save(op, scope):
+    name = op.input("X")[0]
+    if not scope.has(name):
+        raise RuntimeError("save op: %r not in scope" % name)
+    val = np.asarray(scope.get(name))
+    if op.attr("save_as_fp16"):
+        val = val.astype(np.float16)
+    path = _npy_path(op.attr("file_path"))
+    overwrite = op.attr("overwrite")
+    if overwrite is not None and not overwrite and os.path.exists(path):
+        raise RuntimeError(
+            "save op: %r exists and overwrite=False (save_op.cc enforce)"
+            % path)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    np.save(path, val)
+
+
+def _exec_load(op, scope):
+    import jax.numpy as jnp
+
+    path = _npy_path(op.attr("file_path"))
+    if not os.path.exists(path):
+        raise RuntimeError("load op: file %r does not exist" % path)
+    val = np.load(path)
+    if op.attr("load_as_fp16"):
+        val = val.astype(np.float16)
+    scope.set(op.output("Out")[0], jnp.asarray(val))
+
+
+def _exec_save_combine(op, scope):
+    names = op.input("X")
+    arrays = {}
+    for n in names:
+        if not scope.has(n):
+            raise RuntimeError("save_combine op: %r not in scope" % n)
+        v = np.asarray(scope.get(n))
+        if op.attr("save_as_fp16"):
+            v = v.astype(np.float16)
+        arrays[n] = v
+    path = op.attr("file_path")
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    # order-preserving container: load_combine restores by POSITION, as
+    # the reference format does (load_combine_op.cc reads sequentially)
+    np.savez(path if path.endswith(".npz") else path + ".npz",
+             **{"arr_%d" % i: arrays[n] for i, n in enumerate(names)},
+             **{"__names__": np.array(list(names))})
+
+
+def _exec_load_combine(op, scope):
+    import jax.numpy as jnp
+
+    path = op.attr("file_path")
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    if not os.path.exists(path):
+        raise RuntimeError("load_combine op: file %r does not exist" % path)
+    data = np.load(path)
+    outs = op.output("Out")
+    for i, n in enumerate(outs):
+        key = "arr_%d" % i
+        if key not in data:
+            raise RuntimeError(
+                "load_combine op: file %r holds %d arrays, needs %d"
+                % (path, i, len(outs)))
+        v = data[key]
+        if op.attr("load_as_fp16"):
+            v = v.astype(np.float16)
+        scope.set(n, jnp.asarray(v))
+
+
+_HOST_EXEC = {
+    "save": _exec_save,
+    "load": _exec_load,
+    "save_combine": _exec_save_combine,
+    "load_combine": _exec_load_combine,
+}
+
+
+def run_host_io_block(block, scope, phase="all"):
+    """Execute a block's host-IO ops against the scope (Executor entry
+    point).  Compute ops are left for the jit path; ``phase`` selects
+    loads (run BEFORE the jitted compute, so loaded vars are visible to
+    it) or saves (run AFTER, so they see the step's writebacks) —
+    preserving the reference's in-block op order semantics for the
+    standard load→compute→save layout."""
+    load_types = ("load", "load_combine")
+    for op in block.ops:
+        fn = _HOST_EXEC.get(op.type)
+        if fn is None:
+            continue
+        if phase == "load" and op.type not in load_types:
+            continue
+        if phase == "save" and op.type in load_types:
+            continue
+        fn(op, scope)

@@ -152,6 +152,19 @@ def embedding(ctx, attrs, W, Ids):
     return _lookup(W, Ids, attrs.get("padding_idx", -1))
 
 
+@register_op("lookup_sparse_table", inputs=["W", "Ids"], outputs=["Out"],
+             no_grad=True)
+def lookup_sparse_table(ctx, attrs, W, Ids):
+    """PS-era auto-grown sparse table lookup
+    (``lookup_sparse_table_op.cc``: rows materialize in the pserver hash
+    table on first touch, init'd U(min,max)).  TPU-native the table is a
+    dense row-sharded array, so every row already exists — the lookup
+    degenerates to the plain gather; auto_grown_table/is_test only
+    control the reference's hash-table bookkeeping and have no dense
+    equivalent."""
+    return _lookup(W, Ids, attrs.get("padding_idx", -1))
+
+
 @register_op("one_hot", inputs=["X"], outputs=["Out"], no_grad=True)
 def one_hot(ctx, attrs, X):
     depth = int(attrs.get("depth"))

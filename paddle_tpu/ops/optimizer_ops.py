@@ -306,3 +306,38 @@ def average_accumulates(ctx, attrs, param, in_sum_1, in_sum_2, in_sum_3,
         "out_num_accumulates": na, "out_old_num_accumulates": ona,
         "out_num_updates": nu,
     }
+
+
+@register_op(
+    "proximal_gd", inputs=["Param", "Grad", "LearningRate"],
+    outputs=["ParamOut"], no_grad=True)
+def proximal_gd(ctx, attrs, Param, Grad, LearningRate):
+    """Proximal gradient descent (reference
+    ``optimizers/proximal_gd_op.cc``): prox_param = p - lr*g, then the
+    soft-threshold / shrinkage step with l1 and l2."""
+    l1 = jnp.asarray(attrs.get("l1", 0.0), Param.dtype)
+    l2 = jnp.asarray(attrs.get("l2", 0.0), Param.dtype)
+    lr = _lr(LearningRate, Param.dtype)
+    prox = Param - lr * Grad
+    shrink = jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+    return jnp.sign(prox) * shrink / (1.0 + lr * l2)
+
+
+@register_op(
+    "proximal_adagrad",
+    inputs=["Param", "Moment", "Grad", "LearningRate"],
+    outputs=["ParamOut", "MomentOut"], no_grad=True)
+def proximal_adagrad(ctx, attrs, Param, Moment, Grad, LearningRate):
+    """Proximal Adagrad (reference ``optimizers/proximal_adagrad_op.cc``):
+    accumulate squared grads, take the proximal step with the
+    per-element adaptive lr."""
+    l1 = jnp.asarray(attrs.get("l1", 0.0), Param.dtype)
+    l2 = jnp.asarray(attrs.get("l2", 0.0), Param.dtype)
+    lr = _lr(LearningRate, Param.dtype)
+    m = Moment + Grad * Grad
+    # adaptive lr drives the gradient step; the shrinkage uses the PLAIN
+    # scalar lr (proximal_adagrad_op.h: prox_param - lr*l1 thresholds,
+    # 1/(1+lr*l2) decay)
+    prox = Param - (lr / jnp.sqrt(m)) * Grad
+    shrink = jnp.maximum(jnp.abs(prox) - lr * l1, 0.0)
+    return jnp.sign(prox) * shrink / (1.0 + lr * l2), m

@@ -242,3 +242,28 @@ def sequence_scatter(ctx, attrs, X, Ids, Updates, SeqLen):
     def one(row, idx, u):
         return row.at[idx].add(u)
     return jax.vmap(one)(X, ids, upd)
+
+
+@register_op("sequence_erase", inputs=["X", "SeqLen"],
+             outputs=["Out", "OutLen"], no_grad=True,
+             stateful_outputs=("OutLen",))
+def sequence_erase(ctx, attrs, X, SeqLen):
+    """Remove every occurrence of the attr tokens from each sequence and
+    compact left (reference ``sequence_ops/sequence_erase_op.cc``: LoD
+    recomputed after deletion).  Padded design: kept elements scatter to
+    their post-compaction slot, erased slots scatter out of bounds and
+    drop; the new lengths come back as the companion OutLen tensor, in
+    place of the reference's shrunken LoD."""
+    tokens = [int(t) for t in attrs.get("tokens", [])]
+    B, T = jnp.shape(X)[0], jnp.shape(X)[1]
+    valid = _mask(SeqLen, B, T, jnp.int32) > 0
+    keep = valid
+    for t in tokens:
+        keep = keep & (X != t)
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    # erased/padding slots target column T → dropped by scatter mode
+    col = jnp.where(keep, pos, T)
+    row = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T))
+    out = jnp.zeros_like(X).at[row, col].set(X, mode="drop")
+    new_len = keep.astype(jnp.int32).sum(axis=1)
+    return {"Out": out, "OutLen": new_len}

@@ -784,6 +784,13 @@ class DGCMomentumOptimizer(MomentumOptimizer):
                  rampup_step=1, sparsity=(0.999,), use_nesterov=False,
                  local_grad_clip_norm=None, num_trainers=None,
                  regularization=None, name=None):
+        import warnings
+
+        warnings.warn(
+            "DGCMomentumOptimizer runs as plain momentum on TPU: "
+            "sparsity/rampup_begin_step/rampup_step/local_grad_clip_norm "
+            "are ignored (gradient compression loses more in gather "
+            "overhead than it saves in bytes over ICI)")
         super().__init__(learning_rate, momentum, use_nesterov,
                          regularization, name)
 

@@ -16,6 +16,7 @@ import threading
 __all__ = [
     "cache", "map_readers", "shuffle", "chain", "compose", "buffered",
     "firstn", "xmap_readers", "batch", "ComposeNotAligned",
+    "multiprocess_reader", "Fake", "PipeReader",
 ]
 
 
@@ -236,3 +237,144 @@ def batch(reader, batch_size, drop_last=False):
             yield b
 
     return batch_reader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Merge samples from several readers, each driven by its own OS
+    process (reference decorator.py:441).  Queue mode uses a shared
+    multiprocessing.Queue; pipe mode one Pipe per reader with samples
+    JSON-framed, exactly the reference's two transports.  Samples must
+    be picklable (queue) / JSON-able (pipe)."""
+    import multiprocessing
+
+    if not isinstance(readers, list) or not readers:
+        raise AssertionError("readers must be a non-empty list")
+
+    def _read_into_queue(reader, q):
+        for sample in reader():
+            if sample is None:
+                raise ValueError("sample has None")
+            q.put(sample)
+        q.put(None)
+
+    def queue_reader():
+        q = multiprocessing.Queue(queue_size)
+        procs = [
+            multiprocessing.Process(
+                target=_read_into_queue, args=(r, q), daemon=True)
+            for r in readers
+        ]
+        for p in procs:
+            p.start()
+        finished = 0
+        while finished < len(readers):
+            sample = q.get()
+            if sample is None:
+                finished += 1
+            else:
+                yield sample
+        for p in procs:
+            p.join()
+
+    def _read_into_pipe(reader, conn):
+        import json
+
+        for sample in reader():
+            if sample is None:
+                raise ValueError("sample has None")
+            conn.send(json.dumps(sample))
+        conn.send(json.dumps(None))
+        conn.close()
+
+    def pipe_reader():
+        import json
+
+        conns = []
+        procs = []
+        for r in readers:
+            parent, child = multiprocessing.Pipe()
+            conns.append(parent)
+            p = multiprocessing.Process(
+                target=_read_into_pipe, args=(r, child), daemon=True)
+            procs.append(p)
+            p.start()
+        live = list(conns)
+        finished = 0
+        while finished < len(readers):
+            for conn in list(live):
+                sample = json.loads(conn.recv())
+                if sample is None:
+                    finished += 1
+                    conn.close()
+                    live.remove(conn)
+                else:
+                    yield sample
+        for p in procs:
+            p.join()
+
+    return pipe_reader if use_pipe else queue_reader
+
+
+class Fake:
+    """Cache the first sample and replay it data_num times (reference
+    decorator.py:531) — isolates input-pipeline cost for speed tests."""
+
+    def __init__(self):
+        self.data = None
+        self.yield_num = 0
+
+    def __call__(self, reader, data_num):
+        def fake_reader():
+            if self.data is None:
+                self.data = next(reader())
+            while self.yield_num < data_num:
+                yield self.data
+                self.yield_num += 1
+            self.yield_num = 0
+
+        return fake_reader
+
+
+class PipeReader:
+    """Stream a shell command's stdout and yield decoded lines
+    (reference decorator.py:388) — the HDFS/S3/curl ingestion path.
+    gzip file_type inflates on the fly."""
+
+    def __init__(self, command, bufsize=8192, file_type="plain"):
+        import shlex
+        import subprocess
+        import zlib
+
+        if not isinstance(command, str):
+            raise TypeError("command must be a string")
+        if file_type not in ("plain", "gzip"):
+            raise TypeError("file_type %s is not allowed" % file_type)
+        if file_type == "gzip":
+            # wbits offset 32: auto-detect gzip header
+            self.dec = zlib.decompressobj(32 + zlib.MAX_WBITS)
+        self.file_type = file_type
+        self.bufsize = bufsize
+        self.process = subprocess.Popen(
+            shlex.split(command), bufsize=bufsize, stdout=subprocess.PIPE)
+
+    def get_line(self, cut_lines=True, line_break="\n"):
+        remained = ""
+        while True:
+            buff = self.process.stdout.read(self.bufsize)
+            if buff:
+                if self.file_type == "gzip":
+                    decomp = self.dec.decompress(buff).decode(
+                        "utf-8", "replace")
+                else:
+                    decomp = buff.decode("utf-8", "replace")
+                if cut_lines:
+                    parts = (remained + decomp).split(line_break)
+                    remained = parts[-1]
+                    for line in parts[:-1]:
+                        yield line
+                else:
+                    yield decomp
+            else:
+                break
+        if remained:
+            yield remained

@@ -1,0 +1,36 @@
+"""API freeze (reference: ``tools/diff_api.py`` fails CI when the public
+surface drifts from ``paddle/fluid/API.spec``).  Regenerate with:
+
+    PYTHONPATH=. python tools/print_signatures.py > API.spec
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestApiSpec:
+    def test_spec_is_current(self):
+        res = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools",
+                                          "print_signatures.py")],
+            capture_output=True, text=True, timeout=300,
+            env={**os.environ, "PYTHONPATH": REPO}, cwd=REPO)
+        assert res.returncode == 0, res.stderr[-800:]
+        fresh = res.stdout.splitlines()
+        with open(os.path.join(REPO, "API.spec")) as f:
+            frozen = f.read().splitlines()
+        added = sorted(set(fresh) - set(frozen))
+        removed = sorted(set(frozen) - set(fresh))
+        assert not added and not removed, (
+            "public API drifted from API.spec — regenerate it "
+            "(added: %s..., removed: %s...)"
+            % (added[:5], removed[:5]))
+
+    def test_spec_size_bar(self):
+        """Round-3 bar: >= 950 frozen entries (reference: 1031)."""
+        with open(os.path.join(REPO, "API.spec")) as f:
+            n = sum(1 for line in f if line.strip())
+        assert n >= 950, n
