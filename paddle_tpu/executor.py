@@ -267,7 +267,12 @@ class _CompiledBlock:
                 env.update(rw)
                 env.update(feeds)
                 ctx = op_registry.LoweringContext(base_key=key, mode=mode)
-                _run_ops_into_env(block, env, ctx)
+                # host-IO ops of the TOP block run host-side around this
+                # jitted call; in sub-blocks they must fail loudly, so
+                # the filter lives here, not in _run_ops_into_env
+                top_ops = [op for op in block.ops
+                           if op.type not in _HOST_SIDE_OPS]
+                _run_ops_into_env(block, env, ctx, ops=top_ops)
                 fetches = [env[n] for n in self.fetch_names]
                 new_rw = {n: env[n] for n in self.rw_names}
                 fresh = {n: env[n] for n in self.fresh_persist if n in env}
@@ -458,7 +463,7 @@ def _run_ops_into_env(block, env, ctx, ops=None):
     from .ops import control_flow as cf_ops
 
     for op in (block.ops if ops is None else ops):
-        if op.type in _HOST_SIDE_OPS:
+        if op.type in ("feed", "fetch"):
             continue
         if op.type in cf_ops.SUB_BLOCK_OPS:
             # control-flow ops need names + the sub-block, not just values

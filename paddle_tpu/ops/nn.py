@@ -152,8 +152,7 @@ def embedding(ctx, attrs, W, Ids):
     return _lookup(W, Ids, attrs.get("padding_idx", -1))
 
 
-@register_op("lookup_sparse_table", inputs=["W", "Ids"], outputs=["Out"],
-             no_grad=True)
+@register_op("lookup_sparse_table", inputs=["W", "Ids"], outputs=["Out"])
 def lookup_sparse_table(ctx, attrs, W, Ids):
     """PS-era auto-grown sparse table lookup
     (``lookup_sparse_table_op.cc``: rows materialize in the pserver hash

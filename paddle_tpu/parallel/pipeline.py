@@ -45,14 +45,22 @@ def gpipe_stage_params(params_per_stage):
 
 
 def gpipe(stage_fn, stage_params, x, mesh, axis_name, num_microbatches,
-          remat=True):
+          remat=True, param_specs=None, x_spec=None):
     """Run ``num_microbatches`` microbatches through an n-stage pipeline.
 
     stage_fn(params, x_mb) -> y_mb with y_mb.shape == x_mb.shape;
     stage_params: pytree with leading dim n (one slice per stage, see
     :func:`gpipe_stage_params`); x: [M, mb, ...] microbatched input
     (M = num_microbatches); returns [M, mb, ...] outputs of the last stage.
-    """
+
+    3D composition: on a dp×tp×pp mesh, pass ``x_spec`` to shard the
+    microbatch dim over the data axis and ``param_specs`` (a pytree of
+    PartitionSpecs whose FIRST axis must be ``axis_name``) to
+    tensor-shard each stage's weights — stage_fn then sees local shards
+    and is responsible for its own tp collectives (e.g. psum over the
+    model axis after a row-parallel matmul), exactly the Megatron
+    contract.  Defaults preserve the 1-axis behavior: params split over
+    the pipe axis, activations replicated."""
     n = mesh.shape[axis_name]
     m = int(num_microbatches)
     if x.shape[0] != m:
@@ -109,10 +117,25 @@ def gpipe(stage_fn, stage_params, x, mesh, axis_name, num_microbatches,
             axis_name,
         )
 
-    spec_params = jax.tree_util.tree_map(lambda _: P(axis_name),
-                                         stage_params)
+    if param_specs is None:
+        spec_params = jax.tree_util.tree_map(lambda _: P(axis_name),
+                                             stage_params)
+    else:
+        spec_params = param_specs
+        for s in jax.tree_util.tree_leaves(
+                spec_params, is_leaf=lambda v: isinstance(v, P)):
+            if not s or s[0] != axis_name:
+                raise ValueError(
+                    "param_specs must shard dim 0 over %r, got %s"
+                    % (axis_name, s))
+    in_x = x_spec if x_spec is not None else P()
+    if in_x and len(in_x) > 0 and in_x[0] is not None:
+        raise ValueError(
+            "x_spec must leave dim 0 (the microbatch-count dim) "
+            "unsharded — shard the per-microbatch batch dim instead, "
+            "e.g. P(None, 'data'); got %s" % (in_x,))
     return shard_map(
         local, mesh=mesh,
-        in_specs=(spec_params, P()), out_specs=P(),
+        in_specs=(spec_params, in_x), out_specs=in_x,
         check_vma=False,
     )(stage_params, x)

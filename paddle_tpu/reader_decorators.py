@@ -250,12 +250,20 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
     if not isinstance(readers, list) or not readers:
         raise AssertionError("readers must be a non-empty list")
 
+    # error sentinel: a child that dies without its end-sentinel would
+    # deadlock the parent's blocking get (same propagate-don't-hang
+    # contract as `buffered` above, crossing a process boundary)
+    _ERR = "__multiprocess_reader_error__"
+
     def _read_into_queue(reader, q):
-        for sample in reader():
-            if sample is None:
-                raise ValueError("sample has None")
-            q.put(sample)
-        q.put(None)
+        try:
+            for sample in reader():
+                if sample is None:
+                    raise ValueError("sample has None")
+                q.put(sample)
+            q.put(None)
+        except BaseException as exc:  # noqa: BLE001 - must reach parent
+            q.put((_ERR, repr(exc)))
 
     def queue_reader():
         q = multiprocessing.Queue(queue_size)
@@ -271,6 +279,12 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
             sample = q.get()
             if sample is None:
                 finished += 1
+            elif isinstance(sample, tuple) and len(sample) == 2 \
+                    and sample[0] == _ERR:
+                for p in procs:
+                    p.terminate()
+                raise RuntimeError(
+                    "multiprocess_reader child failed: %s" % sample[1])
             else:
                 yield sample
         for p in procs:
@@ -279,12 +293,19 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
     def _read_into_pipe(reader, conn):
         import json
 
-        for sample in reader():
-            if sample is None:
-                raise ValueError("sample has None")
-            conn.send(json.dumps(sample))
-        conn.send(json.dumps(None))
-        conn.close()
+        try:
+            for sample in reader():
+                if sample is None:
+                    raise ValueError("sample has None")
+                conn.send(json.dumps(sample))
+            conn.send(json.dumps(None))
+        except BaseException as exc:  # noqa: BLE001 - must reach parent
+            try:
+                conn.send(json.dumps({_ERR: repr(exc)}))
+            except (OSError, TypeError, ValueError):
+                pass
+        finally:
+            conn.close()
 
     def pipe_reader():
         import json
@@ -302,11 +323,24 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
         finished = 0
         while finished < len(readers):
             for conn in list(live):
-                sample = json.loads(conn.recv())
+                try:
+                    sample = json.loads(conn.recv())
+                except EOFError:
+                    for p in procs:
+                        p.terminate()
+                    raise RuntimeError(
+                        "multiprocess_reader child died without its end "
+                        "sentinel (crashed before sending error)")
                 if sample is None:
                     finished += 1
                     conn.close()
                     live.remove(conn)
+                elif isinstance(sample, dict) and _ERR in sample:
+                    for p in procs:
+                        p.terminate()
+                    raise RuntimeError(
+                        "multiprocess_reader child failed: %s"
+                        % sample[_ERR])
                 else:
                     yield sample
         for p in procs:
