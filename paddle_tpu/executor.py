@@ -187,6 +187,94 @@ _HOST_SIDE_OPS = ("feed", "fetch", "save", "load", "save_combine",
                   "load_combine")
 
 
+class _FusedOp:
+    """Lowering-time stand-in for a group of coalesced ops (duck-types
+    the Operator surface _run_ops_into_env touches)."""
+
+    def __init__(self, type, inputs, outputs, attrs):
+        self.type = type
+        self.inputs = inputs
+        self.outputs = outputs
+        self.attrs = attrs
+
+    @property
+    def input_arg_names(self):
+        return [n for ns in self.inputs.values() for n in ns]
+
+    @property
+    def output_arg_names(self):
+        return [n for ns in self.outputs.values() for n in ns]
+
+
+def _fuse_adam_ops(ops, block):
+    """Coalesce per-param ``adam`` ops into ``fused_adam`` groups — the
+    TPU analogue of the reference's fuse_adam_op_pass
+    (``framework/ir/fuse_optimizer_ops_pass/``).  Grouping key: identical
+    hyperparameter attrs + the same LearningRate input, so every member's
+    bias correction and scale match.  Row-sharded (``_is_distributed``)
+    tables stay unfused: concatenating a sharded table with replicated
+    params would force XLA to re-gather it.  Disable with
+    PADDLE_TPU_FUSE_ADAM=0."""
+    import os
+
+    if os.environ.get("PADDLE_TPU_FUSE_ADAM", "1") == "0":
+        return list(ops)
+
+    def fusible_key(op):
+        if op.type != "adam":
+            return None
+        var = block._find_var_recursive(op.inputs["Param"][0])
+        # non-replicated params stay unfused: concatenating a row-sharded
+        # table or a tensor-parallel weight with replicated params would
+        # force a re-gather and break the param's sharding round-trip
+        if var is not None and (getattr(var, "_is_distributed", False)
+                                or getattr(var, "shard_spec", None)):
+            return None
+        return (
+            op.attrs.get("beta1", 0.9), op.attrs.get("beta2", 0.999),
+            op.attrs.get("epsilon", 1e-8),
+            tuple(op.inputs.get("LearningRate", [])),
+        )
+
+    def emit(run, out):
+        if len(run) == 1:
+            out.append(run[0])
+            return
+        ins = {"LearningRate": list(run[0].inputs["LearningRate"])}
+        outs = {}
+        for slot in ("Param", "Grad", "Moment1", "Moment2",
+                     "Beta1Pow", "Beta2Pow"):
+            ins[slot] = [m.inputs[slot][0] for m in run]
+        for slot in ("ParamOut", "Moment1Out", "Moment2Out",
+                     "Beta1PowOut", "Beta2PowOut"):
+            outs[slot] = [m.outputs[slot][0] for m in run]
+        out.append(_FusedOp("fused_adam", ins, outs, dict(run[0].attrs)))
+
+    # only CONSECUTIVE same-key adam ops fuse: an op interleaved between
+    # members (per-param grad clip, a scale) may write a member's Grad
+    # or read a ParamOut, and hoisting across it would reorder those
+    # dependencies.  Our own optimizer emits the run contiguously, so
+    # the common case fuses fully; odd deserialized layouts degrade to
+    # smaller groups, never to wrong code.
+    out = []
+    run, run_key = [], None
+    for op in ops:
+        key = fusible_key(op)
+        if key is not None and key == run_key:
+            run.append(op)
+            continue
+        if run:
+            emit(run, out)
+        if key is None:
+            out.append(op)
+            run, run_key = [], None
+        else:
+            run, run_key = [op], key
+    if run:
+        emit(run, out)
+    return out
+
+
 def _analyze_block(block, feed_names, fetch_names):
     """SSA analysis: (external scope reads, written names, written persistables)."""
     defined = set(feed_names)
@@ -272,6 +360,7 @@ class _CompiledBlock:
                 # the filter lives here, not in _run_ops_into_env
                 top_ops = [op for op in block.ops
                            if op.type not in _HOST_SIDE_OPS]
+                top_ops = _fuse_adam_ops(top_ops, block)
                 _run_ops_into_env(block, env, ctx, ops=top_ops)
                 fetches = [env[n] for n in self.fetch_names]
                 new_rw = {n: env[n] for n in self.rw_names}
@@ -376,6 +465,7 @@ class _AccumRunner:
         self.mode = mode
         (self.head, self.tail, self.head_written, self.grad_reads,
          self.other_reads) = _accum_partition(block)
+        self.tail = _fuse_adam_ops(self.tail, block)
         # head-written values the caller needs: fetches + persistables
         carry_out = list(self.other_reads)
         for n in cb.fetch_names + cb.rw_names + cb.fresh_persist:

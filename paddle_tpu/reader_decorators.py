@@ -276,7 +276,20 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
             p.start()
         finished = 0
         while finished < len(readers):
-            sample = q.get()
+            try:
+                sample = q.get(timeout=5)
+            except _queue.Empty:
+                # a child killed outright (OOM/SIGKILL) never sends any
+                # sentinel — detect the dead-and-drained state instead
+                # of blocking forever
+                if any(not p.is_alive() and p.exitcode not in (0, None)
+                       for p in procs) and q.empty():
+                    for p in procs:
+                        p.terminate()
+                    raise RuntimeError(
+                        "multiprocess_reader child killed (exitcodes %s)"
+                        % [p.exitcode for p in procs])
+                continue
             if sample is None:
                 finished += 1
             elif isinstance(sample, tuple) and len(sample) == 2 \

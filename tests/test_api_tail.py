@@ -114,6 +114,47 @@ class TestTailOps:
         np.testing.assert_allclose(
             np.asarray(res["Out"][0]).reshape(2, 2), [[2, 3], [8, 9]])
 
+    def test_lookup_sparse_table_trains(self):
+        """The reference's auto-grown table IS trainable (rows update on
+        the pserver); here the dense row-sharded table must receive
+        scatter-add gradients like lookup_table does."""
+        fluid.unique_name.switch()
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 5
+        with fluid.program_guard(main, startup):
+            ids = fluid.layers.data("ids", shape=[3], dtype="int64")
+            label = fluid.layers.data("label", shape=[1], dtype="int64")
+            blk = main.global_block()
+            w = blk.create_parameter(
+                name="sp_table", shape=[32, 4], dtype="float32")
+            out = blk.create_var(name="sp_out", shape=[-1, 3, 4],
+                                 dtype="float32")
+            blk.append_op(type="lookup_sparse_table",
+                          inputs={"W": [w], "Ids": [ids]},
+                          outputs={"Out": [out]},
+                          attrs={"padding_idx": -1})
+            pooled = fluid.layers.reduce_sum(out, dim=1)
+            logits = fluid.layers.fc(pooled, size=2)
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.softmax_with_cross_entropy(logits, label))
+            fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = Scope()
+        with scope_guard(scope):
+            exe.run(startup)
+            # raw create_parameter has no startup init op; seed directly
+            import jax.numpy as jnp
+            scope.set("sp_table", jnp.asarray(
+                np.random.RandomState(0).randn(32, 4).astype("float32")))
+            before = np.asarray(scope.get("sp_table")).copy()
+            feed = {"ids": np.asarray([[1, 2, 3]], "int64"),
+                    "label": np.asarray([[1]], "int64")}
+            exe.run(main, feed=feed, fetch_list=[])
+            after = np.asarray(scope.get("sp_table"))
+        # touched rows changed, untouched rows did not (scatter-add grad)
+        assert not np.allclose(after[1:4], before[1:4])
+        np.testing.assert_allclose(after[5:], before[5:])
+
 
 class TestInGraphSaveLoad:
     def test_save_load_program_roundtrip(self, tmp_path):
