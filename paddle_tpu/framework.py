@@ -521,7 +521,9 @@ class Program:
                 )
                 if for_test and "is_test" in no.attrs:
                     no.attrs["is_test"] = True
-                if for_test and op.type in ("dropout", "batch_norm", "layer_norm"):
+                if for_test and op.type in (
+                        "dropout", "batch_norm", "layer_norm",
+                        "fused_multihead_attention"):
                     no.attrs["is_test"] = True
                 nb.ops.append(no)
         p.current_block_idx = 0

@@ -994,17 +994,21 @@ def cos_sim(X, Y):
 
 
 def fused_multihead_attention(q, k, v, bias=None, causal=False, scale=None,
-                              name=None):
+                              dropout_rate=0.0, name=None):
     """Fused multi-head attention over [B, H, T, Dh] tensors; on TPU this
     is a single Pallas flash-attention kernel (O(T) memory), elsewhere XLA
     attention.  `bias` is an additive key bias ([B, Tk] or [B,1,1,Tk],
-    e.g. a padding mask); no gradient flows to it."""
+    e.g. a padding mask); no gradient flows to it.  dropout_rate applies
+    attention-probability dropout INSIDE the kernel (train mode only) —
+    the [B,H,T,T] mask never materializes in HBM."""
     helper = LayerHelper("fused_multihead_attention", **locals())
     out = helper.create_variable_for_type_inference(q.dtype)
     inputs = {"Q": [q], "K": [k], "V": [v]}
     if bias is not None:
         inputs["BiasQK"] = [bias]
     attrs = {"causal": bool(causal)}
+    if dropout_rate:
+        attrs["dropout_rate"] = float(dropout_rate)
     if scale is not None:
         attrs["scale"] = float(scale)
     helper.append_op(

@@ -55,9 +55,10 @@ def _attention(x, mask_bias, cfg, prefix):
     q = split_heads(proj(x, d, "q"))
     k = split_heads(proj(x, d, "k"))
     v = split_heads(proj(x, d, "v"))
-    if cfg.fuse_attn and not cfg.attn_dropout:
+    if cfg.fuse_attn:
         ctx = fluid.layers.fused_multihead_attention(
-            q, k, v, bias=mask_bias, scale=1.0 / math.sqrt(dh)
+            q, k, v, bias=mask_bias, scale=1.0 / math.sqrt(dh),
+            dropout_rate=cfg.attn_dropout or 0.0,
         )
     else:
         scores = fluid.layers.matmul(q, k, transpose_y=True,
@@ -147,12 +148,13 @@ def build_pretrain(cfg=BERT_BASE, seq_len=128, lr=1e-4, amp=False,
     (main, startup, feed_names, loss).  With train=False only the forward
     loss graph is built (no grad/optimizer ops)."""
     if not train:
-        # attention-prob dropout is inert at inference, so the fused
-        # flash-attention path applies regardless of the configured rate
+        # inference graph: ALL dropout off (hidden + attention-prob) —
+        # the eval program must be deterministic run-to-run
         import copy
 
         cfg = copy.copy(cfg)
         cfg.attn_dropout = 0.0
+        cfg.dropout = 0.0
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         input_ids = fluid.layers.data("input_ids", shape=[seq_len],

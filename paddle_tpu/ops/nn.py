@@ -502,8 +502,20 @@ def fused_multihead_attention(ctx, attrs, Q, K, V, BiasQK=None):
     scale = attrs.get("scale", None)
     if scale is not None:
         scale = float(scale)
+    rate = float(attrs.get("dropout_rate", 0.0) or 0.0)
+    if attrs.get("is_test"):
+        rate = 0.0  # clone(for_test=True) flips this attr (framework.py)
+    seed = None
+    if rate > 0.0 and ctx.mode == "train":
+        # per-step, per-op seed from the deterministic ctx key chain (the
+        # grad op's recompute draws the SAME seed → identical mask)
+        seed = jax.random.randint(ctx.rng(), (1,), 0, 2 ** 31 - 1,
+                                  dtype=jnp.int32)
+    else:
+        rate = 0.0
     return flash_attention(Q, K, V, bias=BiasQK, causal=causal,
-                           sm_scale=scale)
+                           sm_scale=scale, dropout_rate=rate,
+                           dropout_seed=seed)
 
 
 @register_op("selu", inputs=["X"], outputs=["Out"])

@@ -17,9 +17,18 @@ Supported bias: an additive key-padding bias of shape [B, Tk] (the common
 [B,1,1,Tk] mask squeezed), broadcast over heads and query positions; it is
 treated as constant (no gradient — padding masks are data, not parameters).
 Causal masking is a flag; above-diagonal blocks are skipped entirely.
-Attention-probability dropout is intentionally not supported in-kernel (as
-in production TPU flash attention); callers needing prob-dropout use the
-unfused path.
+
+Attention-probability dropout IS supported in-kernel (``dropout_rate``):
+the FA2 formulation — the softmax denominator l comes from the UNdropped
+probabilities, dropout scales the numerator entries feeding the PV matmul
+— so the [B,H,T,T] mask never materializes in HBM.  Mask bits come from
+the TPU hardware PRNG (``pltpu.prng_seed``/``prng_random_bits``), seeded
+per (batch·head, q-block, k-block) so the backward recomputation draws
+the IDENTICAL mask.  ``pltpu`` PRNG has no CPU lowering, so interpret-
+mode tests set ``PADDLE_TPU_FLASH_DROPOUT_DEBUG=iota``: mask bits then
+come from a position hash (same formula exposed as
+:func:`debug_keep_mask`) letting CPU tests verify the dropout MATH
+against the XLA reference; the hardware PRNG path is validated on-chip.
 
 Per-row stats (m, l) live in (block_q, 128) VMEM scratch with the value
 replicated across lanes; rows are recovered with a lanes-reduce and moved
@@ -65,12 +74,59 @@ def _row(x2d):
     return x2d.reshape(1, -1)
 
 
+def _dropout_debug():
+    return os.environ.get("PADDLE_TPU_FLASH_DROPOUT_DEBUG") == "iota"
+
+
+def _rate_threshold(rate):
+    """uint32 threshold: keep a cell iff its random bits >= threshold."""
+    return jnp.uint32(min(int(rate * 4294967296.0), 4294967295))
+
+
+def _hash_bits(b, r, c, seed):
+    """Position-hash mask bits (debug/CPU path) — uint32 wraparound
+    arithmetic, identical inside the kernel and in debug_keep_mask."""
+    h = (r * jnp.uint32(2654435761)
+         ^ (c * jnp.uint32(97559) + b * jnp.uint32(31)))
+    h = h ^ seed.astype(jnp.uint32)
+    return h * jnp.uint32(2246822519)
+
+
+def _keep_mask(shape, rate, seed_ref, bh, qi, kj, block_q, block_k, debug):
+    """In-kernel Bernoulli keep mask for the (qi, kj) block of
+    batch·head bh.  Hardware path: per-block counter seeding of the TPU
+    PRNG (fwd and bwd seed identically, so the draw reproduces)."""
+    if debug:
+        r = (jax.lax.broadcasted_iota(jnp.uint32, shape, 0)
+             + (qi * block_q).astype(jnp.uint32))
+        c = (jax.lax.broadcasted_iota(jnp.uint32, shape, 1)
+             + (kj * block_k).astype(jnp.uint32))
+        bits = _hash_bits(bh.astype(jnp.uint32), r, c, seed_ref[0])
+    else:
+        pltpu.prng_seed(seed_ref[0], bh, qi, kj)
+        bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+    return bits >= _rate_threshold(rate)
+
+
+def debug_keep_mask(bh, tq, tk, rate, seed):
+    """Full-matrix keep mask for the debug hash — the OUT-of-kernel twin
+    of the kernel's debug path, used by CPU tests and the XLA fallback
+    under PADDLE_TPU_FLASH_DROPOUT_DEBUG=iota."""
+    b = jnp.arange(bh, dtype=jnp.uint32)[:, None, None]
+    r = jnp.arange(tq, dtype=jnp.uint32)[None, :, None]
+    c = jnp.arange(tk, dtype=jnp.uint32)[None, None, :]
+    bits = _hash_bits(b, r, c, jnp.uint32(seed))
+    return bits >= _rate_threshold(rate)
+
+
 # ---------------------------------------------------------------------------
 # Forward kernel
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, m_out_ref, l_out_ref,
-                acc_ref, m_ref, l_ref, *, sm_scale, causal, block_q, block_k):
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, m_out_ref,
+                l_out_ref, acc_ref, m_ref, l_ref, *, sm_scale, causal,
+                block_q, block_k, dropout_rate, dropout_debug):
+    b = pl.program_id(0)
     i = pl.program_id(1)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -108,7 +164,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, m_out_ref, l_out_ref,
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
+        # FA2 dropout: l accumulates the UNdropped p (true softmax
+        # denominator); only the numerator entries feeding PV are masked
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        if dropout_rate > 0.0:
+            keep = _keep_mask(p.shape, dropout_rate, seed_ref, b, i, j,
+                              block_q, block_k, dropout_debug)
+            p = jnp.where(keep, p, 0.0) / (1.0 - dropout_rate)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -137,7 +199,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, m_out_ref, l_out_ref,
         l_out_ref[0] = _row(l)
 
 
-def _flash_fwd(q, k, v, bias, causal, sm_scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, bias, seed, causal, sm_scale, block_q, block_k,
+               interpret, dropout_rate, dropout_debug):
     bh, tq, d = q.shape
     _, tk, _ = k.shape
     nq, nk = tq // block_q, tk // block_k
@@ -149,6 +212,9 @@ def _flash_fwd(q, k, v, bias, causal, sm_scale, block_q, block_k, interpret):
         pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
     ]
     args = [q, k, v]
+    kw = dict(sm_scale=sm_scale, causal=causal, block_q=block_q,
+              block_k=block_k, dropout_rate=dropout_rate,
+              dropout_debug=dropout_debug)
     if bias is not None:
         nheads = bh // bias.shape[0]
         in_specs.append(
@@ -156,17 +222,13 @@ def _flash_fwd(q, k, v, bias, causal, sm_scale, block_q, block_k, interpret):
                          lambda b, i, j: (b // nheads, 0, j))
         )
         args.append(bias.reshape(bias.shape[0], 1, tk))
-        kernel = functools.partial(
-            _fwd_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k,
-        )
+        kernel = functools.partial(_fwd_kernel, **kw)
     else:
-        def kernel(qr, kr, vr, o, mo, lo, acc, m, l):
-            return _fwd_kernel(
-                qr, kr, vr, None, o, mo, lo, acc, m, l,
-                sm_scale=sm_scale, causal=causal,
-                block_q=block_q, block_k=block_k,
-            )
+        def kernel(qr, kr, vr, sr, o, mo, lo, acc, m, l):
+            return _fwd_kernel(qr, kr, vr, None, sr, o, mo, lo, acc, m, l,
+                               **kw)
+    in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+    args.append(seed)
 
     o, m_out, l_out = pl.pallas_call(
         kernel,
@@ -214,9 +276,11 @@ def _recompute_p(q, k, bias_ref, m_col, l_col, sm_scale, causal, i, j,
     return jnp.exp(s - m_col) / l_col
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, m_ref, l_ref,
-                    dl_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
-                    sm_scale, causal, block_q, block_k):
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref, m_ref,
+                    l_ref, dl_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                    sm_scale, causal, block_q, block_k, dropout_rate,
+                    dropout_debug):
+    b = pl.program_id(0)
     j = pl.program_id(1)  # kv block
     i = pl.program_id(2)  # q block (innermost sweep)
     nq = pl.num_programs(2)
@@ -236,16 +300,28 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, m_ref, l_ref,
         delta_col = dl_ref[0].reshape(block_q, 1)
         p = _recompute_p(q, k, bias_ref, m_col, l_col, sm_scale, causal,
                          i, j, block_q, block_k)
-        # dV += P^T @ dO
-        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        # dP = dO @ V^T ; dS = P * (dP - delta)
+        # dP = dO @ V^T
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        if dropout_rate > 0.0:
+            # the SAME (b, i, j) seeding as the forward reproduces the
+            # mask; O = P_drop V, so dV uses P_drop and the softmax-
+            # jacobian input is the mask-scaled dP (sum P·dP = delta
+            # still holds because delta = rowsum(dO·O))
+            keep = _keep_mask(p.shape, dropout_rate, seed_ref, b, i, j,
+                              block_q, block_k, dropout_debug)
+            p_v = jnp.where(keep, p, 0.0) / (1.0 - dropout_rate)
+            dp = jnp.where(keep, dp, 0.0) / (1.0 - dropout_rate)
+        else:
+            p_v = p
+        # dV += P_drop^T @ dO
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p_v, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        # dS = P * (dP_masked - delta)
         ds = p * (dp - delta_col)
         # dK += dS^T @ Q * scale
         dk_acc[:] = dk_acc[:] + sm_scale * jax.lax.dot_general(
@@ -266,9 +342,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, m_ref, l_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, m_ref, l_ref,
-                   dl_ref, dq_ref, dq_acc, *, sm_scale, causal,
-                   block_q, block_k):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref, m_ref,
+                   l_ref, dl_ref, dq_ref, dq_acc, *, sm_scale, causal,
+                   block_q, block_k, dropout_rate, dropout_debug):
+    b = pl.program_id(0)
     i = pl.program_id(1)
     j = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -291,6 +368,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, m_ref, l_ref,
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        if dropout_rate > 0.0:
+            keep = _keep_mask(p.shape, dropout_rate, seed_ref, b, i, j,
+                              block_q, block_k, dropout_debug)
+            dp = jnp.where(keep, dp, 0.0) / (1.0 - dropout_rate)
         ds = p * (dp - delta_col)
         dq_acc[:] = dq_acc[:] + sm_scale * jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
@@ -309,8 +390,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, m_ref, l_ref,
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _flash_bwd(q, k, v, bias, o, m, l, do, causal, sm_scale,
-               block_q, block_k, interpret):
+def _flash_bwd(q, k, v, bias, seed, o, m, l, do, causal, sm_scale,
+               block_q, block_k, interpret, dropout_rate, dropout_debug):
     bh, tq, d = q.shape
     _, tk, _ = k.shape
     nq, nk = tq // block_q, tk // block_k
@@ -319,6 +400,9 @@ def _flash_bwd(q, k, v, bias, o, m, l, do, causal, sm_scale,
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
     )[:, None, :]  # [bh, 1, tq], matching the saved m/l row layout
     bias3 = None if bias is None else bias.reshape(bias.shape[0], 1, tk)
+    kw = dict(sm_scale=sm_scale, causal=causal, block_q=block_q,
+              block_k=block_k, dropout_rate=dropout_rate,
+              dropout_debug=dropout_debug)
 
     # --- dK/dV: grid (bh, kv-block, q-sweep) ---
     dkv_specs = [
@@ -334,17 +418,15 @@ def _flash_bwd(q, k, v, bias, o, m, l, do, causal, sm_scale,
                          lambda b, j, i: (b // nheads, 0, j))
         )
         dkv_args.append(bias3)
-        dkv_kernel = functools.partial(
-            _bwd_dkv_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k,
-        )
+        dkv_kernel = functools.partial(_bwd_dkv_kernel, **kw)
     else:
-        def dkv_kernel(qr, kr, vr, dor, mr, lr, dlr, dkr, dvr, dka, dva):
+        def dkv_kernel(qr, kr, vr, sr, dor, mr, lr, dlr, dkr, dvr, dka,
+                       dva):
             return _bwd_dkv_kernel(
-                qr, kr, vr, None, dor, mr, lr, dlr, dkr, dvr, dka, dva,
-                sm_scale=sm_scale, causal=causal,
-                block_q=block_q, block_k=block_k,
-            )
+                qr, kr, vr, None, sr, dor, mr, lr, dlr, dkr, dvr, dka,
+                dva, **kw)
+    dkv_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))   # seed
+    dkv_args.append(seed)
     dkv_specs += [
         pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),     # do
         pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),     # m
@@ -386,17 +468,13 @@ def _flash_bwd(q, k, v, bias, o, m, l, do, causal, sm_scale,
                          lambda b, i, j: (b // nheads, 0, j))
         )
         dq_args.append(bias3)
-        dq_kernel = functools.partial(
-            _bwd_dq_kernel, sm_scale=sm_scale, causal=causal,
-            block_q=block_q, block_k=block_k,
-        )
+        dq_kernel = functools.partial(_bwd_dq_kernel, **kw)
     else:
-        def dq_kernel(qr, kr, vr, dor, mr, lr, dlr, dqr, dqa):
+        def dq_kernel(qr, kr, vr, sr, dor, mr, lr, dlr, dqr, dqa):
             return _bwd_dq_kernel(
-                qr, kr, vr, None, dor, mr, lr, dlr, dqr, dqa,
-                sm_scale=sm_scale, causal=causal,
-                block_q=block_q, block_k=block_k,
-            )
+                qr, kr, vr, None, sr, dor, mr, lr, dlr, dqr, dqa, **kw)
+    dq_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))   # seed
+    dq_args.append(seed)
     dq_specs += [
         pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),     # do
         pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),     # m
@@ -422,8 +500,12 @@ def _flash_bwd(q, k, v, bias, o, m, l, do, causal, sm_scale,
 # XLA fallback (also the numerical reference in tests)
 # ---------------------------------------------------------------------------
 
-def mha_reference(q, k, v, bias=None, causal=False, sm_scale=None):
-    """Plain-XLA multi-head attention. q,k,v: [B,H,T,D]; bias: [B,Tk]."""
+def mha_reference(q, k, v, bias=None, causal=False, sm_scale=None,
+                  dropout_rate=0.0, seed=None, debug=False):
+    """Plain-XLA multi-head attention. q,k,v: [B,H,T,D]; bias: [B,Tk].
+    With dropout: upscale-in-train on the probabilities; the mask comes
+    from the debug position hash (bit-matching the kernel's debug mode)
+    or jax.random (statistically matching the kernel's hardware PRNG)."""
     d = q.shape[-1]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
@@ -437,6 +519,18 @@ def mha_reference(q, k, v, bias=None, causal=False, sm_scale=None):
         mask = jnp.tril(jnp.ones((tq, tk), bool), k=tk - tq)
         s = jnp.where(mask, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
+    if dropout_rate and dropout_rate > 0.0:
+        b, h, tq, tk = p.shape
+        sd = jnp.reshape(jnp.asarray(0 if seed is None else seed,
+                                     jnp.int32), (1,))
+        if debug:
+            keep = debug_keep_mask(b * h, tq, tk, dropout_rate,
+                                   sd[0]).reshape(b, h, tq, tk)
+        else:
+            keep = jax.random.bernoulli(
+                jax.random.PRNGKey(sd[0]), 1.0 - dropout_rate, p.shape)
+        keep = jax.lax.stop_gradient(keep)
+        p = jnp.where(keep, p, 0.0) / (1.0 - dropout_rate)
     o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
     return o.astype(q.dtype)
 
@@ -463,7 +557,13 @@ def _kernel_applicable(q, k, bias):
     # Perf heuristic (measured on v5e): the blocked kernel wins once the
     # score matrix per head exceeds ~256x256 (2.0-2.4x at T=2048); at
     # T=128 XLA's fused unblocked attention is faster, so let it have it.
-    if max(tq, tk) < 256 and os.environ.get("PADDLE_TPU_PALLAS") != "interpret":
+    # The boundary is env-tunable (PADDLE_TPU_FLASH_MIN_T) so on-chip
+    # sweeps (tools/bench_flash.py) can re-decide it — with in-kernel
+    # dropout the break-even may sit lower, since the XLA path then pays
+    # a materialized [B,H,T,T] mask the kernel never writes.
+    min_t = int(os.environ.get("PADDLE_TPU_FLASH_MIN_T", "256"))
+    if max(tq, tk) < min_t and \
+            os.environ.get("PADDLE_TPU_PALLAS") != "interpret":
         return False
     bq, bk = _pick_blocks(tq, tk)
     if tq % bq or tk % bk or bq < 8 or bq % 8 or bk < 128 or bk % 128:
@@ -474,36 +574,47 @@ def _kernel_applicable(q, k, bias):
     return True
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, bias, causal, sm_scale, block_q, block_k, interpret):
-    o, _, _ = _flash_fwd(q, k, v, bias, causal, sm_scale, block_q, block_k,
-                         interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
+def _flash(q, k, v, bias, seed, causal, sm_scale, block_q, block_k,
+           interpret, dropout_rate, dropout_debug):
+    o, _, _ = _flash_fwd(q, k, v, bias, seed, causal, sm_scale, block_q,
+                         block_k, interpret, dropout_rate, dropout_debug)
     return o
 
 
-def _flash_fwd_rule(q, k, v, bias, causal, sm_scale, block_q, block_k,
-                    interpret):
-    o, m, l = _flash_fwd(q, k, v, bias, causal, sm_scale, block_q, block_k,
-                         interpret)
-    return o, (q, k, v, bias, o, m, l)
+def _flash_fwd_rule(q, k, v, bias, seed, causal, sm_scale, block_q,
+                    block_k, interpret, dropout_rate, dropout_debug):
+    o, m, l = _flash_fwd(q, k, v, bias, seed, causal, sm_scale, block_q,
+                         block_k, interpret, dropout_rate, dropout_debug)
+    return o, (q, k, v, bias, seed, o, m, l)
 
 
-def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret, res, do):
-    q, k, v, bias, o, m, l = res
-    dq, dk, dv = _flash_bwd(q, k, v, bias, o, m, l, do, causal, sm_scale,
-                            block_q, block_k, interpret)
+def _flash_bwd_rule(causal, sm_scale, block_q, block_k, interpret,
+                    dropout_rate, dropout_debug, res, do):
+    q, k, v, bias, seed, o, m, l = res
+    dq, dk, dv = _flash_bwd(q, k, v, bias, seed, o, m, l, do, causal,
+                            sm_scale, block_q, block_k, interpret,
+                            dropout_rate, dropout_debug)
     dbias = None if bias is None else jnp.zeros_like(bias)
-    return (dq, dk, dv, dbias)
+    return (dq, dk, dv, dbias, None)  # int seed: no cotangent
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None):
+def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,
+                    dropout_rate=0.0, dropout_seed=None):
     """Multi-head attention: Pallas flash kernel on TPU, XLA elsewhere.
 
     q,k,v: [B, H, T, D]; bias: additive key bias [B, Tk] or [B,1,1,Tk]
     (no gradient flows to bias); returns [B, H, Tq, D].
+
+    dropout_rate > 0 applies attention-probability dropout IN-KERNEL
+    (upscale-in-train semantics); ``dropout_seed`` is an int32 scalar or
+    [1] array that must change per step.  On the XLA fallback the same
+    rate is applied with jax.random (debug hash under
+    PADDLE_TPU_FLASH_DROPOUT_DEBUG=iota, where both paths draw the
+    identical mask for cross-checking).
     """
     if bias is not None:
         # constant on BOTH paths: the Pallas custom_vjp returns zero bias
@@ -514,15 +625,29 @@ def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None):
     d = q.shape[-1]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
+    dropout_rate = float(dropout_rate or 0.0)
+    if not 0.0 <= dropout_rate < 1.0:
+        raise ValueError(
+            "dropout_rate must be in [0, 1), got %r (rate 1 would "
+            "upscale by 1/0)" % dropout_rate)
+    if dropout_rate > 0.0 and dropout_seed is None:
+        raise ValueError("dropout_rate > 0 requires dropout_seed")
     use, interpret = _use_pallas()
+    debug = _dropout_debug()
     b, h, tq, _ = q.shape
     tk = k.shape[2]
     qf = q.reshape(b * h, tq, d)
     kf = k.reshape(b * h, tk, d)
     vf = v.reshape(b * h, tk, d)
+    seed = jnp.reshape(
+        jnp.asarray(0 if dropout_seed is None else dropout_seed,
+                    jnp.int32), (1,))
     if not (use and _kernel_applicable(qf, kf, bias)):
         return mha_reference(q, k, v, bias=bias, causal=causal,
-                             sm_scale=sm_scale)
+                             sm_scale=sm_scale,
+                             dropout_rate=dropout_rate, seed=seed,
+                             debug=debug)
     bq, bk = _pick_blocks(tq, tk)
-    o = _flash(qf, kf, vf, bias, causal, sm_scale, bq, bk, interpret)
+    o = _flash(qf, kf, vf, bias, seed, causal, sm_scale, bq, bk,
+               interpret, dropout_rate, debug)
     return o.reshape(b, h, tq, d)

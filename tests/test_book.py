@@ -228,8 +228,14 @@ class TestUnderstandSentiment:
     reader decorators → feed)."""
 
     def test_train_reaches_accuracy(self):
+        import random
+
         from paddle_tpu import datasets, reader_decorators as rd
 
+        # rd.shuffle draws from the global random module; pin it so the
+        # batch order (and the accuracy threshold) is independent of
+        # whichever tests ran before in the same process
+        random.seed(1234)
         L = 40
         V = datasets.sentiment.VOCAB
         fluid.unique_name.switch()

@@ -128,3 +128,147 @@ def test_fused_op_in_program():
     o_u, gq_u = build(False)
     np.testing.assert_allclose(o_f, o_u, atol=1e-5, rtol=1e-5)
     np.testing.assert_allclose(gq_f, gq_u, atol=1e-4, rtol=1e-4)
+
+
+class TestInKernelDropout:
+    """Debug-hash mode (PADDLE_TPU_FLASH_DROPOUT_DEBUG=iota): the kernel
+    and the XLA reference draw the IDENTICAL mask, so fwd outputs and all
+    grads must match to float tolerance — verifying the FA2 dropout math
+    (l from undropped p, masked numerator, mask-scaled dP in backward)
+    independently of the hardware PRNG."""
+
+    def setup_method(self):
+        os.environ["PADDLE_TPU_FLASH_DROPOUT_DEBUG"] = "iota"
+
+    def teardown_method(self):
+        os.environ.pop("PADDLE_TPU_FLASH_DROPOUT_DEBUG", None)
+
+    @pytest.mark.parametrize("rate", [0.1, 0.5])
+    @pytest.mark.parametrize("multiblock", [False, True])
+    def test_fwd_bwd_match_reference(self, rate, multiblock):
+        rng = np.random.RandomState(0)
+        B, H, D = 2, 2, 64
+        T = 512 if multiblock else 128
+        q = _rand(rng, B, H, T, D)
+        k = _rand(rng, B, H, T, D)
+        v = _rand(rng, B, H, T, D)
+        seed = 1234
+
+        if multiblock:
+            bq, bk = 128, 256
+        else:
+            bq, bk = T, max(128, T)
+
+        def flash_loss(q, k, v):
+            qf = q.reshape(B * H, T, D)
+            kf = k.reshape(B * H, T, D)
+            vf = v.reshape(B * H, T, D)
+            o = FA._flash(qf, kf, vf, None,
+                          jnp.asarray([seed], jnp.int32), False,
+                          1.0 / np.sqrt(D), bq, bk, True, rate, True)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        def ref_loss(q, k, v):
+            o = FA.mha_reference(q, k, v, sm_scale=1.0 / np.sqrt(D),
+                                 dropout_rate=rate,
+                                 seed=jnp.asarray([seed], jnp.int32),
+                                 debug=True)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        lf, gf = jax.value_and_grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+        lr_, gr = jax.value_and_grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(float(lf), float(lr_), rtol=2e-5)
+        for a, b, nm in zip(gf, gr, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=2e-4, rtol=2e-4,
+                err_msg="d%s mismatch" % nm)
+
+    def test_mask_actually_drops(self):
+        """Dropout changes the output vs rate=0 and zero cells appear at
+        the hash-predicted positions."""
+        rng = np.random.RandomState(1)
+        B, H, T, D = 1, 1, 128, 64
+        q = _rand(rng, B, H, T, D)
+        k = _rand(rng, B, H, T, D)
+        v = _rand(rng, B, H, T, D)
+        seed = jnp.asarray([7], jnp.int32)
+        o_drop = FA._flash(q.reshape(1, T, D), k.reshape(1, T, D),
+                           v.reshape(1, T, D), None, seed, False,
+                           1.0 / np.sqrt(D), T, 128, True, 0.5, True)
+        o_plain = FA._flash(q.reshape(1, T, D), k.reshape(1, T, D),
+                            v.reshape(1, T, D), None, seed, False,
+                            1.0 / np.sqrt(D), T, 128, True, 0.0, True)
+        assert not np.allclose(np.asarray(o_drop), np.asarray(o_plain))
+        # keep fraction of the debug hash is ~1-rate
+        keep = np.asarray(FA.debug_keep_mask(1, T, T, 0.5, 7))
+        assert abs(keep.mean() - 0.5) < 0.05
+
+    def test_dropout_through_program(self):
+        """attn_dropout>0 BERT config now takes the fused path and trains
+        (loss finite and decreasing)."""
+        import paddle_tpu as fluid
+        from paddle_tpu.models import bert
+
+        cfg = bert.BertConfig(vocab_size=256, hidden=64, layers=1,
+                              heads=2, ffn=128, max_seq=128, dropout=0.1,
+                              fuse_attn=True)
+        assert cfg.attn_dropout == 0.1
+        main, startup, feeds, loss = bert.build_pretrain(
+            cfg, seq_len=128, lr=1e-3, train=True)
+        fused_ops = [op for op in main.global_block().ops
+                     if op.type == "fused_multihead_attention"]
+        assert fused_ops and fused_ops[0].attr("dropout_rate") == 0.1
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed = bert.make_fake_batch(4, 128, cfg, rng)
+        l0 = float(np.asarray(exe.run(
+            main, feed=feed, fetch_list=[loss])[0]).reshape(()))
+        for _ in range(6):
+            exe.run(main, feed=feed, fetch_list=[])
+        l1 = float(np.asarray(exe.run(
+            main, feed=feed, fetch_list=[loss])[0]).reshape(()))
+        assert np.isfinite(l0) and np.isfinite(l1)
+        assert l1 < l0
+
+
+    def test_clone_for_test_disables_kernel_dropout(self):
+        """clone(for_test=True) must switch in-kernel dropout off — the
+        serving path has no other off-switch for the fused op."""
+        import paddle_tpu as fluid
+        from paddle_tpu.models import bert
+
+        cfg = bert.BertConfig(vocab_size=256, hidden=64, layers=1,
+                              heads=2, ffn=128, max_seq=128, dropout=0.1,
+                              fuse_attn=True)
+        fluid.unique_name.switch()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ids = fluid.layers.data("input_ids", shape=[128],
+                                    dtype="int64")
+            tt = fluid.layers.data("token_type_ids", shape=[128],
+                                   dtype="int64")
+            mb = fluid.layers.data("attn_mask_bias", shape=[1, 1, 128],
+                                   dtype="float32")
+            x = bert.encoder(ids, tt, mb, cfg, 128)
+            out = fluid.layers.reduce_mean(x)
+        test_prog = main.clone(for_test=True)
+        fused = [op for op in test_prog.global_block().ops
+                 if op.type == "fused_multihead_attention"]
+        assert fused and all(op.attr("is_test") for op in fused)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        feed = {k: v for k, v in bert.make_fake_batch(
+            2, 128, cfg, rng).items()
+            if k in ("input_ids", "token_type_ids", "attn_mask_bias",
+                     "pos_ids")}
+        o1 = exe.run(test_prog, feed=feed, fetch_list=[out])[0]
+        o2 = exe.run(test_prog, feed=feed, fetch_list=[out])[0]
+        np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+
+    def test_rate_validation(self):
+        rng = np.random.RandomState(0)
+        q = _rand(rng, 1, 1, 128, 64)
+        with pytest.raises(ValueError, match="dropout_rate"):
+            FA.flash_attention(q, q, q, dropout_rate=1.0, dropout_seed=1)

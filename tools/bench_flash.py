@@ -1,0 +1,97 @@
+"""Measure the Pallas flash-attention kernel against the XLA attention
+path on the real chip: fwd+bwd wall time and effective MFU at the shapes
+that matter (T=128 — the deferral boundary — and T=512/1024/2048, with
+and without in-kernel dropout).
+
+Decides VERDICT r2 #3: is the T<256 deferral justified, and does the
+kernel hit >= 0.40 attention-MFU at seq512 with dropout on?
+
+Usage (on TPU):  python tools/bench_flash.py [--csv]
+"""
+
+import argparse
+import math
+import sys
+import time
+
+import numpy as np
+
+
+def bench_case(T, dropout, use_kernel, B=16, H=12, D=64, steps=30):
+    import os
+
+    os.environ["PADDLE_TPU_PALLAS"] = "auto" if use_kernel else "off"
+    import importlib
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops.pallas import flash_attention as FA
+    importlib.reload(FA)  # re-read PADDLE_TPU_PALLAS
+
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32),
+                    dtype=jnp.bfloat16)
+    k = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32),
+                    dtype=jnp.bfloat16)
+    v = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32),
+                    dtype=jnp.bfloat16)
+    seed = jnp.asarray([3], jnp.int32)
+
+    def loss(q, k, v):
+        o = FA.flash_attention(
+            q, k, v, dropout_rate=dropout,
+            dropout_seed=(seed if dropout else None))
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    step = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
+    l, g = step(q, k, v)   # compile
+    jax.block_until_ready((l, g))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        l, g = step(q, k, v)
+    jax.block_until_ready((l, g))
+    dt = (time.perf_counter() - t0) / steps
+    # attention fwd+bwd FLOPs: fwd 2*2*B*H*T^2*D (scores + PV), bwd ~2.5x
+    flops = 3.5 * 2 * 2 * B * H * T * T * D
+    mfu = flops / dt / 197e12
+    return dt * 1e3, mfu
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--csv", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    plat = str(jax.devices()[0].platform).lower()
+    if "tpu" not in plat and "axon" not in plat:
+        print("# WARNING: not on TPU (platform=%s); numbers meaningless"
+              % plat)
+
+    rows = []
+    for T in (128, 256, 512, 1024, 2048):
+        for dropout in (0.0, 0.1):
+            for use_kernel in (False, True):
+                try:
+                    ms, mfu = bench_case(T, dropout, use_kernel)
+                except Exception as e:  # noqa: BLE001
+                    print("# T=%d drop=%.1f kernel=%s FAILED: %s"
+                          % (T, dropout, use_kernel, e), flush=True)
+                    continue
+                rows.append((T, dropout, use_kernel, ms, mfu))
+                print("T=%-5d drop=%.1f %-6s  %7.3f ms  attn-MFU %.3f"
+                      % (T, dropout,
+                         "pallas" if use_kernel else "xla", ms, mfu),
+                      flush=True)
+    if args.csv:
+        print("T,dropout,kernel,ms,mfu")
+        for r in rows:
+            print("%d,%.2f,%d,%.4f,%.4f"
+                  % (r[0], r[1], int(r[2]), r[3], r[4]))
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    main()
