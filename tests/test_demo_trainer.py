@@ -1,0 +1,68 @@
+"""C++-only train demo (reference: ``paddle/fluid/train/demo/
+demo_trainer.cc`` + its run.sh build): serialize a fit-a-line training
+program, compile the C++ driver against libpython, run it with NO Python
+script, and check it trains."""
+
+import os
+import subprocess
+import sys
+import sysconfig
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import proto
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC = os.path.join(REPO, "paddle_tpu", "native", "src", "demo_trainer.cc")
+
+
+def _build_binary(out_path):
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR")
+    ver = "python%d.%d" % sys.version_info[:2]
+    cmd = ["g++", "-O2", "-std=c++14", SRC, "-I", inc,
+           "-L", libdir, "-l" + ver, "-Wl,-rpath," + libdir,
+           "-o", out_path]
+    res = subprocess.run(cmd, capture_output=True, text=True, timeout=180)
+    if res.returncode != 0:
+        pytest.skip("g++/libpython unavailable: %s" % res.stderr[-300:])
+    return out_path
+
+
+class TestDemoTrainer:
+    def test_cpp_binary_trains_serialized_program(self, tmp_path):
+        # 1. build + serialize fit-a-line (the reference demo's model)
+        fluid.unique_name.switch()
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 1
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[13], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="float32")
+            pred = fluid.layers.fc(x, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+        proto.save_program(main, str(tmp_path / "main_program"))
+        proto.save_program(startup, str(tmp_path / "startup_program"))
+
+        # 2. compile the C++ driver
+        binary = _build_binary(str(tmp_path / "demo_trainer"))
+
+        # 3. run it — no Python script involved; the env must let the
+        # embedded interpreter find the repo and force the CPU backend
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        env["PADDLE_TPU_DEMO_FORCE_CPU"] = "1"
+        res = subprocess.run(
+            [binary, str(tmp_path), "10"], capture_output=True,
+            text=True, timeout=300, env=env)
+        assert res.returncode == 0, (res.stdout[-400:], res.stderr[-400:])
+        lines = [l for l in res.stdout.splitlines()
+                 if l.startswith("step:")]
+        assert len(lines) == 10, res.stdout
+        assert "demo_trainer ok" in res.stdout
+        first = float(lines[0].split("loss:")[1])
+        last = float(lines[-1].split("loss:")[1])
+        assert last < first

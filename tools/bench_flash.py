@@ -21,13 +21,14 @@ def bench_case(T, dropout, use_kernel, B=16, H=12, D=64, steps=30):
     import os
 
     os.environ["PADDLE_TPU_PALLAS"] = "auto" if use_kernel else "off"
-    import importlib
+    # force the kernel at EVERY T (the tool exists to re-decide the
+    # default T<256 deferral, so the boundary must not gate the sweep)
+    os.environ["PADDLE_TPU_FLASH_MIN_T"] = "1" if use_kernel else "256"
 
     import jax
     import jax.numpy as jnp
 
     from paddle_tpu.ops.pallas import flash_attention as FA
-    importlib.reload(FA)  # re-read PADDLE_TPU_PALLAS
 
     rng = np.random.RandomState(0)
     q = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32),
