@@ -76,6 +76,7 @@ std::string FindLossName(PyObject* program) {
 int main(int argc, char** argv) {
   const char* dir = argc > 1 ? argv[1] : ".";
   const int steps = argc > 2 ? std::atoi(argv[2]) : 10;
+  if (steps < 2) Fatal("steps must be >= 2 (loss-decrease check)");
   const int batch = 2;  // reference demo feeds x[2,13], y[2,1]
 
   Py_Initialize();

@@ -133,3 +133,7 @@ __all__ += ["ring_attention", "ring_attention_local"]
 from .pipeline import gpipe, gpipe_stage_params  # noqa: E402,F401
 
 __all__ += ["gpipe", "gpipe_stage_params"]
+
+from .ulysses import ulysses_attention, ulysses_attention_local  # noqa: E402,F401
+
+__all__ += ["ulysses_attention", "ulysses_attention_local"]

@@ -1,0 +1,115 @@
+"""All-to-all (Ulysses-style) sequence parallelism over a mesh axis.
+
+The complement to ring attention (``ring_attention.py``): instead of
+rotating K/V shards around a ring, one ``all_to_all`` re-shards the
+activations from sequence-sharded to HEAD-sharded, every device then runs
+plain (flash) attention over its full sequence for its subset of heads,
+and a second ``all_to_all`` restores sequence sharding.  (DeepSpeed-
+Ulysses construction; on TPU both all-to-alls are single XLA collectives
+riding ICI.)
+
+Trade-off vs the ring (why both exist):
+
+* Ulysses moves 2 x the activation volume but runs DENSE attention with
+  zero per-step latency chaining — best when heads >= axis size and the
+  sequence still fits per-device once heads are split.
+* Ring keeps heads whole and never re-lays-out activations, paying
+  ``axis-1`` pipelined ppermute hops — best when H < axis size or at
+  extreme T where even one head's full sequence is too big.
+
+Entry points mirror ring attention:
+* :func:`ulysses_attention_local` — call INSIDE a ``shard_map``; q/k/v
+  are sequence shards [B, H, T/n, D].
+* :func:`ulysses_attention` — global [B, H, T, D] + mesh wrapper.
+
+The additive key-padding bias is per-position over the FULL sequence
+([B, Tk]); it is replicated into the head-sharded phase (constant, no
+gradient — same contract as the flash kernel and the ring).
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["ulysses_attention", "ulysses_attention_local"]
+
+
+def ulysses_attention_local(q, k, v, axis_name, axis_size, bias=None,
+                            causal=False, sm_scale=None):
+    """Per-shard Ulysses attention.  q,k,v: [B, H, Tl, D] sequence
+    shards (Tl = T/n); H must be divisible by the axis size n.  Returns
+    the [B, H, Tl, D] output shard."""
+    n = axis_size
+    b, h, tl, d = q.shape
+    if h % n:
+        raise ValueError(
+            "ulysses needs heads %% axis_size == 0 (got H=%d, n=%d); "
+            "use ring attention for head counts below the axis size"
+            % (h, n))
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    hl = h // n
+
+    def to_heads(x):
+        # [B, H, Tl, D] seq-sharded → [B, H/n, T, D] head-sharded:
+        # head-group g goes to device g, each device gathers its group's
+        # sequence shards along the sequence dim
+        x = x.reshape(b, n, hl, tl, d)
+        x = jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=3,
+                               tiled=True)
+        # tiled: dim1 n→1, dim3 tl→n·tl
+        return x.reshape(b, hl, n * tl, d)
+
+    def to_seq(x):
+        # inverse: [B, hl, T, D] head-sharded → [B, H, Tl, D]; chunks
+        # arrive source-device-major on the concat axis, so dim1 comes
+        # back as g·hl + j = the original global head order
+        x = x.reshape(b, hl, n, tl, d)
+        x = jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                               tiled=True)
+        # tiled: dim2 n→1 folded away by concat, dim1 hl→n·hl
+        return x.reshape(b, h, tl, d)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+
+    from ..ops.pallas.flash_attention import flash_attention
+
+    oh = flash_attention(qh, kh, vh, bias=bias, causal=causal,
+                         sm_scale=sm_scale)
+    return to_seq(oh)
+
+
+def ulysses_attention(q, k, v, mesh, axis_name, bias=None, causal=False,
+                      sm_scale=None):
+    """Global entry: q,k,v [B, H, T, D] (sequence dim sharded over
+    ``axis_name`` by the partitioner), returns [B, H, T, D]."""
+    from jax import shard_map
+
+    n = mesh.shape[axis_name]
+    if q.shape[2] % n:
+        raise ValueError(
+            "sequence length %d not divisible by mesh axis %r size %d"
+            % (q.shape[2], axis_name, n))
+    if bias is not None and bias.ndim == 4:
+        bias = bias.reshape(bias.shape[0], bias.shape[-1])
+    if bias is not None:
+        bias = jax.lax.stop_gradient(bias)
+
+    spec = P(None, None, axis_name, None)
+    bias_spec = P() if bias is not None else None
+
+    def local(q, k, v, *rest):
+        b = rest[0] if rest else None
+        return ulysses_attention_local(
+            q, k, v, axis_name, n, bias=b, causal=causal,
+            sm_scale=sm_scale)
+
+    args = (q, k, v) + ((bias,) if bias is not None else ())
+    in_specs = (spec, spec, spec) + (
+        (bias_spec,) if bias is not None else ())
+    return shard_map(
+        local, mesh=mesh, in_specs=in_specs, out_specs=spec,
+        check_vma=False,
+    )(*args)
