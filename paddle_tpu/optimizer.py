@@ -777,8 +777,12 @@ class LambOptimizer(AdamOptimizer):
 class DGCMomentumOptimizer(MomentumOptimizer):
     """Deep-gradient-compression momentum (reference optimizer.py:787).
     On TPU the grads ride ICI, where sparsifying compression loses more in
-    gather overhead than it saves in bytes — accepted for API parity,
-    behaves as plain momentum."""
+    gather overhead than it saves in bytes — so under the standard jitted
+    GSPMD step this behaves as plain momentum (API parity).  The REAL
+    algorithm (top-k + momentum correction + error feedback) exists as
+    ``paddle_tpu.parallel.dgc.dgc_exchange`` / ``dgc_momentum_step`` for
+    the slow-interconnect (DP-over-DCN) regime where compression pays,
+    usable inside shard_map over the data axis."""
 
     def __init__(self, learning_rate, momentum, rampup_begin_step=0,
                  rampup_step=1, sparsity=(0.999,), use_nesterov=False,
@@ -790,7 +794,9 @@ class DGCMomentumOptimizer(MomentumOptimizer):
             "DGCMomentumOptimizer runs as plain momentum on TPU: "
             "sparsity/rampup_begin_step/rampup_step/local_grad_clip_norm "
             "are ignored (gradient compression loses more in gather "
-            "overhead than it saves in bytes over ICI)")
+            "overhead than it saves in bytes over ICI); for DP over slow "
+            "links use paddle_tpu.parallel.dgc_momentum_step, the real "
+            "top-k + error-feedback algorithm")
         super().__init__(learning_rate, momentum, use_nesterov,
                          regularization, name)
 

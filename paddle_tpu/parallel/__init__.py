@@ -137,3 +137,7 @@ __all__ += ["gpipe", "gpipe_stage_params"]
 from .ulysses import ulysses_attention, ulysses_attention_local  # noqa: E402,F401
 
 __all__ += ["ulysses_attention", "ulysses_attention_local"]
+
+from .dgc import dgc_exchange, dgc_momentum_step  # noqa: E402,F401
+
+__all__ += ["dgc_exchange", "dgc_momentum_step"]

@@ -1,0 +1,98 @@
+"""Deep Gradient Compression (Lin et al.) as a TPU shard_map primitive.
+
+Reference analogue: ``DGCMomentumOptimizer`` (``python/paddle/fluid/
+optimizer.py:787``) + the dgc op family — per-worker top-k gradient
+sparsification with momentum correction and residual accumulation,
+exchanging only the selected (value, index) pairs.
+
+TPU-native framing: under GSPMD the dense gradient all-reduce is fused
+into the jitted step and rides ICI at line rate, so DGC *loses* time on
+a normal pod (the repo's ``DGCMomentumOptimizer`` therefore stays a
+documented dense-momentum alias).  The regime where compression DOES pay
+is slow interconnect — DP over DCN between distant hosts — and for that
+this module provides the real algorithm as an explicit primitive usable
+inside ``shard_map`` over the data axis:
+
+    new_grad, new_residual, new_momentum = dgc_exchange(
+        local_grad, residual, momentum, axis_name,
+        sparsity=0.999, momentum_coef=0.9)
+
+Per the paper: (1) momentum correction — the LOCAL momentum accumulates
+the raw gradient and the residual accumulates the momentum-corrected
+value; (2) top-k selection by magnitude over the accumulated residual;
+(3) the selected entries are exchanged (here: values masked then psum —
+on a k-sparse tensor XLA's allreduce moves only dense words, so the
+index bookkeeping of the RPC implementation is replaced by the masked
+sum, which is the collective-friendly formulation); (4) selected
+entries clear from the residual/momentum, unselected entries stay local
+(error feedback).
+"""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["dgc_exchange", "dgc_momentum_step"]
+
+
+def _top_k_mask(x, k):
+    """Boolean mask of the k largest-|x| entries (flat)."""
+    flat = jnp.abs(x.reshape(-1))
+    if k >= flat.size:
+        return jnp.ones_like(x, dtype=bool)
+    # threshold at the k-th largest magnitude; ties may admit a few extra
+    # entries (same acceptance the reference's sampled threshold has).
+    # The > 0 guard is PER ELEMENT: when fewer than k entries are nonzero
+    # the threshold is 0 and the real nonzeros must still be sent
+    thresh = jax.lax.top_k(flat, k)[0][-1]
+    return (jnp.abs(x) >= thresh) & (jnp.abs(x) > 0)
+
+
+def dgc_exchange(grad, residual, momentum, axis_name, sparsity=0.999,
+                 momentum_coef=0.9, use_nesterov=False):
+    """One DGC gradient exchange for a single parameter tensor.
+
+    Inside shard_map over ``axis_name`` (one data shard per device):
+    returns (exchanged_grad, new_residual, new_momentum), where
+    exchanged_grad is the cross-replica sum of every worker's top-k
+    momentum-corrected accumulated gradient, divided by the axis size
+    (mean, matching the dense DP convention).
+    """
+    n = jax.lax.axis_size(axis_name)  # static — no extra collective
+    # momentum correction (paper eq. 4/5): accumulate THEN select
+    m_new = momentum_coef * momentum + grad
+    if use_nesterov:
+        acc = residual + momentum_coef * m_new + grad
+    else:
+        acc = residual + m_new
+    k = max(1, int(round(acc.size * (1.0 - sparsity))))
+    mask = _top_k_mask(acc, k)
+    selected = jnp.where(mask, acc, 0.0)
+    # exchange: masked values summed across workers (the all-gather of
+    # (value, index) pairs in the RPC formulation)
+    exchanged = jax.lax.psum(selected, axis_name) / n
+    # error feedback: selected entries leave the local state
+    r_new = jnp.where(mask, 0.0, acc)
+    m_out = jnp.where(mask, 0.0, m_new)
+    return exchanged, r_new, m_out
+
+
+def dgc_momentum_step(params, grads, states, lr, axis_name,
+                      sparsity=0.999, momentum_coef=0.9,
+                      use_nesterov=False):
+    """Apply one DGC step to a pytree of params.
+
+    ``states`` is a pytree of (residual, momentum) tuples matching
+    params (init: zeros).  Returns (new_params, new_states).  The
+    exchanged sparse gradient is applied directly (the momentum lives
+    INSIDE the compression, per the paper's momentum correction)."""
+    flat_p, treedef = jax.tree_util.tree_flatten(params)
+    flat_g = treedef.flatten_up_to(grads)
+    flat_s = treedef.flatten_up_to(states)
+    new_p, new_s = [], []
+    for p, g, (r, m) in zip(flat_p, flat_g, flat_s):
+        ex, r2, m2 = dgc_exchange(g, r, m, axis_name, sparsity,
+                                  momentum_coef, use_nesterov)
+        new_p.append(p - lr * ex)
+        new_s.append((r2, m2))
+    return (jax.tree_util.tree_unflatten(treedef, new_p),
+            jax.tree_util.tree_unflatten(treedef, new_s))
