@@ -20,6 +20,11 @@ from . import wmt14  # noqa: F401
 from . import wmt16  # noqa: F401
 from . import movielens  # noqa: F401
 from . import sentiment  # noqa: F401
+from . import imikolov  # noqa: F401
+from . import mq2007  # noqa: F401
+from . import voc2012  # noqa: F401
+from . import image  # noqa: F401
 
 __all__ = ["common", "mnist", "cifar", "uci_housing", "imdb", "flowers",
-           "conll05", "wmt14", "wmt16", "movielens", "sentiment"]
+           "conll05", "wmt14", "wmt16", "movielens", "sentiment",
+           "imikolov", "mq2007", "voc2012", "image"]

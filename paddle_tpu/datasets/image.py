@@ -1,0 +1,99 @@
+"""Image preprocessing utilities (reference:
+``python/paddle/dataset/image.py`` — load/resize/crop/flip/transform,
+built there on cv2).  TPU-framework version uses PIL + numpy (cv2 is
+not in this image); same function names and HWC-uint8 in /
+CHW-float out conventions.  These run on the HOST feeding the device
+input pipeline — keep them light; heavy augmentation belongs in the
+device program where XLA can fuse it."""
+
+import numpy as np
+
+__all__ = [
+    "load_image", "load_image_bytes", "resize_short", "to_chw",
+    "center_crop", "random_crop", "left_right_flip", "simple_transform",
+    "load_and_transform",
+]
+
+
+def _to_pil(im):
+    from PIL import Image
+
+    return Image.fromarray(im)
+
+
+def load_image_bytes(bytes_, is_color=True):
+    """Decode an encoded image from bytes → HWC uint8 (or HW if gray)."""
+    import io
+
+    from PIL import Image
+
+    im = Image.open(io.BytesIO(bytes_))
+    im = im.convert("RGB" if is_color else "L")
+    return np.asarray(im)
+
+
+def load_image(file, is_color=True):
+    with open(file, "rb") as f:
+        return load_image_bytes(f.read(), is_color=is_color)
+
+
+def resize_short(im, size):
+    """Resize so the SHORTER edge equals ``size`` (aspect preserved)."""
+    h, w = im.shape[:2]
+    if h > w:
+        new_h, new_w = int(round(h * size / w)), size
+    else:
+        new_h, new_w = size, int(round(w * size / h))
+    pil = _to_pil(im).resize((new_w, new_h))
+    return np.asarray(pil)
+
+
+def to_chw(im, order=(2, 0, 1)):
+    assert len(im.shape) == len(order)
+    return im.transpose(order)
+
+
+def center_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h0 = (h - size) // 2
+    w0 = (w - size) // 2
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def random_crop(im, size, is_color=True):
+    h, w = im.shape[:2]
+    h0 = np.random.randint(0, h - size + 1)
+    w0 = np.random.randint(0, w - size + 1)
+    return im[h0:h0 + size, w0:w0 + size]
+
+
+def left_right_flip(im, is_color=True):
+    return im[:, ::-1, :] if (is_color and im.ndim == 3) else im[:, ::-1]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None):
+    """resize_short → (random|center) crop (+ random flip when training)
+    → CHW float32, optionally mean-subtracted (reference :327)."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, is_color=is_color)
+        if np.random.randint(2) == 0:
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size, is_color=is_color)
+    if is_color and im.ndim == 3:
+        im = to_chw(im)
+    im = im.astype("float32")
+    if mean is not None:
+        mean = np.asarray(mean, np.float32)
+        if is_color and mean.ndim == 1:
+            mean = mean[:, None, None]
+        im -= mean
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    return simple_transform(load_image(filename, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)

@@ -1,0 +1,67 @@
+"""PTB (imikolov) language-model readers (reference:
+``python/paddle/dataset/imikolov.py`` — ``build_dict(min_word_freq)``,
+``train(word_idx, n, data_type)``/``test(...)`` yielding n-gram tuples
+or (sequence, next-word) pairs).  Synthetic surrogate (zero-egress
+image): a Zipf-distributed token stream over a fixed vocab, same API
+including the NGRAM/SEQ data types and the ``<s>``/``<e>``/``<unk>``
+markers."""
+
+import numpy as np
+
+__all__ = ["train", "test", "build_dict", "DataType"]
+
+VOCAB = 2000
+N_TRAIN_SENTENCES = 2000
+N_TEST_SENTENCES = 400
+
+
+class DataType:
+    NGRAM = 1
+    SEQ = 2
+
+
+def build_dict(min_word_freq=50):
+    """word → id; ids 0..VOCAB-1 are words, plus <s>, <e>, <unk>."""
+    d = {("w%d" % i): i for i in range(VOCAB)}
+    d["<s>"] = len(d)
+    d["<e>"] = len(d)
+    d["<unk>"] = len(d)
+    return d
+
+
+def _sentences(split, n_sent):
+    seed = 20 if split == "train" else 21
+    r = np.random.RandomState(seed)
+    for _ in range(n_sent):
+        n = int(r.randint(5, 30))
+        # Zipf-ish frequencies, like real text
+        ids = (r.zipf(1.3, size=n) - 1) % VOCAB
+        yield [int(v) for v in ids]
+
+
+def _reader_creator(split, n_sent, word_idx, n, data_type):
+    def reader():
+        s_id, e_id = word_idx["<s>"], word_idx["<e>"]
+        for sent in _sentences(split, n_sent):
+            ids = [s_id] + sent + [e_id]
+            if data_type == DataType.NGRAM:
+                if len(ids) < n:
+                    continue
+                for i in range(n, len(ids) + 1):
+                    yield tuple(ids[i - n:i])
+            elif data_type == DataType.SEQ:
+                yield ids[:-1], ids[1:]
+            else:
+                raise ValueError("unknown data_type %r" % (data_type,))
+
+    return reader
+
+
+def train(word_idx, n, data_type=DataType.NGRAM):
+    return _reader_creator("train", N_TRAIN_SENTENCES, word_idx, n,
+                           data_type)
+
+
+def test(word_idx, n, data_type=DataType.NGRAM):
+    return _reader_creator("test", N_TEST_SENTENCES, word_idx, n,
+                           data_type)

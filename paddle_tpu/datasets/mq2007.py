@@ -1,0 +1,60 @@
+"""MQ2007 learning-to-rank readers (reference:
+``python/paddle/dataset/mq2007.py`` — LETOR query/doc lists with 46
+features and 0-2 relevance labels, served in pointwise / pairwise /
+listwise / plain_txt formats).  Synthetic surrogate (zero-egress image):
+queries of 5-40 docs whose relevance correlates with a planted linear
+direction in feature space — so ranking models actually learn — same
+four output formats."""
+
+import numpy as np
+
+__all__ = ["train", "test"]
+
+N_FEATURES = 46
+N_TRAIN_QUERIES = 120
+N_TEST_QUERIES = 40
+
+
+def _querylists(split, n_queries):
+    seed = 30 if split == "train" else 31
+    r = np.random.RandomState(seed)
+    w = np.random.RandomState(7).randn(N_FEATURES)
+    for qid in range(n_queries):
+        n_docs = int(r.randint(5, 40))
+        feats = r.randn(n_docs, N_FEATURES).astype("float32")
+        score = feats @ w + 0.5 * r.randn(n_docs)
+        # 3-way relevance by score tercile (labels 0/1/2, like LETOR)
+        ranks = np.argsort(np.argsort(score))
+        label = (3 * ranks // n_docs).astype("int64")
+        yield qid, label, feats
+
+
+def _reader(split, n_queries, format="pairwise"):
+    def reader():
+        for qid, label, feats in _querylists(split, n_queries):
+            if format == "plain_txt":
+                for l, f in zip(label, feats):
+                    yield qid, int(l), [float(v) for v in f]
+            elif format == "pointwise":
+                for l, f in zip(label, feats):
+                    yield int(l), f
+            elif format == "pairwise":
+                # all ordered pairs with differing relevance
+                for i in range(len(label)):
+                    for j in range(len(label)):
+                        if label[i] > label[j]:
+                            yield 1, feats[i], feats[j]
+            elif format == "listwise":
+                yield [int(l) for l in label], feats
+            else:
+                raise ValueError("unknown format %r" % (format,))
+
+    return reader
+
+
+def train(format="pairwise"):
+    return _reader("train", N_TRAIN_QUERIES, format)
+
+
+def test(format="pairwise"):
+    return _reader("test", N_TEST_QUERIES, format)

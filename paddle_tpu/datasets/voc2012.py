@@ -1,0 +1,50 @@
+"""VOC2012 segmentation readers (reference:
+``python/paddle/dataset/voc2012.py`` — ``train()``/``test()``/``val()``
+yielding (HWC uint8 image, HW uint8 class-index label) pairs from the
+VOC tarball).  Synthetic surrogate (zero-egress image): composed scenes
+of colored rectangles whose pixel-exact masks form the label — shapes
+vary per sample, 21 classes (background + 20), like the original."""
+
+import numpy as np
+
+__all__ = ["train", "test", "val"]
+
+N_CLASSES = 21
+N_TRAIN, N_TEST, N_VAL = 160, 40, 40
+
+
+def _scene(r):
+    h = int(r.randint(96, 160))
+    w = int(r.randint(96, 160))
+    img = np.full((h, w, 3), 128, np.uint8)
+    label = np.zeros((h, w), np.uint8)
+    for _ in range(int(r.randint(1, 5))):
+        cls = int(r.randint(1, N_CLASSES))
+        y0, x0 = int(r.randint(0, h - 16)), int(r.randint(0, w - 16))
+        bh = int(r.randint(8, min(64, h - y0)))
+        bw = int(r.randint(8, min(64, w - x0)))
+        color = r.randint(0, 256, 3).astype(np.uint8)
+        img[y0:y0 + bh, x0:x0 + bw] = color
+        label[y0:y0 + bh, x0:x0 + bw] = cls
+    return img, label
+
+
+def _reader(seed, n):
+    def reader():
+        r = np.random.RandomState(seed)
+        for _ in range(n):
+            yield _scene(r)
+
+    return reader
+
+
+def train():
+    return _reader(40, N_TRAIN)
+
+
+def test():
+    return _reader(41, N_TEST)
+
+
+def val():
+    return _reader(42, N_VAL)

@@ -26,6 +26,14 @@ index bookkeeping of the RPC implementation is replaced by the masked
 sum, which is the collective-friendly formulation); (4) selected
 entries clear from the residual/momentum, unselected entries stay local
 (error feedback).
+
+State contract: ``residual``/``momentum`` are PER-WORKER state.  When
+they cross the shard_map boundary they must be carried SHARDED over the
+data axis (global shape [n·size], in/out specs ``P('data')``) — never
+declared replicated: each worker's values genuinely differ, and a
+replicated annotation would let any resharding/materialization collapse
+all workers' unsent-gradient memory onto one device's copy, silently
+breaking error feedback.
 """
 
 import jax

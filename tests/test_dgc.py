@@ -89,14 +89,16 @@ class TestDGC:
                 sparsity=0.99, momentum_coef=0.9)
             return w2, s2
 
+        # per the dgc.py state contract: residual/momentum are per-worker
+        # and ride the shard_map boundary SHARDED on the data axis
         sharded = shard_map(
             step, mesh=mesh,
-            in_specs=(P(), (P(), P()), P("data"), P("data")),
-            out_specs=(P(), (P(), P())), check_vma=False)
+            in_specs=(P(), (P("data"), P("data")), P("data"), P("data")),
+            out_specs=(P(), (P("data"), P("data"))), check_vma=False)
         stepj = jax.jit(sharded)
 
         w = jnp.zeros(dim)
-        state = (jnp.zeros(dim), jnp.zeros(dim))
+        state = (jnp.zeros(N * dim), jnp.zeros(N * dim))
         Xd = jnp.asarray(X)
         yd = jnp.asarray(y)
         err0 = float(jnp.linalg.norm(Xd @ w - yd))

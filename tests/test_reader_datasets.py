@@ -172,3 +172,63 @@ def test_new_canned_datasets_shapes():
 
     ids, lab = next(datasets.sentiment.train()())
     assert lab in (0, 1) and len(ids) > 0
+
+
+class TestDatasetTail:
+    """Round-3 dataset-module tail: imikolov, mq2007, voc2012, image —
+    full paddle.dataset parity."""
+
+    def test_imikolov_ngram_and_seq(self):
+        word_idx = datasets.imikolov.build_dict()
+        grams = list(datasets.imikolov.train(word_idx, 5)())
+        assert len(grams) > 100
+        assert all(len(g) == 5 for g in grams[:20])
+        seqs = list(datasets.imikolov.test(
+            word_idx, 5, datasets.imikolov.DataType.SEQ)())
+        src, tgt = seqs[0]
+        assert len(src) == len(tgt)
+        assert src[1:] == tgt[:-1]
+        assert src[0] == word_idx["<s>"] and tgt[-1] == word_idx["<e>"]
+
+    def test_mq2007_formats(self):
+        pairs = list(datasets.mq2007.train("pairwise")())
+        assert len(pairs) > 100
+        lab, a, b = pairs[0]
+        assert lab == 1 and a.shape == (46,) and b.shape == (46,)
+        points = list(datasets.mq2007.test("pointwise")())
+        assert {p[0] for p in points} <= {0, 1, 2}
+        lists = list(datasets.mq2007.test("listwise")())
+        labels, feats = lists[0]
+        assert feats.shape == (len(labels), 46)
+
+    def test_voc2012(self):
+        img, label = next(datasets.voc2012.train()())
+        assert img.ndim == 3 and img.shape[2] == 3
+        assert label.shape == img.shape[:2]
+        assert img.dtype == np.uint8 and label.dtype == np.uint8
+        assert label.max() < 21
+        # val/test distinct streams
+        v = next(datasets.voc2012.val()())
+        assert v[0].shape != img.shape or not np.array_equal(v[0], img)
+
+    def test_image_transform_pipeline(self):
+        from paddle_tpu.datasets import image as img_mod
+
+        im = np.random.RandomState(0).randint(
+            0, 256, (120, 90, 3)).astype(np.uint8)
+        r = img_mod.resize_short(im, 64)
+        assert min(r.shape[:2]) == 64
+        c = img_mod.center_crop(r, 56)
+        assert c.shape[:2] == (56, 56)
+        out = img_mod.simple_transform(im, 64, 56, is_train=True,
+                                       mean=[1.0, 2.0, 3.0])
+        assert out.shape == (3, 56, 56) and out.dtype == np.float32
+        f = img_mod.left_right_flip(c)
+        assert np.array_equal(f[:, ::-1], c)
+        # bytes round-trip through a real PNG encode
+        import io
+        from PIL import Image
+        buf = io.BytesIO()
+        Image.fromarray(im).save(buf, format="PNG")
+        back = img_mod.load_image_bytes(buf.getvalue())
+        assert np.array_equal(back, im)

@@ -47,6 +47,10 @@ MODULES = [
     "paddle_tpu.datasets.movielens",
     "paddle_tpu.datasets.sentiment",
     "paddle_tpu.datasets.common",
+    "paddle_tpu.datasets.imikolov",
+    "paddle_tpu.datasets.mq2007",
+    "paddle_tpu.datasets.voc2012",
+    "paddle_tpu.datasets.image",
     "paddle_tpu.reader_decorators",
     "paddle_tpu.data_feeder",
     "paddle_tpu.reader",
