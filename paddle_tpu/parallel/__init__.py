@@ -141,3 +141,7 @@ __all__ += ["ulysses_attention", "ulysses_attention_local"]
 from .dgc import dgc_exchange, dgc_momentum_step  # noqa: E402,F401
 
 __all__ += ["dgc_exchange", "dgc_momentum_step"]
+
+from .moe import moe_ffn, moe_ffn_local, init_moe_params  # noqa: E402,F401
+
+__all__ += ["moe_ffn", "moe_ffn_local", "init_moe_params"]

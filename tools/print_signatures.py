@@ -77,10 +77,15 @@ MODULES = [
 
 
 def _signature_of(obj):
+    import re
+
     try:
-        return str(inspect.signature(obj))
+        sig = str(inspect.signature(obj))
     except (ValueError, TypeError):
         return "(...)"
+    # object-default reprs embed a per-process address — strip it so the
+    # frozen spec is stable (e.g. activation=<function gelu at 0x..>)
+    return re.sub(r"(<[\w.]+ [\w.<>]+) at 0x[0-9a-f]+>", r"\1>", sig)
 
 
 def iter_api():
