@@ -1,3 +1,4 @@
 from . import fleet
+from . import data_generator
 
-__all__ = ["fleet"]
+__all__ = ["fleet", "data_generator"]

@@ -47,8 +47,8 @@ def _dispatch_tensors(x, gates, n_experts, capacity):
     """Build the [E, C, D] dispatch buffer + combine weights.
 
     x: [T, D] local tokens; gates: [T, E] softmax probs.
-    Returns (dispatched [E, C, D], combine [T], expert_idx [T],
-    slot_idx [T], kept [T] bool)."""
+    Returns (dispatched [E, C, D], combine weights [T], expert_idx [T],
+    slot_idx [T], kept [T] bool, onehot [T, E] int32)."""
     expert_idx = jnp.argmax(gates, axis=-1)                      # [T]
     gate_val = jnp.take_along_axis(
         gates, expert_idx[:, None], axis=-1)[:, 0]               # [T]
@@ -62,9 +62,11 @@ def _dispatch_tensors(x, gates, n_experts, capacity):
     # scatter tokens into [E, C, D]; dropped tokens target (0, C) → OOB
     e_t = jnp.where(kept, expert_idx, 0)
     s_t = jnp.where(kept, slot_idx, capacity)
+    # dropped tokens target slot index `capacity` → out of bounds →
+    # mode="drop" discards the whole update; no value masking needed
     dispatched = jnp.zeros(
         (n_experts, capacity, x.shape[-1]), x.dtype
-    ).at[e_t, s_t].set(jnp.where(kept[:, None], x, 0.0), mode="drop")
+    ).at[e_t, s_t].set(x, mode="drop")
     return dispatched, gate_val, e_t, s_t, kept, onehot
 
 

@@ -56,6 +56,7 @@ MODULES = [
     "paddle_tpu.reader",
     "paddle_tpu.unique_name",
     "paddle_tpu.param_attr",
+    "paddle_tpu.incubate.data_generator",
     "paddle_tpu.incubate.fleet.base.role_maker",
     "paddle_tpu.incubate.fleet.base.fleet_base",
     "paddle_tpu.incubate.fleet.collective",
