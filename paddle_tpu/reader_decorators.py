@@ -17,6 +17,7 @@ __all__ = [
     "cache", "map_readers", "shuffle", "chain", "compose", "buffered",
     "firstn", "xmap_readers", "batch", "ComposeNotAligned",
     "multiprocess_reader", "Fake", "PipeReader",
+    "np_array", "text_file", "recordio",
 ]
 
 
@@ -425,3 +426,55 @@ class PipeReader:
                 break
         if remained:
             yield remained
+
+
+# ---------------------------------------------------------------------------
+# reader creators (reference: python/paddle/reader/creator.py)
+# ---------------------------------------------------------------------------
+
+
+def np_array(x):
+    """Creator from a numpy array: yields one row per sample
+    (reference creator.py:22)."""
+    import numpy as _np
+
+    x = _np.asarray(x)
+    if x.ndim < 1:
+        raise ValueError("np_array needs at least a 1-D array")
+
+    def reader():
+        for row in x:
+            yield row
+
+    return reader
+
+
+def text_file(path):
+    """Creator yielding stripped lines of a text file
+    (reference creator.py:42)."""
+
+    def reader():
+        with open(path) as f:
+            for line in f:
+                yield line.rstrip("\n")
+
+    return reader
+
+
+def recordio(paths, buf_size=100):
+    """Creator over RecordIO file(s) (reference creator.py:63 reads via
+    the recordio client); here the native-or-python reader from
+    recordio_writer.  Accepts a path, comma-joined paths, or a list."""
+    if isinstance(paths, str):
+        paths = paths.split(",")
+
+    def reader():
+        from .recordio_writer import recordio_reader
+
+        for p in paths:
+            for rec in recordio_reader(p)():
+                yield rec
+
+    # reference parity: reads are prefetched through the buffered
+    # decorator with the caller's buf_size
+    return buffered(reader, buf_size)

@@ -232,3 +232,35 @@ class TestDatasetTail:
         Image.fromarray(im).save(buf, format="PNG")
         back = img_mod.load_image_bytes(buf.getvalue())
         assert np.array_equal(back, im)
+
+
+class TestReaderCreators:
+    """reference python/paddle/reader/creator.py parity."""
+
+    def test_np_array(self):
+        x = np.arange(12).reshape(4, 3)
+        got = list(rd.np_array(x)())
+        assert len(got) == 4
+        np.testing.assert_array_equal(got[2], [6, 7, 8])
+
+    def test_text_file(self, tmp_path):
+        p = tmp_path / "t.txt"
+        p.write_text("a 1\nb 2\n")
+        assert list(rd.text_file(str(p))()) == ["a 1", "b 2"]
+
+    def test_recordio(self, tmp_path):
+        from paddle_tpu.recordio_writer import (
+            convert_reader_to_recordio_file)
+
+        p = str(tmp_path / "r.recordio")
+
+        def src():
+            for i in range(3):
+                yield np.full((2,), i, "float32"), i
+
+        n = convert_reader_to_recordio_file(p, src)
+        assert n == 3
+        got = list(rd.recordio(p)())
+        assert len(got) == 3
+        np.testing.assert_array_equal(got[1][0], [1.0, 1.0])
+        assert got[2][1] == 2
