@@ -1018,3 +1018,62 @@ def fused_multihead_attention(q, k, v, bias=None, causal=False, scale=None,
         attrs=attrs,
     )
     return out
+
+
+# Reference parity: the reference keeps all of these names in ONE
+# layers/nn.py module, so `from paddle.fluid.layers.nn import X` works
+# for every entry.  This repo splits the implementation across
+# nn_extra/nn_extra2 for file size; re-exporting them here restores the
+# single-module import surface (nn_extra* import nothing from this
+# module, so the late import is cycle-free).
+#
+# CAUTION for future edits to THIS module: the star-imports below bind
+# layer ops over the builtins `sum` and `hash` (reference nn exports
+# both).  Code added to nn.py after this point must not call those
+# builtins unqualified — use builtins.sum / builtins.hash.
+from .nn_extra import *  # noqa: E402,F401,F403
+from .nn_extra2 import *  # noqa: E402,F401,F403
+from .nn_extra import __all__ as _extra_all
+from .nn_extra2 import __all__ as _extra2_all
+
+__all__ = list(__all__) + list(_extra_all) + list(_extra2_all)
+
+
+def _reexport_reference_nn_names():
+    """The reference nn.py also hosts the sequence/rnn/beam/unary-op
+    layer names; pull EXACTLY the reference-nn names this repo homes
+    elsewhere into this module so `from ...layers.nn import X` covers
+    the full reference nn __all__ (169 names).  The list is curated —
+    a blanket re-export of those modules' __all__ would also drag in
+    names like `abs` that shadow builtins this module's own code uses."""
+    import sys
+
+    from . import beam, detection, ops, sequence
+
+    # ONLY names absent after the nn_extra star-imports above (names
+    # those already bind — selu, sum, rank, roi_pool, lstm, ... — are
+    # deliberately not listed; the hasattr guard is belt-and-braces)
+    wanted = [
+        "sequence_pool", "sequence_softmax", "sequence_expand",
+        "sequence_pad", "sequence_unpad", "sequence_first_step",
+        "sequence_last_step", "sequence_slice", "sequence_mask",
+        "sequence_enumerate", "sequence_concat", "sequence_reverse",
+        "beam_search", "beam_search_decode",
+        "dynamic_lstm", "dynamic_gru",
+        "roi_align",
+        "log", "pow", "scale", "sign", "elu", "relu6", "stanh",
+        "hard_sigmoid", "swish", "brelu", "soft_relu",
+        "logical_and", "logical_or", "logical_xor", "logical_not",
+    ]
+    here = sys.modules[__name__]
+    for name in wanted:
+        if hasattr(here, name):
+            continue
+        for mod in (beam, detection, ops, sequence):
+            if hasattr(mod, name):
+                setattr(here, name, getattr(mod, name))
+                __all__.append(name)
+                break
+
+
+_reexport_reference_nn_names()
