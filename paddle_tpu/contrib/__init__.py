@@ -1,7 +1,19 @@
 """Contrib surface (reference: ``python/paddle/fluid/contrib/``):
-mixed_precision AMP, slim (quant/prune/NAS), extend optimizers."""
+mixed_precision AMP, slim (quant/prune/NAS), extend_optimizer
+(decoupled weight decay), memory/op-frequency diagnostics, fused
+layers.  Not ported: decoder/ (the beam_search_decoder DSL — its
+capability lives in layers.beam_search + DynamicRNN), reader/ and
+utils/ (PS-era ctr/hdfs plumbing subsumed by datasets + the sharded
+table path)."""
 
 from . import mixed_precision
 from . import slim
+from . import extend_optimizer
+from . import layers
+from .memory_usage_calc import memory_usage
+from .op_frequence import op_freq_statistic
+from .extend_optimizer import extend_with_decoupled_weight_decay
 
-__all__ = ["mixed_precision", "slim"]
+__all__ = ["mixed_precision", "slim", "extend_optimizer", "layers",
+           "memory_usage", "op_freq_statistic",
+           "extend_with_decoupled_weight_decay"]

@@ -1,0 +1,87 @@
+"""Decoupled weight decay (reference:
+``python/paddle/fluid/contrib/extend_optimizer/
+extend_optimizer_with_weight_decay.py``): mixes a decay step into any
+optimizer — params are scaled down by ``coeff`` via in-graph ops
+appended BEFORE the optimizer update (the AdamW-style decoupling: decay
+is not part of the gradient, so adaptive scaling never touches it).
+
+TPU note: the decay ops (scale → sub → assign) land in the same jitted
+step as the update, so XLA fuses them into the (fused-)Adam stream —
+the decoupling costs no extra HBM pass."""
+
+from ...framework import Variable
+
+__all__ = ["extend_with_decoupled_weight_decay"]
+
+
+class DecoupledWeightDecay:
+    def __init__(self, coeff=0.0, apply_decay_param_fun=None, **kwargs):
+        if not isinstance(coeff, (float, int)) and \
+                not isinstance(coeff, Variable):
+            raise TypeError("coeff should be float or Variable")
+        self._coeff = coeff
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._decayed_params = set()
+        super().__init__(**kwargs)
+
+    def _append_decay_ops(self, params_grads):
+        from ... import layers
+
+        if isinstance(self._coeff, (float, int)) and \
+                float(self._coeff) == 0.0:
+            return
+        for param, grad in params_grads:
+            if grad is None:
+                continue
+            if self._apply_decay_param_fun is not None and \
+                    not self._apply_decay_param_fun(param.name):
+                continue
+            if param.name in self._decayed_params:
+                raise RuntimeError(
+                    "param %r already decayed by this optimizer"
+                    % param.name)
+            self._decayed_params.add(param.name)
+            if isinstance(self._coeff, Variable):
+                scaled = layers.elementwise_mul(param, self._coeff)
+            else:
+                scaled = layers.scale(param, scale=float(self._coeff))
+            updated = layers.elementwise_sub(param, scaled)
+            layers.assign(updated, output=param)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        params_grads = self.backward(
+            loss, startup_program=startup_program,
+            parameter_list=parameter_list, no_grad_set=no_grad_set)
+        # decay ops precede the optimizer ops in program order, so the
+        # update reads the already-decayed param (reference order)
+        self._append_decay_ops(params_grads)
+        optimize_ops = self.apply_optimize(
+            loss, startup_program, params_grads)
+        return optimize_ops, params_grads
+
+    def __str__(self):
+        return "Weight Decay, params: %s" % ",".join(
+            sorted(self._decayed_params))
+
+
+def extend_with_decoupled_weight_decay(base_optimizer):
+    """Returns a subclass of ``base_optimizer`` whose minimize applies
+    decoupled weight decay (reference :102).  Usage::
+
+        AdamW = extend_with_decoupled_weight_decay(fluid.optimizer.Adam)
+        AdamW(weight_decay=0.01, learning_rate=1e-3).minimize(loss)
+    """
+    from ...optimizer import Optimizer
+
+    if not issubclass(base_optimizer, Optimizer):
+        raise TypeError("base_optimizer must be an Optimizer subclass")
+
+    class OptimizerWithDecoupledWeightDecay(DecoupledWeightDecay,
+                                            base_optimizer):
+        def __init__(self, weight_decay, apply_decay_param_fun=None,
+                     **kwargs):
+            super().__init__(weight_decay, apply_decay_param_fun,
+                             **kwargs)
+
+    return OptimizerWithDecoupledWeightDecay

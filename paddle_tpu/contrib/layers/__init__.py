@@ -1,0 +1,3 @@
+from .nn import fused_elemwise_activation  # noqa: F401
+
+__all__ = ["fused_elemwise_activation"]

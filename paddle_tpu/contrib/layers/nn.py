@@ -1,0 +1,49 @@
+"""contrib layers (reference:
+``python/paddle/fluid/contrib/layers/nn.py`` — fused_elemwise_activation,
+a hand-fused elementwise+activation kernel).
+
+TPU-native: XLA fuses elementwise chains automatically, so the layer
+simply emits the composed ops — same API, and the fusion the reference
+hand-wrote falls out of the compiler."""
+
+from ... import layers
+
+__all__ = ["fused_elemwise_activation"]
+
+_UNARY = {
+    "relu": layers.relu,
+    "sigmoid": lambda x: layers.sigmoid(x),
+    "tanh": lambda x: layers.tanh(x),
+    "scale": layers.scale,
+}
+
+_BINARY = {
+    "elementwise_add": layers.elementwise_add,
+    "elementwise_sub": layers.elementwise_sub,
+    "elementwise_mul": layers.elementwise_mul,
+}
+
+
+def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
+                              save_intermediate_out=True):
+    """Compose f1(f2(x, y)) or f2(x, f1(y)) per the reference contract:
+    functor_list is [unary, binary] or [binary, unary]."""
+    if not isinstance(functor_list, (list, tuple)) or \
+            len(functor_list) != 2:
+        raise ValueError("functor_list must hold exactly two functors")
+    a, b = functor_list
+    if a in _BINARY and b in _UNARY:
+        # binary first then unary: f_u(f_b(x, y))
+        mid = _BINARY[a](x, y, axis=axis) if a != "scale" else None
+        out = (_UNARY[b](mid, scale=scale) if b == "scale"
+               else _UNARY[b](mid))
+    elif a in _UNARY and b in _BINARY:
+        # unary applied to y first: f_b(x, f_u(y))
+        uy = (_UNARY[a](y, scale=scale) if a == "scale"
+              else _UNARY[a](y))
+        out = _BINARY[b](x, uy, axis=axis)
+    else:
+        raise ValueError(
+            "functor_list %r must pair one of %s with one of %s"
+            % (functor_list, sorted(_BINARY), sorted(_UNARY)))
+    return out

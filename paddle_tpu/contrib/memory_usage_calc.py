@@ -1,0 +1,66 @@
+"""Estimate a program's training memory footprint (reference:
+``python/paddle/fluid/contrib/memory_usage_calc.py`` — sums var bytes
+over the main block, scaling -1 dims by batch_size).
+
+TPU-native bounds: the LOWER bound counts each op-output var once
+(XLA's fusion + buffer reuse means transient elementwise intermediates
+mostly never materialize — closer to reality on TPU than on the
+reference's CUDA allocator); the UPPER bound multiplies by 1.7 to cover
+XLA's scratch/padding/donation slack, in place of the reference's
+empirical 1.5x DEBUG factor.  Same return contract:
+``(lower, upper, unit_str)``."""
+
+import numpy as np
+
+from ..framework import Program
+
+__all__ = ["memory_usage"]
+
+_DTYPE_BYTES = {
+    "float16": 2, "bfloat16": 2, "float32": 4, "float64": 8,
+    "int8": 1, "uint8": 1, "int16": 2, "int32": 4, "int64": 8,
+    "bool": 1,
+}
+
+_UNITS = ["B", "KB", "MB", "GB"]
+
+
+def memory_usage(program, batch_size):
+    """Returns (lower, upper, unit) estimated for one training step."""
+    if not isinstance(program, Program):
+        raise TypeError(
+            "memory_usage requires a Program, got %s" % (type(program),))
+    if batch_size <= 0:
+        raise ValueError("batch_size must be positive")
+
+    total = 0.0
+    seen = {"@EMPTY@"}
+    block = program.global_block()
+    for op in block.ops:
+        for name in op.output_arg_names:
+            if not name or name in seen:
+                continue
+            seen.add(name)
+            var = block.vars.get(name)
+            if var is None or var.shape is None:
+                continue
+            count = 1
+            neg = 0
+            for d in var.shape:
+                if d is None or d < 0:
+                    neg += 1
+                    if neg > 1:
+                        raise ValueError(
+                            "var %r has more than one dynamic dim" % name)
+                    count *= batch_size * max(1, -int(d or -1))
+                else:
+                    count *= int(d)
+            total += count * _DTYPE_BYTES.get(str(var.dtype), 4)
+
+    lower, upper = total, total * 1.7
+    unit = 0
+    while upper >= 1024.0 and unit < len(_UNITS) - 1:
+        lower /= 1024.0
+        upper /= 1024.0
+        unit += 1
+    return lower, upper, _UNITS[unit]

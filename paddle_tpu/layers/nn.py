@@ -1029,8 +1029,9 @@ def fused_multihead_attention(q, k, v, bias=None, causal=False, scale=None,
 #
 # CAUTION for future edits to THIS module: the star-imports below bind
 # layer ops over the builtins `sum` and `hash` (reference nn exports
-# both).  Code added to nn.py after this point must not call those
-# builtins unqualified — use builtins.sum / builtins.hash.
+# both).  Globals resolve at CALL time, so code ANYWHERE in this module
+# (before or after this point) must not call those builtins
+# unqualified — use builtins.sum / builtins.hash.
 from .nn_extra import *  # noqa: E402,F401,F403
 from .nn_extra2 import *  # noqa: E402,F401,F403
 from .nn_extra import __all__ as _extra_all

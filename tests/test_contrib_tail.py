@@ -1,0 +1,126 @@
+"""contrib tail: memory_usage, op_freq_statistic, decoupled weight
+decay (AdamW), fused_elemwise_activation."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _small_program():
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, size=16, act="relu")
+        pred = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+    return main, startup, loss
+
+
+class TestContribTail:
+    def test_memory_usage(self):
+        main, _, loss = _small_program()
+        lo, hi, unit = fluid.contrib.memory_usage(main, batch_size=32)
+        assert unit in ("B", "KB", "MB", "GB")
+        assert 0 < lo < hi
+        lo2, hi2, unit2 = fluid.contrib.memory_usage(main, batch_size=64)
+        # bigger batch → no smaller estimate (same-or-larger unit scale)
+        assert (unit2 != unit) or hi2 > hi
+        with pytest.raises(ValueError):
+            fluid.contrib.memory_usage(main, 0)
+        with pytest.raises(TypeError):
+            fluid.contrib.memory_usage("nope", 1)
+
+    def test_op_freq_statistic(self):
+        main, _, loss = _small_program()
+        uni, adj = fluid.contrib.op_freq_statistic(main)
+        assert uni["mul"] == 2
+        counts = list(uni.values())
+        assert counts == sorted(counts, reverse=True)
+        # fc chain: mul feeds elementwise_add (bias)
+        assert any(k.startswith("mul,") for k in adj)
+
+    def test_decoupled_weight_decay_adamw(self):
+        AdamW = fluid.contrib.extend_with_decoupled_weight_decay(
+            fluid.optimizer.Adam)
+
+        def build(use_wd):
+            fluid.unique_name.switch()
+            main, startup = fluid.Program(), fluid.Program()
+            main.random_seed = startup.random_seed = 5
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data("x", shape=[4], dtype="float32")
+                y = fluid.layers.data("y", shape=[1], dtype="float32")
+                pred = fluid.layers.fc(x, size=1, bias_attr=False)
+                loss = fluid.layers.mean(
+                    fluid.layers.square_error_cost(pred, y))
+                if use_wd:
+                    opt = AdamW(weight_decay=0.1, learning_rate=0.0)
+                else:
+                    opt = fluid.optimizer.Adam(learning_rate=0.0)
+                opt.minimize(loss)
+            return main, startup
+
+        # with lr=0 the ONLY param change is the decay: w <- w * (1-coeff)
+        from paddle_tpu.executor import Scope, scope_guard
+        feed = {"x": np.ones((4, 4), "float32"),
+                "y": np.zeros((4, 1), "float32")}
+        results = {}
+        for use_wd in (False, True):
+            main, startup = build(use_wd)
+            exe = fluid.Executor(fluid.CPUPlace())
+            scope = Scope()
+            with scope_guard(scope):
+                exe.run(startup)
+                w0 = np.asarray(scope.get("fc_0.w_0")).copy()
+                exe.run(main, feed=feed, fetch_list=[])
+                w1 = np.asarray(scope.get("fc_0.w_0"))
+            results[use_wd] = (w0, w1)
+        w0, w1 = results[False]
+        np.testing.assert_allclose(w1, w0, atol=1e-7)  # no decay, lr=0
+        w0, w1 = results[True]
+        np.testing.assert_allclose(w1, w0 * 0.9, rtol=1e-6)
+
+        # apply_decay_param_fun filters params
+        fluid.unique_name.switch()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="float32")
+            pred = fluid.layers.fc(x, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            AdamW(weight_decay=0.1, learning_rate=0.0,
+                  apply_decay_param_fun=lambda n: "w" in n
+                  ).minimize(loss)
+
+    def test_fused_elemwise_activation(self):
+        from paddle_tpu.executor import Scope, scope_guard
+
+        fluid.unique_name.switch()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            a = fluid.layers.data("a", shape=[6], dtype="float32")
+            b = fluid.layers.data("b", shape=[6], dtype="float32")
+            out1 = fluid.contrib.layers.fused_elemwise_activation(
+                a, b, ["elementwise_add", "relu"])
+            out2 = fluid.contrib.layers.fused_elemwise_activation(
+                a, b, ["tanh", "elementwise_mul"])
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = Scope()
+        rng = np.random.RandomState(0)
+        av = rng.randn(3, 6).astype("float32")
+        bv = rng.randn(3, 6).astype("float32")
+        with scope_guard(scope):
+            exe.run(startup)
+            o1, o2 = exe.run(main, feed={"a": av, "b": bv},
+                             fetch_list=[out1, out2])
+        np.testing.assert_allclose(o1, np.maximum(av + bv, 0), rtol=1e-6)
+        np.testing.assert_allclose(o2, av * np.tanh(bv), rtol=1e-6)
+        with pytest.raises(ValueError):
+            fluid.contrib.layers.fused_elemwise_activation(
+                a, b, ["relu"])

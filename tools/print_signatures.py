@@ -32,6 +32,10 @@ MODULES = [
     "paddle_tpu.transpiler",
     "paddle_tpu.dygraph",
     "paddle_tpu.contrib.mixed_precision",
+    "paddle_tpu.contrib.extend_optimizer",
+    "paddle_tpu.contrib.layers",
+    "paddle_tpu.contrib.memory_usage_calc",
+    "paddle_tpu.contrib.op_frequence",
     "paddle_tpu.contrib.slim.quantization",
     "paddle_tpu.contrib.slim.prune",
     "paddle_tpu.contrib.slim.distillation",
