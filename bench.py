@@ -33,7 +33,10 @@ V5E_BF16_PEAK = 197e12  # TPU v5e per-chip bf16 peak FLOP/s
 FLAGSHIP_METRIC = "bert_base_mlm_train_tokens_per_sec_per_chip"
 
 PROBE_TIMEOUT_S = 120
-TOTAL_BUDGET_S = 2100  # hard ceiling on orchestrator wall time
+# Hard ceiling on orchestrator wall time, chosen so the WORST case (every
+# child burning its full cap) still finishes inside a ~25-minute driver
+# kill window (the round-2 driver killed at ~25 min)
+TOTAL_BUDGET_S = 1380
 
 
 def model_train_flops_per_token(cfg, seq_len):
@@ -284,22 +287,24 @@ def main():
     probe = next((l for l in lines if l.get("probe") == "ok"), None)
     on_tpu = bool(probe) and _is_tpu_platform(probe.get("platform", ""))
 
-    flagship_line = None
-    extra_lines = []
+    flagship_printed = False
 
     if on_tpu:
-        # flagship seq128 runs BEFORE the secondary seq512 line so budget
-        # exhaustion can never zero the headline metric (printed last anyway)
-        plan = [("resnet", 600), ("bert", 700), ("bert512", 700)]
+        # Every completed line prints IMMEDIATELY — a driver-side kill
+        # mid-run must not lose finished results (lesson of the round-2
+        # 25-minute kill).  The flagship child runs LAST so its line is
+        # also printed last (last-line-wins consumers read the headline
+        # metric), and with these caps the flagship always receives its
+        # full cap even if every earlier child burns its own.
+        plan = [("resnet", 420), ("bert512", 360), ("bert", 420)]
         for mode, cap in plan:
             w_ok, w_lines, w_err = _run_child(mode, remaining(cap))
             if not w_ok:
                 print("# %s bench failed: %s" % (mode, w_err), flush=True)
             for l in w_lines:
+                print(json.dumps(l), flush=True)
                 if l.get("metric") == FLAGSHIP_METRIC:
-                    flagship_line = l
-                else:
-                    extra_lines.append(l)
+                    flagship_printed = True
     else:
         reason = err or "backend probe returned no TPU (platform=%s)" % (
             probe and probe.get("platform"))
@@ -310,26 +315,25 @@ def main():
             env_extra={"PADDLE_BENCH_FORCE_CPU": "1"})
         if not w_ok:
             print("# cpu smoke failed too: %s" % w_err, flush=True)
-        extra_lines.extend(w_lines)
-        flagship_line = {
+        for l in w_lines:
+            print(json.dumps(l), flush=True)
+        print(json.dumps({
             "metric": FLAGSHIP_METRIC,
             "value": 0,
             "unit": "tokens/sec/chip (TPU backend unavailable)",
             "vs_baseline": 0,
             "error": reason,
-        }
+        }), flush=True)
+        flagship_printed = True
 
-    for l in extra_lines:
-        print(json.dumps(l), flush=True)
-    if flagship_line is None:
-        flagship_line = {
+    if not flagship_printed:
+        print(json.dumps({
             "metric": FLAGSHIP_METRIC,
             "value": 0,
             "unit": "tokens/sec/chip (benchmark child failed)",
             "vs_baseline": 0,
             "error": "flagship child produced no line",
-        }
-    print(json.dumps(flagship_line), flush=True)
+        }), flush=True)
     return 0
 
 

@@ -49,7 +49,11 @@ class DecoupledWeightDecay:
             layers.assign(updated, output=param)
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
-                 no_grad_set=None):
+                 no_grad_set=None, grad_clip=None):
+        if grad_clip is not None:
+            from ...clip import set_gradient_clip
+
+            set_gradient_clip(grad_clip)
         params_grads = self.backward(
             loss, startup_program=startup_program,
             parameter_list=parameter_list, no_grad_set=no_grad_set)

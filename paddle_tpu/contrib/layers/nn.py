@@ -26,22 +26,29 @@ _BINARY = {
 
 def fused_elemwise_activation(x, y, functor_list, axis=-1, scale=0.0,
                               save_intermediate_out=True):
-    """Compose f1(f2(x, y)) or f2(x, f1(y)) per the reference contract:
-    functor_list is [unary, binary] or [binary, unary]."""
+    """Reference contract (``fused_elemwise_activation_op.h``:
+    IsBinaryCompound keys on functor_list[0]):
+
+    * ``[binary, unary]`` → Binary(x, Unary(y))
+    * ``[unary, binary]`` → Unary(Binary(x, y))
+
+    A comma-joined string ('elementwise_add,relu') is accepted like the
+    reference."""
+    if isinstance(functor_list, str):
+        functor_list = functor_list.split(",")
     if not isinstance(functor_list, (list, tuple)) or \
             len(functor_list) != 2:
         raise ValueError("functor_list must hold exactly two functors")
-    a, b = functor_list
+    a, b = (f.strip() for f in functor_list)
+
+    def unary(fn_name, v):
+        return (_UNARY[fn_name](v, scale=scale) if fn_name == "scale"
+                else _UNARY[fn_name](v))
+
     if a in _BINARY and b in _UNARY:
-        # binary first then unary: f_u(f_b(x, y))
-        mid = _BINARY[a](x, y, axis=axis) if a != "scale" else None
-        out = (_UNARY[b](mid, scale=scale) if b == "scale"
-               else _UNARY[b](mid))
+        out = _BINARY[a](x, unary(b, y), axis=axis)
     elif a in _UNARY and b in _BINARY:
-        # unary applied to y first: f_b(x, f_u(y))
-        uy = (_UNARY[a](y, scale=scale) if a == "scale"
-              else _UNARY[a](y))
-        out = _BINARY[b](x, uy, axis=axis)
+        out = unary(a, _BINARY[b](x, y, axis=axis))
     else:
         raise ValueError(
             "functor_list %r must pair one of %s with one of %s"

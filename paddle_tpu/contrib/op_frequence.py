@@ -5,18 +5,17 @@ producer→consumer adjacent-pair counts, both sorted descending).
 Used to decide fusion/kernel priorities; on TPU it doubles as a quick
 "what will XLA see" census before profiling."""
 
-from collections import OrderedDict
-
 from ..framework import Program
 
 __all__ = ["op_freq_statistic"]
 
 
 def op_freq_statistic(program):
-    """Returns (uni_op_freq, adj_2_op_freq): descending-sorted
-    OrderedDicts of op-type counts and 'producer,consumer' pair counts
-    (pairs linked through non-parameter dataflow, as in the
-    reference)."""
+    """Returns (uni_op_freq, adj_2_op_freq): descending-sorted LISTS of
+    (key, count) tuples — iterable as ``for op_type, n in uni_op_freq``
+    like the reference docstring shows — with adjacency keys
+    'producer->consumer' (pairs linked through non-parameter
+    dataflow)."""
     if not isinstance(program, Program):
         raise TypeError(
             "op_freq_statistic requires a Program, got %s"
@@ -32,14 +31,12 @@ def op_freq_statistic(program):
         for name in op.input_arg_names:
             src = producer.get(name)
             if src is not None and name not in params:
-                key = "%s,%s" % (src, op.type)
+                key = "%s->%s" % (src, op.type)
                 adj[key] = adj.get(key, 0) + 1
         for name in op.output_arg_names:
             if name and name not in params:
                 producer[name] = op.type
 
-    uni_sorted = OrderedDict(
-        sorted(uni.items(), key=lambda kv: kv[1], reverse=True))
-    adj_sorted = OrderedDict(
-        sorted(adj.items(), key=lambda kv: kv[1], reverse=True))
+    uni_sorted = sorted(uni.items(), key=lambda kv: kv[1], reverse=True)
+    adj_sorted = sorted(adj.items(), key=lambda kv: kv[1], reverse=True)
     return uni_sorted, adj_sorted

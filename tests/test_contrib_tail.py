@@ -38,11 +38,13 @@ class TestContribTail:
     def test_op_freq_statistic(self):
         main, _, loss = _small_program()
         uni, adj = fluid.contrib.op_freq_statistic(main)
-        assert uni["mul"] == 2
-        counts = list(uni.values())
+        # reference iteration contract: lists of (key, count) tuples
+        uni_d = dict(uni)
+        assert uni_d["mul"] == 2
+        counts = [n for _, n in uni]
         assert counts == sorted(counts, reverse=True)
-        # fc chain: mul feeds elementwise_add (bias)
-        assert any(k.startswith("mul,") for k in adj)
+        # fc chain: mul feeds elementwise_add (bias), '->'-keyed
+        assert any(k.startswith("mul->") for k, _ in adj)
 
     def test_decoupled_weight_decay_adamw(self):
         AdamW = fluid.contrib.extend_with_decoupled_weight_decay(
@@ -85,6 +87,18 @@ class TestContribTail:
         w0, w1 = results[True]
         np.testing.assert_allclose(w1, w0 * 0.9, rtol=1e-6)
 
+        # grad_clip passthrough works on the wrapped optimizer
+        fluid.unique_name.switch()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            y = fluid.layers.data("y", shape=[1], dtype="float32")
+            pred = fluid.layers.fc(x, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            AdamW(weight_decay=0.01, learning_rate=1e-3).minimize(
+                loss, grad_clip=fluid.GradientClipByGlobalNorm(1.0))
+
         # apply_decay_param_fun filters params
         fluid.unique_name.switch()
         main, startup = fluid.Program(), fluid.Program()
@@ -106,8 +120,10 @@ class TestContribTail:
         with fluid.program_guard(main, startup):
             a = fluid.layers.data("a", shape=[6], dtype="float32")
             b = fluid.layers.data("b", shape=[6], dtype="float32")
+            # reference semantics: [binary, unary] = Binary(x, Unary(y)),
+            # [unary, binary] = Unary(Binary(x, y)); strings split on ','
             out1 = fluid.contrib.layers.fused_elemwise_activation(
-                a, b, ["elementwise_add", "relu"])
+                a, b, "elementwise_add,relu")
             out2 = fluid.contrib.layers.fused_elemwise_activation(
                 a, b, ["tanh", "elementwise_mul"])
         exe = fluid.Executor(fluid.CPUPlace())
@@ -119,8 +135,8 @@ class TestContribTail:
             exe.run(startup)
             o1, o2 = exe.run(main, feed={"a": av, "b": bv},
                              fetch_list=[out1, out2])
-        np.testing.assert_allclose(o1, np.maximum(av + bv, 0), rtol=1e-6)
-        np.testing.assert_allclose(o2, av * np.tanh(bv), rtol=1e-6)
+        np.testing.assert_allclose(o1, av + np.maximum(bv, 0), rtol=1e-6)
+        np.testing.assert_allclose(o2, np.tanh(av * bv), rtol=1e-6)
         with pytest.raises(ValueError):
             fluid.contrib.layers.fused_elemwise_activation(
                 a, b, ["relu"])
