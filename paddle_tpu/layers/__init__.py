@@ -4,6 +4,7 @@ from . import nn
 from . import nn_extra
 from . import nn_extra2
 from . import io
+from . import layer_function_generator
 from . import tensor
 from . import ops
 from . import control_flow
@@ -20,6 +21,9 @@ from .nn import *  # noqa: F401,F403
 from .nn_extra import *  # noqa: F401,F403
 from .nn_extra2 import *  # noqa: F401,F403
 from .io import *  # noqa: F401,F403
+from .layer_function_generator import (  # noqa: F401
+    deprecated, generate_layer_fn, generate_activation_fn, autodoc,
+    templatedoc)
 from .tensor import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403
 from .control_flow import *  # noqa: F401,F403

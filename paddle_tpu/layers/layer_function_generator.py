@@ -1,0 +1,120 @@
+"""Layer-function codegen helpers (reference:
+``python/paddle/fluid/layers/layer_function_generator.py`` — the
+machinery that stamps out one-op Python layers and their docstrings;
+``layers/ops.py`` is generated with it).
+
+TPU-native: :func:`generate_layer_fn` builds the wrapper from the op
+REGISTRY's OpDef (slots come from the registered lowering, not C++
+OpProto), so any op registered with ``register_op`` gets a layer for
+free — the same one-liner contract the reference uses."""
+
+import functools
+import warnings
+
+from .. import unique_name  # noqa: F401  (parity: referenced by users)
+from ..layer_helper import LayerHelper
+
+__all__ = ["deprecated", "generate_layer_fn", "generate_activation_fn",
+           "autodoc", "templatedoc"]
+
+
+def generate_layer_fn(op_type):
+    """Return a Python layer function for a registered op: inputs become
+    positional/keyword args by slot name, attrs pass via kwargs, and a
+    fresh output var is created per output slot (first slot returned)."""
+    from ..ops.registry import get_op_def
+
+    opdef = get_op_def(op_type)
+    in_slots = [s for s, _ in opdef.inputs]
+    out_slots = [s for s, _ in opdef.outputs]
+
+    def layer_fn(*args, **kwargs):
+        helper = LayerHelper(op_type, **kwargs)
+        if len(args) > len(in_slots):
+            raise TypeError(
+                "%s() takes at most %d positional inputs (%s), got %d"
+                % (op_type, len(in_slots), in_slots, len(args)))
+        inputs = {}
+        for slot, val in zip(in_slots, args):
+            if val is not None:
+                inputs[slot] = val if isinstance(val, list) else [val]
+        for slot in in_slots:
+            if slot in kwargs:
+                if slot in inputs:
+                    raise TypeError(
+                        "%s() got input slot %r both positionally and "
+                        "as a keyword" % (op_type, slot))
+                v = kwargs.pop(slot)
+                if v is not None:
+                    inputs[slot] = v if isinstance(v, list) else [v]
+        kwargs.pop("name", None)
+        dtype = None
+        for vs in inputs.values():
+            if vs and getattr(vs[0], "dtype", None) is not None:
+                dtype = vs[0].dtype
+                break
+        outs = {}
+        out_vars = []
+        for slot in out_slots:
+            v = helper.create_variable_for_type_inference(
+                dtype or "float32")
+            outs[slot] = [v]
+            out_vars.append(v)
+        helper.append_op(type=op_type, inputs=inputs, outputs=outs,
+                         attrs=kwargs)
+        return out_vars[0] if len(out_vars) == 1 else out_vars
+
+    layer_fn.__name__ = op_type
+    layer_fn.__doc__ = "Auto-generated layer for the %r op." % op_type
+    return layer_fn
+
+
+def generate_activation_fn(op_type):
+    """Single-input single-output variant (reference's act-op stamp)."""
+    fn = generate_layer_fn(op_type)
+
+    def act_fn(x, name=None):
+        return fn(X=x, name=name)
+
+    act_fn.__name__ = op_type
+    act_fn.__doc__ = "Auto-generated activation layer for %r." % op_type
+    return act_fn
+
+
+def deprecated(func_or_class):
+    """Warn on use (reference :263)."""
+
+    @functools.wraps(func_or_class)
+    def wrapper(*args, **kwargs):
+        warnings.warn(
+            "API %r is deprecated" % func_or_class.__name__,
+            DeprecationWarning)
+        return func_or_class(*args, **kwargs)
+
+    return wrapper
+
+
+def autodoc(comment=""):
+    """Prepend a comment to the wrapped function's docstring
+    (reference :285)."""
+
+    def impl(func):
+        func.__doc__ = comment + (func.__doc__ or "")
+        return func
+
+    return impl
+
+
+def templatedoc(op_type=None):
+    """Reference fills ${...} docstring slots from the C++ OpProto; the
+    registry has no prose metadata, so this resolves the slots to the
+    op type name — keeping decorated code importable and the decorator
+    API intact."""
+
+    def impl(func):
+        doc = func.__doc__ or ""
+        t = op_type or func.__name__
+        func.__doc__ = doc.replace("${comment}", "the %r op" % t)
+        return func
+
+    return impl

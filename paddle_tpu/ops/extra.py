@@ -311,10 +311,12 @@ def print_op(ctx, attrs, In):
              outputs=["Out", "IntermediateOut"],
              stateful_outputs=("IntermediateOut",))
 def fused_elemwise_activation(ctx, attrs, X, Y):
-    """fused/fused_elemwise_activation_op.cc: functor_list like
-    ['elementwise_add', 'relu'] (binary then unary, or unary then
-    binary)."""
-    from . import activations as acts
+    """fused/fused_elemwise_activation_op.cc — IsBinaryCompound keys on
+    functor_list[0]:
+
+    * [binary, unary] → Binary(X, Unary(Y)), intermediate = Unary(Y)
+    * [unary, binary] → Unary(Binary(X, Y)), intermediate = Binary(X, Y)
+    """
     from .registry import get_op_def
 
     functors = list(attrs.get("functor_list", ["elementwise_add", "relu"]))
@@ -323,14 +325,17 @@ def fused_elemwise_activation(ctx, attrs, X, Y):
     bin_fn = {"elementwise_add": jnp.add, "elementwise_sub": jnp.subtract,
               "elementwise_mul": jnp.multiply}[binary]
     un_def = get_op_def(unary)
+
+    def un(v):
+        r = un_def.fn(ctx, {}, v)
+        return list(r.values())[0] if isinstance(r, dict) else r
+
     if functors[0] == binary:
-        mid = bin_fn(X, Y)
-        out = un_def.fn(ctx, {}, mid)
-    else:
-        mid = un_def.fn(ctx, {}, Y)
+        mid = un(Y)
         out = bin_fn(X, mid)
-    if isinstance(out, dict):
-        out = list(out.values())[0]
+    else:
+        mid = bin_fn(X, Y)
+        out = un(mid)
     return {"Out": out, "IntermediateOut": mid}
 
 

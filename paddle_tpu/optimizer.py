@@ -202,6 +202,23 @@ class Optimizer:
         params_grads = self.backward(
             loss, startup_program, parameter_list, no_grad_set
         )
+        if grad_clip is not None:
+            # per-call clip, registered against the program that OWNS
+            # the loss (not the ambient default) and removed afterwards
+            from . import clip as _clip_mod
+
+            prog_id = id(loss.block.program)
+            prev = _clip_mod._clip_attr.get(prog_id)
+            _clip_mod._clip_attr[prog_id] = grad_clip
+            try:
+                optimize_ops = self.apply_optimize(
+                    loss, startup_program, params_grads)
+            finally:
+                if prev is None:
+                    _clip_mod._clip_attr.pop(prog_id, None)
+                else:
+                    _clip_mod._clip_attr[prog_id] = prev
+            return optimize_ops, params_grads
         optimize_ops = self.apply_optimize(loss, startup_program, params_grads)
         return optimize_ops, params_grads
 

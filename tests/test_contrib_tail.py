@@ -140,3 +140,85 @@ class TestContribTail:
         with pytest.raises(ValueError):
             fluid.contrib.layers.fused_elemwise_activation(
                 a, b, ["relu"])
+
+
+
+class TestLayerFunctionGenerator:
+    def test_generate_layer_fn_runs(self):
+        import jax
+        from paddle_tpu.layers import generate_activation_fn
+
+        softsign = generate_activation_fn("softsign")
+        fluid.unique_name.switch()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            y = softsign(x)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        xv = np.random.RandomState(0).randn(3, 4).astype("float32")
+        (out,) = exe.run(main, feed={"x": xv}, fetch_list=[y])
+        np.testing.assert_allclose(out, xv / (1 + np.abs(xv)),
+                                   rtol=1e-6)
+
+    def test_templatedoc_and_deprecated(self):
+        import warnings
+
+        from paddle_tpu.layers import deprecated, templatedoc
+
+        @templatedoc("relu")
+        def f():
+            """does ${comment}."""
+
+        assert "relu" in f.__doc__
+
+        @deprecated
+        def old():
+            return 7
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            assert old() == 7
+        assert w and issubclass(w[0].category, DeprecationWarning)
+
+
+
+class TestBaseMinimizeGradClip:
+    def test_grad_clip_applies_per_call(self):
+        """Base Optimizer.minimize(grad_clip=...) must clip (it silently
+        dropped the arg before) and must not leak the clip to later
+        minimizes on the same program."""
+        from paddle_tpu.executor import Scope, scope_guard
+
+        def build(clip):
+            fluid.unique_name.switch()
+            main, startup = fluid.Program(), fluid.Program()
+            main.random_seed = startup.random_seed = 9
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data("x", shape=[4], dtype="float32")
+                y = fluid.layers.data("y", shape=[1], dtype="float32")
+                pred = fluid.layers.fc(x, size=1, bias_attr=False)
+                loss = fluid.layers.mean(
+                    fluid.layers.square_error_cost(pred, y))
+                fluid.optimizer.SGD(learning_rate=1.0).minimize(
+                    loss, grad_clip=clip)
+            return main, startup
+
+        feed = {"x": np.full((4, 4), 10.0, "float32"),
+                "y": np.zeros((4, 1), "float32")}
+        deltas = {}
+        for clip in (None, fluid.GradientClipByGlobalNorm(1e-3)):
+            main, startup = build(clip)
+            exe = fluid.Executor(fluid.CPUPlace())
+            scope = Scope()
+            with scope_guard(scope):
+                exe.run(startup)
+                w0 = np.asarray(scope.get("fc_0.w_0")).copy()
+                exe.run(main, feed=feed, fetch_list=[])
+                w1 = np.asarray(scope.get("fc_0.w_0"))
+            deltas[clip is None] = float(np.abs(w1 - w0).max())
+        # the clipped update is drastically smaller than the unclipped
+        assert deltas[False] < 0.01 * deltas[True], deltas
+        # and the registration did not leak into the global registry
+        from paddle_tpu import clip as clip_mod
+        assert not clip_mod._clip_attr

@@ -107,8 +107,13 @@ def test_fused_family():
     rng = np.random.RandomState(3)
     x = rng.randn(3, 4).astype("float32")
     y = rng.randn(3, 4).astype("float32")
+    # reference contract: [binary, unary] = Binary(X, Unary(Y))
     r = call("fused_elemwise_activation", {"X": x, "Y": y},
              {"functor_list": ["elementwise_add", "relu"]})
+    np.testing.assert_allclose(r["Out"][0], x + np.maximum(y, 0), rtol=1e-6)
+    # [unary, binary] = Unary(Binary(X, Y))
+    r = call("fused_elemwise_activation", {"X": x, "Y": y},
+             {"functor_list": ["relu", "elementwise_add"]})
     np.testing.assert_allclose(r["Out"][0], np.maximum(x + y, 0), rtol=1e-6)
 
     W = rng.randn(10, 5).astype("float32")

@@ -17,6 +17,7 @@ import sys
 MODULES = [
     "paddle_tpu",
     "paddle_tpu.layers",
+    "paddle_tpu.layers.layer_function_generator",
     "paddle_tpu.optimizer",
     "paddle_tpu.initializer",
     "paddle_tpu.regularizer",
