@@ -172,6 +172,30 @@ class GradientClipByGlobalNorm(BaseGradientClipAttr):
 _clip_attr = {}
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def per_call_gradient_clip(program, clip):
+    """Temporarily register ``clip`` for ``program`` (the minimize
+    ``grad_clip=`` argument), restoring any persistent
+    ``set_gradient_clip`` registration on exit.  The single owner of the
+    register/restore dance — both minimize implementations use it."""
+    if clip is None:
+        yield
+        return
+    pid = id(program)
+    prev = _clip_attr.get(pid)
+    _clip_attr[pid] = clip
+    try:
+        yield
+    finally:
+        if prev is None:
+            _clip_attr.pop(pid, None)
+        else:
+            _clip_attr[pid] = prev
+
+
 def set_gradient_clip(clip, param_list=None, program=None):
     if program is None:
         program = default_main_program()

@@ -50,24 +50,17 @@ class DecoupledWeightDecay:
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None, grad_clip=None):
-        if grad_clip is not None:
-            # same per-call registration the base minimize performs,
-            # keyed on the loss's own program
-            from ... import clip as _clip_mod
+        from ...clip import per_call_gradient_clip
 
-            _clip_mod._clip_attr[id(loss.block.program)] = grad_clip
         params_grads = self.backward(
             loss, startup_program=startup_program,
             parameter_list=parameter_list, no_grad_set=no_grad_set)
         # decay ops precede the optimizer ops in program order, so the
         # update reads the already-decayed param (reference order)
         self._append_decay_ops(params_grads)
-        try:
+        with per_call_gradient_clip(loss.block.program, grad_clip):
             optimize_ops = self.apply_optimize(
                 loss, startup_program, params_grads)
-        finally:
-            if grad_clip is not None:
-                _clip_mod._clip_attr.pop(id(loss.block.program), None)
         return optimize_ops, params_grads
 
     def __str__(self):

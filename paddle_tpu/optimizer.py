@@ -198,28 +198,21 @@ class Optimizer:
         from .framework import in_dygraph_mode
 
         if in_dygraph_mode():
+            if grad_clip is not None:
+                import warnings
+
+                warnings.warn(
+                    "grad_clip is not applied on the dygraph minimize "
+                    "path; clip gradients explicitly before apply")
             return self._dygraph_minimize(loss, parameter_list)
         params_grads = self.backward(
             loss, startup_program, parameter_list, no_grad_set
         )
-        if grad_clip is not None:
-            # per-call clip, registered against the program that OWNS
-            # the loss (not the ambient default) and removed afterwards
-            from . import clip as _clip_mod
+        from .clip import per_call_gradient_clip
 
-            prog_id = id(loss.block.program)
-            prev = _clip_mod._clip_attr.get(prog_id)
-            _clip_mod._clip_attr[prog_id] = grad_clip
-            try:
-                optimize_ops = self.apply_optimize(
-                    loss, startup_program, params_grads)
-            finally:
-                if prev is None:
-                    _clip_mod._clip_attr.pop(prog_id, None)
-                else:
-                    _clip_mod._clip_attr[prog_id] = prev
-            return optimize_ops, params_grads
-        optimize_ops = self.apply_optimize(loss, startup_program, params_grads)
+        with per_call_gradient_clip(loss.block.program, grad_clip):
+            optimize_ops = self.apply_optimize(
+                loss, startup_program, params_grads)
         return optimize_ops, params_grads
 
     # ---- dygraph (eager) path: apply the SAME optimizer op lowering to

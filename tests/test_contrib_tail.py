@@ -190,9 +190,12 @@ class TestBaseMinimizeGradClip:
         minimizes on the same program."""
         from paddle_tpu.executor import Scope, scope_guard
 
+        built_ids = []
+
         def build(clip):
             fluid.unique_name.switch()
             main, startup = fluid.Program(), fluid.Program()
+            built_ids.append(id(main))
             main.random_seed = startup.random_seed = 9
             with fluid.program_guard(main, startup):
                 x = fluid.layers.data("x", shape=[4], dtype="float32")
@@ -219,6 +222,7 @@ class TestBaseMinimizeGradClip:
             deltas[clip is None] = float(np.abs(w1 - w0).max())
         # the clipped update is drastically smaller than the unclipped
         assert deltas[False] < 0.01 * deltas[True], deltas
-        # and the registration did not leak into the global registry
+        # and the per-call registration did not leak for OUR programs
+        # (other tests may legitimately hold persistent registrations)
         from paddle_tpu import clip as clip_mod
-        assert not clip_mod._clip_attr
+        assert not any(pid in clip_mod._clip_attr for pid in built_ids)
