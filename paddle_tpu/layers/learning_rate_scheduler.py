@@ -89,15 +89,24 @@ def inverse_time_decay(learning_rate, decay_steps, decay_rate, staircase=False):
 
 def polynomial_decay(learning_rate, decay_steps, end_learning_rate=0.0001,
                      power=1.0, cycle=False):
-    from .nn import elementwise_min
+    """reference learning_rate_scheduler.py:253 — with ``cycle`` the decay
+    horizon stretches to decay_steps*ceil(step/decay_steps) (restarting
+    each period); the reference's Switch on step==0 becomes a where."""
+    from .nn import elementwise_min, where
+    from .control_flow import equal
 
     step = _global_step()
     if cycle:
-        raise NotImplementedError("cycle=True polynomial_decay")
-    capped = elementwise_min(
-        step, tensor.fill_constant([1], "float32", float(decay_steps))
-    )
-    frac = capped / float(decay_steps)
+        div = ops.ceil(step / float(decay_steps))
+        one = tensor.fill_constant([1], "float32", 1.0)
+        zero = tensor.fill_constant([1], "float32", 0.0)
+        div = where(equal(step, zero), one, div)
+        frac = step / (div * float(decay_steps))
+    else:
+        capped = elementwise_min(
+            step, tensor.fill_constant([1], "float32", float(decay_steps))
+        )
+        frac = capped / float(decay_steps)
     one_minus = frac * (-1.0) + 1.0
     return (learning_rate - end_learning_rate) * ops.pow(
         one_minus, power

@@ -360,7 +360,36 @@ def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
 
 def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
                act=None, data_layout="NCHW", name=None):
-    raise NotImplementedError("group_norm lands with the vision op set")
+    """Group normalization (reference layers/nn.py:3487; kernel
+    group_norm_op.cc) over the channel axis of an NCHW tensor."""
+    if data_layout != "NCHW":
+        raise ValueError("group_norm supports data_layout='NCHW' only, "
+                         "got %r" % (data_layout,))
+    helper = LayerHelper("group_norm", **locals())
+    dtype = input.dtype
+    c = int(input.shape[1])
+    inputs = {"X": [input]}
+    if param_attr is not False:
+        scale = helper.create_parameter(
+            attr=helper.param_attr, shape=[c], dtype="float32",
+            default_initializer=ConstantInitializer(1.0),
+        )
+        inputs["Scale"] = [scale]
+    if bias_attr is not False:
+        bias = helper.create_parameter(
+            attr=helper.bias_attr, shape=[c], dtype="float32", is_bias=True,
+        )
+        inputs["Bias"] = [bias]
+    out = helper.create_variable_for_type_inference(dtype)
+    mean = helper.create_variable_for_type_inference("float32", True)
+    var = helper.create_variable_for_type_inference("float32", True)
+    helper.append_op(
+        type="group_norm",
+        inputs=inputs,
+        outputs={"Y": [out], "Mean": [mean], "Variance": [var]},
+        attrs={"groups": int(groups), "epsilon": float(epsilon)},
+    )
+    return helper.append_activation(out)
 
 
 def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
@@ -951,12 +980,58 @@ def pad2d(input, paddings=[0, 0, 0, 0], mode="constant", pad_value=0.0,
 
 
 def image_resize(input, out_shape=None, scale=None, name=None,
-                 resample="BILINEAR", align_corners=True, align_mode=1):
-    raise NotImplementedError("image_resize lands with the vision op set")
+                 resample="BILINEAR", actual_shape=None, align_corners=True,
+                 align_mode=1):
+    """Resize NCHW images (reference layers/nn.py:7483; interpolate_op.cc).
+
+    TPU redesign: the output H/W must be static Python ints (XLA static
+    shapes) — tensor-valued `out_shape`/`actual_shape` are rejected with
+    a targeted error instead of the reference's runtime OutSize input.
+    """
+    resample = str(resample).upper()
+    if resample not in ("BILINEAR", "NEAREST"):
+        raise ValueError(
+            "image_resize resample must be 'BILINEAR' or 'NEAREST', got %r"
+            % (resample,))
+    if actual_shape is not None or isinstance(out_shape, Variable):
+        raise ValueError(
+            "image_resize on TPU needs a static out_shape (list/tuple of "
+            "ints); tensor-valued out_shape/actual_shape would make the "
+            "compiled shape dynamic")
+    h, w = int(input.shape[2]), int(input.shape[3])
+    if out_shape is not None:
+        if len(out_shape) != 2:
+            raise ValueError("out_shape must be [out_h, out_w]")
+        oh, ow = int(out_shape[0]), int(out_shape[1])
+    elif scale is not None:
+        oh, ow = int(h * float(scale)), int(w * float(scale))
+    else:
+        raise ValueError("one of out_shape and scale must be set")
+    helper = LayerHelper("image_resize", **locals())
+    out = helper.create_variable_for_type_inference(input.dtype)
+    helper.append_op(
+        type="bilinear_interp" if resample == "BILINEAR" else "nearest_interp",
+        inputs={"X": [input]},
+        outputs={"Out": [out]},
+        attrs={"out_h": oh, "out_w": ow,
+               "align_corners": bool(align_corners),
+               "align_mode": int(align_mode)},
+    )
+    return out
 
 
-resize_bilinear = image_resize
-resize_nearest = image_resize
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    actual_shape=None, align_corners=True, align_mode=1):
+    """reference layers/nn.py:7706."""
+    return image_resize(input, out_shape, scale, name, "BILINEAR",
+                        actual_shape, align_corners, align_mode)
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   actual_shape=None, align_corners=True):
+    """reference layers/nn.py:7811."""
+    return image_resize(input, out_shape, scale, name, "NEAREST",
+                        actual_shape, align_corners)
 
 
 def where(condition, x=None, y=None):

@@ -75,14 +75,15 @@ def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
 
 
 def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
-                  use_peepholes=False, is_reverse=False,
+                  use_peepholes=True, is_reverse=False,
                   gate_activation="sigmoid", cell_activation="tanh",
-                  candidate_activation="tanh", proj_activation="identity",
+                  candidate_activation="tanh", proj_activation="tanh",
                   dtype="float32", name=None, h_0=None, c_0=None,
                   seq_len=None):
-    """reference nn.py dynamic_lstmp → lstmp_op.h.  Padded [B,T,4D]
+    """reference nn.py:727 dynamic_lstmp → lstmp_op.h.  Padded [B,T,4D]
     pre-projected input + seq_len (LoD replacement); weight [P,4D],
-    projection [D,P]."""
+    projection [D,P].  ``use_peepholes``/``proj_activation`` defaults
+    match the reference (True / tanh); peepholes widen Bias to 7D."""
     helper = LayerHelper("dynamic_lstmp", **locals())
     d = size // 4
     w = helper.create_parameter(
@@ -94,10 +95,14 @@ def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
         shape=[d, proj_size], dtype=dtype, is_bias=False)
     inputs = {"Input": [input], "Weight": [w], "ProjWeight": [pw]}
     if bias_attr is not False:
+        bias_width = 7 * d if use_peepholes else 4 * d
         b = helper.create_parameter(
-            attr=helper.bias_attr, shape=[1, 4 * d], dtype=dtype,
+            attr=helper.bias_attr, shape=[1, bias_width], dtype=dtype,
             is_bias=True)
         inputs["Bias"] = [b]
+    elif use_peepholes:
+        raise ValueError("dynamic_lstmp(use_peepholes=True) requires a "
+                         "bias (bias_attr must not be False)")
     if h_0 is not None:
         inputs["H0"] = [h_0]
     if c_0 is not None:
@@ -109,7 +114,8 @@ def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,
     helper.append_op(
         type="dynamic_lstmp", inputs=inputs,
         outputs={"Projection": [proj], "Cell": [cell]},
-        attrs={"gate_activation": gate_activation,
+        attrs={"use_peepholes": bool(use_peepholes),
+               "gate_activation": gate_activation,
                "cell_activation": cell_activation,
                "candidate_activation": candidate_activation,
                "proj_activation": proj_activation,
@@ -462,15 +468,43 @@ def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
          "global_pooling": global_pooling, "exclusive": exclusive})
 
 
+def _adaptive_window(spatial, out_sizes, what):
+    """Uniform window for adaptive pooling; the reference's ragged
+    ceil/floor windows coincide with this exactly when each input extent
+    divides its output extent — the static-shape TPU contract."""
+    for s, o in zip(spatial, out_sizes):
+        if int(s) % int(o):
+            raise ValueError(
+                "%s on TPU needs input extent %% output extent == 0 "
+                "(static windows); got input %s for pool_size %s"
+                % (what, list(spatial), list(out_sizes)))
+    k = [int(s) // int(o) for s, o in zip(spatial, out_sizes)]
+    return k
+
+
 def adaptive_pool2d(input, pool_size, pool_type="max",
                     require_index=False, name=None):
-    """reference nn.py adaptive_pool2d → pool_op adaptive attr."""
-    if require_index:
-        raise NotImplementedError("adaptive_pool2d(require_index=True)")
+    """reference nn.py adaptive_pool2d → pool_op adaptive attr;
+    require_index routes to max_pool2d_with_index
+    (pool_with_index_op.cc) and returns (out, flat-HW indices)."""
 
     def pair(v):
         return [int(v)] * 2 if isinstance(v, int) else [int(a) for a in v]
 
+    if require_index:
+        if pool_type != "max":
+            raise ValueError("require_index=True only with pool_type='max'")
+        k = _adaptive_window(input.shape[2:], pair(pool_size),
+                             "adaptive_pool2d(require_index=True)")
+        helper = LayerHelper("max_pool2d_with_index", **locals())
+        out = helper.create_variable_for_type_inference(input.dtype)
+        mask = helper.create_variable_for_type_inference("int32", True)
+        helper.append_op(
+            type="max_pool2d_with_index", inputs={"X": [input]},
+            outputs={"Out": [out], "Mask": [mask]},
+            attrs={"ksize": k, "strides": list(k), "paddings": [0, 0]},
+        )
+        return out, mask
     return _simple(
         "pool2d", {"X": input},
         {"pooling_type": pool_type, "ksize": pair(pool_size),
@@ -479,13 +513,27 @@ def adaptive_pool2d(input, pool_size, pool_type="max",
 
 def adaptive_pool3d(input, pool_size, pool_type="max",
                     require_index=False, name=None):
-    """reference nn.py adaptive_pool3d → pool_op adaptive attr."""
-    if require_index:
-        raise NotImplementedError("adaptive_pool3d(require_index=True)")
+    """reference nn.py adaptive_pool3d → pool_op adaptive attr;
+    require_index routes to max_pool3d_with_index."""
 
     def triple(v):
         return [int(v)] * 3 if isinstance(v, int) else [int(a) for a in v]
 
+    if require_index:
+        if pool_type != "max":
+            raise ValueError("require_index=True only with pool_type='max'")
+        k = _adaptive_window(input.shape[2:], triple(pool_size),
+                             "adaptive_pool3d(require_index=True)")
+        helper = LayerHelper("max_pool3d_with_index", **locals())
+        out = helper.create_variable_for_type_inference(input.dtype)
+        mask = helper.create_variable_for_type_inference("int32", True)
+        helper.append_op(
+            type="max_pool3d_with_index", inputs={"X": [input]},
+            outputs={"Out": [out], "Mask": [mask]},
+            attrs={"ksize": k, "strides": list(k),
+                   "paddings": [0, 0, 0]},
+        )
+        return out, mask
     return _simple(
         "pool3d", {"X": input},
         {"pooling_type": pool_type, "ksize": triple(pool_size),

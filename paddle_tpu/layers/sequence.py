@@ -158,23 +158,29 @@ def sequence_enumerate(input, win_size, pad_value=0, name=None):
 
 
 def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
-                 bias_attr=None, use_peepholes=False, is_reverse=False,
+                 bias_attr=None, use_peepholes=True, is_reverse=False,
                  gate_activation="sigmoid", cell_activation="tanh",
                  candidate_activation="tanh", dtype="float32", name=None,
                  seq_len=None):
     """LSTM over padded [B, T, 4*hidden] pre-projected input (reference
-    nn.py dynamic_lstm over LoD input; input = fc(x, 4*hidden) as there).
-    size = 4 * hidden."""
+    nn.py:427 dynamic_lstm over LoD input; input = fc(x, 4*hidden) as
+    there).  size = 4 * hidden.  ``use_peepholes`` defaults True exactly
+    like the reference: the bias then carries [1, 7*hidden] with the
+    trailing [W_ic, W_fc, W_oc] peephole weights."""
     assert size % 4 == 0
     hidden = size // 4
-    if use_peepholes:
-        raise NotImplementedError("peephole LSTM lands later")
+    if use_peepholes and bias_attr is False:
+        raise ValueError(
+            "dynamic_lstm(use_peepholes=True) — the reference default — "
+            "stores the W_ic/W_fc/W_oc peephole weights in the bias; "
+            "bias_attr must not be False (or pass use_peepholes=False)")
     helper = LayerHelper("dynamic_lstm", **locals())
     weight = helper.create_parameter(
         attr=helper.param_attr, shape=[hidden, 4 * hidden], dtype=dtype
     )
+    bias_width = 7 * hidden if use_peepholes else 4 * hidden
     bias = helper.create_parameter(
-        attr=helper.bias_attr, shape=[1, 4 * hidden], dtype=dtype,
+        attr=helper.bias_attr, shape=[1, bias_width], dtype=dtype,
         is_bias=True,
     )
     hidden_out = helper.create_variable_for_type_inference(dtype)
@@ -191,6 +197,7 @@ def dynamic_lstm(input, size, h_0=None, c_0=None, param_attr=None,
         inputs=inputs,
         outputs={"Hidden": [hidden_out], "Cell": [cell_out]},
         attrs={
+            "use_peepholes": bool(use_peepholes),
             "is_reverse": is_reverse,
             "gate_activation": gate_activation,
             "cell_activation": cell_activation,

@@ -242,9 +242,17 @@ def linspace(start, stop, num, dtype):
 
 
 def diag(diagonal):
+    """reference layers/tensor.py diag → diag_op.cc (square matrix from a
+    1-D diagonal); numpy input short-circuits to a constant."""
     if isinstance(diagonal, np.ndarray):
         return assign(np.diag(diagonal))
-    raise NotImplementedError("diag of Variable lands later")
+    helper = LayerHelper("diag", **locals())
+    out = helper.create_variable_for_type_inference(diagonal.dtype)
+    helper.append_op(
+        type="diag", inputs={"Diagonal": [diagonal]},
+        outputs={"Out": [out]},
+    )
+    return out
 
 
 def argmax(x, axis=0):

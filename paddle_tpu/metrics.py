@@ -122,10 +122,52 @@ class Auc(MetricBase):
 
 
 class ChunkEvaluator(MetricBase):
+    """Accumulates the three counters emitted by ``layers.chunk_eval``
+    and reports (precision, recall, f1) (reference metrics.py:410)."""
+
     def __init__(self, name=None):
-        raise NotImplementedError("lands with the sequence-labeling batch")
+        super().__init__(name)
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks,
+               num_correct_chunks):
+        self.num_infer_chunks += int(np.asarray(num_infer_chunks).sum())
+        self.num_label_chunks += int(np.asarray(num_label_chunks).sum())
+        self.num_correct_chunks += int(np.asarray(num_correct_chunks).sum())
+
+    def eval(self):
+        precision = (float(self.num_correct_chunks) / self.num_infer_chunks
+                     if self.num_infer_chunks else 0)
+        recall = (float(self.num_correct_chunks) / self.num_label_chunks
+                  if self.num_label_chunks else 0)
+        f1 = (2 * precision * recall / (precision + recall)
+              if self.num_correct_chunks else 0)
+        return precision, recall, f1
 
 
 class EditDistance(MetricBase):
+    """Accumulates per-sequence edit distances from
+    ``layers.edit_distance`` and reports (avg_distance,
+    wrong_instance_ratio) (reference metrics.py:492)."""
+
     def __init__(self, name=None):
-        raise NotImplementedError("lands with the sequence-labeling batch")
+        super().__init__(name)
+        self.total_distance = 0.0
+        self.seq_num = 0
+        self.instance_error = 0
+
+    def update(self, distances, seq_num):
+        distances = np.asarray(distances)
+        self.seq_num += seq_num
+        self.instance_error += int(seq_num - np.sum(distances == 0))
+        self.total_distance += float(np.sum(distances))
+
+    def eval(self):
+        if self.seq_num == 0:
+            raise ValueError(
+                "There is no data in EditDistance Metric. Please feed it "
+                "layers.edit_distance outputs via update() first.")
+        return (self.total_distance / self.seq_num,
+                self.instance_error / float(self.seq_num))

@@ -259,27 +259,55 @@ def spp(ctx, attrs, X):
 # ---- interpolation canonical names -------------------------------------
 
 def _interp(ctx, attrs, X, OutSize, method):
+    """Exact reference semantics (interpolate_op.h): ratio is
+    (in-1)/(out-1) under align_corners else in/out; bilinear with
+    align_mode=0 and no corner alignment uses half-pixel source coords
+    (clamped at 0), otherwise src = ratio*k; nearest rounds under
+    align_corners and truncates otherwise."""
     shape = attrs.get("out_shape") or [int(attrs.get("out_h")),
                                        int(attrs.get("out_w"))]
     oh, ow = int(shape[0]), int(shape[1])
     align = bool(attrs.get("align_corners", True))
+    amode = int(attrs.get("align_mode", 1))
     n, c, h, w = X.shape
-    img = jnp.moveaxis(X, 1, -1)
-    out = jax.image.resize(
-        img, (n, oh, ow, c),
-        method="bilinear" if method == "bilinear" else "nearest")
-    if align and method == "bilinear" and oh > 1 and ow > 1:
-        ys = jnp.linspace(0, h - 1, oh)
-        xs = jnp.linspace(0, w - 1, ow)
-        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
-        from .vision import _bilinear_sample
 
-        gxn = 2.0 * gx / jnp.maximum(w - 1, 1) - 1.0
-        gyn = 2.0 * gy / jnp.maximum(h - 1, 1) - 1.0
-        return _bilinear_sample(
-            X, jnp.broadcast_to(gxn, (n, oh, ow)),
-            jnp.broadcast_to(gyn, (n, oh, ow)))
-    return jnp.moveaxis(out, -1, 1)
+    def ratio(in_len, out_len):
+        if out_len <= 1:
+            return 0.0
+        return ((in_len - 1) / (out_len - 1)) if align else in_len / out_len
+
+    if method == "nearest":
+        def near_idx(in_len, out_len):
+            j = jnp.arange(out_len, dtype=jnp.float32) * ratio(in_len,
+                                                               out_len)
+            j = j + 0.5 if align else j
+            return jnp.clip(j.astype(jnp.int32), 0, in_len - 1)
+
+        return X[:, :, near_idx(h, oh)][:, :, :, near_idx(w, ow)]
+
+    half_pixel = (amode == 0 and not align)
+
+    def src(in_len, out_len):
+        j = jnp.arange(out_len, dtype=jnp.float32)
+        r = ratio(in_len, out_len)
+        if half_pixel:
+            return jnp.maximum(r * (j + 0.5) - 0.5, 0.0)
+        return r * j
+
+    fy, fx = src(h, oh), src(w, ow)
+    y0 = jnp.clip(jnp.floor(fy).astype(jnp.int32), 0, h - 1)
+    x0 = jnp.clip(jnp.floor(fx).astype(jnp.int32), 0, w - 1)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    dy = (fy - y0)[None, None, :, None]
+    dx = (fx - x0)[None, None, None, :]
+
+    def g(yy, xx):
+        return X[:, :, yy][:, :, :, xx]
+
+    top = g(y0, x0) * (1 - dx) + g(y0, x1) * dx
+    bot = g(y1, x0) * (1 - dx) + g(y1, x1) * dx
+    return top * (1 - dy) + bot * dy
 
 
 @register_op("bilinear_interp", inputs=["X", "OutSize"], outputs=["Out"])

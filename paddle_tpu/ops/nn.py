@@ -331,21 +331,30 @@ def conv2d_transpose(ctx, attrs, Input, Filter):
     groups = int(attrs.get("groups", 1) or 1)
     ksize = jnp.shape(Filter)[2:]
     pad = _conv_transpose_padding(paddings, ksize, dilations)
-    if groups != 1:
-        raise NotImplementedError("grouped conv2d_transpose")
-    # kernel stays in the reference's [C_in, C_out, kh, kw] layout: under
+
+    # kernel stays in the reference's [C_in, C_out/g, kh, kw] layout: under
     # transpose_kernel=True that is spec OIHW (O = the fwd conv's output =
     # C_in) — verified against the scatter oracle incl. C_in != C_out and
     # paddings (round-1 used IOHW, which breaks for C_in != C_out)
-    return jax.lax.conv_transpose(
-        Input,
-        Filter,
-        strides=strides,
-        padding=pad,
-        rhs_dilation=dilations,
-        dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        transpose_kernel=True,
-    )
+    def one(inp, flt):
+        return jax.lax.conv_transpose(
+            inp,
+            flt,
+            strides=strides,
+            padding=pad,
+            rhs_dilation=dilations,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            transpose_kernel=True,
+        )
+
+    if groups == 1:
+        return one(Input, Filter)
+    # grouped (conv_transpose_op.cc:67: out channels = filter_dims[1]*g):
+    # static per-group slices; XLA fuses the g small convs + concat.
+    return jnp.concatenate(
+        [one(x, f) for x, f in zip(jnp.split(Input, groups, axis=1),
+                                   jnp.split(Filter, groups, axis=0))],
+        axis=1)
 
 
 def _pool_nd(attrs, X, nd):
@@ -807,17 +816,25 @@ def conv3d_transpose(ctx, attrs, Input, Filter):
     strides = [int(s) for s in attrs.get("strides", [1, 1, 1])]
     paddings = attrs.get("paddings", [0, 0, 0])
     dilations = [int(d) for d in attrs.get("dilations", [1, 1, 1])]
-    if int(attrs.get("groups", 1) or 1) != 1:
-        raise NotImplementedError("grouped conv3d_transpose")
+    groups = int(attrs.get("groups", 1) or 1)
 
     ksize = jnp.shape(Filter)[2:]
     pad = _conv_transpose_padding(paddings, ksize, dilations)
-    return jax.lax.conv_transpose(
-        Input, Filter, strides=strides, padding=pad,
-        rhs_dilation=dilations,
-        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
-        transpose_kernel=True,
-    )
+
+    def one(inp, flt):
+        return jax.lax.conv_transpose(
+            inp, flt, strides=strides, padding=pad,
+            rhs_dilation=dilations,
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+            transpose_kernel=True,
+        )
+
+    if groups == 1:
+        return one(Input, Filter)
+    return jnp.concatenate(
+        [one(x, f) for x, f in zip(jnp.split(Input, groups, axis=1),
+                                   jnp.split(Filter, groups, axis=0))],
+        axis=1)
 
 
 @register_op("pool3d", inputs=["X"], outputs=["Out"])

@@ -39,15 +39,29 @@ _ACT = {
 )
 def lstm(ctx, attrs, Input, H0, C0, Weight, Bias, SeqLen):
     """Input [B,T,4D] (pre-projected x·Wx, as in the reference where the fc
-    is applied outside), Weight [D,4D] recurrent weights, Bias [1,4D] (or
-    [1,7D] with peepholes — peepholes unsupported).  Gate order i,f,c,o
-    (reference gate_activation defaults)."""
+    is applied outside), Weight [D,4D] recurrent weights, Bias [1,4D], or
+    [1,7D] with ``use_peepholes`` — the reference's *default* cell
+    (layers/nn.py:427, kernel math/detail/lstm_kernel.h): the trailing 3D
+    are [W_ic, W_fc, W_oc]; c_prev feeds the i/f gates and the fresh cell
+    feeds the o gate, all pre-activation.  Gate order i,f,c,o."""
     B, T, four_d = jnp.shape(Input)
     d = four_d // 4
     gate_act = _ACT[attrs.get("gate_activation", "sigmoid")]
     cell_act = _ACT[attrs.get("cell_activation", "tanh")]
     cand_act = _ACT[attrs.get("candidate_activation", "tanh")]
     is_reverse = attrs.get("is_reverse", False)
+    use_peepholes = bool(attrs.get("use_peepholes", False))
+    w_ic = w_fc = w_oc = None
+    if use_peepholes:
+        if Bias is None or Bias.size < 7 * d:
+            raise ValueError(
+                "lstm with use_peepholes=True needs a [1, 7*hidden] Bias "
+                "([b_i b_f b_c b_o, W_ic, W_fc, W_oc]); got %r"
+                % (None if Bias is None else Bias.shape,))
+        flat = jnp.reshape(Bias, (-1,))
+        w_ic = flat[4 * d:5 * d][None, :]
+        w_fc = flat[5 * d:6 * d][None, :]
+        w_oc = flat[6 * d:7 * d][None, :]
 
     h0 = H0 if H0 is not None else jnp.zeros((B, d), Input.dtype)
     c0 = C0 if C0 is not None else jnp.zeros((B, d), Input.dtype)
@@ -68,9 +82,15 @@ def lstm(ctx, attrs, Input, H0, C0, Weight, Bias, SeqLen):
         if Bias is not None:
             gates = gates + jnp.reshape(Bias, (1, -1))[:, : 4 * d]
         i, f, g, o = jnp.split(gates, 4, axis=-1)
-        i, f, o = gate_act(i), gate_act(f), gate_act(o)
+        if use_peepholes:
+            i = i + c * w_ic
+            f = f + c * w_fc
+        i, f = gate_act(i), gate_act(f)
         g = cand_act(g)
         c_new = f * c + i * g
+        if use_peepholes:
+            o = o + c_new * w_oc
+        o = gate_act(o)
         h_new = o * cell_act(c_new)
         if mt is not None:
             keep = mt[:, None]
@@ -208,7 +228,9 @@ def dynamic_lstmp(ctx, attrs, Input, H0, C0, Weight, ProjWeight, Bias,
                   SeqLen):
     """LSTM with projection (lstmp_op.h): recurrent input is the
     projection r = act(h @ ProjWeight) [B,P]; Weight [P, 4D];
-    Input [B,T,4D] pre-projected gates; padded + SeqLen mask."""
+    Input [B,T,4D] pre-projected gates; padded + SeqLen mask.
+    ``use_peepholes`` (reference default) takes a [1,7D] Bias whose
+    trailing 3D are [W_ic, W_fc, W_oc], applied as in lstm_kernel.h."""
     B, T, four_d = jnp.shape(Input)
     d = four_d // 4
     p = ProjWeight.shape[1]
@@ -217,6 +239,18 @@ def dynamic_lstmp(ctx, attrs, Input, H0, C0, Weight, ProjWeight, Bias,
     cand_act = _ACT[attrs.get("candidate_activation", "tanh")]
     proj_act = _ACT[attrs.get("proj_activation", "identity")]
     is_reverse = attrs.get("is_reverse", False)
+    use_peepholes = bool(attrs.get("use_peepholes", False))
+    w_ic = w_fc = w_oc = None
+    if use_peepholes:
+        if Bias is None or Bias.size < 7 * d:
+            raise ValueError(
+                "dynamic_lstmp with use_peepholes=True needs a "
+                "[1, 7*hidden] Bias; got %r"
+                % (None if Bias is None else Bias.shape,))
+        flat = jnp.reshape(Bias, (-1,))
+        w_ic = flat[4 * d:5 * d][None, :]
+        w_fc = flat[5 * d:6 * d][None, :]
+        w_oc = flat[6 * d:7 * d][None, :]
 
     r0 = H0 if H0 is not None else jnp.zeros((B, p), Input.dtype)
     c0 = C0 if C0 is not None else jnp.zeros((B, d), Input.dtype)
@@ -237,9 +271,15 @@ def dynamic_lstmp(ctx, attrs, Input, H0, C0, Weight, ProjWeight, Bias,
         if Bias is not None:
             gates = gates + jnp.reshape(Bias, (1, -1))[:, : 4 * d]
         i, f, g, o = jnp.split(gates, 4, axis=-1)
-        i, f, o = gate_act(i), gate_act(f), gate_act(o)
+        if use_peepholes:
+            i = i + c * w_ic
+            f = f + c * w_fc
+        i, f = gate_act(i), gate_act(f)
         g = cand_act(g)
         c_new = f * c + i * g
+        if use_peepholes:
+            o = o + c_new * w_oc
+        o = gate_act(o)
         h_new = o * cell_act(c_new)
         r_new = proj_act(jnp.matmul(h_new, ProjWeight))
         if mt is not None:

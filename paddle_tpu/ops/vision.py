@@ -375,8 +375,6 @@ def deformable_conv(ctx, attrs, Input, Offset, Mask, Filter):
     dil = [int(d) for d in attrs.get("dilations", [1, 1])]
     groups = int(attrs.get("groups", 1) or 1)
     dg = int(attrs.get("deformable_groups", 1) or 1)
-    if groups != 1:
-        raise NotImplementedError("deformable_conv groups>1")
     n, c, h, w = Input.shape
     m, c_g, kh, kw = Filter.shape
     oh = (h + 2 * pads[0] - (dil[0] * (kh - 1) + 1)) // strides[0] + 1
@@ -406,9 +404,18 @@ def deformable_conv(ctx, attrs, Input, Offset, Mask, Filter):
             group_feats.append(v)
         taps.append(jnp.concatenate(group_feats, axis=1))  # [N,C,OH,OW]
     col = jnp.stack(taps, axis=2)  # [N, C, kh*kw, OH, OW]
-    return jnp.einsum("nckhw,mck->nmhw",
-                      col.reshape(n, c, kh * kw, oh, ow),
-                      Filter.reshape(m, c, kh * kw))
+    col = col.reshape(n, c, kh * kw, oh, ow)
+    if groups == 1:
+        return jnp.einsum("nckhw,mck->nmhw", col,
+                          Filter.reshape(m, c, kh * kw))
+    # grouped contraction (deformable_conv_op InferShape: Filter is
+    # [M, C/g, kh, kw]; output channel block gi reads channel block gi)
+    cg, mg = c // groups, m // groups
+    return jnp.concatenate(
+        [jnp.einsum("nckhw,mck->nmhw",
+                    col[:, gi * cg:(gi + 1) * cg],
+                    Filter[gi * mg:(gi + 1) * mg].reshape(mg, cg, kh * kw))
+         for gi in range(groups)], axis=1)
 
 
 @register_op("deformable_psroi_pooling",
