@@ -231,14 +231,10 @@ class _GradEngine:
             any_grad = any_grad or g is not None
         if not any_grad:
             return False
-        if op.type == "while" and not op.attrs.get("max_trip_count"):
-            raise NotImplementedError(
-                "gradients through an unbounded `while` are not supported "
-                "(XLA has no reverse-mode for while_loop); pass "
-                "While(cond, max_trip_count=N) to lower backward as a "
-                "masked N-step scan, or use StaticRNN (lax.scan, fully "
-                "differentiable) for recurrence"
-            )
+        # unbounded `while` (no max_trip_count) is allowed: the executor
+        # probes the concrete trip count before tracing and the grad
+        # lowers as a masked scan of that length (while_op.cc:189 parity,
+        # two-pass because XLA has no reverse-mode while_loop)
 
         sub_block = self.block.program.block(op.attrs["sub_block"])
         exclude = set()

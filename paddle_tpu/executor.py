@@ -214,11 +214,30 @@ def _fuse_adam_ops(ops, block):
     bias correction and scale match.  Row-sharded (``_is_distributed``)
     tables stay unfused: concatenating a sharded table with replicated
     params would force XLA to re-gather it.  Disable with
-    PADDLE_TPU_FUSE_ADAM=0."""
+    PADDLE_TPU_FUSE_ADAM=0.
+
+    The fused op streams Param/Grad/moments through flat fp32 copies, so
+    one group transiently holds ~4 extra fp32 model copies in HBM — for
+    bf16 models that can regress peak memory.
+    PADDLE_TPU_FUSE_ADAM_MAX_ELEMS (default 2**27 elems = 512MB per fp32
+    stream) caps a group's total elements; bigger runs split into
+    several fused groups so XLA can retire each flat stream before the
+    next one materializes."""
     import os
 
     if os.environ.get("PADDLE_TPU_FUSE_ADAM", "1") == "0":
         return list(ops)
+    max_elems = int(os.environ.get("PADDLE_TPU_FUSE_ADAM_MAX_ELEMS",
+                                   str(2 ** 27)))
+
+    def n_elems(op):
+        var = block._find_var_recursive(op.inputs["Param"][0])
+        if var is None or not var.shape:
+            return 1
+        n = 1
+        for d in var.shape:
+            n *= max(int(d), 1)
+        return n
 
     def fusible_key(op):
         if op.type != "adam":
@@ -257,22 +276,62 @@ def _fuse_adam_ops(ops, block):
     # the common case fuses fully; odd deserialized layouts degrade to
     # smaller groups, never to wrong code.
     out = []
-    run, run_key = [], None
+    run, run_key, run_elems = [], None, 0
     for op in ops:
         key = fusible_key(op)
-        if key is not None and key == run_key:
+        if (key is not None and key == run_key
+                and run_elems + n_elems(op) <= max_elems):
             run.append(op)
+            run_elems += n_elems(op)
             continue
         if run:
             emit(run, out)
         if key is None:
             out.append(op)
-            run, run_key = [], None
+            run, run_key, run_elems = [], None, 0
         else:
-            run, run_key = [op], key
+            run, run_key, run_elems = [op], key, n_elems(op)
     if run:
         emit(run, out)
     return out
+
+
+def _probe_trip_counts(block, feed_vals, scope, fetch_names):
+    """Pass 1 of unbounded-while gradients (while_op.cc:189 parity):
+    eagerly run the block's forward prefix on the concrete feed/scope
+    values, counting iterations of every unbounded while (the `while` op
+    lowering runs a host loop under ctx.probing).  Pass 2 traces the
+    block with these counts as static masked-scan lengths; the jit cache
+    keys on them, so a different trip count recompiles rather than
+    reusing a too-short scan."""
+    ext_reads, _, _ = _analyze_block(block, list(feed_vals), fetch_names)
+    env = {n: scope.get(n) for n in ext_reads if scope.has(n)}
+    env.update(feed_vals)
+    ctx = op_registry.LoweringContext(base_key=rng_key(0), mode="train")
+    ctx.probing = True
+    ctx.trip_counts = {}
+    prefix = []
+    for op in block.ops:
+        if op.type.endswith("_grad"):
+            break  # grads follow every forward op; every while — incl.
+            # those nested in cond/recurrent sub-blocks — has been
+            # entered (and counted) by the forward prefix
+        if op.type in _HOST_SIDE_OPS:
+            continue
+        prefix.append(op)
+    _run_ops_into_env(block, env, ctx, ops=prefix)
+    return ctx.trip_counts
+
+
+def _has_unbounded_while_grad(program):
+    """Any while_grad without max_trip_count, in ANY block (an unbounded
+    while may sit inside a cond/recurrent sub-block)."""
+    for block in program.blocks:
+        for op in block.ops:
+            if (op.type == "while_grad"
+                    and not op.attrs.get("max_trip_count")):
+                return True
+    return False
 
 
 def _analyze_block(block, feed_names, fetch_names):
@@ -304,12 +363,13 @@ def _analyze_block(block, feed_names, fetch_names):
 
 class _CompiledBlock:
     def __init__(self, program, block, feed_names, fetch_names, scope, mode,
-                 mesh=None, accumulate_steps=1):
+                 mesh=None, accumulate_steps=1, trip_counts=None):
         import jax
 
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
         self.accumulate_steps = int(accumulate_steps or 1)
+        self.trip_counts = dict(trip_counts or {})
         ext_reads, written, persist_written = _analyze_block(
             block, feed_names, fetch_names
         )
@@ -355,6 +415,7 @@ class _CompiledBlock:
                 env.update(rw)
                 env.update(feeds)
                 ctx = op_registry.LoweringContext(base_key=key, mode=mode)
+                ctx.trip_counts = self.trip_counts
                 # host-IO ops of the TOP block run host-side around this
                 # jitted call; in sub-blocks they must fail loudly, so
                 # the filter lives here, not in _run_ops_into_env
@@ -651,12 +712,20 @@ class Executor:
             (n, tuple(v.shape), str(v.dtype)) for n, v in sorted(feed_vals.items())
         )
         mode = "train"
+        # two-pass unbounded-while gradients: probe concrete trip counts
+        # first; they become static scan lengths, so they join the cache
+        # key (a longer loop must recompile)
+        trip_counts = None
+        if _has_unbounded_while_grad(program):
+            trip_counts = _probe_trip_counts(
+                program.global_block(), feed_vals, scope, fetch_names)
         key_tuple = (
             id(program),
             program._version,
             id(scope),
             sig,
             tuple(fetch_names),
+            tuple(sorted((trip_counts or {}).items())),
         )
         from . import profiler as _prof
 
@@ -670,6 +739,7 @@ class Executor:
                     fetch_names,
                     scope,
                     mode,
+                    trip_counts=trip_counts,
                 )
             if use_program_cache:
                 self._cache[key_tuple] = compiled

@@ -131,6 +131,22 @@ def run_sub_block_op(op, block, env, ctx, run_block_fn):
         def cond(carry):
             return jnp.reshape(carry[cond_name], ()).astype(bool)
 
+        if ctx.probing and not op.attrs.get("max_trip_count"):
+            # two-pass unbounded-while-grad support: concrete host loop
+            # that counts trips (max over re-entries for nested loops).
+            # Bounded whiles keep the lax path and are NOT recorded —
+            # their counts would join the jit-cache key and trigger
+            # spurious recompiles when the data-dependent count varies
+            carry = carry0
+            trips = 0
+            while bool(cond(carry)):
+                carry = body(carry)
+                trips += 1
+            idx = int(op.attrs["sub_block"])
+            ctx.trip_counts[idx] = max(ctx.trip_counts.get(idx, 0), trips)
+            env.update(carry)
+            return
+
         final = jax.lax.while_loop(cond, body, carry0)
         env.update(final)
         return
@@ -205,6 +221,20 @@ def _run_while_grad(op, sub_block, env, ctx, run_block_fn):
     snap_pres = op.attrs.get("snapshot_pres", [])
     pre_of = dict(zip(snap_vars, snap_pres))
     max_trip = int(op.attrs.get("max_trip_count") or 0)
+    if not max_trip:
+        # unbounded while: the executor's probe pass ran the loop on
+        # concrete values and recorded the trip count; use it as the
+        # static scan length (masking keeps extra steps inert; a
+        # legitimately zero-trip loop scans 0 steps → zero grads)
+        idx = int(op.attrs["sub_block"])
+        if idx not in (ctx.trip_counts or {}):
+            raise NotImplementedError(
+                "gradients through an unbounded `while` need the "
+                "executor's trip-count probe (Executor.run does this "
+                "automatically); in this context pass "
+                "While(cond, max_trip_count=N) or use StaticRNN"
+            )
+        max_trip = int(ctx.trip_counts[idx])
 
     written_order, read_before_write = _block_carry_sets(sub_block)
     carried = [

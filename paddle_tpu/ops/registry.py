@@ -147,6 +147,13 @@ class LoweringContext:
         # hook for control-flow ops to lower sub-blocks; set by the executor
         self.lower_sub_block = None
         self.scope = None
+        # unbounded-while support (two-pass, reference while_op.cc:189):
+        # probing=True makes the `while` op run a host-level Python loop on
+        # concrete values recording iteration counts into trip_counts
+        # {sub_block_idx: n}; the jit trace then reads the counts as static
+        # scan lengths for while_grad
+        self.probing = False
+        self.trip_counts = None
 
     def set_op(self, op_id):
         self._op_id = op_id

@@ -48,7 +48,10 @@ def test_static_rnn_with_fc_trains():
     assert losses[-1] < 0.3 * losses[0], (losses[0], losses[-1])
 
 
-def test_while_grad_raises_clear_error():
+def test_while_unbounded_minimize_trains():
+    """Round-4: minimize over an unbounded while no longer raises — the
+    executor's trip-count probe (two-pass while_op.cc:189 lowering) makes
+    the whole pipeline differentiable end to end."""
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         i = fluid.layers.fill_constant([1], "float32", 0.0)
@@ -62,9 +65,17 @@ def test_while_grad_raises_clear_error():
             fluid.layers.assign(fluid.layers.scale(acc, 2.0), output=acc)
             fluid.layers.increment(i, in_place=True)
             fluid.layers.less_than(i, limit, cond=cond)
-        loss = fluid.layers.mean(acc)
-        with pytest.raises(NotImplementedError, match="StaticRNN"):
-            fluid.optimizer.SGD(0.1).minimize(loss)
+        loss = fluid.layers.mean(fluid.layers.square(acc))
+        # loss = (8 w x)^2 → dL/dw = 128 w x^2; lr must stay < 2/128
+        fluid.optimizer.SGD(0.005).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        xv = np.array([[1.0]], "float32")
+        losses = [float(np.asarray(exe.run(
+            main, feed={"x": xv}, fetch_list=[loss])[0]).reshape(()))
+            for _ in range(10)]
+    assert losses[-1] < losses[0]
 
 
 def test_cond_two_branches():

@@ -97,23 +97,93 @@ def test_while_grad_wrt_data_input():
     np.testing.assert_allclose(gv, [[3 * 8.0]], rtol=1e-5)
 
 
-def test_while_unbounded_grad_still_raises():
+def test_while_unbounded_grad_closed_form():
+    """No max_trip_count at all (reference while_op.cc:189 default):
+    the executor probes the concrete trip count eagerly, then lowers the
+    backward as a masked scan of that length."""
+    main, startup, loss, params_grads = _build_pow_loop(None)
+    assert params_grads[0][0].name == "loop.w"
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        xv = np.array([[0.5]], "float32")
+        lv, gv = exe.run(main, feed={"x": xv},
+                         fetch_list=[loss, params_grads[0][1]])
+    np.testing.assert_allclose(lv, 8.0 * 0.5, rtol=1e-5)          # w^3 x
+    np.testing.assert_allclose(gv, [[3 * 4.0 * 0.5]], rtol=1e-5)  # 3 w^2 x
+
+
+def test_while_unbounded_grad_data_dependent_trips():
+    """The trip count depends on a FED value: each distinct count keys a
+    fresh compile; grads match the closed form for both runs, and the
+    numeric finite-difference oracle for the longer one."""
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         x = fluid.layers.data("x", shape=[1, 1], dtype="float32",
                               append_batch_size=False)
+        limit = fluid.layers.data("limit", shape=[1], dtype="float32",
+                                  append_batch_size=False)
         y = fluid.layers.assign(x)
         i = fluid.layers.fill_constant([1], "float32", 0.0)
-        limit = fluid.layers.fill_constant([1], "float32", 3.0)
         cond = fluid.layers.less_than(i, limit)
-        w = fluid.layers.While(cond)  # no max_trip_count
+        w = fluid.layers.While(cond)  # unbounded, runtime-valued limit
         with w.block():
-            fluid.layers.assign(fluid.layers.scale(y, 2.0), output=y)
+            fluid.layers.assign(
+                fluid.layers.fc(
+                    y, size=1, bias_attr=False,
+                    param_attr=fluid.ParamAttr(
+                        name="loop3.w",
+                        initializer=fluid.initializer.Constant(2.0),
+                    ),
+                ),
+                output=y,
+            )
             fluid.layers.increment(i, in_place=True)
             fluid.layers.less_than(i, limit, cond=cond)
         loss = fluid.layers.mean(y)
-        with pytest.raises(NotImplementedError, match="max_trip_count"):
-            fluid.backward.append_backward(loss)
+        params_grads = fluid.backward.append_backward(loss)
+    gvar = params_grads[0][1]
+    exe = fluid.Executor(fluid.CPUPlace())
+    xv = np.array([[0.5]], "float32")
+    with scope_guard(Scope()):
+        exe.run(startup)
+        for n in (2, 4):
+            lv, gv = exe.run(
+                main,
+                feed={"x": xv, "limit": np.array([float(n)], "float32")},
+                fetch_list=[loss, gvar])
+            w_ = 2.0
+            np.testing.assert_allclose(lv, w_ ** n * 0.5, rtol=1e-5)
+            np.testing.assert_allclose(
+                gv, [[n * w_ ** (n - 1) * 0.5]], rtol=1e-5)
+
+        # numeric finite-difference oracle at n=4 (op_test.py pattern)
+        eps = 1e-3
+        scope = fluid.executor.global_scope()
+        import jax.numpy as jnp
+
+        for sign, store in ((+1, "hi"), (-1, "lo")):
+            scope.set("loop3.w", jnp.asarray([[2.0 + sign * eps]],
+                                             jnp.float32))
+            val = exe.run(
+                main,
+                feed={"x": xv, "limit": np.array([4.0], "float32")},
+                fetch_list=[loss])[0]
+            if store == "hi":
+                hi = float(np.asarray(val).reshape(()))
+            else:
+                lo = float(np.asarray(val).reshape(()))
+        np.testing.assert_allclose(float(np.asarray(gv).reshape(())),
+                                   (hi - lo) / (2 * eps), rtol=1e-3)
+
+        # zero-trip loop (limit=0): forward passes x through; grad of w
+        # is exactly zero (scan of length 0), not an error
+        scope.set("loop3.w", jnp.asarray([[2.0]], jnp.float32))
+        lv, gv = exe.run(
+            main, feed={"x": xv, "limit": np.array([0.0], "float32")},
+            fetch_list=[loss, gvar])
+        np.testing.assert_allclose(lv, 0.5, rtol=1e-6)
+        np.testing.assert_allclose(gv, [[0.0]])
 
 
 def _np_dynrnn_cumsum(xv, lens):
