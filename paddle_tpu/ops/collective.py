@@ -27,7 +27,14 @@ def _allreduce(name, fn):
     def _op(ctx, attrs, X, _fn=fn):
         ax = _axis(ctx)
         if ax is None:
+            # GSPMD path: the value is already global — and any averaging
+            # pre_scale must be skipped with it (a separate scale op would
+            # wrongly shrink the identity path; this is why averaging
+            # rides ON the collective, reference scale_loss_grad role)
             return X
+        s = attrs.get("pre_scale")
+        if s:
+            X = X * jnp.asarray(s, X.dtype)
         return _fn(X, ax)
 
     return _op

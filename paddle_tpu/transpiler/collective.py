@@ -9,7 +9,7 @@ execution — so a transpiled program is correct either way."""
 
 from ..framework import default_main_program, default_startup_program
 
-__all__ = ["GradAllReduce", "LocalSGD", "Collective"]
+__all__ = ["GradAllReduce", "LocalSGD", "GeoSGD", "Collective"]
 
 OP_ROLE_BACKWARD = "backward"
 
@@ -71,14 +71,13 @@ class GradAllReduce(Collective):
                 v = block._find_var_recursive(g)
                 if v is None:
                     continue
-                new_ops.append(Operator(
-                    block, "scale", {"X": [g]}, {"Out": [g]},
-                    {"scale": 1.0 / self.nranks,
-                     "op_role": OP_ROLE_BACKWARD},
-                ))
+                # averaging rides on the collective (pre_scale) so the
+                # same program is exact under BOTH shard_map (pmean) and
+                # GSPMD (identity — a separate scale op would shrink it)
                 new_ops.append(Operator(
                     block, "c_allreduce_sum", {"X": [g]}, {"Out": [g]},
-                    {"ring_id": 0, "op_role": OP_ROLE_BACKWARD},
+                    {"ring_id": 0, "pre_scale": 1.0 / self.nranks,
+                     "op_role": OP_ROLE_BACKWARD},
                 ))
         block.ops = new_ops
         self.main_program._bump_version()
@@ -119,12 +118,9 @@ class LocalSGD(Collective):
                 outputs={"Out": [delta]},
             )
             block.append_op(
-                type="scale", inputs={"X": [delta]}, outputs={"Out": [delta]},
-                attrs={"scale": 1.0 / self.nranks},
-            )
-            block.append_op(
                 type="c_allreduce_sum", inputs={"X": [delta]},
-                outputs={"Out": [delta]}, attrs={"ring_id": 0},
+                outputs={"Out": [delta]},
+                attrs={"ring_id": 0, "pre_scale": 1.0 / self.nranks},
             )
             block.append_op(
                 type="elementwise_sub",
@@ -134,5 +130,149 @@ class LocalSGD(Collective):
             block.append_op(
                 type="assign", inputs={"X": [p.name]},
                 outputs={"Out": [snap_name]},
+            )
+        self.main_program._bump_version()
+
+
+class GeoSGD(Collective):
+    """Geo-SGD (reference ``distribute_transpiler.py:131`` geo fields +
+    the async geo ``Communicator`` mode): each worker trains locally and
+    only every ``need_push_nums`` steps the parameter *deltas* since the
+    last sync are averaged across workers.
+
+    TPU redesign: the reference's pserver delta push/pull becomes a gated
+    delta-allreduce appended after the optimizer — a persistable step
+    counter drives a 0/1 gate, so off-sync steps are pure-local (the
+    selects keep the program one static jit; under GSPMD the allreduce is
+    an identity and XLA folds the gate arithmetic)."""
+
+    def __init__(self, need_push_nums=100, nrings=1):
+        super().__init__(nrings)
+        self.need_push_nums = int(need_push_nums)
+
+    def _transpile_main_program(self):
+        if self.nranks <= 1:
+            return
+        block = self.main_program.global_block()
+        sb = self.startup_program.global_block()
+
+        step = "geo_sgd@STEP"
+        block.create_var(name=step, shape=[1], dtype="float32",
+                         persistable=True)
+        sb.create_var(name=step, shape=[1], dtype="float32",
+                      persistable=True)
+        sb.append_op(
+            type="fill_constant", outputs={"Out": [step]},
+            attrs={"shape": [1], "dtype": "float32", "value": 0.0},
+        )
+        block.append_op(
+            type="increment", inputs={"X": [step]}, outputs={"Out": [step]},
+            attrs={"step": 1.0},
+        )
+        k = "geo_sgd@K"
+        block.create_var(name=k, shape=[1], dtype="float32")
+        block.append_op(
+            type="fill_constant", outputs={"Out": [k]},
+            attrs={"shape": [1], "dtype": "float32",
+                   "value": float(self.need_push_nums)},
+        )
+        modv = "geo_sgd@MOD"
+        block.create_var(name=modv, shape=[1], dtype="float32")
+        block.append_op(
+            type="elementwise_mod", inputs={"X": [step], "Y": [k]},
+            outputs={"Out": [modv]},
+        )
+        zero = "geo_sgd@ZERO"
+        block.create_var(name=zero, shape=[1], dtype="float32")
+        block.append_op(
+            type="fill_constant", outputs={"Out": [zero]},
+            attrs={"shape": [1], "dtype": "float32", "value": 0.0},
+        )
+        gate_b = "geo_sgd@GATE_B"
+        block.create_var(name=gate_b, shape=[1], dtype="bool")
+        block.append_op(
+            type="equal", inputs={"X": [modv], "Y": [zero]},
+            outputs={"Out": [gate_b]},
+        )
+        gate = "geo_sgd@GATE"
+        block.create_var(name=gate, shape=[1], dtype="float32")
+        block.append_op(
+            type="cast", inputs={"X": [gate_b]}, outputs={"Out": [gate]},
+            attrs={"in_dtype": "bool", "out_dtype": "float32"},
+        )
+        # reset the counter on sync (step *= 1-gate): it never exceeds k,
+        # so float32 increment can't saturate on billion-step runs
+        notg = "geo_sgd@NOTGATE"
+        block.create_var(name=notg, shape=[1], dtype="float32")
+        block.append_op(
+            type="scale", inputs={"X": [gate]}, outputs={"Out": [notg]},
+            attrs={"scale": -1.0, "bias": 1.0},
+        )
+        block.append_op(
+            type="elementwise_mul", inputs={"X": [step], "Y": [notg]},
+            outputs={"Out": [step]},
+        )
+
+        for p in self.main_program.all_parameters():
+            snap = p.name + "@GEO_SNAPSHOT"
+            block.create_var(name=snap, shape=p.shape, dtype=p.dtype,
+                             persistable=True)
+            sb.create_var(name=snap, shape=p.shape, dtype=p.dtype,
+                          persistable=True)
+            sb.append_op(
+                type="assign", inputs={"X": [p.name]},
+                outputs={"Out": [snap]},
+            )
+
+            def tmp(suffix):
+                n = p.name + suffix
+                block.create_var(name=n, shape=p.shape, dtype=p.dtype)
+                return n
+
+            delta = tmp("@GEO_DELTA")
+            block.append_op(
+                type="elementwise_sub", inputs={"X": [snap], "Y": [p.name]},
+                outputs={"Out": [delta]},
+            )
+            block.append_op(
+                type="c_allreduce_sum", inputs={"X": [delta]},
+                outputs={"Out": [delta]},
+                attrs={"ring_id": 0, "pre_scale": 1.0 / self.nranks},
+            )
+            synced = tmp("@GEO_SYNCED")
+            block.append_op(
+                type="elementwise_sub", inputs={"X": [snap], "Y": [delta]},
+                outputs={"Out": [synced]},
+            )
+            # param = param + gate * (synced - param)
+            diff = tmp("@GEO_DIFF")
+            block.append_op(
+                type="elementwise_sub",
+                inputs={"X": [synced], "Y": [p.name]},
+                outputs={"Out": [diff]},
+            )
+            block.append_op(
+                type="elementwise_mul", inputs={"X": [diff], "Y": [gate]},
+                outputs={"Out": [diff]},
+            )
+            block.append_op(
+                type="elementwise_add",
+                inputs={"X": [p.name], "Y": [diff]},
+                outputs={"Out": [p.name]},
+            )
+            # snapshot = snapshot + gate * (param - snapshot)
+            sdiff = tmp("@GEO_SDIFF")
+            block.append_op(
+                type="elementwise_sub",
+                inputs={"X": [p.name], "Y": [snap]},
+                outputs={"Out": [sdiff]},
+            )
+            block.append_op(
+                type="elementwise_mul", inputs={"X": [sdiff], "Y": [gate]},
+                outputs={"Out": [sdiff]},
+            )
+            block.append_op(
+                type="elementwise_add", inputs={"X": [snap], "Y": [sdiff]},
+                outputs={"Out": [snap]},
             )
         self.main_program._bump_version()

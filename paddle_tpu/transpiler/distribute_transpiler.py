@@ -92,6 +92,20 @@ class DistributeTranspiler:
             self.trainers = len(self.endpoints)
         else:
             self.trainers = int(trainers)
+        if getattr(self.config, "geo_sgd_mode", False):
+            # reference geo-SGD (distribute_transpiler.py:131 geo fields):
+            # local steps + periodic delta sync, redesigned as a gated
+            # delta-allreduce (collective.GeoSGD)
+            from .collective import GeoSGD
+
+            program._trainer_id = trainer_id
+            program._num_trainers = self.trainers
+            GeoSGD(need_push_nums=getattr(
+                self.config, "geo_sgd_need_push_nums", 100)).transpile(
+                program=program, startup_program=startup_program,
+                rank=trainer_id, nranks=self.trainers,
+            )
+            return
         if mode in ("nccl2", "grad_allreduce", "collective"):
             # topology recorded on the program; mesh construction and
             # collective insertion happen at jit time (GSPMD) — the
