@@ -90,10 +90,15 @@ def _save_sharded(dirname, name, val):
         bounds = _index_key(shard.index, val.shape)
         np.save(os.path.join(shard_dir, _shard_fname(bounds)),
                 np.asarray(shard.data))
-    # meta is tiny and identical on every process; last writer wins
-    with open(os.path.join(shard_dir, "meta.json"), "w") as f:
+    # meta is tiny and identical on every process; write-then-rename so
+    # concurrent writers on a shared filesystem can never leave a torn
+    # meta.json (os.replace is atomic on POSIX)
+    meta_tmp = os.path.join(
+        shard_dir, ".meta.json.tmp.%d" % os.getpid())
+    with open(meta_tmp, "w") as f:
         json.dump({"shape": list(val.shape), "dtype": str(val.dtype),
                    "files": all_files}, f)
+    os.replace(meta_tmp, os.path.join(shard_dir, "meta.json"))
 
 
 def _shard_entries(shard_dir, meta):

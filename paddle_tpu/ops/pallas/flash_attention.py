@@ -647,6 +647,16 @@ def flash_attention(q, k, v, bias=None, causal=False, sm_scale=None,
                              sm_scale=sm_scale,
                              dropout_rate=dropout_rate, seed=seed,
                              debug=debug)
+    if interpret and dropout_rate > 0.0 and not debug:
+        # the pltpu hardware PRNG has no CPU/interpret lowering — without
+        # the debug hash the kernel would die deep in Pallas with an
+        # opaque 'prng_seed not found for platform cpu'
+        raise ValueError(
+            "in-kernel dropout cannot run under PADDLE_TPU_PALLAS="
+            "interpret: the pltpu PRNG has no CPU lowering. Set "
+            "PADDLE_TPU_FLASH_DROPOUT_DEBUG=iota (deterministic debug "
+            "hash, identical masks on kernel and XLA paths) or unset "
+            "PADDLE_TPU_PALLAS to use the XLA fallback")
     bq, bk = _pick_blocks(tq, tk)
     o = _flash(qf, kf, vf, bias, seed, causal, sm_scale, bq, bk,
                interpret, dropout_rate, debug)

@@ -198,13 +198,8 @@ class Optimizer:
         from .framework import in_dygraph_mode
 
         if in_dygraph_mode():
-            if grad_clip is not None:
-                import warnings
-
-                warnings.warn(
-                    "grad_clip is not applied on the dygraph minimize "
-                    "path; clip gradients explicitly before apply")
-            return self._dygraph_minimize(loss, parameter_list)
+            return self._dygraph_minimize(loss, parameter_list,
+                                          grad_clip=grad_clip)
         params_grads = self.backward(
             loss, startup_program, parameter_list, no_grad_set
         )
@@ -255,7 +250,39 @@ class Optimizer:
                 reg._regularization_coeff, param._grad.dtype
             ) * jnp.sign(param.value)
 
-    def _dygraph_minimize(self, loss, parameter_list):
+    def _dygraph_clip_grads(self, grad_clip, params):
+        """Eager analogue of append_gradient_clip_ops: clip ``_grad`` of
+        every trainable param in place (same math as the graph-path clip
+        classes, so a model ported between modes trains identically)."""
+        import jax.numpy as jnp
+
+        from .clip import (GradientClipByGlobalNorm, GradientClipByNorm,
+                           GradientClipByValue)
+
+        live = [p for p in params
+                if getattr(p, "_grad", None) is not None and p.trainable]
+        if isinstance(grad_clip, GradientClipByValue):
+            for p in live:
+                p._grad = jnp.clip(p._grad, grad_clip.min, grad_clip.max)
+        elif isinstance(grad_clip, GradientClipByNorm):
+            for p in live:
+                n = jnp.sqrt(jnp.sum(jnp.square(p._grad)))
+                p._grad = p._grad * (
+                    grad_clip.clip_norm / jnp.maximum(n, grad_clip.clip_norm))
+        elif isinstance(grad_clip, GradientClipByGlobalNorm):
+            if not live:
+                return
+            gnorm = jnp.sqrt(sum(
+                jnp.sum(jnp.square(p._grad)) for p in live))
+            scale = grad_clip.clip_norm / jnp.maximum(
+                gnorm, grad_clip.clip_norm)
+            for p in live:
+                p._grad = p._grad * scale
+        else:
+            raise TypeError(
+                "unsupported grad_clip %r on the dygraph path" % grad_clip)
+
+    def _dygraph_minimize(self, loss, parameter_list, grad_clip=None):
         if parameter_list is None:
             raise ValueError(
                 "dygraph minimize requires parameter_list (the Layer's "
@@ -263,6 +290,8 @@ class Optimizer:
             )
         if loss is not None and getattr(loss, "_grad", None) is None:
             loss.backward()
+        if grad_clip is not None:
+            self._dygraph_clip_grads(grad_clip, parameter_list)
         for p in parameter_list:
             if getattr(p, "_grad", None) is None or not p.trainable:
                 continue
