@@ -65,7 +65,22 @@ class SPMDRunner:
         fetch_names = [
             v.name if isinstance(v, Variable) else str(v) for v in fetch_list
         ]
-        feed_vals = {n: jnp.asarray(v) for n, v in feed.items()}
+        if jax.process_count() > 1 and self.mesh is not None:
+            # multi-process cluster (reference nccl2 mode): each process
+            # feeds its LOCAL batch shard; assemble the global batch-
+            # sharded array over the cross-process mesh (the reference's
+            # feed_and_split_tensor_into_local_scopes, inverted — shards
+            # come in, the global view is constructed)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            batch = NamedSharding(self.mesh, P(self.mesh.axis_names[0]))
+            feed_vals = {
+                n: jax.make_array_from_process_local_data(
+                    batch, np.asarray(v))
+                for n, v in feed.items()
+            }
+        else:
+            feed_vals = {n: jnp.asarray(v) for n, v in feed.items()}
         sig = tuple(
             (n, tuple(v.shape), str(v.dtype))
             for n, v in sorted(feed_vals.items())

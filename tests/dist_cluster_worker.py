@@ -1,0 +1,62 @@
+"""Subprocess trainer for the multi-process cluster parity test
+(reference: ``unittests/test_dist_base.py:317`` runtime_main — trainers
+driven by PADDLE_* env vars, printing per-step losses for the parent to
+compare against the single-process oracle).
+
+Each of the 2 processes owns 4 virtual CPU devices (a fake 2-host × 4-chip
+cluster); the REAL user API is driven end to end:
+fleet.init → fleet.distributed_optimizer(...).minimize →
+CompiledProgram.with_data_parallel → exe.run with the process-local half
+batch.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu.incubate.fleet.base import role_maker  # noqa: E402
+from paddle_tpu.incubate.fleet.collective import fleet  # noqa: E402
+from tests.dist_model import build_model, make_batches  # noqa: E402
+
+
+def main():
+    fleet.init(role_maker.PaddleCloudRoleMaker())
+    rank = fleet.worker_index()
+    assert fleet.worker_num() == 2
+    assert jax.process_count() == 2
+    assert jax.device_count() == 8, jax.devices()
+
+    main_prog, startup, loss, feeds = build_model(
+        optimizer_factory=lambda opt: fleet.distributed_optimizer(opt))
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    cp = fluid.CompiledProgram(main_prog).with_data_parallel(
+        loss_name=loss.name)
+
+    losses = []
+    for xb, yb in make_batches():
+        # this process feeds its HALF of the global batch
+        half = slice(rank * (len(xb) // 2), (rank + 1) * (len(xb) // 2))
+        (lv,) = exe.run(cp, feed={feeds[0]: xb[half], feeds[1]: yb[half]},
+                        fetch_list=[loss])
+        losses.append(float(np.asarray(lv).reshape(())))
+    print("CLUSTER_LOSSES rank=%d %s"
+          % (rank, ",".join("%.8f" % v for v in losses)))
+    print("CLUSTER_OK rank=%d" % rank)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""Shared model for the cluster-parity test (the ``dist_mnist.py`` role
+from the reference's test_dist_base harness): a deterministic MLP whose
+initial weights are fixed numpy constants, so the 2-process cluster and
+the single-process oracle start bit-identical."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+GLOBAL_BATCH = 16
+STEPS = 5
+
+
+def _init(name, shape, seed):
+    w = np.random.RandomState(seed).uniform(
+        -0.1, 0.1, size=shape).astype("float32")
+    return fluid.ParamAttr(
+        name=name,
+        initializer=fluid.initializer.NumpyArrayInitializer(w))
+
+
+def build_model(optimizer_factory=None):
+    """Returns (main, startup, loss, feed_names)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, size=16, act="relu",
+                            param_attr=_init("mlp.w0", [8, 16], 1),
+                            bias_attr=_init("mlp.b0", [16], 2))
+        pred = fluid.layers.fc(h, size=1,
+                               param_attr=_init("mlp.w1", [16, 1], 3),
+                               bias_attr=_init("mlp.b1", [1], 4))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        if optimizer_factory is not None:
+            opt = optimizer_factory(opt)
+        opt.minimize(loss)
+    return main, startup, loss, ["x", "y"]
+
+
+def make_batches():
+    rng = np.random.RandomState(42)
+    for _ in range(STEPS):
+        xb = rng.randn(GLOBAL_BATCH, 8).astype("float32")
+        yb = (xb.sum(axis=1, keepdims=True) * 0.3
+              + rng.randn(GLOBAL_BATCH, 1) * 0.01).astype("float32")
+        yield xb, yb
