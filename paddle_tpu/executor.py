@@ -708,6 +708,30 @@ class Executor:
                 value = jnp.asarray(value)
             feed_vals[name] = value
 
+        # host-resident embedding tables (parameter_prefetch.cc role):
+        # prefetch each batch's rows into a dense slab feed; the slab's
+        # gradient is fetched from the step and pushed back to the host
+        # table on a background thread (communicator.h async push)
+        host_specs = getattr(program, "_host_tables", None) or []
+        host_active = []
+        for spec in host_specs:
+            from . import host_table as _host_table
+
+            tab = _host_table.get_table(spec["table"])
+            if spec["ids"] not in feed:
+                raise RuntimeError(
+                    "host_embedding ids var %r must be fed directly — "
+                    "the host-side prefetch reads its value before the "
+                    "device step" % spec["ids"])
+            ids_np = np.asarray(feed[spec["ids"]])
+            feed_vals[spec["slab"]] = jnp.asarray(tab.lookup(ids_np))
+            gname = spec["slab"] + "@GRAD"
+            has_grad = (program.global_block()
+                        ._find_var_recursive(gname) is not None)
+            host_active.append((tab, ids_np, gname if has_grad else None))
+        host_grad_fetches = [g for _, _, g in host_active if g]
+        fetch_names = fetch_names + host_grad_fetches
+
         sig = tuple(
             (n, tuple(v.shape), str(v.dtype)) for n, v in sorted(feed_vals.items())
         )
@@ -762,6 +786,15 @@ class Executor:
             scope.set(n, v)
         for n, v in fresh.items():
             scope.set(n, v)
+
+        if host_grad_fetches:
+            n_user = len(fetch_names) - len(host_grad_fetches)
+            gi = n_user
+            for tab, ids_np, g in host_active:
+                if g is not None:
+                    tab.update_async(ids_np, np.asarray(fetches[gi]))
+                    gi += 1
+            fetches = fetches[:n_user]
 
         if has_host_io:
             run_host_io_block(program.global_block(), scope, phase="save")

@@ -1,0 +1,210 @@
+"""Host-resident embedding tables — the bigger-than-HBM CTR capability
+the reference's parameter server actually served.
+
+Reference: remote prefetch of distributed lookup tables
+(``paddle/fluid/operators/distributed/parameter_prefetch.cc``) and the
+async push/pull ``Communicator`` threads
+(``operators/distributed/communicator.h:160-179``): embedding tables too
+large for accelerator memory live on host (pserver) RAM; each step
+prefetches only the rows the batch touches and pushes back sparse
+gradient updates asynchronously.
+
+TPU redesign: there is no pserver RPC — the table is a numpy array in
+THIS process's host RAM.  Per step the executor
+
+1. joins the previous step's in-flight update thread (the async-push
+   analogue: the host scatter-add overlaps the next device step's
+   dispatch + host data prep),
+2. gathers the batch's rows into a dense ``[batch..., dim]`` slab fed to
+   the jitted step like any other input (MXU-friendly: the device never
+   sees the table, only a small dense slab),
+3. fetches the slab's gradient from the step outputs and hands it to a
+   background thread that aggregates duplicate ids and applies the
+   sparse optimizer update (SGD or Adagrad) on host.
+
+Checkpoints use the SAME per-shard layout as the distributed device
+checkpoint (``io.py`` ``shard-*.npy`` + ``meta.json``), so a table can
+move between host-resident and device-row-sharded deployments in either
+direction (reshard-on-load).
+"""
+
+import os
+import threading
+
+import numpy as np
+
+__all__ = ["HostTable", "get_table", "get_or_create", "reset_tables"]
+
+_TABLES = {}
+
+
+def reset_tables():
+    """Drop all registered tables (test isolation)."""
+    for t in _TABLES.values():
+        t.join()
+    _TABLES.clear()
+
+
+def get_table(name):
+    return _TABLES[name]
+
+
+def get_or_create(name, rows, dim, dtype="float32", lr=0.1,
+                  optimizer="sgd", initializer=None, seed=0):
+    tab = _TABLES.get(name)
+    if tab is None:
+        tab = HostTable(name, rows, dim, dtype=dtype, lr=lr,
+                        optimizer=optimizer, initializer=initializer,
+                        seed=seed)
+        _TABLES[name] = tab
+    elif (tab.rows, tab.dim, tab.lr, tab.optimizer) != (
+            int(rows), int(dim), float(lr), optimizer):
+        raise ValueError(
+            "host table %r already exists with (rows=%d, dim=%d, lr=%g, "
+            "optimizer=%s); requested (%d, %d, %g, %s) — call "
+            "host_table.reset_tables() to rebuild"
+            % (name, tab.rows, tab.dim, tab.lr, tab.optimizer,
+               int(rows), int(dim), float(lr), optimizer))
+    return tab
+
+
+class HostTable:
+    def __init__(self, name, rows, dim, dtype="float32", lr=0.1,
+                 optimizer="sgd", initializer=None, seed=0):
+        self.name = name
+        self.rows = int(rows)
+        self.dim = int(dim)
+        self.lr = float(lr)
+        self.optimizer = optimizer
+        if optimizer not in ("sgd", "adagrad"):
+            raise ValueError("host table optimizer must be sgd or adagrad")
+        if initializer is not None:
+            self.value = np.asarray(initializer, dtype).reshape(
+                self.rows, self.dim)
+        else:
+            # reference lookup-table default init (uniform) — deterministic
+            # per (name, seed) so every process builds the same table
+            # (crc32, NOT hash(): Python hash randomization is salted
+            # per process and would silently desync a multi-process
+            # cluster's replicas)
+            import zlib
+
+            rng = np.random.RandomState(
+                (zlib.crc32(name.encode()) ^ seed) & 0x7FFFFFFF)
+            self.value = rng.uniform(
+                -0.05, 0.05, (self.rows, self.dim)).astype(dtype)
+        self._accum = None
+        if optimizer == "adagrad":
+            self._accum = np.zeros((self.rows, self.dim), "float32")
+        self._pending = None
+
+    # ---- step-time path ------------------------------------------------
+
+    def join(self):
+        """Wait for the in-flight async update (call before lookup)."""
+        t = self._pending
+        if t is not None:
+            t.join()
+            self._pending = None
+
+    def lookup(self, ids):
+        """Prefetch: dense slab of the rows this batch touches
+        (parameter_prefetch.cc role).  ids any int shape; returns
+        ids.shape + (dim,)."""
+        self.join()
+        idx = np.asarray(ids).astype(np.int64)
+        flat = np.clip(idx.reshape(-1), 0, self.rows - 1)
+        return self.value[flat].reshape(idx.shape + (self.dim,))
+
+    def update_async(self, ids, slab_grad):
+        """Async push (communicator.h role): background-thread sparse
+        update; duplicate ids are aggregated before the optimizer rule so
+        the result matches a scatter-add dense update exactly."""
+        self.join()
+        idx = np.clip(np.asarray(ids).astype(np.int64).reshape(-1),
+                      0, self.rows - 1)
+        g = np.asarray(slab_grad, np.float32).reshape(idx.shape[0],
+                                                      self.dim)
+        t = threading.Thread(target=self._apply, args=(idx, g),
+                             daemon=True)
+        self._pending = t
+        t.start()
+
+    def _apply(self, idx, g):
+        uniq, inv = np.unique(idx, return_inverse=True)
+        agg = np.zeros((uniq.shape[0], self.dim), np.float32)
+        np.add.at(agg, inv, g)
+        if self.optimizer == "sgd":
+            self.value[uniq] -= (self.lr * agg).astype(self.value.dtype)
+        else:  # adagrad (reference sparse adagrad_op path)
+            self._accum[uniq] += agg * agg
+            self.value[uniq] -= (
+                self.lr * agg / (np.sqrt(self._accum[uniq]) + 1e-6)
+            ).astype(self.value.dtype)
+
+    # ---- checkpoint (shared per-shard layout with io._save_sharded) ----
+
+    def _shard_dir(self, dirname):
+        return os.path.join(dirname, self.name.replace("/", "_")
+                            + ".shards")
+
+    def has_checkpoint(self, dirname):
+        return os.path.isdir(self._shard_dir(dirname))
+
+    def save(self, dirname, rows_per_shard=None):
+        """Write the table in the distributed checkpoint's shard layout:
+        row-range ``shard-r0_r1-0_D.npy`` files + ``meta.json`` (+ the
+        adagrad accumulator, so resume keeps the optimizer history)."""
+        import json
+
+        from .io import _shard_fname
+
+        self.join()  # never snapshot mid-async-update
+        shard_dir = self._shard_dir(dirname)
+        os.makedirs(shard_dir, exist_ok=True)
+        step = int(rows_per_shard or max(1, min(self.rows, 1 << 20)))
+        files = []
+        for r0 in range(0, self.rows, step):
+            r1 = min(r0 + step, self.rows)
+            bounds = ((r0, r1), (0, self.dim))
+            fname = _shard_fname(bounds)
+            np.save(os.path.join(shard_dir, fname), self.value[r0:r1])
+            files.append(fname)
+        if self._accum is not None:
+            np.save(os.path.join(shard_dir, "adagrad_accum.npy"),
+                    self._accum)
+        meta_tmp = os.path.join(shard_dir,
+                                ".meta.json.tmp.%d" % os.getpid())
+        with open(meta_tmp, "w") as f:
+            json.dump({"shape": [self.rows, self.dim],
+                       "dtype": str(self.value.dtype),
+                       "files": files}, f)
+        os.replace(meta_tmp, os.path.join(shard_dir, "meta.json"))
+
+    def load(self, dirname):
+        """Reshard-on-load from ANY shard layout of the same global
+        table — one written by HostTable.save or by the device-sharded
+        checkpoint path (io._save_sharded)."""
+        import json
+
+        from .io import _read_sharded_region, _shard_entries
+
+        self.join()
+        shard_dir = self._shard_dir(dirname)
+        with open(os.path.join(shard_dir, "meta.json")) as f:
+            meta = json.load(f)
+        if list(meta["shape"]) != [self.rows, self.dim]:
+            raise ValueError(
+                "checkpointed table %s has shape %s, expected %s"
+                % (self.name, meta["shape"], [self.rows, self.dim]))
+        entries = _shard_entries(shard_dir, meta)
+        self.value = np.asarray(_read_sharded_region(
+            entries, meta, ((0, self.rows), (0, self.dim)), self.name),
+            dtype=self.value.dtype)
+        if self._accum is not None:
+            apath = os.path.join(shard_dir, "adagrad_accum.npy")
+            # a checkpoint written by the device path has no accumulator
+            # file: restart the history from zeros rather than mixing the
+            # stale in-memory one with the freshly loaded values
+            self._accum = (np.load(apath) if os.path.exists(apath)
+                           else np.zeros((self.rows, self.dim), "float32"))

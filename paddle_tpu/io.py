@@ -220,9 +220,23 @@ def save_params(executor, dirname, main_program=None, filename=None):
                      predicate=_is_parameter, filename=filename)
 
 
+def _host_tables_of(main_program):
+    from . import host_table as _ht
+
+    prog = main_program or default_main_program()
+    names = {spec["table"]
+             for spec in getattr(prog, "_host_tables", None) or []}
+    return [_ht.get_table(n) for n in sorted(names)]
+
+
 def save_persistables(executor, dirname, main_program=None, filename=None):
-    return save_vars(executor, dirname, main_program,
-                     predicate=_is_persistable, filename=filename)
+    r = save_vars(executor, dirname, main_program,
+                  predicate=_is_persistable, filename=filename)
+    # host-resident embedding tables persist in the same per-shard
+    # layout (reshard-compatible with device-sharded checkpoints)
+    for tab in _host_tables_of(main_program):
+        tab.save(dirname)
+    return r
 
 
 def load_vars(executor, dirname, main_program=None, vars=None,
@@ -270,8 +284,12 @@ def load_params(executor, dirname, main_program=None, filename=None):
 
 
 def load_persistables(executor, dirname, main_program=None, filename=None):
-    return load_vars(executor, dirname, main_program,
-                     predicate=_is_persistable, filename=filename)
+    r = load_vars(executor, dirname, main_program,
+                  predicate=_is_persistable, filename=filename)
+    for tab in _host_tables_of(main_program):
+        if tab.has_checkpoint(dirname):
+            tab.load(dirname)
+    return r
 
 
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,

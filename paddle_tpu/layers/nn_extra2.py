@@ -2,7 +2,7 @@
 softmax family, 3-D conv/pool, sequence extras, CTR helpers (reference
 ``python/paddle/fluid/layers/nn.py``)."""
 
-from ..framework import Variable
+from ..framework import Variable, default_main_program
 from ..layer_helper import LayerHelper
 from ..param_attr import ParamAttr
 from ..initializer import ConstantInitializer
@@ -19,7 +19,7 @@ __all__ = [
     "sequence_scatter",
     "continuous_value_model", "get_tensor_from_selected_rows",
     "merge_selected_rows", "py_func", "tree_conv", "similarity_focus",
-    "deformable_conv", "deformable_roi_pooling",
+    "deformable_conv", "deformable_roi_pooling", "host_embedding",
 ]
 
 
@@ -72,6 +72,49 @@ def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
                "origin_mode": origin_mode},
     )
     return hid, rhp, gate
+
+
+def host_embedding(input, size, name, lr=0.1, optimizer="sgd",
+                   dtype="float32", initializer=None, seed=0):
+    """Bigger-than-HBM embedding lookup against a HOST-resident table
+    (the CTR capability of the reference's distributed lookup table:
+    ``operators/distributed/parameter_prefetch.cc`` remote prefetch +
+    ``communicator.h:160`` async push, redesigned pserver-free).
+
+    The table (``size=[rows, dim]``) lives in host RAM
+    (``paddle_tpu.host_table``), never on the accelerator.  The executor
+    prefetches the batch's rows into a dense slab fed to the jitted
+    step, fetches the slab gradient, and applies the sparse update on a
+    background thread overlapped with the next step.  ``input`` must be
+    a directly-fed data Variable of int ids (the prefetch reads its
+    value before the device step); use the plain ``Executor`` path.
+    The sparse optimizer (``sgd`` or ``adagrad``, own ``lr``) is a
+    property of the table, like the reference pserver's optimizer
+    blocks."""
+    from .. import host_table
+
+    rows, dim = int(size[0]), int(size[1])
+    host_table.get_or_create(name, rows, dim, dtype=dtype, lr=lr,
+                             optimizer=optimizer, initializer=initializer,
+                             seed=seed)
+    block = default_main_program().current_block()
+    if block.idx != 0:
+        raise ValueError("host_embedding must sit in the top-level block "
+                         "(the prefetch runs around the whole jitted step)")
+    slab_name = "%s@SLAB@%s" % (name, input.name)
+    slab = block.create_var(
+        name=slab_name,
+        shape=list(input.shape) + [dim],
+        dtype=dtype,
+        stop_gradient=False,
+        is_data=True,
+    )
+    prog = block.program
+    if not hasattr(prog, "_host_tables"):
+        prog._host_tables = []
+    prog._host_tables.append(
+        {"table": name, "ids": input.name, "slab": slab_name})
+    return slab
 
 
 def dynamic_lstmp(input, size, proj_size, param_attr=None, bias_attr=None,

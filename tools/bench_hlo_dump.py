@@ -1,4 +1,7 @@
-"""Dump the optimized HLO of the BERT bench train step (layout diagnosis)."""
+"""Dump the optimized HLO of a bench train step (layout/fusion
+diagnosis).  ``--model bert`` (default) or ``--model resnet50``;
+``--summary`` prints op-category counts (the conv/BN-fusion pre-stage
+check for the ResNet MFU work)."""
 import sys
 
 import numpy as np
@@ -6,34 +9,76 @@ import numpy as np
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 
-def main():
+def _lower(model):
     import jax
+    import jax.numpy as jnp
     import paddle_tpu as fluid
-    from paddle_tpu.models import bert
     from paddle_tpu.executor import Scope, scope_guard, _CompiledBlock
 
-    cfg = bert.BERT_BASE
-    batch, seq_len = 64, 128
-    main_prog, startup, _, loss = bert.build_pretrain(
-        cfg, seq_len=seq_len, lr=1e-4, amp=True, train=True
-    )
+    rng = np.random.RandomState(0)
+    if model == "resnet50":
+        from paddle_tpu.models import resnet
+
+        batch = 64
+        main_prog, startup, _, loss, _ = resnet.build(
+            dataset="imagenet", amp=True)
+        feed = {
+            "img": rng.randn(batch, 3, 224, 224).astype("float32"),
+            "label": rng.randint(0, 1000, (batch, 1)).astype("int64"),
+        }
+    else:
+        from paddle_tpu.models import bert
+
+        cfg = bert.BERT_BASE
+        batch, seq_len = 64, 128
+        main_prog, startup, _, loss = bert.build_pretrain(
+            cfg, seq_len=seq_len, lr=1e-4, amp=True, train=True
+        )
+        feed = bert.make_fake_batch(batch, seq_len, cfg, rng)
     scope = Scope()
     with scope_guard(scope):
         exe = fluid.Executor(fluid.TPUPlace())
         exe.run(startup)
-        rng = np.random.RandomState(0)
-        feed = bert.make_fake_batch(batch, seq_len, cfg, rng)
-        import jax.numpy as jnp
-
         feed_vals = {k: jnp.asarray(v) for k, v in feed.items()}
         cb = _CompiledBlock(main_prog, main_prog.global_block(),
                            list(feed_vals), [], scope, "train")
         rw = {n: scope.get(n) for n in cb.rw_names}
         ro = {n: scope.get(n) for n in cb.ro_names}
         key = jax.random.key(0)
-        txt = cb.jitted.lower(feed_vals, rw, ro, key).compile().as_text()
-        open("/tmp/bench_hlo.txt", "w").write(txt)
-        print("wrote /tmp/bench_hlo.txt", len(txt))
+        return cb.jitted.lower(feed_vals, rw, ro, key).compile().as_text()
+
+
+def summarize(txt):
+    """Count the op categories that matter for MXU/HBM efficiency."""
+    import re
+
+    cats = {
+        "convolution": r"= \S+ convolution\(",
+        "dot/matmul": r"= \S+ dot\(",
+        "fusion": r"= \S+ fusion\(",
+        "batch-norm-unfused": r"batch-norm-(training|inference|grad)",
+        "transpose (standalone)": r"^\s*\S+ = \S+ transpose\(",
+        "all-reduce": r"all-reduce",
+        "copy (layout change)": r"= \S+ copy\(",
+        "reduce": r"= \S+ reduce\(",
+    }
+    counts = {k: len(re.findall(p, txt, re.M)) for k, p in cats.items()}
+    # conv/BN fusion health: a fused resnet should show ZERO standalone
+    # batch-norm ops (decomposed + fused into neighbors by XLA)
+    return counts
+
+
+def main():
+    model = "bert"
+    if "--model" in sys.argv:
+        model = sys.argv[sys.argv.index("--model") + 1]
+    txt = _lower(model)
+    path = "/tmp/bench_hlo_%s.txt" % model
+    open(path, "w").write(txt)
+    print("wrote %s %d bytes" % (path, len(txt)))
+    if "--summary" in sys.argv:
+        for k, v in summarize(txt).items():
+            print("%-26s %6d" % (k, v))
 
 
 if __name__ == "__main__":
