@@ -10,6 +10,19 @@ distributed-lookup-table role, ``parameter_prefetch.cc``)."""
 import paddle_tpu as fluid
 
 
+def _host_slot_embed_sum(slot, vocab, dim, name, lr=0.01):
+    """Host-resident variant of a slot embedding (bigger-than-HBM tables:
+    ``paddle_tpu.host_table``): masked sum so padding id 0 contributes
+    nothing, like the device path's padding_idx=0."""
+    slab = fluid.layers.host_embedding(slot, size=[vocab, dim], name=name,
+                                       lr=lr)
+    zero = fluid.layers.fill_constant([1], "int64", 0)
+    mask = fluid.layers.cast(fluid.layers.not_equal(slot, zero), "float32")
+    masked = fluid.layers.elementwise_mul(
+        slab, fluid.layers.unsqueeze(mask, [2]))
+    return fluid.layers.reduce_sum(masked, dim=1)  # [B, dim]
+
+
 def _slot_embed_sum(slot, vocab, dim, name, is_sparse=True,
                     is_distributed=False):
     emb = fluid.layers.embedding(
@@ -62,12 +75,21 @@ def wide_deep(slots, dense, label, vocab=100000, embed_dim=16,
 
 
 def deepfm(slots, label, vocab=100000, embed_dim=16, hidden=(400, 400),
-           is_distributed=False):
+           is_distributed=False, use_host_table=False, host_lr=0.01):
     """DeepFM: first-order linear + second-order FM interactions + deep
-    MLP, all sharing slot embeddings."""
-    embs = []     # [B, L, dim] per slot
+    MLP, all sharing slot embeddings.  ``use_host_table`` keeps the
+    tables in host RAM (the >HBM CTR deployment; the tables then train
+    with their own sparse-SGD lr, like the reference pserver's separate
+    optimizer blocks)."""
+    embs = []     # [B, dim] per slot (slot-summed)
     firsts = []   # [B, 1] per slot
     for i, s in enumerate(slots):
+        if use_host_table:
+            embs.append(_host_slot_embed_sum(
+                s, vocab, embed_dim, "fm_emb_%d" % i, lr=host_lr))
+            firsts.append(_host_slot_embed_sum(
+                s, vocab, 1, "fm_first_%d" % i, lr=host_lr))
+            continue
         e = fluid.layers.embedding(
             s, size=[vocab, embed_dim], is_sparse=True, padding_idx=0,
             is_distributed=is_distributed,
@@ -112,7 +134,8 @@ def deepfm(slots, label, vocab=100000, embed_dim=16, hidden=(400, 400),
 
 
 def build(model="wide_deep", num_slots=8, slot_len=4, dense_dim=13,
-          vocab=100000, lr=1e-3, is_distributed=False):
+          vocab=100000, lr=1e-3, is_distributed=False,
+          use_host_table=False, host_lr=0.01):
     """Returns (main, startup, feed_vars, loss, prob)."""
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
@@ -124,6 +147,10 @@ def build(model="wide_deep", num_slots=8, slot_len=4, dense_dim=13,
         label = fluid.layers.data("label", shape=[1], dtype="int64")
         feeds = list(slots) + [label]
         if model == "wide_deep":
+            if use_host_table:
+                raise ValueError(
+                    "use_host_table is implemented for model='deepfm' "
+                    "only; wide_deep still uses device tables")
             dense = fluid.layers.data("dense", shape=[dense_dim],
                                       dtype="float32")
             feeds.append(dense)
@@ -131,6 +158,8 @@ def build(model="wide_deep", num_slots=8, slot_len=4, dense_dim=13,
                                    is_distributed=is_distributed)
         else:
             loss, prob = deepfm(slots, label, vocab,
-                                is_distributed=is_distributed)
+                                is_distributed=is_distributed,
+                                use_host_table=use_host_table,
+                                host_lr=host_lr)
         fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
     return main, startup, feeds, loss, prob

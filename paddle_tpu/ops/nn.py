@@ -290,11 +290,13 @@ def _conv_nd(ctx, attrs, Input, Filter, nd):
     else:
         dn_in = "NCDHW" if layout in ("NCDHW", "AnyLayout", "NCHW") else "NDHWC"
         dn = (dn_in, "OIDHW", dn_in)
-    acc = (
-        jnp.float32
-        if jnp.result_type(Input, Filter) in (jnp.bfloat16, jnp.float16)
-        else None
-    )
+    # NO preferred_element_type here: jax's conv transpose rule feeds the
+    # fp32 cotangent of the widened output straight into a conv against
+    # the bf16 filter and dies with a dtype mismatch — which would crash
+    # every AMP conv BACKWARD at trace time (found pre-staging the
+    # resnet50 AMP bench).  The natural bf16×bf16→bf16 conv is
+    # numerically identical on TPU anyway: the MXU always accumulates in
+    # fp32 internally and rounds once on output.
     out = jax.lax.conv_general_dilated(
         Input,
         Filter,
@@ -303,7 +305,6 @@ def _conv_nd(ctx, attrs, Input, Filter, nd):
         rhs_dilation=dilations,
         dimension_numbers=dn,
         feature_group_count=groups,
-        preferred_element_type=acc,
     )
     return out.astype(jnp.result_type(Input, Filter))
 

@@ -161,6 +161,30 @@ def test_checkpoint_roundtrip_and_reshard():
     np.testing.assert_array_equal(t.value, orig)
 
 
+def test_deepfm_model_with_host_tables_trains():
+    """The real DeepFM model family (models/ctr.py) with host-resident
+    slot tables: must train (loss decreases on a fixed batch) through
+    the plain Executor path."""
+    from paddle_tpu.models import ctr
+
+    fluid.unique_name.switch()
+    main, startup, feeds, loss, prob = ctr.build(
+        model="deepfm", num_slots=4, slot_len=3, vocab=100000,
+        use_host_table=True, host_lr=0.05)
+    rng = np.random.RandomState(9)
+    feed = {"slot_%d" % i: rng.randint(0, 100000, (8, 3)).astype("int64")
+            for i in range(4)}
+    feed["label"] = rng.randint(0, 2, (8, 1)).astype("int64")
+    exe = fluid.Executor(fluid.CPUPlace())
+    losses = []
+    with scope_guard(Scope()):
+        exe.run(startup)
+        for _ in range(8):
+            (lv,) = exe.run(main, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(())))
+    assert losses[-1] < losses[0], losses
+
+
 def test_adagrad_accumulator_survives_checkpoint():
     import tempfile
 

@@ -66,6 +66,29 @@ def test_resnet_cifar_runs_and_learns():
     assert losses[-1] < losses[0], (losses[0], losses[-1])
 
 
+def test_resnet_cifar_amp_bf16_trains():
+    """Regression: the conv lowering's preferred_element_type broke
+    jax's conv TRANSPOSE under bf16 AMP (dtype-mismatch crash at trace
+    time) — the exact path the hardware resnet50 bench takes."""
+    import paddle_tpu as fluid_
+
+    fluid_.unique_name.switch()
+    main, startup, feeds, loss, acc = resnet.build(
+        dataset="cifar10", depth=8, batch_lr=0.05, amp=True
+    )
+    rng = np.random.RandomState(0)
+
+    def feed_fn():
+        y = rng.randint(0, 2, (8, 1)).astype("int64")
+        x = rng.randn(8, 3, 32, 32).astype("float32") * 0.1
+        x += y[:, :, None, None].astype("float32") * 2.0 - 1.0
+        return {"img": x, "label": y}
+
+    losses = _train(main, startup, feed_fn, loss, steps=15)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], (losses[0], losses[-1])
+
+
 def test_bert_tiny_trains():
     cfg = bert.BERT_TINY
     main, startup, feeds, loss = bert.build_pretrain(

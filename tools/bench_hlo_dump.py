@@ -19,12 +19,27 @@ def _lower(model):
     if model == "resnet50":
         from paddle_tpu.models import resnet
 
-        batch = 64
+        # fusion structure is batch-independent; small batch keeps the
+        # CPU compile tractable (--batch N / --dataset cifar10 to
+        # override — the conv/BN lowering is shared, so the cifar net
+        # answers the fusion question when the 224² compile is too slow)
+        batch = 8
+        if "--batch" in sys.argv:
+            batch = int(sys.argv[sys.argv.index("--batch") + 1])
+        dataset = "imagenet"
+        if "--dataset" in sys.argv:
+            dataset = sys.argv[sys.argv.index("--dataset") + 1]
+        if dataset not in ("imagenet", "cifar10"):
+            raise SystemExit("--dataset must be imagenet or cifar10")
+        # same branch condition as resnet.build: cifar10 is the small
+        # net, everything else is the 224² imagenet net
+        size = 32 if dataset == "cifar10" else 224
+        nclass = 10 if dataset == "cifar10" else 1000
         main_prog, startup, _, loss, _ = resnet.build(
-            dataset="imagenet", amp=True)
+            dataset=dataset, amp="--no-amp" not in sys.argv)
         feed = {
-            "img": rng.randn(batch, 3, 224, 224).astype("float32"),
-            "label": rng.randint(0, 1000, (batch, 1)).astype("int64"),
+            "img": rng.randn(batch, 3, size, size).astype("float32"),
+            "label": rng.randint(0, nclass, (batch, 1)).astype("int64"),
         }
     else:
         from paddle_tpu.models import bert
@@ -69,6 +84,15 @@ def summarize(txt):
 
 
 def main():
+    import os
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # the image pins jax_platforms in jax config, so the env var
+        # alone is IGNORED — honor it explicitly or a dead TPU tunnel
+        # hangs the whole dump at backend init
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     model = "bert"
     if "--model" in sys.argv:
         model = sys.argv[sys.argv.index("--model") + 1]
