@@ -106,6 +106,25 @@ def child_probe():
     }), flush=True)
 
 
+def _timed_steps(exe, main_prog, feed, loss, warmup, steps):
+    """Shared measured-throughput discipline (fluid_benchmark.py:296-300):
+    warmup, then a synchronizing loss fetch (async dispatch must not bill
+    compile/warmup tails to the window — and a NaN fails BEFORE timing),
+    then `steps` runs whose last one fetches the loss to close the
+    window.  Returns wall seconds for the `steps` runs."""
+    for _ in range(warmup):
+        exe.run(main_prog, feed=feed, fetch_list=[])
+    lv = exe.run(main_prog, feed=feed, fetch_list=[loss])[0]  # sync
+    assert np.isfinite(lv).all()
+    t0 = time.perf_counter()
+    for _ in range(steps - 1):
+        exe.run(main_prog, feed=feed, fetch_list=[])
+    lv = exe.run(main_prog, feed=feed, fetch_list=[loss])[0]  # final sync
+    dt = time.perf_counter() - t0
+    assert np.isfinite(lv).all()
+    return dt
+
+
 def child_resnet():
     import jax
     import jax.numpy as jnp
@@ -132,16 +151,7 @@ def child_resnet():
             "label": jnp.asarray(
                 rng.randint(0, 10, (batch, 1)).astype("int64")),
         }
-        for _ in range(warmup):
-            exe.run(main_prog, feed=feed, fetch_list=[])
-        lv = exe.run(main_prog, feed=feed, fetch_list=[loss])[0]
-        assert np.isfinite(lv).all()
-        t0 = time.perf_counter()
-        for _ in range(steps - 1):
-            exe.run(main_prog, feed=feed, fetch_list=[])
-        lv = exe.run(main_prog, feed=feed, fetch_list=[loss])[0]
-        dt = time.perf_counter() - t0
-        assert np.isfinite(lv).all()
+        dt = _timed_steps(exe, main_prog, feed, loss, warmup, steps)
     ips = batch * steps / dt
     mfu = ips * RESNET50_TRAIN_FLOPS_PER_IMAGE / peak_flops(dev)
     print(json.dumps({
@@ -180,16 +190,7 @@ def child_ctr():
         0, vocab, (batch, slot_len)).astype("int64")
         for i in range(num_slots)}
     feed["label"] = rng.randint(0, 2, (batch, 1)).astype("int64")
-    for _ in range(warmup):
-        exe.run(main_prog, feed=feed, fetch_list=[])
-    lv = exe.run(main_prog, feed=feed, fetch_list=[loss])[0]  # sync
-    assert np.isfinite(lv).all()
-    t0 = time.perf_counter()
-    for _ in range(steps - 1):
-        exe.run(main_prog, feed=feed, fetch_list=[])
-    lv = exe.run(main_prog, feed=feed, fetch_list=[loss])[0]
-    dt = time.perf_counter() - t0
-    assert np.isfinite(lv).all()
+    dt = _timed_steps(exe, main_prog, feed, loss, warmup, steps)
     eps = batch * steps / dt
     print(json.dumps({
         "metric": "deepfm_host_table_train_examples_per_sec_per_chip"
@@ -234,17 +235,7 @@ def child_bert(seq_len=128):
     # timed loop should not pay per-step H2D latency for an identical batch
     feed = {k: jnp.asarray(v) for k, v in feed.items()}
 
-    for _ in range(warmup):
-        exe.run(main_prog, feed=feed, fetch_list=[])
-    lv = exe.run(main_prog, feed=feed, fetch_list=[loss])[0]  # sync
-    assert np.isfinite(lv).all()
-
-    t0 = time.perf_counter()
-    for _ in range(steps - 1):
-        exe.run(main_prog, feed=feed, fetch_list=[])
-    lv = exe.run(main_prog, feed=feed, fetch_list=[loss])[0]  # final sync
-    dt = time.perf_counter() - t0
-    assert np.isfinite(lv).all()
+    dt = _timed_steps(exe, main_prog, feed, loss, warmup, steps)
 
     tokens_per_sec = batch * seq_len * steps / dt
     flops_per_token = model_train_flops_per_token(cfg, seq_len)
@@ -342,10 +333,10 @@ def main():
         # also printed last (last-line-wins consumers read the headline
         # metric), and with these caps the flagship always receives its
         # full cap even if every earlier child burns its own.
-        # worst-case non-flagship spend: 120 probe + 110 + 400 + 300 =
-        # 930s, leaving 450s ≥ the flagship's full 420s cap even after
-        # per-timeout kill-drains — the invariant below depends on this
-        plan = [("ctr", 110), ("resnet", 400), ("bert512", 300),
+        # worst-case non-flagship spend incl. the 15s post-SIGKILL drain
+        # per timeout (_run_child): (120+15)+(110+15)+(370+15)+(270+15)
+        # = 930s, leaving 450s ≥ the flagship's full 420s cap
+        plan = [("ctr", 110), ("resnet", 370), ("bert512", 270),
                 ("bert", 420)]
         for mode, cap in plan:
             w_ok, w_lines, w_err = _run_child(mode, remaining(cap))
