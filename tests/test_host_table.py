@@ -185,6 +185,59 @@ def test_deepfm_model_with_host_tables_trains():
     assert losses[-1] < losses[0], losses
 
 
+def test_host_table_with_train_from_dataset():
+    """The reference CTR deployment shape end to end: MultiSlot files →
+    InMemoryDataset → train_from_dataset, with the embedding tables
+    HOST-RESIDENT (ids reach the prefetch through the dataset's feed
+    dicts — the dist_ctr.py + pserver-table composition, pserver-free)."""
+    import os
+    import tempfile
+
+    from paddle_tpu.dataset import DatasetFactory
+
+    rng = np.random.RandomState(11)
+    tmpd = tempfile.mkdtemp()
+    path = os.path.join(tmpd, "part-0")
+    with open(path, "w") as f:
+        for _ in range(32):
+            y = rng.randint(0, 2)
+            ids = rng.randint(1, 5000, 3)
+            f.write("1 %d 3 %s\n" % (y, " ".join(map(str, ids))))
+
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        slot = fluid.layers.data("slot", shape=[3], dtype="int64")
+        slab = fluid.layers.host_embedding(slot, size=[5000, 8],
+                                           name="ds.tbl", lr=0.1)
+        pooled = fluid.layers.reduce_sum(slab, dim=1)
+        logit = fluid.layers.fc(pooled, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.sigmoid_cross_entropy_with_logits(
+                logit, fluid.layers.cast(label, "float32")))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+
+    dataset = DatasetFactory().create_dataset("InMemoryDataset")
+    dataset.set_use_var([label, slot])
+    dataset.set_batch_size(8)
+    dataset.set_filelist([path])
+    dataset.load_into_memory()
+
+    t0 = host_table.get_table("ds.tbl").value.copy()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        results = exe.train_from_dataset(
+            program=main, dataset=dataset, fetch_list=[loss],
+            print_period=100)
+    assert len(results) == 4  # 32 / 8
+    assert all(np.isfinite(r[0]).all() for r in results)
+    t = host_table.get_table("ds.tbl")
+    t.join()
+    assert (t.value != t0).any()  # the sparse push actually updated rows
+
+
 def test_adagrad_accumulator_survives_checkpoint():
     import tempfile
 

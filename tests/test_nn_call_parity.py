@@ -651,6 +651,45 @@ def test_metrics_accumulators():
     assert abs(avg - 1.0) < 1e-9 and abs(err - 2 / 3) < 1e-9
 
 
+def test_fpn_style_gn_net_trains():
+    """Model-level unblock proof: an FPN-style top-down pathway (lateral
+    1x1 convs + resize_nearest upsample + group_norm heads) — the exact
+    pattern the round-3 stubs broke — trains end to end."""
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = _f32("img", 2, 3, 32, 32)
+        y = _f32("yt", 2, 1)
+        # bottom-up: 3 levels
+        c2 = L.conv2d(img, 8, 3, stride=2, padding=1, act="relu")  # 16²
+        c3 = L.conv2d(c2, 8, 3, stride=2, padding=1, act="relu")   # 8²
+        c4 = L.conv2d(c3, 8, 3, stride=2, padding=1, act="relu")   # 4²
+        # top-down with lateral adds and GN heads
+        p4 = L.group_norm(L.conv2d(c4, 8, 1), groups=4, act="relu")
+        up4 = L.resize_nearest(p4, out_shape=[8, 8])
+        p3 = L.group_norm(
+            L.elementwise_add(L.conv2d(c3, 8, 1), up4), groups=4,
+            act="relu")
+        up3 = L.resize_bilinear(p3, out_shape=[16, 16])
+        p2 = L.group_norm(
+            L.elementwise_add(L.conv2d(c2, 8, 1), up3), groups=4,
+            act="relu")
+        pooled = L.pool2d(p2, 2, global_pooling=True)
+        pred = L.fc(pooled, size=1)
+        loss = L.mean(L.square_error_cost(pred, y))
+        fluid.optimizer.Adam(0.01).minimize(loss)
+    rng = np.random.RandomState(0)
+    xv = rng.randn(2, 3, 32, 32).astype("float32")
+    yv = xv.mean(axis=(1, 2, 3), keepdims=False)[:, None] * 2
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        losses = [float(np.asarray(exe.run(
+            main, feed={"img": xv, "yt": yv.astype("float32")},
+            fetch_list=[loss])[0]).reshape(())) for _ in range(20)]
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
 # ---------------------------------------------------------------------------
 # OpTest grad checks (analytic vs finite difference) for the round-4 ops
 # ---------------------------------------------------------------------------
