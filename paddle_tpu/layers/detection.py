@@ -302,14 +302,33 @@ def ssd_loss(location, confidence, gt_box, gt_label, prior_box,
     """Simplified SSD training loss with static shapes.
 
     The reference composes bipartite_match + target_assign +
-    mine_hard_examples (detection.py:1074).  TPU-static version: per-prior
-    argmax matching against padded gt boxes (gt padded with zero-area boxes,
-    label slot required to be [N, G] with -1 padding), hard-negative mining
-    by per-image top-k over a static negative budget.
-    """
-    raise NotImplementedError(
-        "ssd_loss composite lands with the SSD model; use "
-        "iou_similarity/box_coder/sigmoid_focal_loss directly")
+    mine_hard_examples (detection.py:1074).  TPU-static version (ssd_loss
+    op): per-prior argmax matching against padded gt boxes (gt padded
+    with zero-area boxes, label slot [N, G] with -1 padding),
+    hard-negative mining by per-image rank under a
+    ceil(neg_pos_ratio·npos) budget.  Returns the [N, P, 1] per-prior
+    weighted loss (reduce it for the scalar objective)."""
+    if mining_type != "max_negative":
+        raise ValueError("ssd_loss supports mining_type='max_negative'")
+    helper = LayerHelper("ssd_loss", **locals())
+    out = helper.create_variable_for_type_inference(location.dtype)
+    inputs = {"Loc": [location], "Conf": [confidence], "GTBox": [gt_box],
+              "GTLabel": [gt_label], "PriorBox": [prior_box]}
+    if prior_box_var is not None:
+        inputs["PriorBoxVar"] = [prior_box_var]
+    helper.append_op(
+        type="ssd_loss", inputs=inputs, outputs={"Loss": [out]},
+        attrs={
+            "background_label": int(background_label),
+            "overlap_threshold": float(overlap_threshold),
+            "neg_pos_ratio": float(neg_pos_ratio),
+            "neg_overlap": float(neg_overlap),
+            "loc_loss_weight": float(loc_loss_weight),
+            "conf_loss_weight": float(conf_loss_weight),
+            "normalize": bool(normalize),
+        },
+    )
+    return out
 
 
 def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,

@@ -92,7 +92,13 @@ def scaled_dot_product_attention(queries, keys, values, num_heads=1,
     return _merge_heads(ctx)
 
 
-def sequence_conv_pool(*args, **kwargs):
-    raise NotImplementedError(
-        "sequence_conv_pool lands with the sequence-op batch (stage 7)"
-    )
+def sequence_conv_pool(input, num_filters, filter_size, param_attr=None,
+                       act="sigmoid", pool_type="max", bias_attr=None,
+                       seq_len=None):
+    """Sequence conv + pool composite (reference nets.py:249): input is a
+    padded [B, T, N] batch (+ optional seq_len, the LoD replacement)."""
+    conv = layers.sequence_conv(
+        input, num_filters=num_filters, filter_size=filter_size,
+        param_attr=param_attr, act=act, bias_attr=bias_attr,
+        seq_len=seq_len)
+    return layers.sequence_pool(conv, pool_type, seq_len=seq_len)

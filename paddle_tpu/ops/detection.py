@@ -1559,3 +1559,99 @@ def retinanet_detection_output(ctx, attrs, BBoxes, Scores, Anchors,
     sel = cand[idx]
     valid = jnp.isfinite(top_s)
     return jnp.where(valid[:, None], sel, -1.0)
+
+
+@register_op("ssd_loss",
+             inputs=["Loc", "Conf", "GTBox", "GTLabel", "PriorBox",
+                     "PriorBoxVar"],
+             outputs=["Loss"])
+def ssd_loss(ctx, attrs, Loc, Conf, GTBox, GTLabel, PriorBox, PriorBoxVar):
+    """SSD training loss (reference layers/detection.py:1074 composite:
+    bipartite_match + target_assign + mine_hard_examples + smooth_l1 +
+    softmax CE), redesigned TPU-static in one fused computation:
+
+    Loc [N,P,4], Conf [N,P,C], GTBox [N,G,4] (zero-area padding rows),
+    GTLabel [N,G] (-1 padding), PriorBox [P,4], PriorBoxVar [P,4]|None →
+    Loss [N,P,1] per-prior weighted loss (normalize divides by the
+    per-image positive count, the reference's npos normalization).
+
+    Matching = per-prior argmax IoU thresholded at overlap_threshold,
+    plus the bipartite seed (each valid gt force-claims its best prior);
+    negatives = unmatched priors with best IoU < neg_overlap, hardest
+    ceil(neg_pos_ratio·npos) kept by rank (mining is stop_gradient, like
+    the reference's non-differentiable mining op).
+    """
+    bg = int(attrs.get("background_label", 0))
+    ov_th = float(attrs.get("overlap_threshold", 0.5))
+    ratio = float(attrs.get("neg_pos_ratio", 3.0))
+    neg_ov = float(attrs.get("neg_overlap", 0.5))
+    loc_w = float(attrs.get("loc_loss_weight", 1.0))
+    conf_w = float(attrs.get("conf_loss_weight", 1.0))
+    normalize = bool(attrs.get("normalize", True))
+
+    P = PriorBox.shape[0]
+    G = GTBox.shape[1]
+    pcx, pcy, pw, ph = _center_size(PriorBox, True)
+    var = (PriorBoxVar if PriorBoxVar is not None
+           else jnp.asarray([0.1, 0.1, 0.2, 0.2], Loc.dtype)[None, :]
+           * jnp.ones((P, 4), Loc.dtype))
+
+    def one(loc, conf, gtb, gtl):
+        gtl = gtl.reshape(-1).astype(jnp.int32)
+        valid_gt = gtl >= 0
+        iou = _pairwise_iou(gtb, PriorBox, True)          # [G, P]
+        iou = jnp.where(valid_gt[:, None], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=0).astype(jnp.int32)   # [P]
+        best_iou = jnp.max(iou, axis=0)                       # [P]
+        match = jnp.where(best_iou > ov_th, best_gt, -1)
+        # bipartite seed: every valid gt claims its best prior.  Invalid
+        # (padding) gts are redirected to the out-of-bounds index P so
+        # their scatter is DROPPED — a where() on the update value would
+        # still write a stale match[best_prior] at prior 0 for every
+        # padding row, clobbering real seeds (last-writer-wins)
+        best_prior = jnp.argmax(iou, axis=1).astype(jnp.int32)  # [G]
+        seed_idx = jnp.where(valid_gt, best_prior, P)
+        match = match.at[seed_idx].set(
+            jnp.arange(G, dtype=jnp.int32), mode="drop")
+        pos = match >= 0
+
+        # conf CE per prior against matched label (bg for negatives)
+        lab = jnp.where(pos, gtl[jnp.maximum(match, 0)], bg)
+        logp = jax.nn.log_softmax(conf, axis=-1)
+        ce = -jnp.take_along_axis(logp, lab[:, None], axis=1)[:, 0]
+
+        # loc smooth_l1 on positives, encoded center-size deltas
+        tgt = gtb[jnp.maximum(match, 0)]                 # [P, 4]
+        tcx = (tgt[:, 0] + tgt[:, 2]) / 2.0
+        tcy = (tgt[:, 1] + tgt[:, 3]) / 2.0
+        tw = jnp.maximum(tgt[:, 2] - tgt[:, 0], 1e-8)
+        th = jnp.maximum(tgt[:, 3] - tgt[:, 1], 1e-8)
+        enc = jnp.stack([
+            (tcx - pcx) / pw, (tcy - pcy) / ph,
+            jnp.log(tw / pw), jnp.log(th / ph)], axis=-1) / var
+        d = loc - jax.lax.stop_gradient(enc)
+        sl1 = jnp.where(jnp.abs(d) < 1.0, 0.5 * d * d,
+                        jnp.abs(d) - 0.5).sum(axis=-1)
+        loc_loss = jnp.where(pos, sl1, 0.0)
+
+        # hard-negative mining (stop_gradient selection)
+        npos = jnp.sum(pos)
+        cand = (~pos) & (best_iou < neg_ov)
+        nloss = jnp.where(cand, jax.lax.stop_gradient(ce), -jnp.inf)
+        ranks = jnp.argsort(jnp.argsort(-nloss))
+        quota = jnp.minimum(
+            jnp.ceil(npos.astype(jnp.float32) * ratio).astype(jnp.int32),
+            jnp.sum(cand))
+        keep_neg = cand & (ranks < quota)
+
+        sel = pos | keep_neg
+        per_prior = (conf_w * jnp.where(sel, ce, 0.0)
+                     + loc_w * loc_loss)
+        if normalize:
+            per_prior = per_prior / jnp.maximum(
+                npos.astype(per_prior.dtype), 1.0)
+        return per_prior
+
+    loss = jax.vmap(one)(Loc, Conf, GTBox,
+                         GTLabel.reshape(GTBox.shape[0], G))
+    return loss[..., None]

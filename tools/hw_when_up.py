@@ -92,7 +92,7 @@ def main():
         if up:
             note("TUNNEL UP: %s" % out.strip()[-120:])
             break
-        note("probe down (rc!=0)")
+        note("probe down: %s" % (out.strip()[-160:] or "no output"))
         time.sleep(POLL_S)
     else:
         note("watch window exhausted; tunnel never came up")
