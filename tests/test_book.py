@@ -223,11 +223,17 @@ class TestLabelSemanticRoles:
 
 
 class TestUnderstandSentiment:
-    """book/test_understand_sentiment.py: embedding + masked mean-pool
-    classifier on the sentiment reader pipeline (canned dataset →
-    reader decorators → feed)."""
+    """book/test_understand_sentiment.py: the sentiment pipeline (canned
+    dataset → reader decorators → feed) with two network bodies — the
+    masked mean-pool baseline and the reference's convolution_net
+    (notest_understand_sentiment.py:28: two sequence_conv_pool towers,
+    tanh, sqrt pooling, multi-input fc)."""
 
-    def test_train_reaches_accuracy(self):
+    L = 40
+
+    def _train(self, net_fn, lr):
+        """Shared scaffold: build program with ``net_fn(emb, lens) ->
+        logits``, train 40 batches, return per-batch accuracies."""
         import random
 
         from paddle_tpu import datasets, reader_decorators as rd
@@ -236,7 +242,7 @@ class TestUnderstandSentiment:
         # batch order (and the accuracy threshold) is independent of
         # whichever tests ran before in the same process
         random.seed(1234)
-        L = 40
+        L = self.L
         V = datasets.sentiment.VOCAB
         fluid.unique_name.switch()
         main, startup = fluid.Program(), fluid.Program()
@@ -245,20 +251,13 @@ class TestUnderstandSentiment:
             ids = fluid.layers.data("ids", shape=[L], dtype="int64")
             lens = fluid.layers.data("lens", shape=[], dtype="int64")
             label = fluid.layers.data("label", shape=[1], dtype="int64")
-            emb = fluid.layers.embedding(ids, size=[V, 16])
-            mask = fluid.layers.cast(
-                fluid.layers.sequence_mask(lens, maxlen=L), "float32")
-            summed = fluid.layers.reduce_sum(
-                fluid.layers.elementwise_mul(
-                    emb, fluid.layers.unsqueeze(mask, [2])), dim=[1])
-            denom = fluid.layers.unsqueeze(
-                fluid.layers.reduce_sum(mask, dim=[1]), [1])
-            pooled = fluid.layers.elementwise_div(summed, denom)
-            logits = fluid.layers.fc(pooled, size=2)
+            emb = fluid.layers.embedding(ids, size=[V, 32])
+            logits = net_fn(emb, lens)
             loss = fluid.layers.mean(
                 fluid.layers.softmax_with_cross_entropy(logits, label))
-            acc = fluid.layers.accuracy(fluid.layers.softmax(logits), label)
-            fluid.optimizer.Adam(5e-3).minimize(loss)
+            acc = fluid.layers.accuracy(fluid.layers.softmax(logits),
+                                        label)
+            fluid.optimizer.Adam(lr).minimize(loss)
 
         reader = rd.batch(
             rd.shuffle(datasets.sentiment.train(), buf_size=500), 64)
@@ -284,4 +283,32 @@ class TestUnderstandSentiment:
                     break
                 av = exe.run(main, feed=to_feed(b), fetch_list=[acc])[0]
                 accs.append(float(np.asarray(av).reshape(())))
+        return accs
+
+    def test_train_reaches_accuracy(self):
+        def mean_pool_net(emb, lens):
+            mask = fluid.layers.cast(
+                fluid.layers.sequence_mask(lens, maxlen=self.L), "float32")
+            summed = fluid.layers.reduce_sum(
+                fluid.layers.elementwise_mul(
+                    emb, fluid.layers.unsqueeze(mask, [2])), dim=[1])
+            denom = fluid.layers.unsqueeze(
+                fluid.layers.reduce_sum(mask, dim=[1]), [1])
+            pooled = fluid.layers.elementwise_div(summed, denom)
+            return fluid.layers.fc(pooled, size=2)
+
+        accs = self._train(mean_pool_net, lr=5e-3)
+        assert np.mean(accs[-5:]) > 0.8, accs[-5:]
+
+    def test_convolution_net_reaches_accuracy(self):
+        def convolution_net(emb, lens):
+            conv_3 = fluid.nets.sequence_conv_pool(
+                emb, num_filters=32, filter_size=3, act="tanh",
+                pool_type="sqrt", seq_len=lens)
+            conv_4 = fluid.nets.sequence_conv_pool(
+                emb, num_filters=32, filter_size=4, act="tanh",
+                pool_type="sqrt", seq_len=lens)
+            return fluid.layers.fc([conv_3, conv_4], size=2)
+
+        accs = self._train(convolution_net, lr=2e-3)
         assert np.mean(accs[-5:]) > 0.8, accs[-5:]
