@@ -78,6 +78,12 @@ from . import native
 from . import recordio_writer
 from . import inference
 from . import reader_decorators
+from . import dygraph_grad_clip
+from . import install_check
+from . import host_table
+from .lod_tensor import (LoDTensor, LoDTensorArray, create_lod_tensor,
+                         create_random_int_lodtensor)
+from .transpiler import memory_optimize, release_memory
 from . import datasets
 from .reader_decorators import batch
 from .reader import PyReader, DataLoader
@@ -155,4 +161,26 @@ __all__ = [
     "cpu_places",
     "cuda_places",
     "tpu_places",
+    "dygraph_grad_clip",
+    "install_check",
+    "host_table",
+    "LoDTensor",
+    "LoDTensorArray",
+    "create_lod_tensor",
+    "create_random_int_lodtensor",
+    "memory_optimize",
+    "release_memory",
+    "is_compiled_with_cuda",
+    "cuda_pinned_places",
 ]
+
+
+def is_compiled_with_cuda():
+    """reference fluid.is_compiled_with_cuda — this backend is XLA/TPU."""
+    return False
+
+
+def cuda_pinned_places(device_count=None):
+    """reference fluid.cuda_pinned_places: pinned host staging areas are
+    XLA's job on TPU; returns CPU places for API compatibility."""
+    return cpu_places(device_count)

@@ -13,7 +13,13 @@ from . import layers
 from .memory_usage_calc import memory_usage
 from .op_frequence import op_freq_statistic
 from .extend_optimizer import extend_with_decoupled_weight_decay
+from .layers import (BasicGRUUnit, BasicLSTMUnit, basic_gru, basic_lstm,
+                     fused_elemwise_activation)
+from .slim.quantization.quantization_pass import (
+    QuantizationTranspiler as QuantizeTranspiler)
 
 __all__ = ["mixed_precision", "slim", "extend_optimizer", "layers",
            "memory_usage", "op_freq_statistic",
-           "extend_with_decoupled_weight_decay"]
+           "extend_with_decoupled_weight_decay",
+           "BasicGRUUnit", "BasicLSTMUnit", "basic_gru", "basic_lstm",
+           "fused_elemwise_activation", "QuantizeTranspiler"]

@@ -1,3 +1,6 @@
 from .nn import fused_elemwise_activation  # noqa: F401
+from .rnn_impl import (BasicGRUUnit, BasicLSTMUnit, basic_gru,  # noqa: F401
+                       basic_lstm)
 
-__all__ = ["fused_elemwise_activation"]
+__all__ = ["fused_elemwise_activation", "BasicGRUUnit", "BasicLSTMUnit",
+           "basic_gru", "basic_lstm"]

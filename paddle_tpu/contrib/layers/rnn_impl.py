@@ -1,0 +1,200 @@
+"""Basic RNN building blocks (reference:
+``python/paddle/fluid/contrib/layers/rnn_impl.py`` — BasicLSTMUnit /
+BasicGRUUnit cells built from basic ops, plus the multi-layer
+``basic_lstm`` / ``basic_gru`` drivers).
+
+TPU redesign: the cells are thin composites over basic ops (one [x,h]
+matmul per step — MXU-shaped); the drivers run the framework's
+scan-based lstm/gru ops per layer/direction over padded batch-first
+[B, T, D] input with an optional ``sequence_length`` mask (the LoD
+replacement).  Initial states follow the reference layout
+[num_layers*dirs, B, H]."""
+
+import paddle_tpu as fluid
+from ...layer_helper import LayerHelper  # noqa: F401 (API parity)
+from ...param_attr import ParamAttr
+
+__all__ = ["BasicGRUUnit", "BasicLSTMUnit", "basic_gru", "basic_lstm"]
+
+
+def _act(name, default):
+    """Resolve an activation given as None (default), a name string, or a
+    callable layer function."""
+    from ...layers import ops as _ops
+
+    if name is None:
+        return getattr(_ops, default)
+    if callable(name):
+        return name
+    return getattr(_ops, str(name))
+
+
+class BasicLSTMUnit:
+    """One LSTM step on [B, D] input + [B, H] states (reference
+    rnn_impl.py:622): gates from one fc over [x, h]."""
+
+    def __init__(self, name_scope, hidden_size, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 forget_bias=1.0, dtype="float32"):
+        self._name = name_scope
+        self._hidden = int(hidden_size)
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._gate_act = _act(gate_activation, "sigmoid")
+        self._act = _act(activation, "tanh")
+        self._forget_bias = float(forget_bias)
+
+    def __call__(self, input, pre_hidden, pre_cell):
+        concat = fluid.layers.concat([input, pre_hidden], axis=1)
+        gates = fluid.layers.fc(
+            concat, size=4 * self._hidden, param_attr=self._param_attr,
+            bias_attr=self._bias_attr)
+        i, f, g, o = fluid.layers.split(gates, 4, dim=1)
+        i = self._gate_act(i)
+        f = self._gate_act(fluid.layers.scale(f, bias=self._forget_bias))
+        o = self._gate_act(o)
+        g = self._act(g)
+        new_cell = fluid.layers.elementwise_add(
+            fluid.layers.elementwise_mul(f, pre_cell),
+            fluid.layers.elementwise_mul(i, g))
+        new_hidden = fluid.layers.elementwise_mul(o, self._act(new_cell))
+        return new_hidden, new_cell
+
+
+class BasicGRUUnit:
+    """One GRU step on [B, D] input + [B, H] state (reference
+    rnn_impl.py:22)."""
+
+    def __init__(self, name_scope, hidden_size, param_attr=None,
+                 bias_attr=None, gate_activation=None, activation=None,
+                 dtype="float32"):
+        self._name = name_scope
+        self._hidden = int(hidden_size)
+        self._param_attr = param_attr
+        self._bias_attr = bias_attr
+        self._gate_act = _act(gate_activation, "sigmoid")
+        self._act = _act(activation, "tanh")
+
+    def __call__(self, input, pre_hidden):
+        concat = fluid.layers.concat([input, pre_hidden], axis=1)
+        ur = fluid.layers.fc(concat, size=2 * self._hidden,
+                             param_attr=self._param_attr,
+                             bias_attr=self._bias_attr)
+        u, r = fluid.layers.split(self._gate_act(ur), 2, dim=1)
+        cand_in = fluid.layers.concat(
+            [input, fluid.layers.elementwise_mul(r, pre_hidden)], axis=1)
+        c = self._act(fluid.layers.fc(
+            cand_in, size=self._hidden, param_attr=self._param_attr,
+            bias_attr=self._bias_attr))
+        one_minus_u = fluid.layers.scale(u, scale=-1.0, bias=1.0)
+        return fluid.layers.elementwise_add(
+            fluid.layers.elementwise_mul(u, pre_hidden),
+            fluid.layers.elementwise_mul(one_minus_u, c))
+
+
+def _layer_io(input, batch_first):
+    if not batch_first:
+        input = fluid.layers.transpose(input, [1, 0, 2])
+    return input
+
+
+def _init_state(init, idx):
+    """Slice [num_layers*dirs, B, H] initial state to [B, H] for slot
+    ``idx`` (reference rnn_impl per-layer slicing); None stays None."""
+    if init is None:
+        return None
+    return fluid.layers.squeeze(
+        fluid.layers.slice(init, axes=[0], starts=[idx], ends=[idx + 1]),
+        [0])
+
+
+def _last_step(h, d, sequence_length):
+    """Final state of a direction: forward ends at t=len-1; the REVERSE
+    scan's outputs are flipped back to input time order by the lstm/gru
+    op, so its final state sits at t=0."""
+    if d == 0:
+        return fluid.layers.sequence_last_step(h, seq_len=sequence_length)
+    return fluid.layers.sequence_first_step(h)
+
+
+def basic_lstm(input, init_hidden, init_cell, hidden_size, num_layers=1,
+               sequence_length=None, dropout_prob=0.0, bidirectional=False,
+               batch_first=True, param_attr=None, bias_attr=None,
+               gate_activation=None, activation=None, dtype="float32",
+               name="basic_lstm"):
+    """Multi-layer (optionally bidirectional) LSTM over padded input
+    (reference rnn_impl.py:353).  Returns (rnn_out, last_hidden,
+    last_cell) with rnn_out [B, T, H*dirs] (batch_first) and last states
+    [num_layers*dirs, B, H]."""
+    x = _layer_io(input, batch_first)
+    dirs = 2 if bidirectional else 1
+    lasts_h, lasts_c = [], []
+    for layer in range(num_layers):
+        outs = []
+        for d in range(dirs):
+            idx = layer * dirs + d
+            proj = fluid.layers.fc(
+                x, size=4 * hidden_size, num_flatten_dims=2,
+                bias_attr=False,
+                param_attr=ParamAttr(name="%s_l%d_d%d_x" % (name, layer,
+                                                            d)))
+            h, c = fluid.layers.dynamic_lstm(
+                proj, size=4 * hidden_size, use_peepholes=False,
+                is_reverse=(d == 1), seq_len=sequence_length,
+                h_0=_init_state(init_hidden, idx),
+                c_0=_init_state(init_cell, idx),
+                param_attr=ParamAttr(name="%s_l%d_d%d_h" % (name, layer,
+                                                            d)),
+                bias_attr=ParamAttr(name="%s_l%d_d%d_b" % (name, layer,
+                                                           d)))
+            outs.append(h)
+            lasts_h.append(_last_step(h, d, sequence_length))
+            lasts_c.append(_last_step(c, d, sequence_length))
+        x = outs[0] if dirs == 1 else fluid.layers.concat(outs, axis=2)
+        if dropout_prob:
+            x = fluid.layers.dropout(
+                x, dropout_prob,
+                dropout_implementation="upscale_in_train")
+    last_h = fluid.layers.stack(lasts_h, axis=0)
+    last_c = fluid.layers.stack(lasts_c, axis=0)
+    out = x if batch_first else fluid.layers.transpose(x, [1, 0, 2])
+    return out, last_h, last_c
+
+
+def basic_gru(input, init_hidden, hidden_size, num_layers=1,
+              sequence_length=None, dropout_prob=0.0, bidirectional=False,
+              batch_first=True, param_attr=None, bias_attr=None,
+              gate_activation=None, activation=None, dtype="float32",
+              name="basic_gru"):
+    """Multi-layer (optionally bidirectional) GRU over padded input
+    (reference rnn_impl.py:139)."""
+    x = _layer_io(input, batch_first)
+    dirs = 2 if bidirectional else 1
+    lasts_h = []
+    for layer in range(num_layers):
+        outs = []
+        for d in range(dirs):
+            idx = layer * dirs + d
+            proj = fluid.layers.fc(
+                x, size=3 * hidden_size, num_flatten_dims=2,
+                bias_attr=False,
+                param_attr=ParamAttr(name="%s_l%d_d%d_x" % (name, layer,
+                                                            d)))
+            h = fluid.layers.dynamic_gru(
+                proj, size=hidden_size, is_reverse=(d == 1),
+                seq_len=sequence_length,
+                h_0=_init_state(init_hidden, idx),
+                param_attr=ParamAttr(name="%s_l%d_d%d_h" % (name, layer,
+                                                            d)),
+                bias_attr=ParamAttr(name="%s_l%d_d%d_b" % (name, layer,
+                                                           d)))
+            outs.append(h)
+            lasts_h.append(_last_step(h, d, sequence_length))
+        x = outs[0] if dirs == 1 else fluid.layers.concat(outs, axis=2)
+        if dropout_prob:
+            x = fluid.layers.dropout(
+                x, dropout_prob,
+                dropout_implementation="upscale_in_train")
+    last_h = fluid.layers.stack(lasts_h, axis=0)
+    out = x if batch_first else fluid.layers.transpose(x, [1, 0, 2])
+    return out, last_h

@@ -1,5 +1,6 @@
 from .decorator import decorate, OptimizerWithMixedPrecision, rewrite_program_bf16
 from . import fp16_lists
+from .fp16_lists import AutoMixedPrecisionLists
 
 __all__ = ["decorate", "OptimizerWithMixedPrecision", "rewrite_program_bf16",
-           "fp16_lists"]
+           "fp16_lists", "AutoMixedPrecisionLists"]

@@ -36,3 +36,30 @@ class DataFeeder:
                 arr = arr.astype(var.dtype)
             out[var.name] = arr
         return out
+
+    def feed_parallel(self, iterable, num_places=None):
+        """reference DataFeeder.feed_parallel: one feed dict per place.
+        Under GSPMD one jit consumes the whole batch, so this yields the
+        per-place SPLITS of each mini-batch for API compatibility."""
+        for batch in iterable:
+            fed = self.feed(batch)
+            n = num_places or 1
+            splits = {k: np.array_split(v, n) for k, v in fed.items()}
+            yield [{k: splits[k][i] for k in splits} for i in range(n)]
+
+    def decorate_reader(self, reader, multi_devices=False,
+                        num_places=None, drop_last=True):
+        """reference DataFeeder.decorate_reader: wrap a batch reader so it
+        yields ready feed dicts."""
+
+        def _reader():
+            for batch in reader():
+                if multi_devices:
+                    n = num_places or 1
+                    if drop_last and len(batch) % n:
+                        continue
+                    yield list(self.feed_parallel([batch], n))[0]
+                else:
+                    yield self.feed(batch)
+
+        return _reader

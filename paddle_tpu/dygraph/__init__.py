@@ -17,6 +17,21 @@ from .nn import (Linear, FC, Conv2D, Pool2D, BatchNorm, Embedding,
                  NCE)
 from .parallel import DataParallel, ParallelEnv, prepare_context
 from .checkpoint import save_dygraph, load_dygraph
+from .learning_rate_scheduler import (
+    LearningRateDecay, PiecewiseDecay, NaturalExpDecay, ExponentialDecay,
+    InverseTimeDecay, PolynomialDecay, CosineDecay, NoamDecay)
+from .tape import Tape as Tracer  # reference imperative.Tracer role
+
+
+class BackwardStrategy:
+    """reference dygraph.BackwardStrategy (pybind imperative.cc): the only
+    knob, sort_sum_gradient, orders fan-in grad sums deterministically —
+    our tape already accumulates in deterministic program order, so the
+    flag is accepted and inert."""
+
+    def __init__(self):
+        self.sort_sum_gradient = False
+
 
 __all__ = [
     "guard", "enabled", "to_variable", "enable_dygraph", "disable_dygraph",
@@ -24,4 +39,7 @@ __all__ = [
     "Pool2D", "BatchNorm", "Embedding", "LayerNorm", "Dropout",
     "DataParallel", "ParallelEnv", "prepare_context",
     "save_dygraph", "load_dygraph",
+    "LearningRateDecay", "PiecewiseDecay", "NaturalExpDecay",
+    "ExponentialDecay", "InverseTimeDecay", "PolynomialDecay",
+    "CosineDecay", "NoamDecay", "Tracer", "BackwardStrategy",
 ]

@@ -1,0 +1,23 @@
+"""Dygraph gradient clipping (reference:
+``python/paddle/fluid/dygraph_grad_clip.py`` GradClipByValue/Norm/
+GlobalNorm).  Same math as the graph-path clip classes — the optimizer's
+eager minimize(grad_clip=...) applies them via
+``Optimizer._dygraph_clip_grads``."""
+
+from .clip import (GradientClipByGlobalNorm, GradientClipByNorm,
+                   GradientClipByValue)
+
+__all__ = ["GradClipByValue", "GradClipByNorm", "GradClipByGlobalNorm"]
+
+
+class GradClipByValue(GradientClipByValue):
+    pass
+
+
+class GradClipByNorm(GradientClipByNorm):
+    pass
+
+
+class GradClipByGlobalNorm(GradientClipByGlobalNorm):
+    def __init__(self, max_global_norm):
+        super().__init__(max_global_norm)

@@ -473,6 +473,26 @@ class Program:
         return self.global_block().all_parameters()
 
     # ---- cloning / pruning ----
+    def to_string(self, throw_on_error=True, with_details=False):
+        """reference Program.to_string: serialized program text (here the
+        JSON ProgramDesc form from proto.py, round-trippable via
+        parse_from_string)."""
+        import json as _json
+
+        from .proto import program_to_dict
+
+        return _json.dumps(program_to_dict(self), indent=2)
+
+    @staticmethod
+    def parse_from_string(s):
+        """reference Program.parse_from_string (binary desc → Program);
+        here the JSON form emitted by to_string/proto.save_program."""
+        import json as _json
+
+        from .proto import program_from_dict
+
+        return program_from_dict(_json.loads(s))
+
     def clone(self, for_test=False):
         """Deep-copy the program.  With for_test=True, flip is_test attrs on
         dropout/batch_norm-style ops (reference framework.py:3004)."""

@@ -207,3 +207,23 @@ TruncatedNormal = TruncatedNormalInitializer
 Xavier = XavierInitializer
 MSRA = MSRAInitializer
 Bilinear = BilinearInitializer
+
+
+def force_init_on_cpu():
+    """reference initializer.force_init_on_cpu flag: initializers always
+    run host-side here (startup program on CPU feeds device buffers), so
+    this is constant False for API parity."""
+    return False
+
+
+import contextlib as _contextlib  # noqa: E402
+
+
+@_contextlib.contextmanager
+def init_on_cpu():
+    """reference initializer.init_on_cpu context: a no-op — startup
+    initialization already happens host-side and XLA stages the results."""
+    yield
+
+
+__all__ += ["force_init_on_cpu", "init_on_cpu"]

@@ -1,0 +1,30 @@
+"""Install sanity check (reference:
+``python/paddle/fluid/install_check.py`` run_check — builds and runs a
+tiny linear model to prove the stack works end to end)."""
+
+import numpy as np
+
+__all__ = ["run_check"]
+
+
+def run_check():
+    from . import (CPUPlace, Executor, Program, layers, optimizer,
+                   program_guard)
+    from .executor import Scope, scope_guard
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        x = layers.data("install_check_x", shape=[2], dtype="float32")
+        y = layers.fc(x, size=1)
+        loss = layers.mean(y)
+        optimizer.SGD(0.01).minimize(loss)
+    exe = Executor(CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        out = exe.run(
+            main,
+            feed={"install_check_x": np.ones((2, 2), "float32")},
+            fetch_list=[loss])
+    assert np.isfinite(np.asarray(out[0])).all()
+    print("Your paddle_tpu works well on this machine.")
+    return True

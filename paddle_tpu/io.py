@@ -220,6 +220,9 @@ def save_params(executor, dirname, main_program=None, filename=None):
                      predicate=_is_parameter, filename=filename)
 
 
+from .reader import PyReader  # noqa: F401  (reference fluid.io.PyReader)
+
+
 def _host_tables_of(main_program):
     from . import host_table as _ht
 

@@ -6,11 +6,12 @@ from ..layer_helper import LayerHelper
 
 __all__ = [
     "exp", "tanh", "sqrt", "rsqrt", "abs", "ceil", "floor", "cos", "sin",
+    "acos", "asin", "atan",
     "round", "reciprocal", "square", "softplus", "softsign", "logsigmoid",
     "sigmoid", "relu6", "pow", "stanh", "hard_sigmoid", "swish",
     "thresholded_relu", "hard_shrink", "softshrink", "elu", "gelu", "erf",
     "brelu", "soft_relu", "leaky_relu", "log", "scale", "hard_swish",
-    "sign", "tanh_shrink",
+    "sign", "tanh_shrink", "cumsum", "uniform_random",
 ]
 
 
@@ -126,3 +127,36 @@ def logical_not(x, out=None, name=None):
 
 
 __all__ += ["logical_and", "logical_or", "logical_xor", "logical_not"]
+
+
+acos = _generate_unary("acos")
+asin = _generate_unary("asin")
+atan = _generate_unary("atan")
+
+
+def cumsum(x, axis=None, exclusive=None, reverse=None):
+    """reference layers/ops.py cumsum (cum_op.cc)."""
+    helper = LayerHelper("cumsum", **locals())
+    out = helper.create_variable_for_type_inference(x.dtype)
+    attrs = {}
+    if axis is not None:
+        attrs["axis"] = int(axis)
+    if exclusive is not None:
+        attrs["exclusive"] = bool(exclusive)
+    if reverse is not None:
+        attrs["reverse"] = bool(reverse)
+    helper.append_op(type="cumsum", inputs={"X": [x]},
+                     outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+def uniform_random(shape, dtype="float32", min=-1.0, max=1.0, seed=0):
+    """reference layers/ops.py uniform_random (uniform_random_op.cc)."""
+    helper = LayerHelper("uniform_random", **locals())
+    out = helper.create_variable_for_type_inference(dtype, True)
+    helper.append_op(
+        type="uniform_random", inputs={}, outputs={"Out": [out]},
+        attrs={"shape": list(shape), "dtype": dtype, "min": float(min),
+               "max": float(max), "seed": int(seed)},
+    )
+    return out

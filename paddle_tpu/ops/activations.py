@@ -34,6 +34,9 @@ _unary("floor", jnp.floor)
 _unary("round", jnp.round)
 _unary("cos", jnp.cos)
 _unary("sin", jnp.sin)
+_unary("acos", jnp.arccos)
+_unary("asin", jnp.arcsin)
+_unary("atan", jnp.arctan)
 _unary("tanh_shrink", lambda x: x - jnp.tanh(x))
 _unary("relu6", lambda x: jnp.clip(x, 0.0, 6.0))
 _unary("sign", jnp.sign)

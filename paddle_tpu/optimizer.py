@@ -55,8 +55,12 @@ class Optimizer:
     def __init__(self, learning_rate, regularization=None, name=None):
         self.regularization = regularization
         self._name = name
-        if not isinstance(learning_rate, (float, int, Variable)):
-            raise TypeError("learning_rate must be float or Variable")
+        from .dygraph.learning_rate_scheduler import LearningRateDecay
+
+        if not isinstance(learning_rate,
+                          (float, int, Variable, LearningRateDecay)):
+            raise TypeError("learning_rate must be float, Variable, or a "
+                            "dygraph LearningRateDecay")
         self._learning_rate = learning_rate
         self._learning_rate_map = {}
         # {accum_name: {param_name: accum_var}}
@@ -73,6 +77,14 @@ class Optimizer:
         if isinstance(self._learning_rate, Variable):
             self._learning_rate_map[program] = self._learning_rate
             return
+        from .dygraph.learning_rate_scheduler import LearningRateDecay
+
+        if isinstance(self._learning_rate, LearningRateDecay):
+            raise TypeError(
+                "dygraph LearningRateDecay objects are dygraph-only; on "
+                "the graph path use layers.learning_rate_scheduler (e.g. "
+                "layers.polynomial_decay) which builds the schedule as "
+                "graph ops")
         name = unique_name.generate("learning_rate")
         lr_var = program.global_block().create_var(
             name=name, shape=[1], dtype="float32", persistable=True
@@ -221,8 +233,16 @@ class Optimizer:
         import jax.numpy as jnp
 
         lr = self._learning_rate
+        from .dygraph.learning_rate_scheduler import LearningRateDecay
+
+        if isinstance(lr, LearningRateDecay):
+            # the schedule advances ONCE per minimize (in
+            # _dygraph_minimize) — stepping here would advance it once
+            # per PARAMETER and give params different rates
+            return jnp.asarray([self._eager_decay_lr], jnp.float32)
         if not isinstance(lr, (float, int)):
-            raise TypeError("dygraph mode needs a float learning rate")
+            raise TypeError("dygraph mode needs a float learning rate or a "
+                            "dygraph.LearningRateDecay")
         return jnp.asarray([lr], jnp.float32)
 
     def _eager_apply(self, param):
@@ -290,6 +310,10 @@ class Optimizer:
             )
         if loss is not None and getattr(loss, "_grad", None) is None:
             loss.backward()
+        from .dygraph.learning_rate_scheduler import LearningRateDecay
+
+        if isinstance(self._learning_rate, LearningRateDecay):
+            self._eager_decay_lr = float(self._learning_rate.step())
         if grad_clip is not None:
             self._dygraph_clip_grads(grad_clip, parameter_list)
         for p in parameter_list:

@@ -15,6 +15,7 @@ from . import native
 
 __all__ = [
     "convert_reader_to_recordio_file",
+    "convert_reader_to_recordio_files",
     "recordio_reader",
 ]
 
@@ -55,3 +56,33 @@ def recordio_reader(filename):
                 yield _unpack(record)
 
     return reader
+
+
+def convert_reader_to_recordio_files(
+        filename, batch_per_file, reader_creator, feeder=None,
+        compressor=None, max_num_records=1000):
+    """Multi-file variant (reference recordio_writer.py): split the
+    stream into files of ``batch_per_file`` records named
+    ``filename-00000`` etc.  Returns the list of paths written."""
+    paths = []
+    buf = []
+
+    def flush():
+        if not buf:
+            return
+        path = "%s-%05d" % (filename, len(paths))
+        with native.RecordIOWriter(
+                path, max_chunk_records=max_num_records) as w:
+            for s in buf:
+                w.write(_pack(s))
+        paths.append(path)
+        buf.clear()
+
+    for sample in reader_creator():
+        if not isinstance(sample, (tuple, list)):
+            sample = (sample,)
+        buf.append(sample)
+        if len(buf) >= batch_per_file:
+            flush()
+    flush()
+    return paths

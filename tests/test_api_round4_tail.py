@@ -1,0 +1,271 @@
+"""Round-4 API tail (reference API.spec entries previously absent):
+trig/cumsum/uniform_random layers, LoDTensor helpers, Program
+serialization methods, DataFeeder decorate_reader/feed_parallel,
+contrib basic_lstm/basic_gru + cells, dygraph LR decay objects +
+grad-clip module, install_check, recordio multi-file converter."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.executor import Scope, scope_guard
+
+
+def _run(build, feeds, n_out=1):
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        outs = build()
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        vals = exe.run(main, feed=feeds, fetch_list=list(outs))
+    return vals[0] if n_out == 1 else vals
+
+
+def _d(name, arr):
+    return fluid.layers.data(name, shape=list(arr.shape),
+                             dtype=str(arr.dtype),
+                             append_batch_size=False)
+
+
+def test_trig_and_cumsum_ops():
+    x = np.random.RandomState(0).uniform(-0.9, 0.9, (2, 3)).astype(
+        "float32")
+    for name, ref in (("acos", np.arccos), ("asin", np.arcsin),
+                      ("atan", np.arctan)):
+        got = _run(lambda: getattr(fluid.layers, name)(_d("x", x)),
+                   {"x": x})
+        np.testing.assert_allclose(got, ref(x), atol=1e-5)
+    got = _run(lambda: fluid.layers.cumsum(_d("x", x), axis=1), {"x": x})
+    np.testing.assert_allclose(got, np.cumsum(x, axis=1), atol=1e-5)
+    got = _run(lambda: fluid.layers.cumsum(_d("x", x), axis=0,
+                                           reverse=True), {"x": x})
+    np.testing.assert_allclose(got, np.cumsum(x[::-1], axis=0)[::-1],
+                               atol=1e-5)
+    u = _run(lambda: fluid.layers.uniform_random([4, 5], min=2.0, max=3.0),
+             {})
+    assert u.shape == (4, 5) and (u >= 2.0).all() and (u <= 3.0).all()
+
+
+def test_lod_tensor_helpers():
+    data = np.arange(12).reshape(6, 2).astype("float32")
+    t = fluid.create_lod_tensor(data, [[4, 2]])
+    assert t.lod() == [[0, 4, 6]]
+    np.testing.assert_array_equal(np.asarray(t), data)
+    pad, lens = t.to_padded()
+    assert pad.shape == (2, 4, 2) and lens.tolist() == [4, 2]
+    assert (pad[1, 2:] == 0).all()
+
+    r = fluid.create_random_int_lodtensor([[3, 1]], [1], low=5, high=9)
+    arr = np.asarray(r)
+    assert arr.shape == (4, 1) and (arr >= 5).all() and (arr <= 9).all()
+
+    arr2 = fluid.LoDTensorArray()
+    arr2.append(np.ones((2, 2), "float32"))
+    assert isinstance(arr2[0], fluid.LoDTensor)
+
+
+def test_program_string_roundtrip():
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2, 3], append_batch_size=False)
+        fluid.layers.softmax(x)
+    s = main.to_string()
+    clone = fluid.Program.parse_from_string(s)
+    assert [op.type for op in clone.global_block().ops] == \
+        [op.type for op in main.global_block().ops]
+
+
+def test_data_feeder_decorate_and_parallel():
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+    feeder = fluid.DataFeeder(feed_list=[x, y], program=main)
+
+    def reader():
+        rng = np.random.RandomState(0)
+        for _ in range(3):
+            yield [(rng.rand(3).astype("float32"), i) for i in range(8)]
+
+    batches = list(feeder.decorate_reader(reader)())
+    assert len(batches) == 3 and batches[0]["x"].shape == (8, 3)
+    par = list(feeder.feed_parallel([next(iter(reader()))], num_places=2))
+    assert len(par[0]) == 2 and par[0][0]["x"].shape == (4, 3)
+
+
+def test_contrib_basic_lstm_gru():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 5, 4).astype("float32")
+    sl = np.array([5, 3], "int64")
+
+    def build_lstm():
+        xv = _d("x", x)
+        slv = _d("sl", sl)
+        out, h, c = fluid.contrib.basic_lstm(
+            xv, None, None, hidden_size=6, num_layers=2,
+            sequence_length=slv, bidirectional=True)
+        return out
+
+    out = _run(build_lstm, {"x": x, "sl": sl})
+    assert out.shape == (2, 5, 12) and np.isfinite(out).all()
+
+    def build_gru():
+        xv = _d("x", x)
+        out, h = fluid.contrib.basic_gru(xv, None, hidden_size=6)
+        return out
+
+    out = _run(build_gru, {"x": x})
+    assert out.shape == (2, 5, 6) and np.isfinite(out).all()
+
+
+def test_basic_lstm_init_state_and_reverse_last():
+    """Round-4 review regressions: init_hidden/init_cell must seed the
+    cells (not be ignored), and the reverse direction's last state is
+    its t=0 output (the op flips reverse outputs back to input order)."""
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 4, 3).astype("float32")
+    h0 = rng.randn(2, 2, 5).astype("float32")  # [layers*dirs=2, B, H]
+    c0 = rng.randn(2, 2, 5).astype("float32")
+
+    def build(with_init):
+        xv = _d("x", x)
+        hv = _d("h0", h0) if with_init else None
+        cv = _d("c0", c0) if with_init else None
+        out, lh, lc = fluid.contrib.basic_lstm(
+            xv, hv, cv, hidden_size=5, bidirectional=True)
+        return out, lh
+
+    feeds = {"x": x, "h0": h0, "c0": c0}
+    out_i, lh_i = _run(lambda: build(True), feeds, n_out=2)
+    out_z, lh_z = _run(lambda: build(False), {"x": x}, n_out=2)
+    # different initial states must change the output
+    assert np.abs(out_i - out_z).max() > 1e-4
+    # reverse-direction last state == its output at t=0
+    np.testing.assert_allclose(lh_i[1], out_i[:, 0, 5:], atol=1e-5)
+    # forward-direction last state == its output at t=T-1
+    np.testing.assert_allclose(lh_i[0], out_i[:, -1, :5], atol=1e-5)
+
+
+def test_contrib_cells():
+    rng = np.random.RandomState(2)
+    xt = rng.randn(3, 4).astype("float32")
+    h0 = np.zeros((3, 6), "float32")
+    c0 = np.zeros((3, 6), "float32")
+
+    def build():
+        cell = fluid.contrib.BasicLSTMUnit("cell", 6)
+        h, c = cell(_d("xt", xt), _d("h0", h0), _d("c0", c0))
+        gcell = fluid.contrib.BasicGRUUnit("gcell", 6)
+        g = gcell(_d("xg", xt), _d("hg", h0))
+        return h, c, g
+
+    h, c, g = _run(build, {"xt": xt, "h0": h0, "c0": c0, "xg": xt,
+                           "hg": h0}, n_out=3)
+    assert h.shape == (3, 6) and c.shape == (3, 6) and g.shape == (3, 6)
+    assert np.isfinite(h).all() and np.isfinite(g).all()
+
+
+def test_dygraph_lr_decays():
+    d = fluid.dygraph.PiecewiseDecay([2, 4], [0.1, 0.01, 0.001], begin=0)
+    vals = [d.step() for _ in range(5)]
+    assert vals == [0.1, 0.1, 0.01, 0.01, 0.001]
+    n = fluid.dygraph.NoamDecay(d_model=64, warmup_steps=10)
+    v1, v2 = n.step(), n.step()
+    assert v2 > v1  # warming up
+    p = fluid.dygraph.PolynomialDecay(0.1, 10, end_learning_rate=0.0,
+                                      power=1.0)
+    assert abs(p.value() - 0.1) < 1e-9
+    for _ in range(10):
+        p.step()
+    assert p.value() < 1e-9
+
+    # a decay drives an eager optimizer: the schedule advances ONCE per
+    # minimize and every parameter sees the same step's lr
+    from paddle_tpu.dygraph import Linear, guard, to_variable
+
+    with guard():
+        model = Linear(3, 1)  # weight AND bias
+        decay = fluid.dygraph.ExponentialDecay(0.1, decay_steps=1,
+                                               decay_rate=0.5)
+        opt = fluid.optimizer.SGD(learning_rate=decay)
+        from paddle_tpu.dygraph.varbase import eager_op
+
+        for step, want_lr in ((0, 0.1), (1, 0.05)):
+            xv = to_variable(np.ones((2, 3), "float32"))
+            loss = eager_op("mean", {"X": [model(xv)]})[0]
+            loss.backward()
+            w0 = np.asarray(model.weight.value).copy()
+            b0 = np.asarray(model.bias.value).copy()
+            gw = np.asarray(model.weight._grad).copy()
+            gb = np.asarray(model.bias._grad).copy()
+            opt.minimize(loss, parameter_list=model.parameters())
+            np.testing.assert_allclose(
+                w0 - np.asarray(model.weight.value), want_lr * gw,
+                rtol=1e-5)
+            np.testing.assert_allclose(
+                b0 - np.asarray(model.bias.value), want_lr * gb,
+                rtol=1e-5)
+            for p in model.parameters():
+                p._grad = None
+    # graph path rejects decay objects with a targeted error
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3], dtype="float32")
+        lossv = fluid.layers.mean(fluid.layers.fc(x, size=1))
+        import pytest
+
+        with pytest.raises(TypeError, match="dygraph-only"):
+            fluid.optimizer.SGD(
+                learning_rate=fluid.dygraph.ExponentialDecay(
+                    0.1, 1, 0.5)).minimize(lossv)
+
+
+def test_dygraph_grad_clip_module():
+    from paddle_tpu.dygraph import Linear, guard, to_variable
+
+    clip = fluid.dygraph_grad_clip.GradClipByGlobalNorm(1.0)
+    with guard():
+        model = Linear(4, 1, bias_attr=False)
+        opt = fluid.optimizer.SGD(learning_rate=1.0)
+        xv = to_variable(np.full((2, 4), 50.0, "float32"))
+        out = model(xv)
+        from paddle_tpu.dygraph.varbase import eager_op
+
+        loss = eager_op("mean", {"X": [out]})[0]
+        loss.backward()
+        w0 = np.asarray(model.weight.value).copy()
+        opt.minimize(loss, parameter_list=model.parameters(),
+                     grad_clip=clip)
+        w1 = np.asarray(model.weight.value)
+    assert np.sqrt(((w0 - w1) ** 2).sum()) <= 1.0 + 1e-5
+
+
+def test_install_check_and_misc():
+    assert fluid.install_check.run_check() is True
+    assert fluid.is_compiled_with_cuda() is False
+    assert len(fluid.cuda_pinned_places(2)) == 2
+    fluid.memory_optimize(fluid.Program())  # inert shims must accept
+    fluid.release_memory(fluid.Program())
+
+
+def test_recordio_multi_file(tmp_path):
+    import paddle_tpu.recordio_writer as rw
+
+    def reader():
+        for i in range(10):
+            yield (np.full((2,), i, "float32"),)
+
+    paths = rw.convert_reader_to_recordio_files(
+        str(tmp_path / "part"), batch_per_file=4, reader_creator=reader)
+    assert len(paths) == 3  # 4 + 4 + 2
+    back = []
+    for p in paths:
+        back.extend(list(rw.recordio_reader(p)()))
+    assert len(back) == 10
+    np.testing.assert_array_equal(back[7][0], np.full((2,), 7, "float32"))
