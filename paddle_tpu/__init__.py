@@ -163,6 +163,7 @@ __all__ = [
     "tpu_places",
     "dygraph_grad_clip",
     "install_check",
+    "in_dygraph_mode",
     "host_table",
     "LoDTensor",
     "LoDTensorArray",

@@ -17,9 +17,26 @@ from .layers import (BasicGRUUnit, BasicLSTMUnit, basic_gru, basic_lstm,
                      fused_elemwise_activation)
 from .slim.quantization.quantization_pass import (
     QuantizationTranspiler as QuantizeTranspiler)
+from .slim.core import Compressor
+from .utils import HDFSClient, multi_download, multi_upload
+from .checkpoint_utils import (convert_dist_to_sparse_program,
+                               load_persistables_for_increment,
+                               load_persistables_for_inference)
+from . import reader
+from .reader import distributed_batch_reader
+from . import decoder
+from .decoder import (BeamSearchDecoder, InitState, StateCell,
+                      TrainingDecoder)
 
 __all__ = ["mixed_precision", "slim", "extend_optimizer", "layers",
            "memory_usage", "op_freq_statistic",
            "extend_with_decoupled_weight_decay",
            "BasicGRUUnit", "BasicLSTMUnit", "basic_gru", "basic_lstm",
-           "fused_elemwise_activation", "QuantizeTranspiler"]
+           "fused_elemwise_activation", "QuantizeTranspiler",
+           "Compressor", "HDFSClient", "multi_download", "multi_upload",
+           "convert_dist_to_sparse_program",
+           "load_persistables_for_increment",
+           "load_persistables_for_inference", "reader",
+           "distributed_batch_reader", "decoder",
+           "InitState", "StateCell", "TrainingDecoder",
+           "BeamSearchDecoder"]

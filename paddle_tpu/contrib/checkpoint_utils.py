@@ -1,0 +1,37 @@
+"""Incremental-training checkpoint helpers (reference:
+``contrib/utils/lookup_table_utils.py`` — reload persistables around a
+distributed lookup table for incremental/inference runs) and the
+dense→sparse program converter (``contrib/sparsity`` era API)."""
+
+__all__ = ["load_persistables_for_increment",
+           "load_persistables_for_inference",
+           "convert_dist_to_sparse_program"]
+
+
+def load_persistables_for_increment(dirname, executor, program,
+                                    lookup_table_var=None,
+                                    lookup_table_var_path=None):
+    """Reload a checkpoint to continue training.  The reference
+    re-assembles pserver-sharded lookup tables; here sharded tables
+    reshard on load and host tables load via the shared shard layout, so
+    the plain load covers both."""
+    from ..io import load_persistables
+
+    return load_persistables(executor, dirname, main_program=program)
+
+
+def load_persistables_for_inference(dirname, executor, program,
+                                    lookup_table_var_name=None):
+    """Reload a checkpoint for inference (reference pulls the remote
+    table to the local program; subsumed as above)."""
+    from ..io import load_persistables
+
+    return load_persistables(executor, dirname, main_program=program)
+
+
+def convert_dist_to_sparse_program(program):
+    """reference contrib.convert_dist_to_sparse_program: rewrite dense
+    lookup tables to the sparse-update form.  Sparse embedding grads are
+    native here (lookup_table emits scatter-add grads; SelectedRows
+    role), so the program is already in the converted form."""
+    return program

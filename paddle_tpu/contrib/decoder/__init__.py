@@ -1,0 +1,7 @@
+"""Decoder DSL (reference: ``python/paddle/fluid/contrib/decoder/``)."""
+
+from .beam_search_decoder import (BeamSearchDecoder, InitState,  # noqa: F401
+                                  StateCell, TrainingDecoder)
+
+__all__ = ["InitState", "StateCell", "TrainingDecoder",
+           "BeamSearchDecoder"]

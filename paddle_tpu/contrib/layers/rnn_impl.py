@@ -11,7 +11,7 @@ replacement).  Initial states follow the reference layout
 [num_layers*dirs, B, H]."""
 
 import paddle_tpu as fluid
-from ...layer_helper import LayerHelper  # noqa: F401 (API parity)
+from ...dygraph.layers import Layer
 from ...param_attr import ParamAttr
 
 __all__ = ["BasicGRUUnit", "BasicLSTMUnit", "basic_gru", "basic_lstm"]
@@ -29,14 +29,15 @@ def _act(name, default):
     return getattr(_ops, str(name))
 
 
-class BasicLSTMUnit:
+class BasicLSTMUnit(Layer):
     """One LSTM step on [B, D] input + [B, H] states (reference
-    rnn_impl.py:622): gates from one fc over [x, h]."""
+    rnn_impl.py:622, a dygraph.Layer subclass like the reference):
+    gates from one fc over [x, h]."""
 
     def __init__(self, name_scope, hidden_size, param_attr=None,
                  bias_attr=None, gate_activation=None, activation=None,
                  forget_bias=1.0, dtype="float32"):
-        self._name = name_scope
+        super().__init__(name_scope)
         self._hidden = int(hidden_size)
         self._param_attr = param_attr
         self._bias_attr = bias_attr
@@ -44,7 +45,7 @@ class BasicLSTMUnit:
         self._act = _act(activation, "tanh")
         self._forget_bias = float(forget_bias)
 
-    def __call__(self, input, pre_hidden, pre_cell):
+    def forward(self, input, pre_hidden, pre_cell):
         concat = fluid.layers.concat([input, pre_hidden], axis=1)
         gates = fluid.layers.fc(
             concat, size=4 * self._hidden, param_attr=self._param_attr,
@@ -61,21 +62,21 @@ class BasicLSTMUnit:
         return new_hidden, new_cell
 
 
-class BasicGRUUnit:
+class BasicGRUUnit(Layer):
     """One GRU step on [B, D] input + [B, H] state (reference
-    rnn_impl.py:22)."""
+    rnn_impl.py:22, a dygraph.Layer subclass like the reference)."""
 
     def __init__(self, name_scope, hidden_size, param_attr=None,
                  bias_attr=None, gate_activation=None, activation=None,
                  dtype="float32"):
-        self._name = name_scope
+        super().__init__(name_scope)
         self._hidden = int(hidden_size)
         self._param_attr = param_attr
         self._bias_attr = bias_attr
         self._gate_act = _act(gate_activation, "sigmoid")
         self._act = _act(activation, "tanh")
 
-    def __call__(self, input, pre_hidden):
+    def forward(self, input, pre_hidden):
         concat = fluid.layers.concat([input, pre_hidden], axis=1)
         ur = fluid.layers.fc(concat, size=2 * self._hidden,
                              param_attr=self._param_attr,

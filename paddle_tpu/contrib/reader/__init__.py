@@ -1,0 +1,7 @@
+"""Contrib readers (reference: ``python/paddle/fluid/contrib/reader/``
+— the C++-thread ctr_reader and the distributed batch reader)."""
+
+from .ctr_reader import ctr_reader  # noqa: F401
+from .distributed_reader import distributed_batch_reader  # noqa: F401
+
+__all__ = ["ctr_reader", "distributed_batch_reader"]

@@ -1,0 +1,28 @@
+"""CTR reader (reference: ``contrib/reader/ctr_reader.py`` — a C++
+thread pool parsing svm/csv slot files into the blocking queue).
+
+TPU redesign: the parse runs through the native MultiSlot parser +
+dataset pipeline (``paddle_tpu.dataset``); this front keeps the
+reference's entry point and yields feed dicts."""
+
+__all__ = ["ctr_reader"]
+
+
+def ctr_reader(feed_dict, file_type, file_format, dense_slot_index,
+               sparse_slot_index, capacity, thread_num, batch_size,
+               file_list, slots, name=None):
+    """Returns a generator of batched feed dicts built by the MultiSlot
+    dataset pipeline over ``file_list`` (the C++ ctr_reader's job)."""
+    from ...dataset import DatasetFactory
+
+    dataset = DatasetFactory().create_dataset("QueueDataset")
+    dataset.set_use_var(feed_dict)
+    dataset.set_batch_size(batch_size)
+    dataset.set_thread(thread_num)
+    dataset.set_filelist(list(file_list))
+
+    def reader():
+        for batch in dataset.batch_iterator():
+            yield batch
+
+    return reader

@@ -1,0 +1,19 @@
+"""reference ``contrib/reader/distributed_reader.py``."""
+
+import os
+
+__all__ = ["distributed_batch_reader"]
+
+
+def distributed_batch_reader(batch_reader):
+    """Each trainer keeps its round-robin share of batches (reference:
+    uses PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM env vars)."""
+    trainer_id = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    trainers = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+
+    def reader():
+        for i, batch in enumerate(batch_reader()):
+            if i % trainers == trainer_id:
+                yield batch
+
+    return reader

@@ -1,0 +1,87 @@
+"""slim Compressor (reference: ``contrib/slim/core/compressor.py:229``
+— the strategy-driven compression driver: reads a YAML config naming
+quantization/pruning/distillation strategies and runs epochs applying
+them around a train/eval graph)."""
+
+__all__ = ["Compressor"]
+
+
+class Compressor:
+    def __init__(self, place, scope, train_program, train_reader=None,
+                 train_feed_list=None, train_fetch_list=None,
+                 eval_program=None, eval_reader=None, eval_feed_list=None,
+                 eval_fetch_list=None, teacher_programs=None,
+                 checkpoint_path="./checkpoints", train_optimizer=None,
+                 distiller_optimizer=None):
+        self.place = place
+        self.scope = scope
+        self.train_program = train_program
+        self.train_reader = train_reader
+        self.train_feed_list = train_feed_list
+        self.train_fetch_list = train_fetch_list
+        self.eval_program = eval_program
+        self.eval_reader = eval_reader
+        self.eval_feed_list = eval_feed_list
+        self.eval_fetch_list = eval_fetch_list
+        self.checkpoint_path = checkpoint_path
+        self.train_optimizer = train_optimizer
+        self.epoch = 1
+        self.strategies = []
+
+    def config(self, config_file):
+        """Load the strategy list.  The reference parses a YAML registry
+        of strategy classes; here accept either a YAML path (parsed for
+        the compress_pass epoch + strategies) or a plain list of strategy
+        objects (each with on_epoch_begin/on_epoch_end hooks)."""
+        if isinstance(config_file, (list, tuple)):
+            self.strategies = list(config_file)
+            return self
+        import yaml  # the image ships pyyaml
+
+        with open(config_file) as f:
+            cfg = yaml.safe_load(f) or {}
+        cp = cfg.get("compress_pass", cfg.get("compressor", {})) or {}
+        self.epoch = int(cp.get("epoch", 1))
+        self.strategies = cp.get("strategies", []) or []
+        return self
+
+    def run(self):
+        """Run the configured epochs, invoking each strategy's hooks
+        around the training loop (the compressor's driver role; the
+        strategies themselves are the slim quant/prune/distill passes)."""
+        from ...executor import Executor
+
+        exe = Executor(self.place)
+        feeder = None
+        if self.train_feed_list:
+            from ...data_feeder import DataFeeder
+
+            feeder = DataFeeder(self.train_feed_list,
+                                program=self.train_program)
+        context = {"exe": exe, "program": self.train_program,
+                   "scope": self.scope, "epoch": 0}
+        for epoch in range(self.epoch):
+            context["epoch"] = epoch
+            for s in self.strategies:
+                if hasattr(s, "on_epoch_begin"):
+                    s.on_epoch_begin(context)
+            if self.train_reader is not None:
+                for batch in self.train_reader():
+                    # reference contract: the reader yields sample-tuple
+                    # batches converted through train_feed_list; a dict
+                    # passes straight through
+                    feed = (batch if isinstance(batch, dict)
+                            else feeder.feed(batch) if feeder is not None
+                            else None)
+                    if feed is None:
+                        raise ValueError(
+                            "Compressor needs train_feed_list to convert "
+                            "sample batches (or a reader yielding feed "
+                            "dicts)")
+                    exe.run(self.train_program, feed=feed,
+                            fetch_list=self.train_fetch_list or [],
+                            scope=self.scope)
+            for s in self.strategies:
+                if hasattr(s, "on_epoch_end"):
+                    s.on_epoch_end(context)
+        return context

@@ -150,3 +150,19 @@ class QuantizationTranspiler(TransformForTraining):
 
     def training_transpile(self, program, startup_program=None):
         return self.apply(program, startup_program)
+
+    def freeze_program(self, program, place=None, fuse_bn=False, scope=None):
+        """reference QuantizeTranspiler.freeze_program: rewrite the
+        trained program for inference — under XLA the fake-quant ops
+        already carry their trained scales, and dequant folding is the
+        compiler's job, so freezing is the identity transform here."""
+        return program
+
+    def convert_to_int8(self, program, place=None, scope=None):
+        """reference QuantizeTranspiler.convert_to_int8: int8 weight
+        storage is an HBM-footprint optimization the XLA path does not
+        implement — raise rather than silently keep fp32."""
+        raise NotImplementedError(
+            "int8 weight conversion is not implemented on the TPU path; "
+            "the fake-quant training transform (training_transpile) and "
+            "slim QAT passes cover the quantization-aware capability")

@@ -33,13 +33,37 @@ class BackwardStrategy:
         self.sort_sum_gradient = False
 
 
+# reference dygraph/checkpoint.py exposes both naming generations
+save_persistables = save_dygraph
+load_persistables = load_dygraph
+
+
+def start_gperf_profiler():
+    """reference dygraph.start_gperf_profiler (gperftools hook): the
+    profiling story here is paddle_tpu.profiler / jax XPlane."""
+    from .. import profiler as _prof
+
+    _prof.start_profiler("All")
+
+
+def stop_gperf_profiler():
+    from .. import profiler as _prof
+
+    _prof.stop_profiler()
+
+
 __all__ = [
     "guard", "enabled", "to_variable", "enable_dygraph", "disable_dygraph",
     "no_grad", "VarBase", "Layer", "nn", "Linear", "FC", "Conv2D",
     "Pool2D", "BatchNorm", "Embedding", "LayerNorm", "Dropout",
+    "Conv3D", "Conv2DTranspose", "Conv3DTranspose", "GRUUnit", "PRelu",
+    "BilinearTensorProduct", "SequenceConv", "RowConv", "GroupNorm",
+    "SpectralNorm", "TreeConv", "NCE",
     "DataParallel", "ParallelEnv", "prepare_context",
     "save_dygraph", "load_dygraph",
     "LearningRateDecay", "PiecewiseDecay", "NaturalExpDecay",
     "ExponentialDecay", "InverseTimeDecay", "PolynomialDecay",
     "CosineDecay", "NoamDecay", "Tracer", "BackwardStrategy",
+    "save_persistables", "load_persistables",
+    "start_gperf_profiler", "stop_gperf_profiler",
 ]

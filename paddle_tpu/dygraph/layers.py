@@ -14,10 +14,51 @@ class Layer:
         self._parameters = OrderedDict()
         self._sub_layers = OrderedDict()
         self.training = True
+        self._full_name = name_scope or self.__class__.__name__.lower()
+
+    def full_name(self):
+        """reference Layer.full_name: the layer's name scope."""
+        return self._full_name
+
+    def add_parameter(self, name, parameter):
+        """reference Layer.add_parameter: register + return (validates
+        like the reference instead of silently dropping non-parameters
+        from parameters()/state_dict())."""
+        if not (isinstance(parameter, VarBase) and parameter.persistable):
+            raise TypeError(
+                "add_parameter expects a persistable VarBase (a "
+                "parameter); got %r — create it via create_parameter or "
+                "VarBase(..., persistable=True)" % (parameter,))
+        setattr(self, name, parameter)
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        """reference Layer.add_sublayer: register + return."""
+        setattr(self, name, sublayer)
+        return sublayer
+
+    def create_variable(self, name=None, persistable=None, dtype="float32",
+                        type=None):
+        """reference Layer.create_variable: a non-parameter buffer."""
+        v = VarBase(np.zeros((1,), dtype),
+                    persistable=bool(persistable), stop_gradient=True)
+        return v
+
+    def backward(self, *inputs):
+        """reference Layer.backward raises — grads flow through the tape
+        via loss.backward(), not per-layer hooks."""
+        raise ValueError("Layer.backward is not implemented; call "
+                         "backward() on the loss VarBase instead")
 
     def create_parameter(self, shape, dtype, value):
-        p = VarBase(np.asarray(value, dtype), persistable=True,
-                    stop_gradient=False)
+        from .. import unique_name
+
+        # unique per-process names (deterministic under the same model
+        # construction order) key the optimizer's accumulator state, so
+        # Optimizer.load can restore it across processes
+        p = VarBase(np.asarray(value, dtype),
+                    name=unique_name.generate("eager_param"),
+                    persistable=True, stop_gradient=False)
         p.trainable = True
         return p
 

@@ -28,6 +28,13 @@ class LearningRateDecay:
         self.step_num += self.step_size
         return v
 
+    def create_lr_var(self, lr):
+        """reference LearningRateDecay.create_lr_var wraps the float in a
+        [1] float32 variable; eager values are jnp arrays here."""
+        import jax.numpy as jnp
+
+        return jnp.asarray([float(lr)], jnp.float32)
+
     # reference API: calling the object yields the current value
     def __call__(self):
         return self.value()

@@ -35,6 +35,38 @@ class Tape:
         if not self.paused:
             self.entries.append(entry)
 
+    # ---- reference imperative.Tracer API surface (tracer.h:41) ----
+    def trace(self, entry):
+        """reference Tracer.trace: record one executed op."""
+        self.record(entry)
+
+    trace_op = trace
+
+    def trace_var(self, name, var):
+        """reference Tracer.trace_var: vars are tracked via the entries'
+        in/out VarBase references — nothing extra to do here."""
+        return var
+
+    def all_parameters(self):
+        """reference Tracer.all_parameters: persistable VarBases seen on
+        the tape."""
+        seen, out = set(), []
+        for e in self.entries:
+            for vars_ in e.in_vars.values():
+                for v in vars_:
+                    if (v is not None and getattr(v, "persistable", False)
+                            and id(v) not in seen):
+                        seen.add(id(v))
+                        out.append(v)
+        return out
+
+    def train_mode(self):
+        self.paused = False
+
+    def eval_mode(self):
+        """no-grad evaluation: stop recording (dygraph.no_grad role)."""
+        self.paused = True
+
     def backward(self, root_var, root_grad):
         import jax.numpy as jnp
 

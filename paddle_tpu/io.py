@@ -35,6 +35,7 @@ __all__ = [
     "save_inference_model",
     "load_inference_model",
     "get_program_parameter",
+    "PyReader",
 ]
 
 MODEL_FILENAME = "__model__"

@@ -68,6 +68,27 @@ class Optimizer:
         self.helper = None
         self.type = getattr(self, "type", "optimizer")
 
+    def get_opti_var_name_list(self):
+        """reference Optimizer.get_opti_var_name_list: names of the
+        optimizer-created vars (accumulators + the lr var)."""
+        out = []
+        for accums in self._accumulators.values():
+            out.extend(v.name for v in accums.values())
+        for lr in self._learning_rate_map.values():
+            if hasattr(lr, "name"):
+                out.append(lr.name)
+        return out
+
+    def load(self, state_dict):
+        """reference Optimizer.load (dygraph): restore the eager
+        accumulator state (the dict is keyed by parameter NAME, which
+        regenerates deterministically for the same model-construction
+        order — rebuild the model before loading)."""
+        if not isinstance(state_dict, dict):
+            raise TypeError("load expects the dict of per-param "
+                            "accumulator maps (optimizer._eager_state)")
+        self._eager_state = dict(state_dict)
+
     # ---- learning rate ----
     def _create_global_learning_rate(self):
         program = default_main_program()
@@ -227,7 +248,11 @@ class Optimizer:
     def _eager_state_for(self, param):
         if not hasattr(self, "_eager_state"):
             self._eager_state = {}
-        return self._eager_state.setdefault(id(param), {})
+        # keyed by the param's unique name (not id()): names regenerate
+        # deterministically for the same model-construction order, so a
+        # state dict saved in one process restores in another
+        key = getattr(param, "name", None) or id(param)
+        return self._eager_state.setdefault(key, {})
 
     def _eager_lr(self):
         import jax.numpy as jnp

@@ -140,6 +140,12 @@ class ParallelExecutor:
             self._exe, feed, fetch_list, self._scope, return_numpy
         )
 
+    def drop_local_exe_scopes(self):
+        """reference ParallelExecutor.drop_local_exe_scopes: frees the
+        per-place scope buffers; the SPMD runner's only cached state is
+        its jit cache, which this drops."""
+        self._runner._cache.clear()
+
 
 from .ring_attention import ring_attention, ring_attention_local  # noqa: E402,F401
 

@@ -103,6 +103,23 @@ class PyReader:
 
     decorate_paddle_reader = decorate_sample_list_generator
 
+    def decorate_sample_generator(self, sample_generator, batch_size,
+                                  drop_last=True, places=None):
+        """reference PyReader.decorate_sample_generator: batch a
+        per-sample generator then feed (reader_py.cc role)."""
+
+        def batched():
+            buf = []
+            for sample in sample_generator():
+                buf.append(sample)
+                if len(buf) == batch_size:
+                    yield buf
+                    buf = []
+            if buf and not drop_last:
+                yield buf
+
+        return self.decorate_sample_list_generator(batched, places)
+
     def start(self):
         self._prefetcher = _Prefetcher(self._gen, self._capacity)
         self._prefetcher.start()

@@ -1,0 +1,133 @@
+"""contrib decoder DSL (reference contrib/decoder/beam_search_decoder.py)
+— StateCell + TrainingDecoder train a toy copy-task seq2seq; the SAME
+StateCell drives BeamSearchDecoder.decode() and the top beam reproduces
+the source (the TestNMTBook oracle, through the DSL instead of
+hand-rolled loops)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.executor import Scope, scope_guard
+
+V, L, EMB, H = 12, 4, 24, 48
+START, END = 1, 2
+
+
+def _shared(name):
+    return fluid.ParamAttr(name=name)
+
+
+def _encode(src):
+    emb = fluid.layers.embedding(src, size=[V, EMB],
+                                 param_attr=_shared("src_emb"))
+    flat = fluid.layers.reshape(emb, shape=[-1, L * EMB])  # order-aware
+    h0 = fluid.layers.fc(flat, size=H, act="tanh",
+                         param_attr=_shared("enc_w"),
+                         bias_attr=_shared("enc_b"))
+    return h0
+
+
+def _make_cell(init_h):
+    cell = fluid.contrib.StateCell(
+        inputs={"x": None},
+        states={"h": fluid.contrib.InitState(init=init_h)},
+        out_state="h")
+
+    @cell.state_updater
+    def updater(c):
+        x = c.get_input("x")
+        h = c.get_state("h")
+        nh = fluid.layers.fc(
+            fluid.layers.concat([x, h], axis=1), size=H, act="tanh",
+            param_attr=_shared("dec_w"), bias_attr=_shared("dec_b"))
+        c.set_state("h", nh)
+
+    return cell
+
+
+def _build_train():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = fluid.layers.data("src", shape=[L], dtype="int64")
+        tgt_in = fluid.layers.data("tgt_in", shape=[L + 1], dtype="int64")
+        tgt_out = fluid.layers.data("tgt_out", shape=[L + 1, 1],
+                                    dtype="int64")
+        h0 = _encode(src)
+        cell = _make_cell(h0)
+        tgt_emb = fluid.layers.embedding(tgt_in, size=[V, EMB],
+                                         param_attr=_shared("bsd_emb"))
+        decoder = fluid.contrib.TrainingDecoder(cell)
+        with decoder.block():
+            tok = decoder.step_input(tgt_emb)
+            cell.compute_state(inputs={"x": tok})
+            out = cell.out_state()
+            cell.update_states()
+            decoder.output(out)
+        states = decoder()                                  # [B, T, H]
+        logits = fluid.layers.fc(
+            states, size=V, num_flatten_dims=2,
+            param_attr=_shared("bsd_out_w"),
+            bias_attr=_shared("bsd_out_b"))
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, tgt_out))
+        fluid.optimizer.Adam(5e-3).minimize(loss)
+    return main, startup, loss
+
+
+def _build_infer(B, K, max_len):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        src = fluid.layers.data("src", shape=[B, L], dtype="int64",
+                                append_batch_size=False)
+        h0 = _encode(src)
+        cell = _make_cell(h0)
+        init_ids = fluid.layers.fill_constant([B, K], "int32",
+                                              float(START))
+        zero_col = fluid.layers.fill_constant([B, 1], "float32", 0.0)
+        ninf = fluid.layers.fill_constant([B, K - 1], "float32", -1e9)
+        init_scores = fluid.layers.concat([zero_col, ninf], axis=1)
+        decoder = fluid.contrib.BeamSearchDecoder(
+            cell, init_ids, init_scores, target_dict_dim=V, word_dim=EMB,
+            max_len=max_len, beam_size=K, end_id=END, name="bsd")
+        decoder.decode()
+        sent_ids, sent_scores = decoder()
+    return main, startup, sent_ids, sent_scores
+
+
+def test_decoder_dsl_trains_and_beam_decodes():
+    rng = np.random.RandomState(0)
+    B, K = 4, 3
+
+    def make_batch(n):
+        toks = rng.randint(3, V, size=(n, L))
+        tgt_in = np.concatenate([np.full((n, 1), START), toks], axis=1)
+        tgt_out = np.concatenate(
+            [toks, np.full((n, 1), END)], axis=1)[..., None]
+        return (toks.astype("int64"), tgt_in.astype("int64"),
+                tgt_out.astype("int64"))
+
+    fluid.unique_name.switch()
+    main, startup, loss = _build_train()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        first = last = None
+        for _ in range(200):
+            s, ti, to = make_batch(16)
+            (lv,) = exe.run(main,
+                            feed={"src": s, "tgt_in": ti, "tgt_out": to},
+                            fetch_list=[loss])
+            lv = float(np.asarray(lv).reshape(()))
+            first = first if first is not None else lv
+            last = lv
+        assert last < first * 0.25, (first, last)
+
+        imain, istartup, sent, scores = _build_infer(B, K, L + 2)
+        s, _, _ = make_batch(B)
+        sids, sscores = exe.run(imain, feed={"src": s},
+                                fetch_list=[sent, scores])
+    assert sids.shape == (B, K, L + 2)
+    correct = sum(1 for b in range(B)
+                  if sids[b, 0, :L].tolist() == s[b].tolist())
+    assert correct >= B - 1, (sids[:, 0], s)
+    assert (sscores[:, 0] >= sscores[:, 1] - 1e-6).all()

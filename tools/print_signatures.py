@@ -16,6 +16,11 @@ import sys
 
 MODULES = [
     "paddle_tpu",
+    "paddle_tpu.contrib",
+    "paddle_tpu.dygraph_grad_clip",
+    "paddle_tpu.install_check",
+    "paddle_tpu.lod_tensor",
+    "paddle_tpu.host_table",
     "paddle_tpu.layers",
     "paddle_tpu.layers.layer_function_generator",
     "paddle_tpu.optimizer",
@@ -113,12 +118,22 @@ def iter_api():
                 # addition to the class line (API.spec: 100 such lines)
                 yield "%s.%s.__init__ %s" % (modname, name,
                                              _signature_of(obj.__init__))
-                for mname, meth in sorted(vars(obj).items()):
+                # inherited public methods too (the reference spec lists
+                # e.g. every dygraph Layer subclass's add_parameter /
+                # state_dict / train lines), and nested classes (the
+                # BuildStrategy.ReduceStrategy enum pattern)
+                for mname, meth in sorted(inspect.getmembers(obj)):
                     if mname.startswith("_"):
                         continue
-                    if callable(meth):
+                    if inspect.isfunction(meth) or inspect.ismethod(meth):
                         yield "%s.%s.%s %s" % (modname, name, mname,
                                                _signature_of(meth))
+                    elif inspect.isclass(meth):
+                        yield "%s.%s.%s %s" % (modname, name, mname,
+                                               _signature_of(meth.__init__))
+                        yield "%s.%s.%s.__init__ %s" % (
+                            modname, name, mname,
+                            _signature_of(meth.__init__))
             elif callable(obj):
                 yield "%s.%s %s" % (modname, name, _signature_of(obj))
 
