@@ -608,6 +608,49 @@ class _AccumRunner:
         return fetches, new_rw, fresh
 
 
+def _host_table_prefetch(program, feed, feed_vals):
+    """Host-table step-prefetch shared by the Executor and the SPMD
+    runner (parameter_prefetch.cc role): gather each batch's rows into
+    the dense slab feed.  Returns (host_active, grad_fetch_names)."""
+    import jax
+    import jax.numpy as jnp
+
+    host_specs = getattr(program, "_host_tables", None) or []
+    host_active = []
+    if host_specs and jax.process_count() > 1:
+        raise RuntimeError(
+            "host_embedding under a multi-process cluster would let each "
+            "process's table replica drift (each only sees its local "
+            "grads); use embedding(is_distributed=True) row-sharded "
+            "tables for multi-host, or a single-process mesh")
+    for spec in host_specs:
+        from . import host_table as _host_table
+
+        tab = _host_table.get_table(spec["table"])
+        if spec["ids"] not in feed:
+            raise RuntimeError(
+                "host_embedding ids var %r must be fed directly — "
+                "the host-side prefetch reads its value before the "
+                "device step" % spec["ids"])
+        ids_np = np.asarray(feed[spec["ids"]])
+        feed_vals[spec["slab"]] = jnp.asarray(tab.lookup(ids_np))
+        gname = spec["slab"] + "@GRAD"
+        has_grad = (program.global_block()
+                    ._find_var_recursive(gname) is not None)
+        host_active.append((tab, ids_np, gname if has_grad else None))
+    return host_active, [g for _, _, g in host_active if g]
+
+
+def _host_table_push(host_active, fetches, n_user):
+    """Async-push the fetched slab grads; returns the user fetches."""
+    gi = n_user
+    for tab, ids_np, g in host_active:
+        if g is not None:
+            tab.update_async(ids_np, np.asarray(fetches[gi]))
+            gi += 1
+    return fetches[:n_user]
+
+
 def _run_ops_into_env(block, env, ctx, ops=None):
     """Lower ops of `block` (all, or the given subset) into `env` (the SSA
     value map)."""
@@ -712,24 +755,8 @@ class Executor:
         # prefetch each batch's rows into a dense slab feed; the slab's
         # gradient is fetched from the step and pushed back to the host
         # table on a background thread (communicator.h async push)
-        host_specs = getattr(program, "_host_tables", None) or []
-        host_active = []
-        for spec in host_specs:
-            from . import host_table as _host_table
-
-            tab = _host_table.get_table(spec["table"])
-            if spec["ids"] not in feed:
-                raise RuntimeError(
-                    "host_embedding ids var %r must be fed directly — "
-                    "the host-side prefetch reads its value before the "
-                    "device step" % spec["ids"])
-            ids_np = np.asarray(feed[spec["ids"]])
-            feed_vals[spec["slab"]] = jnp.asarray(tab.lookup(ids_np))
-            gname = spec["slab"] + "@GRAD"
-            has_grad = (program.global_block()
-                        ._find_var_recursive(gname) is not None)
-            host_active.append((tab, ids_np, gname if has_grad else None))
-        host_grad_fetches = [g for _, _, g in host_active if g]
+        host_active, host_grad_fetches = _host_table_prefetch(
+            program, feed, feed_vals)
         fetch_names = fetch_names + host_grad_fetches
 
         sig = tuple(
@@ -788,13 +815,9 @@ class Executor:
             scope.set(n, v)
 
         if host_grad_fetches:
-            n_user = len(fetch_names) - len(host_grad_fetches)
-            gi = n_user
-            for tab, ids_np, g in host_active:
-                if g is not None:
-                    tab.update_async(ids_np, np.asarray(fetches[gi]))
-                    gi += 1
-            fetches = fetches[:n_user]
+            fetches = _host_table_push(
+                host_active, fetches,
+                len(fetch_names) - len(host_grad_fetches))
 
         if has_host_io:
             run_host_io_block(program.global_block(), scope, phase="save")

@@ -14,7 +14,8 @@ the XLA partitioner.
 import numpy as np
 
 from .. import core
-from ..executor import _CompiledBlock, global_scope, rng_key
+from ..executor import (_CompiledBlock, _host_table_prefetch,
+                        _host_table_push, global_scope, rng_key)
 from ..framework import Variable, default_main_program
 
 __all__ = ["ParallelExecutor", "SPMDRunner"]
@@ -81,6 +82,20 @@ class SPMDRunner:
             }
         else:
             feed_vals = {n: jnp.asarray(v) for n, v in feed.items()}
+        # host-resident tables under DP: prefetch the GLOBAL batch's
+        # slab (GSPMD shards it over the data axis like any feed)
+        if (getattr(self.program, "_host_tables", None)
+                and self.accumulate_steps > 1):
+            raise RuntimeError(
+                "host_embedding with batch_merge_repeat>1 is not "
+                "supported: the accumulation scan reassembles slab "
+                "grads per-microbatch WITHOUT the 1/k averaging applied "
+                "to param grads, so the host push would be k-times too "
+                "large — run host-table programs with "
+                "batch_merge_repeat=1")
+        host_active, host_grad_fetches = _host_table_prefetch(
+            self.program, feed, feed_vals)
+        fetch_names = fetch_names + host_grad_fetches
         sig = tuple(
             (n, tuple(v.shape), str(v.dtype))
             for n, v in sorted(feed_vals.items())
@@ -110,6 +125,10 @@ class SPMDRunner:
             scope.set(n, v)
         for n, v in fresh.items():
             scope.set(n, v)
+        if host_grad_fetches:
+            fetches = _host_table_push(
+                host_active, fetches,
+                len(fetch_names) - len(host_grad_fetches))
         if return_numpy:
             return [np.asarray(v) for v in fetches]
         return list(fetches)
