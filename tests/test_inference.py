@@ -215,3 +215,37 @@ def test_fc_fuse_preserves_fetched_intermediate():
     assert all(np.isfinite(o).all() for o in outs)
     types = [op.type for op in main.global_block().ops]
     assert "mul" in types  # fusion skipped, target still produced
+
+
+def test_gn_resize_model_inference_roundtrip(tmp_path):
+    """Round-4 layers survive the inference export: a GN + resize vision
+    net saves via save_inference_model, reloads through the
+    AnalysisPredictor pipeline, and reproduces its outputs exactly."""
+    rng = np.random.RandomState(0)
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[3, 8, 8], dtype="float32")
+        up = fluid.layers.resize_bilinear(img, out_shape=[16, 16])
+        conv = fluid.layers.conv2d(up, 4, 3, padding=1)
+        gn = fluid.layers.group_norm(conv, groups=2, act="relu")
+        pool = fluid.layers.pool2d(gn, 2, global_pooling=True)
+        out = fluid.layers.fc(pool, size=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    d = str(tmp_path / "gn_model")
+    xv = rng.randn(2, 3, 8, 8).astype("float32")
+    with scope_guard(Scope()):
+        exe.run(startup)
+        (direct,) = exe.run(main, feed={"img": xv}, fetch_list=[out])
+        fluid.io.save_inference_model(d, ["img"], [out], exe,
+                                      main_program=main)
+    with scope_guard(Scope()):
+        prog, feeds, fetches = fluid.io.load_inference_model(d, exe)
+        (loaded,) = exe.run(prog, feed={feeds[0]: xv},
+                            fetch_list=fetches)
+    np.testing.assert_allclose(loaded, direct, rtol=1e-5)
+
+    cfg = AnalysisConfig(d)
+    predictor = create_paddle_predictor(cfg)
+    (pred_out,) = predictor.run({"img": xv})
+    np.testing.assert_allclose(np.asarray(pred_out), direct, rtol=1e-5)
