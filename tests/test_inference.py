@@ -248,4 +248,7 @@ def test_gn_resize_model_inference_roundtrip(tmp_path):
     cfg = AnalysisConfig(d)
     predictor = create_paddle_predictor(cfg)
     (pred_out,) = predictor.run({"img": xv})
-    np.testing.assert_allclose(np.asarray(pred_out), direct, rtol=1e-5)
+    # predictor may run on the TPU while `direct` came from CPU: same
+    # tolerance as test_predictor_runs_analysis_pipeline
+    np.testing.assert_allclose(np.asarray(pred_out), direct, rtol=1e-4,
+                               atol=1e-5)

@@ -390,12 +390,35 @@ def test_builder_table_covers_reference_all():
 
 @pytest.mark.parametrize("name", REFERENCE_ALL)
 def test_call_with_reference_defaults(name):
-    """The call itself (graph build) must not raise for any name."""
+    """The call itself (graph build) must not raise for any name — and
+    when every fed input is float (no id/label ranges to respect), the
+    program is also EXECUTED on synthesized data and must produce
+    finite-or-bool outputs."""
     fluid.unique_name.switch()
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         out = BUILDERS[name]()
     assert out is not None or name == "py_func"
+
+    data_vars = [v for v in main.global_block().vars.values()
+                 if getattr(v, "is_data", False)]
+    if not data_vars or any(str(v.dtype) != "float32" for v in data_vars):
+        return  # int/bool feeds need semantic ranges; covered elsewhere
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    outs = [o for o in outs if hasattr(o, "name")]
+    if not outs:
+        return
+    rng = np.random.RandomState(0)
+    feeds = {v.name: rng.randn(*[abs(d) for d in v.shape]).astype(
+        "float32") for v in data_vars}
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        vals = exe.run(main, feed=feeds, fetch_list=list(outs))
+    for v in vals:
+        arr = np.asarray(v)
+        if arr.dtype.kind == "f":
+            assert np.isfinite(arr).all(), name
 
 
 # ---------------------------------------------------------------------------
