@@ -402,8 +402,9 @@ def test_call_with_reference_defaults(name):
 
     data_vars = [v for v in main.global_block().vars.values()
                  if getattr(v, "is_data", False)]
-    if not data_vars or any(str(v.dtype) != "float32" for v in data_vars):
+    if any(str(v.dtype) != "float32" for v in data_vars):
         return  # int/bool feeds need semantic ranges; covered elsewhere
+    # zero data vars (constant-built programs) execute with empty feeds
     outs = out if isinstance(out, (list, tuple)) else [out]
     outs = [o for o in outs if hasattr(o, "name")]
     if not outs:

@@ -1,12 +1,11 @@
 """Capture a jax profiler trace of the BERT bench step and print the
 top-op time breakdown (MFU diagnosis aid)."""
-import glob
-import os
 import sys
 
 import numpy as np
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
+sys.path.insert(0, __file__.rsplit("/", 1)[0])  # xplane_top_ops sibling
 
 TRACE_DIR = "/tmp/bench_trace"
 
@@ -38,17 +37,14 @@ def run_and_trace(cfg_kw=None, batch=64, seq_len=128, steps=5):
 
 
 def analyze():
-    from tensorboard_plugin_profile.convert import raw_to_tool_data
+    # parse the xplane directly (xplane_top_ops): this image's
+    # tensorboard_plugin_profile is incompatible with both its protobuf
+    # (needs PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python) and its TF
+    # pywrap (no xspace_to_tools_data) — found pre-staging the hardware
+    # run; the direct parser needs neither
+    from xplane_top_ops import top_ops
 
-    xplanes = glob.glob(TRACE_DIR + "/**/*.xplane.pb", recursive=True)
-    assert xplanes, "no xplane captured"
-    xp = max(xplanes, key=os.path.getmtime)
-    data, _ = raw_to_tool_data.xspace_to_tool_data(
-        [xp], "framework_op_stats", {}
-    )
-    out = data.decode() if isinstance(data, bytes) else str(data)
-    open("/tmp/bench_trace/op_stats.csv", "w").write(out)
-    print(out[:4000])
+    top_ops(TRACE_DIR)  # globs + asserts the xplane itself
 
 
 if __name__ == "__main__":
