@@ -163,3 +163,42 @@ def build(model="wide_deep", num_slots=8, slot_len=4, dense_dim=13,
                                 host_lr=host_lr)
         fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
     return main, startup, feeds, loss, prob
+
+
+def run_deepfm_host_table_steps(steps=5, data_parallel=False, places=None,
+                                num_slots=4, slot_len=3, vocab=100000,
+                                batch=16, host_lr=0.05, seed=8):
+    """Shared smoke recipe (used by tests AND the driver dryrun): build
+    DeepFM with host-resident tables, train ``steps`` on a fixed batch,
+    return the per-step losses.  ``data_parallel`` routes through
+    CompiledProgram.with_data_parallel over ``places`` (None = all)."""
+    import numpy as np
+
+    from .. import host_table
+    from ..executor import Scope, scope_guard
+
+    host_table.reset_tables()
+    fluid.unique_name.switch()
+    main, startup, feeds, loss, prob = build(
+        model="deepfm", num_slots=num_slots, slot_len=slot_len,
+        vocab=vocab, use_host_table=True, host_lr=host_lr)
+    rng = np.random.RandomState(seed)
+    feed = {"slot_%d" % i:
+            rng.randint(0, vocab, (batch, slot_len)).astype("int64")
+            for i in range(num_slots)}
+    feed["label"] = rng.randint(0, 2, (batch, 1)).astype("int64")
+    exe = fluid.Executor(fluid.TPUPlace())
+    losses = []
+    with scope_guard(Scope()):
+        exe.run(startup)
+        target = main
+        if data_parallel:
+            target = fluid.CompiledProgram(main).with_data_parallel(
+                loss_name=loss.name, places=places)
+        for _ in range(steps):
+            (lv,) = exe.run(target, feed=feed, fetch_list=[loss])
+            losses.append(float(np.asarray(lv).reshape(())))
+        for i in range(num_slots):
+            host_table.get_table("fm_emb_%d" % i).join()
+            host_table.get_table("fm_first_%d" % i).join()
+    return losses

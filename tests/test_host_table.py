@@ -192,32 +192,10 @@ def test_host_table_under_data_parallel():
     the slab over the data axis; the host push sees the global batch)."""
     from paddle_tpu.models import ctr
 
-    def train(data_parallel):
-        host_table.reset_tables()
-        fluid.unique_name.switch()
-        main, startup, feeds, loss, prob = ctr.build(
-            model="deepfm", num_slots=4, slot_len=3, vocab=30000,
-            use_host_table=True, host_lr=0.05)
-        rng = np.random.RandomState(8)
-        feed = {"slot_%d" % i:
-                rng.randint(0, 30000, (16, 3)).astype("int64")
-                for i in range(4)}
-        feed["label"] = rng.randint(0, 2, (16, 1)).astype("int64")
-        exe = fluid.Executor(fluid.CPUPlace())
-        losses = []
-        with scope_guard(Scope()):
-            exe.run(startup)
-            target = main
-            if data_parallel:
-                target = fluid.CompiledProgram(main).with_data_parallel(
-                    loss_name=loss.name)
-            for _ in range(5):
-                (lv,) = exe.run(target, feed=feed, fetch_list=[loss])
-                losses.append(float(np.asarray(lv).reshape(())))
-        return losses
-
-    single = train(False)
-    dp = train(True)
+    single = ctr.run_deepfm_host_table_steps(
+        steps=5, data_parallel=False, vocab=30000)
+    dp = ctr.run_deepfm_host_table_steps(
+        steps=5, data_parallel=True, vocab=30000)
     np.testing.assert_allclose(dp, single, rtol=1e-4)
     assert dp[-1] < dp[0]
 
