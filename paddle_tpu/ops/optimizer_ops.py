@@ -391,8 +391,14 @@ def fused_adam(ctx, attrs, Param, Grad, LearningRate, Moment1, Moment2,
         / (1 - b1.reshape(()).astype(jnp.float32))
         for b1, b2 in zip(Beta1Pow, Beta2Pow)
     ])
-    lr_t = jnp.repeat(lr_ts, jnp.asarray(sizes),
-                      total_repeat_length=int(sum(sizes)))
+    # the segment map is STATIC — concat of scalar broadcasts instead of
+    # jnp.repeat: repeat's cumsum lowering XLA constant-folds for seconds
+    # on every compile (flat-stream-sized scan), and an index-gather
+    # alternative would bake a stream-sized int32 constant into HBM;
+    # broadcasts fuse to nothing
+    lr_t = jnp.concatenate([
+        jnp.broadcast_to(lr_ts[i], (n,)) for i, n in enumerate(sizes)
+    ])
     m1n = beta1 * m1 + (1 - beta1) * g
     m2n = beta2 * m2 + (1 - beta2) * jnp.square(g)
     pn = p - lr_t * m1n / (jnp.sqrt(m2n) + eps)
