@@ -3,13 +3,16 @@
 ``operators/distributed/communicator.h:160`` — background send/recv
 threads shipping grads to parameter servers between steps).
 
-TPU redesign: there is no parameter server and no async grad shipping —
+TPU redesign: there is no parameter server and no background threads —
 gradient communication is the GSPMD all-reduce fused INTO the step by the
-partitioner (SURVEY §2.3), and the sparse-table path is row-sharded
-embeddings (``embedding(is_distributed=True)``).  The class keeps the
-reference's lifecycle API so PS-era training scripts run unchanged; the
-state answers honestly (communication is always 'running' while a
-distributed mesh is active)."""
+partitioner (SURVEY §2.3); the async PS mode the Communicator served maps
+to ``transpiler.collective.AsyncSGD`` (staleness-1 delayed gradient
+exchange — the head collective ships LAST step's grads so XLA overlaps it
+with compute, the scheduler-level analogue of the send/recv threads) and
+to ``host_table.HostEmbeddingTable.update_async`` for the sparse path.
+The class keeps the reference's lifecycle API so PS-era training scripts
+run unchanged; the state answers honestly (communication is always
+'running' while a distributed mesh is active)."""
 
 __all__ = ["Communicator"]
 

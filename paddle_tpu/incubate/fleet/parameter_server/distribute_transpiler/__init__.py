@@ -103,20 +103,27 @@ class DistributedTranspiler(Fleet):
     def _transpile(self, config, programs=None):
         """The TPU 'transpile': mark sparse-lookup params as row-sharded
         and stamp the trainer topology.  No program split.  Of the
-        DistributeTranspilerConfig fields only sync_mode is meaningful
-        here (the jitted step is always synchronous; slicing/geo-sgd
-        knobs describe the pserver program that no longer exists)."""
+        DistributeTranspilerConfig fields, sync_mode=False applies the
+        AsyncSGD staleness-1 rewrite (+ enable_dc_asgd compensation);
+        slicing knobs describe the pserver program that no longer
+        exists."""
         from .....framework import (default_main_program,
                                     default_startup_program)
-
-        if config is not None and not getattr(config, "sync_mode", True):
-            warnings.warn(
-                "sync_mode=False (async PS training) has no TPU "
-                "equivalent; the jitted step runs synchronously")
 
         main = (programs or {}).get("main") or default_main_program()
         startup = (programs or {}).get("startup") or \
             default_startup_program()
+        if config is not None and not getattr(config, "sync_mode", True):
+            # async PS mode (communicator.h:160) → staleness-1 delayed
+            # gradient exchange, same as DistributeTranspiler(sync_mode
+            # =False); enable_dc_asgd adds delay compensation
+            from .....transpiler.collective import AsyncSGD
+
+            AsyncSGD(dc_asgd=getattr(
+                config, "enable_dc_asgd", False)).transpile(
+                program=main, startup_program=startup,
+                rank=self.worker_index(), nranks=self.worker_num(),
+            )
         _mark_sparse_tables(main)
         main._num_trainers = self.worker_num()
         main._trainer_id = self.worker_index()

@@ -385,7 +385,7 @@ def fused_adam(ctx, attrs, Param, Grad, LearningRate, Moment1, Moment2,
     # bias correction stays PER PARAM: each member's own beta-pow drives
     # its lr_t (a checkpoint-resumed model can hold accumulators at
     # different steps, e.g. a freshly added layer), broadcast to its
-    # segment of the flat stream via a static-length repeat
+    # segment of the flat stream
     lr_ts = jnp.stack([
         lr * jnp.sqrt(1 - b2.reshape(()).astype(jnp.float32))
         / (1 - b1.reshape(()).astype(jnp.float32))

@@ -252,6 +252,17 @@ def _make_generic_grad_def(fwd_def):
                     g = jnp.zeros(jnp.shape(p), _cotangent_dtype(p))
                 else:
                     g = g.astype(_cotangent_dtype(p))
+                # under shard_map the primal may be varying over manual
+                # mesh axes; a freshly built cotangent is replicated and
+                # jax rejects the vma mismatch — promote it to match
+                missing = (getattr(jax.typeof(p), "vma", frozenset())
+                           - getattr(jax.typeof(g), "vma", frozenset()))
+                if missing:
+                    if hasattr(jax.lax, "pcast"):
+                        g = jax.lax.pcast(
+                            g, tuple(missing), to="varying")
+                    else:
+                        g = jax.lax.pvary(g, tuple(missing))
                 lst.append(g)
             cot[slot] = lst
         (gin,) = vjp_fn(cot)

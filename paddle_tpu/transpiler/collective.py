@@ -9,7 +9,7 @@ execution — so a transpiled program is correct either way."""
 
 from ..framework import default_main_program, default_startup_program
 
-__all__ = ["GradAllReduce", "LocalSGD", "GeoSGD", "Collective"]
+__all__ = ["GradAllReduce", "LocalSGD", "GeoSGD", "AsyncSGD", "Collective"]
 
 OP_ROLE_BACKWARD = "backward"
 
@@ -275,4 +275,131 @@ class GeoSGD(Collective):
                 type="elementwise_add", inputs={"X": [snap], "Y": [sdiff]},
                 outputs={"Out": [snap]},
             )
+        self.main_program._bump_version()
+
+
+class AsyncSGD(Collective):
+    """Async-SGD (the reference's ``sync_mode=False`` parameter-server
+    mode: ``communicator.h:160-179`` send/recv threads push gradients and
+    pull parameters without barriers, so every update lands with roughly
+    one step of staleness relative to the gradients of the other
+    trainers).
+
+    TPU redesign — staleness-1 delayed gradient exchange.  A persistable
+    buffer per gradient holds the *previous* step's local gradient.  At
+    the top of the step the buffers are allreduce-averaged; because this
+    collective only carries last step's data, it has no data dependency
+    on the current forward/backward and XLA is free to overlap it with
+    compute (the latency-hiding the reference bought with communicator
+    threads, here bought by the scheduler).  The optimizer consumes the
+    stale average while the fresh local gradient replaces the buffer.
+
+    Optional DC-ASGD delay compensation (``DistributeTranspilerConfig.
+    enable_dc_asgd``; the reference wires this flag into its async
+    pserver optimizer blocks): the applied gradient becomes
+    ``g + lambda * g * g * (w - w_snapshot)`` where ``w_snapshot`` is the
+    parameter value at the step the buffered gradient was produced —
+    a first-order correction of the staleness (Zheng et al., 2017).
+
+    Under GSPMD execution the allreduce is an identity and the sharded
+    batch already averages gradients globally, so the program degrades to
+    exact delayed-gradient descent — which is what the parity test
+    asserts; under shard_map the collective is a real psum.
+    """
+
+    def __init__(self, dc_asgd=False, dc_lambda=0.04, nrings=1):
+        super().__init__(nrings)
+        self.dc_asgd = bool(dc_asgd)
+        self.dc_lambda = float(dc_lambda)
+
+    def _transpile_main_program(self):
+        from ..framework import Operator
+
+        block = self.main_program.global_block()
+        sb = self.startup_program.global_block()
+
+        grad_of = {p.name + "@GRAD": p
+                   for p in self.main_program.all_parameters()}
+
+        # last producer index per param-grad (fan-in dedup guarantees the
+        # optimizer reads the final write)
+        last_prod = {}
+        for i, op in enumerate(block.ops):
+            for g in op.output_arg_names:
+                if g in grad_of:
+                    last_prod[g] = i
+        if not last_prod:
+            return
+
+        head = []   # ops prepended before the whole block
+        after = {}  # producer index -> ops appended right after it
+        for g, p in grad_of.items():
+            if g not in last_prod:
+                continue
+            gv = block._find_var_recursive(g)
+            gshape = list(gv.shape) if gv is not None else list(p.shape)
+            gdtype = gv.dtype if gv is not None else p.dtype
+
+            buf = g + "@ASYNC_BUF"
+            stale = g + "@ASYNC_STALE"
+            block.create_var(name=buf, shape=gshape, dtype=gdtype,
+                             persistable=True)
+            block.create_var(name=stale, shape=gshape, dtype=gdtype)
+            sb.create_var(name=buf, shape=gshape, dtype=gdtype,
+                          persistable=True)
+            sb.append_op(
+                type="fill_constant", outputs={"Out": [buf]},
+                attrs={"shape": gshape, "dtype": gdtype, "value": 0.0},
+            )
+
+            # the head collective ships LAST step's gradients: no data
+            # dependency on this step's compute, so it can overlap
+            head.append(Operator(
+                block, "c_allreduce_sum", {"X": [buf]}, {"Out": [stale]},
+                {"ring_id": 0, "pre_scale": 1.0 / max(self.nranks, 1),
+                 "op_role": OP_ROLE_BACKWARD},
+            ))
+            if self.dc_asgd:
+                snap = p.name + "@ASYNC_PSNAP"
+                block.create_var(name=snap, shape=list(p.shape),
+                                 dtype=p.dtype, persistable=True)
+                sb.create_var(name=snap, shape=list(p.shape),
+                              dtype=p.dtype, persistable=True)
+                sb.append_op(type="assign", inputs={"X": [p.name]},
+                             outputs={"Out": [snap]})
+                diff = g + "@ASYNC_DIFF"
+                sq = g + "@ASYNC_SQ"
+                block.create_var(name=diff, shape=gshape, dtype=gdtype)
+                block.create_var(name=sq, shape=gshape, dtype=gdtype)
+                head.append(Operator(
+                    block, "elementwise_sub",
+                    {"X": [p.name], "Y": [snap]}, {"Out": [diff]}, {}))
+                head.append(Operator(
+                    block, "elementwise_mul",
+                    {"X": [stale], "Y": [stale]}, {"Out": [sq]}, {}))
+                head.append(Operator(
+                    block, "elementwise_mul",
+                    {"X": [sq], "Y": [diff]}, {"Out": [sq]}, {}))
+                head.append(Operator(
+                    block, "scale", {"X": [sq]}, {"Out": [sq]},
+                    {"scale": self.dc_lambda}))
+                head.append(Operator(
+                    block, "elementwise_add",
+                    {"X": [stale], "Y": [sq]}, {"Out": [stale]}, {}))
+                # snapshot w for the gradient being produced THIS step
+                head.append(Operator(
+                    block, "assign", {"X": [p.name]}, {"Out": [snap]}, {}))
+
+            after.setdefault(last_prod[g], []).extend([
+                Operator(block, "assign", {"X": [g]}, {"Out": [buf]},
+                         {"op_role": OP_ROLE_BACKWARD}),
+                Operator(block, "assign", {"X": [stale]}, {"Out": [g]},
+                         {"op_role": OP_ROLE_BACKWARD}),
+            ])
+
+        new_ops = list(head)
+        for i, op in enumerate(block.ops):
+            new_ops.append(op)
+            new_ops.extend(after.get(i, ()))
+        block.ops = new_ops
         self.main_program._bump_version()

@@ -106,6 +106,24 @@ class DistributeTranspiler:
                 rank=trainer_id, nranks=self.trainers,
             )
             return
+        if ((not sync_mode or not getattr(self.config, "sync_mode", True))
+                and mode not in ("grad_allreduce", "collective")):
+            # mode wins over sync_mode for the explicitly-collective
+            # modes (reference precedence: those are inherently
+            # synchronous); async applies to the PS-flavored path
+            # reference async PS mode (communicator.h:160 barrier-free
+            # send/recv threads), redesigned as staleness-1 delayed
+            # gradient exchange; enable_dc_asgd adds delay compensation
+            from .collective import AsyncSGD
+
+            program._trainer_id = trainer_id
+            program._num_trainers = self.trainers
+            AsyncSGD(dc_asgd=getattr(
+                self.config, "enable_dc_asgd", False)).transpile(
+                program=program, startup_program=startup_program,
+                rank=trainer_id, nranks=self.trainers,
+            )
+            return
         if mode in ("nccl2", "grad_allreduce", "collective"):
             # topology recorded on the program; mesh construction and
             # collective insertion happen at jit time (GSPMD) — the
