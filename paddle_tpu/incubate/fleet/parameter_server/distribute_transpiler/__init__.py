@@ -32,22 +32,10 @@ from ..... import io as fluid_io
 __all__ = ["fleet", "DistributedTranspiler", "TranspilerOptimizer"]
 
 
-def _mark_sparse_tables(program):
-    """Mark every sparse/distributed ``lookup_table`` parameter
-    ``_is_distributed`` so it row-shards over the mesh data axis (the
-    TPU replacement for the pserver-sliced distributed lookup table,
-    ``transpiler/distribute_transpiler.py:353-376``).  Params live in
-    the global block even when the lookup runs in a sub-block, hence
-    the recursive var lookup."""
-    for block in program.blocks:
-        for op in block.ops:
-            if op.type not in ("lookup_table", "lookup_table_v2"):
-                continue
-            if not op.attr("is_sparse") and not op.attr("is_distributed"):
-                continue
-            w = block.var_recursive(op.input("W")[0])
-            w._is_distributed = True
-            op._set_attr("is_distributed", True)
+# canonical home is the core transpiler (fleet builds on it, not the
+# reverse); re-exported here for existing importers
+from .....transpiler.distribute_transpiler import mark_sparse_tables \
+    as _mark_sparse_tables
 
 
 class DistributedTranspiler(Fleet):

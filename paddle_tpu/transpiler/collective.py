@@ -315,6 +315,11 @@ class AsyncSGD(Collective):
     def _transpile_main_program(self):
         from ..framework import Operator
 
+        if self.nranks <= 1:
+            # single trainer: nothing to overlap — the reference's
+            # one-trainer async run is effectively synchronous, and a
+            # delayed-gradient rewrite would only hurt convergence
+            return
         block = self.main_program.global_block()
         sb = self.startup_program.global_block()
 
@@ -403,3 +408,78 @@ class AsyncSGD(Collective):
             new_ops.extend(after.get(i, ()))
         block.ops = new_ops
         self.main_program._bump_version()
+
+
+ASYNC_TOY_W0 = (1.0, -2.0, 3.0, 0.5)
+
+
+def build_toy_async_program(dc_asgd=False, nranks=2, lr=0.1):
+    """The 4-weight SGD toy used by every AsyncSGD oracle (tests +
+    dryrun): loss = mean((w - x)^2), so d/dw = (w - x)/2.  Returns
+    ``(main, startup, loss, w0)`` with the async transpile applied."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    w0 = np.array(ASYNC_TOY_W0, "float32")
+    with fluid.program_guard(main, startup):
+        w = fluid.layers.create_parameter(
+            [4], "float32", name="w",
+            default_initializer=fluid.initializer.NumpyArrayInitializer(w0))
+        x = fluid.layers.data(name="x", shape=[4], append_batch_size=False)
+        d = fluid.layers.elementwise_sub(w, x)
+        loss = fluid.layers.reduce_mean(fluid.layers.elementwise_mul(d, d))
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    AsyncSGD(dc_asgd=dc_asgd).transpile(
+        program=main, startup_program=startup, rank=0, nranks=nranks)
+    return main, startup, loss, w0
+
+
+def async_two_worker_probe(devices, lr=0.1):
+    """Shared recipe for the AsyncSGD cross-worker oracle (used by
+    tests/test_async_sgd.py and __graft_entry__._dryrun_async_sgd): build
+    a tiny async-transpiled program, run one step on a 2-worker shard_map
+    mesh with diverged gradient buffers, and return
+    ``(w0, x_w, buf_w, w_out, buf_out)`` for the caller to assert
+    - both workers applied the MEAN of the buffered (previous-step) grads
+    - each buffer took its own fresh local gradient (w - x)/2.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:  # pre-0.8 fallback
+        from jax.experimental.shard_map import shard_map
+
+    from ..executor import _run_ops_into_env
+    from ..ops import registry as op_registry
+
+    main, startup, _loss, w0 = build_toy_async_program(lr=lr)
+    block = main.global_block()
+    lr_names = [n for n in block.vars if "learning_rate" in n]
+
+    mesh = Mesh(np.array(devices[:2]), ("workers",))
+    x_w = np.stack([np.arange(4, dtype="float32"),
+                    np.arange(4, dtype="float32") + 10.0])
+    buf_w = np.stack([np.full(4, 2.0, "float32"),
+                      np.full(4, 4.0, "float32")])
+
+    def per_worker(w, buf, x):
+        ctx = op_registry.LoweringContext(mode="train")
+        ctx.collective_axis = "workers"
+        env = {"w": w[0], "w@GRAD@ASYNC_BUF": buf[0], "x": x[0]}
+        for n in lr_names:  # startup-filled persistable
+            env[n] = jnp.asarray([lr], jnp.float32)
+        _run_ops_into_env(block, env, ctx)
+        return env["w"][None], env["w@GRAD@ASYNC_BUF"][None]
+
+    f = shard_map(per_worker, mesh=mesh, in_specs=(P("workers"),) * 3,
+                  out_specs=(P("workers"),) * 2)
+    w_out, buf_out = [np.asarray(v) for v in f(
+        jnp.asarray(np.tile(w0, (2, 1))), jnp.asarray(buf_w),
+        jnp.asarray(x_w))]
+    return w0, x_w, buf_w, w_out, buf_out

@@ -16,16 +16,19 @@ membership from the jax coordination service.  So:
   CompiledProgram/fleet for mesh construction) and, for collective mode,
   insert the same program-level `c_allreduce_sum` ops the reference does
   (identity under GSPMD, psum under shard_map execution).
-- pserver mode has no TPU equivalent worth building (RPC per step against
-  host servers defeats ICI); the sparse/huge-embedding use case it served
-  maps to sharded embedding tables (see layers.embedding is_distributed +
-  the CTR path).  get_pserver_program raises with that guidance.
+- pserver mode (the reference default) keeps its user-facing semantics
+  but not its mechanism: sparse lookup tables are marked row-sharded over
+  the mesh (the distributed-lookup-table role), dense training is
+  GSPMD's job, sync_mode=False becomes AsyncSGD staleness-1 delayed
+  gradient exchange, and there is no separate pserver program — per-step
+  RPC against host servers defeats ICI, so get_pserver_program raises
+  with that guidance (the >HBM case is host_table.py).
 """
 
 from ..framework import default_main_program, default_startup_program
 
 __all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
-           "slice_variable"]
+           "slice_variable", "mark_sparse_tables"]
 
 
 class DistributeTranspilerConfig:
@@ -39,10 +42,31 @@ class DistributeTranspilerConfig:
     runtime_split_send_recv = False
     geo_sgd_mode = False
     geo_sgd_need_push_nums = 100
-    mode = "nccl2"
+    # reference default (distribute_transpiler.py:162); sync_mode and
+    # enable_dc_asgd apply to THIS mode only — nccl2/collective are
+    # inherently synchronous (reference precedence)
+    mode = "pserver"
     print_log = False
     wait_port = True
     collective_mode = None
+
+
+def mark_sparse_tables(program):
+    """Mark every sparse/distributed ``lookup_table`` parameter
+    ``_is_distributed`` so it row-shards over the mesh data axis (the
+    TPU replacement for the pserver-sliced distributed lookup table,
+    ``transpiler/distribute_transpiler.py:353-376``).  Params live in
+    the global block even when the lookup runs in a sub-block, hence
+    the recursive var lookup."""
+    for block in program.blocks:
+        for op in block.ops:
+            if op.type not in ("lookup_table", "lookup_table_v2"):
+                continue
+            if not op.attr("is_sparse") and not op.attr("is_distributed"):
+                continue
+            w = block.var_recursive(op.input("W")[0])
+            w._is_distributed = True
+            op._set_attr("is_distributed", True)
 
 
 def slice_variable(var_list, slice_count, min_block_size=8192):
@@ -86,7 +110,7 @@ class DistributeTranspiler:
         program = program or default_main_program()
         startup_program = startup_program or default_startup_program()
         self.trainer_id = trainer_id
-        mode = getattr(self.config, "mode", "nccl2")
+        mode = getattr(self.config, "mode", "pserver")
         if isinstance(trainers, str):
             self.endpoints = trainers.split(",")
             self.trainers = len(self.endpoints)
@@ -106,23 +130,27 @@ class DistributeTranspiler:
                 rank=trainer_id, nranks=self.trainers,
             )
             return
-        if ((not sync_mode or not getattr(self.config, "sync_mode", True))
-                and mode not in ("grad_allreduce", "collective")):
-            # mode wins over sync_mode for the explicitly-collective
-            # modes (reference precedence: those are inherently
-            # synchronous); async applies to the PS-flavored path
-            # reference async PS mode (communicator.h:160 barrier-free
-            # send/recv threads), redesigned as staleness-1 delayed
-            # gradient exchange; enable_dc_asgd adds delay compensation
-            from .collective import AsyncSGD
-
+        if mode == "pserver":
+            # The TPU redesign of PS mode: sparse lookup tables become
+            # row-sharded over the mesh (the distributed-lookup-table
+            # role), dense "shards" are GSPMD's job — no program split,
+            # no pserver program.  sync_mode=False (the reference async
+            # Communicator, communicator.h:160 barrier-free send/recv
+            # threads) becomes staleness-1 delayed gradient exchange;
+            # enable_dc_asgd adds delay compensation.  Reference
+            # precedence kept: these knobs apply to pserver mode ONLY.
             program._trainer_id = trainer_id
             program._num_trainers = self.trainers
-            AsyncSGD(dc_asgd=getattr(
-                self.config, "enable_dc_asgd", False)).transpile(
-                program=program, startup_program=startup_program,
-                rank=trainer_id, nranks=self.trainers,
-            )
+            mark_sparse_tables(program)
+            if not sync_mode or not getattr(self.config, "sync_mode",
+                                            True):
+                from .collective import AsyncSGD
+
+                AsyncSGD(dc_asgd=getattr(
+                    self.config, "enable_dc_asgd", False)).transpile(
+                    program=program, startup_program=startup_program,
+                    rank=trainer_id, nranks=self.trainers,
+                )
             return
         if mode in ("nccl2", "grad_allreduce", "collective"):
             # topology recorded on the program; mesh construction and
@@ -138,12 +166,9 @@ class DistributeTranspiler:
                     rank=trainer_id, nranks=self.trainers,
                 )
             return
-        raise NotImplementedError(
-            "pserver transpilation has no TPU-native equivalent: per-step "
-            "RPC to host parameter servers defeats ICI. Use collective "
-            "mode (fleet.CollectiveOptimizer) for dense training, or "
-            "sharded embeddings (layers.embedding(is_distributed=True)) "
-            "for the huge-sparse-table use case the pserver served."
+        raise ValueError(
+            "unknown transpiler mode %r: supported are pserver, nccl2, "
+            "grad_allreduce, collective" % (mode,)
         )
 
     def get_trainer_program(self, wait_port=True):

@@ -17,27 +17,17 @@ Oracles:
 import numpy as np
 
 import paddle_tpu as fluid
-from paddle_tpu.executor import Scope, scope_guard, _run_ops_into_env
-from paddle_tpu.ops import registry as op_registry
-from paddle_tpu.transpiler.collective import AsyncSGD
+from paddle_tpu.executor import Scope, scope_guard
+from paddle_tpu.transpiler.collective import (ASYNC_TOY_W0,
+                                              build_toy_async_program)
 
 LR = 0.1
-W0 = np.array([1.0, -2.0, 3.0, 0.5], dtype="float32")
+W0 = np.array(ASYNC_TOY_W0, dtype="float32")
 
 
 def _build(dc_asgd=False, nranks=2):
-    fluid.unique_name.switch()
-    main, startup = fluid.Program(), fluid.Program()
-    with fluid.program_guard(main, startup):
-        w = fluid.layers.create_parameter(
-            [4], "float32", name="w",
-            default_initializer=fluid.initializer.NumpyArrayInitializer(W0))
-        x = fluid.layers.data(name="x", shape=[4], append_batch_size=False)
-        d = fluid.layers.elementwise_sub(w, x)
-        loss = fluid.layers.reduce_mean(fluid.layers.elementwise_mul(d, d))
-        fluid.optimizer.SGD(learning_rate=LR).minimize(loss)
-    AsyncSGD(dc_asgd=dc_asgd).transpile(
-        program=main, startup_program=startup, rank=0, nranks=nranks)
+    main, startup, loss, _w0 = build_toy_async_program(
+        dc_asgd=dc_asgd, nranks=nranks, lr=LR)
     return main, startup, loss
 
 
@@ -91,45 +81,20 @@ class TestDelayedGradParityUnderGSPMD:
 class TestCrossWorkerAverageUnderPsum:
     def test_two_workers(self):
         import jax
-        import jax.numpy as jnp
-        from jax.sharding import Mesh, PartitionSpec as P
-        from jax.experimental.shard_map import shard_map
 
-        main, startup, loss = _build(dc_asgd=False, nranks=2)
-        block = main.global_block()
-        mesh = Mesh(np.array(jax.devices()[:2]), ("workers",))
+        from paddle_tpu.transpiler.collective import async_two_worker_probe
 
-        x_w = np.stack([np.arange(4, dtype="float32"),
-                        np.arange(4, dtype="float32") + 10.0])
-        buf_w = np.stack([np.full(4, 2.0, "float32"),
-                          np.full(4, 4.0, "float32")])
-        w_init = np.tile(W0, (2, 1))
-
-        lr_names = [n for n in block.vars if "learning_rate" in n]
-
-        def per_worker(w, buf, x):
-            ctx = op_registry.LoweringContext(mode="train")
-            ctx.collective_axis = "workers"
-            env = {"w": w[0], "w@GRAD@ASYNC_BUF": buf[0], "x": x[0]}
-            for n in lr_names:  # startup-filled persistable
-                env[n] = jnp.asarray([LR], jnp.float32)
-            _run_ops_into_env(block, env, ctx)
-            return env["w"][None], env["w@GRAD@ASYNC_BUF"][None]
-
-        f = shard_map(per_worker, mesh=mesh,
-                      in_specs=(P("workers"),) * 3,
-                      out_specs=(P("workers"),) * 2)
-        w_out, buf_out = [np.asarray(v) for v in f(
-            jnp.asarray(w_init), jnp.asarray(buf_w), jnp.asarray(x_w))]
+        w0, x_w, buf_w, w_out, buf_out = async_two_worker_probe(
+            jax.devices(), lr=LR)
 
         # both workers applied the MEAN of the buffered grads (psum/2)
-        expect_w = W0 - LR * buf_w.mean(axis=0)
+        expect_w = w0 - LR * buf_w.mean(axis=0)
         np.testing.assert_allclose(w_out[0], expect_w, rtol=1e-6)
         np.testing.assert_allclose(w_out[1], expect_w, rtol=1e-6)
         # each buffer took its own fresh local gradient
         for r in range(2):
             np.testing.assert_allclose(
-                buf_out[r], _np_grad(W0, x_w[r]), rtol=1e-6)
+                buf_out[r], _np_grad(w0, x_w[r]), rtol=1e-6)
 
 
 class TestTranspilerWiring:
