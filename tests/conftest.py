@@ -11,6 +11,28 @@ os.environ["XLA_FLAGS"] = (
 
 import jax
 
-# the image pins jax_platforms=axon,cpu (real TPU via tunnel); tests run on
-# CPU so they are hermetic and can use the 8-device mesh
-jax.config.update("jax_platforms", "cpu")
+if not os.environ.get("PADDLE_TPU_TESTS_ON_TPU"):
+    # the image pins jax_platforms=axon,cpu (real TPU via tunnel); tests
+    # run on CPU so they are hermetic and can use the 8-device mesh.
+    # PADDLE_TPU_TESTS_ON_TPU=1 leaves the real backend active — the
+    # reference's backend-flag rerun pattern (unittests/mkldnn/* reruns
+    # the same OpTest classes with use_mkldnn on; SURVEY §4): the op-test
+    # files then execute on the chip with bf16-tolerant bounds
+    # (tools/hw_when_up.py runs them whenever the tunnel is up).
+    jax.config.update("jax_platforms", "cpu")
+else:
+    import pytest
+
+    def pytest_collection_modifyitems(config, items):
+        """TPU rerun covers the OpTest corpus only: non-OpTest tests
+        assert CPU-tight tolerances (1e-5/1e-6) that bf16 MXU matmuls
+        legitimately miss, and some drive multi-device meshes that the
+        single chip doesn't have."""
+        from op_test import OpTest
+
+        mark = pytest.mark.skip(
+            reason="TPU backend rerun covers OpTest classes only")
+        for item in items:
+            cls = getattr(item, "cls", None)
+            if cls is None or not issubclass(cls, OpTest):
+                item.add_marker(mark)

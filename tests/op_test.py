@@ -6,13 +6,25 @@ finite differences (check_grad, op_test.py:532)).
 
 Same oracles here: numpy forward reference supplied by each test;
 grad check compares the program-level grad ops produced by append_backward
-against central finite differences of the op's own lowering."""
+against central finite differences of the op's own lowering.
+
+Backend-flag rerun (reference ``unittests/mkldnn/`` pattern, SURVEY §4):
+with ``PADDLE_TPU_TESTS_ON_TPU=1`` (conftest leaves the real backend on)
+``check_output`` runs every one-op program on the chip against the same
+numpy oracle with bf16-MXU-tolerant bounds, and ``check_grad`` skips —
+central finite differences at delta 1e-3 are noise under bf16 matmul
+rounding (grad correctness is CPU-proven; the chip run validates the
+forward lowerings on real silicon)."""
+
+import os
 
 import numpy as np
 
 import paddle_tpu as fluid
 from paddle_tpu.executor import Scope, scope_guard, global_scope
 from paddle_tpu.ops import registry as op_registry
+
+ON_TPU = bool(os.environ.get("PADDLE_TPU_TESTS_ON_TPU"))
 
 
 class OpTest:
@@ -66,8 +78,12 @@ class OpTest:
         return main, startup, feed, in_names, out_names
 
     def check_output(self, atol=1e-5, rtol=1e-5):
+        if ON_TPU:
+            # f32 matmuls run at bf16 MXU precision on the chip
+            atol, rtol = max(atol, 2e-2), max(rtol, 2e-2)
         main, startup, feed, _, out_names = self._build_program()
-        exe = fluid.Executor(fluid.CPUPlace())
+        exe = fluid.Executor(
+            fluid.TPUPlace() if ON_TPU else fluid.CPUPlace())
         with scope_guard(Scope()):
             fetch = [n for slot in self.outputs for n in out_names[slot]]
             outs = exe.run(main, feed=feed, fetch_list=fetch)
@@ -88,6 +104,11 @@ class OpTest:
                    numeric_delta=1e-3):
         """Analytic (program grad-op) vs numeric (finite difference) grads
         w.r.t. each named input, using sum(output) as the scalar loss."""
+        if ON_TPU:
+            import pytest
+
+            pytest.skip("finite-difference grads are noise under bf16 "
+                        "MXU rounding; grad oracle runs on CPU")
         main, startup, feed, in_names, out_names = self._build_program()
         with fluid.program_guard(main, startup):
             block = main.global_block()

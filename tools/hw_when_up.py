@@ -40,6 +40,14 @@ STEPS = [
     ("bench_full", [sys.executable, "bench.py"], 1500, None),
     ("bench_profile",
      [sys.executable, "tools/bench_profile.py"], 700, None),
+    # backend-flag op rerun (unittests/mkldnn pattern): the OpTest corpus
+    # forwards on real silicon with bf16-tolerant bounds
+    ("optest_on_tpu",
+     [sys.executable, "-m", "pytest", "tests/test_ops_math.py",
+      "tests/test_nn_extra_ops.py", "tests/test_nn_wave3_ops.py",
+      "tests/test_extra_ops.py", "tests/test_detection.py", "-q",
+      "-p", "no:cacheprovider"], 1500,
+     {"PADDLE_TPU_TESTS_ON_TPU": "1"}),
 ]
 
 
