@@ -338,10 +338,38 @@ def main():
         # = 930s, leaving 450s ≥ the flagship's full 420s cap
         plan = [("ctr", 110), ("resnet", 370), ("bert512", 270),
                 ("bert", 420)]
+        failed = []
         for mode, cap in plan:
             w_ok, w_lines, w_err = _run_child(mode, remaining(cap))
             if not w_ok:
                 print("# %s bench failed: %s" % (mode, w_err), flush=True)
+                failed.append((mode, cap, w_err))
+            for l in w_lines:
+                print(json.dumps(l), flush=True)
+                if l.get("metric") == FLAGSHIP_METRIC:
+                    flagship_printed = True
+        # Retry pass: the axon tunnel flaps mid-compile ("response body
+        # closed before all bytes were read" killed both the r04 resnet
+        # and flagship children on their first attempt while the very
+        # same children succeeded minutes later).  One bounded retry per
+        # transiently-failed mode, in plan order (flagship stays last),
+        # with 300s reserved for the flagship's own retry.
+        transient = ("response body closed", "remote_compile", "HTTP 5",
+                     "UNAVAILABLE", "DEADLINE_EXCEEDED", "Socket closed",
+                     "timeout after")
+        retry = [f for f in failed
+                 if any(s in f[2] for s in transient)]
+        reserve = 300 if any(m == "bert" for m, _, _ in retry) else 0
+        for mode, cap, _ in retry:
+            left = TOTAL_BUDGET_S - (time.time() - t_start)
+            if mode != "bert":
+                left -= reserve
+            if left < 90:
+                continue
+            w_ok, w_lines, w_err = _run_child(mode, min(cap, left))
+            if not w_ok:
+                print("# %s bench retry failed: %s" % (mode, w_err),
+                      flush=True)
             for l in w_lines:
                 print(json.dumps(l), flush=True)
                 if l.get("metric") == FLAGSHIP_METRIC:

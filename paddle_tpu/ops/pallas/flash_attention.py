@@ -103,7 +103,15 @@ def _keep_mask(shape, rate, seed_ref, bh, qi, kj, block_q, block_k, debug):
              + (kj * block_k).astype(jnp.uint32))
         bits = _hash_bits(bh.astype(jnp.uint32), r, c, seed_ref[0])
     else:
-        pltpu.prng_seed(seed_ref[0], bh, qi, kj)
+        # v5e Mosaic caps prng_seed at 2 words ("Setting seed with more
+        # than 2 values is not supported") — fold the block coordinates
+        # into one mixed word.  Deterministic in (bh, qi, kj), so the
+        # bwd recompute draws the identical mask; int32 wraparound is
+        # well-defined in Mosaic and collisions across blocks are
+        # statistically benign.
+        mix = (bh * jnp.int32(1000003) + qi * jnp.int32(7919)
+               + kj * jnp.int32(104729))
+        pltpu.prng_seed(seed_ref[0], mix)
         bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
     return bits >= _rate_threshold(rate)
 

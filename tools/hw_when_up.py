@@ -29,24 +29,28 @@ STEPS = [
     # (name, argv, timeout_s, extra_env)
     ("validate_flash_prng",
      [sys.executable, "tools/validate_flash_prng.py"], 420, None),
-    ("bench_flash_sweep",
-     [sys.executable, "tools/bench_flash.py"], 900, None),
     ("bench_fused_adam_off",
      [sys.executable, "bench.py", "--child", "bert"], 480,
      {"PADDLE_TPU_FUSE_ADAM": "0"}),
     ("bench_fused_adam_on",
      [sys.executable, "bench.py", "--child", "bert"], 480,
      {"PADDLE_TPU_FUSE_ADAM": "1"}),
-    ("bench_full", [sys.executable, "bench.py"], 1500, None),
+    ("bench_resnet",
+     [sys.executable, "bench.py", "--child", "resnet"], 480, None),
     ("bench_profile",
      [sys.executable, "tools/bench_profile.py"], 700, None),
+    ("bench_flash_sweep",
+     [sys.executable, "tools/bench_flash.py"], 900, None),
+    ("bench_full", [sys.executable, "bench.py"], 1500, None),
     # backend-flag op rerun (unittests/mkldnn pattern): the OpTest corpus
-    # forwards on real silicon with bf16-tolerant bounds
+    # forwards on real silicon with bf16-tolerant bounds.  Only files
+    # that define OpTest subclasses belong here — the conftest hook
+    # skips every non-OpTest item under PADDLE_TPU_TESTS_ON_TPU=1.
     ("optest_on_tpu",
      [sys.executable, "-m", "pytest", "tests/test_ops_math.py",
-      "tests/test_nn_extra_ops.py", "tests/test_nn_wave3_ops.py",
-      "tests/test_extra_ops.py", "tests/test_detection.py", "-q",
-      "-p", "no:cacheprovider"], 1500,
+      "tests/test_detection.py", "tests/test_nn_call_parity.py",
+      "tests/test_quantization.py", "tests/test_flash_attention.py",
+      "-q", "-p", "no:cacheprovider"], 1500,
      {"PADDLE_TPU_TESTS_ON_TPU": "1"}),
 ]
 
@@ -93,36 +97,60 @@ def main():
         print(line, flush=True)
         log.write(line + "\n")
 
+    def done(name):
+        """A step is done iff its artifact records a clean run — lets the
+        watcher resume across tunnel flaps without re-burning caps."""
+        path = os.path.join(OUT, name + ".txt")
+        try:
+            with open(path) as f:
+                return f.readline().startswith("[watcher] rc=0")
+        except OSError:
+            return False
+
+    # a deterministically-failing step must not eat the whole watch
+    # window in back-to-back reruns; 3 shots each, then give up on it
+    attempts = {}
+    MAX_ATTEMPTS = 3
+
     t_start = time.time()
     note("watcher start")
     while time.time() - t_start < MAX_WATCH_S:
-        up, out = probe()
-        if up:
-            note("TUNNEL UP: %s" % out.strip()[-120:])
-            break
-        note("probe down: %s" % (out.strip()[-160:] or "no output"))
-        time.sleep(POLL_S)
-    else:
-        note("watch window exhausted; tunnel never came up")
-        return 1
-
-    for name, argv, cap, extra in STEPS:
-        note("running %s (cap %ds)" % (name, cap))
-        t0 = time.time()
-        rc, out = _bounded(argv, cap, extra)
-        path = os.path.join(OUT, name + ".txt")
-        with open(path, "w") as f:
-            f.write(out)
-        note("%s done rc=%s in %.0fs -> %s"
-             % (name, rc, time.time() - t0, path))
-        # if the tunnel died mid-suite, stop burning caps on a dead chip
-        if rc != 0:
-            ok, _ = probe()
-            if not ok:
-                note("tunnel lost after %s; stopping suite" % name)
+        todo = [s for s in STEPS if not done(s[0])
+                and attempts.get(s[0], 0) < MAX_ATTEMPTS]
+        if not todo:
+            undone = [s[0] for s in STEPS if not done(s[0])]
+            if undone:
+                note("gave up on %s after %d attempts each"
+                     % (undone, MAX_ATTEMPTS))
                 return 1
-    note("suite complete")
-    return 0
+            note("suite complete")
+            return 0
+        up, out = probe()
+        if not up:
+            note("probe down: %s" % (out.strip()[-160:] or "no output"))
+            time.sleep(POLL_S)
+            continue
+        note("TUNNEL UP (%d steps left): %s"
+             % (len(todo), out.strip()[-120:]))
+        for name, argv, cap, extra in todo:
+            note("running %s (cap %ds)" % (name, cap))
+            attempts[name] = attempts.get(name, 0) + 1
+            t0 = time.time()
+            rc, out = _bounded(argv, cap, extra)
+            path = os.path.join(OUT, name + ".txt")
+            with open(path, "w") as f:
+                f.write("[watcher] rc=%s\n%s" % (rc, out))
+            note("%s done rc=%s in %.0fs -> %s"
+                 % (name, rc, time.time() - t0, path))
+            # if the tunnel died mid-suite, go back to waiting — the
+            # flap windows are hours long; completed steps stay done
+            if rc != 0:
+                ok, _ = probe()
+                if not ok:
+                    note("tunnel lost after %s; back to waiting" % name)
+                    break
+    note("watch window exhausted")
+    return 0 if not [s for s in STEPS if not done(s[0])] else 1
 
 
 if __name__ == "__main__":
