@@ -517,8 +517,11 @@ def mha_reference(q, k, v, bias=None, causal=False, sm_scale=None,
     d = q.shape[-1]
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(d)
+    # matmuls run in the INPUT dtype (bf16 under AMP → full-rate MXU;
+    # upcasting the operands to f32 would quarter the matmul rate) with
+    # f32 accumulation; softmax statistics stay f32 either way
     s = jnp.einsum(
-        "bhqd,bhkd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * sm_scale
     if bias is not None:
         s = s + bias[:, None, None, :].astype(jnp.float32)
@@ -539,7 +542,8 @@ def mha_reference(q, k, v, bias=None, causal=False, sm_scale=None,
                 jax.random.PRNGKey(sd[0]), 1.0 - dropout_rate, p.shape)
         keep = jax.lax.stop_gradient(keep)
         p = jnp.where(keep, p, 0.0) / (1.0 - dropout_rate)
-    o = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    o = jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v,
+                   preferred_element_type=jnp.float32)
     return o.astype(q.dtype)
 
 
