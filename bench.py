@@ -228,6 +228,21 @@ def child_bert(seq_len=128):
     exe = fluid.Executor(fluid.TPUPlace())
     exe.run(startup)
 
+    # num_iteration_per_run (execution_strategy.h:42): K optimizer steps
+    # per dispatch as one scanned launch — amortizes the per-dispatch
+    # tunnel roundtrip the same way a real TPU training loop amortizes
+    # host dispatch.  The emitted unit string records the setting.
+    iters = max(1, int(os.environ.get("PADDLE_BENCH_ITERS_PER_RUN", "1")
+                       or 1))
+    run_prog = main_prog
+    if iters > 1:
+        es = fluid.ExecutionStrategy()
+        es.num_iteration_per_run = iters
+        run_prog = fluid.CompiledProgram(main_prog).with_data_parallel(
+            loss_name=loss.name, exec_strategy=es,
+            places=jax.devices()[:1])
+        steps = max(1, steps // iters)
+
     rng = np.random.RandomState(0)
     feed = bert.make_fake_batch(batch, seq_len, cfg, rng)
     # stage the batch on device once: a real input pipeline prefetches
@@ -235,9 +250,9 @@ def child_bert(seq_len=128):
     # timed loop should not pay per-step H2D latency for an identical batch
     feed = {k: jnp.asarray(v) for k, v in feed.items()}
 
-    dt = _timed_steps(exe, main_prog, feed, loss, warmup, steps)
+    dt = _timed_steps(exe, run_prog, feed, loss, warmup, steps)
 
-    tokens_per_sec = batch * seq_len * steps / dt
+    tokens_per_sec = batch * seq_len * steps * iters / dt
     flops_per_token = model_train_flops_per_token(cfg, seq_len)
     mfu = tokens_per_sec * flops_per_token / peak_flops(dev)
 
@@ -251,8 +266,10 @@ def child_bert(seq_len=128):
     print(json.dumps({
         "metric": metric,
         "value": round(tokens_per_sec, 1),
-        "unit": "tokens/sec/chip (seq%d bs%d bf16 AMP, MFU %.3f on %s)"
-                % (seq_len, batch, mfu, getattr(dev, "device_kind", str(dev))),
+        "unit": "tokens/sec/chip (seq%d bs%d bf16 AMP%s, MFU %.3f on %s)"
+                % (seq_len, batch,
+                   " ipr%d" % iters if iters > 1 else "",
+                   mfu, getattr(dev, "device_kind", str(dev))),
         "vs_baseline": round(mfu / bar, 3),
     }), flush=True)
 

@@ -124,7 +124,9 @@ class CompiledProgram:
 
     def _run(self, executor, feed, fetch_list, scope, return_numpy):
         accum = getattr(self._build_strategy, "batch_merge_repeat", 1) or 1
-        if not self._is_data_parallel and accum <= 1:
+        iters = int(getattr(self._exec_strategy, "num_iteration_per_run",
+                            1) or 1) if self._exec_strategy else 1
+        if not self._is_data_parallel and accum <= 1 and iters <= 1:
             return executor.run(
                 self._program, feed=feed, fetch_list=fetch_list, scope=scope,
                 return_numpy=return_numpy, use_program_cache=True,
@@ -135,6 +137,7 @@ class CompiledProgram:
             self._parallel_runner = SPMDRunner(
                 self._program, self._build_strategy, self._places,
                 data_parallel=self._is_data_parallel,
+                exec_strategy=self._exec_strategy,
             )
         return self._parallel_runner.run(
             executor, feed, fetch_list, scope, return_numpy

@@ -363,12 +363,18 @@ def _analyze_block(block, feed_names, fetch_names):
 
 class _CompiledBlock:
     def __init__(self, program, block, feed_names, fetch_names, scope, mode,
-                 mesh=None, accumulate_steps=1, trip_counts=None):
+                 mesh=None, accumulate_steps=1, trip_counts=None,
+                 iters_per_run=1):
         import jax
 
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
         self.accumulate_steps = int(accumulate_steps or 1)
+        self.iters_per_run = int(iters_per_run or 1)
+        if self.accumulate_steps > 1 and self.iters_per_run > 1:
+            raise ValueError(
+                "num_iteration_per_run cannot combine with "
+                "batch_merge_repeat: both wrap the step in a scan")
         self.trip_counts = dict(trip_counts or {})
         ext_reads, written, persist_written = _analyze_block(
             block, feed_names, fetch_names
@@ -406,27 +412,69 @@ class _CompiledBlock:
                 + " (reference: executor.cc enforce 'Tensor holds no memory')"
             )
 
+        # host-IO ops of the TOP block run host-side around the jitted
+        # call; in sub-blocks they must fail loudly, so the filter lives
+        # here, not in _run_ops_into_env.  (Program mutation invalidates
+        # this _CompiledBlock via the _version cache key, so snapshotting
+        # the op list here is safe.)
+        _top_ops = [op for op in block.ops
+                    if op.type not in _HOST_SIDE_OPS]
+        _top_ops = _fuse_adam_ops(_top_ops, block)
+
+        def step_once(feeds, rw, ro, key):
+            """One whole train/infer step — shared by the plain path and
+            the num_iteration_per_run scan so the two cannot drift."""
+            env = {}
+            env.update(ro)
+            env.update(rw)
+            env.update(feeds)
+            ctx = op_registry.LoweringContext(base_key=key, mode=mode)
+            ctx.trip_counts = self.trip_counts
+            _run_ops_into_env(block, env, ctx, ops=_top_ops)
+            fetches = [env[n] for n in self.fetch_names]
+            new_rw = {n: env[n] for n in self.rw_names}
+            fresh = {n: env[n] for n in self.fresh_persist if n in env}
+            return fetches, new_rw, fresh
+
         if self.accumulate_steps > 1:
             run_block = _AccumRunner(self, block, mode)
-        else:
+        elif self.iters_per_run > 1:
+            # ExecutionStrategy.num_iteration_per_run
+            # (execution_strategy.h:42): K whole train steps inside ONE
+            # dispatch, as a lax.scan carrying the mutable state.  One
+            # launch + one host roundtrip amortizes over K steps — on
+            # TPU this is how real training loops run; dropout draws a
+            # fresh key per iteration, in-graph counters advance per
+            # iteration, and fetches report the FINAL iteration (the
+            # reference returns the last Run's fetch too).  Each
+            # iteration consumes the same fed batch; pair with the
+            # dataset runtime for distinct per-iteration batches.
+            # Fetch/fresh values ride the CARRY (zero-init from an
+            # abstract eval), so memory stays O(1) in K — no K-stacked
+            # ys buffers.
+            iters = self.iters_per_run
+
             def run_block(feeds, rw, ro, key):
-                env = {}
-                env.update(ro)
-                env.update(rw)
-                env.update(feeds)
-                ctx = op_registry.LoweringContext(base_key=key, mode=mode)
-                ctx.trip_counts = self.trip_counts
-                # host-IO ops of the TOP block run host-side around this
-                # jitted call; in sub-blocks they must fail loudly, so
-                # the filter lives here, not in _run_ops_into_env
-                top_ops = [op for op in block.ops
-                           if op.type not in _HOST_SIDE_OPS]
-                top_ops = _fuse_adam_ops(top_ops, block)
-                _run_ops_into_env(block, env, ctx, ops=top_ops)
-                fetches = [env[n] for n in self.fetch_names]
-                new_rw = {n: env[n] for n in self.rw_names}
-                fresh = {n: env[n] for n in self.fresh_persist if n in env}
-                return fetches, new_rw, fresh
+                import jax.numpy as jnp
+
+                f_s, _, fr_s = jax.eval_shape(step_once, feeds, rw, ro,
+                                              key)
+                f0 = [jnp.zeros(s.shape, s.dtype) for s in f_s]
+                fr0 = {n: jnp.zeros(s.shape, s.dtype)
+                       for n, s in fr_s.items()}
+
+                def body(carry, idx):
+                    rw_c = carry[0]
+                    f, nrw, fr = step_once(
+                        feeds, rw_c, ro, jax.random.fold_in(key, idx))
+                    return (nrw, f, fr), None
+
+                (rw_f, fetches, fresh), _ = jax.lax.scan(
+                    body, (rw, f0, fr0),
+                    jnp.arange(iters, dtype=jnp.int32))
+                return fetches, rw_f, fresh
+        else:
+            run_block = step_once
 
         if mesh is None:
             self.jitted = jax.jit(run_block, donate_argnums=(1,))

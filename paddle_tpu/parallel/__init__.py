@@ -45,7 +45,7 @@ class SPMDRunner:
     """jit-with-shardings runner behind CompiledProgram.with_data_parallel."""
 
     def __init__(self, program, build_strategy=None, places=None,
-                 data_parallel=True):
+                 data_parallel=True, exec_strategy=None):
         self.program = program
         self.build_strategy = build_strategy
         tp = int(getattr(build_strategy, "tensor_parallel_degree", 1) or 1)
@@ -53,6 +53,8 @@ class SPMDRunner:
                      if data_parallel else None)
         self.accumulate_steps = int(
             getattr(build_strategy, "batch_merge_repeat", 1) or 1)
+        self.iters_per_run = int(
+            getattr(exec_strategy, "num_iteration_per_run", 1) or 1)
         self._cache = {}
 
     def run(self, executor, feed, fetch_list, scope, return_numpy):
@@ -93,6 +95,15 @@ class SPMDRunner:
                 "to param grads, so the host push would be k-times too "
                 "large — run host-table programs with "
                 "batch_merge_repeat=1")
+        if (getattr(self.program, "_host_tables", None)
+                and self.iters_per_run > 1):
+            raise RuntimeError(
+                "host_embedding with num_iteration_per_run>1 is not "
+                "supported: the slab is prefetched once per DISPATCH, so "
+                "all K scanned iterations would reuse a stale lookup and "
+                "only the final iteration's slab gradient reaches the "
+                "host push — run host-table programs with "
+                "num_iteration_per_run=1")
         host_active, host_grad_fetches = _host_table_prefetch(
             self.program, feed, feed_vals)
         fetch_names = fetch_names + host_grad_fetches
@@ -112,6 +123,7 @@ class SPMDRunner:
                 "train",
                 mesh=self.mesh,
                 accumulate_steps=self.accumulate_steps,
+                iters_per_run=self.iters_per_run,
             )
             self._cache[key_tuple] = compiled
 
@@ -144,7 +156,8 @@ class ParallelExecutor:
                  scope=None):
         self._program = main_program or default_main_program()
         self._scope = scope or global_scope()
-        self._runner = SPMDRunner(self._program, build_strategy)
+        self._runner = SPMDRunner(self._program, build_strategy,
+                                  exec_strategy=exec_strategy)
         from .executor import Executor
 
         self._exe = Executor(core.TPUPlace(0))

@@ -1,0 +1,91 @@
+"""ExecutionStrategy.num_iteration_per_run: K whole optimizer steps per
+dispatch as a lax.scan (reference execution_strategy.h:42 — there, the
+SSA executor loops the graph K times per Run call; here one jitted scan
+carries the mutable state so a single launch covers K steps)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _build(seed=7):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=32, act="relu")
+        pred = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _feed(rng, batch=8):
+    x = rng.randn(batch, 16).astype(np.float32)
+    return {"x": x, "y": (x.sum(1, keepdims=True) > 0).astype(np.float32)}
+
+
+def test_k_iters_matches_k_runs():
+    rng = np.random.RandomState(0)
+    feed = _feed(rng)
+    k = 4
+
+    # reference trajectory: k separate dispatches on the same batch
+    main, startup, loss = _build()
+    from paddle_tpu.executor import Scope, scope_guard
+
+    s1 = Scope()
+    with scope_guard(s1):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        losses = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+                  for _ in range(k)]
+
+    # one dispatch with num_iteration_per_run=k; fetch = final iteration
+    main2, startup2, loss2 = _build()
+    s2 = Scope()
+    with scope_guard(s2):
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        exe2.run(startup2)
+        es = fluid.ExecutionStrategy()
+        es.num_iteration_per_run = k
+        cp = fluid.CompiledProgram(main2).with_data_parallel(
+            loss_name=loss2.name, exec_strategy=es)
+        got = float(exe2.run(cp, feed=feed, fetch_list=[loss2])[0])
+
+    assert np.isclose(got, losses[-1], rtol=1e-5, atol=1e-6), (
+        got, losses)
+    # and the state advanced k steps: one more single run from each side
+    with scope_guard(s1):
+        nxt_ref = float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+    with scope_guard(s2):
+        nxt_got = float(exe2.run(cp, feed=feed, fetch_list=[loss2])[0])
+    # nxt_got ran k MORE iters; compare its first-iter equivalent by
+    # rerunning the reference k more times and checking the last
+    with scope_guard(s1):
+        more = [nxt_ref] + [
+            float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+            for _ in range(k - 1)]
+    assert np.isclose(nxt_got, more[-1], rtol=1e-5, atol=1e-6)
+
+
+def test_iters_rejects_accum_combo():
+    main, startup, loss = _build()
+    bs = fluid.BuildStrategy()
+    bs.batch_merge_repeat = 2
+    es = fluid.ExecutionStrategy()
+    es.num_iteration_per_run = 2
+    cp = fluid.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name, build_strategy=bs, exec_strategy=es)
+    from paddle_tpu.executor import Scope, scope_guard
+
+    s = Scope()
+    with scope_guard(s):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(1)
+        with pytest.raises(ValueError, match="num_iteration_per_run"):
+            exe.run(cp, feed=_feed(rng), fetch_list=[loss])

@@ -37,6 +37,12 @@ STEPS = [
      {"PADDLE_TPU_FUSE_ADAM": "1"}),
     ("bench_resnet",
      [sys.executable, "bench.py", "--child", "resnet"], 480, None),
+    # K-steps-per-dispatch A/B: if wall step time is dispatch-bound
+    # (tunnel roundtrips), ipr25 amortizes 25x and the gap to the
+    # profile's device time closes
+    ("bench_bert_ipr25",
+     [sys.executable, "bench.py", "--child", "bert"], 480,
+     {"PADDLE_BENCH_ITERS_PER_RUN": "25"}),
     ("bench_profile",
      [sys.executable, "tools/bench_profile.py"], 700, None),
     ("bench_flash_sweep",
