@@ -48,7 +48,11 @@ class BuildStrategy:
         self.nccl_comm_num = 1
         self.use_hierarchical_allreduce = False
         # TPU-native extensions
-        self.remat = False  # jax.checkpoint the forward
+        # jax.checkpoint: honored by pipeline stages (parallel/pipeline.py)
+        # and ring attention; the plain executor path warns (explicit grad
+        # ops read named activations, so segment remat must be chosen at
+        # the model level)
+        self.remat = False
         self.donate_params = True
         # microbatch gradient accumulation (reference
         # ir/multi_batch_merge_pass.cc "repeat"): split the batch into k
@@ -111,6 +115,13 @@ class CompiledProgram:
                 "GradientScaleStrategy.Customized is not supported: scale "
                 "the loss explicitly in the program instead "
                 "(reference multi_devices_graph_pass ScaleLossGrad)",
+                stacklevel=3)
+        if getattr(bs, "remat", False):
+            warnings.warn(
+                "BuildStrategy.remat applies to pipeline stages "
+                "(PipelineOptimizer) and ring attention only; the plain "
+                "executor keeps activations under XLA liveness — pick "
+                "recompute boundaries at the model level instead",
                 stacklevel=3)
 
     def with_inference_optimize(self, config):
