@@ -213,19 +213,24 @@ def _fuse_adam_ops(ops, block):
     hyperparameter attrs + the same LearningRate input, so every member's
     bias correction and scale match.  Row-sharded (``_is_distributed``)
     tables stay unfused: concatenating a sharded table with replicated
-    params would force XLA to re-gather it.  Disable with
-    PADDLE_TPU_FUSE_ADAM=0.
+    params would force XLA to re-gather it.  Enable with
+    PADDLE_TPU_FUSE_ADAM=1.
 
-    The fused op streams Param/Grad/moments through flat fp32 copies, so
-    one group transiently holds ~4 extra fp32 model copies in HBM — for
-    bf16 models that can regress peak memory.
-    PADDLE_TPU_FUSE_ADAM_MAX_ELEMS (default 2**27 elems = 512MB per fp32
-    stream) caps a group's total elements; bigger runs split into
-    several fused groups so XLA can retire each flat stream before the
-    next one materializes."""
+    DEFAULT OFF (r04): XLA's cost model convicts the fusion — the
+    BERT-base bs64 train step reads/writes 145GB unfused vs 664GB fused
+    (concat + per-param scatter-back makes every member update touch
+    the whole flat stream), and the r04 flagship hardware capture
+    regressed MFU 0.42→0.30 with it on.  XLA already fuses each
+    per-param adam update into one elementwise kernel; the concat buys
+    fewer launches but pays O(n_params × stream) traffic.
+
+    The fused op also streams Param/Grad/moments through flat fp32
+    copies, so one group transiently holds ~4 extra fp32 model copies
+    in HBM.  PADDLE_TPU_FUSE_ADAM_MAX_ELEMS (default 2**27 elems =
+    512MB per fp32 stream) caps a group's total elements."""
     import os
 
-    if os.environ.get("PADDLE_TPU_FUSE_ADAM", "1") == "0":
+    if os.environ.get("PADDLE_TPU_FUSE_ADAM", "0") != "1":
         return list(ops)
     max_elems = int(os.environ.get("PADDLE_TPU_FUSE_ADAM_MAX_ELEMS",
                                    str(2 ** 27)))
