@@ -39,15 +39,27 @@ PROBE_TIMEOUT_S = 120
 TOTAL_BUDGET_S = 1380
 
 
-def model_train_flops_per_token(cfg, seq_len):
-    """Analytic FLOPs per token for one fwd+bwd step (bwd = 2x fwd)."""
+def model_train_flops_per_token(cfg, seq_len, max_pred=None):
+    """Analytic FLOPs per token for one fwd+bwd step (bwd = 2x fwd).
+    max_pred: the MLM head scores only that many gathered positions per
+    sequence (models/bert.py default), so the vocab-projection term
+    scales by max_pred/seq_len — the MFU denominator must count the
+    FLOPs the model actually runs, not the legacy all-position head."""
     d, ff, layers, vocab = cfg.hidden, cfg.ffn, cfg.layers, cfg.vocab_size
+    if max_pred is None:
+        # lazy: only children import the model package (orchestrator
+        # stays jax-free)
+        from paddle_tpu.models.bert import default_max_pred
+
+        max_pred = default_max_pred(seq_len)
+    head_frac = (max_pred / seq_len) if max_pred else 1.0
     per_layer = (
         2 * 4 * d * d          # q,k,v,o projections
         + 2 * 2 * d * ff       # ffn in+out
         + 2 * 2 * seq_len * d  # scores + context matmuls
     )
-    fwd = layers * per_layer + 2 * d * vocab  # + MLM vocab projection
+    # MLM vocab projection over the gathered masked positions only
+    fwd = layers * per_layer + 2 * d * vocab * head_frac
     return 3 * fwd
 
 

@@ -142,11 +142,29 @@ def encoder(input_ids, token_type_ids, attn_mask_bias, cfg, seq_len):
     return x
 
 
+def default_max_pred(seq_len):
+    """Masked positions the MLM head scores per sequence — the single
+    source of truth shared by build_pretrain, make_fake_batch, and
+    bench.py's MFU denominator (they must agree on the gather layout)."""
+    return int(0.15 * seq_len) + 1
+
+
 def build_pretrain(cfg=BERT_BASE, seq_len=128, lr=1e-4, amp=False,
-                   train=True):
+                   train=True, max_pred=None):
     """Masked-LM pretraining program.  Returns
     (main, startup, feed_names, loss).  With train=False only the forward
-    loss graph is built (no grad/optimizer ops)."""
+    loss graph is built (no grad/optimizer ops).
+
+    max_pred: how many masked positions per sequence the MLM head scores.
+    Default ``int(0.15 * seq_len) + 1`` — the reference-era BERT recipe
+    gathers the masked positions (fed as flattened ``mask_pos`` indices)
+    BEFORE the vocab projection, so the [positions, V] logits cover only
+    ~15% of tokens instead of all of them; the vocab head is ~20% of the
+    step's FLOPs at seq128, so scoring every position wastes real MXU
+    time and logits bandwidth.  Pass ``max_pred=0`` for the legacy
+    all-position head."""
+    if max_pred is None:
+        max_pred = default_max_pred(seq_len)
     if not train:
         # inference graph: ALL dropout off (hidden + attention-prob) —
         # the eval program must be deterministic run-to-run
@@ -165,24 +183,39 @@ def build_pretrain(cfg=BERT_BASE, seq_len=128, lr=1e-4, amp=False,
         mask_bias = fluid.layers.data(
             "attn_mask_bias", shape=[1, 1, seq_len], dtype="float32"
         )
-        mlm_labels = fluid.layers.data("mlm_labels", shape=[seq_len],
+        n_pred = max_pred or seq_len
+        mlm_labels = fluid.layers.data("mlm_labels", shape=[n_pred],
                                        dtype="int64")
-        mlm_weights = fluid.layers.data("mlm_weights", shape=[seq_len],
+        mlm_weights = fluid.layers.data("mlm_weights", shape=[n_pred],
                                         dtype="float32")
+        if max_pred:
+            # flattened absolute indices b*seq_len + pos of the masked
+            # positions; weight 0 marks padding of the masked set
+            mask_pos = fluid.layers.data("mask_pos", shape=[n_pred],
+                                         dtype="int64")
         x = encoder(input_ids, token_type, mask_bias, cfg, seq_len)
-        # MLM head: project back to vocab with the word embedding transposed
-        # (weight tying, the standard BERT head)
+        # MLM head: project back to vocab with the word embedding
+        # transposed (weight tying, the standard BERT head).  With
+        # max_pred the masked positions are gathered FIRST, so the
+        # projection scores [B*max_pred, V] instead of [B*T, V].
         block = main.global_block()
         word_emb = block.var("bert.word_emb")
+        if max_pred:
+            x = fluid.layers.reshape(x, shape=[-1, cfg.hidden])
+            x = fluid.layers.gather(
+                x, fluid.layers.reshape(mask_pos, shape=[-1]))
+            labels2 = fluid.layers.reshape(mlm_labels, shape=[-1, 1])
+            w_flat = fluid.layers.reshape(mlm_weights, shape=[-1])
+        else:
+            labels2 = fluid.layers.unsqueeze(mlm_labels, [2])
+            w_flat = mlm_weights
         logits = fluid.layers.matmul(x, word_emb, transpose_y=True)
-        loss_tok = fluid.layers.softmax_with_cross_entropy(
-            logits, fluid.layers.unsqueeze(mlm_labels, [2])
-        )
-        loss_tok = fluid.layers.squeeze(loss_tok, [2])
+        loss_tok = fluid.layers.softmax_with_cross_entropy(logits, labels2)
+        loss_tok = fluid.layers.squeeze(loss_tok, [1 if max_pred else 2])
         num = fluid.layers.reduce_sum(
-            fluid.layers.elementwise_mul(loss_tok, mlm_weights)
+            fluid.layers.elementwise_mul(loss_tok, w_flat)
         )
-        den = fluid.layers.reduce_sum(mlm_weights)
+        den = fluid.layers.reduce_sum(w_flat)
         loss = fluid.layers.elementwise_div(num, den)
         if train:
             opt = fluid.optimizer.Adam(learning_rate=lr)
@@ -193,23 +226,43 @@ def build_pretrain(cfg=BERT_BASE, seq_len=128, lr=1e-4, amp=False,
             fluid.contrib.mixed_precision.rewrite_program_bf16(main)
     feeds = ["input_ids", "token_type_ids", "attn_mask_bias", "pos_ids",
              "mlm_labels", "mlm_weights"]
+    if max_pred:
+        feeds.append("mask_pos")
     return main, startup, feeds, loss
 
 
-def make_fake_batch(batch, seq_len, cfg, rng):
+def make_fake_batch(batch, seq_len, cfg, rng, max_pred=None):
+    """Fake MLM batch matching build_pretrain's feeds (same max_pred
+    default — the two must agree on the masked-gather layout)."""
     import numpy as np
 
+    if max_pred is None:
+        max_pred = default_max_pred(seq_len)
     ids = rng.randint(10, cfg.vocab_size, (batch, seq_len)).astype("int64")
     types = np.zeros((batch, seq_len), "int64")
     mask = np.zeros((batch, 1, 1, seq_len), "float32")
     pos = np.tile(np.arange(seq_len, dtype="int64"), (batch, 1))
-    labels = ids.copy()
-    weights = (rng.rand(batch, seq_len) < 0.15).astype("float32")
-    return {
+    out = {
         "input_ids": ids,
         "token_type_ids": types,
         "attn_mask_bias": mask,
         "pos_ids": pos,
-        "mlm_labels": labels,
-        "mlm_weights": weights,
     }
+    if max_pred:
+        n_real = max(1, int(0.15 * seq_len))
+        mask_pos = np.zeros((batch, max_pred), "int64")
+        labels = np.zeros((batch, max_pred), "int64")
+        weights = np.zeros((batch, max_pred), "float32")
+        for b in range(batch):
+            picks = rng.permutation(seq_len)[:n_real]
+            mask_pos[b, :n_real] = b * seq_len + picks
+            labels[b, :n_real] = ids[b, picks]
+            weights[b, :n_real] = 1.0
+        out["mask_pos"] = mask_pos
+        out["mlm_labels"] = labels
+        out["mlm_weights"] = weights
+    else:
+        out["mlm_labels"] = ids.copy()
+        out["mlm_weights"] = (rng.rand(batch, seq_len) < 0.15).astype(
+            "float32")
+    return out

@@ -141,7 +141,11 @@ def main():
     class Cfg:
         hidden, ffn, layers, vocab_size = D, FF, L, V
 
-    mfu = tps * model_train_flops_per_token(Cfg, T) / peak_flops(jax.devices()[0])
+    # max_pred=0: this control scores ALL positions in its MLM head, so
+    # its MFU denominator must count the full vocab projection (the
+    # framework model gathers masked positions and uses the default)
+    mfu = (tps * model_train_flops_per_token(Cfg, T, max_pred=0)
+           / peak_flops(jax.devices()[0]))
     print("pure-jax: tokens/sec=%.0f MFU=%.3f loss=%.4f"
           % (tps, mfu, lv))
 
