@@ -189,8 +189,12 @@ def build_pretrain(cfg=BERT_BASE, seq_len=128, lr=1e-4, amp=False,
         mlm_weights = fluid.layers.data("mlm_weights", shape=[n_pred],
                                         dtype="float32")
         if max_pred:
-            # flattened absolute indices b*seq_len + pos of the masked
-            # positions; weight 0 marks padding of the masked set
+            # PER-SEQUENCE masked positions in [0, seq_len); weight 0
+            # marks padding of the masked set.  The b*seq_len row offset
+            # is added IN-GRAPH so the feed is shard-safe: under the
+            # multi-process DP path each rank feeds only its local batch
+            # shard, and host-side absolute indices would point into the
+            # wrong rows of the assembled global batch
             mask_pos = fluid.layers.data("mask_pos", shape=[n_pred],
                                          dtype="int64")
         x = encoder(input_ids, token_type, mask_bias, cfg, seq_len)
@@ -201,9 +205,17 @@ def build_pretrain(cfg=BERT_BASE, seq_len=128, lr=1e-4, amp=False,
         block = main.global_block()
         word_emb = block.var("bert.word_emb")
         if max_pred:
+            # in-graph row offsets [B,1]: cumsum of a T-filled column
+            # minus itself = b*T at row b (stays int64 throughout)
+            rowT = fluid.layers.fill_constant_batch_size_like(
+                mlm_weights, shape=[-1, 1], dtype="int64",
+                value=float(seq_len))
+            offs = fluid.layers.elementwise_sub(
+                fluid.layers.cumsum(rowT, axis=0), rowT)
+            abs_pos = fluid.layers.elementwise_add(mask_pos, offs)
             x = fluid.layers.reshape(x, shape=[-1, cfg.hidden])
             x = fluid.layers.gather(
-                x, fluid.layers.reshape(mask_pos, shape=[-1]))
+                x, fluid.layers.reshape(abs_pos, shape=[-1]))
             labels2 = fluid.layers.reshape(mlm_labels, shape=[-1, 1])
             w_flat = fluid.layers.reshape(mlm_weights, shape=[-1])
         else:
@@ -249,13 +261,13 @@ def make_fake_batch(batch, seq_len, cfg, rng, max_pred=None):
         "pos_ids": pos,
     }
     if max_pred:
-        n_real = max(1, int(0.15 * seq_len))
+        n_real = min(max_pred, max(1, int(0.15 * seq_len)))
         mask_pos = np.zeros((batch, max_pred), "int64")
         labels = np.zeros((batch, max_pred), "int64")
         weights = np.zeros((batch, max_pred), "float32")
         for b in range(batch):
             picks = rng.permutation(seq_len)[:n_real]
-            mask_pos[b, :n_real] = b * seq_len + picks
+            mask_pos[b, :n_real] = picks  # per-sequence; offset in-graph
             labels[b, :n_real] = ids[b, picks]
             weights[b, :n_real] = 1.0
         out["mask_pos"] = mask_pos
