@@ -88,10 +88,25 @@ def _child_setup():
     """Per-child backend forcing: the image pins jax_platforms=axon in jax
     config, so the JAX_PLATFORMS env var is IGNORED — forcing CPU must be
     done in-process before first backend use."""
-    if os.environ.get("PADDLE_BENCH_FORCE_CPU"):
-        import jax
+    import jax
 
+    if os.environ.get("PADDLE_BENCH_FORCE_CPU"):
         jax.config.update("jax_platforms", "cpu")
+    # persistent compilation cache: over the flapping tunnel, compiles
+    # are the dominant (and timeout-prone) cost — a prior watcher run
+    # seeds the cache so the driver's round-end bench reuses executables
+    # (harmless no-op if the PJRT client can't serialize them)
+    try:
+        cache_dir = os.environ.get(
+            "PADDLE_TPU_COMPILE_CACHE",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".jax_cache"))
+        if cache_dir and cache_dir != "0":
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              1.0)
+    except Exception:  # noqa: BLE001 - cache is best-effort
+        pass
 
 
 # ---------------------------------------------------------------------------
@@ -363,9 +378,10 @@ def main():
         # metric), and with these caps the flagship always receives its
         # full cap even if every earlier child burns its own.
         # worst-case non-flagship spend incl. the 15s post-SIGKILL drain
-        # per timeout (_run_child): (120+15)+(110+15)+(370+15)+(270+15)
-        # = 930s, leaving 450s ≥ the flagship's full 420s cap
-        plan = [("ctr", 110), ("resnet", 370), ("bert512", 270),
+        # per timeout (_run_child): (120+15)+(160+15)+(340+15)+(270+15)
+        # = 950s, leaving 430s ≥ the flagship's full 420s cap
+        # (r04: ctr hit its old 110s cap mid-compile on the tunnel)
+        plan = [("ctr", 160), ("resnet", 340), ("bert512", 270),
                 ("bert", 420)]
         failed = []
         for mode, cap in plan:
