@@ -37,6 +37,13 @@ STEPS = [
      [sys.executable, "bench.py", "--child", "resnet"], 480, None),
     ("bench_bert_default",
      [sys.executable, "bench.py", "--child", "bert"], 480, None),
+    # flash kernel at the flagship's T=128 with IN-KERNEL dropout (the
+    # hardware-validated path): if this beats bench_bert_default, the
+    # MIN_T default drops to 128 for dropout graphs — the direct route
+    # past the 0.45 MFU gate (dropout cost ~8% MFU per the r02 sweep)
+    ("bench_bert_flash128",
+     [sys.executable, "bench.py", "--child", "bert"], 480,
+     {"PADDLE_TPU_FLASH_MIN_T": "128"}),
     # K-steps-per-dispatch A/B: if wall step time is dispatch-bound
     # (tunnel roundtrips), ipr25 amortizes 25x and the gap to the
     # profile's device time closes
