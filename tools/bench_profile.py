@@ -11,7 +11,15 @@ TRACE_DIR = "/tmp/bench_trace"
 
 
 def run_and_trace(cfg_kw=None, batch=64, seq_len=128, steps=5):
+    import os
+
     import jax
+
+    if os.environ.get("PADDLE_BENCH_FORCE_CPU"):
+        # the env var alone is ignored (the image pins jax_platforms);
+        # forcing CPU must happen in-process before first backend use
+        jax.config.update("jax_platforms", "cpu")
+
     import paddle_tpu as fluid
     from paddle_tpu.models import bert
     from paddle_tpu.executor import Scope, scope_guard
@@ -48,5 +56,14 @@ def analyze():
 
 
 if __name__ == "__main__":
-    run_and_trace()
+    import os
+
+    if os.environ.get("PADDLE_BENCH_FORCE_CPU"):
+        # CPU smoke: BERT-base bs64 is ~100s/step on CPU — downscale so
+        # the tool's plumbing (trace capture + xplane parse) still runs
+        run_and_trace(cfg_kw=dict(vocab_size=1024, hidden=128, layers=2,
+                                  heads=2, ffn=512, max_seq=128),
+                      batch=8)
+    else:
+        run_and_trace()
     analyze()

@@ -14,6 +14,7 @@ def top_ops(trace_dir, n=35):
     xp = max(xplanes, key=os.path.getmtime)
     space = xplane_pb2.XSpace()
     space.ParseFromString(open(xp, "rb").read())
+    printed = False
     for plane in space.planes:
         if "TPU" not in plane.name and "/device:" not in plane.name:
             continue
@@ -33,9 +34,15 @@ def top_ops(trace_dir, n=35):
             continue
         print("== plane: %s  (total XLA-op time %.2f ms) ==" % (
             plane.name, total / 1e9))
+        printed = True
         for name, ps in by_name.most_common(n):
             print("%8.3f ms  %5.1f%%  x%-4d %s" % (
                 ps / 1e9, 100.0 * ps / total, cnt[name], name[:110]))
+    if not printed:
+        # e.g. a CPU smoke: the CPU xplane has no device op line — name
+        # the planes so a silent run is diagnosable, not mysterious
+        print("no device XLA-op plane matched; planes present: %s"
+              % [p.name for p in space.planes])
 
 
 if __name__ == "__main__":
