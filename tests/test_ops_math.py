@@ -310,6 +310,15 @@ class TestGather(OpTest):
         self.outputs = {"Out": x[idx]}
         self.check_output()
 
+    def test_grad(self):
+        # scatter-add transpose incl. a REPEATED index (rows 2x2): the
+        # MLM masked-gather head relies on this vjp
+        x = rng.rand(6, 4).astype("float32")
+        idx = np.array([1, 3, 3, 0], dtype="int32")
+        self.inputs = {"X": x, "Index": idx}
+        self.outputs = {"Out": x[idx]}
+        self.check_grad(["in_X"], "Out")
+
 
 class TestOneHot(OpTest):
     op_type = "one_hot"
