@@ -369,20 +369,22 @@ def main():
     on_tpu = bool(probe) and _is_tpu_platform(probe.get("platform", ""))
 
     flagship_printed = False
+    flagship_line = None
 
     if on_tpu:
         # Every completed line prints IMMEDIATELY — a driver-side kill
         # mid-run must not lose finished results (lesson of the round-2
-        # 25-minute kill).  The flagship child runs LAST so its line is
-        # also printed last (last-line-wins consumers read the headline
-        # metric), and with these caps the flagship always receives its
-        # full cap even if every earlier child burns its own.
-        # worst-case non-flagship spend incl. the 15s post-SIGKILL drain
-        # per timeout (_run_child): (120+15)+(160+15)+(340+15)+(270+15)
-        # = 950s, leaving 430s ≥ the flagship's full 420s cap
+        # 25-minute kill).  The flagship child runs FIRST — the tunnel
+        # flaps, and a window that dies after one child must still yield
+        # the headline number (its line is RE-printed at the end so
+        # last-line-wins consumers read the flagship metric).
+        # worst-case spend incl. the 15s post-SIGKILL drain per timeout
+        # (_run_child): probe (120+15) + (420+15)+(160+15)+(340+15)
+        # = 1100s, leaving 280s for bert512 + retries before the 1380s
+        # budget clamps them via remaining().
         # (r04: ctr hit its old 110s cap mid-compile on the tunnel)
-        plan = [("ctr", 160), ("resnet", 340), ("bert512", 270),
-                ("bert", 420)]
+        plan = [("bert", 420), ("ctr", 160), ("resnet", 340),
+                ("bert512", 270)]
         failed = []
         for mode, cap in plan:
             w_ok, w_lines, w_err = _run_child(mode, remaining(cap))
@@ -393,12 +395,13 @@ def main():
                 print(json.dumps(l), flush=True)
                 if l.get("metric") == FLAGSHIP_METRIC:
                     flagship_printed = True
+                    flagship_line = l
         # Retry pass: the axon tunnel flaps mid-compile ("response body
         # closed before all bytes were read" killed both the r04 resnet
         # and flagship children on their first attempt while the very
         # same children succeeded minutes later).  One bounded retry per
-        # transiently-failed mode, in plan order (flagship stays last),
-        # with 300s reserved for the flagship's own retry.
+        # transiently-failed mode, flagship first (plan order), with
+        # 300s reserved for the flagship's own retry.
         transient = ("response body closed", "remote_compile", "HTTP 5",
                      "UNAVAILABLE", "DEADLINE_EXCEEDED", "Socket closed",
                      "timeout after")
@@ -419,6 +422,10 @@ def main():
                 print(json.dumps(l), flush=True)
                 if l.get("metric") == FLAGSHIP_METRIC:
                     flagship_printed = True
+                    flagship_line = l
+        if flagship_line is not None:
+            # re-print so the flagship is also the LAST line
+            print(json.dumps(flagship_line), flush=True)
     else:
         reason = err or "backend probe returned no TPU (platform=%s)" % (
             probe and probe.get("platform"))
