@@ -1,0 +1,74 @@
+"""Parse a bench_flash sweep artifact and recommend PADDLE_TPU_FLASH_MIN_T.
+
+Input: the output of tools/bench_flash.py (directly or the watcher's
+``hw_results/bench_flash_sweep.txt``), lines like
+
+    T=512   drop=0.1 pallas    1.234 ms  attn-MFU 0.345
+
+For each (T, dropout) the kernel should engage iff it beats the XLA
+path; the recommended MIN_T is the smallest T where the kernel wins at
+the TRAINING configuration (dropout on) and keeps winning above.
+
+Usage:  python tools/decide_flash_min_t.py [hw_results/bench_flash_sweep.txt]
+"""
+
+import re
+import sys
+
+
+def parse(path):
+    rows = {}
+    pat = re.compile(
+        r"T=(\d+)\s+drop=([\d.]+)\s+(pallas|xla)\s+([\d.]+) ms")
+    with open(path) as f:
+        for line in f:
+            m = pat.search(line)
+            if m:
+                t, drop, kind, ms = (int(m.group(1)), float(m.group(2)),
+                                     m.group(3), float(m.group(4)))
+                rows[(t, drop, kind)] = ms
+    return rows
+
+
+def main():
+    path = sys.argv[1] if len(sys.argv) > 1 else \
+        "hw_results/bench_flash_sweep.txt"
+    rows = parse(path)
+    if not rows:
+        raise SystemExit("no sweep rows parsed from %s" % path)
+    ts = sorted({t for t, _, _ in rows})
+    drops = sorted({d for _, d, _ in rows})
+    print("%-6s %-6s %10s %10s  %s" % ("T", "drop", "xla ms",
+                                       "pallas ms", "winner"))
+    wins = {}
+    for t in ts:
+        for d in drops:
+            x = rows.get((t, d, "xla"))
+            p = rows.get((t, d, "pallas"))
+            if x is None or p is None:
+                continue
+            w = "pallas" if p < x else "xla"
+            wins.setdefault(d, {})[t] = (w == "pallas")
+            print("%-6d %-6.1f %10.3f %10.3f  %s (%.2fx)"
+                  % (t, d, x, p, w, x / p))
+    # recommendation keyed on the training config: the largest dropout
+    # in the sweep (bench trains with attention dropout on)
+    d_train = max(drops)
+    per_t = wins.get(d_train, {})
+    rec = None
+    for t in sorted(per_t):
+        if per_t[t] and all(per_t[u] for u in per_t if u >= t):
+            rec = t
+            break
+    if rec is None:
+        print("\nrecommendation: kernel never cleanly wins at drop=%.1f "
+              "— keep PADDLE_TPU_FLASH_MIN_T above %d (XLA path)"
+              % (d_train, max(ts)))
+    else:
+        print("\nrecommendation: PADDLE_TPU_FLASH_MIN_T=%d "
+              "(kernel wins at drop=%.1f from T=%d upward)"
+              % (rec, d_train, rec))
+
+
+if __name__ == "__main__":
+    main()
