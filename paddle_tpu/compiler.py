@@ -53,6 +53,10 @@ class BuildStrategy:
         # ops read named activations, so segment remat must be chosen at
         # the model level)
         self.remat = False
+        # ZeRO-1: partition param-shaped optimizer accumulators (Adam
+        # moments etc.) over the data axis — per-chip optimizer memory
+        # drops by dp_degree (the fleet "sharding" strategy, TPU-style)
+        self.shard_optimizer_state = False
         self.donate_params = True
         # microbatch gradient accumulation (reference
         # ir/multi_batch_merge_pass.cc "repeat"): split the batch into k

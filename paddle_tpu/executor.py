@@ -369,13 +369,14 @@ def _analyze_block(block, feed_names, fetch_names):
 class _CompiledBlock:
     def __init__(self, program, block, feed_names, fetch_names, scope, mode,
                  mesh=None, accumulate_steps=1, trip_counts=None,
-                 iters_per_run=1):
+                 iters_per_run=1, shard_opt_state=False):
         import jax
 
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
         self.accumulate_steps = int(accumulate_steps or 1)
         self.iters_per_run = int(iters_per_run or 1)
+        self.shard_opt_state = bool(shard_opt_state) and mesh is not None
         if self.accumulate_steps > 1 and self.iters_per_run > 1:
             raise ValueError(
                 "num_iteration_per_run cannot combine with "
@@ -424,7 +425,10 @@ class _CompiledBlock:
         # the op list here is safe.)
         _top_ops = [op for op in block.ops
                     if op.type not in _HOST_SIDE_OPS]
-        _top_ops = _fuse_adam_ops(_top_ops, block)
+        if not self.shard_opt_state:
+            # (concatenating data-axis-sharded moments would force XLA
+            # to re-gather them, defeating the ZeRO-1 partition)
+            _top_ops = _fuse_adam_ops(_top_ops, block)
 
         def step_once(feeds, rw, ro, key):
             """One whole train/infer step — shared by the plain path and
@@ -521,7 +525,16 @@ class _CompiledBlock:
                         "shard_spec %r of %r does not fit mesh %s / shape "
                         "%s; replicating" % (spec, n, dict(mesh.shape),
                                              v.shape))
-                if getattr(v, "_is_distributed", False) and v.shape:
+                # row-shard over the data axis: distributed embedding
+                # tables always; optimizer accumulators under ZeRO-1
+                # (BuildStrategy.shard_optimizer_state — per-chip
+                # optimizer memory drops by dp_degree; GSPMD shards the
+                # elementwise update and all-gathers only the param)
+                if v.shape and (
+                        getattr(v, "_is_distributed", False)
+                        or (self.shard_opt_state
+                            and getattr(v, "_is_optimizer_state", False)
+                            and v.shape[0] % mesh.shape[data_axis] == 0)):
                     return NamedSharding(
                         mesh, P(data_axis, *([None] * (len(v.shape) - 1)))
                     )
@@ -530,10 +543,16 @@ class _CompiledBlock:
             feed_sh = {n: batch for n in self.feed_names}
             rw_sh = {n: param_sharding(n) for n in self.rw_names}
             ro_sh = {n: param_sharding(n) for n in self.ro_names}
+            # pin state OUTPUT shardings to the input classification:
+            # under shard_opt_state GSPMD would otherwise follow the
+            # sharded moments and emit the updated PARAM sharded too
+            # (ZeRO-3 creep) — the next dispatch's replicated in_sharding
+            # then rejects the arg.  Fetches/fresh stay None (XLA picks).
             self.jitted = jax.jit(
                 run_block,
                 donate_argnums=(1,),
                 in_shardings=(feed_sh, rw_sh, ro_sh, repl),
+                out_shardings=(None, rw_sh, None),
             )
 
 
@@ -579,7 +598,10 @@ class _AccumRunner:
         self.mode = mode
         (self.head, self.tail, self.head_written, self.grad_reads,
          self.other_reads) = _accum_partition(block)
-        self.tail = _fuse_adam_ops(self.tail, block)
+        if not cb.shard_opt_state:
+            # same guard as the non-accum path: fusing would concatenate
+            # (re-gather) ZeRO-1-sharded moments every step
+            self.tail = _fuse_adam_ops(self.tail, block)
         # head-written values the caller needs: fetches + persistables
         carry_out = list(self.other_reads)
         for n in cb.fetch_names + cb.rw_names + cb.fresh_persist:

@@ -156,8 +156,11 @@ class Optimizer:
         var.stop_gradient = True
         # param-shaped accumulators shard with their param (distributed
         # embedding rows / TP shard_spec), so the optimizer update stays
-        # local to each shard
+        # local to each shard; the marker also lets
+        # BuildStrategy.shard_optimizer_state partition replicated-param
+        # state over the data axis (ZeRO-1)
         if list(shape) == list(param.shape or []):
+            var._is_optimizer_state = True
             if getattr(param, "_is_distributed", False):
                 var._is_distributed = True
             spec = getattr(param, "shard_spec", None)

@@ -55,6 +55,8 @@ class SPMDRunner:
             getattr(build_strategy, "batch_merge_repeat", 1) or 1)
         self.iters_per_run = int(
             getattr(exec_strategy, "num_iteration_per_run", 1) or 1)
+        self.shard_opt_state = bool(
+            getattr(build_strategy, "shard_optimizer_state", False))
         self._cache = {}
 
     def run(self, executor, feed, fetch_list, scope, return_numpy):
@@ -124,6 +126,7 @@ class SPMDRunner:
                 mesh=self.mesh,
                 accumulate_steps=self.accumulate_steps,
                 iters_per_run=self.iters_per_run,
+                shard_opt_state=self.shard_opt_state,
             )
             self._cache[key_tuple] = compiled
 

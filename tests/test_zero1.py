@@ -1,0 +1,72 @@
+"""BuildStrategy.shard_optimizer_state (ZeRO-1): param-shaped Adam
+moments partition dim 0 over the data axis under DP — per-chip optimizer
+memory drops by dp_degree, training is numerically unchanged.
+
+Reference analogue: the fleet "sharding" strategy (post-v1.5); on TPU it
+is a sharding annotation — GSPMD shards the elementwise update and
+all-gathers only the param result."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.executor import Scope, scope_guard
+
+
+def _build(seed=5):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = seed
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=32, act="relu")
+        pred = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.square_error_cost(input=pred, label=y))
+        fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+    return main, startup, loss
+
+
+def _train(shard_state, steps=5):
+    import jax
+
+    main, startup, loss = _build()
+    bs = fluid.BuildStrategy()
+    bs.shard_optimizer_state = shard_state
+    cp = fluid.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name, build_strategy=bs)
+    sc = Scope()
+    rng = np.random.RandomState(0)
+    xb = rng.randn(16, 16).astype("float32")
+    feed = {"x": xb, "y": (xb.sum(1, keepdims=True) > 0).astype("float32")}
+    with scope_guard(sc):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        ls = [float(np.asarray(exe.run(cp, feed=feed,
+                                       fetch_list=[loss])[0]).reshape(-1)[0])
+              for _ in range(steps)]
+        moments = {n: sc.get(n) for n in list(sc.vars)
+                   if "_adam_moment1_" in n}
+    return ls, moments
+
+
+class TestZero1:
+    def test_loss_parity_and_sharded_moments(self):
+        import jax
+
+        ls_off, m_off = _train(False)
+        ls_on, m_on = _train(True)
+        np.testing.assert_allclose(ls_off, ls_on, rtol=1e-5, atol=1e-6)
+        assert ls_on[-1] < ls_on[0]
+        # the fc weight moment [16,32] / [32,1]... dim0 divisible by 8
+        # for the first fc's w: find a moment whose dim0 % ndev == 0
+        ndev = len(jax.devices())
+        sharded = [
+            n for n, v in m_on.items()
+            if v.ndim >= 1 and v.shape[0] % ndev == 0
+            and not v.sharding.is_fully_replicated
+        ]
+        assert sharded, (
+            "no divisible moment came back data-axis-sharded: %s"
+            % {n: (v.shape, str(v.sharding)) for n, v in m_on.items()})
+        # and the off-run's moments stay replicated
+        assert all(v.sharding.is_fully_replicated for v in m_off.values())
