@@ -1,0 +1,82 @@
+"""BERT MLM pretraining on synthetic data — single chip or 8-way data
+parallel with optional ZeRO-1 and K-steps-per-dispatch.
+
+    python examples/bert_pretrain.py --cpu --tiny           # smoke
+    python examples/bert_pretrain.py --dp 8 --zero1 --ipr 10
+"""
+
+import argparse
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--tiny", action="store_true",
+                    help="BERT_TINY config (CPU-friendly)")
+    ap.add_argument("--dp", type=int, default=1,
+                    help="data-parallel degree (devices)")
+    ap.add_argument("--zero1", action="store_true",
+                    help="shard optimizer state over the data axis")
+    ap.add_argument("--ipr", type=int, default=1,
+                    help="optimizer steps per dispatch (scanned)")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=128)
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import bert
+
+    cfg = bert.BERT_TINY if args.tiny else bert.BERT_BASE
+    main_prog, startup, feeds, loss = bert.build_pretrain(
+        cfg, seq_len=args.seq, lr=1e-4, amp=not args.cpu, train=True)
+
+    run_prog = main_prog
+    if args.dp > 1 or args.zero1 or args.ipr > 1:
+        ndev = len(jax.devices())
+        if args.dp > ndev:
+            raise SystemExit(
+                "--dp %d but only %d device(s) visible (for a virtual "
+                "mesh: XLA_FLAGS=--xla_force_host_platform_device_count"
+                "=%d with --cpu)" % (args.dp, ndev, args.dp))
+        if args.dp > 1 and args.batch % args.dp:
+            raise SystemExit("--batch %d must divide --dp %d"
+                             % (args.batch, args.dp))
+        bs = fluid.BuildStrategy()
+        bs.shard_optimizer_state = args.zero1
+        es = fluid.ExecutionStrategy()
+        es.num_iteration_per_run = args.ipr
+        run_prog = fluid.CompiledProgram(main_prog).with_data_parallel(
+            loss_name=loss.name, build_strategy=bs, exec_strategy=es,
+            places=jax.devices()[:max(args.dp, 1)])
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    feed = bert.make_fake_batch(args.batch, args.seq, cfg, rng)
+
+    t0 = time.time()
+    for i in range(args.steps):
+        (lv,) = exe.run(run_prog, feed=feed, fetch_list=[loss])
+        if i % 5 == 0:
+            print("step %d (x%d iters): loss %.4f"
+                  % (i, args.ipr, float(np.asarray(lv).reshape(-1)[0])))
+    dt = time.time() - t0
+    toks = args.batch * args.seq * args.steps * args.ipr
+    print("done: %.0f tokens/sec" % (toks / dt))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,73 @@
+"""Train an MNIST MLP with the Fluid-style API (reference:
+``tests/book/test_recognize_digits.py`` flow).
+
+    python examples/mnist_train.py [--cpu] [--epochs N]
+"""
+
+import argparse
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend")
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--batch", type=int, default=64)
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as fluid
+    from paddle_tpu import datasets
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        img = fluid.layers.data(name="img", shape=[784], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=img, size=200, act="relu")
+        h = fluid.layers.fc(input=h, size=200, act="relu")
+        pred = fluid.layers.fc(input=h, size=10, act="softmax")
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.cross_entropy(input=pred, label=label))
+        acc = fluid.layers.accuracy(input=pred, label=label)
+        test_prog = main_prog.clone(for_test=True)
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+
+    train_reader = fluid.batch(datasets.mnist.train(), args.batch)
+    test_reader = fluid.batch(datasets.mnist.test(), 256)
+
+    for epoch in range(args.epochs):
+        for i, batch in enumerate(train_reader()):
+            xs = np.stack([b[0].reshape(-1) for b in batch]).astype(
+                "float32")
+            ys = np.array([[b[1]] for b in batch], dtype="int64")
+            lv, av = exe.run(main_prog, feed={"img": xs, "label": ys},
+                             fetch_list=[loss, acc])
+            if i % 100 == 0:
+                print("epoch %d step %d: loss %.4f acc %.3f"
+                      % (epoch, i, np.asarray(lv).reshape(-1)[0],
+                         np.asarray(av).reshape(-1)[0]))
+        accs = []
+        for batch in test_reader():
+            xs = np.stack([b[0].reshape(-1) for b in batch]).astype(
+                "float32")
+            ys = np.array([[b[1]] for b in batch], dtype="int64")
+            accs.append(np.asarray(
+                exe.run(test_prog, feed={"img": xs, "label": ys},
+                        fetch_list=[acc])[0]).reshape(-1)[0])
+        print("epoch %d: test acc %.4f" % (epoch, float(np.mean(accs))))
+
+
+if __name__ == "__main__":
+    main()
