@@ -52,8 +52,8 @@ def main():
                 "mesh: XLA_FLAGS=--xla_force_host_platform_device_count"
                 "=%d with --cpu)" % (args.dp, ndev, args.dp))
         if args.dp > 1 and args.batch % args.dp:
-            raise SystemExit("--batch %d must divide --dp %d"
-                             % (args.batch, args.dp))
+            raise SystemExit("--dp %d must divide --batch %d"
+                             % (args.dp, args.batch))
         bs = fluid.BuildStrategy()
         bs.shard_optimizer_state = args.zero1
         es = fluid.ExecutionStrategy()

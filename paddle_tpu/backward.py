@@ -111,7 +111,8 @@ class _GradEngine:
         """Append the grad op(s) for `op`; returns True if appended."""
         from .ops import control_flow as cf_ops
 
-        if op.type in ("while", "conditional_block", "recurrent"):
+        if op.type in ("while", "conditional_block", "recurrent",
+                       "recompute_block"):
             return self._backprop_sub_block_op(op)
         try:
             opdef = op_registry.get_op_def(op.type)
@@ -221,7 +222,7 @@ class _GradEngine:
         from .ops import control_flow as cf_ops
 
         out_slot = {"recurrent": "outputs", "conditional_block": "Out",
-                    "while": "Out"}[op.type]
+                    "while": "Out", "recompute_block": "Out"}[op.type]
         out_names = op.outputs.get(out_slot, [])
         gnames = []
         any_grad = False

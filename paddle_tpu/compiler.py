@@ -123,9 +123,9 @@ class CompiledProgram:
         if getattr(bs, "remat", False):
             warnings.warn(
                 "BuildStrategy.remat applies to pipeline stages "
-                "(PipelineOptimizer) and ring attention only; the plain "
-                "executor keeps activations under XLA liveness — pick "
-                "recompute boundaries at the model level instead",
+                "(PipelineOptimizer) and ring attention only; for the "
+                "plain executor pick recompute boundaries at the model "
+                "level with `with fluid.layers.recompute():`",
                 stacklevel=3)
 
     def with_inference_optimize(self, config):

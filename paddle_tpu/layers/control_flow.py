@@ -21,6 +21,7 @@ __all__ = [
     "StaticRNN",
     "Switch",
     "ConditionalBlock",
+    "recompute",
     "IfElse",
     "DynamicRNN",
     "increment",
@@ -356,6 +357,84 @@ class ConditionalBlock:
             outputs={"Out": out_names, "Scope": [scope_var]},
             attrs={"sub_block": sub_block.idx},
         )
+
+
+class _RecomputeGuard(BlockGuard):
+    """``with fluid.layers.recompute():`` — activation rematerialization
+    (SURVEY §7g "remat"; beyond the v1.5 reference, which has no
+    recompute; later Paddle added RecomputeOptimizer).
+
+    Ops built inside the region lower as ONE ``recompute_block`` op; its
+    grad op re-runs the region's forward from optimization-barriered
+    inputs (jax.checkpoint's own mechanism) instead of keeping the
+    intermediate activations live — peak memory for the region drops to
+    its inputs+outputs at the cost of one extra forward, on backends
+    whose scheduler honors the barrier (TPU; the XLA CPU pipeline CSE's
+    remat away for native jax.checkpoint too).  Gradients are
+    numerically identical (dropout keys are per-op deterministic, so
+    the recomputed masks match)."""
+
+    def __init__(self, name=None):
+        from ..framework import default_main_program
+
+        super().__init__(default_main_program())
+        self.helper = LayerHelper("recompute_block", name=name)
+
+    def __enter__(self):
+        self.sub_block = self.program._create_block()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if exc_type is not None:
+            # always leave the block stack sane — a caught exception must
+            # not strand subsequent layers in the orphaned sub-block
+            self.program._rollback()
+            return False
+        self.program._rollback()
+        parent = self.program.current_block()
+        # vars created inside the region must stay referable by later
+        # layers: promote them to the parent block (activation tmp vars
+        # only — params are persistables in the global block already)
+        for name, var in self.sub_block.vars.items():
+            if parent._find_var_recursive(name) is None:
+                parent.vars[name] = var
+        written = []
+        for op in self.sub_block.ops:
+            for n in op.output_arg_names:
+                if n and n not in written:
+                    written.append(n)
+        # the captured outer reads MUST be declared as formal inputs:
+        # backward's op-path pruning and the executor's external-read
+        # analysis walk input edges, and an inputless op would orphan
+        # everything upstream of the region (params included)
+        from ..ops.control_flow import sub_block_external_reads
+
+        captured = [
+            n for n in sub_block_external_reads(self.sub_block)
+            if parent._find_var_recursive(n) is not None
+        ]
+        scope_var = parent.create_var(
+            name=self.helper.name + ".scope",
+            type=core.VarDesc.VarType.STEP_SCOPES,
+        )
+        parent.append_op(
+            type="recompute_block",
+            inputs={"Captured": captured},
+            outputs={"Out": written, "Scope": [scope_var]},
+            attrs={"sub_block": self.sub_block.idx},
+        )
+        return True
+
+
+def recompute(name=None):
+    """Context manager: ops built inside are rematerialized in backward
+    (region runs under jax.checkpoint).  Usage::
+
+        with fluid.layers.recompute():
+            h = fluid.layers.fc(h, size=1024, act="relu")
+            h = fluid.layers.fc(h, size=1024, act="relu")
+    """
+    return _RecomputeGuard(name=name)
 
 
 class ConditionalBlockGuard(BlockGuard):

@@ -20,7 +20,8 @@ import numpy as np
 from .registry import register_op, EMPTY_VAR_NAME
 
 SUB_BLOCK_OPS = ("while", "conditional_block", "recurrent",
-                 "recurrent_grad", "conditional_block_grad", "while_grad")
+                 "recurrent_grad", "conditional_block_grad", "while_grad",
+                 "recompute_block", "recompute_block_grad")
 
 ARRAY_CAPACITY_ATTR = "tensor_array_capacity"
 DEFAULT_ARRAY_CAPACITY = 128
@@ -149,6 +150,34 @@ def run_sub_block_op(op, block, env, ctx, run_block_fn):
 
         final = jax.lax.while_loop(cond, body, carry0)
         env.update(final)
+        return
+
+    if op.type == "recompute_block":
+        # forward of the remat region: a PLAIN run of the sub-block (this
+        # call is never differentiated by jax — grads are explicit ops),
+        # emitting every written name into env.  Unconsumed entries are
+        # ordinary unbarriered values, so XLA DCEs them; the remat effect
+        # lives entirely in the GRAD op's barriered re-forward.
+        out_names = list(op.outputs.get("Out", []))
+        cap = [n for n in op.inputs.get("Captured", [])
+               or sub_block_external_reads(sub_block) if n in env]
+        outer = dict(env)
+
+        def region(cap_vals):
+            e = dict(outer)
+            e.update(dict(zip(cap, cap_vals)))
+            run_block_fn(sub_block, e, ctx)
+            return tuple(e[n] for n in out_names)
+
+        # plain run: this call is never differentiated by jax (grads are
+        # explicit ops), so the region's unexported intermediates die
+        # here; the grad op recomputes them behind a barrier
+        outs = region(tuple(env[n] for n in cap))
+        env.update(dict(zip(out_names, outs)))
+        return
+
+    if op.type == "recompute_block_grad":
+        _run_recompute_grad(op, sub_block, env, ctx, run_block_fn)
         return
 
     if op.type == "conditional_block":
@@ -466,6 +495,42 @@ def _run_recurrent_grad(op, sub_block, env, ctx, run_block_fn):
         for n, g, p in zip(names, gvals, primals):
             if n and n != EMPTY_VAR_NAME:
                 env[n] = _clean_grad(g, p)
+
+
+def _run_recompute_grad(op, sub_block, env, ctx, run_block_fn):
+    """Grad of recompute_block: jax.vjp over the region re-run from
+    BARRIERED inputs.  The optimization_barrier on the captured values
+    (jax.checkpoint's own mechanism) makes the recompute a distinct
+    subgraph XLA cannot CSE with the forward op's chain — without it the
+    'recompute' would alias the original activations and their liveness
+    would span fwd→bwd again, defeating the remat."""
+    import jax
+
+    cap_names = op.inputs.get("Captured", [])
+    out_names = op.inputs.get("Out", [])
+    gout_names = op.inputs.get("Out@GRAD", [])
+    outer = dict(env)
+
+    def f(cap_vals):
+        e = dict(outer)
+        e.update(dict(zip(cap_names, cap_vals)))
+        run_block_fn(sub_block, e, ctx)
+        return tuple(e[n] for n in out_names)
+
+    cap_vals = tuple(env[n] for n in cap_names)
+    if cap_vals:
+        cap_vals = jax.lax.optimization_barrier(cap_vals)
+    primal, vjp_fn = jax.vjp(f, cap_vals)
+    cots = []
+    for i, p in enumerate(primal):
+        gname = gout_names[i] if i < len(gout_names) else EMPTY_VAR_NAME
+        g = env.get(gname) if gname and gname != EMPTY_VAR_NAME else None
+        cots.append(_nonzero_cotangent(g, p))
+    (gcap,) = vjp_fn(tuple(cots))
+    names = op.outputs.get("Captured@GRAD", [])
+    for n, g, p in zip(names, gcap, cap_vals):
+        if n and n != EMPTY_VAR_NAME:
+            env[n] = _clean_grad(g, p)
 
 
 def _run_conditional_grad(op, sub_block, env, ctx, run_block_fn):
