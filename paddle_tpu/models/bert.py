@@ -16,7 +16,7 @@ import paddle_tpu as fluid
 class BertConfig:
     def __init__(self, vocab_size=30522, hidden=768, layers=12, heads=12,
                  ffn=3072, max_seq=512, type_vocab=2, dropout=0.1,
-                 attn_dropout=None, fuse_attn=True):
+                 attn_dropout=None, fuse_attn=True, recompute=False):
         self.vocab_size = vocab_size
         self.hidden = hidden
         self.layers = layers
@@ -30,6 +30,10 @@ class BertConfig:
         # that want the fused kernel set attn_dropout=0
         self.attn_dropout = dropout if attn_dropout is None else attn_dropout
         self.fuse_attn = fuse_attn
+        # wrap each encoder layer in fluid.layers.recompute() — backward
+        # re-runs the layer instead of keeping its activations (the
+        # long-sequence memory lever; one extra forward per layer)
+        self.recompute = recompute
 
 
 BERT_BASE = BertConfig()
@@ -138,7 +142,12 @@ def encoder(input_ids, token_type_ids, attn_mask_bias, cfg, seq_len):
             x, cfg.dropout, dropout_implementation="upscale_in_train"
         )
     for i in range(cfg.layers):
-        x = _encoder_layer(x, attn_mask_bias, cfg, "bert.layer%d" % i)
+        if cfg.recompute:
+            with fluid.layers.recompute():
+                x = _encoder_layer(x, attn_mask_bias, cfg,
+                                   "bert.layer%d" % i)
+        else:
+            x = _encoder_layer(x, attn_mask_bias, cfg, "bert.layer%d" % i)
     return x
 
 
