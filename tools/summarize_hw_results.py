@@ -1,0 +1,102 @@
+"""Summarize hw_results/ artifacts into decisions.
+
+Reads every watcher artifact, extracts bench JSON lines and validation
+markers, prints the A/B deltas that gate the knob defaults:
+
+- bench_bert_default vs bench_fused_adam_on  -> PADDLE_TPU_FUSE_ADAM
+- bench_bert_default vs bench_bert_flash128  -> PADDLE_TPU_FLASH_MIN_T
+  (training-with-dropout regime; the full sweep refines via
+  tools/decide_flash_min_t.py)
+- bench_bert_default vs bench_bert_ipr25     -> dispatch-latency share
+  (if ipr25 >> default, the wall step was dispatch-bound and the bench
+  should default PADDLE_BENCH_ITERS_PER_RUN on TPU)
+
+Usage:  python tools/summarize_hw_results.py [hw_results/]
+"""
+
+import glob
+import json
+import os
+import re
+import sys
+
+
+def lines_of(path):
+    out = []
+    try:
+        with open(path) as f:
+            for ln in f:
+                ln = ln.strip()
+                if ln.startswith("{"):
+                    try:
+                        out.append(json.loads(ln))
+                    except ValueError:
+                        pass
+    except OSError:
+        pass
+    return out
+
+
+def main():
+    d = sys.argv[1] if len(sys.argv) > 1 else "hw_results"
+    arts = sorted(glob.glob(os.path.join(d, "*.txt")))
+    if not arts:
+        raise SystemExit("no artifacts under %s" % d)
+
+    metrics = {}  # artifact-stem -> {metric: (value, unit)}
+    for p in arts:
+        stem = os.path.splitext(os.path.basename(p))[0]
+        for l in lines_of(p):
+            if "metric" in l:
+                metrics.setdefault(stem, {})[l["metric"]] = (
+                    l.get("value", 0), l.get("unit", ""))
+        with open(p) as f:
+            txt = f.read()
+        if "FLASH-PRNG-VALIDATION-OK" in txt:
+            print("[ok] %s: FLASH-PRNG-VALIDATION-OK" % stem)
+
+    print()
+    for stem in sorted(metrics):
+        for m, (v, u) in sorted(metrics[stem].items()):
+            print("%-28s %-46s %12s  %s" % (stem, m, v, u[:60]))
+
+    def flagship(stem):
+        m = metrics.get(stem, {})
+        for k, (v, u) in m.items():
+            if k == "bert_base_mlm_train_tokens_per_sec_per_chip" and v:
+                mfu = re.search(r"MFU ([\d.]+)", u)
+                return float(v), float(mfu.group(1)) if mfu else None
+        return None, None
+
+    base_v, base_m = flagship("bench_bert_default")
+    print()
+    if base_v:
+        print("flagship default: %.0f tok/s (MFU %s)" % (base_v, base_m))
+        for stem, knob, better in (
+                ("bench_fused_adam_on", "PADDLE_TPU_FUSE_ADAM=1", "on"),
+                ("bench_bert_flash128", "PADDLE_TPU_FLASH_MIN_T=128",
+                 "flash@128"),
+                ("bench_bert_ipr25", "ITERS_PER_RUN=25", "ipr25")):
+            v, m = flagship(stem)
+            if v:
+                print("  %-26s %.0f tok/s (%+.1f%%) -> %s wins"
+                      % (better, v, 100 * (v - base_v) / base_v,
+                         better if v > base_v else "default"))
+            else:
+                print("  %-26s not captured" % better)
+        if base_m and base_m >= 0.45:
+            print("MFU gate: PASSED (%.3f >= 0.45)" % base_m)
+        elif base_m:
+            print("MFU gate: %.3f < 0.45 — check the A/B winners above "
+                  "and the profile artifact" % base_m)
+    else:
+        print("flagship default not captured yet")
+
+    sweep = os.path.join(d, "bench_flash_sweep.txt")
+    if os.path.exists(sweep):
+        print("\nflash sweep present — run: "
+              "python tools/decide_flash_min_t.py %s" % sweep)
+
+
+if __name__ == "__main__":
+    main()
