@@ -140,6 +140,40 @@ class TestRecompute:
                   for _ in range(8)]
         assert ls[-1] < ls[0] * 0.9, ls
 
+    def test_clone_and_inference_export(self, tmp_path):
+        """Train-with-recompute → clone(for_test) eval → inference-model
+        round-trip: the sub-block must survive pruning + serialization."""
+        fluid.unique_name.switch()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            with fluid.layers.recompute():
+                h = fluid.layers.fc(input=x, size=32, act="relu")
+            pred = fluid.layers.fc(input=h, size=1)
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.square_error_cost(input=pred, label=y))
+            test_prog = main.clone(for_test=True)
+            fluid.optimizer.Adam(learning_rate=1e-2).minimize(loss)
+        sc = Scope()
+        rng = np.random.RandomState(0)
+        xb = rng.randn(8, 16).astype("float32")
+        feed = {"x": xb,
+                "y": (xb.sum(1, keepdims=True) > 0).astype("float32")}
+        with scope_guard(sc):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            exe.run(main, feed=feed, fetch_list=[loss])
+            ev = exe.run(test_prog, feed=feed, fetch_list=[loss])[0]
+            assert np.isfinite(np.asarray(ev)).all()
+            d = str(tmp_path)
+            fluid.io.save_inference_model(d, ["x"], [pred], exe,
+                                          main_program=main)
+            prog2, fnames, ftargets = fluid.io.load_inference_model(d, exe)
+            o = exe.run(prog2, feed={fnames[0]: xb},
+                        fetch_list=ftargets)[0]
+            assert np.asarray(o).shape == (8, 1)
+
     def test_dropout_inside_region(self):
         """Per-op deterministic keys: the recomputed forward must draw
         the SAME dropout mask, so training stays stable and finite."""
