@@ -25,6 +25,9 @@ def main():
                     help="shard optimizer state over the data axis")
     ap.add_argument("--ipr", type=int, default=1,
                     help="optimizer steps per dispatch (scanned)")
+    ap.add_argument("--recompute", action="store_true",
+                    help="rematerialize each encoder layer in backward "
+                         "(the long-sequence memory lever)")
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--seq", type=int, default=128)
@@ -40,6 +43,11 @@ def main():
     from paddle_tpu.models import bert
 
     cfg = bert.BERT_TINY if args.tiny else bert.BERT_BASE
+    if args.recompute:
+        import copy
+
+        cfg = copy.copy(cfg)
+        cfg.recompute = True
     main_prog, startup, feeds, loss = bert.build_pretrain(
         cfg, seq_len=args.seq, lr=1e-4, amp=not args.cpu, train=True)
 
