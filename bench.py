@@ -152,6 +152,24 @@ def _timed_steps(exe, main_prog, feed, loss, warmup, steps):
     return dt
 
 
+def _wrap_iters_per_run(main_prog, loss, steps):
+    """Shared K-steps-per-dispatch knob (PADDLE_BENCH_ITERS_PER_RUN):
+    returns (run_prog, adjusted_dispatch_count, iters)."""
+    import jax
+
+    import paddle_tpu as fluid
+
+    iters = max(1, int(os.environ.get("PADDLE_BENCH_ITERS_PER_RUN", "1")
+                       or 1))
+    if iters <= 1:
+        return main_prog, steps, 1
+    es = fluid.ExecutionStrategy()
+    es.num_iteration_per_run = iters
+    run_prog = fluid.CompiledProgram(main_prog).with_data_parallel(
+        loss_name=loss.name, exec_strategy=es, places=jax.devices()[:1])
+    return run_prog, max(1, steps // iters), iters
+
+
 def child_resnet():
     import jax
     import jax.numpy as jnp
@@ -167,6 +185,7 @@ def child_resnet():
     size = 224 if on_tpu else 32
     main_prog, startup, feeds, loss, acc = resnet.build(
         dataset="imagenet" if on_tpu else "cifar10", amp=on_tpu)
+    run_prog, steps, iters = _wrap_iters_per_run(main_prog, loss, steps)
     scope = Scope()
     with scope_guard(scope):
         exe = fluid.Executor(fluid.TPUPlace())
@@ -178,16 +197,18 @@ def child_resnet():
             "label": jnp.asarray(
                 rng.randint(0, 10, (batch, 1)).astype("int64")),
         }
-        dt = _timed_steps(exe, main_prog, feed, loss, warmup, steps)
-    ips = batch * steps / dt
+        dt = _timed_steps(exe, run_prog, feed, loss, warmup, steps)
+    ips = batch * steps * iters / dt
     mfu = ips * RESNET50_TRAIN_FLOPS_PER_IMAGE / peak_flops(dev)
     print(json.dumps({
         "metric": "resnet50_imagenet_train_images_per_sec_per_chip"
                   if on_tpu else "resnet_cifar_smoke_images_per_sec",
         "value": round(ips, 1),
-        "unit": "images/sec/chip (%dx%d bs%d bf16 AMP, MFU %.3f on %s)"
-                % (size, size, batch, mfu,
-                   getattr(dev, "device_kind", str(dev))),
+        "unit": "images/sec/chip (%dx%d bs%d %s%s, MFU %.3f on %s)"
+                % (size, size, batch,
+                   "bf16 AMP" if on_tpu else "fp32",
+                   " ipr%d" % iters if iters > 1 else "",
+                   mfu, getattr(dev, "device_kind", str(dev))),
         "vs_baseline": round(mfu / 0.45, 3),
     }), flush=True)
 
@@ -259,16 +280,7 @@ def child_bert(seq_len=128):
     # per dispatch as one scanned launch — amortizes the per-dispatch
     # tunnel roundtrip the same way a real TPU training loop amortizes
     # host dispatch.  The emitted unit string records the setting.
-    iters = max(1, int(os.environ.get("PADDLE_BENCH_ITERS_PER_RUN", "1")
-                       or 1))
-    run_prog = main_prog
-    if iters > 1:
-        es = fluid.ExecutionStrategy()
-        es.num_iteration_per_run = iters
-        run_prog = fluid.CompiledProgram(main_prog).with_data_parallel(
-            loss_name=loss.name, exec_strategy=es,
-            places=jax.devices()[:1])
-        steps = max(1, steps // iters)
+    run_prog, steps, iters = _wrap_iters_per_run(main_prog, loss, steps)
 
     rng = np.random.RandomState(0)
     feed = bert.make_fake_batch(batch, seq_len, cfg, rng)
