@@ -366,11 +366,19 @@ def _analyze_block(block, feed_names, fetch_names):
     return ext_reads, written, persist_written
 
 
+# most recently constructed block — bench/profiling hook: its .jitted
+# drives AOT cost_analysis (XLA's own FLOPs) without re-tracing state
+_LAST_COMPILED_BLOCK = None
+
+
 class _CompiledBlock:
     def __init__(self, program, block, feed_names, fetch_names, scope, mode,
                  mesh=None, accumulate_steps=1, trip_counts=None,
                  iters_per_run=1, shard_opt_state=False):
         import jax
+
+        global _LAST_COMPILED_BLOCK
+        _LAST_COMPILED_BLOCK = self
 
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
@@ -728,15 +736,27 @@ def _host_table_push(host_active, fetches, n_user):
 
 def _run_ops_into_env(block, env, ctx, ops=None):
     """Lower ops of `block` (all, or the given subset) into `env` (the SSA
-    value map)."""
+    value map).
+
+    Every op's lowering is wrapped in a ``jax.named_scope`` carrying the
+    Program op type + block position (``pd<idx>_<type>``).  The scope
+    rides the jaxpr into HLO op metadata, so device profiles (XPlane)
+    can be attributed back to Program ops — the whole-block jit makes
+    host-side per-op timing impossible, and this is the device-side
+    equivalent of the reference's per-op profiler tables
+    (platform/profiler.h:166).  Trace-time only: zero runtime cost."""
+    import jax
+
     from .ops import control_flow as cf_ops
 
-    for op in (block.ops if ops is None else ops):
+    for i, op in enumerate(block.ops if ops is None else ops):
         if op.type in ("feed", "fetch"):
             continue
         if op.type in cf_ops.SUB_BLOCK_OPS:
             # control-flow ops need names + the sub-block, not just values
-            cf_ops.run_sub_block_op(op, block, env, ctx, _run_ops_into_env)
+            with jax.named_scope("pd%d_%s" % (i, op.type)):
+                cf_ops.run_sub_block_op(op, block, env, ctx,
+                                        _run_ops_into_env)
             continue
         opdef = op_registry.get_op_def(op.type)
         ins = {}
@@ -749,7 +769,9 @@ def _run_ops_into_env(block, env, ctx, ops=None):
                     vals.append(env.get(n))
             ins[slot] = vals
         op_id = op.attrs.get("__fwd_op_id__", op.attrs.get("__op_id__", 0))
-        outs = op_registry.call_op(opdef, ctx, ins, op.attrs, op_id=op_id)
+        with jax.named_scope("pd%d_%s" % (i, op.type)):
+            outs = op_registry.call_op(opdef, ctx, ins, op.attrs,
+                                       op_id=op_id)
         for slot, names in op.outputs.items():
             vals = outs.get(slot)
             if vals is None:
@@ -758,6 +780,35 @@ def _run_ops_into_env(block, env, ctx, ops=None):
                 if n and n != EMPTY_VAR_NAME and v is not None:
                     env[n] = v
     return env
+
+
+def _check_feed_shapes(program, feed_vals):
+    """Validate fed arrays against declared ``layers.data`` shapes
+    (reference executor's check_feed_shape_type on need_check_feed vars).
+
+    Only rank-equal feeds with a static declared dim that disagrees are
+    rejected — -1 dims (batch, ragged) accept anything, and rank
+    differences are left to the lowering (some callers feed unbatched
+    scalars).  A builder-attached ``var.feed_hint`` is appended so model
+    contracts (e.g. bert's masked-gather head) produce targeted errors
+    instead of a jit shape failure deep in the stack."""
+    block = program.global_block()
+    for name, value in feed_vals.items():
+        var = block.vars.get(name)
+        if var is None or not getattr(var, "need_check_feed", False):
+            continue
+        declared = var.shape
+        got = tuple(getattr(value, "shape", ()))
+        if declared is None or len(declared) != len(got):
+            continue
+        for d_decl, d_got in zip(declared, got):
+            if d_decl >= 0 and d_decl != d_got:
+                hint = getattr(var, "feed_hint", None)
+                raise ValueError(
+                    "feed %r has shape %s but the data layer declares %s "
+                    "(dim %d != %d)%s"
+                    % (name, got, tuple(declared), d_got, d_decl,
+                       ("\n" + hint) if hint else ""))
 
 
 class Executor:
@@ -792,6 +843,11 @@ class Executor:
         if program is None:
             program = default_main_program()
         if isinstance(program, CompiledProgram):
+            # feed checking must also cover the DP/ZeRO/ipr paths — the
+            # wrapped program carries the declared data shapes
+            if isinstance(feed, dict) and feed \
+                    and getattr(program, "_program", None) is not None:
+                _check_feed_shapes(program._program, feed)
             return program._run(self, feed, fetch_list, scope, return_numpy)
         if scope is None:
             scope = global_scope()
@@ -825,6 +881,7 @@ class Executor:
             if isinstance(value, (np.ndarray, list, tuple, int, float)):
                 value = jnp.asarray(value)
             feed_vals[name] = value
+        _check_feed_shapes(program, feed_vals)
 
         # host-resident embedding tables (parameter_prefetch.cc role):
         # prefetch each batch's rows into a dense slab feed; the slab's

@@ -117,6 +117,9 @@ class Variable:
         self.is_data = is_data
         self.type = type
         self.need_check_feed = need_check_feed
+        # model builders may attach a message appended to feed-shape
+        # mismatch errors (e.g. bert's masked-gather head contract)
+        self.feed_hint = None
         # op that produced this var last (set by Block.append_op)
         self.op = None
 

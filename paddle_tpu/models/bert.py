@@ -198,6 +198,16 @@ def build_pretrain(cfg=BERT_BASE, seq_len=128, lr=1e-4, amp=False,
         mlm_weights = fluid.layers.data("mlm_weights", shape=[n_pred],
                                         dtype="float32")
         if max_pred:
+            # catch callers still feeding the legacy all-position
+            # [seq_len] layout with a targeted message instead of a jit
+            # shape error (the masked-gather head changed the contract)
+            for v in (mlm_labels, mlm_weights):
+                v.feed_hint = (
+                    "build_pretrain(max_pred=%d) expects GATHERED "
+                    "masked-position feeds: mlm_labels/mlm_weights are "
+                    "[batch, %d] and mask_pos is required.  To keep the "
+                    "legacy all-position [batch, seq_len] layout, build "
+                    "with max_pred=0." % (max_pred, n_pred))
             # PER-SEQUENCE masked positions in [0, seq_len); weight 0
             # marks padding of the masked set.  The b*seq_len row offset
             # is added IN-GRAPH so the feed is shard-safe: under the

@@ -104,14 +104,13 @@ def _keep_mask(shape, rate, seed_ref, bh, qi, kj, block_q, block_k, debug):
         bits = _hash_bits(bh.astype(jnp.uint32), r, c, seed_ref[0])
     else:
         # v5e Mosaic caps prng_seed at 2 words ("Setting seed with more
-        # than 2 values is not supported") — fold the block coordinates
-        # into one mixed word.  Deterministic in (bh, qi, kj), so the
-        # bwd recompute draws the identical mask; int32 wraparound is
-        # well-defined in Mosaic and collisions across blocks are
-        # statistically benign.
-        mix = (bh * jnp.int32(1000003) + qi * jnp.int32(7919)
-               + kj * jnp.int32(104729))
-        pltpu.prng_seed(seed_ref[0], mix)
+        # than 2 values is not supported") — use BOTH words: batch·head
+        # XORs into the user seed (word 0) so distinct bh never collide,
+        # and only (qi, kj) share the mixing word.  Deterministic in
+        # (bh, qi, kj), so the bwd recompute draws the identical mask;
+        # int32 wraparound is well-defined in Mosaic.
+        mix = qi * jnp.int32(7919) + kj * jnp.int32(104729)
+        pltpu.prng_seed(seed_ref[0] ^ bh, mix)
         bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
     return bits >= _rate_threshold(rate)
 

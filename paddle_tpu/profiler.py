@@ -18,12 +18,14 @@ Reference surfaces reproduced:
 
 import contextlib
 import json
+import re
 import tempfile
 import threading
 import time
 
 __all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
-           "record_event", "cuda_profiler", "is_profiler_enabled"]
+           "record_event", "cuda_profiler", "is_profiler_enabled",
+           "attribute_op_name", "device_op_stats"]
 
 _trace_dir = None
 _enabled = False
@@ -109,6 +111,102 @@ def _write_chrome_trace(path):
                    "displayTimeUnit": "ms"}, f)
 
 
+# ---------------------------------------------------------------------------
+# Device-side per-op attribution (reference profiler.h:166 tables)
+#
+# The Executor wraps every op lowering in jax.named_scope("pd<idx>_<type>")
+# (executor._run_ops_into_env), which XLA carries into HLO op metadata and
+# the profiler into XPlane event stats.  These helpers map device-plane
+# rows back to Program ops and aggregate the reference-style
+# total/max/ave/calls table — per-op timing the whole-block jit cannot
+# provide host-side.
+# ---------------------------------------------------------------------------
+
+_PD_SCOPE_RE = re.compile(r"pd(\d+)_([A-Za-z0-9_.]+?)(?:/|$)")
+
+
+def attribute_op_name(s):
+    """Extract the INNERMOST ``pd<idx>_<type>`` Program-op tag from an
+    HLO metadata / scope path; returns (op_type, idx) or None."""
+    m = None
+    for m in _PD_SCOPE_RE.finditer(s or ""):
+        pass
+    if m is None:
+        return None
+    return m.group(2), int(m.group(1))
+
+
+def _event_strings(plane, ev, metadata):
+    """Every string on an XPlane event that might carry the scope path:
+    the event metadata name/display_name plus all string-valued stats
+    (schema varies across backends/profiler versions)."""
+    out = [metadata.name, metadata.display_name]
+    stat_names = plane.stat_metadata
+    for stat in list(ev.stats) + list(metadata.stats):
+        if stat.str_value:
+            out.append(stat.str_value)
+        elif stat.ref_value and stat.ref_value in stat_names:
+            out.append(stat_names[stat.ref_value].name)
+    return [s for s in out if s]
+
+
+def device_op_stats(trace_dir):
+    """Aggregate device XLA-op time by Program op from a jax profiler
+    trace dir.  Returns {op_type: [calls, total_ms, max_ms, min_ms]};
+    events with no pd-tag aggregate under their raw HLO name prefixed
+    '~' (so unattributed time stays visible, not silently dropped)."""
+    import glob
+    import os
+
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    xplanes = glob.glob(trace_dir + "/**/*.xplane.pb", recursive=True)
+    if not xplanes:
+        return {}
+    space = xplane_pb2.XSpace()
+    with open(max(xplanes, key=os.path.getmtime), "rb") as f:
+        space.ParseFromString(f.read())
+    table = {}
+    for plane in space.planes:
+        if "TPU" not in plane.name and "/device:" not in plane.name:
+            continue
+        ev_meta = plane.event_metadata
+        for line in plane.lines:
+            if "XLA Ops" not in line.name and line.name != "Ops":
+                continue
+            for ev in line.events:
+                md = ev_meta[ev.metadata_id]
+                tag = None
+                for s in _event_strings(plane, ev, md):
+                    tag = attribute_op_name(s)
+                    if tag:
+                        break
+                name = tag[0] if tag else "~" + (md.name or "?")[:60]
+                row = table.setdefault(name, [0, 0.0, 0.0, None])
+                dt = ev.duration_ps / 1e9  # ms
+                row[0] += 1
+                row[1] += dt
+                row[2] = max(row[2], dt)
+                row[3] = dt if row[3] is None else min(row[3], dt)
+    return table
+
+
+def _print_device_op_table(table, top=40):
+    if not table:
+        return
+    rows = sorted(table.items(), key=lambda kv: -kv[1][1])[:top]
+    name_w = max(len("Op"), *(len(n) for n, _ in rows)) + 2
+    print("\n-------------------->  Device per-op Report  "
+          "<--------------------\n")
+    print("%-*s %-8s %-12s %-12s %-12s %-12s" % (
+        name_w, "Op", "Calls", "Total(ms)", "Max(ms)", "Min(ms)",
+        "Ave(ms)"))
+    for name, (calls, total, mx, mn) in rows:
+        print("%-*s %-8d %-12.4f %-12.4f %-12.4f %-12.4f" % (
+            name_w, name, calls, total, mx, mn or 0.0, total / calls))
+    print()
+
+
 def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
     global _enabled, _device_trace
     if not _enabled:
@@ -124,6 +222,13 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
         except Exception:
             pass
         _device_trace = False
+        # reference-style per-op device table (profiler.h:166), mapped
+        # back to Program ops via the executor's pd-scope tags
+        try:
+            _print_device_op_table(device_op_stats(_trace_dir))
+        except Exception as e:  # noqa: BLE001 - table is best-effort
+            print("[paddle_tpu.profiler] per-op attribution unavailable: "
+                  "%s" % e)
     if profile_path:
         try:
             _write_chrome_trace(profile_path)

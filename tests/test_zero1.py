@@ -59,7 +59,15 @@ class TestZero1:
         assert ls_on[-1] < ls_on[0]
         # the fc weight moment [16,32] / [32,1]... dim0 divisible by 8
         # for the first fc's w: find a moment whose dim0 % ndev == 0
+        import pytest
+
         ndev = len(jax.devices())
+        if ndev == 1:
+            # is_fully_replicated on a size-1 mesh axis is a jax
+            # implementation detail; the sharding assertion is only
+            # meaningful with real partitions (conftest forces 8 virtual
+            # devices, so a skip here is VISIBLE if that forcing breaks)
+            pytest.skip("moment-sharding assertion needs >1 device")
         sharded = [
             n for n, v in m_on.items()
             if v.ndim >= 1 and v.shape[0] % ndev == 0

@@ -45,5 +45,19 @@ def top_ops(trace_dir, n=35):
               % [p.name for p in space.planes])
 
 
+def by_program_op(trace_dir):
+    """Program-op attribution view (reference profiler.h:166 tables):
+    aggregates the same device rows by the executor's pd-scope tags."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from paddle_tpu.profiler import device_op_stats, _print_device_op_table
+
+    _print_device_op_table(device_op_stats(trace_dir))
+
+
 if __name__ == "__main__":
-    top_ops(sys.argv[1] if len(sys.argv) > 1 else "/tmp/bench_trace")
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    trace = args[0] if args else "/tmp/bench_trace"
+    top_ops(trace)
+    if "--by-op" in sys.argv:
+        by_program_op(trace)
