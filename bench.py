@@ -138,7 +138,24 @@ def _timed_steps(exe, main_prog, feed, loss, warmup, steps):
     warmup, then a synchronizing loss fetch (async dispatch must not bill
     compile/warmup tails to the window — and a NaN fails BEFORE timing),
     then `steps` runs whose last one fetches the loss to close the
-    window.  Returns wall seconds for the `steps` runs."""
+    window.  Returns wall seconds for the `steps` runs.
+
+    PADDLE_BENCH_COMPILE_ONLY=1 turns the child into the COMPILE PHASE
+    of a checkpointed bench item: run one step (jit-compiles and seeds
+    the persistent .jax_cache), print a marker, exit.  The later measure
+    phase then reuses the cached executable, so a tunnel flap between
+    the two phases costs a cache-hit recompile, not 60-120s."""
+    if os.environ.get("PADDLE_BENCH_COMPILE_ONLY"):
+        # compile BOTH executables the measure phase will use: the jit
+        # cache keys on fetch_names, so fetch_list=[] (warmup + timed
+        # loop) and fetch_list=[loss] (sync points) are distinct
+        # compilations — seeding only one would leave the measure phase
+        # paying a full over-tunnel compile anyway
+        lv = exe.run(main_prog, feed=feed, fetch_list=[loss])[0]
+        assert np.isfinite(lv).all()
+        exe.run(main_prog, feed=feed, fetch_list=[])
+        print(json.dumps({"compiled": True}), flush=True)
+        sys.exit(0)
     for _ in range(warmup):
         exe.run(main_prog, feed=feed, fetch_list=[])
     lv = exe.run(main_prog, feed=feed, fetch_list=[loss])[0]  # sync
@@ -150,6 +167,59 @@ def _timed_steps(exe, main_prog, feed, loss, warmup, steps):
     dt = time.perf_counter() - t0
     assert np.isfinite(lv).all()
     return dt
+
+
+def _xla_flops_per_step(scope, feed):
+    """XLA's OWN cost-model FLOPs for the compiled step — the
+    independent cross-check of the analytic MFU denominator (VERDICT r4
+    weak #6: a FLOPs-counting bug would otherwise silently inflate every
+    MFU claim).  Returns FLOPs per single optimizer step, or None when
+    the backend can't report it.  AOT-lowers the SAME jitted callable
+    the timed loop ran, so with the persistent compile cache this is a
+    cache hit, not a fresh over-tunnel compile."""
+    if os.environ.get("PADDLE_BENCH_MFU_XCHECK", "1") == "0":
+        return None
+    try:
+        import paddle_tpu.executor as ex
+
+        cb = ex._LAST_COMPILED_BLOCK
+        if cb is None:
+            return None
+        rw = {n: scope.get(n) for n in cb.rw_names}
+        ro = {n: scope.get(n) for n in cb.ro_names}
+        comp = cb.jitted.lower(feed, rw, ro, ex.rng_key(0)).compile()
+        ca = comp.cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        flops = float(ca.get("flops", 0.0))
+        if flops <= 0:
+            return None
+        return flops / max(1, cb.iters_per_run)
+    except Exception as e:  # noqa: BLE001 - cross-check is best-effort
+        print("# mfu cross-check unavailable: %s" % str(e)[-200:],
+              flush=True)
+        return None
+
+
+def _mfu_fields(mfu_analytic, steps_per_sec, xla_flops, peak,
+                warn=True):
+    """Extra JSON fields carrying both MFU accountings; flags >10%
+    disagreement (drivers read metric/value/unit, extra keys ride
+    along).  warn=False for the CPU smoke models, whose analytic count
+    deliberately omits vector-op FLOPs that only matter at tiny scale —
+    the fields still record both numbers, the loud audit line fires only
+    for the real benchmark models."""
+    fields = {"mfu_analytic": round(mfu_analytic, 4)}
+    if xla_flops:
+        mfu_xla = steps_per_sec * xla_flops / peak
+        fields["mfu_xla"] = round(mfu_xla, 4)
+        if mfu_analytic > 0 and abs(mfu_xla / mfu_analytic - 1.0) > 0.10:
+            fields["mfu_disagree"] = True
+            if warn:
+                print("# MFU CROSS-CHECK DISAGREEMENT: analytic %.4f vs "
+                      "xla-cost-model %.4f (>10%%) — audit the FLOPs count"
+                      % (mfu_analytic, mfu_xla), flush=True)
+    return fields
 
 
 def _wrap_iters_per_run(main_prog, loss, steps):
@@ -198,9 +268,10 @@ def child_resnet():
                 rng.randint(0, 10, (batch, 1)).astype("int64")),
         }
         dt = _timed_steps(exe, run_prog, feed, loss, warmup, steps)
+        xla_flops = _xla_flops_per_step(scope, feed)
     ips = batch * steps * iters / dt
     mfu = ips * RESNET50_TRAIN_FLOPS_PER_IMAGE / peak_flops(dev)
-    print(json.dumps({
+    line = {
         "metric": "resnet50_imagenet_train_images_per_sec_per_chip"
                   if on_tpu else "resnet_cifar_smoke_images_per_sec",
         "value": round(ips, 1),
@@ -210,7 +281,10 @@ def child_resnet():
                    " ipr%d" % iters if iters > 1 else "",
                    mfu, getattr(dev, "device_kind", str(dev))),
         "vs_baseline": round(mfu / 0.45, 3),
-    }), flush=True)
+    }
+    line.update(_mfu_fields(mfu, steps * iters / dt, xla_flops,
+                            peak_flops(dev), warn=on_tpu))
+    print(json.dumps(line), flush=True)
 
 
 def child_ctr():
@@ -290,6 +364,9 @@ def child_bert(seq_len=128):
     feed = {k: jnp.asarray(v) for k, v in feed.items()}
 
     dt = _timed_steps(exe, run_prog, feed, loss, warmup, steps)
+    from paddle_tpu.executor import global_scope
+
+    xla_flops = _xla_flops_per_step(global_scope(), feed)
 
     tokens_per_sec = batch * seq_len * steps * iters / dt
     flops_per_token = model_train_flops_per_token(cfg, seq_len)
@@ -302,7 +379,7 @@ def child_bert(seq_len=128):
     else:
         metric = "bert_base_seq%d_mlm_train_tokens_per_sec_per_chip" % seq_len
         bar = 0.40  # long-seq target (VERDICT r2 #3)
-    print(json.dumps({
+    line = {
         "metric": metric,
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec/chip (seq%d bs%d bf16 AMP%s, MFU %.3f on %s)"
@@ -310,7 +387,10 @@ def child_bert(seq_len=128):
                    " ipr%d" % iters if iters > 1 else "",
                    mfu, getattr(dev, "device_kind", str(dev))),
         "vs_baseline": round(mfu / bar, 3),
-    }), flush=True)
+    }
+    line.update(_mfu_fields(mfu, steps * iters / dt, xla_flops,
+                            peak_flops(dev)))
+    print(json.dumps(line), flush=True)
 
 
 # ---------------------------------------------------------------------------

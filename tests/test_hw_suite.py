@@ -1,0 +1,202 @@
+"""Simulated-tunnel-window tests for the hardware capture suite
+(``tools/hw_suite.py``).
+
+The axon tunnel gives ~25-minute windows (round 4); these tests prove —
+without a TPU — that a window where the backend dies mid-suite still
+yields multiple metric artifacts, that the runner resumes at the first
+unmeasured item, and that transient tunnel errors are retried in-window
+instead of zeroing the step.  (Verdict r4, next-round item #3.)
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+import hw_suite  # noqa: E402
+
+PY = sys.executable
+
+
+def _metric_step(name, value, cap=30):
+    code = "import json; print(json.dumps({'metric': %r, 'value': %d}))" % (
+        name, value)
+    return (name, [PY, "-c", code], cap, None)
+
+
+def _hang_step(name, cap=2):
+    """Simulates the backend dying mid-suite: the child blocks forever
+    and must be group-killed at its cap."""
+    return (name, [PY, "-c", "import time; time.sleep(600)"], cap, None)
+
+
+def _artifact(tmp, name):
+    with open(os.path.join(tmp, name + ".txt")) as f:
+        return f.read()
+
+
+def test_short_window_yields_metrics_despite_midsuite_death(tmp_path):
+    """Backend dies at item 3 of 5 (hang → cap kill, probe says down):
+    the window still yields >=3 completed metric artifacts — the verdict
+    bar for a 10-minute window."""
+    out = str(tmp_path)
+    steps = [
+        _metric_step("bench_a", 1),
+        _metric_step("bench_b", 2),
+        _metric_step("bench_c", 3),
+        _hang_step("bench_dead"),
+        _metric_step("bench_e", 5),
+    ]
+    # probe flips to down once the hang step has burned its cap,
+    # mimicking the tunnel dropping mid-suite
+    state = {"up": True}
+
+    def probe():
+        return state["up"], ""
+
+    def runner(argv, cap, extra):
+        rc, out_text = hw_suite.bounded(argv, cap, extra)
+        if "time.sleep" in " ".join(argv):
+            state["up"] = False
+        return rc, out_text
+
+    all_done, ran = hw_suite.run_window(
+        steps, out_dir=out, probe=probe, runner=runner, note=lambda m: None)
+    assert not all_done
+    metrics = []
+    for name in ("bench_a", "bench_b", "bench_c"):
+        assert hw_suite.is_done(name, out)
+        body = _artifact(out, name).splitlines()[1]
+        metrics.append(json.loads(body))
+    assert len(metrics) >= 3
+    # the hang was killed at its cap, not waited out
+    assert not hw_suite.is_done("bench_dead", out)
+    assert "killed after" in _artifact(out, "bench_dead")
+    # the window ended at the dead probe: bench_e never ran
+    assert not os.path.exists(os.path.join(out, "bench_e.txt"))
+
+
+def test_resume_skips_done_items(tmp_path):
+    """Second window re-runs ONLY the unfinished tail — completed
+    artifacts are never re-burned (resume-at-first-unmeasured-item)."""
+    out = str(tmp_path)
+    steps = [
+        _metric_step("bench_a", 1),
+        _hang_step("bench_dead"),
+        _metric_step("bench_c", 3),
+    ]
+    attempts = {}
+    hw_suite.run_window(steps, out_dir=out, runner=hw_suite.bounded,
+                        note=lambda m: None, attempts=attempts)
+    first_mtime = os.path.getmtime(os.path.join(out, "bench_a.txt"))
+
+    ran_names = []
+
+    def counting_runner(argv, cap, extra):
+        ran_names.append(argv)
+        return hw_suite.bounded(argv, cap, extra)
+
+    # "tunnel back up": second window
+    all_done, ran = hw_suite.run_window(
+        steps, out_dir=out, runner=counting_runner, note=lambda m: None,
+        attempts=attempts)
+    assert os.path.getmtime(os.path.join(out, "bench_a.txt")) == first_mtime
+    assert all("bench_a" not in " ".join(a) for a in ran_names)
+    # bench_c completed in one of the windows
+    assert hw_suite.is_done("bench_c", out)
+
+
+def test_transient_failure_retried_in_window(tmp_path):
+    """A step that aborts with a transient tunnel signature is re-run
+    immediately (probe still up) and succeeds — one mid-window
+    remote_compile abort must not zero the line."""
+    out = str(tmp_path)
+    flag = os.path.join(out, "flaked")
+    code = (
+        "import json, os, sys\n"
+        "if not os.path.exists(%r):\n"
+        "    open(%r, 'w').close()\n"
+        "    sys.stderr.write('aborted: response body closed before all "
+        "bytes were read\\n')\n"
+        "    sys.exit(1)\n"
+        "print(json.dumps({'metric': 'flaky', 'value': 7}))\n" % (flag, flag)
+    )
+    steps = [("bench_flaky", [PY, "-c", code], 30, None)]
+    all_done, ran = hw_suite.run_window(
+        steps, out_dir=out, probe=lambda: (True, ""),
+        note=lambda m: None)
+    assert all_done
+    assert hw_suite.is_done("bench_flaky", out)
+
+
+def test_deterministic_failure_not_retried_in_window(tmp_path):
+    """A hard (non-transient) failure must not eat the window in
+    back-to-back reruns."""
+    out = str(tmp_path)
+    runs = []
+
+    def runner(argv, cap, extra):
+        runs.append(1)
+        return 1, "TypeError: deterministic bug"
+
+    steps = [("bench_bug", [PY, "-c", "pass"], 30, None)]
+    all_done, ran = hw_suite.run_window(
+        steps, out_dir=out, probe=lambda: (True, ""), runner=runner,
+        note=lambda m: None)
+    assert len(runs) == 1
+    assert not all_done
+
+
+def test_lifetime_attempt_cap(tmp_path):
+    """Across windows, a transiently-failing step stops after
+    MAX_ATTEMPTS total tries."""
+    out = str(tmp_path)
+    runs = []
+
+    def runner(argv, cap, extra):
+        runs.append(1)
+        return 1, "UNAVAILABLE: tunnel burp"
+
+    steps = [("bench_sad", [PY, "-c", "pass"], 30, None)]
+    attempts = {}
+    for _ in range(4):  # four windows
+        hw_suite.run_window(steps, out_dir=out, probe=lambda: (True, ""),
+                            runner=runner, note=lambda m: None,
+                            attempts=attempts)
+    assert len(runs) == hw_suite.MAX_ATTEMPTS
+
+
+def test_compile_phase_steps_exist():
+    """Every checkpointed bench item exposes a .compile phase before its
+    measure phase, and the flagship comes first after PRNG validation
+    (verdict #1/#2 ordering)."""
+    steps = hw_suite.build_steps()
+    names = [s[0] for s in steps]
+    assert names[0] == "validate_flash_prng"
+    assert names[1] == "bench_bert_default.compile"
+    assert names[2] == "bench_bert_default"
+    assert names[3] == "bench_resnet.compile"
+    assert names[4] == "bench_resnet"
+    for compile_name in [n for n in names if n.endswith(".compile")]:
+        base = compile_name[:-len(".compile")]
+        assert base in names
+        # the compile phase sets the env knob the measure phase relies on
+        idx = names.index(compile_name)
+        env = steps[idx][3]
+        assert env["PADDLE_BENCH_COMPILE_ONLY"] == "1"
+
+
+def test_bench_compile_only_smoke(tmp_path):
+    """End-to-end: a real bench child under PADDLE_BENCH_COMPILE_ONLY=1
+    runs exactly one step and prints the compiled marker (CPU backend)."""
+    rc, out = hw_suite.bounded(
+        [PY, "bench.py", "--child", "ctr"], 240,
+        {"PADDLE_BENCH_COMPILE_ONLY": "1", "PADDLE_BENCH_FORCE_CPU": "1"})
+    assert rc == 0, out[-800:]
+    assert any(
+        json.loads(ln).get("compiled")
+        for ln in out.splitlines() if ln.strip().startswith("{")), out[-800:]

@@ -1,12 +1,14 @@
 """Tunnel watcher: poll the TPU through bounded subprocess probes; the
-moment the chip answers, run the queued hardware suite (each step
-bounded + process-group-killed on timeout) and save outputs under
+moment the chip answers, run the queued hardware suite (``tools/
+hw_suite.py``: compile/measure phase checkpoints, artifact-based
+resume, in-window transient retry) and save outputs under
 ``hw_results/``.
 
 The axon tunnel flaps for hours (rounds 2-4); driver bench runs at
 round end have missed it twice.  This converts any mid-round uptime
-window into captured artifacts: flash-PRNG validation, kernel-vs-XLA
-sweep, fused-Adam A/B, the full bench, and a profile.
+window into captured artifacts: flash-PRNG validation, the flagship
+BERT + ResNet-50 numbers, the knob A/Bs, the flash sweep, and a
+profile.
 
 ``hw_results/`` is DELIBERATELY tracked: the captured outputs are the
 round's hardware evidence — commit them when they appear.
@@ -15,129 +17,41 @@ Run detached:  python tools/hw_when_up.py &
 """
 
 import os
-import signal
-import subprocess
 import sys
 import time
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-OUT = os.path.join(REPO, "hw_results")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import hw_suite  # noqa: E402
+
 POLL_S = 240
-MAX_WATCH_S = 7 * 3600
-
-STEPS = [
-    # (name, argv, timeout_s, extra_env) — ordered by evidence value for
-    # a SHORT tunnel window (the r04 window lasted ~25 min): the
-    # never-captured resnet number first, then the flagship with the
-    # r04 fixes (unfused adam + bf16 fallback + gathered MLM head),
-    # then the dispatch-latency ipr25 A/B, then confirmations.
-    ("validate_flash_prng",
-     [sys.executable, "tools/validate_flash_prng.py"], 420, None),
-    ("bench_resnet",
-     [sys.executable, "bench.py", "--child", "resnet"], 480, None),
-    ("bench_bert_default",
-     [sys.executable, "bench.py", "--child", "bert"], 480, None),
-    # flash kernel at the flagship's T=128 with IN-KERNEL dropout (the
-    # hardware-validated path): if this beats bench_bert_default, the
-    # MIN_T default drops to 128 for dropout graphs — the direct route
-    # past the 0.45 MFU gate (dropout cost ~8% MFU per the r02 sweep)
-    ("bench_bert_flash128",
-     [sys.executable, "bench.py", "--child", "bert"], 480,
-     {"PADDLE_TPU_FLASH_MIN_T": "128"}),
-    # K-steps-per-dispatch A/B: if wall step time is dispatch-bound
-    # (tunnel roundtrips), ipr25 amortizes 25x and the gap to the
-    # profile's device time closes
-    ("bench_bert_ipr25",
-     [sys.executable, "bench.py", "--child", "bert"], 480,
-     {"PADDLE_BENCH_ITERS_PER_RUN": "25"}),
-    ("bench_fused_adam_on",
-     [sys.executable, "bench.py", "--child", "bert"], 480,
-     {"PADDLE_TPU_FUSE_ADAM": "1"}),
-    ("bench_profile",
-     [sys.executable, "tools/bench_profile.py"], 700, None),
-    ("bench_flash_sweep",
-     [sys.executable, "tools/bench_flash.py"], 900, None),
-    ("bench_full", [sys.executable, "bench.py"], 1500, None),
-    # backend-flag op rerun (unittests/mkldnn pattern): the OpTest corpus
-    # forwards on real silicon with bf16-tolerant bounds.  Only files
-    # that define OpTest subclasses belong here — the conftest hook
-    # skips every non-OpTest item under PADDLE_TPU_TESTS_ON_TPU=1.
-    ("optest_on_tpu",
-     [sys.executable, "-m", "pytest", "tests/test_ops_math.py",
-      "tests/test_detection.py", "tests/test_nn_call_parity.py",
-      "tests/test_quantization.py", "tests/test_flash_attention.py",
-      "-q", "-p", "no:cacheprovider"], 1500,
-     {"PADDLE_TPU_TESTS_ON_TPU": "1"}),
-]
+MAX_WATCH_S = 11 * 3600
 
 
-def _bounded(argv, timeout_s, extra_env=None):
-    """Run argv in its own session; SIGKILL the whole group on timeout
-    (TPU plugin helpers inherit the stdout pipe — killing only the child
-    leaves communicate() blocked; the round-2 hang)."""
-    env = dict(os.environ)
-    if extra_env:
-        env.update(extra_env)
-    proc = subprocess.Popen(
-        argv, cwd=REPO, env=env, stdout=subprocess.PIPE,
-        stderr=subprocess.STDOUT, text=True, start_new_session=True)
-    try:
-        out, _ = proc.communicate(timeout=timeout_s)
-        return proc.returncode, out or ""
-    except subprocess.TimeoutExpired:
-        try:
-            os.killpg(proc.pid, signal.SIGKILL)
-        except (ProcessLookupError, PermissionError):
-            pass
-        try:
-            out, _ = proc.communicate(timeout=15)
-        except Exception:  # noqa: BLE001
-            out = ""
-        return -9, (out or "") + "\n[watcher] killed after %ds" % timeout_s
-
-
-def probe():
-    rc, out = _bounded(
-        [sys.executable, "-c",
-         "import jax; d = jax.devices(); print(d); "
-         "assert any('cpu' not in str(x).lower() for x in d)"], 100)
-    return rc == 0, out
+probe = hw_suite.probe
 
 
 def main():
-    os.makedirs(OUT, exist_ok=True)
-    log = open(os.path.join(OUT, "watcher.log"), "a", buffering=1)
+    os.makedirs(hw_suite.OUT, exist_ok=True)
+    log = open(os.path.join(hw_suite.OUT, "watcher.log"), "a", buffering=1)
 
     def note(msg):
         line = "%s %s" % (time.strftime("%H:%M:%S"), msg)
         print(line, flush=True)
         log.write(line + "\n")
 
-    def done(name):
-        """A step is done iff its artifact records a clean run — lets the
-        watcher resume across tunnel flaps without re-burning caps."""
-        path = os.path.join(OUT, name + ".txt")
-        try:
-            with open(path) as f:
-                return f.readline().startswith("[watcher] rc=0")
-        except OSError:
-            return False
-
-    # a deterministically-failing step must not eat the whole watch
-    # window in back-to-back reruns; 3 shots each, then give up on it
-    attempts = {}
-    MAX_ATTEMPTS = 3
-
+    steps = hw_suite.build_steps()
+    attempts = {}  # lifetime step attempts, shared across windows
     t_start = time.time()
-    note("watcher start")
+    note("watcher start (%d steps)" % len(steps))
     while time.time() - t_start < MAX_WATCH_S:
-        todo = [s for s in STEPS if not done(s[0])
-                and attempts.get(s[0], 0) < MAX_ATTEMPTS]
+        todo = [s for s in steps if not hw_suite.is_done(s[0])
+                and attempts.get(s[0], 0) < hw_suite.MAX_ATTEMPTS]
         if not todo:
-            undone = [s[0] for s in STEPS if not done(s[0])]
+            undone = [s[0] for s in steps if not hw_suite.is_done(s[0])]
             if undone:
                 note("gave up on %s after %d attempts each"
-                     % (undone, MAX_ATTEMPTS))
+                     % (undone, hw_suite.MAX_ATTEMPTS))
                 return 1
             note("suite complete")
             return 0
@@ -148,25 +62,13 @@ def main():
             continue
         note("TUNNEL UP (%d steps left): %s"
              % (len(todo), out.strip()[-120:]))
-        for name, argv, cap, extra in todo:
-            note("running %s (cap %ds)" % (name, cap))
-            attempts[name] = attempts.get(name, 0) + 1
-            t0 = time.time()
-            rc, out = _bounded(argv, cap, extra)
-            path = os.path.join(OUT, name + ".txt")
-            with open(path, "w") as f:
-                f.write("[watcher] rc=%s\n%s" % (rc, out))
-            note("%s done rc=%s in %.0fs -> %s"
-                 % (name, rc, time.time() - t0, path))
-            # if the tunnel died mid-suite, go back to waiting — the
-            # flap windows are hours long; completed steps stay done
-            if rc != 0:
-                ok, _ = probe()
-                if not ok:
-                    note("tunnel lost after %s; back to waiting" % name)
-                    break
+        all_done, ran = hw_suite.run_window(
+            steps, probe=probe, note=note, attempts=attempts)
+        if all_done:
+            note("suite complete")
+            return 0
     note("watch window exhausted")
-    return 0 if not [s for s in STEPS if not done(s[0])] else 1
+    return 0 if all(hw_suite.is_done(s[0]) for s in steps) else 1
 
 
 if __name__ == "__main__":
