@@ -9,7 +9,7 @@ import argparse
 import sys
 import time
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+import _common  # noqa: E402 - repo-root path + bounded backend probe
 
 import numpy as np
 
@@ -33,10 +33,7 @@ def main():
     ap.add_argument("--seq", type=int, default=128)
     args = ap.parse_args()
 
-    if args.cpu:
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+    backend = _common.pick_backend(force_cpu=args.cpu)
     import jax
 
     import paddle_tpu as fluid
@@ -49,7 +46,7 @@ def main():
         cfg = copy.copy(cfg)
         cfg.recompute = True
     main_prog, startup, feeds, loss = bert.build_pretrain(
-        cfg, seq_len=args.seq, lr=1e-4, amp=not args.cpu, train=True)
+        cfg, seq_len=args.seq, lr=1e-4, amp=backend == "tpu", train=True)
 
     run_prog = main_prog
     if args.dp > 1 or args.zero1 or args.ipr > 1:

@@ -11,7 +11,7 @@ import os
 import sys
 import tempfile
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+import _common  # noqa: E402 - repo-root path + bounded backend probe
 
 import numpy as np
 
@@ -39,10 +39,7 @@ def main():
     ap.add_argument("--slots", type=int, default=4)
     args = ap.parse_args()
 
-    if args.cpu:
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+    backend = _common.pick_backend(force_cpu=args.cpu)
 
     import paddle_tpu as fluid
     from paddle_tpu.models import ctr

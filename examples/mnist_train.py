@@ -7,7 +7,7 @@
 import argparse
 import sys
 
-sys.path.insert(0, __file__.rsplit("/", 2)[0])
+import _common  # noqa: E402 - repo-root path + bounded backend probe
 
 import numpy as np
 
@@ -20,10 +20,7 @@ def main():
     ap.add_argument("--batch", type=int, default=64)
     args = ap.parse_args()
 
-    if args.cpu:
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+    backend = _common.pick_backend(force_cpu=args.cpu)
 
     import paddle_tpu as fluid
     from paddle_tpu import datasets
