@@ -1,9 +1,33 @@
-"""slim Compressor (reference: ``contrib/slim/core/compressor.py:229``
-— the strategy-driven compression driver: reads a YAML config naming
-quantization/pruning/distillation strategies and runs epochs applying
-them around a train/eval graph)."""
+"""slim core: the Compressor driver + Strategy base (reference:
+``contrib/slim/core/compressor.py:229`` and ``core/strategy.py`` — the
+strategy-driven compression loop: strategies hook compression/epoch
+boundaries, rewrite the training graph, and the compressor runs the
+epochs around them)."""
 
-__all__ = ["Compressor"]
+__all__ = ["Compressor", "Strategy"]
+
+
+class Strategy:
+    """reference ``core/strategy.py:Strategy``: hook points around the
+    compression run and each epoch.  ``start_epoch``/``end_epoch``
+    bound when a subclass acts (reference semantics: act on epoch
+    boundaries within [start_epoch, end_epoch])."""
+
+    def __init__(self, start_epoch=0, end_epoch=0):
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+
+    def on_compression_begin(self, context):
+        pass
+
+    def on_epoch_begin(self, context):
+        pass
+
+    def on_epoch_end(self, context):
+        pass
+
+    def on_compression_end(self, context):
+        pass
 
 
 class Compressor:
@@ -12,10 +36,11 @@ class Compressor:
                  eval_program=None, eval_reader=None, eval_feed_list=None,
                  eval_fetch_list=None, teacher_programs=None,
                  checkpoint_path="./checkpoints", train_optimizer=None,
-                 distiller_optimizer=None):
+                 distiller_optimizer=None, startup_program=None):
         self.place = place
         self.scope = scope
         self.train_program = train_program
+        self.startup_program = startup_program
         self.train_reader = train_reader
         self.train_feed_list = train_feed_list
         self.train_fetch_list = train_fetch_list
@@ -25,6 +50,7 @@ class Compressor:
         self.eval_fetch_list = eval_fetch_list
         self.checkpoint_path = checkpoint_path
         self.train_optimizer = train_optimizer
+        self.distiller_optimizer = distiller_optimizer
         self.epoch = 1
         self.strategies = []
 
@@ -45,6 +71,30 @@ class Compressor:
         self.strategies = cp.get("strategies", []) or []
         return self
 
+    def _maybe_minimize(self, context):
+        """Build the optimizer into the (possibly strategy-rewritten)
+        forward program — the reference compressor's _init_model role.
+        Runs AFTER on_compression_begin so graph-rewriting strategies
+        (QAT insertion) see the forward graph, exactly like the
+        reference's graph-then-compile ordering.  No-op when the program
+        already carries grad ops (caller pre-minimized)."""
+        if self.train_optimizer is None or not self.train_fetch_list:
+            return
+        prog = context["program"]
+        if any(op.type.endswith("_grad") for op in prog.global_block().ops):
+            return
+        from ...framework import Program, program_guard
+
+        loss_name = self.train_fetch_list[0]
+        loss_name = getattr(loss_name, "name", loss_name)
+        loss = prog.global_block().var(loss_name)
+        startup = context.get("startup_program")
+        if startup is None:
+            startup = Program()
+            context["startup_program"] = startup
+        with program_guard(prog, startup):
+            self.train_optimizer.minimize(loss)
+
     def run(self):
         """Run the configured epochs, invoking each strategy's hooks
         around the training loop (the compressor's driver role; the
@@ -59,7 +109,23 @@ class Compressor:
             feeder = DataFeeder(self.train_feed_list,
                                 program=self.train_program)
         context = {"exe": exe, "program": self.train_program,
-                   "scope": self.scope, "epoch": 0}
+                   "eval_program": self.eval_program,
+                   "scope": self.scope, "epoch": 0,
+                   "place": self.place,
+                   "startup_program": self.startup_program,
+                   "train_fetch_list": self.train_fetch_list,
+                   "distiller_optimizer": self.distiller_optimizer,
+                   "checkpoint_path": self.checkpoint_path}
+        for s in self.strategies:
+            if hasattr(s, "on_compression_begin"):
+                s.on_compression_begin(context)
+        self._maybe_minimize(context)
+        # init AFTER strategies + minimize so strategy-added state
+        # (quant scales) and optimizer accumulators exist (the reference
+        # compressor's own init ordering); callers who pre-initialize or
+        # load a checkpoint simply don't pass startup_program
+        if context.get("startup_program") is not None:
+            exe.run(context["startup_program"], scope=self.scope)
         for epoch in range(self.epoch):
             context["epoch"] = epoch
             for s in self.strategies:
@@ -78,10 +144,14 @@ class Compressor:
                             "Compressor needs train_feed_list to convert "
                             "sample batches (or a reader yielding feed "
                             "dicts)")
-                    exe.run(self.train_program, feed=feed,
-                            fetch_list=self.train_fetch_list or [],
+                    exe.run(context["program"], feed=feed,
+                            fetch_list=context.get("train_fetch_list")
+                            or [],
                             scope=self.scope)
             for s in self.strategies:
                 if hasattr(s, "on_epoch_end"):
                     s.on_epoch_end(context)
+        for s in self.strategies:
+            if hasattr(s, "on_compression_end"):
+                s.on_compression_end(context)
         return context

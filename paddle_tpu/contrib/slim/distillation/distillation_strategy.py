@@ -1,0 +1,67 @@
+"""DistillationStrategy for the slim Compressor (reference
+``contrib/slim/distillation/distillation_strategy.py``: between
+``start_epoch`` and ``end_epoch`` the compressor trains the DISTILL
+graph — student+teacher merged, distiller losses appended — then
+returns to the plain student graph).
+
+TPU note: the reference merges separate teacher/student programs and
+compiles the merged graph here; on this framework teacher and student
+are built in ONE program (teacher branch under stop_gradient — see
+``distillation/__init__.py``), so the strategy's job reduces to swapping
+which program the compressor steps."""
+
+from ..core import Strategy
+
+__all__ = ["DistillationStrategy"]
+
+
+class DistillationStrategy(Strategy):
+    def __init__(self, distillers=None, start_epoch=0, end_epoch=0,
+                 distill_program=None, distill_fetch_list=None):
+        super().__init__(start_epoch, end_epoch)
+        self.distillers = distillers or []
+        self.distill_program = distill_program
+        self.distill_fetch_list = distill_fetch_list
+        self._saved = None
+
+    def on_epoch_begin(self, context):
+        if context["epoch"] == self.start_epoch \
+                and self.distill_program is not None:
+            self._saved = (context["program"],
+                           context.get("train_fetch_list"))
+            self._ensure_optimized(context)
+            context["program"] = self.distill_program
+            if self.distill_fetch_list is not None:
+                context["train_fetch_list"] = self.distill_fetch_list
+
+    def _ensure_optimized(self, context):
+        """Build the distiller optimizer into the distill program on
+        first entry (the reference strategy compiles the distill graph
+        with ``distiller_optimizer`` the same way) — otherwise the
+        distillation epochs would be forward-only no-ops."""
+        prog = self.distill_program
+        if any(op.type.endswith("_grad")
+               for op in prog.global_block().ops):
+            return
+        opt = context.get("distiller_optimizer")
+        fetch = self.distill_fetch_list or []
+        if opt is None or not fetch:
+            raise ValueError(
+                "DistillationStrategy needs the Compressor's "
+                "distiller_optimizer and a distill_fetch_list whose "
+                "first entry is the distillation loss (the distill "
+                "program carries no optimizer ops)")
+        from paddle_tpu.framework import Program, program_guard
+
+        loss_name = getattr(fetch[0], "name", fetch[0])
+        startup = Program()
+        with program_guard(prog, startup):
+            opt.minimize(prog.global_block().var(loss_name))
+        context["exe"].run(startup, scope=context["scope"])
+
+    def on_epoch_end(self, context):
+        if context["epoch"] == self.end_epoch and self._saved is not None:
+            context["program"], fetch = self._saved
+            if fetch is not None:
+                context["train_fetch_list"] = fetch
+            self._saved = None

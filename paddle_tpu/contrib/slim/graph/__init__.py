@@ -1,0 +1,206 @@
+"""Graph wrappers (reference ``contrib/slim/graph/graph_wrapper.py``:
+``VarWrapper``/``OpWrapper``/``GraphWrapper`` — the uniform view every
+slim strategy uses to walk a Program, find producer/consumer ops, pull
+parameters, and cost the model in FLOPs/params).
+
+TPU note: the reference wraps ``IrGraph`` over the C++ graph; here the
+same API wraps ``Program`` directly — the Program IS the graph (SSA
+versioning is the executor's concern), so wrappers stay thin views and
+every mutation routes through the normal Block APIs.
+"""
+
+import numpy as np
+
+__all__ = ["VarWrapper", "OpWrapper", "GraphWrapper"]
+
+
+class VarWrapper:
+    """reference graph_wrapper.py:VarWrapper."""
+
+    def __init__(self, var, graph):
+        self._var = var
+        self._graph = graph
+
+    def name(self):
+        return self._var.name
+
+    def shape(self):
+        return list(self._var.shape or ())
+
+    def set_shape(self, shape):
+        self._var.shape = tuple(shape)
+
+    def is_parameter(self):
+        return (type(self._var).__name__ == "Parameter"
+                or getattr(self._var, "persistable", False))
+
+    def inputs(self):
+        """Ops that produce this var."""
+        return [op for op in self._graph.ops()
+                if self.name() in op.all_output_names()]
+
+    def outputs(self):
+        """Ops that consume this var."""
+        return [op for op in self._graph.ops()
+                if self.name() in op.all_input_names()]
+
+    def __eq__(self, other):
+        return isinstance(other, VarWrapper) and \
+            self._var.name == other._var.name
+
+    def __hash__(self):
+        return hash(self._var.name)
+
+    def __repr__(self):
+        return "VarWrapper(%s%s)" % (self.name(), self.shape())
+
+
+class OpWrapper:
+    """reference graph_wrapper.py:OpWrapper."""
+
+    def __init__(self, op, graph):
+        self._op = op
+        self._graph = graph
+
+    def type(self):
+        return self._op.type
+
+    def idx(self):
+        return self._graph._block.ops.index(self._op)
+
+    def all_input_names(self):
+        return [n for ns in self._op.inputs.values() for n in ns if n]
+
+    def all_output_names(self):
+        return [n for ns in self._op.outputs.values() for n in ns if n]
+
+    def all_inputs(self):
+        return [self._graph.var(n) for n in self.all_input_names()
+                if self._graph.has_var(n)]
+
+    def all_outputs(self):
+        return [self._graph.var(n) for n in self.all_output_names()
+                if self._graph.has_var(n)]
+
+    def inputs(self, name):
+        """Vars bound to input slot `name`."""
+        return [self._graph.var(n) for n in self._op.inputs.get(name, [])
+                if n and self._graph.has_var(n)]
+
+    def outputs(self, name):
+        return [self._graph.var(n) for n in self._op.outputs.get(name, [])
+                if n and self._graph.has_var(n)]
+
+    def attr(self, name):
+        return self._op.attrs.get(name)
+
+    def set_attr(self, name, value):
+        self._op.attrs[name] = value
+
+    def __eq__(self, other):
+        return isinstance(other, OpWrapper) and self._op is other._op
+
+    def __hash__(self):
+        return id(self._op)
+
+    def __repr__(self):
+        return "OpWrapper(%s)" % self.type()
+
+
+class GraphWrapper:
+    """reference graph_wrapper.py:GraphWrapper — Program-level view with
+    producer/consumer walks and model costing."""
+
+    def __init__(self, program, in_nodes=None, out_nodes=None):
+        self.program = program
+        self._block = program.global_block()
+        self.in_nodes = dict(in_nodes or {})
+        self.out_nodes = dict(out_nodes or {})
+
+    # -- structure ----------------------------------------------------
+
+    def all_parameters(self):
+        return [VarWrapper(v, self) for v in self._block.vars.values()
+                if type(v).__name__ == "Parameter"
+                or getattr(v, "persistable", False)]
+
+    def is_parameter(self, var):
+        return var.is_parameter()
+
+    def ops(self):
+        return [OpWrapper(op, self) for op in self._block.ops]
+
+    def vars(self):
+        return [VarWrapper(v, self) for v in self._block.vars.values()]
+
+    def var(self, name):
+        return VarWrapper(self._block._find_var_recursive(name), self)
+
+    def has_var(self, name):
+        return self._block._find_var_recursive(name) is not None
+
+    def pre_ops(self, op):
+        """Ops producing any input of `op` (reference pre_ops)."""
+        ins = set(op.all_input_names())
+        return [o for o in self.ops()
+                if ins & set(o.all_output_names())]
+
+    def next_ops(self, op):
+        """Ops consuming any output of `op` (reference next_ops)."""
+        outs = set(op.all_output_names())
+        return [o for o in self.ops()
+                if outs & set(o.all_input_names())]
+
+    def get_param_by_op(self, op):
+        """Parameters read by `op` (reference get_param_by_op)."""
+        return [v for v in op.all_inputs() if v.is_parameter()]
+
+    def clone(self, for_test=False):
+        return GraphWrapper(self.program.clone(for_test=for_test),
+                            self.in_nodes, self.out_nodes)
+
+    # -- costing (reference graph_wrapper.py flops/numel_params) ------
+
+    def numel_params(self):
+        return int(sum(
+            np.prod([d for d in p.shape() if d > 0]) or 0
+            for p in self.all_parameters()))
+
+    def flops(self):
+        """Static FLOPs of the forward ops (reference flops(): conv,
+        mul/matmul, pool, elementwise, relu counted; 2*MACs for the
+        matmul-class ops)."""
+        total = 0
+        for op in self.ops():
+            t = op.type()
+            if t in ("conv2d", "depthwise_conv2d"):
+                out = op.outputs("Output")
+                flt = op.inputs("Filter")
+                if not out or not flt:
+                    continue
+                oshape = out[0].shape()
+                fshape = flt[0].shape()
+                if len(oshape) < 4 or len(fshape) < 4:
+                    continue
+                groups = int(op.attr("groups") or 1)
+                # 2 * H_out*W_out * Cout * (Cin/g * kh * kw) per image
+                total += int(2 * oshape[2] * oshape[3] * fshape[0]
+                             * (fshape[1] * fshape[2] * fshape[3]))
+                if op.inputs("Bias"):
+                    total += int(np.prod(oshape[1:]))
+            elif t in ("mul", "matmul"):
+                x = op.inputs("X")
+                y = op.inputs("Y")
+                if not x or not y:
+                    continue
+                xs, ys = x[0].shape(), y[0].shape()
+                if len(xs) >= 2 and len(ys) >= 2:
+                    m = int(np.prod([d for d in xs[:-1] if d > 0]) or 1)
+                    total += 2 * m * xs[-1] * ys[-1]
+            elif t in ("relu", "sigmoid", "tanh", "elementwise_add",
+                       "elementwise_mul", "batch_norm", "pool2d"):
+                out = op.all_outputs()
+                if out:
+                    total += int(np.prod(
+                        [d for d in out[0].shape() if d > 0]) or 0)
+        return int(total)

@@ -1,0 +1,126 @@
+"""Pruning strategies for the slim Compressor (reference
+``contrib/slim/prune/prune_strategy.py``: ``UniformPruneStrategy`` and
+``SensitivePruneStrategy`` — pick per-parameter ratios, prune through
+the Pruner, report the FLOPs/params saved via the GraphWrapper)."""
+
+import fnmatch
+
+import numpy as np
+
+from ..core import Strategy
+from ..graph import GraphWrapper
+from . import StructurePruner, sensitivity_analysis
+
+__all__ = ["UniformPruneStrategy", "SensitivePruneStrategy"]
+
+
+def _match_params(graph, patterns):
+    names = []
+    for p in graph.all_parameters():
+        if any(fnmatch.fnmatch(p.name(), pat) for pat in patterns):
+            names.append(p.name())
+    return names
+
+
+class UniformPruneStrategy(Strategy):
+    """Prune every matched parameter at the same ratio at
+    ``start_epoch`` (reference prune_strategy.py:UniformPruneStrategy).
+
+    Lazy (mask-zero) pruning keeps shapes static so the already-compiled
+    program keeps serving — the TPU translation of the reference's
+    in-place shape shrink, which XLA would treat as a recompile anyway.
+    The structural shrink happens at export via ``Pruner.prune_tensor``.
+    """
+
+    def __init__(self, pruner=None, start_epoch=0, end_epoch=0,
+                 target_ratio=0.5, pruned_params="*.w_0", metric_name=None):
+        super().__init__(start_epoch, end_epoch)
+        self.pruner = pruner or StructurePruner()
+        self.target_ratio = float(target_ratio)
+        self.pruned_params = pruned_params
+        self.pruned_idx = {}
+
+    def on_epoch_begin(self, context):
+        if context["epoch"] != self.start_epoch:
+            return
+        graph = GraphWrapper(context["program"])
+        scope = context["scope"]
+        before = graph.numel_params()
+        for name in _match_params(graph, [self.pruned_params]):
+            self.pruned_idx[name] = self.pruner.prune_scope(
+                scope, name, self.target_ratio, lazy=True)
+        context["pruned_params"] = dict(self.pruned_idx)
+        context["params_before_prune"] = before
+
+
+class SensitivePruneStrategy(Strategy):
+    """Sensitivity-guided pruning (reference
+    prune_strategy.py:SensitivePruneStrategy): measure each parameter's
+    loss sensitivity, then assign LOWER ratios to sensitive parameters
+    and higher to insensitive ones until the mean ratio hits
+    ``target_ratio``, pruning at ``start_epoch``."""
+
+    def __init__(self, pruner=None, start_epoch=0, end_epoch=0,
+                 delta_rate=0.2, target_ratio=0.5,
+                 pruned_params="*.w_0", sensitivities_file=None,
+                 eval_batch=None, loss_name=None):
+        super().__init__(start_epoch, end_epoch)
+        self.pruner = pruner or StructurePruner()
+        self.delta_rate = float(delta_rate)
+        self.target_ratio = float(target_ratio)
+        self.pruned_params = pruned_params
+        self.sensitivities_file = sensitivities_file
+        self.eval_batch = eval_batch
+        self.loss_name = loss_name
+        self.sensitivities = {}
+        self.ratios = {}
+
+    def _compute_ratios(self, sens):
+        """Invert sensitivity into per-param ratios whose mean equals
+        target_ratio: ratio_i ∝ 1/(1+loss_delta_i) (the reference's
+        greedy variant normalized in one shot)."""
+        deltas = {}
+        for name, by_ratio in sens.items():
+            base = by_ratio.get(0.0)
+            probe = max(r for r in by_ratio if r > 0)
+            deltas[name] = max(by_ratio[probe] - base, 0.0)
+        inv = {n: 1.0 / (1.0 + d) for n, d in deltas.items()}
+        mean_inv = sum(inv.values()) / len(inv)
+        return {n: min(0.9, self.target_ratio * v / mean_inv)
+                for n, v in inv.items()}
+
+    def on_epoch_begin(self, context):
+        if context["epoch"] != self.start_epoch:
+            return
+        graph = GraphWrapper(context["program"])
+        scope = context["scope"]
+        names = _match_params(graph, [self.pruned_params])
+        if self.eval_batch is None or self.loss_name is None:
+            raise ValueError(
+                "SensitivePruneStrategy needs eval_batch (a feed dict) "
+                "and loss_name to measure sensitivities")
+        self.sensitivities = sensitivity_analysis(
+            context["exe"], context.get("eval_program")
+            or context["program"], self.eval_batch, self.loss_name,
+            scope, names, ratios=(self.delta_rate,), lazy=True)
+        if self.sensitivities_file:
+            import json
+
+            with open(self.sensitivities_file, "w") as f:
+                json.dump(self.sensitivities, f, default=float)
+        self.ratios = self._compute_ratios(self.sensitivities)
+        for name, ratio in self.ratios.items():
+            self.pruner.prune_scope(scope, name, ratio, lazy=True)
+        context["pruned_ratios"] = dict(self.ratios)
+
+    def on_epoch_end(self, context):
+        if context["epoch"] != self.end_epoch:
+            return
+        # report sparsity actually achieved (reference logs the same)
+        scope = context["scope"]
+        zeros = total = 0
+        for name in self.ratios:
+            w = np.asarray(scope.get(name))
+            zeros += int((w == 0).sum())
+            total += w.size
+        context["achieved_sparsity"] = zeros / max(total, 1)

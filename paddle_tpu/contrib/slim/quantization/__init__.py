@@ -1,8 +1,11 @@
 from .quantization_pass import (  # noqa: F401
+    QuantizationFreezePass,
     QuantizationTranspiler,
     TransformForTraining,
     QUANTIZABLE_OP_TYPES,
 )
+from .post_training import PostTrainingQuantization  # noqa: F401
 
-__all__ = ["QuantizationTranspiler", "TransformForTraining",
-           "QUANTIZABLE_OP_TYPES"]
+__all__ = ["QuantizationFreezePass", "QuantizationTranspiler",
+           "TransformForTraining", "QUANTIZABLE_OP_TYPES",
+           "PostTrainingQuantization"]

@@ -1,0 +1,204 @@
+"""Post-training quantization with a calibration dataset.
+
+Reference: ``paddle/fluid/inference/api/mkldnn_quantizer.cc`` — run the
+fp32 model over calibration batches, gather per-tensor maxima, compute
+scales (max / average of per-batch maxima), then rewrite the graph for
+int8 execution.  TPU-native translation:
+
+* calibration fetches every quantizable-op activation input through the
+  normal Executor (one jit per calibration signature, cached);
+* weights convert to int8 STORAGE + ``fake_dequantize_max_abs`` ops
+  (via :class:`QuantizationFreezePass` — 4x smaller persistables, the
+  dequant multiply fused into the consumer by XLA);
+* activations get ``quantize_dequantize_fixed_scale`` ops carrying the
+  calibrated scale, so the exported model's numerics include the
+  quantization error an int8 deploy would see, and consumers carry the
+  recorded ``Input_scale`` attr an int8 engine reads.
+"""
+
+import numpy as np
+
+from .quantization_pass import (
+    _QUANT_SLOTS,
+    QuantizationFreezePass,
+    TransformForTraining,
+)
+
+__all__ = ["PostTrainingQuantization"]
+
+
+class PostTrainingQuantization:
+    """Calibrate-and-quantize an inference program.
+
+    Parameters mirror the reference API shape: an executor, the program
+    (or a model dir to load), its feed names and fetch targets, the
+    scale algorithm (``abs_max`` = global max over batches, ``avg`` =
+    mean of per-batch maxima) and an optional batch cap.
+    """
+
+    def __init__(self, executor, program=None, feed_names=None,
+                 fetch_targets=None, model_dir=None, scope=None,
+                 algo="abs_max", weight_bits=8, activation_bits=8,
+                 batch_nums=None):
+        if algo not in ("abs_max", "avg"):
+            raise ValueError("algo must be abs_max or avg, got %r" % algo)
+        self._exe = executor
+        self._algo = algo
+        self.weight_bits = int(weight_bits)
+        self.activation_bits = int(activation_bits)
+        self._batch_nums = batch_nums
+        if scope is None:
+            from paddle_tpu.executor import global_scope
+
+            scope = global_scope()
+        self._scope = scope
+        if program is None:
+            if model_dir is None:
+                raise ValueError("pass program+feed_names or model_dir")
+            from paddle_tpu import io as fluid_io
+
+            program, feed_names, fetch_targets = \
+                fluid_io.load_inference_model(model_dir, executor)
+        self._program = program
+        self._feed_names = list(feed_names or [])
+        self._fetch_targets = list(fetch_targets or [])
+
+    # -- calibration --------------------------------------------------
+
+    def _activation_targets(self):
+        """(op_index, slot, var_name) for every non-persistable input of
+        a quantizable op — the tensors whose dynamic range calibration
+        must observe."""
+        block = self._program.global_block()
+        targets = []
+        for idx, op in enumerate(block.ops):
+            slots = _QUANT_SLOTS.get(op.type)
+            if not slots or op.attrs.get("__quant_skip__"):
+                continue
+            for slot in slots:
+                names = op.inputs.get(slot)
+                if not names:
+                    continue
+                var = block._find_var_recursive(names[0])
+                if var is None or getattr(var, "persistable", False) or \
+                        type(var).__name__ == "Parameter":
+                    continue
+                targets.append((idx, slot, names[0]))
+        return targets
+
+    def quantize(self, data_reader):
+        """Run calibration batches from ``data_reader`` (an iterable of
+        feed dicts), compute activation scales, rewrite the program.
+        Returns the quantized program."""
+        targets = self._activation_targets()
+        names = sorted({n for _, _, n in targets})
+        maxima = {n: [] for n in names}
+        n_batches = 0
+        # calibration feeds carry only the model INPUTS — prune the
+        # program to the observed tensors so label-consuming metric ops
+        # (accuracy/loss in a test program) don't demand feeds
+        calib_prog = self._program._prune(
+            [n for n in self._feed_names], names)
+        for feed in data_reader:
+            outs = self._exe.run(calib_prog, feed=feed,
+                                 fetch_list=names)
+            for n, v in zip(names, outs):
+                maxima[n].append(float(np.max(np.abs(np.asarray(v)))))
+            n_batches += 1
+            if self._batch_nums and n_batches >= self._batch_nums:
+                break
+        if not n_batches:
+            raise ValueError("calibration reader yielded no batches")
+        reduce = max if self._algo == "abs_max" else \
+            (lambda xs: sum(xs) / len(xs))
+        scales = {n: max(reduce(v), 1e-8) for n, v in maxima.items()}
+        self._rewrite(targets, scales)
+        return self._program
+
+    # -- rewrite ------------------------------------------------------
+
+    def _rewrite(self, targets, scales):
+        import jax.numpy as jnp
+
+        program, scope = self._program, self._scope
+        block = program.global_block()
+
+        # 1. weights → int8 storage + dequant: insert dynamic fake-qdq
+        #    on weight slots, then freeze them (reads trained values)
+        TransformForTraining(
+            weight_bits=self.weight_bits,
+            activation_bits=self.activation_bits,
+            activation_quantize_type="abs_max").apply(program)
+        # drop the activation fake-qdq ops that transform just added —
+        # PTQ uses the calibrated FIXED scales instead
+        i = 0
+        while i < len(block.ops):
+            op = block.ops[i]
+            if op.type.startswith("fake_quantize_dequantize"):
+                x_name = op.inputs["X"][0]
+                xvar = block._find_var_recursive(x_name)
+                if not (xvar is not None
+                        and (getattr(xvar, "persistable", False)
+                             or type(xvar).__name__ == "Parameter")):
+                    out_name = op.outputs["Out"][0]
+                    block._remove_op(i)
+                    for later in block.ops[i:]:
+                        for slot, ns in later.inputs.items():
+                            if out_name in ns:
+                                later.inputs[slot] = [
+                                    x_name if n == out_name else n
+                                    for n in ns]
+                    continue
+            i += 1
+        QuantizationFreezePass(
+            scope=scope, weight_bits=self.weight_bits,
+            activation_bits=self.activation_bits).apply(
+                program, weights_only=True)
+
+        # 2. activations → fixed-scale QDQ with the calibrated scale
+        done = {}
+        for _, _, name in targets:
+            if name in done:
+                continue
+            scale_name = name + ".calib_scale"
+            sv = block.create_var(name=scale_name, shape=(1,),
+                                  dtype="float32", persistable=True)
+            sv.stop_gradient = True
+            scope.set(scale_name,
+                      jnp.asarray([scales[name]], dtype=jnp.float32))
+            out_name = name + ".calib_qdq"
+            block.create_var(name=out_name, shape=None, dtype="float32")
+            # insert immediately before the first consumer
+            pos = next(i for i, op in enumerate(block.ops)
+                       if any(name in ns for ns in op.inputs.values()))
+            block._insert_op(
+                pos,
+                type="quantize_dequantize_fixed_scale",
+                inputs={"X": [name], "InScale": [scale_name]},
+                outputs={"Out": [out_name]},
+                attrs={"bit_length": self.activation_bits},
+            )
+            done[name] = out_name
+        # rewire every quantizable consumer and stamp the record attrs
+        for op in block.ops:
+            slots = _QUANT_SLOTS.get(op.type)
+            if not slots or op.attrs.get("__quant_skip__"):
+                continue
+            for slot in slots:
+                ns = op.inputs.get(slot)
+                if ns and ns[0] in done:
+                    op.inputs[slot] = [done[ns[0]]]
+                    op.attrs["quantization_type"] = "post_training_int8"
+                    op.attrs["Input_scale"] = float(scales[ns[0]])
+        program._bump_version()
+
+    # -- export -------------------------------------------------------
+
+    def save_quantized_model(self, dirname, model_filename=None,
+                             params_filename=None):
+        from paddle_tpu import io as fluid_io
+
+        return fluid_io.save_inference_model(
+            dirname, self._feed_names, self._fetch_targets, self._exe,
+            main_program=self._program, model_filename=model_filename,
+            params_filename=params_filename)

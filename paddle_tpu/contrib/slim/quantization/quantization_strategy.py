@@ -1,0 +1,92 @@
+"""QuantizationStrategy for the slim Compressor (reference
+``contrib/slim/quantization/quantization_strategy.py``: insert the QAT
+fake-quant ops at ``start_epoch``, train, freeze + export int8 at
+``end_epoch``)."""
+
+from ..core import Strategy
+from .quantization_pass import QuantizationFreezePass, TransformForTraining
+
+__all__ = ["QuantizationStrategy"]
+
+
+class QuantizationStrategy(Strategy):
+    """Insert → train → freeze → save, driven by Compressor epochs.
+
+    Contract (matches the reference's graph-then-compile ordering): give
+    the Compressor the FORWARD program plus ``train_optimizer``; this
+    strategy rewrites the forward graph in ``on_compression_begin`` and
+    the compressor builds the optimizer afterwards, so gradients flow
+    through the straight-through fake-quant ops.
+    """
+
+    def __init__(self, start_epoch=0, end_epoch=0, weight_bits=8,
+                 activation_bits=8,
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_quantize_type="abs_max",
+                 save_out_nodes=None, save_in_nodes=None,
+                 float_model_save_path=None, int8_model_save_path=None):
+        super().__init__(start_epoch, end_epoch)
+        self.transform = TransformForTraining(
+            weight_bits=weight_bits, activation_bits=activation_bits,
+            activation_quantize_type=activation_quantize_type,
+            weight_quantize_type=weight_quantize_type)
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.save_out_nodes = save_out_nodes
+        self.save_in_nodes = save_in_nodes
+        self.float_model_save_path = float_model_save_path
+        self.int8_model_save_path = int8_model_save_path
+
+    def on_compression_begin(self, context):
+        from paddle_tpu.framework import Program
+
+        startup = context.get("startup_program")
+        if startup is None:
+            startup = Program()
+            context["startup_program"] = startup
+        n = self.transform.apply(context["program"], startup)
+        context["quantized_slots"] = n
+        # a test clone BEFORE the compressor minimizes: the freeze/export
+        # target (reference uses the separate test graph the same way)
+        context["quant_test_program"] = context["program"].clone(
+            for_test=True)
+
+    def on_epoch_end(self, context):
+        if context["epoch"] != self.end_epoch:
+            return
+        test_prog = context.get("quant_test_program")
+        if test_prog is None:
+            return
+        scope = context["scope"]
+        if self.float_model_save_path and self.save_out_nodes:
+            self._save(context, test_prog.clone(for_test=True), scope,
+                       self.float_model_save_path)
+        # freeze into a COPIED scope: QuantizationFreezePass rewrites
+        # weight storage to int8 codes, and doing that to the live
+        # training scope would make any epochs after end_epoch train on
+        # raw quantization codes (silent ~bin_cnt-x weight corruption)
+        from paddle_tpu.executor import Scope
+
+        frozen_scope = Scope()
+        for v in test_prog.global_block().vars.values():
+            if getattr(v, "persistable", False) and scope.has(v.name):
+                frozen_scope.set(v.name, scope.get(v.name))
+        QuantizationFreezePass(
+            scope=frozen_scope, weight_bits=self.weight_bits,
+            activation_bits=self.activation_bits).apply(test_prog)
+        context["quant_frozen_program"] = test_prog
+        context["quant_frozen_scope"] = frozen_scope
+        if self.int8_model_save_path and self.save_out_nodes:
+            self._save(context, test_prog, frozen_scope,
+                       self.int8_model_save_path)
+
+    def _save(self, context, program, scope, path):
+        from paddle_tpu import io as fluid_io
+        from paddle_tpu.executor import scope_guard
+
+        with scope_guard(scope):
+            fluid_io.save_inference_model(
+                path, list(self.save_in_nodes or []),
+                [program.global_block().var(getattr(n, "name", n))
+                 for n in self.save_out_nodes],
+                context["exe"], main_program=program)

@@ -112,6 +112,17 @@ def _quant_dequant(x, scale, bin_cnt):
     return _clip_quant(x, s, bin_cnt) * (s / bin_cnt)
 
 
+@register_op("quantize_dequantize_fixed_scale", inputs=["X", "InScale"],
+             outputs=["Out"], no_grad=True)
+def quantize_dequantize_fixed_scale(ctx, attrs, X, InScale):
+    """Static-scale QDQ simulation for post-training-calibrated
+    activations (the role of the reference's calibrated int8 rewrite,
+    ``inference/api/mkldnn_quantizer.cc`` — scales computed offline from
+    a calibration set, applied as constants at inference)."""
+    bin_cnt = _bin_cnt(attrs)
+    return _quant_dequant(X, InScale.reshape(()), bin_cnt)
+
+
 @register_op("fake_quantize_dequantize_abs_max", inputs=["X"],
              outputs=["Out", "OutScale"])
 def fake_quantize_dequantize_abs_max(ctx, attrs, X):
