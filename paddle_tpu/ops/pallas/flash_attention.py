@@ -145,13 +145,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, m_out_ref,
         l_ref[:] = jnp.zeros_like(l_ref)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32)  # [bq, d]
-        k = k_ref[0].astype(jnp.float32)  # [bk, d]
-        v = v_ref[0].astype(jnp.float32)  # [bk, d]
+        # matmuls run at the INPUT dtype with f32 accumulation: under
+        # bf16 AMP the MXU's bf16 rate is ~4x its f32 rate, and
+        # bf16xbf16->f32 QK^T is bit-identical to upcast-then-f32 (bf16
+        # casts are exact; 8-bit-mantissa products fit f32's 24).  Same
+        # fix as the r04 XLA-fallback change; f32 inputs are unchanged.
+        q = q_ref[0]  # [bq, d]
+        k = k_ref[0]  # [bk, d]
+        v = v_ref[0]  # [bk, d]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * sm_scale  # [bq, bk]
+        ) * sm_scale  # [bq, bk] f32
         if bias_ref is not None:
             s = s + bias_ref[0].astype(jnp.float32)  # (1, bk) broadcasts
         if causal:
@@ -178,8 +183,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, m_out_ref,
             keep = _keep_mask(p.shape, dropout_rate, seed_ref, b, i, j,
                               block_q, block_k, dropout_debug)
             p = jnp.where(keep, p, 0.0) / (1.0 - dropout_rate)
+        # PV at input dtype (p downcast under AMP): the MXU-rate
+        # tradeoff mha_reference makes identically; acc stays f32
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
@@ -298,10 +305,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref, m_ref,
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # input-dtype matmuls, f32 accumulation (see _fwd_kernel note)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         m_col = m_ref[0].reshape(block_q, 1)
         l_col = l_ref[0].reshape(block_q, 1)
         delta_col = dl_ref[0].reshape(block_q, 1)
@@ -325,14 +333,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref, m_ref,
             p_v = p
         # dV += P_drop^T @ dO
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
-            p_v, do, (((0,), (0,)), ((), ())),
+            p_v.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         # dS = P * (dP_masked - delta)
         ds = p * (dp - delta_col)
         # dK += dS^T @ Q * scale
         dk_acc[:] = dk_acc[:] + sm_scale * jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
@@ -362,10 +370,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref, m_ref,
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     def _compute():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        # input-dtype matmuls, f32 accumulation (see _fwd_kernel note)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         m_col = m_ref[0].reshape(block_q, 1)
         l_col = l_ref[0].reshape(block_q, 1)
         delta_col = dl_ref[0].reshape(block_q, 1)
@@ -381,7 +390,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref, m_ref,
             dp = jnp.where(keep, dp, 0.0) / (1.0 - dropout_rate)
         ds = p * (dp - delta_col)
         dq_acc[:] = dq_acc[:] + sm_scale * jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
@@ -551,10 +560,16 @@ def mha_reference(q, k, v, bias=None, causal=False, sm_scale=None,
 # ---------------------------------------------------------------------------
 
 def _pick_blocks(tq, tk):
-    bq = max(8, min(512, tq))
+    """Block shapes, env-tunable for on-chip sweeps
+    (tools/bench_flash.py --blocks writes the decision artifact):
+    PADDLE_TPU_FLASH_BLOCK_Q / PADDLE_TPU_FLASH_BLOCK_K cap the
+    defaults; divisibility/alignment still enforced here."""
+    cap_q = int(os.environ.get("PADDLE_TPU_FLASH_BLOCK_Q", "512") or 512)
+    cap_k = int(os.environ.get("PADDLE_TPU_FLASH_BLOCK_K", "512") or 512)
+    bq = max(8, min(cap_q, tq))
     while tq % bq:
         bq //= 2
-    bk = max(128, min(512, tk))
+    bk = max(128, min(cap_k, tk))
     while tk % bk:
         bk //= 2
     return bq, bk

@@ -82,6 +82,34 @@ def test_kernel_multiblock(monkeypatch):
         np.testing.assert_allclose(a, b, atol=5e-4, rtol=1e-3)
 
 
+def test_kernel_bf16_inputs_match_reference():
+    """bf16 (AMP) inputs: the kernel now feeds the MXU input-dtype
+    operands with f32 accumulation — QK^T is bit-identical to the old
+    upcast form (bf16 casts are exact, 8-bit-mantissa products fit
+    f32), and the PV/backward downcasts match mha_reference's own
+    (bf16-scaled tolerances)."""
+    rng = np.random.RandomState(5)
+    B, H, T, D = 2, 2, 256, 64
+    q, k, v = (jnp.asarray(rng.randn(B, H, T, D), jnp.bfloat16)
+               for _ in range(3))
+    o1 = FA.flash_attention(q, k, v)
+    o2 = FA.mha_reference(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(o1, np.float32), np.asarray(o2, np.float32),
+        atol=2e-2, rtol=2e-2)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(
+            fn(q, k, v).astype(jnp.float32) ** 2)
+
+    g1 = jax.grad(loss(FA.flash_attention), argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss(FA.mha_reference), argnums=(0, 1, 2))(q, k, v)
+    for a, b, nm in zip(g1, g2, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            atol=0.5, rtol=6e-2, err_msg="d%s" % nm)
+
+
 def test_fused_op_in_program():
     """Program-level: fused_multihead_attention layer vs the unfused op
     chain, both through the Executor, gradients included.  T=128 so the

@@ -17,13 +17,20 @@ import time
 import numpy as np
 
 
-def bench_case(T, dropout, use_kernel, B=16, H=12, D=64, steps=30):
+def bench_case(T, dropout, use_kernel, B=16, H=12, D=64, steps=30,
+               block_q=None, block_k=None):
     import os
 
     os.environ["PADDLE_TPU_PALLAS"] = "auto" if use_kernel else "off"
     # force the kernel at EVERY T (the tool exists to re-decide the
     # default T<256 deferral, so the boundary must not gate the sweep)
     os.environ["PADDLE_TPU_FLASH_MIN_T"] = "1" if use_kernel else "256"
+    for var, val in (("PADDLE_TPU_FLASH_BLOCK_Q", block_q),
+                     ("PADDLE_TPU_FLASH_BLOCK_K", block_k)):
+        if val is None:
+            os.environ.pop(var, None)
+        else:
+            os.environ[var] = str(val)
 
     import jax
     import jax.numpy as jnp
@@ -59,9 +66,38 @@ def bench_case(T, dropout, use_kernel, B=16, H=12, D=64, steps=30):
     return dt * 1e3, mfu
 
 
+def block_sweep():
+    """Block-shape sweep at the kernel's own regime (VERDICT r4 #4):
+    (block_q, block_k) combos at T=512/1024 with dropout on, kernel
+    path only.  Prints per-T winners and BLOCK-DECISION lines the
+    watcher artifact records (parsed by tools/decide_flash_min_t.py)."""
+    best = {}
+    for T in (512, 1024):
+        for bq in (128, 256, 512):
+            for bk in (128, 256, 512):
+                if bq > T or bk > T:
+                    continue
+                try:
+                    ms, mfu = bench_case(T, 0.1, True, block_q=bq,
+                                         block_k=bk)
+                except Exception as e:  # noqa: BLE001
+                    print("# T=%d bq=%d bk=%d FAILED: %s"
+                          % (T, bq, bk, str(e)[-160:]), flush=True)
+                    continue
+                print("T=%-5d bq=%-4d bk=%-4d  %7.3f ms  attn-MFU %.3f"
+                      % (T, bq, bk, ms, mfu), flush=True)
+                if T not in best or mfu > best[T][2]:
+                    best[T] = (bq, bk, mfu)
+    for T, (bq, bk, mfu) in sorted(best.items()):
+        print("BLOCK-DECISION T=%d: block_q=%d block_k=%d (attn-MFU "
+              "%.3f)" % (T, bq, bk, mfu), flush=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--csv", action="store_true")
+    ap.add_argument("--blocks", action="store_true",
+                    help="sweep kernel block shapes at T=512/1024")
     args = ap.parse_args()
 
     import jax
@@ -70,6 +106,10 @@ def main():
     if "tpu" not in plat and "axon" not in plat:
         print("# WARNING: not on TPU (platform=%s); numbers meaningless"
               % plat)
+
+    if args.blocks:
+        block_sweep()
+        return
 
     rows = []
     for T in (128, 256, 512, 1024, 2048):

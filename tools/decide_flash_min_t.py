@@ -69,6 +69,24 @@ def main():
               "(kernel wins at drop=%.1f from T=%d upward)"
               % (rec, d_train, rec))
 
+    # block-shape decisions, if the --blocks sweep artifact exists
+    # (tools/bench_flash.py --blocks; watcher step bench_flash_blocks)
+    import os
+
+    bpath = os.path.join(os.path.dirname(path) or ".",
+                         "bench_flash_blocks.txt")
+    try:
+        with open(bpath) as f:
+            decisions = [ln.strip() for ln in f
+                         if ln.startswith("BLOCK-DECISION")]
+    except OSError:
+        decisions = []
+    if decisions:
+        print("\nblock-shape decisions (%s):" % bpath)
+        for d in decisions:
+            print("  " + d)
+        print("  -> set PADDLE_TPU_FLASH_BLOCK_Q/K accordingly")
+
 
 if __name__ == "__main__":
     main()

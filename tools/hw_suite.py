@@ -101,6 +101,8 @@ def build_steps():
                   None))
     steps.append(("bench_flash_sweep", [py, "tools/bench_flash.py"], 900,
                   None))
+    steps.append(("bench_flash_blocks",
+                  [py, "tools/bench_flash.py", "--blocks"], 900, None))
     # the full driver-format bench; every compile above seeded the cache
     steps.append(("bench_full", [py, "bench.py"], 1500, None))
     steps.append(("optest_on_tpu",
