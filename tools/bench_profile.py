@@ -50,9 +50,12 @@ def analyze():
     # (needs PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python) and its TF
     # pywrap (no xspace_to_tools_data) — found pre-staging the hardware
     # run; the direct parser needs neither
-    from xplane_top_ops import top_ops
+    from xplane_top_ops import by_program_op, top_ops
 
     top_ops(TRACE_DIR)  # globs + asserts the xplane itself
+    # Program-op attribution (the executor's pd-scope tags): the
+    # reference-style per-op table, conv2d/fused_adam/... level
+    by_program_op(TRACE_DIR)
 
 
 if __name__ == "__main__":
