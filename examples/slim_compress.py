@@ -1,0 +1,113 @@
+"""Model compression with the slim Compressor (reference:
+``contrib/slim`` demos — a YAML config names the strategies; the
+Compressor drives epochs around them).
+
+Two configs shown on an MNIST convnet:
+  --mode qat    quantization-aware training: insert fake-quant ops,
+                train, freeze to REAL int8 weight storage, report
+                accuracy of fp32 vs frozen-int8.
+  --mode prune  uniform structured pruning at 50%, report sparsity.
+
+    python examples/slim_compress.py [--cpu] [--mode qat|prune]
+"""
+
+import argparse
+
+import _common  # noqa: E402 - repo-root path + bounded backend probe
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--mode", choices=("qat", "prune"), default="qat")
+    ap.add_argument("--batches", type=int, default=120)
+    args = ap.parse_args()
+    _common.pick_backend(force_cpu=args.cpu)
+
+    import paddle_tpu as fluid
+    from paddle_tpu import datasets
+    from paddle_tpu.contrib.slim.core import Compressor
+    from paddle_tpu.executor import Scope, scope_guard
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        img = fluid.layers.data("img", shape=[1, 28, 28], dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        conv = fluid.layers.conv2d(img, num_filters=8, filter_size=5,
+                                   padding=2, act="relu")
+        pool = fluid.layers.pool2d(conv, pool_size=4, pool_stride=4)
+        logits = fluid.layers.fc(pool, size=10)
+        prob = fluid.layers.softmax(logits)
+        acc = fluid.layers.accuracy(input=prob, label=label)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+
+    def reader():
+        r = fluid.batch(datasets.mnist.train(), 64)
+        for i, b in enumerate(r()):
+            if i >= args.batches:
+                break
+            yield {"img": np.stack([x[0].reshape(1, 28, 28) for x in b])
+                   .astype("float32"),
+                   "label": np.array([[x[1]] for x in b], dtype="int64")}
+
+    if args.mode == "qat":
+        from paddle_tpu.contrib.slim.quantization.quantization_strategy \
+            import QuantizationStrategy
+
+        strategies = [QuantizationStrategy(start_epoch=0, end_epoch=1)]
+    else:
+        from paddle_tpu.contrib.slim.prune.prune_strategy import (
+            UniformPruneStrategy)
+
+        strategies = [UniformPruneStrategy(target_ratio=0.5,
+                                           start_epoch=1,
+                                           pruned_params="*.w_0")]
+
+    scope = Scope()
+    with scope_guard(scope):
+        comp = Compressor(
+            fluid.TPUPlace(), scope, main_prog, train_reader=reader,
+            train_fetch_list=[loss.name],
+            train_optimizer=fluid.optimizer.Adam(learning_rate=2e-3),
+            startup_program=startup)
+        comp.epoch = 2
+        comp.config(strategies)
+        ctx = comp.run()
+
+        exe = ctx["exe"]
+        test_prog = main_prog.clone(for_test=True)
+        evals = []
+        for feed in list(reader())[:4]:
+            evals.append(float(np.asarray(exe.run(
+                test_prog, feed=feed, fetch_list=[acc])[0]).reshape(-1)[0]))
+        print("train-set accuracy after compression: %.4f"
+              % float(np.mean(evals)))
+
+        if args.mode == "qat":
+            frozen = ctx["quant_frozen_program"]
+            fscope = ctx["quant_frozen_scope"]
+            block = frozen.global_block()
+            conv_op = next(op for op in block.ops
+                           if op.type in ("conv2d", "depthwise_conv2d"))
+            w = conv_op.inputs["Filter"][0].rsplit(".quant_dequant", 1)[0]
+            print("frozen int8 weight %r dtype: %s"
+                  % (w, np.asarray(fscope.get(w)).dtype))
+            with scope_guard(fscope):
+                a = [float(np.asarray(exe.run(
+                    frozen, feed=feed, fetch_list=[acc])[0]).reshape(-1)[0])
+                     for feed in list(reader())[:4]]
+            print("frozen-int8 accuracy: %.4f" % float(np.mean(a)))
+        else:
+            sp = ctx.get("achieved_sparsity")
+            name, idx = next(iter(strategies[0].pruned_idx.items()))
+            print("pruned %d filter groups of %r%s"
+                  % (len(idx), name,
+                     "; sparsity %.2f" % sp if sp is not None else ""))
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

@@ -12,6 +12,8 @@ from . import extend_optimizer
 from . import layers
 from .memory_usage_calc import memory_usage
 from .op_frequence import op_freq_statistic
+from . import model_stat
+from .model_stat import summary
 from .extend_optimizer import extend_with_decoupled_weight_decay
 from .layers import (BasicGRUUnit, BasicLSTMUnit, basic_gru, basic_lstm,
                      fused_elemwise_activation)
@@ -29,7 +31,7 @@ from .decoder import (BeamSearchDecoder, InitState, StateCell,
                       TrainingDecoder)
 
 __all__ = ["mixed_precision", "slim", "extend_optimizer", "layers",
-           "memory_usage", "op_freq_statistic",
+           "memory_usage", "op_freq_statistic", "model_stat", "summary",
            "extend_with_decoupled_weight_decay",
            "BasicGRUUnit", "BasicLSTMUnit", "basic_gru", "basic_lstm",
            "fused_elemwise_activation", "QuantizeTranspiler",

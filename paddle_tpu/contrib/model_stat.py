@@ -1,0 +1,62 @@
+"""Model statistics summary (reference
+``python/paddle/fluid/contrib/model_stat.py``: ``summary(main_prog)``
+prints a per-op table of TYPE / INPUT / OUTPUT / PARAMs / FLOPs plus
+totals).  Built on the slim GraphWrapper's shared per-op FLOPs
+accounting; no prettytable dependency (plain column formatting)."""
+
+import numpy as np
+
+__all__ = ["summary"]
+
+_COUNTED = ("conv2d", "depthwise_conv2d", "mul", "matmul", "batch_norm",
+            "relu", "sigmoid", "tanh", "pool2d", "elementwise_add",
+            "elementwise_mul")
+
+
+def _fmt_shape(shapes):
+    if not shapes:
+        return "-"
+    s = shapes[0]
+    return str(tuple(int(d) for d in s)) if s else "-"
+
+
+def summary(main_prog):
+    """Print (and return as a list of rows) the per-op stats table for
+    the counted op set; mirrors the reference's output shape
+    (model_stat.py docstring table)."""
+    from .slim.graph import GraphWrapper, op_flops
+
+    g = GraphWrapper(main_prog)
+    rows = []
+    total_params = 0
+    total_flops = 0
+    for op in g.ops():
+        t = op.type()
+        if t not in _COUNTED:
+            continue
+        params = int(sum(
+            np.prod([d for d in p.shape() if d > 0]) or 0
+            for p in g.get_param_by_op(op)))
+        flops = op_flops(op)
+        ins = [v.shape() for v in op.all_inputs()
+               if not v.is_parameter()]
+        outs = [v.shape() for v in op.all_outputs()]
+        rows.append((len(rows), t, _fmt_shape(ins), _fmt_shape(outs),
+                     params, flops))
+        total_params += params
+        total_flops += flops
+
+    widths = (5, 12, 18, 18, 10, 14)
+    heads = ("No.", "TYPE", "INPUT", "OUTPUT", "PARAMs", "FLOPs")
+    sep = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
+    print(sep)
+    print("|" + "|".join(" %*s " % (w, h)
+                         for w, h in zip(widths, heads)) + "|")
+    print(sep)
+    for r in rows:
+        print("|" + "|".join(" %*s " % (w, str(c))
+                             for w, c in zip(widths, r)) + "|")
+    print(sep)
+    print("Total PARAMs: %d(%.4fG)" % (total_params, total_params / 1e9))
+    print("Total FLOPs: %d(%.2fG)" % (total_flops, total_flops / 1e9))
+    return rows

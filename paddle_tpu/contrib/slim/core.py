@@ -7,6 +7,33 @@ epochs around them)."""
 __all__ = ["Compressor", "Strategy"]
 
 
+def _resolve_strategy_class(name):
+    """Strategy-class registry for YAML configs (the reference resolves
+    class names through its factory the same way)."""
+    from .distillation.distillation_strategy import DistillationStrategy
+    from .prune.prune_strategy import (SensitivePruneStrategy,
+                                       UniformPruneStrategy)
+    from .quantization.quantization_strategy import QuantizationStrategy
+
+    reg = {c.__name__: c for c in (
+        UniformPruneStrategy, SensitivePruneStrategy,
+        QuantizationStrategy, DistillationStrategy)}
+    if name not in reg:
+        raise ValueError("unknown strategy class %r (known: %s)"
+                         % (name, sorted(reg)))
+    return reg[name]
+
+
+def _resolve_pruner_class(name):
+    from .prune import MagnitudePruner, StructurePruner
+
+    reg = {c.__name__: c for c in (StructurePruner, MagnitudePruner)}
+    if name not in reg:
+        raise ValueError("unknown pruner class %r (known: %s)"
+                         % (name, sorted(reg)))
+    return reg[name]
+
+
 class Strategy:
     """reference ``core/strategy.py:Strategy``: hook points around the
     compression run and each epoch.  ``start_epoch``/``end_epoch``
@@ -55,10 +82,24 @@ class Compressor:
         self.strategies = []
 
     def config(self, config_file):
-        """Load the strategy list.  The reference parses a YAML registry
-        of strategy classes; here accept either a YAML path (parsed for
-        the compress_pass epoch + strategies) or a plain list of strategy
-        objects (each with on_epoch_begin/on_epoch_end hooks)."""
+        """Load the strategy list: either a plain list of strategy
+        objects, or a YAML path in the reference's registry shape
+        (``compressor.py _load_config``) —
+
+            strategies:
+              prune_one:
+                class: UniformPruneStrategy
+                target_ratio: 0.5
+            pruners:
+              pruner_1:
+                class: StructurePruner
+            compress_pass:
+              epoch: 2
+              strategies: [prune_one]
+
+        ``class`` names resolve from the slim strategy/pruner registry;
+        a strategy's ``pruner:`` kwarg may name an entry in the
+        top-level ``pruners`` section."""
         if isinstance(config_file, (list, tuple)):
             self.strategies = list(config_file)
             return self
@@ -68,7 +109,34 @@ class Compressor:
             cfg = yaml.safe_load(f) or {}
         cp = cfg.get("compress_pass", cfg.get("compressor", {})) or {}
         self.epoch = int(cp.get("epoch", 1))
-        self.strategies = cp.get("strategies", []) or []
+        named = cfg.get("strategies", {}) or {}
+        pruners = cfg.get("pruners", {}) or {}
+        out = []
+        for entry in cp.get("strategies", []) or []:
+            if isinstance(entry, str):
+                spec = dict(named.get(entry) or {})
+                if not spec:
+                    raise ValueError(
+                        "strategy %r not found in the top-level "
+                        "'strategies' section" % entry)
+            else:
+                spec = dict(entry or {})
+            if "class" not in spec:
+                raise ValueError(
+                    "strategy spec %r has no 'class' key" % (entry,))
+            cls = _resolve_strategy_class(spec.pop("class"))
+            if isinstance(spec.get("pruner"), str):
+                pname = spec["pruner"]
+                if pname not in pruners:
+                    raise ValueError(
+                        "pruner %r not found in the top-level 'pruners' "
+                        "section (known: %s)" % (pname, sorted(pruners)))
+                pspec = dict(pruners[pname] or {})
+                pcls = _resolve_pruner_class(pspec.pop("class",
+                                                       "StructurePruner"))
+                spec["pruner"] = pcls(**pspec)
+            out.append(cls(**spec))
+        self.strategies = out
         return self
 
     def _maybe_minimize(self, context):

@@ -11,7 +11,7 @@ every mutation routes through the normal Block APIs.
 
 import numpy as np
 
-__all__ = ["VarWrapper", "OpWrapper", "GraphWrapper"]
+__all__ = ["VarWrapper", "OpWrapper", "GraphWrapper", "op_flops"]
 
 
 class VarWrapper:
@@ -170,37 +170,43 @@ class GraphWrapper:
         """Static FLOPs of the forward ops (reference flops(): conv,
         mul/matmul, pool, elementwise, relu counted; 2*MACs for the
         matmul-class ops)."""
-        total = 0
-        for op in self.ops():
-            t = op.type()
-            if t in ("conv2d", "depthwise_conv2d"):
-                out = op.outputs("Output")
-                flt = op.inputs("Filter")
-                if not out or not flt:
-                    continue
-                oshape = out[0].shape()
-                fshape = flt[0].shape()
-                if len(oshape) < 4 or len(fshape) < 4:
-                    continue
-                groups = int(op.attr("groups") or 1)
-                # 2 * H_out*W_out * Cout * (Cin/g * kh * kw) per image
-                total += int(2 * oshape[2] * oshape[3] * fshape[0]
-                             * (fshape[1] * fshape[2] * fshape[3]))
-                if op.inputs("Bias"):
-                    total += int(np.prod(oshape[1:]))
-            elif t in ("mul", "matmul"):
-                x = op.inputs("X")
-                y = op.inputs("Y")
-                if not x or not y:
-                    continue
-                xs, ys = x[0].shape(), y[0].shape()
-                if len(xs) >= 2 and len(ys) >= 2:
-                    m = int(np.prod([d for d in xs[:-1] if d > 0]) or 1)
-                    total += 2 * m * xs[-1] * ys[-1]
-            elif t in ("relu", "sigmoid", "tanh", "elementwise_add",
-                       "elementwise_mul", "batch_norm", "pool2d"):
-                out = op.all_outputs()
-                if out:
-                    total += int(np.prod(
-                        [d for d in out[0].shape() if d > 0]) or 0)
-        return int(total)
+        return int(sum(op_flops(op) for op in self.ops()))
+
+
+def op_flops(op):
+    """Per-op static FLOPs (shared by GraphWrapper.flops and
+    contrib.model_stat.summary — the reference counts the same op set
+    in both places)."""
+    t = op.type()
+    if t in ("conv2d", "depthwise_conv2d"):
+        out = op.outputs("Output")
+        flt = op.inputs("Filter")
+        if not out or not flt:
+            return 0
+        oshape = out[0].shape()
+        fshape = flt[0].shape()
+        if len(oshape) < 4 or len(fshape) < 4:
+            return 0
+        # 2 * H_out*W_out * Cout * (Cin/g * kh * kw) per image
+        total = int(2 * oshape[2] * oshape[3] * fshape[0]
+                    * (fshape[1] * fshape[2] * fshape[3]))
+        if op.inputs("Bias"):
+            total += int(np.prod(oshape[1:]))
+        return total
+    if t in ("mul", "matmul"):
+        x = op.inputs("X")
+        y = op.inputs("Y")
+        if not x or not y:
+            return 0
+        xs, ys = x[0].shape(), y[0].shape()
+        if len(xs) >= 2 and len(ys) >= 2:
+            m = int(np.prod([d for d in xs[:-1] if d > 0]) or 1)
+            return 2 * m * xs[-1] * ys[-1]
+        return 0
+    if t in ("relu", "sigmoid", "tanh", "elementwise_add",
+             "elementwise_mul", "batch_norm", "pool2d"):
+        out = op.all_outputs()
+        if out:
+            return int(np.prod(
+                [d for d in out[0].shape() if d > 0]) or 0)
+    return 0

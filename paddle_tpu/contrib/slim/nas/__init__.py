@@ -109,12 +109,17 @@ def light_nas_search(search_space, reward_fn, search_steps=50,
                      controller=None, constrain_func=None):
     """In-process LightNAS loop (reference light_nas_strategy.py
     on_compression_begin): anneal over the token space, evaluating each
-    candidate with `reward_fn(net)`; returns (best_tokens, best_reward)."""
+    candidate with `reward_fn(net)`; returns (best_tokens, best_reward).
+
+    ``constrain_func`` gates EVERY candidate including the initial
+    tokens — an over-budget init seeds the mutation walk but is never
+    evaluated or eligible as best."""
     ctl = controller or SAController()
     init = search_space.init_tokens()
     ctl.reset(search_space.range_table(), init, constrain_func)
-    reward = reward_fn(search_space.create_net(init))
-    ctl.update(init, reward)
+    if constrain_func is None or constrain_func(init):
+        reward = reward_fn(search_space.create_net(init))
+        ctl.update(init, reward)
     for _ in range(search_steps):
         tokens = ctl.next_tokens()
         reward = reward_fn(search_space.create_net(tokens))

@@ -1,0 +1,62 @@
+"""LightNASStrategy (reference ``contrib/slim/nas/light_nas_strategy.py``:
+run the SA-controller search at compression begin — each candidate
+scored by a short train/eval — under a FLOPs constraint read off the
+candidate's graph).
+
+TPU redesign note: the reference delegates candidate evaluation to a
+controller *server* + socket-connected search agents; here evaluation is
+in-process (each candidate is one jit-compiled short run), so the
+strategy is a thin loop over ``light_nas_search`` with the constraint
+built from the slim GraphWrapper."""
+
+from ..core import Strategy
+from ..graph import GraphWrapper
+from . import SAController, light_nas_search
+
+__all__ = ["LightNASStrategy"]
+
+
+class LightNASStrategy(Strategy):
+    """Search at ``on_compression_begin``; stores ``best_tokens`` /
+    ``best_reward`` in the context and on self.
+
+    search_space: a ``SearchSpace`` (init_tokens/range_table/create_net).
+    reward_fn: net -> float (higher is better), e.g. short-train the
+        candidate and return -loss or eval accuracy.
+    max_flops: optional FLOPs budget; candidates whose program exceeds
+        it are never evaluated (the reference's flops constraint).
+    program_of: net -> Program used for the FLOPs check; defaults to
+        ``net[1]`` matching SearchSpace.create_net's documented
+        (startup, main, loss) shape.
+    """
+
+    def __init__(self, search_space, reward_fn, search_steps=50,
+                 max_flops=None, program_of=None, controller=None,
+                 start_epoch=0, end_epoch=0):
+        super().__init__(start_epoch, end_epoch)
+        self.search_space = search_space
+        self.reward_fn = reward_fn
+        self.search_steps = search_steps
+        self.max_flops = max_flops
+        self.program_of = program_of or (lambda net: net[1])
+        self.controller = controller or SAController()
+        self.best_tokens = None
+        self.best_reward = None
+
+    def _constrain(self, tokens):
+        if self.max_flops is None:
+            return True
+        net = self.search_space.create_net(tokens)
+        return GraphWrapper(
+            self.program_of(net)).flops() <= self.max_flops
+
+    def on_compression_begin(self, context):
+        constrain = (self._constrain if self.max_flops is not None
+                     else None)
+        tokens, reward = light_nas_search(
+            self.search_space, self.reward_fn,
+            search_steps=self.search_steps, controller=self.controller,
+            constrain_func=constrain)
+        self.best_tokens, self.best_reward = tokens, reward
+        context["nas_best_tokens"] = tokens
+        context["nas_best_reward"] = reward

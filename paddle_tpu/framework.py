@@ -535,6 +535,16 @@ class Program:
                     )
                 nb.vars[name] = nv
             for op in b.ops:
+                # for_test prunes the backward+optimize+lr-sched tail
+                # (reference clone → _inference_optimize: ops carrying
+                # the Backward/Optimize/LRSched roles are dropped), so
+                # cloning AFTER minimize yields a pure eval program —
+                # without this an "eval" run would keep TRAINING
+                # (donating params, advancing the decay counter)
+                if for_test and b.idx == 0 and op.attrs.get(
+                        "op_role") in ("backward", "optimize",
+                                       "lr_sched"):
+                    continue
                 no = Operator(
                     nb,
                     op.type,

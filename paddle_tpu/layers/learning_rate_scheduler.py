@@ -42,7 +42,10 @@ def _global_step(counter_name="@LR_DECAY_COUNTER@"):
             type="increment",
             inputs={"X": [counter]},
             outputs={"Out": [counter]},
-            attrs={"step": 1.0},
+            # LRSched role (reference op_role enum): clone(for_test)
+            # prunes it — an eval batch must not advance the decay
+            # counter of the shared training scope
+            attrs={"step": 1.0, "op_role": "lr_sched"},
         )
         counter.stop_gradient = True
     return counter

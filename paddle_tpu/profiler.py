@@ -25,7 +25,7 @@ import time
 
 __all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
            "record_event", "cuda_profiler", "is_profiler_enabled",
-           "attribute_op_name", "device_op_stats"]
+           "attribute_op_name", "device_op_stats", "device_op_events"]
 
 _trace_dir = None
 _enabled = False
@@ -150,11 +150,12 @@ def _event_strings(plane, ev, metadata):
     return [s for s in out if s]
 
 
-def device_op_stats(trace_dir):
-    """Aggregate device XLA-op time by Program op from a jax profiler
-    trace dir.  Returns {op_type: [calls, total_ms, max_ms, min_ms]};
-    events with no pd-tag aggregate under their raw HLO name prefixed
-    '~' (so unattributed time stays visible, not silently dropped)."""
+def _iter_device_xla_events(trace_dir):
+    """Yield ``(raw_name, tag_or_None, ts_us, dur_us, line_label)`` for
+    every device XLA-op event in the newest xplane under ``trace_dir``
+    — the ONE parsing/attribution pipeline behind both the aggregate
+    table (:func:`device_op_stats`) and the timeline rows
+    (:func:`device_op_events`)."""
     import glob
     import os
 
@@ -162,11 +163,10 @@ def device_op_stats(trace_dir):
 
     xplanes = glob.glob(trace_dir + "/**/*.xplane.pb", recursive=True)
     if not xplanes:
-        return {}
+        return
     space = xplane_pb2.XSpace()
     with open(max(xplanes, key=os.path.getmtime), "rb") as f:
         space.ParseFromString(f.read())
-    table = {}
     for plane in space.planes:
         if "TPU" not in plane.name and "/device:" not in plane.name:
             continue
@@ -174,6 +174,7 @@ def device_op_stats(trace_dir):
         for line in plane.lines:
             if "XLA Ops" not in line.name and line.name != "Ops":
                 continue
+            t0_us = line.timestamp_ns / 1e3
             for ev in line.events:
                 md = ev_meta[ev.metadata_id]
                 tag = None
@@ -181,14 +182,36 @@ def device_op_stats(trace_dir):
                     tag = attribute_op_name(s)
                     if tag:
                         break
-                name = tag[0] if tag else "~" + (md.name or "?")[:60]
-                row = table.setdefault(name, [0, 0.0, 0.0, None])
-                dt = ev.duration_ps / 1e9  # ms
-                row[0] += 1
-                row[1] += dt
-                row[2] = max(row[2], dt)
-                row[3] = dt if row[3] is None else min(row[3], dt)
+                yield ((md.name or "?"), tag,
+                       t0_us + ev.offset_ps / 1e6, ev.duration_ps / 1e6,
+                       "%s/%s" % (plane.name, line.name))
+
+
+def device_op_stats(trace_dir):
+    """Aggregate device XLA-op time by Program op from a jax profiler
+    trace dir.  Returns {op_type: [calls, total_ms, max_ms, min_ms]};
+    events with no pd-tag aggregate under their raw HLO name prefixed
+    '~' (so unattributed time stays visible, not silently dropped)."""
+    table = {}
+    for raw, tag, _ts, dur_us, _line in _iter_device_xla_events(trace_dir):
+        name = tag[0] if tag else "~" + raw[:60]
+        row = table.setdefault(name, [0, 0.0, 0.0, None])
+        dt = dur_us / 1e3  # ms
+        row[0] += 1
+        row[1] += dt
+        row[2] = max(row[2], dt)
+        row[3] = dt if row[3] is None else min(row[3], dt)
     return table
+
+
+def device_op_events(trace_dir):
+    """Per-event device rows ``[(op_name, ts_us, dur_us, line_name)]``
+    with Program-op attribution applied — the chrome-trace material
+    (reference ``tools/timeline.py:115`` renders op-named device
+    streams); the aggregate view is :func:`device_op_stats`."""
+    return [(tag[0] if tag else raw, ts, dur, line)
+            for raw, tag, ts, dur, line
+            in _iter_device_xla_events(trace_dir)]
 
 
 def _print_device_op_table(table, top=40):

@@ -99,3 +99,78 @@ def test_serialization_roundtrip():
         op.type for op in prog.global_block().ops
     ]
     assert prog2.global_block().var(y.name).shape == y.shape
+
+
+def test_clone_for_test_after_minimize_prunes_training_ops():
+    """Reference clone(for_test=True) drops ops carrying the Backward/
+    Optimize role (framework.py clone -> _inference_optimize), so a
+    POST-minimize clone is a pure eval program — without the prune an
+    'eval' run would keep training and donate the parameter buffers
+    (found via examples/slim_compress.py)."""
+    import numpy as np
+
+    from paddle_tpu.executor import Scope, scope_guard
+
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=16, act="relu")
+        logits = fluid.layers.fc(h, size=3)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    test = main.clone(for_test=True)
+    types = [op.type for op in test.global_block().ops]
+    assert not any(t.endswith("_grad") for t in types), types
+    assert "adam" not in types
+    # the train program is untouched
+    assert any(op.type == "adam" for op in main.global_block().ops)
+    # eval really evaluates: params identical before/after, loss equal
+    # across two runs on the same batch
+    sc = Scope()
+    with scope_guard(sc):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        feed = {"x": np.random.RandomState(0).randn(4, 8).astype(
+            "float32"), "y": np.zeros((4, 1), "int64")}
+        l1 = exe.run(test, feed=feed, fetch_list=[loss])[0]
+        l2 = exe.run(test, feed=feed, fetch_list=[loss])[0]
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2))
+
+
+def test_clone_for_test_does_not_advance_lr_counter():
+    """Eval batches must not advance @LR_DECAY_COUNTER@: the scheduler's
+    increment op carries the LRSched role and is pruned by
+    clone(for_test) — otherwise interleaved eval decays the training lr
+    faster the more eval batches run."""
+    import numpy as np
+
+    from paddle_tpu.executor import Scope, scope_guard
+
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, size=4)
+        loss = fluid.layers.reduce_mean(fluid.layers.square(h - y))
+        lr = fluid.layers.exponential_decay(
+            learning_rate=0.1, decay_steps=1, decay_rate=0.5)
+        fluid.optimizer.SGD(learning_rate=lr).minimize(loss)
+    test = main.clone(for_test=True)
+    assert not any(op.type == "increment"
+                   for op in test.global_block().ops)
+    sc = Scope()
+    with scope_guard(sc):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        feed = {"x": np.zeros((2, 4), "float32"),
+                "y": np.zeros((2, 1), "float32")}
+        exe.run(main, feed=feed, fetch_list=[])  # 1 train step
+        c1 = float(np.asarray(sc.get("@LR_DECAY_COUNTER@")).reshape(-1)[0])
+        for _ in range(3):  # eval must not move the counter
+            exe.run(test, feed=feed, fetch_list=[loss])
+        c2 = float(np.asarray(sc.get("@LR_DECAY_COUNTER@")).reshape(-1)[0])
+    assert c1 == c2 == 1.0, (c1, c2)

@@ -1,0 +1,42 @@
+"""contrib.model_stat.summary (reference
+``contrib/model_stat.py``: per-op TYPE/INPUT/OUTPUT/PARAMs/FLOPs table
++ totals)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.contrib.model_stat import summary
+
+
+def test_summary_counts_params_and_flops(capsys):
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[3, 16, 16], dtype="float32")
+        conv = fluid.layers.conv2d(img, num_filters=8, filter_size=3,
+                                   padding=1, act="relu")
+        pool = fluid.layers.pool2d(conv, pool_size=2, pool_stride=2)
+        fc = fluid.layers.fc(pool, size=10)
+    rows = summary(main)
+    out = capsys.readouterr().out
+    types = [r[1] for r in rows]
+    assert "conv2d" in types or "depthwise_conv2d" in types
+    assert "relu" in types
+    assert "pool2d" in types
+    assert "mul" in types
+    # the layer decomposes conv into conv + elementwise_add(bias), so
+    # the conv op carries the filter only (8*3*3*3); the bias param (8)
+    # rides the elementwise_add row
+    conv_row = next(r for r in rows
+                    if r[1] in ("conv2d", "depthwise_conv2d"))
+    assert conv_row[4] == 8 * 3 * 3 * 3
+    add_rows = [r for r in rows if r[1] == "elementwise_add"]
+    assert any(r[4] == 8 for r in add_rows)
+    mul_row = next(r for r in rows if r[1] == "mul")
+    assert mul_row[4] == 8 * 8 * 8 * 10
+    # conv FLOPs: 2*Hout*Wout*Cout*(Cin*kh*kw)
+    assert conv_row[5] == 2 * 16 * 16 * 8 * (3 * 3 * 3)
+    total_params = sum(r[4] for r in rows)
+    assert "Total PARAMs: %d" % total_params in out
+    assert "Total FLOPs:" in out
+    assert "| conv2d |" in out.replace("  ", " ") or "conv2d" in out

@@ -137,6 +137,36 @@ def test_device_op_stats_synthetic(tmp_path):
     assert abs(total - 15.0) < 1e-6
 
 
+def test_device_op_events_and_timeline_merge(tmp_path):
+    """Per-event rows carry attribution + timestamps, and
+    tools/timeline.py renders a trace dir into op-named chrome rows
+    (the reference timeline's device stream)."""
+    import json
+    import os
+    import sys
+
+    trace_dir = _synthetic_xspace(tmp_path)
+    rows = profiler.device_op_events(trace_dir)
+    names = [r[0] for r in rows]
+    assert names.count("conv2d") == 2
+    assert "sgd" in names
+    assert "fusion.99" in names  # unattributed keeps its HLO name
+    conv = next(r for r in rows if r[0] == "conv2d")
+    assert conv[2] > 0  # duration_us
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    import timeline
+
+    out = tmp_path / "merged.json"
+    n = timeline.merge([("dev", trace_dir)], str(out))
+    assert n == 1 + len(rows)
+    data = json.load(open(out))
+    ev_names = [e["name"] for e in data["traceEvents"]
+                if e.get("ph") == "X"]
+    assert "conv2d" in ev_names and "sgd" in ev_names
+
+
 def test_stop_profiler_prints_table(tmp_path, capsys, monkeypatch):
     """stop_profiler emits the reference-style sorted per-op report when
     a device trace directory holds attributable rows."""

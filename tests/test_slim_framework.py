@@ -276,6 +276,106 @@ class TestCompressorStrategies:
         assert any("student.w_0" in n for n in grad_outs)
         assert not any("teacher.w_0" in n for n in grad_outs)
 
+    def test_yaml_config_instantiates_strategies(self, tmp_path):
+        """Reference-shaped YAML registry: named strategy specs with
+        class + kwargs, pruner cross-references, compress_pass epoch."""
+        cfg = tmp_path / "compress.yaml"
+        cfg.write_text(
+            "strategies:\n"
+            "  prune_one:\n"
+            "    class: UniformPruneStrategy\n"
+            "    target_ratio: 0.5\n"
+            "    start_epoch: 0\n"
+            "    pruner: pruner_1\n"
+            "pruners:\n"
+            "  pruner_1:\n"
+            "    class: StructurePruner\n"
+            "compress_pass:\n"
+            "  epoch: 2\n"
+            "  strategies: [prune_one]\n")
+        from paddle_tpu.contrib.slim.prune import StructurePruner
+        from paddle_tpu.contrib.slim.prune.prune_strategy import (
+            UniformPruneStrategy)
+
+        main, startup, loss = _convnet()
+        scope = Scope()
+        with scope_guard(scope):
+            comp = Compressor(
+                fluid.CPUPlace(), scope, main, train_reader=_reader(),
+                train_fetch_list=[loss.name],
+                train_optimizer=fluid.optimizer.Adam(learning_rate=1e-3),
+                startup_program=startup)
+            comp.config(str(cfg))
+            assert comp.epoch == 2
+            assert len(comp.strategies) == 1
+            s = comp.strategies[0]
+            assert isinstance(s, UniformPruneStrategy)
+            assert s.target_ratio == 0.5
+            assert isinstance(s.pruner, StructurePruner)
+            ctx = comp.run()
+        assert s.pruned_idx  # the YAML-built strategy really pruned
+
+    def test_yaml_config_unknown_class_raises(self, tmp_path):
+        cfg = tmp_path / "bad.yaml"
+        cfg.write_text(
+            "compress_pass:\n"
+            "  strategies:\n"
+            "    - class: NoSuchStrategy\n")
+        main, startup, loss = _convnet()
+        comp = Compressor(fluid.CPUPlace(), Scope(), main)
+        try:
+            comp.config(str(cfg))
+            raise AssertionError("expected ValueError")
+        except ValueError as e:
+            assert "NoSuchStrategy" in str(e)
+
+    def test_light_nas_strategy_respects_flops_budget(self):
+        """LightNASStrategy: SA search over a width table under a
+        GraphWrapper-FLOPs budget — the best candidate must satisfy the
+        budget and beat the initial tokens."""
+        from paddle_tpu.contrib.slim.nas import SearchSpace
+        from paddle_tpu.contrib.slim.nas.light_nas_strategy import (
+            LightNASStrategy)
+
+        widths = [4, 8, 16, 32]
+
+        class WidthSpace(SearchSpace):
+            def init_tokens(self):
+                return [0]
+
+            def range_table(self):
+                return [len(widths)]
+
+            def create_net(self, tokens):
+                fluid.unique_name.switch()
+                main, startup = fluid.Program(), fluid.Program()
+                with fluid.program_guard(main, startup):
+                    x = fluid.layers.data("x", shape=[8],
+                                          dtype="float32")
+                    h = fluid.layers.fc(x, size=widths[tokens[0]])
+                    out = fluid.layers.fc(h, size=1)
+                return startup, main, out
+
+        # reward favors the LARGEST width; the budget excludes 32
+        def reward(net):
+            _, main, _ = net
+            return float(GraphWrapper(main).numel_params())
+
+        # flops budget: width 16 net fits, width 32 does not
+        def flops_of(w):
+            fluid.unique_name.switch()
+            s = WidthSpace()
+            return GraphWrapper(s.create_net([widths.index(w)])[1]).flops()
+
+        budget = (flops_of(16) + flops_of(32)) // 2
+        s = LightNASStrategy(WidthSpace(), reward, search_steps=30,
+                             max_flops=budget)
+        ctx = {"epoch": 0}
+        s.on_compression_begin(ctx)
+        assert s.best_tokens is not None
+        best_w = widths[s.best_tokens[0]]
+        assert best_w == 16, (best_w, ctx["nas_best_reward"])
+
     def test_quantization_freeze_does_not_corrupt_training_scope(self):
         """end_epoch < last epoch: epochs after the freeze keep training
         on fp32 weights — the freeze writes int8 codes to a COPIED

@@ -92,10 +92,25 @@ def main():
     else:
         print("flagship default not captured yet")
 
+    # MFU cross-check fields (bench prints mfu_analytic + mfu_xla)
+    for stem in sorted(metrics):
+        for l in lines_of(os.path.join(d, stem + ".txt")):
+            if l.get("mfu_xla") is not None:
+                tag = " DISAGREE>10%" if l.get("mfu_disagree") else ""
+                print("%-28s mfu_analytic=%.4f mfu_xla=%.4f%s"
+                      % (stem, l.get("mfu_analytic", 0), l["mfu_xla"],
+                         tag))
+
     sweep = os.path.join(d, "bench_flash_sweep.txt")
     if os.path.exists(sweep):
         print("\nflash sweep present — run: "
               "python tools/decide_flash_min_t.py %s" % sweep)
+    blocks = os.path.join(d, "bench_flash_blocks.txt")
+    if os.path.exists(blocks):
+        with open(blocks) as f:
+            for ln in f:
+                if ln.startswith("BLOCK-DECISION"):
+                    print(ln.strip())
 
 
 if __name__ == "__main__":

@@ -12,17 +12,39 @@ Usage:
 
 import argparse
 import json
+import os
+import sys
+
+
+def _device_events(trace_dir, pid):
+    """A directory entry is a jax profiler trace dir: render its device
+    XLA-op rows, named by Program-op attribution (reference
+    timeline.py:115 merges host + device streams the same way)."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from paddle_tpu.profiler import device_op_events
+
+    out = []
+    tids = {}
+    for name, ts_us, dur_us, line in device_op_events(trace_dir):
+        tid = tids.setdefault(line, len(tids))
+        out.append({"name": name, "cat": "device", "ph": "X",
+                    "pid": pid, "tid": tid, "ts": ts_us, "dur": dur_us})
+    return out
 
 
 def merge(named_paths, out_path):
     events = []
     for pid, (name, path) in enumerate(named_paths):
-        with open(path) as f:
-            trace = json.load(f)
         events.append({
             "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
             "args": {"name": name},
         })
+        if os.path.isdir(path):
+            events.extend(_device_events(path, pid))
+            continue
+        with open(path) as f:
+            trace = json.load(f)
         for ev in trace.get("traceEvents", []):
             ev = dict(ev)
             ev["pid"] = pid

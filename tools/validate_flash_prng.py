@@ -81,6 +81,28 @@ def main():
                   sm, bq, bk, False, rate, False)))(v)
     assert np.isfinite(np.asarray(g)).all()
     print("bwd grads finite ok")
+
+    # bf16 no-dropout parity ON CHIP: the r05 input-dtype matmul change
+    # (MXU bf16 rate) must agree with the XLA reference within
+    # bf16-scaled bounds — fwd and all three grads
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    q4 = qb.reshape(1, qb.shape[0], qb.shape[1], qb.shape[2])
+    k4, v4 = (x.reshape(q4.shape) for x in (kb, vb))
+
+    def loss(fn):
+        return lambda a, b, c: jnp.sum(fn(a, b, c).astype(jnp.float32)
+                                       ** 2)
+
+    ok = np.asarray(FA.flash_attention(q4, k4, v4), np.float32)
+    oref = np.asarray(FA.mha_reference(q4, k4, v4), np.float32)
+    np.testing.assert_allclose(ok, oref, atol=3e-2, rtol=3e-2)
+    gk = jax.grad(loss(FA.flash_attention), argnums=(0, 1, 2))(q4, k4, v4)
+    gr = jax.grad(loss(FA.mha_reference), argnums=(0, 1, 2))(q4, k4, v4)
+    for a, b, nm in zip(gk, gr, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            atol=0.5, rtol=8e-2, err_msg="bf16 d%s" % nm)
+    print("bf16 input-dtype matmul parity ok")
     print("FLASH-PRNG-VALIDATION-OK")
 
 
