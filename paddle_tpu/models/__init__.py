@@ -8,5 +8,8 @@ from . import bert
 from . import vgg
 from . import ctr
 from . import machine_translation
+from . import se_resnext
+from . import stacked_dynamic_lstm
 
-__all__ = ["mnist", "resnet", "bert", "vgg", "ctr", "machine_translation"]
+__all__ = ["mnist", "resnet", "bert", "vgg", "ctr",
+           "machine_translation", "se_resnext", "stacked_dynamic_lstm"]
