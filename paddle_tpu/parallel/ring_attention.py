@@ -49,14 +49,19 @@ def _merge(acc, m, l, o_c, m_c, l_c):
 
 
 @functools.partial(jax.checkpoint, static_argnums=(5, 6))
-def _chunk_scores(q32, kc, vc, bias_c, col0_row0, sm_scale, causal):
+def _chunk_scores(q, kc, vc, bias_c, col0_row0, sm_scale, causal):
     """(unnormalized out, rowmax, rowsum) of local Q against one K/V chunk.
 
-    q32 [B,H,Tq,D] f32; kc/vc [B,H,Tc,D]; bias_c [B,Tc] or None;
-    col0_row0 = (global col offset of this chunk, global row offset of Q).
+    q [B,H,Tq,D]; kc/vc [B,H,Tc,D] (input dtype — the matmuls run at
+    the MXU's native rate with f32 ACCUMULATION, the same input-dtype
+    policy as the flash kernel: bf16 QK^T is bit-identical to
+    upcast-then-f32, and PV downcasts the probabilities); bias_c
+    [B,Tc] or None; col0_row0 = (global col offset of this chunk,
+    global row offset of Q).
     """
     col0, row0 = col0_row0
-    s = jnp.einsum("bhqd,bhkd->bhqk", q32, kc.astype(jnp.float32)) * sm_scale
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, kc,
+                   preferred_element_type=jnp.float32) * sm_scale
     if bias_c is not None:
         s = s + bias_c[:, None, None, :].astype(jnp.float32)
     if causal:
@@ -67,7 +72,8 @@ def _chunk_scores(q32, kc, vc, bias_c, col0_row0, sm_scale, causal):
     m_c = jnp.max(s, axis=-1)
     p = jnp.exp(s - m_c[..., None])
     l_c = jnp.sum(p, axis=-1)
-    o_c = jnp.einsum("bhqk,bhkd->bhqd", p, vc.astype(jnp.float32))
+    o_c = jnp.einsum("bhqk,bhkd->bhqd", p.astype(vc.dtype), vc,
+                     preferred_element_type=jnp.float32)
     return o_c, m_c, l_c
 
 
@@ -86,7 +92,6 @@ def ring_attention_local(q, k, v, axis_name, axis_size, bias=None,
         sm_scale = 1.0 / math.sqrt(d)
     idx = jax.lax.axis_index(axis_name)
     row0 = idx * tl
-    q32 = q.astype(jnp.float32)
 
     perm = [(i, (i + 1) % n) for i in range(n)]
 
@@ -98,7 +103,7 @@ def ring_attention_local(q, k, v, axis_name, axis_size, bias=None,
         def compute(args):
             acc, m, l = args
             o_c, m_c, l_c = _chunk_scores(
-                q32, kc, vc, bc, (col0, row0), sm_scale, causal
+                q, kc, vc, bc, (col0, row0), sm_scale, causal
             )
             return _merge(acc, m, l, o_c, m_c, l_c)
 
