@@ -38,12 +38,13 @@ class TransformForTraining:
             raise ValueError(
                 "unsupported activation_quantize_type %r"
                 % activation_quantize_type)
-        if weight_quantize_type != "abs_max":
+        if weight_quantize_type not in ("abs_max", "channel_wise_abs_max"):
             raise ValueError(
                 "unsupported weight_quantize_type %r" % weight_quantize_type)
         self.weight_bits = int(weight_bits)
         self.activation_bits = int(activation_bits)
         self.activation_quantize_type = activation_quantize_type
+        self.weight_quantize_type = weight_quantize_type
         self.moving_rate = float(moving_rate)
 
     def apply(self, program, startup_program=None):
@@ -100,9 +101,14 @@ class TransformForTraining:
         out = block.create_var(name=out_name, shape=var.shape,
                                dtype=var.dtype)
         out.stop_gradient = False
+        channel_wise = (is_weight
+                        and getattr(self, "weight_quantize_type",
+                                    "abs_max") == "channel_wise_abs_max"
+                        and var.shape and len(var.shape) >= 2)
+        scale_shape = ((var.shape[0],) if channel_wise else (1,))
         scale = block.create_var(
-            name=name + ".quant_scale", shape=(1,), dtype="float32",
-            persistable=True)
+            name=name + ".quant_scale", shape=scale_shape,
+            dtype="float32", persistable=True)
         scale.stop_gradient = True
 
         bits = self.weight_bits if is_weight else self.activation_bits
@@ -112,7 +118,9 @@ class TransformForTraining:
         if not use_ma:
             block._insert_op(
                 idx,
-                type="fake_quantize_dequantize_abs_max",
+                type="fake_channel_wise_quantize_dequantize_abs_max"
+                     if channel_wise
+                     else "fake_quantize_dequantize_abs_max",
                 inputs={"X": [name]},
                 outputs={"Out": [out_name], "OutScale": [scale.name]},
                 attrs={"bit_length": bits},
@@ -147,6 +155,7 @@ class TransformForTraining:
 _FAKE_QDQ_TYPES = (
     "fake_quantize_dequantize_abs_max",
     "fake_quantize_dequantize_moving_average_abs_max",
+    "fake_channel_wise_quantize_dequantize_abs_max",
 )
 
 
@@ -208,15 +217,25 @@ class QuantizationFreezePass:
             bits = int(op.attrs.get("bit_length", 8))
             bin_cnt = float((1 << (bits - 1)) - 1)
             if _is_weight_var(xvar):
+                channel_wise = op.type.startswith("fake_channel_wise")
                 w = np.asarray(scope.get(x_name), dtype=np.float32)
-                scale = float(np.max(np.abs(w)))
-                if scale <= 0:
-                    scale = 1e-8
-                wq = np.clip(np.round(w / scale * bin_cnt), -bin_cnt,
-                             bin_cnt).astype(np.int8)
+                if channel_wise:
+                    scale = np.abs(w.reshape(w.shape[0], -1)).max(axis=1)
+                    scale = np.maximum(scale, 1e-8)
+                    s_b = scale.reshape((-1,) + (1,) * (w.ndim - 1))
+                    wq = np.clip(np.round(w / s_b * bin_cnt), -bin_cnt,
+                                 bin_cnt).astype(np.int8)
+                    scope.set(scale_name, jnp.asarray(
+                        scale, dtype=jnp.float32))
+                else:
+                    scale = float(np.max(np.abs(w)))
+                    if scale <= 0:
+                        scale = 1e-8
+                    wq = np.clip(np.round(w / scale * bin_cnt), -bin_cnt,
+                                 bin_cnt).astype(np.int8)
+                    scope.set(scale_name,
+                              jnp.asarray([scale], dtype=jnp.float32))
                 scope.set(x_name, jnp.asarray(wq))
-                scope.set(scale_name,
-                          jnp.asarray([scale], dtype=jnp.float32))
                 from paddle_tpu import core
 
                 xvar.dtype = core.convert_np_dtype_to_dtype_("int8")
@@ -224,13 +243,22 @@ class QuantizationFreezePass:
                 if svar is not None:
                     svar.persistable = True
                 block._remove_op(i)
-                block._insert_op(
-                    i,
-                    type="fake_dequantize_max_abs",
-                    inputs={"X": [x_name], "Scale": [scale_name]},
-                    outputs={"Out": [out_name]},
-                    attrs={"max_range": bin_cnt},
-                )
+                if channel_wise:
+                    block._insert_op(
+                        i,
+                        type="fake_channel_wise_dequantize_max_abs",
+                        inputs={"X": [x_name], "Scales": [scale_name]},
+                        outputs={"Out": [out_name]},
+                        attrs={"quant_bits": [bits]},
+                    )
+                else:
+                    block._insert_op(
+                        i,
+                        type="fake_dequantize_max_abs",
+                        inputs={"X": [x_name], "Scale": [scale_name]},
+                        outputs={"Out": [out_name]},
+                        attrs={"max_range": bin_cnt},
+                    )
                 i += 1
             elif weights_only:
                 i += 1

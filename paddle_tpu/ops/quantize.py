@@ -131,6 +131,27 @@ def fake_quantize_dequantize_abs_max(ctx, attrs, X):
     return _quant_dequant(X, scale, bin_cnt), scale.reshape(1)
 
 
+@register_op("fake_channel_wise_quantize_dequantize_abs_max",
+             inputs=["X"], outputs=["Out", "OutScale"])
+def fake_channel_wise_quantize_dequantize_abs_max(ctx, attrs, X):
+    """Per-output-channel (axis 0, conv filter layout) QDQ simulation —
+    the reference's channel_wise_abs_max weight quantization
+    (fake_quantize_op.cc FakeChannelWiseQuantizeDequantizeAbsMax)."""
+    bin_cnt = _bin_cnt(attrs)
+    scale = jnp.max(jnp.abs(X.reshape(X.shape[0], -1)), axis=1)
+    s_b = scale.reshape((-1,) + (1,) * (X.ndim - 1))
+    return _quant_dequant(X, s_b, bin_cnt), scale
+
+
+@register_op("fake_channel_wise_quantize_dequantize_abs_max_grad",
+             inputs=["X", "Out", "OutScale", "Out@GRAD"],
+             outputs=["X@GRAD"], no_grad=True)
+def fake_channel_wise_qdq_abs_max_grad(ctx, attrs, X, Out, OutScale,
+                                       Out_grad):
+    # straight-through estimator (abs_max never clips interior values)
+    return Out_grad
+
+
 @register_op("fake_quantize_dequantize_abs_max_grad",
              inputs=["X", "Out", "OutScale", "Out@GRAD"],
              outputs=["X@GRAD"], no_grad=True)

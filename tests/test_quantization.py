@@ -397,3 +397,62 @@ class TestQATDataParallel:
         sharded = run(dp=True)
         np.testing.assert_allclose(sharded, single, rtol=2e-4, atol=2e-4)
         assert single[-1] < single[0]
+
+
+class TestChannelWiseQAT:
+    def test_channel_wise_weight_qat_and_freeze(self):
+        """weight_quantize_type='channel_wise_abs_max' (reference
+        fake_channel_wise_quantize_op): per-output-channel weight scales
+        through training, frozen to int8 + channel-wise dequant."""
+        fluid.unique_name.switch()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data("img", shape=[1, 8, 8],
+                                    dtype="float32")
+            label = fluid.layers.data("label", shape=[1], dtype="int64")
+            conv = fluid.layers.conv2d(img, num_filters=4, filter_size=3,
+                                       padding=1, act="relu")
+            pool = fluid.layers.pool2d(conv, pool_size=8,
+                                       pool_type="avg")
+            logits = fluid.layers.fc(pool, size=3)
+            loss = fluid.layers.mean(
+                fluid.layers.softmax_with_cross_entropy(logits, label))
+            t = QuantizationTranspiler(
+                weight_quantize_type="channel_wise_abs_max")
+            t.training_transpile(main, startup)
+            test_prog = main.clone(for_test=True)
+            fluid.optimizer.Adam(learning_rate=5e-3).minimize(loss)
+        types = [op.type for op in main.global_block().ops]
+        assert "fake_channel_wise_quantize_dequantize_abs_max" in types
+        r = np.random.RandomState(6)
+        W = r.randn(64, 3)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = Scope()
+        with scope_guard(scope):
+            exe.run(startup)
+            for _ in range(30):
+                xv = r.rand(16, 1, 8, 8).astype("float32")
+                yv = np.argmax(xv.reshape(16, -1) @ W, axis=1)[:, None]
+                exe.run(main, feed={"img": xv,
+                                    "label": yv.astype("int64")},
+                        fetch_list=[])
+            feed = {"img": xv, "label": yv.astype("int64")}
+            (l_qat,) = exe.run(test_prog, feed=feed, fetch_list=[loss])
+            t.freeze_program(test_prog, scope=scope)
+            ftypes = [op.type for op in test_prog.global_block().ops]
+            assert "fake_channel_wise_dequantize_max_abs" in ftypes
+            conv_op = next(op for op in test_prog.global_block().ops
+                           if op.type in ("conv2d", "depthwise_conv2d"))
+            w_name = conv_op.inputs["Filter"][0].rsplit(
+                ".quant_dequant", 1)[0]
+            wq = np.asarray(scope.get(w_name))
+            assert wq.dtype == np.int8
+            scales = np.asarray(scope.get(w_name + ".quant_scale"))
+            assert scales.shape == (wq.shape[0],)  # per output channel
+            # per-channel dequant reproduces the trained fake-quant
+            # weights: frozen loss == QAT-sim loss on the same batch
+            (l_frozen,) = exe.run(test_prog, feed=feed,
+                                  fetch_list=[loss])
+        np.testing.assert_allclose(
+            float(np.asarray(l_frozen).reshape(())),
+            float(np.asarray(l_qat).reshape(())), rtol=2e-2, atol=2e-2)
