@@ -39,13 +39,14 @@ class PostTrainingQuantization:
     def __init__(self, executor, program=None, feed_names=None,
                  fetch_targets=None, model_dir=None, scope=None,
                  algo="abs_max", weight_bits=8, activation_bits=8,
-                 batch_nums=None):
+                 weight_quantize_type="abs_max", batch_nums=None):
         if algo not in ("abs_max", "avg"):
             raise ValueError("algo must be abs_max or avg, got %r" % algo)
         self._exe = executor
         self._algo = algo
         self.weight_bits = int(weight_bits)
         self.activation_bits = int(activation_bits)
+        self.weight_quantize_type = weight_quantize_type
         self._batch_nums = batch_nums
         if scope is None:
             from paddle_tpu.executor import global_scope
@@ -128,7 +129,8 @@ class PostTrainingQuantization:
         TransformForTraining(
             weight_bits=self.weight_bits,
             activation_bits=self.activation_bits,
-            activation_quantize_type="abs_max").apply(program)
+            activation_quantize_type="abs_max",
+            weight_quantize_type=self.weight_quantize_type).apply(program)
         # drop the activation fake-qdq ops that transform just added —
         # PTQ uses the calibrated FIXED scales instead
         i = 0
