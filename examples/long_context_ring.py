@@ -1,0 +1,95 @@
+"""Long-context training with ring attention (sequence parallelism).
+
+The reference (2019) handles long sequences by LoD dynamic batching;
+sequence PARALLELISM is this framework's net-new TPU capability: shard
+the sequence dim over a mesh axis, rotate K/V shards around the ring
+with ``ppermute`` (compute overlaps ICI transfer), and keep per-chip
+attention memory at O(T_local^2) instead of O(T^2).
+
+This demo proves both claims without needing 8 real chips:
+
+1. **Memory**: compile full attention and ring attention at --seq 8192
+   on an 8-way virtual mesh and print XLA's own ``memory_analysis`` —
+   the ring's temp footprint drops by ~the square of the ring size.
+2. **Correctness**: run one fwd+bwd step of both at a small T and
+   check loss parity.
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python examples/long_context_ring.py --cpu
+"""
+
+import argparse
+import os
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+import _common  # noqa: E402 - repo-root path + bounded backend probe
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--seq", type=int, default=8192,
+                    help="sequence length for the memory comparison")
+    args = ap.parse_args()
+    _common.pick_backend(force_cpu=args.cpu)
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.ops.pallas.flash_attention import mha_reference
+    from paddle_tpu.parallel import ring_attention
+
+    devs = jax.devices()
+    n = len(devs)
+    mesh = Mesh(np.asarray(devs).reshape(n), ("seq",))
+    B, H, D = 1, 4, 64
+    T = args.seq - args.seq % n
+    print("mesh: %d devices on the 'seq' axis; B=%d H=%d T=%d D=%d"
+          % (n, B, H, T, D))
+
+    x = jnp.zeros((B, H, T, D), jnp.bfloat16)
+    xs = jax.device_put(x, NamedSharding(mesh, P(None, None, "seq",
+                                                 None)))
+
+    def full_loss(q):
+        return jnp.mean(mha_reference(q, q, q, causal=True)
+                        .astype(jnp.float32) ** 2)
+
+    def ring_loss(q):
+        return jnp.mean(
+            ring_attention(q, q, q, mesh, "seq", causal=True)
+            .astype(jnp.float32) ** 2)
+
+    # 1. memory: XLA's static accounting of both compiled programs
+    for name, fn, arg in (("full (one device)", full_loss, x),
+                          ("ring (%d-way)" % n, ring_loss, xs)):
+        comp = jax.jit(jax.value_and_grad(fn)).lower(arg).compile()
+        mem = comp.memory_analysis()
+        print("%-20s temp %8.1f MB  output %6.1f MB"
+              % (name, mem.temp_size_in_bytes / 1e6,
+                 mem.output_size_in_bytes / 1e6))
+
+    # 2. correctness at a runnable size
+    Ts = 64 * n
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, H, Ts, D).astype("float32"))
+    qs = jax.device_put(q, NamedSharding(mesh, P(None, None, "seq",
+                                                 None)))
+    lf, gf = jax.jit(jax.value_and_grad(full_loss))(q)
+    lr, gr = jax.jit(jax.value_and_grad(ring_loss))(qs)
+    print("loss parity @T=%d: full %.6f ring %.6f" % (Ts, float(lf),
+                                                      float(lr)))
+    np.testing.assert_allclose(float(lf), float(lr), rtol=2e-4)
+    np.testing.assert_allclose(np.asarray(gf, np.float32),
+                               np.asarray(gr, np.float32),
+                               atol=2e-3, rtol=2e-2)
+    print("gradients match; done")
+
+
+if __name__ == "__main__":
+    main()
