@@ -456,3 +456,45 @@ class TestChannelWiseQAT:
         np.testing.assert_allclose(
             float(np.asarray(l_frozen).reshape(())),
             float(np.asarray(l_qat).reshape(())), rtol=2e-2, atol=2e-2)
+
+
+class TestPTQChannelWise:
+    def test_ptq_channel_wise_weights(self, tmp_path):
+        """PostTrainingQuantization(weight_quantize_type=
+        'channel_wise_abs_max'): calibrated activations + per-channel
+        int8 weights through the same pipeline."""
+        from paddle_tpu.contrib.slim.quantization import (
+            PostTrainingQuantization)
+
+        main, startup, loss, acc, prob = _mnist_convnet()
+        with fluid.program_guard(main, startup):
+            test_prog = main.clone(for_test=True)
+            fluid.optimizer.Adam(learning_rate=2e-3).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = Scope()
+        with scope_guard(scope):
+            exe.run(startup)
+            for feed in _mnist_batches(40):
+                exe.run(main, feed=feed, fetch_list=[])
+            calib = [{"img": f["img"]} for f in _mnist_batches(4, seed=5)]
+            ptq = PostTrainingQuantization(
+                exe, program=test_prog, feed_names=["img"],
+                fetch_targets=[prob], scope=scope,
+                weight_quantize_type="channel_wise_abs_max",
+                batch_nums=4)
+            qprog = ptq.quantize(iter(calib))
+            types = [op.type for op in qprog.global_block().ops]
+            assert "fake_channel_wise_dequantize_max_abs" in types
+            conv = next(op for op in qprog.global_block().ops
+                        if op.type in ("conv2d", "depthwise_conv2d"))
+            w_name = conv.inputs["Filter"][0].rsplit(
+                ".quant_dequant", 1)[0]
+            wq = np.asarray(scope.get(w_name))
+            assert wq.dtype == np.int8
+            scales = np.asarray(scope.get(w_name + ".quant_scale"))
+            assert scales.shape == (wq.shape[0],)
+            # quantized program still classifies
+            feed = _mnist_batches(1, train=False, batch=128)[0]
+            a = float(np.asarray(exe.run(
+                qprog, feed=feed, fetch_list=[acc])[0]).reshape(-1)[0])
+            assert a > 0.5, a
