@@ -498,3 +498,12 @@ class TestPTQChannelWise:
             a = float(np.asarray(exe.run(
                 qprog, feed=feed, fetch_list=[acc])[0]).reshape(-1)[0])
             assert a > 0.5, a
+            ptq.save_quantized_model(str(tmp_path))
+        # per-channel int8 weights survive export -> AnalysisPredictor
+        from paddle_tpu.inference import AnalysisConfig, AnalysisPredictor
+
+        pred = AnalysisPredictor(AnalysisConfig(model_dir=str(tmp_path)))
+        (p,) = pred.run([feed["img"]])
+        pa = float((np.argmax(p, axis=1)
+                    == feed["label"].reshape(-1)).mean())
+        assert pa > 0.5, pa
