@@ -176,11 +176,11 @@ def test_compile_phase_steps_exist():
     (verdict #1/#2 ordering)."""
     steps = hw_suite.build_steps()
     names = [s[0] for s in steps]
-    assert names[0] == "validate_flash_prng"
-    assert names[1] == "bench_bert_default.compile"
-    assert names[2] == "bench_bert_default"
-    assert names[3] == "bench_resnet.compile"
-    assert names[4] == "bench_resnet"
+    assert names[0] == "bench_bert_default.compile"
+    assert names[1] == "bench_bert_default"
+    assert names[2] == "bench_resnet.compile"
+    assert names[3] == "bench_resnet"
+    assert names[4] == "validate_flash_prng"
     for compile_name in [n for n in names if n.endswith(".compile")]:
         base = compile_name[:-len(".compile")]
         assert base in names
