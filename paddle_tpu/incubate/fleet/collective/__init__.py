@@ -108,6 +108,23 @@ class CollectiveOptimizer(DistributedOptimizer):
             from ....contrib import mixed_precision
 
             self._optimizer = mixed_precision.decorate(self._optimizer)
+        if self._strategy and getattr(self._strategy, "use_recompute",
+                                      False):
+            # reference fleet strategy: wrap in RecomputeOptimizer with
+            # the user-listed checkpoint vars (previously this flag was
+            # silently ignored)
+            cps = getattr(self._strategy, "recompute_checkpoints",
+                          None) or []
+            if not cps:
+                raise ValueError(
+                    "DistributedStrategy.use_recompute needs "
+                    "recompute_checkpoints (the segment-boundary vars); "
+                    "alternatively build regions with "
+                    "fluid.layers.recompute()")
+            from ....optimizer import RecomputeOptimizer
+
+            self._optimizer = RecomputeOptimizer(self._optimizer)
+            self._optimizer._set_checkpoints(cps)
         ops, params_grads = self._optimizer.minimize(
             loss, startup_program, parameter_list, no_grad_set
         )

@@ -47,6 +47,7 @@ __all__ = [
     "ExponentialMovingAverage",
     "ModelAverage",
     "PipelineOptimizer",
+    "RecomputeOptimizer",
     "DGCMomentumOptimizer",
 ]
 
@@ -1337,6 +1338,119 @@ class PipelineOptimizer:
             "legacy": self._legacy_knobs,
         }
         return result
+
+
+def rewrite_program_recompute(program, checkpoints):
+    """Split the global block's forward at the checkpoint vars: each
+    interior segment of 2+ ops becomes ONE ``recompute_block`` op whose
+    grad re-runs the segment's forward under ``jax.checkpoint``
+    (``fluid.layers.recompute`` applied POST-HOC — the graph-rewrite
+    shape of the reference's RecomputeOptimizer/fleet
+    ``DistributedStrategy.use_recompute``).  Must run BEFORE
+    ``append_backward``: the rewrite moves forward ops into sub-blocks
+    and backward needs to see the region op."""
+    from .core import VarDesc
+    from .framework import Operator
+    from .ops.control_flow import sub_block_external_reads
+    from .ops.io_ops import HOST_IO_OP_TYPES
+
+    block = program.global_block()
+    if any(op.type.endswith("_grad") for op in block.ops):
+        raise RuntimeError(
+            "rewrite_program_recompute must run before append_backward/"
+            "minimize (backward needs to see the recompute regions)")
+    cps = {getattr(c, "name", c) for c in checkpoints}
+    missing = [c for c in cps
+               if block._find_var_recursive(c) is None]
+    if missing:
+        raise ValueError("checkpoint vars %s not found in the program"
+                         % sorted(missing))
+    unwrappable = ("feed", "fetch") + HOST_IO_OP_TYPES
+    segments, cur = [], []
+    for op in block.ops:
+        if op.type in unwrappable:
+            if cur:
+                segments.append(cur)
+                cur = []
+            segments.append([op])
+            continue
+        cur.append(op)
+        if cps & set(op.output_arg_names):
+            segments.append(cur)
+            cur = []
+    if cur:
+        segments.append(cur)
+    new_ops = []
+    n_wrapped = 0
+    for si, seg in enumerate(segments):
+        # the tail segment (checkpoint -> loss) stays unwrapped: its
+        # activations feed the backward head directly, so wrapping it
+        # buys no memory; single-op segments aren't worth a region
+        wrap = (len(seg) >= 2 and si < len(segments) - 1
+                and all(op.type not in unwrappable for op in seg))
+        if not wrap:
+            new_ops.extend(seg)
+            continue
+        sub = program._create_block(parent_idx=0)
+        program._rollback()
+        sub.ops = list(seg)
+        for op in seg:
+            op.block = sub
+        written = []
+        for op in seg:
+            for n in op.output_arg_names:
+                if n and n not in written:
+                    written.append(n)
+        captured = [n for n in sub_block_external_reads(sub)
+                    if block._find_var_recursive(n) is not None]
+        scope_var = block.create_var(
+            name=unique_name.generate("recompute_seg") + ".scope",
+            type=VarDesc.VarType.STEP_SCOPES)
+        new_ops.append(Operator(
+            block, "recompute_block",
+            inputs={"Captured": captured},
+            outputs={"Out": written, "Scope": [scope_var.name]},
+            attrs={"sub_block": sub.idx}))
+        n_wrapped += 1
+    block.ops = new_ops
+    program._bump_version()
+    return n_wrapped
+
+
+class RecomputeOptimizer:
+    """Activation recompute as an optimizer wrapper (the fleet
+    ``DistributedStrategy.use_recompute`` contract; later-reference
+    ``fluid.optimizer.RecomputeOptimizer``): ``_set_checkpoints`` names
+    the segment boundaries, ``minimize`` rewrites the forward into
+    ``recompute_block`` regions and delegates to the inner optimizer.
+    The region-scoped alternative is ``fluid.layers.recompute()``."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        self._checkpoints = None
+
+    def _set_checkpoints(self, checkpoints):
+        self._checkpoints = list(checkpoints)
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return self._optimizer.backward(
+            loss, startup_program=startup_program,
+            parameter_list=parameter_list, no_grad_set=no_grad_set)
+
+    def apply_gradients(self, params_grads):
+        return self._optimizer.apply_gradients(params_grads)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        if not self._checkpoints:
+            raise ValueError(
+                "RecomputeOptimizer needs checkpoints: call "
+                "_set_checkpoints([...]) with the segment-boundary vars")
+        rewrite_program_recompute(loss.block.program, self._checkpoints)
+        return self._optimizer.minimize(
+            loss, startup_program=startup_program,
+            parameter_list=parameter_list, no_grad_set=no_grad_set)
 
 
 # reference short aliases

@@ -205,3 +205,98 @@ class TestRecompute:
                   for _ in range(8)]
         assert all(np.isfinite(ls)), ls
         assert ls[-1] < ls[0], ls
+
+
+class TestRecomputeOptimizer:
+    """fluid.optimizer.RecomputeOptimizer (the fleet use_recompute
+    contract): post-hoc rewrite at the checkpoint vars — interior
+    segments become recompute_block regions, training is numerically
+    identical to the unwrapped program."""
+
+    def _build(self, seed=33):
+        fluid.unique_name.switch()
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = seed
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[32], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            h1 = fluid.layers.fc(input=x, size=64, act="relu")
+            h2 = fluid.layers.fc(input=h1, size=64, act="relu")
+            h3 = fluid.layers.fc(input=h2, size=32, act="relu")
+            pred = fluid.layers.fc(input=h3, size=1)
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.square_error_cost(input=pred, label=y))
+        return main, startup, loss, [h1, h2]
+
+    def _train(self, wrap, steps=8):
+        main, startup, loss, cps = self._build()
+        with fluid.program_guard(main, startup):
+            opt = fluid.optimizer.SGD(learning_rate=0.05)
+            if wrap:
+                opt = fluid.optimizer.RecomputeOptimizer(opt)
+                opt._set_checkpoints(cps)
+            opt.minimize(loss)
+        rng = np.random.RandomState(3)
+        xb = rng.randn(8, 32).astype("float32")
+        feed = {"x": xb,
+                "y": (xb.sum(1, keepdims=True) > 0).astype("float32")}
+        sc = Scope()
+        with scope_guard(sc):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            ls = [float(np.asarray(
+                exe.run(main, feed=feed,
+                        fetch_list=[loss])[0]).reshape(-1)[0])
+                  for _ in range(steps)]
+        return main, ls
+
+    def test_rewrite_structure(self):
+        main, ls = self._train(wrap=True, steps=1)
+        types = [op.type for op in main.global_block().ops]
+        # two interior segments wrapped (up to h1, h1->h2); the tail
+        # (h2 -> loss) stays unwrapped
+        assert types.count("recompute_block") == 2
+        # forward compute ops for h1/h2 moved out of block 0
+        assert types.count("relu") == 1  # only h3's tail relu remains
+
+    def test_loss_trajectory_identical(self):
+        _, plain = self._train(wrap=False)
+        _, wrapped = self._train(wrap=True)
+        np.testing.assert_allclose(wrapped, plain, rtol=1e-6, atol=1e-7)
+        assert plain[-1] < plain[0]
+
+    def test_requires_checkpoints_and_pre_backward(self):
+        import pytest
+
+        main, startup, loss, cps = self._build()
+        with fluid.program_guard(main, startup):
+            opt = fluid.optimizer.RecomputeOptimizer(
+                fluid.optimizer.SGD(learning_rate=0.05))
+            with pytest.raises(ValueError):
+                opt.minimize(loss)
+            opt._set_checkpoints([cps[0]])
+            opt.minimize(loss)
+            # a second rewrite after backward must refuse
+            from paddle_tpu.optimizer import rewrite_program_recompute
+
+            with pytest.raises(RuntimeError):
+                rewrite_program_recompute(main, [cps[1].name])
+
+    def test_fleet_strategy_wires_recompute(self):
+        from paddle_tpu.incubate.fleet.base.role_maker import (
+            Role, UserDefinedRoleMaker)
+        from paddle_tpu.incubate.fleet.collective import (
+            CollectiveOptimizer, DistributedStrategy, fleet)
+
+        fleet.init(UserDefinedRoleMaker(current_id=0, role=Role.WORKER,
+                                        worker_num=1))
+        main, startup, loss, cps = self._build()
+        strategy = DistributedStrategy()
+        strategy.use_recompute = True
+        strategy.recompute_checkpoints = [c.name for c in cps]
+        with fluid.program_guard(main, startup):
+            opt = fleet.distributed_optimizer(
+                fluid.optimizer.SGD(learning_rate=0.05), strategy)
+            opt.minimize(loss)
+        types = [op.type for op in main.global_block().ops]
+        assert types.count("recompute_block") == 2
