@@ -104,10 +104,12 @@ class CollectiveOptimizer(DistributedOptimizer):
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
-        if self._strategy and getattr(self._strategy, "use_amp", False):
-            from ....contrib import mixed_precision
-
-            self._optimizer = mixed_precision.decorate(self._optimizer)
+        # wrapper chain built LOCALLY per call (reassigning
+        # self._optimizer would stack another AMP/recompute wrapper on
+        # every minimize); recompute sits INNER, AMP outermost, so the
+        # bf16 rewrite scans the flat graph BEFORE segments move into
+        # recompute_block sub-blocks
+        opt = self._optimizer
         if self._strategy and getattr(self._strategy, "use_recompute",
                                       False):
             # reference fleet strategy: wrap in RecomputeOptimizer with
@@ -123,15 +125,37 @@ class CollectiveOptimizer(DistributedOptimizer):
                     "fluid.layers.recompute()")
             from ....optimizer import RecomputeOptimizer
 
-            self._optimizer = RecomputeOptimizer(self._optimizer)
-            self._optimizer._set_checkpoints(cps)
-        ops, params_grads = self._optimizer.minimize(
+            opt = RecomputeOptimizer(opt)
+            opt._set_checkpoints(cps)
+        if self._strategy and getattr(self._strategy, "use_amp", False):
+            from ....contrib import mixed_precision
+
+            opt = mixed_precision.decorate(
+                opt,
+                init_loss_scaling=float(getattr(
+                    self._strategy, "amp_loss_scaling", 2 ** 15)))
+        ops, params_grads = opt.minimize(
             loss, startup_program, parameter_list, no_grad_set
         )
         program = loss.block.program
         if self._fleet is not None:
             program._num_trainers = self._fleet.worker_num()
             program._trainer_id = self._fleet.worker_index()
+        if self._strategy and getattr(self._strategy, "use_local_sgd",
+                                      False):
+            # reference strategy knob → collective.py LocalSGD:
+            # snapshot/train-local/allreduce-deltas appended after the
+            # optimizer ops (previously stored but silently ignored)
+            from ....framework import default_startup_program
+            from ....transpiler.collective import LocalSGD
+
+            LocalSGD().transpile(
+                program=program,
+                startup_program=startup_program
+                or default_startup_program(),
+                rank=getattr(program, "_trainer_id", 0),
+                nranks=getattr(program, "_num_trainers", 1),
+            )
         return ops, params_grads
 
     def main_program(self):

@@ -359,6 +359,35 @@ class ConditionalBlock:
         )
 
 
+def make_recompute_region_op_spec(parent, sub_block, scope_name):
+    """The ``recompute_block`` op contract, shared by
+    ``fluid.layers.recompute()`` and
+    ``optimizer.rewrite_program_recompute`` (one definition of the
+    Captured/Out/Scope plumbing): outputs = every name the region
+    writes; Captured = the region's closure reads that resolve in the
+    parent (they MUST be formal inputs — backward's op-path pruning and
+    the executor's external-read analysis walk input edges)."""
+    from ..ops.control_flow import sub_block_external_reads
+
+    written = []
+    for op in sub_block.ops:
+        for n in op.output_arg_names:
+            if n and n not in written:
+                written.append(n)
+    captured = [
+        n for n in sub_block_external_reads(sub_block)
+        if parent._find_var_recursive(n) is not None
+    ]
+    scope_var = parent.create_var(
+        name=scope_name, type=core.VarDesc.VarType.STEP_SCOPES)
+    return dict(
+        type="recompute_block",
+        inputs={"Captured": captured},
+        outputs={"Out": written, "Scope": [scope_var.name]},
+        attrs={"sub_block": sub_block.idx},
+    )
+
+
 class _RecomputeGuard(BlockGuard):
     """``with fluid.layers.recompute():`` — activation rematerialization
     (SURVEY §7g "remat"; beyond the v1.5 reference, which has no
@@ -398,31 +427,9 @@ class _RecomputeGuard(BlockGuard):
         for name, var in self.sub_block.vars.items():
             if parent._find_var_recursive(name) is None:
                 parent.vars[name] = var
-        written = []
-        for op in self.sub_block.ops:
-            for n in op.output_arg_names:
-                if n and n not in written:
-                    written.append(n)
-        # the captured outer reads MUST be declared as formal inputs:
-        # backward's op-path pruning and the executor's external-read
-        # analysis walk input edges, and an inputless op would orphan
-        # everything upstream of the region (params included)
-        from ..ops.control_flow import sub_block_external_reads
-
-        captured = [
-            n for n in sub_block_external_reads(self.sub_block)
-            if parent._find_var_recursive(n) is not None
-        ]
-        scope_var = parent.create_var(
-            name=self.helper.name + ".scope",
-            type=core.VarDesc.VarType.STEP_SCOPES,
-        )
-        parent.append_op(
-            type="recompute_block",
-            inputs={"Captured": captured},
-            outputs={"Out": written, "Scope": [scope_var]},
-            attrs={"sub_block": self.sub_block.idx},
-        )
+        spec = make_recompute_region_op_spec(
+            parent, self.sub_block, self.helper.name + ".scope")
+        parent.append_op(**spec)
         return True
 
 

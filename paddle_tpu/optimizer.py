@@ -1349,9 +1349,7 @@ def rewrite_program_recompute(program, checkpoints):
     ``DistributedStrategy.use_recompute``).  Must run BEFORE
     ``append_backward``: the rewrite moves forward ops into sub-blocks
     and backward needs to see the region op."""
-    from .core import VarDesc
     from .framework import Operator
-    from .ops.control_flow import sub_block_external_reads
     from .ops.io_ops import HOST_IO_OP_TYPES
 
     block = program.global_block()
@@ -1386,8 +1384,7 @@ def rewrite_program_recompute(program, checkpoints):
         # the tail segment (checkpoint -> loss) stays unwrapped: its
         # activations feed the backward head directly, so wrapping it
         # buys no memory; single-op segments aren't worth a region
-        wrap = (len(seg) >= 2 and si < len(segments) - 1
-                and all(op.type not in unwrappable for op in seg))
+        wrap = len(seg) >= 2 and si < len(segments) - 1
         if not wrap:
             new_ops.extend(seg)
             continue
@@ -1396,21 +1393,11 @@ def rewrite_program_recompute(program, checkpoints):
         sub.ops = list(seg)
         for op in seg:
             op.block = sub
-        written = []
-        for op in seg:
-            for n in op.output_arg_names:
-                if n and n not in written:
-                    written.append(n)
-        captured = [n for n in sub_block_external_reads(sub)
-                    if block._find_var_recursive(n) is not None]
-        scope_var = block.create_var(
-            name=unique_name.generate("recompute_seg") + ".scope",
-            type=VarDesc.VarType.STEP_SCOPES)
-        new_ops.append(Operator(
-            block, "recompute_block",
-            inputs={"Captured": captured},
-            outputs={"Out": written, "Scope": [scope_var.name]},
-            attrs={"sub_block": sub.idx}))
+        from .layers.control_flow import make_recompute_region_op_spec
+
+        spec = make_recompute_region_op_spec(
+            block, sub, unique_name.generate("recompute_seg") + ".scope")
+        new_ops.append(Operator(block, **spec))
         n_wrapped += 1
     block.ops = new_ops
     program._bump_version()
@@ -1432,22 +1419,29 @@ class RecomputeOptimizer:
     def _set_checkpoints(self, checkpoints):
         self._checkpoints = list(checkpoints)
 
+    def _apply_rewrite(self, loss):
+        if not self._checkpoints:
+            raise ValueError(
+                "RecomputeOptimizer needs checkpoints: call "
+                "_set_checkpoints([...]) with the segment-boundary vars")
+        rewrite_program_recompute(loss.block.program, self._checkpoints)
+
     def backward(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None, callbacks=None):
+        # the rewrite lives HERE so the decomposed backward() +
+        # apply_gradients() path recomputes too, not only minimize()
+        self._apply_rewrite(loss)
         return self._optimizer.backward(
             loss, startup_program=startup_program,
-            parameter_list=parameter_list, no_grad_set=no_grad_set)
+            parameter_list=parameter_list, no_grad_set=no_grad_set,
+            callbacks=callbacks)
 
     def apply_gradients(self, params_grads):
         return self._optimizer.apply_gradients(params_grads)
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
                  no_grad_set=None):
-        if not self._checkpoints:
-            raise ValueError(
-                "RecomputeOptimizer needs checkpoints: call "
-                "_set_checkpoints([...]) with the segment-boundary vars")
-        rewrite_program_recompute(loss.block.program, self._checkpoints)
+        self._apply_rewrite(loss)
         return self._optimizer.minimize(
             loss, startup_program=startup_program,
             parameter_list=parameter_list, no_grad_set=no_grad_set)

@@ -152,13 +152,23 @@ class DistributeTranspiler:
                     rank=trainer_id, nranks=self.trainers,
                 )
             return
-        if mode in ("nccl2", "grad_allreduce", "collective"):
+        if mode in ("nccl2", "grad_allreduce", "collective", "local_sgd"):
             # topology recorded on the program; mesh construction and
             # collective insertion happen at jit time (GSPMD) — the
             # gen_nccl_id bootstrap is subsumed by jax.distributed
             program._trainer_id = trainer_id
             program._num_trainers = self.trainers
-            if mode in ("grad_allreduce", "collective"):
+            if mode == "local_sgd":
+                # reference _transpile_collective(collective_mode=
+                # 'local_sgd') → collective.py LocalSGD: snapshot params,
+                # train locally, allreduce the deltas each step
+                from .collective import LocalSGD
+
+                LocalSGD().transpile(
+                    program=program, startup_program=startup_program,
+                    rank=trainer_id, nranks=self.trainers,
+                )
+            elif mode in ("grad_allreduce", "collective"):
                 from .collective import GradAllReduce
 
                 GradAllReduce().transpile(
@@ -168,7 +178,7 @@ class DistributeTranspiler:
             return
         raise ValueError(
             "unknown transpiler mode %r: supported are pserver, nccl2, "
-            "grad_allreduce, collective" % (mode,)
+            "grad_allreduce, collective, local_sgd" % (mode,)
         )
 
     def get_trainer_program(self, wait_port=True):

@@ -300,3 +300,109 @@ class TestRecomputeOptimizer:
             opt.minimize(loss)
         types = [op.type for op in main.global_block().ops]
         assert types.count("recompute_block") == 2
+
+
+class TestRecomputeComposition:
+    def test_amp_plus_recompute_casts_inside_regions(self):
+        """fleet use_amp + use_recompute: AMP sits OUTERMOST so the bf16
+        rewrite runs before segments move — the recompute sub-blocks
+        must contain cast ops (previously the wrapped body silently
+        stayed fp32)."""
+        from paddle_tpu.incubate.fleet.collective import (
+            CollectiveOptimizer, DistributedStrategy)
+
+        fluid.unique_name.switch()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[32], dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+            h1 = fluid.layers.fc(input=x, size=64, act="relu")
+            h2 = fluid.layers.fc(input=h1, size=64, act="relu")
+            pred = fluid.layers.fc(input=h2, size=1)
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.square_error_cost(input=pred, label=y))
+            strategy = DistributedStrategy()
+            strategy.use_amp = True
+            strategy.use_recompute = True
+            strategy.recompute_checkpoints = [h1.name]
+            opt = CollectiveOptimizer(
+                fluid.optimizer.SGD(learning_rate=0.05), strategy)
+            opt.minimize(loss, startup_program=startup)
+        types0 = [op.type for op in main.global_block().ops]
+        assert "recompute_block" in types0
+        rc = next(op for op in main.global_block().ops
+                  if op.type == "recompute_block")
+        sub = main.blocks[rc.attrs["sub_block"]]
+        sub_types = [op.type for op in sub.ops]
+        assert "cast" in sub_types, sub_types  # bf16 AMP reached inside
+        # and the program still trains
+        from paddle_tpu.executor import Scope, scope_guard
+
+        rng = np.random.RandomState(1)
+        xb = rng.randn(8, 32).astype("float32")
+        feed = {"x": xb,
+                "y": (xb.sum(1, keepdims=True) > 0).astype("float32")}
+        with scope_guard(Scope()):
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            ls = [float(np.asarray(exe.run(
+                main, feed=feed, fetch_list=[loss])[0]).reshape(-1)[0])
+                for _ in range(6)]
+        assert all(np.isfinite(ls)) and ls[-1] < ls[0], ls
+
+    def test_repeat_minimize_does_not_stack_wrappers(self):
+        """Two minimize() calls (train + a second program) must not
+        stack AMP/recompute wrappers or leak first-call checkpoints."""
+        from paddle_tpu.incubate.fleet.collective import (
+            CollectiveOptimizer, DistributedStrategy)
+
+        def build():
+            fluid.unique_name.switch()
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data(name="x", shape=[8],
+                                      dtype="float32")
+                h = fluid.layers.fc(input=x, size=8, act="relu")
+                pred = fluid.layers.fc(input=h, size=1)
+                loss = fluid.layers.reduce_mean(
+                    fluid.layers.square(pred))
+            return main, startup, loss, h
+
+        strategy = DistributedStrategy()
+        strategy.use_recompute = True
+        inner = fluid.optimizer.SGD(learning_rate=0.05)
+        opt = CollectiveOptimizer(inner, strategy)
+
+        main1, startup1, loss1, h1 = build()
+        strategy.recompute_checkpoints = [h1.name]
+        with fluid.program_guard(main1, startup1):
+            opt.minimize(loss1, startup_program=startup1)
+        assert opt._optimizer is inner  # no wrapper stacking
+
+        main2, startup2, loss2, h2 = build()
+        strategy.recompute_checkpoints = [h2.name]  # fresh checkpoints
+        with fluid.program_guard(main2, startup2):
+            opt.minimize(loss2, startup_program=startup2)
+        for prog in (main1, main2):
+            types = [op.type for op in prog.global_block().ops]
+            assert types.count("recompute_block") == 1
+
+    def test_decomposed_backward_applies_rewrite(self):
+        """The API.spec backward()/apply_gradients() decomposition must
+        recompute too (previously backward() silently skipped the
+        rewrite)."""
+        fluid.unique_name.switch()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+            h = fluid.layers.fc(input=x, size=8, act="relu")
+            pred = fluid.layers.fc(input=h, size=1)
+            loss = fluid.layers.reduce_mean(fluid.layers.square(pred))
+            opt = fluid.optimizer.RecomputeOptimizer(
+                fluid.optimizer.SGD(learning_rate=0.05))
+            opt._set_checkpoints([h])
+            pg = opt.backward(loss)
+            opt.apply_gradients(pg)
+        types = [op.type for op in main.global_block().ops]
+        assert "recompute_block" in types
+        assert any(t == "sgd" for t in types)
