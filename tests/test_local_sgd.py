@@ -117,3 +117,49 @@ def test_fleet_use_local_sgd_knob():
     assert "c_allreduce_sum" in types
     assert any(n.endswith("@SNAPSHOT")
                for n in main.global_block().vars)
+
+
+class TestLocalSGDDeltaAverageUnderPsum:
+    """shard_map 2-worker oracle (the geo-SGD test's pattern): diverged
+    workers must land on the delta-average after the LocalSGD tail runs
+    with a REAL psum."""
+
+    def test_diverged_workers_average(self):
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+        import jax
+
+        from paddle_tpu.executor import _run_ops_into_env
+        from paddle_tpu.ops import registry as op_registry
+        from paddle_tpu.transpiler.collective import LocalSGD
+
+        fluid.unique_name.switch()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            fluid.layers.create_parameter([4], "float32", name="w")
+        LocalSGD().transpile(program=main, startup_program=startup,
+                             rank=0, nranks=2)
+        block = main.global_block()
+        mesh = Mesh(np.array(__import__("jax").devices()[:2]),
+                    ("workers",))
+
+        def per_worker(w, snap):
+            ctx = op_registry.LoweringContext(mode="train")
+            ctx.collective_axis = "workers"
+            env = {"w": w[0], "w@SNAPSHOT": snap[0]}
+            _run_ops_into_env(block, env, ctx)
+            return env["w"][None], env["w@SNAPSHOT"][None]
+
+        f = shard_map(per_worker, mesh=mesh,
+                      in_specs=(P("workers"), P("workers")),
+                      out_specs=(P("workers"), P("workers")))
+        snap = np.tile(np.arange(4, dtype="float32"), (2, 1))
+        # locally-trained params drifted by -1 and -3 from the snapshot
+        w = snap - np.array([[1.0], [3.0]], "float32")
+        w2, s2 = (np.asarray(v) for v in
+                  f(jnp.asarray(w), jnp.asarray(snap)))
+        # delta = snap - w = (+1, +3); mean 2 → w = snap - 2 on BOTH
+        np.testing.assert_allclose(w2, snap - 2.0)
+        # snapshot re-arms to the synced params
+        np.testing.assert_allclose(s2, w2)
