@@ -74,7 +74,15 @@ def peak_flops(device):
     return V5E_BF16_PEAK
 
 
-RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 4.09e9  # fwd 4.09 GFLOP @224^2, bwd 2x
+# fwd = 4.09 GMACs @224^2 (the standard torchvision/fvcore count, which
+# counts multiply-accumulates) = 8.18 GFLOP; train = 3x fwd (bwd = 2x).
+# The first r05 hardware capture's MFU cross-check caught this constant
+# treating MACs as FLOPs (analytic 0.101 vs xla 0.308).  The residual
+# analytic-vs-xla gap after the fix is real: XLA's cost model counts the
+# padding/dilation zeros the MXU physically multiplies in stride-2
+# backward convs (hardware FLOPs > model FLOPs), so for conv nets
+# mfu_xla is expected ~1.5x mfu_analytic; MFU reports the model count.
+RESNET50_TRAIN_FLOPS_PER_IMAGE = 3 * 2 * 4.09e9
 
 
 def _is_tpu_platform(platform):
@@ -202,23 +210,30 @@ def _xla_flops_per_step(scope, feed):
 
 
 def _mfu_fields(mfu_analytic, steps_per_sec, xla_flops, peak,
-                warn=True):
-    """Extra JSON fields carrying both MFU accountings; flags >10%
-    disagreement (drivers read metric/value/unit, extra keys ride
-    along).  warn=False for the CPU smoke models, whose analytic count
+                warn=True, band=(0.90, 1.10)):
+    """Extra JSON fields carrying both MFU accountings; flags
+    disagreement when mfu_xla falls outside ``band`` × mfu_analytic
+    (drivers read metric/value/unit, extra keys ride along).
+    warn=False for the CPU smoke models, whose analytic count
     deliberately omits vector-op FLOPs that only matter at tiny scale —
     the fields still record both numbers, the loud audit line fires only
-    for the real benchmark models."""
+    for the real benchmark models.  Conv nets pass a wider band: XLA's
+    cost model counts the padding/dilation zeros the MXU physically
+    multiplies in stride-2 backward convs, so hardware FLOPs run
+    ~1.5x the model count there by design, not by bug."""
     fields = {"mfu_analytic": round(mfu_analytic, 4)}
     if xla_flops:
         mfu_xla = steps_per_sec * xla_flops / peak
         fields["mfu_xla"] = round(mfu_xla, 4)
-        if mfu_analytic > 0 and abs(mfu_xla / mfu_analytic - 1.0) > 0.10:
+        ratio = mfu_xla / mfu_analytic if mfu_analytic > 0 else 1.0
+        if not band[0] <= ratio <= band[1]:
             fields["mfu_disagree"] = True
             if warn:
                 print("# MFU CROSS-CHECK DISAGREEMENT: analytic %.4f vs "
-                      "xla-cost-model %.4f (>10%%) — audit the FLOPs count"
-                      % (mfu_analytic, mfu_xla), flush=True)
+                      "xla-cost-model %.4f (ratio %.2f outside [%.2f, "
+                      "%.2f]) — audit the FLOPs count"
+                      % (mfu_analytic, mfu_xla, ratio, band[0], band[1]),
+                      flush=True)
     return fields
 
 
@@ -251,6 +266,9 @@ def child_resnet():
     dev = jax.devices()[0]
     on_tpu = _is_tpu_platform(dev.platform)
     batch = 64 if on_tpu else 4
+    bs_env = os.environ.get("PADDLE_BENCH_RESNET_BS")
+    if bs_env:
+        batch = int(bs_env)
     warmup, steps = 3, (60 if on_tpu else 3)
     size = 224 if on_tpu else 32
     main_prog, startup, feeds, loss, acc = resnet.build(
@@ -286,7 +304,8 @@ def child_resnet():
         xla_flops = _xla_flops_per_step(scope, feed)
     if xla_flops:
         line.update(_mfu_fields(mfu, steps * iters / dt, xla_flops,
-                                peak_flops(dev), warn=on_tpu))
+                                peak_flops(dev), warn=on_tpu,
+                                band=(0.95, 1.9)))
         print(json.dumps(line), flush=True)
 
 
@@ -342,13 +361,19 @@ def child_bert(seq_len=128):
         cfg = bert.BERT_TINY  # CPU smoke: prove the path, not the chip
         seq_len = min(seq_len, 128)
     batch = (64 if seq_len <= 128 else 16) if on_tpu else 8
+    # A/B knob: PADDLE_BENCH_MAX_PRED=0 → legacy all-position MLM head
+    # (more vocab-matmul FLOPs, the r02 configuration); unset → the
+    # masked-gather default.  MFU denominator follows the choice.
+    mp_env = os.environ.get("PADDLE_BENCH_MAX_PRED")
+    max_pred = int(mp_env) if mp_env not in (None, "") else None
     # the timed window ends with one loss fetch; through the axon tunnel a
     # fetch costs ~67ms of pure roundtrip latency, so the window must be
     # long enough to amortize it (real training fetches metrics rarely)
     warmup, steps = 3, 100 if on_tpu else 5
 
     main_prog, startup, feed_names, loss = bert.build_pretrain(
-        cfg, seq_len=seq_len, lr=1e-4, amp=True, train=True
+        cfg, seq_len=seq_len, lr=1e-4, amp=True, train=True,
+        max_pred=max_pred,
     )
     exe = fluid.Executor(fluid.TPUPlace())
     exe.run(startup)
@@ -360,7 +385,7 @@ def child_bert(seq_len=128):
     run_prog, steps, iters = _wrap_iters_per_run(main_prog, loss, steps)
 
     rng = np.random.RandomState(0)
-    feed = bert.make_fake_batch(batch, seq_len, cfg, rng)
+    feed = bert.make_fake_batch(batch, seq_len, cfg, rng, max_pred=max_pred)
     # stage the batch on device once: a real input pipeline prefetches
     # batches ahead of the step (SURVEY §7 input-pipeline overlap), so the
     # timed loop should not pay per-step H2D latency for an identical batch
@@ -369,7 +394,8 @@ def child_bert(seq_len=128):
     dt = _timed_steps(exe, run_prog, feed, loss, warmup, steps)
 
     tokens_per_sec = batch * seq_len * steps * iters / dt
-    flops_per_token = model_train_flops_per_token(cfg, seq_len)
+    flops_per_token = model_train_flops_per_token(cfg, seq_len,
+                                                  max_pred=max_pred)
     mfu = tokens_per_sec * flops_per_token / peak_flops(dev)
 
     if not on_tpu:
@@ -382,9 +408,11 @@ def child_bert(seq_len=128):
     line = {
         "metric": metric,
         "value": round(tokens_per_sec, 1),
-        "unit": "tokens/sec/chip (seq%d bs%d bf16 AMP%s, MFU %.3f on %s)"
+        "unit": "tokens/sec/chip (seq%d bs%d bf16 AMP%s%s, MFU %.3f on %s)"
                 % (seq_len, batch,
                    " ipr%d" % iters if iters > 1 else "",
+                   ("" if max_pred is None else
+                    " fullhead" if max_pred == 0 else " mp%d" % max_pred),
                    mfu, getattr(dev, "device_kind", str(dev))),
         "vs_baseline": round(mfu / bar, 3),
     }

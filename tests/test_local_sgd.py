@@ -141,8 +141,7 @@ class TestLocalSGDDeltaAverageUnderPsum:
         LocalSGD().transpile(program=main, startup_program=startup,
                              rank=0, nranks=2)
         block = main.global_block()
-        mesh = Mesh(np.array(__import__("jax").devices()[:2]),
-                    ("workers",))
+        mesh = Mesh(np.array(jax.devices()[:2]), ("workers",))
 
         def per_worker(w, snap):
             ctx = op_registry.LoweringContext(mode="train")

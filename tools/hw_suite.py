@@ -89,18 +89,33 @@ def build_steps():
     # only silicon can test it; gates trust in the flash lines below
     steps.append(("validate_flash_prng",
                   [py, "tools/validate_flash_prng.py"], 420, None))
-    # seq512: the flash kernel's own regime (verdict #4)
-    item("bench_bert512", "bert512", 300, 300)
+    # K-steps-per-dispatch A/B (tunnel roundtrip amortization) — the
+    # prime suspect for the analytic-vs-wall gap, so it runs first among
+    # the A/Bs; its compile wraps 25 steps in one scan (heavier)
+    item("bench_bert_ipr25", "bert", 420, 300,
+         PADDLE_BENCH_ITERS_PER_RUN="25")
     # flash kernel at T=128 WITH in-kernel dropout: if this beats the
     # default (XLA fallback) line, MIN_T drops to 128 for dropout graphs
     item("bench_bert_flash128", "bert", 300, 300,
          PADDLE_TPU_FLASH_MIN_T="128")
-    # K-steps-per-dispatch A/B (tunnel roundtrip amortization)
-    item("bench_bert_ipr25", "bert", 300, 300,
-         PADDLE_BENCH_ITERS_PER_RUN="25")
     # fused-Adam confirmation A/B (default flipped OFF in r04)
     item("bench_fused_adam_on", "bert", 300, 300,
          PADDLE_TPU_FUSE_ADAM="1")
+    # seq512: the flash kernel's own regime (verdict #4).  r05 window 1
+    # killed its compile at 300s — a flap, or genuinely slower over the
+    # tunnel; either way the cap rises
+    item("bench_bert512", "bert512", 420, 300)
+    # legacy all-position MLM head (the r02 configuration): more
+    # MXU-efficient vocab FLOPs → higher MFU, lower tok/s; captures the
+    # MFU-optimal point of the tok/s-vs-MFU tradeoff for the record
+    item("bench_bert_fullhead", "bert", 300, 300,
+         PADDLE_BENCH_MAX_PRED="0")
+    # resnet batch sweep: conv MFU usually rises with batch (deeper MXU
+    # pipelining per weight load); bs128/bs256 vs the bs64 default
+    item("bench_resnet_bs128", "resnet", 360, 300,
+         PADDLE_BENCH_RESNET_BS="128")
+    item("bench_resnet_bs256", "resnet", 420, 330,
+         PADDLE_BENCH_RESNET_BS="256")
     steps.append(("bench_profile", [py, "tools/bench_profile.py"], 700,
                   None))
     steps.append(("bench_flash_sweep", [py, "tools/bench_flash.py"], 900,
