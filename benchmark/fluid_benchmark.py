@@ -48,6 +48,11 @@ def parse_args():
                     help="profile one pass (per-op device table)")
     ap.add_argument("--no_amp", action="store_true",
                     help="disable bf16 AMP where the model supports it")
+    ap.add_argument("--require_device", action="store_true",
+                    help="exit nonzero instead of falling back to CPU "
+                         "when --device TPU does not answer (used by the "
+                         "hardware-capture suite so a tunnel flap cannot "
+                         "record a CPU run as a silicon artifact)")
     return ap.parse_args()
 
 
@@ -132,6 +137,10 @@ def main():
     else:
         up, _ = hw_suite.probe(timeout_s=60)
         if not up:
+            if args.require_device:
+                raise SystemExit(
+                    "TPU did not answer in 60s and --require_device is "
+                    "set; refusing the CPU fallback")
             print("# TPU did not answer in 60s -- falling back to CPU",
                   flush=True)
             jax.config.update("jax_platforms", "cpu")

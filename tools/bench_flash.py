@@ -19,12 +19,18 @@ import numpy as np
 
 def bench_case(T, dropout, use_kernel, B=16, H=12, D=64, steps=30,
                block_q=None, block_k=None):
+    """use_kernel: False = XLA fallback, True = our Pallas kernel,
+    "jax" = the upstream jax.experimental TPU flash kernel (no-dropout
+    comparator: how far is our kernel from the stock tuned one?)."""
     import os
 
-    os.environ["PADDLE_TPU_PALLAS"] = "auto" if use_kernel else "off"
+    jax_impl = use_kernel == "jax"
+    os.environ["PADDLE_TPU_PALLAS"] = (
+        "auto" if use_kernel and not jax_impl else "off")
     # force the kernel at EVERY T (the tool exists to re-decide the
     # default T<256 deferral, so the boundary must not gate the sweep)
-    os.environ["PADDLE_TPU_FLASH_MIN_T"] = "1" if use_kernel else "256"
+    os.environ["PADDLE_TPU_FLASH_MIN_T"] = (
+        "1" if use_kernel and not jax_impl else "256")
     for var, val in (("PADDLE_TPU_FLASH_BLOCK_Q", block_q),
                      ("PADDLE_TPU_FLASH_BLOCK_K", block_k)):
         if val is None:
@@ -46,11 +52,21 @@ def bench_case(T, dropout, use_kernel, B=16, H=12, D=64, steps=30,
                     dtype=jnp.bfloat16)
     seed = jnp.asarray([3], jnp.int32)
 
-    def loss(q, k, v):
-        o = FA.flash_attention(
-            q, k, v, dropout_rate=dropout,
-            dropout_seed=(seed if dropout else None))
-        return jnp.sum(o.astype(jnp.float32) ** 2)
+    if jax_impl:
+        from jax.experimental.pallas.ops.tpu import (
+            flash_attention as UFA,
+        )
+
+        def loss(q, k, v):
+            o = UFA.flash_attention(q, k, v,
+                                    sm_scale=1.0 / math.sqrt(D))
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+    else:
+        def loss(q, k, v):
+            o = FA.flash_attention(
+                q, k, v, dropout_rate=dropout,
+                dropout_seed=(seed if dropout else None))
+            return jnp.sum(o.astype(jnp.float32) ** 2)
 
     step = jax.jit(jax.value_and_grad(loss, argnums=(0, 1, 2)))
     l, g = step(q, k, v)   # compile
@@ -114,7 +130,10 @@ def main():
     rows = []
     for T in (128, 256, 512, 1024, 2048):
         for dropout in (0.0, 0.1):
-            for use_kernel in (False, True):
+            # "jax" = upstream stock kernel, dropout-free only — the
+            # is-our-kernel-near-SOTA comparator
+            impls = (False, True) if dropout else (False, True, "jax")
+            for use_kernel in impls:
                 try:
                     ms, mfu = bench_case(T, dropout, use_kernel)
                 except Exception as e:  # noqa: BLE001
@@ -122,15 +141,17 @@ def main():
                           % (T, dropout, use_kernel, e), flush=True)
                     continue
                 rows.append((T, dropout, use_kernel, ms, mfu))
-                print("T=%-5d drop=%.1f %-6s  %7.3f ms  attn-MFU %.3f"
+                print("T=%-5d drop=%.1f %-8s  %7.3f ms  attn-MFU %.3f"
                       % (T, dropout,
-                         "pallas" if use_kernel else "xla", ms, mfu),
+                         {False: "xla", True: "pallas",
+                          "jax": "jaxflash"}[use_kernel], ms, mfu),
                       flush=True)
     if args.csv:
         print("T,dropout,kernel,ms,mfu")
         for r in rows:
-            print("%d,%.2f,%d,%.4f,%.4f"
-                  % (r[0], r[1], int(r[2]), r[3], r[4]))
+            impl = {False: "xla", True: "pallas", "jax": "jaxflash"}[r[2]]
+            print("%d,%.2f,%s,%.4f,%.4f"
+                  % (r[0], r[1], impl, r[3], r[4]))
 
 
 if __name__ == "__main__":

@@ -98,6 +98,12 @@ def build_steps():
     # default (XLA fallback) line, MIN_T drops to 128 for dropout graphs
     item("bench_bert_flash128", "bert", 300, 300,
          PADDLE_TPU_FLASH_MIN_T="128")
+    # the combined candidate-best configuration: dispatch amortization +
+    # in-kernel-dropout flash attention at seq128.  If the single-knob
+    # A/Bs above each help, this line is the headline toward the 0.45
+    # MFU gate
+    item("bench_bert_best", "bert", 420, 300,
+         PADDLE_BENCH_ITERS_PER_RUN="25", PADDLE_TPU_FLASH_MIN_T="128")
     # fused-Adam confirmation A/B (default flipped OFF in r04)
     item("bench_fused_adam_on", "bert", 300, 300,
          PADDLE_TPU_FUSE_ADAM="1")
@@ -116,6 +122,16 @@ def build_steps():
          PADDLE_BENCH_RESNET_BS="128")
     item("bench_resnet_bs256", "resnet", 420, 330,
          PADDLE_BENCH_RESNET_BS="256")
+    # the rest of the reference's headline benchmark set
+    # (fluid_benchmark.py models), proven on silicon: examples/sec lines
+    # in the reference's own reporting format
+    for fb in ("vgg", "stacked_dynamic_lstm", "machine_translation",
+               "se_resnext"):
+        steps.append(("fb_" + fb,
+                      [py, "benchmark/fluid_benchmark.py", "--model", fb,
+                       "--batch_size", "64" if fb == "vgg" else "32",
+                       "--iterations", "30", "--require_device"],
+                      480, None))
     steps.append(("bench_profile", [py, "tools/bench_profile.py"], 700,
                   None))
     steps.append(("bench_flash_sweep", [py, "tools/bench_flash.py"], 900,
