@@ -47,3 +47,27 @@ def test_build_model_covers_all_workloads():
         assert loss.name in main.global_block().vars
         feed = feed_fn(4)
         assert isinstance(feed, dict) and feed
+
+
+def test_require_device_refuses_cpu_fallback(monkeypatch):
+    """--require_device turns the dead-tunnel CPU fallback into a
+    nonzero exit, so the hardware-capture suite can never record a CPU
+    run as a silicon artifact (hw_suite fb_* steps pass this flag)."""
+    sys.path.insert(0, os.path.join(REPO, "benchmark"))
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import importlib
+
+    import pytest
+
+    fb = importlib.import_module("fluid_benchmark")
+    import hw_suite
+
+    monkeypatch.setattr(hw_suite, "probe",
+                        lambda timeout_s=60: (False, "probe down"))
+    monkeypatch.setattr(
+        sys, "argv",
+        ["fluid_benchmark.py", "--model", "mnist", "--device", "TPU",
+         "--iterations", "1", "--require_device"])
+    with pytest.raises(SystemExit) as ei:
+        fb.main()
+    assert "refusing the CPU fallback" in str(ei.value)
