@@ -21,6 +21,7 @@ Prints one JSON line per workload (flagship BERT seq128 line last):
 
 import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -505,13 +506,19 @@ def _captured_hw_lines(max_age_s=24 * 3600):
     now = time.time()
     for p in arts:
         try:
-            if now - os.path.getmtime(p) > max_age_s:
-                continue
             with open(p) as f:
                 first = f.readline()
                 if not first.startswith("[watcher] rc=0"):
                     continue
                 body = f.read()
+            # capture time comes from INSIDE the artifact (git checkout
+            # resets mtime, so a fresh clone would make every committed
+            # artifact look freshly measured); legacy ts-less artifacts
+            # fall back to mtime
+            m_ts = re.search(r"\bts=(\d+)", first)
+            ts = int(m_ts.group(1)) if m_ts else os.path.getmtime(p)
+            if now - ts > max_age_s:
+                continue
         except OSError:
             continue
         for ln in body.splitlines():
@@ -631,9 +638,9 @@ def main():
         captured = _captured_hw_lines()
         for l in captured:
             print(json.dumps(l), flush=True)
-        if any(l.get("metric") == FLAGSHIP_METRIC for l in captured):
-            flagship_line = [l for l in captured
-                             if l.get("metric") == FLAGSHIP_METRIC][-1]
+            if l.get("metric") == FLAGSHIP_METRIC:
+                flagship_line = l  # unique per metric by construction
+        if flagship_line is not None:
             print(json.dumps(flagship_line), flush=True)
         else:
             print(json.dumps({

@@ -116,6 +116,11 @@ def build_steps():
     # MFU-optimal point of the tok/s-vs-MFU tradeoff for the record
     item("bench_bert_fullhead", "bert", 300, 300,
          PADDLE_BENCH_MAX_PRED="0")
+    # fullhead + dispatch amortization: the MFU-maximal candidate (the
+    # r02 0.421 configuration plus every r04/r05 fix plus ipr25) — the
+    # arm most likely to cross the 0.45 gate
+    item("bench_bert_fullhead_ipr", "bert", 420, 300,
+         PADDLE_BENCH_MAX_PRED="0", PADDLE_BENCH_ITERS_PER_RUN="25")
     # resnet batch sweep: conv MFU usually rises with batch (deeper MXU
     # pipelining per weight load); bs128/bs256 vs the bs64 default
     item("bench_resnet_bs128", "resnet", 360, 300,
@@ -226,8 +231,12 @@ def run_window(steps, out_dir=OUT, probe=None, runner=bounded,
                  % (name, cap, attempts[name]))
             t_step = time.time()
             rc, out = runner(argv, cap, extra)
+            # ts= travels INSIDE the artifact: git checkout resets
+            # mtime, so freshness checks (bench.py _captured_hw_lines)
+            # must not trust the filesystem
             with open(os.path.join(out_dir, name + ".txt"), "w") as f:
-                f.write("[watcher] rc=%s\n%s" % (rc, out))
+                f.write("[watcher] rc=%s ts=%d\n%s"
+                        % (rc, int(time.time()), out))
             note("%s rc=%s in %.0fs" % (name, rc, time.time() - t_step))
             if rc == 0:
                 break

@@ -24,7 +24,9 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import hw_suite  # noqa: E402
 
-POLL_S = 240
+POLL_S = 120  # down-probe already burns its 100s timeout; a short sleep
+# keeps worst-case window discovery ~3.7 min (r05 window 1 was 17 min
+# total — discovery latency is real capture time)
 MAX_WATCH_S = 11 * 3600
 
 
