@@ -234,9 +234,11 @@ class AnalysisPredictor:
     def program(self):
         return self._program
 
-    def run(self, inputs):
+    def run(self, inputs, return_numpy=True):
         """inputs: list of numpy arrays in get_input_names() order (or a
-        dict name→array).  Returns list of numpy arrays."""
+        dict name→array).  Returns list of numpy arrays; with
+        return_numpy=False, device arrays (no host sync — serving-style
+        callers can pipeline batches and block once at the end)."""
         if isinstance(inputs, dict):
             feed = dict(inputs)
         else:
@@ -249,7 +251,10 @@ class AnalysisPredictor:
             feed = dict(zip(self._feed_names, inputs))
         with scope_guard(self._scope):
             outs = self._exe.run(self._program, feed=feed,
-                                 fetch_list=self._fetch_vars)
+                                 fetch_list=self._fetch_vars,
+                                 return_numpy=return_numpy)
+        if not return_numpy:
+            return list(outs)
         return [np.asarray(o) for o in outs]
 
 

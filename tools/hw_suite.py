@@ -127,6 +127,9 @@ def build_steps():
          PADDLE_BENCH_RESNET_BS="128")
     item("bench_resnet_bs256", "resnet", 420, 330,
          PADDLE_BENCH_RESNET_BS="256")
+    # inference headline: resnet50 through save_inference_model +
+    # AnalysisPredictor (the reference's infer comparison class)
+    item("bench_infer", "infer", 360, 300)
     # the rest of the reference's headline benchmark set
     # (fluid_benchmark.py models), proven on silicon: examples/sec lines
     # in the reference's own reporting format
