@@ -169,6 +169,7 @@ class AnalysisConfig:
         self.prog_file = prog_file
         self.params_file = params_file
         self._ir_optim = True
+        self._bf16 = False
         self._pass_builder = PassBuilder()
 
     def switch_ir_optim(self, flag=True):
@@ -176,6 +177,17 @@ class AnalysisConfig:
 
     def ir_optim(self):
         return self._ir_optim
+
+    def enable_bf16(self, flag=True):
+        """bf16 the loaded graph AFTER the analysis passes (reference
+        analogue: ``EnableMkldnnBfloat16`` in later reference versions).
+        Order matters: rewriting before conv+bn folding would insert
+        f32 casts between conv and bn (bn is AMP-black-listed) and
+        defeat the fold's producer-pattern match."""
+        self._bf16 = bool(flag)
+
+    def bf16_enabled(self):
+        return self._bf16
 
     def pass_builder(self):
         """Mutable pipeline (reference AnalysisConfig::pass_builder)."""
@@ -220,6 +232,10 @@ class AnalysisPredictor:
                 program = Analyzer(config.pass_builder()).run(
                     program, scope=self._scope,
                     targets=[v.name for v in fetch_vars])
+            if config.bf16_enabled():
+                from .contrib.mixed_precision import rewrite_program_bf16
+
+                rewrite_program_bf16(program)
         self._program = program
         self._feed_names = feed_names
         self._fetch_vars = fetch_vars

@@ -252,3 +252,67 @@ def test_gn_resize_model_inference_roundtrip(tmp_path):
     # tolerance as test_predictor_runs_analysis_pipeline
     np.testing.assert_allclose(np.asarray(pred_out), direct, rtol=1e-4,
                                atol=1e-5)
+
+
+def test_predictor_run_return_numpy_false(tmp_path):
+    """return_numpy=False returns device arrays without a host sync —
+    the serving-style pipelining contract bench.py's inference
+    benchmark relies on (block once at the end)."""
+    import jax
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        out = fluid.layers.fc(x, size=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    d = str(tmp_path / "m")
+    with scope_guard(Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["x"], [out], exe,
+                                      main_program=main)
+    pred = create_paddle_predictor(AnalysisConfig(d))
+    xv = np.arange(8, dtype="float32").reshape(2, 4)
+    outs = [pred.run([xv], return_numpy=False) for _ in range(3)]
+    jax.block_until_ready(outs)
+    (ref,) = pred.run([xv])
+    for o in outs:
+        assert not isinstance(o[0], np.ndarray)
+        np.testing.assert_allclose(np.asarray(o[0]), ref, rtol=1e-6)
+
+
+def test_analysis_config_enable_bf16_after_fold(tmp_path):
+    """enable_bf16 rewrites AFTER the analysis passes: conv+bn folding
+    must see the clean conv->bn producer chain (a pre-export bf16
+    rewrite would cast-sandwich every bn and defeat the fold — the
+    bench.py inference-headline bug this switch exists to prevent)."""
+    from paddle_tpu.models.resnet import resnet_cifar10
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[3, 32, 32],
+                                dtype="float32")
+        logits = resnet_cifar10(img, 10, 20, is_test=True)
+    d = str(tmp_path / "m")
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["img"], [logits], exe,
+                                      main_program=main)
+    x = np.random.RandomState(0).randn(2, 3, 32, 32).astype("float32")
+
+    ref_pred = create_paddle_predictor(AnalysisConfig(d))
+    (ref,) = ref_pred.run([x])
+
+    cfg = AnalysisConfig(d)
+    cfg.enable_bf16()
+    pred = create_paddle_predictor(cfg)
+    ops = [op.type for op in pred.program.global_block().ops]
+    assert ops.count("batch_norm") == 0, "fold defeated by bf16 casts"
+    assert ops.count("cast") > 0, "bf16 rewrite missing"
+    (got,) = pred.run([x])
+    # bf16 numerics, scale-relative: error accumulates over 20 bf16
+    # conv layers (near-zero logit elements make elementwise-relative
+    # meaningless) — far outside fp32 noise (proves the bf16 graph
+    # actually executed), far inside correctness tolerance
+    err = np.abs(got.astype("float32") - ref).max() / np.abs(ref).max()
+    assert 1e-6 < err < 0.05, err
