@@ -44,18 +44,54 @@ def run_and_trace(cfg_kw=None, batch=64, seq_len=128, steps=5):
         jax.profiler.stop_trace()
 
 
+# op-type keyword → optimization category: one glance at the captured
+# artifact names the biggest lever (CATEGORY lines are grep-able)
+_CATEGORIES = (
+    ("loss", ("cross_entropy", "label_smooth")),
+    ("attention", ("multihead", "softmax", "flash", "matmul")),
+    ("optimizer", ("adam", "sgd", "momentum", "scale", "sum",
+                   "lamb", "clip")),
+    ("norm", ("layer_norm", "batch_norm", "group_norm")),
+    ("dropout", ("dropout",)),
+    ("matmul/conv", ("mul", "fc", "conv", "lookup", "gather")),
+    ("elementwise", ("elementwise", "cast", "relu", "gelu", "tanh",
+                     "add", "reshape", "transpose")),
+)
+
+
+def _categorize(table):
+    cats = {}
+    total = 0.0
+    for name, (calls, tot, mx, mn) in table.items():
+        # device_op_stats keys are BARE op types (attribute_op_name
+        # strips the pd<i>_ scope prefix): 'layer_norm', 'matmul', ...
+        cat = next((c for c, keys in _CATEGORIES
+                    if any(k in name for k in keys)), "other")
+        cats[cat] = cats.get(cat, 0.0) + tot
+        total += tot
+    for cat, t in sorted(cats.items(), key=lambda kv: -kv[1]):
+        print("CATEGORY %-14s %10.3f ms  %5.1f%%"
+              % (cat, t, 100.0 * t / total if total else 0.0),
+              flush=True)
+
+
 def analyze():
     # parse the xplane directly (xplane_top_ops): this image's
     # tensorboard_plugin_profile is incompatible with both its protobuf
     # (needs PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION=python) and its TF
     # pywrap (no xspace_to_tools_data) — found pre-staging the hardware
     # run; the direct parser needs neither
-    from xplane_top_ops import by_program_op, top_ops
+    from xplane_top_ops import top_ops
+
+    from paddle_tpu.profiler import device_op_stats, _print_device_op_table
 
     top_ops(TRACE_DIR)  # globs + asserts the xplane itself
     # Program-op attribution (the executor's pd-scope tags): the
-    # reference-style per-op table, conv2d/fused_adam/... level
-    by_program_op(TRACE_DIR)
+    # reference-style per-op table, conv2d/fused_adam/... level —
+    # parse the xplane ONCE and feed both the table and the summary
+    table = device_op_stats(TRACE_DIR)
+    _print_device_op_table(table)
+    _categorize(table)
 
 
 if __name__ == "__main__":
