@@ -456,6 +456,9 @@ def child_bert(seq_len=128):
         cfg = bert.BERT_TINY  # CPU smoke: prove the path, not the chip
         seq_len = min(seq_len, 128)
     batch = (64 if seq_len <= 128 else 16) if on_tpu else 8
+    bs_env = os.environ.get("PADDLE_BENCH_BERT_BS")
+    if bs_env:
+        batch = int(bs_env)
     # A/B knob: PADDLE_BENCH_MAX_PRED=0 → legacy all-position MLM head
     # (more vocab-matmul FLOPs, the r02 configuration); unset → the
     # masked-gather default.  MFU denominator follows the choice.

@@ -44,29 +44,47 @@ def run_and_trace(cfg_kw=None, batch=64, seq_len=128, steps=5):
         jax.profiler.stop_trace()
 
 
-# op-type keyword → optimization category: one glance at the captured
-# artifact names the biggest lever (CATEGORY lines are grep-able)
-_CATEGORIES = (
-    ("loss", ("cross_entropy", "label_smooth")),
-    ("attention", ("multihead", "softmax", "flash", "matmul")),
-    ("optimizer", ("adam", "sgd", "momentum", "scale", "sum",
-                   "lamb", "clip")),
-    ("norm", ("layer_norm", "batch_norm", "group_norm")),
-    ("dropout", ("dropout",)),
-    ("matmul/conv", ("mul", "fc", "conv", "lookup", "gather")),
-    ("elementwise", ("elementwise", "cast", "relu", "gelu", "tanh",
-                     "add", "reshape", "transpose")),
-)
+def _category(name):
+    """Op name → optimization category.  Explicit matching, not loose
+    substrings: 'convert' must not bin as conv, 'reduce_sum' is not the
+    grad-aggregation 'sum' op, 'elementwise_mul' is not a matmul.
+    Plain 'matmul' is deliberately matmul/conv, NOT attention — the MLM
+    vocab projection shares the op type with attention scores, and the
+    per-op table cannot tell instances apart; attention here means the
+    unambiguous fused/softmax paths only."""
+    import re as _re
+
+    n = _re.sub(r"\.\d+$", "", name.lstrip("~"))
+    if "cross_entropy" in n or "label_smooth" in n:
+        return "loss"
+    if "multihead" in n or "flash" in n or n == "softmax":
+        return "attention"
+    if n in ("sum", "scale") or any(
+            k in n for k in ("adam", "sgd", "momentum", "lamb", "clip")):
+        return "optimizer"
+    if n.endswith("_norm") or "_norm_" in n:
+        return "norm"
+    if "dropout" in n:
+        return "dropout"
+    if n in ("mul", "fc") or "matmul" in n or n.startswith(
+            ("conv2d", "conv3d", "depthwise_conv", "lookup", "gather",
+             "embedding")):
+        return "matmul/conv"
+    if n.startswith(("elementwise", "cast", "convert", "relu", "gelu",
+                     "tanh", "reshape", "transpose")) or n == "add":
+        return "elementwise"
+    return "other"
 
 
 def _categorize(table):
+    """Grep-able CATEGORY lines: one glance at the captured artifact
+    names the biggest lever."""
     cats = {}
     total = 0.0
     for name, (calls, tot, mx, mn) in table.items():
         # device_op_stats keys are BARE op types (attribute_op_name
         # strips the pd<i>_ scope prefix): 'layer_norm', 'matmul', ...
-        cat = next((c for c, keys in _CATEGORIES
-                    if any(k in name for k in keys)), "other")
+        cat = _category(name)
         cats[cat] = cats.get(cat, 0.0) + tot
         total += tot
     for cat, t in sorted(cats.items(), key=lambda kv: -kv[1]):

@@ -98,6 +98,12 @@ def build_steps():
     # default (XLA fallback) line, MIN_T drops to 128 for dropout graphs
     item("bench_bert_flash128", "bert", 300, 300,
          PADDLE_TPU_FLASH_MIN_T="128")
+    # fullhead + dispatch amortization: the MFU-maximal candidate (the
+    # r02 0.421 configuration plus every r04/r05 fix plus ipr25) — the
+    # arm most likely to cross the 0.45 gate, so it outranks the rest
+    # of the A/B matrix (a short window must reach one gate candidate)
+    item("bench_bert_fullhead_ipr", "bert", 420, 300,
+         PADDLE_BENCH_MAX_PRED="0", PADDLE_BENCH_ITERS_PER_RUN="25")
     # the combined candidate-best configuration: dispatch amortization +
     # in-kernel-dropout flash attention at seq128.  If the single-knob
     # A/Bs above each help, this line is the headline toward the 0.45
@@ -111,16 +117,14 @@ def build_steps():
     # killed its compile at 300s — a flap, or genuinely slower over the
     # tunnel; either way the cap rises
     item("bench_bert512", "bert512", 420, 300)
+    # bs32 doubles tokens/step at seq512 — bs16 may under-fill the chip
+    item("bench_bert512_bs32", "bert512", 420, 300,
+         PADDLE_BENCH_BERT_BS="32")
     # legacy all-position MLM head (the r02 configuration): more
     # MXU-efficient vocab FLOPs → higher MFU, lower tok/s; captures the
     # MFU-optimal point of the tok/s-vs-MFU tradeoff for the record
     item("bench_bert_fullhead", "bert", 300, 300,
          PADDLE_BENCH_MAX_PRED="0")
-    # fullhead + dispatch amortization: the MFU-maximal candidate (the
-    # r02 0.421 configuration plus every r04/r05 fix plus ipr25) — the
-    # arm most likely to cross the 0.45 gate
-    item("bench_bert_fullhead_ipr", "bert", 420, 300,
-         PADDLE_BENCH_MAX_PRED="0", PADDLE_BENCH_ITERS_PER_RUN="25")
     # resnet batch sweep: conv MFU usually rises with batch (deeper MXU
     # pipelining per weight load); bs128/bs256 vs the bs64 default
     item("bench_resnet_bs128", "resnet", 360, 300,
