@@ -1,0 +1,85 @@
+"""Deploy a trained model for inference: export → AnalysisPredictor.
+
+The full reference-analogue serving flow (``save_inference_model`` →
+``AnalysisConfig`` → ``create_paddle_predictor``): the analysis pass
+pipeline folds conv+bn and prunes the graph, ``enable_bf16`` rewrites
+the folded graph to bf16 on TPU (order matters — see
+``AnalysisConfig.enable_bf16``), and ``run(..., return_numpy=False)``
+pipelines batches serving-style.
+
+    python examples/resnet_infer.py [--cpu] [--batch N]
+
+Reference analogue: ``paddle/fluid/inference/api`` demos +
+``benchmark/figs/resnet-infer-*.png``.
+"""
+
+import argparse
+import shutil
+import tempfile
+import time
+
+import _common  # noqa: E402 - repo-root path + bounded backend probe
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--batches", type=int, default=5)
+    args = ap.parse_args()
+
+    backend = _common.pick_backend(force_cpu=args.cpu)
+    on_tpu = backend == "tpu"
+
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu.executor import Scope, scope_guard
+    from paddle_tpu.models.resnet import resnet_cifar10
+
+    # 1. build + "train" (randomly initialized here; load_persistables
+    #    would restore a real checkpoint) and export the eval graph
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[3, 32, 32],
+                                dtype="float32")
+        logits = resnet_cifar10(img, 10, 20, is_test=True)
+        prob = fluid.layers.softmax(logits)
+    export_dir = tempfile.mkdtemp(prefix="resnet_export_")
+    exe = fluid.Executor(fluid.TPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(export_dir, ["img"], [prob], exe,
+                                      main_program=main)
+    print("exported inference model ->", export_dir)
+
+    # 2. load through the analysis pipeline
+    cfg = fluid.inference.AnalysisConfig(model_dir=export_dir)
+    if on_tpu:
+        cfg.enable_bf16()  # fold conv+bn FIRST, then bf16 the graph
+    pred = fluid.inference.create_paddle_predictor(cfg)
+    ops = [op.type for op in pred.program.global_block().ops]
+    print("analysis pipeline: %d ops, %d batch_norm left (folded), "
+          "%d casts" % (len(ops), ops.count("batch_norm"),
+                        ops.count("cast")))
+    shutil.rmtree(export_dir, ignore_errors=True)
+
+    # 3. serving loop: pipeline batches, block once
+    rng = np.random.RandomState(0)
+    batches = [rng.randn(args.batch, 3, 32, 32).astype("float32")
+               for _ in range(args.batches)]
+    (first,) = pred.run([batches[0]])  # warm the executable
+    t0 = time.perf_counter()
+    outs = [pred.run([b], return_numpy=False) for b in batches]
+    jax.block_until_ready(outs)
+    dt = time.perf_counter() - t0
+    print("top-1 of first image:", int(np.argmax(first[0])))
+    print("%d batches x %d images in %.1f ms (%.0f images/sec)"
+          % (args.batches, args.batch, dt * 1e3,
+             args.batches * args.batch / dt))
+
+
+if __name__ == "__main__":
+    main()
