@@ -383,10 +383,13 @@ def child_infer():
     lat_ms = (time.perf_counter() - t0) / lat_runs * 1e3
     assert np.isfinite(out[0]).all()
     # throughput: pipelined batches (serving style — overlap dispatch),
-    # blocked on at the end
+    # synced by a data FETCH of the last output: on the axon tunnel
+    # block_until_ready does not actually wait (bench_pure_jax.py
+    # lesson) and execution is in-order, so the final fetch closes the
+    # whole pipeline
     t0 = time.perf_counter()
     outs = [run_once(return_numpy=False) for _ in range(steps)]
-    jax.block_until_ready(outs)
+    np.asarray(outs[-1][0])
     dt = time.perf_counter() - t0
     ips = batch * steps / dt
     # fwd-only model FLOPs: 2 x 4.09 GMACs at 224^2 (see the train

@@ -33,8 +33,6 @@ def main():
     backend = _common.pick_backend(force_cpu=args.cpu)
     on_tpu = backend == "tpu"
 
-    import jax
-
     import paddle_tpu as fluid
     from paddle_tpu.executor import Scope, scope_guard
     from paddle_tpu.models.resnet import resnet_cifar10
@@ -73,7 +71,11 @@ def main():
     (first,) = pred.run([batches[0]])  # warm the executable
     t0 = time.perf_counter()
     outs = [pred.run([b], return_numpy=False) for b in batches]
-    jax.block_until_ready(outs)
+    # sync via a data FETCH of the last output: on the axon-tunnel TPU
+    # backend block_until_ready does not actually wait (see
+    # tools/bench_pure_jax.py), and execution is in-order, so fetching
+    # the final result closes the whole pipeline
+    np.asarray(outs[-1][0])
     dt = time.perf_counter() - t0
     print("top-1 of first image:", int(np.argmax(first[0])))
     print("%d batches x %d images in %.1f ms (%.0f images/sec)"
