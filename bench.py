@@ -272,17 +272,25 @@ def child_resnet():
         batch = int(bs_env)
     warmup, steps = 3, (60 if on_tpu else 3)
     size = 224 if on_tpu else 32
+    # NHWC A/B: channels-last is the TPU-native conv layout; whether
+    # XLA's internal NCHW re-layout costs real transposes is empirical
+    fmt = os.environ.get("PADDLE_BENCH_RESNET_FMT", "NCHW").upper()
+    if fmt not in ("NCHW", "NHWC"):
+        raise SystemExit("PADDLE_BENCH_RESNET_FMT must be NCHW or NHWC, "
+                         "got %r" % fmt)
     main_prog, startup, feeds, loss, acc = resnet.build(
-        dataset="imagenet" if on_tpu else "cifar10", amp=on_tpu)
+        dataset="imagenet" if on_tpu else "cifar10", amp=on_tpu,
+        data_format=fmt)
     run_prog, steps, iters = _wrap_iters_per_run(main_prog, loss, steps)
     scope = Scope()
     with scope_guard(scope):
         exe = fluid.Executor(fluid.TPUPlace())
         exe.run(startup)
         rng = np.random.RandomState(0)
+        img_shape = ((batch, 3, size, size) if fmt == "NCHW"
+                     else (batch, size, size, 3))
         feed = {
-            "img": jnp.asarray(
-                rng.randn(batch, 3, size, size).astype("float32")),
+            "img": jnp.asarray(rng.randn(*img_shape).astype("float32")),
             "label": jnp.asarray(
                 rng.randint(0, 10, (batch, 1)).astype("int64")),
         }
@@ -293,10 +301,11 @@ def child_resnet():
         "metric": "resnet50_imagenet_train_images_per_sec_per_chip"
                   if on_tpu else "resnet_cifar_smoke_images_per_sec",
         "value": round(ips, 1),
-        "unit": "images/sec/chip (%dx%d bs%d %s%s, MFU %.3f on %s)"
+        "unit": "images/sec/chip (%dx%d bs%d %s%s%s, MFU %.3f on %s)"
                 % (size, size, batch,
                    "bf16 AMP" if on_tpu else "fp32",
                    " ipr%d" % iters if iters > 1 else "",
+                   " NHWC" if fmt == "NHWC" else "",
                    mfu, getattr(dev, "device_kind", str(dev))),
         "vs_baseline": round(mfu / 0.45, 3),
     }

@@ -248,7 +248,8 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
 
 def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
            pool_padding=0, global_pooling=False, use_cudnn=True,
-           ceil_mode=False, name=None, exclusive=True):
+           ceil_mode=False, name=None, exclusive=True,
+           data_format="NCHW"):
     helper = LayerHelper("pool2d", **locals())
     out = helper.create_variable_for_type_inference(input.dtype)
     helper.append_op(
@@ -263,6 +264,7 @@ def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
             "global_pooling": global_pooling,
             "ceil_mode": ceil_mode,
             "exclusive": exclusive,
+            "data_format": data_format,
         },
     )
     return out

@@ -1,65 +1,85 @@
 """ResNet for cifar/ImageNet (reference: ``benchmark/fluid/models/resnet.py``
 — BASELINE config 2).
 
-TPU notes: NCHW layout is kept for reference parity (XLA re-lays out for the
-MXU internally); batch_norm is the framework's batch_norm op whose
-running-stat updates ride the same jitted step."""
+TPU notes: NCHW layout is the default for reference parity, but every
+builder threads ``data_format`` and the bench exposes an NHWC arm —
+channels-last is the TPU-native conv layout (the vector lane dimension),
+and whether XLA's internal re-layout of NCHW costs real transposes is
+an empirical question the hardware A/B answers (identical math either
+way: conv filters stay OIHW, BN/bias are per-channel, the head pools to
+[N,1,1,C] so the fc weight order matches — proven by
+``tests/test_models.py`` layout-parity).  batch_norm is the framework's
+batch_norm op whose running-stat updates ride the same jitted step."""
 
 import paddle_tpu as fluid
 
 
 def conv_bn_layer(input, ch_out, filter_size, stride, padding, act="relu",
-                  is_test=False):
+                  is_test=False, data_format="NCHW"):
     conv = fluid.layers.conv2d(
         input=input, num_filters=ch_out, filter_size=filter_size,
         stride=stride, padding=padding, bias_attr=False,
+        data_format=data_format,
     )
-    return fluid.layers.batch_norm(conv, act=act, is_test=is_test)
+    return fluid.layers.batch_norm(conv, act=act, is_test=is_test,
+                                   data_layout=data_format)
 
 
-def _shortcut(input, ch_in, ch_out, stride, is_test):
+def _shortcut(input, ch_in, ch_out, stride, is_test, data_format="NCHW"):
     if stride != 1 or ch_in != ch_out:
         return conv_bn_layer(input, ch_out, 1, stride, 0, act=None,
-                             is_test=is_test)
+                             is_test=is_test, data_format=data_format)
     return input
 
 
-def basicblock(input, ch_in, ch_out, stride, is_test):
-    short = _shortcut(input, ch_in, ch_out, stride, is_test)
-    conv1 = conv_bn_layer(input, ch_out, 3, stride, 1, is_test=is_test)
-    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, act=None, is_test=is_test)
+def basicblock(input, ch_in, ch_out, stride, is_test, data_format="NCHW"):
+    short = _shortcut(input, ch_in, ch_out, stride, is_test, data_format)
+    conv1 = conv_bn_layer(input, ch_out, 3, stride, 1, is_test=is_test,
+                          data_format=data_format)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, act=None,
+                          is_test=is_test, data_format=data_format)
     return fluid.layers.elementwise_add(short, conv2, act="relu")
 
 
-def bottleneck(input, ch_in, ch_out, stride, is_test):
-    short = _shortcut(input, ch_in, ch_out * 4, stride, is_test)
-    conv1 = conv_bn_layer(input, ch_out, 1, stride, 0, is_test=is_test)
-    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, is_test=is_test)
+def bottleneck(input, ch_in, ch_out, stride, is_test, data_format="NCHW"):
+    short = _shortcut(input, ch_in, ch_out * 4, stride, is_test,
+                      data_format)
+    conv1 = conv_bn_layer(input, ch_out, 1, stride, 0, is_test=is_test,
+                          data_format=data_format)
+    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, is_test=is_test,
+                          data_format=data_format)
     conv3 = conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, act=None,
-                          is_test=is_test)
+                          is_test=is_test, data_format=data_format)
     return fluid.layers.elementwise_add(short, conv3, act="relu")
 
 
-def _layer_warp(block_func, input, ch_in, ch_out, count, stride, is_test):
-    res = block_func(input, ch_in, ch_out, stride, is_test)
+def _layer_warp(block_func, input, ch_in, ch_out, count, stride, is_test,
+                data_format="NCHW"):
+    res = block_func(input, ch_in, ch_out, stride, is_test, data_format)
     for _ in range(1, count):
-        res = block_func(res, ch_out, ch_out, 1, is_test)
+        res = block_func(res, ch_out, ch_out, 1, is_test, data_format)
     return res
 
 
-def resnet_cifar10(input, class_dim=10, depth=32, is_test=False):
+def resnet_cifar10(input, class_dim=10, depth=32, is_test=False,
+                   data_format="NCHW"):
     assert (depth - 2) % 6 == 0
     n = (depth - 2) // 6
-    conv1 = conv_bn_layer(input, 16, 3, 1, 1, is_test=is_test)
-    res1 = _layer_warp(basicblock, conv1, 16, 16, n, 1, is_test)
-    res2 = _layer_warp(basicblock, res1, 16, 32, n, 2, is_test)
-    res3 = _layer_warp(basicblock, res2, 32, 64, n, 2, is_test)
+    conv1 = conv_bn_layer(input, 16, 3, 1, 1, is_test=is_test,
+                          data_format=data_format)
+    res1 = _layer_warp(basicblock, conv1, 16, 16, n, 1, is_test,
+                       data_format)
+    res2 = _layer_warp(basicblock, res1, 16, 32, n, 2, is_test,
+                       data_format)
+    res3 = _layer_warp(basicblock, res2, 32, 64, n, 2, is_test,
+                       data_format)
     pool = fluid.layers.pool2d(res3, pool_size=8, pool_type="avg",
-                               pool_stride=1)
+                               pool_stride=1, data_format=data_format)
     return fluid.layers.fc(pool, size=class_dim)
 
 
-def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False):
+def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False,
+                    data_format="NCHW"):
     cfg = {
         18: ([2, 2, 2, 2], basicblock),
         34: ([3, 4, 6, 3], basicblock),
@@ -68,9 +88,11 @@ def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False):
         152: ([3, 8, 36, 3], bottleneck),
     }
     stages, block_func = cfg[depth]
-    conv1 = conv_bn_layer(input, 64, 7, 2, 3, is_test=is_test)
+    conv1 = conv_bn_layer(input, 64, 7, 2, 3, is_test=is_test,
+                          data_format=data_format)
     pool1 = fluid.layers.pool2d(conv1, pool_size=3, pool_stride=2,
-                                pool_padding=1, pool_type="max")
+                                pool_padding=1, pool_type="max",
+                                data_format=data_format)
     expansion = 4 if block_func is bottleneck else 1
     res = pool1
     ch_in = 64
@@ -78,30 +100,35 @@ def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False):
         ch_out = 64 * (2 ** i)
         stride = 1 if i == 0 else 2
         res = _layer_warp(block_func, res, ch_in, ch_out, count, stride,
-                          is_test)
+                          is_test, data_format)
         ch_in = ch_out * expansion
     pool2 = fluid.layers.pool2d(res, pool_size=7, pool_type="avg",
-                                global_pooling=True)
+                                global_pooling=True,
+                                data_format=data_format)
     return fluid.layers.fc(pool2, size=class_dim)
 
 
 def build(dataset="cifar10", depth=None, batch_lr=0.1, class_dim=None,
-          is_test=False, amp=False):
+          is_test=False, amp=False, data_format="NCHW"):
     """Returns (main, startup, feeds, loss, acc).  amp=True applies the
-    bf16 AMP rewrite (fp32 master weights) like the BERT bench path."""
+    bf16 AMP rewrite (fp32 master weights) like the BERT bench path.
+    data_format="NHWC" builds the channels-last variant (the ``img``
+    feed is then [H, W, C])."""
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         if dataset == "cifar10":
-            img = fluid.layers.data("img", shape=[3, 32, 32],
-                                    dtype="float32")
+            shape = ([3, 32, 32] if data_format == "NCHW"
+                     else [32, 32, 3])
+            img = fluid.layers.data("img", shape=shape, dtype="float32")
             logits_fn = lambda im: resnet_cifar10(  # noqa: E731
-                im, class_dim or 10, depth or 20, is_test
+                im, class_dim or 10, depth or 20, is_test, data_format
             )
         else:
-            img = fluid.layers.data("img", shape=[3, 224, 224],
-                                    dtype="float32")
+            shape = ([3, 224, 224] if data_format == "NCHW"
+                     else [224, 224, 3])
+            img = fluid.layers.data("img", shape=shape, dtype="float32")
             logits_fn = lambda im: resnet_imagenet(  # noqa: E731
-                im, class_dim or 1000, depth or 50, is_test
+                im, class_dim or 1000, depth or 50, is_test, data_format
             )
         label = fluid.layers.data("label", shape=[1], dtype="int64")
         logits = logits_fn(img)

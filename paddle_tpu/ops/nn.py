@@ -371,7 +371,13 @@ def _pool_nd(attrs, X, nd):
     global_pooling = attrs.get("global_pooling", False)
     adaptive = attrs.get("adaptive", False)
     exclusive = attrs.get("exclusive", True)
-    spatial = jnp.shape(X)[2:]
+    # same predicate as the conv lowering (anything not NC* is
+    # channels-last) — a mismatch would silently build a mixed-layout
+    # model that traces fine and computes garbage
+    channels_last = attrs.get("data_format", "NCHW") not in (
+        "NCHW", "NCDHW", "AnyLayout")
+    spatial = (jnp.shape(X)[1:-1] if channels_last
+               else jnp.shape(X)[2:])
     if global_pooling or (adaptive and ksize == [1] * nd):
         ksize = list(spatial)
         strides = [1] * nd
@@ -380,9 +386,14 @@ def _pool_nd(attrs, X, nd):
         ksize = [s // k for s, k in zip(spatial, ksize)]
         strides = list(ksize)
         paddings = [0] * nd
-    window = (1, 1) + tuple(ksize)
-    wstrides = (1, 1) + tuple(strides)
-    pad = ((0, 0), (0, 0)) + tuple((p, p) for p in paddings)
+    if channels_last:
+        window = (1,) + tuple(ksize) + (1,)
+        wstrides = (1,) + tuple(strides) + (1,)
+        pad = ((0, 0),) + tuple((p, p) for p in paddings) + ((0, 0),)
+    else:
+        window = (1, 1) + tuple(ksize)
+        wstrides = (1, 1) + tuple(strides)
+        pad = ((0, 0), (0, 0)) + tuple((p, p) for p in paddings)
     if ptype == "max":
         if jnp.issubdtype(X.dtype, jnp.floating):
             import ml_dtypes
@@ -397,7 +408,9 @@ def _pool_nd(attrs, X, nd):
     s = jax.lax.reduce_window(
         X.astype(jnp.float32), 0.0, jax.lax.add, window, wstrides, pad)
     if exclusive and any(paddings):
-        ones = jnp.ones((1, 1) + tuple(spatial), jnp.float32)
+        ones_shape = ((1,) + tuple(spatial) + (1,) if channels_last
+                      else (1, 1) + tuple(spatial))
+        ones = jnp.ones(ones_shape, jnp.float32)
         cnt = jax.lax.reduce_window(
             ones, 0.0, jax.lax.add, window, wstrides, pad)
         out = s / cnt

@@ -171,3 +171,53 @@ def test_se_resnext_trains():
                             fetch_list=[loss])
             losses.append(float(np.asarray(lv).reshape(())))
     assert losses[-1] < 0.6 * losses[0], (losses[0], losses[-1])
+
+
+def test_resnet_nhwc_layout_parity():
+    """NHWC (channels-last, the TPU-native conv layout) computes the
+    SAME function as NCHW: conv filters stay OIHW, BN/bias are
+    per-channel, and the head global-pools to [N,1,1,C] so the fc
+    weight order matches.  Same params + transposed input => same
+    logits and same loss gradient step."""
+    fluid.unique_name.switch()
+    m_nchw, s_nchw, _, loss_nchw, _ = resnet.build(
+        dataset="cifar10", depth=8, batch_lr=0.05)
+    fluid.unique_name.switch()
+    m_nhwc, s_nhwc, _, loss_nhwc, _ = resnet.build(
+        dataset="cifar10", depth=8, batch_lr=0.05, data_format="NHWC")
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 3, 32, 32).astype("float32")
+    y = rng.randint(0, 10, (4, 1)).astype("int64")
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc1, sc2 = Scope(), Scope()
+    with scope_guard(sc1):
+        exe.run(s_nchw)
+        params = {p.name: np.asarray(sc1.get(p.name))
+                  for p in m_nchw.all_parameters()}
+        (l1,) = exe.run(m_nchw, feed={"img": x, "label": y},
+                        fetch_list=[loss_nchw])
+    with scope_guard(sc2):
+        exe.run(s_nhwc)
+        # identical params: both programs generate the same name
+        # sequence (unique_name reset before each build)
+        for p in m_nhwc.all_parameters():
+            sc2.set(p.name, params[p.name])
+        (l2,) = exe.run(m_nhwc,
+                        feed={"img": x.transpose(0, 2, 3, 1),
+                              "label": y},
+                        fetch_list=[loss_nhwc])
+    np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
+                               rtol=1e-4, atol=1e-5)
+    # one optimizer step each: params must stay in lockstep (grads
+    # match through the transposed layout)
+    with scope_guard(sc1):
+        exe.run(m_nchw, feed={"img": x, "label": y},
+                fetch_list=[loss_nchw])
+        w1 = np.asarray(sc1.get(m_nchw.all_parameters()[0].name))
+    with scope_guard(sc2):
+        exe.run(m_nhwc, feed={"img": x.transpose(0, 2, 3, 1),
+                              "label": y}, fetch_list=[loss_nhwc])
+        w2 = np.asarray(sc2.get(m_nhwc.all_parameters()[0].name))
+    np.testing.assert_allclose(w1, w2, rtol=1e-3, atol=1e-5)

@@ -131,6 +131,11 @@ def build_steps():
          PADDLE_BENCH_RESNET_BS="128")
     item("bench_resnet_bs256", "resnet", 420, 330,
          PADDLE_BENCH_RESNET_BS="256")
+    # channels-last: the TPU-native conv layout (layout-parity proven
+    # by tests/test_models.py); decides whether XLA's internal NCHW
+    # re-layout costs real transposes on this chip
+    item("bench_resnet_nhwc", "resnet", 360, 300,
+         PADDLE_BENCH_RESNET_FMT="NHWC")
     # inference headline: resnet50 through save_inference_model +
     # AnalysisPredictor (the reference's infer comparison class)
     item("bench_infer", "infer", 360, 300)
