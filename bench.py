@@ -343,14 +343,20 @@ def child_infer():
     size = 224 if on_tpu else 32
     warmup, steps = 3, (60 if on_tpu else 3)
 
+    fmt = os.environ.get("PADDLE_BENCH_RESNET_FMT", "NCHW").upper()
+    if fmt not in ("NCHW", "NHWC"):
+        raise SystemExit("PADDLE_BENCH_RESNET_FMT must be NCHW or NHWC, "
+                         "got %r" % fmt)
+    img_shape = [3, size, size] if fmt == "NCHW" else [size, size, 3]
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
-        img = fluid.layers.data("img", shape=[3, size, size],
-                                dtype="float32")
+        img = fluid.layers.data("img", shape=img_shape, dtype="float32")
         if on_tpu:
-            logits = resnet_imagenet(img, 1000, 50, is_test=True)
+            logits = resnet_imagenet(img, 1000, 50, is_test=True,
+                                     data_format=fmt)
         else:
-            logits = resnet_cifar10(img, 10, 20, is_test=True)
+            logits = resnet_cifar10(img, 10, 20, is_test=True,
+                                    data_format=fmt)
         prob = fluid.layers.softmax(logits)
     # export stays fp32: the predictor folds conv+bn FIRST, then
     # bf16-rewrites via AnalysisConfig.enable_bf16 — rewriting before
@@ -370,8 +376,8 @@ def child_infer():
     pred = fluid.inference.create_paddle_predictor(cfg)
     shutil.rmtree(export_dir, ignore_errors=True)
     rng = np.random.RandomState(0)
-    feed = {"img": jnp.asarray(
-        rng.randn(batch, 3, size, size).astype("float32"))}
+    feed = {"img": jnp.asarray(rng.randn(
+        *((batch,) + tuple(img_shape))).astype("float32"))}
 
     def run_once(return_numpy=True):
         return pred.run(feed, return_numpy=return_numpy)
@@ -408,9 +414,10 @@ def child_infer():
         "metric": "resnet50_infer_images_per_sec_per_chip"
                   if on_tpu else "resnet_cifar_infer_smoke_images_per_sec",
         "value": round(ips, 1),
-        "unit": "images/sec/chip (%dx%d bs%d %s AnalysisPredictor, "
+        "unit": "images/sec/chip (%dx%d bs%d %s%s AnalysisPredictor, "
                 "sync latency %.1f ms/batch, MFU %.3f on %s)"
                 % (size, size, batch, "bf16" if on_tpu else "fp32",
+                   " NHWC" if fmt == "NHWC" else "",
                    lat_ms, mfu, getattr(dev, "device_kind", str(dev))),
         "vs_baseline": round(mfu / 0.45, 3),
     }), flush=True)

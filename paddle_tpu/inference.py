@@ -85,8 +85,15 @@ def fuse_conv_bn(program, scope, eps_default=1e-5):
         if conv_op is None:
             i += 1
             continue
-        if conv_op.attrs.get("data_format", "NCHW") != "NCHW" or \
-                op.attrs.get("data_layout", "NCHW") != "NCHW":
+        conv_fmt = conv_op.attrs.get("data_format", "NCHW")
+        bn_fmt = op.attrs.get("data_layout", "NCHW")
+        if conv_fmt != bn_fmt or conv_fmt not in ("NCHW", "NHWC"):
+            i += 1
+            continue
+        channels_last = conv_fmt == "NHWC"
+        if channels_last and bias_add_op is not None:
+            # the conv-bias chain is detected by its NCHW axis=1 add;
+            # don't mix layouts — fold only the direct conv→bn pair
             i += 1
             continue
         # never fold into weight-shared params (another op would see the
@@ -130,13 +137,14 @@ def fuse_conv_bn(program, scope, eps_default=1e-5):
                 dtype="float32", persistable=True)
             bias_var.stop_gradient = True
             scope.set(bias_var_name, b_new)
-            # replace the bn op with the add (channel axis 1, NCHW)
+            # replace the bn op with the add: the [C] bias broadcasts on
+            # the channel axis — 1 for NCHW, last for NHWC
             block._remove_op(i)
             block._insert_op(
                 i, type="elementwise_add",
                 inputs={"X": [x_name], "Y": [bias_var_name]},
                 outputs={"Out": [y_name]},
-                attrs={"axis": 1},
+                attrs={"axis": -1 if channels_last else 1},
             )
             i += 1
         fused += 1

@@ -203,13 +203,23 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
             "data_format": data_format,
         },
     )
-    pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    # bias is per output CHANNEL: axis 1 for NCHW, last for NHWC (a
+    # layout-blind axis-1 add would silently bias over H instead)
+    if data_format == "NCHW":
+        pre_act = helper.append_bias_op(pre_bias, dim_start=1, dim_end=2)
+    else:
+        nd = len(input.shape)
+        pre_act = helper.append_bias_op(pre_bias, dim_start=nd - 1,
+                                        dim_end=nd)
     return helper.append_activation(pre_act)
 
 
 def depthwise_conv2d(input, num_filters, filter_size, **kwargs):
-    return conv2d(input, num_filters, filter_size,
-                  groups=input.shape[1], **kwargs)
+    groups = (input.shape[1]
+              if kwargs.get("data_format", "NCHW") == "NCHW"
+              else input.shape[-1])
+    return conv2d(input, num_filters, filter_size, groups=groups,
+                  **kwargs)
 
 
 def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,

@@ -316,3 +316,31 @@ def test_analysis_config_enable_bf16_after_fold(tmp_path):
     # actually executed), far inside correctness tolerance
     err = np.abs(got.astype("float32") - ref).max() / np.abs(ref).max()
     assert 1e-6 < err < 0.05, err
+
+
+def test_conv_bn_fold_nhwc(tmp_path):
+    """The conv+bn fold handles channels-last: filter scaling is
+    layout-independent (OIHW per output channel), only the replacement
+    bias-add's broadcast axis differs (last vs 1)."""
+    from paddle_tpu.models.resnet import resnet_cifar10
+
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[32, 32, 3],
+                                dtype="float32")
+        logits = resnet_cifar10(img, 10, 8, is_test=True,
+                                data_format="NHWC")
+    d = str(tmp_path / "m")
+    x = np.random.RandomState(0).randn(2, 32, 32, 3).astype("float32")
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        (ref,) = exe.run(main, feed={"img": x}, fetch_list=[logits])
+        fluid.io.save_inference_model(d, ["img"], [logits], exe,
+                                      main_program=main)
+    pred = create_paddle_predictor(AnalysisConfig(d))
+    ops = [op.type for op in pred.program.global_block().ops]
+    assert ops.count("batch_norm") == 0, "NHWC fold did not fire"
+    (got,) = pred.run([x])
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
