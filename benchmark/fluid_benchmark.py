@@ -48,6 +48,11 @@ def parse_args():
                     help="profile one pass (per-op device table)")
     ap.add_argument("--no_amp", action="store_true",
                     help="disable bf16 AMP where the model supports it")
+    ap.add_argument("--data_format", choices=("NCHW", "NHWC"),
+                    default="NCHW",
+                    help="conv layout (reference args.py:50; unlike the "
+                         "reference, NHWC is fully supported — it is the "
+                         "TPU-native layout; resnet only for now)")
     ap.add_argument("--require_device", action="store_true",
                     help="exit nonzero instead of falling back to CPU "
                          "when --device TPU does not answer (used by the "
@@ -73,12 +78,16 @@ def build_model(args, on_tpu):
                     "label": rng.randint(0, 10, (bs, 1)).astype("int64")}
     elif m == "resnet":
         dataset = "imagenet" if on_tpu else "cifar10"
-        size = 224 if on_tpu else 32
         main, startup, feeds, loss, acc = models.resnet.build(
-            dataset=dataset, amp=on_tpu and not args.no_amp)
+            dataset=dataset, amp=on_tpu and not args.no_amp,
+            data_format=getattr(args, "data_format", "NCHW"))
+        # single source of truth: the builder's declared img shape
+        # (feeds[0].shape is [-1, ...]) — no third copy of the
+        # layout/size conditional
+        img_shape = tuple(feeds[0].shape[1:])
 
         def feed_fn(bs):
-            return {"img": rng.randn(bs, 3, size, size).astype("float32"),
+            return {"img": rng.randn(bs, *img_shape).astype("float32"),
                     "label": rng.randint(0, 10, (bs, 1)).astype("int64")}
     elif m == "vgg":
         main, startup, feeds, loss, acc = models.vgg.build(
@@ -128,6 +137,10 @@ def build_model(args, on_tpu):
 
 def main():
     args = parse_args()
+    if args.data_format != "NCHW" and args.model != "resnet":
+        raise SystemExit(
+            "--data_format NHWC is only wired for --model resnet; "
+            "refusing to record a run under a layout it would not use")
     import hw_suite
 
     import jax

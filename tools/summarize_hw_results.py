@@ -106,9 +106,10 @@ def main():
     else:
         print("flagship default not captured yet")
 
-    # resnet batch sweep (images/sec; bigger batch usually lifts conv MFU)
+    # resnet sweep (images/sec): batch size + layout
     rn = {}
-    for stem in ("bench_resnet", "bench_resnet_bs128", "bench_resnet_bs256"):
+    for stem in ("bench_resnet", "bench_resnet_bs128",
+                 "bench_resnet_bs256", "bench_resnet_nhwc"):
         for k, (v, u) in metrics.get(stem, {}).items():
             if k.startswith("resnet50") and v:
                 rn[stem] = (v, u)
@@ -118,6 +119,17 @@ def main():
         for stem, (v, u) in sorted(rn.items()):
             print("  %-26s %8.0f img/s%s" % (
                 stem, v, "  <-- best" if stem == best else ""))
+
+    # seq512 batch A/B (the flash kernel's regime)
+    s5 = {}
+    for stem in ("bench_bert512", "bench_bert512_bs32"):
+        for k, (v, u) in metrics.get(stem, {}).items():
+            if "seq512" in k and v:
+                s5[stem] = (v, u)
+    if s5:
+        print()
+        for stem, (v, u) in sorted(s5.items()):
+            print("  %-26s %8.0f tok/s  %s" % (stem, v, u[:48]))
 
     # MFU cross-check fields (bench prints mfu_analytic + mfu_xla)
     for stem in sorted(metrics):
