@@ -379,6 +379,39 @@ def child_infer():
     feed = {"img": jnp.asarray(rng.randn(
         *((batch,) + tuple(img_shape))).astype("float32"))}
 
+    lat_ms, dt = _predictor_timing(pred, feed, warmup, steps)
+    if dt is None:  # compile-only phase
+        return
+    ips = batch * steps / dt
+    # fwd-only model FLOPs: 2 x 4.09 GMACs at 224^2 (see the train
+    # constant above); the cifar smoke reuses it only nominally
+    mfu = ips * (RESNET50_TRAIN_FLOPS_PER_IMAGE / 3) / peak_flops(dev)
+    print(json.dumps({
+        "metric": "resnet50_infer_images_per_sec_per_chip"
+                  if on_tpu else "resnet_cifar_infer_smoke_images_per_sec",
+        "value": round(ips, 1),
+        "unit": "images/sec/chip (%dx%d bs%d %s%s AnalysisPredictor, "
+                "sync latency %.1f ms/batch, MFU %.3f on %s)"
+                % (size, size, batch, "bf16" if on_tpu else "fp32",
+                   " NHWC" if fmt == "NHWC" else "",
+                   lat_ms, mfu, getattr(dev, "device_kind", str(dev))),
+        "vs_baseline": round(mfu / 0.45, 3),
+    }), flush=True)
+
+
+def child_bert_infer():
+    """Own child mode (not chained onto child_infer): isolates failures
+    and gives each inference benchmark a realistic tunnel-compile cap."""
+    import jax
+
+    dev = jax.devices()[0]
+    _bert_infer(_is_tpu_platform(dev.platform), dev)
+
+
+def _predictor_timing(pred, feed, warmup, steps, lat_runs=10):
+    """Shared predictor measurement: sync per-request latency + pipelined
+    serving throughput.  Returns (lat_ms, dt_seconds); (None, None) in
+    the compile-only phase (one finite run to seed the cache)."""
     def run_once(return_numpy=True):
         return pred.run(feed, return_numpy=return_numpy)
 
@@ -386,13 +419,12 @@ def child_infer():
         out = run_once()
         assert np.isfinite(out[0]).all()
         print(json.dumps({"compiled": True}), flush=True)
-        return
+        return None, None
     for _ in range(warmup):
         run_once()
     # latency: synchronous single-batch round trips (what one request
     # pays, incl. the tunnel fetch on this setup)
     t0 = time.perf_counter()
-    lat_runs = 10
     for _ in range(lat_runs):
         out = run_once()
     lat_ms = (time.perf_counter() - t0) / lat_runs * 1e3
@@ -406,18 +438,83 @@ def child_infer():
     outs = [run_once(return_numpy=False) for _ in range(steps)]
     np.asarray(outs[-1][0])
     dt = time.perf_counter() - t0
-    ips = batch * steps / dt
-    # fwd-only model FLOPs: 2 x 4.09 GMACs at 224^2 (see the train
-    # constant above); the cifar smoke reuses it only nominally
-    mfu = ips * (RESNET50_TRAIN_FLOPS_PER_IMAGE / 3) / peak_flops(dev)
+    return lat_ms, dt
+
+
+def _bert_infer(on_tpu, dev, seq_len=128):
+    """BERT encoder serving (bert-as-a-service feature extraction)
+    through the same export → AnalysisPredictor path — the NLP half of
+    the inference headline (reference analogue: the ernie/bert models
+    under ``paddle/fluid/inference/tests/api``)."""
+    import shutil
+    import tempfile
+
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu.executor import Scope, scope_guard
+    from paddle_tpu.models import bert
+
+    cfg = bert.BERT_BASE if on_tpu else bert.BERT_TINY
+    batch = 32 if on_tpu else 4
+    warmup, steps = 3, (40 if on_tpu else 3)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        input_ids = fluid.layers.data("input_ids", shape=[seq_len],
+                                      dtype="int64")
+        token_type = fluid.layers.data("token_type_ids", shape=[seq_len],
+                                       dtype="int64")
+        mask = fluid.layers.data("attn_mask_bias",
+                                 shape=[1, 1, seq_len], dtype="float32")
+        import copy
+
+        icfg = copy.copy(cfg)
+        icfg.dropout = 0.0
+        icfg.attn_dropout = 0.0
+        hidden = bert.encoder(input_ids, token_type, mask, icfg, seq_len)
+
+    export_dir = tempfile.mkdtemp(prefix="bench_bert_infer_")
+    scope = Scope()
+    with scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        fluid.io.save_inference_model(
+            export_dir,
+            ["input_ids", "token_type_ids", "attn_mask_bias", "pos_ids"],
+            [hidden], exe, main_program=main)
+    acfg = fluid.inference.AnalysisConfig(model_dir=export_dir)
+    if on_tpu:
+        acfg.enable_bf16()
+    pred = fluid.inference.create_paddle_predictor(acfg)
+    shutil.rmtree(export_dir, ignore_errors=True)
+
+    rng = np.random.RandomState(0)
+    # feed layout comes from the single source of truth
+    # (bert.make_fake_batch "must agree" with the model); the encoder
+    # export needs only the 4 input feeds, not the MLM labels
+    feed_names = ("input_ids", "token_type_ids", "attn_mask_bias",
+                  "pos_ids")
+    feed = {k: jnp.asarray(v)
+            for k, v in bert.make_fake_batch(batch, seq_len, cfg, rng,
+                                             max_pred=0).items()
+            if k in feed_names}
+    lat_ms, dt = _predictor_timing(pred, feed, warmup, steps)
+    if dt is None:
+        return
+    tps = batch * seq_len * steps / dt
+    d, ff = cfg.hidden, cfg.ffn
+    fwd_flops_per_token = cfg.layers * (
+        8 * d * d + 4 * d * ff + 4 * seq_len * d)
+    mfu = tps * fwd_flops_per_token / peak_flops(dev)
     print(json.dumps({
-        "metric": "resnet50_infer_images_per_sec_per_chip"
-                  if on_tpu else "resnet_cifar_infer_smoke_images_per_sec",
-        "value": round(ips, 1),
-        "unit": "images/sec/chip (%dx%d bs%d %s%s AnalysisPredictor, "
-                "sync latency %.1f ms/batch, MFU %.3f on %s)"
-                % (size, size, batch, "bf16" if on_tpu else "fp32",
-                   " NHWC" if fmt == "NHWC" else "",
+        "metric": "bert_base_infer_tokens_per_sec_per_chip"
+                  if on_tpu else "bert_infer_smoke_tokens_per_sec",
+        "value": round(tps, 1),
+        "unit": "tokens/sec/chip (encoder fwd seq%d bs%d %s "
+                "AnalysisPredictor, sync latency %.1f ms/batch, "
+                "MFU %.3f on %s)"
+                % (seq_len, batch, "bf16" if on_tpu else "fp32",
                    lat_ms, mfu, getattr(dev, "device_kind", str(dev))),
         "vs_baseline": round(mfu / 0.45, 3),
     }), flush=True)
@@ -690,7 +787,7 @@ def main():
         # resnet (340+15) = 1100s; bert512 gets the remaining ~270s and
         # infer only runs when caches were warm enough to leave >=90s
         plan = [("bert", 420), ("ctr", 160), ("resnet", 340),
-                ("bert512", 270), ("infer", 220)]
+                ("bert512", 270), ("infer", 220), ("bert_infer", 200)]
         failed = []
         for mode, cap in plan:
             if remaining(cap) < 90:
@@ -699,11 +796,12 @@ def main():
                 print("# %s skipped: <90s left in budget" % mode,
                       flush=True)
                 continue
-            if mode == "infer" and any(m == "bert" for m, _, _ in failed):
-                # the flagship retry (below) outranks the tail item —
-                # infer must not burn the budget a bert recovery needs
-                print("# infer skipped: reserving budget for the "
-                      "flagship retry", flush=True)
+            if mode in ("infer", "bert_infer") and any(
+                    m == "bert" for m, _, _ in failed):
+                # the flagship retry (below) outranks the tail items —
+                # they must not burn the budget a bert recovery needs
+                print("# %s skipped: reserving budget for the "
+                      "flagship retry" % mode, flush=True)
                 continue
             w_ok, w_lines, w_err = _run_child(mode, remaining(cap))
             if not w_ok:
@@ -809,6 +907,8 @@ if __name__ == "__main__":
             child_bert(512)
         elif mode == "infer":
             child_infer()
+        elif mode == "bert_infer":
+            child_bert_infer()
         else:
             raise SystemExit("unknown child mode %r" % mode)
         sys.exit(0)

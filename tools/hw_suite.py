@@ -137,8 +137,10 @@ def build_steps():
     item("bench_resnet_nhwc", "resnet", 360, 300,
          PADDLE_BENCH_RESNET_FMT="NHWC")
     # inference headline: resnet50 through save_inference_model +
-    # AnalysisPredictor (the reference's infer comparison class)
+    # AnalysisPredictor (the reference's infer comparison class), and
+    # BERT encoder serving as its own item (isolated failure/caps)
     item("bench_infer", "infer", 360, 300)
+    item("bench_bert_infer", "bert_infer", 360, 300)
     # the rest of the reference's headline benchmark set
     # (fluid_benchmark.py models), proven on silicon: examples/sec lines
     # in the reference's own reporting format
