@@ -327,14 +327,10 @@ def child_infer():
     figures (``benchmark/figs/resnet-infer-*.png``) and
     ``paddle/fluid/inference/tests/api`` benchmarks; this is the
     inference-stack headline, not just a unit test."""
-    import shutil
-    import tempfile
-
     import jax
     import jax.numpy as jnp
 
     import paddle_tpu as fluid
-    from paddle_tpu.executor import Scope, scope_guard
     from paddle_tpu.models.resnet import resnet_cifar10, resnet_imagenet
 
     dev = jax.devices()[0]
@@ -362,19 +358,8 @@ def child_infer():
     # bf16-rewrites via AnalysisConfig.enable_bf16 — rewriting before
     # export would cast-sandwich every bn and defeat the fold
 
-    export_dir = tempfile.mkdtemp(prefix="bench_infer_")
-    scope = Scope()
-    with scope_guard(scope):
-        exe = fluid.Executor(fluid.TPUPlace())
-        exe.run(startup)
-        fluid.io.save_inference_model(export_dir, ["img"], [prob], exe,
-                                      main_program=main)
-
-    cfg = fluid.inference.AnalysisConfig(model_dir=export_dir)
-    if on_tpu:
-        cfg.enable_bf16()
-    pred = fluid.inference.create_paddle_predictor(cfg)
-    shutil.rmtree(export_dir, ignore_errors=True)
+    pred = _export_predictor(main, startup, ["img"], [prob], on_tpu,
+                             "bench_infer_")
     rng = np.random.RandomState(0)
     feed = {"img": jnp.asarray(rng.randn(
         *((batch,) + tuple(img_shape))).astype("float32"))}
@@ -406,6 +391,32 @@ def child_bert_infer():
 
     dev = jax.devices()[0]
     _bert_infer(_is_tpu_platform(dev.platform), dev)
+
+
+def _export_predictor(main, startup, feed_names, targets, on_tpu,
+                      prefix):
+    """Shared export→predictor scaffold: save_inference_model into a
+    tempdir, load through the analysis pipeline (+bf16 AFTER folding on
+    TPU via AnalysisConfig.enable_bf16), remove the tempdir."""
+    import shutil
+    import tempfile
+
+    import paddle_tpu as fluid
+    from paddle_tpu.executor import Scope, scope_guard
+
+    export_dir = tempfile.mkdtemp(prefix=prefix)
+    scope = Scope()
+    with scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        fluid.io.save_inference_model(export_dir, feed_names, targets,
+                                      exe, main_program=main)
+    cfg = fluid.inference.AnalysisConfig(model_dir=export_dir)
+    if on_tpu:
+        cfg.enable_bf16()
+    pred = fluid.inference.create_paddle_predictor(cfg)
+    shutil.rmtree(export_dir, ignore_errors=True)
+    return pred
 
 
 def _predictor_timing(pred, feed, warmup, steps, lat_runs=10):
@@ -446,13 +457,9 @@ def _bert_infer(on_tpu, dev, seq_len=128):
     through the same export → AnalysisPredictor path — the NLP half of
     the inference headline (reference analogue: the ernie/bert models
     under ``paddle/fluid/inference/tests/api``)."""
-    import shutil
-    import tempfile
-
     import jax.numpy as jnp
 
     import paddle_tpu as fluid
-    from paddle_tpu.executor import Scope, scope_guard
     from paddle_tpu.models import bert
 
     cfg = bert.BERT_BASE if on_tpu else bert.BERT_TINY
@@ -474,20 +481,10 @@ def _bert_infer(on_tpu, dev, seq_len=128):
         icfg.attn_dropout = 0.0
         hidden = bert.encoder(input_ids, token_type, mask, icfg, seq_len)
 
-    export_dir = tempfile.mkdtemp(prefix="bench_bert_infer_")
-    scope = Scope()
-    with scope_guard(scope):
-        exe = fluid.Executor(fluid.TPUPlace())
-        exe.run(startup)
-        fluid.io.save_inference_model(
-            export_dir,
-            ["input_ids", "token_type_ids", "attn_mask_bias", "pos_ids"],
-            [hidden], exe, main_program=main)
-    acfg = fluid.inference.AnalysisConfig(model_dir=export_dir)
-    if on_tpu:
-        acfg.enable_bf16()
-    pred = fluid.inference.create_paddle_predictor(acfg)
-    shutil.rmtree(export_dir, ignore_errors=True)
+    pred = _export_predictor(
+        main, startup,
+        ["input_ids", "token_type_ids", "attn_mask_bias", "pos_ids"],
+        [hidden], on_tpu, "bench_bert_infer_")
 
     rng = np.random.RandomState(0)
     # feed layout comes from the single source of truth
@@ -700,7 +697,7 @@ def _json_lines(text):
     return out
 
 
-def _captured_hw_lines(max_age_s=24 * 3600):
+def _captured_hw_lines(max_age_s=24 * 3600, results_dir=None):
     """Best clean watcher capture per hardware metric (hw_results/*.txt
     with rc=0, captured within ``max_age_s`` — i.e. THIS round, not a
     committed artifact from an earlier one), unit re-labeled with
@@ -713,9 +710,11 @@ def _captured_hw_lines(max_age_s=24 * 3600):
     import glob
 
     out = {}
-    arts = sorted(glob.glob(os.path.join(
-        os.path.dirname(os.path.abspath(__file__)),
-        "hw_results", "*.txt")), key=os.path.getmtime)
+    if results_dir is None:
+        results_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "hw_results")
+    arts = sorted(glob.glob(os.path.join(results_dir, "*.txt")),
+                  key=os.path.getmtime)
     now = time.time()
     for p in arts:
         try:
@@ -785,7 +784,8 @@ def main():
         # ones burn their caps (warm .jax_cache runs finish them all).
         # worst case: probe (120+15) + bert (420+15) + ctr (160+15) +
         # resnet (340+15) = 1100s; bert512 gets the remaining ~270s and
-        # infer only runs when caches were warm enough to leave >=90s
+        # the infer/bert_infer tail items only run when caches were
+        # warm enough to leave >=90s each
         plan = [("bert", 420), ("ctr", 160), ("resnet", 340),
                 ("bert512", 270), ("infer", 220), ("bert_infer", 200)]
         failed = []

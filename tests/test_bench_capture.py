@@ -1,0 +1,78 @@
+"""bench.py's TPU-down fallback: surface the best clean in-round
+watcher capture per metric (the driver-visible flagship for rounds
+where the tunnel is dead at bench time — the r02-r04 failure mode)."""
+
+import importlib.util
+import json
+import os
+import time
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write(d, name, rc, ts, lines):
+    with open(os.path.join(d, name + ".txt"), "w") as f:
+        f.write("[watcher] rc=%s ts=%d\n" % (rc, ts))
+        for l in lines:
+            f.write(json.dumps(l) + "\n")
+
+
+def test_best_arm_wins_and_failures_excluded(bench, tmp_path):
+    d = str(tmp_path)
+    now = int(time.time())
+    m = "bert_base_mlm_train_tokens_per_sec_per_chip"
+    _write(d, "bench_bert_default", 0, now - 60,
+           [{"metric": m, "value": 100.0, "unit": "u", "vs_baseline": 0.5}])
+    _write(d, "bench_bert_ipr25", 0, now - 30,
+           [{"metric": m, "value": 120.0, "unit": "u ipr25",
+             "vs_baseline": 0.6}])
+    _write(d, "bench_bert_broken", 1, now - 10,
+           [{"metric": m, "value": 999.0, "unit": "u", "vs_baseline": 9.9}])
+    out = bench._captured_hw_lines(results_dir=d)
+    assert len(out) == 1
+    l = out[0]
+    assert l["value"] == 120.0 and l["captured_earlier"] is True
+    assert "CAPTURED EARLIER" in l["unit"]
+    assert l["captured_artifact"] == "bench_bert_ipr25.txt"
+
+
+def test_in_artifact_ts_beats_checkout_mtime(bench, tmp_path):
+    """git checkout resets mtime; freshness must come from the ts=
+    header, so a previous round's committed artifact can never replay."""
+    d = str(tmp_path)
+    m = "resnet50_imagenet_train_images_per_sec_per_chip"
+    _write(d, "bench_resnet", 0, int(time.time()) - 3 * 24 * 3600,
+           [{"metric": m, "value": 1000.0, "unit": "u",
+             "vs_baseline": 0.4}])
+    # fresh mtime (as a clone would produce)
+    os.utime(os.path.join(d, "bench_resnet.txt"))
+    assert bench._captured_hw_lines(results_dir=d) == []
+
+
+def test_smoke_metrics_excluded_and_ties_prefer_newer(bench, tmp_path):
+    d = str(tmp_path)
+    now = int(time.time())
+    m = "resnet50_imagenet_train_images_per_sec_per_chip"
+    _write(d, "a_old", 0, now - 100,
+           [{"metric": m, "value": 50.0, "unit": "old", "vs_baseline": 0.2},
+            {"metric": "resnet_cifar_smoke_images_per_sec", "value": 5.0,
+             "unit": "smoke", "vs_baseline": 1.0}])
+    _write(d, "b_new", 0, now - 10,
+           [{"metric": m, "value": 50.0, "unit": "new corrected",
+             "vs_baseline": 0.2}])
+    # mtime order must match write order for the tie-break
+    os.utime(os.path.join(d, "a_old.txt"), (now - 100, now - 100))
+    os.utime(os.path.join(d, "b_new.txt"), (now - 10, now - 10))
+    out = bench._captured_hw_lines(results_dir=d)
+    assert len(out) == 1
+    assert out[0]["captured_artifact"] == "b_new.txt"
