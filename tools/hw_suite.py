@@ -164,6 +164,7 @@ def build_steps():
                    "tests/test_detection.py", "tests/test_nn_call_parity.py",
                    "tests/test_quantization.py",
                    "tests/test_flash_attention.py",
+                   "tests/test_inference.py",
                    "-q", "-p", "no:cacheprovider"], 1500,
                   {"PADDLE_TPU_TESTS_ON_TPU": "1"}))
     return steps
