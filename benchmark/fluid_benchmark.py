@@ -52,7 +52,7 @@ def parse_args():
                     default="NCHW",
                     help="conv layout (reference args.py:50; unlike the "
                          "reference, NHWC is fully supported — it is the "
-                         "TPU-native layout; resnet only for now)")
+                         "TPU-native layout; wired for resnet and vgg)")
     ap.add_argument("--require_device", action="store_true",
                     help="exit nonzero instead of falling back to CPU "
                          "when --device TPU does not answer (used by the "
@@ -91,10 +91,12 @@ def build_model(args, on_tpu):
                     "label": rng.randint(0, 10, (bs, 1)).astype("int64")}
     elif m == "vgg":
         main, startup, feeds, loss, acc = models.vgg.build(
-            dataset="cifar10", lr=args.learning_rate)
+            dataset="cifar10", lr=args.learning_rate,
+            data_format=getattr(args, "data_format", "NCHW"))
+        img_shape = tuple(feeds[0].shape[1:])
 
         def feed_fn(bs):
-            return {"img": rng.randn(bs, 3, 32, 32).astype("float32"),
+            return {"img": rng.randn(bs, *img_shape).astype("float32"),
                     "label": rng.randint(0, 10, (bs, 1)).astype("int64")}
     elif m == "stacked_dynamic_lstm":
         seq_len, vocab = 80, 5149
@@ -137,9 +139,9 @@ def build_model(args, on_tpu):
 
 def main():
     args = parse_args()
-    if args.data_format != "NCHW" and args.model != "resnet":
+    if args.data_format != "NCHW" and args.model not in ("resnet", "vgg"):
         raise SystemExit(
-            "--data_format NHWC is only wired for --model resnet; "
+            "--data_format NHWC is only wired for resnet and vgg; "
             "refusing to record a run under a layout it would not use")
     import hw_suite
 

@@ -3,12 +3,13 @@
 import paddle_tpu as fluid
 
 
-def vgg16(input, class_dim, is_test=False):
+def vgg16(input, class_dim, is_test=False, data_format="NCHW"):
     def conv_block(inp, num_filter, groups):
         return fluid.nets.img_conv_group(
             input=inp, conv_num_filter=[num_filter] * groups,
             pool_size=2, pool_stride=2, conv_filter_size=3,
             conv_act="relu", conv_with_batchnorm=True,
+            data_format=data_format,
         )
 
     c1 = conv_block(input, 64, 2)
@@ -25,14 +26,16 @@ def vgg16(input, class_dim, is_test=False):
     return fluid.layers.fc(fc2, size=class_dim)
 
 
-def build(dataset="cifar10", lr=1e-3):
+def build(dataset="cifar10", lr=1e-3, data_format="NCHW"):
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
-        shape = [3, 32, 32] if dataset == "cifar10" else [3, 224, 224]
+        size = 32 if dataset == "cifar10" else 224
+        shape = ([3, size, size] if data_format == "NCHW"
+                 else [size, size, 3])
         class_dim = 10 if dataset == "cifar10" else 1000
         img = fluid.layers.data("img", shape=shape, dtype="float32")
         label = fluid.layers.data("label", shape=[1], dtype="int64")
-        logits = vgg16(img, class_dim)
+        logits = vgg16(img, class_dim, data_format=data_format)
         loss = fluid.layers.mean(
             fluid.layers.softmax_with_cross_entropy(logits, label)
         )

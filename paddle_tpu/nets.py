@@ -27,7 +27,8 @@ def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
 def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
                    conv_filter_size=3, conv_act=None, param_attr=None,
                    conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
-                   pool_stride=1, pool_type="max", use_cudnn=True):
+                   pool_stride=1, pool_type="max", use_cudnn=True,
+                   data_format="NCHW"):
     tmp = input
     if not isinstance(conv_padding, list):
         conv_padding = [conv_padding] * len(conv_num_filter)
@@ -45,14 +46,16 @@ def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
             input=tmp, num_filters=conv_num_filter[i],
             filter_size=conv_filter_size[i], padding=conv_padding[i],
             param_attr=param_attr, act=local_act,
+            data_format=data_format,
         )
         if conv_with_batchnorm[i]:
-            tmp = layers.batch_norm(input=tmp, act=conv_act)
+            tmp = layers.batch_norm(input=tmp, act=conv_act,
+                                    data_layout=data_format)
             if conv_batchnorm_drop_rate[i]:
                 tmp = layers.dropout(tmp, conv_batchnorm_drop_rate[i])
     return layers.pool2d(
         input=tmp, pool_size=pool_size, pool_type=pool_type,
-        pool_stride=pool_stride,
+        pool_stride=pool_stride, data_format=data_format,
     )
 
 
