@@ -203,7 +203,13 @@ def _xla_flops_per_step(scope, feed):
         flops = float(ca.get("flops", 0.0))
         if flops <= 0:
             return None
-        return flops / max(1, cb.iters_per_run)
+        # XLA's cost analysis counts a while/scan body ONCE regardless
+        # of trip count (verified: a length-4 scan of a matmul reports
+        # the same flops as the unscanned matmul; the r05 ipr25
+        # hardware capture read 25x low under the old /iters division),
+        # so the reported figure already IS per-step for the
+        # num_iteration_per_run scan wrapper.
+        return flops
     except Exception as e:  # noqa: BLE001 - cross-check is best-effort
         print("# mfu cross-check unavailable: %s" % str(e)[-200:],
               flush=True)
@@ -278,9 +284,17 @@ def child_resnet():
     if fmt not in ("NCHW", "NHWC"):
         raise SystemExit("PADDLE_BENCH_RESNET_FMT must be NCHW or NHWC, "
                          "got %r" % fmt)
+    # s2d A/B: the space-to-depth stem (models/resnet.py _s2d_stem) —
+    # imagenet only (the cifar smoke has no 7x7 stem to replace)
+    stem = os.environ.get("PADDLE_BENCH_RESNET_STEM", "conv7").lower()
+    if stem not in ("conv7", "s2d"):
+        raise SystemExit("PADDLE_BENCH_RESNET_STEM must be conv7 or "
+                         "s2d, got %r" % stem)
+    if not on_tpu:
+        stem = "conv7"
     main_prog, startup, feeds, loss, acc = resnet.build(
         dataset="imagenet" if on_tpu else "cifar10", amp=on_tpu,
-        data_format=fmt)
+        data_format=fmt, stem=stem)
     run_prog, steps, iters = _wrap_iters_per_run(main_prog, loss, steps)
     scope = Scope()
     with scope_guard(scope):
@@ -305,7 +319,8 @@ def child_resnet():
                 % (size, size, batch,
                    "bf16 AMP" if on_tpu else "fp32",
                    " ipr%d" % iters if iters > 1 else "",
-                   " NHWC" if fmt == "NHWC" else "",
+                   (" NHWC" if fmt == "NHWC" else "")
+                   + (" s2d-stem" if stem == "s2d" else ""),
                    mfu, getattr(dev, "device_kind", str(dev))),
         "vs_baseline": round(mfu / 0.45, 3),
     }

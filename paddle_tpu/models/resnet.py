@@ -78,8 +78,37 @@ def resnet_cifar10(input, class_dim=10, depth=32, is_test=False,
     return fluid.layers.fc(pool, size=class_dim)
 
 
+def _s2d_stem(input, is_test, data_format):
+    """The 7x7/s2 stem recast via space-to-depth (block 2): the
+    3-channel stride-2 conv under-fills the MXU's contraction lanes
+    (7*7*3 = 147 sparse taps over a strided window); folding the
+    stride into channels gives a dense 4x4/s1 conv over 12 channels on
+    the 112x112 grid — the standard TPU ResNet stem recipe.  A free
+    [64, 12, 4, 4] filter strictly contains the original [64, 3, 7, 7]
+    class (pad 7x7 -> 8x8 with a zero row/col, space-to-depth the
+    filter), so training from scratch is equivalent; checkpoints are
+    not weight-compatible with the conv7 stem, hence opt-in
+    (stem="s2d").  Output matches conv7 exactly in shape: [*, 64, 112,
+    112] via asymmetric (1, 2) spatial padding."""
+    if data_format == "NCHW":
+        x = fluid.layers.space_to_depth(input, 2)      # [N,12,112,112]
+        x = fluid.layers.pad(x, [0, 0, 0, 0, 1, 2, 1, 2])
+    else:
+        # channels-last: s2d expressed as reshape+transpose (the
+        # space_to_depth op is NCHW by reference parity); XLA folds
+        # this into the conv's input layout
+        n, h, w, c = input.shape
+        x = fluid.layers.reshape(
+            input, [-1, h // 2, 2, w // 2, 2, c])
+        x = fluid.layers.transpose(x, [0, 1, 3, 2, 4, 5])
+        x = fluid.layers.reshape(x, [-1, h // 2, w // 2, 4 * c])
+        x = fluid.layers.pad(x, [0, 0, 1, 2, 1, 2, 0, 0])
+    return conv_bn_layer(x, 64, 4, 1, 0, is_test=is_test,
+                         data_format=data_format)
+
+
 def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False,
-                    data_format="NCHW"):
+                    data_format="NCHW", stem="conv7"):
     cfg = {
         18: ([2, 2, 2, 2], basicblock),
         34: ([3, 4, 6, 3], basicblock),
@@ -88,8 +117,11 @@ def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False,
         152: ([3, 8, 36, 3], bottleneck),
     }
     stages, block_func = cfg[depth]
-    conv1 = conv_bn_layer(input, 64, 7, 2, 3, is_test=is_test,
-                          data_format=data_format)
+    if stem == "s2d":
+        conv1 = _s2d_stem(input, is_test, data_format)
+    else:
+        conv1 = conv_bn_layer(input, 64, 7, 2, 3, is_test=is_test,
+                              data_format=data_format)
     pool1 = fluid.layers.pool2d(conv1, pool_size=3, pool_stride=2,
                                 pool_padding=1, pool_type="max",
                                 data_format=data_format)
@@ -109,11 +141,12 @@ def resnet_imagenet(input, class_dim=1000, depth=50, is_test=False,
 
 
 def build(dataset="cifar10", depth=None, batch_lr=0.1, class_dim=None,
-          is_test=False, amp=False, data_format="NCHW"):
+          is_test=False, amp=False, data_format="NCHW", stem="conv7"):
     """Returns (main, startup, feeds, loss, acc).  amp=True applies the
     bf16 AMP rewrite (fp32 master weights) like the BERT bench path.
     data_format="NHWC" builds the channels-last variant (the ``img``
-    feed is then [H, W, C])."""
+    feed is then [H, W, C]).  stem="s2d" (imagenet only) uses the
+    space-to-depth stem — see ``_s2d_stem``."""
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         if dataset == "cifar10":
@@ -128,7 +161,8 @@ def build(dataset="cifar10", depth=None, batch_lr=0.1, class_dim=None,
                      else [224, 224, 3])
             img = fluid.layers.data("img", shape=shape, dtype="float32")
             logits_fn = lambda im: resnet_imagenet(  # noqa: E731
-                im, class_dim or 1000, depth or 50, is_test, data_format
+                im, class_dim or 1000, depth or 50, is_test, data_format,
+                stem,
             )
         label = fluid.layers.data("label", shape=[1], dtype="int64")
         logits = logits_fn(img)

@@ -76,3 +76,40 @@ def test_smoke_metrics_excluded_and_ties_prefer_newer(bench, tmp_path):
     out = bench._captured_hw_lines(results_dir=d)
     assert len(out) == 1
     assert out[0]["captured_artifact"] == "b_new.txt"
+
+
+def test_xla_cost_analysis_counts_scan_body_once():
+    """The MFU cross-check (bench.py _xla_flops_per_step) treats XLA's
+    cost-analysis flops as per-step even under the
+    num_iteration_per_run scan wrapper, because XLA counts a
+    while/scan body ONCE regardless of trip count.  This pins that
+    backend behavior: if a jax upgrade starts multiplying by the trip
+    count, the cross-check must go back to dividing (the r05 ipr25
+    hardware capture read 25x low under an erroneous /iters)."""
+    import jax
+    import jax.numpy as jnp
+
+    def step(x):
+        return x @ x
+
+    @jax.jit
+    def one(x):
+        return step(x)
+
+    @jax.jit
+    def scan4(x):
+        c, _ = jax.lax.scan(lambda c, _: (step(c), None), x, None,
+                            length=4)
+        return c
+
+    x = jnp.ones((64, 64), jnp.float32)
+
+    def flops(f):
+        ca = f.lower(x).compile().cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        return float(ca.get("flops", 0.0))
+
+    f1, f4 = flops(one), flops(scan4)
+    assert f1 > 0
+    assert abs(f4 - f1) / f1 < 0.05, (f1, f4)

@@ -257,3 +257,73 @@ def test_vgg_nhwc_layout_parity():
                                   "label": y}, fetch_list=[l2])
     np.testing.assert_allclose(np.asarray(v1), np.asarray(v2),
                                rtol=1e-4, atol=1e-5)
+
+
+def _build_imagenet_small(data_format, stem, size=32):
+    """Tiny imagenet-architecture resnet-18 (global avg pool head, so
+    any even spatial size works) for stem tests."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        shape = ([3, size, size] if data_format == "NCHW"
+                 else [size, size, 3])
+        img = fluid.layers.data("img", shape=shape, dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        logits = resnet.resnet_imagenet(img, 10, 18,
+                                        data_format=data_format,
+                                        stem=stem)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        opt = fluid.optimizer.Momentum(0.05, 0.9)
+        opt.minimize(loss)
+    return main, startup, loss
+
+
+def test_resnet_s2d_stem_trains():
+    """The space-to-depth stem (models/resnet.py _s2d_stem): the
+    4x4/s1 conv over 12 s2d channels replaces conv7x7/s2 — the filter
+    is [64, 12, 4, 4], the spatial output halves exactly like conv7
+    (asymmetric (1,2) pad), and the model trains."""
+    fluid.unique_name.switch()
+    main, startup, loss = _build_imagenet_small("NCHW", "s2d")
+    conv1 = next(p for p in main.all_parameters()
+                 if tuple(p.shape) == (64, 12, 4, 4))
+    assert conv1 is not None
+    rng = np.random.RandomState(3)
+
+    def feed():
+        return {"img": rng.randn(2, 3, 32, 32).astype("float32"),
+                "label": rng.randint(0, 10, (2, 1)).astype("int64")}
+
+    losses = _train(main, startup, feed, loss, steps=6)
+    assert np.isfinite(losses).all()
+
+
+def test_resnet_s2d_stem_layout_parity():
+    """NCHW s2d (space_to_depth op) and NHWC s2d (reshape+transpose
+    form) compute the SAME function: the NHWC block unrolling
+    (h-block, w-block) major order matches the op's channel order, so
+    identical OIHW filters see identically-ordered input channels."""
+    fluid.unique_name.switch()
+    m1, s1, l1 = _build_imagenet_small("NCHW", "s2d")
+    fluid.unique_name.switch()
+    m2, s2, l2 = _build_imagenet_small("NHWC", "s2d")
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 32, 32).astype("float32")
+    y = rng.randint(0, 10, (2, 1)).astype("int64")
+    exe = fluid.Executor(fluid.CPUPlace())
+    sc1, sc2 = Scope(), Scope()
+    with scope_guard(sc1):
+        exe.run(s1)
+        params = {p.name: np.asarray(sc1.get(p.name))
+                  for p in m1.all_parameters()}
+        (v1,) = exe.run(m1, feed={"img": x, "label": y},
+                        fetch_list=[l1])
+    with scope_guard(sc2):
+        exe.run(s2)
+        for p in m2.all_parameters():
+            sc2.set(p.name, params[p.name])
+        (v2,) = exe.run(m2, feed={"img": x.transpose(0, 2, 3, 1),
+                                  "label": y}, fetch_list=[l2])
+    np.testing.assert_allclose(np.asarray(v1), np.asarray(v2),
+                               rtol=1e-4, atol=1e-5)

@@ -1,5 +1,6 @@
-"""Capture a jax profiler trace of the BERT bench step and print the
-top-op time breakdown (MFU diagnosis aid)."""
+"""Capture a jax profiler trace of a bench train step (BERT default,
+``--model resnet`` for the conv workload) and print the top-op time
+breakdown (MFU diagnosis aid)."""
 import sys
 
 import numpy as np
@@ -34,14 +35,63 @@ def run_and_trace(cfg_kw=None, batch=64, seq_len=128, steps=5):
         exe.run(startup)
         rng = np.random.RandomState(0)
         feed = bert.make_fake_batch(batch, seq_len, cfg, rng)
-        for _ in range(3):
-            exe.run(main_prog, feed=feed, fetch_list=[])
-        exe.run(main_prog, feed=feed, fetch_list=[loss])
-        jax.profiler.start_trace(TRACE_DIR)
-        for _ in range(steps - 1):
-            exe.run(main_prog, feed=feed, fetch_list=[])
-        exe.run(main_prog, feed=feed, fetch_list=[loss])
-        jax.profiler.stop_trace()
+        _trace_loop(exe, main_prog, feed, loss, steps)
+
+
+def run_and_trace_resnet(batch=64, steps=5):
+    """ResNet-50 imagenet AMP train-step trace — the bs64 bench
+    configuration (mfu_xla 0.30 in r05 window 2: where do the other 70
+    points go?).  PADDLE_BENCH_RESNET_FMT=NHWC profiles the
+    channels-last variant."""
+    import os
+
+    import jax
+
+    if os.environ.get("PADDLE_BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+        dataset, batch, size = "cifar10", 4, 32
+    else:
+        dataset, size = "imagenet", 224
+
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import resnet
+    from paddle_tpu.executor import Scope, scope_guard
+
+    fmt = os.environ.get("PADDLE_BENCH_RESNET_FMT", "NCHW").upper()
+    main_prog, startup, _, loss, _ = resnet.build(
+        dataset=dataset, amp=(dataset == "imagenet"), data_format=fmt)
+    scope = Scope()
+    with scope_guard(scope):
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        img_shape = ((batch, 3, size, size) if fmt == "NCHW"
+                     else (batch, size, size, 3))
+        feed = {
+            "img": jnp.asarray(rng.randn(*img_shape).astype("float32")),
+            "label": jnp.asarray(
+                rng.randint(0, 10, (batch, 1)).astype("int64")),
+        }
+        _trace_loop(exe, main_prog, feed, loss, steps)
+
+
+def _trace_loop(exe, prog, feed, loss, steps):
+    """The shared trace protocol: 3 warmups, a fetch-synced step (the
+    loss fetch blocks until the device drains — compile + ramp-up stay
+    out of the trace), then `steps` traced dispatches ending on another
+    fetch-sync so the final step's device work is inside the window."""
+    import jax
+
+    for _ in range(3):
+        exe.run(prog, feed=feed, fetch_list=[])
+    exe.run(prog, feed=feed, fetch_list=[loss])
+    jax.profiler.start_trace(TRACE_DIR)
+    for _ in range(steps - 1):
+        exe.run(prog, feed=feed, fetch_list=[])
+    exe.run(prog, feed=feed, fetch_list=[loss])
+    jax.profiler.stop_trace()
 
 
 def _category(name):
@@ -115,7 +165,17 @@ def analyze():
 if __name__ == "__main__":
     import os
 
-    if os.environ.get("PADDLE_BENCH_FORCE_CPU"):
+    model = "bert"
+    if "--model" in sys.argv:
+        idx = sys.argv.index("--model")
+        if idx + 1 >= len(sys.argv):
+            raise SystemExit("--model requires a value (bert|resnet)")
+        model = sys.argv[idx + 1]
+    if model not in ("bert", "resnet"):
+        raise SystemExit("unknown --model %r (bert|resnet)" % model)
+    if model == "resnet":
+        run_and_trace_resnet()
+    elif os.environ.get("PADDLE_BENCH_FORCE_CPU"):
         # CPU smoke: BERT-base bs64 is ~100s/step on CPU — downscale so
         # the tool's plumbing (trace capture + xplane parse) still runs
         run_and_trace(cfg_kw=dict(vocab_size=1024, hidden=128, layers=2,

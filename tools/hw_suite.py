@@ -136,6 +136,11 @@ def build_steps():
     # re-layout costs real transposes on this chip
     item("bench_resnet_nhwc", "resnet", 360, 300,
          PADDLE_BENCH_RESNET_FMT="NHWC")
+    # space-to-depth stem (models/resnet.py _s2d_stem): folds the 7x7
+    # stride-2 3-channel stem — the classic MXU-underfill — into a
+    # dense 4x4/s1 conv over 12 channels (the TPU ResNet stem recipe)
+    item("bench_resnet_s2d", "resnet", 360, 300,
+         PADDLE_BENCH_RESNET_STEM="s2d")
     # inference headline: resnet50 through save_inference_model +
     # AnalysisPredictor (the reference's infer comparison class), and
     # BERT encoder serving as its own item (isolated failure/caps)
@@ -153,6 +158,11 @@ def build_steps():
                       480, None))
     steps.append(("bench_profile", [py, "tools/bench_profile.py"], 700,
                   None))
+    # where do ResNet's other 70 MFU points go?  per-category device
+    # time for the conv workload (r05 window 2: mfu_xla 0.30)
+    steps.append(("bench_profile_resnet",
+                  [py, "tools/bench_profile.py", "--model", "resnet"],
+                  700, None))
     steps.append(("bench_flash_sweep", [py, "tools/bench_flash.py"], 900,
                   None))
     steps.append(("bench_flash_blocks",

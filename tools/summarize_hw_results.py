@@ -109,7 +109,8 @@ def main():
     # resnet sweep (images/sec): batch size + layout
     rn = {}
     for stem in ("bench_resnet", "bench_resnet_bs128",
-                 "bench_resnet_bs256", "bench_resnet_nhwc"):
+                 "bench_resnet_bs256", "bench_resnet_nhwc",
+                 "bench_resnet_s2d"):
         for k, (v, u) in metrics.get(stem, {}).items():
             if k.startswith("resnet50") and v:
                 rn[stem] = (v, u)
