@@ -272,7 +272,9 @@ def child_resnet():
 
     dev = jax.devices()[0]
     on_tpu = _is_tpu_platform(dev.platform)
-    batch = 64 if on_tpu else 4
+    # bs128 measured best on v5e (r05 window 2: 1786 img/s vs 1599 at
+    # bs64, 1747 at bs256 — deeper MXU pipelining per weight load)
+    batch = 128 if on_tpu else 4
     bs_env = os.environ.get("PADDLE_BENCH_RESNET_BS")
     if bs_env:
         batch = int(bs_env)
@@ -583,6 +585,14 @@ def child_bert(seq_len=128):
     if not on_tpu:
         cfg = bert.BERT_TINY  # CPU smoke: prove the path, not the chip
         seq_len = min(seq_len, 128)
+    # A/B knob: PADDLE_BENCH_FUSE_ATTN=0 → the unfused op-chain
+    # attention (matmul/softmax/dropout/matmul ops XLA fuses itself —
+    # the literal r02 graph); default → fused_multihead_attention
+    if os.environ.get("PADDLE_BENCH_FUSE_ATTN", "1") == "0":
+        import copy
+
+        cfg = copy.copy(cfg)
+        cfg.fuse_attn = False
     batch = (64 if seq_len <= 128 else 16) if on_tpu else 8
     bs_env = os.environ.get("PADDLE_BENCH_BERT_BS")
     if bs_env:
@@ -638,7 +648,8 @@ def child_bert(seq_len=128):
                 % (seq_len, batch,
                    " ipr%d" % iters if iters > 1 else "",
                    ("" if max_pred is None else
-                    " fullhead" if max_pred == 0 else " mp%d" % max_pred),
+                    " fullhead" if max_pred == 0 else " mp%d" % max_pred)
+                   + ("" if cfg.fuse_attn else " unfused-attn"),
                    mfu, getattr(dev, "device_kind", str(dev))),
         "vs_baseline": round(mfu / bar, 3),
     }

@@ -120,15 +120,31 @@ def build_steps():
     # bs32 doubles tokens/step at seq512 — bs16 may under-fill the chip
     item("bench_bert512_bs32", "bert512", 420, 300,
          PADDLE_BENCH_BERT_BS="32")
+    # the flash kernel's own regime A/B'd against plain XLA fusion of
+    # the unfused op chain — never measured with the r05 bf16 kernel
+    # (seq128 data says XLA fusion beats the fused fallback there)
+    item("bench_bert512_unfused", "bert512", 420, 300,
+         PADDLE_BENCH_FUSE_ATTN="0")
     # legacy all-position MLM head (the r02 configuration): more
     # MXU-efficient vocab FLOPs → higher MFU, lower tok/s; captures the
     # MFU-optimal point of the tok/s-vs-MFU tradeoff for the record
     item("bench_bert_fullhead", "bert", 300, 300,
          PADDLE_BENCH_MAX_PRED="0")
-    # resnet batch sweep: conv MFU usually rises with batch (deeper MXU
-    # pipelining per weight load); bs128/bs256 vs the bs64 default
-    item("bench_resnet_bs128", "resnet", 360, 300,
-         PADDLE_BENCH_RESNET_BS="128")
+    # fullhead measured 0.397 vs r02's 0.421 on the same head: the
+    # remaining graph delta vs r02 is fused_multihead_attention's
+    # explicit fallback chain vs the unfused ops r02 let XLA fuse —
+    # this arm IS the literal r02 graph (+ the r04/r05 optimizer fixes)
+    item("bench_bert_fullhead_unfused", "bert", 300, 300,
+         PADDLE_BENCH_MAX_PRED="0", PADDLE_BENCH_FUSE_ATTN="0")
+    item("bench_bert_unfused", "bert", 300, 300,
+         PADDLE_BENCH_FUSE_ATTN="0")
+    # resnet batch sweep vs the bs128 default (r05 window 2 flipped the
+    # default 64→128 on measured data: 1786 vs 1599 img/s; the bs64 and
+    # bs256 arms keep the sweep's endpoints for future windows —
+    # bench_resnet_bs128 artifacts from the window-2 capture predate the
+    # default flip and equal today's default config)
+    item("bench_resnet_bs64", "resnet", 360, 300,
+         PADDLE_BENCH_RESNET_BS="64")
     item("bench_resnet_bs256", "resnet", 420, 330,
          PADDLE_BENCH_RESNET_BS="256")
     # channels-last: the TPU-native conv layout (layout-parity proven

@@ -77,7 +77,9 @@ def main():
                 ("bench_bert_flash128", "PADDLE_TPU_FLASH_MIN_T=128",
                  "flash@128"),
                 ("bench_bert_ipr25", "ITERS_PER_RUN=25", "ipr25"),
-                ("bench_bert_best", "ipr25+flash128", "combined-best")):
+                ("bench_bert_best", "ipr25+flash128", "combined-best"),
+                ("bench_bert_unfused", "PADDLE_BENCH_FUSE_ATTN=0",
+                 "unfused-attn")):
             v, m = flagship(stem)
             if v:
                 print("  %-26s %.0f tok/s (%+.1f%%) -> %s wins"
@@ -89,7 +91,9 @@ def main():
         # all-position vocab projection) — judge them on the MFU axis
         mfu_arms = [base_m]
         for stem, label in (("bench_bert_fullhead", "fullhead"),
-                            ("bench_bert_fullhead_ipr", "fullhead+ipr25")):
+                            ("bench_bert_fullhead_ipr", "fullhead+ipr25"),
+                            ("bench_bert_fullhead_unfused",
+                             "fullhead+unfused-attn")):
             fh_v, fh_m = flagship(stem)
             if fh_v:
                 print("  %-26s %.0f tok/s, MFU %s (MFU-axis config; "
@@ -108,9 +112,9 @@ def main():
 
     # resnet sweep (images/sec): batch size + layout
     rn = {}
-    for stem in ("bench_resnet", "bench_resnet_bs128",
-                 "bench_resnet_bs256", "bench_resnet_nhwc",
-                 "bench_resnet_s2d"):
+    for stem in ("bench_resnet", "bench_resnet_bs64",
+                 "bench_resnet_bs128", "bench_resnet_bs256",
+                 "bench_resnet_nhwc", "bench_resnet_s2d"):
         for k, (v, u) in metrics.get(stem, {}).items():
             if k.startswith("resnet50") and v:
                 rn[stem] = (v, u)
@@ -121,9 +125,11 @@ def main():
             print("  %-26s %8.0f img/s%s" % (
                 stem, v, "  <-- best" if stem == best else ""))
 
-    # seq512 batch A/B (the flash kernel's regime)
+    # seq512 A/Bs (the flash kernel's regime): batch size + the
+    # flash-kernel-vs-plain-XLA-fusion decision (unfused arm)
     s5 = {}
-    for stem in ("bench_bert512", "bench_bert512_bs32"):
+    for stem in ("bench_bert512", "bench_bert512_bs32",
+                 "bench_bert512_unfused"):
         for k, (v, u) in metrics.get(stem, {}).items():
             if "seq512" in k and v:
                 s5[stem] = (v, u)
