@@ -585,14 +585,19 @@ def child_bert(seq_len=128):
     if not on_tpu:
         cfg = bert.BERT_TINY  # CPU smoke: prove the path, not the chip
         seq_len = min(seq_len, 128)
-    # A/B knob: PADDLE_BENCH_FUSE_ATTN=0 → the unfused op-chain
-    # attention (matmul/softmax/dropout/matmul ops XLA fuses itself —
-    # the literal r02 graph); default → fused_multihead_attention
-    if os.environ.get("PADDLE_BENCH_FUSE_ATTN", "1") == "0":
+    # A/B knob: PADDLE_BENCH_FUSE_ATTN=0/1 forces the unfused op-chain
+    # attention / the fused_multihead_attention op; unset keeps the
+    # config default ("auto": route by seq_len vs the flash threshold —
+    # the measured winner on both sides)
+    fa_env = os.environ.get("PADDLE_BENCH_FUSE_ATTN")
+    if fa_env not in (None, "", "0", "1", "auto"):
+        raise SystemExit("PADDLE_BENCH_FUSE_ATTN must be 0, 1 or auto, "
+                         "got %r" % fa_env)
+    if fa_env in ("0", "1"):
         import copy
 
         cfg = copy.copy(cfg)
-        cfg.fuse_attn = False
+        cfg.fuse_attn = fa_env == "1"
     batch = (64 if seq_len <= 128 else 16) if on_tpu else 8
     bs_env = os.environ.get("PADDLE_BENCH_BERT_BS")
     if bs_env:
@@ -649,7 +654,8 @@ def child_bert(seq_len=128):
                    " ipr%d" % iters if iters > 1 else "",
                    ("" if max_pred is None else
                     " fullhead" if max_pred == 0 else " mp%d" % max_pred)
-                   + ("" if cfg.fuse_attn else " unfused-attn"),
+                   + ({"auto": "", True: " fused-attn",
+                       False: " unfused-attn"}[cfg.fuse_attn]),
                    mfu, getattr(dev, "device_kind", str(dev))),
         "vs_baseline": round(mfu / bar, 3),
     }

@@ -575,6 +575,15 @@ def _pick_blocks(tq, tk):
     return bq, bk
 
 
+def flash_min_t():
+    """The sequence length at which the blocked Pallas kernel starts
+    beating XLA's fused unblocked attention (measured on v5e: XLA wins
+    at T=128 by 7-26%, the kernel wins at T=512 by ~15%).  Env-tunable
+    so on-chip sweeps can re-decide the boundary; model builders
+    (models/bert.py fuse_attn="auto") route by the same value."""
+    return int(os.environ.get("PADDLE_TPU_FLASH_MIN_T", "256"))
+
+
 def _kernel_applicable(q, k, bias):
     bh, tq, d = q.shape
     _, tk, _ = k.shape
@@ -587,7 +596,7 @@ def _kernel_applicable(q, k, bias):
     # sweeps (tools/bench_flash.py) can re-decide it — with in-kernel
     # dropout the break-even may sit lower, since the XLA path then pays
     # a materialized [B,H,T,T] mask the kernel never writes.
-    min_t = int(os.environ.get("PADDLE_TPU_FLASH_MIN_T", "256"))
+    min_t = flash_min_t()
     if max(tq, tk) < min_t and \
             os.environ.get("PADDLE_TPU_PALLAS") != "interpret":
         return False

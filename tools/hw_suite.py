@@ -94,8 +94,9 @@ def build_steps():
     # the A/Bs; its compile wraps 25 steps in one scan (heavier)
     item("bench_bert_ipr25", "bert", 420, 300,
          PADDLE_BENCH_ITERS_PER_RUN="25")
-    # flash kernel at T=128 WITH in-kernel dropout: if this beats the
-    # default (XLA fallback) line, MIN_T drops to 128 for dropout graphs
+    # flash kernel at T=128 WITH in-kernel dropout (lowering MIN_T also
+    # routes fuse_attn="auto" to the fused op at 128): if this beats
+    # the default line, MIN_T drops to 128 for dropout graphs
     item("bench_bert_flash128", "bert", 300, 300,
          PADDLE_TPU_FLASH_MIN_T="128")
     # fullhead + dispatch amortization: the MFU-maximal candidate (the
@@ -130,14 +131,15 @@ def build_steps():
     # MFU-optimal point of the tok/s-vs-MFU tradeoff for the record
     item("bench_bert_fullhead", "bert", 300, 300,
          PADDLE_BENCH_MAX_PRED="0")
-    # fullhead measured 0.397 vs r02's 0.421 on the same head: the
-    # remaining graph delta vs r02 is fused_multihead_attention's
-    # explicit fallback chain vs the unfused ops r02 let XLA fuse —
-    # this arm IS the literal r02 graph (+ the r04/r05 optimizer fixes)
-    item("bench_bert_fullhead_unfused", "bert", 300, 300,
-         PADDLE_BENCH_MAX_PRED="0", PADDLE_BENCH_FUSE_ATTN="0")
-    item("bench_bert_unfused", "bert", 300, 300,
-         PADDLE_BENCH_FUSE_ATTN="0")
+    # the unfused-vs-fused story is settled and encoded in the
+    # fuse_attn="auto" default (unfused chain below flash_min_t, Pallas
+    # kernel above: bench_bert_unfused 137.9k vs old fused default
+    # 127.5k; bench_bert_fullhead_unfused 124.7k MFU 0.421 == the r02
+    # record).  The default arms now measure the auto graph; this arm
+    # keeps the FORCED-fused fallback path on the record at seq128
+    # (regression canary for the fused op's explicit chain)
+    item("bench_bert_fused", "bert", 300, 300,
+         PADDLE_BENCH_FUSE_ATTN="1")
     # resnet batch sweep vs the bs128 default (r05 window 2 flipped the
     # default 64→128 on measured data: 1786 vs 1599 img/s; the bs64 and
     # bs256 arms keep the sweep's endpoints for future windows —

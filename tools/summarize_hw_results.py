@@ -79,7 +79,9 @@ def main():
                 ("bench_bert_ipr25", "ITERS_PER_RUN=25", "ipr25"),
                 ("bench_bert_best", "ipr25+flash128", "combined-best"),
                 ("bench_bert_unfused", "PADDLE_BENCH_FUSE_ATTN=0",
-                 "unfused-attn")):
+                 "unfused-attn"),
+                ("bench_bert_fused", "PADDLE_BENCH_FUSE_ATTN=1",
+                 "forced-fused")):
             v, m = flagship(stem)
             if v:
                 print("  %-26s %.0f tok/s (%+.1f%%) -> %s wins"
