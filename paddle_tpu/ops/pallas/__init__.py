@@ -8,4 +8,12 @@ kernel here ships with an XLA fallback so every op runs on any backend; the
 Pallas path is selected on TPU (or when interpret-mode testing is forced).
 """
 
-from .flash_attention import flash_attention  # noqa: F401
+# NOTE: deliberately NO `from .flash_attention import flash_attention`
+# re-export: it would rebind the package attribute `flash_attention`
+# from the submodule to the function, so `import
+# paddle_tpu.ops.pallas.flash_attention as FA` (and the from-import of
+# the name) silently yields the FUNCTION — which cost a round-5
+# hardware window its whole block-shape sweep.  Import the function
+# from the submodule: `from paddle_tpu.ops.pallas.flash_attention
+# import flash_attention`.
+from . import flash_attention  # noqa: F401

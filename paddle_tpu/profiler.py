@@ -187,14 +187,37 @@ def _iter_device_xla_events(trace_dir):
                        "%s/%s" % (plane.name, line.name))
 
 
-def device_op_stats(trace_dir):
+ASYNC_OVERLAP_ROW = "~async-in-flight (overlapped)"
+
+
+def _is_async_span(raw_name):
+    """True for HLO async-start ops (copy-start/slice-start/
+    all-gather-start/...) whose xplane event duration spans the whole
+    in-flight window — that window OVERLAPS real compute, so summing it
+    with compute rows double-counts wall time (the r05 TPU profile
+    read 96% 'other' from exactly this)."""
+    head = raw_name.lstrip("%~").split(" ", 1)[0].split(".", 1)[0]
+    return head.endswith("-start") or head in ("send", "recv")
+
+
+def device_op_stats(trace_dir, include_async=False):
     """Aggregate device XLA-op time by Program op from a jax profiler
     trace dir.  Returns {op_type: [calls, total_ms, max_ms, min_ms]};
     events with no pd-tag aggregate under their raw HLO name prefixed
-    '~' (so unattributed time stays visible, not silently dropped)."""
+    '~' (so unattributed time stays visible, not silently dropped).
+    Async-start spans collapse into the single ``ASYNC_OVERLAP_ROW``
+    (their duration overlaps compute rows); ``include_async=True``
+    keeps them as individual rows instead."""
     table = {}
     for raw, tag, _ts, dur_us, _line in _iter_device_xla_events(trace_dir):
-        name = tag[0] if tag else "~" + raw[:60]
+        # async test FIRST: a tagged async span would otherwise bill
+        # its whole overlapped in-flight window to that op's row
+        if not include_async and _is_async_span(raw):
+            name = ASYNC_OVERLAP_ROW
+        elif tag:
+            name = tag[0]
+        else:
+            name = "~" + raw[:60]
         row = table.setdefault(name, [0, 0.0, 0.0, None])
         dt = dur_us / 1e3  # ms
         row[0] += 1
@@ -208,8 +231,11 @@ def device_op_events(trace_dir):
     """Per-event device rows ``[(op_name, ts_us, dur_us, line_name)]``
     with Program-op attribution applied — the chrome-trace material
     (reference ``tools/timeline.py:115`` renders op-named device
-    streams); the aggregate view is :func:`device_op_stats`."""
-    return [(tag[0] if tag else raw, ts, dur, line)
+    streams); the aggregate view is :func:`device_op_stats`.  Async
+    in-flight spans keep their raw HLO name (the timeline SHOWS the
+    overlap; attributing them would bill overlapped time to an op)."""
+    return [(raw if _is_async_span(raw) else (tag[0] if tag else raw),
+             ts, dur, line)
             for raw, tag, ts, dur, line
             in _iter_device_xla_events(trace_dir)]
 

@@ -116,7 +116,12 @@ def test_fused_op_in_program():
     Pallas kernel path (interpret mode) actually engages — this covers the
     registry's generic jax.vjp grad over the kernel's custom_vjp."""
     import paddle_tpu as fluid
-    from paddle_tpu.ops.pallas import flash_attention as _fa_fn  # noqa: F401
+    # the package must expose the SUBMODULE under this name (a
+    # function re-export here once shadowed it and broke every
+    # module-path import — see ops/pallas/__init__.py)
+    from paddle_tpu.ops.pallas import flash_attention as _fa_mod
+
+    assert _fa_mod is FA and callable(_fa_mod.flash_attention)
 
     assert FA._kernel_applicable(
         jnp.zeros((4, 128, 16)), jnp.zeros((4, 128, 16)), None

@@ -108,6 +108,13 @@ def _synthetic_xspace(tmp_path):
     add_event(3, "fusion.11", 1.0, stat_ref=2)
     # an unattributed fusion: must stay visible under '~'
     add_event(4, "fusion.99", 8.0)
+    # async-start spans: their duration covers the whole in-flight
+    # window (overlaps compute) — must collapse into the single
+    # ASYNC_OVERLAP_ROW, even when scope-tagged (the tag would bill
+    # overlapped time to that op)
+    add_event(5, "%copy-start.5 = (bf16[3072]) copy-start(...)", 5.0)
+    add_event(6, "%slice-start.7 = ((f32[30522,768])) async-start", 4.0,
+              stat_str="jit(run)/pd3_conv2d/slice")
     # a host plane that must be ignored entirely
     host = space.planes.add(name="/host:CPU")
     hl = host.lines.add(name="XLA Ops")
@@ -130,10 +137,15 @@ def test_device_op_stats_synthetic(tmp_path):
     assert table["sgd"][0] == 1
     assert abs(table["sgd"][1] - 1.0) < 1e-6
     # unattributed row present, host plane excluded
-    unattr = [k for k in table if k.startswith("~")]
-    assert unattr == ["~fusion.99"]
+    unattr = sorted(k for k in table if k.startswith("~"))
+    assert unattr == [profiler.ASYNC_OVERLAP_ROW, "~fusion.99"]
     assert abs(table["~fusion.99"][1] - 8.0) < 1e-6
-    total = sum(v[1] for v in table.values())
+    # both async spans (tagged or not) collapse into the overlap row —
+    # conv2d's total must NOT include the tagged slice-start's 4ms
+    assert table[profiler.ASYNC_OVERLAP_ROW][0] == 2
+    assert abs(table[profiler.ASYNC_OVERLAP_ROW][1] - 9.0) < 1e-6
+    total = sum(v[1] for n, v in table.items()
+                if n != profiler.ASYNC_OVERLAP_ROW)
     assert abs(total - 15.0) < 1e-6
 
 

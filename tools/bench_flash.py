@@ -41,7 +41,11 @@ def bench_case(T, dropout, use_kernel, B=16, H=12, D=64, steps=30,
     import jax
     import jax.numpy as jnp
 
-    from paddle_tpu.ops.pallas import flash_attention as FA
+    # the package __init__ once re-exported the flash_attention
+    # FUNCTION under this name, shadowing the submodule and breaking
+    # every kernel arm of a hardware sweep ('function' object has no
+    # attribute) — see ops/pallas/__init__.py for the standing rule
+    import paddle_tpu.ops.pallas.flash_attention as FA
 
     rng = np.random.RandomState(0)
     q = jnp.asarray(rng.randn(B, H, T, D).astype(np.float32),

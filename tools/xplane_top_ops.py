@@ -14,6 +14,10 @@ def top_ops(trace_dir, n=35):
     xp = max(xplanes, key=os.path.getmtime)
     space = xplane_pb2.XSpace()
     space.ParseFromString(open(xp, "rb").read())
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from paddle_tpu.profiler import _is_async_span
+
     printed = False
     for plane in space.planes:
         if "TPU" not in plane.name and "/device:" not in plane.name:
@@ -21,19 +25,26 @@ def top_ops(trace_dir, n=35):
         ev_names = plane.event_metadata
         by_name = collections.Counter()
         cnt = collections.Counter()
-        total = 0
+        total = async_ps = async_n = 0
         for line in plane.lines:
             if "XLA Ops" not in line.name and "Ops" != line.name:
                 continue
             for ev in line.events:
                 name = ev_names[ev.metadata_id].name
+                if _is_async_span(name):
+                    # async-start spans overlap real compute: summing
+                    # them with compute rows double-counts wall time
+                    async_ps += ev.duration_ps
+                    async_n += 1
+                    continue
                 by_name[name] += ev.duration_ps
                 cnt[name] += 1
                 total += ev.duration_ps
-        if not total:
+        if not total and not async_n:
             continue
-        print("== plane: %s  (total XLA-op time %.2f ms) ==" % (
-            plane.name, total / 1e9))
+        print("== plane: %s  (total XLA-op time %.2f ms"
+              " + %.2f ms async in-flight over %d events, overlapped)"
+              " ==" % (plane.name, total / 1e9, async_ps / 1e9, async_n))
         printed = True
         for name, ps in by_name.most_common(n):
             print("%8.3f ms  %5.1f%%  x%-4d %s" % (
