@@ -428,11 +428,13 @@ def _export_predictor(main, startup, feed_names, targets, on_tpu,
         exe.run(startup)
         fluid.io.save_inference_model(export_dir, feed_names, targets,
                                       exe, main_program=main)
+    print("# inference model exported", flush=True)
     cfg = fluid.inference.AnalysisConfig(model_dir=export_dir)
     if on_tpu:
         cfg.enable_bf16()
     pred = fluid.inference.create_paddle_predictor(cfg)
     shutil.rmtree(export_dir, ignore_errors=True)
+    print("# predictor built (analysis passes done)", flush=True)
     return pred
 
 
@@ -448,8 +450,14 @@ def _predictor_timing(pred, feed, warmup, steps, lat_runs=10):
         assert np.isfinite(out[0]).all()
         print(json.dumps({"compiled": True}), flush=True)
         return None, None
+    # phase markers: when a watcher cap kills this child, the captured
+    # stdout shows WHICH phase stalled (two r05 bench_infer attempts
+    # died at the cap with no output at all)
+    t0 = time.perf_counter()
     for _ in range(warmup):
         run_once()
+    print("# predictor warmup done in %.1fs" % (time.perf_counter() - t0),
+          flush=True)
     # latency: synchronous single-batch round trips (what one request
     # pays, incl. the tunnel fetch on this setup)
     t0 = time.perf_counter()
@@ -457,6 +465,7 @@ def _predictor_timing(pred, feed, warmup, steps, lat_runs=10):
         out = run_once()
     lat_ms = (time.perf_counter() - t0) / lat_runs * 1e3
     assert np.isfinite(out[0]).all()
+    print("# predictor sync latency %.1f ms/batch" % lat_ms, flush=True)
     # throughput: pipelined batches (serving style — overlap dispatch),
     # synced by a data FETCH of the last output: on the axon tunnel
     # block_until_ready does not actually wait (bench_pure_jax.py

@@ -162,7 +162,10 @@ def build_steps():
     # inference headline: resnet50 through save_inference_model +
     # AnalysisPredictor (the reference's infer comparison class), and
     # BERT encoder serving as its own item (isolated failure/caps)
-    item("bench_infer", "infer", 360, 300)
+    # measure cap 600: two r05 attempts died at 300s with silent
+    # stdout; the child now prints phase markers (export, warmup,
+    # latency) so a third kill is diagnosable
+    item("bench_infer", "infer", 360, 600)
     item("bench_bert_infer", "bert_infer", 360, 300)
     # the rest of the reference's headline benchmark set
     # (fluid_benchmark.py models), proven on silicon: examples/sec lines
