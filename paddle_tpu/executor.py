@@ -371,6 +371,35 @@ def _analyze_block(block, feed_names, fetch_names):
 _LAST_COMPILED_BLOCK = None
 
 
+def promote_readonly_scope_arrays(scope, compiled):
+    """Gather the compiled block's read-only args, promoting host numpy
+    values to device arrays ONCE (written back to the scope).
+
+    Scope values can be host numpy — the analysis passes (e.g.
+    ``fuse_conv_bn``) compute folded weights in numpy and store them:
+    jit would re-transfer those on EVERY dispatch.  Through the axon
+    tunnel that made ResNet-50 inference 30x slower than its own
+    training step (r05 hw window 2: 2.8s/batch ≈ the folded weights
+    re-uploading per call).  rw values need no promotion: they are
+    donated on call and the scope is refreshed from the jit's device
+    outputs (promoting them here would leave donated buffers in the
+    scope if the call raises).  Under SPMD, ``param_shardings`` places
+    the promoted array with its compiled in_sharding directly."""
+    import jax
+
+    ro = {}
+    for n in compiled.ro_names:
+        v = scope.get(n)
+        if isinstance(v, np.ndarray):
+            if compiled.param_shardings is not None:
+                v = jax.device_put(v, compiled.param_shardings[n])
+            else:
+                v = jax.device_put(v)
+            scope.set(n, v)
+        ro[n] = v
+    return ro
+
+
 class _CompiledBlock:
     def __init__(self, program, block, feed_names, fetch_names, scope, mode,
                  mesh=None, accumulate_steps=1, trip_counts=None,
@@ -494,6 +523,7 @@ class _CompiledBlock:
             run_block = step_once
 
         if mesh is None:
+            self.param_shardings = None
             self.jitted = jax.jit(run_block, donate_argnums=(1,))
         else:
             # SPMD: batch dim of every feed sharded over the mesh's data
@@ -551,6 +581,7 @@ class _CompiledBlock:
             feed_sh = {n: batch for n in self.feed_names}
             rw_sh = {n: param_sharding(n) for n in self.rw_names}
             ro_sh = {n: param_sharding(n) for n in self.ro_names}
+            self.param_shardings = dict(ro_sh)
             # pin state OUTPUT shardings to the input classification:
             # under shard_opt_state GSPMD would otherwise follow the
             # sharded moments and emit the updated PARAM sharded too
@@ -928,7 +959,7 @@ class Executor:
                 self._cache[key_tuple] = compiled
 
         rw = {n: scope.get(n) for n in compiled.rw_names}
-        ro = {n: scope.get(n) for n in compiled.ro_names}
+        ro = promote_readonly_scope_arrays(scope, compiled)
         seed = program.random_seed or 0
         base_key = jax.random.fold_in(rng_key(seed), self._step)
         self._step += 1

@@ -577,11 +577,15 @@ def _pick_blocks(tq, tk):
 
 def flash_min_t():
     """The sequence length at which the blocked Pallas kernel starts
-    beating XLA's fused unblocked attention (measured on v5e: XLA wins
-    at T=128 by 7-26%, the kernel wins at T=512 by ~15%).  Env-tunable
-    so on-chip sweeps can re-decide the boundary; model builders
-    (models/bert.py fuse_attn="auto") route by the same value."""
-    return int(os.environ.get("PADDLE_TPU_FLASH_MIN_T", "256"))
+    beating XLA's fused unblocked attention.  r05 v5e sweep
+    (hw_results/bench_flash_sweep.txt): XLA wins at T=128 (model-level
+    +26%) and still edges the kernel at T=256 (attention-level 7-16%,
+    both dropout regimes); the kernel wins at T=512 (+15% model-level,
+    2.1x over XLA / 4.8x over the upstream jax kernel at T=2048) — so
+    the boundary sits at 512.  Env-tunable so on-chip sweeps can
+    re-decide it; model builders (models/bert.py fuse_attn="auto")
+    route by the same value."""
+    return int(os.environ.get("PADDLE_TPU_FLASH_MIN_T", "512"))
 
 
 def _kernel_applicable(q, k, bias):

@@ -15,7 +15,8 @@ import numpy as np
 
 from .. import core
 from ..executor import (_CompiledBlock, _host_table_prefetch,
-                        _host_table_push, global_scope, rng_key)
+                        _host_table_push, global_scope,
+                        promote_readonly_scope_arrays, rng_key)
 from ..framework import Variable, default_main_program
 
 __all__ = ["ParallelExecutor", "SPMDRunner"]
@@ -131,7 +132,7 @@ class SPMDRunner:
             self._cache[key_tuple] = compiled
 
         rw = {n: scope.get(n) for n in compiled.rw_names}
-        ro = {n: scope.get(n) for n in compiled.ro_names}
+        ro = promote_readonly_scope_arrays(scope, compiled)
         seed = self.program.random_seed or 0
         base_key = jax.random.fold_in(rng_key(seed), executor._step)
         executor._step += 1
