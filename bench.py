@@ -598,6 +598,13 @@ def child_bert(seq_len=128):
     # attention / the fused_multihead_attention op; unset keeps the
     # config default ("auto": route by seq_len vs the flash threshold —
     # the measured winner on both sides)
+    if seq_len > cfg.max_seq:
+        # long-context ladder (bert1024/bert2048): extend the position
+        # table to the bench sequence length
+        import copy
+
+        cfg = copy.copy(cfg)
+        cfg.max_seq = seq_len
     fa_env = os.environ.get("PADDLE_BENCH_FUSE_ATTN")
     if fa_env not in (None, "", "0", "1", "auto"):
         raise SystemExit("PADDLE_BENCH_FUSE_ATTN must be 0, 1 or auto, "
@@ -944,8 +951,10 @@ if __name__ == "__main__":
             child_ctr()
         elif mode == "bert":
             child_bert(128)
-        elif mode == "bert512":
-            child_bert(512)
+        elif mode.startswith("bert") and mode[4:].isdigit():
+            # bert512 / bert1024 / bert2048 ... — the long-context
+            # ladder (the flash kernel's regime from MIN_T up)
+            child_bert(int(mode[4:]))
         elif mode == "infer":
             child_infer()
         elif mode == "bert_infer":

@@ -126,6 +126,21 @@ def build_steps():
     # (seq128 data says XLA fusion beats the fused fallback there)
     item("bench_bert512_unfused", "bert512", 420, 300,
          PADDLE_BENCH_FUSE_ATTN="0")
+    # long-context ladder: full-model numbers where the kernel's sweep
+    # advantage is largest (attention-level 1.66x/2.3x at 1024, 2.1x/
+    # 2.9x at 2048 over XLA — hw_results/bench_flash_sweep.txt)
+    item("bench_bert1024", "bert1024", 420, 300)
+    item("bench_bert2048", "bert2048", 420, 300,
+         PADDLE_BENCH_BERT_BS="8")
+    # 0.45-gate push: 83% of the r05 step is matmul (profile artifact),
+    # so batch 128 doubles every GEMM's M dim.  r02 rejected bs128 on
+    # the OLD graph (fused fallback + all-position head); re-decide on
+    # the r05 graph for both head configs
+    item("bench_bert_bs128", "bert", 420, 300,
+         PADDLE_BENCH_BERT_BS="128")
+    item("bench_bert_fullhead_unfused_bs128", "bert", 420, 300,
+         PADDLE_BENCH_BERT_BS="128", PADDLE_BENCH_MAX_PRED="0",
+         PADDLE_BENCH_FUSE_ATTN="0")
     # legacy all-position MLM head (the r02 configuration): more
     # MXU-efficient vocab FLOPs → higher MFU, lower tok/s; captures the
     # MFU-optimal point of the tok/s-vs-MFU tradeoff for the record
