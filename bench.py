@@ -614,15 +614,32 @@ def child_bert(seq_len=128):
 
         cfg = copy.copy(cfg)
         cfg.fuse_attn = fa_env == "1"
+    # A/B knob: PADDLE_BENCH_MAX_PRED=0 → legacy all-position MLM head
+    # (more vocab-matmul FLOPs, the r02 configuration); unset → the
+    # masked-gather default.  MFU denominator follows the choice.
+    # (Parsed here because the fused-QKV default below keys on it.)
+    mp_env = os.environ.get("PADDLE_BENCH_MAX_PRED")
+    max_pred = int(mp_env) if mp_env not in (None, "") else None
+    # fused-QKV defaults ON only in its measured-winning regime: the
+    # gathered-head seq128 flagship (140.1k vs 137.9k tok/s).  The
+    # fullhead graph hits an XLA cliff with it (53.4k, mfu_xla agrees —
+    # genuinely slow program, not a measurement artifact), and longer
+    # sequences are unmeasured.  PADDLE_BENCH_FUSED_QKV=0/1 forces.
+    fq_env = os.environ.get("PADDLE_BENCH_FUSED_QKV")
+    if fq_env not in (None, "", "0", "1"):
+        raise SystemExit("PADDLE_BENCH_FUSED_QKV must be 0 or 1, got %r"
+                         % fq_env)
+    use_qkv = (fq_env == "1") if fq_env in ("0", "1") else (
+        seq_len == 128 and max_pred != 0)
+    if use_qkv:
+        import copy
+
+        cfg = copy.copy(cfg)
+        cfg.fused_qkv = True
     batch = (64 if seq_len <= 128 else 16) if on_tpu else 8
     bs_env = os.environ.get("PADDLE_BENCH_BERT_BS")
     if bs_env:
         batch = int(bs_env)
-    # A/B knob: PADDLE_BENCH_MAX_PRED=0 → legacy all-position MLM head
-    # (more vocab-matmul FLOPs, the r02 configuration); unset → the
-    # masked-gather default.  MFU denominator follows the choice.
-    mp_env = os.environ.get("PADDLE_BENCH_MAX_PRED")
-    max_pred = int(mp_env) if mp_env not in (None, "") else None
     # the timed window ends with one loss fetch; through the axon tunnel a
     # fetch costs ~67ms of pure roundtrip latency, so the window must be
     # long enough to amortize it (real training fetches metrics rarely)

@@ -16,7 +16,8 @@ import paddle_tpu as fluid
 class BertConfig:
     def __init__(self, vocab_size=30522, hidden=768, layers=12, heads=12,
                  ffn=3072, max_seq=512, type_vocab=2, dropout=0.1,
-                 attn_dropout=None, fuse_attn="auto", recompute=False):
+                 attn_dropout=None, fuse_attn="auto", recompute=False,
+                 fused_qkv=False):
         self.vocab_size = vocab_size
         self.hidden = hidden
         self.layers = layers
@@ -36,6 +37,9 @@ class BertConfig:
         # kernel beat XLA fusion by +14.6% at T=512).  True/False force
         # one path (the r05 hardware A/B knobs).
         self.fuse_attn = fuse_attn
+        # one 3d-wide QKV projection GEMM per layer instead of three
+        # d-wide ones (see _attention); opt-in, changes param layout
+        self.fused_qkv = fused_qkv
         # wrap each encoder layer in fluid.layers.recompute() — backward
         # re-runs the layer instead of keeping its activations (the
         # long-sequence memory lever; one extra forward per layer)
@@ -62,9 +66,19 @@ def _attention(x, mask_bias, cfg, prefix):
         t = fluid.layers.reshape(t, [0, 0, cfg.heads, dh])
         return fluid.layers.transpose(t, [0, 2, 1, 3])
 
-    q = split_heads(proj(x, d, "q"))
-    k = split_heads(proj(x, d, "k"))
-    v = split_heads(proj(x, d, "v"))
+    if getattr(cfg, "fused_qkv", False):
+        # one [*, d]x[d, 3d] GEMM instead of three [d, d] GEMMs: fewer,
+        # wider MXU launches (N=2304 amortizes weight loads the three
+        # N=768 launches each pay).  Parameter layout differs from the
+        # per-projection form (one .qkv.w), hence opt-in.
+        qkv = proj(x, 3 * d, "qkv")
+        q = split_heads(fluid.layers.slice(qkv, [2], [0], [d]))
+        k = split_heads(fluid.layers.slice(qkv, [2], [d], [2 * d]))
+        v = split_heads(fluid.layers.slice(qkv, [2], [2 * d], [3 * d]))
+    else:
+        q = split_heads(proj(x, d, "q"))
+        k = split_heads(proj(x, d, "k"))
+        v = split_heads(proj(x, d, "v"))
     fuse = cfg.fuse_attn
     if fuse == "auto":
         # static [B, H, T, dh] shape: route by T against the flash

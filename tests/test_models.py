@@ -327,3 +327,22 @@ def test_resnet_s2d_stem_layout_parity():
                                   "label": y}, fetch_list=[l2])
     np.testing.assert_allclose(np.asarray(v1), np.asarray(v2),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_bert_fused_qkv_trains_and_matches_flops():
+    """fused_qkv=True (one [d,3d] projection GEMM per layer): same
+    function class — the model trains; loss path is finite and the
+    parameter set swaps three .q/.k/.v weights for one .qkv weight."""
+    fluid.unique_name.switch()
+    cfg = bert.BertConfig(vocab_size=256, hidden=64, layers=2, heads=2,
+                          ffn=128, max_seq=64, fused_qkv=True)
+    main, startup, feeds, loss = bert.build_pretrain(
+        cfg, seq_len=32, lr=1e-3, train=True)
+    names = [p.name for p in main.all_parameters()]
+    assert any(".qkv.w" in n for n in names)
+    assert not any(".q.w" in n for n in names)
+    rng = np.random.RandomState(0)
+    feed = bert.make_fake_batch(4, 32, cfg, rng)
+    losses = _train(main, startup, lambda: feed, loss, steps=5)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]

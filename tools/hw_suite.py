@@ -141,6 +141,12 @@ def build_steps():
     item("bench_bert_fullhead_unfused_bs128", "bert", 420, 300,
          PADDLE_BENCH_BERT_BS="128", PADDLE_BENCH_MAX_PRED="0",
          PADDLE_BENCH_FUSE_ATTN="0")
+    # fused-QKV became the gathered-head seq128 DEFAULT after winning
+    # its A/B (bench_bert_qkv artifact, +1.6%); the isolating control
+    # arm is now the knob OFF.  fullhead+qkv stays captured as the XLA
+    # cliff record (53.4k) — do not re-run it.
+    item("bench_bert_noqkv", "bert", 300, 300,
+         PADDLE_BENCH_FUSED_QKV="0")
     # legacy all-position MLM head (the r02 configuration): more
     # MXU-efficient vocab FLOPs → higher MFU, lower tok/s; captures the
     # MFU-optimal point of the tok/s-vs-MFU tradeoff for the record

@@ -44,6 +44,7 @@ def main():
         raise SystemExit("no artifacts under %s" % d)
 
     metrics = {}  # artifact-stem -> {metric: (value, unit)}
+    capture_ts = {}  # artifact-stem -> watcher ts (capture time)
     for p in arts:
         stem = os.path.splitext(os.path.basename(p))[0]
         for l in lines_of(p):
@@ -52,6 +53,9 @@ def main():
                     l.get("value", 0), l.get("unit", ""))
         with open(p) as f:
             txt = f.read()
+        m = re.match(r"\[watcher\] rc=0 ts=(\d+)", txt)
+        if m:
+            capture_ts[stem] = int(m.group(1))
         if "FLASH-PRNG-VALIDATION-OK" in txt:
             print("[ok] %s: FLASH-PRNG-VALIDATION-OK" % stem)
 
@@ -81,12 +85,26 @@ def main():
                 ("bench_bert_unfused", "PADDLE_BENCH_FUSE_ATTN=0",
                  "unfused-attn"),
                 ("bench_bert_fused", "PADDLE_BENCH_FUSE_ATTN=1",
-                 "forced-fused")):
+                 "forced-fused"),
+                ("bench_bert_bs128", "PADDLE_BENCH_BERT_BS=128",
+                 "bs128"),
+                ("bench_bert_qkv", "PADDLE_BENCH_FUSED_QKV=1",
+                 "fused-qkv"),
+                ("bench_bert_noqkv", "PADDLE_BENCH_FUSED_QKV=0",
+                 "no-qkv control")):
             v, m = flagship(stem)
             if v:
-                print("  %-26s %.0f tok/s (%+.1f%%) -> %s wins"
+                # an arm captured BEFORE the default's own capture may
+                # reflect an older default config (e.g. the fused-QKV
+                # default flip): its delta then mixes in unrelated
+                # changes — tag it so close verdicts aren't trusted
+                stale = (capture_ts.get(stem, 0)
+                         < capture_ts.get("bench_bert_default", 0))
+                print("  %-26s %.0f tok/s (%+.1f%%) -> %s wins%s"
                       % (better, v, 100 * (v - base_v) / base_v,
-                         better if v > base_v else "default"))
+                         better if v > base_v else "default",
+                         "  [predates current default capture]"
+                         if stale else ""))
             else:
                 print("  %-26s not captured" % better)
         # fullhead arms trade tok/s for MFU BY DESIGN (restore the
@@ -95,7 +113,11 @@ def main():
         for stem, label in (("bench_bert_fullhead", "fullhead"),
                             ("bench_bert_fullhead_ipr", "fullhead+ipr25"),
                             ("bench_bert_fullhead_unfused",
-                             "fullhead+unfused-attn")):
+                             "fullhead+unfused-attn"),
+                            ("bench_bert_fullhead_unfused_bs128",
+                             "fullhead+unfused+bs128"),
+                            ("bench_bert_fullhead_qkv",
+                             "fullhead+qkv (XLA cliff)")):
             fh_v, fh_m = flagship(stem)
             if fh_v:
                 print("  %-26s %.0f tok/s, MFU %s (MFU-axis config; "
