@@ -147,6 +147,11 @@ def build_steps():
     # cliff record (53.4k) — do not re-run it.
     item("bench_bert_noqkv", "bert", 300, 300,
          PADDLE_BENCH_FUSED_QKV="0")
+    # does fused-QKV extend to the flash-kernel regime?  (unmeasured —
+    # the seq128 win and the fullhead cliff both came from the unfused
+    # graph; the kernel consumes q/k/v slices directly)
+    item("bench_bert512_qkv", "bert512", 420, 300,
+         PADDLE_BENCH_FUSED_QKV="1")
     # legacy all-position MLM head (the r02 configuration): more
     # MXU-efficient vocab FLOPs → higher MFU, lower tok/s; captures the
     # MFU-optimal point of the tok/s-vs-MFU tradeoff for the record
