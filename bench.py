@@ -636,6 +636,13 @@ def child_bert(seq_len=128):
 
         cfg = copy.copy(cfg)
         cfg.fused_qkv = True
+    # A/B knob: fused dropout+add+layer_norm Pallas op (opt-in pending
+    # its hardware A/B — the profile bills the unfused glue ~8% of step)
+    if os.environ.get("PADDLE_BENCH_FUSED_LN") == "1":
+        import copy
+
+        cfg = copy.copy(cfg)
+        cfg.fused_ln = True
     batch = (64 if seq_len <= 128 else 16) if on_tpu else 8
     bs_env = os.environ.get("PADDLE_BENCH_BERT_BS")
     if bs_env:

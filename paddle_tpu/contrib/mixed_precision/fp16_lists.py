@@ -20,6 +20,7 @@ white_list = {
     "depthwise_conv2d",
     "conv3d",
     "conv2d_transpose",
+    "fused_dropout_add_ln",
     "fused_multihead_attention",
     # elementwise / activation glue
     "elementwise_add",

@@ -556,7 +556,8 @@ class Program:
                     no.attrs["is_test"] = True
                 if for_test and op.type in (
                         "dropout", "batch_norm", "layer_norm",
-                        "fused_multihead_attention"):
+                        "fused_multihead_attention",
+                        "fused_dropout_add_ln"):
                     no.attrs["is_test"] = True
                 nb.ops.append(no)
         p.current_block_idx = 0

@@ -35,6 +35,7 @@ __all__ = [
     "reduce_prod",
     "matmul",
     "mul",
+    "fused_dropout_add_ln",
     "fused_multihead_attention",
     "elementwise_add",
     "elementwise_sub",
@@ -1078,6 +1079,35 @@ def cos_sim(X, Y):
     xn = l2_normalize(X, axis=-1)
     yn = l2_normalize(Y, axis=-1)
     return reduce_sum(elementwise_mul(xn, yn), dim=-1, keep_dim=True)
+
+
+def fused_dropout_add_ln(x, residual, dropout_prob=0.0, epsilon=1e-5,
+                         param_attr=None, bias_attr=None, name=None):
+    """``layer_norm(residual + dropout(x))`` over the LAST axis as one
+    fused op (Pallas kernel on TPU, XLA expression elsewhere) — the
+    transformer encoder's inter-GEMM glue without the intermediate HBM
+    round-trips.  Creates LN scale/bias parameters of shape [D] like
+    ``layer_norm(begin_norm_axis=ndim-1)``."""
+    helper = LayerHelper("fused_dropout_add_ln", **locals())
+    d = x.shape[-1]
+    # params match layer_norm's exactly (float32 + is_bias) so the two
+    # graph forms stay checkpoint-compatible under the same names
+    scale = helper.create_parameter(
+        attr=helper.param_attr, shape=[d], dtype="float32",
+        default_initializer=ConstantInitializer(1.0))
+    bias = helper.create_parameter(
+        attr=helper.bias_attr, shape=[d], dtype="float32",
+        is_bias=True)
+    out = helper.create_variable_for_type_inference(x.dtype)
+    helper.append_op(
+        type="fused_dropout_add_ln",
+        inputs={"X": [x], "Residual": [residual], "Scale": [scale],
+                "Bias": [bias]},
+        outputs={"Out": [out]},
+        attrs={"dropout_prob": float(dropout_prob),
+               "epsilon": float(epsilon)},
+    )
+    return out
 
 
 def fused_multihead_attention(q, k, v, bias=None, causal=False, scale=None,

@@ -17,7 +17,7 @@ class BertConfig:
     def __init__(self, vocab_size=30522, hidden=768, layers=12, heads=12,
                  ffn=3072, max_seq=512, type_vocab=2, dropout=0.1,
                  attn_dropout=None, fuse_attn="auto", recompute=False,
-                 fused_qkv=False):
+                 fused_qkv=False, fused_ln=False):
         self.vocab_size = vocab_size
         self.hidden = hidden
         self.layers = layers
@@ -40,6 +40,10 @@ class BertConfig:
         # one 3d-wide QKV projection GEMM per layer instead of three
         # d-wide ones (see _attention); opt-in, changes param layout
         self.fused_qkv = fused_qkv
+        # route the encoder's dropout+residual+layer_norm glue through
+        # the fused Pallas op (layers.fused_dropout_add_ln) — one VMEM
+        # pass instead of three HBM-bound ops; opt-in pending hardware A/B
+        self.fused_ln = fused_ln
         # wrap each encoder layer in fluid.layers.recompute() — backward
         # re-runs the layer instead of keeping its activations (the
         # long-sequence memory lever; one extra forward per layer)
@@ -109,17 +113,31 @@ def _attention(x, mask_bias, cfg, prefix):
     return proj(ctx, d, "o")
 
 
+def _sublayer_close(x, sub, cfg, ln_name):
+    """The encoder's inter-GEMM glue, ``layer_norm(x + dropout(sub))``:
+    either the three-op chain (XLA fuses what it can) or the single
+    fused Pallas op (cfg.fused_ln) — identical math, same LN param
+    names/shapes either way."""
+    if cfg.fused_ln:
+        return fluid.layers.fused_dropout_add_ln(
+            sub, x, dropout_prob=cfg.dropout or 0.0,
+            param_attr=fluid.ParamAttr(name=ln_name + ".scale"),
+            bias_attr=fluid.ParamAttr(name=ln_name + ".bias"),
+        )
+    if cfg.dropout:
+        sub = fluid.layers.dropout(
+            sub, cfg.dropout, dropout_implementation="upscale_in_train"
+        )
+    return fluid.layers.layer_norm(
+        fluid.layers.elementwise_add(x, sub), begin_norm_axis=2,
+        param_attr=fluid.ParamAttr(name=ln_name + ".scale"),
+        bias_attr=fluid.ParamAttr(name=ln_name + ".bias"),
+    )
+
+
 def _encoder_layer(x, mask_bias, cfg, prefix):
     attn = _attention(x, mask_bias, cfg, prefix + ".attn")
-    if cfg.dropout:
-        attn = fluid.layers.dropout(
-            attn, cfg.dropout, dropout_implementation="upscale_in_train"
-        )
-    x = fluid.layers.layer_norm(
-        fluid.layers.elementwise_add(x, attn), begin_norm_axis=2,
-        param_attr=fluid.ParamAttr(name=prefix + ".ln1.scale"),
-        bias_attr=fluid.ParamAttr(name=prefix + ".ln1.bias"),
-    )
+    x = _sublayer_close(x, attn, cfg, prefix + ".ln1")
     ff = fluid.layers.fc(
         x, size=cfg.ffn, num_flatten_dims=2, act="gelu",
         param_attr=fluid.ParamAttr(name=prefix + ".ffn1.w"),
@@ -130,15 +148,7 @@ def _encoder_layer(x, mask_bias, cfg, prefix):
         param_attr=fluid.ParamAttr(name=prefix + ".ffn2.w"),
         bias_attr=fluid.ParamAttr(name=prefix + ".ffn2.b"),
     )
-    if cfg.dropout:
-        ff = fluid.layers.dropout(
-            ff, cfg.dropout, dropout_implementation="upscale_in_train"
-        )
-    return fluid.layers.layer_norm(
-        fluid.layers.elementwise_add(x, ff), begin_norm_axis=2,
-        param_attr=fluid.ParamAttr(name=prefix + ".ln2.scale"),
-        bias_attr=fluid.ParamAttr(name=prefix + ".ln2.bias"),
-    )
+    return _sublayer_close(x, ff, cfg, prefix + ".ln2")
 
 
 def encoder(input_ids, token_type_ids, attn_mask_bias, cfg, seq_len):

@@ -541,6 +541,33 @@ def fused_multihead_attention(ctx, attrs, Q, K, V, BiasQK=None):
                            dropout_seed=seed)
 
 
+@register_op("fused_dropout_add_ln", inputs=["X", "Residual", "Scale",
+                                             "Bias"],
+             outputs=["Out"])
+def fused_dropout_add_ln(ctx, attrs, X, Residual, Scale, Bias):
+    """``layer_norm(residual + dropout(x))`` in one Pallas pass
+    (ops/pallas/fused_ln.py; reference analogue: the fused_elemwise /
+    layer_norm JIT kernels).  X/Residual: [..., D] normalized over the
+    last axis; Scale/Bias: [D]."""
+    from .pallas.fused_ln import fused_dropout_add_ln as _fused
+
+    rate = float(attrs.get("dropout_prob", 0.0) or 0.0)
+    if attrs.get("is_test") or ctx.mode == "infer":
+        rate = 0.0
+    eps = float(attrs.get("epsilon", 1e-5))
+    seed = None
+    if rate > 0.0:
+        # per-step, per-op seed from the deterministic ctx key chain
+        # (the grad op's recompute draws the SAME seed/mask)
+        seed = jax.random.randint(ctx.rng(), (1,), 0, 2 ** 31 - 1,
+                                  dtype=jnp.int32)
+    shape = jnp.shape(X)
+    d = shape[-1]
+    out = _fused(X.reshape(-1, d), Residual.reshape(-1, d), Scale, Bias,
+                 dropout_rate=rate, eps=eps, seed=seed)
+    return out.reshape(shape)
+
+
 @register_op("selu", inputs=["X"], outputs=["Out"])
 def selu(ctx, attrs, X):
     """scale * (max(0,x) + min(0, alpha*(exp(x)-1))) (selu_op.cc)."""
