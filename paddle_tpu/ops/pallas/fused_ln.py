@@ -102,8 +102,20 @@ def _bwd_kernel(dout_ref, y_ref, g_ref, mean_ref, rstd_ref, seed_ref,
     mean = mean_ref[...].reshape(-1, 1)
     rstd = rstd_ref[...].reshape(-1, 1)
     xhat = (y - mean) * rstd
-    dg_ref[...] = jnp.sum(dout * xhat, axis=0, keepdims=True)
-    db_ref[...] = jnp.sum(dout, axis=0, keepdims=True)
+
+    # dgamma/dbeta: TPU grid steps run sequentially and revisit the
+    # same [1, D] output block (index_map pins (0, 0)), so accumulate
+    # across row blocks in-kernel — a [grid, D] partials array would
+    # need a block first-dim of 1, which Mosaic's (8, 128) tiling
+    # rejects (this exact lowering error cost the first hardware
+    # attempt of the A/B)
+    @pl.when(i == 0)
+    def _init():
+        dg_ref[...] = jnp.zeros(dg_ref.shape, dg_ref.dtype)
+        db_ref[...] = jnp.zeros(db_ref.shape, db_ref.dtype)
+
+    dg_ref[...] += jnp.sum(dout * xhat, axis=0, keepdims=True)
+    db_ref[...] += jnp.sum(dout, axis=0, keepdims=True)
     dxhat = dout * g_ref[...].astype(jnp.float32)
     m1 = jnp.mean(dxhat, axis=1, keepdims=True)
     m2 = jnp.mean(dxhat * xhat, axis=1, keepdims=True)
@@ -211,14 +223,14 @@ def _bwd_call(dout, y, gamma, mean, rstd, rate, seed, dtypes):
         out_specs=[
             pl.BlockSpec((bn, d), lambda i: (i, 0)),
             pl.BlockSpec((bn, d), lambda i: (i, 0)),
-            pl.BlockSpec((1, d), lambda i: (i, 0)),
-            pl.BlockSpec((1, d), lambda i: (i, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((n, d), dtypes[0]),
             jax.ShapeDtypeStruct((n, d), dtypes[1]),
-            jax.ShapeDtypeStruct((n // bn, d), jnp.float32),
-            jax.ShapeDtypeStruct((n // bn, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, d), jnp.float32),
+            jax.ShapeDtypeStruct((1, d), jnp.float32),
         ],
         interpret=interpret,
     )(dout, y, gamma.reshape(1, d), mean, rstd, seed)
@@ -241,11 +253,10 @@ def _fused_core_bwd(rate, eps, saved, dout):
     # y was stored in x's dtype and residual/beta share the model's
     # compute dtypes (y / gamma respectively) — cotangent dtypes follow
     y, gamma, mean, rstd, seed = saved
-    dx, dres, dg_part, db_part = _bwd_call(
+    dx, dres, dg, db = _bwd_call(
         dout, y, gamma, mean, rstd, rate, seed, (y.dtype, y.dtype))
-    dg = jnp.sum(dg_part, axis=0).astype(gamma.dtype)
-    db = jnp.sum(db_part, axis=0).astype(gamma.dtype)
-    return dx, dres, dg, db, None
+    return (dx, dres, dg.reshape(-1).astype(gamma.dtype),
+            db.reshape(-1).astype(gamma.dtype), None)
 
 
 _fused_core.defvjp(_fused_core_fwd, _fused_core_bwd)

@@ -152,13 +152,17 @@ def build_steps():
     # graph; the kernel consumes q/k/v slices directly)
     item("bench_bert512_qkv", "bert512", 420, 300,
          PADDLE_BENCH_FUSED_QKV="1")
-    # fused dropout+add+layer_norm Pallas kernel (ops/pallas/fused_ln.py):
-    # the profile bills the unfused glue ~4.6ms of the 58ms step — both
-    # tok/s-axis (default+) and MFU-axis (fullhead) candidates
-    item("bench_bert_fusedln", "bert", 360, 300,
+    # fused dropout+add+layer_norm became the seq128 default after its
+    # A/B (+26%, gate-crossing MFU 0.488/0.480; on-chip validation
+    # artifact below).  The control arm measures the knob OFF; the
+    # seq512 arm decides whether the default extends to the flash
+    # regime.
+    steps.append(("validate_fused_ln",
+                  [py, "tools/validate_fused_ln.py"], 420, None))
+    item("bench_bert_nofusedln", "bert", 360, 300,
+         PADDLE_BENCH_FUSED_LN="0")
+    item("bench_bert512_fusedln", "bert512", 420, 300,
          PADDLE_BENCH_FUSED_LN="1")
-    item("bench_bert_fullhead_fusedln", "bert", 360, 300,
-         PADDLE_BENCH_MAX_PRED="0", PADDLE_BENCH_FUSED_LN="1")
     # legacy all-position MLM head (the r02 configuration): more
     # MXU-efficient vocab FLOPs → higher MFU, lower tok/s; captures the
     # MFU-optimal point of the tok/s-vs-MFU tradeoff for the record

@@ -91,7 +91,11 @@ def main():
                 ("bench_bert_qkv", "PADDLE_BENCH_FUSED_QKV=1",
                  "fused-qkv"),
                 ("bench_bert_noqkv", "PADDLE_BENCH_FUSED_QKV=0",
-                 "no-qkv control")):
+                 "no-qkv control"),
+                ("bench_bert_fusedln", "PADDLE_BENCH_FUSED_LN=1",
+                 "fused-ln (now default)"),
+                ("bench_bert_nofusedln", "PADDLE_BENCH_FUSED_LN=0",
+                 "no-fused-ln control")):
             v, m = flagship(stem)
             if v:
                 # an arm captured BEFORE the default's own capture may
@@ -117,7 +121,9 @@ def main():
                             ("bench_bert_fullhead_unfused_bs128",
                              "fullhead+unfused+bs128"),
                             ("bench_bert_fullhead_qkv",
-                             "fullhead+qkv (XLA cliff)")):
+                             "fullhead+qkv (XLA cliff)"),
+                            ("bench_bert_fullhead_fusedln",
+                             "fullhead+fused-ln")):
             fh_v, fh_m = flagship(stem)
             if fh_v:
                 print("  %-26s %.0f tok/s, MFU %s (MFU-axis config; "
@@ -153,7 +159,8 @@ def main():
     # flash-kernel-vs-plain-XLA-fusion decision (unfused arm)
     s5 = {}
     for stem in ("bench_bert512", "bench_bert512_bs32",
-                 "bench_bert512_unfused"):
+                 "bench_bert512_unfused", "bench_bert512_qkv",
+                 "bench_bert512_fusedln"):
         for k, (v, u) in metrics.get(stem, {}).items():
             if "seq512" in k and v:
                 s5[stem] = (v, u)
