@@ -620,26 +620,10 @@ def child_bert(seq_len=128):
     # (Parsed here because the fused-QKV default below keys on it.)
     mp_env = os.environ.get("PADDLE_BENCH_MAX_PRED")
     max_pred = int(mp_env) if mp_env not in (None, "") else None
-    # fused-QKV defaults ON only in its measured-winning regime: the
-    # gathered-head seq128 flagship (140.1k vs 137.9k tok/s).  The
-    # fullhead graph hits an XLA cliff with it (53.4k, mfu_xla agrees —
-    # genuinely slow program, not a measurement artifact), and longer
-    # sequences are unmeasured.  PADDLE_BENCH_FUSED_QKV=0/1 forces.
-    fq_env = os.environ.get("PADDLE_BENCH_FUSED_QKV")
-    if fq_env not in (None, "", "0", "1"):
-        raise SystemExit("PADDLE_BENCH_FUSED_QKV must be 0 or 1, got %r"
-                         % fq_env)
-    use_qkv = (fq_env == "1") if fq_env in ("0", "1") else (
-        seq_len == 128 and max_pred != 0)
-    if use_qkv:
-        import copy
-
-        cfg = copy.copy(cfg)
-        cfg.fused_qkv = True
     # fused dropout+add+layer_norm Pallas op: measured +26% at seq128
     # on BOTH heads (gathered 176.2k vs 140.3k same-session control;
-    # fullhead MFU 0.480 vs 0.421 — past the 0.45 gate) and +12.6% at
-    # seq512 (125.7k vs 111.6k), validated on chip
+    # fullhead MFU 0.480 vs 0.421 — past the 0.45 gate) and +13/+16/
+    # +10% at seq512/1024/2048, validated on chip
     # (tools/validate_fused_ln.py: mask mass, determinism, rate-0
     # parity, convergence).  Default ON; PADDLE_BENCH_FUSED_LN=0 forces
     # the three-op chain.
@@ -653,6 +637,23 @@ def child_bert(seq_len=128):
 
         cfg = copy.copy(cfg)
         cfg.fused_ln = True
+    # fused-QKV: wins at seq128 on the gathered head (140.1k vs
+    # 137.9k), and WITH fused-LN on the fullhead too (0.504 vs 0.480 —
+    # the pre-fused-LN fullhead cliff at 53.4k was a fusion-boundary
+    # artifact the fused kernel removes).  Without fused-LN the
+    # fullhead cliff stands, and longer sequences measured neutral, so
+    # the default keys on all three.  PADDLE_BENCH_FUSED_QKV=0/1 forces.
+    fq_env = os.environ.get("PADDLE_BENCH_FUSED_QKV")
+    if fq_env not in (None, "", "0", "1"):
+        raise SystemExit("PADDLE_BENCH_FUSED_QKV must be 0 or 1, got %r"
+                         % fq_env)
+    use_qkv = (fq_env == "1") if fq_env in ("0", "1") else (
+        seq_len == 128 and (use_fln or max_pred != 0))
+    if use_qkv:
+        import copy
+
+        cfg = copy.copy(cfg)
+        cfg.fused_qkv = True
     batch = (64 if seq_len <= 128 else 16) if on_tpu else 8
     bs_env = os.environ.get("PADDLE_BENCH_BERT_BS")
     if bs_env:

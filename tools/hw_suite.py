@@ -141,10 +141,11 @@ def build_steps():
     item("bench_bert_fullhead_unfused_bs128", "bert", 420, 300,
          PADDLE_BENCH_BERT_BS="128", PADDLE_BENCH_MAX_PRED="0",
          PADDLE_BENCH_FUSE_ATTN="0")
-    # fused-QKV became the gathered-head seq128 DEFAULT after winning
-    # its A/B (bench_bert_qkv artifact, +1.6%); the isolating control
-    # arm is now the knob OFF.  fullhead+qkv stays captured as the XLA
-    # cliff record (53.4k) — do not re-run it.
+    # fused-QKV became the seq128 DEFAULT after winning its A/Bs
+    # (gathered +1.6%; on the fullhead it wins only WITH fused-LN —
+    # the bench_bert_fullhead_qkv artifact records the PRE-fused-LN
+    # cliff at 53.4k, superseded by bench_bert_fullhead_qkv_fln at MFU
+    # 0.504).  This control isolates the knob on the gathered head.
     item("bench_bert_noqkv", "bert", 300, 300,
          PADDLE_BENCH_FUSED_QKV="0")
     # does fused-QKV extend to the flash-kernel regime?  (unmeasured —
@@ -162,6 +163,15 @@ def build_steps():
     item("bench_bert_nofusedln", "bert", 360, 300,
          PADDLE_BENCH_FUSED_LN="0")
     item("bench_bert512_fusedln", "bert512", 420, 300,
+         PADDLE_BENCH_FUSED_LN="1")
+    # fullhead+QKV+fused-LN measured MFU 0.504 (the pre-fused-LN
+    # fullhead+qkv cliff at 53.4k was a fusion-boundary artifact the
+    # fused kernel removes) and is now the bench_bert_fullhead DEFAULT
+    # config; this control isolates the qkv term on the fullhead (the
+    # 0.480 point).  fln pinned explicitly: the arm's claim must not
+    # depend on the ambient default.
+    item("bench_bert_fullhead_noqkv", "bert", 360, 300,
+         PADDLE_BENCH_MAX_PRED="0", PADDLE_BENCH_FUSED_QKV="0",
          PADDLE_BENCH_FUSED_LN="1")
     # legacy all-position MLM head (the r02 configuration): more
     # MXU-efficient vocab FLOPs → higher MFU, lower tok/s; captures the

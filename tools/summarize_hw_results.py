@@ -123,7 +123,11 @@ def main():
                             ("bench_bert_fullhead_qkv",
                              "fullhead+qkv (XLA cliff)"),
                             ("bench_bert_fullhead_fusedln",
-                             "fullhead+fused-ln")):
+                             "fullhead+fused-ln"),
+                            ("bench_bert_fullhead_qkv_fln",
+                             "fullhead+qkv+fused-ln"),
+                            ("bench_bert_fullhead_noqkv",
+                             "fullhead+fused-ln no-qkv control")):
             fh_v, fh_m = flagship(stem)
             if fh_v:
                 print("  %-26s %.0f tok/s, MFU %s (MFU-axis config; "
