@@ -25,7 +25,12 @@ def run_and_trace(cfg_kw=None, batch=64, seq_len=128, steps=5):
     from paddle_tpu.models import bert
     from paddle_tpu.executor import Scope, scope_guard
 
-    cfg = bert.BertConfig(**cfg_kw) if cfg_kw else bert.BERT_BASE
+    if cfg_kw:
+        cfg = bert.BertConfig(**cfg_kw)
+    else:
+        # trace the SHIPPED flagship config (bench.py child_bert
+        # defaults): fused-LN glue + fused-QKV projections
+        cfg = bert.BertConfig(fused_ln=True, fused_qkv=True)
     main_prog, startup, _, loss = bert.build_pretrain(
         cfg, seq_len=seq_len, lr=1e-4, amp=True, train=True
     )
@@ -116,6 +121,10 @@ def _category(name):
         return "loss"
     if "multihead" in n or "flash" in n or n == "softmax":
         return "attention"
+    if n.startswith("fused_dropout_add_ln"):
+        # the fused glue kernel carries dropout+residual+LN — its own
+        # bucket, not "dropout" (which would overstate dropout 4x)
+        return "fused-ln-glue"
     if n in ("sum", "scale") or any(
             k in n for k in ("adam", "sgd", "momentum", "lamb", "clip")):
         return "optimizer"
