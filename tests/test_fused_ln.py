@@ -179,3 +179,31 @@ def test_bert_fused_ln_trains_with_dropout():
         a = exe.run(main2, feed=feed, fetch_list=[loss2])[0]
         b = exe.run(main2, feed=feed, fetch_list=[loss2])[0]
     np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_bert_fused_ln_under_recompute():
+    """cfg.recompute wraps each encoder layer in fluid.layers.recompute
+    (backward re-runs the forward): the fused op's dropout seed comes
+    from the deterministic ctx key chain, so the replay must draw the
+    IDENTICAL mask — trains finite and decreasing."""
+    import paddle_tpu as fluid
+    from paddle_tpu.executor import Scope, scope_guard
+    from paddle_tpu.models import bert
+
+    fluid.unique_name.switch()
+    cfg = bert.BertConfig(vocab_size=128, hidden=128, layers=2, heads=2,
+                          ffn=256, max_seq=32, dropout=0.1,
+                          fused_ln=True, recompute=True)
+    main, startup, _, loss = bert.build_pretrain(
+        cfg, seq_len=32, lr=1e-3, train=True)
+    rng = np.random.RandomState(2)
+    feed = bert.make_fake_batch(2, 32, cfg, rng)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        vals = []
+        for _ in range(6):
+            lv = exe.run(main, feed=feed, fetch_list=[loss])[0]
+            vals.append(float(np.asarray(lv).reshape(-1)[0]))
+    assert np.isfinite(vals).all()
+    assert vals[-1] < vals[0]
