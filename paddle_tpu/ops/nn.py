@@ -191,6 +191,10 @@ def layer_norm(ctx, attrs, X, Scale, Bias):
     axes = tuple(range(begin, jnp.ndim(X)))
     x32 = X.astype(jnp.float32)
     mean = jnp.mean(x32, axis=axes, keepdims=True)
+    # deliberately the TWO-pass variance (not batch_norm's single-pass
+    # E[x^2]-E[x]^2): per-row LN stats see drifting residual-stream
+    # means where the cancellation form loses all precision, and norm
+    # is 0.2% of the profiled step — there is no perf win to buy here
     var = jnp.mean(jnp.square(x32 - mean), axis=axes, keepdims=True)
     y = (x32 - mean) * jax.lax.rsqrt(var + eps)
     # Scale/Bias are stored flattened over the normalized dims
@@ -230,7 +234,14 @@ def batch_norm(ctx, attrs, X, Scale, Bias, Mean, Variance):
         saved_mean, saved_var = Mean, Variance
     else:
         bm = jnp.mean(x32, axis=reduce_axes)
-        bv = jnp.mean(jnp.square(x32 - bm.reshape(bshape)), axis=reduce_axes)
+        # single-pass variance E[x^2] - E[x]^2: both reductions read x
+        # ONCE (XLA fuses them into one sweep) instead of the dependent
+        # two-pass mean(square(x - mean)) form, which forces a second
+        # full pass over the activation per BN site.  f32 accumulation;
+        # clamped >= 0 against cancellation on near-constant channels.
+        bv = jnp.maximum(
+            jnp.mean(jnp.square(x32), axis=reduce_axes) - jnp.square(bm),
+            0.0)
         use_mean, use_var = bm, bv
         mean_out = Mean * momentum + bm * (1 - momentum)
         var_out = Variance * momentum + bv * (1 - momentum)
@@ -892,17 +903,22 @@ def group_norm_op(ctx, attrs, X, Scale, Bias):
     g = int(attrs.get("groups", 1))
     eps = float(attrs.get("epsilon", 1e-5))
     n, c = X.shape[0], X.shape[1]
-    xg = X.reshape((n, g, c // g) + X.shape[2:])
+    xg = X.reshape((n, g, c // g) + X.shape[2:]).astype(jnp.float32)
     axes = tuple(range(2, xg.ndim))
     mean = jnp.mean(xg, axis=axes, keepdims=True)
-    var = jnp.mean(jnp.square(xg - mean), axis=axes, keepdims=True)
+    # single-pass E[x^2]-E[x]^2 (see batch_norm); stats in f32 — the
+    # cancellation form needs full-precision accumulation under AMP
+    var = jnp.maximum(
+        jnp.mean(jnp.square(xg), axis=axes, keepdims=True)
+        - jnp.square(mean), 0.0)
     y = ((xg - mean) * jax.lax.rsqrt(var + eps)).reshape(X.shape)
     shape = (1, c) + (1,) * (X.ndim - 2)
     if Scale is not None:
-        y = y * Scale.reshape(shape)
+        y = y * Scale.reshape(shape).astype(jnp.float32)
     if Bias is not None:
-        y = y + Bias.reshape(shape)
-    return {"Y": y, "Mean": mean.reshape(n, g), "Variance": var.reshape(n, g)}
+        y = y + Bias.reshape(shape).astype(jnp.float32)
+    return {"Y": y.astype(X.dtype), "Mean": mean.reshape(n, g),
+            "Variance": var.reshape(n, g)}
 
 
 @register_op(
