@@ -126,3 +126,36 @@ class TestSyncBatchNorm:
         dp = _train(_bn_model, batches, data_parallel=True)
         np.testing.assert_allclose(dp, single, rtol=3e-4, atol=3e-4)
         assert single[-1] < single[0]
+
+
+def test_batch_norm_single_pass_variance_numerics():
+    """The BN training stats use the single-pass E[x^2]-E[x]^2 form
+    (one activation sweep — +12% ResNet-50 on v5e).  Pin its numerics:
+    matches numpy's two-pass variance on ordinary activations, and the
+    >=0 clamp keeps constant channels finite (cancellation would
+    otherwise produce a small negative under rsqrt)."""
+    import numpy as np
+
+    import paddle_tpu as fluid
+    from paddle_tpu.executor import Scope, scope_guard
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 4, 6, 6).astype("float32") * 3.0 + 5.0
+    x[:, 2] = 7.25  # a CONSTANT channel: true var 0
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xin = fluid.layers.data("x", shape=[4, 6, 6], dtype="float32")
+        y = fluid.layers.batch_norm(xin)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        (out,) = exe.run(main, feed={"x": x}, fetch_list=[y])
+    out = np.asarray(out)
+    assert np.isfinite(out).all()
+    # normal channels: matches the reference two-pass normalization
+    for c in (0, 1, 3):
+        ch = x[:, c]
+        ref = (ch - ch.mean()) / np.sqrt(ch.var() + 1e-5)
+        np.testing.assert_allclose(out[:, c], ref, atol=2e-4, rtol=2e-4)
+    # constant channel: var clamps to ~0 -> output ~(x-mean)*rsqrt(eps)=0
+    np.testing.assert_allclose(out[:, 2], 0.0, atol=1e-2)
