@@ -207,3 +207,35 @@ def test_bert_fused_ln_under_recompute():
             vals.append(float(np.asarray(lv).reshape(-1)[0]))
     assert np.isfinite(vals).all()
     assert vals[-1] < vals[0]
+
+
+def test_fused_ln_model_inference_export_roundtrip(tmp_path):
+    """A model using layers.fused_dropout_add_ln survives
+    save_inference_model → AnalysisPredictor (the analysis passes must
+    pass the op through; the exported eval graph runs it with
+    is_test → dropout off, deterministically)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.executor import Scope, scope_guard
+
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2, 256], dtype="float32")
+        h = fluid.layers.fc(x, size=256, num_flatten_dims=2, act="relu")
+        out = fluid.layers.fused_dropout_add_ln(h, x, dropout_prob=0.1)
+        logits = fluid.layers.fc(out, size=4, num_flatten_dims=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    path = str(tmp_path / "m")
+    with scope_guard(Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(path, ["x"], [logits], exe,
+                                      main_program=main)
+    pred = fluid.inference.create_paddle_predictor(
+        fluid.inference.AnalysisConfig(model_dir=path))
+    feed = {"x": np.random.RandomState(0)
+            .randn(3, 2, 256).astype("float32")}
+    o1 = np.asarray(pred.run(feed)[0])
+    o2 = np.asarray(pred.run(feed)[0])
+    assert o1.shape == (3, 2, 4)
+    np.testing.assert_allclose(o1, o2)  # dropout off in the export
+    assert np.isfinite(o1).all()
