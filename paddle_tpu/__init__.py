@@ -105,6 +105,8 @@ from . import transpiler
 from .transpiler import DistributeTranspiler, DistributeTranspilerConfig
 from .data_feed_desc import DataFeedDesc
 from .dataset import DatasetFactory
+from . import static_analysis
+from .static_analysis import verify_program
 
 # `import paddle_tpu as fluid` is the intended spelling for users of the
 # reference's `import paddle.fluid as fluid`.
@@ -173,6 +175,8 @@ __all__ = [
     "release_memory",
     "is_compiled_with_cuda",
     "cuda_pinned_places",
+    "static_analysis",
+    "verify_program",
 ]
 
 

@@ -12,7 +12,7 @@ the framework IR, registered by name like the reference's PassRegistry."""
 
 __all__ = ["register_pass", "get_pass", "PassBuilder", "Analyzer",
            "fc_fuse_pass", "dead_code_elimination_pass",
-           "conv_bn_fuse_pass"]
+           "conv_bn_fuse_pass", "verify_pass"]
 
 _PASSES = {}
 
@@ -48,13 +48,39 @@ def fc_fuse_pass(program, scope=None, targets=None):
     """mul + elementwise_add(bias) → one fc op (ir/fc_fuse_pass.cc).
 
     Matches when the mul output has exactly one consumer (the add) and
-    the add's Y operand is a 1-D persistable bias."""
+    the add's Y operand is a 1-D persistable bias.
+
+    The consumer map is rebuilt after every fusion: each fusion replaces
+    two ops with one, so a map built once over the original op list goes
+    stale (it holds removed ``elementwise_add`` objects and misses the
+    new ``fc`` reads), silently breaking chained mul+add pairs.  Sub-block
+    reads also count as consumers — fusing away a var a ``while`` body
+    captures by closure would leave a dangling read the op's input slots
+    never show."""
+    from .framework import Operator
+    from .static_analysis import sub_block_reads_recursive
+    from .static_analysis.defuse import resolve_sub_block
+
     block = program.global_block()
-    ops = block.ops
-    consumers = {}
-    for op in ops:
-        for n in op.input_arg_names:
-            consumers.setdefault(n, []).append(op)
+
+    # per-op sub-block closure reads are invariant across the pass (fusion
+    # only rewrites the global block), so walk each sub-block once
+    closure_reads = {}
+    for o in block.ops:
+        sub = resolve_sub_block(program, o, host_block_idx=block.idx)
+        if sub is not None:
+            closure_reads[id(o)] = sub_block_reads_recursive(program, sub)
+
+    def build_consumers():
+        consumers = {}
+        for o in block.ops:
+            for n in o.input_arg_names:
+                consumers.setdefault(n, []).append(o)
+            for n in closure_reads.get(id(o), ()):
+                consumers.setdefault(n, []).append(o)
+        return consumers
+
+    consumers = build_consumers()
     fused = 0
     i = 0
     while i < len(block.ops):
@@ -88,9 +114,17 @@ def fc_fuse_pass(program, scope=None, targets=None):
                 or len(bias_var.shape or ()) != 1:
             i += 1
             continue
-        j = block.ops.index(add)
-        from .framework import Operator
-
+        try:
+            j = block.ops.index(add)
+        except ValueError:
+            i += 1
+            continue
+        if j <= i:
+            # the add precedes the mul (rewritten/deserialized op order):
+            # fusing here would move the output's production past
+            # consumers between j and i
+            i += 1
+            continue
         fc = Operator(
             block, "fc",
             {"Input": list(op.inputs["X"]), "W": list(op.inputs["Y"]),
@@ -101,6 +135,7 @@ def fc_fuse_pass(program, scope=None, targets=None):
         block.ops[i] = fc
         del block.ops[j]
         fused += 1
+        consumers = build_consumers()
         i += 1
     if fused:
         program._bump_version()
@@ -111,9 +146,20 @@ def fc_fuse_pass(program, scope=None, targets=None):
 def dead_code_elimination_pass(program, scope=None, targets=None):
     """Remove ops whose outputs never reach the targets (the analysis
     memory_optimize/prune role; XLA also DCEs at jit, this shrinks the
-    PROGRAM)."""
+    PROGRAM).
+
+    Liveness follows ``input_arg_names`` AND sub-block closure reads: a
+    ``conditional_block`` lists only ``Cond`` as a formal input, and a
+    ``recurrent`` only its sequence/state slots, so vars read exclusively
+    inside ``attrs["sub_block"]`` (via
+    ``cf_ops.sub_block_external_reads``, cf. backward.py:250) must be
+    marked live when the control-flow op is kept — otherwise their
+    producers are eliminated and the program fails at trace time."""
     if not targets:
         return program
+    from .static_analysis import sub_block_reads_recursive
+    from .static_analysis.defuse import resolve_sub_block
+
     block = program.global_block()
     needed = set(targets)
     keep = []
@@ -126,9 +172,27 @@ def dead_code_elimination_pass(program, scope=None, targets=None):
                 "feed", "fetch", "print"):
             keep.append(op)
             needed.update(op.input_arg_names)
+            sub = resolve_sub_block(program, op, host_block_idx=block.idx)
+            if sub is not None:
+                needed.update(sub_block_reads_recursive(program, sub))
     if len(keep) != len(block.ops):
         block.ops[:] = list(reversed(keep))
         program._bump_version()
+    return program
+
+
+@register_pass("verify_pass")
+def verify_pass(program, scope=None, targets=None, context=None):
+    """Run the static_analysis verifier as a pipeline pass (the TVM/XLA
+    lesson: rewrite-heavy pipelines need invariant checks BETWEEN passes).
+    Raises ``VerifyError`` with structured diagnostics on ERROR-severity
+    findings; warnings/advisories pass through silently.  ``context``
+    names the surrounding pass in the failure header."""
+    from .static_analysis import assert_valid
+
+    header = ("program failed verification%s:"
+              % (" (%s)" % context if context else ""))
+    assert_valid(program, targets=targets, header=header)
     return program
 
 
@@ -156,12 +220,28 @@ class PassBuilder:
 
 class Analyzer:
     """Run the configured pipeline (reference analysis/analyzer.h:
-    Analyzer::RunAnalysis)."""
+    Analyzer::RunAnalysis).
+
+    With verification enabled (``verify=True``, or the default resolving
+    from ``PADDLE_TPU_VERIFY_PASSES`` — on in tests via conftest), the
+    program is verified before the pipeline and re-verified after every
+    rewrite pass, so the offending pass is named instead of surfacing as
+    an opaque trace error at ``Executor.run``."""
 
     def __init__(self, pass_builder=None):
         self._builder = pass_builder or PassBuilder()
 
-    def run(self, program, scope=None, targets=None):
+    def run(self, program, scope=None, targets=None, verify=None):
+        if verify is None:
+            from .static_analysis import pass_verification_enabled
+
+            verify = pass_verification_enabled()
+        if verify:
+            verify_pass(program, scope=scope, targets=targets,
+                        context="before analysis pipeline")
         for name in self._builder.all_passes():
             program = get_pass(name)(program, scope=scope, targets=targets)
+            if verify and name != "verify_pass":
+                verify_pass(program, scope=scope, targets=targets,
+                            context="after %s" % name)
         return program

@@ -865,6 +865,7 @@ class Executor:
         return_numpy=True,
         use_program_cache=True,
         use_prune=False,
+        verify=False,
     ):
         import jax
         import jax.numpy as jnp
@@ -873,6 +874,23 @@ class Executor:
 
         if program is None:
             program = default_main_program()
+        if verify:
+            # opt-in debug hook: catch malformed programs (dangling reads
+            # after a bad pass, dtype drift, double writes aliasing the
+            # donated param buffers) with structured diagnostics BEFORE
+            # they become opaque trace-time errors
+            from .static_analysis import assert_valid
+
+            to_verify = (getattr(program, "_program", None)
+                         if isinstance(program, CompiledProgram)
+                         else program)
+            if to_verify is not None:
+                assert_valid(
+                    to_verify,
+                    targets=[v.name if isinstance(v, Variable) else str(v)
+                             for v in (fetch_list or [])],
+                    header="Executor.run(verify=True): program failed "
+                           "verification:")
         if isinstance(program, CompiledProgram):
             # feed checking must also cover the DP/ZeRO/ipr paths — the
             # wrapped program carries the declared data shapes

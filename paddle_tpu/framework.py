@@ -593,6 +593,16 @@ class Program:
         p._bump_version()
         return p
 
+    def lint(self, targets=None, checks=None, exclude=()):
+        """Run the static-analysis check battery over this program and
+        return the structured diagnostics (see
+        :mod:`paddle_tpu.static_analysis`); raises nothing — gating is
+        the caller's choice (``static_analysis.assert_valid`` raises)."""
+        from .static_analysis import verify_program
+
+        return verify_program(self, targets=targets, checks=checks,
+                              exclude=exclude)
+
     def __repr__(self):
         return "Program(blocks=%d, version=%d)" % (len(self.blocks), self._version)
 

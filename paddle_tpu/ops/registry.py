@@ -35,6 +35,7 @@ __all__ = [
     "LoweringContext",
     "call_op",
     "infer_shapes",
+    "infer_output_structs",
     "EMPTY_VAR_NAME",
 ]
 
@@ -314,13 +315,36 @@ def infer_shapes(op, block):
     graph-construction metadata only; execution re-traces with concrete feed
     shapes, so approximation is acceptable (the reference's InferShape has
     the same -1-propagation looseness, framework.py:985)."""
-    import jax
-
     opdef = get_op_def(op.type)
 
     if opdef.custom_infer_shape is not None:
         opdef.custom_infer_shape(op, block)
         return
+
+    inferred = infer_output_structs(op, block)
+    if inferred is None:
+        return
+    for n, (shape, dtype) in inferred.items():
+        var = block._find_var_recursive(n)
+        if var is None:
+            continue
+        var.shape = shape
+        var.dtype = dtype
+
+
+def infer_output_structs(op, block):
+    """Non-mutating core of :func:`infer_shapes`: eval_shape the op's
+    lowering against the recorded input metadata and return
+    ``{out_var_name: (shape_with_-1_dims, dtype_str)}``, or None when the
+    op is not inferable this way (custom InferShape, un-inferable inputs,
+    sentinel arithmetic broke the trace).  The verifier diffs this against
+    recorded Variable metadata to catch drift introduced by pass rewrites
+    without touching the graph."""
+    import jax
+
+    opdef = get_op_def(op.type)
+    if opdef.custom_infer_shape is not None:
+        return None
 
     ins = {}
     used_sentinel = False
@@ -332,7 +356,7 @@ def infer_shapes(op, block):
                 continue
             var = block._find_var_recursive(n)
             if var is None or var.shape is None:
-                return  # cannot infer
+                return None  # cannot infer
             shape = []
             for i, d in enumerate(var.shape):
                 if d is None or d < 0:
@@ -352,22 +376,23 @@ def infer_shapes(op, block):
         out_structs = jax.eval_shape(f, ins)
     except Exception:
         if used_sentinel:
-            return  # sentinel arithmetic broke the trace; leave shapes unset
+            return None  # sentinel arithmetic broke the trace
         raise
 
     sent = set(_SHAPE_SENTINELS)
+    out = {}
     for slot, names in op.outputs.items():
         structs = out_structs.get(slot)
         if structs is None:
             continue
         for n, s in zip(names, structs):
-            var = block._find_var_recursive(n)
-            if var is None or s is None:
+            if s is None or n == EMPTY_VAR_NAME:
                 continue
-            var.shape = tuple(-1 if d in sent else int(d) for d in s.shape)
-            var.dtype = (
-                "bfloat16" if s.dtype == _np_dtype_of_bf16() else np.dtype(s.dtype).name
-            )
+            shape = tuple(-1 if d in sent else int(d) for d in s.shape)
+            dtype = ("bfloat16" if s.dtype == _np_dtype_of_bf16()
+                     else np.dtype(s.dtype).name)
+            out[n] = (shape, dtype)
+    return out
 
 
 @functools.lru_cache(maxsize=1)

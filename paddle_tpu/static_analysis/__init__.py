@@ -1,0 +1,51 @@
+"""Static analysis over the paddle_tpu Program IR: def-use graph,
+program verifier, and lint pass framework.
+
+Motivation (ISSUE 1): the Executor lowers a whole Program to one jaxpr, so
+a malformed program — dangling read after a bad fuse, dtype drift, a
+double write aliasing donated param buffers — surfaces only as an opaque
+trace-time JAX error or silently wrong numerics.  This package restores
+the reference's graph-level validation (``ir::Graph`` checkers, per-op
+``InferShape``, ``PADDLE_ENFORCE``) as a TPU-native battery of structured
+checks runnable at any point, especially *between* Analyzer rewrite
+passes.
+
+Surfaces:
+
+* ``verify_program(program, targets=...)`` / ``Program.lint()``
+* ``analysis.verify_pass`` — registered pass; ``Analyzer`` brackets every
+  rewrite with it when enabled (``PADDLE_TPU_VERIFY_PASSES=1``, on in
+  tests)
+* ``python -m paddle_tpu.tools.lint_program <model_dir>`` — lint a saved
+  inference model; exit 1 on ERROR findings
+* ``Executor.run(..., verify=True)`` — debug hook
+"""
+
+from .diagnostics import Diagnostic, Severity, format_diagnostics
+from .defuse import DefUseGraph, build_def_use, sub_block_reads_recursive
+from .checks import VerifyContext, all_checks, get_check, register_check
+from .verifier import (
+    VerifyError,
+    assert_valid,
+    pass_verification_enabled,
+    set_pass_verification,
+    verify_program,
+)
+
+__all__ = [
+    "Diagnostic",
+    "Severity",
+    "format_diagnostics",
+    "DefUseGraph",
+    "build_def_use",
+    "sub_block_reads_recursive",
+    "VerifyContext",
+    "all_checks",
+    "get_check",
+    "register_check",
+    "VerifyError",
+    "assert_valid",
+    "pass_verification_enabled",
+    "set_pass_verification",
+    "verify_program",
+]

@@ -1,0 +1,470 @@
+"""Built-in verifier checks + the check registry.
+
+Each check is a generator ``fn(ctx) -> Iterable[Diagnostic]`` registered
+under a stable id, mirroring ``analysis.register_pass``; custom checks
+register the same way::
+
+    from paddle_tpu.static_analysis import register_check, Diagnostic, Severity
+
+    @register_check("no-print-ops")
+    def no_print_ops(ctx):
+        for block_idx, op_idx, op in ctx.graph.order:
+            if op.type == "print":
+                yield ctx.diag("no-print-ops", Severity.WARNING,
+                               "print op in production program",
+                               block_idx=block_idx, op_idx=op_idx, op=op)
+
+The catalog (see README "Static analysis / lint"):
+
+==========================  ========  ====================================
+check id                    severity  violation
+==========================  ========  ====================================
+use-before-def              ERROR     non-persistable var read before any
+                                      write (or never declared at all)
+double-write                ERROR/W   second blind write to a var (ERROR
+                                      for persistables: donated-buffer
+                                      aliasing hazard; WARNING otherwise)
+shape-dtype-drift           ERROR/W   re-inferred output dtype (ERROR) or
+                                      static shape (WARNING) disagrees
+                                      with recorded Variable metadata
+orphaned-fetch              ERROR     fetch target neither produced, fed,
+                                      nor persistable (or missing wholly)
+sub-block-index             ERROR     attrs["sub_block"] out of range or
+                                      self-referential
+collective-ring             ERROR/W   collective op missing ring_id or
+                                      send_v2/recv_v2 missing peer
+                                      (ERROR); c_gen_nccl_id without a
+                                      matching c_comm_init (WARNING)
+unreferenced-op             INFO      op output never read / fetched —
+                                      advisory twin of DCE
+==========================  ========  ====================================
+"""
+
+from .defuse import (SUB_BLOCK_DESCENT_OPS, _machinery_defined_names,
+                     resolve_sub_block, sub_block_reads_recursive)
+from .diagnostics import Diagnostic, Severity
+from ..ops.registry import EMPTY_VAR_NAME
+
+__all__ = ["register_check", "get_check", "all_checks", "VerifyContext"]
+
+_CHECKS = {}
+
+
+def register_check(check_id):
+    """Register ``fn(ctx) -> Iterable[Diagnostic]`` under ``check_id``
+    (the ``register_pass`` idiom; later registration replaces earlier,
+    so a project can override a built-in)."""
+
+    def deco(fn):
+        _CHECKS[check_id] = fn
+        return fn
+
+    return deco
+
+
+def get_check(check_id):
+    return _CHECKS[check_id]
+
+
+def all_checks():
+    """Ordered {id: fn} of registered checks."""
+    return dict(_CHECKS)
+
+
+class VerifyContext:
+    """What a check sees: the program, the def-use graph, the (optional)
+    fetch targets, and a Diagnostic factory that fills in coordinates."""
+
+    def __init__(self, program, graph, targets=None):
+        self.program = program
+        self.graph = graph
+        self.targets = tuple(targets or ())
+
+    def var(self, name, near_block=None):
+        """Recursive var lookup starting at ``near_block`` (a block idx)."""
+        b = (self.program.block(near_block) if near_block is not None
+             else self.program.global_block())
+        return b._find_var_recursive(name)
+
+    def diag(self, check, severity, message, block_idx=None, op_idx=None,
+             op=None, var_names=(), hint=""):
+        return Diagnostic(
+            check, severity, message,
+            block_idx=block_idx, op_idx=op_idx,
+            op_type=op.type if op is not None else None,
+            op_id=op.attrs.get("__op_id__") if op is not None else None,
+            var_names=var_names, hint=hint,
+        )
+
+
+def _is_defined_root(ctx, name, block_idx):
+    """Names with a value before any op runs: persistables (scope-resident
+    across runs) and data vars (fed)."""
+    v = ctx.var(name, block_idx)
+    if v is None:
+        return False
+    return bool(v.persistable or v.is_data)
+
+
+# ---------------------------------------------------------------------------
+# use-before-def
+# ---------------------------------------------------------------------------
+
+@register_check("use-before-def")
+def check_use_before_def(ctx):
+    """Walk in execution order threading the defined-name set through
+    sub-block descent; flag reads of non-persistable, non-fed vars with no
+    prior write (the dangling edges a broken fuse/DCE pass leaves)."""
+    program = ctx.program
+    reported = set()
+    visited_blocks = set()
+
+    def walk(block, defined):
+        if block.idx in visited_blocks:
+            # sub_block cycle in a malformed program: sub-block-index
+            # reports it; don't recurse forever here
+            return
+        visited_blocks.add(block.idx)
+        for op_idx, op in enumerate(block.ops):
+            for n in op.input_arg_names:
+                if (not n or n == EMPTY_VAR_NAME or n in defined
+                        or n in reported):
+                    continue
+                if _is_defined_root(ctx, n, block.idx):
+                    defined.add(n)
+                    continue
+                reported.add(n)
+                v = ctx.var(n, block.idx)
+                if v is None:
+                    msg = ("op reads %r which is not declared in any "
+                           "reachable block" % n)
+                    hint = ("a pass rewired an input to a var it never "
+                            "created — create the var or fix the slot")
+                else:
+                    msg = ("op reads %r before any op writes it (and it "
+                           "is neither persistable nor fed)" % n)
+                    hint = ("reorder the producer before this op, or mark "
+                            "the var persistable/is_data if it is "
+                            "scope-provided")
+                yield ctx.diag(
+                    "use-before-def", Severity.ERROR, msg,
+                    block_idx=block.idx, op_idx=op_idx, op=op,
+                    var_names=(n,), hint=hint)
+            if op.type in SUB_BLOCK_DESCENT_OPS:
+                inner = resolve_sub_block(program, op,
+                                          host_block_idx=block.idx)
+                if inner is not None:
+                    inner_defined = set(defined)
+                    inner_defined.update(_machinery_defined_names(op))
+                    yield from walk(inner, inner_defined)
+            for n in op.output_arg_names:
+                if n and n != EMPTY_VAR_NAME:
+                    defined.add(n)
+
+    yield from walk(program.global_block(), set())
+
+
+# ---------------------------------------------------------------------------
+# double-write
+# ---------------------------------------------------------------------------
+
+@register_check("double-write")
+def check_double_write(ctx):
+    """Two writes to one var in a block with no read in between, where the
+    second writer does not read-modify-write it: the first write is dead,
+    and for persistables it aliases the jit cache's donated param buffers
+    (executor.py donates the mutated-param argument — two blind writes in
+    one step mean one update silently vanishes).
+
+    Read-modify-write ops (sgd ParamOut==Param, batch_norm MeanOut==Mean,
+    c_allreduce in-place) and control-flow merges (conditional branches
+    each assign the merge var; the op semantically reads the prior value)
+    are not violations.
+    """
+    for block in ctx.program.blocks:
+        if block.idx not in ctx.graph.walked_blocks:
+            continue
+        last_write = {}   # name -> (op_idx, op)
+        read_since = {}   # name -> True once read after last write
+        for op_idx, op in enumerate(block.ops):
+            for n in op.input_arg_names:
+                read_since[n] = True
+            sub = resolve_sub_block(ctx.program, op,
+                                    host_block_idx=block.idx)
+            if sub is not None:
+                # closure reads never appear on the op's input slots
+                for n in sub_block_reads_recursive(ctx.program, sub):
+                    read_since[n] = True
+            is_cf = op.type in SUB_BLOCK_DESCENT_OPS
+            if is_cf:
+                # the sub-block body reads/merges the carried names
+                for n in op.output_arg_names:
+                    read_since[n] = True
+            for n in op.output_arg_names:
+                if not n or n == EMPTY_VAR_NAME:
+                    continue
+                prev = last_write.get(n)
+                # read-modify-write ops (sgd, batch_norm stats, in-place
+                # allreduce) are exempt via read_since: their own input
+                # read was recorded just above
+                if prev is not None and not read_since.get(n) and not is_cf:
+                    v = ctx.var(n, block.idx)
+                    persistable = bool(v is not None and v.persistable)
+                    sev = Severity.ERROR if persistable else Severity.WARNING
+                    what = ("persistable %r (donation-aliasing hazard: the "
+                            "first update is lost in the donated buffer)"
+                            if persistable else
+                            "%r (the first write is dead)")
+                    yield ctx.diag(
+                        "double-write", sev,
+                        ("op overwrites " + what + "; prior write at op %d "
+                         "(%s) was never read") % (n, prev[0], prev[1].type),
+                        block_idx=block.idx, op_idx=op_idx, op=op,
+                        var_names=(n,),
+                        hint="drop the dead writer or rename one output")
+                last_write[n] = (op_idx, op)
+                read_since[n] = False
+
+
+# ---------------------------------------------------------------------------
+# shape/dtype re-inference drift
+# ---------------------------------------------------------------------------
+
+def _shapes_conflict(recorded, inferred):
+    """Static-dim conflict only: -1/None dims are unknown, and rank-1 vs
+    rank-0 scalars round-trip loosely through serialization, so only
+    same-rank tensors with differing static dims count."""
+    if recorded is None or inferred is None:
+        return False
+    if len(recorded) != len(inferred):
+        return not (len(recorded) == 0 or len(inferred) == 0)
+    for r, i in zip(recorded, inferred):
+        if r is None or i is None or r < 0 or i < 0:
+            continue
+        if int(r) != int(i):
+            return True
+    return False
+
+
+@register_check("shape-dtype-drift")
+def check_shape_dtype_drift(ctx):
+    """Re-run the jax.eval_shape inference engine (framework.py's
+    append-time InferShape) over every op and diff against the recorded
+    Variable metadata.  At build time the two agree by construction, so a
+    disagreement means a pass rewired the graph without re-inferring —
+    dtype drift is an ERROR (it changes numerics/casts silently), static
+    shape drift a WARNING (execution re-traces with concrete feeds)."""
+    from ..ops import registry
+
+    for block_idx, op_idx, op in ctx.graph.order:
+        if op.type.endswith("_grad") or op.type in ("feed", "fetch"):
+            continue
+        block = ctx.program.block(block_idx)
+        try:
+            inferred = registry.infer_output_structs(op, block)
+        except registry.OpNotRegistered:
+            continue
+        except Exception as e:
+            # at build time append_op would have propagated this, so a
+            # raise here means a rewrite left metadata the lowering
+            # rejects outright — the strongest drift signal there is
+            yield ctx.diag(
+                "shape-dtype-drift", Severity.ERROR,
+                "the op's lowering rejects the recorded input metadata "
+                "(%s: %s)" % (type(e).__name__, str(e)[:200]),
+                block_idx=block_idx, op_idx=op_idx, op=op,
+                var_names=tuple(op.input_arg_names),
+                hint="a pass rewired this op's inputs to incompatible "
+                     "vars — fix the rewrite or re-infer shapes")
+            continue
+        if not inferred:
+            continue
+        for n, (shape, dtype) in inferred.items():
+            var = block._find_var_recursive(n)
+            if var is None:
+                continue
+            recorded_dtype = var.dtype
+            if recorded_dtype is not None and dtype != str(recorded_dtype):
+                yield ctx.diag(
+                    "shape-dtype-drift", Severity.ERROR,
+                    "recorded dtype of %r is %s but the op's lowering "
+                    "produces %s" % (n, recorded_dtype, dtype),
+                    block_idx=block_idx, op_idx=op_idx, op=op,
+                    var_names=(n,),
+                    hint="re-run shape inference after rewriting, or cast "
+                         "explicitly")
+            elif _shapes_conflict(var.shape, shape):
+                yield ctx.diag(
+                    "shape-dtype-drift", Severity.WARNING,
+                    "recorded shape of %r is %s but the op's lowering "
+                    "produces %s" % (n, tuple(var.shape), tuple(shape)),
+                    block_idx=block_idx, op_idx=op_idx, op=op,
+                    var_names=(n,),
+                    hint="update the var's shape metadata after rewriting")
+
+
+# ---------------------------------------------------------------------------
+# orphaned fetch targets
+# ---------------------------------------------------------------------------
+
+@register_check("orphaned-fetch")
+def check_orphaned_fetch(ctx):
+    """Every fetch target (explicit ``targets`` plus any fetch op's inputs)
+    must be produced by a surviving op, fed, or persistable — the exact
+    invariant a too-eager rewrite pass breaks."""
+    wanted = list(ctx.targets)
+    for block_idx, op_idx, op in ctx.graph.order:
+        if op.type == "fetch":
+            wanted.extend(op.input_arg_names)
+    seen = set()
+    for n in wanted:
+        if not n or n == EMPTY_VAR_NAME or n in seen:
+            continue
+        seen.add(n)
+        v = ctx.var(n)
+        if v is None:
+            yield ctx.diag(
+                "orphaned-fetch", Severity.ERROR,
+                "fetch target %r does not exist in the program" % n,
+                var_names=(n,),
+                hint="a pass pruned the target var — exclude fetch "
+                     "targets from rewrites (pass targets= to the "
+                     "Analyzer)")
+        elif not (ctx.graph.is_produced(n) or v.persistable or v.is_data):
+            yield ctx.diag(
+                "orphaned-fetch", Severity.ERROR,
+                "fetch target %r is never produced by any op (nor fed, "
+                "nor persistable)" % n,
+                var_names=(n,),
+                hint="the producing op was fused/eliminated — rerun the "
+                     "pass with targets= or keep the producer")
+
+
+# ---------------------------------------------------------------------------
+# sub-block indices
+# ---------------------------------------------------------------------------
+
+@register_check("sub-block-index")
+def check_sub_block_index(ctx):
+    for block in ctx.program.blocks:
+        for op_idx, op in enumerate(block.ops):
+            if "sub_block" not in op.attrs:
+                continue
+            idx = op.attrs["sub_block"]
+            if (not isinstance(idx, int)
+                    or idx < 0 or idx >= ctx.program.num_blocks):
+                yield ctx.diag(
+                    "sub-block-index", Severity.ERROR,
+                    "attrs['sub_block']=%r is not a valid block index "
+                    "(program has %d blocks)" % (idx, ctx.program.num_blocks),
+                    block_idx=block.idx, op_idx=op_idx, op=op,
+                    hint="clone/serialize must remap sub_block indices")
+            elif idx == block.idx:
+                yield ctx.diag(
+                    "sub-block-index", Severity.ERROR,
+                    "op's sub_block is its own block (infinite descent)",
+                    block_idx=block.idx, op_idx=op_idx, op=op)
+
+
+# ---------------------------------------------------------------------------
+# collective ring-id pairing (transpiled programs)
+# ---------------------------------------------------------------------------
+
+# c_sync_*_stream ops are ring-less by design and match none of these
+_COLLECTIVE_OP_PREFIXES = ("c_allreduce", "c_reduce", "c_broadcast",
+                           "c_allgather", "c_reducescatter", "c_scatter")
+
+
+@register_check("collective-ring")
+def check_collective_ring(ctx):
+    """Transpiled programs: every collective must carry an integer
+    ``ring_id``; bootstrap pairs (``c_gen_nccl_id`` → ``c_comm_init``)
+    must agree per ring, and p2p send/recv ops must name an integer
+    ``peer`` (reference keeps rings consistent in C++; here a mismatch
+    would silently place collectives on different meshes).  Note: a
+    single rank's program legitimately has asymmetric send/recv peers
+    (pipeline stages), so pairing is checked per-op, not globally."""
+    gen_rings = {}
+    init_rings = set()
+    for block_idx, op_idx, op in ctx.graph.order:
+        t = op.type
+        if t == "c_gen_nccl_id":
+            gen_rings[op.attrs.get("ring_id", 0)] = (block_idx, op_idx, op)
+        elif t == "c_comm_init":
+            init_rings.add(op.attrs.get("ring_id", 0))
+        elif t in ("send_v2", "recv_v2"):
+            if not isinstance(op.attrs.get("peer"), int):
+                yield ctx.diag(
+                    "collective-ring", Severity.ERROR,
+                    "%s op has no integer peer attr (got %r)"
+                    % (t, op.attrs.get("peer")),
+                    block_idx=block_idx, op_idx=op_idx, op=op,
+                    hint="p2p ops must name their partner rank")
+        elif t.startswith(_COLLECTIVE_OP_PREFIXES):
+            ring = op.attrs.get("ring_id")
+            if ring is None or not isinstance(ring, int):
+                yield ctx.diag(
+                    "collective-ring", Severity.ERROR,
+                    "collective op has no integer ring_id attr (got %r)"
+                    % (ring,),
+                    block_idx=block_idx, op_idx=op_idx, op=op,
+                    hint="the transpiler must stamp ring_id on every "
+                         "collective it inserts")
+    # key=repr: a malformed program may mix int and str ring ids — the
+    # check must report them, not die sorting them
+    for ring, (block_idx, op_idx, op) in sorted(gen_rings.items(),
+                                                key=lambda kv: repr(kv[0])):
+        if ring not in init_rings:
+            yield ctx.diag(
+                "collective-ring", Severity.WARNING,
+                "c_gen_nccl_id for ring %r has no matching c_comm_init"
+                % (ring,),
+                block_idx=block_idx, op_idx=op_idx, op=op,
+                hint="append c_comm_init with the same ring_id in the "
+                     "startup program")
+
+
+# ---------------------------------------------------------------------------
+# unreferenced ops (advisory DCE twin)
+# ---------------------------------------------------------------------------
+
+# op types whose value is their side effect, not a consumed output
+_SIDE_EFFECT_OPS = frozenset((
+    "feed", "fetch", "print", "save", "load", "save_combine",
+    "load_combine", "c_gen_nccl_id", "c_comm_init", "c_sync_calc_stream",
+    "c_sync_comm_stream", "barrier",
+))
+
+
+@register_check("unreferenced-op")
+def check_unreferenced_op(ctx):
+    """Ops in the global block whose outputs nothing reads and nothing
+    fetches: dead weight the DCE pass would remove.  Advisory (INFO) —
+    intentionally kept side-effecting, persistable-writing and
+    control-flow ops are exempt."""
+    targets = set(ctx.targets)
+    block = ctx.program.global_block()
+    for op_idx, op in enumerate(block.ops):
+        if (op.type in _SIDE_EFFECT_OPS
+                or op.type in SUB_BLOCK_DESCENT_OPS
+                or op.type.endswith("_grad")):
+            continue
+        outs = [n for n in op.output_arg_names
+                if n and n != EMPTY_VAR_NAME]
+        if not outs:
+            continue
+        live = False
+        for n in outs:
+            v = ctx.var(n)
+            if (n in targets or ctx.graph.consumers(n)
+                    or (v is not None and v.persistable)):
+                live = True
+                break
+        if not live:
+            yield ctx.diag(
+                "unreferenced-op", Severity.INFO,
+                "no op, fetch target or persistable consumes outputs %s"
+                % (outs,),
+                block_idx=block.idx, op_idx=op_idx, op=op,
+                var_names=tuple(outs),
+                hint="dead_code_elimination_pass would remove this op")

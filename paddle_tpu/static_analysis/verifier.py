@@ -1,0 +1,116 @@
+"""Program verifier: run the check battery over a Program.
+
+Entry points:
+
+* ``verify_program(program, targets=...)`` → list of Diagnostics
+* ``assert_valid(program, ...)`` → raises :class:`VerifyError` on ERRORs
+* ``Program.lint()`` (framework.py) delegates here
+* ``analysis.verify_pass`` wraps the Analyzer pipeline with it
+* ``Executor.run(..., verify=True)`` runs it before lowering
+
+The reference's equivalent is scattered: per-op ``InferShape`` +
+``PADDLE_ENFORCE`` at build, ``ir::Graph`` sanity in each pass.  Here the
+whole battery is one function over the finished Program, runnable at any
+point — crucially *between* rewrite passes, where TVM/XLA-style fusion
+pipelines introduce exactly the dangling-edge bugs these checks catch.
+"""
+
+import os
+
+from .checks import VerifyContext, all_checks
+from .defuse import DefUseGraph
+from .diagnostics import Severity, format_diagnostics
+
+__all__ = ["verify_program", "assert_valid", "VerifyError",
+           "pass_verification_enabled", "set_pass_verification"]
+
+
+class VerifyError(RuntimeError):
+    """Raised when a program fails verification; carries the structured
+    diagnostics (``.diagnostics``) in addition to the formatted text."""
+
+    def __init__(self, message, diagnostics=()):
+        super().__init__(message)
+        self.diagnostics = list(diagnostics)
+
+
+def verify_program(program, targets=None, checks=None, exclude=()):
+    """Run lint/verifier checks over ``program``.
+
+    Parameters
+    ----------
+    program:  framework.Program
+    targets:  optional fetch-target names (Variables or strings); enables
+              the orphaned-fetch check and informs unreferenced-op
+    checks:   optional iterable of check ids to run (default: all)
+    exclude:  check ids to skip
+
+    Returns the list of Diagnostics sorted most-severe-first, then by
+    (block, op) coordinates.
+    """
+    from ..framework import Variable
+
+    target_names = [
+        t.name if isinstance(t, Variable) else str(t)
+        for t in (targets or ())
+    ]
+    graph = DefUseGraph(program)
+    ctx = VerifyContext(program, graph, targets=target_names)
+    registry = all_checks()
+    if checks is not None:
+        unknown = [c for c in checks if c not in registry]
+        if unknown:
+            raise KeyError("unknown check ids %s (have %s)"
+                           % (unknown, sorted(registry)))
+        registry = {k: registry[k] for k in checks}
+    diags = []
+    for check_id, fn in registry.items():
+        if check_id in exclude:
+            continue
+        diags.extend(fn(ctx))
+    diags.sort(key=lambda d: (-int(d.severity),
+                              d.block_idx if d.block_idx is not None else -1,
+                              d.op_idx if d.op_idx is not None else -1))
+    return diags
+
+
+def assert_valid(program, targets=None, min_severity=Severity.ERROR,
+                 header=None, **kw):
+    """verify_program + raise VerifyError if any finding reaches
+    ``min_severity``.  Returns all diagnostics (incl. advisories) when
+    the program is acceptable."""
+    diags = verify_program(program, targets=targets, **kw)
+    bad = [d for d in diags if d.severity >= min_severity]
+    if bad:
+        raise VerifyError(
+            format_diagnostics(
+                bad, header=header or "program failed verification:"),
+            diagnostics=bad)
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# pass-pipeline gating flag (analysis.Analyzer reads this)
+# ---------------------------------------------------------------------------
+
+_PASS_VERIFY_OVERRIDE = None  # None → env var decides
+
+
+def pass_verification_enabled():
+    """Should Analyzer wrap each rewrite pass with verification?  Off by
+    default in production (it re-traces every op's lowering); tests turn
+    it on via ``PADDLE_TPU_VERIFY_PASSES=1`` (tests/conftest.py) or
+    :func:`set_pass_verification`."""
+    if _PASS_VERIFY_OVERRIDE is not None:
+        return _PASS_VERIFY_OVERRIDE
+    val = os.environ.get("PADDLE_TPU_VERIFY_PASSES", "0")
+    return val.strip().lower() not in ("0", "", "false", "off")
+
+
+def set_pass_verification(flag):
+    """Force pass verification on/off (None → defer to the env var
+    again).  Returns the previous override."""
+    global _PASS_VERIFY_OVERRIDE
+    old = _PASS_VERIFY_OVERRIDE
+    _PASS_VERIFY_OVERRIDE = flag
+    return old

@@ -1,0 +1,6 @@
+"""Runnable tool modules (``python -m paddle_tpu.tools.<name>``).
+
+Unlike the repo-root ``tools/`` scripts (bench/profiling drivers), these
+ship inside the package so deployments can run them against saved models
+without a checkout.
+"""

@@ -1,0 +1,105 @@
+"""Lint a saved inference model from the command line.
+
+Usage::
+
+    python -m paddle_tpu.tools.lint_program MODEL_DIR [options]
+    python -m paddle_tpu.tools.lint_program --program-json prog.json
+
+Loads the serialized Program (``__model__`` + ``__meta__.json`` as written
+by ``fluid.io.save_inference_model``; parameters are NOT needed — linting
+is static) and prints the verifier's structured diagnostics.  Exit status:
+
+* 0 — no findings at or above ``--fail-on`` (default ERROR)
+* 1 — findings at or above the gate (CI-friendly)
+* 2 — could not load the model
+
+The check catalog and severities are documented in README
+("Static analysis / lint") and ``paddle_tpu/static_analysis/checks.py``.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+
+def _load_program(args):
+    from ..proto import load_program
+
+    if args.program_json:
+        prog = load_program(args.program_json)
+        return prog, []
+    model_path = os.path.join(args.model_dir,
+                              args.model_filename or "__model__")
+    prog = load_program(model_path)
+    targets = []
+    meta_path = os.path.join(args.model_dir, "__meta__.json")
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            targets = json.load(f).get("fetch", [])
+    return prog, targets
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.tools.lint_program",
+        description="Verify/lint a saved paddle_tpu inference model.")
+    parser.add_argument("model_dir", nargs="?", default=None,
+                        help="directory written by save_inference_model")
+    parser.add_argument("--model-filename", default=None,
+                        help="program file inside model_dir "
+                             "(default __model__)")
+    parser.add_argument("--program-json", default=None,
+                        help="lint a bare serialized Program instead of a "
+                             "model dir (no fetch targets)")
+    parser.add_argument("--checks", default=None,
+                        help="comma-separated check ids to run "
+                             "(default: all)")
+    parser.add_argument("--exclude", default="",
+                        help="comma-separated check ids to skip")
+    parser.add_argument("--fail-on", default="ERROR",
+                        choices=["ERROR", "WARNING", "INFO"],
+                        help="lowest severity that fails the lint "
+                             "(default ERROR)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit diagnostics as a JSON array")
+    args = parser.parse_args(argv)
+    if not args.model_dir and not args.program_json:
+        parser.error("need MODEL_DIR or --program-json")
+
+    from ..static_analysis import Severity, format_diagnostics, verify_program
+
+    try:
+        program, targets = _load_program(args)
+    except Exception as e:
+        print("error: could not load model: %s" % e, file=sys.stderr)
+        return 2
+
+    checks = ([c for c in args.checks.split(",") if c]
+              if args.checks else None)
+    exclude = tuple(c for c in args.exclude.split(",") if c)
+    try:
+        diags = verify_program(program, targets=targets, checks=checks,
+                               exclude=exclude)
+    except KeyError as e:
+        parser.error(str(e))
+
+    if args.as_json:
+        print(json.dumps([d.to_dict() for d in diags], indent=2))
+    elif diags:
+        print(format_diagnostics(diags))
+    else:
+        print("clean: no findings")
+
+    gate = Severity[args.fail_on]
+    failing = [d for d in diags if d.severity >= gate]
+    if failing:
+        if not args.as_json:
+            print("\n%d finding(s) at or above %s" % (len(failing), gate),
+                  file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,7 +9,29 @@ os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
 
+# Analyzer brackets every rewrite pass with the static_analysis verifier
+# (off by default in production, ON in tests): a pass that breaks
+# producer/consumer links fails HERE with structured diagnostics instead
+# of surfacing as an opaque trace-time JAX error downstream.
+os.environ.setdefault("PADDLE_TPU_VERIFY_PASSES", "1")
+
+import pytest
+
 import jax
+
+@pytest.fixture
+def verify_clean():
+    """Run ``verify_program`` on a program and assert no ERROR-severity
+    findings; returns all diagnostics (advisories included) so tests can
+    also assert on warnings.  Usage: ``verify_clean(program, targets=[...])``.
+    """
+    def _check(program, targets=None):
+        from paddle_tpu.static_analysis import assert_valid
+
+        return assert_valid(program, targets=targets)
+
+    return _check
+
 
 if not os.environ.get("PADDLE_TPU_TESTS_ON_TPU"):
     # the image pins jax_platforms=axon,cpu (real TPU via tunnel); tests

@@ -1,0 +1,792 @@
+"""Program verifier + lint framework (paddle_tpu/static_analysis/).
+
+One positive (clean program) and one negative (seeded bug) case per
+check, the fc_fuse/DCE pass regressions the verifier now guards, the
+three exposure surfaces (Program.lint / verify_pass in the Analyzer /
+the lint CLI), and a representative-programs sweep: book-style models,
+control flow, and transpiled distributed programs must all verify clean.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.executor import Scope, scope_guard
+from paddle_tpu.static_analysis import (
+    Diagnostic,
+    Severity,
+    VerifyError,
+    assert_valid,
+    register_check,
+    verify_program,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _errors(diags):
+    return [d for d in diags if d.severity >= Severity.ERROR]
+
+
+def _fresh_programs():
+    fluid.unique_name.switch()
+    return fluid.Program(), fluid.Program()
+
+
+def _mlp_with_loss():
+    main, startup = _fresh_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=8, act="relu")
+        out = fluid.layers.fc(h, size=3)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(out, y))
+    return main, startup, loss
+
+
+# ---------------------------------------------------------------------------
+# per-check positive/negative pairs
+# ---------------------------------------------------------------------------
+
+class TestUseBeforeDef:
+    def test_clean(self, verify_clean):
+        main, _, loss = _mlp_with_loss()
+        verify_clean(main, targets=[loss.name])
+
+    def test_flags_dangling_read(self):
+        p = fluid.Program()
+        b = p.global_block()
+        b.create_var(name="a", shape=[2, 2], dtype="float32")
+        b.create_var(name="c", shape=[2, 2], dtype="float32")
+        b.append_op(type="scale", inputs={"X": ["a"]},
+                    outputs={"Out": ["c"]}, attrs={"scale": 2.0})
+        errs = _errors(verify_program(p, targets=["c"]))
+        assert [d.check for d in errs] == ["use-before-def"]
+        d = errs[0]
+        # structured coordinates: check id, severity, op index/type, vars
+        assert d.severity is Severity.ERROR
+        assert (d.block_idx, d.op_idx, d.op_type) == (0, 0, "scale")
+        assert d.var_names == ("a",)
+        assert d.hint
+
+    def test_flags_undeclared_var(self):
+        p = fluid.Program()
+        b = p.global_block()
+        b.create_var(name="c", shape=[2], dtype="float32")
+        b.append_op(type="scale", inputs={"X": ["ghost"]},
+                    outputs={"Out": ["c"]}, attrs={"scale": 1.0})
+        errs = _errors(verify_program(p, targets=["c"]))
+        assert errs and errs[0].check == "use-before-def"
+        assert "not declared" in errs[0].message
+
+    def test_sub_block_use_of_late_parent_def(self):
+        """A var the sub-block reads but the parent defines only AFTER
+        the control-flow op is a use-before-def, not a false pass."""
+        main, startup = _fresh_programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[2, 4], dtype="float32",
+                                  append_batch_size=False)
+            pred = fluid.layers.fill_constant([1], "bool", True)
+            scale = fluid.layers.scale(x, scale=3.0)
+            out = fluid.layers.cond(
+                pred, lambda: fluid.layers.scale(scale, scale=1.0),
+                lambda: fluid.layers.scale(x, scale=-1.0))
+        block = main.global_block()
+        # move the producer of `scale` after the conditional blocks
+        prod = next(op for op in block.ops
+                    if scale.name in op.output_arg_names)
+        block.ops.remove(prod)
+        block.ops.append(prod)
+        errs = _errors(verify_program(main, targets=[out.name]))
+        assert any(d.check == "use-before-def"
+                   and scale.name in d.var_names for d in errs)
+
+
+class TestDoubleWrite:
+    def test_in_place_update_is_clean(self, verify_clean):
+        """sgd's ParamOut==Param read-modify-write must not be flagged."""
+        main, startup, loss = _mlp_with_loss()
+        with fluid.program_guard(main, startup):
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        verify_clean(main, targets=[loss.name])
+
+    def test_flags_blind_overwrite_of_persistable(self):
+        p = fluid.Program()
+        b = p.global_block()
+        b.create_var(name="x", shape=[2], dtype="float32", is_data=True)
+        b.create_var(name="w", shape=[2], dtype="float32", persistable=True)
+        for s in (1.0, 2.0):
+            b.append_op(type="scale", inputs={"X": ["x"]},
+                        outputs={"Out": ["w"]}, attrs={"scale": s})
+        errs = _errors(verify_program(p))
+        assert [d.check for d in errs] == ["double-write"]
+        assert "donation" in errs[0].message
+
+    def test_dead_write_to_temp_is_warning(self):
+        p = fluid.Program()
+        b = p.global_block()
+        b.create_var(name="x", shape=[2], dtype="float32", is_data=True)
+        b.create_var(name="t", shape=[2], dtype="float32")
+        for s in (1.0, 2.0):
+            b.append_op(type="scale", inputs={"X": ["x"]},
+                        outputs={"Out": ["t"]}, attrs={"scale": s})
+        diags = verify_program(p, targets=["t"])
+        dw = [d for d in diags if d.check == "double-write"]
+        assert dw and dw[0].severity is Severity.WARNING
+
+    def test_sub_block_closure_read_counts_as_read(self, verify_clean):
+        """write t → branch body reads t by closure only (no slot on the
+        conditional_block op) → write t again: the closure read makes the
+        second write a legitimate refresh, not a dead first write."""
+        main, startup = _fresh_programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[2, 4], dtype="float32",
+                                  append_batch_size=False)
+            t = fluid.layers.scale(x, scale=2.0)        # write #1
+            pred = fluid.layers.fill_constant([1], "bool", True)
+            out = fluid.layers.cond(
+                pred, lambda: fluid.layers.scale(t, scale=1.0),
+                lambda: fluid.layers.scale(x, scale=-1.0))
+            block = main.global_block()
+            block.append_op(type="assign", inputs={"X": [out.name]},
+                            outputs={"Out": [t.name]})  # write #2
+        diags = verify_clean(main, targets=[out.name, t.name])
+        assert not [d for d in diags if d.check == "double-write"]
+
+    def test_conditional_merge_is_clean(self, verify_clean):
+        """Both branches of cond() assign the merge var — CF ops merge,
+        they don't blindly overwrite."""
+        main, startup = _fresh_programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[2, 4], dtype="float32",
+                                  append_batch_size=False)
+            pred = fluid.layers.fill_constant([1], "bool", True)
+            out = fluid.layers.cond(
+                pred, lambda: fluid.layers.scale(x, scale=1.0),
+                lambda: fluid.layers.scale(x, scale=-1.0))
+        verify_clean(main, targets=[out.name])
+
+
+class TestShapeDtypeDrift:
+    def test_clean_after_append_time_inference(self, verify_clean):
+        main, _, loss = _mlp_with_loss()
+        verify_clean(main, targets=[loss.name])
+
+    def test_flags_dtype_drift(self):
+        p = fluid.Program()
+        b = p.global_block()
+        b.create_var(name="x", shape=[2, 2], dtype="float32", is_data=True)
+        b.create_var(name="y", shape=[2, 2], dtype="float32")
+        b.append_op(type="scale", inputs={"X": ["x"]},
+                    outputs={"Out": ["y"]}, attrs={"scale": 1.0})
+        b.vars["y"].dtype = "int64"  # a rewrite forgot to re-infer
+        errs = _errors(verify_program(p, targets=["y"]))
+        assert any(d.check == "shape-dtype-drift" for d in errs)
+
+    def test_shape_drift_is_warning(self):
+        p = fluid.Program()
+        b = p.global_block()
+        b.create_var(name="x", shape=[2, 3], dtype="float32", is_data=True)
+        b.create_var(name="y", shape=[2, 3], dtype="float32")
+        b.append_op(type="scale", inputs={"X": ["x"]},
+                    outputs={"Out": ["y"]}, attrs={"scale": 1.0})
+        b.vars["y"].shape = (2, 7)
+        diags = verify_program(p, targets=["y"])
+        drift = [d for d in diags if d.check == "shape-dtype-drift"]
+        assert drift and drift[0].severity is Severity.WARNING
+
+
+class TestOrphanedFetch:
+    def test_clean(self, verify_clean):
+        main, _, loss = _mlp_with_loss()
+        verify_clean(main, targets=[loss.name])
+
+    def test_flags_unproduced_and_missing_targets(self):
+        p = fluid.Program()
+        b = p.global_block()
+        b.create_var(name="x", shape=[2], dtype="float32", is_data=True)
+        b.create_var(name="orphan", shape=[2], dtype="float32")
+        errs = _errors(verify_program(p, targets=["orphan", "missing"]))
+        kinds = sorted(d.check for d in errs)
+        assert kinds == ["orphaned-fetch", "orphaned-fetch"]
+
+
+class TestSubBlockIndex:
+    @pytest.mark.parametrize("bad_idx", [99, "1"], ids=["oob", "non-int"])
+    def test_flags_bad_index_without_crashing(self, bad_idx):
+        """Out-of-range AND non-int sub_block attrs must come back as
+        diagnostics from every walker — not TypeError/RecursionError."""
+        from paddle_tpu.analysis import (dead_code_elimination_pass,
+                                         fc_fuse_pass)
+
+        p = fluid.Program()
+        b = p.global_block()
+        b.create_var(name="x", shape=[2], dtype="float32", is_data=True)
+        b.append_op(type="conditional_block", inputs={"Cond": ["x"]},
+                    outputs={}, attrs={"sub_block": bad_idx})
+        errs = _errors(verify_program(p, targets=["x"]))
+        assert any(d.check == "sub-block-index" for d in errs)
+        fc_fuse_pass(p, targets=["x"])
+        dead_code_elimination_pass(p, targets=["x"])
+
+    def test_sub_block_cycle_diagnosed_not_recursion_error(self):
+        """A sub_block-attr cycle (block 1 ↔ block 2) must produce
+        diagnostics, not crash the verifier or the rewrite passes."""
+        from paddle_tpu.analysis import dead_code_elimination_pass
+        from paddle_tpu.static_analysis import sub_block_reads_recursive
+
+        p = fluid.Program()
+        b1 = p._create_block(parent_idx=0)
+        b2 = p._create_block(parent_idx=1)
+        p.current_block_idx = 0
+        b1.append_op(type="while", inputs={}, outputs={},
+                     attrs={"sub_block": 2})
+        b2.append_op(type="while", inputs={}, outputs={},
+                     attrs={"sub_block": 1})
+        g = p.global_block()
+        g.create_var(name="x", shape=[2], dtype="float32", is_data=True)
+        g.append_op(type="while", inputs={"X": ["x"]}, outputs={},
+                    attrs={"sub_block": 1})
+        diags = verify_program(p, targets=["x"])  # must not recurse forever
+        assert isinstance(diags, list)
+        # the liveness helper used by fc_fuse/DCE must also terminate
+        assert isinstance(sub_block_reads_recursive(p, b1), list)
+        dead_code_elimination_pass(p, targets=["x"])
+
+    def test_self_referential_sub_block_flagged(self):
+        p = fluid.Program()
+        b1 = p._create_block(parent_idx=0)
+        p.current_block_idx = 0
+        b1.append_op(type="while", inputs={}, outputs={},
+                     attrs={"sub_block": 1})
+        g = p.global_block()
+        g.append_op(type="while", inputs={}, outputs={},
+                    attrs={"sub_block": 1})
+        errs = _errors(verify_program(p))
+        assert any(d.check == "sub-block-index" for d in errs)
+
+
+class TestCollectiveRing:
+    def test_transpiled_programs_clean(self, verify_clean):
+        main, startup, loss = _mlp_with_loss()
+        with fluid.program_guard(main, startup):
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        cfg = fluid.DistributeTranspilerConfig()
+        cfg.mode = "collective"
+        t = fluid.DistributeTranspiler(cfg)
+        t.transpile(0, program=main, startup_program=startup, trainers=2)
+        assert any(op.type == "c_allreduce_sum"
+                   for op in main.global_block().ops)
+        verify_clean(main, targets=[loss.name])
+        verify_clean(startup)
+
+    def test_flags_missing_ring_id(self):
+        p = fluid.Program()
+        b = p.global_block()
+        b.create_var(name="g", shape=[2], dtype="float32", is_data=True)
+        b.append_op(type="c_allreduce_sum", inputs={"X": ["g"]},
+                    outputs={"Out": ["g"]}, attrs={})
+        errs = _errors(verify_program(p, targets=["g"]))
+        assert [d.check for d in errs] == ["collective-ring"]
+
+    def test_flags_peerless_send_recv(self):
+        p = fluid.Program()
+        b = p.global_block()
+        b.create_var(name="g", shape=[2], dtype="float32", is_data=True)
+        b.append_op(type="send_v2", inputs={"X": ["g"]}, outputs={},
+                    attrs={"ring_id": 0})
+        errs = _errors(verify_program(p, targets=["g"]))
+        assert [d.check for d in errs] == ["collective-ring"]
+        assert "peer" in errs[0].message
+
+    def test_asymmetric_pipeline_stage_peers_are_clean(self):
+        """A middle pipeline stage recvs from rank-1 and sends to rank+1;
+        peer asymmetry within one rank's program is legal."""
+        p = fluid.Program()
+        b = p.global_block()
+        b.create_var(name="g", shape=[2], dtype="float32", is_data=True)
+        b.create_var(name="h", shape=[2], dtype="float32")
+        b.append_op(type="recv_v2", inputs={}, outputs={"Out": ["h"]},
+                    attrs={"ring_id": 0, "peer": 0})
+        b.append_op(type="send_v2", inputs={"X": ["h"]}, outputs={},
+                    attrs={"ring_id": 0, "peer": 2})
+        diags = verify_program(p, targets=["h"])
+        assert not [d for d in diags if d.check == "collective-ring"]
+
+    def test_warns_unpaired_comm_init(self):
+        p = fluid.Program()
+        b = p.global_block()
+        b.create_var(name="id0", shape=[1], dtype="int32", persistable=True)
+        b.append_op(type="c_gen_nccl_id", outputs={"Out": ["id0"]},
+                    attrs={"ring_id": 3})
+        diags = verify_program(p)
+        ring = [d for d in diags if d.check == "collective-ring"]
+        assert ring and ring[0].severity is Severity.WARNING
+
+    def test_mixed_type_ring_ids_diagnosed_not_crashed(self):
+        """int and str ring ids in one malformed program must not blow
+        up the sort that orders the unpaired-ring warnings."""
+        p = fluid.Program()
+        b = p.global_block()
+        for name, ring in (("id0", "0"), ("id1", 1)):
+            b.create_var(name=name, shape=[1], dtype="int32",
+                         persistable=True)
+            b.append_op(type="c_gen_nccl_id", outputs={"Out": [name]},
+                        attrs={"ring_id": ring})
+        diags = verify_program(p)
+        ring = [d for d in diags if d.check == "collective-ring"]
+        assert len(ring) == 2
+
+
+class TestUnreferencedOp:
+    def test_advisory_on_dead_op(self):
+        main, startup = _fresh_programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            dead = fluid.layers.scale(x, scale=2.0)
+            out = fluid.layers.scale(x, scale=3.0)
+        diags = verify_program(main, targets=[out.name])
+        assert not _errors(diags)
+        unref = [d for d in diags if d.check == "unreferenced-op"]
+        assert unref and unref[0].severity is Severity.INFO
+        assert dead.name in unref[0].var_names
+
+
+# ---------------------------------------------------------------------------
+# satellite regressions: fc_fuse_pass + DCE control-flow liveness
+# ---------------------------------------------------------------------------
+
+class TestFcFusePassFixed:
+    def test_chained_pairs_fuse_with_numeric_parity(self, verify_clean):
+        from paddle_tpu.analysis import Analyzer, PassBuilder
+
+        rng = np.random.RandomState(7)
+        main, startup = _fresh_programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            h1 = fluid.layers.fc(x, size=8)
+            h2 = fluid.layers.fc(h1, size=8)
+            out = fluid.layers.fc(h2, size=3)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = Scope()
+        with scope_guard(scope):
+            exe.run(startup)
+            xv = rng.randn(5, 4).astype("float32")
+            before = exe.run(main, feed={"x": xv}, fetch_list=[out])[0]
+            Analyzer(PassBuilder(["fc_fuse_pass"])).run(
+                main, scope=scope, targets=[out.name])
+            after = exe.run(main, feed={"x": xv}, fetch_list=[out])[0]
+        types = [op.type for op in main.global_block().ops]
+        assert types.count("fc") == 3 and "mul" not in types
+        verify_clean(main, targets=[out.name])
+        np.testing.assert_allclose(after, before, rtol=1e-5, atol=1e-6)
+
+    def test_add_before_mul_order_is_skipped_not_corrupted(self,
+                                                          verify_clean):
+        """Adversarial op order (add precedes its mul): the old
+        ``ops[i] = fc; del ops[j]`` assumed j > i and corrupted the
+        block; the fixed pass skips the pair and the program still
+        verifies."""
+        from paddle_tpu.analysis import fc_fuse_pass
+
+        main, startup = _fresh_programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            h = fluid.layers.fc(x, size=8)
+            out = fluid.layers.scale(h, scale=1.0)
+        block = main.global_block()
+        mul_i = next(i for i, op in enumerate(block.ops)
+                     if op.type == "mul")
+        add_i = next(i for i, op in enumerate(block.ops)
+                     if op.type == "elementwise_add")
+        assert add_i > mul_i
+        block.ops[mul_i], block.ops[add_i] = (block.ops[add_i],
+                                              block.ops[mul_i])
+        n_before = len(block.ops)
+        fc_fuse_pass(main, targets=[out.name])
+        # pair skipped: nothing fused, nothing corrupted, op count intact
+        assert len(block.ops) == n_before
+        types = [op.type for op in block.ops]
+        assert "mul" in types and "elementwise_add" in types
+
+    def test_verifier_flags_broken_fuse_output(self):
+        """Simulate the OLD bug's effect — fuse removed the mul but left
+        the add reading its output: use-before-def, structured."""
+        main, startup = _fresh_programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            h = fluid.layers.fc(x, size=8)
+        block = main.global_block()
+        mul_out = next(op.outputs["Out"][0] for op in block.ops
+                       if op.type == "mul")
+        block.ops = [op for op in block.ops if op.type != "mul"]
+        errs = _errors(verify_program(main, targets=[h.name]))
+        assert any(d.check == "use-before-def"
+                   and mul_out in d.var_names for d in errs)
+
+    def test_mul_feeding_sub_block_not_fused_away(self, verify_clean):
+        """A mul output captured by a conditional_block's closure has no
+        visible consumer on any input slot — the fixed pass must count
+        sub-block reads as consumers and leave the pair alone unless the
+        add is that single consumer."""
+        main, startup = _fresh_programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[2, 4], dtype="float32",
+                                  append_batch_size=False)
+            h = fluid.layers.fc(x, size=4)   # mul + add
+            pred = fluid.layers.fill_constant([1], "bool", True)
+            block = main.global_block()
+            mul_out_name = next(op.outputs["Out"][0] for op in block.ops
+                                if op.type == "mul")
+            mul_out = block.var(mul_out_name)
+            out = fluid.layers.cond(
+                pred, lambda: fluid.layers.scale(mul_out, scale=1.0),
+                lambda: fluid.layers.scale(x, scale=-1.0))
+        from paddle_tpu.analysis import fc_fuse_pass
+
+        fc_fuse_pass(main, targets=[out.name, h.name])
+        types = [op.type for op in main.global_block().ops]
+        # two consumers now (add + sub-block closure): must not fuse
+        assert "mul" in types
+        verify_clean(main, targets=[out.name, h.name])
+
+
+class TestDcePassControlFlow:
+    def _cond_program(self):
+        main, startup = _fresh_programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[2, 4], dtype="float32",
+                                  append_batch_size=False)
+            pred = fluid.layers.fill_constant([1], "bool", True)
+            scale = fluid.layers.scale(x, scale=3.0)  # read only in branch
+            out = fluid.layers.cond(
+                pred, lambda: fluid.layers.scale(scale, scale=1.0),
+                lambda: fluid.layers.scale(x, scale=-1.0))
+        return main, startup, scale, out
+
+    def test_keeps_producers_of_sub_block_reads(self, verify_clean):
+        from paddle_tpu.analysis import dead_code_elimination_pass
+
+        main, startup, scale, out = self._cond_program()
+        dead_code_elimination_pass(main, targets=[out.name])
+        assert any(scale.name in op.output_arg_names
+                   for op in main.global_block().ops), \
+            "DCE removed the producer of a sub-block-only read"
+        verify_clean(main, targets=[out.name])
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            exe.run(startup)
+            r = exe.run(main, feed={"x": np.ones((2, 4), "float32")},
+                        fetch_list=[out], verify=True)
+        np.testing.assert_allclose(r[0], np.full((2, 4), 3.0, "float32"))
+
+    def test_still_prunes_actually_dead_ops(self):
+        from paddle_tpu.analysis import dead_code_elimination_pass
+
+        main, startup, scale, out = self._cond_program()
+        with fluid.program_guard(main, startup):
+            x_var = main.global_block().var("x")
+            fluid.layers.scale(x_var, scale=9.0)  # genuinely dead
+        n = len(main.global_block().ops)
+        dead_code_elimination_pass(main, targets=[out.name])
+        assert len(main.global_block().ops) == n - 1
+
+
+# ---------------------------------------------------------------------------
+# exposure surfaces
+# ---------------------------------------------------------------------------
+
+class TestSurfaces:
+    def test_program_lint_returns_diagnostics(self):
+        main, _, loss = _mlp_with_loss()
+        diags = main.lint(targets=[loss.name])
+        assert isinstance(diags, list)
+        assert not _errors(diags)
+
+    def test_assert_valid_raises_with_structured_payload(self):
+        p = fluid.Program()
+        b = p.global_block()
+        b.create_var(name="c", shape=[2], dtype="float32")
+        b.append_op(type="scale", inputs={"X": ["ghost"]},
+                    outputs={"Out": ["c"]}, attrs={"scale": 1.0})
+        with pytest.raises(VerifyError) as ei:
+            assert_valid(p)
+        assert ei.value.diagnostics
+        assert ei.value.diagnostics[0].check == "use-before-def"
+
+    def test_analyzer_verifies_around_every_pass(self):
+        """A pass that breaks the program is caught by the bracketing
+        verify with the pass named in the error."""
+        from paddle_tpu.analysis import (Analyzer, PassBuilder,
+                                         register_pass, _PASSES)
+
+        @register_pass("_test_breaking_pass")
+        def _breaking(program, scope=None, targets=None):
+            block = program.global_block()
+            block.ops = [op for op in block.ops if op.type != "mul"]
+            return program
+
+        try:
+            main, _, loss = _mlp_with_loss()
+            with pytest.raises(VerifyError) as ei:
+                Analyzer(PassBuilder(["_test_breaking_pass"])).run(
+                    main, targets=[loss.name], verify=True)
+            assert "_test_breaking_pass" in str(ei.value)
+        finally:
+            _PASSES.pop("_test_breaking_pass", None)
+
+    def test_analyzer_default_passes_preserve_numerics(self):
+        """Acceptance: the default pipeline under verification changes
+        nothing numerically (same guarantee as before, now checked)."""
+        from paddle_tpu.analysis import Analyzer
+
+        rng = np.random.RandomState(3)
+        main, startup = _fresh_programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            h = fluid.layers.fc(x, size=8, act="relu")
+            out = fluid.layers.fc(h, size=3)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = Scope()
+        with scope_guard(scope):
+            exe.run(startup)
+            xv = rng.randn(3, 4).astype("float32")
+            before = exe.run(main, feed={"x": xv}, fetch_list=[out])[0]
+            Analyzer().run(main, scope=scope, targets=[out.name],
+                           verify=True)
+            after = exe.run(main, feed={"x": xv}, fetch_list=[out])[0]
+        np.testing.assert_allclose(after, before, rtol=1e-5, atol=1e-6)
+
+    def test_executor_run_verify_hook(self):
+        main, startup = _fresh_programs()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            out = fluid.layers.scale(x, scale=2.0)
+        block = main.global_block()
+        prod = block.ops[-1]
+        block.ops.remove(prod)
+        block.ops.append(
+            type(prod)(block, "scale", {"X": [out.name]},
+                       {"Out": [out.name + ".2"]}, {"scale": 1.0}))
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            with pytest.raises(VerifyError):
+                exe.run(main, feed={"x": np.ones((1, 4), "float32")},
+                        fetch_list=[out.name + ".2"], verify=True)
+
+    def test_register_custom_check(self):
+        """README contract: custom checks register like passes."""
+        from paddle_tpu.static_analysis import checks as checks_mod
+
+        @register_check("no-print-ops")
+        def no_print_ops(ctx):
+            for block_idx, op_idx, op in ctx.graph.order:
+                if op.type == "print":
+                    yield ctx.diag(
+                        "no-print-ops", Severity.WARNING,
+                        "print op in production program",
+                        block_idx=block_idx, op_idx=op_idx, op=op)
+
+        try:
+            p = fluid.Program()
+            b = p.global_block()
+            b.create_var(name="x", shape=[2], dtype="float32", is_data=True)
+            b.append_op(type="print", inputs={"In": ["x"]},
+                        outputs={"Out": ["x"]}, attrs={})
+            diags = verify_program(p, checks=["no-print-ops"])
+            assert [d.check for d in diags] == ["no-print-ops"]
+        finally:
+            checks_mod._CHECKS.pop("no-print-ops", None)
+
+    def test_unknown_check_id_rejected(self):
+        with pytest.raises(KeyError):
+            verify_program(fluid.Program(), checks=["no-such-check"])
+
+
+# ---------------------------------------------------------------------------
+# lint CLI
+# ---------------------------------------------------------------------------
+
+def _save_model(tmp_path, break_it=False):
+    main, startup = _fresh_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        out = fluid.layers.fc(x, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    d = str(tmp_path / ("broken" if break_it else "ok"))
+    with scope_guard(Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["x"], [out], exe,
+                                      main_program=main)
+    if break_it:
+        # corrupt the saved program: drop the mul so the add dangles
+        from paddle_tpu.proto import load_program, save_program
+
+        prog = load_program(os.path.join(d, "__model__"))
+        b = prog.global_block()
+        b.ops = [op for op in b.ops if op.type != "mul"]
+        save_program(prog, os.path.join(d, "__model__"))
+    return d
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.tools.lint_program", *args],
+        capture_output=True, text=True, timeout=240,
+        env={**os.environ,
+             "PYTHONPATH": REPO + os.pathsep + os.environ.get(
+                 "PYTHONPATH", ""),
+             "JAX_PLATFORMS": "cpu"},
+        cwd=REPO)
+
+
+class TestLintCli:
+    def test_clean_model_exits_zero(self, tmp_path):
+        d = _save_model(tmp_path)
+        res = _run_cli(d)
+        assert res.returncode == 0, res.stdout + res.stderr
+        assert "clean" in res.stdout
+
+    def test_broken_model_exits_nonzero_with_diagnostics(self, tmp_path):
+        d = _save_model(tmp_path, break_it=True)
+        res = _run_cli(d)
+        assert res.returncode == 1, res.stdout + res.stderr
+        assert "use-before-def" in res.stdout
+
+    def test_unknown_check_id_is_clean_usage_error(self, tmp_path):
+        d = _save_model(tmp_path)
+        res = _run_cli(d, "--checks", "no-such-check,")
+        assert res.returncode == 2
+        assert "no-such-check" in res.stderr
+        assert "Traceback" not in res.stderr
+
+    def test_drift_check_reports_rejected_metadata(self):
+        """An op whose lowering raises on the recorded input metadata
+        (instead of returning mismatched structs) still yields an ERROR
+        — the strongest malformed-metadata signal must not be swallowed."""
+        p = fluid.Program()
+        b = p.global_block()
+        b.create_var(name="a", shape=[2, 3], dtype="float32", is_data=True)
+        b.create_var(name="bm", shape=[5, 7], dtype="float32",
+                     is_data=True)
+        b.create_var(name="o", shape=[2, 7], dtype="float32")
+        # contraction dims 3 vs 5 cannot multiply: eval_shape raises.
+        # Built via Operator directly (as a rewriting pass would) —
+        # append_op would have refused this op at build time.
+        from paddle_tpu.framework import Operator
+
+        b.ops.append(Operator(b, "mul", {"X": ["a"], "Y": ["bm"]},
+                              {"Out": ["o"]}, {}))
+        errs = _errors(verify_program(p, targets=["o"]))
+        assert any(d.check == "shape-dtype-drift"
+                   and "rejects" in d.message for d in errs)
+
+    def test_json_output_is_structured(self, tmp_path):
+        d = _save_model(tmp_path, break_it=True)
+        res = _run_cli(d, "--json")
+        assert res.returncode == 1
+        payload = json.loads(res.stdout)
+        assert any(f["check"] == "use-before-def" for f in payload)
+        f = payload[0]
+        assert {"check", "severity", "message", "block_idx", "op_idx",
+                "op_type", "var_names", "hint"} <= set(f)
+
+
+# ---------------------------------------------------------------------------
+# representative programs: the whole catalog must pass clean on realistic
+# graphs (book models, control flow, transpiled dist programs)
+# ---------------------------------------------------------------------------
+
+def _book_mlp():
+    main, startup = _fresh_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("img", shape=[784], dtype="float32")
+        y = fluid.layers.data("label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=128, act="relu")
+        h = fluid.layers.fc(h, size=64, act="relu")
+        out = fluid.layers.fc(h, size=10, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(out, y))
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    return [(main, [loss.name]), (startup, None)]
+
+
+def _book_conv_bn():
+    main, startup = _fresh_programs()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[3, 8, 8], dtype="float32")
+        c = fluid.layers.conv2d(img, num_filters=4, filter_size=3,
+                                padding=1, bias_attr=False)
+        c = fluid.layers.batch_norm(c)
+        p = fluid.layers.pool2d(c, pool_size=8, pool_type="avg")
+        out = fluid.layers.fc(p, size=2)
+    return [(main, [out.name]), (startup, None)]
+
+
+def _control_flow_while_grad():
+    main, startup = _fresh_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[2, 4], dtype="float32",
+                              append_batch_size=False)
+        w = fluid.layers.create_parameter([4, 4], "float32", name="w")
+        i = fluid.layers.fill_constant([1], "int64", 0)
+        n = fluid.layers.fill_constant([1], "int64", 3)
+        acc = fluid.layers.fill_constant([2, 4], "float32", 0.0)
+        cond_v = fluid.layers.less_than(i, n)
+        wl = fluid.layers.While(cond_v, max_trip_count=8)
+        with wl.block():
+            h = fluid.layers.mul(x, w)
+            fluid.layers.assign(fluid.layers.elementwise_add(acc, h), acc)
+            fluid.layers.increment(i)
+            fluid.layers.assign(fluid.layers.less_than(i, n), cond_v)
+        loss = fluid.layers.mean(acc)
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    return [(main, [loss.name]), (startup, None)]
+
+
+def _static_rnn():
+    main, startup = _fresh_programs()
+    with fluid.program_guard(main, startup):
+        seq = fluid.layers.data("seq", shape=[5, 2, 4], dtype="float32",
+                                append_batch_size=False)
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(seq)
+            mem = rnn.memory(shape=[4], batch_ref=xt, init_value=0.0)
+            nh = fluid.layers.elementwise_add(mem, xt)
+            rnn.update_memory(mem, nh)
+            rnn.step_output(nh)
+        out = rnn()
+        loss = fluid.layers.mean(out)
+    return [(main, [loss.name]), (startup, None)]
+
+
+def _transpiled_collective():
+    main, startup = _fresh_programs()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        out = fluid.layers.fc(x, size=2)
+        loss = fluid.layers.mean(out)
+        fluid.optimizer.SGD(learning_rate=0.01).minimize(loss)
+    cfg = fluid.DistributeTranspilerConfig()
+    cfg.mode = "collective"
+    t = fluid.DistributeTranspiler(cfg)
+    t.transpile(0, program=main, startup_program=startup, trainers=2)
+    return [(main, [loss.name]), (startup, None)]
+
+
+@pytest.mark.parametrize("builder", [
+    _book_mlp, _book_conv_bn, _control_flow_while_grad, _static_rnn,
+    _transpiled_collective,
+], ids=["book-mlp", "book-conv-bn", "while-grad", "static-rnn",
+        "dist-collective"])
+def test_exemplar_programs_lint_clean(builder, verify_clean):
+    """Fast tier-1 sweep: the verifier itself is exercised on every run
+    against realistic programs — and must stay silent on them."""
+    for program, targets in builder():
+        verify_clean(program, targets=targets)

@@ -82,6 +82,7 @@ MODULES = [
     "paddle_tpu.average",
     "paddle_tpu.trainer_desc",
     "paddle_tpu.analysis",
+    "paddle_tpu.static_analysis",
     "paddle_tpu.device_worker",
     "paddle_tpu.evaluator",
 ]
