@@ -107,6 +107,7 @@ from .data_feed_desc import DataFeedDesc
 from .dataset import DatasetFactory
 from . import static_analysis
 from .static_analysis import verify_program
+from . import resilience
 
 # `import paddle_tpu as fluid` is the intended spelling for users of the
 # reference's `import paddle.fluid as fluid`.
@@ -177,6 +178,7 @@ __all__ = [
     "cuda_pinned_places",
     "static_analysis",
     "verify_program",
+    "resilience",
 ]
 
 

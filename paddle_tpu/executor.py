@@ -186,6 +186,12 @@ def as_numpy(value):
 _HOST_SIDE_OPS = ("feed", "fetch", "save", "load", "save_combine",
                   "load_combine")
 
+# extra feed carrying the resilience fault-injection gate vector —
+# present only under an active PADDLE_TPU_FAULT_SPEC with value faults,
+# so normal runs never pay for it.  (faults.py owns the name; safe to
+# import at module level: resilience/ is stdlib-only at import time.)
+from .resilience.faults import GATE_FEED as _FAULT_GATE_FEED
+
 
 class _FusedOp:
     """Lowering-time stand-in for a group of coalesced ops (duck-types
@@ -328,6 +334,18 @@ def _probe_trip_counts(block, feed_vals, scope, fetch_names):
     return ctx.trip_counts
 
 
+def _is_training_program(program):
+    """Does the global block train (grad/optimize ops present)?  Gates
+    both the finite step-guard and value-fault injection: an eval or
+    startup dispatch at the same step must neither engage the guard nor
+    burn a value fault's firing budget."""
+    for op in program.global_block().ops:
+        if op.type.endswith("_grad") \
+                or op.attrs.get("op_role") == "optimize":
+            return True
+    return False
+
+
 def _has_unbounded_while_grad(program):
     """Any while_grad without max_trip_count, in ANY block (an unbounded
     while may sit inside a cond/recurrent sub-block)."""
@@ -371,6 +389,31 @@ def _analyze_block(block, feed_names, fetch_names):
 _LAST_COMPILED_BLOCK = None
 
 
+def _all_finite(values):
+    """One scalar flag: every inexact value in `values` is NaN/Inf-free
+    (the in-graph side of the resilience NaN step-guard)."""
+    import jax.numpy as jnp
+
+    flags = [jnp.all(jnp.isfinite(v)) for v in values
+             if v is not None and hasattr(v, "dtype")
+             and jnp.issubdtype(v.dtype, jnp.inexact)]
+    if not flags:
+        return jnp.asarray(True)
+    out = flags[0]
+    for f in flags[1:]:
+        out = jnp.logical_and(out, f)
+    return out
+
+
+def _guard_select(finite, new, old):
+    """Route a state update through the finite flag: a non-finite step
+    keeps the old value bit-identically (dynamic-loss-scaling-style
+    skip)."""
+    import jax.numpy as jnp
+
+    return jnp.where(finite, new, old)
+
+
 def promote_readonly_scope_arrays(scope, compiled):
     """Gather the compiled block's read-only args, promoting host numpy
     values to device arrays ONCE (written back to the scope).
@@ -403,7 +446,7 @@ def promote_readonly_scope_arrays(scope, compiled):
 class _CompiledBlock:
     def __init__(self, program, block, feed_names, fetch_names, scope, mode,
                  mesh=None, accumulate_steps=1, trip_counts=None,
-                 iters_per_run=1, shard_opt_state=False):
+                 iters_per_run=1, shard_opt_state=False, nan_guard=False):
         import jax
 
         global _LAST_COMPILED_BLOCK
@@ -411,6 +454,7 @@ class _CompiledBlock:
 
         self.feed_names = list(feed_names)
         self.fetch_names = list(fetch_names)
+        self.nan_guard = bool(nan_guard)
         self.accumulate_steps = int(accumulate_steps or 1)
         self.iters_per_run = int(iters_per_run or 1)
         self.shard_opt_state = bool(shard_opt_state) and mesh is not None
@@ -422,6 +466,15 @@ class _CompiledBlock:
         ext_reads, written, persist_written = _analyze_block(
             block, feed_names, fetch_names
         )
+        # every gradient the block produces joins the finite-guard check
+        # (plus the inexact fetches — the loss — checked at step time).
+        # A block producing NO gradients (startup, inference) has no
+        # update to skip: the guard downgrades to off so those runs
+        # neither pay the extra sync nor inflate the skip counters.
+        self._guard_grad_names = (
+            [n for n in dict.fromkeys(written) if "@GRAD" in n]
+            if self.nan_guard else [])
+        self.nan_guard = self.nan_guard and bool(self._guard_grad_names)
         # vars read from scope, split into mutated (donated) vs read-only
         self.rw_names = [n for n in ext_reads if n in persist_written]
         self.ro_names = [n for n in ext_reads if n not in persist_written]
@@ -476,10 +529,26 @@ class _CompiledBlock:
             env.update(feeds)
             ctx = op_registry.LoweringContext(base_key=key, mode=mode)
             ctx.trip_counts = self.trip_counts
+            gate = feeds.get(_FAULT_GATE_FEED)
+            if gate is not None:
+                from .resilience import faults as _rfaults
+
+                ctx.fault_value_hook = _rfaults.get_injector() \
+                    .make_value_hook(gate, loss_name=getattr(
+                        program, "_guard_loss_name", None))
             _run_ops_into_env(block, env, ctx, ops=_top_ops)
             fetches = [env[n] for n in self.fetch_names]
             new_rw = {n: env[n] for n in self.rw_names}
             fresh = {n: env[n] for n in self.fresh_persist if n in env}
+            if self.nan_guard:
+                finite = _all_finite(
+                    [env.get(n) for n in self._guard_grad_names]
+                    + fetches)
+                new_rw = {n: _guard_select(finite, v, rw[n])
+                          for n, v in new_rw.items()}
+                # the flag rides the fetch list back to the host, where
+                # guard.record_step keeps the skip counter
+                fetches = fetches + [finite]
             return fetches, new_rw, fresh
 
         if self.accumulate_steps > 1:
@@ -506,13 +575,22 @@ class _CompiledBlock:
                 f_s, _, fr_s = jax.eval_shape(step_once, feeds, rw, ro,
                                               key)
                 f0 = [jnp.zeros(s.shape, s.dtype) for s in f_s]
+                if self.nan_guard:
+                    # the guard flag (last fetch) AND-folds across the
+                    # scanned iterations — one non-finite iteration
+                    # anywhere in the dispatch must surface, not just
+                    # the final iteration's verdict
+                    f0[-1] = jnp.ones(f_s[-1].shape, f_s[-1].dtype)
                 fr0 = {n: jnp.zeros(s.shape, s.dtype)
                        for n, s in fr_s.items()}
 
                 def body(carry, idx):
-                    rw_c = carry[0]
+                    rw_c, f_prev = carry[0], carry[1]
                     f, nrw, fr = step_once(
                         feeds, rw_c, ro, jax.random.fold_in(key, idx))
+                    if self.nan_guard:
+                        f = f[:-1] + [jnp.logical_and(f[-1],
+                                                      f_prev[-1])]
                     return (nrw, f, fr), None
 
                 (rw_f, fetches, fresh), _ = jax.lax.scan(
@@ -657,8 +735,20 @@ class _AccumRunner:
         base_env = {}
         base_env.update(ro)
         base_env.update(rw)
+        # the fault gate is per-step metadata, not batch data: keep it
+        # out of the microbatch reshape and hand it to the hook directly
+        gate = feeds.get(_FAULT_GATE_FEED)
+        fault_hook = None
+        if gate is not None:
+            from .resilience import faults as _rfaults
+
+            fault_hook = _rfaults.get_injector().make_value_hook(
+                gate, loss_name=getattr(self.block.program,
+                                        "_guard_loss_name", None))
         micro = {}
         for n, v in feeds.items():
+            if n == _FAULT_GATE_FEED:
+                continue
             b = v.shape[0]
             if b % k:
                 raise ValueError(
@@ -671,6 +761,7 @@ class _AccumRunner:
             e.update(mf)
             ctx = op_registry.LoweringContext(
                 base_key=jax.random.fold_in(key, idx), mode=self.mode)
+            ctx.fault_value_hook = fault_hook
             _run_ops_into_env(self.block, e, ctx, ops=self.head)
             return (
                 {n: e[n] for n in self.grad_reads},
@@ -715,10 +806,17 @@ class _AccumRunner:
         for n in self.grad_reads:
             env[n] = acc[n] / jnp.asarray(k, acc[n].dtype)
         ctx = op_registry.LoweringContext(base_key=key, mode=self.mode)
+        ctx.fault_value_hook = fault_hook
         _run_ops_into_env(self.block, env, ctx, ops=self.tail)
         fetches = [env[n] for n in cb.fetch_names]
         new_rw = {n: env[n] for n in cb.rw_names}
         fresh = {n: env[n] for n in cb.fresh_persist if n in env}
+        if cb.nan_guard:
+            finite = _all_finite(
+                [env.get(n) for n in self.grad_reads] + fetches)
+            new_rw = {n: _guard_select(finite, v, rw[n])
+                      for n, v in new_rw.items()}
+            fetches = fetches + [finite]
         return fetches, new_rw, fresh
 
 
@@ -765,6 +863,42 @@ def _host_table_push(host_active, fetches, n_user):
     return fetches[:n_user]
 
 
+def _apply_step_results(compiled, scope, fetches, new_rw, fresh,
+                        fetch_names, host_active, host_grad_fetches,
+                        step):
+    """Post-dispatch protocol shared by Executor.run and SPMDRunner.run.
+
+    Order matters: the donated rw state must reach the scope FIRST (its
+    old buffers are gone; the guard already reverted a non-finite step
+    in-graph), then the guard flag is stripped and recorded — which may
+    raise on a diverged run, leaving the scope consistent — and only a
+    finite step applies write-only persistables and the host-table grad
+    push: a skipped step must leave host tables and fresh persistables
+    exactly as untouched as the params."""
+    from .resilience import guard as _rguard
+
+    for n, v in new_rw.items():
+        scope.set(n, v)
+    step_finite = True
+    if compiled.nan_guard:
+        # last fetch is the in-graph all-finite flag; a cold flag means
+        # this step's update was skipped in-graph
+        finite_flag = fetches[-1]
+        fetches = fetches[:-1]
+        step_finite = _rguard.record_step(bool(np.asarray(finite_flag)),
+                                          step=step)
+    if step_finite:
+        for n, v in fresh.items():
+            scope.set(n, v)
+    if host_grad_fetches:
+        n_user = len(fetch_names) - len(host_grad_fetches)
+        if step_finite:
+            fetches = _host_table_push(host_active, fetches, n_user)
+        else:
+            fetches = fetches[:n_user]
+    return fetches
+
+
 def _run_ops_into_env(block, env, ctx, ops=None):
     """Lower ops of `block` (all, or the given subset) into `env` (the SSA
     value map).
@@ -780,6 +914,7 @@ def _run_ops_into_env(block, env, ctx, ops=None):
 
     from .ops import control_flow as cf_ops
 
+    fault_hook = getattr(ctx, "fault_value_hook", None)
     for i, op in enumerate(block.ops if ops is None else ops):
         if op.type in ("feed", "fetch"):
             continue
@@ -809,6 +944,8 @@ def _run_ops_into_env(block, env, ctx, ops=None):
                 continue
             for n, v in zip(names, vals):
                 if n and n != EMPTY_VAR_NAME and v is not None:
+                    if fault_hook is not None:
+                        v = fault_hook(n, v)
                     env[n] = v
     return env
 
@@ -907,6 +1044,17 @@ class Executor:
             v.name if isinstance(v, Variable) else str(v) for v in fetch_list
         ]
 
+        # ---- resilience hooks (all no-ops without a fault spec /
+        # PADDLE_TPU_NAN_GUARD — see resilience/) ----
+        from .resilience import faults as _rfaults
+        from .resilience import guard as _rguard
+        from .resilience import retry as _rretry
+
+        inj = _rfaults.get_injector()
+        # fires worker_kill / worker_hang process faults at their step
+        cur_step = inj.on_step() if inj.active else self._step
+        nan_guard = _rguard.guard_enabled(program)
+
         # save/load ops are host IO, never jitted (reference save_op.cc).
         # Loads run now (their outputs feed the compute), saves after the
         # jitted step's scope writeback; a pure-IO program skips jit.
@@ -931,6 +1079,16 @@ class Executor:
                 value = jnp.asarray(value)
             feed_vals[name] = value
         _check_feed_shapes(program, feed_vals)
+
+        # fault-injection gate vector: one fed scalar per value fault, so
+        # the step-dependent corruption never recompiles the block.
+        # Training dispatches only — gate_vector() consumes firing
+        # budgets, and an eval/startup run at the eligible step must not
+        # silently burn the fault
+        if inj.active and inj.trace_faults \
+                and _is_training_program(program):
+            feed_vals[_FAULT_GATE_FEED] = jnp.asarray(
+                inj.gate_vector(cur_step))
 
         # host-resident embedding tables (parameter_prefetch.cc role):
         # prefetch each batch's rows into a dense slab feed; the slab's
@@ -958,13 +1116,19 @@ class Executor:
             sig,
             tuple(fetch_names),
             tuple(sorted((trip_counts or {}).items())),
+            nan_guard,
         )
         from . import profiler as _prof
 
         compiled = self._cache.get(key_tuple) if use_program_cache else None
         if compiled is None:
-            with _prof.record_event("executor.lower_and_jit"):
-                compiled = _CompiledBlock(
+            def _compile():
+                # injectable site (compile_fail) — and transient
+                # backend/OS failures back off and retry instead of
+                # killing an otherwise healthy run
+                if inj.active:
+                    inj.maybe_fire("compile", step=cur_step)
+                return _CompiledBlock(
                     program,
                     program.global_block(),
                     list(feed_vals),
@@ -972,7 +1136,12 @@ class Executor:
                     scope,
                     mode,
                     trip_counts=trip_counts,
+                    nan_guard=nan_guard,
                 )
+
+            with _prof.record_event("executor.lower_and_jit"):
+                compiled = _rretry.retry_call(_compile,
+                                              site="executor.compile")
             if use_program_cache:
                 self._cache[key_tuple] = compiled
 
@@ -990,15 +1159,9 @@ class Executor:
         with run_ctx:
             fetches, new_rw, fresh = compiled.jitted(
                 feed_vals, rw, ro, base_key)
-        for n, v in new_rw.items():
-            scope.set(n, v)
-        for n, v in fresh.items():
-            scope.set(n, v)
-
-        if host_grad_fetches:
-            fetches = _host_table_push(
-                host_active, fetches,
-                len(fetch_names) - len(host_grad_fetches))
+        fetches = _apply_step_results(
+            compiled, scope, fetches, new_rw, fresh, fetch_names,
+            host_active, host_grad_fetches, cur_step)
 
         if has_host_io:
             run_host_io_block(program.global_block(), scope, phase="save")

@@ -14,18 +14,31 @@ def init_jax_distributed(coordinator_address, num_processes, process_id):
     """Multi-host bootstrap via the jax coordination service (replaces
     the reference's gen_nccl_id_op.cc:188 rank-0 RPC broadcast).
 
-    A genuinely failed bootstrap re-raises: silently degrading to
+    The bootstrap is the rendezvous where transient faults concentrate
+    (a peer restarting, a coordinator port not yet listening), so it
+    runs under the resilience retry policy: injected/transient
+    connection-level failures back off and re-attempt; a genuinely
+    failed bootstrap still re-raises — silently degrading to
     un-synchronized single-host training on an n-host job is the one
     outcome worse than crashing.  Only 'already initialized' is benign.
     """
     import jax
 
-    try:
+    from ....resilience import faults as _rfaults
+    from ....resilience import retry as _rretry
+
+    def _boot():
+        # injectable site (barrier_fail): a transient bootstrap failure
+        # must be absorbed by the backoff, not kill the worker
+        _rfaults.get_injector().maybe_fire("barrier")
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
             process_id=process_id,
         )
+
+    try:
+        _rretry.retry_call(_boot, site="fleet.init_jax_distributed")
     except (RuntimeError, ValueError) as e:
         if "already" not in str(e).lower():
             raise

@@ -51,6 +51,35 @@ class Collective(Fleet):
     def init_worker(self):
         pass
 
+    def barrier_worker(self, timeout=None):
+        """All-worker rendezvous with a bounded wait (reference
+        fleet_base barrier_worker, minus the ability to hang forever):
+        a peer that died leaves this call stuck in the coordination
+        service, so the sync runs under a wall-clock deadline (env
+        ``PADDLE_TPU_BARRIER_TIMEOUT_S``, default 600) and surfaces as
+        :class:`~paddle_tpu.resilience.watchdog.WorkerLostError` instead
+        of an unbounded hang."""
+        import jax
+
+        if self.worker_num() <= 1 or jax.process_count() <= 1:
+            return
+
+        from ....resilience import retry as _retry
+        from ....resilience.watchdog import WorkerLostError
+
+        if timeout is None:
+            timeout = float(os.environ.get(
+                "PADDLE_TPU_BARRIER_TIMEOUT_S", "600"))
+
+        def _sync():
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("paddle_tpu_fleet_barrier")
+
+        _retry.run_with_timeout(
+            _sync, timeout, what="fleet worker barrier",
+            error_cls=WorkerLostError)
+
     def init_server(self, model_dir=None):
         raise NotImplementedError(
             "Collective fleet has no servers; all members are workers"

@@ -41,6 +41,34 @@ __all__ = [
 MODEL_FILENAME = "__model__"
 
 
+# the one atomic-write idiom, shared with the resilience runtime
+# (stdlib-only module: no import-cycle risk)
+from .resilience.atomic import atomic_write as _atomic_write
+
+
+def _atomic_np_save(path, arr):
+    _atomic_write(path, lambda f: np.save(f, arr))
+
+
+def _load_array(path, var_name):
+    """np.load with failures renamed to something actionable: which file,
+    which variable, what's wrong — instead of a bare numpy/zipfile
+    traceback from deep inside a restore."""
+    import zipfile
+
+    if not os.path.exists(path):
+        raise RuntimeError(
+            "checkpoint file %r for variable %r is missing — the "
+            "checkpoint directory is incomplete (torn save or wrong "
+            "dirname)" % (path, var_name))
+    try:
+        return np.load(path)
+    except (ValueError, OSError, EOFError, zipfile.BadZipFile) as e:
+        raise RuntimeError(
+            "checkpoint file %r for variable %r is corrupt or "
+            "unreadable: %s" % (path, var_name, e)) from e
+
+
 def _is_persistable(var):
     return var.persistable and not var.is_data
 
@@ -89,8 +117,8 @@ def _save_sharded(dirname, name, val):
         if shard.replica_id != 0:
             continue
         bounds = _index_key(shard.index, val.shape)
-        np.save(os.path.join(shard_dir, _shard_fname(bounds)),
-                np.asarray(shard.data))
+        _atomic_np_save(os.path.join(shard_dir, _shard_fname(bounds)),
+                        np.asarray(shard.data))
     # meta is tiny and identical on every process; write-then-rename so
     # concurrent writers on a shared filesystem can never leave a torn
     # meta.json (os.replace is atomic on POSIX)
@@ -136,7 +164,7 @@ def _read_sharded_region(entries, meta, bounds, name):
                 "files listed in meta.json must be reachable from this "
                 "process (on multi-host, merge the per-host checkpoint "
                 "dirs or load on the saving topology)" % (name, path))
-        data = np.load(path)
+        data = _load_array(path, name)
         src = tuple(slice(o0 - f0, o1 - f0)
                     for (o0, o1), (f0, _) in zip(overlap, fb))
         dst = tuple(slice(o0 - b0, o1 - b0)
@@ -158,8 +186,18 @@ def _load_sharded(shard_dir, current, name):
     import jax
     import jax.numpy as jnp
 
-    with open(os.path.join(shard_dir, "meta.json")) as f:
-        meta = json.load(f)
+    meta_path = os.path.join(shard_dir, "meta.json")
+    if not os.path.exists(meta_path):
+        raise RuntimeError(
+            "sharded checkpoint for %r has no meta.json under %r — torn "
+            "or pre-meta save; re-save the checkpoint" % (name, shard_dir))
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except ValueError as e:
+        raise RuntimeError(
+            "sharded checkpoint meta %r for %r is corrupt: %s"
+            % (meta_path, name, e)) from e
     shape = tuple(meta["shape"])
     entries = _shard_entries(shard_dir, meta)
     if current is not None and _is_sharded_value(current) \
@@ -191,6 +229,11 @@ def save_vars(executor, dirname, main_program=None, vars=None,
         ]
     scope = global_scope()
     os.makedirs(dirname, exist_ok=True)
+    from .resilience.faults import get_injector
+
+    inj = get_injector()
+    if inj.active:
+        inj.maybe_fire("io_write")
     if filename is None:
         for v in vars:
             val = scope.get(v.name)
@@ -199,8 +242,10 @@ def save_vars(executor, dirname, main_program=None, vars=None,
             if _is_sharded_value(val):
                 _save_sharded(dirname, v.name, val)
             else:
-                np.save(os.path.join(dirname, v.name.replace("/", "_")),
-                        np.asarray(val))
+                _atomic_np_save(
+                    os.path.join(dirname,
+                                 v.name.replace("/", "_") + ".npy"),
+                    np.asarray(val))
     else:
         arrays = {}
         for v in vars:
@@ -213,7 +258,10 @@ def save_vars(executor, dirname, main_program=None, vars=None,
                 _save_sharded(dirname, v.name, val)
             else:
                 arrays[v.name] = np.asarray(val)
-        np.savez(os.path.join(dirname, filename), **arrays)
+        path = os.path.join(dirname, filename)
+        if not path.endswith(".npz"):
+            path += ".npz"
+        _atomic_write(path, lambda f: np.savez(f, **arrays))
 
 
 def save_params(executor, dirname, main_program=None, filename=None):
@@ -255,6 +303,11 @@ def load_vars(executor, dirname, main_program=None, vars=None,
             if (predicate or _is_persistable)(v)
         ]
     scope = global_scope()
+    from .resilience.faults import get_injector
+
+    inj = get_injector()
+    if inj.active:
+        inj.maybe_fire("io_read")
     if filename is None:
         for v in vars:
             safe = v.name.replace("/", "_")
@@ -265,13 +318,29 @@ def load_vars(executor, dirname, main_program=None, vars=None,
                 continue
             path = os.path.join(dirname, safe + ".npy")
             if not os.path.exists(path):
+                # historically a silent skip; at least surface the
+                # partial restore — the var keeps its current (likely
+                # freshly-initialized) value.  Raising here would break
+                # legitimate subset loads (load_params over a program
+                # that also holds never-saved state), so: warn.
+                import warnings
+
+                warnings.warn(
+                    "checkpoint dir %r has no file for variable %r — "
+                    "it keeps its current value (partial restore?)"
+                    % (dirname, v.name), RuntimeWarning, stacklevel=2)
                 continue
-            scope.set(v.name, jnp.asarray(np.load(path)))
+            scope.set(v.name, jnp.asarray(_load_array(path, v.name)))
     else:
         path = os.path.join(dirname, filename)
         if not path.endswith(".npz"):
             path = path + ".npz"
-        data = np.load(path)
+        if not os.path.exists(path):
+            raise RuntimeError(
+                "combined checkpoint file %r does not exist — nothing "
+                "was saved under filename %r in %r"
+                % (path, filename, dirname))
+        data = _load_array(path, "<combined>")
         for v in vars:
             shard_dir = os.path.join(
                 dirname, v.name.replace("/", "_") + ".shards")
@@ -279,7 +348,19 @@ def load_vars(executor, dirname, main_program=None, vars=None,
                 cur = scope.get(v.name) if scope.has(v.name) else None
                 scope.set(v.name, _load_sharded(shard_dir, cur, v.name))
             elif v.name in data:
-                scope.set(v.name, jnp.asarray(data[v.name]))
+                # npz loads lazily: a truncated/corrupt MEMBER surfaces
+                # here, not at np.load — name the var and file
+                import zipfile
+                import zlib
+
+                try:
+                    arr = data[v.name]
+                except (ValueError, OSError, EOFError,
+                        zipfile.BadZipFile, zlib.error) as e:
+                    raise RuntimeError(
+                        "member %r of combined checkpoint %r is corrupt "
+                        "or unreadable: %s" % (v.name, path, e)) from e
+                scope.set(v.name, jnp.asarray(arr))
 
 
 def load_params(executor, dirname, main_program=None, filename=None):

@@ -155,6 +155,10 @@ class LoweringContext:
         # scan lengths for while_grad
         self.probing = False
         self.trip_counts = None
+        # resilience fault injection: optional (name, value) -> value hook
+        # applied to every op output at trace time (executor sets it when
+        # a PADDLE_TPU_FAULT_SPEC names value faults; None = zero cost)
+        self.fault_value_hook = None
 
     def set_op(self, op_id):
         self._op_id = op_id
@@ -255,9 +259,13 @@ def _make_generic_grad_def(fwd_def):
                     g = g.astype(_cotangent_dtype(p))
                 # under shard_map the primal may be varying over manual
                 # mesh axes; a freshly built cotangent is replicated and
-                # jax rejects the vma mismatch — promote it to match
-                missing = (getattr(jax.typeof(p), "vma", frozenset())
-                           - getattr(jax.typeof(g), "vma", frozenset()))
+                # jax rejects the vma mismatch — promote it to match.
+                # (jax.typeof only exists on jax versions that track vma
+                # avals; without it there is no mismatch to repair)
+                _typeof = getattr(jax, "typeof", None)
+                missing = frozenset() if _typeof is None else (
+                    getattr(_typeof(p), "vma", frozenset())
+                    - getattr(_typeof(g), "vma", frozenset()))
                 if missing:
                     if hasattr(jax.lax, "pcast"):
                         g = jax.lax.pcast(

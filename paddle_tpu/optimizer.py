@@ -240,6 +240,10 @@ class Optimizer:
         params_grads = self.backward(
             loss, startup_program, parameter_list, no_grad_set
         )
+        # resilience: record which var is THE loss so the NaN step-guard
+        # (executor) and value-fault injection target it by name, and the
+        # static-analysis finite-guard advisory can name it in its hint
+        loss.block.program._guard_loss_name = loss.name
         from .clip import per_call_gradient_clip
 
         with per_call_gradient_clip(loss.block.program, grad_clip):

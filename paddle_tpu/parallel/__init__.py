@@ -14,9 +14,10 @@ the XLA partitioner.
 import numpy as np
 
 from .. import core
-from ..executor import (_CompiledBlock, _host_table_prefetch,
-                        _host_table_push, global_scope,
-                        promote_readonly_scope_arrays, rng_key)
+from ..executor import (_CompiledBlock, _apply_step_results,
+                        _host_table_prefetch, _host_table_push,
+                        global_scope, promote_readonly_scope_arrays,
+                        rng_key)
 from ..framework import Variable, default_main_program
 
 __all__ = ["ParallelExecutor", "SPMDRunner"]
@@ -71,6 +72,17 @@ class SPMDRunner:
         fetch_names = [
             v.name if isinstance(v, Variable) else str(v) for v in fetch_list
         ]
+
+        # resilience hooks (see resilience/): process faults fire here
+        # too, and the finite step-guard covers the DP/ZeRO paths.
+        # (Value-fault gates stay single-process-executor-only — a fed
+        # scalar cannot take the batch sharding this path pins on feeds.)
+        from ..resilience import faults as _rfaults
+        from ..resilience import guard as _rguard
+
+        inj = _rfaults.get_injector()
+        cur_step = inj.on_step() if inj.active else executor._step
+        nan_guard = _rguard.guard_enabled(self.program)
         if jax.process_count() > 1 and self.mesh is not None:
             # multi-process cluster (reference nccl2 mode): each process
             # feeds its LOCAL batch shard; assemble the global batch-
@@ -114,7 +126,8 @@ class SPMDRunner:
             (n, tuple(v.shape), str(v.dtype))
             for n, v in sorted(feed_vals.items())
         )
-        key_tuple = (self.program._version, id(scope), sig, tuple(fetch_names))
+        key_tuple = (self.program._version, id(scope), sig,
+                     tuple(fetch_names), nan_guard)
         compiled = self._cache.get(key_tuple)
         if compiled is None:
             compiled = _CompiledBlock(
@@ -128,6 +141,7 @@ class SPMDRunner:
                 accumulate_steps=self.accumulate_steps,
                 iters_per_run=self.iters_per_run,
                 shard_opt_state=self.shard_opt_state,
+                nan_guard=nan_guard,
             )
             self._cache[key_tuple] = compiled
 
@@ -137,14 +151,9 @@ class SPMDRunner:
         base_key = jax.random.fold_in(rng_key(seed), executor._step)
         executor._step += 1
         fetches, new_rw, fresh = compiled.jitted(feed_vals, rw, ro, base_key)
-        for n, v in new_rw.items():
-            scope.set(n, v)
-        for n, v in fresh.items():
-            scope.set(n, v)
-        if host_grad_fetches:
-            fetches = _host_table_push(
-                host_active, fetches,
-                len(fetch_names) - len(host_grad_fetches))
+        fetches = _apply_step_results(
+            compiled, scope, fetches, new_rw, fresh, fetch_names,
+            host_active, host_grad_fetches, cur_step)
         if return_numpy:
             return [np.asarray(v) for v in fetches]
         return list(fetches)

@@ -1,0 +1,29 @@
+"""One atomic-write idiom for the whole runtime (stdlib-only, so io.py,
+faults, checkpoint and watchdog can all share it without import cycles):
+write-to-tmp, optional fsync, ``os.replace`` — a crash mid-write can
+never leave a torn file under the final name, and the previous file (if
+any) survives intact."""
+
+import os
+
+__all__ = ["atomic_write"]
+
+
+def atomic_write(path, writer, fsync=True, text=False):
+    """``writer(fileobj)`` produces the content; ``path`` must already
+    carry its extension (handing numpy an open file object stops it from
+    appending one)."""
+    tmp = "%s.tmp-%d" % (path, os.getpid())
+    try:
+        with open(tmp, "w" if text else "wb") as f:
+            writer(f)
+            f.flush()
+            if fsync:
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass

@@ -1,0 +1,323 @@
+"""Atomic + versioned checkpoints with integrity manifests and
+auto-resume.
+
+Layered over ``io.save_persistables``/``load_persistables`` (reference:
+``fluid.io`` checkpoint_utils role).  Layout under a checkpoint root::
+
+    <root>/
+      ckpt-00000007/
+        MANIFEST.json      # written LAST: schema, step, per-file sha256
+        state.json         # trainer state: step counter, user extras
+        vars/              # persistables (one .npy / .shards dir per var)
+      ckpt-00000008/
+      .tmp-00000009-<pid>/ # in-flight save (invisible to load)
+
+Guarantees:
+
+* **atomic**: everything is staged into a ``.tmp-*`` sibling and renamed
+  into place in one ``os.rename``; a crash mid-save leaves only a tmp
+  dir that loaders never look at (and the next save sweeps);
+* **verified**: ``MANIFEST.json`` records a sha256 + size per file and is
+  itself written last — a version missing its manifest, missing a listed
+  file, or failing a checksum is *torn* and is skipped, never loaded;
+* **versioned**: ``retain`` newest versions are kept (default env
+  ``PADDLE_TPU_CKPT_RETAIN`` = 5), older ones pruned after a successful
+  save — never before, so a failed save cannot eat the last good state;
+* **retried**: the save/load bodies run under
+  :func:`~paddle_tpu.resilience.retry.retry_call`, absorbing transient
+  I/O failures (injected ``ckpt_write_fail``/``ckpt_read_fail`` faults
+  included);
+* **resumable**: :func:`try_load_latest_checkpoint` walks versions
+  newest-first, loads the first intact one into the scope and returns
+  its step + trainer state (``None`` when nothing valid exists — a fresh
+  run, not an error).
+"""
+
+import collections
+import hashlib
+import json
+import os
+import shutil
+import time
+import warnings
+
+from . import faults as _faults
+from . import retry as _retry
+
+__all__ = ["CheckpointInfo", "CorruptCheckpointError", "save_checkpoint",
+           "try_load_latest_checkpoint", "list_checkpoints",
+           "verify_checkpoint", "MANIFEST_NAME", "CKPT_PREFIX"]
+
+MANIFEST_NAME = "MANIFEST.json"
+STATE_NAME = "state.json"
+VARS_SUBDIR = "vars"
+CKPT_PREFIX = "ckpt-"
+_SCHEMA = 1
+
+CheckpointInfo = collections.namedtuple(
+    "CheckpointInfo", ["step", "path", "state"])
+
+
+class CorruptCheckpointError(RuntimeError):
+    """A checkpoint version failed integrity verification."""
+
+
+def _default_retain():
+    try:
+        return int(os.environ.get("PADDLE_TPU_CKPT_RETAIN", "5"))
+    except ValueError:
+        return 5
+
+
+def _file_sha256(path, chunk=1 << 20):
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            h.update(block)
+    return h.hexdigest()
+
+
+def _walk_files(root):
+    for dirpath, _, filenames in os.walk(root):
+        for fname in sorted(filenames):
+            full = os.path.join(dirpath, fname)
+            yield os.path.relpath(full, root), full
+
+
+def _version_dir(root, step):
+    return os.path.join(root, "%s%08d" % (CKPT_PREFIX, int(step)))
+
+
+def _parse_step(dirname):
+    base = os.path.basename(dirname.rstrip(os.sep))
+    if not base.startswith(CKPT_PREFIX):
+        return None
+    try:
+        return int(base[len(CKPT_PREFIX):])
+    except ValueError:
+        return None
+
+
+def list_checkpoints(root, include_torn=False):
+    """``[(step, path)]`` of complete versions, newest first.  A version
+    dir without a manifest is torn (the manifest is written last) and is
+    excluded unless ``include_torn`` — torn dirs must count neither
+    toward retention nor as "latest" anywhere; per-file integrity is
+    verified at load."""
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for name in os.listdir(root):
+        path = os.path.join(root, name)
+        step = _parse_step(name)
+        if step is None or not os.path.isdir(path):
+            continue
+        if not include_torn \
+                and not os.path.exists(os.path.join(path, MANIFEST_NAME)):
+            continue
+        out.append((step, path))
+    out.sort(key=lambda sp: sp[0], reverse=True)
+    return out
+
+
+def _pid_alive(pid):
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except OSError:
+        pass  # EPERM etc.: exists but not ours — treat as alive
+    return True
+
+
+def _sweep_tmp(root):
+    """Remove crashed saves' staging dirs (best-effort).  Only dirs
+    whose owning pid is gone (or is us) are swept — a concurrent
+    ``all_ranks`` saver's in-flight staging must not be deleted from
+    under it."""
+    if not os.path.isdir(root):
+        return
+    for name in os.listdir(root):
+        if not (name.startswith(".tmp-") or name.startswith(".old-")):
+            continue
+        try:
+            owner = int(name.rsplit("-", 1)[1])
+        except (ValueError, IndexError):
+            owner = None
+        if owner is None or owner == os.getpid() \
+                or not _pid_alive(owner):
+            shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+
+
+def _is_primary():
+    """Only one process of a cluster writes the shared checkpoint dirs
+    (replicated persistables are identical everywhere; per-process shard
+    files remain a single-host affair in this harness)."""
+    try:
+        return int(os.environ.get("PADDLE_TRAINER_ID", "0")) == 0
+    except ValueError:
+        return True
+
+
+def save_checkpoint(executor, root, main_program=None, step=0, state=None,
+                    retain=None, policy=None, all_ranks=False):
+    """Write one atomic, verified checkpoint version; returns its final
+    path (``None`` on non-primary cluster ranks unless ``all_ranks``).
+
+    The whole body — stage, checksum, finalize — is one retryable unit:
+    a transient failure anywhere discards the staging dir and starts
+    over, so no partial version ever becomes visible.
+    """
+    if not all_ranks and not _is_primary():
+        return None
+    from .. import io as fluid_io
+
+    step = int(step)
+    os.makedirs(root, exist_ok=True)
+    _sweep_tmp(root)
+    inj = _faults.get_injector()
+
+    def _attempt():
+        tmp = os.path.join(root, ".tmp-%08d-%d" % (step, os.getpid()))
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+        try:
+            vars_dir = os.path.join(tmp, VARS_SUBDIR)
+            fluid_io.save_persistables(executor, vars_dir,
+                                       main_program=main_program)
+            with open(os.path.join(tmp, STATE_NAME), "w") as f:
+                json.dump({"step": step, "state": state or {}}, f)
+            # the injected transient fires AFTER the expensive writes so
+            # a retry exercises the full stage-again path
+            inj.maybe_fire("ckpt_write")
+            files = {}
+            for rel, full in _walk_files(tmp):
+                files[rel] = {"sha256": _file_sha256(full),
+                              "size": os.path.getsize(full)}
+            manifest = {"schema": _SCHEMA, "step": step,
+                        "wall_time": time.time(), "files": files}
+            from .atomic import atomic_write
+
+            atomic_write(os.path.join(tmp, MANIFEST_NAME),
+                         lambda f: json.dump(manifest, f, indent=1),
+                         text=True)
+            final = _version_dir(root, step)
+            aside = None
+            if os.path.isdir(final):
+                # re-save of the same step: move the old version aside
+                # FIRST (rename, not rmtree — the window between the two
+                # renames is the narrowest possible; the old data is
+                # never destroyed before the new version is in place)
+                aside = os.path.join(
+                    root, ".old-%08d-%d" % (step, os.getpid()))
+                shutil.rmtree(aside, ignore_errors=True)
+                os.rename(final, aside)
+            os.rename(tmp, final)
+            if aside is not None:
+                shutil.rmtree(aside, ignore_errors=True)
+            return final
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+
+    final = _retry.retry_call(_attempt, policy=policy,
+                              site="save_checkpoint(step=%d)" % step)
+    _prune(root, retain if retain is not None else _default_retain())
+    return final
+
+
+def _prune(root, retain):
+    if retain is None or retain <= 0:
+        return
+    complete = list_checkpoints(root)
+    for _, path in complete[retain:]:
+        shutil.rmtree(path, ignore_errors=True)
+    # torn versions (no manifest — a crashed finalize from an older
+    # writer, or tampering) are garbage: they can never be loaded, so
+    # they must not accumulate either
+    keep = {p for _, p in complete}
+    for _, path in list_checkpoints(root, include_torn=True):
+        if path not in keep:
+            shutil.rmtree(path, ignore_errors=True)
+
+
+def verify_checkpoint(path):
+    """Integrity-check one version dir; returns its manifest dict or
+    raises :class:`CorruptCheckpointError` naming what's wrong."""
+    man_path = os.path.join(path, MANIFEST_NAME)
+    if not os.path.exists(man_path):
+        raise CorruptCheckpointError(
+            "checkpoint %r has no %s (torn or in-flight save)"
+            % (path, MANIFEST_NAME))
+    try:
+        with open(man_path) as f:
+            manifest = json.load(f)
+    except (ValueError, OSError) as e:
+        raise CorruptCheckpointError(
+            "checkpoint %r manifest unreadable: %s" % (path, e)) from e
+    files = manifest.get("files")
+    if not isinstance(files, dict):
+        raise CorruptCheckpointError(
+            "checkpoint %r manifest has no file table" % path)
+    for rel, meta in files.items():
+        full = os.path.join(path, rel)
+        if not os.path.exists(full):
+            raise CorruptCheckpointError(
+                "checkpoint %r is missing file %r listed in its manifest"
+                % (path, rel))
+        size = os.path.getsize(full)
+        if size != meta.get("size"):
+            raise CorruptCheckpointError(
+                "checkpoint %r file %r size %d != manifest %s (truncated "
+                "write?)" % (path, rel, size, meta.get("size")))
+        digest = _file_sha256(full)
+        if digest != meta.get("sha256"):
+            raise CorruptCheckpointError(
+                "checkpoint %r file %r checksum mismatch (corrupt data)"
+                % (path, rel))
+    return manifest
+
+
+def try_load_latest_checkpoint(executor, root, main_program=None,
+                               policy=None):
+    """Auto-resume: load the newest *intact* checkpoint version into the
+    scope.  Corrupt/partial versions are warned about and skipped —
+    exactly the torn-file scenario this layer exists for.  Returns a
+    :class:`CheckpointInfo` (step, path, trainer state) or ``None`` when
+    no loadable version exists."""
+    from .. import io as fluid_io
+
+    inj = _faults.get_injector()
+    for step, path in list_checkpoints(root):
+        try:
+            def _attempt():
+                inj.maybe_fire("ckpt_read")
+                manifest = verify_checkpoint(path)
+                fluid_io.load_persistables(
+                    executor, os.path.join(path, VARS_SUBDIR),
+                    main_program=main_program)
+                return manifest
+
+            manifest = _retry.retry_call(
+                _attempt, policy=policy,
+                site="load_checkpoint(%s)" % os.path.basename(path))
+        except (CorruptCheckpointError, _retry.RetryExhaustedError) as e:
+            # ONLY integrity/transient failures demote to skip-this-
+            # version; anything else (model/checkpoint mismatch, a
+            # systemic path problem) would recur on every version and
+            # must fail fast, not silently restart training from step 0
+            warnings.warn(
+                "skipping unusable checkpoint %r: %s" % (path, e),
+                RuntimeWarning, stacklevel=2)
+            continue
+        state = {}
+        state_path = os.path.join(path, STATE_NAME)
+        if os.path.exists(state_path):
+            with open(state_path) as f:
+                state = json.load(f).get("state", {})
+        return CheckpointInfo(step=manifest.get("step", step), path=path,
+                              state=state)
+    return None

@@ -1,0 +1,413 @@
+"""Deterministic, seeded fault injection for resilience testing.
+
+The reference's fault story is exercised only by hand (kill a trainer,
+corrupt a checkpoint, watch what happens); here faults are first-class and
+reproducible: a spec string — env ``PADDLE_TPU_FAULT_SPEC`` or
+:func:`set_fault_spec` — names *what* fails, *when*, and *how often*, and
+every probabilistic decision draws from a seeded RNG so a chaos run can be
+replayed bit-for-bit.
+
+Spec grammar (``;``-separated faults, each ``kind@key=val,key=val``)::
+
+    nan_grad@step=3                       # NaN into every @GRAD at step 3
+    inf_grad@step=2,target=fc_0.w_0@GRAD  # +inf into one chosen gradient
+    nan_loss@step=4                       # NaN into the loss value
+    ckpt_write_fail@step=5,times=2        # transient IOError in ckpt save
+    ckpt_read_fail@times=1                # transient IOError in ckpt load
+    io_fail@target=write,p=0.5,seed=7     # probabilistic raw io.py faults
+    compile_fail@times=1                  # simulated executor compile fail
+    barrier_fail@times=1                  # transient fleet-bootstrap fail
+    worker_kill@step=7,rank=1             # os._exit at step 7 on rank 1
+    worker_hang@step=7,rank=0,secs=3600   # simulated hang (sleep)
+
+Keys: ``step`` (training step to fire at; omitted = any step), ``rank``
+(only this worker, default any; rank = ``PADDLE_TRAINER_ID``), ``times``
+(max firings, default 1; ``times=0`` = unlimited), ``p`` (firing
+probability per eligible occurrence, default 1.0), ``seed`` (RNG seed for
+``p``), ``target`` (fnmatch pattern selecting gradient names / io
+direction), ``value`` (``nan`` | ``inf`` | ``-inf`` | float, for value
+faults), ``secs`` (hang duration).
+
+Fault classes:
+
+* **value faults** (``nan_grad``, ``inf_grad``, ``nan_loss``) corrupt
+  values *inside* the jitted step via a fed per-fault gate vector, so the
+  compiled function is reused across steps and the corruption is exactly
+  as the guard would see a real one;
+* **site faults** (``ckpt_write_fail``, ``ckpt_read_fail``, ``io_fail``,
+  ``compile_fail``, ``barrier_fail``) raise :class:`TransientFault` at a
+  named call site — the retry layer must absorb them;
+* **process faults** (``worker_kill``, ``worker_hang``) terminate or
+  stall the process at a training step — the watchdog layer must surface
+  them as :class:`~paddle_tpu.resilience.watchdog.WorkerLostError`.
+
+Step accounting: the Executor advances an internal run counter, but a
+training loop should pin the authoritative step with :func:`set_step`
+(the chaos CLI and tests do) so ``step=k`` means *its* step k regardless
+of startup-program runs or resume offsets.
+"""
+
+import fnmatch
+import os
+import random
+import time
+
+__all__ = [
+    "FaultInjected",
+    "TransientFault",
+    "Fault",
+    "FaultInjector",
+    "get_injector",
+    "set_fault_spec",
+    "reset_injector",
+    "set_step",
+    "GATE_FEED",
+    "KILL_EXIT_CODE",
+]
+
+# feed name carrying the per-fault gate vector into the jitted step
+GATE_FEED = "__fault_gate__"
+# exit status of a worker_kill fault — distinguishable from real crashes
+KILL_EXIT_CODE = 43
+
+VALUE_KINDS = ("nan_grad", "inf_grad", "nan_loss")
+SITE_KINDS = ("ckpt_write_fail", "ckpt_read_fail", "io_fail",
+              "compile_fail", "barrier_fail")
+PROCESS_KINDS = ("worker_kill", "worker_hang")
+
+# site fault kind -> default call-site it fires at
+_SITE_OF = {
+    "ckpt_write_fail": "ckpt_write",
+    "ckpt_read_fail": "ckpt_read",
+    "compile_fail": "compile",
+    "barrier_fail": "barrier",
+    # io_fail: site io_<target>, target in {write, read} (default write)
+}
+
+
+class FaultInjected(RuntimeError):
+    """Base class for every injected failure."""
+
+
+class TransientFault(FaultInjected, OSError):
+    """An injected *transient* failure (also an OSError so any generic
+    io retry policy treats it as retryable)."""
+
+
+def _parse_value(tok):
+    t = tok.strip().lower()
+    if t in ("nan",):
+        return float("nan")
+    if t in ("inf", "+inf"):
+        return float("inf")
+    if t == "-inf":
+        return float("-inf")
+    return float(tok)
+
+
+class Fault:
+    """One parsed spec entry; owns its firing budget and seeded RNG."""
+
+    def __init__(self, kind, step=None, rank=None, times=None, p=1.0,
+                 seed=0, target=None, value=None, secs=3600.0):
+        if kind not in VALUE_KINDS + SITE_KINDS + PROCESS_KINDS:
+            raise ValueError(
+                "unknown fault kind %r (have %s)"
+                % (kind, sorted(VALUE_KINDS + SITE_KINDS + PROCESS_KINDS)))
+        self.kind = kind
+        self.step = None if step is None else int(step)
+        self.rank = None if rank is None else int(rank)
+        # default: fire once (0 = unlimited)
+        self.times = 1 if times is None else int(times)
+        self.p = float(p)
+        self.seed = int(seed)
+        self._rng = random.Random(self.seed)
+        self.secs = float(secs)
+        if target is None:
+            if kind in ("nan_grad", "inf_grad"):
+                target = "*@GRAD"
+            elif kind == "io_fail":
+                target = "write"
+        self.target = target
+        if value is None and kind in VALUE_KINDS:
+            value = float("inf") if kind == "inf_grad" else float("nan")
+        self.value = value
+        self.fired = 0
+
+    @classmethod
+    def parse(cls, text):
+        text = text.strip()
+        if not text:
+            raise ValueError("empty fault entry")
+        kind, _, params = text.partition("@")
+        kw = {}
+        if params:
+            for item in params.split(","):
+                key, eq, val = item.partition("=")
+                key = key.strip()
+                if not eq or not key:
+                    raise ValueError(
+                        "malformed fault param %r in %r (want key=value)"
+                        % (item, text))
+                if key in ("step", "rank", "times", "seed"):
+                    kw[key] = int(val)
+                elif key in ("p", "secs"):
+                    kw[key] = float(val)
+                elif key == "value":
+                    kw[key] = _parse_value(val)
+                elif key == "target":
+                    kw[key] = val.strip()
+                else:
+                    raise ValueError(
+                        "unknown fault param %r in %r" % (key, text))
+        return cls(kind.strip(), **kw)
+
+    @property
+    def site(self):
+        if self.kind == "io_fail":
+            return "io_" + (self.target or "write")
+        return _SITE_OF.get(self.kind)
+
+    def exhausted(self):
+        return self.times > 0 and self.fired >= self.times
+
+    def _eligible(self, step, rank):
+        if self.exhausted():
+            return False
+        if self.step is not None and step is not None \
+                and step != self.step:
+            return False
+        if self.rank is not None and rank is not None \
+                and rank != self.rank:
+            return False
+        return True
+
+    def should_fire(self, step=None, rank=None):
+        """Decide (and consume budget on True)."""
+        if not self._eligible(step, rank):
+            return False
+        if self.p < 1.0 and self._rng.random() >= self.p:
+            return False
+        self.fired += 1
+        return True
+
+    def matches_name(self, name, loss_name=None):
+        if self.kind == "nan_loss":
+            pat = self.target or loss_name
+            return pat is not None and fnmatch.fnmatchcase(name, pat)
+        return self.target is not None \
+            and fnmatch.fnmatchcase(name, self.target)
+
+    def __repr__(self):
+        parts = [self.kind]
+        for k in ("step", "rank", "target"):
+            v = getattr(self, k)
+            if v is not None:
+                parts.append("%s=%s" % (k, v))
+        return "<Fault %s times=%d fired=%d>" % (
+            " ".join(parts), self.times, self.fired)
+
+
+def _default_rank():
+    try:
+        return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    except ValueError:
+        return 0
+
+
+class FaultInjector:
+    """Parsed fault spec + firing state.  One per process (see
+    :func:`get_injector`); a spec-less injector is inert and every hook
+    is a cheap no-op."""
+
+    def __init__(self, spec=None, rank=None, state_file=None):
+        self.spec = spec or ""
+        self.rank = _default_rank() if rank is None else int(rank)
+        self.faults = []
+        for entry in self.spec.split(";"):
+            if entry.strip():
+                self.faults.append(Fault.parse(entry))
+        self._auto_step = 0
+        self._pinned_step = None
+        # firing budgets can span process restarts (a worker_kill is ONE
+        # preemption, not one per incarnation): point
+        # PADDLE_TPU_FAULT_STATE_FILE at a shared path and consumed
+        # budgets persist across auto-resume restarts
+        self.state_file = (state_file if state_file is not None
+                           else os.environ.get(
+                               "PADDLE_TPU_FAULT_STATE_FILE"))
+        self._load_state()
+
+    def _load_state(self):
+        if not self.state_file or not os.path.exists(self.state_file):
+            return
+        import json
+
+        try:
+            with open(self.state_file) as f:
+                state = json.load(f)
+        except (ValueError, OSError):
+            return
+        if state.get("spec") != self.spec:
+            # stale file from a run with a different spec (e.g. same
+            # --ckpt-dir, new --spec): its positional counts are
+            # meaningless here — start fresh rather than pre-exhaust
+            return
+        for f_obj, count in zip(self.faults, state.get("fired", [])):
+            f_obj.fired = int(count)
+
+    def _persist_state(self):
+        if not self.state_file:
+            return
+        import json
+
+        from .atomic import atomic_write
+
+        try:
+            atomic_write(
+                self.state_file,
+                lambda f: json.dump(
+                    {"spec": self.spec,
+                     "fired": [f_obj.fired for f_obj in self.faults]},
+                    f),
+                text=True)
+        except OSError:
+            pass  # fault accounting must never take the trainer down
+
+    @property
+    def active(self):
+        return bool(self.faults)
+
+    @property
+    def trace_faults(self):
+        return [f for f in self.faults if f.kind in VALUE_KINDS]
+
+    # ---- step accounting ----
+    def set_step(self, step):
+        """Pin the authoritative training step (trainer loops should call
+        this each iteration; unpinned, Executor.run calls auto-count)."""
+        self._pinned_step = None if step is None else int(step)
+
+    def current_step(self):
+        return (self._pinned_step if self._pinned_step is not None
+                else self._auto_step)
+
+    # ---- hooks ----
+    def on_step(self):
+        """Called by the executor once per run dispatch: fires process
+        faults (kill/hang) for the current step and returns it."""
+        step = self.current_step()
+        if self._pinned_step is None:
+            self._auto_step += 1
+        if not self.faults:
+            return step
+        for f in self.faults:
+            if f.kind == "worker_kill" and f.should_fire(step, self.rank):
+                import sys
+
+                # persist BEFORE dying: the restarted incarnation must
+                # see this preemption as already-spent
+                self._persist_state()
+                print("FAULT_INJECTED worker_kill step=%d rank=%d"
+                      % (step, self.rank), file=sys.stderr, flush=True)
+                os._exit(KILL_EXIT_CODE)
+            elif f.kind == "worker_hang" \
+                    and f.should_fire(step, self.rank):
+                import sys
+
+                self._persist_state()
+                print("FAULT_INJECTED worker_hang step=%d rank=%d "
+                      "secs=%s" % (step, self.rank, f.secs),
+                      file=sys.stderr, flush=True)
+                time.sleep(f.secs)
+        return step
+
+    def maybe_fire(self, site, step=None):
+        """Raise :class:`TransientFault` if a site fault fires here."""
+        if not self.faults:
+            return
+        if step is None:
+            step = self.current_step()
+        for f in self.faults:
+            if f.site == site and f.should_fire(step, self.rank):
+                self._persist_state()
+                raise TransientFault(
+                    "injected %s at site %r (step %s, firing %d/%s)"
+                    % (f.kind, site, step, f.fired,
+                       f.times or "unlimited"))
+
+    def gate_vector(self, step=None):
+        """Per-trace-fault gate values (1.0 = corrupt this dispatch) as a
+        host float32 array; consumes each firing fault's budget."""
+        import numpy as np
+
+        if step is None:
+            step = self.current_step()
+        gates = [1.0 if f.should_fire(step, self.rank) else 0.0
+                 for f in self.trace_faults]
+        if any(gates):
+            self._persist_state()
+        return np.asarray(gates, dtype=np.float32)
+
+    def make_value_hook(self, gate, loss_name=None):
+        """Trace-time hook ``(name, value) -> value`` corrupting values
+        selected by the trace faults when their fed gate entry is hot.
+        ``jnp.where`` (not ``gate * value``) so a cold gate is exactly
+        identity — ``0 * nan`` would itself be nan."""
+        import jax.numpy as jnp
+
+        faults = self.trace_faults
+        for f in faults:
+            if f.kind == "nan_loss" and f.target is None \
+                    and loss_name is None:
+                import warnings
+
+                warnings.warn(
+                    "nan_loss fault has no target= and this program "
+                    "records no loss var (built without "
+                    "Optimizer.minimize?) — the fault will consume its "
+                    "budget without corrupting anything",
+                    RuntimeWarning, stacklevel=3)
+
+        def hook(name, val):
+            if not hasattr(val, "dtype") \
+                    or not jnp.issubdtype(val.dtype, jnp.inexact):
+                return val
+            for i, f in enumerate(faults):
+                if f.matches_name(name, loss_name=loss_name):
+                    val = jnp.where(gate[i] > 0,
+                                    jnp.asarray(f.value, val.dtype), val)
+            return val
+
+        return hook
+
+
+_injector = None
+
+
+def get_injector():
+    """Process singleton, parsed from ``PADDLE_TPU_FAULT_SPEC`` on first
+    use."""
+    global _injector
+    if _injector is None:
+        _injector = FaultInjector(
+            os.environ.get("PADDLE_TPU_FAULT_SPEC", ""))
+    return _injector
+
+
+def set_fault_spec(spec, rank=None):
+    """Install a new spec (replacing the singleton); returns the new
+    injector.  ``set_fault_spec(None)`` re-reads the env var lazily."""
+    global _injector
+    _injector = None if spec is None else FaultInjector(spec, rank=rank)
+    return _injector
+
+
+def reset_injector():
+    """Drop all firing state and re-parse from the environment."""
+    return set_fault_spec(None)
+
+
+def set_step(step):
+    """Pin the current training step on the process injector."""
+    get_injector().set_step(step)

@@ -1,0 +1,104 @@
+"""NaN/Inf step-guard: skip the parameter update on a non-finite step.
+
+Dynamic-loss-scaling semantics without the scaling: the jitted train step
+computes one all-finite flag over every produced gradient plus every
+inexact fetch (the loss), and every scope-state update is routed through
+``where(finite, new, old)`` — a non-finite step leaves params, optimizer
+moments and in-graph counters bit-identical to the step before, exactly
+as if the step had not run.  The flag rides the fetch list back to the
+host, where :func:`record_step` keeps the structured skip counter and
+emits a :class:`NonFiniteStepWarning`.
+
+Enable with env ``PADDLE_TPU_NAN_GUARD=1`` or per-program
+``program._nan_guard = True``; runs with the guard off behave (and
+compile) exactly as before.  ``PADDLE_TPU_NAN_GUARD_MAX_SKIPS`` (default
+25) bounds *consecutive* skipped steps — a run whose every step is
+non-finite has diverged and must crash loudly, not spin.
+"""
+
+import os
+import warnings
+
+__all__ = ["NonFiniteStepWarning", "GuardStats", "stats", "guard_enabled",
+           "record_step", "max_consecutive_skips"]
+
+
+class NonFiniteStepWarning(UserWarning):
+    """A training step produced non-finite loss/gradients and its
+    parameter update was skipped."""
+
+
+class GuardStats:
+    """Structured skip counter (process-wide; ``stats`` is the
+    singleton)."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.total_steps = 0
+        self.skipped_steps = 0
+        self.consecutive_skips = 0
+        self.last_skipped_step = None
+
+    def as_dict(self):
+        return {
+            "total_steps": self.total_steps,
+            "skipped_steps": self.skipped_steps,
+            "consecutive_skips": self.consecutive_skips,
+            "last_skipped_step": self.last_skipped_step,
+        }
+
+    def __repr__(self):
+        return "<GuardStats %s>" % self.as_dict()
+
+
+stats = GuardStats()
+
+
+def _truthy(val):
+    return str(val).strip().lower() not in ("", "0", "false", "off", "no")
+
+
+def guard_enabled(program=None):
+    """Is the finite step-guard on for this run?  Env wins; a program
+    can opt in via ``program._nan_guard = True``."""
+    env = os.environ.get("PADDLE_TPU_NAN_GUARD")
+    if env is not None:
+        return _truthy(env)
+    return bool(getattr(program, "_nan_guard", False))
+
+
+def max_consecutive_skips():
+    try:
+        return int(os.environ.get("PADDLE_TPU_NAN_GUARD_MAX_SKIPS", "25"))
+    except ValueError:
+        return 25
+
+
+def record_step(finite, step=None):
+    """Host-side bookkeeping for one guarded step.  Returns ``finite``;
+    raises ``RuntimeError`` once ``max_consecutive_skips`` consecutive
+    steps were non-finite (the run has diverged — backoff cannot fix
+    arithmetic)."""
+    finite = bool(finite)
+    stats.total_steps += 1
+    if finite:
+        stats.consecutive_skips = 0
+        return True
+    stats.skipped_steps += 1
+    stats.consecutive_skips += 1
+    stats.last_skipped_step = step
+    warnings.warn(
+        "non-finite loss/gradients at step %s — parameter update skipped "
+        "(%d/%d steps skipped so far)"
+        % (step, stats.skipped_steps, stats.total_steps),
+        NonFiniteStepWarning, stacklevel=3)
+    limit = max_consecutive_skips()
+    if limit > 0 and stats.consecutive_skips >= limit:
+        raise RuntimeError(
+            "finite step-guard skipped %d consecutive steps (limit %d, "
+            "env PADDLE_TPU_NAN_GUARD_MAX_SKIPS) — the run has diverged; "
+            "lower the learning rate or restore an earlier checkpoint"
+            % (stats.consecutive_skips, limit))
+    return False

@@ -1,0 +1,159 @@
+"""Retry/timeout with jittered exponential backoff.
+
+The transient-failure policy for every host-side edge the runtime
+crosses: checkpoint I/O, executor compilation, fleet bootstrap/barriers.
+Deterministic by construction — the jitter RNG is seeded — so a chaos
+replay sleeps the same schedule it slept the first time.
+
+Env knobs (defaults in parentheses):
+
+* ``PADDLE_TPU_RETRY_MAX_ATTEMPTS`` (3) — total attempts incl. the first
+* ``PADDLE_TPU_RETRY_BASE_DELAY_MS`` (50) — first backoff delay
+* ``PADDLE_TPU_RETRY_MAX_DELAY_MS`` (2000) — backoff ceiling
+* ``PADDLE_TPU_RETRY_JITTER`` (0.25) — +/- fraction of each delay
+* ``PADDLE_TPU_RETRY_SEED`` (0) — jitter RNG seed
+"""
+
+import os
+import random
+import threading
+import time
+import warnings
+
+from .faults import TransientFault
+
+__all__ = ["RetryPolicy", "RetryExhaustedError", "retry_call",
+           "with_retries", "run_with_timeout"]
+
+#: exception types retried by default — injected transients plus the
+#: OS-level failures checkpoint I/O actually produces.  Deliberately NOT
+#: Exception: a genuine bug (TypeError, ValueError, a jax trace error)
+#: must fail fast, not be retried into a 3x-slower identical failure.
+DEFAULT_RETRY_ON = (TransientFault, OSError, ConnectionError)
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class RetryExhaustedError(RuntimeError):
+    """All attempts failed; ``.last_error`` is the final exception."""
+
+    def __init__(self, message, last_error=None, attempts=0):
+        super().__init__(message)
+        self.last_error = last_error
+        self.attempts = attempts
+
+
+class RetryPolicy:
+    """max_attempts / base_delay / max_delay / multiplier / jitter /
+    retry_on, env-defaulted.  ``delays()`` yields the (deterministic)
+    backoff schedule between attempts."""
+
+    def __init__(self, max_attempts=None, base_delay=None, max_delay=None,
+                 multiplier=2.0, jitter=None, seed=None, retry_on=None):
+        self.max_attempts = int(
+            max_attempts if max_attempts is not None
+            else _env_float("PADDLE_TPU_RETRY_MAX_ATTEMPTS", 3))
+        self.base_delay = (
+            base_delay if base_delay is not None
+            else _env_float("PADDLE_TPU_RETRY_BASE_DELAY_MS", 50) / 1000.0)
+        self.max_delay = (
+            max_delay if max_delay is not None
+            else _env_float("PADDLE_TPU_RETRY_MAX_DELAY_MS", 2000) / 1000.0)
+        self.multiplier = float(multiplier)
+        self.jitter = (jitter if jitter is not None
+                       else _env_float("PADDLE_TPU_RETRY_JITTER", 0.25))
+        self.seed = int(seed if seed is not None
+                        else _env_float("PADDLE_TPU_RETRY_SEED", 0))
+        self.retry_on = tuple(retry_on or DEFAULT_RETRY_ON)
+
+    def delays(self):
+        """Backoff delay before attempt i+2, for i in range(attempts-1)."""
+        rng = random.Random(self.seed)
+        d = self.base_delay
+        for _ in range(max(self.max_attempts - 1, 0)):
+            j = 1.0 + self.jitter * rng.uniform(-1.0, 1.0)
+            yield max(min(d, self.max_delay) * j, 0.0)
+            d *= self.multiplier
+
+
+def retry_call(fn, *args, policy=None, site="", on_retry=None, **kwargs):
+    """Call ``fn(*args, **kwargs)``, retrying ``policy.retry_on``
+    failures with backoff.  Non-retryable exceptions propagate
+    immediately; exhausting attempts raises :class:`RetryExhaustedError`
+    chaining the last failure.  ``on_retry(attempt, exc, delay)`` is
+    notified before each sleep."""
+    policy = policy or RetryPolicy()
+    delays = policy.delays()
+    last = None
+    for attempt in range(1, max(policy.max_attempts, 1) + 1):
+        try:
+            return fn(*args, **kwargs)
+        except policy.retry_on as e:
+            last = e
+            try:
+                delay = next(delays)
+            except StopIteration:
+                break
+            warnings.warn(
+                "transient failure%s (attempt %d/%d): %s — retrying in "
+                "%.0f ms" % ((" at %s" % site) if site else "", attempt,
+                             policy.max_attempts, e, delay * 1000.0),
+                RuntimeWarning, stacklevel=2)
+            if on_retry is not None:
+                on_retry(attempt, e, delay)
+            time.sleep(delay)
+    raise RetryExhaustedError(
+        "%s failed after %d attempts: %s"
+        % (site or getattr(fn, "__name__", "call"),
+           policy.max_attempts, last),
+        last_error=last, attempts=policy.max_attempts) from last
+
+
+def with_retries(**policy_kwargs):
+    """Decorator form of :func:`retry_call`."""
+
+    def deco(fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            return retry_call(fn, *args,
+                              policy=RetryPolicy(**policy_kwargs),
+                              site=getattr(fn, "__name__", ""), **kwargs)
+
+        return wrapped
+
+    return deco
+
+
+def run_with_timeout(fn, timeout, what="operation", error_cls=None):
+    """Run ``fn()`` with a wall-clock deadline.  On timeout raises
+    ``error_cls`` (default :class:`TimeoutError`) — the worker thread is
+    abandoned (daemonized), which is the only portable option for a call
+    stuck inside a native collective; callers are expected to treat the
+    raise as fatal for this process's step."""
+    if timeout is None or timeout <= 0:
+        return fn()
+    result = {}
+
+    def _target():
+        try:
+            result["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 — reported to caller
+            result["error"] = e
+
+    t = threading.Thread(target=_target, daemon=True,
+                         name="paddle_tpu-timeout-%s" % what)
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        cls = error_cls or TimeoutError
+        raise cls("%s did not complete within %.1fs" % (what, timeout))
+    if "error" in result:
+        raise result["error"]
+    return result.get("value")

@@ -37,6 +37,10 @@ collective-ring             ERROR/W   collective op missing ring_id or
                                       matching c_comm_init (WARNING)
 unreferenced-op             INFO      op output never read / fetched —
                                       advisory twin of DCE
+resilience-finite-guard     INFO      training program fetches its loss
+                                      but no NaN/Inf step-guard is
+                                      enabled (PADDLE_TPU_NAN_GUARD /
+                                      program._nan_guard)
 ==========================  ========  ====================================
 """
 
@@ -468,3 +472,34 @@ def check_unreferenced_op(ctx):
                 block_idx=block.idx, op_idx=op_idx, op=op,
                 var_names=tuple(outs),
                 hint="dead_code_elimination_pass would remove this op")
+
+
+@register_check("resilience-finite-guard")
+def check_resilience_finite_guard(ctx):
+    """Training programs run without the NaN/Inf step-guard: one
+    non-finite gradient silently corrupts every parameter it touches,
+    and the donated-buffer executor cannot roll the step back after the
+    fact.  Advisory (INFO) — inference programs and guarded runs are
+    exempt; fires only when fetch targets are given (i.e. a run loop is
+    actually reading the loss)."""
+    if not ctx.targets:
+        return
+    is_training = any(
+        op.type.endswith("_grad") or op.attrs.get("op_role") == "optimize"
+        for _, _, op in ctx.graph.order)
+    if not is_training:
+        return
+    from ..resilience.guard import guard_enabled
+
+    if guard_enabled(ctx.program):
+        return
+    loss = getattr(ctx.program, "_guard_loss_name", None)
+    yield ctx.diag(
+        "resilience-finite-guard", Severity.INFO,
+        "training program fetches %s but no finite step-guard is "
+        "enabled — a NaN/Inf step would be applied to the parameters"
+        % (("loss %r" % loss) if loss else list(ctx.targets)),
+        block_idx=0,
+        var_names=(loss,) if loss else tuple(ctx.targets),
+        hint="set PADDLE_TPU_NAN_GUARD=1 (or program._nan_guard=True) so "
+             "non-finite steps are skipped, counted and warned about")

@@ -1,0 +1,314 @@
+"""Chaos harness: run a short training loop under an injected fault spec
+and exit nonzero unless the run RECOVERS.
+
+Usage::
+
+    python -m paddle_tpu.tools.chaos \
+        --steps 9 --spec "nan_grad@step=3;ckpt_write_fail@step=5;worker_kill@step=7"
+
+The driver supervises a training *worker* subprocess (this same module
+with ``--worker``) the way a production job controller supervises a
+trainer:
+
+* the worker trains a fixed deterministic model, pins the injector step
+  each iteration, saves an atomic versioned checkpoint every step, and
+  auto-resumes from the latest intact version on boot;
+* the driver restarts a killed/hung worker with jittered backoff (up to
+  ``--max-restarts``), bounding each incarnation with a wall-clock
+  timeout so an injected hang also surfaces;
+* after the final incarnation finishes, the driver replays the SAME
+  schedule fault-free in-process, *skipping* the steps the guarded
+  worker skipped, and demands the final parameter digest match
+  bit-for-bit.
+
+Exit status: 0 = recovered and matched; 1 = survived but diverged;
+2 = did not survive (restarts exhausted / no completion).
+
+This is the executable form of the ISSUE-2 acceptance scenario — CI runs
+it with the spec above; any spec drawn from the
+``PADDLE_TPU_FAULT_SPEC`` grammar works.
+"""
+
+import argparse
+import hashlib
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+def _force_cpu():
+    """Both the worker and the in-process oracle run on CPU: the drill
+    verifies recovery logic, and the bit-for-bit digest comparison needs
+    one platform on both sides (the env var alone can be ignored when an
+    image pins a TPU plugin via jax config)."""
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
+# deterministic tiny regression problem — the model must be
+# dropout-free so a skipped step is exactly "one batch not applied"
+_DATA_SEED = 1234
+_MODEL_SEED = 77
+_BATCH = 16
+_FEATS = 4
+_HIDDEN = 8
+_LR = 0.1
+
+
+def _build_model():
+    import paddle_tpu as fluid
+
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = _MODEL_SEED
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[_FEATS], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, size=_HIDDEN, act="relu")
+        p = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.reduce_mean(fluid.layers.square(p - y))
+        fluid.optimizer.Adam(learning_rate=_LR).minimize(loss)
+    return main, startup, loss
+
+
+def _batches(steps):
+    import numpy as np
+
+    rng = np.random.RandomState(_DATA_SEED)
+    out = []
+    for _ in range(steps):
+        xb = rng.randn(_BATCH, _FEATS).astype("float32")
+        yb = (xb.sum(axis=1, keepdims=True)
+              + 0.1 * rng.randn(_BATCH, 1)).astype("float32")
+        out.append((xb, yb))
+    return out
+
+
+def _param_digest(scope, program):
+    import numpy as np
+
+    h = hashlib.sha256()
+    for v in sorted(program.list_vars(), key=lambda v: v.name):
+        if not v.persistable:
+            continue
+        val = scope.get(v.name)
+        if val is None:
+            continue
+        h.update(v.name.encode())
+        h.update(np.ascontiguousarray(np.asarray(val)).tobytes())
+    return h.hexdigest()
+
+
+def _run_worker(args):
+    """One trainer incarnation: resume → train → checkpoint each step."""
+    import warnings
+
+    import numpy as np  # noqa: F401
+
+    _force_cpu()
+    import paddle_tpu as fluid
+    from paddle_tpu.resilience import checkpoint, faults, guard
+
+    main, startup, loss = _build_model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    start_step = 0
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        info = checkpoint.try_load_latest_checkpoint(
+            exe, args.ckpt_dir, main_program=main)
+    if info is not None:
+        start_step = int(info.state.get("next_step", info.step + 1))
+        print("CHAOS_RESUME step=%d from=%s"
+              % (start_step, os.path.basename(info.path)), flush=True)
+
+    for k, (xb, yb) in enumerate(_batches(args.steps)):
+        if k < start_step:
+            continue
+        faults.set_step(k)
+        skipped_before = guard.stats.skipped_steps
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            (lv,) = exe.run(main, feed={"x": xb, "y": yb},
+                            fetch_list=[loss])
+        skipped = int(guard.stats.skipped_steps > skipped_before)
+        print("CHAOS_STEP %d loss=%.8f skipped=%d"
+              % (k, float(np.asarray(lv).reshape(())), skipped),
+              flush=True)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            checkpoint.save_checkpoint(
+                exe, args.ckpt_dir, main_program=main, step=k,
+                state={"next_step": k + 1}, retain=3)
+    digest = _param_digest(fluid.global_scope(), main)
+    print("CHAOS_FINAL params_sha=%s skipped_total=%d"
+          % (digest, guard.stats.skipped_steps), flush=True)
+    print("CHAOS_OK", flush=True)
+    return 0
+
+
+def _oracle_digest(steps, skip_steps):
+    """Fault-free replay in-process, not applying the skipped steps —
+    the trajectory the recovered run must land on exactly."""
+    import warnings
+
+    _force_cpu()
+    import paddle_tpu as fluid
+    from paddle_tpu.executor import Scope, scope_guard
+    from paddle_tpu.resilience import faults
+
+    faults.set_fault_spec("")
+    main, startup, loss = _build_model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        exe.run(startup)
+        for k, (xb, yb) in enumerate(_batches(steps)):
+            if k in skip_steps:
+                continue
+            faults.set_step(k)
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[loss])
+        return _param_digest(fluid.global_scope(), main)
+
+
+def _parse_worker_output(text, losses, skipped):
+    final = None
+    resumed = []
+    for line in text.splitlines():
+        if line.startswith("CHAOS_STEP "):
+            parts = line.split()
+            k = int(parts[1])
+            losses[k] = float(parts[2].split("=")[1])
+            if int(parts[3].split("=")[1]):
+                skipped.add(k)
+            else:
+                # a later incarnation re-ran this step cleanly (e.g. the
+                # skip happened just before a crash and the resumed
+                # worker applied it): the newest verdict wins
+                skipped.discard(k)
+        elif line.startswith("CHAOS_FINAL "):
+            final = line.split()[1].split("=")[1]
+        elif line.startswith("CHAOS_RESUME "):
+            resumed.append(int(line.split()[1].split("=")[1]))
+    return final, resumed
+
+
+def _run_driver(args):
+    from paddle_tpu.resilience import retry as _retry
+
+    ckpt_dir = args.ckpt_dir or tempfile.mkdtemp(prefix="paddle_tpu_chaos_")
+    from paddle_tpu.resilience import checkpoint as _ckpt
+
+    existing = _ckpt.list_checkpoints(ckpt_dir)
+    if existing and existing[0][0] >= args.steps - 1:
+        print("chaos: ERROR — --ckpt-dir already holds a completed run "
+              "(newest version: step %d); the worker would resume past "
+              "every step.  Use a fresh --ckpt-dir." % existing[0][0],
+              flush=True)
+        return 2
+    losses, skipped, final_sha = {}, set(), None
+    all_resumes = []
+    backoff = _retry.RetryPolicy(max_attempts=args.max_restarts + 1,
+                                 base_delay=0.2, max_delay=2.0, seed=7)
+    delays = backoff.delays()
+    print("chaos: spec=%r steps=%d ckpt=%s"
+          % (args.spec, args.steps, ckpt_dir), flush=True)
+
+    for incarnation in range(args.max_restarts + 1):
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TPU_FAULT_SPEC": args.spec,
+            # firing budgets span restarts: a worker_kill is ONE
+            # preemption, not one per incarnation
+            "PADDLE_TPU_FAULT_STATE_FILE":
+                os.path.join(ckpt_dir, "fault_state.json"),
+            "PADDLE_TPU_NAN_GUARD": "1",
+            "JAX_PLATFORMS": env.get("JAX_PLATFORMS", "cpu"),
+        })
+        cmd = [sys.executable, "-m", "paddle_tpu.tools.chaos", "--worker",
+               "--steps", str(args.steps), "--ckpt-dir", ckpt_dir]
+        with tempfile.NamedTemporaryFile("w+", suffix=".log",
+                                         delete=False) as logf:
+            t0 = time.time()
+            proc = subprocess.Popen(cmd, env=env, stdout=logf,
+                                    stderr=subprocess.STDOUT)
+            try:
+                rc = proc.wait(timeout=args.worker_timeout)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+                rc = "timeout"
+            logf.seek(0)
+            out = logf.read()
+        final_sha, resumes = _parse_worker_output(out, losses, skipped)
+        all_resumes.extend(resumes)
+        print("chaos: incarnation %d rc=%s (%.1fs) steps_done=%d"
+              % (incarnation, rc, time.time() - t0, len(losses)),
+              flush=True)
+        if rc == 0 and final_sha is not None:
+            break
+        if incarnation == args.max_restarts:
+            print("chaos: FAIL — worker never completed within %d "
+                  "restarts; last output:\n%s"
+                  % (args.max_restarts, out[-2000:]), flush=True)
+            return 2
+        try:
+            delay = next(delays)
+        except StopIteration:
+            delay = 1.0
+        print("chaos: restarting worker (auto-resume) in %.2fs" % delay,
+              flush=True)
+        time.sleep(delay)
+
+    missing = [k for k in range(args.steps) if k not in losses]
+    if missing:
+        print("chaos: FAIL — steps %s never ran" % missing, flush=True)
+        return 2
+    print("chaos: worker recovered; skipped steps=%s resumes=%s"
+          % (sorted(skipped), all_resumes), flush=True)
+
+    oracle = _oracle_digest(args.steps, skipped)
+    if oracle != final_sha:
+        print("chaos: FAIL — final params %s != fault-free oracle %s "
+              "(recovery diverged)" % (final_sha[:16], oracle[:16]),
+              flush=True)
+        return 1
+    print("chaos: PASS — final params match the fault-free trajectory "
+          "(sha %s)" % final_sha[:16], flush=True)
+    return 0
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.tools.chaos",
+        description="Fault-injection chaos run: train, inject, recover, "
+                    "verify against the fault-free trajectory.")
+    parser.add_argument("--spec", default=os.environ.get(
+        "PADDLE_TPU_FAULT_SPEC",
+        "nan_grad@step=3;ckpt_write_fail@step=5;worker_kill@step=7"),
+        help="fault spec (see resilience/faults.py grammar)")
+    parser.add_argument("--steps", type=int, default=9)
+    parser.add_argument("--ckpt-dir", default=None)
+    parser.add_argument("--max-restarts", type=int, default=3)
+    parser.add_argument("--worker-timeout", type=float, default=300.0,
+                        help="seconds per worker incarnation (bounds "
+                             "injected hangs)")
+    parser.add_argument("--worker", action="store_true",
+                        help=argparse.SUPPRESS)
+    args = parser.parse_args(argv)
+    if args.worker:
+        return _run_worker(args)
+    return _run_driver(args)
+
+
+if __name__ == "__main__":
+    import numpy as np  # noqa: F401  (worker fast-fail if numpy absent)
+
+    sys.exit(main())

@@ -38,6 +38,22 @@ def main():
     assert jax.process_count() == 2
     assert jax.device_count() == 8, jax.devices()
 
+    # opt-in liveness watchdog (PADDLE_TPU_HEARTBEAT_DIR): a dead peer
+    # turns into a prompt visible exit instead of a gloo hang — see
+    # resilience/watchdog.py and dist_resilient_worker.py
+    writer = monitor = None
+    hb_dir = os.environ.get("PADDLE_TPU_HEARTBEAT_DIR")
+    if hb_dir:
+        from paddle_tpu.resilience import watchdog
+
+        writer = watchdog.HeartbeatWriter(hb_dir, rank,
+                                          interval=0.2).start()
+        monitor = watchdog.HeartbeatMonitor(
+            hb_dir, [r for r in range(fleet.worker_num()) if r != rank],
+            timeout=float(os.environ.get(
+                "PADDLE_TPU_HEARTBEAT_TIMEOUT_S", "10")),
+            interval=0.2).start()
+
     main_prog, startup, loss, feeds = build_model(
         optimizer_factory=lambda opt: fleet.distributed_optimizer(opt))
 
@@ -56,6 +72,10 @@ def main():
     print("CLUSTER_LOSSES rank=%d %s"
           % (rank, ",".join("%.8f" % v for v in losses)))
     print("CLUSTER_OK rank=%d" % rank)
+    if monitor is not None:
+        monitor.stop()
+    if writer is not None:
+        writer.stop()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,101 @@
+"""Resilient cluster trainer for the kill-and-resume test
+(``dist_cluster_worker.py`` style, plus the full resilience runtime):
+heartbeat writer + peer watchdog, per-step atomic checkpoints (rank 0),
+auto-resume from the latest intact version, and fault injection from
+``PADDLE_TPU_FAULT_SPEC`` — so an injected ``worker_kill`` surfaces to
+the parent within a bounded time and the relaunched cluster continues
+the SAME loss trajectory."""
+
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu.incubate.fleet.base import role_maker  # noqa: E402
+from paddle_tpu.incubate.fleet.collective import fleet  # noqa: E402
+from paddle_tpu.resilience import checkpoint, faults, watchdog  # noqa: E402
+from tests.dist_model import build_model  # noqa: E402
+
+GLOBAL_BATCH = 16
+
+
+def make_batches(n):
+    rng = np.random.RandomState(42)
+    for _ in range(n):
+        xb = rng.randn(GLOBAL_BATCH, 8).astype("float32")
+        yb = (xb.sum(axis=1, keepdims=True) * 0.3
+              + rng.randn(GLOBAL_BATCH, 1) * 0.01).astype("float32")
+        yield xb, yb
+
+
+def main():
+    n_steps = int(os.environ.get("RESIL_STEPS", "6"))
+    ckpt_dir = os.environ["PADDLE_TPU_CKPT_DIR"]
+
+    fleet.init(role_maker.PaddleCloudRoleMaker())
+    rank = fleet.worker_index()
+    nworkers = fleet.worker_num()
+
+    # heartbeat + peer watchdog: if a peer dies mid-collective this
+    # process would hang in gloo forever — the monitor's default on_lost
+    # hard-exits with LOST_EXIT_CODE instead, within ~timeout seconds
+    writer = monitor = None
+    hb_dir = os.environ.get("PADDLE_TPU_HEARTBEAT_DIR")
+    if hb_dir:
+        writer = watchdog.HeartbeatWriter(hb_dir, rank,
+                                          interval=0.2).start()
+        hb_timeout = float(os.environ.get(
+            "PADDLE_TPU_HEARTBEAT_TIMEOUT_S", "5"))
+        monitor = watchdog.HeartbeatMonitor(
+            hb_dir, [r for r in range(nworkers) if r != rank],
+            timeout=hb_timeout, interval=0.2).start()
+
+    main_prog, startup, loss, feeds = build_model(
+        optimizer_factory=lambda opt: fleet.distributed_optimizer(opt))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+
+    start_step = 0
+    info = checkpoint.try_load_latest_checkpoint(exe, ckpt_dir,
+                                                 main_program=main_prog)
+    if info is not None:
+        start_step = int(info.state.get("next_step", info.step + 1))
+        print("RESIL_RESUME rank=%d step=%d from=%s"
+              % (rank, start_step, os.path.basename(info.path)),
+              flush=True)
+
+    cp = fluid.CompiledProgram(main_prog).with_data_parallel(
+        loss_name=loss.name)
+    per = GLOBAL_BATCH // nworkers
+    for k, (xb, yb) in enumerate(make_batches(n_steps)):
+        if k < start_step:
+            continue
+        faults.set_step(k)
+        half = slice(rank * per, (rank + 1) * per)
+        (lv,) = exe.run(cp, feed={feeds[0]: xb[half], feeds[1]: yb[half]},
+                        fetch_list=[loss])
+        print("RESIL_STEP rank=%d step=%d loss=%.8f"
+              % (rank, k, float(np.asarray(lv).reshape(()))), flush=True)
+        # atomic versioned save every step (rank 0 writes; the version
+        # rename means a kill mid-save can never leave a loadable torn
+        # checkpoint for the resumed cluster)
+        checkpoint.save_checkpoint(exe, ckpt_dir, main_program=main_prog,
+                                   step=k, state={"next_step": k + 1},
+                                   retain=3)
+    print("RESIL_OK rank=%d" % rank, flush=True)
+    if monitor is not None:
+        monitor.stop()
+    if writer is not None:
+        writer.stop()
+
+
+if __name__ == "__main__":
+    main()

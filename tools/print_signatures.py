@@ -83,6 +83,12 @@ MODULES = [
     "paddle_tpu.trainer_desc",
     "paddle_tpu.analysis",
     "paddle_tpu.static_analysis",
+    "paddle_tpu.resilience",
+    "paddle_tpu.resilience.faults",
+    "paddle_tpu.resilience.retry",
+    "paddle_tpu.resilience.guard",
+    "paddle_tpu.resilience.watchdog",
+    "paddle_tpu.resilience.checkpoint",
     "paddle_tpu.device_worker",
     "paddle_tpu.evaluator",
 ]
