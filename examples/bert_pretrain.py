@@ -14,6 +14,22 @@ import _common  # noqa: E402 - repo-root path + bounded backend probe
 import numpy as np
 
 
+def build_program(tiny=True, seq_len=128, recompute=False):
+    """The example's program set, importable by tooling (the analyzer
+    CI sweep runs ``Program.analyze`` over it).  Returns
+    ``(main, startup, feeds, loss)``."""
+    from paddle_tpu.models import bert
+
+    cfg = bert.BERT_TINY if tiny else bert.BERT_BASE
+    if recompute:
+        import copy
+
+        cfg = copy.copy(cfg)
+        cfg.recompute = True
+    return bert.build_pretrain(cfg, seq_len=seq_len, lr=1e-4, amp=False,
+                               train=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--cpu", action="store_true")

@@ -12,18 +12,11 @@ import _common  # noqa: E402 - repo-root path + bounded backend probe
 import numpy as np
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--cpu", action="store_true",
-                    help="force the CPU backend")
-    ap.add_argument("--epochs", type=int, default=1)
-    ap.add_argument("--batch", type=int, default=64)
-    args = ap.parse_args()
-
-    backend = _common.pick_backend(force_cpu=args.cpu)
-
+def build_program():
+    """The example's program set, importable by tooling (the analyzer
+    CI sweep runs ``Program.analyze`` over it).  Returns
+    ``(main, startup, test_prog, loss, acc)``."""
     import paddle_tpu as fluid
-    from paddle_tpu import datasets
 
     main_prog, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_prog, startup):
@@ -37,6 +30,23 @@ def main():
         acc = fluid.layers.accuracy(input=pred, label=label)
         test_prog = main_prog.clone(for_test=True)
         fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    return main_prog, startup, test_prog, loss, acc
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend")
+    ap.add_argument("--epochs", type=int, default=1)
+    ap.add_argument("--batch", type=int, default=64)
+    args = ap.parse_args()
+
+    backend = _common.pick_backend(force_cpu=args.cpu)
+
+    import paddle_tpu as fluid
+    from paddle_tpu import datasets
+
+    main_prog, startup, test_prog, loss, acc = build_program()
 
     exe = fluid.Executor(fluid.TPUPlace())
     exe.run(startup)

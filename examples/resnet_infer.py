@@ -23,6 +23,22 @@ import _common  # noqa: E402 - repo-root path + bounded backend probe
 import numpy as np
 
 
+def build_program():
+    """The example's eval program, importable by tooling (the analyzer
+    CI sweep runs ``Program.analyze`` over it).  Returns
+    ``(main, startup, prob)``."""
+    import paddle_tpu as fluid
+    from paddle_tpu.models.resnet import resnet_cifar10
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[3, 32, 32],
+                                dtype="float32")
+        logits = resnet_cifar10(img, 10, 20, is_test=True)
+        prob = fluid.layers.softmax(logits)
+    return main, startup, prob
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--cpu", action="store_true")
@@ -35,16 +51,10 @@ def main():
 
     import paddle_tpu as fluid
     from paddle_tpu.executor import Scope, scope_guard
-    from paddle_tpu.models.resnet import resnet_cifar10
 
     # 1. build + "train" (randomly initialized here; load_persistables
     #    would restore a real checkpoint) and export the eval graph
-    main, startup = fluid.Program(), fluid.Program()
-    with fluid.program_guard(main, startup):
-        img = fluid.layers.data("img", shape=[3, 32, 32],
-                                dtype="float32")
-        logits = resnet_cifar10(img, 10, 20, is_test=True)
-        prob = fluid.layers.softmax(logits)
+    main, startup, prob = build_program()
     export_dir = tempfile.mkdtemp(prefix="resnet_export_")
     exe = fluid.Executor(fluid.TPUPlace())
     with scope_guard(Scope()):

@@ -18,18 +18,11 @@ import _common  # noqa: E402 - repo-root path + bounded backend probe
 import numpy as np
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--cpu", action="store_true")
-    ap.add_argument("--mode", choices=("qat", "prune"), default="qat")
-    ap.add_argument("--batches", type=int, default=120)
-    args = ap.parse_args()
-    _common.pick_backend(force_cpu=args.cpu)
-
+def build_program():
+    """The example's program set, importable by tooling (the analyzer
+    CI sweep runs ``Program.analyze`` over it).  Returns
+    ``(main, startup, loss, acc, prob)``."""
     import paddle_tpu as fluid
-    from paddle_tpu import datasets
-    from paddle_tpu.contrib.slim.core import Compressor
-    from paddle_tpu.executor import Scope, scope_guard
 
     main_prog, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_prog, startup):
@@ -43,6 +36,23 @@ def main():
         acc = fluid.layers.accuracy(input=prob, label=label)
         loss = fluid.layers.mean(
             fluid.layers.softmax_with_cross_entropy(logits, label))
+    return main_prog, startup, loss, acc, prob
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--mode", choices=("qat", "prune"), default="qat")
+    ap.add_argument("--batches", type=int, default=120)
+    args = ap.parse_args()
+    _common.pick_backend(force_cpu=args.cpu)
+
+    import paddle_tpu as fluid
+    from paddle_tpu import datasets
+    from paddle_tpu.contrib.slim.core import Compressor
+    from paddle_tpu.executor import Scope, scope_guard
+
+    main_prog, startup, loss, acc, prob = build_program()
 
     def reader():
         r = fluid.batch(datasets.mnist.train(), 64)

@@ -106,7 +106,7 @@ from .transpiler import DistributeTranspiler, DistributeTranspilerConfig
 from .data_feed_desc import DataFeedDesc
 from .dataset import DatasetFactory
 from . import static_analysis
-from .static_analysis import verify_program
+from .static_analysis import analyze_program, verify_program
 from . import resilience
 
 # `import paddle_tpu as fluid` is the intended spelling for users of the
@@ -178,6 +178,7 @@ __all__ = [
     "cuda_pinned_places",
     "static_analysis",
     "verify_program",
+    "analyze_program",
     "resilience",
 ]
 

@@ -603,6 +603,22 @@ class Program:
         return verify_program(self, targets=targets, checks=checks,
                               exclude=exclude)
 
+    def analyze(self, targets=None, workers=None, nranks=None,
+                batch_size=None, hbm_budget=None):
+        """Whole-program distributed static analysis: abstract
+        interpretation (shape/dtype/sharding per var), the static
+        FLOP/byte/ICI cost model with a liveness-based peak-memory
+        estimate, this worker's per-ring collective schedule, and —
+        when ``workers`` supplies the N transpiled per-worker programs
+        — the cross-worker collective schedule deadlock-freedom proof.
+        Returns a :class:`paddle_tpu.static_analysis.AnalysisReport`;
+        raises nothing (gate on ``report.errors``)."""
+        from .static_analysis import analyze_program
+
+        return analyze_program(self, targets=targets, workers=workers,
+                               nranks=nranks, batch_size=batch_size,
+                               hbm_budget=hbm_budget)
+
     def __repr__(self):
         return "Program(blocks=%d, version=%d)" % (len(self.blocks), self._version)
 

@@ -100,3 +100,85 @@ def c_gen_nccl_id(ctx, attrs):
 @register_op("c_comm_init", inputs=["X"], outputs=[], no_grad=True)
 def c_comm_init(ctx, attrs, X):
     return {}
+
+
+# ---------------------------------------------------------------------------
+# reshard / p2p collectives (the parallel program emitters:
+# parallel/{moe,ulysses}.py emit all_to_all, parallel/ring_attention.py
+# emits ppermute hops, parallel/pipeline.transpile_pipeline emits
+# send_v2/recv_v2 stage boundaries).  In the IR these ops carry GLOBAL
+# shapes (GSPMD view): the static analyzer reads their ring_id/peer
+# attrs and payload metadata; under plain jit they are identity (the
+# partitioner owns resharding) and under shard_map they issue the real
+# lax collective.
+# ---------------------------------------------------------------------------
+
+def _identity_infer(op, block):
+    """Out shape/dtype = X shape/dtype (global-view reshard ops)."""
+    src = block._find_var_recursive(op.inputs["X"][0])
+    for n in op.outputs.get("Out", []):
+        v = block._find_var_recursive(n)
+        if v is not None and src is not None:
+            v.shape = src.shape
+            v.dtype = src.dtype
+
+
+@register_op("all_to_all", inputs=["X"], outputs=["Out"], no_grad=True,
+             infer_shape=_identity_infer)
+def all_to_all(ctx, attrs, X):
+    ax = _axis(ctx)
+    if ax is None:
+        return X  # GSPMD: the partitioner re-lays-out the global value
+    return jax.lax.all_to_all(
+        X, ax, split_axis=int(attrs.get("split_axis", 0)),
+        concat_axis=int(attrs.get("concat_axis", 0)), tiled=True)
+
+
+@register_op("ppermute", inputs=["X"], outputs=["Out"], no_grad=True,
+             infer_shape=_identity_infer)
+def ppermute(ctx, attrs, X):
+    ax = _axis(ctx)
+    if ax is None:
+        return X
+    perm = [tuple(p) for p in attrs.get("perm", [])]
+    if not perm:
+        n = jax.lax.psum(1, ax)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+    return jax.lax.ppermute(X, ax, perm)
+
+
+def _send_infer(op, block):
+    pass  # no outputs
+
+
+@register_op("send_v2", inputs=["X"], outputs=[], no_grad=True,
+             infer_shape=_send_infer)
+def send_v2(ctx, attrs, X):
+    # structural p2p marker: the analyzable pipeline stage boundary.
+    # The runnable TPU pipeline schedule is parallel.gpipe (one SPMD
+    # computation, ppermute hops); a per-stage program containing this
+    # op is a deployment/analysis artifact like the reference's
+    # pserver programs, not an executor fast path.
+    return {}
+
+
+def _recv_infer(op, block):
+    for n in op.outputs.get("Out", []):
+        v = block._find_var_recursive(n)
+        if v is not None:
+            if op.attrs.get("out_shape") is not None:
+                v.shape = tuple(op.attrs["out_shape"])
+            if op.attrs.get("dtype") is not None:
+                from ..core import convert_np_dtype_to_dtype_
+
+                v.dtype = convert_np_dtype_to_dtype_(op.attrs["dtype"])
+
+
+@register_op("recv_v2", inputs=[], outputs=["Out"], no_grad=True,
+             infer_shape=_recv_infer)
+def recv_v2(ctx, attrs, X=None):
+    shape = tuple(max(int(d), 1) for d in attrs.get("out_shape", (1,)))
+    dtype = attrs.get("dtype", "float32")
+    if str(dtype) == "bfloat16":
+        dtype = jnp.bfloat16
+    return jnp.zeros(shape, dtype)  # structural twin of send_v2

@@ -192,22 +192,27 @@ class ParallelExecutor:
         self._runner._cache.clear()
 
 
-from .ring_attention import ring_attention, ring_attention_local  # noqa: E402,F401
+from .ring_attention import (ring_attention, ring_attention_local,  # noqa: E402,F401
+                             ring_rotate)
 
-__all__ += ["ring_attention", "ring_attention_local"]
+__all__ += ["ring_attention", "ring_attention_local", "ring_rotate"]
 
-from .pipeline import gpipe, gpipe_stage_params  # noqa: E402,F401
+from .pipeline import gpipe, gpipe_stage_params, transpile_pipeline  # noqa: E402,F401
 
-__all__ += ["gpipe", "gpipe_stage_params"]
+__all__ += ["gpipe", "gpipe_stage_params", "transpile_pipeline"]
 
-from .ulysses import ulysses_attention, ulysses_attention_local  # noqa: E402,F401
+from .ulysses import (ulysses_attention, ulysses_attention_local,  # noqa: E402,F401
+                      ulysses_to_heads, ulysses_to_seq)
 
-__all__ += ["ulysses_attention", "ulysses_attention_local"]
+__all__ += ["ulysses_attention", "ulysses_attention_local",
+            "ulysses_to_heads", "ulysses_to_seq"]
 
 from .dgc import dgc_exchange, dgc_momentum_step  # noqa: E402,F401
 
 __all__ += ["dgc_exchange", "dgc_momentum_step"]
 
-from .moe import moe_ffn, moe_ffn_local, init_moe_params  # noqa: E402,F401
+from .moe import (moe_ffn, moe_ffn_local, init_moe_params,  # noqa: E402,F401
+                  moe_dispatch, moe_combine)
 
-__all__ += ["moe_ffn", "moe_ffn_local", "init_moe_params"]
+__all__ += ["moe_ffn", "moe_ffn_local", "init_moe_params",
+            "moe_dispatch", "moe_combine"]

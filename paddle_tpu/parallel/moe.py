@@ -26,7 +26,47 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["moe_ffn", "moe_ffn_local", "init_moe_params"]
+__all__ = ["moe_ffn", "moe_ffn_local", "init_moe_params",
+           "moe_dispatch", "moe_combine", "MOE_RING_ID"]
+
+# ring-id convention (see parallel/pipeline.py / README "Analyzer")
+MOE_RING_ID = 2
+
+
+def _append_all_to_all(x, ring_id, tag, split_axis, concat_axis):
+    """Append an ``all_to_all`` IR op re-sharding ``x`` (global view:
+    shape-preserving; under shard_map it is the real lax collective).
+    The ring_id stamp is what the ``collective-ring`` lint check and the
+    cross-worker schedule prover key on."""
+    from .. import unique_name
+
+    block = x.block
+    out = block.create_var(
+        name=unique_name.generate(x.name + "." + tag),
+        shape=x.shape, dtype=x.dtype)
+    block.append_op(
+        type="all_to_all", inputs={"X": [x]}, outputs={"Out": [out]},
+        attrs={"ring_id": int(ring_id), "split_axis": int(split_axis),
+               "concat_axis": int(concat_axis), "comm_tag": tag})
+    return out
+
+
+def moe_dispatch(x, ring_id=MOE_RING_ID, split_axis=0, concat_axis=0):
+    """Program-IR twin of the dispatch ``all_to_all`` in
+    :func:`moe_ffn_local`: tokens move to the device holding their
+    expert.  Emits one ring-stamped ``all_to_all`` op so expert-parallel
+    programs carry their communication schedule in the IR the static
+    analyzer walks."""
+    return _append_all_to_all(x, ring_id, "moe_dispatch",
+                              split_axis, concat_axis)
+
+
+def moe_combine(x, ring_id=MOE_RING_ID, split_axis=0, concat_axis=0):
+    """Program-IR twin of the combine ``all_to_all``: expert outputs
+    return to their source device.  Must mirror :func:`moe_dispatch` on
+    every worker, in the same order — the schedule prover checks it."""
+    return _append_all_to_all(x, ring_id, "moe_combine",
+                              split_axis, concat_axis)
 
 
 def init_moe_params(rng, d_model, d_ff, n_experts, dtype=jnp.float32):

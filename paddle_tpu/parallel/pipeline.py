@@ -32,7 +32,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["gpipe", "gpipe_stage_params"]
+__all__ = ["gpipe", "gpipe_stage_params", "transpile_pipeline",
+           "PIPELINE_RING_ID"]
+
+# ring-id convention (README "Analyzer"): 0 = data-parallel gradient
+# exchange (transpiler/collective.py), 1 = pipeline p2p, 2 = MoE
+# all_to_all, 3 = Ulysses all_to_all, 4 = ring-attention ppermute
+PIPELINE_RING_ID = 1
 
 
 def gpipe_stage_params(params_per_stage):
@@ -139,3 +145,150 @@ def gpipe(stage_fn, stage_params, x, mesh, axis_name, num_microbatches,
         in_specs=(spec_params, in_x), out_specs=in_x,
         check_vma=False,
     )(stage_params, x)
+
+
+# ---------------------------------------------------------------------------
+# program-level pipeline transpiler (the reference PipelineOptimizer's
+# section-splitting role): N per-stage worker programs with explicit
+# send_v2/recv_v2 stage boundaries in the IR
+# ---------------------------------------------------------------------------
+
+def _op_stage(op, idx, fwd_stage_by_op_id, param_stage, n_stages):
+    """Stage of a non-forward op: a grad op runs where its forward twin
+    ran (it reads that stage's activations and feeds that stage's param
+    updates); an optimizer op runs where its param's forward lives; the
+    loss-grad seed (backward fill_constant with no forward twin) runs on
+    the last stage."""
+    fwd_id = op.attrs.get("__fwd_op_id__")
+    if fwd_id is not None and fwd_id in fwd_stage_by_op_id:
+        return fwd_stage_by_op_id[fwd_id]
+    stages = [param_stage[n] for n in op.input_arg_names
+              if n in param_stage]
+    if stages:
+        return max(stages)
+    return n_stages - 1
+
+
+def transpile_pipeline(program, cut_vars, startup_program=None,
+                       ring_id=PIPELINE_RING_ID):
+    """Split ``program`` into per-stage worker programs joined by
+    explicit p2p ops — the reference ``PipelineOptimizer`` section split
+    (``optimizer.py:2664``), as a Program→[Program] rewrite.
+
+    ``cut_vars`` (k Variables/names in forward order) induce k+1 stages:
+    forward ops up to the producer of cut i belong to stage i; a grad op
+    joins its forward twin's stage (via ``__fwd_op_id__``); optimizer
+    ops join their parameter's stage.  Every value produced on one stage
+    and read on another — forward activations AND backward activation
+    grads — becomes a ``send_v2`` right after its producer and a
+    ``recv_v2`` right before its first consumer, stamped with
+    ``ring_id`` and the peer stage, so the cross-worker analyzer
+    (``static_analysis.distributed``) can pair the channels and prove
+    the schedule deadlock-free.
+
+    Returns ``(worker_programs, worker_startups)``; worker ``w`` is
+    stage ``w``.  These per-stage programs are the analyzable/deployable
+    artifact (like the reference's pserver programs) — the runnable TPU
+    pipeline schedule remains :func:`gpipe` (one SPMD computation).
+    """
+    from ..framework import Operator, Program
+    from ..transpiler.collective import ensure_comm_ring
+
+    block = program.global_block()
+    cuts = [getattr(c, "name", c) for c in cut_vars]
+    missing = [c for c in cuts if block._find_var_recursive(c) is None]
+    if missing:
+        raise ValueError("cut vars %s not found in the program"
+                         % sorted(missing))
+    n_stages = len(cuts) + 1
+
+    # ---- stage assignment ----
+    fwd_stage_by_op_id = {}
+    param_stage = {}
+    stage_of = [0] * len(block.ops)
+    cur = 0
+    remaining = list(cuts)
+    for idx, op in enumerate(block.ops):
+        if op.attrs.get("op_role") in ("backward", "optimize",
+                                       "lr_sched") \
+                or op.type.endswith("_grad"):
+            continue
+        stage_of[idx] = cur
+        fwd_stage_by_op_id[op.attrs.get("__op_id__")] = cur
+        for n in op.input_arg_names:
+            param_stage.setdefault(n, cur)
+        if remaining and remaining[0] in op.output_arg_names:
+            remaining.pop(0)
+            cur += 1
+    if remaining:
+        raise ValueError(
+            "cut vars %s are never produced by a forward op" % remaining)
+    for idx, op in enumerate(block.ops):
+        if op.attrs.get("op_role") in ("backward", "optimize",
+                                       "lr_sched") \
+                or op.type.endswith("_grad"):
+            stage_of[idx] = _op_stage(op, idx, fwd_stage_by_op_id,
+                                      param_stage, n_stages)
+
+    # ---- cross-stage data edges ----
+    def _is_local(name):
+        v = block._find_var_recursive(name)
+        return v is None or v.persistable or v.is_data
+
+    producer_stage = {}
+    producer_idx = {}
+    for idx, op in enumerate(block.ops):
+        for n in op.output_arg_names:
+            producer_stage[n] = stage_of[idx]
+            producer_idx[n] = idx
+    edges = {}  # (name, src, dst) -> first consumer op index
+    for idx, op in enumerate(block.ops):
+        t = stage_of[idx]
+        for n in op.input_arg_names:
+            s = producer_stage.get(n)
+            if s is None or s == t or _is_local(n):
+                continue
+            edges.setdefault((n, s, t), idx)
+
+    # ---- emit per-stage programs ----
+    sends_after = {}  # producer op idx -> [(name, dst)] in dst order
+    recvs_before = {}  # first consumer op idx -> [(name, src)]
+    for (n, s, t), first_use in sorted(
+            edges.items(), key=lambda kv: (kv[1], kv[0][2], kv[0][0])):
+        sends_after.setdefault(producer_idx[n], []).append((n, t))
+        recvs_before.setdefault(first_use, []).append((n, s))
+
+    workers, startups = [], []
+    for w in range(n_stages):
+        clone = program.clone()
+        nb = clone.global_block()
+        src_ops = list(nb.ops)
+        new_ops = []
+        for idx, op in enumerate(src_ops):
+            if stage_of[idx] == w:
+                for n, s in recvs_before.get(idx, ()):
+                    v = nb._find_var_recursive(n)
+                    new_ops.append(Operator(
+                        nb, "recv_v2", {}, {"Out": [n]},
+                        {"peer": s, "ring_id": ring_id,
+                         "out_shape": list(v.shape)
+                         if v is not None and v.shape else None,
+                         "dtype": str(v.dtype)
+                         if v is not None else "float32",
+                         "op_role": op.attrs.get("op_role")}))
+                new_ops.append(op)
+            for n, t in sends_after.get(idx, ()):
+                if stage_of[idx] == w:
+                    new_ops.append(Operator(
+                        nb, "send_v2", {"X": [n]}, {},
+                        {"peer": t, "ring_id": ring_id,
+                         "op_role": op.attrs.get("op_role")}))
+        nb.ops = new_ops
+        clone._pipeline_stage = w
+        clone._bump_version()
+        workers.append(clone)
+        su = (startup_program.clone() if startup_program is not None
+              else Program())
+        ensure_comm_ring(su, ring_id, rank=w, nranks=n_stages)
+        startups.append(su)
+    return workers, startups

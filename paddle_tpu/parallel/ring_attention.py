@@ -37,7 +37,34 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["ring_attention", "ring_attention_local"]
+__all__ = ["ring_attention", "ring_attention_local", "ring_rotate",
+           "RING_ATTENTION_RING_ID"]
+
+# ring-id convention (see parallel/pipeline.py / README "Analyzer")
+RING_ATTENTION_RING_ID = 4
+
+
+def ring_rotate(x, ring_id=RING_ATTENTION_RING_ID, steps=1):
+    """Program-IR twin of one (or ``steps``) K/V rotation hop(s) in
+    :func:`ring_attention_local`: a ``ppermute`` one-hop shift around
+    the ring.  Emits ring-stamped ``ppermute`` ops so ring-attention
+    programs carry their communication schedule in the IR the static
+    analyzer walks (every participant must issue the same hop sequence
+    — the schedule prover checks it)."""
+    from .. import unique_name
+
+    block = x.block
+    cur = x
+    for _ in range(int(steps)):
+        out = block.create_var(
+            name=unique_name.generate(x.name + ".ring_rotate"),
+            shape=cur.shape, dtype=cur.dtype)
+        block.append_op(
+            type="ppermute", inputs={"X": [cur]},
+            outputs={"Out": [out]},
+            attrs={"ring_id": int(ring_id), "comm_tag": "ring_rotate"})
+        cur = out
+    return cur
 
 
 def _merge(acc, m, l, o_c, m_c, l_c):

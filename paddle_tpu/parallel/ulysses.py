@@ -33,7 +33,32 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["ulysses_attention", "ulysses_attention_local"]
+__all__ = ["ulysses_attention", "ulysses_attention_local",
+           "ulysses_to_heads", "ulysses_to_seq", "ULYSSES_RING_ID"]
+
+# ring-id convention (see parallel/pipeline.py / README "Analyzer")
+ULYSSES_RING_ID = 3
+
+
+def ulysses_to_heads(x, ring_id=ULYSSES_RING_ID):
+    """Program-IR twin of the seq→head reshard ``all_to_all`` in
+    :func:`ulysses_attention_local` ([B, H, T, D] global view;
+    dims 1↔2 trade sharding).  Emits one ring-stamped ``all_to_all`` op
+    so sequence-parallel programs carry their communication schedule in
+    the IR the static analyzer walks."""
+    from .moe import _append_all_to_all
+
+    return _append_all_to_all(x, ring_id, "ulysses_to_heads",
+                              split_axis=1, concat_axis=2)
+
+
+def ulysses_to_seq(x, ring_id=ULYSSES_RING_ID):
+    """Inverse reshard (head→seq); must mirror :func:`ulysses_to_heads`
+    on every worker in the same order."""
+    from .moe import _append_all_to_all
+
+    return _append_all_to_all(x, ring_id, "ulysses_to_seq",
+                              split_axis=2, concat_axis=1)
 
 
 def ulysses_attention_local(q, k, v, axis_name, axis_size, bias=None,

@@ -19,6 +19,15 @@ Surfaces:
 * ``python -m paddle_tpu.tools.lint_program <model_dir>`` — lint a saved
   inference model; exit 1 on ERROR findings
 * ``Executor.run(..., verify=True)`` — debug hook
+
+ISSUE 3 grows the substrate into a whole-program distributed static
+analyzer: an abstract interpreter over the IR (:mod:`.interp` — shape /
+dtype / persistability / sharding lattice), a static cost model
+(:mod:`.cost` — FLOPs, bytes, ICI bytes, liveness-based peak memory
+against an HBM budget), and a cross-worker collective schedule
+extractor + deadlock-freedom proof (:mod:`.distributed`), surfaced as
+``Program.analyze()`` (:mod:`.analyze`), four analyzer-backed lint
+checks, and ``python -m paddle_tpu.tools.analyze_program``.
 """
 
 from .diagnostics import Diagnostic, Severity, format_diagnostics
@@ -31,6 +40,14 @@ from .verifier import (
     set_pass_verification,
     verify_program,
 )
+from .interp import (AbstractVal, InterpResult, Sharding,
+                     interpret_program, register_transfer)
+from .cost import (CostReport, OpCost, collective_ici_bytes,
+                   estimate_cost, hbm_budget, register_flops)
+from .distributed import (CollectiveEvent, check_schedule_consistency,
+                          extract_collective_schedule,
+                          prove_deadlock_free)
+from .analyze import AnalysisReport, analyze_program
 
 __all__ = [
     "Diagnostic",
@@ -48,4 +65,21 @@ __all__ = [
     "pass_verification_enabled",
     "set_pass_verification",
     "verify_program",
+    "AbstractVal",
+    "InterpResult",
+    "Sharding",
+    "interpret_program",
+    "register_transfer",
+    "CostReport",
+    "OpCost",
+    "collective_ici_bytes",
+    "estimate_cost",
+    "hbm_budget",
+    "register_flops",
+    "CollectiveEvent",
+    "check_schedule_consistency",
+    "extract_collective_schedule",
+    "prove_deadlock_free",
+    "AnalysisReport",
+    "analyze_program",
 ]

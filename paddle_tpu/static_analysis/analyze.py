@@ -1,0 +1,169 @@
+"""``Program.analyze()`` — the whole-program distributed static
+analyzer driver.
+
+One call composes the three analyses this package provides:
+
+* the **abstract interpretation** (:mod:`.interp`) — shape / dtype /
+  persistability / sharding per var;
+* the **cost model** (:mod:`.cost`) — FLOPs, bytes, ICI bytes, and the
+  liveness-based peak-memory estimate against the HBM budget;
+* the **collective schedule** (:mod:`.distributed`) — this worker's
+  per-ring schedule, and, when the N per-worker programs are supplied,
+  the cross-worker deadlock-freedom proof;
+
+plus the lint battery (including the analyzer-backed checks
+``peak-memory-over-budget``, ``collective-schedule-divergence``,
+``degenerate-sharding`` and ``oversized-replicated-persistable``)
+— all folded into one structured :class:`AnalysisReport`.
+"""
+
+from .cost import estimate_cost
+from .diagnostics import Severity, format_diagnostics
+from .distributed import (check_schedule_consistency,
+                          extract_collective_schedule)
+from .interp import interpret_program
+
+__all__ = ["AnalysisReport", "analyze_program"]
+
+
+class AnalysisReport:
+    """Everything the static analyzer can prove about a program.
+
+    Fields
+    ------
+    interp:            :class:`~.interp.InterpResult`
+    cost:              :class:`~.cost.CostReport`
+    schedule:          {ring_id: [CollectiveEvent]} for THIS program
+    worker_schedules:  per-worker schedules when ``workers`` was given
+    diagnostics:       lint findings (most severe first)
+    """
+
+    def __init__(self, program, interp, cost, schedule,
+                 worker_schedules, diagnostics):
+        self.program = program
+        self.interp = interp
+        self.cost = cost
+        self.schedule = schedule
+        self.worker_schedules = worker_schedules
+        self.diagnostics = list(diagnostics)
+
+    @property
+    def errors(self):
+        return [d for d in self.diagnostics
+                if d.severity >= Severity.ERROR]
+
+    @property
+    def ok(self):
+        return not self.errors
+
+    @property
+    def schedule_consistent(self):
+        """True when the cross-worker proof ran and found no divergence
+        (None when no worker set was supplied)."""
+        if self.worker_schedules is None:
+            return None
+        return not any(d.check == "collective-schedule-divergence"
+                       for d in self.errors)
+
+    def to_dict(self):
+        return {
+            "ok": self.ok,
+            "cost": self.cost.to_dict(),
+            "schedule": {
+                str(r): [e.to_dict() for e in evs]
+                for r, evs in self.schedule.items()},
+            "worker_schedules": None if self.worker_schedules is None
+            else [
+                {str(r): [e.to_dict() for e in evs]
+                 for r, evs in s.items()}
+                for s in self.worker_schedules],
+            "schedule_consistent": self.schedule_consistent,
+            "sharding": {
+                n: repr(v.sharding)
+                for n, v in sorted(self.interp.sharded_vars().items())},
+            "diagnostics": [d.to_dict() for d in self.diagnostics],
+        }
+
+    def format(self, top_ops=12):
+        """Human report: cost/memory table, schedules, diagnostics."""
+        lines = [self.cost.format_table(top=top_ops)]
+        if self.schedule:
+            lines.append("collective schedule:")
+            for ring, evs in sorted(self.schedule.items(),
+                                    key=lambda kv: repr(kv[0])):
+                lines.append("  ring %r (%d op(s)):" % (ring, len(evs)))
+                for e in evs:
+                    lines.append(
+                        "    block %d op %3d %-16s %s x%s%s"
+                        % (e.block_idx, e.op_idx, e.kind, e.dtype,
+                           e.numel,
+                           " peer=%s" % e.peer
+                           if e.peer is not None else ""))
+        if self.worker_schedules is not None:
+            lines.append(
+                "cross-worker schedule (%d workers): %s"
+                % (len(self.worker_schedules),
+                   "consistent (deadlock-free)"
+                   if self.schedule_consistent else "DIVERGENT"))
+        if self.diagnostics:
+            lines.append(format_diagnostics(
+                self.diagnostics, header="diagnostics:"))
+        else:
+            lines.append("diagnostics: none")
+        return "\n".join(lines)
+
+    def __repr__(self):
+        return "AnalysisReport(ok=%s, flops=%d, peak=%dB, %d diag(s))" % (
+            self.ok, self.cost.total_flops,
+            self.cost.peak_memory_bytes, len(self.diagnostics))
+
+
+def analyze_program(program, targets=None, workers=None, nranks=None,
+                    batch_size=None, hbm_budget=None, checks=None,
+                    exclude=()):
+    """Run the full static analyzer over ``program``.
+
+    Parameters
+    ----------
+    program:    the (transpiled) main program of this worker
+    targets:    fetch targets (kept live for peak memory; enables the
+                fetch-related lint checks)
+    workers:    optional list of ALL per-worker main programs (this one
+                included) — enables the cross-worker collective schedule
+                proof; ``program`` need not be in the list, worker
+                indices follow list order
+    nranks:     worker count for the sharding lattice / ICI model
+                (default: len(workers) if given, else
+                ``program._num_trainers``, else 1)
+    batch_size: what ``-1`` dims resolve to (default
+                ``PADDLE_TPU_ANALYZE_BATCH`` or 1)
+    hbm_budget: peak-memory budget in bytes (default
+                ``program._hbm_budget`` / ``PADDLE_TPU_HBM_BUDGET``)
+
+    Returns an :class:`AnalysisReport`; raises nothing — gating on
+    ``report.errors`` is the caller's choice.
+    """
+    from .verifier import verify_program
+
+    if nranks is None and workers:
+        nranks = len(workers)
+    interp = interpret_program(program, nranks=nranks,
+                               batch_size=batch_size)
+    cost = estimate_cost(program, interp=interp, targets=targets or (),
+                         budget=hbm_budget)
+    schedule = extract_collective_schedule(program, interp=interp)
+
+    worker_schedules = None
+    if workers:
+        worker_schedules = [
+            extract_collective_schedule(p, worker=w, nranks=nranks,
+                                        batch_size=batch_size)
+            for w, p in enumerate(workers)
+        ]
+
+    diags = verify_program(program, targets=targets, checks=checks,
+                           exclude=exclude, workers=workers,
+                           _analysis=(interp, cost),
+                           _worker_schedules=worker_schedules)
+    return AnalysisReport(program, interp, cost, schedule,
+                          worker_schedules, diags)

@@ -41,6 +41,21 @@ resilience-finite-guard     INFO      training program fetches its loss
                                       but no NaN/Inf step-guard is
                                       enabled (PADDLE_TPU_NAN_GUARD /
                                       program._nan_guard)
+peak-memory-over-budget     ERROR     liveness peak-memory estimate
+                                      exceeds the configured HBM budget
+                                      (PADDLE_TPU_HBM_BUDGET /
+                                      program._hbm_budget)
+collective-schedule-        ERROR     cross-worker collective schedules
+divergence                            diverge (kind/dtype/numel/order,
+                                      or mispaired p2p) — runs when the
+                                      per-worker programs are supplied
+degenerate-sharding         WARNING   var marked sharded over parts the
+                                      tensor dim cannot fill (or fill
+                                      evenly) — silently degenerate
+                                      distribution
+oversized-replicated-       WARNING   replicated persistable larger
+persistable                           than the replication budget on a
+                                      multi-worker program — shard it
 ==========================  ========  ====================================
 """
 
@@ -77,12 +92,41 @@ def all_checks():
 
 class VerifyContext:
     """What a check sees: the program, the def-use graph, the (optional)
-    fetch targets, and a Diagnostic factory that fills in coordinates."""
+    fetch targets, the (optional) per-worker program list for the
+    cross-worker checks, and a Diagnostic factory that fills in
+    coordinates.  ``interp``/``cost`` are computed lazily so the cheap
+    structural checks never pay for the analyzer."""
 
-    def __init__(self, program, graph, targets=None):
+    def __init__(self, program, graph, targets=None, workers=None,
+                 analysis=None, worker_schedules=None):
         self.program = program
         self.graph = graph
         self.targets = tuple(targets or ())
+        self.workers = list(workers) if workers else None
+        # precomputed per-worker schedules (Program.analyze already
+        # extracted them) so the divergence check doesn't re-interpret
+        # every worker program
+        self.worker_schedules = worker_schedules
+        self._interp, self._cost = analysis or (None, None)
+
+    @property
+    def interp(self):
+        if self._interp is None:
+            from .interp import interpret_program
+
+            self._interp = interpret_program(
+                self.program,
+                nranks=len(self.workers) if self.workers else None)
+        return self._interp
+
+    @property
+    def cost(self):
+        if self._cost is None:
+            from .cost import estimate_cost
+
+            self._cost = estimate_cost(
+                self.program, interp=self.interp, targets=self.targets)
+        return self._cost
 
     def var(self, name, near_block=None):
         """Recursive var lookup starting at ``near_block`` (a block idx)."""
@@ -377,19 +421,29 @@ def check_sub_block_index(ctx):
 # c_sync_*_stream ops are ring-less by design and match none of these
 _COLLECTIVE_OP_PREFIXES = ("c_allreduce", "c_reduce", "c_broadcast",
                            "c_allgather", "c_reducescatter", "c_scatter")
+# collectives emitted by the parallel program emitters (moe/ulysses
+# all_to_all resharding, ring-attention/pipeline ppermute hops) — no
+# ``c_`` prefix but the same ring_id contract
+_RINGED_OP_TYPES = ("all_to_all", "ppermute")
 
 
 @register_check("collective-ring")
 def check_collective_ring(ctx):
     """Transpiled programs: every collective must carry an integer
-    ``ring_id``; bootstrap pairs (``c_gen_nccl_id`` → ``c_comm_init``)
-    must agree per ring, and p2p send/recv ops must name an integer
-    ``peer`` (reference keeps rings consistent in C++; here a mismatch
-    would silently place collectives on different meshes).  Note: a
-    single rank's program legitimately has asymmetric send/recv peers
-    (pipeline stages), so pairing is checked per-op, not globally."""
+    ``ring_id`` — the transpiler-emitted ``c_*`` families AND the
+    collectives the parallel emitters insert (``all_to_all`` from
+    parallel/{moe,ulysses}.py, ``ppermute`` from
+    parallel/ring_attention.py); bootstrap pairs (``c_gen_nccl_id`` →
+    ``c_comm_init``) must agree per ring, every ring a collective uses
+    should have a bootstrap pair when any bootstrap exists, and p2p
+    send/recv ops must name an integer ``peer`` (reference keeps rings
+    consistent in C++; here a mismatch would silently place collectives
+    on different meshes).  Note: a single rank's program legitimately
+    has asymmetric send/recv peers (pipeline stages), so pairing is
+    checked per-op, not globally."""
     gen_rings = {}
     init_rings = set()
+    used_rings = {}
     for block_idx, op_idx, op in ctx.graph.order:
         t = op.type
         if t == "c_gen_nccl_id":
@@ -404,7 +458,9 @@ def check_collective_ring(ctx):
                     % (t, op.attrs.get("peer")),
                     block_idx=block_idx, op_idx=op_idx, op=op,
                     hint="p2p ops must name their partner rank")
-        elif t.startswith(_COLLECTIVE_OP_PREFIXES):
+            used_rings.setdefault(op.attrs.get("ring_id"),
+                                  (block_idx, op_idx, op))
+        elif t.startswith(_COLLECTIVE_OP_PREFIXES) or t in _RINGED_OP_TYPES:
             ring = op.attrs.get("ring_id")
             if ring is None or not isinstance(ring, int):
                 yield ctx.diag(
@@ -412,8 +468,10 @@ def check_collective_ring(ctx):
                     "collective op has no integer ring_id attr (got %r)"
                     % (ring,),
                     block_idx=block_idx, op_idx=op_idx, op=op,
-                    hint="the transpiler must stamp ring_id on every "
-                         "collective it inserts")
+                    hint="the transpiler/parallel emitter must stamp "
+                         "ring_id on every collective it inserts")
+            else:
+                used_rings.setdefault(ring, (block_idx, op_idx, op))
     # key=repr: a malformed program may mix int and str ring ids — the
     # check must report them, not die sorting them
     for ring, (block_idx, op_idx, op) in sorted(gen_rings.items(),
@@ -426,6 +484,22 @@ def check_collective_ring(ctx):
                 block_idx=block_idx, op_idx=op_idx, op=op,
                 hint="append c_comm_init with the same ring_id in the "
                      "startup program")
+    # a program that carries its own bootstrap (startup, or merged
+    # startup+main) must bootstrap every ring its collectives use; a
+    # main-only program (gen_rings empty) is exempt — its bootstrap
+    # lives in the separate startup program
+    if gen_rings:
+        for ring, (block_idx, op_idx, op) in sorted(
+                used_rings.items(), key=lambda kv: repr(kv[0])):
+            if ring not in gen_rings and ring is not None:
+                yield ctx.diag(
+                    "collective-ring", Severity.WARNING,
+                    "collective uses ring %r but the program only "
+                    "bootstraps ring(s) %s"
+                    % (ring, sorted(gen_rings, key=repr)),
+                    block_idx=block_idx, op_idx=op_idx, op=op,
+                    hint="transpiler.collective.ensure_comm_ring "
+                         "appends the c_gen_nccl_id/c_comm_init pair")
 
 
 # ---------------------------------------------------------------------------
@@ -503,3 +577,142 @@ def check_resilience_finite_guard(ctx):
         var_names=(loss,) if loss else tuple(ctx.targets),
         hint="set PADDLE_TPU_NAN_GUARD=1 (or program._nan_guard=True) so "
              "non-finite steps are skipped, counted and warned about")
+
+
+# ---------------------------------------------------------------------------
+# analyzer-backed checks (abstract interpretation + cost model)
+# ---------------------------------------------------------------------------
+
+@register_check("peak-memory-over-budget")
+def check_peak_memory_over_budget(ctx):
+    """The liveness-based peak-memory estimate must fit the configured
+    HBM budget (``PADDLE_TPU_HBM_BUDGET`` / ``program._hbm_budget``, or
+    an explicit ``analyze(hbm_budget=...)`` override riding on the
+    precomputed cost report).  Skipped when no budget is configured —
+    there is nothing to gate against, and guessing a device would make
+    CI flaky."""
+    from .cost import hbm_budget
+
+    # cheap pre-probe: only build the cost report when some budget
+    # source exists (the lazy ctx.cost resolves the same sources)
+    if hbm_budget(ctx.program) is None and ctx._cost is None:
+        return
+    cost = ctx.cost
+    budget = cost.hbm_budget
+    if budget is None:
+        return
+    if cost.peak_memory_bytes > budget:
+        yield ctx.diag(
+            "peak-memory-over-budget", Severity.ERROR,
+            "estimated peak memory %d bytes exceeds the HBM budget %d "
+            "(persistables %d, peak live activations %d; batch=%d)"
+            % (cost.peak_memory_bytes, budget, cost.persistent_bytes,
+               cost.peak_memory_bytes - cost.persistent_bytes,
+               cost.batch_size),
+            block_idx=0,
+            hint="shard the largest persistables, enable recompute, or "
+                 "cut the assumed batch (PADDLE_TPU_ANALYZE_BATCH)")
+
+
+@register_check("collective-schedule-divergence")
+def check_collective_schedule_divergence(ctx):
+    """Cross-worker proof: the N per-worker programs must issue the same
+    ordered collectives per ring and pairwise-matched p2p — the static
+    deadlock-freedom obligation (see static_analysis/distributed.py).
+    Runs only when the worker program set is supplied
+    (``verify_program(..., workers=[...])`` / ``Program.analyze``)."""
+    from .distributed import check_schedule_consistency
+
+    if ctx.worker_schedules is not None:
+        yield from check_schedule_consistency(ctx.worker_schedules)
+        return
+    if not ctx.workers or len(ctx.workers) <= 1:
+        return
+    from .distributed import prove_deadlock_free
+
+    _, diags = prove_deadlock_free(ctx.workers)
+    yield from diags
+
+
+@register_check("degenerate-sharding")
+def check_degenerate_sharding(ctx):
+    """A var marked sharded into more parts than its sharded dim holds
+    (or into parts that don't divide it) silently degenerates: some
+    workers hold empty/ragged shards while the program still pays every
+    collective.  Runs on multi-worker programs only — the cheap
+    trainer-count probe comes first so single-worker lint/verify_pass
+    sweeps never build the interpreter."""
+    nranks = (len(ctx.workers) if ctx.workers
+              else int(getattr(ctx.program, "_num_trainers", 1) or 1))
+    if nranks <= 1:
+        return
+    interp = ctx.interp
+    for name, v in sorted(interp.sharded_vars().items()):
+        s = v.sharding
+        if v.shape is None or s.dim is None or s.dim >= len(v.shape):
+            continue
+        # a dynamic (-1) recorded dim is runtime-sized — the interp
+        # resolved it to the assumed batch, which must not be judged
+        recorded = ctx.var(name)
+        if recorded is not None and recorded.shape is not None \
+                and s.dim < len(recorded.shape):
+            rd = recorded.shape[s.dim]
+            if rd is None or int(rd) < 0:
+                continue
+        dim_size = int(v.shape[s.dim])
+        if dim_size < s.parts:
+            yield ctx.diag(
+                "degenerate-sharding", Severity.WARNING,
+                "%r is sharded %d-way over axis %r but its dim %d has "
+                "only %d element(s) — some workers hold empty shards"
+                % (name, s.parts, s.axis, s.dim, dim_size),
+                var_names=(name,),
+                hint="shard a larger dim, or lower the parallelism "
+                     "degree for this tensor")
+        elif dim_size % s.parts:
+            yield ctx.diag(
+                "degenerate-sharding", Severity.WARNING,
+                "%r dim %d (%d elements) is not divisible by the %d-way "
+                "sharding over axis %r — ragged shards"
+                % (name, s.dim, dim_size, s.parts, s.axis),
+                var_names=(name,),
+                hint="pad the dim or choose a degree that divides it")
+
+
+@register_check("oversized-replicated-persistable")
+def check_oversized_replicated_persistable(ctx):
+    """On a multi-worker program, a replicated persistable bigger than
+    the replication budget (``PADDLE_TPU_REPLICATED_BUDGET`` bytes,
+    default: HBM budget / 4 when configured, else 1 GiB) multiplies its
+    HBM cost by the worker count for no throughput — shard it (ZeRO /
+    tensor parallel / host table)."""
+    import os
+
+    from .cost import dtype_bytes, hbm_budget, parse_size
+
+    nranks = (len(ctx.workers) if ctx.workers
+              else int(getattr(ctx.program, "_num_trainers", 1) or 1))
+    if nranks <= 1:
+        return
+    interp = ctx.interp
+    val = os.environ.get("PADDLE_TPU_REPLICATED_BUDGET", "").strip()
+    if val:
+        threshold = parse_size(val)
+    else:
+        budget = hbm_budget(ctx.program)
+        threshold = budget // 4 if budget else 1 << 30
+    for name, v in sorted(interp.replicated_persistables().items()):
+        n = v.numel
+        if n is None:
+            continue
+        size = n * dtype_bytes(v.dtype)
+        if size > threshold:
+            yield ctx.diag(
+                "oversized-replicated-persistable", Severity.WARNING,
+                "persistable %r (%d bytes) is replicated on all %d "
+                "workers (budget %d bytes per replicated var)"
+                % (name, size, nranks, threshold),
+                var_names=(name,),
+                hint="shard it: BuildStrategy.shard_optimizer_state "
+                     "(ZeRO-1), shard_spec/tensor parallel, or a host "
+                     "table for embeddings")

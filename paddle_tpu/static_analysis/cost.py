@@ -1,0 +1,406 @@
+"""Static per-op and whole-program cost model over the abstract
+interpretation (:mod:`.interp`).
+
+In the spirit of XLA's ahead-of-time fusion/memory analysis
+(arXiv:2301.13062) and static parallelism-placement cost models
+(arXiv:2110.10548): every op gets a :class:`OpCost` — FLOPs, bytes read,
+bytes written, and ICI bytes for collectives — and the program gets
+totals plus a **liveness-based peak-memory estimate** checked against a
+configurable HBM budget.
+
+Conventions (also in README "Static analysis / lint > Analyzer"):
+
+* FLOPs — one multiply-add = 2 FLOPs.  ``mul``/``matmul``/``fc`` are
+  ``2·M·K·N`` (+bias adds for fc); ``conv2d`` is
+  ``2 · out_numel · Cin·kh·kw``; a generic ``*_grad`` op costs 2x its
+  forward; everything else defaults to one FLOP per output element.
+* Bytes — dtype-sized reads of every input + writes of every output,
+  using LOCAL (per-worker shard) element counts.
+* ICI bytes — ring-algorithm transfer volume per worker for an
+  ``n``-participant collective of payload ``B`` local bytes:
+  allreduce ``2·B·(n-1)/n``; broadcast / allgather / reducescatter /
+  all_to_all ``B·(n-1)/n``; p2p ``send_v2``/``recv_v2`` and ``ppermute``
+  move exactly ``B``.
+* Peak memory — persistables are always resident; a non-persistable
+  value is live from its producing op to its last use (fetch targets to
+  program end).  ``-1`` dims resolve via ``PADDLE_TPU_ANALYZE_BATCH``.
+* HBM budget — ``PADDLE_TPU_HBM_BUDGET`` (bytes; ``K``/``M``/``G``
+  suffixes) or ``program._hbm_budget``; the ``peak-memory-over-budget``
+  lint check gates on it.
+"""
+
+import json
+import os
+
+from .interp import interpret_program
+
+__all__ = [
+    "OpCost", "CostReport", "estimate_cost", "register_flops",
+    "collective_ici_bytes", "dtype_bytes", "parse_size", "hbm_budget",
+    "COLLECTIVE_OP_TYPES", "P2P_OP_TYPES",
+]
+
+_DTYPE_BYTES = {
+    "float64": 8, "int64": 8, "uint64": 8,
+    "float32": 4, "int32": 4, "uint32": 4,
+    "float16": 2, "bfloat16": 2, "int16": 2, "uint16": 2,
+    "int8": 1, "uint8": 1, "bool": 1,
+}
+
+
+def dtype_bytes(dtype):
+    return _DTYPE_BYTES.get(str(dtype), 4)
+
+
+def parse_size(text):
+    """'2G' / '512M' / '16384' -> bytes."""
+    s = str(text).strip()
+    mult = 1
+    if s and s[-1].upper() in "KMGT":
+        mult = 1024 ** ("KMGT".index(s[-1].upper()) + 1)
+        s = s[:-1]
+    return int(float(s) * mult)
+
+
+def hbm_budget(program=None):
+    """The configured HBM budget in bytes, or None (check disabled):
+    ``program._hbm_budget`` wins over ``PADDLE_TPU_HBM_BUDGET``."""
+    if program is not None:
+        b = getattr(program, "_hbm_budget", None)
+        if b:
+            return parse_size(b)
+    val = os.environ.get("PADDLE_TPU_HBM_BUDGET", "").strip()
+    return parse_size(val) if val else None
+
+
+# collective op types (the ICI-bytes and schedule-extraction roster);
+# symmetric collectives must appear in the same order on every
+# participant, p2p ops pair per directed (src, dst) channel
+COLLECTIVE_OP_TYPES = frozenset((
+    "c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
+    "c_allreduce_prod", "allreduce", "c_reduce_sum", "c_broadcast",
+    "broadcast", "c_allgather", "c_reducescatter", "c_scatter",
+    "all_to_all", "ppermute",
+))
+P2P_OP_TYPES = frozenset(("send_v2", "recv_v2"))
+
+
+def collective_ici_bytes(op_type, payload_bytes, nranks):
+    """Ring-algorithm ICI transfer volume per worker (see module doc)."""
+    n = max(int(nranks), 1)
+    b = payload_bytes
+    if n <= 1:
+        return 0
+    if op_type.startswith("c_allreduce") or op_type == "allreduce":
+        return int(2 * b * (n - 1) / n)
+    if op_type in P2P_OP_TYPES or op_type == "ppermute":
+        return int(b)
+    if op_type in COLLECTIVE_OP_TYPES:
+        return int(b * (n - 1) / n)
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# FLOP rules
+# ---------------------------------------------------------------------------
+
+_FLOP_RULES = {}
+
+
+def register_flops(op_type):
+    """Register ``fn(op, ins, outs) -> flops`` (ins/outs: [AbstractVal])
+    as the FLOP rule for ``op_type``; the ``register_check`` idiom."""
+
+    def deco(fn):
+        _FLOP_RULES[op_type] = fn
+        return fn
+
+    return deco
+
+
+def _out_numel(outs):
+    return sum(v.local_numel or 0 for v in outs)
+
+
+def _matmul_flops(op, ins, outs):
+    # 2·M·K·N from the two operand shapes (last-two-dims contraction)
+    if len(ins) < 2 or ins[0].shape is None or ins[1].shape is None:
+        return 2 * _out_numel(outs)
+    a, b = ins[0].shape, ins[1].shape
+    if not a or not b:
+        return 2 * _out_numel(outs)
+    k = a[-1]
+    m = 1
+    for d in a[:-1]:
+        m *= max(int(d), 1)
+    n = 1
+    for d in b[1:]:
+        n *= max(int(d), 1)
+    return 2 * m * max(int(k), 1) * n
+
+
+register_flops("mul")(_matmul_flops)
+register_flops("matmul")(_matmul_flops)
+
+
+@register_flops("fc")
+def _fc_flops(op, ins, outs):
+    return _matmul_flops(op, ins, outs) + _out_numel(outs)
+
+
+@register_flops("conv2d")
+def _conv2d_flops(op, ins, outs):
+    if len(ins) < 2 or ins[1].shape is None or len(ins[1].shape) != 4:
+        return 2 * _out_numel(outs)
+    cout, cin, kh, kw = (max(int(d), 1) for d in ins[1].shape)
+    return 2 * _out_numel(outs) * cin * kh * kw
+
+
+@register_flops("softmax")
+def _softmax_flops(op, ins, outs):
+    return 5 * _out_numel(outs)  # max, sub, exp, sum, div
+
+
+for _t in ("mean", "reduce_mean", "reduce_sum", "reduce_max",
+           "reduce_min", "reduce_prod", "sum"):
+    register_flops(_t)(
+        lambda op, ins, outs: sum(v.local_numel or 0 for v in ins))
+
+
+def _op_flops(op, ins, outs):
+    rule = _FLOP_RULES.get(op.type)
+    if rule is not None:
+        return int(rule(op, ins, outs))
+    if op.type.endswith("_grad"):
+        base = _FLOP_RULES.get(op.type[:-len("_grad")])
+        if base is not None:
+            return 2 * int(base(op, ins, outs))
+    if op.type in ("feed", "fetch", "fill_constant", "assign",
+                   "c_gen_nccl_id", "c_comm_init", "send_v2", "recv_v2"):
+        return 0
+    return _out_numel(outs)
+
+
+# ---------------------------------------------------------------------------
+# per-op cost + whole-program report
+# ---------------------------------------------------------------------------
+
+class OpCost:
+    """Static cost of one op (all byte counts are per-worker/local)."""
+
+    __slots__ = ("record", "flops", "bytes_read", "bytes_written",
+                 "ici_bytes", "ring_id")
+
+    def __init__(self, record, flops, bytes_read, bytes_written,
+                 ici_bytes, ring_id=None):
+        self.record = record
+        self.flops = int(flops)
+        self.bytes_read = int(bytes_read)
+        self.bytes_written = int(bytes_written)
+        self.ici_bytes = int(ici_bytes)
+        self.ring_id = ring_id
+
+    def to_dict(self):
+        r = self.record
+        return {
+            "block_idx": r.block_idx, "op_idx": r.op_idx,
+            "op_type": r.op.type, "flops": self.flops,
+            "bytes_read": self.bytes_read,
+            "bytes_written": self.bytes_written,
+            "ici_bytes": self.ici_bytes, "ring_id": self.ring_id,
+        }
+
+
+class CostReport:
+    """Whole-program totals + the per-op breakdown behind them."""
+
+    def __init__(self, program, op_costs, peak_memory_bytes,
+                 persistent_bytes, nranks, batch_size, budget=None):
+        self.program = program
+        self.op_costs = op_costs
+        self.peak_memory_bytes = int(peak_memory_bytes)
+        self.persistent_bytes = int(persistent_bytes)
+        self.nranks = nranks
+        self.batch_size = batch_size
+        self.hbm_budget = budget
+
+    @property
+    def total_flops(self):
+        return sum(c.flops for c in self.op_costs)
+
+    @property
+    def total_bytes_read(self):
+        return sum(c.bytes_read for c in self.op_costs)
+
+    @property
+    def total_bytes_written(self):
+        return sum(c.bytes_written for c in self.op_costs)
+
+    @property
+    def total_ici_bytes(self):
+        return sum(c.ici_bytes for c in self.op_costs)
+
+    def ici_bytes_per_ring(self):
+        per = {}
+        for c in self.op_costs:
+            if c.ici_bytes:
+                per[c.ring_id] = per.get(c.ring_id, 0) + c.ici_bytes
+        return per
+
+    @property
+    def over_budget(self):
+        return (self.hbm_budget is not None
+                and self.peak_memory_bytes > self.hbm_budget)
+
+    def to_dict(self):
+        return {
+            "total_flops": self.total_flops,
+            "total_bytes_read": self.total_bytes_read,
+            "total_bytes_written": self.total_bytes_written,
+            "total_ici_bytes": self.total_ici_bytes,
+            "ici_bytes_per_ring": {
+                str(k): v for k, v in self.ici_bytes_per_ring().items()},
+            "peak_memory_bytes": self.peak_memory_bytes,
+            "persistent_bytes": self.persistent_bytes,
+            "hbm_budget": self.hbm_budget,
+            "nranks": self.nranks,
+            "batch_size": self.batch_size,
+            "per_op": [c.to_dict() for c in self.op_costs],
+        }
+
+    def bench_json(self):
+        """BENCH-style metric lines (one JSON object per line) so perf
+        PRs can cite the static baseline next to measured numbers."""
+        unit_suffix = " (static, batch=%d, nranks=%d)" % (
+            self.batch_size, self.nranks)
+        rows = [
+            ("static_program_flops", self.total_flops, "FLOPs"),
+            ("static_program_bytes_read", self.total_bytes_read, "bytes"),
+            ("static_program_bytes_written", self.total_bytes_written,
+             "bytes"),
+            ("static_program_ici_bytes", self.total_ici_bytes, "bytes"),
+            ("static_program_peak_memory", self.peak_memory_bytes,
+             "bytes"),
+        ]
+        return "\n".join(
+            json.dumps({"metric": m, "value": v, "unit": u + unit_suffix})
+            for m, v, u in rows)
+
+    def format_table(self, top=12):
+        """Human cost/memory table: totals then the top-N ops by FLOPs."""
+        lines = [
+            "cost model (batch=%d, nranks=%d):"
+            % (self.batch_size, self.nranks),
+            "  FLOPs           %16d" % self.total_flops,
+            "  bytes read      %16d" % self.total_bytes_read,
+            "  bytes written   %16d" % self.total_bytes_written,
+            "  ICI bytes       %16d  %s" % (
+                self.total_ici_bytes,
+                " ".join("ring %s: %d" % (r, b) for r, b in
+                         sorted(self.ici_bytes_per_ring().items(),
+                                key=lambda kv: repr(kv[0])))),
+            "  peak memory     %16d  (persistables %d%s)" % (
+                self.peak_memory_bytes, self.persistent_bytes,
+                ", budget %d %s" % (
+                    self.hbm_budget,
+                    "EXCEEDED" if self.over_budget else "ok")
+                if self.hbm_budget is not None else ""),
+        ]
+        ranked = sorted(self.op_costs, key=lambda c: -c.flops)[:top]
+        if ranked and ranked[0].flops:
+            lines.append("  top ops by FLOPs:")
+            for c in ranked:
+                if not c.flops:
+                    break
+                r = c.record
+                lines.append(
+                    "    block %d op %3d %-22s %12d FLOPs %10d B"
+                    % (r.block_idx, r.op_idx, r.op.type, c.flops,
+                       c.bytes_read + c.bytes_written))
+        return "\n".join(lines)
+
+
+def _val_bytes(v):
+    n = v.local_numel
+    if n is None:
+        return 0
+    return n * dtype_bytes(v.dtype)
+
+
+def estimate_cost(program, interp=None, targets=(), nranks=None,
+                  batch_size=None, budget=None):
+    """Run the cost model; returns a :class:`CostReport`.
+
+    ``interp``: reuse an existing :func:`interpret_program` result.
+    ``targets``: fetch targets kept live to program end for the peak-
+    memory estimate.  ``budget``: HBM budget override in bytes (default
+    :func:`hbm_budget`).
+    """
+    if interp is None:
+        interp = interpret_program(program, nranks=nranks,
+                                   batch_size=batch_size)
+    if budget is None:
+        budget = hbm_budget(program)
+    nranks = interp.nranks
+
+    op_costs = []
+    for rec in interp.records:
+        op = rec.op
+        bytes_read = sum(_val_bytes(v) for v in rec.ins)
+        bytes_written = sum(_val_bytes(v) for v in rec.outs)
+        ici = 0
+        ring = None
+        if op.type in COLLECTIVE_OP_TYPES or op.type in P2P_OP_TYPES:
+            ring = op.attrs.get("ring_id")
+            payload = max(
+                [_val_bytes(v) for v in (rec.ins or rec.outs)] or [0])
+            if op.type == "recv_v2" and rec.outs:
+                payload = _val_bytes(rec.outs[0])
+            ici = collective_ici_bytes(op.type, payload, nranks)
+        op_costs.append(OpCost(
+            rec, _op_flops(op, rec.ins, rec.outs), bytes_read,
+            bytes_written, ici, ring_id=ring))
+
+    # ---- liveness-based peak memory ----
+    # interval per non-persistable var: [def index, last read index];
+    # feeds start live at 0; targets stay live to the end
+    target_names = {getattr(t, "name", t) for t in (targets or ())}
+    first_def = {}
+    last_use = {}
+    # every persistable is scope-resident whether or not an op touches
+    # it this step (params, optimizer state, snapshots)
+    persist = {n: v for n, v in interp.env.items() if v.persistable}
+    for rec in interp.records:
+        for v in rec.ins:
+            if v.persistable:
+                continue
+            first_def.setdefault(v.name, 0)   # fed/root value
+            last_use[v.name] = rec.index
+        for v in rec.outs:
+            if v.persistable:
+                continue
+            first_def.setdefault(v.name, rec.index)
+            last_use.setdefault(v.name, rec.index)
+    end = len(interp.records)
+    for n in target_names:
+        if n in first_def:
+            last_use[n] = end
+    persistent_bytes = sum(_val_bytes(v) for v in persist.values())
+    # sweep: delta array of byte changes at each op index
+    deltas = [0] * (end + 2)
+    for n, d0 in first_def.items():
+        v = interp.env.get(n)
+        if v is None:
+            continue
+        b = _val_bytes(v)
+        deltas[d0] += b
+        deltas[last_use.get(n, d0) + 1] -= b
+    peak_live = 0
+    running = 0
+    for d in deltas:
+        running += d
+        peak_live = max(peak_live, running)
+    peak = persistent_bytes + peak_live
+
+    return CostReport(program, op_costs, peak, persistent_bytes,
+                      nranks, interp.batch_size, budget=budget)

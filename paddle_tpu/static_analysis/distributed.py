@@ -1,0 +1,332 @@
+"""Cross-worker collective schedule extraction and static
+deadlock-freedom proof.
+
+A distributed Fluid program is N per-worker programs that must agree on
+their communication schedule: every participant of a ring must issue
+the SAME ordered sequence of symmetric collectives (kind, dtype,
+element count), and every ``send_v2`` must meet a matching ``recv_v2``
+on the peer, in the same relative order — otherwise the cluster
+deadlocks (or silently reduces mismatched buffers).  Because the
+transpilers (``DistributeTranspiler``, ``transpiler/collective.py``)
+and the parallel program emitters (``parallel/pipeline.py``
+``transpile_pipeline``, ``parallel/{moe,ulysses,ring_attention}``
+collective emitters) insert these ops into the same Program IR the
+executor runs, the whole schedule is statically visible — this module
+extracts it and proves consistency, or names the first diverging pair.
+
+The proof obligations (the ``collective-schedule-divergence`` check):
+
+1. per ring_id, every worker's ordered list of symmetric collectives
+   matches worker 0's in length, op kind, dtype, and element count;
+2. per directed channel (src worker → dst worker, per ring), the
+   ordered ``send_v2`` list on src matches the ordered ``recv_v2`` list
+   on dst in length, dtype, and element count;
+3. the whole interleaved schedule completes under **rendezvous
+   semantics** (every collective blocks until all its participants
+   arrive; a send blocks on its recv and vice versa) — proven by
+   simulating the N queues to exhaustion.  This is what catches
+   cross-channel reorderings that per-ring/per-channel matching cannot
+   (worker A does send-then-recv while worker B does send-then-recv of
+   the opposite channels: both channels match pairwise, yet both
+   workers block forever).
+
+Together these rule out the classic static deadlocks: reordered
+collectives, mismatched reduce payloads, and orphaned/mispaired p2p.
+The model is conservative: a runtime with buffered (eager) sends may
+survive some schedules the rendezvous model rejects — but a schedule
+that passes here is safe under either semantics.
+"""
+
+from .cost import COLLECTIVE_OP_TYPES, P2P_OP_TYPES
+from .diagnostics import Diagnostic, Severity
+from .interp import interpret_program
+
+__all__ = [
+    "CollectiveEvent", "extract_collective_schedule",
+    "flatten_schedule", "check_schedule_consistency",
+    "prove_deadlock_free",
+]
+
+
+class CollectiveEvent:
+    """One collective op in one worker's schedule.  ``order`` is the
+    op's position in the worker's global execution order (across
+    rings) — what the rendezvous simulation queues on."""
+
+    __slots__ = ("worker", "ring_id", "kind", "dtype", "numel",
+                 "block_idx", "op_idx", "op_type", "var", "peer",
+                 "order")
+
+    def __init__(self, worker, ring_id, kind, dtype, numel, block_idx,
+                 op_idx, op_type, var=None, peer=None, order=0):
+        self.worker = worker
+        self.ring_id = ring_id
+        self.kind = kind          # op type for symmetric, send/recv for p2p
+        self.dtype = dtype
+        self.numel = numel
+        self.block_idx = block_idx
+        self.op_idx = op_idx
+        self.op_type = op_type
+        self.var = var
+        self.peer = peer
+        self.order = order
+
+    @property
+    def is_p2p(self):
+        return self.op_type in P2P_OP_TYPES
+
+    def signature(self):
+        """What must match across participants."""
+        return (self.kind, self.dtype, self.numel)
+
+    def where(self):
+        return "worker %s block %d op %d (%s%s)" % (
+            self.worker, self.block_idx, self.op_idx, self.op_type,
+            " %s" % self.var if self.var else "")
+
+    def to_dict(self):
+        return {
+            "worker": self.worker, "ring_id": self.ring_id,
+            "kind": self.kind, "dtype": self.dtype, "numel": self.numel,
+            "block_idx": self.block_idx, "op_idx": self.op_idx,
+            "op_type": self.op_type, "var": self.var, "peer": self.peer,
+        }
+
+    def __repr__(self):
+        return "CollectiveEvent(%s ring=%r %s[%s x%s]%s)" % (
+            self.where(), self.ring_id, self.kind, self.dtype,
+            self.numel,
+            " peer=%s" % self.peer if self.peer is not None else "")
+
+
+def extract_collective_schedule(program, worker=0, interp=None,
+                                nranks=None, batch_size=None):
+    """Ordered per-ring collective sequences of one worker's program.
+
+    Returns ``{ring_id: [CollectiveEvent]}`` in execution order
+    (sub-blocks included via the interpreter's walk).  Element counts
+    come from the abstract interpretation, so ``-1`` dims resolve the
+    same way the cost model resolves them.
+    """
+    if interp is None:
+        interp = interpret_program(program, nranks=nranks,
+                                   batch_size=batch_size)
+    schedule = {}
+    for rec in interp.records:
+        op = rec.op
+        if op.type not in COLLECTIVE_OP_TYPES \
+                and op.type not in P2P_OP_TYPES:
+            continue
+        ring = op.attrs.get("ring_id")
+        payload = rec.outs[0] if (op.type == "recv_v2" and rec.outs) \
+            else (rec.ins[0] if rec.ins else
+                  (rec.outs[0] if rec.outs else None))
+        ev = CollectiveEvent(
+            worker, ring,
+            "send" if op.type == "send_v2"
+            else ("recv" if op.type == "recv_v2" else op.type),
+            payload.dtype if payload is not None else None,
+            payload.local_numel if payload is not None else None,
+            rec.block_idx, rec.op_idx, op.type,
+            var=payload.name if payload is not None else None,
+            peer=op.attrs.get("peer"), order=rec.index)
+        schedule.setdefault(ring, []).append(ev)
+    return schedule
+
+
+def flatten_schedule(schedule):
+    """One worker's events across all rings, in execution order."""
+    evs = [e for ring_evs in schedule.values() for e in ring_evs]
+    evs.sort(key=lambda e: e.order)
+    return evs
+
+
+def _diag(message, ev, check="collective-schedule-divergence",
+          severity=Severity.ERROR, hint=""):
+    return Diagnostic(
+        check, severity, message,
+        block_idx=ev.block_idx if ev is not None else None,
+        op_idx=ev.op_idx if ev is not None else None,
+        op_type=ev.op_type if ev is not None else None,
+        var_names=(ev.var,) if ev is not None and ev.var else (),
+        hint=hint)
+
+
+def _simulate_rendezvous(schedules):
+    """Run the interleaved schedule to exhaustion under rendezvous
+    semantics.  Returns [] when every queue drains, else diagnostics
+    naming the mutually-blocked head events (the diverging pair).
+
+    Fire rules per step:
+    * p2p — worker ``src``'s head is a send to ``dst`` and ``dst``'s
+      head is the matching recv from ``src`` (same ring, dtype, numel):
+      both advance;
+    * symmetric — every participant of the ring (any worker with events
+      on it) sits at a same-signature head collective on that ring: all
+      advance.
+    """
+    queues = [flatten_schedule(s) for s in schedules]
+    ring_members = {}
+    for w, q in enumerate(queues):
+        for e in q:
+            if not e.is_p2p:
+                ring_members.setdefault(e.ring_id, set()).add(w)
+    heads = [0] * len(queues)
+
+    def head(w):
+        return queues[w][heads[w]] if heads[w] < len(queues[w]) else None
+
+    progress = True
+    while progress:
+        progress = False
+        for w in range(len(queues)):
+            e = head(w)
+            if e is None:
+                continue
+            if e.op_type == "send_v2":
+                d = e.peer
+                if not isinstance(d, int) or not 0 <= d < len(queues):
+                    continue
+                r = head(d)
+                if (r is not None and r.op_type == "recv_v2"
+                        and r.peer == w and r.ring_id == e.ring_id
+                        and (r.dtype, r.numel) == (e.dtype, e.numel)):
+                    heads[w] += 1
+                    heads[d] += 1
+                    progress = True
+            elif not e.is_p2p:
+                members = ring_members.get(e.ring_id, {w})
+                peers = [head(m) for m in sorted(members)]
+                if all(p is not None and not p.is_p2p
+                       and p.ring_id == e.ring_id
+                       and p.signature() == e.signature()
+                       for p in peers):
+                    for m in sorted(members):
+                        heads[m] += 1
+                    progress = True
+            # a recv head can only be advanced by its sender's turn
+
+    stuck = [(w, head(w)) for w in range(len(queues))
+             if head(w) is not None]
+    if not stuck:
+        return []
+    (w0, e0) = stuck[0]
+    others = ", ".join(e.where() for _, e in stuck[1:3]) or \
+        "every peer has drained its schedule"
+    return [_diag(
+        "collective schedule deadlocks under rendezvous semantics: %s "
+        "waits forever (blocked against: %s)" % (e0.where(), others),
+        e0,
+        hint="reorder the collectives so matching pairs meet in the "
+             "same relative position on every participant")]
+
+
+def check_schedule_consistency(schedules):
+    """Prove the per-worker schedules deadlock-free, or return precise
+    ERROR diagnostics naming the first diverging pair.
+
+    ``schedules``: list (indexed by worker) of the per-ring dicts
+    :func:`extract_collective_schedule` returns.  Three layers: per-ring
+    symmetric-sequence comparison, per-channel p2p matching (both give
+    position-precise messages), then the rendezvous simulation
+    (:func:`_simulate_rendezvous`) for cross-channel orderings the
+    pairwise layers cannot see.
+    """
+    diags = []
+    if len(schedules) <= 1:
+        return diags
+    rings = sorted({r for s in schedules for r in s},
+                   key=lambda r: repr(r))
+    for ring in rings:
+        per_worker = [
+            [e for e in s.get(ring, ()) if not e.is_p2p]
+            for s in schedules
+        ]
+        ref = per_worker[0]
+        for w in range(1, len(per_worker)):
+            cur = per_worker[w]
+            stop = False
+            for i, (a, b) in enumerate(zip(ref, cur)):
+                if a.signature() != b.signature():
+                    diags.append(_diag(
+                        "collective schedule diverges on ring %r at "
+                        "position %d: %s issues %s[%s x%s] but %s "
+                        "issues %s[%s x%s]"
+                        % (ring, i, a.where(), a.kind, a.dtype, a.numel,
+                           b.where(), b.kind, b.dtype, b.numel),
+                        b,
+                        hint="all participants of a ring must issue "
+                             "the same collectives in the same order "
+                             "with the same payload"))
+                    stop = True
+                    break
+            if not stop and len(ref) != len(cur):
+                longer, which = ((ref, 0) if len(ref) > len(cur)
+                                 else (cur, w))
+                extra = longer[min(len(ref), len(cur))]
+                diags.append(_diag(
+                    "ring %r: worker 0 issues %d collective(s) but "
+                    "worker %d issues %d — first unmatched is %s"
+                    % (ring, len(ref), w, len(cur), extra.where()),
+                    extra,
+                    hint="a transpiler inserted a collective on some "
+                         "workers only — every participant must issue "
+                         "it or none"))
+        # ---- p2p channels on this ring ----
+        sends = {}
+        recvs = {}
+        for w, s in enumerate(schedules):
+            for e in s.get(ring, ()):
+                if e.op_type == "send_v2":
+                    sends.setdefault((w, e.peer), []).append(e)
+                elif e.op_type == "recv_v2":
+                    recvs.setdefault((e.peer, w), []).append(e)
+        for chan in sorted(set(sends) | set(recvs)):
+            src, dst = chan
+            ss = sends.get(chan, [])
+            rr = recvs.get(chan, [])
+            for i, (a, b) in enumerate(zip(ss, rr)):
+                if (a.dtype, a.numel) != (b.dtype, b.numel):
+                    diags.append(_diag(
+                        "p2p channel %s->%s on ring %r diverges at "
+                        "position %d: %s sends [%s x%s] but %s "
+                        "receives [%s x%s]"
+                        % (src, dst, ring, i, a.where(), a.dtype,
+                           a.numel, b.where(), b.dtype, b.numel),
+                        b,
+                        hint="matched send_v2/recv_v2 pairs must agree "
+                             "on dtype and element count"))
+                    break
+            else:
+                if len(ss) != len(rr):
+                    extra = (ss if len(ss) > len(rr)
+                             else rr)[min(len(ss), len(rr))]
+                    diags.append(_diag(
+                        "p2p channel %s->%s on ring %r: %d send(s) vs "
+                        "%d recv(s) — first unmatched is %s"
+                        % (src, dst, ring, len(ss), len(rr),
+                           extra.where()),
+                        extra,
+                        hint="every send_v2 must meet exactly one "
+                             "recv_v2 on the peer (and vice versa)"))
+    if not diags:
+        # pairwise layers are clean — prove the interleaving too
+        diags.extend(_simulate_rendezvous(schedules))
+    return diags
+
+
+def prove_deadlock_free(programs, nranks=None, batch_size=None):
+    """Extract every worker's schedule and check consistency.
+
+    Returns ``(schedules, diagnostics)`` — empty diagnostics means the
+    schedule is proven consistent (deadlock-free under the static
+    model).  ``programs``: the N transpiled per-worker main programs.
+    """
+    if nranks is None:
+        nranks = len(programs)
+    schedules = [
+        extract_collective_schedule(p, worker=w, nranks=nranks,
+                                    batch_size=batch_size)
+        for w, p in enumerate(programs)
+    ]
+    return schedules, check_schedule_consistency(schedules)

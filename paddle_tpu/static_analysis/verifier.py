@@ -34,7 +34,8 @@ class VerifyError(RuntimeError):
         self.diagnostics = list(diagnostics)
 
 
-def verify_program(program, targets=None, checks=None, exclude=()):
+def verify_program(program, targets=None, checks=None, exclude=(),
+                   workers=None, _analysis=None, _worker_schedules=None):
     """Run lint/verifier checks over ``program``.
 
     Parameters
@@ -44,6 +45,15 @@ def verify_program(program, targets=None, checks=None, exclude=()):
               the orphaned-fetch check and informs unreferenced-op
     checks:   optional iterable of check ids to run (default: all)
     exclude:  check ids to skip
+    workers:  optional list of ALL per-worker main programs — enables
+              the cross-worker ``collective-schedule-divergence`` check
+              (worker indices follow list order)
+    _analysis: internal — a precomputed (InterpResult, CostReport) pair
+              from ``Program.analyze`` so the analyzer-backed checks
+              don't recompute it
+    _worker_schedules: internal — precomputed per-worker schedules from
+              ``Program.analyze`` so the divergence check doesn't
+              re-interpret every worker program
 
     Returns the list of Diagnostics sorted most-severe-first, then by
     (block, op) coordinates.
@@ -55,7 +65,9 @@ def verify_program(program, targets=None, checks=None, exclude=()):
         for t in (targets or ())
     ]
     graph = DefUseGraph(program)
-    ctx = VerifyContext(program, graph, targets=target_names)
+    ctx = VerifyContext(program, graph, targets=target_names,
+                        workers=workers, analysis=_analysis,
+                        worker_schedules=_worker_schedules)
     registry = all_checks()
     if checks is not None:
         unknown = [c for c in checks if c not in registry]

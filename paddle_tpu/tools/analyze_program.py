@@ -1,0 +1,99 @@
+"""Whole-program static analysis from the command line.
+
+Usage::
+
+    python -m paddle_tpu.tools.analyze_program MODEL_DIR [options]
+    python -m paddle_tpu.tools.analyze_program --program-json prog.json \
+        --workers w0.json w1.json --hbm-budget 16G --batch 64
+
+Loads a serialized Program (same inputs as ``lint_program``) and runs
+``Program.analyze()``: the abstract interpretation, the static
+FLOP/byte/ICI cost model with the liveness-based peak-memory estimate,
+the per-ring collective schedule, and — when ``--workers`` supplies the
+N transpiled per-worker programs — the cross-worker collective schedule
+deadlock-freedom proof.  Prints the cost/memory table (or ``--json``
+for the full machine-readable report; same emitter as the lint CLI)
+and exits:
+
+* 0 — no findings at or above ``--fail-on`` (default ERROR)
+* 1 — findings at or above the gate (CI-friendly)
+* 2 — could not load a program
+
+``--bench-json PATH`` additionally writes the BENCH-style static cost
+metrics so perf PRs can cite the static baseline next to measured
+numbers.
+"""
+
+import argparse
+import sys
+
+from .diag_cli import (add_emitter_args, add_program_args,
+                       emit_diagnostics, load_program_arg,
+                       severity_gate)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.tools.analyze_program",
+        description="Static cost/memory/collective-schedule analysis "
+                    "of a saved paddle_tpu program.")
+    add_program_args(parser)
+    parser.add_argument("--workers", nargs="+", default=None,
+                        metavar="PROG_JSON",
+                        help="serialized per-worker main programs (ALL "
+                             "workers, in rank order) — enables the "
+                             "cross-worker schedule proof")
+    parser.add_argument("--nranks", type=int, default=None,
+                        help="worker count for the sharding/ICI model "
+                             "(default: len(--workers) or the recorded "
+                             "trainer count)")
+    parser.add_argument("--batch", type=int, default=None,
+                        help="what -1 (batch) dims resolve to (default "
+                             "PADDLE_TPU_ANALYZE_BATCH or 1)")
+    parser.add_argument("--hbm-budget", default=None,
+                        help="peak-memory budget (bytes; K/M/G suffix) "
+                             "— overrides PADDLE_TPU_HBM_BUDGET")
+    parser.add_argument("--top", type=int, default=12,
+                        help="rows in the top-ops-by-FLOPs table")
+    parser.add_argument("--bench-json", default=None, metavar="PATH",
+                        help="also write BENCH-style static cost "
+                             "metric lines to PATH")
+    add_emitter_args(parser)
+    args = parser.parse_args(argv)
+    if not args.model_dir and not args.program_json:
+        parser.error("need MODEL_DIR or --program-json")
+
+    from ..proto import load_program
+    from ..static_analysis.cost import parse_size
+
+    try:
+        program, targets = load_program_arg(args)
+        workers = None
+        if args.workers:
+            workers = [load_program(p) for p in args.workers]
+    except Exception as e:
+        print("error: could not load program: %s" % e, file=sys.stderr)
+        return 2
+
+    budget = parse_size(args.hbm_budget) if args.hbm_budget else None
+    report = program.analyze(
+        targets=targets, workers=workers, nranks=args.nranks,
+        batch_size=args.batch, hbm_budget=budget)
+
+    if args.as_json:
+        emit_diagnostics(report.diagnostics, True,
+                         extra_json={k: v for k, v in
+                                     report.to_dict().items()
+                                     if k != "diagnostics"})
+    else:
+        print(report.format(top_ops=args.top))
+
+    if args.bench_json:
+        with open(args.bench_json, "w") as f:
+            f.write(report.cost.bench_json() + "\n")
+
+    return severity_gate(report.diagnostics, args.fail_on, args.as_json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,93 @@
+"""Shared CLI plumbing for the static-analysis tools
+(``lint_program`` and ``analyze_program``): program loading from a
+saved model dir or a bare serialized Program, and the diagnostics
+emitter (text or ``--json``) with the ``--fail-on`` severity gate.
+
+Both tools speak the same machine-readable format — a JSON array of
+``Diagnostic.to_dict()`` objects — so CI consumers parse one schema.
+"""
+
+import json
+import os
+import sys
+
+__all__ = ["add_program_args", "add_emitter_args", "load_program_arg",
+           "emit_diagnostics", "severity_gate"]
+
+
+def add_program_args(parser):
+    """MODEL_DIR / --program-json / --model-filename trio."""
+    parser.add_argument("model_dir", nargs="?", default=None,
+                        help="directory written by save_inference_model")
+    parser.add_argument("--model-filename", default=None,
+                        help="program file inside model_dir "
+                             "(default __model__)")
+    parser.add_argument("--program-json", default=None,
+                        help="operate on a bare serialized Program "
+                             "instead of a model dir (no fetch targets)")
+
+
+def add_emitter_args(parser, default_fail_on="ERROR"):
+    """--json / --fail-on pair shared by both tools."""
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit machine-readable JSON instead of text")
+    parser.add_argument("--fail-on", default=default_fail_on,
+                        type=str.upper,
+                        choices=["ERROR", "WARNING", "INFO"],
+                        help="lowest severity that fails the run — "
+                             "case-insensitive (default %s)"
+                        % default_fail_on)
+
+
+def load_program_arg(args):
+    """Load (program, fetch_targets) per the shared program args.
+    Raises whatever the loader raises — callers map that to exit 2."""
+    from ..proto import load_program
+
+    if args.program_json:
+        return load_program(args.program_json), []
+    model_path = os.path.join(args.model_dir,
+                              args.model_filename or "__model__")
+    prog = load_program(model_path)
+    targets = []
+    meta_path = os.path.join(args.model_dir, "__meta__.json")
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            targets = json.load(f).get("fetch", [])
+    return prog, targets
+
+
+def emit_diagnostics(diags, as_json, extra_json=None, header=None):
+    """Print diagnostics (JSON array, or formatted text with an
+    optional header line).  ``extra_json``: dict merged into a wrapper
+    object when the caller has more than diagnostics to report (the
+    analyzer's cost/schedule payload) — plain lint emits the bare array
+    for backward compatibility."""
+    from ..static_analysis import format_diagnostics
+
+    if as_json:
+        payload = [d.to_dict() for d in diags]
+        if extra_json is not None:
+            out = dict(extra_json)
+            out["diagnostics"] = payload
+            print(json.dumps(out, indent=2))
+        else:
+            print(json.dumps(payload, indent=2))
+    elif diags:
+        print(format_diagnostics(diags, header=header))
+    else:
+        print("clean: no findings")
+
+
+def severity_gate(diags, fail_on, as_json):
+    """Exit code for the run: 1 when any finding reaches ``fail_on``."""
+    from ..static_analysis import Severity
+
+    gate = Severity[fail_on]
+    failing = [d for d in diags if d.severity >= gate]
+    if failing:
+        if not as_json:
+            print("\n%d finding(s) at or above %s" % (len(failing), gate),
+                  file=sys.stderr)
+        return 1
+    return 0

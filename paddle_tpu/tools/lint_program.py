@@ -18,59 +18,31 @@ The check catalog and severities are documented in README
 """
 
 import argparse
-import json
-import os
 import sys
 
-
-def _load_program(args):
-    from ..proto import load_program
-
-    if args.program_json:
-        prog = load_program(args.program_json)
-        return prog, []
-    model_path = os.path.join(args.model_dir,
-                              args.model_filename or "__model__")
-    prog = load_program(model_path)
-    targets = []
-    meta_path = os.path.join(args.model_dir, "__meta__.json")
-    if os.path.exists(meta_path):
-        with open(meta_path) as f:
-            targets = json.load(f).get("fetch", [])
-    return prog, targets
+from .diag_cli import (add_emitter_args, add_program_args,
+                       emit_diagnostics, load_program_arg, severity_gate)
 
 
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="python -m paddle_tpu.tools.lint_program",
         description="Verify/lint a saved paddle_tpu inference model.")
-    parser.add_argument("model_dir", nargs="?", default=None,
-                        help="directory written by save_inference_model")
-    parser.add_argument("--model-filename", default=None,
-                        help="program file inside model_dir "
-                             "(default __model__)")
-    parser.add_argument("--program-json", default=None,
-                        help="lint a bare serialized Program instead of a "
-                             "model dir (no fetch targets)")
+    add_program_args(parser)
     parser.add_argument("--checks", default=None,
                         help="comma-separated check ids to run "
                              "(default: all)")
     parser.add_argument("--exclude", default="",
                         help="comma-separated check ids to skip")
-    parser.add_argument("--fail-on", default="ERROR",
-                        choices=["ERROR", "WARNING", "INFO"],
-                        help="lowest severity that fails the lint "
-                             "(default ERROR)")
-    parser.add_argument("--json", action="store_true", dest="as_json",
-                        help="emit diagnostics as a JSON array")
+    add_emitter_args(parser)
     args = parser.parse_args(argv)
     if not args.model_dir and not args.program_json:
         parser.error("need MODEL_DIR or --program-json")
 
-    from ..static_analysis import Severity, format_diagnostics, verify_program
+    from ..static_analysis import verify_program
 
     try:
-        program, targets = _load_program(args)
+        program, targets = load_program_arg(args)
     except Exception as e:
         print("error: could not load model: %s" % e, file=sys.stderr)
         return 2
@@ -84,21 +56,8 @@ def main(argv=None):
     except KeyError as e:
         parser.error(str(e))
 
-    if args.as_json:
-        print(json.dumps([d.to_dict() for d in diags], indent=2))
-    elif diags:
-        print(format_diagnostics(diags))
-    else:
-        print("clean: no findings")
-
-    gate = Severity[args.fail_on]
-    failing = [d for d in diags if d.severity >= gate]
-    if failing:
-        if not args.as_json:
-            print("\n%d finding(s) at or above %s" % (len(failing), gate),
-                  file=sys.stderr)
-        return 1
-    return 0
+    emit_diagnostics(diags, args.as_json)
+    return severity_gate(diags, args.fail_on, args.as_json)
 
 
 if __name__ == "__main__":

@@ -9,9 +9,35 @@ execution — so a transpiled program is correct either way."""
 
 from ..framework import default_main_program, default_startup_program
 
-__all__ = ["GradAllReduce", "LocalSGD", "GeoSGD", "AsyncSGD", "Collective"]
+__all__ = ["GradAllReduce", "LocalSGD", "GeoSGD", "AsyncSGD", "Collective",
+           "ensure_comm_ring"]
 
 OP_ROLE_BACKWARD = "backward"
+
+
+def ensure_comm_ring(startup_program, ring_id, rank=0, nranks=1):
+    """Append the ``c_gen_nccl_id`` → ``c_comm_init`` bootstrap pair for
+    ``ring_id`` to a startup program, idempotently (the reference emits
+    this pair per ring in C++; on TPU the ops are structural no-ops —
+    mesh membership comes from the jax coordination service — but the
+    static analyzer's ``collective-ring`` check pairs them per ring, and
+    every emitter of ring-stamped collectives calls this so the ring is
+    declared exactly once)."""
+    block = startup_program.global_block()
+    for op in block.ops:
+        if op.type == "c_gen_nccl_id" \
+                and op.attrs.get("ring_id") == ring_id:
+            return
+    nccl_id = block.create_var(name="tpu_comm_id_%s" % ring_id,
+                               shape=[1], dtype="int32", persistable=True)
+    block.append_op(
+        type="c_gen_nccl_id", outputs={"Out": [nccl_id]},
+        attrs={"rank": rank, "ring_id": ring_id},
+    )
+    block.append_op(
+        type="c_comm_init", inputs={"X": [nccl_id]},
+        attrs={"nranks": nranks, "rank": rank, "ring_id": ring_id},
+    )
 
 
 class Collective:
@@ -31,20 +57,13 @@ class Collective:
         self._transpile_main_program()
 
     def _transpile_startup_program(self):
-        # reference appends c_gen_nccl_id + c_comm_init per ring; on TPU
-        # mesh membership comes from the jax coordination service, the ops
-        # are kept (as no-ops) for program-structure parity
-        block = self.startup_program.global_block()
-        nccl_id = block.create_var(name="tpu_comm_id_0", shape=[1],
-                                   dtype="int32", persistable=True)
-        block.append_op(
-            type="c_gen_nccl_id", outputs={"Out": [nccl_id]},
-            attrs={"rank": self.rank, "ring_id": 0},
-        )
-        block.append_op(
-            type="c_comm_init", inputs={"X": [nccl_id]},
-            attrs={"nranks": self.nranks, "rank": self.rank, "ring_id": 0},
-        )
+        # reference appends c_gen_nccl_id + c_comm_init PER RING; the
+        # old code bootstrapped ring 0 only, so Collective(nrings=2)
+        # emitted collectives on a ring the startup never declared (the
+        # pairing gap the collective-ring check now reports)
+        for ring in range(self.nrings):
+            ensure_comm_ring(self.startup_program, ring,
+                             rank=self.rank, nranks=self.nranks)
 
     def _transpile_main_program(self):
         raise NotImplementedError
@@ -55,8 +74,30 @@ class GradAllReduce(Collective):
         if self.nranks <= 1:
             return
         block = self.main_program.global_block()
-        # find grads by op role; insert allreduce right after the producing
-        # op, scaled 1/nranks (reference collective.py:205)
+        # find PARAMETER grads by op role; insert allreduce right after
+        # the producing op, scaled 1/nranks (reference collective.py:205
+        # iterates param_grads).  Activation grads must NOT be exchanged:
+        # they legitimately differ per worker (each holds its own batch
+        # shard), averaging them mid-backward corrupts every downstream
+        # grad under shard_map — and even under GSPMD (identity) each
+        # extra collective inflates the static ICI schedule ~6x on an
+        # MLP, which is exactly what the analyzer's cost model showed.
+        #
+        # The grad THE OPTIMIZER CONSUMES is authoritative: for a shared
+        # parameter backward emits partials (w@GRAD, w@GRAD@RENAME_0)
+        # and a fan-in sum producing w@GRAD@SUM_0 — allreducing the
+        # partial while the optimizer reads the sum would apply
+        # avg(partial1)+local(partial2), silently divergent per worker.
+        param_grads = {
+            p.name + "@GRAD" for p in self.main_program.all_parameters()
+        }
+        for op in block.ops:
+            if op.attrs.get("op_role") == "optimize" and op.input("Grad"):
+                g = op.input("Grad")[0]
+                p = op.input("Param")
+                if p:
+                    param_grads.discard(p[0] + "@GRAD")
+                param_grads.add(g)
         new_ops = []
         from ..framework import Operator
 
@@ -65,7 +106,7 @@ class GradAllReduce(Collective):
             if op.attrs.get("op_role") != OP_ROLE_BACKWARD:
                 continue
             grad_outs = [
-                n for n in op.output_arg_names if n.endswith("@GRAD")
+                n for n in op.output_arg_names if n in param_grads
             ]
             for g in grad_outs:
                 v = block._find_var_recursive(g)

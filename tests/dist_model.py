@@ -46,3 +46,87 @@ def make_batches():
         yb = (xb.sum(axis=1, keepdims=True) * 0.3
               + rng.randn(GLOBAL_BATCH, 1) * 0.01).astype("float32")
         yield xb, yb
+
+
+# ---------------------------------------------------------------------------
+# transpiled multi-worker program sets for the static analyzer tests
+# (pipeline, DP, MoE) — each builder returns the N per-worker main
+# programs plus whatever the analyzer needs to anchor assertions
+# ---------------------------------------------------------------------------
+
+def build_pipeline_model():
+    """Same MLP, but returning the hidden (cut) var too:
+    (main, startup, loss, cut_var_name)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, size=16, act="relu",
+                            param_attr=_init("mlp.w0", [8, 16], 1),
+                            bias_attr=_init("mlp.b0", [16], 2))
+        pred = fluid.layers.fc(h, size=1,
+                               param_attr=_init("mlp.w1", [16, 1], 3),
+                               bias_attr=_init("mlp.b1", [1], 4))
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss, h.name
+
+
+def build_pipeline_workers():
+    """2-stage pipeline split of the training MLP: per-stage worker
+    programs with send_v2/recv_v2 boundaries (forward activation down,
+    activation grad back).  Returns (workers, startups, loss_name)."""
+    from paddle_tpu.parallel.pipeline import transpile_pipeline
+
+    fluid.unique_name.switch()
+    main, startup, loss, cut = build_pipeline_model()
+    workers, startups = transpile_pipeline(main, [cut],
+                                           startup_program=startup)
+    return workers, startups, loss.name
+
+
+def build_dp_workers(nranks=2):
+    """N-rank GradAllReduce transpile: each rank builds the identical
+    model and transpiles for its own rank — the schedules must agree.
+    Returns (workers, startups, loss_name)."""
+    from paddle_tpu.transpiler.collective import GradAllReduce
+
+    workers, startups = [], []
+    loss_name = None
+    for rank in range(nranks):
+        fluid.unique_name.switch()
+        main, startup, loss, _ = build_model()
+        GradAllReduce().transpile(program=main, startup_program=startup,
+                                  rank=rank, nranks=nranks)
+        workers.append(main)
+        startups.append(startup)
+        loss_name = loss.name
+    return workers, startups, loss_name
+
+
+def build_moe_workers(nranks=2):
+    """Expert-parallel MLP: hidden acts go through the MoE dispatch
+    all_to_all, an expert fc, and the combine all_to_all (ring 2).
+    Every rank builds the same program.  Returns
+    (workers, startups, out_name)."""
+    from paddle_tpu.parallel.moe import moe_combine, moe_dispatch
+
+    workers, startups = [], []
+    out_name = None
+    for _rank in range(nranks):
+        fluid.unique_name.switch()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[8], dtype="float32")
+            h = fluid.layers.fc(x, size=16, act="relu",
+                                param_attr=_init("moe.w0", [8, 16], 1))
+            d = moe_dispatch(h)
+            e = fluid.layers.fc(d, size=16, act="relu",
+                                param_attr=_init("moe.we", [16, 16], 5))
+            c = moe_combine(e)
+            out = fluid.layers.fc(c, size=4,
+                                  param_attr=_init("moe.w1", [16, 4], 3))
+        workers.append(main)
+        startups.append(startup)
+        out_name = out.name
+    return workers, startups, out_name

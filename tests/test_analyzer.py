@@ -1,0 +1,519 @@
+"""Whole-program distributed static analyzer (ISSUE 3):
+abstract interpretation, the static cost model, collective schedule
+extraction + the cross-worker deadlock-freedom proof, the new
+analyzer-backed lint checks, and the analyze_program CLI.
+
+Golden numbers are hand-derived from the documented conventions (README
+"Static analysis / lint > Analyzer"): one multiply-add = 2 FLOPs,
+mul = 2·M·K·N, ``*_grad`` = 2x forward, default = one FLOP per output
+element; ring-allreduce ICI = 2·B·(n-1)/n.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import paddle_tpu as fluid
+from paddle_tpu.static_analysis import (
+    Severity,
+    Sharding,
+    estimate_cost,
+    interpret_program,
+    prove_deadlock_free,
+    verify_program,
+)
+
+import dist_model
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _errors(diags):
+    return [d for d in diags if d.severity >= Severity.ERROR]
+
+
+def _fresh():
+    fluid.unique_name.switch()
+    return fluid.Program(), fluid.Program()
+
+
+# ---------------------------------------------------------------------------
+# abstract interpretation
+# ---------------------------------------------------------------------------
+
+class TestInterp:
+    def test_shapes_dtypes_and_batch_resolution(self):
+        main, startup, loss, _ = dist_model.build_model()
+        res = interpret_program(main, batch_size=16)
+        x = res.val("x")
+        assert x.shape == (16, 8) and x.dtype == "float32"
+        assert not x.persistable
+        w = res.val("mlp.w0")
+        assert w.shape == (8, 16) and w.persistable
+        # walk covered every op
+        assert len(res.records) == len(main.global_block().ops)
+
+    def test_sharding_seeds_and_collective_transfer(self):
+        """DP transpile: feeds are batch-sharded over the data axis,
+        params replicated, and a grad coming out of c_allreduce_sum is
+        replicated again (the collective transfer rule)."""
+        workers, _, _ = dist_model.build_dp_workers(nranks=2)
+        res = interpret_program(workers[0], nranks=2, batch_size=16)
+        assert res.val("x").sharding.is_sharded
+        assert res.val("x").sharding.parts == 2
+        assert res.val("x").local_numel == 16 * 8 // 2
+        assert res.val("mlp.w0").sharding.kind == Sharding.REPLICATED
+        # the allreduced grad is the LAST write to mlp.w0@GRAD
+        assert res.val("mlp.w0@GRAD").sharding.kind == Sharding.REPLICATED
+
+    def test_unreferenced_persistables_enter_env(self):
+        p, _ = _fresh()
+        with fluid.program_guard(p):
+            fluid.layers.create_parameter([4, 4], "float32", name="orphan.w")
+        res = interpret_program(p)
+        assert res.val("orphan.w") is not None
+        assert res.val("orphan.w").persistable
+
+    def test_sub_block_descent(self):
+        main, startup = _fresh()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[2, 4], dtype="float32",
+                                  append_batch_size=False)
+            pred = fluid.layers.fill_constant([1], "bool", True)
+            out = fluid.layers.cond(
+                pred, lambda: fluid.layers.scale(x, scale=2.0),
+                lambda: fluid.layers.scale(x, scale=-1.0))
+        res = interpret_program(main)
+        types = {r.op.type for r in res.records}
+        assert "scale" in types  # sub-block ops were interpreted
+
+
+# ---------------------------------------------------------------------------
+# cost model
+# ---------------------------------------------------------------------------
+
+class TestCostModel:
+    def test_golden_mlp_flops(self):
+        """Hand-derived total for the dist_model MLP at batch 16 — the
+        stable-numbers contract future perf PRs cite."""
+        main, startup, loss, _ = dist_model.build_model()
+        rep = estimate_cost(main, targets=[loss.name], batch_size=16)
+        # fwd: mul 2·16·8·16 + add 256 + relu 256 + mul 2·16·16·1 +
+        #      add 16 + sec 16 + mean 16
+        # bwd: seed 0 + mean_grad 36 + sec_grad 16 + add_grad 17 +
+        #      mul_grad 1024 + relu_grad 256 + add_grad 272 +
+        #      mul_grad 8192
+        # sgd: 16 + 1 + 128 + 16
+        assert rep.total_flops == 15142
+        assert rep.total_bytes_read > 0
+        assert rep.total_bytes_written > 0
+        assert rep.total_ici_bytes == 0  # no collectives
+
+    def test_cost_is_deterministic(self):
+        main, startup, loss, _ = dist_model.build_model()
+        a = estimate_cost(main, targets=[loss.name], batch_size=16)
+        b = estimate_cost(main, targets=[loss.name], batch_size=16)
+        assert a.total_flops == b.total_flops
+        assert a.peak_memory_bytes == b.peak_memory_bytes
+        assert [c.to_dict() for c in a.op_costs] == \
+            [c.to_dict() for c in b.op_costs]
+
+    def test_peak_memory_components(self):
+        main, startup, loss, _ = dist_model.build_model()
+        rep = estimate_cost(main, targets=[loss.name], batch_size=16)
+        # persistables: (8·16 + 16 + 16·1 + 1 + lr 1) · 4 bytes
+        assert rep.persistent_bytes == (8 * 16 + 16 + 16 + 1 + 1) * 4
+        assert rep.peak_memory_bytes > rep.persistent_bytes
+
+    def test_allreduce_ici_convention(self):
+        """2-rank DP: each c_allreduce_sum moves 2·B·(n-1)/n = B."""
+        workers, _, _ = dist_model.build_dp_workers(nranks=2)
+        rep = estimate_cost(workers[0], nranks=2, batch_size=16)
+        grads_bytes = (8 * 16 + 16 + 16 + 1) * 4
+        assert rep.total_ici_bytes == grads_bytes
+        assert rep.ici_bytes_per_ring() == {0: grads_bytes}
+
+    def test_hbm_budget_gate(self, monkeypatch):
+        main, startup, loss, _ = dist_model.build_model()
+        rep = estimate_cost(main, targets=[loss.name], batch_size=16,
+                            budget=100)
+        assert rep.over_budget
+        monkeypatch.setenv("PADDLE_TPU_HBM_BUDGET", "1G")
+        rep = estimate_cost(main, targets=[loss.name], batch_size=16)
+        assert rep.hbm_budget == 1 << 30 and not rep.over_budget
+
+    def test_bench_json_lines(self):
+        main, startup, loss, _ = dist_model.build_model()
+        rep = estimate_cost(main, targets=[loss.name], batch_size=16)
+        lines = rep.bench_json().splitlines()
+        metrics = {json.loads(l)["metric"] for l in lines}
+        assert "static_program_flops" in metrics
+        assert "static_program_peak_memory" in metrics
+
+
+# ---------------------------------------------------------------------------
+# collective schedules + the deadlock-freedom proof
+# ---------------------------------------------------------------------------
+
+class TestSchedules:
+    def test_pipeline_workers_prove_consistent(self):
+        workers, startups, loss_name = dist_model.build_pipeline_workers()
+        assert len(workers) == 2
+        scheds, diags = prove_deadlock_free(workers)
+        assert diags == []
+        # stage 0 sends the activation down, receives the grad back
+        kinds0 = [(e.kind, e.peer) for e in scheds[0][1]]
+        kinds1 = [(e.kind, e.peer) for e in scheds[1][1]]
+        assert kinds0 == [("send", 1), ("recv", 1)]
+        assert kinds1 == [("recv", 0), ("send", 0)]
+
+    def test_pipeline_workers_lint_clean(self, verify_clean):
+        workers, startups, loss_name = dist_model.build_pipeline_workers()
+        verify_clean(workers[0])
+        verify_clean(workers[1], targets=[loss_name])
+        for su in startups:
+            verify_clean(su)
+
+    def test_dp_workers_prove_consistent(self):
+        workers, _, _ = dist_model.build_dp_workers(nranks=2)
+        scheds, diags = prove_deadlock_free(workers)
+        assert diags == []
+        assert len(scheds[0][0]) == 4  # one allreduce per grad
+        assert all(e.kind == "c_allreduce_sum" for e in scheds[0][0])
+
+    def test_moe_workers_prove_consistent(self):
+        workers, _, out_name = dist_model.build_moe_workers(nranks=2)
+        scheds, diags = prove_deadlock_free(workers)
+        assert diags == []
+        from paddle_tpu.parallel.moe import MOE_RING_ID
+
+        kinds = [e.kind for e in scheds[0][MOE_RING_ID]]
+        assert kinds == ["all_to_all", "all_to_all"]
+
+    def test_swapped_p2p_yields_divergence_with_coordinates(self):
+        """The acceptance negative: swap two collectives in ONE
+        worker's program → collective-schedule-divergence ERROR naming
+        the diverging op pair with block/op indices."""
+        workers, _, _ = dist_model.build_pipeline_workers()
+        b = workers[1].global_block()
+        idxs = [i for i, op in enumerate(b.ops)
+                if op.type in ("send_v2", "recv_v2")]
+        b.ops[idxs[0]], b.ops[idxs[1]] = b.ops[idxs[1]], b.ops[idxs[0]]
+        _, diags = prove_deadlock_free(workers)
+        assert len(diags) == 1
+        d = diags[0]
+        assert d.check == "collective-schedule-divergence"
+        assert d.severity is Severity.ERROR
+        # the diagnostic anchors an op coordinate and names both sides
+        assert d.block_idx == 0 and isinstance(d.op_idx, int)
+        assert "worker 0" in d.message and "worker 1" in d.message
+
+    def test_reordered_allreduce_yields_position_divergence(self):
+        workers, _, _ = dist_model.build_dp_workers(nranks=2)
+        b = workers[1].global_block()
+        ar = [i for i, op in enumerate(b.ops)
+              if op.type == "c_allreduce_sum"]
+        # swap two allreduces with different payloads
+        b.ops[ar[0]], b.ops[ar[1]] = b.ops[ar[1]], b.ops[ar[0]]
+        _, diags = prove_deadlock_free(workers)
+        assert diags
+        d = diags[0]
+        assert d.check == "collective-schedule-divergence"
+        assert "position" in d.message
+        assert d.op_type == "c_allreduce_sum"
+
+    def test_shared_param_fanin_grad_is_allreduced(self):
+        """A parameter used by two ops gets its partials summed into
+        ``w@GRAD@SUM_0`` — the grad the optimizer consumes.  The
+        allreduce must land on THAT var, not on the partial (which
+        would apply avg(partial1)+local(partial2), divergent per
+        worker)."""
+        from paddle_tpu.transpiler.collective import GradAllReduce
+
+        fluid.unique_name.switch()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[8], dtype="float32")
+            w_attr = fluid.ParamAttr(name="sharedw")
+            h1 = fluid.layers.fc(x, size=8, param_attr=w_attr,
+                                 bias_attr=False)
+            h2 = fluid.layers.fc(h1, size=8, param_attr=w_attr,
+                                 bias_attr=False)
+            loss = fluid.layers.mean(h2)
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        GradAllReduce().transpile(program=main, startup_program=startup,
+                                  rank=0, nranks=2)
+        b = main.global_block()
+        ars = [op.inputs["X"][0] for op in b.ops
+               if op.type == "c_allreduce_sum"]
+        assert ars == ["sharedw@GRAD@SUM_0"]
+        sgd = next(op for op in b.ops if op.type == "sgd")
+        assert sgd.inputs["Grad"] == ["sharedw@GRAD@SUM_0"]
+
+    def test_missing_collective_yields_length_divergence(self):
+        workers, _, _ = dist_model.build_dp_workers(nranks=2)
+        b = workers[1].global_block()
+        # drop the LAST allreduce so every shared position still
+        # matches — the length layer, not the position layer, must fire
+        i = max(i for i, op in enumerate(b.ops)
+                if op.type == "c_allreduce_sum")
+        del b.ops[i]
+        _, diags = prove_deadlock_free(workers)
+        assert any("worker 0 issues" in d.message for d in diags)
+
+    def test_mismatched_p2p_payload_flagged(self):
+        workers, _, _ = dist_model.build_pipeline_workers()
+        b = workers[1].global_block()
+        recv = next(op for op in b.ops if op.type == "recv_v2")
+        recv.attrs["out_shape"] = [4, 4]
+        v = b._find_var_recursive(recv.outputs["Out"][0])
+        v.shape = (4, 4)
+        _, diags = prove_deadlock_free(workers, batch_size=16)
+        assert any("p2p channel" in d.message for d in diags)
+
+
+# ---------------------------------------------------------------------------
+# Program.analyze — the acceptance flow
+# ---------------------------------------------------------------------------
+
+class TestProgramAnalyze:
+    def test_pipeline_acceptance(self):
+        """ISSUE 3 acceptance: analyze() on the dist_model pipeline
+        program reports consistent per-worker schedules, a nonzero
+        FLOP/byte/ICI breakdown, and a peak-memory estimate."""
+        workers, _, loss_name = dist_model.build_pipeline_workers()
+        rep = workers[1].analyze(targets=[loss_name], workers=workers,
+                                 batch_size=16)
+        assert rep.ok
+        assert rep.schedule_consistent is True
+        assert rep.cost.total_flops > 0
+        assert rep.cost.total_bytes_read > 0
+        assert rep.cost.total_ici_bytes > 0
+        assert rep.cost.peak_memory_bytes > 0
+        assert rep.worker_schedules and len(rep.worker_schedules) == 2
+        text = rep.format()
+        assert "deadlock-free" in text and "peak memory" in text
+
+    def test_analyze_reports_swap_divergence(self):
+        workers, _, loss_name = dist_model.build_pipeline_workers()
+        b = workers[0].global_block()
+        idxs = [i for i, op in enumerate(b.ops)
+                if op.type in ("send_v2", "recv_v2")]
+        b.ops[idxs[0]], b.ops[idxs[1]] = b.ops[idxs[1]], b.ops[idxs[0]]
+        rep = workers[0].analyze(workers=workers, batch_size=16)
+        assert not rep.ok
+        assert rep.schedule_consistent is False
+        assert any(d.check == "collective-schedule-divergence"
+                   for d in rep.errors)
+
+    def test_to_dict_round_trips_through_json(self):
+        workers, _, loss_name = dist_model.build_pipeline_workers()
+        rep = workers[0].analyze(workers=workers, batch_size=16)
+        blob = json.loads(json.dumps(rep.to_dict()))
+        assert blob["ok"] is True
+        assert blob["schedule_consistent"] is True
+        assert blob["cost"]["total_flops"] == rep.cost.total_flops
+
+
+# ---------------------------------------------------------------------------
+# analyzer-backed lint checks
+# ---------------------------------------------------------------------------
+
+class TestNewChecks:
+    def test_peak_memory_over_budget(self):
+        main, startup, loss, _ = dist_model.build_model()
+        main._hbm_budget = "1K"
+        errs = _errors(verify_program(main, targets=[loss.name]))
+        assert any(d.check == "peak-memory-over-budget" for d in errs)
+        main._hbm_budget = None
+        assert not any(d.check == "peak-memory-over-budget"
+                       for d in verify_program(main, targets=[loss.name]))
+
+    def test_degenerate_sharding(self):
+        p, _ = _fresh()
+        with fluid.program_guard(p):
+            fluid.layers.create_parameter([3, 4], "float32",
+                                          name="tiny.w")
+        p._num_trainers = 4
+        p.global_block().vars["tiny.w"]._is_distributed = True
+        diags = verify_program(p)
+        hits = [d for d in diags if d.check == "degenerate-sharding"]
+        assert hits and hits[0].var_names == ("tiny.w",)
+        assert hits[0].severity is Severity.WARNING
+
+    def test_degenerate_sharding_skips_dynamic_batch_dims(self):
+        p, _ = _fresh()
+        with fluid.program_guard(p):
+            x = fluid.layers.data("x", shape=[8], dtype="float32")
+            fluid.layers.scale(x, scale=1.0)
+        p._num_trainers = 4
+        assert not any(d.check == "degenerate-sharding"
+                       for d in verify_program(p))
+
+    def test_oversized_replicated_persistable(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_REPLICATED_BUDGET", "1M")
+        p, _ = _fresh()
+        with fluid.program_guard(p):
+            fluid.layers.create_parameter([600, 600], "float32",
+                                          name="big.w")
+        p._num_trainers = 2
+        diags = verify_program(p)
+        hits = [d for d in diags
+                if d.check == "oversized-replicated-persistable"]
+        assert hits and hits[0].var_names == ("big.w",)
+        # single-worker programs are exempt
+        p._num_trainers = 1
+        assert not any(d.check == "oversized-replicated-persistable"
+                       for d in verify_program(p))
+
+    def test_parallel_emitter_collectives_need_ring_id(self):
+        """Satellite: check_collective_ring covers moe/ulysses/ring-
+        attention emitted collectives, not just transpiler c_* ops."""
+        workers, _, out_name = dist_model.build_moe_workers(nranks=2)
+        b = workers[0].global_block()
+        a2a = next(op for op in b.ops if op.type == "all_to_all")
+        del a2a.attrs["ring_id"]
+        errs = _errors(verify_program(workers[0], targets=[out_name]))
+        assert any(d.check == "collective-ring"
+                   and d.op_type == "all_to_all" for d in errs)
+
+    def test_ppermute_needs_ring_id(self):
+        from paddle_tpu.parallel.ring_attention import ring_rotate
+
+        p, s = _fresh()
+        with fluid.program_guard(p, s):
+            k = fluid.layers.data("k", shape=[4, 8, 16], dtype="float32")
+            kr = ring_rotate(k)
+        op = next(op for op in p.global_block().ops
+                  if op.type == "ppermute")
+        op.attrs["ring_id"] = "not-an-int"
+        errs = _errors(verify_program(p, targets=[kr.name]))
+        assert any(d.check == "collective-ring"
+                   and d.op_type == "ppermute" for d in errs)
+
+    def test_collective_nrings_bootstrap_gap_fixed(self, verify_clean):
+        """Collective(nrings=2) used to bootstrap ring 0 only — the
+        pairing gap the satellite names.  Now every ring gets its
+        c_gen_nccl_id/c_comm_init pair."""
+        from paddle_tpu.transpiler.collective import GradAllReduce
+
+        fluid.unique_name.switch()
+        main, startup, loss, _ = dist_model.build_model()
+        GradAllReduce(nrings=2).transpile(
+            program=main, startup_program=startup, rank=0, nranks=2)
+        rings = {op.attrs["ring_id"]
+                 for op in startup.global_block().ops
+                 if op.type == "c_gen_nccl_id"}
+        assert rings == {0, 1}
+        verify_clean(startup)
+
+    def test_startup_bootstrap_covers_used_rings(self):
+        """A program carrying its own bootstrap must declare every ring
+        its collectives use."""
+        from paddle_tpu.transpiler.collective import ensure_comm_ring
+
+        p, _ = _fresh()
+        ensure_comm_ring(p, 0, rank=0, nranks=2)
+        b = p.global_block()
+        b.create_var(name="g", shape=[4], dtype="float32", is_data=True)
+        b.append_op(type="c_allreduce_sum", inputs={"X": ["g"]},
+                    outputs={"Out": ["g"]}, attrs={"ring_id": 7})
+        diags = verify_program(p)
+        assert any(d.check == "collective-ring"
+                   and "ring 7" in d.message
+                   and d.severity is Severity.WARNING for d in diags)
+
+
+# ---------------------------------------------------------------------------
+# analyze_program CLI (shares the lint_program emitter)
+# ---------------------------------------------------------------------------
+
+def _save_worker_programs(tmp_path):
+    from paddle_tpu.proto import save_program
+
+    workers, _, loss_name = dist_model.build_pipeline_workers()
+    paths = []
+    for w, p in enumerate(workers):
+        pth = str(tmp_path / ("w%d.json" % w))
+        save_program(p, pth)
+        paths.append(pth)
+    return workers, paths, loss_name
+
+
+def _run_cli(tool, *args):
+    return subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.tools.%s" % tool, *args],
+        capture_output=True, text=True, timeout=240,
+        env={**os.environ,
+             "PYTHONPATH": REPO + os.pathsep + os.environ.get(
+                 "PYTHONPATH", ""),
+             "JAX_PLATFORMS": "cpu"},
+        cwd=REPO)
+
+
+class TestAnalyzeCli:
+    def test_table_and_proof_exit_zero(self, tmp_path):
+        _, paths, _ = _save_worker_programs(tmp_path)
+        res = _run_cli("analyze_program", "--program-json", paths[0],
+                       "--workers", *paths, "--batch", "16")
+        assert res.returncode == 0, res.stdout + res.stderr
+        assert "cost model" in res.stdout
+        assert "deadlock-free" in res.stdout
+
+    def test_json_report_schema(self, tmp_path):
+        _, paths, _ = _save_worker_programs(tmp_path)
+        res = _run_cli("analyze_program", "--program-json", paths[0],
+                       "--workers", *paths, "--batch", "16", "--json")
+        assert res.returncode == 0, res.stdout + res.stderr
+        blob = json.loads(res.stdout)
+        assert {"cost", "schedule", "schedule_consistent",
+                "diagnostics", "ok"} <= set(blob)
+        assert blob["cost"]["total_ici_bytes"] > 0
+
+    def test_divergent_workers_exit_nonzero(self, tmp_path):
+        from paddle_tpu.proto import save_program
+
+        workers, _, _ = dist_model.build_pipeline_workers()
+        b = workers[1].global_block()
+        idxs = [i for i, op in enumerate(b.ops)
+                if op.type in ("send_v2", "recv_v2")]
+        b.ops[idxs[0]], b.ops[idxs[1]] = b.ops[idxs[1]], b.ops[idxs[0]]
+        paths = []
+        for w, p in enumerate(workers):
+            pth = str(tmp_path / ("d%d.json" % w))
+            save_program(p, pth)
+            paths.append(pth)
+        res = _run_cli("analyze_program", "--program-json", paths[0],
+                       "--workers", *paths)
+        assert res.returncode == 1
+        assert "collective-schedule-divergence" in res.stdout
+
+    def test_bench_json_dump(self, tmp_path):
+        _, paths, _ = _save_worker_programs(tmp_path)
+        out = str(tmp_path / "bench.json")
+        res = _run_cli("analyze_program", "--program-json", paths[0],
+                       "--batch", "16", "--bench-json", out)
+        assert res.returncode == 0
+        lines = [json.loads(l) for l in open(out) if l.strip()]
+        assert any(l["metric"] == "static_program_flops" for l in lines)
+
+    def test_hbm_budget_flag_gates(self, tmp_path):
+        _, paths, _ = _save_worker_programs(tmp_path)
+        res = _run_cli("analyze_program", "--program-json", paths[0],
+                       "--batch", "16", "--hbm-budget", "1K")
+        assert res.returncode == 1
+        assert "peak-memory-over-budget" in res.stdout
+
+    def test_lint_cli_shares_emitter_flags(self, tmp_path):
+        """Satellite: lint_program and analyze_program speak the same
+        --json/--fail-on emitter."""
+        _, paths, _ = _save_worker_programs(tmp_path)
+        for tool in ("lint_program", "analyze_program"):
+            res = _run_cli(tool, "--program-json", paths[0], "--json",
+                           "--fail-on", "ERROR")
+            assert res.returncode == 0, (tool, res.stdout, res.stderr)
+            blob = json.loads(res.stdout)
+            diags = blob if isinstance(blob, list) else \
+                blob["diagnostics"]
+            assert isinstance(diags, list)
