@@ -381,16 +381,20 @@ def child_infer():
     feed = {"img": jnp.asarray(rng.randn(
         *((batch,) + tuple(img_shape))).astype("float32"))}
 
-    lat_ms, dt = _predictor_timing(pred, feed, warmup, steps)
+    lat_ms, dt, async_ms = _predictor_timing(pred, feed, warmup, steps)
     if dt is None:  # compile-only phase
         return
     ips = batch * steps / dt
+    metric = ("resnet50_infer_images_per_sec_per_chip"
+              if on_tpu else "resnet_cifar_infer_smoke_images_per_sec")
+    _emit_sync_latency(
+        "resnet50_infer" if on_tpu else "resnet_cifar_infer_smoke",
+        async_ms, lat_ms, dev)
     # fwd-only model FLOPs: 2 x 4.09 GMACs at 224^2 (see the train
     # constant above); the cifar smoke reuses it only nominally
     mfu = ips * (RESNET50_TRAIN_FLOPS_PER_IMAGE / 3) / peak_flops(dev)
     print(json.dumps({
-        "metric": "resnet50_infer_images_per_sec_per_chip"
-                  if on_tpu else "resnet_cifar_infer_smoke_images_per_sec",
+        "metric": metric,
         "value": round(ips, 1),
         "unit": "images/sec/chip (%dx%d bs%d %s%s AnalysisPredictor, "
                 "sync latency %.1f ms/batch, MFU %.3f on %s)"
@@ -439,9 +443,12 @@ def _export_predictor(main, startup, feed_names, targets, on_tpu,
 
 
 def _predictor_timing(pred, feed, warmup, steps, lat_runs=10):
-    """Shared predictor measurement: sync per-request latency + pipelined
-    serving throughput.  Returns (lat_ms, dt_seconds); (None, None) in
-    the compile-only phase (one finite run to seed the cache)."""
+    """Shared predictor measurement: sync per-request latency, pipelined
+    serving throughput, and the ASYNC per-batch host-blocking latency
+    (what one batch costs the serving loop when fetches stay lazy — the
+    per-batch sync latency the fetch-handle path is meant to eliminate).
+    Returns (lat_ms, dt_seconds, async_ms); (None, None, None) in the
+    compile-only phase (one finite run to seed the cache)."""
     def run_once(return_numpy=True):
         return pred.run(feed, return_numpy=return_numpy)
 
@@ -449,7 +456,7 @@ def _predictor_timing(pred, feed, warmup, steps, lat_runs=10):
         out = run_once()
         assert np.isfinite(out[0]).all()
         print(json.dumps({"compiled": True}), flush=True)
-        return None, None
+        return None, None, None
     # phase markers: when a watcher cap kills this child, the captured
     # stdout shows WHICH phase stalled (two r05 bench_infer attempts
     # died at the cap with no output at all)
@@ -475,7 +482,37 @@ def _predictor_timing(pred, feed, warmup, steps, lat_runs=10):
     outs = [run_once(return_numpy=False) for _ in range(steps)]
     np.asarray(outs[-1][0])
     dt = time.perf_counter() - t0
-    return lat_ms, dt
+    # async per-batch host-blocking latency: each run_async-style call
+    # returns lazy fetch handles the moment the step is enqueued — the
+    # per-call wall time is ALL a pipelined serving loop pays per batch
+    # (vs lat_ms for the blocking round trip); one final fetch closes
+    # the window so in-flight work is not billed to the next phase
+    blocked = 0.0
+    tail = None
+    for _ in range(lat_runs):
+        t1 = time.perf_counter()
+        tail = pred.run_async(feed)
+        blocked += time.perf_counter() - t1
+    np.asarray(tail[0])
+    async_ms = blocked / lat_runs * 1e3
+    print("# predictor async dispatch latency %.2f ms/batch" % async_ms,
+          flush=True)
+    return lat_ms, dt, async_ms
+
+
+def _emit_sync_latency(base_metric, async_ms, lat_ms, dev):
+    """BENCH line: per-batch sync latency of the async serving loop
+    (single-digit ms is the target; the blocking round trip rides in
+    the unit for contrast).  vs_baseline >= 1 once the per-batch
+    host-blocking time is under the 10 ms bar."""
+    print(json.dumps({
+        "metric": base_metric + "_sync_latency_ms",
+        "value": round(async_ms, 2),
+        "unit": "ms/batch host-blocking (async fetch-handle loop; "
+                "blocking round-trip %.1f ms/batch on %s)"
+                % (lat_ms, getattr(dev, "device_kind", str(dev))),
+        "vs_baseline": round(10.0 / max(async_ms, 1e-3), 3),
+    }), flush=True)
 
 
 def _bert_infer(on_tpu, dev, seq_len=128):
@@ -522,17 +559,20 @@ def _bert_infer(on_tpu, dev, seq_len=128):
             for k, v in bert.make_fake_batch(batch, seq_len, cfg, rng,
                                              max_pred=0).items()
             if k in feed_names}
-    lat_ms, dt = _predictor_timing(pred, feed, warmup, steps)
+    lat_ms, dt, async_ms = _predictor_timing(pred, feed, warmup, steps)
     if dt is None:
         return
     tps = batch * seq_len * steps / dt
+    metric = ("bert_base_infer_tokens_per_sec_per_chip"
+              if on_tpu else "bert_infer_smoke_tokens_per_sec")
+    _emit_sync_latency("bert_base_infer" if on_tpu else "bert_infer_smoke",
+                       async_ms, lat_ms, dev)
     d, ff = cfg.hidden, cfg.ffn
     fwd_flops_per_token = cfg.layers * (
         8 * d * d + 4 * d * ff + 4 * seq_len * d)
     mfu = tps * fwd_flops_per_token / peak_flops(dev)
     print(json.dumps({
-        "metric": "bert_base_infer_tokens_per_sec_per_chip"
-                  if on_tpu else "bert_infer_smoke_tokens_per_sec",
+        "metric": metric,
         "value": round(tps, 1),
         "unit": "tokens/sec/chip (encoder fwd seq%d bs%d %s "
                 "AnalysisPredictor, sync latency %.1f ms/batch, "

@@ -54,25 +54,36 @@ def main():
     train_reader = fluid.batch(datasets.mnist.train(), args.batch)
     test_reader = fluid.batch(datasets.mnist.test(), 256)
 
+    def feed_dicts(reader):
+        for batch in reader():
+            xs = np.stack([b[0].reshape(-1) for b in batch]).astype(
+                "float32")
+            ys = np.array([[b[1]] for b in batch], dtype="int64")
+            yield {"img": xs, "label": ys}
+
+    # async dispatch loop: a background thread stages upcoming batches
+    # on device (depth 2, env PADDLE_TPU_PIPELINE_DEPTH) while lazy
+    # fetch handles keep every step un-synced — the host only blocks at
+    # the print boundary, so batch prep + H2D overlap device compute
+    from paddle_tpu import pipeline as pl
+
     for epoch in range(args.epochs):
-        for i, batch in enumerate(train_reader()):
-            xs = np.stack([b[0].reshape(-1) for b in batch]).astype(
-                "float32")
-            ys = np.array([[b[1]] for b in batch], dtype="int64")
-            lv, av = exe.run(main_prog, feed={"img": xs, "label": ys},
-                             fetch_list=[loss, acc])
+        for i, feed in enumerate(
+                pl.DeviceFeedPipeline(lambda: feed_dicts(train_reader))):
+            lv, av = exe.run(main_prog, feed=feed,
+                             fetch_list=[loss, acc], return_numpy=False)
             if i % 100 == 0:
+                lv, av = pl.materialize([lv, av])  # one batched sync
                 print("epoch %d step %d: loss %.4f acc %.3f"
-                      % (epoch, i, np.asarray(lv).reshape(-1)[0],
-                         np.asarray(av).reshape(-1)[0]))
-        accs = []
-        for batch in test_reader():
-            xs = np.stack([b[0].reshape(-1) for b in batch]).astype(
-                "float32")
-            ys = np.array([[b[1]] for b in batch], dtype="int64")
-            accs.append(np.asarray(
-                exe.run(test_prog, feed={"img": xs, "label": ys},
-                        fetch_list=[acc])[0]).reshape(-1)[0])
+                      % (epoch, i, lv.reshape(-1)[0], av.reshape(-1)[0]))
+        accs = [
+            exe.run(test_prog, feed=feed, fetch_list=[acc],
+                    return_numpy=False)[0]
+            for feed in pl.DeviceFeedPipeline(
+                lambda: feed_dicts(test_reader))
+        ]
+        # the whole eval epoch syncs ONCE
+        accs = [a.reshape(-1)[0] for a in pl.materialize(accs)]
         print("epoch %d: test acc %.4f" % (epoch, float(np.mean(accs))))
 
 

@@ -73,6 +73,7 @@ from . import unique_name
 from . import dygraph
 from . import profiler
 from . import contrib
+from . import pipeline
 from . import reader
 from . import native
 from . import recordio_writer
@@ -155,6 +156,7 @@ __all__ = [
     "metrics",
     "io",
     "reader",
+    "pipeline",
     "PyReader",
     "DataLoader",
     "unique_name",

@@ -17,37 +17,53 @@ def run_from_dataset(executor, program, dataset, scope, fetch_list,
     trainer._set_infer(not train)
     trainer._set_fetch_var_and_info(fetch_list, fetch_info, print_period)
     program._trainer_desc = trainer
-    import numpy as np
 
-    from .reader_decorators import buffered
+    from . import pipeline as pl
 
-    # Input-pipeline overlap (SURVEY §7g; the reference's DataFeed worker
-    # threads): parse batches on a background thread (2-deep buffer) and
-    # keep per-step fetches as DEVICE arrays — jax dispatch is async, so
-    # the host parses batch i+1 while the chip runs step i.  One numpy
-    # sync at the end (or at each print_period line) instead of per step.
-    it = buffered(dataset.batch_iterator, 2)()
+    # Async dispatch loop (SURVEY §7g; the reference's DataFeed worker
+    # threads + double-buffer queue): a background thread parses AND
+    # device_puts upcoming batches (depth PADDLE_TPU_PIPELINE_DEPTH,
+    # default 2), every step returns lazy FetchHandles, and the ONLY
+    # device→host syncs are one batched materialize per print_period
+    # window — host prep of batch k+1 overlaps device compute of batch k
+    # with no per-step round trip anywhere in the loop.
+    it = pl.DeviceFeedPipeline(dataset.batch_iterator)
+    # sync window = print_period (the printed line needs the values
+    # anyway); without printing, a pipeline-depth-sized window keeps
+    # device residency of un-synced fetches O(depth) — the batched sync
+    # of completed steps still overlaps the steps in flight behind it
+    window = (print_period if (fetch_list and print_period)
+              else max(2, pl.pipeline_depth() * 2))
     results = []
+    unsynced = 0
+
+    def _sync_window():
+        # ONE batched sync for every fetch still in flight
+        pl.materialize([h for step in results[len(results) - unsynced:]
+                        for h in step])
+
     for i, feed in enumerate(it):
         out = executor.run(
             program, feed=feed, fetch_list=fetch_list, scope=scope,
             return_numpy=False,
         )
+        results.append(out)
+        unsynced += 1
         if fetch_list and print_period and i % print_period == 0:
+            _sync_window()
+            unsynced = 0
             names = fetch_info or [
                 getattr(v, "name", str(v)) for v in fetch_list
             ]
             msg = ", ".join(
-                "%s=%s" % (n, np.asarray(o).reshape(-1)[:3])
+                "%s=%s" % (n, o.numpy().reshape(-1)[:3])
                 for n, o in zip(names, out)
             )
             print("[paddle_tpu] step %d: %s" % (i, msg))
-        results.append(out)
-        if len(results) >= 2:
-            # one-step-lag host conversion: step i is dispatched, so
-            # pulling step i-1's (already computed) fetches costs no
-            # pipeline stall, and device residency stays O(1) in steps
-            results[-2] = [np.asarray(o) for o in results[-2]]
-    if results:
-        results[-1] = [np.asarray(o) for o in results[-1]]
-    return results
+        elif unsynced >= window:
+            _sync_window()
+            unsynced = 0
+    if unsynced:
+        _sync_window()
+    # contract: numpy values per step (handles are synced — free here)
+    return [pl.materialize(step) for step in results]

@@ -85,13 +85,18 @@ class ChunkEvaluator(Evaluator):
 
     def eval(self, executor, eval_program=None):
         from .executor import global_scope
+        from .pipeline import host_values
 
-        ni = float(np.asarray(global_scope().get(
-            self.num_infer_chunks.name)).reshape(-1)[0])
-        nl = float(np.asarray(global_scope().get(
-            self.num_label_chunks.name)).reshape(-1)[0])
-        nc = float(np.asarray(global_scope().get(
-            self.num_correct_chunks.name)).reshape(-1)[0])
+        # one batched device→host sync for all three accumulators —
+        # per-var np.asarray would serialize the async dispatch queue
+        # three times per eval
+        scope = global_scope()
+        ni, nl, nc = (
+            float(a.reshape(-1)[0]) for a in host_values([
+                scope.get(self.num_infer_chunks.name),
+                scope.get(self.num_label_chunks.name),
+                scope.get(self.num_correct_chunks.name),
+            ]))
         precision = nc / ni if ni else 0.0
         recall = nc / nl if nl else 0.0
         f1 = (2 * precision * recall / (precision + recall)
@@ -128,9 +133,12 @@ class EditDistance(Evaluator):
 
     def eval(self, executor, eval_program=None):
         from .executor import global_scope
+        from .pipeline import host_values
 
-        total = float(np.asarray(global_scope().get(
-            self.total_distance.name)).reshape(-1)[0])
-        n = float(np.asarray(global_scope().get(
-            self.seq_num.name)).reshape(-1)[0])
+        scope = global_scope()
+        total, n = (
+            float(a.reshape(-1)[0]) for a in host_values([
+                scope.get(self.total_distance.name),
+                scope.get(self.seq_num.name),
+            ]))
         return np.array([total / n if n else 0.0])

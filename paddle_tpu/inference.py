@@ -260,26 +260,74 @@ class AnalysisPredictor:
 
     def run(self, inputs, return_numpy=True):
         """inputs: list of numpy arrays in get_input_names() order (or a
-        dict name→array).  Returns list of numpy arrays; with
-        return_numpy=False, device arrays (no host sync — serving-style
-        callers can pipeline batches and block once at the end)."""
-        if isinstance(inputs, dict):
-            feed = dict(inputs)
-        else:
-            inputs = _as_list(inputs)
-            if len(inputs) != len(self._feed_names):
-                raise ValueError(
-                    "expected %d inputs (%s), got %d" % (
-                        len(self._feed_names), self._feed_names,
-                        len(inputs)))
-            feed = dict(zip(self._feed_names, inputs))
+        dict name→array).  Returns list of numpy arrays (ONE batched
+        device→host sync after the step is dispatched); with
+        return_numpy=False, lazy ``FetchHandle``\\ s — no host sync at
+        all until a handle is materialized, so serving-style callers can
+        keep batches in flight and block once at the end (see
+        :meth:`run_async` / :meth:`run_batches`)."""
+        feed = self._as_feed(inputs)
         with scope_guard(self._scope):
             outs = self._exe.run(self._program, feed=feed,
                                  fetch_list=self._fetch_vars,
                                  return_numpy=return_numpy)
-        if not return_numpy:
-            return list(outs)
-        return [np.asarray(o) for o in outs]
+        # numpy conversion (batched, one sync) already happened in
+        # Executor.run for return_numpy=True; handles pass through
+        return list(outs)
+
+    def _as_feed(self, inputs):
+        if isinstance(inputs, dict):
+            return dict(inputs)
+        inputs = _as_list(inputs)
+        if len(inputs) != len(self._feed_names):
+            raise ValueError(
+                "expected %d inputs (%s), got %d" % (
+                    len(self._feed_names), self._feed_names,
+                    len(inputs)))
+        return dict(zip(self._feed_names, inputs))
+
+    def run_async(self, inputs):
+        """Dispatch one batch WITHOUT waiting: returns lazy
+        ``FetchHandle``\\ s the moment the step is enqueued (the
+        NaiveExecutor-style async serving call).  Materialize with
+        ``np.asarray(handle)`` / ``handle.numpy()``, or batch many
+        handles' syncs with ``paddle_tpu.pipeline.materialize``."""
+        return self.run(inputs, return_numpy=False)
+
+    def run_batches(self, batches, max_in_flight=2, return_numpy=True):
+        """Streamed serving loop: generator yielding one result list per
+        input batch, keeping up to ``max_in_flight`` dispatched batches'
+        results un-synced while a background thread device-stages
+        upcoming feeds (``paddle_tpu.pipeline.DeviceFeedPipeline``).
+
+        ``max_in_flight`` is the latency-vs-throughput knob: 1 ≈ the
+        synchronous loop (lowest per-request latency, no overlap);
+        2-4 overlaps host prep + H2D + D2H with device compute (serving
+        throughput); larger mainly adds queueing delay.  With
+        ``return_numpy=False`` the generator yields un-synced handles
+        and never blocks on results at all."""
+        import collections
+
+        from . import pipeline as pl
+
+        if max_in_flight < 1:
+            raise ValueError("max_in_flight must be >= 1, got %d"
+                             % max_in_flight)
+
+        def feeds():
+            for b in batches:
+                yield self._as_feed(b)
+
+        def finish(handles):
+            return pl.materialize(handles) if return_numpy else handles
+
+        inflight = collections.deque()
+        for feed in pl.DeviceFeedPipeline(feeds, depth=max_in_flight):
+            inflight.append(self.run_async(feed))
+            if len(inflight) >= max_in_flight:
+                yield finish(inflight.popleft())
+        while inflight:
+            yield finish(inflight.popleft())
 
 
 def create_paddle_predictor(config):

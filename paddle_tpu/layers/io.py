@@ -89,9 +89,15 @@ def read_file(reader):
 
 
 def double_buffer(reader, place=None, name=None):
-    """reference layers/io.py double_buffer: prefetch overlap is built
-    into the PyReader pipeline; identity."""
-    return reader
+    """reference layers/io.py double_buffer: stage the reader's batches
+    on DEVICE from a background thread (depth 2, env
+    ``PADDLE_TPU_PIPELINE_DEPTH``) so H2D transfer of the next batch
+    overlaps the async-dispatched current step — the role the
+    reference's double-buffer queue + read op played.  ``place`` is
+    accepted for API parity (placement is the default device)."""
+    from .. import reader_decorators as rd
+
+    return rd.device_buffered(reader)
 
 
 def batch(reader, batch_size):

@@ -1,10 +1,24 @@
 """Python-side metric accumulators (reference:
-``python/paddle/fluid/metrics.py``)."""
+``python/paddle/fluid/metrics.py``).
+
+``update`` methods accept device arrays (e.g. un-synced fetch handles
+from ``Executor.run(..., return_numpy=False)``) and convert every
+argument in ONE batched device→host sync — per-value ``np.asarray``
+would serialize the async dispatch queue once per argument and turn an
+eval loop back into lock-step host/device alternation."""
 
 import numpy as np
 
 __all__ = ["MetricBase", "Accuracy", "CompositeMetric", "Precision",
            "Recall", "Auc", "ChunkEvaluator", "EditDistance"]
+
+
+def _host(*values):
+    """Batched device→host conversion of update() arguments (one sync
+    for all of them; pure-numpy inputs pass straight through)."""
+    from .pipeline import host_values
+
+    return host_values(values)
 
 
 class MetricBase:
@@ -46,8 +60,9 @@ class Precision(MetricBase):
         self.fp = 0.0
 
     def update(self, preds, labels):
-        preds = np.rint(np.asarray(preds)).astype(int).reshape(-1)
-        labels = np.asarray(labels).astype(int).reshape(-1)
+        preds, labels = _host(preds, labels)
+        preds = np.rint(preds).astype(int).reshape(-1)
+        labels = labels.astype(int).reshape(-1)
         self.tp += int(((preds == 1) & (labels == 1)).sum())
         self.fp += int(((preds == 1) & (labels == 0)).sum())
 
@@ -63,8 +78,9 @@ class Recall(MetricBase):
         self.fn = 0.0
 
     def update(self, preds, labels):
-        preds = np.rint(np.asarray(preds)).astype(int).reshape(-1)
-        labels = np.asarray(labels).astype(int).reshape(-1)
+        preds, labels = _host(preds, labels)
+        preds = np.rint(preds).astype(int).reshape(-1)
+        labels = labels.astype(int).reshape(-1)
         self.tp += int(((preds == 1) & (labels == 1)).sum())
         self.fn += int(((preds == 0) & (labels == 1)).sum())
 
@@ -97,8 +113,8 @@ class Auc(MetricBase):
         self._stat_neg = np.zeros(num_thresholds + 1)
 
     def update(self, preds, labels):
-        preds = np.asarray(preds)
-        labels = np.asarray(labels).reshape(-1)
+        preds, labels = _host(preds, labels)
+        labels = labels.reshape(-1)
         pos_prob = preds[:, 1] if preds.ndim == 2 else preds.reshape(-1)
         idx = np.minimum(
             (pos_prob * self._num_thresholds).astype(int),
@@ -133,9 +149,11 @@ class ChunkEvaluator(MetricBase):
 
     def update(self, num_infer_chunks, num_label_chunks,
                num_correct_chunks):
-        self.num_infer_chunks += int(np.asarray(num_infer_chunks).sum())
-        self.num_label_chunks += int(np.asarray(num_label_chunks).sum())
-        self.num_correct_chunks += int(np.asarray(num_correct_chunks).sum())
+        ni, nl, nc = _host(num_infer_chunks, num_label_chunks,
+                           num_correct_chunks)
+        self.num_infer_chunks += int(ni.sum())
+        self.num_label_chunks += int(nl.sum())
+        self.num_correct_chunks += int(nc.sum())
 
     def eval(self):
         precision = (float(self.num_correct_chunks) / self.num_infer_chunks
@@ -159,7 +177,7 @@ class EditDistance(MetricBase):
         self.instance_error = 0
 
     def update(self, distances, seq_num):
-        distances = np.asarray(distances)
+        (distances,) = _host(distances)
         self.seq_num += seq_num
         self.instance_error += int(seq_num - np.sum(distances == 0))
         self.total_distance += float(np.sum(distances))

@@ -15,9 +15,9 @@ import numpy as np
 
 from .. import core
 from ..executor import (_CompiledBlock, _apply_step_results,
-                        _host_table_prefetch, _host_table_push,
-                        global_scope, promote_readonly_scope_arrays,
-                        rng_key)
+                        _finish_fetches, _host_table_prefetch,
+                        _host_table_push, global_scope,
+                        promote_readonly_scope_arrays, rng_key)
 from ..framework import Variable, default_main_program
 
 __all__ = ["ParallelExecutor", "SPMDRunner"]
@@ -60,6 +60,9 @@ class SPMDRunner:
         self.shard_opt_state = bool(
             getattr(build_strategy, "shard_optimizer_state", False))
         self._cache = {}
+        from ..pipeline import FeedCache
+
+        self._feed_cache = FeedCache()
 
     def run(self, executor, feed, fetch_list, scope, return_numpy):
         import jax
@@ -98,7 +101,18 @@ class SPMDRunner:
                 for n, v in feed.items()
             }
         else:
-            feed_vals = {n: jnp.asarray(v) for n, v in feed.items()}
+            # same placement cache as Executor.run: an identical host
+            # array re-fed across steps transfers once (the partitioner
+            # re-shards the staged array on later dispatches)
+            from ..pipeline import FetchHandle, _stage
+
+            feed_vals = {}
+            for n, v in feed.items():
+                if isinstance(v, FetchHandle):
+                    v = v.device_value  # chained lazy fetch
+                feed_vals[n] = (
+                    _stage(v, name=n, cache=self._feed_cache)
+                    if isinstance(v, np.ndarray) else jnp.asarray(v))
         # host-resident tables under DP: prefetch the GLOBAL batch's
         # slab (GSPMD shards it over the data axis like any feed)
         if (getattr(self.program, "_host_tables", None)
@@ -154,9 +168,7 @@ class SPMDRunner:
         fetches = _apply_step_results(
             compiled, scope, fetches, new_rw, fresh, fetch_names,
             host_active, host_grad_fetches, cur_step)
-        if return_numpy:
-            return [np.asarray(v) for v in fetches]
-        return list(fetches)
+        return _finish_fetches(fetches, return_numpy)
 
 
 class ParallelExecutor:

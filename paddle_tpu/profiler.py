@@ -5,10 +5,12 @@ Reference surfaces reproduced:
   run, thread-local ``EventList``, ``EnableProfiler/DisableProfiler``
   printing tables aggregated by total/max/ave/calls.  Here host events
   come from ``record_event`` scopes and the Executor's phase hooks
-  (lower/compile/execute) — per-op host timing does not exist under a
-  whole-block jit, so phases are the host-side unit of accounting (the
-  per-op cost lives in the device trace, which XLA annotates with HLO op
-  names).
+  (``executor.lower_and_jit`` / ``executor.dispatch`` /
+  ``executor.device_compute`` / ``executor.host_sync`` — the async-
+  dispatch split :func:`host_event_stats` documents) — per-op host
+  timing does not exist under a whole-block jit, so phases are the
+  host-side unit of accounting (the per-op cost lives in the device
+  trace, which XLA annotates with HLO op names).
 * ``tools/timeline.py:115-161`` — chrome://tracing JSON; written directly
   by ``stop_profiler`` from the recorded host events.
 * device side: ``jax.profiler`` (XPlane → TensorBoard), the CUPTI
@@ -25,7 +27,8 @@ import time
 
 __all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
            "record_event", "cuda_profiler", "is_profiler_enabled",
-           "attribute_op_name", "device_op_stats", "device_op_events"]
+           "attribute_op_name", "device_op_stats", "device_op_events",
+           "host_event_stats"]
 
 _trace_dir = None
 _enabled = False
@@ -67,6 +70,22 @@ def _aggregate():
         row[2] = max(row[2], dt)
         row[3] = dt if row[3] is None else min(row[3], dt)
     return table
+
+
+def host_event_stats():
+    """Aggregated host events while profiling is (or was) on:
+    ``{name: {"calls", "total_ms", "max_ms", "min_ms"}}``.  The executor
+    splits every run into ``executor.dispatch`` (enqueue under async
+    dispatch), ``executor.device_compute`` (waiting for the in-flight
+    step at a sync point) and ``executor.host_sync`` (D2H copies) — so
+    ``dispatch ≪ device_compute`` in a profile means the loop overlaps,
+    while a large per-step ``host_sync`` total flags a loop that blocks
+    every iteration (the r05 infer pathology)."""
+    return {
+        name: {"calls": calls, "total_ms": total, "max_ms": mx,
+               "min_ms": mn or 0.0}
+        for name, (calls, total, mx, mn) in _aggregate().items()
+    }
 
 
 def _print_summary(sorted_key):

@@ -4,12 +4,16 @@
 TPU-native: a double-buffered background-thread prefetcher that overlaps
 host batch assembly + H2D transfer with device compute — the role the
 reference's blocking queue + read op play, without graph-side reader ops.
-In-process batches pass by REFERENCE through a bounded queue.Queue (its
-condition variables already release the GIL during waits; serializing
-numpy batches here would only add copies).  The native byte-buffer queue
-(``native.BlockingQueue``, blocking_queue.cc) serves the
-serialized-batch/multi-process role of the reference's
-LoDTensorBlockingQueue instead."""
+With ``use_double_buffer`` (the default, matching the reference's
+double_buffer decorator) the prefetch thread additionally
+``jax.device_put``\\ s each staged batch via ``paddle_tpu.pipeline``, so
+the Executor's async dispatch never pays per-step H2D latency; depth is
+``PADDLE_TPU_PIPELINE_DEPTH`` (default 2).  In-process batches pass by
+REFERENCE through a bounded queue.Queue (its condition variables already
+release the GIL during waits; serializing numpy batches here would only
+add copies).  The native byte-buffer queue (``native.BlockingQueue``,
+blocking_queue.cc) serves the serialized-batch/multi-process role of the
+reference's LoDTensorBlockingQueue instead."""
 
 import queue as _queue
 import threading
@@ -62,6 +66,31 @@ class _Prefetcher:
             yield item
 
 
+class _DeviceStagedPrefetcher:
+    """Two-stage prefetch: ``capacity`` host batches buffered by the
+    classic background thread (the user's knob, unchanged), with the
+    device pipeline staging the front ``PADDLE_TPU_PIPELINE_DEPTH`` of
+    them via ``jax.device_put`` — deep host buffering rides out jittery
+    sample generators while device residency stays bounded."""
+
+    def __init__(self, gen_fn, capacity):
+        from .pipeline import DeviceFeedPipeline
+
+        self._host = _Prefetcher(gen_fn, capacity)
+        self._dev = DeviceFeedPipeline(lambda: iter(self._host))
+
+    def start(self):
+        self._host.start()
+        self._dev.start()
+
+    def stop(self):
+        self._dev.stop()
+        self._host.stop()
+
+    def __iter__(self):
+        return iter(self._dev)
+
+
 class PyReader:
     """Iterable/decorated reader (reference reader.py:46).  Use
     ``decorate_sample_list_generator``/``decorate_batch_generator`` then
@@ -72,6 +101,7 @@ class PyReader:
         self._feed_list = feed_list or []
         self._capacity = capacity
         self._iterable = iterable
+        self._use_double_buffer = bool(use_double_buffer)
         self._prefetcher = None
         self._feeder = None
 
@@ -121,7 +151,11 @@ class PyReader:
         return self.decorate_sample_list_generator(batched, places)
 
     def start(self):
-        self._prefetcher = _Prefetcher(self._gen, self._capacity)
+        if self._use_double_buffer:
+            self._prefetcher = _DeviceStagedPrefetcher(
+                self._gen, self._capacity)
+        else:
+            self._prefetcher = _Prefetcher(self._gen, self._capacity)
         self._prefetcher.start()
 
     def reset(self):

@@ -15,8 +15,8 @@ import threading
 
 __all__ = [
     "cache", "map_readers", "shuffle", "chain", "compose", "buffered",
-    "firstn", "xmap_readers", "batch", "ComposeNotAligned",
-    "multiprocess_reader", "Fake", "PipeReader",
+    "device_buffered", "firstn", "xmap_readers", "batch",
+    "ComposeNotAligned", "multiprocess_reader", "Fake", "PipeReader",
     "np_array", "text_file", "recordio",
 ]
 
@@ -140,6 +140,23 @@ def buffered(reader, size):
             if isinstance(e, BaseException):
                 raise e
             yield e
+
+    return data_reader
+
+
+def device_buffered(reader, size=None):
+    """Background-thread prefetch that ALSO stages each item's numpy
+    arrays on device (``jax.device_put`` off the consumer thread) — the
+    TPU-native ``double_buffer``: batch k+1's H2D transfer overlaps the
+    async-dispatched step k.  ``size`` defaults to
+    ``PADDLE_TPU_PIPELINE_DEPTH`` (2).  Items may be dicts (feed
+    name→array; placement cached for repeated arrays), tuples/lists of
+    arrays, or bare arrays; non-array leaves pass through.  Reader
+    exceptions propagate to the consumer (the ``buffered`` contract)."""
+    from .pipeline import DeviceFeedPipeline
+
+    def data_reader():
+        return iter(DeviceFeedPipeline(reader, depth=size))
 
     return data_reader
 

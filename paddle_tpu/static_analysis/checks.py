@@ -56,6 +56,12 @@ degenerate-sharding         WARNING   var marked sharded over parts the
 oversized-replicated-       WARNING   replicated persistable larger
 persistable                           than the replication budget on a
                                       multi-worker program — shard it
+executor-host-sync-in-loop  INFO      host-IO op (save/load/...) in
+                                      the hot loop — a while/recurrent
+                                      body, or the per-step program of
+                                      a training run — forces a device
+                                      sync every iteration and defeats
+                                      async dispatch overlap
 ==========================  ========  ====================================
 """
 
@@ -577,6 +583,84 @@ def check_resilience_finite_guard(ctx):
         var_names=(loss,) if loss else tuple(ctx.targets),
         hint="set PADDLE_TPU_NAN_GUARD=1 (or program._nan_guard=True) so "
              "non-finite steps are skipped, counted and warned about")
+
+
+# loop-body ops: their sub_block re-runs per iteration, so host IO
+# inside costs one sync per ITERATION, not per step.  The host-IO op
+# roster itself comes from cost.HOST_IO_OP_TYPES (one source of truth,
+# derived from the executor's ops/io_ops list; `print` is jitted via
+# jax.debug.print and deliberately absent).
+_LOOP_BODY_OPS = ("while", "recurrent")
+
+
+@register_check("executor-host-sync-in-loop")
+def check_executor_host_sync_in_loop(ctx):
+    """Advisory: host-IO ops in a hot loop serialize async dispatch.
+
+    Two shapes (both INFO — sometimes a per-step save is the point):
+
+    * a host-IO op inside a ``while``/``recurrent`` sub-block (or any
+      block nested under one) — every loop iteration would bounce to
+      the host;
+    * a host-IO op in the global block of a TRAINING program — the
+      per-step program IS the hot loop, so each ``Executor.run`` pays a
+      full pipeline drain around the jitted step, exactly the per-batch
+      sync latency the async fetch-handle path exists to remove.
+    """
+    from .cost import HOST_IO_OP_TYPES
+
+    program = ctx.program
+
+    def loop_block_idxs():
+        """Block indices reachable through a while/recurrent sub_block."""
+        seen = set()
+        stack = []
+        for block in program.blocks:
+            for op in block.ops:
+                if op.type in _LOOP_BODY_OPS:
+                    inner = resolve_sub_block(program, op,
+                                              host_block_idx=block.idx)
+                    if inner is not None:
+                        stack.append(inner)
+        while stack:
+            b = stack.pop()
+            if b.idx in seen:
+                continue
+            seen.add(b.idx)
+            for op in b.ops:
+                inner = resolve_sub_block(program, op,
+                                          host_block_idx=b.idx)
+                if inner is not None:
+                    stack.append(inner)
+        return seen
+
+    in_loop = loop_block_idxs()
+    is_training = any(
+        op.type.endswith("_grad") or op.attrs.get("op_role") == "optimize"
+        for _, _, op in ctx.graph.order)
+    for block_idx, op_idx, op in ctx.graph.order:
+        if op.type not in HOST_IO_OP_TYPES:
+            continue
+        if block_idx in in_loop:
+            yield ctx.diag(
+                "executor-host-sync-in-loop", Severity.INFO,
+                "host-IO op %r inside a while/recurrent body forces a "
+                "device sync every loop iteration" % op.type,
+                block_idx=block_idx, op_idx=op_idx, op=op,
+                hint="hoist the IO out of the loop (checkpoint/print at "
+                     "step boundaries) so the loop stays one dispatch")
+        elif block_idx == 0 and is_training:
+            yield ctx.diag(
+                "executor-host-sync-in-loop", Severity.INFO,
+                "host-IO op %r in a training program's global block "
+                "forces a per-step host sync around the jitted step"
+                % op.type,
+                block_idx=block_idx, op_idx=op_idx, op=op,
+                hint="run IO from a separate program at "
+                     "checkpoint/print_period boundaries; keep the "
+                     "per-step program pure so async dispatch "
+                     "(return_numpy=False fetch handles, "
+                     "DeviceFeedPipeline feeds) can overlap steps")
 
 
 # ---------------------------------------------------------------------------

@@ -37,7 +37,8 @@ from .interp import interpret_program
 __all__ = [
     "OpCost", "CostReport", "estimate_cost", "register_flops",
     "collective_ici_bytes", "dtype_bytes", "parse_size", "hbm_budget",
-    "COLLECTIVE_OP_TYPES", "P2P_OP_TYPES",
+    "sync_latency_ms", "COLLECTIVE_OP_TYPES", "P2P_OP_TYPES",
+    "HOST_IO_OP_TYPES",
 ]
 
 _DTYPE_BYTES = {
@@ -60,6 +61,26 @@ def parse_size(text):
         mult = 1024 ** ("KMGT".index(s[-1].upper()) + 1)
         s = s[:-1]
     return int(float(s) * mult)
+
+
+def sync_latency_ms():
+    """Assumed cost of one device→host sync (``PADDLE_TPU_SYNC_LATENCY_MS``,
+    default 1.0 ms) — the knob behind the static dispatch-overhead
+    estimate; set it to the deployment's measured round-trip latency."""
+    try:
+        return float(os.environ.get("PADDLE_TPU_SYNC_LATENCY_MS", "1.0"))
+    except ValueError:
+        return 1.0
+
+
+# host-IO op types executed host-side around the jitted step; each one
+# is a per-step sync point in the executor's async dispatch loop.
+# Derived from the executor's own roster (ops/io_ops.py) so a new host
+# op is counted here automatically; NOT `print` — that lowers to
+# jax.debug.print inside the jit and never drains the dispatch queue.
+from ..ops.io_ops import HOST_IO_OP_TYPES as _EXEC_HOST_IO_OP_TYPES
+
+HOST_IO_OP_TYPES = frozenset(_EXEC_HOST_IO_OP_TYPES)
 
 
 def hbm_budget(program=None):
@@ -215,7 +236,8 @@ class CostReport:
     """Whole-program totals + the per-op breakdown behind them."""
 
     def __init__(self, program, op_costs, peak_memory_bytes,
-                 persistent_bytes, nranks, batch_size, budget=None):
+                 persistent_bytes, nranks, batch_size, budget=None,
+                 host_sync_points=0):
         self.program = program
         self.op_costs = op_costs
         self.peak_memory_bytes = int(peak_memory_bytes)
@@ -223,6 +245,18 @@ class CostReport:
         self.nranks = nranks
         self.batch_size = batch_size
         self.hbm_budget = budget
+        # per-step host sync points: host-IO ops the Executor runs
+        # around the jitted step (save/load/print) + one for the fetch
+        # materialization itself — each drains the async dispatch queue
+        self.host_sync_points = int(host_sync_points)
+
+    @property
+    def dispatch_overhead_ms(self):
+        """Estimated per-step host-sync overhead: ``host_sync_points ×
+        PADDLE_TPU_SYNC_LATENCY_MS`` (default 1.0 ms; set it to the
+        measured round-trip of the deployment — e.g. ~70 ms over the
+        axon tunnel — to project the cost of a sync-per-step loop)."""
+        return self.host_sync_points * sync_latency_ms()
 
     @property
     def total_flops(self):
@@ -262,6 +296,8 @@ class CostReport:
                 str(k): v for k, v in self.ici_bytes_per_ring().items()},
             "peak_memory_bytes": self.peak_memory_bytes,
             "persistent_bytes": self.persistent_bytes,
+            "host_sync_points": self.host_sync_points,
+            "dispatch_overhead_ms": self.dispatch_overhead_ms,
             "hbm_budget": self.hbm_budget,
             "nranks": self.nranks,
             "batch_size": self.batch_size,
@@ -281,6 +317,12 @@ class CostReport:
             ("static_program_ici_bytes", self.total_ici_bytes, "bytes"),
             ("static_program_peak_memory", self.peak_memory_bytes,
              "bytes"),
+            ("static_host_sync_points", self.host_sync_points,
+             "syncs/step"),
+            ("static_dispatch_overhead_ms",
+             round(self.dispatch_overhead_ms, 3),
+             "ms/step est. (host_sync_points x "
+             "PADDLE_TPU_SYNC_LATENCY_MS)"),
         ]
         return "\n".join(
             json.dumps({"metric": m, "value": v, "unit": u + unit_suffix})
@@ -305,6 +347,8 @@ class CostReport:
                     self.hbm_budget,
                     "EXCEEDED" if self.over_budget else "ok")
                 if self.hbm_budget is not None else ""),
+            "  host syncs/step %16d  (est. %.1f ms dispatch overhead)"
+            % (self.host_sync_points, self.dispatch_overhead_ms),
         ]
         ranked = sorted(self.op_costs, key=lambda c: -c.flops)[:top]
         if ranked and ranked[0].flops:
@@ -402,5 +446,16 @@ def estimate_cost(program, interp=None, targets=(), nranks=None,
         peak_live = max(peak_live, running)
     peak = persistent_bytes + peak_live
 
+    # per-step host sync points: host-IO ops in the global block (the
+    # Executor runs them host-side around the jit, draining the async
+    # dispatch queue each step) + one sync for materializing the fetch
+    # targets themselves (batched — the single-sync-point contract)
+    host_syncs = sum(
+        1 for op in program.global_block().ops
+        if op.type in HOST_IO_OP_TYPES)
+    if targets:
+        host_syncs += 1
+
     return CostReport(program, op_costs, peak, persistent_bytes,
-                      nranks, interp.batch_size, budget=budget)
+                      nranks, interp.batch_size, budget=budget,
+                      host_sync_points=host_syncs)

@@ -82,4 +82,10 @@ def test_example_cost_baselines_are_nonzero():
     assert report.cost.peak_memory_bytes > report.cost.persistent_bytes
     assert report.cost.persistent_bytes > 0
     lines = report.cost.bench_json().splitlines()
-    assert len(lines) == 5
+    assert len(lines) == 7
+    import json as _json
+
+    metrics = {_json.loads(l)["metric"] for l in lines}
+    # the async-dispatch additions ride in the same BENCH stream
+    assert "static_host_sync_points" in metrics
+    assert "static_dispatch_overhead_ms" in metrics

@@ -1,0 +1,512 @@
+"""Async dispatch pipeline (ISSUE 4): lazy fetch handles, the
+single-sync-point return_numpy path, device-resident double-buffered
+feeds, the streamed predictor, and their interaction with the NaN
+step-guard — all bit-exact against the synchronous paths (the async
+plumbing must never change a numeric result, only when the host waits).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import pipeline as pl
+from paddle_tpu.executor import FetchHandle, Scope, scope_guard
+from paddle_tpu.inference import (AnalysisConfig, create_paddle_predictor)
+
+BATCHES = 6
+BS = 16
+
+
+def build_mlp():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=16, act="relu")
+        pred = fluid.layers.fc(h, size=4, act="softmax")
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.cross_entropy(input=pred, label=y))
+        test_prog = main.clone(for_test=True)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, test_prog, loss
+
+
+def make_batches(n=BATCHES, bs=BS, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{"x": rng.randn(bs, 8).astype("float32"),
+             "y": rng.randint(0, 4, (bs, 1)).astype("int64")}
+            for _ in range(n)]
+
+
+def run_sync(main, startup, loss, batches):
+    """Reference path: blocking numpy fetch per step, plain feeds."""
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = Scope()
+    with scope_guard(scope):
+        exe.run(startup)
+        losses = [exe.run(main, feed=b, fetch_list=[loss])[0]
+                  for b in batches]
+    return losses, scope
+
+
+def scope_params(scope):
+    return {n: np.asarray(scope.get(n)) for n in sorted(scope.vars)
+            if scope.get(n) is not None}
+
+
+class TestAsyncTrainBitExact:
+    @pytest.mark.parametrize("depth", [1, 2, 4])
+    def test_async_loop_matches_sync(self, depth):
+        """Device-pipelined feeds + lazy fetch handles at depth 1/2/4
+        produce bit-identical losses AND parameters (same compiled
+        step, same inputs — the async path only changes when the host
+        blocks)."""
+        main, startup, _, loss = build_mlp()
+        batches = make_batches()
+        ref_losses, ref_scope = run_sync(main, startup, loss, batches)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = Scope()
+        with scope_guard(scope):
+            exe.run(startup)
+            handles = []
+            for feed in pl.DeviceFeedPipeline(iter(batches), depth=depth):
+                (h,) = exe.run(main, feed=feed, fetch_list=[loss],
+                               return_numpy=False)
+                handles.append(h)
+            got = pl.materialize(handles)
+        for a, b in zip(ref_losses, got):
+            np.testing.assert_array_equal(a, b)
+        ref_params = scope_params(ref_scope)
+        got_params = scope_params(scope)
+        assert set(ref_params) == set(got_params)
+        for n, v in ref_params.items():
+            np.testing.assert_array_equal(v, got_params[n], err_msg=n)
+
+    def test_return_numpy_true_single_sync(self):
+        """The return_numpy=True path issues ONE batched sync after the
+        whole step is dispatched — not one per fetch value."""
+        main, startup, _, loss = build_mlp()
+        (batch,) = make_batches(1)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            exe.run(startup)
+            exe.run(main, feed=batch, fetch_list=[loss])  # warm the jit
+            pl.reset_sync_stats()
+            outs = exe.run(main, feed=batch, fetch_list=[loss, loss, loss])
+        assert pl.sync_stats()["syncs"] == 1
+        assert all(isinstance(o, np.ndarray) for o in outs)
+
+
+class TestFetchHandleLaziness:
+    def test_no_sync_until_materialized(self):
+        main, startup, _, loss = build_mlp()
+        (batch,) = make_batches(1)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            exe.run(startup)
+            pl.reset_sync_stats()
+            (h,) = exe.run(main, feed=batch, fetch_list=[loss],
+                           return_numpy=False)
+            assert isinstance(h, FetchHandle)
+            assert not h.synced
+            # shape/dtype/repr/block_until_ready never sync
+            assert h.shape == (1,)
+            assert "in-flight" in repr(h) or "synced" in repr(h)
+            h.block_until_ready()
+            assert pl.sync_stats()["syncs"] == 0
+            v = np.asarray(h)
+        assert h.synced
+        assert pl.sync_stats()["syncs"] == 1
+        assert np.isfinite(v).all()
+        # cached: a second read is free
+        np.testing.assert_array_equal(v, h.numpy())
+        assert pl.sync_stats()["syncs"] == 1
+
+    def test_fetch_handle_feeds_next_run(self):
+        """A previous run's un-synced FetchHandle can be fed straight
+        into another program — chaining stays on device (the raw-device-
+        array contract of the pre-handle return_numpy=False path)."""
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[8], dtype="float32")
+            out = fluid.layers.scale(x, scale=2.0)
+        p2 = fluid.Program()
+        with fluid.program_guard(p2, fluid.Program()):
+            x2 = fluid.layers.data("x", shape=[8], dtype="float32")
+            out2 = fluid.layers.scale(x2, scale=3.0)
+        xv = np.arange(16, dtype="float32").reshape(2, 8)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            (h,) = exe.run(main, feed={"x": xv}, fetch_list=[out],
+                           return_numpy=False)
+            (r,) = exe.run(p2, feed={"x": h}, fetch_list=[out2])
+        np.testing.assert_array_equal(r, xv * 6.0)
+
+    def test_materialize_batches_many_handles_in_one_sync(self):
+        main, startup, _, loss = build_mlp()
+        batches = make_batches(4)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            exe.run(startup)
+            handles = [exe.run(main, feed=b, fetch_list=[loss],
+                               return_numpy=False)[0] for b in batches]
+            pl.reset_sync_stats()
+            vals = pl.materialize(handles)
+        assert pl.sync_stats()["syncs"] == 1
+        assert len(vals) == 4 and all(isinstance(v, np.ndarray)
+                                      for v in vals)
+
+
+class TestAsyncInference:
+    def _export_predictor(self, tmp_path):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[6], dtype="float32")
+            out = fluid.layers.fc(x, size=3, act="softmax")
+        exe = fluid.Executor(fluid.CPUPlace())
+        d = str(tmp_path / "m")
+        with scope_guard(Scope()):
+            exe.run(startup)
+            fluid.io.save_inference_model(d, ["x"], [out], exe,
+                                          main_program=main)
+        return create_paddle_predictor(AnalysisConfig(d))
+
+    def test_run_async_bit_exact(self, tmp_path):
+        pred = self._export_predictor(tmp_path)
+        xv = np.random.RandomState(0).randn(4, 6).astype("float32")
+        (ref,) = pred.run([xv])
+        handles = pred.run_async([xv])
+        assert isinstance(handles[0], FetchHandle)
+        assert not handles[0].synced
+        np.testing.assert_array_equal(ref, np.asarray(handles[0]))
+
+    @pytest.mark.parametrize("k", [1, 2, 4])
+    def test_run_batches_streams_in_order(self, tmp_path, k):
+        pred = self._export_predictor(tmp_path)
+        rng = np.random.RandomState(1)
+        batches = [[rng.randn(4, 6).astype("float32")] for _ in range(5)]
+        refs = [pred.run(b)[0] for b in batches]
+        outs = list(pred.run_batches(batches, max_in_flight=k))
+        assert len(outs) == len(batches)
+        for r, o in zip(refs, outs):
+            np.testing.assert_array_equal(r, o[0])
+
+    def test_run_batches_lazy_mode(self, tmp_path):
+        pred = self._export_predictor(tmp_path)
+        rng = np.random.RandomState(2)
+        batches = [[rng.randn(4, 6).astype("float32")] for _ in range(3)]
+        outs = list(pred.run_batches(batches, max_in_flight=2,
+                                     return_numpy=False))
+        assert all(isinstance(o[0], FetchHandle) for o in outs)
+        vals = pl.materialize([o[0] for o in outs])
+        refs = [pred.run(b)[0] for b in batches]
+        for r, v in zip(refs, vals):
+            np.testing.assert_array_equal(r, v)
+
+
+class TestExceptionPropagation:
+    def test_prefetch_thread_exception_reaches_consumer(self):
+        """A reader that dies mid-epoch must raise in the consumer, not
+        hang the queue (the buffered-decorator contract, across the
+        device-staging thread)."""
+        batches = make_batches(3)
+
+        def bad_source():
+            yield batches[0]
+            yield batches[1]
+            raise ValueError("reader exploded")
+
+        seen = []
+        with pytest.raises(ValueError, match="reader exploded"):
+            for feed in pl.DeviceFeedPipeline(bad_source):
+                seen.append(feed)
+        assert len(seen) == 2
+
+    def test_failed_in_flight_step_raises_without_corrupting(self):
+        """A bad batch raises at ITS dispatch; handles from earlier
+        in-flight steps still materialize."""
+        main, startup, _, loss = build_mlp()
+        (good,) = make_batches(1)
+        bad = {"x": good["x"][:, :5], "y": good["y"]}  # wrong feature dim
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            exe.run(startup)
+            (h,) = exe.run(main, feed=good, fetch_list=[loss],
+                           return_numpy=False)
+            with pytest.raises(ValueError, match="declares"):
+                exe.run(main, feed=bad, fetch_list=[loss],
+                        return_numpy=False)
+            assert np.isfinite(np.asarray(h)).all()
+
+
+class TestNanGuardInteraction:
+    def test_guard_skips_nan_step_in_async_loop(self, monkeypatch):
+        """The resilience step-guard still works under async dispatch:
+        its scalar finite flag is the ONE per-step sync, a NaN batch's
+        update is skipped bit-exactly, and the loop's fetch handles
+        stay materializable."""
+        from paddle_tpu.resilience import guard
+
+        monkeypatch.delenv("PADDLE_TPU_NAN_GUARD", raising=False)
+        main, startup, _, loss = build_mlp()
+        main._nan_guard = True
+        batches = make_batches(3)
+        nan_batch = {"x": np.full((BS, 8), np.nan, "float32"),
+                     "y": batches[0]["y"]}
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = Scope()
+        guard.stats.reset()
+        with scope_guard(scope):
+            exe.run(startup)
+            (h0,) = exe.run(main, feed=batches[0], fetch_list=[loss],
+                            return_numpy=False)
+            params_before = scope_params(scope)
+            with pytest.warns(guard.NonFiniteStepWarning):
+                (h1,) = exe.run(main, feed=nan_batch, fetch_list=[loss],
+                                return_numpy=False)
+            params_after = scope_params(scope)
+            (h2,) = exe.run(main, feed=batches[1], fetch_list=[loss],
+                            return_numpy=False)
+            l0, l1, l2 = pl.materialize([h0, h1, h2])
+        assert guard.stats.skipped_steps == 1
+        assert np.isfinite(l0).all() and np.isfinite(l2).all()
+        assert np.isnan(l1).all()
+        for n, v in params_before.items():
+            np.testing.assert_array_equal(v, params_after[n], err_msg=n)
+
+
+class TestDeviceFeeds:
+    def test_device_buffered_stages_arrays(self):
+        """double_buffer / device_buffered move ndarray leaves to device
+        on the prefetch thread; structure and values survive."""
+        from paddle_tpu import reader_decorators as rd
+
+        def reader():
+            for i in range(3):
+                yield (np.full((2, 2), i, "float32"), i)
+
+        items = list(fluid.layers.double_buffer(
+            rd.buffered(reader, 2))())
+        assert len(items) == 3
+        for i, (arr, scalar) in enumerate(items):
+            assert not isinstance(arr, np.ndarray)  # device-resident
+            np.testing.assert_array_equal(np.asarray(arr),
+                                          np.full((2, 2), i, "float32"))
+            assert scalar == i
+
+    def test_pyreader_double_buffer_feeds_executor(self):
+        main, startup, _, loss = build_mlp()
+        batches = make_batches(3)
+        reader = fluid.reader.PyReader(feed_list=[], capacity=4,
+                                       use_double_buffer=True)
+        reader.decorate_batch_generator(lambda: iter(batches))
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            exe.run(startup)
+            losses = []
+            for feed in reader:
+                assert not isinstance(feed["x"], np.ndarray)
+                losses.append(exe.run(main, feed=feed,
+                                      fetch_list=[loss])[0])
+        assert len(losses) == 3 and all(np.isfinite(l).all()
+                                        for l in losses)
+
+    def test_feed_cache_reuses_placement(self):
+        """The SAME host array re-fed across steps (a constant mask, a
+        bench batch) transfers once: the executor's placement cache
+        returns the identical device array."""
+        main, startup, _, loss = build_mlp()
+        (batch,) = make_batches(1)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            exe.run(startup)
+            exe.run(main, feed=batch, fetch_list=[loss])
+            dev1 = exe._feed_cache.get("x", batch["x"])
+            assert dev1 is not None
+            exe.run(main, feed=batch, fetch_list=[loss])
+            assert exe._feed_cache.get("x", batch["x"]) is dev1
+            # a DIFFERENT array with equal contents must NOT hit
+            assert exe._feed_cache.get("x", batch["x"].copy()) is None
+            # an IN-PLACE mutation of the cached buffer must not serve
+            # stale data: the content fingerprint turns it into a miss
+            batch["x"][:] = batch["x"] + 1.0
+            assert exe._feed_cache.get("x", batch["x"]) is None
+            (l2,) = exe.run(main, feed=batch, fetch_list=[loss])
+            assert np.isfinite(l2).all()
+
+    def test_materialize_releases_device_buffer(self):
+        """A synced handle drops its device reference — windowed loops
+        hold device memory O(un-synced window), not O(steps)."""
+        main, startup, _, loss = build_mlp()
+        (batch,) = make_batches(1)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            exe.run(startup)
+            (h,) = exe.run(main, feed=batch, fetch_list=[loss],
+                           return_numpy=False)
+            assert not isinstance(h.device_value, np.ndarray)
+            v = h.numpy()
+            assert h._dev is None  # device buffer released
+            assert isinstance(h.device_value, np.ndarray)
+            np.testing.assert_array_equal(v, h.numpy())  # still cached
+            assert h.shape == (1,)  # metadata survives the release
+
+    def test_abandoned_iteration_unblocks_worker(self):
+        """Breaking out of the loop early must release the prefetch
+        thread (it parks in a bounded-queue put) and its staged
+        batches, not leak them for the process lifetime."""
+        import threading
+        import time
+
+        produced = []
+
+        def source():
+            for i in range(100):
+                produced.append(i)
+                yield {"x": np.zeros((2, 2), "float32")}
+
+        pipe = pl.DeviceFeedPipeline(source, depth=2)
+        for _ in pipe:
+            break  # abandon with the worker mid-stream
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            if not any(t.name == "paddle_tpu-device-feed" and t.is_alive()
+                       for t in threading.enumerate()):
+                break
+            time.sleep(0.05)
+        assert not any(t.name == "paddle_tpu-device-feed" and t.is_alive()
+                       for t in threading.enumerate())
+        assert len(produced) < 100  # stopped early, not fully drained
+
+    def test_pipeline_depth_env(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_PIPELINE_DEPTH", "4")
+        assert pl.pipeline_depth() == 4
+        monkeypatch.setenv("PADDLE_TPU_PIPELINE_DEPTH", "0")
+        assert pl.pipeline_depth() == 1  # floor
+        monkeypatch.delenv("PADDLE_TPU_PIPELINE_DEPTH")
+        assert pl.pipeline_depth() == 2  # default
+
+
+class TestMetricsBatchedSync:
+    def test_metrics_accept_device_values(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu import metrics
+
+        m = metrics.Precision()
+        m.update(jnp.asarray([1.0, 0.0, 1.0, 1.0]),
+                 jnp.asarray([1, 0, 0, 1]))
+        assert m.eval() == pytest.approx(2.0 / 3.0)
+        r = metrics.Recall()
+        r.update(np.array([1.0, 0.0]), np.array([1, 1]))  # numpy still ok
+        assert r.eval() == pytest.approx(0.5)
+
+
+class TestHostSyncLint:
+    def test_save_in_training_program_flagged(self):
+        main, startup, _, loss = build_mlp()
+        param = next(n for n in main.global_block().vars
+                     if n.startswith("fc_") and n.endswith(".w_0"))
+        main.global_block().append_op(
+            type="save", inputs={"X": [param]}, outputs={},
+            attrs={"file_path": "/tmp/x.npy"})
+        diags = main.lint(targets=[loss.name])
+        hits = [d for d in diags
+                if d.check == "executor-host-sync-in-loop"]
+        assert hits, [d.check for d in diags]
+        assert "per-step host sync" in hits[0].message
+
+    def test_save_in_while_body_flagged(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            i = fluid.layers.fill_constant([1], "float32", 0.0)
+            limit = fluid.layers.fill_constant([1], "float32", 4.0)
+            cond = fluid.layers.less_than(i, limit)
+            w = fluid.layers.While(cond)
+            with w.block():
+                fluid.layers.increment(i, value=1.0, in_place=True)
+                fluid.default_main_program().current_block().append_op(
+                    type="save", inputs={"X": [i.name]}, outputs={},
+                    attrs={"file_path": "/tmp/x.npy"})
+                fluid.layers.less_than(i, limit, cond=cond)
+        diags = main.lint()
+        hits = [d for d in diags
+                if d.check == "executor-host-sync-in-loop"]
+        assert hits
+        assert "loop iteration" in hits[0].message
+
+    def test_clean_inference_program_not_flagged(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            out = fluid.layers.fc(x, size=2)
+        diags = main.lint(targets=[out.name])
+        assert not [d for d in diags
+                    if d.check == "executor-host-sync-in-loop"]
+
+
+class TestCostDispatchOverhead:
+    def test_host_sync_points_and_bench_json(self, monkeypatch):
+        import json
+
+        monkeypatch.setenv("PADDLE_TPU_SYNC_LATENCY_MS", "2.5")
+        main, startup, _, loss = build_mlp()
+        param = next(n for n in main.global_block().vars
+                     if n.startswith("fc_") and n.endswith(".w_0"))
+        main.global_block().append_op(
+            type="save", inputs={"X": [param]}, outputs={},
+            attrs={"file_path": "/tmp/x.npy"})
+        rep = main.analyze(targets=[loss.name])
+        # one save op + one fetch materialization
+        assert rep.cost.host_sync_points == 2
+        assert rep.cost.dispatch_overhead_ms == pytest.approx(5.0)
+        lines = [json.loads(l) for l in rep.cost.bench_json().splitlines()]
+        metrics = {l["metric"]: l["value"] for l in lines}
+        assert metrics["static_host_sync_points"] == 2
+        assert metrics["static_dispatch_overhead_ms"] == pytest.approx(5.0)
+
+
+class TestDatasetRuntimeContract:
+    def test_train_from_dataset_returns_numpy(self, tmp_path):
+        """run_from_dataset drives the device pipeline + fetch handles
+        internally but still returns numpy per step (and stays
+        bit-exact across print windows)."""
+        from paddle_tpu.dataset import DatasetFactory
+
+        f = tmp_path / "part-0"
+        rng = np.random.RandomState(0)
+        lines = []
+        for _ in range(24):
+            label = rng.randint(0, 2)
+            feat = " ".join("%.4f" % v for v in rng.randn(4))
+            lines.append("1 %d 4 %s" % (label, feat))
+        f.write_text("\n".join(lines) + "\n")
+
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            label = fluid.layers.data("label", shape=[1], dtype="int64")
+            dense = fluid.layers.data("dense", shape=[4],
+                                      dtype="float32")
+            logit = fluid.layers.fc(dense, size=1)
+            loss = fluid.layers.mean(
+                fluid.layers.sigmoid_cross_entropy_with_logits(
+                    logit, fluid.layers.cast(label, "float32")))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+        ds = DatasetFactory().create_dataset("InMemoryDataset")
+        ds.set_use_var([label, dense])
+        ds.set_batch_size(8)
+        ds.set_filelist([str(f)])
+        ds.load_into_memory()
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            exe.run(startup)
+            results = exe.train_from_dataset(
+                program=main, dataset=ds, fetch_list=[loss],
+                print_period=2)
+        assert len(results) == 3  # 24 / 8
+        for r in results:
+            assert isinstance(r[0], np.ndarray)
+            assert np.isfinite(r[0]).all()

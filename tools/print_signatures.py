@@ -64,6 +64,7 @@ MODULES = [
     "paddle_tpu.reader_decorators",
     "paddle_tpu.data_feeder",
     "paddle_tpu.reader",
+    "paddle_tpu.pipeline",
     "paddle_tpu.unique_name",
     "paddle_tpu.param_attr",
     "paddle_tpu.incubate.data_generator",
