@@ -583,6 +583,91 @@ def _bert_infer(on_tpu, dev, seq_len=128):
     }), flush=True)
 
 
+def child_fusion():
+    """Fusion pass pipeline A/B (ISSUE 5): the same mnist-shaped MLP
+    train step with PADDLE_TPU_FUSION on vs off, plus the fused-op
+    census of the bert-tiny train program (IR-only).  Emits
+    ``*_fusion_speedup`` (>1 = fusion wins) and fused-op counts so the
+    pipeline's effect is visible next to every other BENCH line."""
+    import paddle_tpu as fluid
+    from paddle_tpu.executor import Scope, scope_guard
+    from paddle_tpu.static_analysis import fusion
+
+    def build():
+        fluid.unique_name.switch()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data(name="img", shape=[784],
+                                    dtype="float32")
+            label = fluid.layers.data(name="label", shape=[1],
+                                      dtype="int64")
+            h = fluid.layers.fc(input=img, size=200, act="relu")
+            h = fluid.layers.fc(input=h, size=200, act="relu")
+            pred = fluid.layers.fc(input=h, size=10, act="softmax")
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.cross_entropy(input=pred, label=label))
+            fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(0)
+    feed = {"img": rng.rand(64, 784).astype("float32"),
+            "label": rng.randint(0, 10, (64, 1)).astype("int64")}
+    warmup, steps = 3, 30
+    times = {}
+    for arm in ("1", "0"):
+        os.environ["PADDLE_TPU_FUSION"] = arm
+        main, startup, loss = build()
+        exe = fluid.Executor()
+        with scope_guard(Scope()):
+            exe.run(startup)
+            times[arm] = _timed_steps(exe, main, feed, loss.name,
+                                      warmup, steps)
+    os.environ.pop("PADDLE_TPU_FUSION", None)
+    speedup = times["0"] / times["1"] if times["1"] else 0.0
+    main, startup, loss = build()
+    _, report = fusion.resolve_fused_program(main, targets=[loss.name])
+    dev = "cpu" if os.environ.get("PADDLE_BENCH_FORCE_CPU") else \
+        jax_backend_name()
+    print(json.dumps({
+        "metric": "mnist_mlp_train_fusion_speedup",
+        "value": round(speedup, 4),
+        "unit": "x (fusion-off step time / fusion-on, %d steps, %s)"
+                % (steps, dev),
+        "fused_op_counts": report.counts(),
+        "ops_removed": report.ops_removed,
+    }), flush=True)
+
+    # bert-tiny train program census (IR-only, no execution): how many
+    # subgraphs each family rewrites at the default config
+    import copy as _copy
+
+    from paddle_tpu.models import bert
+
+    cfg = _copy.copy(bert.BERT_TINY)
+    cfg.fuse_attn = False
+    fluid.unique_name.switch()
+    bmain, _, _, bloss = bert.build_pretrain(cfg, seq_len=32, train=True)
+    n_before = len(bmain.global_block().ops)
+    bfused, brep = fusion.resolve_fused_program(
+        bmain, targets=[bloss.name])
+    print(json.dumps({
+        "metric": "bert_tiny_train_fused_op_count",
+        "value": sum(brep.counts().values()),
+        "unit": "rewrites (program ops %d -> %d)"
+                % (n_before, len(bfused.global_block().ops)),
+        "fused_op_counts": brep.counts(),
+    }), flush=True)
+
+
+def jax_backend_name():
+    import jax
+
+    try:
+        return jax.default_backend()
+    except Exception:  # noqa: BLE001
+        return "unknown"
+
+
 def child_ctr():
     """DeepFM CTR with HOST-RESIDENT embedding tables (BASELINE config 5;
     the reference's pserver/distributed-lookup-table workload, here via
@@ -910,7 +995,8 @@ def main():
         # the infer/bert_infer tail items only run when caches were
         # warm enough to leave >=90s each
         plan = [("bert", 420), ("ctr", 160), ("resnet", 340),
-                ("bert512", 270), ("infer", 220), ("bert_infer", 200)]
+                ("bert512", 270), ("infer", 220), ("bert_infer", 200),
+                ("fusion", 150)]
         failed = []
         for mode, cap in plan:
             if remaining(cap) < 90:
@@ -970,7 +1056,7 @@ def main():
             probe and probe.get("platform"))
         print("# TPU unavailable: %s — emitting CPU smoke + captured "
               "hardware lines (if any)" % reason, flush=True)
-        for mode in ("ctr", "bert"):
+        for mode in ("ctr", "bert", "fusion"):
             w_ok, w_lines, w_err = _run_child(
                 mode, remaining(420 if mode == "bert" else 150),
                 env_extra={"PADDLE_BENCH_FORCE_CPU": "1"})
@@ -1034,6 +1120,8 @@ if __name__ == "__main__":
             child_infer()
         elif mode == "bert_infer":
             child_bert_infer()
+        elif mode == "fusion":
+            child_fusion()
         else:
             raise SystemExit("unknown child mode %r" % mode)
         sys.exit(0)

@@ -7,8 +7,12 @@ that clones the graph per GPU and inserts NCCL all-reduce op-handles
 ``jax.sharding.Mesh`` over the data axis and the executor jits the SAME
 program with batch-sharded inputs and replicated params — GSPMD emits the
 grad all-reduce over ICI.  The BuildStrategy knobs that survive are the ones
-XLA doesn't subsume (donation, remat); the reduce-strategy / fused-allreduce
-/ hierarchical-allreduce knobs are accepted for API parity and ignored.
+XLA doesn't subsume: donation, remat, and the ``fuse_*`` family — which
+since the fusion-pipeline PR drive REAL cost-guided Program-IR rewrites
+(``static_analysis/fusion.py``: Pallas attention/LN kernels, fused
+bias+act, one-op softmax+xent, multi-tensor optimizer updates, bucketed
+gradient allreduce).  Only reduce-strategy / hierarchical-allreduce remain
+accepted-for-parity no-ops (GSPMD always emits fused ring allreduce).
 """
 
 __all__ = ["CompiledProgram", "BuildStrategy", "ExecutionStrategy"]
@@ -31,9 +35,28 @@ class BuildStrategy:
         )
         self.memory_optimize = False
         self.enable_inplace = True  # buffer donation
-        self.fuse_all_reduce_ops = True  # XLA fuses collectives natively
-        self.fuse_elewise_add_act_ops = True  # XLA fusion, always on
+        # the fuse_* knobs drive the REAL cost-guided fusion pass
+        # pipeline (static_analysis/fusion.py), the TPU realization of
+        # the reference's fuse_all_reduce_op_pass /
+        # fuse_elewise_add_act_pass / fuse_optimizer_ops_pass:
+        #   fuse_all_reduce_ops      -> bucketed gradient allreduce
+        #                               (PADDLE_TPU_ALLREDUCE_BUCKET_MB)
+        #   fuse_elewise_add_act_ops -> fused_bias_act +
+        #                               fused_dropout_add_ln rewrites
+        #   fuse_all_optimizer_ops   -> multi-tensor fused_adam/fused_sgd
+        #                               (cost-gated: BERT-scale groups
+        #                               are rejected, see the r04 A/B)
+        # PADDLE_TPU_FUSION=0 kills the whole pipeline;
+        # CompiledProgram.fusion_report() shows what fired and why not.
+        self.fuse_all_reduce_ops = True
+        self.fuse_elewise_add_act_ops = True
         self.fuse_all_optimizer_ops = True
+        # TPU-native pattern families beyond the reference's flags:
+        # attention subgraph -> Pallas flash kernel (gated on the
+        # measured engagement threshold), softmax+cross_entropy -> one
+        # numerically-stable op
+        self.fuse_attention = True
+        self.fuse_softmax_xent = True
         self.enable_sequential_execution = False
         self.remove_unnecessary_lock = True
         self.num_trainers = 1
@@ -88,6 +111,8 @@ class CompiledProgram:
         self._places = None
         self._share_vars_from = None
         self._parallel_runner = None
+        self._last_fusion_report = None
+        self._last_fusion_key = None
 
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, share_vars_from=None,
@@ -137,14 +162,55 @@ class CompiledProgram:
     def program(self):
         return self._program
 
+    def fusion_report(self):
+        """The fusion pipeline's outcome for this program under this
+        BuildStrategy: applied rewrites with op coordinates and
+        predicted deltas, plus matched-but-skipped patterns with the
+        cost-model reason.  Resolves the fused program on demand if no
+        run has happened yet (fetch-target protection then defaults to
+        'nothing fetched')."""
+        from .static_analysis import fusion as _fusion
+
+        if self._parallel_runner is not None \
+                and self._parallel_runner._last_fusion_report is not None:
+            return self._parallel_runner._last_fusion_report
+        if self._last_fusion_report is not None:
+            return self._last_fusion_report
+        _, report = _fusion.resolve_fused_program(
+            self._program,
+            config=_fusion.FusionConfig.from_build_strategy(
+                self._build_strategy))
+        return report
+
     def _run(self, executor, feed, fetch_list, scope, return_numpy):
         accum = getattr(self._build_strategy, "batch_merge_repeat", 1) or 1
         iters = int(getattr(self._exec_strategy, "num_iteration_per_run",
                             1) or 1) if self._exec_strategy else 1
         if not self._is_data_parallel and accum <= 1 and iters <= 1:
+            # hand the BuildStrategy-derived fusion config to the
+            # executor so the fuse_* flags are honored on the plain path
+            # too — including when every pass no-ops (the executor must
+            # not fall back to the default config and re-enable families
+            # the strategy disabled)
+            from .framework import Variable
+            from .static_analysis import fusion as _fusion
+
+            config = _fusion.FusionConfig.from_build_strategy(
+                self._build_strategy)
+            targets = [v.name if isinstance(v, Variable) else str(v)
+                       for v in (fetch_list or [])]
+            # refresh the report only when its resolve key changes —
+            # steady-state steps skip the (cached) resolve entirely
+            key = (config.signature(), self._program._version,
+                   tuple(sorted(set(targets))))
+            if key != self._last_fusion_key:
+                _, self._last_fusion_report = _fusion.resolve_fused_program(
+                    self._program, config=config, targets=targets)
+                self._last_fusion_key = key
             return executor.run(
-                self._program, feed=feed, fetch_list=fetch_list, scope=scope,
-                return_numpy=return_numpy, use_program_cache=True,
+                self._program, feed=feed, fetch_list=fetch_list,
+                scope=scope, return_numpy=return_numpy,
+                use_program_cache=True, _fusion_config=config,
             )
         from .parallel import SPMDRunner
 

@@ -1031,6 +1031,7 @@ class Executor:
         use_program_cache=True,
         use_prune=False,
         verify=False,
+        _fusion_config=None,
     ):
         import jax
         import jax.numpy as jnp
@@ -1071,6 +1072,22 @@ class Executor:
         fetch_names = [
             v.name if isinstance(v, Variable) else str(v) for v in fetch_list
         ]
+
+        # ---- cost-guided fusion pass pipeline (static_analysis/fusion):
+        # resolve the fusion-rewritten twin of the program (a cached
+        # clone — the user's program is never mutated, and PADDLE_TPU_
+        # FUSION=0 reproduces the pre-fusion numerics bit-exactly).  The
+        # fetch names ride into the resolution so a fetched intermediate
+        # is never fused away; the jit cache below keys on the resolved
+        # program's identity/version + the fusion signature.
+        # ``_fusion_config`` (CompiledProgram._run) carries the caller's
+        # BuildStrategy-derived config — without it a config whose
+        # passes all no-op would fall back to the default config here,
+        # silently re-enabling families the user disabled.
+        from .static_analysis import fusion as _fusion
+
+        program, _fusion_report = _fusion.resolve_fused_program(
+            program, config=_fusion_config, targets=fetch_names)
 
         # ---- resilience hooks (all no-ops without a fault spec /
         # PADDLE_TPU_NAN_GUARD — see resilience/) ----
@@ -1154,6 +1171,11 @@ class Executor:
             tuple(fetch_names),
             tuple(sorted((trip_counts or {}).items())),
             nan_guard,
+            # fusion config is part of the compilation identity: the
+            # same source program under a different fusion config is a
+            # different (cloned) program object, and the signature makes
+            # the separation explicit/debuggable
+            getattr(program, "_fusion_sig", None),
         )
         from . import profiler as _prof
 

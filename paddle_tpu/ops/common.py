@@ -46,3 +46,30 @@ def normalize_axis(axis, ndim):
     if axis < 0:
         axis += ndim
     return axis
+
+
+def flatten_concat(xs, dtype=None):
+    """Pack a list of arrays into one flat stream (the multi-tensor /
+    bucketed-collective layout), optionally casting each segment."""
+    return jnp.concatenate([
+        x.reshape(-1).astype(dtype) if dtype is not None else x.reshape(-1)
+        for x in xs
+    ])
+
+
+def split_like(flat, refs, cast=True):
+    """Unpack a flat stream into segments shaped (and, with ``cast``,
+    dtyped) like ``refs`` — the inverse of :func:`flatten_concat`.
+    Segment sizes are static (taken from the refs' shapes), so the
+    slices stay jit-friendly."""
+    outs = []
+    off = 0
+    for r in refs:
+        shape = jnp.shape(r)
+        n = 1
+        for d in shape:
+            n *= int(d)
+        seg = flat[off:off + n].reshape(shape)
+        outs.append(seg.astype(r.dtype) if cast else seg)
+        off += n
+    return outs

@@ -579,6 +579,22 @@ def fused_dropout_add_ln(ctx, attrs, X, Residual, Scale, Bias):
     return out.reshape(shape)
 
 
+@register_op("fused_bias_act", inputs=["X", "Bias"], outputs=["Out"])
+def fused_bias_act(ctx, attrs, X, Bias):
+    """``act(x + bias)`` in one op — the fusion pipeline's rewrite of
+    Fluid's ``fuse_elewise_add_act_pass`` (the fc bias+activation tail).
+    Bit-exact by construction: it calls the SAME registered
+    ``elementwise_add`` broadcast helper and the SAME registered
+    activation lowering the unfused pair uses."""
+    from .common import fluid_broadcast
+    from .registry import get_op_def
+
+    x, b = fluid_broadcast(X, Bias, attrs.get("axis", -1))
+    y = jnp.add(x, b)
+    act = attrs.get("act_type", "relu")
+    return get_op_def(act).fn(ctx, dict(attrs), y)
+
+
 @register_op("selu", inputs=["X"], outputs=["Out"])
 def selu(ctx, attrs, X):
     """scale * (max(0,x) + min(0, alpha*(exp(x)-1))) (selu_op.cc)."""

@@ -345,6 +345,26 @@ def proximal_adagrad(ctx, attrs, Param, Moment, Grad, LearningRate):
 
 
 @register_op(
+    "fused_sgd",
+    inputs=["Param*", "Grad*", "LearningRate"],
+    outputs=["ParamOut*"],
+    no_grad=True,
+)
+def fused_sgd(ctx, attrs, Param, Grad, LearningRate):
+    """Multi-tensor SGD: all same-(dtype, lr) param updates of a step as
+    one flat stream (the sgd face of Fluid's fuse_optimizer_ops_pass;
+    the fusion pipeline groups per dtype so the stream stays uniform).
+    Bit-exact vs the per-param op: concatenation does not change the
+    elementwise ``p - lr*g`` each segment computes."""
+    from .common import flatten_concat, split_like
+
+    dtype = Param[0].dtype
+    lr = _lr(LearningRate, dtype)
+    new = flatten_concat(Param) - lr * flatten_concat(Grad, dtype)
+    return {"ParamOut": split_like(new, Param, cast=False)}
+
+
+@register_op(
     "fused_adam",
     inputs=["Param*", "Grad*", "LearningRate", "Moment1*", "Moment2*",
             "Beta1Pow*", "Beta2Pow*"],
@@ -370,6 +390,8 @@ def fused_adam(ctx, attrs, Param, Grad, LearningRate, Moment1, Moment2,
     scalars stay per-param (cheap) so each param's bias correction reads
     ITS OWN accumulator exactly as before — though the rewrite only
     groups params whose beta pows are in lockstep anyway."""
+    from .common import flatten_concat, split_like
+
     beta1 = attrs.get("beta1", 0.9)
     beta2 = attrs.get("beta2", 0.999)
     eps = attrs.get("epsilon", 1e-8)
@@ -377,11 +399,8 @@ def fused_adam(ctx, attrs, Param, Grad, LearningRate, Moment1, Moment2,
     shapes = [p.shape for p in Param]
     sizes = [int(np.prod(s)) if s else 1 for s in shapes]
 
-    def flat(xs):
-        return jnp.concatenate(
-            [x.reshape(-1).astype(jnp.float32) for x in xs])
-
-    p, g, m1, m2 = (flat(Param), flat(Grad), flat(Moment1), flat(Moment2))
+    p, g, m1, m2 = (flatten_concat(xs, jnp.float32)
+                    for xs in (Param, Grad, Moment1, Moment2))
     # bias correction stays PER PARAM: each member's own beta-pow drives
     # its lr_t (a checkpoint-resumed model can hold accumulators at
     # different steps, e.g. a freshly added layer), broadcast to its
@@ -403,18 +422,10 @@ def fused_adam(ctx, attrs, Param, Grad, LearningRate, Moment1, Moment2,
     m2n = beta2 * m2 + (1 - beta2) * jnp.square(g)
     pn = p - lr_t * m1n / (jnp.sqrt(m2n) + eps)
 
-    def split(v, refs):
-        outs = []
-        off = 0
-        for s, n, r in zip(shapes, sizes, refs):
-            outs.append(v[off:off + n].reshape(s).astype(r.dtype))
-            off += n
-        return outs
-
     return {
-        "ParamOut": split(pn, Param),
-        "Moment1Out": split(m1n, Moment1),
-        "Moment2Out": split(m2n, Moment2),
+        "ParamOut": split_like(pn, Param),
+        "Moment1Out": split_like(m1n, Moment1),
+        "Moment2Out": split_like(m2n, Moment2),
         "Beta1PowOut": [
             (b.reshape(()).astype(jnp.float32) * beta1)
             .reshape(b.shape).astype(b.dtype) for b in Beta1Pow],

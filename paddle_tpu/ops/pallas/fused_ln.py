@@ -34,10 +34,9 @@ import os
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
 
 from .flash_attention import (_HAS_PLTPU, _hash_bits, _rate_threshold,
-                              pltpu)
+                              pallas_supported, pl, pltpu)
 
 _BN = 256  # rows per grid step; D stays whole in the lane dimension
 
@@ -129,7 +128,7 @@ def _bwd_kernel(dout_ref, y_ref, g_ref, mean_ref, rstd_ref, seed_ref,
 
 
 def _eligible(x):
-    if _pallas_mode() == "off":
+    if not pallas_supported() or _pallas_mode() == "off":
         return False
     n, d = x.shape
     if d % 128 or d > 4096 or n % 8:
@@ -274,6 +273,17 @@ def fused_dropout_add_ln(x, residual, gamma, beta, dropout_rate=0.0,
     rate = float(dropout_rate or 0.0)
     if seed is None:
         seed = jnp.zeros((1,), jnp.int32)
+    if _pallas_mode() == "interpret" and rate > 0.0 and not _debug_mask():
+        # the pltpu hardware PRNG has no CPU/interpret lowering — the
+        # kernel would die deep in Pallas with an opaque 'prng_seed not
+        # found for platform cpu'.  Unlike the flash entry (whose caller
+        # explicitly opted into the kernel) this op is routinely
+        # INTRODUCED by the fusion pass rewrite, so degrade to the XLA
+        # composite instead of raising; set
+        # PADDLE_TPU_FLASH_DROPOUT_DEBUG=iota to run the kernel with the
+        # deterministic debug hash instead.
+        return _xla_reference(x, residual, gamma, beta, rate, eps, seed,
+                              False)
     if not _eligible(x):
         return _xla_reference(x, residual, gamma, beta, rate, eps, seed,
                               _debug_mask())

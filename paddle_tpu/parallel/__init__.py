@@ -59,6 +59,7 @@ class SPMDRunner:
             getattr(exec_strategy, "num_iteration_per_run", 1) or 1)
         self.shard_opt_state = bool(
             getattr(build_strategy, "shard_optimizer_state", False))
+        self._last_fusion_report = None
         self._cache = {}
         from ..pipeline import FeedCache
 
@@ -76,6 +77,16 @@ class SPMDRunner:
             v.name if isinstance(v, Variable) else str(v) for v in fetch_list
         ]
 
+        # fusion pass pipeline, honoring the BuildStrategy.fuse_* flags
+        # (cached clone; the wrapped program itself is never mutated)
+        from ..static_analysis import fusion as _fusion
+
+        program, self._last_fusion_report = _fusion.resolve_fused_program(
+            self.program,
+            config=_fusion.FusionConfig.from_build_strategy(
+                self.build_strategy),
+            targets=fetch_names)
+
         # resilience hooks (see resilience/): process faults fire here
         # too, and the finite step-guard covers the DP/ZeRO paths.
         # (Value-fault gates stay single-process-executor-only — a fed
@@ -85,7 +96,7 @@ class SPMDRunner:
 
         inj = _rfaults.get_injector()
         cur_step = inj.on_step() if inj.active else executor._step
-        nan_guard = _rguard.guard_enabled(self.program)
+        nan_guard = _rguard.guard_enabled(program)
         if jax.process_count() > 1 and self.mesh is not None:
             # multi-process cluster (reference nccl2 mode): each process
             # feeds its LOCAL batch shard; assemble the global batch-
@@ -115,7 +126,7 @@ class SPMDRunner:
                     if isinstance(v, np.ndarray) else jnp.asarray(v))
         # host-resident tables under DP: prefetch the GLOBAL batch's
         # slab (GSPMD shards it over the data axis like any feed)
-        if (getattr(self.program, "_host_tables", None)
+        if (getattr(program, "_host_tables", None)
                 and self.accumulate_steps > 1):
             raise RuntimeError(
                 "host_embedding with batch_merge_repeat>1 is not "
@@ -124,7 +135,7 @@ class SPMDRunner:
                 "to param grads, so the host push would be k-times too "
                 "large — run host-table programs with "
                 "batch_merge_repeat=1")
-        if (getattr(self.program, "_host_tables", None)
+        if (getattr(program, "_host_tables", None)
                 and self.iters_per_run > 1):
             raise RuntimeError(
                 "host_embedding with num_iteration_per_run>1 is not "
@@ -134,19 +145,20 @@ class SPMDRunner:
                 "host push — run host-table programs with "
                 "num_iteration_per_run=1")
         host_active, host_grad_fetches = _host_table_prefetch(
-            self.program, feed, feed_vals)
+            program, feed, feed_vals)
         fetch_names = fetch_names + host_grad_fetches
         sig = tuple(
             (n, tuple(v.shape), str(v.dtype))
             for n, v in sorted(feed_vals.items())
         )
-        key_tuple = (self.program._version, id(scope), sig,
-                     tuple(fetch_names), nan_guard)
+        key_tuple = (id(program), program._version, id(scope), sig,
+                     tuple(fetch_names), nan_guard,
+                     getattr(program, "_fusion_sig", None))
         compiled = self._cache.get(key_tuple)
         if compiled is None:
             compiled = _CompiledBlock(
-                self.program,
-                self.program.global_block(),
+                program,
+                program.global_block(),
                 list(feed_vals),
                 fetch_names,
                 scope,
@@ -161,7 +173,7 @@ class SPMDRunner:
 
         rw = {n: scope.get(n) for n in compiled.rw_names}
         ro = promote_readonly_scope_arrays(scope, compiled)
-        seed = self.program.random_seed or 0
+        seed = program.random_seed or 0
         base_key = jax.random.fold_in(rng_key(seed), executor._step)
         executor._step += 1
         fetches, new_rw, fresh = compiled.jitted(feed_vals, rw, ro, base_key)

@@ -48,6 +48,9 @@ from .distributed import (CollectiveEvent, check_schedule_consistency,
                           extract_collective_schedule,
                           prove_deadlock_free)
 from .analyze import AnalysisReport, analyze_program
+from .fusion import (FusionConfig, FusionReport, apply_fusion_passes,
+                     fusion_enabled, resolve_fused_program,
+                     scan_fusible_patterns)
 
 __all__ = [
     "Diagnostic",
@@ -82,4 +85,10 @@ __all__ = [
     "prove_deadlock_free",
     "AnalysisReport",
     "analyze_program",
+    "FusionConfig",
+    "FusionReport",
+    "apply_fusion_passes",
+    "fusion_enabled",
+    "resolve_fused_program",
+    "scan_fusible_patterns",
 ]

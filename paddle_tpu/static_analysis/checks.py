@@ -62,6 +62,13 @@ executor-host-sync-in-loop  INFO      host-IO op (save/load/...) in
                                       a training run — forces a device
                                       sync every iteration and defeats
                                       async dispatch overlap
+fused-op-missing-grad       ERROR     fused op registered no_grad=True
+                                      on a parameter-derived path of a
+                                      training program — its param
+                                      grads would silently zero
+fusible-pattern-not-fused   INFO      pattern the fusion pipeline
+                                      matched but will not rewrite,
+                                      with the cost-model reason
 ==========================  ========  ====================================
 """
 
@@ -800,3 +807,105 @@ def check_oversized_replicated_persistable(ctx):
                 hint="shard it: BuildStrategy.shard_optimizer_state "
                      "(ZeRO-1), shard_spec/tensor parallel, or a host "
                      "table for embeddings")
+
+
+@register_check("fused-op-missing-grad")
+def check_fused_op_missing_grad(ctx):
+    """A fused forward op registered with ``no_grad=True`` silently
+    blocks gradient flow: backward.py treats it as non-differentiable,
+    so every parameter feeding it gets a zero (or missing) gradient with
+    no error.  ERROR when such an op sits on a parameter-derived path of
+    a TRAINING program AND a gradient is actually demanded through its
+    output (a metrics-only branch is fine; the fusion pipeline's own
+    fused ops are all differentiable via the registry's generic vjp —
+    this guards custom fused kernels wired in by hand)."""
+    from ..ops import registry
+
+    order = [rec for rec in ctx.graph.order if rec[0] == 0]
+    training = any(
+        op.type.endswith("_grad") or op.attrs.get("op_role") == "optimize"
+        for _, _, op in order)
+    if not training:
+        return
+    bearing = set()
+    for p in ctx.program.all_parameters():
+        if getattr(p, "trainable", True) and not p.stop_gradient:
+            bearing.add(p.name)
+    # gradient demand: vars from which an op WITH a grad twin is
+    # reachable.  Only a blocked gradient on a demanded path silently
+    # zeroes a param update — a metrics/fetch-only branch reading
+    # param-derived values through a no_grad fused op is fine.
+    twin_ids = {op.attrs.get("__fwd_op_id__") for _, _, op in order
+                if op.type.endswith("_grad")}
+    twin_ids.discard(None)
+    demanded = set()
+    for _, _, op in order:
+        if op.attrs.get("__op_id__") in twin_ids:
+            demanded.update(n for n in op.input_arg_names
+                            if n and n != EMPTY_VAR_NAME)
+    for _, _, op in reversed(order):
+        if op.type.endswith("_grad"):
+            continue
+        if demanded.intersection(op.output_arg_names):
+            demanded.update(n for n in op.input_arg_names
+                            if n and n != EMPTY_VAR_NAME)
+    for block_idx, op_idx, op in order:
+        if op.type.endswith("_grad") \
+                or op.attrs.get("op_role") in ("backward", "optimize"):
+            continue
+        try:
+            opdef = registry.get_op_def(op.type)
+        except registry.OpNotRegistered:
+            continue
+        touches = [n for n in op.input_arg_names if n in bearing]
+        if not touches:
+            continue
+        if opdef.no_grad and (op.type.startswith("fused_")
+                              or op.type.startswith("c_fused_")) \
+                and demanded.intersection(op.output_arg_names):
+            yield ctx.diag(
+                "fused-op-missing-grad", Severity.ERROR,
+                "fused op %r has no registered grad_fn (no_grad=True) "
+                "but a parameter gradient path flows through it via %s "
+                "— training would silently zero those grads"
+                % (op.type, touches[:3]),
+                block_idx=block_idx, op_idx=op_idx, op=op,
+                var_names=tuple(touches[:3]),
+                hint="register the op without no_grad (the registry "
+                     "derives <type>_grad via jax.vjp) or give it a "
+                     "custom grad_maker")
+        if not opdef.no_grad:
+            bearing.update(
+                n for n in op.output_arg_names if n != EMPTY_VAR_NAME)
+
+
+@register_check("fusible-pattern-not-fused")
+def check_fusible_pattern_not_fused(ctx):
+    """Advisory twin of the fusion pipeline: patterns the matchers
+    recognize but the pipeline will NOT rewrite — either gated out by
+    the cost model (with the model's reason) or because fusion is
+    globally disabled.  Points at the anchor op of each pattern."""
+    from .fusion import (FusionConfig, fusion_enabled,
+                         scan_fusible_patterns)
+
+    report = scan_fusible_patterns(
+        ctx.program, FusionConfig(enabled=True), targets=ctx.targets)
+    for s in report.skipped:
+        yield ctx.diag(
+            "fusible-pattern-not-fused", Severity.INFO,
+            "fusible %s pattern matched but will not fuse: %s"
+            % (s.family, s.reason),
+            block_idx=s.block_idx, op_idx=s.op_idx,
+            hint="see CompiledProgram.fusion_report() for the full "
+                 "pipeline outcome")
+    if not fusion_enabled():
+        for r in report.applied:
+            yield ctx.diag(
+                "fusible-pattern-not-fused", Severity.INFO,
+                "fusible %s pattern (block %d ops %s -> %s) is disabled "
+                "by PADDLE_TPU_FUSION=0"
+                % (r.family, r.block_idx, list(r.op_idxs),
+                   r.fused_op_type),
+                block_idx=r.block_idx,
+                op_idx=r.op_idxs[0] if r.op_idxs else None,
+                hint="unset PADDLE_TPU_FUSION to enable the rewrite")

@@ -101,7 +101,7 @@ COLLECTIVE_OP_TYPES = frozenset((
     "c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
     "c_allreduce_prod", "allreduce", "c_reduce_sum", "c_broadcast",
     "broadcast", "c_allgather", "c_reducescatter", "c_scatter",
-    "all_to_all", "ppermute",
+    "all_to_all", "ppermute", "c_fused_allreduce_sum",
 ))
 P2P_OP_TYPES = frozenset(("send_v2", "recv_v2"))
 
@@ -112,7 +112,8 @@ def collective_ici_bytes(op_type, payload_bytes, nranks):
     b = payload_bytes
     if n <= 1:
         return 0
-    if op_type.startswith("c_allreduce") or op_type == "allreduce":
+    if op_type.startswith("c_allreduce") or op_type == "allreduce" \
+            or op_type == "c_fused_allreduce_sum":
         return int(2 * b * (n - 1) / n)
     if op_type in P2P_OP_TYPES or op_type == "ppermute":
         return int(b)
@@ -180,6 +181,46 @@ def _conv2d_flops(op, ins, outs):
 @register_flops("softmax")
 def _softmax_flops(op, ins, outs):
     return 5 * _out_numel(outs)  # max, sub, exp, sum, div
+
+
+@register_flops("fused_multihead_attention")
+def _fused_mha_flops(op, ins, outs):
+    # Q [B,H,Tq,dh], K [B,H,Tk,dh]: two matmuls (4·B·H·Tq·Tk·dh) plus
+    # the online-softmax arithmetic (~5 FLOPs per score cell)
+    if len(ins) < 2 or not ins[0].shape or not ins[1].shape \
+            or len(ins[0].shape) != 4 or len(ins[1].shape) != 4:
+        return 2 * _out_numel(outs)
+    b, h, tq, dh = (max(int(d), 1) for d in ins[0].shape)
+    tk = max(int(ins[1].shape[2]), 1)
+    return 4 * b * h * tq * tk * dh + 5 * b * h * tq * tk
+
+
+@register_flops("fused_dropout_add_ln")
+def _fused_ln_flops(op, ins, outs):
+    # mask+add+two-pass stats+normalize+affine ≈ 8 FLOPs per element
+    return 8 * _out_numel(outs)
+
+
+@register_flops("fused_bias_act")
+def _fused_bias_act_flops(op, ins, outs):
+    return 2 * _out_numel(outs)
+
+
+@register_flops("softmax_with_cross_entropy")
+def _softmax_xent_flops(op, ins, outs):
+    n = ins[0].local_numel if ins and ins[0].local_numel else \
+        _out_numel(outs)
+    return 5 * (n or 0)
+
+
+@register_flops("fused_adam")
+def _fused_adam_flops(op, ins, outs):
+    return 4 * _out_numel(outs)  # ~12 FLOPs per param over 3 out streams
+
+
+@register_flops("fused_sgd")
+def _fused_sgd_flops(op, ins, outs):
+    return 2 * _out_numel(outs)
 
 
 for _t in ("mean", "reduce_mean", "reduce_sum", "reduce_max",
@@ -396,8 +437,13 @@ def estimate_cost(program, interp=None, targets=(), nranks=None,
         ring = None
         if op.type in COLLECTIVE_OP_TYPES or op.type in P2P_OP_TYPES:
             ring = op.attrs.get("ring_id")
-            payload = max(
-                [_val_bytes(v) for v in (rec.ins or rec.outs)] or [0])
+            if op.type == "c_fused_allreduce_sum":
+                # bucketed allreduce: the coalesced buffer carries the
+                # SUM of the member payloads in one launch
+                payload = sum(_val_bytes(v) for v in rec.ins)
+            else:
+                payload = max(
+                    [_val_bytes(v) for v in (rec.ins or rec.outs)] or [0])
             if op.type == "recv_v2" and rec.outs:
                 payload = _val_bytes(rec.outs[0])
             ici = collective_ici_bytes(op.type, payload, nranks)

@@ -121,14 +121,24 @@ def extract_collective_schedule(program, worker=0, interp=None,
         payload = rec.outs[0] if (op.type == "recv_v2" and rec.outs) \
             else (rec.ins[0] if rec.ins else
                   (rec.outs[0] if rec.outs else None))
+        numel = payload.local_numel if payload is not None else None
+        var = payload.name if payload is not None else None
+        if op.type == "c_fused_allreduce_sum" and rec.ins:
+            # the bucketed allreduce moves ONE coalesced buffer: its
+            # schedule signature is the summed member payload (identical
+            # on every worker because the fusion pass is deterministic
+            # over identical per-worker programs)
+            numel = sum(v.local_numel or 0 for v in rec.ins)
+            var = "%s(+%d coalesced)" % (rec.ins[0].name,
+                                         len(rec.ins) - 1)
         ev = CollectiveEvent(
             worker, ring,
             "send" if op.type == "send_v2"
             else ("recv" if op.type == "recv_v2" else op.type),
             payload.dtype if payload is not None else None,
-            payload.local_numel if payload is not None else None,
+            numel,
             rec.block_idx, rec.op_idx, op.type,
-            var=payload.name if payload is not None else None,
+            var=var,
             peer=op.attrs.get("peer"), order=rec.index)
         schedule.setdefault(ring, []).append(ev)
     return schedule

@@ -19,6 +19,13 @@ import pytest
 
 import jax
 
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: heavyweight tests excluded from the tier-1 "
+                   "run (ROADMAP.md runs -m 'not slow')")
+
+
 @pytest.fixture
 def verify_clean():
     """Run ``verify_program`` on a program and assert no ERROR-severity

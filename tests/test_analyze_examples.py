@@ -89,3 +89,46 @@ def test_example_cost_baselines_are_nonzero():
     # the async-dispatch additions ride in the same BENCH stream
     assert "static_host_sync_points" in metrics
     assert "static_dispatch_overhead_ms" in metrics
+
+@pytest.mark.parametrize("builder", [
+    _mnist, _bert_tiny, _ctr, _resnet_eval, _slim,
+], ids=["mnist", "bert-tiny", "ctr", "resnet-eval", "slim"])
+def test_every_example_fuses_and_analyzes_clean(builder):
+    """ISSUE 5 CI sweep: the fusion pipeline (on, default config) over
+    every example program must introduce ZERO new ERROR diagnostics —
+    the fused ops are first-class citizens of the analyzer (cost rules,
+    sharding transfers, schedule extraction) and every rewrite is
+    verify_pass-bracketed."""
+    from paddle_tpu.static_analysis import fusion
+
+    fluid.unique_name.switch()
+    for program, targets in builder():
+        fused, report = fusion.resolve_fused_program(
+            program, targets=targets or ())
+        analysis = fused.analyze(targets=targets)
+        assert analysis.ok, "\n".join(str(d) for d in analysis.errors)
+
+
+def test_fusion_families_fire_across_example_corpus(monkeypatch):
+    """The rewrite families all fire somewhere in the examples: mnist
+    carries bias_act + softmax_xent + optimizer, bert carries the
+    dropout_add_ln sites (and attention once T reaches the flash
+    threshold — exercised in test_fusion.py with the env override).
+    The optimizer gate gets the TPU-scale launch credit — the CPU
+    default refuses mnist-scale groups (measured slower there)."""
+    from paddle_tpu.static_analysis import fusion
+
+    monkeypatch.setenv("PADDLE_TPU_FUSE_OPT_OVERHEAD_BYTES",
+                       str(8 << 20))
+    seen = {}
+    fluid.unique_name.switch()
+    for build in (_mnist, _bert_tiny):
+        for program, targets in build():
+            _, report = fusion.resolve_fused_program(
+                program, targets=targets or ())
+            for fam, n in report.counts().items():
+                seen[fam] = seen.get(fam, 0) + n
+    assert seen.get("bias_act", 0) >= 2
+    assert seen.get("softmax_xent", 0) >= 1
+    assert seen.get("optimizer", 0) >= 1
+    assert seen.get("dropout_add_ln", 0) >= 5
