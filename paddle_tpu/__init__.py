@@ -82,6 +82,7 @@ from . import reader_decorators
 from . import dygraph_grad_clip
 from . import install_check
 from . import host_table
+from . import autotune
 from .lod_tensor import (LoDTensor, LoDTensorArray, create_lod_tensor,
                          create_random_int_lodtensor)
 from .transpiler import memory_optimize, release_memory
@@ -170,6 +171,7 @@ __all__ = [
     "install_check",
     "in_dygraph_mode",
     "host_table",
+    "autotune",
     "LoDTensor",
     "LoDTensorArray",
     "create_lod_tensor",

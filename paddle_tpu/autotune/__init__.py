@@ -1,0 +1,33 @@
+"""Kernel autotuning: sweep Pallas block/tile knobs on silicon, cache
+the winners, and calibrate the static cost model from the measurements.
+
+The measure-and-learn loop (TVM, arXiv:1802.04799; PAPERS.md) for this
+codebase's kernels: instead of hand-picking flash block shapes,
+fused-LN/conv-BN row blocks, and engagement thresholds from one-off
+sweeps pasted into env defaults, :func:`sweep` times candidates on the
+actual backend (profiler-phase-event instrumented), persists the winner
+in a versioned corrupt-safe on-disk cache keyed by fusion signature
+(:mod:`.cache`), and records predicted-vs-measured calibration factors
+that :mod:`..static_analysis.cost` and the fusion gates consume — so
+the PR-5 cost gating learns from silicon instead of constants.
+
+Knobs: ``PADDLE_TPU_AUTOTUNE=0`` (kill switch — hand-set defaults
+everywhere, bit-exact pre-autotune behavior),
+``PADDLE_TPU_AUTOTUNE_CACHE`` (cache file path).
+"""
+
+from .cache import (SCHEMA_VERSION, autotune_enabled, cache_path,
+                    entries, lookup, record, reset, signature,
+                    state_token)
+from .harness import (cached_block_cap, cached_params,
+                      calibration_factor, calibrations, decide_threshold,
+                      flash_min_t_decision, record_flash_min_t, sweep,
+                      sweep_signature, time_candidate)
+
+__all__ = [
+    "SCHEMA_VERSION", "autotune_enabled", "cache_path", "signature",
+    "lookup", "record", "entries", "state_token", "reset",
+    "time_candidate", "sweep", "sweep_signature", "cached_params",
+    "cached_block_cap", "decide_threshold", "flash_min_t_decision",
+    "record_flash_min_t", "calibration_factor", "calibrations",
+]

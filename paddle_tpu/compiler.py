@@ -57,6 +57,15 @@ class BuildStrategy:
         # numerically-stable op
         self.fuse_attention = True
         self.fuse_softmax_xent = True
+        # reference fuse_bn_act_ops, extended to ride the conv too:
+        # conv2d -> batch_norm -> (act) becomes one fused_conv_bn_act
+        # (Pallas epilogue on TPU); lookup_table/embedding on device
+        # tables dispatch to the Pallas row-DMA gather kernel.  Both
+        # gates weigh predicted deltas by the autotune calibration
+        # factors (paddle_tpu.autotune) when a silicon sweep recorded
+        # them.
+        self.fuse_bn_act_ops = True
+        self.fuse_embedding_gather = True
         self.enable_sequential_execution = False
         self.remove_unnecessary_lock = True
         self.num_trainers = 1

@@ -595,6 +595,95 @@ def fused_bias_act(ctx, attrs, X, Bias):
     return get_op_def(act).fn(ctx, dict(attrs), y)
 
 
+@register_op(
+    "fused_conv_bn_act",
+    inputs=["Input", "Filter", "Scale", "Bias", "Mean", "Variance"],
+    outputs=["Out", "MeanOut", "VarianceOut"],
+    stateful_outputs=("MeanOut", "VarianceOut"),
+)
+def fused_conv_bn_act(ctx, attrs, Input, Filter, Scale, Bias, Mean,
+                      Variance):
+    """conv2d → batch_norm → activation as one op (the reference's
+    ``fuse_bn_act_ops`` pass + inference conv+bn fold, fused at train
+    time too).  The conv runs through the SAME ``_conv_nd`` lowering as
+    the unfused op (XLA owns the MXU schedule); the BN statistics use
+    the SAME single-pass form as ``batch_norm``; the normalize+affine+
+    act epilogue is one Pallas VMEM pass when eligible
+    (ops/pallas/conv_bn_act.py) and the bit-exact XLA composite
+    otherwise.  Running-stat updates (MeanOut/VarianceOut) ride along
+    exactly as in ``batch_norm``."""
+    from .pallas.conv_bn_act import bn_act_epilogue, epilogue_eligible
+
+    conv = _conv_nd(ctx, attrs, Input, Filter, 2)
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    is_test = attrs.get("is_test", False) \
+        or attrs.get("use_global_stats", False)
+    layout = attrs.get("data_layout", attrs.get("data_format", "NCHW"))
+    if layout == "AnyLayout":
+        layout = "NCHW"
+    c_axis = 1 if layout == "NCHW" else jnp.ndim(conv) - 1
+    reduce_axes = tuple(i for i in range(jnp.ndim(conv)) if i != c_axis)
+    bshape = tuple(
+        jnp.shape(conv)[i] if i == c_axis else 1
+        for i in range(jnp.ndim(conv)))
+    x32 = conv.astype(jnp.float32)
+    if is_test:
+        use_mean, use_var = Mean, Variance
+        mean_out, var_out = Mean, Variance
+    else:
+        bm = jnp.mean(x32, axis=reduce_axes)
+        # single-pass E[x^2] - E[x]^2, clamped — identical to batch_norm
+        bv = jnp.maximum(
+            jnp.mean(jnp.square(x32), axis=reduce_axes) - jnp.square(bm),
+            0.0)
+        use_mean, use_var = bm, bv
+        mean_out = Mean * momentum + bm * (1 - momentum)
+        var_out = Variance * momentum + bv * (1 - momentum)
+    act = attrs.get("act_type", "") or "identity"
+    rows = 1
+    for i in reduce_axes:
+        rows *= jnp.shape(conv)[i]
+    channels = jnp.shape(conv)[c_axis]
+    if c_axis == jnp.ndim(conv) - 1 \
+            and epilogue_eligible(rows, channels, act):
+        rstd = jax.lax.rsqrt(use_var.astype(jnp.float32) + eps)
+        out2d = bn_act_epilogue(
+            conv.reshape(-1, channels), Scale, Bias, use_mean, rstd,
+            act=act)
+        y = out2d.reshape(jnp.shape(conv))
+    else:
+        # the XLA composite — the exact float sequence of the unfused
+        # batch_norm lowering followed by the registered activation, so
+        # fusion-on matches fusion-off bit-for-bit on this path
+        y = (x32 - use_mean.reshape(bshape)) * jax.lax.rsqrt(
+            use_var.reshape(bshape) + eps)
+        y = y * Scale.reshape(bshape) + Bias.reshape(bshape)
+        y = y.astype(conv.dtype)
+        if act != "identity":
+            from .registry import get_op_def
+
+            y = get_op_def(act).fn(ctx, dict(attrs), y)
+    return {
+        "Out": y,
+        "MeanOut": jax.lax.stop_gradient(mean_out),
+        "VarianceOut": jax.lax.stop_gradient(var_out),
+    }
+
+
+@register_op("fused_embedding_gather", inputs=["W", "Ids"],
+             outputs=["Out"])
+def fused_embedding_gather(ctx, attrs, W, Ids):
+    """Embedding lookup dispatched to the Pallas row-DMA gather kernel
+    on TPU (ops/pallas/embedding.py; XLA take elsewhere) — the device-
+    side form of the reference's distributed lookup_table prefetch.
+    Semantics (clamping, padding_idx, scatter-add grad) are identical
+    to ``lookup_table``, so the fusion rewrite is value-preserving."""
+    from .pallas.embedding import embedding_gather
+
+    return embedding_gather(W, Ids, attrs.get("padding_idx", -1))
+
+
 @register_op("selu", inputs=["X"], outputs=["Out"])
 def selu(ctx, attrs, X):
     """scale * (max(0,x) + min(0, alpha*(exp(x)-1))) (selu_op.cc)."""

@@ -1,0 +1,137 @@
+"""Device-side embedding gather as a Pallas TPU kernel (scalar-prefetch
+row DMA), with the scatter-add backward.
+
+Reference analogue: the distributed lookup-table path
+(``operators/distributed/parameter_prefetch.cc``) whose TPU host-side
+redesign is :mod:`paddle_tpu.host_table` — the table lives in host RAM
+and every step pays a host gather + H2D of the slab plus a D2H of the
+slab gradient.  That round-trip caps DeepFM at its baseline (2720
+ex/s/chip flat).  When the table FITS device memory (or a row shard of
+it does, ``_is_distributed`` row sharding), the lookups belong on the
+chip: this module is that device-side gather.
+
+Kernel: ``pltpu.PrefetchScalarGridSpec`` with the flat id vector as the
+scalar-prefetch argument — the grid is one step per id, and the table
+BlockSpec's index map reads ``ids_ref[i]`` to DMA exactly row ``ids[i]``
+HBM→VMEM (rows never transit as a dense [V, D] read; only the touched
+rows move).  The id stream is known before the kernel body runs, so
+Mosaic double-buffers the row DMAs across grid steps.
+
+Backward: the standard sparse-embedding gradient — a scatter-add of the
+slab gradient into a zero [V, D] buffer (``.at[ids].add``), XLA's
+native SelectedRows-equivalent form on TPU, attached via custom_vjp so
+both the Pallas and XLA forwards share it.
+
+Fallback: ``jnp.take`` (the exact ``lookup_table`` lowering semantics:
+negative ids clamp to row 0, overflowing ids clamp to the last row,
+``padding_idx`` rows read zeros) off-TPU or for ineligible shapes;
+``PADDLE_TPU_PALLAS=interpret`` forces the kernel on CPU for tests.
+"""
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+from .flash_attention import _HAS_PLTPU, pallas_supported, pl, pltpu
+
+
+def _pallas_mode():
+    return os.environ.get("PADDLE_TPU_PALLAS", "")
+
+
+def gather_eligible(rows, dim):
+    """Whether the Pallas gather kernel can take a [rows, dim] table."""
+    if not pallas_supported() or _pallas_mode() == "off":
+        return False
+    if dim % 128 or dim > 8192 or rows < 1:
+        return False
+    if _pallas_mode() == "interpret":
+        return True
+    if not _HAS_PLTPU:
+        return False
+    plat = jax.devices()[0].platform.lower()
+    return "tpu" in plat or "axon" in plat
+
+
+def _gather_kernel(ids_ref, tab_ref, out_ref):
+    # the BlockSpec index maps already routed row ids[i] into tab_ref
+    out_ref[...] = tab_ref[...]
+
+
+def _pallas_gather(table, flat_ids):
+    n = flat_ids.shape[0]
+    v, d = table.shape
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, d), lambda i, ids: (ids[i], 0)),
+        ],
+        out_specs=pl.BlockSpec((1, d), lambda i, ids: (i, 0)),
+    )
+    return pl.pallas_call(
+        _gather_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n, d), table.dtype),
+        interpret=_pallas_mode() == "interpret",
+    )(flat_ids, table)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _gather_core(table, flat_ids, meta):
+    """Row gather with clamped int32 ids; Pallas when eligible.
+    ``meta`` = (rows, dim, dtype_str) — static, so the backward knows
+    the table struct without hauling the table through the residuals."""
+    if gather_eligible(*table.shape):
+        return _pallas_gather(table, flat_ids)
+    return jnp.take(table, flat_ids, axis=0)
+
+
+def _gather_core_fwd(table, flat_ids, meta):
+    return _gather_core(table, flat_ids, meta), flat_ids
+
+
+def _gather_core_bwd(meta, flat_ids, dout):
+    rows, dim, dtype = meta
+    # scatter-add: duplicate ids accumulate, exactly the vjp of take
+    # (and the reference's SelectedRows sparse-grad merge-add)
+    dtab = jnp.zeros((rows, dim), dout.dtype).at[flat_ids].add(dout)
+    return dtab.astype(dtype), None
+
+
+_gather_core.defvjp(_gather_core_fwd, _gather_core_bwd)
+
+
+def embedding_gather(W, Ids, padding_idx=-1):
+    """``W[ids]`` with the framework ``lookup_table`` semantics, Pallas
+    row-DMA gather on TPU (XLA take elsewhere).
+
+    W: [V, D]; Ids: any int shape, a trailing dim of 1 is squeezed
+    (the reference's ``[..., 1]`` id layout); returns ids.shape + (D,).
+    Negative ids clamp to row 0 and ids >= V NaN-fill with no gradient
+    (``jnp.take``'s default fill mode — identical to the unfused
+    lowering, so the rewrite is value-preserving even on corrupt id
+    streams); ``padding_idx`` rows come back zero with no gradient.
+    """
+    ids = Ids
+    squeeze_last = ids.ndim > 1 and ids.shape[-1] == 1
+    if squeeze_last:
+        ids = ids[..., 0]
+    ids = ids.astype(jnp.int32)
+    v, dim = W.shape
+    flat = jnp.clip(ids, 0, v - 1).reshape(-1)
+    meta = (int(v), int(dim), str(W.dtype))
+    out = _gather_core(W, flat, meta).reshape(ids.shape + (dim,))
+    if jnp.issubdtype(out.dtype, jnp.floating):
+        # jnp.take's default fill mode NaN-fills ids >= V (and the vjp
+        # sends them no gradient) — replicate exactly, so the fused op
+        # is value-preserving vs the lookup_table lowering even on
+        # corrupt id streams
+        out = jnp.where((ids >= v)[..., None],
+                        jnp.full_like(out, jnp.nan), out)
+    if padding_idx is not None and padding_idx != -1:
+        out = jnp.where(
+            (ids == padding_idx)[..., None], jnp.zeros_like(out), out)
+    return out

@@ -579,12 +579,29 @@ def mha_reference(q, k, v, bias=None, causal=False, sm_scale=None,
 # ---------------------------------------------------------------------------
 
 def _pick_blocks(tq, tk):
-    """Block shapes, env-tunable for on-chip sweeps
-    (tools/bench_flash.py --blocks writes the decision artifact):
-    PADDLE_TPU_FLASH_BLOCK_Q / PADDLE_TPU_FLASH_BLOCK_K cap the
+    """Block shapes: env caps win (manual override for on-chip sweeps,
+    tools/bench_flash.py --blocks), else the autotune cache's measured
+    winner for this (tq, tk) on this backend, else the hand-set 512
     defaults; divisibility/alignment still enforced here."""
-    cap_q = int(os.environ.get("PADDLE_TPU_FLASH_BLOCK_Q", "512") or 512)
-    cap_k = int(os.environ.get("PADDLE_TPU_FLASH_BLOCK_K", "512") or 512)
+    cap_q = cap_k = None
+    env_q = os.environ.get("PADDLE_TPU_FLASH_BLOCK_Q", "").strip()
+    env_k = os.environ.get("PADDLE_TPU_FLASH_BLOCK_K", "").strip()
+    if env_q:
+        cap_q = int(env_q)
+    if env_k:
+        cap_k = int(env_k)
+    if cap_q is None or cap_k is None:
+        try:
+            from ...autotune import cached_params
+
+            won = cached_params("flash_blocks",
+                                {"block_q": 512, "block_k": 512},
+                                tq=tq, tk=tk)
+            cap_q = cap_q if cap_q is not None else int(won["block_q"])
+            cap_k = cap_k if cap_k is not None else int(won["block_k"])
+        except Exception:  # pragma: no cover - autotune unavailable
+            cap_q = cap_q if cap_q is not None else 512
+            cap_k = cap_k if cap_k is not None else 512
     bq = max(8, min(cap_q, tq))
     while tq % bq:
         bq //= 2
@@ -601,10 +618,27 @@ def flash_min_t():
     +26%) and still edges the kernel at T=256 (attention-level 7-16%,
     both dropout regimes); the kernel wins at T=512 (+15% model-level,
     2.1x over XLA / 4.8x over the upstream jax kernel at T=2048) — so
-    the boundary sits at 512.  Env-tunable so on-chip sweeps can
-    re-decide it; model builders (models/bert.py fuse_attn="auto")
-    route by the same value."""
-    return int(os.environ.get("PADDLE_TPU_FLASH_MIN_T", "512"))
+    the boundary sits at 512.  Model builders (models/bert.py
+    fuse_attn="auto") route by the same value.
+
+    Resolution order: ``PADDLE_TPU_FLASH_MIN_T`` (manual override) →
+    the autotune cache's recorded decision for this backend
+    (``tools/decide_flash_min_t.py --write-cache``, or
+    ``paddle_tpu.autotune.record_flash_min_t`` from an on-chip sweep)
+    → the hand-set 512 default.  ``PADDLE_TPU_AUTOTUNE=0`` restores
+    the pure env/default behavior bit-exactly."""
+    env = os.environ.get("PADDLE_TPU_FLASH_MIN_T", "").strip()
+    if env:
+        return int(env)
+    try:
+        from ...autotune import flash_min_t_decision
+
+        t = flash_min_t_decision()
+        if t is not None:
+            return int(t)
+    except Exception:  # pragma: no cover - autotune unavailable
+        pass
+    return 512
 
 
 def _kernel_applicable(q, k, bias):

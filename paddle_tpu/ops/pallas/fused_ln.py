@@ -49,8 +49,21 @@ def _debug_mask():
     return os.environ.get("PADDLE_TPU_FLASH_DROPOUT_DEBUG") == "iota"
 
 
-def _block_rows(n):
-    bn = min(_BN, n)
+def _block_rows(n, d=None):
+    """Rows per grid step: env cap → autotune-cached winner for this
+    (n, d) → the hand-set default; always a divisor of n."""
+    if d is None:
+        cap = _BN
+    else:
+        try:
+            from ...autotune import cached_block_cap
+
+            cap = cached_block_cap(
+                "fused_ln", "PADDLE_TPU_FUSED_LN_BLOCK_ROWS",
+                "block_rows", _BN, rows=n, d=d)
+        except Exception:  # pragma: no cover - autotune unavailable
+            cap = _BN
+    bn = min(max(cap, 1), n)
     while n % bn:
         bn //= 2
     return max(bn, 1)
@@ -168,7 +181,7 @@ def _xla_reference(x, residual, gamma, beta, rate, eps, seed, debug):
 
 def _fwd_call(x, residual, gamma, beta, rate, eps, seed):
     n, d = x.shape
-    bn = _block_rows(n)
+    bn = _block_rows(n, d)
     grid = (n // bn,)
     debug = _debug_mask()
     interpret = _pallas_mode() == "interpret"
@@ -203,7 +216,7 @@ def _fwd_call(x, residual, gamma, beta, rate, eps, seed):
 
 def _bwd_call(dout, y, gamma, mean, rstd, rate, seed, dtypes):
     n, d = y.shape
-    bn = _block_rows(n)
+    bn = _block_rows(n, d)
     grid = (n // bn,)
     debug = _debug_mask()
     interpret = _pallas_mode() == "interpret"

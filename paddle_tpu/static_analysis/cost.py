@@ -37,8 +37,8 @@ from .interp import interpret_program
 __all__ = [
     "OpCost", "CostReport", "estimate_cost", "register_flops",
     "collective_ici_bytes", "dtype_bytes", "parse_size", "hbm_budget",
-    "sync_latency_ms", "COLLECTIVE_OP_TYPES", "P2P_OP_TYPES",
-    "HOST_IO_OP_TYPES",
+    "sync_latency_ms", "calibration_factors", "COLLECTIVE_OP_TYPES",
+    "P2P_OP_TYPES", "HOST_IO_OP_TYPES",
 ]
 
 _DTYPE_BYTES = {
@@ -81,6 +81,22 @@ def sync_latency_ms():
 from ..ops.io_ops import HOST_IO_OP_TYPES as _EXEC_HOST_IO_OP_TYPES
 
 HOST_IO_OP_TYPES = frozenset(_EXEC_HOST_IO_OP_TYPES)
+
+
+def calibration_factors():
+    """Per-signature predicted-vs-measured calibration factors the
+    autotune loop recorded (``{fusion signature: factor}``) — the
+    measure-and-learn feedback into this cost model.  The fusion gates
+    multiply their predicted deltas by these; ``analyze_program
+    --bench-json`` surfaces them so perf PRs can cite how far the
+    static model sits from silicon.  Empty when autotune is disabled or
+    nothing has been measured."""
+    try:
+        from ..autotune import calibrations
+
+        return calibrations()
+    except Exception:  # pragma: no cover - autotune subsystem broken
+        return {}
 
 
 def hbm_budget(program=None):
@@ -211,6 +227,21 @@ def _softmax_xent_flops(op, ins, outs):
     n = ins[0].local_numel if ins and ins[0].local_numel else \
         _out_numel(outs)
     return 5 * (n or 0)
+
+
+@register_flops("fused_conv_bn_act")
+def _fused_conv_bn_act_flops(op, ins, outs):
+    # the conv's 2·out·Cin·kh·kw plus ~8 FLOPs/element of BN stats +
+    # normalize/affine/act epilogue (outs[0] is Out; MeanOut/VarOut are
+    # [C] noise)
+    conv = _conv2d_flops(op, ins, outs[:1])
+    epilogue = (outs[0].local_numel or 0) if outs else 0
+    return conv + 8 * epilogue
+
+
+@register_flops("fused_embedding_gather")
+def _fused_embedding_gather_flops(op, ins, outs):
+    return _out_numel(outs)  # a gather moves bytes, not FLOPs
 
 
 @register_flops("fused_adam")
@@ -365,9 +396,23 @@ class CostReport:
              "ms/step est. (host_sync_points x "
              "PADDLE_TPU_SYNC_LATENCY_MS)"),
         ]
-        return "\n".join(
+        lines = [
             json.dumps({"metric": m, "value": v, "unit": u + unit_suffix})
-            for m, v, u in rows)
+            for m, v, u in rows
+        ]
+        factors = calibration_factors()
+        if factors:
+            # the autotune feedback loop: measured/predicted gain per
+            # fusion signature, so readers see how far the static model
+            # sits from silicon (and which gates run calibrated)
+            lines.append(json.dumps({
+                "metric": "autotune_calibration_factors",
+                "value": len(factors),
+                "unit": "calibrated fusion signatures" + unit_suffix,
+                "factors": {k: round(v, 4)
+                            for k, v in sorted(factors.items())},
+            }))
+        return "\n".join(lines)
 
     def format_table(self, top=12):
         """Human cost/memory table: totals then the top-N ops by FLOPs."""

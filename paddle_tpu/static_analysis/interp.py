@@ -258,6 +258,36 @@ for _t in ("c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
     register_transfer(_t)(_replicating_transfer)
 
 
+@register_transfer("fused_conv_bn_act")
+def _fused_conv_bn_transfer(op, in_vals, out_val):
+    # conv preserves the batch dim: a batch-sharded input stays
+    # batch-sharded even though the spatial/channel shape changes (the
+    # default rule would degrade the shape change to UNKNOWN).  Applies
+    # to the rank-preserving Out ONLY — the [C]-shaped MeanOut/
+    # VarianceOut running stats are replicated, and stamping them
+    # sharded would report C/parts local elements for a full vector
+    if in_vals and in_vals[0].sharding.is_sharded \
+            and in_vals[0].sharding.dim == 0 \
+            and out_val.shape is not None \
+            and in_vals[0].shape is not None \
+            and len(out_val.shape) == len(in_vals[0].shape) \
+            and out_val.shape[0] == in_vals[0].shape[0]:
+        return in_vals[0].sharding
+    if out_val.shape is not None and len(out_val.shape) == 1:
+        return Sharding.replicated()  # the running-stat outputs
+    return _default_transfer(op, in_vals, out_val)
+
+
+@register_transfer("fused_embedding_gather")
+def _fused_embedding_transfer(op, in_vals, out_val):
+    # the gathered slab follows the ID stream's (batch) sharding; the
+    # table's row sharding does not shard the output (each worker
+    # resolves its batch's rows — GSPMD inserts the halo exchange)
+    if len(in_vals) > 1 and in_vals[1].sharding.is_sharded:
+        return in_vals[1].sharding
+    return Sharding.replicated()
+
+
 @register_transfer("c_reducescatter")
 def _reducescatter_transfer(op, in_vals, out_val):
     parts = max((v.sharding.parts for v in in_vals

@@ -9,6 +9,18 @@ os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 )
 
+# Hermetic autotune cache: the fusion gates consult the per-user cache
+# (~/.cache/paddle_tpu/...), and a developer's local sweep recording a
+# calibration factor would silently flip gate decisions inside the
+# suite.  Point at a per-process temp file (explicit env still wins;
+# autotune tests monkeypatch their own paths on top).
+import tempfile
+
+os.environ.setdefault(
+    "PADDLE_TPU_AUTOTUNE_CACHE",
+    os.path.join(tempfile.gettempdir(),
+                 "paddle_tpu_autotune_test_%d.json" % os.getpid()))
+
 # Analyzer brackets every rewrite pass with the static_analysis verifier
 # (off by default in production, ON in tests): a pass that breaks
 # producer/consumer links fails HERE with structured diagnostics instead

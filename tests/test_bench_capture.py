@@ -113,3 +113,22 @@ def test_xla_cost_analysis_counts_scan_body_once():
     f1, f4 = flops(one), flops(scan4)
     assert f1 > 0
     assert abs(f4 - f1) / f1 < 0.05, (f1, f4)
+
+
+def test_dedupe_metrics_one_record_per_metric_last_wins(bench):
+    """Satellite (ISSUE 6): the train children print each *_per_chip
+    metric twice (measured line first, MFU-enriched re-print after the
+    AOT cross-check) — the orchestrator must emit ONE record per metric,
+    the LAST (enriched) one, at the first occurrence's position, with
+    non-metric lines passing through."""
+    plain = {"metric": "resnet50_imagenet_train_images_per_sec_per_chip",
+             "value": 2000.0, "unit": "images/sec/chip"}
+    enriched = dict(plain, mfu_analytic=0.25, mfu_xla=0.38)
+    other = {"metric": "other_metric", "value": 1}
+    marker = {"compiled": True}
+    out = bench._dedupe_metrics([plain, marker, other, enriched])
+    assert out == [enriched, marker, other]
+    # a clean single emission is untouched
+    assert bench._dedupe_metrics([plain, other]) == [plain, other]
+    # duplicate-free input of N metrics stays N records
+    assert len([l for l in out if l.get("metric")]) == 2

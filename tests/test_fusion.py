@@ -790,3 +790,368 @@ class TestPallasFallback:
         batch = bert.make_fake_batch(2, 32, cfg, rng)
         out, _ = run_steps(main, startup, batch, [loss.name], steps=2)
         assert np.isfinite(out).all()
+
+
+# ---------------------------------------------------------------------------
+# conv + batch_norm + act family (ISSUE 6)
+# ---------------------------------------------------------------------------
+
+def build_conv_bn(act="relu", train=True, width=8, hw=16):
+    """conv(bias-free) -> batch_norm(act) x2 -> pool -> fc: two
+    conv_bn_act sites (one with act, one without)."""
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data(name="img", shape=[width, hw, hw],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        c = fluid.layers.conv2d(img, num_filters=width, filter_size=3,
+                                padding=1, bias_attr=False)
+        h = fluid.layers.batch_norm(c, act=act)
+        c2 = fluid.layers.conv2d(h, num_filters=width, filter_size=3,
+                                 padding=1, bias_attr=False)
+        h2 = fluid.layers.batch_norm(c2, act=None)
+        pool = fluid.layers.pool2d(h2, pool_size=hw, pool_type="avg")
+        pred = fluid.layers.fc(pool, size=10, act="softmax")
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.cross_entropy(input=pred, label=label))
+        if train:
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def conv_feed(rng, bs=4, width=8, hw=16):
+    return {"img": rng.randn(bs, width, hw, hw).astype("float32"),
+            "label": rng.randint(0, 10, (bs, 1)).astype("int64")}
+
+
+class TestConvBnActFamily:
+    def test_rewrite_golden_with_and_without_act(self):
+        main, startup, loss = build_conv_bn()
+        fused, report = fusion.resolve_fused_program(
+            main, targets=[loss.name])
+        assert report.counts().get("conv_bn_act") == 2
+        types = op_types(fused)
+        assert types.count("fused_conv_bn_act") == 2
+        assert types.count("fused_conv_bn_act_grad") == 2
+        assert types.count("batch_norm") == 0
+        assert types.count("conv2d") == 0
+        # one site carries the act, the other is the bare conv+bn close
+        acts = [op.attrs.get("act_type")
+                for op in fused.global_block().ops
+                if op.type == "fused_conv_bn_act"]
+        assert sorted(acts) == ["", "relu"]
+        verify_program(fused, targets=[loss.name])
+
+    def test_resnet_builder_fuses_every_conv_bn_site(self):
+        from paddle_tpu.models import resnet
+
+        fluid.unique_name.switch()
+        main, startup, feeds, loss, acc = resnet.build(dataset="cifar10")
+        fused, report = fusion.resolve_fused_program(
+            main, targets=[loss.name])
+        # depth-20 cifar resnet: 1 stem + 18 block convs + 2 shortcut
+        # projections, every one behind a batch_norm
+        assert report.counts().get("conv_bn_act") == 21
+        assert op_types(fused).count("batch_norm") == 0
+
+    def test_train_bit_exact_family_isolated(self, monkeypatch):
+        """Fusion-on vs conv-family-gated-off over real train steps is
+        BIT-EXACT on the XLA composite path (the acceptance bar)."""
+        rng = np.random.RandomState(0)
+        feed = conv_feed(rng)
+
+        def arm(gate):
+            if gate is not None:
+                monkeypatch.setenv("PADDLE_TPU_CONV_BN_MIN_BYTES", gate)
+            else:
+                monkeypatch.delenv("PADDLE_TPU_CONV_BN_MIN_BYTES",
+                                   raising=False)
+            main, startup, loss = build_conv_bn()
+            out, _ = run_steps(main, startup, feed, [loss.name], steps=4)
+            return out
+
+        on = arm(None)
+        off = arm("1000000000000")
+        assert np.array_equal(on, off)
+
+    def test_infer_program_rewrites_forward_only(self):
+        main, startup, loss = build_conv_bn(train=False)
+        fused, report = fusion.resolve_fused_program(
+            main, targets=[loss.name])
+        assert report.counts().get("conv_bn_act") == 2
+        assert not any(t.endswith("_grad") for t in op_types(fused))
+
+    def test_fetched_conv_out_is_never_fused_away(self):
+        main, startup, loss = build_conv_bn()
+        conv_out = next(op.outputs["Output"][0]
+                        for op in main.global_block().ops
+                        if op.type == "conv2d")
+        fused, report = fusion.resolve_fused_program(
+            main, targets=[loss.name, conv_out])
+        assert report.counts().get("conv_bn_act", 0) <= 1  # site 1 kept
+        assert conv_out in {n for op in fused.global_block().ops
+                            for n in op.output_arg_names}
+
+    def test_running_stats_update_identically(self, monkeypatch):
+        """MeanOut/VarianceOut ride the fused op: after N steps the
+        running stats in scope match the unfused run bit-for-bit."""
+        rng = np.random.RandomState(1)
+        feed = conv_feed(rng)
+
+        def arm(gate):
+            if gate is not None:
+                monkeypatch.setenv("PADDLE_TPU_CONV_BN_MIN_BYTES", gate)
+            else:
+                monkeypatch.delenv("PADDLE_TPU_CONV_BN_MIN_BYTES",
+                                   raising=False)
+            main, startup, loss = build_conv_bn()
+            mean_name = next(
+                op.outputs["MeanOut"][0]
+                for op in main.global_block().ops
+                if op.type == "batch_norm")
+            exe = fluid.Executor()
+            scope = Scope()
+            with scope_guard(scope):
+                exe.run(startup)
+                for _ in range(3):
+                    exe.run(main, feed=feed, fetch_list=[loss.name])
+                return np.asarray(scope.get(mean_name))
+
+        on = arm(None)
+        off = arm("1000000000000")
+        assert np.array_equal(on, off)
+
+    def test_cost_gate_skip_names_uncalibrated_autotune(
+            self, monkeypatch, tmp_path):
+        """Satellite: the advisory reason carries the autotune state —
+        an empty cache reads 'uncalibrated' with the signature to sweep."""
+        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE_CACHE",
+                           str(tmp_path / "at.json"))
+        monkeypatch.setenv("PADDLE_TPU_CONV_BN_MIN_BYTES", "1000000000000")
+        from paddle_tpu import autotune
+        autotune.reset()
+        main, startup, loss = build_conv_bn()
+        fused, report = fusion.resolve_fused_program(
+            main, targets=[loss.name])
+        assert report.counts().get("conv_bn_act") is None
+        skips = [s for s in report.skipped if s.family == "conv_bn_act"]
+        assert skips
+        assert "uncalibrated" in skips[0].reason
+        assert "conv_bn_act|" in skips[0].reason  # the signature to sweep
+        autotune.reset()
+
+    def test_calibration_flips_the_gate(self, monkeypatch, tmp_path):
+        """The measure-and-learn loop closed: a recorded calibration
+        factor scales the predicted delta past the gate."""
+        from paddle_tpu import autotune
+
+        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE_CACHE",
+                           str(tmp_path / "at.json"))
+        autotune.reset()
+        main, startup, loss = build_conv_bn()
+        # gate sits just above the un-calibrated predicted saving
+        conv_out_bytes = 8 * 16 * 16 * 4  # batch=1 resolution
+        monkeypatch.setenv("PADDLE_TPU_CONV_BN_MIN_BYTES",
+                           str(conv_out_bytes * 2))
+        _, rep_uncal = fusion.resolve_fused_program(
+            main, targets=[loss.name])
+        assert rep_uncal.counts().get("conv_bn_act") is None
+        # a silicon sweep measured 4x the predicted gain -> gate opens
+        ov = next(op for op in main.global_block().ops
+                  if op.type == "conv2d").outputs["Output"][0]
+        shape = tuple(main.global_block()._find_var_recursive(ov).shape)
+        for act in ("relu", "identity"):
+            autotune.record(
+                autotune.sweep_signature(
+                    "conv_bn_act", {"shape": shape, "dtype": "float32",
+                                    "act": act}),
+                {"params": {}, "calibration": 4.0})
+        _, rep_cal = fusion.resolve_fused_program(main,
+                                                  targets=[loss.name])
+        assert rep_cal.counts().get("conv_bn_act") == 2
+        autotune.reset()
+
+    def test_pallas_epilogue_interpret_close_to_xla(self, monkeypatch):
+        """PADDLE_TPU_PALLAS=interpret routes the NHWC lane-aligned
+        epilogue through the kernel; tolerance documented ~1e-6."""
+        monkeypatch.setenv("PADDLE_TPU_PALLAS", "interpret")
+        import jax.numpy as jnp
+        from paddle_tpu.ops.registry import (LoweringContext, call_op,
+                                             get_op_def)
+
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(2, 8, 8, 128).astype("float32"))
+        w = jnp.asarray(rng.randn(128, 128, 1, 1).astype("float32") * .1)
+        g = jnp.asarray(rng.rand(128).astype("float32") + 0.5)
+        b = jnp.asarray(rng.randn(128).astype("float32"))
+        attrs = {"strides": [1, 1], "paddings": [0, 0],
+                 "dilations": [1, 1], "groups": 1,
+                 "data_format": "NHWC", "data_layout": "NHWC",
+                 "epsilon": 1e-5, "momentum": 0.9, "is_test": False,
+                 "act_type": "relu"}
+        ins = {"Input": [x], "Filter": [w], "Scale": [g], "Bias": [b],
+               "Mean": [jnp.zeros(128)], "Variance": [jnp.ones(128)]}
+        fused = get_op_def("fused_conv_bn_act")
+        pal = call_op(fused, LoweringContext(), ins, attrs, 1)["Out"][0]
+        monkeypatch.setenv("PADDLE_TPU_PALLAS", "off")
+        xla = call_op(fused, LoweringContext(), ins, attrs, 1)["Out"][0]
+        np.testing.assert_allclose(np.asarray(pal), np.asarray(xla),
+                                   rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# embedding gather family (ISSUE 6)
+# ---------------------------------------------------------------------------
+
+def build_embedding(dim=128, vocab=100, slot_len=16, train=True):
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data(name="ids", shape=[slot_len],
+                                dtype="int64")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(
+            ids, size=[vocab, dim], padding_idx=0,
+            param_attr=fluid.ParamAttr(name="fused_emb_tab"))
+        s = fluid.layers.reduce_sum(emb, dim=1)
+        pred = fluid.layers.fc(s, size=10, act="softmax")
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.cross_entropy(input=pred, label=label))
+        if train:
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+class TestEmbeddingGatherFamily:
+    def test_rewrite_golden(self):
+        main, startup, loss = build_embedding()
+        fused, report = fusion.resolve_fused_program(
+            main, targets=[loss.name])
+        assert report.counts().get("embedding_gather") == 1
+        types = op_types(fused)
+        assert types.count("fused_embedding_gather") == 1
+        assert types.count("fused_embedding_gather_grad") == 1
+        assert "lookup_table" not in types
+        assert "lookup_table_grad" not in types
+        verify_program(fused, targets=[loss.name])
+
+    def test_train_bit_exact_family_isolated(self, monkeypatch):
+        rng = np.random.RandomState(0)
+        feed = {"ids": rng.randint(0, 100, (4, 16)).astype("int64"),
+                "label": rng.randint(0, 10, (4, 1)).astype("int64")}
+
+        def arm(gate):
+            if gate is not None:
+                monkeypatch.setenv("PADDLE_TPU_EMBED_FUSE_MIN_BYTES",
+                                   gate)
+            else:
+                monkeypatch.delenv("PADDLE_TPU_EMBED_FUSE_MIN_BYTES",
+                                   raising=False)
+            main, startup, loss = build_embedding()
+            out, _ = run_steps(main, startup, feed, [loss.name], steps=4)
+            return out
+
+        on = arm(None)
+        off = arm("1000000000000")
+        assert np.array_equal(on, off)
+
+    def test_unaligned_dim_skips_with_reason(self):
+        main, startup, loss = build_embedding(dim=48)
+        fused, report = fusion.resolve_fused_program(
+            main, targets=[loss.name])
+        assert report.counts().get("embedding_gather") is None
+        skips = [s for s in report.skipped
+                 if s.family == "embedding_gather"]
+        assert skips and "lane-aligned" in skips[0].reason
+
+    def test_deepfm_device_table_path_fuses(self):
+        """The DeepFM device-table migration: lane-aligned tables fuse,
+        the dim-1 first-order tables are correctly refused, and the
+        model trains to finite losses through the fused gather."""
+        from paddle_tpu.models import ctr
+
+        losses, report = ctr.run_deepfm_device_table_steps(
+            steps=3, num_slots=2, slot_len=3, vocab=200, batch=8,
+            embed_dim=128)
+        assert report.counts().get("embedding_gather") == 2
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[0] != losses[-1]  # it actually trains
+
+    def test_lint_advisory_covers_new_families(self, monkeypatch,
+                                               tmp_path):
+        """Satellite: fusible-pattern-not-fused surfaces the gated-out
+        conv+bn+act and embedding-gather sites with the autotune
+        cost-gate reason."""
+        from paddle_tpu import autotune
+
+        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE_CACHE",
+                           str(tmp_path / "at.json"))
+        monkeypatch.setenv("PADDLE_TPU_EMBED_FUSE_MIN_BYTES",
+                           "1000000000000")
+        autotune.reset()
+        main, startup, loss = build_embedding()
+        diags = verify_program(main, targets=[loss.name])
+        hits = [d for d in diags
+                if d.check == "fusible-pattern-not-fused"
+                and "embedding_gather" in d.message]
+        assert hits
+        assert any("uncalibrated" in d.message for d in hits)
+        autotune.reset()
+
+
+class TestConvBnActAmp:
+    def test_amp_cast_sandwich_is_absorbed(self):
+        """The bf16 AMP rewrite cast-sandwiches BN (conv -> cast f32 ->
+        bn -> cast bf16 -> act); the matcher absorbs the pair — every
+        resnet conv+bn site still fuses under AMP (the bench config)."""
+        from paddle_tpu.models import resnet
+
+        fluid.unique_name.switch()
+        main, startup, feeds, loss, acc = resnet.build(
+            dataset="cifar10", amp=True)
+        fused, report = fusion.resolve_fused_program(
+            main, targets=[loss.name])
+        assert report.counts().get("conv_bn_act") == 21
+        assert op_types(fused).count("batch_norm") == 0
+        # the rewrite note documents the AMP tolerance exception
+        conv_rewrites = [r for r in report.applied
+                         if r.family == "conv_bn_act"]
+        assert any("AMP cast sandwich" in r.note for r in conv_rewrites)
+
+    def test_amp_train_within_documented_tolerance(self, monkeypatch):
+        """AMP A/B: losses track within float-noise tolerance.  NOT
+        bit-exact by design — absorbing the cast sandwich lets XLA
+        reassociate the BN scale/bias gradient reductions (f32-stored
+        grads show ~1e-4 relative noise; bf16-stored conv grads round
+        identically) — the documented exception, mirroring the
+        softmax_xent ~1e-6 precedent."""
+        import jax.numpy as jnp
+        from paddle_tpu.models import resnet
+
+        rng = np.random.RandomState(0)
+        feed = {"img": jnp.asarray(
+                    rng.randn(4, 3, 32, 32).astype("float32")),
+                "label": jnp.asarray(
+                    rng.randint(0, 10, (4, 1)).astype("int64"))}
+
+        def arm(gate):
+            if gate is not None:
+                monkeypatch.setenv("PADDLE_TPU_CONV_BN_MIN_BYTES", gate)
+            else:
+                monkeypatch.delenv("PADDLE_TPU_CONV_BN_MIN_BYTES",
+                                   raising=False)
+            fluid.unique_name.switch()
+            main, startup, feeds, loss, acc = resnet.build(
+                dataset="cifar10", amp=True)
+            exe = fluid.Executor()
+            with scope_guard(Scope()):
+                exe.run(startup)
+                return [float(np.asarray(exe.run(
+                    main, feed=feed, fetch_list=[loss])[0]).reshape(()))
+                    for _ in range(3)]
+
+        on = arm(None)
+        off = arm("1000000000000")
+        assert np.isfinite(on).all() and np.isfinite(off).all()
+        np.testing.assert_allclose(on, off, rtol=2e-2, atol=1e-2)

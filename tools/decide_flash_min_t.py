@@ -9,11 +9,22 @@ For each (T, dropout) the kernel should engage iff it beats the XLA
 path; the recommended MIN_T is the smallest T where the kernel wins at
 the TRAINING configuration (dropout on) and keeps winning above.
 
-Usage:  python tools/decide_flash_min_t.py [hw_results/bench_flash_sweep.txt]
+The decision rule itself lives in the autotune harness
+(``paddle_tpu.autotune.decide_threshold`` — this tool's original logic,
+generalized), and ``--write-cache`` persists the recommendation into the
+autotune cache so ``flash_min_t()`` consumes it as a measured decision
+instead of a hand-set env default (``PADDLE_TPU_FLASH_MIN_T`` stays the
+manual override; ``PADDLE_TPU_AUTOTUNE=0`` ignores the cache).
+
+Usage:  python tools/decide_flash_min_t.py [sweep.txt] [--write-cache]
 """
 
+import os
 import re
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 
 def parse(path):
@@ -31,8 +42,20 @@ def parse(path):
 
 
 def main():
-    path = sys.argv[1] if len(sys.argv) > 1 else \
-        "hw_results/bench_flash_sweep.txt"
+    # positional args exclude flags AND their value operands
+    # (--backend NAME), or the backend name would be taken as the path
+    args = []
+    skip = False
+    for a in sys.argv[1:]:
+        if skip:
+            skip = False
+            continue
+        if a == "--backend":
+            skip = True
+            continue
+        if not a.startswith("--"):
+            args.append(a)
+    path = args[0] if args else "hw_results/bench_flash_sweep.txt"
     rows = parse(path)
     if not rows:
         raise SystemExit("no sweep rows parsed from %s" % path)
@@ -52,14 +75,17 @@ def main():
             print("%-6d %-6.1f %10.3f %10.3f  %s (%.2fx)"
                   % (t, d, x, p, w, x / p))
     # recommendation keyed on the training config: the largest dropout
-    # in the sweep (bench trains with attention dropout on)
+    # in the sweep (bench trains with attention dropout on).  The rule
+    # is the autotune harness's generalized threshold decision.
+    from paddle_tpu.autotune import decide_threshold
+
     d_train = max(drops)
-    per_t = wins.get(d_train, {})
-    rec = None
-    for t in sorted(per_t):
-        if per_t[t] and all(per_t[u] for u in per_t if u >= t):
-            rec = t
-            break
+    pairs = {t: (rows.get((t, d_train, "pallas")),
+                 rows.get((t, d_train, "xla")))
+             for t in ts
+             if (t, d_train, "pallas") in rows
+             and (t, d_train, "xla") in rows}
+    rec = decide_threshold(pairs)
     if rec is None:
         print("\nrecommendation: kernel never cleanly wins at drop=%.1f "
               "— keep PADDLE_TPU_FLASH_MIN_T above %d (XLA path)"
@@ -68,6 +94,34 @@ def main():
         print("\nrecommendation: PADDLE_TPU_FLASH_MIN_T=%d "
               "(kernel wins at drop=%.1f from T=%d upward)"
               % (rec, d_train, rec))
+    if "--write-cache" in sys.argv:
+        from paddle_tpu.autotune import (autotune_enabled, cache_path,
+                                         record_flash_min_t)
+
+        # the sweep artifact came from a chip, but this tool often runs
+        # on a workstation: the decision must be filed under the backend
+        # the TRAINING process will look it up with (its
+        # jax.default_backend(); the axon tunnel reports 'axon').
+        # --backend NAME overrides; default assumes on-chip artifacts.
+        backend = None
+        for n, a in enumerate(sys.argv):
+            if a == "--backend" and n + 1 < len(sys.argv):
+                backend = sys.argv[n + 1]
+        if backend is None:
+            backend = "tpu"
+            print("(filing the decision under backend=tpu — the sweep "
+                  "artifact is an on-chip measurement; pass --backend "
+                  "NAME to override, e.g. 'axon' for the tunnel plugin)")
+        if rec is None:
+            print("nothing to cache (no clean win)")
+        elif not autotune_enabled():
+            print("PADDLE_TPU_AUTOTUNE=0 — cache write skipped")
+        else:
+            record_flash_min_t(rec, rows=pairs, backend=backend)
+            print("cached flash_min_t=%d (backend=%s) in %s — "
+                  "flash_min_t() now uses the measured decision on that "
+                  "backend (env var still overrides)"
+                  % (rec, backend, cache_path()))
 
     # block-shape decisions, if the --blocks sweep artifact exists
     # (tools/bench_flash.py --blocks; watcher step bench_flash_blocks)

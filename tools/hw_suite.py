@@ -198,9 +198,21 @@ def build_steps():
          PADDLE_BENCH_RESNET_BS="256")
     # channels-last: the TPU-native conv layout (layout-parity proven
     # by tests/test_models.py); decides whether XLA's internal NCHW
-    # re-layout costs real transposes on this chip
+    # re-layout costs real transposes on this chip.  With the ISSUE-6
+    # conv_bn_act family this arm ALSO engages the Pallas BN+act
+    # epilogue kernel (channels-last eligibility) — the headline
+    # candidate for ResNet-50 MFU >= 0.30
     item("bench_resnet_nhwc", "resnet", 360, 300,
          PADDLE_BENCH_RESNET_FMT="NHWC")
+    # conv_bn_act fusion control: the family cost-gated OFF on the same
+    # default config — the single-variable silicon A/B of the ISSUE-6
+    # rewrite (its CPU twin lives in bench.py --child kernels)
+    item("bench_resnet_nofuse_convbn", "resnet", 360, 300,
+         PADDLE_TPU_CONV_BN_MIN_BYTES="1000000000000")
+    # ISSUE-6 kernel-gap A/Bs: conv fusion speedup + DeepFM host- vs
+    # device-resident tables (the Pallas gather path); emits
+    # resnet50_conv_fusion_speedup / deepfm_device_table_speedup
+    item("bench_kernels", "kernels", 480, 480)
     # space-to-depth stem (models/resnet.py _s2d_stem): folds the 7x7
     # stride-2 3-channel stem — the classic MXU-underfill — into a
     # dense 4x4/s1 conv over 12 channels (the TPU ResNet stem recipe)

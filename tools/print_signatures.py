@@ -84,6 +84,7 @@ MODULES = [
     "paddle_tpu.trainer_desc",
     "paddle_tpu.analysis",
     "paddle_tpu.static_analysis",
+    "paddle_tpu.autotune",
     "paddle_tpu.resilience",
     "paddle_tpu.resilience.faults",
     "paddle_tpu.resilience.retry",

@@ -1,11 +1,64 @@
 """Parse a jax profiler xplane.pb and print per-op time on the device plane
-(MFU diagnosis aid; framework_op_stats without the tensorboard stack)."""
+(MFU diagnosis aid; framework_op_stats without the tensorboard stack).
+
+``--suggest-kernels`` ranks the UNFUSED hot ops against the available
+Pallas kernel families (attention, dropout+add+LN, conv+BN+act epilogue,
+embedding gather) — the triage view for "which kernel closes the next
+gap", feeding the autotune sweep queue."""
 import collections
 import glob
 import os
 import sys
 
 from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+# substring -> (Pallas family, pointer).  Matched against lowercased XLA
+# op names on the device plane; an op already running as a Mosaic/Pallas
+# custom call is counted as fused and excluded.
+KERNEL_FAMILIES = [
+    (("convolution", "conv"), "conv_bn_act",
+     "ops/pallas/conv_bn_act.py epilogue rides this conv's output — "
+     "check fusion_report() for why the site did not fuse"),
+    (("batch-norm", "batchnorm", "batch_norm"), "conv_bn_act",
+     "training-mode BN stats/normalize belong in the fused epilogue"),
+    (("gather",), "embedding_gather",
+     "ops/pallas/embedding.py row-DMA gather (lane-aligned dims)"),
+    (("scatter",), "embedding_gather",
+     "embedding backward — rides the fused gather's scatter-add vjp"),
+    (("softmax", "reduce-window"), "flash_attention",
+     "blocked online-softmax attention (PADDLE_TPU_FLASH_MIN_T gates)"),
+    (("layer-norm", "layernorm", "rsqrt"), "fused_dropout_add_ln",
+     "one-pass dropout+residual+LN kernel (ops/pallas/fused_ln.py)"),
+]
+
+_FUSED_MARKERS = ("mosaic", "pallas", "custom-call", "tpu_custom_call")
+
+
+def suggest_kernels(by_name, total, top=10):
+    """Rank unfused hot ops against the Pallas families.  ``by_name``:
+    {op name: duration_ps}; prints one line per suggested site with its
+    time share and the family that could take it."""
+    rows = []
+    for name, ps in by_name.most_common():
+        low = name.lower()
+        if any(m in low for m in _FUSED_MARKERS):
+            continue  # already a hand-written kernel
+        for subs, family, hint in KERNEL_FAMILIES:
+            if any(s in low for s in subs):
+                rows.append((ps, name, family, hint))
+                break
+    if not rows:
+        print("no unfused ops matched a Pallas family — the hot path "
+              "is already kernel-covered (or this is not a device "
+              "plane)")
+        return rows
+    print("== kernel suggestions (unfused hot ops vs available Pallas "
+          "families) ==")
+    for ps, name, family, hint in rows[:top]:
+        print("%8.3f ms  %5.1f%%  -> %-18s %s\n%s^ %s" % (
+            ps / 1e9, 100.0 * ps / total if total else 0.0, family,
+            name[:80], " " * 12, hint))
+    return rows
 
 
 def top_ops(trace_dir, n=35):
@@ -49,6 +102,8 @@ def top_ops(trace_dir, n=35):
         for name, ps in by_name.most_common(n):
             print("%8.3f ms  %5.1f%%  x%-4d %s" % (
                 ps / 1e9, 100.0 * ps / total, cnt[name], name[:110]))
+        if "--suggest-kernels" in sys.argv:
+            suggest_kernels(by_name, total)
     if not printed:
         # e.g. a CPU smoke: the CPU xplane has no device op line — name
         # the planes so a silent run is diagnosable, not mysterious
