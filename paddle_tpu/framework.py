@@ -415,6 +415,25 @@ class Block:
         )
 
 
+# per-var attrs Program.clone() must preserve (execution semantics
+# depend on them): feed-shape validation + targeted feed errors, ZeRO-1
+# accumulator classification, and sharding marks on non-Parameter vars.
+# static_analysis/fusion.py aliases this roster for its clone paths.
+CLONE_VAR_MARKS = ("need_check_feed", "feed_hint",
+                   "_is_optimizer_state", "_is_distributed",
+                   "shard_spec")
+
+# program-level marks clone() preserves: the auto-parallelism planner's
+# applied runtime knobs (apply_plan) and the HBM budget — a clone of an
+# auto-transpiled program must keep running the plan it was priced
+# with.  Deliberately NOT _num_trainers/_trainer_id/_pipeline_stage:
+# those describe a specific worker's place in a topology, and emitters
+# that clone to BUILD a topology (transpile_pipeline, fusion's resolved
+# clones via _PROGRAM_MARKS) manage them explicitly.
+CLONE_PROGRAM_MARKS = ("_shard_optimizer_state", "_allreduce_bucket_mb",
+                       "_hbm_budget")
+
+
 class Program:
     """A list of Blocks; block 0 is the global block (reference
     framework.py:2775, ProgramDesc at framework.proto:184)."""
@@ -501,6 +520,9 @@ class Program:
         dropout/batch_norm-style ops (reference framework.py:3004)."""
         p = Program()
         p.random_seed = self.random_seed
+        for mark in CLONE_PROGRAM_MARKS:
+            if hasattr(self, mark):
+                setattr(p, mark, getattr(self, mark))
         p.blocks = []
         for b in self.blocks:
             nb = Block(p, b.idx, b.parent_idx)
@@ -533,6 +555,16 @@ class Program:
                         is_data=v.is_data,
                         type=v.type,
                     )
+                # per-var marks execution semantics depend on — feed
+                # validation, ZeRO-1 accumulator classification,
+                # sharding marks on non-Parameter vars.  A clone that
+                # dropped _is_optimizer_state made every planner-emitted
+                # dp+zero1 worker silently NOT shard its optimizer state
+                # (fusion.py worked around this per-clone; clone itself
+                # is the right place)
+                for mark in CLONE_VAR_MARKS:
+                    if hasattr(v, mark):
+                        setattr(nv, mark, getattr(v, mark))
                 nb.vars[name] = nv
             for op in b.ops:
                 # for_test prunes the backward+optimize+lr-sched tail

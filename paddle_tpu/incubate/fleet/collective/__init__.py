@@ -34,6 +34,14 @@ class DistributedStrategy:
         self.mode = "collective"
         self.collective_mode = "grad_allreduce"
         self.nccl_comm_num = 1
+        # auto=True replaces the hand-picked collective_mode with the
+        # auto-parallelism planner (parallel.auto_transpile): the
+        # candidate search runs over the worker count at minimize time,
+        # a DP-family winner is applied in place, and the full
+        # PlanResult lands on program._auto_plan (non-DP winners —
+        # pipeline stage sets — are emitted there for the caller to
+        # deploy; one worker's in-place program cannot express them)
+        self.auto = False
 
 
 class Collective(Fleet):
@@ -170,6 +178,22 @@ class CollectiveOptimizer(DistributedOptimizer):
         if self._fleet is not None:
             program._num_trainers = self._fleet.worker_num()
             program._trainer_id = self._fleet.worker_index()
+        if self._strategy and getattr(self._strategy, "auto", False):
+            # DistributedStrategy.auto=True: search the placement space
+            # instead of assuming grad-allreduce DP
+            from ....framework import default_startup_program
+            from ....parallel.planner import (apply_plan, auto_transpile,
+                                              resolve_cluster_spec)
+
+            nworkers = getattr(program, "_num_trainers", 1) or 1
+            if nworkers > 1:
+                su = startup_program or default_startup_program()
+                result = auto_transpile(
+                    program, resolve_cluster_spec(chips=nworkers),
+                    startup_program=su, targets=[loss.name])
+                apply_plan(program, result, startup_program=su,
+                           rank=getattr(program, "_trainer_id", 0))
+            return ops, params_grads
         if self._strategy and getattr(self._strategy, "use_local_sgd",
                                       False):
             # reference strategy knob → collective.py LocalSGD:

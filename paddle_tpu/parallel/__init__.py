@@ -57,8 +57,15 @@ class SPMDRunner:
             getattr(build_strategy, "batch_merge_repeat", 1) or 1)
         self.iters_per_run = int(
             getattr(exec_strategy, "num_iteration_per_run", 1) or 1)
+        # EITHER source enables ZeRO-1: the BuildStrategy flag, or the
+        # program-level stamp the auto-parallelism planner's in-place
+        # apply (planner.apply_plan) leaves — a default-constructed
+        # BuildStrategy is indistinguishable from an explicit False, so
+        # to disable a stamped program's sharding, clear the stamp
+        # (program._shard_optimizer_state = False), not the flag
         self.shard_opt_state = bool(
-            getattr(build_strategy, "shard_optimizer_state", False))
+            getattr(build_strategy, "shard_optimizer_state", False)
+            or getattr(program, "_shard_optimizer_state", False))
         self._last_fusion_report = None
         self._cache = {}
         from ..pipeline import FeedCache
@@ -240,3 +247,9 @@ from .moe import (moe_ffn, moe_ffn_local, init_moe_params,  # noqa: E402,F401
 
 __all__ += ["moe_ffn", "moe_ffn_local", "init_moe_params",
             "moe_dispatch", "moe_combine"]
+
+from .planner import (ClusterSpec, PlanCandidate, PlanResult,  # noqa: E402,F401
+                      auto_transpile)
+
+__all__ += ["ClusterSpec", "PlanCandidate", "PlanResult",
+            "auto_transpile"]

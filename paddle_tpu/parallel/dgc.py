@@ -65,7 +65,9 @@ def dgc_exchange(grad, residual, momentum, axis_name, sparsity=0.999,
     momentum-corrected accumulated gradient, divided by the axis size
     (mean, matching the dense DP convention).
     """
-    n = jax.lax.axis_size(axis_name)  # static — no extra collective
+    from ..jax_compat import axis_size
+
+    n = axis_size(axis_name)  # static — no extra collective
     # momentum correction (paper eq. 4/5): accumulate THEN select
     m_new = momentum_coef * momentum + grad
     if use_nesterov:

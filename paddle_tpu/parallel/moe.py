@@ -170,7 +170,7 @@ def moe_ffn(x, params, mesh, axis_name, capacity_factor=1.25,
             activation=jax.nn.gelu):
     """Global entry: x [B, T, D] batch-sharded over ``axis_name``,
     expert weights sharded on their expert dim.  Returns (y, aux)."""
-    from jax import shard_map
+    from ..jax_compat import shard_map
 
     n = mesh.shape[axis_name]
     b, t, d = x.shape

@@ -83,7 +83,7 @@ def gpipe(stage_fn, stage_params, x, mesh, axis_name, num_microbatches,
 
     fn = jax.checkpoint(stage_fn) if remat else stage_fn
 
-    from jax import shard_map
+    from ..jax_compat import shard_map
 
     shift_perm = [(i, i + 1) for i in range(n - 1)]
 

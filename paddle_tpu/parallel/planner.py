@@ -1,0 +1,1075 @@
+"""Auto-parallelism planner: search the placement/sharding space the
+static analyzer can already price.
+
+The reference stack makes distribution a USER decision: pick
+``DistributeTranspiler`` vs fleet ``DistributedStrategy``, pick DP vs
+pipeline vs MoE vs ulysses, pick the allreduce bucket size — then hope.
+Following "Synthesizing Optimal Parallelism Placement and Reduction
+Strategies on Hierarchical Systems" (arXiv:2110.10548, PAPERS.md), this
+module closes the loop with the ingredients PR 1-6 built:
+
+* **candidate enumeration** — data-parallel (with bucketed-allreduce
+  launch counts and optional ZeRO-1 optimizer-state sharding seeded
+  through the interp's sharding lattice), pipeline stage splits (cut
+  points searched over layer boundaries by a bounded branch-and-bound
+  over per-layer fwd+bwd FLOP loads, reusing ``transpile_pipeline``'s
+  stage-assignment rules), and MoE / ulysses replication where the
+  program already carries those collectives;
+* **pricing** — every candidate's per-worker programs go through the
+  PR-3 cost model (:func:`~paddle_tpu.static_analysis.cost.price_plan`)
+  against a :class:`ClusterSpec`, multiplied by the PR-6 autotune
+  ``calibration_factors()`` so estimates track measured silicon;
+* **pruning** — candidates whose peak HBM exceeds the budget
+  (``PADDLE_TPU_HBM_BUDGET`` or ``ClusterSpec.hbm_gb``) are marked
+  infeasible; when NOTHING fits, the planner degrades to the
+  least-memory plan instead of crashing;
+* **proof** — the winner's collective schedule must pass the PR-3
+  three-layer deadlock-freedom proof
+  (:mod:`~paddle_tpu.static_analysis.distributed`) before its worker
+  programs are returned; a candidate that fails the proof is rejected
+  with the diagnostic and the next-cheapest takes its place;
+* **determinism** — identical (program, ClusterSpec) inputs always
+  yield the byte-identical plan: enumeration order is fixed, every
+  sort carries the candidate's ``plan_key()`` as tie-break, and no
+  wall-clock, RNG, or set-iteration order reaches a decision.
+
+Entry point: ``parallel.auto_transpile(program, cluster_spec)`` →
+:class:`PlanResult` (chosen plan + per-worker programs + the full
+candidate table).  Front-ends: fleet ``DistributedStrategy.auto=True``
+and ``DistributeTranspilerConfig.mode="auto"`` route here; the CLI
+``python -m paddle_tpu.tools.analyze_program --plan cluster.json``
+prints the candidate table without executing anything.
+"""
+
+import json
+import math
+import os
+
+from ..static_analysis.cost import (dtype_bytes, estimate_cost,
+                                    hbm_budget, price_plan)
+from ..static_analysis.distributed import (check_schedule_consistency,
+                                           extract_collective_schedule)
+from ..static_analysis.interp import (DATA_AXIS, Sharding,
+                                      interpret_program)
+
+__all__ = ["ClusterSpec", "PlanCandidate", "PricedCandidate",
+           "PlanResult", "auto_transpile", "apply_plan",
+           "enumerate_candidates", "price_worker_set",
+           "resolve_cluster_spec", "select_dp_standin"]
+
+_MB = 1024 * 1024
+
+# comm tags whose presence makes the moe / ulysses replication
+# candidates applicable — the emitters stamp their all_to_all ops with
+# these (the program already expresses that parallelism; the planner's
+# job is then to price it against the alternatives)
+_MOE_COMM_TAGS = ("moe_dispatch", "moe_combine")
+_ULYSSES_COMM_TAGS = ("ulysses_to_heads", "ulysses_to_seq")
+
+
+class ClusterSpec:
+    """The hierarchical system the planner places onto: chip count plus
+    the hardware numbers the cost model prices against.  Defaults are a
+    generic contemporary TPU chip; load deployment truth from JSON::
+
+        {"chips": 8, "peak_tflops": 275, "hbm_gb": 16,
+         "hbm_gbps": 1200, "ici_gbps": 100, "launch_us": 5,
+         "topology": "ring"}
+    """
+
+    __slots__ = ("chips", "peak_tflops", "hbm_gb", "hbm_gbps",
+                 "ici_gbps", "launch_us", "topology")
+
+    def __init__(self, chips=1, peak_tflops=100.0, hbm_gb=16.0,
+                 hbm_gbps=1200.0, ici_gbps=100.0, launch_us=5.0,
+                 topology="ring"):
+        self.chips = max(1, int(chips))
+        self.peak_tflops = float(peak_tflops)
+        self.hbm_gb = float(hbm_gb)
+        self.hbm_gbps = float(hbm_gbps)
+        self.ici_gbps = float(ici_gbps)
+        self.launch_us = float(launch_us)
+        self.topology = str(topology)
+
+    @property
+    def hbm_bytes(self):
+        return int(self.hbm_gb * 1024 ** 3)
+
+    @classmethod
+    def coerce(cls, spec):
+        """ClusterSpec | dict | bare chip count | JSON file path |
+        JSON string (object or bare number) → spec."""
+        if isinstance(spec, cls):
+            return spec
+        if isinstance(spec, str):
+            if os.path.exists(spec):
+                with open(spec) as f:
+                    spec = json.load(f)
+            else:
+                spec = json.loads(spec)
+        if isinstance(spec, (int, float)) and not isinstance(spec, bool):
+            return cls(chips=int(spec))
+        if not isinstance(spec, dict):
+            raise TypeError("cannot build a ClusterSpec from %r" % (spec,))
+        known = {k: spec[k] for k in cls.__slots__ if k in spec}
+        unknown = sorted(set(spec) - set(cls.__slots__))
+        if unknown:
+            raise ValueError("unknown ClusterSpec field(s) %s (known: %s)"
+                             % (unknown, list(cls.__slots__)))
+        return cls(**known)
+
+    def to_dict(self):
+        return {k: getattr(self, k) for k in self.__slots__}
+
+    def __repr__(self):
+        return "ClusterSpec(%s)" % ", ".join(
+            "%s=%r" % (k, getattr(self, k)) for k in self.__slots__)
+
+
+def resolve_cluster_spec(chips=None):
+    """The deployment's :class:`ClusterSpec`:
+    ``PADDLE_TPU_CLUSTER_SPEC`` (a JSON file path or inline JSON) when
+    set, defaults otherwise — with ``chips`` (the ACTUAL worker count
+    the fleet/transpiler front-ends know) overriding the spec's chip
+    count, because the planner must place onto the cluster that exists,
+    not the one the config file remembers."""
+    raw = os.environ.get("PADDLE_TPU_CLUSTER_SPEC", "").strip()
+    spec = ClusterSpec.coerce(raw) if raw else ClusterSpec()
+    if chips:
+        spec.chips = max(1, int(chips))
+    return spec
+
+
+def select_dp_standin(result):
+    """The dp-family candidate that stands in when the winner cannot be
+    expressed (in-place apply) or executed (the bench's measured arm)
+    in one worker's program: the cheapest FEASIBLE non-divergent
+    dp/single candidate, else the least-memory one (plan_key
+    tie-break) — never a cheaper-but-over-budget dp whose OOM the
+    candidate table itself predicts.  One policy, shared by
+    :func:`apply_plan` and ``bench.py --child planner``.  Returns the
+    :class:`PricedCandidate` or None."""
+    dp_pool = [pc for pc in result.candidates
+               if pc.candidate.kind in ("dp", "single")
+               and pc.deadlock != "divergent"]
+    for pc in dp_pool:  # result.candidates is ranked by step_ms
+        if pc.feasible:
+            return pc
+    if dp_pool:
+        return min(dp_pool,
+                   key=lambda pc: (pc.price.peak_memory_bytes,
+                                   pc.candidate.plan_key()))
+    return None
+
+
+def apply_plan(program, result, startup_program=None, rank=0):
+    """Apply ``result``'s winning plan to ``program`` IN PLACE where
+    one worker's program can express it (the dp family) — the shared
+    tail of both ``auto`` front-ends (fleet ``DistributedStrategy.auto``
+    and ``DistributeTranspilerConfig.mode="auto"``).
+
+    Realizes every knob the plan was priced with: the GradAllReduce
+    transpile at the plan's degree, the ZeRO-1 stamp
+    (``program._shard_optimizer_state`` — the SPMD runner enables
+    sharding when either this stamp or the BuildStrategy flag is set;
+    clear the stamp to disable it), and the allreduce
+    bucket cap as the ``program._allreduce_bucket_mb`` mark the fusion
+    pass consults before the env var — scoped to THIS program, so an
+    auto apply neither leaks into nor clobbers another program's
+    ``PADDLE_TPU_ALLREDUCE_BUCKET_MB`` configuration.  A dp winner
+    chosen FOR its bucket/zero1 numbers must not silently run without
+    them.  The full :class:`PlanResult` lands on ``program._auto_plan``
+    either way.
+
+    A non-dp winner (a pipeline stage set) cannot be expressed by
+    mutating one program — leaving the program untranspiled would make
+    N workers train on disjoint shards with NO gradient sync, silently
+    divergent.  So the in-place apply falls back to the cheapest
+    dp-family candidate (warning that the cheaper plan lives in
+    ``result.worker_programs`` for per-stage deployment).  Returns the
+    applied :class:`PlanCandidate`."""
+    import warnings
+
+    program._auto_plan = result
+    cand = result.plan.candidate
+    if cand.kind not in ("dp", "single"):
+        applied_pc = select_dp_standin(result)
+        applied = applied_pc.candidate if applied_pc else None
+        warnings.warn(
+            "auto plan winner %r cannot be applied in place (one "
+            "worker's program cannot express a %s plan) — applying %s "
+            "instead; deploy result.worker_programs to run the cheaper "
+            "plan" % (cand.describe(), cand.kind,
+                      applied.describe() if applied else
+                      "plain grad-allreduce DP"),
+            stacklevel=2)
+        cand = applied or PlanCandidate("dp", result.cluster.chips)
+    program._auto_plan_applied = cand
+    if cand.kind == "single":
+        return cand
+    from ..transpiler.collective import GradAllReduce
+
+    GradAllReduce().transpile(program=program,
+                              startup_program=startup_program,
+                              rank=rank, nranks=cand.degree)
+    program._shard_optimizer_state = cand.zero1
+    if cand.bucket_mb:
+        program._allreduce_bucket_mb = cand.bucket_mb
+    return cand
+
+
+class PlanCandidate:
+    """One point of the placement/sharding search space."""
+
+    __slots__ = ("kind", "degree", "stages", "dp_degree", "cuts",
+                 "bucket_mb", "zero1", "microbatches")
+
+    def __init__(self, kind, degree, stages=1, dp_degree=1, cuts=(),
+                 bucket_mb=None, zero1=False, microbatches=1):
+        self.kind = kind            # single | dp | pipeline | moe | ulysses
+        self.degree = int(degree)   # total chips the plan occupies
+        self.stages = int(stages)
+        self.dp_degree = int(dp_degree)
+        self.cuts = tuple(cuts)
+        self.bucket_mb = bucket_mb
+        self.zero1 = bool(zero1)
+        self.microbatches = int(microbatches)
+
+    def plan_key(self):
+        """Deterministic identity/tie-break key."""
+        return (self.kind, self.degree, self.stages, self.dp_degree,
+                self.bucket_mb if self.bucket_mb is not None else -1,
+                self.zero1, self.cuts)
+
+    def describe(self):
+        if self.kind == "single":
+            return "single-chip (no transpile)"
+        if self.kind == "dp":
+            s = "dp x%d" % self.degree
+            if self.zero1:
+                s += " +zero1"
+            if self.bucket_mb:
+                s += " (allreduce bucket %dMB)" % self.bucket_mb
+            return s
+        if self.kind == "pipeline":
+            s = "pipeline x%d stages" % self.stages
+            if self.dp_degree > 1:
+                s += " x dp %d" % self.dp_degree
+            return s + " (M=%d, cuts: %s)" % (self.microbatches,
+                                              ", ".join(self.cuts))
+        return "%s x%d (replicated worker set)" % (self.kind, self.degree)
+
+    def to_dict(self):
+        return {
+            "kind": self.kind, "degree": self.degree,
+            "stages": self.stages, "dp_degree": self.dp_degree,
+            "cuts": list(self.cuts), "bucket_mb": self.bucket_mb,
+            "zero1": self.zero1, "microbatches": self.microbatches,
+            "describe": self.describe(),
+        }
+
+    def __repr__(self):
+        return "PlanCandidate(%s)" % self.describe()
+
+
+class PricedCandidate:
+    """A candidate with its price, feasibility and (for the winner /
+    rejected finalists) the deadlock verdict."""
+
+    __slots__ = ("candidate", "price", "feasible", "budget", "status",
+                 "deadlock", "chosen")
+
+    def __init__(self, candidate, price, budget):
+        self.candidate = candidate
+        self.price = price
+        self.budget = budget
+        self.feasible = (budget is None
+                         or price.peak_memory_bytes <= budget)
+        self.status = ""
+        self.deadlock = None    # None = not proven; "ok"; "divergent"
+        self.chosen = False
+
+    def to_dict(self, canonical=False):
+        return {
+            "candidate": self.candidate.to_dict(),
+            "price": self.price.to_dict(canonical=canonical),
+            "feasible": self.feasible,
+            "hbm_budget": self.budget,
+            "deadlock": self.deadlock,
+            "chosen": self.chosen,
+            "status": self.status,
+        }
+
+
+class PlanResult:
+    """What :func:`auto_transpile` returns: the chosen plan, its
+    emitted per-worker programs, and the whole priced candidate table
+    (so rejections are explainable, not silent)."""
+
+    def __init__(self, program, cluster, candidates, plan,
+                 worker_programs, worker_startups, proof_diagnostics,
+                 fallback=False):
+        self.program = program
+        self.cluster = cluster
+        self.candidates = candidates        # [PricedCandidate], ranked
+        self.plan = plan                    # the chosen PricedCandidate
+        self.worker_programs = worker_programs
+        self.worker_startups = worker_startups
+        self.proof_diagnostics = list(proof_diagnostics)
+        self.fallback = bool(fallback)
+
+    @property
+    def deadlock_free(self):
+        return self.plan is not None and self.plan.deadlock == "ok"
+
+    def to_dict(self, canonical=False):
+        return {
+            "cluster": self.cluster.to_dict(),
+            "plan": self.plan.to_dict(canonical=canonical)
+            if self.plan else None,
+            "fallback": self.fallback,
+            "candidates": [c.to_dict(canonical=canonical)
+                           for c in self.candidates],
+        }
+
+    def to_json(self):
+        """Canonical byte-stable serialization — the determinism
+        contract: same (program, ClusterSpec) → identical bytes in any
+        process, autotune on or off.  Prices serialize in CANONICAL
+        form (calibration divided back out): a cached calibration
+        factor scales every candidate alike — it cannot flip the
+        ranking — so the canonical bytes stay invariant to the cache
+        state while ``to_dict()`` keeps the calibrated numbers for the
+        CLI."""
+        return json.dumps(self.to_dict(canonical=True), sort_keys=True,
+                          separators=(",", ":"))
+
+    def format_table(self):
+        """Human candidate table: predicted step cost, ICI bytes, peak
+        HBM, deadlock verdict, chosen/rejected reason."""
+        lines = [
+            "auto-parallelism plan for %r:" % (self.cluster,),
+            "  %-44s %10s %12s %12s %8s  %s" % (
+                "candidate", "step ms", "ICI bytes", "peak HBM",
+                "deadlock", "verdict"),
+        ]
+        for pc in self.candidates:
+            lines.append("  %-44s %10.3f %12d %12d %8s  %s" % (
+                pc.candidate.describe()[:44], pc.price.step_ms,
+                pc.price.ici_bytes, pc.price.peak_memory_bytes,
+                pc.deadlock or "-",
+                ("CHOSEN: " if pc.chosen else "") + pc.status))
+        if self.fallback:
+            lines.append(
+                "  (no candidate fits the %s-byte HBM budget — degraded "
+                "to the least-memory plan)" % (self.plan.budget,))
+        return "\n".join(lines)
+
+    def runtime_config(self):
+        """``(BuildStrategy, env)`` realizing the chosen plan's runtime
+        knobs: ZeRO-1 optimizer-state sharding and the allreduce bucket
+        cap as the ``PADDLE_TPU_ALLREDUCE_BUCKET_MB`` env the fusion
+        pass falls back to — the manual/multi-process deployment form
+        (:func:`apply_plan` scopes the same bucket to one program via
+        the ``_allreduce_bucket_mb`` mark instead)."""
+        from ..compiler import BuildStrategy
+
+        bs = BuildStrategy()
+        c = self.plan.candidate
+        bs.shard_optimizer_state = c.zero1
+        env = {}
+        if c.bucket_mb:
+            bs.fuse_all_reduce_ops = True
+            env["PADDLE_TPU_ALLREDUCE_BUCKET_MB"] = str(c.bucket_mb)
+        return bs, env
+
+    def __repr__(self):
+        return "PlanResult(%s, %d candidate(s), deadlock_free=%s)" % (
+            self.plan.candidate.describe() if self.plan else None,
+            len(self.candidates), self.deadlock_free)
+
+
+# ---------------------------------------------------------------------------
+# enumeration
+# ---------------------------------------------------------------------------
+
+def _bucket_candidates_mb():
+    """Allreduce bucket sizes to search (MB).  Env
+    ``PADDLE_TPU_PLAN_BUCKETS_MB`` ("8,32,128") overrides."""
+    raw = os.environ.get("PADDLE_TPU_PLAN_BUCKETS_MB", "").strip()
+    if raw:
+        vals = sorted({max(1, int(float(v))) for v in raw.split(",")
+                       if v.strip()})
+        if vals:
+            return vals
+    return [8, 32, 128]
+
+
+def _stage_counts(chips):
+    """Pipeline depths to search: divisors of the chip count in
+    [2, min(chips, 8)] — deeper pipelines exceed the bubble regime the
+    GPipe schedule model is honest about."""
+    return [s for s in range(2, min(chips, 8) + 1) if chips % s == 0]
+
+
+def _optimizer_state_overrides(program, parts):
+    """ZeRO-1 candidate seeding: every optimizer-state persistable
+    (moment/velocity accumulators, marked ``_is_optimizer_state`` by
+    the optimizer) pinned SHARDED over the data axis — the interp then
+    prices the per-worker shard, which is exactly what
+    ``BuildStrategy.shard_optimizer_state`` realizes at run time."""
+    overrides = {}
+    for block in program.blocks:
+        for name, var in block.vars.items():
+            if getattr(var, "_is_optimizer_state", False) \
+                    and var.persistable:
+                overrides[name] = Sharding.sharded(DATA_AXIS, 0, parts)
+    return overrides
+
+
+def _has_backward(program):
+    return any(
+        op.attrs.get("op_role") == "backward" or op.type.endswith("_grad")
+        for op in program.global_block().ops)
+
+
+def _microbatch_count(stages):
+    raw = os.environ.get("PADDLE_TPU_PLAN_MICROBATCHES", "").strip()
+    if raw:
+        try:
+            return max(1, int(raw))
+        except ValueError:
+            pass
+    return 4 * stages
+
+
+# ---- pipeline cut-point search ----
+
+def _forward_loads(program, base_interp, base_report):
+    """Per-forward-op total load (own FLOPs + the grad twins', located
+    via ``__fwd_op_id__`` like ``transpile_pipeline``'s stage
+    assignment) and the candidate cut boundaries.
+
+    Returns ``(loads, boundaries)``: ``loads[i]`` is the load of the
+    i-th forward op of the global block; ``boundaries`` is a list of
+    ``(fwd_pos, cut_var_name, cut_bytes)`` — cutting after ``fwd_pos``
+    by naming ``cut_var_name`` reproduces exactly the stage assignment
+    ``transpile_pipeline`` derives from that cut var.
+    """
+    flops_by_coord = {}
+    for c in base_report.op_costs:
+        flops_by_coord[(c.record.block_idx, c.record.op_idx)] = c.flops
+
+    block = program.global_block()
+    fwd_pos_by_op_id = {}
+    loads = []
+    fwd_ops = []
+    for op_idx, op in enumerate(block.ops):
+        if op.attrs.get("op_role") in ("backward", "optimize",
+                                       "lr_sched") \
+                or op.type.endswith("_grad"):
+            continue
+        fwd_pos_by_op_id[op.attrs.get("__op_id__")] = len(fwd_ops)
+        fwd_ops.append((op_idx, op))
+        loads.append(flops_by_coord.get((0, op_idx), 0))
+    # fold each grad op's FLOPs onto its forward twin's position
+    for op_idx, op in enumerate(block.ops):
+        fwd_id = op.attrs.get("__fwd_op_id__")
+        if fwd_id is None or fwd_id not in fwd_pos_by_op_id:
+            continue
+        loads[fwd_pos_by_op_id[fwd_id]] += flops_by_coord.get(
+            (0, op_idx), 0)
+
+    # candidate boundaries: ARTICULATION POINTS of the forward dataflow
+    # — positions where exactly ONE non-persistable, non-data value is
+    # live across the cut (produced before, read after).  Cutting
+    # anywhere else makes several activations cross the stage edge;
+    # ``transpile_pipeline`` then emits multiple p2p edges per channel
+    # whose send/recv orders can interleave into exactly the rendezvous
+    # deadlocks the prover rejects (it DID reject them — this
+    # restriction keeps the search inside the provable region, the
+    # residual-stream layer boundaries of a transformer).
+    def _crosses(name):
+        var = block._find_var_recursive(name)
+        if var is None or var.persistable or var.is_data:
+            return False
+        return True
+
+    prod_pos = {}
+    last_read_pos = {}
+    for pos, (op_idx, op) in enumerate(fwd_ops):
+        for n in op.input_arg_names:
+            if n in prod_pos:
+                last_read_pos[n] = pos
+        for n in op.output_arg_names:
+            prod_pos.setdefault(n, pos)
+    boundaries = []
+    for pos in range(len(fwd_ops) - 1):
+        live = [n for n in prod_pos
+                if _crosses(n) and prod_pos[n] <= pos
+                and last_read_pos.get(n, -1) > pos]
+        if len(live) != 1:
+            continue
+        n = live[0]
+        av = base_interp.val(n)
+        if av is None or av.shape is None or av.numel is None:
+            continue
+        boundaries.append((pos, n, av.numel * dtype_bytes(av.dtype)))
+    # transpile_pipeline cuts when the cut var first appears in a
+    # forward op's outputs: only the FIRST live position of each var
+    # reproduces that stage assignment
+    seen = set()
+    firsts = []
+    for pos, n, nbytes in boundaries:
+        if n in seen:
+            continue
+        seen.add(n)
+        firsts.append((pos, n, nbytes))
+    return loads, firsts
+
+
+def _thin_boundaries(loads, boundaries, cap=64):
+    """Bound the branch-and-bound: keep at most ``cap`` boundaries,
+    the ones closest to evenly spaced cumulative-load quantiles
+    (deterministic)."""
+    if len(boundaries) <= cap:
+        return boundaries
+    prefix = [0]
+    for v in loads:
+        prefix.append(prefix[-1] + v)
+    total = prefix[-1] or 1
+    kept = []
+    kept_idx = set()
+    for q in range(1, cap + 1):
+        target = total * q / (cap + 1)
+        best = min(
+            range(len(boundaries)),
+            key=lambda i: (abs(prefix[boundaries[i][0] + 1] - target),
+                           boundaries[i][0], boundaries[i][1]))
+        if best not in kept_idx:
+            kept_idx.add(best)
+            kept.append(boundaries[best])
+    kept.sort()
+    return kept
+
+
+def _best_cuts(loads, boundaries, stages):
+    """Pick ``stages-1`` cut boundaries minimizing the max per-stage
+    fwd+bwd load — branch-and-bound over the boundary lattice (exact
+    dynamic program with dominance pruning), tie-broken by smaller
+    total cut bytes then lexicographic cut names, so the same inputs
+    always select the same cuts.  Returns the cut-var name tuple, or
+    None when there are not enough boundaries."""
+    k = stages - 1
+    if k <= 0 or len(boundaries) < k:
+        return None
+    prefix = [0]
+    for v in loads:
+        prefix.append(prefix[-1] + v)
+    n_ops = len(loads)
+
+    def seg(a, b):  # load of fwd ops [a, b)
+        return prefix[b] - prefix[a]
+
+    # dp[(j)] after choosing c cuts ending at boundary j:
+    # (max_load_so_far, cut_bytes_so_far, cut_names) — minimize
+    # lexicographically; positions strictly increase
+    best = {}
+    for j, (pos, name, nbytes) in enumerate(boundaries):
+        best[j] = (seg(0, pos + 1), nbytes, (name,), pos)
+    for c in range(1, k):
+        nxt = {}
+        for j, (pos, name, nbytes) in enumerate(boundaries):
+            cand = None
+            for i, state in best.items():
+                ppos = state[3]
+                if ppos >= pos:
+                    continue
+                key = (max(state[0], seg(ppos + 1, pos + 1)),
+                       state[1] + nbytes, state[2] + (name,), pos)
+                if cand is None or key[:3] < cand[:3]:
+                    cand = key
+            if cand is not None:
+                nxt[j] = cand
+        best = nxt
+        if not best:
+            return None
+    final = None
+    for state in best.values():
+        key = (max(state[0], seg(state[3] + 1, n_ops)),
+               state[1], state[2])
+        if final is None or key < final:
+            final = key
+    return final[2] if final else None
+
+
+def enumerate_candidates(program, cluster, base_interp=None,
+                         base_report=None, batch_size=None):
+    """The deterministic candidate list for ``program`` on ``cluster``.
+    Pipeline cut points are searched here (bounded branch-and-bound
+    over layer-boundary loads); pricing happens in
+    :func:`auto_transpile`."""
+    chips = cluster.chips
+    if chips <= 1:
+        return [PlanCandidate("single", 1)]
+    if base_interp is None:
+        base_interp = interpret_program(program, nranks=1,
+                                        batch_size=batch_size)
+    if base_report is None:
+        base_report = estimate_cost(program, interp=base_interp)
+
+    cands = []
+    trainable = _has_backward(program)
+
+    # data parallel (with the bucketed-allreduce launch model); ZeRO-1
+    # variant only when there is optimizer state to shard
+    buckets = _bucket_candidates_mb()
+    has_opt_state = bool(_optimizer_state_overrides(program, chips))
+    for bucket in buckets:
+        cands.append(PlanCandidate("dp", chips, bucket_mb=bucket))
+        if trainable and has_opt_state:
+            cands.append(PlanCandidate("dp", chips, bucket_mb=bucket,
+                                       zero1=True))
+
+    # pipeline splits over searched layer boundaries
+    loads, boundaries = _forward_loads(program, base_interp, base_report)
+    boundaries = _thin_boundaries(loads, boundaries)
+    for stages in _stage_counts(chips):
+        cuts = _best_cuts(loads, boundaries, stages)
+        if cuts is None:
+            continue
+        cands.append(PlanCandidate(
+            "pipeline", chips, stages=stages, dp_degree=chips // stages,
+            cuts=cuts, microbatches=_microbatch_count(stages)))
+
+    # moe / ulysses replication — applicable when the program already
+    # expresses that parallelism (the emitters stamped their all_to_all
+    # ops with the family's comm_tag) AND the program is not a trainer:
+    # plain replication of a TRAINABLE program has no gradient
+    # exchange, so it would always price below dp (same compute, no
+    # allreduce) while silently training N divergent replicas — a
+    # trainable expert/sequence-parallel placement needs its gradient
+    # topology expressed in the program (the dp candidates above
+    # GradAllReduce the same moe/ulysses program and stay sound)
+    if not trainable:
+        comm_tags = {
+            str(op.attrs.get("comm_tag", ""))
+            for b in program.blocks for op in b.ops
+            if op.type == "all_to_all"}
+        if any(t.startswith(_MOE_COMM_TAGS) for t in comm_tags):
+            cands.append(PlanCandidate("moe", chips))
+        if any(t.startswith(_ULYSSES_COMM_TAGS) for t in comm_tags):
+            cands.append(PlanCandidate("ulysses", chips))
+
+    cands.sort(key=lambda c: c.plan_key())
+    return cands
+
+
+# ---------------------------------------------------------------------------
+# emission (through the existing per-strategy emitters)
+# ---------------------------------------------------------------------------
+
+def _prune_foreign_persistables(worker, startup=None):
+    """Drop persistable vars no op of this worker references (other
+    stages' parameters survive ``transpile_pipeline``'s clone) so the
+    per-stage peak-memory estimate reflects what the stage actually
+    holds — and prune the matching ``startup`` the same way: a startup
+    that still initializes EVERY parameter would materialize the whole
+    model on each stage, making the pruned feasibility estimate a lie
+    at deploy time."""
+    referenced = set()
+    for block in worker.blocks:
+        for op in block.ops:
+            referenced.update(op.input_arg_names)
+            referenced.update(op.output_arg_names)
+
+    def keep(v, n):
+        return n in referenced or not v.persistable or v.is_data
+
+    for block in worker.blocks:
+        block.vars = {n: v for n, v in block.vars.items()
+                      if keep(v, n)}
+    worker._bump_version()
+    if startup is not None:
+        sb = startup.global_block()
+        dropped = {
+            n for n, v in sb.vars.items()
+            if v.persistable and not keep(v, n)
+            # comm-ring bootstrap vars belong to the startup itself
+            and not n.startswith("tpu_comm_id_")}
+        sb.ops = [op for op in sb.ops
+                  if not (set(op.output_arg_names) & dropped)]
+        sb.vars = {n: v for n, v in sb.vars.items()
+                   if n not in dropped}
+        startup._bump_version()
+    return worker
+
+
+def _emit(program, startup_program, cand, cluster, limit=None):
+    """Realize one candidate as per-worker (main, startup) program
+    pairs via the existing emitters.  Emitted mains carry
+    ``_auto_plan_key`` so downstream tooling (and the
+    ``manual-plan-suboptimal`` advisory) can tell planner output from
+    hand transpiles.  ``limit`` caps the emitted rank count for the
+    SYMMETRIC kinds (every rank runs the identical program, so pricing
+    needs just one clone); pipeline stages differ and always emit in
+    full."""
+    from ..framework import Program
+    from ..transpiler.collective import GradAllReduce, ensure_comm_ring
+    from .pipeline import transpile_pipeline
+
+    def _startup_clone():
+        return (startup_program.clone()
+                if startup_program is not None else Program())
+
+    if cand.kind == "single":
+        workers, startups = [program.clone()], [_startup_clone()]
+    elif cand.kind == "dp":
+        workers, startups = [], []
+        for rank in range(min(cand.degree, limit or cand.degree)):
+            m = program.clone()
+            s = _startup_clone()
+            GradAllReduce().transpile(program=m, startup_program=s,
+                                      rank=rank, nranks=cand.degree)
+            m._num_trainers = cand.degree
+            m._trainer_id = rank
+            if cand.zero1:
+                m._shard_optimizer_state = True
+            workers.append(m)
+            startups.append(s)
+    elif cand.kind == "pipeline":
+        workers, startups = transpile_pipeline(
+            program, list(cand.cuts), startup_program=startup_program)
+        workers = [_prune_foreign_persistables(w, startup=s)
+                   for w, s in zip(workers, startups)]
+        if cand.dp_degree > 1:
+            # hierarchical: each stage is itself data-parallel over
+            # chips/stages ranks — grad allreduce on ring 0 within the
+            # stage's DP subgroup (every subgroup member runs the
+            # identical stage program).  _num_trainers carries the DP
+            # degree so pricing interprets the stage at its LOCAL batch
+            # shard with ring-0 ICI at the subgroup size, not the
+            # full-batch/stage-count mispricing
+            for w, s in zip(workers, startups):
+                GradAllReduce().transpile(program=w, startup_program=s,
+                                          rank=0,
+                                          nranks=cand.dp_degree)
+                w._num_trainers = cand.dp_degree
+    else:  # moe / ulysses replication
+        workers, startups = [], []
+        rings = sorted({
+            op.attrs.get("ring_id")
+            for b in program.blocks for op in b.ops
+            if op.attrs.get("ring_id") is not None})
+        for rank in range(min(cand.degree, limit or cand.degree)):
+            m = program.clone()
+            m._num_trainers = cand.degree
+            m._trainer_id = rank
+            s = _startup_clone()
+            for ring in rings:
+                ensure_comm_ring(s, ring, rank=rank, nranks=cand.degree)
+            workers.append(m)
+            startups.append(s)
+    for w in workers:
+        w._auto_plan_key = repr(cand.plan_key())
+    return workers, startups
+
+
+# ---------------------------------------------------------------------------
+# pricing
+# ---------------------------------------------------------------------------
+
+def _combine_prices(prices):
+    """Plan price of a multi-worker (pipeline) schedule: every stage
+    runs concurrently, so each roofline component is the max over
+    workers; the step total re-derives from the maxima."""
+    from ..static_analysis.cost import PlanPrice, plan_calibration_factor
+
+    calibration = plan_calibration_factor()
+    flops_ms = max(p.flops_ms for p in prices)
+    hbm_ms = max(p.hbm_ms for p in prices)
+    compute_ms = max(p.compute_ms for p in prices)
+    ici_ms = max(p.ici_ms for p in prices)
+    launch_ms = max(p.launch_ms for p in prices)
+    step_ms = (compute_ms + ici_ms + launch_ms) * calibration
+    return PlanPrice(
+        flops_ms, hbm_ms, compute_ms, ici_ms, launch_ms, step_ms,
+        max(p.ici_bytes for p in prices),
+        max(p.peak_memory_bytes for p in prices),
+        max(p.collective_launches for p in prices),
+        max(p.schedule_factor for p in prices), calibration)
+
+
+def _param_allgather_bytes(program, nranks):
+    """Per-worker ICI volume of the ZeRO-1 param allgather: every
+    parameter's update is computed on its owning shard and gathered to
+    all, a ``B·(n-1)/n`` ring transfer of the full parameter bytes."""
+    from .. import framework
+
+    total = 0
+    for block in program.blocks:
+        for var in block.vars.values():
+            if isinstance(var, framework.Parameter) and var.shape:
+                n = 1
+                for d in var.shape:
+                    n *= max(int(d), 1)
+                total += n * dtype_bytes(var.dtype)
+    n = max(int(nranks), 1)
+    return int(total * (n - 1) / n)
+
+
+def _bucketed_launches(report, bucket_mb):
+    """Launch count under size-capped allreduce coalescing: ring-0
+    allreduce payloads pack into ``bucket_mb`` buckets (the PR-5
+    ``c_fused_allreduce_sum`` rewrite); other collectives launch as
+    is."""
+    if not bucket_mb:
+        return None
+    cap = bucket_mb * _MB
+    grad_bytes = 0
+    grad_launches = 0
+    other = 0
+    for c in report.op_costs:
+        if c.ici_bytes <= 0:
+            continue
+        if c.record.op.type in ("c_allreduce_sum",
+                                "c_fused_allreduce_sum") \
+                and (c.ring_id in (0, None)):
+            payload = sum(
+                (v.local_numel or 0) * dtype_bytes(v.dtype)
+                for v in c.record.ins)
+            grad_bytes += payload
+            grad_launches += 1
+        else:
+            other += 1
+    if not grad_launches:
+        return None
+    return other + max(1, int(math.ceil(grad_bytes / float(cap))))
+
+
+def price_worker_set(workers, cluster, cand=None, targets=(),
+                     batch_size=None, shard_overrides=None):
+    """Price an emitted per-worker program set against ``cluster``;
+    returns ``(reports, PlanPrice)``.  Also the entry point the tests
+    use to price the HAND-written ``dist_model`` worker builders so
+    planner output and manual transpiles meet the same meter.
+
+    A pipeline worker set (stamped ``_pipeline_stage`` by
+    ``transpile_pipeline``) gets the GPipe bubble factor
+    ``(M+S-1)/M`` whether it came from the planner or a hand
+    transpile — both plans pay the same schedule inefficiency."""
+    budget = hbm_budget(workers[0]) or cluster.hbm_bytes
+    schedule_factor = 1.0
+    stages = None
+    if cand is not None and cand.kind == "pipeline":
+        stages, microbatches = cand.stages, cand.microbatches
+    elif getattr(workers[0], "_pipeline_stage", None) is not None:
+        stages, microbatches = len(workers), _microbatch_count(
+            len(workers))
+    if stages is not None:
+        m = max(1, microbatches)
+        schedule_factor = (m + stages - 1) / float(m)
+    reports = []
+    prices = []
+    for w in workers:
+        nranks = int(getattr(w, "_num_trainers", 0) or 0) or len(workers)
+        interp = interpret_program(w, nranks=nranks,
+                                   batch_size=batch_size,
+                                   shard_overrides=shard_overrides)
+        report = estimate_cost(w, interp=interp, targets=targets,
+                               budget=budget)
+        launches = None
+        extra_ici = 0
+        extra_launches = 0
+        if cand is not None:
+            launches = _bucketed_launches(report, cand.bucket_mb)
+            if cand.zero1:
+                # ZeRO-1 is not free speed: sharding the optimizer
+                # state means each step allgathers the updated params
+                # (no op in the IR carries it — charge it here)
+                extra_ici = _param_allgather_bytes(w, cand.degree)
+                extra_launches = 1 if extra_ici else 0
+        reports.append(report)
+        prices.append(price_plan(
+            report,
+            peak_tflops=cluster.peak_tflops,
+            hbm_gbps=cluster.hbm_gbps,
+            ici_gbps=cluster.ici_gbps,
+            launch_us=cluster.launch_us,
+            schedule_factor=schedule_factor,
+            collective_launches=launches,
+            extra_ici_bytes=extra_ici,
+            extra_launches=extra_launches))
+    if len(prices) == 1:
+        return reports, prices[0]
+    return reports, _combine_prices(prices)
+
+
+def _price_candidate(program, startup_program, cand, cluster, targets,
+                     batch_size):
+    """Emit (one rank for the symmetric kinds — every rank runs the
+    identical program; all stages for pipeline) and exactly price one
+    candidate.  Returns ``(PricedCandidate, workers, startups)`` —
+    the emission is reused by the proof loop so no candidate is
+    cloned/transpiled twice."""
+    workers, startups = _emit(program, startup_program, cand, cluster,
+                              limit=1)
+    overrides = None
+    if cand.zero1:
+        overrides = _optimizer_state_overrides(program, cand.degree)
+    _, price = price_worker_set(
+        workers, cluster, cand=cand, targets=targets,
+        batch_size=batch_size, shard_overrides=overrides)
+    budget = hbm_budget(program) or cluster.hbm_bytes
+    return PricedCandidate(cand, price, budget), workers, startups
+
+
+# ---------------------------------------------------------------------------
+# the proof, scoped per ring family
+# ---------------------------------------------------------------------------
+
+def _prove(cand, workers, batch_size=None):
+    """Deadlock-freedom proof for one candidate's worker set.
+
+    Symmetric plans (dp / moe / ulysses / single) and pure pipelines go
+    straight through :func:`check_schedule_consistency`.  Hierarchical
+    pipeline×dp plans scope the proof: ring-0 grad allreduces live in
+    per-stage DP subgroups whose members run the IDENTICAL stage
+    program (consistent by construction), so they are filtered before
+    the cross-stage p2p proof — feeding them in unscoped would
+    fabricate a divergence between stages that never share ring 0.
+
+    Symmetric worker sets are byte-identical clones of one transpile,
+    so worker 0's schedule is extracted ONCE and replicated to the
+    candidate's full degree — the proof stays an N-worker consistency
+    check without paying N abstract interpretations (or even N
+    emissions) of the same program.
+    """
+    if cand.kind != "pipeline":
+        s0 = extract_collective_schedule(workers[0], worker=0,
+                                         nranks=cand.degree,
+                                         batch_size=batch_size)
+        schedules = [s0] * cand.degree
+        return schedules, check_schedule_consistency(schedules)
+    nranks = len(workers)
+    schedules = [
+        extract_collective_schedule(p, worker=w, nranks=nranks,
+                                    batch_size=batch_size)
+        for w, p in enumerate(workers)
+    ]
+    if cand.kind == "pipeline" and cand.dp_degree > 1:
+        schedules = [
+            {ring: evs for ring, evs in sched.items() if ring != 0}
+            for sched in schedules
+        ]
+    return schedules, check_schedule_consistency(schedules)
+
+
+# ---------------------------------------------------------------------------
+# the planner
+# ---------------------------------------------------------------------------
+
+def auto_transpile(program, cluster_spec, startup_program=None,
+                   targets=None, batch_size=None):
+    """Search the placement/sharding space for ``program`` on
+    ``cluster_spec`` and return a :class:`PlanResult`: the cheapest
+    feasible candidate that the deadlock prover accepts, its per-worker
+    programs emitted through the existing emitters, and the full priced
+    candidate table.
+
+    * Candidates over the HBM budget are pruned (kept in the table,
+      marked); if nothing fits, the planner DEGRADES to the
+      least-memory candidate (``result.fallback``) instead of raising.
+    * Deterministic: same (program, ClusterSpec) → the byte-identical
+      ``result.to_json()`` in any process, autotune on or off (a
+      calibration factor scales every candidate alike, so even a
+      calibrated cache cannot flip a ranking).
+    """
+    cluster = ClusterSpec.coerce(cluster_spec)
+    targets = targets or ()
+    base_interp = interpret_program(program, nranks=1,
+                                    batch_size=batch_size)
+    base_report = estimate_cost(program, interp=base_interp,
+                                targets=targets)
+    cands = enumerate_candidates(program, cluster,
+                                 base_interp=base_interp,
+                                 base_report=base_report,
+                                 batch_size=batch_size)
+
+    priced = []
+    realized = {}
+    for cand in cands:
+        pc, workers, startups = _price_candidate(
+            program, startup_program, cand, cluster, targets,
+            batch_size)
+        realized[cand.plan_key()] = (workers, startups)
+        priced.append(pc)
+
+    priced.sort(key=lambda pc: (pc.price.step_ms,
+                                pc.candidate.plan_key()))
+    feasible = [pc for pc in priced if pc.feasible]
+    fallback = not feasible
+    if fallback:
+        # nothing fits the budget: degrade to the least-memory plan —
+        # the planner must never crash on an over-subscribed cluster
+        pool = sorted(priced,
+                      key=lambda pc: (pc.price.peak_memory_bytes,
+                                      pc.candidate.plan_key()))
+    else:
+        pool = feasible
+
+    winner = None
+    winner_set = None
+    proof_diags = []
+    for pc in pool:
+        # the pricing emission is reused: symmetric kinds prove from
+        # their single emitted rank (schedule replicated to the full
+        # degree), pipelines were emitted in full for pricing anyway;
+        # only the accepted WINNER pays a full symmetric emission
+        workers, startups = realized[pc.candidate.plan_key()]
+        sch, diags = _prove(pc.candidate, workers,
+                            batch_size=batch_size)
+        if diags:
+            pc.deadlock = "divergent"
+            pc.status = "rejected: %s" % diags[0].message
+            proof_diags.extend(diags)
+            continue
+        pc.deadlock = "ok"
+        pc.chosen = True
+        winner = pc
+        if pc.candidate.kind != "pipeline" \
+                and len(workers) < pc.candidate.degree:
+            # only the symmetric kinds were emitted rank-limited for
+            # pricing; a pipeline set is already complete (its "degree"
+            # counts chips, not stage programs)
+            workers, startups = _emit(program, startup_program,
+                                      pc.candidate, cluster)
+        winner_set = (workers, startups)
+        break
+    if winner is None:
+        raise RuntimeError(
+            "auto_transpile: every candidate failed the deadlock "
+            "proof — the emitters are inconsistent; diagnostics: %s"
+            % [d.message for d in proof_diags[:3]])
+
+    if fallback:
+        winner.status = ("hbm-infeasible fallback: least-memory plan "
+                         "(peak %d > budget %d)"
+                         % (winner.price.peak_memory_bytes,
+                            winner.budget))
+    else:
+        winner.status = "cheapest feasible plan"
+    for pc in priced:
+        if pc is winner or pc.status:
+            continue
+        if not pc.feasible:
+            pc.status = "over HBM budget (peak %d > %d)" % (
+                pc.price.peak_memory_bytes, pc.budget)
+        else:
+            pc.status = "costlier than winner (+%.1f%%)" % (
+                100.0 * (pc.price.step_ms - winner.price.step_ms)
+                / max(winner.price.step_ms, 1e-12))
+
+    workers, startups = winner_set
+    return PlanResult(program, cluster, priced, winner, workers,
+                      startups, proof_diags, fallback=fallback)

@@ -174,7 +174,7 @@ def ring_attention(q, k, v, mesh, axis_name, bias=None, causal=False,
     spans ``axis_name``; batch shards run independent rings.  ``bias`` is a
     constant: no gradient flows to it (matching fused_multihead_attention).
     """
-    from jax import shard_map
+    from ..jax_compat import shard_map
 
     n = mesh.shape[axis_name]
     t = q.shape[2]

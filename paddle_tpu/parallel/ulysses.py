@@ -110,7 +110,7 @@ def ulysses_attention(q, k, v, mesh, axis_name, bias=None, causal=False,
                       sm_scale=None):
     """Global entry: q,k,v [B, H, T, D] (sequence dim sharded over
     ``axis_name`` by the partitioner), returns [B, H, T, D]."""
-    from jax import shard_map
+    from ..jax_compat import shard_map
 
     n = mesh.shape[axis_name]
     if q.shape[2] % n:

@@ -42,8 +42,9 @@ from .verifier import (
 )
 from .interp import (AbstractVal, InterpResult, Sharding,
                      interpret_program, register_transfer)
-from .cost import (CostReport, OpCost, collective_ici_bytes,
-                   estimate_cost, hbm_budget, register_flops)
+from .cost import (CostReport, OpCost, PlanPrice, collective_ici_bytes,
+                   estimate_cost, hbm_budget, price_plan,
+                   price_program, register_flops)
 from .distributed import (CollectiveEvent, check_schedule_consistency,
                           extract_collective_schedule,
                           prove_deadlock_free)
@@ -75,9 +76,12 @@ __all__ = [
     "register_transfer",
     "CostReport",
     "OpCost",
+    "PlanPrice",
     "collective_ici_bytes",
     "estimate_cost",
     "hbm_budget",
+    "price_plan",
+    "price_program",
     "register_flops",
     "CollectiveEvent",
     "check_schedule_consistency",

@@ -909,3 +909,116 @@ def check_fusible_pattern_not_fused(ctx):
                 block_idx=r.block_idx,
                 op_idx=r.op_idxs[0] if r.op_idxs else None,
                 hint="unset PADDLE_TPU_FUSION to enable the rewrite")
+
+
+@register_check("manual-plan-suboptimal")
+def check_manual_plan_suboptimal(ctx):
+    """Advisory twin of the auto-parallelism planner: a user-transpiled
+    (GradAllReduce-style) program priced against the planner's best
+    plan for the same cluster.  Fires when the manual plan is more than
+    ``PADDLE_TPU_PLAN_ADVISORY_MARGIN`` (default 15%) worse, naming the
+    cheaper plan and the predicted delta.
+
+    Opt-in: needs a cluster spec — ``program._cluster_spec`` or
+    ``PADDLE_TPU_CLUSTER_SPEC`` (a JSON file path, inline JSON, or a
+    bare chip count); with neither, the check is silent (lint must not
+    pay for a planner search nobody asked for).  Planner-emitted
+    programs (``_auto_plan_key``) and pipeline-stage workers (their
+    pre-transpile program is not reconstructible from one stage) are
+    skipped.
+    """
+    import os as _os
+
+    spec = getattr(ctx.program, "_cluster_spec", None)
+    if spec is None:
+        spec = _os.environ.get("PADDLE_TPU_CLUSTER_SPEC", "").strip()
+    if not spec:
+        return
+    if getattr(ctx.program, "_auto_plan_key", None) is not None:
+        return  # the planner priced this very program already
+    if getattr(ctx.program, "_pipeline_stage", None) is not None:
+        return
+    block = ctx.program.global_block()
+
+    # the invertible manual journey: per-grad allreduce inserted over
+    # the same vars (X == Out identity under GSPMD) — exactly what
+    # DistributeTranspiler(grad_allreduce)/fleet emit.  ONE predicate
+    # for both the gate below and the strip that reconstructs the
+    # pre-transpile program, so they cannot drift apart
+    def _is_identity_allreduce(op):
+        return (op.type in ("c_allreduce_sum", "c_fused_allreduce_sum")
+                and set(op.input_arg_names) == set(op.output_arg_names))
+
+    manual_allreduces = [op for op in block.ops
+                         if _is_identity_allreduce(op)]
+    if not manual_allreduces or any(
+            op.type in ("send_v2", "recv_v2") for op in block.ops):
+        return
+
+    from ..parallel.planner import (ClusterSpec, auto_transpile,
+                                    price_worker_set)
+
+    try:
+        cluster = ClusterSpec.coerce(spec)
+    except Exception as e:  # noqa: BLE001 - bad spec is a finding
+        yield ctx.diag(
+            "manual-plan-suboptimal", Severity.WARNING,
+            "cluster spec %r is unusable: %s" % (spec, e),
+            hint="PADDLE_TPU_CLUSTER_SPEC takes a JSON file path, "
+                 "inline JSON, or a chip count")
+        return
+
+    # strip the identity allreduces to recover the pre-transpile
+    # program the planner searches from
+    base = ctx.program.clone()
+    bb = base.global_block()
+    bb.ops = [op for op in bb.ops if not _is_identity_allreduce(op)]
+    base._bump_version()
+
+    try:
+        from ..parallel.planner import PlanCandidate
+        from .fusion import allreduce_bucket_mb
+
+        manual = ctx.program.clone()
+        manual._num_trainers = int(
+            getattr(ctx.program, "_num_trainers", 0) or 0) \
+            or cluster.chips
+        # price the manual program as the RUNTIME runs it: the fusion
+        # pass buckets its per-grad allreduces too (fuse_all_reduce_ops
+        # defaults on), so charging one launch per c_allreduce_sum
+        # would fabricate a delta against a behaviorally-equal plan
+        manual_as = PlanCandidate(
+            "dp", manual._num_trainers,
+            bucket_mb=int(allreduce_bucket_mb(ctx.program)))
+        _, manual_price = price_worker_set([manual], cluster,
+                                           cand=manual_as,
+                                           targets=ctx.targets)
+        result = auto_transpile(base, cluster, targets=ctx.targets)
+    except Exception as e:  # noqa: BLE001 - an opt-in advisory must
+        # never abort the whole check battery; degrade to a finding
+        yield ctx.diag(
+            "manual-plan-suboptimal", Severity.WARNING,
+            "planner comparison failed for this program: %s" % e,
+            hint="run parallel.auto_transpile directly for the full "
+                 "traceback")
+        return
+    best = result.plan
+    try:
+        margin = float(_os.environ.get(
+            "PADDLE_TPU_PLAN_ADVISORY_MARGIN", "0.15"))
+    except ValueError:
+        margin = 0.15
+    if manual_price.step_ms <= (1.0 + margin) * best.price.step_ms:
+        return
+    delta = 100.0 * (manual_price.step_ms - best.price.step_ms) \
+        / max(best.price.step_ms, 1e-12)
+    yield ctx.diag(
+        "manual-plan-suboptimal", Severity.INFO,
+        "manual parallelism plan prices %.1f%% worse than the "
+        "planner's best for this cluster: %s (predicted %.3f ms/step "
+        "vs %.3f ms/step manual)"
+        % (delta, best.candidate.describe(), best.price.step_ms,
+           manual_price.step_ms),
+        hint="parallel.auto_transpile(program, cluster_spec) emits the "
+             "cheaper plan; see analyze_program --plan for the full "
+             "candidate table")

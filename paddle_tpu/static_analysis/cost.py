@@ -38,7 +38,9 @@ __all__ = [
     "OpCost", "CostReport", "estimate_cost", "register_flops",
     "collective_ici_bytes", "dtype_bytes", "parse_size", "hbm_budget",
     "sync_latency_ms", "calibration_factors", "COLLECTIVE_OP_TYPES",
-    "P2P_OP_TYPES", "HOST_IO_OP_TYPES",
+    "P2P_OP_TYPES", "HOST_IO_OP_TYPES", "PlanPrice", "price_plan",
+    "price_program", "plan_calibration_factor",
+    "PLANNER_CALIBRATION_FAMILY",
 ]
 
 _DTYPE_BYTES = {
@@ -550,3 +552,167 @@ def estimate_cost(program, interp=None, targets=(), nranks=None,
     return CostReport(program, op_costs, peak, persistent_bytes,
                       nranks, interp.batch_size, budget=budget,
                       host_sync_points=host_syncs)
+
+
+# ---------------------------------------------------------------------------
+# plan pricing — the auto-parallelism planner's entry points
+# (arXiv:2110.10548: search placement candidates against a static cost
+# model of the hierarchical system)
+# ---------------------------------------------------------------------------
+
+# autotune-cache family the planner's predicted-vs-measured step times
+# are recorded under (bench.py --child planner writes them); the factor
+# multiplies every PlanPrice so plan rankings track measured silicon
+PLANNER_CALIBRATION_FAMILY = "planner"
+
+
+def plan_calibration_factor():
+    """measured/predicted step-time factor the autotune loop recorded
+    for the planner's own time model (1.0 when autotune is disabled or
+    nothing has been measured).  Recorded by ``bench.py --child
+    planner`` under the ``planner`` cache family; consumed by
+    :func:`price_plan` so every candidate's predicted cost is scaled by
+    how far the static model sat from the last measurement."""
+    try:
+        from ..autotune import calibration_factor, sweep_signature
+
+        return float(calibration_factor(
+            sweep_signature(PLANNER_CALIBRATION_FAMILY, {})))
+    except Exception:  # pragma: no cover - autotune subsystem broken
+        return 1.0
+
+
+class PlanPrice:
+    """Predicted per-step wall time of one parallelism plan candidate.
+
+    Roofline decomposition over the cluster numbers the caller supplies
+    (defaults are a generic contemporary TPU chip):
+
+    * ``flops_ms``   — FLOPs / chip peak;
+    * ``hbm_ms``     — (bytes read + written) / HBM bandwidth;
+    * ``compute_ms`` — max(flops_ms, hbm_ms) × ``schedule_factor``
+      (the candidate's schedule inefficiency, e.g. the GPipe bubble
+      ``(M+S-1)/M``);
+    * ``ici_ms``     — ICI bytes / link bandwidth;
+    * ``launch_ms``  — per-collective launch overhead ×
+      ``collective_launches`` (how bucketed allreduce wins);
+    * ``step_ms``    — (compute + ici + launch) × ``calibration``
+      (:func:`plan_calibration_factor`).
+
+    Absolute numbers are estimates; the planner only needs the RANKING
+    to be faithful, and the calibration factor keeps even the absolute
+    scale honest once ``bench --child planner`` has measured a step.
+    """
+
+    __slots__ = ("flops_ms", "hbm_ms", "compute_ms", "ici_ms",
+                 "launch_ms", "step_ms", "ici_bytes",
+                 "peak_memory_bytes", "collective_launches",
+                 "schedule_factor", "calibration")
+
+    def __init__(self, flops_ms, hbm_ms, compute_ms, ici_ms, launch_ms,
+                 step_ms, ici_bytes, peak_memory_bytes,
+                 collective_launches, schedule_factor, calibration):
+        self.flops_ms = flops_ms
+        self.hbm_ms = hbm_ms
+        self.compute_ms = compute_ms
+        self.ici_ms = ici_ms
+        self.launch_ms = launch_ms
+        self.step_ms = step_ms
+        self.ici_bytes = int(ici_bytes)
+        self.peak_memory_bytes = int(peak_memory_bytes)
+        self.collective_launches = int(collective_launches)
+        self.schedule_factor = schedule_factor
+        self.calibration = calibration
+
+    def to_dict(self, canonical=False):
+        """``canonical=True`` divides the calibration factor back out
+        of ``step_ms`` and reports calibration 1.0 — the byte-stable
+        form the planner's determinism contract serializes (a cached
+        calibration scales every candidate alike, so the CHOICE is
+        invariant, and the canonical bytes must be too)."""
+        cal = (self.calibration
+               if canonical and self.calibration else None)
+        return {
+            "step_ms": round(self.step_ms / cal if cal
+                             else self.step_ms, 6),
+            "flops_ms": round(self.flops_ms, 6),
+            "hbm_ms": round(self.hbm_ms, 6),
+            "compute_ms": round(self.compute_ms, 6),
+            "ici_ms": round(self.ici_ms, 6),
+            "launch_ms": round(self.launch_ms, 6),
+            "ici_bytes": self.ici_bytes,
+            "peak_memory_bytes": self.peak_memory_bytes,
+            "collective_launches": self.collective_launches,
+            "schedule_factor": round(self.schedule_factor, 6),
+            "calibration": 1.0 if canonical
+            else round(self.calibration, 6),
+        }
+
+    def __repr__(self):
+        return ("PlanPrice(step=%.3fms compute=%.3f ici=%.3f "
+                "launch=%.3f peak=%dB)") % (
+            self.step_ms, self.compute_ms, self.ici_ms, self.launch_ms,
+            self.peak_memory_bytes)
+
+
+def price_plan(report, peak_tflops=100.0, hbm_gbps=1200.0,
+               ici_gbps=100.0, launch_us=5.0, schedule_factor=1.0,
+               collective_launches=None, calibration=None,
+               extra_ici_bytes=0, extra_launches=0):
+    """Price one worker's :class:`CostReport` against cluster numbers;
+    returns a :class:`PlanPrice`.  ``collective_launches`` overrides
+    the launch count (the planner models allreduce bucketing this way
+    without rewriting the program); ``extra_ici_bytes`` /
+    ``extra_launches`` charge traffic the program IR does not carry as
+    ops (the planner's ZeRO-1 candidates pay their per-step
+    param-allgather here); ``calibration`` overrides
+    :func:`plan_calibration_factor`."""
+    if collective_launches is None:
+        collective_launches = sum(
+            1 for c in report.op_costs if c.ici_bytes > 0)
+    collective_launches += int(extra_launches)
+    if calibration is None:
+        calibration = plan_calibration_factor()
+    flops_ms = report.total_flops / (max(peak_tflops, 1e-9) * 1e9)
+    hbm_ms = (report.total_bytes_read + report.total_bytes_written) \
+        / (max(hbm_gbps, 1e-9) * 1e6)
+    compute_ms = max(flops_ms, hbm_ms) * schedule_factor
+    ici_bytes = report.total_ici_bytes + int(extra_ici_bytes)
+    ici_ms = ici_bytes / (max(ici_gbps, 1e-9) * 1e6)
+    launch_ms = collective_launches * launch_us / 1000.0
+    step_ms = (compute_ms + ici_ms + launch_ms) * calibration
+    return PlanPrice(flops_ms, hbm_ms, compute_ms, ici_ms, launch_ms,
+                     step_ms, ici_bytes,
+                     report.peak_memory_bytes, collective_launches,
+                     schedule_factor, calibration)
+
+
+def price_program(program, cluster=None, nranks=None, targets=(),
+                  batch_size=None, shard_overrides=None,
+                  schedule_factor=1.0, collective_launches=None,
+                  budget=None, calibration=None):
+    """One-call plan pricing: interpret ``program`` (optionally with
+    :func:`~.interp.interpret_program` ``shard_overrides`` candidate
+    seeding), run the cost model, and price against ``cluster`` — any
+    object with ``peak_tflops`` / ``hbm_gbps`` / ``ici_gbps`` /
+    ``launch_us`` / ``hbm_bytes`` attributes (the planner's
+    ``ClusterSpec``), or None for the module defaults.  Returns
+    ``(CostReport, PlanPrice)``."""
+    interp = interpret_program(program, nranks=nranks,
+                               batch_size=batch_size,
+                               shard_overrides=shard_overrides)
+    if budget is None:
+        budget = getattr(cluster, "hbm_bytes", None) \
+            if cluster is not None else hbm_budget(program)
+    report = estimate_cost(program, interp=interp, targets=targets,
+                           budget=budget)
+    price = price_plan(
+        report,
+        peak_tflops=getattr(cluster, "peak_tflops", 100.0),
+        hbm_gbps=getattr(cluster, "hbm_gbps", 1200.0),
+        ici_gbps=getattr(cluster, "ici_gbps", 100.0),
+        launch_us=getattr(cluster, "launch_us", 5.0),
+        schedule_factor=schedule_factor,
+        collective_launches=collective_launches,
+        calibration=calibration)
+    return report, price

@@ -113,13 +113,13 @@ _ACT_TYPES = ("relu", "gelu", "tanh", "sigmoid", "relu6", "leaky_relu",
 # which makes the divergence easy to miss)
 _PROGRAM_MARKS = ("_num_trainers", "_trainer_id", "_host_tables",
                   "_hbm_budget", "_nan_guard", "_guard_loss_name",
-                  "_pipeline_stage", "_guard_abort_after")
+                  "_pipeline_stage", "_guard_abort_after",
+                  "_allreduce_bucket_mb", "_shard_optimizer_state")
 
-# per-var attrs clone() drops that execution semantics depend on:
-# feed-shape validation, targeted feed errors, ZeRO-1 accumulator
-# classification, and sharding marks on non-Parameter vars
-_VAR_MARKS = ("need_check_feed", "feed_hint", "_is_optimizer_state",
-              "_is_distributed", "shard_spec")
+# per-var attrs execution semantics depend on; Program.clone() now
+# preserves these itself (framework.CLONE_VAR_MARKS) — this copy pass
+# remains for rewrite paths that build vars without clone()
+from ..framework import CLONE_VAR_MARKS as _VAR_MARKS  # noqa: E402
 
 
 def _copy_var_marks(src_program, dst_program):
@@ -188,9 +188,19 @@ def _calibration(family, **key):
         return 1.0, str(family), False
 
 
-def allreduce_bucket_mb():
-    """Gradient-allreduce bucket cap in MB
-    (``PADDLE_TPU_ALLREDUCE_BUCKET_MB``, default 32)."""
+def allreduce_bucket_mb(program=None):
+    """Gradient-allreduce bucket cap in MB: the program's own
+    ``_allreduce_bucket_mb`` mark (how the auto-parallelism planner's
+    in-place apply scopes its chosen bucket to ONE program instead of
+    leaking a process-global env change), else
+    ``PADDLE_TPU_ALLREDUCE_BUCKET_MB``, default 32."""
+    mark = getattr(program, "_allreduce_bucket_mb", None) \
+        if program is not None else None
+    if mark:
+        try:
+            return float(mark)
+        except (TypeError, ValueError):
+            pass
     try:
         return float(os.environ.get(
             "PADDLE_TPU_ALLREDUCE_BUCKET_MB", "32") or 32)
@@ -1621,7 +1631,7 @@ def _find_allreduce(view, report, dry_run=False):
                str(view.var(x[0]).dtype))
         groups.setdefault(key, []).append((i, op, nbytes))
 
-    cap = int(allreduce_bucket_mb() * (1 << 20))
+    cap = int(allreduce_bucket_mb(block.program) * (1 << 20))
     matches = []
     for key, members in sorted(groups.items(),
                                key=lambda kv: kv[1][0][0]):
@@ -1690,7 +1700,7 @@ def _find_allreduce(view, report, dry_run=False):
                 predicted={
                     "collectives_removed": len(safe) - 1,
                     "ici_bytes_unchanged": total,
-                    "bucket_mb_cap": allreduce_bucket_mb(),
+                    "bucket_mb_cap": allreduce_bucket_mb(block.program),
                 },
                 note="ring %r; ICI volume unchanged, %d launches -> 1"
                      % (key[0], len(safe)))

@@ -350,19 +350,33 @@ def _seed_sharding(var, nranks, data_parallel=True):
     return Sharding.replicated()
 
 
-def interpret_program(program, nranks=None, batch_size=None):
+def interpret_program(program, nranks=None, batch_size=None,
+                      shard_overrides=None):
     """Walk ``program`` and return an :class:`InterpResult`.
 
     ``nranks``: worker count for the sharding lattice (default: the
     ``program._num_trainers`` the transpiler recorded, else 1).
     ``batch_size``: what ``-1`` dims resolve to (default
     :func:`assumed_batch_size`).
+    ``shard_overrides``: ``{var name: Sharding}`` candidate seeding —
+    pins the named vars to the given lattice points for the whole walk
+    (seed AND after every producing op), overriding both the recorded
+    annotations and the transfer rules.  This is how the
+    auto-parallelism planner prices hypothetical per-layer shard specs
+    (e.g. ZeRO-sharded optimizer state) without mutating the program.
     """
     if nranks is None:
         nranks = int(getattr(program, "_num_trainers", 1) or 1)
     if batch_size is None:
         batch_size = assumed_batch_size()
-    data_parallel = getattr(program, "_pipeline_stage", None) is None
+    # pipeline-stage workers feed each stage its LOCAL batch (feeds
+    # replicated) — EXCEPT hierarchical pipeline x dp stages, which
+    # carry _num_trainers = dp subgroup size and shard their feeds over
+    # it like any data-parallel program
+    data_parallel = (getattr(program, "_pipeline_stage", None) is None
+                     or int(getattr(program, "_num_trainers", 0)
+                            or 0) > 1)
+    shard_overrides = shard_overrides or {}
 
     env = {}
     records = []
@@ -380,6 +394,8 @@ def interpret_program(program, nranks=None, batch_size=None):
                 name, _resolve_shape(var.shape, batch_size), var.dtype,
                 persistable=var.persistable,
                 sharding=_seed_sharding(var, nranks, data_parallel))
+        if name in shard_overrides:
+            av.sharding = shard_overrides[name]
         env[name] = av
         return av
 
@@ -404,7 +420,8 @@ def interpret_program(program, nranks=None, batch_size=None):
                         batch_size),
                     var.dtype if var is not None else None,
                     persistable=bool(var is not None and var.persistable))
-                av.sharding = _transfer(op, in_vals, av)
+                av.sharding = shard_overrides.get(
+                    n) or _transfer(op, in_vals, av)
                 env[n] = av
                 out_vals.append(av)
             records.append(OpRecord(len(records), block.idx, op_idx, op,

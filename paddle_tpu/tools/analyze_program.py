@@ -58,6 +58,14 @@ def main(argv=None):
     parser.add_argument("--bench-json", default=None, metavar="PATH",
                         help="also write BENCH-style static cost "
                              "metric lines to PATH")
+    parser.add_argument("--plan", default=None, metavar="CLUSTER_SPEC",
+                        help="run the auto-parallelism planner against "
+                             "this ClusterSpec (JSON file, inline JSON, "
+                             "or a bare chip count) and print the "
+                             "candidate table — predicted step cost, "
+                             "ICI bytes, peak HBM, deadlock verdict, "
+                             "chosen/rejected reason — without "
+                             "executing anything")
     add_emitter_args(parser)
     args = parser.parse_args(argv)
     if not args.model_dir and not args.program_json:
@@ -80,13 +88,29 @@ def main(argv=None):
         targets=targets, workers=workers, nranks=args.nranks,
         batch_size=args.batch, hbm_budget=budget)
 
+    plan_result = None
+    if args.plan:
+        from ..parallel.planner import ClusterSpec, auto_transpile
+
+        try:
+            spec = ClusterSpec.coerce(args.plan)
+        except Exception as e:
+            print("error: bad --plan cluster spec: %s" % e,
+                  file=sys.stderr)
+            return 2
+        plan_result = auto_transpile(program, spec, targets=targets,
+                                     batch_size=args.batch)
+
     if args.as_json:
-        emit_diagnostics(report.diagnostics, True,
-                         extra_json={k: v for k, v in
-                                     report.to_dict().items()
-                                     if k != "diagnostics"})
+        extra = {k: v for k, v in report.to_dict().items()
+                 if k != "diagnostics"}
+        if plan_result is not None:
+            extra["plan"] = plan_result.to_dict()
+        emit_diagnostics(report.diagnostics, True, extra_json=extra)
     else:
         print(report.format(top_ops=args.top))
+        if plan_result is not None:
+            print(plan_result.format_table())
 
     if args.bench_json:
         with open(args.bench_json, "w") as f:

@@ -49,6 +49,12 @@ class DistributeTranspilerConfig:
     print_log = False
     wait_port = True
     collective_mode = None
+    # auto=True (or mode="auto"): route transpile() through the
+    # auto-parallelism planner (parallel.auto_transpile) instead of a
+    # hand-picked mode — the planner searches DP/pipeline/... against
+    # the PADDLE_TPU_CLUSTER_SPEC cost model, applies a DP-family
+    # winner in place, and stashes the PlanResult on program._auto_plan
+    auto = False
 
 
 def mark_sparse_tables(program):
@@ -116,6 +122,22 @@ class DistributeTranspiler:
             self.trainers = len(self.endpoints)
         else:
             self.trainers = int(trainers)
+        if mode == "auto" or getattr(self.config, "auto", False):
+            # planner-routed transpile: search, prove, apply
+            from ..parallel.planner import (apply_plan, auto_transpile,
+                                            resolve_cluster_spec)
+
+            program._trainer_id = trainer_id
+            program._num_trainers = self.trainers
+            if self.trainers <= 1:
+                return
+            result = auto_transpile(
+                program, resolve_cluster_spec(chips=self.trainers),
+                startup_program=startup_program)
+            apply_plan(program, result,
+                       startup_program=startup_program,
+                       rank=trainer_id)
+            return
         if getattr(self.config, "geo_sgd_mode", False):
             # reference geo-SGD (distribute_transpiler.py:131 geo fields):
             # local steps + periodic delta sync, redesigned as a gated

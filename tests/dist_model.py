@@ -104,6 +104,49 @@ def build_dp_workers(nranks=2):
     return workers, startups, loss_name
 
 
+def build_example_program(which):
+    """The planner-acceptance example programs (ISSUE 7): bert_base's
+    CI stand-in (BERT_TINY — same op structure, CPU-friendly), the
+    resnet trainer and the deepfm CTR trainer, each as
+    ``(main, startup, loss_name)``."""
+    fluid.unique_name.switch()
+    if which == "bert":
+        from paddle_tpu.models import bert
+
+        main, startup, _feeds, loss = bert.build_pretrain(
+            bert.BERT_TINY, seq_len=32, train=True)
+        return main, startup, loss.name
+    if which == "resnet":
+        from paddle_tpu.models import resnet
+
+        main, startup, _feeds, loss, _acc = resnet.build(
+            dataset="cifar10", depth=8)
+        return main, startup, loss.name
+    if which == "deepfm":
+        from paddle_tpu.models import ctr
+
+        main, startup, _feeds, loss, _prob = ctr.build(
+            model="deepfm", num_slots=4, slot_len=3, vocab=1000)
+        return main, startup, loss.name
+    raise ValueError(which)
+
+
+def build_example_dp_workers(which, nranks=8):
+    """Hand-written DP baseline for an example program — the exact
+    GradAllReduce journey a user would write, priced by the planner
+    tests against ``auto_transpile``'s chosen plan.  Emits rank 0's
+    program only (every rank is identical): returns
+    ``(worker0, startup0, loss_name)``."""
+    main, startup, loss_name = build_example_program(which)
+    from paddle_tpu.transpiler.collective import GradAllReduce
+
+    GradAllReduce().transpile(program=main, startup_program=startup,
+                              rank=0, nranks=nranks)
+    main._num_trainers = nranks
+    main._trainer_id = 0
+    return main, startup, loss_name
+
+
 def build_moe_workers(nranks=2):
     """Expert-parallel MLP: hidden acts go through the MoE dispatch
     all_to_all, an expert fc, and the combine all_to_all (ring 2).

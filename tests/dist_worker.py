@@ -34,7 +34,8 @@ def main():
 
     # framework collective op across processes via shard_map
     import jax.numpy as jnp
-    from jax import shard_map, make_array_from_process_local_data
+    from jax import make_array_from_process_local_data
+    from paddle_tpu.jax_compat import shard_map
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
     from paddle_tpu.ops import registry as op_registry
     from paddle_tpu.ops.registry import LoweringContext

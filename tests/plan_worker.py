@@ -1,0 +1,87 @@
+"""Subprocess helper for the planner determinism/acceptance tests:
+build a named program set, run ``auto_transpile``, and print one JSON
+line — the canonical plan bytes' sha256, the chosen plan, the search
+wall time, and the hand-written DP baseline's priced step time (so the
+parent asserts planner <= hand without a second build).
+
+    python plan_worker.py {mlp|bert|bert_base} CHIPS
+"""
+
+import hashlib
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+for p in (REPO, os.path.dirname(os.path.abspath(__file__))):
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+
+def main():
+    which, chips = sys.argv[1], int(sys.argv[2])
+    import paddle_tpu as fluid
+    from paddle_tpu.parallel.planner import (ClusterSpec, auto_transpile,
+                                             price_worker_set)
+    from paddle_tpu.transpiler.collective import GradAllReduce
+
+    fluid.unique_name.switch()
+    if which == "mlp":
+        import dist_model
+
+        main_prog, startup, loss, _feeds = dist_model.build_model()
+        loss_name = loss.name
+    elif which == "bert":
+        import dist_model
+
+        main_prog, startup, loss_name = dist_model.build_example_program(
+            "bert")
+    elif which == "bert_base":
+        from paddle_tpu.models import bert
+
+        main_prog, startup, _feeds, loss = bert.build_pretrain(
+            bert.BERT_BASE, seq_len=128, train=True)
+        loss_name = loss.name
+    else:
+        raise SystemExit("unknown program %r" % which)
+
+    spec = ClusterSpec(chips=chips)
+    t0 = time.time()
+    result = auto_transpile(main_prog, spec, startup_program=startup,
+                            targets=[loss_name])
+    search_s = time.time() - t0
+
+    # the hand-written DP baseline, priced by the same meter
+    fluid.unique_name.switch()
+    if which == "mlp":
+        import dist_model
+
+        hand, hstartup, hloss, _ = dist_model.build_model()
+    elif which == "bert":
+        import dist_model
+
+        hand, hstartup, _ = dist_model.build_example_program("bert")
+    else:
+        from paddle_tpu.models import bert
+
+        hand, hstartup, _feeds, hloss = bert.build_pretrain(
+            bert.BERT_BASE, seq_len=128, train=True)
+    GradAllReduce().transpile(program=hand, startup_program=hstartup,
+                              rank=0, nranks=chips)
+    hand._num_trainers = chips
+    _, hand_price = price_worker_set([hand], spec, targets=[loss_name])
+
+    js = result.to_json()
+    print(json.dumps({
+        "sha": hashlib.sha256(js.encode()).hexdigest(),
+        "plan": result.plan.candidate.describe(),
+        "step_ms": result.plan.price.step_ms,
+        "hand_dp_step_ms": hand_price.step_ms,
+        "deadlock_free": result.deadlock_free,
+        "search_s": round(search_s, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

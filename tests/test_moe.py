@@ -7,7 +7,7 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from paddle_tpu.jax_compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from paddle_tpu.parallel import moe_ffn, init_moe_params

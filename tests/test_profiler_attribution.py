@@ -44,7 +44,10 @@ def test_scope_tags_reach_hlo_metadata():
                                [loss.name], sc, "train")
         rw = {n: sc.get(n) for n in cb.rw_names}
         ro = {n: sc.get(n) for n in cb.ro_names}
-        txt = cb.jitted.lower(feed, rw, ro, ex.rng_key(0)).as_text(
+        from paddle_tpu.jax_compat import lowered_as_text
+
+        txt = lowered_as_text(
+            cb.jitted.lower(feed, rw, ro, ex.rng_key(0)),
             debug_info=True)
     tags = set(re.findall(r"pd\d+_[a-z0-9_]+", txt))
     types = {t.split("_", 1)[1] for t in tags}

@@ -213,6 +213,12 @@ def build_steps():
     # device-resident tables (the Pallas gather path); emits
     # resnet50_conv_fusion_speedup / deepfm_device_table_speedup
     item("bench_kernels", "kernels", 480, 480)
+    # ISSUE-7 auto-parallelism planner A/B on the real chips: the
+    # planner-chosen plan vs the hand-written DP builder on BERT_BASE;
+    # emits bert_base_auto_plan_speedup + planner_calibration_factor
+    # (the measured/predicted step time lands in the autotune cache so
+    # later searches on this backend price against silicon)
+    item("bench_planner", "planner", 480, 420)
     # space-to-depth stem (models/resnet.py _s2d_stem): folds the 7x7
     # stride-2 3-channel stem — the classic MXU-underfill — into a
     # dense 4x4/s1 conv over 12 channels (the TPU ResNet stem recipe)
