@@ -22,11 +22,13 @@ exist in serialized programs are recognized and skipped.
 """
 
 import contextlib
+import time as _time
 
 import numpy as np
 
 from . import core
 from . import pipeline as _pipeline
+from .observability import runtime as _obs
 from .framework import Program, default_main_program, Variable
 from .ops import registry as op_registry
 from .ops.registry import EMPTY_VAR_NAME
@@ -197,6 +199,40 @@ def _finish_fetches(fetches, return_numpy):
         return _pipeline.host_values(fetches)
     return [v if isinstance(v, FetchHandle) else FetchHandle(v)
             for v in fetches]
+
+
+def _register_compile_telemetry(compiled, program, feed_vals,
+                                fetch_names):
+    """Compile-time telemetry (shared by Executor and SPMDRunner):
+    register the cost model's predictions with the drift monitor and
+    install the compiled program's extracted collective schedule as
+    per-ring launch/payload gauges.  Best-effort — static analysis must
+    never fail a run — and skipped entirely under the kill switch."""
+    from .observability.metrics import telemetry_enabled
+
+    if not telemetry_enabled():
+        return
+    try:
+        from .observability import drift as _drift
+
+        batch = None
+        for v in feed_vals.values():
+            shape = getattr(v, "shape", None)
+            if shape:
+                batch = int(shape[0])
+                break
+        key = _drift.monitor().register_program(
+            program, batch_size=batch, targets=fetch_names)
+        compiled._drift_key = key
+        if key is not None:
+            from .static_analysis.distributed import \
+                extract_collective_schedule
+
+            _obs.set_collective_schedule(
+                extract_collective_schedule(program, batch_size=batch),
+                drift_key=key)
+    except Exception:  # noqa: BLE001 - telemetry never breaks a run
+        compiled._drift_key = None
 
 
 # ops executed host-side by Executor.run, invisible to the jit path
@@ -1180,6 +1216,7 @@ class Executor:
         from . import profiler as _prof
 
         compiled = self._cache.get(key_tuple) if use_program_cache else None
+        _obs.record_jit_cache(compiled is not None)
         if compiled is None:
             def _compile():
                 # injectable site (compile_fail) — and transient
@@ -1198,11 +1235,16 @@ class Executor:
                     nan_guard=nan_guard,
                 )
 
+            _t_compile = _time.perf_counter()
             with _prof.record_event("executor.lower_and_jit"):
                 compiled = _rretry.retry_call(_compile,
                                               site="executor.compile")
+            _obs.record_compile(
+                (_time.perf_counter() - _t_compile) * 1000.0)
             if use_program_cache:
                 self._cache[key_tuple] = compiled
+            _register_compile_telemetry(compiled, program, feed_vals,
+                                        fetch_names)
 
         rw = {n: scope.get(n) for n in compiled.rw_names}
         ro = promote_readonly_scope_arrays(scope, compiled)
@@ -1215,6 +1257,7 @@ class Executor:
         profiling = _prof.is_profiler_enabled()
         run_ctx = (_prof.record_event("executor.run") if profiling
                    else contextlib.nullcontext())
+        _t_step = _time.perf_counter()
         with run_ctx:
             # dispatch only: under jax async dispatch the jitted call
             # returns once the step is ENQUEUED — the matching
@@ -1226,6 +1269,7 @@ class Executor:
             with disp_ctx:
                 fetches, new_rw, fresh = compiled.jitted(
                     feed_vals, rw, ro, base_key)
+            _dispatch_ms = (_time.perf_counter() - _t_step) * 1000.0
             fetches = _apply_step_results(
                 compiled, scope, fetches, new_rw, fresh, fetch_names,
                 host_active, host_grad_fetches, cur_step)
@@ -1234,7 +1278,13 @@ class Executor:
                 run_host_io_block(program.global_block(), scope,
                                   phase="save")
 
-            return _finish_fetches(fetches, return_numpy)
+            result = _finish_fetches(fetches, return_numpy)
+        _obs.record_step(
+            "executor", cur_step,
+            (_time.perf_counter() - _t_step) * 1000.0,
+            dispatch_ms=_dispatch_ms,
+            drift_key=getattr(compiled, "_drift_key", None))
+        return result
 
     # ------ dataset entry points (reference executor.py:909) — see
     # paddle_tpu/trainer.py once the dataset path lands ------

@@ -1,0 +1,342 @@
+"""Instrumentation hooks — the one-line calls the executor, pipeline,
+resilience runtime and fusion resolver make.
+
+Centralizing the metric names and journal kinds here keeps the
+instrumented files to single-line edits, and keeps the disabled path
+uniform: every hook starts with the cached kill-switch check and
+returns immediately when telemetry is off.
+"""
+
+import os
+import threading
+import time
+
+from . import journal as _journal
+from . import metrics as _m
+from .metrics import telemetry_enabled
+
+__all__ = [
+    "record_step", "record_jit_cache", "record_compile",
+    "record_fusion_resolve", "record_feed_cache", "record_sync",
+    "record_prefetch", "record_guard_step", "record_guard_skip",
+    "record_checkpoint_save", "record_checkpoint_load", "record_retry",
+    "record_fault", "record_worker_lost", "record_missed_beat",
+    "set_collective_schedule", "last_step_info", "reset_runtime",
+]
+
+# latest step progress, consumed by the watchdog heartbeat payload so
+# `tools/monitor` can tell a wedged-but-alive rank from a healthy one
+_last_step = {"step": None, "step_ms": None, "ts": None}
+_last_step_lock = threading.Lock()
+
+# per-step collective totals of the last compiled program:
+# [(launches_counter, payload_counter, launches, payload_bytes)]
+# (counter handles pre-resolved at schedule install, off the step path)
+_collective_per_step = []
+
+# hot-path metric handles, resolved once per series: the registry's
+# get-or-create pays a sorted-label key build plus a lock per call,
+# which is real money at per-step rates.  Populated only while enabled;
+# reset_runtime() clears them (reset_telemetry() resets the registry
+# too, so a stale handle can never outlive its series).
+_step_handles = {}
+_jit_handles = {}
+_named_handles = {}
+
+
+def _step_h(runner):
+    h = _step_handles.get(runner)
+    if h is None:
+        h = (_m.counter("steps_total", runner=runner),
+             _m.histogram("step_wall_ms", runner=runner),
+             _m.histogram("step_dispatch_ms", runner=runner))
+        _step_handles[runner] = h
+    return h
+
+
+def _named(factory, name):
+    m = _named_handles.get(name)
+    if m is None:
+        m = factory(name)
+        _named_handles[name] = m
+    return m
+
+
+_env_cache = {}
+
+
+def _env_int(name, default):
+    v = _env_cache.get(name)
+    if v is None:
+        try:
+            v = int(os.environ.get(name, default))
+        except ValueError:
+            v = default
+        _env_cache[name] = v
+    return v
+
+
+def _step_event_every():
+    """Journal ``step`` events are SAMPLED (default every 10th step):
+    they exist for the monitor's rate/latency view, which step numbers
+    make exact anyway, and a per-step JSONL append would be the single
+    biggest line item in the <2% overhead budget.  Set
+    ``PADDLE_TPU_TELEMETRY_STEP_EVERY=1`` for full per-step fidelity."""
+    return max(_env_int("PADDLE_TPU_TELEMETRY_STEP_EVERY", 10), 1)
+
+
+_snapshot_state = {"steps": 0, "last_write": 0.0}
+
+
+def _maybe_write_snapshot():
+    """Refresh ``metrics-r<rank>-<pid>.json`` in the telemetry dir —
+    the gauge/histogram side of what the monitor CLI reads (the journal
+    carries the events).  Double-throttled: every
+    ``PADDLE_TPU_TELEMETRY_SNAPSHOT_EVERY`` steps AND at least
+    ``PADDLE_TPU_TELEMETRY_SNAPSHOT_SECS`` apart (the first write is
+    exempt so short runs still leave a snapshot)."""
+    j = _journal.get_journal()  # its dir is pinned at creation — no
+    if j.path is None:          # per-step env read on the hot path
+        return
+    _snapshot_state["steps"] += 1
+    if _snapshot_state["steps"] \
+            % max(_env_int("PADDLE_TPU_TELEMETRY_SNAPSHOT_EVERY", 25),
+                  1) != 1:
+        return
+    now = time.time()
+    if _snapshot_state["last_write"] and (
+            now - _snapshot_state["last_write"]
+            < _env_int("PADDLE_TPU_TELEMETRY_SNAPSHOT_SECS", 2)):
+        return
+    _snapshot_state["last_write"] = now
+    from .exporters import write_metrics_snapshot
+
+    write_metrics_snapshot(os.path.join(
+        os.path.dirname(j.path),
+        "metrics-r%d-%d.json" % (j.rank, os.getpid())))
+
+
+# ---------------------------------------------------------------------------
+# executor / SPMD runner
+# ---------------------------------------------------------------------------
+
+def record_step(runner, step, wall_ms, dispatch_ms=None,
+                drift_key=None):
+    """One completed training/inference step."""
+    if not telemetry_enabled():
+        return
+    steps_c, wall_h, disp_h = _step_h(runner)
+    steps_c.inc()
+    wall_h.observe(wall_ms)
+    if dispatch_ms is not None:
+        disp_h.observe(dispatch_ms)
+    with _last_step_lock:
+        _last_step["step"] = step
+        _last_step["step_ms"] = wall_ms
+        _last_step["ts"] = time.time()
+    for launches_c, payload_c, launches, payload in _collective_per_step:
+        launches_c.inc(launches)
+        payload_c.inc(payload)
+    ev = _step_event_every()
+    if ev == 1 or steps_c.value % ev == 1:
+        _journal.emit("step", runner=runner, step=step,
+                      wall_ms=round(wall_ms, 4),
+                      dispatch_ms=None if dispatch_ms is None
+                      else round(dispatch_ms, 4))
+    if drift_key is not None:
+        from . import drift as _drift
+
+        _drift.monitor().observe_step(wall_ms, key=drift_key,
+                                      step=step)
+    _maybe_write_snapshot()
+
+
+def record_jit_cache(hit, runner="executor"):
+    if not telemetry_enabled():
+        return
+    key = (runner, bool(hit))
+    c = _jit_handles.get(key)
+    if c is None:
+        c = _m.counter("jit_cache_hits_total" if hit
+                       else "jit_cache_misses_total", runner=runner)
+        _jit_handles[key] = c
+    c.inc()
+
+
+def record_compile(ms, runner="executor"):
+    if not telemetry_enabled():
+        return
+    _m.histogram("compile_ms", runner=runner).observe(ms)
+    _journal.emit("compile", runner=runner, compile_ms=round(ms, 2))
+
+
+def record_fusion_resolve(hit):
+    if not telemetry_enabled():
+        return
+    _named(_m.counter,
+           "fusion_resolve_cache_hits_total" if hit
+           else "fusion_resolve_cache_misses_total").inc()
+
+
+# ---------------------------------------------------------------------------
+# async pipeline
+# ---------------------------------------------------------------------------
+
+def record_feed_cache(hit):
+    if not telemetry_enabled():
+        return
+    _named(_m.counter,
+           "feed_cache_hits_total" if hit
+           else "feed_cache_misses_total").inc()
+
+
+def record_sync(wait_ms, handles=1):
+    """One batched device->host sync drained ``handles`` handles."""
+    if not telemetry_enabled():
+        return
+    _named(_m.counter, "host_syncs_total").inc()
+    _named(_m.counter, "host_sync_handles_total").inc(handles)
+    _named(_m.histogram, "host_sync_wait_ms").observe(wait_ms)
+
+
+def record_prefetch(depth, capacity):
+    """Prefetch queue occupancy observed at a consumer get()."""
+    if not telemetry_enabled():
+        return
+    _named(_m.counter, "prefetch_gets_total").inc()
+    _named(_m.gauge, "prefetch_queue_depth").set(depth)
+    if capacity:
+        _named(_m.gauge, "prefetch_occupancy").set(
+            depth / float(capacity))
+
+
+# ---------------------------------------------------------------------------
+# resilience runtime
+# ---------------------------------------------------------------------------
+
+def record_guard_step(finite):
+    if not telemetry_enabled():
+        return
+    _named(_m.counter, "guard_steps_total").inc()
+    if not finite:
+        _named(_m.counter, "guard_skips_total").inc()
+
+
+def record_guard_skip(step, consecutive):
+    if not telemetry_enabled():
+        return
+    _journal.emit("guard-skip", step=step, consecutive=consecutive)
+
+
+def record_checkpoint_save(step, duration_ms, nbytes, path):
+    if not telemetry_enabled():
+        return
+    _m.counter("checkpoint_saves_total").inc()
+    _m.histogram("checkpoint_save_ms").observe(duration_ms)
+    _m.counter("checkpoint_bytes_written_total").inc(nbytes)
+    _m.gauge("checkpoint_last_step").set(step if step is not None else -1)
+    _m.gauge("checkpoint_last_save_ts").set(time.time())
+    _journal.emit("checkpoint-saved", step=step,
+                  duration_ms=round(duration_ms, 2), bytes=nbytes,
+                  path=os.path.basename(str(path)))
+
+
+def record_checkpoint_load(step, duration_ms, path):
+    if not telemetry_enabled():
+        return
+    _m.counter("checkpoint_loads_total").inc()
+    _m.histogram("checkpoint_load_ms").observe(duration_ms)
+    _journal.emit("checkpoint-loaded", step=step,
+                  duration_ms=round(duration_ms, 2),
+                  path=os.path.basename(str(path)))
+
+
+def record_retry(site):
+    if not telemetry_enabled():
+        return
+    _m.counter("retries_total", site=site or "unknown").inc()
+
+
+def record_fault(kind, step=None, site=None):
+    if not telemetry_enabled():
+        return
+    _m.counter("faults_injected_total", kind=kind).inc()
+    _journal.emit("fault-injected", fault=kind, step=step, site=site)
+
+
+def record_worker_lost(ranks, reason=""):
+    if not telemetry_enabled():
+        return
+    _m.counter("workers_lost_total").inc(max(len(ranks), 1))
+    _journal.emit("worker-lost", ranks=list(ranks), reason=reason)
+
+
+def record_missed_beat(ranks):
+    if not telemetry_enabled():
+        return
+    _m.counter("watchdog_missed_beats_total").inc(max(len(ranks), 1))
+
+
+# ---------------------------------------------------------------------------
+# collectives
+# ---------------------------------------------------------------------------
+
+def set_collective_schedule(schedule, drift_key=None):
+    """Install the compiled program's extracted per-ring schedule:
+    gauges for the per-step shape, and the per-step totals the step
+    hook turns into running counters.  ``schedule`` is
+    ``{ring_id: [CollectiveEvent]}``."""
+    global _collective_per_step
+    if not telemetry_enabled():
+        return
+    per_step = []
+    total_bytes = 0
+    try:
+        from ..static_analysis.cost import dtype_bytes
+    except Exception:  # noqa: BLE001
+        def dtype_bytes(_d):
+            return 4
+    for ring, events in (schedule or {}).items():
+        label = str(ring)
+        payload = sum(int(e.numel) * dtype_bytes(e.dtype)
+                      for e in events)
+        per_step.append((
+            _m.counter("collective_launches_total", ring=label),
+            _m.counter("collective_payload_bytes_total", ring=label),
+            len(events), payload))
+        total_bytes += payload
+        _m.gauge("collective_launches_per_step", ring=label).set(
+            len(events))
+        _m.gauge("collective_payload_bytes_per_step", ring=label).set(
+            payload)
+    _collective_per_step = per_step
+    if drift_key is not None and schedule:
+        from . import drift as _drift
+
+        _drift.monitor().observe_scheduled_ici(total_bytes,
+                                               key=drift_key)
+
+
+# ---------------------------------------------------------------------------
+# watchdog payload
+# ---------------------------------------------------------------------------
+
+def last_step_info():
+    """``{"step": ..., "step_ms": ..., "ts": ...}`` of the newest
+    completed step (None fields before the first) — what heartbeats
+    embed so the monitor can flag a wedged-but-alive rank."""
+    with _last_step_lock:
+        return dict(_last_step)
+
+
+def reset_runtime():
+    """Clear cross-step state and cached handles (test isolation)."""
+    global _collective_per_step
+    with _last_step_lock:
+        _last_step.update(step=None, step_ms=None, ts=None)
+    _collective_per_step = []
+    _snapshot_state.update(steps=0, last_write=0.0)
+    _step_handles.clear()
+    _jit_handles.clear()
+    _named_handles.clear()
+    _env_cache.clear()

@@ -11,13 +11,17 @@ The reference's BuildStrategy reduce/fuse/hierarchical knobs are subsumed by
 the XLA partitioner.
 """
 
+import time as _time
+
 import numpy as np
 
 from .. import core
 from ..executor import (_CompiledBlock, _apply_step_results,
                         _finish_fetches, _host_table_prefetch,
-                        _host_table_push, global_scope,
-                        promote_readonly_scope_arrays, rng_key)
+                        _host_table_push, _register_compile_telemetry,
+                        global_scope, promote_readonly_scope_arrays,
+                        rng_key)
+from ..observability import runtime as _obs
 from ..framework import Variable, default_main_program
 
 __all__ = ["ParallelExecutor", "SPMDRunner"]
@@ -162,7 +166,9 @@ class SPMDRunner:
                      tuple(fetch_names), nan_guard,
                      getattr(program, "_fusion_sig", None))
         compiled = self._cache.get(key_tuple)
+        _obs.record_jit_cache(compiled is not None, runner="spmd")
         if compiled is None:
+            _t_compile = _time.perf_counter()
             compiled = _CompiledBlock(
                 program,
                 program.global_block(),
@@ -176,18 +182,31 @@ class SPMDRunner:
                 shard_opt_state=self.shard_opt_state,
                 nan_guard=nan_guard,
             )
+            _obs.record_compile(
+                (_time.perf_counter() - _t_compile) * 1000.0,
+                runner="spmd")
             self._cache[key_tuple] = compiled
+            _register_compile_telemetry(compiled, program, feed_vals,
+                                        fetch_names)
 
         rw = {n: scope.get(n) for n in compiled.rw_names}
         ro = promote_readonly_scope_arrays(scope, compiled)
         seed = program.random_seed or 0
         base_key = jax.random.fold_in(rng_key(seed), executor._step)
         executor._step += 1
+        _t_step = _time.perf_counter()
         fetches, new_rw, fresh = compiled.jitted(feed_vals, rw, ro, base_key)
+        _dispatch_ms = (_time.perf_counter() - _t_step) * 1000.0
         fetches = _apply_step_results(
             compiled, scope, fetches, new_rw, fresh, fetch_names,
             host_active, host_grad_fetches, cur_step)
-        return _finish_fetches(fetches, return_numpy)
+        result = _finish_fetches(fetches, return_numpy)
+        _obs.record_step(
+            "spmd", cur_step,
+            (_time.perf_counter() - _t_step) * 1000.0,
+            dispatch_ms=_dispatch_ms,
+            drift_key=getattr(compiled, "_drift_key", None))
+        return result
 
 
 class ParallelExecutor:

@@ -124,9 +124,13 @@ def host_values(values):
             out = _copy_all(vals)
     else:
         out = _copy_all(vals)
+    wait_ms = (time.perf_counter() - t0) * 1e3
     with _sync_lock:
         _sync_count += 1
-        _sync_wait_ms += (time.perf_counter() - t0) * 1e3
+        _sync_wait_ms += wait_ms
+    from .observability import runtime as _obs
+
+    _obs.record_sync(wait_ms, handles=len(dev))
     return out
 
 
@@ -291,10 +295,14 @@ class FeedCache:
     def get(self, name, host_value):
         if not _cache_enabled():
             return None
+        from .observability import runtime as _obs
+
         e = self._entries.get(name)
         if (e is not None and e[0] is host_value
                 and e[2] == self._fingerprint(host_value)):
+            _obs.record_feed_cache(True)
             return e[1]
+        _obs.record_feed_cache(False)
         return None
 
     def put(self, name, host_value, device_value):
@@ -413,8 +421,15 @@ class DeviceFeedPipeline:
         act = self._active or self._spawn()
         self._active = None
         q, stop = act
+        from .observability import runtime as _obs
+
         try:
             while True:
+                # occupancy sampled before the blocking get: qsize==0
+                # here means the consumer is about to stall on the
+                # producer — the starvation signal the prefetch gauges
+                # exist to expose
+                _obs.record_prefetch(q.qsize(), q.maxsize)
                 item = q.get()
                 if item is _PipeEnd:
                     return

@@ -28,7 +28,7 @@ import time
 __all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
            "record_event", "cuda_profiler", "is_profiler_enabled",
            "attribute_op_name", "device_op_stats", "device_op_events",
-           "host_event_stats"]
+           "host_event_stats", "export_chrome_trace"]
 
 _trace_dir = None
 _enabled = False
@@ -114,9 +114,14 @@ def _print_summary(sorted_key):
     print()
 
 
-def _write_chrome_trace(path):
+def _write_chrome_trace(path, device_events=None):
     """chrome://tracing 'traceEvents' JSON (tools/timeline.py output
-    format: X (complete) events with microsecond timestamps)."""
+    format: X (complete) events with microsecond timestamps).
+
+    ``device_events`` — parsed :func:`device_op_events` rows
+    ``(op_name, ts_us, dur_us, line_name)`` — render as pid 1 with one
+    tid per device line, so the device stream sits next to the host
+    phase events instead of being silently dropped."""
     events = []
     with _events_lock:
         evs = list(_events)
@@ -125,9 +130,46 @@ def _write_chrome_trace(path):
             "name": name, "cat": "paddle_tpu", "ph": "X",
             "pid": 0, "tid": tid, "ts": t0, "dur": t1 - t0,
         })
+    if device_events:
+        events.append({"name": "process_name", "ph": "M", "pid": 1,
+                       "args": {"name": "device"}})
+        line_tids = {}
+        for name, ts, dur, line in device_events:
+            tid = line_tids.setdefault(line, len(line_tids))
+            events.append({
+                "name": name, "cat": "device", "ph": "X",
+                "pid": 1, "tid": tid, "ts": ts, "dur": dur,
+            })
+        for line, tid in line_tids.items():
+            events.append({"name": "thread_name", "ph": "M", "pid": 1,
+                           "tid": tid, "args": {"name": line}})
     with open(path, "w") as f:
         json.dump({"traceEvents": events,
                    "displayTimeUnit": "ms"}, f)
+
+
+def _collect_device_events():
+    """Best-effort device rows from the session's trace dir ([] when
+    there is no device trace or the xplane can't be parsed)."""
+    if _trace_dir is None:
+        return []
+    try:
+        return device_op_events(_trace_dir)
+    except Exception:  # noqa: BLE001 - merge is best-effort
+        return []
+
+
+def export_chrome_trace(path):
+    """Write the merged host+device chrome trace for the current (or
+    just-stopped) profiler session.  Returns ``path``, or None when
+    there is nothing to export."""
+    with _events_lock:
+        have_host = bool(_events)
+    device_events = _collect_device_events()
+    if not have_host and not device_events:
+        return None
+    _write_chrome_trace(path, device_events=device_events)
+    return path
 
 
 # ---------------------------------------------------------------------------
@@ -280,6 +322,7 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
     if not _enabled:
         return
     _enabled = False
+    device_events = []
     if _device_trace:
         import jax
 
@@ -297,20 +340,27 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
         except Exception as e:  # noqa: BLE001 - table is best-effort
             print("[paddle_tpu.profiler] per-op attribution unavailable: "
                   "%s" % e)
+        device_events = _collect_device_events()
     if profile_path:
         try:
-            _write_chrome_trace(profile_path)
-            print("[paddle_tpu.profiler] host timeline written to %s "
-                  "(open with chrome://tracing)" % profile_path)
+            _write_chrome_trace(profile_path,
+                                device_events=device_events)
+            print("[paddle_tpu.profiler] %stimeline written to %s "
+                  "(open with chrome://tracing)"
+                  % ("host+device " if device_events else "host ",
+                     profile_path))
         except OSError:
             pass
     _print_summary(sorted_key)
 
 
 def reset_profiler():
-    global _events
+    global _events, _trace_dir
     with _events_lock:
         _events = []
+    # a stale dir from a previous session would silently misattribute
+    # the next device_op_stats read; a new device trace re-sets it
+    _trace_dir = None
 
 
 @contextlib.contextmanager
@@ -323,6 +373,21 @@ def profiler(state="All", sorted_key=None, profile_path="/tmp/profile",
         stop_profiler(sorted_key, profile_path)
 
 
+_trace_annotation = None
+
+
+def _get_trace_annotation():
+    """``jax.profiler.TraceAnnotation``, imported once — record_event
+    sits on the executor's per-step path, so the disabled case must not
+    pay an ``import jax`` lookup every call."""
+    global _trace_annotation
+    if _trace_annotation is None:
+        import jax
+
+        _trace_annotation = jax.profiler.TraceAnnotation
+    return _trace_annotation
+
+
 @contextlib.contextmanager
 def record_event(name):
     """Scoped annotation: host event (when profiling) + device trace
@@ -330,18 +395,14 @@ def record_event(name):
     if not _enabled:
         # still forward to the device tracer so annotations show up in
         # externally started jax traces
-        import jax
-
-        with jax.profiler.TraceAnnotation(name):
+        with _get_trace_annotation()(name):
             yield
         return
-    import jax
-
     # wall-clock epoch so traces from different hosts merge sensibly in
     # tools/timeline.py
     t0 = time.time_ns() // 1000
     try:
-        with jax.profiler.TraceAnnotation(name):
+        with _get_trace_annotation()(name):
             yield
     finally:
         t1 = time.time_ns() // 1000

@@ -223,8 +223,18 @@ def save_checkpoint(executor, root, main_program=None, step=0, state=None,
             shutil.rmtree(tmp, ignore_errors=True)
             raise
 
+    t0 = time.perf_counter()
     final = _retry.retry_call(_attempt, policy=policy,
                               site="save_checkpoint(step=%d)" % step)
+    from ..observability import runtime as _obs
+
+    try:
+        nbytes = sum(os.path.getsize(full)
+                     for _rel, full in _walk_files(final))
+    except OSError:
+        nbytes = 0
+    _obs.record_checkpoint_save(
+        step, (time.perf_counter() - t0) * 1000.0, nbytes, final)
     _prune(root, retain if retain is not None else _default_retain())
     return final
 
@@ -291,6 +301,7 @@ def try_load_latest_checkpoint(executor, root, main_program=None,
     from .. import io as fluid_io
 
     inj = _faults.get_injector()
+    t0 = time.perf_counter()
     for step, path in list_checkpoints(root):
         try:
             def _attempt():
@@ -318,6 +329,11 @@ def try_load_latest_checkpoint(executor, root, main_program=None,
         if os.path.exists(state_path):
             with open(state_path) as f:
                 state = json.load(f).get("state", {})
+        from ..observability import runtime as _obs
+
+        _obs.record_checkpoint_load(
+            manifest.get("step", step),
+            (time.perf_counter() - t0) * 1000.0, path)
         return CheckpointInfo(step=manifest.get("step", step), path=path,
                               state=state)
     return None

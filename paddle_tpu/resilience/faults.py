@@ -94,6 +94,19 @@ class TransientFault(FaultInjected, OSError):
     io retry policy treats it as retryable)."""
 
 
+def _record_fault(kind, step, site=None):
+    """Count + journal an injected fault.  The journal treats
+    ``fault-injected`` as urgent (synchronous flush) — worker_kill
+    ``os._exit``\\ s immediately after, and the whole point is that the
+    monitor can still see the fault."""
+    try:
+        from ..observability import runtime as _obs
+
+        _obs.record_fault(kind, step=step, site=site)
+    except Exception:  # noqa: BLE001 - telemetry never blocks a fault
+        pass
+
+
 def _parse_value(tok):
     t = tok.strip().lower()
     if t in ("nan",):
@@ -308,6 +321,7 @@ class FaultInjector:
                 # persist BEFORE dying: the restarted incarnation must
                 # see this preemption as already-spent
                 self._persist_state()
+                _record_fault("worker_kill", step)
                 print("FAULT_INJECTED worker_kill step=%d rank=%d"
                       % (step, self.rank), file=sys.stderr, flush=True)
                 os._exit(KILL_EXIT_CODE)
@@ -316,6 +330,7 @@ class FaultInjector:
                 import sys
 
                 self._persist_state()
+                _record_fault("worker_hang", step)
                 print("FAULT_INJECTED worker_hang step=%d rank=%d "
                       "secs=%s" % (step, self.rank, f.secs),
                       file=sys.stderr, flush=True)
@@ -331,6 +346,7 @@ class FaultInjector:
         for f in self.faults:
             if f.site == site and f.should_fire(step, self.rank):
                 self._persist_state()
+                _record_fault(f.kind, step, site=site)
                 raise TransientFault(
                     "injected %s at site %r (step %s, firing %d/%s)"
                     % (f.kind, site, step, f.fired,
@@ -347,6 +363,9 @@ class FaultInjector:
                  for f in self.trace_faults]
         if any(gates):
             self._persist_state()
+            for f, g in zip(self.trace_faults, gates):
+                if g:
+                    _record_fault(f.kind, step)
         return np.asarray(gates, dtype=np.float32)
 
     def make_value_hook(self, gate, loss_name=None):

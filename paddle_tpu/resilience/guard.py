@@ -81,14 +81,18 @@ def record_step(finite, step=None):
     raises ``RuntimeError`` once ``max_consecutive_skips`` consecutive
     steps were non-finite (the run has diverged — backoff cannot fix
     arithmetic)."""
+    from ..observability import runtime as _obs
+
     finite = bool(finite)
     stats.total_steps += 1
+    _obs.record_guard_step(finite)
     if finite:
         stats.consecutive_skips = 0
         return True
     stats.skipped_steps += 1
     stats.consecutive_skips += 1
     stats.last_skipped_step = step
+    _obs.record_guard_skip(step, stats.consecutive_skips)
     warnings.warn(
         "non-finite loss/gradients at step %s — parameter update skipped "
         "(%d/%d steps skipped so far)"

@@ -104,6 +104,9 @@ def retry_call(fn, *args, policy=None, site="", on_retry=None, **kwargs):
                 "%.0f ms" % ((" at %s" % site) if site else "", attempt,
                              policy.max_attempts, e, delay * 1000.0),
                 RuntimeWarning, stacklevel=2)
+            from ..observability import runtime as _obs
+
+            _obs.record_retry(site)
             if on_retry is not None:
                 on_retry(attempt, e, delay)
             time.sleep(delay)

@@ -22,13 +22,14 @@ harness) and NFS-backed real ones both get this for free, and a
 heartbeat writer that is itself wedged cannot lie.
 """
 
+import json
 import os
 import sys
 import threading
 import time
 
 __all__ = ["WorkerLostError", "HeartbeatWriter", "HeartbeatMonitor",
-           "wait_cluster", "LOST_EXIT_CODE"]
+           "wait_cluster", "read_heartbeat", "LOST_EXIT_CODE"]
 
 #: exit status a worker uses when its peer-loss watchdog trips
 LOST_EXIT_CODE = 44
@@ -44,12 +45,52 @@ class WorkerLostError(RuntimeError):
         self.returncodes = tuple(returncodes)
 
 
+def _record_lost(ranks, reason):
+    """Journal + count a worker-loss verdict (urgent-flushed — the
+    default on_lost handler ``os._exit``\\ s right after)."""
+    try:
+        from ..observability import runtime as _obs
+
+        _obs.record_missed_beat(ranks)
+        _obs.record_worker_lost(ranks, reason=reason)
+    except Exception:  # noqa: BLE001 - telemetry never blocks the exit
+        pass
+
+
 def _hb_path(dirname, rank):
     return os.path.join(dirname, "hb-%d" % rank)
 
 
 def _done_path(dirname, rank):
     return _hb_path(dirname, rank) + ".done"
+
+
+def read_heartbeat(dirname, rank):
+    """Parse one rank's heartbeat file: ``{"t", "rank", and — when the
+    telemetry layer has seen a step — "step", "step_ms", "step_ts"}``,
+    plus ``"mtime"`` (what staleness is judged on).  Returns None when
+    the file is absent.  Tolerates the pre-telemetry plain-float
+    payload and torn writes (mtime still counts as a beat)."""
+    path = _hb_path(dirname, rank)
+    try:
+        mtime = os.path.getmtime(path)
+        with open(path) as f:
+            raw = f.read().strip()
+    except OSError:
+        return None
+    out = {"rank": int(rank), "mtime": mtime}
+    try:
+        payload = json.loads(raw)
+        if isinstance(payload, dict):
+            out.update(payload)
+        else:
+            out["t"] = float(payload)
+    except (ValueError, TypeError):
+        try:
+            out["t"] = float(raw)
+        except (ValueError, TypeError):
+            pass
+    return out
 
 
 class HeartbeatWriter:
@@ -65,11 +106,28 @@ class HeartbeatWriter:
 
     def beat(self):
         """One heartbeat now (atomic create-or-touch; no fsync — a beat
-        is cheap and its loss is one interval, not corruption)."""
+        is cheap and its loss is one interval, not corruption).
+
+        The payload carries the newest step number + step latency from
+        the telemetry layer, so ``tools/monitor`` can tell a
+        wedged-but-alive rank (fresh beats, step frozen) from a healthy
+        one.  Staleness detection stays mtime-based — a reader that
+        ignores the content loses nothing."""
         from .atomic import atomic_write
 
+        payload = {"t": time.time(), "rank": self.rank}
+        try:
+            from ..observability import runtime as _obs
+
+            info = _obs.last_step_info()
+            if info.get("step") is not None:
+                payload["step"] = info["step"]
+                payload["step_ms"] = round(info["step_ms"], 3)
+                payload["step_ts"] = info["ts"]
+        except Exception:  # noqa: BLE001 - a beat must never fail
+            pass
         atomic_write(_hb_path(self.dirname, self.rank),
-                     lambda f: f.write("%f\n" % time.time()),
+                     lambda f: f.write(json.dumps(payload) + "\n"),
                      fsync=False, text=True)
 
     def start(self):
@@ -155,10 +213,16 @@ class HeartbeatMonitor:
                 stale.append(rank)
         return stale
 
+    def progress_of(self, rank):
+        """The rank's parsed heartbeat payload (see
+        :func:`read_heartbeat`), or None."""
+        return read_heartbeat(self.dirname, rank)
+
     def check(self):
         """Raise :class:`WorkerLostError` if any watched rank is stale."""
         stale = self.stale_ranks()
         if stale:
+            _record_lost(stale, "heartbeat stale > %.1fs" % self.timeout)
             raise WorkerLostError(
                 "worker rank(s) %s heartbeat stale for > %.1fs (dir %s)"
                 % (stale, self.timeout, self.dirname), ranks=stale)
@@ -181,6 +245,8 @@ class HeartbeatMonitor:
             while not self._stop.wait(self.interval):
                 stale = self.stale_ranks()
                 if stale:
+                    _record_lost(stale,
+                                 "heartbeat stale > %.1fs" % self.timeout)
                     handler(stale)
                     return
 
@@ -214,6 +280,8 @@ def wait_cluster(procs, timeout=None, poll=0.25, kill_on_failure=True):
                     if c is None:
                         p.kill()
             ranks = [i for i, _ in bad]
+            _record_lost(ranks, "exited with code(s) %s"
+                         % [c for _, c in bad])
             raise WorkerLostError(
                 "cluster worker(s) %s exited with code(s) %s"
                 % (ranks, [c for _, c in bad]),
@@ -226,6 +294,7 @@ def wait_cluster(procs, timeout=None, poll=0.25, kill_on_failure=True):
                 for p, c in zip(procs, codes):
                     if c is None:
                         p.kill()
+            _record_lost(hung, "timeout after %.1fs" % float(timeout))
             raise WorkerLostError(
                 "cluster worker(s) %s still running after %.1fs timeout "
                 "(likely hung in a collective)" % (hung, float(timeout)),

@@ -1891,13 +1891,17 @@ def resolve_fused_program(program, config=None, targets=()):
     if not config.enabled:
         report = FusionReport(config)
         return program, report
+    from ..observability import runtime as _obs
+
     tkey = tuple(sorted({getattr(t, "name", t) for t in (targets or ())}))
     key = (config.signature(), program._version, tkey)
     cache = program.__dict__.setdefault("_fusion_cache", {})
     hit = cache.get(key)
     if hit is not None:
+        _obs.record_fusion_resolve(True)
         fused, report = hit
         return (fused if fused is not None else program), report
+    _obs.record_fusion_resolve(False)
     # drop entries of stale versions so a mutated-every-step program
     # cannot leak clones
     for k in [k for k in cache if k[1] != program._version]:
@@ -1920,4 +1924,16 @@ def resolve_fused_program(program, config=None, targets=()):
     clone._fusion_sig = config.signature()
     clone._fusion_report = report
     cache[key] = (clone, report)
+    try:
+        from ..observability import journal as _journal
+
+        _journal.emit(
+            "fusion-applied",
+            applied={name: count for name, count
+                     in sorted(report.applied.items())}
+            if isinstance(report.applied, dict)
+            else list(report.applied),
+            signature=config.signature())
+    except Exception:  # noqa: BLE001 - telemetry never breaks resolve
+        pass
     return clone, report

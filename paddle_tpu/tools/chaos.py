@@ -127,6 +127,10 @@ def _run_worker(args):
         start_step = int(info.state.get("next_step", info.step + 1))
         print("CHAOS_RESUME step=%d from=%s"
               % (start_step, os.path.basename(info.path)), flush=True)
+        from paddle_tpu.observability import journal as _journal
+
+        _journal.emit("resume", step=start_step,
+                      source=os.path.basename(info.path))
 
     for k, (xb, yb) in enumerate(_batches(args.steps)):
         if k < start_step:
@@ -218,8 +222,17 @@ def _run_driver(args):
     backoff = _retry.RetryPolicy(max_attempts=args.max_restarts + 1,
                                  base_delay=0.2, max_delay=2.0, seed=7)
     delays = backoff.delays()
-    print("chaos: spec=%r steps=%d ckpt=%s"
-          % (args.spec, args.steps, ckpt_dir), flush=True)
+    # the drill doubles as the observability acceptance scenario: every
+    # incarnation journals into one shared dir, so the monitor CLI can
+    # replay the fault -> guard-skip -> restore story afterwards
+    from paddle_tpu.observability.metrics import telemetry_enabled
+
+    telemetry_dir = args.telemetry_dir
+    if telemetry_dir is None and telemetry_enabled():
+        telemetry_dir = os.path.join(ckpt_dir, "telemetry")
+    print("chaos: spec=%r steps=%d ckpt=%s telemetry=%s"
+          % (args.spec, args.steps, ckpt_dir, telemetry_dir or "off"),
+          flush=True)
 
     for incarnation in range(args.max_restarts + 1):
         env = dict(os.environ)
@@ -232,6 +245,8 @@ def _run_driver(args):
             "PADDLE_TPU_NAN_GUARD": "1",
             "JAX_PLATFORMS": env.get("JAX_PLATFORMS", "cpu"),
         })
+        if telemetry_dir:
+            env["PADDLE_TPU_TELEMETRY_DIR"] = telemetry_dir
         cmd = [sys.executable, "-m", "paddle_tpu.tools.chaos", "--worker",
                "--steps", str(args.steps), "--ckpt-dir", ckpt_dir]
         with tempfile.NamedTemporaryFile("w+", suffix=".log",
@@ -274,7 +289,15 @@ def _run_driver(args):
     print("chaos: worker recovered; skipped steps=%s resumes=%s"
           % (sorted(skipped), all_resumes), flush=True)
 
-    oracle = _oracle_digest(args.steps, skipped)
+    # the oracle replay is bookkeeping, not training: keep its steps and
+    # checkpoints out of the telemetry the workers just wrote
+    from paddle_tpu.observability import metrics as _metrics
+
+    _metrics.set_telemetry_enabled(False)
+    try:
+        oracle = _oracle_digest(args.steps, skipped)
+    finally:
+        _metrics.set_telemetry_enabled(None)
     if oracle != final_sha:
         print("chaos: FAIL — final params %s != fault-free oracle %s "
               "(recovery diverged)" % (final_sha[:16], oracle[:16]),
@@ -296,6 +319,11 @@ def main(argv=None):
         help="fault spec (see resilience/faults.py grammar)")
     parser.add_argument("--steps", type=int, default=9)
     parser.add_argument("--ckpt-dir", default=None)
+    parser.add_argument("--telemetry-dir", default=None,
+                        help="journal/metrics dir for the workers "
+                             "(default: <ckpt-dir>/telemetry when "
+                             "telemetry is on); tail it with "
+                             "python -m paddle_tpu.tools.monitor")
     parser.add_argument("--max-restarts", type=int, default=3)
     parser.add_argument("--worker-timeout", type=float, default=300.0,
                         help="seconds per worker incarnation (bounds "

@@ -1,0 +1,460 @@
+"""Always-on runtime telemetry (ISSUE 9): metrics registry + kill
+switch, the schema-versioned step/event journal (round-trip and
+torn-write tolerance), predicted-vs-measured drift math, the
+Prometheus/JSON exporters against goldens, the `tools/monitor` CLI
+exit-code contract, and the chaos-integration acceptance scenario
+(fault -> guard-skip -> checkpoint-restore readable from the journal).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import drift as od
+from paddle_tpu.observability import exporters as oe
+from paddle_tpu.observability import journal as oj
+from paddle_tpu.observability import metrics as om
+from paddle_tpu.tools import monitor as mon
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry(monkeypatch):
+    """Every test starts with fresh singletons and no telemetry env
+    knobs leaking in (or out)."""
+    for var in ("PADDLE_TPU_TELEMETRY", "PADDLE_TPU_TELEMETRY_DIR",
+                "PADDLE_TPU_TELEMETRY_FLUSH", "PADDLE_TPU_TELEMETRY_RING",
+                "PADDLE_TPU_TELEMETRY_STEP_EVERY",
+                "PADDLE_TPU_DRIFT_RECORD", "PADDLE_TPU_DRIFT_EVERY",
+                "PADDLE_TPU_DRIFT_RECORD_EVERY"):
+        monkeypatch.delenv(var, raising=False)
+    obs.reset_telemetry()
+    yield
+    obs.reset_telemetry()
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+class TestRegistry:
+    def test_counter_semantics(self):
+        c = om.counter("t_steps_total")
+        c.inc()
+        c.inc(4)
+        assert c.value == 5
+        with pytest.raises(ValueError):
+            c.inc(-1)
+        # get-or-create returns the same instance
+        assert om.counter("t_steps_total") is c
+
+    def test_gauge_semantics(self):
+        g = om.gauge("t_depth")
+        g.set(3.5)
+        g.inc()
+        g.dec(0.5)
+        assert g.value == 4.0
+
+    def test_labels_are_distinct_series(self):
+        a = om.counter("t_ring_total", ring="0")
+        b = om.counter("t_ring_total", ring="1")
+        assert a is not b
+        a.inc(2)
+        assert b.value == 0
+        # label order never matters: keyed on sorted items
+        assert om.gauge("t_xy", x="1", y="2") is om.gauge(
+            "t_xy", y="2", x="1")
+
+    def test_kind_conflict_is_a_bug_not_an_overwrite(self):
+        om.counter("t_conflict")
+        with pytest.raises(TypeError):
+            om.gauge("t_conflict")
+
+    def test_histogram_buckets_and_percentiles(self):
+        h = om.histogram("t_lat_ms", buckets=(1.0, 2.0, 5.0, 10.0))
+        for v in (0.5, 1.5, 3.0, 7.0, 100.0):
+            h.observe(v)
+        d = h.to_dict()
+        assert d["count"] == 5 and d["counts"] == [1, 1, 1, 1, 1]
+        assert d["min"] == 0.5 and d["max"] == 100.0
+        assert abs(d["sum"] - 112.0) < 1e-9
+        # percentile interpolates within the bucket, clamps to max
+        assert 0.0 < h.percentile(10) <= 1.0
+        assert h.percentile(99) <= 100.0
+        assert h.percentile(100) == 100.0
+        assert om.histogram("t_empty").percentile(50) is None
+
+    def test_kill_switch_shares_one_null_stub(self):
+        om.set_telemetry_enabled(False)
+        n_before = len(om.registry())
+        c = om.counter("t_dead_total")
+        assert c is om.NULL_METRIC
+        assert om.gauge("t_dead_g") is c is om.histogram("t_dead_h")
+        c.inc()
+        c.observe(1.0)
+        c.set(2.0)
+        assert c.value == 0
+        # nothing was registered, nothing journaled
+        assert len(om.registry()) == n_before
+        assert oj.emit("step", step=1) is None
+
+    def test_env_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_TELEMETRY", "0")
+        om.reset_metrics()  # re-arm the lazy env read
+        assert not om.telemetry_enabled()
+        monkeypatch.setenv("PADDLE_TPU_TELEMETRY", "1")
+        om.set_telemetry_enabled(None)
+        assert om.telemetry_enabled()
+
+
+# ---------------------------------------------------------------------------
+# step/event journal
+# ---------------------------------------------------------------------------
+class TestJournal:
+    def test_round_trip(self, tmp_path):
+        j = oj.Journal(dirname=str(tmp_path), flush_every=2, rank=3)
+        j.emit("plan-chosen", plan="dp2", score=1.5)
+        j.emit("step", step=1, wall_ms=2.25)
+        j.flush()
+        events = oj.read_journal(str(tmp_path))
+        assert [e["kind"] for e in events] == ["plan-chosen", "step"]
+        assert all(e["schema"] == oj.SCHEMA_VERSION for e in events)
+        assert all(e["rank"] == 3 for e in events)
+        assert events[0]["plan"] == "dp2"
+        assert events[1]["wall_ms"] == 2.25
+        # file-or-dir reader: same result via the explicit path
+        assert oj.read_journal(j.path) == events
+
+    def test_urgent_kinds_flush_immediately(self, tmp_path):
+        j = oj.Journal(dirname=str(tmp_path), flush_every=1000)
+        j.emit("step", step=1)
+        assert oj.read_journal(str(tmp_path)) == []  # still buffered
+        j.emit("fault-injected", fault="nan_grad", step=3)
+        kinds = [e["kind"] for e in oj.read_journal(str(tmp_path))]
+        assert "fault-injected" in kinds  # crash-critical: on disk now
+
+    def test_torn_and_foreign_lines_are_skipped(self, tmp_path):
+        j = oj.Journal(dirname=str(tmp_path), flush_every=1)
+        j.emit("checkpoint-saved", step=5)
+        with open(j.path, "a") as f:
+            f.write('{"kind": "torn", "ts": 9')      # killed mid-write
+            f.write("\nnot json at all\n")
+            f.write(json.dumps({"no_kind": True}) + "\n")
+            f.write(json.dumps({"schema": 99, "kind": "future",
+                                "ts": 1.0}) + "\n")  # future writer
+        j.emit("resume", step=5)
+        events = oj.read_journal(str(tmp_path))
+        assert [e["kind"] for e in events] == ["checkpoint-saved",
+                                               "resume"]
+
+    def test_ring_is_bounded(self):
+        j = oj.Journal(capacity=4)
+        for i in range(10):
+            j.emit("step", step=i)
+        assert len(j) == 4
+        assert [e["step"] for e in j.events("step")] == [6, 7, 8, 9]
+
+    def test_read_missing_path_is_empty(self, tmp_path):
+        assert oj.read_journal(str(tmp_path / "nope")) == []
+
+
+# ---------------------------------------------------------------------------
+# drift monitor
+# ---------------------------------------------------------------------------
+class TestDrift:
+    def test_ratio_math_and_gauges(self):
+        m = od.monitor()
+        m.register("prog-a", predicted_step_ms=10.0,
+                   predicted_ici_bytes=1000, predicted_peak_bytes=2048)
+        m.observe_step(20.0, key="prog-a")
+        state = m.get("prog-a")
+        assert state.measured_ms_ema == 20.0
+        assert state.step_ratio() == 2.0
+        g = om.registry().get("drift_ratio", kind="step_ms")
+        assert g is not None and g.value == 2.0
+        # EMA folds the next sample at alpha=0.1
+        m.observe_step(10.0, key="prog-a")
+        assert abs(state.measured_ms_ema - 19.0) < 1e-9
+        assert abs(g.value - 1.9) < 1e-9
+        m.observe_scheduled_ici(500, key="prog-a")
+        assert state.ici_ratio() == 0.5
+        gi = om.registry().get("drift_ratio", kind="ici_bytes")
+        assert gi is not None and gi.value == 0.5
+        assert set(state.ratios()) == {"step_ms", "ici_bytes"}
+
+    def test_register_report_prices_golden_program(self):
+        from paddle_tpu.static_analysis import analyze_program
+
+        main = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+            y = fluid.layers.fc(input=x, size=8, act="relu")
+            loss = fluid.layers.mean(y)
+        report = analyze_program(main, targets=[loss], batch_size=4)
+        key = od.monitor().register_report(report)
+        state = od.monitor().get(key)
+        assert state is not None
+        assert state.predicted_step_ms > 0
+        assert state.predicted_peak_bytes \
+            == report.cost.peak_memory_bytes
+        m = od.monitor()
+        m.observe_step(1.0, key=key)
+        r = state.step_ratio()
+        assert r is not None and r > 0 and r == 1.0 / state.predicted_step_ms
+
+    def test_calibration_recorded_into_autotune_cache(self, monkeypatch):
+        from paddle_tpu.autotune import lookup, sweep_signature
+
+        monkeypatch.setenv("PADDLE_TPU_DRIFT_RECORD", "1")
+        od.reset_drift()
+        m = od.monitor()
+        m.register("prog-cal", predicted_step_ms=10.0)
+        for _ in range(od._RECORD_WARMUP_STEPS + 1):
+            m.observe_step(20.0, key="prog-cal")
+        sig = sweep_signature(od.DRIFT_CALIBRATION_FAMILY,
+                              {"program": "prog-cal"})
+        hit = lookup(sig)
+        assert hit is not None
+        assert abs(hit["calibration"] - 2.0) < 0.05
+        c = om.registry().get("drift_calibrations_recorded_total")
+        assert c is not None and c.value >= 1
+
+    def test_recording_defaults_off_without_telemetry_dir(self):
+        from paddle_tpu.autotune import lookup, sweep_signature
+
+        m = od.monitor()
+        assert not m.recording_enabled()
+        m.register("prog-norec", predicted_step_ms=10.0)
+        for _ in range(od._RECORD_WARMUP_STEPS + 1):
+            m.observe_step(20.0, key="prog-norec")
+        sig = sweep_signature(od.DRIFT_CALIBRATION_FAMILY,
+                              {"program": "prog-norec"})
+        assert lookup(sig) is None
+
+    def test_drift_events_journal_periodically(self, monkeypatch,
+                                               tmp_path):
+        monkeypatch.setenv("PADDLE_TPU_TELEMETRY_DIR", str(tmp_path))
+        monkeypatch.setenv("PADDLE_TPU_DRIFT_EVERY", "5")
+        monkeypatch.setenv("PADDLE_TPU_DRIFT_RECORD", "0")
+        obs.reset_telemetry()
+        m = od.monitor()
+        m.register("prog-j", predicted_step_ms=4.0)
+        for _ in range(10):
+            m.observe_step(8.0, key="prog-j")
+        oj.get_journal().flush()
+        drifts = [e for e in oj.read_journal(str(tmp_path))
+                  if e["kind"] == "drift"]
+        assert len(drifts) == 2
+        assert drifts[-1]["ratios"]["step_ms"] == pytest.approx(2.0)
+
+
+# ---------------------------------------------------------------------------
+# exporters
+# ---------------------------------------------------------------------------
+class TestExporters:
+    def _populate(self):
+        om.counter("a_total").inc(3)
+        om.gauge("g", x="1").set(2.5)
+        h = om.histogram("h", buckets=(1.0, 10.0))
+        for v in (0.5, 5.0, 50.0):
+            h.observe(v)
+
+    def test_prometheus_golden(self):
+        self._populate()
+        assert oe.export_prometheus() == (
+            "# TYPE paddle_tpu_a_total counter\n"
+            "paddle_tpu_a_total 3\n"
+            "# TYPE paddle_tpu_g gauge\n"
+            'paddle_tpu_g{x="1"} 2.5\n'
+            "# TYPE paddle_tpu_h histogram\n"
+            'paddle_tpu_h_bucket{le="1"} 1\n'
+            'paddle_tpu_h_bucket{le="10"} 2\n'
+            'paddle_tpu_h_bucket{le="+Inf"} 3\n'
+            "paddle_tpu_h_sum 55.5\n"
+            "paddle_tpu_h_count 3\n")
+
+    def test_json_export_shape(self):
+        self._populate()
+        snap = oe.export_json()
+        assert snap["schema"] == 1 and snap["pid"] == os.getpid()
+        metrics = snap["metrics"]
+        assert metrics["a_total"] == {"type": "counter", "value": 3}
+        assert metrics['g{x="1"}']["value"] == 2.5
+        hist = metrics["h"]
+        assert hist["count"] == 3 and hist["counts"] == [1, 1, 1]
+        assert hist["p50"] is not None and hist["p99"] <= 50.0
+
+    def test_snapshot_write_is_atomic(self, tmp_path):
+        self._populate()
+        path = str(tmp_path / "metrics-r0-1.json")
+        snap = oe.write_metrics_snapshot(path)
+        assert snap is not None
+        with open(path) as f:
+            on_disk = json.load(f)
+        assert on_disk["metrics"] == snap["metrics"]
+        assert not [n for n in os.listdir(str(tmp_path))
+                    if ".tmp." in n]
+
+
+# ---------------------------------------------------------------------------
+# monitor CLI
+# ---------------------------------------------------------------------------
+def _fake_run(dirname):
+    """Synthesize one rank's telemetry dir: journal with an incident
+    story, a metrics snapshot, and heartbeats."""
+    j = oj.Journal(dirname=dirname, flush_every=1, rank=0)
+    for s in (1, 11, 21, 31, 41):
+        j.emit("step", runner="executor", step=s, wall_ms=2.0 + s / 100.0)
+    j.emit("fault-injected", fault="nan_grad", step=3)
+    j.emit("guard-skip", step=3, consecutive=1)
+    j.emit("checkpoint-saved", step=5, duration_ms=4.0, bytes=1024,
+           path="ckpt-5")
+    j.emit("checkpoint-loaded", step=5, duration_ms=3.0, path="ckpt-5")
+    j.emit("resume", step=5, source="ckpt-5")
+    j.emit("step", runner="executor", step=50, wall_ms=2.5)
+    j.flush()
+
+    om.counter("steps_total", runner="executor").inc(50)
+    om.counter("guard_steps_total").inc(50)
+    om.counter("guard_skips_total").inc(1)
+    h = om.histogram("step_wall_ms", runner="executor")
+    for _ in range(49):
+        h.observe(2.0)
+    h.observe(40.0)
+    om.gauge("drift_ratio", kind="step_ms").set(1.25)
+    om.gauge("checkpoint_last_save_ts").set(time.time() - 5.0)
+    oe.write_metrics_snapshot(
+        os.path.join(dirname, "metrics-r0-%d.json" % os.getpid()))
+
+    now = time.time()
+    with open(os.path.join(dirname, "hb-0"), "w") as f:
+        f.write(json.dumps({"t": now, "rank": 0, "step": 50,
+                            "step_ms": 2.5, "step_ts": now}))
+    with open(os.path.join(dirname, "hb-1"), "w") as f:  # wedged rank
+        f.write(json.dumps({"t": now, "rank": 1, "step": 12,
+                            "step_ms": 2.5, "step_ts": now - 300.0}))
+
+
+class TestMonitor:
+    def test_collect_status(self, tmp_path):
+        _fake_run(str(tmp_path))
+        st = mon.collect_status(str(tmp_path))
+        assert st["steps"] == 50
+        assert st["p50_step_ms"] is not None
+        assert st["p99_step_ms"] > st["p50_step_ms"]
+        assert st["skip_rate"] == pytest.approx(0.02)
+        assert st["faults"] == 1 and st["restores"] == 1
+        assert st["drift"] == {"step_ms": 1.25}
+        assert 0 < st["checkpoint_age_s"] < 60
+        assert [e["kind"] for e in st["sequence"]] == [
+            "fault-injected", "guard-skip", "checkpoint-saved",
+            "checkpoint-loaded", "resume"]
+        assert st["ranks"]["0"]["alive"] and not st["ranks"]["0"]["wedged"]
+        assert st["ranks"]["1"]["wedged"]
+        assert st["alive_ranks"] == 2 and st["lost_ranks"] == 0
+        # the human rendering mentions the incident tail + wedged rank
+        text = mon.render_status(st)
+        assert "fault-injected" in text and "WEDGED" in text
+
+    def test_alert_exit_codes(self, tmp_path):
+        _fake_run(str(tmp_path))
+        st = mon.collect_status(str(tmp_path))
+        assert mon.check_alert(st, "p99_step_ms>1000000")[0] == 0
+        assert mon.check_alert(st, "faults>=1")[0] == 1
+        assert mon.check_alert(st, "no_such_field>1")[0] == 2
+        # dotted path and the bare-name alias into drift
+        assert mon.check_alert(st, "drift.step_ms>2")[0] == 0
+        assert mon.check_alert(st, "step_ms>1.2")[0] == 1
+        with pytest.raises(ValueError):
+            mon.check_alert(st, "p99 !! 5")
+
+    def test_cli_subprocess_contract(self, tmp_path):
+        _fake_run(str(tmp_path))
+        env = dict(os.environ)
+        env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO})
+
+        def run(*extra):
+            return subprocess.run(
+                [sys.executable, "-m", "paddle_tpu.tools.monitor",
+                 str(tmp_path), "--once", "--json"] + list(extra),
+                capture_output=True, text=True, timeout=120, env=env,
+                cwd=REPO)
+
+        res = run()
+        assert res.returncode == 0, res.stderr[-800:]
+        st = json.loads(res.stdout)
+        assert st["steps"] == 50 and st["faults"] == 1
+
+        assert run("--alert", "p99_step_ms>1000000").returncode == 0
+        assert run("--alert", "faults>=1").returncode == 1
+        assert run("--alert", "no_such_field>1").returncode == 2
+
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        res = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.tools.monitor",
+             str(empty), "--once"],
+            capture_output=True, text=True, timeout=120, env=env,
+            cwd=REPO)
+        assert res.returncode == 2
+        assert "no telemetry" in res.stderr
+
+
+# ---------------------------------------------------------------------------
+# chaos integration — the ISSUE-9 acceptance scenario
+# ---------------------------------------------------------------------------
+class TestChaosTelemetry:
+    def test_chaos_run_yields_readable_incident_story(self, tmp_path):
+        """A chaos run with telemetry on produces a journal from which
+        the monitor reports the fault -> guard-skip -> restore sequence
+        and a finite drift ratio."""
+        tdir = str(tmp_path / "telemetry")
+        env = dict(os.environ)
+        env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO})
+        env.pop("PADDLE_TPU_FAULT_SPEC", None)
+        env.pop("PADDLE_TPU_TELEMETRY", None)
+        res = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.tools.chaos",
+             "--steps", "9", "--ckpt-dir", str(tmp_path / "ckpt"),
+             "--telemetry-dir", tdir,
+             "--spec", "nan_grad@step=3;worker_kill@step=7"],
+            capture_output=True, text=True, timeout=300, env=env,
+            cwd=REPO)
+        assert res.returncode == 0, res.stdout[-3000:] + res.stderr[-800:]
+
+        events = oj.read_journal(tdir)
+        first = {}
+        for e in events:
+            first.setdefault(e["kind"], e["ts"])
+        assert "fault-injected" in first and "guard-skip" in first \
+            and "checkpoint-loaded" in first and "resume" in first
+        # the incident reads in causal order from the merged journal
+        assert first["fault-injected"] <= first["guard-skip"]
+        assert first["guard-skip"] <= first["checkpoint-loaded"]
+
+        out = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.tools.monitor", tdir,
+             "--once", "--json"],
+            capture_output=True, text=True, timeout=120, env=env,
+            cwd=REPO)
+        assert out.returncode == 0, out.stderr[-800:]
+        st = json.loads(out.stdout)
+        assert st["faults"] >= 1
+        assert st["guard_skips"] >= 1
+        assert st["restores"] >= 1
+        kinds = [e["kind"] for e in st["sequence"]]
+        assert kinds.index("fault-injected") < kinds.index("guard-skip")
+        assert kinds.index("guard-skip") \
+            < kinds.index("checkpoint-loaded")
+        if st["drift"]:  # registered when the cost model priced the run
+            import math
+
+            assert all(math.isfinite(v) and v > 0
+                       for v in st["drift"].values())

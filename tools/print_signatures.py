@@ -93,6 +93,12 @@ MODULES = [
     "paddle_tpu.resilience.checkpoint",
     "paddle_tpu.device_worker",
     "paddle_tpu.evaluator",
+    "paddle_tpu.observability",
+    "paddle_tpu.observability.metrics",
+    "paddle_tpu.observability.journal",
+    "paddle_tpu.observability.drift",
+    "paddle_tpu.observability.exporters",
+    "paddle_tpu.observability.runtime",
 ]
 
 
