@@ -863,6 +863,102 @@ def child_kernels():
     }), flush=True)
 
 
+def child_lint():
+    """Static-analysis CI arm (ISSUE 10): run the whole-program
+    analyzer with the concurrency battery (max_in_flight=2) over every
+    examples/ builder and all dist_model worker sets, and fail (exit 1)
+    on ANY ERROR diagnostic — the same sweep the analyzer tests run,
+    but wired into the bench harness so perf/CI runs catch analyzer or
+    example regressions without waiting on the full test suite.  Emits
+    ``static_lint_programs_checked`` / ``static_lint_errors`` BENCH
+    lines plus per-program failure detail on stderr."""
+    import paddle_tpu as fluid
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    for sub in ("examples", "tests"):
+        p = os.path.join(repo, sub)
+        if p not in sys.path:
+            sys.path.insert(0, p)
+
+    def example_sets():
+        import bert_pretrain
+        import mnist_train
+        import ps_migration
+        import resnet_infer
+        import slim_compress
+
+        fluid.unique_name.switch()
+        main, startup, test_prog, loss, acc = mnist_train.build_program()
+        yield "mnist", [(main, [loss.name, acc.name]),
+                        (test_prog, [acc.name]), (startup, None)]
+        fluid.unique_name.switch()
+        main, startup, feeds, loss = bert_pretrain.build_program(
+            tiny=True, seq_len=32)
+        yield "bert-tiny", [(main, [loss.name]), (startup, None)]
+        fluid.unique_name.switch()
+        main, startup, loss = ps_migration.build_ctr(vocab=512)
+        yield "ctr", [(main, [loss.name]), (startup, None)]
+        fluid.unique_name.switch()
+        main, startup, prob = resnet_infer.build_program()
+        yield "resnet-eval", [(main, [prob.name]), (startup, None)]
+        fluid.unique_name.switch()
+        main, startup, loss, acc, prob = slim_compress.build_program()
+        yield "slim", [(main, [loss.name, acc.name]), (startup, None)]
+
+    def worker_sets():
+        import dist_model
+
+        workers, _, loss = dist_model.build_pipeline_workers()
+        yield "dist-pipeline", workers, loss
+        workers, _, loss = dist_model.build_dp_workers(nranks=2)
+        yield "dist-dp2", workers, loss
+        w0, _, loss = dist_model.build_example_dp_workers(
+            "bert", nranks=8)
+        yield "dist-bert-dp8", [w0], loss
+        workers, _, out = dist_model.build_moe_workers(nranks=2)
+        yield "dist-moe2", workers, out
+
+    checked, errors = 0, 0
+    failures = []
+
+    def sweep(label, program, targets):
+        nonlocal checked, errors
+        checked += 1
+        report = program.analyze(targets=targets, concurrency=True,
+                                 max_in_flight=2)
+        bad = list(report.errors)
+        if bad:
+            errors += len(bad)
+            failures.append(label)
+            for d in bad:
+                print("LINT %s: %s" % (label, d), file=sys.stderr)
+
+    for name, progs in example_sets():
+        for i, (program, targets) in enumerate(progs):
+            sweep("%s[%d]" % (name, i), program, targets)
+    for name, workers, fetch in worker_sets():
+        for rank, w in enumerate(workers):
+            has = any(fetch in op.output_arg_names
+                      for b in w.blocks for op in b.ops)
+            sweep("%s[r%d]" % (name, rank), w,
+                  [fetch] if has else None)
+
+    print(json.dumps({
+        "metric": "static_lint_programs_checked",
+        "value": checked,
+        "unit": "programs (examples + dist worker sets, "
+                "concurrency@K=2)",
+    }), flush=True)
+    print(json.dumps({
+        "metric": "static_lint_errors",
+        "value": errors,
+        "unit": "ERROR diagnostics (failing: %s)"
+                % (", ".join(failures) or "none"),
+    }), flush=True)
+    if errors:
+        raise SystemExit(1)
+
+
 def child_planner():
     """Auto-parallelism planner A/B (ISSUE 7): search the placement
     space for the BERT trainer at the visible chip count, execute the
@@ -1521,6 +1617,8 @@ if __name__ == "__main__":
             child_kernels()
         elif mode == "planner":
             child_planner()
+        elif mode == "lint":
+            child_lint()
         else:
             raise SystemExit("unknown child mode %r" % mode)
         sys.exit(0)

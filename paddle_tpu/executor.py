@@ -22,6 +22,7 @@ exist in serialized programs are recognized and skipped.
 """
 
 import contextlib
+import threading
 import time as _time
 
 import numpy as np
@@ -164,20 +165,37 @@ class Scope:
 
 
 _global_scope = Scope()
-_scope_stack = [_global_scope]
+
+
+class _ScopeStack(threading.local):
+    """PER-THREAD scope stack (latent hazard found by the ISSUE-10
+    concurrency analyzer): the stack used to be one process-wide list,
+    so two predictors serving from different threads interleaved their
+    ``scope_guard`` push/pops — thread A's executor could resolve
+    ``global_scope()`` to thread B's private scope and read (or donate)
+    the other tenant's weights.  Each thread now gets its own stack
+    rooted at the shared global scope; single-threaded behavior is
+    unchanged, and the ``scope-overlap`` check proves the remaining
+    (deliberate) sharing safe."""
+
+    def __init__(self):
+        self.frames = [_global_scope]
+
+
+_scope_stack = _ScopeStack()
 
 
 def global_scope():
-    return _scope_stack[-1]
+    return _scope_stack.frames[-1]
 
 
 @contextlib.contextmanager
 def scope_guard(scope):
-    _scope_stack.append(scope)
+    _scope_stack.frames.append(scope)
     try:
         yield
     finally:
-        _scope_stack.pop()
+        _scope_stack.frames.pop()
 
 
 def as_numpy(value):
@@ -186,7 +204,8 @@ def as_numpy(value):
     return np.asarray(value)
 
 
-def _finish_fetches(fetches, return_numpy):
+def _finish_fetches(fetches, return_numpy, fetch_names=(),
+                    state_names=()):
     """Fetch-return protocol shared by Executor.run and SPMDRunner.run.
 
     ``return_numpy=True``: ONE batched device→host sync issued after the
@@ -194,11 +213,26 @@ def _finish_fetches(fetches, return_numpy):
     — not one blocking ``np.asarray`` per fetch value.
     ``return_numpy=False``: lazy :class:`FetchHandle`\\ s — no sync at
     all until a handle is materialized, so a serving/training loop can
-    keep many steps in flight and block once."""
+    keep many steps in flight and block once.
+
+    A fetch value whose name is in ``state_names`` (the compiled
+    block's read-write / fresh persistables) IS the scope array the
+    next step's donation invalidates — exactly the
+    ``donated-buffer-live-read`` hazard the concurrency analyzer flags.
+    Lazy handles for those are detached with a device-side copy (async,
+    no host sync) so a handle materialized after later steps dispatched
+    still reads this step's value instead of a deleted buffer."""
     if return_numpy:
         return _pipeline.host_values(fetches)
-    return [v if isinstance(v, FetchHandle) else FetchHandle(v)
-            for v in fetches]
+    out = []
+    state = set(state_names)
+    for i, v in enumerate(fetches):
+        if (state and i < len(fetch_names)
+                and fetch_names[i] in state
+                and not isinstance(v, FetchHandle)):
+            v = _pipeline.detach_device(v)
+        out.append(v if isinstance(v, FetchHandle) else FetchHandle(v))
+    return out
 
 
 def _register_compile_telemetry(compiled, program, feed_vals,
@@ -1150,7 +1184,11 @@ class Executor:
                 run_host_io_block(program.global_block(), scope,
                                   phase="save")
                 vals = [scope.get(n) for n in fetch_names]
-                return _finish_fetches(vals, return_numpy)
+                # every value here is a live scope array — detach lazy
+                # handles so a later step's donation can't gut them
+                return _finish_fetches(vals, return_numpy,
+                                       fetch_names=fetch_names,
+                                       state_names=fetch_names)
 
         # device transfer of feeds (reference: _feed_data → set_feed_variable)
         # with a placement cache: the SAME host array re-fed step after
@@ -1278,7 +1316,10 @@ class Executor:
                 run_host_io_block(program.global_block(), scope,
                                   phase="save")
 
-            result = _finish_fetches(fetches, return_numpy)
+            result = _finish_fetches(
+                fetches, return_numpy, fetch_names=fetch_names,
+                state_names=(tuple(compiled.rw_names)
+                             + tuple(compiled.fresh_persist)))
         _obs.record_step(
             "executor", cur_step,
             (_time.perf_counter() - _t_step) * 1000.0,

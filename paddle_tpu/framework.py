@@ -431,7 +431,8 @@ CLONE_VAR_MARKS = ("need_check_feed", "feed_hint",
 # that clone to BUILD a topology (transpile_pipeline, fusion's resolved
 # clones via _PROGRAM_MARKS) manage them explicitly.
 CLONE_PROGRAM_MARKS = ("_shard_optimizer_state", "_allreduce_bucket_mb",
-                       "_hbm_budget")
+                       "_hbm_budget", "_max_in_flight",
+                       "_serving_hot_loop")
 
 
 class Program:
@@ -636,20 +637,32 @@ class Program:
                               exclude=exclude)
 
     def analyze(self, targets=None, workers=None, nranks=None,
-                batch_size=None, hbm_budget=None):
+                batch_size=None, hbm_budget=None, concurrency=False,
+                max_in_flight=None, coresident=None,
+                certify_zero_sync=False):
         """Whole-program distributed static analysis: abstract
         interpretation (shape/dtype/sharding per var), the static
         FLOP/byte/ICI cost model with a liveness-based peak-memory
         estimate, this worker's per-ring collective schedule, and —
         when ``workers`` supplies the N transpiled per-worker programs
         — the cross-worker collective schedule deadlock-freedom proof.
+        ``concurrency=True`` adds the happens-before concurrency
+        analysis (:mod:`paddle_tpu.static_analysis.concurrency`):
+        in-flight race detection at ``max_in_flight`` (default 2), the
+        ``scope-overlap`` isolation proof against ``coresident``
+        programs, and — with ``certify_zero_sync=True`` — the zero-sync
+        certificate for the steady-state loop.
         Returns a :class:`paddle_tpu.static_analysis.AnalysisReport`;
         raises nothing (gate on ``report.errors``)."""
         from .static_analysis import analyze_program
 
         return analyze_program(self, targets=targets, workers=workers,
                                nranks=nranks, batch_size=batch_size,
-                               hbm_budget=hbm_budget)
+                               hbm_budget=hbm_budget,
+                               concurrency=concurrency,
+                               max_in_flight=max_in_flight,
+                               coresident=coresident,
+                               certify_zero_sync=certify_zero_sync)
 
     def __repr__(self):
         return "Program(blocks=%d, version=%d)" % (len(self.blocks), self._version)

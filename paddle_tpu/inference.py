@@ -294,7 +294,8 @@ class AnalysisPredictor:
         handles' syncs with ``paddle_tpu.pipeline.materialize``."""
         return self.run(inputs, return_numpy=False)
 
-    def run_batches(self, batches, max_in_flight=2, return_numpy=True):
+    def run_batches(self, batches, max_in_flight=2, return_numpy=True,
+                    verify=False):
         """Streamed serving loop: generator yielding one result list per
         input batch, keeping up to ``max_in_flight`` dispatched batches'
         results un-synced while a background thread device-stages
@@ -305,14 +306,36 @@ class AnalysisPredictor:
         2-4 overlaps host prep + H2D + D2H with device compute (serving
         throughput); larger mainly adds queueing delay.  With
         ``return_numpy=False`` the generator yields un-synced handles
-        and never blocks on results at all."""
-        import collections
+        and never blocks on results at all.
 
-        from . import pipeline as pl
-
+        ``verify=True`` gates entry on the static concurrency analyzer
+        (:mod:`paddle_tpu.static_analysis.concurrency`): the program
+        the executor will actually run (fused twin included) is
+        race-checked at this in-flight depth and certified free of
+        host-sync points; a finding raises ``VerifyError`` naming the
+        op — before any batch is dispatched."""
         if max_in_flight < 1:
             raise ValueError("max_in_flight must be >= 1, got %d"
                              % max_in_flight)
+        # the serving-path marks: strict-sync promotion + the in-flight
+        # depth the race checks assume for this program from now on
+        self._program._serving_hot_loop = True
+        self._program._max_in_flight = max(
+            max_in_flight,
+            int(getattr(self._program, "_max_in_flight", 1) or 1))
+        if verify:
+            from .static_analysis.concurrency import verify_async_hot_path
+
+            verify_async_hot_path(
+                self._program,
+                targets=[v.name for v in self._fetch_vars],
+                max_in_flight=max_in_flight, label="serving hot loop")
+        return self._run_batches(batches, max_in_flight, return_numpy)
+
+    def _run_batches(self, batches, max_in_flight, return_numpy):
+        import collections
+
+        from . import pipeline as pl
 
         def feeds():
             for b in batches:

@@ -38,7 +38,7 @@ SCHEMA_VERSION = 1
 #: on a crash would defeat their purpose
 URGENT_KINDS = frozenset([
     "fault-injected", "guard-skip", "checkpoint-saved",
-    "checkpoint-loaded", "worker-lost", "resume",
+    "checkpoint-loaded", "worker-lost", "resume", "race-detected",
 ])
 
 _DEFAULT_CAPACITY = 4096

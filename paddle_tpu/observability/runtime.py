@@ -21,6 +21,7 @@ __all__ = [
     "record_prefetch", "record_guard_step", "record_guard_skip",
     "record_checkpoint_save", "record_checkpoint_load", "record_retry",
     "record_fault", "record_worker_lost", "record_missed_beat",
+    "record_concurrency_check",
     "set_collective_schedule", "last_step_info", "reset_runtime",
 ]
 
@@ -275,6 +276,21 @@ def record_missed_beat(ranks):
     if not telemetry_enabled():
         return
     _m.counter("watchdog_missed_beats_total").inc(max(len(ranks), 1))
+
+
+def record_concurrency_check(races_found, gate, tripped=False):
+    """One run of the ISSUE-10 concurrency analyzer: ``gate`` names the
+    caller (``analyze``, ``run_batches``, a rewrite-bracket context).
+    A finding at an enforcing gate journals an URGENT ``race-detected``
+    event so the monitor's incident sequence shows the tripped gate."""
+    if not telemetry_enabled():
+        return
+    _named(lambda n: _m.counter(n), "concurrency_checks_total").inc()
+    if races_found:
+        _named(lambda n: _m.counter(n), "races_found_total").inc(
+            races_found)
+        _journal.emit("race-detected", races=int(races_found),
+                      gate=str(gate), tripped=bool(tripped))
 
 
 # ---------------------------------------------------------------------------

@@ -200,7 +200,10 @@ class SPMDRunner:
         fetches = _apply_step_results(
             compiled, scope, fetches, new_rw, fresh, fetch_names,
             host_active, host_grad_fetches, cur_step)
-        result = _finish_fetches(fetches, return_numpy)
+        result = _finish_fetches(
+            fetches, return_numpy, fetch_names=fetch_names,
+            state_names=(tuple(compiled.rw_names)
+                         + tuple(compiled.fresh_persist)))
         _obs.record_step(
             "spmd", cur_step,
             (_time.perf_counter() - _t_step) * 1000.0,

@@ -207,11 +207,27 @@ def apply_plan(program, result, startup_program=None, rank=0):
     program._auto_plan_applied = cand
     if cand.kind == "single":
         return cand
+    from ..static_analysis.verifier import pass_verification_enabled
     from ..transpiler.collective import GradAllReduce
 
+    # rewrite bracket (ISSUE 10): the transpile may not introduce an
+    # in-flight race the input program didn't have — same contract the
+    # fusion passes carry, baseline-aware so pre-existing races are
+    # not blamed on the planner
+    verify = pass_verification_enabled()
+    race_baseline = None
+    if verify:
+        from ..static_analysis.concurrency import race_signatures
+
+        race_baseline = race_signatures(program)
     GradAllReduce().transpile(program=program,
                               startup_program=startup_program,
                               rank=rank, nranks=cand.degree)
+    if verify:
+        from ..static_analysis.concurrency import assert_no_new_races
+
+        assert_no_new_races(program, race_baseline,
+                            "auto-plan apply (%s)" % cand.describe())
     program._shard_optimizer_state = cand.zero1
     if cand.bucket_mb:
         program._allreduce_bucket_mb = cand.bucket_mb

@@ -40,8 +40,8 @@ import numpy as np
 
 __all__ = [
     "FetchHandle", "DeviceFeedPipeline", "FeedCache", "host_values",
-    "materialize", "device_put_feed", "pipeline_depth", "sync_stats",
-    "reset_sync_stats",
+    "materialize", "detach_device", "device_put_feed",
+    "pipeline_depth", "sync_stats", "reset_sync_stats",
 ]
 
 
@@ -254,6 +254,24 @@ def materialize(fetches):
         return x.numpy() if isinstance(x, FetchHandle) else np.asarray(x)
 
     return rebuild(fetches)
+
+
+def detach_device(value):
+    """Device-side copy of a device array WITHOUT a host sync.
+
+    Breaks buffer aliasing between a lazy :class:`FetchHandle` and
+    donated scope state: when a fetched value IS a read-write
+    persistable, the next in-flight step's ``donate_argnums`` donation
+    invalidates that exact buffer, so a handle materialized after the
+    next dispatch would read freed memory (the analyzer's
+    ``donated-buffer-live-read``).  The copy is dispatched like any
+    device op — the step stays async.  Host arrays and non-array
+    values pass through untouched."""
+    if isinstance(value, np.ndarray) or not hasattr(value, "dtype"):
+        return value
+    import jax.numpy as jnp
+
+    return jnp.array(value, copy=True)
 
 
 # ---------------------------------------------------------------------------

@@ -42,6 +42,8 @@ def program_to_dict(program):
                 "is_data": v.is_data,
                 "is_parameter": isinstance(v, Parameter),
                 "trainable": getattr(v, "trainable", False),
+                "need_check_feed": getattr(v, "need_check_feed", False),
+                "feed_hint": getattr(v, "feed_hint", None),
             })
         ops = []
         for op in b.ops:
@@ -84,7 +86,12 @@ def program_from_dict(d):
                     persistable=vd.get("persistable", False),
                     stop_gradient=vd.get("stop_gradient", False),
                     is_data=vd.get("is_data", False),
+                    # pre-existing saves lack the key; data vars are always
+                    # built with the feed check on, so fall back to is_data
+                    need_check_feed=vd.get(
+                        "need_check_feed", vd.get("is_data", False)),
                 )
+                v.feed_hint = vd.get("feed_hint")
             b.vars[v.name] = v
         for od in bd["ops"]:
             op = Operator(

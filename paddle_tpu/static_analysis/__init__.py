@@ -28,6 +28,18 @@ against an HBM budget), and a cross-worker collective schedule
 extractor + deadlock-freedom proof (:mod:`.distributed`), surfaced as
 ``Program.analyze()`` (:mod:`.analyze`), four analyzer-backed lint
 checks, and ``python -m paddle_tpu.tools.analyze_program``.
+
+ISSUE 10 adds whole-program concurrency analysis (:mod:`.concurrency`):
+a happens-before model of the runtime's overlap sources — K in-flight
+steps, the prefetch thread, lazy FetchHandles, donated buffers — that
+detects in-flight races (``race-inflight-write``,
+``donated-buffer-live-read``), proves scope isolation between
+co-resident programs (``scope-overlap``), and certifies a hot loop
+free of host syncs (``sync-in-hot-loop``), surfaced through
+``Program.analyze(concurrency=True)``, the analyze CLI's
+``--concurrency`` / ``--certify-zero-sync`` flags, and enforcement
+gates in ``run_batches(verify=True)`` and the fusion/planner rewrite
+brackets.
 """
 
 from .diagnostics import Diagnostic, Severity, format_diagnostics
@@ -48,6 +60,14 @@ from .cost import (CostReport, OpCost, PlanPrice, collective_ici_bytes,
 from .distributed import (CollectiveEvent, check_schedule_consistency,
                           extract_collective_schedule,
                           prove_deadlock_free)
+from .concurrency import (CONCURRENCY_CHECK_IDS, RACE_CHECK_IDS,
+                          ConcurrencyReport, ScopeFootprint,
+                          SyncPoint, ZeroSyncCertificate,
+                          analyze_concurrency, assert_no_new_races,
+                          certify_zero_sync, find_inflight_races,
+                          prove_scope_isolation, race_signatures,
+                          resolve_max_in_flight, scope_footprint,
+                          strict_sync_enabled, verify_async_hot_path)
 from .analyze import AnalysisReport, analyze_program
 from .fusion import (FusionConfig, FusionReport, apply_fusion_passes,
                      fusion_enabled, resolve_fused_program,
@@ -87,6 +107,22 @@ __all__ = [
     "check_schedule_consistency",
     "extract_collective_schedule",
     "prove_deadlock_free",
+    "CONCURRENCY_CHECK_IDS",
+    "RACE_CHECK_IDS",
+    "ConcurrencyReport",
+    "ScopeFootprint",
+    "SyncPoint",
+    "ZeroSyncCertificate",
+    "analyze_concurrency",
+    "assert_no_new_races",
+    "certify_zero_sync",
+    "find_inflight_races",
+    "prove_scope_isolation",
+    "race_signatures",
+    "resolve_max_in_flight",
+    "scope_footprint",
+    "strict_sync_enabled",
+    "verify_async_hot_path",
     "AnalysisReport",
     "analyze_program",
     "FusionConfig",

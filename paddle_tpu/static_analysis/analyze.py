@@ -39,13 +39,17 @@ class AnalysisReport:
     """
 
     def __init__(self, program, interp, cost, schedule,
-                 worker_schedules, diagnostics):
+                 worker_schedules, diagnostics, concurrency=None):
         self.program = program
         self.interp = interp
         self.cost = cost
         self.schedule = schedule
         self.worker_schedules = worker_schedules
         self.diagnostics = list(diagnostics)
+        #: :class:`~.concurrency.ConcurrencyReport` when the analysis
+        #: ran with ``concurrency=True`` (races, scope footprint /
+        #: isolation, zero-sync certificate), else None
+        self.concurrency = concurrency
 
     @property
     def errors(self):
@@ -82,6 +86,8 @@ class AnalysisReport:
                 n: repr(v.sharding)
                 for n, v in sorted(self.interp.sharded_vars().items())},
             "diagnostics": [d.to_dict() for d in self.diagnostics],
+            "concurrency": self.concurrency.to_dict()
+            if self.concurrency is not None else None,
         }
 
     def format(self, top_ops=12):
@@ -105,6 +111,8 @@ class AnalysisReport:
                 % (len(self.worker_schedules),
                    "consistent (deadlock-free)"
                    if self.schedule_consistent else "DIVERGENT"))
+        if self.concurrency is not None:
+            lines.append(self.concurrency.format())
         if self.diagnostics:
             lines.append(format_diagnostics(
                 self.diagnostics, header="diagnostics:"))
@@ -120,7 +128,8 @@ class AnalysisReport:
 
 def analyze_program(program, targets=None, workers=None, nranks=None,
                     batch_size=None, hbm_budget=None, checks=None,
-                    exclude=()):
+                    exclude=(), concurrency=False, max_in_flight=None,
+                    coresident=None, certify_zero_sync=False):
     """Run the full static analyzer over ``program``.
 
     Parameters
@@ -139,12 +148,33 @@ def analyze_program(program, targets=None, workers=None, nranks=None,
                 ``PADDLE_TPU_ANALYZE_BATCH`` or 1)
     hbm_budget: peak-memory budget in bytes (default
                 ``program._hbm_budget`` / ``PADDLE_TPU_HBM_BUDGET``)
+    concurrency: also run the happens-before concurrency analysis
+                (:mod:`.concurrency`) — race checks at ``max_in_flight``
+                (default 2, the async serving depth), the scope
+                footprint, and the report's ``concurrency`` section
+    max_in_flight: in-flight depth for the race model (implies
+                ``concurrency=True`` when > 1)
+    coresident: programs (or ``(label, program)`` pairs) sharing this
+                program's Executor scope — runs the ``scope-overlap``
+                isolation proof
+    certify_zero_sync: emit the zero-sync certificate; any host-sync
+                point in the steady-state loop becomes a
+                ``sync-in-hot-loop`` ERROR naming the introducing API
 
     Returns an :class:`AnalysisReport`; raises nothing — gating on
     ``report.errors`` is the caller's choice.
     """
     from .verifier import verify_program
 
+    want_concurrency = bool(concurrency or coresident
+                            or certify_zero_sync
+                            or (max_in_flight or 0) > 1)
+    k = None
+    if want_concurrency:
+        from .concurrency import resolve_max_in_flight
+
+        k = resolve_max_in_flight(program, explicit=max_in_flight,
+                                  default=2)
     if nranks is None and workers:
         nranks = len(workers)
     interp = interpret_program(program, nranks=nranks,
@@ -163,7 +193,27 @@ def analyze_program(program, targets=None, workers=None, nranks=None,
 
     diags = verify_program(program, targets=targets, checks=checks,
                            exclude=exclude, workers=workers,
+                           max_in_flight=k, coresident=coresident,
+                           certify_zero_sync=certify_zero_sync,
                            _analysis=(interp, cost),
                            _worker_schedules=worker_schedules)
+
+    conc_report = None
+    if want_concurrency:
+        from .concurrency import (RACE_CHECK_IDS, ConcurrencyReport,
+                                  certify_zero_sync as _certify,
+                                  scope_footprint)
+        from ..observability import runtime as _obs
+
+        races = [d for d in diags if d.check in RACE_CHECK_IDS]
+        isolation = [d for d in diags if d.check == "scope-overlap"]
+        cert = _certify(program, targets=targets or (),
+                        max_in_flight=k) if certify_zero_sync else None
+        conc_report = ConcurrencyReport(
+            k, races, isolation, footprint=scope_footprint(program),
+            certificate=cert)
+        _obs.record_concurrency_check(len(races) + len(isolation),
+                                      gate="analyze")
     return AnalysisReport(program, interp, cost, schedule,
-                          worker_schedules, diags)
+                          worker_schedules, diags,
+                          concurrency=conc_report)

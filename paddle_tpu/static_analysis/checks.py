@@ -56,12 +56,35 @@ degenerate-sharding         WARNING   var marked sharded over parts the
 oversized-replicated-       WARNING   replicated persistable larger
 persistable                           than the replication budget on a
                                       multi-worker program — shard it
-executor-host-sync-in-loop  INFO      host-IO op (save/load/...) in
+executor-host-sync-in-loop  INFO/E    host-IO op (save/load/...) in
                                       the hot loop — a while/recurrent
                                       body, or the per-step program of
                                       a training run — forces a device
                                       sync every iteration and defeats
-                                      async dispatch overlap
+                                      async dispatch overlap (ERROR
+                                      under PADDLE_TPU_STRICT_SYNC=1
+                                      or in the serving hot loop)
+race-inflight-write         ERROR     persistable fetched AND written,
+                                      or a fed data var overwritten —
+                                      overlapping in-flight steps race
+                                      on the buffer (silent when
+                                      max_in_flight<=1; see
+                                      static_analysis.concurrency)
+donated-buffer-live-read    ERROR     pending FetchHandle aliases a
+                                      buffer an in-place op (fused
+                                      optimizer, in-place collective)
+                                      donates in the next in-flight
+                                      step
+scope-overlap               ERROR     coresident programs' scope
+                                      footprints are not disjoint —
+                                      multi-tenant isolation proof
+                                      fails (runs when coresident
+                                      programs are supplied)
+sync-in-hot-loop            ERROR     zero-sync certificate violation:
+                                      host-sync point (host-IO, host
+                                      table, eager while probe) in the
+                                      steady-state loop (runs when
+                                      certifying or strict)
 fused-op-missing-grad       ERROR     fused op registered no_grad=True
                                       on a parameter-derived path of a
                                       training program — its param
@@ -111,7 +134,9 @@ class VerifyContext:
     structural checks never pay for the analyzer."""
 
     def __init__(self, program, graph, targets=None, workers=None,
-                 analysis=None, worker_schedules=None):
+                 analysis=None, worker_schedules=None,
+                 max_in_flight=None, coresident=None,
+                 certify_zero_sync=False):
         self.program = program
         self.graph = graph
         self.targets = tuple(targets or ())
@@ -121,6 +146,13 @@ class VerifyContext:
         # every worker program
         self.worker_schedules = worker_schedules
         self._interp, self._cost = analysis or (None, None)
+        # concurrency context (ISSUE 10): the in-flight depth the race
+        # checks assume (None → program mark / env / 1), programs
+        # sharing this one's Executor scope, and whether the zero-sync
+        # certificate check should run unconditionally
+        self.max_in_flight = max_in_flight
+        self.coresident = list(coresident) if coresident else None
+        self.certify_zero_sync = bool(certify_zero_sync)
 
     @property
     def interp(self):
@@ -645,23 +677,40 @@ def check_executor_host_sync_in_loop(ctx):
     is_training = any(
         op.type.endswith("_grad") or op.attrs.get("op_role") == "optimize"
         for _, _, op in ctx.graph.order)
+    # ISSUE 10 promotion: under PADDLE_TPU_STRICT_SYNC=1 (or once the
+    # program has entered the serving hot loop) the advisory is an
+    # ERROR backed by the zero-sync certificate — a per-step host sync
+    # there is a throughput bug, not a style note
+    from .concurrency import strict_sync_enabled
+
+    strict = strict_sync_enabled(ctx.program)
+    severity = Severity.ERROR if strict else Severity.INFO
     for block_idx, op_idx, op in ctx.graph.order:
         if op.type not in HOST_IO_OP_TYPES:
             continue
         if block_idx in in_loop:
             yield ctx.diag(
-                "executor-host-sync-in-loop", Severity.INFO,
-                "host-IO op %r inside a while/recurrent body forces a "
-                "device sync every loop iteration" % op.type,
+                "executor-host-sync-in-loop", severity,
+                "host-IO op %r at block %d op %d inside a "
+                "while/recurrent body forces a device sync every loop "
+                "iteration — introduced by Executor.run's host-IO "
+                "phase (ops.io_ops.run_host_io_block)%s"
+                % (op.type, block_idx, op_idx,
+                   "; strict-sync mode fails the zero-sync certificate "
+                   "on it" if strict else ""),
                 block_idx=block_idx, op_idx=op_idx, op=op,
                 hint="hoist the IO out of the loop (checkpoint/print at "
                      "step boundaries) so the loop stays one dispatch")
         elif block_idx == 0 and is_training:
             yield ctx.diag(
-                "executor-host-sync-in-loop", Severity.INFO,
-                "host-IO op %r in a training program's global block "
-                "forces a per-step host sync around the jitted step"
-                % op.type,
+                "executor-host-sync-in-loop", severity,
+                "host-IO op %r at block %d op %d in a training "
+                "program's global block forces a per-step host sync "
+                "around the jitted step — introduced by Executor.run's "
+                "host-IO phase (ops.io_ops.run_host_io_block)%s"
+                % (op.type, block_idx, op_idx,
+                   "; strict-sync mode fails the zero-sync certificate "
+                   "on it" if strict else ""),
                 block_idx=block_idx, op_idx=op_idx, op=op,
                 hint="run IO from a separate program at "
                      "checkpoint/print_period boundaries; keep the "

@@ -1791,20 +1791,42 @@ def apply_fusion_passes(program, config=None, targets=(), verify=None):
 # lines the bracket would filter out anyway
 _BRACKET_EXCLUDE = ("fusible-pattern-not-fused", "unreferenced-op",
                     "resilience-finite-guard",
-                    "executor-host-sync-in-loop")
+                    "executor-host-sync-in-loop", "sync-in-hot-loop")
+
+
+# the in-flight depth the bracket's race checks assume: a fusion
+# rewrite must be safe for the async serving/training paths whatever
+# depth the caller later picks, so the bracket models the overlapped
+# case (K=2) even for a program that will run sequentially —
+# baseline-aware diffing means pre-existing races are never blamed on
+# the pass, only INTRODUCED ones fail it
+_BRACKET_MAX_IN_FLIGHT = 2
+
+
+def _finding_signature(d):
+    """Baseline-diff key for one ERROR finding.  Op indices are
+    deliberately excluded so removing ops ahead of a pre-existing
+    finding does not make it look new; race findings also drop the
+    message, which names the writing op's TYPE — rewriting ``sgd`` into
+    ``fused_sgd`` must not make a pre-existing race look introduced."""
+    from .concurrency import RACE_CHECK_IDS
+
+    if d.check in RACE_CHECK_IDS:
+        return (d.check, d.var_names)
+    return (d.check, d.message, d.var_names)
 
 
 def _error_signatures(program, targets):
-    """(check, message, var_names) of every ERROR finding — op indices
-    are deliberately excluded so removing ops ahead of a pre-existing
-    finding does not make it look new."""
+    """Signatures of every ERROR finding (see
+    :func:`_finding_signature`)."""
     from .diagnostics import Severity
     from .verifier import verify_program
 
     return {
-        (d.check, d.message, d.var_names)
+        _finding_signature(d)
         for d in verify_program(program, targets=list(targets),
-                                exclude=_BRACKET_EXCLUDE)
+                                exclude=_BRACKET_EXCLUDE,
+                                max_in_flight=_BRACKET_MAX_IN_FLIGHT)
         if d.severity >= Severity.ERROR
     }
 
@@ -1814,10 +1836,11 @@ def _assert_no_new_errors(program, targets, baseline, context):
     from .verifier import VerifyError, verify_program
 
     diags = verify_program(program, targets=list(targets),
-                           exclude=_BRACKET_EXCLUDE)
+                           exclude=_BRACKET_EXCLUDE,
+                           max_in_flight=_BRACKET_MAX_IN_FLIGHT)
     new = [d for d in diags
            if d.severity >= Severity.ERROR
-           and (d.check, d.message, d.var_names) not in baseline]
+           and _finding_signature(d) not in baseline]
     if new:
         raise VerifyError(
             format_diagnostics(

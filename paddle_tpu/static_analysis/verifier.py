@@ -35,7 +35,9 @@ class VerifyError(RuntimeError):
 
 
 def verify_program(program, targets=None, checks=None, exclude=(),
-                   workers=None, _analysis=None, _worker_schedules=None):
+                   workers=None, max_in_flight=None, coresident=None,
+                   certify_zero_sync=False, _analysis=None,
+                   _worker_schedules=None):
     """Run lint/verifier checks over ``program``.
 
     Parameters
@@ -48,6 +50,15 @@ def verify_program(program, targets=None, checks=None, exclude=(),
     workers:  optional list of ALL per-worker main programs — enables
               the cross-worker ``collective-schedule-divergence`` check
               (worker indices follow list order)
+    max_in_flight: in-flight step depth the concurrency race checks
+              assume (default: the program's ``_max_in_flight`` mark /
+              ``PADDLE_TPU_MAX_IN_FLIGHT``, else 1 — sequential, races
+              vacuously impossible)
+    coresident: optional programs (or ``(label, program)`` pairs) that
+              share this program's Executor scope — enables the
+              ``scope-overlap`` isolation proof
+    certify_zero_sync: run the ``sync-in-hot-loop`` certificate check
+              even without strict-sync mode
     _analysis: internal — a precomputed (InterpResult, CostReport) pair
               from ``Program.analyze`` so the analyzer-backed checks
               don't recompute it
@@ -55,8 +66,9 @@ def verify_program(program, targets=None, checks=None, exclude=(),
               ``Program.analyze`` so the divergence check doesn't
               re-interpret every worker program
 
-    Returns the list of Diagnostics sorted most-severe-first, then by
-    (block, op) coordinates.
+    Returns the list of Diagnostics, deduped and in a total order that
+    is stable across passes and runs: most-severe-first, then (block,
+    op) coordinates, then check id and message.
     """
     from ..framework import Variable
 
@@ -67,7 +79,10 @@ def verify_program(program, targets=None, checks=None, exclude=(),
     graph = DefUseGraph(program)
     ctx = VerifyContext(program, graph, targets=target_names,
                         workers=workers, analysis=_analysis,
-                        worker_schedules=_worker_schedules)
+                        worker_schedules=_worker_schedules,
+                        max_in_flight=max_in_flight,
+                        coresident=coresident,
+                        certify_zero_sync=certify_zero_sync)
     registry = all_checks()
     if checks is not None:
         unknown = [c for c in checks if c not in registry]
@@ -76,13 +91,24 @@ def verify_program(program, targets=None, checks=None, exclude=(),
                            % (unknown, sorted(registry)))
         registry = {k: registry[k] for k in checks}
     diags = []
+    seen = set()
     for check_id, fn in registry.items():
         if check_id in exclude:
             continue
-        diags.extend(fn(ctx))
+        for d in fn(ctx):
+            # identical findings can arrive twice (e.g. a check run by
+            # both lint() and an analyze() battery feeding one report);
+            # CI diffs depend on each appearing once
+            key = (d.check, int(d.severity), d.message, d.block_idx,
+                   d.op_idx, d.op_type, tuple(d.var_names), d.hint)
+            if key in seen:
+                continue
+            seen.add(key)
+            diags.append(d)
     diags.sort(key=lambda d: (-int(d.severity),
                               d.block_idx if d.block_idx is not None else -1,
-                              d.op_idx if d.op_idx is not None else -1))
+                              d.op_idx if d.op_idx is not None else -1,
+                              d.check, d.message))
     return diags
 
 

@@ -22,6 +22,14 @@ and exits:
 ``--bench-json PATH`` additionally writes the BENCH-style static cost
 metrics so perf PRs can cite the static baseline next to measured
 numbers.
+
+``--concurrency`` adds the whole-program concurrency battery
+(``race-inflight-write``, ``donated-buffer-live-read``,
+``scope-overlap``, ``sync-in-hot-loop``) at ``--max-in-flight K``;
+``--certify-zero-sync`` prints the zero-sync certificate for the hot
+loop and fails the gate if any host-sync point remains; ``--coresident
+P.json ...`` proves scope isolation against programs that will share
+the Executor.
 """
 
 import argparse
@@ -58,6 +66,25 @@ def main(argv=None):
     parser.add_argument("--bench-json", default=None, metavar="PATH",
                         help="also write BENCH-style static cost "
                              "metric lines to PATH")
+    parser.add_argument("--concurrency", action="store_true",
+                        help="run the whole-program concurrency "
+                             "analyzer: in-flight race detection, "
+                             "donated-buffer hazards, host-sync audit")
+    parser.add_argument("--max-in-flight", type=int, default=None,
+                        metavar="K",
+                        help="in-flight step depth the race analysis "
+                             "assumes (default: the program's recorded "
+                             "depth, PADDLE_TPU_MAX_IN_FLIGHT, or 2)")
+    parser.add_argument("--certify-zero-sync", action="store_true",
+                        help="prove the program's hot loop issues no "
+                             "host syncs (prints the certificate; any "
+                             "violation is an ERROR naming the "
+                             "introducing API)")
+    parser.add_argument("--coresident", nargs="+", default=None,
+                        metavar="PROG_JSON",
+                        help="serialized programs that will share this "
+                             "program's Executor/scope — proves their "
+                             "scope-variable footprints are disjoint")
     parser.add_argument("--plan", default=None, metavar="CLUSTER_SPEC",
                         help="run the auto-parallelism planner against "
                              "this ClusterSpec (JSON file, inline JSON, "
@@ -79,6 +106,9 @@ def main(argv=None):
         workers = None
         if args.workers:
             workers = [load_program(p) for p in args.workers]
+        coresident = None
+        if args.coresident:
+            coresident = [(p, load_program(p)) for p in args.coresident]
     except Exception as e:
         print("error: could not load program: %s" % e, file=sys.stderr)
         return 2
@@ -86,7 +116,10 @@ def main(argv=None):
     budget = parse_size(args.hbm_budget) if args.hbm_budget else None
     report = program.analyze(
         targets=targets, workers=workers, nranks=args.nranks,
-        batch_size=args.batch, hbm_budget=budget)
+        batch_size=args.batch, hbm_budget=budget,
+        concurrency=args.concurrency, max_in_flight=args.max_in_flight,
+        coresident=coresident,
+        certify_zero_sync=args.certify_zero_sync)
 
     plan_result = None
     if args.plan:

@@ -3,16 +3,24 @@
 saved model dir or a bare serialized Program, and the diagnostics
 emitter (text or ``--json``) with the ``--fail-on`` severity gate.
 
-Both tools speak the same machine-readable format — a JSON array of
-``Diagnostic.to_dict()`` objects — so CI consumers parse one schema.
+Both tools speak the same machine-readable format — a wrapper object
+``{"schema": N, "diagnostics": [Diagnostic.to_dict(), ...], ...}`` —
+so CI/monitor consumers can parse one schema forward-compatibly.
+``DIAG_SCHEMA_VERSION`` bumps whenever a field changes meaning;
+version 1 was the unversioned bare-array era.
 """
 
 import json
 import os
 import sys
 
-__all__ = ["add_program_args", "add_emitter_args", "load_program_arg",
+__all__ = ["DIAG_SCHEMA_VERSION", "add_program_args",
+           "add_emitter_args", "load_program_arg",
            "emit_diagnostics", "severity_gate"]
+
+#: version of the --json payload (v1: bare array, no stamp; v2: wrapper
+#: object with "schema" + "diagnostics" keys, analyzer extras merged in)
+DIAG_SCHEMA_VERSION = 2
 
 
 def add_program_args(parser):
@@ -58,21 +66,17 @@ def load_program_arg(args):
 
 
 def emit_diagnostics(diags, as_json, extra_json=None, header=None):
-    """Print diagnostics (JSON array, or formatted text with an
-    optional header line).  ``extra_json``: dict merged into a wrapper
-    object when the caller has more than diagnostics to report (the
-    analyzer's cost/schedule payload) — plain lint emits the bare array
-    for backward compatibility."""
+    """Print diagnostics (a schema-stamped JSON wrapper object, or
+    formatted text with an optional header line).  ``extra_json``: dict
+    merged into the wrapper when the caller has more than diagnostics
+    to report (the analyzer's cost/schedule/concurrency payload)."""
     from ..static_analysis import format_diagnostics
 
     if as_json:
-        payload = [d.to_dict() for d in diags]
-        if extra_json is not None:
-            out = dict(extra_json)
-            out["diagnostics"] = payload
-            print(json.dumps(out, indent=2))
-        else:
-            print(json.dumps(payload, indent=2))
+        out = dict(extra_json) if extra_json is not None else {}
+        out["schema"] = DIAG_SCHEMA_VERSION
+        out["diagnostics"] = [d.to_dict() for d in diags]
+        print(json.dumps(out, indent=2))
     elif diags:
         print(format_diagnostics(diags, header=header))
     else:

@@ -45,8 +45,10 @@ _ALERT_RE = re.compile(
 
 # the journal kinds an incident reads as a story, in the order the
 # chaos acceptance scenario expects them: fault -> skip -> restore
-_SEQUENCE_KINDS = ("fault-injected", "guard-skip", "worker-lost",
-                   "checkpoint-saved", "checkpoint-loaded", "resume")
+# (race-detected: a concurrency gate tripped before dispatch)
+_SEQUENCE_KINDS = ("fault-injected", "guard-skip", "race-detected",
+                   "worker-lost", "checkpoint-saved",
+                   "checkpoint-loaded", "resume")
 
 
 def _read_snapshots(dirname):

@@ -1,0 +1,120 @@
+"""Seeded random Program generator for the static-analysis property
+tests (ISSUE 10 satellite): every generated program is a plausible
+feed-forward graph (data -> fc/activation/scale/add chains, optionally
+trained with SGD), so "the analyzer never crashes and finds no race at
+``max_in_flight=1``" can be asserted across a whole family of programs
+instead of a handful of goldens.
+
+Also provides the two *seeded-hazard* builders the runtime-vs-static
+cross-checks anchor on:
+
+* :func:`gen_feed_overwrite_program` — an op writes back into the fed
+  data buffer (the double-buffer feed overwrite the prefetch pipeline
+  turns into a real race at depth 2)
+* :func:`gen_param_fetch_program` — a training program that fetches a
+  parameter the optimizer updates in place (the donated-buffer hazard)
+
+Deterministic by construction: same seed, same program.
+"""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+__all__ = ["gen_program", "gen_feed_overwrite_program",
+           "gen_param_fetch_program"]
+
+_WIDTHS = (4, 8, 16)
+
+
+def gen_program(seed, max_layers=8, train=None):
+    """Build a random feed-forward program.
+
+    Returns ``(main, startup, fetch_names)`` — ``fetch_names`` is what
+    a run of the program would fetch (the loss when training, the head
+    output otherwise).
+    """
+    rng = np.random.RandomState(seed)
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        width = int(rng.choice(_WIDTHS))
+        x = fluid.layers.data("x", shape=[width], dtype="float32")
+        h = x
+        for _ in range(int(rng.randint(1, max_layers + 1))):
+            kind = rng.choice(["fc", "relu", "sigmoid", "scale", "add"])
+            if kind == "fc":
+                width = int(rng.choice(_WIDTHS))
+                act = rng.choice([None, "relu", "sigmoid"])
+                h = fluid.layers.fc(h, size=width, act=act)
+            elif kind == "relu":
+                h = fluid.layers.relu(h)
+            elif kind == "sigmoid":
+                h = fluid.layers.sigmoid(h)
+            elif kind == "scale":
+                h = fluid.layers.scale(
+                    h, scale=float(rng.uniform(0.5, 1.5)))
+            else:
+                h = fluid.layers.elementwise_add(h, h)
+        if train is None:
+            train = bool(rng.randint(2))
+        if train:
+            loss = fluid.layers.mean(h)
+            fluid.optimizer.SGD(
+                learning_rate=float(rng.uniform(0.01, 0.2))
+            ).minimize(loss)
+            fetch = [loss.name]
+        else:
+            fetch = [h.name]
+    return main, startup, fetch
+
+
+def gen_feed_overwrite_program():
+    """The seeded double-buffer hazard: a program whose last op writes
+    back INTO the fed data var 'x'.  At ``max_in_flight>=2`` the
+    prefetch pipeline may stage batch N+1 into the same slot while the
+    in-flight step is still reading/writing batch N's buffer.
+
+    Returns ``(main, startup, out_name, hazard_coords)`` where
+    ``hazard_coords`` is ``(block_idx, op_idx)`` of the overwriting op
+    — what the golden test pins the diagnostic to.
+    """
+    from paddle_tpu.framework import Operator
+
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        out = fluid.layers.scale(x, scale=2.0)
+    b = main.global_block()
+    # built via Operator directly (as a rewriting pass would): append_op
+    # would be within its rights to refuse a write to a data var
+    b.ops.append(Operator(b, "scale", {"X": [out.name]}, {"Out": ["x"]},
+                          {"scale": 1.0}))
+    return main, startup, out.name, (0, len(b.ops) - 1)
+
+
+def gen_param_fetch_program():
+    """The seeded donated-buffer hazard: an SGD training program that
+    fetches a parameter the optimizer writes in place.  With
+    ``max_in_flight>=2`` the jitted step donates its read-write
+    persistables, so the pending FetchHandle for step N-1 aliases the
+    buffer step N invalidates.
+
+    Returns ``(main, startup, loss_name, param_name)``.
+    """
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        y = fluid.layers.data("y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(x, size=8, act="relu")
+        pred = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    params = sorted(
+        v.name for v in main.global_block().vars.values()
+        if getattr(v, "persistable", False)
+        and v.name.endswith(".w_0"))
+    return main, startup, loss.name, params[0]

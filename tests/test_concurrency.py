@@ -1,0 +1,761 @@
+"""ISSUE 10: whole-program concurrency analysis — golden race
+detections with exact coordinates, the scope-isolation proof, the
+zero-sync certificate, the ``run_batches(verify=True)`` gate, the
+rewrite brackets, diagnostic determinism, the strict-sync promotion,
+the two latent-hazard fixes (thread-local scope stack, fetch-handle
+detach), telemetry, and the prog_gen property/cross-check suite.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import prog_gen
+from paddle_tpu.executor import Executor, Scope, global_scope, scope_guard
+from paddle_tpu.framework import Operator
+from paddle_tpu.static_analysis import (
+    RACE_CHECK_IDS,
+    Severity,
+    VerifyError,
+    analyze_concurrency,
+    assert_no_new_races,
+    certify_zero_sync,
+    find_inflight_races,
+    prove_scope_isolation,
+    race_signatures,
+    resolve_max_in_flight,
+    scope_footprint,
+    strict_sync_enabled,
+    verify_async_hot_path,
+    verify_program,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_names():
+    fluid.unique_name.switch()
+
+
+def _errors(diags):
+    return [d for d in diags if d.severity >= Severity.ERROR]
+
+
+# ---------------------------------------------------------------------------
+# golden race detections (exact coordinates)
+# ---------------------------------------------------------------------------
+
+class TestInflightRaces:
+    def test_feed_overwrite_flagged_at_depth_2_with_exact_coords(self):
+        main, _, out, (bidx, oidx) = prog_gen.gen_feed_overwrite_program()
+        diags = find_inflight_races(main, targets=[out],
+                                    max_in_flight=2)
+        hits = [d for d in diags if d.check == "race-inflight-write"
+                and "x" in d.var_names]
+        assert hits, diags
+        d = hits[0]
+        assert (d.block_idx, d.op_idx) == (bidx, oidx)
+        assert d.op_type == "scale"
+        assert d.severity == Severity.ERROR
+        assert "double-buffer" in d.message
+
+    def test_param_fetch_is_donated_buffer_live_read(self):
+        main, _, loss, pname = prog_gen.gen_param_fetch_program()
+        diags = find_inflight_races(main, targets=[loss, pname],
+                                    max_in_flight=2)
+        hits = [d for d in diags
+                if d.check == "donated-buffer-live-read"]
+        assert hits, diags
+        d = hits[0]
+        assert d.var_names == (pname,)
+        assert d.op_type == "sgd"
+        # the coords name the exact updating op
+        op = main.block(d.block_idx).ops[d.op_idx]
+        assert op.type == "sgd"
+        assert pname in op.input_arg_names
+        assert pname in op.output_arg_names
+
+    def test_sequential_execution_has_no_races(self):
+        main, _, out, _ = prog_gen.gen_feed_overwrite_program()
+        assert find_inflight_races(main, targets=[out],
+                                   max_in_flight=1) == []
+        main, _, loss, pname = prog_gen.gen_param_fetch_program()
+        assert find_inflight_races(main, targets=[loss, pname],
+                                   max_in_flight=1) == []
+
+    def test_plain_lint_stays_unchanged(self):
+        """The race checks are registered in the default battery but
+        resolve K=1 without an in-flight context — seeded hazards do
+        NOT fail a plain lint()."""
+        main, _, loss, pname = prog_gen.gen_param_fetch_program()
+        diags = main.lint(targets=[loss, pname])
+        assert not [d for d in diags if d.check in RACE_CHECK_IDS]
+
+    def test_battery_carries_races_with_in_flight_context(self):
+        main, _, loss, pname = prog_gen.gen_param_fetch_program()
+        diags = verify_program(main, targets=[loss, pname],
+                               max_in_flight=2)
+        assert [d for d in diags
+                if d.check == "donated-buffer-live-read"]
+
+    def test_race_messages_name_depth_and_api_not_coords(self):
+        """Coordinates live in structured fields; messages stay
+        coordinate-free so rewrite-bracket signatures survive op
+        renumbering."""
+        main, _, loss, pname = prog_gen.gen_param_fetch_program()
+        for d in find_inflight_races(main, targets=[loss, pname],
+                                     max_in_flight=3):
+            assert "max_in_flight=3" in d.message
+            assert "block" not in d.message
+
+    def test_training_programs_fetching_loss_are_clean(self):
+        main, _, loss, _ = prog_gen.gen_param_fetch_program()
+        assert find_inflight_races(main, targets=[loss],
+                                   max_in_flight=4) == []
+
+
+class TestMaxInFlightResolution:
+    def test_explicit_wins(self):
+        p = fluid.Program()
+        p._max_in_flight = 8
+        assert resolve_max_in_flight(p, explicit=3) == 3
+
+    def test_program_mark_wins_over_env(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_MAX_IN_FLIGHT", "5")
+        p = fluid.Program()
+        p._max_in_flight = 4
+        assert resolve_max_in_flight(p) == 4
+
+    def test_env_then_default(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_MAX_IN_FLIGHT", "6")
+        assert resolve_max_in_flight(fluid.Program()) == 6
+        monkeypatch.delenv("PADDLE_TPU_MAX_IN_FLIGHT")
+        assert resolve_max_in_flight(fluid.Program(), default=2) == 2
+
+    def test_floor_is_one(self):
+        assert resolve_max_in_flight(None, explicit=0) == 1
+
+
+# ---------------------------------------------------------------------------
+# scope isolation
+# ---------------------------------------------------------------------------
+
+def _named_mlp(prefix, train=False):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(prefix + "_x", shape=[4], dtype="float32")
+        attr = fluid.ParamAttr(name=prefix + ".w")
+        h = fluid.layers.fc(x, size=4, param_attr=attr,
+                            bias_attr=fluid.ParamAttr(name=prefix + ".b"))
+        if train:
+            loss = fluid.layers.mean(h)
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main
+
+
+class TestScopeIsolation:
+    def test_disjoint_programs_prove_isolated(self):
+        a, b = _named_mlp("a"), _named_mlp("b")
+        prints, diags = prove_scope_isolation([a, b])
+        assert diags == []
+        assert prints[0].isolated_from(prints[1])
+
+    def test_written_overlap_is_error_naming_pair_and_vars(self):
+        a = _named_mlp("m", train=True)   # writes m.w / m.b
+        b = _named_mlp("m")               # reads m.w / m.b
+        _, diags = prove_scope_isolation([a, b], labels=["train",
+                                                         "serve"])
+        errs = _errors(diags)
+        assert len(errs) == 1
+        d = errs[0]
+        assert d.check == "scope-overlap"
+        assert "train" in d.message and "serve" in d.message
+        assert "m.w" in d.var_names and "m.b" in d.var_names
+
+    def test_shared_read_only_state_warns_not_errors(self):
+        a, b = _named_mlp("m"), _named_mlp("m")
+        _, diags = prove_scope_isolation([a, b])
+        assert not _errors(diags)
+        assert [d for d in diags if d.severity == Severity.WARNING
+                and d.check == "scope-overlap"]
+
+    def test_footprint_excludes_feeds_includes_optimizer_writes(self):
+        main = _named_mlp("m", train=True)
+        fp = scope_footprint(main)
+        assert "m.w" in fp.writes and "m.w" in fp.reads
+        assert "m_x" not in fp.reads and "m_x" not in fp.writes
+
+    def test_battery_surface_via_coresident(self):
+        a = _named_mlp("m", train=True)
+        b = _named_mlp("m")
+        diags = verify_program(a, coresident=[("serve-copy", b)])
+        hits = [d for d in diags if d.check == "scope-overlap"]
+        assert hits and "serve-copy" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# zero-sync certificate
+# ---------------------------------------------------------------------------
+
+class TestZeroSyncCertificate:
+    def test_pure_inference_loop_passes(self):
+        main, _, fetch = prog_gen.gen_program(3, train=False)
+        cert = certify_zero_sync(main, targets=fetch,
+                                 label="async inference loop")
+        assert cert.ok
+        assert "PASS" in cert.format()
+
+    def test_injected_host_io_fails_naming_the_op(self):
+        main, _, fetch = prog_gen.gen_program(3, train=False)
+        b = main.global_block()
+        b.ops.append(Operator(b, "save", {"X": [fetch[0]]}, {},
+                              {"file_path": "/tmp/x"}))
+        cert = certify_zero_sync(main, targets=fetch)
+        assert not cert.ok
+        v = cert.violations[0]
+        assert v.op_type == "save"
+        assert (v.block_idx, v.op_idx) == (0, len(b.ops) - 1)
+        assert "run_host_io_block" in v.api
+        assert "FAIL" in cert.format()
+
+    def test_host_table_is_a_program_level_violation(self):
+        main, _, fetch = prog_gen.gen_program(4, train=False)
+        main._host_tables = ["big_embedding"]
+        cert = certify_zero_sync(main, targets=fetch)
+        assert not cert.ok
+        assert cert.violations[0].where() == "program-level"
+        assert "np.asarray" in cert.violations[0].api
+
+    def test_nan_guard_is_allowed_not_violation(self):
+        main, _, fetch = prog_gen.gen_program(5, train=True)
+        main._nan_guard = True
+        cert = certify_zero_sync(main, targets=fetch)
+        assert cert.ok
+        assert cert.allowed and cert.allowed[0].allowed
+        assert "guard" in cert.allowed[0].api
+
+    def test_cli_certify_pass_and_fail_name_the_op(self, tmp_path):
+        from paddle_tpu.proto import save_program
+
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[4], dtype="float32")
+            out = fluid.layers.fc(x, size=2)
+        clean = str(tmp_path / "clean.json")
+        save_program(main, clean)
+        b = main.global_block()
+        b.ops.append(Operator(b, "save", {"X": [out.name]}, {},
+                              {"file_path": "/tmp/x"}))
+        synced = str(tmp_path / "synced.json")
+        save_program(main, synced)
+
+        def cli(path):
+            return subprocess.run(
+                [sys.executable, "-m",
+                 "paddle_tpu.tools.analyze_program",
+                 "--program-json", path, "--certify-zero-sync"],
+                capture_output=True, text=True,
+                env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=REPO)
+
+        res = cli(clean)
+        assert res.returncode == 0
+        assert "zero-sync certificate" in res.stdout
+        assert "PASS" in res.stdout
+        res = cli(synced)
+        assert res.returncode == 1
+        assert "FAIL" in res.stdout
+        assert "save" in res.stdout
+        assert "run_host_io_block" in res.stdout
+
+    def test_certificate_in_analyze_report_and_json(self):
+        main, _, fetch = prog_gen.gen_program(6, train=False)
+        report = main.analyze(targets=fetch, certify_zero_sync=True)
+        assert report.concurrency is not None
+        assert report.concurrency.certificate.ok
+        blob = report.to_dict()["concurrency"]["certificate"]
+        assert blob["ok"] is True
+
+
+# ---------------------------------------------------------------------------
+# strict-sync promotion (satellite 1)
+# ---------------------------------------------------------------------------
+
+def _synced_training_program():
+    """A program the PR-4 advisory fires on: training with a host-IO
+    op in the block (the executor must drain the pipeline per step)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        h = fluid.layers.fc(x, size=4)
+        loss = fluid.layers.mean(h)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    b = main.global_block()
+    b.ops.append(Operator(b, "save", {"X": [loss.name]}, {},
+                          {"file_path": "/tmp/x"}))
+    return main, loss.name
+
+
+class TestStrictSyncPromotion:
+    def test_default_is_info(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_TPU_STRICT_SYNC", raising=False)
+        main, loss = _synced_training_program()
+        diags = [d for d in main.lint(targets=[loss])
+                 if d.check == "executor-host-sync-in-loop"]
+        assert diags and diags[0].severity == Severity.INFO
+
+    def test_env_promotes_to_error_with_coords_and_api(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_STRICT_SYNC", "1")
+        main, loss = _synced_training_program()
+        diags = [d for d in main.lint(targets=[loss])
+                 if d.check == "executor-host-sync-in-loop"]
+        assert diags and diags[0].severity == Severity.ERROR
+        msg = diags[0].message
+        assert "at block" in msg and "op" in msg
+        assert "Executor.run's host-IO phase" in msg
+        assert "zero-sync certificate" in msg
+
+    def test_serving_hot_loop_mark_promotes(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_TPU_STRICT_SYNC", raising=False)
+        main, loss = _synced_training_program()
+        main._serving_hot_loop = True
+        assert strict_sync_enabled(main)
+        diags = [d for d in main.lint(targets=[loss])
+                 if d.check == "executor-host-sync-in-loop"]
+        assert diags and diags[0].severity == Severity.ERROR
+
+    def test_env_zero_does_not_promote(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_STRICT_SYNC", "0")
+        assert not strict_sync_enabled(fluid.Program())
+
+
+# ---------------------------------------------------------------------------
+# run_batches(verify=True) gate
+# ---------------------------------------------------------------------------
+
+def _save_inference_model(tmp_path, hazard=False):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4], dtype="float32")
+        out = fluid.layers.fc(x, size=2)
+    exe = Executor()
+    with scope_guard(Scope()):
+        exe.run(startup)
+        d = str(tmp_path / ("hazard" if hazard else "clean"))
+        fluid.io.save_inference_model(d, ["x"], [out], exe,
+                                      main_program=main)
+    return d
+
+
+class TestRunBatchesGate:
+    def test_clean_program_verifies_and_streams(self, tmp_path):
+        d = _save_inference_model(tmp_path)
+        pred = fluid.inference.create_paddle_predictor(
+            fluid.inference.AnalysisConfig(d))
+        batches = [[np.ones((2, 4), dtype="float32") * i]
+                   for i in range(3)]
+        outs = list(pred.run_batches(batches, max_in_flight=2,
+                                     verify=True))
+        assert len(outs) == 3
+        # the gate stamped the serving marks used by strict-sync and
+        # depth resolution
+        assert pred.program._serving_hot_loop
+        assert pred.program._max_in_flight == 2
+
+    def test_injected_sync_fails_at_call_time_naming_the_op(
+            self, tmp_path):
+        d = _save_inference_model(tmp_path)
+        pred = fluid.inference.create_paddle_predictor(
+            fluid.inference.AnalysisConfig(d))
+        b = pred.program.global_block()
+        out_name = pred.get_output_names()[0]
+        b.ops.append(Operator(b, "save", {"X": [out_name]}, {},
+                              {"file_path": "/tmp/x"}))
+        with pytest.raises(VerifyError) as ei:
+            # eager wrapper: raises at CALL, not at first next()
+            pred.run_batches([[np.ones((2, 4), dtype="float32")]],
+                             max_in_flight=2, verify=True)
+        assert "sync-in-hot-loop" in str(ei.value)
+        assert "save" in str(ei.value)
+
+    def test_bad_depth_raises_at_call_time(self, tmp_path):
+        d = _save_inference_model(tmp_path)
+        pred = fluid.inference.create_paddle_predictor(
+            fluid.inference.AnalysisConfig(d))
+        with pytest.raises(ValueError):
+            pred.run_batches([], max_in_flight=0)
+
+    def test_verify_async_hot_path_flags_seeded_race(self):
+        main, _, loss, pname = prog_gen.gen_param_fetch_program()
+        with pytest.raises(VerifyError) as ei:
+            verify_async_hot_path(main, targets=[loss, pname],
+                                  max_in_flight=2)
+        assert "donated-buffer-live-read" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# rewrite brackets (fusion / planner may not introduce races)
+# ---------------------------------------------------------------------------
+
+class TestRewriteBrackets:
+    def test_signatures_are_coordinate_free(self):
+        main, _, loss, pname = prog_gen.gen_param_fetch_program()
+        sigs = race_signatures(main, targets=[loss, pname])
+        assert ("donated-buffer-live-read", (pname,)) in sigs
+
+    def test_preexisting_race_is_not_blamed_on_rewrite(self):
+        main, _, loss, pname = prog_gen.gen_param_fetch_program()
+        baseline = race_signatures(main, targets=[loss, pname])
+        # unchanged program: nothing new
+        assert_no_new_races(main, baseline, "noop rewrite",
+                            targets=[loss, pname])
+
+    def test_introduced_race_raises_naming_context(self):
+        main, _, loss, pname = prog_gen.gen_param_fetch_program()
+        baseline = race_signatures(main, targets=[loss])  # no hazard
+        with pytest.raises(VerifyError) as ei:
+            assert_no_new_races(main, baseline, "bad-pass",
+                                targets=[loss, pname])
+        assert "bad-pass" in str(ei.value)
+
+    def test_fusion_resolve_keeps_seeded_program_race_stable(self):
+        """The fusion pipeline's bracket diffs at K=2: resolving a
+        program that already carries the hazard must not raise (it
+        didn't introduce it) — and the fused twin still detects it."""
+        from paddle_tpu.static_analysis import fusion
+
+        main, _, loss, pname = prog_gen.gen_param_fetch_program()
+        fused, _report = fusion.resolve_fused_program(
+            main, targets=[loss, pname])
+        diags = find_inflight_races(fused, targets=[loss, pname],
+                                    max_in_flight=2)
+        assert [d for d in diags
+                if d.check == "donated-buffer-live-read"
+                and pname in d.var_names]
+
+
+# ---------------------------------------------------------------------------
+# latent hazards fixed: thread-local scope stack, fetch-handle detach
+# ---------------------------------------------------------------------------
+
+class TestThreadLocalScopeStack:
+    def test_scope_guard_is_thread_private(self):
+        """Two predictor threads interleaving scope_guard push/pops must
+        each resolve their OWN scope — the process-wide stack let one
+        tenant's executor read another's variables."""
+        a_in = threading.Event()
+        release_a = threading.Event()
+        results = {}
+
+        def tenant_a():
+            s = Scope()
+            with scope_guard(s):
+                a_in.set()
+                release_a.wait(5)
+                results["a"] = global_scope() is s
+
+        def tenant_b():
+            a_in.wait(5)
+            s = Scope()
+            with scope_guard(s):
+                results["b"] = global_scope() is s
+            release_a.set()
+
+        ta = threading.Thread(target=tenant_a)
+        tb = threading.Thread(target=tenant_b)
+        ta.start()
+        tb.start()
+        ta.join(10)
+        tb.join(10)
+        assert results == {"a": True, "b": True}
+
+    def test_fresh_thread_sees_process_global_scope(self):
+        seen = {}
+
+        def probe():
+            seen["scope"] = global_scope()
+
+        t = threading.Thread(target=probe)
+        t.start()
+        t.join(10)
+        assert seen["scope"] is global_scope()
+
+
+class TestFetchHandleDetach:
+    def test_fetched_state_handle_does_not_alias_scope_buffer(self):
+        """The donated-buffer fix at runtime: a lazy handle for a
+        read-write persistable holds a detached device copy, not the
+        scope array the next step's donation invalidates."""
+        main, startup, loss, pname = prog_gen.gen_param_fetch_program()
+        exe = Executor()
+        scope = Scope()
+        feed = {"x": np.ones((2, 4), dtype="float32"),
+                "y": np.zeros((2, 1), dtype="float32")}
+        with scope_guard(scope):
+            exe.run(startup)
+            outs = exe.run(main, feed=feed, fetch_list=[loss, pname],
+                           return_numpy=False)
+            handle = outs[1]
+            assert handle.device_value is not scope.vars[pname]
+            np.testing.assert_allclose(np.asarray(handle),
+                                       np.asarray(scope.vars[pname]))
+
+    def test_temporary_fetches_stay_zero_copy(self):
+        """Only scope state needs the detach copy; temporaries (the
+        loss) are not donated scope buffers."""
+        from paddle_tpu.pipeline import FetchHandle
+
+        main, startup, loss, _ = prog_gen.gen_param_fetch_program()
+        exe = Executor()
+        with scope_guard(Scope()):
+            exe.run(startup)
+            outs = exe.run(main,
+                           feed={"x": np.ones((2, 4), dtype="float32"),
+                                 "y": np.zeros((2, 1), dtype="float32")},
+                           fetch_list=[loss], return_numpy=False)
+            assert isinstance(outs[0], FetchHandle)
+            assert np.isfinite(float(outs[0]))
+
+    def test_detach_device_passthrough(self):
+        from paddle_tpu.pipeline import detach_device
+
+        host = np.arange(4.0)
+        assert detach_device(host) is host
+        assert detach_device("not-an-array") == "not-an-array"
+        import jax.numpy as jnp
+
+        dev = jnp.arange(4.0)
+        out = detach_device(dev)
+        assert out is not dev
+        np.testing.assert_allclose(np.asarray(out), np.asarray(dev))
+
+
+# ---------------------------------------------------------------------------
+# diagnostic determinism + schema (satellite 2)
+# ---------------------------------------------------------------------------
+
+class TestDeterminism:
+    def test_repeated_runs_are_identical(self):
+        main, _, loss, pname = prog_gen.gen_param_fetch_program()
+        runs = [verify_program(main, targets=[loss, pname],
+                               max_in_flight=2) for _ in range(3)]
+        as_tuples = [[(d.check, d.severity, d.message, d.block_idx,
+                       d.op_idx) for d in run] for run in runs]
+        assert as_tuples[0] == as_tuples[1] == as_tuples[2]
+
+    def test_sorted_by_severity_then_coords(self):
+        main, loss = _synced_training_program()
+        main._serving_hot_loop = True  # promote INFO → ERROR + cert
+        diags = verify_program(main, targets=[loss], max_in_flight=2)
+        sevs = [d.severity for d in diags]
+        assert sevs == sorted(sevs, reverse=True)
+        errs = _errors(diags)
+        coords = [(d.block_idx or -1, d.op_idx or -1) for d in errs]
+        assert coords == sorted(coords)
+
+    def test_identical_findings_dedupe(self):
+        """Two check ids can surface the same (check, message, coords)
+        tuple through different walks; the battery reports it once."""
+        main, _, loss, pname = prog_gen.gen_param_fetch_program()
+        diags = verify_program(main, targets=[loss, pname],
+                               max_in_flight=2)
+        keys = [(d.check, d.message, d.block_idx, d.op_idx)
+                for d in diags]
+        assert len(keys) == len(set(keys))
+
+    def test_lint_cli_json_is_schema_stamped(self, tmp_path):
+        from paddle_tpu.tools.diag_cli import DIAG_SCHEMA_VERSION
+
+        d = _save_inference_model(tmp_path)
+        res = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.tools.lint_program",
+             d, "--json"],
+            capture_output=True, text=True,
+            env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=REPO)
+        payload = json.loads(res.stdout)
+        assert payload["schema"] == DIAG_SCHEMA_VERSION
+        assert isinstance(payload["diagnostics"], list)
+
+
+# ---------------------------------------------------------------------------
+# telemetry (satellite 6)
+# ---------------------------------------------------------------------------
+
+class TestTelemetry:
+    @pytest.fixture(autouse=True)
+    def _fresh(self, monkeypatch):
+        import paddle_tpu.observability as obs
+
+        monkeypatch.delenv("PADDLE_TPU_TELEMETRY", raising=False)
+        monkeypatch.delenv("PADDLE_TPU_TELEMETRY_DIR", raising=False)
+        obs.reset_telemetry()
+        yield
+        obs.reset_telemetry()
+
+    def test_counters_and_urgent_journal_event(self, monkeypatch,
+                                               tmp_path):
+        import paddle_tpu.observability as obs
+        from paddle_tpu.observability import journal, metrics
+
+        monkeypatch.setenv("PADDLE_TPU_TELEMETRY_DIR", str(tmp_path))
+        obs.reset_telemetry()
+        main, _, loss, pname = prog_gen.gen_param_fetch_program()
+        analyze_concurrency(main, targets=[loss, pname])
+        reg = metrics.registry()
+        assert reg.get("concurrency_checks_total").value >= 1
+        assert reg.get("races_found_total").value >= 1
+        events = journal.get_journal().events("race-detected")
+        assert events and events[0]["gate"] == "analyze"
+        # urgent kind: flushed to disk immediately, no flush() needed
+        on_disk = journal.read_journal(str(tmp_path))
+        assert any(e["kind"] == "race-detected" for e in on_disk)
+
+    def test_clean_program_counts_check_but_no_race(self):
+        import paddle_tpu.observability as obs
+        from paddle_tpu.observability import metrics
+
+        obs.reset_telemetry()
+        main, _, fetch = prog_gen.gen_program(7, train=False)
+        analyze_concurrency(main, targets=fetch)
+        reg = metrics.registry()
+        assert reg.get("concurrency_checks_total").value == 1
+        assert reg.get("races_found_total") is None
+
+    def test_monitor_incident_sequence_includes_race(self, monkeypatch,
+                                                     tmp_path):
+        import paddle_tpu.observability as obs
+        from paddle_tpu.tools import monitor
+
+        monkeypatch.setenv("PADDLE_TPU_TELEMETRY_DIR", str(tmp_path))
+        obs.reset_telemetry()
+        main, _, loss, pname = prog_gen.gen_param_fetch_program()
+        with pytest.raises(VerifyError):
+            verify_async_hot_path(main, targets=[loss, pname],
+                                  max_in_flight=2)
+        status = monitor.collect_status(str(tmp_path))
+        kinds = [s["kind"] for s in status["sequence"]]
+        assert "race-detected" in kinds
+
+    def test_disabled_telemetry_is_inert(self, monkeypatch):
+        import paddle_tpu.observability as obs
+        from paddle_tpu.observability import metrics
+        from paddle_tpu.observability.runtime import (
+            record_concurrency_check,
+        )
+
+        monkeypatch.setenv("PADDLE_TPU_TELEMETRY", "0")
+        obs.reset_telemetry()
+        record_concurrency_check(3, gate="analyze", tripped=True)
+        assert metrics.registry().get("concurrency_checks_total") is None
+
+
+# ---------------------------------------------------------------------------
+# prog_gen property suite + runtime-vs-static cross-checks (satellite 3)
+# ---------------------------------------------------------------------------
+
+class TestGeneratedPrograms:
+    @pytest.mark.parametrize("seed", range(16))
+    def test_analyses_never_crash_and_k1_is_race_free(self, seed):
+        main, startup, fetch = prog_gen.gen_program(seed)
+        main.lint(targets=fetch)
+        report = main.analyze(targets=fetch)
+        assert report.cost.total_flops >= 0
+        report = main.analyze(targets=fetch, concurrency=True,
+                              max_in_flight=1)
+        assert report.concurrency.race_free
+        startup.lint()
+
+    def test_generator_is_deterministic(self):
+        a_main, _, a_fetch = prog_gen.gen_program(11)
+        b_main, _, b_fetch = prog_gen.gen_program(11)
+        assert a_fetch == b_fetch
+        assert [op.type for b in a_main.blocks for op in b.ops] == \
+            [op.type for b in b_main.blocks for op in b.ops]
+
+    def test_generated_trainers_clean_at_depth_2_when_fetching_loss(self):
+        for seed in range(8):
+            main, _, fetch = prog_gen.gen_program(seed, train=True)
+            diags = find_inflight_races(main, targets=fetch,
+                                        max_in_flight=2)
+            assert diags == [], (seed, diags)
+
+
+class TestRuntimeVsStatic:
+    def test_static_flags_exactly_the_op_the_runtime_would_race_on(self):
+        """The seeded double-buffer feed overwrite: the static analyzer
+        pins the hazard to the exact op the prefetch pipeline would
+        race with at depth 2."""
+        main, _, out, (bidx, oidx) = prog_gen.gen_feed_overwrite_program()
+        report = main.analyze(targets=[out], concurrency=True,
+                              max_in_flight=2)
+        races = report.concurrency.races
+        assert [d for d in races
+                if (d.block_idx, d.op_idx) == (bidx, oidx)
+                and d.check == "race-inflight-write"]
+        # and the report fails overall (races are ERRORs)
+        assert not report.ok
+
+    def test_feed_cache_reproduces_the_stale_read_dynamically(
+            self, tmp_path, monkeypatch):
+        """Dynamic twin of the static warning: sharing one live host
+        buffer with the depth-2 feed pipeline and mutating it in place
+        (same object, NON-sampled index — the fingerprint samples
+        stride-2 from 0) makes batch 2 reuse batch 1's device value:
+        the mutation is invisible.  Both fix classes the analyzer
+        suggests restore it: fresh arrays per batch, or
+        ``PADDLE_TPU_FEED_CACHE=0``."""
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[128], dtype="float32")
+            out = fluid.layers.reduce_sum(x)
+        exe = Executor()
+        with scope_guard(Scope()):
+            exe.run(startup)
+            d = str(tmp_path / "m")
+            fluid.io.save_inference_model(d, ["x"], [out], exe,
+                                          main_program=main)
+
+        def run_mutating(cache_on, copy_per_batch=False):
+            monkeypatch.setenv("PADDLE_TPU_FEED_CACHE",
+                               "1" if cache_on else "0")
+            pred = fluid.inference.create_paddle_predictor(
+                fluid.inference.AnalysisConfig(d))
+            buf = np.zeros((1, 128), dtype="float32")
+
+            def batches():
+                yield [buf.copy() if copy_per_batch else buf]
+                buf[0, 1] = 100.0
+                yield [buf.copy() if copy_per_batch else buf]
+
+            return [float(np.asarray(r[0]).sum())
+                    for r in pred.run_batches(batches(),
+                                              max_in_flight=2)]
+
+        # hazard: same object, mutated content — batch 2 is a stale
+        # replay of batch 1's device value (the sum never moves)
+        stale = run_mutating(cache_on=True)
+        assert stale[1] == stale[0]
+        # fix 1: don't share live buffers (fresh array per batch)
+        fresh = run_mutating(cache_on=True, copy_per_batch=True)
+        assert fresh == [0.0, 100.0]
+        # fix 2: kill the cache
+        nocache = run_mutating(cache_on=False)
+        assert nocache[1] == 100.0
+
+    def test_static_side_of_the_cache_hazard_is_the_feed_rule(self):
+        """The same program is statically clean (nothing writes x) —
+        the cache hazard is a host-side buffer-sharing bug, which is
+        why the analyzer's feed rule only fires when the PROGRAM writes
+        a fed slot.  Guards against over-reporting."""
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[128], dtype="float32")
+            out = fluid.layers.reduce_sum(x)
+        assert find_inflight_races(main, targets=[out.name],
+                                   max_in_flight=2) == []

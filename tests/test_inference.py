@@ -3,6 +3,7 @@ unittests/test_inference_model_io.py, test_inference_transpiler.py —
 save → load → predict round-trips and pass-preserves-output checks)."""
 
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu.executor import Scope, scope_guard
@@ -382,3 +383,28 @@ def test_predictor_params_promoted_to_device_once(tmp_path):
     # and the promotion must not change results across runs
     o2 = pred.run(feed)[0]
     np.testing.assert_allclose(np.asarray(o1), np.asarray(o2))
+
+
+def test_feed_check_survives_inference_model_roundtrip(tmp_path):
+    """need_check_feed / feed_hint must round-trip through
+    save_inference_model: a loaded serving program feeding a wrong
+    inner dim should fail fast with the targeted data-layer ValueError,
+    not a jit shape error deep inside the step."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[8], dtype="float32")
+        x.feed_hint = "x is the 8-wide feature row"
+        out = fluid.layers.fc(x, size=2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    d = str(tmp_path / "m")
+    with scope_guard(Scope()):
+        exe.run(startup)
+        fluid.io.save_inference_model(d, ["x"], [out], exe,
+                                      main_program=main)
+        iprog, feeds, fetches = fluid.io.load_inference_model(d, exe)
+        v = iprog.global_block().vars[feeds[0]]
+        assert v.need_check_feed
+        assert v.feed_hint == "x is the 8-wide feature row"
+        with pytest.raises(ValueError, match="declares"):
+            exe.run(iprog, feed={feeds[0]: np.zeros((4, 5), "float32")},
+                    fetch_list=fetches)

@@ -688,12 +688,16 @@ class TestLintCli:
                    and "rejects" in d.message for d in errs)
 
     def test_json_output_is_structured(self, tmp_path):
+        from paddle_tpu.tools.diag_cli import DIAG_SCHEMA_VERSION
+
         d = _save_model(tmp_path, break_it=True)
         res = _run_cli(d, "--json")
         assert res.returncode == 1
         payload = json.loads(res.stdout)
-        assert any(f["check"] == "use-before-def" for f in payload)
-        f = payload[0]
+        assert payload["schema"] == DIAG_SCHEMA_VERSION
+        diags = payload["diagnostics"]
+        assert any(f["check"] == "use-before-def" for f in diags)
+        f = diags[0]
         assert {"check", "severity", "message", "block_idx", "op_idx",
                 "op_type", "var_names", "hint"} <= set(f)
 
