@@ -863,6 +863,182 @@ def child_kernels():
     }), flush=True)
 
 
+def child_serving():
+    """Continuous-batching serving benchmark (ISSUE 11): two
+    co-resident tenants — the mnist-shaped MLP and the bert encoder —
+    behind one ``paddle_tpu.serving.PredictorServer``.  The placement
+    passes the scope-overlap proof and every tenant's hot loop passes
+    the zero-sync certificate under ``PADDLE_TPU_STRICT_SYNC=1`` (both
+    enforced at server construction).  Runs a fixed-QPS load (latency
+    percentiles, shed-rate gate) plus a saturation A/B of continuous
+    batching vs naive one-request-per-step dispatch at the same
+    request mix.  Hard gates (exit 1): certificate pass, shed == 0 and
+    rejected == 0 at the smoke QPS, and jit-cache entries bounded by
+    the bucket count (no unbounded compile growth)."""
+    import copy
+
+    import jax
+
+    import paddle_tpu as fluid
+    from paddle_tpu import serving
+    from paddle_tpu.models import bert
+
+    os.environ["PADDLE_TPU_STRICT_SYNC"] = "1"
+    dev = jax.devices()[0]
+    on_tpu = _is_tpu_platform(dev.platform)
+    seq_len = 64 if on_tpu else 32
+
+    # tenant 1: the mnist MLP (examples/mnist_train.py shape), eval form
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[784], dtype="float32")
+        h = fluid.layers.fc(img, size=200, act="relu")
+        h = fluid.layers.fc(h, size=200, act="relu")
+        prob = fluid.layers.softmax(fluid.layers.fc(h, size=10))
+    mnist_pred = _export_predictor(main, startup, ["img"], [prob],
+                                   on_tpu, "bench_serve_mnist_")
+
+    # tenant 2: the bert encoder (feature-extraction serving)
+    cfg = bert.BERT_BASE if on_tpu else bert.BERT_TINY
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        input_ids = fluid.layers.data("input_ids", shape=[seq_len],
+                                      dtype="int64")
+        token_type = fluid.layers.data("token_type_ids",
+                                       shape=[seq_len], dtype="int64")
+        mask = fluid.layers.data("attn_mask_bias",
+                                 shape=[1, 1, seq_len], dtype="float32")
+        icfg = copy.copy(cfg)
+        icfg.dropout = 0.0
+        icfg.attn_dropout = 0.0
+        hidden = bert.encoder(input_ids, token_type, mask, icfg,
+                              seq_len)
+    bert_feeds = ("input_ids", "token_type_ids", "attn_mask_bias",
+                  "pos_ids")
+    bert_pred = _export_predictor(main, startup, list(bert_feeds),
+                                  [hidden], on_tpu,
+                                  "bench_serve_bert_")
+
+    rng = np.random.RandomState(0)
+
+    def mnist_sample():
+        return {"img": rng.randn(1, 784).astype("float32")}
+
+    def bert_sample():
+        return {k: v for k, v in bert.make_fake_batch(
+            1, seq_len, cfg, rng, max_pred=0).items()
+            if k in bert_feeds}
+
+    samplers = {"mnist": mnist_sample, "bert": bert_sample}
+    buckets = (1, 2, 4, 8)
+    preds = {"mnist": mnist_pred, "bert": bert_pred}
+
+    def make_server(bucket_set, max_in_flight, queue_cap=1024):
+        # construction runs the scope-overlap proof + per-tenant
+        # zero-sync verification; a VerifyError here IS the gate firing
+        return serving.PredictorServer(
+            preds, max_in_flight=max_in_flight, buckets=bucket_set,
+            queue_cap=queue_cap, auto_start=False)
+
+    server = make_server(buckets, max_in_flight=3)
+    assert all(c.ok for c in server.certificates.values()), \
+        "zero-sync certificate failed: %s" % server.certificates
+    print("# serving gates: scope-overlap proof + zero-sync "
+          "certificates PASS (%s)" % list(server.certificates),
+          flush=True)
+    server.warmup({t: samplers[t]() for t in preds})
+    print("# serving warmup done (%d bucket signatures per tenant)"
+          % len(buckets), flush=True)
+    if os.environ.get("PADDLE_BENCH_COMPILE_ONLY"):
+        server.close()
+        print(json.dumps({"compiled": True}), flush=True)
+        return
+
+    # arm 1: fixed-QPS smoke — latency percentiles under a generous SLA
+    qps = 120.0 if on_tpu else 60.0
+    n_req = 360 if on_tpu else 120
+    server.start()
+    fixed = serving.run_load(server, samplers, qps=qps,
+                             requests=n_req, sla_ms=5000.0)
+    server.close()
+    print("# fixed-qps arm: %s" % json.dumps(
+        {k: fixed[k] for k in ("completed", "shed", "rejected",
+                               "p50_ms", "p99_ms", "qps")}),
+        flush=True)
+
+    # arm 2 A/B at saturation: naive one-request-per-step dispatch
+    # (bucket {1}, in-flight window 1) vs continuous batching, same mix
+    naive = make_server((1,), max_in_flight=1)
+    naive.warmup({t: samplers[t]() for t in preds})
+    rep_naive = serving.run_load(naive.start(), samplers,
+                                 requests=n_req, burst=True)
+    naive.close()
+    cont = make_server(buckets, max_in_flight=3)
+    cont.warmup({t: samplers[t]() for t in preds})
+    rep_cont = serving.run_load(cont.start(), samplers,
+                                requests=n_req, burst=True)
+    cont.close()
+    speedup = rep_cont["qps"] / max(rep_naive["qps"], 1e-9)
+    print("# saturation A/B: continuous %.1f qps (p99 %.1fms) vs "
+          "naive %.1f qps (p99 %.1fms)"
+          % (rep_cont["qps"], rep_cont["p99_ms"] or 0,
+             rep_naive["qps"], rep_naive["p99_ms"] or 0), flush=True)
+
+    # hard gates
+    errors = []
+    if fixed["shed"] or fixed["rejected"] or fixed["failed"]:
+        errors.append("fixed-qps arm shed/rejected/failed: %d/%d/%d"
+                      % (fixed["shed"], fixed["rejected"],
+                         fixed["failed"]))
+    for name, pred in preds.items():
+        entries = len(pred._exe._cache)
+        if entries > len(buckets):
+            errors.append(
+                "tenant %s jit cache grew past the bucket cap: "
+                "%d entries > %d buckets" % (name, entries,
+                                             len(buckets)))
+
+    kind = getattr(dev, "device_kind", str(dev))
+    print(json.dumps({
+        "metric": "p50_serving_latency_ms",
+        "value": round(fixed["p50_ms"], 2),
+        "unit": "ms (2 tenants mnist+bert seq%d, %.0f qps offered, "
+                "buckets %s, in-flight 3, on %s)"
+                % (seq_len, qps, list(buckets), kind),
+        "vs_baseline": round(100.0 / max(fixed["p50_ms"], 1e-3), 3),
+    }), flush=True)
+    print(json.dumps({
+        "metric": "p99_serving_latency_ms",
+        "value": round(fixed["p99_ms"], 2),
+        "unit": "ms (2 tenants, %.0f qps offered, shed=%d rejected=%d, "
+                "zero-sync certified, on %s)"
+                % (qps, fixed["shed"], fixed["rejected"], kind),
+        "vs_baseline": round(250.0 / max(fixed["p99_ms"], 1e-3), 3),
+    }), flush=True)
+    print(json.dumps({
+        "metric": "serving_throughput_qps",
+        "value": round(rep_cont["qps"], 1),
+        "unit": "req/sec at saturation (continuous batching p99 "
+                "%.1fms vs naive 1-req/step %.1f qps p99 %.1fms)"
+                % (rep_cont["p99_ms"] or 0, rep_naive["qps"],
+                   rep_naive["p99_ms"] or 0),
+        "vs_baseline": round(speedup, 3),
+    }), flush=True)
+    print(json.dumps({
+        "metric": "serving_continuous_batching_speedup",
+        "value": round(speedup, 3),
+        "unit": "x naive dispatch throughput (%d reqs, 2 tenants)"
+                % n_req,
+        "vs_baseline": round(speedup, 3),
+    }), flush=True)
+
+    if errors:
+        for e in errors:
+            print("# SERVING GATE FAILED: %s" % e, file=sys.stderr,
+                  flush=True)
+        raise SystemExit(1)
+
+
 def child_lint():
     """Static-analysis CI arm (ISSUE 10): run the whole-program
     analyzer with the concurrency battery (max_in_flight=2) over every
@@ -1478,7 +1654,7 @@ def main():
         plan = [("bert", 420), ("ctr", 160), ("resnet", 340),
                 ("bert512", 270), ("infer", 220), ("bert_infer", 200),
                 ("fusion", 150), ("kernels", 220), ("planner", 220),
-                ("observability", 150)]
+                ("observability", 150), ("serving", 200)]
         failed = []
         for mode, cap in plan:
             if remaining(cap) < 90:
@@ -1539,7 +1715,7 @@ def main():
         print("# TPU unavailable: %s — emitting CPU smoke + captured "
               "hardware lines (if any)" % reason, flush=True)
         for mode in ("ctr", "bert", "fusion", "kernels", "planner",
-                     "observability"):
+                     "observability", "serving"):
             env_extra = {"PADDLE_BENCH_FORCE_CPU": "1"}
             if mode == "planner":
                 # the CPU smoke needs a virtual mesh for a real DP A/B
@@ -1617,6 +1793,8 @@ if __name__ == "__main__":
             child_kernels()
         elif mode == "planner":
             child_planner()
+        elif mode == "serving":
+            child_serving()
         elif mode == "lint":
             child_lint()
         else:

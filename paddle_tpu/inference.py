@@ -295,7 +295,7 @@ class AnalysisPredictor:
         return self.run(inputs, return_numpy=False)
 
     def run_batches(self, batches, max_in_flight=2, return_numpy=True,
-                    verify=False):
+                    verify=False, request_ids=None):
         """Streamed serving loop: generator yielding one result list per
         input batch, keeping up to ``max_in_flight`` dispatched batches'
         results un-synced while a background thread device-stages
@@ -313,7 +313,15 @@ class AnalysisPredictor:
         the executor will actually run (fused twin included) is
         race-checked at this in-flight depth and certified free of
         host-sync points; a finding raises ``VerifyError`` naming the
-        op — before any batch is dispatched."""
+        op — before any batch is dispatched.
+
+        Every batch is validated against the program's
+        ``need_check_feed`` declarations AT ENQUEUE TIME (on the
+        prefetch thread, before device staging), so a malformed feed
+        raises a ``ValueError`` attributed to the offending batch —
+        optionally by the matching entry of ``request_ids`` — instead
+        of surfacing ``max_in_flight`` steps later as a raw jit shape
+        error."""
         if max_in_flight < 1:
             raise ValueError("max_in_flight must be >= 1, got %d"
                              % max_in_flight)
@@ -330,16 +338,31 @@ class AnalysisPredictor:
                 self._program,
                 targets=[v.name for v in self._fetch_vars],
                 max_in_flight=max_in_flight, label="serving hot loop")
-        return self._run_batches(batches, max_in_flight, return_numpy)
+        if request_ids is not None:
+            request_ids = list(request_ids)
+        return self._run_batches(batches, max_in_flight, return_numpy,
+                                 request_ids)
 
-    def _run_batches(self, batches, max_in_flight, return_numpy):
+    def _run_batches(self, batches, max_in_flight, return_numpy,
+                     request_ids=None):
         import collections
 
         from . import pipeline as pl
+        from .executor import _check_feed_shapes
 
         def feeds():
-            for b in batches:
-                yield self._as_feed(b)
+            for i, b in enumerate(batches):
+                rid = None
+                if request_ids is not None and i < len(request_ids):
+                    rid = request_ids[i]
+                try:
+                    feed = self._as_feed(b)
+                    _check_feed_shapes(self._program, feed)
+                except ValueError as exc:
+                    who = ("request %r (batch #%d)" % (rid, i)
+                           if rid is not None else "batch #%d" % i)
+                    raise ValueError("%s: %s" % (who, exc)) from None
+                yield feed
 
         def finish(handles):
             return pl.materialize(handles) if return_numpy else handles

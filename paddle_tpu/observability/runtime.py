@@ -17,8 +17,13 @@ from .metrics import telemetry_enabled
 
 __all__ = [
     "record_step", "record_jit_cache", "record_compile",
-    "record_fusion_resolve", "record_feed_cache", "record_sync",
+    "record_fusion_resolve", "record_feed_cache",
+    "record_feed_cache_eviction", "record_sync",
     "record_prefetch", "record_guard_step", "record_guard_skip",
+    "record_serving_request", "record_serving_reject",
+    "record_serving_shed", "record_serving_batch",
+    "record_serving_done", "set_serving_depths",
+    "set_serving_throughput",
     "record_checkpoint_save", "record_checkpoint_load", "record_retry",
     "record_fault", "record_worker_lost", "record_missed_beat",
     "record_concurrency_check",
@@ -191,6 +196,13 @@ def record_feed_cache(hit):
            else "feed_cache_misses_total").inc()
 
 
+def record_feed_cache_eviction(n=1):
+    """LRU eviction(s) from the bounded feed placement cache."""
+    if not telemetry_enabled():
+        return
+    _named(_m.counter, "feed_cache_evictions_total").inc(n)
+
+
 def record_sync(wait_ms, handles=1):
     """One batched device->host sync drained ``handles`` handles."""
     if not telemetry_enabled():
@@ -209,6 +221,64 @@ def record_prefetch(depth, capacity):
     if capacity:
         _named(_m.gauge, "prefetch_occupancy").set(
             depth / float(capacity))
+
+
+# ---------------------------------------------------------------------------
+# serving (paddle_tpu/serving — the continuous-batching server)
+# ---------------------------------------------------------------------------
+
+def record_serving_request(tenant):
+    if not telemetry_enabled():
+        return
+    _m.counter("serving_requests_total", tenant=tenant).inc()
+
+
+def record_serving_reject():
+    """Backpressure rejection (bounded queue full)."""
+    if not telemetry_enabled():
+        return
+    _named(_m.counter, "serving_rejected_total").inc()
+
+
+def record_serving_shed(tenant):
+    """SLA priority eviction: a request shed before dispatch."""
+    if not telemetry_enabled():
+        return
+    _m.counter("serving_shed_total", tenant=tenant).inc()
+    _journal.emit("request-shed", tenant=tenant)
+
+
+def record_serving_batch(tenant, bucket, rows):
+    """One coalesced batch dispatched: occupancy = real rows over the
+    padded bucket size (1.0 means no padding waste)."""
+    if not telemetry_enabled():
+        return
+    _m.counter("serving_batches_total", tenant=tenant).inc()
+    _named(_m.counter, "serving_rows_total").inc(rows)
+    _named(_m.counter, "serving_padded_rows_total").inc(bucket - rows)
+    _named(_m.gauge, "serving_batch_occupancy").set(
+        rows / float(bucket) if bucket else 0.0)
+
+
+def record_serving_done(tenant, latency_ms):
+    """One request completed (enqueue→result latency)."""
+    if not telemetry_enabled():
+        return
+    _m.counter("serving_completed_total", tenant=tenant).inc()
+    _named(_m.histogram, "serving_latency_ms").observe(latency_ms)
+
+
+def set_serving_depths(queued, inflight):
+    if not telemetry_enabled():
+        return
+    _named(_m.gauge, "serving_queue_depth").set(queued)
+    _named(_m.gauge, "serving_inflight_depth").set(inflight)
+
+
+def set_serving_throughput(qps):
+    if not telemetry_enabled():
+        return
+    _named(_m.gauge, "serving_throughput_qps").set(qps)
 
 
 # ---------------------------------------------------------------------------

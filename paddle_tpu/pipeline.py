@@ -283,23 +283,42 @@ def _cache_enabled():
     return os.environ.get("PADDLE_TPU_FEED_CACHE", "1") != "0"
 
 
+def _cache_cap(default=64):
+    try:
+        cap = int(os.environ.get("PADDLE_TPU_FEED_CACHE_CAP", default))
+    except ValueError:
+        cap = default
+    return max(1, cap)
+
+
 class FeedCache:
-    """Placement cache for repeated feed structures: per feed name, the
-    last host array fed and its device placement.  A hit requires the
-    SAME host object (``is`` — the entry keeps the host array alive, so
-    identity cannot be recycled) AND an unchanged content fingerprint (a
-    strided ~64-element sample), which makes re-feeding a constant
-    (attention-mask bias, a benchmark batch) free instead of one H2D
-    transfer per step while catching the in-place-mutated-buffer pattern
-    (same object, new data → treated as a miss and re-transferred).
+    """Bounded LRU placement cache for repeated feeds, keyed by
+    ``(name, shape, dtype, content fingerprint)``.
 
-    The fingerprint is probabilistic — a mutation that leaves every
-    sampled element bit-identical would slip through; pass fresh arrays
-    per batch (what every reader/DataFeeder path produces) or set
-    ``PADDLE_TPU_FEED_CACHE=0`` if that matters."""
+    The original identity-keyed design (same host object per name) never
+    hits under serving traffic — every request arrives as a fresh numpy
+    array — so constants that recur BY VALUE (an attention-mask bias, a
+    shared position-id table) paid one H2D transfer per request.
+    Content-shape keying fixes that: a candidate hit (same key) is
+    confirmed with an ``is`` identity check (the training-loop fast
+    path) or a full ``np.array_equal`` compare (still far cheaper than
+    the H2D it saves, and immune to fingerprint collisions — a false
+    device-placement reuse would silently corrupt results, so the
+    fingerprint only narrows, never decides).  In-place mutation changes
+    the fingerprint → new key → miss and re-transfer, same as before.
 
-    def __init__(self):
-        self._entries = {}
+    The cache is a per-Executor LRU bounded by
+    ``PADDLE_TPU_FEED_CACHE_CAP`` (default 64 entries; each predictor —
+    i.e. each serving tenant — owns its Executor and therefore its own
+    cap); evictions count into ``feed_cache_evictions_total``.  Set
+    ``PADDLE_TPU_FEED_CACHE=0`` to disable entirely."""
+
+    def __init__(self, cap=None):
+        import collections
+
+        self._entries = collections.OrderedDict()
+        self._cap = _cache_cap() if cap is None else max(1, int(cap))
+        self._lock = threading.Lock()
 
     @staticmethod
     def _fingerprint(a):
@@ -310,26 +329,48 @@ class FeedCache:
         sample = flat[:: max(1, n // 64)][:64]
         return sample.tobytes()
 
+    def _key(self, name, a):
+        return (name, a.shape, str(a.dtype), self._fingerprint(a))
+
     def get(self, name, host_value):
         if not _cache_enabled():
             return None
         from .observability import runtime as _obs
 
-        e = self._entries.get(name)
-        if (e is not None and e[0] is host_value
-                and e[2] == self._fingerprint(host_value)):
-            _obs.record_feed_cache(True)
-            return e[1]
+        key = self._key(name, host_value)
+        with self._lock:
+            e = self._entries.get(key)
+            if e is not None and (e[0] is host_value
+                                  or np.array_equal(e[0], host_value)):
+                self._entries.move_to_end(key)
+                _obs.record_feed_cache(True)
+                return e[1]
         _obs.record_feed_cache(False)
         return None
 
     def put(self, name, host_value, device_value):
-        if _cache_enabled():
-            self._entries[name] = (host_value, device_value,
-                                   self._fingerprint(host_value))
+        if not _cache_enabled():
+            return
+        evicted = 0
+        with self._lock:
+            key = self._key(name, host_value)
+            self._entries[key] = (host_value, device_value)
+            self._entries.move_to_end(key)
+            while len(self._entries) > self._cap:
+                self._entries.popitem(last=False)
+                evicted += 1
+        if evicted:
+            from .observability import runtime as _obs
+
+            _obs.record_feed_cache_eviction(evicted)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._entries)
 
     def clear(self):
-        self._entries.clear()
+        with self._lock:
+            self._entries.clear()
 
 
 def _stage(value, name=None, cache=None):

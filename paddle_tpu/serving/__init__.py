@@ -1,0 +1,36 @@
+"""Continuous-batching multi-tenant predictor serving.
+
+The layer that turns the async predictor substrate into sustained
+traffic: a bounded request queue, shape-bucket padding (jit-cache
+bounded), an in-flight dispatcher over the zero-sync certified hot
+loop, SLA shedding, per-tenant fairness, and a load generator.  CLI:
+``python -m paddle_tpu.tools.serve``.
+"""
+
+from .buckets import (BUCKET_CAP_ENV, BUCKETS_ENV, DEFAULT_BUCKETS,
+                      ShapeBuckets, bucket_cap, derive_buckets,
+                      parse_buckets, resolve_buckets)
+from .loadgen import make_feed_sampler, percentile, run_load
+from .server import (DeadlineExceededError, PredictorServer,
+                     QueueFullError, Request, ServerClosedError,
+                     ServingError)
+
+__all__ = [
+    "BUCKETS_ENV",
+    "BUCKET_CAP_ENV",
+    "DEFAULT_BUCKETS",
+    "DeadlineExceededError",
+    "PredictorServer",
+    "QueueFullError",
+    "Request",
+    "ServerClosedError",
+    "ServingError",
+    "ShapeBuckets",
+    "bucket_cap",
+    "derive_buckets",
+    "make_feed_sampler",
+    "parse_buckets",
+    "percentile",
+    "resolve_buckets",
+    "run_load",
+]

@@ -265,6 +265,19 @@ def collect_status(dirname, hb_dir=None, now=None,
     checkpoint_age_s = (round(now - ckpt_ts, 2)
                         if ckpt_ts else None)
 
+    # serving view (paddle_tpu/serving): latency percentiles from the
+    # pooled serving_latency_ms histogram, throughput/depth gauges, and
+    # the shed rate (SLA evictions over submitted requests)
+    srv_lat = _merged_histogram(merged, "serving_latency_ms")
+    srv_p50 = _hist_percentile(srv_lat, 50) if srv_lat else None
+    srv_p99 = _hist_percentile(srv_lat, 99) if srv_lat else None
+    srv_qps = _metric_value(merged, "serving_throughput_qps")
+    srv_reqs = _metric_value(merged, "serving_requests_total")
+    srv_shed = _metric_value(merged, "serving_shed_total")
+    srv_shed_rate = None
+    if srv_reqs:
+        srv_shed_rate = round((srv_shed or 0.0) / srv_reqs, 4)
+
     counts = {}
     for e in events:
         counts[e["kind"]] = counts.get(e["kind"], 0) + 1
@@ -288,6 +301,19 @@ def collect_status(dirname, hb_dir=None, now=None,
         "restores": counts.get("checkpoint-loaded", 0),
         "drift": drift or None,
         "checkpoint_age_s": checkpoint_age_s,
+        "p50_serving_latency_ms": (None if srv_p50 is None
+                                   else round(srv_p50, 3)),
+        "p99_serving_latency_ms": (None if srv_p99 is None
+                                   else round(srv_p99, 3)),
+        "serving_throughput_qps": (None if srv_qps is None
+                                   else round(srv_qps, 3)),
+        "serving_queue_depth": _metric_value(merged,
+                                             "serving_queue_depth"),
+        "serving_requests": (None if srv_reqs is None
+                             else int(srv_reqs)),
+        "serving_rejected": _metric_value(merged,
+                                          "serving_rejected_total"),
+        "serving_shed_rate": srv_shed_rate,
         "ranks": ranks or None,
         "alive_ranks": alive if ranks else None,
         "lost_ranks": (len(ranks) - alive) if ranks else None,
@@ -360,6 +386,16 @@ def render_status(status):
         lines.append("  drift " + "  ".join(
             "%s=%s" % (k, _fmt(v))
             for k, v in sorted(status["drift"].items())))
+    if status.get("serving_requests") is not None:
+        lines.append(
+            "  serving: reqs=%s  qps=%s  lat_ms p50=%s p99=%s  "
+            "queue=%s  shed_rate=%s" % (
+                _fmt(status["serving_requests"]),
+                _fmt(status["serving_throughput_qps"]),
+                _fmt(status["p50_serving_latency_ms"]),
+                _fmt(status["p99_serving_latency_ms"]),
+                _fmt(status["serving_queue_depth"]),
+                _fmt(status["serving_shed_rate"])))
     if status["ranks"]:
         for rank in sorted(status["ranks"], key=int):
             r = status["ranks"][rank]
@@ -398,7 +434,9 @@ def main(argv=None):
                     help="machine-readable output")
     ap.add_argument("--alert", action="append", default=[],
                     metavar="EXPR",
-                    help="e.g. 'p99_step_ms>50'; exit 1 when tripped, "
+                    help="e.g. 'p99_step_ms>50' or, for a serving job, "
+                         "'p99_serving_latency_ms>250' / "
+                         "'serving_shed_rate>0'; exit 1 when tripped, "
                          "2 when the field has no data (repeatable)")
     ap.add_argument("--interval", type=float, default=2.0,
                     help="live-mode refresh seconds (default 2)")

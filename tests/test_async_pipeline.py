@@ -326,8 +326,11 @@ class TestDeviceFeeds:
             assert dev1 is not None
             exe.run(main, feed=batch, fetch_list=[loss])
             assert exe._feed_cache.get("x", batch["x"]) is dev1
-            # a DIFFERENT array with equal contents must NOT hit
-            assert exe._feed_cache.get("x", batch["x"].copy()) is None
+            # a DIFFERENT array with equal contents also hits: the
+            # cache keys on (name, shape, dtype, content) — serving
+            # traffic re-sends constants as fresh objects every request
+            # (equality is verified in full, not just fingerprinted)
+            assert exe._feed_cache.get("x", batch["x"].copy()) is dev1
             # an IN-PLACE mutation of the cached buffer must not serve
             # stale data: the content fingerprint turns it into a miss
             batch["x"][:] = batch["x"] + 1.0

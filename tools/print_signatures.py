@@ -99,6 +99,7 @@ MODULES = [
     "paddle_tpu.observability.drift",
     "paddle_tpu.observability.exporters",
     "paddle_tpu.observability.runtime",
+    "paddle_tpu.serving",
 ]
 
 
