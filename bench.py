@@ -1039,6 +1039,71 @@ def child_serving():
         raise SystemExit(1)
 
 
+def child_elastic():
+    """Elastic-training recovery drill (ISSUE 12): run the chaos
+    elastic scenario — 3 workers, kill one mid-run — and report
+    ``elastic_recovery_ms``, the wall time from the worker-lost verdict
+    to the first completed step at the shrunk world.  The chaos driver
+    itself enforces the hard part (rc=0 only when every survivor covers
+    every step from ONE process — re-plan, reshard and resume happened
+    in-process with no restart — and the post-recovery loss curve
+    matches the shrunk-world oracle); this child additionally gates on
+    the journaled incident chain and on the resume event carrying the
+    measured recovery latency.  vs_baseline compares against a 60s
+    full-job-restart budget (kill fleet, reschedule, recompile, reload
+    — the Fluid-era recovery story)."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.observability.journal import read_journal
+    from paddle_tpu.tools import chaos
+
+    workdir = tempfile.mkdtemp(prefix="paddle_tpu_elastic_bench_")
+    print("# elastic drill: 3 workers, worker_kill mid-run — survivors "
+          "must re-plan/reshard/resume in-process", flush=True)
+    try:
+        rc = chaos.main(["--elastic", "--ckpt-dir", workdir])
+    except SystemExit as e:  # argparse or driver bail-out
+        rc = int(e.code or 0)
+
+    telemetry = os.path.join(workdir, "telemetry")
+    events = read_journal(telemetry) if os.path.isdir(telemetry) else []
+    kinds = [e.get("kind") for e in events]
+    resumes = [e for e in events if e.get("kind") == "resume"
+               and e.get("recovery_ms") is not None]
+
+    errors = []
+    if rc != 0:
+        errors.append("chaos --elastic drill failed (rc=%s) — recovery "
+                      "must complete in-process, without a process "
+                      "restart" % rc)
+    for k in ("worker-lost", "replan", "reshard", "resume"):
+        if k not in kinds:
+            errors.append("journal is missing the %r incident event" % k)
+    if not resumes:
+        errors.append("no journaled resume event carries recovery_ms")
+
+    recovery_ms = (max(float(e["recovery_ms"]) for e in resumes)
+                   if resumes else 0.0)
+    restart_budget_ms = 60000.0
+    print(json.dumps({
+        "metric": "elastic_recovery_ms",
+        "value": round(recovery_ms, 2),
+        "unit": "ms worker-lost -> first step at shrunk world, "
+                "in-process (3->2 workers, %d resume events)"
+                % len(resumes),
+        "vs_baseline": round(restart_budget_ms / max(recovery_ms, 1e-3),
+                             2),
+    }), flush=True)
+
+    if errors:
+        for e in errors:
+            print("# ELASTIC GATE FAILED: %s" % e, file=sys.stderr,
+                  flush=True)
+        raise SystemExit(1)
+    shutil.rmtree(workdir, ignore_errors=True)
+
+
 def child_lint():
     """Static-analysis CI arm (ISSUE 10): run the whole-program
     analyzer with the concurrency battery (max_in_flight=2) over every
@@ -1654,7 +1719,8 @@ def main():
         plan = [("bert", 420), ("ctr", 160), ("resnet", 340),
                 ("bert512", 270), ("infer", 220), ("bert_infer", 200),
                 ("fusion", 150), ("kernels", 220), ("planner", 220),
-                ("observability", 150), ("serving", 200)]
+                ("observability", 150), ("serving", 200),
+                ("elastic", 240)]
         failed = []
         for mode, cap in plan:
             if remaining(cap) < 90:
@@ -1715,7 +1781,7 @@ def main():
         print("# TPU unavailable: %s — emitting CPU smoke + captured "
               "hardware lines (if any)" % reason, flush=True)
         for mode in ("ctr", "bert", "fusion", "kernels", "planner",
-                     "observability", "serving"):
+                     "observability", "serving", "elastic"):
             env_extra = {"PADDLE_BENCH_FORCE_CPU": "1"}
             if mode == "planner":
                 # the CPU smoke needs a virtual mesh for a real DP A/B
@@ -1723,7 +1789,8 @@ def main():
                     os.environ.get("XLA_FLAGS", "")
                     + " --xla_force_host_platform_device_count=2")
             w_ok, w_lines, w_err = _run_child(
-                mode, remaining(420 if mode == "bert" else 150),
+                mode, remaining(420 if mode == "bert"
+                                else 240 if mode == "elastic" else 150),
                 env_extra=env_extra)
             if not w_ok:
                 print("# cpu %s smoke failed: %s" % (mode, w_err),
@@ -1795,6 +1862,8 @@ if __name__ == "__main__":
             child_planner()
         elif mode == "serving":
             child_serving()
+        elif mode == "elastic":
+            child_elastic()
         elif mode == "lint":
             child_lint()
         else:

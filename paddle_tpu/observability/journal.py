@@ -39,6 +39,7 @@ SCHEMA_VERSION = 1
 URGENT_KINDS = frozenset([
     "fault-injected", "guard-skip", "checkpoint-saved",
     "checkpoint-loaded", "worker-lost", "resume", "race-detected",
+    "replan", "reshard", "dispatcher-died",
 ])
 
 _DEFAULT_CAPACITY = 4096

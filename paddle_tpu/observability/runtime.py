@@ -26,7 +26,8 @@ __all__ = [
     "set_serving_throughput",
     "record_checkpoint_save", "record_checkpoint_load", "record_retry",
     "record_fault", "record_worker_lost", "record_missed_beat",
-    "record_concurrency_check",
+    "record_concurrency_check", "record_replan", "record_reshard",
+    "record_elastic_recovery", "record_dispatcher_died",
     "set_collective_schedule", "last_step_info", "reset_runtime",
 ]
 
@@ -340,6 +341,54 @@ def record_worker_lost(ranks, reason=""):
         return
     _m.counter("workers_lost_total").inc(max(len(ranks), 1))
     _journal.emit("worker-lost", ranks=list(ranks), reason=reason)
+
+
+def record_replan(epoch, old_world, new_world, plan, duration_ms):
+    """One elastic re-plan: the survivors re-transpiled for the shrunk
+    world and the new schedule passed the deadlock/race provers."""
+    if not telemetry_enabled():
+        return
+    _named(_m.counter, "elastic_replans_total").inc()
+    _named(_m.histogram, "elastic_replan_ms").observe(duration_ms)
+    _journal.emit("replan", epoch=epoch, old_world=old_world,
+                  new_world=new_world, plan=str(plan),
+                  duration_ms=round(duration_ms, 2))
+
+
+def record_reshard(step, old_world, new_world, vars_resharded,
+                   duration_ms, path):
+    """One checkpoint reshard old→new topology (resilience.reshard)."""
+    if not telemetry_enabled():
+        return
+    _named(_m.counter, "reshards_total").inc()
+    _named(_m.histogram, "reshard_ms").observe(duration_ms)
+    _journal.emit("reshard", step=step, old_world=old_world,
+                  new_world=new_world, vars=vars_resharded,
+                  duration_ms=round(duration_ms, 2),
+                  path=os.path.basename(str(path)))
+
+
+def record_elastic_recovery(epoch, step, new_world, recovery_ms):
+    """End of one elastic recovery: detect→first post-resume step,
+    completed in-process (no restart).  Closes the incident chain the
+    monitor renders (worker-lost → replan → reshard → resume)."""
+    if not telemetry_enabled():
+        return
+    _named(_m.counter, "elastic_recoveries_total").inc()
+    _named(_m.histogram, "elastic_recovery_ms").observe(recovery_ms)
+    _m.gauge("elastic_world_size").set(new_world)
+    _journal.emit("resume", epoch=epoch, step=step, world=new_world,
+                  recovery_ms=round(recovery_ms, 2))
+
+
+def record_dispatcher_died(reason, failed_requests):
+    """The serving dispatcher thread crashed: every pending request was
+    failed with a typed error instead of stranding callers."""
+    if not telemetry_enabled():
+        return
+    _named(_m.counter, "serving_dispatcher_crashes_total").inc()
+    _journal.emit("dispatcher-died", reason=str(reason)[:200],
+                  failed_requests=int(failed_requests))
 
 
 def record_missed_beat(ranks):

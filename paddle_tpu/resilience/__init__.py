@@ -19,7 +19,14 @@ env-driven trainer restarts — reproduced TPU-native and made testable:
   barriers;
 * :mod:`~paddle_tpu.resilience.watchdog` — heartbeats and cluster
   supervision turning a dead peer into a bounded
-  :class:`WorkerLostError` instead of a collective hang.
+  :class:`WorkerLostError` instead of a collective hang;
+* :mod:`~paddle_tpu.resilience.elastic` — the ISSUE-12 recovery loop:
+  on worker loss, survivors agree on a shrunk membership, re-plan and
+  re-prove the schedule, reshard the checkpoint, and resume in-process
+  (no restart, no lost hardware);
+* :mod:`~paddle_tpu.resilience.reshard` — checkpoint topology
+  remapping: re-slice row-sharded optimizer/embedding state from an
+  old world size to a new one, bit-exactly.
 
 Chaos harness: ``python -m paddle_tpu.tools.chaos`` runs a short training
 loop under a fault spec and exits nonzero unless the run *recovers* —
@@ -40,8 +47,15 @@ from .guard import NonFiniteStepWarning, GuardStats, guard_enabled
 from .watchdog import (WorkerLostError, HeartbeatWriter, HeartbeatMonitor,
                        wait_cluster)
 from .checkpoint import (CheckpointInfo, CorruptCheckpointError,
-                         save_checkpoint, try_load_latest_checkpoint,
-                         list_checkpoints, verify_checkpoint)
+                         TopologyMismatchError, save_checkpoint,
+                         try_load_latest_checkpoint, list_checkpoints,
+                         verify_checkpoint, read_topology)
+from . import elastic
+from . import reshard
+from .elastic import (ELASTIC_EVICTED_EXIT_CODE, ElasticError,
+                      ElasticEvictedError, ElasticTrainer, Membership,
+                      agree_membership, reduce_gradients)
+from .reshard import reshard_checkpoint, shard_bounds
 
 __all__ = [
     "faults",
@@ -49,6 +63,8 @@ __all__ = [
     "guard",
     "watchdog",
     "checkpoint",
+    "elastic",
+    "reshard",
     "FaultInjected",
     "TransientFault",
     "FaultInjector",
@@ -70,8 +86,19 @@ __all__ = [
     "wait_cluster",
     "CheckpointInfo",
     "CorruptCheckpointError",
+    "TopologyMismatchError",
     "save_checkpoint",
     "try_load_latest_checkpoint",
     "list_checkpoints",
     "verify_checkpoint",
+    "read_topology",
+    "ELASTIC_EVICTED_EXIT_CODE",
+    "ElasticError",
+    "ElasticEvictedError",
+    "ElasticTrainer",
+    "Membership",
+    "agree_membership",
+    "reduce_gradients",
+    "reshard_checkpoint",
+    "shard_bounds",
 ]

@@ -44,9 +44,11 @@ import warnings
 from . import faults as _faults
 from . import retry as _retry
 
-__all__ = ["CheckpointInfo", "CorruptCheckpointError", "save_checkpoint",
+__all__ = ["CheckpointInfo", "CorruptCheckpointError",
+           "TopologyMismatchError", "save_checkpoint",
            "try_load_latest_checkpoint", "list_checkpoints",
-           "verify_checkpoint", "MANIFEST_NAME", "CKPT_PREFIX"]
+           "verify_checkpoint", "read_topology", "MANIFEST_NAME",
+           "CKPT_PREFIX"]
 
 MANIFEST_NAME = "MANIFEST.json"
 STATE_NAME = "state.json"
@@ -60,6 +62,26 @@ CheckpointInfo = collections.namedtuple(
 
 class CorruptCheckpointError(RuntimeError):
     """A checkpoint version failed integrity verification."""
+
+
+class TopologyMismatchError(RuntimeError):
+    """The manifest's recorded cluster topology (world size, ZeRO-1
+    partitioning) does not match the cluster trying to restore from it.
+
+    Deliberately NOT a :class:`CorruptCheckpointError`: the data is
+    intact, it is just laid out for a different world — skipping the
+    version (the corrupt-checkpoint policy) would silently restart
+    training from an older topology-matching version or from scratch.
+    The elastic recovery path catches this error and routes the version
+    through :mod:`~paddle_tpu.resilience.reshard` instead."""
+
+    def __init__(self, message, path=None, step=None, recorded=None,
+                 expected=None):
+        super().__init__(message)
+        self.path = path
+        self.step = step
+        self.recorded = dict(recorded or {})
+        self.expected = dict(expected or {})
 
 
 def _default_retain():
@@ -163,13 +185,20 @@ def _is_primary():
 
 
 def save_checkpoint(executor, root, main_program=None, step=0, state=None,
-                    retain=None, policy=None, all_ranks=False):
+                    retain=None, policy=None, all_ranks=False,
+                    topology=None):
     """Write one atomic, verified checkpoint version; returns its final
     path (``None`` on non-primary cluster ranks unless ``all_ranks``).
 
     The whole body — stage, checksum, finalize — is one retryable unit:
     a transient failure anywhere discards the staging dir and starts
     over, so no partial version ever becomes visible.
+
+    ``topology`` (a dict, e.g. ``{"world": 4, "zero1": False}``) is
+    recorded in the manifest so a later restore on a DIFFERENT cluster
+    shape is rejected with :class:`TopologyMismatchError` instead of
+    silently loading misshapen shards (pass the matching
+    ``expected_topology`` to :func:`try_load_latest_checkpoint`).
     """
     if not all_ranks and not _is_primary():
         return None
@@ -199,6 +228,8 @@ def save_checkpoint(executor, root, main_program=None, step=0, state=None,
                               "size": os.path.getsize(full)}
             manifest = {"schema": _SCHEMA, "step": step,
                         "wall_time": time.time(), "files": files}
+            if topology:
+                manifest["topology"] = dict(topology)
             from .atomic import atomic_write
 
             atomic_write(os.path.join(tmp, MANIFEST_NAME),
@@ -291,13 +322,60 @@ def verify_checkpoint(path):
     return manifest
 
 
+def read_topology(path):
+    """The cluster topology dict a version dir's manifest records, or
+    ``None`` for legacy manifests saved before topology stamping."""
+    man_path = os.path.join(path, MANIFEST_NAME)
+    try:
+        with open(man_path) as f:
+            manifest = json.load(f)
+    except (ValueError, OSError) as e:
+        raise CorruptCheckpointError(
+            "checkpoint %r manifest unreadable: %s" % (path, e)) from e
+    topo = manifest.get("topology")
+    return dict(topo) if isinstance(topo, dict) else None
+
+
+def _check_topology(path, manifest, expected):
+    """Raise :class:`TopologyMismatchError` when the manifest records a
+    topology and any key present on BOTH sides disagrees.  Legacy
+    manifests (no topology) and keys only one side knows are accepted —
+    the check must never reject a checkpoint the old code would have
+    loaded correctly."""
+    if not expected:
+        return
+    recorded = manifest.get("topology")
+    if not isinstance(recorded, dict):
+        return
+    diffs = {k: (recorded[k], expected[k]) for k in expected
+             if k in recorded and recorded[k] != expected[k]}
+    if diffs:
+        raise TopologyMismatchError(
+            "checkpoint %r was saved for a different cluster topology "
+            "(%s); refusing to load misshapen shards — reshard it with "
+            "resilience.reshard.reshard_checkpoint or restore at the "
+            "recorded world size" % (
+                path,
+                ", ".join("%s: recorded %r != expected %r" % (k, r, e)
+                          for k, (r, e) in sorted(diffs.items()))),
+            path=path, step=manifest.get("step"),
+            recorded=recorded, expected=expected)
+
+
 def try_load_latest_checkpoint(executor, root, main_program=None,
-                               policy=None):
+                               policy=None, expected_topology=None):
     """Auto-resume: load the newest *intact* checkpoint version into the
     scope.  Corrupt/partial versions are warned about and skipped —
     exactly the torn-file scenario this layer exists for.  Returns a
     :class:`CheckpointInfo` (step, path, trainer state) or ``None`` when
-    no loadable version exists."""
+    no loadable version exists.
+
+    With ``expected_topology``, a version whose manifest records a
+    conflicting topology raises :class:`TopologyMismatchError`
+    immediately (no retry, no skip-to-older-version): the data is fine,
+    the *world* changed, and silently loading misshapen shards — or
+    quietly falling back to an older matching version — would corrupt
+    the run.  The elastic path catches it and reshards."""
     from .. import io as fluid_io
 
     inj = _faults.get_injector()
@@ -307,6 +385,7 @@ def try_load_latest_checkpoint(executor, root, main_program=None,
             def _attempt():
                 inj.maybe_fire("ckpt_read")
                 manifest = verify_checkpoint(path)
+                _check_topology(path, manifest, expected_topology)
                 fluid_io.load_persistables(
                     executor, os.path.join(path, VARS_SUBDIR),
                     main_program=main_program)

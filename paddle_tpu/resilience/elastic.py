@@ -1,0 +1,697 @@
+"""Elastic training: re-plan, reshard, and resume on worker loss —
+in-process, no restart, no lost hardware.
+
+The recovery loop coordinates four existing layers when a peer dies or
+wedges mid-run:
+
+1. **Agree on the shrunk membership.**  Survivors converge on one
+   epoch-numbered, write-once membership file in the shared heartbeat
+   dir (:func:`agree_membership`).  ``os.link`` makes the write
+   first-wins-atomic, so two workers can never adopt different worlds
+   for the same epoch; a worker absent from the winning record evicts
+   itself (:data:`ELASTIC_EVICTED_EXIT_CODE`).
+2. **Re-plan and prove.**  ``parallel.auto_transpile`` re-prices the
+   placement space for the shrunk :class:`~..parallel.ClusterSpec`;
+   the winner carries the PR-3 deadlock proof (``deadlock == "ok"``)
+   and the apply runs inside the PR-10 race bracket
+   (``race_signatures`` / ``assert_no_new_races``) — both proved
+   BEFORE any post-recovery step runs (:func:`plan_world`).
+3. **Reshard the checkpoint.**  The new leader routes the latest
+   manifest through :func:`~.reshard.reshard_checkpoint` when its
+   recorded topology mismatches the new world; followers poll
+   :func:`~.checkpoint.try_load_latest_checkpoint` (typed
+   :class:`~.checkpoint.TopologyMismatchError` routing, never a silent
+   skip) until the resharded version lands.
+4. **Resume in-process**, journaling the incident chain
+   ``worker-lost → replan → reshard → checkpoint-loaded → resume``
+   that ``tools/monitor`` renders.
+
+Why file-mediated gradient exchange?  The pinned jax/gloo runtime
+cannot shrink a live distributed world in-process: the XLA coordination
+service hard-terminates every survivor the moment
+``jax.distributed.shutdown()`` runs with a dead peer (verified by
+prototype) — "restart the job smaller" is exactly the failure mode this
+module exists to remove.  So elastic workers never enter
+``jax.distributed``: each runs single-process XLA, the transpiled
+program is split at the optimizer boundary (the
+``multi_batch_merge_pass`` partition the executor already uses for
+gradient accumulation), and the ``c_allreduce_sum`` ops between head
+and tail are realized as a deterministic file-rendezvous reduction
+(:class:`GradExchange`, :func:`reduce_gradients`) in sorted-member
+order.  The exchange wait doubles as the failure detector: a peer whose
+heartbeat goes stale — or that stays silent past ``wedge_timeout``
+while still beating — is a :class:`~.watchdog.WorkerLostError` verdict.
+
+Caveats (documented contract): the split assumes forward/backward ops
+do not mutate persistables (no sync-BN-style state in the head); plans
+stamped ``zero1`` execute with unsharded optimizer state on
+single-device elastic workers (execution-equivalent — the shard
+remapping itself is exercised by the reshard round-trip tests on the
+8-virtual-device harness).
+"""
+
+import collections
+import json
+import os
+import time
+
+import numpy as np
+
+from . import checkpoint as _ckpt
+from . import faults as _faults
+from .watchdog import HeartbeatMonitor, HeartbeatWriter, WorkerLostError
+from .watchdog import _record_lost
+
+__all__ = [
+    "ELASTIC_EVICTED_EXIT_CODE", "ElasticError", "ElasticEvictedError",
+    "Membership", "agree_membership", "reduce_gradients",
+    "SplitStep", "build_split", "plan_world", "GradExchange",
+    "ElasticTrainer",
+]
+
+#: exit status of a worker excluded from the agreed shrunk membership
+ELASTIC_EVICTED_EXIT_CODE = 45
+
+_MEMBER_PREFIX = "member-"
+_GRAD_PREFIX = "g-"
+
+
+class ElasticError(RuntimeError):
+    """Elastic recovery could not complete (membership timeout, plan
+    proof failure, reshard wait exhausted)."""
+
+
+class ElasticEvictedError(ElasticError):
+    """This worker is not part of the agreed shrunk membership and must
+    exit (:data:`ELASTIC_EVICTED_EXIT_CODE`)."""
+
+
+# ---------------------------------------------------------------------------
+# membership agreement
+# ---------------------------------------------------------------------------
+
+Membership = collections.namedtuple(
+    "Membership", ["epoch", "members", "world", "lost", "writer"])
+
+
+def _member_path(dirname, epoch):
+    return os.path.join(dirname, "%s%08d.json" % (_MEMBER_PREFIX,
+                                                  int(epoch)))
+
+
+def _write_once(path, record):
+    """First-wins atomic publish: stage a private file, ``os.link`` it
+    to the final name (fails EEXIST when a peer won the race), and
+    return whatever record actually ended up at ``path``.  Two workers
+    can therefore never read different membership for one epoch."""
+    tmp = "%s.tmp-%d" % (path, os.getpid())
+    with open(tmp, "w") as f:
+        json.dump(record, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    try:
+        os.link(tmp, path)
+    except FileExistsError:
+        pass
+    finally:
+        os.unlink(tmp)
+    with open(path) as f:
+        return json.load(f)
+
+
+def agree_membership(dirname, rank, epoch, survivors, lost, reason="",
+                     stale_timeout=5.0, timeout=60.0, poll=0.05):
+    """Converge every survivor on one :class:`Membership` for ``epoch``.
+
+    The lowest-ranked *alive* survivor writes the epoch's write-once
+    record; everyone (writer included) returns what the file actually
+    says.  Liveness of the would-be writer is judged by its heartbeat:
+    if the presumptive leader dies while deciding, the next-lowest
+    survivor takes over — the ladder ends with every waiter eligible,
+    so a record always appears unless *all* lower ranks are dead AND we
+    are dead, which is not a case this process observes.
+    """
+    os.makedirs(dirname, exist_ok=True)
+    path = _member_path(dirname, epoch)
+    survivors = sorted(int(r) for r in survivors)
+    record = {
+        "schema": 1, "epoch": int(epoch), "members": survivors,
+        "world": len(survivors), "lost": sorted(int(r) for r in lost),
+        "reason": str(reason)[:500], "writer": int(rank),
+        "ts": time.time(),
+    }
+    monitor = HeartbeatMonitor(
+        dirname, [r for r in survivors if r != rank],
+        timeout=stale_timeout, boot_grace=stale_timeout)
+    deadline = time.time() + timeout
+    while True:
+        if os.path.exists(path):
+            try:
+                with open(path) as f:
+                    got = json.load(f)
+                break
+            except ValueError:
+                # racing the winner's link: visible-but-unreadable
+                # cannot happen (link publishes a complete file), so a
+                # parse error is a torn leftover — retry briefly
+                time.sleep(poll)
+        stale = set(monitor.stale_ranks())
+        alive = [r for r in survivors if r == rank or r not in stale]
+        if alive and alive[0] == rank:
+            got = _write_once(path, record)
+            break
+        if time.time() > deadline:
+            raise ElasticError(
+                "membership for epoch %d did not appear within %.1fs "
+                "(waiting on writer among %s)" % (epoch, timeout, alive))
+        time.sleep(poll)
+    return Membership(epoch=int(got["epoch"]),
+                      members=[int(r) for r in got["members"]],
+                      world=int(got["world"]),
+                      lost=[int(r) for r in got.get("lost", [])],
+                      writer=int(got.get("writer", -1)))
+
+
+# ---------------------------------------------------------------------------
+# program split at the optimizer boundary
+# ---------------------------------------------------------------------------
+
+SplitStep = collections.namedtuple(
+    "SplitStep", ["head", "tail", "grad_names", "pre_scale",
+                  "passthrough"])
+
+
+def build_split(program):
+    """Split a GradAllReduce-transpiled ``program`` into a *head* clone
+    (forward + backward, collectives removed) and a *tail* clone
+    (optimizer ops, reduced gradients fed by name).
+
+    Follows the executor's ``_accum_partition`` contract: the cut is the
+    first ``op_role == "optimize"`` op; non-persistable head outputs the
+    tail reads (``passthrough``) ride the fetch/feed path, persistable
+    ones flow through the scope.  ``grad_names`` are the (in-place)
+    outputs of the removed ``c_allreduce_sum`` ops — exactly the
+    gradients the optimizer consumes — and ``pre_scale`` is their
+    recorded averaging factor.  Returns ``None`` when the program has no
+    collectives (world 1 / "single" plan): run it whole.
+    """
+    block = program.global_block()
+    ops = block.ops
+    ar_ops = [op for op in ops if op.type == "c_allreduce_sum"]
+    if not ar_ops:
+        return None
+    grad_names = []
+    for op in ar_ops:
+        for n in op.output_arg_names:
+            if n not in grad_names:
+                grad_names.append(n)
+    pre_scale = float(ar_ops[0].attrs.get("pre_scale", 1.0))
+    split = next((i for i, op in enumerate(ops)
+                  if op.attrs.get("op_role") == "optimize"), len(ops))
+
+    head_prog = program.clone()
+    hb = head_prog.global_block()
+    hb.ops = [op for op in hb.ops[:split]
+              if op.type != "c_allreduce_sum"]
+    head_prog._bump_version()
+
+    tail_prog = program.clone()
+    tb = tail_prog.global_block()
+    tb.ops = list(tb.ops[split:])
+    tail_prog._bump_version()
+
+    head_written = set()
+    for op in hb.ops:
+        head_written.update(op.output_arg_names)
+    passthrough = []
+    for op in tb.ops:
+        for n in op.input_arg_names:
+            if not n or n in grad_names or n not in head_written \
+                    or n in passthrough:
+                continue
+            v = block._find_var_recursive(n)
+            if v is not None and v.persistable:
+                continue  # scope carries it between the two runs
+            passthrough.append(n)
+    return SplitStep(head=head_prog, tail=tail_prog,
+                     grad_names=grad_names, pre_scale=pre_scale,
+                     passthrough=passthrough)
+
+
+def reduce_gradients(per_member, scale):
+    """Deterministic mirror of the on-disk exchange: float32 sum of each
+    gradient over ``per_member`` (dicts in sorted-member order), scaled
+    by ``scale``, cast back to the local dtype.  The in-process oracle
+    and the distributed workers share this one reduction, so their
+    trajectories can be compared within fp tolerance, not luck."""
+    if not per_member:
+        return {}
+    out = {}
+    for name, local in per_member[0].items():
+        local = np.asarray(local)
+        acc = np.zeros(local.shape, dtype=np.float32)
+        for contrib in per_member:
+            acc = acc + np.asarray(contrib[name], dtype=np.float32)
+        out[name] = (acc * float(scale)).astype(local.dtype, copy=False)
+    return out
+
+
+def plan_world(program, startup_program, world, rank_index=0,
+               batch_size=None):
+    """Clone + re-plan ``program`` for ``world`` chips and prove the
+    result safe: ``auto_transpile`` must return a deadlock-proved winner
+    and the apply must introduce no new race signatures.  The elastic
+    loop additionally pins the data-parallel family — whatever plan the
+    planner prefers on paper, a shrunk *live* world must exchange
+    gradients, so a "single" standin at world > 1 gets the
+    GradAllReduce transpile at the full membership degree.
+
+    Returns ``(train_prog, startup_prog, split, result, applied)``;
+    ``split`` is None for world 1."""
+    from ..parallel.planner import (apply_plan, auto_transpile,
+                                    resolve_cluster_spec)
+    from ..static_analysis.concurrency import (assert_no_new_races,
+                                               race_signatures)
+    from ..transpiler.collective import GradAllReduce
+
+    world = int(world)
+    prog = program.clone()
+    startup = startup_program.clone() if startup_program is not None \
+        else None
+    spec = resolve_cluster_spec(chips=world)
+    result = auto_transpile(prog, spec, startup_program=startup,
+                            batch_size=batch_size)
+    if not result.deadlock_free:
+        raise ElasticError(
+            "re-planned schedule for world=%d failed the deadlock "
+            "proof: %s" % (world, result.plan.status))
+    baseline = race_signatures(prog)
+    applied = apply_plan(prog, result, startup_program=startup,
+                         rank=rank_index)
+    if world > 1 and not any(op.type == "c_allreduce_sum"
+                             for op in prog.global_block().ops):
+        GradAllReduce().transpile(program=prog, startup_program=startup,
+                                  rank=rank_index, nranks=world)
+    assert_no_new_races(prog, baseline,
+                        "elastic re-plan (world=%d)" % world)
+    return prog, startup, build_split(prog), result, applied
+
+
+# ---------------------------------------------------------------------------
+# file-rendezvous gradient exchange
+# ---------------------------------------------------------------------------
+
+def _grad_fname(epoch, step, rank):
+    return "%se%04d-s%08d-r%d.npz" % (_GRAD_PREFIX, int(epoch),
+                                      int(step), int(rank))
+
+
+class GradExchange:
+    """Deterministic all-reduce through a shared directory.
+
+    Each member atomically publishes its local gradients for
+    ``(epoch, step)`` and assembles the reduction from every member's
+    file in sorted-member order (:func:`reduce_gradients`).  The wait
+    for a peer's file IS the rendezvous barrier and the failure
+    detector: a peer whose heartbeat goes stale, or that stays silent
+    past ``wedge_timeout`` while still beating (alive but stuck), is
+    reported as :class:`WorkerLostError` — the verdict the elastic loop
+    recovers from.  Files from ``step - 2`` are reclaimed on each
+    publish (every member passing the ``step - 1`` rendezvous proves
+    they were consumed)."""
+
+    def __init__(self, dirname, rank, members, monitor,
+                 wedge_timeout=60.0, poll=0.02):
+        self.dirname = dirname
+        self.rank = int(rank)
+        self.members = sorted(int(m) for m in members)
+        self.monitor = monitor
+        self.wedge_timeout = float(wedge_timeout)
+        self.poll = float(poll)
+        os.makedirs(dirname, exist_ok=True)
+
+    def _publish(self, epoch, step, arrays):
+        final = os.path.join(self.dirname,
+                             _grad_fname(epoch, step, self.rank))
+        tmp = "%s.tmp-%d" % (final, os.getpid())
+        with open(tmp, "wb") as f:
+            np.savez(f, **{n: np.asarray(v) for n, v in arrays.items()})
+        os.replace(tmp, final)
+        old = os.path.join(self.dirname,
+                           _grad_fname(epoch, step - 2, self.rank))
+        if step >= 2 and os.path.exists(old):
+            try:
+                os.unlink(old)
+            except OSError:
+                pass
+
+    def _wait_peer(self, epoch, step, peer, deadline):
+        path = os.path.join(self.dirname, _grad_fname(epoch, step, peer))
+        while not os.path.exists(path):
+            stale = set(self.monitor.stale_ranks()) \
+                & set(self.members)
+            if stale:
+                _record_lost(sorted(stale),
+                             "heartbeat stale during gradient exchange "
+                             "(epoch %d step %d)" % (epoch, step))
+                raise WorkerLostError(
+                    "worker rank(s) %s lost during gradient exchange at "
+                    "step %d" % (sorted(stale), step),
+                    ranks=sorted(stale))
+            if time.time() > deadline:
+                _record_lost([peer],
+                             "wedged: heartbeat fresh but no gradients "
+                             "for %.1fs (epoch %d step %d)"
+                             % (self.wedge_timeout, epoch, step))
+                raise WorkerLostError(
+                    "worker rank %d wedged: alive but produced no "
+                    "gradients for step %d within %.1fs"
+                    % (peer, step, self.wedge_timeout), ranks=[peer])
+            time.sleep(self.poll)
+        return path
+
+    def allreduce(self, epoch, step, grads, scale):
+        """Publish ``grads`` and return the scaled sorted-member-order
+        reduction over all members' contributions."""
+        self._publish(epoch, step, grads)
+        per_member = []
+        deadline = time.time() + self.wedge_timeout
+        for member in self.members:
+            if member == self.rank:
+                per_member.append(grads)
+                continue
+            path = self._wait_peer(epoch, step, member, deadline)
+            with np.load(path) as z:
+                per_member.append({n: z[n] for n in z.files})
+        return reduce_gradients(per_member, scale)
+
+    def sweep(self, keep_epoch):
+        """Drop this rank's files from epochs before ``keep_epoch``
+        (adopting a new membership obsoletes every older rendezvous)."""
+        prefix = "%se" % _GRAD_PREFIX
+        suffix = "-r%d.npz" % self.rank
+        try:
+            names = os.listdir(self.dirname)
+        except OSError:
+            return
+        for name in names:
+            if not (name.startswith(prefix) and name.endswith(suffix)):
+                continue
+            try:
+                epoch = int(name[len(prefix):].split("-", 1)[0])
+            except ValueError:
+                continue
+            if epoch < keep_epoch:
+                try:
+                    os.unlink(os.path.join(self.dirname, name))
+                except OSError:
+                    pass
+
+
+# ---------------------------------------------------------------------------
+# the elastic trainer
+# ---------------------------------------------------------------------------
+
+class ElasticTrainer:
+    """Own the train loop so recovery can rewind it.
+
+    ``run(total_steps, make_feed)`` executes the split step —
+    head (forward+backward) → file all-reduce → tail (optimizer) —
+    checkpointing from the leader with the membership topology stamped
+    into the manifest.  A :class:`WorkerLostError` anywhere in the step
+    triggers the four-layer recovery *in this process*; the step that
+    was interrupted re-runs under the new world.
+
+    ``make_feed(step, index, world)`` receives the member's POSITION in
+    the sorted membership, not its original rank: a constant global
+    batch sliced by index keeps the global gradient identical across
+    world sizes (equal slices assumed), which is what makes the
+    shrunk-world oracle comparison in ``tools/chaos --elastic`` exact
+    up to fp reassociation.
+    """
+
+    def __init__(self, program, startup_program, executor, rank, world,
+                 workdir, fetch_list=(), batch_size=None, ckpt_every=1,
+                 retain=None, hb_interval=0.25, stale_timeout=3.0,
+                 wedge_timeout=60.0, state=None):
+        self.base_program = program
+        self.base_startup = startup_program
+        self.exe = executor
+        self.rank = int(rank)
+        self.workdir = workdir
+        self.hb_dir = os.path.join(workdir, "hb")
+        self.exchange_dir = os.path.join(workdir, "exchange")
+        self.ckpt_dir = os.path.join(workdir, "ckpt")
+        self.fetch_list = [getattr(v, "name", v) for v in fetch_list]
+        self.batch_size = batch_size
+        self.ckpt_every = max(int(ckpt_every), 1)
+        self.retain = retain
+        self.hb_interval = float(hb_interval)
+        self.stale_timeout = float(stale_timeout)
+        self.wedge_timeout = float(wedge_timeout)
+        self.state = dict(state or {})
+
+        self.epoch = 0
+        self.members = list(range(int(world)))
+        self.step = 0
+        self.train_prog = None
+        self.split = None
+        self.zero1 = False
+        self._hb = None
+        self._monitor = None
+        self._exchange = None
+        self._recovering_since = None
+        for d in (self.hb_dir, self.exchange_dir, self.ckpt_dir):
+            os.makedirs(d, exist_ok=True)
+
+    # -- membership-derived views -------------------------------------
+
+    @property
+    def world(self):
+        return len(self.members)
+
+    @property
+    def index(self):
+        return self.members.index(self.rank)
+
+    def _is_leader(self):
+        return self.rank == min(self.members)
+
+    def _topology(self):
+        return {"world": self.world, "zero1": bool(self.zero1)}
+
+    def _adopt_membership(self, membership):
+        """Install an agreed membership: peers list, watchdog, exchange,
+        and the fleet env contract (``PADDLE_TRAINER_ID`` /
+        ``PADDLE_TRAINERS_NUM``) that role makers and ``_is_primary``
+        read — after a leader loss the new leader must also *look*
+        primary to every downstream layer."""
+        self.epoch = membership.epoch
+        self.members = list(membership.members)
+        if self.rank not in self.members:
+            raise ElasticEvictedError(
+                "rank %d is not part of membership epoch %d %s — "
+                "exiting with ELASTIC_EVICTED_EXIT_CODE"
+                % (self.rank, self.epoch, self.members))
+        os.environ["PADDLE_TRAINER_ID"] = str(self.index)
+        os.environ["PADDLE_TRAINERS_NUM"] = str(self.world)
+        peers = [m for m in self.members if m != self.rank]
+        self._monitor = HeartbeatMonitor(
+            self.hb_dir, peers, timeout=self.stale_timeout,
+            interval=self.hb_interval, boot_grace=self.wedge_timeout)
+        self._exchange = GradExchange(
+            self.exchange_dir, self.rank, self.members, self._monitor,
+            wedge_timeout=self.wedge_timeout)
+        self._exchange.sweep(self.epoch)
+
+    # -- planning / restore --------------------------------------------
+
+    def _plan(self):
+        t0 = time.perf_counter()
+        old_world = self.world if self.train_prog is not None else None
+        (self.train_prog, startup, self.split, result,
+         applied) = plan_world(self.base_program, self.base_startup,
+                               self.world, rank_index=self.index,
+                               batch_size=self.batch_size)
+        self.zero1 = bool(getattr(self.train_prog,
+                                  "_shard_optimizer_state", False))
+        if old_world is not None:
+            from ..observability import runtime as _obs
+
+            _obs.record_replan(
+                self.epoch, old_world, self.world, applied.describe(),
+                (time.perf_counter() - t0) * 1000.0)
+        return startup
+
+    def _topology_compatible(self, recorded):
+        expected = self._topology()
+        return not any(k in recorded and recorded[k] != expected[k]
+                       for k in expected)
+
+    def _restore(self, recovery):
+        """Load the newest checkpoint at the CURRENT topology.  The
+        leader reshards a mismatched latest version first; followers
+        wait for the resharded manifest to land rather than loading a
+        stale layout or silently falling back to an older version."""
+        topo = self._topology()
+        if self._is_leader():
+            versions = _ckpt.list_checkpoints(self.ckpt_dir)
+            if versions:
+                _step, path = versions[0]
+                recorded = _ckpt.read_topology(path)
+                if recorded is not None \
+                        and not self._topology_compatible(recorded):
+                    from .reshard import reshard_checkpoint
+
+                    reshard_checkpoint(path, topo)
+        else:
+            self._await_resharded(recovery)
+        info = _ckpt.try_load_latest_checkpoint(
+            self.exe, self.ckpt_dir, main_program=self.train_prog,
+            expected_topology=topo)
+        if info is not None:
+            self.step = int(info.state.get("step", info.step)) + 1
+            self.state.update(info.state.get("extra", {}))
+        elif not recovery:
+            self.step = 0
+        # on recovery with no checkpoint at all, every survivor keeps
+        # its in-memory state: the tail applied identical reduced
+        # gradients everywhere, so replicated state is still consistent
+        # and self.step already points at the interrupted step
+        return info
+
+    def _await_resharded(self, recovery, none_grace=2.0):
+        """Follower side of the reshard rendezvous: poll until the
+        newest version's recorded topology fits this world.  A brief
+        empty-listing window is tolerated (the leader's save-aside
+        replacement renames the dir out and back); a persistent empty
+        root means there is nothing to restore."""
+        deadline = time.time() + self.wedge_timeout
+        none_since = None
+        while True:
+            versions = _ckpt.list_checkpoints(self.ckpt_dir)
+            if versions:
+                none_since = None
+                try:
+                    recorded = _ckpt.read_topology(versions[0][1])
+                except _ckpt.CorruptCheckpointError:
+                    recorded = None  # racing the replacement rename
+                if recorded is None \
+                        or self._topology_compatible(recorded):
+                    return
+            else:
+                if not recovery:
+                    return  # fresh start: nothing will appear
+                if none_since is None:
+                    none_since = time.time()
+                elif time.time() - none_since > none_grace:
+                    return
+            if time.time() > deadline:
+                raise ElasticError(
+                    "timed out after %.1fs waiting for the leader to "
+                    "reshard the checkpoint for %s"
+                    % (self.wedge_timeout, self._topology()))
+            time.sleep(0.05)
+
+    # -- the step -------------------------------------------------------
+
+    def _run_step(self, make_feed):
+        step = self.step
+        _faults.set_step(step)
+        self._hb.beat()
+        feed = make_feed(step, self.index, self.world)
+        if self.split is None:
+            return self.exe.run(program=self.train_prog, feed=feed,
+                                fetch_list=list(self.fetch_list))
+        sp = self.split
+        head_fetch = (list(self.fetch_list) + sp.grad_names
+                      + sp.passthrough)
+        out = self.exe.run(program=sp.head, feed=feed,
+                           fetch_list=head_fetch)
+        nf = len(self.fetch_list)
+        ng = len(sp.grad_names)
+        fetches = out[:nf]
+        grads = dict(zip(sp.grad_names, out[nf:nf + ng]))
+        passthrough = dict(zip(sp.passthrough, out[nf + ng:]))
+        reduced = self._exchange.allreduce(self.epoch, step, grads,
+                                           sp.pre_scale)
+        tail_feed = dict(passthrough)
+        tail_feed.update(reduced)
+        self.exe.run(program=sp.tail, feed=tail_feed, fetch_list=[])
+        return fetches
+
+    def _maybe_checkpoint(self):
+        if not self._is_leader() \
+                or (self.step + 1) % self.ckpt_every != 0:
+            return
+        _ckpt.save_checkpoint(
+            self.exe, self.ckpt_dir, main_program=self.train_prog,
+            step=self.step,
+            state={"step": self.step, "extra": self.state},
+            retain=self.retain, all_ranks=True,
+            topology=self._topology())
+
+    # -- recovery -------------------------------------------------------
+
+    def _recover(self, err):
+        t0 = time.perf_counter()
+        lost = sorted(set(int(r) for r in err.ranks)
+                      & set(self.members))
+        if not lost:
+            raise err  # a loss verdict naming no current member
+        survivors = [m for m in self.members if m not in lost]
+        if not survivors or self.rank not in survivors:
+            raise ElasticEvictedError(
+                "rank %d was declared lost (%s) — exiting"
+                % (self.rank, err))
+        membership = agree_membership(
+            self.hb_dir, self.rank, self.epoch + 1, survivors, lost,
+            reason=str(err), stale_timeout=self.stale_timeout,
+            timeout=self.wedge_timeout)
+        self._adopt_membership(membership)
+        self._plan()
+        self._restore(recovery=True)
+        self._recovering_since = t0
+        _faults.set_step(self.step)
+
+    def _after_step(self):
+        if self._recovering_since is not None:
+            from ..observability import runtime as _obs
+
+            _obs.record_elastic_recovery(
+                self.epoch, self.step, self.world,
+                (time.perf_counter() - self._recovering_since)
+                * 1000.0)
+            self._recovering_since = None
+
+    # -- entry point ----------------------------------------------------
+
+    def run(self, total_steps, make_feed, on_step=None):
+        """Train ``total_steps`` steps, recovering from worker loss
+        in-process.  ``on_step(step, fetches, trainer)`` observes each
+        completed step.  Returns the final step count."""
+        membership = Membership(
+            epoch=self.epoch, members=list(self.members),
+            world=len(self.members), lost=[], writer=self.rank)
+        self._hb = HeartbeatWriter(self.hb_dir, self.rank,
+                                   interval=self.hb_interval).start()
+        try:
+            self._adopt_membership(membership)
+            startup = self._plan()
+            if startup is not None:
+                self.exe.run(program=startup)
+            self._restore(recovery=False)
+            while self.step < int(total_steps):
+                try:
+                    fetches = self._run_step(make_feed)
+                except WorkerLostError as e:
+                    self._recover(e)
+                    continue
+                self._after_step()
+                self._maybe_checkpoint()
+                if on_step is not None:
+                    on_step(self.step, fetches, self)
+                self.step += 1
+            return self.step
+        finally:
+            self._hb.stop()

@@ -1,0 +1,178 @@
+"""Checkpoint resharding: remap a saved version from one cluster
+topology to another without losing a byte of state.
+
+A checkpoint saved at world size N lays row-sharded tables (ZeRO-1
+optimizer moments, distributed embedding tables) out as N contiguous
+row-range files under ``<var>.shards/``.  Restoring that version at a
+different world size would either fail the topology check
+(:class:`~paddle_tpu.resilience.checkpoint.TopologyMismatchError`) or,
+on a multi-host layout, silently read misshapen slices.  This module
+rewrites the version *in place* for a new world size:
+
+* plain (replicated) ``.npy`` vars and ``state.json`` are copied
+  verbatim — replication is topology-independent;
+* each ``.shards`` dir is assembled to the full global array (via the
+  same overlap reader the loader uses, so arbitrary old layouts work),
+  re-sliced into the new world's contiguous row ranges, and written
+  back with a fresh ``meta.json``;
+* a new ``MANIFEST.json`` records the new topology plus re-checksummed
+  files, and the old version dir is replaced with the save-aside idiom
+  from :mod:`~paddle_tpu.resilience.checkpoint` — the old data is never
+  destroyed before the new version is fully in place.
+
+The transformation is gather-then-scatter by construction, so the
+round-trip tests can hold it to a bit-exact standard.
+"""
+
+import json
+import os
+import shutil
+import time
+
+import numpy as np
+
+from . import checkpoint as _ckpt
+from . import retry as _retry
+from .atomic import atomic_write
+
+__all__ = ["shard_bounds", "reshard_checkpoint"]
+
+_META_NAME = "meta.json"
+_SHARDS_SUFFIX = ".shards"
+
+
+def shard_bounds(nrows, world):
+    """Contiguous ``[(start, stop)]`` row ranges splitting ``nrows`` over
+    ``world`` members — equal chunks when divisible, otherwise the first
+    ``nrows % world`` members take one extra row (``np.array_split``
+    order, matching the executor's optimizer-state partitioner)."""
+    nrows, world = int(nrows), int(world)
+    if world < 1:
+        raise ValueError("world must be >= 1, got %d" % world)
+    sizes = [len(c) for c in np.array_split(np.arange(nrows), world)]
+    bounds, start = [], 0
+    for size in sizes:
+        bounds.append((start, start + size))
+        start += size
+    return bounds
+
+
+def _reshard_shard_dir(src, dst, new_world, report):
+    """Reassemble one var's shard dir and re-slice its rows for
+    ``new_world``.  Raises (via the overlap reader) on gaps or missing
+    files — resharding must never paper over a torn source."""
+    from .. import io as _io
+
+    meta_path = os.path.join(src, _META_NAME)
+    with open(meta_path) as f:
+        meta = json.load(f)
+    shape = tuple(meta["shape"])
+    name = os.path.basename(src)[:-len(_SHARDS_SUFFIX)]
+    entries = _io._shard_entries(src, meta)
+    full = _io._read_sharded_region(
+        entries, meta, tuple((0, d) for d in shape), name)
+    os.makedirs(dst)
+    rest = tuple((0, d) for d in shape[1:])
+    new_files = []
+    for start, stop in shard_bounds(shape[0] if shape else 0, new_world):
+        if start == stop:
+            # more members than rows: the extra members simply hold no
+            # slice of this var (the loader assembles from whoever does)
+            continue
+        bounds = ((start, stop),) + rest
+        fname = _io._shard_fname(bounds)
+        new_files.append(fname)
+        _io._atomic_np_save(os.path.join(dst, fname), full[start:stop])
+    atomic_write(
+        os.path.join(dst, _META_NAME),
+        lambda f: json.dump({"shape": list(shape),
+                             "dtype": str(meta["dtype"]),
+                             "files": sorted(new_files)}, f),
+        text=True)
+    report.append({"var": name, "shape": list(shape),
+                   "old_files": len(entries), "new_files": len(new_files)})
+
+
+def _reshard_tree(src, dst, new_world, report):
+    os.makedirs(dst, exist_ok=True)
+    for name in sorted(os.listdir(src)):
+        s, d = os.path.join(src, name), os.path.join(dst, name)
+        if os.path.isdir(s):
+            if name.endswith(_SHARDS_SUFFIX) \
+                    and os.path.exists(os.path.join(s, _META_NAME)):
+                _reshard_shard_dir(s, d, new_world, report)
+            else:
+                _reshard_tree(s, d, new_world, report)
+        else:
+            shutil.copy2(s, d)
+
+
+def reshard_checkpoint(path, new_topology, policy=None):
+    """Rewrite version dir ``path`` in place for ``new_topology`` (a
+    manifest-style dict; ``new_topology["world"]`` drives the row
+    re-slicing).  Returns a report list — one entry per resharded var —
+    and journals an urgent ``reshard`` event.  The source is verified
+    first and replaced atomically; a failure at any point leaves the
+    original version untouched."""
+    path = os.path.normpath(path)
+    root = os.path.dirname(path)
+    manifest = _ckpt.verify_checkpoint(path)
+    step = int(manifest.get("step", _ckpt._parse_step(path) or 0))
+    old_topo = manifest.get("topology")
+    new_topo = dict(new_topology or {})
+    new_world = int(new_topo.get("world", 1))
+    if new_world < 1:
+        raise ValueError(
+            "new topology needs world >= 1, got %r" % (new_topo,))
+    t0 = time.perf_counter()
+
+    def _attempt():
+        tmp = os.path.join(root, ".tmp-%08d-%d" % (step, os.getpid()))
+        shutil.rmtree(tmp, ignore_errors=True)
+        report = []
+        try:
+            os.makedirs(tmp)
+            for name in sorted(os.listdir(path)):
+                if name == _ckpt.MANIFEST_NAME:
+                    continue  # regenerated below with fresh checksums
+                s, d = os.path.join(path, name), os.path.join(tmp, name)
+                if os.path.isdir(s):
+                    if name.endswith(_SHARDS_SUFFIX) \
+                            and os.path.exists(os.path.join(s, _META_NAME)):
+                        _reshard_shard_dir(s, d, new_world, report)
+                    else:
+                        _reshard_tree(s, d, new_world, report)
+                else:
+                    shutil.copy2(s, d)
+            files = {}
+            for rel, full in _ckpt._walk_files(tmp):
+                files[rel] = {"sha256": _ckpt._file_sha256(full),
+                              "size": os.path.getsize(full)}
+            new_manifest = dict(manifest)
+            new_manifest["files"] = files
+            new_manifest["topology"] = new_topo
+            new_manifest["wall_time"] = time.time()
+            if old_topo:
+                new_manifest["resharded_from"] = dict(old_topo)
+            atomic_write(
+                os.path.join(tmp, _ckpt.MANIFEST_NAME),
+                lambda f: json.dump(new_manifest, f, indent=1), text=True)
+            aside = os.path.join(
+                root, ".old-%08d-%d" % (step, os.getpid()))
+            shutil.rmtree(aside, ignore_errors=True)
+            os.rename(path, aside)
+            os.rename(tmp, path)
+            shutil.rmtree(aside, ignore_errors=True)
+            return report
+        except BaseException:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+
+    report = _retry.retry_call(
+        _attempt, policy=policy, site="reshard_checkpoint(step=%d)" % step)
+    from ..observability import runtime as _obs
+
+    _obs.record_reshard(
+        step, (old_topo or {}).get("world"), new_world, len(report),
+        (time.perf_counter() - t0) * 1000.0, path)
+    return report

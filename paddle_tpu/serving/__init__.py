@@ -11,15 +11,16 @@ from .buckets import (BUCKET_CAP_ENV, BUCKETS_ENV, DEFAULT_BUCKETS,
                       ShapeBuckets, bucket_cap, derive_buckets,
                       parse_buckets, resolve_buckets)
 from .loadgen import make_feed_sampler, percentile, run_load
-from .server import (DeadlineExceededError, PredictorServer,
-                     QueueFullError, Request, ServerClosedError,
-                     ServingError)
+from .server import (DeadlineExceededError, DispatcherCrashedError,
+                     PredictorServer, QueueFullError, Request,
+                     ServerClosedError, ServingError)
 
 __all__ = [
     "BUCKETS_ENV",
     "BUCKET_CAP_ENV",
     "DEFAULT_BUCKETS",
     "DeadlineExceededError",
+    "DispatcherCrashedError",
     "PredictorServer",
     "QueueFullError",
     "Request",

@@ -37,6 +37,12 @@ deadlines with priority eviction (a request that can no longer meet its
 deadline — ``now + EMA(batch service time) > deadline`` — is shed at
 batch formation rather than poisoning the batch), and backpressure (a
 bounded queue that rejects with :class:`QueueFullError`).
+
+Failure contract: a batch that fails dispatch or materialization fails
+only its own requests; if the dispatcher THREAD itself dies, every
+pending request is failed with :class:`DispatcherCrashedError`, the
+crash is journaled urgent (``dispatcher-died``), and the server stays
+dead — no client ever blocks forever on :meth:`Request.result`.
 """
 
 import itertools
@@ -52,6 +58,7 @@ from .buckets import ShapeBuckets
 
 __all__ = [
     "DeadlineExceededError",
+    "DispatcherCrashedError",
     "PredictorServer",
     "QueueFullError",
     "Request",
@@ -74,6 +81,16 @@ class ServerClosedError(ServingError):
 
 class DeadlineExceededError(ServingError):
     """The request was shed: it could no longer meet its SLA deadline."""
+
+
+class DispatcherCrashedError(ServingError):
+    """The dispatcher thread died outside the per-batch guards.
+
+    Every pending request (queued and in-flight) is failed with this
+    error — a client blocked in :meth:`Request.result` gets a typed
+    verdict, never a silent hang — and the server is dead: subsequent
+    :meth:`PredictorServer.submit` calls raise it too.  The crash is
+    journaled urgent as ``dispatcher-died``."""
 
 
 class Request:
@@ -191,6 +208,7 @@ class PredictorServer:
         self._cond = threading.Condition()
         self._running = False
         self._closed = False
+        self._crashed = None
         self._thread = None
         self._inflight = []          # owned by the dispatcher thread
         self.dispatch_log = []       # (tenant, bucket, rows) — bounded
@@ -334,6 +352,10 @@ class PredictorServer:
                     if sla_ms is not None else None)
         req = Request(rid, tenant, feed, rows, deadline, sig, seq)
         with self._cond:
+            if self._crashed is not None:
+                raise DispatcherCrashedError(
+                    "server is dead: dispatcher crashed (%s: %s)"
+                    % (type(self._crashed).__name__, self._crashed))
             if self._closed:
                 raise ServerClosedError("server is closed")
             depth = sum(len(x.queue) for x in self._tenants.values())
@@ -356,6 +378,10 @@ class PredictorServer:
 
     def start(self):
         with self._cond:
+            if self._crashed is not None:
+                raise DispatcherCrashedError(
+                    "server is dead: dispatcher crashed (%s: %s)"
+                    % (type(self._crashed).__name__, self._crashed))
             if self._closed:
                 raise ServerClosedError("server is closed")
             if self._running:
@@ -387,6 +413,41 @@ class PredictorServer:
         return any(t.queue for t in self._tenants.values())
 
     def _loop(self):
+        try:
+            self._dispatch_loop()
+        except Exception as exc:  # noqa: BLE001 — last-resort net: the
+            # per-batch guards in _dispatch_loop already contain
+            # request-attributable failures; anything landing here is a
+            # dispatcher bug and must not strand blocked clients
+            self._dispatcher_crashed(exc)
+
+    def _dispatcher_crashed(self, exc):
+        with self._cond:
+            self._crashed = exc
+            self._closed = True
+            self._running = False
+            pending = []
+            for t in self._tenants.values():
+                pending.extend(t.queue)
+                t.queue = []
+            self._cond.notify_all()
+        for entry in self._inflight:
+            pending.extend(entry.requests)
+        self._inflight = []
+        err = DispatcherCrashedError(
+            "serving dispatcher thread crashed: %s: %s"
+            % (type(exc).__name__, exc))
+        err.__cause__ = exc
+        to_fail = [r for r in pending if not r.done()]
+        # journal + count BEFORE unblocking clients: whoever observes
+        # the typed error can rely on the incident being on disk
+        self._count("failed", len(to_fail))
+        _obs.record_dispatcher_died(
+            "%s: %s" % (type(exc).__name__, exc), len(to_fail))
+        for r in to_fail:
+            r._fail(err)
+
+    def _dispatch_loop(self):
         while True:
             picked = None
             with self._cond:

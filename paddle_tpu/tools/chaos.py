@@ -27,6 +27,15 @@ Exit status: 0 = recovered and matched; 1 = survived but diverged;
 This is the executable form of the ISSUE-2 acceptance scenario — CI runs
 it with the spec above; any spec drawn from the
 ``PADDLE_TPU_FAULT_SPEC`` grammar works.
+
+``--elastic`` runs the ISSUE-12 acceptance scenario instead: an
+elastic cluster of ``--elastic-world`` workers trains a shared global
+batch, one worker is killed mid-run, and the survivors must re-plan,
+reshard and resume IN-PROCESS at the shrunk world size — no restart.
+The post-recovery loss curve is diffed against a same-seed oracle run
+uninterrupted at the shrunk world size (exit 1 beyond ``--tolerance``),
+and the journal must show the
+``worker-lost → replan → reshard → resume`` incident chain.
 """
 
 import argparse
@@ -182,6 +191,299 @@ def _oracle_digest(steps, skip_steps):
         return _param_digest(fluid.global_scope(), main)
 
 
+# elastic drill: a constant GLOBAL batch sliced by membership index —
+# divisible by both the full and the shrunk world, so the global
+# gradient (sum of member means / world) is identical at every world
+# size and the shrunk-world oracle is comparable within fp tolerance
+_GLOBAL_BATCH = 24
+
+
+def _elastic_batches(steps):
+    import numpy as np
+
+    rng = np.random.RandomState(_DATA_SEED)
+    out = []
+    for _ in range(steps):
+        xb = rng.randn(_GLOBAL_BATCH, _FEATS).astype("float32")
+        yb = (xb.sum(axis=1, keepdims=True)
+              + 0.1 * rng.randn(_GLOBAL_BATCH, 1)).astype("float32")
+        out.append((xb, yb))
+    return out
+
+
+def _elastic_feed(batches):
+    def make_feed(step, index, world):
+        xb, yb = batches[step]
+        n = xb.shape[0] // world
+        sl = slice(index * n, (index + 1) * n)
+        return {"x": xb[sl], "y": yb[sl]}
+    return make_feed
+
+
+def _run_elastic_worker(args):
+    """One elastic cluster member: the ElasticTrainer owns the loop —
+    worker loss is recovered in here, never by a process restart."""
+    import warnings
+
+    import numpy as np
+
+    _force_cpu()
+    import paddle_tpu as fluid
+    from paddle_tpu.resilience import elastic
+
+    main, startup, loss = _build_model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    batches = _elastic_batches(args.steps)
+
+    def on_step(step, fetches, trainer):
+        print("ELASTIC_STEP %d rank=%d index=%d world=%d epoch=%d "
+              "loss=%.8f"
+              % (step, trainer.rank, trainer.index, trainer.world,
+                 trainer.epoch,
+                 float(np.asarray(fetches[0]).reshape(()))), flush=True)
+
+    trainer = elastic.ElasticTrainer(
+        main, startup, exe, rank=args.rank, world=args.world,
+        workdir=args.ckpt_dir, fetch_list=[loss.name],
+        batch_size=_GLOBAL_BATCH, ckpt_every=1,
+        stale_timeout=args.stale_timeout,
+        wedge_timeout=args.worker_timeout)
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            trainer.run(args.steps, _elastic_feed(batches), on_step)
+    except elastic.ElasticEvictedError as e:
+        print("ELASTIC_EVICTED %s" % e, flush=True)
+        return elastic.ELASTIC_EVICTED_EXIT_CODE
+    digest = _param_digest(fluid.global_scope(), trainer.train_prog)
+    print("ELASTIC_FINAL rank=%d params_sha=%s world=%d epoch=%d"
+          % (trainer.rank, digest, trainer.world, trainer.epoch),
+          flush=True)
+    print("ELASTIC_OK", flush=True)
+    return 0
+
+
+def _elastic_oracle(steps, world):
+    """Uninterrupted same-seed trajectory at the shrunk world size,
+    simulated in one process through the SAME plan/split/reduce helpers
+    the distributed workers run — per-step, per-member losses."""
+    import warnings
+
+    _force_cpu()
+    import numpy as np
+    import paddle_tpu as fluid
+    from paddle_tpu.executor import Scope, scope_guard
+    from paddle_tpu.resilience import elastic, faults
+
+    faults.set_fault_spec("")
+    main, startup, loss = _build_model()
+    exe = fluid.Executor(fluid.CPUPlace())
+    batches = _elastic_batches(steps)
+    make_feed = _elastic_feed(batches)
+    per_step = []
+    with scope_guard(Scope()), warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        prog, st, split, _result, _applied = elastic.plan_world(
+            main, startup, world, batch_size=_GLOBAL_BATCH)
+        exe.run(program=st if st is not None else startup)
+        for k in range(steps):
+            if split is None:
+                out = exe.run(program=prog, feed=make_feed(k, 0, 1),
+                              fetch_list=[loss.name])
+                per_step.append(
+                    [float(np.asarray(out[0]).reshape(()))])
+                continue
+            ng = len(split.grad_names)
+            per_member, member_losses, passthrough = [], [], {}
+            for idx in range(world):
+                out = exe.run(
+                    program=split.head, feed=make_feed(k, idx, world),
+                    fetch_list=[loss.name] + split.grad_names
+                    + split.passthrough)
+                member_losses.append(
+                    float(np.asarray(out[0]).reshape(())))
+                per_member.append(
+                    dict(zip(split.grad_names, out[1:1 + ng])))
+                if idx == 0:
+                    passthrough = dict(zip(split.passthrough,
+                                           out[1 + ng:]))
+            reduced = elastic.reduce_gradients(per_member,
+                                               split.pre_scale)
+            feed = dict(passthrough)
+            feed.update(reduced)
+            exe.run(program=split.tail, feed=feed, fetch_list=[])
+            per_step.append(member_losses)
+    return per_step
+
+
+def _parse_elastic_output(text):
+    """{step: (index, world, epoch, loss)} plus final/evicted flags."""
+    steps = {}
+    final = None
+    for line in text.splitlines():
+        if line.startswith("ELASTIC_STEP "):
+            parts = line.split()
+            k = int(parts[1])
+            kv = dict(p.split("=") for p in parts[2:])
+            steps[k] = (int(kv["index"]), int(kv["world"]),
+                        int(kv["epoch"]), float(kv["loss"]))
+        elif line.startswith("ELASTIC_FINAL "):
+            parts = line.split()
+            kv = dict(p.split("=") for p in parts[1:])
+            final = kv
+    return steps, final
+
+
+def _run_elastic_driver(args):
+    """Spawn the elastic cluster, kill one worker, verify the survivors
+    recover in-process and track the shrunk-world oracle."""
+    import subprocess as sp
+
+    from paddle_tpu.resilience.faults import KILL_EXIT_CODE
+
+    world = args.elastic_world
+    kill_rank = world - 1 if args.kill_rank is None else args.kill_rank
+    workdir = args.ckpt_dir or tempfile.mkdtemp(
+        prefix="paddle_tpu_elastic_")
+    os.makedirs(workdir, exist_ok=True)
+    telemetry_dir = args.telemetry_dir \
+        or os.path.join(workdir, "telemetry")
+    print("chaos[elastic]: world=%d kill rank %d at step %d, %d steps, "
+          "workdir=%s" % (world, kill_rank, args.kill_step, args.steps,
+                          workdir), flush=True)
+
+    procs, logs = [], []
+    for rank in range(world):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = env.get("JAX_PLATFORMS", "cpu")
+        env["PADDLE_TPU_TELEMETRY_DIR"] = telemetry_dir
+        env.pop("PADDLE_TPU_FAULT_SPEC", None)
+        env.pop("PADDLE_TPU_NAN_GUARD", None)
+        if rank == kill_rank:
+            env["PADDLE_TPU_FAULT_SPEC"] = (
+                "worker_kill@step=%d" % args.kill_step)
+            env["PADDLE_TPU_FAULT_STATE_FILE"] = os.path.join(
+                workdir, "fault_state_r%d.json" % rank)
+        cmd = [sys.executable, "-m", "paddle_tpu.tools.chaos",
+               "--elastic-worker", "--rank", str(rank),
+               "--world", str(world), "--steps", str(args.steps),
+               "--ckpt-dir", workdir,
+               "--stale-timeout", str(args.stale_timeout),
+               "--worker-timeout", str(args.worker_timeout)]
+        logf = open(os.path.join(workdir, "worker-r%d.log" % rank),
+                    "w+")
+        logs.append(logf)
+        procs.append(sp.Popen(cmd, env=env, stdout=logf,
+                              stderr=sp.STDOUT))
+
+    deadline = time.time() + args.worker_timeout
+    while any(p.poll() is None for p in procs) \
+            and time.time() < deadline:
+        time.sleep(0.2)
+    hung = [r for r, p in enumerate(procs) if p.poll() is None]
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+            p.wait()
+    outputs = []
+    for logf in logs:
+        logf.seek(0)
+        outputs.append(logf.read())
+        logf.close()
+    rcs = [p.returncode for p in procs]
+    print("chaos[elastic]: exit codes %s%s"
+          % (rcs, " (killed hung: %s)" % hung if hung else ""),
+          flush=True)
+    if hung:
+        print("chaos[elastic]: FAIL — worker(s) %s hung past %.0fs; "
+              "rank 0 tail:\n%s" % (hung, args.worker_timeout,
+                                    outputs[0][-2000:]), flush=True)
+        return 2
+    if rcs[kill_rank] != KILL_EXIT_CODE:
+        print("chaos[elastic]: FAIL — victim rank %d exited %s, "
+              "expected the injected kill (%d)"
+              % (kill_rank, rcs[kill_rank], KILL_EXIT_CODE), flush=True)
+        return 2
+    survivors = [r for r in range(world) if r != kill_rank]
+    bad = [r for r in survivors if rcs[r] != 0]
+    if bad:
+        print("chaos[elastic]: FAIL — survivor(s) %s exited nonzero; "
+              "rank %d tail:\n%s"
+              % (bad, bad[0], outputs[bad[0]][-3000:]), flush=True)
+        return 2
+
+    shrunk = world - 1
+    parsed = {r: _parse_elastic_output(outputs[r]) for r in survivors}
+    for r in survivors:
+        steps_seen, final = parsed[r]
+        missing = [k for k in range(args.steps) if k not in steps_seen]
+        if missing or final is None:
+            print("chaos[elastic]: FAIL — rank %d missed steps %s "
+                  "(in-process resume must cover every step)"
+                  % (r, missing), flush=True)
+            return 2
+        post = [k for k, (_i, w, _e, _l) in steps_seen.items()
+                if w == shrunk]
+        if not post or min(post) > args.kill_step:
+            print("chaos[elastic]: FAIL — rank %d never re-ran step "
+                  "%d at world %d (post-recovery steps: %s)"
+                  % (r, args.kill_step, shrunk, sorted(post)),
+                  flush=True)
+            return 2
+    digests = {parsed[r][1]["params_sha"] for r in survivors}
+    if len(digests) != 1:
+        print("chaos[elastic]: FAIL — survivors ended on different "
+              "params: %s" % sorted(digests), flush=True)
+        return 1
+    print("chaos[elastic]: survivors recovered in-process at world=%d "
+          "(one log per rank — no restarts) and agree on params %s"
+          % (shrunk, next(iter(digests))[:16]), flush=True)
+
+    # the oracle is bookkeeping: keep it out of the workers' telemetry
+    from paddle_tpu.observability import metrics as _metrics
+
+    _metrics.set_telemetry_enabled(False)
+    try:
+        oracle = _elastic_oracle(args.steps, shrunk)
+    finally:
+        _metrics.set_telemetry_enabled(None)
+    worst = 0.0
+    for r in survivors:
+        steps_seen, _final = parsed[r]
+        for k, (index, w, _epoch, lv) in sorted(steps_seen.items()):
+            if w != shrunk:
+                continue  # pre-kill steps ran at the full world
+            want = oracle[k][index]
+            rel = abs(lv - want) / max(abs(want), 1e-6)
+            worst = max(worst, rel)
+            if rel > args.tolerance:
+                print("chaos[elastic]: FAIL — rank %d step %d loss "
+                      "%.8f vs shrunk-world oracle %.8f (rel %.2e > "
+                      "%.2e)" % (r, k, lv, want, rel, args.tolerance),
+                      flush=True)
+                return 1
+    print("chaos[elastic]: post-recovery loss curve tracks the "
+          "world-%d oracle (worst rel err %.2e <= %.2e)"
+          % (shrunk, worst, args.tolerance), flush=True)
+
+    from paddle_tpu.observability.journal import read_journal
+
+    kinds = {e.get("kind") for e in read_journal(telemetry_dir)}
+    chain = ["worker-lost", "replan", "reshard", "checkpoint-loaded",
+             "resume"]
+    gone = [k for k in chain if k not in kinds]
+    if gone:
+        print("chaos[elastic]: FAIL — journal is missing incident "
+              "events %s (have %s)" % (gone, sorted(kinds)), flush=True)
+        return 1
+    print("chaos[elastic]: journal shows the full incident chain "
+          "%s — view it with: python -m paddle_tpu.tools.monitor "
+          "--once %s" % (" -> ".join(chain), telemetry_dir),
+          flush=True)
+    print("chaos[elastic]: PASS", flush=True)
+    return 0
+
+
 def _parse_worker_output(text, losses, skipped):
     final = None
     resumed = []
@@ -328,11 +630,40 @@ def main(argv=None):
     parser.add_argument("--worker-timeout", type=float, default=300.0,
                         help="seconds per worker incarnation (bounds "
                              "injected hangs)")
+    parser.add_argument("--elastic", action="store_true",
+                        help="run the elastic drill instead: kill one "
+                             "of --elastic-world workers mid-run and "
+                             "demand an in-process re-plan/reshard/"
+                             "resume at the shrunk world size")
+    parser.add_argument("--elastic-world", type=int, default=3,
+                        help="elastic cluster size before the kill")
+    parser.add_argument("--kill-step", type=int, default=3,
+                        help="step at which the victim is killed")
+    parser.add_argument("--kill-rank", type=int, default=None,
+                        help="victim rank (default: highest rank, so "
+                             "the leader path stays exercised; pick 0 "
+                             "to drill a leader loss)")
+    parser.add_argument("--tolerance", type=float, default=0.02,
+                        help="max relative loss error vs the "
+                             "shrunk-world oracle")
+    parser.add_argument("--stale-timeout", type=float, default=2.0,
+                        help="seconds without a heartbeat before a "
+                             "peer is declared lost")
     parser.add_argument("--worker", action="store_true",
+                        help=argparse.SUPPRESS)
+    parser.add_argument("--elastic-worker", action="store_true",
+                        help=argparse.SUPPRESS)
+    parser.add_argument("--rank", type=int, default=0,
+                        help=argparse.SUPPRESS)
+    parser.add_argument("--world", type=int, default=1,
                         help=argparse.SUPPRESS)
     args = parser.parse_args(argv)
     if args.worker:
         return _run_worker(args)
+    if args.elastic_worker:
+        return _run_elastic_worker(args)
+    if args.elastic:
+        return _run_elastic_driver(args)
     return _run_driver(args)
 
 

@@ -44,10 +44,13 @@ _ALERT_RE = re.compile(
     r"^\s*([A-Za-z_][\w.]*)\s*(>=|<=|==|!=|>|<)\s*(-?[\d.]+)\s*$")
 
 # the journal kinds an incident reads as a story, in the order the
-# chaos acceptance scenario expects them: fault -> skip -> restore
-# (race-detected: a concurrency gate tripped before dispatch)
+# chaos acceptance scenarios expect them: fault -> skip -> restore, and
+# the elastic chain worker-lost -> replan -> reshard -> resume
+# (race-detected: a concurrency gate tripped before dispatch;
+# dispatcher-died: the serving dispatch thread crashed)
 _SEQUENCE_KINDS = ("fault-injected", "guard-skip", "race-detected",
-                   "worker-lost", "checkpoint-saved",
+                   "dispatcher-died", "worker-lost", "replan",
+                   "reshard", "checkpoint-saved",
                    "checkpoint-loaded", "resume")
 
 
@@ -407,11 +410,20 @@ def render_status(status):
                     rank, state, r["beat_age_s"], _fmt(r["step"]),
                     _fmt(r["step_ms"])))
     if status["sequence"]:
-        tail = status["sequence"][-6:]
+        # collapse consecutive repeats (routine per-step checkpoints)
+        # so they cannot scroll an incident chain out of the window
+        collapsed = []
+        for e in status["sequence"]:
+            if collapsed and collapsed[-1][0]["kind"] == e["kind"]:
+                collapsed[-1] = (e, collapsed[-1][1] + 1)
+            else:
+                collapsed.append((e, 1))
+        tail = collapsed[-8:]
         lines.append("  recent: " + " -> ".join(
-            e["kind"] + ("@%s" % e["step"]
-                         if e.get("step") is not None else "")
-            for e in tail))
+            e["kind"]
+            + ("@%s" % e["step"] if e.get("step") is not None else "")
+            + (" x%d" % n if n > 1 else "")
+            for e, n in tail))
     return "\n".join(lines)
 
 

@@ -269,6 +269,75 @@ class TestScheduling:
 
 
 # ---------------------------------------------------------------------------
+# dispatcher-crash containment
+# ---------------------------------------------------------------------------
+
+class TestDispatcherCrash:
+    def test_crash_fails_pending_journals_and_poisons_submit(
+            self, tmp_path, monkeypatch):
+        from paddle_tpu.observability import journal as oj
+
+        tdir = tmp_path / "tel"
+        monkeypatch.setenv("PADDLE_TPU_TELEMETRY_DIR", str(tdir))
+        obs.reset_telemetry()
+        pred = _predictor(_save_model(tmp_path / "m"))
+        server = serving.PredictorServer({"t": pred}, buckets=(2,),
+                                         auto_start=False)
+
+        def boom():
+            raise RuntimeError("scheduler bug")
+
+        # crash OUTSIDE the per-batch guards: the thread itself dies
+        monkeypatch.setattr(server, "_pick_batch_locked", boom)
+        rng = np.random.RandomState(11)
+        r1 = server.submit("t", {"x": _rows(rng, 1)})
+        r2 = server.submit("t", {"x": _rows(rng, 1)})
+        server.start()
+        # blocked clients get a typed verdict, never a silent hang
+        with pytest.raises(serving.DispatcherCrashedError,
+                           match="scheduler bug"):
+            r1.result(timeout=60)
+        with pytest.raises(serving.DispatcherCrashedError):
+            r2.result(timeout=60)
+        # the server stays dead: submit/start raise the same error
+        with pytest.raises(serving.DispatcherCrashedError):
+            server.submit("t", {"x": _rows(rng, 1)})
+        with pytest.raises(serving.DispatcherCrashedError):
+            server.start()
+        assert server.stats()["failed"] == 2
+        # ... and the crash is journaled urgent as dispatcher-died
+        died = [e for e in oj.read_journal(str(tdir))
+                if e["kind"] == "dispatcher-died"]
+        assert died and died[0]["failed_requests"] == 2
+        assert "scheduler bug" in died[0]["reason"]
+        server.close()
+
+    def test_batch_failure_does_not_kill_the_dispatcher(self, tmp_path):
+        pred = _predictor(_save_model(tmp_path / "m"))
+        server = serving.PredictorServer({"t": pred}, buckets=(2,),
+                                         auto_start=False)
+        orig = pred.run_async
+        calls = []
+
+        def flaky(feed):
+            calls.append(1)
+            if len(calls) == 1:
+                raise RuntimeError("one bad batch")
+            return orig(feed)
+
+        pred.run_async = flaky
+        rng = np.random.RandomState(12)
+        r1 = server.submit("t", {"x": _rows(rng, 2)})
+        server.start()
+        with pytest.raises(RuntimeError, match="one bad batch"):
+            r1.result(timeout=60)
+        # the per-batch guard contained it: the server still serves
+        r2 = server.submit("t", {"x": _rows(rng, 1)})
+        assert r2.result(timeout=60)[0].shape == (1, 3)
+        server.close()
+
+
+# ---------------------------------------------------------------------------
 # enqueue-time validation (satellite 2)
 # ---------------------------------------------------------------------------
 
