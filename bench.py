@@ -746,6 +746,93 @@ def child_observability():
     }), flush=True)
 
 
+def child_tracing():
+    """Tracing overhead A/B (ISSUE 13): the same mnist-shaped MLP train
+    loop with distributed tracing ON (executor.step/dispatch spans,
+    JSONL flushes into a real dir) vs killed via ``PADDLE_TPU_TRACING``
+    — telemetry itself stays ON in both arms so the delta isolates the
+    span layer.  Emits ``tracing_overhead_pct``; the acceptance gate is
+    < 2%.  Min-over-repeats on both arms, same discipline as
+    ``child_observability``."""
+    import shutil
+    import tempfile
+
+    import paddle_tpu as fluid
+    from paddle_tpu.executor import Scope, scope_guard
+    from paddle_tpu.observability import (metrics as _om,
+                                          tracing as _otr,
+                                          reset_telemetry)
+
+    def build():
+        fluid.unique_name.switch()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data(name="img", shape=[784],
+                                    dtype="float32")
+            label = fluid.layers.data(name="label", shape=[1],
+                                      dtype="int64")
+            h = fluid.layers.fc(input=img, size=200, act="relu")
+            h = fluid.layers.fc(input=h, size=200, act="relu")
+            pred = fluid.layers.fc(input=h, size=10, act="softmax")
+            loss = fluid.layers.reduce_mean(
+                fluid.layers.cross_entropy(input=pred, label=label))
+            fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+        return main, startup, loss
+
+    rng = np.random.RandomState(0)
+    feed = {"img": rng.rand(64, 784).astype("float32"),
+            "label": rng.randint(0, 10, (64, 1)).astype("int64")}
+    warmup, steps, repeats = 10, 200, 7
+    tdir = tempfile.mkdtemp(prefix="paddle_tpu_trace_bench_")
+    times = {"on": None, "off": None}
+    os.environ["PADDLE_TPU_DRIFT_RECORD"] = "0"
+    os.environ["PADDLE_TPU_TELEMETRY_DIR"] = tdir
+    reset_telemetry()
+    try:
+        # ONE build/compile; the arms toggle only the tracing kill
+        # switch over interleaved windows of the same jitted step
+        _om.set_telemetry_enabled(True)
+        main, startup, loss = build()
+        exe = fluid.Executor()
+        with scope_guard(Scope()):
+            exe.run(startup)
+            lv = exe.run(main, feed=feed, fetch_list=[loss.name])[0]
+            assert np.isfinite(lv).all()
+            for _ in range(warmup):
+                exe.run(main, feed=feed, fetch_list=[])
+            for rep in range(repeats):
+                # alternate which arm goes first so frequency drift /
+                # cache-warming bias doesn't systematically charge one
+                order = ("on", "off") if rep % 2 == 0 else ("off", "on")
+                for arm in order:
+                    _otr.set_tracing_enabled(arm == "on")
+                    t0 = time.perf_counter()
+                    for _ in range(steps):
+                        exe.run(main, feed=feed, fetch_list=[])
+                    t = time.perf_counter() - t0
+                    if times[arm] is None or t < times[arm]:
+                        times[arm] = t
+    finally:
+        _otr.set_tracing_enabled(None)
+        _om.set_telemetry_enabled(None)
+        reset_telemetry()
+        os.environ.pop("PADDLE_TPU_TELEMETRY_DIR", None)
+        os.environ.pop("PADDLE_TPU_DRIFT_RECORD", None)
+        shutil.rmtree(tdir, ignore_errors=True)
+    overhead = ((times["on"] - times["off"]) / times["off"] * 100.0
+                if times["off"] else 0.0)
+    dev = "cpu" if os.environ.get("PADDLE_BENCH_FORCE_CPU") else \
+        jax_backend_name()
+    print(json.dumps({
+        "metric": "tracing_overhead_pct",
+        "value": round(overhead, 3),
+        "unit": "%% step-time delta, tracing on vs off (%d steps x%d "
+                "min, %s; gate < 2)" % (steps, repeats, dev),
+        "on_s": round(times["on"], 4),
+        "off_s": round(times["off"], 4),
+    }), flush=True)
+
+
 def child_kernels():
     """Kernel-gap A/Bs (ISSUE 6): (1) the conv+BN+act fusion family on
     the ResNet trainer — same program with the family cost-gated off vs
@@ -1719,8 +1806,8 @@ def main():
         plan = [("bert", 420), ("ctr", 160), ("resnet", 340),
                 ("bert512", 270), ("infer", 220), ("bert_infer", 200),
                 ("fusion", 150), ("kernels", 220), ("planner", 220),
-                ("observability", 150), ("serving", 200),
-                ("elastic", 240)]
+                ("observability", 150), ("tracing", 150),
+                ("serving", 200), ("elastic", 240)]
         failed = []
         for mode, cap in plan:
             if remaining(cap) < 90:
@@ -1781,7 +1868,7 @@ def main():
         print("# TPU unavailable: %s — emitting CPU smoke + captured "
               "hardware lines (if any)" % reason, flush=True)
         for mode in ("ctr", "bert", "fusion", "kernels", "planner",
-                     "observability", "serving", "elastic"):
+                     "observability", "tracing", "serving", "elastic"):
             env_extra = {"PADDLE_BENCH_FORCE_CPU": "1"}
             if mode == "planner":
                 # the CPU smoke needs a virtual mesh for a real DP A/B
@@ -1856,6 +1943,8 @@ if __name__ == "__main__":
             child_fusion()
         elif mode == "observability":
             child_observability()
+        elif mode == "tracing":
+            child_tracing()
         elif mode == "kernels":
             child_kernels()
         elif mode == "planner":

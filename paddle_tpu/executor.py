@@ -30,6 +30,7 @@ import numpy as np
 from . import core
 from . import pipeline as _pipeline
 from .observability import runtime as _obs
+from .observability import tracing as _tr
 from .framework import Program, default_main_program, Variable
 from .ops import registry as op_registry
 from .ops.registry import EMPTY_VAR_NAME
@@ -1274,9 +1275,10 @@ class Executor:
                 )
 
             _t_compile = _time.perf_counter()
-            with _prof.record_event("executor.lower_and_jit"):
-                compiled = _rretry.retry_call(_compile,
-                                              site="executor.compile")
+            with _tr.span("executor.compile", step=cur_step):
+                with _prof.record_event("executor.lower_and_jit"):
+                    compiled = _rretry.retry_call(
+                        _compile, site="executor.compile")
             _obs.record_compile(
                 (_time.perf_counter() - _t_compile) * 1000.0)
             if use_program_cache:
@@ -1296,7 +1298,19 @@ class Executor:
         run_ctx = (_prof.record_event("executor.run") if profiling
                    else contextlib.nullcontext())
         _t_step = _time.perf_counter()
-        with run_ctx:
+        # the step span activates on this thread, so the dispatch child
+        # and any host.sync recorded at the fetch point nest under it;
+        # per-ring collective launches ride as attributes (cheap, and a
+        # per-launch span would dwarf the thing it measures).  Steps
+        # inside a trace record fully; standalone loops sample 1-of-N
+        # (the dispatch/sync children gate on the same decision via
+        # span_if_traced — no ambient context when sampled out)
+        step_span = (_tr.span("executor.step", step=cur_step)
+                     if _tr.sample_step(cur_step) else _tr.NULL_SPAN)
+        if step_span.recording:
+            for ring, shape in _obs.collective_step_shape().items():
+                step_span.set_attr(ring, shape)
+        with step_span, run_ctx:
             # dispatch only: under jax async dispatch the jitted call
             # returns once the step is ENQUEUED — the matching
             # device_compute/host_sync phases are recorded at the fetch
@@ -1304,7 +1318,7 @@ class Executor:
             # much host work overlapped the in-flight step
             disp_ctx = (_prof.record_event("executor.dispatch")
                         if profiling else contextlib.nullcontext())
-            with disp_ctx:
+            with _tr.span_if_traced("executor.dispatch"), disp_ctx:
                 fetches, new_rw, fresh = compiled.jitted(
                     feed_vals, rw, ro, base_key)
             _dispatch_ms = (_time.perf_counter() - _t_step) * 1000.0

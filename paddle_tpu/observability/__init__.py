@@ -14,12 +14,16 @@ one low-overhead layer that is simply *on*:
 * :mod:`.exporters` — Prometheus text, JSON snapshot, merged
   host+device chrome trace;
 * :mod:`.runtime` — the one-line hooks the executor, async pipeline,
-  resilience runtime and fusion resolver call.
+  resilience runtime and fusion resolver call;
+* :mod:`.tracing` — distributed spans (cross-thread / cross-process
+  context propagation, ``PADDLE_TPU_TRACING=0`` kill switch) plus a
+  flight recorder dumped on fatal conditions.
 
-Tail a live run with ``python -m paddle_tpu.tools.monitor <dir>``.
+Tail a live run with ``python -m paddle_tpu.tools.monitor <dir>``;
+reconstruct traces with ``python -m paddle_tpu.tools.trace <dir>``.
 """
 
-from . import drift, exporters, journal, metrics, runtime  # noqa: F401
+from . import drift, exporters, journal, metrics, runtime, tracing  # noqa: F401
 from .drift import (DRIFT_CALIBRATION_FAMILY, DriftMonitor,
                     ProgramDrift, monitor, program_key, reset_drift)
 from .exporters import (export_json, export_prometheus,
@@ -30,6 +34,13 @@ from .metrics import (DEFAULT_LATENCY_BUCKETS_MS, Counter, Gauge,
                       Histogram, MetricsRegistry, counter, gauge,
                       histogram, registry, reset_metrics,
                       set_telemetry_enabled, telemetry_enabled)
+from .tracing import (NULL_SPAN, Span, SpanContext, Tracer,
+                      capture_context, current_span, current_trace_id,
+                      current_traceparent, flight_dump, get_tracer,
+                      read_flight_records, read_traces, reset_tracing,
+                      sample_step, set_rank, set_tracing_enabled, span,
+                      span_if_traced, start_span, step_sample_every,
+                      tracing_enabled, use_context)
 
 __all__ = [
     # metrics
@@ -46,15 +57,23 @@ __all__ = [
     # exporters
     "export_prometheus", "export_json", "write_metrics_snapshot",
     "write_chrome_trace",
+    # tracing
+    "Span", "SpanContext", "Tracer", "NULL_SPAN", "span", "start_span",
+    "span_if_traced", "sample_step", "step_sample_every",
+    "current_span", "current_trace_id", "current_traceparent",
+    "capture_context", "use_context", "get_tracer", "flight_dump",
+    "read_traces", "read_flight_records", "tracing_enabled",
+    "set_tracing_enabled", "set_rank", "reset_tracing",
 ]
 
 
 def reset_telemetry():
     """Full reset — metrics, journal singleton, drift monitor, runtime
-    cross-step state (test isolation)."""
+    cross-step state, tracer singleton (test isolation)."""
     reset_metrics()
     reset_journal()
     reset_drift()
+    reset_tracing()
     runtime.reset_runtime()
 
 

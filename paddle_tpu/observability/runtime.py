@@ -13,6 +13,7 @@ import time
 
 from . import journal as _journal
 from . import metrics as _m
+from . import tracing as _tracing
 from .metrics import telemetry_enabled
 
 __all__ = [
@@ -22,14 +23,29 @@ __all__ = [
     "record_prefetch", "record_guard_step", "record_guard_skip",
     "record_serving_request", "record_serving_reject",
     "record_serving_shed", "record_serving_batch",
-    "record_serving_done", "set_serving_depths",
+    "record_serving_done", "record_serving_queue_wait",
+    "record_serving_sync", "set_serving_depths",
     "set_serving_throughput",
     "record_checkpoint_save", "record_checkpoint_load", "record_retry",
     "record_fault", "record_worker_lost", "record_missed_beat",
     "record_concurrency_check", "record_replan", "record_reshard",
     "record_elastic_recovery", "record_dispatcher_died",
-    "set_collective_schedule", "last_step_info", "reset_runtime",
+    "set_collective_schedule", "collective_step_shape",
+    "last_step_info", "reset_runtime",
 ]
+
+
+def _trace_id(explicit=None):
+    """Trace id to stamp on an urgent journal event: the caller's
+    explicit id, else this thread's active trace (which falls back to
+    the cross-process ``PADDLE_TPU_TRACEPARENT`` parent) — links the
+    monitor's incident sequences to ``tools.trace --id``."""
+    if explicit is not None:
+        return explicit
+    try:
+        return _tracing.current_trace_id()
+    except Exception:  # noqa: BLE001 - correlation must never raise
+        return None
 
 # latest step progress, consumed by the watchdog heartbeat payload so
 # `tools/monitor` can tell a wedged-but-alive rank from a healthy one
@@ -269,6 +285,22 @@ def record_serving_done(tenant, latency_ms):
     _named(_m.histogram, "serving_latency_ms").observe(latency_ms)
 
 
+def record_serving_queue_wait(tenant, wait_ms):
+    """Enqueue→batch-formation wait of one request (the queue_wait
+    span's interval) — the histogram shedding decisions are diagnosed
+    from."""
+    if not telemetry_enabled():
+        return
+    _named(_m.histogram, "serving_queue_wait_ms").observe(wait_ms)
+
+
+def record_serving_sync(tenant, sync_ms):
+    """One batched materialize (the serving.sync span's interval)."""
+    if not telemetry_enabled():
+        return
+    _named(_m.histogram, "serving_sync_ms").observe(sync_ms)
+
+
 def set_serving_depths(queued, inflight):
     if not telemetry_enabled():
         return
@@ -336,11 +368,14 @@ def record_fault(kind, step=None, site=None):
     _journal.emit("fault-injected", fault=kind, step=step, site=site)
 
 
-def record_worker_lost(ranks, reason=""):
+def record_worker_lost(ranks, reason="", trace=None):
     if not telemetry_enabled():
         return
     _m.counter("workers_lost_total").inc(max(len(ranks), 1))
-    _journal.emit("worker-lost", ranks=list(ranks), reason=reason)
+    _journal.emit("worker-lost", ranks=list(ranks), reason=reason,
+                  trace=_trace_id(trace))
+    _tracing.flight_dump("worker-lost: ranks=%s %s" % (list(ranks),
+                                                       reason))
 
 
 def record_replan(epoch, old_world, new_world, plan, duration_ms):
@@ -352,7 +387,7 @@ def record_replan(epoch, old_world, new_world, plan, duration_ms):
     _named(_m.histogram, "elastic_replan_ms").observe(duration_ms)
     _journal.emit("replan", epoch=epoch, old_world=old_world,
                   new_world=new_world, plan=str(plan),
-                  duration_ms=round(duration_ms, 2))
+                  duration_ms=round(duration_ms, 2), trace=_trace_id())
 
 
 def record_reshard(step, old_world, new_world, vars_resharded,
@@ -365,7 +400,7 @@ def record_reshard(step, old_world, new_world, vars_resharded,
     _journal.emit("reshard", step=step, old_world=old_world,
                   new_world=new_world, vars=vars_resharded,
                   duration_ms=round(duration_ms, 2),
-                  path=os.path.basename(str(path)))
+                  path=os.path.basename(str(path)), trace=_trace_id())
 
 
 def record_elastic_recovery(epoch, step, new_world, recovery_ms):
@@ -378,17 +413,19 @@ def record_elastic_recovery(epoch, step, new_world, recovery_ms):
     _named(_m.histogram, "elastic_recovery_ms").observe(recovery_ms)
     _m.gauge("elastic_world_size").set(new_world)
     _journal.emit("resume", epoch=epoch, step=step, world=new_world,
-                  recovery_ms=round(recovery_ms, 2))
+                  recovery_ms=round(recovery_ms, 2), trace=_trace_id())
 
 
-def record_dispatcher_died(reason, failed_requests):
+def record_dispatcher_died(reason, failed_requests, trace=None):
     """The serving dispatcher thread crashed: every pending request was
     failed with a typed error instead of stranding callers."""
     if not telemetry_enabled():
         return
     _named(_m.counter, "serving_dispatcher_crashes_total").inc()
     _journal.emit("dispatcher-died", reason=str(reason)[:200],
-                  failed_requests=int(failed_requests))
+                  failed_requests=int(failed_requests),
+                  trace=_trace_id(trace))
+    _tracing.flight_dump("dispatcher-died: %s" % str(reason)[:200])
 
 
 def record_missed_beat(ranks):
@@ -409,7 +446,8 @@ def record_concurrency_check(races_found, gate, tripped=False):
         _named(lambda n: _m.counter(n), "races_found_total").inc(
             races_found)
         _journal.emit("race-detected", races=int(races_found),
-                      gate=str(gate), tripped=bool(tripped))
+                      gate=str(gate), tripped=bool(tripped),
+                      trace=_trace_id())
 
 
 # ---------------------------------------------------------------------------
@@ -450,6 +488,18 @@ def set_collective_schedule(schedule, drift_key=None):
 
         _drift.monitor().observe_scheduled_ici(total_bytes,
                                                key=drift_key)
+
+
+def collective_step_shape():
+    """The installed schedule's per-ring per-step shape as span attrs:
+    ``{"ring:<label>": "<launches>x/<payload_bytes>B"}`` (empty when no
+    schedule is installed) — what the step span carries so a trace
+    shows each step's collective launches without per-launch spans."""
+    out = {}
+    for launches_c, _payload_c, launches, payload in _collective_per_step:
+        ring = dict(getattr(launches_c, "labels", ())).get("ring", "?")
+        out["ring:%s" % ring] = "%dx/%dB" % (launches, payload)
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -22,6 +22,7 @@ from ..executor import (_CompiledBlock, _apply_step_results,
                         global_scope, promote_readonly_scope_arrays,
                         rng_key)
 from ..observability import runtime as _obs
+from ..observability import tracing as _tr
 from ..framework import Variable, default_main_program
 
 __all__ = ["ParallelExecutor", "SPMDRunner"]
@@ -195,15 +196,23 @@ class SPMDRunner:
         base_key = jax.random.fold_in(rng_key(seed), executor._step)
         executor._step += 1
         _t_step = _time.perf_counter()
-        fetches, new_rw, fresh = compiled.jitted(feed_vals, rw, ro, base_key)
-        _dispatch_ms = (_time.perf_counter() - _t_step) * 1000.0
-        fetches = _apply_step_results(
-            compiled, scope, fetches, new_rw, fresh, fetch_names,
-            host_active, host_grad_fetches, cur_step)
-        result = _finish_fetches(
-            fetches, return_numpy, fetch_names=fetch_names,
-            state_names=(tuple(compiled.rw_names)
-                         + tuple(compiled.fresh_persist)))
+        step_span = (_tr.span("spmd.step", step=cur_step)
+                     if _tr.sample_step(cur_step) else _tr.NULL_SPAN)
+        if step_span.recording:
+            for ring, shape in _obs.collective_step_shape().items():
+                step_span.set_attr(ring, shape)
+        with step_span:
+            with _tr.span_if_traced("spmd.dispatch"):
+                fetches, new_rw, fresh = compiled.jitted(
+                    feed_vals, rw, ro, base_key)
+            _dispatch_ms = (_time.perf_counter() - _t_step) * 1000.0
+            fetches = _apply_step_results(
+                compiled, scope, fetches, new_rw, fresh, fetch_names,
+                host_active, host_grad_fetches, cur_step)
+            result = _finish_fetches(
+                fetches, return_numpy, fetch_names=fetch_names,
+                state_names=(tuple(compiled.rw_names)
+                             + tuple(compiled.fresh_persist)))
         _obs.record_step(
             "spmd", cur_step,
             (_time.perf_counter() - _t_step) * 1000.0,

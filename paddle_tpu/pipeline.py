@@ -115,15 +115,17 @@ def host_values(values):
         return [np.asarray(v) for v in vals]
 
     from . import profiler as _prof
+    from .observability import tracing as _tr
 
     t0 = time.perf_counter()
-    if _prof.is_profiler_enabled():
-        with _prof.record_event("executor.device_compute"):
-            _block_all(dev)
-        with _prof.record_event("executor.host_sync"):
+    with _tr.span_if_traced("host.sync", handles=len(dev)):
+        if _prof.is_profiler_enabled():
+            with _prof.record_event("executor.device_compute"):
+                _block_all(dev)
+            with _prof.record_event("executor.host_sync"):
+                out = _copy_all(vals)
+        else:
             out = _copy_all(vals)
-    else:
-        out = _copy_all(vals)
     wait_ms = (time.perf_counter() - t0) * 1e3
     with _sync_lock:
         _sync_count += 1
@@ -425,9 +427,14 @@ class DeviceFeedPipeline:
         self._active = None
 
     def _spawn(self):
+        from .observability import tracing as _tr
+
         src = self._source() if callable(self._source) else self._source
         q = _queue.Queue(maxsize=max(1, int(self._depth)))
         stop = threading.Event()
+        # the prefetch thread's spans join the CONSUMER's trace: capture
+        # the spawning thread's context here, attach it inside worker()
+        ctx = _tr.capture_context()
 
         def put(item):
             # never block forever on a full queue: an abandoned consumer
@@ -444,12 +451,19 @@ class DeviceFeedPipeline:
 
         def worker():
             try:
-                for item in src:
-                    if stop.is_set():
-                        return
-                    if not put(device_put_feed(item, cache=self._cache)):
-                        return
-                put(_PipeEnd)
+                with _tr.use_context(ctx):
+                    with _tr.span("pipeline.prefetch",
+                                  depth=int(self._depth)) as pspan:
+                        n = 0
+                        for item in src:
+                            if stop.is_set():
+                                return
+                            if not put(device_put_feed(
+                                    item, cache=self._cache)):
+                                return
+                            n += 1
+                        pspan.set_attr("items", n)
+                    put(_PipeEnd)
             except BaseException as exc:  # propagate, never hang
                 put(exc)
 

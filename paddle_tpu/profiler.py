@@ -114,14 +114,20 @@ def _print_summary(sorted_key):
     print()
 
 
-def _write_chrome_trace(path, device_events=None):
+def _write_chrome_trace(path, device_events=None, spans=None):
     """chrome://tracing 'traceEvents' JSON (tools/timeline.py output
     format: X (complete) events with microsecond timestamps).
 
     ``device_events`` — parsed :func:`device_op_events` rows
     ``(op_name, ts_us, dur_us, line_name)`` — render as pid 1 with one
     tid per device line, so the device stream sits next to the host
-    phase events instead of being silently dropped."""
+    phase events instead of being silently dropped.
+
+    ``spans`` — tracing span records — render as per-rank span
+    processes with flow arrows (cross-thread/rank causality), plus a
+    flow arrow from each dispatch-shaped span to the first device op
+    launched after it, so a serving request's span visibly leads to
+    the device ops it ran — ONE file for all three streams."""
     events = []
     with _events_lock:
         evs = list(_events)
@@ -130,10 +136,10 @@ def _write_chrome_trace(path, device_events=None):
             "name": name, "cat": "paddle_tpu", "ph": "X",
             "pid": 0, "tid": tid, "ts": t0, "dur": t1 - t0,
         })
+    line_tids = {}
     if device_events:
         events.append({"name": "process_name", "ph": "M", "pid": 1,
                        "args": {"name": "device"}})
-        line_tids = {}
         for name, ts, dur, line in device_events:
             tid = line_tids.setdefault(line, len(line_tids))
             events.append({
@@ -143,9 +149,47 @@ def _write_chrome_trace(path, device_events=None):
         for line, tid in line_tids.items():
             events.append({"name": "thread_name", "ph": "M", "pid": 1,
                            "tid": tid, "args": {"name": line}})
+    if spans:
+        from .observability.tracing import spans_to_chrome_events
+
+        events.extend(spans_to_chrome_events(spans))
+        if device_events:
+            events.extend(_span_device_flows(spans, device_events,
+                                             line_tids))
     with open(path, "w") as f:
         json.dump({"traceEvents": events,
                    "displayTimeUnit": "ms"}, f)
+
+
+def _span_device_flows(spans, device_events, line_tids):
+    """Best-effort flow arrows dispatch-span → first device op at or
+    after the span's start (both clocks are wall-epoch µs, so 'the op
+    this dispatch launched' is the nearest subsequent event)."""
+    out = []
+    dev = sorted((ts, name, line) for name, ts, dur, line
+                 in device_events)
+    if not dev:
+        return out
+    starts = [d[0] for d in dev]
+    import bisect
+
+    for r in spans:
+        if r.get("ts") is None \
+                or not str(r.get("name", "")).endswith(".dispatch"):
+            continue
+        ts_us = float(r["ts"]) * 1e6
+        i = bisect.bisect_left(starts, ts_us)
+        if i >= len(dev):
+            continue
+        dts, _dname, dline = dev[i]
+        fid = "dev/%s" % r.get("span")
+        out.append({"name": "launch", "cat": "span-device", "ph": "s",
+                    "id": fid, "pid": "rank%s" % r.get("rank", 0),
+                    "tid": r.get("thread", "main"), "ts": ts_us})
+        out.append({"name": "launch", "cat": "span-device", "ph": "f",
+                    "bp": "e", "id": fid, "pid": 1,
+                    "tid": line_tids.get(dline, 0), "ts": dts})
+    return out
 
 
 def _collect_device_events():
@@ -159,16 +203,31 @@ def _collect_device_events():
         return []
 
 
+def _collect_spans():
+    """This process's span records (closed ring + open snapshots) from
+    the live tracer — [] when tracing is disabled or nothing recorded."""
+    try:
+        from .observability import tracing as _tracing
+
+        if not _tracing.tracing_enabled():
+            return []
+        tracer = _tracing.get_tracer()
+        return tracer.records() + tracer.open_spans()
+    except Exception:  # noqa: BLE001 - merge is best-effort
+        return []
+
+
 def export_chrome_trace(path):
-    """Write the merged host+device chrome trace for the current (or
-    just-stopped) profiler session.  Returns ``path``, or None when
+    """Write the merged host+device+span chrome trace for the current
+    (or just-stopped) profiler session.  Returns ``path``, or None when
     there is nothing to export."""
     with _events_lock:
         have_host = bool(_events)
     device_events = _collect_device_events()
-    if not have_host and not device_events:
+    spans = _collect_spans()
+    if not have_host and not device_events and not spans:
         return None
-    _write_chrome_trace(path, device_events=device_events)
+    _write_chrome_trace(path, device_events=device_events, spans=spans)
     return path
 
 
@@ -344,7 +403,8 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
     if profile_path:
         try:
             _write_chrome_trace(profile_path,
-                                device_events=device_events)
+                                device_events=device_events,
+                                spans=_collect_spans())
             print("[paddle_tpu.profiler] %stimeline written to %s "
                   "(open with chrome://tracing)"
                   % ("host+device " if device_events else "host ",

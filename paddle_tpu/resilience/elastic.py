@@ -59,6 +59,7 @@ import numpy as np
 
 from . import checkpoint as _ckpt
 from . import faults as _faults
+from ..observability import tracing as _tr
 from .watchdog import HeartbeatMonitor, HeartbeatWriter, WorkerLostError
 from .watchdog import _record_lost
 
@@ -91,7 +92,11 @@ class ElasticEvictedError(ElasticError):
 # ---------------------------------------------------------------------------
 
 Membership = collections.namedtuple(
-    "Membership", ["epoch", "members", "world", "lost", "writer"])
+    "Membership", ["epoch", "members", "world", "lost", "writer",
+                   "traceparent"])
+# traceparent is optional so positional construction from before the
+# tracing PR keeps working
+Membership.__new__.__defaults__ = (None,)
 
 
 def _member_path(dirname, epoch):
@@ -139,6 +144,9 @@ def agree_membership(dirname, rank, epoch, survivors, lost, reason="",
         "world": len(survivors), "lost": sorted(int(r) for r in lost),
         "reason": str(reason)[:500], "writer": int(rank),
         "ts": time.time(),
+        # the writer's trace rides in the record so every survivor can
+        # join ONE recovery trace even if the drill env was not set
+        "traceparent": _tr.current_traceparent(),
     }
     monitor = HeartbeatMonitor(
         dirname, [r for r in survivors if r != rank],
@@ -169,7 +177,8 @@ def agree_membership(dirname, rank, epoch, survivors, lost, reason="",
                       members=[int(r) for r in got["members"]],
                       world=int(got["world"]),
                       lost=[int(r) for r in got.get("lost", [])],
-                      writer=int(got.get("writer", -1)))
+                      writer=int(got.get("writer", -1)),
+                      traceparent=got.get("traceparent"))
 
 
 # ---------------------------------------------------------------------------
@@ -334,8 +343,15 @@ class GradExchange:
         final = os.path.join(self.dirname,
                              _grad_fname(epoch, step, self.rank))
         tmp = "%s.tmp-%d" % (final, os.getpid())
+        payload = {n: np.asarray(v) for n, v in arrays.items()}
+        # traceparent rides in-band so a peer can link its exchange
+        # span to ours; stripped before reduction (reduce_gradients
+        # never sees it)
+        tp = _tr.current_traceparent()
+        if tp:
+            payload["__traceparent__"] = np.asarray(tp)
         with open(tmp, "wb") as f:
-            np.savez(f, **{n: np.asarray(v) for n, v in arrays.items()})
+            np.savez(f, **payload)
         os.replace(tmp, final)
         old = os.path.join(self.dirname,
                            _grad_fname(epoch, step - 2, self.rank))
@@ -373,17 +389,27 @@ class GradExchange:
     def allreduce(self, epoch, step, grads, scale):
         """Publish ``grads`` and return the scaled sorted-member-order
         reduction over all members' contributions."""
-        self._publish(epoch, step, grads)
-        per_member = []
-        deadline = time.time() + self.wedge_timeout
-        for member in self.members:
-            if member == self.rank:
-                per_member.append(grads)
-                continue
-            path = self._wait_peer(epoch, step, member, deadline)
-            with np.load(path) as z:
-                per_member.append({n: z[n] for n in z.files})
-        return reduce_gradients(per_member, scale)
+        xspan = _tr.span("elastic.exchange", epoch=int(epoch),
+                         step=int(step), members=len(self.members))
+        with xspan:
+            self._publish(epoch, step, grads)
+            per_member = []
+            peer_traces = {}
+            deadline = time.time() + self.wedge_timeout
+            for member in self.members:
+                if member == self.rank:
+                    per_member.append(grads)
+                    continue
+                path = self._wait_peer(epoch, step, member, deadline)
+                with np.load(path) as z:
+                    contrib = {n: z[n] for n in z.files
+                               if n != "__traceparent__"}
+                    if "__traceparent__" in z.files:
+                        peer_traces[member] = str(z["__traceparent__"])
+                per_member.append(contrib)
+            if peer_traces and xspan.recording:
+                xspan.set_attr("peer_traceparents", peer_traces)
+            return reduce_gradients(per_member, scale)
 
     def sweep(self, keep_epoch):
         """Drop this rank's files from epochs before ``keep_epoch``
@@ -509,10 +535,12 @@ class ElasticTrainer:
     def _plan(self):
         t0 = time.perf_counter()
         old_world = self.world if self.train_prog is not None else None
-        (self.train_prog, startup, self.split, result,
-         applied) = plan_world(self.base_program, self.base_startup,
-                               self.world, rank_index=self.index,
-                               batch_size=self.batch_size)
+        with _tr.span("elastic.replan", epoch=self.epoch,
+                      world=self.world):
+            (self.train_prog, startup, self.split, result,
+             applied) = plan_world(self.base_program, self.base_startup,
+                                   self.world, rank_index=self.index,
+                                   batch_size=self.batch_size)
         self.zero1 = bool(getattr(self.train_prog,
                                   "_shard_optimizer_state", False))
         if old_world is not None:
@@ -644,13 +672,18 @@ class ElasticTrainer:
             raise ElasticEvictedError(
                 "rank %d was declared lost (%s) — exiting"
                 % (self.rank, err))
-        membership = agree_membership(
-            self.hb_dir, self.rank, self.epoch + 1, survivors, lost,
-            reason=str(err), stale_timeout=self.stale_timeout,
-            timeout=self.wedge_timeout)
-        self._adopt_membership(membership)
-        self._plan()
-        self._restore(recovery=True)
+        with _tr.span("elastic.recover", epoch=self.epoch + 1,
+                      lost=lost, survivors=len(survivors)):
+            with _tr.span("elastic.agree"):
+                membership = agree_membership(
+                    self.hb_dir, self.rank, self.epoch + 1, survivors,
+                    lost, reason=str(err),
+                    stale_timeout=self.stale_timeout,
+                    timeout=self.wedge_timeout)
+            self._adopt_membership(membership)
+            self._plan()
+            with _tr.span("elastic.restore"):
+                self._restore(recovery=True)
         self._recovering_since = t0
         _faults.set_step(self.step)
 
@@ -675,23 +708,37 @@ class ElasticTrainer:
             world=len(self.members), lost=[], writer=self.rank)
         self._hb = HeartbeatWriter(self.hb_dir, self.rank,
                                    interval=self.hb_interval).start()
-        try:
-            self._adopt_membership(membership)
-            startup = self._plan()
-            if startup is not None:
-                self.exe.run(program=startup)
-            self._restore(recovery=False)
-            while self.step < int(total_steps):
-                try:
-                    fetches = self._run_step(make_feed)
-                except WorkerLostError as e:
-                    self._recover(e)
-                    continue
-                self._after_step()
-                self._maybe_checkpoint()
-                if on_step is not None:
-                    on_step(self.step, fetches, self)
-                self.step += 1
-            return self.step
-        finally:
-            self._hb.stop()
+        # the worker's root span: joins the drill/driver trace when
+        # PADDLE_TPU_TRACEPARENT is in the env (the remote-parent
+        # fallback), so one trace covers every rank through recovery.
+        # Rank reaches this process as an argument, not env, and the
+        # fleet env contract is only written at membership adoption —
+        # stamp spans with the stable elastic rank explicitly (the
+        # post-recovery index would mislabel survivors of a leader
+        # loss).
+        if _tr.tracing_enabled():
+            _tr.set_rank(self.rank)
+        with _tr.span("elastic.worker", rank=self.rank,
+                      world=len(self.members)):
+            try:
+                self._adopt_membership(membership)
+                startup = self._plan()
+                if startup is not None:
+                    self.exe.run(program=startup)
+                self._restore(recovery=False)
+                while self.step < int(total_steps):
+                    try:
+                        with _tr.span("elastic.step", step=self.step,
+                                      epoch=self.epoch):
+                            fetches = self._run_step(make_feed)
+                    except WorkerLostError as e:
+                        self._recover(e)
+                        continue
+                    self._after_step()
+                    self._maybe_checkpoint()
+                    if on_step is not None:
+                        on_step(self.step, fetches, self)
+                    self.step += 1
+                return self.step
+            finally:
+                self._hb.stop()

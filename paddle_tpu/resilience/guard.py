@@ -100,6 +100,11 @@ def record_step(finite, step=None):
         NonFiniteStepWarning, stacklevel=3)
     limit = max_consecutive_skips()
     if limit > 0 and stats.consecutive_skips >= limit:
+        from ..observability import tracing as _tracing
+
+        _tracing.flight_dump(
+            "guard-abort: %d consecutive non-finite steps at step %s"
+            % (stats.consecutive_skips, step))
         raise RuntimeError(
             "finite step-guard skipped %d consecutive steps (limit %d, "
             "env PADDLE_TPU_NAN_GUARD_MAX_SKIPS) — the run has diverged; "

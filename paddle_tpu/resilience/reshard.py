@@ -33,6 +33,7 @@ import numpy as np
 
 from . import checkpoint as _ckpt
 from . import retry as _retry
+from ..observability import tracing as _tr
 from .atomic import atomic_write
 
 __all__ = ["shard_bounds", "reshard_checkpoint"]
@@ -154,6 +155,11 @@ def reshard_checkpoint(path, new_topology, policy=None):
             new_manifest["wall_time"] = time.time()
             if old_topo:
                 new_manifest["resharded_from"] = dict(old_topo)
+            tp = _tr.current_traceparent()
+            if tp:
+                # followers awaiting this manifest can join the
+                # leader's recovery trace from the file itself
+                new_manifest["traceparent"] = tp
             atomic_write(
                 os.path.join(tmp, _ckpt.MANIFEST_NAME),
                 lambda f: json.dump(new_manifest, f, indent=1), text=True)
@@ -168,8 +174,12 @@ def reshard_checkpoint(path, new_topology, policy=None):
             shutil.rmtree(tmp, ignore_errors=True)
             raise
 
-    report = _retry.retry_call(
-        _attempt, policy=policy, site="reshard_checkpoint(step=%d)" % step)
+    with _tr.span("elastic.reshard", step=step,
+                  old_world=(old_topo or {}).get("world"),
+                  new_world=new_world):
+        report = _retry.retry_call(
+            _attempt, policy=policy,
+            site="reshard_checkpoint(step=%d)" % step)
     from ..observability import runtime as _obs
 
     _obs.record_reshard(

@@ -53,6 +53,7 @@ import numpy as np
 
 from ..executor import _check_feed_shapes
 from ..observability import runtime as _obs
+from ..observability import tracing as _tr
 from ..static_analysis.diagnostics import Severity, format_diagnostics
 from .buckets import ShapeBuckets
 
@@ -103,7 +104,7 @@ class Request:
 
     __slots__ = ("id", "tenant", "feed", "rows", "deadline", "enqueue_ts",
                  "sig", "seq", "_event", "_outputs", "_error",
-                 "latency_ms")
+                 "latency_ms", "queue_wait_ms", "span", "_qspan")
 
     def __init__(self, rid, tenant, feed, rows, deadline, sig, seq):
         self.id = rid
@@ -118,6 +119,12 @@ class Request:
         self._outputs = None
         self._error = None
         self.latency_ms = None
+        self.queue_wait_ms = None
+        # request-lifecycle spans: span covers enqueue→respond and is
+        # ended by whichever thread completes/fails the request; _qspan
+        # covers enqueue→batch-formation (or shed)
+        self.span = _tr.NULL_SPAN
+        self._qspan = _tr.NULL_SPAN
 
     def done(self):
         return self._event.is_set()
@@ -134,11 +141,19 @@ class Request:
     def _complete(self, outputs):
         self._outputs = outputs
         self.latency_ms = (time.time() - self.enqueue_ts) * 1000.0
+        self.span.set_attr("latency_ms", round(self.latency_ms, 3))
+        self.span.end("ok")
         self._event.set()
 
     def _fail(self, exc):
         self._error = exc
         self.latency_ms = (time.time() - self.enqueue_ts) * 1000.0
+        status = "%s:%s" % (
+            "shed" if isinstance(exc, DeadlineExceededError)
+            else "crash" if isinstance(exc, DispatcherCrashedError)
+            else "error", type(exc).__name__)
+        self._qspan.end(status)
+        self.span.end(status)
         self._event.set()
 
     def __repr__(self):
@@ -160,16 +175,17 @@ class _Tenant:
 
 class _InFlight:
     __slots__ = ("tenant", "requests", "offsets", "bucket", "handles",
-                 "dispatch_ts")
+                 "dispatch_ts", "span")
 
     def __init__(self, tenant, requests, offsets, bucket, handles,
-                 dispatch_ts):
+                 dispatch_ts, span=_tr.NULL_SPAN):
         self.tenant = tenant
         self.requests = requests
         self.offsets = offsets
         self.bucket = bucket
         self.handles = handles
         self.dispatch_ts = dispatch_ts
+        self.span = span  # serving.batch, ends after the batched sync
 
 
 class PredictorServer:
@@ -351,17 +367,29 @@ class PredictorServer:
         deadline = (time.time() + sla_ms / 1000.0
                     if sla_ms is not None else None)
         req = Request(rid, tenant, feed, rows, deadline, sig, seq)
+        # root of the request's trace (enqueue→respond); joins the
+        # caller's active trace when there is one
+        req.span = _tr.start_span("serving.request", tenant=tenant,
+                                  request_id=rid, rows=rows)
+        req._qspan = _tr.start_span("serving.queue_wait",
+                                    parent=req.span)
         with self._cond:
             if self._crashed is not None:
+                req._qspan.end("crash:DispatcherCrashedError")
+                req.span.end("crash:DispatcherCrashedError")
                 raise DispatcherCrashedError(
                     "server is dead: dispatcher crashed (%s: %s)"
                     % (type(self._crashed).__name__, self._crashed))
             if self._closed:
+                req._qspan.end("reject:ServerClosedError")
+                req.span.end("reject:ServerClosedError")
                 raise ServerClosedError("server is closed")
             depth = sum(len(x.queue) for x in self._tenants.values())
             if depth >= self._queue_cap:
                 self._count("rejected")
                 _obs.record_serving_reject()
+                req._qspan.end("reject:QueueFullError")
+                req.span.end("reject:QueueFullError")
                 raise QueueFullError(
                     "queue full (%d queued, cap %d) — backpressure"
                     % (depth, self._queue_cap))
@@ -433,6 +461,7 @@ class PredictorServer:
             self._cond.notify_all()
         for entry in self._inflight:
             pending.extend(entry.requests)
+            entry.span.end("crash:DispatcherCrashedError")
         self._inflight = []
         err = DispatcherCrashedError(
             "serving dispatcher thread crashed: %s: %s"
@@ -443,7 +472,9 @@ class PredictorServer:
         # the typed error can rely on the incident being on disk
         self._count("failed", len(to_fail))
         _obs.record_dispatcher_died(
-            "%s: %s" % (type(exc).__name__, exc), len(to_fail))
+            "%s: %s" % (type(exc).__name__, exc), len(to_fail),
+            trace=next((r.span.trace_id for r in pending
+                        if r.span.recording), None))
         for r in to_fail:
             r._fail(err)
 
@@ -517,28 +548,50 @@ class PredictorServer:
                 else:
                     rest.append(r)
             t.queue = rest
+            formed = time.time()
+            for r in batch:
+                r.queue_wait_ms = (formed - r.enqueue_ts) * 1000.0
+                r._qspan.end("ok")
+                _obs.record_serving_queue_wait(name, r.queue_wait_ms)
             return t, batch
         return None
 
     def _dispatch(self, tenant, reqs):
         rows = sum(r.rows for r in reqs)
         bucket = self.buckets.bucket_for(rows)
-        feed = {}
-        for name in reqs[0].feed:
-            feed[name] = (reqs[0].feed[name] if len(reqs) == 1
-                          else np.concatenate(
-                              [r.feed[name] for r in reqs], axis=0))
-        feed = self.buckets.pad_feed(feed, rows, bucket)
-        offsets, off = [], 0
-        for r in reqs:
-            offsets.append((off, off + r.rows))
-            off += r.rows
-        now = time.time()
-        if self._first_dispatch_ts is None:
-            self._first_dispatch_ts = now
-        handles = tenant.predictor.run_async(feed)
+        # the batch span parents to the first request's span and names
+        # its coalesced siblings, so a trace walks request→batch even
+        # when N requests share one device launch
+        bspan = _tr.start_span(
+            "serving.batch", parent=reqs[0].span, tenant=tenant.name,
+            bucket=bucket, rows=rows, requests=len(reqs),
+            coalesced=[r.span.span_id for r in reqs[1:]
+                       if r.span.recording])
+        try:
+            with _tr.use_context(bspan.context):
+                with _tr.span("serving.pad", bucket=bucket):
+                    feed = {}
+                    for name in reqs[0].feed:
+                        feed[name] = (reqs[0].feed[name]
+                                      if len(reqs) == 1
+                                      else np.concatenate(
+                                          [r.feed[name] for r in reqs],
+                                          axis=0))
+                    feed = self.buckets.pad_feed(feed, rows, bucket)
+                offsets, off = [], 0
+                for r in reqs:
+                    offsets.append((off, off + r.rows))
+                    off += r.rows
+                now = time.time()
+                if self._first_dispatch_ts is None:
+                    self._first_dispatch_ts = now
+                with _tr.span("serving.dispatch"):
+                    handles = tenant.predictor.run_async(feed)
+        except Exception as exc:  # noqa: BLE001 — terminal status, then
+            bspan.end("error:%s" % type(exc).__name__)  # re-raised for
+            raise                                 # the per-batch guard
         self._inflight.append(_InFlight(tenant, reqs, offsets, bucket,
-                                        handles, now))
+                                        handles, now, span=bspan))
         if len(self.dispatch_log) < 4096:
             self.dispatch_log.append((tenant.name, bucket, rows))
         _obs.record_serving_batch(tenant.name, bucket, rows)
@@ -550,14 +603,31 @@ class PredictorServer:
         from .. import pipeline as pl
 
         entry = self._inflight.pop(0)
+        sync_t0 = time.time()
+        # the window dispatch→sync-start is device compute overlapped
+        # with anything the dispatcher did meanwhile — attributed as a
+        # retroactive child span of the batch
+        _tr.start_span("serving.device", parent=entry.span,
+                       start_ts=entry.dispatch_ts,
+                       bucket=entry.bucket).end(
+            dur_ms=(sync_t0 - entry.dispatch_ts) * 1000.0)
+        sspan = _tr.start_span("serving.sync", parent=entry.span,
+                               handles=len(entry.handles)
+                               if hasattr(entry.handles, "__len__")
+                               else 1)
         try:
             outputs = pl.materialize(entry.handles)
         except Exception as exc:  # noqa: BLE001
+            sspan.end("error:%s" % type(exc).__name__)
+            entry.span.end("error:%s" % type(exc).__name__)
             for r in entry.requests:
                 r._fail(exc)
             self._count("failed", len(entry.requests))
             return
+        sspan.end("ok")
         now = time.time()
+        sync_ms = (now - sync_t0) * 1000.0
+        _obs.record_serving_sync(entry.tenant.name, sync_ms)
         service_ms = (now - entry.dispatch_ts) * 1000.0
         t = entry.tenant
         t.est_ms = (service_ms if t.est_ms is None
@@ -567,6 +637,8 @@ class PredictorServer:
             r._complete(self.buckets.slice_rows(outputs, a, b,
                                                 entry.bucket))
             _obs.record_serving_done(t.name, r.latency_ms)
+        entry.span.set_attr("service_ms", round(service_ms, 3))
+        entry.span.end("ok")
         self._count("completed", len(entry.requests))
         self._last_complete_ts = now
         qps = self._qps_locked()

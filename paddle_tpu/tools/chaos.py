@@ -352,11 +352,24 @@ def _run_elastic_driver(args):
           "workdir=%s" % (world, kill_rank, args.kill_step, args.steps,
                           workdir), flush=True)
 
+    # one traceparent for the whole drill: every worker's spans join
+    # this trace, so worker-lost→replan→reshard→resume reconstructs as
+    # ONE trace across victim + survivors (tools.trace --elastic)
+    from paddle_tpu.observability import tracing as _tracing
+
+    drill_ctx = _tracing.new_trace_context()
+    drill_tp = _tracing.format_traceparent(drill_ctx)
+    print("chaos[elastic]: trace %s" % drill_ctx.trace_id, flush=True)
+
     procs, logs = [], []
     for rank in range(world):
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = env.get("JAX_PLATFORMS", "cpu")
         env["PADDLE_TPU_TELEMETRY_DIR"] = telemetry_dir
+        env["PADDLE_TPU_TRACEPARENT"] = drill_tp
+        # drills are short and killed mid-flight: flush every span so
+        # the victim's pre-death spans reach disk before the kill
+        env.setdefault("PADDLE_TPU_TELEMETRY_FLUSH", "1")
         env.pop("PADDLE_TPU_FAULT_SPEC", None)
         env.pop("PADDLE_TPU_NAN_GUARD", None)
         if rank == kill_rank:
@@ -480,6 +493,29 @@ def _run_elastic_driver(args):
           "%s — view it with: python -m paddle_tpu.tools.monitor "
           "--once %s" % (" -> ".join(chain), telemetry_dir),
           flush=True)
+
+    # every rank's spans — victim included — must have joined the ONE
+    # drill trace, with the recovery phases visible inside it
+    spans = [r for r in _tracing.read_traces(telemetry_dir)
+             if r.get("trace") == drill_ctx.trace_id]
+    span_ranks = {r.get("rank") for r in spans}
+    span_names = {r.get("name") for r in spans}
+    want_names = {"elastic.worker", "elastic.recover", "elastic.replan",
+                  "elastic.restore"}
+    missing_ranks = set(range(world)) - span_ranks
+    missing_names = want_names - span_names
+    if missing_ranks or missing_names:
+        print("chaos[elastic]: FAIL — drill trace %s is missing "
+              "rank(s) %s / span(s) %s (have ranks %s, %d spans)"
+              % (drill_ctx.trace_id, sorted(missing_ranks),
+                 sorted(missing_names), sorted(span_ranks), len(spans)),
+              flush=True)
+        return 1
+    print("chaos[elastic]: ONE trace %s spans all %d ranks through "
+          "recovery (%d spans) — reconstruct it with: python -m "
+          "paddle_tpu.tools.trace --elastic %s"
+          % (drill_ctx.trace_id, world, len(spans), telemetry_dir),
+          flush=True)
     print("chaos[elastic]: PASS", flush=True)
     return 0
 
@@ -536,6 +572,11 @@ def _run_driver(args):
           % (args.spec, args.steps, ckpt_dir, telemetry_dir or "off"),
           flush=True)
 
+    from paddle_tpu.observability import tracing as _tracing
+
+    # one trace across every incarnation of the worker
+    drill_tp = _tracing.format_traceparent(_tracing.new_trace_context())
+
     for incarnation in range(args.max_restarts + 1):
         env = dict(os.environ)
         env.update({
@@ -545,8 +586,10 @@ def _run_driver(args):
             "PADDLE_TPU_FAULT_STATE_FILE":
                 os.path.join(ckpt_dir, "fault_state.json"),
             "PADDLE_TPU_NAN_GUARD": "1",
+            "PADDLE_TPU_TRACEPARENT": drill_tp,
             "JAX_PLATFORMS": env.get("JAX_PLATFORMS", "cpu"),
         })
+        env.setdefault("PADDLE_TPU_TELEMETRY_FLUSH", "1")
         if telemetry_dir:
             env["PADDLE_TPU_TELEMETRY_DIR"] = telemetry_dir
         cmd = [sys.executable, "-m", "paddle_tpu.tools.chaos", "--worker",

@@ -274,6 +274,13 @@ def collect_status(dirname, hb_dir=None, now=None,
     srv_lat = _merged_histogram(merged, "serving_latency_ms")
     srv_p50 = _hist_percentile(srv_lat, 50) if srv_lat else None
     srv_p99 = _hist_percentile(srv_lat, 99) if srv_lat else None
+    # queue-wait percentiles (fed from the serving.queue_wait spans):
+    # the component that explains shedding, invisible in end-to-end
+    srv_qw = _merged_histogram(merged, "serving_queue_wait_ms")
+    srv_qw_p50 = _hist_percentile(srv_qw, 50) if srv_qw else None
+    srv_qw_p99 = _hist_percentile(srv_qw, 99) if srv_qw else None
+    srv_sync = _merged_histogram(merged, "serving_sync_ms")
+    srv_sync_p99 = _hist_percentile(srv_sync, 99) if srv_sync else None
     srv_qps = _metric_value(merged, "serving_throughput_qps")
     srv_reqs = _metric_value(merged, "serving_requests_total")
     srv_shed = _metric_value(merged, "serving_shed_total")
@@ -286,7 +293,7 @@ def collect_status(dirname, hb_dir=None, now=None,
         counts[e["kind"]] = counts.get(e["kind"], 0) + 1
     sequence = [
         {"kind": e["kind"], "ts": e.get("ts"), "rank": e.get("rank"),
-         "step": e.get("step")}
+         "step": e.get("step"), "trace": e.get("trace")}
         for e in events if e.get("kind") in _SEQUENCE_KINDS
     ]
 
@@ -308,6 +315,12 @@ def collect_status(dirname, hb_dir=None, now=None,
                                    else round(srv_p50, 3)),
         "p99_serving_latency_ms": (None if srv_p99 is None
                                    else round(srv_p99, 3)),
+        "p50_serving_queue_wait_ms": (None if srv_qw_p50 is None
+                                      else round(srv_qw_p50, 3)),
+        "p99_serving_queue_wait_ms": (None if srv_qw_p99 is None
+                                      else round(srv_qw_p99, 3)),
+        "p99_serving_sync_ms": (None if srv_sync_p99 is None
+                                else round(srv_sync_p99, 3)),
         "serving_throughput_qps": (None if srv_qps is None
                                    else round(srv_qps, 3)),
         "serving_queue_depth": _metric_value(merged,
@@ -392,11 +405,13 @@ def render_status(status):
     if status.get("serving_requests") is not None:
         lines.append(
             "  serving: reqs=%s  qps=%s  lat_ms p50=%s p99=%s  "
-            "queue=%s  shed_rate=%s" % (
+            "qwait_ms p50=%s p99=%s  queue=%s  shed_rate=%s" % (
                 _fmt(status["serving_requests"]),
                 _fmt(status["serving_throughput_qps"]),
                 _fmt(status["p50_serving_latency_ms"]),
                 _fmt(status["p99_serving_latency_ms"]),
+                _fmt(status.get("p50_serving_queue_wait_ms")),
+                _fmt(status.get("p99_serving_queue_wait_ms")),
                 _fmt(status["serving_queue_depth"]),
                 _fmt(status["serving_shed_rate"])))
     if status["ranks"]:
@@ -424,6 +439,15 @@ def render_status(status):
             + ("@%s" % e["step"] if e.get("step") is not None else "")
             + (" x%d" % n if n > 1 else "")
             for e, n in tail))
+        # point the operator at `tools.trace --id` for the incident chain
+        traces = []
+        for e, _ in tail:
+            t = e.get("trace")
+            if t and t not in traces:
+                traces.append(t)
+        if traces:
+            lines.append("  trace: " + " ".join(t[:8] for t in traces)
+                         + "  (paddle_tpu.tools.trace --id <id> DIR)")
     return "\n".join(lines)
 
 

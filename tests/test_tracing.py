@@ -1,0 +1,412 @@
+"""Distributed tracing (ISSUE 13): span round-trip + torn-write
+tolerance, the ``PADDLE_TPU_TRACING=0`` kill switch, context
+propagation in-thread / cross-thread (the ``run_batches`` prefetch
+worker) / cross-process (traceparent env), critical-path attribution
+and the ``tools.trace`` CLI contract, and the flight recorder firing on
+a dispatcher crash.  The full multi-process elastic drill (ONE trace
+across victim + survivors) is the slow-marked acceptance test.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.observability as obs
+from paddle_tpu import serving
+from paddle_tpu.executor import Scope, scope_guard
+from paddle_tpu.inference import AnalysisConfig, AnalysisPredictor
+from paddle_tpu.observability import journal as oj
+from paddle_tpu.observability import tracing as tr
+from paddle_tpu.tools import trace as trace_cli
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    fluid.unique_name.switch()
+    for var in ("PADDLE_TPU_TELEMETRY", "PADDLE_TPU_TELEMETRY_DIR",
+                "PADDLE_TPU_TELEMETRY_FLUSH", "PADDLE_TPU_TRACING",
+                "PADDLE_TPU_TRACEPARENT", "PADDLE_TPU_TRACE_RING"):
+        monkeypatch.delenv(var, raising=False)
+    obs.reset_telemetry()
+    yield
+    obs.reset_telemetry()
+
+
+def _trace_dir(monkeypatch, tmp_path, flush=1):
+    tdir = tmp_path / "telemetry"
+    monkeypatch.setenv("PADDLE_TPU_TELEMETRY_DIR", str(tdir))
+    monkeypatch.setenv("PADDLE_TPU_TELEMETRY_FLUSH", str(flush))
+    obs.reset_telemetry()
+    return str(tdir)
+
+
+# ---------------------------------------------------------------------------
+# span model: ids, round-trip, torn lines, kill switch
+# ---------------------------------------------------------------------------
+class TestSpanModel:
+    def test_round_trip_parent_child(self, tmp_path, monkeypatch):
+        tdir = _trace_dir(monkeypatch, tmp_path)
+        with tr.span("outer", step=3) as outer:
+            with tr.span("inner") as inner:
+                inner.set_attr("rows", 8)
+        tr.get_tracer().flush()
+        recs = tr.read_traces(tdir)
+        by_name = {r["name"]: r for r in recs}
+        assert set(by_name) == {"outer", "inner"}
+        o, i = by_name["outer"], by_name["inner"]
+        assert i["trace"] == o["trace"] == outer.trace_id
+        assert i["parent"] == o["span"]
+        assert o["parent"] is None
+        assert i["attrs"]["rows"] == 8 and o["attrs"]["step"] == 3
+        assert o["status"] == i["status"] == "ok"
+        assert o["dur_ms"] >= i["dur_ms"] >= 0
+        assert o["pid"] == os.getpid()
+
+    def test_error_status_flushes_urgently(self, tmp_path, monkeypatch):
+        tdir = _trace_dir(monkeypatch, tmp_path, flush=1000)
+        with pytest.raises(ValueError):
+            with tr.span("doomed"):
+                raise ValueError("boom")
+        # no explicit flush: the error terminal must already be on disk
+        recs = tr.read_traces(tdir)
+        assert recs and recs[0]["status"] == "error:ValueError"
+
+    def test_torn_trailing_line_is_skipped(self, tmp_path, monkeypatch):
+        tdir = _trace_dir(monkeypatch, tmp_path)
+        with tr.span("kept"):
+            pass
+        tr.get_tracer().flush()
+        path = tr.get_tracer().path
+        with open(path, "a") as f:
+            f.write('{"schema": 1, "kind": "span", "trunc')  # SIGKILL
+        recs = tr.read_traces(tdir)
+        assert [r["name"] for r in recs] == ["kept"]
+        # future-schema records are skipped too, never raised
+        with open(path, "a") as f:
+            f.write(json.dumps({"schema": 99, "span": "x",
+                                "name": "future"}) + "\n")
+        assert [r["name"] for r in tr.read_traces(tdir)] == ["kept"]
+
+    def test_kill_switch_zero_growth(self, tmp_path, monkeypatch):
+        tdir = _trace_dir(monkeypatch, tmp_path)
+        monkeypatch.setenv("PADDLE_TPU_TRACING", "0")
+        obs.reset_telemetry()
+        s = tr.span("invisible", big=1)
+        assert s is tr.NULL_SPAN and not s.recording
+        with s:
+            assert tr.current_span() is None
+            assert tr.current_traceparent() is None
+        s.end("never")
+        assert len(tr.get_tracer()) == 0
+        tr.get_tracer().flush()
+        assert not [n for n in os.listdir(tdir)
+                    if n.startswith("trace-")]
+        # flight dump is a no-op when killed, never a second failure
+        assert tr.flight_dump("whatever") is None
+
+    def test_traceparent_round_trip_and_tolerance(self):
+        ctx = tr.new_trace_context()
+        assert tr.parse_traceparent(tr.format_traceparent(ctx)) == ctx
+        for bad in (None, "", "nope", "00-zz-yy-01", "00--01", 42):
+            assert tr.parse_traceparent(bad) is None
+
+    def test_ring_is_bounded(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_TRACE_RING", "4")
+        obs.reset_telemetry()
+        for i in range(10):
+            tr.span("s%d" % i).end()
+        assert len(tr.get_tracer()) == 4
+
+
+# ---------------------------------------------------------------------------
+# context propagation: threads and processes
+# ---------------------------------------------------------------------------
+class TestPropagation:
+    def test_capture_use_context_across_thread(self):
+        got = {}
+        with tr.span("root") as root:
+            ctx = tr.capture_context()
+
+            def worker():
+                with tr.use_context(ctx):
+                    with tr.span("child") as c:
+                        got["trace"] = c.trace_id
+                        got["parent"] = c.parent_id
+
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        assert got["trace"] == root.trace_id
+        assert got["parent"] == root.span_id
+
+    def test_remote_parent_from_env(self, monkeypatch):
+        ctx = tr.new_trace_context()
+        monkeypatch.setenv(tr.TRACEPARENT_ENV, tr.format_traceparent(ctx))
+        obs.reset_telemetry()
+        with tr.span("adopted") as s:
+            assert s.trace_id == ctx.trace_id
+            assert s.parent_id == ctx.span_id
+
+    def test_run_batches_prefetch_thread_joins_trace(self, tmp_path):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            out = fluid.layers.fc(x, size=3)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            exe.run(startup)
+            fluid.io.save_inference_model(
+                str(tmp_path / "m"), ["x"], [out], exe, main_program=main)
+        pred = AnalysisPredictor(
+            AnalysisConfig(model_dir=str(tmp_path / "m")))
+        rng = np.random.RandomState(0)
+        feeds = [{"x": rng.standard_normal((2, 4)).astype("float32")}
+                 for _ in range(4)]
+        with tr.span("client") as root:
+            results = list(pred.run_batches(feeds, max_in_flight=2))
+        assert len(results) == 4
+        recs = tr.get_tracer().records()
+        pf = [r for r in recs if r["name"] == "pipeline.prefetch"]
+        assert pf, "prefetch thread emitted no span"
+        # the prefetch worker runs on its own thread yet joins the
+        # caller's trace — that's the cross-thread propagation contract
+        assert pf[0]["trace"] == root.trace_id
+        assert pf[0]["thread"] != root.thread
+        assert pf[0]["attrs"]["items"] == 4
+
+    def test_cross_process_env_propagation(self, tmp_path, monkeypatch):
+        tdir = _trace_dir(monkeypatch, tmp_path)
+        with tr.span("parent-proc") as root:
+            env = dict(os.environ)
+            env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO,
+                        "PADDLE_TPU_TELEMETRY_DIR": tdir,
+                        "PADDLE_TPU_TELEMETRY_FLUSH": "1"})
+            tr.inject_env(env)
+            assert env[tr.TRACEPARENT_ENV] == root.traceparent
+            res = subprocess.run(
+                [sys.executable, "-c",
+                 "from paddle_tpu.observability import tracing as t\n"
+                 "t.span('child-proc').end()\n"
+                 "t.get_tracer().flush()"],
+                capture_output=True, text=True, timeout=120, env=env,
+                cwd=REPO)
+        assert res.returncode == 0, res.stderr[-800:]
+        tr.get_tracer().flush()
+        recs = [r for r in tr.read_traces(tdir)
+                if r["trace"] == root.trace_id]
+        names = {r["name"] for r in recs}
+        assert names == {"parent-proc", "child-proc"}
+        pids = {r["pid"] for r in recs}
+        assert len(pids) == 2, "expected two processes in one trace"
+
+
+# ---------------------------------------------------------------------------
+# critical path + the tools.trace CLI
+# ---------------------------------------------------------------------------
+def _synthetic_request(trace="t" * 32, base=1000.0, rank=0):
+    """A serving.request tree with a known critical path:
+    2ms queue + (1ms pad inside 2ms batch) + 4ms device + 2ms sync."""
+
+    def rec(name, span, parent, ts, dur_ms, **attrs):
+        r = {"schema": 1, "kind": "span", "ts": base + ts, "rank": rank,
+             "pid": 1, "thread": "main", "trace": trace, "span": span,
+             "parent": parent, "name": name, "dur_ms": dur_ms,
+             "status": "ok"}
+        if attrs:
+            r["attrs"] = attrs
+        return r
+
+    return [
+        rec("serving.request", "a1", None, 0.0, 10.0),
+        rec("serving.queue_wait", "a2", "a1", 0.0, 2.0),
+        rec("serving.batch", "a3", "a1", 0.002, 2.0),
+        rec("serving.pad", "a4", "a3", 0.002, 1.0),
+        rec("serving.device", "a5", "a1", 0.004, 4.0),
+        rec("serving.sync", "a6", "a1", 0.008, 2.0),
+    ]
+
+
+class TestCriticalPath:
+    def test_attribution_sums_to_root(self):
+        spans = _synthetic_request()
+        segments = trace_cli.critical_path(spans)
+        contrib = {rec["name"]: ms for rec, ms in segments}
+        assert segments[0][0]["name"] == "serving.request"
+        assert contrib["serving.queue_wait"] == pytest.approx(2.0, abs=.1)
+        assert contrib["serving.pad"] == pytest.approx(1.0, abs=0.1)
+        assert contrib["serving.batch"] == pytest.approx(1.0, abs=0.1)
+        assert contrib["serving.device"] == pytest.approx(4.0, abs=0.1)
+        assert contrib["serving.sync"] == pytest.approx(2.0, abs=0.1)
+        total = sum(ms for _, ms in segments)
+        assert total == pytest.approx(10.0, abs=0.2)
+
+    def test_open_spans_excluded_and_summary(self):
+        spans = _synthetic_request()
+        spans.append({"schema": 1, "ts": 1000.0, "trace": "t" * 32,
+                      "span": "a7", "parent": "a1", "name": "hung",
+                      "dur_ms": None, "status": "ok", "open": True,
+                      "rank": 2})
+        assert all(rec["name"] != "hung"
+                   for rec, _ in trace_cli.critical_path(spans))
+        info = trace_cli.trace_summary("t" * 32, spans)
+        assert info["root"] == "serving.request"
+        assert info["dur_ms"] == 10.0
+        assert info["ranks"] == [0, 2]
+
+    def test_serving_stats_and_alert_exit_codes(self, tmp_path, capsys):
+        path = tmp_path / "trace-r0-1.jsonl"
+        lines = []
+        for i in range(3):
+            lines.extend(json.dumps(r) for r in _synthetic_request(
+                trace=("%032x" % i), base=1000.0 + i))
+        path.write_text("\n".join(lines) + "\n")
+        stats = trace_cli.serving_stats(
+            trace_cli.group_traces(tr.read_traces(str(path))))
+        assert stats["requests"] == 3
+        assert stats["queue_wait_p99_ms"] == pytest.approx(2.0, abs=0.1)
+        assert stats["sync_p99_ms"] == pytest.approx(2.0, abs=0.1)
+
+        rc = trace_cli.main([str(tmp_path), "--serving", "--json"])
+        assert rc == 0
+        st = json.loads(capsys.readouterr().out)
+        assert st["request_p99_ms"] == pytest.approx(10.0, abs=0.1)
+        assert trace_cli.main(
+            [str(tmp_path), "--serving", "--alert",
+             "queue_wait_p99_ms>100"]) == 0
+        assert trace_cli.main(
+            [str(tmp_path), "--serving", "--alert",
+             "queue_wait_p99_ms>1"]) == 1
+        assert trace_cli.main(
+            [str(tmp_path), "--serving", "--alert",
+             "no_such_field>1"]) == 2
+        capsys.readouterr()
+
+    def test_id_view_and_chrome_export(self, tmp_path, capsys):
+        path = tmp_path / "trace-r0-1.jsonl"
+        path.write_text("\n".join(
+            json.dumps(r) for r in _synthetic_request()) + "\n")
+        out_json = str(tmp_path / "chrome.json")
+        rc = trace_cli.main([str(tmp_path), "--id", "tttttttt",
+                             "--chrome", out_json])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "critical path" in out and "serving.device" in out
+        with open(out_json) as f:
+            ct = json.load(f)
+        xs = [e for e in ct["traceEvents"] if e.get("ph") == "X"]
+        assert len(xs) == 6
+        assert all(e["pid"] == "rank0" for e in xs)
+
+    def test_empty_dir_exits_2(self, tmp_path, capsys):
+        assert trace_cli.main([str(tmp_path)]) == 2
+        capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: dispatcher crash postmortem
+# ---------------------------------------------------------------------------
+class TestFlightRecorder:
+    def _save_model(self, dirname):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+            out = fluid.layers.fc(x, size=3)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with scope_guard(Scope()):
+            exe.run(startup)
+            fluid.io.save_inference_model(str(dirname), ["x"], [out],
+                                          exe, main_program=main)
+        return str(dirname)
+
+    def test_dispatcher_crash_dumps_flight_record(
+            self, tmp_path, monkeypatch):
+        tdir = _trace_dir(monkeypatch, tmp_path)
+        pred = AnalysisPredictor(
+            AnalysisConfig(model_dir=self._save_model(tmp_path / "m")))
+        server = serving.PredictorServer({"t": pred}, buckets=(2,),
+                                         auto_start=False)
+
+        def boom():
+            raise RuntimeError("scheduler bug")
+
+        monkeypatch.setattr(server, "_pick_batch_locked", boom)
+        rng = np.random.RandomState(7)
+        feed = {"x": rng.standard_normal((1, 4)).astype("float32")}
+        r1 = server.submit("t", feed)
+        server.submit("t", feed)
+        server.start()
+        with pytest.raises(serving.DispatcherCrashedError):
+            r1.result(timeout=60)
+        server.close()
+
+        flights = tr.read_flight_records(tdir)
+        assert flights, "dispatcher crash produced no flight record"
+        rec = flights[0]
+        assert "dispatcher-died" in rec["reason"]
+        assert "scheduler bug" in rec["reason"]
+        # the postmortem shows what was in flight WHEN it died: the
+        # stranded request spans are captured still open
+        open_names = {s["name"] for s in rec["open_spans"]}
+        assert "serving.request" in open_names
+        # satellite 3: the urgent journal kind carries the trace id so
+        # `tools.trace --id` reconstructs the incident chain
+        died = [e for e in oj.read_journal(tdir)
+                if e["kind"] == "dispatcher-died"]
+        assert died and died[0].get("trace") == r1.span.trace_id
+
+    def test_flights_cli_view(self, tmp_path, monkeypatch, capsys):
+        tdir = _trace_dir(monkeypatch, tmp_path)
+        with tr.span("stuck"):
+            tr.flight_dump("synthetic hang")
+        rc = trace_cli.main([tdir, "--flights"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "synthetic hang" in out and "OPEN stuck" in out
+
+
+# ---------------------------------------------------------------------------
+# the acceptance drill: ONE trace across victim + survivors (slow)
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+class TestElasticDrillTrace:
+    def test_elastic_drill_is_one_trace(self, tmp_path):
+        tdir = str(tmp_path / "telemetry")
+        env = dict(os.environ)
+        env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO})
+        for var in ("PADDLE_TPU_FAULT_SPEC", "PADDLE_TPU_TELEMETRY",
+                    "PADDLE_TPU_TRACING", "PADDLE_TPU_TRACEPARENT"):
+            env.pop(var, None)
+        res = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.tools.chaos", "--elastic",
+             "--steps", "8", "--ckpt-dir", str(tmp_path / "ckpt"),
+             "--telemetry-dir", tdir],
+            capture_output=True, text=True, timeout=600, env=env,
+            cwd=REPO)
+        assert res.returncode == 0, res.stdout[-3000:] + res.stderr[-800:]
+        assert "chaos[elastic]: PASS" in res.stdout
+        assert "ONE trace" in res.stdout
+
+        out = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.tools.trace",
+             "--elastic", tdir, "--json"],
+            capture_output=True, text=True, timeout=120, env=env,
+            cwd=REPO)
+        assert out.returncode == 0, out.stderr[-800:]
+        st = json.loads(out.stdout)
+        # every rank — victim AND survivors — contributed to the trace
+        assert st["ranks"] == [0, 1, 2]
+        human = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.tools.trace",
+             "--elastic", tdir],
+            capture_output=True, text=True, timeout=120, env=env,
+            cwd=REPO)
+        assert "replan" in human.stdout and "reshard" in human.stdout
