@@ -1126,6 +1126,134 @@ def child_serving():
         raise SystemExit(1)
 
 
+def child_decode():
+    """Autoregressive decoding benchmark (ISSUE 14): the
+    examples/gpt_small KV-cache generation loop (device-resident ring
+    cache + flash-decode attention + while-op decode_loop — ONE jit
+    entry for the whole generation) A/B'd against the naive
+    full-recompute baseline (re-run the full forward over the Tmax
+    token buffer every step) at the same (batch, prompt, max_new) and
+    the same Tmax=512 capacity.  Emits
+    ``gpt_small_decode_tokens_per_sec`` and
+    ``gpt_small_time_to_first_token_ms``; the measured A/B is recorded
+    into the autotune ``decode`` family, and on TPU a kernel micro-sweep
+    writes the ``decode_min_t`` engagement threshold.  Hard gate
+    (exit 1): KV-cache path >= 2x the naive tokens/sec."""
+    import jax
+
+    from paddle_tpu import autotune
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    ex = os.path.join(repo, "examples")
+    if ex not in sys.path:
+        sys.path.insert(0, ex)
+    import gpt_small
+
+    os.environ["PADDLE_TPU_STRICT_SYNC"] = "1"
+    dev = jax.devices()[0]
+    on_tpu = _is_tpu_platform(dev.platform)
+    kind = getattr(dev, "device_kind", str(dev))
+
+    cfg = gpt_small.GPT_TINY  # Tmax=512: the naive arm pays full
+    batch = 8 if on_tpu else 2          # recompute over all 512 slots
+    prompt = 32 if on_tpu else 8
+    new = 64 if on_tpu else 32
+
+    def kv_build():
+        return gpt_small.build_program(cfg, batch, prompt, new)
+
+    def naive_build():
+        return gpt_small.build_naive_program(cfg, batch, prompt, new)
+
+    toks_kv, _glen, ttft_kv, tps_kv = gpt_small.run_generate(
+        kv_build, cfg, batch, prompt, new)
+    toks_nv, _glen, ttft_nv, tps_nv = gpt_small.run_generate(
+        naive_build, cfg, batch, prompt, new)
+    if toks_kv.tolist() != toks_nv.tolist():
+        print("# DECODE GATE FAILED: kv-cache and naive paths disagree "
+              "on greedy tokens", file=sys.stderr, flush=True)
+        raise SystemExit(1)
+    speedup = tps_kv / max(tps_nv, 1e-9)
+
+    sig = autotune.sweep_signature(
+        "decode", {"model": "gpt_small", "tmax": cfg.max_len,
+                   "batch": batch, "prompt": prompt, "new": new})
+    autotune.record(sig, {
+        "tokens_per_sec": round(tps_kv, 2),
+        "naive_tokens_per_sec": round(tps_nv, 2),
+        "ttft_ms": round(ttft_kv * 1e3, 2),
+        "speedup": round(speedup, 3),
+    })
+
+    if on_tpu:
+        # kernel engagement sweep: flash-decode vs the XLA composite
+        # per cache length; the crossover is the recorded min_t
+        import jax.numpy as jnp
+
+        from paddle_tpu.ops.pallas import flash_decode as fd
+
+        rng = np.random.RandomState(0)
+        bh, d = 8, cfg.hidden // cfg.heads
+        rows, min_t = {}, None
+
+        def timed(fn, *a):
+            jax.block_until_ready(fn(*a))  # compile outside the timing
+            t0 = time.perf_counter()
+            for _ in range(10):
+                r = fn(*a)
+            jax.block_until_ready(r)
+            return (time.perf_counter() - t0) / 10
+
+        kernel_fn = jax.jit(lambda q, k, v, l: fd.flash_decode(q, k, v, l))
+        ref_fn = jax.jit(lambda q, k, v, l: fd.decode_reference(q, k, v, l))
+        for t in (256, 512, 1024, 2048):
+            q = jnp.asarray(rng.randn(bh, cfg.heads, d), jnp.float32)
+            k = jnp.asarray(rng.randn(bh, cfg.heads, t, d), jnp.float32)
+            v = jnp.asarray(rng.randn(bh, cfg.heads, t, d), jnp.float32)
+            lens = jnp.full((bh,), t, jnp.int32)
+            os.environ["PADDLE_TPU_DECODE_MIN_T"] = "1"  # force kernel
+            try:
+                ker = timed(kernel_fn, q, k, v, lens)
+            finally:
+                os.environ.pop("PADDLE_TPU_DECODE_MIN_T", None)
+            ref = timed(ref_fn, q, k, v, lens)
+            rows[t] = (ker, ref)
+            if min_t is None and ker < ref:
+                min_t = t
+        autotune.record_decode_min_t(min_t or fd.DEFAULT_MIN_T,
+                                     rows=rows)
+        print("# decode_min_t sweep: %s -> min_t=%s"
+              % ({t: (round(c * 1e6), round(b * 1e6))
+                  for t, (c, b) in rows.items()},
+                 min_t or fd.DEFAULT_MIN_T), flush=True)
+
+    label = ("gpt_small" if not on_tpu else "gpt_small_tpu")
+    print(json.dumps({
+        "metric": "gpt_small_decode_tokens_per_sec",
+        "value": round(tps_kv, 1),
+        "unit": "tokens/sec (%s bs%d prompt%d new%d Tmax%d, KV-cache "
+                "decode_loop vs naive full-recompute %.1f tok/s -> "
+                "%.1fx, on %s)"
+                % (label, batch, prompt, new, cfg.max_len, tps_nv,
+                   speedup, kind),
+        "vs_baseline": round(speedup / 2.0, 3),  # bar: >= 2x naive
+    }), flush=True)
+    print(json.dumps({
+        "metric": "gpt_small_time_to_first_token_ms",
+        "value": round(ttft_kv * 1e3, 1),
+        "unit": "ms (first run incl jit compile; naive arm %.1f ms; "
+                "steady decode is the tokens_per_sec line)"
+                % (ttft_nv * 1e3),
+        "vs_baseline": round(ttft_nv / max(ttft_kv, 1e-9), 3),
+    }), flush=True)
+
+    if speedup < 2.0:
+        print("# DECODE GATE FAILED: kv-cache %.1f tok/s < 2x naive "
+              "%.1f tok/s" % (tps_kv, tps_nv), file=sys.stderr,
+              flush=True)
+        raise SystemExit(1)
+
+
 def child_elastic():
     """Elastic-training recovery drill (ISSUE 12): run the chaos
     elastic scenario — 3 workers, kill one mid-run — and report
@@ -1807,7 +1935,7 @@ def main():
                 ("bert512", 270), ("infer", 220), ("bert_infer", 200),
                 ("fusion", 150), ("kernels", 220), ("planner", 220),
                 ("observability", 150), ("tracing", 150),
-                ("serving", 200), ("elastic", 240)]
+                ("serving", 200), ("decode", 200), ("elastic", 240)]
         failed = []
         for mode, cap in plan:
             if remaining(cap) < 90:
@@ -1868,7 +1996,8 @@ def main():
         print("# TPU unavailable: %s — emitting CPU smoke + captured "
               "hardware lines (if any)" % reason, flush=True)
         for mode in ("ctr", "bert", "fusion", "kernels", "planner",
-                     "observability", "tracing", "serving", "elastic"):
+                     "observability", "tracing", "serving", "decode",
+                     "elastic"):
             env_extra = {"PADDLE_BENCH_FORCE_CPU": "1"}
             if mode == "planner":
                 # the CPU smoke needs a virtual mesh for a real DP A/B
@@ -1951,6 +2080,8 @@ if __name__ == "__main__":
             child_planner()
         elif mode == "serving":
             child_serving()
+        elif mode == "decode":
+            child_decode()
         elif mode == "elastic":
             child_elastic()
         elif mode == "lint":

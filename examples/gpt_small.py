@@ -1,0 +1,363 @@
+"""gpt_small: a tiny decoder-only transformer and its TPU-native
+autoregressive generation program.
+
+The decode program is the ISSUE-14 tentpole exercised end to end:
+prefill fills a device-resident ring-buffer KV cache (static
+``[B, H, Tmax, Dh]`` shape, integer cursor), then
+``layers.decode_loop`` generates through a ``while_op`` whose body is a
+single-token transformer step — flash-decode attention against the
+cache, grad-free sampling — so the Executor's jit cache holds ONE
+entry for the whole generation regardless of generated length.
+
+    python examples/gpt_small.py [--cpu] [--batch N] [--prompt L]
+                                 [--new N] [--naive]
+
+``--naive`` runs the full-recompute A/B: same weights, but every step
+re-runs the whole prompt+generated prefix through the transformer
+(no KV cache) — the ~Tmax× more per-step work the cache removes.
+
+Reference analogue: ``fluid.layers.beam_search`` /
+``contrib.decoder.beam_search_decoder`` are the classic per-step-graph
+decoders this replaces (see MIGRATION.md "Autoregressive decoding").
+"""
+
+import argparse
+import math
+import time
+
+import _common  # noqa: E402 - repo-root path + bounded backend probe
+
+import numpy as np
+
+
+class GPTConfig:
+    def __init__(self, vocab=128, hidden=64, layers=2, heads=4,
+                 max_len=512, ffn=None, eos_id=None):
+        self.vocab = vocab
+        self.hidden = hidden
+        self.layers = layers
+        self.heads = heads
+        self.max_len = max_len
+        self.ffn = ffn or 4 * hidden
+        # eos outside the sampled range by default: examples/bench decode
+        # a fixed number of tokens unless the caller wires a real eos
+        self.eos_id = eos_id if eos_id is not None else vocab - 1
+
+
+GPT_TINY = GPTConfig()
+
+
+def _fluid():
+    import paddle_tpu as fluid
+
+    return fluid
+
+
+def _attr(name):
+    fluid = _fluid()
+    return fluid.ParamAttr(name=name)
+
+
+def _proj(x, size, name, flatten_dims):
+    fluid = _fluid()
+    return fluid.layers.fc(
+        x, size=size, num_flatten_dims=flatten_dims,
+        param_attr=_attr(name + ".w"), bias_attr=_attr(name + ".b"))
+
+
+def _ln(x, name, axis):
+    fluid = _fluid()
+    return fluid.layers.layer_norm(
+        x, begin_norm_axis=axis,
+        param_attr=_attr(name + ".scale"), bias_attr=_attr(name + ".bias"))
+
+
+def _embed(ids, cfg, table, rows):
+    fluid = _fluid()
+    return fluid.layers.embedding(
+        ids, size=[rows, cfg.hidden], param_attr=_attr(table))
+
+
+def _block_prefill(x, cfg, prefix, kc, vc):
+    """One transformer block over the full prompt [B, L, E]; writes this
+    layer's K/V rows into the ring caches (positions [0, L))."""
+    fluid = _fluid()
+    d, h = cfg.hidden, cfg.heads
+    dh = d // h
+
+    def split_heads(t):
+        t = fluid.layers.reshape(t, [0, 0, h, dh])
+        return fluid.layers.transpose(t, [0, 2, 1, 3])  # [B, H, L, dh]
+
+    q = split_heads(_proj(x, d, prefix + ".q", 2))
+    k = split_heads(_proj(x, d, prefix + ".k", 2))
+    v = split_heads(_proj(x, d, prefix + ".v", 2))
+    fluid.layers.kv_cache_prefill(kc, k)
+    fluid.layers.kv_cache_prefill(vc, v)
+    ctxv = fluid.layers.fused_multihead_attention(
+        q, k, v, causal=True, scale=1.0 / math.sqrt(dh))
+    ctxv = fluid.layers.transpose(ctxv, [0, 2, 1, 3])
+    ctxv = fluid.layers.reshape(ctxv, [0, 0, d])
+    x = _ln(fluid.layers.elementwise_add(
+        x, _proj(ctxv, d, prefix + ".o", 2)), prefix + ".ln1", 2)
+    m = _proj(x, cfg.ffn, prefix + ".fc1", 2)
+    m = fluid.layers.gelu(m)
+    x = _ln(fluid.layers.elementwise_add(
+        x, _proj(m, d, prefix + ".fc2", 2)), prefix + ".ln2", 2)
+    return x
+
+
+def _block_decode(x, cfg, prefix, kc, vc, cursor, lens, per_row=False):
+    """The same block over ONE token [B, E]: ring-buffer K/V write at
+    ``cursor``, flash-decode read over ``lens`` valid entries.  Shares
+    every parameter with :func:`_block_prefill` by name."""
+    fluid = _fluid()
+    d, h = cfg.hidden, cfg.heads
+    dh = d // h
+
+    def split_heads(t):
+        return fluid.layers.reshape(t, [0, h, dh])  # [B, H, dh]
+
+    q = split_heads(_proj(x, d, prefix + ".q", 1))
+    k = split_heads(_proj(x, d, prefix + ".k", 1))
+    v = split_heads(_proj(x, d, prefix + ".v", 1))
+    fluid.layers.kv_cache_write(kc, k, cursor, per_row=per_row)
+    fluid.layers.kv_cache_write(vc, v, cursor, per_row=per_row)
+    ctxv = fluid.layers.flash_decode(
+        q, kc, vc, lens, sm_scale=1.0 / math.sqrt(dh), per_row=per_row)
+    ctxv = fluid.layers.reshape(ctxv, [0, d])
+    x = _ln(fluid.layers.elementwise_add(
+        x, _proj(ctxv, d, prefix + ".o", 1)), prefix + ".ln1", 1)
+    m = _proj(x, cfg.ffn, prefix + ".fc1", 1)
+    m = fluid.layers.gelu(m)
+    x = _ln(fluid.layers.elementwise_add(
+        x, _proj(m, d, prefix + ".fc2", 1)), prefix + ".ln2", 1)
+    return x
+
+
+def _prefill_trunk(prompt, plen, cfg, caches, prompt_len):
+    """Embed the [B, L] prompt and run every block, filling the caches.
+    Returns the last REAL position's hidden state [B, E] (``plen`` may
+    be below the L bucket — prompt-length bucketing pads on the right).
+    """
+    fluid = _fluid()
+    x = _embed(prompt, cfg, "gpt.wte", cfg.vocab)  # [B, L, E]
+    pos = fluid.layers.range(0, prompt_len, 1, "int32")
+    pe = _embed(pos, cfg, "gpt.wpe", cfg.max_len)  # [L, E]
+    x = fluid.layers.elementwise_add(x, pe, axis=1)
+    for li in range(cfg.layers):
+        kc, vc = caches[li]
+        x = _block_prefill(x, cfg, "gpt.l%d" % li, kc, vc)
+    x = _ln(x, "gpt.lnf", 2)
+    # one-hot select of hidden[:, plen-1, :] — gather keeps shapes static
+    last = fluid.layers.increment(fluid.layers.assign(plen), value=-1,
+                                  in_place=True)
+    sel = fluid.layers.cast(
+        fluid.layers.one_hot(last, prompt_len), x.dtype)  # [1, L]
+    return fluid.layers.squeeze(fluid.layers.matmul(sel, x), [1])
+
+
+def _logits(x, cfg, flatten_dims=1):
+    return _proj(x, cfg.vocab, "gpt.head", flatten_dims)
+
+
+def _decode_step(cur, cursor, cfg, caches, lens, per_row=False):
+    fluid = _fluid()
+    x = _embed(cur, cfg, "gpt.wte", cfg.vocab)  # [B, E]
+    pe = _embed(cursor, cfg, "gpt.wpe", cfg.max_len)  # [1|B, E]
+    x = fluid.layers.elementwise_add(x, pe)
+    for li in range(cfg.layers):
+        kc, vc = caches[li]
+        x = _block_decode(x, cfg, "gpt.l%d" % li, kc, vc, cursor, lens,
+                          per_row=per_row)
+    x = _ln(x, "gpt.lnf", 1)
+    return _logits(x, cfg)
+
+
+def build_program(cfg=GPT_TINY, batch=2, prompt_len=8, max_new_tokens=8,
+                  strategy="greedy", temperature=1.0, top_k=8, top_p=0.9,
+                  seed=0, eos_id=None):
+    """The full generation program: prefill + recompile-free decode loop.
+
+    Returns ``(main, startup, feeds, tokens, gen_len)`` where ``feeds``
+    is ``["prompt_ids", "prompt_len"]`` (ids [B, L] int32; len [1]
+    int32, <= L).  ``tokens`` is [B, max_new_tokens] int32.
+    """
+    fluid = _fluid()
+    main, startup = fluid.Program(), fluid.Program()
+    dh = cfg.hidden // cfg.heads
+    with fluid.program_guard(main, startup):
+        # static [batch, L]: decode programs are bucketed per
+        # (batch, prompt-length) — no -1 dims anywhere in the loop
+        prompt = fluid.layers.data("prompt_ids",
+                                   shape=[batch, prompt_len],
+                                   dtype="int32",
+                                   append_batch_size=False)
+        plen = fluid.layers.data("prompt_len", shape=[1], dtype="int32",
+                                 append_batch_size=False)
+        caches = [
+            (fluid.layers.create_kv_cache(batch, cfg.heads, cfg.max_len,
+                                          dh),
+             fluid.layers.create_kv_cache(batch, cfg.heads, cfg.max_len,
+                                          dh))
+            for _ in range(cfg.layers)
+        ]
+        last_h = _prefill_trunk(prompt, plen, cfg, caches, prompt_len)
+        first = fluid.layers.sampling(
+            _logits(last_h, cfg), strategy=strategy, k=top_k, p=top_p,
+            temperature=temperature, seed=seed)
+
+        def step(cur, cursor, i):
+            lens = fluid.layers.increment(
+                fluid.layers.assign(cursor), value=1, in_place=True)
+            return _decode_step(cur, cursor, cfg, caches, lens)
+
+        tokens, gen_len = fluid.layers.decode_loop(
+            step, first, plen, max_new_tokens, eos_id=eos_id,
+            strategy=strategy, k=top_k, p=top_p,
+            temperature=temperature, seed=seed)
+    return main, startup, ["prompt_ids", "prompt_len"], tokens, gen_len
+
+
+def build_naive_program(cfg=GPT_TINY, batch=2, prompt_len=8,
+                        max_new_tokens=8):
+    """The A/B baseline: NO KV cache — each step re-embeds the whole
+    [B, Tmax] token buffer and re-runs every block over all Tmax
+    positions (causal-masked), then reads the logits at the cursor.
+    Shapes stay static (it still compiles once — the honest baseline:
+    same jit behavior, ~Tmax× the per-step attention/FFN work), making
+    the A/B measure the CACHE, not recompilation artifacts."""
+    fluid = _fluid()
+    main, startup = fluid.Program(), fluid.Program()
+    t = cfg.max_len
+    with fluid.program_guard(main, startup):
+        prompt = fluid.layers.data("prompt_ids",
+                                   shape=[batch, prompt_len],
+                                   dtype="int32",
+                                   append_batch_size=False)
+        plen = fluid.layers.data("prompt_len", shape=[1], dtype="int32",
+                                 append_batch_size=False)
+        # token buffer [B, Tmax]: prompt left-aligned, zeros elsewhere
+        pad = fluid.layers.fill_constant([batch, t - prompt_len],
+                                         "int32", 0)
+        buf = fluid.layers.concat([prompt, pad], axis=1)
+
+        def full_forward(token_buf, pos_count):
+            x = _embed(token_buf, cfg, "gpt.wte", cfg.vocab)  # [B,T,E]
+            pos = fluid.layers.range(0, t, 1, "int32")
+            pe = _embed(pos, cfg, "gpt.wpe", cfg.max_len)
+            x = fluid.layers.elementwise_add(x, pe, axis=1)
+            d, h = cfg.hidden, cfg.heads
+            dh = d // h
+            for li in range(cfg.layers):
+                prefix = "gpt.l%d" % li
+
+                def split_heads(tt):
+                    tt = fluid.layers.reshape(tt, [0, 0, h, dh])
+                    return fluid.layers.transpose(tt, [0, 2, 1, 3])
+
+                q = split_heads(_proj(x, d, prefix + ".q", 2))
+                k = split_heads(_proj(x, d, prefix + ".k", 2))
+                v = split_heads(_proj(x, d, prefix + ".v", 2))
+                ctxv = fluid.layers.fused_multihead_attention(
+                    q, k, v, causal=True, scale=1.0 / math.sqrt(dh))
+                ctxv = fluid.layers.transpose(ctxv, [0, 2, 1, 3])
+                ctxv = fluid.layers.reshape(ctxv, [0, 0, d])
+                x = _ln(fluid.layers.elementwise_add(
+                    x, _proj(ctxv, d, prefix + ".o", 2)),
+                    prefix + ".ln1", 2)
+                m = _proj(x, cfg.ffn, prefix + ".fc1", 2)
+                m = fluid.layers.gelu(m)
+                x = _ln(fluid.layers.elementwise_add(
+                    x, _proj(m, d, prefix + ".fc2", 2)),
+                    prefix + ".ln2", 2)
+            x = _ln(x, "gpt.lnf", 2)
+            sel = fluid.layers.cast(
+                fluid.layers.one_hot(pos_count, t), x.dtype)  # [1, T]
+            return _logits(
+                fluid.layers.squeeze(fluid.layers.matmul(sel, x), [1]),
+                cfg)
+
+        last = fluid.layers.increment(fluid.layers.assign(plen),
+                                      value=-1, in_place=True)
+        first = fluid.layers.sampling(full_forward(buf, last),
+                                      strategy="greedy")
+
+        def step(cur, cursor, i):
+            # scatter this token into the buffer at the cursor column,
+            # then recompute EVERYTHING
+            onehot = fluid.layers.one_hot(cursor, t)  # [1, T] f32
+            keep = fluid.layers.cast(
+                fluid.layers.scale(onehot, scale=-1.0, bias=1.0),
+                "int32")
+            add = fluid.layers.cast(onehot, "int32")
+            upd = fluid.layers.elementwise_add(
+                fluid.layers.elementwise_mul(buf, keep),
+                fluid.layers.elementwise_mul(
+                    add, fluid.layers.unsqueeze(cur, [1])))
+            fluid.layers.assign(upd, output=buf)
+            return full_forward(buf, cursor)
+
+        tokens, gen_len = fluid.layers.decode_loop(
+            step, first, plen, max_new_tokens, strategy="greedy")
+    return main, startup, ["prompt_ids", "prompt_len"], tokens, gen_len
+
+
+def make_fake_prompt(batch, prompt_len, cfg, rng):
+    ids = rng.randint(1, cfg.vocab - 1,
+                      size=(batch, prompt_len)).astype("int32")
+    return {"prompt_ids": ids,
+            "prompt_len": np.array([prompt_len], "int32")}
+
+
+def run_generate(build, cfg, batch, prompt_len, max_new_tokens, seed=0):
+    """Build + run one generation; returns (tokens, gen_len, ttft_s,
+    steady_tokens_per_sec).  TTFT is the (compiled) first run; the rate
+    comes from a second, cache-warm run."""
+    fluid = _fluid()
+    from paddle_tpu.executor import Scope, scope_guard
+
+    fluid.unique_name.switch()
+    main, startup, feeds, tokens, gen_len = build()
+    exe = fluid.Executor(fluid.TPUPlace())
+    rng = np.random.RandomState(seed)
+    feed = make_fake_prompt(batch, prompt_len, cfg, rng)
+    with scope_guard(Scope()):
+        exe.run(startup)
+        t0 = time.perf_counter()
+        out = exe.run(main, feed=feed, fetch_list=[tokens, gen_len])
+        ttft = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out = exe.run(main, feed=feed, fetch_list=[tokens, gen_len])
+        dt = time.perf_counter() - t0
+    total = int(np.sum(out[1]))
+    return out[0], out[1], ttft, (total / dt if dt > 0 else 0.0)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--prompt", type=int, default=8)
+    ap.add_argument("--new", type=int, default=16)
+    ap.add_argument("--naive", action="store_true",
+                    help="full-recompute A/B baseline (no KV cache)")
+    args = ap.parse_args()
+    _common.pick_backend(force_cpu=args.cpu)
+
+    cfg = GPT_TINY
+    if args.naive:
+        build = lambda: build_naive_program(  # noqa: E731
+            cfg, args.batch, args.prompt, args.new)
+    else:
+        build = lambda: build_program(  # noqa: E731
+            cfg, args.batch, args.prompt, args.new)
+    toks, glen, ttft, tps = run_generate(
+        build, cfg, args.batch, args.prompt, args.new)
+    print("mode=%s tokens/sec=%.1f ttft_ms=%.1f"
+          % ("naive" if args.naive else "kv-cache", tps, ttft * 1e3))
+    print("generated:", toks[:, :12].tolist())
+
+
+if __name__ == "__main__":
+    main()

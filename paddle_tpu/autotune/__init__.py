@@ -21,7 +21,8 @@ from .cache import (SCHEMA_VERSION, autotune_enabled, cache_path,
                     state_token)
 from .harness import (cached_block_cap, cached_params,
                       calibration_factor, calibrations, decide_threshold,
-                      flash_min_t_decision, record_flash_min_t, sweep,
+                      decode_min_t_decision, flash_min_t_decision,
+                      record_decode_min_t, record_flash_min_t, sweep,
                       sweep_signature, time_candidate)
 
 __all__ = [
@@ -30,4 +31,5 @@ __all__ = [
     "time_candidate", "sweep", "sweep_signature", "cached_params",
     "cached_block_cap", "decide_threshold", "flash_min_t_decision",
     "record_flash_min_t", "calibration_factor", "calibrations",
+    "decode_min_t_decision", "record_decode_min_t",
 ]

@@ -233,6 +233,35 @@ def record_flash_min_t(min_t, rows=None, backend=None):
     return record(signature(_FLASH_MIN_T_FAMILY, backend=backend), entry)
 
 
+_DECODE_MIN_T_FAMILY = "decode_min_t"
+
+
+def decode_min_t_decision():
+    """The cached flash-*decode* engagement threshold for this backend,
+    or None.  Consumed by ``ops.pallas.flash_decode.decode_min_t()``
+    when ``PADDLE_TPU_DECODE_MIN_T`` is unset — same contract as
+    :func:`flash_min_t_decision` for the prefill kernel."""
+    hit = lookup(sweep_signature(_DECODE_MIN_T_FAMILY, {}))
+    if hit is None:
+        return None
+    try:
+        t = int(hit.get("params", {}).get("min_t"))
+    except (TypeError, ValueError):
+        return None
+    return t if t > 0 else None
+
+
+def record_decode_min_t(min_t, rows=None, backend=None):
+    """Persist a decode engagement threshold (bench ``--child decode``
+    sweep or a manual on-chip run); mirrors :func:`record_flash_min_t`
+    including the explicit-backend provenance rule."""
+    backend = _norm_backend(backend) if backend else _backend()
+    entry = {"params": {"min_t": int(min_t)}, "backend": backend}
+    if rows:
+        entry["rows"] = {str(t): [c, b] for t, (c, b) in rows.items()}
+    return record(signature(_DECODE_MIN_T_FAMILY, backend=backend), entry)
+
+
 # ---------------------------------------------------------------------------
 # calibration factors (the cost-model feedback loop)
 # ---------------------------------------------------------------------------

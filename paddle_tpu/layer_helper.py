@@ -105,10 +105,16 @@ class LayerHelper:
 
         shape = [int(s) for s in shape]
         startup_block = self.startup_program.global_block()
+        # a re-declared shared parameter (same ParamAttr name — e.g. the
+        # prefill and decode-step subgraphs of one generation program)
+        # is ONE var: initialize it once, or startup double-writes the
+        # buffer (a donation-aliasing hazard the lint rightly flags)
+        redeclared = attr.name in startup_block.vars
         sp = startup_block.create_parameter(
             shape=shape, dtype=dtype, **attr._to_kwargs(with_initializer=False)
         )
-        attr.initializer(sp, startup_block)
+        if not redeclared:
+            attr.initializer(sp, startup_block)
         main_block = self.main_program.global_block()
         return main_block.create_parameter(
             shape=shape, dtype=dtype, **attr._to_kwargs()

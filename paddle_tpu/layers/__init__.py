@@ -13,6 +13,7 @@ from . import metric_op
 from . import detection
 from . import detection_extra
 from . import beam
+from . import decode
 from . import learning_rate_scheduler
 from . import collective
 from . import math_op_patch  # noqa: F401  (Variable operator overloads)
@@ -32,6 +33,7 @@ from .metric_op import *  # noqa: F401,F403
 from .detection import *  # noqa: F401,F403
 from .detection_extra import *  # noqa: F401,F403
 from .beam import *  # noqa: F401,F403
+from .decode import *  # noqa: F401,F403
 from .learning_rate_scheduler import *  # noqa: F401,F403
 
 __all__ = (
@@ -47,5 +49,6 @@ __all__ = (
     + detection.__all__
     + detection_extra.__all__
     + beam.__all__
+    + decode.__all__
     + learning_rate_scheduler.__all__
 )

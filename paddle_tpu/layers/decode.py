@@ -1,0 +1,244 @@
+"""Autoregressive decoding layers: KV cache, flash-decode attention,
+sampling, and the recompile-free ``decode_loop``.
+
+The reference's generation stack (``fluid.layers.beam_search`` /
+``beam_search_decode`` and ``contrib.decoder.beam_search_decoder``)
+rebuilds a per-step graph over a growing sequence; the TPU-native
+formulation here keeps every shape static — a ring-buffer KV cache
+(``create_kv_cache`` + ``kv_cache_write``) with an integer cursor, a
+single-query flash-decode attention read, and a ``while_op`` loop whose
+body lowers to ONE jaxpr for the whole generation.  The jit cache holds
+one entry per (batch, prompt-bucket) regardless of generated length,
+and the loop is grad-free end to end so the executor never takes the
+unbounded-while host-probing path (the PR-10 zero-sync certificate
+holds over the decode hot loop).
+
+``beam_search.py`` remains the classic path; the sampling ops here
+(greedy / temperature / top-k / top-p) are the modern serving path.
+"""
+
+from ..layer_helper import LayerHelper
+from . import tensor as tensor_layers
+from . import control_flow as cf_layers
+from . import nn as nn_layers
+
+__all__ = [
+    "create_kv_cache", "kv_cache_write", "kv_cache_prefill",
+    "flash_decode", "top_k_sampling", "top_p_sampling",
+    "greedy_sampling", "sampling", "decode_loop",
+]
+
+
+def create_kv_cache(batch, heads, max_len, head_dim, dtype="float32",
+                    name=None):
+    """A zero-initialized ring-buffer cache var [batch, heads, max_len,
+    head_dim] with a STATIC max shape — the device-resident buffer the
+    decode loop writes through its cursor.  ``batch`` may be -1 (batch
+    dim resolved by the feed bucket)."""
+    shape = [batch, heads, max_len, head_dim]
+    if batch == -1:
+        # materialized full-shape per feed bucket by fill_constant's
+        # batch-size-like expansion path
+        raise ValueError(
+            "create_kv_cache needs a static batch (the serving bucket "
+            "size); got -1")
+    return tensor_layers.fill_constant(shape, dtype, 0.0)
+
+
+def _append(op_type, inputs, outputs, attrs):
+    helper = LayerHelper(op_type)
+    helper.append_op(type=op_type, inputs=inputs, outputs=outputs,
+                     attrs=attrs)
+
+
+def kv_cache_write(cache, x, cursor, per_row=False, in_place=True,
+                   name=None):
+    """Write this step's K (or V) [B, H, D] into ``cache`` at ``cursor``
+    (ring semantics).  With ``in_place`` (default) the op writes the
+    cache var itself — inside a ``While`` body that is what makes the
+    cache a loop carry, exactly like ``increment``'s counter idiom."""
+    helper = LayerHelper("kv_cache_write", **locals())
+    out = cache if in_place else \
+        helper.create_variable_for_type_inference(cache.dtype)
+    helper.append_op(
+        type="kv_cache_write",
+        inputs={"Cache": [cache], "X": [x], "Cursor": [cursor]},
+        outputs={"Out": [out]},
+        attrs={"per_row": bool(per_row)},
+    )
+    return out
+
+
+def kv_cache_prefill(cache, x, slot=None, in_place=True, name=None):
+    """Bulk-write a prompt's K/V [B, H, L, D] into cache rows [0, L).
+    ``slot`` ([1] int32 var) routes a batch-1 prefill into that cache
+    row — the serving path that admits a request into a free slot."""
+    helper = LayerHelper("kv_cache_prefill", **locals())
+    out = cache if in_place else \
+        helper.create_variable_for_type_inference(cache.dtype)
+    inputs = {"Cache": [cache], "X": [x]}
+    if slot is not None:
+        inputs["Slot"] = [slot]
+    helper.append_op(type="kv_cache_prefill", inputs=inputs,
+                     outputs={"Out": [out]}, attrs={})
+    return out
+
+
+def flash_decode(q, k_cache, v_cache, cursor, sm_scale=None,
+                 per_row=False, name=None):
+    """Single-query attention [B, H, D] against the ring cache, masked
+    to ``cursor`` valid entries (Pallas flash-decode kernel on TPU, XLA
+    composite elsewhere — ops/pallas/flash_decode.py)."""
+    helper = LayerHelper("flash_decode", **locals())
+    out = helper.create_variable_for_type_inference(q.dtype)
+    attrs = {"per_row": bool(per_row)}
+    if sm_scale is not None:
+        attrs["sm_scale"] = float(sm_scale)
+    helper.append_op(
+        type="flash_decode_attention",
+        inputs={"Q": [q], "KCache": [k_cache], "VCache": [v_cache],
+                "Cursor": [cursor]},
+        outputs={"Out": [out]},
+        attrs=attrs,
+    )
+    return out
+
+
+def _sampling_op(op_type, logits, attrs, step, name):
+    helper = LayerHelper(op_type, logits=logits, name=name)
+    out = helper.create_variable_for_type_inference("int32")
+    inputs = {"X": [logits]}
+    if step is not None:
+        inputs["Step"] = [step]
+    helper.append_op(type=op_type, inputs=inputs,
+                     outputs={"Out": [out]}, attrs=attrs)
+    return out
+
+
+def top_k_sampling(logits, k=1, temperature=1.0, seed=0, step=None,
+                   name=None):
+    """Token ids [B] sampled from the top-k of logits [B, V]; ``k=1``
+    or ``temperature<=0`` is greedy argmax.  ``step`` (the loop index
+    var) decorrelates draws across decode steps."""
+    return _sampling_op(
+        "top_k_sampling", logits,
+        {"k": int(k), "temperature": float(temperature),
+         "seed": int(seed)}, step, name)
+
+
+def top_p_sampling(logits, p=0.9, temperature=1.0, seed=0, step=None,
+                   name=None):
+    """Nucleus sampling over logits [B, V]: smallest descending-softmax
+    prefix reaching mass ``p`` (head token always kept)."""
+    return _sampling_op(
+        "top_p_sampling", logits,
+        {"p": float(p), "temperature": float(temperature),
+         "seed": int(seed)}, step, name)
+
+
+def greedy_sampling(logits, name=None):
+    """Argmax token ids [B] — the deterministic decode path."""
+    return top_k_sampling(logits, k=1, temperature=0.0, name=name)
+
+
+def sampling(logits, strategy="greedy", k=8, p=0.9, temperature=1.0,
+             seed=0, step=None, name=None):
+    """Dispatch to greedy / top-k / top-p by name (the decode_loop and
+    serving tenant-config entry point)."""
+    if strategy == "greedy":
+        return greedy_sampling(logits, name=name)
+    if strategy == "top_k":
+        return top_k_sampling(logits, k=k, temperature=temperature,
+                              seed=seed, step=step, name=name)
+    if strategy == "top_p":
+        return top_p_sampling(logits, p=p, temperature=temperature,
+                              seed=seed, step=step, name=name)
+    raise ValueError("unknown sampling strategy %r "
+                     "(greedy|top_k|top_p)" % (strategy,))
+
+
+def decode_loop(step_fn, first_ids, prompt_len, max_new_tokens,
+                eos_id=None, strategy="greedy", k=8, p=0.9,
+                temperature=1.0, seed=0, name=None):
+    """The recompile-free generation loop.
+
+    ``step_fn(cur_ids, cursor, step) -> logits`` builds ONE decode step:
+    embed ``cur_ids`` [B] at position ``cursor`` [1], write K/V through
+    :func:`kv_cache_write`, attend with :func:`flash_decode`, and return
+    next-token logits [B, V].  ``first_ids`` [B] is the first generated
+    token (sampled from the prefill's last-position logits);
+    ``prompt_len`` [1] int32 is the cursor start.
+
+    Returns ``(tokens, gen_len)``: tokens [B, max_new_tokens] int32,
+    gen_len [B] int32.  A row that hits eos keeps emitting eos until
+    every row is done; positions past the loop's early exit keep the
+    initial zero fill — slice each row with ``gen_len``.  The body
+    carries only static-shape state (ring caches via ``in_place``
+    writes, the [1] counters, the fixed-capacity token array), so the
+    whole generation is one jit-cache entry; with ``eos_id`` the loop
+    exits early once every row has finished — without changing shapes
+    or adding a host sync.
+    """
+    layers = _fluid_layers()
+    max_new_tokens = int(max_new_tokens)
+    if max_new_tokens < 1:
+        raise ValueError("max_new_tokens must be >= 1")
+
+    i = layers.fill_constant([1], "int32", 1)
+    limit = layers.fill_constant([1], "int32", max_new_tokens)
+    cursor = layers.assign(prompt_len)  # don't mutate the feed var
+    cur = layers.assign(first_ids)
+    ones = layers.cast(layers.equal(cur, cur), "int32")  # [B] of 1
+    gen_len = layers.assign(ones)
+    arr = layers.array_write(
+        layers.unsqueeze(cur, [1]),
+        layers.fill_constant([1], "int32", 0),
+        capacity=max_new_tokens)
+
+    if eos_id is not None:
+        eos_c = layers.fill_constant([1], "int32", int(eos_id))
+        finished = layers.equal(cur, eos_c)
+        running = layers.logical_not(layers.reduce_all(finished))
+        cond = layers.logical_and(layers.less_than(i, limit), running)
+    else:
+        finished = None
+        cond = layers.less_than(i, limit)
+
+    w = cf_layers.While(cond, max_trip_count=max_new_tokens)
+    with w.block():
+        logits = step_fn(cur, cursor, i)
+        nxt = sampling(logits, strategy=strategy, k=k, p=p,
+                       temperature=temperature, seed=seed, step=i)
+        if eos_id is not None:
+            # rows already finished keep emitting eos; live rows count
+            # this token
+            nxt = layers.where(finished, layers.elementwise_mul(
+                ones, eos_c), nxt)
+            live = layers.cast(layers.logical_not(finished), "int32")
+            layers.assign(layers.elementwise_add(gen_len, live),
+                          output=gen_len)
+            layers.assign(
+                layers.logical_or(finished, layers.equal(nxt, eos_c)),
+                output=finished)
+        layers.array_write(layers.unsqueeze(nxt, [1]), i, array=arr)
+        layers.assign(nxt, output=cur)
+        layers.increment(i, value=1, in_place=True)
+        layers.increment(cursor, value=1, in_place=True)
+        if eos_id is not None:
+            running = layers.logical_not(layers.reduce_all(finished))
+            layers.assign(
+                layers.logical_and(layers.less_than(i, limit), running),
+                output=cond)
+        else:
+            layers.less_than(i, limit, cond=cond)
+
+    tokens, _ = tensor_layers.tensor_array_to_tensor(arr, axis=1)
+    return tokens, gen_len
+
+
+def _fluid_layers():
+    """The assembled layers namespace (avoids import cycles: this module
+    is imported by ``layers/__init__`` before the star-imports run)."""
+    from .. import layers as L
+
+    return L

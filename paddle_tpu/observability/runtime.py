@@ -26,6 +26,8 @@ __all__ = [
     "record_serving_done", "record_serving_queue_wait",
     "record_serving_sync", "set_serving_depths",
     "set_serving_throughput",
+    "record_decode_tokens", "record_decode_request",
+    "set_decode_throughput",
     "record_checkpoint_save", "record_checkpoint_load", "record_retry",
     "record_fault", "record_worker_lost", "record_missed_beat",
     "record_concurrency_check", "record_replan", "record_reshard",
@@ -312,6 +314,31 @@ def set_serving_throughput(qps):
     if not telemetry_enabled():
         return
     _named(_m.gauge, "serving_throughput_qps").set(qps)
+
+
+def record_decode_tokens(tenant, n):
+    """``n`` tokens generated this decode step across a tenant's active
+    slots (the autoregressive analogue of serving_rows_total)."""
+    if not telemetry_enabled():
+        return
+    _m.counter("serving_decode_tokens_total", tenant=tenant).inc(n)
+
+
+def record_decode_request(tenant, generated_len, ttft_ms=None):
+    """One generation request finished: its generated length (the
+    per-request histogram capacity planning reads) and, when known, its
+    time-to-first-token."""
+    if not telemetry_enabled():
+        return
+    _named(_m.histogram, "serving_generated_len").observe(generated_len)
+    if ttft_ms is not None:
+        _named(_m.histogram, "serving_ttft_ms").observe(ttft_ms)
+
+
+def set_decode_throughput(tokens_per_sec):
+    if not telemetry_enabled():
+        return
+    _named(_m.gauge, "decode_tokens_per_sec").set(tokens_per_sec)
 
 
 # ---------------------------------------------------------------------------

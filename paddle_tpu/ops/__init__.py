@@ -32,6 +32,7 @@ from . import collective  # noqa: F401
 from . import detection  # noqa: F401
 from . import metrics  # noqa: F401
 from . import beam_search  # noqa: F401
+from . import decode  # noqa: F401
 from . import quantize  # noqa: F401
 from . import vision  # noqa: F401
 from . import losses  # noqa: F401

@@ -1,0 +1,173 @@
+"""Autoregressive decoding ops: ring-buffer KV cache and sampling.
+
+The recompile-free decode contract: the KV cache is a device-resident
+ring buffer with a STATIC max shape (``[B, H, Tmax, D]``) and an integer
+write cursor, so every decode step lowers to the same jaxpr regardless
+of how many tokens have been generated — the Executor's jit cache holds
+ONE entry for the whole generation (the reference's
+``DecoderBase``/``TrainingHelper`` per-step graphs re-specialize on the
+growing sequence; see the ``decode-shape-unbucketed`` lint check).
+
+All ops here are grad-free forward-only registrations (the
+LoDTensorArray pattern in ``ops/control_flow.py``): generation is pure
+inference, and keeping the while body grad-free is what keeps the
+executor off the unbounded-while host-probing path (a per-step host
+sync that would fail the PR-10 zero-sync certificate).
+
+Cursor convention: ``Cursor`` is int32 of shape ``[1]`` (shared scalar
+cursor — every row at the same position, the single-program decode
+loop) or ``[B]`` with attr ``per_row=True`` (continuous batching:
+each serving slot is at its own generation depth).  Writes wrap at
+``Tmax`` (ring semantics); reads mask to ``min(cursor, Tmax)``.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op
+
+NEG_INF = -1e30
+
+
+def _cursor_starts(Cursor, per_row, batch):
+    """int32 [B] positions from a [1]/[] shared cursor or [B] per-row."""
+    cur = jnp.asarray(Cursor, jnp.int32).reshape(-1)
+    if per_row:
+        return jnp.broadcast_to(cur, (batch,))
+    return jnp.broadcast_to(cur[0], (batch,))
+
+
+def _norm_kv(X, cache):
+    """New K/V entries as [B, H, 1, D] (accepts [B, H, D] too)."""
+    if X.ndim == cache.ndim - 1:
+        X = X[:, :, None, :]
+    return X.astype(cache.dtype)
+
+
+@register_op("kv_cache_write", inputs=["Cache", "X", "Cursor"],
+             outputs=["Out"], no_grad=True)
+def kv_cache_write(ctx, attrs, Cache, X, Cursor):
+    """Write this step's K (or V) row into the ring cache at the cursor.
+
+    Cache [B, H, Tmax, D]; X [B, H, D] (or [B, H, 1, D]); Cursor [1] or
+    [B] (``per_row=True``).  Position wraps at Tmax — the ring-buffer
+    half of the static-shape contract.  The shared-cursor path is a
+    single ``dynamic_update_slice``; the per-row path is a one-hot
+    masked merge (each serving slot writes its own depth).
+    """
+    b, h, t, d = Cache.shape
+    X = _norm_kv(X, Cache)
+    per_row = bool(attrs.get("per_row", False))
+    if not per_row:
+        pos = jnp.asarray(Cursor, jnp.int32).reshape(-1)[0] % t
+        return lax.dynamic_update_slice(Cache, X, (0, 0, pos, 0))
+    pos = _cursor_starts(Cursor, True, b) % t          # [B]
+    onehot = jax.nn.one_hot(pos, t, dtype=Cache.dtype)  # [B, T]
+    m = onehot[:, None, :, None]                        # [B, 1, T, 1]
+    return Cache * (1.0 - m) + X * m
+
+
+@register_op("kv_cache_prefill", inputs=["Cache", "X", "Slot"],
+             outputs=["Out"], no_grad=True)
+def kv_cache_prefill(ctx, attrs, Cache, X, Slot):
+    """Bulk-write a prompt's K/V rows into cache positions [0, L).
+
+    Cache [B, H, Tmax, D]; X [B, H, L, D] (L static — the prompt
+    bucket).  With ``Slot`` given ([1] int32), X is [1, H, L, D] and
+    lands in cache row ``slot`` — the serving path that carves the
+    per-slot cache blocks out of one resident buffer.
+    """
+    X = X.astype(Cache.dtype)
+    if Slot is None:
+        return lax.dynamic_update_slice(Cache, X, (0, 0, 0, 0))
+    slot = jnp.asarray(Slot, jnp.int32).reshape(-1)[0]
+    return lax.dynamic_update_slice(Cache, X, (slot, 0, 0, 0))
+
+
+@register_op("flash_decode_attention",
+             inputs=["Q", "KCache", "VCache", "Cursor"],
+             outputs=["Out"], no_grad=True)
+def flash_decode_attention(ctx, attrs, Q, KCache, VCache, Cursor):
+    """Single-query attention against the ring cache, masked to the
+    cursor.  Q [B, H, D] (or [B, H, 1, D]); caches [B, H, Tmax, D];
+    Cursor = number of VALID entries (typically prompt_len + step + 1).
+    Pallas flash-decode kernel on TPU past the measured engagement
+    threshold, XLA composite otherwise (ops/pallas/flash_decode.py)."""
+    from .pallas.flash_decode import flash_decode
+
+    squeeze = False
+    if Q.ndim == 4:
+        Q = Q[:, :, 0, :]
+        squeeze = True
+    b, h, d = Q.shape
+    t = KCache.shape[2]
+    sm_scale = attrs.get("sm_scale")
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    per_row = bool(attrs.get("per_row", False))
+    lens = _cursor_starts(Cursor, per_row, b)
+    lens = jnp.minimum(lens, t)  # ring: at most Tmax entries are live
+    out = flash_decode(Q, KCache, VCache, lens, sm_scale=float(sm_scale))
+    return out[:, :, None, :] if squeeze else out
+
+
+def _sampling_key(ctx, attrs, Step):
+    """Deterministic per-(op, seed, step) key: the registry's derived
+    base key, folded with the user seed and the loop index so every
+    decode step draws fresh noise yet replays bit-exactly."""
+    key = ctx.rng()
+    key = jax.random.fold_in(key, int(attrs.get("seed", 0)) & 0x7FFFFFFF)
+    if Step is not None:
+        step = jnp.asarray(Step, jnp.int32).reshape(-1)[0]
+        key = jax.random.fold_in(key, step)
+    return key
+
+
+@register_op("top_k_sampling", inputs=["X", "Step"], outputs=["Out"],
+             no_grad=True)
+def top_k_sampling(ctx, attrs, X, Step):
+    """Sample token ids from the top-k of each row of logits X [B, V].
+
+    attrs: ``k`` (1 = greedy), ``temperature`` (<= 0 = greedy argmax),
+    ``seed``.  ``Step`` (optional [1] int32, the decode loop index) is
+    folded into the RNG key — inside a while body the op lowers once,
+    so without it every step would redraw identical noise.  Gumbel-max
+    over the top-k keeps the draw a single fused argmax."""
+    k = int(attrs.get("k", 1))
+    temp = float(attrs.get("temperature", 1.0))
+    if k <= 1 or temp <= 0.0:
+        return jnp.argmax(X, axis=-1).astype(jnp.int32)
+    k = min(k, X.shape[-1])
+    vals, idx = lax.top_k(X, k)  # [B, k]
+    g = jax.random.gumbel(_sampling_key(ctx, attrs, Step), vals.shape,
+                          jnp.float32)
+    choice = jnp.argmax(vals.astype(jnp.float32) / temp + g, axis=-1)
+    out = jnp.take_along_axis(idx, choice[:, None], axis=1)[:, 0]
+    return out.astype(jnp.int32)
+
+
+@register_op("top_p_sampling", inputs=["X", "Step"], outputs=["Out"],
+             no_grad=True)
+def top_p_sampling(ctx, attrs, X, Step):
+    """Nucleus sampling: keep the smallest prefix of the descending
+    softmax whose mass reaches ``p`` (the head token always survives),
+    then gumbel-max over the survivors.  attrs: ``p``, ``temperature``
+    (<= 0 = greedy), ``seed``; ``Step`` as in top_k_sampling."""
+    p = float(attrs.get("p", 0.9))
+    temp = float(attrs.get("temperature", 1.0))
+    if temp <= 0.0:
+        return jnp.argmax(X, axis=-1).astype(jnp.int32)
+    order = jnp.argsort(-X, axis=-1)
+    sorted_logits = jnp.take_along_axis(X, order, axis=-1) / temp
+    probs = jax.nn.softmax(sorted_logits.astype(jnp.float32), axis=-1)
+    csum = jnp.cumsum(probs, axis=-1)
+    keep = (csum - probs) < p  # exclusive prefix mass: head always kept
+    masked = jnp.where(keep, sorted_logits.astype(jnp.float32), NEG_INF)
+    g = jax.random.gumbel(_sampling_key(ctx, attrs, Step), masked.shape,
+                          jnp.float32)
+    choice = jnp.argmax(masked + g, axis=-1)
+    out = jnp.take_along_axis(order, choice[:, None], axis=1)[:, 0]
+    return out.astype(jnp.int32)

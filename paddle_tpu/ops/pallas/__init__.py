@@ -17,5 +17,6 @@ Pallas path is selected on TPU (or when interpret-mode testing is forced).
 # from the submodule: `from paddle_tpu.ops.pallas.flash_attention
 # import flash_attention`.
 from . import flash_attention  # noqa: F401
+from . import flash_decode  # noqa: F401
 from . import conv_bn_act  # noqa: F401
 from . import embedding  # noqa: F401

@@ -8,8 +8,9 @@ loop, SLA shedding, per-tenant fairness, and a load generator.  CLI:
 """
 
 from .buckets import (BUCKET_CAP_ENV, BUCKETS_ENV, DEFAULT_BUCKETS,
-                      ShapeBuckets, bucket_cap, derive_buckets,
-                      parse_buckets, resolve_buckets)
+                      SEQ_BUCKETS_ENV, ShapeBuckets, bucket_cap,
+                      derive_buckets, parse_buckets, resolve_buckets)
+from .decode import DecodeEngine, DecodeRequest, GenerationConfig
 from .loadgen import make_feed_sampler, percentile, run_load
 from .server import (DeadlineExceededError, DispatcherCrashedError,
                      PredictorServer, QueueFullError, Request,
@@ -19,8 +20,12 @@ __all__ = [
     "BUCKETS_ENV",
     "BUCKET_CAP_ENV",
     "DEFAULT_BUCKETS",
+    "SEQ_BUCKETS_ENV",
     "DeadlineExceededError",
+    "DecodeEngine",
+    "DecodeRequest",
     "DispatcherCrashedError",
+    "GenerationConfig",
     "PredictorServer",
     "QueueFullError",
     "Request",

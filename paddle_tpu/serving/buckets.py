@@ -22,6 +22,7 @@ import numpy as np
 __all__ = [
     "BUCKETS_ENV",
     "BUCKET_CAP_ENV",
+    "SEQ_BUCKETS_ENV",
     "DEFAULT_BUCKETS",
     "ShapeBuckets",
     "bucket_cap",
@@ -32,6 +33,9 @@ __all__ = [
 
 BUCKETS_ENV = "PADDLE_TPU_SERVING_BUCKETS"
 BUCKET_CAP_ENV = "PADDLE_TPU_SERVING_BUCKET_CAP"
+# optional second bucket axis: padded sequence (prompt) lengths for
+# decode tenants — each (batch, seq) pair is one jit signature
+SEQ_BUCKETS_ENV = "PADDLE_TPU_SERVING_SEQ_BUCKETS"
 DEFAULT_BUCKETS = (1, 2, 4, 8)
 
 
@@ -90,12 +94,21 @@ def derive_buckets(observed_sizes, cap=None, max_batch=None):
     return tuple(sizes[i] for i in idx)
 
 
-def resolve_buckets(explicit=None, observed=None, cap=None):
+def resolve_buckets(explicit=None, observed=None, cap=None, seq=None,
+                    seq_observed=None):
     """Bucket-set precedence: explicit arg > env override > derived from
     observed traffic > :data:`DEFAULT_BUCKETS`.  Always returns a sorted
     tuple of at most ``cap`` sizes (explicit/env sets larger than the
     cap are rejected — a silent truncation would change which shapes
-    compile)."""
+    compile).
+
+    With a sequence-length axis requested — ``seq`` (explicit sizes),
+    the ``PADDLE_TPU_SERVING_SEQ_BUCKETS`` env, or ``seq_observed``
+    (observed prompt lengths) — the return value is the PAIR
+    ``(batch_sizes, seq_sizes)``: a decode tenant's jit signatures
+    cover (batch, prompt-length), one compile per pair.  With no seq
+    signal at all the single-axis return is unchanged — existing
+    callers never see the pair."""
     cap = bucket_cap() if cap is None else max(1, int(cap))
     if explicit is not None:
         sizes = parse_buckets(explicit)
@@ -112,15 +125,39 @@ def resolve_buckets(explicit=None, observed=None, cap=None):
             "bucket set %r exceeds the cap of %d buckets (raise %s or "
             "thin the set — every bucket is one jit signature)"
             % (sizes, cap, BUCKET_CAP_ENV))
-    return sizes
+    seq_env = os.environ.get(SEQ_BUCKETS_ENV)
+    if seq is None and not seq_env and not seq_observed:
+        return sizes
+    if seq is not None:
+        seq_sizes = parse_buckets(seq)
+    elif seq_env:
+        seq_sizes = parse_buckets(seq_env)
+    else:
+        seq_sizes = derive_buckets(seq_observed, cap=cap)
+    if len(sizes) * len(seq_sizes) > cap * cap:
+        raise ValueError(
+            "bucket grid %r x %r exceeds %d signatures (every "
+            "(batch, seq) pair is one jit compile)"
+            % (sizes, seq_sizes, cap * cap))
+    return sizes, seq_sizes
 
 
 class ShapeBuckets:
-    """The fixed bucket set plus the pad/slice mechanics."""
+    """The fixed bucket set plus the pad/slice mechanics.
 
-    def __init__(self, sizes=None, observed=None, cap=None):
-        self.sizes = resolve_buckets(explicit=sizes, observed=observed,
-                                     cap=cap)
+    ``seq_sizes`` adds the optional second axis (padded prompt lengths
+    for decode tenants); it stays None — and every existing behavior is
+    untouched — unless a seq signal is given."""
+
+    def __init__(self, sizes=None, observed=None, cap=None,
+                 seq_sizes=None, seq_observed=None):
+        resolved = resolve_buckets(explicit=sizes, observed=observed,
+                                   cap=cap, seq=seq_sizes,
+                                   seq_observed=seq_observed)
+        if isinstance(resolved[0], tuple):
+            self.sizes, self.seq_sizes = resolved
+        else:
+            self.sizes, self.seq_sizes = resolved, None
 
     @property
     def max_rows(self):
@@ -133,6 +170,31 @@ class ShapeBuckets:
             if s >= rows:
                 return s
         return None
+
+    def bucket_for_seq(self, length):
+        """Smallest sequence-length bucket that fits ``length``; None
+        when it exceeds the largest (the caller truncates or rejects).
+        Raises if no seq axis was configured."""
+        if self.seq_sizes is None:
+            raise ValueError(
+                "no sequence-length axis configured (pass seq_sizes/"
+                "seq_observed or set %s)" % SEQ_BUCKETS_ENV)
+        for s in self.seq_sizes:
+            if s >= length:
+                return s
+        return None
+
+    @staticmethod
+    def pad_seq(array, length, bucket, axis=1, value=0):
+        """Pad ``array`` (dim ``axis`` == ``length``) up to ``bucket``
+        along the sequence axis with ``value`` (decode programs mask by
+        prompt_len, so the pad content never matters); no-op when
+        already full."""
+        if length == bucket:
+            return array
+        widths = [(0, 0)] * array.ndim
+        widths[axis] = (0, bucket - length)
+        return np.pad(array, widths, constant_values=value)
 
     @staticmethod
     def pad_rows(array, rows, bucket):

@@ -1,0 +1,479 @@
+"""Continuous-batching autoregressive decode engine (the serving side
+of the ISSUE-14 tentpole).
+
+One resident KV cache per layer, shape ``[slots, H, Tmax, Dh]`` with a
+per-slot integer cursor (``per_row=True`` writes/reads), is carved into
+``slots`` independent cache blocks.  Requests flow through two program
+families that share the caches by (persistable) var name in the
+engine's private Scope:
+
+* **prefill** — one program per prompt-length bucket (the
+  :mod:`~paddle_tpu.serving.buckets` seq axis): feeds one request's
+  padded ``[1, L]`` prompt plus its slot index, writes K/V rows
+  ``[0, plen)`` into that slot's cache block and returns the first
+  sampled token.  Runs whenever a slot is FREE and a request is queued
+  — admission happens mid-stream, between decode steps, without
+  touching the other slots' state.
+* **decode step** — ONE program for all slots: feeds the current token
+  and cursor per slot, ring-writes K/V at each slot's own depth,
+  flash-decode-attends masked to each slot's cursor, samples the next
+  token per slot.  Every step is the same feed signature, so the jit
+  cache holds exactly one entry for the whole steady state regardless
+  of how long any request has been generating.
+
+The scheduler thread interleaves the two: step the active slots, drain
+finished requests, admit queued requests into the freed cache blocks,
+repeat.  The per-step host hop (the sampled ``[slots]`` token vector)
+is the admission decision — the device work itself stays one compiled
+program.  Telemetry: ``serving_decode_tokens_total``,
+``serving_generated_len`` / ``serving_ttft_ms`` histograms and the
+``decode_tokens_per_sec`` gauge (``tools.monitor``), plus
+``serving.prefill`` / ``serving.decode`` spans so ``tools.trace
+--serving`` attributes time between the two phases.
+"""
+
+import threading
+import time
+
+import numpy as np
+
+from ..observability import runtime as _obs
+from ..observability import tracing as _tr
+from .buckets import ShapeBuckets
+
+__all__ = ["DecodeEngine", "DecodeRequest", "GenerationConfig"]
+
+
+class GenerationConfig:
+    """Sampling knobs a decode tenant applies to every request."""
+
+    __slots__ = ("strategy", "k", "p", "temperature", "seed",
+                 "max_new_tokens", "eos_id")
+
+    def __init__(self, strategy="greedy", k=8, p=0.9, temperature=1.0,
+                 seed=0, max_new_tokens=64, eos_id=None):
+        self.strategy = strategy
+        self.k = int(k)
+        self.p = float(p)
+        self.temperature = float(temperature)
+        self.seed = int(seed)
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+
+
+class DecodeRequest:
+    """One generation request: a future resolving to
+    ``(tokens, info)`` — the generated ids (eos included when hit) and
+    ``{"generated_len", "ttft_ms", "latency_ms"}``."""
+
+    __slots__ = ("id", "prompt", "enqueue_ts", "_event", "_tokens",
+                 "_error", "info", "span", "first_token_ts")
+
+    def __init__(self, rid, prompt):
+        self.id = rid
+        self.prompt = prompt
+        self.enqueue_ts = time.time()
+        self._event = threading.Event()
+        self._tokens = None
+        self._error = None
+        self.info = {}
+        self.span = _tr.NULL_SPAN
+        self.first_token_ts = None
+
+    def done(self):
+        return self._event.is_set()
+
+    def result(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("decode request %r not completed within "
+                               "%ss" % (self.id, timeout))
+        if self._error is not None:
+            raise self._error
+        return self._tokens, self.info
+
+    def _complete(self, tokens):
+        self._tokens = list(tokens)
+        self.info["generated_len"] = len(self._tokens)
+        self.info["latency_ms"] = (time.time()
+                                   - self.enqueue_ts) * 1000.0
+        if self.first_token_ts is not None:
+            self.info["ttft_ms"] = (self.first_token_ts
+                                    - self.enqueue_ts) * 1000.0
+        self.span.set_attr("generated_len", len(self._tokens))
+        self.span.end("ok")
+        self._event.set()
+
+    def _fail(self, exc):
+        self._error = exc
+        self.span.end("error:%s" % type(exc).__name__)
+        self._event.set()
+
+
+class _Slot:
+    __slots__ = ("request", "cursor", "tokens", "finished")
+
+    def __init__(self):
+        self.request = None   # None == free cache block
+        self.cursor = 0
+        self.tokens = []
+        self.finished = False
+
+
+class DecodeEngine:
+    """The decode tenant a :class:`PredictorServer` serves.
+
+    ``model`` supplies the two graph builders (sharing parameters by
+    ParamAttr name):
+
+    * ``model.build_prefill(prompt, plen, slot, caches) -> logits`` —
+      prompt ``[1, L]`` ids, ``plen``/``slot`` ``[1]`` int32; must write
+      the prompt's K/V into cache row ``slot`` (``kv_cache_prefill``
+      with ``slot=``) and return the LAST real position's logits
+      ``[1, V]``.
+    * ``model.build_step(cur, cursors, caches) -> logits`` — ``cur``
+      ``[slots]`` ids, ``cursors`` ``[slots]`` int32 (each slot's own
+      depth); per-row ring-write + flash-decode; logits ``[slots, V]``.
+
+    plus ``model.cache_spec() -> (layers, heads, max_len, head_dim)``
+    and optionally ``model.init_params(program, startup, exe, scope)``
+    to load/initialize weights (called once inside the engine scope).
+    """
+
+    def __init__(self, model, slots=2, prompt_buckets=(32,),
+                 config=None, place=None, name="decode",
+                 auto_start=True):
+        import paddle_tpu as fluid
+        from ..executor import Scope
+
+        self.name = name
+        self.model = model
+        self.slots = int(slots)
+        self.config = config or GenerationConfig()
+        self.buckets = ShapeBuckets((1,), seq_sizes=prompt_buckets)
+        self.scope = Scope()
+        self.place = place if place is not None else fluid.TPUPlace()
+        self._exe = fluid.Executor(self.place)
+        self._layers, self._heads, self.max_len, self._head_dim = \
+            model.cache_spec()
+        self._cache_names = []
+        for li in range(self._layers):
+            self._cache_names.append(("%s.kcache.%d" % (name, li),
+                                      "%s.vcache.%d" % (name, li)))
+        self._slots = [_Slot() for _ in range(self.slots)]
+        self._queue = []
+        self._cond = threading.Condition()
+        self._running = False
+        self._closed = False
+        self._step_count = 0
+        self._tokens_done = 0
+        self._rate_t0 = None
+        self.stats_lock = threading.Lock()
+        self._counts = {"submitted": 0, "completed": 0, "failed": 0,
+                        "tokens": 0}
+        self._build_programs()
+        if auto_start:
+            self.start()
+
+    # ------------------------------------------------------------------
+    # graph construction
+    # ------------------------------------------------------------------
+
+    def _declare_caches(self, block):
+        """Declare the persistable resident caches in ``block``'s
+        program — every program family names the SAME vars, so they
+        alias one buffer in the engine scope."""
+        caches = []
+        for kn, vn in self._cache_names:
+            shape = [self.slots, self._heads, self.max_len,
+                     self._head_dim]
+            k = block.create_var(name=kn, shape=shape, dtype="float32",
+                                 persistable=True)
+            v = block.create_var(name=vn, shape=shape, dtype="float32",
+                                 persistable=True)
+            caches.append((k, v))
+        return caches
+
+    def _build_programs(self):
+        import paddle_tpu as fluid
+
+        cfg = self.config
+        fluid.unique_name.switch()
+
+        # init: zero the caches + the model's parameter init
+        init = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(init, startup):
+            for k, v in self._declare_caches(init.global_block()):
+                fluid.layers.fill_constant(
+                    [self.slots, self._heads, self.max_len,
+                     self._head_dim], "float32", 0.0, out=k)
+                fluid.layers.fill_constant(
+                    [self.slots, self._heads, self.max_len,
+                     self._head_dim], "float32", 0.0, out=v)
+
+        # prefill: one program per prompt-length bucket
+        self._prefill = {}
+        for L in self.buckets.seq_sizes:
+            main = fluid.Program()
+            with fluid.program_guard(main, startup):
+                prompt = fluid.layers.data(
+                    "prompt_ids", shape=[1, L], dtype="int32",
+                    append_batch_size=False)
+                plen = fluid.layers.data(
+                    "prompt_len", shape=[1], dtype="int32",
+                    append_batch_size=False)
+                slot = fluid.layers.data(
+                    "slot", shape=[1], dtype="int32",
+                    append_batch_size=False)
+                caches = self._declare_caches(main.global_block())
+                logits = self.model.build_prefill(prompt, plen, slot,
+                                                  caches)
+                first = fluid.layers.sampling(
+                    logits, strategy=cfg.strategy, k=cfg.k, p=cfg.p,
+                    temperature=cfg.temperature, seed=cfg.seed)
+            self._prefill[L] = (main, first.name)
+
+        # decode step: ONE program, all slots
+        main = fluid.Program()
+        with fluid.program_guard(main, startup):
+            cur = fluid.layers.data("cur_ids", shape=[self.slots],
+                                    dtype="int32",
+                                    append_batch_size=False)
+            cursors = fluid.layers.data("cursors", shape=[self.slots],
+                                        dtype="int32",
+                                        append_batch_size=False)
+            step = fluid.layers.data("step", shape=[1], dtype="int32",
+                                     append_batch_size=False)
+            caches = self._declare_caches(main.global_block())
+            logits = self.model.build_step(cur, cursors, caches)
+            nxt = fluid.layers.sampling(
+                logits, strategy=cfg.strategy, k=cfg.k, p=cfg.p,
+                temperature=cfg.temperature, seed=cfg.seed, step=step)
+        self._step_prog, self._step_fetch = main, nxt.name
+        #: the program PredictorServer stamps/verifies as the hot loop
+        self.program = main
+
+        self._exe.run(startup, scope=self.scope)
+        self._exe.run(init, scope=self.scope)
+        init_params = getattr(self.model, "init_params", None)
+        if init_params is not None:
+            init_params(self._step_prog, startup, self._exe, self.scope)
+
+    # the PredictorServer tenant-introspection surface
+    def get_input_names(self):
+        return ["prompt_ids"]
+
+    def get_output_names(self):
+        return [self._step_fetch]
+
+    # ------------------------------------------------------------------
+    # client side
+    # ------------------------------------------------------------------
+
+    def submit(self, prompt, request_id=None):
+        """Enqueue one prompt (1-D int array); returns the
+        :class:`DecodeRequest` future."""
+        prompt = np.asarray(prompt, dtype="int32").reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if prompt.size > self.max_len - 1:
+            raise ValueError(
+                "prompt of %d tokens exceeds the cache depth %d"
+                % (prompt.size, self.max_len))
+        if self.buckets.bucket_for_seq(prompt.size) is None:
+            raise ValueError(
+                "prompt of %d tokens exceeds the largest prompt "
+                "bucket (%d)" % (prompt.size,
+                                 self.buckets.seq_sizes[-1]))
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("decode engine is closed")
+            rid = request_id if request_id is not None \
+                else len(self._queue) + self._counts["submitted"]
+            req = DecodeRequest(rid, prompt)
+            req.span = _tr.start_span("serving.request",
+                                      tenant=self.name, request_id=rid,
+                                      prompt_len=int(prompt.size))
+            self._queue.append(req)
+            self._count("submitted")
+            self._cond.notify()
+        _obs.record_serving_request(self.name)
+        return req
+
+    # ------------------------------------------------------------------
+    # scheduler
+    # ------------------------------------------------------------------
+
+    def start(self):
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("decode engine is closed")
+            if self._running:
+                return self
+            self._running = True
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name="paddle_tpu-decode-%s" % self.name)
+        self._thread.start()
+        return self
+
+    def close(self, timeout=60.0):
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        t = getattr(self, "_thread", None)
+        if t is not None:
+            t.join(timeout)
+            self._thread = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def _active(self):
+        return [s for s in self._slots if s.request is not None]
+
+    def _loop(self):
+        try:
+            while True:
+                with self._cond:
+                    while (not self._closed and not self._queue
+                           and not self._active()):
+                        self._cond.wait(0.05)
+                    if (self._closed and not self._queue
+                            and not self._active()):
+                        return
+                self._admit()
+                if self._active():
+                    self._step()
+        except Exception as exc:  # noqa: BLE001 — fail everything
+            with self._cond:     # pending; never strand a caller
+                self._closed = True
+                pending = self._queue
+                self._queue = []
+            for s in self._slots:
+                if s.request is not None:
+                    pending.append(s.request)
+                    s.request = None
+            for r in pending:
+                if not r.done():
+                    r._fail(exc)
+                    self._count("failed")
+
+    def _admit(self):
+        """Fill free cache blocks from the queue: one prefill run per
+        admission, between decode steps — the other slots' caches and
+        cursors are untouched (their rows in the [slots, ...] buffer
+        are not written by this slot's kv_cache_prefill)."""
+        while True:
+            free = next((i for i, s in enumerate(self._slots)
+                         if s.request is None), None)
+            with self._cond:
+                if free is None or not self._queue:
+                    return
+                req = self._queue.pop(0)
+            L = self.buckets.bucket_for_seq(req.prompt.size)
+            padded = np.zeros((1, L), dtype="int32")
+            padded[0, :req.prompt.size] = req.prompt
+            main, fetch = self._prefill[L]
+            with _tr.span("serving.prefill", parent=req.span,
+                          tenant=self.name, slot=free, bucket=L,
+                          prompt_len=int(req.prompt.size)):
+                out = self._exe.run(
+                    main,
+                    feed={"prompt_ids": padded,
+                          "prompt_len": np.asarray([req.prompt.size],
+                                                   "int32"),
+                          "slot": np.asarray([free], "int32")},
+                    fetch_list=[fetch], scope=self.scope)
+            first = int(np.asarray(out[0]).reshape(-1)[0])
+            req.first_token_ts = time.time()
+            slot = self._slots[free]
+            slot.request = req
+            slot.cursor = int(req.prompt.size)
+            slot.tokens = [first]
+            slot.finished = (self.config.eos_id is not None
+                             and first == self.config.eos_id)
+
+    def _step(self):
+        """One decode step for every active slot (one jit signature),
+        then retire finished requests so their cache blocks free up."""
+        cur = np.zeros((self.slots,), dtype="int32")
+        cursors = np.zeros((self.slots,), dtype="int32")
+        active = []
+        for i, s in enumerate(self._slots):
+            if s.request is not None and not s.finished:
+                cur[i] = s.tokens[-1]
+                cursors[i] = s.cursor
+                active.append(i)
+        if active:
+            self._step_count += 1
+            with _tr.span("serving.decode_step", tenant=self.name,
+                          step=self._step_count, active=len(active)):
+                out = self._exe.run(
+                    self._step_prog,
+                    feed={"cur_ids": cur, "cursors": cursors,
+                          "step": np.asarray([self._step_count],
+                                             "int32")},
+                    fetch_list=[self._step_fetch], scope=self.scope)
+            nxt = np.asarray(out[0]).reshape(-1)
+            now = time.time()
+            if self._rate_t0 is None:
+                self._rate_t0 = now
+            self._tokens_done += len(active)
+            self._count("tokens", len(active))
+            _obs.record_decode_tokens(self.name, len(active))
+            span_s = now - self._rate_t0
+            if span_s > 0:
+                _obs.set_decode_throughput(self._tokens_done / span_s)
+            for i in active:
+                s = self._slots[i]
+                tok = int(nxt[i])
+                s.tokens.append(tok)
+                s.cursor += 1
+                if self.config.eos_id is not None \
+                        and tok == self.config.eos_id:
+                    s.finished = True
+        # retire: eos, generation budget, or cache depth exhausted
+        for s in self._slots:
+            if s.request is None:
+                continue
+            full = (len(s.tokens) >= self.config.max_new_tokens
+                    or s.cursor >= self.max_len - 1)
+            if s.finished or full:
+                req = s.request
+                s.request = None
+                # retroactive per-request decode span (first token →
+                # done) so `tools.trace --serving` splits the request's
+                # critical path into prefill vs decode
+                if req.first_token_ts is not None:
+                    _tr.start_span(
+                        "serving.decode", parent=req.span,
+                        start_ts=req.first_token_ts, tenant=self.name,
+                        tokens=len(s.tokens)).end(
+                        dur_ms=(time.time()
+                                - req.first_token_ts) * 1000.0)
+                req._complete(s.tokens)
+                self._count("completed")
+                _obs.record_decode_request(
+                    self.name, len(s.tokens),
+                    ttft_ms=req.info.get("ttft_ms"))
+                _obs.record_serving_done(self.name,
+                                         req.info["latency_ms"])
+
+    def _count(self, key, n=1):
+        with self.stats_lock:
+            self._counts[key] += n
+
+    def stats(self):
+        with self.stats_lock:
+            counts = dict(self._counts)
+        with self._cond:
+            counts["queue_depth"] = len(self._queue)
+        counts["active_slots"] = len(self._active())
+        counts["slots"] = self.slots
+        counts["prompt_buckets"] = list(self.buckets.seq_sizes)
+        counts["decode_steps"] = self._step_count
+        return counts

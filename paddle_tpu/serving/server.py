@@ -204,6 +204,8 @@ class PredictorServer:
     def __init__(self, tenants, max_in_flight=2, sla_ms=None,
                  queue_cap=256, buckets=None, bucket_cap=None,
                  verify=True, auto_start=True):
+        from .decode import DecodeEngine
+
         if hasattr(tenants, "run_async") or hasattr(tenants, "program"):
             tenants = {"default": tenants}
         if not tenants:
@@ -211,8 +213,15 @@ class PredictorServer:
         if max_in_flight < 1:
             raise ValueError("max_in_flight must be >= 1, got %d"
                              % max_in_flight)
+        # decode tenants run their own slot scheduler (continuous
+        # batching over KV-cache blocks) instead of the padded-batch
+        # dispatcher; they still go through the co-residency proof and
+        # the zero-sync stamp below
+        self._engines = {name: t for name, t in tenants.items()
+                         if isinstance(t, DecodeEngine)}
         self._tenants = {name: _Tenant(name, pred)
-                         for name, pred in tenants.items()}
+                         for name, pred in tenants.items()
+                         if not isinstance(pred, DecodeEngine)}
         self._order = list(self._tenants)   # round-robin order
         self._rr = 0
         self._max_in_flight = int(max_in_flight)
@@ -253,10 +262,15 @@ class PredictorServer:
         from ..static_analysis.verifier import VerifyError
 
         programs = [t.predictor.program for t in self._tenants.values()]
+        labels = list(self._tenants)
+        # decode engines co-reside too: their step program names the
+        # resident caches, so a cache-name collision between tenants is
+        # caught here
+        programs += [e.program for e in self._engines.values()]
+        labels += list(self._engines)
         if len(programs) < 2:
             return
-        _fp, diags = prove_scope_isolation(programs,
-                                           labels=list(self._tenants))
+        _fp, diags = prove_scope_isolation(programs, labels=labels)
         self.placement_diags = tuple(diags)
         errors = [d for d in diags if d.severity >= Severity.ERROR]
         if errors:
@@ -272,22 +286,26 @@ class PredictorServer:
         from ..static_analysis.concurrency import (certify_zero_sync,
                                                    verify_async_hot_path)
 
-        for t in self._tenants.values():
-            prog = t.predictor.program
+        holders = [(t.name, t.predictor) for t in self._tenants.values()]
+        # a decode engine's hot loop is its step program — the one the
+        # slot scheduler re-runs every generated token
+        holders += list(self._engines.items())
+        for name, holder in holders:
+            prog = holder.program
             prog._serving_hot_loop = True
             prog._max_in_flight = max(
                 self._max_in_flight,
                 int(getattr(prog, "_max_in_flight", 1) or 1))
             targets = []
-            get = getattr(t.predictor, "get_output_names", None)
+            get = getattr(holder, "get_output_names", None)
             if get is not None:
                 targets = list(get())
             if verify:
                 verify_async_hot_path(prog, targets=targets,
                                       max_in_flight=self._max_in_flight,
-                                      label="serving:%s" % t.name)
-            self.certificates[t.name] = certify_zero_sync(
-                prog, targets=targets, label="serving:%s" % t.name,
+                                      label="serving:%s" % name)
+            self.certificates[name] = certify_zero_sync(
+                prog, targets=targets, label="serving:%s" % name,
                 max_in_flight=self._max_in_flight)
 
     # ------------------------------------------------------------------
@@ -354,10 +372,19 @@ class PredictorServer:
         client), ``ValueError`` on a malformed feed (attributed to
         ``request_id``), :class:`ServerClosedError` after ``close``.
         """
+        engine = self._engines.get(tenant)
+        if engine is not None:
+            # decode tenant: the engine's slot scheduler owns queueing,
+            # admission, and completion — returns a DecodeRequest future
+            with self._cond:
+                if self._closed:
+                    raise ServerClosedError("server is closed")
+            return engine.submit(inputs, request_id=request_id)
         t = self._tenants.get(tenant)
         if t is None:
             raise KeyError("unknown tenant %r (have %s)"
-                           % (tenant, list(self._tenants)))
+                           % (tenant, list(self._tenants)
+                              + list(self._engines)))
         seq = next(self._seq)
         rid = request_id if request_id is not None else seq
         feed = self._as_feed(t, inputs)
@@ -415,6 +442,8 @@ class PredictorServer:
             if self._running:
                 return self
             self._running = True
+        for engine in self._engines.values():
+            engine.start()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="paddle_tpu-serving")
         self._thread.start()
@@ -430,6 +459,8 @@ class PredictorServer:
         if self._thread is not None:
             self._thread.join(timeout)
             self._thread = None
+        for engine in self._engines.values():
+            engine.close(timeout)
 
     def __enter__(self):
         return self
@@ -698,4 +729,7 @@ class PredictorServer:
                        if counts["submitted"] else 0.0),
             zero_sync={n: c.ok for n, c in self.certificates.items()},
         )
+        if self._engines:
+            counts["decode"] = {n: e.stats()
+                                for n, e in self._engines.items()}
         return counts

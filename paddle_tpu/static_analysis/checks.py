@@ -92,6 +92,13 @@ fused-op-missing-grad       ERROR     fused op registered no_grad=True
 fusible-pattern-not-fused   INFO      pattern the fusion pipeline
                                       matched but will not rewrite,
                                       with the cost-model reason
+decode-shape-unbucketed     WARNING   while body concatenates a loop
+                                      carry with per-step data and
+                                      writes it back — operand shapes
+                                      grow with the loop index, so
+                                      every decode step is a fresh
+                                      shape bucket (use the ring-buffer
+                                      KV cache: layers.decode_loop)
 ==========================  ========  ====================================
 """
 
@@ -869,6 +876,7 @@ def check_fused_op_missing_grad(ctx):
     fused ops are all differentiable via the registry's generic vjp —
     this guards custom fused kernels wired in by hand)."""
     from ..ops import registry
+    from .fusion import FUSED_FORWARD_OP_TYPES
 
     order = [rec for rec in ctx.graph.order if rec[0] == 0]
     training = any(
@@ -910,7 +918,8 @@ def check_fused_op_missing_grad(ctx):
         if not touches:
             continue
         if opdef.no_grad and (op.type.startswith("fused_")
-                              or op.type.startswith("c_fused_")) \
+                              or op.type.startswith("c_fused_")
+                              or op.type in FUSED_FORWARD_OP_TYPES) \
                 and demanded.intersection(op.output_arg_names):
             yield ctx.diag(
                 "fused-op-missing-grad", Severity.ERROR,
@@ -1071,3 +1080,108 @@ def check_manual_plan_suboptimal(ctx):
         hint="parallel.auto_transpile(program, cluster_spec) emits the "
              "cheaper plan; see analyze_program --plan for the full "
              "candidate table")
+
+
+@register_check("decode-shape-unbucketed")
+def check_decode_shape_unbucketed(ctx):
+    """WARNING: a ``while`` body concatenates a loop-carried tensor with
+    fresh per-step data and feeds the result back into the carry — the
+    operand's shape grows with the loop index.  That is the classic
+    naive KV-append decoder (``k = concat([k, k_step], axis=2)``): on
+    TPU every iteration is a NEW shape bucket, so each generated token
+    pays a fresh trace+compile plus the host sync that entails — the
+    jit cache grows linearly with generated length instead of holding
+    one entry.
+
+    The carry set is the while op's ``X``/``Out`` slots plus every
+    external var the body writes in place; a concat counts as growing
+    when a carried var flows into it (directly or through a chain of
+    shape-preserving views) and its result is written back to a carried
+    var (directly, via ``assign``, or through such a chain)."""
+    _VIEW_OPS = ("assign", "scale", "cast", "reshape", "dropout")
+    for block in ctx.program.blocks:
+        for op_idx, op in enumerate(block.ops):
+            if op.type != "while":
+                continue
+            carried = set()
+            for names in op.inputs.values():
+                carried.update(names)
+            for names in op.outputs.values():
+                carried.update(names)
+            carried.discard(EMPTY_VAR_NAME)
+            sub = resolve_sub_block(ctx.program, op,
+                                    host_block_idx=block.idx)
+            if sub is None:
+                continue
+            # in-place writes to externals are carries too (increment /
+            # kv_cache_write idiom): written in the body, defined outside
+            local = {v for v in sub.vars}
+            for b_op in sub.ops:
+                for n in b_op.output_arg_names:
+                    if n != EMPTY_VAR_NAME and n not in local:
+                        carried.add(n)
+            # taint: carried names + anything view-derived from them
+            tainted = set(carried)
+            grown = {}  # var name -> (op_idx in sub, concat op)
+            for b_idx, b_op in enumerate(sub.ops):
+                ins = [n for n in b_op.input_arg_names
+                       if n != EMPTY_VAR_NAME]
+                outs = [n for n in b_op.output_arg_names
+                        if n != EMPTY_VAR_NAME]
+                if b_op.type == "concat" and tainted.intersection(ins):
+                    if set(outs) & carried:  # concat straight into carry
+                        yield ctx.diag(
+                            "decode-shape-unbucketed", Severity.WARNING,
+                            "while body grows a loop-carried tensor: "
+                            "concat(axis=%s) over carried %s writes the "
+                            "carry itself — each iteration is a new "
+                            "shape bucket (per-token recompile + host "
+                            "sync on TPU)"
+                            % (b_op.attrs.get("axis"),
+                               sorted(tainted.intersection(ins))[:2]),
+                            block_idx=sub.idx, op_idx=b_idx, op=b_op,
+                            var_names=tuple(sorted(set(outs)
+                                                   & carried))[:3],
+                            hint="keep decode shapes static with a "
+                                 "ring-buffer KV cache: "
+                                 "layers.create_kv_cache(...) + "
+                                 "kv_cache_write(cache, x, cursor) + "
+                                 "flash_decode(q, k_cache, v_cache, "
+                                 "cursor) — see layers.decode_loop")
+                        continue
+                    for n in outs:
+                        grown[n] = (b_idx, b_op)
+                    continue
+                hit = grown.keys() & set(ins)
+                if hit:
+                    # does the grown value reach a carried var?
+                    if set(outs) & carried:
+                        g_idx, g_op = grown[next(iter(hit))]
+                        axis = g_op.attrs.get("axis")
+                        yield ctx.diag(
+                            "decode-shape-unbucketed", Severity.WARNING,
+                            "while body grows a loop-carried tensor: "
+                            "concat(axis=%s) over carried %s is written "
+                            "back to the carry via %r — each iteration "
+                            "is a new shape bucket (per-token "
+                            "recompile + host sync on TPU)"
+                            % (axis,
+                               sorted(tainted.intersection(
+                                   g_op.input_arg_names))[:2],
+                               b_op.type),
+                            block_idx=sub.idx, op_idx=g_idx, op=g_op,
+                            var_names=tuple(sorted(set(outs)
+                                                   & carried))[:3],
+                            hint="keep decode shapes static with a "
+                                 "ring-buffer KV cache: "
+                                 "layers.create_kv_cache(...) + "
+                                 "kv_cache_write(cache, x, cursor) + "
+                                 "flash_decode(q, k_cache, v_cache, "
+                                 "cursor) — see layers.decode_loop")
+                        for n in hit:
+                            grown.pop(n, None)
+                    elif b_op.type in _VIEW_OPS:
+                        for n in outs:
+                            grown[n] = grown[next(iter(hit))]
+                if b_op.type in _VIEW_OPS and tainted.intersection(ins):
+                    tainted.update(outs)

@@ -262,6 +262,45 @@ for _t in ("mean", "reduce_mean", "reduce_sum", "reduce_max",
         lambda op, ins, outs: sum(v.local_numel or 0 for v in ins))
 
 
+@register_flops("flash_decode_attention")
+def _flash_decode_flops(op, ins, outs):
+    # Q [B,H,D] (one row) vs the full ring cache [B,H,Tmax,D]: two
+    # matvecs (4·B·H·Tmax·dh) plus ~5 FLOPs/score of online softmax.
+    # Static analysis charges the Tmax worst case — the mask-to-cursor
+    # saving is a runtime property the cost model deliberately ignores
+    if len(ins) < 2 or not ins[1].shape or len(ins[1].shape) != 4:
+        return 2 * _out_numel(outs)
+    b, h, t, dh = (max(int(d), 1) for d in ins[1].shape)
+    return 4 * b * h * t * dh + 5 * b * h * t
+
+
+@register_flops("kv_cache_write")
+def _kv_cache_write_flops(op, ins, outs):
+    # a dynamic-slice store: moves X's bytes, negligible arithmetic.
+    # Charging the cache's numel (the default) would make every decode
+    # step look like a full-cache rewrite
+    return ins[1].local_numel or 0 if len(ins) > 1 else 0
+
+
+@register_flops("kv_cache_prefill")
+def _kv_cache_prefill_flops(op, ins, outs):
+    return ins[1].local_numel or 0 if len(ins) > 1 else 0
+
+
+@register_flops("top_k_sampling")
+def _top_k_sampling_flops(op, ins, outs):
+    # top-k scan + gumbel over k survivors ≈ 2 passes over the logits
+    n = ins[0].local_numel if ins and ins[0].local_numel else 0
+    return 2 * n
+
+
+@register_flops("top_p_sampling")
+def _top_p_sampling_flops(op, ins, outs):
+    # full sort + softmax + cumsum + gumbel ≈ 5 passes over the logits
+    n = ins[0].local_numel if ins and ins[0].local_numel else 0
+    return 5 * n
+
+
 def _op_flops(op, ins, outs):
     rule = _FLOP_RULES.get(op.type)
     if rule is not None:

@@ -100,6 +100,11 @@ FUSED_FORWARD_OP_TYPES = frozenset((
     "fused_multihead_attention", "fused_dropout_add_ln",
     "fused_bias_act", "softmax_with_cross_entropy",
     "fused_conv_bn_act", "fused_embedding_gather",
+    # decode family: emitted by layers.decode_loop/flash_decode, never
+    # by a rewrite here — listed so the matchers and the
+    # fused-op-missing-grad lint treat it as an already-fused kernel
+    # (forward-only by design: generation is inference)
+    "flash_decode_attention",
 ))
 
 _ACT_TYPES = ("relu", "gelu", "tanh", "sigmoid", "relu6", "leaky_relu",

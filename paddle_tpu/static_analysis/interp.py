@@ -296,6 +296,39 @@ def _reducescatter_transfer(op, in_vals, out_val):
         else Sharding.unknown()
 
 
+@register_transfer("kv_cache_write")
+@register_transfer("kv_cache_prefill")
+def _kv_cache_transfer(op, in_vals, out_val):
+    # the output IS the cache (ring-buffer update): it keeps the cache's
+    # placement.  The default join would degrade to UNKNOWN whenever the
+    # [B,H,D] step row is sharded (different shape from the cache)
+    if in_vals:
+        return in_vals[0].sharding
+    return _default_transfer(op, in_vals, out_val)
+
+
+@register_transfer("flash_decode_attention")
+def _flash_decode_transfer(op, in_vals, out_val):
+    # out [B,H,D] follows the query row's placement (batch-sharded
+    # serving slots stay batch-sharded); the cache inputs don't shard
+    # the output — each worker reads its own slots' cache blocks
+    if in_vals and in_vals[0].sharding.is_sharded:
+        return in_vals[0].sharding
+    return Sharding.replicated()
+
+
+@register_transfer("top_k_sampling")
+@register_transfer("top_p_sampling")
+def _sampling_transfer(op, in_vals, out_val):
+    # ids [B] from logits [B,V]: batch sharding survives the vocab-dim
+    # reduction; a vocab-sharded input would need a cross-worker argmax,
+    # which the lowering doesn't do — flag UNKNOWN so the analyzer warns
+    if in_vals and in_vals[0].sharding.is_sharded:
+        s = in_vals[0].sharding
+        return s if s.dim == 0 else Sharding.unknown()
+    return Sharding.replicated()
+
+
 @register_transfer("all_to_all")
 def _all_to_all_transfer(op, in_vals, out_val):
     # a reshard: stays sharded over the same axis, the sharded tensor

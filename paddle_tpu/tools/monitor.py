@@ -287,6 +287,13 @@ def collect_status(dirname, hb_dir=None, now=None,
     srv_shed_rate = None
     if srv_reqs:
         srv_shed_rate = round((srv_shed or 0.0) / srv_reqs, 4)
+    # decode-tenant view: generated tokens, per-request generated-length
+    # percentiles, and the steady-state tokens/sec gauge
+    dec_tokens = _metric_value(merged, "serving_decode_tokens_total")
+    dec_len = _merged_histogram(merged, "serving_generated_len")
+    dec_len_p50 = _hist_percentile(dec_len, 50) if dec_len else None
+    dec_len_p99 = _hist_percentile(dec_len, 99) if dec_len else None
+    dec_tps = _metric_value(merged, "decode_tokens_per_sec")
 
     counts = {}
     for e in events:
@@ -330,6 +337,14 @@ def collect_status(dirname, hb_dir=None, now=None,
         "serving_rejected": _metric_value(merged,
                                           "serving_rejected_total"),
         "serving_shed_rate": srv_shed_rate,
+        "serving_decode_tokens": (None if dec_tokens is None
+                                  else int(dec_tokens)),
+        "p50_generated_len": (None if dec_len_p50 is None
+                              else round(dec_len_p50, 1)),
+        "p99_generated_len": (None if dec_len_p99 is None
+                              else round(dec_len_p99, 1)),
+        "decode_tokens_per_sec": (None if dec_tps is None
+                                  else round(dec_tps, 3)),
         "ranks": ranks or None,
         "alive_ranks": alive if ranks else None,
         "lost_ranks": (len(ranks) - alive) if ranks else None,
@@ -414,6 +429,13 @@ def render_status(status):
                 _fmt(status.get("p99_serving_queue_wait_ms")),
                 _fmt(status["serving_queue_depth"]),
                 _fmt(status["serving_shed_rate"])))
+    if status.get("serving_decode_tokens") is not None:
+        lines.append(
+            "  decode: tokens=%s  tok/s=%s  gen_len p50=%s p99=%s" % (
+                _fmt(status["serving_decode_tokens"]),
+                _fmt(status["decode_tokens_per_sec"]),
+                _fmt(status["p50_generated_len"]),
+                _fmt(status["p99_generated_len"])))
     if status["ranks"]:
         for rank in sorted(status["ranks"], key=int):
             r = status["ranks"][rank]
@@ -472,7 +494,10 @@ def main(argv=None):
                     metavar="EXPR",
                     help="e.g. 'p99_step_ms>50' or, for a serving job, "
                          "'p99_serving_latency_ms>250' / "
-                         "'serving_shed_rate>0'; exit 1 when tripped, "
+                         "'serving_shed_rate>0'; decode tenants add "
+                         "'decode_tokens_per_sec<100' / "
+                         "'serving_decode_tokens==0' / "
+                         "'p99_generated_len>512'; exit 1 when tripped, "
                          "2 when the field has no data (repeatable)")
     ap.add_argument("--interval", type=float, default=2.0,
                     help="live-mode refresh seconds (default 2)")

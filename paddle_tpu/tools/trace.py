@@ -206,7 +206,11 @@ def serving_stats(traces):
                         ("serving.pad", "pad"),
                         ("serving.dispatch", "dispatch"),
                         ("serving.device", "device"),
-                        ("serving.sync", "sync")):
+                        ("serving.sync", "sync"),
+                        # decode tenants: prompt ingest vs per-token
+                        # generation — the TTFT / steady-state split
+                        ("serving.prefill", "prefill"),
+                        ("serving.decode", "decode")):
         if name in stats["phases"]:
             stats["%s_p50_ms" % alias] = stats["phases"][name]["p50_ms"]
             stats["%s_p99_ms" % alias] = stats["phases"][name]["p99_ms"]

@@ -53,6 +53,15 @@ def _resnet_eval():
     return [(main, [prob.name]), (startup, None)]
 
 
+
+def _gpt_small():
+    import gpt_small
+
+    main, startup, feeds, tokens, gen_len = gpt_small.build_program(
+        batch=2, prompt_len=8, max_new_tokens=4)
+    return [(main, [tokens.name, gen_len.name]), (startup, None)]
+
+
 def _slim():
     import slim_compress
 
@@ -61,8 +70,9 @@ def _slim():
 
 
 @pytest.mark.parametrize("builder", [
-    _mnist, _bert_tiny, _ctr, _resnet_eval, _slim,
-], ids=["mnist", "bert-tiny", "ctr", "resnet-eval", "slim"])
+    _mnist, _bert_tiny, _ctr, _resnet_eval, _slim, _gpt_small,
+], ids=["mnist", "bert-tiny", "ctr", "resnet-eval", "slim",
+        "gpt-small"])
 def test_every_example_program_analyzes_clean(builder):
     fluid.unique_name.switch()
     for program, targets in builder():
@@ -91,8 +101,9 @@ def test_example_cost_baselines_are_nonzero():
     assert "static_dispatch_overhead_ms" in metrics
 
 @pytest.mark.parametrize("builder", [
-    _mnist, _bert_tiny, _ctr, _resnet_eval, _slim,
-], ids=["mnist", "bert-tiny", "ctr", "resnet-eval", "slim"])
+    _mnist, _bert_tiny, _ctr, _resnet_eval, _slim, _gpt_small,
+], ids=["mnist", "bert-tiny", "ctr", "resnet-eval", "slim",
+        "gpt-small"])
 def test_every_example_fuses_and_analyzes_clean(builder):
     """ISSUE 5 CI sweep: the fusion pipeline (on, default config) over
     every example program must introduce ZERO new ERROR diagnostics —
@@ -110,8 +121,9 @@ def test_every_example_fuses_and_analyzes_clean(builder):
 
 
 @pytest.mark.parametrize("builder", [
-    _mnist, _bert_tiny, _ctr, _resnet_eval, _slim,
-], ids=["mnist", "bert-tiny", "ctr", "resnet-eval", "slim"])
+    _mnist, _bert_tiny, _ctr, _resnet_eval, _slim, _gpt_small,
+], ids=["mnist", "bert-tiny", "ctr", "resnet-eval", "slim",
+        "gpt-small"])
 def test_every_example_program_concurrency_clean(builder):
     """ISSUE 10 CI sweep: the concurrency battery at max_in_flight=2
     finds ZERO races across every example program — training programs

@@ -135,6 +135,44 @@ class TestBuckets:
         with pytest.raises(ValueError):
             bk.parse_buckets("")
 
+    def test_seq_axis_default_behavior_unchanged(self, monkeypatch):
+        monkeypatch.delenv(bk.SEQ_BUCKETS_ENV, raising=False)
+        assert bk.resolve_buckets(explicit="1,4") == (1, 4)  # no pair
+        b = bk.ShapeBuckets((2, 4))
+        assert b.seq_sizes is None
+        with pytest.raises(ValueError, match="sequence-length"):
+            b.bucket_for_seq(8)
+
+    def test_seq_axis_resolution_and_precedence(self, monkeypatch):
+        got = bk.resolve_buckets(explicit="1,4", seq="128,32")
+        assert got == ((1, 4), (32, 128))
+        monkeypatch.setenv(bk.SEQ_BUCKETS_ENV, "64,256")
+        assert bk.resolve_buckets(explicit="1,4") == ((1, 4), (64, 256))
+        # explicit seq beats env; observed lengths derive when neither
+        assert bk.resolve_buckets(explicit="1", seq="16") == ((1,), (16,))
+        monkeypatch.delenv(bk.SEQ_BUCKETS_ENV)
+        got = bk.resolve_buckets(explicit="1",
+                                 seq_observed=[30, 60, 100])
+        assert got == ((1,), (32, 64, 128))
+
+    def test_seq_axis_bucket_for_and_pad(self):
+        b = bk.ShapeBuckets((1, 2), seq_sizes=(32, 128))
+        assert b.seq_sizes == (32, 128)
+        assert b.bucket_for_seq(7) == 32
+        assert b.bucket_for_seq(33) == 128
+        assert b.bucket_for_seq(129) is None
+        ids = np.arange(20, dtype="int32").reshape(2, 10)
+        padded = b.pad_seq(ids, 10, 32)
+        assert padded.shape == (2, 32)
+        assert np.array_equal(padded[:, :10], ids)
+        assert (padded[:, 10:] == 0).all()
+        assert b.pad_seq(ids, 10, 10) is ids  # no-op when full
+
+    def test_seq_axis_grid_cap_enforced(self, monkeypatch):
+        monkeypatch.setenv(bk.BUCKET_CAP_ENV, "2")
+        with pytest.raises(ValueError, match="grid"):
+            bk.resolve_buckets(explicit="1,2", seq="8,16,32,64,128")
+
 
 # ---------------------------------------------------------------------------
 # padded-bucket bit-exactness (the satellite-3 contract)

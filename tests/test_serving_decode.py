@@ -1,0 +1,261 @@
+"""DecodeEngine serving tests (ISSUE 14): continuous batching over
+KV-cache slots, PredictorServer decode-tenant routing + certificates,
+decode telemetry counters, and prefill/decode trace attribution."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.observability as obs
+import paddle_tpu.observability.metrics as om
+from paddle_tpu.executor import Scope, scope_guard
+from paddle_tpu.inference import AnalysisConfig, AnalysisPredictor
+from paddle_tpu.observability import tracing as tr
+from paddle_tpu.serving import (DecodeEngine, GenerationConfig,
+                                PredictorServer, ServerClosedError)
+from paddle_tpu.tools import trace as trace_cli
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    fluid.unique_name.switch()
+    for var in ("PADDLE_TPU_TELEMETRY", "PADDLE_TPU_TELEMETRY_DIR",
+                "PADDLE_TPU_TELEMETRY_FLUSH", "PADDLE_TPU_TRACING",
+                "PADDLE_TPU_STRICT_SYNC"):
+        monkeypatch.delenv(var, raising=False)
+    obs.reset_telemetry()
+    yield
+    obs.reset_telemetry()
+
+
+V = 16
+
+
+class TinyModel:
+    """Deterministic adapter: next token = cur + 1, with the real
+    kv_cache_prefill / kv_cache_write / flash_decode path exercised
+    (the attention output is folded in at zero weight so any cache
+    corruption would still poison the logits)."""
+
+    def cache_spec(self):
+        return 1, 1, 32, 4  # layers, heads, max_len, head_dim
+
+    def _embed(self, ids_f, rows):
+        ones = fluid.layers.fill_constant([1, 4], "float32", 1.0)
+        x = fluid.layers.reshape(ids_f, [rows, 1])
+        return fluid.layers.matmul(x, ones)  # [rows, 4]
+
+    def build_prefill(self, prompt, plen, slot, caches):
+        L = prompt.shape[1]
+        pf = fluid.layers.cast(prompt, "float32")            # [1, L]
+        emb = self._embed(fluid.layers.reshape(pf, [L]), L)  # [L, 4]
+        x = fluid.layers.reshape(emb, [1, 1, L, 4])
+        k, v = caches[0]
+        fluid.layers.kv_cache_prefill(k, x, slot=slot)
+        fluid.layers.kv_cache_prefill(v, x, slot=slot)
+        idx = fluid.layers.increment(fluid.layers.assign(plen),
+                                     value=-1, in_place=True)
+        oh = fluid.layers.cast(fluid.layers.one_hot(
+            fluid.layers.reshape(idx, [1, 1]), L), "float32")
+        last = fluid.layers.reduce_sum(
+            fluid.layers.elementwise_mul(pf, oh), dim=[1])   # [1]
+        nxt = fluid.layers.cast(
+            fluid.layers.scale(last, scale=1.0, bias=1.0), "int32")
+        return fluid.layers.scale(fluid.layers.cast(
+            fluid.layers.one_hot(
+                fluid.layers.reshape(nxt, [1, 1]), V), "float32"), 10.0)
+
+    def build_step(self, cur, cursors, caches):
+        S = cur.shape[0]
+        cf = fluid.layers.cast(cur, "float32")  # [S]
+        emb = self._embed(cf, S)                # [S, 4]
+        x = fluid.layers.reshape(emb, [S, 1, 4])
+        k, v = caches[0]
+        fluid.layers.kv_cache_write(k, x, cursors, per_row=True)
+        fluid.layers.kv_cache_write(v, x, cursors, per_row=True)
+        att = fluid.layers.flash_decode(x, k, v, cursors, per_row=True)
+        zero = fluid.layers.scale(
+            fluid.layers.reduce_sum(att, dim=[1, 2]), 0.0)  # [S]
+        nxt = fluid.layers.cast(
+            fluid.layers.scale(cf, scale=1.0, bias=1.0), "int32")
+        logits = fluid.layers.scale(fluid.layers.cast(
+            fluid.layers.one_hot(
+                fluid.layers.reshape(nxt, [S, 1]), V), "float32"), 10.0)
+        return fluid.layers.elementwise_add(
+            logits, fluid.layers.reshape(zero, [S, 1]), axis=0)
+
+
+def _engine(name="tiny", max_new=4, eos_id=None, auto_start=True):
+    return DecodeEngine(
+        TinyModel(), slots=2, prompt_buckets=(8,),
+        config=GenerationConfig(max_new_tokens=max_new, eos_id=eos_id),
+        place=fluid.CPUPlace(), name=name, auto_start=auto_start)
+
+
+IN_DIM = 6
+
+
+def _fc_predictor(dirname, seed=0):
+    """A classic padded-batch tenant so the decode engine has a
+    co-resident to prove isolation against."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[IN_DIM], dtype="float32")
+        h = fluid.layers.fc(x, size=8, act="relu")
+        out = fluid.layers.fc(h, size=3, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    with scope_guard(Scope()):
+        np.random.seed(seed)
+        exe.run(startup)
+        fluid.io.save_inference_model(str(dirname), ["x"], [out], exe,
+                                      main_program=main)
+    return AnalysisPredictor(AnalysisConfig(model_dir=str(dirname)))
+
+
+# ---------------------------------------------------------------------------
+# the engine itself: continuous batching over cache slots
+# ---------------------------------------------------------------------------
+class TestDecodeEngine:
+    def test_mid_stream_admission_and_determinism(self):
+        """Three requests onto two slots: the third is admitted into a
+        freed cache block mid-stream and every token sequence is the
+        deterministic cur+1 chain from its own prompt — no cross-slot
+        cache bleed."""
+        with _engine() as eng:
+            r1 = eng.submit([3, 5, 7])
+            r2 = eng.submit([2])
+            r3 = eng.submit([1, 2, 3, 4])   # queued until a slot frees
+            t1, i1 = r1.result(timeout=60)
+            t2, i2 = r2.result(timeout=60)
+            t3, i3 = r3.result(timeout=60)
+            assert t1 == [8, 9, 10, 11]
+            assert t2 == [3, 4, 5, 6]
+            assert t3 == [5, 6, 7, 8]
+            for info in (i1, i2, i3):
+                assert info["generated_len"] == 4
+                assert info["latency_ms"] >= info["ttft_ms"] >= 0.0
+            stats = eng.stats()
+        assert stats["submitted"] == stats["completed"] == 3
+        assert stats["failed"] == 0
+        assert stats["queue_depth"] == 0 and stats["active_slots"] == 0
+        # 4 tokens/request: 1 from prefill + 3 from decode steps
+        assert stats["tokens"] == 9
+        assert stats["decode_steps"] >= 3
+        assert stats["slots"] == 2
+        assert stats["prompt_buckets"] == [8]
+
+    def test_eos_stops_generation(self):
+        with _engine(max_new=10, eos_id=8) as eng:
+            toks, info = eng.submit([5]).result(timeout=60)
+        assert toks == [6, 7, 8]        # stops AT eos, eos included
+        assert info["generated_len"] == 3
+
+    def test_prompt_validation(self):
+        with _engine() as eng:
+            with pytest.raises(ValueError, match="empty"):
+                eng.submit([])
+            with pytest.raises(ValueError, match="cache depth"):
+                eng.submit(list(range(40)))     # > max_len - 1
+            with pytest.raises(ValueError, match="bucket"):
+                eng.submit(list(range(10)))     # > largest bucket (8)
+
+    def test_submit_after_close_raises(self):
+        eng = _engine()
+        eng.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            eng.submit([1])
+
+
+# ---------------------------------------------------------------------------
+# PredictorServer decode-tenant integration
+# ---------------------------------------------------------------------------
+class TestServerDecodeTenant:
+    def test_routing_certificates_and_stats(self, tmp_path,
+                                            monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_STRICT_SYNC", "1")
+        pred = _fc_predictor(tmp_path / "fc")
+        eng = _engine(name="gen", auto_start=False)
+        server = PredictorServer({"fc": pred, "gen": eng},
+                                 buckets=(1, 2))
+        try:
+            # both tenants passed the co-residency proof and carry a
+            # zero-sync certificate; the engine's is over its step
+            # program — the true hot loop
+            assert set(server.certificates) == {"fc", "gen"}
+            assert server.certificates["gen"].ok, "\n".join(
+                str(d) for d in server.certificates["gen"].diagnostics)
+            # server.start() (via auto_start) started the engine
+            toks, info = server.submit("gen", [3, 5, 7]).result(
+                timeout=60)
+            assert toks == [8, 9, 10, 11]
+            # the classic padded-batch path is untouched
+            x = np.random.RandomState(0).rand(1, IN_DIM).astype(
+                "float32")
+            out = server.submit("fc", {"x": x}).result(timeout=60)
+            assert out[0].shape == (1, 3)
+            stats = server.stats()
+            assert stats["decode"]["gen"]["completed"] == 1
+            with pytest.raises(KeyError, match="gen"):
+                server.submit("nope", [1])
+        finally:
+            server.close()
+        with pytest.raises(ServerClosedError):
+            server.submit("gen", [1])
+
+    def test_engine_only_server(self):
+        eng = _engine(name="solo", auto_start=False)
+        server = PredictorServer({"solo": eng})
+        try:
+            toks, _ = server.submit("solo", [2]).result(timeout=60)
+            assert toks == [3, 4, 5, 6]
+        finally:
+            server.close()
+
+
+# ---------------------------------------------------------------------------
+# telemetry: the monitor-facing decode metrics
+# ---------------------------------------------------------------------------
+class TestDecodeTelemetry:
+    def test_counters_histograms_and_gauge(self):
+        with _engine(name="tmet") as eng:
+            eng.submit([1]).result(timeout=60)
+            eng.submit([2]).result(timeout=60)
+        # 3 step tokens per request (first token comes from prefill)
+        assert om.counter("serving_decode_tokens_total",
+                          tenant="tmet").value == 6
+        h = om.histogram("serving_generated_len")
+        assert h.count == 2 and h.value == 4.0     # mean generated len
+        assert om.histogram("serving_ttft_ms").count == 2
+        assert om.gauge("decode_tokens_per_sec").value > 0
+
+
+# ---------------------------------------------------------------------------
+# tracing: prefill vs decode attribution for `tools.trace --serving`
+# ---------------------------------------------------------------------------
+class TestDecodeTracing:
+    def test_request_spans_split_prefill_and_decode(self, tmp_path,
+                                                    monkeypatch):
+        tdir = tmp_path / "telemetry"
+        monkeypatch.setenv("PADDLE_TPU_TELEMETRY_DIR", str(tdir))
+        monkeypatch.setenv("PADDLE_TPU_TELEMETRY_FLUSH", "1")
+        obs.reset_telemetry()
+        with _engine(name="ttr") as eng:
+            eng.submit([3]).result(timeout=60)
+        tr.get_tracer().flush()
+        recs = tr.read_traces(str(tdir))
+        by_name = {}
+        for r in recs:
+            by_name.setdefault(r["name"], []).append(r)
+        assert {"serving.request", "serving.prefill",
+                "serving.decode_step",
+                "serving.decode"} <= set(by_name)
+        root = by_name["serving.request"][0]
+        # prefill and the retroactive decode span hang off the request
+        # root — per-request phase attribution, not just global steps
+        assert by_name["serving.prefill"][0]["parent"] == root["span"]
+        assert by_name["serving.decode"][0]["parent"] == root["span"]
+        assert by_name["serving.decode"][0]["attrs"]["tokens"] == 4
+        stats = trace_cli.serving_stats(trace_cli.group_traces(recs))
+        assert stats["requests"] == 1
+        assert "prefill_p50_ms" in stats
+        assert "decode_p50_ms" in stats
