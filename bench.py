@@ -1570,6 +1570,258 @@ def child_planner():
         }), flush=True)
 
 
+def child_quant():
+    """Block-quantized collective A/B (ISSUE 15): the BERT trainer's
+    gradient allreduce ring dense vs int8 block-quantized.
+
+    Two gates:
+
+    * ``bert_base_allreduce_byte_cut`` — the analyzer-priced ICI bytes
+      of the dense fused ring divided by the quantized ring's (int8
+      payload + f32-per-block scale sidecar), on the SAME transpiled
+      program.  Must be >= 1.8 (the int8-vs-bf16 wire math promises
+      ~1.97x at block 256; the sidecar and padding eat the rest).
+    * ``bert_base_quant_loss_delta`` — twin short training runs through
+      the REAL executor collectives on the visible mesh (CPU smoke: the
+      driver's 2 virtual devices), quant engaged vs the dense ring, same
+      seeds and feeds.  Max per-step loss delta must stay <= 1e-3: the
+      documented error model at training lr is noise, not drift.
+
+    The measured-vs-model quantization error of the actual gradient
+    buckets is recorded in the autotune ``quant`` family, which clears
+    the ``quantizable-bucket-not-quantized`` advisory's "uncalibrated"
+    tag for these shapes."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu import autotune
+    from paddle_tpu.executor import Scope, scope_guard
+    from paddle_tpu.models import bert
+    from paddle_tpu.quant import (block_dequantize, block_quantize,
+                                  predicted_rms_error, quant_block)
+    from paddle_tpu.static_analysis.cost import estimate_cost
+    from paddle_tpu.static_analysis.fusion import resolve_fused_program
+    from paddle_tpu.transpiler.collective import GradAllReduce
+
+    dev = jax.devices()[0]
+    on_tpu = _is_tpu_platform(dev.platform)
+    ndev = len(jax.devices())
+    nranks = ndev if ndev > 1 else 2
+    cfg = bert.BERT_BASE if on_tpu else bert.BERT_TINY
+    seq = 128 if on_tpu else 32
+    batch = (8 * ndev) if on_tpu else 2 * max(ndev, 1)
+    model_name = "bert_base" if on_tpu else "bert_tiny"
+    dev_name = "cpu" if os.environ.get("PADDLE_BENCH_FORCE_CPU") else \
+        jax_backend_name()
+
+    def build():
+        fluid.unique_name.switch()
+        main, startup, feeds, loss = bert.build_pretrain(
+            cfg, seq_len=seq, lr=1e-4, train=True)
+        return main, startup, feeds, loss
+
+    quant_env = {"PADDLE_TPU_QUANT": "1",
+                 "PADDLE_TPU_QUANT_MIN_BYTES": "1"}
+    dense_env = {"PADDLE_TPU_QUANT": "0"}
+    saved = {k: os.environ.get(k) for k in
+             set(quant_env) | set(dense_env)}
+
+    def with_env(env, fn):
+        os.environ.update(env)
+        try:
+            return fn()
+        finally:
+            for k in env:
+                v = saved.get(k)
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    # ---- arm 1: analyzer-priced wire bytes on the transpiled twin ----
+    main, startup, feeds, loss = build()
+    GradAllReduce().transpile(program=main, startup_program=startup,
+                              rank=0, nranks=nranks)
+    main._num_trainers = nranks
+
+    def ici_bytes(env):
+        def run():
+            fused, _ = resolve_fused_program(main, targets=[loss.name])
+            report = estimate_cost(fused, nranks=nranks,
+                                   targets=[loss.name])
+            return report.total_ici_bytes
+        return with_env(env, run)
+
+    dense_ici = ici_bytes(dense_env)
+    quant_ici = ici_bytes(quant_env)
+    byte_cut = (dense_ici / quant_ici) if quant_ici else 0.0
+    print(json.dumps({
+        "metric": "bert_base_allreduce_byte_cut",
+        "value": round(byte_cut, 4),
+        "unit": "x dense/quant ICI bytes (%s seq%d x%d ranks, block %d, "
+                "analyzer-priced, %s)"
+                % (model_name, seq, nranks, quant_block(), dev_name),
+        "dense_ici_bytes": int(dense_ici),
+        "quant_ici_bytes": int(quant_ici),
+        "vs_baseline": round(byte_cut, 3),
+    }), flush=True)
+    if byte_cut < 1.8:
+        print("# FAIL: allreduce byte cut %.3f < 1.8 gate" % byte_cut,
+              flush=True)
+
+    # ---- autotune 'quant' family: measured error vs the model on the
+    # actual quantized buckets (keyed the way the advisory looks up) ---
+    rng = np.random.RandomState(0)
+    blk = quant_block()
+    recorded = 0
+    fused_q, _ = with_env(
+        quant_env,
+        lambda: resolve_fused_program(main, targets=[loss.name]))
+    for block in fused_q.blocks:
+        for op in block.ops:
+            if op.type != "c_allreduce_quant" or recorded >= 4:
+                continue
+            numel = 0
+            for name in op.input("X"):
+                v = block._find_var_recursive(name)
+                if v is None or not v.shape or any(
+                        d is None or d < 0 for d in v.shape):
+                    continue
+                n = 1
+                for d in v.shape:
+                    n *= d
+                numel += n
+            if not numel:
+                continue
+            g = jnp.asarray(
+                rng.randn(numel).astype("float32") * 1e-2)
+            q, s = block_quantize(g)
+            err = g - block_dequantize(q, s, size=numel)
+            measured = float(jnp.sqrt(jnp.mean(err ** 2)))
+            predicted = float(predicted_rms_error(s))
+            factor = measured / predicted if predicted else 1.0
+            nblocks = max(numel // blk, 1)
+            autotune.record(
+                autotune.sweep_signature(
+                    "quant", {"nblocks": nblocks, "block": blk}),
+                {"calibration": round(factor, 4),
+                 "measured_rms": measured,
+                 "predicted_rms": predicted})
+            recorded += 1
+    if recorded:
+        print("# quant family calibrated: %d bucket signatures" %
+              recorded, flush=True)
+
+    # ---- arm 2: twin training through the transpiled collectives ----
+    # The executor's with_data_parallel path is GSPMD (XLA inserts the
+    # ring; framework collective ops are identity there), so the
+    # executable quantized wire lives where the transpiled programs run:
+    # per-worker op interpretation under shard_map with a collective
+    # axis — the same path the multi-process fleet runtime drives.  The
+    # twins share seeds, batches and the transpile; only the fusion
+    # rewrite differs (c_fused_allreduce_sum vs c_allreduce_quant).
+    if ndev < 2:
+        print("# quant loss-delta arm skipped: needs >=2 devices "
+              "(driver passes --xla_force_host_platform_device_count)",
+              flush=True)
+        return
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from paddle_tpu.executor import _run_ops_into_env, global_scope
+    from paddle_tpu.jax_compat import shard_map
+    from paddle_tpu.ops import registry as op_registry
+
+    steps = 6
+    feats, hidden = 16, 64
+    half = 8
+
+    def twin_losses(env):
+        def run():
+            fluid.unique_name.switch()
+            m, s = fluid.Program(), fluid.Program()
+            m.random_seed = s.random_seed = 77
+            with fluid.program_guard(m, s):
+                x = fluid.layers.data("x", shape=[feats],
+                                      dtype="float32")
+                y = fluid.layers.data("y", shape=[1], dtype="float32")
+                h = fluid.layers.fc(x, size=hidden, act="relu")
+                p = fluid.layers.fc(h, size=1)
+                l = fluid.layers.reduce_mean(
+                    fluid.layers.square(p - y))
+                fluid.optimizer.SGD(learning_rate=1e-2).minimize(l)
+            GradAllReduce().transpile(program=m, startup_program=s,
+                                      rank=0, nranks=2)
+            m._num_trainers = 2
+            fused, _ = resolve_fused_program(m, targets=[l.name])
+            fblock = fused.global_block()
+            kinds = [op.type for op in fblock.ops
+                     if "allreduce" in op.type]
+            exe = fluid.Executor()
+            with scope_guard(Scope()):
+                exe.run(s)
+                params = {}
+                for v in m.list_vars():
+                    if not v.persistable:
+                        continue
+                    val = global_scope().get(v.name)
+                    if val is not None:
+                        params[v.name] = np.asarray(val)
+            pnames = sorted(params)
+            mesh = Mesh(np.array(jax.devices()[:2]), ("dp",))
+
+            def per_worker(pvals, xb, yb):
+                ctx = op_registry.LoweringContext(mode="train")
+                ctx.collective_axis = "dp"
+                envd = {n: v[0] for n, v in zip(pnames, pvals)}
+                envd["x"], envd["y"] = xb[0], yb[0]
+                _run_ops_into_env(fblock, envd, ctx)
+                return ([envd[n][None] for n in pnames],
+                        envd[l.name].reshape(1))
+
+            step_fn = jax.jit(shard_map(
+                per_worker, mesh=mesh,
+                in_specs=([P("dp")] * len(pnames), P("dp"), P("dp")),
+                out_specs=([P("dp")] * len(pnames), P("dp"))))
+            lrng = np.random.RandomState(4321)
+            vals = [np.tile(params[n][None], (2,) + (1,) * params[n].ndim)
+                    for n in pnames]
+            out = []
+            for _ in range(steps):
+                xb = lrng.randn(2, half, feats).astype("float32")
+                yb = (xb.mean(axis=2, keepdims=True)
+                      + 0.05 * lrng.randn(2, half, 1)).astype("float32")
+                vals, lv = step_fn([jnp.asarray(v) for v in vals],
+                                   jnp.asarray(xb), jnp.asarray(yb))
+                vals = [np.asarray(v) for v in vals]
+                out.append(float(np.mean(np.asarray(lv))))
+            return out, kinds
+        return with_env(env, run)
+
+    dense_losses, dense_kinds = twin_losses(dense_env)
+    quant_losses, quant_kinds = twin_losses(quant_env)
+    if not any(k == "c_allreduce_quant" for k in quant_kinds):
+        raise SystemExit("quant arm vacuous: fusion emitted %r, no "
+                         "c_allreduce_quant" % (quant_kinds,))
+    if any(k == "c_allreduce_quant" for k in dense_kinds):
+        raise SystemExit("dense arm contaminated: %r" % (dense_kinds,))
+    delta = max(abs(a - b) for a, b in zip(dense_losses, quant_losses))
+    print(json.dumps({
+        "metric": "quant_collective_loss_delta",
+        "value": round(delta, 6),
+        "unit": "max |loss_quant - loss_dense| over %d DP steps on a "
+                "2-worker mesh (%s ring vs %s, %s; gate <= 1e-3)"
+                % (steps, "/".join(sorted(set(quant_kinds))),
+                   "/".join(sorted(set(dense_kinds))), dev_name),
+        "dense_losses": [round(x, 6) for x in dense_losses],
+        "quant_losses": [round(x, 6) for x in quant_losses],
+        "vs_baseline": 1.0 if delta <= 1e-3 else 0.0,
+    }), flush=True)
+    if delta > 1e-3:
+        print("# FAIL: quant twin loss delta %.2e > 1e-3 gate" % delta,
+              flush=True)
+
+
 def jax_backend_name():
     import jax
 
@@ -1935,7 +2187,8 @@ def main():
                 ("bert512", 270), ("infer", 220), ("bert_infer", 200),
                 ("fusion", 150), ("kernels", 220), ("planner", 220),
                 ("observability", 150), ("tracing", 150),
-                ("serving", 200), ("decode", 200), ("elastic", 240)]
+                ("serving", 200), ("decode", 200), ("elastic", 240),
+                ("quant", 220)]
         failed = []
         for mode, cap in plan:
             if remaining(cap) < 90:
@@ -1997,16 +2250,17 @@ def main():
               "hardware lines (if any)" % reason, flush=True)
         for mode in ("ctr", "bert", "fusion", "kernels", "planner",
                      "observability", "tracing", "serving", "decode",
-                     "elastic"):
+                     "elastic", "quant"):
             env_extra = {"PADDLE_BENCH_FORCE_CPU": "1"}
-            if mode == "planner":
+            if mode in ("planner", "quant"):
                 # the CPU smoke needs a virtual mesh for a real DP A/B
                 env_extra["XLA_FLAGS"] = (
                     os.environ.get("XLA_FLAGS", "")
                     + " --xla_force_host_platform_device_count=2")
             w_ok, w_lines, w_err = _run_child(
                 mode, remaining(420 if mode == "bert"
-                                else 240 if mode == "elastic" else 150),
+                                else 240 if mode in ("elastic", "quant")
+                                else 150),
                 env_extra=env_extra)
             if not w_ok:
                 print("# cpu %s smoke failed: %s" % (mode, w_err),
@@ -2078,6 +2332,8 @@ if __name__ == "__main__":
             child_kernels()
         elif mode == "planner":
             child_planner()
+        elif mode == "quant":
+            child_quant()
         elif mode == "serving":
             child_serving()
         elif mode == "decode":

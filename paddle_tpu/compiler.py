@@ -210,7 +210,7 @@ class CompiledProgram:
                        for v in (fetch_list or [])]
             # refresh the report only when its resolve key changes —
             # steady-state steps skip the (cached) resolve entirely
-            key = (config.signature(), self._program._version,
+            key = (config.signature(self._program), self._program._version,
                    tuple(sorted(set(targets))))
             if key != self._last_fusion_key:
                 _, self._last_fusion_report = _fusion.resolve_fused_program(

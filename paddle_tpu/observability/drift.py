@@ -46,6 +46,7 @@ _WARMUP_STEPS = 5
 _RECORD_WARMUP_STEPS = 30
 #: drift_ratio gauge handles by kind — resolved once, off the step path
 _RATIO_GAUGES = {}
+_QUANT_GAUGES = {}
 #: device memory stats are polled every Nth observed step — the query
 #: crosses into the backend and must not tax the per-step hot path
 _MEM_POLL_EVERY = 16
@@ -284,6 +285,27 @@ class DriftMonitor:
         state = self.get(key)
         return state.ratios() if state is not None else {}
 
+    def observe_quant_error(self, measured, predicted=None, bucket=None):
+        """Per-bucket quantization-error gauges for the quant subsystem
+        (``paddle_tpu/quant``): ``quant_error`` holds the measured
+        relative RMS error of the int8 round trip and
+        ``quant_error_ratio`` the measured/predicted factor against the
+        blockwise error model — the convergence tripwire
+        ``tools.monitor --alert 'quant_error>0.05'`` watches in
+        production."""
+        label = str(bucket) if bucket is not None else "all"
+        g = _QUANT_GAUGES.get(label)
+        if g is None:
+            g = _metrics.gauge("quant_error", bucket=label)
+            _QUANT_GAUGES[label] = g
+        g.set(float(measured))
+        if predicted is not None and float(predicted) > 0:
+            rg = _QUANT_GAUGES.get(("ratio", label))
+            if rg is None:
+                rg = _metrics.gauge("quant_error_ratio", bucket=label)
+                _QUANT_GAUGES[("ratio", label)] = rg
+            rg.set(float(measured) / float(predicted))
+
     # -- calibration feedback -------------------------------------------
 
     def recording_enabled(self):
@@ -396,3 +418,4 @@ def reset_drift():
     with _monitor_lock:
         _monitor = None
     _RATIO_GAUGES.clear()
+    _QUANT_GAUGES.clear()

@@ -75,6 +75,35 @@ def c_fused_allreduce_sum(ctx, attrs, X):
     return {"Out": split_like(flat, X, cast=False)}
 
 
+@register_op("c_allreduce_quant", inputs=["X*"], outputs=["Out*"],
+             no_grad=True)
+def c_allreduce_quant(ctx, attrs, X):
+    """Bucketed allreduce with int8 block-quantized exchange (EQuARX;
+    ``quant.collective``): flatten like ``c_fused_allreduce_sum``, then
+    quantize → reduce-scatter int8 → dequant-sum-requant → allgather.
+    ~2x ICI byte cut at the quantization error documented in
+    ``quant.blockwise``; the planner only emits it for buckets the cost
+    model prices as ICI-bound winners.
+
+    GSPMD path (no shard_map axis): identity, exactly like the bf16
+    fused op — the partitioner already reduced the values, so with
+    quant disabled OR under GSPMD this op is bit-exact with the dense
+    path."""
+    from ..quant.collective import quantized_allreduce
+    from .common import flatten_concat, split_like
+
+    ax = _axis(ctx)
+    if ax is None:
+        return {"Out": list(X)}
+    s = attrs.get("pre_scale")
+    flat = flatten_concat(X)
+    if s:
+        flat = flat * jnp.asarray(s, flat.dtype)
+    flat = quantized_allreduce(flat, ax,
+                               block=attrs.get("quant_block") or None)
+    return {"Out": split_like(flat, X, cast=False)}
+
+
 @register_op("c_broadcast", inputs=["X"], outputs=["Out"], no_grad=True)
 def c_broadcast(ctx, attrs, X):
     ax = _axis(ctx)

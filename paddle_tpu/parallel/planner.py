@@ -231,6 +231,13 @@ def apply_plan(program, result, startup_program=None, rank=0):
     program._shard_optimizer_state = cand.zero1
     if cand.bucket_mb:
         program._allreduce_bucket_mb = cand.bucket_mb
+    if getattr(cand, "quant", False):
+        # per-bucket realization of the quant axis: the fusion rewrite
+        # consults this mark (quant.collective.quant_min_bytes) and only
+        # quantizes buckets at or above the cluster's break-even size —
+        # smaller (compute-bound) buckets keep the bf16 fused op
+        program._quant_buckets = quant_bucket_mark(result.cluster,
+                                                   cand.degree)
     return cand
 
 
@@ -238,10 +245,11 @@ class PlanCandidate:
     """One point of the placement/sharding search space."""
 
     __slots__ = ("kind", "degree", "stages", "dp_degree", "cuts",
-                 "bucket_mb", "zero1", "microbatches")
+                 "bucket_mb", "zero1", "microbatches", "quant")
 
     def __init__(self, kind, degree, stages=1, dp_degree=1, cuts=(),
-                 bucket_mb=None, zero1=False, microbatches=1):
+                 bucket_mb=None, zero1=False, microbatches=1,
+                 quant=False):
         self.kind = kind            # single | dp | pipeline | moe | ulysses
         self.degree = int(degree)   # total chips the plan occupies
         self.stages = int(stages)
@@ -250,12 +258,13 @@ class PlanCandidate:
         self.bucket_mb = bucket_mb
         self.zero1 = bool(zero1)
         self.microbatches = int(microbatches)
+        self.quant = bool(quant)    # int8 block-quantized grad exchange
 
     def plan_key(self):
         """Deterministic identity/tie-break key."""
         return (self.kind, self.degree, self.stages, self.dp_degree,
                 self.bucket_mb if self.bucket_mb is not None else -1,
-                self.zero1, self.cuts)
+                self.zero1, self.cuts, self.quant)
 
     def describe(self):
         if self.kind == "single":
@@ -264,6 +273,8 @@ class PlanCandidate:
             s = "dp x%d" % self.degree
             if self.zero1:
                 s += " +zero1"
+            if self.quant:
+                s += " +int8"
             if self.bucket_mb:
                 s += " (allreduce bucket %dMB)" % self.bucket_mb
             return s
@@ -281,7 +292,7 @@ class PlanCandidate:
             "stages": self.stages, "dp_degree": self.dp_degree,
             "cuts": list(self.cuts), "bucket_mb": self.bucket_mb,
             "zero1": self.zero1, "microbatches": self.microbatches,
-            "describe": self.describe(),
+            "quant": self.quant, "describe": self.describe(),
         }
 
     def __repr__(self):
@@ -365,14 +376,16 @@ class PlanResult:
         HBM, deadlock verdict, chosen/rejected reason."""
         lines = [
             "auto-parallelism plan for %r:" % (self.cluster,),
-            "  %-44s %10s %12s %12s %8s  %s" % (
-                "candidate", "step ms", "ICI bytes", "peak HBM",
-                "deadlock", "verdict"),
+            "  %-44s %10s %12s %5s %12s %8s  %s" % (
+                "candidate", "step ms", "ICI bytes", "quant",
+                "peak HBM", "deadlock", "verdict"),
         ]
         for pc in self.candidates:
-            lines.append("  %-44s %10.3f %12d %12d %8s  %s" % (
+            lines.append("  %-44s %10.3f %12d %5s %12d %8s  %s" % (
                 pc.candidate.describe()[:44], pc.price.step_ms,
-                pc.price.ici_bytes, pc.price.peak_memory_bytes,
+                pc.price.ici_bytes,
+                "int8" if getattr(pc.candidate, "quant", False) else "-",
+                pc.price.peak_memory_bytes,
                 pc.deadlock or "-",
                 ("CHOSEN: " if pc.chosen else "") + pc.status))
         if self.fallback:
@@ -397,6 +410,10 @@ class PlanResult:
         if c.bucket_mb:
             bs.fuse_all_reduce_ops = True
             env["PADDLE_TPU_ALLREDUCE_BUCKET_MB"] = str(c.bucket_mb)
+        if getattr(c, "quant", False):
+            mark = quant_bucket_mark(self.cluster, c.degree)
+            env["PADDLE_TPU_QUANT_MIN_BYTES"] = str(mark["min_bytes"])
+            env["PADDLE_TPU_QUANT_BLOCK"] = str(mark["block"])
         return bs, env
 
     def __repr__(self):
@@ -641,11 +658,23 @@ def enumerate_candidates(program, cluster, base_interp=None,
     # variant only when there is optimizer state to shard
     buckets = _bucket_candidates_mb()
     has_opt_state = bool(_optimizer_state_overrides(program, chips))
+    # int8 block-quantized gradient exchange is one more per-bucket
+    # dimension of the same dp family (EQuARX; the ``quant`` subsystem);
+    # only trainable programs have gradients to quantize, and the
+    # PADDLE_TPU_QUANT=0 kill switch removes the axis entirely so plans
+    # (and their byte-stable to_json) are identical to the pre-quant
+    # planner
+    from ..quant.blockwise import quant_enabled
+
+    quant_axis = (False, True) if (trainable and quant_enabled()) \
+        else (False,)
     for bucket in buckets:
-        cands.append(PlanCandidate("dp", chips, bucket_mb=bucket))
-        if trainable and has_opt_state:
+        for q in quant_axis:
             cands.append(PlanCandidate("dp", chips, bucket_mb=bucket,
-                                       zero1=True))
+                                       quant=q))
+            if trainable and has_opt_state:
+                cands.append(PlanCandidate("dp", chips, bucket_mb=bucket,
+                                           zero1=True, quant=q))
 
     # pipeline splits over searched layer boundaries
     loads, boundaries = _forward_loads(program, base_interp, base_report)
@@ -863,6 +892,71 @@ def _bucketed_launches(report, bucket_mb):
     return other + max(1, int(math.ceil(grad_bytes / float(cap))))
 
 
+def _quant_price_delta(report, nranks, bucket_mb):
+    """(ici_delta_bytes, extra_launches) of int8-quantizing the ring-0
+    gradient exchange: delta is NEGATIVE (bytes saved) and the launch
+    tax covers the extra collective phase plus the quant/dequant
+    kernels per bucket — what makes a compute-bound (small-payload)
+    program price quant as losing."""
+    from ..quant.blockwise import quant_block
+    from ..quant.collective import quantized_wire_bytes
+    from ..static_analysis.cost import collective_ici_bytes
+
+    grad_numel = 0
+    dense_bytes = 0
+    launches = 0
+    for c in report.op_costs:
+        if c.ici_bytes <= 0:
+            continue
+        if c.record.op.type in ("c_allreduce_sum",
+                                "c_fused_allreduce_sum") \
+                and (c.ring_id in (0, None)):
+            members = [v for v in c.record.ins
+                       if str(v.dtype) in ("float32", "bfloat16")]
+            if not members:
+                continue
+            grad_numel += sum(v.local_numel or 0 for v in members)
+            dense_bytes += sum(
+                (v.local_numel or 0) * dtype_bytes(v.dtype)
+                for v in members)
+            launches += 1
+    if not grad_numel:
+        return 0, 0
+    wire, _ = quantized_wire_bytes(grad_numel, nranks,
+                                   block=quant_block())
+    delta = (collective_ici_bytes("c_allreduce_quant", wire, nranks)
+             - collective_ici_bytes("c_allreduce_sum", dense_bytes,
+                                    nranks))
+    if bucket_mb:
+        buckets = max(1, int(math.ceil(dense_bytes
+                                       / float(bucket_mb * _MB))))
+    else:
+        buckets = launches
+    # per bucket: 1 extra collective phase (scatter+gather vs one psum)
+    # + quantize + dequantize kernel launches
+    return delta, 3 * buckets
+
+
+def quant_bucket_mark(cluster, nranks, dtype_nbytes=4):
+    """The ``_quant_buckets`` program mark a quant-winning plan stamps:
+    the break-even bucket size (bytes) where the int8 byte cut pays for
+    the per-bucket launch tax on THIS cluster, plus the block size the
+    plan was priced with.  Buckets below ``min_bytes`` stay bf16 — the
+    per-bucket realization of "only ICI-bound buckets win"."""
+    from ..quant.blockwise import quant_block
+
+    blk = quant_block()
+    n = max(int(nranks), 2)
+    wire_per_elem = 1.0 + 4.0 / blk          # int8 + f32-scale sidecar
+    saved_per_byte = max(
+        (dtype_nbytes - wire_per_elem) / float(dtype_nbytes), 1e-6)
+    ici_bps = cluster.ici_gbps * 1e9
+    overhead_s = 3 * cluster.launch_us * 1e-6
+    ring = 2.0 * (n - 1) / n
+    min_bytes = overhead_s * ici_bps / (ring * saved_per_byte)
+    return {"min_bytes": max(int(min_bytes), 1), "block": blk}
+
+
 def price_worker_set(workers, cluster, cand=None, targets=(),
                      batch_size=None, shard_overrides=None):
     """Price an emitted per-worker program set against ``cluster``;
@@ -905,6 +999,11 @@ def price_worker_set(workers, cluster, cand=None, targets=(),
                 # (no op in the IR carries it — charge it here)
                 extra_ici = _param_allgather_bytes(w, cand.degree)
                 extra_launches = 1 if extra_ici else 0
+            if getattr(cand, "quant", False):
+                qd, ql = _quant_price_delta(report, nranks,
+                                            cand.bucket_mb)
+                extra_ici += qd
+                extra_launches += ql
         reports.append(report)
         prices.append(price_plan(
             report,

@@ -1,0 +1,39 @@
+"""Block-quantized collectives: int8 gradient exchange as a priced axis.
+
+Reference analogue: Fluid's gradient-compression family —
+``DGCMomentumOptimizer`` (``python/paddle/fluid/optimizer.py:787``) and
+the ``dgc``/``quantize`` op clusters — bandwidth-saving gradient
+exchange bolted onto the RPC transport.  TPU-native framing (EQuARX,
+arXiv 2506.17615): the win is not sparsity bookkeeping but cutting the
+ICI payload of the dense allreduce in half by moving int8 blocks with a
+per-block f32 scale sidecar, and the decision of WHERE to do so belongs
+to the planner's placement search (arXiv 2110.10548), not a global
+toggle — only ICI-bound buckets quantize; compute-bound buckets stay
+bf16.
+
+Layers:
+
+- :mod:`.blockwise` — the quantize/dequantize primitives with the
+  documented error model, Pallas fused kernels (autotune family
+  ``quant``) and an XLA composite fallback.
+- :mod:`.collective` — the ``c_allreduce_quant`` math: quantize →
+  reduce-scatter in int8 → dequant-sum-requant → allgather.
+
+Kill switches: ``PADDLE_TPU_QUANT=0`` disables the whole subsystem
+(planner stops enumerating quant candidates, the fusion rewrite emits
+plain ``c_fused_allreduce_sum``, and collectives are bit-exactly the
+pre-quant bf16 path); ``PADDLE_TPU_QUANT_BLOCK`` overrides the block
+size (default 256); ``PADDLE_TPU_QUANT_MIN_BYTES`` forces the
+per-bucket engagement threshold without a planner mark.
+"""
+
+from .blockwise import (block_dequantize, block_quantize, predicted_rms_error,
+                        quant_block, quant_enabled, quantization_error)
+from .collective import (quant_min_bytes, quantized_allreduce,
+                         quantized_wire_bytes)
+
+__all__ = [
+    "block_quantize", "block_dequantize", "quant_block", "quant_enabled",
+    "predicted_rms_error", "quantization_error", "quantized_allreduce",
+    "quantized_wire_bytes", "quant_min_bytes",
+]

@@ -1,0 +1,122 @@
+"""The ``c_allreduce_quant`` math: int8 block-quantized ring allreduce.
+
+EQuARX-style (arXiv 2506.17615) two-phase exchange, expressed with the
+explicit lax collectives so the wire payload really is int8:
+
+1. quantize the flat bucket (``blockwise.block_quantize``), padded so
+   every rank's chunk is a whole number of blocks;
+2. reduce-scatter in int8: ``all_to_all`` the per-rank chunks (int8 q +
+   f32 scale sidecar), then each rank dequant-sums its chunk over peers
+   in fixed ascending rank order — the deterministic-reduction
+   discipline of PR 12's ``reduce_gradients`` (same summands, same
+   order, on every rank);
+3. requantize the reduced chunk and ``all_gather`` it back (int8 +
+   sidecar), dequant, trim the pad.
+
+Wire bytes per rank ≈ ``2 · (n-1)/n · numel`` int8 plus the scale
+sidecar (4/B per element) vs ``2 · (n-1)/n · 2·numel`` for the bf16
+ring — the ~2x cut :func:`quantized_wire_bytes` prices for the planner.
+Error: the payload is quantized twice (once per direction), so the
+end-to-end RMS error is ≈ √2 × the single-pass model in
+:mod:`.blockwise`; the drift gauge measures against exactly that.
+
+Determinism: quantization is a pure function of the input bits and the
+dequant-sum runs in rank order, so every rank computes bit-identical
+results from the identical collective output — cross-process replay is
+exact (covered by the multiprocess test).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from .blockwise import (block_quantize, padded_size, quant_block,
+                        quant_enabled)
+
+__all__ = ["quantized_allreduce", "quantized_wire_bytes",
+           "quant_min_bytes"]
+
+
+def quantized_allreduce(flat, axis_name, block=None):
+    """Allreduce-sum a flat f32/bf16 vector with int8 block-quantized
+    exchange.  Call inside shard_map over ``axis_name``; returns the
+    (approximate) cross-replica sum in ``flat``'s dtype."""
+    from ..jax_compat import axis_size
+
+    b = int(block) if block else quant_block()
+    n = axis_size(axis_name)  # static — no extra collective
+    dtype = flat.dtype
+    numel = flat.size
+    npad = padded_size(numel, n * b)
+    chunk = npad // n
+
+    # kernel=False: pallas_call has no shard_map replication rule, and
+    # this function is by contract traced under the mesh axis — the XLA
+    # composite is the same math, same bits
+    q, scales = block_quantize(flat, block=b, kernel=False)  # pads to npad
+    if q.size != npad:  # block multiple < rank multiple: re-pad
+        q2, s2 = (jnp.zeros(npad, jnp.int8),
+                  jnp.ones(npad // b, jnp.float32))
+        q = q2.at[:q.size].set(q)
+        scales = s2.at[:scales.size].set(scales)
+
+    # reduce-scatter in int8: ship each rank its chunk from every peer
+    q_peer = jax.lax.all_to_all(q.reshape(n, chunk), axis_name,
+                                split_axis=0, concat_axis=0, tiled=False)
+    s_peer = jax.lax.all_to_all(scales.reshape(n, chunk // b), axis_name,
+                                split_axis=0, concat_axis=0, tiled=False)
+    # dequant-sum in ascending rank order (deterministic on every rank)
+    peer_vals = (q_peer.astype(jnp.float32)
+                 * jnp.repeat(s_peer, b, axis=1))
+    part = jnp.sum(peer_vals, axis=0)  # [chunk]
+
+    # requantize the reduced shard and gather it back
+    q_r, s_r = block_quantize(part, block=b, kernel=False)
+    q_all = jax.lax.all_gather(q_r, axis_name)  # [n, chunk]
+    s_all = jax.lax.all_gather(s_r, axis_name)  # [n, chunk // b]
+    out = (q_all.astype(jnp.float32)
+           * jnp.repeat(s_all, b, axis=1)).reshape(-1)
+    return out[:numel].astype(dtype)
+
+
+def quantized_wire_bytes(numel, nranks, block=None, dtype_bytes=2):
+    """(quant_bytes, dense_bytes) one ring allreduce moves per rank for a
+    ``numel``-element bucket: the cost-model payload rule.  Both sides
+    include the 2·(n-1)/n ring factor's *payload* term only (the factor
+    itself is applied by ``collective_ici_bytes``), i.e. these are the
+    B in ``2·B·(n-1)/n``.  quant side = int8 elements (padded to rank ×
+    block alignment) + the f32-per-block scale sidecar, counted for both
+    the scatter and gather phases by the shared ring factor."""
+    b = int(block) if block else quant_block()
+    n = max(int(nranks), 1)
+    npad = padded_size(numel, n * b)
+    quant_bytes = npad + (npad // b) * 4
+    dense_bytes = int(numel) * int(dtype_bytes)
+    return quant_bytes, dense_bytes
+
+
+def quant_min_bytes(program=None):
+    """The per-bucket engagement threshold in bytes, or None when
+    quantized collectives are off for this program.
+
+    Precedence: global kill switch (``PADDLE_TPU_QUANT=0`` → None) →
+    planner ``_quant_buckets`` program mark (``{"min_bytes": …,
+    "block": …}``) → ``PADDLE_TPU_QUANT_MIN_BYTES`` env → None (quant
+    never engages without an explicit plan or env opt-in — the default
+    path stays bit-exact bf16)."""
+    if not quant_enabled():
+        return None
+    mark = getattr(program, "_quant_buckets", None) if program else None
+    if isinstance(mark, dict) and mark.get("min_bytes") is not None:
+        try:
+            return int(mark["min_bytes"])
+        except (TypeError, ValueError):
+            return None
+    env = os.environ.get("PADDLE_TPU_QUANT_MIN_BYTES", "").strip()
+    if env:
+        try:
+            return int(env)
+        except ValueError:
+            return None
+    return None

@@ -92,6 +92,12 @@ fused-op-missing-grad       ERROR     fused op registered no_grad=True
 fusible-pattern-not-fused   INFO      pattern the fusion pipeline
                                       matched but will not rewrite,
                                       with the cost-model reason
+quantizable-bucket-not-     INFO      ICI-bound gradient bucket the
+quantized                             cost model prices as an int8
+                                      quantization win but that runs
+                                      bf16 (no plan mark / env
+                                      threshold, kill switch, or
+                                      uncalibrated autotune family)
 decode-shape-unbucketed     WARNING   while body concatenates a loop
                                       carry with per-step data and
                                       writes it back — operand shapes
@@ -967,6 +973,108 @@ def check_fusible_pattern_not_fused(ctx):
                 block_idx=r.block_idx,
                 op_idx=r.op_idxs[0] if r.op_idxs else None,
                 hint="unset PADDLE_TPU_FUSION to enable the rewrite")
+
+
+@register_check("quantizable-bucket-not-quantized")
+def check_quantizable_bucket_not_quantized(ctx):
+    """Advisory twin of the quant planner axis (``paddle_tpu/quant``):
+    ring-0 gradient buckets big enough that the cost model prices the
+    int8 block-quantized exchange as a win, but that will run bf16 —
+    because ``PADDLE_TPU_QUANT=0`` disables the subsystem, or because
+    no plan mark / env threshold engages it.  Mirrors
+    ``fusible-pattern-not-fused``, including the "uncalibrated" reason
+    when the autotune ``quant`` family has no measured entry for the
+    bucket's shape."""
+    from ..quant.blockwise import quant_block, quant_enabled
+    from ..quant.collective import quant_min_bytes
+    from .cost import dtype_bytes
+    from .fusion import _calibration, allreduce_bucket_mb
+
+    if quant_min_bytes(ctx.program) is not None:
+        return  # quant is engaged — the rewrite handles these buckets
+    block = ctx.program.global_block()
+    # group + size-cap the in-place grad allreduces exactly as the
+    # fusion bucketer does, so the advisory names the same buckets the
+    # rewrite would quantize
+    groups = {}
+    for i, op in enumerate(block.ops):
+        if op.type not in ("c_allreduce_sum", "c_fused_allreduce_sum"):
+            continue
+        names = op.inputs.get("X", [])
+        if not names or set(names) != set(op.outputs.get("Out", [])):
+            continue  # only the in-place grad-allreduce shape
+        dt = None
+        nbytes = 0
+        for n in names:
+            v = block._find_var_recursive(n)
+            if v is None or not v.shape or any(
+                    int(d) < 0 for d in v.shape):
+                nbytes = 0
+                break
+            numel = 1
+            for d in v.shape:
+                numel *= int(d)
+            nbytes += numel * dtype_bytes(v.dtype)
+            dt = str(v.dtype)
+        if not nbytes or dt not in ("float32", "bfloat16"):
+            continue
+        key = (op.attrs.get("ring_id"), dt)
+        groups.setdefault(key, []).append((i, names[0], nbytes))
+    if not groups:
+        return
+    cap = int(allreduce_bucket_mb(ctx.program) * (1 << 20))
+    # break-even on the program's cluster spec (or the generic default
+    # chip): the same rule a quant-winning plan stamps as min_bytes
+    from ..parallel.planner import ClusterSpec, quant_bucket_mark
+
+    spec = getattr(ctx.program, "_cluster_spec", None)
+    try:
+        cluster = ClusterSpec.coerce(spec) if spec else ClusterSpec(2)
+    except Exception:  # noqa: BLE001 - bad spec has its own advisory
+        cluster = ClusterSpec(2)
+    mark = quant_bucket_mark(cluster, max(cluster.chips, 2))
+    blk = quant_block()
+    for key, members in sorted(groups.items(),
+                               key=lambda kv: kv[1][0][0]):
+        buckets = []
+        cur, cur_bytes = [], 0
+        for item in members:
+            if cur and cur_bytes + item[2] > cap:
+                buckets.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(item)
+            cur_bytes += item[2]
+        if cur:
+            buckets.append(cur)
+        for bucket in buckets:
+            total = sum(b for _, _, b in bucket)
+            if total < mark["min_bytes"]:
+                continue  # cost model says bf16 is right — no noise
+            if not quant_enabled():
+                reason = "disabled by PADDLE_TPU_QUANT=0"
+                hint = "unset PADDLE_TPU_QUANT to let the planner " \
+                       "price int8 exchange for this bucket"
+            else:
+                reason = ("no _quant_buckets plan mark or "
+                          "PADDLE_TPU_QUANT_MIN_BYTES threshold engages "
+                          "it")
+                hint = ("run parallel.auto_transpile (the quant axis "
+                        "prices it) or set PADDLE_TPU_QUANT_MIN_BYTES")
+                _, _, calibrated = _calibration(
+                    "quant", nblocks=total // max(
+                        dtype_bytes(key[1]), 1) // blk or 1, block=blk)
+                if not calibrated:
+                    reason += (" (uncalibrated: autotune family 'quant'"
+                               " has no measured entry for this shape)")
+            yield ctx.diag(
+                "quantizable-bucket-not-quantized", Severity.INFO,
+                "ring %r %s gradient bucket (%d members, %d bytes, "
+                "anchored at %r) prices as an int8 quantization win "
+                "(break-even %d bytes) but runs bf16: %s"
+                % (key[0], key[1], len(bucket), total, bucket[0][1],
+                   mark["min_bytes"], reason),
+                block_idx=0, op_idx=bucket[0][0],
+                var_names=(bucket[0][1],), hint=hint)
 
 
 @register_check("manual-plan-suboptimal")

@@ -120,8 +120,18 @@ COLLECTIVE_OP_TYPES = frozenset((
     "c_allreduce_prod", "allreduce", "c_reduce_sum", "c_broadcast",
     "broadcast", "c_allgather", "c_reducescatter", "c_scatter",
     "all_to_all", "ppermute", "c_fused_allreduce_sum",
+    "c_allreduce_quant",
 ))
 P2P_OP_TYPES = frozenset(("send_v2", "recv_v2"))
+
+
+def _op_quant_block(op):
+    """The quantization block size a ``c_allreduce_quant`` op carries
+    (0 = the env/default resolved at run time)."""
+    try:
+        return int(op.attrs.get("quant_block", 0) or 0)
+    except (TypeError, ValueError):
+        return 0
 
 
 def collective_ici_bytes(op_type, payload_bytes, nranks):
@@ -260,6 +270,14 @@ for _t in ("mean", "reduce_mean", "reduce_sum", "reduce_max",
            "reduce_min", "reduce_prod", "sum"):
     register_flops(_t)(
         lambda op, ins, outs: sum(v.local_numel or 0 for v in ins))
+
+
+@register_flops("c_allreduce_quant")
+def _allreduce_quant_flops(op, ins, outs):
+    # quantize (absmax/scale/round) + dequant-sum + requant + final
+    # dequant ≈ 8 FLOPs per element on top of the wire transfer — the
+    # compute tax that lets compute-bound buckets price quant as losing
+    return 8 * sum(v.local_numel or 0 for v in ins)
 
 
 @register_flops("flash_decode_attention")
@@ -527,6 +545,15 @@ def estimate_cost(program, interp=None, targets=(), nranks=None,
                 # bucketed allreduce: the coalesced buffer carries the
                 # SUM of the member payloads in one launch
                 payload = sum(_val_bytes(v) for v in rec.ins)
+            elif op.type == "c_allreduce_quant":
+                # quantized bucket: the wire carries int8 elements plus
+                # the f32-per-block scale sidecar, not the member dtype
+                from ..quant.collective import quantized_wire_bytes
+
+                numel = sum(v.local_numel or 0 for v in rec.ins)
+                payload, _ = quantized_wire_bytes(
+                    numel, nranks,
+                    block=_op_quant_block(op) or None)
             else:
                 payload = max(
                     [_val_bytes(v) for v in (rec.ins or rec.outs)] or [0])

@@ -123,6 +123,22 @@ def extract_collective_schedule(program, worker=0, interp=None,
                   (rec.outs[0] if rec.outs else None))
         numel = payload.local_numel if payload is not None else None
         var = payload.name if payload is not None else None
+        if op.type == "c_allreduce_quant" and rec.ins:
+            # quantized bucket: like the fused op it moves one coalesced
+            # buffer, but the WIRE identity is int8 + scale sidecar —
+            # recording dtype "int8" keeps a quantized ring from
+            # signature-matching a bf16 ring with the same numel (a
+            # worker pair that disagreed about quantizing a bucket must
+            # be flagged as divergent, not proven consistent)
+            numel = sum(v.local_numel or 0 for v in rec.ins)
+            var = "%s(+%d coalesced, int8)" % (rec.ins[0].name,
+                                               len(rec.ins) - 1)
+            ev = CollectiveEvent(
+                worker, ring, op.type, "int8", numel,
+                rec.block_idx, rec.op_idx, op.type,
+                var=var, peer=op.attrs.get("peer"), order=rec.index)
+            schedule.setdefault(ring, []).append(ev)
+            continue
         if op.type == "c_fused_allreduce_sum" and rec.ins:
             # the bucketed allreduce moves ONE coalesced buffer: its
             # schedule signature is the summed member payload (identical

@@ -119,7 +119,8 @@ _ACT_TYPES = ("relu", "gelu", "tanh", "sigmoid", "relu6", "leaky_relu",
 _PROGRAM_MARKS = ("_num_trainers", "_trainer_id", "_host_tables",
                   "_hbm_budget", "_nan_guard", "_guard_loss_name",
                   "_pipeline_stage", "_guard_abort_after",
-                  "_allreduce_bucket_mb", "_shard_optimizer_state")
+                  "_allreduce_bucket_mb", "_shard_optimizer_state",
+                  "_quant_buckets")
 
 # per-var attrs execution semantics depend on; Program.clone() now
 # preserves these itself (framework.CLONE_VAR_MARKS) — this copy pass
@@ -290,15 +291,25 @@ class FusionConfig:
             getattr(bs, "fuse_embedding_gather", True))
         return c
 
-    def signature(self):
-        """Hashable identity — part of the executor's jit cache key."""
+    def signature(self, program=None):
+        """Hashable identity — part of the executor's jit cache key.
+
+        Pass the program whose rewrite is being keyed: the bucket cap
+        and quant threshold resolve mark → env → default, and the MARK
+        must win in the key too — ``allreduce_bucket_mb()`` bare would
+        record the env value for a program whose ``_allreduce_bucket_mb``
+        mark overrides it, so a plan re-stamp (same program version)
+        could hit a stale fused clone built for the old bucket size."""
+        from ..quant.collective import quant_min_bytes as _qmb
+        from ..quant.blockwise import quant_block as _qb
+
         return (self.enabled, self.fuse_attention, self.fuse_elewise,
                 self.fuse_softmax_xent, self.fuse_optimizer,
                 self.fuse_allreduce, self.fuse_conv_bn_act,
-                self.fuse_embedding_gather, allreduce_bucket_mb(),
+                self.fuse_embedding_gather, allreduce_bucket_mb(program),
                 optimizer_fuse_overhead_bytes(), _flash_min_t(),
                 conv_bn_min_bytes(), embed_fuse_min_bytes(),
-                _autotune_state())
+                _qmb(program), _qb(), _autotune_state())
 
     def __repr__(self):
         return "FusionConfig%r" % (self.signature(),)
@@ -1637,6 +1648,15 @@ def _find_allreduce(view, report, dry_run=False):
         groups.setdefault(key, []).append((i, op, nbytes))
 
     cap = int(allreduce_bucket_mb(block.program) * (1 << 20))
+    # quantized-collective engagement: the planner's _quant_buckets mark
+    # (or the env override) names the per-bucket byte threshold; None =
+    # quant off for this program → plain bf16 coalescing only
+    from ..quant.blockwise import quant_block as _quant_block
+    from ..quant.collective import (quant_min_bytes as _quant_min,
+                                    quantized_wire_bytes)
+
+    qmin = _quant_min(block.program)
+    qblock = _quant_block()
     matches = []
     for key, members in sorted(groups.items(),
                                key=lambda kv: kv[1][0][0]):
@@ -1652,7 +1672,13 @@ def _find_allreduce(view, report, dry_run=False):
         if cur:
             buckets.append(cur)
         for bucket in buckets:
-            if len(bucket) < 2:
+            # a quantizable bucket engages at ANY member count (a lone
+            # big grad still wins the byte cut); without quant a
+            # single-member bucket has nothing to coalesce
+            quantizable = (qmin is not None
+                           and key[2] in ("float32", "bfloat16")
+                           and sum(b for _, _, b in bucket) >= qmin)
+            if len(bucket) < 2 and not quantizable:
                 continue  # nothing to coalesce; no advisory noise
             flush_idx = bucket[-1][0]
             member_ids = {id(op) for _, op, _ in bucket}
@@ -1689,26 +1715,48 @@ def _find_allreduce(view, report, dry_run=False):
                         "grad %r is read/written between its allreduce "
                         "and the bucket flush site — stays unfused" % g,
                         key=op.attrs.get("__op_id__"))
-            if len(safe) < 2:
+            total = sum(b for _, _, b in safe)
+            quant = (qmin is not None
+                     and key[2] in ("float32", "bfloat16")
+                     and total >= qmin)
+            if len(safe) < (1 if quant else 2):
                 continue
             names = [op.inputs["X"][0] for _, op, _ in safe]
-            total = sum(b for _, _, b in safe)
             attrs = {"ring_id": key[0], "op_role": "backward"}
             if key[1]:
                 attrs["pre_scale"] = key[1]
-            fused = _new_op(None if dry_run else block, "c_fused_allreduce_sum",
+            if quant:
+                attrs["quant_block"] = qblock
+            fused_type = "c_allreduce_quant" if quant \
+                else "c_fused_allreduce_sum"
+            fused = _new_op(None if dry_run else block, fused_type,
                             {"X": list(names)}, {"Out": list(names)},
                             attrs)
-            rewrite = FusionRewrite(
-                "allreduce", "c_fused_allreduce_sum", block.idx,
-                [i for i, _, _ in safe], vars=tuple(names),
-                predicted={
+            if quant:
+                numel = total // max(dtype_bytes(key[2]), 1)
+                wire, dense = quantized_wire_bytes(
+                    numel, 2, block=qblock, dtype_bytes=dtype_bytes(key[2]))
+                predicted = {
+                    "collectives_removed": len(safe) - 1,
+                    "ici_bytes_saved": dense - wire,
+                    "quant_block": qblock,
+                    "bucket_mb_cap": allreduce_bucket_mb(block.program),
+                }
+                note = ("ring %r; int8 wire %d -> %d bytes, "
+                        "%d launches -> 1"
+                        % (key[0], dense, wire, len(safe)))
+            else:
+                predicted = {
                     "collectives_removed": len(safe) - 1,
                     "ici_bytes_unchanged": total,
                     "bucket_mb_cap": allreduce_bucket_mb(block.program),
-                },
-                note="ring %r; ICI volume unchanged, %d launches -> 1"
-                     % (key[0], len(safe)))
+                }
+                note = ("ring %r; ICI volume unchanged, %d launches -> 1"
+                        % (key[0], len(safe)))
+            rewrite = FusionRewrite(
+                "allreduce", fused_type, block.idx,
+                [i for i, _, _ in safe], vars=tuple(names),
+                predicted=predicted, note=note)
             matches.append({
                 "replacements": {safe[-1][0]: fused},
                 "removals": {i for i, _, _ in safe[:-1]},
@@ -1796,7 +1844,8 @@ def apply_fusion_passes(program, config=None, targets=(), verify=None):
 # lines the bracket would filter out anyway
 _BRACKET_EXCLUDE = ("fusible-pattern-not-fused", "unreferenced-op",
                     "resilience-finite-guard",
-                    "executor-host-sync-in-loop", "sync-in-hot-loop")
+                    "executor-host-sync-in-loop", "sync-in-hot-loop",
+                    "quantizable-bucket-not-quantized")
 
 
 # the in-flight depth the bracket's race checks assume: a fusion
@@ -1922,7 +1971,7 @@ def resolve_fused_program(program, config=None, targets=()):
     from ..observability import runtime as _obs
 
     tkey = tuple(sorted({getattr(t, "name", t) for t in (targets or ())}))
-    key = (config.signature(), program._version, tkey)
+    key = (config.signature(program), program._version, tkey)
     cache = program.__dict__.setdefault("_fusion_cache", {})
     hit = cache.get(key)
     if hit is not None:
@@ -1949,7 +1998,7 @@ def resolve_fused_program(program, config=None, targets=()):
     if not report.applied:
         cache[key] = (None, report)
         return program, report
-    clone._fusion_sig = config.signature()
+    clone._fusion_sig = config.signature(program)
     clone._fusion_report = report
     cache[key] = (clone, report)
     try:
@@ -1961,7 +2010,7 @@ def resolve_fused_program(program, config=None, targets=()):
                      in sorted(report.applied.items())}
             if isinstance(report.applied, dict)
             else list(report.applied),
-            signature=config.signature())
+            signature=config.signature(program))
     except Exception:  # noqa: BLE001 - telemetry never breaks resolve
         pass
     return clone, report

@@ -254,7 +254,8 @@ def _replicating_transfer(op, in_vals, out_val):
 # materialize the global value on every participant)
 for _t in ("c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
            "c_allreduce_prod", "allreduce", "c_broadcast", "broadcast",
-           "c_allgather", "fill_constant", "c_fused_allreduce_sum"):
+           "c_allgather", "fill_constant", "c_fused_allreduce_sum",
+           "c_allreduce_quant"):
     register_transfer(_t)(_replicating_transfer)
 
 

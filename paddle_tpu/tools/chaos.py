@@ -36,6 +36,17 @@ The post-recovery loss curve is diffed against a same-seed oracle run
 uninterrupted at the shrunk world size (exit 1 beyond ``--tolerance``),
 and the journal must show the
 ``worker-lost → replan → reshard → resume`` incident chain.
+
+``--quant`` runs the quantized-collective A/B drill (ISSUE-15): twin
+same-seed data-parallel training runs where the control reduces
+gradients densely and the quant twin pushes every gradient bucket
+through the real int8 block-quantized reduction pipeline
+(quantize → dequant-sum → requant → dequant, exactly the
+``quant/collective.py`` wire math for a 2-rank ring).  Every step the
+measured quantization error is checked against the documented error
+model and fed to the ``quant_error`` drift gauge; the drill exits 1
+unless the two loss curves stay within ``--tolerance`` relative error
+AND both converge.
 """
 
 import argparse
@@ -653,6 +664,148 @@ def _run_driver(args):
     return 0
 
 
+def _run_quant_driver(args):
+    """ISSUE-15 acceptance drill: quantized vs dense collective twins.
+
+    Both twins train the same deterministic model from the same seed on
+    the same batches, each step splitting the batch across a simulated
+    2-rank data-parallel ring.  The control sums the per-rank gradients
+    in full precision; the quant twin runs them through the identical
+    quantize → dequant-sum → requant → dequant pipeline the
+    ``c_allreduce_quant`` op executes on the wire (same primitives, same
+    block size, same fixed reduction order), so the injected error IS
+    the collective's error — not a stand-in.  Runs on one CPU device;
+    no mesh is needed because a 2-rank quantized ring's arithmetic is
+    rank-count-independent pointwise math once the shards are in hand.
+    """
+    _force_cpu()
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.flatten_util import ravel_pytree
+
+    from paddle_tpu.observability.drift import monitor, reset_drift
+    from paddle_tpu.quant import (block_dequantize, block_quantize,
+                                  predicted_rms_error, quant_block)
+
+    steps = max(args.steps, 6)
+    lr = 0.05
+    print("chaos: quant A/B drill — %d steps, block=%d, tolerance=%g"
+          % (steps, quant_block(), args.tolerance), flush=True)
+
+    def init_params():
+        k = jax.random.PRNGKey(_MODEL_SEED)
+        k1, k2 = jax.random.split(k)
+        return {
+            "w1": jax.random.normal(k1, (_FEATS, _HIDDEN)) * 0.5,
+            "b1": jnp.zeros((_HIDDEN,)),
+            "w2": jax.random.normal(k2, (_HIDDEN, 1)) * 0.5,
+            "b2": jnp.zeros((1,)),
+        }
+
+    def loss_fn(params, xb, yb):
+        h = jnp.maximum(xb @ params["w1"] + params["b1"], 0.0)
+        p = h @ params["w2"] + params["b2"]
+        return jnp.mean((p - yb) ** 2)
+
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+
+    def quant_reduce(flats):
+        """Mirror quantized_allreduce on already-materialized shards:
+        each rank's contribution crosses the wire as int8 + scales both
+        directions (reduce-scatter then allgather)."""
+        numel = int(flats[0].size)
+        parts, preds = [], []
+        for f in flats:
+            q, s = block_quantize(f)
+            parts.append(block_dequantize(q, s, size=numel))
+            preds.append(float(predicted_rms_error(s)))
+        acc = parts[0]
+        for p in parts[1:]:
+            acc = acc + p
+        q_r, s_r = block_quantize(acc)
+        out = block_dequantize(q_r, s_r, size=numel)
+        preds.append(float(predicted_rms_error(s_r)))
+        predicted = float(np.sqrt(sum(p * p for p in preds)))
+        return out, predicted
+
+    reset_drift()
+    mon = monitor()
+    params_c = init_params()
+    params_q = jax.tree_util.tree_map(lambda a: a, params_c)
+    losses_c, losses_q = [], []
+    worst_rel, worst_err_ratio = 0.0, 0.0
+    for k, (xb, yb) in enumerate(_batches(steps)):
+        half = _BATCH // 2
+        shards = [(xb[:half], yb[:half]), (xb[half:], yb[half:])]
+
+        # control twin: dense mean-of-shards reduction
+        lv_c = grad_fn(params_c, xb, yb)[0]
+        gflats_c = []
+        unravel = None
+        for xs, ys in shards:
+            _, g = grad_fn(params_c, xs, ys)
+            flat, unravel = ravel_pytree(g)
+            gflats_c.append(flat * 0.5)
+        dense_c = gflats_c[0] + gflats_c[1]
+        params_c = unravel(ravel_pytree(params_c)[0] - lr * dense_c)
+
+        # quant twin: same shards, int8 wire reduction; the dense sum of
+        # ITS OWN gradients is the per-step error reference
+        lv_q = grad_fn(params_q, xb, yb)[0]
+        gflats_q = []
+        for xs, ys in shards:
+            _, g = grad_fn(params_q, xs, ys)
+            flat, _ = ravel_pytree(g)
+            gflats_q.append(flat * 0.5)
+        dense_q = gflats_q[0] + gflats_q[1]
+        reduced, predicted = quant_reduce(gflats_q)
+        measured = float(jnp.sqrt(jnp.mean((reduced - dense_q) ** 2)))
+        mon.observe_quant_error(measured, predicted=predicted,
+                                bucket="grads")
+        if predicted > 0:
+            worst_err_ratio = max(worst_err_ratio, measured / predicted)
+        params_q = unravel(ravel_pytree(params_q)[0] - lr * reduced)
+
+        lc, lq = float(lv_c), float(lv_q)
+        losses_c.append(lc)
+        losses_q.append(lq)
+        rel = abs(lq - lc) / max(abs(lc), 1e-8)
+        worst_rel = max(worst_rel, rel)
+        print("CHAOS_QUANT_STEP %d loss_dense=%.8f loss_quant=%.8f "
+              "rel=%.2e quant_rms=%.3e model_rms=%.3e"
+              % (k, lc, lq, rel, measured, predicted), flush=True)
+
+    converged_c = losses_c[-1] < losses_c[0]
+    converged_q = losses_q[-1] < losses_q[0]
+    # 3x headroom over the RMS model: per-step error is a random draw,
+    # the model is its expectation
+    model_ok = worst_err_ratio <= 3.0
+    print("chaos: quant drill worst_loss_rel=%.2e worst_error_vs_model="
+          "%.2fx converged dense=%s quant=%s"
+          % (worst_rel, worst_err_ratio, converged_c, converged_q),
+          flush=True)
+    if worst_rel > args.tolerance:
+        print("chaos: FAIL — quant twin loss diverged %.2e > "
+              "tolerance %g" % (worst_rel, args.tolerance), flush=True)
+        return 1
+    if not (converged_c and converged_q):
+        print("chaos: FAIL — a twin failed to converge "
+              "(dense %s, quant %s)" % (converged_c, converged_q),
+              flush=True)
+        return 1
+    if not model_ok:
+        print("chaos: FAIL — measured quant error %.2fx the documented "
+              "model (alert 'quant_error_ratio>2' would page)"
+              % worst_err_ratio, flush=True)
+        return 1
+    print("chaos: PASS — quantized twin matched the dense loss curve "
+          "within %g and the error stayed inside the model"
+          % args.tolerance, flush=True)
+    return 0
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(
         prog="python -m paddle_tpu.tools.chaos",
@@ -678,6 +831,11 @@ def main(argv=None):
                              "of --elastic-world workers mid-run and "
                              "demand an in-process re-plan/reshard/"
                              "resume at the shrunk world size")
+    parser.add_argument("--quant", action="store_true",
+                        help="run the quantized-collective A/B drill "
+                             "instead: same-seed twins (dense vs int8 "
+                             "block-quantized gradient reduction) must "
+                             "match loss curves within --tolerance")
     parser.add_argument("--elastic-world", type=int, default=3,
                         help="elastic cluster size before the kill")
     parser.add_argument("--kill-step", type=int, default=3,
@@ -705,6 +863,8 @@ def main(argv=None):
         return _run_worker(args)
     if args.elastic_worker:
         return _run_elastic_worker(args)
+    if args.quant:
+        return _run_quant_driver(args)
     if args.elastic:
         return _run_elastic_driver(args)
     return _run_driver(args)

@@ -18,11 +18,15 @@ Modes::
     python -m paddle_tpu.tools.monitor DIR --once --json  # machine form
     python -m paddle_tpu.tools.monitor DIR --once \\
         --alert 'p99_step_ms>50'                          # exit 1 if hot
+    python -m paddle_tpu.tools.monitor DIR --once \\
+        --alert 'quant_error>0.05'        # int8 collectives degrading
 
 Alert expressions are ``<field><op><number>`` with op one of
 ``> >= < <= == !=`` against any numeric field of the ``--json`` output
-(dotted paths allowed, e.g. ``drift.step_ms``).  Exit codes: 0 OK,
-1 alert tripped, 2 no data for the alerted field (or an empty dir).
+(dotted paths allowed, e.g. ``drift.step_ms``; ``quant_error`` is the
+worst per-bucket measured quantization error of the int8 collectives).
+Exit codes: 0 OK, 1 alert tripped, 2 no data for the alerted field (or
+an empty dir).
 """
 
 import argparse
@@ -135,6 +139,19 @@ def _metric_value(merged, name, labels=None):
         total += float(m.get("value", 0.0))
         seen = True
     return total if seen else None
+
+
+def _metric_max(merged, name):
+    """Max over matching gauge series — for per-bucket gauges (e.g.
+    ``quant_error``) where the alert should watch the WORST bucket, not
+    the sum of all of them; None when absent."""
+    worst = None
+    for key, m in merged.items():
+        if key.split("{", 1)[0] != name:
+            continue
+        v = float(m.get("value", 0.0))
+        worst = v if worst is None else max(worst, v)
+    return worst
 
 
 def _merged_histogram(merged, name):
@@ -261,6 +278,12 @@ def collect_status(dirname, hb_dir=None, now=None,
                         drift[kind] = round(float(v), 4)
                 break
 
+    # quantized-collective health (paddle_tpu/quant): worst per-bucket
+    # measured relative error and its drift against the blockwise error
+    # model — the '--alert quant_error>0.05' production gate
+    quant_err = _metric_max(merged, "quant_error")
+    quant_ratio = _metric_max(merged, "quant_error_ratio")
+
     ckpt_ts = _metric_value(merged, "checkpoint_last_save_ts")
     if not ckpt_ts:
         saved = [e for e in events if e.get("kind") == "checkpoint-saved"]
@@ -317,6 +340,10 @@ def collect_status(dirname, hb_dir=None, now=None,
         "faults": counts.get("fault-injected", 0),
         "restores": counts.get("checkpoint-loaded", 0),
         "drift": drift or None,
+        "quant_error": (None if quant_err is None
+                        else round(quant_err, 6)),
+        "quant_error_ratio": (None if quant_ratio is None
+                              else round(quant_ratio, 4)),
         "checkpoint_age_s": checkpoint_age_s,
         "p50_serving_latency_ms": (None if srv_p50 is None
                                    else round(srv_p50, 3)),
@@ -417,6 +444,10 @@ def render_status(status):
         lines.append("  drift " + "  ".join(
             "%s=%s" % (k, _fmt(v))
             for k, v in sorted(status["drift"].items())))
+    if status.get("quant_error") is not None:
+        lines.append("  quant: error=%s  vs_model=%sx" % (
+            _fmt(status["quant_error"]),
+            _fmt(status.get("quant_error_ratio"))))
     if status.get("serving_requests") is not None:
         lines.append(
             "  serving: reqs=%s  qps=%s  lat_ms p50=%s p99=%s  "
@@ -497,8 +528,11 @@ def main(argv=None):
                          "'serving_shed_rate>0'; decode tenants add "
                          "'decode_tokens_per_sec<100' / "
                          "'serving_decode_tokens==0' / "
-                         "'p99_generated_len>512'; exit 1 when tripped, "
-                         "2 when the field has no data (repeatable)")
+                         "'p99_generated_len>512'; quantized-collective "
+                         "jobs add 'quant_error>0.05' (worst per-bucket "
+                         "int8 error) / 'quant_error_ratio>2' (error "
+                         "model drift); exit 1 when tripped, 2 when the "
+                         "field has no data (repeatable)")
     ap.add_argument("--interval", type=float, default=2.0,
                     help="live-mode refresh seconds (default 2)")
     ap.add_argument("--stale-after", type=float,

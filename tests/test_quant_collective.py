@@ -1,0 +1,275 @@
+"""Quantized collective (ISSUE 15): the ``quantized_allreduce`` wire
+math under shard_map, the ``c_allreduce_quant`` op's GSPMD-identity /
+shard_map split, rank-level bit-determinism of the reduction, and the
+schedule extraction + deadlock/consistency proofs over rewritten
+programs."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+import paddle_tpu as fluid
+from paddle_tpu.jax_compat import shard_map
+from paddle_tpu.ops import registry as op_registry
+from paddle_tpu.quant import (block_quantize, block_dequantize,
+                              quantized_allreduce, quantized_wire_bytes)
+from paddle_tpu.static_analysis import fusion, prove_deadlock_free
+from paddle_tpu.static_analysis.distributed import (
+    extract_collective_schedule)
+from paddle_tpu.transpiler.collective import GradAllReduce
+
+NDEV = len(jax.devices())
+needs_mesh = pytest.mark.skipif(
+    NDEV < 4, reason="needs the conftest 8-device CPU mesh")
+
+
+def _mesh(n):
+    return Mesh(np.array(jax.devices()[:n]), ("d",))
+
+
+def _dp_mlp(rank=0, nranks=2):
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=32, act="relu")
+        pred = fluid.layers.fc(input=h, size=4, act="softmax")
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.cross_entropy(input=pred, label=label))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    GradAllReduce().transpile(program=main, startup_program=startup,
+                              rank=rank, nranks=nranks)
+    main._num_trainers = nranks
+    return main, startup, loss
+
+
+class TestQuantizedAllreduce:
+    @needs_mesh
+    @pytest.mark.parametrize("numel", [4096, 1000, 7])
+    def test_approximates_dense_sum(self, numel):
+        """Wire result ~ the dense cross-replica sum within the √2-
+        compounded error model (quantized both directions); odd sizes
+        exercise the pad-to-rank-multiple path."""
+        n = 4
+        mesh = _mesh(n)
+        rng = np.random.RandomState(0)
+        xs = rng.randn(n, numel).astype("float32")
+
+        f = jax.jit(shard_map(
+            lambda x: quantized_allreduce(x[0], "d")[None],
+            mesh=mesh, in_specs=P("d"), out_specs=P("d")))
+        out = np.asarray(f(jnp.asarray(xs)))
+        dense = xs.sum(axis=0)
+        # |err| <= sum of per-pass half-steps; bound loosely by the
+        # reduced tensor's scale: n+1 quantizations of ~absmax/254 each
+        step = np.abs(dense).max() / 127.0
+        assert np.max(np.abs(out - dense[None])) <= (n + 1) * step
+
+    @needs_mesh
+    def test_bit_identical_across_ranks(self):
+        """Every rank dequant-sums identical collective outputs in the
+        same fixed order, so the reduction is bit-identical on all
+        ranks — the cross-process determinism discipline (the wire
+        payload is a pure function of the input bits; a replay or a
+        peer re-computation cannot diverge)."""
+        n = 8
+        mesh = _mesh(n)
+        rng = np.random.RandomState(1)
+        xs = rng.randn(n, 2048).astype("float32")
+        f = jax.jit(shard_map(
+            lambda x: quantized_allreduce(x[0], "d")[None],
+            mesh=mesh, in_specs=P("d"), out_specs=P("d")))
+        out = np.asarray(f(jnp.asarray(xs)))
+        for r in range(1, n):
+            assert np.array_equal(out[0], out[r]), "rank %d diverged" % r
+        # and bit-exact replay of the whole collective
+        out2 = np.asarray(f(jnp.asarray(xs)))
+        assert np.array_equal(out, out2)
+
+    @needs_mesh
+    def test_dtype_preserved(self):
+        mesh = _mesh(2)
+        xs = np.ones((2, 512), "float32")
+        f = jax.jit(shard_map(
+            lambda x: quantized_allreduce(
+                x[0].astype(jnp.bfloat16), "d")[None],
+            mesh=mesh, in_specs=P("d"), out_specs=P("d")))
+        assert f(jnp.asarray(xs)).dtype == jnp.bfloat16
+
+    @needs_mesh
+    def test_kernel_eligible_shape_under_interpret_mode(self, monkeypatch):
+        """Regression: with PADDLE_TPU_PALLAS=interpret session-wide
+        (test_flash_attention sets it at import) a kernel-eligible
+        bucket shape must still trace under shard_map — pallas_call has
+        no replication rule, so the collective pins the XLA composite."""
+        monkeypatch.setenv("PADDLE_TPU_PALLAS", "interpret")
+        n = 4
+        mesh = _mesh(n)
+        rng = np.random.RandomState(9)
+        # 4096/256 = 16 blocks: % 8 == 0, kernel-eligible
+        xs = rng.randn(n, 4096).astype("float32")
+        f = jax.jit(shard_map(
+            lambda x: quantized_allreduce(x[0], "d")[None],
+            mesh=mesh, in_specs=P("d"), out_specs=P("d")))
+        out = np.asarray(f(jnp.asarray(xs)))
+        dense = xs.sum(axis=0)
+        step = np.abs(dense).max() / 127.0
+        assert np.max(np.abs(out - dense[None])) <= (n + 1) * step
+
+    def test_wire_bytes_cut(self):
+        """The cost-model payload rule: int8 + sidecar vs dense, >= 1.9x
+        for bf16 and ~3.9x for f32 at block 256 (modulo pad)."""
+        quant, dense = quantized_wire_bytes(1 << 20, 8, block=256,
+                                            dtype_bytes=2)
+        assert dense / quant >= 1.9
+        quant4, dense4 = quantized_wire_bytes(1 << 20, 8, block=256,
+                                              dtype_bytes=4)
+        assert dense4 / quant4 >= 3.8
+        # tiny bucket: padding makes quant LOSE — the planner's
+        # break-even threshold exists for a reason
+        quant_t, dense_t = quantized_wire_bytes(64, 8, block=256,
+                                                dtype_bytes=2)
+        assert quant_t > dense_t
+
+
+class TestCAllreduceQuantOp:
+    def test_gspmd_identity(self):
+        """No shard_map axis (the GSPMD path): the op is an identity
+        like every framework collective — XLA owns the wire, so the
+        executor path stays bit-exact."""
+        opdef = op_registry.get_op_def("c_allreduce_quant")
+        ctx = op_registry.LoweringContext(mode="train")
+        x = jnp.asarray(np.random.RandomState(2).randn(100)
+                        .astype("float32"))
+        out = op_registry.call_op(opdef, ctx, {"X": [x]}, {})
+        assert np.array_equal(np.asarray(out["Out"][0]), np.asarray(x))
+
+    @needs_mesh
+    def test_shard_map_lowering_sums(self):
+        opdef = op_registry.get_op_def("c_allreduce_quant")
+        n = 2
+        mesh = _mesh(n)
+        rng = np.random.RandomState(3)
+        xs = rng.randn(n, 512).astype("float32")
+
+        def f(x):
+            ctx = op_registry.LoweringContext(mode="train")
+            ctx.collective_axis = "d"
+            out = op_registry.call_op(opdef, ctx, {"X": [x[0]]}, {})
+            return out["Out"][0][None]
+
+        g = jax.jit(shard_map(f, mesh=mesh, in_specs=P("d"),
+                              out_specs=P("d")))
+        out = np.asarray(g(jnp.asarray(xs)))
+        dense = xs.sum(axis=0)
+        step = np.abs(dense).max() / 127.0
+        assert np.max(np.abs(out - dense[None])) <= (n + 1) * step
+
+    @needs_mesh
+    def test_multi_slot_matches_member_roundtrip(self):
+        """The duplicable X*/Out* slots flatten-concat members into one
+        bucket; each member comes back the same shape."""
+        opdef = op_registry.get_op_def("c_allreduce_quant")
+        mesh = _mesh(2)
+        rng = np.random.RandomState(4)
+        a = rng.randn(2, 8, 4).astype("float32")
+        b = rng.randn(2, 33).astype("float32")
+
+        def f(av, bv):
+            ctx = op_registry.LoweringContext(mode="train")
+            ctx.collective_axis = "d"
+            out = op_registry.call_op(
+                opdef, ctx, {"X": [av[0], bv[0]]}, {})
+            return out["Out"][0][None], out["Out"][1][None]
+
+        g = jax.jit(shard_map(f, mesh=mesh,
+                              in_specs=(P("d"), P("d")),
+                              out_specs=(P("d"), P("d"))))
+        oa, ob = g(jnp.asarray(a), jnp.asarray(b))
+        assert np.asarray(oa).shape == (2, 8, 4)
+        assert np.asarray(ob).shape == (2, 33)
+        da, db = a.sum(axis=0), b.sum(axis=0)
+        step = max(np.abs(da).max(), np.abs(db).max()) / 127.0
+        assert np.max(np.abs(np.asarray(oa)[0] - da)) <= 3 * step
+        assert np.max(np.abs(np.asarray(ob)[0] - db)) <= 3 * step
+
+
+class TestRewrittenScheduleProofs:
+    def _resolve_quant(self, rank=0, nranks=2):
+        main, _, loss = _dp_mlp(rank=rank, nranks=nranks)
+        fused, report = fusion.resolve_fused_program(
+            main, targets=[loss.name])
+        return main, fused, loss, report
+
+    def test_quant_events_sign_int8(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_QUANT_MIN_BYTES", "1")
+        _, fused, loss, _ = self._resolve_quant()
+        types = [op.type for blk in fused.blocks for op in blk.ops]
+        assert "c_allreduce_quant" in types
+        assert "c_fused_allreduce_sum" not in types
+        sched = extract_collective_schedule(fused)
+        evs = sched.get(0, [])
+        assert [e.op_type for e in evs] == ["c_allreduce_quant"]
+        assert evs[0].dtype == "int8"
+        assert evs[0].numel == 16 * 32 + 32 + 32 * 4 + 4
+        assert "int8" in evs[0].var
+
+    def test_deadlock_prover_accepts_quant_twins(self, monkeypatch):
+        """PR-3 acceptance: two workers that both quantize the bucket
+        re-prove deadlock-free on the REWRITTEN schedule."""
+        monkeypatch.setenv("PADDLE_TPU_QUANT_MIN_BYTES", "1")
+        workers = [self._resolve_quant(rank=r)[1] for r in range(2)]
+        schedules, diags = prove_deadlock_free(workers, nranks=2)
+        assert diags == []
+        assert [e.op_type for e in schedules[0].get(0, [])] == \
+            ["c_allreduce_quant"]
+
+    def test_quant_disagreement_flags_divergent(self, monkeypatch):
+        """A worker pair disagreeing about quantizing a bucket must NOT
+        prove consistent: the int8 wire identity breaks the dense
+        ring's signature even at equal numel."""
+        monkeypatch.setenv("PADDLE_TPU_QUANT_MIN_BYTES", "1")
+        _, quant_worker, _, _ = self._resolve_quant(rank=0)
+        monkeypatch.delenv("PADDLE_TPU_QUANT_MIN_BYTES")
+        main, _, loss = _dp_mlp(rank=1)
+        dense_worker, _ = fusion.resolve_fused_program(
+            main, targets=[loss.name])
+        _, diags = prove_deadlock_free([quant_worker, dense_worker],
+                                       nranks=2)
+        assert diags, "quant/dense disagreement proved consistent"
+
+    def test_kill_switch_schedule_identical_to_dense(self, monkeypatch):
+        """PADDLE_TPU_QUANT=0 with the threshold still set: the rewrite,
+        the schedule and the wire dtype are the pre-quant ones."""
+        monkeypatch.setenv("PADDLE_TPU_QUANT_MIN_BYTES", "1")
+        monkeypatch.setenv("PADDLE_TPU_QUANT", "0")
+        _, fused, loss, _ = self._resolve_quant()
+        types = [op.type for blk in fused.blocks for op in blk.ops]
+        assert "c_allreduce_quant" not in types
+        assert "c_fused_allreduce_sum" in types
+        evs = extract_collective_schedule(fused).get(0, [])
+        assert [e.op_type for e in evs] == ["c_fused_allreduce_sum"]
+        assert evs[0].dtype != "int8"
+
+
+class TestAnalyzerPricing:
+    def test_cost_model_prices_int8_payload(self, monkeypatch):
+        """estimate_cost charges the quant op the int8+sidecar payload,
+        not the dense member bytes."""
+        monkeypatch.setenv("PADDLE_TPU_QUANT_MIN_BYTES", "1")
+        from paddle_tpu.static_analysis.cost import estimate_cost
+
+        main, _, loss = _dp_mlp()
+        dense_rep = estimate_cost(main, nranks=2, targets=[loss.name])
+        fused, _ = fusion.resolve_fused_program(main,
+                                                targets=[loss.name])
+        quant_rep = estimate_cost(fused, nranks=2, targets=[loss.name])
+        assert quant_rep.total_ici_bytes < dense_rep.total_ici_bytes
+        numel = 16 * 32 + 32 + 32 * 4 + 4
+        wire, dense = quantized_wire_bytes(numel, 2, dtype_bytes=4)
+        assert dense_rep.total_ici_bytes // quant_rep.total_ici_bytes \
+            == dense // wire

@@ -1,0 +1,340 @@
+"""Quant as a priced planner axis (ISSUE 15): candidate enumeration,
+per-bucket pricing, the winning plan's ``_quant_buckets`` stamp through
+``apply_plan``, the fusion rewrite it engages, the kill-switch
+bit-exactness contract, the bucket-cap precedence bugfix, and the
+``quantizable-bucket-not-quantized`` advisory."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import autotune
+from paddle_tpu.parallel.planner import (ClusterSpec, apply_plan,
+                                         auto_transpile,
+                                         enumerate_candidates,
+                                         quant_bucket_mark)
+from paddle_tpu.quant.blockwise import quant_block
+from paddle_tpu.quant.collective import quant_min_bytes
+from paddle_tpu.static_analysis import verify_program
+from paddle_tpu.static_analysis import fusion
+from paddle_tpu.static_analysis.fusion import (FusionConfig,
+                                               allreduce_bucket_mb)
+from paddle_tpu.transpiler.collective import GradAllReduce
+
+import dist_model
+
+
+def _fresh_mlp():
+    fluid.unique_name.switch()
+    return dist_model.build_model()
+
+
+def _wide_mlp():
+    """Gradient-heavy builder (one ~1MB fc) so a starved interconnect
+    prices the int8 exchange as the outright winner."""
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[64], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        h = fluid.layers.fc(input=x, size=4096, act="relu")
+        p = fluid.layers.fc(input=h, size=1)
+        loss = fluid.layers.reduce_mean(fluid.layers.square(p - y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return main, startup, loss
+
+
+def _dp_mlp(rank=0, nranks=2):
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=32, act="relu")
+        pred = fluid.layers.fc(input=h, size=4, act="softmax")
+        loss = fluid.layers.reduce_mean(
+            fluid.layers.cross_entropy(input=pred, label=label))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    GradAllReduce().transpile(program=main, startup_program=startup,
+                              rank=rank, nranks=nranks)
+    main._num_trainers = nranks
+    return main, startup, loss
+
+
+def _non_quant_key(cand):
+    """plan_key with the quant dimension dropped."""
+    return cand.plan_key()[:-1]
+
+
+class TestCandidateEnumeration:
+    def test_quant_doubles_the_trainable_dp_family(self):
+        main, startup, loss, _ = _fresh_mlp()
+        cands = enumerate_candidates(main, ClusterSpec(4))
+        quant = [c for c in cands if c.quant]
+        assert quant, "no quant candidates for a trainable program"
+        assert all(c.kind == "dp" for c in quant)
+        # every quant candidate shadows a dense twin of the same knobs
+        dense_keys = {_non_quant_key(c) for c in cands if not c.quant}
+        for c in quant:
+            assert _non_quant_key(c) in dense_keys
+
+    def test_kill_switch_removes_the_axis(self, monkeypatch):
+        main, startup, loss, _ = _fresh_mlp()
+        with_axis = enumerate_candidates(main, ClusterSpec(4))
+        monkeypatch.setenv("PADDLE_TPU_QUANT", "0")
+        fluid.unique_name.switch()
+        main2, _, _, _ = dist_model.build_model()
+        without = enumerate_candidates(main2, ClusterSpec(4))
+        assert not any(c.quant for c in without)
+        # exactly the pre-quant candidate list: the dense keys match
+        assert [c.plan_key() for c in without] == \
+            [c.plan_key() for c in with_axis if not c.quant]
+
+    def test_inference_program_has_no_quant_candidates(self):
+        fluid.unique_name.switch()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+            h = fluid.layers.fc(input=x, size=32, act="relu")
+            fluid.layers.fc(input=h, size=4, act="softmax")
+        cands = enumerate_candidates(main, ClusterSpec(4))
+        assert not any(getattr(c, "quant", False) for c in cands)
+
+
+class TestPricing:
+    def _priced_pair(self, res):
+        """(quant, dense) PricedCandidate pairs sharing all other
+        knobs, keyed for deterministic comparison."""
+        dense = {_non_quant_key(pc.candidate): pc
+                 for pc in res.candidates if not pc.candidate.quant}
+        pairs = []
+        for pc in res.candidates:
+            if pc.candidate.quant:
+                twin = dense.get(_non_quant_key(pc.candidate))
+                if twin is not None:
+                    pairs.append((pc, twin))
+        return pairs
+
+    def test_quant_wins_on_starved_ici(self):
+        main, startup, loss, _ = _fresh_mlp()
+        res = auto_transpile(
+            main, ClusterSpec(chips=2, ici_gbps=0.0001, launch_us=0.1),
+            startup_program=startup, targets=[loss.name])
+        pairs = self._priced_pair(res)
+        assert pairs
+        # bandwidth-bound: int8 wire cut beats the extra phase/launches
+        assert all(q.price.step_ms < d.price.step_ms for q, d in pairs)
+
+    def test_dense_not_worse_on_rich_ici(self):
+        """Tiny gradients on a fat interconnect: the quant launch tax
+        dominates, the dense twin prices at or below the quant one —
+        the axis must never be a free lunch in the table."""
+        main, startup, loss, _ = _fresh_mlp()
+        res = auto_transpile(main, ClusterSpec(chips=2),
+                             startup_program=startup,
+                             targets=[loss.name])
+        pairs = self._priced_pair(res)
+        assert pairs
+        assert all(d.price.step_ms <= q.price.step_ms for q, d in pairs)
+        assert not res.plan.candidate.quant
+
+
+class TestWinnerApplyAndStamp:
+    SPEC = dict(chips=2, ici_gbps=0.01, launch_us=1)
+
+    def _win(self):
+        main, startup, loss = _wide_mlp()
+        res = auto_transpile(main, ClusterSpec(**self.SPEC),
+                             startup_program=startup,
+                             targets=[loss.name], batch_size=256)
+        return main, startup, loss, res
+
+    def test_quant_dp_wins_outright(self):
+        _, _, _, res = self._win()
+        assert res.plan.candidate.quant
+        assert res.plan.candidate.kind == "dp"
+        assert "+int8" in res.plan.candidate.describe()
+        assert res.deadlock_free
+
+    def test_apply_stamps_quant_buckets_mark(self):
+        main, startup, loss, res = self._win()
+        cand = apply_plan(main, res, startup_program=startup)
+        assert cand.quant
+        mark = main._quant_buckets
+        assert mark == quant_bucket_mark(res.cluster, cand.degree)
+        assert mark["block"] == quant_block()
+        assert mark["min_bytes"] >= 1
+        # the mark IS the engagement: quant_min_bytes reads it with no
+        # env set, and the fusion rewrite emits the quant op
+        assert quant_min_bytes(main) == mark["min_bytes"]
+        fused, _ = fusion.resolve_fused_program(main,
+                                                targets=[loss.name])
+        types = [op.type for blk in fused.blocks for op in blk.ops]
+        assert "c_allreduce_quant" in types
+
+    def test_clone_preserves_the_mark(self):
+        main, startup, loss, res = self._win()
+        apply_plan(main, res, startup_program=startup)
+        clone = main.clone()
+        assert getattr(clone, "_quant_buckets", None) \
+            == main._quant_buckets
+
+    def test_runtime_config_emits_quant_env(self):
+        _, _, _, res = self._win()
+        _, env = res.runtime_config()
+        mark = quant_bucket_mark(res.cluster, res.plan.candidate.degree)
+        assert env["PADDLE_TPU_QUANT_MIN_BYTES"] \
+            == str(mark["min_bytes"])
+        assert env["PADDLE_TPU_QUANT_BLOCK"] == str(mark["block"])
+
+    def test_format_table_has_quant_column(self):
+        _, _, _, res = self._win()
+        table = res.format_table()
+        header = table.splitlines()[1]
+        assert "quant" in header
+        assert "int8" in table
+        chosen = [ln for ln in table.splitlines() if "+int8" in ln]
+        assert chosen
+
+
+class TestKillSwitchBitExact:
+    def test_disabled_resolve_is_op_for_op_dense(self, monkeypatch):
+        """PADDLE_TPU_QUANT=0 with the threshold still exported: the
+        resolved program is op-for-op the no-quant-env baseline — the
+        acceptance criterion's bit-exact escape hatch."""
+        main, _, loss = _dp_mlp()
+        baseline, _ = fusion.resolve_fused_program(main,
+                                                   targets=[loss.name])
+        monkeypatch.setenv("PADDLE_TPU_QUANT_MIN_BYTES", "1")
+        monkeypatch.setenv("PADDLE_TPU_QUANT", "0")
+        killed, _ = fusion.resolve_fused_program(main,
+                                                 targets=[loss.name])
+
+        def flat(p):
+            return [(op.type, dict(op.inputs), dict(op.outputs))
+                    for blk in p.blocks for op in blk.ops]
+
+        assert flat(killed) == flat(baseline)
+
+
+class TestFusionQuantRewrite:
+    def test_single_member_bucket_engages(self, monkeypatch):
+        """A lone large gradient is below the dense fuser's interest
+        (nothing to coalesce) but still a quant win — the rewrite must
+        take single-member buckets when quant is on."""
+        fluid.unique_name.switch()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[64],
+                                  dtype="float32")
+            y = fluid.layers.data(name="y", shape=[1],
+                                  dtype="float32")
+            p = fluid.layers.fc(input=x, size=1, bias_attr=False)
+            loss = fluid.layers.reduce_mean(fluid.layers.square(p - y))
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        GradAllReduce().transpile(program=main,
+                                  startup_program=startup,
+                                  rank=0, nranks=2)
+        main._num_trainers = 2
+        dense, _ = fusion.resolve_fused_program(main,
+                                                targets=[loss.name])
+        dtypes = [op.type for blk in dense.blocks for op in blk.ops]
+        assert "c_allreduce_sum" in dtypes  # single grad: left alone
+        monkeypatch.setenv("PADDLE_TPU_QUANT_MIN_BYTES", "1")
+        fused, _ = fusion.resolve_fused_program(main,
+                                                targets=[loss.name])
+        qops = [op for blk in fused.blocks for op in blk.ops
+                if op.type == "c_allreduce_quant"]
+        assert len(qops) == 1
+        assert qops[0].attrs["quant_block"] == quant_block()
+
+
+class TestBucketCapPrecedence:
+    def test_mark_beats_env_beats_default(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_TPU_ALLREDUCE_BUCKET_MB",
+                           raising=False)
+        assert allreduce_bucket_mb(None) == 32.0
+        monkeypatch.setenv("PADDLE_TPU_ALLREDUCE_BUCKET_MB", "8")
+        assert allreduce_bucket_mb(None) == 8.0
+        main, _, _ = _dp_mlp()
+        assert allreduce_bucket_mb(main) == 8.0
+        main._allreduce_bucket_mb = 2
+        assert allreduce_bucket_mb(main) == 2.0
+        monkeypatch.setenv("PADDLE_TPU_ALLREDUCE_BUCKET_MB",
+                           "not-a-number")
+        assert allreduce_bucket_mb(None) == 32.0
+
+    def test_signature_sees_the_program_mark(self):
+        """The bugfix: ``FusionConfig.signature()`` used to hash the
+        env-only bucket cap, so stamping ``_allreduce_bucket_mb`` after
+        a resolve served the STALE fused clone from cache.  The
+        signature now threads the program through."""
+        main, _, loss = _dp_mlp()
+        cfg = FusionConfig()
+        base_sig = cfg.signature(main)
+        fused1, _ = fusion.resolve_fused_program(main,
+                                                 targets=[loss.name])
+        n1 = sum(op.type == "c_fused_allreduce_sum"
+                 for blk in fused1.blocks for op in blk.ops)
+        assert n1 == 1  # all four grads (~2.7KB) in one 32MB bucket
+        # 2KB cap splits the 2KB w1 grad from the rest
+        main._allreduce_bucket_mb = 0.002
+        assert cfg.signature(main) != base_sig
+        fused2, _ = fusion.resolve_fused_program(main,
+                                                 targets=[loss.name])
+        n2 = sum(op.type in ("c_fused_allreduce_sum",
+                             "c_allreduce_sum")
+                 for blk in fused2.blocks for op in blk.ops)
+        assert n2 >= 2, "stale cached clone served after re-mark"
+
+
+class TestAdvisory:
+    # a starved link drops the break-even below this MLP's ~2.7KB of
+    # gradients (the default ~2MB threshold would mute the advisory)
+    SPEC = {"chips": 2, "ici_gbps": 0.001}
+
+    def _lint(self, monkeypatch, tmp_path, **env):
+        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE_CACHE",
+                           str(tmp_path / "at.json"))
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+        autotune.reset()
+        main, _, loss = _dp_mlp()
+        main._cluster_spec = dict(self.SPEC)
+        diags = verify_program(main, targets=[loss.name])
+        autotune.reset()
+        return [d for d in diags
+                if d.check == "quantizable-bucket-not-quantized"]
+
+    def test_fires_with_uncalibrated_reason(self, monkeypatch,
+                                            tmp_path):
+        hits = self._lint(monkeypatch, tmp_path)
+        assert hits
+        from paddle_tpu.static_analysis import Severity
+        assert all(d.severity == Severity.INFO for d in hits)
+        msg = hits[0].message
+        assert "no _quant_buckets plan mark" in msg
+        assert "uncalibrated" in msg
+        assert "auto_transpile" in hits[0].hint
+
+    def test_fires_with_kill_switch_reason(self, monkeypatch,
+                                           tmp_path):
+        hits = self._lint(monkeypatch, tmp_path, PADDLE_TPU_QUANT="0")
+        assert hits
+        assert "disabled by PADDLE_TPU_QUANT=0" in hits[0].message
+
+    def test_silent_when_engaged(self, monkeypatch, tmp_path):
+        hits = self._lint(monkeypatch, tmp_path,
+                          PADDLE_TPU_QUANT_MIN_BYTES="1")
+        assert hits == []
+
+    def test_silent_below_break_even(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE_CACHE",
+                           str(tmp_path / "at.json"))
+        autotune.reset()
+        main, _, loss = _dp_mlp()
+        # the default spec's break-even (~2MB) dwarfs 2.7KB of grads
+        diags = verify_program(main, targets=[loss.name])
+        autotune.reset()
+        assert [d for d in diags
+                if d.check == "quantizable-bucket-not-quantized"] == []

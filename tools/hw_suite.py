@@ -219,6 +219,12 @@ def build_steps():
     # (the measured/predicted step time lands in the autotune cache so
     # later searches on this backend price against silicon)
     item("bench_planner", "planner", 480, 420)
+    # ISSUE-15 quantized-collective A/B on the real ICI: dense vs int8
+    # block-quantized gradient ring on BERT_BASE; emits
+    # bert_base_allreduce_byte_cut (gate >= 1.8) +
+    # bert_base_quant_loss_delta (gate <= 1e-3) and calibrates the
+    # autotune 'quant' family against the measured error
+    item("bench_quant", "quant", 420, 360)
     # space-to-depth stem (models/resnet.py _s2d_stem): folds the 7x7
     # stride-2 3-channel stem — the classic MXU-underfill — into a
     # dense 4x4/s1 conv over 12 channels (the TPU ResNet stem recipe)

@@ -100,6 +100,7 @@ MODULES = [
     "paddle_tpu.observability.exporters",
     "paddle_tpu.observability.runtime",
     "paddle_tpu.serving",
+    "paddle_tpu.quant",
 ]
 
 
