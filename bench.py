@@ -1822,6 +1822,241 @@ def child_quant():
               flush=True)
 
 
+def child_overlap():
+    """Overlap-scheduler A/B (ISSUE 16): the BERT trainer's bucketed
+    gradient allreduce ring synchronous vs start/wait split.
+
+    Two gates:
+
+    * ``bert_overlap_exposed_wire_cut`` — the analyzer-priced
+      ``exposed_wire_ms`` of the overlap schedule vs the synchronous
+      one, SAME transpiled program, on an ICI-starved ClusterSpec
+      where the wire dominates.  Must cut >= 25%; both provers (PR-3
+      deadlock, PR-10 in-flight race) must PASS on the rewritten
+      program or the metric reports proofs=FAIL.
+    * ``overlap_collective_loss_delta`` — twin short training runs
+      through the REAL start/wait collectives on a 2-worker shard_map
+      mesh (the with_data_parallel path is GSPMD where framework
+      collectives are identity — same reasoning as child_quant's
+      arm 2), overlap on vs off, same seeds and feeds.  The pair is
+      bit-exact with the fused op by construction, so the gate is
+      BIT-IDENTICAL losses (delta == 0.0), not a tolerance."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu.models import bert
+    from paddle_tpu.static_analysis.concurrency import \
+        find_overlap_window_races
+    from paddle_tpu.static_analysis.cost import estimate_cost, price_plan
+    from paddle_tpu.static_analysis.distributed import prove_deadlock_free
+    from paddle_tpu.static_analysis.fusion import resolve_fused_program
+    from paddle_tpu.transpiler.collective import GradAllReduce
+
+    dev = jax.devices()[0]
+    on_tpu = _is_tpu_platform(dev.platform)
+    ndev = len(jax.devices())
+    nranks = ndev if ndev > 1 else 2
+    cfg = bert.BERT_BASE if on_tpu else bert.BERT_TINY
+    seq = 128 if on_tpu else 32
+    model_name = "bert_base" if on_tpu else "bert_tiny"
+    dev_name = "cpu" if os.environ.get("PADDLE_BENCH_FORCE_CPU") else \
+        jax_backend_name()
+    # ICI-starved spec: wire comparable to the backward's compute so
+    # hoisted windows can actually hide it (cap chosen so bert's grads
+    # split into several buckets, each closing well before the
+    # optimizer reads it)
+    if on_tpu:
+        bucket_cap, price_kw = "8", {
+            "peak_tflops": 1.0, "hbm_gbps": 100.0, "ici_gbps": 10.0,
+            "launch_us": 1.0}
+    else:
+        bucket_cap, price_kw = "0.5", {
+            "peak_tflops": 0.005, "hbm_gbps": 5.0, "ici_gbps": 0.5,
+            "launch_us": 1.0}
+
+    overlap_env = {"PADDLE_TPU_OVERLAP": "1",
+                   "PADDLE_TPU_ALLREDUCE_BUCKET_MB": bucket_cap}
+    sync_env = {"PADDLE_TPU_OVERLAP": "0",
+                "PADDLE_TPU_ALLREDUCE_BUCKET_MB": bucket_cap}
+    saved = {k: os.environ.get(k) for k in
+             set(overlap_env) | set(sync_env)}
+
+    def with_env(env, fn):
+        os.environ.update(env)
+        try:
+            return fn()
+        finally:
+            for k in env:
+                v = saved.get(k)
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    # ---- arm 1: analyzer-priced exposed wire + both proofs ----------
+    fluid.unique_name.switch()
+    main, startup, feeds, loss = bert.build_pretrain(
+        cfg, seq_len=seq, lr=1e-4, train=True)
+    GradAllReduce().transpile(program=main, startup_program=startup,
+                              rank=0, nranks=nranks)
+    main._num_trainers = nranks
+
+    def priced(env):
+        def run():
+            fused, _ = resolve_fused_program(main, targets=[loss.name])
+            report = estimate_cost(fused, nranks=nranks,
+                                   targets=[loss.name])
+            return fused, price_plan(report, **price_kw).to_dict()
+        return with_env(env, run)
+
+    fused_ov, price_ov = priced(overlap_env)
+    _, price_sync = priced(sync_env)
+    exposed_on = price_ov["exposed_wire_ms"]
+    exposed_off = price_sync["exposed_wire_ms"]
+    cut = (1.0 - exposed_on / exposed_off) if exposed_off else 0.0
+
+    ov_report = getattr(fused_ov, "_overlap_report", None)
+    applied = len(ov_report.applied) if ov_report else 0
+    race_diags = find_overlap_window_races(fused_ov)
+    _, dl_diags = prove_deadlock_free([fused_ov] * nranks,
+                                      nranks=nranks)
+    proofs_ok = (applied > 0 and not race_diags
+                 and not [d for d in dl_diags
+                          if d.severity.name == "ERROR"])
+    print(json.dumps({
+        "metric": "bert_overlap_exposed_wire_cut",
+        "value": round(cut, 4),
+        "unit": "1 - exposed_wire_ms(overlap)/exposed_wire_ms(sync) "
+                "(%s seq%d x%d ranks, bucket %sMB, ICI-starved spec, "
+                "analyzer-priced, %s; gate >= 0.25)"
+                % (model_name, seq, nranks, bucket_cap, dev_name),
+        "exposed_ms_overlap": round(exposed_on, 4),
+        "exposed_ms_sync": round(exposed_off, 4),
+        "overlap_fraction": price_ov["overlap_fraction"],
+        "windows_applied": applied,
+        "proofs": "PASS" if proofs_ok else "FAIL",
+        "vs_baseline": round(cut, 3),
+    }), flush=True)
+    if cut < 0.25:
+        print("# FAIL: exposed wire cut %.3f < 0.25 gate" % cut,
+              flush=True)
+    if not proofs_ok:
+        print("# FAIL: overlap proofs did not pass (applied=%d, "
+              "races=%d, deadlock diags=%d)"
+              % (applied, len(race_diags), len(dl_diags)), flush=True)
+
+    # ---- arm 2: twin training through the real start/wait pair ------
+    if ndev < 2:
+        print("# overlap loss-delta arm skipped: needs >=2 devices "
+              "(driver passes --xla_force_host_platform_device_count)",
+              flush=True)
+        return
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from paddle_tpu.executor import (Scope, _run_ops_into_env,
+                                     global_scope, scope_guard)
+    from paddle_tpu.jax_compat import shard_map
+    from paddle_tpu.ops import registry as op_registry
+
+    steps = 6
+    feats, hidden = 16, 64
+    half = 8
+
+    def twin_losses(env):
+        def run():
+            fluid.unique_name.switch()
+            m, s = fluid.Program(), fluid.Program()
+            m.random_seed = s.random_seed = 77
+            with fluid.program_guard(m, s):
+                x = fluid.layers.data("x", shape=[feats],
+                                      dtype="float32")
+                y = fluid.layers.data("y", shape=[1], dtype="float32")
+                h = fluid.layers.fc(x, size=hidden, act="relu")
+                h2 = fluid.layers.fc(h, size=hidden, act="relu")
+                p = fluid.layers.fc(h2, size=1)
+                l = fluid.layers.reduce_mean(
+                    fluid.layers.square(p - y))
+                fluid.optimizer.SGD(learning_rate=1e-2).minimize(l)
+            GradAllReduce().transpile(program=m, startup_program=s,
+                                      rank=0, nranks=2)
+            m._num_trainers = 2
+            fused, _ = resolve_fused_program(m, targets=[l.name])
+            fblock = fused.global_block()
+            kinds = [op.type for op in fblock.ops
+                     if "allreduce" in op.type]
+            exe = fluid.Executor()
+            with scope_guard(Scope()):
+                exe.run(s)
+                params = {}
+                for v in m.list_vars():
+                    if not v.persistable:
+                        continue
+                    val = global_scope().get(v.name)
+                    if val is not None:
+                        params[v.name] = np.asarray(val)
+            pnames = sorted(params)
+            mesh = Mesh(np.array(jax.devices()[:2]), ("dp",))
+
+            def per_worker(pvals, xb, yb):
+                ctx = op_registry.LoweringContext(mode="train")
+                ctx.collective_axis = "dp"
+                envd = {n: v[0] for n, v in zip(pnames, pvals)}
+                envd["x"], envd["y"] = xb[0], yb[0]
+                _run_ops_into_env(fblock, envd, ctx)
+                return ([envd[n][None] for n in pnames],
+                        envd[l.name].reshape(1))
+
+            step_fn = jax.jit(shard_map(
+                per_worker, mesh=mesh,
+                in_specs=([P("dp")] * len(pnames), P("dp"), P("dp")),
+                out_specs=([P("dp")] * len(pnames), P("dp"))))
+            lrng = np.random.RandomState(4321)
+            vals = [np.tile(params[n][None], (2,) + (1,) * params[n].ndim)
+                    for n in pnames]
+            out = []
+            for _ in range(steps):
+                xb = lrng.randn(2, half, feats).astype("float32")
+                yb = (xb.mean(axis=2, keepdims=True)
+                      + 0.05 * lrng.randn(2, half, 1)).astype("float32")
+                vals, lv = step_fn([jnp.asarray(v) for v in vals],
+                                   jnp.asarray(xb), jnp.asarray(yb))
+                vals = [np.asarray(v) for v in vals]
+                out.append(float(np.mean(np.asarray(lv))))
+            return out, kinds
+        return with_env(env, run)
+
+    twin_env_on = dict(overlap_env,
+                       PADDLE_TPU_ALLREDUCE_BUCKET_MB="0.004")
+    twin_env_off = dict(sync_env,
+                        PADDLE_TPU_ALLREDUCE_BUCKET_MB="0.004")
+    ov_losses, ov_kinds = twin_losses(twin_env_on)
+    sync_losses, sync_kinds = twin_losses(twin_env_off)
+    if not any(k == "c_allreduce_start" for k in ov_kinds):
+        raise SystemExit("overlap arm vacuous: fusion emitted %r, no "
+                         "c_allreduce_start" % (ov_kinds,))
+    if any(k in ("c_allreduce_start", "c_allreduce_wait")
+           for k in sync_kinds):
+        raise SystemExit("sync arm contaminated: %r" % (sync_kinds,))
+    delta = max(abs(a - b) for a, b in zip(sync_losses, ov_losses))
+    bitmatch = sync_losses == ov_losses
+    print(json.dumps({
+        "metric": "overlap_collective_loss_delta",
+        "value": round(delta, 10),
+        "unit": "max |loss_overlap - loss_sync| over %d DP steps on a "
+                "2-worker mesh (%s vs %s, %s; gate == 0.0 bit-exact)"
+                % (steps, "/".join(sorted(set(ov_kinds))),
+                   "/".join(sorted(set(sync_kinds))), dev_name),
+        "sync_losses": [repr(x) for x in sync_losses],
+        "overlap_losses": [repr(x) for x in ov_losses],
+        "bit_identical": bool(bitmatch),
+        "vs_baseline": 1.0 if bitmatch else 0.0,
+    }), flush=True)
+    if not bitmatch:
+        print("# FAIL: overlap twin losses not bit-identical "
+              "(max delta %.3e)" % delta, flush=True)
+
+
 def jax_backend_name():
     import jax
 
@@ -2188,7 +2423,7 @@ def main():
                 ("fusion", 150), ("kernels", 220), ("planner", 220),
                 ("observability", 150), ("tracing", 150),
                 ("serving", 200), ("decode", 200), ("elastic", 240),
-                ("quant", 220)]
+                ("quant", 220), ("overlap", 220)]
         failed = []
         for mode, cap in plan:
             if remaining(cap) < 90:
@@ -2250,16 +2485,17 @@ def main():
               "hardware lines (if any)" % reason, flush=True)
         for mode in ("ctr", "bert", "fusion", "kernels", "planner",
                      "observability", "tracing", "serving", "decode",
-                     "elastic", "quant"):
+                     "elastic", "quant", "overlap"):
             env_extra = {"PADDLE_BENCH_FORCE_CPU": "1"}
-            if mode in ("planner", "quant"):
+            if mode in ("planner", "quant", "overlap"):
                 # the CPU smoke needs a virtual mesh for a real DP A/B
                 env_extra["XLA_FLAGS"] = (
                     os.environ.get("XLA_FLAGS", "")
                     + " --xla_force_host_platform_device_count=2")
             w_ok, w_lines, w_err = _run_child(
                 mode, remaining(420 if mode == "bert"
-                                else 240 if mode in ("elastic", "quant")
+                                else 240 if mode in ("elastic", "quant",
+                                                     "overlap")
                                 else 150),
                 env_extra=env_extra)
             if not w_ok:
@@ -2334,6 +2570,8 @@ if __name__ == "__main__":
             child_planner()
         elif mode == "quant":
             child_quant()
+        elif mode == "overlap":
+            child_overlap()
         elif mode == "serving":
             child_serving()
         elif mode == "decode":

@@ -104,6 +104,50 @@ def c_allreduce_quant(ctx, attrs, X):
     return {"Out": split_like(flat, X, cast=False)}
 
 
+@register_op("c_allreduce_start", inputs=["X*"], outputs=["Out*"],
+             no_grad=True)
+def c_allreduce_start(ctx, attrs, X):
+    """Async half of a bucketed allreduce (the overlap scheduler's split
+    of ``c_fused_allreduce_sum`` / ``c_allreduce_quant``): emits the
+    collective at the hoisted schedule position so XLA's async scheduler
+    can overlap the ring transfer with the compute between start and
+    wait.  The math is byte-identical to the fused synchronous op — the
+    pair differs only in WHERE the collective sits in the schedule, so
+    ``PADDLE_TPU_OVERLAP=0`` (which keeps the fused form) is bit-exact
+    by construction.  ``attrs["quant"]`` selects the int8 block-quantized
+    exchange (the ``c_allreduce_quant`` path); ``attrs["overlap_bucket"]``
+    links this op to its ``c_allreduce_wait`` twin."""
+    from .common import flatten_concat, split_like
+
+    ax = _axis(ctx)
+    if ax is None:
+        return {"Out": list(X)}
+    s = attrs.get("pre_scale")
+    flat = flatten_concat(X)
+    if s:
+        flat = flat * jnp.asarray(s, flat.dtype)
+    if attrs.get("quant"):
+        from ..quant.collective import quantized_allreduce
+
+        flat = quantized_allreduce(flat, ax,
+                                   block=attrs.get("quant_block") or None)
+    else:
+        flat = jax.lax.psum(flat, ax)
+    return {"Out": split_like(flat, X, cast=False)}
+
+
+@register_op("c_allreduce_wait", inputs=["X*"], outputs=["Out*"],
+             no_grad=True)
+def c_allreduce_wait(ctx, attrs, X):
+    """Consumer barrier of the start/wait pair: identity on the reduced
+    values, placed just before the first consumer so every use of a
+    bucket member data-depends on the collective having completed.  No
+    wire traffic of its own (the cost model prices it at zero ICI
+    bytes); it exists purely to pin the earliest legal consume point in
+    the schedule."""
+    return {"Out": list(X)}
+
+
 @register_op("c_broadcast", inputs=["X"], outputs=["Out"], no_grad=True)
 def c_broadcast(ctx, attrs, X):
     ax = _axis(ctx)

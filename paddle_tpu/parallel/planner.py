@@ -238,6 +238,13 @@ def apply_plan(program, result, startup_program=None, rank=0):
         # smaller (compute-bound) buckets keep the bf16 fused op
         program._quant_buckets = quant_bucket_mark(result.cluster,
                                                    cand.degree)
+    from ..static_analysis.overlap import overlap_enabled
+    if overlap_enabled():
+        # the axis was searched: realize the verdict either way — a
+        # winner priced WITHOUT overlap must not silently run with it
+        # (the mark wins over the env default in overlap_enabled()).
+        # Kill switch off → axis absent → no stamp, schedule untouched.
+        program._overlap = bool(getattr(cand, "overlap", False))
     return cand
 
 
@@ -245,11 +252,12 @@ class PlanCandidate:
     """One point of the placement/sharding search space."""
 
     __slots__ = ("kind", "degree", "stages", "dp_degree", "cuts",
-                 "bucket_mb", "zero1", "microbatches", "quant")
+                 "bucket_mb", "zero1", "microbatches", "quant",
+                 "overlap")
 
     def __init__(self, kind, degree, stages=1, dp_degree=1, cuts=(),
                  bucket_mb=None, zero1=False, microbatches=1,
-                 quant=False):
+                 quant=False, overlap=False):
         self.kind = kind            # single | dp | pipeline | moe | ulysses
         self.degree = int(degree)   # total chips the plan occupies
         self.stages = int(stages)
@@ -259,12 +267,17 @@ class PlanCandidate:
         self.zero1 = bool(zero1)
         self.microbatches = int(microbatches)
         self.quant = bool(quant)    # int8 block-quantized grad exchange
+        self.overlap = bool(overlap)  # start/wait split allreduce schedule
 
     def plan_key(self):
-        """Deterministic identity/tie-break key."""
+        """Deterministic identity/tie-break key.  ``overlap=False``
+        sorts first, so a tie (no wire actually hidden) resolves to the
+        synchronous schedule.  ``quant`` stays the LAST element — the
+        established ``plan_key()[:-1]`` idiom for "this plan modulo the
+        quant axis" keeps working."""
         return (self.kind, self.degree, self.stages, self.dp_degree,
                 self.bucket_mb if self.bucket_mb is not None else -1,
-                self.zero1, self.cuts, self.quant)
+                self.zero1, self.cuts, self.overlap, self.quant)
 
     def describe(self):
         if self.kind == "single":
@@ -275,6 +288,8 @@ class PlanCandidate:
                 s += " +zero1"
             if self.quant:
                 s += " +int8"
+            if self.overlap:
+                s += " +overlap"
             if self.bucket_mb:
                 s += " (allreduce bucket %dMB)" % self.bucket_mb
             return s
@@ -292,7 +307,8 @@ class PlanCandidate:
             "stages": self.stages, "dp_degree": self.dp_degree,
             "cuts": list(self.cuts), "bucket_mb": self.bucket_mb,
             "zero1": self.zero1, "microbatches": self.microbatches,
-            "quant": self.quant, "describe": self.describe(),
+            "quant": self.quant, "overlap": self.overlap,
+            "describe": self.describe(),
         }
 
     def __repr__(self):
@@ -414,6 +430,13 @@ class PlanResult:
             mark = quant_bucket_mark(self.cluster, c.degree)
             env["PADDLE_TPU_QUANT_MIN_BYTES"] = str(mark["min_bytes"])
             env["PADDLE_TPU_QUANT_BLOCK"] = str(mark["block"])
+        from ..static_analysis.overlap import overlap_enabled
+        if overlap_enabled():
+            # the overlap axis was searched: the env realizes the
+            # verdict either way (a plan priced synchronous must not
+            # silently run overlapped); kill switch off → key absent
+            env["PADDLE_TPU_OVERLAP"] = \
+                "1" if getattr(c, "overlap", False) else "0"
         return bs, env
 
     def __repr__(self):
@@ -665,16 +688,27 @@ def enumerate_candidates(program, cluster, base_interp=None,
     # (and their byte-stable to_json) are identical to the pre-quant
     # planner
     from ..quant.blockwise import quant_enabled
+    from ..static_analysis.overlap import overlap_enabled
 
     quant_axis = (False, True) if (trainable and quant_enabled()) \
         else (False,)
+    # start/wait collective overlap (ISSUE 16) is the third per-bucket
+    # dimension; it interacts with both others — a bigger bucket hides
+    # more wire under one window but defines later (smaller window),
+    # and quantization shrinks the wire a window must hide.  The
+    # PADDLE_TPU_OVERLAP=0 kill switch removes the axis entirely so
+    # plans stay byte-stable against the pre-overlap planner.
+    overlap_axis = (False, True) if (trainable and overlap_enabled()) \
+        else (False,)
     for bucket in buckets:
         for q in quant_axis:
-            cands.append(PlanCandidate("dp", chips, bucket_mb=bucket,
-                                       quant=q))
-            if trainable and has_opt_state:
+            for ov in overlap_axis:
                 cands.append(PlanCandidate("dp", chips, bucket_mb=bucket,
-                                           zero1=True, quant=q))
+                                           quant=q, overlap=ov))
+                if trainable and has_opt_state:
+                    cands.append(PlanCandidate(
+                        "dp", chips, bucket_mb=bucket,
+                        zero1=True, quant=q, overlap=ov))
 
     # pipeline splits over searched layer boundaries
     loads, boundaries = _forward_loads(program, base_interp, base_report)
@@ -937,6 +971,57 @@ def _quant_price_delta(report, nranks, bucket_mb):
     return delta, 3 * buckets
 
 
+def _overlap_windows(worker, cand, cluster, nranks, targets,
+                     batch_size=None):
+    """Overlap windows of the bucketed-fusion + start/wait rewrite this
+    candidate would actually run with, extracted from a throwaway
+    pricing clone carrying the candidate's bucket/quant/overlap marks
+    (NOT the worker's env) — exact windows, not a byte-delta model,
+    because the window's hideable wire depends on where liveness lets
+    the start hoist, which only the real rewrite knows.  Returns ()
+    when the rewrite yields no window (tiny program, proof revert, no
+    multi-member bucket): the candidate then prices identically to its
+    synchronous twin and loses the ``plan_key`` tie-break.
+
+    Only the allreduce bucketing family runs on the pricing clone: the
+    compute-side fusions (attention, elewise, …) preserve the window's
+    FLOPs and don't move collectives, so skipping their pattern
+    matching changes nothing the window model reads while cutting the
+    per-candidate rewrite cost ~2x (bert_base: the search stays inside
+    the determinism test's 30 s CPU budget)."""
+    from ..static_analysis.fusion import FusionConfig, apply_fusion_passes
+    from ..static_analysis.overlap import apply_overlap_pass
+    from ..static_analysis.verifier import set_pass_verification
+
+    # the clone is a throwaway meter, never executed or returned: the
+    # per-pass verify bracket (PADDLE_TPU_VERIFY_PASSES=1 in the test
+    # suite) would re-lint bert_base once per candidate for nothing
+    prev = set_pass_verification(False)
+    try:
+        clone = worker.clone()
+        clone._allreduce_bucket_mb = cand.bucket_mb
+        clone._overlap = True
+        if getattr(cand, "quant", False):
+            clone._quant_buckets = quant_bucket_mark(cluster,
+                                                     cand.degree)
+        tkey = tuple(targets or ())
+        cfg = FusionConfig(enabled=True, fuse_attention=False,
+                           fuse_elewise=False, fuse_softmax_xent=False,
+                           fuse_optimizer=False, fuse_conv_bn_act=False,
+                           fuse_embedding_gather=False)
+        apply_fusion_passes(clone, cfg, targets=tkey)
+        ov = apply_overlap_pass(clone, targets=tkey, nranks=nranks)
+        if not ov.applied:
+            return ()
+        report = estimate_cost(clone, nranks=nranks, targets=tkey,
+                               batch_size=batch_size)
+    except Exception:  # pricing must degrade, never crash the search
+        return ()
+    finally:
+        set_pass_verification(prev)
+    return tuple(report.overlap_windows)
+
+
 def quant_bucket_mark(cluster, nranks, dtype_nbytes=4):
     """The ``_quant_buckets`` program mark a quant-winning plan stamps:
     the break-even bucket size (bytes) where the int8 byte cut pays for
@@ -958,7 +1043,8 @@ def quant_bucket_mark(cluster, nranks, dtype_nbytes=4):
 
 
 def price_worker_set(workers, cluster, cand=None, targets=(),
-                     batch_size=None, shard_overrides=None):
+                     batch_size=None, shard_overrides=None,
+                     reports=None, _window_cache=None):
     """Price an emitted per-worker program set against ``cluster``;
     returns ``(reports, PlanPrice)``.  Also the entry point the tests
     use to price the HAND-written ``dist_model`` worker builders so
@@ -979,15 +1065,22 @@ def price_worker_set(workers, cluster, cand=None, targets=(),
     if stages is not None:
         m = max(1, microbatches)
         schedule_factor = (m + stages - 1) / float(m)
+    precomputed = reports
     reports = []
     prices = []
-    for w in workers:
+    for wi, w in enumerate(workers):
         nranks = int(getattr(w, "_num_trainers", 0) or 0) or len(workers)
-        interp = interpret_program(w, nranks=nranks,
-                                   batch_size=batch_size,
-                                   shard_overrides=shard_overrides)
-        report = estimate_cost(w, interp=interp, targets=targets,
-                               budget=budget)
+        if precomputed is not None:
+            # the caller already priced this exact worker (an overlap
+            # twin reuses its synchronous sibling's emission): the base
+            # report is identical by construction, skip the re-estimate
+            report = precomputed[wi]
+        else:
+            interp = interpret_program(w, nranks=nranks,
+                                       batch_size=batch_size,
+                                       shard_overrides=shard_overrides)
+            report = estimate_cost(w, interp=interp, targets=targets,
+                                   budget=budget)
         launches = None
         extra_ici = 0
         extra_launches = 0
@@ -1004,6 +1097,30 @@ def price_worker_set(workers, cluster, cand=None, targets=(),
                                             cand.bucket_mb)
                 extra_ici += qd
                 extra_launches += ql
+            if getattr(cand, "overlap", False):
+                # exact windows from the rewrite this candidate runs
+                # with, attached to the BASE report so the overlap twin
+                # differs from its synchronous sibling ONLY by hidden
+                # wire (price_plan's max(compute, wire) window model)
+                # plus one wait-barrier launch per window.  Cached per
+                # (kind, degree, bucket, quant) across the search:
+                # zero1 twins share the windows because ZeRO-1 only
+                # reshapes the optimizer tail, which sits AFTER every
+                # wait sink — the backward region the windows span is
+                # byte-identical
+                wkey = (cand.kind, cand.degree, cand.dp_degree,
+                        cand.bucket_mb,
+                        bool(getattr(cand, "quant", False)))
+                windows = None if _window_cache is None \
+                    else _window_cache.get(wkey)
+                if windows is None:
+                    windows = _overlap_windows(w, cand, cluster, nranks,
+                                               targets, batch_size)
+                    if _window_cache is not None:
+                        _window_cache[wkey] = windows
+                if windows:
+                    report.overlap_windows = list(windows)
+                    extra_launches += len(windows)
         reports.append(report)
         prices.append(price_plan(
             report,
@@ -1020,23 +1137,44 @@ def price_worker_set(workers, cluster, cand=None, targets=(),
     return reports, _combine_prices(prices)
 
 
+def _overlap_twin_key(cand):
+    """Candidate identity modulo the overlap axis — pairs each overlap
+    twin with the synchronous sibling whose emission/report it can
+    reuse."""
+    return (cand.kind, cand.degree, cand.stages, cand.dp_degree,
+            tuple(cand.cuts or ()), cand.bucket_mb, cand.zero1,
+            cand.microbatches, getattr(cand, "quant", False))
+
+
 def _price_candidate(program, startup_program, cand, cluster, targets,
-                     batch_size):
+                     batch_size, reuse=None, window_cache=None):
     """Emit (one rank for the symmetric kinds — every rank runs the
     identical program; all stages for pipeline) and exactly price one
-    candidate.  Returns ``(PricedCandidate, workers, startups)`` —
-    the emission is reused by the proof loop so no candidate is
-    cloned/transpiled twice."""
-    workers, startups = _emit(program, startup_program, cand, cluster,
-                              limit=1)
+    candidate.  Returns ``(PricedCandidate, workers, startups,
+    reports)`` — the emission is reused by the proof loop so no
+    candidate is cloned/transpiled twice.
+
+    ``reuse=(workers, startups, reports)`` skips both the emission and
+    the base cost estimate: an overlap twin's emitted worker and base
+    report are byte-identical to its synchronous sibling's (overlap is
+    a resolve-time rewrite, not an emission change), so only the
+    pricing deltas differ."""
+    if reuse is not None:
+        workers, startups, base_reports = reuse
+    else:
+        workers, startups = _emit(program, startup_program, cand,
+                                  cluster, limit=1)
+        base_reports = None
     overrides = None
     if cand.zero1:
         overrides = _optimizer_state_overrides(program, cand.degree)
-    _, price = price_worker_set(
+    reports, price = price_worker_set(
         workers, cluster, cand=cand, targets=targets,
-        batch_size=batch_size, shard_overrides=overrides)
+        batch_size=batch_size, shard_overrides=overrides,
+        reports=base_reports, _window_cache=window_cache)
     budget = hbm_budget(program) or cluster.hbm_bytes
-    return PricedCandidate(cand, price, budget), workers, startups
+    return (PricedCandidate(cand, price, budget), workers, startups,
+            reports)
 
 
 # ---------------------------------------------------------------------------
@@ -1113,10 +1251,18 @@ def auto_transpile(program, cluster_spec, startup_program=None,
 
     priced = []
     realized = {}
+    sync_twins = {}   # non-overlap (workers, startups, reports) by key
+    window_cache = {}
     for cand in cands:
-        pc, workers, startups = _price_candidate(
+        reuse = None
+        if getattr(cand, "overlap", False):
+            reuse = sync_twins.get(_overlap_twin_key(cand))
+        pc, workers, startups, reports = _price_candidate(
             program, startup_program, cand, cluster, targets,
-            batch_size)
+            batch_size, reuse=reuse, window_cache=window_cache)
+        if not getattr(cand, "overlap", False):
+            sync_twins[_overlap_twin_key(cand)] = (workers, startups,
+                                                   reports)
         realized[cand.plan_key()] = (workers, startups)
         priced.append(pc)
 

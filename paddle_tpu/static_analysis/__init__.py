@@ -40,6 +40,16 @@ free of host syncs (``sync-in-hot-loop``), surfaced through
 ``--concurrency`` / ``--certify-zero-sync`` flags, and enforcement
 gates in ``run_batches(verify=True)`` and the fusion/planner rewrite
 brackets.
+
+ISSUE 16 adds the overlap scheduler (:mod:`.overlap`): bucketed
+collectives split into ``c_allreduce_start`` / ``c_allreduce_wait``
+pairs scheduled by a liveness pass (start after the bucket's last def,
+wait before its first consumer), bracketed by the race and deadlock
+provers with per-bucket revert, priced by an overlap-aware window
+model in :mod:`.cost` (``exposed_wire_ms`` / ``overlap_fraction``),
+and surfaced through the planner's third axis, the
+``overlap-opportunity-unexploited`` advisory, and
+``analyze_program --overlap``.
 """
 
 from .diagnostics import Diagnostic, Severity, format_diagnostics
@@ -65,6 +75,7 @@ from .concurrency import (CONCURRENCY_CHECK_IDS, RACE_CHECK_IDS,
                           SyncPoint, ZeroSyncCertificate,
                           analyze_concurrency, assert_no_new_races,
                           certify_zero_sync, find_inflight_races,
+                          find_overlap_window_races,
                           prove_scope_isolation, race_signatures,
                           resolve_max_in_flight, scope_footprint,
                           strict_sync_enabled, verify_async_hot_path)
@@ -72,6 +83,8 @@ from .analyze import AnalysisReport, analyze_program
 from .fusion import (FusionConfig, FusionReport, apply_fusion_passes,
                      fusion_enabled, resolve_fused_program,
                      scan_fusible_patterns)
+from .overlap import (OverlapDecision, OverlapReport,
+                      apply_overlap_pass, overlap_enabled)
 
 __all__ = [
     "Diagnostic",
@@ -117,6 +130,7 @@ __all__ = [
     "assert_no_new_races",
     "certify_zero_sync",
     "find_inflight_races",
+    "find_overlap_window_races",
     "prove_scope_isolation",
     "race_signatures",
     "resolve_max_in_flight",
@@ -131,4 +145,8 @@ __all__ = [
     "fusion_enabled",
     "resolve_fused_program",
     "scan_fusible_patterns",
+    "OverlapDecision",
+    "OverlapReport",
+    "apply_overlap_pass",
+    "overlap_enabled",
 ]

@@ -98,6 +98,20 @@ quantized                             cost model prices as an int8
                                       bf16 (no plan mark / env
                                       threshold, kill switch, or
                                       uncalibrated autotune family)
+collective-start-without-   ERROR     c_allreduce_start with no
+wait                                  matching c_allreduce_wait after
+                                      it — the in-flight reduction is
+                                      never barriered
+wait-without-start          ERROR     c_allreduce_wait with no
+                                      c_allreduce_start before it —
+                                      barriers a reduction nobody
+                                      launched
+double-wait                 ERROR     duplicate c_allreduce_wait for
+                                      one overlap bucket
+overlap-opportunity-        INFO      bucketed collective kept fused
+unexploited                           synchronous despite a window of
+                                      dead compute (overlap disabled,
+                                      proof-reverted, or no-window)
 decode-shape-unbucketed     WARNING   while body concatenates a loop
                                       carry with per-step data and
                                       writes it back — operand shapes
@@ -1075,6 +1089,154 @@ def check_quantizable_bucket_not_quantized(ctx):
                    mark["min_bytes"], reason),
                 block_idx=0, op_idx=bucket[0][0],
                 var_names=(bucket[0][1],), hint=hint)
+
+
+def _overlap_pair_sites(block):
+    """Per-bucket start/wait op indices in one block, keyed by the
+    ``overlap_bucket`` attr that links a pair's twins."""
+    starts, waits = {}, {}
+    for i, op in enumerate(block.ops):
+        if op.type == "c_allreduce_start":
+            starts.setdefault(op.attrs.get("overlap_bucket"),
+                              []).append(i)
+        elif op.type == "c_allreduce_wait":
+            waits.setdefault(op.attrs.get("overlap_bucket"),
+                             []).append(i)
+    return starts, waits
+
+
+@register_check("collective-start-without-wait")
+def check_collective_start_without_wait(ctx):
+    """A ``c_allreduce_start`` with no matching ``c_allreduce_wait``
+    after it (same ``overlap_bucket``, same block): the in-flight
+    reduction has no consumer barrier, so nothing orders the optimizer
+    behind the ring — the step would read whatever the async transfer
+    happened to deliver.  Extends the collective ring-pairing battery
+    to the ISSUE-16 split-collective form."""
+    for block in ctx.program.blocks:
+        starts, waits = _overlap_pair_sites(block)
+        for b, sidxs in sorted(starts.items(),
+                               key=lambda kv: kv[1][0]):
+            avail = sorted(waits.get(b, []))
+            for s in sorted(sidxs):
+                w = next((x for x in avail if x > s), None)
+                if w is not None:
+                    avail.remove(w)
+                    continue
+                yield ctx.diag(
+                    "collective-start-without-wait", Severity.ERROR,
+                    "c_allreduce_start (overlap bucket %r) at block %d "
+                    "op %d has no c_allreduce_wait after it — the "
+                    "in-flight reduction is never barriered"
+                    % (b, block.idx, s),
+                    block_idx=block.idx, op_idx=s, op=block.ops[s],
+                    hint="the overlap pass emits the pair atomically; "
+                         "a hand edit dropped or reordered the wait")
+
+
+@register_check("wait-without-start")
+def check_wait_without_start(ctx):
+    """A ``c_allreduce_wait`` with no ``c_allreduce_start`` before it
+    (same ``overlap_bucket``, same block): the barrier guards a
+    transfer nobody launched, so the 'reduced' values it hands the
+    optimizer are the raw local gradients."""
+    for block in ctx.program.blocks:
+        starts, waits = _overlap_pair_sites(block)
+        for b, widxs in sorted(waits.items(),
+                               key=lambda kv: kv[1][0]):
+            sidxs = sorted(starts.get(b, []))
+            w = sorted(widxs)[0]
+            if not sidxs or sidxs[0] > w:
+                yield ctx.diag(
+                    "wait-without-start", Severity.ERROR,
+                    "c_allreduce_wait (overlap bucket %r) at block %d "
+                    "op %d has no c_allreduce_start before it — the "
+                    "barrier guards a reduction nobody launched"
+                    % (b, block.idx, w),
+                    block_idx=block.idx, op_idx=w, op=block.ops[w],
+                    hint="the overlap pass emits the pair atomically; "
+                         "a hand edit dropped or reordered the start")
+
+
+@register_check("double-wait")
+def check_double_wait(ctx):
+    """More than one ``c_allreduce_wait`` for the same
+    ``overlap_bucket`` in one block: the pass emits exactly one
+    consumer barrier per bucket — a duplicate re-consumes buffers the
+    first wait already settled (and under a real async runtime would
+    block on a rendezvous that never fires twice)."""
+    for block in ctx.program.blocks:
+        _, waits = _overlap_pair_sites(block)
+        for b, widxs in sorted(waits.items(),
+                               key=lambda kv: kv[1][0]):
+            for w in sorted(widxs)[1:]:
+                yield ctx.diag(
+                    "double-wait", Severity.ERROR,
+                    "duplicate c_allreduce_wait for overlap bucket %r "
+                    "at block %d op %d (first wait at op %d)"
+                    % (b, block.idx, w, sorted(widxs)[0]),
+                    block_idx=block.idx, op_idx=w, op=block.ops[w],
+                    hint="one wait per bucket: drop the duplicate")
+
+
+@register_check("overlap-opportunity-unexploited")
+def check_overlap_opportunity_unexploited(ctx):
+    """Advisory twin of the overlap scheduler (ISSUE 16): bucketed
+    collectives still in fused synchronous form even though the
+    liveness plan finds a window of dead compute to hide the wire
+    under — because ``PADDLE_TPU_OVERLAP=0`` disables the pass or a
+    proof reverted the bucket — plus the degenerate no-window buckets
+    (wait would immediately follow start).  Mirrors
+    ``fusible-pattern-not-fused``: INFO, with the pass's own reason."""
+    from .overlap import OVERLAPPABLE_OP_TYPES, _plan, overlap_enabled
+
+    block = ctx.program.global_block()
+    if not any(op.type in OVERLAPPABLE_OP_TYPES for op in block.ops):
+        return
+    enabled = overlap_enabled(ctx.program)
+    report = getattr(ctx.program, "_overlap_report", None)
+    by_vars = {frozenset(d.vars): d for d in report.decisions} \
+        if report is not None else {}
+    decisions, schedule = _plan(ctx.program, ctx.targets, {})
+    planned = {d.bucket for d, _, _, _, _ in schedule}
+    for dec in decisions:
+        coord = dec.fused_idx
+        if dec.status == "no-window":
+            yield ctx.diag(
+                "overlap-opportunity-unexploited", Severity.INFO,
+                "bucket of %d gradient(s) (ring %r, anchored at %r) "
+                "stays synchronous: %s"
+                % (len(dec.vars), dec.ring_id,
+                   dec.vars[0] if dec.vars else "?", dec.note),
+                block_idx=coord[0], op_idx=coord[1],
+                var_names=dec.vars[:1],
+                hint="a smaller allreduce bucket cap closes buckets "
+                     "earlier and opens a window")
+            continue
+        if dec.bucket not in planned or dec.window_ops <= 1:
+            continue
+        if not enabled:
+            reason = "disabled by PADDLE_TPU_OVERLAP=0"
+            hint = ("unset PADDLE_TPU_OVERLAP to let the pass hide "
+                    "the wire under %d ops of compute"
+                    % dec.window_ops)
+        else:
+            prior = by_vars.get(frozenset(dec.vars))
+            if prior is None or not prior.status.startswith(
+                    "reverted"):
+                continue  # pass will split it at the next resolve
+            reason = "%s — %s" % (prior.status, prior.note)
+            hint = ("fix the in-window hazard (or the ring asymmetry) "
+                    "and re-resolve")
+        yield ctx.diag(
+            "overlap-opportunity-unexploited", Severity.INFO,
+            "bucket of %d gradient(s) (ring %r, anchored at %r) has a "
+            "%d-op window of dead compute but runs synchronous: %s"
+            % (len(dec.vars), dec.ring_id,
+               dec.vars[0] if dec.vars else "?", dec.window_ops,
+               reason),
+            block_idx=coord[0], op_idx=coord[1],
+            var_names=dec.vars[:1], hint=hint)
 
 
 @register_check("manual-plan-suboptimal")

@@ -64,7 +64,8 @@ __all__ = [
     "ScopeFootprint", "scope_footprint", "prove_scope_isolation",
     "SyncPoint", "ZeroSyncCertificate", "certify_zero_sync",
     "ConcurrencyReport", "analyze_concurrency",
-    "find_inflight_races", "resolve_max_in_flight",
+    "find_inflight_races", "find_overlap_window_races",
+    "resolve_max_in_flight",
     "strict_sync_enabled", "race_signatures", "assert_no_new_races",
     "verify_async_hot_path",
 ]
@@ -268,13 +269,22 @@ def find_inflight_races(program, targets=(), max_in_flight=None,
       with the ``DeviceFeedPipeline`` prefetch thread's staging slot —
       the double-buffer feed overwrite).
 
-    K<=1 (sequential) proves every window empty: returns ``[]``.
+    * ``race-inflight-write`` (overlap window) — a write to a bucket
+      member between its ``c_allreduce_start`` and ``c_allreduce_wait``
+      (:func:`find_overlap_window_races`).  Unlike the cross-step
+      hazards this is K-INDEPENDENT: the ring transfer is in flight
+      within one step, so even sequential execution races.
+
+    K<=1 (sequential) proves every cross-step window empty: returns
+    only the overlap-window findings.
     """
     k = resolve_max_in_flight(program, explicit=max_in_flight)
+    # the overlap scheduler's start→wait windows race at ANY depth —
+    # checked before the sequential early-out on purpose
+    diags = find_overlap_window_races(program)
     if k <= 1:
-        return []
+        return diags
     graph = graph or DefUseGraph(program)
-    diags = []
 
     def _mk(check, message, site, var, hint):
         return Diagnostic(
@@ -339,6 +349,62 @@ def find_inflight_races(program, targets=(), max_in_flight=None,
                 op_id=op.attrs.get("__op_id__"), var_names=(name,),
                 hint="write results to a fresh var; feed slots belong "
                      "to the feed pipeline"))
+    return diags
+
+
+def find_overlap_window_races(program):
+    """The overlap scheduler's in-flight window scan: between a
+    ``c_allreduce_start`` and its ``c_allreduce_wait`` (paired by the
+    ``overlap_bucket`` attr) the ring transfer holds the bucket members
+    in flight — an op writing any member inside that window (output
+    slot or sub-block closure write) clobbers the buffer the collective
+    is still reducing.  ERROR per (window, writer, member).
+
+    K-independent by design: this is intra-step overlap, not the
+    cross-step pipelining :func:`find_inflight_races` models — the
+    overlap pass's proof bracket reverts the bucket on any finding."""
+    from .defuse import resolve_sub_block, sub_block_writes_recursive
+
+    diags = []
+    block = program.global_block()
+    open_windows = {}   # bucket -> (start idx, member set)
+    for idx, op in enumerate(block.ops):
+        if op.type == "c_allreduce_start":
+            b = op.attrs.get("overlap_bucket")
+            if b is not None:
+                open_windows[int(b)] = (
+                    idx, frozenset(op.outputs.get("Out", ())))
+            continue
+        if op.type != "c_allreduce_wait":
+            continue
+        b = op.attrs.get("overlap_bucket")
+        if b is None or int(b) not in open_windows:
+            continue
+        start_idx, members = open_windows.pop(int(b))
+        for j in range(start_idx + 1, idx):
+            other = block.ops[j]
+            written = members.intersection(other.output_arg_names)
+            sub = resolve_sub_block(program, other,
+                                    host_block_idx=block.idx)
+            if sub is not None:
+                written = written | (
+                    members
+                    & set(sub_block_writes_recursive(program, sub)))
+            for name in sorted(written):
+                diags.append(Diagnostic(
+                    "race-inflight-write", Severity.ERROR,
+                    "op %r writes bucket member %r inside the overlap "
+                    "window of bucket %d (start at op %d, wait at op "
+                    "%d) — the in-flight ring transfer is still "
+                    "reducing this buffer"
+                    % (other.type, name, int(b), start_idx, idx),
+                    block_idx=block.idx, op_idx=j, op_type=other.type,
+                    op_id=other.attrs.get("__op_id__"),
+                    var_names=(name,),
+                    hint="let the overlap pass place the start after "
+                         "the member's last def (it reverts the bucket "
+                         "to the fused synchronous form when it "
+                         "cannot), or write to a fresh var"))
     return diags
 
 

@@ -40,7 +40,8 @@ __all__ = [
     "sync_latency_ms", "calibration_factors", "COLLECTIVE_OP_TYPES",
     "P2P_OP_TYPES", "HOST_IO_OP_TYPES", "PlanPrice", "price_plan",
     "price_program", "plan_calibration_factor",
-    "PLANNER_CALIBRATION_FAMILY",
+    "PLANNER_CALIBRATION_FAMILY", "OverlapWindow",
+    "overlap_window_table",
 ]
 
 _DTYPE_BYTES = {
@@ -120,8 +121,12 @@ COLLECTIVE_OP_TYPES = frozenset((
     "c_allreduce_prod", "allreduce", "c_reduce_sum", "c_broadcast",
     "broadcast", "c_allgather", "c_reducescatter", "c_scatter",
     "all_to_all", "ppermute", "c_fused_allreduce_sum",
-    "c_allreduce_quant",
+    "c_allreduce_quant", "c_allreduce_start",
 ))
+# NOT c_allreduce_wait: the wait half of an overlap pair is a consumer
+# barrier with zero wire traffic — the start op already carried the
+# full ring volume, and counting the wait would double the ICI bytes
+# and fabricate a second rendezvous in the schedule prover
 P2P_OP_TYPES = frozenset(("send_v2", "recv_v2"))
 
 
@@ -363,12 +368,46 @@ class OpCost:
         }
 
 
+class OverlapWindow:
+    """One start→wait in-flight window of an overlap-scheduled bucket:
+    the op coords of the pair, the roofline inputs (FLOPs + HBM bytes)
+    of every op scheduled BETWEEN them, and the ring wire volume of the
+    collective itself.  :func:`price_plan` hides
+    ``min(window compute, wire)`` per window (arXiv 2110.10548's
+    compute-vs-wire window model)."""
+
+    __slots__ = ("bucket", "start", "wait", "window_flops",
+                 "window_bytes", "wire_bytes", "quant", "var_names")
+
+    def __init__(self, bucket, start, wait, window_flops, window_bytes,
+                 wire_bytes, quant=False, var_names=()):
+        self.bucket = int(bucket)
+        self.start = tuple(start)   # (block_idx, op_idx) of the start
+        self.wait = tuple(wait)     # (block_idx, op_idx) of the wait
+        self.window_flops = int(window_flops)
+        self.window_bytes = int(window_bytes)
+        self.wire_bytes = int(wire_bytes)
+        self.quant = bool(quant)
+        self.var_names = tuple(var_names)
+
+    def to_dict(self):
+        return {
+            "bucket": self.bucket,
+            "start": list(self.start), "wait": list(self.wait),
+            "window_flops": self.window_flops,
+            "window_bytes": self.window_bytes,
+            "wire_bytes": self.wire_bytes,
+            "quant": self.quant,
+            "var_names": list(self.var_names),
+        }
+
+
 class CostReport:
     """Whole-program totals + the per-op breakdown behind them."""
 
     def __init__(self, program, op_costs, peak_memory_bytes,
                  persistent_bytes, nranks, batch_size, budget=None,
-                 host_sync_points=0):
+                 host_sync_points=0, overlap_windows=()):
         self.program = program
         self.op_costs = op_costs
         self.peak_memory_bytes = int(peak_memory_bytes)
@@ -376,6 +415,9 @@ class CostReport:
         self.nranks = nranks
         self.batch_size = batch_size
         self.hbm_budget = budget
+        # start→wait windows the overlap scheduler opened (empty when
+        # the program carries no c_allreduce_start/wait pairs)
+        self.overlap_windows = list(overlap_windows)
         # per-step host sync points: host-IO ops the Executor runs
         # around the jitted step (save/load/print) + one for the fetch
         # materialization itself — each drains the async dispatch queue
@@ -432,6 +474,8 @@ class CostReport:
             "hbm_budget": self.hbm_budget,
             "nranks": self.nranks,
             "batch_size": self.batch_size,
+            "overlap_windows": [w.to_dict()
+                                for w in self.overlap_windows],
             "per_op": [c.to_dict() for c in self.op_costs],
         }
 
@@ -459,6 +503,20 @@ class CostReport:
             json.dumps({"metric": m, "value": v, "unit": u + unit_suffix})
             for m, v, u in rows
         ]
+        if self.overlap_windows:
+            # overlap-aware wire accounting (priced at the module's
+            # default cluster numbers; calibration divided out so the
+            # lines are byte-stable across autotune state)
+            price = price_plan(self, calibration=1.0)
+            lines.append(json.dumps({
+                "metric": "static_exposed_wire_ms",
+                "value": round(price.exposed_wire_ms, 6),
+                "unit": "ms/step est." + unit_suffix}))
+            lines.append(json.dumps({
+                "metric": "static_overlap_fraction",
+                "value": round(price.overlap_fraction, 6),
+                "unit": "fraction of wire hidden under %d windows"
+                        % len(self.overlap_windows) + unit_suffix}))
         factors = calibration_factors()
         if factors:
             # the autotune feedback loop: measured/predicted gain per
@@ -541,11 +599,16 @@ def estimate_cost(program, interp=None, targets=(), nranks=None,
         ring = None
         if op.type in COLLECTIVE_OP_TYPES or op.type in P2P_OP_TYPES:
             ring = op.attrs.get("ring_id")
-            if op.type == "c_fused_allreduce_sum":
+            if op.type == "c_fused_allreduce_sum" \
+                    or (op.type == "c_allreduce_start"
+                        and not op.attrs.get("quant")):
                 # bucketed allreduce: the coalesced buffer carries the
-                # SUM of the member payloads in one launch
+                # SUM of the member payloads in one launch (the async
+                # start half carries the same volume at its hoisted
+                # position; the wait half is a zero-byte barrier)
                 payload = sum(_val_bytes(v) for v in rec.ins)
-            elif op.type == "c_allreduce_quant":
+            elif op.type == "c_allreduce_quant" \
+                    or op.type == "c_allreduce_start":
                 # quantized bucket: the wire carries int8 elements plus
                 # the f32-per-block scale sidecar, not the member dtype
                 from ..quant.collective import quantized_wire_bytes
@@ -563,6 +626,33 @@ def estimate_cost(program, interp=None, targets=(), nranks=None,
         op_costs.append(OpCost(
             rec, _op_flops(op, rec.ins, rec.outs), bytes_read,
             bytes_written, ici, ring_id=ring))
+
+    # ---- overlap windows (start→wait pairs by overlap_bucket id) ----
+    windows = []
+    open_starts = {}
+    for i, c in enumerate(op_costs):
+        op = c.record.op
+        bucket = op.attrs.get("overlap_bucket")
+        if bucket is None:
+            continue
+        if op.type == "c_allreduce_start":
+            open_starts[int(bucket)] = i
+        elif op.type == "c_allreduce_wait" \
+                and int(bucket) in open_starts:
+            si = open_starts.pop(int(bucket))
+            inner = op_costs[si + 1:i]
+            start = op_costs[si]
+            windows.append(OverlapWindow(
+                bucket=int(bucket),
+                start=(start.record.block_idx, start.record.op_idx),
+                wait=(c.record.block_idx, c.record.op_idx),
+                window_flops=sum(x.flops for x in inner),
+                window_bytes=sum(x.bytes_read + x.bytes_written
+                                 for x in inner),
+                wire_bytes=start.ici_bytes,
+                quant=bool(start.record.op.attrs.get("quant")),
+                var_names=start.record.op.outputs.get("Out", ())))
+    windows.sort(key=lambda w: (w.start, w.bucket))
 
     # ---- liveness-based peak memory ----
     # interval per non-persistable var: [def index, last read index];
@@ -617,7 +707,8 @@ def estimate_cost(program, interp=None, targets=(), nranks=None,
 
     return CostReport(program, op_costs, peak, persistent_bytes,
                       nranks, interp.batch_size, budget=budget,
-                      host_sync_points=host_syncs)
+                      host_sync_points=host_syncs,
+                      overlap_windows=windows)
 
 
 # ---------------------------------------------------------------------------
@@ -662,8 +753,16 @@ class PlanPrice:
     * ``ici_ms``     — ICI bytes / link bandwidth;
     * ``launch_ms``  — per-collective launch overhead ×
       ``collective_launches`` (how bucketed allreduce wins);
-    * ``step_ms``    — (compute + ici + launch) × ``calibration``
-      (:func:`plan_calibration_factor`).
+    * ``exposed_wire_ms`` — the overlap-aware wire term: per start→wait
+      window the ring transfer hides under ``min(window compute,
+      wire)`` of the compute scheduled inside the window, and only the
+      remainder (plus all non-window collective traffic) stays on the
+      critical path.  With no overlap windows this equals ``ici_ms``
+      exactly — the additive model is the degenerate case;
+    * ``overlap_fraction`` — hidden wire / total wire (0.0 when nothing
+      overlaps);
+    * ``step_ms``    — (compute + exposed_wire + launch) ×
+      ``calibration`` (:func:`plan_calibration_factor`).
 
     Absolute numbers are estimates; the planner only needs the RANKING
     to be faithful, and the calibration factor keeps even the absolute
@@ -673,11 +772,13 @@ class PlanPrice:
     __slots__ = ("flops_ms", "hbm_ms", "compute_ms", "ici_ms",
                  "launch_ms", "step_ms", "ici_bytes",
                  "peak_memory_bytes", "collective_launches",
-                 "schedule_factor", "calibration")
+                 "schedule_factor", "calibration", "exposed_wire_ms",
+                 "overlap_fraction")
 
     def __init__(self, flops_ms, hbm_ms, compute_ms, ici_ms, launch_ms,
                  step_ms, ici_bytes, peak_memory_bytes,
-                 collective_launches, schedule_factor, calibration):
+                 collective_launches, schedule_factor, calibration,
+                 exposed_wire_ms=None, overlap_fraction=0.0):
         self.flops_ms = flops_ms
         self.hbm_ms = hbm_ms
         self.compute_ms = compute_ms
@@ -689,6 +790,9 @@ class PlanPrice:
         self.collective_launches = int(collective_launches)
         self.schedule_factor = schedule_factor
         self.calibration = calibration
+        self.exposed_wire_ms = (ici_ms if exposed_wire_ms is None
+                                else exposed_wire_ms)
+        self.overlap_fraction = overlap_fraction
 
     def to_dict(self, canonical=False):
         """``canonical=True`` divides the calibration factor back out
@@ -706,6 +810,8 @@ class PlanPrice:
             "compute_ms": round(self.compute_ms, 6),
             "ici_ms": round(self.ici_ms, 6),
             "launch_ms": round(self.launch_ms, 6),
+            "exposed_wire_ms": round(self.exposed_wire_ms, 6),
+            "overlap_fraction": round(self.overlap_fraction, 6),
             "ici_bytes": self.ici_bytes,
             "peak_memory_bytes": self.peak_memory_bytes,
             "collective_launches": self.collective_launches,
@@ -746,11 +852,27 @@ def price_plan(report, peak_tflops=100.0, hbm_gbps=1200.0,
     ici_bytes = report.total_ici_bytes + int(extra_ici_bytes)
     ici_ms = ici_bytes / (max(ici_gbps, 1e-9) * 1e6)
     launch_ms = collective_launches * launch_us / 1000.0
-    step_ms = (compute_ms + ici_ms + launch_ms) * calibration
+    # overlap-aware wire term: each start→wait window hides up to its
+    # own compute under the ring transfer (max(compute, wire) per
+    # window == compute + exposed remainder); everything outside a
+    # window — including extra_ici_bytes like the ZeRO-1 allgather —
+    # stays fully exposed.  No windows → exposed == ici_ms exactly.
+    hidden_ms = 0.0
+    for w in getattr(report, "overlap_windows", None) or ():
+        wire_ms = w.wire_bytes / (max(ici_gbps, 1e-9) * 1e6)
+        win_compute_ms = max(
+            w.window_flops / (max(peak_tflops, 1e-9) * 1e9),
+            w.window_bytes / (max(hbm_gbps, 1e-9) * 1e6))
+        hidden_ms += min(win_compute_ms, wire_ms)
+    exposed_wire_ms = max(ici_ms - hidden_ms, 0.0)
+    overlap_fraction = (hidden_ms / ici_ms) if ici_ms > 0 else 0.0
+    step_ms = (compute_ms + exposed_wire_ms + launch_ms) * calibration
     return PlanPrice(flops_ms, hbm_ms, compute_ms, ici_ms, launch_ms,
                      step_ms, ici_bytes,
                      report.peak_memory_bytes, collective_launches,
-                     schedule_factor, calibration)
+                     schedule_factor, calibration,
+                     exposed_wire_ms=exposed_wire_ms,
+                     overlap_fraction=overlap_fraction)
 
 
 def price_program(program, cluster=None, nranks=None, targets=(),
@@ -782,3 +904,38 @@ def price_program(program, cluster=None, nranks=None, targets=(),
         collective_launches=collective_launches,
         calibration=calibration)
     return report, price
+
+
+def overlap_window_table(report, peak_tflops=100.0, hbm_gbps=1200.0,
+                         ici_gbps=100.0):
+    """Per-window pricing rows for the overlap windows a
+    :class:`CostReport` carries — the ``analyze_program --overlap``
+    table and the bench gate both read these.  Each row: bucket id,
+    start/wait op coords, the window's roofline compute ms, the ring
+    wire ms, the exposed remainder, and a verdict (``hidden`` /
+    ``partial`` / ``exposed``)."""
+    rows = []
+    for w in report.overlap_windows:
+        wire_ms = w.wire_bytes / (max(ici_gbps, 1e-9) * 1e6)
+        compute_ms = max(
+            w.window_flops / (max(peak_tflops, 1e-9) * 1e9),
+            w.window_bytes / (max(hbm_gbps, 1e-9) * 1e6))
+        hidden = min(compute_ms, wire_ms)
+        exposed = wire_ms - hidden
+        if wire_ms <= 0 or exposed <= wire_ms * 1e-6:
+            verdict = "hidden"
+        elif hidden > 0:
+            verdict = "partial"
+        else:
+            verdict = "exposed"
+        rows.append({
+            "bucket": w.bucket,
+            "start": list(w.start), "wait": list(w.wait),
+            "vars": len(w.var_names),
+            "quant": w.quant,
+            "window_compute_ms": round(compute_ms, 6),
+            "wire_ms": round(wire_ms, 6),
+            "exposed_ms": round(exposed, 6),
+            "verdict": verdict,
+        })
+    return rows

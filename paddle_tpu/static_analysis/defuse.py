@@ -15,8 +15,8 @@ NOT descend into them a second time.
 """
 
 __all__ = ["VarSite", "DefUseGraph", "build_def_use",
-           "sub_block_reads_recursive", "resolve_sub_block",
-           "SUB_BLOCK_DESCENT_OPS"]
+           "sub_block_reads_recursive", "sub_block_writes_recursive",
+           "resolve_sub_block", "SUB_BLOCK_DESCENT_OPS"]
 
 # forward control-flow ops whose sub-block the walker descends into
 SUB_BLOCK_DESCENT_OPS = ("while", "conditional_block", "recurrent",
@@ -93,6 +93,31 @@ def sub_block_reads_recursive(program, sub_block, exclude=(), _visited=None):
                         reads.append(n)
         written.update(op.output_arg_names)
     return reads
+
+
+def sub_block_writes_recursive(program, sub_block, _visited=None):
+    """All names a sub-block writes, including writes of nested
+    sub-blocks — the closure-write twin of
+    :func:`sub_block_reads_recursive` (the overlap scheduler's liveness
+    pass needs the last DEF of a bucket member, and a while body
+    updating a grad is a def no output slot of the host op shows).
+    Same cycle guard: a malformed sub_block-attr cycle degrades to
+    partial writes instead of a RecursionError."""
+    if _visited is None:
+        _visited = set()
+    if sub_block.idx in _visited:
+        return set()
+    _visited.add(sub_block.idx)
+    writes = set()
+    for op in sub_block.ops:
+        writes.update(n for n in op.output_arg_names
+                      if n and n != EMPTY_VAR_NAME)
+        if op.type in SUB_BLOCK_DESCENT_OPS:
+            inner = resolve_sub_block(program, op)
+            if inner is not None:
+                writes |= sub_block_writes_recursive(program, inner,
+                                                     _visited)
+    return writes
 
 
 class DefUseGraph:

@@ -139,6 +139,27 @@ def extract_collective_schedule(program, worker=0, interp=None,
                 var=var, peer=op.attrs.get("peer"), order=rec.index)
             schedule.setdefault(ring, []).append(ev)
             continue
+        if op.type == "c_allreduce_start" and rec.ins:
+            # the async half of an overlap pair is the rendezvous (the
+            # wait half is a zero-byte local barrier and never appears
+            # here): one coalesced buffer, wire identity int8 when the
+            # start carries the quantized path.  Because the signature
+            # embeds the hoisted ORDER via the per-ring sequence, a
+            # worker pair whose overlap passes hoisted starts into
+            # different relative ring positions is flagged divergent —
+            # exactly the rank-asymmetry the overlap prover must reject
+            numel = sum(v.local_numel or 0 for v in rec.ins)
+            wire_dtype = "int8" if op.attrs.get("quant") \
+                else (payload.dtype if payload is not None else None)
+            var = "%s(+%d coalesced%s)" % (
+                rec.ins[0].name, len(rec.ins) - 1,
+                ", int8" if op.attrs.get("quant") else "")
+            ev = CollectiveEvent(
+                worker, ring, op.type, wire_dtype, numel,
+                rec.block_idx, rec.op_idx, op.type,
+                var=var, peer=op.attrs.get("peer"), order=rec.index)
+            schedule.setdefault(ring, []).append(ev)
+            continue
         if op.type == "c_fused_allreduce_sum" and rec.ins:
             # the bucketed allreduce moves ONE coalesced buffer: its
             # schedule signature is the summed member payload (identical

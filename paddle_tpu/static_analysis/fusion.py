@@ -120,7 +120,7 @@ _PROGRAM_MARKS = ("_num_trainers", "_trainer_id", "_host_tables",
                   "_hbm_budget", "_nan_guard", "_guard_loss_name",
                   "_pipeline_stage", "_guard_abort_after",
                   "_allreduce_bucket_mb", "_shard_optimizer_state",
-                  "_quant_buckets")
+                  "_quant_buckets", "_overlap")
 
 # per-var attrs execution semantics depend on; Program.clone() now
 # preserves these itself (framework.CLONE_VAR_MARKS) — this copy pass
@@ -294,14 +294,18 @@ class FusionConfig:
     def signature(self, program=None):
         """Hashable identity — part of the executor's jit cache key.
 
-        Pass the program whose rewrite is being keyed: the bucket cap
-        and quant threshold resolve mark → env → default, and the MARK
-        must win in the key too — ``allreduce_bucket_mb()`` bare would
-        record the env value for a program whose ``_allreduce_bucket_mb``
-        mark overrides it, so a plan re-stamp (same program version)
-        could hit a stale fused clone built for the old bucket size."""
+        Pass the program whose rewrite is being keyed: the bucket cap,
+        quant threshold, and overlap knob resolve mark → env → default,
+        and the MARK must win in the key too — ``allreduce_bucket_mb()``
+        bare would record the env value for a program whose
+        ``_allreduce_bucket_mb`` mark overrides it, so a plan re-stamp
+        (same program version) could hit a stale fused clone built for
+        the old bucket size.  Same for ``_overlap``: stamping the mark
+        after a resolve must miss the cached clone, or the executor
+        keeps running yesterday's schedule."""
         from ..quant.collective import quant_min_bytes as _qmb
         from ..quant.blockwise import quant_block as _qb
+        from .overlap import overlap_enabled as _ov
 
         return (self.enabled, self.fuse_attention, self.fuse_elewise,
                 self.fuse_softmax_xent, self.fuse_optimizer,
@@ -309,7 +313,7 @@ class FusionConfig:
                 self.fuse_embedding_gather, allreduce_bucket_mb(program),
                 optimizer_fuse_overhead_bytes(), _flash_min_t(),
                 conv_bn_min_bytes(), embed_fuse_min_bytes(),
-                _qmb(program), _qb(), _autotune_state())
+                _qmb(program), _qb(), _ov(program), _autotune_state())
 
     def __repr__(self):
         return "FusionConfig%r" % (self.signature(),)
@@ -1804,7 +1808,8 @@ def _run_family(view, find, report):
     return applied
 
 
-def apply_fusion_passes(program, config=None, targets=(), verify=None):
+def apply_fusion_passes(program, config=None, targets=(), verify=None,
+                        baseline=None):
     """Run the fusion pipeline over ``program`` IN PLACE; returns the
     :class:`FusionReport`.  Each family is bracketed by the verifier
     when pass verification is enabled (on in tests) so a bad rewrite is
@@ -1825,8 +1830,7 @@ def apply_fusion_passes(program, config=None, targets=(), verify=None):
         verify = pass_verification_enabled()
     view = _GlobalView(program, targets)
 
-    baseline = None
-    if verify:
+    if verify and baseline is None:
         baseline = _error_signatures(program, view.targets)
     for family, flag, find in _FAMILIES:
         if not getattr(config, flag):
@@ -1845,7 +1849,8 @@ def apply_fusion_passes(program, config=None, targets=(), verify=None):
 _BRACKET_EXCLUDE = ("fusible-pattern-not-fused", "unreferenced-op",
                     "resilience-finite-guard",
                     "executor-host-sync-in-loop", "sync-in-hot-loop",
-                    "quantizable-bucket-not-quantized")
+                    "quantizable-bucket-not-quantized",
+                    "overlap-opportunity-unexploited")
 
 
 # the in-flight depth the bracket's race checks assume: a fusion
@@ -1944,6 +1949,34 @@ def _register_passes():
 _register_passes()
 
 
+def _run_overlap_pass(clone, targets, baseline=None):
+    """Run the overlap scheduler on the resolved clone after the fusion
+    pipeline (it splits the bucketed collectives fusion just emitted),
+    bracketed by the verifier exactly like a fusion family.  Returns
+    whether any bucket was actually split — the resolve cache must keep
+    the clone even when no FUSION family fired, or the overlap-only
+    rewrite would be thrown away.
+
+    ``baseline`` is the pre-fusion error-signature set the fusion
+    pipeline already computed; reusing it keeps the bracket one verify
+    per resolve instead of two (each family that fired already asserted
+    it introduced nothing over the same baseline)."""
+    from .overlap import apply_overlap_pass, overlap_enabled
+
+    if not overlap_enabled(clone):
+        return False
+    from .verifier import pass_verification_enabled
+
+    verify = pass_verification_enabled()
+    if verify and baseline is None:
+        baseline = _error_signatures(clone, set(targets))
+    ov = apply_overlap_pass(clone, targets=targets)
+    if ov.applied and verify:
+        _assert_no_new_errors(clone, set(targets), baseline,
+                              "after overlap_schedule_pass")
+    return bool(ov.applied)
+
+
 # ---------------------------------------------------------------------------
 # executor entry: fused-clone resolution + caching
 # ---------------------------------------------------------------------------
@@ -1994,8 +2027,17 @@ def resolve_fused_program(program, config=None, targets=()):
             setattr(clone, mark, getattr(program, mark))
     _copy_var_marks(program, clone)
     clone._fusion_applied = True
-    report = apply_fusion_passes(clone, config, targets=tkey)
-    if not report.applied:
+    from .verifier import pass_verification_enabled
+
+    baseline = None
+    if pass_verification_enabled():
+        # one pre-rewrite verify shared by the fusion families AND the
+        # overlap pass bracket (each asserts against the same baseline)
+        baseline = _error_signatures(clone, set(tkey))
+    report = apply_fusion_passes(clone, config, targets=tkey,
+                                 baseline=baseline)
+    overlap_applied = _run_overlap_pass(clone, tkey, baseline=baseline)
+    if not report.applied and not overlap_applied:
         cache[key] = (None, report)
         return program, report
     clone._fusion_sig = config.signature(program)

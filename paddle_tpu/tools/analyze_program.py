@@ -93,6 +93,14 @@ def main(argv=None):
                              "ICI bytes, peak HBM, deadlock verdict, "
                              "chosen/rejected reason — without "
                              "executing anything")
+    parser.add_argument("--overlap", action="store_true",
+                        help="run the fusion + overlap-scheduler "
+                             "rewrite (ISSUE 16) and print the "
+                             "per-window table: bucket, start/wait op "
+                             "coords, window compute ms, wire ms, "
+                             "exposed ms, verdict — priced against "
+                             "the --plan ClusterSpec when given, else "
+                             "the generic default chip")
     add_emitter_args(parser)
     args = parser.parse_args(argv)
     if not args.model_dir and not args.program_json:
@@ -134,16 +142,55 @@ def main(argv=None):
         plan_result = auto_transpile(program, spec, targets=targets,
                                      batch_size=args.batch)
 
+    overlap_info = overlap_lines = None
+    if args.overlap:
+        from ..static_analysis.cost import (estimate_cost,
+                                            overlap_window_table)
+        from ..static_analysis.fusion import resolve_fused_program
+
+        resolved, _ = resolve_fused_program(program, targets=targets)
+        cost_r = estimate_cost(resolved, nranks=args.nranks,
+                               targets=targets, batch_size=args.batch,
+                               budget=budget)
+        price_kw = {}
+        if plan_result is not None:
+            c = plan_result.cluster
+            price_kw = {"peak_tflops": c.peak_tflops,
+                        "hbm_gbps": c.hbm_gbps,
+                        "ici_gbps": c.ici_gbps}
+        rows = overlap_window_table(cost_r, **price_kw)
+        ovr = getattr(resolved, "_overlap_report", None)
+        overlap_info = {"windows": rows,
+                        "report": ovr.to_dict() if ovr else None}
+        overlap_lines = ["overlap windows (%d):" % len(rows),
+                         "  %-6s %-10s %-10s %5s %5s %12s %10s %11s  %s"
+                         % ("bucket", "start", "wait", "vars", "quant",
+                            "compute ms", "wire ms", "exposed ms",
+                            "verdict")]
+        for r in rows:
+            overlap_lines.append(
+                "  %-6d %-10s %-10s %5d %5s %12.4f %10.4f %11.4f  %s"
+                % (r["bucket"], tuple(r["start"]), tuple(r["wait"]),
+                   r["vars"], "int8" if r["quant"] else "-",
+                   r["window_compute_ms"], r["wire_ms"],
+                   r["exposed_ms"], r["verdict"]))
+        if ovr is not None:
+            overlap_lines.append(ovr.format())
+
     if args.as_json:
         extra = {k: v for k, v in report.to_dict().items()
                  if k != "diagnostics"}
         if plan_result is not None:
             extra["plan"] = plan_result.to_dict()
+        if overlap_info is not None:
+            extra["overlap"] = overlap_info
         emit_diagnostics(report.diagnostics, True, extra_json=extra)
     else:
         print(report.format(top_ops=args.top))
         if plan_result is not None:
             print(plan_result.format_table())
+        if overlap_lines is not None:
+            print("\n".join(overlap_lines))
 
     if args.bench_json:
         with open(args.bench_json, "w") as f:

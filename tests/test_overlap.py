@@ -1,0 +1,535 @@
+"""Overlap scheduler (ISSUE 16): hoist/sink goldens on the example
+builders, proof-gated revert negatives (in-flight write, asymmetric
+ring), the PADDLE_TPU_OVERLAP=0 kill-switch schedule identity, the
+FusionConfig.signature overlap-knob fold, quant-bucket pairs, the
+planner's three-axis pricing, the new pairing lint checks, and a
+prog_gen property sweep (every rewritten schedule re-proves or the
+bucket reverts)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.framework import Operator
+from paddle_tpu.static_analysis import (FusionConfig,
+                                        apply_overlap_pass,
+                                        check_schedule_consistency,
+                                        extract_collective_schedule,
+                                        find_overlap_window_races,
+                                        overlap_enabled,
+                                        verify_program)
+from paddle_tpu.static_analysis import fusion, overlap
+from paddle_tpu.static_analysis.cost import (estimate_cost,
+                                             overlap_window_table,
+                                             price_plan)
+from paddle_tpu.transpiler.collective import GradAllReduce
+
+from test_fusion import build_bert_tiny, build_mnist_mlp, op_types
+
+# mnist grads are a few KB: this cap splits them into multi-member
+# buckets that close before the optimizer, opening a real window
+BUCKET_SMALL = ("PADDLE_TPU_ALLREDUCE_BUCKET_MB", "0.004")
+
+
+def transpiled_mnist(nranks=2):
+    main, startup, loss, acc, pred = build_mnist_mlp()
+    GradAllReduce().transpile(program=main, startup_program=startup,
+                              rank=0, nranks=nranks)
+    main._num_trainers = nranks
+    return main, startup, loss
+
+
+def transpiled_bert(nranks=2):
+    main, startup, feeds, loss, cfg = build_bert_tiny()
+    GradAllReduce().transpile(program=main, startup_program=startup,
+                              rank=0, nranks=nranks)
+    main._num_trainers = nranks
+    return main, startup, loss
+
+
+def fused_clone(program, targets):
+    """The synchronous-fusion-only rewrite (what resolve produced
+    before ISSUE 16): clone + fusion passes, overlap pass not run."""
+    clone = program.clone()
+    fusion.apply_fusion_passes(clone, FusionConfig(),
+                               targets=tuple(targets))
+    return clone
+
+
+def pair_sites(program):
+    block = program.global_block()
+    starts = [(i, op) for i, op in enumerate(block.ops)
+              if op.type == "c_allreduce_start"]
+    waits = [(i, op) for i, op in enumerate(block.ops)
+             if op.type == "c_allreduce_wait"]
+    return starts, waits
+
+
+class TestHoistSink:
+    def test_mnist_hoist_sink_golden(self, monkeypatch):
+        monkeypatch.setenv(*BUCKET_SMALL)
+        monkeypatch.delenv("PADDLE_TPU_OVERLAP", raising=False)
+        main, startup, loss = transpiled_mnist()
+        resolved, _ = fusion.resolve_fused_program(
+            main, targets=[loss.name])
+        report = resolved._overlap_report
+        assert len(report.applied) == 1
+        (dec,) = report.applied
+        starts, waits = pair_sites(resolved)
+        assert len(starts) == 1 and len(waits) == 1
+        (si, start_op), (wi, wait_op) = starts[0], waits[0]
+        # the decision's final coordinates are the real op indices
+        assert dec.start_idx == (0, si)
+        assert dec.wait_idx == (0, wi)
+        assert dec.window_ops == wi - si - 1 >= 1
+        members = set(start_op.inputs["X"])
+        assert members == set(dec.vars)
+        block = resolved.global_block()
+        # hoist golden: the op right before the start defines (or
+        # reads) a member — the start sits at the earliest legal point
+        prev = block.ops[si - 1]
+        assert members & (set(prev.output_arg_names)
+                          | set(prev.input_arg_names))
+        # sink golden: the op right after the wait is the first
+        # consumer of a member (the optimizer reads the reduced grad)
+        nxt = block.ops[wi + 1]
+        assert members & set(nxt.input_arg_names)
+        # nothing in the window touches a member
+        for j in range(si + 1, wi):
+            op = block.ops[j]
+            assert not members & set(op.output_arg_names)
+            assert not members & set(op.input_arg_names)
+
+    def test_bert_multi_bucket(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_ALLREDUCE_BUCKET_MB", "0.2")
+        monkeypatch.delenv("PADDLE_TPU_OVERLAP", raising=False)
+        main, startup, loss = transpiled_bert()
+        resolved, _ = fusion.resolve_fused_program(
+            main, targets=[loss.name])
+        report = resolved._overlap_report
+        assert len(report.applied) >= 2
+        starts, waits = pair_sites(resolved)
+        assert len(starts) == len(waits) == len(report.applied)
+        # twins pair 1:1 by overlap_bucket, start strictly before wait
+        for dec in report.applied:
+            s = [i for i, op in starts
+                 if op.attrs["overlap_bucket"] == dec.bucket]
+            w = [i for i, op in waits
+                 if op.attrs["overlap_bucket"] == dec.bucket]
+            assert len(s) == 1 and len(w) == 1 and s[0] < w[0]
+        # the rewritten program is still a valid program (pairing
+        # checks included) with no new ERRORs
+        diags = verify_program(resolved, targets=[loss.name])
+        assert not [d for d in diags if d.severity.name == "ERROR"]
+
+    def test_overlap_windows_priced(self, monkeypatch):
+        monkeypatch.setenv(*BUCKET_SMALL)
+        monkeypatch.delenv("PADDLE_TPU_OVERLAP", raising=False)
+        main, startup, loss = transpiled_mnist()
+        resolved, _ = fusion.resolve_fused_program(
+            main, targets=[loss.name])
+        rep = estimate_cost(resolved, nranks=2, targets=[loss.name])
+        assert len(rep.overlap_windows) == 1
+        (w,) = rep.overlap_windows
+        assert w.wire_bytes > 0 and w.window_flops >= 0
+        price = price_plan(rep, ici_gbps=0.001)
+        assert price.exposed_wire_ms < price.ici_ms
+        assert 0.0 < price.overlap_fraction <= 1.0
+        rows = overlap_window_table(rep, ici_gbps=0.001)
+        assert len(rows) == 1
+        assert rows[0]["verdict"] in ("hidden", "partial")
+        # bench_json carries the static overlap numbers
+        bench = rep.bench_json()
+        assert "static_exposed_wire_ms" in bench
+        assert "static_overlap_fraction" in bench
+
+    def test_price_plan_degenerates_without_windows(self, monkeypatch):
+        monkeypatch.setenv(*BUCKET_SMALL)
+        monkeypatch.setenv("PADDLE_TPU_OVERLAP", "0")
+        main, startup, loss = transpiled_mnist()
+        resolved, _ = fusion.resolve_fused_program(
+            main, targets=[loss.name])
+        rep = estimate_cost(resolved, nranks=2, targets=[loss.name])
+        assert rep.overlap_windows == []
+        price = price_plan(rep, ici_gbps=0.001)
+        # no windows: exposed wire IS the wire, fraction 0 — the old
+        # additive formula exactly
+        assert price.exposed_wire_ms == price.ici_ms
+        assert price.overlap_fraction == 0.0
+        assert "static_exposed_wire_ms" not in rep.bench_json()
+
+
+class TestProofsAndRevert:
+    def test_inflight_write_reverts(self, monkeypatch):
+        """A start misplaced above a member's last def puts that def
+        INSIDE the window — the race prover must reject and the pass
+        must revert the bucket to its fused synchronous form."""
+        monkeypatch.setenv(*BUCKET_SMALL)
+        monkeypatch.delenv("PADDLE_TPU_OVERLAP", raising=False)
+        main, startup, loss = transpiled_mnist()
+        clone = fused_clone(main, [loss.name])
+        monkeypatch.setattr(overlap, "_start_position",
+                            lambda program, block, members, fi: 0)
+        report = apply_overlap_pass(clone, targets=(loss.name,),
+                                    nranks=2)
+        assert not report.applied
+        assert any(d.status == "reverted-race" for d in report.decisions)
+        # reverted means the fused op is back and no pair ops remain
+        types = op_types(clone)
+        assert "c_fused_allreduce_sum" in types
+        assert "c_allreduce_start" not in types
+        assert "c_allreduce_wait" not in types
+
+    def test_race_prover_flags_window_write(self, monkeypatch):
+        monkeypatch.setenv(*BUCKET_SMALL)
+        monkeypatch.delenv("PADDLE_TPU_OVERLAP", raising=False)
+        main, startup, loss = transpiled_mnist()
+        resolved, _ = fusion.resolve_fused_program(
+            main, targets=[loss.name])
+        assert find_overlap_window_races(resolved) == []
+        block = resolved.global_block()
+        (si, start_op), _ = pair_sites(resolved)[0][0], None
+        g = start_op.inputs["X"][0]
+        block.ops.insert(si + 1, Operator(
+            block, "scale", {"X": [g]}, {"Out": [g]}, {"scale": 2.0}))
+        resolved._bump_version()
+        diags = find_overlap_window_races(resolved)
+        assert len(diags) == 1
+        assert diags[0].check == "race-inflight-write"
+        assert diags[0].severity.name == "ERROR"
+        assert g in diags[0].var_names
+
+    def test_asymmetric_ring_rejected(self, monkeypatch):
+        """Two workers starting the same ring's buckets in different
+        orders is the classic collective deadlock — the prover must
+        reject the hand-built asymmetric schedule."""
+        monkeypatch.setenv("PADDLE_TPU_ALLREDUCE_BUCKET_MB", "0.002")
+        monkeypatch.delenv("PADDLE_TPU_OVERLAP", raising=False)
+        main, startup, loss = transpiled_mnist()
+        resolved, _ = fusion.resolve_fused_program(
+            main, targets=[loss.name])
+        s0 = extract_collective_schedule(resolved, worker=0, nranks=2)
+        assert any(e.op_type == "c_allreduce_start"
+                   for e in s0.get(0, ()))
+        assert len(s0[0]) >= 2
+        assert check_schedule_consistency([s0, s0]) == []
+        # worker 1 launches ring 0's first two collectives (the hoisted
+        # start among them) in the opposite order — asymmetric ring
+        s1 = {r: list(evs) for r, evs in s0.items()}
+        s1[0][0], s1[0][1] = s1[0][1], s1[0][0]
+        diags = check_schedule_consistency([s0, s1])
+        assert any(d.severity.name == "ERROR" for d in diags)
+
+    def test_rewritten_schedule_proves_deadlock_free(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_ALLREDUCE_BUCKET_MB", "0.2")
+        monkeypatch.delenv("PADDLE_TPU_OVERLAP", raising=False)
+        main, startup, loss = transpiled_bert()
+        resolved, _ = fusion.resolve_fused_program(
+            main, targets=[loss.name])
+        assert resolved._overlap_report.applied
+        s0 = extract_collective_schedule(resolved, worker=0, nranks=2)
+        assert check_schedule_consistency([s0, s0]) == []
+
+    def test_prog_gen_property_sweep(self, monkeypatch):
+        """Random programs: the overlap resolve either applies with
+        both proofs clean or reverts — never ships an unproven
+        schedule, never crashes."""
+        from prog_gen import gen_program
+
+        monkeypatch.setenv(*BUCKET_SMALL)
+        monkeypatch.delenv("PADDLE_TPU_OVERLAP", raising=False)
+        for seed in range(8):
+            main, startup, fetches = gen_program(seed, train=True)
+            GradAllReduce().transpile(program=main,
+                                      startup_program=startup,
+                                      rank=0, nranks=2)
+            main._num_trainers = 2
+            resolved, _ = fusion.resolve_fused_program(
+                main, targets=list(fetches))
+            assert find_overlap_window_races(resolved) == []
+            report = getattr(resolved, "_overlap_report", None)
+            if report is not None and report.applied:
+                s0 = extract_collective_schedule(resolved, worker=0,
+                                                 nranks=2)
+                assert check_schedule_consistency([s0, s0]) == []
+
+
+class TestKillSwitchAndSignature:
+    def test_kill_switch_restores_schedule_bit_exactly(self,
+                                                       monkeypatch):
+        monkeypatch.setenv(*BUCKET_SMALL)
+        monkeypatch.setenv("PADDLE_TPU_OVERLAP", "0")
+        main, startup, loss = transpiled_mnist()
+        resolved, _ = fusion.resolve_fused_program(
+            main, targets=[loss.name])
+        baseline = fused_clone(main, [loss.name])
+
+        def sig(program):
+            return [(op.type, sorted(op.inputs.items()),
+                     sorted(op.outputs.items()))
+                    for op in program.global_block().ops]
+
+        assert sig(resolved) == sig(baseline)
+        assert "c_allreduce_start" not in op_types(resolved)
+
+    def test_overlap_enabled_precedence(self, monkeypatch):
+        main, _, _ = transpiled_mnist()
+        # default: on
+        monkeypatch.delenv("PADDLE_TPU_OVERLAP", raising=False)
+        assert overlap_enabled() and overlap_enabled(main)
+        # env beats default
+        monkeypatch.setenv("PADDLE_TPU_OVERLAP", "0")
+        assert not overlap_enabled(main)
+        # mark beats env, in BOTH directions
+        main._overlap = True
+        assert overlap_enabled(main)
+        monkeypatch.setenv("PADDLE_TPU_OVERLAP", "1")
+        main._overlap = False
+        assert not overlap_enabled(main)
+        assert overlap_enabled()  # no mark -> env still wins
+
+    def test_signature_folds_overlap_knob(self, monkeypatch):
+        """The PR-15 bucket-cap lesson, replayed for overlap: the
+        resolved-clone cache and the jit cache key both derive from
+        FusionConfig.signature, so the knob MUST be in it — stamping
+        ``_overlap`` after a resolve must invalidate the cached
+        clone."""
+        monkeypatch.setenv(*BUCKET_SMALL)
+        monkeypatch.delenv("PADDLE_TPU_OVERLAP", raising=False)
+        cfg = FusionConfig()
+        main, startup, loss = transpiled_mnist()
+        s_default = cfg.signature(main)
+        monkeypatch.setenv("PADDLE_TPU_OVERLAP", "0")
+        assert cfg.signature(main) != s_default
+        monkeypatch.delenv("PADDLE_TPU_OVERLAP", raising=False)
+
+        resolved, _ = fusion.resolve_fused_program(
+            main, targets=[loss.name])
+        assert "c_allreduce_start" in op_types(resolved)
+        # stamp the mark AFTER the resolve: the next resolve must miss
+        # the cached overlapped clone and return the fused form
+        main._overlap = False
+        assert cfg.signature(main) != s_default
+        resolved2, _ = fusion.resolve_fused_program(
+            main, targets=[loss.name])
+        types2 = op_types(resolved2)
+        assert "c_allreduce_start" not in types2
+        assert "c_fused_allreduce_sum" in types2
+
+
+class TestQuantInteraction:
+    def test_quant_bucket_splits_into_quant_start(self, monkeypatch):
+        monkeypatch.setenv(*BUCKET_SMALL)
+        monkeypatch.setenv("PADDLE_TPU_QUANT", "1")
+        monkeypatch.setenv("PADDLE_TPU_QUANT_MIN_BYTES", "1")
+        monkeypatch.delenv("PADDLE_TPU_OVERLAP", raising=False)
+        main, startup, loss = transpiled_mnist()
+        resolved, _ = fusion.resolve_fused_program(
+            main, targets=[loss.name])
+        starts, waits = pair_sites(resolved)
+        assert starts and waits
+        quant_starts = [op for _, op in starts
+                        if op.attrs.get("quant")]
+        assert quant_starts, "quant bucket should split into a " \
+                             "quant-carrying start"
+        report = resolved._overlap_report
+        assert any(d.quant and d.status == "applied"
+                   for d in report.decisions)
+        # the quantized window's wire bytes use the int8+sidecar model
+        rep = estimate_cost(resolved, nranks=2, targets=[loss.name])
+        qw = [w for w in rep.overlap_windows if w.quant]
+        assert qw and all(w.wire_bytes > 0 for w in qw)
+
+
+class TestPlannerThirdAxis:
+    SPEC = {"chips": 4, "peak_tflops": 0.05, "ici_gbps": 0.2,
+            "launch_us": 1.0}
+
+    def test_axis_enumerated_and_prices_lower(self, monkeypatch):
+        from paddle_tpu.parallel.planner import (ClusterSpec,
+                                                 auto_transpile)
+
+        monkeypatch.setenv("PADDLE_TPU_PLAN_BUCKETS_MB", "1")
+        monkeypatch.delenv("PADDLE_TPU_OVERLAP", raising=False)
+        main, startup, feeds, loss, cfg = build_bert_tiny()
+        res = auto_transpile(main, ClusterSpec(**self.SPEC),
+                             startup_program=startup,
+                             targets=[loss.name])
+        dp = {(c.candidate.zero1, c.candidate.quant,
+               c.candidate.overlap): c
+              for c in res.candidates if c.candidate.kind == "dp"}
+        # three axes: overlap twin exists for every (zero1, quant) combo
+        for (z, q, ov) in list(dp):
+            assert (z, q, not ov) in dp
+        sync = dp[(False, False, False)].price
+        over = dp[(False, False, True)].price
+        assert over.exposed_wire_ms < sync.exposed_wire_ms
+        assert over.step_ms < sync.step_ms
+        assert over.overlap_fraction > 0.0
+        # to_dict carries the axis; describe names it
+        c = dp[(False, False, True)].candidate
+        assert c.to_dict()["overlap"] is True
+        assert "+overlap" in c.describe()
+
+    def test_kill_switch_removes_axis(self, monkeypatch):
+        from paddle_tpu.parallel.planner import (ClusterSpec,
+                                                 auto_transpile)
+
+        monkeypatch.setenv("PADDLE_TPU_OVERLAP", "0")
+        main, startup, loss = transpiled_mnist(nranks=1)
+        res = auto_transpile(main, ClusterSpec(**self.SPEC),
+                             targets=[loss.name])
+        assert not any(getattr(c.candidate, "overlap", False)
+                       for c in res.candidates)
+
+    def test_apply_plan_stamps_mark_and_runtime_config(self,
+                                                       monkeypatch):
+        from paddle_tpu.parallel.planner import (ClusterSpec,
+                                                 auto_transpile,
+                                                 apply_plan,
+                                                 select_dp_standin)
+
+        monkeypatch.setenv("PADDLE_TPU_PLAN_BUCKETS_MB", "1")
+        monkeypatch.delenv("PADDLE_TPU_OVERLAP", raising=False)
+        main, startup, feeds, loss, cfg = build_bert_tiny()
+        res = auto_transpile(main, ClusterSpec(**self.SPEC),
+                             startup_program=startup,
+                             targets=[loss.name])
+        applied = apply_plan(main, res, startup_program=startup)
+        # axis searched -> verdict realized on the program either way
+        assert main._overlap == applied.overlap
+        dp_pc = select_dp_standin(res)
+        bs, env = res.runtime_config()
+        assert env["PADDLE_TPU_OVERLAP"] in ("0", "1")
+        expected = "1" if getattr(res.plan.candidate, "overlap",
+                                  False) else "0"
+        assert env["PADDLE_TPU_OVERLAP"] == expected
+
+
+class TestPairingLintChecks:
+    def _rewritten(self, monkeypatch):
+        monkeypatch.setenv(*BUCKET_SMALL)
+        monkeypatch.delenv("PADDLE_TPU_OVERLAP", raising=False)
+        main, startup, loss = transpiled_mnist()
+        resolved, _ = fusion.resolve_fused_program(
+            main, targets=[loss.name])
+        return resolved, loss
+
+    @staticmethod
+    def _checks(diags):
+        return [d.check for d in diags
+                if d.check in ("collective-start-without-wait",
+                               "wait-without-start", "double-wait")]
+
+    def test_clean_pair_is_silent(self, monkeypatch):
+        resolved, loss = self._rewritten(monkeypatch)
+        assert self._checks(
+            verify_program(resolved, targets=[loss.name])) == []
+
+    def test_start_without_wait(self, monkeypatch):
+        resolved, loss = self._rewritten(monkeypatch)
+        block = resolved.global_block()
+        wi = next(i for i, op in enumerate(block.ops)
+                  if op.type == "c_allreduce_wait")
+        del block.ops[wi]
+        resolved._bump_version()
+        assert self._checks(
+            verify_program(resolved, targets=[loss.name])) \
+            == ["collective-start-without-wait"]
+
+    def test_wait_without_start(self, monkeypatch):
+        resolved, loss = self._rewritten(monkeypatch)
+        block = resolved.global_block()
+        si = next(i for i, op in enumerate(block.ops)
+                  if op.type == "c_allreduce_start")
+        del block.ops[si]
+        resolved._bump_version()
+        assert self._checks(
+            verify_program(resolved, targets=[loss.name])) \
+            == ["wait-without-start"]
+
+    def test_double_wait(self, monkeypatch):
+        resolved, loss = self._rewritten(monkeypatch)
+        block = resolved.global_block()
+        wi = next(i for i, op in enumerate(block.ops)
+                  if op.type == "c_allreduce_wait")
+        block.ops.insert(wi + 1, block.ops[wi])
+        resolved._bump_version()
+        assert self._checks(
+            verify_program(resolved, targets=[loss.name])) \
+            == ["double-wait"]
+
+    def test_advisory_on_kill_switch(self, monkeypatch):
+        monkeypatch.setenv(*BUCKET_SMALL)
+        monkeypatch.setenv("PADDLE_TPU_OVERLAP", "0")
+        main, startup, loss = transpiled_mnist()
+        resolved, _ = fusion.resolve_fused_program(
+            main, targets=[loss.name])
+        diags = [d for d in verify_program(resolved,
+                                           targets=[loss.name])
+                 if d.check == "overlap-opportunity-unexploited"]
+        assert diags
+        assert all(d.severity.name == "INFO" for d in diags)
+        assert any("PADDLE_TPU_OVERLAP=0" in d.message for d in diags)
+
+
+class TestExecutionParity:
+    def test_single_device_losses_identical(self, monkeypatch):
+        """GSPMD path: collectives are identity, so overlap on/off must
+        produce bit-identical training (the pair really is a pure
+        schedule change)."""
+        from test_fusion import mlp_feed, run_steps
+
+        monkeypatch.setenv(*BUCKET_SMALL)
+
+        feed = mlp_feed(np.random.RandomState(7))
+
+        def losses(ov):
+            monkeypatch.setenv("PADDLE_TPU_OVERLAP", ov)
+            main, startup, loss = transpiled_mnist(nranks=1)
+            out, _ = run_steps(main, startup, feed, [loss.name],
+                               steps=3)
+            return out
+
+        np.testing.assert_array_equal(losses("1"), losses("0"))
+
+
+class TestAnalyzeCLI:
+    def test_overlap_flag_json(self, tmp_path, monkeypatch, capsys):
+        from paddle_tpu.proto import save_program
+        from paddle_tpu.tools import analyze_program as cli
+
+        monkeypatch.setenv(*BUCKET_SMALL)
+        monkeypatch.delenv("PADDLE_TPU_OVERLAP", raising=False)
+        main, startup, loss = transpiled_mnist()
+        path = str(tmp_path / "prog.json")
+        save_program(main, path)
+        rc = cli.main(["--program-json", path, "--overlap",
+                       "--nranks", "2", "--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        ov = out["overlap"]
+        assert ov["windows"] and ov["report"]["enabled"]
+        row = ov["windows"][0]
+        for key in ("bucket", "start", "wait", "window_compute_ms",
+                    "wire_ms", "exposed_ms", "verdict"):
+            assert key in row
+
+    def test_overlap_flag_table(self, tmp_path, monkeypatch, capsys):
+        from paddle_tpu.proto import save_program
+        from paddle_tpu.tools import analyze_program as cli
+
+        monkeypatch.setenv(*BUCKET_SMALL)
+        monkeypatch.delenv("PADDLE_TPU_OVERLAP", raising=False)
+        main, startup, loss = transpiled_mnist()
+        path = str(tmp_path / "prog.json")
+        save_program(main, path)
+        rc = cli.main(["--program-json", path, "--overlap",
+                       "--nranks", "2"])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "overlap windows" in out
+        assert "verdict" in out and "exposed ms" in out

@@ -225,6 +225,11 @@ def build_steps():
     # bert_base_quant_loss_delta (gate <= 1e-3) and calibrates the
     # autotune 'quant' family against the measured error
     item("bench_quant", "quant", 420, 360)
+    # ISSUE-16 overlap-scheduler A/B on the real ICI: synchronous vs
+    # start/wait split gradient ring on BERT_BASE; emits
+    # bert_overlap_exposed_wire_cut (gate >= 0.25, proofs must PASS)
+    # and overlap_collective_loss_delta (gate == 0.0, bit-exact)
+    item("bench_overlap", "overlap", 420, 360)
     # space-to-depth stem (models/resnet.py _s2d_stem): folds the 7x7
     # stride-2 3-channel stem — the classic MXU-underfill — into a
     # dense 4x4/s1 conv over 12 channels (the TPU ResNet stem recipe)
