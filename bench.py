@@ -1319,6 +1319,111 @@ def child_elastic():
     shutil.rmtree(workdir, ignore_errors=True)
 
 
+def child_autoscale():
+    """Elastic scale-up + autoscaler gate (ISSUE 17): run the chaos
+    rejoin drill — 3 workers, kill one mid-run, relaunch it with
+    ``--join`` — and report ``elastic_rejoin_ms``, the wall time from
+    the join request to the rejoined worker's first step at the grown
+    world.  The chaos driver enforces the hard part (rc=0 only when the
+    fleet grows back to the full world, every digest agrees, and the
+    whole shrink->grow incident chain reads causally in ONE trace);
+    this child additionally gates the journaled join events and the
+    SLO policy's decision triple (overload -> grow, idle -> shrink,
+    in-band -> no-op) so an autoscaler regression fails the bench even
+    when the drill itself survives.  vs_baseline compares against the
+    same 60s full-job-restart budget the recovery drill uses — a warm
+    rejoin must beat tearing the fleet down and rescheduling."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.observability.journal import read_journal
+    from paddle_tpu.resilience.autoscale import (GROW, NOOP, SHRINK,
+                                                 SLOPolicy)
+    from paddle_tpu.tools import chaos
+
+    if os.environ.get("PADDLE_BENCH_COMPILE_ONLY"):
+        # the drill's workers compile their own programs in
+        # subprocesses against the shared persistent cache; there is no
+        # separate driver-side executable to pre-seed, so the compile
+        # phase is a no-op marker
+        print(json.dumps({"compiled": True}), flush=True)
+        sys.exit(0)
+
+    workdir = tempfile.mkdtemp(prefix="paddle_tpu_autoscale_bench_")
+    print("# rejoin drill: 3 workers, kill one mid-run, relaunch with "
+          "--join — fleet must admit, warm up and grow back to 3",
+          flush=True)
+    try:
+        rc = chaos.main(["--elastic", "--rejoin", "--ckpt-dir", workdir])
+    except SystemExit as e:  # argparse or driver bail-out
+        rc = int(e.code or 0)
+
+    telemetry = os.path.join(workdir, "telemetry")
+    events = read_journal(telemetry) if os.path.isdir(telemetry) else []
+    kinds = [e.get("kind") for e in events]
+    rejoins = [e for e in events if e.get("kind") == "resume"
+               and e.get("rejoin_ms") is not None]
+
+    errors = []
+    if rc != 0:
+        errors.append("chaos --elastic --rejoin drill failed (rc=%s) — "
+                      "the killed worker must rejoin through the "
+                      "admission protocol and the fleet must grow back "
+                      "to the full world" % rc)
+    for k in ("join-request", "admitted", "warmup", "resume"):
+        if k not in kinds:
+            errors.append("journal is missing the %r join event" % k)
+    if not rejoins:
+        errors.append("no journaled resume event carries rejoin_ms")
+
+    rejoin_ms = (max(float(e["rejoin_ms"]) for e in rejoins)
+                 if rejoins else 0.0)
+    restart_budget_ms = 60000.0
+    print(json.dumps({
+        "metric": "elastic_rejoin_ms",
+        "value": round(rejoin_ms, 2),
+        "unit": "ms join-request -> first step at grown world "
+                "(2->3 workers, warm-up admission, %d rejoin events)"
+                % len(rejoins),
+        "vs_baseline": round(restart_budget_ms / max(rejoin_ms, 1e-3),
+                             2),
+    }), flush=True)
+
+    # The pure decision gate: the policy that drives the control loop
+    # must map the three canonical statuses to the three verdicts.
+    policy = SLOPolicy(min_world=1, max_world=8, p99_step_ms=100.0,
+                       p99_latency_ms=250.0, shed_rate=0.0,
+                       hysteresis=0.2, cooldown_s=0.0)
+    triple = (
+        ({"p99_step_ms": 400.0, "p99_serving_latency_ms": 900.0,
+          "serving_shed_rate": 0.3}, GROW),
+        ({"p99_step_ms": 10.0, "p99_serving_latency_ms": 20.0,
+          "serving_shed_rate": 0.0, "serving_queue_depth": 0}, SHRINK),
+        ({"p99_step_ms": 110.0}, NOOP),
+    )
+    verdicts = [(policy.decide(status, world=2).action, want)
+                for status, want in triple]
+    correct = all(got == want for got, want in verdicts)
+    print(json.dumps({
+        "metric": "autoscale_decision_correct",
+        "value": 1.0 if correct else 0.0,
+        "unit": "SLO policy triple: overload->grow idle->shrink "
+                "in-band->no-op (got %s)"
+                % ", ".join(got for got, _ in verdicts),
+        "vs_baseline": 1.0 if correct else 0.0,
+    }), flush=True)
+    if not correct:
+        errors.append("SLO policy decision triple mismatch: %s"
+                      % ["%s (want %s)" % v for v in verdicts])
+
+    if errors:
+        for e in errors:
+            print("# AUTOSCALE GATE FAILED: %s" % e, file=sys.stderr,
+                  flush=True)
+        raise SystemExit(1)
+    shutil.rmtree(workdir, ignore_errors=True)
+
+
 def child_lint():
     """Static-analysis CI arm (ISSUE 10): run the whole-program
     analyzer with the concurrency battery (max_in_flight=2) over every
@@ -2423,7 +2528,7 @@ def main():
                 ("fusion", 150), ("kernels", 220), ("planner", 220),
                 ("observability", 150), ("tracing", 150),
                 ("serving", 200), ("decode", 200), ("elastic", 240),
-                ("quant", 220), ("overlap", 220)]
+                ("quant", 220), ("overlap", 220), ("autoscale", 300)]
         failed = []
         for mode, cap in plan:
             if remaining(cap) < 90:
@@ -2485,7 +2590,7 @@ def main():
               "hardware lines (if any)" % reason, flush=True)
         for mode in ("ctr", "bert", "fusion", "kernels", "planner",
                      "observability", "tracing", "serving", "decode",
-                     "elastic", "quant", "overlap"):
+                     "elastic", "quant", "overlap", "autoscale"):
             env_extra = {"PADDLE_BENCH_FORCE_CPU": "1"}
             if mode in ("planner", "quant", "overlap"):
                 # the CPU smoke needs a virtual mesh for a real DP A/B
@@ -2494,6 +2599,7 @@ def main():
                     + " --xla_force_host_platform_device_count=2")
             w_ok, w_lines, w_err = _run_child(
                 mode, remaining(420 if mode == "bert"
+                                else 300 if mode == "autoscale"
                                 else 240 if mode in ("elastic", "quant",
                                                      "overlap")
                                 else 150),
@@ -2578,6 +2684,8 @@ if __name__ == "__main__":
             child_decode()
         elif mode == "elastic":
             child_elastic()
+        elif mode == "autoscale":
+            child_autoscale()
         elif mode == "lint":
             child_lint()
         else:

@@ -40,6 +40,7 @@ URGENT_KINDS = frozenset([
     "fault-injected", "guard-skip", "checkpoint-saved",
     "checkpoint-loaded", "worker-lost", "resume", "race-detected",
     "replan", "reshard", "dispatcher-died",
+    "join-request", "admitted", "warmup", "autoscale",
 ])
 
 _DEFAULT_CAPACITY = 4096

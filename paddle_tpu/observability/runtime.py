@@ -31,7 +31,10 @@ __all__ = [
     "record_checkpoint_save", "record_checkpoint_load", "record_retry",
     "record_fault", "record_worker_lost", "record_missed_beat",
     "record_concurrency_check", "record_replan", "record_reshard",
-    "record_elastic_recovery", "record_dispatcher_died",
+    "record_elastic_recovery", "record_join_request",
+    "record_join_admitted", "record_warmup", "record_rejoin",
+    "set_elastic_state", "record_autoscale_decision",
+    "record_decode_resize", "record_dispatcher_died",
     "set_collective_schedule", "collective_step_shape",
     "last_step_info", "reset_runtime",
 ]
@@ -441,6 +444,93 @@ def record_elastic_recovery(epoch, step, new_world, recovery_ms):
     _m.gauge("elastic_world_size").set(new_world)
     _journal.emit("resume", epoch=epoch, step=step, world=new_world,
                   recovery_ms=round(recovery_ms, 2), trace=_trace_id())
+
+
+def record_join_request(rank, epoch):
+    """A returning/new worker posted its write-once join request and is
+    heartbeating for admission (resilience.elastic scale-up)."""
+    if not telemetry_enabled():
+        return
+    _named(_m.counter, "elastic_join_requests_total").inc()
+    _journal.emit("join-request", rank=int(rank), epoch=int(epoch),
+                  trace=_trace_id())
+
+
+def record_join_admitted(epoch, joiners, writer=None):
+    """The epoch writer admitted pending joiners into the next epoch's
+    warm-up round."""
+    if not telemetry_enabled():
+        return
+    _named(_m.counter, "elastic_admissions_total").inc()
+    _journal.emit("admitted", epoch=int(epoch),
+                  joiners=[int(r) for r in joiners],
+                  writer=writer, trace=_trace_id())
+
+
+def record_warmup(rank, epoch, warmup_ms):
+    """An admitted joiner finished compiling + dry-running its worker
+    program and acked ready — the fleet stepped at the old epoch the
+    whole time."""
+    if not telemetry_enabled():
+        return
+    _named(_m.histogram, "elastic_warmup_ms").observe(warmup_ms)
+    _journal.emit("warmup", rank=int(rank), epoch=int(epoch),
+                  warmup_ms=round(warmup_ms, 2), trace=_trace_id())
+
+
+def record_rejoin(epoch, step, new_world, rejoin_ms):
+    """A joiner completed its first full-world step: join-request →
+    admitted → warm-up → replan/reshard → stepping, measured end to
+    end."""
+    if not telemetry_enabled():
+        return
+    _named(_m.counter, "elastic_rejoins_total").inc()
+    _named(_m.histogram, "elastic_rejoin_ms").observe(rejoin_ms)
+    _m.gauge("elastic_world_size").set(new_world)
+    _journal.emit("resume", epoch=epoch, step=step, world=new_world,
+                  rejoin_ms=round(rejoin_ms, 2), trace=_trace_id())
+
+
+def set_elastic_state(epoch, world, pending=None):
+    """Current membership as gauges (monitor surfaces these):
+    membership epoch, world size, and — when known — the number of
+    joiners pending admission/warm-up."""
+    if not telemetry_enabled():
+        return
+    _m.gauge("membership_epoch").set(int(epoch))
+    _m.gauge("elastic_world_size").set(int(world))
+    if pending is not None:
+        _m.gauge("elastic_pending_joins").set(int(pending))
+
+
+def record_autoscale_decision(action, reason, world=None,
+                              target_world=None, evidence=None):
+    """One autoscaler control-loop verdict, journaled with the evidence
+    it was decided on (resilience.autoscale)."""
+    if not telemetry_enabled():
+        return
+    _m.counter("autoscale_decisions_total", action=str(action)).inc()
+    _journal.emit("autoscale", action=str(action),
+                  reason=str(reason)[:300], world=world,
+                  target_world=target_world,
+                  evidence=dict(evidence or {}), trace=_trace_id())
+
+
+def record_decode_resize(tenant, old_slots, new_slots):
+    """A DecodeEngine drained and rebuilt its KV-cache slots at a new
+    count (autoscaler serving surface)."""
+    if not telemetry_enabled():
+        return
+    _named(_m.counter, "decode_resizes_total").inc()
+    _m.gauge("decode_slots", tenant=str(tenant)).set(int(new_slots))
+    _journal.emit("autoscale", action="resize-slots",
+                  reason="decode tenant %s: %d -> %d slots"
+                         % (tenant, old_slots, new_slots),
+                  world=None, target_world=None,
+                  evidence={"tenant": str(tenant),
+                            "old_slots": int(old_slots),
+                            "new_slots": int(new_slots)},
+                  trace=_trace_id())
 
 
 def record_dispatcher_died(reason, failed_requests, trace=None):

@@ -23,7 +23,15 @@ env-driven trainer restarts — reproduced TPU-native and made testable:
 * :mod:`~paddle_tpu.resilience.elastic` — the ISSUE-12 recovery loop:
   on worker loss, survivors agree on a shrunk membership, re-plan and
   re-prove the schedule, reshard the checkpoint, and resume in-process
-  (no restart, no lost hardware);
+  (no restart, no lost hardware) — plus the ISSUE-17 upward half: a
+  returning worker posts a write-once join request, warms up (compile
+  + dry-run) behind the stepping fleet, and enters at an agreed
+  ``start_step`` after a N→N+1 reshard;
+* :mod:`~paddle_tpu.resilience.autoscale` — the SLO-driven control
+  loop (:class:`~paddle_tpu.resilience.autoscale.SLOPolicy` /
+  :class:`~paddle_tpu.resilience.autoscale.Autoscaler`) deciding
+  grow/shrink/replan/no-op from monitor-collected signals and
+  journaling every decision with its evidence;
 * :mod:`~paddle_tpu.resilience.reshard` — checkpoint topology
   remapping: re-slice row-sharded optimizer/embedding state from an
   old world size to a new one, bit-exactly.
@@ -52,10 +60,13 @@ from .checkpoint import (CheckpointInfo, CorruptCheckpointError,
                          verify_checkpoint, read_topology)
 from . import elastic
 from . import reshard
+from . import autoscale
 from .elastic import (ELASTIC_EVICTED_EXIT_CODE, ElasticError,
                       ElasticEvictedError, ElasticTrainer, Membership,
-                      agree_membership, reduce_gradients)
+                      agree_membership, reduce_gradients,
+                      request_join, pending_joins, gc_epoch_files)
 from .reshard import reshard_checkpoint, shard_bounds
+from .autoscale import Autoscaler, Decision, SLOPolicy
 
 __all__ = [
     "faults",
@@ -65,6 +76,7 @@ __all__ = [
     "checkpoint",
     "elastic",
     "reshard",
+    "autoscale",
     "FaultInjected",
     "TransientFault",
     "FaultInjector",
@@ -99,6 +111,12 @@ __all__ = [
     "Membership",
     "agree_membership",
     "reduce_gradients",
+    "request_join",
+    "pending_joins",
+    "gc_epoch_files",
     "reshard_checkpoint",
     "shard_bounds",
+    "Autoscaler",
+    "Decision",
+    "SLOPolicy",
 ]

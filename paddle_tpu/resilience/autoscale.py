@@ -1,0 +1,368 @@
+"""SLO-driven autoscaling: a control loop over the elastic membership
+machinery and the serving decode tenants.
+
+The loop is deliberately split into a *pure* decision function and a
+thin actuator so every verdict is testable without a fleet:
+
+- :class:`SLOPolicy` holds the declarative targets (p99 step latency,
+  p99 serving latency, queue occupancy, shed rate, drift) plus the
+  stability knobs — hysteresis band, idle low-watermark, cooldown, and
+  min/max world/slot clamps.  ``decide()`` maps one monitor-collected
+  status dict to a :class:`Decision` (grow / shrink / replan / no-op)
+  with the evidence it was decided on.
+- :class:`Autoscaler` runs collect → decide → journal → execute.  Every
+  decision — including no-ops — is journaled (kind ``autoscale``) with
+  its evidence and counted in ``autoscale_decisions_total{action=}``.
+  Execution is delegated: growing launches a joiner through the
+  caller's ``launch_worker`` (the new worker then runs the
+  :mod:`.elastic` join protocol: join-request → admit → warm-up →
+  ``member-<epoch+1>``), shrinking releases one through
+  ``release_worker`` (a released worker exits and the fleet's
+  scale-down path shrinks the membership), and serving capacity scales
+  in place via ``DecodeEngine.resize``.
+
+Hysteresis contract: a signal must exceed ``target * (1 + hysteresis)``
+before the loop grows, and *every* monitored signal must sit below
+``target * low_watermark`` (with an empty queue and zero shed) before
+it shrinks — a value merely above target is in-band and yields a no-op,
+so the fleet never flaps across the target line.
+
+``PADDLE_TPU_AUTOSCALE=0`` is the master kill switch: the loop still
+reports what it *would* observe but decides ``no-op`` and never
+actuates, and constructing a trainer without any :class:`SLOPolicy`
+leaves the scale-down-only behavior untouched.
+"""
+
+import collections
+import os
+import threading
+import time
+
+__all__ = [
+    "GROW", "SHRINK", "REPLAN", "NOOP",
+    "Decision", "SLOPolicy", "Autoscaler", "autoscale_enabled",
+]
+
+GROW = "grow"
+SHRINK = "shrink"
+REPLAN = "replan"
+NOOP = "no-op"
+
+Decision = collections.namedtuple(
+    "Decision", ["action", "reason", "world", "target_world",
+                 "slots", "target_slots", "evidence"])
+
+
+def autoscale_enabled():
+    """Master kill switch: ``PADDLE_TPU_AUTOSCALE=0`` forces every
+    decision to no-op and disables actuation."""
+    return os.environ.get("PADDLE_TPU_AUTOSCALE", "1") \
+        .strip().lower() not in ("0", "false", "off")
+
+
+class SLOPolicy:
+    """Declarative SLO targets with the stability knobs that keep an
+    autoscaler from flapping.
+
+    A ``None`` target removes that signal from consideration.  Signals
+    are read from a flat monitor-style status dict: ``p99_step_ms``,
+    ``p99_serving_latency_ms``, ``serving_queue_depth``,
+    ``serving_shed_rate``, and ``drift`` (worst per-var ratio).
+    """
+
+    def __init__(self, min_world=1, max_world=8, p99_step_ms=None,
+                 p99_latency_ms=None, queue_depth=None, shed_rate=0.0,
+                 drift_ratio=None, hysteresis=0.2, low_watermark=0.5,
+                 cooldown_s=60.0, grow_step=1, shrink_step=1,
+                 min_slots=1, max_slots=8):
+        if int(min_world) < 1 or int(max_world) < int(min_world):
+            raise ValueError(
+                "world bounds must satisfy 1 <= min_world <= max_world,"
+                " got [%s, %s]" % (min_world, max_world))
+        if int(min_slots) < 1 or int(max_slots) < int(min_slots):
+            raise ValueError(
+                "slot bounds must satisfy 1 <= min_slots <= max_slots,"
+                " got [%s, %s]" % (min_slots, max_slots))
+        if float(hysteresis) < 0:
+            raise ValueError("hysteresis must be >= 0")
+        if not 0.0 < float(low_watermark) < 1.0:
+            raise ValueError("low_watermark must be in (0, 1)")
+        self.min_world = int(min_world)
+        self.max_world = int(max_world)
+        self.p99_step_ms = p99_step_ms
+        self.p99_latency_ms = p99_latency_ms
+        self.queue_depth = queue_depth
+        self.shed_rate = shed_rate
+        self.drift_ratio = drift_ratio
+        self.hysteresis = float(hysteresis)
+        self.low_watermark = float(low_watermark)
+        self.cooldown_s = float(cooldown_s)
+        self.grow_step = max(int(grow_step), 1)
+        self.shrink_step = max(int(shrink_step), 1)
+        self.min_slots = int(min_slots)
+        self.max_slots = int(max_slots)
+
+    def _targets(self):
+        return (("p99_step_ms", self.p99_step_ms),
+                ("p99_serving_latency_ms", self.p99_latency_ms),
+                ("serving_queue_depth", self.queue_depth))
+
+    def decide(self, status, world, now=None, last_action_ts=None,
+               slots=None):
+        """Map one status observation to a :class:`Decision`.
+
+        Pure: no clocks beyond the passed ``now``, no I/O — the bench
+        decision gate and the tests drive it with synthetic statuses.
+        """
+        now = time.time() if now is None else now
+        status = status or {}
+        world = int(world)
+        evidence = {}
+        breaches = []
+        below_watermark = []
+        observed = 0
+        for field, target in self._targets():
+            if target is None:
+                continue
+            value = status.get(field)
+            if value is None:
+                continue
+            value = float(value)
+            observed += 1
+            evidence[field] = value
+            if value > float(target) * (1.0 + self.hysteresis):
+                breaches.append("%s=%.4g > %.4g (target %.4g +%d%%)"
+                                % (field, value,
+                                   float(target) * (1 + self.hysteresis),
+                                   float(target),
+                                   round(self.hysteresis * 100)))
+            elif value <= float(target) * self.low_watermark:
+                below_watermark.append(field)
+        shed = status.get("serving_shed_rate")
+        if self.shed_rate is not None and shed is not None:
+            shed = float(shed)
+            evidence["serving_shed_rate"] = shed
+            if shed > float(self.shed_rate):
+                breaches.append("serving_shed_rate=%.4g > %.4g"
+                                % (shed, float(self.shed_rate)))
+        drift = status.get("drift")
+        if isinstance(drift, dict):
+            drift = max([v for v in drift.values()
+                         if isinstance(v, (int, float))] or [None])
+        if self.drift_ratio is not None and drift is not None:
+            drift = float(drift)
+            evidence["drift"] = drift
+
+        def _decision(action, reason, target_world=None,
+                      target_slots=None):
+            return Decision(action=action, reason=reason, world=world,
+                            target_world=target_world
+                            if target_world is not None else world,
+                            slots=slots, target_slots=target_slots
+                            if target_slots is not None else slots,
+                            evidence=dict(evidence))
+
+        if self.drift_ratio is not None and drift is not None \
+                and drift > float(self.drift_ratio):
+            return _decision(
+                REPLAN, "drift %.4g exceeds ratio %.4g: the placement "
+                "no longer matches the workload" % (
+                    drift, float(self.drift_ratio)))
+
+        in_cooldown = (last_action_ts is not None
+                       and now - float(last_action_ts)
+                       < self.cooldown_s)
+        if breaches:
+            if in_cooldown:
+                return _decision(
+                    NOOP, "overloaded (%s) but cooling down: %.0fs of "
+                    "%.0fs elapsed" % ("; ".join(breaches),
+                                       now - float(last_action_ts),
+                                       self.cooldown_s))
+            target_world = min(world + self.grow_step, self.max_world)
+            target_slots = None
+            if slots is not None:
+                target_slots = min(int(slots) + 1, self.max_slots)
+            if target_world == world and target_slots in (None, slots):
+                return _decision(
+                    NOOP, "overloaded (%s) but already at max_world=%d"
+                    % ("; ".join(breaches), self.max_world))
+            return _decision(GROW, "; ".join(breaches),
+                             target_world=target_world,
+                             target_slots=target_slots)
+
+        queue_idle = float(status.get("serving_queue_depth") or 0) == 0
+        shed_idle = float(status.get("serving_shed_rate") or 0) == 0
+        idle = (observed > 0
+                and len(below_watermark) == observed
+                and queue_idle and shed_idle)
+        if idle:
+            if in_cooldown:
+                return _decision(
+                    NOOP, "idle (%s below %d%% watermark) but cooling "
+                    "down" % (", ".join(below_watermark),
+                              round(self.low_watermark * 100)))
+            target_world = max(world - self.shrink_step,
+                               self.min_world)
+            target_slots = None
+            if slots is not None:
+                target_slots = max(int(slots) - 1, self.min_slots)
+            if target_world == world and target_slots in (None, slots):
+                return _decision(
+                    NOOP, "idle but already at min_world=%d"
+                    % self.min_world)
+            return _decision(
+                SHRINK, "%s below %d%% watermark, queue empty, no shed"
+                % (", ".join(below_watermark),
+                   round(self.low_watermark * 100)),
+                target_world=target_world, target_slots=target_slots)
+        return _decision(
+            NOOP, "within band: no target breached beyond +%d%% "
+            "hysteresis, not all signals idle"
+            % round(self.hysteresis * 100))
+
+
+class Autoscaler:
+    """Collect → decide → journal → execute, on a timer or by hand.
+
+    ``launch_worker(count, target_world)`` must start ``count`` new
+    worker processes that call ``ElasticTrainer.run(..., join=True)``;
+    ``release_worker(count, target_world)`` must signal ``count``
+    members to leave (their exit drives the normal scale-down epoch).
+    ``engines`` are :class:`~..serving.decode.DecodeEngine` instances
+    whose KV-cache ``slots`` follow the same decisions via
+    ``resize``.  Any actuator may be ``None``: the decision is still
+    journaled, which is what the drills assert on.
+    """
+
+    def __init__(self, policy, telemetry_dir=None, hb_dir=None,
+                 collect=None, world=None, launch_worker=None,
+                 release_worker=None, engines=(), interval=10.0):
+        self.policy = policy
+        self.telemetry_dir = telemetry_dir
+        self.hb_dir = hb_dir
+        self._collect = collect
+        self._world = world
+        self.launch_worker = launch_worker
+        self.release_worker = release_worker
+        self.engines = list(engines)
+        self.interval = float(interval)
+        self.last_decision = None
+        self._last_action_ts = None
+        self._stop = threading.Event()
+        self._thread = None
+
+    def enabled(self):
+        return self.policy is not None and autoscale_enabled()
+
+    # -- observation ----------------------------------------------------
+
+    def current_world(self):
+        """World size from the newest membership record when a
+        membership dir is wired, else the constructor's static value,
+        else 1."""
+        if self.hb_dir is not None:
+            from . import elastic as _elastic
+
+            _epoch, rec = _elastic.latest_epoch(self.hb_dir)
+            if rec is not None and rec.get("members"):
+                return len(rec["members"])
+        return int(self._world) if self._world is not None else 1
+
+    def _status(self):
+        if self._collect is not None:
+            return self._collect()
+        if self.telemetry_dir is not None:
+            from ..tools.monitor import collect_status
+
+            return collect_status(self.telemetry_dir,
+                                  hb_dir=self.hb_dir)
+        return {}
+
+    # -- the loop -------------------------------------------------------
+
+    def poll_once(self, status=None, now=None):
+        """One control-loop turn.  Returns the :class:`Decision`."""
+        from ..observability import runtime as _obs
+
+        now = time.time() if now is None else now
+        world = self.current_world()
+        slots = sum(e.slots for e in self.engines) \
+            if self.engines else None
+        if not self.enabled():
+            decision = Decision(
+                action=NOOP,
+                reason="autoscaler disabled (PADDLE_TPU_AUTOSCALE=0 "
+                       "or no SLOPolicy)",
+                world=world, target_world=world, slots=slots,
+                target_slots=slots, evidence={})
+            self.last_decision = decision
+            return decision
+        if status is None:
+            status = self._status()
+        decision = self.policy.decide(
+            status, world, now=now,
+            last_action_ts=self._last_action_ts, slots=slots)
+        _obs.record_autoscale_decision(
+            decision.action, decision.reason, world=decision.world,
+            target_world=decision.target_world,
+            evidence=decision.evidence)
+        self.last_decision = decision
+        if self._execute(decision):
+            self._last_action_ts = now
+        return decision
+
+    def _execute(self, decision):
+        acted = False
+        if decision.action == GROW:
+            if self.launch_worker is not None \
+                    and decision.target_world > decision.world:
+                self.launch_worker(
+                    decision.target_world - decision.world,
+                    decision.target_world)
+                acted = True
+            acted = self._resize_engines(+1) or acted
+        elif decision.action == SHRINK:
+            if self.release_worker is not None \
+                    and decision.target_world < decision.world:
+                self.release_worker(
+                    decision.world - decision.target_world,
+                    decision.target_world)
+                acted = True
+            acted = self._resize_engines(-1) or acted
+        return acted
+
+    def _resize_engines(self, delta):
+        acted = False
+        for engine in self.engines:
+            want = min(max(engine.slots + delta,
+                           self.policy.min_slots),
+                       self.policy.max_slots)
+            if want != engine.slots:
+                engine.resize(want)
+                acted = True
+        return acted
+
+    # -- background operation -------------------------------------------
+
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop,
+                                        name="autoscaler", daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 - the loop must survive
+                pass           # a bad collect; next tick retries
+
+    def stop(self, timeout=5.0):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None

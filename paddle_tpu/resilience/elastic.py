@@ -48,6 +48,33 @@ stamped ``zero1`` execute with unsharded optimizer state on
 single-device elastic workers (execution-equivalent — the shard
 remapping itself is exercised by the reshard round-trip tests on the
 8-virtual-device harness).
+
+Scale-UP mirrors the shrink machinery with a two-phase admission:
+
+1. **Join request.**  A joiner posts a write-once
+   ``join-<epoch>-r<rank>.json`` at the membership dir
+   (:func:`request_join`) and heartbeats while it waits — staleness
+   evicts it from admission exactly like it evicts a member from the
+   fleet.
+2. **Admit + warm up.**  The epoch writer (lowest-ranked alive member,
+   same takeover ladder) publishes ``admit-<epoch+1>.json`` naming the
+   joiners.  Each joiner then compiles and dry-runs its re-planned
+   worker program BEFORE acknowledging with a ``ready`` marker; the
+   fleet keeps stepping at the old epoch the whole time, and a joiner
+   that dies or wedges mid-warm-up is dropped by heartbeat staleness —
+   the admission rolls forward without it.
+3. **Transition.**  Once every surviving joiner is ready the leader
+   writes ``member-<epoch+1>`` carrying ``start_step = leader.step +
+   2``.  The exchange is lockstep (no member begins step S+1 before
+   every member finished the step-S rendezvous), so a record written
+   at the leader's boundary S is visible to all members by their
+   boundary S+1 < start_step — everyone re-plans up, the leader
+   reshards the freshest checkpoint N→N+1 through the
+   direction-agnostic reshard, and the grown world resumes at
+   ``start_step`` together.
+
+:mod:`.autoscale` drives this loop (and ``DecodeEngine`` slot counts)
+from monitor-collected SLO signals.
 """
 
 import collections
@@ -60,14 +87,16 @@ import numpy as np
 from . import checkpoint as _ckpt
 from . import faults as _faults
 from ..observability import tracing as _tr
-from .watchdog import HeartbeatMonitor, HeartbeatWriter, WorkerLostError
+from .watchdog import (HeartbeatMonitor, HeartbeatWriter,
+                       WorkerLostError, read_heartbeat)
 from .watchdog import _record_lost
 
 __all__ = [
     "ELASTIC_EVICTED_EXIT_CODE", "ElasticError", "ElasticEvictedError",
     "Membership", "agree_membership", "reduce_gradients",
     "SplitStep", "build_split", "plan_world", "GradExchange",
-    "ElasticTrainer",
+    "ElasticTrainer", "latest_epoch", "request_join", "pending_joins",
+    "gc_epoch_files", "join_enabled",
 ]
 
 #: exit status of a worker excluded from the agreed shrunk membership
@@ -75,6 +104,9 @@ ELASTIC_EVICTED_EXIT_CODE = 45
 
 _MEMBER_PREFIX = "member-"
 _GRAD_PREFIX = "g-"
+_JOIN_PREFIX = "join-"
+_ADMIT_PREFIX = "admit-"
+_READY_PREFIX = "ready-"
 
 
 class ElasticError(RuntimeError):
@@ -179,6 +211,176 @@ def agree_membership(dirname, rank, epoch, survivors, lost, reason="",
                       lost=[int(r) for r in got.get("lost", [])],
                       writer=int(got.get("writer", -1)),
                       traceparent=got.get("traceparent"))
+
+
+def _membership_from_record(rec):
+    return Membership(epoch=int(rec["epoch"]),
+                      members=[int(r) for r in rec["members"]],
+                      world=int(rec["world"]),
+                      lost=[int(r) for r in rec.get("lost", [])],
+                      writer=int(rec.get("writer", -1)),
+                      traceparent=rec.get("traceparent"))
+
+
+# ---------------------------------------------------------------------------
+# join protocol: request / admit / ready files + epoch-scoped GC
+# ---------------------------------------------------------------------------
+
+def _join_path(dirname, epoch, rank):
+    return os.path.join(dirname, "%s%08d-r%d.json"
+                        % (_JOIN_PREFIX, int(epoch), int(rank)))
+
+
+def _admit_path(dirname, epoch):
+    return os.path.join(dirname, "%s%08d.json" % (_ADMIT_PREFIX,
+                                                  int(epoch)))
+
+
+def _ready_path(dirname, epoch, rank):
+    return os.path.join(dirname, "%s%08d-r%d.json"
+                        % (_READY_PREFIX, int(epoch), int(rank)))
+
+
+def join_enabled():
+    """Scale-up admission master switch (``PADDLE_TPU_ELASTIC_JOIN``,
+    default on).  With it off — or simply with no join files on disk —
+    the scale-down path is untouched."""
+    return os.environ.get("PADDLE_TPU_ELASTIC_JOIN", "1") \
+        .strip().lower() not in ("0", "false", "off")
+
+
+def latest_epoch(dirname):
+    """Newest ``member-<epoch>`` record in ``dirname`` as
+    ``(epoch, record_dict)``.  ``(None, None)`` when no record exists;
+    a present-but-unreadable record returns ``(epoch, None)`` (caller
+    retries — it is mid-publish)."""
+    best = None
+    try:
+        names = os.listdir(dirname)
+    except OSError:
+        return None, None
+    for name in names:
+        if not (name.startswith(_MEMBER_PREFIX)
+                and name.endswith(".json")):
+            continue
+        try:
+            epoch = int(name[len(_MEMBER_PREFIX):-len(".json")])
+        except ValueError:
+            continue
+        best = epoch if best is None else max(best, epoch)
+    if best is None:
+        return None, None
+    try:
+        with open(_member_path(dirname, best)) as f:
+            return best, json.load(f)
+    except (OSError, ValueError):
+        return best, None
+
+
+def request_join(dirname, rank, epoch, traceparent=None):
+    """Post the write-once join request asking admission into the epoch
+    AFTER ``epoch`` (the newest membership the joiner observed).
+    Returns whatever record won the slot."""
+    os.makedirs(dirname, exist_ok=True)
+    record = {
+        "schema": 1, "rank": int(rank), "epoch": int(epoch),
+        "ts": time.time(),
+        "traceparent": traceparent or _tr.current_traceparent(),
+    }
+    return _write_once(_join_path(dirname, epoch, rank), record)
+
+
+def pending_joins(dirname, epoch, stale_timeout=5.0, now=None):
+    """Ranks with a join request posted against ``epoch`` whose
+    heartbeat is fresh — a joiner that died after posting never makes
+    it into an admission round."""
+    now = time.time() if now is None else now
+    prefix = "%s%08d-r" % (_JOIN_PREFIX, int(epoch))
+    out = []
+    try:
+        names = os.listdir(dirname)
+    except OSError:
+        return out
+    for name in names:
+        if not (name.startswith(prefix) and name.endswith(".json")):
+            continue
+        try:
+            rank = int(name[len(prefix):-len(".json")])
+        except ValueError:
+            continue
+        hb = read_heartbeat(dirname, rank)
+        if hb is not None and now - hb["mtime"] <= stale_timeout:
+            out.append(rank)
+    return sorted(out)
+
+
+def _protocol_epoch(name):
+    """Epoch encoded in a membership-protocol or grad-exchange file
+    name, or None for files outside the epoch-scoped families."""
+    for prefix in (_MEMBER_PREFIX, _JOIN_PREFIX, _ADMIT_PREFIX,
+                   _READY_PREFIX):
+        if name.startswith(prefix):
+            digits = name[len(prefix):].split("-", 1)[0] \
+                .split(".", 1)[0]
+            try:
+                return int(digits)
+            except ValueError:
+                return None
+    if name.startswith(_GRAD_PREFIX + "e"):
+        try:
+            return int(name[len(_GRAD_PREFIX) + 1:].split("-", 1)[0])
+        except ValueError:
+            return None
+    return None
+
+
+def gc_epoch_files(dirname, keep_epoch, members=None, hb_grace=None,
+                   now=None):
+    """Epoch-scoped garbage collection: a long-lived elastic run must
+    not grow its workdir without bound.  Drops membership-protocol
+    files (``member-``/``join-``/``admit-``/``ready-``) and
+    grad-exchange files from epochs before ``keep_epoch - 1`` — the
+    current AND previous epoch are always retained, so nothing a
+    straggler could still be reading disappears under it.  When
+    ``members``/``hb_grace`` are given, also reclaims ``hb-*`` (and
+    done-marker) files of ranks outside ``members`` whose last beat is
+    more than ``hb_grace`` old — a pending joiner keeps beating, so its
+    file survives.  Returns the removed names."""
+    removed = []
+    try:
+        names = os.listdir(dirname)
+    except OSError:
+        return removed
+    floor = int(keep_epoch) - 1
+    now = time.time() if now is None else now
+    members = set(int(m) for m in members) if members else set()
+    for name in names:
+        path = os.path.join(dirname, name)
+        epoch = _protocol_epoch(name)
+        if epoch is not None:
+            if epoch >= floor:
+                continue
+        elif hb_grace is not None and name.startswith("hb-"):
+            base = name[len("hb-"):].split(".", 1)[0]
+            try:
+                rank = int(base)
+            except ValueError:
+                continue
+            if rank in members:
+                continue
+            try:
+                if now - os.path.getmtime(path) <= hb_grace:
+                    continue
+            except OSError:
+                continue
+        else:
+            continue
+        try:
+            os.unlink(path)
+            removed.append(name)
+        except OSError:
+            pass
+    return sorted(removed)
 
 
 # ---------------------------------------------------------------------------
@@ -459,7 +661,8 @@ class ElasticTrainer:
     def __init__(self, program, startup_program, executor, rank, world,
                  workdir, fetch_list=(), batch_size=None, ckpt_every=1,
                  retain=None, hb_interval=0.25, stale_timeout=3.0,
-                 wedge_timeout=60.0, state=None):
+                 wedge_timeout=60.0, state=None, warmup_timeout=120.0,
+                 join_timeout=300.0):
         self.base_program = program
         self.base_startup = startup_program
         self.exe = executor
@@ -475,6 +678,8 @@ class ElasticTrainer:
         self.hb_interval = float(hb_interval)
         self.stale_timeout = float(stale_timeout)
         self.wedge_timeout = float(wedge_timeout)
+        self.warmup_timeout = float(warmup_timeout)
+        self.join_timeout = float(join_timeout)
         self.state = dict(state or {})
 
         self.epoch = 0
@@ -487,6 +692,10 @@ class ElasticTrainer:
         self._monitor = None
         self._exchange = None
         self._recovering_since = None
+        self._rejoining_since = None
+        self._admission = None
+        self._pending_member = None
+        self._total_steps = None
         for d in (self.hb_dir, self.exchange_dir, self.ckpt_dir):
             os.makedirs(d, exist_ok=True)
 
@@ -506,12 +715,18 @@ class ElasticTrainer:
     def _topology(self):
         return {"world": self.world, "zero1": bool(self.zero1)}
 
-    def _adopt_membership(self, membership):
+    def _adopt_membership(self, membership, keep_epoch=None):
         """Install an agreed membership: peers list, watchdog, exchange,
         and the fleet env contract (``PADDLE_TRAINER_ID`` /
         ``PADDLE_TRAINERS_NUM``) that role makers and ``_is_primary``
         read — after a leader loss the new leader must also *look*
-        primary to every downstream layer."""
+        primary to every downstream layer.
+
+        ``keep_epoch`` widens the sweep/GC retention floor: a grow
+        transition keeps the outgoing epoch's grad files on disk because
+        a peer one boundary behind may still be reading them (the shrink
+        path has no such reader — every survivor abandoned the old
+        rendezvous)."""
         self.epoch = membership.epoch
         self.members = list(membership.members)
         if self.rank not in self.members:
@@ -528,7 +743,23 @@ class ElasticTrainer:
         self._exchange = GradExchange(
             self.exchange_dir, self.rank, self.members, self._monitor,
             wedge_timeout=self.wedge_timeout)
-        self._exchange.sweep(self.epoch)
+        keep = self.epoch if keep_epoch is None else int(keep_epoch)
+        self._exchange.sweep(keep)
+        if self._is_leader():
+            # epoch-scoped GC (current + previous epoch retained); its
+            # floor is already one epoch behind ``keep_epoch``, so the
+            # grow transition's outgoing-epoch grad files survive it
+            # either way.  The hb grace is generous so only long-gone
+            # ranks lose their beat files — pending joiners keep
+            # beating and are safe
+            gc_epoch_files(
+                self.hb_dir, self.epoch, members=self.members,
+                hb_grace=max(self.wedge_timeout,
+                             4.0 * self.stale_timeout))
+            gc_epoch_files(self.exchange_dir, self.epoch)
+        from ..observability import runtime as _obs
+
+        _obs.set_elastic_state(self.epoch, self.world)
 
     # -- planning / restore --------------------------------------------
 
@@ -556,13 +787,22 @@ class ElasticTrainer:
         return not any(k in recorded and recorded[k] != expected[k]
                        for k in expected)
 
-    def _restore(self, recovery):
+    def _restore(self, recovery, leader=None, require=False):
         """Load the newest checkpoint at the CURRENT topology.  The
         leader reshards a mismatched latest version first; followers
         wait for the resharded manifest to land rather than loading a
-        stale layout or silently falling back to an older version."""
+        stale layout or silently falling back to an older version.
+
+        ``leader`` overrides who owns the reshard: during a grow
+        transition the OLD leader holds the fresh checkpoint, and an
+        admitted joiner with a lower rank than every member must not
+        grab the reshard it cannot yet serve.  ``require`` makes an
+        empty checkpoint root a wait, not a pass — a joiner has no
+        in-memory state to fall back on."""
         topo = self._topology()
-        if self._is_leader():
+        if leader is None:
+            leader = self._is_leader()
+        if leader:
             versions = _ckpt.list_checkpoints(self.ckpt_dir)
             if versions:
                 _step, path = versions[0]
@@ -573,7 +813,7 @@ class ElasticTrainer:
 
                     reshard_checkpoint(path, topo)
         else:
-            self._await_resharded(recovery)
+            self._await_resharded(recovery, require=require)
         info = _ckpt.try_load_latest_checkpoint(
             self.exe, self.ckpt_dir, main_program=self.train_prog,
             expected_topology=topo)
@@ -588,12 +828,14 @@ class ElasticTrainer:
         # and self.step already points at the interrupted step
         return info
 
-    def _await_resharded(self, recovery, none_grace=2.0):
+    def _await_resharded(self, recovery, none_grace=2.0,
+                         require=False):
         """Follower side of the reshard rendezvous: poll until the
         newest version's recorded topology fits this world.  A brief
         empty-listing window is tolerated (the leader's save-aside
         replacement renames the dir out and back); a persistent empty
-        root means there is nothing to restore."""
+        root means there is nothing to restore — unless ``require``
+        (the joiner path), where only a compatible checkpoint counts."""
         deadline = time.time() + self.wedge_timeout
         none_since = None
         while True:
@@ -604,10 +846,12 @@ class ElasticTrainer:
                     recorded = _ckpt.read_topology(versions[0][1])
                 except _ckpt.CorruptCheckpointError:
                     recorded = None  # racing the replacement rename
+                if recorded is None and require:
+                    recorded = {"world": -1}  # keep waiting
                 if recorded is None \
                         or self._topology_compatible(recorded):
                     return
-            else:
+            elif not require:
                 if not recovery:
                     return  # fresh start: nothing will appear
                 if none_since is None:
@@ -659,10 +903,269 @@ class ElasticTrainer:
             retain=self.retain, all_ranks=True,
             topology=self._topology())
 
+    # -- scale-up: admission (leader side) ------------------------------
+
+    def _maybe_admit(self):
+        """Leader-side admission state machine, run at every healthy
+        step boundary.  Phase 1 turns fresh join requests into a
+        write-once admit record; phase 2 watches the admitted joiners'
+        warm-up, drops any that die or wedge (heartbeat staleness /
+        warm-up budget), and finalizes ``member-<epoch+1>`` with a
+        ``start_step`` two boundaries out — the lockstep exchange makes
+        that horizon race-free.  The fleet keeps stepping at the old
+        epoch throughout."""
+        if not join_enabled() or not self._is_leader() \
+                or self._pending_member is not None:
+            return
+        total = self._total_steps
+        if self._admission is None:
+            if total is not None and self.step + 4 >= total:
+                return  # no headroom left for warm-up + transition
+            joiners = [r for r in pending_joins(
+                self.hb_dir, self.epoch,
+                stale_timeout=max(self.stale_timeout,
+                                  4.0 * self.hb_interval))
+                if r not in self.members]
+            if not joiners:
+                return
+            from ..observability import runtime as _obs
+
+            got = _write_once(
+                _admit_path(self.hb_dir, self.epoch + 1), {
+                    "schema": 1, "epoch": self.epoch + 1,
+                    "members": list(self.members), "joiners": joiners,
+                    "writer": self.rank, "ts": time.time(),
+                    "traceparent": _tr.current_traceparent(),
+                })
+            self._admission = {
+                "epoch": int(got["epoch"]),
+                "joiners": [int(r) for r in got["joiners"]],
+                "deadline": time.time() + self.warmup_timeout,
+            }
+            _obs.set_elastic_state(
+                self.epoch, self.world,
+                pending=len(self._admission["joiners"]))
+            _obs.record_join_admitted(self._admission["epoch"],
+                                      self._admission["joiners"])
+            return
+        adm = self._admission
+        ready = [r for r in adm["joiners"] if os.path.exists(
+            _ready_path(self.hb_dir, adm["epoch"], r))]
+        waiting = [r for r in adm["joiners"] if r not in ready]
+        if waiting:
+            now = time.time()
+            dead = [r for r in waiting
+                    if (lambda hb: hb is None
+                        or now - hb["mtime"] > self.stale_timeout)(
+                        read_heartbeat(self.hb_dir, r))]
+            if now > adm["deadline"]:
+                dead = list(waiting)  # warm-up budget exhausted
+            if len(dead) < len(waiting):
+                return  # still warming up: keep the old epoch stepping
+            if dead:
+                _record_lost(sorted(dead),
+                             "joiner died or wedged mid-warm-up "
+                             "(admission epoch %d)" % adm["epoch"])
+        if total is not None and self.step + 2 >= total:
+            return  # too late to transition before the run ends
+        members = sorted(set(self.members) | set(ready))
+        got = _write_once(_member_path(self.hb_dir, adm["epoch"]), {
+            "schema": 1, "epoch": adm["epoch"], "members": members,
+            "world": len(members), "lost": [], "reason": "grow",
+            "joined": ready, "writer": self.rank,
+            "start_step": self.step + 2, "ts": time.time(),
+            "traceparent": _tr.current_traceparent(),
+        })
+        self._admission = None
+        self._pending_member = got
+        from ..observability import runtime as _obs
+
+        _obs.set_elastic_state(self.epoch, self.world, pending=0)
+
+    # -- scale-up: the grown-epoch transition (every member) ------------
+
+    def _maybe_transition(self):
+        """Adopt a finalized grown membership exactly at its
+        ``start_step`` boundary.  The record was written at the
+        leader's boundary ``start_step - 2`` and the exchange is
+        lockstep, so every member observes it at least one boundary
+        before the transition — no member can run a step under the old
+        epoch that a peer already ran under the new one."""
+        if self._pending_member is None:
+            path = _member_path(self.hb_dir, self.epoch + 1)
+            if not os.path.exists(path):
+                return
+            try:
+                with open(path) as f:
+                    rec = json.load(f)
+            except (OSError, ValueError):
+                return  # racing the writer's link; retry next boundary
+            if rec.get("start_step") is None:
+                return  # a shrink record: reached via WorkerLostError
+            self._pending_member = rec
+        if self.step < int(self._pending_member["start_step"]):
+            return
+        self._transition(self._pending_member)
+
+    def _transition(self, rec):
+        t0 = time.perf_counter()
+        old_members = list(self.members)
+        was_leader = self._is_leader()
+        membership = _membership_from_record(rec)
+        grew = list(membership.members) != old_members
+        with _tr.span("elastic.grow", epoch=membership.epoch,
+                      world=membership.world):
+            if grew and was_leader:
+                # the joiners restore from a checkpoint of the state
+                # entering start_step — force one if the cadence missed
+                self._checkpoint_now()
+            self._adopt_membership(membership,
+                                   keep_epoch=membership.epoch - 1)
+            self._pending_member = None
+            self._admission = None
+            if not grew:
+                return  # every admitted joiner died warming up:
+                        # epoch bump only, keep stepping
+            self._plan()
+            with _tr.span("elastic.restore"):
+                self._restore(recovery=True, leader=was_leader)
+        self._recovering_since = t0
+        _faults.set_step(self.step)
+
+    def _checkpoint_now(self):
+        versions = _ckpt.list_checkpoints(self.ckpt_dir)
+        if versions and int(versions[0][0]) >= self.step - 1:
+            return
+        _ckpt.save_checkpoint(
+            self.exe, self.ckpt_dir, main_program=self.train_prog,
+            step=self.step - 1,
+            state={"step": self.step - 1, "extra": self.state},
+            retain=self.retain, all_ranks=True,
+            topology=self._topology())
+
+    # -- scale-up: the joiner side --------------------------------------
+
+    def _read_admit(self, epoch):
+        try:
+            with open(_admit_path(self.hb_dir, epoch)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _await_member_record(self, target, deadline):
+        while True:
+            epoch, rec = latest_epoch(self.hb_dir)
+            if epoch is not None and epoch >= target and rec is not None:
+                return rec
+            if time.time() > deadline:
+                raise ElasticError(
+                    "membership epoch %d did not land within the join "
+                    "timeout" % target)
+            time.sleep(0.05)
+
+    def _dry_run(self, make_feed):
+        """Compile both halves of the split step by running them once on
+        real feed shapes — the warm-up contract: all jit cost is paid
+        BEFORE the ready ack, so the fleet's first grown step is not a
+        compile stall.  Parameter values are scratch; the restore that
+        follows admission overwrites them."""
+        feed = make_feed(self.step, self.index, self.world)
+        if self.split is None:
+            self.exe.run(program=self.train_prog, feed=feed,
+                         fetch_list=list(self.fetch_list))
+            return
+        sp = self.split
+        out = self.exe.run(program=sp.head, feed=feed,
+                           fetch_list=(list(self.fetch_list)
+                                       + sp.grad_names
+                                       + sp.passthrough))
+        nf = len(self.fetch_list)
+        ng = len(sp.grad_names)
+        grads = dict(zip(sp.grad_names, out[nf:nf + ng]))
+        passthrough = dict(zip(sp.passthrough, out[nf + ng:]))
+        reduced = reduce_gradients([grads] * self.world, sp.pre_scale)
+        tail_feed = dict(passthrough)
+        tail_feed.update(reduced)
+        self.exe.run(program=sp.tail, feed=tail_feed, fetch_list=[])
+
+    def _join_fleet(self, make_feed):
+        """Joiner entry: post the write-once join request against the
+        newest observed epoch, heartbeat while waiting, warm up on
+        admission, and only ack ready once compiled.  Re-posts when the
+        fleet's epoch moves under us (a concurrent shrink consumes the
+        epoch we asked for) and retries when an admission round rolls
+        forward without us."""
+        from ..observability import runtime as _obs
+
+        t0 = time.perf_counter()
+        deadline = time.time() + self.join_timeout
+        observed = None
+        with _tr.span("elastic.join", rank=self.rank):
+            while True:
+                epoch, _rec = latest_epoch(self.hb_dir)
+                epoch = 0 if epoch is None else epoch
+                if observed is None or epoch > observed:
+                    observed = epoch
+                    request_join(self.hb_dir, self.rank, observed)
+                    _obs.record_join_request(self.rank, observed)
+                admit = self._read_admit(observed + 1)
+                if admit is None or self.rank not in [
+                        int(r) for r in admit.get("joiners", [])]:
+                    if time.time() > deadline:
+                        raise ElasticError(
+                            "join request by rank %d was not admitted "
+                            "within %.1fs"
+                            % (self.rank, self.join_timeout))
+                    time.sleep(0.05)
+                    continue
+                target = int(admit["epoch"])
+                provisional = sorted(
+                    set(int(m) for m in admit["members"])
+                    | set(int(r) for r in admit["joiners"]))
+                wt0 = time.perf_counter()
+                with _tr.span("elastic.warmup", epoch=target,
+                              world=len(provisional)):
+                    self._adopt_membership(Membership(
+                        epoch=target, members=provisional,
+                        world=len(provisional), lost=[],
+                        writer=int(admit.get("writer", -1)),
+                        traceparent=admit.get("traceparent")))
+                    startup = self._plan()
+                    if startup is not None:
+                        self.exe.run(program=startup)
+                    self._dry_run(make_feed)
+                    _write_once(
+                        _ready_path(self.hb_dir, target, self.rank),
+                        {"schema": 1, "rank": self.rank,
+                         "epoch": target, "ts": time.time()})
+                _obs.record_warmup(
+                    self.rank, target,
+                    (time.perf_counter() - wt0) * 1000.0)
+                final = self._await_member_record(target, deadline)
+                if self.rank not in [int(m) for m in final["members"]]:
+                    continue  # round rolled forward without us: retry
+                membership = _membership_from_record(final)
+                replan = list(membership.members) != self.members
+                self._adopt_membership(membership)
+                if replan:
+                    self._plan()  # a co-joiner was dropped mid-warm-up
+                with _tr.span("elastic.restore"):
+                    self._restore(recovery=True, leader=False,
+                                  require=True)
+                self.step = max(self.step,
+                                int(final.get("start_step", 0)))
+                break
+        self._rejoining_since = t0
+        _faults.set_step(self.step)
+
     # -- recovery -------------------------------------------------------
 
     def _recover(self, err):
         t0 = time.perf_counter()
+        # a shrink consumes the next epoch: any in-flight admission or
+        # pending grown membership is void, joiners re-request later
+        self._admission = None
+        self._pending_member = None
         lost = sorted(set(int(r) for r in err.ranks)
                       & set(self.members))
         if not lost:
@@ -696,16 +1199,42 @@ class ElasticTrainer:
                 (time.perf_counter() - self._recovering_since)
                 * 1000.0)
             self._recovering_since = None
+        if self._rejoining_since is not None:
+            from ..observability import runtime as _obs
+
+            # join-request → first completed full-world step
+            _obs.record_rejoin(
+                self.epoch, self.step, self.world,
+                (time.perf_counter() - self._rejoining_since)
+                * 1000.0)
+            self._rejoining_since = None
 
     # -- entry point ----------------------------------------------------
 
-    def run(self, total_steps, make_feed, on_step=None):
+    def _publish_initial_membership(self):
+        """First-wins publish of the boot epoch's record so a later
+        joiner can discover the current membership from disk alone."""
+        if os.path.exists(_member_path(self.hb_dir, self.epoch)):
+            return
+        _write_once(_member_path(self.hb_dir, self.epoch), {
+            "schema": 1, "epoch": int(self.epoch),
+            "members": list(self.members), "world": len(self.members),
+            "lost": [], "reason": "boot", "writer": self.rank,
+            "ts": time.time(),
+            "traceparent": _tr.current_traceparent(),
+        })
+
+    def run(self, total_steps, make_feed, on_step=None, join=False):
         """Train ``total_steps`` steps, recovering from worker loss
         in-process.  ``on_step(step, fetches, trainer)`` observes each
-        completed step.  Returns the final step count."""
+        completed step.  With ``join=True`` this worker is not part of
+        the boot membership: it requests admission, warms up, and
+        enters the fleet at the agreed ``start_step``.  Returns the
+        final step count."""
         membership = Membership(
             epoch=self.epoch, members=list(self.members),
             world=len(self.members), lost=[], writer=self.rank)
+        self._total_steps = int(total_steps)
         self._hb = HeartbeatWriter(self.hb_dir, self.rank,
                                    interval=self.hb_interval).start()
         # the worker's root span: joins the drill/driver trace when
@@ -721,12 +1250,18 @@ class ElasticTrainer:
         with _tr.span("elastic.worker", rank=self.rank,
                       world=len(self.members)):
             try:
-                self._adopt_membership(membership)
-                startup = self._plan()
-                if startup is not None:
-                    self.exe.run(program=startup)
-                self._restore(recovery=False)
+                if join:
+                    self._join_fleet(make_feed)
+                else:
+                    self._publish_initial_membership()
+                    self._adopt_membership(membership)
+                    startup = self._plan()
+                    if startup is not None:
+                        self.exe.run(program=startup)
+                    self._restore(recovery=False)
                 while self.step < int(total_steps):
+                    self._maybe_transition()
+                    self._maybe_admit()
                     try:
                         with _tr.span("elastic.step", step=self.step,
                                       epoch=self.epoch):

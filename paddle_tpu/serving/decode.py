@@ -164,6 +164,8 @@ class DecodeEngine:
         self._cond = threading.Condition()
         self._running = False
         self._closed = False
+        self._resizing = False
+        self._admitting = 0
         self._step_count = 0
         self._tokens_done = 0
         self._rate_t0 = None
@@ -326,6 +328,47 @@ class DecodeEngine:
             t.join(timeout)
             self._thread = None
 
+    def resize(self, slots, timeout=60.0):
+        """Scale the KV-cache slot count in place — the autoscaler's
+        serving actuator.  Drain-to-idle semantics: admissions are held
+        (queued requests stay queued), in-flight generations run to
+        completion, then the cache buffers and both program families
+        are rebuilt at the new count and the scheduler resumes.  No
+        per-slot state needs migrating because only FREE slots exist at
+        the rebuild point."""
+        slots = int(slots)
+        if slots < 1:
+            raise ValueError("slots must be >= 1, got %d" % slots)
+        if slots == self.slots:
+            return self.slots
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("decode engine is closed")
+            if self._resizing:
+                raise RuntimeError("a resize is already in progress")
+            self._resizing = True
+        try:
+            deadline = time.time() + timeout
+            while True:
+                with self._cond:
+                    if not self._active() and self._admitting == 0:
+                        break
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        "decode engine %r did not drain to idle within "
+                        "%.1fs for resize" % (self.name, timeout))
+                time.sleep(0.01)
+            old = self.slots
+            self.slots = slots
+            self._slots = [_Slot() for _ in range(slots)]
+            self._build_programs()
+            _obs.record_decode_resize(self.name, old, slots)
+        finally:
+            with self._cond:
+                self._resizing = False
+                self._cond.notify_all()
+        return self.slots
+
     def __enter__(self):
         return self
 
@@ -348,6 +391,10 @@ class DecodeEngine:
                 self._admit()
                 if self._active():
                     self._step()
+                elif self._resizing:
+                    # admissions are held while a resize drains; yield
+                    # so the resizer sees the idle point promptly
+                    time.sleep(0.005)
         except Exception as exc:  # noqa: BLE001 — fail everything
             with self._cond:     # pending; never strand a caller
                 self._closed = True
@@ -371,9 +418,10 @@ class DecodeEngine:
             free = next((i for i, s in enumerate(self._slots)
                          if s.request is None), None)
             with self._cond:
-                if free is None or not self._queue:
+                if self._resizing or free is None or not self._queue:
                     return
                 req = self._queue.pop(0)
+                self._admitting += 1
             L = self.buckets.bucket_for_seq(req.prompt.size)
             padded = np.zeros((1, L), dtype="int32")
             padded[0, :req.prompt.size] = req.prompt
@@ -390,12 +438,15 @@ class DecodeEngine:
                     fetch_list=[fetch], scope=self.scope)
             first = int(np.asarray(out[0]).reshape(-1)[0])
             req.first_token_ts = time.time()
-            slot = self._slots[free]
-            slot.request = req
-            slot.cursor = int(req.prompt.size)
-            slot.tokens = [first]
-            slot.finished = (self.config.eos_id is not None
-                             and first == self.config.eos_id)
+            with self._cond:
+                slot = self._slots[free]
+                slot.request = req
+                slot.cursor = int(req.prompt.size)
+                slot.tokens = [first]
+                slot.finished = (self.config.eos_id is not None
+                                 and first == self.config.eos_id)
+                self._admitting -= 1
+                self._cond.notify_all()
 
     def _step(self):
         """One decode step for every active slot (one jit signature),

@@ -245,6 +245,7 @@ def _run_elastic_worker(args):
     main, startup, loss = _build_model()
     exe = fluid.Executor(fluid.CPUPlace())
     batches = _elastic_batches(args.steps)
+    delay = float(getattr(args, "step_delay", 0.0) or 0.0)
 
     def on_step(step, fetches, trainer):
         print("ELASTIC_STEP %d rank=%d index=%d world=%d epoch=%d "
@@ -252,6 +253,10 @@ def _run_elastic_worker(args):
               % (step, trainer.rank, trainer.index, trainer.world,
                  trainer.epoch,
                  float(np.asarray(fetches[0]).reshape(()))), flush=True)
+        if delay > 0:
+            # rejoin drills pace the fleet so a relaunched worker has
+            # live steps left to join
+            time.sleep(delay)
 
     trainer = elastic.ElasticTrainer(
         main, startup, exe, rank=args.rank, world=args.world,
@@ -262,7 +267,8 @@ def _run_elastic_worker(args):
     try:
         with warnings.catch_warnings():
             warnings.simplefilter("ignore")
-            trainer.run(args.steps, _elastic_feed(batches), on_step)
+            trainer.run(args.steps, _elastic_feed(batches), on_step,
+                        join=bool(getattr(args, "join", False)))
     except elastic.ElasticEvictedError as e:
         print("ELASTIC_EVICTED %s" % e, flush=True)
         return elastic.ELASTIC_EVICTED_EXIT_CODE
@@ -394,6 +400,8 @@ def _run_elastic_driver(args):
                "--ckpt-dir", workdir,
                "--stale-timeout", str(args.stale_timeout),
                "--worker-timeout", str(args.worker_timeout)]
+        if args.step_delay:
+            cmd += ["--step-delay", str(args.step_delay)]
         logf = open(os.path.join(workdir, "worker-r%d.log" % rank),
                     "w+")
         logs.append(logf)
@@ -401,6 +409,67 @@ def _run_elastic_driver(args):
                               stderr=sp.STDOUT))
 
     deadline = time.time() + args.worker_timeout
+
+    def _abort(msg):
+        print("chaos[elastic]: FAIL — %s" % msg, flush=True)
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+        for logf in logs:
+            logf.close()
+        return 2
+
+    if args.rejoin:
+        from paddle_tpu.observability.journal import read_journal
+
+        victim = procs[kill_rank]
+        while victim.poll() is None and time.time() < deadline:
+            time.sleep(0.1)
+        if victim.returncode != KILL_EXIT_CODE:
+            return _abort("victim rank %d exited %s before the rejoin "
+                          "could be staged, expected the injected kill "
+                          "(%d)" % (kill_rank, victim.returncode,
+                                    KILL_EXIT_CODE))
+        # relaunch only once the shrunk fleet is stepping again (its
+        # "resume" journal event has landed), so the incident chain
+        # reads worker-lost -> replan -> reshard -> join-request in
+        # causal order rather than racing the shrink
+        seen_resume = False
+        while time.time() < deadline:
+            if any(e.get("kind") == "resume"
+                   for e in read_journal(telemetry_dir)):
+                seen_resume = True
+                break
+            time.sleep(0.2)
+        if not seen_resume:
+            return _abort("survivors never resumed at world %d; cannot "
+                          "stage the rejoin" % (world - 1))
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = env.get("JAX_PLATFORMS", "cpu")
+        env["PADDLE_TPU_TELEMETRY_DIR"] = telemetry_dir
+        env["PADDLE_TPU_TRACEPARENT"] = drill_tp
+        env.setdefault("PADDLE_TPU_TELEMETRY_FLUSH", "1")
+        # the second life joins clean — it must NOT re-inherit the kill
+        env.pop("PADDLE_TPU_FAULT_SPEC", None)
+        env.pop("PADDLE_TPU_FAULT_STATE_FILE", None)
+        env.pop("PADDLE_TPU_NAN_GUARD", None)
+        cmd = [sys.executable, "-m", "paddle_tpu.tools.chaos",
+               "--elastic-worker", "--join", "--rank", str(kill_rank),
+               "--world", str(world), "--steps", str(args.steps),
+               "--ckpt-dir", workdir,
+               "--stale-timeout", str(args.stale_timeout),
+               "--worker-timeout", str(args.worker_timeout)]
+        if args.step_delay:
+            cmd += ["--step-delay", str(args.step_delay)]
+        print("chaos[elastic]: victim died with %d; relaunching rank %d "
+              "as a joiner" % (KILL_EXIT_CODE, kill_rank), flush=True)
+        logf = open(os.path.join(
+            workdir, "worker-r%d-rejoin.log" % kill_rank), "w+")
+        logs.append(logf)
+        procs.append(sp.Popen(cmd, env=env, stdout=logf,
+                              stderr=sp.STDOUT))
+
     while any(p.poll() is None for p in procs) \
             and time.time() < deadline:
         time.sleep(0.2)
@@ -435,6 +504,10 @@ def _run_elastic_driver(args):
               "rank %d tail:\n%s"
               % (bad, bad[0], outputs[bad[0]][-3000:]), flush=True)
         return 2
+
+    if args.rejoin:
+        return _verify_rejoin(args, world, kill_rank, rcs, outputs,
+                              telemetry_dir, drill_ctx)
 
     shrunk = world - 1
     parsed = {r: _parse_elastic_output(outputs[r]) for r in survivors}
@@ -527,6 +600,156 @@ def _run_elastic_driver(args):
           "paddle_tpu.tools.trace --elastic %s"
           % (drill_ctx.trace_id, world, len(spans), telemetry_dir),
           flush=True)
+    print("chaos[elastic]: PASS", flush=True)
+    return 0
+
+
+def _verify_rejoin(args, world, kill_rank, rcs, outputs, telemetry_dir,
+                   drill_ctx):
+    """Rejoin half of the verdict: the victim's second life joined, the
+    fleet grew back to the full world, every run's losses track the
+    per-world oracles, and the journal reads the whole incident —
+    shrink, join, warm-up, grow — as ONE causally ordered trace."""
+    from paddle_tpu.observability import metrics as _metrics
+    from paddle_tpu.observability import tracing as _tracing
+    from paddle_tpu.observability.journal import read_journal
+
+    survivors = [r for r in range(world) if r != kill_rank]
+    if rcs[-1] != 0:
+        print("chaos[elastic]: FAIL — the victim's second life exited "
+              "%s (a rejoined worker must exit 0); tail:\n%s"
+              % (rcs[-1], outputs[-1][-3000:]), flush=True)
+        return 2
+
+    parsed = {r: _parse_elastic_output(outputs[r]) for r in survivors}
+    jsteps, jfinal = _parse_elastic_output(outputs[-1])
+    for r in survivors:
+        steps_seen, final = parsed[r]
+        missing = [k for k in range(args.steps) if k not in steps_seen]
+        if missing or final is None:
+            print("chaos[elastic]: FAIL — rank %d missed steps %s "
+                  "(in-process resume must cover every step)"
+                  % (r, missing), flush=True)
+            return 2
+        if int(final["world"]) != world:
+            print("chaos[elastic]: FAIL — rank %d finished at world=%s; "
+                  "the fleet never grew back to %d"
+                  % (r, final["world"], world), flush=True)
+            return 2
+    if jfinal is None or int(jfinal["world"]) != world:
+        print("chaos[elastic]: FAIL — the joiner finished at world=%s "
+              "(want %d); tail:\n%s"
+              % (jfinal and jfinal.get("world"), world,
+                 outputs[-1][-3000:]), flush=True)
+        return 2
+    if not jsteps:
+        print("chaos[elastic]: FAIL — the joiner was admitted but ran "
+              "no steps", flush=True)
+        return 2
+    off_world = sorted(k for k, (_i, w, _e, _l) in jsteps.items()
+                       if w != world)
+    if off_world:
+        print("chaos[elastic]: FAIL — the joiner stepped outside the "
+              "grown world at steps %s (must only run at world=%d)"
+              % (off_world, world), flush=True)
+        return 2
+    join_step = min(jsteps)
+    if join_step <= args.kill_step:
+        print("chaos[elastic]: FAIL — the joiner's first step %d is "
+              "not after the kill at step %d" % (join_step,
+                                                 args.kill_step),
+              flush=True)
+        return 2
+    digests = {parsed[r][1]["params_sha"] for r in survivors}
+    digests.add(jfinal["params_sha"])
+    if len(digests) != 1:
+        print("chaos[elastic]: FAIL — survivors and joiner ended on "
+              "different params: %s" % sorted(digests), flush=True)
+        return 1
+    print("chaos[elastic]: fleet grew back to world=%d (joiner entered "
+          "at step %d) and all %d workers agree on params %s"
+          % (world, join_step, world, next(iter(digests))[:16]),
+          flush=True)
+
+    # two oracles: world-N before the kill and after the grow,
+    # world-(N-1) in between — every printed step names its world and
+    # shard index, so each loss is compared against the right one
+    _metrics.set_telemetry_enabled(False)
+    try:
+        oracles = {world: _elastic_oracle(args.steps, world),
+                   world - 1: _elastic_oracle(args.steps, world - 1)}
+    finally:
+        _metrics.set_telemetry_enabled(None)
+    runs = [("rank %d" % r, parsed[r][0]) for r in survivors]
+    runs.append(("rank %d (rejoined)" % kill_rank, jsteps))
+    worst = 0.0
+    for label, steps_seen in runs:
+        for k, (index, w, _epoch, lv) in sorted(steps_seen.items()):
+            want = oracles[w][k][index]
+            rel = abs(lv - want) / max(abs(want), 1e-6)
+            worst = max(worst, rel)
+            if rel > args.tolerance:
+                print("chaos[elastic]: FAIL — %s step %d loss %.8f vs "
+                      "world-%d oracle %.8f (rel %.2e > %.2e)"
+                      % (label, k, lv, w, want, rel, args.tolerance),
+                      flush=True)
+                return 1
+    print("chaos[elastic]: loss curve tracks the world-%d/world-%d "
+          "oracles across shrink and grow (worst rel err %.2e <= %.2e)"
+          % (world, world - 1, worst, args.tolerance), flush=True)
+
+    # the whole incident must read causally in ONE trace: walk the
+    # required kinds, each picked event at-or-after the previous one
+    events = sorted(read_journal(telemetry_dir),
+                    key=lambda e: e.get("ts", 0.0))
+    chain = ["worker-lost", "replan", "reshard", "join-request",
+             "admitted", "warmup", "replan", "reshard", "resume"]
+    t = float("-inf")
+    for kind in chain:
+        pick = next(
+            (e for e in events
+             if e.get("kind") == kind and e.get("ts", 0.0) >= t
+             and e.get("trace") == drill_ctx.trace_id), None)
+        if pick is None:
+            have = sorted({e.get("kind") for e in events})
+            print("chaos[elastic]: FAIL — journal has no '%s' event "
+                  "after the previous link in trace %s (chain %s, "
+                  "kinds present: %s)"
+                  % (kind, drill_ctx.trace_id, " -> ".join(chain),
+                     have), flush=True)
+            return 1
+        t = pick.get("ts", t)
+    print("chaos[elastic]: journal reads %s in causal order inside "
+          "one trace — view it with: python -m paddle_tpu.tools."
+          "monitor --once %s" % (" -> ".join(chain), telemetry_dir),
+          flush=True)
+
+    spans = [s for s in _tracing.read_traces(telemetry_dir)
+             if s.get("trace") == drill_ctx.trace_id]
+    span_ranks = {s.get("rank") for s in spans}
+    span_names = {s.get("name") for s in spans}
+    want_names = {"elastic.worker", "elastic.recover", "elastic.replan",
+                  "elastic.restore", "elastic.join", "elastic.warmup",
+                  "elastic.grow"}
+    missing_ranks = set(range(world)) - span_ranks
+    missing_names = want_names - span_names
+    if missing_ranks or missing_names:
+        print("chaos[elastic]: FAIL — drill trace %s is missing "
+              "rank(s) %s / span(s) %s (have ranks %s, %d spans)"
+              % (drill_ctx.trace_id, sorted(missing_ranks),
+                 sorted(missing_names), sorted(span_ranks), len(spans)),
+              flush=True)
+        return 1
+    print("chaos[elastic]: ONE trace %s spans all %d ranks through "
+          "shrink, rejoin and grow (%d spans)"
+          % (drill_ctx.trace_id, world, len(spans)), flush=True)
+
+    rejoin_ms = [e.get("rejoin_ms") for e in events
+                 if e.get("kind") == "resume"
+                 and e.get("rejoin_ms") is not None]
+    if rejoin_ms:
+        print("chaos[elastic]: elastic_rejoin_ms=%.0f (join request -> "
+              "first grown step)" % rejoin_ms[-1], flush=True)
     print("chaos[elastic]: PASS", flush=True)
     return 0
 
@@ -815,7 +1038,10 @@ def main(argv=None):
         "PADDLE_TPU_FAULT_SPEC",
         "nan_grad@step=3;ckpt_write_fail@step=5;worker_kill@step=7"),
         help="fault spec (see resilience/faults.py grammar)")
-    parser.add_argument("--steps", type=int, default=9)
+    parser.add_argument("--steps", type=int, default=None,
+                        help="training steps (default 9; 24 for "
+                             "--elastic --rejoin so the joiner has "
+                             "live steps left to enter)")
     parser.add_argument("--ckpt-dir", default=None)
     parser.add_argument("--telemetry-dir", default=None,
                         help="journal/metrics dir for the workers "
@@ -836,6 +1062,16 @@ def main(argv=None):
                              "instead: same-seed twins (dense vs int8 "
                              "block-quantized gradient reduction) must "
                              "match loss curves within --tolerance")
+    parser.add_argument("--rejoin", action="store_true",
+                        help="with --elastic: after the shrink "
+                             "recovery, relaunch the victim as a "
+                             "joiner and demand the fleet grows back "
+                             "to the full world (matching digests, "
+                             "causally ordered journal, one trace)")
+    parser.add_argument("--step-delay", type=float, default=None,
+                        help="seconds each worker sleeps per step "
+                             "(default 0; 0.4 for --rejoin so the "
+                             "joiner warms up behind a live fleet)")
     parser.add_argument("--elastic-world", type=int, default=3,
                         help="elastic cluster size before the kill")
     parser.add_argument("--kill-step", type=int, default=3,
@@ -854,11 +1090,18 @@ def main(argv=None):
                         help=argparse.SUPPRESS)
     parser.add_argument("--elastic-worker", action="store_true",
                         help=argparse.SUPPRESS)
+    parser.add_argument("--join", action="store_true",
+                        help=argparse.SUPPRESS)
     parser.add_argument("--rank", type=int, default=0,
                         help=argparse.SUPPRESS)
     parser.add_argument("--world", type=int, default=1,
                         help=argparse.SUPPRESS)
     args = parser.parse_args(argv)
+    rejoin_drill = args.elastic and args.rejoin
+    if args.steps is None:
+        args.steps = 24 if rejoin_drill else 9
+    if args.step_delay is None:
+        args.step_delay = 0.4 if rejoin_drill else 0.0
     if args.worker:
         return _run_worker(args)
     if args.elastic_worker:

@@ -48,14 +48,61 @@ _ALERT_RE = re.compile(
     r"^\s*([A-Za-z_][\w.]*)\s*(>=|<=|==|!=|>|<)\s*(-?[\d.]+)\s*$")
 
 # the journal kinds an incident reads as a story, in the order the
-# chaos acceptance scenarios expect them: fault -> skip -> restore, and
-# the elastic chain worker-lost -> replan -> reshard -> resume
-# (race-detected: a concurrency gate tripped before dispatch;
-# dispatcher-died: the serving dispatch thread crashed)
+# chaos acceptance scenarios expect them: fault -> skip -> restore, the
+# elastic shrink chain worker-lost -> replan -> reshard -> resume, and
+# the grow chain join-request -> admitted -> warmup -> replan ->
+# reshard -> resume (race-detected: a concurrency gate tripped before
+# dispatch; dispatcher-died: the serving dispatch thread crashed;
+# autoscale: an SLO-policy decision)
 _SEQUENCE_KINDS = ("fault-injected", "guard-skip", "race-detected",
                    "dispatcher-died", "worker-lost", "replan",
                    "reshard", "checkpoint-saved",
-                   "checkpoint-loaded", "resume")
+                   "checkpoint-loaded", "join-request", "admitted",
+                   "warmup", "autoscale", "resume")
+
+_MEMBER_RE = re.compile(r"^member-(\d{8})\.json$")
+_JOIN_RE = re.compile(r"^join-(\d{8})-r(\d+)\.json$")
+
+
+def _elastic_fs_view(hb_dir, ranks):
+    """Elastic membership read straight off the rendezvous dir: the
+    newest ``member-*`` record's epoch/world, plus how many *live*
+    non-member ranks have a join request posted at (or past) it.  A
+    dead job's leftovers still render — gauges need a live snapshot,
+    files do not."""
+    try:
+        names = os.listdir(hb_dir)
+    except OSError:
+        return {}
+    epochs = [int(m.group(1))
+              for m in (_MEMBER_RE.match(n) for n in names) if m]
+    joins = [(int(m.group(1)), int(m.group(2)))
+             for m in (_JOIN_RE.match(n) for n in names) if m]
+    if not epochs and not joins:
+        return {}
+    out = {}
+    members = set()
+    if epochs:
+        newest = max(epochs)
+        out["epoch"] = newest
+        try:
+            with open(os.path.join(
+                    hb_dir, "member-%08d.json" % newest)) as f:
+                rec = json.load(f)
+            members = set(rec.get("members") or [])
+            out["world"] = len(members) or None
+        except (OSError, ValueError):
+            pass  # torn write: epoch still stands, world unknown
+    floor = max(epochs) if epochs else 0
+    pending = set()
+    for epoch, rank in joins:
+        if epoch < floor or rank in members:
+            continue
+        r = ranks.get(str(rank))
+        if r is not None and r["alive"] and not r["done"]:
+            pending.add(rank)
+    out["pending"] = len(pending)
+    return out
 
 
 def _read_snapshots(dirname):
@@ -318,6 +365,30 @@ def collect_status(dirname, hb_dir=None, now=None,
     dec_len_p99 = _hist_percentile(dec_len, 99) if dec_len else None
     dec_tps = _metric_value(merged, "decode_tokens_per_sec")
 
+    # elastic view (resilience/elastic + autoscale): world/epoch from
+    # the gauges when a live snapshot exists, else from the membership
+    # files; pending joiners from the join files (ground truth), else
+    # the leader's gauge; plus the autoscaler's last journaled decision
+    fs = _elastic_fs_view(hb_dir or dirname, ranks)
+    elastic_world = _metric_value(merged, "elastic_world_size")
+    if elastic_world is None:
+        elastic_world = fs.get("world")
+    membership_epoch = _metric_value(merged, "membership_epoch")
+    if membership_epoch is None:
+        membership_epoch = fs.get("epoch")
+    pending_joins = fs.get("pending")
+    if pending_joins is None:
+        pending_joins = _metric_value(merged, "elastic_pending_joins")
+    autoscale = None
+    for e in reversed(events):
+        if e.get("kind") == "autoscale":
+            autoscale = {"action": e.get("action"),
+                         "reason": e.get("reason"),
+                         "world": e.get("world"),
+                         "target_world": e.get("target_world"),
+                         "ts": e.get("ts")}
+            break
+
     counts = {}
     for e in events:
         counts[e["kind"]] = counts.get(e["kind"], 0) + 1
@@ -372,6 +443,13 @@ def collect_status(dirname, hb_dir=None, now=None,
                               else round(dec_len_p99, 1)),
         "decode_tokens_per_sec": (None if dec_tps is None
                                   else round(dec_tps, 3)),
+        "elastic_world_size": (None if elastic_world is None
+                               else int(elastic_world)),
+        "membership_epoch": (None if membership_epoch is None
+                             else int(membership_epoch)),
+        "pending_joins": (None if pending_joins is None
+                          else int(pending_joins)),
+        "autoscale": autoscale,
         "ranks": ranks or None,
         "alive_ranks": alive if ranks else None,
         "lost_ranks": (len(ranks) - alive) if ranks else None,
@@ -467,6 +545,16 @@ def render_status(status):
                 _fmt(status["decode_tokens_per_sec"]),
                 _fmt(status["p50_generated_len"]),
                 _fmt(status["p99_generated_len"])))
+    if status.get("elastic_world_size") is not None \
+            or status.get("pending_joins"):
+        lines.append("  elastic: world=%s  epoch=%s  pending_joins=%s"
+                     % (_fmt(status.get("elastic_world_size")),
+                        _fmt(status.get("membership_epoch")),
+                        _fmt(status.get("pending_joins"))))
+    if status.get("autoscale"):
+        a = status["autoscale"]
+        lines.append("  autoscale: %s (%s)"
+                     % (a.get("action"), a.get("reason")))
     if status["ranks"]:
         for rank in sorted(status["ranks"], key=int):
             r = status["ranks"][rank]
@@ -531,8 +619,11 @@ def main(argv=None):
                          "'p99_generated_len>512'; quantized-collective "
                          "jobs add 'quant_error>0.05' (worst per-bucket "
                          "int8 error) / 'quant_error_ratio>2' (error "
-                         "model drift); exit 1 when tripped, 2 when the "
-                         "field has no data (repeatable)")
+                         "model drift); elastic jobs add "
+                         "'pending_joins>0' (a worker is waiting for "
+                         "admission) / 'elastic_world_size<4'; exit 1 "
+                         "when tripped, 2 when the field has no data "
+                         "(repeatable)")
     ap.add_argument("--interval", type=float, default=2.0,
                     help="live-mode refresh seconds (default 2)")
     ap.add_argument("--stale-after", type=float,

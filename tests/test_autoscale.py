@@ -1,0 +1,362 @@
+"""SLO-driven autoscaler (ISSUE 17): the pure policy decision function
+(hysteresis band, watermark, cooldown, clamps, drift-replan), the
+Autoscaler loop's journal/kill-switch/executor contracts, the decode
+engine's drain-then-rebuild ``resize``, and the monitor's elastic
+surface (world/epoch gauges, pending joins, last autoscale decision,
+``--alert 'pending_joins>0'``)."""
+
+import json
+import os
+import time
+
+import pytest
+
+import paddle_tpu as fluid
+import paddle_tpu.observability as obs
+from paddle_tpu.observability.journal import read_journal
+from paddle_tpu.resilience import elastic
+from paddle_tpu.resilience.autoscale import (GROW, NOOP, REPLAN, SHRINK,
+                                             Autoscaler, SLOPolicy,
+                                             autoscale_enabled)
+from paddle_tpu.resilience.watchdog import HeartbeatWriter
+from paddle_tpu.serving import DecodeEngine, GenerationConfig
+from paddle_tpu.tools import monitor
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    fluid.unique_name.switch()
+    for var in ("PADDLE_TPU_TELEMETRY", "PADDLE_TPU_TELEMETRY_DIR",
+                "PADDLE_TPU_TELEMETRY_FLUSH", "PADDLE_TPU_TRACING",
+                "PADDLE_TPU_AUTOSCALE"):
+        monkeypatch.delenv(var, raising=False)
+    obs.reset_telemetry()
+    yield
+    obs.reset_telemetry()
+
+
+def _policy(**kw):
+    kw.setdefault("min_world", 1)
+    kw.setdefault("max_world", 8)
+    kw.setdefault("p99_step_ms", 100.0)
+    kw.setdefault("p99_latency_ms", 250.0)
+    kw.setdefault("shed_rate", 0.0)
+    kw.setdefault("hysteresis", 0.2)
+    kw.setdefault("cooldown_s", 0.0)
+    return SLOPolicy(**kw)
+
+
+OVERLOAD = {"p99_step_ms": 400.0, "p99_serving_latency_ms": 900.0,
+            "serving_shed_rate": 0.3}
+IDLE = {"p99_step_ms": 10.0, "p99_serving_latency_ms": 20.0,
+        "serving_shed_rate": 0.0, "serving_queue_depth": 0}
+
+
+# ---------------------------------------------------------------------------
+# the pure decision function
+# ---------------------------------------------------------------------------
+
+class TestSLOPolicy:
+    def test_bounds_validation(self):
+        with pytest.raises(ValueError, match="world bounds"):
+            SLOPolicy(min_world=4, max_world=2)
+        with pytest.raises(ValueError, match="slot bounds"):
+            SLOPolicy(min_slots=0)
+        with pytest.raises(ValueError, match="low_watermark"):
+            SLOPolicy(low_watermark=1.5)
+
+    def test_overload_grows(self):
+        d = _policy().decide(OVERLOAD, world=2)
+        assert d.action == GROW and d.target_world == 3
+        assert d.evidence["p99_step_ms"] == 400.0
+        assert "p99_step_ms" in d.reason
+
+    def test_idle_shrinks(self):
+        d = _policy().decide(IDLE, world=3)
+        assert d.action == SHRINK and d.target_world == 2
+
+    def test_within_band_is_a_noop(self):
+        # above target but inside the +20% hysteresis band: no flap
+        d = _policy().decide({"p99_step_ms": 110.0}, world=2)
+        assert d.action == NOOP and "within band" in d.reason
+        # below target but above the idle watermark: also in-band
+        d = _policy().decide({"p99_step_ms": 80.0,
+                              "p99_serving_latency_ms": 200.0}, world=2)
+        assert d.action == NOOP and d.target_world == 2
+
+    def test_shrink_needs_every_signal_idle(self):
+        hot_queue = dict(IDLE, serving_queue_depth=4)
+        assert _policy().decide(hot_queue, world=3).action == NOOP
+        shedding = dict(IDLE, serving_shed_rate=0.1)
+        assert _policy().decide(shedding, world=3).action != SHRINK
+
+    def test_cooldown_blocks_consecutive_actions(self):
+        p = _policy(cooldown_s=60.0)
+        now = 1000.0
+        d = p.decide(OVERLOAD, world=2, now=now, last_action_ts=990.0)
+        assert d.action == NOOP and "cooling down" in d.reason
+        d = p.decide(OVERLOAD, world=2, now=now, last_action_ts=900.0)
+        assert d.action == GROW
+
+    def test_world_clamps(self):
+        d = _policy(max_world=2).decide(OVERLOAD, world=2)
+        assert d.action == NOOP and "max_world" in d.reason
+        d = _policy(min_world=2).decide(IDLE, world=2)
+        assert d.action == NOOP and "min_world" in d.reason
+
+    def test_drift_triggers_replan_before_growing(self):
+        p = _policy(drift_ratio=2.0)
+        status = dict(OVERLOAD, drift={"step_ms": 3.5, "peak_hbm": 0.9})
+        d = p.decide(status, world=2)
+        assert d.action == REPLAN and d.evidence["drift"] == 3.5
+        # drift inside the ratio falls through to the breach logic
+        status["drift"] = {"step_ms": 1.1}
+        assert p.decide(status, world=2).action == GROW
+
+    def test_missing_signals_never_decide(self):
+        # no observations at all: neither overloaded nor idle
+        assert _policy().decide({}, world=2).action == NOOP
+
+
+# ---------------------------------------------------------------------------
+# the control loop
+# ---------------------------------------------------------------------------
+
+class TestAutoscaler:
+    def test_every_decision_is_journaled_with_evidence(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_TELEMETRY_DIR", str(tmp_path))
+        monkeypatch.setenv("PADDLE_TPU_TELEMETRY_FLUSH", "1")
+        obs.reset_telemetry()
+        scaler = Autoscaler(_policy(), world=2)
+        for status, action in ((OVERLOAD, GROW), (IDLE, SHRINK),
+                               ({"p99_step_ms": 110.0}, NOOP)):
+            d = scaler.poll_once(status=status)
+            assert d.action == action
+        assert scaler.last_decision.action == NOOP
+        events = [e for e in read_journal(str(tmp_path))
+                  if e.get("kind") == "autoscale"]
+        assert [e["action"] for e in events] == [GROW, SHRINK, NOOP]
+        assert events[0]["evidence"]["p99_step_ms"] == 400.0
+        assert events[0]["target_world"] == 3
+        assert all(e.get("reason") for e in events)
+
+    def test_kill_switch_decides_noop_and_never_actuates(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_TELEMETRY_DIR", str(tmp_path))
+        monkeypatch.setenv("PADDLE_TPU_TELEMETRY_FLUSH", "1")
+        monkeypatch.setenv("PADDLE_TPU_AUTOSCALE", "0")
+        obs.reset_telemetry()
+        assert not autoscale_enabled()
+
+        def _boom(*_a):
+            raise AssertionError("disabled loop must not actuate")
+
+        scaler = Autoscaler(_policy(), world=2, launch_worker=_boom,
+                            release_worker=_boom)
+        assert not scaler.enabled()
+        d = scaler.poll_once(status=OVERLOAD)
+        assert d.action == NOOP and "disabled" in d.reason
+        # a disabled loop leaves no journal trail either
+        assert [e for e in read_journal(str(tmp_path))
+                if e.get("kind") == "autoscale"] == []
+
+    def test_no_policy_means_disabled(self):
+        assert not Autoscaler(None, world=2).enabled()
+
+    def test_executors_receive_count_and_target(self):
+        launched, released = [], []
+        scaler = Autoscaler(
+            _policy(), world=2,
+            launch_worker=lambda n, t: launched.append((n, t)),
+            release_worker=lambda n, t: released.append((n, t)))
+        assert scaler.poll_once(status=OVERLOAD).action == GROW
+        assert launched == [(1, 3)] and released == []
+        assert scaler.poll_once(status=IDLE).action == SHRINK
+        assert released == [(1, 1)]
+
+    def test_acting_arms_the_cooldown(self):
+        launched = []
+        scaler = Autoscaler(
+            _policy(cooldown_s=3600.0), world=2,
+            launch_worker=lambda n, t: launched.append((n, t)))
+        assert scaler.poll_once(status=OVERLOAD).action == GROW
+        d = scaler.poll_once(status=OVERLOAD)
+        assert d.action == NOOP and "cooling down" in d.reason
+        assert launched == [(1, 3)]
+
+    def test_current_world_reads_the_membership(self, tmp_path):
+        d = str(tmp_path)
+        elastic._write_once(elastic._member_path(d, 2),
+                            {"epoch": 2, "members": [0, 1, 3],
+                             "world": 3})
+        scaler = Autoscaler(_policy(), hb_dir=d, world=7)
+        assert scaler.current_world() == 3  # files beat the static hint
+        assert Autoscaler(_policy(), world=7).current_world() == 7
+
+
+# ---------------------------------------------------------------------------
+# DecodeEngine.resize: drain-to-idle, rebuild, resume
+# ---------------------------------------------------------------------------
+
+V = 16
+
+
+class TinyModel:
+    """Deterministic next-token = cur + 1 adapter (same contract as the
+    decode serving tests) so resized programs stay verifiable."""
+
+    def cache_spec(self):
+        return 1, 1, 32, 4
+
+    def _embed(self, ids_f, rows):
+        ones = fluid.layers.fill_constant([1, 4], "float32", 1.0)
+        x = fluid.layers.reshape(ids_f, [rows, 1])
+        return fluid.layers.matmul(x, ones)
+
+    def build_prefill(self, prompt, plen, slot, caches):
+        L = prompt.shape[1]
+        pf = fluid.layers.cast(prompt, "float32")
+        emb = self._embed(fluid.layers.reshape(pf, [L]), L)
+        x = fluid.layers.reshape(emb, [1, 1, L, 4])
+        k, v = caches[0]
+        fluid.layers.kv_cache_prefill(k, x, slot=slot)
+        fluid.layers.kv_cache_prefill(v, x, slot=slot)
+        idx = fluid.layers.increment(fluid.layers.assign(plen),
+                                     value=-1, in_place=True)
+        oh = fluid.layers.cast(fluid.layers.one_hot(
+            fluid.layers.reshape(idx, [1, 1]), L), "float32")
+        last = fluid.layers.reduce_sum(
+            fluid.layers.elementwise_mul(pf, oh), dim=[1])
+        nxt = fluid.layers.cast(
+            fluid.layers.scale(last, scale=1.0, bias=1.0), "int32")
+        return fluid.layers.scale(fluid.layers.cast(
+            fluid.layers.one_hot(
+                fluid.layers.reshape(nxt, [1, 1]), V), "float32"), 10.0)
+
+    def build_step(self, cur, cursors, caches):
+        S = cur.shape[0]
+        cf = fluid.layers.cast(cur, "float32")
+        emb = self._embed(cf, S)
+        x = fluid.layers.reshape(emb, [S, 1, 4])
+        k, v = caches[0]
+        fluid.layers.kv_cache_write(k, x, cursors, per_row=True)
+        fluid.layers.kv_cache_write(v, x, cursors, per_row=True)
+        att = fluid.layers.flash_decode(x, k, v, cursors, per_row=True)
+        zero = fluid.layers.scale(
+            fluid.layers.reduce_sum(att, dim=[1, 2]), 0.0)
+        nxt = fluid.layers.cast(
+            fluid.layers.scale(cf, scale=1.0, bias=1.0), "int32")
+        logits = fluid.layers.scale(fluid.layers.cast(
+            fluid.layers.one_hot(
+                fluid.layers.reshape(nxt, [S, 1]), V), "float32"), 10.0)
+        return fluid.layers.elementwise_add(
+            logits, fluid.layers.reshape(zero, [S, 1]), axis=0)
+
+
+def _engine(name="scaler-tiny", slots=2):
+    return DecodeEngine(
+        TinyModel(), slots=slots, prompt_buckets=(8,),
+        config=GenerationConfig(max_new_tokens=4),
+        place=fluid.CPUPlace(), name=name)
+
+
+class TestDecodeResize:
+    def test_resize_drains_rebuilds_and_resumes(self):
+        with _engine() as eng:
+            toks, _ = eng.submit([3, 5]).result(timeout=60)
+            assert toks == [6, 7, 8, 9]
+            # grow mid-service: drains to idle, rebuilds the slot pool
+            assert eng.resize(4) == 4
+            assert eng.stats()["slots"] == 4
+            rs = [eng.submit([i]) for i in range(1, 5)]
+            for i, r in enumerate(rs, start=1):
+                toks, _ = r.result(timeout=60)
+                assert toks == [i + 1, i + 2, i + 3, i + 4]
+            # shrink back below the burst
+            assert eng.resize(1) == 1
+            toks, _ = eng.submit([7]).result(timeout=60)
+            assert toks == [8, 9, 10, 11]
+
+    def test_resize_waits_for_inflight_requests(self):
+        with _engine() as eng:
+            r = eng.submit([2])
+            eng.resize(3)   # must drain r, not strand it
+            toks, _ = r.result(timeout=60)
+            assert toks == [3, 4, 5, 6]
+            assert eng.stats()["slots"] == 3
+
+    def test_resize_validation(self):
+        with _engine() as eng:
+            with pytest.raises(ValueError):
+                eng.resize(0)
+            assert eng.resize(2) == 2   # same size: no drain, no-op
+        with pytest.raises(RuntimeError, match="closed"):
+            eng.resize(3)
+
+    def test_autoscaler_scales_engine_slots(self):
+        with _engine() as eng:
+            scaler = Autoscaler(_policy(max_slots=3), world=1,
+                                engines=[eng])
+            d = scaler.poll_once(status=OVERLOAD)
+            assert d.action == GROW
+            assert eng.slots == 3
+            # clamped at max_slots: a further overload can't overshoot
+            scaler.poll_once(status=OVERLOAD)
+            assert eng.slots == 3
+            scaler.poll_once(status=IDLE)
+            assert eng.slots == 2
+            toks, _ = eng.submit([1]).result(timeout=60)
+            assert toks == [2, 3, 4, 5]
+
+
+# ---------------------------------------------------------------------------
+# the monitor's elastic surface
+# ---------------------------------------------------------------------------
+
+class TestMonitorElastic:
+    def _elastic_dir(self, tmp_path):
+        hb = str(tmp_path / "hb")
+        os.makedirs(hb)
+        elastic._write_once(elastic._member_path(hb, 1),
+                            {"epoch": 1, "members": [0, 1], "world": 2})
+        return hb
+
+    def test_elastic_fields_and_pending_join_alert(self, tmp_path):
+        hb = self._elastic_dir(tmp_path)
+        elastic.request_join(hb, 2, 1)
+        HeartbeatWriter(hb, 2, interval=60.0).beat()
+        status = monitor.collect_status(str(tmp_path), hb_dir=hb)
+        assert status["elastic_world_size"] == 2
+        assert status["membership_epoch"] == 1
+        assert status["pending_joins"] == 1
+        code, msg = monitor.check_alert(status, "pending_joins>0")
+        assert code == 1 and "TRIPPED" in msg
+        code, _msg = monitor.check_alert(status, "elastic_world_size<2")
+        assert code == 0
+        text = monitor.render_status(status)
+        assert "elastic: world=2" in text and "pending_joins=1" in text
+
+    def test_pending_ignores_members_and_the_dead(self, tmp_path):
+        hb = self._elastic_dir(tmp_path)
+        elastic.request_join(hb, 0, 1)   # already a member
+        elastic.request_join(hb, 3, 1)   # posted, then died: no beat
+        status = monitor.collect_status(str(tmp_path), hb_dir=hb)
+        assert status["pending_joins"] == 0
+        code, _ = monitor.check_alert(status, "pending_joins>0")
+        assert code == 0
+
+    def test_last_autoscale_decision_surfaces(self, tmp_path):
+        hb = self._elastic_dir(tmp_path)
+        with open(str(tmp_path / "journal-r0-1.jsonl"), "w") as f:
+            for action, ts in (("no-op", 10.0), ("grow", 20.0)):
+                f.write(json.dumps(
+                    {"schema": 1, "ts": ts, "rank": 0,
+                     "kind": "autoscale", "action": action,
+                     "reason": "p99 breach", "world": 2,
+                     "target_world": 3}) + "\n")
+        status = monitor.collect_status(str(tmp_path), hb_dir=hb)
+        assert status["autoscale"]["action"] == "grow"
+        assert status["autoscale"]["reason"] == "p99 breach"
+        text = monitor.render_status(status)
+        assert "autoscale: grow (p99 breach)" in text

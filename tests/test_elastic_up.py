@@ -1,0 +1,391 @@
+"""Elastic scale-UP (ISSUE 17): the join protocol (write-once request /
+admit / ready files), the leader's warm-up admission state machine —
+including the pinned guarantee that a joiner dying mid-warm-up never
+stalls the fleet — epoch-scoped GC of protocol files, and upward
+reshard round-trips (N -> N+1 / N+2) held to the same bit-exact
+gather-then-scatter standard as the downward ones.
+
+The full kill-relaunch-regrow drill lives in ``tools/chaos --elastic
+--rejoin`` (subprocess cluster); these tests exercise the pieces
+hermetically.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.executor import Scope, scope_guard
+from paddle_tpu.models import ctr
+from paddle_tpu.resilience import checkpoint, elastic, reshard
+from paddle_tpu.resilience.checkpoint import TopologyMismatchError
+from paddle_tpu.resilience.watchdog import HeartbeatWriter
+
+
+def _beat(dirname, rank):
+    """One manual heartbeat (no thread, no done marker)."""
+    HeartbeatWriter(dirname, rank, interval=60.0).beat()
+
+
+# ---------------------------------------------------------------------------
+# join protocol files
+# ---------------------------------------------------------------------------
+
+class TestJoinProtocol:
+    def test_request_join_is_write_once(self, tmp_path):
+        d = str(tmp_path)
+        first = elastic.request_join(d, 5, 3)
+        second = elastic.request_join(d, 5, 3)
+        # the repost reads the winner's record — never clobbers it
+        assert first == second and second["rank"] == 5
+        assert second["epoch"] == 3
+
+    def test_pending_joins_requires_a_fresh_heartbeat(self, tmp_path):
+        d = str(tmp_path)
+        elastic.request_join(d, 5, 0)
+        elastic.request_join(d, 6, 0)   # posted, then died: no beat
+        _beat(d, 5)
+        assert elastic.pending_joins(d, 0) == [5]
+        # the same joiner gone silent drops out of the next round
+        assert elastic.pending_joins(d, 0, stale_timeout=5.0,
+                                     now=time.time() + 100.0) == []
+        # requests against another epoch are not this epoch's pending
+        assert elastic.pending_joins(d, 1) == []
+
+    def test_latest_epoch(self, tmp_path):
+        d = str(tmp_path)
+        assert elastic.latest_epoch(d) == (None, None)
+        for epoch in (0, 3):
+            elastic._write_once(
+                elastic._member_path(d, epoch),
+                {"epoch": epoch, "members": [0, 1], "world": 2})
+        epoch, rec = elastic.latest_epoch(d)
+        assert epoch == 3 and rec["members"] == [0, 1]
+        # a newer record mid-publish: epoch visible, record not yet
+        with open(elastic._member_path(d, 7), "w") as f:
+            f.write("{torn")
+        assert elastic.latest_epoch(d) == (7, None)
+
+    def test_join_kill_switch(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_TPU_ELASTIC_JOIN", raising=False)
+        assert elastic.join_enabled()
+        monkeypatch.setenv("PADDLE_TPU_ELASTIC_JOIN", "0")
+        assert not elastic.join_enabled()
+
+
+# ---------------------------------------------------------------------------
+# epoch-scoped GC (satellite: the stale-file leak fix)
+# ---------------------------------------------------------------------------
+
+class TestEpochGC:
+    def _populate(self, d, epochs):
+        names = []
+        for e in epochs:
+            for path in (elastic._member_path(d, e),
+                         elastic._join_path(d, e, 7),
+                         elastic._admit_path(d, e),
+                         elastic._ready_path(d, e, 7)):
+                with open(path, "w") as f:
+                    json.dump({"epoch": e}, f)
+                names.append(os.path.basename(path))
+            gname = elastic._grad_fname(e, 4, 0)
+            with open(os.path.join(d, gname), "wb") as f:
+                f.write(b"x")
+            names.append(gname)
+        return names
+
+    def test_three_epoch_run_leaves_two(self, tmp_path):
+        d = str(tmp_path)
+        self._populate(d, (0, 1, 2))
+        removed = elastic.gc_epoch_files(d, 2)
+        # the current AND previous epoch survive; epoch 0 is reclaimed
+        left = {elastic._protocol_epoch(n) for n in os.listdir(d)}
+        assert left == {1, 2}
+        assert all(elastic._protocol_epoch(n) == 0 for n in removed)
+        assert len(removed) == 5  # one per family at epoch 0
+
+    def test_gc_is_idempotent_and_returns_names(self, tmp_path):
+        d = str(tmp_path)
+        self._populate(d, (0, 1, 2, 3))
+        first = elastic.gc_epoch_files(d, 3)
+        assert sorted(first) == first and len(first) == 10
+        assert elastic.gc_epoch_files(d, 3) == []
+
+    def test_hb_files_of_nonmembers_reclaimed_past_grace(self,
+                                                         tmp_path):
+        d = str(tmp_path)
+        _beat(d, 0)   # member: always kept
+        _beat(d, 7)   # long-gone ex-member
+        _beat(d, 9)   # pending joiner, still beating
+        old = time.time() - 1000.0
+        os.utime(os.path.join(d, "hb-7"), (old, old))
+        removed = elastic.gc_epoch_files(d, 5, members=[0],
+                                         hb_grace=60.0)
+        assert removed == ["hb-7"]
+        assert os.path.exists(os.path.join(d, "hb-0"))
+        assert os.path.exists(os.path.join(d, "hb-9"))
+        # without the grace argument heartbeats are never touched
+        os.utime(os.path.join(d, "hb-9"), (old, old))
+        assert elastic.gc_epoch_files(d, 5) == []
+
+    def test_adopting_an_epoch_garbage_collects_behind_it(self,
+                                                          tmp_path):
+        tr = elastic.ElasticTrainer(None, None, None, rank=0, world=1,
+                                    workdir=str(tmp_path))
+        self._populate(tr.hb_dir, (0, 1, 2))
+        tr._adopt_membership(elastic.Membership(
+            epoch=2, members=[0], world=1, lost=[], writer=0))
+        left = {elastic._protocol_epoch(n)
+                for n in os.listdir(tr.hb_dir)}
+        left.discard(None)  # hb files of the adopting rank
+        assert left == {1, 2}
+
+
+# ---------------------------------------------------------------------------
+# the leader's admission state machine
+# ---------------------------------------------------------------------------
+
+class TestAdmission:
+    def _leader(self, tmp_path, **kw):
+        kw.setdefault("stale_timeout", 0.2)
+        kw.setdefault("hb_interval", 0.05)
+        kw.setdefault("warmup_timeout", 30.0)
+        return elastic.ElasticTrainer(None, None, None, rank=0,
+                                      world=1, workdir=str(tmp_path),
+                                      **kw)
+
+    def test_admission_round_finalizes_with_start_step(self, tmp_path):
+        tr = self._leader(tmp_path)
+        tr.step = 4
+        _beat(tr.hb_dir, 5)
+        elastic.request_join(tr.hb_dir, 5, 0)
+        tr._maybe_admit()
+        # phase 1: write-once admit record naming members + joiners
+        adm = json.load(open(elastic._admit_path(tr.hb_dir, 1)))
+        assert adm["members"] == [0] and adm["joiners"] == [5]
+        assert tr._pending_member is None  # not finalized yet
+        # the joiner finishes warm-up and acks ready
+        elastic._write_once(elastic._ready_path(tr.hb_dir, 1, 5),
+                            {"rank": 5})
+        tr.step = 6
+        tr._maybe_admit()
+        rec = tr._pending_member
+        assert rec is not None
+        assert rec["members"] == [0, 5] and rec["reason"] == "grow"
+        assert rec["joined"] == [5]
+        # two boundaries out: the lockstep exchange makes it race-free
+        assert rec["start_step"] == 8
+
+    def test_joiner_dying_midwarmup_never_stalls_the_fleet(self,
+                                                           tmp_path):
+        """Acceptance pin: an admitted joiner that dies before its
+        ready ack is evicted by heartbeat staleness and admission rolls
+        forward — the fleet keeps stepping, transitions to an epoch
+        bump only, and the NEXT joiner is admitted normally."""
+        tr = self._leader(tmp_path)
+        _beat(tr.hb_dir, 5)
+        elastic.request_join(tr.hb_dir, 5, 0)
+        tr._maybe_admit()
+        assert tr._admission is not None
+        # the fleet keeps stepping at the old epoch while warm-up runs
+        for _ in range(3):
+            tr.step += 1
+            tr._maybe_admit()
+            assert tr.epoch == 0 and tr._pending_member is None
+        # the joiner dies: heartbeat goes stale, no ready ack ever
+        time.sleep(0.5)
+        tr.step += 1
+        tr._maybe_admit()
+        rec = tr._pending_member
+        assert rec is not None
+        assert rec["members"] == [0] and rec["joined"] == []
+        # the transition is an epoch bump only — re-plan/restore would
+        # be a stall (and would crash this programless trainer)
+        def _boom(*_a, **_k):
+            raise AssertionError("no-grow transition must not re-plan")
+        tr._plan = _boom
+        tr._restore = _boom
+        tr._checkpoint_now = _boom
+        tr.step = int(rec["start_step"])
+        tr._maybe_transition()
+        assert tr.epoch == 1 and tr.members == [0]
+        assert tr._pending_member is None and tr._admission is None
+        # ...and admission rolls forward: the next joiner gets in
+        _beat(tr.hb_dir, 6)
+        elastic.request_join(tr.hb_dir, 6, 1)
+        tr._maybe_admit()
+        adm = json.load(open(elastic._admit_path(tr.hb_dir, 2)))
+        assert adm["joiners"] == [6]
+
+    def test_warmup_budget_exhaustion_evicts_a_wedged_joiner(self,
+                                                             tmp_path):
+        tr = self._leader(tmp_path, warmup_timeout=0.05)
+        _beat(tr.hb_dir, 5)
+        elastic.request_join(tr.hb_dir, 5, 0)
+        tr._maybe_admit()
+        time.sleep(0.1)
+        _beat(tr.hb_dir, 5)  # alive but wedged: fresh beat, no ready
+        tr._maybe_admit()
+        rec = tr._pending_member
+        assert rec is not None and rec["members"] == [0]
+
+    def test_no_admission_without_headroom(self, tmp_path):
+        tr = self._leader(tmp_path)
+        tr._total_steps, tr.step = 10, 6
+        _beat(tr.hb_dir, 5)
+        elastic.request_join(tr.hb_dir, 5, 0)
+        tr._maybe_admit()   # step + 4 >= total: too late to warm up
+        assert tr._admission is None
+        assert not os.path.exists(elastic._admit_path(tr.hb_dir, 1))
+
+    def test_kill_switch_freezes_admission(self, tmp_path,
+                                           monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_ELASTIC_JOIN", "0")
+        tr = self._leader(tmp_path)
+        _beat(tr.hb_dir, 5)
+        elastic.request_join(tr.hb_dir, 5, 0)
+        tr._maybe_admit()
+        assert tr._admission is None
+        assert not os.path.exists(elastic._admit_path(tr.hb_dir, 1))
+
+
+# ---------------------------------------------------------------------------
+# upward reshard round-trips: save at N, restore at N+1 / N+2
+# ---------------------------------------------------------------------------
+
+VOCAB = 64
+N_SLOTS, SLOT_LEN, DENSE = 2, 3, 4
+
+
+def _build_sharded(lr=0.05):
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        slots = [
+            fluid.layers.data("slot%d" % i, shape=[SLOT_LEN],
+                              dtype="int64")
+            for i in range(N_SLOTS)
+        ]
+        dense = fluid.layers.data("dense", shape=[DENSE],
+                                  dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="int64")
+        loss, _prob = ctr.wide_deep(
+            slots, dense, label, vocab=VOCAB, embed_dim=8,
+            hidden=(8,), is_distributed=True)
+        fluid.optimizer.Adam(learning_rate=lr).minimize(loss)
+    return main, startup, loss
+
+
+def _ctr_feed(rng, bs=16):
+    feed = {
+        "slot%d" % i: rng.randint(0, VOCAB, (bs, SLOT_LEN))
+        .astype("int64") for i in range(N_SLOTS)
+    }
+    feed["dense"] = rng.randn(bs, DENSE).astype("float32")
+    feed["label"] = rng.randint(0, 2, (bs, 1)).astype("int64")
+    return feed
+
+
+def _gathered_shards(path):
+    """Gather reference: reassemble every ``<var>.shards`` dir by
+    concatenating the shard files in row order, independent of the
+    reshard code under test."""
+    full = {}
+    for root, dirs, _files in os.walk(path):
+        for d in list(dirs):
+            if not d.endswith(".shards"):
+                continue
+            sdir = os.path.join(root, d)
+            parts = []
+            for fname in os.listdir(sdir):
+                if not fname.startswith("shard-"):
+                    continue
+                start = int(fname[len("shard-"):].split("_", 1)[0])
+                parts.append((start,
+                              np.load(os.path.join(sdir, fname))))
+            parts.sort(key=lambda p: p[0])
+            full[d[:-len(".shards")]] = np.concatenate(
+                [a for _s, a in parts], axis=0)
+    return full
+
+
+class TestUpwardReshard:
+    def _save_at_8(self, tmp_path):
+        root = str(tmp_path / "ckpt")
+        main, startup, loss = _build_sharded()
+        exe = fluid.Executor(fluid.CPUPlace())
+        rng = np.random.RandomState(13)
+        with scope_guard(Scope()):
+            exe.run(startup)
+            prog = fluid.CompiledProgram(main).with_data_parallel(
+                loss_name=loss.name)
+            for _ in range(2):
+                exe.run(prog, feed=_ctr_feed(rng), fetch_list=[])
+            path = checkpoint.save_checkpoint(
+                exe, root, main_program=main, step=2,
+                state={"step": 2},
+                topology={"world": 8, "zero1": True})
+        return root, path, main, startup, exe
+
+    def test_restore_grown_bit_exact(self, tmp_path):
+        root, path, main, startup, exe = self._save_at_8(tmp_path)
+        before = _gathered_shards(path)
+        # the is_distributed table and its Adam moments (ZeRO-1 rows)
+        assert any("emb" in n for n in before)
+        assert sum("moment" in n for n in before) >= 2
+
+        for new_world in (9, 10):   # N+1, then N+2 chained on top
+            report = reshard.reshard_checkpoint(
+                path, {"world": new_world, "zero1": True})
+            assert sorted(e["var"] for e in report) == sorted(before)
+            manifest = checkpoint.verify_checkpoint(path)
+            assert manifest["topology"]["world"] == new_world
+            after = _gathered_shards(path)
+            for name, ref in before.items():
+                # gather-then-scatter: bit-identical through chained
+                # upward reshards, sliced to the grown world's rows
+                assert after[name].dtype == ref.dtype
+                np.testing.assert_array_equal(after[name], ref)
+                bounds = [b for b in reshard.shard_bounds(
+                    ref.shape[0], new_world) if b[0] != b[1]]
+                entry = [e for e in report if e["var"] == name][0]
+                assert entry["new_files"] == len(bounds)
+
+        # the grown version restores on a fresh scope
+        with scope_guard(Scope()):
+            exe.run(startup)
+            info = checkpoint.try_load_latest_checkpoint(
+                exe, root, main_program=main,
+                expected_topology={"world": 10, "zero1": True})
+            assert info is not None and info.step == 2
+        # ... and the pre-reshard topology is now rejected
+        with scope_guard(Scope()):
+            exe.run(startup)
+            with pytest.raises(TopologyMismatchError):
+                checkpoint.try_load_latest_checkpoint(
+                    exe, root, main_program=main,
+                    expected_topology={"world": 8, "zero1": True})
+
+    def test_gate_clears_after_upward_reshard(self, tmp_path):
+        """A grown world hits the topology gate as a TYPED error until
+        the reshard runs — then the same load succeeds."""
+        root, path, main, startup, exe = self._save_at_8(tmp_path)
+        grown = {"world": 9, "zero1": True}
+        with scope_guard(Scope()):
+            exe.run(startup)
+            with pytest.raises(TopologyMismatchError) as ei:
+                checkpoint.try_load_latest_checkpoint(
+                    exe, root, main_program=main,
+                    expected_topology=grown)
+        assert ei.value.expected["world"] == 9
+        reshard.reshard_checkpoint(path, grown)
+        with scope_guard(Scope()):
+            exe.run(startup)
+            info = checkpoint.try_load_latest_checkpoint(
+                exe, root, main_program=main,
+                expected_topology=grown)
+            assert info is not None and info.step == 2

@@ -230,6 +230,11 @@ def build_steps():
     # bert_overlap_exposed_wire_cut (gate >= 0.25, proofs must PASS)
     # and overlap_collective_loss_delta (gate == 0.0, bit-exact)
     item("bench_overlap", "overlap", 420, 360)
+    # ISSUE-17 elastic scale-up: the rejoin drill on real chips — kill
+    # a worker mid-run, relaunch it with --join, fleet grows back to
+    # the full world; emits elastic_rejoin_ms (vs the 60s restart
+    # budget) + autoscale_decision_correct (SLO policy triple gate)
+    item("bench_autoscale", "autoscale", 480, 420)
     # space-to-depth stem (models/resnet.py _s2d_stem): folds the 7x7
     # stride-2 3-channel stem — the classic MXU-underfill — into a
     # dense 4x4/s1 conv over 12 channels (the TPU ResNet stem recipe)
