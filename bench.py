@@ -2162,6 +2162,220 @@ def child_overlap():
               "(max delta %.3e)" % delta, flush=True)
 
 
+def child_hierarchy():
+    """Hierarchical-collective A/B (ISSUE 18): the BERT trainer's
+    gradient ring flat across a virtual 2-tier mesh (chips=8 in 2
+    slices, DCN between them) vs the reduce-scatter / cross-slice
+    allreduce / allgather decomposition with the DCN hop
+    int8-quantized.
+
+    Two gates:
+
+    * ``bert_base_slow_tier_byte_cut`` — the analyzer-priced DCN-tier
+      wire bytes of the flat fused ring divided by the hierarchical +
+      per-tier-int8 schedule's, on the SAME transpiled program.  The
+      tier math promises ~2(n-1)/n : 2(1/c)(s-1)/s = 7x at c=4, s=2
+      before quantization; the gate is >= 1.8.
+    * ``hierarchy_collective_loss_delta`` — twin short training runs
+      through the REAL decomposed collectives on a 4-worker shard_map
+      mesh (2 slices x 2 chips, the virtual 2-tier mesh), hierarchy
+      engaged vs the flat ring, same seeds and feeds.  The float-sum
+      decomposition is order-fixed (ascending slice), so the losses
+      must match the flat schedule BIT-EXACTLY."""
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as fluid
+    from paddle_tpu.executor import Scope, scope_guard
+    from paddle_tpu.models import bert
+    from paddle_tpu.parallel.planner import ClusterSpec
+    from paddle_tpu.static_analysis.cost import estimate_cost
+    from paddle_tpu.static_analysis.fusion import resolve_fused_program
+    from paddle_tpu.transpiler.collective import GradAllReduce
+
+    dev = jax.devices()[0]
+    on_tpu = _is_tpu_platform(dev.platform)
+    ndev = len(jax.devices())
+    cfg = bert.BERT_BASE if on_tpu else bert.BERT_TINY
+    seq = 128 if on_tpu else 32
+    model_name = "bert_base" if on_tpu else "bert_tiny"
+    dev_name = "cpu" if os.environ.get("PADDLE_BENCH_FORCE_CPU") else \
+        jax_backend_name()
+    spec = {"chips": 8, "slices": 2, "ici_gbps": 1200.0,
+            "dcn_gbps": 25.0, "launch_us": 5.0, "dcn_launch_us": 50.0}
+    cluster = ClusterSpec.coerce(spec)
+    nranks = cluster.chips
+
+    flat_env = {"PADDLE_TPU_HIERARCHY": "0", "PADDLE_TPU_QUANT": "0"}
+    hier_env = {"PADDLE_TPU_HIERARCHY": "1", "PADDLE_TPU_QUANT": "1",
+                "PADDLE_TPU_QUANT_MIN_BYTES": "1"}
+    saved = {k: os.environ.get(k) for k in
+             set(flat_env) | set(hier_env)}
+
+    def with_env(env, fn):
+        os.environ.update(env)
+        try:
+            return fn()
+        finally:
+            for k in env:
+                v = saved.get(k)
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    # ---- arm 1: analyzer-priced slow-tier bytes on the 2-tier twin --
+    fluid.unique_name.switch()
+    main, startup, feeds, loss = bert.build_pretrain(
+        cfg, seq_len=seq, lr=1e-4, train=True)
+    GradAllReduce().transpile(program=main, startup_program=startup,
+                              rank=0, nranks=nranks)
+    main._num_trainers = nranks
+    main._cluster_spec = dict(spec)
+
+    def dcn_bytes(env):
+        def run():
+            fused, _ = resolve_fused_program(main, targets=[loss.name])
+            report = estimate_cost(fused, nranks=nranks,
+                                   targets=[loss.name])
+            return report.ici_bytes_per_tier(cluster).get("dcn", 0)
+        return with_env(env, run)
+
+    flat_dcn = dcn_bytes(flat_env)
+    hier_dcn = dcn_bytes(hier_env)
+    byte_cut = (flat_dcn / hier_dcn) if hier_dcn else 0.0
+    print(json.dumps({
+        "metric": "bert_base_slow_tier_byte_cut",
+        "value": round(byte_cut, 4),
+        "unit": "x flat/hierarchical DCN-tier bytes (%s seq%d, "
+                "chips=%d in %d slices, per-tier int8 on the cross "
+                "hop, analyzer-priced, %s; gate >= 1.8)"
+                % (model_name, seq, nranks, cluster.slices, dev_name),
+        "flat_dcn_bytes": int(flat_dcn),
+        "hier_dcn_bytes": int(hier_dcn),
+        "vs_baseline": round(byte_cut, 3),
+    }), flush=True)
+    if byte_cut < 1.8:
+        print("# FAIL: slow-tier byte cut %.3f < 1.8 gate" % byte_cut,
+              flush=True)
+
+    # ---- arm 2: twin training through the decomposed collectives ----
+    # 4 workers = 2 slices x 2 chips: the smallest mesh where both the
+    # intra-slice reduce-scatter/allgather AND the cross-slice hop are
+    # real collectives.  GSPMD with_data_parallel is identity here, so
+    # the twins run per-worker op interpretation under shard_map — the
+    # same path the multi-process fleet runtime drives.
+    if ndev < 4:
+        print("# hierarchy loss-delta arm skipped: needs >=4 devices "
+              "(driver passes --xla_force_host_platform_device_count)",
+              flush=True)
+        return
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from paddle_tpu.executor import _run_ops_into_env, global_scope
+    from paddle_tpu.jax_compat import shard_map
+    from paddle_tpu.ops import registry as op_registry
+
+    steps = 6
+    feats, hidden = 16, 64
+    half = 8
+    nw = 4
+
+    def twin_losses(hier):
+        def run():
+            fluid.unique_name.switch()
+            m, s = fluid.Program(), fluid.Program()
+            m.random_seed = s.random_seed = 77
+            with fluid.program_guard(m, s):
+                x = fluid.layers.data("x", shape=[feats],
+                                      dtype="float32")
+                y = fluid.layers.data("y", shape=[1], dtype="float32")
+                h = fluid.layers.fc(x, size=hidden, act="relu")
+                p = fluid.layers.fc(h, size=1)
+                l = fluid.layers.reduce_mean(
+                    fluid.layers.square(p - y))
+                fluid.optimizer.SGD(learning_rate=1e-2).minimize(l)
+            GradAllReduce().transpile(program=m, startup_program=s,
+                                      rank=0, nranks=nw)
+            m._num_trainers = nw
+            m._hierarchy = ({"chips_per_slice": 2} if hier else False)
+            fused, _ = resolve_fused_program(m, targets=[l.name])
+            fblock = fused.global_block()
+            kinds = [op.type for op in fblock.ops
+                     if "allreduce" in op.type or "hier" in op.type]
+            exe = fluid.Executor()
+            with scope_guard(Scope()):
+                exe.run(s)
+                params = {}
+                for v in m.list_vars():
+                    if not v.persistable:
+                        continue
+                    val = global_scope().get(v.name)
+                    if val is not None:
+                        params[v.name] = np.asarray(val)
+            pnames = sorted(params)
+            mesh = Mesh(np.array(jax.devices()[:nw]), ("dp",))
+
+            def per_worker(pvals, xb, yb):
+                ctx = op_registry.LoweringContext(mode="train")
+                ctx.collective_axis = "dp"
+                envd = {n: v[0] for n, v in zip(pnames, pvals)}
+                envd["x"], envd["y"] = xb[0], yb[0]
+                _run_ops_into_env(fblock, envd, ctx)
+                return ([envd[n][None] for n in pnames],
+                        envd[l.name].reshape(1))
+
+            step_fn = jax.jit(shard_map(
+                per_worker, mesh=mesh,
+                in_specs=([P("dp")] * len(pnames), P("dp"), P("dp")),
+                out_specs=([P("dp")] * len(pnames), P("dp"))))
+            lrng = np.random.RandomState(4321)
+            vals = [np.tile(params[n][None],
+                            (nw,) + (1,) * params[n].ndim)
+                    for n in pnames]
+            out = []
+            for _ in range(steps):
+                xb = lrng.randn(nw, half, feats).astype("float32")
+                yb = (xb.mean(axis=2, keepdims=True)
+                      + 0.05 * lrng.randn(nw, half, 1)).astype(
+                          "float32")
+                vals, lv = step_fn([jnp.asarray(v) for v in vals],
+                                   jnp.asarray(xb), jnp.asarray(yb))
+                vals = [np.asarray(v) for v in vals]
+                out.append(float(np.mean(np.asarray(lv))))
+            return out, kinds
+        return with_env(flat_env if not hier
+                        else {"PADDLE_TPU_HIERARCHY": "1",
+                              "PADDLE_TPU_QUANT": "0"}, run)
+
+    flat_losses, fkinds = twin_losses(False)
+    hier_losses, hkinds = twin_losses(True)
+    if not any("hier" in k for k in hkinds):
+        raise SystemExit("hierarchy arm vacuous: fusion emitted %r, "
+                         "no c_hier_* ops" % (hkinds,))
+    if any("hier" in k for k in fkinds):
+        raise SystemExit("flat arm contaminated: %r" % (fkinds,))
+    delta = max(abs(a - b) for a, b in zip(flat_losses, hier_losses))
+    bitmatch = all(repr(a) == repr(b)
+                   for a, b in zip(flat_losses, hier_losses))
+    print(json.dumps({
+        "metric": "hierarchy_collective_loss_delta",
+        "value": round(delta, 10),
+        "unit": "max |loss_hier - loss_flat| over %d DP steps on a "
+                "4-worker 2-slice mesh (%s vs %s, %s; gate == 0.0 "
+                "bit-exact)"
+                % (steps, "/".join(sorted(set(hkinds))),
+                   "/".join(sorted(set(fkinds))), dev_name),
+        "flat_losses": [repr(x) for x in flat_losses],
+        "hier_losses": [repr(x) for x in hier_losses],
+        "bit_identical": bool(bitmatch),
+        "vs_baseline": 1.0 if bitmatch else 0.0,
+    }), flush=True)
+    if not bitmatch:
+        print("# FAIL: hierarchy twin losses not bit-identical "
+              "(max delta %.3e)" % delta, flush=True)
+
+
 def jax_backend_name():
     import jax
 
@@ -2528,7 +2742,8 @@ def main():
                 ("fusion", 150), ("kernels", 220), ("planner", 220),
                 ("observability", 150), ("tracing", 150),
                 ("serving", 200), ("decode", 200), ("elastic", 240),
-                ("quant", 220), ("overlap", 220), ("autoscale", 300)]
+                ("quant", 220), ("overlap", 220),
+                ("hierarchy", 220), ("autoscale", 300)]
         failed = []
         for mode, cap in plan:
             if remaining(cap) < 90:
@@ -2590,18 +2805,25 @@ def main():
               "hardware lines (if any)" % reason, flush=True)
         for mode in ("ctr", "bert", "fusion", "kernels", "planner",
                      "observability", "tracing", "serving", "decode",
-                     "elastic", "quant", "overlap", "autoscale"):
+                     "elastic", "quant", "overlap", "hierarchy",
+                     "autoscale"):
             env_extra = {"PADDLE_BENCH_FORCE_CPU": "1"}
             if mode in ("planner", "quant", "overlap"):
                 # the CPU smoke needs a virtual mesh for a real DP A/B
                 env_extra["XLA_FLAGS"] = (
                     os.environ.get("XLA_FLAGS", "")
                     + " --xla_force_host_platform_device_count=2")
+            elif mode == "hierarchy":
+                # 2 slices x 2 chips: the smallest 2-tier mesh
+                env_extra["XLA_FLAGS"] = (
+                    os.environ.get("XLA_FLAGS", "")
+                    + " --xla_force_host_platform_device_count=4")
             w_ok, w_lines, w_err = _run_child(
                 mode, remaining(420 if mode == "bert"
                                 else 300 if mode == "autoscale"
                                 else 240 if mode in ("elastic", "quant",
-                                                     "overlap")
+                                                     "overlap",
+                                                     "hierarchy")
                                 else 150),
                 env_extra=env_extra)
             if not w_ok:
@@ -2678,6 +2900,8 @@ if __name__ == "__main__":
             child_quant()
         elif mode == "overlap":
             child_overlap()
+        elif mode == "hierarchy":
+            child_hierarchy()
         elif mode == "serving":
             child_serving()
         elif mode == "decode":

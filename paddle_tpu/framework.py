@@ -432,7 +432,8 @@ CLONE_VAR_MARKS = ("need_check_feed", "feed_hint",
 # clones via _PROGRAM_MARKS) manage them explicitly.
 CLONE_PROGRAM_MARKS = ("_shard_optimizer_state", "_allreduce_bucket_mb",
                        "_hbm_budget", "_max_in_flight",
-                       "_serving_hot_loop", "_quant_buckets")
+                       "_serving_hot_loop", "_quant_buckets",
+                       "_hierarchy", "_cluster_spec")
 
 
 class Program:

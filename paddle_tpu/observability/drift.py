@@ -175,7 +175,7 @@ class DriftMonitor:
     # -- registration ---------------------------------------------------
 
     def register(self, key, predicted_step_ms, predicted_ici_bytes=None,
-                 predicted_peak_bytes=None):
+                 predicted_peak_bytes=None, tier_bytes=None):
         with self._lock:
             state = ProgramDrift(key, predicted_step_ms,
                                  predicted_ici_bytes,
@@ -184,6 +184,12 @@ class DriftMonitor:
             self._last_key = key
         g = _metrics.gauge("predicted_step_ms", program=key)
         g.set(predicted_step_ms)
+        # per-tier wire gauges (ici/dcn/pod) when the cluster carries a
+        # topology tree — tools.monitor surfaces these next to the
+        # drift ratios so a mis-tiered plan shows up as DCN bytes
+        for tier, nbytes in sorted((tier_bytes or {}).items()):
+            _metrics.gauge("predicted_tier_bytes", program=key,
+                           tier=tier).set(nbytes)
         return state
 
     def register_program(self, program, cluster=None, batch_size=None,
@@ -208,9 +214,16 @@ class DriftMonitor:
                 calibration=1.0)
         except Exception:  # noqa: BLE001 - analysis must not kill a run
             return None
+        tiers = None
+        if getattr(cluster, "has_topology", False):
+            try:
+                tiers = report.ici_bytes_per_tier(cluster)
+            except Exception:  # noqa: BLE001 - telemetry only
+                tiers = None
         self.register(key, price.step_ms,
                       predicted_ici_bytes=report.total_ici_bytes,
-                      predicted_peak_bytes=report.peak_memory_bytes)
+                      predicted_peak_bytes=report.peak_memory_bytes,
+                      tier_bytes=tiers)
         return key
 
     def register_report(self, report, cluster=None, key=None):
@@ -227,9 +240,16 @@ class DriftMonitor:
             ici_gbps=getattr(cluster, "ici_gbps", 100.0),
             launch_us=getattr(cluster, "launch_us", 5.0),
             calibration=1.0)
+        tiers = None
+        if getattr(cluster, "has_topology", False):
+            try:
+                tiers = report.cost.ici_bytes_per_tier(cluster)
+            except Exception:  # noqa: BLE001 - telemetry only
+                tiers = None
         self.register(key, price.step_ms,
                       predicted_ici_bytes=report.cost.total_ici_bytes,
-                      predicted_peak_bytes=report.cost.peak_memory_bytes)
+                      predicted_peak_bytes=report.cost.peak_memory_bytes,
+                      tier_bytes=tiers)
         return key
 
     def get(self, key=None):

@@ -22,6 +22,40 @@ def _axis(ctx):
     return getattr(ctx, "collective_axis", None)
 
 
+def _slice_groups(ax, c):
+    """Contiguous intra-slice groups: chips [si*c, si*c+c) per slice."""
+    from ..jax_compat import axis_size
+
+    n = axis_size(ax)
+    c = max(min(int(c), n), 1)
+    return [[si * c + i for i in range(c)] for si in range(n // c)]
+
+
+def _cross_groups(ax, s):
+    """Cross-slice groups: same intra-slice position across slices
+    (the DCN hop's participants under the contiguous-slice layout)."""
+    from ..jax_compat import axis_size
+
+    n = axis_size(ax)
+    s = max(min(int(s), n), 1)
+    c = max(n // s, 1)
+    return [[i + si * c for si in range(s)] for i in range(c)]
+
+
+def _cross_slice_sum(x, ax, attrs):
+    """Grouped cross-slice sum via all_gather + ascending-slice-order
+    add (grouped psum trips shard_map's replication checker; grouped
+    all_gather does not, and the explicit ascending sum is the same
+    bits on every member of the group)."""
+    s = int(attrs.get("comm_nranks") or attrs.get("hier_slices") or 1)
+    g = jax.lax.all_gather(x, ax,
+                           axis_index_groups=_cross_groups(ax, s))
+    acc = g[0]
+    for si in range(1, g.shape[0]):
+        acc = acc + g[si]
+    return acc
+
+
 def _allreduce(name, fn):
     @register_op(name, inputs=["X"], outputs=["Out"], no_grad=True)
     def _op(ctx, attrs, X, _fn=fn):
@@ -35,6 +69,10 @@ def _allreduce(name, fn):
         s = attrs.get("pre_scale")
         if s:
             X = X * jnp.asarray(s, X.dtype)
+        if attrs.get("hier_groups") == "cross":
+            # the DCN hop of a hierarchical decomposition: sum only
+            # across slices (this chip's chunk-shard peers)
+            return _cross_slice_sum(X, ax, attrs)
         return _fn(X, ax)
 
     return _op
@@ -99,6 +137,26 @@ def c_allreduce_quant(ctx, attrs, X):
     flat = flatten_concat(X)
     if s:
         flat = flat * jnp.asarray(s, flat.dtype)
+    if attrs.get("hier_groups") == "cross":
+        # DCN hop of a hierarchical decomposition: int8 exchange across
+        # slices only (EQuARX pays most on the slow tier).  Grouped
+        # all_gather of the quantized payload + scales, then a
+        # deterministic ascending-slice dequant-sum — identical bits on
+        # every member of the cross group.
+        from ..quant.blockwise import block_dequantize, block_quantize
+
+        q, scales = block_quantize(
+            flat, block=attrs.get("quant_block") or None, kernel=False)
+        groups = _cross_groups(
+            ax, int(attrs.get("comm_nranks") or 1))
+        gq = jax.lax.all_gather(q, ax, axis_index_groups=groups)
+        gs = jax.lax.all_gather(scales, ax, axis_index_groups=groups)
+        acc = None
+        for si in range(gq.shape[0]):
+            d = block_dequantize(gq[si], gs[si], size=flat.size,
+                                 dtype=flat.dtype, kernel=False)
+            acc = d if acc is None else acc + d
+        return {"Out": split_like(acc, X, cast=False)}
     flat = quantized_allreduce(flat, ax,
                                block=attrs.get("quant_block") or None)
     return {"Out": split_like(flat, X, cast=False)}
@@ -146,6 +204,77 @@ def c_allreduce_wait(ctx, attrs, X):
     bytes); it exists purely to pin the earliest legal consume point in
     the schedule."""
     return {"Out": list(X)}
+
+
+@register_op("c_hier_reducescatter", inputs=["X*"], outputs=["Out"],
+             no_grad=True)
+def c_hier_reducescatter(ctx, attrs, X):
+    """Intra-slice half of a hierarchical allreduce (ring 5): flatten
+    the bucket like ``c_fused_allreduce_sum``, apply the averaging
+    pre_scale, pad to a multiple of ``hier_chips`` and reduce-scatter
+    within the slice — each chip ends with its 1/c chunk of the
+    slice-local sum, ready for the cross-slice DCN hop.
+
+    GSPMD path (no shard_map axis): the triple must be net-identity
+    like the flat op, so this half just carries the padded flat buffer
+    through (no scale, no scatter) and ``c_hier_allgather`` splits it
+    back."""
+    from .common import flatten_concat
+
+    ax = _axis(ctx)
+    flat = flatten_concat(X)
+    c = int(attrs.get("hier_chips", 1))
+    total = flat.size
+    pad = -(-total // c) * c - total
+    if ax is None:
+        if pad:
+            flat = jnp.pad(flat, (0, pad))
+        return flat
+    s = attrs.get("pre_scale")
+    if s:
+        flat = flat * jnp.asarray(s, flat.dtype)
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return jax.lax.psum_scatter(
+        flat, ax, scatter_dimension=0,
+        axis_index_groups=_slice_groups(ax, c), tiled=True)
+
+
+@register_op("c_hier_allgather", inputs=["X*"], outputs=["Out*"],
+             no_grad=True)
+def c_hier_allgather(ctx, attrs, X):
+    """Intra-slice gather-back (ring 5): after the cross-slice hop the
+    chunk holds the GLOBAL sum of its shard — allgather within the
+    slice reassembles the full bucket, trims the reduce-scatter pad,
+    and splits the members back to ``attrs["member_shapes"]``.
+
+    GSPMD path: the input is the padded flat buffer the identity
+    reduce-scatter carried through — trim and split, net identity."""
+    ax = _axis(ctx)
+    flat = X[0]
+    if ax is not None:
+        c = int(attrs.get("hier_chips", 1))
+        flat = jax.lax.all_gather(
+            flat, ax, axis_index_groups=_slice_groups(ax, c),
+            tiled=True)
+    total = int(attrs.get("hier_total", flat.size))
+    if flat.size < total:
+        # metadata replay (eval_shape against the chunk var's recorded
+        # shard shape): pad so the splits below type-check — every real
+        # path (gathered shard_map shard, identity GSPMD buffer)
+        # arrives with >= total elements
+        flat = jnp.pad(flat, (0, total - flat.size))
+    flat = flat[:total]
+    outs = []
+    off = 0
+    for sh in attrs.get("member_shapes", ()):
+        shape = tuple(int(d) for d in sh)
+        k = 1
+        for d in shape:
+            k *= d
+        outs.append(flat[off:off + k].reshape(shape))
+        off += k
+    return {"Out": outs}
 
 
 @register_op("c_broadcast", inputs=["X"], outputs=["Out"], no_grad=True)

@@ -75,14 +75,33 @@ class ClusterSpec:
         {"chips": 8, "peak_tflops": 275, "hbm_gb": 16,
          "hbm_gbps": 1200, "ici_gbps": 100, "launch_us": 5,
          "topology": "ring"}
-    """
+
+    A MULTI-SLICE deployment adds the topology tree — chips within a
+    slice over ICI, slices (within a pod) over DCN, pods over the WAN
+    tier — each tier with its own bandwidth/latency::
+
+        {"chips": 8, "slices": 2, "dcn_gbps": 25, "dcn_launch_us": 50}
+
+    The flat form is the ``slices=1, pods=1`` degenerate tree, so every
+    existing spec (bare chip counts, old JSON files) coerces unchanged
+    and — because :meth:`to_dict` only emits topology fields when a
+    topology is actually declared — serializes byte-identically to
+    before the tree existed."""
 
     __slots__ = ("chips", "peak_tflops", "hbm_gb", "hbm_gbps",
-                 "ici_gbps", "launch_us", "topology")
+                 "ici_gbps", "launch_us", "topology",
+                 "slices", "dcn_gbps", "dcn_launch_us",
+                 "pods", "pod_gbps", "pod_launch_us")
+
+    #: topology-tree fields: omitted from to_dict()/repr() on flat specs
+    _TOPOLOGY_FIELDS = ("slices", "dcn_gbps", "dcn_launch_us",
+                        "pods", "pod_gbps", "pod_launch_us")
 
     def __init__(self, chips=1, peak_tflops=100.0, hbm_gb=16.0,
                  hbm_gbps=1200.0, ici_gbps=100.0, launch_us=5.0,
-                 topology="ring"):
+                 topology="ring", slices=1, dcn_gbps=25.0,
+                 dcn_launch_us=50.0, pods=1, pod_gbps=5.0,
+                 pod_launch_us=200.0):
         self.chips = max(1, int(chips))
         self.peak_tflops = float(peak_tflops)
         self.hbm_gb = float(hbm_gb)
@@ -90,10 +109,54 @@ class ClusterSpec:
         self.ici_gbps = float(ici_gbps)
         self.launch_us = float(launch_us)
         self.topology = str(topology)
+        self.slices = max(1, int(slices))
+        self.dcn_gbps = float(dcn_gbps)
+        self.dcn_launch_us = float(dcn_launch_us)
+        self.pods = max(1, int(pods))
+        self.pod_gbps = float(pod_gbps)
+        self.pod_launch_us = float(pod_launch_us)
+        if self.has_topology and self.chips % (self.slices * self.pods):
+            raise ValueError(
+                "asymmetric topology: chips=%d is not divisible by "
+                "slices×pods (%d×%d) — every slice must hold the same "
+                "chip count" % (self.chips, self.slices, self.pods))
 
     @property
     def hbm_bytes(self):
         return int(self.hbm_gb * 1024 ** 3)
+
+    # ---- the topology tree ----
+
+    @property
+    def has_topology(self):
+        """True when the spec declares more than one ICI domain."""
+        return self.slices > 1 or self.pods > 1
+
+    @property
+    def chips_per_slice(self):
+        """Chips sharing one fast (ICI) domain."""
+        return self.chips // (self.slices * self.pods)
+
+    def tier_for(self, participants):
+        """The slowest wire tier a ring of ``participants`` co-located
+        ranks crosses: ``"ici"`` inside one slice, ``"dcn"`` across
+        slices, ``"pod"`` across pods.  Flat specs answer ``"ici"`` for
+        any size."""
+        if not self.has_topology or participants <= self.chips_per_slice:
+            return "ici"
+        if self.pods > 1 and participants > self.chips // self.pods:
+            return "pod"
+        return "dcn"
+
+    def tier_wire(self):
+        """``{tier: (gbps, launch_us)}`` for the tiers this spec
+        declares, fastest first."""
+        out = {"ici": (self.ici_gbps, self.launch_us)}
+        if self.slices > 1:
+            out["dcn"] = (self.dcn_gbps, self.dcn_launch_us)
+        if self.pods > 1:
+            out["pod"] = (self.pod_gbps, self.pod_launch_us)
+        return out
 
     @classmethod
     def coerce(cls, spec):
@@ -119,11 +182,16 @@ class ClusterSpec:
         return cls(**known)
 
     def to_dict(self):
-        return {k: getattr(self, k) for k in self.__slots__}
+        flat = {k: getattr(self, k) for k in self.__slots__
+                if k not in self._TOPOLOGY_FIELDS}
+        if self.has_topology:
+            flat.update({k: getattr(self, k)
+                         for k in self._TOPOLOGY_FIELDS})
+        return flat
 
     def __repr__(self):
         return "ClusterSpec(%s)" % ", ".join(
-            "%s=%r" % (k, getattr(self, k)) for k in self.__slots__)
+            "%s=%r" % (k, v) for k, v in self.to_dict().items())
 
 
 def resolve_cluster_spec(chips=None):
@@ -137,6 +205,11 @@ def resolve_cluster_spec(chips=None):
     spec = ClusterSpec.coerce(raw) if raw else ClusterSpec()
     if chips:
         spec.chips = max(1, int(chips))
+        if spec.has_topology and spec.chips % (spec.slices * spec.pods):
+            # the fleet's actual world doesn't fill the configured tree
+            # symmetrically — degrade to a flat (single-tier) spec
+            # rather than price a topology that doesn't exist
+            spec.slices = spec.pods = 1
     return spec
 
 
@@ -245,6 +318,16 @@ def apply_plan(program, result, startup_program=None, rank=0):
         # (the mark wins over the env default in overlap_enabled()).
         # Kill switch off → axis absent → no stamp, schedule untouched.
         program._overlap = bool(getattr(cand, "overlap", False))
+    from ..static_analysis.hierarchy import hierarchy_enabled
+    if getattr(result.cluster, "has_topology", False):
+        # pin the topology the plan was priced with (the lint advisory
+        # and FusionConfig.signature read this mark) and realize the
+        # hier verdict either way when the axis was searched
+        program._cluster_spec = result.cluster.to_dict()
+        if hierarchy_enabled():
+            program._hierarchy = (
+                {"chips_per_slice": result.cluster.chips_per_slice}
+                if getattr(cand, "hier", False) else False)
     return cand
 
 
@@ -253,11 +336,11 @@ class PlanCandidate:
 
     __slots__ = ("kind", "degree", "stages", "dp_degree", "cuts",
                  "bucket_mb", "zero1", "microbatches", "quant",
-                 "overlap")
+                 "overlap", "hier")
 
     def __init__(self, kind, degree, stages=1, dp_degree=1, cuts=(),
                  bucket_mb=None, zero1=False, microbatches=1,
-                 quant=False, overlap=False):
+                 quant=False, overlap=False, hier=False):
         self.kind = kind            # single | dp | pipeline | moe | ulysses
         self.degree = int(degree)   # total chips the plan occupies
         self.stages = int(stages)
@@ -268,16 +351,19 @@ class PlanCandidate:
         self.microbatches = int(microbatches)
         self.quant = bool(quant)    # int8 block-quantized grad exchange
         self.overlap = bool(overlap)  # start/wait split allreduce schedule
+        self.hier = bool(hier)      # hierarchical RS/AR/AG decomposition
 
     def plan_key(self):
-        """Deterministic identity/tie-break key.  ``overlap=False``
-        sorts first, so a tie (no wire actually hidden) resolves to the
+        """Deterministic identity/tie-break key.  ``hier=False`` and
+        ``overlap=False`` sort first, so a tie (no slow-tier bytes
+        actually saved / no wire hidden) resolves to the flat
         synchronous schedule.  ``quant`` stays the LAST element — the
         established ``plan_key()[:-1]`` idiom for "this plan modulo the
         quant axis" keeps working."""
         return (self.kind, self.degree, self.stages, self.dp_degree,
                 self.bucket_mb if self.bucket_mb is not None else -1,
-                self.zero1, self.cuts, self.overlap, self.quant)
+                self.zero1, self.cuts, self.hier, self.overlap,
+                self.quant)
 
     def describe(self):
         if self.kind == "single":
@@ -286,6 +372,8 @@ class PlanCandidate:
             s = "dp x%d" % self.degree
             if self.zero1:
                 s += " +zero1"
+            if self.hier:
+                s += " +hier"
             if self.quant:
                 s += " +int8"
             if self.overlap:
@@ -308,6 +396,7 @@ class PlanCandidate:
             "cuts": list(self.cuts), "bucket_mb": self.bucket_mb,
             "zero1": self.zero1, "microbatches": self.microbatches,
             "quant": self.quant, "overlap": self.overlap,
+            "hier": self.hier,
             "describe": self.describe(),
         }
 
@@ -410,6 +499,30 @@ class PlanResult:
                 "to the least-memory plan)" % (self.plan.budget,))
         return "\n".join(lines)
 
+    def tier_wire_table(self):
+        """Per-ring wire rows (ring -> tier, bytes, ms, quant) of the
+        winner's REALIZED schedule — the hierarchy rewrite applied when
+        the winner carries ``hier`` — priced on the cluster's topology
+        tiers.  None when the spec is flat (no tiers to split across)
+        or no plan was chosen; ``analyze_program --plan`` prints these
+        rows in text and under ``plan.tier_wire_table`` in ``--json``."""
+        if not getattr(self.cluster, "has_topology", False):
+            return None
+        if self.plan is None or not self.worker_programs:
+            return None
+        from ..static_analysis.cost import (estimate_cost,
+                                            tier_wire_table)
+
+        cand = self.plan.candidate
+        w0 = self.worker_programs[0]
+        if getattr(cand, "hier", False):
+            w0 = _hier_proof_twin(w0, cand, self.cluster) or w0
+        try:
+            report = estimate_cost(w0, nranks=max(cand.degree, 2))
+        except Exception:  # a table, not a gate — degrade to nothing
+            return None
+        return tier_wire_table(report, self.cluster)
+
     def runtime_config(self):
         """``(BuildStrategy, env)`` realizing the chosen plan's runtime
         knobs: ZeRO-1 optimizer-state sharding and the allreduce bucket
@@ -437,6 +550,16 @@ class PlanResult:
             # silently run overlapped); kill switch off → key absent
             env["PADDLE_TPU_OVERLAP"] = \
                 "1" if getattr(c, "overlap", False) else "0"
+        from ..static_analysis.hierarchy import hierarchy_enabled
+        if getattr(self.cluster, "has_topology", False) \
+                and hierarchy_enabled():
+            # same realize-the-verdict discipline for the hierarchy
+            # axis; the spec env carries the topology the deployment's
+            # resolve needs to compute the slice groups
+            env["PADDLE_TPU_HIERARCHY"] = \
+                "1" if getattr(c, "hier", False) else "0"
+            env["PADDLE_TPU_CLUSTER_SPEC"] = json.dumps(
+                self.cluster.to_dict(), sort_keys=True)
         return bs, env
 
     def __repr__(self):
@@ -700,15 +823,30 @@ def enumerate_candidates(program, cluster, base_interp=None,
     # plans stay byte-stable against the pre-overlap planner.
     overlap_axis = (False, True) if (trainable and overlap_enabled()) \
         else (False,)
+    # hierarchical decomposition (ISSUE 18) is the fourth per-bucket
+    # dimension — only meaningful when the cluster HAS a topology and
+    # the dp ring would span slices (DP across the slow tier; the
+    # model/pipeline/bucket/quant/overlap axes stay inside the fast
+    # tier).  PADDLE_TPU_HIERARCHY=0 removes the axis entirely, and a
+    # flat (no-topology) ClusterSpec never grows it — plans stay
+    # byte-stable against the pre-hierarchy planner.
+    from ..static_analysis.hierarchy import hierarchy_enabled
+
+    hier_axis = (False, True) if (
+        trainable and hierarchy_enabled()
+        and getattr(cluster, "has_topology", False)
+        and chips > cluster.chips_per_slice) else (False,)
     for bucket in buckets:
         for q in quant_axis:
             for ov in overlap_axis:
-                cands.append(PlanCandidate("dp", chips, bucket_mb=bucket,
-                                           quant=q, overlap=ov))
-                if trainable and has_opt_state:
+                for h in hier_axis:
                     cands.append(PlanCandidate(
                         "dp", chips, bucket_mb=bucket,
-                        zero1=True, quant=q, overlap=ov))
+                        quant=q, overlap=ov, hier=h))
+                    if trainable and has_opt_state:
+                        cands.append(PlanCandidate(
+                            "dp", chips, bucket_mb=bucket,
+                            zero1=True, quant=q, overlap=ov, hier=h))
 
     # pipeline splits over searched layer boundaries
     loads, boundaries = _forward_loads(program, base_interp, base_report)
@@ -971,6 +1109,71 @@ def _quant_price_delta(report, nranks, bucket_mb):
     return delta, 3 * buckets
 
 
+def _hier_price_delta(report, cluster, nranks, bucket_mb, quant):
+    """Per-tier pricing delta of hierarchically decomposing the ring-0
+    gradient exchange on ``cluster``: returns ``(extra_tier_bytes,
+    tier_launches, extra_launches)`` or ``(None, None, 0)`` when
+    nothing decomposes.
+
+    The flat report's ring-0 ops price their FULL volume at the slow
+    tier (``_op_tier`` maps a ring of ``nranks > chips_per_slice``
+    participants to DCN); the decomposition replaces that with
+    intra-slice RS + AG (``2·B·(c-1)/c`` at ICI) plus a cross-slice
+    allreduce of the 1/c chunk (``2·(B/c)·(s-1)/s`` at DCN — int8 wire
+    when the candidate quantizes, the hop where EQuARX pays most).  So
+    the delta ADDS the ICI volume and SUBTRACTS the flat DCN volume in
+    favor of the chunk exchange."""
+    from ..quant.blockwise import quant_block
+    from ..quant.collective import quantized_wire_bytes
+    from ..static_analysis.cost import collective_ici_bytes
+
+    c = max(int(cluster.chips_per_slice), 1)
+    s = max(nranks // c, 1)
+    if s <= 1:
+        return None, None, 0
+    grad_numel = 0
+    dense_bytes = 0
+    flat_ici = 0
+    launches = 0
+    for oc in report.op_costs:
+        if oc.ici_bytes <= 0:
+            continue
+        if oc.record.op.type in ("c_allreduce_sum",
+                                 "c_fused_allreduce_sum",
+                                 "c_allreduce_quant") \
+                and (oc.ring_id in (0, None)):
+            members = oc.record.ins
+            grad_numel += sum(v.local_numel or 0 for v in members)
+            dense_bytes += sum(
+                (v.local_numel or 0) * dtype_bytes(v.dtype)
+                for v in members)
+            flat_ici += oc.ici_bytes
+            launches += 1
+    if not grad_numel:
+        return None, None, 0
+    if bucket_mb:
+        buckets = max(1, int(math.ceil(dense_bytes
+                                       / float(bucket_mb * _MB))))
+    else:
+        buckets = launches
+    chunk_numel = -(-grad_numel // c)
+    chunk_bytes = -(-dense_bytes // c)
+    # RS and AG each move the full bucket around the slice ring
+    ici_add = 2 * collective_ici_bytes("c_allgather", dense_bytes, c)
+    if quant:
+        wire, _ = quantized_wire_bytes(chunk_numel, s,
+                                       block=quant_block())
+        cross = collective_ici_bytes("c_allreduce_quant", wire, s)
+    else:
+        cross = collective_ici_bytes("c_allreduce_sum", chunk_bytes, s)
+    extra_tier = {"ici": ici_add, "dcn": cross - flat_ici}
+    tier_launches = {"dcn": buckets}
+    extra = 2 * buckets           # 3 collective phases where 1 fired
+    if quant:
+        extra += 3 * buckets      # quant/dequant kernels on the hop
+    return extra_tier, tier_launches, extra
+
+
 def _overlap_windows(worker, cand, cluster, nranks, targets,
                      batch_size=None):
     """Overlap windows of the bucketed-fusion + start/wait rewrite this
@@ -1010,6 +1213,15 @@ def _overlap_windows(worker, cand, cluster, nranks, targets,
                            fuse_optimizer=False, fuse_conv_bn_act=False,
                            fuse_embedding_gather=False)
         apply_fusion_passes(clone, cfg, targets=tkey)
+        if getattr(cand, "hier", False):
+            # a hier+overlap twin's windows come from the DECOMPOSED
+            # schedule (the remaining overlappable buckets after the
+            # hierarchy rewrite), same as the resolve-time pass order
+            from ..static_analysis.hierarchy import apply_hierarchy_pass
+
+            clone._hierarchy = {
+                "chips_per_slice": cluster.chips_per_slice}
+            apply_hierarchy_pass(clone, targets=tkey, nranks=nranks)
         ov = apply_overlap_pass(clone, targets=tkey, nranks=nranks)
         if not ov.applied:
             return ()
@@ -1035,10 +1247,17 @@ def quant_bucket_mark(cluster, nranks, dtype_nbytes=4):
     wire_per_elem = 1.0 + 4.0 / blk          # int8 + f32-scale sidecar
     saved_per_byte = max(
         (dtype_nbytes - wire_per_elem) / float(dtype_nbytes), 1e-6)
-    ici_bps = cluster.ici_gbps * 1e9
-    overhead_s = 3 * cluster.launch_us * 1e-6
+    wire_gbps, launch_us = cluster.ici_gbps, cluster.launch_us
+    if getattr(cluster, "has_topology", False) \
+            and n > cluster.chips_per_slice:
+        # the exchange crosses the slow tier: int8 breaks even where
+        # the DCN wire pays for the launch tax (EQuARX prices the hop,
+        # not the flat ring) — slower wire → smaller break-even bucket
+        wire_gbps, launch_us = cluster.tier_wire().get(
+            "dcn", (wire_gbps, launch_us))
+    overhead_s = 3 * max(launch_us, cluster.launch_us) * 1e-6
     ring = 2.0 * (n - 1) / n
-    min_bytes = overhead_s * ici_bps / (ring * saved_per_byte)
+    min_bytes = overhead_s * wire_gbps * 1e9 / (ring * saved_per_byte)
     return {"min_bytes": max(int(min_bytes), 1), "block": blk}
 
 
@@ -1084,6 +1303,8 @@ def price_worker_set(workers, cluster, cand=None, targets=(),
         launches = None
         extra_ici = 0
         extra_launches = 0
+        extra_tier = None
+        tier_launches = None
         if cand is not None:
             launches = _bucketed_launches(report, cand.bucket_mb)
             if cand.zero1:
@@ -1092,10 +1313,24 @@ def price_worker_set(workers, cluster, cand=None, targets=(),
                 # (no op in the IR carries it — charge it here)
                 extra_ici = _param_allgather_bytes(w, cand.degree)
                 extra_launches = 1 if extra_ici else 0
-            if getattr(cand, "quant", False):
+            if getattr(cand, "hier", False):
+                # hierarchical decomposition reprices the ring-0
+                # exchange per tier (the quant axis folds into the
+                # cross-slice hop, so _quant_price_delta is skipped)
+                extra_tier, tier_launches, hl = _hier_price_delta(
+                    report, cluster, nranks, cand.bucket_mb,
+                    getattr(cand, "quant", False))
+                extra_launches += hl
+            elif getattr(cand, "quant", False):
                 qd, ql = _quant_price_delta(report, nranks,
                                             cand.bucket_mb)
-                extra_ici += qd
+                if getattr(cluster, "has_topology", False) \
+                        and cluster.tier_for(nranks) != "ici":
+                    # the flat ring spans the slow tier: the int8 byte
+                    # cut applies where those bytes are priced
+                    extra_tier = {cluster.tier_for(nranks): qd}
+                else:
+                    extra_ici += qd
                 extra_launches += ql
             if getattr(cand, "overlap", False):
                 # exact windows from the rewrite this candidate runs
@@ -1110,7 +1345,8 @@ def price_worker_set(workers, cluster, cand=None, targets=(),
                 # byte-identical
                 wkey = (cand.kind, cand.degree, cand.dp_degree,
                         cand.bucket_mb,
-                        bool(getattr(cand, "quant", False)))
+                        bool(getattr(cand, "quant", False)),
+                        bool(getattr(cand, "hier", False)))
                 windows = None if _window_cache is None \
                     else _window_cache.get(wkey)
                 if windows is None:
@@ -1131,7 +1367,10 @@ def price_worker_set(workers, cluster, cand=None, targets=(),
             schedule_factor=schedule_factor,
             collective_launches=launches,
             extra_ici_bytes=extra_ici,
-            extra_launches=extra_launches))
+            extra_launches=extra_launches,
+            cluster=cluster,
+            extra_tier_bytes=extra_tier,
+            tier_launches=tier_launches))
     if len(prices) == 1:
         return reports, prices[0]
     return reports, _combine_prices(prices)
@@ -1143,7 +1382,8 @@ def _overlap_twin_key(cand):
     reuse."""
     return (cand.kind, cand.degree, cand.stages, cand.dp_degree,
             tuple(cand.cuts or ()), cand.bucket_mb, cand.zero1,
-            cand.microbatches, getattr(cand, "quant", False))
+            cand.microbatches, getattr(cand, "quant", False),
+            getattr(cand, "hier", False))
 
 
 def _price_candidate(program, startup_program, cand, cluster, targets,
@@ -1181,7 +1421,41 @@ def _price_candidate(program, startup_program, cand, cluster, targets,
 # the proof, scoped per ring family
 # ---------------------------------------------------------------------------
 
-def _prove(cand, workers, batch_size=None):
+def _hier_proof_twin(worker, cand, cluster):
+    """The decomposed schedule a ``hier`` candidate actually runs: a
+    throwaway resolve twin (allreduce bucketing + the hierarchy pass,
+    exactly the resolve-time order) whose rings 5/6 the deadlock proof
+    extracts.  Returns None when the rewrite yields nothing — the
+    proof then covers the flat schedule the candidate degrades to."""
+    from ..static_analysis.fusion import FusionConfig, \
+        apply_fusion_passes
+    from ..static_analysis.hierarchy import apply_hierarchy_pass
+    from ..static_analysis.verifier import set_pass_verification
+
+    prev = set_pass_verification(False)
+    try:
+        clone = worker.clone()
+        clone._num_trainers = cand.degree
+        clone._allreduce_bucket_mb = cand.bucket_mb
+        clone._hierarchy = {"chips_per_slice": cluster.chips_per_slice}
+        if getattr(cand, "quant", False):
+            clone._quant_buckets = quant_bucket_mark(cluster,
+                                                     cand.degree)
+        cfg = FusionConfig(enabled=True, fuse_attention=False,
+                           fuse_elewise=False, fuse_softmax_xent=False,
+                           fuse_optimizer=False, fuse_conv_bn_act=False,
+                           fuse_embedding_gather=False)
+        apply_fusion_passes(clone, cfg, targets=())
+        if not apply_hierarchy_pass(clone, nranks=cand.degree):
+            return None
+        return clone
+    except Exception:  # the proof must degrade to flat, never crash
+        return None
+    finally:
+        set_pass_verification(prev)
+
+
+def _prove(cand, workers, batch_size=None, cluster=None):
     """Deadlock-freedom proof for one candidate's worker set.
 
     Symmetric plans (dp / moe / ulysses / single) and pure pipelines go
@@ -1199,7 +1473,13 @@ def _prove(cand, workers, batch_size=None):
     emissions) of the same program.
     """
     if cand.kind != "pipeline":
-        s0 = extract_collective_schedule(workers[0], worker=0,
+        w0 = workers[0]
+        if getattr(cand, "hier", False) and cluster is not None \
+                and getattr(cluster, "has_topology", False):
+            # prove the DECOMPOSED schedule (rings 5/6), not the flat
+            # emission the resolve-time rewrite replaces
+            w0 = _hier_proof_twin(w0, cand, cluster) or w0
+        s0 = extract_collective_schedule(w0, worker=0,
                                          nranks=cand.degree,
                                          batch_size=batch_size)
         schedules = [s0] * cand.degree
@@ -1289,7 +1569,7 @@ def auto_transpile(program, cluster_spec, startup_program=None,
         # only the accepted WINNER pays a full symmetric emission
         workers, startups = realized[pc.candidate.plan_key()]
         sch, diags = _prove(pc.candidate, workers,
-                            batch_size=batch_size)
+                            batch_size=batch_size, cluster=cluster)
         if diags:
             pc.deadlock = "divergent"
             pc.status = "rejected: %s" % diags[0].message

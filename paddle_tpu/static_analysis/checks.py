@@ -98,6 +98,14 @@ quantized                             cost model prices as an int8
                                       bf16 (no plan mark / env
                                       threshold, kill switch, or
                                       uncalibrated autotune family)
+collective-crosses-slow-    INFO      ring-0 gradient exchange whose
+tier                                  ring spans slices carrying >=
+                                      threshold bytes flat across the
+                                      DCN tier (rewrite disabled, plan
+                                      mark pins flat, asymmetric
+                                      topology, or no topology in
+                                      ClusterSpec), with the priced
+                                      per-tier delta in the hint
 collective-start-without-   ERROR     c_allreduce_start with no
 wait                                  matching c_allreduce_wait after
                                       it — the in-flight reduction is
@@ -1089,6 +1097,156 @@ def check_quantizable_bucket_not_quantized(ctx):
                    mark["min_bytes"], reason),
                 block_idx=0, op_idx=bucket[0][0],
                 var_names=(bucket[0][1],), hint=hint)
+
+
+@register_check("collective-crosses-slow-tier")
+def check_collective_crosses_slow_tier(ctx):
+    """Advisory twin of the hierarchical-collective rewrite
+    (``static_analysis/hierarchy.py``): ring-0 gradient buckets that
+    will cross the cluster's slow (DCN) tier as a flat single-ring
+    exchange — because the rewrite is disabled, the plan mark pins the
+    flat schedule, the topology is asymmetric, or no topology is
+    stamped at all so the tier split cannot engage.  Mirrors
+    ``fusible-pattern-not-fused`` reason discipline; the hint carries
+    the priced per-tier byte/ms delta of the reduce-scatter /
+    cross-slice allreduce / allgather decomposition."""
+    import os
+
+    from .cost import collective_ici_bytes, dtype_bytes
+    from .fusion import allreduce_bucket_mb
+    from .hierarchy import (HIER_OP_TYPES, hierarchy_enabled,
+                            hierarchy_min_bytes, hierarchy_topology)
+
+    block = ctx.program.global_block()
+    nranks = (len(ctx.workers) if ctx.workers
+              else int(getattr(ctx.program, "_num_trainers", 0) or 0))
+    groups = {}
+    for i, op in enumerate(block.ops):
+        if op.type not in HIER_OP_TYPES:
+            continue
+        if op.attrs.get("hier_groups"):
+            continue  # already a tier hop of a decomposed exchange
+        if op.attrs.get("ring_id") not in (0, None):
+            continue  # subgroup rings live inside the fast tier
+        names = op.inputs.get("X", [])
+        if not names or set(names) != set(op.outputs.get("Out", [])):
+            continue  # only the in-place grad-allreduce shape
+        nranks = max(nranks, int(op.attrs.get("comm_nranks") or 0))
+        if op.attrs.get("pre_scale"):  # GradAllReduce stamps 1/nranks
+            nranks = max(
+                nranks, int(round(1.0 / float(op.attrs["pre_scale"]))))
+        nbytes = 0
+        for n in names:
+            v = block._find_var_recursive(n)
+            if v is None or not v.shape or any(
+                    int(d) < 0 for d in v.shape):
+                nbytes = 0
+                break
+            numel = 1
+            for d in v.shape:
+                numel *= int(d)
+            nbytes += numel * dtype_bytes(v.dtype)
+        if not nbytes:
+            continue
+        groups.setdefault(op.attrs.get("ring_id"),
+                          []).append((i, names[0], nbytes))
+    if not groups or nranks < 4:
+        return  # a 2-tier split needs >= 2 chips on each tier
+    c = hierarchy_topology(ctx.program, nranks=nranks)
+    if c is not None and nranks <= c:
+        return  # ring fits inside one slice — nothing crosses DCN
+    min_bytes = hierarchy_min_bytes(ctx.program)
+    mark = getattr(ctx.program, "_hierarchy", None)
+    delta = None
+    if c is None:
+        reason = ("no topology in ClusterSpec — the ring's tier is "
+                  "unknown, so the hierarchical rewrite cannot engage")
+        hint = ("stamp program._cluster_spec (or set "
+                "PADDLE_TPU_CLUSTER_SPEC) with slices/dcn_gbps so "
+                "analyze --plan can price the per-tier split")
+    elif nranks % c:
+        reason = ("asymmetric topology: nranks=%d not divisible by "
+                  "chips_per_slice=%d, so the hierarchical rewrite "
+                  "refuses the ring" % (nranks, c))
+        hint = ("repair the topology (slices must tile the ring) or "
+                "re-plan on the real chip count")
+    elif not hierarchy_enabled(ctx.program):
+        if mark is False:
+            reason = ("the _hierarchy plan mark pins the flat "
+                      "schedule (the planner priced flat as the win)")
+            hint = ("re-run parallel.auto_transpile if the topology "
+                    "or model changed since the plan was stamped")
+        else:
+            reason = "disabled by PADDLE_TPU_HIERARCHY=0"
+            hint = ("unset PADDLE_TPU_HIERARCHY to let "
+                    "resolve_fused_program decompose the exchange")
+        delta = True
+    else:
+        return  # rewrite engaged: resolve_fused_program handles these
+
+    # price the per-tier delta on the stamped spec (or its topology
+    # defaults) so the hint carries numbers, not vibes
+    rates = None
+    if delta:
+        from ..parallel.planner import ClusterSpec
+
+        raw = getattr(ctx.program, "_cluster_spec", None)
+        if raw is None:
+            raw = os.environ.get("PADDLE_TPU_CLUSTER_SPEC") or None
+        try:
+            spec = ClusterSpec.coerce(raw) if raw is not None else None
+        except (ValueError, TypeError):
+            spec = None
+        if spec is None or not spec.has_topology:
+            spec = ClusterSpec.coerce(
+                {"chips": nranks, "slices": nranks // c})
+        rates = spec.tier_wire()
+
+    cap = int(allreduce_bucket_mb(ctx.program) * (1 << 20))
+    for ring_id, members in sorted(groups.items(),
+                                   key=lambda kv: kv[1][0][0]):
+        buckets = []
+        cur, cur_bytes = [], 0
+        for item in members:
+            if cur and cur_bytes + item[2] > cap:
+                buckets.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(item)
+            cur_bytes += item[2]
+        if cur:
+            buckets.append(cur)
+        for bucket in buckets:
+            total = sum(b for _, _, b in bucket)
+            if total < min_bytes:
+                continue  # threshold says flat is right — no noise
+            hint_txt = hint
+            if rates is not None:
+                s = nranks // c
+                flat_dcn = collective_ici_bytes(
+                    "c_allreduce_sum", total, nranks)
+                hier_dcn = collective_ici_bytes(
+                    "c_allreduce_sum", -(-total // c), s)
+                hier_ici = 2 * collective_ici_bytes(
+                    "c_allgather", total, c)
+                dcn_gbps = rates["dcn"][0]
+                ici_gbps = rates.get("ici", rates["dcn"])[0]
+                hint_txt = (
+                    "%s; decomposing cuts slow-tier bytes %d -> %d "
+                    "(%.3f -> %.3f ms DCN wire, +%.3f ms ICI)"
+                    % (hint, flat_dcn, hier_dcn,
+                       flat_dcn / (dcn_gbps * 1e9) * 1e3,
+                       hier_dcn / (dcn_gbps * 1e9) * 1e3,
+                       hier_ici / (ici_gbps * 1e9) * 1e3))
+            yield ctx.diag(
+                "collective-crosses-slow-tier", Severity.INFO,
+                "ring %r gradient bucket (%d members, %d bytes, "
+                "anchored at %r) crosses the slow tier flat "
+                "(nranks=%d%s): %s"
+                % (ring_id, len(bucket), total, bucket[0][1], nranks,
+                   "" if c is None else ", chips_per_slice=%d" % c,
+                   reason),
+                block_idx=0, op_idx=bucket[0][0],
+                var_names=(bucket[0][1],), hint=hint_txt)
 
 
 def _overlap_pair_sites(block):

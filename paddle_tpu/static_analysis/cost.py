@@ -41,7 +41,7 @@ __all__ = [
     "P2P_OP_TYPES", "HOST_IO_OP_TYPES", "PlanPrice", "price_plan",
     "price_program", "plan_calibration_factor",
     "PLANNER_CALIBRATION_FAMILY", "OverlapWindow",
-    "overlap_window_table",
+    "overlap_window_table", "tier_wire_table",
 ]
 
 _DTYPE_BYTES = {
@@ -122,6 +122,7 @@ COLLECTIVE_OP_TYPES = frozenset((
     "broadcast", "c_allgather", "c_reducescatter", "c_scatter",
     "all_to_all", "ppermute", "c_fused_allreduce_sum",
     "c_allreduce_quant", "c_allreduce_start",
+    "c_hier_reducescatter", "c_hier_allgather",
 ))
 # NOT c_allreduce_wait: the wait half of an overlap pair is a consumer
 # barrier with zero wire traffic — the start op already carried the
@@ -346,26 +347,37 @@ class OpCost:
     """Static cost of one op (all byte counts are per-worker/local)."""
 
     __slots__ = ("record", "flops", "bytes_read", "bytes_written",
-                 "ici_bytes", "ring_id")
+                 "ici_bytes", "ring_id", "tier", "group")
 
     def __init__(self, record, flops, bytes_read, bytes_written,
-                 ici_bytes, ring_id=None):
+                 ici_bytes, ring_id=None, tier=None, group=None):
         self.record = record
         self.flops = int(flops)
         self.bytes_read = int(bytes_read)
         self.bytes_written = int(bytes_written)
         self.ici_bytes = int(ici_bytes)
         self.ring_id = ring_id
+        # wire tier of a topology-decomposed collective ("ici"/"dcn"/
+        # "pod", from the op's `tier` attr) and its subgroup size (from
+        # `comm_nranks`); None on flat collectives — the pricer then
+        # derives the tier from the ClusterSpec topology, so flat
+        # reports stay byte-identical to the pre-topology model
+        self.tier = tier
+        self.group = group
 
     def to_dict(self):
         r = self.record
-        return {
+        d = {
             "block_idx": r.block_idx, "op_idx": r.op_idx,
             "op_type": r.op.type, "flops": self.flops,
             "bytes_read": self.bytes_read,
             "bytes_written": self.bytes_written,
             "ici_bytes": self.ici_bytes, "ring_id": self.ring_id,
         }
+        if self.tier is not None:
+            d["tier"] = self.tier
+            d["group"] = self.group
+        return d
 
 
 class OverlapWindow:
@@ -377,10 +389,11 @@ class OverlapWindow:
     compute-vs-wire window model)."""
 
     __slots__ = ("bucket", "start", "wait", "window_flops",
-                 "window_bytes", "wire_bytes", "quant", "var_names")
+                 "window_bytes", "wire_bytes", "quant", "var_names",
+                 "tier")
 
     def __init__(self, bucket, start, wait, window_flops, window_bytes,
-                 wire_bytes, quant=False, var_names=()):
+                 wire_bytes, quant=False, var_names=(), tier=None):
         self.bucket = int(bucket)
         self.start = tuple(start)   # (block_idx, op_idx) of the start
         self.wait = tuple(wait)     # (block_idx, op_idx) of the wait
@@ -389,9 +402,10 @@ class OverlapWindow:
         self.wire_bytes = int(wire_bytes)
         self.quant = bool(quant)
         self.var_names = tuple(var_names)
+        self.tier = tier  # wire tier the window's ring rides, or None
 
     def to_dict(self):
-        return {
+        d = {
             "bucket": self.bucket,
             "start": list(self.start), "wait": list(self.wait),
             "window_flops": self.window_flops,
@@ -400,6 +414,9 @@ class OverlapWindow:
             "quant": self.quant,
             "var_names": list(self.var_names),
         }
+        if self.tier is not None:
+            d["tier"] = self.tier
+        return d
 
 
 class CostReport:
@@ -452,6 +469,21 @@ class CostReport:
         for c in self.op_costs:
             if c.ici_bytes:
                 per[c.ring_id] = per.get(c.ring_id, 0) + c.ici_bytes
+        return per
+
+    def ici_bytes_per_tier(self, cluster=None):
+        """Wire bytes per topology tier.  An op's explicit ``tier``
+        attr (stamped by the hierarchical decomposition) wins; flat
+        collectives derive their tier from ``cluster``'s topology (the
+        ring size vs chips-per-slice), or ``"ici"`` with no topology —
+        so a flat report on a flat cluster is all-ICI, exactly the
+        pre-topology accounting."""
+        per = {}
+        for c in self.op_costs:
+            if not c.ici_bytes:
+                continue
+            tier = _op_tier(c, cluster, self.nranks)
+            per[tier] = per.get(tier, 0) + c.ici_bytes
         return per
 
     @property
@@ -597,15 +629,30 @@ def estimate_cost(program, interp=None, targets=(), nranks=None,
         bytes_written = sum(_val_bytes(v) for v in rec.outs)
         ici = 0
         ring = None
+        tier = None
+        group = None
         if op.type in COLLECTIVE_OP_TYPES or op.type in P2P_OP_TYPES:
             ring = op.attrs.get("ring_id")
-            if op.type == "c_fused_allreduce_sum" \
+            # a topology-decomposed collective runs on a SUBGROUP of
+            # the axis (the slice ring or the cross-slice ring): its
+            # `comm_nranks` attr carries the subgroup size the ring
+            # formula must use, and `tier` names the wire it rides
+            tier = op.attrs.get("tier")
+            try:
+                group = int(op.attrs.get("comm_nranks") or 0) or None
+            except (TypeError, ValueError):
+                group = None
+            participants = group or nranks
+            if op.type in ("c_fused_allreduce_sum",
+                           "c_hier_reducescatter") \
                     or (op.type == "c_allreduce_start"
                         and not op.attrs.get("quant")):
                 # bucketed allreduce: the coalesced buffer carries the
                 # SUM of the member payloads in one launch (the async
                 # start half carries the same volume at its hoisted
-                # position; the wait half is a zero-byte barrier)
+                # position; the wait half is a zero-byte barrier).
+                # Same rule for the hierarchical reduce-scatter: the
+                # slice ring moves the whole coalesced bucket once
                 payload = sum(_val_bytes(v) for v in rec.ins)
             elif op.type == "c_allreduce_quant" \
                     or op.type == "c_allreduce_start":
@@ -615,17 +662,21 @@ def estimate_cost(program, interp=None, targets=(), nranks=None,
 
                 numel = sum(v.local_numel or 0 for v in rec.ins)
                 payload, _ = quantized_wire_bytes(
-                    numel, nranks,
+                    numel, participants,
                     block=_op_quant_block(op) or None)
+            elif op.type == "c_hier_allgather":
+                # the gather-back reassembles the full bucket from the
+                # per-rank chunks: volume is the OUTPUT member total
+                payload = sum(_val_bytes(v) for v in rec.outs)
             else:
                 payload = max(
                     [_val_bytes(v) for v in (rec.ins or rec.outs)] or [0])
             if op.type == "recv_v2" and rec.outs:
                 payload = _val_bytes(rec.outs[0])
-            ici = collective_ici_bytes(op.type, payload, nranks)
+            ici = collective_ici_bytes(op.type, payload, participants)
         op_costs.append(OpCost(
             rec, _op_flops(op, rec.ins, rec.outs), bytes_read,
-            bytes_written, ici, ring_id=ring))
+            bytes_written, ici, ring_id=ring, tier=tier, group=group))
 
     # ---- overlap windows (start→wait pairs by overlap_bucket id) ----
     windows = []
@@ -651,7 +702,8 @@ def estimate_cost(program, interp=None, targets=(), nranks=None,
                                  for x in inner),
                 wire_bytes=start.ici_bytes,
                 quant=bool(start.record.op.attrs.get("quant")),
-                var_names=start.record.op.outputs.get("Out", ())))
+                var_names=start.record.op.outputs.get("Out", ()),
+                tier=start.tier))
     windows.sort(key=lambda w: (w.start, w.bucket))
 
     # ---- liveness-based peak memory ----
@@ -773,12 +825,13 @@ class PlanPrice:
                  "launch_ms", "step_ms", "ici_bytes",
                  "peak_memory_bytes", "collective_launches",
                  "schedule_factor", "calibration", "exposed_wire_ms",
-                 "overlap_fraction")
+                 "overlap_fraction", "tier_wire")
 
     def __init__(self, flops_ms, hbm_ms, compute_ms, ici_ms, launch_ms,
                  step_ms, ici_bytes, peak_memory_bytes,
                  collective_launches, schedule_factor, calibration,
-                 exposed_wire_ms=None, overlap_fraction=0.0):
+                 exposed_wire_ms=None, overlap_fraction=0.0,
+                 tier_wire=None):
         self.flops_ms = flops_ms
         self.hbm_ms = hbm_ms
         self.compute_ms = compute_ms
@@ -793,6 +846,11 @@ class PlanPrice:
         self.exposed_wire_ms = (ici_ms if exposed_wire_ms is None
                                 else exposed_wire_ms)
         self.overlap_fraction = overlap_fraction
+        # {tier: {"bytes": int, "ms": float}} when tiered pricing ran;
+        # None on a flat cluster — to_dict() omits the key then, so
+        # flat plans serialize byte-identically to the pre-topology
+        # planner (the back-compat contract)
+        self.tier_wire = tier_wire
 
     def to_dict(self, canonical=False):
         """``canonical=True`` divides the calibration factor back out
@@ -802,7 +860,7 @@ class PlanPrice:
         invariant, and the canonical bytes must be too)."""
         cal = (self.calibration
                if canonical and self.calibration else None)
-        return {
+        d = {
             "step_ms": round(self.step_ms / cal if cal
                              else self.step_ms, 6),
             "flops_ms": round(self.flops_ms, 6),
@@ -819,6 +877,12 @@ class PlanPrice:
             "calibration": 1.0 if canonical
             else round(self.calibration, 6),
         }
+        if self.tier_wire is not None:
+            d["tier_wire"] = {
+                t: {"bytes": int(v["bytes"]),
+                    "ms": round(v["ms"], 6)}
+                for t, v in sorted(self.tier_wire.items())}
+        return d
 
     def __repr__(self):
         return ("PlanPrice(step=%.3fms compute=%.3f ici=%.3f "
@@ -827,10 +891,37 @@ class PlanPrice:
             self.peak_memory_bytes)
 
 
+def _op_tier(c, cluster, nranks):
+    """Wire tier of one collective :class:`OpCost`: the op's explicit
+    ``tier`` attr (stamped by the hierarchical decomposition) wins;
+    otherwise the cluster topology decides by ring size — a flat
+    collective over more ranks than fit one slice rides the slow tier."""
+    if c.tier:
+        return c.tier
+    tier_for = getattr(cluster, "tier_for", None)
+    if tier_for is None:
+        return "ici"
+    return tier_for(c.group or nranks or 1)
+
+
+def _tier_rates(cluster, ici_gbps, launch_us):
+    """``{tier: (gbps, launch_us)}``: the caller's explicit ici numbers
+    stay authoritative for the fast tier; the slow tiers come from the
+    cluster topology."""
+    rates = {"ici": (ici_gbps, launch_us)}
+    wire = getattr(cluster, "tier_wire", None)
+    if wire is not None:
+        for t, v in wire().items():
+            if t != "ici":
+                rates[t] = v
+    return rates
+
+
 def price_plan(report, peak_tflops=100.0, hbm_gbps=1200.0,
                ici_gbps=100.0, launch_us=5.0, schedule_factor=1.0,
                collective_launches=None, calibration=None,
-               extra_ici_bytes=0, extra_launches=0):
+               extra_ici_bytes=0, extra_launches=0, cluster=None,
+               extra_tier_bytes=None, tier_launches=None):
     """Price one worker's :class:`CostReport` against cluster numbers;
     returns a :class:`PlanPrice`.  ``collective_launches`` overrides
     the launch count (the planner models allreduce bucketing this way
@@ -838,7 +929,21 @@ def price_plan(report, peak_tflops=100.0, hbm_gbps=1200.0,
     ``extra_launches`` charge traffic the program IR does not carry as
     ops (the planner's ZeRO-1 candidates pay their per-step
     param-allgather here); ``calibration`` overrides
-    :func:`plan_calibration_factor`."""
+    :func:`plan_calibration_factor`.
+
+    **Tiered wire pricing** engages when ``cluster`` declares a
+    topology (``ClusterSpec.has_topology``), when the report carries
+    tier-stamped ops, or when the caller passes per-tier deltas: each
+    collective is assigned a tier (:func:`_op_tier`), wire time is
+    summed per tier at that tier's bandwidth, slow-tier launches pay
+    the tier's launch latency, and overlap windows hide wire at their
+    own tier's rate.  ``extra_tier_bytes`` (``{tier: ±bytes}``) and
+    ``tier_launches`` (``{tier: count}`` — an explicit slow-tier launch
+    count overriding the per-op tally) are how the planner prices a
+    hierarchical decomposition without rewriting the program.  With a
+    flat/absent cluster and no tier inputs the flat single-tier
+    arithmetic runs unchanged — bit-identical prices, the kill-switch
+    contract."""
     if collective_launches is None:
         collective_launches = sum(
             1 for c in report.op_costs if c.ici_bytes > 0)
@@ -849,9 +954,64 @@ def price_plan(report, peak_tflops=100.0, hbm_gbps=1200.0,
     hbm_ms = (report.total_bytes_read + report.total_bytes_written) \
         / (max(hbm_gbps, 1e-9) * 1e6)
     compute_ms = max(flops_ms, hbm_ms) * schedule_factor
-    ici_bytes = report.total_ici_bytes + int(extra_ici_bytes)
-    ici_ms = ici_bytes / (max(ici_gbps, 1e-9) * 1e6)
-    launch_ms = collective_launches * launch_us / 1000.0
+
+    tiered = (bool(getattr(cluster, "has_topology", False))
+              or bool(extra_tier_bytes) or bool(tier_launches)
+              or any(c.tier for c in report.op_costs))
+    tier_wire = None
+    tier_surcharge_ms = 0.0
+    if not tiered:
+        ici_bytes = report.total_ici_bytes + int(extra_ici_bytes)
+        ici_ms = ici_bytes / (max(ici_gbps, 1e-9) * 1e6)
+
+        def _wire_ms(w):
+            return w.wire_bytes / (max(ici_gbps, 1e-9) * 1e6)
+    else:
+        rates = _tier_rates(cluster, ici_gbps, launch_us)
+
+        def _rate(t):
+            return rates.get(t, rates["ici"])
+
+        tier_bytes = {}
+        tier_ops = {}
+        for c in report.op_costs:
+            if c.ici_bytes <= 0:
+                continue
+            t = _op_tier(c, cluster, report.nranks)
+            tier_bytes[t] = tier_bytes.get(t, 0) + c.ici_bytes
+            tier_ops[t] = tier_ops.get(t, 0) + 1
+        if extra_ici_bytes:
+            tier_bytes["ici"] = (tier_bytes.get("ici", 0)
+                                 + int(extra_ici_bytes))
+        for t, b in sorted((extra_tier_bytes or {}).items()):
+            tier_bytes[t] = max(tier_bytes.get(t, 0) + int(b), 0)
+        ici_bytes = sum(tier_bytes.values())
+        ici_ms = sum(b / (max(_rate(t)[0], 1e-9) * 1e6)
+                     for t, b in tier_bytes.items())
+        tier_wire = {t: {"bytes": int(b),
+                         "ms": b / (max(_rate(t)[0], 1e-9) * 1e6)}
+                     for t, b in tier_bytes.items()}
+        # slow-tier launch surcharge: a DCN/pod collective pays that
+        # tier's launch latency, not the fast tier's.  The per-op tally
+        # is capped by the (possibly bucketed) launch override — a
+        # bucketed ring launches `collective_launches` times total, so
+        # no more than that many can be slow
+        for t, (gbps, t_launch) in sorted(rates.items()):
+            if t == "ici" or t_launch <= launch_us:
+                continue
+            if tier_launches is not None:
+                count = int(tier_launches.get(t, 0))
+            else:
+                count = min(tier_ops.get(t, 0), collective_launches)
+            tier_surcharge_ms += count * (t_launch - launch_us) / 1000.0
+
+        def _wire_ms(w):
+            t = w.tier or _op_tier(
+                _WindowTierProbe(w), cluster, report.nranks)
+            return w.wire_bytes / (max(_rate(t)[0], 1e-9) * 1e6)
+
+    launch_ms = (collective_launches * launch_us / 1000.0
+                 + tier_surcharge_ms)
     # overlap-aware wire term: each start→wait window hides up to its
     # own compute under the ring transfer (max(compute, wire) per
     # window == compute + exposed remainder); everything outside a
@@ -859,7 +1019,7 @@ def price_plan(report, peak_tflops=100.0, hbm_gbps=1200.0,
     # stays fully exposed.  No windows → exposed == ici_ms exactly.
     hidden_ms = 0.0
     for w in getattr(report, "overlap_windows", None) or ():
-        wire_ms = w.wire_bytes / (max(ici_gbps, 1e-9) * 1e6)
+        wire_ms = _wire_ms(w)
         win_compute_ms = max(
             w.window_flops / (max(peak_tflops, 1e-9) * 1e9),
             w.window_bytes / (max(hbm_gbps, 1e-9) * 1e6))
@@ -872,7 +1032,20 @@ def price_plan(report, peak_tflops=100.0, hbm_gbps=1200.0,
                      report.peak_memory_bytes, collective_launches,
                      schedule_factor, calibration,
                      exposed_wire_ms=exposed_wire_ms,
-                     overlap_fraction=overlap_fraction)
+                     overlap_fraction=overlap_fraction,
+                     tier_wire=tier_wire)
+
+
+class _WindowTierProbe:
+    """Adapter giving an :class:`OverlapWindow` the ``tier``/``group``
+    shape :func:`_op_tier` reads — a tier-less window's ring spans the
+    full worker set, so its tier derives from the cluster topology."""
+
+    __slots__ = ("tier", "group")
+
+    def __init__(self, w):
+        self.tier = w.tier
+        self.group = None
 
 
 def price_program(program, cluster=None, nranks=None, targets=(),
@@ -902,8 +1075,51 @@ def price_program(program, cluster=None, nranks=None, targets=(),
         launch_us=getattr(cluster, "launch_us", 5.0),
         schedule_factor=schedule_factor,
         collective_launches=collective_launches,
-        calibration=calibration)
+        calibration=calibration,
+        cluster=cluster)
     return report, price
+
+
+def tier_wire_table(report, cluster):
+    """Per-ring wire rows of the topology-tiered accounting — the
+    ``analyze_program --plan`` table and the bench hierarchy gate read
+    these.  Each row: ring id, the tier that ring rides, total wire
+    bytes, the wire ms at that tier's bandwidth, and whether the ring's
+    payload travels quantized (any int8-wire op on the ring)."""
+    rates = _tier_rates(cluster,
+                        getattr(cluster, "ici_gbps", 100.0),
+                        getattr(cluster, "launch_us", 5.0))
+    per_ring = {}
+    for c in report.op_costs:
+        if c.ici_bytes <= 0:
+            continue
+        row = per_ring.setdefault(
+            c.ring_id, {"bytes": 0, "quant": False, "tier": None})
+        row["bytes"] += c.ici_bytes
+        op = c.record.op
+        if op.type == "c_allreduce_quant" or op.attrs.get("quant"):
+            row["quant"] = True
+        t = _op_tier(c, cluster, report.nranks)
+        # rings are tier-homogeneous by construction; the slowest op
+        # wins if a hand-built program mixes them
+        order = ("ici", "dcn", "pod")
+        if row["tier"] is None or (t in order and row["tier"] in order
+                                   and order.index(t)
+                                   > order.index(row["tier"])):
+            row["tier"] = t
+    rows = []
+    for ring in sorted(per_ring, key=lambda r: (r is None, repr(r))):
+        row = per_ring[ring]
+        tier = row["tier"] or "ici"
+        gbps = rates.get(tier, rates["ici"])[0]
+        rows.append({
+            "ring": ring,
+            "tier": tier,
+            "bytes": int(row["bytes"]),
+            "ms": round(row["bytes"] / (max(gbps, 1e-9) * 1e6), 6),
+            "quant": bool(row["quant"]),
+        })
+    return rows
 
 
 def overlap_window_table(report, peak_tflops=100.0, hbm_gbps=1200.0,

@@ -160,6 +160,20 @@ def extract_collective_schedule(program, worker=0, interp=None,
                 var=var, peer=op.attrs.get("peer"), order=rec.index)
             schedule.setdefault(ring, []).append(ev)
             continue
+        if op.type in ("c_hier_reducescatter", "c_hier_allgather"):
+            # hierarchical intra-slice hops: like the fused op they move
+            # one coalesced buffer — the RS is signed by its member
+            # inputs, the AG by its member outputs (its input is just
+            # the 1/c chunk).  Both hops carry the FULL bucket around
+            # the slice ring, so the signature numel is the member sum:
+            # two slices that disagreed about decomposing a bucket
+            # diverge on ring 5 length, not silently on payload
+            vals = rec.ins if op.type == "c_hier_reducescatter" \
+                else rec.outs
+            if vals:
+                numel = sum(v.local_numel or 0 for v in vals)
+                var = "%s(+%d coalesced)" % (vals[0].name,
+                                             len(vals) - 1)
         if op.type == "c_fused_allreduce_sum" and rec.ins:
             # the bucketed allreduce moves ONE coalesced buffer: its
             # schedule signature is the summed member payload (identical

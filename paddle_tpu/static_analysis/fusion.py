@@ -120,7 +120,8 @@ _PROGRAM_MARKS = ("_num_trainers", "_trainer_id", "_host_tables",
                   "_hbm_budget", "_nan_guard", "_guard_loss_name",
                   "_pipeline_stage", "_guard_abort_after",
                   "_allreduce_bucket_mb", "_shard_optimizer_state",
-                  "_quant_buckets", "_overlap")
+                  "_quant_buckets", "_overlap", "_hierarchy",
+                  "_cluster_spec")
 
 # per-var attrs execution semantics depend on; Program.clone() now
 # preserves these itself (framework.CLONE_VAR_MARKS) — this copy pass
@@ -305,6 +306,7 @@ class FusionConfig:
         keeps running yesterday's schedule."""
         from ..quant.collective import quant_min_bytes as _qmb
         from ..quant.blockwise import quant_block as _qb
+        from .hierarchy import hierarchy_signature as _hier
         from .overlap import overlap_enabled as _ov
 
         return (self.enabled, self.fuse_attention, self.fuse_elewise,
@@ -313,7 +315,8 @@ class FusionConfig:
                 self.fuse_embedding_gather, allreduce_bucket_mb(program),
                 optimizer_fuse_overhead_bytes(), _flash_min_t(),
                 conv_bn_min_bytes(), embed_fuse_min_bytes(),
-                _qmb(program), _qb(), _ov(program), _autotune_state())
+                _qmb(program), _qb(), _ov(program), _hier(program),
+                _autotune_state())
 
     def __repr__(self):
         return "FusionConfig%r" % (self.signature(),)
@@ -1850,6 +1853,7 @@ _BRACKET_EXCLUDE = ("fusible-pattern-not-fused", "unreferenced-op",
                     "resilience-finite-guard",
                     "executor-host-sync-in-loop", "sync-in-hot-loop",
                     "quantizable-bucket-not-quantized",
+                    "collective-crosses-slow-tier",
                     "overlap-opportunity-unexploited")
 
 
@@ -1949,6 +1953,32 @@ def _register_passes():
 _register_passes()
 
 
+def _run_hierarchy_pass(clone, targets, baseline=None):
+    """Run the hierarchical-collective decomposition on the resolved
+    clone after the fusion pipeline (it decomposes the bucketed
+    collectives fusion just emitted) and BEFORE the overlap scheduler
+    (the remaining flat buckets can still split into start/wait pairs;
+    the hierarchical hops themselves opt out of overlap).  Bracketed by
+    the verifier like a fusion family; returns whether any bucket
+    decomposed — the resolve cache must keep the clone for a
+    hierarchy-only rewrite."""
+    from .hierarchy import apply_hierarchy_pass, hierarchy_enabled
+
+    if not hierarchy_enabled(clone):
+        clone._hierarchy_report = None
+        return False
+    from .verifier import pass_verification_enabled
+
+    verify = pass_verification_enabled()
+    if verify and baseline is None:
+        baseline = _error_signatures(clone, set(targets))
+    applied = apply_hierarchy_pass(clone, targets=targets)
+    if applied and verify:
+        _assert_no_new_errors(clone, set(targets), baseline,
+                              "after hierarchy_pass")
+    return applied
+
+
 def _run_overlap_pass(clone, targets, baseline=None):
     """Run the overlap scheduler on the resolved clone after the fusion
     pipeline (it splits the bucketed collectives fusion just emitted),
@@ -2036,8 +2066,9 @@ def resolve_fused_program(program, config=None, targets=()):
         baseline = _error_signatures(clone, set(tkey))
     report = apply_fusion_passes(clone, config, targets=tkey,
                                  baseline=baseline)
+    hier_applied = _run_hierarchy_pass(clone, tkey, baseline=baseline)
     overlap_applied = _run_overlap_pass(clone, tkey, baseline=baseline)
-    if not report.applied and not overlap_applied:
+    if not report.applied and not overlap_applied and not hier_applied:
         cache[key] = (None, report)
         return program, report
     clone._fusion_sig = config.signature(program)

@@ -255,7 +255,8 @@ def _replicating_transfer(op, in_vals, out_val):
 for _t in ("c_allreduce_sum", "c_allreduce_max", "c_allreduce_min",
            "c_allreduce_prod", "allreduce", "c_broadcast", "broadcast",
            "c_allgather", "fill_constant", "c_fused_allreduce_sum",
-           "c_allreduce_quant", "c_allreduce_start", "c_allreduce_wait"):
+           "c_allreduce_quant", "c_allreduce_start", "c_allreduce_wait",
+           "c_hier_reducescatter", "c_hier_allgather"):
     register_transfer(_t)(_replicating_transfer)
 
 

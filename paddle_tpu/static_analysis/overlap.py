@@ -226,6 +226,12 @@ def _plan(program, targets, exclude):
     for fi, op in enumerate(block.ops):
         if op.type not in OVERLAPPABLE_OP_TYPES:
             continue
+        if op.attrs.get("hier_groups"):
+            # the cross-slice hop of a hierarchical decomposition: it
+            # reuses the allreduce op types but its ring is the DCN
+            # group — splitting it into a start/wait pair would drop
+            # the group attrs and mis-lower to a full-ring collective
+            continue
         bucket += 1
         members = frozenset(op.inputs.get("X", ()))
         quant = op.type == "c_allreduce_quant"

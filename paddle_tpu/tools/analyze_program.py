@@ -182,6 +182,9 @@ def main(argv=None):
                  if k != "diagnostics"}
         if plan_result is not None:
             extra["plan"] = plan_result.to_dict()
+            tier_rows = plan_result.tier_wire_table()
+            if tier_rows is not None:
+                extra["plan"]["tier_wire_table"] = tier_rows
         if overlap_info is not None:
             extra["overlap"] = overlap_info
         emit_diagnostics(report.diagnostics, True, extra_json=extra)
@@ -189,6 +192,15 @@ def main(argv=None):
         print(report.format(top_ops=args.top))
         if plan_result is not None:
             print(plan_result.format_table())
+            tier_rows = plan_result.tier_wire_table()
+            if tier_rows:
+                print("per-tier wire (winner's realized schedule):")
+                print("  %-8s %-5s %14s %10s %6s"
+                      % ("ring", "tier", "bytes", "wire ms", "quant"))
+                for r in tier_rows:
+                    print("  %-8s %-5s %14d %10.4f %6s"
+                          % (r["ring"], r["tier"], r["bytes"], r["ms"],
+                             "int8" if r["quant"] else "-"))
         if overlap_lines is not None:
             print("\n".join(overlap_lines))
 

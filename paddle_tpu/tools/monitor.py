@@ -325,6 +325,17 @@ def collect_status(dirname, hb_dir=None, now=None,
                         drift[kind] = round(float(v), 4)
                 break
 
+    # per-tier wire bytes (static_analysis/hierarchy + the topology
+    # tree): predicted ICI vs DCN traffic of the registered programs —
+    # a hierarchical plan shows its slow-tier cut here, a flat plan on
+    # a multi-slice spec shows every gradient byte riding DCN
+    tier_bytes = {}
+    for tier in ("ici", "dcn", "pod"):
+        v = _metric_value(merged, "predicted_tier_bytes",
+                          labels={"tier": tier})
+        if v is not None:
+            tier_bytes[tier] = int(v)
+
     # quantized-collective health (paddle_tpu/quant): worst per-bucket
     # measured relative error and its drift against the blockwise error
     # model — the '--alert quant_error>0.05' production gate
@@ -411,6 +422,7 @@ def collect_status(dirname, hb_dir=None, now=None,
         "faults": counts.get("fault-injected", 0),
         "restores": counts.get("checkpoint-loaded", 0),
         "drift": drift or None,
+        "tier_bytes": tier_bytes or None,
         "quant_error": (None if quant_err is None
                         else round(quant_err, 6)),
         "quant_error_ratio": (None if quant_ratio is None
@@ -522,6 +534,10 @@ def render_status(status):
         lines.append("  drift " + "  ".join(
             "%s=%s" % (k, _fmt(v))
             for k, v in sorted(status["drift"].items())))
+    if status.get("tier_bytes"):
+        lines.append("  wire " + "  ".join(
+            "%s=%sB" % (k, _fmt(v))
+            for k, v in sorted(status["tier_bytes"].items())))
     if status.get("quant_error") is not None:
         lines.append("  quant: error=%s  vs_model=%sx" % (
             _fmt(status["quant_error"]),
