@@ -303,6 +303,158 @@ def build_naive_program(cfg=GPT_TINY, batch=2, prompt_len=8,
     return main, startup, ["prompt_ids", "prompt_len"], tokens, gen_len
 
 
+class DecodeAdapter:
+    """gpt_small as a ``serving.DecodeEngine`` model (ISSUE 19): the
+    four builders share every transformer parameter by ParamAttr name,
+    so the slot-ring and paged-pool program families are the SAME
+    network — which is what makes the bench A/B's "paged greedy is
+    bit-identical to ring greedy" gate meaningful.  ``init_params``
+    re-runs startup under a fixed numpy seed so two separately built
+    engines (ring vs paged vs draft) hold identical weights."""
+
+    def __init__(self, cfg=GPT_TINY, max_len=None, seed=0):
+        self.cfg = cfg
+        self.max_len = int(max_len or cfg.max_len)
+        self.seed = int(seed)
+
+    def cache_spec(self):
+        cfg = self.cfg
+        return (cfg.layers, cfg.heads, self.max_len,
+                cfg.hidden // cfg.heads)
+
+    def init_params(self, program, startup, exe, scope):
+        np.random.seed(self.seed)
+        exe.run(startup, scope=scope)
+
+    # --- shared trunks -------------------------------------------------
+
+    def _trunk_prefill(self, prompt, plen, store):
+        fluid = _fluid()
+        cfg = self.cfg
+        L = prompt.shape[1]
+        d, h = cfg.hidden, cfg.heads
+        dh = d // h
+        x = _embed(prompt, cfg, "gpt.wte", cfg.vocab)      # [1, L, E]
+        pos = fluid.layers.range(0, L, 1, "int32")
+        pe = _embed(pos, cfg, "gpt.wpe", cfg.max_len)
+        x = fluid.layers.elementwise_add(x, pe, axis=1)
+
+        def split_heads(t):
+            t = fluid.layers.reshape(t, [0, 0, h, dh])
+            return fluid.layers.transpose(t, [0, 2, 1, 3])
+
+        for li in range(cfg.layers):
+            prefix = "gpt.l%d" % li
+            q = split_heads(_proj(x, d, prefix + ".q", 2))
+            k = split_heads(_proj(x, d, prefix + ".k", 2))
+            v = split_heads(_proj(x, d, prefix + ".v", 2))
+            store(li, k, v)
+            ctxv = fluid.layers.fused_multihead_attention(
+                q, k, v, causal=True, scale=1.0 / math.sqrt(dh))
+            ctxv = fluid.layers.transpose(ctxv, [0, 2, 1, 3])
+            ctxv = fluid.layers.reshape(ctxv, [0, 0, d])
+            x = _ln(fluid.layers.elementwise_add(
+                x, _proj(ctxv, d, prefix + ".o", 2)),
+                prefix + ".ln1", 2)
+            m = fluid.layers.gelu(_proj(x, cfg.ffn, prefix + ".fc1", 2))
+            x = _ln(fluid.layers.elementwise_add(
+                x, _proj(m, d, prefix + ".fc2", 2)), prefix + ".ln2", 2)
+        x = _ln(x, "gpt.lnf", 2)
+        last = fluid.layers.increment(fluid.layers.assign(plen),
+                                      value=-1, in_place=True)
+        sel = fluid.layers.cast(fluid.layers.one_hot(last, L), x.dtype)
+        return _logits(
+            fluid.layers.squeeze(fluid.layers.matmul(sel, x), [1]), cfg)
+
+    def _trunk_step(self, cur, cursors, write, attend):
+        fluid = _fluid()
+        cfg = self.cfg
+        d, h = cfg.hidden, cfg.heads
+        dh = d // h
+        x = _embed(cur, cfg, "gpt.wte", cfg.vocab)         # [S, E]
+        pe = _embed(cursors, cfg, "gpt.wpe", cfg.max_len)  # [S, E]
+        x = fluid.layers.elementwise_add(x, pe)
+
+        def split_heads(t):
+            return fluid.layers.reshape(t, [0, h, dh])
+
+        for li in range(cfg.layers):
+            prefix = "gpt.l%d" % li
+            q = split_heads(_proj(x, d, prefix + ".q", 1))
+            k = split_heads(_proj(x, d, prefix + ".k", 1))
+            v = split_heads(_proj(x, d, prefix + ".v", 1))
+            write(li, k, v)
+            ctxv = fluid.layers.reshape(attend(li, q), [0, d])
+            x = _ln(fluid.layers.elementwise_add(
+                x, _proj(ctxv, d, prefix + ".o", 1)),
+                prefix + ".ln1", 1)
+            m = fluid.layers.gelu(_proj(x, cfg.ffn, prefix + ".fc1", 1))
+            x = _ln(fluid.layers.elementwise_add(
+                x, _proj(m, d, prefix + ".fc2", 1)), prefix + ".ln2", 1)
+        x = _ln(x, "gpt.lnf", 1)
+        return _logits(x, cfg)
+
+    # --- slot-ring builders -------------------------------------------
+
+    def build_prefill(self, prompt, plen, slot, caches):
+        fluid = _fluid()
+
+        def store(li, k, v):
+            kc, vc = caches[li]
+            fluid.layers.kv_cache_prefill(kc, k, slot=slot)
+            fluid.layers.kv_cache_prefill(vc, v, slot=slot)
+
+        return self._trunk_prefill(prompt, plen, store)
+
+    def build_step(self, cur, cursors, caches):
+        fluid = _fluid()
+        dh = self.cfg.hidden // self.cfg.heads
+
+        def write(li, k, v):
+            kc, vc = caches[li]
+            fluid.layers.kv_cache_write(kc, k, cursors, per_row=True)
+            fluid.layers.kv_cache_write(vc, v, cursors, per_row=True)
+
+        def attend(li, q):
+            kc, vc = caches[li]
+            return fluid.layers.flash_decode(
+                q, kc, vc, cursors, sm_scale=1.0 / math.sqrt(dh),
+                per_row=True)
+
+        return self._trunk_step(cur, cursors, write, attend)
+
+    # --- paged-pool builders ------------------------------------------
+
+    def build_prefill_paged(self, prompt, plen, table, caches):
+        fluid = _fluid()
+
+        def store(li, k, v):
+            kc, vc = caches[li]
+            fluid.layers.paged_kv_cache_prefill(kc, k, plen, table)
+            fluid.layers.paged_kv_cache_prefill(vc, v, plen, table)
+
+        return self._trunk_prefill(prompt, plen, store)
+
+    def build_step_paged(self, cur, cursors, tables, caches):
+        fluid = _fluid()
+        dh = self.cfg.hidden // self.cfg.heads
+
+        def write(li, k, v):
+            kc, vc = caches[li]
+            fluid.layers.paged_kv_cache_write(kc, k, cursors, tables,
+                                              per_row=True)
+            fluid.layers.paged_kv_cache_write(vc, v, cursors, tables,
+                                              per_row=True)
+
+        def attend(li, q):
+            kc, vc = caches[li]
+            return fluid.layers.paged_flash_decode(
+                q, kc, vc, cursors, tables,
+                sm_scale=1.0 / math.sqrt(dh), per_row=True)
+
+        return self._trunk_step(cur, cursors, write, attend)
+
+
 def make_fake_prompt(batch, prompt_len, cfg, rng):
     ids = rng.randint(1, cfg.vocab - 1,
                       size=(batch, prompt_len)).astype("int32")

@@ -24,8 +24,9 @@ from . import nn as nn_layers
 
 __all__ = [
     "create_kv_cache", "kv_cache_write", "kv_cache_prefill",
-    "flash_decode", "top_k_sampling", "top_p_sampling",
-    "greedy_sampling", "sampling", "decode_loop",
+    "flash_decode", "create_paged_kv_cache", "paged_kv_cache_write",
+    "paged_kv_cache_prefill", "paged_flash_decode", "top_k_sampling",
+    "top_p_sampling", "greedy_sampling", "sampling", "decode_loop",
 ]
 
 
@@ -98,6 +99,74 @@ def flash_decode(q, k_cache, v_cache, cursor, sm_scale=None,
         type="flash_decode_attention",
         inputs={"Q": [q], "KCache": [k_cache], "VCache": [v_cache],
                 "Cursor": [cursor]},
+        outputs={"Out": [out]},
+        attrs=attrs,
+    )
+    return out
+
+
+def create_paged_kv_cache(num_blocks, heads, block_len, head_dim,
+                          dtype="float32", name=None):
+    """A zero-initialized paged KV pool ``[num_blocks, heads,
+    block_len, head_dim]`` — HBM carved into fixed-size blocks that a
+    free-list hands to requests (serving/paging.py); block tables route
+    each stream's reads/writes into its owned blocks."""
+    shape = [num_blocks, heads, block_len, head_dim]
+    return tensor_layers.fill_constant(shape, dtype, 0.0)
+
+
+def paged_kv_cache_write(cache, x, cursor, table, per_row=True,
+                         in_place=True, name=None):
+    """Write this step's K (or V) ``[S, H, D]`` into the paged pool at
+    each stream's cursor, routed through its block-table row (``-1``
+    entries drop the write — inactive streams leave the pool
+    untouched)."""
+    helper = LayerHelper("paged_kv_cache_write", **locals())
+    out = cache if in_place else \
+        helper.create_variable_for_type_inference(cache.dtype)
+    helper.append_op(
+        type="paged_kv_cache_write",
+        inputs={"Cache": [cache], "X": [x], "Cursor": [cursor],
+                "BlockTable": [table]},
+        outputs={"Out": [out]},
+        attrs={"per_row": bool(per_row)},
+    )
+    return out
+
+
+def paged_kv_cache_prefill(cache, x, length, table, in_place=True,
+                           name=None):
+    """Bulk-write a prompt's K/V ``[1, H, L, D]`` into the blocks its
+    table owns; padded positions ``>= length`` are dropped."""
+    helper = LayerHelper("paged_kv_cache_prefill", **locals())
+    out = cache if in_place else \
+        helper.create_variable_for_type_inference(cache.dtype)
+    helper.append_op(
+        type="paged_kv_cache_prefill",
+        inputs={"Cache": [cache], "X": [x], "Len": [length],
+                "BlockTable": [table]},
+        outputs={"Out": [out]}, attrs={},
+    )
+    return out
+
+
+def paged_flash_decode(q, k_cache, v_cache, cursor, table,
+                       sm_scale=None, per_row=True, name=None):
+    """Single-query attention ``[S, H, D]`` through the block table,
+    masked to ``cursor`` valid entries per stream (Pallas paged kernel
+    on TPU, gather + ring-oracle composite elsewhere —
+    ops/pallas/paged_flash_decode.py).  Rows are independent, so the
+    speculative verify feeds ``k+1`` rows per stream with graduated
+    cursors."""
+    helper = LayerHelper("paged_flash_decode", **locals())
+    out = helper.create_variable_for_type_inference(q.dtype)
+    attrs = {"per_row": bool(per_row)}
+    if sm_scale is not None:
+        attrs["sm_scale"] = float(sm_scale)
+    helper.append_op(
+        type="paged_flash_decode_attention",
+        inputs={"Q": [q], "KCache": [k_cache], "VCache": [v_cache],
+                "Cursor": [cursor], "BlockTable": [table]},
         outputs={"Out": [out]},
         attrs=attrs,
     )

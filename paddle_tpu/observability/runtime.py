@@ -344,6 +344,47 @@ def set_decode_throughput(tokens_per_sec):
     _named(_m.gauge, "decode_tokens_per_sec").set(tokens_per_sec)
 
 
+def set_kv_pool(tenant, total, free):
+    """Paged-KV pool state after an allocate/free: the capacity-
+    planning gauges ``tools.monitor`` renders, plus the occupancy
+    ratio the ``--alert 'kv_pool_occupancy>0.9'`` predicate watches
+    (high occupancy means admissions are about to backpressure)."""
+    if not telemetry_enabled():
+        return
+    _m.gauge("kv_blocks_total", tenant=tenant).set(total)
+    _m.gauge("kv_blocks_free", tenant=tenant).set(free)
+    occ = 1.0 - free / float(total) if total else 0.0
+    _m.gauge("kv_pool_occupancy", tenant=tenant).set(occ)
+
+
+def record_kv_handoff(tenant, wait_ms, blocks):
+    """One prefill->decode KV-block handoff (disaggregated serving):
+    how long the finished prefill waited for a decode slot, and how
+    many pool blocks changed owner without a copy."""
+    if not telemetry_enabled():
+        return
+    _m.counter("serving_kv_handoffs_total", tenant=tenant).inc()
+    _m.counter("serving_kv_handoff_blocks_total",
+               tenant=tenant).inc(blocks)
+    _named(_m.histogram, "serving_kv_handoff_wait_ms").observe(wait_ms)
+
+
+def record_spec_round(tenant, proposed, accepted):
+    """One speculative-decoding verify round: ``proposed`` draft
+    tokens checked, ``accepted`` of them kept (the bonus token is not
+    counted on either side).  The cumulative ratio feeds the
+    ``spec_acceptance_rate`` gauge bench gates on."""
+    if not telemetry_enabled():
+        return
+    p = _m.counter("spec_tokens_proposed_total", tenant=tenant)
+    a = _m.counter("spec_tokens_accepted_total", tenant=tenant)
+    p.inc(proposed)
+    a.inc(accepted)
+    if p.value:
+        _m.gauge("spec_acceptance_rate",
+                 tenant=tenant).set(a.value / float(p.value))
+
+
 # ---------------------------------------------------------------------------
 # resilience runtime
 # ---------------------------------------------------------------------------

@@ -114,6 +114,112 @@ def flash_decode_attention(ctx, attrs, Q, KCache, VCache, Cursor):
     return out[:, :, None, :] if squeeze else out
 
 
+# ---------------------------------------------------------------------------
+# paged KV cache (ISSUE 19): fixed-size blocks + per-request block tables
+# ---------------------------------------------------------------------------
+
+
+def _norm_table(BlockTable, rows):
+    """int32 ``[rows, MB]`` block table (accepts a single ``[MB]``
+    row, broadcast is NOT implied — a 1-D table means rows == 1)."""
+    table = jnp.asarray(BlockTable, jnp.int32)
+    if table.ndim == 1:
+        table = table[None, :]
+    return table.reshape(rows, -1)
+
+
+@register_op("paged_kv_cache_write",
+             inputs=["Cache", "X", "Cursor", "BlockTable"],
+             outputs=["Out"], no_grad=True)
+def paged_kv_cache_write(ctx, attrs, Cache, X, Cursor, BlockTable):
+    """Write this step's K (or V) rows into the paged pool through the
+    block table.
+
+    Cache ``[N, H, BL, D]`` (the shared pool); X ``[S, H, D]`` (or
+    ``[S, H, 1, D]``); Cursor ``[S]`` with ``per_row=True`` (each
+    stream's own depth — the serving default) or ``[1]`` shared;
+    BlockTable ``[S, MB]`` int32, ``-1`` = unmapped.  Row ``s`` lands in
+    pool block ``table[s, cursor//BL]`` at offset ``cursor % BL``; a row
+    routed to an unmapped entry (or an inactive stream carrying ``-1``)
+    is dropped, leaving the pool untouched — the scatter-level
+    ownership guarantee the allocator's no-double-assign invariant
+    builds on."""
+    n, h, bl, d = Cache.shape
+    X = _norm_kv(X, Cache)[:, :, 0, :]                   # [S, H, D]
+    s = X.shape[0]
+    per_row = bool(attrs.get("per_row", True))
+    pos = _cursor_starts(Cursor, per_row, s)             # [S]
+    table = _norm_table(BlockTable, s)
+    blk = jnp.take_along_axis(
+        table, jnp.clip(pos // bl, 0, table.shape[1] - 1)[:, None],
+        axis=1)[:, 0]                                    # [S]
+    off = pos % bl
+    # unmapped → an out-of-range index that mode="drop" discards
+    blk = jnp.where(blk < 0, n, blk)
+    return Cache.at[blk, :, off, :].set(X, mode="drop")
+
+
+@register_op("paged_kv_cache_prefill",
+             inputs=["Cache", "X", "Len", "BlockTable"],
+             outputs=["Out"], no_grad=True)
+def paged_kv_cache_prefill(ctx, attrs, Cache, X, Len, BlockTable):
+    """Bulk-write a prompt's K/V rows into the table's blocks.
+
+    Cache ``[N, H, BL, D]``; X ``[1, H, L, D]`` (L static — the prompt
+    bucket); Len ``[1]`` int32 (real prompt length — padded positions
+    ``>= Len`` are dropped, not written); BlockTable ``[MB]`` (or
+    ``[1, MB]``).  Logical position ``p`` lands in block
+    ``table[p // BL]`` offset ``p % BL``."""
+    n, h, bl, d = Cache.shape
+    if X.ndim == 4:
+        X = X[0]
+    X = X.astype(Cache.dtype)                            # [H, L, D]
+    L = X.shape[1]
+    table = _norm_table(BlockTable, 1)[0]                # [MB]
+    pos = jnp.arange(L, dtype=jnp.int32)
+    blk = table[jnp.clip(pos // bl, 0, table.shape[0] - 1)]
+    off = pos % bl
+    ln = jnp.asarray(Len, jnp.int32).reshape(-1)[0]
+    blk = jnp.where((pos < ln) & (blk >= 0), blk, n)     # else dropped
+    Xl = jnp.transpose(X, (1, 0, 2))                     # [L, H, D]
+    return Cache.at[blk, :, off, :].set(Xl, mode="drop")
+
+
+@register_op("paged_flash_decode_attention",
+             inputs=["Q", "KCache", "VCache", "Cursor", "BlockTable"],
+             outputs=["Out"], no_grad=True)
+def paged_flash_decode_attention(ctx, attrs, Q, KCache, VCache, Cursor,
+                                 BlockTable):
+    """Single-query attention through the block table, masked to the
+    cursor.  Q ``[S, H, D]`` (or ``[S, H, 1, D]``); pool caches
+    ``[N, H, BL, D]``; Cursor = valid entries per stream (``per_row``
+    default true).  Rows are independent — the speculative-decoding
+    verify feeds ``k+1`` rows per stream with graduated cursors and
+    repeated table rows, scoring every draft position in ONE launch.
+    Pallas paged kernel on TPU past the ``decode`` family's engagement
+    threshold, gather + ring-oracle composite otherwise
+    (ops/pallas/paged_flash_decode.py)."""
+    from .pallas.paged_flash_decode import paged_flash_decode
+
+    squeeze = False
+    if Q.ndim == 4:
+        Q = Q[:, :, 0, :]
+        squeeze = True
+    s, h, d = Q.shape
+    bl = KCache.shape[2]
+    sm_scale = attrs.get("sm_scale")
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(d)
+    per_row = bool(attrs.get("per_row", True))
+    table = _norm_table(BlockTable, s)
+    lens = _cursor_starts(Cursor, per_row, s)
+    # at most the table's mapped depth is live
+    lens = jnp.minimum(lens, table.shape[1] * bl)
+    out = paged_flash_decode(Q, KCache, VCache, lens, table,
+                             sm_scale=float(sm_scale))
+    return out[:, :, None, :] if squeeze else out
+
+
 def _sampling_key(ctx, attrs, Step):
     """Deterministic per-(op, seed, step) key: the registry's derived
     base key, folded with the user seed and the loop index so every

@@ -12,29 +12,41 @@ from .buckets import (BUCKET_CAP_ENV, BUCKETS_ENV, DEFAULT_BUCKETS,
                       derive_buckets, parse_buckets, resolve_buckets)
 from .decode import DecodeEngine, DecodeRequest, GenerationConfig
 from .loadgen import make_feed_sampler, percentile, run_load
+from .paging import (PAGED_KV_ENV, BlockAllocator, KVPoolExhausted,
+                     blocks_needed, build_block_table,
+                     paged_kv_enabled)
 from .server import (DeadlineExceededError, DispatcherCrashedError,
                      PredictorServer, QueueFullError, Request,
                      ServerClosedError, ServingError)
+from .speculative import SpeculativeDecoder, ngram_draft
 
 __all__ = [
     "BUCKETS_ENV",
     "BUCKET_CAP_ENV",
+    "BlockAllocator",
     "DEFAULT_BUCKETS",
-    "SEQ_BUCKETS_ENV",
     "DeadlineExceededError",
     "DecodeEngine",
     "DecodeRequest",
     "DispatcherCrashedError",
     "GenerationConfig",
+    "KVPoolExhausted",
+    "PAGED_KV_ENV",
     "PredictorServer",
     "QueueFullError",
     "Request",
+    "SEQ_BUCKETS_ENV",
     "ServerClosedError",
     "ServingError",
     "ShapeBuckets",
+    "SpeculativeDecoder",
+    "blocks_needed",
     "bucket_cap",
+    "build_block_table",
     "derive_buckets",
     "make_feed_sampler",
+    "ngram_draft",
+    "paged_kv_enabled",
     "parse_buckets",
     "percentile",
     "resolve_buckets",

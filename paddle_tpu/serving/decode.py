@@ -30,6 +30,30 @@ program.  Telemetry: ``serving_decode_tokens_total``,
 ``decode_tokens_per_sec`` gauge (``tools.monitor``), plus
 ``serving.prefill`` / ``serving.decode`` spans so ``tools.trace
 --serving`` attributes time between the two phases.
+
+**Paged mode (ISSUE 19).**  When the model also supplies
+``build_prefill_paged`` / ``build_step_paged`` (and
+``PADDLE_TPU_PAGED_KV`` isn't ``0``), the resident cache becomes a
+paged pool ``[num_blocks, H, block_len, Dh]`` with a free-list
+(:mod:`~paddle_tpu.serving.paging`): a stream owns exactly
+``ceil(rows / block_len)`` blocks named by its block table instead of a
+full ``Tmax`` ring row, so the concurrent-stream count is bounded by
+ACTUAL cache usage, not by ``slots × Tmax`` reservations — the ≥4x
+streams-per-chip lever bench's A/B gates.  Admission allocates
+all-or-nothing (a short pool queues the request, never truncates it).
+
+**Disaggregated prefill (``disaggregate=True``, paged only).**  Prefill
+(compute-bound) runs on its own worker thread with its own programs;
+finished prefills hand the request to the decode scheduler as a
+**KV-block handoff** — ownership of the block-table entries transfers,
+the K/V rows never move.  The ``serving.kv_handoff`` span covers
+prefill-done → slot activation so ``tools.trace --serving`` splits
+TTFT into prefill vs handoff vs first decode step.  Both program
+families declare the pool vars as ``_kv_handoff_vars`` so the PR-10
+co-residency proof records the shared-write as a declared handoff
+(INFO) instead of rejecting the placement; device mutation is
+serialized through one executor lock (single-host co-residency — on a
+real disaggregated deployment the tenants hold different chips).
 """
 
 import threading
@@ -40,6 +64,8 @@ import numpy as np
 from ..observability import runtime as _obs
 from ..observability import tracing as _tr
 from .buckets import ShapeBuckets
+from .paging import (BlockAllocator, blocks_needed, build_block_table,
+                     paged_kv_enabled)
 
 __all__ = ["DecodeEngine", "DecodeRequest", "GenerationConfig"]
 
@@ -67,7 +93,7 @@ class DecodeRequest:
     ``{"generated_len", "ttft_ms", "latency_ms"}``."""
 
     __slots__ = ("id", "prompt", "enqueue_ts", "_event", "_tokens",
-                 "_error", "info", "span", "first_token_ts")
+                 "_error", "info", "span", "first_token_ts", "tenant")
 
     def __init__(self, rid, prompt):
         self.id = rid
@@ -79,6 +105,12 @@ class DecodeRequest:
         self.info = {}
         self.span = _tr.NULL_SPAN
         self.first_token_ts = None
+        self.tenant = None
+
+    @property
+    def latency_ms(self):
+        """Loadgen-compatible latency accessor (None until done)."""
+        return self.info.get("latency_ms")
 
     def done(self):
         return self._event.is_set()
@@ -110,13 +142,16 @@ class DecodeRequest:
 
 
 class _Slot:
-    __slots__ = ("request", "cursor", "tokens", "finished")
+    __slots__ = ("request", "cursor", "tokens", "finished", "blocks",
+                 "table")
 
     def __init__(self):
         self.request = None   # None == free cache block
         self.cursor = 0
         self.tokens = []
         self.finished = False
+        self.blocks = []      # paged mode: owned KV-pool block ids
+        self.table = None     # paged mode: [max_blocks] int32, -1 pad
 
 
 class DecodeEngine:
@@ -137,11 +172,27 @@ class DecodeEngine:
     plus ``model.cache_spec() -> (layers, heads, max_len, head_dim)``
     and optionally ``model.init_params(program, startup, exe, scope)``
     to load/initialize weights (called once inside the engine scope).
+
+    A model that ALSO supplies the paged builders opts into the paged
+    KV pool (unless ``PADDLE_TPU_PAGED_KV=0`` or ``paged=False``):
+
+    * ``model.build_prefill_paged(prompt, plen, table, caches)`` —
+      ``table`` ``[1, max_blocks]`` int32 (-1 padded); writes the
+      prompt's K/V through the block table (``paged_kv_cache_prefill``).
+    * ``model.build_step_paged(cur, cursors, tables, caches)`` —
+      ``tables`` ``[slots, max_blocks]``; per-row paged write +
+      ``paged_flash_decode`` masked to each row's cursor.
+
+    The paged cache shape is ``[num_blocks, H, block_len, Dh]``;
+    ``num_blocks`` defaults to ``slots * max_len / block_len`` (the
+    same HBM the ring reserved) but any pool size works — admission
+    backpressures on the free-list instead of on ``slots``.
     """
 
     def __init__(self, model, slots=2, prompt_buckets=(32,),
                  config=None, place=None, name="decode",
-                 auto_start=True):
+                 auto_start=True, paged=None, block_len=None,
+                 num_blocks=None, disaggregate=False):
         import paddle_tpu as fluid
         from ..executor import Scope
 
@@ -159,6 +210,47 @@ class DecodeEngine:
         for li in range(self._layers):
             self._cache_names.append(("%s.kcache.%d" % (name, li),
                                       "%s.vcache.%d" % (name, li)))
+        model_paged = (hasattr(model, "build_prefill_paged")
+                       and hasattr(model, "build_step_paged"))
+        if paged is None:
+            # auto: paged whenever the model can express it and the
+            # PADDLE_TPU_PAGED_KV kill switch isn't 0
+            paged = paged_kv_enabled() and model_paged
+        self.paged = bool(paged)
+        if self.paged and not model_paged:
+            raise ValueError(
+                "paged=True but model %r lacks build_prefill_paged/"
+                "build_step_paged" % (type(model).__name__,))
+        if self.paged:
+            from ..ops.pallas.paged_flash_decode import paged_block_len
+            bl = int(block_len) if block_len \
+                else paged_block_len(self._head_dim, self.max_len)
+            if self.max_len % bl != 0:
+                raise ValueError(
+                    "block_len %d must divide the cache depth %d (the "
+                    "full-depth block table is what keeps paged greedy "
+                    "bit-identical to the slot ring)"
+                    % (bl, self.max_len))
+            self.block_len = bl
+            self.max_blocks = self.max_len // bl
+            self._explicit_blocks = num_blocks is not None
+            # default pool = the HBM the slot ring would have reserved
+            self.num_blocks = int(num_blocks) if num_blocks \
+                else self.slots * self.max_blocks
+            self._pool = BlockAllocator(self.num_blocks, self.block_len)
+        else:
+            self.block_len = None
+            self.max_blocks = 0
+            self.num_blocks = 0
+            self._explicit_blocks = False
+            self._pool = None
+        self.disaggregate = bool(disaggregate)
+        if self.disaggregate and not self.paged:
+            raise ValueError("disaggregate=True requires paged KV mode "
+                             "(the handoff transfers block-table "
+                             "entries, not cache rows)")
+        self._handoff = []       # (req, blocks, table, first, ready_ts)
+        self._exe_lock = threading.Lock()
         self._slots = [_Slot() for _ in range(self.slots)]
         self._queue = []
         self._cond = threading.Condition()
@@ -173,6 +265,7 @@ class DecodeEngine:
         self._counts = {"submitted": 0, "completed": 0, "failed": 0,
                         "tokens": 0}
         self._build_programs()
+        self._publish_pool()
         if auto_start:
             self.start()
 
@@ -180,14 +273,28 @@ class DecodeEngine:
     # graph construction
     # ------------------------------------------------------------------
 
+    def _cache_shape(self):
+        if self.paged:
+            return [self.num_blocks, self._heads, self.block_len,
+                    self._head_dim]
+        return [self.slots, self._heads, self.max_len, self._head_dim]
+
+    @property
+    def cache_bytes(self):
+        """Resident KV bytes (K and V, every layer) — what the ring vs
+        paged-pool HBM-equality A/B compares."""
+        rows = 1
+        for d in self._cache_shape():
+            rows *= d
+        return rows * 4 * 2 * self._layers
+
     def _declare_caches(self, block):
         """Declare the persistable resident caches in ``block``'s
         program — every program family names the SAME vars, so they
         alias one buffer in the engine scope."""
         caches = []
+        shape = self._cache_shape()
         for kn, vn in self._cache_names:
-            shape = [self.slots, self._heads, self.max_len,
-                     self._head_dim]
             k = block.create_var(name=kn, shape=shape, dtype="float32",
                                  persistable=True)
             v = block.create_var(name=vn, shape=shape, dtype="float32",
@@ -196,6 +303,18 @@ class DecodeEngine:
         return caches
 
     def _build_programs(self):
+        if self.paged:
+            self._build_programs_paged()
+        else:
+            self._build_programs_ring()
+        self._exe.run(self._startup, scope=self.scope)
+        self._exe.run(self._init, scope=self.scope)
+        init_params = getattr(self.model, "init_params", None)
+        if init_params is not None:
+            init_params(self._step_prog, self._startup, self._exe,
+                        self.scope)
+
+    def _build_programs_ring(self):
         import paddle_tpu as fluid
 
         cfg = self.config
@@ -206,12 +325,11 @@ class DecodeEngine:
         startup = fluid.Program()
         with fluid.program_guard(init, startup):
             for k, v in self._declare_caches(init.global_block()):
-                fluid.layers.fill_constant(
-                    [self.slots, self._heads, self.max_len,
-                     self._head_dim], "float32", 0.0, out=k)
-                fluid.layers.fill_constant(
-                    [self.slots, self._heads, self.max_len,
-                     self._head_dim], "float32", 0.0, out=v)
+                fluid.layers.fill_constant(self._cache_shape(),
+                                           "float32", 0.0, out=k)
+                fluid.layers.fill_constant(self._cache_shape(),
+                                           "float32", 0.0, out=v)
+        self._init, self._startup = init, startup
 
         # prefill: one program per prompt-length bucket
         self._prefill = {}
@@ -255,11 +373,75 @@ class DecodeEngine:
         #: the program PredictorServer stamps/verifies as the hot loop
         self.program = main
 
-        self._exe.run(startup, scope=self.scope)
-        self._exe.run(init, scope=self.scope)
-        init_params = getattr(self.model, "init_params", None)
-        if init_params is not None:
-            init_params(self._step_prog, startup, self._exe, self.scope)
+    def _build_programs_paged(self):
+        import paddle_tpu as fluid
+
+        cfg = self.config
+        fluid.unique_name.switch()
+        mb = self.max_blocks
+
+        init = fluid.Program()
+        startup = fluid.Program()
+        with fluid.program_guard(init, startup):
+            for k, v in self._declare_caches(init.global_block()):
+                fluid.layers.fill_constant(self._cache_shape(),
+                                           "float32", 0.0, out=k)
+                fluid.layers.fill_constant(self._cache_shape(),
+                                           "float32", 0.0, out=v)
+        self._init, self._startup = init, startup
+
+        # the pool vars prefill WRITES and decode READS+WRITES: a
+        # declared KV-block handoff, not an accidental overlap — the
+        # co-residency proof downgrades it to INFO only when BOTH
+        # programs carry the declaration
+        handoff = frozenset(n for pair in self._cache_names
+                            for n in pair)
+
+        self._prefill = {}
+        for L in self.buckets.seq_sizes:
+            main = fluid.Program()
+            with fluid.program_guard(main, startup):
+                prompt = fluid.layers.data(
+                    "prompt_ids", shape=[1, L], dtype="int32",
+                    append_batch_size=False)
+                plen = fluid.layers.data(
+                    "prompt_len", shape=[1], dtype="int32",
+                    append_batch_size=False)
+                table = fluid.layers.data(
+                    "block_table", shape=[1, mb], dtype="int32",
+                    append_batch_size=False)
+                caches = self._declare_caches(main.global_block())
+                logits = self.model.build_prefill_paged(
+                    prompt, plen, table, caches)
+                first = fluid.layers.sampling(
+                    logits, strategy=cfg.strategy, k=cfg.k, p=cfg.p,
+                    temperature=cfg.temperature, seed=cfg.seed)
+            main._kv_handoff_vars = handoff
+            self._prefill[L] = (main, first.name)
+
+        main = fluid.Program()
+        with fluid.program_guard(main, startup):
+            cur = fluid.layers.data("cur_ids", shape=[self.slots],
+                                    dtype="int32",
+                                    append_batch_size=False)
+            cursors = fluid.layers.data("cursors", shape=[self.slots],
+                                        dtype="int32",
+                                        append_batch_size=False)
+            tables = fluid.layers.data(
+                "block_tables", shape=[self.slots, mb], dtype="int32",
+                append_batch_size=False)
+            step = fluid.layers.data("step", shape=[1], dtype="int32",
+                                     append_batch_size=False)
+            caches = self._declare_caches(main.global_block())
+            logits = self.model.build_step_paged(cur, cursors, tables,
+                                                 caches)
+            nxt = fluid.layers.sampling(
+                logits, strategy=cfg.strategy, k=cfg.k, p=cfg.p,
+                temperature=cfg.temperature, seed=cfg.seed, step=step)
+        main._kv_handoff_vars = handoff
+        self._step_prog, self._step_fetch = main, nxt.name
+        #: the program PredictorServer stamps/verifies as the hot loop
+        self.program = main
 
     # the PredictorServer tenant-introspection surface
     def get_input_names(self):
@@ -267,6 +449,21 @@ class DecodeEngine:
 
     def get_output_names(self):
         return [self._step_fetch]
+
+    def coresident_programs(self):
+        """Every program family this engine keeps resident, as
+        ``(label, program, fetch_targets)``.  With disaggregated
+        prefill the prefill programs run on their own thread against
+        the same scope, so the PredictorServer placement proof and
+        zero-sync certification must cover them too — not just the hot
+        step loop."""
+        progs = [(self.name, self._step_prog, [self._step_fetch])]
+        if self.disaggregate:
+            for L in sorted(self._prefill):
+                main, fetch = self._prefill[L]
+                progs.append(("%s.prefill%d" % (self.name, L), main,
+                              [fetch]))
+        return progs
 
     # ------------------------------------------------------------------
     # client side
@@ -287,12 +484,23 @@ class DecodeEngine:
                 "prompt of %d tokens exceeds the largest prompt "
                 "bucket (%d)" % (prompt.size,
                                  self.buckets.seq_sizes[-1]))
+        if self.paged:
+            need = blocks_needed(
+                min(int(prompt.size) + self.config.max_new_tokens,
+                    self.max_len), self.block_len)
+            if need > self.num_blocks:
+                raise ValueError(
+                    "prompt + generation budget needs %d KV blocks but "
+                    "the pool holds %d (block_len=%d) — it could never "
+                    "be admitted" % (need, self.num_blocks,
+                                     self.block_len))
         with self._cond:
             if self._closed:
                 raise RuntimeError("decode engine is closed")
             rid = request_id if request_id is not None \
                 else len(self._queue) + self._counts["submitted"]
             req = DecodeRequest(rid, prompt)
+            req.tenant = self.name
             req.span = _tr.start_span("serving.request",
                                       tenant=self.name, request_id=rid,
                                       prompt_len=int(prompt.size))
@@ -317,16 +525,22 @@ class DecodeEngine:
             target=self._loop, daemon=True,
             name="paddle_tpu-decode-%s" % self.name)
         self._thread.start()
+        if self.disaggregate:
+            self._prefill_thread = threading.Thread(
+                target=self._prefill_loop, daemon=True,
+                name="paddle_tpu-prefill-%s" % self.name)
+            self._prefill_thread.start()
         return self
 
     def close(self, timeout=60.0):
         with self._cond:
             self._closed = True
             self._cond.notify_all()
-        t = getattr(self, "_thread", None)
-        if t is not None:
-            t.join(timeout)
-            self._thread = None
+        for attr in ("_thread", "_prefill_thread"):
+            t = getattr(self, attr, None)
+            if t is not None:
+                t.join(timeout)
+                setattr(self, attr, None)
 
     def resize(self, slots, timeout=60.0):
         """Scale the KV-cache slot count in place — the autoscaler's
@@ -351,7 +565,8 @@ class DecodeEngine:
             deadline = time.time() + timeout
             while True:
                 with self._cond:
-                    if not self._active() and self._admitting == 0:
+                    if (not self._active() and self._admitting == 0
+                            and not self._handoff):
                         break
                 if time.time() > deadline:
                     raise TimeoutError(
@@ -361,7 +576,13 @@ class DecodeEngine:
             old = self.slots
             self.slots = slots
             self._slots = [_Slot() for _ in range(slots)]
+            if self.paged:
+                if not self._explicit_blocks:
+                    self.num_blocks = slots * self.max_blocks
+                self._pool = BlockAllocator(self.num_blocks,
+                                            self.block_len)
             self._build_programs()
+            self._publish_pool()
             _obs.record_decode_resize(self.name, old, slots)
         finally:
             with self._cond:
@@ -378,16 +599,56 @@ class DecodeEngine:
     def _active(self):
         return [s for s in self._slots if s.request is not None]
 
+    def _work_ready(self):
+        if self.disaggregate:
+            # queued requests belong to the prefill worker; the decode
+            # loop acts on handoffs and active slots only
+            return bool(self._handoff) or bool(self._active())
+        return bool(self._queue) or bool(self._active())
+
+    def _drained(self):
+        return (not self._queue and not self._handoff
+                and self._admitting == 0 and not self._active())
+
+    def _publish_pool(self):
+        if self.paged:
+            with self._cond:
+                free = self._pool.num_free
+            _obs.set_kv_pool(self.name, self._pool.num_blocks, free)
+
+    def _blocks_for(self, req):
+        """Blocks to reserve at admission: the whole prompt plus the
+        full generation budget, all-or-nothing — a short pool delays
+        the request, it never truncates it."""
+        rows = min(int(req.prompt.size) + self.config.max_new_tokens,
+                   self.max_len)
+        return blocks_needed(rows, self.block_len)
+
+    def _fail_all(self, exc):
+        with self._cond:  # fail everything pending; never strand a
+            self._closed = True                            # caller
+            pending = self._queue
+            self._queue = []
+            pending.extend(rec[0] for rec in self._handoff)
+            self._handoff = []
+            self._cond.notify_all()
+        for s in self._slots:
+            if s.request is not None:
+                pending.append(s.request)
+                s.request = None
+        for r in pending:
+            if not r.done():
+                r._fail(exc)
+                self._count("failed")
+
     def _loop(self):
         try:
             while True:
                 with self._cond:
-                    while (not self._closed and not self._queue
-                           and not self._active()):
+                    while not self._work_ready():
+                        if self._closed and self._drained():
+                            return
                         self._cond.wait(0.05)
-                    if (self._closed and not self._queue
-                            and not self._active()):
-                        return
                 self._admit()
                 if self._active():
                     self._step()
@@ -395,58 +656,130 @@ class DecodeEngine:
                     # admissions are held while a resize drains; yield
                     # so the resizer sees the idle point promptly
                     time.sleep(0.005)
-        except Exception as exc:  # noqa: BLE001 — fail everything
-            with self._cond:     # pending; never strand a caller
-                self._closed = True
-                pending = self._queue
-                self._queue = []
-            for s in self._slots:
-                if s.request is not None:
-                    pending.append(s.request)
-                    s.request = None
-            for r in pending:
-                if not r.done():
-                    r._fail(exc)
-                    self._count("failed")
+        except Exception as exc:  # noqa: BLE001
+            self._fail_all(exc)
+
+    def _run_prefill(self, req, table=None, slot=None):
+        """Run the bucketed prefill program for ``req``; returns the
+        first sampled token.  Ring mode feeds the slot index, paged
+        mode the block table."""
+        L = self.buckets.bucket_for_seq(req.prompt.size)
+        padded = np.zeros((1, L), dtype="int32")
+        padded[0, :req.prompt.size] = req.prompt
+        main, fetch = self._prefill[L]
+        feed = {"prompt_ids": padded,
+                "prompt_len": np.asarray([req.prompt.size], "int32")}
+        attrs = dict(tenant=self.name, bucket=L,
+                     prompt_len=int(req.prompt.size))
+        if self.paged:
+            feed["block_table"] = table.reshape(1, self.max_blocks)
+            attrs["blocks"] = int((table >= 0).sum())
+        else:
+            feed["slot"] = np.asarray([slot], "int32")
+        if slot is not None:
+            attrs["slot"] = slot
+        with _tr.span("serving.prefill", parent=req.span, **attrs):
+            with self._exe_lock:
+                out = self._exe.run(main, feed=feed,
+                                    fetch_list=[fetch],
+                                    scope=self.scope)
+        return int(np.asarray(out[0]).reshape(-1)[0])
+
+    def _activate(self, free, req, first, blocks, table):
+        with self._cond:
+            slot = self._slots[free]
+            slot.request = req
+            slot.cursor = int(req.prompt.size)
+            slot.tokens = [first]
+            slot.finished = (self.config.eos_id is not None
+                             and first == self.config.eos_id)
+            slot.blocks = blocks
+            slot.table = table
+            self._cond.notify_all()
 
     def _admit(self):
         """Fill free cache blocks from the queue: one prefill run per
         admission, between decode steps — the other slots' caches and
-        cursors are untouched (their rows in the [slots, ...] buffer
-        are not written by this slot's kv_cache_prefill)."""
+        cursors are untouched.  Disaggregated mode instead drains the
+        prefill worker's finished handoffs into free slots."""
+        if self.disaggregate:
+            self._drain_handoffs()
+            return
         while True:
             free = next((i for i, s in enumerate(self._slots)
                          if s.request is None), None)
             with self._cond:
                 if self._resizing or free is None or not self._queue:
                     return
+                if self.paged:
+                    need = self._blocks_for(self._queue[0])
+                    if not self._pool.can_allocate(need):
+                        return  # backpressure: wait for a retirement
+                    blocks = self._pool.allocate(need)
+                else:
+                    blocks = []
                 req = self._queue.pop(0)
                 self._admitting += 1
-            L = self.buckets.bucket_for_seq(req.prompt.size)
-            padded = np.zeros((1, L), dtype="int32")
-            padded[0, :req.prompt.size] = req.prompt
-            main, fetch = self._prefill[L]
-            with _tr.span("serving.prefill", parent=req.span,
-                          tenant=self.name, slot=free, bucket=L,
-                          prompt_len=int(req.prompt.size)):
-                out = self._exe.run(
-                    main,
-                    feed={"prompt_ids": padded,
-                          "prompt_len": np.asarray([req.prompt.size],
-                                                   "int32"),
-                          "slot": np.asarray([free], "int32")},
-                    fetch_list=[fetch], scope=self.scope)
-            first = int(np.asarray(out[0]).reshape(-1)[0])
+            table = build_block_table(blocks, self.max_blocks) \
+                if self.paged else None
+            first = self._run_prefill(req, table=table, slot=free)
             req.first_token_ts = time.time()
+            self._activate(free, req, first, blocks, table)
             with self._cond:
-                slot = self._slots[free]
-                slot.request = req
-                slot.cursor = int(req.prompt.size)
-                slot.tokens = [first]
-                slot.finished = (self.config.eos_id is not None
-                                 and first == self.config.eos_id)
                 self._admitting -= 1
                 self._cond.notify_all()
+            self._publish_pool()
+
+    def _drain_handoffs(self):
+        """Activate finished prefills: ownership of the KV-pool blocks
+        transfers from the prefill tenant to a decode slot — the K/V
+        rows themselves never move (zero-copy handoff)."""
+        while True:
+            free = next((i for i, s in enumerate(self._slots)
+                         if s.request is None), None)
+            with self._cond:
+                if free is None or not self._handoff:
+                    return
+                req, blocks, table, first, ready_ts = \
+                    self._handoff.pop(0)
+            wait_ms = (time.time() - ready_ts) * 1000.0
+            _tr.start_span("serving.kv_handoff", parent=req.span,
+                           start_ts=ready_ts, tenant=self.name,
+                           slot=free, blocks=len(blocks)).end(
+                dur_ms=wait_ms)
+            _obs.record_kv_handoff(self.name, wait_ms, len(blocks))
+            self._activate(free, req, first, blocks, table)
+
+    def _prefill_loop(self):
+        """Disaggregated-prefill worker: its own thread, its own
+        program family, shared scope.  Allocates the request's blocks,
+        prefills through the block table, then posts the handoff
+        record for the decode scheduler to activate."""
+        try:
+            while True:
+                with self._cond:
+                    while True:
+                        if self._closed and not self._queue:
+                            return
+                        if (self._queue and not self._resizing
+                                and self._pool.can_allocate(
+                                    self._blocks_for(self._queue[0]))):
+                            break
+                        self._cond.wait(0.05)
+                    req = self._queue.pop(0)
+                    blocks = self._pool.allocate(self._blocks_for(req))
+                    self._admitting += 1
+                table = build_block_table(blocks, self.max_blocks)
+                first = self._run_prefill(req, table=table)
+                req.first_token_ts = time.time()
+                with self._cond:
+                    self._handoff.append((req, blocks, table, first,
+                                          time.time()))
+                    self._admitting -= 1
+                    self._cond.notify_all()
+                self._publish_pool()
+        except Exception as exc:  # noqa: BLE001
+            self._fail_all(exc)
 
     def _step(self):
         """One decode step for every active slot (one jit signature),
@@ -460,15 +793,22 @@ class DecodeEngine:
                 cursors[i] = s.cursor
                 active.append(i)
         if active:
+            feed = {"cur_ids": cur, "cursors": cursors}
+            if self.paged:
+                tables = np.full((self.slots, self.max_blocks), -1,
+                                 dtype="int32")
+                for i in active:
+                    tables[i] = self._slots[i].table
+                feed["block_tables"] = tables
             self._step_count += 1
+            feed["step"] = np.asarray([self._step_count], "int32")
             with _tr.span("serving.decode_step", tenant=self.name,
                           step=self._step_count, active=len(active)):
-                out = self._exe.run(
-                    self._step_prog,
-                    feed={"cur_ids": cur, "cursors": cursors,
-                          "step": np.asarray([self._step_count],
-                                             "int32")},
-                    fetch_list=[self._step_fetch], scope=self.scope)
+                with self._exe_lock:
+                    out = self._exe.run(
+                        self._step_prog, feed=feed,
+                        fetch_list=[self._step_fetch],
+                        scope=self.scope)
             nxt = np.asarray(out[0]).reshape(-1)
             now = time.time()
             if self._rate_t0 is None:
@@ -496,6 +836,13 @@ class DecodeEngine:
             if s.finished or full:
                 req = s.request
                 s.request = None
+                if self.paged and s.blocks:
+                    with self._cond:
+                        self._pool.free(s.blocks)
+                        self._cond.notify_all()  # wake admission
+                    s.blocks = []
+                    s.table = None
+                    self._publish_pool()
                 # retroactive per-request decode span (first token →
                 # done) so `tools.trace --serving` splits the request's
                 # critical path into prefill vs decode
@@ -523,8 +870,19 @@ class DecodeEngine:
             counts = dict(self._counts)
         with self._cond:
             counts["queue_depth"] = len(self._queue)
+            counts["handoff_depth"] = len(self._handoff)
+            free = self._pool.num_free if self.paged else 0
         counts["active_slots"] = len(self._active())
         counts["slots"] = self.slots
         counts["prompt_buckets"] = list(self.buckets.seq_sizes)
         counts["decode_steps"] = self._step_count
+        counts["paged"] = self.paged
+        counts["disaggregated"] = self.disaggregate
+        counts["kv_cache_bytes"] = self.cache_bytes
+        if self.paged:
+            counts["block_len"] = self.block_len
+            counts["kv_blocks_total"] = self._pool.num_blocks
+            counts["kv_blocks_free"] = free
+            counts["kv_pool_occupancy"] = \
+                1.0 - free / float(self._pool.num_blocks)
         return counts

@@ -265,9 +265,19 @@ class PredictorServer:
         labels = list(self._tenants)
         # decode engines co-reside too: their step program names the
         # resident caches, so a cache-name collision between tenants is
-        # caught here
-        programs += [e.program for e in self._engines.values()]
-        labels += list(self._engines)
+        # caught here.  A disaggregated engine contributes ALL its
+        # resident program families (prefill runs on its own thread
+        # against the same scope) — the pool overlap between them is a
+        # declared KV-block handoff, not an accidental collision
+        for name, eng in self._engines.items():
+            co = getattr(eng, "coresident_programs", None)
+            if co is not None:
+                for label, prog, _targets in co():
+                    programs.append(prog)
+                    labels.append(label)
+            else:
+                programs.append(eng.program)
+                labels.append(name)
         if len(programs) < 2:
             return
         _fp, diags = prove_scope_isolation(programs, labels=labels)
@@ -286,20 +296,30 @@ class PredictorServer:
         from ..static_analysis.concurrency import (certify_zero_sync,
                                                    verify_async_hot_path)
 
-        holders = [(t.name, t.predictor) for t in self._tenants.values()]
+        entries = []
+        for t in self._tenants.values():
+            prog = t.predictor.program
+            targets = []
+            get = getattr(t.predictor, "get_output_names", None)
+            if get is not None:
+                targets = list(get())
+            entries.append((t.name, prog, targets))
         # a decode engine's hot loop is its step program — the one the
-        # slot scheduler re-runs every generated token
-        holders += list(self._engines.items())
-        for name, holder in holders:
-            prog = holder.program
+        # slot scheduler re-runs every generated token.  Disaggregated
+        # engines also run their prefill programs concurrently, so
+        # those get stamped + certified under "name.prefillL" labels
+        for name, eng in self._engines.items():
+            co = getattr(eng, "coresident_programs", None)
+            if co is not None:
+                entries.extend(co())
+            else:
+                entries.append((name, eng.program,
+                                list(eng.get_output_names())))
+        for name, prog, targets in entries:
             prog._serving_hot_loop = True
             prog._max_in_flight = max(
                 self._max_in_flight,
                 int(getattr(prog, "_max_in_flight", 1) or 1))
-            targets = []
-            get = getattr(holder, "get_output_names", None)
-            if get is not None:
-                targets = list(get())
             if verify:
                 verify_async_hot_path(prog, targets=targets,
                                       max_in_flight=self._max_in_flight,

@@ -1613,3 +1613,94 @@ def check_decode_shape_unbucketed(ctx):
                             grown[n] = grown[next(iter(hit))]
                 if b_op.type in _VIEW_OPS and tainted.intersection(ins):
                     tainted.update(outs)
+
+
+#: size floor (bytes) below which a slot-ring KV cache is not worth
+#: paging — the block table + free-list overhead beats the saving
+PAGED_MIN_BYTES_ENV = "PADDLE_TPU_PAGED_MIN_BYTES"
+DEFAULT_PAGED_MIN_BYTES = 4 << 20
+
+
+def paged_min_bytes():
+    import os
+
+    raw = os.environ.get(PAGED_MIN_BYTES_ENV)
+    if raw:
+        try:
+            return int(raw)
+        except ValueError:
+            pass
+    return DEFAULT_PAGED_MIN_BYTES
+
+
+@register_check("decode-cache-unpaged")
+def check_decode_cache_unpaged(ctx):
+    """Advisory twin of the paged-KV serving path (ISSUE 19): a large
+    persistable slot-ring KV cache written by ``kv_cache_write`` /
+    ``kv_cache_prefill`` that would run through the paged pool
+    (``paged_kv_cache_*`` + ``DecodeEngine`` paged mode) instead.  The
+    slot ring reserves ``Tmax`` rows per stream no matter how short
+    the stream actually runs; the paged pool bounds that internal
+    fragmentation at one ``block_len`` block per stream, which is the
+    whole streams-per-chip lever.  Mirrors the reason discipline of
+    ``fusible-pattern-not-fused``: names the kill switch when
+    ``PADDLE_TPU_PAGED_KV=0`` is the blocker, otherwise points at the
+    missing paged builders.  Gated by ``PADDLE_TPU_PAGED_MIN_BYTES``
+    (default 4 MiB) so toy caches stay quiet."""
+    from .cost import dtype_bytes
+
+    floor = paged_min_bytes()
+    seen = set()
+    for block in ctx.program.blocks:
+        for op_idx, op in enumerate(block.ops):
+            if op.type not in ("kv_cache_write", "kv_cache_prefill"):
+                continue
+            names = op.inputs.get("Cache", [])
+            if not names or names[0] in seen:
+                continue
+            name = names[0]
+            v = block._find_var_recursive(name)
+            if v is None or not getattr(v, "persistable", False):
+                continue
+            shape = [int(d) for d in (v.shape or [])]
+            if len(shape) != 4 or any(d <= 0 for d in shape):
+                continue
+            seen.add(name)
+            slots, heads, tmax, dh = shape
+            nbytes = slots * heads * tmax * dh * dtype_bytes(v.dtype)
+            if nbytes < floor:
+                continue
+            try:
+                from ..ops.pallas.paged_flash_decode import \
+                    paged_block_len
+                from ..serving.paging import paged_kv_enabled
+                bl = paged_block_len(dh, tmax)
+                enabled = paged_kv_enabled()
+            except Exception:  # pragma: no cover - serving stack absent
+                bl, enabled = 16, True
+            # the ring's worst-case idle reservation is the full Tmax
+            # row per stream; paging bounds it at one block
+            saving = 100.0 * (1.0 - bl / float(tmax)) if tmax else 0.0
+            if not enabled:
+                reason = ("disabled by the PADDLE_TPU_PAGED_KV=0 kill "
+                          "switch")
+                hint = ("unset PADDLE_TPU_PAGED_KV to let a "
+                        "paged-capable model use the pool")
+            else:
+                reason = ("the program builds the slot-ring path only "
+                          "(no paged_kv_cache_* ops)")
+                hint = ("give the model build_prefill_paged/"
+                        "build_step_paged (layers.paged_kv_cache_"
+                        "prefill/write + layers.paged_flash_decode) — "
+                        "DecodeEngine pages it automatically")
+            yield ctx.diag(
+                "decode-cache-unpaged", Severity.INFO,
+                "persistable KV cache %r ([%d, %d, %d, %d], %d bytes) "
+                "is slot-ring managed: every stream reserves the full "
+                "%d-row depth up front; paging (block_len=%d) would "
+                "bound idle reservation at one block — up to %.0f%% "
+                "less HBM fragmentation per stream: %s"
+                % (name, slots, heads, tmax, dh, nbytes, tmax, bl,
+                   saving, reason),
+                block_idx=block.idx, op_idx=op_idx, op=op,
+                var_names=(name,), hint=hint)

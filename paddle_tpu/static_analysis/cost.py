@@ -307,8 +307,30 @@ def _kv_cache_write_flops(op, ins, outs):
 
 
 @register_flops("kv_cache_prefill")
+@register_flops("paged_kv_cache_write")
+@register_flops("paged_kv_cache_prefill")
 def _kv_cache_prefill_flops(op, ins, outs):
+    # paged or ring, a cache fill is a scatter of X's rows — the block
+    # table adds an [S] (or [L]) index gather, which rounds to zero
     return ins[1].local_numel or 0 if len(ins) > 1 else 0
+
+
+@register_flops("paged_flash_decode_attention")
+def _paged_flash_decode_flops(op, ins, outs):
+    # same two matvecs + online softmax as the ring kernel, but the
+    # static worst case is the TABLE depth MB·BL (the request's owned
+    # blocks), not a monolithic Tmax — paging's capacity win shows up
+    # in the cost model as a per-stream, not per-slot, charge.
+    # ins: Q [S,H,D], KCache [N,H,BL,D], VCache, Cursor, BlockTable
+    # [S,MB]
+    if (len(ins) < 5 or not ins[1].shape or len(ins[1].shape) != 4
+            or not ins[4].shape or len(ins[4].shape) < 1):
+        return 2 * _out_numel(outs)
+    _n, h, bl, dh = (max(int(d), 1) for d in ins[1].shape)
+    mb = max(int(ins[4].shape[-1]), 1)
+    s = max(int(ins[0].shape[0]), 1) if ins[0].shape else 1
+    t = mb * bl
+    return 4 * s * h * t * dh + 5 * s * h * t
 
 
 @register_flops("top_k_sampling")

@@ -104,7 +104,7 @@ FUSED_FORWARD_OP_TYPES = frozenset((
     # by a rewrite here — listed so the matchers and the
     # fused-op-missing-grad lint treat it as an already-fused kernel
     # (forward-only by design: generation is inference)
-    "flash_decode_attention",
+    "flash_decode_attention", "paged_flash_decode_attention",
 ))
 
 _ACT_TYPES = ("relu", "gelu", "tanh", "sigmoid", "relu6", "leaky_relu",

@@ -300,6 +300,8 @@ def _reducescatter_transfer(op, in_vals, out_val):
 
 @register_transfer("kv_cache_write")
 @register_transfer("kv_cache_prefill")
+@register_transfer("paged_kv_cache_write")
+@register_transfer("paged_kv_cache_prefill")
 def _kv_cache_transfer(op, in_vals, out_val):
     # the output IS the cache (ring-buffer update): it keeps the cache's
     # placement.  The default join would degrade to UNKNOWN whenever the
@@ -310,6 +312,7 @@ def _kv_cache_transfer(op, in_vals, out_val):
 
 
 @register_transfer("flash_decode_attention")
+@register_transfer("paged_flash_decode_attention")
 def _flash_decode_transfer(op, in_vals, out_val):
     # out [B,H,D] follows the query row's placement (batch-sharded
     # serving slots stay batch-sharded); the cache inputs don't shard

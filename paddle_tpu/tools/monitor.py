@@ -375,6 +375,17 @@ def collect_status(dirname, hb_dir=None, now=None,
     dec_len_p50 = _hist_percentile(dec_len, 50) if dec_len else None
     dec_len_p99 = _hist_percentile(dec_len, 99) if dec_len else None
     dec_tps = _metric_value(merged, "decode_tokens_per_sec")
+    # paged-KV pool: totals sum across engines, occupancy takes the
+    # WORST engine (the one about to backpressure admissions)
+    kv_total = _metric_value(merged, "kv_blocks_total")
+    kv_free = _metric_value(merged, "kv_blocks_free")
+    kv_occ = _metric_max(merged, "kv_pool_occupancy")
+    kv_handoffs = _metric_value(merged, "serving_kv_handoffs_total")
+    # acceptance from the raw counters so multi-tenant rates merge as
+    # a true token-weighted ratio, not an average of gauges
+    spec_prop = _metric_value(merged, "spec_tokens_proposed_total")
+    spec_acc = _metric_value(merged, "spec_tokens_accepted_total")
+    spec_rate = (spec_acc or 0.0) / spec_prop if spec_prop else None
 
     # elastic view (resilience/elastic + autoscale): world/epoch from
     # the gauges when a live snapshot exists, else from the membership
@@ -455,6 +466,15 @@ def collect_status(dirname, hb_dir=None, now=None,
                               else round(dec_len_p99, 1)),
         "decode_tokens_per_sec": (None if dec_tps is None
                                   else round(dec_tps, 3)),
+        "kv_blocks_total": (None if kv_total is None
+                            else int(kv_total)),
+        "kv_blocks_free": (None if kv_free is None else int(kv_free)),
+        "kv_pool_occupancy": (None if kv_occ is None
+                              else round(kv_occ, 4)),
+        "kv_handoffs": (None if kv_handoffs is None
+                        else int(kv_handoffs)),
+        "spec_acceptance_rate": (None if spec_rate is None
+                                 else round(spec_rate, 4)),
         "elastic_world_size": (None if elastic_world is None
                                else int(elastic_world)),
         "membership_epoch": (None if membership_epoch is None
@@ -561,6 +581,17 @@ def render_status(status):
                 _fmt(status["decode_tokens_per_sec"]),
                 _fmt(status["p50_generated_len"]),
                 _fmt(status["p99_generated_len"])))
+    if status.get("kv_blocks_total") is not None:
+        kv = "  kv_pool: blocks=%s free=%s occupancy=%s" % (
+            _fmt(status["kv_blocks_total"]),
+            _fmt(status["kv_blocks_free"]),
+            _fmt(status["kv_pool_occupancy"]))
+        if status.get("kv_handoffs") is not None:
+            kv += "  handoffs=%s" % _fmt(status["kv_handoffs"])
+        if status.get("spec_acceptance_rate") is not None:
+            kv += "  spec_accept=%s" % _fmt(
+                status["spec_acceptance_rate"])
+        lines.append(kv)
     if status.get("elastic_world_size") is not None \
             or status.get("pending_joins"):
         lines.append("  elastic: world=%s  epoch=%s  pending_joins=%s"
@@ -632,7 +663,14 @@ def main(argv=None):
                          "'serving_shed_rate>0'; decode tenants add "
                          "'decode_tokens_per_sec<100' / "
                          "'serving_decode_tokens==0' / "
-                         "'p99_generated_len>512'; quantized-collective "
+                         "'p99_generated_len>512'; paged-KV serving "
+                         "adds 'kv_pool_occupancy>0.9' (the worst "
+                         "engine's pool is nearly exhausted — "
+                         "admissions are about to backpressure) / "
+                         "'kv_blocks_free==0' / "
+                         "'spec_acceptance_rate<0.3' (the draft "
+                         "stopped paying for itself); "
+                         "quantized-collective "
                          "jobs add 'quant_error>0.05' (worst per-bucket "
                          "int8 error) / 'quant_error_ratio>2' (error "
                          "model drift); elastic jobs add "

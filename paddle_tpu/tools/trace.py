@@ -210,6 +210,9 @@ def serving_stats(traces):
                         # decode tenants: prompt ingest vs per-token
                         # generation — the TTFT / steady-state split
                         ("serving.prefill", "prefill"),
+                        # disaggregated serving: finished-prefill ->
+                        # decode-slot block handoff, the third TTFT leg
+                        ("serving.kv_handoff", "kv_handoff"),
                         ("serving.decode", "decode")):
         if name in stats["phases"]:
             stats["%s_p50_ms" % alias] = stats["phases"][name]["p50_ms"]

@@ -53,6 +53,9 @@ class TinyModel:
         k, v = caches[0]
         fluid.layers.kv_cache_prefill(k, x, slot=slot)
         fluid.layers.kv_cache_prefill(v, x, slot=slot)
+        return self._prefill_logits(pf, plen, L)
+
+    def _prefill_logits(self, pf, plen, L):
         idx = fluid.layers.increment(fluid.layers.assign(plen),
                                      value=-1, in_place=True)
         oh = fluid.layers.cast(fluid.layers.one_hot(
@@ -74,6 +77,9 @@ class TinyModel:
         fluid.layers.kv_cache_write(k, x, cursors, per_row=True)
         fluid.layers.kv_cache_write(v, x, cursors, per_row=True)
         att = fluid.layers.flash_decode(x, k, v, cursors, per_row=True)
+        return self._step_logits(cf, att, S)
+
+    def _step_logits(self, cf, att, S):
         zero = fluid.layers.scale(
             fluid.layers.reduce_sum(att, dim=[1, 2]), 0.0)  # [S]
         nxt = fluid.layers.cast(

@@ -235,6 +235,13 @@ def build_steps():
     # the full world; emits elastic_rejoin_ms (vs the 60s restart
     # budget) + autoscale_decision_correct (SLO policy triple gate)
     item("bench_autoscale", "autoscale", 480, 420)
+    # ISSUE-14/19 decode + paged serving on the real chips: KV-cache
+    # vs naive-recompute tokens/sec, the flash-decode min_t micro-sweep
+    # (writes the autotune decode_min_t engagement threshold for this
+    # backend), then the paged-pool arms — stream capacity vs the slot
+    # ring at equal HBM, kill-switch restore, disaggregated
+    # prefill/decode certificates, ngram speculation
+    item("bench_decode", "decode", 480, 420)
     # space-to-depth stem (models/resnet.py _s2d_stem): folds the 7x7
     # stride-2 3-channel stem — the classic MXU-underfill — into a
     # dense 4x4/s1 conv over 12 channels (the TPU ResNet stem recipe)
